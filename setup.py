@@ -1,0 +1,39 @@
+"""Build hooks: generate protobuf message modules with `protoc` at build time.
+
+The reference project runs gRPC codegen inside its build
+(/root/reference/setup.py:10-40, via grpcio-tools).  This environment has no
+`grpc_tools` wheel, so we shell out to the system `protoc` binary for the
+message classes and ship hand-written service bindings
+(vllm_tgis_adapter_tpu/grpc/pb/rpc.py) instead of protoc-plugin-generated
+stubs.
+"""
+
+from __future__ import annotations
+
+import subprocess
+from pathlib import Path
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+
+def generate_protos(root: Path) -> None:
+    pb_dir = root / "vllm_tgis_adapter_tpu" / "grpc" / "pb"
+    for proto in sorted(pb_dir.glob("*.proto")):
+        subprocess.check_call(
+            [
+                "protoc",
+                f"--proto_path={pb_dir}",
+                f"--python_out={pb_dir}",
+                str(proto),
+            ]
+        )
+
+
+class BuildPyWithProtoGen(build_py):
+    def run(self) -> None:
+        generate_protos(Path(__file__).parent)
+        super().run()
+
+
+setup(cmdclass={"build_py": BuildPyWithProtoGen})

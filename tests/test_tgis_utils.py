@@ -1,0 +1,171 @@
+"""Unit tests for the env-var argument system (reference model: tests/test_tgis_utils.py)."""
+
+from __future__ import annotations
+
+import argparse
+
+import pytest
+
+from vllm_tgis_adapter_tpu.tgis_utils.args import (
+    EnvVarArgumentParser,
+    StoreBoolean,
+    add_tgis_args,
+    make_parser,
+    postprocess_tgis_args,
+)
+
+
+def _parser_with(arg_name: str, **kwargs) -> EnvVarArgumentParser:
+    base = argparse.ArgumentParser()
+    base.add_argument(arg_name, **kwargs)
+    return EnvVarArgumentParser(parser=base)
+
+
+@pytest.mark.parametrize(
+    ("env_value", "expected"),
+    [("some-string", "some-string"), ("", None)],
+)
+def test_str_env_fallback(monkeypatch, env_value, expected):
+    if env_value:
+        monkeypatch.setenv("TEST_ARG", env_value)
+    args = _parser_with("--test-arg", type=str).parse_args([])
+    assert args.test_arg == expected
+
+
+@pytest.mark.parametrize(
+    ("env_value", "expected"),
+    [("42", 42), ("0", 0)],
+)
+def test_int_env_fallback(monkeypatch, env_value, expected):
+    monkeypatch.setenv("TEST_ARG", env_value)
+    args = _parser_with("--test-arg", type=int).parse_args([])
+    assert args.test_arg == expected
+
+
+@pytest.mark.parametrize(
+    ("env_value", "expected"),
+    [
+        ("true", True),
+        ("True", True),
+        ("1", True),
+        ("false", False),
+        ("no", False),
+        ("0", False),
+    ],
+)
+@pytest.mark.parametrize(
+    "action_kwargs",
+    [
+        {"type": bool},
+        {"action": "store_true"},
+        {"action": StoreBoolean},
+    ],
+)
+def test_bool_env_fallback(monkeypatch, env_value, expected, action_kwargs):
+    monkeypatch.setenv("TEST_ARG", env_value)
+    args = _parser_with("--test-arg", **action_kwargs).parse_args([])
+    assert args.test_arg is expected
+
+
+def test_store_false_env_fallback(monkeypatch):
+    monkeypatch.setenv("TEST_ARG", "false")
+    args = _parser_with("--test-arg", action="store_false").parse_args([])
+    assert args.test_arg is False
+
+
+def test_cli_beats_env(monkeypatch):
+    monkeypatch.setenv("TEST_ARG", "env-value")
+    args = _parser_with("--test-arg", type=str).parse_args(
+        ["--test-arg", "cli-value"]
+    )
+    assert args.test_arg == "cli-value"
+
+
+def test_underscore_flag_spelling():
+    args = _parser_with("--test-arg", type=str).parse_args(
+        ["--test_arg=value"]
+    )
+    assert args.test_arg == "value"
+
+
+def test_help_mentions_env_var(capsys):
+    parser = _parser_with("--test-arg", type=str, help="a test arg")
+    with pytest.raises(SystemExit):
+        parser.parse_args(["--help"])
+    assert "[env: TEST_ARG]" in capsys.readouterr().out
+
+
+def test_tgis_args_present():
+    parser = add_tgis_args(argparse.ArgumentParser())
+    args = parser.parse_args([])
+    assert args.max_new_tokens == 1024
+    assert args.grpc_port == 8033
+    assert args.default_include_stop_seqs is True
+    assert args.output_special_tokens is False
+
+
+def _full_args(argv: list[str]) -> argparse.Namespace:
+    return postprocess_tgis_args(make_parser().parse_args(argv))
+
+
+def test_postprocess_model_name_mapping():
+    args = _full_args(["--model-name", "foo/bar"])
+    assert args.model == "foo/bar"
+
+
+def test_postprocess_max_sequence_length():
+    args = _full_args(["--max-sequence-length", "2048"])
+    assert args.max_model_len == 2048
+
+
+def test_postprocess_max_sequence_length_conflict():
+    with pytest.raises(ValueError, match="Inconsistent"):
+        _full_args(
+            ["--max-sequence-length", "2048", "--max-model-len", "1024"]
+        )
+
+
+def test_postprocess_num_shard_mapping():
+    args = _full_args(["--num-shard", "8"])
+    assert args.tensor_parallel_size == 8
+
+
+def test_postprocess_num_gpus_conflict():
+    with pytest.raises(ValueError, match="Inconsistent"):
+        _full_args(["--num-gpus", "4", "--num-shard", "8"])
+
+
+def test_postprocess_quantize_mapping():
+    args = _full_args(["--quantize", "awq"])
+    assert args.quantization == "awq"
+
+
+def test_postprocess_tls_mapping():
+    args = _full_args(
+        ["--tls-cert-path", "/c", "--tls-key-path", "/k",
+         "--tls-client-ca-cert-path", "/ca"]
+    )
+    assert args.ssl_certfile == "/c"
+    assert args.ssl_keyfile == "/k"
+    assert args.ssl_ca_certs == "/ca"
+
+
+def test_postprocess_forces_max_logprobs():
+    args = _full_args(["--max-logprobs", "2"])
+    assert args.max_logprobs == 11
+
+
+def test_postprocess_disables_engine_request_logs():
+    assert _full_args([]).disable_log_requests is True
+    assert (
+        _full_args(["--enable-vllm-log-requests", "true"]).disable_log_requests
+        is False
+    )
+
+
+def test_env_var_engine_arg(monkeypatch):
+    monkeypatch.setenv("GRPC_PORT", "9999")
+    monkeypatch.setenv("MODEL_NAME", "env/model")
+    args = _full_args([])
+    assert args.grpc_port == 9999
+    assert args.model == "env/model"

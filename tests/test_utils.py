@@ -1,0 +1,80 @@
+"""Unit tests for shared utilities (TTL cache, termination log, task scan)."""
+
+from __future__ import annotations
+
+import asyncio
+
+from vllm_tgis_adapter_tpu.utils import (
+    TTLCache,
+    check_for_failed_tasks,
+    to_list,
+    write_termination_log,
+)
+
+
+class FakeTimer:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self):
+        return self.now
+
+
+def test_ttl_cache_basic():
+    cache = TTLCache(maxsize=4, ttl=10)
+    cache["a"] = 1
+    assert cache["a"] == 1
+    assert cache.get("missing") is None
+    assert "a" in cache
+
+
+def test_ttl_cache_expiry():
+    timer = FakeTimer()
+    cache = TTLCache(maxsize=4, ttl=10, timer=timer)
+    cache["a"] = 1
+    timer.now = 11
+    assert cache.get("a") is None
+    assert len(cache) == 0
+
+
+def test_ttl_cache_eviction():
+    cache = TTLCache(maxsize=2, ttl=100)
+    cache["a"] = 1
+    cache["b"] = 2
+    cache["c"] = 3
+    assert cache.get("a") is None
+    assert cache["b"] == 2
+    assert cache["c"] == 3
+
+
+def test_termination_log_roundtrip(tmp_path):
+    log = tmp_path / "termination-log"
+    log.touch()
+    write_termination_log("boom", str(log))
+    assert log.read_text() == "boom\n"
+
+
+def test_termination_log_missing_file(tmp_path):
+    # must be a silent no-op
+    write_termination_log("boom", str(tmp_path / "nope"))
+
+
+def test_to_list():
+    assert to_list([1, 2]) == [1, 2]
+    assert to_list((1, 2)) == [1, 2]
+
+
+def test_check_for_failed_tasks():
+    async def run():
+        async def ok():
+            return 1
+
+        async def bad():
+            raise RuntimeError("x")
+
+        t1 = asyncio.ensure_future(ok())
+        t2 = asyncio.ensure_future(bad())
+        await asyncio.gather(t1, t2, return_exceptions=True)
+        return check_for_failed_tasks([t1, t2]) is t2
+
+    assert asyncio.run(run())

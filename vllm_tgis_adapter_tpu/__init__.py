@@ -1,0 +1,11 @@
+"""TPU-native text-generation serving framework.
+
+Serves the TGIS-compatible ``fmaas.GenerationService`` gRPC API and an
+OpenAI-compatible HTTP API from a single shared JAX/XLA inference engine,
+mirroring the capability surface of ``vllm-tgis-adapter`` (reference:
+/root/reference/src/vllm_tgis_adapter) with the engine itself implemented
+TPU-natively instead of delegating to vLLM/CUDA.
+"""
+
+__version__ = "0.1.0"
+version_tuple = (0, 1, 0)

@@ -1,0 +1,64 @@
+"""Logger configuration for the whole framework.
+
+The reference clones vLLM's dictConfig for its own namespace
+(reference: logging.py:10-22).  We own the whole stack here, so we define the
+format directly: one concise line per record with timestamp, level, and
+location, matching the operational style of the reference's logs.
+"""
+
+from __future__ import annotations
+
+import logging
+import logging.config
+import os
+import sys
+
+DEFAULT_LOGGER_NAME = __name__.split(".")[0]
+
+_FORMAT = (
+    "%(levelname)s %(asctime)s.%(msecs)03d %(filename)s:%(lineno)d] %(message)s"
+)
+_DATE_FORMAT = "%m-%d %H:%M:%S"
+
+_LOGGING_CONFIG = {
+    "version": 1,
+    "disable_existing_loggers": False,
+    "formatters": {
+        DEFAULT_LOGGER_NAME: {
+            "format": _FORMAT,
+            "datefmt": _DATE_FORMAT,
+        },
+    },
+    "handlers": {
+        DEFAULT_LOGGER_NAME: {
+            "class": "logging.StreamHandler",
+            "formatter": DEFAULT_LOGGER_NAME,
+            "level": os.getenv("TGIS_TPU_LOG_LEVEL", "INFO").upper(),
+            "stream": "ext://sys.stdout",
+        },
+    },
+    "loggers": {
+        DEFAULT_LOGGER_NAME: {
+            "handlers": [DEFAULT_LOGGER_NAME],
+            "level": "DEBUG",
+            "propagate": False,
+        },
+    },
+}
+
+_configured = False
+
+
+def _configure() -> None:
+    global _configured
+    if not _configured:
+        logging.config.dictConfig(_LOGGING_CONFIG)
+        _configured = True
+
+
+def init_logger(name: str) -> logging.Logger:
+    """Return a logger under the framework's root logger namespace."""
+    _configure()
+    if name == DEFAULT_LOGGER_NAME or name.startswith(DEFAULT_LOGGER_NAME + "."):
+        return logging.getLogger(name)
+    return logging.getLogger(f"{DEFAULT_LOGGER_NAME}.{name}")

@@ -1,0 +1,383 @@
+"""CLI argument system: engine flags + TGIS compatibility flags + env fallback.
+
+Capability parity with the reference's ``tgis_utils/args.py``:
+
+* every flag can be supplied via an environment variable named after it
+  (``--grpc-port`` <-> ``GRPC_PORT``), including boolean actions, with the
+  ``[env: NAME]`` annotation in ``--help`` (reference: args.py:30-98);
+* the TGIS-legacy flag set (``--model-name``, ``--max-sequence-length``,
+  ``--num-gpus``/``--num-shard``, ``--quantize``, TLS paths, speculator
+  args, ...) is accepted and mapped onto the engine's native namespace with
+  conflict errors (reference: args.py:101-258).
+
+Where the reference wraps vLLM's ``make_arg_parser``, we define the engine
+argument set ourselves (`add_engine_args`): the engine here is this package's
+own JAX/TPU engine, and ``--tensor-parallel-size`` selects the size of the
+SPMD mesh axis over ICI rather than a NCCL world size.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+from vllm_tgis_adapter_tpu.logging import init_logger
+
+logger = init_logger(__name__)
+
+MAX_TOP_N_TOKENS = 10  # shared limit, see grpc/validation.py
+
+
+def _to_env_var(arg_name: str) -> str:
+    return arg_name.upper().replace("-", "_")
+
+
+def _bool_from_string(val: str) -> bool:
+    return val.lower().strip() == "true" or val == "1"
+
+
+class StoreBoolean(argparse.Action):
+    """``--flag true|false`` style boolean action."""
+
+    def __call__(self, parser, namespace, values, option_string=None):  # noqa: ANN001
+        lowered = values.lower()
+        if lowered not in ("true", "false"):
+            raise ValueError(
+                f"Invalid boolean value: {values}. Expected 'true' or 'false'."
+            )
+        setattr(namespace, self.dest, lowered == "true")
+
+
+class FlexibleArgumentParser(argparse.ArgumentParser):
+    """ArgumentParser accepting both ``--foo-bar`` and ``--foo_bar`` spellings."""
+
+    def parse_args(self, args=None, namespace=None):  # noqa: ANN001
+        import sys
+
+        if args is None:
+            args = sys.argv[1:]
+        processed = []
+        for arg in args:
+            if arg.startswith("--") and "_" in arg:
+                if "=" in arg:
+                    key, _, value = arg.partition("=")
+                    processed.append(f"{key.replace('_', '-')}={value}")
+                else:
+                    processed.append(arg.replace("_", "-"))
+            else:
+                processed.append(arg)
+        return super().parse_args(processed, namespace)
+
+
+_BOOLEAN_ACTIONS = (
+    argparse._StoreTrueAction,  # noqa: SLF001
+    argparse._StoreFalseAction,  # noqa: SLF001
+    argparse.BooleanOptionalAction,
+    StoreBoolean,
+)
+
+
+def _apply_env_fallback(action: argparse.Action) -> None:
+    """Replace an action's default with the value of its env var, if set."""
+    env_val = os.environ.get(_to_env_var(action.dest))
+    if not env_val:
+        return
+
+    val: bool | str
+    if action.type is bool or isinstance(action, _BOOLEAN_ACTIONS):
+        # bool("false") == True, so parse the string ourselves
+        val = _bool_from_string(env_val)
+    else:
+        # non-string types get converted by argparse when the default is used
+        val = env_val
+
+    if action.nargs in ("+", "*"):
+        action.default = [val]
+    else:
+        action.default = val
+
+
+class EnvVarArgumentParser(FlexibleArgumentParser):
+    """Parser where every argument falls back to an env var of the same name."""
+
+    class _EnvVarHelpFormatter(argparse.ArgumentDefaultsHelpFormatter):
+        def _get_help_string(self, action: argparse.Action) -> str:
+            help_ = super()._get_help_string(action)
+            assert help_ is not None
+            if action.dest != "help":
+                help_ += f" [env: {_to_env_var(action.dest)}]"
+            return help_
+
+    def __init__(
+        self,
+        parser: argparse.ArgumentParser | None = None,
+        *,
+        formatter_class=_EnvVarHelpFormatter,
+        **kwargs,
+    ):
+        parents = []
+        if parser:
+            parents.append(parser)
+            for action in parser._actions:  # noqa: SLF001
+                if isinstance(action, argparse._HelpAction):  # noqa: SLF001
+                    continue
+                _apply_env_fallback(action)
+        super().__init__(
+            formatter_class=formatter_class, parents=parents, add_help=False, **kwargs
+        )
+
+    def _add_action(self, action: argparse.Action) -> argparse.Action:
+        _apply_env_fallback(action)
+        return super()._add_action(action)
+
+
+def add_engine_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
+    """Add the TPU engine's native argument set.
+
+    This is the analog of the vLLM engine arg surface the reference exposes
+    through ``make_arg_parser`` (reference: __main__.py:118-120); names are
+    kept compatible where the concept carries over so existing deployments'
+    flags keep working.
+    """
+    g = parser.add_argument_group("model")
+    g.add_argument("--model", type=str, default=None,
+                   help="name or local path of the model to serve")
+    g.add_argument("--tokenizer", type=str, default=None,
+                   help="tokenizer path override; defaults to --model")
+    g.add_argument("--served-model-name", type=str, nargs="*", default=None,
+                   help="model name(s) reported by the APIs; defaults to --model")
+    g.add_argument("--revision", type=str, default=None,
+                   help="model revision (accepted for compatibility)")
+    g.add_argument("--trust-remote-code", action="store_true",
+                   help="allow custom code from the model repo when loading "
+                        "tokenizer/config")
+    g.add_argument("--dtype", type=str, default="auto",
+                   choices=["auto", "bfloat16", "float16", "float32"],
+                   help="activation/weight dtype; 'auto' picks bfloat16 on TPU")
+    g.add_argument("--kv-cache-dtype", type=str, default="auto",
+                   choices=["auto", "bfloat16", "float32", "float8_e4m3"],
+                   help="KV-cache storage dtype")
+    g.add_argument("--quantization", type=str, default=None,
+                   choices=["int8", "awq", "gptq", "squeezellm"],
+                   help="weight quantization scheme (int8 native; others "
+                        "reserved)")
+    g.add_argument("--max-model-len", type=int, default=None,
+                   help="model context length; derived from the model config "
+                        "if unset")
+    g.add_argument("--seed", type=int, default=0, help="engine-level RNG seed")
+    g.add_argument("--max-logprobs", type=int, default=20,
+                   help="max number of logprobs returnable per position")
+
+    g = parser.add_argument_group("engine")
+    g.add_argument("--max-num-seqs", type=int, default=64,
+                   help="max sequences resident in the decode batch")
+    g.add_argument("--max-num-batched-tokens", type=int, default=None,
+                   help="cap on tokens processed per engine step (prefill "
+                        "chunking budget)")
+    g.add_argument("--block-size", type=int, default=16,
+                   help="KV-cache page size in tokens")
+    g.add_argument("--hbm-memory-utilization", "--gpu-memory-utilization",
+                   dest="hbm_memory_utilization", type=float, default=0.90,
+                   help="fraction of device memory budgeted for weights + KV "
+                        "cache (accepts --gpu-memory-utilization for "
+                        "compatibility)")
+    g.add_argument("--swap-space", type=float, default=0,
+                   help="accepted for compatibility; host swap is not used")
+    g.add_argument("--enforce-eager", action="store_true",
+                   help="accepted for compatibility; the TPU engine always "
+                        "compiles with XLA")
+    g.add_argument("--disable-log-stats", action="store_true",
+                   help="disable periodic engine stats logging")
+    g.add_argument("--enable-prefix-caching", action="store_true",
+                   help="reuse KV pages across requests with a shared prefix")
+
+    g = parser.add_argument_group("parallelism")
+    g.add_argument("--tensor-parallel-size", "-tp", type=int, default=None,
+                   help="SPMD tensor-parallel mesh size over ICI")
+    g.add_argument("--pipeline-parallel-size", "-pp", type=int, default=1,
+                   help="pipeline stages across the mesh")
+    g.add_argument("--data-parallel-size", "-dp", type=int, default=1,
+                   help="engine replicas over a data-parallel mesh axis")
+
+    g = parser.add_argument_group("lora")
+    g.add_argument("--enable-lora", action="store_true",
+                   help="enable LoRA adapter support")
+    g.add_argument("--max-loras", type=int, default=4,
+                   help="max distinct LoRA adapters resident per batch")
+    g.add_argument("--max-lora-rank", type=int, default=64,
+                   help="max supported LoRA rank")
+    g.add_argument("--lora-modules", type=str, nargs="*", default=None,
+                   help="static LoRA modules to register: name=path ...")
+
+    g = parser.add_argument_group("speculative decoding")
+    g.add_argument("--speculative-model", type=str, default=None,
+                   help="draft model for speculative decoding")
+    g.add_argument("--num-speculative-tokens", type=int, default=None,
+                   help="tokens proposed per speculation round")
+    g.add_argument("--use-v2-block-manager", action="store_true",
+                   help="accepted for compatibility; this engine has a single "
+                        "block manager")
+
+    g = parser.add_argument_group("http server")
+    g.add_argument("--host", type=str, default=None, help="bind address")
+    g.add_argument("--port", type=int, default=8000, help="HTTP port")
+    g.add_argument("--uvicorn-log-level", type=str, default="info",
+                   choices=["debug", "info", "warning", "error", "critical",
+                            "trace"],
+                   help="HTTP server log level (flag name kept for "
+                        "compatibility)")
+    g.add_argument("--ssl-keyfile", type=str, default=None)
+    g.add_argument("--ssl-certfile", type=str, default=None)
+    g.add_argument("--ssl-ca-certs", type=str, default=None)
+    g.add_argument("--ssl-cert-reqs", type=int, default=0,
+                   help="ssl.CERT_* constant for client cert verification")
+    g.add_argument("--root-path", type=str, default=None,
+                   help="HTTP root path prefix when behind a proxy")
+    g.add_argument("--api-key", type=str, default=None,
+                   help="require this bearer token on the HTTP API")
+
+    g = parser.add_argument_group("observability")
+    g.add_argument("--otlp-traces-endpoint", type=str, default=None,
+                   help="OTLP endpoint; enables trace-context propagation")
+    g.add_argument("--disable-log-requests", action="store_true",
+                   help="disable engine-level per-request logs")
+
+    return parser
+
+
+def add_tgis_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
+    """Add TGIS-compatibility flags (reference: args.py:101-181)."""
+    # maps to model
+    parser.add_argument("--model-name", type=str,
+                        help="name or path of the huggingface model to use")
+    # maps to max_model_len
+    parser.add_argument("--max-sequence-length", type=int,
+                        help="model context length. If unspecified, will be "
+                             "automatically derived from the model.")
+    parser.add_argument("--max-new-tokens", type=int, default=1024,
+                        help="maximum allowed new (generated) tokens per "
+                             "request")
+    # maps to max_num_seqs (advisory)
+    parser.add_argument("--max-batch-size", type=int)
+    # legacy arg no longer supported
+    parser.add_argument("--max-concurrent-requests", type=int)
+    # maps to dtype
+    parser.add_argument("--dtype-str", type=str, help="deprecated, use dtype")
+    # maps to quantization
+    parser.add_argument("--quantize", type=str,
+                        choices=["awq", "gptq", "squeezellm", None],
+                        help="method used to quantize the weights")
+    # both map to tensor_parallel_size (mesh size over ICI)
+    parser.add_argument("--num-gpus", type=int)
+    parser.add_argument("--num-shard", type=int)
+    parser.add_argument("--output-special-tokens", type=_bool_from_string,
+                        default=False)
+    parser.add_argument("--default-include-stop-seqs", type=_bool_from_string,
+                        default=True)
+    parser.add_argument("--grpc-port", type=int, default=8033)
+    # map to ssl_certfile / ssl_keyfile / ssl_ca_certs
+    parser.add_argument("--tls-cert-path", type=str)
+    parser.add_argument("--tls-key-path", type=str)
+    parser.add_argument("--tls-client-ca-cert-path", type=str)
+    # path PEFT adapters are loaded from
+    parser.add_argument("--adapter-cache", type=str)
+    # backwards-compatibility support for tgis prompt tuning
+    parser.add_argument("--prefix-store-path", type=str,
+                        help="deprecated, use --adapter-cache")
+    # spec decode
+    parser.add_argument("--speculator-name", type=str)
+    parser.add_argument("--speculator-n-candidates", type=int)
+    parser.add_argument("--speculator-max-batch-size", type=int)
+    # re-enable engine-native per-request logging
+    parser.add_argument("--enable-vllm-log-requests", type=_bool_from_string,
+                        default=False)
+    parser.add_argument("--disable-prompt-logprobs", type=_bool_from_string,
+                        default=False)
+    return parser
+
+
+def postprocess_tgis_args(args: argparse.Namespace) -> argparse.Namespace:  # noqa: C901, PLR0912
+    """Resolve TGIS-legacy flags onto the engine namespace.
+
+    Same mapping and conflict semantics as the reference
+    (args.py:184-258); raises ValueError on inconsistent values.
+    """
+    if args.model_name:
+        args.model = args.model_name
+    if args.max_sequence_length is not None:
+        if args.max_model_len not in (None, args.max_sequence_length):
+            raise ValueError(
+                "Inconsistent max_model_len and max_sequence_length arg values"
+            )
+        args.max_model_len = args.max_sequence_length
+    if args.dtype_str is not None:
+        if args.dtype not in (None, "auto", args.dtype_str):
+            raise ValueError("Inconsistent dtype and dtype_str arg values")
+        args.dtype = args.dtype_str
+    if args.quantize:
+        if args.quantization and args.quantization != args.quantize:
+            raise ValueError("Inconsistent quantize and quantization arg values")
+        args.quantization = args.quantize
+    if args.num_gpus is not None or args.num_shard is not None:
+        if (
+            args.num_gpus is not None
+            and args.num_shard is not None
+            and args.num_gpus != args.num_shard
+        ):
+            raise ValueError("Inconsistent num_gpus and num_shard arg values")
+        num_chips = args.num_gpus if args.num_gpus is not None else args.num_shard
+        if args.tensor_parallel_size not in [None, 1, num_chips]:
+            raise ValueError(
+                "Inconsistent tensor_parallel_size and num_gpus/num_shard arg values"
+            )
+        args.tensor_parallel_size = num_chips
+    if args.max_logprobs < MAX_TOP_N_TOKENS + 1:
+        logger.info("Setting max_logprobs to %d", MAX_TOP_N_TOKENS + 1)
+        args.max_logprobs = MAX_TOP_N_TOKENS + 1
+
+    # The TGIS-style wrapper logs every request; keep the engine quiet unless
+    # explicitly re-enabled.
+    args.disable_log_requests = not args.enable_vllm_log_requests
+
+    if args.speculator_name:
+        if args.speculative_model and args.speculative_model != args.speculator_name:
+            raise ValueError(
+                "Inconsistent speculator_name and speculative_model arg values"
+            )
+        args.speculative_model = args.speculator_name
+
+    if args.speculator_n_candidates or args.speculator_max_batch_size:
+        logger.warning(
+            "speculator_n_candidates and speculator_max_batch_size args are "
+            "not yet supported"
+        )
+
+    if args.max_batch_size is not None:
+        logger.warning(
+            "max_batch_size is set to %d but will be ignored for now. "
+            "max_num_seqs can be used if this is still needed.",
+            args.max_batch_size,
+        )
+    if args.max_concurrent_requests is not None:
+        logger.warning(
+            "max_concurrent_requests is not supported and will be ignored."
+        )
+
+    if args.tls_cert_path:
+        args.ssl_certfile = args.tls_cert_path
+    if args.tls_key_path:
+        args.ssl_keyfile = args.tls_key_path
+    if args.tls_client_ca_cert_path:
+        args.ssl_ca_certs = args.tls_client_ca_cert_path
+
+    return args
+
+
+def make_parser() -> EnvVarArgumentParser:
+    """Build the complete CLI parser used by ``python -m vllm_tgis_adapter_tpu``."""
+    base = FlexibleArgumentParser(
+        description="TPU-native TGIS gRPC + OpenAI REST api server"
+    )
+    base = add_engine_args(base)
+    parser = EnvVarArgumentParser(parser=base)
+    return add_tgis_args(parser)

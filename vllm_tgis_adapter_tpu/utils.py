@@ -1,0 +1,107 @@
+"""Small shared utilities (reference: utils.py:7-45).
+
+Covers the failed-task scan used by the dual-server orchestrator, the
+Kubernetes termination-log writer, and tiny sequence helpers.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import time
+from typing import TYPE_CHECKING, Optional
+
+if TYPE_CHECKING:
+    from collections.abc import Iterable, Sequence
+
+
+def check_for_failed_tasks(tasks: Iterable[asyncio.Task]) -> Optional[asyncio.Task]:
+    """Return the first task that finished with an exception, if any."""
+    for task in tasks:
+        try:
+            if task.exception() is not None:
+                return task
+        except (asyncio.InvalidStateError, asyncio.CancelledError):
+            continue
+    return None
+
+
+def write_termination_log(msg: str, file: str = "/dev/termination-log") -> None:
+    """Record the cause of death where Kubernetes probes can read it.
+
+    Mirrors the reference semantics (utils.py:20-41): silently skip when the
+    file does not exist (not running under k8s), and never let logging errors
+    mask the original failure.
+    """
+    if not os.path.exists(file):
+        from .logging import DEFAULT_LOGGER_NAME, init_logger
+
+        init_logger(DEFAULT_LOGGER_NAME).debug(
+            "Not writing to termination log %s since it does not exist", file
+        )
+        return
+    try:
+        with open(file, "w") as f:
+            f.write(f"{msg}\n")
+    except Exception:
+        from .logging import DEFAULT_LOGGER_NAME, init_logger
+
+        init_logger(DEFAULT_LOGGER_NAME).exception(
+            "Unable to write termination logs to %s", file
+        )
+
+
+def to_list(seq: "Sequence[int]") -> list[int]:
+    return seq if isinstance(seq, list) else list(seq)
+
+
+class TTLCache:
+    """Minimal dict-like cache with max size + per-entry TTL.
+
+    Replacement for ``cachetools.TTLCache`` (not installed here), used as the
+    correlation-ID blackboard (reference: tgis_utils/logs.py:29).  Expiry is
+    enforced lazily on access and insertion; eviction is oldest-inserted-first
+    once ``maxsize`` is reached.
+    """
+
+    def __init__(self, maxsize: int, ttl: float, timer=time.monotonic):
+        self.maxsize = maxsize
+        self.ttl = ttl
+        self._timer = timer
+        self._data: dict = {}  # key -> (expiry, value); insertion-ordered
+
+    def _expire(self) -> None:
+        now = self._timer()
+        dead = [k for k, (exp, _) in self._data.items() if exp <= now]
+        for k in dead:
+            del self._data[k]
+
+    def __setitem__(self, key, value) -> None:
+        self._expire()
+        self._data.pop(key, None)
+        while len(self._data) >= self.maxsize:
+            self._data.pop(next(iter(self._data)))
+        self._data[key] = (self._timer() + self.ttl, value)
+
+    def __getitem__(self, key):
+        exp, value = self._data[key]
+        if exp <= self._timer():
+            del self._data[key]
+            raise KeyError(key)
+        return value
+
+    def get(self, key, default=None):
+        try:
+            return self[key]
+        except KeyError:
+            return default
+
+    def __contains__(self, key) -> bool:
+        return self.get(key, _MISSING) is not _MISSING
+
+    def __len__(self) -> int:
+        self._expire()
+        return len(self._data)
+
+
+_MISSING = object()
