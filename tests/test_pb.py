@@ -1,0 +1,110 @@
+"""Proto codegen + hand-written binding smoke tests, including a live RPC."""
+
+from __future__ import annotations
+
+import asyncio
+
+import grpc
+import pytest
+
+from vllm_tgis_adapter_tpu.grpc import health
+from vllm_tgis_adapter_tpu.grpc.pb import generation_pb2, health_pb2, rpc
+
+
+def test_message_roundtrip():
+    req = generation_pb2.BatchedGenerationRequest(
+        model_id="m",
+        requests=[generation_pb2.GenerationRequest(text="hello")],
+        params=generation_pb2.Parameters(
+            method=generation_pb2.SAMPLE,
+            sampling=generation_pb2.SamplingParameters(
+                temperature=0.7, top_k=5, seed=42
+            ),
+            stopping=generation_pb2.StoppingCriteria(
+                max_new_tokens=10, stop_sequences=["\n"]
+            ),
+        ),
+    )
+    data = req.SerializeToString()
+    back = generation_pb2.BatchedGenerationRequest.FromString(data)
+    assert back.requests[0].text == "hello"
+    assert back.params.sampling.HasField("seed")
+    assert back.params.sampling.seed == 42
+    assert back.params.method == generation_pb2.SAMPLE
+
+
+def test_guided_oneof():
+    dec = generation_pb2.DecodingParameters(regex="[0-9]+")
+    assert dec.WhichOneof("guided") == "regex"
+    dec = generation_pb2.DecodingParameters(
+        choice=generation_pb2.DecodingParameters.StringChoices(choices=["a", "b"])
+    )
+    assert dec.WhichOneof("guided") == "choice"
+    assert generation_pb2.DecodingParameters().WhichOneof("guided") is None
+
+
+def test_stop_reason_enum_values():
+    # Values are part of the wire contract.
+    sr = generation_pb2.StopReason
+    assert (
+        sr.NOT_FINISHED,
+        sr.MAX_TOKENS,
+        sr.EOS_TOKEN,
+        sr.CANCELLED,
+        sr.TIME_LIMIT,
+        sr.STOP_SEQUENCE,
+        sr.TOKEN_LIMIT,
+        sr.ERROR,
+    ) == (0, 1, 2, 3, 4, 5, 6, 7)
+
+
+class _EchoService(rpc.GenerationServiceServicer):
+    async def ModelInfo(self, request, context):
+        return generation_pb2.ModelInfoResponse(
+            model_kind=generation_pb2.ModelInfoResponse.DECODER_ONLY,
+            max_sequence_length=2048,
+            max_new_tokens=1024,
+        )
+
+    async def GenerateStream(self, request, context):
+        for i in range(3):
+            yield generation_pb2.GenerationResponse(generated_token_count=i)
+
+
+def test_live_rpc_with_handwritten_bindings():
+    async def run() -> None:
+        server = grpc.aio.server()
+        rpc.add_GenerationServiceServicer_to_server(_EchoService(), server)
+        health_servicer = health.HealthServicer()
+        health_servicer.set(rpc.SERVICE_NAME, health.ServingStatus.SERVING)
+        health.add_HealthServicer_to_server(health_servicer, server)
+        port = server.add_insecure_port("127.0.0.1:0")
+        await server.start()
+        try:
+            async with grpc.aio.insecure_channel(f"127.0.0.1:{port}") as channel:
+                stub = rpc.GenerationServiceStub(channel)
+                info = await stub.ModelInfo(
+                    generation_pb2.ModelInfoRequest(model_id="m")
+                )
+                assert info.max_sequence_length == 2048
+
+                chunks = [r async for r in stub.GenerateStream(
+                    generation_pb2.SingleGenerationRequest(model_id="m")
+                )]
+                assert [c.generated_token_count for c in chunks] == [0, 1, 2]
+
+                hstub = health.HealthStub(channel)
+                resp = await hstub.Check(
+                    health_pb2.HealthCheckRequest(service=rpc.SERVICE_NAME)
+                )
+                assert resp.status == health_pb2.HealthCheckResponse.SERVING
+
+                with pytest.raises(grpc.aio.AioRpcError) as err:
+                    await hstub.Check(
+                        health_pb2.HealthCheckRequest(service="nope")
+                    )
+                assert err.value.code() == grpc.StatusCode.NOT_FOUND
+        finally:
+            await server.stop(None)
+
+    asyncio.run(run())

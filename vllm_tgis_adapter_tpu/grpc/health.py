@@ -1,0 +1,91 @@
+"""grpc.health.v1 server implementation.
+
+Stands in for the ``grpc_health`` package (not installed here): an asyncio
+HealthServicer with the same ``set(service, status)`` API the reference uses
+(reference: grpc_server.py:907-908,200-203), plus hand-written registration
+and client stub helpers (see pb/rpc.py for why these are hand-written).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from collections import defaultdict
+
+import grpc
+
+from .pb import health_pb2
+
+SERVICE_NAME = "grpc.health.v1.Health"
+
+ServingStatus = health_pb2.HealthCheckResponse.ServingStatus
+
+
+class HealthServicer:
+    """Async health servicer with per-service status and Watch streaming."""
+
+    def __init__(self) -> None:
+        self._statuses: dict[str, int] = {"": ServingStatus.SERVING}
+        self._watch_events: dict[str, list[asyncio.Event]] = defaultdict(list)
+
+    def set(self, service: str, status: int) -> None:
+        self._statuses[service] = status
+        for event in self._watch_events.get(service, []):
+            event.set()
+
+    async def Check(self, request, context):  # noqa: ANN001
+        status = self._statuses.get(request.service)
+        if status is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "service not found")
+        return health_pb2.HealthCheckResponse(status=status)
+
+    async def Watch(self, request, context):  # noqa: ANN001
+        service = request.service
+        event = asyncio.Event()
+        self._watch_events[service].append(event)
+        try:
+            last = None
+            while True:
+                status = self._statuses.get(
+                    service, health_pb2.HealthCheckResponse.SERVICE_UNKNOWN
+                )
+                if status != last:
+                    last = status
+                    yield health_pb2.HealthCheckResponse(status=status)
+                await event.wait()
+                event.clear()
+        finally:
+            self._watch_events[service].remove(event)
+
+
+def add_HealthServicer_to_server(servicer: HealthServicer, server) -> None:  # noqa: ANN001, N802
+    handlers = {
+        "Check": grpc.unary_unary_rpc_method_handler(
+            servicer.Check,
+            request_deserializer=health_pb2.HealthCheckRequest.FromString,
+            response_serializer=health_pb2.HealthCheckResponse.SerializeToString,
+        ),
+        "Watch": grpc.unary_stream_rpc_method_handler(
+            servicer.Watch,
+            request_deserializer=health_pb2.HealthCheckRequest.FromString,
+            response_serializer=health_pb2.HealthCheckResponse.SerializeToString,
+        ),
+    }
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(SERVICE_NAME, handlers),)
+    )
+
+
+class HealthStub:
+    """Client stub for grpc.health.v1.Health (sync or asyncio channels)."""
+
+    def __init__(self, channel: grpc.Channel):
+        self.Check = channel.unary_unary(
+            f"/{SERVICE_NAME}/Check",
+            request_serializer=health_pb2.HealthCheckRequest.SerializeToString,
+            response_deserializer=health_pb2.HealthCheckResponse.FromString,
+        )
+        self.Watch = channel.unary_stream(
+            f"/{SERVICE_NAME}/Watch",
+            request_serializer=health_pb2.HealthCheckRequest.SerializeToString,
+            response_deserializer=health_pb2.HealthCheckResponse.FromString,
+        )

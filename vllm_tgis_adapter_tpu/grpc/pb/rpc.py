@@ -1,0 +1,87 @@
+"""Hand-written gRPC service bindings for the fmaas.GenerationService API.
+
+grpcio-tools (the protoc plugin that would normally emit
+``generation_pb2_grpc.py``) is not available in this environment, so the
+stub and servicer-registration helpers are written out by hand using the
+public ``grpc`` APIs.  Wire behavior is identical to plugin-generated code:
+method paths, serializers, and handler kinds match the service definition in
+``generation.proto``.
+"""
+
+from __future__ import annotations
+
+import grpc
+
+from . import generation_pb2
+
+SERVICE_NAME = "fmaas.GenerationService"
+
+# (method, is_server_streaming, request class, response class)
+_METHODS = (
+    ("Generate", False,
+     generation_pb2.BatchedGenerationRequest,
+     generation_pb2.BatchedGenerationResponse),
+    ("GenerateStream", True,
+     generation_pb2.SingleGenerationRequest,
+     generation_pb2.GenerationResponse),
+    ("Tokenize", False,
+     generation_pb2.BatchedTokenizeRequest,
+     generation_pb2.BatchedTokenizeResponse),
+    ("ModelInfo", False,
+     generation_pb2.ModelInfoRequest,
+     generation_pb2.ModelInfoResponse),
+)
+
+
+class GenerationServiceServicer:
+    """Base servicer; concrete services override these methods."""
+
+    async def Generate(self, request, context):  # noqa: ANN001
+        await context.abort(grpc.StatusCode.UNIMPLEMENTED, "Generate")
+
+    async def GenerateStream(self, request, context):  # noqa: ANN001
+        await context.abort(grpc.StatusCode.UNIMPLEMENTED, "GenerateStream")
+        yield  # pragma: no cover - makes this an async generator
+
+    async def Tokenize(self, request, context):  # noqa: ANN001
+        await context.abort(grpc.StatusCode.UNIMPLEMENTED, "Tokenize")
+
+    async def ModelInfo(self, request, context):  # noqa: ANN001
+        await context.abort(grpc.StatusCode.UNIMPLEMENTED, "ModelInfo")
+
+
+def add_GenerationServiceServicer_to_server(servicer, server) -> None:  # noqa: ANN001, N802
+    handlers = {}
+    for name, server_streaming, req_cls, resp_cls in _METHODS:
+        make_handler = (
+            grpc.unary_stream_rpc_method_handler
+            if server_streaming
+            else grpc.unary_unary_rpc_method_handler
+        )
+        handlers[name] = make_handler(
+            getattr(servicer, name),
+            request_deserializer=req_cls.FromString,
+            response_serializer=resp_cls.SerializeToString,
+        )
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(SERVICE_NAME, handlers),)
+    )
+
+
+class GenerationServiceStub:
+    """Client stub; works with both sync and asyncio grpc channels."""
+
+    def __init__(self, channel: grpc.Channel):
+        for name, server_streaming, req_cls, resp_cls in _METHODS:
+            make_callable = (
+                channel.unary_stream if server_streaming else channel.unary_unary
+            )
+            setattr(
+                self,
+                name,
+                make_callable(
+                    f"/{SERVICE_NAME}/{name}",
+                    request_serializer=req_cls.SerializeToString,
+                    response_deserializer=resp_cls.FromString,
+                ),
+            )
