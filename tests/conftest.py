@@ -1,21 +1,31 @@
 """Test session setup.
 
-Force the JAX CPU backend with 8 virtual devices BEFORE jax is imported
-anywhere, so the whole suite (including SPMD mesh tests) runs on CPU-only CI
-— the capability the reference lacks entirely (its CI compiles vLLM for CPU
-but has no distributed tests, SURVEY.md §4).
+Force the JAX CPU backend with 8 virtual devices so the whole suite
+(including SPMD mesh tests) runs on CPU-only CI — the capability the
+reference lacks entirely (its CI compiles vLLM for CPU but has no
+distributed tests, SURVEY.md §4).
+
+The host environment may import jax at interpreter startup (sitecustomize
+registering a TPU PJRT plugin) with JAX_PLATFORMS pointing at real
+hardware; by then env vars are already read, so the platform override must
+go through ``jax.config`` — but XLA_FLAGS is still read lazily at backend
+initialisation, so it must be set before the first device query.
 """
 
 from __future__ import annotations
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,116 @@
+"""Deterministic tiny-model fixtures built offline (no network).
+
+The reference's tests boot a real tiny HF model downloaded from the hub
+(tests/conftest.py:85-89 in the reference); this environment has no network
+egress, so we synthesise an equivalent: a 2-layer llama-architecture
+checkpoint with a from-scratch byte-level BPE tokenizer, saved in standard
+HF format so the whole load path (config.json → safetensors → tokenizer) is
+exercised for real.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+_CORPUS = [
+    "the quick brown fox jumps over the lazy dog",
+    "hello world, this is a tiny test corpus for a tiny tokenizer",
+    "The capital of France is Paris. The capital of Italy is Rome.",
+    "0 1 2 3 4 5 6 7 8 9 10 11 12 13 14 15 16 17 18 19 20",
+    "def main():\n    print('hello')\n    return 0\n",
+    '{"name": "value", "list": [1, 2, 3], "flag": true}',
+    "to be or not to be, that is the question",
+    "pack my box with five dozen liquor jugs",
+]
+
+TINY_LLAMA_CONFIG = {
+    "architectures": ["LlamaForCausalLM"],
+    "model_type": "llama",
+    "vocab_size": 512,
+    "hidden_size": 64,
+    "intermediate_size": 128,
+    "num_hidden_layers": 2,
+    "num_attention_heads": 4,
+    "num_key_value_heads": 2,
+    "head_dim": 16,
+    "max_position_embeddings": 512,
+    "rope_theta": 10000.0,
+    "rms_norm_eps": 1e-6,
+    "tie_word_embeddings": False,
+    "bos_token_id": 1,
+    "eos_token_id": 2,
+    "torch_dtype": "float32",
+}
+
+
+def build_tokenizer(path: str, vocab_size: int = 512):
+    from tokenizers import Tokenizer, decoders, models, pre_tokenizers, trainers
+    from transformers import PreTrainedTokenizerFast
+
+    tok = Tokenizer(models.BPE(unk_token=None))
+    tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+    tok.decoder = decoders.ByteLevel()
+    trainer = trainers.BpeTrainer(
+        vocab_size=vocab_size,
+        special_tokens=["<unk>", "<s>", "</s>"],
+        initial_alphabet=pre_tokenizers.ByteLevel.alphabet(),
+        show_progress=False,
+    )
+    tok.train_from_iterator(_CORPUS, trainer=trainer)
+    fast = PreTrainedTokenizerFast(
+        tokenizer_object=tok,
+        unk_token="<unk>",
+        bos_token="<s>",
+        eos_token="</s>",
+        pad_token="</s>",
+    )
+    fast.save_pretrained(path)
+    return fast
+
+
+def build_tiny_llama(path: str, seed: int = 0) -> str:
+    """Write config.json + model.safetensors + tokenizer to ``path``."""
+    import numpy as np
+    from safetensors.numpy import save_file
+
+    out = Path(path)
+    out.mkdir(parents=True, exist_ok=True)
+
+    tokenizer = build_tokenizer(path)
+    cfg = dict(TINY_LLAMA_CONFIG)
+    cfg["vocab_size"] = max(cfg["vocab_size"], len(tokenizer))
+    with open(out / "config.json", "w") as f:
+        json.dump(cfg, f, indent=2)
+
+    rng = np.random.default_rng(seed)
+    d = cfg["hidden_size"]
+    dh = cfg["head_dim"]
+    h = cfg["num_attention_heads"]
+    hkv = cfg["num_key_value_heads"]
+    inter = cfg["intermediate_size"]
+    vocab = cfg["vocab_size"]
+
+    def w(shape):
+        return (rng.standard_normal(shape) * 0.02).astype(np.float32)
+
+    tensors = {
+        "model.embed_tokens.weight": w((vocab, d)),
+        "model.norm.weight": np.ones(d, dtype=np.float32),
+        "lm_head.weight": w((vocab, d)),
+    }
+    for i in range(cfg["num_hidden_layers"]):
+        p = f"model.layers.{i}"
+        tensors |= {
+            f"{p}.input_layernorm.weight": np.ones(d, dtype=np.float32),
+            f"{p}.post_attention_layernorm.weight": np.ones(d, dtype=np.float32),
+            f"{p}.self_attn.q_proj.weight": w((h * dh, d)),
+            f"{p}.self_attn.k_proj.weight": w((hkv * dh, d)),
+            f"{p}.self_attn.v_proj.weight": w((hkv * dh, d)),
+            f"{p}.self_attn.o_proj.weight": w((d, h * dh)),
+            f"{p}.mlp.gate_proj.weight": w((inter, d)),
+            f"{p}.mlp.up_proj.weight": w((inter, d)),
+            f"{p}.mlp.down_proj.weight": w((d, inter)),
+        }
+    save_file(tensors, out / "model.safetensors")
+    return str(out)

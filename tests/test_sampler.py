@@ -1,0 +1,289 @@
+"""Unit tests for the batched jitted sampler.
+
+Covers the sampling surface the reference adapter configures on vLLM
+(greedy/temperature/top-k/top-p/typical, penalties, seeds, token info) as
+pure-array tests — no engine needed.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture()
+def sampler_mod():
+    from vllm_tgis_adapter_tpu.engine import sampler
+
+    return sampler
+
+
+def make_tensors(sampler_mod, n, **overrides):
+    import jax.numpy as jnp
+
+    defaults = dict(
+        temperature=np.zeros(n, np.float32),
+        top_k=np.zeros(n, np.int32),
+        top_p=np.ones(n, np.float32),
+        typical_p=np.ones(n, np.float32),
+        repetition_penalty=np.ones(n, np.float32),
+        len_penalty_start=np.full(n, -1, np.int32),
+        len_penalty_decay=np.ones(n, np.float32),
+        min_tokens=np.zeros(n, np.int32),
+        eos_token_id=np.full(n, 2, np.int32),
+        gen_len=np.zeros(n, np.int32),
+        base_key=np.arange(n, dtype=np.uint32),
+    )
+    defaults.update(overrides)
+    return sampler_mod.SamplingTensors(
+        **{k: jnp.asarray(v) for k, v in defaults.items()}
+    )
+
+
+def no_seen(n, v):
+    import jax.numpy as jnp
+
+    return jnp.zeros((n, v), bool)
+
+
+def test_greedy_picks_argmax(sampler_mod):
+    import jax.numpy as jnp
+
+    logits = jnp.asarray([[0.1, 3.0, -1.0, 0.5], [2.0, 0.0, 0.1, -5.0]])
+    t = make_tensors(sampler_mod, 2)
+    out = sampler_mod.sample(logits, no_seen(2, 4), t)
+    assert out.tokens.tolist() == [1, 0]
+    assert out.rank.tolist() == [1, 1]
+
+
+def test_chosen_logprob_and_topn(sampler_mod):
+    import jax.numpy as jnp
+
+    logits = jnp.asarray([[0.0, 1.0, 2.0, 3.0]])
+    t = make_tensors(sampler_mod, 1)
+    out = sampler_mod.sample(logits, no_seen(1, 4), t)
+    logp = np.asarray(jnp.log(jnp.exp(logits[0] - 3.0) / jnp.sum(jnp.exp(logits[0] - 3.0))))
+    np.testing.assert_allclose(float(out.logprob[0]), logp[3], rtol=1e-5)
+    # top-N is ordered descending and starts with the argmax
+    assert out.topn_ids[0, :4].tolist() == [3, 2, 1, 0]
+    np.testing.assert_allclose(
+        np.asarray(out.topn_logprobs[0, :4]), logp[[3, 2, 1, 0]], rtol=1e-5
+    )
+
+
+def test_seeded_sampling_reproducible_and_seed_sensitive(sampler_mod):
+    import jax.numpy as jnp
+
+    logits = jnp.zeros((1, 64))  # uniform
+    t1 = make_tensors(
+        sampler_mod, 1, temperature=np.ones(1, np.float32),
+        base_key=np.asarray([42], np.uint32),
+    )
+    out_a = sampler_mod.sample(logits, no_seen(1, 64), t1)
+    out_b = sampler_mod.sample(logits, no_seen(1, 64), t1)
+    assert out_a.tokens.tolist() == out_b.tokens.tolist()
+
+    draws = set()
+    for seed in range(8):
+        t = make_tensors(
+            sampler_mod, 1, temperature=np.ones(1, np.float32),
+            base_key=np.asarray([seed], np.uint32),
+        )
+        draws.add(int(sampler_mod.sample(logits, no_seen(1, 64), t).tokens[0]))
+    assert len(draws) > 1
+
+    # position folding changes the draw stream along a request
+    many_a = [
+        int(sampler_mod.sample(logits, no_seen(1, 64),
+                               make_tensors(sampler_mod, 1,
+                                            temperature=np.ones(1, np.float32),
+                                            base_key=np.asarray([42], np.uint32),
+                                            gen_len=np.asarray([g], np.int32),
+                                            )).tokens[0])
+        for g in range(6)
+    ]
+    assert len(set(many_a)) > 1
+
+
+def test_top_k_restricts_support(sampler_mod):
+    import jax.numpy as jnp
+
+    logits = jnp.asarray([[5.0, 4.0, 3.0, 2.0, 1.0, 0.0]] * 4)
+    t = make_tensors(
+        sampler_mod, 4,
+        temperature=np.ones(4, np.float32),
+        top_k=np.asarray([2, 2, 2, 2], np.int32),
+        base_key=np.arange(4, dtype=np.uint32),
+    )
+    for step in range(16):
+        t2 = make_tensors(
+            sampler_mod, 4, temperature=np.ones(4, np.float32),
+            top_k=np.asarray([2] * 4, np.int32),
+            base_key=np.arange(4, dtype=np.uint32),
+            gen_len=np.asarray([step] * 4, np.int32),
+        )
+        out = sampler_mod.sample(logits, no_seen(4, 6), t2)
+        assert all(tok in (0, 1) for tok in out.tokens.tolist())
+
+
+def test_top_p_restricts_support(sampler_mod):
+    import jax.numpy as jnp
+
+    # p = [0.6, 0.3, 0.06, ...] roughly; top_p=0.5 must keep only token 0
+    logits = jnp.log(jnp.asarray([[0.6, 0.3, 0.06, 0.03, 0.01]]))
+    for step in range(16):
+        t = make_tensors(
+            sampler_mod, 1, temperature=np.ones(1, np.float32),
+            top_p=np.asarray([0.5], np.float32),
+            base_key=np.asarray([7], np.uint32),
+            gen_len=np.asarray([step], np.int32),
+        )
+        out = sampler_mod.sample(logits, no_seen(1, 5), t)
+        assert out.tokens.tolist() == [0]
+
+
+def test_repetition_penalty_demotes_seen_tokens(sampler_mod):
+    import jax.numpy as jnp
+
+    logits = jnp.asarray([[2.0, 1.9, -1.0]])
+    seen = jnp.asarray([[True, False, False]])
+    t = make_tensors(
+        sampler_mod, 1, repetition_penalty=np.asarray([2.0], np.float32)
+    )
+    out = sampler_mod.sample(logits, seen, t)
+    # token 0 penalised to 1.0 < 1.9 → greedy picks token 1
+    assert out.tokens.tolist() == [1]
+
+
+def test_min_tokens_suppresses_eos(sampler_mod):
+    import jax.numpy as jnp
+
+    logits = jnp.asarray([[0.0, 0.0, 9.0, 1.0]])  # eos (id 2) dominates
+    t = make_tensors(
+        sampler_mod, 1, min_tokens=np.asarray([3], np.int32),
+        gen_len=np.asarray([1], np.int32),
+    )
+    out = sampler_mod.sample(logits, no_seen(1, 4), t)
+    assert out.tokens.tolist() == [3]
+    # once gen_len >= min_tokens EOS is allowed again
+    t2 = make_tensors(
+        sampler_mod, 1, min_tokens=np.asarray([3], np.int32),
+        gen_len=np.asarray([3], np.int32),
+    )
+    assert sampler_mod.sample(logits, no_seen(1, 4), t2).tokens.tolist() == [2]
+
+
+def test_exp_decay_length_penalty_boosts_eos(sampler_mod):
+    import jax.numpy as jnp
+
+    logits = jnp.asarray([[1.0, 0.0, 0.9, 0.0]])  # eos slightly below best
+    base = make_tensors(
+        sampler_mod, 1, len_penalty_start=np.asarray([2], np.int32),
+        len_penalty_decay=np.asarray([1.5], np.float32),
+        gen_len=np.asarray([0], np.int32),
+    )
+    assert sampler_mod.sample(logits, no_seen(1, 4), base).tokens.tolist() == [0]
+    late = make_tensors(
+        sampler_mod, 1, len_penalty_start=np.asarray([2], np.int32),
+        len_penalty_decay=np.asarray([1.5], np.float32),
+        gen_len=np.asarray([6], np.int32),
+    )
+    assert sampler_mod.sample(logits, no_seen(1, 4), late).tokens.tolist() == [2]
+
+
+def test_typical_p_filters(sampler_mod):
+    import jax.numpy as jnp
+
+    # one dominant token: typical set with small mass keeps it
+    logits = jnp.log(jnp.asarray([[0.90, 0.05, 0.03, 0.02]]))
+    for step in range(8):
+        t = make_tensors(
+            sampler_mod, 1, temperature=np.ones(1, np.float32),
+            typical_p=np.asarray([0.5], np.float32),
+            base_key=np.asarray([3], np.uint32),
+            gen_len=np.asarray([step], np.int32),
+        )
+        out = sampler_mod.sample(logits, no_seen(1, 4), t)
+        assert out.tokens.tolist() == [0]
+
+
+def test_structured_output_mask(sampler_mod):
+    import jax.numpy as jnp
+
+    logits = jnp.asarray([[9.0, 1.0, 0.5, 0.2]])
+    mask = jnp.asarray([[False, False, True, True]])
+    t = make_tensors(sampler_mod, 1)
+    out = sampler_mod.sample(logits, no_seen(1, 4), t, allowed_mask=mask)
+    assert out.tokens.tolist() == [2]
+
+
+def test_update_seen_drops_negative_rows(sampler_mod):
+    """Padding rows (slot -1) must not wrap to the last row of the matrix.
+
+    Regression: JAX scatter mode='drop' only drops positive out-of-bounds
+    indices; -1 wraps and polluted the last slot's repetition-penalty state.
+    """
+    import jax.numpy as jnp
+
+    seen = jnp.zeros((4, 8), bool)
+    seen2 = sampler_mod.update_seen(
+        seen, jnp.asarray([0, -1]), jnp.asarray([3, 5])
+    )
+    expected = np.zeros((4, 8), bool)
+    expected[0, 3] = True  # row -1 dropped, NOT written to row 3
+    np.testing.assert_array_equal(np.asarray(seen2), expected)
+
+
+def test_write_kv_drops_negative_slots():
+    """Regression: pad tokens (slot -1) must not overwrite the last KV page."""
+    import jax.numpy as jnp
+
+    from vllm_tgis_adapter_tpu.ops.attention import write_kv
+
+    k_cache = jnp.zeros((8, 2, 4))
+    v_cache = jnp.zeros((8, 2, 4))
+    k = jnp.ones((2, 2, 4))
+    v = jnp.ones((2, 2, 4))
+    k2, v2 = write_kv(k_cache, v_cache, k, v, jnp.asarray([1, -1]))
+    assert float(k2[1].sum()) > 0
+    assert float(k2[7].sum()) == 0.0  # slot -1 dropped, not wrapped
+    assert float(v2[7].sum()) == 0.0
+
+
+def test_prompt_seen_matrix_and_update(sampler_mod):
+    import jax.numpy as jnp
+
+    rows = jnp.asarray([[1, 2, -1], [3, -1, -1]], dtype=jnp.int32)
+    seen = sampler_mod.prompt_seen_matrix(rows, 5)
+    expected = np.zeros((2, 5), bool)
+    expected[0, [1, 2]] = True
+    expected[1, 3] = True
+    np.testing.assert_array_equal(np.asarray(seen), expected)
+
+    seen2 = sampler_mod.update_seen(
+        seen, jnp.asarray([0, 1]), jnp.asarray([4, 0])
+    )
+    expected[0, 4] = True
+    expected[1, 0] = True
+    np.testing.assert_array_equal(np.asarray(seen2), expected)
+
+
+def test_from_params_packing(sampler_mod):
+    from vllm_tgis_adapter_tpu.engine.sampling_params import SamplingParams
+
+    params = [
+        SamplingParams(temperature=0.7, top_k=40, top_p=0.9, seed=123,
+                       min_tokens=2, max_tokens=10,
+                       repetition_penalty=1.2, length_penalty=(5, 1.3)),
+        None,
+    ]
+    t = sampler_mod.SamplingTensors.from_params(
+        params, eos_token_id=2, gen_lens=[4, 0],
+        fallback_seeds=np.asarray([11, 22], np.uint32),
+    )
+    assert t.temperature.tolist() == pytest.approx([0.7, 0.0])
+    assert t.top_k.tolist() == [40, 0]
+    assert t.min_tokens.tolist() == [2, 0]
+    assert t.len_penalty_start.tolist() == [5, -1]
+    assert t.gen_len.tolist() == [4, 0]
+    assert t.base_key[1] == 22

@@ -1,0 +1,183 @@
+"""Async engine client: the interface the serving layer programs against.
+
+Shape-compatible with the ``EngineClient`` surface the reference adapter
+consumes from vLLM (SURVEY.md §2.3; consumption points grpc_server.py:68,
+205-225, 292, 648-660): ``generate(...)`` returns an async stream of
+RequestOutput, ``abort`` cancels and evicts, ``errored``/``is_running``
+surface engine death to the servers, and the tokenizer/model-config
+accessors feed validation.
+
+Concurrency model: the jitted device step is blocking, so the step loop
+runs in a single dedicated worker thread (device work is serialized by
+construction) while asyncio queues fan results out to per-request streams.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from collections.abc import AsyncGenerator, Mapping
+from typing import Optional
+
+from vllm_tgis_adapter_tpu.engine.config import EngineConfig
+from vllm_tgis_adapter_tpu.engine.core import LLMEngine
+from vllm_tgis_adapter_tpu.engine.outputs import RequestOutput
+from vllm_tgis_adapter_tpu.engine.sampling_params import SamplingParams
+from vllm_tgis_adapter_tpu.logging import init_logger
+
+logger = init_logger(__name__)
+
+
+class EngineDeadError(RuntimeError):
+    pass
+
+
+class AsyncLLMEngine:
+    def __init__(self, engine: LLMEngine):
+        self.engine = engine
+        self._queues: dict[str, asyncio.Queue] = {}
+        self._new_work = asyncio.Event()
+        self._loop_task: Optional[asyncio.Task] = None
+        self._dead_error: Optional[BaseException] = None
+        self._stopped = False
+        # serializes engine-state mutations (add/abort) against the step
+        # running in the worker thread — scheduler state is not thread-safe
+        self._engine_lock = asyncio.Lock()
+
+    # ------------------------------------------------------------- lifecycle
+
+    @classmethod
+    def from_config(cls, config: EngineConfig) -> "AsyncLLMEngine":
+        return cls(LLMEngine.from_config(config))
+
+    async def start(self) -> None:
+        if self._loop_task is None:
+            self._loop_task = asyncio.create_task(
+                self._run_loop(), name="engine-step-loop"
+            )
+
+    async def stop(self) -> None:
+        self._stopped = True
+        self._new_work.set()
+        if self._loop_task is not None:
+            self._loop_task.cancel()
+            try:
+                await self._loop_task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+            self._loop_task = None
+
+    # ----------------------------------------------------- EngineClient-like
+
+    @property
+    def errored(self) -> bool:
+        return self._dead_error is not None
+
+    @property
+    def dead_error(self) -> BaseException:
+        return self._dead_error or EngineDeadError("engine is dead")
+
+    @property
+    def is_running(self) -> bool:
+        return (
+            not self.errored
+            and not self._stopped
+            and self._loop_task is not None
+            and not self._loop_task.done()
+        )
+
+    async def get_tokenizer(self, lora_request=None):  # noqa: ANN001
+        return self.engine.get_tokenizer()
+
+    async def get_model_config(self):
+        return self.engine.get_model_config()
+
+    async def is_tracing_enabled(self) -> bool:
+        return self.engine.config.otlp_traces_endpoint is not None
+
+    async def check_health(self) -> None:
+        if self.errored:
+            raise self.dead_error
+
+    async def generate(
+        self,
+        prompt: Optional[str] = None,
+        sampling_params: Optional[SamplingParams] = None,
+        request_id: str = "",
+        *,
+        prompt_token_ids: Optional[list[int]] = None,
+        lora_request=None,  # noqa: ANN001 — adapter-store LoRARequest
+        trace_headers: Optional[Mapping[str, str]] = None,
+    ) -> AsyncGenerator[RequestOutput, None]:
+        """Submit a request and stream its outputs.
+
+        Yield cadence follows ``sampling_params.output_kind``: DELTA and
+        CUMULATIVE yield every step, FINAL_ONLY yields exactly once.
+        """
+        if self.errored:
+            raise self.dead_error
+        if self._loop_task is None:
+            await self.start()
+        sampling_params = sampling_params or SamplingParams()
+        if request_id in self._queues:
+            # reject WITHOUT touching the existing request's queue
+            raise ValueError(f"duplicate request_id {request_id!r}")
+        queue: asyncio.Queue = asyncio.Queue()
+        self._queues[request_id] = queue
+        try:
+            async with self._engine_lock:
+                self.engine.add_request(
+                    request_id,
+                    prompt,
+                    sampling_params,
+                    prompt_token_ids=prompt_token_ids,
+                    lora_name=getattr(lora_request, "name", None),
+                )
+        except Exception:
+            self._queues.pop(request_id, None)
+            raise
+        self._new_work.set()
+        try:
+            while True:
+                item = await queue.get()
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+                if item.finished:
+                    return
+        finally:
+            self._queues.pop(request_id, None)
+
+    async def abort(self, request_id: str) -> None:
+        async with self._engine_lock:
+            out = self.engine.abort_request(request_id)
+        queue = self._queues.get(request_id)
+        if queue is not None and out is not None:
+            queue.put_nowait(out)
+
+    # ------------------------------------------------------------- step loop
+
+    async def _run_loop(self) -> None:
+        try:
+            while not self._stopped:
+                if not self.engine.has_unfinished_requests():
+                    self._new_work.clear()
+                    await self._new_work.wait()
+                    continue
+                async with self._engine_lock:
+                    outputs = await asyncio.to_thread(self.engine.step)
+                for out in outputs:
+                    queue = self._queues.get(out.request_id)
+                    if queue is not None:
+                        queue.put_nowait(out)
+                    elif not out.finished:
+                        # stream consumer went away → stop generating
+                        async with self._engine_lock:
+                            self.engine.abort_request(out.request_id)
+        except asyncio.CancelledError:
+            raise
+        except BaseException as e:  # noqa: BLE001 — engine death is terminal
+            logger.exception("engine step loop died")
+            self._dead_error = e
+            for queue in self._queues.values():
+                queue.put_nowait(e)
+            raise

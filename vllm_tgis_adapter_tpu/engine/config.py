@@ -1,0 +1,236 @@
+"""Engine configuration objects.
+
+The analog of the model/cache/scheduler/parallel config surface the adapter
+consumes from vLLM (reference: grpc_server.py:195-199 reads
+``model_config.max_model_len``; args flow in via __main__.py:118-122).  All
+fields here are plain data so configs can be hashed into jit static args.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from pathlib import Path
+from typing import Any, Optional
+
+import jax.numpy as jnp
+
+_DTYPE_MAP = {
+    "bfloat16": jnp.bfloat16,
+    "float16": jnp.float16,
+    "float32": jnp.float32,
+    "float8_e4m3": jnp.float8_e4m3fn,
+}
+
+
+def resolve_dtype(name: str, default: str = "bfloat16"):
+    if name in ("auto", None):
+        name = default
+    if name not in _DTYPE_MAP:
+        raise ValueError(f"unsupported dtype: {name}")
+    return _DTYPE_MAP[name]
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    """Architecture hyperparameters, read from a HF-style ``config.json``."""
+
+    model: str
+    model_type: str
+    vocab_size: int
+    hidden_size: int
+    intermediate_size: int
+    num_layers: int
+    num_heads: int
+    num_kv_heads: int
+    head_dim: int
+    max_model_len: int
+    rope_theta: float = 10000.0
+    rms_norm_eps: float = 1e-6
+    tie_word_embeddings: bool = False
+    dtype: Any = jnp.bfloat16
+    eos_token_id: int = 2
+    bos_token_id: int = 1
+    # granite-style output scaling (1.0 = disabled)
+    logits_scaling: float = 1.0
+    embedding_multiplier: float = 1.0
+    residual_multiplier: float = 1.0
+    attention_multiplier: Optional[float] = None
+    # mixtral-style MoE (num_experts == 0 means dense)
+    num_experts: int = 0
+    num_experts_per_tok: int = 0
+    attention_bias: bool = False
+    mlp_bias: bool = False
+
+    @property
+    def q_per_kv(self) -> int:
+        return self.num_heads // self.num_kv_heads
+
+    @staticmethod
+    def from_hf_config(
+        model: str,
+        hf: dict,
+        *,
+        max_model_len: int | None = None,
+        dtype: str = "auto",
+    ) -> "ModelConfig":
+        """Map a HF transformers config dict onto ModelConfig.
+
+        Supports the llama lineage (llama/mistral/granite/mixtral/qwen2):
+        same decoder skeleton, differing in GQA ratios, biases, and the
+        granite scaling multipliers.
+        """
+        model_type = hf.get("model_type", "llama")
+        hidden = hf["hidden_size"]
+        heads = hf["num_attention_heads"]
+        derived_len = hf.get("max_position_embeddings", 2048)
+        eos = hf.get("eos_token_id", 2)
+        if isinstance(eos, list):
+            eos = eos[0]
+        return ModelConfig(
+            model=model,
+            model_type=model_type,
+            vocab_size=hf["vocab_size"],
+            hidden_size=hidden,
+            intermediate_size=hf.get("intermediate_size", 4 * hidden),
+            num_layers=hf["num_hidden_layers"],
+            num_heads=heads,
+            num_kv_heads=hf.get("num_key_value_heads", heads),
+            head_dim=hf.get("head_dim", hidden // heads),
+            max_model_len=max_model_len or derived_len,
+            rope_theta=hf.get("rope_theta", 10000.0),
+            rms_norm_eps=hf.get("rms_norm_eps", 1e-6),
+            tie_word_embeddings=hf.get("tie_word_embeddings", False),
+            dtype=resolve_dtype(dtype),
+            eos_token_id=eos,
+            bos_token_id=hf.get("bos_token_id", 1) or 1,
+            logits_scaling=hf.get("logits_scaling", 1.0),
+            embedding_multiplier=hf.get("embedding_multiplier", 1.0),
+            residual_multiplier=hf.get("residual_multiplier", 1.0),
+            attention_multiplier=hf.get("attention_multiplier"),
+            num_experts=hf.get("num_local_experts", 0),
+            num_experts_per_tok=hf.get("num_experts_per_tok", 0),
+            attention_bias=hf.get("attention_bias", False),
+            mlp_bias=hf.get("mlp_bias", False),
+        )
+
+    @staticmethod
+    def from_pretrained(
+        model_path: str,
+        *,
+        max_model_len: int | None = None,
+        dtype: str = "auto",
+    ) -> "ModelConfig":
+        config_file = Path(model_path) / "config.json"
+        if not config_file.exists():
+            raise ValueError(
+                f"model path {model_path!r} has no config.json; only local "
+                "model paths are supported (use `model-util download-weights` "
+                "to fetch from the HF hub)"
+            )
+        with open(config_file) as f:
+            hf = json.load(f)
+        return ModelConfig.from_hf_config(
+            model_path, hf, max_model_len=max_model_len, dtype=dtype
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class CacheConfig:
+    """Paged KV-cache geometry."""
+
+    block_size: int = 16
+    num_blocks: int = 512  # resolved against the HBM budget at engine boot
+    cache_dtype: Any = jnp.bfloat16
+
+
+@dataclasses.dataclass(frozen=True)
+class SchedulerConfig:
+    max_num_seqs: int = 64
+    max_num_batched_tokens: int = 2048
+    # prompt lengths are padded up to one of these buckets to bound the
+    # number of distinct compiled prefill shapes
+    prefill_buckets: tuple[int, ...] = (32, 64, 128, 256, 512, 1024, 2048, 4096)
+
+
+@dataclasses.dataclass(frozen=True)
+class ParallelConfig:
+    tensor_parallel_size: int = 1
+    pipeline_parallel_size: int = 1
+    data_parallel_size: int = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class LoRAConfig:
+    enabled: bool = False
+    max_loras: int = 4
+    max_lora_rank: int = 64
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    model_config: ModelConfig
+    cache_config: CacheConfig
+    scheduler_config: SchedulerConfig
+    parallel_config: ParallelConfig
+    lora_config: LoRAConfig
+    tokenizer: str | None = None
+    seed: int = 0
+    max_logprobs: int = 20
+    hbm_memory_utilization: float = 0.90
+    quantization: str | None = None
+    otlp_traces_endpoint: str | None = None
+    disable_log_requests: bool = True
+
+    @property
+    def max_model_len(self) -> int:
+        return self.model_config.max_model_len
+
+    @staticmethod
+    def from_args(args: Any) -> "EngineConfig":
+        """Build from the parsed CLI namespace (tgis_utils/args.py)."""
+        model_config = ModelConfig.from_pretrained(
+            args.model,
+            max_model_len=args.max_model_len,
+            dtype=args.dtype,
+        )
+        max_len = model_config.max_model_len
+        buckets = tuple(
+            b for b in SchedulerConfig.prefill_buckets if b < max_len
+        ) + (max_len,)
+        return EngineConfig(
+            model_config=model_config,
+            cache_config=CacheConfig(
+                block_size=args.block_size,
+                cache_dtype=(
+                    model_config.dtype
+                    if args.kv_cache_dtype == "auto"
+                    else resolve_dtype(args.kv_cache_dtype)
+                ),
+            ),
+            scheduler_config=SchedulerConfig(
+                max_num_seqs=args.max_num_seqs,
+                max_num_batched_tokens=(
+                    args.max_num_batched_tokens or max(2048, max_len)
+                ),
+                prefill_buckets=buckets,
+            ),
+            parallel_config=ParallelConfig(
+                tensor_parallel_size=args.tensor_parallel_size or 1,
+                pipeline_parallel_size=args.pipeline_parallel_size,
+                data_parallel_size=args.data_parallel_size,
+            ),
+            lora_config=LoRAConfig(
+                enabled=args.enable_lora,
+                max_loras=args.max_loras,
+                max_lora_rank=args.max_lora_rank,
+            ),
+            tokenizer=args.tokenizer,
+            seed=args.seed,
+            max_logprobs=args.max_logprobs,
+            hbm_memory_utilization=args.hbm_memory_utilization,
+            quantization=args.quantization,
+            otlp_traces_endpoint=args.otlp_traces_endpoint,
+            disable_log_requests=args.disable_log_requests,
+        )

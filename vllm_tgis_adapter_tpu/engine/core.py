@@ -1,0 +1,262 @@
+"""Synchronous engine core: request admission → step loop → outputs.
+
+The TPU-native engine beneath the serving layer.  Together with
+``async_llm.AsyncLLMEngine`` it satisfies the capability surface the
+reference adapter consumes from vLLM (SURVEY.md §2.3): add/abort requests,
+continuous batching, per-step sampling, incremental detokenization, stop
+detection, and per-request timing metrics (reference consumption points:
+grpc_server.py:205-225, tgis_utils/logs.py:193-202).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from vllm_tgis_adapter_tpu.engine.config import EngineConfig
+from vllm_tgis_adapter_tpu.engine.detokenizer import IncrementalDetokenizer
+from vllm_tgis_adapter_tpu.engine.outputs import Logprob, RequestOutput
+from vllm_tgis_adapter_tpu.engine.runner import (
+    ModelRunner,
+    PromptLogprobInfo,
+    SampledToken,
+)
+from vllm_tgis_adapter_tpu.engine.sampling_params import (
+    RequestOutputKind,
+    SamplingParams,
+)
+from vllm_tgis_adapter_tpu.engine.scheduler import (
+    DecodePlan,
+    PrefillPlan,
+    Scheduler,
+)
+from vllm_tgis_adapter_tpu.engine.sequence import Sequence, SequenceStatus
+from vllm_tgis_adapter_tpu.logging import init_logger
+
+logger = init_logger(__name__)
+
+
+class LLMEngine:
+    """Single-process engine: one model, one scheduler, one device program."""
+
+    def __init__(self, config: EngineConfig, model, params, tokenizer):
+        self.config = config
+        self.tokenizer = tokenizer
+        self.runner = ModelRunner(config, model, params)
+        self.scheduler = Scheduler(
+            config.scheduler_config,
+            config.cache_config,
+            config.cache_config.num_blocks,
+        )
+        self._seqs: dict[str, Sequence] = {}
+
+    # ------------------------------------------------------------- lifecycle
+
+    @classmethod
+    def from_config(cls, config: EngineConfig) -> "LLMEngine":
+        from transformers import AutoTokenizer
+
+        from vllm_tgis_adapter_tpu.engine.weights import load_llama_params
+        from vllm_tgis_adapter_tpu.models import get_model_class
+
+        mcfg = config.model_config
+        model_cls = get_model_class(mcfg.model_type)
+        model = model_cls(mcfg)
+        logger.info("loading weights from %s", mcfg.model)
+        params = load_llama_params(mcfg, mcfg.model)
+        tokenizer = AutoTokenizer.from_pretrained(config.tokenizer or mcfg.model)
+        return cls(config, model, params, tokenizer)
+
+    def get_tokenizer(self):
+        return self.tokenizer
+
+    def get_model_config(self):
+        return self.config.model_config
+
+    # -------------------------------------------------------------- requests
+
+    def add_request(
+        self,
+        request_id: str,
+        prompt: Optional[str],
+        params: SamplingParams,
+        *,
+        prompt_token_ids: Optional[list[int]] = None,
+        arrival_time: Optional[float] = None,
+        lora_name: Optional[str] = None,
+    ) -> None:
+        if request_id in self._seqs:
+            raise ValueError(f"duplicate request_id {request_id!r}")
+        if prompt_token_ids is None:
+            if prompt is None:
+                raise ValueError("either prompt or prompt_token_ids required")
+            prompt_token_ids = self.tokenizer(prompt).input_ids
+        max_len = self.config.max_model_len
+        if len(prompt_token_ids) >= max_len:
+            raise ValueError(
+                f"prompt length {len(prompt_token_ids)} exceeds "
+                f"max_model_len {max_len}"
+            )
+        seq = Sequence(
+            request_id,
+            prompt,
+            list(prompt_token_ids),
+            params,
+            arrival_time=arrival_time,
+            fallback_seed=self.runner.new_fallback_seed(),
+            lora_name=lora_name,
+        )
+        seq.detokenizer = IncrementalDetokenizer(
+            self.tokenizer,
+            seq.prompt_token_ids,
+            skip_special_tokens=params.skip_special_tokens,
+        )
+        self._seqs[request_id] = seq
+        self.scheduler.add(seq)
+
+    def abort_request(self, request_id: str) -> Optional[RequestOutput]:
+        seq = self._seqs.pop(request_id, None)
+        if seq is None or seq.is_finished:
+            return None
+        self.scheduler.abort(request_id)
+        seq.metrics.finished_time = time.time()
+        return seq.to_request_output()
+
+    def has_unfinished_requests(self) -> bool:
+        return self.scheduler.num_unfinished > 0
+
+    # ------------------------------------------------------------- step loop
+
+    def step(self) -> list[RequestOutput]:
+        """Run one device step; return outputs due for emission."""
+        outputs: list[RequestOutput] = []
+        for seq in self.scheduler.newly_finished:
+            self._seqs.pop(seq.request_id, None)
+            seq.metrics.finished_time = time.time()
+            outputs.append(seq.to_request_output())
+        self.scheduler.newly_finished.clear()
+
+        plan = self.scheduler.schedule()
+        if plan is None:
+            return outputs
+
+        now = time.time()
+        if isinstance(plan, PrefillPlan):
+            seq = plan.seq
+            if seq.metrics.first_scheduled_time is None:
+                seq.metrics.first_scheduled_time = now
+                seq.metrics.time_in_queue = now - seq.metrics.arrival_time
+            sampled, prompt_info = self.runner.run_prefill(plan)
+            if prompt_info is not None and seq.prompt_logprobs is None:
+                seq.prompt_logprobs = self._build_prompt_logprobs(
+                    seq, prompt_info
+                )
+            outputs.extend(self._process_sampled([seq], [sampled]))
+        elif isinstance(plan, DecodePlan):
+            sampled = self.runner.run_decode(plan)
+            outputs.extend(self._process_sampled(plan.seqs, sampled))
+        return outputs
+
+    # -------------------------------------------------------------- internal
+
+    def _process_sampled(
+        self, seqs: list[Sequence], sampled: list[SampledToken]
+    ) -> list[RequestOutput]:
+        now = time.time()
+        outputs = []
+        for seq, tok in zip(seqs, sampled):
+            if seq.is_finished:
+                continue  # aborted mid-step
+            seq.output_token_ids.append(tok.token_id)
+            if seq.metrics.first_token_time is None:
+                seq.metrics.first_token_time = now
+            seq.metrics.last_token_time = now
+            seq.detokenizer.append([tok.token_id])
+            if seq.output_logprobs is not None:
+                seq.output_logprobs.append(self._build_logprob_dict(seq, tok))
+            self._maybe_finish(seq, tok.token_id)
+            if seq.is_finished:
+                seq.metrics.finished_time = now
+                self.scheduler.finish(seq)
+                self._seqs.pop(seq.request_id, None)
+                outputs.append(seq.to_request_output())
+            elif seq.params.output_kind != RequestOutputKind.FINAL_ONLY:
+                # DELTA with an empty text delta still carries the token id
+                outputs.append(seq.to_request_output())
+        return outputs
+
+    def _maybe_finish(self, seq: Sequence, token_id: int) -> None:
+        params = seq.params
+        eos = self.config.model_config.eos_token_id
+        if not params.ignore_eos and token_id == eos:
+            seq.status = SequenceStatus.FINISHED_STOPPED
+            seq.stop_reason = None
+            return
+        if params.stop:
+            text = seq.output_text
+            best: Optional[tuple[int, str]] = None
+            for s in params.stop:
+                idx = text.find(s)
+                if idx != -1 and (best is None or idx < best[0]):
+                    best = (idx, s)
+            if best is not None:
+                idx, s = best
+                seq.status = SequenceStatus.FINISHED_STOPPED
+                seq.stop_reason = s
+                end = idx + len(s) if params.include_stop_str_in_output else idx
+                seq.detokenizer.output_text = text[:end]
+                return
+        max_tokens = params.max_tokens
+        if max_tokens is not None and seq.num_output_tokens >= max_tokens:
+            seq.status = SequenceStatus.FINISHED_LENGTH
+            return
+        if seq.num_tokens >= self.config.max_model_len:
+            seq.status = SequenceStatus.FINISHED_LENGTH
+
+    def _decode_token_text(self, token_id: int) -> str:
+        return self.tokenizer.convert_ids_to_tokens(token_id)
+
+    def _build_logprob_dict(
+        self, seq: Sequence, tok: SampledToken
+    ) -> dict[int, Logprob]:
+        """{token_id: Logprob} for the chosen token + requested top-N."""
+        n = seq.params.logprobs or 0
+        entry: dict[int, Logprob] = {}
+        for i in range(min(n, len(tok.topn_ids))):
+            tid = tok.topn_ids[i]
+            entry[tid] = Logprob(
+                logprob=tok.topn_logprobs[i],
+                rank=i + 1,
+                decoded_token=self._decode_token_text(tid),
+            )
+        if tok.token_id not in entry:
+            entry[tok.token_id] = Logprob(
+                logprob=tok.logprob,
+                rank=tok.rank,
+                decoded_token=self._decode_token_text(tok.token_id),
+            )
+        return entry
+
+    def _build_prompt_logprobs(
+        self, seq: Sequence, info: PromptLogprobInfo
+    ) -> list:
+        n = seq.params.prompt_logprobs or 0
+        result: list = [None]  # position 0 has no logprob
+        for i in range(len(info.logprobs)):
+            token_id = seq.prompt_token_ids[i + 1]
+            entry: dict[int, Logprob] = {}
+            for j in range(min(n, len(info.topn_ids[i]))):
+                tid = info.topn_ids[i][j]
+                entry[tid] = Logprob(
+                    logprob=info.topn_logprobs[i][j],
+                    rank=j + 1,
+                    decoded_token=self._decode_token_text(tid),
+                )
+            if token_id not in entry:
+                entry[token_id] = Logprob(
+                    logprob=info.logprobs[i],
+                    rank=info.ranks[i],
+                    decoded_token=self._decode_token_text(token_id),
+                )
+            result.append(entry)
+        return result

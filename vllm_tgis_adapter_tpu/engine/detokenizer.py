@@ -1,0 +1,61 @@
+"""Incremental detokenization for streaming.
+
+Streaming sends text deltas per decode step, but BPE tokenizers cannot be
+decoded one token at a time: multi-token UTF-8 sequences and sentencepiece
+whitespace handling make ``decode([t])`` lossy.  This implements the
+standard two-offset algorithm (as used across TGIS/vLLM/HF TGI): keep a
+window of recent token ids, decode prefix and full window, and emit only
+the suffix once it no longer ends in an incomplete UTF-8 replacement char.
+
+Reference behavior anchor: the adapter's per-token wire conversion uses
+``convert_ids_to_tokens`` for token *texts* (grpc_server.py:717) while the
+running output text comes from the engine's incremental detokenizer; both
+are provided here.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:
+    from transformers import PreTrainedTokenizerBase
+
+
+class IncrementalDetokenizer:
+    def __init__(
+        self,
+        tokenizer: "PreTrainedTokenizerBase",
+        prompt_token_ids: list[int],
+        *,
+        skip_special_tokens: bool = True,
+    ):
+        self._tokenizer = tokenizer
+        self._skip_special = skip_special_tokens
+        # seed the window with prompt tail so the first generated token gets
+        # correct leading-space treatment
+        self._all_ids: list[int] = list(prompt_token_ids[-8:])
+        self._prefix_offset = 0
+        self._read_offset = len(self._all_ids)
+        self.output_text = ""
+
+    def append(self, token_ids: list[int]) -> str:
+        """Add generated token ids; return the new text delta (may be '')."""
+        if not token_ids:
+            return ""
+        self._all_ids.extend(token_ids)
+        prefix_text = self._tokenizer.decode(
+            self._all_ids[self._prefix_offset : self._read_offset],
+            skip_special_tokens=self._skip_special,
+        )
+        full_text = self._tokenizer.decode(
+            self._all_ids[self._prefix_offset :],
+            skip_special_tokens=self._skip_special,
+        )
+        if len(full_text) > len(prefix_text) and not full_text.endswith("�"):
+            delta = full_text[len(prefix_text) :]
+            self._prefix_offset = self._read_offset
+            self._read_offset = len(self._all_ids)
+            self.output_text += delta
+            return delta
+        # token did not yet complete a printable unit (e.g. UTF-8 continuation)
+        return ""

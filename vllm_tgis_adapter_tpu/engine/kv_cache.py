@@ -1,0 +1,79 @@
+"""Paged KV-cache block management (host-side bookkeeping).
+
+TPU-native counterpart of vLLM's block manager: the device holds one flat
+slot-indexed cache per K/V (see ops/attention.py for the layout); this
+module owns which pages belong to which sequence.  Allocation is on-demand
+per decode step; when the pool runs dry the scheduler preempts the
+youngest sequence and re-prefills it later (engine/scheduler.py).
+
+Device memory sizing happens at engine boot: the page count is derived
+from the HBM budget left after weights (engine/core.py).
+"""
+
+from __future__ import annotations
+
+from vllm_tgis_adapter_tpu.logging import init_logger
+
+logger = init_logger(__name__)
+
+
+class BlockAllocator:
+    """Free-list allocator over a fixed pool of KV pages."""
+
+    def __init__(self, num_blocks: int, block_size: int):
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self._free: list[int] = list(range(num_blocks - 1, -1, -1))
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    def can_allocate(self, n: int) -> bool:
+        return len(self._free) >= n
+
+    def allocate(self, n: int) -> list[int]:
+        if n > len(self._free):
+            raise RuntimeError(
+                f"KV cache exhausted: need {n} pages, {len(self._free)} free"
+            )
+        taken = self._free[-n:][::-1]
+        del self._free[len(self._free) - n:]
+        return taken
+
+    def free(self, blocks: list[int]) -> None:
+        self._free.extend(reversed(blocks))
+
+    def blocks_needed(self, num_tokens: int) -> int:
+        return -(-num_tokens // self.block_size)
+
+
+class SequenceBlocks:
+    """Per-sequence page list + slot computation."""
+
+    def __init__(self, allocator: BlockAllocator):
+        self._allocator = allocator
+        self.blocks: list[int] = []
+        self.num_tokens = 0
+
+    def ensure_capacity(self, num_tokens: int) -> None:
+        """Grow the page list to hold ``num_tokens`` total tokens."""
+        needed = self._allocator.blocks_needed(num_tokens) - len(self.blocks)
+        if needed > 0:
+            self.blocks.extend(self._allocator.allocate(needed))
+
+    def slot_for(self, position: int) -> int:
+        """Flat cache slot for the token at ``position``."""
+        block = self.blocks[position // self._allocator.block_size]
+        return block * self._allocator.block_size + (
+            position % self._allocator.block_size
+        )
+
+    def slots_for_range(self, start: int, end: int) -> list[int]:
+        return [self.slot_for(p) for p in range(start, end)]
+
+    def release(self) -> None:
+        if self.blocks:
+            self._allocator.free(self.blocks)
+            self.blocks = []
+        self.num_tokens = 0

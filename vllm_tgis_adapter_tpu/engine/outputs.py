@@ -1,0 +1,63 @@
+"""Engine output and metrics dataclasses.
+
+Shapes mirror the surface the reference adapter reads from vLLM
+(SURVEY.md §2.3): ``RequestOutput.prompt_token_ids / prompt_logprobs /
+outputs[0].{token_ids,text,logprobs,finish_reason,stop_reason}`` and
+``RequestMetrics.{first_scheduled_time,time_in_queue,last_token_time}``
+(reference: grpc_server.py:274-311, tgis_utils/logs.py:193-202).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Union
+
+
+@dataclasses.dataclass
+class Logprob:
+    logprob: float
+    rank: Optional[int] = None
+    decoded_token: Optional[str] = None
+
+
+# {token_id: Logprob} per position; None entry = not requested at that position
+LogprobsList = list[Optional[dict[int, Logprob]]]
+
+
+@dataclasses.dataclass
+class RequestMetrics:
+    arrival_time: float
+    first_scheduled_time: Optional[float] = None
+    first_token_time: Optional[float] = None
+    last_token_time: Optional[float] = None
+    time_in_queue: Optional[float] = None
+    finished_time: Optional[float] = None
+
+
+@dataclasses.dataclass
+class CompletionOutput:
+    index: int
+    text: str
+    token_ids: list[int]
+    cumulative_logprob: Optional[float] = None
+    logprobs: Optional[LogprobsList] = None
+    # None = still running; "length" | "stop" | "abort" | "error"
+    finish_reason: Optional[str] = None
+    # for finish_reason == "stop": the matched stop string, or the int token
+    # id of the EOS token, or None for EOS-token default
+    stop_reason: Union[str, int, None] = None
+
+    @property
+    def finished(self) -> bool:
+        return self.finish_reason is not None
+
+
+@dataclasses.dataclass
+class RequestOutput:
+    request_id: str
+    prompt: Optional[str]
+    prompt_token_ids: list[int]
+    outputs: list[CompletionOutput]
+    finished: bool
+    prompt_logprobs: Optional[LogprobsList] = None
+    metrics: Optional[RequestMetrics] = None

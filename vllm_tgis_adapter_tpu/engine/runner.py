@@ -1,0 +1,210 @@
+"""Model runner: scheduler plans → jitted device programs → sampled tokens.
+
+Owns the jit-compiled prefill/decode functions, the device-resident KV
+caches, the seen-token matrix for repetition penalties, and the sampler
+invocation.  All shapes flowing into jit are drawn from the scheduler's
+buckets, so the compile count is bounded by
+``len(prefill_buckets) + len(batch_buckets)`` (SURVEY.md §7 "XLA
+recompilation discipline").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import TYPE_CHECKING, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from vllm_tgis_adapter_tpu.engine import sampler as sampler_mod
+from vllm_tgis_adapter_tpu.engine.sampler import TOPN_WIDTH, SamplingTensors
+from vllm_tgis_adapter_tpu.logging import init_logger
+
+if TYPE_CHECKING:
+    from vllm_tgis_adapter_tpu.engine.config import EngineConfig
+    from vllm_tgis_adapter_tpu.engine.scheduler import DecodePlan, PrefillPlan
+
+logger = init_logger(__name__)
+
+
+@dataclasses.dataclass
+class SampledToken:
+    """Host-side result for one sequence after one step."""
+
+    token_id: int
+    logprob: float
+    rank: int
+    topn_ids: list[int]
+    topn_logprobs: list[float]
+
+
+@dataclasses.dataclass
+class PromptLogprobInfo:
+    """Per-position prompt logprob table (position 0 has no entry)."""
+
+    logprobs: list[float]  # [T-1] for positions 1..T-1
+    ranks: list[int]
+    topn_ids: list[list[int]]
+    topn_logprobs: list[list[float]]
+
+
+class ModelRunner:
+    def __init__(self, config: "EngineConfig", model, params):
+        self.config = config
+        self.model = model
+        self.params = params
+        cache_cfg = config.cache_config
+        mcfg = config.model_config
+        self.block_size = cache_cfg.block_size
+        self.num_slots = cache_cfg.num_blocks * cache_cfg.block_size
+        self.max_blocks_per_seq = -(-mcfg.max_model_len // self.block_size)
+        self.caches = model.make_kv_caches(self.num_slots, cache_cfg.cache_dtype)
+
+        # buffer donation lets XLA update the KV cache in place; host
+        # platforms don't implement donation and warn, so gate it
+        donate = (1,) if jax.default_backend() == "tpu" else ()
+        self._prefill_fn = jax.jit(model.prefill, donate_argnums=donate)
+        self._decode_fn = jax.jit(
+            model.decode, static_argnums=(7,), donate_argnums=donate
+        )
+
+        max_seqs = config.scheduler_config.max_num_seqs
+        self.seen = jnp.zeros((max_seqs, mcfg.vocab_size), bool)
+        self._rng = np.random.default_rng(config.seed)
+
+    def new_fallback_seed(self) -> int:
+        """Engine-drawn PRNG material for requests without an explicit seed."""
+        return int(self._rng.integers(0, 2**32, dtype=np.uint32))
+
+    # --------------------------------------------------------------- prefill
+
+    def run_prefill(
+        self, plan: "PrefillPlan"
+    ) -> tuple[SampledToken, Optional[PromptLogprobInfo]]:
+        seq = plan.seq
+        t = len(plan.token_ids)
+        bucket = plan.bucket_len
+
+        token_ids = np.zeros(bucket, np.int32)
+        token_ids[:t] = plan.token_ids
+        positions = np.arange(bucket, dtype=np.int32)
+        slot_mapping = np.full(bucket, -1, np.int32)
+        slot_mapping[:t] = plan.slots
+
+        want_prompt_lp = seq.params.prompt_logprobs is not None
+        logits_indices = (
+            np.arange(bucket, dtype=np.int32)
+            if want_prompt_lp
+            else np.asarray([t - 1], np.int32)
+        )
+
+        logits, self.caches = self._prefill_fn(
+            self.params,
+            self.caches,
+            jnp.asarray(token_ids),
+            jnp.asarray(positions),
+            jnp.asarray(slot_mapping),
+            jnp.asarray(t, jnp.int32),
+            jnp.asarray(logits_indices),
+        )
+
+        prompt_info = None
+        if want_prompt_lp:
+            lp, rank, tn_ids, tn_lp = sampler_mod.prompt_logprob_info(
+                logits, jnp.asarray(token_ids)
+            )
+            n = t - 1  # rows 0..t-2 describe positions 1..t-1
+            prompt_info = PromptLogprobInfo(
+                logprobs=np.asarray(lp)[:n].tolist(),
+                ranks=np.asarray(rank)[:n].tolist(),
+                topn_ids=np.asarray(tn_ids)[:n].tolist(),
+                topn_logprobs=np.asarray(tn_lp)[:n].tolist(),
+            )
+            last_logits = logits[t - 1][None]
+        else:
+            last_logits = logits
+
+        # seed this row's seen-token matrix with the prompt, then sample
+        row_tokens = np.full(bucket, -1, np.int32)
+        row_tokens[:t] = plan.token_ids
+        self.seen = sampler_mod.set_seen_row(
+            self.seen, jnp.asarray(seq.slot), jnp.asarray(row_tokens)
+        )
+        result = self._sample(last_logits, [seq])
+        return result[0], prompt_info
+
+    # ---------------------------------------------------------------- decode
+
+    def run_decode(self, plan: "DecodePlan") -> list[SampledToken]:
+        seqs = plan.seqs
+        n, b = len(seqs), plan.batch_bucket
+
+        token_ids = np.zeros(b, np.int32)
+        positions = np.zeros(b, np.int32)
+        slot_mapping = np.full(b, -1, np.int32)
+        context_lens = np.ones(b, np.int32)
+        block_tables = np.zeros((b, self.max_blocks_per_seq), np.int32)
+        for i, seq in enumerate(seqs):
+            pos = seq.num_tokens - 1  # the last sampled token runs this step
+            token_ids[i] = seq.all_token_ids[-1]
+            positions[i] = pos
+            slot_mapping[i] = seq.blocks.slot_for(pos)
+            context_lens[i] = seq.num_tokens
+            blocks = seq.blocks.blocks
+            block_tables[i, : len(blocks)] = blocks
+
+        logits, self.caches = self._decode_fn(
+            self.params,
+            self.caches,
+            jnp.asarray(token_ids),
+            jnp.asarray(positions),
+            jnp.asarray(slot_mapping),
+            jnp.asarray(block_tables),
+            jnp.asarray(context_lens),
+            self.block_size,
+        )
+        return self._sample(logits, seqs)
+
+    # --------------------------------------------------------------- sampler
+
+    def _sample(self, logits: jax.Array, seqs) -> list[SampledToken]:
+        """Sample one token per row; rows beyond ``len(seqs)`` are padding."""
+        b = logits.shape[0]
+        params_list = [s.params for s in seqs] + [None] * (b - len(seqs))
+        gen_lens = [s.num_output_tokens for s in seqs] + [0] * (b - len(seqs))
+        seeds = np.zeros(b, np.uint32)
+        slots = np.full(b, -1, np.int32)
+        for i, s in enumerate(seqs):
+            seeds[i] = s.fallback_seed
+            slots[i] = s.slot
+
+        tensors = SamplingTensors.from_params(
+            params_list,
+            eos_token_id=self.config.model_config.eos_token_id,
+            gen_lens=gen_lens,
+            fallback_seeds=seeds,
+        )
+        seen_rows = jnp.take(
+            self.seen, jnp.clip(jnp.asarray(slots), 0, None), axis=0
+        )
+        out = sampler_mod.sample(logits, seen_rows, tensors)
+        self.seen = sampler_mod.update_seen(
+            self.seen, jnp.asarray(slots), out.tokens
+        )
+
+        tokens = np.asarray(out.tokens)
+        logprobs = np.asarray(out.logprob)
+        ranks = np.asarray(out.rank)
+        tn_ids = np.asarray(out.topn_ids)
+        tn_lp = np.asarray(out.topn_logprobs)
+        return [
+            SampledToken(
+                token_id=int(tokens[i]),
+                logprob=float(logprobs[i]),
+                rank=int(ranks[i]),
+                topn_ids=tn_ids[i].tolist(),
+                topn_logprobs=tn_lp[i].tolist(),
+            )
+            for i in range(len(seqs))
+        ]

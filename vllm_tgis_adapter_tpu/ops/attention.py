@@ -1,0 +1,121 @@
+"""Attention over the paged KV cache.
+
+TPU-native replacement for the paged-attention CUDA kernels the reference
+stack executes inside vLLM (SURVEY.md §2.2/§2.3).  This module holds the
+XLA-composed implementations: dense causal prefill attention and
+gather-based paged decode attention.  They are correct on every backend
+(CPU tests included) and serve as the numerical reference for the Pallas
+TPU kernels in ``pallas_attention.py``, which are swapped in at engine boot
+when running on real TPU hardware.
+
+Layout choices (TPU-first):
+* KV cache is one array per K/V of shape ``[num_layers, num_slots, kv_heads,
+  head_dim]`` where ``num_slots = num_blocks * block_size`` — a flat slot
+  dimension so page writes are scatters and page reads are gathers with
+  plain integer indices (no data-dependent shapes, jit-stable).
+* softmax runs in float32 regardless of cache dtype (MXU-friendly bf16 in,
+  f32 accumulate).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = float("-inf")
+
+
+def write_kv(
+    k_cache: jax.Array,  # [num_slots, Hkv, Dh]
+    v_cache: jax.Array,
+    k: jax.Array,  # [T, Hkv, Dh]
+    v: jax.Array,
+    slot_mapping: jax.Array,  # [T] int32 flat slot per token; -1 = drop
+) -> tuple[jax.Array, jax.Array]:
+    """Scatter new K/V rows into their assigned cache slots.
+
+    Padding tokens carry slot -1; JAX's scatter mode='drop' only discards
+    out-of-bounds *positive* indices (negatives wrap), so negatives are
+    remapped to num_slots first and then dropped.
+    """
+    k = k.astype(k_cache.dtype)
+    v = v.astype(v_cache.dtype)
+    safe = jnp.where(slot_mapping < 0, k_cache.shape[0], slot_mapping)
+    k_cache = k_cache.at[safe].set(k, mode="drop")
+    v_cache = v_cache.at[safe].set(v, mode="drop")
+    return k_cache, v_cache
+
+
+def prefill_attention(
+    q: jax.Array,  # [T, H, Dh]
+    k: jax.Array,  # [T, Hkv, Dh]
+    v: jax.Array,  # [T, Hkv, Dh]
+    scale: float,
+    valid_len: jax.Array | None = None,  # scalar int: tokens < valid_len attend
+) -> jax.Array:
+    """Causal self-attention over a single (padded) prompt.
+
+    Prompts are padded up to a bucket length; padding tokens still flow
+    through the math (static shapes) but their K/V are masked out for real
+    tokens' queries via the causal mask, and their own outputs are discarded
+    by the caller.
+    """
+    t, num_heads, head_dim = q.shape
+    num_kv = k.shape[1]
+    q_per_kv = num_heads // num_kv
+
+    qh = q.reshape(t, num_kv, q_per_kv, head_dim).astype(jnp.float32)
+    kh = k.astype(jnp.float32)
+    vh = v.astype(jnp.float32)
+
+    # [num_kv, q_per_kv, Tq, Tk]
+    scores = jnp.einsum("tkgd,skd->kgts", qh, kh) * scale
+    causal = jnp.tril(jnp.ones((t, t), dtype=bool))
+    mask = causal
+    if valid_len is not None:
+        mask = mask & (jnp.arange(t) < valid_len)[None, :]
+    scores = jnp.where(mask[None, None], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("kgts,skd->tkgd", probs, vh)
+    return out.reshape(t, num_heads, head_dim).astype(q.dtype)
+
+
+def paged_decode_attention(
+    q: jax.Array,  # [B, H, Dh]
+    k_cache: jax.Array,  # [num_slots, Hkv, Dh]
+    v_cache: jax.Array,
+    block_tables: jax.Array,  # [B, max_blocks] int32 page ids (-1 pad)
+    context_lens: jax.Array,  # [B] int32, tokens of context incl. current
+    block_size: int,
+    scale: float,
+) -> jax.Array:
+    """One-token-per-sequence attention against the paged cache.
+
+    Gather-based XLA implementation: materialises each sequence's pages as
+    ``[B, max_blocks * block_size]`` rows, masks beyond ``context_len``.
+    """
+    b, num_heads, head_dim = q.shape
+    max_blocks = block_tables.shape[1]
+    num_kv = k_cache.shape[1]
+    q_per_kv = num_heads // num_kv
+    s = max_blocks * block_size
+
+    # [B, S] flat slot index per in-context token position
+    slot_idx = (
+        block_tables[:, :, None] * block_size
+        + jnp.arange(block_size)[None, None, :]
+    ).reshape(b, s)
+    # pages with id -1 produce negative slots; take(mode='fill') would give
+    # garbage — clamp and rely on the length mask instead
+    gather_idx = jnp.clip(slot_idx, 0, k_cache.shape[0] - 1)
+
+    keys = jnp.take(k_cache, gather_idx, axis=0).astype(jnp.float32)  # [B,S,Hkv,Dh]
+    values = jnp.take(v_cache, gather_idx, axis=0).astype(jnp.float32)
+
+    qh = q.reshape(b, num_kv, q_per_kv, head_dim).astype(jnp.float32)
+    scores = jnp.einsum("bkgd,bskd->bkgs", qh, keys) * scale
+    length_mask = jnp.arange(s)[None, :] < context_lens[:, None]  # [B, S]
+    scores = jnp.where(length_mask[:, None, None, :], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bkgs,bskd->bkgd", probs, values)
+    return out.reshape(b, num_heads, head_dim).astype(q.dtype)
