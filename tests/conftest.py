@@ -15,6 +15,7 @@ initialisation, so it must be set before the first device query.
 from __future__ import annotations
 
 import os
+from pathlib import Path
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
@@ -38,3 +39,110 @@ def tiny_model_dir(tmp_path_factory) -> str:
     path = tmp_path_factory.mktemp("tiny-llama")
     build_tiny_llama(str(path))
     return str(path)
+
+
+def _build_args(argv: list[str]):
+    """Run the REAL parser chain, as the reference's conftest does
+    (conftest.py:80-98), instead of constructing a namespace by hand."""
+    import sys
+
+    from vllm_tgis_adapter_tpu.tgis_utils.args import (
+        make_parser,
+        postprocess_tgis_args,
+    )
+
+    old_argv = sys.argv
+    sys.argv = ["__main__.py", *argv]
+    try:
+        return postprocess_tgis_args(make_parser().parse_args())
+    finally:
+        sys.argv = old_argv
+
+
+@pytest.fixture(scope="session")
+def server_args(tiny_model_dir):
+    from tests.utils import get_random_port
+
+    return _build_args(
+        [
+            "--model",
+            tiny_model_dir,
+            "--max-model-len",
+            "512",
+            "--dtype",
+            "float32",
+            "--grpc-port",
+            str(get_random_port()),
+            "--port",
+            str(get_random_port()),
+            "--max-num-seqs",
+            "8",
+            "--adapter-cache",
+            str(Path(__file__).parent / "fixtures"),
+        ]
+    )
+
+
+@pytest.fixture(scope="session")
+def _servers(server_args):
+    """Boot the REAL dual-server stack (no mock engine) in a background
+    thread's event loop, mirroring the reference's integration strategy."""
+    import asyncio
+    import threading
+    import urllib.request
+    from contextlib import suppress
+
+    from tests.utils import GrpcClient, wait_until
+
+    from vllm_tgis_adapter_tpu.__main__ import start_servers
+
+    loop = asyncio.new_event_loop()
+    server_task = None
+
+    def target() -> None:
+        nonlocal server_task
+        asyncio.set_event_loop(loop)
+        server_task = loop.create_task(start_servers(server_args))
+        with suppress(asyncio.CancelledError):
+            loop.run_until_complete(server_task)
+
+    thread = threading.Thread(target=target, daemon=True)
+    thread.start()
+
+    def http_healthy() -> bool:
+        with urllib.request.urlopen(
+            f"http://localhost:{server_args.port}/health", timeout=5
+        ) as resp:
+            return resp.status == 200
+
+    def grpc_healthy() -> bool:
+        with GrpcClient("localhost", server_args.grpc_port) as client:
+            return client.health_check()
+
+    try:
+        wait_until(http_healthy, timeout=300)
+        wait_until(grpc_healthy, timeout=60)
+        yield server_args
+    finally:
+
+        def cancel_all() -> None:
+            for task in asyncio.all_tasks(loop):
+                task.cancel()
+
+        loop.call_soon_threadsafe(cancel_all)
+        thread.join(timeout=60)
+        if not loop.is_closed():
+            loop.close()
+
+
+@pytest.fixture
+def grpc_client(_servers):
+    from tests.utils import GrpcClient
+
+    with GrpcClient("localhost", _servers.grpc_port) as client:
+        yield client
+
+
+@pytest.fixture
+def http_base_url(_servers) -> str:
+    return f"http://localhost:{_servers.port}"

@@ -65,6 +65,12 @@ def build_tokenizer(path: str, vocab_size: int = 512):
         eos_token="</s>",
         pad_token="</s>",
     )
+    # pad the vocab to exactly vocab_size so every model id round-trips
+    # through convert_ids_to_tokens (BPE training on the tiny corpus stops
+    # short of the requested size)
+    n_missing = vocab_size - len(fast)
+    if n_missing > 0:
+        fast.add_tokens([f"<filler_{i}>" for i in range(n_missing)])
     fast.save_pretrained(path)
     return fast
 

@@ -1,0 +1,298 @@
+"""Full-stack gRPC integration tests against the real dual-server stack.
+
+Mirrors the reference's integration coverage (tests/test_grpc_server.py):
+generation, tokenization, streaming framing (N tokens → N+1 messages),
+batching, validation errors, model info, token detail options, stop
+sequences, and time limits — all through real RPCs against a real engine
+running the tiny fixture model on the JAX CPU backend.
+"""
+
+from __future__ import annotations
+
+import grpc
+import pytest
+
+from vllm_tgis_adapter_tpu.grpc.pb import generation_pb2 as pb2
+
+
+def test_generation_request(grpc_client):
+    response = grpc_client.make_request("The answer to life the universe")
+    assert response.text
+    assert response.generated_token_count == 10
+    assert response.stop_reason == pb2.StopReason.MAX_TOKENS
+    assert response.input_token_count > 0
+
+
+def test_generation_request_stop_reason_eos_or_max(grpc_client):
+    params = pb2.Parameters(
+        method=pb2.DecodingMethod.GREEDY,
+        stopping=pb2.StoppingCriteria(max_new_tokens=64),
+    )
+    response = grpc_client.make_request("this is a test", params=params)
+    assert response.stop_reason in (
+        pb2.StopReason.MAX_TOKENS,
+        pb2.StopReason.EOS_TOKEN,
+    )
+
+
+def test_batched_generation_request(grpc_client):
+    responses = grpc_client.make_request(
+        ["The answer to life", "Medicine is", "The capital of France is"]
+    )
+    assert len(responses) == 3
+    for response in responses:
+        assert response.generated_token_count == 10
+        assert response.input_token_count > 0
+
+
+def test_generation_request_stream(grpc_client):
+    streaming_response = grpc_client.make_request_stream(
+        "The answer to life the universe",
+        max_new_tokens=10,
+    )
+    # input-details frame + one frame per generated token
+    assert len(streaming_response) == 11
+    first = streaming_response[0]
+    assert first.input_token_count > 0
+    assert first.generated_token_count == 0
+    text = "".join(r.text for r in streaming_response)
+    assert text
+    assert streaming_response[-1].stop_reason == pb2.StopReason.MAX_TOKENS
+    total_tokens = streaming_response[-1].generated_token_count
+    assert total_tokens == 10
+
+
+def test_stream_matches_unary(grpc_client):
+    prompt = "The weather today is"
+    unary = grpc_client.make_request(prompt, max_new_tokens=12)
+    stream = grpc_client.make_request_stream(prompt, max_new_tokens=12)
+    assert "".join(r.text for r in stream) == unary.text
+
+
+def test_tokenize_request(grpc_client):
+    response = grpc_client.make_request_tokenize("The answer to life")
+    assert response.token_count > 0
+    assert not response.tokens
+
+
+def test_tokenize_with_tokens_and_offsets(grpc_client):
+    response = grpc_client.make_request_tokenize(
+        "Hello world, how are you?", return_tokens=True, return_offsets=True
+    )
+    assert response.token_count > 0
+    assert len(response.tokens) == response.token_count
+    assert len(response.offsets) == response.token_count
+
+
+def test_tokenize_truncation(grpc_client):
+    full = grpc_client.make_request_tokenize("one two three four five six seven")
+    truncated = grpc_client.make_request_tokenize(
+        "one two three four five six seven",
+        return_tokens=True,
+        truncate_input_tokens=3,
+    )
+    assert full.token_count > 3
+    assert truncated.token_count == 3
+    assert len(truncated.tokens) == 3
+
+
+def test_model_info(grpc_client):
+    info = grpc_client.model_info()
+    assert info.model_kind == pb2.ModelInfoResponse.ModelKind.DECODER_ONLY
+    assert info.max_sequence_length == 512
+    assert info.max_new_tokens == 1024
+
+
+def test_generation_with_token_details(grpc_client):
+    params = pb2.Parameters(
+        method=pb2.DecodingMethod.GREEDY,
+        stopping=pb2.StoppingCriteria(max_new_tokens=5),
+        response=pb2.ResponseOptions(
+            generated_tokens=True,
+            token_logprobs=True,
+            token_ranks=True,
+            top_n_tokens=2,
+        ),
+    )
+    response = grpc_client.make_request("The answer to life", params=params)
+    assert len(response.tokens) == 5
+    for token in response.tokens:
+        assert token.text
+        assert token.logprob <= 0.0
+        assert token.rank >= 1
+        assert len(token.top_tokens) == 2
+
+
+def test_generation_with_input_tokens(grpc_client):
+    params = pb2.Parameters(
+        method=pb2.DecodingMethod.GREEDY,
+        stopping=pb2.StoppingCriteria(max_new_tokens=5),
+        response=pb2.ResponseOptions(
+            input_tokens=True,
+            generated_tokens=True,
+            token_logprobs=True,
+        ),
+    )
+    response = grpc_client.make_request("The answer to life", params=params)
+    assert len(response.input_tokens) == response.input_token_count
+    # first prompt token has no logprob entry
+    assert response.input_tokens[0].logprob == 0.0
+
+
+def test_generation_with_stop_sequence(grpc_client):
+    params = pb2.Parameters(
+        method=pb2.DecodingMethod.GREEDY,
+        stopping=pb2.StoppingCriteria(
+            max_new_tokens=64,
+            stop_sequences=["e"],
+        ),
+    )
+    response = grpc_client.make_request("The answer to life", params=params)
+    if response.stop_reason == pb2.StopReason.STOP_SEQUENCE:
+        assert response.stop_sequence == "e"
+        # server default is --default-include-stop-seqs=true
+        assert response.text.endswith("e")
+
+
+def test_generation_with_stop_sequence_excluded(grpc_client):
+    params = pb2.Parameters(
+        method=pb2.DecodingMethod.GREEDY,
+        stopping=pb2.StoppingCriteria(
+            max_new_tokens=64,
+            stop_sequences=["e"],
+            include_stop_sequence=False,
+        ),
+    )
+    response = grpc_client.make_request("The answer to life", params=params)
+    if response.stop_reason == pb2.StopReason.STOP_SEQUENCE:
+        assert "e" not in response.text
+
+
+def test_generation_seeded_sampling_reproducible(grpc_client):
+    params = pb2.Parameters(
+        method=pb2.DecodingMethod.SAMPLE,
+        sampling=pb2.SamplingParameters(temperature=0.9, seed=42),
+        stopping=pb2.StoppingCriteria(max_new_tokens=8),
+    )
+    r1 = grpc_client.make_request("Once upon a time", params=params)
+    r2 = grpc_client.make_request("Once upon a time", params=params)
+    assert r1.text == r2.text
+    assert r1.seed == 42
+
+
+def test_generation_input_text_echo(grpc_client):
+    params = pb2.Parameters(
+        method=pb2.DecodingMethod.GREEDY,
+        stopping=pb2.StoppingCriteria(max_new_tokens=4),
+        response=pb2.ResponseOptions(input_text=True),
+    )
+    prompt = "The answer to life"
+    response = grpc_client.make_request(prompt, params=params)
+    assert response.text.startswith(prompt)
+
+
+def test_time_limit(grpc_client):
+    params = pb2.Parameters(
+        method=pb2.DecodingMethod.GREEDY,
+        stopping=pb2.StoppingCriteria(
+            max_new_tokens=1024, time_limit_millis=300
+        ),
+    )
+    response = grpc_client.make_request("Count to one thousand:", params=params)
+    assert response.stop_reason in (
+        pb2.StopReason.TIME_LIMIT,
+        # fast machines may legitimately finish first
+        pb2.StopReason.EOS_TOKEN,
+        pb2.StopReason.MAX_TOKENS,
+    )
+
+
+@pytest.mark.parametrize(
+    ("params", "error_fragment"),
+    [
+        (
+            pb2.Parameters(
+                response=pb2.ResponseOptions(
+                    generated_tokens=True, top_n_tokens=11
+                )
+            ),
+            "top_n_tokens",
+        ),
+        (
+            pb2.Parameters(
+                stopping=pb2.StoppingCriteria(max_new_tokens=2048)
+            ),
+            "max_new_tokens must be <= 1024",
+        ),
+        (
+            pb2.Parameters(
+                stopping=pb2.StoppingCriteria(
+                    max_new_tokens=10, min_new_tokens=20
+                )
+            ),
+            "min_new_tokens must be <= max_new_tokens",
+        ),
+        (
+            pb2.Parameters(
+                stopping=pb2.StoppingCriteria(
+                    stop_sequences=["a"] * 7, max_new_tokens=10
+                )
+            ),
+            "stop sequences",
+        ),
+        (
+            pb2.Parameters(
+                method=pb2.DecodingMethod.SAMPLE,
+                sampling=pb2.SamplingParameters(top_p=1.5),
+                stopping=pb2.StoppingCriteria(max_new_tokens=10),
+            ),
+            "top_p",
+        ),
+        (
+            pb2.Parameters(
+                response=pb2.ResponseOptions(token_logprobs=True),
+                stopping=pb2.StoppingCriteria(max_new_tokens=10),
+            ),
+            "must request input and/or generated tokens",
+        ),
+    ],
+)
+def test_invalid_params_rejected(grpc_client, params, error_fragment):
+    with pytest.raises(grpc.RpcError) as excinfo:
+        grpc_client.make_request("test", params=params)
+    assert excinfo.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+    assert error_fragment in excinfo.value.details()
+
+
+def test_input_too_long_rejected(grpc_client):
+    with pytest.raises(grpc.RpcError) as excinfo:
+        grpc_client.make_request("word " * 600, max_new_tokens=5)
+    assert excinfo.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+    assert "input tokens" in excinfo.value.details()
+
+
+def test_truncate_input_tokens(grpc_client):
+    params = pb2.Parameters(
+        method=pb2.DecodingMethod.GREEDY,
+        stopping=pb2.StoppingCriteria(max_new_tokens=5),
+        truncate_input_tokens=3,
+    )
+    response = grpc_client.make_request("word " * 600, params=params)
+    assert response.input_token_count <= 3
+
+
+def test_request_id_from_correlation_id_header(grpc_client):
+    response = grpc_client.make_request(
+        "The answer to life",
+        metadata=[("x-correlation-id", "test-correlation-id")],
+    )
+    assert response.text
+
+
+def test_unknown_adapter_rejected(grpc_client):
+    with pytest.raises(grpc.RpcError) as excinfo:
+        grpc_client.make_request(
+            "test", adapter_id="this-adapter-does-not-exist"
+        )
+    assert excinfo.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+    assert "can't retrieve adapter" in excinfo.value.details()

@@ -1,0 +1,154 @@
+"""Full-stack HTTP integration tests (reference: tests/test_http_server.py)."""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+
+def _get(url: str, timeout: float = 30):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, resp.read()
+
+
+def _post_json(url: str, payload: dict, timeout: float = 60):
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, resp.read()
+
+
+def test_startup(http_base_url):
+    status, _ = _get(f"{http_base_url}/health")
+    assert status == 200
+
+
+def test_models(http_base_url, server_args):
+    status, body = _get(f"{http_base_url}/v1/models")
+    assert status == 200
+    payload = json.loads(body)
+    assert payload["object"] == "list"
+    ids = [m["id"] for m in payload["data"]]
+    assert server_args.model in ids
+
+
+def test_completions(http_base_url, server_args):
+    status, body = _post_json(
+        f"{http_base_url}/v1/completions",
+        {
+            "model": server_args.model,
+            "prompt": "The answer to life the universe",
+            "max_tokens": 10,
+            "temperature": 0.0,
+        },
+    )
+    assert status == 200
+    payload = json.loads(body)
+    assert payload["object"] == "text_completion"
+    assert len(payload["choices"]) == 1
+    assert payload["choices"][0]["text"]
+    assert payload["usage"]["completion_tokens"] == 10
+
+
+def test_completions_batch_prompts(http_base_url, server_args):
+    status, body = _post_json(
+        f"{http_base_url}/v1/completions",
+        {
+            "model": server_args.model,
+            "prompt": ["Hello", "Goodbye"],
+            "max_tokens": 4,
+            "temperature": 0.0,
+        },
+    )
+    assert status == 200
+    payload = json.loads(body)
+    assert len(payload["choices"]) == 2
+    assert {c["index"] for c in payload["choices"]} == {0, 1}
+
+
+def test_completions_stream(http_base_url, server_args):
+    req = urllib.request.Request(
+        f"{http_base_url}/v1/completions",
+        data=json.dumps(
+            {
+                "model": server_args.model,
+                "prompt": "The answer",
+                "max_tokens": 5,
+                "temperature": 0.0,
+                "stream": True,
+            }
+        ).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        assert resp.status == 200
+        assert resp.headers["content-type"].startswith("text/event-stream")
+        raw = resp.read().decode()
+    events = [
+        line[len("data: ") :]
+        for line in raw.splitlines()
+        if line.startswith("data: ")
+    ]
+    assert events[-1] == "[DONE]"
+    chunks = [json.loads(e) for e in events[:-1]]
+    assert len(chunks) == 5
+    assert all(c["object"] == "text_completion" for c in chunks)
+    assert chunks[-1]["choices"][0]["finish_reason"] == "length"
+
+
+def test_completions_unknown_model(http_base_url):
+    with pytest.raises(urllib.error.HTTPError) as excinfo:
+        _post_json(
+            f"{http_base_url}/v1/completions",
+            {"model": "does-not-exist", "prompt": "hi", "max_tokens": 2},
+        )
+    assert excinfo.value.code == 404
+
+
+def test_completions_invalid_params(http_base_url, server_args):
+    with pytest.raises(urllib.error.HTTPError) as excinfo:
+        _post_json(
+            f"{http_base_url}/v1/completions",
+            {
+                "model": server_args.model,
+                "prompt": "hi",
+                "max_tokens": 2,
+                "temperature": -1.0,
+            },
+        )
+    assert excinfo.value.code == 400
+
+
+def test_metrics(http_base_url, server_args):
+    # generate something first so counters are non-trivial
+    _post_json(
+        f"{http_base_url}/v1/completions",
+        {"model": server_args.model, "prompt": "hi", "max_tokens": 2},
+    )
+    status, body = _get(f"{http_base_url}/metrics")
+    assert status == 200
+    text = body.decode()
+    assert "tgis_tpu_generated_tokens_total" in text
+
+
+def test_correlation_id_header_roundtrip(http_base_url):
+    req = urllib.request.Request(
+        f"{http_base_url}/health",
+        headers={"X-Correlation-ID": "abc-123"},
+    )
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        assert resp.headers.get("x-correlation-id") == "abc-123"
+
+
+def test_version(http_base_url):
+    status, body = _get(f"{http_base_url}/version")
+    assert status == 200
+    assert "version" in json.loads(body)

@@ -1,0 +1,56 @@
+"""Failure-path integration: bad boot configs must write the termination log.
+
+Reference behavior (tests/test_termination_log.py + utils.py:20-41): a boot
+failure raises out of start_servers and the first cause is recorded where
+Kubernetes probes read it, honoring the TERMINATION_LOG_DIR override.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from tests.conftest import _build_args
+
+
+def _boot(args) -> None:
+    from vllm_tgis_adapter_tpu.__main__ import (
+        run_and_catch_termination_cause,
+        start_servers,
+    )
+
+    loop = asyncio.new_event_loop()
+    try:
+        task = loop.create_task(start_servers(args))
+        run_and_catch_termination_cause(loop, task)
+    finally:
+        loop.close()
+
+
+def test_unsupported_model_writes_termination_log(tmp_path, monkeypatch):
+    termination_log = tmp_path / "termination-log"
+    termination_log.touch()
+    monkeypatch.setenv("TERMINATION_LOG_DIR", str(termination_log))
+
+    args = _build_args(
+        ["--model", str(tmp_path / "not-a-model"), "--port", "0",
+         "--grpc-port", "0"]
+    )
+    with pytest.raises(ValueError, match="config.json"):
+        _boot(args)
+
+    contents = termination_log.read_text()
+    assert "config.json" in contents
+
+
+def test_no_termination_log_file_is_fine(tmp_path, monkeypatch):
+    monkeypatch.setenv(
+        "TERMINATION_LOG_DIR", str(tmp_path / "does-not-exist")
+    )
+    args = _build_args(
+        ["--model", str(tmp_path / "not-a-model"), "--port", "0",
+         "--grpc-port", "0"]
+    )
+    with pytest.raises(ValueError, match="config.json"):
+        _boot(args)

@@ -1,0 +1,124 @@
+"""Pure unit tests for the TGIS validation table (grpc/validation.py)."""
+
+from __future__ import annotations
+
+import pytest
+
+from vllm_tgis_adapter_tpu.engine.sampling_params import SamplingParams
+from vllm_tgis_adapter_tpu.grpc.pb import generation_pb2 as pb2
+from vllm_tgis_adapter_tpu.grpc.validation import (
+    MAX_STOP_SEQS,
+    TGISValidationError,
+    validate_input,
+    validate_params,
+)
+
+MAX_NEW_TOKENS = 1024
+
+
+def test_defaults_valid():
+    validate_params(pb2.Parameters(), MAX_NEW_TOKENS)
+
+
+def test_error_messages_are_wire_contract():
+    # spot-check strings clients depend on
+    assert TGISValidationError.TopK.value == "top_k must be strictly positive"
+    assert (
+        TGISValidationError.MaxNewTokens.value == "max_new_tokens must be <= {0}"
+    )
+
+
+@pytest.mark.parametrize(
+    "params",
+    [
+        pb2.Parameters(stopping=pb2.StoppingCriteria(max_new_tokens=1025)),
+        pb2.Parameters(
+            stopping=pb2.StoppingCriteria(max_new_tokens=5, min_new_tokens=6)
+        ),
+        pb2.Parameters(stopping=pb2.StoppingCriteria(min_new_tokens=1025)),
+        pb2.Parameters(
+            stopping=pb2.StoppingCriteria(
+                stop_sequences=["x"] * (MAX_STOP_SEQS + 1)
+            )
+        ),
+        pb2.Parameters(stopping=pb2.StoppingCriteria(stop_sequences=[""])),
+        pb2.Parameters(
+            stopping=pb2.StoppingCriteria(stop_sequences=["y" * 241])
+        ),
+        pb2.Parameters(
+            response=pb2.ResponseOptions(generated_tokens=True, top_n_tokens=11)
+        ),
+        pb2.Parameters(response=pb2.ResponseOptions(token_logprobs=True)),
+        pb2.Parameters(response=pb2.ResponseOptions(token_ranks=True)),
+        pb2.Parameters(
+            response=pb2.ResponseOptions(top_n_tokens=2),
+        ),
+        pb2.Parameters(sampling=pb2.SamplingParameters(top_p=1.5)),
+        pb2.Parameters(sampling=pb2.SamplingParameters(typical_p=1.5)),
+        pb2.Parameters(
+            decoding=pb2.DecodingParameters(repetition_penalty=2.5)
+        ),
+        pb2.Parameters(
+            decoding=pb2.DecodingParameters(
+                length_penalty=pb2.DecodingParameters.LengthPenalty(
+                    start_index=0, decay_factor=0.5
+                )
+            )
+        ),
+        pb2.Parameters(
+            decoding=pb2.DecodingParameters(
+                length_penalty=pb2.DecodingParameters.LengthPenalty(
+                    start_index=0, decay_factor=11.0
+                )
+            )
+        ),
+    ],
+)
+def test_invalid_params(params):
+    with pytest.raises(ValueError):
+        validate_params(params, MAX_NEW_TOKENS)
+
+
+@pytest.mark.parametrize(
+    "params",
+    [
+        pb2.Parameters(
+            stopping=pb2.StoppingCriteria(stop_sequences=["a"] * MAX_STOP_SEQS)
+        ),
+        pb2.Parameters(
+            response=pb2.ResponseOptions(generated_tokens=True, top_n_tokens=10)
+        ),
+        pb2.Parameters(
+            response=pb2.ResponseOptions(input_tokens=True, token_ranks=True)
+        ),
+        pb2.Parameters(sampling=pb2.SamplingParameters(top_p=1.0)),
+        pb2.Parameters(
+            decoding=pb2.DecodingParameters(
+                repetition_penalty=1.2,
+                length_penalty=pb2.DecodingParameters.LengthPenalty(
+                    start_index=4, decay_factor=1.5
+                ),
+            )
+        ),
+    ],
+)
+def test_valid_params(params):
+    validate_params(params, MAX_NEW_TOKENS)
+
+
+def test_validate_input_too_long():
+    with pytest.raises(ValueError, match="input tokens"):
+        validate_input(SamplingParams(), token_num=512, max_model_len=512)
+
+
+def test_validate_input_min_tokens_overflow():
+    with pytest.raises(ValueError, match="min_new_tokens"):
+        validate_input(
+            SamplingParams(min_tokens=100, max_tokens=100),
+            token_num=450,
+            max_model_len=512,
+        )
+
+
+def test_validate_input_ok():
+    validate_input(SamplingParams(), token_num=100, max_model_len=512)

@@ -1,0 +1,187 @@
+"""Test client library (analog of the reference's tests/utils.py).
+
+Reusable synchronous ``GrpcClient`` over the hand-written service stubs,
+a ``wait_until`` poller, and random free-port allocation.
+"""
+
+from __future__ import annotations
+
+import socket
+import time
+from typing import Callable, Optional
+
+import grpc
+
+from vllm_tgis_adapter_tpu.grpc.pb import generation_pb2 as pb2
+from vllm_tgis_adapter_tpu.grpc.pb.rpc import GenerationServiceStub
+
+
+def get_random_port() -> int:
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def wait_until(
+    pred: Callable[[], bool],
+    timeout: float = 120.0,
+    pause: float = 0.5,
+) -> None:
+    start = time.monotonic()
+    exc = None
+    while (time.monotonic() - start) < timeout:
+        try:
+            if pred():
+                return
+            exc = None
+        except Exception as e:  # noqa: BLE001
+            exc = e
+        time.sleep(pause)
+    raise TimeoutError(f"timed out waiting for {pred}: last error: {exc}")
+
+
+class GrpcClient:
+    """Synchronous client for the fmaas.GenerationService API."""
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        *,
+        insecure: bool = True,
+        ca_cert: Optional[bytes] = None,
+        client_cert: Optional[bytes] = None,
+        client_key: Optional[bytes] = None,
+    ):
+        target = f"{host}:{port}"
+        if insecure:
+            self.channel = grpc.insecure_channel(target)
+        else:
+            credentials = grpc.ssl_channel_credentials(
+                root_certificates=ca_cert,
+                private_key=client_key,
+                certificate_chain=client_cert,
+            )
+            self.channel = grpc.secure_channel(target, credentials)
+        self.stub = GenerationServiceStub(self.channel)
+
+    def __enter__(self) -> "GrpcClient":
+        return self
+
+    def __exit__(self, *exc_info) -> None:  # noqa: ANN002
+        self.channel.close()
+
+    # ------------------------------------------------------------------ RPCs
+
+    def make_request(
+        self,
+        text: str | list[str],
+        model_id: str = "",
+        *,
+        adapter_id: Optional[str] = None,
+        max_new_tokens: Optional[int] = None,
+        sampling: bool = False,
+        seed: Optional[int] = None,
+        metadata: Optional[list[tuple[str, str]]] = None,
+        params: Optional[pb2.Parameters] = None,
+        timeout: float = 60,
+    ):
+        texts = [text] if isinstance(text, str) else text
+        if params is None:
+            params = pb2.Parameters(
+                method=(
+                    pb2.DecodingMethod.SAMPLE
+                    if sampling
+                    else pb2.DecodingMethod.GREEDY
+                ),
+                stopping=pb2.StoppingCriteria(
+                    max_new_tokens=max_new_tokens or 10
+                ),
+            )
+            if seed is not None:
+                params.sampling.seed = seed
+        request = pb2.BatchedGenerationRequest(
+            model_id=model_id,
+            requests=[pb2.GenerationRequest(text=t) for t in texts],
+            params=params,
+        )
+        if adapter_id is not None:
+            request.adapter_id = adapter_id
+        response = self.stub.Generate(
+            request, metadata=metadata or [], timeout=timeout
+        )
+        if isinstance(text, str):
+            return response.responses[0]
+        return list(response.responses)
+
+    def make_request_stream(
+        self,
+        text: str,
+        model_id: str = "",
+        *,
+        adapter_id: Optional[str] = None,
+        max_new_tokens: Optional[int] = None,
+        params: Optional[pb2.Parameters] = None,
+        metadata: Optional[list[tuple[str, str]]] = None,
+        timeout: float = 60,
+    ):
+        if params is None:
+            params = pb2.Parameters(
+                stopping=pb2.StoppingCriteria(max_new_tokens=max_new_tokens or 10)
+            )
+        request = pb2.SingleGenerationRequest(
+            model_id=model_id,
+            request=pb2.GenerationRequest(text=text),
+            params=params,
+        )
+        if adapter_id is not None:
+            request.adapter_id = adapter_id
+        return list(
+            self.stub.GenerateStream(
+                request, metadata=metadata or [], timeout=timeout
+            )
+        )
+
+    def make_request_tokenize(
+        self,
+        text: str | list[str],
+        model_id: str = "",
+        *,
+        adapter_id: Optional[str] = None,
+        return_tokens: bool = False,
+        return_offsets: bool = False,
+        truncate_input_tokens: int = 0,
+        timeout: float = 60,
+    ):
+        texts = [text] if isinstance(text, str) else text
+        request = pb2.BatchedTokenizeRequest(
+            model_id=model_id,
+            requests=[pb2.TokenizeRequest(text=t) for t in texts],
+            return_tokens=return_tokens,
+            return_offsets=return_offsets,
+            truncate_input_tokens=truncate_input_tokens,
+        )
+        if adapter_id is not None:
+            request.adapter_id = adapter_id
+        response = self.stub.Tokenize(request, timeout=timeout)
+        if isinstance(text, str):
+            return response.responses[0]
+        return list(response.responses)
+
+    def model_info(self, model_id: str = "", timeout: float = 60):
+        return self.stub.ModelInfo(
+            pb2.ModelInfoRequest(model_id=model_id), timeout=timeout
+        )
+
+    def health_check(self, timeout: float = 5) -> bool:
+        from vllm_tgis_adapter_tpu.grpc.health import HealthStub
+        from vllm_tgis_adapter_tpu.grpc.pb.health_pb2 import (
+            HealthCheckRequest,
+            HealthCheckResponse,
+        )
+
+        response = HealthStub(self.channel).Check(
+            HealthCheckRequest(service="fmaas.GenerationService"),
+            timeout=timeout,
+        )
+        return response.status == HealthCheckResponse.SERVING

@@ -1,0 +1,141 @@
+"""Process entrypoint: boot the engine, run gRPC + HTTP servers together.
+
+Same lifecycle contract as the reference (__main__.py:38-131): bind the
+HTTP socket before engine boot, build ONE shared engine, wrap it with the
+TGIS logging hooks, launch both servers as tasks, cancel the survivor when
+either exits, re-raise the first failure, and record the cause of death in
+the Kubernetes termination log.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import socket
+import traceback
+from typing import TYPE_CHECKING
+
+from vllm_tgis_adapter_tpu.engine.async_llm import AsyncLLMEngine
+from vllm_tgis_adapter_tpu.grpc.grpc_server import run_grpc_server
+from vllm_tgis_adapter_tpu.http import build_http_server, run_http_server
+from vllm_tgis_adapter_tpu.logging import init_logger
+from vllm_tgis_adapter_tpu.tgis_utils import logs
+from vllm_tgis_adapter_tpu.tgis_utils.args import (
+    make_parser,
+    postprocess_tgis_args,
+)
+from vllm_tgis_adapter_tpu.utils import (
+    check_for_failed_tasks,
+    write_termination_log,
+)
+
+if TYPE_CHECKING:
+    import argparse
+
+logger = init_logger(__name__)
+
+
+class TaskFailedError(RuntimeError):
+    pass
+
+
+def create_server_socket(host: str | None, port: int) -> socket.socket:
+    """Bind the HTTP port before the (slow) engine boot so probes can't
+    race a half-started process (reference workaround, __main__.py:41-45)."""
+    family = socket.AF_INET6 if host and ":" in host else socket.AF_INET
+    sock = socket.socket(family=family, type=socket.SOCK_STREAM)
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    sock.bind((host or "", port))
+    return sock
+
+
+async def start_servers(args: "argparse.Namespace") -> None:
+    sock = create_server_socket(args.host, args.port)
+
+    engine = None
+    tasks: list[asyncio.Task] = []
+    try:
+        from vllm_tgis_adapter_tpu.engine.config import EngineConfig
+
+        engine = AsyncLLMEngine.from_config(EngineConfig.from_args(args))
+        await engine.start()
+
+        # uniform TGIS-style request logging for both servers
+        logs.add_logging_wrappers(engine)
+
+        http_app = build_http_server(args, engine)
+
+        loop = asyncio.get_running_loop()
+        tasks = [
+            loop.create_task(
+                run_http_server(args, engine, http_app, sock),
+                name="http_server",
+            ),
+            loop.create_task(
+                run_grpc_server(args, engine),
+                name="grpc_server",
+            ),
+        ]
+
+        with_task_names = ", ".join(t.get_name() for t in tasks)
+        logger.info("Started tasks: %s", with_task_names)
+
+        done, _pending = await asyncio.wait(
+            tasks, return_when=asyncio.FIRST_COMPLETED
+        )
+
+        if engine.errored:
+            # surface the engine failure rather than a generic task error
+            raise engine.dead_error
+
+        for task in done:
+            if (exception := task.exception()) is not None:
+                raise TaskFailedError(
+                    f"task {task.get_name()} failed"
+                ) from exception
+    finally:
+        for task in tasks:
+            if not task.done():
+                task.cancel()
+        await asyncio.gather(*tasks, return_exceptions=True)
+        if engine is not None:
+            await engine.stop()
+        sock.close()
+
+    failed = check_for_failed_tasks(tasks)
+    if failed is not None:
+        raise TaskFailedError(f"task {failed.get_name()} failed") from (
+            failed.exception()
+        )
+
+
+def run_and_catch_termination_cause(
+    loop: asyncio.AbstractEventLoop, task: asyncio.Task
+) -> None:
+    try:
+        loop.run_until_complete(task)
+    except BaseException:
+        # report the first exception as the cause of termination
+        msg = traceback.format_exc()
+        write_termination_log(
+            msg, os.getenv("TERMINATION_LOG_DIR", "/dev/termination-log")
+        )
+        raise
+
+
+def main() -> None:
+    parser = make_parser()
+    args = postprocess_tgis_args(parser.parse_args())
+    if not args.model:
+        parser.error("--model (or --model-name / MODEL_NAME env) is required")
+
+    loop = asyncio.new_event_loop()
+    try:
+        task = loop.create_task(start_servers(args))
+        run_and_catch_termination_cause(loop, task)
+    finally:
+        loop.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,149 @@
+"""LoRA adapter loading and registry.
+
+TPU-native analog of the LoRA surface the reference adapter consumes from
+vLLM (`OpenAIServingModels.load_lora_adapter` + its ``lora_requests`` cache,
+reference: grpc/adapters.py:141-180).  Weights are loaded from PEFT-style
+checkpoints (adapter_config.json + adapter_model.safetensors) into
+host-pinned arrays; the model runner applies them as batched A·B matmul
+deltas on the attention/MLP projections (see models/llama.py), padded to
+``max_lora_rank`` so one compiled program serves every adapter.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+from vllm_tgis_adapter_tpu.logging import init_logger
+
+logger = init_logger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class LoRARequest:
+    """Per-request adapter handle passed into ``engine.generate``."""
+
+    lora_name: str
+    lora_int_id: int
+    lora_path: str
+
+    @property
+    def name(self) -> str:
+        return self.lora_name
+
+    @property
+    def adapter_id(self) -> str:
+        return self.lora_name
+
+
+@dataclasses.dataclass
+class LoRAAdapterWeights:
+    """Host-side weights of one loaded adapter.
+
+    ``a``/``b`` map target-module keys (e.g. ``layers.0.q_proj``) to the
+    LoRA down/up projection matrices; ``scaling = alpha / r``.
+    """
+
+    rank: int
+    scaling: float
+    target_modules: tuple[str, ...]
+    a: dict[str, np.ndarray]
+    b: dict[str, np.ndarray]
+
+
+class LoRAError(ValueError):
+    pass
+
+
+def load_peft_adapter(path: str) -> LoRAAdapterWeights:
+    """Read a PEFT LoRA checkpoint directory into host arrays."""
+    adapter_dir = Path(path)
+    config_file = adapter_dir / "adapter_config.json"
+    if not config_file.exists():
+        raise LoRAError(f"no adapter_config.json in {path!r}")
+    with open(config_file) as f:
+        config = json.load(f)
+    peft_type = config.get("peft_type")
+    if peft_type != "LORA":
+        raise LoRAError(f"unsupported peft type {peft_type!r}")
+
+    rank = int(config.get("r", 8))
+    alpha = float(config.get("lora_alpha", rank))
+    target_modules = tuple(config.get("target_modules", ()))
+
+    weights_file = adapter_dir / "adapter_model.safetensors"
+    a: dict[str, np.ndarray] = {}
+    b: dict[str, np.ndarray] = {}
+    if weights_file.exists():
+        from safetensors.numpy import load_file
+
+        for key, value in load_file(str(weights_file)).items():
+            # PEFT keys look like:
+            # base_model.model.model.layers.0.self_attn.q_proj.lora_A.weight
+            if "lora_A" in key:
+                a[_normalize_key(key)] = value.astype(np.float32)
+            elif "lora_B" in key:
+                b[_normalize_key(key)] = value.astype(np.float32)
+    else:
+        # Some fixture adapters ship config-only (dummy weights); register
+        # them with empty deltas so request routing still works end-to-end.
+        logger.warning("adapter %s has no adapter_model.safetensors", path)
+
+    return LoRAAdapterWeights(
+        rank=rank,
+        scaling=alpha / max(rank, 1),
+        target_modules=target_modules,
+        a=a,
+        b=b,
+    )
+
+
+def _normalize_key(key: str) -> str:
+    """``base_model.model.model.layers.N.self_attn.q_proj.lora_A.weight``
+    → ``layers.N.q_proj``."""
+    parts = key.split(".")
+    try:
+        i = parts.index("layers")
+        layer = parts[i + 1]
+    except (ValueError, IndexError):
+        layer = "?"
+    module = parts[-3] if len(parts) >= 3 else key
+    return f"layers.{layer}.{module}"
+
+
+class LoRAManager:
+    """Registry of hot-loaded adapters, shaped like the serving-models
+    handler the reference adapter store talks to."""
+
+    def __init__(self, max_loras: int = 4):
+        self.max_loras = max_loras
+        self.lora_requests: dict[str, LoRARequest] = {}
+        self._weights: dict[str, LoRAAdapterWeights] = {}
+        self._next_id = 1
+
+    async def load_lora_adapter(self, lora_name: str, lora_path: str) -> LoRARequest:
+        """Load (or return the cached) adapter; raises LoRAError on bad input."""
+        if (existing := self.lora_requests.get(lora_name)) is not None:
+            return existing
+        import asyncio
+
+        weights = await asyncio.to_thread(load_peft_adapter, lora_path)
+        if len(self.lora_requests) >= self.max_loras:
+            evict = next(iter(self.lora_requests))
+            logger.info("evicting LoRA adapter %s", evict)
+            self.lora_requests.pop(evict, None)
+            self._weights.pop(evict, None)
+        request = LoRARequest(
+            lora_name=lora_name, lora_int_id=self._next_id, lora_path=lora_path
+        )
+        self._next_id += 1
+        self.lora_requests[lora_name] = request
+        self._weights[lora_name] = weights
+        return request
+
+    def get_weights(self, lora_name: str) -> Optional[LoRAAdapterWeights]:
+        return self._weights.get(lora_name)

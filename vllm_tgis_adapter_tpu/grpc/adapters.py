@@ -1,0 +1,172 @@
+"""Request-level adapter (LoRA / PEFT prefix) resolution.
+
+Maps the ``adapter_id`` (or legacy ``prefix_id``) on incoming TGIS requests
+to an engine ``lora_request`` kwarg, with the same semantics as the
+reference (grpc/adapters.py:63-226): per-adapter asyncio locks, off-thread
+filesystem reads, path-traversal rejection, caching through the model
+handler's ``lora_requests`` registry, and rejection of non-LORA peft types.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import dataclasses
+import json
+import re
+from pathlib import Path
+from typing import TYPE_CHECKING, Union
+
+from vllm_tgis_adapter_tpu.grpc.validation import TGISValidationError
+from vllm_tgis_adapter_tpu.logging import init_logger
+
+if TYPE_CHECKING:
+    from vllm_tgis_adapter_tpu.engine.lora import LoRAManager, LoRARequest
+    from vllm_tgis_adapter_tpu.grpc.pb.generation_pb2 import (
+        BatchedGenerationRequest,
+        BatchedTokenizeRequest,
+        SingleGenerationRequest,
+    )
+
+global_thread_pool = None  # lazily-created pool for adapter file reads
+
+VALID_ADAPTER_ID_PATTERN = re.compile("[/\\w\\-]+")
+
+logger = init_logger(__name__)
+
+AnyAdapterRequest = Union[
+    "SingleGenerationRequest",
+    "BatchedGenerationRequest",
+    "BatchedTokenizeRequest",
+]
+
+
+@dataclasses.dataclass
+class AdapterMetadata:
+    unique_id: int  # engine-facing integer id
+    adapter_type: str  # peft type string from adapter_config.json, e.g. LORA
+    full_path: str
+    full_config: dict
+
+
+@dataclasses.dataclass
+class AdapterStore:
+    cache_path: str  # directory adapter ids are resolved under
+    adapters: dict[str, AdapterMetadata]
+    # large base so ids can't collide with engine-internal adapter ids
+    next_unique_id: int = 1000001
+    load_locks: dict[str, asyncio.Lock] = dataclasses.field(default_factory=dict)
+
+
+async def validate_adapters(
+    request: AnyAdapterRequest,
+    adapter_store: AdapterStore | None,
+    lora_manager: "LoRAManager | None",
+) -> dict[str, "LoRARequest"]:
+    """Resolve the request's adapter id into engine.generate() kwargs.
+
+    Raises ValueError (TGIS contract messages) when the adapter is missing,
+    malformed, or of an unsupported type.
+    """
+    global global_thread_pool  # noqa: PLW0603
+    adapter_id = request.adapter_id
+    if not adapter_id and request.prefix_id:
+        adapter_id = request.prefix_id
+
+    if adapter_id and not adapter_store:
+        TGISValidationError.AdaptersDisabled.error()
+
+    if not adapter_id or not adapter_store:
+        return {}
+
+    # serialize loads of the same adapter
+    async with adapter_store.load_locks.setdefault(adapter_id, asyncio.Lock()):
+        if lora_manager is not None and (
+            existing := lora_manager.lora_requests.get(adapter_id)
+        ):
+            return {"lora_request": existing}
+
+        if (adapter_metadata := adapter_store.adapters.get(adapter_id)) is None:
+            _reject_bad_adapter_id(adapter_id)
+            local_adapter_path = str(Path(adapter_store.cache_path) / adapter_id)
+
+            loop = asyncio.get_running_loop()
+            if global_thread_pool is None:
+                global_thread_pool = concurrent.futures.ThreadPoolExecutor(
+                    max_workers=2
+                )
+
+            # unique-id increment stays in async land: no thread races
+            unique_id = adapter_store.next_unique_id
+            adapter_store.next_unique_id += 1
+
+            adapter_metadata = await loop.run_in_executor(
+                global_thread_pool,
+                _load_adapter_metadata,
+                adapter_id,
+                local_adapter_path,
+                unique_id,
+            )
+
+            if adapter_metadata.adapter_type == "LORA":
+                lora_request = await _load_lora_adapter(
+                    adapter_id, adapter_metadata, lora_manager
+                )
+                return {"lora_request": lora_request}
+            # cache non-LoRA metadata so repeat requests fail fast
+            adapter_store.adapters[adapter_id] = adapter_metadata
+
+    # all other adapter types unsupported
+    TGISValidationError.AdapterUnsupported.error(adapter_metadata.adapter_type)
+
+
+async def _load_lora_adapter(
+    adapter_id: str,
+    adapter_metadata: AdapterMetadata,
+    lora_manager: "LoRAManager | None",
+) -> "LoRARequest":
+    if lora_manager is None:
+        TGISValidationError.AdaptersDisabled.error()
+    try:
+        return await lora_manager.load_lora_adapter(
+            lora_name=adapter_id,
+            lora_path=adapter_metadata.full_path,
+        )
+    except ValueError as e:
+        TGISValidationError.AdapterNotFound.error(adapter_id, str(e))
+
+
+def _load_adapter_metadata(
+    adapter_id: str, adapter_path: str, unique_id: int
+) -> AdapterMetadata:
+    """Filesystem half of adapter validation; runs in the thread pool."""
+    if not Path(adapter_path).exists():
+        TGISValidationError.AdapterNotFound.error(
+            adapter_id, "directory does not exist"
+        )
+
+    adapter_config_path = Path(adapter_path) / "adapter_config.json"
+    if not Path(adapter_config_path).exists():
+        TGISValidationError.AdapterNotFound.error(
+            adapter_id, "invalid adapter: no adapter_config.json found"
+        )
+
+    with open(adapter_config_path) as adapter_config_file:
+        adapter_config = json.load(adapter_config_file)
+
+    return AdapterMetadata(
+        unique_id=unique_id,
+        adapter_type=adapter_config.get("peft_type", None),
+        full_path=adapter_path,
+        full_config=adapter_config,
+    )
+
+
+def _reject_bad_adapter_id(adapter_id: str) -> None:
+    """Reject ids with invalid characters or path traversal."""
+    if not VALID_ADAPTER_ID_PATTERN.fullmatch(adapter_id):
+        TGISValidationError.InvalidAdapterID.error(adapter_id)
+
+    cwd = Path().cwd()
+    if not Path(adapter_id).resolve().is_relative_to(cwd):
+        TGISValidationError.InvalidAdapterID.error(adapter_id)

@@ -1,0 +1,153 @@
+"""TGIS request validation.
+
+Error strings are part of the TGIS wire contract: clients match on them, so
+they are reproduced byte-for-byte from the reference enumeration
+(reference: grpc/validation.py:18-57, which itself mirrors the TGIS Rust
+router's validation table).  The checks run against the raw protobuf
+``Parameters`` BEFORE conversion to engine ``SamplingParams`` so that the
+error surface matches TGIS rather than our engine internals.
+"""
+
+from __future__ import annotations
+
+import typing
+from enum import Enum
+
+from vllm_tgis_adapter_tpu.grpc.pb.generation_pb2 import DecodingMethod
+
+if typing.TYPE_CHECKING:
+    from vllm_tgis_adapter_tpu.engine.sampling_params import SamplingParams
+    from vllm_tgis_adapter_tpu.grpc.pb.generation_pb2 import Parameters
+
+MAX_TOP_N_TOKENS = 10
+
+MAX_STOP_SEQS = 6
+MAX_STOP_SEQ_LENGTH = 240
+
+# Reject (True) vs. silently ignore (False) sampling parameters supplied in
+# greedy mode.  TGIS and the reference both ship with lenient behavior.
+STRICT_PARAMETER_VALIDATION = False
+
+
+class TGISValidationError(str, Enum):
+    """All TGIS parameter-validation failure messages (wire contract)."""
+
+    TopP = "top_p must be > 0.0 and <= 1.0"
+    TopK = "top_k must be strictly positive"
+    TypicalP = "typical_p must be <= 1.0"
+    RepetitionPenalty = "repetition_penalty must be > 0.0 and <= 2.0"
+    LengthPenalty = "length_penalty.decay_factor must be >= 1.0 and <= 10.0"
+    MaxNewTokens = "max_new_tokens must be <= {0}"
+    MinNewTokens = "min_new_tokens must be <= max_new_tokens"
+    InputLength = (
+        "input tokens ({0}) plus prefix length ({1}) plus "
+        "min_new_tokens ({2}) must be <= {3}"
+    )
+    InputLength2 = "input tokens ({0}) plus prefix length ({1}) must be < {2}"
+    Tokenizer = "tokenizer error {0}"
+    StopSequences = (
+        "can specify at most {0} non-empty stop sequences, each "
+        "not more than {1} UTF8 bytes"
+    )
+    TokenDetail = (
+        "must request input and/or generated tokens to request extra token detail"
+    )
+    PromptPrefix = "can't retrieve prompt prefix with id '{0}': {1}"
+    SampleParametersGreedy = (
+        "sampling parameters aren't applicable in greedy decoding mode"
+    )
+
+    # Additions beyond the TGIS table (same as the reference adapter's)
+    TopN = "top_n_tokens ({0}) must be <= {1}"
+    AdapterNotFound = "can't retrieve adapter with id '{0}': {1}"
+    AdaptersDisabled = "adapter_id supplied but no adapter store was configured"
+    AdapterUnsupported = "adapter type {0} is not currently supported"
+    InvalidAdapterID = (
+        "Invalid adapter id '{0}', must contain only alphanumeric, _ and - and /"
+    )
+
+    def error(self, *args: object, **kwargs: object) -> typing.NoReturn:
+        """Raise a ValueError with the formatted contract message."""
+        raise ValueError(self.value.format(*args, **kwargs))
+
+
+def validate_input(
+    sampling_params: "SamplingParams",
+    token_num: int,
+    max_model_len: int,
+) -> None:
+    """Reject prompts that cannot fit in the model context window."""
+    if token_num >= max_model_len:
+        TGISValidationError.InputLength2.error(token_num, 0, max_model_len)
+
+    if token_num + sampling_params.min_tokens > max_model_len:
+        TGISValidationError.InputLength.error(
+            token_num, 0, sampling_params.min_tokens, max_model_len
+        )
+
+
+def validate_params(  # noqa: C901
+    params: "Parameters",
+    max_max_new_tokens: int,
+) -> None:
+    """Raise ValueError (from TGISValidationError) if Parameters is invalid.
+
+    Check order matches the reference (decoding → stopping → response →
+    sampling) so identical requests fail with identical messages.
+    """
+    resp_options = params.response
+    sampling = params.sampling
+    stopping = params.stopping
+    decoding = params.decoding
+
+    if decoding.HasField("length_penalty") and not (
+        1.0 <= decoding.length_penalty.decay_factor <= 10.0
+    ):
+        TGISValidationError.LengthPenalty.error()
+
+    # 0 means unset/no penalty on the wire
+    if not (0 <= decoding.repetition_penalty <= 2):
+        TGISValidationError.RepetitionPenalty.error()
+
+    if stopping.max_new_tokens > max_max_new_tokens:
+        TGISValidationError.MaxNewTokens.error(max_max_new_tokens)
+
+    if stopping.min_new_tokens > (stopping.max_new_tokens or max_max_new_tokens):
+        TGISValidationError.MinNewTokens.error()
+
+    if (
+        stopping.stop_sequences and (len(stopping.stop_sequences) > MAX_STOP_SEQS)
+    ) or not all(
+        0 < len(ss.encode("utf-8")) <= MAX_STOP_SEQ_LENGTH
+        for ss in stopping.stop_sequences
+    ):
+        TGISValidationError.StopSequences.error(MAX_STOP_SEQS, MAX_STOP_SEQ_LENGTH)
+
+    if resp_options.top_n_tokens > MAX_TOP_N_TOKENS:
+        TGISValidationError.TopN.error(resp_options.top_n_tokens, MAX_TOP_N_TOKENS)
+
+    if (
+        resp_options.token_logprobs
+        or resp_options.token_ranks
+        or resp_options.top_n_tokens
+    ) and not (resp_options.input_tokens or resp_options.generated_tokens):
+        TGISValidationError.TokenDetail.error()
+
+    greedy = params.method == DecodingMethod.GREEDY
+    if (
+        STRICT_PARAMETER_VALIDATION
+        and greedy
+        and (
+            sampling.temperature
+            or sampling.top_k
+            or sampling.top_p
+            or sampling.typical_p
+        )
+    ):
+        TGISValidationError.SampleParametersGreedy.error()
+    if sampling.top_k < 0:
+        TGISValidationError.TopK.error()
+    if not (0 <= sampling.top_p <= 1):
+        TGISValidationError.TopP.error()
+    if sampling.typical_p > 1:
+        TGISValidationError.TypicalP.error()

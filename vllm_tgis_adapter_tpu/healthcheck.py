@@ -1,0 +1,101 @@
+"""Standalone gRPC health probe CLI (``grpc_healthcheck``).
+
+Capability analog of the reference probe (healthcheck.py:17-96): calls
+``grpc.health.v1.Health/Check`` for ``fmaas.GenerationService`` and exits
+non-zero unless the status is SERVING — suitable for k8s liveness probes.
+Uses our hand-written health stub (grpc/health.py) since grpc_health is not
+installed in this environment.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import grpc
+
+
+def health_check(
+    *,
+    server_url: str = "localhost:8033",
+    service: str | None = None,
+    insecure: bool = True,
+    timeout: float = 1,
+) -> bool:
+    from vllm_tgis_adapter_tpu.grpc.health import HealthStub
+    from vllm_tgis_adapter_tpu.grpc.pb.health_pb2 import HealthCheckRequest
+
+    print("health check...", end="")
+    request = HealthCheckRequest(service=service or "")
+    channel = (
+        grpc.insecure_channel(server_url)
+        if insecure
+        else grpc.secure_channel(server_url, grpc.ssl_channel_credentials())
+    )
+    try:
+        with channel:
+            response = HealthStub(channel).Check(request, timeout=timeout)
+    except grpc.RpcError as e:
+        print(f"Health.Check failed: code={e.code()}, details={e.details()}")
+        return False
+
+    print(str(response).strip())
+    from vllm_tgis_adapter_tpu.grpc.pb.health_pb2 import HealthCheckResponse
+
+    return response.status == HealthCheckResponse.SERVING
+
+
+def cli() -> None:
+    args = parse_args()
+    if not health_check(
+        server_url=args.server_url,
+        service=args.service_name,
+        insecure=args.insecure,
+        timeout=args.timeout,
+    ):
+        sys.exit(1)
+
+
+def parse_args() -> argparse.Namespace:
+    parser = argparse.ArgumentParser()
+    parser.formatter_class = argparse.ArgumentDefaultsHelpFormatter
+    group = parser.add_mutually_exclusive_group(required=False)
+    group.add_argument(
+        "--insecure",
+        dest="insecure",
+        action="store_true",
+        help="Use an insecure connection",
+    )
+    group.add_argument(
+        "--secure",
+        dest="insecure",
+        action="store_false",
+        help="Use a secure connection",
+    )
+    group.set_defaults(insecure=True)
+    parser.add_argument(
+        "--server-url",
+        type=str,
+        help="grpc server url (`host:port`)",
+        default="localhost:8033",
+    )
+    parser.add_argument(
+        "--timeout",
+        type=float,
+        help="Timeout for healthcheck request",
+        default=1,
+    )
+    parser.add_argument(
+        "--service-name",
+        type=str,
+        help="Name of the service to check",
+        required=False,
+        # matches TextGenerationService.SERVICE_NAME without the import cost
+        default="fmaas.GenerationService",
+    )
+
+    return parser.parse_args()
+
+
+if __name__ == "__main__":
+    cli()

@@ -1,0 +1,533 @@
+"""OpenAI-compatible HTTP server sharing the TGIS gRPC server's engine.
+
+Capability analog of the reference's in-process vLLM FastAPI app
+(http.py:41-99): ``/v1/completions`` (unary + SSE streaming),
+``/v1/models``, ``/health``, ``/metrics``, and the ``X-Correlation-ID``
+middleware behavior (http.py:26-38).  FastAPI/uvicorn are not available in
+this environment, so the app runs on a small asyncio + h11 HTTP/1.1 server
+(h11 provides the protocol state machine; sockets and concurrency are
+asyncio).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import socket
+import ssl as ssl_module
+import time
+import uuid
+from typing import TYPE_CHECKING, Any, AsyncIterator, Callable, Optional
+
+import h11
+
+from vllm_tgis_adapter_tpu import metrics
+from vllm_tgis_adapter_tpu.engine.sampling_params import (
+    RequestOutputKind,
+    SamplingParams,
+)
+from vllm_tgis_adapter_tpu.logging import init_logger
+from vllm_tgis_adapter_tpu.tgis_utils import logs
+
+if TYPE_CHECKING:
+    import argparse
+
+    from vllm_tgis_adapter_tpu.engine.async_llm import AsyncLLMEngine
+
+logger = init_logger(__name__)
+
+CORRELATION_ID_HEADER = "x-correlation-id"
+
+
+# --------------------------------------------------------------------- app
+
+
+class HttpRequest:
+    def __init__(
+        self,
+        method: str,
+        path: str,
+        headers: dict[str, str],
+        body: bytes,
+    ):
+        self.method = method
+        self.path = path
+        self.headers = headers
+        self.body = body
+
+    def json(self) -> Any:
+        return json.loads(self.body or b"{}")
+
+
+class HttpResponse:
+    def __init__(
+        self,
+        status: int = 200,
+        body: bytes | str = b"",
+        content_type: str = "application/json",
+        headers: Optional[dict[str, str]] = None,
+    ):
+        self.status = status
+        self.body = body.encode() if isinstance(body, str) else body
+        self.content_type = content_type
+        self.headers = headers or {}
+
+
+class StreamingResponse:
+    """Chunked response driven by an async byte-chunk generator."""
+
+    def __init__(
+        self,
+        chunks: AsyncIterator[bytes],
+        content_type: str = "text/event-stream",
+        headers: Optional[dict[str, str]] = None,
+    ):
+        self.chunks = chunks
+        self.content_type = content_type
+        self.headers = headers or {}
+        self.status = 200
+
+
+class JsonResponse(HttpResponse):
+    def __init__(self, obj: Any, status: int = 200, **kwargs):  # noqa: ANN003
+        super().__init__(status=status, body=json.dumps(obj), **kwargs)
+
+
+def error_response(status: int, message: str, err_type: str = "invalid_request_error"):
+    return JsonResponse(
+        {"error": {"message": message, "type": err_type, "code": status}},
+        status=status,
+    )
+
+
+class App:
+    """Method+path router with the shared-engine state, FastAPI-app analog."""
+
+    def __init__(self) -> None:
+        self.routes: dict[tuple[str, str], Callable] = {}
+        self.state: dict[str, Any] = {}
+
+    def route(self, method: str, path: str):  # noqa: ANN201
+        def register(fn):  # noqa: ANN001, ANN202
+            self.routes[(method, path)] = fn
+            return fn
+
+        return register
+
+    async def dispatch(self, request: HttpRequest):  # noqa: ANN201
+        handler = self.routes.get((request.method, request.path.split("?")[0]))
+        if handler is None:
+            if any(p == request.path for (_, p) in self.routes):
+                return error_response(405, "method not allowed")
+            return error_response(404, "not found")
+        return await handler(self, request)
+
+
+# ------------------------------------------------------------- endpoints
+
+
+def build_http_server(args: "argparse.Namespace", engine: "AsyncLLMEngine") -> App:
+    """Assemble the app around the SHARED engine (reference: http.py:41-67)."""
+    app = App()
+    app.state["engine"] = engine
+    app.state["args"] = args
+    served_names = args.served_model_name or [args.model]
+    app.state["model_names"] = served_names
+    app.state["api_key"] = args.api_key
+
+    app.route("GET", "/health")(_health)
+    app.route("GET", "/metrics")(_metrics)
+    app.route("GET", "/version")(_version)
+    app.route("GET", "/v1/models")(_models)
+    app.route("POST", "/v1/completions")(_completions)
+    return app
+
+
+async def _health(app: App, request: HttpRequest) -> HttpResponse:
+    engine: AsyncLLMEngine = app.state["engine"]
+    try:
+        await engine.check_health()
+    except Exception as e:  # noqa: BLE001 — cancellation must propagate
+        return error_response(500, f"engine dead: {e}", "engine_error")
+    return HttpResponse(200, b"")
+
+
+async def _metrics(app: App, request: HttpRequest) -> HttpResponse:  # noqa: ARG001
+    return HttpResponse(
+        200, metrics.render(), content_type="text/plain; version=0.0.4"
+    )
+
+
+async def _version(app: App, request: HttpRequest) -> HttpResponse:  # noqa: ARG001
+    from vllm_tgis_adapter_tpu import __version__
+
+    return JsonResponse({"version": __version__})
+
+
+async def _models(app: App, request: HttpRequest) -> HttpResponse:  # noqa: ARG001
+    created = int(time.time())
+    data = [
+        {
+            "id": name,
+            "object": "model",
+            "created": created,
+            "owned_by": "vllm-tgis-adapter-tpu",
+            "root": name,
+        }
+        for name in app.state["model_names"]
+    ]
+    engine: AsyncLLMEngine = app.state["engine"]
+    lora_manager = getattr(engine.engine, "lora_manager", None)
+    if lora_manager is not None:
+        data.extend(
+            {
+                "id": name,
+                "object": "model",
+                "created": created,
+                "owned_by": "vllm-tgis-adapter-tpu",
+                "root": req.lora_path,
+                "parent": app.state["model_names"][0],
+            }
+            for name, req in lora_manager.lora_requests.items()
+        )
+    return JsonResponse({"object": "list", "data": data})
+
+
+def _completion_sampling_params(body: dict[str, Any]) -> SamplingParams:
+    stop = body.get("stop")
+    if isinstance(stop, str):
+        stop = [stop]
+    temperature = float(body.get("temperature", 1.0))
+    params = dict(
+        max_tokens=int(body.get("max_tokens", 16)),
+        temperature=temperature,
+        seed=body.get("seed"),
+        stop=stop,
+        repetition_penalty=float(body.get("repetition_penalty", 1.0)),
+        logprobs=body.get("logprobs"),
+        min_tokens=int(body.get("min_tokens", 0)),
+        ignore_eos=bool(body.get("ignore_eos", False)),
+    )
+    if temperature > 0.0:
+        params.update(
+            top_p=float(body.get("top_p", 1.0)),
+            top_k=int(body.get("top_k", -1)),
+        )
+    return SamplingParams(**params)
+
+
+async def _completions(app: App, request: HttpRequest):  # noqa: ANN201, C901, PLR0915
+    engine: AsyncLLMEngine = app.state["engine"]
+    if (key := app.state.get("api_key")) and request.headers.get(
+        "authorization"
+    ) != f"Bearer {key}":
+        return error_response(401, "invalid api key", "authentication_error")
+    try:
+        body = request.json()
+    except json.JSONDecodeError as e:
+        return error_response(400, f"invalid JSON body: {e}")
+
+    prompt = body.get("prompt", "")
+    prompts = prompt if isinstance(prompt, list) else [prompt]
+    if not prompts or not all(isinstance(p, str) for p in prompts):
+        return error_response(400, "prompt must be a string or list of strings")
+    model_name = body.get("model") or app.state["model_names"][0]
+    if model_name not in app.state["model_names"]:
+        return error_response(404, f"model {model_name!r} does not exist")
+    try:
+        sampling_params = _completion_sampling_params(body)
+    except (ValueError, TypeError) as e:
+        return error_response(400, str(e))
+
+    stream = bool(body.get("stream", False))
+    base_request_id = uuid.uuid4().hex
+    created = int(time.time())
+    completion_id = f"cmpl-{base_request_id}"
+    correlation_id = request.headers.get(CORRELATION_ID_HEADER)
+
+    generators = []
+    for i, p in enumerate(prompts):
+        # id format {method}-{base}-{index} is what logs.get_correlation_id
+        # strips back down (reference format, tgis_utils/logs.py:40-44)
+        request_id = f"cmpl-{base_request_id}-{i}"
+        logs.set_correlation_id(base_request_id, correlation_id)
+        sp = SamplingParams(**{**sampling_params.__dict__})
+        sp.output_kind = (
+            RequestOutputKind.DELTA if stream else RequestOutputKind.FINAL_ONLY
+        )
+        generators.append(
+            engine.generate(
+                prompt=p, sampling_params=sp, request_id=request_id
+            )
+        )
+
+    from vllm_tgis_adapter_tpu.utils import merge_async_iterators
+
+    merged = merge_async_iterators(*generators)
+
+    if stream:
+
+        async def sse() -> AsyncIterator[bytes]:
+            try:
+                async for i, res in merged:
+                    out = res.outputs[0]
+                    chunk = {
+                        "id": completion_id,
+                        "object": "text_completion",
+                        "created": created,
+                        "model": model_name,
+                        "choices": [
+                            {
+                                "index": i,
+                                "text": out.text,
+                                "logprobs": None,
+                                "finish_reason": out.finish_reason,
+                            }
+                        ],
+                    }
+                    yield f"data: {json.dumps(chunk)}\n\n".encode()
+            except Exception as e:  # noqa: BLE001 — cancellation must propagate
+                err = {"error": {"message": str(e), "type": "server_error"}}
+                yield f"data: {json.dumps(err)}\n\n".encode()
+            yield b"data: [DONE]\n\n"
+
+        return StreamingResponse(sse())
+
+    results: list = [None] * len(prompts)
+    try:
+        async for i, res in merged:
+            results[i] = res
+    except ValueError as e:
+        return error_response(400, str(e))
+
+    prompt_tokens = sum(len(r.prompt_token_ids) for r in results)
+    completion_tokens = sum(len(r.outputs[0].token_ids) for r in results)
+    choices = []
+    for i, res in enumerate(results):
+        out = res.outputs[0]
+        text = out.text
+        if body.get("echo"):
+            text = prompts[i] + text
+        choices.append(
+            {
+                "index": i,
+                "text": text,
+                "logprobs": _convert_http_logprobs(out, engine)
+                if sampling_params.logprobs is not None
+                else None,
+                "finish_reason": out.finish_reason,
+                "stop_reason": out.stop_reason,
+            }
+        )
+    return JsonResponse(
+        {
+            "id": completion_id,
+            "object": "text_completion",
+            "created": created,
+            "model": model_name,
+            "choices": choices,
+            "usage": {
+                "prompt_tokens": prompt_tokens,
+                "completion_tokens": completion_tokens,
+                "total_tokens": prompt_tokens + completion_tokens,
+            },
+        }
+    )
+
+
+def _convert_http_logprobs(out, engine) -> Optional[dict]:  # noqa: ANN001
+    if out.logprobs is None:
+        return None
+    tokenizer = engine.engine.get_tokenizer()
+    token_logprobs: list[Optional[float]] = []
+    tokens: list[str] = []
+    top_logprobs: list[Optional[dict[str, float]]] = []
+    for tid, entry in zip(out.token_ids, out.logprobs):
+        if entry is None:
+            token_logprobs.append(None)
+            tokens.append(tokenizer.convert_ids_to_tokens(tid))
+            top_logprobs.append(None)
+            continue
+        lp = entry.get(tid)
+        tokens.append(tokenizer.convert_ids_to_tokens(tid))
+        token_logprobs.append(lp.logprob if lp else None)
+        top_logprobs.append(
+            {
+                tokenizer.convert_ids_to_tokens(t): v.logprob
+                for t, v in entry.items()
+            }
+        )
+    return {
+        "tokens": tokens,
+        "token_logprobs": token_logprobs,
+        "top_logprobs": top_logprobs,
+        "text_offset": [],
+    }
+
+
+# ------------------------------------------------------- h11 server plumbing
+
+
+_MAX_BODY = 32 * 1024 * 1024
+
+
+async def _handle_connection(  # noqa: C901, PLR0915
+    app: App,
+    reader: asyncio.StreamReader,
+    writer: asyncio.StreamWriter,
+) -> None:
+    conn = h11.Connection(h11.SERVER)
+
+    async def send(event) -> None:  # noqa: ANN001
+        data = conn.send(event)
+        if data:
+            writer.write(data)
+            await writer.drain()
+
+    try:
+        while True:
+            # -------- read one request (headers + full body)
+            request_ev = None
+            body = b""
+            while True:
+                event = conn.next_event()
+                if event is h11.NEED_DATA:
+                    data = await reader.read(65536)
+                    conn.receive_data(data)
+                    if data == b"" and request_ev is None:
+                        return  # client closed between requests
+                    continue
+                if isinstance(event, h11.Request):
+                    request_ev = event
+                elif isinstance(event, h11.Data):
+                    body += event.data
+                    if len(body) > _MAX_BODY:
+                        return
+                elif isinstance(event, h11.EndOfMessage):
+                    break
+                elif isinstance(event, (h11.ConnectionClosed,)):
+                    return
+
+            headers = {
+                k.decode("latin1").lower(): v.decode("latin1")
+                for k, v in request_ev.headers
+            }
+            request = HttpRequest(
+                method=request_ev.method.decode(),
+                path=request_ev.target.decode(),
+                headers=headers,
+                body=body,
+            )
+
+            # correlation-ID middleware behavior (reference: http.py:26-38)
+            correlation_id = headers.get(CORRELATION_ID_HEADER)
+
+            try:
+                response = await app.dispatch(request)
+            except Exception as e:  # noqa: BLE001
+                logger.exception("HTTP handler failed")
+                response = error_response(500, str(e), "server_error")
+
+            common_headers = [
+                ("server", "vllm-tgis-adapter-tpu"),
+                ("date", _http_date()),
+            ]
+            if correlation_id:
+                common_headers.append((CORRELATION_ID_HEADER, correlation_id))
+            for k, v in response.headers.items():
+                common_headers.append((k.lower(), v))
+
+            if isinstance(response, StreamingResponse):
+                await send(
+                    h11.Response(
+                        status_code=response.status,
+                        headers=[
+                            *common_headers,
+                            ("content-type", response.content_type),
+                            ("transfer-encoding", "chunked"),
+                        ],
+                    )
+                )
+                async for chunk in response.chunks:
+                    await send(h11.Data(data=chunk))
+                await send(h11.EndOfMessage())
+            else:
+                await send(
+                    h11.Response(
+                        status_code=response.status,
+                        headers=[
+                            *common_headers,
+                            ("content-type", response.content_type),
+                            ("content-length", str(len(response.body))),
+                        ],
+                    )
+                )
+                await send(h11.Data(data=response.body))
+                await send(h11.EndOfMessage())
+
+            # -------- keep-alive / close
+            if conn.our_state is h11.MUST_CLOSE or conn.their_state in (
+                h11.MUST_CLOSE,
+                h11.CLOSED,
+            ):
+                return
+            try:
+                conn.start_next_cycle()
+            except h11.ProtocolError:
+                return
+    except (
+        ConnectionResetError,
+        BrokenPipeError,
+        asyncio.IncompleteReadError,
+        h11.RemoteProtocolError,
+    ):
+        pass
+    finally:
+        try:
+            writer.close()
+            await writer.wait_closed()
+        except Exception:  # noqa: BLE001, S110
+            pass
+
+
+def _http_date() -> str:
+    from email.utils import formatdate
+
+    return formatdate(time.time(), usegmt=True)
+
+
+async def run_http_server(
+    args: "argparse.Namespace",
+    engine: "AsyncLLMEngine",
+    app: App,
+    sock: Optional[socket.socket] = None,
+) -> None:
+    """Serve the app forever on ``sock`` (pre-bound by the entrypoint)."""
+    ssl_context = None
+    if args.ssl_keyfile and args.ssl_certfile:
+        ssl_context = ssl_module.SSLContext(ssl_module.PROTOCOL_TLS_SERVER)
+        ssl_context.load_cert_chain(args.ssl_certfile, args.ssl_keyfile)
+        if args.ssl_ca_certs:
+            ssl_context.load_verify_locations(args.ssl_ca_certs)
+            ssl_context.verify_mode = ssl_module.CERT_REQUIRED
+
+    async def client_connected(
+        reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        await _handle_connection(app, reader, writer)
+
+    if sock is not None:
+        server = await asyncio.start_server(
+            client_connected, sock=sock, ssl=ssl_context
+        )
+    else:
+        server = await asyncio.start_server(
+            client_connected,
+            host=args.host or "0.0.0.0",  # noqa: S104
+            port=args.port,
+            ssl=ssl_context,
+        )
+    addr = args.host or "0.0.0.0"  # noqa: S104
+    logger.info("HTTP Server started at %s:%s", addr, args.port)
+    async with server:
+        await server.serve_forever()

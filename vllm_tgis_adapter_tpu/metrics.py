@@ -1,0 +1,88 @@
+"""Prometheus metrics for the serving layer.
+
+The reference exposes engine metrics through vLLM's HTTP ``/metrics``
+endpoint (pyproject.toml:31, exercised by tests/test_http_server.py:32-35).
+Here the registry is fed directly by our engine and servers.
+"""
+
+from __future__ import annotations
+
+from prometheus_client import (
+    REGISTRY,
+    Counter,
+    Gauge,
+    Histogram,
+    generate_latest,
+)
+
+_PREFIX = "tgis_tpu"
+
+
+def _get_or_create(cls, name: str, doc: str, **kwargs):  # noqa: ANN001, ANN003, ANN202
+    """Idempotent metric construction (tests boot multiple servers)."""
+    try:
+        return cls(name, doc, **kwargs)
+    except ValueError:
+        collector = REGISTRY._names_to_collectors.get(name)  # noqa: SLF001
+        if collector is None:
+            raise
+        return collector
+
+
+request_count = _get_or_create(
+    Counter,
+    f"{_PREFIX}_request_count",
+    "Total generation requests processed",
+    labelnames=("kind",),
+)
+request_failure_count = _get_or_create(
+    Counter,
+    f"{_PREFIX}_request_failure_count",
+    "Total failed generation requests",
+)
+prompt_tokens_total = _get_or_create(
+    Counter,
+    f"{_PREFIX}_prompt_tokens_total",
+    "Total prompt tokens processed",
+)
+generated_tokens_total = _get_or_create(
+    Counter,
+    f"{_PREFIX}_generated_tokens_total",
+    "Total tokens generated",
+)
+request_duration = _get_or_create(
+    Histogram,
+    f"{_PREFIX}_request_duration_seconds",
+    "End-to-end request duration",
+    buckets=(0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0),
+)
+queue_duration = _get_or_create(
+    Histogram,
+    f"{_PREFIX}_queue_duration_seconds",
+    "Time requests spend queued before first schedule",
+    buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0),
+)
+num_requests_running = _get_or_create(
+    Gauge,
+    f"{_PREFIX}_num_requests_running",
+    "Requests currently being generated",
+)
+
+
+def record_response(
+    *,
+    kind: str,
+    prompt_tokens: int,
+    generated_tokens: int,
+    duration_s: float,
+    queue_s: float,
+) -> None:
+    request_count.labels(kind=kind).inc()
+    prompt_tokens_total.inc(prompt_tokens)
+    generated_tokens_total.inc(generated_tokens)
+    request_duration.observe(duration_s)
+    queue_duration.observe(queue_s)
+
+
+def render() -> bytes:
+    return generate_latest(REGISTRY)

@@ -1,0 +1,238 @@
+"""TGIS-style structured per-request logging.
+
+Uniform request/response/error/cancellation log lines for BOTH the gRPC and
+HTTP servers, implemented (as in the reference, tgis_utils/logs.py:48-114)
+by wrapping ``engine.generate`` once at startup so every entrypoint is
+covered regardless of which API produced the request.  Correlation IDs are
+passed between servers and this module through a TTL-bounded blackboard
+(reference: logs.py:29).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+import logging
+import time
+from contextlib import suppress
+from typing import TYPE_CHECKING, Optional
+
+from vllm_tgis_adapter_tpu.logging import init_logger
+from vllm_tgis_adapter_tpu.utils import TTLCache
+
+if TYPE_CHECKING:
+    from collections.abc import AsyncGenerator
+
+    from vllm_tgis_adapter_tpu.engine.async_llm import AsyncLLMEngine
+    from vllm_tgis_adapter_tpu.engine.outputs import RequestMetrics, RequestOutput
+    from vllm_tgis_adapter_tpu.engine.sampling_params import SamplingParams
+
+logger = init_logger(__name__)
+
+# request_id -> correlation_id blackboard.  Size/TTL match the reference
+# (2048 entries, 600 s) so log-correlation behavior is identical under load.
+_REQUEST_ID_TO_CORRELATION_ID: TTLCache = TTLCache(maxsize=2048, ttl=600)
+
+
+def set_correlation_id(request_id: str, correlation_id: Optional[str]) -> None:
+    if correlation_id is not None:
+        _REQUEST_ID_TO_CORRELATION_ID[request_id] = correlation_id
+
+
+def get_correlation_id(request_id: str) -> Optional[str]:
+    correlation_id = _REQUEST_ID_TO_CORRELATION_ID.get(request_id)
+    if not correlation_id:
+        # the http server formats ids as {method}-{base_request_id}-{index};
+        # strip the leading and trailing clauses and retry
+        request_id = "-".join(request_id.split("-")[1:-1])
+        correlation_id = _REQUEST_ID_TO_CORRELATION_ID.get(request_id)
+    return correlation_id
+
+
+def add_logging_wrappers(engine: "AsyncLLMEngine") -> None:
+    """Wrap ``engine.generate`` with uniform TGIS-style logging."""
+    old_generate_fn = engine.generate
+
+    @functools.wraps(old_generate_fn)
+    async def generate_with_logging(
+        *args, **kwargs
+    ) -> "AsyncGenerator[RequestOutput, None]":
+        start_time = time.time()
+
+        # NB: coupled to AsyncLLMEngine.generate() positional order
+        prompt = _get_arg("prompt", 0, *args, **kwargs)
+        sampling_params = _get_arg("sampling_params", 1, *args, **kwargs)
+        request_id = _get_arg("request_id", 2, *args, **kwargs)
+        lora_request = kwargs.get("lora_request")
+        prompt_token_ids = kwargs.get("prompt_token_ids")
+
+        correlation_id = get_correlation_id(request_id=request_id)
+        adapter_id = getattr(lora_request, "adapter_id", None)
+
+        with suppress(BaseException):
+            _log_request(
+                prompt=prompt,
+                prompt_token_ids=prompt_token_ids,
+                params=sampling_params,
+                request_id=request_id,
+                correlation_id=correlation_id,
+                adapter_id=adapter_id,
+            )
+
+        from vllm_tgis_adapter_tpu import metrics
+
+        last = None
+        metrics.num_requests_running.inc()
+        try:
+            async for response in old_generate_fn(*args, **kwargs):
+                last = response
+                yield response
+        except asyncio.CancelledError:
+            _log_cancellation(request_id=request_id, correlation_id=correlation_id)
+            raise
+        except BaseException as e:
+            metrics.request_failure_count.inc()
+            _log_error(
+                request_id=request_id,
+                correlation_id=correlation_id,
+                exception_str=str(e),
+            )
+            raise
+        finally:
+            metrics.num_requests_running.dec()
+
+        if last:
+            with suppress(BaseException):
+                _log_response(
+                    request_id=request_id,
+                    correlation_id=correlation_id,
+                    response=last,
+                    engine_metrics=last.metrics,
+                    start_time=start_time,
+                )
+
+    engine.generate = generate_with_logging  # type: ignore[method-assign]
+
+
+def _log_error(request_id: str, correlation_id: str, exception_str: str) -> None:
+    logger.error(
+        "Request failed: request_id=%s correlation_id=%s error=%s",
+        request_id,
+        correlation_id,
+        exception_str,
+    )
+
+
+def _log_cancellation(request_id: str, correlation_id: str) -> None:
+    logger.info(
+        "Request cancelled: request_id=%s correlation_id=%s",
+        request_id,
+        correlation_id,
+    )
+
+
+def _sanitize_sampling_params(params: "SamplingParams") -> str:
+    """Redact constrained-decoding payloads (may embed user data/secrets)."""
+    original_params = str(params)
+    if getattr(params, "structured_outputs", None) is not None:
+        return original_params.replace(str(params.structured_outputs), "(...)")
+    return original_params
+
+
+def _log_request(  # noqa: PLR0913
+    request_id: str,
+    params: "SamplingParams",
+    adapter_id: Optional[str],
+    correlation_id: Optional[str],
+    prompt: object,
+    prompt_token_ids: Optional[list[int]],
+) -> None:
+    if prompt_token_ids is not None:
+        input_tokens = f" input_tokens={len(prompt_token_ids)},"
+    else:
+        input_tokens = ""
+
+    sanitized_params = _sanitize_sampling_params(params)
+
+    logger.info(
+        "Processing request: {request_id=%s, correlation_id=%s, adapter_id=%s, "
+        "%sparams=%s}",
+        request_id,
+        correlation_id,
+        adapter_id,
+        input_tokens,
+        sanitized_params,
+    )
+
+
+def _log_response(
+    request_id: str,
+    correlation_id: Optional[str],
+    response: "RequestOutput",
+    engine_metrics: "Optional[RequestMetrics]",
+    start_time: float,
+) -> None:
+    """One TGIS-style summary line with queue/inference/per-token timings."""
+    if len(response.outputs) == 0:
+        return
+
+    generated_tokens = len(response.outputs[0].token_ids)
+    if (
+        engine_metrics is None
+        or engine_metrics.first_scheduled_time is None
+        or engine_metrics.last_token_time is None
+    ):
+        logger.warning("No engine metrics for request, cannot log timing info")
+        inference_time = queue_time = time_per_token = total_time = 0.0
+    else:
+        inference_time = (
+            engine_metrics.last_token_time - engine_metrics.first_scheduled_time
+        )
+        queue_time = engine_metrics.time_in_queue or 0.0
+        time_per_token = _safe_div(inference_time, generated_tokens)
+        total_time = engine_metrics.last_token_time - start_time
+    output_len = len(response.outputs[0].text)
+
+    stop_reason_str = response.outputs[0].finish_reason
+
+    with suppress(BaseException):
+        from vllm_tgis_adapter_tpu import metrics
+
+        metrics.record_response(
+            kind=stop_reason_str or "unknown",
+            prompt_tokens=len(response.prompt_token_ids or ()),
+            generated_tokens=generated_tokens,
+            duration_s=total_time,
+            queue_s=queue_time,
+        )
+
+    level = logging.WARNING if stop_reason_str == "abort" else logging.INFO
+    logger.log(
+        level,
+        "Finished processing request: {request_id=%s, correlation_id=%s}. "
+        "Timing info: {queue_time=%.2fms, inference_time=%.2fms, "
+        "time_per_token=%.2fms, total_time=%.2fms}. "
+        "Generated %d tokens before finish reason: %s, output %d chars",
+        request_id,
+        correlation_id,
+        queue_time * 1e3,
+        inference_time * 1e3,
+        time_per_token * 1e3,
+        total_time * 1e3,
+        generated_tokens,
+        stop_reason_str,
+        output_len,
+    )
+
+
+def _safe_div(a: float, b: float, *, default: float = 0.0) -> float:
+    try:
+        return a / b
+    except ZeroDivisionError:
+        return default
+
+
+def _get_arg(name: str, pos: int, *args, **kwargs):  # noqa: ANN002, ANN003, ANN202
+    if len(args) > pos:
+        return args[pos]
+    return kwargs.get(name)

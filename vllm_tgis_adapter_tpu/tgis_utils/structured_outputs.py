@@ -1,0 +1,42 @@
+"""Map the proto constrained-decoding oneof onto engine params.
+
+TPU-native analog of the reference mapping (tgis_utils/structured_outputs.py:
+14-38): the proto ``DecodingParameters.guided`` oneof becomes a
+``StructuredOutputsParams`` consumed by the engine's FSM-constrained sampler
+(ops/constrained.py) rather than a vLLM backend.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from vllm_tgis_adapter_tpu.engine.sampling_params import StructuredOutputsParams
+from vllm_tgis_adapter_tpu.grpc.pb.generation_pb2 import DecodingParameters
+
+
+def get_structured_output_params(
+    decoding_params: DecodingParameters,
+) -> Optional[StructuredOutputsParams]:
+    guided = decoding_params.WhichOneof("guided")
+    if not guided:
+        return None
+
+    if guided == "json_schema":
+        return StructuredOutputsParams(json=decoding_params.json_schema)
+
+    if guided == "regex":
+        return StructuredOutputsParams(regex=decoding_params.regex)
+
+    if guided == "choice":
+        choice_list = decoding_params.choice.choices
+        if len(choice_list) < 2:
+            raise ValueError("Must provide at least two choices")
+        return StructuredOutputsParams(choice=list(choice_list))
+
+    if guided == "grammar":
+        return StructuredOutputsParams(grammar=decoding_params.grammar)
+
+    if decoding_params.format == DecodingParameters.JSON:
+        return StructuredOutputsParams(json_object=True)
+
+    raise ValueError(guided)

@@ -55,6 +55,48 @@ def to_list(seq: "Sequence[int]") -> list[int]:
     return seq if isinstance(seq, list) else list(seq)
 
 
+async def merge_async_iterators(*iterators):  # noqa: ANN001, ANN201
+    """Merge async iterators into one stream of ``(index, item)`` pairs.
+
+    The batched Generate RPC fans one engine stream per sub-request and
+    consumes them as a single merged stream (the reference borrows vLLM's
+    helper for this, grpc_server.py:274-276).  Cancellation propagates to
+    every underlying iterator.
+    """
+    queue: asyncio.Queue = asyncio.Queue()
+    done_sentinel = object()
+
+    async def produce(i: int, iterator) -> None:  # noqa: ANN001
+        try:
+            async for item in iterator:
+                await queue.put((i, item))
+        except BaseException as e:  # noqa: BLE001 — forwarded to the consumer
+            await queue.put(e)
+        finally:
+            # put_nowait: the queue is unbounded and this must run even
+            # while this producer task is being cancelled
+            queue.put_nowait(done_sentinel)
+
+    tasks = [
+        asyncio.create_task(produce(i, iterator))
+        for i, iterator in enumerate(iterators)
+    ]
+    try:
+        remaining = len(tasks)
+        while remaining:
+            item = await queue.get()
+            if item is done_sentinel:
+                remaining -= 1
+            elif isinstance(item, BaseException):
+                raise item
+            else:
+                yield item
+    finally:
+        for t in tasks:
+            t.cancel()
+        await asyncio.gather(*tasks, return_exceptions=True)
+
+
 class TTLCache:
     """Minimal dict-like cache with max size + per-entry TTL.
 
