@@ -39,10 +39,11 @@ logger = init_logger(__name__)
 class LLMEngine:
     """Single-process engine: one model, one scheduler, one device program."""
 
-    def __init__(self, config: EngineConfig, model, params, tokenizer):
+    def __init__(self, config: EngineConfig, model, params, tokenizer,
+                 mesh=None):
         self.config = config
         self.tokenizer = tokenizer
-        self.runner = ModelRunner(config, model, params)
+        self.runner = ModelRunner(config, model, params, mesh=mesh)
         self.scheduler = Scheduler(
             config.scheduler_config,
             config.cache_config,
@@ -59,13 +60,29 @@ class LLMEngine:
         from vllm_tgis_adapter_tpu.engine.weights import load_llama_params
         from vllm_tgis_adapter_tpu.models import get_model_class
 
+        from vllm_tgis_adapter_tpu.parallel import (
+            make_place_fn,
+            validate_tp_divisibility,
+        )
+        from vllm_tgis_adapter_tpu.parallel.mesh import (
+            mesh_from_parallel_config,
+        )
+
         mcfg = config.model_config
         model_cls = get_model_class(mcfg.model_type)
         model = model_cls(mcfg)
+        # build the mesh BEFORE loading so every tensor is sharded onto it
+        # as it is read — sharding after a full single-device load would
+        # OOM device 0 for models that need TP in the first place
+        mesh = mesh_from_parallel_config(config.parallel_config)
+        place = None
+        if mesh is not None:
+            validate_tp_divisibility(mcfg, mesh.shape["tp"])
+            place = make_place_fn(mesh)
         logger.info("loading weights from %s", mcfg.model)
-        params = load_llama_params(mcfg, mcfg.model)
+        params = load_llama_params(mcfg, mcfg.model, place=place)
         tokenizer = AutoTokenizer.from_pretrained(config.tokenizer or mcfg.model)
-        return cls(config, model, params, tokenizer)
+        return cls(config, model, params, tokenizer, mesh=mesh)
 
     def get_tokenizer(self):
         return self.tokenizer

@@ -50,16 +50,43 @@ class PromptLogprobInfo:
 
 
 class ModelRunner:
-    def __init__(self, config: "EngineConfig", model, params):
+    def __init__(self, config: "EngineConfig", model, params, mesh=None):
         self.config = config
         self.model = model
-        self.params = params
         cache_cfg = config.cache_config
         mcfg = config.model_config
         self.block_size = cache_cfg.block_size
         self.num_slots = cache_cfg.num_blocks * cache_cfg.block_size
         self.max_blocks_per_seq = -(-mcfg.max_model_len // self.block_size)
-        self.caches = model.make_kv_caches(self.num_slots, cache_cfg.cache_dtype)
+        caches = model.make_kv_caches(self.num_slots, cache_cfg.cache_dtype)
+
+        # distributed: shard params/caches over the mesh; the XLA SPMD
+        # partitioner propagates Megatron TP through the step fns
+        # (parallel/sharding.py).  tp=1 single-chip keeps the fast path.
+        pcfg = config.parallel_config
+        if mesh is None:
+            from vllm_tgis_adapter_tpu.parallel.mesh import (
+                mesh_from_parallel_config,
+            )
+
+            mesh = mesh_from_parallel_config(pcfg)
+        self.mesh = mesh
+        if mesh is not None:
+            from vllm_tgis_adapter_tpu.parallel import (
+                cache_sharding,
+                data_sharding,
+                shard_llama_params,
+                validate_tp_divisibility,
+            )
+
+            validate_tp_divisibility(mcfg, mesh.shape["tp"])
+            params = shard_llama_params(mesh, params)
+            caches = jax.device_put(caches, cache_sharding(mesh))
+            self._data_sharding = data_sharding(mesh)
+        else:
+            self._data_sharding = None
+        self.params = params
+        self.caches = caches
 
         # buffer donation lets XLA update the KV cache in place; host
         # platforms don't implement donation and warn, so gate it
@@ -70,8 +97,15 @@ class ModelRunner:
         )
 
         max_seqs = config.scheduler_config.max_num_seqs
-        self.seen = jnp.zeros((max_seqs, mcfg.vocab_size), bool)
+        self.seen = self._put(jnp.zeros((max_seqs, mcfg.vocab_size), bool))
         self._rng = np.random.default_rng(config.seed)
+
+    def _put(self, x) -> jax.Array:
+        """Host array → device; replicated over the mesh when distributed
+        so every tp shard sees the full batch (parallel/sharding.py)."""
+        if self._data_sharding is not None:
+            return jax.device_put(x, self._data_sharding)
+        return jnp.asarray(x)
 
     def new_fallback_seed(self) -> int:
         """Engine-drawn PRNG material for requests without an explicit seed."""
@@ -102,11 +136,11 @@ class ModelRunner:
         logits, self.caches = self._prefill_fn(
             self.params,
             self.caches,
-            jnp.asarray(token_ids),
-            jnp.asarray(positions),
-            jnp.asarray(slot_mapping),
-            jnp.asarray(t, jnp.int32),
-            jnp.asarray(logits_indices),
+            self._put(token_ids),
+            self._put(positions),
+            self._put(slot_mapping),
+            self._put(np.asarray(t, np.int32)),
+            self._put(logits_indices),
         )
 
         prompt_info = None
@@ -129,7 +163,7 @@ class ModelRunner:
         row_tokens = np.full(bucket, -1, np.int32)
         row_tokens[:t] = plan.token_ids
         self.seen = sampler_mod.set_seen_row(
-            self.seen, jnp.asarray(seq.slot), jnp.asarray(row_tokens)
+            self.seen, self._put(np.asarray(seq.slot)), self._put(row_tokens)
         )
         result = self._sample(last_logits, [seq])
         return result[0], prompt_info
@@ -157,11 +191,11 @@ class ModelRunner:
         logits, self.caches = self._decode_fn(
             self.params,
             self.caches,
-            jnp.asarray(token_ids),
-            jnp.asarray(positions),
-            jnp.asarray(slot_mapping),
-            jnp.asarray(block_tables),
-            jnp.asarray(context_lens),
+            self._put(token_ids),
+            self._put(positions),
+            self._put(slot_mapping),
+            self._put(block_tables),
+            self._put(context_lens),
             self.block_size,
         )
         return self._sample(logits, seqs)

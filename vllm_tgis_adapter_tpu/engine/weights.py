@@ -34,18 +34,43 @@ def _np_to_jnp(tensor, dtype) -> jax.Array:
     return jnp.asarray(tensor).astype(dtype)
 
 
+class CheckpointIndex:
+    """Lazy name→shard index over a directory of safetensors files.
+
+    Tensors are read one at a time on demand so host memory never holds
+    more than one full tensor alongside the (possibly sharded) params —
+    required for 70B-class models whose full checkpoint exceeds host RAM
+    headroom and whose unsharded weights exceed one chip's HBM.
+    """
+
+    def __init__(self, model_path: str):
+        files = sorted(Path(model_path).glob("*.safetensors"))
+        if not files:
+            raise ValueError(f"no *.safetensors files found in {model_path}")
+        self._by_name: dict[str, Path] = {}
+        for file in files:
+            # framework="flax" decodes bf16 natively (numpy cannot)
+            with safe_open(file, framework="flax") as f:
+                for name in f.keys():  # noqa: SIM118
+                    self._by_name[name] = file
+        self._taken: set[str] = set()
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._by_name and name not in self._taken
+
+    def pop(self, name: str) -> jax.Array:
+        self._taken.add(name)
+        with safe_open(self._by_name[name], framework="flax") as f:
+            return f.get_tensor(name)
+
+    def remaining(self) -> list[str]:
+        return [n for n in self._by_name if n not in self._taken]
+
+
 def load_checkpoint_tensors(model_path: str) -> dict:
-    """Yield {hf_name: np/jnp array} across all safetensors shards."""
-    files = sorted(Path(model_path).glob("*.safetensors"))
-    if not files:
-        raise ValueError(f"no *.safetensors files found in {model_path}")
-    tensors = {}
-    for file in files:
-        # framework="flax" decodes bf16 natively (numpy cannot)
-        with safe_open(file, framework="flax") as f:
-            for name in f.keys():  # noqa: SIM118
-                tensors[name] = f.get_tensor(name)
-    return tensors
+    """Eager {hf_name: array} across all shards (tests/small models)."""
+    index = CheckpointIndex(model_path)
+    return {name: index.pop(name) for name in index.remaining()}
 
 
 def load_llama_params(
@@ -56,7 +81,7 @@ def load_llama_params(
     """Build the LlamaForCausalLM param pytree from a HF checkpoint."""
     place = place or (lambda _name, x: x)
     dtype = config.dtype
-    raw = load_checkpoint_tensors(model_path)
+    raw = CheckpointIndex(model_path)
 
     def take(name: str, transpose: bool = False) -> jax.Array:
         if name not in raw:
@@ -95,7 +120,7 @@ def load_llama_params(
             layer["bv"] = take(f"{prefix}.self_attn.v_proj.bias")
         params["layers"].append(layer)
 
-    ignored = [n for n in raw if "rotary_emb" not in n]
+    ignored = [n for n in raw.remaining() if "rotary_emb" not in n]
     if ignored:
         logger.warning("ignored %d unexpected checkpoint tensors: %s",
                        len(ignored), ignored[:5])

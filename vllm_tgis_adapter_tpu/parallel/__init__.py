@@ -1,0 +1,36 @@
+"""Distributed layer: device meshes, sharding rules, multi-host init.
+
+TPU-native replacement for the NCCL/Ray/MP distribution stack beneath the
+reference adapter (SURVEY.md §2.4): there is no process-group runtime to
+write — collectives are XLA ops emitted by the SPMD partitioner under a
+``jax.sharding.Mesh`` — but mesh construction, parameter/KV-cache layout,
+and multi-host initialisation are ours and live here.
+"""
+
+from vllm_tgis_adapter_tpu.parallel.mesh import (
+    MeshAxes,
+    build_mesh,
+    initialize_multihost,
+    mesh_from_parallel_config,
+)
+from vllm_tgis_adapter_tpu.parallel.sharding import (
+    cache_sharding,
+    data_sharding,
+    llama_param_specs,
+    make_place_fn,
+    shard_llama_params,
+    validate_tp_divisibility,
+)
+
+__all__ = [
+    "MeshAxes",
+    "build_mesh",
+    "initialize_multihost",
+    "mesh_from_parallel_config",
+    "cache_sharding",
+    "data_sharding",
+    "llama_param_specs",
+    "make_place_fn",
+    "shard_llama_params",
+    "validate_tp_divisibility",
+]

@@ -1,0 +1,148 @@
+"""Device-mesh construction and multi-host initialisation.
+
+The reference stack distributes with NCCL process groups spawned by vLLM
+(one worker per TP rank; `--num-shard` → ``tensor_parallel_size``,
+reference tgis_utils/args.py:139-142).  On TPU the equivalent is a
+single-controller ``jax.sharding.Mesh`` whose axes ride the ICI fabric;
+collectives (psum/all-gather/reduce-scatter/ppermute) are inserted by the
+XLA SPMD partitioner from sharding annotations, so this module only owns
+mesh geometry and host-process bring-up.
+
+Axis convention (outermost → innermost, matching ICI locality: the tp axis
+is innermost so its all-reduces ride the fastest links):
+
+* ``dp``  — data parallel / replica axis (DCN across slices later)
+* ``sp``  — sequence/context parallel axis (ring attention, long context)
+* ``tp``  — tensor parallel axis (Megatron-style sharded matmuls)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from vllm_tgis_adapter_tpu.logging import init_logger
+
+logger = init_logger(__name__)
+
+DP_AXIS = "dp"
+SP_AXIS = "sp"
+TP_AXIS = "tp"
+AXIS_NAMES = (DP_AXIS, SP_AXIS, TP_AXIS)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshAxes:
+    """Logical parallelism degrees for one engine instance."""
+
+    data_parallel_size: int = 1
+    sequence_parallel_size: int = 1
+    tensor_parallel_size: int = 1
+
+    @property
+    def total_devices(self) -> int:
+        return (
+            self.data_parallel_size
+            * self.sequence_parallel_size
+            * self.tensor_parallel_size
+        )
+
+
+def build_mesh(
+    axes: MeshAxes | None = None,
+    *,
+    tensor_parallel_size: int = 1,
+    data_parallel_size: int = 1,
+    sequence_parallel_size: int = 1,
+    devices: list | None = None,
+) -> Mesh:
+    """Build a ``(dp, sp, tp)`` mesh over the available devices.
+
+    The tp axis is placed innermost so neighbouring mesh coordinates map to
+    neighbouring chips (``jax.devices()`` enumerates in ICI order on TPU),
+    keeping per-layer all-reduces on the fastest links.
+    """
+    if axes is None:
+        axes = MeshAxes(
+            data_parallel_size=data_parallel_size,
+            sequence_parallel_size=sequence_parallel_size,
+            tensor_parallel_size=tensor_parallel_size,
+        )
+    devices = list(devices if devices is not None else jax.devices())
+    need = axes.total_devices
+    if need > len(devices):
+        raise ValueError(
+            f"mesh needs {need} devices "
+            f"(dp={axes.data_parallel_size} × sp={axes.sequence_parallel_size}"
+            f" × tp={axes.tensor_parallel_size}) but only "
+            f"{len(devices)} are visible"
+        )
+    grid = np.asarray(devices[:need]).reshape(
+        axes.data_parallel_size,
+        axes.sequence_parallel_size,
+        axes.tensor_parallel_size,
+    )
+    mesh = Mesh(grid, AXIS_NAMES)
+    logger.info(
+        "built device mesh dp=%d sp=%d tp=%d over %d %s device(s)",
+        axes.data_parallel_size,
+        axes.sequence_parallel_size,
+        axes.tensor_parallel_size,
+        need,
+        devices[0].platform,
+    )
+    return mesh
+
+
+def mesh_from_parallel_config(pcfg) -> Mesh | None:
+    """Mesh for an engine's ParallelConfig; None for the single-chip path.
+
+    Fails fast on parallelism modes the engine does not implement yet, so
+    a flag the CLI accepts can never silently run unsharded (dp replicas
+    are deployment-level in this release: one engine per replica behind a
+    load balancer, as the reference deploys TGIS).
+    """
+    if pcfg.pipeline_parallel_size > 1:
+        raise NotImplementedError(
+            "--pipeline-parallel-size > 1 is not implemented yet; "
+            "use --tensor-parallel-size to scale within a slice"
+        )
+    if pcfg.data_parallel_size > 1:
+        raise NotImplementedError(
+            "--data-parallel-size > 1 is not implemented in-process; run "
+            "one engine per replica behind a load balancer (deployment-"
+            "level DP, as the reference stack deploys TGIS)"
+        )
+    if pcfg.tensor_parallel_size <= 1:
+        return None
+    return build_mesh(tensor_parallel_size=pcfg.tensor_parallel_size)
+
+
+def initialize_multihost(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Bring up the multi-host runtime (one controller process per host).
+
+    Wraps ``jax.distributed.initialize``; on TPU pods all arguments are
+    discovered from the metadata server, so a bare call suffices.  Must run
+    before the first device query.  The reference's analog is vLLM's
+    Ray/MP worker launch; here every host runs the same SPMD program and
+    XLA handles cross-host collectives over ICI/DCN.
+    """
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    logger.info(
+        "multi-host initialised: process %d/%d, %d local / %d global devices",
+        jax.process_index(),
+        jax.process_count(),
+        jax.local_device_count(),
+        jax.device_count(),
+    )

@@ -1,0 +1,155 @@
+"""Sharding rules: llama param pytree + paged KV cache onto the mesh.
+
+Megatron-style tensor parallelism expressed as ``PartitionSpec`` leaves —
+the XLA SPMD partitioner turns these into the same comm pattern the
+reference stack gets from hand-written NCCL calls inside vLLM (one
+all-reduce after attention-out and one after mlp-down per layer):
+
+* wq/wk/wv ``[d, H·Dh]``: column-parallel (heads split across tp)
+* wo ``[H·Dh, d]``: row-parallel → psum of partial sums
+* w_gate/w_up ``[d, f]``: column-parallel; w_down ``[f, d]``: row-parallel
+* embed ``[V, d]``: vocab-parallel; lm_head ``[d, V]``: column-parallel
+  (logits arrive vocab-sharded; the sampler's reductions gather them)
+* KV cache ``[L, slots, Hkv, Dh]``: head-sharded — each tp shard holds the
+  pages for its own kv heads, so paged reads/writes are shard-local
+* norms / biases on the hidden dim: replicated
+
+No activation specs are needed: annotating the params is enough for the
+partitioner to propagate Megatron sharding through the whole step fn.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from vllm_tgis_adapter_tpu.parallel.mesh import TP_AXIS
+
+if TYPE_CHECKING:
+    from vllm_tgis_adapter_tpu.engine.config import ModelConfig
+
+
+def validate_tp_divisibility(config: "ModelConfig", tp: int) -> None:
+    """Fail fast (like vLLM's engine-boot check) when tp can't split the model."""
+    problems = []
+    if config.num_heads % tp:
+        problems.append(f"num_heads={config.num_heads}")
+    if config.num_kv_heads % tp:
+        problems.append(f"num_kv_heads={config.num_kv_heads}")
+    if config.intermediate_size % tp:
+        problems.append(f"intermediate_size={config.intermediate_size}")
+    if config.vocab_size % tp:
+        problems.append(f"vocab_size={config.vocab_size}")
+    if problems:
+        raise ValueError(
+            f"tensor_parallel_size={tp} does not divide "
+            + ", ".join(problems)
+        )
+
+
+_LAYER_SPECS = {
+    "input_norm": P(None),
+    "post_attn_norm": P(None),
+    "wq": P(None, TP_AXIS),
+    "wk": P(None, TP_AXIS),
+    "wv": P(None, TP_AXIS),
+    "wo": P(TP_AXIS, None),
+    "w_gate": P(None, TP_AXIS),
+    "w_up": P(None, TP_AXIS),
+    "w_down": P(TP_AXIS, None),
+    "bq": P(TP_AXIS),
+    "bk": P(TP_AXIS),
+    "bv": P(TP_AXIS),
+    # mixtral-style MoE: experts stacked on axis 0, expert-parallel later;
+    # per-expert ffn dims follow the dense rules on their trailing axes
+    "router": P(None, None),
+    "experts_gate": P(None, None, TP_AXIS),
+    "experts_up": P(None, None, TP_AXIS),
+    "experts_down": P(None, TP_AXIS, None),
+}
+
+
+def llama_param_specs(params: dict) -> dict:
+    """PartitionSpec pytree matching models/llama.py's param layout."""
+    specs: dict = {
+        "embed": P(TP_AXIS, None),
+        "final_norm": P(None),
+    }
+    if "lm_head" in params:
+        specs["lm_head"] = P(None, TP_AXIS)
+    specs["layers"] = [
+        {name: _LAYER_SPECS[name] for name in layer}
+        for layer in params["layers"]
+    ]
+    return specs
+
+
+def shard_llama_params(mesh: Mesh, params: dict) -> dict:
+    """device_put every leaf onto the mesh with its Megatron spec.
+
+    (tree.map uses ``params``' structure, so the PartitionSpec leaves of
+    ``specs`` are passed through whole — they are never flattened even
+    though PartitionSpec subclasses tuple.)
+    """
+    specs = llama_param_specs(params)
+    return jax.tree.map(
+        lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)),
+        params,
+        specs,
+    )
+
+
+# HF checkpoint-name → spec for shard-on-load (engine/weights.py PlaceFn):
+# names seen AFTER the loader's transpose to [in, out] orientation.
+_HF_NAME_SPECS = (
+    ("embed_tokens.weight", P(TP_AXIS, None)),
+    ("lm_head.weight", P(None, TP_AXIS)),
+    ("q_proj.weight", P(None, TP_AXIS)),
+    ("k_proj.weight", P(None, TP_AXIS)),
+    ("v_proj.weight", P(None, TP_AXIS)),
+    ("o_proj.weight", P(TP_AXIS, None)),
+    ("gate_proj.weight", P(None, TP_AXIS)),
+    ("up_proj.weight", P(None, TP_AXIS)),
+    ("down_proj.weight", P(TP_AXIS, None)),
+    ("q_proj.bias", P(TP_AXIS)),
+    ("k_proj.bias", P(TP_AXIS)),
+    ("v_proj.bias", P(TP_AXIS)),
+    ("norm.weight", P(None)),
+    ("layernorm.weight", P(None)),
+)
+
+
+def hf_name_spec(name: str) -> P:
+    for suffix, spec in _HF_NAME_SPECS:
+        if name.endswith(suffix):
+            return spec
+    return P()
+
+
+def make_place_fn(mesh: Mesh):
+    """PlaceFn for the weight loader: shard each tensor onto the mesh as it
+    is read, so no device ever materialises the full unsharded model
+    (70B-class models exceed one chip's HBM — sharding after a full load
+    would OOM device 0)."""
+
+    def place(name: str, x: jax.Array) -> jax.Array:
+        return jax.device_put(x, NamedSharding(mesh, hf_name_spec(name)))
+
+    return place
+
+
+def cache_sharding(mesh: Mesh) -> NamedSharding:
+    """KV cache ``[L, slots, Hkv, Dh]``: shard the kv-head axis on tp."""
+    return NamedSharding(mesh, P(None, None, TP_AXIS, None))
+
+
+def data_sharding(mesh: Mesh) -> NamedSharding:
+    """Replicated placement for host-built step inputs (token ids, tables).
+
+    Data-parallel batch sharding will split these on the dp axis; with a
+    single engine replica they are replicated so every tp shard sees the
+    full batch.
+    """
+    return NamedSharding(mesh, P())
