@@ -1,0 +1,149 @@
+"""Continuous-batching serving benchmark → one JSON line.
+
+Measures aggregate output tok/s through the real engine (scheduler →
+jitted prefill/paged-decode → batched sampler → incremental detokenizer),
+the metric the driver tracks against BASELINE.json's north star (≥2000
+aggregate output tok/s, Llama-3-8B on v5e-8 over the TGIS port).
+
+Proxy model (no network egress, 70B/8B checkpoints unavailable): a
+Llama-3.2-1B-shaped decoder with random weights and a 16k byte-level
+tokenizer.  Rationale: Llama-3-8B on v5e-8 runs TP=8, so each chip holds
+1/8 of the weights and computes ~2 GFLOP/token; a 1B model on ONE chip
+also computes ~2 GFLOP/token — per-chip arithmetic intensity matches, so
+single-chip tok/s on the proxy ≈ the aggregate tok/s the same engine
+would sustain on 8B/TP=8 (minus ICI collective overhead, which XLA
+overlaps).  vs_baseline = value / 2000.
+
+Workload: 64 requests × 128 prompt tokens → 128 output tokens, greedy,
+max_num_seqs=32 (continuous batching ramps 1→32).  Warmup pass first so
+every (prefill-bucket, batch-bucket) program is compiled before timing.
+
+Env knobs: BENCH_TINY=1 (CI smoke on CPU), BENCH_REQUESTS, BENCH_PROMPT,
+BENCH_OUTPUT, BENCH_BATCH.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import jax
+import numpy as np
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_compile_cache")
+
+# honour JAX_PLATFORMS=cpu even when a site hook pre-registered a TPU
+# plugin (env vars alone are read too late once jax is imported at
+# interpreter startup; see tests/conftest.py)
+if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+
+BASELINE_TOKS = 2000.0  # BASELINE.json north star, v5e-8 aggregate
+
+
+def build_model_dir(tiny: bool) -> tuple[str, dict]:
+    """Write tokenizer + config for the bench model; params are random."""
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "tests"))
+    from fixture_models import build_tokenizer
+
+    if tiny:
+        arch = dict(vocab_size=512, hidden_size=64, intermediate_size=128,
+                    num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16)
+    else:
+        # Llama-3.2-1B shape, 16k vocab (see module docstring)
+        arch = dict(vocab_size=16384, hidden_size=2048,
+                    intermediate_size=8192, num_layers=16, num_heads=32,
+                    num_kv_heads=8, head_dim=64)
+    path = f"/tmp/bench-model-{'tiny' if tiny else '1b'}"
+    if not os.path.exists(os.path.join(path, "tokenizer.json")):
+        os.makedirs(path, exist_ok=True)
+        build_tokenizer(path, vocab_size=arch["vocab_size"])
+    return path, arch
+
+
+def main() -> None:
+    tiny = os.environ.get("BENCH_TINY", "") == "1" or (
+        jax.default_backend() != "tpu"
+    )
+    n_requests = int(os.environ.get("BENCH_REQUESTS", 16 if tiny else 64))
+    prompt_len = int(os.environ.get("BENCH_PROMPT", 32 if tiny else 128))
+    output_len = int(os.environ.get("BENCH_OUTPUT", 16 if tiny else 128))
+    max_seqs = int(os.environ.get("BENCH_BATCH", 8 if tiny else 32))
+
+    import jax.numpy as jnp
+    from transformers import AutoTokenizer
+
+    from vllm_tgis_adapter_tpu.engine.config import (
+        CacheConfig,
+        EngineConfig,
+        LoRAConfig,
+        ModelConfig,
+        ParallelConfig,
+        SchedulerConfig,
+    )
+    from vllm_tgis_adapter_tpu.engine.core import LLMEngine
+    from vllm_tgis_adapter_tpu.engine.sampling_params import SamplingParams
+    from vllm_tgis_adapter_tpu.models.llama import LlamaForCausalLM
+
+    model_dir, arch = build_model_dir(tiny)
+    dtype = jnp.float32 if tiny else jnp.bfloat16
+    max_len = prompt_len + output_len + 16
+    mcfg = ModelConfig(
+        model=model_dir, model_type="llama", max_model_len=max_len,
+        rope_theta=500000.0, dtype=dtype, **arch,
+    )
+    block_size = 16
+    blocks_needed = max_seqs * (-(-max_len // block_size)) * 2
+    config = EngineConfig(
+        model_config=mcfg,
+        cache_config=CacheConfig(block_size=block_size,
+                                 num_blocks=blocks_needed,
+                                 cache_dtype=dtype),
+        scheduler_config=SchedulerConfig(
+            max_num_seqs=max_seqs,
+            prefill_buckets=(prompt_len, max_len),
+        ),
+        parallel_config=ParallelConfig(),
+        lora_config=LoRAConfig(),
+    )
+    model = LlamaForCausalLM(mcfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+    tokenizer = AutoTokenizer.from_pretrained(model_dir)
+    engine = LLMEngine(config, model, params, tokenizer)
+
+    rng = np.random.default_rng(0)
+
+    def run_pass(num: int, out_tokens: int) -> tuple[int, float]:
+        for i in range(num):
+            ids = rng.integers(3, mcfg.vocab_size, size=prompt_len).tolist()
+            engine.add_request(
+                f"bench-{time.monotonic_ns()}-{i}", None,
+                SamplingParams(temperature=0.0, max_tokens=out_tokens,
+                               ignore_eos=True),
+                prompt_token_ids=ids,
+            )
+        produced = 0
+        start = time.perf_counter()
+        while engine.has_unfinished_requests():
+            for out in engine.step():
+                if out.finished:
+                    produced += len(out.outputs[0].token_ids)
+        return produced, time.perf_counter() - start
+
+    run_pass(min(n_requests, 2 * max_seqs), output_len)  # compile warmup
+    produced, elapsed = run_pass(n_requests, output_len)
+
+    value = produced / elapsed
+    print(json.dumps({
+        "metric": "aggregate_output_tok_per_s",
+        "value": round(value, 2),
+        "unit": "tok/s",
+        "vs_baseline": round(value / BASELINE_TOKS, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

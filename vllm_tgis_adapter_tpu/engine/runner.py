@@ -58,7 +58,6 @@ class ModelRunner:
         self.block_size = cache_cfg.block_size
         self.num_slots = cache_cfg.num_blocks * cache_cfg.block_size
         self.max_blocks_per_seq = -(-mcfg.max_model_len // self.block_size)
-        caches = model.make_kv_caches(self.num_slots, cache_cfg.cache_dtype)
 
         # distributed: shard params/caches over the mesh; the XLA SPMD
         # partitioner propagates Megatron TP through the step fns
@@ -81,9 +80,19 @@ class ModelRunner:
 
             validate_tp_divisibility(mcfg, mesh.shape["tp"])
             params = shard_llama_params(mesh, params)
-            caches = jax.device_put(caches, cache_sharding(mesh))
+            # allocate the cache sharded from the start: the pool is sized
+            # against the mesh's AGGREGATE HBM, so materialising it on one
+            # device first would OOM exactly like an unsharded weight load
+            sh = cache_sharding(mesh)
+            caches = jax.jit(
+                lambda: model.make_kv_caches(
+                    self.num_slots, cache_cfg.cache_dtype
+                ),
+                out_shardings=(sh, sh),
+            )()
             self._data_sharding = data_sharding(mesh)
         else:
+            caches = model.make_kv_caches(self.num_slots, cache_cfg.cache_dtype)
             self._data_sharding = None
         self.params = params
         self.caches = caches

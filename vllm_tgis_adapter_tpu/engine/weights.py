@@ -48,11 +48,16 @@ class CheckpointIndex:
         if not files:
             raise ValueError(f"no *.safetensors files found in {model_path}")
         self._by_name: dict[str, Path] = {}
+        # one open handle per shard (mmap-backed, cheap) — reopening per
+        # tensor would re-parse each multi-GB shard's header ~720 times
+        # for a 70B checkpoint
+        self._handles: dict[Path, object] = {}
         for file in files:
             # framework="flax" decodes bf16 natively (numpy cannot)
-            with safe_open(file, framework="flax") as f:
-                for name in f.keys():  # noqa: SIM118
-                    self._by_name[name] = file
+            f = safe_open(file, framework="flax")
+            self._handles[file] = f
+            for name in f.keys():  # noqa: SIM118
+                self._by_name[name] = file
         self._taken: set[str] = set()
 
     def __contains__(self, name: str) -> bool:
@@ -60,8 +65,7 @@ class CheckpointIndex:
 
     def pop(self, name: str) -> jax.Array:
         self._taken.add(name)
-        with safe_open(self._by_name[name], framework="flax") as f:
-            return f.get_tensor(name)
+        return self._handles[self._by_name[name]].get_tensor(name)
 
     def remaining(self) -> list[str]:
         return [n for n in self._by_name if n not in self._taken]
