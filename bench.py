@@ -105,6 +105,7 @@ def main() -> None:
         scheduler_config=SchedulerConfig(
             max_num_seqs=max_seqs,
             prefill_buckets=(prompt_len, max_len),
+            num_decode_steps=int(os.environ.get("BENCH_STEPS", 8)),
         ),
         parallel_config=ParallelConfig(),
         lora_config=LoRAConfig(),

@@ -1,0 +1,125 @@
+"""Multi-step (fused K-step) decode equivalence tests.
+
+The engine fuses K decode+sample steps into one device dispatch
+(engine/runner.py decode_steps).  These tests pin the invariant that K is
+purely a dispatch-granularity knob: token streams must be identical for
+any K, for greedy and for seeded sampling, and max_tokens must be exact.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from vllm_tgis_adapter_tpu.engine.sampling_params import SamplingParams
+
+
+@pytest.fixture(scope="module")
+def engine_for_steps(tiny_model_dir):
+    from vllm_tgis_adapter_tpu.engine.config import (
+        CacheConfig,
+        EngineConfig,
+        LoRAConfig,
+        ModelConfig,
+        ParallelConfig,
+        SchedulerConfig,
+    )
+    from vllm_tgis_adapter_tpu.engine.core import LLMEngine
+
+    def make(num_decode_steps: int):
+        model_config = ModelConfig.from_pretrained(
+            tiny_model_dir, dtype="float32"
+        )
+        config = EngineConfig(
+            model_config=model_config,
+            cache_config=CacheConfig(
+                block_size=16, num_blocks=64,
+                cache_dtype=model_config.dtype,
+            ),
+            scheduler_config=SchedulerConfig(
+                max_num_seqs=4,
+                prefill_buckets=(32, 64, 128),
+                num_decode_steps=num_decode_steps,
+            ),
+            parallel_config=ParallelConfig(),
+            lora_config=LoRAConfig(),
+        )
+        return LLMEngine.from_config(config)
+
+    return make
+
+
+def collect(engine, requests, max_steps=500):
+    for rid, prompt, params in requests:
+        engine.add_request(rid, prompt, params)
+    outputs = {}
+    for _ in range(max_steps):
+        if not engine.has_unfinished_requests():
+            break
+        for out in engine.step():
+            outputs[out.request_id] = out
+    assert not engine.has_unfinished_requests()
+    return outputs
+
+
+@pytest.mark.parametrize("k", [1, 3, 8])
+def test_greedy_stream_invariant_under_k(engine_for_steps, k):
+    """Same greedy tokens whatever the fused-step count."""
+    reqs = [
+        ("a", "the quick brown fox", SamplingParams(
+            temperature=0.0, max_tokens=13, ignore_eos=True)),
+        ("b", "hello world", SamplingParams(
+            temperature=0.0, max_tokens=6, ignore_eos=True)),
+    ]
+    ref = collect(engine_for_steps(1), reqs)
+    got = collect(engine_for_steps(k), reqs)
+    for rid in ("a", "b"):
+        assert got[rid].outputs[0].token_ids == ref[rid].outputs[0].token_ids
+        assert got[rid].outputs[0].text == ref[rid].outputs[0].text
+
+
+def test_seeded_sampling_invariant_under_k(engine_for_steps):
+    """Per-request PRNG folds on generation index, not dispatch shape —
+    a seeded stream replays exactly across K values."""
+    def reqs():
+        return [(
+            "s", "pack my box",
+            SamplingParams(temperature=0.9, top_k=8, seed=1234,
+                           max_tokens=12, ignore_eos=True),
+        )]
+
+    ref = collect(engine_for_steps(1), reqs())
+    got = collect(engine_for_steps(4), reqs())
+    assert got["s"].outputs[0].token_ids == ref["s"].outputs[0].token_ids
+
+
+def test_max_tokens_exact_and_no_overshoot(engine_for_steps):
+    """max_tokens not divisible by K must still yield exactly max_tokens."""
+    engine = engine_for_steps(8)
+    outs = collect(engine, [
+        ("x", "hello", SamplingParams(temperature=0.0, max_tokens=5,
+                                      ignore_eos=True)),
+        ("y", "world", SamplingParams(temperature=0.0, max_tokens=17,
+                                      ignore_eos=True)),
+    ])
+    assert len(outs["x"].outputs[0].token_ids) == 5
+    assert len(outs["y"].outputs[0].token_ids) == 17
+    assert outs["x"].outputs[0].finish_reason == "length"
+
+
+def test_delta_frames_per_token_under_k(engine_for_steps):
+    """DELTA mode still emits one output per generated token (TGIS stream
+    framing: 10 tokens → 10 engine outputs + server's input-details)."""
+    from vllm_tgis_adapter_tpu.engine.sampling_params import (
+        RequestOutputKind,
+    )
+
+    engine = engine_for_steps(4)
+    engine.add_request("d", "the quick", SamplingParams(
+        temperature=0.0, max_tokens=10, ignore_eos=True,
+        output_kind=RequestOutputKind.DELTA))
+    n_outputs = 0
+    for _ in range(200):
+        if not engine.has_unfinished_requests():
+            break
+        n_outputs += len(engine.step())
+    assert n_outputs == 10

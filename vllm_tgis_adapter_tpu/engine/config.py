@@ -152,6 +152,20 @@ class SchedulerConfig:
     # prompt lengths are padded up to one of these buckets to bound the
     # number of distinct compiled prefill shapes
     prefill_buckets: tuple[int, ...] = (32, 64, 128, 256, 512, 1024, 2048, 4096)
+    # decode steps fused into one device dispatch (lax.scan over the step
+    # axis): each dispatch samples up to this many tokens per sequence
+    # before control returns to the host, amortising dispatch latency and
+    # host work across K tokens.  Stop/EOS detection happens on the host
+    # afterwards, so up to K-1 speculatively decoded tokens per finished
+    # sequence are discarded — cheap next to the dispatch savings.
+    num_decode_steps: int = 8
+
+    def __post_init__(self):
+        if self.num_decode_steps < 1:
+            raise ValueError(
+                f"num_decode_steps must be >= 1 "
+                f"(got {self.num_decode_steps}); 1 disables multi-step decode"
+            )
 
 
 @dataclasses.dataclass(frozen=True)
@@ -215,6 +229,7 @@ class EngineConfig:
                     args.max_num_batched_tokens or max(2048, max_len)
                 ),
                 prefill_buckets=buckets,
+                num_decode_steps=args.num_scheduler_steps,
             ),
             parallel_config=ParallelConfig(
                 tensor_parallel_size=args.tensor_parallel_size or 1,

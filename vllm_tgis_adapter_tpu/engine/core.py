@@ -48,6 +48,7 @@ class LLMEngine:
             config.scheduler_config,
             config.cache_config,
             config.cache_config.num_blocks,
+            max_model_len=config.max_model_len,
         )
         self._seqs: dict[str, Sequence] = {}
 
@@ -168,7 +169,7 @@ class LLMEngine:
                 seq.prompt_logprobs = self._build_prompt_logprobs(
                     seq, prompt_info
                 )
-            outputs.extend(self._process_sampled([seq], [sampled]))
+            outputs.extend(self._process_sampled([seq], [[sampled]]))
         elif isinstance(plan, DecodePlan):
             sampled = self.runner.run_decode(plan)
             outputs.extend(self._process_sampled(plan.seqs, sampled))
@@ -177,29 +178,39 @@ class LLMEngine:
     # -------------------------------------------------------------- internal
 
     def _process_sampled(
-        self, seqs: list[Sequence], sampled: list[SampledToken]
+        self, seqs: list[Sequence], sampled: list[list[SampledToken]]
     ) -> list[RequestOutput]:
+        """Consume each row's sampled tokens (one per fused device step).
+
+        A row that finishes (EOS / stop string / length) mid-list simply
+        discards its remaining speculatively decoded tokens — their KV
+        writes targeted pages the sequence owned, which are freed with it.
+        """
         now = time.time()
         outputs = []
-        for seq, tok in zip(seqs, sampled):
+        for seq, toks in zip(seqs, sampled):
             if seq.is_finished:
                 continue  # aborted mid-step
-            seq.output_token_ids.append(tok.token_id)
-            if seq.metrics.first_token_time is None:
-                seq.metrics.first_token_time = now
-            seq.metrics.last_token_time = now
-            seq.detokenizer.append([tok.token_id])
-            if seq.output_logprobs is not None:
-                seq.output_logprobs.append(self._build_logprob_dict(seq, tok))
-            self._maybe_finish(seq, tok.token_id)
-            if seq.is_finished:
-                seq.metrics.finished_time = now
-                self.scheduler.finish(seq)
-                self._seqs.pop(seq.request_id, None)
-                outputs.append(seq.to_request_output())
-            elif seq.params.output_kind != RequestOutputKind.FINAL_ONLY:
-                # DELTA with an empty text delta still carries the token id
-                outputs.append(seq.to_request_output())
+            for tok in toks:
+                seq.output_token_ids.append(tok.token_id)
+                if seq.metrics.first_token_time is None:
+                    seq.metrics.first_token_time = now
+                seq.metrics.last_token_time = now
+                seq.detokenizer.append([tok.token_id])
+                if seq.output_logprobs is not None:
+                    seq.output_logprobs.append(
+                        self._build_logprob_dict(seq, tok)
+                    )
+                self._maybe_finish(seq, tok.token_id)
+                if seq.is_finished:
+                    seq.metrics.finished_time = now
+                    self.scheduler.finish(seq)
+                    self._seqs.pop(seq.request_id, None)
+                    outputs.append(seq.to_request_output())
+                    break
+                if seq.params.output_kind != RequestOutputKind.FINAL_ONLY:
+                    # DELTA with an empty text delta still carries the token
+                    outputs.append(seq.to_request_output())
         return outputs
 
     def _maybe_finish(self, seq: Sequence, token_id: int) -> None:

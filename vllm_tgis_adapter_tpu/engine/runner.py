@@ -49,6 +49,36 @@ class PromptLogprobInfo:
     topn_logprobs: list[list[float]]
 
 
+@dataclasses.dataclass
+class _HostSamplerOutput:
+    """Sampler results pulled to host as [K, B] numpy arrays."""
+
+    tokens: "np.ndarray"
+    logprobs: "np.ndarray"
+    ranks: "np.ndarray"
+    topn_ids: "np.ndarray"  # [K, B, W]
+    topn_logprobs: "np.ndarray"
+
+    @staticmethod
+    def from_device(outs) -> "_HostSamplerOutput":
+        return _HostSamplerOutput(
+            tokens=np.asarray(outs.tokens),
+            logprobs=np.asarray(outs.logprob),
+            ranks=np.asarray(outs.rank),
+            topn_ids=np.asarray(outs.topn_ids),
+            topn_logprobs=np.asarray(outs.topn_logprobs),
+        )
+
+    def token(self, k: int, i: int) -> "SampledToken":
+        return SampledToken(
+            token_id=int(self.tokens[k, i]),
+            logprob=float(self.logprobs[k, i]),
+            rank=int(self.ranks[k, i]),
+            topn_ids=self.topn_ids[k, i].tolist(),
+            topn_logprobs=self.topn_logprobs[k, i].tolist(),
+        )
+
+
 class ModelRunner:
     def __init__(self, config: "EngineConfig", model, params, mesh=None):
         self.config = config
@@ -101,13 +131,78 @@ class ModelRunner:
         # platforms don't implement donation and warn, so gate it
         donate = (1,) if jax.default_backend() == "tpu" else ()
         self._prefill_fn = jax.jit(model.prefill, donate_argnums=donate)
-        self._decode_fn = jax.jit(
-            model.decode, static_argnums=(7,), donate_argnums=donate
-        )
+        self._decode_fn = self._build_decode_fn()
 
         max_seqs = config.scheduler_config.max_num_seqs
         self.seen = self._put(jnp.zeros((max_seqs, mcfg.vocab_size), bool))
         self._rng = np.random.default_rng(config.seed)
+
+    def _build_decode_fn(self):
+        """Fused K-step decode+sample program (SURVEY.md §7 recompilation
+        discipline: one compiled program per batch-width bucket).
+
+        A ``lax.scan`` over the step axis runs the whole
+        decode → penalties → sample → feed-back loop on device, so the
+        host pays one dispatch and one [K, B] result transfer for K
+        tokens per sequence instead of K round-trips.  Per-step KV slots
+        are computed on device from the block tables; rows finish early
+        via the ``limits`` mask (their writes are dropped and their
+        sampled tokens discarded by the host).
+        """
+        model = self.model
+        block_size = self.block_size
+
+        def decode_steps(
+            params,
+            caches,
+            seen,  # [max_seqs, V] full seen-token matrix (carried)
+            tokens,  # [B] last sampled token per row
+            positions0,  # [B] position of that token
+            limits,  # [B] last position each row may run (mask after)
+            block_tables,  # [B, max_blocks]
+            context_lens0,  # [B] length including the current token
+            row_slots,  # [B] row index into ``seen``; -1 pads
+            tensors: SamplingTensors,
+            num_steps: int,  # static: steps fused into this dispatch
+        ):
+            b = tokens.shape[0]
+            rows = jnp.clip(row_slots, 0, None)
+            max_blocks = block_tables.shape[1]
+
+            def step(carry, k):
+                caches, seen, tokens = carry
+                pos = positions0 + k
+                active = (pos <= limits) & (row_slots >= 0)
+                blk = jnp.take_along_axis(
+                    block_tables,
+                    jnp.clip(pos // block_size, 0, max_blocks - 1)[:, None],
+                    axis=1,
+                )[:, 0]
+                slot = jnp.where(
+                    active, blk * block_size + pos % block_size, -1
+                )
+                logits, caches = model.decode(
+                    params, caches, tokens, pos, slot, block_tables,
+                    context_lens0 + k, block_size,
+                )
+                t_k = dataclasses.replace(
+                    tensors, gen_len=tensors.gen_len + k
+                )
+                seen_rows = jnp.take(seen, rows, axis=0)
+                out = sampler_mod.sample(logits, seen_rows, t_k)
+                seen = sampler_mod.update_seen(
+                    seen, jnp.where(active, row_slots, -1), out.tokens
+                )
+                return (caches, seen, out.tokens), out
+
+            (caches, seen, _), outs = jax.lax.scan(
+                step, (caches, seen, tokens), jnp.arange(num_steps)
+            )
+            return caches, seen, outs
+
+        donate = (1, 2) if jax.default_backend() == "tpu" else ()
+        return jax.jit(decode_steps, static_argnums=(10,),
+                       donate_argnums=donate)
 
     def _put(self, x) -> jax.Array:
         """Host array → device; replicated over the mesh when distributed
@@ -179,35 +274,61 @@ class ModelRunner:
 
     # ---------------------------------------------------------------- decode
 
-    def run_decode(self, plan: "DecodePlan") -> list[SampledToken]:
+    def run_decode(self, plan: "DecodePlan") -> list[list[SampledToken]]:
+        """One fused K-step dispatch; returns per-seq token lists.
+
+        Row i's list has ``plan.steps_per_seq[i]`` entries; the host-side
+        engine stops consuming a row's list at EOS/stop-string.
+        """
         seqs = plan.seqs
-        n, b = len(seqs), plan.batch_bucket
+        b = plan.batch_bucket
 
         token_ids = np.zeros(b, np.int32)
         positions = np.zeros(b, np.int32)
-        slot_mapping = np.full(b, -1, np.int32)
+        limits = np.full(b, -1, np.int32)
         context_lens = np.ones(b, np.int32)
         block_tables = np.zeros((b, self.max_blocks_per_seq), np.int32)
+        slots = np.full(b, -1, np.int32)
+        seeds = np.zeros(b, np.uint32)
         for i, seq in enumerate(seqs):
-            pos = seq.num_tokens - 1  # the last sampled token runs this step
+            pos = seq.num_tokens - 1  # the last sampled token runs first
             token_ids[i] = seq.all_token_ids[-1]
             positions[i] = pos
-            slot_mapping[i] = seq.blocks.slot_for(pos)
+            limits[i] = pos + plan.steps_per_seq[i] - 1
             context_lens[i] = seq.num_tokens
             blocks = seq.blocks.blocks
             block_tables[i, : len(blocks)] = blocks
+            slots[i] = seq.slot
+            seeds[i] = seq.fallback_seed
 
-        logits, self.caches = self._decode_fn(
+        params_list = [s.params for s in seqs] + [None] * (b - len(seqs))
+        gen_lens = [s.num_output_tokens for s in seqs] + [0] * (b - len(seqs))
+        tensors = SamplingTensors.from_params(
+            params_list,
+            eos_token_id=self.config.model_config.eos_token_id,
+            gen_lens=gen_lens,
+            fallback_seeds=seeds,
+        )
+
+        self.caches, self.seen, outs = self._decode_fn(
             self.params,
             self.caches,
+            self.seen,
             self._put(token_ids),
             self._put(positions),
-            self._put(slot_mapping),
+            self._put(limits),
             self._put(block_tables),
             self._put(context_lens),
-            self.block_size,
+            self._put(slots),
+            jax.tree.map(self._put, tensors),
+            plan.num_steps,
         )
-        return self._sample(logits, seqs)
+
+        host = _HostSamplerOutput.from_device(outs)  # [K, B] arrays
+        return [
+            [host.token(k, i) for k in range(plan.steps_per_seq[i])]
+            for i in range(len(seqs))
+        ]
 
     # --------------------------------------------------------------- sampler
 
@@ -236,18 +357,7 @@ class ModelRunner:
             self.seen, jnp.asarray(slots), out.tokens
         )
 
-        tokens = np.asarray(out.tokens)
-        logprobs = np.asarray(out.logprob)
-        ranks = np.asarray(out.rank)
-        tn_ids = np.asarray(out.topn_ids)
-        tn_lp = np.asarray(out.topn_logprobs)
-        return [
-            SampledToken(
-                token_id=int(tokens[i]),
-                logprob=float(logprobs[i]),
-                rank=int(ranks[i]),
-                topn_ids=tn_ids[i].tolist(),
-                topn_logprobs=tn_lp[i].tolist(),
-            )
-            for i in range(len(seqs))
-        ]
+        host = _HostSamplerOutput.from_device(
+            jax.tree.map(lambda x: x[None], out)  # add a unit step axis
+        )
+        return [host.token(0, i) for i in range(len(seqs))]

@@ -41,6 +41,11 @@ class PrefillPlan:
 class DecodePlan:
     seqs: list[Sequence]  # active rows, in slot order
     batch_bucket: int  # padded batch width
+    # multi-step decode: the device runs ``num_steps`` fused steps; row i
+    # is live for its first ``steps_per_seq[i]`` of them (bounded by its
+    # max_tokens remainder and the model-length headroom), masked after
+    num_steps: int = 1
+    steps_per_seq: list[int] = dataclasses.field(default_factory=list)
 
 
 class Scheduler:
@@ -49,9 +54,11 @@ class Scheduler:
         scheduler_config: SchedulerConfig,
         cache_config: CacheConfig,
         num_blocks: int,
+        max_model_len: int = 1 << 30,
     ):
         self.config = scheduler_config
         self.block_size = cache_config.block_size
+        self.max_model_len = max_model_len
         self.allocator = BlockAllocator(num_blocks, cache_config.block_size)
         self.waiting: deque[Sequence] = deque()
         self.running: list[Sequence] = []
@@ -157,29 +164,53 @@ class Scheduler:
             slots=seq.blocks.slots_for_range(0, len(token_ids)),
         )
 
+    def _allowed_steps(self, seq: Sequence) -> int:
+        """Device steps row ``seq`` may run this dispatch (≥1)."""
+        k = self.config.num_decode_steps
+        if seq.params.max_tokens is not None:
+            k = min(k, seq.params.max_tokens - seq.num_output_tokens)
+        k = min(k, self.max_model_len - seq.num_tokens)
+        return max(1, k)
+
     def _schedule_decode(self) -> Optional[DecodePlan]:
         if not self.running:
             return None
-        # grow each sequence's page list for the token this step will write;
-        # preempt youngest sequences if the pool runs dry.  Iterate over a
-        # snapshot but re-check membership: a preemption earlier in this
-        # loop may have evicted a later element (blocks == None).
+        # grow each sequence's page list for every token this dispatch may
+        # write (positions num_tokens-1 … num_tokens-2+allowed); preempt
+        # youngest sequences if the pool runs dry.  Iterate over a snapshot
+        # but re-check membership: a preemption earlier in this loop may
+        # have evicted a later element (blocks == None).
+        planned: dict[int, int] = {}
         for seq in sorted(self.running, key=lambda s: s.metrics.arrival_time):
             if seq not in self.running:
                 continue  # preempted earlier in this same pass
+            k = self._allowed_steps(seq)
             while True:
                 try:
-                    seq.blocks.ensure_capacity(seq.num_tokens)
+                    seq.blocks.ensure_capacity(seq.num_tokens - 1 + k)
                     break
                 except RuntimeError:
+                    if k > 1:
+                        # pool is tight: shrink this row's fused-step run
+                        # before resorting to preemption
+                        k = k // 2
+                        continue
                     if not self._preempt_youngest(exclude=seq):
                         raise RuntimeError(
                             "KV cache too small for a single sequence"
                         ) from None
+            planned[id(seq)] = k
         if not self.running:
             return None
         seqs = sorted(self.running, key=lambda s: s.slot)
-        return DecodePlan(seqs=seqs, batch_bucket=self._batch_bucket(len(seqs)))
+        return DecodePlan(
+            seqs=seqs,
+            batch_bucket=self._batch_bucket(len(seqs)),
+            # fixed step count per dispatch keeps one compiled program per
+            # batch bucket; rows with fewer planned steps are masked
+            num_steps=self.config.num_decode_steps,
+            steps_per_seq=[planned[id(s)] for s in seqs],
+        )
 
     def _batch_bucket(self, n: int) -> int:
         for b in self.batch_buckets:

@@ -174,6 +174,10 @@ def add_engine_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     g.add_argument("--max-num-batched-tokens", type=int, default=None,
                    help="cap on tokens processed per engine step (prefill "
                         "chunking budget)")
+    g.add_argument("--num-scheduler-steps", type=int, default=8,
+                   help="decode steps fused into one device dispatch "
+                        "(tokens sampled per sequence between host "
+                        "round-trips); 1 disables multi-step decode")
     g.add_argument("--block-size", type=int, default=16,
                    help="KV-cache page size in tokens")
     g.add_argument("--hbm-memory-utilization", "--gpu-memory-utilization",
