@@ -19,10 +19,43 @@ Layout choices (TPU-first):
 
 from __future__ import annotations
 
+import functools
+import os
+
 import jax
 import jax.numpy as jnp
+from jax import shard_map
 
 NEG_INF = float("-inf")
+
+# ATTENTION_BACKEND=pallas|xla|auto (auto: Pallas kernels on TPU, XLA
+# fallbacks elsewhere; pallas on a non-TPU backend runs the kernels in
+# interpreter mode — slow, tests only)
+_BACKEND_ENV = "ATTENTION_BACKEND"
+
+# pallas_call is an opaque custom call the GSPMD partitioner cannot split,
+# so under a TP mesh the kernels must be wrapped in shard_map over the
+# head-sharded axis.  The runner registers its mesh here at boot
+# (engine/runner.py); None means single-device dispatch.
+_ACTIVE_MESH = None
+
+
+def set_active_mesh(mesh) -> None:
+    global _ACTIVE_MESH
+    _ACTIVE_MESH = mesh
+
+
+def _use_pallas() -> bool:
+    mode = os.environ.get(_BACKEND_ENV, "auto")
+    if mode == "xla":
+        return False
+    if mode == "pallas":
+        return True
+    return jax.default_backend() == "tpu"
+
+
+def _pallas_interpret() -> bool:
+    return jax.default_backend() != "tpu"
 
 
 def write_kv(
@@ -47,6 +80,47 @@ def write_kv(
 
 
 def prefill_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    scale: float,
+    valid_len: jax.Array | None = None,
+) -> jax.Array:
+    """Dispatch: flash Pallas kernel on TPU, XLA fallback elsewhere.
+
+    Under a TP mesh the kernel runs inside shard_map over the head axis
+    (each shard attends with its local query/kv heads; GQA grouping is
+    preserved because tp divides both H and Hkv, parallel/sharding.py).
+    """
+    if _use_pallas():
+        from vllm_tgis_adapter_tpu.ops import pallas_attention
+
+        vl = (
+            jnp.asarray(q.shape[0], jnp.int32)
+            if valid_len is None
+            else valid_len
+        )
+        kernel = functools.partial(
+            pallas_attention.prefill_attention,
+            scale=scale,
+            interpret=_pallas_interpret(),
+        )
+        if _ACTIVE_MESH is not None:
+            from jax.sharding import PartitionSpec as P
+
+            heads = P(None, "tp", None)
+            return shard_map(
+                lambda q, k, v, vl: kernel(q, k, v, valid_len=vl),
+                mesh=_ACTIVE_MESH,
+                in_specs=(heads, heads, heads, P()),
+                out_specs=heads,
+                check_vma=False,
+            )(q, k, v, vl)
+        return kernel(q, k, v, valid_len=vl)
+    return prefill_attention_xla(q, k, v, scale, valid_len)
+
+
+def prefill_attention_xla(
     q: jax.Array,  # [T, H, Dh]
     k: jax.Array,  # [T, Hkv, Dh]
     v: jax.Array,  # [T, Hkv, Dh]
@@ -81,6 +155,46 @@ def prefill_attention(
 
 
 def paged_decode_attention(
+    q: jax.Array,
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    block_tables: jax.Array,
+    context_lens: jax.Array,
+    block_size: int,
+    scale: float,
+) -> jax.Array:
+    """Dispatch: flash Pallas kernel on TPU, XLA fallback elsewhere.
+
+    Under a TP mesh the kernel runs inside shard_map: the cache is
+    head-sharded on tp, so each shard's kernel reads only its local pages.
+    """
+    if _use_pallas():
+        from vllm_tgis_adapter_tpu.ops import pallas_attention
+
+        kernel = functools.partial(
+            pallas_attention.paged_decode_attention,
+            block_size=block_size,
+            scale=scale,
+            interpret=_pallas_interpret(),
+        )
+        if _ACTIVE_MESH is not None:
+            from jax.sharding import PartitionSpec as P
+
+            heads = P(None, "tp", None)
+            return shard_map(
+                kernel,
+                mesh=_ACTIVE_MESH,
+                in_specs=(heads, heads, heads, P(), P()),
+                out_specs=heads,
+                check_vma=False,
+            )(q, k_cache, v_cache, block_tables, context_lens)
+        return kernel(q, k_cache, v_cache, block_tables, context_lens)
+    return paged_decode_attention_xla(
+        q, k_cache, v_cache, block_tables, context_lens, block_size, scale
+    )
+
+
+def paged_decode_attention_xla(
     q: jax.Array,  # [B, H, Dh]
     k_cache: jax.Array,  # [num_slots, Hkv, Dh]
     v_cache: jax.Array,

@@ -1,0 +1,302 @@
+"""Pallas TPU kernels: paged decode attention + flash causal prefill.
+
+Native tier of the attention stack (SURVEY.md §2.2: the reference serves
+through vLLM's CUDA paged-attention kernels; these are the TPU-first
+equivalents).  Both kernels stream K/V through VMEM with an online-softmax
+accumulator, so HBM traffic is one read of the live context in cache
+dtype — unlike the XLA fallbacks in ops/attention.py, which materialise
+float32 ``[B, S, Hkv, Dh]`` gathers (decode) or ``[Hkv, g, T, T]`` score
+tensors (prefill).
+
+Decode kernel layout: grid ``(batch, kv_head, page)``; the page axis is
+innermost so the per-(seq, head) accumulator lives in VMEM scratch across
+page steps.  Block tables are scalar-prefetched and drive the K/V page
+BlockSpec index maps directly — the pipeline DMAs exactly the pages the
+block table names, i.e. the gather happens in the memory system, not in
+registers.
+
+Numerics: f32 accumulation (MXU-friendly: bf16 in, f32 out), identical
+masking semantics to the XLA reference; parity is pinned by
+tests/test_pallas_attention.py in interpreter mode on CPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = float("-inf")
+
+
+# --------------------------------------------------------------------- decode
+
+
+def _decode_kernel(
+    # scalar prefetch
+    block_tables_ref,  # [B, max_blocks] SMEM
+    context_lens_ref,  # [B] SMEM
+    # blocks
+    q_ref,  # [1, 1, G, Dh] VMEM (G = q_per_kv)
+    k_ref,  # [block_size, 1, Dh] VMEM — page picked by index_map
+    v_ref,  # [block_size, 1, Dh] VMEM
+    o_ref,  # [1, 1, G, Dh] VMEM
+    # scratch
+    m_ref,  # [G, 1] f32 running max
+    l_ref,  # [G, 1] f32 running denominator
+    acc_ref,  # [G, Dh] f32 running numerator
+    *,
+    scale: float,
+    block_size: int,
+):
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    last = pl.num_programs(2) - 1
+    ctx = context_lens_ref[b]
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    @pl.when(j * block_size < ctx)
+    def _page():
+        q = q_ref[0, 0].astype(jnp.float32)  # [G, Dh]
+        k = k_ref[:, 0].astype(jnp.float32)  # [bs, Dh]
+        v = v_ref[:, 0].astype(jnp.float32)  # [bs, Dh]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [G, bs]
+        pos = j * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, dimension=1
+        )
+        s = jnp.where(pos < ctx, s, NEG_INF)
+
+        m_prev = m_ref[...]  # [G, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)  # [G, bs]
+        alpha = jnp.exp(m_prev - m_new)  # [G, 1]
+        l_ref[...] = alpha * l_ref[...] + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = alpha * acc_ref[...] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[...] = m_new
+
+    @pl.when(j == last)
+    def _finalize():
+        # rows with zero context cannot occur for live sequences (the
+        # runner masks dead rows host-side); guard the divide anyway
+        denom = jnp.maximum(l_ref[...], 1e-30)
+        o_ref[0, 0] = (acc_ref[...] / denom).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block_size", "scale", "interpret")
+)
+def paged_decode_attention(
+    q: jax.Array,  # [B, H, Dh]
+    k_cache: jax.Array,  # [num_slots, Hkv, Dh]
+    v_cache: jax.Array,
+    block_tables: jax.Array,  # [B, max_blocks] int32 page ids
+    context_lens: jax.Array,  # [B] int32 incl. current token
+    block_size: int,
+    scale: float,
+    *,
+    interpret: bool = False,
+) -> jax.Array:
+    """Flash-style paged decode attention, one query token per sequence."""
+    b, num_heads, head_dim = q.shape
+    num_kv = k_cache.shape[1]
+    g = num_heads // num_kv
+    max_blocks = block_tables.shape[1]
+
+    qg = q.reshape(b, num_kv, g, head_dim)
+    # invalid/padding pages (id <= 0 beyond context) clamp to page 0; the
+    # in-kernel length mask discards their scores
+    safe_tables = jnp.clip(block_tables, 0, k_cache.shape[0] // block_size - 1)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, num_kv, max_blocks),
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, g, head_dim),
+                lambda i, h, j, bt, cl: (i, h, 0, 0),
+            ),
+            pl.BlockSpec(
+                (block_size, 1, head_dim),
+                lambda i, h, j, bt, cl: (bt[i, j], h, 0),
+            ),
+            pl.BlockSpec(
+                (block_size, 1, head_dim),
+                lambda i, h, j, bt, cl: (bt[i, j], h, 0),
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, g, head_dim),
+            lambda i, h, j, bt, cl: (i, h, 0, 0),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((g, 1), jnp.float32),
+            pltpu.VMEM((g, 1), jnp.float32),
+            pltpu.VMEM((g, head_dim), jnp.float32),
+        ],
+    )
+    # K/V pages are indexed in units of the block shape: page p starts at
+    # slot p*block_size, which is block-row p of a (block_size, 1, Dh) grid
+    out = pl.pallas_call(
+        functools.partial(
+            _decode_kernel, scale=scale, block_size=block_size
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, num_kv, g, head_dim), q.dtype),
+        interpret=interpret,
+    )(safe_tables, context_lens, qg, k_cache, v_cache)
+    return out.reshape(b, num_heads, head_dim)
+
+
+# -------------------------------------------------------------------- prefill
+
+
+def _prefill_kernel(
+    valid_len_ref,  # [1] SMEM scalar prefetch
+    q_ref,  # [1, bq, Dh]
+    k_ref,  # [1, bk, Dh] (kv head h, key block j)
+    v_ref,  # [1, bk, Dh]
+    o_ref,  # [1, bq, Dh]
+    m_ref,  # [bq, 1]
+    l_ref,  # [bq, 1]
+    acc_ref,  # [bq, Dh]
+    *,
+    scale: float,
+    block_q: int,
+    block_k: int,
+):
+    i = pl.program_id(1)  # query block
+    j = pl.program_id(2)  # key block
+    last = pl.num_programs(2) - 1
+    valid = valid_len_ref[0]
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    # causal: skip key blocks fully beyond this query block; valid_len is
+    # scalar-prefetched, so blocks entirely in the padding region (every
+    # score masked anyway) are skipped for free too
+    @pl.when(
+        (j * block_k <= i * block_q + block_q - 1) & (j * block_k < valid)
+    )
+    def _block():
+        q = q_ref[0].astype(jnp.float32)  # [bq, Dh]
+        k = k_ref[0].astype(jnp.float32)  # [bk, Dh]
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [bq, bk]
+        rows = i * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, dimension=0
+        )
+        cols = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, dimension=1
+        )
+        s = jnp.where((cols <= rows) & (cols < valid), s, NEG_INF)
+
+        m_prev = m_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        # fully masked rows keep m == -inf; exp(-inf - -inf) is nan — pin
+        # the shift to a finite value for those rows
+        shift = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(s - shift)
+        alpha = jnp.exp(jnp.where(jnp.isfinite(m_prev), m_prev, shift) - shift)
+        l_ref[...] = alpha * l_ref[...] + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = alpha * acc_ref[...] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[...] = m_new
+
+    @pl.when(j == last)
+    def _finalize():
+        denom = jnp.maximum(l_ref[...], 1e-30)
+        o_ref[0] = (acc_ref[...] / denom).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("scale", "block_q", "block_k", "interpret"),
+)
+def prefill_attention(
+    q: jax.Array,  # [T, H, Dh]
+    k: jax.Array,  # [T, Hkv, Dh]
+    v: jax.Array,
+    scale: float,
+    valid_len: jax.Array,  # scalar int32
+    *,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+) -> jax.Array:
+    """Flash causal self-attention over one padded prompt bucket.
+
+    GQA is handled by repeating K/V heads logically: the grid runs over
+    *query* heads and the K/V BlockSpec maps query head → kv head, so no
+    repeated K/V materialisation in HBM.
+    """
+    t, num_heads, head_dim = q.shape
+    num_kv = k.shape[1]
+    g = num_heads // num_kv
+    block_q = min(block_q, t)
+    block_k = min(block_k, t)
+    nq = pl.cdiv(t, block_q)
+    nk = pl.cdiv(t, block_k)
+
+    qh = jnp.swapaxes(q, 0, 1)  # [H, T, Dh]
+    kh = jnp.swapaxes(k, 0, 1)  # [Hkv, T, Dh]
+    vh = jnp.swapaxes(v, 0, 1)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(num_heads, nq, nk),
+        in_specs=[
+            pl.BlockSpec(
+                (1, block_q, head_dim),
+                lambda h, i, j, vl: (h, i, 0),
+            ),
+            pl.BlockSpec(
+                (1, block_k, head_dim),
+                lambda h, i, j, vl: (h // g, j, 0),
+            ),
+            pl.BlockSpec(
+                (1, block_k, head_dim),
+                lambda h, i, j, vl: (h // g, j, 0),
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, block_q, head_dim),
+            lambda h, i, j, vl: (h, i, 0),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, head_dim), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _prefill_kernel, scale=scale, block_q=block_q, block_k=block_k
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((num_heads, t, head_dim), q.dtype),
+        interpret=interpret,
+    )(jnp.asarray([valid_len], jnp.int32), qh, kh, vh)
+    return jnp.swapaxes(out, 0, 1)
