@@ -1,0 +1,112 @@
+"""Hub tooling tests.
+
+Conversion and index rewriting are fully offline-testable (synthetic
+torch checkpoints); live-download paths are marked ``hf_data`` and
+deselected by default, mirroring the reference's test gating.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import pytest
+import torch
+
+from vllm_tgis_adapter_tpu.tgis_utils import hub
+
+
+def make_bin_checkpoint(path: Path, shared: bool = False) -> dict:
+    tensors = {
+        "model.embed.weight": torch.randn(8, 4),
+        "model.layer.0.w": torch.randn(4, 4),
+        "model.layer.0.b": torch.zeros(4),
+    }
+    if shared:
+        tensors["tied.lm_head.weight"] = tensors["model.embed.weight"]
+    torch.save(tensors, path)
+    return tensors
+
+
+def test_convert_file_bit_exact(tmp_path):
+    pt = tmp_path / "pytorch_model.bin"
+    tensors = make_bin_checkpoint(pt)
+    sf = tmp_path / "model.safetensors"
+    hub.convert_file(pt, sf)
+
+    from safetensors.torch import load_file
+
+    reloaded = load_file(str(sf))
+    assert set(reloaded) == set(tensors)
+    for name, tensor in tensors.items():
+        assert torch.equal(tensor, reloaded[name])
+
+
+def test_convert_file_dedups_shared_tensors(tmp_path):
+    pt = tmp_path / "pytorch_model.bin"
+    make_bin_checkpoint(pt, shared=True)
+    sf = tmp_path / "model.safetensors"
+    hub.convert_file(pt, sf)
+
+    from safetensors.torch import load_file
+
+    reloaded = load_file(str(sf))
+    # the alias set keeps exactly one name per storage
+    assert "model.embed.weight" in reloaded
+    assert "tied.lm_head.weight" not in reloaded
+
+
+def test_convert_files_skips_existing(tmp_path, caplog):
+    pt = tmp_path / "a.bin"
+    make_bin_checkpoint(pt)
+    sf = tmp_path / "a.safetensors"
+    hub.convert_files([pt], [sf])
+    mtime = sf.stat().st_mtime_ns
+    hub.convert_files([pt], [sf])  # second run must skip
+    assert sf.stat().st_mtime_ns == mtime
+
+
+def test_convert_index_file(tmp_path):
+    source = tmp_path / "pytorch_model.bin.index.json"
+    index = {
+        "metadata": {"total_size": 123},
+        "weight_map": {
+            "w1": "pytorch_model-00001-of-00002.bin",
+            "w2": "pytorch_model-00002-of-00002.bin",
+        },
+    }
+    source.write_text(json.dumps(index))
+    pt_files = [tmp_path / "pytorch_model-00001-of-00002.bin",
+                tmp_path / "pytorch_model-00002-of-00002.bin"]
+    sf_files = [p.with_suffix(".safetensors") for p in pt_files]
+    dest = tmp_path / "model.safetensors.index.json"
+    hub.convert_index_file(source, dest, pt_files, sf_files)
+    converted = json.loads(dest.read_text())
+    assert converted["weight_map"]["w1"].endswith("00001-of-00002.safetensors")
+    assert converted["metadata"]["total_size"] == 123
+
+
+def test_get_model_path_local_dir(tmp_path):
+    assert hub.get_model_path(str(tmp_path)) == str(tmp_path)
+
+
+def test_cli_parser_and_offline_convert(tmp_path, monkeypatch):
+    """model-util convert-to-safetensors over a monkeypatched local cache."""
+    from vllm_tgis_adapter_tpu.tgis_utils import scripts
+
+    pt = tmp_path / "pytorch_model.bin"
+    make_bin_checkpoint(pt)
+    monkeypatch.setattr(hub, "weight_files",
+                        lambda name, revision=None, extension=".bin": [pt])
+    scripts.cli(["convert-to-safetensors", "fake/model"])
+    assert (tmp_path / "pytorch_model.safetensors").exists()
+
+
+def test_convert_fast_tokenizer_roundtrip(tmp_path, tiny_model_dir):
+    hub.convert_to_fast_tokenizer(tiny_model_dir, str(tmp_path / "tok"))
+    assert (tmp_path / "tok" / "tokenizer.json").exists()
+
+
+@pytest.mark.hf_data
+def test_download_weights_live():
+    hub.download_weights("bigscience/bloom-560m", extension=".safetensors")

@@ -43,7 +43,7 @@ def test_paged_decode_matches_reference(seed, g):
         seed, b, num_kv, g, head_dim, block_size, max_blocks, num_slots=512
     )
     scale = head_dim**-0.5
-    ref = ref_ops.paged_decode_attention(
+    ref = ref_ops.paged_decode_attention_xla(
         jnp.asarray(q), jnp.asarray(k_cache), jnp.asarray(v_cache),
         jnp.asarray(bt), jnp.asarray(cl), block_size, scale,
     )
@@ -68,7 +68,7 @@ def test_paged_decode_short_context_ignores_garbage_pages():
     bt_garbage[0, 1:] = 999999  # ids far out of range
     bt_garbage[1, 2:] = -1
     scale = head_dim**-0.5
-    ref = ref_ops.paged_decode_attention(
+    ref = ref_ops.paged_decode_attention_xla(
         jnp.asarray(q), jnp.asarray(k_cache), jnp.asarray(v_cache),
         jnp.asarray(bt), jnp.asarray(cl), block_size, scale,
     )
@@ -91,7 +91,7 @@ def test_flash_prefill_matches_reference(t, valid, g):
     k = rng.standard_normal((t, num_kv, head_dim), dtype=np.float32)
     v = rng.standard_normal((t, num_kv, head_dim), dtype=np.float32)
     scale = head_dim**-0.5
-    ref = ref_ops.prefill_attention(
+    ref = ref_ops.prefill_attention_xla(
         jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), scale,
         jnp.asarray(valid),
     )
@@ -115,7 +115,7 @@ def test_flash_prefill_bf16():
     k = jnp.asarray(rng.standard_normal((t, num_kv, head_dim)), jnp.bfloat16)
     v = jnp.asarray(rng.standard_normal((t, num_kv, head_dim)), jnp.bfloat16)
     scale = head_dim**-0.5
-    ref = ref_ops.prefill_attention(q, k, v, scale, jnp.asarray(t))
+    ref = ref_ops.prefill_attention_xla(q, k, v, scale, jnp.asarray(t))
     got = pk.prefill_attention(q, k, v, scale, jnp.asarray(t, jnp.int32),
                                interpret=True)
     np.testing.assert_allclose(
@@ -178,11 +178,10 @@ def test_pallas_kernels_under_tp_mesh(monkeypatch):
     )
 
     mesh = build_mesh(tensor_parallel_size=4)
-    attn.set_active_mesh(mesh)
-    try:
+    if True:
         got = attn.paged_decode_attention(
             jnp.asarray(q), jnp.asarray(k_cache), jnp.asarray(v_cache),
-            jnp.asarray(bt), jnp.asarray(cl), block_size, scale,
+            jnp.asarray(bt), jnp.asarray(cl), block_size, scale, mesh=mesh,
         )
         # prefill too
         t, valid = 128, 100
@@ -196,10 +195,8 @@ def test_pallas_kernels_under_tp_mesh(monkeypatch):
         )
         got_p = attn.prefill_attention(
             jnp.asarray(qp), jnp.asarray(kp), jnp.asarray(vp), scale,
-            jnp.asarray(valid, jnp.int32),
+            jnp.asarray(valid, jnp.int32), mesh=mesh,
         )
-    finally:
-        attn.set_active_mesh(None)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
     np.testing.assert_allclose(np.asarray(got_p)[:valid],

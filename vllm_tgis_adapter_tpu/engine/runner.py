@@ -126,12 +126,10 @@ class ModelRunner:
             self._data_sharding = None
         self.params = params
         self.caches = caches
-        # pallas kernels must be shard_map-wrapped under a TP mesh
-        # (ops/attention.py dispatch); clear/register unconditionally so a
-        # fresh single-device engine never inherits a stale mesh
-        from vllm_tgis_adapter_tpu.ops import attention as attn_ops
-
-        attn_ops.set_active_mesh(mesh)
+        # pallas kernels must be shard_map-wrapped under a TP mesh; the
+        # mesh travels on the model so each engine's retraces see its own
+        # (ops/attention.py dispatch)
+        model.mesh = mesh
 
         # buffer donation lets XLA update the KV cache in place; host
         # platforms don't implement donation and warn, so gate it

@@ -64,8 +64,11 @@ def apply_rotary(
 
 
 class LlamaForCausalLM:
-    def __init__(self, config: "ModelConfig"):
+    def __init__(self, config: "ModelConfig", mesh=None):
         self.config = config
+        # TP mesh for shard_map-wrapped Pallas attention (ops/attention.py);
+        # set by the runner at boot, None on a single device
+        self.mesh = mesh
 
     # ---------------------------------------------------------------- params
 
@@ -198,7 +201,8 @@ class LlamaForCausalLM:
             v_cache = v_cache.at[i, safe_slots].set(
                 v.astype(v_cache.dtype), mode="drop"
             )
-            o = attn_ops.prefill_attention(q, k, v, scale, valid_len)
+            o = attn_ops.prefill_attention(q, k, v, scale, valid_len,
+                                           mesh=self.mesh)
             o = o.reshape(x.shape[0], -1) @ layer["wo"]
             x = x + cfg.residual_multiplier * o
 
@@ -242,7 +246,7 @@ class LlamaForCausalLM:
             )
             o = attn_ops.paged_decode_attention(
                 q, k_cache[i], v_cache[i], block_tables, context_lens,
-                block_size, scale,
+                block_size, scale, mesh=self.mesh,
             )
             o = o.reshape(x.shape[0], -1) @ layer["wo"]
             x = x + cfg.residual_multiplier * o

@@ -34,15 +34,10 @@ NEG_INF = float("-inf")
 _BACKEND_ENV = "ATTENTION_BACKEND"
 
 # pallas_call is an opaque custom call the GSPMD partitioner cannot split,
-# so under a TP mesh the kernels must be wrapped in shard_map over the
-# head-sharded axis.  The runner registers its mesh here at boot
-# (engine/runner.py); None means single-device dispatch.
-_ACTIVE_MESH = None
-
-
-def set_active_mesh(mesh) -> None:
-    global _ACTIVE_MESH
-    _ACTIVE_MESH = mesh
+# so under a TP mesh the kernels are wrapped in shard_map over the
+# head-sharded axis.  The mesh travels explicitly on the call path
+# (model -> dispatch), never via process state: two engines with
+# different meshes in one process must not affect each other's retraces.
 
 
 def _use_pallas() -> bool:
@@ -85,6 +80,7 @@ def prefill_attention(
     v: jax.Array,
     scale: float,
     valid_len: jax.Array | None = None,
+    mesh=None,
 ) -> jax.Array:
     """Dispatch: flash Pallas kernel on TPU, XLA fallback elsewhere.
 
@@ -105,13 +101,13 @@ def prefill_attention(
             scale=scale,
             interpret=_pallas_interpret(),
         )
-        if _ACTIVE_MESH is not None:
+        if mesh is not None:
             from jax.sharding import PartitionSpec as P
 
             heads = P(None, "tp", None)
             return shard_map(
                 lambda q, k, v, vl: kernel(q, k, v, valid_len=vl),
-                mesh=_ACTIVE_MESH,
+                mesh=mesh,
                 in_specs=(heads, heads, heads, P()),
                 out_specs=heads,
                 check_vma=False,
@@ -162,6 +158,7 @@ def paged_decode_attention(
     context_lens: jax.Array,
     block_size: int,
     scale: float,
+    mesh=None,
 ) -> jax.Array:
     """Dispatch: flash Pallas kernel on TPU, XLA fallback elsewhere.
 
@@ -177,13 +174,13 @@ def paged_decode_attention(
             scale=scale,
             interpret=_pallas_interpret(),
         )
-        if _ACTIVE_MESH is not None:
+        if mesh is not None:
             from jax.sharding import PartitionSpec as P
 
             heads = P(None, "tp", None)
             return shard_map(
                 kernel,
-                mesh=_ACTIVE_MESH,
+                mesh=mesh,
                 in_specs=(heads, heads, heads, P(), P()),
                 out_specs=heads,
                 check_vma=False,

@@ -121,6 +121,14 @@ def paged_decode_attention(
     # in-kernel length mask discards their scores
     safe_tables = jnp.clip(block_tables, 0, k_cache.shape[0] // block_size - 1)
 
+    def page_index(i, j, bt, cl):
+        # page steps beyond the live context re-map to the last live page:
+        # Pallas elides the DMA when consecutive grid steps hit the same
+        # block, so HBM traffic stops at the context boundary instead of
+        # scaling with max_blocks (the pl.when only skips compute)
+        last_live = jnp.maximum(cl[i] - 1, 0) // block_size
+        return bt[i, jnp.minimum(j, last_live)]
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b, num_kv, max_blocks),
@@ -131,11 +139,11 @@ def paged_decode_attention(
             ),
             pl.BlockSpec(
                 (block_size, 1, head_dim),
-                lambda i, h, j, bt, cl: (bt[i, j], h, 0),
+                lambda i, h, j, bt, cl: (page_index(i, j, bt, cl), h, 0),
             ),
             pl.BlockSpec(
                 (block_size, 1, head_dim),
-                lambda i, h, j, bt, cl: (bt[i, j], h, 0),
+                lambda i, h, j, bt, cl: (page_index(i, j, bt, cl), h, 0),
             ),
         ],
         out_specs=pl.BlockSpec(

@@ -1,0 +1,205 @@
+"""Model hub utilities: weight download, safetensors conversion, tokenizer.
+
+Capability match for the reference's offline model tooling (SURVEY.md §2
+component #14: list/download HF safetensors, resolve the local cache,
+convert legacy ``.bin`` checkpoints to safetensors with bit-exact
+verification, convert index files, create a fast tokenizer; reference
+surface: tgis_utils/hub.py:69-221).  Implementation is our own; torch is
+used only for reading legacy pickle checkpoints — the serving path loads
+safetensors straight into JAX (engine/weights.py).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import json
+import os
+import time
+from pathlib import Path
+
+from vllm_tgis_adapter_tpu.logging import init_logger
+
+logger = init_logger(__name__)
+
+WEIGHTS_CACHE_OVERRIDE = os.getenv("WEIGHTS_CACHE_OVERRIDE", None)
+
+
+def _hub():
+    import huggingface_hub
+
+    return huggingface_hub
+
+
+def weight_hub_files(
+    model_name: str,
+    revision: str | None = None,
+    extension: str = ".safetensors",
+) -> list[str]:
+    """File names with ``extension`` available on the hub for the model."""
+    api = _hub().HfApi()
+    info = api.model_info(model_name, revision=revision)
+    return [
+        s.rfilename
+        for s in info.siblings
+        if s.rfilename.endswith(extension)
+        # skip non-weight safetensors (e.g. consolidated duplicates are
+        # still wanted; only filter obvious non-tensor files)
+    ]
+
+
+def weight_files(
+    model_name: str,
+    revision: str | None = None,
+    extension: str = ".safetensors",
+) -> list[Path]:
+    """Local paths of cached weight files; raises if any are missing."""
+    filenames = weight_hub_files(model_name, revision, extension)
+    paths = []
+    for name in filenames:
+        path = _hub().try_to_load_from_cache(
+            model_name, name, revision=revision
+        )
+        if path is None:
+            raise FileNotFoundError(
+                f"{name} of {model_name} is not cached; run "
+                f"`model-util download-weights {model_name}` first"
+            )
+        paths.append(Path(path))
+    return paths
+
+
+def get_model_path(model_name: str, revision: str | None = None) -> str:
+    """Resolve a model to a local directory (path, override cache, or HF
+    cache snapshot)."""
+    if Path(model_name).exists():
+        return model_name
+    if WEIGHTS_CACHE_OVERRIDE:
+        override = Path(WEIGHTS_CACHE_OVERRIDE) / model_name
+        if override.exists():
+            return str(override)
+    snapshot = _hub().snapshot_download(
+        model_name,
+        revision=revision,
+        local_files_only=True,
+        allow_patterns=["*.json", "*.safetensors", "tokenizer*"],
+    )
+    return snapshot
+
+
+def download_weights(
+    model_name: str,
+    revision: str | None = None,
+    extension: str = ".safetensors",
+    max_workers: int = 16,
+) -> list[Path]:
+    """Download all weight files with ``extension`` (parallel fetch)."""
+    filenames = weight_hub_files(model_name, revision, extension)
+    logger.info("downloading %d files for %s", len(filenames), model_name)
+
+    def fetch(name: str) -> Path:
+        start = time.monotonic()
+        path = _hub().hf_hub_download(
+            model_name, filename=name, revision=revision
+        )
+        logger.info("downloaded %s in %.1fs", name, time.monotonic() - start)
+        return Path(path)
+
+    with concurrent.futures.ThreadPoolExecutor(
+        max_workers=max_workers
+    ) as pool:
+        return list(pool.map(fetch, filenames))
+
+
+# ------------------------------------------------------------- conversion
+
+
+def _remove_shared_pointers(tensors: dict) -> dict:
+    """Keep one name per storage: safetensors rejects aliased tensors."""
+    import collections
+
+    by_storage = collections.defaultdict(list)
+    for name, tensor in tensors.items():
+        by_storage[tensor.data_ptr()].append(name)
+    kept = {}
+    for names in by_storage.values():
+        # deterministic: keep the lexicographically first alias
+        keep = sorted(names)[0]
+        kept[keep] = tensors[keep]
+    return kept
+
+
+def convert_file(pt_file: Path, sf_file: Path) -> None:
+    """Convert one torch ``.bin`` pickle shard to safetensors.
+
+    Verifies the round trip bit-exactly before declaring success, like the
+    reference converter does — a silently corrupted weight file is the
+    worst possible failure mode for a model server.
+    """
+    import torch
+    from safetensors.torch import load_file, save_file
+
+    logger.info("converting %s -> %s", pt_file, sf_file)
+    loaded = torch.load(pt_file, map_location="cpu", weights_only=True)
+    if "state_dict" in loaded:
+        loaded = loaded["state_dict"]
+    loaded = _remove_shared_pointers(loaded)
+    # safetensors requires contiguous memory
+    loaded = {k: v.contiguous() for k, v in loaded.items()}
+
+    sf_file.parent.mkdir(parents=True, exist_ok=True)
+    save_file(loaded, str(sf_file), metadata={"format": "pt"})
+
+    reloaded = load_file(str(sf_file))
+    for name, tensor in loaded.items():
+        if not torch.equal(tensor, reloaded[name]):
+            raise RuntimeError(
+                f"conversion of {pt_file} produced a mismatch for {name!r}"
+            )
+
+
+def convert_index_file(
+    source: Path, dest: Path, pt_files: list[Path], sf_files: list[Path]
+) -> None:
+    """Rewrite a ``.bin.index.json`` weight map for the converted names."""
+    with open(source) as f:
+        index = json.load(f)
+    name_map = {p.name: s.name for p, s in zip(pt_files, sf_files)}
+    index["weight_map"] = {
+        tensor: name_map.get(shard, shard)
+        for tensor, shard in index.get("weight_map", {}).items()
+    }
+    with open(dest, "w") as f:
+        json.dump(index, f, indent=2)
+
+
+def convert_files(pt_files: list[Path], sf_files: list[Path]) -> None:
+    """Convert a list of torch shards, skipping already-converted ones."""
+    assert len(pt_files) == len(sf_files)
+    n = len(pt_files)
+    for i, (pt, sf) in enumerate(zip(pt_files, sf_files), start=1):
+        if sf.exists():
+            logger.info("[%d/%d] %s already exists, skipping", i, n, sf.name)
+            continue
+        start = time.monotonic()
+        convert_file(pt, sf)
+        logger.info(
+            "[%d/%d] converted %s in %.1fs", i, n, sf.name,
+            time.monotonic() - start,
+        )
+
+
+def convert_to_fast_tokenizer(
+    model_name: str,
+    output_path: str,
+    revision: str | None = None,
+) -> None:
+    """Materialise a ``tokenizer.json`` fast tokenizer for the model."""
+    from transformers import AutoTokenizer
+
+    tokenizer = AutoTokenizer.from_pretrained(model_name, revision=revision)
+    if not tokenizer.is_fast:
+        raise ValueError(
+            f"{model_name} has no fast-tokenizer conversion available"
+        )
+    tokenizer.save_pretrained(output_path)
+    logger.info("saved fast tokenizer to %s", output_path)

@@ -1,0 +1,126 @@
+"""`model-util` / `text-generation-server` CLIs.
+
+Capability match for the reference's operator tooling (SURVEY.md §2
+component #15; entry points mirrored in pyproject.toml): subcommands
+``download-weights`` (with automatic .bin→.safetensors conversion when no
+safetensors exist upstream), ``convert-to-safetensors``, and
+``convert-to-fast-tokenizer``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from vllm_tgis_adapter_tpu.logging import init_logger
+from vllm_tgis_adapter_tpu.tgis_utils import hub
+
+logger = init_logger(__name__)
+
+
+def download_weights(
+    model_name: str,
+    revision: str | None = None,
+    extension: str = ".safetensors",
+    auto_convert: bool = True,
+) -> None:
+    """Fetch weights; fall back to .bin + local conversion when the model
+    publishes no safetensors."""
+    try:
+        filenames = hub.weight_hub_files(model_name, revision, extension)
+    except Exception:
+        filenames = []
+    if filenames:
+        hub.download_weights(model_name, revision, extension)
+        return
+    if not auto_convert or extension != ".safetensors":
+        raise FileNotFoundError(
+            f"no {extension} weights found for {model_name}"
+        )
+    logger.warning(
+        "%s publishes no safetensors; downloading .bin shards and "
+        "converting locally", model_name,
+    )
+    pt_files = hub.download_weights(model_name, revision, ".bin")
+    sf_files = [p.with_suffix(".safetensors") for p in pt_files]
+    hub.convert_files(pt_files, sf_files)
+    for index in Path(pt_files[0]).parent.glob("*.bin.index.json"):
+        hub.convert_index_file(
+            index,
+            index.with_name(
+                index.name.replace(".bin.index.json",
+                                   ".safetensors.index.json")
+            ),
+            pt_files,
+            sf_files,
+        )
+
+
+def convert_to_safetensors(
+    model_name: str, revision: str | None = None
+) -> None:
+    pt_files = hub.weight_files(model_name, revision, ".bin")
+    sf_files = [p.with_suffix(".safetensors") for p in pt_files]
+    hub.convert_files(pt_files, sf_files)
+
+
+def _build_parser(prog: str) -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog=prog, description="model weight utilities"
+    )
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("download-weights",
+                       help="download model weights from the HF hub")
+    p.add_argument("model_name")
+    p.add_argument("--revision", default=None)
+    p.add_argument("--extension", default=".safetensors")
+    p.add_argument("--no-auto-convert", action="store_true",
+                   help="do not fall back to .bin download + conversion")
+
+    p = sub.add_parser("convert-to-safetensors",
+                       help="convert cached .bin shards to safetensors")
+    p.add_argument("model_name")
+    p.add_argument("--revision", default=None)
+
+    p = sub.add_parser("convert-to-fast-tokenizer",
+                       help="materialise a tokenizer.json fast tokenizer")
+    p.add_argument("model_name")
+    p.add_argument("--revision", default=None)
+    p.add_argument("--output-path", default=None)
+    return parser
+
+
+def _dispatch(args: argparse.Namespace) -> None:
+    if args.command == "download-weights":
+        download_weights(
+            args.model_name,
+            revision=args.revision,
+            extension=args.extension,
+            auto_convert=not args.no_auto_convert,
+        )
+    elif args.command == "convert-to-safetensors":
+        convert_to_safetensors(args.model_name, revision=args.revision)
+    elif args.command == "convert-to-fast-tokenizer":
+        hub.convert_to_fast_tokenizer(
+            args.model_name,
+            args.output_path or args.model_name,
+            revision=args.revision,
+        )
+
+
+def cli(argv: list[str] | None = None) -> None:
+    """`model-util` entry point."""
+    args = _build_parser("model-util").parse_args(argv)
+    _dispatch(args)
+
+
+def tgis_cli(argv: list[str] | None = None) -> None:
+    """`text-generation-server` compat entry point (same subcommands)."""
+    args = _build_parser("text-generation-server").parse_args(argv)
+    _dispatch(args)
+
+
+if __name__ == "__main__":
+    cli(sys.argv[1:])
