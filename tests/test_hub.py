@@ -110,3 +110,21 @@ def test_convert_fast_tokenizer_roundtrip(tmp_path, tiny_model_dir):
 @pytest.mark.hf_data
 def test_download_weights_live():
     hub.download_weights("bigscience/bloom-560m", extension=".safetensors")
+
+
+def test_convert_preserves_distinct_views(tmp_path):
+    """A view sharing storage with a full tensor must be cloned, not
+    dropped (data_ptr-only dedup would silently lose it)."""
+    base = torch.randn(32)
+    tensors = {"z.full": base, "a.view": base[:8]}
+    pt = tmp_path / "m.bin"
+    torch.save(tensors, pt)
+    sf = tmp_path / "m.safetensors"
+    hub.convert_file(pt, sf)
+
+    from safetensors.torch import load_file
+
+    reloaded = load_file(str(sf))
+    assert set(reloaded) == {"z.full", "a.view"}
+    assert torch.equal(reloaded["z.full"], base)
+    assert torch.equal(reloaded["a.view"], base[:8])

@@ -178,25 +178,24 @@ def test_pallas_kernels_under_tp_mesh(monkeypatch):
     )
 
     mesh = build_mesh(tensor_parallel_size=4)
-    if True:
-        got = attn.paged_decode_attention(
-            jnp.asarray(q), jnp.asarray(k_cache), jnp.asarray(v_cache),
-            jnp.asarray(bt), jnp.asarray(cl), block_size, scale, mesh=mesh,
-        )
-        # prefill too
-        t, valid = 128, 100
-        rng = np.random.default_rng(5)
-        qp = rng.standard_normal((t, num_kv * g, head_dim), dtype=np.float32)
-        kp = rng.standard_normal((t, num_kv, head_dim), dtype=np.float32)
-        vp = rng.standard_normal((t, num_kv, head_dim), dtype=np.float32)
-        ref_p = attn.prefill_attention_xla(
-            jnp.asarray(qp), jnp.asarray(kp), jnp.asarray(vp), scale,
-            jnp.asarray(valid),
-        )
-        got_p = attn.prefill_attention(
-            jnp.asarray(qp), jnp.asarray(kp), jnp.asarray(vp), scale,
-            jnp.asarray(valid, jnp.int32), mesh=mesh,
-        )
+    got = attn.paged_decode_attention(
+        jnp.asarray(q), jnp.asarray(k_cache), jnp.asarray(v_cache),
+        jnp.asarray(bt), jnp.asarray(cl), block_size, scale, mesh=mesh,
+    )
+    # prefill too
+    t, valid = 128, 100
+    rng = np.random.default_rng(5)
+    qp = rng.standard_normal((t, num_kv * g, head_dim), dtype=np.float32)
+    kp = rng.standard_normal((t, num_kv, head_dim), dtype=np.float32)
+    vp = rng.standard_normal((t, num_kv, head_dim), dtype=np.float32)
+    ref_p = attn.prefill_attention_xla(
+        jnp.asarray(qp), jnp.asarray(kp), jnp.asarray(vp), scale,
+        jnp.asarray(valid),
+    )
+    got_p = attn.prefill_attention(
+        jnp.asarray(qp), jnp.asarray(kp), jnp.asarray(vp), scale,
+        jnp.asarray(valid, jnp.int32), mesh=mesh,
+    )
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
     np.testing.assert_allclose(np.asarray(got_p)[:valid],

@@ -64,11 +64,11 @@ def apply_rotary(
 
 
 class LlamaForCausalLM:
-    def __init__(self, config: "ModelConfig", mesh=None):
+    def __init__(self, config: "ModelConfig"):
         self.config = config
         # TP mesh for shard_map-wrapped Pallas attention (ops/attention.py);
-        # set by the runner at boot, None on a single device
-        self.mesh = mesh
+        # assigned by the runner at boot, None on a single device
+        self.mesh = None
 
     # ---------------------------------------------------------------- params
 

@@ -1,0 +1,130 @@
+"""Ring attention: causal prefill sharded over the sequence axis.
+
+Long-context scale-out path (SURVEY.md §5 "long-context"): when a prompt
+exceeds one chip's HBM (activations + KV), the sequence axis is sharded
+over the mesh's ``sp`` axis and K/V chunks rotate around the ring via
+``ppermute`` while every device accumulates online-softmax partials for
+its local queries.  Peak per-device memory is O(T/n) and the ring rides
+the ICI neighbour links; compute overlaps the rotation because XLA
+schedules the collective-permute asynchronously.
+
+Causality over chunks: device d owns global positions [d·c, (d+1)·c); a
+K/V chunk originating from device s is fully visible when s < d, fully
+masked when s > d, and diagonally masked when s == d — so each hop does
+full-block work and the mask only materialises on the diagonal hop.
+
+Numerics mirror ops/attention.py:prefill_attention_xla (f32 softmax);
+parity is pinned on the virtual CPU mesh in tests/test_ring_attention.py.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from vllm_tgis_adapter_tpu.parallel.mesh import SP_AXIS
+
+NEG_INF = float("-inf")
+
+
+def _chunk_attention(
+    q: jax.Array,  # [C, Hkv, G, Dh] f32 local queries
+    k: jax.Array,  # [C, Hkv, Dh] f32 visiting key chunk
+    v: jax.Array,  # [C, Hkv, Dh] f32
+    scale: float,
+    q_pos: jax.Array,  # [C] global positions of local queries
+    k_pos: jax.Array,  # [C] global positions of the visiting chunk
+    valid_len: jax.Array,
+    m: jax.Array,  # [Hkv, G, C, 1] running max
+    l: jax.Array,  # [Hkv, G, C, 1] running denom
+    acc: jax.Array,  # [Hkv, G, C, Dh] running numerator
+):
+    s = jnp.einsum("ckgd,skd->kgcs", q, k) * scale  # [Hkv, G, C, C]
+    mask = (k_pos[None, :] <= q_pos[:, None]) & (
+        k_pos[None, :] < valid_len
+    )  # [C, C]
+    s = jnp.where(mask[None, None], s, NEG_INF)
+
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+    shift = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    p = jnp.exp(s - shift)
+    alpha = jnp.exp(jnp.where(jnp.isfinite(m), m, shift) - shift)
+    l = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+    acc = alpha * acc + jnp.einsum("kgcs,skd->kgcd", p, v)
+    return m_new, l, acc
+
+
+def ring_prefill_attention(
+    q: jax.Array,  # [T, H, Dh] sequence-sharded on sp
+    k: jax.Array,  # [T, Hkv, Dh]
+    v: jax.Array,
+    scale: float,
+    valid_len: jax.Array,  # scalar int32 (global)
+    mesh: Mesh,
+    axis: str = SP_AXIS,
+) -> jax.Array:
+    """Causal attention with the sequence axis sharded over ``axis``.
+
+    All inputs/outputs are global-view arrays; shard_map splits them so
+    each device keeps only its T/n chunk resident.
+    """
+    n = mesh.shape[axis]
+    if n == 1:
+        from vllm_tgis_adapter_tpu.ops.attention import prefill_attention_xla
+
+        return prefill_attention_xla(q, k, v, scale, valid_len)
+    t, num_heads, head_dim = q.shape
+    num_kv = k.shape[1]
+    g = num_heads // num_kv
+    if t % n:
+        raise ValueError(f"sequence {t} not divisible by ring size {n}")
+    c = t // n
+
+    def local_fn(q_loc, k_loc, v_loc, vl):
+        # q_loc [C, H, Dh]; k_loc/v_loc [C, Hkv, Dh]; vl [1]
+        d = jax.lax.axis_index(axis)
+        qf = q_loc.reshape(c, num_kv, g, head_dim).astype(jnp.float32)
+        q_pos = d * c + jnp.arange(c)
+
+        m = jnp.full((num_kv, g, c, 1), NEG_INF, jnp.float32)
+        l = jnp.zeros((num_kv, g, c, 1), jnp.float32)
+        acc = jnp.zeros((num_kv, g, c, head_dim), jnp.float32)
+
+        k_cur = k_loc.astype(jnp.float32)
+        v_cur = v_loc.astype(jnp.float32)
+        # ring size is static (mesh shape): unrolled python loop lets XLA
+        # pipeline each hop's ppermute under the previous hop's compute
+        for i in range(n):
+            src = (d - i) % n  # chunk currently visiting this device
+            k_pos = src * c + jnp.arange(c)
+            m, l, acc = _chunk_attention(
+                qf, k_cur, v_cur, scale, q_pos, k_pos, vl[0], m, l, acc
+            )
+            if i != n - 1:
+                perm = [(j, (j + 1) % n) for j in range(n)]
+                k_cur = jax.lax.ppermute(k_cur, axis, perm)
+                v_cur = jax.lax.ppermute(v_cur, axis, perm)
+
+        out = acc / jnp.maximum(l, 1e-30)  # [Hkv, G, C, Dh]
+        out = jnp.transpose(out, (2, 0, 1, 3)).reshape(
+            c, num_heads, head_dim
+        )
+        return out.astype(q_loc.dtype)
+
+    seq = P(axis, None, None)
+    return shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(seq, seq, seq, P()),
+        out_specs=seq,
+        check_vma=False,
+    )(q, k, v, jnp.asarray([valid_len], jnp.int32))
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "mesh", "axis"))
+def _jitted(q, k, v, scale, valid_len, mesh, axis):
+    return ring_prefill_attention(q, k, v, scale, valid_len, mesh, axis)

@@ -59,7 +59,8 @@ def weight_files(
         path = _hub().try_to_load_from_cache(
             model_name, name, revision=revision
         )
-        if path is None:
+        # None = not cached; the _CACHED_NO_EXIST sentinel = cached 404
+        if not isinstance(path, (str, Path)):
             raise FileNotFoundError(
                 f"{name} of {model_name} is not cached; run "
                 f"`model-util download-weights {model_name}` first"
@@ -114,7 +115,13 @@ def download_weights(
 
 
 def _remove_shared_pointers(tensors: dict) -> dict:
-    """Keep one name per storage: safetensors rejects aliased tensors."""
+    """Break storage sharing: safetensors rejects aliased tensors.
+
+    True aliases (identical shape/stride/offset, e.g. tied embeddings)
+    keep only the lexicographically-first name, matching upstream
+    convention.  Distinct views over a shared base are CLONED instead of
+    dropped — keying on data_ptr alone would silently lose their data.
+    """
     import collections
 
     by_storage = collections.defaultdict(list)
@@ -122,9 +129,19 @@ def _remove_shared_pointers(tensors: dict) -> dict:
         by_storage[tensor.data_ptr()].append(name)
     kept = {}
     for names in by_storage.values():
-        # deterministic: keep the lexicographically first alias
-        keep = sorted(names)[0]
-        kept[keep] = tensors[keep]
+        names = sorted(names)
+        first = tensors[names[0]]
+        kept[names[0]] = first
+        for other in names[1:]:
+            t = tensors[other]
+            identical_view = (
+                t.shape == first.shape
+                and t.stride() == first.stride()
+                and t.storage_offset() == first.storage_offset()
+                and t.dtype == first.dtype
+            )
+            if not identical_view:
+                kept[other] = t.clone()
     return kept
 
 

@@ -26,11 +26,12 @@ def download_weights(
     auto_convert: bool = True,
 ) -> None:
     """Fetch weights; fall back to .bin + local conversion when the model
-    publishes no safetensors."""
-    try:
-        filenames = hub.weight_hub_files(model_name, revision, extension)
-    except Exception:
-        filenames = []
+    publishes no safetensors.
+
+    Listing errors (network, auth, bad revision) propagate — only a model
+    that genuinely lists zero matching files takes the fallback path.
+    """
+    filenames = hub.weight_hub_files(model_name, revision, extension)
     if filenames:
         hub.download_weights(model_name, revision, extension)
         return
@@ -43,9 +44,17 @@ def download_weights(
         "converting locally", model_name,
     )
     pt_files = hub.download_weights(model_name, revision, ".bin")
+    if not pt_files:
+        raise FileNotFoundError(
+            f"{model_name} publishes neither .safetensors nor .bin weights"
+        )
     sf_files = [p.with_suffix(".safetensors") for p in pt_files]
     hub.convert_files(pt_files, sf_files)
-    for index in Path(pt_files[0]).parent.glob("*.bin.index.json"):
+    # sharded checkpoints: fetch + rewrite the weight-map index (the .bin
+    # download above matches only *.bin, never the .bin.index.json)
+    if hub.weight_hub_files(model_name, revision, ".bin.index.json"):
+        hub.download_weights(model_name, revision, ".bin.index.json")
+    for index in pt_files[0].parent.glob("*.bin.index.json"):
         hub.convert_index_file(
             index,
             index.with_name(
