@@ -1,0 +1,301 @@
+"""FSM-constrained decoding tests: regex engine, schema compiler, token
+tables, and end-to-end guided generation on the tiny model.
+
+Mirrors the reference's guided-decoding test matrix
+(tests/test_grpc_server.py parametrization over json/schema/regex/choice)
+at engine level; the gRPC-level pass-through is covered in
+test_grpc_server.py.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from vllm_tgis_adapter_tpu.engine.constrained import (
+    ByteDFA,
+    TokenFSM,
+    compile_fsm,
+    constraint_regex,
+    json_object_regex,
+    schema_to_regex,
+)
+from vllm_tgis_adapter_tpu.engine.sampling_params import (
+    SamplingParams,
+    StructuredOutputsParams,
+)
+
+
+# ------------------------------------------------------------- regex engine
+
+
+@pytest.mark.parametrize("pattern,ok,bad", [
+    ("abc", ["abc"], ["ab", "abcd", "xbc"]),
+    ("a+b*", ["a", "aab", "abbb"], ["", "b", "ba"]),
+    ("(foo|bar)", ["foo", "bar"], ["baz", "fooo"]),
+    ("[a-c]{2,3}", ["ab", "abc", "ccc"], ["a", "abcd", "xy"]),
+    ("[^0-9]+", ["abc", "!?"], ["a1", "7"]),
+    ("\\d{3}-\\d{2}", ["123-45"], ["123-456", "12-345"]),
+    ("a?b", ["b", "ab"], ["aab"]),
+    ("(ab)+", ["ab", "abab"], ["a", "aba"]),
+    ("x.z", ["xyz", "x z"], ["xz", "x\nz"]),
+    ("\\w+@\\w+", ["a_1@bc"], ["@bc", "a@"]),
+])
+def test_regex_dfa(pattern, ok, bad):
+    dfa = ByteDFA.from_regex(pattern)
+    for text in ok:
+        assert dfa.matches(text.encode()), (pattern, text)
+    for text in bad:
+        assert not dfa.matches(text.encode()), (pattern, text)
+
+
+def test_regex_utf8_literals():
+    dfa = ByteDFA.from_regex("héllo")
+    assert dfa.matches("héllo".encode())
+    assert not dfa.matches(b"hello")
+
+
+# ----------------------------------------------------------- json compilers
+
+
+def test_json_object_regex_accepts_real_json():
+    dfa = ByteDFA.from_regex(json_object_regex())
+    good = [
+        '{}',
+        '{"a": 1}',
+        '{"a": "x", "b": [1, 2.5, true]}',
+        '{"nested": {"deep": {"ok": null}}}',
+    ]
+    for doc in good:
+        assert dfa.matches(doc.encode()), doc
+    assert not dfa.matches(b'{"unclosed": ')
+    assert not dfa.matches(b'[1, 2]')  # top level must be an object
+
+
+def test_schema_to_regex_object():
+    schema = {
+        "type": "object",
+        "properties": {
+            "name": {"type": "string"},
+            "age": {"type": "integer"},
+            "active": {"type": "boolean"},
+        },
+        "required": ["name", "age", "active"],
+    }
+    dfa = ByteDFA.from_regex(schema_to_regex(schema))
+    assert dfa.matches(b'{"active": true}') is False
+    assert dfa.matches(b'{"name": "bo", "age": 3, "active": false}')
+    assert not dfa.matches(b'{"name": "bo", "age": "x", "active": true}')
+
+
+def test_schema_enum_and_array():
+    schema = {
+        "type": "object",
+        "properties": {
+            "color": {"enum": ["red", "green"]},
+            "nums": {"type": "array", "items": {"type": "integer"}},
+        },
+    }
+    dfa = ByteDFA.from_regex(schema_to_regex(schema))
+    assert dfa.matches(b'{"color": "red", "nums": [1, 2, 3]}')
+    assert not dfa.matches(b'{"color": "blue", "nums": []}')
+
+
+def test_constraint_regex_modes():
+    assert constraint_regex(
+        StructuredOutputsParams(regex="a+")
+    ) == "a+"
+    choice = constraint_regex(
+        StructuredOutputsParams(choice=["yes", "no"])
+    )
+    dfa = ByteDFA.from_regex(choice)
+    assert dfa.matches(b"yes") and dfa.matches(b"no")
+    assert not dfa.matches(b"maybe")
+    with pytest.raises(ValueError, match="grammar"):
+        constraint_regex(StructuredOutputsParams(grammar="root ::= x"))
+
+
+# ------------------------------------------------------------- token tables
+
+
+class FakeTok:
+    """Minimal tokenizer: one printable char per id + an EOS special."""
+
+    def __init__(self, alphabet="abcdefgh-123 "):
+        self.alphabet = list(alphabet)
+        self.all_special_tokens = ["</s>"]
+
+    def __len__(self):
+        return len(self.alphabet) + 1
+
+    def convert_ids_to_tokens(self, ids):
+        table = self.alphabet + ["</s>"]
+        return [table[i] for i in ids]
+
+
+def test_token_fsm_masks_and_walk():
+    tok = FakeTok()
+    eos = len(tok) - 1
+    dfa = ByteDFA.from_regex("ab+")
+    fsm = TokenFSM(
+        dfa,
+        [c.encode() for c in tok.alphabet] + [b""],
+        eos_id=eos,
+    )
+    state = fsm.init_state
+    row = fsm.allowed_row(state)
+    assert row[tok.alphabet.index("a")]
+    assert not row[tok.alphabet.index("b")]
+    assert not row[eos]  # "" not accepting
+    state = fsm.next_state(state, tok.alphabet.index("a"))
+    row = fsm.allowed_row(state)
+    assert row[tok.alphabet.index("b")] and not row[tok.alphabet.index("a")]
+    assert not row[eos]  # "a" not accepting
+    state = fsm.next_state(state, tok.alphabet.index("b"))
+    assert fsm.allowed_row(state)[eos]  # "ab" accepting
+
+
+# ------------------------------------------------------- engine end-to-end
+
+
+@pytest.fixture(scope="module")
+def guided_engine(tiny_model_dir):
+    from vllm_tgis_adapter_tpu.engine.config import (
+        CacheConfig,
+        EngineConfig,
+        LoRAConfig,
+        ModelConfig,
+        ParallelConfig,
+        SchedulerConfig,
+    )
+    from vllm_tgis_adapter_tpu.engine.core import LLMEngine
+
+    mcfg = ModelConfig.from_pretrained(tiny_model_dir, dtype="float32")
+    config = EngineConfig(
+        model_config=mcfg,
+        cache_config=CacheConfig(block_size=16, num_blocks=64,
+                                 cache_dtype=mcfg.dtype),
+        scheduler_config=SchedulerConfig(
+            max_num_seqs=4, prefill_buckets=(32, 64)),
+        parallel_config=ParallelConfig(),
+        lora_config=LoRAConfig(),
+    )
+    return LLMEngine.from_config(config)
+
+
+def run_guided(engine, rid, constraint, max_tokens=24, temperature=0.8):
+    engine.add_request(rid, "the quick", SamplingParams(
+        temperature=temperature, seed=17, max_tokens=max_tokens,
+        structured_outputs=constraint))
+    outputs = {}
+    for _ in range(300):
+        if not engine.has_unfinished_requests():
+            break
+        for out in engine.step():
+            outputs[out.request_id] = out
+    return outputs[rid].outputs[0]
+
+
+def test_guided_choice_engine(guided_engine):
+    out = run_guided(
+        guided_engine, "choice",
+        StructuredOutputsParams(choice=["hello world", "goodbye"]),
+    )
+    assert out.text in ("hello world", "goodbye")
+    assert out.finish_reason == "stop"
+
+
+def test_guided_regex_engine(guided_engine):
+    out = run_guided(
+        guided_engine, "regex",
+        StructuredOutputsParams(regex="[0-9]{2}-[0-9]{2}"),
+    )
+    import re
+
+    assert re.fullmatch(r"[0-9]{2}-[0-9]{2}", out.text), out.text
+
+
+def test_guided_json_schema_engine(guided_engine):
+    out = run_guided(
+        guided_engine, "schema",
+        StructuredOutputsParams(json=json.dumps({
+            "type": "object",
+            "properties": {"n": {"type": "integer"}},
+            "required": ["n"],
+        })),
+        max_tokens=48,
+    )
+    doc = json.loads(out.text)
+    assert isinstance(doc["n"], int)
+
+
+def test_guided_mixed_batch(guided_engine):
+    """Constrained and unconstrained requests share a decode batch (the
+    constrained row single-steps, the free row multi-steps)."""
+    from vllm_tgis_adapter_tpu.engine.sampling_params import SamplingParams
+
+    guided_engine.add_request("free", "hello", SamplingParams(
+        temperature=0.0, max_tokens=12, ignore_eos=True))
+    guided_engine.add_request("tied", "the quick", SamplingParams(
+        temperature=0.9, seed=3, max_tokens=16,
+        structured_outputs=StructuredOutputsParams(choice=["123", "ab-c"])))
+    outputs = {}
+    for _ in range(300):
+        if not guided_engine.has_unfinished_requests():
+            break
+        for out in guided_engine.step():
+            outputs[out.request_id] = out
+    assert outputs["tied"].outputs[0].text in ("123", "ab-c")
+    assert len(outputs["free"].outputs[0].token_ids) == 12
+
+
+def test_schema_optional_first_property():
+    """Omitting an optional first property must not strand a comma."""
+    schema = {
+        "type": "object",
+        "properties": {"a": {"type": "integer"}, "b": {"type": "integer"}},
+        "required": ["b"],
+    }
+    dfa = ByteDFA.from_regex(schema_to_regex(schema))
+    assert dfa.matches(b'{"b": 2}')
+    assert dfa.matches(b'{"a": 1, "b": 2}')
+    assert not dfa.matches(b'{,"b": 2}')
+    assert not dfa.matches(b'{"a": 1}')  # b required
+
+
+def test_schema_all_optional_allows_empty():
+    schema = {"type": "object",
+              "properties": {"x": {"type": "boolean"}}, "required": []}
+    dfa = ByteDFA.from_regex(schema_to_regex(schema))
+    assert dfa.matches(b'{}')
+    assert dfa.matches(b'{"x": true}')
+
+
+def test_open_repetition_not_capped():
+    dfa = ByteDFA.from_regex("[0-9]{3,}")
+    assert dfa.matches(b"123")
+    assert dfa.matches(b"1234567890123456789012345678901234567890")
+    assert not dfa.matches(b"12")
+
+
+def test_min_tokens_yields_to_fsm_dead_end(guided_engine):
+    """min_new_tokens larger than the constraint's longest string: the
+    FSM dead-end wins and the stream closes with a legal output."""
+    out = run_guided(
+        guided_engine, "mintok",
+        StructuredOutputsParams(choice=["ab", "cd"]),
+        max_tokens=24,
+    )
+    # engine-level min_tokens is set via SamplingParams; rerun explicitly
+    guided_engine.add_request("mintok2", "x", SamplingParams(
+        temperature=0.7, seed=5, max_tokens=24, min_tokens=20,
+        structured_outputs=StructuredOutputsParams(choice=["ab", "cd"])))
+    outputs = {}
+    for _ in range(200):
+        if not guided_engine.has_unfinished_requests():
+            break
+        for o in guided_engine.step():
+            outputs[o.request_id] = o
+    assert outputs["mintok2"].outputs[0].text in ("ab", "cd")

@@ -128,3 +128,20 @@ def test_convert_preserves_distinct_views(tmp_path):
     assert set(reloaded) == {"z.full", "a.view"}
     assert torch.equal(reloaded["z.full"], base)
     assert torch.equal(reloaded["a.view"], base[:8])
+
+
+def test_convert_preserves_offset_views(tmp_path):
+    """A view at a nonzero storage offset aliases its base storage even
+    though data_ptr differs — it must be cloned, not passed through."""
+    base = torch.randn(32)
+    tensors = {"z.full": base, "a.tail": base[8:]}
+    pt = tmp_path / "o.bin"
+    torch.save(tensors, pt)
+    sf = tmp_path / "o.safetensors"
+    hub.convert_file(pt, sf)
+
+    from safetensors.torch import load_file
+
+    reloaded = load_file(str(sf))
+    assert torch.equal(reloaded["z.full"], base)
+    assert torch.equal(reloaded["a.tail"], base[8:])

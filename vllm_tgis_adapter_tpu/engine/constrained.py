@@ -1,0 +1,645 @@
+"""FSM-constrained decoding: regex/choice/JSON → per-state token bitmasks.
+
+TPU-native replacement for the guided-decoding backends the reference
+delegates to vLLM (SURVEY.md §2.3 "Guided decoding": proto oneof mapped at
+tgis_utils/structured_outputs.py, consumed by FSM logit masking).  The
+whole stack is self-contained:
+
+1. a byte-level regex engine (parse → Thompson NFA → subset-construction
+   DFA) covering the guided-decoding subset: literals, escapes, ``.``,
+   classes ``[a-z0-9_^-]``, ``* + ? {m} {m,n}``, alternation, groups;
+2. compilers from the TGIS constraint modes onto that regex core —
+   ``choice`` (escaped alternation), ``json_schema`` (outlines-style
+   schema→regex for the common subset), ``json_object`` (depth-bounded
+   generic JSON);
+3. a vectorised token-table compiler: for each DFA state, the set of
+   vocabulary tokens whose full byte string survives, plus the landing
+   state — numpy walks the padded token-byte matrix through the dense
+   byte-transition table, so mask compilation is O(max_token_len × S)
+   vector ops instead of O(S × V × len) Python.
+
+At decode time the sampler consumes ``mask[state]`` as its
+``allowed_mask`` row and the host advances ``state = dest[state, token]``
+(engine/core.py).  EOS is permitted exactly in accepting states.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Optional
+
+import numpy as np
+
+from vllm_tgis_adapter_tpu.logging import init_logger
+
+logger = init_logger(__name__)
+
+MAX_DFA_STATES = 16384
+DEAD = -1
+
+
+# ----------------------------------------------------------------- regex core
+
+
+class _Parser:
+    """Recursive-descent parser for the guided-decoding regex subset.
+
+    Produces an AST of tuples:
+    ("lit", byteset) | ("cat", a, b) | ("alt", a, b) |
+    ("star", a) | ("plus", a) | ("opt", a) | ("rep", a, m, n)
+    """
+
+    def __init__(self, pattern: str):
+        self.src = pattern
+        self.pos = 0
+
+    def parse(self):
+        node = self._alternation()
+        if self.pos != len(self.src):
+            raise ValueError(
+                f"unexpected {self.src[self.pos]!r} at {self.pos} in regex"
+            )
+        return node
+
+    # grammar: alternation := concat ('|' concat)*
+    def _alternation(self):
+        node = self._concat()
+        while self._peek() == "|":
+            self.pos += 1
+            node = ("alt", node, self._concat())
+        return node
+
+    def _concat(self):
+        parts = []
+        while True:
+            c = self._peek()
+            if c is None or c in "|)":
+                break
+            parts.append(self._repeat())
+        if not parts:
+            return ("eps",)
+        node = parts[0]
+        for p in parts[1:]:
+            node = ("cat", node, p)
+        return node
+
+    def _repeat(self):
+        node = self._atom()
+        while True:
+            c = self._peek()
+            if c == "*":
+                self.pos += 1
+                node = ("star", node)
+            elif c == "+":
+                self.pos += 1
+                node = ("plus", node)
+            elif c == "?":
+                self.pos += 1
+                node = ("opt", node)
+            elif c == "{":
+                end = self.src.find("}", self.pos)
+                if end == -1:
+                    raise ValueError("unterminated {m,n}")
+                spec = self.src[self.pos + 1 : end]
+                self.pos = end + 1
+                if "," in spec:
+                    lo, hi = spec.split(",", 1)
+                    m = int(lo) if lo else 0
+                    n = int(hi) if hi else None  # {m,} = m copies + star
+                else:
+                    m = n = int(spec)
+                node = ("rep", node, m, n)
+            else:
+                return node
+
+    def _atom(self):
+        c = self._peek()
+        if c == "(":
+            self.pos += 1
+            # ignore non-capturing marker
+            if self.src.startswith("?:", self.pos):
+                self.pos += 2
+            node = self._alternation()
+            if self._peek() != ")":
+                raise ValueError("unbalanced parenthesis")
+            self.pos += 1
+            return node
+        if c == "[":
+            return self._char_class()
+        if c == ".":
+            self.pos += 1
+            # any byte except newline (regex '.' convention)
+            return ("lit", frozenset(range(256)) - {ord("\n")})
+        if c == "\\":
+            self.pos += 1
+            return ("lit", self._escape())
+        if c is None or c in "*+?{|)":
+            raise ValueError(f"unexpected {c!r} in regex")
+        self.pos += 1
+        encoded = c.encode("utf-8")
+        # multi-byte characters are a SEQUENCE of byte literals, not a
+        # one-byte class
+        node = ("lit", frozenset({encoded[0]}))
+        for b in encoded[1:]:
+            node = ("cat", node, ("lit", frozenset({b})))
+        return node
+
+    def _escape(self) -> frozenset:
+        c = self.src[self.pos]
+        self.pos += 1
+        table = {
+            "d": frozenset(range(0x30, 0x3A)),
+            "w": frozenset(
+                list(range(0x30, 0x3A))
+                + list(range(0x41, 0x5B))
+                + list(range(0x61, 0x7B))
+                + [0x5F]
+            ),
+            "s": frozenset(b" \t\r\n\f\v"),
+            "n": frozenset(b"\n"),
+            "t": frozenset(b"\t"),
+            "r": frozenset(b"\r"),
+        }
+        if c in table:
+            return table[c]
+        if c in ("D", "W", "S"):
+            return frozenset(range(256)) - table[c.lower()]
+        return frozenset(c.encode("utf-8"))
+
+    def _char_class(self) -> tuple:
+        assert self.src[self.pos] == "["
+        self.pos += 1
+        negate = self._peek() == "^"
+        if negate:
+            self.pos += 1
+        members: set[int] = set()
+        prev: Optional[int] = None
+        while True:
+            c = self._peek()
+            if c is None:
+                raise ValueError("unterminated character class")
+            if c == "]":
+                self.pos += 1
+                break
+            if c == "\\":
+                self.pos += 1
+                members |= self._escape()
+                prev = None
+                continue
+            if c == "-" and prev is not None and self._peek(1) not in ("]", None):
+                self.pos += 1
+                hi = self._peek()
+                self.pos += 1
+                members |= set(range(prev, ord(hi) + 1))
+                prev = None
+                continue
+            self.pos += 1
+            b = c.encode("utf-8")
+            members |= set(b)
+            prev = b[0] if len(b) == 1 else None
+        byteset = frozenset(members)
+        if negate:
+            byteset = frozenset(range(256)) - byteset
+        return ("lit", byteset)
+
+    def _peek(self, ahead: int = 0):
+        i = self.pos + ahead
+        return self.src[i] if i < len(self.src) else None
+
+
+class _NFA:
+    """Thompson construction over byte transitions."""
+
+    def __init__(self):
+        self.eps: list[set[int]] = []
+        self.trans: list[dict[int, set[int]]] = []
+
+    def new_state(self) -> int:
+        self.eps.append(set())
+        self.trans.append({})
+        return len(self.eps) - 1
+
+    def build(self, node) -> tuple[int, int]:
+        kind = node[0]
+        if kind == "eps":
+            s = self.new_state()
+            e = self.new_state()
+            self.eps[s].add(e)
+            return s, e
+        if kind == "lit":
+            s = self.new_state()
+            e = self.new_state()
+            for b in node[1]:
+                self.trans[s].setdefault(b, set()).add(e)
+            return s, e
+        if kind == "cat":
+            s1, e1 = self.build(node[1])
+            s2, e2 = self.build(node[2])
+            self.eps[e1].add(s2)
+            return s1, e2
+        if kind == "alt":
+            s = self.new_state()
+            e = self.new_state()
+            s1, e1 = self.build(node[1])
+            s2, e2 = self.build(node[2])
+            self.eps[s] |= {s1, s2}
+            self.eps[e1].add(e)
+            self.eps[e2].add(e)
+            return s, e
+        if kind == "star":
+            s = self.new_state()
+            e = self.new_state()
+            s1, e1 = self.build(node[1])
+            self.eps[s] |= {s1, e}
+            self.eps[e1] |= {s1, e}
+            return s, e
+        if kind == "plus":
+            return self.build(("cat", node[1], ("star", node[1])))
+        if kind == "opt":
+            return self.build(("alt", node[1], ("eps",)))
+        if kind == "rep":
+            _, child, m, n = node
+            if n is None:  # open upper bound: m mandatory copies + star
+                parts = [child] * m + [("star", child)]
+            else:
+                parts = [child] * m + [("opt", child)] * (n - m)
+            if not parts:
+                return self.build(("eps",))
+            expr = parts[0]
+            for p in parts[1:]:
+                expr = ("cat", expr, p)
+            return self.build(expr)
+        raise ValueError(f"unknown AST node {kind}")
+
+
+class ByteDFA:
+    """Dense byte-level DFA: ``trans[state, byte] -> state`` (-1 dead)."""
+
+    def __init__(self, trans: np.ndarray, accepting: np.ndarray):
+        self.trans = trans  # [S, 256] int32
+        self.accepting = accepting  # [S] bool
+
+    @property
+    def num_states(self) -> int:
+        return self.trans.shape[0]
+
+    @staticmethod
+    def from_regex(pattern: str) -> "ByteDFA":
+        ast = _Parser(pattern).parse()
+        nfa = _NFA()
+        start, end = nfa.build(ast)
+
+        def closure(states: frozenset) -> frozenset:
+            stack, seen = list(states), set(states)
+            while stack:
+                s = stack.pop()
+                for nxt in nfa.eps[s]:
+                    if nxt not in seen:
+                        seen.add(nxt)
+                        stack.append(nxt)
+            return frozenset(seen)
+
+        start_set = closure(frozenset({start}))
+        index = {start_set: 0}
+        rows = [np.full(256, DEAD, np.int32)]
+        accepting = [end in start_set]
+        work = [start_set]
+        while work:
+            cur = work.pop()
+            i = index[cur]
+            # group reachable byte → next-set
+            by_byte: dict[int, set[int]] = {}
+            for s in cur:
+                for b, dests in nfa.trans[s].items():
+                    by_byte.setdefault(b, set()).update(dests)
+            for b, dests in by_byte.items():
+                nxt = closure(frozenset(dests))
+                if nxt not in index:
+                    if len(index) >= MAX_DFA_STATES:
+                        raise ValueError(
+                            "constraint too complex: DFA exceeds "
+                            f"{MAX_DFA_STATES} states"
+                        )
+                    index[nxt] = len(index)
+                    rows.append(np.full(256, DEAD, np.int32))
+                    accepting.append(end in nxt)
+                    work.append(nxt)
+                rows[i][b] = index[nxt]
+        return ByteDFA(np.stack(rows), np.asarray(accepting, bool))
+
+    def matches(self, text: bytes) -> bool:
+        s = 0
+        for b in text:
+            s = self.trans[s, b]
+            if s == DEAD:
+                return False
+        return bool(self.accepting[s])
+
+
+# ----------------------------------------------------- constraint → regex
+
+
+def _escape_literal(text: str) -> str:
+    return "".join(
+        "\\" + c if c in r".[]{}()*+?|\\^$-" else c for c in text
+    )
+
+
+# unbounded loops (* / +) keep the NFA small: bounded {m,n} repetition
+# duplicates the sub-AST n times, which explodes exponentially once
+# nested (the Thompson star reuses ONE copy of its child instead)
+_WS = '[ \\n\\t]*'
+_JSON_STRING = '"[^"\\\\\x00-\x1f]*"'
+_JSON_INT = "(-)?(0|[1-9][0-9]*)"
+_JSON_NUM = _JSON_INT + "([.][0-9]+)?([eE][+-]?[0-9]+)?"
+
+
+def json_object_regex(depth: int = 3) -> str:
+    """Depth-bounded generic JSON value (arbitrary nesting is not
+    regular; three levels covers the practical ``format=JSON`` uses)."""
+    value = f"({_JSON_STRING}|{_JSON_NUM}|true|false|null)"
+    for _ in range(depth):
+        member = f"{_JSON_STRING}{_WS}:{_WS}{value}"
+        obj = (
+            "\\{" + _WS + f"({member}({_WS},{_WS}{member})*)?"
+            + _WS + "\\}"
+        )
+        arr = (
+            "\\[" + _WS + f"({value}({_WS},{_WS}{value})*)?"
+            + _WS + "\\]"
+        )
+        value = f"({_JSON_STRING}|{_JSON_NUM}|true|false|null|{obj}|{arr})"
+    member = f"{_JSON_STRING}{_WS}:{_WS}{value}"
+    return (
+        "\\{" + _WS + f"({member}({_WS},{_WS}{member})*)?"
+        + _WS + "\\}"
+    )
+
+
+def schema_to_regex(schema: dict | str) -> str:
+    """Outlines-style JSON-schema → regex for the common subset:
+    object/properties/required, string (+enum/pattern), integer, number,
+    boolean, null, array (+items), enum, const."""
+    if isinstance(schema, str):
+        schema = json.loads(schema)
+
+    def value_regex(s: dict) -> str:
+        if "enum" in s:
+            return (
+                "("
+                + "|".join(
+                    _escape_literal(json.dumps(v)) for v in s["enum"]
+                )
+                + ")"
+            )
+        if "const" in s:
+            return _escape_literal(json.dumps(s["const"]))
+        t = s.get("type")
+        if isinstance(t, list):
+            return "(" + "|".join(
+                value_regex({**s, "type": x}) for x in t
+            ) + ")"
+        if t == "string":
+            if "pattern" in s:
+                return f'"{s["pattern"]}"'
+            return _JSON_STRING
+        if t == "integer":
+            return _JSON_INT
+        if t == "number":
+            return _JSON_NUM
+        if t == "boolean":
+            return "(true|false)"
+        if t == "null":
+            return "null"
+        if t == "array":
+            item = value_regex(s.get("items", {}))
+            return (
+                "\\[" + _WS + f"({item}({_WS},{_WS}{item})*)?"
+                + _WS + "\\]"
+            )
+        if t == "object" or "properties" in s:
+            props = s.get("properties", {})
+            if not props:
+                return json_object_regex(depth=2)
+            # fixed property order; optional members may be omitted.  A
+            # flat "(,member)?" chain would strand a leading comma when
+            # the first property is optional, so build one alternative
+            # per possible FIRST-present property: everything after it
+            # joins with a mandatory comma if required, optional otherwise
+            names = list(props)
+            required = set(s.get("required", names))
+
+            def member(name: str) -> str:
+                return (
+                    f'"{_escape_literal(name)}"{_WS}:{_WS}'
+                    + value_regex(props[name])
+                )
+
+            alts = []
+            for i, first in enumerate(names):
+                tail = []
+                for name in names[i + 1 :]:
+                    piece = f"{_WS},{_WS}" + member(name)
+                    if name not in required:
+                        piece = f"({piece})?"
+                    tail.append(piece)
+                alts.append(member(first) + "".join(tail))
+                if first in required:
+                    break  # a required member can never be skipped
+            else:
+                alts.append("")  # every property optional: empty object
+            body = "(" + "|".join(alts) + ")"
+            return "\\{" + _WS + body + _WS + "\\}"
+        # unconstrained value
+        return json_object_regex(depth=2)
+
+    return value_regex(schema)
+
+
+def constraint_regex(params) -> str:
+    """StructuredOutputsParams → the regex the DFA is built from."""
+    if params.regex is not None:
+        return params.regex
+    if params.choice is not None:
+        return "(" + "|".join(_escape_literal(c) for c in params.choice) + ")"
+    if params.json is not None:
+        return schema_to_regex(params.json)
+    if params.json_object:
+        return json_object_regex()
+    if params.grammar is not None:
+        raise ValueError(
+            "grammar-constrained decoding is not supported yet; use "
+            "regex, choice, or json_schema"
+        )
+    raise ValueError("empty structured-output constraint")
+
+
+# --------------------------------------------------------------- token tables
+
+
+class TokenFSM:
+    """DFA lifted to the token vocabulary, one state row at a time.
+
+    For a visited state the full vocabulary is walked through the dense
+    byte-transition table in vectorised numpy (O(max_token_len) vector
+    ops over [V]); the resulting (mask, dest) rows are cached.  Lazy rows
+    keep memory at O(visited_states × V) instead of the O(S × V) dense
+    tables that would cost gigabytes for a 128k vocab and a JSON-sized
+    DFA — a generation only ever visits about as many states as it emits
+    tokens.
+    """
+
+    def __init__(self, dfa: ByteDFA, token_bytes, eos_id: int):
+        self.dfa = dfa
+        self.eos_id = eos_id
+        if isinstance(token_bytes, tuple):
+            # pre-built (padded, lens) matrix shared across FSMs for the
+            # same tokenizer (compile_fsm path)
+            self._padded, self._lens = token_bytes
+        else:
+            self._padded, self._lens = _pad_token_bytes(token_bytes)
+        # row S = dead sink so DEAD states index safely
+        self._trans = np.concatenate(
+            [dfa.trans, np.full((1, 256), DEAD, np.int32)]
+        )
+        self._rows: dict[int, tuple[np.ndarray, np.ndarray]] = {}
+
+    @property
+    def init_state(self) -> int:
+        return 0
+
+    def _state_row(self, state: int) -> tuple[np.ndarray, np.ndarray]:
+        cached = self._rows.get(state)
+        if cached is not None:
+            return cached
+        v, max_len = self._padded.shape
+        sink = self._trans.shape[0] - 1
+        states = np.full(v, state, np.int32)
+        for col in range(max_len):
+            live = col < self._lens
+            nxt = self._trans[
+                np.where(states == DEAD, sink, states), self._padded[:, col]
+            ]
+            states = np.where(live, nxt, states)
+        # zero-length tokens act as no-ops but sampling one would loop
+        # forever — forbid them outright
+        dest = np.where(self._lens == 0, DEAD, states).astype(np.int32)
+        mask = dest != DEAD
+        # EOS: allowed exactly in accepting states, terminal
+        mask[self.eos_id] = bool(self.dfa.accepting[state])
+        dest[self.eos_id] = DEAD
+        # a non-accepting state whose every token dies (vocab can't spell
+        # any legal continuation) must still allow something — emit EOS
+        # and close the stream rather than hand the sampler an all -inf row
+        if not mask.any():
+            mask[self.eos_id] = True
+        self._rows[state] = (mask, dest)
+        return mask, dest
+
+    def next_state(self, state: int, token_id: int) -> int:
+        if state == DEAD or token_id == self.eos_id:
+            return DEAD
+        return int(self._state_row(state)[1][token_id])
+
+    def allowed_row(self, state: int) -> np.ndarray:
+        if state == DEAD:
+            row = np.zeros(self._padded.shape[0], bool)
+            row[self.eos_id] = True  # dead end: close the stream
+            return row
+        return self._state_row(state)[0]
+
+
+def _pad_token_bytes(token_bytes: list[bytes]) -> tuple:
+    v = len(token_bytes)
+    max_len = max((len(t) for t in token_bytes), default=1)
+    padded = np.zeros((v, max_len), np.uint8)
+    lens = np.zeros(v, np.int32)
+    for i, t in enumerate(token_bytes):
+        lens[i] = len(t)
+        if t:
+            padded[i, : len(t)] = np.frombuffer(t, np.uint8)
+    return padded, lens
+
+
+# LRU-bounded: the cache key contains request-supplied patterns, so an
+# unbounded dict would let clients grow server memory without limit
+import collections
+
+_FSM_CACHE: "collections.OrderedDict[tuple, TokenFSM]" = (
+    collections.OrderedDict()
+)
+_FSM_CACHE_MAX = 32
+_TOKEN_BYTES_CACHE: dict[int, list[bytes]] = {}
+_TOKEN_MATRIX_CACHE: dict[int, tuple] = {}
+
+# GPT-2 byte-level BPE printable-unicode → raw byte table (the standard
+# mapping used by every ByteLevel tokenizer)
+def _bytelevel_decoder() -> dict[str, int]:
+    bs = (
+        list(range(ord("!"), ord("~") + 1))
+        + list(range(0xA1, 0xAD))
+        + list(range(0xAE, 0x100))
+    )
+    cs = bs[:]
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(256 + n)
+            n += 1
+    return {chr(c): b for b, c in zip(bs, cs)}
+
+
+def token_byte_strings(tokenizer) -> list[bytes]:
+    """Raw byte string of every vocab id (ByteLevel map when applicable,
+    utf-8 of the decoded piece otherwise)."""
+    key = id(tokenizer)
+    if key in _TOKEN_BYTES_CACHE:
+        return _TOKEN_BYTES_CACHE[key]
+    vocab_size = len(tokenizer)
+    tokens = tokenizer.convert_ids_to_tokens(list(range(vocab_size)))
+    table = _bytelevel_decoder()
+    special = set(tokenizer.all_special_tokens)
+    out: list[bytes] = []
+    for tok in tokens:
+        if tok is None or tok in special:
+            out.append(b"")  # specials are never constraint-legal
+            continue
+        if all(c in table for c in tok):
+            out.append(bytes(table[c] for c in tok))
+        elif tok.startswith("▁"):  # sentencepiece underline
+            out.append((" " + tok[1:]).encode("utf-8"))
+        else:
+            out.append(tok.encode("utf-8"))
+    _TOKEN_BYTES_CACHE[key] = out
+    return out
+
+
+def compile_fsm(params, tokenizer, eos_id: int) -> TokenFSM:
+    """StructuredOutputsParams + tokenizer → cached TokenFSM."""
+    pattern = constraint_regex(params)
+    key = (
+        hashlib.sha256(pattern.encode()).hexdigest(),
+        id(tokenizer),
+        eos_id,
+    )
+    fsm = _FSM_CACHE.get(key)
+    if fsm is None:
+        tok_key = id(tokenizer)
+        matrix = _TOKEN_MATRIX_CACHE.get(tok_key)
+        if matrix is None:
+            matrix = _pad_token_bytes(token_byte_strings(tokenizer))
+            _TOKEN_MATRIX_CACHE[tok_key] = matrix
+        dfa = ByteDFA.from_regex(pattern)
+        fsm = TokenFSM(dfa, matrix, eos_id)
+        _FSM_CACHE[key] = fsm
+        while len(_FSM_CACHE) > _FSM_CACHE_MAX:
+            _FSM_CACHE.popitem(last=False)
+        logger.info(
+            "compiled constraint FSM: %d DFA states, pattern %.60s…",
+            dfa.num_states, pattern,
+        )
+    else:
+        _FSM_CACHE.move_to_end(key)
+    return fsm

@@ -124,6 +124,15 @@ class LLMEngine:
             fallback_seed=self.runner.new_fallback_seed(),
             lora_name=lora_name,
         )
+        if params.structured_outputs is not None:
+            from vllm_tgis_adapter_tpu.engine.constrained import compile_fsm
+
+            seq.fsm = compile_fsm(
+                params.structured_outputs,
+                self.tokenizer,
+                self.config.model_config.eos_token_id,
+            )
+            seq.fsm_state = seq.fsm.init_state
         seq.detokenizer = IncrementalDetokenizer(
             self.tokenizer,
             seq.prompt_token_ids,
@@ -193,6 +202,10 @@ class LLMEngine:
                 continue  # aborted mid-step
             for tok in toks:
                 seq.output_token_ids.append(tok.token_id)
+                if seq.fsm is not None:
+                    seq.fsm_state = seq.fsm.next_state(
+                        seq.fsm_state, tok.token_id
+                    )
                 if seq.metrics.first_token_time is None:
                     seq.metrics.first_token_time = now
                 seq.metrics.last_token_time = now

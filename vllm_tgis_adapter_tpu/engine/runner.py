@@ -167,6 +167,7 @@ class ModelRunner:
             context_lens0,  # [B] length including the current token
             row_slots,  # [B] row index into ``seen``; -1 pads
             tensors: SamplingTensors,
+            allowed_mask,  # [B, V] bool or None (FSM-constrained rows)
             num_steps: int,  # static: steps fused into this dispatch
         ):
             b = tokens.shape[0]
@@ -193,7 +194,9 @@ class ModelRunner:
                     tensors, gen_len=tensors.gen_len + k
                 )
                 seen_rows = jnp.take(seen, rows, axis=0)
-                out = sampler_mod.sample(logits, seen_rows, t_k)
+                out = sampler_mod.sample(
+                    logits, seen_rows, t_k, allowed_mask=allowed_mask
+                )
                 seen = sampler_mod.update_seen(
                     seen, jnp.where(active, row_slots, -1), out.tokens
                 )
@@ -205,7 +208,7 @@ class ModelRunner:
             return caches, seen, outs
 
         donate = (1, 2) if jax.default_backend() == "tpu" else ()
-        return jax.jit(decode_steps, static_argnums=(10,),
+        return jax.jit(decode_steps, static_argnums=(11,),
                        donate_argnums=donate)
 
     def _put(self, x) -> jax.Array:
@@ -273,7 +276,14 @@ class ModelRunner:
         self.seen = sampler_mod.set_seen_row(
             self.seen, self._put(np.asarray(seq.slot)), self._put(row_tokens)
         )
-        result = self._sample(last_logits, [seq])
+        allowed_mask = None
+        if seq.fsm is not None:
+            vocab = self.config.model_config.vocab_size
+            row = np.zeros(vocab, bool)
+            fsm_row = seq.fsm.allowed_row(seq.fsm_state)
+            row[: len(fsm_row)] = fsm_row
+            allowed_mask = self._put(row[None, :])
+        result = self._sample(last_logits, [seq], allowed_mask=allowed_mask)
         return result[0], prompt_info
 
     # ---------------------------------------------------------------- decode
@@ -314,6 +324,23 @@ class ModelRunner:
             fallback_seeds=seeds,
         )
 
+        # FSM-constrained rows: per-row token masks (constrained rows run
+        # exactly one step per dispatch, scheduler._allowed_steps); the
+        # mask arg stays None on unconstrained batches so the common path
+        # never pays the [B, V] transfer
+        allowed_mask = None
+        if any(seq.fsm is not None for seq in seqs):
+            vocab = self.config.model_config.vocab_size
+            mask = np.ones((b, vocab), bool)
+            for i, seq in enumerate(seqs):
+                if seq.fsm is not None:
+                    row = seq.fsm.allowed_row(seq.fsm_state)
+                    # model vocab may exceed the tokenizer's (padded
+                    # embeddings): ids the tokenizer can't spell stay banned
+                    mask[i, : len(row)] = row
+                    mask[i, len(row):] = False
+            allowed_mask = self._put(mask)
+
         self.caches, self.seen, outs = self._decode_fn(
             self.params,
             self.caches,
@@ -325,6 +352,7 @@ class ModelRunner:
             self._put(context_lens),
             self._put(slots),
             jax.tree.map(self._put, tensors),
+            allowed_mask,
             plan.num_steps,
         )
 
@@ -336,7 +364,9 @@ class ModelRunner:
 
     # --------------------------------------------------------------- sampler
 
-    def _sample(self, logits: jax.Array, seqs) -> list[SampledToken]:
+    def _sample(
+        self, logits: jax.Array, seqs, allowed_mask=None
+    ) -> list[SampledToken]:
         """Sample one token per row; rows beyond ``len(seqs)`` are padding."""
         b = logits.shape[0]
         params_list = [s.params for s in seqs] + [None] * (b - len(seqs))
@@ -356,7 +386,9 @@ class ModelRunner:
         seen_rows = jnp.take(
             self.seen, jnp.clip(jnp.asarray(slots), 0, None), axis=0
         )
-        out = sampler_mod.sample(logits, seen_rows, tensors)
+        out = sampler_mod.sample(
+            logits, seen_rows, tensors, allowed_mask=allowed_mask
+        )
         self.seen = sampler_mod.update_seen(
             self.seen, jnp.asarray(slots), out.tokens
         )

@@ -200,6 +200,18 @@ def sample(
     logits = logits.astype(jnp.float32)
     if allowed_mask is not None:
         logits = jnp.where(allowed_mask, logits, NEG_INF)
+        # an FSM dead-end state permits only EOS; min-tokens suppression
+        # would then leave an all -inf row, so the constraint wins and the
+        # row's min_tokens is lifted for this step
+        eos_col = jnp.take_along_axis(
+            allowed_mask, t.eos_token_id[:, None], axis=-1
+        )[:, 0]
+        non_eos_allowed = jnp.sum(allowed_mask, axis=-1) - eos_col.astype(
+            jnp.int32
+        )
+        t = dataclasses.replace(
+            t, min_tokens=jnp.where(non_eos_allowed > 0, t.min_tokens, 0)
+        )
     logits = apply_penalties(logits, seen, t)
 
     # token-info distribution: post-penalty, pre-filter (matches the TGIS

@@ -166,6 +166,10 @@ class Scheduler:
 
     def _allowed_steps(self, seq: Sequence) -> int:
         """Device steps row ``seq`` may run this dispatch (≥1)."""
+        if seq.fsm is not None:
+            # constrained rows take one step per dispatch: the host must
+            # advance the FSM and rebuild the token mask between tokens
+            return 1
         k = self.config.num_decode_steps
         if seq.params.max_tokens is not None:
             k = min(k, seq.params.max_tokens - seq.num_output_tokens)

@@ -75,6 +75,10 @@ class Sequence:
 
         self.blocks: Optional["SequenceBlocks"] = None
         self.slot: int = -1  # fixed batch row while RUNNING
+        # FSM-constrained decoding (engine/constrained.py): compiled token
+        # FSM + current state; None when the request is unconstrained
+        self.fsm = None
+        self.fsm_state: int = 0
         self.detokenizer: Optional["IncrementalDetokenizer"] = None
         # for DELTA streams: what has already been emitted
         self._emitted_text_len = 0

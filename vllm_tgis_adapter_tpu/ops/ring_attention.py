@@ -19,8 +19,6 @@ parity is pinned on the virtual CPU mesh in tests/test_ring_attention.py.
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 from jax import shard_map
@@ -123,8 +121,3 @@ def ring_prefill_attention(
         out_specs=seq,
         check_vma=False,
     )(q, k, v, jnp.asarray([valid_len], jnp.int32))
-
-
-@functools.partial(jax.jit, static_argnames=("scale", "mesh", "axis"))
-def _jitted(q, k, v, scale, valid_len, mesh, axis):
-    return ring_prefill_attention(q, k, v, scale, valid_len, mesh, axis)

@@ -126,7 +126,9 @@ def _remove_shared_pointers(tensors: dict) -> dict:
 
     by_storage = collections.defaultdict(list)
     for name, tensor in tensors.items():
-        by_storage[tensor.data_ptr()].append(name)
+        # group by the UNDERLYING storage: offset views have a different
+        # data_ptr but still alias (safetensors would reject them)
+        by_storage[tensor.untyped_storage().data_ptr()].append(name)
     kept = {}
     for names in by_storage.values():
         names = sorted(names)

@@ -34,7 +34,11 @@ def get_structured_output_params(
         return StructuredOutputsParams(choice=list(choice_list))
 
     if guided == "grammar":
-        return StructuredOutputsParams(grammar=decoding_params.grammar)
+        # surfaces at request validation → INVALID_ARGUMENT, not mid-stream
+        raise ValueError(
+            "grammar-constrained decoding is not supported yet; use "
+            "regex, choice, or json_schema"
+        )
 
     if decoding_params.format == DecodingParameters.JSON:
         return StructuredOutputsParams(json_object=True)
