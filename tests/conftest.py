@@ -60,7 +60,25 @@ def _build_args(argv: list[str]):
 
 
 @pytest.fixture(scope="session")
-def server_args(tiny_model_dir):
+def adapter_cache_dir(tmp_path_factory) -> str:
+    """Adapter cache with one real tiny-llama LoRA fixture + one non-LoRA
+    peft dir (exercised as the unsupported-type path, like the
+    reference's bloomz prompt-tuning fixture)."""
+    import json
+
+    from tests.fixture_models import build_tiny_lora_adapter
+
+    cache = tmp_path_factory.mktemp("adapters")
+    build_tiny_lora_adapter(str(cache / "tiny-lora"))
+    prompt_dir = cache / "tiny-prompt-adapter"
+    prompt_dir.mkdir()
+    json.dump({"peft_type": "PROMPT_TUNING"},
+              open(prompt_dir / "adapter_config.json", "w"))
+    return str(cache)
+
+
+@pytest.fixture(scope="session")
+def server_args(tiny_model_dir, adapter_cache_dir):
     from tests.utils import get_random_port
 
     return _build_args(
@@ -78,7 +96,7 @@ def server_args(tiny_model_dir):
             "--max-num-seqs",
             "8",
             "--adapter-cache",
-            str(Path(__file__).parent / "fixtures"),
+            adapter_cache_dir,
         ]
     )
 

@@ -120,3 +120,48 @@ def build_tiny_llama(path: str, seed: int = 0) -> str:
         }
     save_file(tensors, out / "model.safetensors")
     return str(out)
+
+
+def build_tiny_lora_adapter(path: str, seed: int = 7, rank: int = 4) -> str:
+    """PEFT-format LoRA adapter matching the tiny llama fixture: real
+    random A/B weights on q/v projections of both layers (the reference's
+    fixture adapters carry dummy weights; ours are live so generation
+    with the adapter measurably diverges from the base model)."""
+    import json as json_mod
+
+    import numpy as np
+    from safetensors.numpy import save_file
+
+    out = Path(path)
+    out.mkdir(parents=True, exist_ok=True)
+    cfg = TINY_LLAMA_CONFIG
+    d = cfg["hidden_size"]
+    dh = cfg["head_dim"]
+    h = cfg["num_attention_heads"]
+    hkv = cfg["num_key_value_heads"]
+    rng = np.random.default_rng(seed)
+
+    json_mod.dump(
+        {
+            "peft_type": "LORA",
+            "r": rank,
+            "lora_alpha": 4 * rank,  # strong scaling: visible deltas
+            "target_modules": ["q_proj", "v_proj"],
+            "base_model_name_or_path": "tiny-llama",
+        },
+        open(out / "adapter_config.json", "w"),
+        indent=2,
+    )
+
+    def w(shape):
+        return (rng.standard_normal(shape) * 0.5).astype(np.float32)
+
+    tensors = {}
+    for i in range(cfg["num_hidden_layers"]):
+        p = f"base_model.model.model.layers.{i}.self_attn"
+        tensors[f"{p}.q_proj.lora_A.weight"] = w((rank, d))
+        tensors[f"{p}.q_proj.lora_B.weight"] = w((h * dh, rank))
+        tensors[f"{p}.v_proj.lora_A.weight"] = w((rank, d))
+        tensors[f"{p}.v_proj.lora_B.weight"] = w((hkv * dh, rank))
+    save_file(tensors, out / "adapter_model.safetensors")
+    return str(out)

@@ -51,6 +51,11 @@ class LLMEngine:
             max_model_len=config.max_model_len,
         )
         self._seqs: dict[str, Sequence] = {}
+        # adapter registry consumed by the gRPC adapter store
+        # (grpc/adapters.py) and by the runner's stacked device tensors
+        from vllm_tgis_adapter_tpu.engine.lora import LoRAManager
+
+        self.lora_manager = LoRAManager(config.lora_config.max_loras)
 
     # ------------------------------------------------------------- lifecycle
 
@@ -124,6 +129,7 @@ class LLMEngine:
             fallback_seed=self.runner.new_fallback_seed(),
             lora_name=lora_name,
         )
+        seq.lora_slot = self.lora_manager.slot_of(lora_name)
         if params.structured_outputs is not None:
             from vllm_tgis_adapter_tpu.engine.constrained import compile_fsm
 
@@ -163,6 +169,7 @@ class LLMEngine:
             outputs.append(seq.to_request_output())
         self.scheduler.newly_finished.clear()
 
+        self.runner.sync_lora(self.lora_manager)
         plan = self.scheduler.schedule()
         if plan is None:
             return outputs

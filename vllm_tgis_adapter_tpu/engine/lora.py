@@ -117,13 +117,21 @@ def _normalize_key(key: str) -> str:
 
 class LoRAManager:
     """Registry of hot-loaded adapters, shaped like the serving-models
-    handler the reference adapter store talks to."""
+    handler the reference adapter store talks to.
+
+    Each adapter owns a device slot 1..max_loras (slot 0 = "no adapter",
+    identically zero); ``version`` bumps on every load/evict so the model
+    runner knows when to rebuild its stacked device tensors.
+    """
 
     def __init__(self, max_loras: int = 4):
         self.max_loras = max_loras
         self.lora_requests: dict[str, LoRARequest] = {}
         self._weights: dict[str, LoRAAdapterWeights] = {}
+        self._slots: dict[str, int] = {}
+        self._free_slots = list(range(max_loras, 0, -1))
         self._next_id = 1
+        self.version = 0
 
     async def load_lora_adapter(self, lora_name: str, lora_path: str) -> LoRARequest:
         """Load (or return the cached) adapter; raises LoRAError on bad input."""
@@ -132,18 +140,114 @@ class LoRAManager:
         import asyncio
 
         weights = await asyncio.to_thread(load_peft_adapter, lora_path)
-        if len(self.lora_requests) >= self.max_loras:
+        if not self._free_slots:
             evict = next(iter(self.lora_requests))
             logger.info("evicting LoRA adapter %s", evict)
             self.lora_requests.pop(evict, None)
             self._weights.pop(evict, None)
+            self._free_slots.append(self._slots.pop(evict))
         request = LoRARequest(
             lora_name=lora_name, lora_int_id=self._next_id, lora_path=lora_path
         )
         self._next_id += 1
         self.lora_requests[lora_name] = request
         self._weights[lora_name] = weights
+        self._slots[lora_name] = self._free_slots.pop()
+        self.version += 1
         return request
 
     def get_weights(self, lora_name: str) -> Optional[LoRAAdapterWeights]:
         return self._weights.get(lora_name)
+
+    def slot_of(self, lora_name: Optional[str]) -> int:
+        """Device slot for a loaded adapter name (0 = no adapter)."""
+        if lora_name is None:
+            return 0
+        return self._slots.get(lora_name, 0)
+
+    def loaded(self) -> list[tuple[int, LoRAAdapterWeights]]:
+        return [
+            (self._slots[name], w) for name, w in self._weights.items()
+        ]
+
+
+# ------------------------------------------------------------- device stacks
+
+# target module → (param key in models/llama.py, (d_in, d_out) resolver)
+LORA_TARGETS = (
+    "q_proj", "k_proj", "v_proj", "o_proj", "gate_proj", "up_proj",
+    "down_proj",
+)
+
+
+def _target_dims(mcfg, target: str) -> tuple[int, int]:
+    d, dh = mcfg.hidden_size, mcfg.head_dim
+    h, hkv, f = mcfg.num_heads, mcfg.num_kv_heads, mcfg.intermediate_size
+    return {
+        "q_proj": (d, h * dh),
+        "k_proj": (d, hkv * dh),
+        "v_proj": (d, hkv * dh),
+        "o_proj": (h * dh, d),
+        "gate_proj": (d, f),
+        "up_proj": (d, f),
+        "down_proj": (f, d),
+    }[target]
+
+
+import jax
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class LoRAStacks:
+    """Stacked device tensors for every loaded adapter.
+
+    One compiled program serves every adapter: ranks are padded to
+    ``max_rank`` and adapters live in fixed slots, so hot-loading swaps
+    data without recompiling (SURVEY.md §7 "LoRA on TPU without
+    per-adapter recompile").
+
+    ``a[target]``: [L, S, d_in, r] · ``b[target]``: [L, S, r, d_out] ·
+    ``scaling``: [S] (slot 0 zero).
+    """
+
+    a: dict
+    b: dict
+    scaling: object  # [S] f32
+
+
+def build_lora_stacks(mcfg, max_loras: int, max_rank: int,
+                      manager: LoRAManager) -> LoRAStacks:
+    """Host-side assembly of the padded stacks from loaded adapters."""
+    s_count = max_loras + 1
+    layers = mcfg.num_layers
+    a = {}
+    b = {}
+    scaling = np.zeros(s_count, np.float32)
+    for target in LORA_TARGETS:
+        din, dout = _target_dims(mcfg, target)
+        a[target] = np.zeros((layers, s_count, din, max_rank), np.float32)
+        b[target] = np.zeros((layers, s_count, max_rank, dout), np.float32)
+    for slot, weights in manager.loaded():
+        r = min(weights.rank, max_rank)
+        if weights.rank > max_rank:
+            logger.warning(
+                "adapter rank %d exceeds --max-lora-rank %d; truncating",
+                weights.rank, max_rank,
+            )
+        scaling[slot] = weights.scaling
+        for key, mat in weights.a.items():
+            # key = "layers.N.<target>"; PEFT lora_A is [r, d_in]
+            _, layer_s, target = key.split(".")
+            if target not in a or not layer_s.isdigit():
+                continue
+            layer = int(layer_s)
+            a[target][layer, slot, :, :r] = mat.T[:, :r]
+        for key, mat in weights.b.items():
+            # PEFT lora_B is [d_out, r]
+            _, layer_s, target = key.split(".")
+            if target not in b or not layer_s.isdigit():
+                continue
+            layer = int(layer_s)
+            b[target][layer, slot, :r, :] = mat.T[:r, :]
+    return LoRAStacks(a=a, b=b, scaling=scaling)

@@ -140,6 +140,24 @@ class ModelRunner:
         max_seqs = config.scheduler_config.max_num_seqs
         self.seen = self._put(jnp.zeros((max_seqs, mcfg.vocab_size), bool))
         self._rng = np.random.default_rng(config.seed)
+        self.lora_stacks = None
+        self._lora_version = 0  # manager starts at 0 = nothing loaded
+
+    def sync_lora(self, manager) -> None:
+        """Rebuild the stacked adapter tensors when the registry changed
+        (hot load/evict).  One compiled program serves every adapter —
+        slots and padded ranks keep shapes constant across reloads."""
+        if manager is None or manager.version == self._lora_version:
+            return
+        from vllm_tgis_adapter_tpu.engine.lora import build_lora_stacks
+
+        lcfg = self.config.lora_config
+        stacks = build_lora_stacks(
+            self.config.model_config, manager.max_loras,
+            lcfg.max_lora_rank, manager,
+        )
+        self.lora_stacks = jax.tree.map(self._put, stacks)
+        self._lora_version = manager.version
 
     def _build_decode_fn(self):
         """Fused K-step decode+sample program (SURVEY.md §7 recompilation
@@ -168,6 +186,8 @@ class ModelRunner:
             row_slots,  # [B] row index into ``seen``; -1 pads
             tensors: SamplingTensors,
             allowed_mask,  # [B, V] bool or None (FSM-constrained rows)
+            lora,  # LoRAStacks or None
+            lora_idx,  # [B] adapter slot per row or None
             num_steps: int,  # static: steps fused into this dispatch
         ):
             b = tokens.shape[0]
@@ -188,7 +208,7 @@ class ModelRunner:
                 )
                 logits, caches = model.decode(
                     params, caches, tokens, pos, slot, block_tables,
-                    context_lens0 + k, block_size,
+                    context_lens0 + k, block_size, lora, lora_idx,
                 )
                 t_k = dataclasses.replace(
                     tensors, gen_len=tensors.gen_len + k
@@ -208,7 +228,7 @@ class ModelRunner:
             return caches, seen, outs
 
         donate = (1, 2) if jax.default_backend() == "tpu" else ()
-        return jax.jit(decode_steps, static_argnums=(11,),
+        return jax.jit(decode_steps, static_argnums=(13,),
                        donate_argnums=donate)
 
     def _put(self, x) -> jax.Array:
@@ -244,6 +264,12 @@ class ModelRunner:
             else np.asarray([t - 1], np.int32)
         )
 
+        lora_args = ()
+        if self.lora_stacks is not None:
+            lora_args = (
+                self.lora_stacks,
+                self._put(np.asarray(seq.lora_slot, np.int32)),
+            )
         logits, self.caches = self._prefill_fn(
             self.params,
             self.caches,
@@ -252,6 +278,7 @@ class ModelRunner:
             self._put(slot_mapping),
             self._put(np.asarray(t, np.int32)),
             self._put(logits_indices),
+            *lora_args,
         )
 
         prompt_info = None
@@ -341,6 +368,14 @@ class ModelRunner:
                     mask[i, len(row):] = False
             allowed_mask = self._put(mask)
 
+        lora, lora_idx = None, None
+        if self.lora_stacks is not None:
+            lora = self.lora_stacks
+            idx = np.zeros(b, np.int32)
+            for i, seq in enumerate(seqs):
+                idx[i] = seq.lora_slot
+            lora_idx = self._put(idx)
+
         self.caches, self.seen, outs = self._decode_fn(
             self.params,
             self.caches,
@@ -353,6 +388,8 @@ class ModelRunner:
             self._put(slots),
             jax.tree.map(self._put, tensors),
             allowed_mask,
+            lora,
+            lora_idx,
             plan.num_steps,
         )
 

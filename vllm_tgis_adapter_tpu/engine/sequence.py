@@ -79,6 +79,8 @@ class Sequence:
         # FSM + current state; None when the request is unconstrained
         self.fsm = None
         self.fsm_state: int = 0
+        # device slot of this request's LoRA adapter (0 = base model)
+        self.lora_slot: int = 0
         self.detokenizer: Optional["IncrementalDetokenizer"] = None
         # for DELTA streams: what has already been emitted
         self._emitted_text_len = 0

@@ -63,6 +63,24 @@ def apply_rotary(
     return out.astype(x.dtype)
 
 
+def _lora_delta_single(lora, layer: int, slot, target: str, x: jax.Array):
+    """LoRA delta for one sequence (scalar adapter slot): x @ A @ B · s."""
+    a_l = lora.a[target][layer][slot]  # [din, r]
+    b_l = lora.b[target][layer][slot]  # [r, dout]
+    scale = lora.scaling[slot]
+    t = x.astype(jnp.float32) @ a_l
+    return (scale * (t @ b_l)).astype(x.dtype)
+
+
+def _lora_delta_batched(lora, layer: int, idx, target: str, x: jax.Array):
+    """Per-row adapter slots (decode batch): gathered batched A·B GEMMs."""
+    a_sel = jnp.take(lora.a[target][layer], idx, axis=0)  # [B, din, r]
+    b_sel = jnp.take(lora.b[target][layer], idx, axis=0)  # [B, r, dout]
+    t = jnp.einsum("bd,bdr->br", x.astype(jnp.float32), a_sel)
+    d = jnp.einsum("br,bro->bo", t, b_sel)
+    return (jnp.take(lora.scaling, idx)[:, None] * d).astype(x.dtype)
+
+
 class LlamaForCausalLM:
     def __init__(self, config: "ModelConfig"):
         self.config = config
@@ -125,12 +143,16 @@ class LlamaForCausalLM:
             return cfg.attention_multiplier
         return cfg.head_dim**-0.5
 
-    def _qkv(self, layer: dict, x: jax.Array) -> tuple[jax.Array, ...]:
+    def _qkv(self, layer: dict, x: jax.Array, dl=None) -> tuple[jax.Array, ...]:
         cfg = self.config
         t = x.shape[0]
         q = x @ layer["wq"]
         k = x @ layer["wk"]
         v = x @ layer["wv"]
+        if dl is not None:  # LoRA deltas share the projection input
+            q = q + dl("q_proj", x)
+            k = k + dl("k_proj", x)
+            v = v + dl("v_proj", x)
         if "bq" in layer:
             q = q + layer["bq"]
             k = k + layer["bk"]
@@ -141,10 +163,17 @@ class LlamaForCausalLM:
             v.reshape(t, cfg.num_kv_heads, cfg.head_dim),
         )
 
-    def _mlp(self, layer: dict, x: jax.Array) -> jax.Array:
-        return (jax.nn.silu(x @ layer["w_gate"]) * (x @ layer["w_up"])) @ layer[
-            "w_down"
-        ]
+    def _mlp(self, layer: dict, x: jax.Array, dl=None) -> jax.Array:
+        gate = x @ layer["w_gate"]
+        up = x @ layer["w_up"]
+        if dl is not None:
+            gate = gate + dl("gate_proj", x)
+            up = up + dl("up_proj", x)
+        h = jax.nn.silu(gate) * up
+        out = h @ layer["w_down"]
+        if dl is not None:
+            out = out + dl("down_proj", h)
+        return out
 
     def _embed(self, params: dict, token_ids: jax.Array) -> jax.Array:
         cfg = self.config
@@ -173,6 +202,8 @@ class LlamaForCausalLM:
         slot_mapping: jax.Array,  # [T] flat cache slot per token; -1 pads
         valid_len: jax.Array,  # scalar: number of real tokens
         logits_indices: jax.Array | None = None,  # [R] rows to compute logits for
+        lora=None,  # LoRAStacks (engine/lora.py) or None
+        lora_slot: jax.Array | None = None,  # scalar adapter slot
     ) -> tuple[jax.Array, tuple[jax.Array, jax.Array]]:
         """Full-prompt forward.
 
@@ -191,8 +222,15 @@ class LlamaForCausalLM:
 
         x = self._embed(params, token_ids)
         for i, layer in enumerate(params["layers"]):
+            dl = None
+            if lora is not None:
+                dl = (
+                    lambda target, xx, i=i: _lora_delta_single(
+                        lora, i, lora_slot, target, xx
+                    )
+                )
             h = rms_norm(x, layer["input_norm"], cfg.rms_norm_eps)
-            q, k, v = self._qkv(layer, h)
+            q, k, v = self._qkv(layer, h, dl)
             q = apply_rotary(q, cos, sin)
             k = apply_rotary(k, cos, sin)
             k_cache = k_cache.at[i, safe_slots].set(
@@ -203,11 +241,14 @@ class LlamaForCausalLM:
             )
             o = attn_ops.prefill_attention(q, k, v, scale, valid_len,
                                            mesh=self.mesh)
-            o = o.reshape(x.shape[0], -1) @ layer["wo"]
+            o_flat = o.reshape(x.shape[0], -1)
+            o = o_flat @ layer["wo"]
+            if dl is not None:
+                o = o + dl("o_proj", o_flat)
             x = x + cfg.residual_multiplier * o
 
             h = rms_norm(x, layer["post_attn_norm"], cfg.rms_norm_eps)
-            x = x + cfg.residual_multiplier * self._mlp(layer, h)
+            x = x + cfg.residual_multiplier * self._mlp(layer, h, dl)
 
         if logits_indices is not None:
             x = x[logits_indices]
@@ -223,6 +264,8 @@ class LlamaForCausalLM:
         block_tables: jax.Array,  # [B, max_blocks]
         context_lens: jax.Array,  # [B] length INCLUDING the current token
         block_size: int,
+        lora=None,  # LoRAStacks or None
+        lora_idx: jax.Array | None = None,  # [B] adapter slot per row
     ) -> tuple[jax.Array, tuple[jax.Array, jax.Array]]:
         """One decode step for the whole (padded) running batch."""
         cfg = self.config
@@ -234,8 +277,15 @@ class LlamaForCausalLM:
 
         x = self._embed(params, token_ids)
         for i, layer in enumerate(params["layers"]):
+            dl = None
+            if lora is not None:
+                dl = (
+                    lambda target, xx, i=i: _lora_delta_batched(
+                        lora, i, lora_idx, target, xx
+                    )
+                )
             h = rms_norm(x, layer["input_norm"], cfg.rms_norm_eps)
-            q, k, v = self._qkv(layer, h)
+            q, k, v = self._qkv(layer, h, dl)
             q = apply_rotary(q, cos, sin)
             k = apply_rotary(k, cos, sin)
             k_cache = k_cache.at[i, safe_slots].set(
@@ -248,10 +298,13 @@ class LlamaForCausalLM:
                 q, k_cache[i], v_cache[i], block_tables, context_lens,
                 block_size, scale, mesh=self.mesh,
             )
-            o = o.reshape(x.shape[0], -1) @ layer["wo"]
+            o_flat = o.reshape(x.shape[0], -1)
+            o = o_flat @ layer["wo"]
+            if dl is not None:
+                o = o + dl("o_proj", o_flat)
             x = x + cfg.residual_multiplier * o
 
             h = rms_norm(x, layer["post_attn_norm"], cfg.rms_norm_eps)
-            x = x + cfg.residual_multiplier * self._mlp(layer, h)
+            x = x + cfg.residual_multiplier * self._mlp(layer, h, dl)
 
         return self._logits(params, x), (k_cache, v_cache)
