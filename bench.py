@@ -5,6 +5,15 @@ jitted prefill/paged-decode → batched sampler → incremental detokenizer),
 the metric the driver tracks against BASELINE.json's north star (≥2000
 aggregate output tok/s, Llama-3-8B on v5e-8 over the TGIS port).
 
+Robustness contract (round-2, VERDICT #1): this script ALWAYS exits 0 and
+ALWAYS prints exactly one JSON line on stdout.  TPU backend availability
+is probed in a subprocess with a hard timeout — the round-1 run died
+inside in-process backend init (rc=1, no output), and the tunnel-backed
+plugin has also been observed to hang rather than fail.  If the probe
+fails or times out, the bench falls back to the CPU backend and reports
+the proxy number with a ``backend: cpu`` annotation; if the bench itself
+raises, the JSON line carries value 0 and the error.
+
 Proxy model (no network egress, 70B/8B checkpoints unavailable): a
 Llama-3.2-1B-shaped decoder with random weights and a 16k byte-level
 tokenizer.  Rationale: Llama-3-8B on v5e-8 runs TP=8, so each chip holds
@@ -14,38 +23,92 @@ single-chip tok/s on the proxy ≈ the aggregate tok/s the same engine
 would sustain on 8B/TP=8 (minus ICI collective overhead, which XLA
 overlaps).  vs_baseline = value / 2000.
 
+MFU: decode-phase model FLOPs/token are taken as 2 × (total elements of
+all ≥2-D weight arrays, i.e. every matmul operand incl. lm_head, excl.
+norm vectors) plus the attention KV-dot term, divided by the device's
+peak dense bf16 FLOP/s (per-device-kind table).  On CPU, mfu is null.
+
 Workload: 64 requests × 128 prompt tokens → 128 output tokens, greedy,
 max_num_seqs=32 (continuous batching ramps 1→32).  Warmup pass first so
 every (prefill-bucket, batch-bucket) program is compiled before timing.
 
 Env knobs: BENCH_TINY=1 (CI smoke on CPU), BENCH_REQUESTS, BENCH_PROMPT,
-BENCH_OUTPUT, BENCH_BATCH.
+BENCH_OUTPUT, BENCH_BATCH, BENCH_STEPS, BENCH_PROBE_TIMEOUT (s),
+BENCH_FORCE_CPU=1.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
+import sys
 import time
 
-import jax
-import numpy as np
-
-jax.config.update("jax_compilation_cache_dir", "/tmp/jax_compile_cache")
-
-# honour JAX_PLATFORMS=cpu even when a site hook pre-registered a TPU
-# plugin (env vars alone are read too late once jax is imported at
-# interpreter startup; see tests/conftest.py)
-if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
-    jax.config.update("jax_platforms", "cpu")
-
 BASELINE_TOKS = 2000.0  # BASELINE.json north star, v5e-8 aggregate
+
+# Peak dense bf16 FLOP/s per chip, by PJRT device_kind substring.
+# (Public figures: v4 275T, v5e 197T, v5p 459T, v6e/Trillium 918T.)
+_PEAK_FLOPS = (
+    ("v6e", 918e12),
+    ("v6", 918e12),
+    ("v5p", 459e12),
+    ("v5 lite", 197e12),
+    ("v5e", 197e12),
+    ("v5litepod", 197e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 46e12),
+)
+
+
+def _emit(value: float, *, extra: dict) -> None:
+    line = {
+        "metric": "aggregate_output_tok_per_s",
+        "value": round(float(value), 2),
+        "unit": "tok/s",
+        "vs_baseline": round(float(value) / BASELINE_TOKS, 4),
+    }
+    line.update(extra)
+    print(json.dumps(line), flush=True)
+
+
+def _probe_tpu(timeout_s: float) -> bool:
+    """Check TPU backend health in a throwaway subprocess.
+
+    Backend init happens inside the PJRT plugin with no in-process
+    timeout hook; a subprocess is the only way to bound it.  The probe
+    also runs one tiny computation so "initialises but cannot compile"
+    counts as unavailable.
+    """
+    code = (
+        "import jax, jax.numpy as jnp\n"
+        "assert jax.default_backend() == 'tpu', jax.default_backend()\n"
+        "assert float(jnp.ones(8).sum()) == 8.0\n"
+        "print('TPU_OK', jax.devices()[0].device_kind)\n"
+    )
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    try:
+        res = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=timeout_s, env=env,
+        )
+    except (subprocess.TimeoutExpired, OSError):
+        return False
+    return res.returncode == 0 and "TPU_OK" in res.stdout
+
+
+def _peak_flops(device_kind: str) -> float | None:
+    kind = device_kind.lower()
+    for sub, peak in _PEAK_FLOPS:
+        if sub in kind:
+            return peak
+    return None
 
 
 def build_model_dir(tiny: bool) -> tuple[str, dict]:
     """Write tokenizer + config for the bench model; params are random."""
-    import sys
-
     sys.path.insert(0, os.path.join(os.path.dirname(__file__), "tests"))
     from fixture_models import build_tokenizer
 
@@ -64,16 +127,15 @@ def build_model_dir(tiny: bool) -> tuple[str, dict]:
     return path, arch
 
 
-def main() -> None:
-    tiny = os.environ.get("BENCH_TINY", "") == "1" or (
-        jax.default_backend() != "tpu"
-    )
-    n_requests = int(os.environ.get("BENCH_REQUESTS", 16 if tiny else 64))
-    prompt_len = int(os.environ.get("BENCH_PROMPT", 32 if tiny else 128))
-    output_len = int(os.environ.get("BENCH_OUTPUT", 16 if tiny else 128))
-    max_seqs = int(os.environ.get("BENCH_BATCH", 8 if tiny else 32))
+def run_bench(on_tpu: bool) -> dict:
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", "/tmp/jax_compile_cache")
+    if not on_tpu:
+        jax.config.update("jax_platforms", "cpu")
 
     import jax.numpy as jnp
+    import numpy as np
     from transformers import AutoTokenizer
 
     from vllm_tgis_adapter_tpu.engine.config import (
@@ -87,6 +149,14 @@ def main() -> None:
     from vllm_tgis_adapter_tpu.engine.core import LLMEngine
     from vllm_tgis_adapter_tpu.engine.sampling_params import SamplingParams
     from vllm_tgis_adapter_tpu.models.llama import LlamaForCausalLM
+
+    backend = jax.default_backend()
+    device = jax.devices()[0]
+    tiny = os.environ.get("BENCH_TINY", "") == "1" or backend != "tpu"
+    n_requests = int(os.environ.get("BENCH_REQUESTS", 16 if tiny else 64))
+    prompt_len = int(os.environ.get("BENCH_PROMPT", 32 if tiny else 128))
+    output_len = int(os.environ.get("BENCH_OUTPUT", 16 if tiny else 128))
+    max_seqs = int(os.environ.get("BENCH_BATCH", 8 if tiny else 32))
 
     model_dir, arch = build_model_dir(tiny)
     dtype = jnp.float32 if tiny else jnp.bfloat16
@@ -115,6 +185,19 @@ def main() -> None:
     tokenizer = AutoTokenizer.from_pretrained(model_dir)
     engine = LLMEngine(config, model, params, tokenizer)
 
+    # matmul weight elements -> decode FLOPs/token (2*N MACs) for MFU
+    matmul_elems = sum(
+        int(np.prod(x.shape))
+        for x in jax.tree_util.tree_leaves(params)
+        if hasattr(x, "shape") and len(x.shape) >= 2
+    )
+    # QK + PV dots run once per QUERY head over the (average) context
+    attn_flops_per_tok = (
+        4 * arch["num_layers"] * arch["num_heads"] * arch["head_dim"]
+        * (prompt_len + output_len // 2)
+    )
+    flops_per_tok = 2 * matmul_elems + attn_flops_per_tok
+
     rng = np.random.default_rng(0)
 
     def run_pass(num: int, out_tokens: int) -> tuple[int, float]:
@@ -136,14 +219,41 @@ def main() -> None:
 
     run_pass(min(n_requests, 2 * max_seqs), output_len)  # compile warmup
     produced, elapsed = run_pass(n_requests, output_len)
-
     value = produced / elapsed
-    print(json.dumps({
-        "metric": "aggregate_output_tok_per_s",
-        "value": round(value, 2),
-        "unit": "tok/s",
-        "vs_baseline": round(value / BASELINE_TOKS, 4),
-    }))
+
+    peak = _peak_flops(device.device_kind) if backend == "tpu" else None
+    mfu = round(value * flops_per_tok / peak, 4) if peak else None
+    return {
+        "value": value,
+        "backend": backend,
+        "device_kind": device.device_kind,
+        "mfu": mfu,
+        "model_gflop_per_tok": round(flops_per_tok / 1e9, 3),
+        "requests": n_requests,
+        "prompt_len": prompt_len,
+        "output_len": output_len,
+        "produced_tok": produced,
+        "elapsed_s": round(elapsed, 3),
+    }
+
+
+def main() -> None:
+    on_tpu = False
+    try:
+        force_cpu = (
+            os.environ.get("BENCH_FORCE_CPU", "") == "1"
+            or os.environ.get("JAX_PLATFORMS", "").strip() == "cpu"
+        )
+        probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", 300))
+        on_tpu = False if force_cpu else _probe_tpu(probe_timeout)
+        stats = run_bench(on_tpu)
+    except Exception as exc:  # noqa: BLE001 — must still emit JSON
+        _emit(0.0, extra={"error": f"{type(exc).__name__}: {exc}",
+                          "tpu_probe_ok": on_tpu})
+        return
+    value = stats.pop("value")
+    stats["tpu_probe_ok"] = on_tpu
+    _emit(value, extra=stats)
 
 
 if __name__ == "__main__":

@@ -204,3 +204,63 @@ def test_non_lora_peft_rejected_over_grpc(grpc_client):
             "test", adapter_id="tiny-prompt-adapter"
         )
     assert excinfo.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+
+def test_pinned_adapter_never_evicted(lora_dir):
+    """A running sequence pins its adapter's slot; eviction must pick an
+    unpinned victim or fail the load with a retriable error (ADVICE r1:
+    silent slot reuse corrupted in-flight generations)."""
+    from vllm_tgis_adapter_tpu.engine.lora import LoRAError
+
+    mgr = LoRAManager(max_loras=2)
+    asyncio.run(mgr.load_lora_adapter("a", lora_dir))
+    asyncio.run(mgr.load_lora_adapter("b", lora_dir))
+    mgr.pin("a")
+    mgr.pin("b")
+    with pytest.raises(LoRAError, match="pinned by running requests"):
+        asyncio.run(mgr.load_lora_adapter("c", lora_dir))
+    # releasing one pin makes that adapter evictable again
+    mgr.unpin("a")
+    req = asyncio.run(mgr.load_lora_adapter("c", lora_dir))
+    assert req.lora_name == "c"
+    assert mgr.slot_of("a") == 0  # "a" was the eviction victim
+    assert mgr.slot_of("b") != 0  # pinned survivor kept its slot
+
+
+def test_pin_is_refcounted(lora_dir):
+    from vllm_tgis_adapter_tpu.engine.lora import LoRAError
+
+    mgr = LoRAManager(max_loras=1)
+    asyncio.run(mgr.load_lora_adapter("a", lora_dir))
+    mgr.pin("a")
+    mgr.pin("a")
+    mgr.unpin("a")
+    with pytest.raises(LoRAError):
+        asyncio.run(mgr.load_lora_adapter("b", lora_dir))
+    mgr.unpin("a")
+    asyncio.run(mgr.load_lora_adapter("b", lora_dir))
+    assert mgr.slot_of("b") != 0
+
+
+def test_over_rank_adapter_rejected(tmp_path):
+    """rank > --max-lora-rank must fail the load, not silently truncate
+    (ADVICE r1)."""
+    import numpy as np
+    from safetensors.numpy import save_file
+
+    from vllm_tgis_adapter_tpu.engine.lora import LoRAError
+
+    d = tmp_path / "big-rank"
+    d.mkdir()
+    (d / "adapter_config.json").write_text(json.dumps({
+        "peft_type": "LORA", "r": 128, "lora_alpha": 16,
+        "target_modules": ["q_proj"],
+    }))
+    save_file(
+        {"base_model.model.model.layers.0.self_attn.q_proj.lora_A.weight":
+         np.zeros((128, 64), np.float32)},
+        str(d / "adapter_model.safetensors"),
+    )
+    mgr = LoRAManager(max_loras=2, max_lora_rank=64)
+    with pytest.raises(LoRAError, match="exceeds --max-lora-rank"):
+        asyncio.run(mgr.load_lora_adapter("big", str(d)))

@@ -299,3 +299,57 @@ def test_min_tokens_yields_to_fsm_dead_end(guided_engine):
         for o in guided_engine.step():
             outputs[o.request_id] = o
     assert outputs["mintok2"].outputs[0].text in ("ab", "cd")
+
+
+def test_sentencepiece_byte_fallback_tokens():
+    """SP byte-fallback tokens like <0x0A> denote ONE raw byte; mapping
+    them through the ByteLevel char table banned them from constraints
+    (ADVICE r1: newline-requiring constraints became unsatisfiable)."""
+    from vllm_tgis_adapter_tpu.engine.constrained import token_byte_strings
+
+    class SPTok:
+        all_special_tokens = ["<s>", "</s>"]
+        _vocab = ["<s>", "</s>", "<0x0A>", "<0xFF>", "▁hello", "▁▁", "world"]
+
+        def __len__(self):
+            return len(self._vocab)
+
+        def convert_ids_to_tokens(self, ids):
+            return [self._vocab[i] for i in ids]
+
+    got = token_byte_strings(SPTok())
+    assert got[2] == b"\n"
+    assert got[3] == b"\xff"
+    assert got[4] == b" hello"
+    assert got[5] == b"  "
+    assert got[6] == b"world"
+
+
+def test_schema_pattern_anchors_stripped():
+    """^...$ anchors in a schema string pattern are outlines-style content
+    anchors, not literal bytes (ADVICE r1)."""
+    from vllm_tgis_adapter_tpu.engine.constrained import (
+        ByteDFA,
+        schema_to_regex,
+    )
+
+    rx = schema_to_regex(
+        {"type": "object",
+         "properties": {"id": {"type": "string", "pattern": "^[a-z]{3}$"}},
+         "required": ["id"]}
+    )
+    dfa = ByteDFA.from_regex(rx)
+    assert dfa.matches(b'{"id": "abc"}')
+    assert not dfa.matches(b'{"id": "ABC"}')
+    assert not dfa.matches(b'{"id": "^ab$"}')
+
+
+def test_schema_pattern_unescaped_quote_rejected():
+    from vllm_tgis_adapter_tpu.engine.constrained import schema_to_regex
+
+    with pytest.raises(ValueError, match="unescaped double quote"):
+        schema_to_regex(
+            {"type": "object",
+             "properties": {"x": {"type": "string", "pattern": 'a"b'}},
+             "required": ["x"]}
+        )

@@ -1,0 +1,170 @@
+"""KV-pool sizing from the HBM budget (kv_cache.resolve_num_blocks).
+
+The reference stack sizes its KV pool from ``gpu_memory_utilization``
+(vLLM engine-arg behavior the adapter inherits); these tests pin the TPU
+analog: pages derived from per-device free HBM after weights, shrinking
+per-device page cost under TP, fail-fast when one sequence cannot fit,
+and a static fallback on statless backends.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import pytest
+
+from vllm_tgis_adapter_tpu.engine.config import (
+    CacheConfig,
+    EngineConfig,
+    LoRAConfig,
+    ModelConfig,
+    ParallelConfig,
+    SchedulerConfig,
+)
+from vllm_tgis_adapter_tpu.engine.kv_cache import (
+    _FALLBACK_BLOCKS,
+    resolve_num_blocks,
+)
+
+
+class FakeDevice:
+    def __init__(self, stats):
+        self._stats = stats
+
+    def memory_stats(self):
+        return self._stats
+
+
+def make_config(
+    *,
+    num_kv_heads=8,
+    num_layers=4,
+    head_dim=64,
+    max_model_len=2048,
+    block_size=16,
+    max_num_seqs=32,
+    tp=1,
+    util=0.9,
+):
+    mcfg = ModelConfig(
+        model="/tmp/x", model_type="llama", vocab_size=1024,
+        hidden_size=num_kv_heads * head_dim * 2, intermediate_size=256,
+        num_layers=num_layers, num_heads=num_kv_heads * 2,
+        num_kv_heads=num_kv_heads, head_dim=head_dim,
+        max_model_len=max_model_len, dtype=jnp.bfloat16,
+    )
+    return EngineConfig(
+        model_config=mcfg,
+        cache_config=CacheConfig(block_size=block_size, num_blocks=0,
+                                 cache_dtype=jnp.bfloat16),
+        scheduler_config=SchedulerConfig(max_num_seqs=max_num_seqs),
+        parallel_config=ParallelConfig(tensor_parallel_size=tp),
+        lora_config=LoRAConfig(),
+        hbm_memory_utilization=util,
+    )
+
+
+def block_bytes(cfg, tp=1):
+    m = cfg.model_config
+    return (
+        2 * m.num_layers * cfg.cache_config.block_size
+        * (m.num_kv_heads // tp) * m.head_dim * 2  # bf16
+    )
+
+
+def test_blocks_scale_with_budget():
+    cfg = make_config()
+    bb = block_bytes(cfg)
+    small = resolve_num_blocks(
+        cfg, FakeDevice({"bytes_limit": 1000 * bb, "bytes_in_use": 0})
+    )
+    big = resolve_num_blocks(
+        cfg, FakeDevice({"bytes_limit": 2000 * bb, "bytes_in_use": 0})
+    )
+    assert small == 900  # 1000 * 0.9 utilization
+    assert big == 1800
+
+
+def test_in_use_bytes_subtracted():
+    cfg = make_config(util=1.0)
+    bb = block_bytes(cfg)
+    got = resolve_num_blocks(
+        cfg,
+        FakeDevice({"bytes_limit": 1000 * bb, "bytes_in_use": 400 * bb}),
+    )
+    assert got == 600
+
+
+def test_capped_at_full_batch_occupancy():
+    cfg = make_config(max_num_seqs=2, max_model_len=64, block_size=16,
+                      util=1.0)
+    bb = block_bytes(cfg)
+    got = resolve_num_blocks(
+        cfg, FakeDevice({"bytes_limit": 10**6 * bb, "bytes_in_use": 0})
+    )
+    assert got == 2 * (64 // 16)  # pages beyond full occupancy are dead
+
+
+def test_tp_shrinks_per_device_page_cost():
+    # under TP=4 each device holds 1/4 of the kv heads per page, so the
+    # same per-device budget fits 4x the pages
+    cfg1 = make_config(tp=1, util=1.0)
+    cfg4 = make_config(tp=4, util=1.0)
+    bb1 = block_bytes(cfg1)
+    dev = FakeDevice({"bytes_limit": 200 * bb1, "bytes_in_use": 0})
+    assert resolve_num_blocks(cfg4, dev) == 4 * resolve_num_blocks(cfg1, dev)
+
+
+def test_too_small_budget_raises():
+    cfg = make_config(max_model_len=2048, block_size=16, util=1.0)
+    bb = block_bytes(cfg)
+    with pytest.raises(RuntimeError, match="KV cache budget too small"):
+        resolve_num_blocks(
+            cfg, FakeDevice({"bytes_limit": 10 * bb, "bytes_in_use": 0})
+        )
+
+
+def test_statless_backend_falls_back():
+    cfg = make_config()
+    assert resolve_num_blocks(cfg, FakeDevice(None)) == _FALLBACK_BLOCKS
+    assert resolve_num_blocks(cfg, FakeDevice({})) == _FALLBACK_BLOCKS
+
+
+def test_engine_resolves_auto_sizing(tiny_model_dir):
+    """num_blocks=0 in the config must be resolved by engine boot."""
+    import jax
+    from transformers import AutoTokenizer
+
+    from vllm_tgis_adapter_tpu.engine.core import LLMEngine
+    from vllm_tgis_adapter_tpu.models.llama import LlamaForCausalLM
+
+    mcfg = ModelConfig.from_pretrained(tiny_model_dir, max_model_len=128,
+                                       dtype="float32")
+    cfg = EngineConfig(
+        model_config=mcfg,
+        cache_config=CacheConfig(block_size=16, num_blocks=0,
+                                 cache_dtype=jnp.float32),
+        scheduler_config=SchedulerConfig(max_num_seqs=4,
+                                         prefill_buckets=(32, 128)),
+        parallel_config=ParallelConfig(),
+        lora_config=LoRAConfig(),
+    )
+    model = LlamaForCausalLM(mcfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+    tokenizer = AutoTokenizer.from_pretrained(tiny_model_dir)
+    bb = (2 * mcfg.num_layers * 16 * mcfg.num_kv_heads * mcfg.head_dim * 4)
+    dev = FakeDevice({"bytes_limit": 200 * bb, "bytes_in_use": 20 * bb})
+    engine = LLMEngine(cfg, model, params, tokenizer, memory_device=dev)
+    expected = min(int(200 * 0.9) - 20, 4 * (128 // 16))
+    assert engine.config.cache_config.num_blocks == expected
+    assert engine.scheduler.allocator.num_blocks == expected
+
+
+def test_from_args_requests_auto_sizing(tiny_model_dir):
+    from vllm_tgis_adapter_tpu.tgis_utils.args import make_parser
+
+    parser = make_parser()
+    args = parser.parse_args(["--model", tiny_model_dir])
+    cfg = EngineConfig.from_args(args)
+    assert cfg.cache_config.num_blocks == 0  # auto → resolved at boot

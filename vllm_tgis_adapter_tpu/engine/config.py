@@ -141,7 +141,9 @@ class CacheConfig:
     """Paged KV-cache geometry."""
 
     block_size: int = 16
-    num_blocks: int = 512  # resolved against the HBM budget at engine boot
+    # <= 0 requests auto-sizing against the HBM budget at engine boot
+    # (kv_cache.resolve_num_blocks); a positive value is used as-is
+    num_blocks: int = 512
     cache_dtype: Any = jnp.bfloat16
 
 
@@ -217,6 +219,7 @@ class EngineConfig:
             model_config=model_config,
             cache_config=CacheConfig(
                 block_size=args.block_size,
+                num_blocks=0,  # auto-size from HBM at engine boot
                 cache_dtype=(
                     model_config.dtype
                     if args.kv_cache_dtype == "auto"

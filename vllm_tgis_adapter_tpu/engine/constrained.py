@@ -402,7 +402,24 @@ def schema_to_regex(schema: dict | str) -> str:
             ) + ")"
         if t == "string":
             if "pattern" in s:
-                return f'"{s["pattern"]}"'
+                # the pattern constrains the string *content* inside the
+                # JSON quotes: anchors would be literal bytes to our regex
+                # engine (strip them, as outlines does) and an unescaped
+                # quote would break out of the JSON-string context
+                pat = s["pattern"]
+                if pat.startswith("^"):
+                    pat = pat[1:]
+                if pat.endswith("$") and not pat.endswith("\\$"):
+                    pat = pat[:-1]
+                prev = ""
+                for ch in pat:
+                    if ch == '"' and prev != "\\":
+                        raise ValueError(
+                            "schema string pattern must not contain an "
+                            "unescaped double quote"
+                        )
+                    prev = "" if prev == "\\" else ch
+                return f'"{pat}"'
             return _JSON_STRING
         if t == "integer":
             return _JSON_INT
@@ -601,15 +618,32 @@ def token_byte_strings(tokenizer) -> list[bytes]:
     tokens = tokenizer.convert_ids_to_tokens(list(range(vocab_size)))
     table = _bytelevel_decoder()
     special = set(tokenizer.all_special_tokens)
+    # the ByteLevel char table only applies to byte-level (GPT-2/llama-3
+    # style) vocabs — detected by the Ġ space marker.  Applying it to a
+    # sentencepiece vocab would mistranslate any token whose chars happen
+    # to all sit in the table (e.g. byte-fallback "<0x0A>").
+    bytelevel = any(t is not None and "Ġ" in t for t in tokens)
     out: list[bytes] = []
     for tok in tokens:
         if tok is None or tok in special:
             out.append(b"")  # specials are never constraint-legal
             continue
-        if all(c in table for c in tok):
+        if tok.startswith("▁"):  # sentencepiece underline = space
+            out.append(tok.replace("▁", " ").encode("utf-8"))
+            continue
+        if (
+            len(tok) == 6
+            and tok.startswith("<0x")
+            and tok.endswith(">")
+        ):
+            # sentencepiece byte-fallback token: denotes one raw byte
+            try:
+                out.append(bytes([int(tok[3:5], 16)]))
+                continue
+            except ValueError:
+                pass
+        if bytelevel and all(c in table for c in tok):
             out.append(bytes(table[c] for c in tok))
-        elif tok.startswith("▁"):  # sentencepiece underline
-            out.append((" " + tok[1:]).encode("utf-8"))
         else:
             out.append(tok.encode("utf-8"))
     _TOKEN_BYTES_CACHE[key] = out

@@ -40,7 +40,23 @@ class LLMEngine:
     """Single-process engine: one model, one scheduler, one device program."""
 
     def __init__(self, config: EngineConfig, model, params, tokenizer,
-                 mesh=None):
+                 mesh=None, memory_device=None):
+        if config.cache_config.num_blocks <= 0:
+            # auto-size the KV pool from free HBM now that the weights are
+            # resident (reference behavior: vLLM's gpu_memory_utilization)
+            import dataclasses as _dc
+
+            from vllm_tgis_adapter_tpu.engine.kv_cache import (
+                resolve_num_blocks,
+            )
+
+            config = _dc.replace(
+                config,
+                cache_config=_dc.replace(
+                    config.cache_config,
+                    num_blocks=resolve_num_blocks(config, memory_device),
+                ),
+            )
         self.config = config
         self.tokenizer = tokenizer
         self.runner = ModelRunner(config, model, params, mesh=mesh)
@@ -55,7 +71,9 @@ class LLMEngine:
         # (grpc/adapters.py) and by the runner's stacked device tensors
         from vllm_tgis_adapter_tpu.engine.lora import LoRAManager
 
-        self.lora_manager = LoRAManager(config.lora_config.max_loras)
+        self.lora_manager = LoRAManager(
+            config.lora_config.max_loras, config.lora_config.max_lora_rank
+        )
 
     # ------------------------------------------------------------- lifecycle
 
@@ -144,6 +162,11 @@ class LLMEngine:
             seq.prompt_token_ids,
             skip_special_tokens=params.skip_special_tokens,
         )
+        # pin for the sequence's whole lifetime (incl. preemption-resume):
+        # eviction must not reassign a slot a running row still indexes.
+        # Pinned only once admission can no longer fail — an exception
+        # above this line must not leak a ref no finish path will release.
+        self.lora_manager.pin(lora_name)
         self._seqs[request_id] = seq
         self.scheduler.add(seq)
 
@@ -152,6 +175,7 @@ class LLMEngine:
         if seq is None or seq.is_finished:
             return None
         self.scheduler.abort(request_id)
+        self.lora_manager.unpin(seq.lora_name)
         seq.metrics.finished_time = time.time()
         return seq.to_request_output()
 
@@ -165,6 +189,7 @@ class LLMEngine:
         outputs: list[RequestOutput] = []
         for seq in self.scheduler.newly_finished:
             self._seqs.pop(seq.request_id, None)
+            self.lora_manager.unpin(seq.lora_name)
             seq.metrics.finished_time = time.time()
             outputs.append(seq.to_request_output())
         self.scheduler.newly_finished.clear()
@@ -226,6 +251,7 @@ class LLMEngine:
                     seq.metrics.finished_time = now
                     self.scheduler.finish(seq)
                     self._seqs.pop(seq.request_id, None)
+                    self.lora_manager.unpin(seq.lora_name)
                     outputs.append(seq.to_request_output())
                     break
                 if seq.params.output_kind != RequestOutputKind.FINAL_ONLY:

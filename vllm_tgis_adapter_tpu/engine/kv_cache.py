@@ -12,9 +12,117 @@ from the HBM budget left after weights (engine/core.py).
 
 from __future__ import annotations
 
+from typing import TYPE_CHECKING, Optional
+
 from vllm_tgis_adapter_tpu.logging import init_logger
 
+if TYPE_CHECKING:
+    from vllm_tgis_adapter_tpu.engine.config import EngineConfig
+
 logger = init_logger(__name__)
+
+# Static pool size used when the backend exposes no memory stats (CPU/test
+# backends): enough pages that the CI-sized models never preempt, small
+# enough not to blow up host RAM in the 8-virtual-device suite.
+_FALLBACK_BLOCKS = 2048
+
+
+def _lora_stack_bytes(config: "EngineConfig") -> int:
+    """Device bytes of the padded LoRA stacks (engine/lora.py
+    ``build_lora_stacks``): f32 ``[L, S, d_in, r]`` + ``[L, S, r, d_out]``
+    per target, S = max_loras + 1."""
+    if not config.lora_config.enabled:
+        return 0
+    from vllm_tgis_adapter_tpu.engine.lora import LORA_TARGETS, _target_dims
+
+    m = config.model_config
+    s = config.lora_config.max_loras + 1
+    r = config.lora_config.max_lora_rank
+    elems = 0
+    for target in LORA_TARGETS:
+        din, dout = _target_dims(m, target)
+        elems += m.num_layers * s * (din * r + r * dout)
+    return elems * 4
+
+
+def resolve_num_blocks(
+    config: "EngineConfig", device=None
+) -> int:
+    """Size the KV page pool from the device's free-HBM budget.
+
+    The reference stack sizes its pool from ``gpu_memory_utilization``
+    (vLLM behavior the adapter inherits via its engine args); the TPU
+    analog measures per-device free HBM AFTER the weights are resident
+    (PJRT ``memory_stats``), applies ``hbm_memory_utilization`` to the
+    device's total, and divides by the per-device bytes of one page.
+
+    Under TP the cache is head-sharded, so each device holds
+    ``num_kv_heads / tp`` heads of every page — the per-device page cost
+    shrinks with the mesh and the pool grows accordingly.
+
+    Backends without memory stats (CPU tests) fall back to a static pool.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    mcfg = config.model_config
+    ccfg = config.cache_config
+    tp = config.parallel_config.tensor_parallel_size or 1
+    kv_heads_per_dev = max(1, mcfg.num_kv_heads // tp)
+    itemsize = jnp.dtype(ccfg.cache_dtype).itemsize
+    block_bytes = (
+        2 * mcfg.num_layers * ccfg.block_size
+        * kv_heads_per_dev * mcfg.head_dim * itemsize
+    )
+    blocks_per_seq = -(-mcfg.max_model_len // ccfg.block_size)
+    # beyond full occupancy (every batch row at max_model_len) extra pages
+    # can never be touched
+    full_occupancy = config.scheduler_config.max_num_seqs * blocks_per_seq
+
+    if device is None:
+        device = jax.local_devices()[0]
+    stats: Optional[dict] = None
+    try:
+        stats = device.memory_stats()
+    except Exception:  # pragma: no cover - backend-dependent API
+        stats = None
+    limit = (stats or {}).get("bytes_limit")
+    in_use = (stats or {}).get("bytes_in_use", 0)
+    if not limit:
+        num_blocks = min(full_occupancy, _FALLBACK_BLOCKS)
+        logger.info(
+            "backend exposes no memory stats; static KV pool of %d pages "
+            "(%d tokens)", num_blocks, num_blocks * ccfg.block_size,
+        )
+        return num_blocks
+
+    budget = int(limit * config.hbm_memory_utilization) - int(in_use)
+    lora_bytes = _lora_stack_bytes(config)
+    if lora_bytes:
+        # the runner materialises the stacked adapter tensors on the first
+        # hot-load (runner.sync_lora), AFTER the pool is sized — reserve
+        # their footprint now or the first load OOMs
+        budget -= lora_bytes
+        logger.info(
+            "reserving %.2f GB for LoRA adapter stacks", lora_bytes / 1e9
+        )
+    num_blocks = budget // block_bytes
+    if num_blocks < blocks_per_seq:
+        raise RuntimeError(
+            f"KV cache budget too small: {budget / 1e9:.2f} GB free under "
+            f"hbm_memory_utilization={config.hbm_memory_utilization} fits "
+            f"{max(num_blocks, 0)} pages but one max-length sequence needs "
+            f"{blocks_per_seq}; lower --max-model-len or raise "
+            f"--hbm-memory-utilization"
+        )
+    num_blocks = min(num_blocks, full_occupancy)
+    logger.info(
+        "KV pool: %d pages x %d tokens (%.2f GB/device of %.2f GB HBM, "
+        "%.2f GB in use after weights)",
+        num_blocks, ccfg.block_size, num_blocks * block_bytes / 1e9,
+        limit / 1e9, in_use / 1e9,
+    )
+    return num_blocks
 
 
 class BlockAllocator:

@@ -124,11 +124,16 @@ class LoRAManager:
     runner knows when to rebuild its stacked device tensors.
     """
 
-    def __init__(self, max_loras: int = 4):
+    def __init__(self, max_loras: int = 4, max_lora_rank: int = 64):
         self.max_loras = max_loras
+        self.max_lora_rank = max_lora_rank
         self.lora_requests: dict[str, LoRARequest] = {}
         self._weights: dict[str, LoRAAdapterWeights] = {}
         self._slots: dict[str, int] = {}
+        # in-flight sequences per adapter: a pinned (refcount > 0) adapter
+        # must never be evicted — its running sequences hold the slot index
+        # and would silently decode with the replacement's weights
+        self._refs: dict[str, int] = {}
         self._free_slots = list(range(max_loras, 0, -1))
         self._next_id = 1
         self.version = 0
@@ -140,11 +145,27 @@ class LoRAManager:
         import asyncio
 
         weights = await asyncio.to_thread(load_peft_adapter, lora_path)
+        if weights.rank > self.max_lora_rank:
+            # truncating silently corrupts every request using the adapter;
+            # the reference path rejects over-rank adapters at load time
+            raise LoRAError(
+                f"adapter rank {weights.rank} exceeds --max-lora-rank "
+                f"{self.max_lora_rank}"
+            )
         if not self._free_slots:
-            evict = next(iter(self.lora_requests))
+            evict = next(
+                (n for n in self.lora_requests if not self._refs.get(n)),
+                None,
+            )
+            if evict is None:
+                raise LoRAError(
+                    f"all {self.max_loras} adapter slots are pinned by "
+                    "running requests; retry when they finish"
+                )
             logger.info("evicting LoRA adapter %s", evict)
             self.lora_requests.pop(evict, None)
             self._weights.pop(evict, None)
+            self._refs.pop(evict, None)
             self._free_slots.append(self._slots.pop(evict))
         request = LoRARequest(
             lora_name=lora_name, lora_int_id=self._next_id, lora_path=lora_path
@@ -164,6 +185,23 @@ class LoRAManager:
         if lora_name is None:
             return 0
         return self._slots.get(lora_name, 0)
+
+    def pin(self, lora_name: Optional[str]) -> None:
+        """Mark one in-flight sequence as using ``lora_name``.
+
+        Counted by name regardless of load state so pin/unpin stay
+        symmetric: a sequence admitted while its adapter happened to be
+        unloaded must not, on finish, steal the pin of a sequence that
+        loaded it later.
+        """
+        if lora_name is not None:
+            self._refs[lora_name] = self._refs.get(lora_name, 0) + 1
+
+    def unpin(self, lora_name: Optional[str]) -> None:
+        if lora_name in self._refs:
+            self._refs[lora_name] -= 1
+            if self._refs[lora_name] <= 0:
+                del self._refs[lora_name]
 
     def loaded(self) -> list[tuple[int, LoRAAdapterWeights]]:
         return [

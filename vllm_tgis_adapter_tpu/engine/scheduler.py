@@ -207,13 +207,17 @@ class Scheduler:
         if not self.running:
             return None
         seqs = sorted(self.running, key=lambda s: s.slot)
+        steps_per_seq = [planned[id(s)] for s in seqs]
         return DecodePlan(
             seqs=seqs,
             batch_bucket=self._batch_bucket(len(seqs)),
-            # fixed step count per dispatch keeps one compiled program per
-            # batch bucket; rows with fewer planned steps are masked
-            num_steps=self.config.num_decode_steps,
-            steps_per_seq=[planned[id(s)] for s in seqs],
+            # fuse only as many steps as some row can consume: an
+            # all-FSM-constrained batch (every row at 1 step) would
+            # otherwise pay num_decode_steps of dead decode+sample work.
+            # num_steps is a static jit arg bounded by num_decode_steps,
+            # so this adds at most a handful of compiles per batch bucket.
+            num_steps=max(steps_per_seq),
+            steps_per_seq=steps_per_seq,
         )
 
     def _batch_bucket(self, n: int) -> int:
