@@ -17,16 +17,22 @@ from __future__ import annotations
 import os
 from pathlib import Path
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+# RUN_TPU_TESTS=1 keeps the real backend so `pytest -m tpu` compiles the
+# Pallas kernels through Mosaic on hardware (tests/test_tpu_kernels.py) —
+# the gate that interpreter-mode parity structurally cannot provide
+_TPU_RUN = os.environ.get("RUN_TPU_TESTS") == "1"
+if not _TPU_RUN:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+if not _TPU_RUN:
+    jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

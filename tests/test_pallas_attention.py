@@ -18,14 +18,15 @@ from vllm_tgis_adapter_tpu.ops import pallas_attention as pk
 
 
 def make_paged_case(seed, b, num_kv, g, head_dim, block_size, max_blocks,
-                    num_slots):
+                    num_slots, dtype=np.float32):
+    """Shared paged-decode test case builder (also used by the on-hardware
+    gate in test_tpu_kernels.py — one construction, two suites)."""
     rng = np.random.default_rng(seed)
     h = num_kv * g
-    q = rng.standard_normal((b, h, head_dim), dtype=np.float32)
-    k_cache = rng.standard_normal((num_slots, num_kv, head_dim),
-                                  dtype=np.float32)
-    v_cache = rng.standard_normal((num_slots, num_kv, head_dim),
-                                  dtype=np.float32)
+    q = rng.standard_normal((b, h, head_dim)).astype(dtype)
+    # head-leading cache layout (ops/pallas_attention.py docstring)
+    k_cache = rng.standard_normal((num_kv, num_slots, head_dim)).astype(dtype)
+    v_cache = rng.standard_normal((num_kv, num_slots, head_dim)).astype(dtype)
     # distinct random pages per sequence, random context lengths
     pages = rng.permutation(num_slots // block_size)[: b * max_blocks]
     block_tables = pages.reshape(b, max_blocks).astype(np.int32)

@@ -240,14 +240,14 @@ def test_write_kv_drops_negative_slots():
 
     from vllm_tgis_adapter_tpu.ops.attention import write_kv
 
-    k_cache = jnp.zeros((8, 2, 4))
-    v_cache = jnp.zeros((8, 2, 4))
-    k = jnp.ones((2, 2, 4))
+    k_cache = jnp.zeros((2, 8, 4))  # [Hkv, slots, Dh] head-leading
+    v_cache = jnp.zeros((2, 8, 4))
+    k = jnp.ones((2, 2, 4))  # [T, Hkv, Dh]
     v = jnp.ones((2, 2, 4))
     k2, v2 = write_kv(k_cache, v_cache, k, v, jnp.asarray([1, -1]))
-    assert float(k2[1].sum()) > 0
-    assert float(k2[7].sum()) == 0.0  # slot -1 dropped, not wrapped
-    assert float(v2[7].sum()) == 0.0
+    assert float(k2[:, 1].sum()) > 0
+    assert float(k2[:, 7].sum()) == 0.0  # slot -1 dropped, not wrapped
+    assert float(v2[:, 7].sum()) == 0.0
 
 
 def test_prompt_seen_matrix_and_update(sampler_mod):

@@ -131,8 +131,11 @@ class LlamaForCausalLM:
         return params
 
     def make_kv_caches(self, num_slots: int, dtype) -> tuple[jax.Array, jax.Array]:
+        # head-leading layout: a KV page is a contiguous (block_size, Dh)
+        # tile per head — the shape the Pallas decode kernel DMAs directly
+        # (ops/pallas_attention.py module docstring)
         cfg = self.config
-        shape = (cfg.num_layers, num_slots, cfg.num_kv_heads, cfg.head_dim)
+        shape = (cfg.num_layers, cfg.num_kv_heads, num_slots, cfg.head_dim)
         return jnp.zeros(shape, dtype=dtype), jnp.zeros(shape, dtype=dtype)
 
     # --------------------------------------------------------------- forward
@@ -196,7 +199,7 @@ class LlamaForCausalLM:
     def prefill(
         self,
         params: dict,
-        caches: tuple[jax.Array, jax.Array],  # ([L,S,Hkv,Dh], [L,S,Hkv,Dh])
+        caches: tuple[jax.Array, jax.Array],  # ([L,Hkv,S,Dh], [L,Hkv,S,Dh])
         token_ids: jax.Array,  # [T] padded to a bucket length
         positions: jax.Array,  # [T]
         slot_mapping: jax.Array,  # [T] flat cache slot per token; -1 pads
@@ -218,7 +221,7 @@ class LlamaForCausalLM:
         cos, sin = rotary_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
         # negative (padding) slots must not wrap: remap past the end, then
         # scatter mode='drop' discards them (JAX drops only positive OOB)
-        safe_slots = jnp.where(slot_mapping < 0, k_cache.shape[1], slot_mapping)
+        safe_slots = jnp.where(slot_mapping < 0, k_cache.shape[2], slot_mapping)
 
         x = self._embed(params, token_ids)
         for i, layer in enumerate(params["layers"]):
@@ -233,10 +236,10 @@ class LlamaForCausalLM:
             q, k, v = self._qkv(layer, h, dl)
             q = apply_rotary(q, cos, sin)
             k = apply_rotary(k, cos, sin)
-            k_cache = k_cache.at[i, safe_slots].set(
+            k_cache = k_cache.at[i, :, safe_slots].set(
                 k.astype(k_cache.dtype), mode="drop"
             )
-            v_cache = v_cache.at[i, safe_slots].set(
+            v_cache = v_cache.at[i, :, safe_slots].set(
                 v.astype(v_cache.dtype), mode="drop"
             )
             o = attn_ops.prefill_attention(q, k, v, scale, valid_len,
@@ -273,7 +276,7 @@ class LlamaForCausalLM:
         scale = self._attention_scale()
         cos, sin = rotary_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
         # see prefill: negative pad slots must not wrap to the last page
-        safe_slots = jnp.where(slot_mapping < 0, k_cache.shape[1], slot_mapping)
+        safe_slots = jnp.where(slot_mapping < 0, k_cache.shape[2], slot_mapping)
 
         x = self._embed(params, token_ids)
         for i, layer in enumerate(params["layers"]):
@@ -288,10 +291,10 @@ class LlamaForCausalLM:
             q, k, v = self._qkv(layer, h, dl)
             q = apply_rotary(q, cos, sin)
             k = apply_rotary(k, cos, sin)
-            k_cache = k_cache.at[i, safe_slots].set(
+            k_cache = k_cache.at[i, :, safe_slots].set(
                 k.astype(k_cache.dtype), mode="drop"
             )
-            v_cache = v_cache.at[i, safe_slots].set(
+            v_cache = v_cache.at[i, :, safe_slots].set(
                 v.astype(v_cache.dtype), mode="drop"
             )
             o = attn_ops.paged_decode_attention(

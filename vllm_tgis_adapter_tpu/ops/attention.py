@@ -9,10 +9,13 @@ TPU kernels in ``pallas_attention.py``, which are swapped in at engine boot
 when running on real TPU hardware.
 
 Layout choices (TPU-first):
-* KV cache is one array per K/V of shape ``[num_layers, num_slots, kv_heads,
-  head_dim]`` where ``num_slots = num_blocks * block_size`` — a flat slot
-  dimension so page writes are scatters and page reads are gathers with
-  plain integer indices (no data-dependent shapes, jit-stable).
+* KV cache is one array per K/V of shape ``[num_layers, kv_heads, num_slots,
+  head_dim]`` where ``num_slots = num_blocks * block_size`` — head-leading
+  so a KV page is a contiguous ``(block_size, head_dim)`` tile, the layout
+  Mosaic can DMA as a legal (sublane, lane) block (see
+  pallas_attention.py's module docstring); the flat slot dimension keeps
+  page writes as scatters and page reads as gathers with plain integer
+  indices (no data-dependent shapes, jit-stable).
 * softmax runs in float32 regardless of cache dtype (MXU-friendly bf16 in,
   f32 accumulate).
 """
@@ -54,7 +57,7 @@ def _pallas_interpret() -> bool:
 
 
 def write_kv(
-    k_cache: jax.Array,  # [num_slots, Hkv, Dh]
+    k_cache: jax.Array,  # [Hkv, num_slots, Dh] head-leading
     v_cache: jax.Array,
     k: jax.Array,  # [T, Hkv, Dh]
     v: jax.Array,
@@ -64,13 +67,15 @@ def write_kv(
 
     Padding tokens carry slot -1; JAX's scatter mode='drop' only discards
     out-of-bounds *positive* indices (negatives wrap), so negatives are
-    remapped to num_slots first and then dropped.
+    remapped to num_slots first and then dropped.  A single advanced index
+    keeps the indexed dim in place — ``cache[:, safe]`` is ``[Hkv, T, Dh]``
+    — so ``k``/``v`` are swapped to head-leading before the scatter.
     """
     k = k.astype(k_cache.dtype)
     v = v.astype(v_cache.dtype)
-    safe = jnp.where(slot_mapping < 0, k_cache.shape[0], slot_mapping)
-    k_cache = k_cache.at[safe].set(k, mode="drop")
-    v_cache = v_cache.at[safe].set(v, mode="drop")
+    safe = jnp.where(slot_mapping < 0, k_cache.shape[1], slot_mapping)
+    k_cache = k_cache.at[:, safe].set(jnp.swapaxes(k, 0, 1), mode="drop")
+    v_cache = v_cache.at[:, safe].set(jnp.swapaxes(v, 0, 1), mode="drop")
     return k_cache, v_cache
 
 
@@ -178,10 +183,11 @@ def paged_decode_attention(
             from jax.sharding import PartitionSpec as P
 
             heads = P(None, "tp", None)
+            cache = P("tp", None, None)
             return shard_map(
                 kernel,
                 mesh=mesh,
-                in_specs=(heads, heads, heads, P(), P()),
+                in_specs=(heads, cache, cache, P(), P()),
                 out_specs=heads,
                 check_vma=False,
             )(q, k_cache, v_cache, block_tables, context_lens)
@@ -193,7 +199,7 @@ def paged_decode_attention(
 
 def paged_decode_attention_xla(
     q: jax.Array,  # [B, H, Dh]
-    k_cache: jax.Array,  # [num_slots, Hkv, Dh]
+    k_cache: jax.Array,  # [Hkv, num_slots, Dh] head-leading
     v_cache: jax.Array,
     block_tables: jax.Array,  # [B, max_blocks] int32 page ids (-1 pad)
     context_lens: jax.Array,  # [B] int32, tokens of context incl. current
@@ -207,7 +213,7 @@ def paged_decode_attention_xla(
     """
     b, num_heads, head_dim = q.shape
     max_blocks = block_tables.shape[1]
-    num_kv = k_cache.shape[1]
+    num_kv = k_cache.shape[0]
     q_per_kv = num_heads // num_kv
     s = max_blocks * block_size
 
@@ -218,15 +224,15 @@ def paged_decode_attention_xla(
     ).reshape(b, s)
     # pages with id -1 produce negative slots; take(mode='fill') would give
     # garbage — clamp and rely on the length mask instead
-    gather_idx = jnp.clip(slot_idx, 0, k_cache.shape[0] - 1)
+    gather_idx = jnp.clip(slot_idx, 0, k_cache.shape[1] - 1)
 
-    keys = jnp.take(k_cache, gather_idx, axis=0).astype(jnp.float32)  # [B,S,Hkv,Dh]
-    values = jnp.take(v_cache, gather_idx, axis=0).astype(jnp.float32)
+    keys = jnp.take(k_cache, gather_idx, axis=1).astype(jnp.float32)  # [Hkv,B,S,Dh]
+    values = jnp.take(v_cache, gather_idx, axis=1).astype(jnp.float32)
 
     qh = q.reshape(b, num_kv, q_per_kv, head_dim).astype(jnp.float32)
-    scores = jnp.einsum("bkgd,bskd->bkgs", qh, keys) * scale
+    scores = jnp.einsum("bkgd,kbsd->bkgs", qh, keys) * scale
     length_mask = jnp.arange(s)[None, :] < context_lens[:, None]  # [B, S]
     scores = jnp.where(length_mask[:, None, None, :], scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bkgs,bskd->bkgd", probs, values)
+    out = jnp.einsum("bkgs,kbsd->bkgd", probs, values)
     return out.reshape(b, num_heads, head_dim).astype(q.dtype)

@@ -15,6 +15,16 @@ BlockSpec index maps directly — the pipeline DMAs exactly the pages the
 block table names, i.e. the gather happens in the memory system, not in
 registers.
 
+The KV cache is **head-leading**: ``[Hkv, num_slots, Dh]`` per layer, so a
+page block is ``(1, block_size, Dh)`` — its trailing two dims are
+(sublane, lane) = (block_size, head_dim), a legal Mosaic tile for
+``block_size`` a multiple of the dtype's sublane quantum (8 for f32, 16
+for bf16) and any ``Dh`` (the block spans the full array dim).  A
+slot-leading layout ``[num_slots, Hkv, Dh]`` would force the illegal
+``(block_size, 1, Dh)`` block whose middle dim can't tile the head axis —
+Mosaic rejects it for every real config, which is exactly why the layout
+is a kernel-design decision, not a storage detail.
+
 Numerics: f32 accumulation (MXU-friendly: bf16 in, f32 out), identical
 masking semantics to the XLA reference; parity is pinned by
 tests/test_pallas_attention.py in interpreter mode on CPU.
@@ -41,8 +51,8 @@ def _decode_kernel(
     context_lens_ref,  # [B] SMEM
     # blocks
     q_ref,  # [1, 1, G, Dh] VMEM (G = q_per_kv)
-    k_ref,  # [block_size, 1, Dh] VMEM — page picked by index_map
-    v_ref,  # [block_size, 1, Dh] VMEM
+    k_ref,  # [1, block_size, Dh] VMEM — page picked by index_map
+    v_ref,  # [1, block_size, Dh] VMEM
     o_ref,  # [1, 1, G, Dh] VMEM
     # scratch
     m_ref,  # [G, 1] f32 running max
@@ -66,8 +76,8 @@ def _decode_kernel(
     @pl.when(j * block_size < ctx)
     def _page():
         q = q_ref[0, 0].astype(jnp.float32)  # [G, Dh]
-        k = k_ref[:, 0].astype(jnp.float32)  # [bs, Dh]
-        v = v_ref[:, 0].astype(jnp.float32)  # [bs, Dh]
+        k = k_ref[0].astype(jnp.float32)  # [bs, Dh]
+        v = v_ref[0].astype(jnp.float32)  # [bs, Dh]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -101,7 +111,7 @@ def _decode_kernel(
 )
 def paged_decode_attention(
     q: jax.Array,  # [B, H, Dh]
-    k_cache: jax.Array,  # [num_slots, Hkv, Dh]
+    k_cache: jax.Array,  # [Hkv, num_slots, Dh] head-leading (module docstring)
     v_cache: jax.Array,
     block_tables: jax.Array,  # [B, max_blocks] int32 page ids
     context_lens: jax.Array,  # [B] int32 incl. current token
@@ -112,14 +122,14 @@ def paged_decode_attention(
 ) -> jax.Array:
     """Flash-style paged decode attention, one query token per sequence."""
     b, num_heads, head_dim = q.shape
-    num_kv = k_cache.shape[1]
+    num_kv = k_cache.shape[0]
     g = num_heads // num_kv
     max_blocks = block_tables.shape[1]
 
     qg = q.reshape(b, num_kv, g, head_dim)
     # invalid/padding pages (id <= 0 beyond context) clamp to page 0; the
     # in-kernel length mask discards their scores
-    safe_tables = jnp.clip(block_tables, 0, k_cache.shape[0] // block_size - 1)
+    safe_tables = jnp.clip(block_tables, 0, k_cache.shape[1] // block_size - 1)
 
     def page_index(i, j, bt, cl):
         # page steps beyond the live context re-map to the last live page:
@@ -137,13 +147,16 @@ def paged_decode_attention(
                 (1, 1, g, head_dim),
                 lambda i, h, j, bt, cl: (i, h, 0, 0),
             ),
+            # page p of head h is block (h, p) of a (1, block_size, Dh)
+            # grid over the [Hkv, num_slots, Dh] cache — trailing dims
+            # (block_size, Dh) are a legal (sublane, lane) tile
             pl.BlockSpec(
-                (block_size, 1, head_dim),
-                lambda i, h, j, bt, cl: (page_index(i, j, bt, cl), h, 0),
+                (1, block_size, head_dim),
+                lambda i, h, j, bt, cl: (h, page_index(i, j, bt, cl), 0),
             ),
             pl.BlockSpec(
-                (block_size, 1, head_dim),
-                lambda i, h, j, bt, cl: (page_index(i, j, bt, cl), h, 0),
+                (1, block_size, head_dim),
+                lambda i, h, j, bt, cl: (h, page_index(i, j, bt, cl), 0),
             ),
         ],
         out_specs=pl.BlockSpec(
@@ -156,8 +169,6 @@ def paged_decode_attention(
             pltpu.VMEM((g, head_dim), jnp.float32),
         ],
     )
-    # K/V pages are indexed in units of the block shape: page p starts at
-    # slot p*block_size, which is block-row p of a (block_size, 1, Dh) grid
     out = pl.pallas_call(
         functools.partial(
             _decode_kernel, scale=scale, block_size=block_size

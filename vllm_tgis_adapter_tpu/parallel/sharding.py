@@ -141,8 +141,8 @@ def make_place_fn(mesh: Mesh):
 
 
 def cache_sharding(mesh: Mesh) -> NamedSharding:
-    """KV cache ``[L, slots, Hkv, Dh]``: shard the kv-head axis on tp."""
-    return NamedSharding(mesh, P(None, None, TP_AXIS, None))
+    """KV cache ``[L, Hkv, slots, Dh]``: shard the kv-head axis on tp."""
+    return NamedSharding(mesh, P(None, TP_AXIS, None, None))
 
 
 def data_sharding(mesh: Mesh) -> NamedSharding:
