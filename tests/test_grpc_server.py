@@ -360,3 +360,42 @@ def test_guided_grammar_rejected(grpc_client):
     with pytest.raises(grpc.RpcError) as excinfo:
         grpc_client.make_request("test", params=params)
     assert excinfo.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+
+def test_server_reflection(_servers):
+    """v1alpha reflection: list services + fetch the fmaas descriptor set
+    (what `grpcurl list` / `describe` do under the hood)."""
+    import grpc
+    from google.protobuf import descriptor_pb2
+
+    from vllm_tgis_adapter_tpu.grpc.pb import reflection_pb2
+
+    def ask(channel, **kwargs):
+        call = channel.stream_stream(
+            "/grpc.reflection.v1alpha.ServerReflection/ServerReflectionInfo",
+            request_serializer=(
+                reflection_pb2.ServerReflectionRequest.SerializeToString
+            ),
+            response_deserializer=(
+                reflection_pb2.ServerReflectionResponse.FromString
+            ),
+        )
+        req = reflection_pb2.ServerReflectionRequest(**kwargs)
+        return next(iter(call(iter([req]))))
+
+    with grpc.insecure_channel(f"localhost:{_servers.grpc_port}") as ch:
+        listing = ask(ch, list_services="*")
+        names = {s.name for s in listing.list_services_response.service}
+        assert "fmaas.GenerationService" in names
+        assert "grpc.health.v1.Health" in names
+        assert "grpc.reflection.v1alpha.ServerReflection" in names
+
+        symbol = ask(ch, file_containing_symbol="fmaas.GenerationService")
+        blobs = symbol.file_descriptor_response.file_descriptor_proto
+        assert blobs
+        fdp = descriptor_pb2.FileDescriptorProto.FromString(blobs[-1])
+        assert fdp.package == "fmaas"
+        assert any(s.name == "GenerationService" for s in fdp.service)
+
+        missing = ask(ch, file_containing_symbol="no.such.Service")
+        assert missing.error_response.error_message

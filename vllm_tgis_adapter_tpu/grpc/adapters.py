@@ -1,21 +1,24 @@
 """Request-level adapter (LoRA / PEFT prefix) resolution.
 
-Maps the ``adapter_id`` (or legacy ``prefix_id``) on incoming TGIS requests
-to an engine ``lora_request`` kwarg, with the same semantics as the
-reference (grpc/adapters.py:63-226): per-adapter asyncio locks, off-thread
-filesystem reads, path-traversal rejection, caching through the model
-handler's ``lora_requests`` registry, and rejection of non-LORA peft types.
+Maps the ``adapter_id`` (or legacy ``prefix_id``) on incoming TGIS
+requests to an engine ``lora_request`` kwarg.  Capability parity with the
+reference store (/root/reference/src/vllm_tgis_adapter/grpc/adapters.py:
+63-226) — per-adapter serialization, off-thread config reads, path
+traversal rejection, engine-cache reuse, non-LORA peft rejection — but
+organised as methods on the store itself rather than free functions.
+Resolution order: engine cache first (ids the engine already accepted),
+then id hygiene, then the filesystem.
 """
 
 from __future__ import annotations
 
 import asyncio
-import concurrent.futures
 import dataclasses
 import json
 import re
+from concurrent.futures import ThreadPoolExecutor
 from pathlib import Path
-from typing import TYPE_CHECKING, Union
+from typing import TYPE_CHECKING, Optional, Union
 
 from vllm_tgis_adapter_tpu.grpc.validation import TGISValidationError
 from vllm_tgis_adapter_tpu.logging import init_logger
@@ -28,10 +31,6 @@ if TYPE_CHECKING:
         SingleGenerationRequest,
     )
 
-global_thread_pool = None  # lazily-created pool for adapter file reads
-
-VALID_ADAPTER_ID_PATTERN = re.compile("[/\\w\\-]+")
-
 logger = init_logger(__name__)
 
 AnyAdapterRequest = Union[
@@ -40,22 +39,92 @@ AnyAdapterRequest = Union[
     "BatchedTokenizeRequest",
 ]
 
+# word chars, dashes and path separators only — everything else (and any
+# id escaping the store root) is rejected before touching the filesystem
+_ID_CHARS = re.compile(r"[/\w\-]+")
+
+# engine-facing ids start far above anything the engine allocates itself
+_ID_FLOOR = 1_000_001
+
 
 @dataclasses.dataclass
 class AdapterMetadata:
-    unique_id: int  # engine-facing integer id
-    adapter_type: str  # peft type string from adapter_config.json, e.g. LORA
+    unique_id: int
+    adapter_type: str  # peft_type from adapter_config.json (e.g. LORA)
     full_path: str
     full_config: dict
 
 
 @dataclasses.dataclass
 class AdapterStore:
-    cache_path: str  # directory adapter ids are resolved under
+    """Resolution state for one server: cache dir + known adapters."""
+
+    cache_path: str
     adapters: dict[str, AdapterMetadata]
-    # large base so ids can't collide with engine-internal adapter ids
-    next_unique_id: int = 1000001
-    load_locks: dict[str, asyncio.Lock] = dataclasses.field(default_factory=dict)
+    next_unique_id: int = _ID_FLOOR
+    load_locks: dict[str, asyncio.Lock] = dataclasses.field(
+        default_factory=dict
+    )
+    _io_pool: Optional[ThreadPoolExecutor] = None
+
+    def _lock_for(self, adapter_id: str) -> asyncio.Lock:
+        return self.load_locks.setdefault(adapter_id, asyncio.Lock())
+
+    def _take_unique_id(self) -> int:
+        # increment happens on the event loop only — no thread races
+        uid = self.next_unique_id
+        self.next_unique_id += 1
+        return uid
+
+    @staticmethod
+    def check_id_hygiene(adapter_id: str) -> None:
+        """Refuse ids with bad characters or directory escapes."""
+        if not _ID_CHARS.fullmatch(adapter_id):
+            TGISValidationError.InvalidAdapterID.error(adapter_id)
+        anchored = Path(adapter_id)
+        if not anchored.resolve().is_relative_to(Path.cwd()):
+            TGISValidationError.InvalidAdapterID.error(adapter_id)
+
+    async def _read_metadata(self, adapter_id: str) -> AdapterMetadata:
+        """Load adapter_config.json off-thread and wrap it."""
+        if self._io_pool is None:
+            self._io_pool = ThreadPoolExecutor(max_workers=2)
+        uid = self._take_unique_id()
+        directory = str(Path(self.cache_path) / adapter_id)
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            self._io_pool, _metadata_from_disk, adapter_id, directory, uid
+        )
+
+    async def resolve(
+        self, adapter_id: str, lora_manager: "LoRAManager | None"
+    ) -> "LoRARequest":
+        """adapter_id → engine LoRARequest, loading on first sight.
+
+        Raises ValueError (TGIS contract strings) for unknown ids, bad
+        paths, or unsupported peft types.
+        """
+        async with self._lock_for(adapter_id):
+            # already registered with the engine?  reuse its request
+            if lora_manager is not None:
+                cached = lora_manager.lora_requests.get(adapter_id)
+                if cached is not None:
+                    return cached
+
+            meta = self.adapters.get(adapter_id)
+            if meta is None:
+                self.check_id_hygiene(adapter_id)
+                meta = await self._read_metadata(adapter_id)
+                if meta.adapter_type != "LORA":
+                    # remember the bad type so repeats fail without IO
+                    self.adapters[adapter_id] = meta
+
+            if meta.adapter_type == "LORA":
+                return await _register_with_engine(
+                    adapter_id, meta, lora_manager
+                )
+
+        TGISValidationError.AdapterUnsupported.error(meta.adapter_type)
 
 
 async def validate_adapters(
@@ -63,110 +132,53 @@ async def validate_adapters(
     adapter_store: AdapterStore | None,
     lora_manager: "LoRAManager | None",
 ) -> dict[str, "LoRARequest"]:
-    """Resolve the request's adapter id into engine.generate() kwargs.
+    """Resolve the request's adapter reference into engine.generate kwargs.
 
-    Raises ValueError (TGIS contract messages) when the adapter is missing,
-    malformed, or of an unsupported type.
+    An empty dict means the request uses the base model.
     """
-    global global_thread_pool  # noqa: PLW0603
-    adapter_id = request.adapter_id
-    if not adapter_id and request.prefix_id:
-        adapter_id = request.prefix_id
-
-    if adapter_id and not adapter_store:
-        TGISValidationError.AdaptersDisabled.error()
-
-    if not adapter_id or not adapter_store:
+    adapter_id = request.adapter_id or request.prefix_id
+    if not adapter_id:
         return {}
-
-    # serialize loads of the same adapter
-    async with adapter_store.load_locks.setdefault(adapter_id, asyncio.Lock()):
-        if lora_manager is not None and (
-            existing := lora_manager.lora_requests.get(adapter_id)
-        ):
-            return {"lora_request": existing}
-
-        if (adapter_metadata := adapter_store.adapters.get(adapter_id)) is None:
-            _reject_bad_adapter_id(adapter_id)
-            local_adapter_path = str(Path(adapter_store.cache_path) / adapter_id)
-
-            loop = asyncio.get_running_loop()
-            if global_thread_pool is None:
-                global_thread_pool = concurrent.futures.ThreadPoolExecutor(
-                    max_workers=2
-                )
-
-            # unique-id increment stays in async land: no thread races
-            unique_id = adapter_store.next_unique_id
-            adapter_store.next_unique_id += 1
-
-            adapter_metadata = await loop.run_in_executor(
-                global_thread_pool,
-                _load_adapter_metadata,
-                adapter_id,
-                local_adapter_path,
-                unique_id,
-            )
-
-            if adapter_metadata.adapter_type == "LORA":
-                lora_request = await _load_lora_adapter(
-                    adapter_id, adapter_metadata, lora_manager
-                )
-                return {"lora_request": lora_request}
-            # cache non-LoRA metadata so repeat requests fail fast
-            adapter_store.adapters[adapter_id] = adapter_metadata
-
-    # all other adapter types unsupported
-    TGISValidationError.AdapterUnsupported.error(adapter_metadata.adapter_type)
+    if adapter_store is None:
+        TGISValidationError.AdaptersDisabled.error()
+    return {
+        "lora_request": await adapter_store.resolve(adapter_id, lora_manager)
+    }
 
 
-async def _load_lora_adapter(
+async def _register_with_engine(
     adapter_id: str,
-    adapter_metadata: AdapterMetadata,
+    meta: AdapterMetadata,
     lora_manager: "LoRAManager | None",
 ) -> "LoRARequest":
     if lora_manager is None:
         TGISValidationError.AdaptersDisabled.error()
     try:
         return await lora_manager.load_lora_adapter(
-            lora_name=adapter_id,
-            lora_path=adapter_metadata.full_path,
+            lora_name=adapter_id, lora_path=meta.full_path
         )
     except ValueError as e:
         TGISValidationError.AdapterNotFound.error(adapter_id, str(e))
 
 
-def _load_adapter_metadata(
-    adapter_id: str, adapter_path: str, unique_id: int
+def _metadata_from_disk(
+    adapter_id: str, directory: str, unique_id: int
 ) -> AdapterMetadata:
-    """Filesystem half of adapter validation; runs in the thread pool."""
-    if not Path(adapter_path).exists():
+    """Blocking filesystem half; runs in the store's IO pool."""
+    root = Path(directory)
+    if not root.exists():
         TGISValidationError.AdapterNotFound.error(
             adapter_id, "directory does not exist"
         )
-
-    adapter_config_path = Path(adapter_path) / "adapter_config.json"
-    if not Path(adapter_config_path).exists():
+    config_file = root / "adapter_config.json"
+    if not config_file.exists():
         TGISValidationError.AdapterNotFound.error(
             adapter_id, "invalid adapter: no adapter_config.json found"
         )
-
-    with open(adapter_config_path) as adapter_config_file:
-        adapter_config = json.load(adapter_config_file)
-
+    config = json.loads(config_file.read_text())
     return AdapterMetadata(
         unique_id=unique_id,
-        adapter_type=adapter_config.get("peft_type", None),
-        full_path=adapter_path,
-        full_config=adapter_config,
+        adapter_type=config.get("peft_type"),
+        full_path=directory,
+        full_config=config,
     )
-
-
-def _reject_bad_adapter_id(adapter_id: str) -> None:
-    """Reject ids with invalid characters or path traversal."""
-    if not VALID_ADAPTER_ID_PATTERN.fullmatch(adapter_id):
-        TGISValidationError.InvalidAdapterID.error(adapter_id)
-
-    cwd = Path().cwd()
-    if not Path(adapter_id).resolve().is_relative_to(cwd):
-        TGISValidationError.InvalidAdapterID.error(adapter_id)

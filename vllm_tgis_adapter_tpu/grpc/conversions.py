@@ -1,0 +1,330 @@
+"""Engine↔proto conversion layer for the TGIS gRPC service.
+
+Everything here is pure data shaping: TGIS ``Parameters`` →
+``SamplingParams`` (plus the request deadline), engine finish reasons →
+``StopReason`` enum values, and engine logprob tables → ``TokenInfo``
+wire messages.  The servicer (grpc_server.py) orchestrates RPCs and
+delegates all per-message math to this module.
+
+Wire semantics covered by tests/test_grpc_server.py and
+tests/test_validation.py; the reference behavior being matched is the
+parameter conversion + token-info assembly of the reference servicer
+(/root/reference/src/vllm_tgis_adapter/grpc/grpc_server.py:508-756),
+re-expressed over our engine's dataclasses.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import TYPE_CHECKING, Any, Optional
+
+from vllm_tgis_adapter_tpu.engine.sampling_params import SamplingParams
+from vllm_tgis_adapter_tpu.grpc.pb.generation_pb2 import (
+    DecodingMethod,
+    GenerationResponse,
+    StopReason,
+    TokenInfo,
+)
+from vllm_tgis_adapter_tpu.logging import init_logger
+from vllm_tgis_adapter_tpu.tgis_utils.structured_outputs import (
+    get_structured_output_params,
+)
+
+if TYPE_CHECKING:
+    from collections.abc import MutableSequence
+
+    from vllm_tgis_adapter_tpu.engine.outputs import (
+        CompletionOutput,
+        RequestOutput,
+    )
+    from vllm_tgis_adapter_tpu.grpc.pb.generation_pb2 import (
+        Parameters,
+        ResponseOptions,
+    )
+
+logger = init_logger(__name__)
+
+
+# ------------------------------------------------------------ sampling params
+
+
+@dataclasses.dataclass(frozen=True)
+class ServicePolicy:
+    """Server-level knobs that shape every conversion (from CLI args)."""
+
+    max_new_tokens_cap: int
+    skip_special_tokens: bool
+    include_stop_seq_default: bool
+    prompt_logprobs_enabled: bool
+
+
+def _logprob_width(resp: "ResponseOptions", greedy: bool) -> Optional[int]:
+    """How many logprob entries per position the engine must produce.
+
+    TGIS accounting: 1 for the chosen token when logprobs/ranks are on,
+    plus ``top_n_tokens`` extras (the sampled token may coincide with a
+    top-n entry under greedy, saving one).
+    """
+    width = 1 if (resp.token_logprobs or resp.token_ranks) else 0
+    if resp.top_n_tokens:
+        width += resp.top_n_tokens
+        if greedy and resp.token_logprobs:
+            width -= 1
+    return width or None
+
+
+def _decay_tuple(decoding) -> Optional[tuple[int, float]]:  # noqa: ANN001
+    if not decoding.HasField("length_penalty"):
+        return None
+    lp = decoding.length_penalty
+    return (lp.start_index, lp.decay_factor)
+
+
+def _sampling_fields(sampling, greedy: bool) -> dict[str, Any]:  # noqa: ANN001
+    """Temperature/top-k/top-p/seed block; greedy collapses to temp=0."""
+    temp = sampling.temperature if sampling.HasField("temperature") else 1.0
+    if greedy or temp == 0.0:
+        return {"temperature": 0.0}
+    return {
+        "temperature": temp,
+        "top_k": sampling.top_k or -1,
+        "top_p": sampling.top_p or 1.0,
+        "seed": sampling.seed if sampling.HasField("seed") else None,
+    }
+
+
+def make_sampling_params(
+    params: "Parameters", policy: ServicePolicy
+) -> tuple[SamplingParams, Optional[float]]:
+    """TGIS ``Parameters`` → engine ``SamplingParams`` + absolute deadline.
+
+    Assumes ``validate_params`` has already passed (TGIS error strings are
+    the validation module's contract).  Raises ValueError for engine-level
+    constraints the TGIS table doesn't cover; the caller maps that onto
+    INVALID_ARGUMENT.
+    """
+    greedy = params.method == DecodingMethod.GREEDY
+    resp = params.response
+    stopping = params.stopping
+    decoding = params.decoding
+
+    width = _logprob_width(resp, greedy)
+
+    # typical_p decoding is a native field of the batched sampler
+    typical_p = 1.0
+    if not greedy and 0.0 < params.sampling.typical_p < 1.0:
+        typical_p = params.sampling.typical_p
+
+    deadline = None
+    if stopping.time_limit_millis > 0:
+        deadline = time.time() + stopping.time_limit_millis / 1e3
+
+    want_prompt_details = (
+        policy.prompt_logprobs_enabled and resp.input_tokens
+    )
+
+    sp = SamplingParams(
+        logprobs=width,
+        prompt_logprobs=width if want_prompt_details else None,
+        max_tokens=stopping.max_new_tokens or None,
+        min_tokens=max(0, stopping.min_new_tokens),
+        repetition_penalty=decoding.repetition_penalty or 1.0,
+        typical_p=typical_p,
+        length_penalty=_decay_tuple(decoding),
+        structured_outputs=get_structured_output_params(decoding),
+        stop=list(stopping.stop_sequences) or None,
+        include_stop_str_in_output=(
+            stopping.include_stop_sequence
+            if stopping.HasField("include_stop_sequence")
+            else policy.include_stop_seq_default
+        ),
+        skip_special_tokens=policy.skip_special_tokens,
+        **_sampling_fields(params.sampling, greedy),
+    )
+    return sp, deadline
+
+
+# -------------------------------------------------------------- stop reasons
+
+
+def map_stop_reason(
+    output: "CompletionOutput",
+    *,
+    capped_by_context: bool,
+    deadline_hit: bool,
+    eos_text_of,  # noqa: ANN001 — callable: token id | None -> str | None
+) -> tuple[int, Optional[str]]:
+    """Engine finish_reason → (StopReason enum, matched stop text).
+
+    The TGIS enum distinguishes cases the engine folds together:
+    "length" splits on whether the cap came from the request or the
+    context window, "stop" splits on EOS vs stop-sequence, and "abort"
+    splits on deadline vs client cancellation.
+    """
+    reason = output.finish_reason
+    if reason is None:
+        code = StopReason.TIME_LIMIT if deadline_hit else StopReason.NOT_FINISHED
+        return code, None
+
+    if reason == "length":
+        code = (
+            StopReason.TOKEN_LIMIT if capped_by_context
+            else StopReason.MAX_TOKENS
+        )
+        return code, None
+
+    if reason == "stop":
+        matched = output.stop_reason
+        if matched is None or isinstance(matched, int):
+            return StopReason.EOS_TOKEN, eos_text_of(matched)
+        if isinstance(matched, str):
+            return StopReason.STOP_SEQUENCE, matched
+        logger.warning("Unexpected stop_reason type: %s", type(matched))
+        return StopReason.STOP_SEQUENCE, None
+
+    if reason == "abort":
+        code = StopReason.TIME_LIMIT if deadline_hit else StopReason.CANCELLED
+        return code, None
+
+    logger.warning("Unrecognized finish_reason: %s", reason)
+    return StopReason.CANCELLED, None
+
+
+def eos_text_fn(tokenizer):  # noqa: ANN001, ANN201
+    """Resolve an EOS stop id (or None) to its display text."""
+
+    def resolve(token_id: Optional[int]) -> Optional[str]:
+        if token_id is None:
+            return getattr(tokenizer, "eos_token", None)
+        return tokenizer.convert_ids_to_tokens(token_id)
+
+    return resolve
+
+
+# ---------------------------------------------------------------- token info
+
+
+@dataclasses.dataclass(frozen=True)
+class TokenDetail:
+    """Which per-token details the response asked for."""
+
+    logprobs: bool
+    ranks: bool
+    top_n: int
+
+    @classmethod
+    def from_options(cls, resp: "ResponseOptions") -> "TokenDetail":
+        return cls(
+            logprobs=resp.token_logprobs,
+            ranks=resp.token_ranks,
+            top_n=resp.top_n_tokens,
+        )
+
+
+def _top_token_block(
+    entry_map, detail: TokenDetail, tokenizer  # noqa: ANN001
+) -> list[TokenInfo.TopToken]:
+    """The top-N sub-messages for one position, ordered by logprob."""
+    ranked = sorted(
+        entry_map.items(), key=lambda kv: kv[1].logprob, reverse=True
+    )
+    ranked = ranked[: detail.top_n]
+    texts = tokenizer.convert_ids_to_tokens([tid for tid, _ in ranked])
+    return [
+        TokenInfo.TopToken(
+            text=text,
+            logprob=entry.logprob if detail.logprobs else 0.0,
+        )
+        for text, (_, entry) in zip(texts, ranked)
+    ]
+
+
+def append_token_infos(
+    dest: "MutableSequence[TokenInfo]",
+    token_ids: list[int],
+    logprob_maps,  # noqa: ANN001 — per-position {token_id: Logprob} or None
+    detail: TokenDetail,
+    tokenizer,  # noqa: ANN001
+    skip: int = 0,
+) -> None:
+    """Build TokenInfo messages for each position into ``dest`` (wire OUT).
+
+    ``logprob_maps[i] is None`` (the first prompt position) yields a bare
+    text-only entry.  Ranks are clamped non-negative for the unsigned wire
+    field.
+    """
+    ids = token_ids[skip:]
+    maps = logprob_maps[skip:] if logprob_maps is not None else None
+    texts = tokenizer.convert_ids_to_tokens(ids)
+
+    for i, text in enumerate(texts):
+        info = TokenInfo(text=text)
+        entry_map = maps[i] if maps else None
+        if entry_map is not None:
+            if detail.logprobs or detail.ranks:
+                chosen = entry_map[ids[i]]
+                if detail.logprobs:
+                    info.logprob = chosen.logprob
+                if detail.ranks:
+                    info.rank = max(chosen.rank or 0, 0)
+            if detail.top_n:
+                info.top_tokens.extend(
+                    _top_token_block(entry_map, detail, tokenizer)
+                )
+        dest.append(info)
+
+
+# ------------------------------------------------------------- frame helpers
+
+
+def make_generation_frame(
+    output: "CompletionOutput",
+    resp: "ResponseOptions",
+    *,
+    token_count: int,
+    stop_code: int,
+    stop_text: Optional[str],
+    tokenizer,  # noqa: ANN001
+) -> GenerationResponse:
+    """One wire frame for a (possibly partial) completion output."""
+    frame = GenerationResponse(
+        text=output.text,
+        generated_token_count=token_count,
+        stop_reason=stop_code,
+        stop_sequence=stop_text or "",
+    )
+    if resp.generated_tokens:
+        append_token_infos(
+            frame.tokens,
+            list(output.token_ids),
+            output.logprobs,
+            TokenDetail.from_options(resp),
+            tokenizer,
+        )
+    return frame
+
+
+def attach_input_details(
+    frame: GenerationResponse,
+    result: "RequestOutput",
+    resp: "ResponseOptions",
+    seed: Optional[int],
+    tokenizer,  # noqa: ANN001
+) -> GenerationResponse:
+    """Add prompt-side details (token count/texts/logprobs, echo, seed)."""
+    if result.prompt_token_ids:
+        frame.input_token_count = len(result.prompt_token_ids)
+        if resp.input_tokens:
+            append_token_infos(
+                frame.input_tokens,
+                result.prompt_token_ids,
+                result.prompt_logprobs,
+                TokenDetail.from_options(resp),
+                tokenizer,
+            )
+    if resp.input_text and result.prompt:
+        frame.text = result.prompt + frame.text
+    if seed is not None:
+        frame.seed = seed
+    return frame

@@ -1,18 +1,19 @@
 """The TGIS-compatible gRPC server.
 
 Implements the four ``fmaas.GenerationService`` RPCs with the same wire
-semantics as the reference servicer (grpc_server.py:161-994): TGIS
-validation and error strings, Parameters→SamplingParams conversion,
-prompt tokenization + truncation, batched generation over merged async
-iterators, DELTA streaming with the input-details first frame (N tokens →
-N+1 messages), finish-reason mapping onto the StopReason enum, token
+semantics as the reference servicer
+(/root/reference/src/vllm_tgis_adapter/grpc/grpc_server.py:161-994): TGIS
+validation and error strings, Parameters→SamplingParams conversion, prompt
+tokenization + truncation, batched generation over merged async iterators,
+DELTA streaming with the input-details first frame (N tokens → N+1
+messages), finish-reason mapping onto the StopReason enum, token
 info/logprob/rank/top-N conversion, per-request deadlines via
 ``time_limit_millis``, and engine-death self-shutdown through a stop event.
 
-The engine behind it is the TPU-native JAX engine (engine/async_llm.py)
-rather than vLLM; sampling extensions (typical_p, exponential length
-penalty) are fields on our batched jitted sampler instead of per-row torch
-logits processors.
+Architecture differs from the reference: proto↔engine data shaping lives
+in grpc/conversions.py; this module owns RPC orchestration, the error
+boundary, and server lifecycle.  The engine behind it is the TPU-native
+JAX engine (engine/async_llm.py) rather than vLLM.
 """
 
 from __future__ import annotations
@@ -22,7 +23,7 @@ import dataclasses
 import os
 import time
 import uuid
-from typing import TYPE_CHECKING, Any, Callable, Optional, TypeVar, Union
+from typing import TYPE_CHECKING, Any, Optional, Union
 
 import grpc
 from grpc import StatusCode, aio
@@ -31,103 +32,56 @@ from vllm_tgis_adapter_tpu.engine.sampling_params import (
     RequestOutputKind,
     SamplingParams,
 )
-from vllm_tgis_adapter_tpu.grpc import health
+from vllm_tgis_adapter_tpu.grpc import conversions as conv
+from vllm_tgis_adapter_tpu.grpc import health, reflection
 from vllm_tgis_adapter_tpu.grpc.adapters import AdapterStore, validate_adapters
-from vllm_tgis_adapter_tpu.grpc.pb import generation_pb2, rpc
+from vllm_tgis_adapter_tpu.grpc.pb import rpc
 from vllm_tgis_adapter_tpu.grpc.pb.generation_pb2 import (
     BatchedGenerationResponse,
     BatchedTokenizeResponse,
-    DecodingMethod,
     GenerationResponse,
     ModelInfoResponse,
-    StopReason,
-    TokenInfo,
     TokenizeResponse,
 )
 from vllm_tgis_adapter_tpu.grpc.pb.health_pb2 import HealthCheckResponse
 from vllm_tgis_adapter_tpu.grpc.validation import validate_input, validate_params
 from vllm_tgis_adapter_tpu.logging import init_logger
 from vllm_tgis_adapter_tpu.tgis_utils import logs
-from vllm_tgis_adapter_tpu.tgis_utils.structured_outputs import (
-    get_structured_output_params,
-)
-from vllm_tgis_adapter_tpu.utils import merge_async_iterators, to_list
+from vllm_tgis_adapter_tpu.utils import merge_async_iterators
 
 if TYPE_CHECKING:
     import argparse
-    from collections.abc import AsyncIterator, MutableSequence
+    from collections.abc import AsyncIterator
 
     from vllm_tgis_adapter_tpu.engine.async_llm import AsyncLLMEngine
-    from vllm_tgis_adapter_tpu.engine.outputs import CompletionOutput, RequestOutput
     from vllm_tgis_adapter_tpu.grpc.pb.generation_pb2 import (
         BatchedGenerationRequest,
         BatchedTokenizeRequest,
         ModelInfoRequest,
         Parameters,
-        ResponseOptions,
         SingleGenerationRequest,
     )
 
-_F = TypeVar("_F")
-
 logger = init_logger(__name__)
 
-ADD_SPECIAL_TOKENS: bool = os.getenv("ADD_SPECIAL_TOKENS", "true").lower() not in (
-    "0",
-    "false",
-)
 CORRELATION_ID_HEADER = "x-correlation-id"
-
-_T = TypeVar("_T")
-
-
-def with_default(value: _T, default: _T) -> _T:
-    return value if value else default
+_TRACE_HEADERS = frozenset(("traceparent", "tracestate"))
 
 
-async def _handle_exception(e: Exception, func, *args, **kwargs) -> None:  # noqa: ANN001, ANN002, ANN003
-    context = kwargs.get("context") or args[-1]
-    servicer = args[0]
-    engine = servicer.engine
-    # A dead engine cannot serve anything further: signal the server
-    # coroutine to stop immediately instead of waiting for probes to fail
-    # (reference: grpc_server.py:113-123).
-    if engine.errored and not engine.is_running:
-        servicer.stop_event.set()
-
-    if not isinstance(e, aio.AbortError):
-        if _is_oom_error(e):
-            logger.exception("%s caused TPU HBM OOM error", func.__name__)
-            await context.abort(StatusCode.RESOURCE_EXHAUSTED, str(e))
-        logger.exception("%s failed", func.__name__)
-    raise e
+def _special_tokens_enabled() -> bool:
+    return os.getenv("ADD_SPECIAL_TOKENS", "true").lower() not in ("0", "false")
 
 
-def _is_oom_error(e: BaseException) -> bool:
-    """XLA surfaces HBM exhaustion as RESOURCE_EXHAUSTED XlaRuntimeError."""
-    return "RESOURCE_EXHAUSTED" in str(e) or "out of memory" in str(e).lower()
+@dataclasses.dataclass
+class _RequestSetup:
+    """Everything an RPC needs after the shared prelude."""
 
-
-def log_rpc_handler_errors(func: _F) -> _F:
-    import inspect
-
-    if inspect.isasyncgenfunction(func):
-
-        async def func_with_log(*args, **kwargs):  # noqa: ANN002, ANN003, ANN202
-            try:
-                async for val in func(*args, **kwargs):
-                    yield val
-            except Exception as e:  # noqa: BLE001
-                await _handle_exception(e, func, *args, **kwargs)
-    else:
-
-        async def func_with_log(*args, **kwargs):  # noqa: ANN002, ANN003, ANN202
-            try:
-                return await func(*args, **kwargs)
-            except Exception as e:  # noqa: BLE001
-                await _handle_exception(e, func, *args, **kwargs)
-
-    return func_with_log
+    request_id: str
+    tokenizer: Any
+    engine_kwargs: dict[str, Any]
+    sampling_params: SamplingParams
+    deadline: Optional[float]
+    correlation_id: Optional[str] = None
 
 
 class TextGenerationService(rpc.GenerationServiceServicer):
@@ -142,441 +96,103 @@ class TextGenerationService(rpc.GenerationServiceServicer):
     ):
         self.engine = engine
         self.stop_event = stop_event
+        self.health_servicer = health_servicer
+        self.config = None  # populated by post_init()
 
-        # set in post_init()
-        self.config = None
-
-        self.max_max_new_tokens = args.max_new_tokens
-        self.skip_special_tokens = not args.output_special_tokens
-        self.default_include_stop_seqs = args.default_include_stop_seqs
-        self.disable_prompt_logprobs = getattr(
-            args, "disable_prompt_logprobs", False
+        self.policy = conv.ServicePolicy(
+            max_new_tokens_cap=args.max_new_tokens,
+            skip_special_tokens=not args.output_special_tokens,
+            include_stop_seq_default=args.default_include_stop_seqs,
+            prompt_logprobs_enabled=not getattr(
+                args, "disable_prompt_logprobs", False
+            ),
         )
-
-        # TGIS backwards compatibility: PREFIX_STORE_PATH
-        adapter_cache_path = args.adapter_cache or args.prefix_store_path
+        # PREFIX_STORE_PATH is the TGIS-era name for the adapter dir
+        store_dir = args.adapter_cache or args.prefix_store_path
         self.adapter_store = (
-            AdapterStore(cache_path=adapter_cache_path, adapters={})
-            if adapter_cache_path
+            AdapterStore(cache_path=store_dir, adapters={})
+            if store_dir
             else None
         )
-        self.health_servicer = health_servicer
+
+    async def post_init(self) -> None:
+        self.config = await self.engine.get_model_config()
+        self.health_servicer.set(self.SERVICE_NAME, HealthCheckResponse.SERVING)
 
     @property
     def lora_manager(self):
         return getattr(self.engine.engine, "lora_manager", None)
 
-    async def post_init(self) -> None:
-        self.config = await self.engine.get_model_config()
-        self.health_servicer.set(
-            self.SERVICE_NAME, HealthCheckResponse.SERVING
-        )
+    # -------------------------------------------------------- error boundary
 
-    def _make_generator(
-        self,
-        prompt: str,
-        prompt_token_ids: list[int],
-        **kwargs: Any,
-    ):
-        return self.engine.generate(
-            prompt=prompt,
-            prompt_token_ids=prompt_token_ids,
-            **kwargs,
-        )
+    async def _rpc_failed(self, exc: Exception, context, rpc_name: str) -> None:  # noqa: ANN001
+        """Uniform failure handling for every RPC.
 
-    @log_rpc_handler_errors
-    async def Generate(
-        self,
-        request: "BatchedGenerationRequest",
-        context: aio.ServicerContext,
-    ) -> BatchedGenerationResponse:
-        request_id = self.request_id(context)
-        kwargs = await self._validate_adapters(request, context)
-        tokenizer = await self._get_tokenizer(kwargs)
+        Engine death flips the server's stop event (the process is done
+        serving); HBM exhaustion maps onto RESOURCE_EXHAUSTED; everything
+        else logs and re-raises as INTERNAL via grpc.aio's default path.
+        AbortError means we already set a status — pass it through silently.
+        """
+        if self.engine.errored and not self.engine.is_running:
+            self.stop_event.set()
+        if isinstance(exc, aio.AbortError):
+            raise exc
+        msg = str(exc)
+        if "RESOURCE_EXHAUSTED" in msg or "out of memory" in msg.lower():
+            logger.exception("%s caused TPU HBM OOM error", rpc_name)
+            await context.abort(StatusCode.RESOURCE_EXHAUSTED, msg)
+        logger.exception("%s failed", rpc_name)
+        raise exc
 
-        sampling_params, deadline = await self._validate_and_convert_params(
-            request.params, tokenizer, context
-        )
-        sampling_params.output_kind = RequestOutputKind.FINAL_ONLY
-        truncate_input_tokens = with_default(
-            request.params.truncate_input_tokens, None
-        )
-        request_count = len(request.requests)
-
-        generators = []
-        max_is_token_limit = [False] * request_count
-
-        for i, req in enumerate(request.requests):
-            # per-sub-request copy: _validate_prompt_and_tokenize caps
-            # max_tokens against THIS prompt's length, and our engine holds
-            # the params object by reference until the stream is consumed
-            sampling_params_i = dataclasses.replace(sampling_params)
-            input_ids, max_is_token_limit[i] = (
-                await self._validate_prompt_and_tokenize(
-                    sampling_params_i, truncate_input_tokens, req.text,
-                    tokenizer, context,
-                )
-            )
-            request_id_i = f"{request_id}-{i}"
-
-            headers = dict(context.invocation_metadata())
-            logs.set_correlation_id(
-                request_id_i, headers.get(CORRELATION_ID_HEADER)
-            )
-            if await self.engine.is_tracing_enabled():
-                kwargs["trace_headers"] = _extract_trace_headers(headers)
-            generators.append(
-                self._make_generator(
-                    prompt=req.text,
-                    prompt_token_ids=input_ids,
-                    sampling_params=sampling_params_i,
-                    request_id=request_id_i,
-                    **kwargs,
-                )
-            )
-
-        result_generator = merge_async_iterators(*generators)
-
-        # With FINAL_ONLY streams each generator yields exactly once at
-        # completion, so the time limit is enforced by a timer task that
-        # aborts every sub-request at the deadline (the engine then emits
-        # their partial outputs).
-        time_limit_reached = False
-        timer_task: Optional[asyncio.Task] = None
-        if deadline is not None:
-
-            async def _expire() -> None:
-                nonlocal time_limit_reached
-                await asyncio.sleep(max(0.0, deadline - time.time()))
-                time_limit_reached = True
-                for j in range(request_count):
-                    await self.engine.abort(f"{request_id}-{j}")
-
-            timer_task = asyncio.create_task(_expire())
-
-        resp_options = request.params.response
-        responses: list = [None] * request_count
-        try:
-            async for i, res in result_generator:
-                if res.prompt is None:
-                    res.prompt = request.requests[i].text
-                responses[i] = res
-        finally:
-            if timer_task is not None:
-                timer_task.cancel()
-
-        for i in range(len(responses)):
-            res = responses[i]
-            output = res.outputs[0]
-            response = self._convert_output(
-                output,
-                resp_options,
-                max_is_token_limit=max_is_token_limit[i],
-                tokenizer=tokenizer,
-                time_limit_reached=time_limit_reached,
-                generated_token_count=len(output.token_ids),
-            )
-            response = self._convert_input_details(
-                res, resp_options, sampling_params, response, tokenizer
-            )
-            responses[i] = response
-
-        return BatchedGenerationResponse(responses=responses)
-
-    @log_rpc_handler_errors
-    async def GenerateStream(  # noqa: C901, PLR0915
-        self,
-        request: "SingleGenerationRequest",
-        context: aio.ServicerContext,
-    ) -> "AsyncIterator[GenerationResponse]":
-        request_id = self.request_id(context)
-        adapter_kwargs = await self._validate_adapters(request, context)
-        tokenizer = await self._get_tokenizer(adapter_kwargs)
-
-        sampling_params, deadline = await self._validate_and_convert_params(
-            request.params, tokenizer, context
-        )
-        sampling_params.output_kind = RequestOutputKind.DELTA
-        truncate_input_tokens = with_default(
-            request.params.truncate_input_tokens, None
-        )
-
-        input_ids, max_is_tok_limit = await self._validate_prompt_and_tokenize(
-            sampling_params,
-            truncate_input_tokens,
-            request.request.text,
-            tokenizer,
-            context,
-        )
-
-        kwargs: dict[str, Any] = {}
-        headers = dict(context.invocation_metadata())
-        if await self.engine.is_tracing_enabled():
-            kwargs["trace_headers"] = _extract_trace_headers(headers)
-        if CORRELATION_ID_HEADER in headers:
-            logs.set_correlation_id(request_id, headers.get(CORRELATION_ID_HEADER))
-
-        result_generator = self._make_generator(
-            prompt=request.request.text,
-            prompt_token_ids=input_ids,
-            sampling_params=sampling_params,
-            request_id=request_id,
-            **adapter_kwargs,
-            **kwargs,
-        )
-
-        resp_options = request.params.response
-
-        first_response: Optional[GenerationResponse] = None
-        last_response: Optional[GenerationResponse] = None
-        generated_token_count = 0
-        time_limit_reached = False
-        full_output = ""
-        async for result in result_generator:
-            if first_response is None:
-                if result.prompt is None:
-                    result.prompt = request.request.text
-                first_response = self._convert_input_details(
-                    result,
-                    resp_options,
-                    sampling_params,
-                    GenerationResponse(),
-                    tokenizer,
-                )
-                last_response = first_response
-                yield first_response
-
-            if deadline is not None and time.time() >= deadline:
-                await self.engine.abort(request_id)
-                time_limit_reached = True
-
-            output = result.outputs[0]
-            generated_token_count += len(output.token_ids)
-
-            if (
-                not generated_token_count
-                and not output.finish_reason
-                and not time_limit_reached
-            ):
-                continue
-
-            last_response = self._convert_output(
-                output,
-                resp_options,
-                max_is_token_limit=max_is_tok_limit,
-                tokenizer=tokenizer,
-                time_limit_reached=time_limit_reached,
-                generated_token_count=generated_token_count,
-            )
-            yield last_response
-
-            full_output += output.text
-
-            if time_limit_reached:
-                break
-
-        if first_response is None:
-            # nothing was generated at all
-            return
-
-        # patch the first response object for the logging wrapper's benefit
-        assert last_response is not None
-        first_response.text = full_output
-        first_response.stop_reason = last_response.stop_reason
-        first_response.stop_sequence = last_response.stop_sequence
-        first_response.generated_token_count = last_response.generated_token_count
-
-    def _convert_input_details(
-        self,
-        result: "RequestOutput",
-        resp_options: "ResponseOptions",
-        sampling_params: SamplingParams,
-        response: GenerationResponse,
-        tokenizer,  # noqa: ANN001
-    ) -> GenerationResponse:
-        if result.prompt_token_ids:
-            response.input_token_count = len(result.prompt_token_ids)
-            if resp_options.input_tokens:
-                self._convert_tokens(
-                    result.prompt_token_ids,
-                    result.prompt_logprobs,
-                    include_logprobs=resp_options.token_logprobs,
-                    include_ranks=resp_options.token_ranks,
-                    top_n_tokens=resp_options.top_n_tokens,
-                    tokenizer=tokenizer,
-                    token_infos=response.input_tokens,
-                )
-
-        if resp_options.input_text and result.prompt:
-            response.text = (
-                result.prompt
-                if not response.text
-                else result.prompt + response.text
-            )
-
-        if sampling_params.seed is not None:
-            response.seed = sampling_params.seed
-        return response
-
-    def _convert_output(  # noqa: PLR0913
-        self,
-        output: "CompletionOutput",
-        resp_options: "ResponseOptions",
-        *,
-        generated_token_count: int,
-        max_is_token_limit: bool,
-        tokenizer,  # noqa: ANN001
-        time_limit_reached: bool = False,
-    ) -> GenerationResponse:
-        stop_reason, stop_sequence = self._convert_reason(
-            output,
-            max_is_token_limit=max_is_token_limit,
-            time_limit_reached=time_limit_reached,
-            tokenizer=tokenizer,
-        )
-        response = GenerationResponse(
-            text=output.text,
-            generated_token_count=generated_token_count,
-            stop_reason=stop_reason,
-            stop_sequence=stop_sequence or "",
-        )
-
-        if resp_options.generated_tokens:
-            self._convert_tokens(
-                to_list(output.token_ids),
-                output.logprobs,
-                include_logprobs=resp_options.token_logprobs,
-                include_ranks=resp_options.token_ranks,
-                top_n_tokens=resp_options.top_n_tokens,
-                tokenizer=tokenizer,
-                token_infos=response.tokens,
-            )
-        return response
+    # ------------------------------------------------------- shared prelude
 
     @staticmethod
     def request_id(context: aio.ServicerContext) -> str:
-        metadata = context.invocation_metadata()
-        if not metadata:
-            return uuid.uuid4().hex
+        """Correlation-id header if present, else a fresh uuid."""
+        for key, value in context.invocation_metadata() or ():
+            if key == CORRELATION_ID_HEADER and value:
+                return value
+        return uuid.uuid4().hex
 
-        correlation_id = dict(metadata).get(CORRELATION_ID_HEADER)
-        if not correlation_id:
-            return uuid.uuid4().hex
-        return correlation_id
-
-    async def _validate_and_convert_params(
+    async def _setup(
         self,
+        request,  # noqa: ANN001 — any request carrying adapter fields
         params: "Parameters",
-        tokenizer,  # noqa: ANN001
         context: aio.ServicerContext,
-    ) -> tuple[SamplingParams, Optional[float]]:
-        """Return (sampling_params, deadline)."""
-        # TGIS-level validation first so error strings match the TGIS API
-        try:
-            validate_params(params, self.max_max_new_tokens)
-        except ValueError as tgis_validation_error:
-            await context.abort(
-                StatusCode.INVALID_ARGUMENT, str(tgis_validation_error)
-            )
-
-        resp_options = params.response
-        sampling = params.sampling
-        stopping = params.stopping
-        decoding = params.decoding
-        greedy = params.method == DecodingMethod.GREEDY
-
-        max_new_tokens: Optional[int] = None
-        if stopping.max_new_tokens > 0:
-            max_new_tokens = stopping.max_new_tokens
-        min_new_tokens = max(0, stopping.min_new_tokens)
-
-        logprobs: Optional[int] = (
-            1 if (resp_options.token_logprobs or resp_options.token_ranks) else 0
+    ) -> _RequestSetup:
+        """Adapter resolution + tokenizer + param conversion, shared by
+        Generate and GenerateStream."""
+        request_id = self.request_id(context)
+        engine_kwargs = await self._resolve_adapters(request, context)
+        tokenizer = await self.engine.get_tokenizer(
+            engine_kwargs.get("lora_request")
         )
-        top_n_tokens = resp_options.top_n_tokens
-        if top_n_tokens:
-            # the engine returns logprobs for n+1 tokens (the sampled token
-            # plus the top-n excluding it) — same accounting as the reference
-            logprobs += top_n_tokens
-            if greedy and resp_options.token_logprobs:
-                logprobs -= 1
-        logprobs = with_default(logprobs, None)
 
-        # typical_p and the exponential length penalty are native fields of
-        # the batched TPU sampler, not per-row logits-processor callables
-        typical_p = 1.0
-        if not greedy and 0.0 < sampling.typical_p < 1.0:
-            typical_p = sampling.typical_p
-
-        length_penalty: Optional[tuple[int, float]] = None
-        if decoding.HasField("length_penalty"):
-            length_penalty = (
-                decoding.length_penalty.start_index,
-                decoding.length_penalty.decay_factor,
-            )
-
-        structured_outputs = None
         try:
-            structured_outputs = get_structured_output_params(decoding)
+            validate_params(params, self.policy.max_new_tokens_cap)
+            sampling_params, deadline = conv.make_sampling_params(
+                params, self.policy
+            )
         except ValueError as e:
             await context.abort(StatusCode.INVALID_ARGUMENT, str(e))
 
-        time_limit_millis = stopping.time_limit_millis
-        deadline = (
-            time.time() + time_limit_millis / 1000.0
-            if time_limit_millis > 0
-            else None
-        )
-
-        temperature = (
-            sampling.temperature if sampling.HasField("temperature") else 1.0
-        )
-        if greedy or temperature == 0.0:
-            random_sampling_params: dict[str, Any] = {"temperature": 0.0}
-        else:
-            random_sampling_params = {
-                "temperature": temperature,
-                "top_k": with_default(sampling.top_k, -1),
-                "top_p": with_default(sampling.top_p, 1.0),
-                "seed": sampling.seed if sampling.HasField("seed") else None,
+        headers = dict(context.invocation_metadata() or ())
+        if await self.engine.is_tracing_enabled():
+            engine_kwargs["trace_headers"] = {
+                k: v for k, v in headers.items() if k.lower() in _TRACE_HEADERS
             }
+        correlation_id = headers.get(CORRELATION_ID_HEADER)
+        logs.set_correlation_id(request_id, correlation_id)
+        return _RequestSetup(
+            request_id=request_id,
+            tokenizer=tokenizer,
+            engine_kwargs=engine_kwargs,
+            sampling_params=sampling_params,
+            deadline=deadline,
+            correlation_id=correlation_id,
+        )
 
-        try:
-            sampling_params = SamplingParams(
-                logprobs=logprobs,
-                prompt_logprobs=logprobs
-                if not self.disable_prompt_logprobs and resp_options.input_tokens
-                else None,
-                max_tokens=max_new_tokens,
-                min_tokens=min_new_tokens,
-                repetition_penalty=with_default(decoding.repetition_penalty, 1.0),
-                typical_p=typical_p,
-                length_penalty=length_penalty,
-                structured_outputs=structured_outputs,
-                stop=with_default(list(stopping.stop_sequences), None),
-                include_stop_str_in_output=stopping.include_stop_sequence
-                if stopping.HasField("include_stop_sequence")
-                else self.default_include_stop_seqs,
-                skip_special_tokens=self.skip_special_tokens,
-                **random_sampling_params,
-            )
-        except ValueError as engine_validation_error:
-            # engine-level checks not covered by the TGIS table
-            await context.abort(
-                StatusCode.INVALID_ARGUMENT, str(engine_validation_error)
-            )
-
-        return sampling_params, deadline
-
-    async def _validate_adapters(
-        self,
-        request: Union[
-            "SingleGenerationRequest",
-            "BatchedGenerationRequest",
-            "BatchedTokenizeRequest",
-        ],
-        context: aio.ServicerContext,
-    ) -> dict[str, Any]:
+    async def _resolve_adapters(self, request, context) -> dict[str, Any]:  # noqa: ANN001
         try:
             return await validate_adapters(
                 request=request,
@@ -586,230 +202,354 @@ class TextGenerationService(rpc.GenerationServiceServicer):
         except ValueError as e:
             await context.abort(StatusCode.INVALID_ARGUMENT, str(e))
 
-    async def _get_tokenizer(self, adapter_kwargs: dict[str, Any]):  # noqa: ANN201
-        return await self.engine.get_tokenizer(
-            adapter_kwargs.get("lora_request")
-        )
-
-    @staticmethod
-    def _convert_reason(
-        output: "CompletionOutput",
-        *,
-        max_is_token_limit: bool,
-        time_limit_reached: bool,
-        tokenizer,  # noqa: ANN001
-    ) -> tuple[int, Optional[str]]:
-        finish_reason = output.finish_reason
-        stop_sequence = None
-        if finish_reason is None:
-            stop_reason = (
-                StopReason.TIME_LIMIT
-                if time_limit_reached
-                else StopReason.NOT_FINISHED
-            )
-        elif finish_reason == "length":
-            stop_reason = (
-                StopReason.TOKEN_LIMIT
-                if max_is_token_limit
-                else StopReason.MAX_TOKENS
-            )
-        elif finish_reason == "stop":
-            stop_reason = StopReason.STOP_SEQUENCE
-            stop_str_or_tok = output.stop_reason
-            if stop_str_or_tok is None:
-                stop_reason = StopReason.EOS_TOKEN
-                stop_sequence = getattr(tokenizer, "eos_token", None)
-            elif isinstance(stop_str_or_tok, int):
-                stop_reason = StopReason.EOS_TOKEN
-                stop_sequence = tokenizer.convert_ids_to_tokens(stop_str_or_tok)
-            elif isinstance(stop_str_or_tok, str):
-                stop_sequence = stop_str_or_tok
-            else:
-                logger.warning(
-                    "Unexpected stop_reason type: %s", type(stop_str_or_tok)
-                )
-        elif finish_reason == "abort":
-            # an abort caused by the request's own deadline is TIME_LIMIT,
-            # not client cancellation
-            stop_reason = (
-                StopReason.TIME_LIMIT
-                if time_limit_reached
-                else StopReason.CANCELLED
-            )
-        else:
-            logger.warning("Unrecognized finish_reason: %s", finish_reason)
-            stop_reason = StopReason.CANCELLED
-
-        return stop_reason, stop_sequence
-
-    @staticmethod
-    def _convert_tokens(  # noqa: PLR0913
-        token_ids: list[int],
-        logprobs_list,  # noqa: ANN001
-        *,
-        include_logprobs: bool,
-        include_ranks: bool,
-        top_n_tokens: int,
-        tokenizer,  # noqa: ANN001
-        token_infos: "MutableSequence[TokenInfo]",  # OUT
-        token_start_offset: int = 0,
-    ) -> None:
-        if token_start_offset:
-            token_ids = token_ids[token_start_offset:]
-            if logprobs_list is not None:
-                logprobs_list = logprobs_list[token_start_offset:]
-        token_texts = tokenizer.convert_ids_to_tokens(token_ids)
-        for i, text in enumerate(token_texts):
-            token_info = TokenInfo(text=text)
-            logprobs = logprobs_list[i] if logprobs_list else None
-            # logprobs entry is None for the first prompt token
-            if logprobs is None:
-                token_infos.append(token_info)
-                continue
-
-            if include_logprobs or include_ranks:
-                logprob = logprobs[token_ids[i]]
-                if include_logprobs:
-                    token_info.logprob = logprob.logprob
-                if include_ranks:
-                    # rank is unsigned on the wire; clamp engine dummies
-                    token_info.rank = max(logprob.rank or 0, 0)
-            if top_n_tokens:
-                items = sorted(
-                    logprobs.items(),
-                    key=lambda item: item[1].logprob,
-                    reverse=True,
-                )[:top_n_tokens]
-                tt_texts = tokenizer.convert_ids_to_tokens(
-                    [tid for tid, _ in items]
-                )
-                token_info.top_tokens.extend(
-                    TokenInfo.TopToken(
-                        text=tt_text,
-                        logprob=(lp.logprob if include_logprobs else 0.0),
-                    )
-                    for tt_text, (_, lp) in zip(tt_texts, items)
-                )
-            token_infos.append(token_info)
-
-    async def _validate_prompt_and_tokenize(
+    async def _encode_prompt(
         self,
+        text: str,
         sampling_params: SamplingParams,
-        truncate_input_tokens: Optional[int],
-        prompt: str,
+        truncate_to: Optional[int],
         tokenizer,  # noqa: ANN001
         context: aio.ServicerContext,
     ) -> tuple[list[int], bool]:
-        assert self.config is not None
+        """Tokenize one prompt; clamp max_tokens to the context window.
 
-        max_model_len = self.config.max_model_len
-
-        tokenizer_kwargs: dict[str, Any] = {
-            "add_special_tokens": ADD_SPECIAL_TOKENS
+        Returns (ids, capped) where ``capped`` records that the effective
+        token budget came from the model context rather than the request
+        (StopReason.TOKEN_LIMIT vs MAX_TOKENS on the wire).
+        """
+        encode_kwargs: dict[str, Any] = {
+            "add_special_tokens": _special_tokens_enabled()
         }
-        if truncate_input_tokens is not None:
-            tokenizer_kwargs.update(
-                {"truncation": True, "max_length": truncate_input_tokens}
-            )
+        if truncate_to is not None:
+            encode_kwargs["truncation"] = True
+            encode_kwargs["max_length"] = truncate_to
+        ids = tokenizer(text, **encode_kwargs).input_ids
 
-        input_ids = tokenizer(prompt, **tokenizer_kwargs).input_ids
-        token_num = len(input_ids)
-
+        window = self.config.max_model_len
         try:
-            validate_input(sampling_params, token_num, max_model_len)
-        except ValueError as tgis_validation_error:
-            await context.abort(
-                StatusCode.INVALID_ARGUMENT, str(tgis_validation_error)
-            )
+            validate_input(sampling_params, len(ids), window)
+        except ValueError as e:
+            await context.abort(StatusCode.INVALID_ARGUMENT, str(e))
 
-        max_new_tokens: Optional[int] = sampling_params.max_tokens
-        max_is_token_limit = False
-        if max_new_tokens is None:
-            # no request cap: default to the largest of server default /
-            # remaining context (same policy as the reference, :789-795)
+        room = window - len(ids)
+        requested = sampling_params.max_tokens
+        if requested is None:
+            # no request cap: largest of server default / remaining window
             sampling_params.max_tokens = min(
-                self.max_max_new_tokens, max_model_len - token_num
+                self.policy.max_new_tokens_cap, room
             )
-            max_is_token_limit = True
-        elif token_num + max_new_tokens > max_model_len:
-            sampling_params.max_tokens = max_model_len - token_num
-            max_is_token_limit = True
+            return ids, True
+        if requested > room:
+            sampling_params.max_tokens = room
+            return ids, True
+        return ids, False
 
-        return input_ids, max_is_token_limit
+    def _make_generator(self, prompt, prompt_token_ids, **kwargs):  # noqa: ANN001, ANN202
+        return self.engine.generate(
+            prompt=prompt, prompt_token_ids=prompt_token_ids, **kwargs
+        )
 
-    @log_rpc_handler_errors
+    # ----------------------------------------------------------------- RPCs
+
+    async def Generate(
+        self,
+        request: "BatchedGenerationRequest",
+        context: aio.ServicerContext,
+    ) -> BatchedGenerationResponse:
+        try:
+            return await self._generate_batch(request, context)
+        except Exception as e:  # noqa: BLE001
+            await self._rpc_failed(e, context, "Generate")
+
+    async def _generate_batch(
+        self,
+        request: "BatchedGenerationRequest",
+        context: aio.ServicerContext,
+    ) -> BatchedGenerationResponse:
+        setup = await self._setup(request, request.params, context)
+        setup.sampling_params.output_kind = RequestOutputKind.FINAL_ONLY
+        truncate_to = request.params.truncate_input_tokens or None
+        n = len(request.requests)
+
+        streams = []
+        capped = [False] * n
+        for i, sub in enumerate(request.requests):
+            # each sub-request gets its own params copy: max_tokens is
+            # clamped against THIS prompt and the engine holds the object
+            # until the stream completes
+            sp = dataclasses.replace(setup.sampling_params)
+            ids, capped[i] = await self._encode_prompt(
+                sub.text, sp, truncate_to, setup.tokenizer, context
+            )
+            sub_id = f"{setup.request_id}-{i}"
+            logs.set_correlation_id(sub_id, setup.correlation_id)
+            streams.append(
+                self._make_generator(
+                    prompt=sub.text,
+                    prompt_token_ids=ids,
+                    sampling_params=sp,
+                    request_id=sub_id,
+                    **setup.engine_kwargs,
+                )
+            )
+
+        # FINAL_ONLY streams yield exactly once, so the deadline is a timer
+        # task that aborts every sub-request when it fires; aborted
+        # requests still emit their partial output.
+        deadline_hit = False
+
+        async def _expire() -> None:
+            nonlocal deadline_hit
+            await asyncio.sleep(max(0.0, setup.deadline - time.time()))
+            deadline_hit = True
+            for j in range(n):
+                await self.engine.abort(f"{setup.request_id}-{j}")
+
+        timer = (
+            asyncio.create_task(_expire())
+            if setup.deadline is not None
+            else None
+        )
+        finals: list = [None] * n
+        try:
+            async for i, result in merge_async_iterators(*streams):
+                if result.prompt is None:
+                    result.prompt = request.requests[i].text
+                finals[i] = result
+        finally:
+            if timer is not None:
+                timer.cancel()
+
+        resp = request.params.response
+        eos_of = conv.eos_text_fn(setup.tokenizer)
+        wire = []
+        for i, result in enumerate(finals):
+            output = result.outputs[0]
+            code, text = conv.map_stop_reason(
+                output,
+                capped_by_context=capped[i],
+                deadline_hit=deadline_hit,
+                eos_text_of=eos_of,
+            )
+            frame = conv.make_generation_frame(
+                output,
+                resp,
+                token_count=len(output.token_ids),
+                stop_code=code,
+                stop_text=text,
+                tokenizer=setup.tokenizer,
+            )
+            conv.attach_input_details(
+                frame, result, resp, setup.sampling_params.seed,
+                setup.tokenizer,
+            )
+            wire.append(frame)
+        return BatchedGenerationResponse(responses=wire)
+
+    async def GenerateStream(
+        self,
+        request: "SingleGenerationRequest",
+        context: aio.ServicerContext,
+    ) -> "AsyncIterator[GenerationResponse]":
+        try:
+            async for frame in self._generate_stream(request, context):
+                yield frame
+        except Exception as e:  # noqa: BLE001
+            await self._rpc_failed(e, context, "GenerateStream")
+
+    async def _generate_stream(
+        self,
+        request: "SingleGenerationRequest",
+        context: aio.ServicerContext,
+    ) -> "AsyncIterator[GenerationResponse]":
+        setup = await self._setup(request, request.params, context)
+        setup.sampling_params.output_kind = RequestOutputKind.DELTA
+        ids, capped = await self._encode_prompt(
+            request.request.text,
+            setup.sampling_params,
+            request.params.truncate_input_tokens or None,
+            setup.tokenizer,
+            context,
+        )
+
+        resp = request.params.response
+        eos_of = conv.eos_text_fn(setup.tokenizer)
+        head: Optional[GenerationResponse] = None  # input-details frame
+        tail: Optional[GenerationResponse] = None  # last emitted frame
+        tokens_so_far = 0
+        deadline_hit = False
+        accumulated_text = []
+
+        stream = self._make_generator(
+            prompt=request.request.text,
+            prompt_token_ids=ids,
+            sampling_params=setup.sampling_params,
+            request_id=setup.request_id,
+            **setup.engine_kwargs,
+        )
+        async for result in stream:
+            if head is None:
+                # frame 0: prompt details only (the +1 in the N+1 framing
+                # contract); chunked prefill may deliver prompt token ids
+                # across several results but the first carries the count
+                if result.prompt is None:
+                    result.prompt = request.request.text
+                head = conv.attach_input_details(
+                    GenerationResponse(), result, resp,
+                    setup.sampling_params.seed, setup.tokenizer,
+                )
+                tail = head
+                yield head
+
+            if setup.deadline is not None and time.time() >= setup.deadline:
+                deadline_hit = True
+                await self.engine.abort(setup.request_id)
+
+            output = result.outputs[0]
+            tokens_so_far += len(output.token_ids)
+            is_empty_delta = (
+                not tokens_so_far
+                and not output.finish_reason
+                and not deadline_hit
+            )
+            if is_empty_delta:
+                continue
+
+            code, text = conv.map_stop_reason(
+                output,
+                capped_by_context=capped,
+                deadline_hit=deadline_hit,
+                eos_text_of=eos_of,
+            )
+            tail = conv.make_generation_frame(
+                output,
+                resp,
+                token_count=tokens_so_far,
+                stop_code=code,
+                stop_text=text,
+                tokenizer=setup.tokenizer,
+            )
+            yield tail
+            accumulated_text.append(output.text)
+            if deadline_hit:
+                break
+
+        if head is None or tail is None:
+            return
+        # the logging wrapper reads the FIRST yielded object after the
+        # stream closes; fold the final state into it
+        head.text = "".join(accumulated_text)
+        head.stop_reason = tail.stop_reason
+        head.stop_sequence = tail.stop_sequence
+        head.generated_token_count = tail.generated_token_count
+
     async def Tokenize(
         self,
         request: "BatchedTokenizeRequest",
         context: aio.ServicerContext,
     ) -> BatchedTokenizeResponse:
-        """Tokenize input texts, with optional truncation/offsets/tokens."""
-        adapter_kwargs = await self._validate_adapters(request, context)
-        tokenizer = await self._get_tokenizer(adapter_kwargs)
+        try:
+            return await self._tokenize_batch(request, context)
+        except Exception as e:  # noqa: BLE001
+            await self._rpc_failed(e, context, "Tokenize")
 
-        responses: list[TokenizeResponse] = []
+    async def _tokenize_batch(
+        self,
+        request: "BatchedTokenizeRequest",
+        context: aio.ServicerContext,
+    ) -> BatchedTokenizeResponse:
+        engine_kwargs = await self._resolve_adapters(request, context)
+        tokenizer = await self.engine.get_tokenizer(
+            engine_kwargs.get("lora_request")
+        )
+        out = [
+            self._tokenize_one(sub.text, request, tokenizer)
+            for sub in request.requests
+        ]
+        return BatchedTokenizeResponse(responses=out)
 
-        for req in request.requests:
-            if not hasattr(tokenizer, "encode_plus"):
-                if request.return_offsets:
-                    raise ValueError(
-                        f"{type(tokenizer)} doesn't support the "
-                        "return_offsets option"
-                    )
-                batch_encoding = None
-                token_ids = tokenizer.encode(req.text)
-            else:
-                batch_encoding = tokenizer.encode_plus(
-                    text=req.text,
-                    return_offsets_mapping=request.return_offsets,
-                    add_special_tokens=ADD_SPECIAL_TOKENS,
-                )
-                token_ids = batch_encoding.input_ids
-
-            token_count = len(token_ids)
-            if 0 < request.truncate_input_tokens < token_count:
-                token_count = request.truncate_input_tokens
-
-            tokens = tokenizer.convert_ids_to_tokens(token_ids)
-            offsets = None
-
-            if request.return_offsets:
-                offsets = [
-                    {"start": start, "end": end}
-                    for start, end in batch_encoding.offset_mapping
-                    if start is not None and end is not None
-                ]
-                offsets = offsets[-token_count:]
-
-            tokens = tokens[-token_count:] if request.return_tokens else None
-
-            responses.append(
-                TokenizeResponse(
-                    token_count=token_count, tokens=tokens, offsets=offsets
-                )
+    @staticmethod
+    def _tokenize_one(
+        text: str,
+        request: "BatchedTokenizeRequest",
+        tokenizer,  # noqa: ANN001
+    ) -> TokenizeResponse:
+        """Encode one text; truncation keeps the TAIL (TGIS semantics)."""
+        if hasattr(tokenizer, "encode_plus"):
+            enc = tokenizer.encode_plus(
+                text=text,
+                return_offsets_mapping=request.return_offsets,
+                add_special_tokens=_special_tokens_enabled(),
             )
+            ids = enc.input_ids
+            offset_pairs = (
+                enc.offset_mapping if request.return_offsets else None
+            )
+        elif request.return_offsets:
+            raise ValueError(
+                f"{type(tokenizer)} doesn't support the return_offsets option"
+            )
+        else:
+            ids = tokenizer.encode(text)
+            offset_pairs = None
 
-        return BatchedTokenizeResponse(responses=responses)
+        keep = len(ids)
+        if 0 < request.truncate_input_tokens < keep:
+            keep = request.truncate_input_tokens
 
-    @log_rpc_handler_errors
+        tokens = offsets = None
+        if request.return_tokens:
+            tokens = tokenizer.convert_ids_to_tokens(ids)[-keep:]
+        if offset_pairs is not None:
+            offsets = [
+                {"start": s, "end": e}
+                for s, e in offset_pairs
+                if s is not None and e is not None
+            ][-keep:]
+        return TokenizeResponse(
+            token_count=keep, tokens=tokens, offsets=offsets
+        )
+
     async def ModelInfo(
         self,
         request: "ModelInfoRequest",  # noqa: ARG002
-        context: aio.ServicerContext,  # noqa: ARG002
+        context: aio.ServicerContext,
     ) -> ModelInfoResponse:
-        return ModelInfoResponse(
-            # decoder-only transformer families only, like the reference
-            model_kind=ModelInfoResponse.ModelKind.DECODER_ONLY,
-            max_sequence_length=self.config.max_model_len,
-            max_new_tokens=self.max_max_new_tokens,
-        )
+        try:
+            return ModelInfoResponse(
+                # decoder-only transformer families only, like the reference
+                model_kind=ModelInfoResponse.ModelKind.DECODER_ONLY,
+                max_sequence_length=self.config.max_model_len,
+                max_new_tokens=self.policy.max_new_tokens_cap,
+            )
+        except Exception as e:  # noqa: BLE001
+            await self._rpc_failed(e, context, "ModelInfo")
 
 
-def _extract_trace_headers(headers: dict[str, str]) -> dict[str, str]:
-    """Keep only W3C trace-context headers for engine-side OTel propagation."""
-    return {
-        k: v for k, v in headers.items() if k.lower() in ("traceparent", "tracestate")
-    }
+# ------------------------------------------------------------------- server
+
+
+def _tls_credentials(args: "argparse.Namespace"):  # noqa: ANN202
+    """Build server TLS credentials from --ssl-* args, or None for
+    plaintext.  mTLS (client-cert verification) turns on when a CA bundle
+    is supplied."""
+    if not (args.ssl_keyfile and args.ssl_certfile):
+        return None
+
+    def read(path: str, flag: str) -> bytes:
+        try:
+            with open(path, "rb") as f:
+                return f.read()
+        except OSError as e:
+            raise ValueError(f"Error reading `{flag}` file: {path}") from e
+
+    key = read(args.ssl_keyfile, "ssl_keyfile")
+    cert = read(args.ssl_certfile, "ssl_certfile")
+    ca = read(args.ssl_ca_certs, "ssl_ca_certs") if args.ssl_ca_certs else None
+    return grpc.ssl_server_credentials(
+        [(key, cert)],
+        root_certificates=ca,
+        require_client_auth=ca is not None,
+    )
 
 
 async def start_grpc_server(
@@ -822,57 +562,23 @@ async def start_grpc_server(
     health_servicer = health.HealthServicer()
     health.add_HealthServicer_to_server(health_servicer, server)
 
-    generation = TextGenerationService(engine, args, health_servicer, stop_event)
-    await generation.post_init()
-    rpc.add_GenerationServiceServicer_to_server(generation, server)
+    service = TextGenerationService(engine, args, health_servicer, stop_event)
+    await service.post_init()
+    rpc.add_GenerationServiceServicer_to_server(service, server)
 
-    # reflection: grpc_reflection isn't available in this environment; the
-    # descriptor set is still importable from generation_pb2 for clients
-    _ = generation_pb2.DESCRIPTOR
+    reflection.enable_server_reflection(
+        (service.SERVICE_NAME, health.SERVICE_NAME), server
+    )
 
-    host = "0.0.0.0" if args.host is None else args.host  # noqa: S104
-    listen_on = f"{host}:{args.grpc_port}"
-    ssl_keyfile = args.ssl_keyfile
-    ssl_certfile = args.ssl_certfile
-    ssl_ca_certs = args.ssl_ca_certs
-
-    if ssl_keyfile and ssl_certfile:
-        require_client_auth = False
-        try:
-            with open(ssl_keyfile, "rb") as f:
-                ssl_key = f.read()
-        except Exception as e:
-            raise ValueError(
-                f"Error reading `ssl_keyfile` file: {ssl_keyfile}"
-            ) from e
-        try:
-            with open(ssl_certfile, "rb") as f:
-                ssl_cert = f.read()
-        except Exception as e:
-            raise ValueError(
-                f"Error reading `ssl_certfile` file: {ssl_certfile}"
-            ) from e
-        if ssl_ca_certs:
-            require_client_auth = True
-            try:
-                with open(ssl_ca_certs, "rb") as f:
-                    root_certificates = f.read()
-            except Exception as e:
-                raise ValueError(
-                    f"Error reading `ssl_ca_certs` file: {ssl_ca_certs}"
-                ) from e
-        else:
-            root_certificates = None
-        server_credentials = grpc.ssl_server_credentials(
-            [(ssl_key, ssl_cert)], root_certificates, require_client_auth
-        )
-        server.add_secure_port(listen_on, server_credentials)
+    address = f"{args.host or '0.0.0.0'}:{args.grpc_port}"  # noqa: S104
+    creds = _tls_credentials(args)
+    if creds is not None:
+        server.add_secure_port(address, creds)
     else:
-        server.add_insecure_port(listen_on)
+        server.add_insecure_port(address)
 
     await server.start()
-    logger.info("gRPC Server started at %s", listen_on)
-
+    logger.info("gRPC Server started at %s", address)
     return server
 
 
@@ -882,16 +588,11 @@ async def run_grpc_server(
 ) -> None:
     stop_event = asyncio.Event()
     server = await start_grpc_server(args, engine, stop_event)
-
-    async def wait_for_server_shutdown() -> None:
-        await stop_event.wait()
-        # no grace: the engine is dead
-        await server.stop(0)
-
     try:
-        # either the server stops itself (engine death) or this task is
-        # cancelled by the dual-server orchestrator
-        await wait_for_server_shutdown()
+        # run until the engine dies (stop_event) or the orchestrator
+        # cancels us
+        await stop_event.wait()
+        await server.stop(0)  # no grace: the engine is gone
     except asyncio.CancelledError:
         logger.info("Gracefully stopping gRPC server")
         await server.stop(30)

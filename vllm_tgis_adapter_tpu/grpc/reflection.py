@@ -1,0 +1,126 @@
+"""gRPC server reflection (v1alpha) over the hand-rolled pb layer.
+
+The reference registers grpc_reflection's implementation
+(/root/reference/src/vllm_tgis_adapter/grpc/grpc_server.py:17,923-926);
+that package is unavailable here, so the service is implemented directly —
+same approach as the hand-written bindings in pb/rpc.py.  Descriptors are
+served from protobuf's default descriptor pool, which every
+protoc-generated ``*_pb2`` module in the process registers into, so
+``grpcurl ... list`` / ``describe`` work against this server.
+"""
+
+from __future__ import annotations
+
+import grpc
+from google.protobuf import descriptor_pb2, descriptor_pool
+
+from vllm_tgis_adapter_tpu.grpc.pb import reflection_pb2
+
+SERVICE_NAME = "grpc.reflection.v1alpha.ServerReflection"
+
+
+def _serialized_file_closure(file_desc) -> list[bytes]:  # noqa: ANN001
+    """A file's FileDescriptorProto plus its transitive dependencies.
+
+    Clients (grpcurl, evans) expect the whole closure so they can build a
+    self-contained descriptor database from one response.
+    """
+    seen: set[str] = set()
+    ordered = []
+
+    def walk(fd) -> None:  # noqa: ANN001
+        if fd.name in seen:
+            return
+        seen.add(fd.name)
+        for dep in fd.dependencies:
+            walk(dep)
+        proto = descriptor_pb2.FileDescriptorProto()
+        fd.CopyToProto(proto)
+        ordered.append(proto.SerializeToString())
+
+    walk(file_desc)
+    return ordered
+
+
+class ReflectionServicer:
+    """Answers v1alpha reflection queries for a fixed set of services."""
+
+    def __init__(self, service_names, pool=None):  # noqa: ANN001
+        self._services = tuple(service_names)
+        self._pool = pool or descriptor_pool.Default()
+
+    def _files_response(self, file_desc):  # noqa: ANN001, ANN202
+        return reflection_pb2.ServerReflectionResponse(
+            file_descriptor_response=reflection_pb2.FileDescriptorResponse(
+                file_descriptor_proto=_serialized_file_closure(file_desc)
+            )
+        )
+
+    @staticmethod
+    def _not_found(detail: str):  # noqa: ANN205
+        return reflection_pb2.ServerReflectionResponse(
+            error_response=reflection_pb2.ErrorResponse(
+                error_code=grpc.StatusCode.NOT_FOUND.value[0],
+                error_message=detail,
+            )
+        )
+
+    def _answer(self, request):  # noqa: ANN001, ANN202
+        kind = request.WhichOneof("message_request")
+
+        if kind == "list_services":
+            return reflection_pb2.ServerReflectionResponse(
+                list_services_response=reflection_pb2.ListServiceResponse(
+                    service=[
+                        reflection_pb2.ServiceResponse(name=name)
+                        for name in self._services
+                    ]
+                )
+            )
+
+        if kind == "file_by_filename":
+            try:
+                fd = self._pool.FindFileByName(request.file_by_filename)
+            except KeyError:
+                return self._not_found(request.file_by_filename)
+            return self._files_response(fd)
+
+        if kind == "file_containing_symbol":
+            try:
+                fd = self._pool.FindFileContainingSymbol(
+                    request.file_containing_symbol
+                )
+            except KeyError:
+                return self._not_found(request.file_containing_symbol)
+            return self._files_response(fd)
+
+        # extensions are proto2-era; nothing in this API uses them
+        return self._not_found(f"unsupported reflection request: {kind}")
+
+    async def ServerReflectionInfo(self, request_iterator, context):  # noqa: ANN001, ARG002, N802
+        async for request in request_iterator:
+            response = self._answer(request)
+            response.valid_host = request.host
+            response.original_request.CopyFrom(request)
+            yield response
+
+
+def enable_server_reflection(service_names, server) -> None:  # noqa: ANN001
+    """Register the reflection service (itself included in the listing)."""
+    servicer = ReflectionServicer((*service_names, SERVICE_NAME))
+    handler = grpc.stream_stream_rpc_method_handler(
+        servicer.ServerReflectionInfo,
+        request_deserializer=(
+            reflection_pb2.ServerReflectionRequest.FromString
+        ),
+        response_serializer=(
+            reflection_pb2.ServerReflectionResponse.SerializeToString
+        ),
+    )
+    server.add_generic_rpc_handlers(
+        (
+            grpc.method_handlers_generic_handler(
+                SERVICE_NAME, {"ServerReflectionInfo": handler}
+            ),
+        )
+    )

@@ -1,10 +1,11 @@
 """Standalone gRPC health probe CLI (``grpc_healthcheck``).
 
-Capability analog of the reference probe (healthcheck.py:17-96): calls
-``grpc.health.v1.Health/Check`` for ``fmaas.GenerationService`` and exits
-non-zero unless the status is SERVING — suitable for k8s liveness probes.
-Uses our hand-written health stub (grpc/health.py) since grpc_health is not
-installed in this environment.
+Capability analog of the reference probe
+(/root/reference/src/vllm_tgis_adapter/healthcheck.py:17-96): queries
+``grpc.health.v1.Health/Check`` for the generation service and exits
+non-zero unless the reported status is SERVING, which makes it directly
+usable as a k8s liveness/readiness exec probe.  Built on our hand-written
+health stub (grpc/health.py); grpc_health is not installed here.
 """
 
 from __future__ import annotations
@@ -12,89 +13,75 @@ from __future__ import annotations
 import argparse
 import sys
 
-import grpc
+DEFAULT_TARGET = "localhost:8033"
+DEFAULT_SERVICE = "fmaas.GenerationService"  # TextGenerationService.SERVICE_NAME
 
 
-def health_check(
-    *,
-    server_url: str = "localhost:8033",
-    service: str | None = None,
-    insecure: bool = True,
-    timeout: float = 1,
-) -> bool:
+def probe(target: str, service: str, timeout: float, secure: bool) -> int:
+    """Run one Health/Check round trip; return a process exit code."""
+    import grpc
+
     from vllm_tgis_adapter_tpu.grpc.health import HealthStub
-    from vllm_tgis_adapter_tpu.grpc.pb.health_pb2 import HealthCheckRequest
+    from vllm_tgis_adapter_tpu.grpc.pb.health_pb2 import (
+        HealthCheckRequest,
+        HealthCheckResponse,
+    )
 
     print("health check...", end="")
-    request = HealthCheckRequest(service=service or "")
-    channel = (
-        grpc.insecure_channel(server_url)
-        if insecure
-        else grpc.secure_channel(server_url, grpc.ssl_channel_credentials())
+    make_channel = (
+        (lambda: grpc.secure_channel(target, grpc.ssl_channel_credentials()))
+        if secure
+        else (lambda: grpc.insecure_channel(target))
     )
     try:
-        with channel:
-            response = HealthStub(channel).Check(request, timeout=timeout)
-    except grpc.RpcError as e:
-        print(f"Health.Check failed: code={e.code()}, details={e.details()}")
-        return False
+        with make_channel() as channel:
+            stub = HealthStub(channel)
+            reply = stub.Check(
+                HealthCheckRequest(service=service), timeout=timeout
+            )
+    except grpc.RpcError as err:
+        print(f"Health.Check failed: code={err.code()}, details={err.details()}")
+        return 1
 
-    print(str(response).strip())
-    from vllm_tgis_adapter_tpu.grpc.pb.health_pb2 import HealthCheckResponse
+    print(str(reply).strip())
+    return 0 if reply.status == HealthCheckResponse.SERVING else 1
 
-    return response.status == HealthCheckResponse.SERVING
+
+def _build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="grpc_healthcheck",
+        description=__doc__.splitlines()[0],
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter,
+    )
+    tls = parser.add_mutually_exclusive_group()
+    tls.add_argument(
+        "--insecure", action="store_false", dest="secure", default=False,
+        help="Use an insecure connection",
+    )
+    tls.add_argument(
+        "--secure", action="store_true", dest="secure",
+        help="Use a secure connection",
+    )
+    parser.add_argument(
+        "--server-url", default=DEFAULT_TARGET,
+        help="grpc server url (`host:port`)",
+    )
+    parser.add_argument(
+        "--timeout", type=float, default=1,
+        help="Timeout for healthcheck request",
+    )
+    parser.add_argument(
+        "--service-name", default=DEFAULT_SERVICE,
+        help="Name of the service to check",
+    )
+    return parser
 
 
 def cli() -> None:
-    args = parse_args()
-    if not health_check(
-        server_url=args.server_url,
-        service=args.service_name,
-        insecure=args.insecure,
-        timeout=args.timeout,
-    ):
-        sys.exit(1)
-
-
-def parse_args() -> argparse.Namespace:
-    parser = argparse.ArgumentParser()
-    parser.formatter_class = argparse.ArgumentDefaultsHelpFormatter
-    group = parser.add_mutually_exclusive_group(required=False)
-    group.add_argument(
-        "--insecure",
-        dest="insecure",
-        action="store_true",
-        help="Use an insecure connection",
+    opts = _build_parser().parse_args()
+    sys.exit(
+        probe(opts.server_url, opts.service_name, opts.timeout, opts.secure)
     )
-    group.add_argument(
-        "--secure",
-        dest="insecure",
-        action="store_false",
-        help="Use a secure connection",
-    )
-    group.set_defaults(insecure=True)
-    parser.add_argument(
-        "--server-url",
-        type=str,
-        help="grpc server url (`host:port`)",
-        default="localhost:8033",
-    )
-    parser.add_argument(
-        "--timeout",
-        type=float,
-        help="Timeout for healthcheck request",
-        default=1,
-    )
-    parser.add_argument(
-        "--service-name",
-        type=str,
-        help="Name of the service to check",
-        required=False,
-        # matches TextGenerationService.SERVICE_NAME without the import cost
-        default="fmaas.GenerationService",
-    )
-
-    return parser.parse_args()
 
 
 if __name__ == "__main__":

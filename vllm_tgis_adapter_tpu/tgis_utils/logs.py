@@ -1,11 +1,13 @@
 """TGIS-style structured per-request logging.
 
-Uniform request/response/error/cancellation log lines for BOTH the gRPC and
-HTTP servers, implemented (as in the reference, tgis_utils/logs.py:48-114)
-by wrapping ``engine.generate`` once at startup so every entrypoint is
-covered regardless of which API produced the request.  Correlation IDs are
-passed between servers and this module through a TTL-bounded blackboard
-(reference: logs.py:29).
+Uniform request/response/error/cancellation log lines for BOTH the gRPC
+and HTTP servers.  Coverage works the same way as the reference
+(/root/reference/src/vllm_tgis_adapter/tgis_utils/logs.py:48-114): the
+engine's ``generate`` is wrapped once at startup so every entrypoint is
+logged no matter which API produced the request.  The line formats are
+TGIS log-compat (operators grep for them); the implementation here is
+organised around a per-request ``_RequestLog`` recorder instead of the
+reference's free-function layout.
 """
 
 from __future__ import annotations
@@ -24,215 +26,184 @@ if TYPE_CHECKING:
     from collections.abc import AsyncGenerator
 
     from vllm_tgis_adapter_tpu.engine.async_llm import AsyncLLMEngine
-    from vllm_tgis_adapter_tpu.engine.outputs import RequestMetrics, RequestOutput
+    from vllm_tgis_adapter_tpu.engine.outputs import RequestOutput
     from vllm_tgis_adapter_tpu.engine.sampling_params import SamplingParams
 
 logger = init_logger(__name__)
 
-# request_id -> correlation_id blackboard.  Size/TTL match the reference
-# (2048 entries, 600 s) so log-correlation behavior is identical under load.
-_REQUEST_ID_TO_CORRELATION_ID: TTLCache = TTLCache(maxsize=2048, ttl=600)
+# request_id → correlation_id blackboard shared by both servers.  Geometry
+# (2048 entries / 600 s) is part of the operational contract: correlation
+# survives as long under load as it does in the reference deployment.
+_CORRELATION_TTL_S = 600
+_CORRELATION_CAPACITY = 2048
+_correlations: TTLCache = TTLCache(
+    maxsize=_CORRELATION_CAPACITY, ttl=_CORRELATION_TTL_S
+)
 
 
 def set_correlation_id(request_id: str, correlation_id: Optional[str]) -> None:
-    if correlation_id is not None:
-        _REQUEST_ID_TO_CORRELATION_ID[request_id] = correlation_id
+    if correlation_id:
+        _correlations[request_id] = correlation_id
 
 
 def get_correlation_id(request_id: str) -> Optional[str]:
-    correlation_id = _REQUEST_ID_TO_CORRELATION_ID.get(request_id)
-    if not correlation_id:
-        # the http server formats ids as {method}-{base_request_id}-{index};
-        # strip the leading and trailing clauses and retry
-        request_id = "-".join(request_id.split("-")[1:-1])
-        correlation_id = _REQUEST_ID_TO_CORRELATION_ID.get(request_id)
-    return correlation_id
+    found = _correlations.get(request_id)
+    if found:
+        return found
+    # http request ids look like {method}-{base_id}-{index}; retry on the
+    # middle section
+    parts = request_id.split("-")
+    if len(parts) > 2:
+        return _correlations.get("-".join(parts[1:-1]))
+    return None
+
+
+def _redacted_params(params: "SamplingParams") -> str:
+    """Stringify sampling params with constrained-decoding payloads masked
+    (schemas/regexes may embed user data or secrets)."""
+    text = str(params)
+    payload = getattr(params, "structured_outputs", None)
+    if payload is not None:
+        text = text.replace(str(payload), "(...)")
+    return text
+
+
+class _RequestLog:
+    """Collects one request's identity + timing and emits its log lines."""
+
+    def __init__(self, request_id: str, lora_request, prompt_token_ids):  # noqa: ANN001
+        self.request_id = request_id
+        self.correlation_id = get_correlation_id(request_id)
+        self.adapter_id = getattr(lora_request, "adapter_id", None)
+        self.num_prompt_tokens = (
+            len(prompt_token_ids) if prompt_token_ids is not None else None
+        )
+        self.started_at = time.time()
+
+    def accepted(self, params: "SamplingParams") -> None:
+        token_clause = (
+            f" input_tokens={self.num_prompt_tokens},"
+            if self.num_prompt_tokens is not None
+            else ""
+        )
+        logger.info(
+            "Processing request: {request_id=%s, correlation_id=%s, "
+            "adapter_id=%s,%s params=%s}",
+            self.request_id, self.correlation_id, self.adapter_id,
+            token_clause, _redacted_params(params),
+        )
+
+    def cancelled(self) -> None:
+        logger.info(
+            "Request cancelled: request_id=%s correlation_id=%s",
+            self.request_id, self.correlation_id,
+        )
+
+    def failed(self, exc: BaseException) -> None:
+        logger.error(
+            "Request failed: request_id=%s correlation_id=%s error=%s",
+            self.request_id, self.correlation_id, exc,
+        )
+
+    def finished(self, final: "RequestOutput") -> None:
+        """The TGIS summary line: queue/inference/per-token/total timings."""
+        if not final.outputs:
+            return
+        completion = final.outputs[0]
+        n_generated = len(completion.token_ids)
+
+        timings = self._timings(final, n_generated)
+        if timings is None:
+            logger.warning(
+                "No engine metrics for request, cannot log timing info"
+            )
+            queue_s = infer_s = per_tok_s = total_s = 0.0
+        else:
+            queue_s, infer_s, per_tok_s, total_s = timings
+
+        reason = completion.finish_reason
+        with suppress(BaseException):
+            from vllm_tgis_adapter_tpu import metrics
+
+            metrics.record_response(
+                kind=reason or "unknown",
+                prompt_tokens=len(final.prompt_token_ids or ()),
+                generated_tokens=n_generated,
+                duration_s=total_s,
+                queue_s=queue_s,
+            )
+
+        logger.log(
+            logging.WARNING if reason == "abort" else logging.INFO,
+            "Finished processing request: {request_id=%s, correlation_id=%s}. "
+            "Timing info: {queue_time=%.2fms, inference_time=%.2fms, "
+            "time_per_token=%.2fms, total_time=%.2fms}. "
+            "Generated %d tokens before finish reason: %s, output %d chars",
+            self.request_id, self.correlation_id,
+            queue_s * 1e3, infer_s * 1e3, per_tok_s * 1e3, total_s * 1e3,
+            n_generated, reason, len(completion.text),
+        )
+
+    def _timings(
+        self, final: "RequestOutput", n_generated: int
+    ) -> Optional[tuple[float, float, float, float]]:
+        m = final.metrics
+        if (
+            m is None
+            or m.first_scheduled_time is None
+            or m.last_token_time is None
+        ):
+            return None
+        inference = m.last_token_time - m.first_scheduled_time
+        per_token = inference / n_generated if n_generated else 0.0
+        return (
+            m.time_in_queue or 0.0,
+            inference,
+            per_token,
+            m.last_token_time - self.started_at,
+        )
 
 
 def add_logging_wrappers(engine: "AsyncLLMEngine") -> None:
     """Wrap ``engine.generate`` with uniform TGIS-style logging."""
-    old_generate_fn = engine.generate
+    inner = engine.generate
 
-    @functools.wraps(old_generate_fn)
-    async def generate_with_logging(
+    @functools.wraps(inner)
+    async def logged_generate(
         *args, **kwargs
     ) -> "AsyncGenerator[RequestOutput, None]":
-        start_time = time.time()
+        # mirror AsyncLLMEngine.generate's positional order
+        def arg(name: str, pos: int):  # noqa: ANN202
+            return args[pos] if len(args) > pos else kwargs.get(name)
 
-        # NB: coupled to AsyncLLMEngine.generate() positional order
-        prompt = _get_arg("prompt", 0, *args, **kwargs)
-        sampling_params = _get_arg("sampling_params", 1, *args, **kwargs)
-        request_id = _get_arg("request_id", 2, *args, **kwargs)
-        lora_request = kwargs.get("lora_request")
-        prompt_token_ids = kwargs.get("prompt_token_ids")
-
-        correlation_id = get_correlation_id(request_id=request_id)
-        adapter_id = getattr(lora_request, "adapter_id", None)
-
+        record = _RequestLog(
+            request_id=arg("request_id", 2),
+            lora_request=kwargs.get("lora_request"),
+            prompt_token_ids=kwargs.get("prompt_token_ids"),
+        )
         with suppress(BaseException):
-            _log_request(
-                prompt=prompt,
-                prompt_token_ids=prompt_token_ids,
-                params=sampling_params,
-                request_id=request_id,
-                correlation_id=correlation_id,
-                adapter_id=adapter_id,
-            )
+            record.accepted(arg("sampling_params", 1))
 
         from vllm_tgis_adapter_tpu import metrics
 
-        last = None
+        final = None
         metrics.num_requests_running.inc()
         try:
-            async for response in old_generate_fn(*args, **kwargs):
-                last = response
-                yield response
+            async for out in inner(*args, **kwargs):
+                final = out
+                yield out
         except asyncio.CancelledError:
-            _log_cancellation(request_id=request_id, correlation_id=correlation_id)
+            record.cancelled()
             raise
         except BaseException as e:
             metrics.request_failure_count.inc()
-            _log_error(
-                request_id=request_id,
-                correlation_id=correlation_id,
-                exception_str=str(e),
-            )
+            record.failed(e)
             raise
         finally:
             metrics.num_requests_running.dec()
 
-        if last:
+        if final is not None:
             with suppress(BaseException):
-                _log_response(
-                    request_id=request_id,
-                    correlation_id=correlation_id,
-                    response=last,
-                    engine_metrics=last.metrics,
-                    start_time=start_time,
-                )
+                record.finished(final)
 
-    engine.generate = generate_with_logging  # type: ignore[method-assign]
-
-
-def _log_error(request_id: str, correlation_id: str, exception_str: str) -> None:
-    logger.error(
-        "Request failed: request_id=%s correlation_id=%s error=%s",
-        request_id,
-        correlation_id,
-        exception_str,
-    )
-
-
-def _log_cancellation(request_id: str, correlation_id: str) -> None:
-    logger.info(
-        "Request cancelled: request_id=%s correlation_id=%s",
-        request_id,
-        correlation_id,
-    )
-
-
-def _sanitize_sampling_params(params: "SamplingParams") -> str:
-    """Redact constrained-decoding payloads (may embed user data/secrets)."""
-    original_params = str(params)
-    if getattr(params, "structured_outputs", None) is not None:
-        return original_params.replace(str(params.structured_outputs), "(...)")
-    return original_params
-
-
-def _log_request(  # noqa: PLR0913
-    request_id: str,
-    params: "SamplingParams",
-    adapter_id: Optional[str],
-    correlation_id: Optional[str],
-    prompt: object,
-    prompt_token_ids: Optional[list[int]],
-) -> None:
-    if prompt_token_ids is not None:
-        input_tokens = f" input_tokens={len(prompt_token_ids)},"
-    else:
-        input_tokens = ""
-
-    sanitized_params = _sanitize_sampling_params(params)
-
-    logger.info(
-        "Processing request: {request_id=%s, correlation_id=%s, adapter_id=%s, "
-        "%sparams=%s}",
-        request_id,
-        correlation_id,
-        adapter_id,
-        input_tokens,
-        sanitized_params,
-    )
-
-
-def _log_response(
-    request_id: str,
-    correlation_id: Optional[str],
-    response: "RequestOutput",
-    engine_metrics: "Optional[RequestMetrics]",
-    start_time: float,
-) -> None:
-    """One TGIS-style summary line with queue/inference/per-token timings."""
-    if len(response.outputs) == 0:
-        return
-
-    generated_tokens = len(response.outputs[0].token_ids)
-    if (
-        engine_metrics is None
-        or engine_metrics.first_scheduled_time is None
-        or engine_metrics.last_token_time is None
-    ):
-        logger.warning("No engine metrics for request, cannot log timing info")
-        inference_time = queue_time = time_per_token = total_time = 0.0
-    else:
-        inference_time = (
-            engine_metrics.last_token_time - engine_metrics.first_scheduled_time
-        )
-        queue_time = engine_metrics.time_in_queue or 0.0
-        time_per_token = _safe_div(inference_time, generated_tokens)
-        total_time = engine_metrics.last_token_time - start_time
-    output_len = len(response.outputs[0].text)
-
-    stop_reason_str = response.outputs[0].finish_reason
-
-    with suppress(BaseException):
-        from vllm_tgis_adapter_tpu import metrics
-
-        metrics.record_response(
-            kind=stop_reason_str or "unknown",
-            prompt_tokens=len(response.prompt_token_ids or ()),
-            generated_tokens=generated_tokens,
-            duration_s=total_time,
-            queue_s=queue_time,
-        )
-
-    level = logging.WARNING if stop_reason_str == "abort" else logging.INFO
-    logger.log(
-        level,
-        "Finished processing request: {request_id=%s, correlation_id=%s}. "
-        "Timing info: {queue_time=%.2fms, inference_time=%.2fms, "
-        "time_per_token=%.2fms, total_time=%.2fms}. "
-        "Generated %d tokens before finish reason: %s, output %d chars",
-        request_id,
-        correlation_id,
-        queue_time * 1e3,
-        inference_time * 1e3,
-        time_per_token * 1e3,
-        total_time * 1e3,
-        generated_tokens,
-        stop_reason_str,
-        output_len,
-    )
-
-
-def _safe_div(a: float, b: float, *, default: float = 0.0) -> float:
-    try:
-        return a / b
-    except ZeroDivisionError:
-        return default
-
-
-def _get_arg(name: str, pos: int, *args, **kwargs):  # noqa: ANN002, ANN003, ANN202
-    if len(args) > pos:
-        return args[pos]
-    return kwargs.get(name)
+    engine.generate = logged_generate  # type: ignore[method-assign]
