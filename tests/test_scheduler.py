@@ -5,12 +5,14 @@ from __future__ import annotations
 import pytest
 
 
-def make_scheduler(num_blocks=8, max_num_seqs=4, block_size=4):
+def make_scheduler(num_blocks=8, max_num_seqs=4, block_size=4,
+                   num_decode_steps=8, **cfg_kwargs):
     from vllm_tgis_adapter_tpu.engine.config import CacheConfig, SchedulerConfig
     from vllm_tgis_adapter_tpu.engine.scheduler import Scheduler
 
     return Scheduler(
-        SchedulerConfig(max_num_seqs=max_num_seqs, prefill_buckets=(8, 16, 32)),
+        SchedulerConfig(max_num_seqs=max_num_seqs, prefill_buckets=(8, 16, 32),
+                        num_decode_steps=num_decode_steps, **cfg_kwargs),
         CacheConfig(block_size=block_size, num_blocks=num_blocks),
         num_blocks,
     )
@@ -69,13 +71,16 @@ def test_decode_preempts_youngest_when_pool_dry():
     from vllm_tgis_adapter_tpu.engine.scheduler import DecodePlan
     from vllm_tgis_adapter_tpu.engine.sequence import SequenceStatus
 
-    sched = make_scheduler(num_blocks=4, block_size=4)
+    # num_decode_steps=1 so the interleaved decode between the two prefills
+    # does not pre-grow a's page list
+    sched = make_scheduler(num_blocks=4, block_size=4, num_decode_steps=1)
     a = make_seq("a", 7, arrival=0.0)  # 2 blocks
     sched.add(a)
     sched.schedule()
     b = make_seq("b", 7, arrival=1.0)  # 2 blocks → pool now full
     sched.add(b)
-    sched.schedule()
+    sched.schedule()  # interleave: decode for a runs after a's prefill
+    sched.schedule()  # now b's prefill is admitted
     assert sched.allocator.num_free == 0
 
     # a grows past its block boundary: 8 tokens fit, the 9th needs a page
@@ -140,3 +145,93 @@ def test_batch_buckets_are_powers_of_two():
     assert sched.batch_buckets == [1, 2, 4, 8, 12]
     assert sched._batch_bucket(3) == 4
     assert sched._batch_bucket(9) == 12
+
+
+def test_chunked_prefill_interleaves_with_decode():
+    """A prompt above max_num_batched_tokens is admitted in chunks and
+    decode steps run between chunks (VERDICT r2 #3: no decode starvation
+    while a long prompt prefils)."""
+    from vllm_tgis_adapter_tpu.engine.scheduler import DecodePlan, PrefillPlan
+
+    sched = make_scheduler(num_blocks=32, block_size=4,
+                           max_num_batched_tokens=8)
+    short = make_seq("short", 5, arrival=0.0)
+    sched.add(short)
+    assert isinstance(sched.schedule(), PrefillPlan)
+
+    long = make_seq("long", 20, arrival=1.0)  # 3 chunks of <=8
+    sched.add(long)
+
+    kinds = []
+    chunk_plans = []
+    for _ in range(8):
+        plan = sched.schedule()
+        if plan is None:
+            break
+        kinds.append(type(plan).__name__)
+        if isinstance(plan, PrefillPlan):
+            chunk_plans.append(plan)
+        if isinstance(plan, DecodePlan):
+            # emulate the engine: each scheduled decode produces a token
+            for s in plan.seqs:
+                s.output_token_ids.append(1)
+        if long.status.name == "RUNNING" and len(chunk_plans) >= 3:
+            break
+
+    # the long prompt was split into 3 chunks: 8 + 8 + 4 tokens
+    assert [len(p.token_ids) for p in chunk_plans] == [8, 8, 4]
+    assert [p.start_pos for p in chunk_plans] == [0, 8, 16]
+    assert [p.is_final for p in chunk_plans] == [False, False, True]
+    # decode ran between the chunks — the short request kept generating
+    first_chunk = kinds.index("PrefillPlan")
+    assert "DecodePlan" in kinds[first_chunk:]
+    assert short.num_output_tokens > 0
+    # slots: each chunk wrote its own token range
+    assert chunk_plans[1].slots == long.blocks.slots_for_range(8, 16)
+
+
+def test_chunked_prefill_abort_releases_pages():
+    """Aborting a request mid-chunked-prefill frees its pages and slot."""
+    sched = make_scheduler(num_blocks=32, block_size=4,
+                           max_num_batched_tokens=8)
+    long = make_seq("long", 20, arrival=0.0)
+    sched.add(long)
+    plan = sched.schedule()
+    assert plan is not None and not plan.is_final
+    free_before = sched.allocator.num_free
+    assert long.blocks is not None
+    sched.abort("long")
+    assert long.blocks is None
+    assert sched.allocator.num_free > free_before
+    assert sched.schedule() is None
+
+
+def test_mid_chunk_prefill_sequence_is_preemptible():
+    """Decode page pressure must reclaim a mid-chunked-prefill sequence's
+    pages (it holds its full allocation while still in `waiting`), not
+    raise the engine-killing 'KV cache too small' error."""
+    from vllm_tgis_adapter_tpu.engine.scheduler import DecodePlan
+    from vllm_tgis_adapter_tpu.engine.sequence import SequenceStatus
+
+    # pool: 5 pages of 4 slots.  A (7 tokens) takes 2; B (12 tokens,
+    # chunked by 8) takes 3 up front → pool dry.
+    sched = make_scheduler(num_blocks=5, block_size=4, num_decode_steps=1,
+                           max_num_batched_tokens=8)
+    a = make_seq("a", 7, arrival=0.0)
+    sched.add(a)
+    sched.schedule()  # prefill a
+    b = make_seq("b", 12, arrival=1.0)
+    sched.add(b)
+    sched.schedule()  # interleave: decode a
+    plan = sched.schedule()  # first chunk of b (8 of 12 tokens)
+    assert plan is not None and not plan.is_final
+    assert sched.allocator.num_free == 0
+
+    # a crosses a page boundary: needs a 3rd page; b (mid-prefill, in
+    # waiting) must be the preemption victim
+    a.output_token_ids.extend([0, 1])  # num_tokens 9
+    plan = sched.schedule()
+    assert isinstance(plan, DecodePlan) and plan.seqs == [a]
+    assert b.status == SequenceStatus.PREEMPTED
+    assert b.blocks is None and b.prefill_pos == 0
+    assert b in sched.waiting  # never left the queue; re-runs from chunk 0

@@ -163,8 +163,20 @@ class AsyncLLMEngine:
                     self._new_work.clear()
                     await self._new_work.wait()
                     continue
+                # the lock covers only the fast host phases (plan/commit);
+                # the blocking device dispatch runs WITHOUT it so aborts
+                # and new requests land mid-dispatch instead of queueing
+                # behind a full fused-step program
                 async with self._engine_lock:
-                    outputs = await asyncio.to_thread(self.engine.step)
+                    outputs, plan, prepared = self.engine.plan_step()
+                if plan is not None:
+                    result = await asyncio.to_thread(
+                        self.engine.execute_step, plan, prepared
+                    )
+                    async with self._engine_lock:
+                        outputs = outputs + self.engine.commit_step(
+                            plan, result
+                        )
                 for out in outputs:
                     queue = self._queues.get(out.request_id)
                     if queue is not None:

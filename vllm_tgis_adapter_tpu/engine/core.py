@@ -185,7 +185,21 @@ class LLMEngine:
     # ------------------------------------------------------------- step loop
 
     def step(self) -> list[RequestOutput]:
-        """Run one device step; return outputs due for emission."""
+        """Run one device step; return outputs due for emission.
+
+        Composes the three phases below; the async engine calls them
+        separately so the engine lock is held only for the (fast) host
+        phases and add_request/abort can land during the device dispatch.
+        """
+        outputs, plan, prepared = self.plan_step()
+        if plan is None:
+            return outputs
+        result = self.execute_step(plan, prepared)
+        return outputs + self.commit_step(plan, result)
+
+    def plan_step(self):
+        """Phase 1 (host, engine lock held): drain scheduler-finished
+        requests, pick the next plan, snapshot its dispatch inputs."""
         outputs: list[RequestOutput] = []
         for seq in self.scheduler.newly_finished:
             self._seqs.pop(seq.request_id, None)
@@ -197,24 +211,42 @@ class LLMEngine:
         self.runner.sync_lora(self.lora_manager)
         plan = self.scheduler.schedule()
         if plan is None:
-            return outputs
+            return outputs, None, None
 
-        now = time.time()
         if isinstance(plan, PrefillPlan):
             seq = plan.seq
             if seq.metrics.first_scheduled_time is None:
+                now = time.time()
                 seq.metrics.first_scheduled_time = now
                 seq.metrics.time_in_queue = now - seq.metrics.arrival_time
-            sampled, prompt_info = self.runner.run_prefill(plan)
+            prepared = self.runner.prepare_prefill(plan)
+        else:
+            prepared = self.runner.prepare_decode(plan)
+        return outputs, plan, prepared
+
+    def execute_step(self, plan, prepared):
+        """Phase 2 (device, lock-free): runs only against the snapshot and
+        runner-owned device state — never reads scheduler structures."""
+        if isinstance(plan, PrefillPlan):
+            return self.runner.execute_prefill(prepared)
+        return self.runner.execute_decode(prepared)
+
+    def commit_step(self, plan, result) -> list[RequestOutput]:
+        """Phase 3 (host, engine lock held): fold sampled tokens back into
+        sequences; requests aborted mid-dispatch are skipped here."""
+        if isinstance(plan, PrefillPlan):
+            seq = plan.seq
+            sampled, prompt_info = result
+            if sampled is None:
+                return []  # mid-prompt chunk: nothing emitted yet
+            if seq.is_finished:
+                return []  # aborted while the dispatch was in flight
             if prompt_info is not None and seq.prompt_logprobs is None:
                 seq.prompt_logprobs = self._build_prompt_logprobs(
                     seq, prompt_info
                 )
-            outputs.extend(self._process_sampled([seq], [[sampled]]))
-        elif isinstance(plan, DecodePlan):
-            sampled = self.runner.run_decode(plan)
-            outputs.extend(self._process_sampled(plan.seqs, sampled))
-        return outputs
+            return self._process_sampled([seq], [[sampled]])
+        return self._process_sampled(plan.seqs, result)
 
     # -------------------------------------------------------------- internal
 
@@ -269,10 +301,17 @@ class LLMEngine:
         if params.stop:
             text = seq.output_text
             best: Optional[tuple[int, str]] = None
+            # scan only the tail that new text could have completed: every
+            # char before stop_scan_pos was already cleared on an earlier
+            # token, so a first match can only start within len(s)-1 chars
+            # of the old frontier (keeps the per-token cost O(delta), not
+            # O(total output) — the earliest-match result is unchanged)
+            frontier = seq.stop_scan_pos
             for s in params.stop:
-                idx = text.find(s)
+                idx = text.find(s, max(0, frontier - len(s) + 1))
                 if idx != -1 and (best is None or idx < best[0]):
                     best = (idx, s)
+            seq.stop_scan_pos = len(text)
             if best is not None:
                 idx, s = best
                 seq.status = SequenceStatus.FINISHED_STOPPED

@@ -11,6 +11,7 @@ recompilation discipline").
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import TYPE_CHECKING, Optional
 
 import jax
@@ -47,6 +48,48 @@ class PromptLogprobInfo:
     ranks: list[int]
     topn_ids: list[list[int]]
     topn_logprobs: list[list[float]]
+
+
+@dataclasses.dataclass
+class PreparedPrefill:
+    """Host-built dispatch inputs for one prefill (chunk) step.
+
+    Snapshotted from the sequence under the engine lock so the device
+    dispatch can run lock-free (engine/async_llm.py step loop).
+    """
+
+    t: int  # real tokens in this chunk
+    token_ids: "np.ndarray"  # [bucket]
+    positions: "np.ndarray"  # [bucket] global positions
+    slot_mapping: "np.ndarray"  # [bucket]
+    start_pos: int
+    is_final: bool
+    block_table: "Optional[np.ndarray]"  # [max_blocks] when start_pos > 0
+    logits_indices: "np.ndarray"
+    want_prompt_lp: bool
+    row_slot: int
+    seen_tokens: "Optional[np.ndarray]"  # final chunks only
+    tensors: Optional[SamplingTensors]  # final chunks only
+    allowed_row: "Optional[np.ndarray]"  # FSM mask, final chunks only
+    lora_slot: int
+
+
+@dataclasses.dataclass
+class PreparedDecode:
+    """Host-built dispatch inputs for one fused K-step decode."""
+
+    num_seqs: int
+    num_steps: int
+    steps_per_seq: list[int]
+    token_ids: "np.ndarray"
+    positions: "np.ndarray"
+    limits: "np.ndarray"
+    context_lens: "np.ndarray"
+    block_tables: "np.ndarray"
+    slots: "np.ndarray"
+    tensors: SamplingTensors
+    allowed_mask: "Optional[np.ndarray]"
+    lora_idx: "Optional[np.ndarray]"
 
 
 @dataclasses.dataclass
@@ -142,6 +185,16 @@ class ModelRunner:
         self._rng = np.random.default_rng(config.seed)
         self.lora_stacks = None
         self._lora_version = 0  # manager starts at 0 = nothing loaded
+
+        # chunked prefill: non-first chunks attend to prior context through
+        # the paged cache (models/llama.py prefill_chunk)
+        self._prefill_chunk_fn = jax.jit(
+            functools.partial(model.prefill_chunk, block_size=self.block_size),
+            donate_argnums=donate,
+        )
+        self._seen_pad_lens = sorted(
+            set(config.scheduler_config.prefill_buckets)
+        )
 
     def sync_lora(self, manager) -> None:
         """Rebuild the stacked adapter tensors when the registry changed
@@ -244,47 +297,125 @@ class ModelRunner:
 
     # --------------------------------------------------------------- prefill
 
-    def run_prefill(
-        self, plan: "PrefillPlan"
-    ) -> tuple[SampledToken, Optional[PromptLogprobInfo]]:
+    def _seen_pad_len(self, n: int) -> int:
+        """Pad length for seen-matrix seeding (bounded compile shapes)."""
+        for b in self._seen_pad_lens:
+            if n <= b:
+                return b
+        quantum = self._seen_pad_lens[-1]
+        return -(-n // quantum) * quantum
+
+    def prepare_prefill(self, plan: "PrefillPlan") -> "PreparedPrefill":
+        """Host half: snapshot everything the dispatch needs from the
+        sequence, so the engine lock can be released during the (slow)
+        device execution — an abort mid-dispatch then cannot race the
+        input build."""
         seq = plan.seq
         t = len(plan.token_ids)
         bucket = plan.bucket_len
 
         token_ids = np.zeros(bucket, np.int32)
         token_ids[:t] = plan.token_ids
-        positions = np.arange(bucket, dtype=np.int32)
+        positions = plan.start_pos + np.arange(bucket, dtype=np.int32)
         slot_mapping = np.full(bucket, -1, np.int32)
         slot_mapping[:t] = plan.slots
 
-        want_prompt_lp = seq.params.prompt_logprobs is not None
+        want_prompt_lp = (
+            plan.is_final and seq.params.prompt_logprobs is not None
+        )
+        # logits rows: the sampled row only, except prompt-logprob requests
+        # which need every bucket row.  (The bucket is already the smallest
+        # compile shape ≥ t, so an exact [t]-row gather would only change
+        # shapes per-request and trade bounded padding for recompiles.)
         logits_indices = (
             np.arange(bucket, dtype=np.int32)
             if want_prompt_lp
             else np.asarray([t - 1], np.int32)
         )
 
+        block_table = None
+        if plan.start_pos > 0:
+            block_table = np.zeros(self.max_blocks_per_seq, np.int32)
+            blocks = seq.blocks.blocks
+            block_table[: len(blocks)] = blocks
+
+        seen_tokens = None
+        tensors = None
+        allowed_row = None
+        if plan.is_final:
+            all_ids = seq.all_token_ids
+            padded = self._seen_pad_len(len(all_ids))
+            seen_tokens = np.full(padded, -1, np.int32)
+            seen_tokens[: len(all_ids)] = all_ids
+            seeds = np.asarray([seq.fallback_seed], np.uint32)
+            tensors = SamplingTensors.from_params(
+                [seq.params],
+                eos_token_id=self.config.model_config.eos_token_id,
+                gen_lens=[seq.num_output_tokens],
+                fallback_seeds=seeds,
+            )
+            if seq.fsm is not None:
+                vocab = self.config.model_config.vocab_size
+                allowed_row = np.zeros(vocab, bool)
+                fsm_row = seq.fsm.allowed_row(seq.fsm_state)
+                allowed_row[: len(fsm_row)] = fsm_row
+
+        return PreparedPrefill(
+            t=t,
+            token_ids=token_ids,
+            positions=positions,
+            slot_mapping=slot_mapping,
+            start_pos=plan.start_pos,
+            is_final=plan.is_final,
+            block_table=block_table,
+            logits_indices=logits_indices,
+            want_prompt_lp=want_prompt_lp,
+            row_slot=seq.slot,
+            seen_tokens=seen_tokens,
+            tensors=tensors,
+            allowed_row=allowed_row,
+            lora_slot=seq.lora_slot,
+        )
+
+    def execute_prefill(
+        self, prep: "PreparedPrefill"
+    ) -> tuple[Optional[SampledToken], Optional[PromptLogprobInfo]]:
+        """Device half; touches only runner-owned state."""
+        t = prep.t
         lora_args = ()
         if self.lora_stacks is not None:
             lora_args = (
                 self.lora_stacks,
-                self._put(np.asarray(seq.lora_slot, np.int32)),
+                self._put(np.asarray(prep.lora_slot, np.int32)),
             )
-        logits, self.caches = self._prefill_fn(
+        common = (
             self.params,
             self.caches,
-            self._put(token_ids),
-            self._put(positions),
-            self._put(slot_mapping),
+            self._put(prep.token_ids),
+            self._put(prep.positions),
+            self._put(prep.slot_mapping),
             self._put(np.asarray(t, np.int32)),
-            self._put(logits_indices),
-            *lora_args,
         )
+        if prep.start_pos == 0:
+            # whole prompt (or the first chunk): flash causal attention is
+            # exact — there is no earlier context to see
+            logits, self.caches = self._prefill_fn(
+                *common, self._put(prep.logits_indices), *lora_args
+            )
+        else:
+            logits, self.caches = self._prefill_chunk_fn(
+                *common,
+                self._put(prep.block_table),
+                self._put(prep.logits_indices),
+                *lora_args,
+            )
+        if not prep.is_final:
+            return None, None  # mid-prompt chunk: nothing to sample
 
         prompt_info = None
-        if want_prompt_lp:
+        if prep.want_prompt_lp:
             lp, rank, tn_ids, tn_lp = sampler_mod.prompt_logprob_info(
-                logits, jnp.asarray(token_ids)
+                logits, jnp.asarray(prep.token_ids)
             )
             n = t - 1  # rows 0..t-2 describe positions 1..t-1
             prompt_info = PromptLogprobInfo(
@@ -297,30 +428,46 @@ class ModelRunner:
         else:
             last_logits = logits
 
-        # seed this row's seen-token matrix with the prompt, then sample
-        row_tokens = np.full(bucket, -1, np.int32)
-        row_tokens[:t] = plan.token_ids
+        # seed this row's seen-token matrix with the full prompt, sample
         self.seen = sampler_mod.set_seen_row(
-            self.seen, self._put(np.asarray(seq.slot)), self._put(row_tokens)
+            self.seen,
+            self._put(np.asarray(prep.row_slot)),
+            self._put(prep.seen_tokens),
         )
-        allowed_mask = None
-        if seq.fsm is not None:
-            vocab = self.config.model_config.vocab_size
-            row = np.zeros(vocab, bool)
-            fsm_row = seq.fsm.allowed_row(seq.fsm_state)
-            row[: len(fsm_row)] = fsm_row
-            allowed_mask = self._put(row[None, :])
-        result = self._sample(last_logits, [seq], allowed_mask=allowed_mask)
-        return result[0], prompt_info
+        allowed_mask = (
+            self._put(prep.allowed_row[None, :])
+            if prep.allowed_row is not None
+            else None
+        )
+        seen_rows = jnp.take(
+            self.seen,
+            jnp.clip(jnp.asarray([prep.row_slot]), 0, None),
+            axis=0,
+        )
+        out = sampler_mod.sample(
+            last_logits,
+            seen_rows,
+            jax.tree.map(self._put, prep.tensors),
+            allowed_mask=allowed_mask,
+        )
+        self.seen = sampler_mod.update_seen(
+            self.seen, jnp.asarray([prep.row_slot]), out.tokens
+        )
+        host = _HostSamplerOutput.from_device(
+            jax.tree.map(lambda x: x[None], out)
+        )
+        return host.token(0, 0), prompt_info
+
+    def run_prefill(
+        self, plan: "PrefillPlan"
+    ) -> tuple[Optional[SampledToken], Optional[PromptLogprobInfo]]:
+        return self.execute_prefill(self.prepare_prefill(plan))
 
     # ---------------------------------------------------------------- decode
 
-    def run_decode(self, plan: "DecodePlan") -> list[list[SampledToken]]:
-        """One fused K-step dispatch; returns per-seq token lists.
-
-        Row i's list has ``plan.steps_per_seq[i]`` entries; the host-side
-        engine stops consuming a row's list at EOS/stop-string.
-        """
+    def prepare_decode(self, plan: "DecodePlan") -> "PreparedDecode":
+        """Host half of a fused K-step decode dispatch (see
+        prepare_prefill for the locking rationale)."""
         seqs = plan.seqs
         b = plan.batch_bucket
 
@@ -358,79 +505,65 @@ class ModelRunner:
         allowed_mask = None
         if any(seq.fsm is not None for seq in seqs):
             vocab = self.config.model_config.vocab_size
-            mask = np.ones((b, vocab), bool)
+            allowed_mask = np.ones((b, vocab), bool)
             for i, seq in enumerate(seqs):
                 if seq.fsm is not None:
                     row = seq.fsm.allowed_row(seq.fsm_state)
                     # model vocab may exceed the tokenizer's (padded
                     # embeddings): ids the tokenizer can't spell stay banned
-                    mask[i, : len(row)] = row
-                    mask[i, len(row):] = False
-            allowed_mask = self._put(mask)
+                    allowed_mask[i, : len(row)] = row
+                    allowed_mask[i, len(row):] = False
 
-        lora, lora_idx = None, None
+        lora_idx = None
         if self.lora_stacks is not None:
-            lora = self.lora_stacks
-            idx = np.zeros(b, np.int32)
+            lora_idx = np.zeros(b, np.int32)
             for i, seq in enumerate(seqs):
-                idx[i] = seq.lora_slot
-            lora_idx = self._put(idx)
+                lora_idx[i] = seq.lora_slot
 
+        return PreparedDecode(
+            num_seqs=len(seqs),
+            num_steps=plan.num_steps,
+            steps_per_seq=list(plan.steps_per_seq),
+            token_ids=token_ids,
+            positions=positions,
+            limits=limits,
+            context_lens=context_lens,
+            block_tables=block_tables,
+            slots=slots,
+            tensors=tensors,
+            allowed_mask=allowed_mask,
+            lora_idx=lora_idx,
+        )
+
+    def execute_decode(self, prep: "PreparedDecode") -> list[list[SampledToken]]:
+        """Device half; returns per-seq token lists (row i gets
+        ``steps_per_seq[i]`` entries; the engine stops consuming a row's
+        list at EOS/stop-string)."""
+        lora = self.lora_stacks if prep.lora_idx is not None else None
         self.caches, self.seen, outs = self._decode_fn(
             self.params,
             self.caches,
             self.seen,
-            self._put(token_ids),
-            self._put(positions),
-            self._put(limits),
-            self._put(block_tables),
-            self._put(context_lens),
-            self._put(slots),
-            jax.tree.map(self._put, tensors),
-            allowed_mask,
+            self._put(prep.token_ids),
+            self._put(prep.positions),
+            self._put(prep.limits),
+            self._put(prep.block_tables),
+            self._put(prep.context_lens),
+            self._put(prep.slots),
+            jax.tree.map(self._put, prep.tensors),
+            self._put(prep.allowed_mask)
+            if prep.allowed_mask is not None
+            else None,
             lora,
-            lora_idx,
-            plan.num_steps,
+            self._put(prep.lora_idx) if prep.lora_idx is not None else None,
+            prep.num_steps,
         )
 
         host = _HostSamplerOutput.from_device(outs)  # [K, B] arrays
         return [
-            [host.token(k, i) for k in range(plan.steps_per_seq[i])]
-            for i in range(len(seqs))
+            [host.token(k, i) for k in range(prep.steps_per_seq[i])]
+            for i in range(prep.num_seqs)
         ]
 
-    # --------------------------------------------------------------- sampler
-
-    def _sample(
-        self, logits: jax.Array, seqs, allowed_mask=None
-    ) -> list[SampledToken]:
-        """Sample one token per row; rows beyond ``len(seqs)`` are padding."""
-        b = logits.shape[0]
-        params_list = [s.params for s in seqs] + [None] * (b - len(seqs))
-        gen_lens = [s.num_output_tokens for s in seqs] + [0] * (b - len(seqs))
-        seeds = np.zeros(b, np.uint32)
-        slots = np.full(b, -1, np.int32)
-        for i, s in enumerate(seqs):
-            seeds[i] = s.fallback_seed
-            slots[i] = s.slot
-
-        tensors = SamplingTensors.from_params(
-            params_list,
-            eos_token_id=self.config.model_config.eos_token_id,
-            gen_lens=gen_lens,
-            fallback_seeds=seeds,
-        )
-        seen_rows = jnp.take(
-            self.seen, jnp.clip(jnp.asarray(slots), 0, None), axis=0
-        )
-        out = sampler_mod.sample(
-            logits, seen_rows, tensors, allowed_mask=allowed_mask
-        )
-        self.seen = sampler_mod.update_seen(
-            self.seen, jnp.asarray(slots), out.tokens
-        )
-
-        host = _HostSamplerOutput.from_device(
-            jax.tree.map(lambda x: x[None], out)  # add a unit step axis
-        )
-        return [host.token(0, i) for i in range(len(seqs))]
+    def run_decode(self, plan: "DecodePlan") -> list[list[SampledToken]]:
+        return self.execute_decode(self.prepare_decode(plan))

@@ -32,9 +32,14 @@ logger = init_logger(__name__)
 @dataclasses.dataclass
 class PrefillPlan:
     seq: Sequence
-    bucket_len: int  # padded prompt length (compile bucket)
-    token_ids: list[int]  # tokens to run (prompt, or prompt+output on resume)
-    slots: list[int]  # flat KV slot per token
+    bucket_len: int  # padded chunk length (compile bucket)
+    token_ids: list[int]  # tokens of THIS chunk (whole prompt if unchunked)
+    slots: list[int]  # flat KV slot per chunk token
+    # chunked prefill (token-budgeted admission): tokens already in the KV
+    # cache before this chunk, and whether this chunk completes the prompt
+    # (only final chunks sample a token and move the sequence to decode)
+    start_pos: int = 0
+    is_final: bool = True
 
 
 @dataclasses.dataclass
@@ -73,6 +78,14 @@ class Scheduler:
             self.batch_buckets.append(b)
             b *= 2
         self.batch_buckets.append(scheduler_config.max_num_seqs)
+        # prefill token budget per device step: prompts longer than this
+        # are admitted in chunks, with decode steps interleaved between
+        # chunks so long prompts cannot starve running sequences
+        self.chunk_budget = min(
+            scheduler_config.max_num_batched_tokens,
+            max(scheduler_config.prefill_buckets),
+        )
+        self._last_was_prefill = False
 
     # ------------------------------------------------------------ bookkeeping
 
@@ -89,6 +102,8 @@ class Scheduler:
             if seq.request_id == request_id:
                 del self.waiting[i]
                 seq.status = SequenceStatus.FINISHED_ABORTED
+                # mid-chunked-prefill sequences wait with pages+slot held
+                self.finish(seq)
                 return seq
         for seq in self.running:
             if seq.request_id == request_id:
@@ -107,6 +122,7 @@ class Scheduler:
         if seq.blocks is not None:
             seq.blocks.release()
             seq.blocks = None
+        seq.prefill_pos = 0  # preemption-resume re-runs the whole prefill
 
     # -------------------------------------------------------------- planning
 
@@ -117,18 +133,47 @@ class Scheduler:
         return None
 
     def schedule(self) -> Optional[PrefillPlan | DecodePlan]:
-        """Pick the next device step: prefill-priority, else batched decode."""
+        """Pick the next device step.
+
+        Prefill normally has priority (a waiting prompt becomes a running
+        row as fast as possible), but right after a prefill chunk a decode
+        step runs first if any rows are runnable — chunked admission of a
+        long prompt interleaves with decode instead of starving it.
+        """
+        if self._last_was_prefill and self.running:
+            self._last_was_prefill = False
+            plan = self._schedule_decode()
+            if plan is not None:
+                return plan
         plan = self._try_schedule_prefill()
         if plan is not None:
+            self._last_was_prefill = True
             return plan
+        self._last_was_prefill = False
         return self._schedule_decode()
 
+    def _chunkable(self, seq: Sequence) -> bool:
+        # prompt-logprob requests need one pass over the whole prompt (the
+        # per-position logprob table is built from a single bucket of
+        # logits) — they are admitted unchunked
+        return seq.params.prompt_logprobs is None
+
     def _try_schedule_prefill(self) -> Optional[PrefillPlan]:
-        if not self.waiting or not self._free_slots:
+        if not self.waiting:
             return None
         seq = self.waiting[0]
+        first_chunk = seq.prefill_pos == 0
+        if first_chunk and not self._free_slots:
+            return None
         token_ids = seq.all_token_ids  # includes output on preemption-resume
-        bucket = self._prefill_bucket(len(token_ids))
+        total = len(token_ids)
+        remaining = total - seq.prefill_pos
+        chunk = (
+            min(remaining, self.chunk_budget)
+            if self._chunkable(seq)
+            else remaining
+        )
+        bucket = self._prefill_bucket(chunk)
         if bucket is None:
             # cannot happen if server-side validation enforced max_model_len
             self.waiting.popleft()
@@ -137,32 +182,43 @@ class Scheduler:
             logger.warning("request %s exceeds the largest prefill bucket",
                            seq.request_id)
             return None
-        needed = self.allocator.blocks_needed(len(token_ids))
-        if not self.allocator.can_allocate(needed):
-            # never preempt running work to admit new work — wait for pages
-            # to free up as running sequences finish
-            if not self.running:
-                self.waiting.popleft()
-                seq.status = SequenceStatus.FINISHED_LENGTH
-                self.newly_finished.append(seq)
-                logger.warning(
-                    "request %s needs %d KV pages but the pool only has %d",
-                    seq.request_id, needed, self.allocator.num_blocks,
-                )
+        end = seq.prefill_pos + chunk
+        if first_chunk:
+            needed = self.allocator.blocks_needed(total)
+            if not self.allocator.can_allocate(needed):
+                # never preempt running work to admit new work — wait for
+                # pages to free up as running sequences finish
+                if not self.running:
+                    self.waiting.popleft()
+                    seq.status = SequenceStatus.FINISHED_LENGTH
+                    self.newly_finished.append(seq)
+                    logger.warning(
+                        "request %s needs %d KV pages but the pool only "
+                        "has %d",
+                        seq.request_id, needed, self.allocator.num_blocks,
+                    )
+                    return None
                 return None
-            return None
-        self.waiting.popleft()
-        seq.blocks = SequenceBlocks(self.allocator)
-        seq.blocks.ensure_capacity(len(token_ids))
-        seq.slot = self._free_slots.pop()
-        seq.status = SequenceStatus.RUNNING
-        self.running.append(seq)
-        return PrefillPlan(
+            seq.blocks = SequenceBlocks(self.allocator)
+            seq.blocks.ensure_capacity(total)
+            seq.slot = self._free_slots.pop()
+
+        plan = PrefillPlan(
             seq=seq,
             bucket_len=bucket,
-            token_ids=token_ids,
-            slots=seq.blocks.slots_for_range(0, len(token_ids)),
+            token_ids=token_ids[seq.prefill_pos:end],
+            slots=seq.blocks.slots_for_range(seq.prefill_pos, end),
+            start_pos=seq.prefill_pos,
+            is_final=end == total,
         )
+        seq.prefill_pos = end
+        if plan.is_final:
+            self.waiting.popleft()
+            seq.status = SequenceStatus.RUNNING
+            self.running.append(seq)
+        # non-final: the sequence stays at the queue head (FCFS) with its
+        # pages and slot held; the next prefill step continues it
+        return plan
 
     def _allowed_steps(self, seq: Sequence) -> int:
         """Device steps row ``seq`` may run this dispatch (≥1)."""
@@ -229,13 +285,22 @@ class Scheduler:
     # ------------------------------------------------------------ preemption
 
     def _preempt_youngest(self, exclude: Optional[Sequence] = None) -> bool:
-        candidates = [s for s in self.running if s is not exclude]
+        # mid-chunked-prefill sequences sit in `waiting` but hold their
+        # full page allocation — they must be reclaimable too, or decode
+        # page pressure escalates to engine death instead of preemption
+        candidates = [s for s in self.running if s is not exclude] + [
+            s for s in self.waiting
+            if s.blocks is not None and s is not exclude
+        ]
         if not candidates:
             return False
         victim = max(candidates, key=lambda s: s.metrics.arrival_time)
         logger.info("preempting request %s (KV pool exhausted)",
                     victim.request_id)
-        self.finish(victim)
+        was_running = victim in self.running
+        self.finish(victim)  # releases pages+slot, resets prefill_pos
         victim.status = SequenceStatus.PREEMPTED
-        self.waiting.appendleft(victim)
+        if was_running:
+            self.waiting.appendleft(victim)
+        # mid-prefill victims are already queued; they re-run from chunk 0
         return True

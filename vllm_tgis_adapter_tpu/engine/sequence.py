@@ -75,6 +75,11 @@ class Sequence:
 
         self.blocks: Optional["SequenceBlocks"] = None
         self.slot: int = -1  # fixed batch row while RUNNING
+        # chunked prefill: prompt tokens already written to KV cache; the
+        # sequence enters decode only once this reaches the full prompt
+        self.prefill_pos: int = 0
+        # stop-string scan frontier: chars of output_text already cleared
+        self.stop_scan_pos: int = 0
         # FSM-constrained decoding (engine/constrained.py): compiled token
         # FSM + current state; None when the request is unconstrained
         self.fsm = None

@@ -257,6 +257,81 @@ class LlamaForCausalLM:
             x = x[logits_indices]
         return self._logits(params, x), (k_cache, v_cache)
 
+    def prefill_chunk(
+        self,
+        params: dict,
+        caches: tuple[jax.Array, jax.Array],
+        token_ids: jax.Array,  # [T] one chunk, padded to a bucket
+        positions: jax.Array,  # [T] GLOBAL positions (start_pos + i)
+        slot_mapping: jax.Array,  # [T] cache slot per chunk token; -1 pads
+        valid_len: jax.Array,  # scalar: real tokens in this chunk
+        block_table: jax.Array,  # [max_blocks] this sequence's page table
+        logits_indices: jax.Array | None = None,
+        lora=None,
+        lora_slot: jax.Array | None = None,
+        *,
+        block_size: int,
+    ) -> tuple[jax.Array, tuple[jax.Array, jax.Array]]:
+        """A non-first prefill chunk: queries attend to the chunk AND all
+        earlier context already resident in the paged cache.
+
+        The chunk's K/V are scattered into the cache first, then attention
+        runs through the paged decode kernel with the chunk's T queries as
+        batch rows and per-row context lengths ``position + 1`` — exact
+        causal semantics over [0, start+T) with no new kernel and no
+        Mosaic-illegal shapes.  Bandwidth note: pages are re-read per query
+        row (T× the traffic of the fused flash path), which is why the
+        scheduler only produces chunks bounded by max_num_batched_tokens.
+        """
+        cfg = self.config
+        k_cache, v_cache = caches
+        scale = self._attention_scale()
+        cos, sin = rotary_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
+        safe_slots = jnp.where(slot_mapping < 0, k_cache.shape[2], slot_mapping)
+
+        t = token_ids.shape[0]
+        local = jnp.arange(t, dtype=jnp.int32)
+        # each real query sees everything up to and including itself;
+        # padding rows read one slot of page 0 and are discarded
+        ctx_lens = jnp.where(local < valid_len, positions + 1, 1)
+        tables = jnp.broadcast_to(block_table[None, :], (t, block_table.shape[0]))
+
+        x = self._embed(params, token_ids)
+        for i, layer in enumerate(params["layers"]):
+            dl = None
+            if lora is not None:
+                dl = (
+                    lambda target, xx, i=i: _lora_delta_single(
+                        lora, i, lora_slot, target, xx
+                    )
+                )
+            h = rms_norm(x, layer["input_norm"], cfg.rms_norm_eps)
+            q, k, v = self._qkv(layer, h, dl)
+            q = apply_rotary(q, cos, sin)
+            k = apply_rotary(k, cos, sin)
+            k_cache = k_cache.at[i, :, safe_slots].set(
+                k.astype(k_cache.dtype), mode="drop"
+            )
+            v_cache = v_cache.at[i, :, safe_slots].set(
+                v.astype(v_cache.dtype), mode="drop"
+            )
+            o = attn_ops.paged_decode_attention(
+                q, k_cache[i], v_cache[i], tables, ctx_lens,
+                block_size, scale, mesh=self.mesh,
+            )
+            o_flat = o.reshape(x.shape[0], -1)
+            o = o_flat @ layer["wo"]
+            if dl is not None:
+                o = o + dl("o_proj", o_flat)
+            x = x + cfg.residual_multiplier * o
+
+            h = rms_norm(x, layer["post_attn_norm"], cfg.rms_norm_eps)
+            x = x + cfg.residual_multiplier * self._mlp(layer, h, dl)
+
+        if logits_indices is not None:
+            x = x[logits_indices]
+        return self._logits(params, x), (k_cache, v_cache)
+
     def decode(
         self,
         params: dict,
