@@ -264,3 +264,49 @@ def test_over_rank_adapter_rejected(tmp_path):
     mgr = LoRAManager(max_loras=2, max_lora_rank=64)
     with pytest.raises(LoRAError, match="exceeds --max-lora-rank"):
         asyncio.run(mgr.load_lora_adapter("big", str(d)))
+
+
+def test_per_lora_tokenizer(tiny_model_dir, lora_dir, tmp_path):
+    """get_tokenizer(lora_request) returns the adapter's own tokenizer
+    when its directory ships tokenizer files, else the base tokenizer
+    (reference grpc_server.py:648-652 semantics)."""
+    import shutil
+
+    from vllm_tgis_adapter_tpu.engine.config import (
+        CacheConfig,
+        EngineConfig,
+        LoRAConfig,
+        ModelConfig,
+        ParallelConfig,
+        SchedulerConfig,
+    )
+    from vllm_tgis_adapter_tpu.engine.core import LLMEngine
+    from vllm_tgis_adapter_tpu.engine.lora import LoRARequest
+
+    mcfg = ModelConfig.from_pretrained(tiny_model_dir, dtype="float32")
+    eng = LLMEngine.from_config(EngineConfig(
+        model_config=mcfg,
+        cache_config=CacheConfig(block_size=16, num_blocks=16,
+                                 cache_dtype=mcfg.dtype),
+        scheduler_config=SchedulerConfig(max_num_seqs=2,
+                                         prefill_buckets=(32,)),
+        parallel_config=ParallelConfig(),
+        lora_config=LoRAConfig(enabled=True),
+    ))
+
+    # adapter without tokenizer files -> base tokenizer
+    no_tok = LoRARequest(lora_name="plain", lora_int_id=1,
+                         lora_path=lora_dir)
+    assert eng.get_tokenizer(no_tok) is eng.get_tokenizer()
+
+    # adapter that ships its own tokenizer -> loaded from the adapter dir
+    with_tok = tmp_path / "with-tok"
+    shutil.copytree(lora_dir, with_tok)
+    for f in ("tokenizer.json", "tokenizer_config.json"):
+        src = f"{tiny_model_dir}/{f}"
+        shutil.copy(src, with_tok / f)
+    req = LoRARequest(lora_name="tok", lora_int_id=2,
+                      lora_path=str(with_tok))
+    tok = eng.get_tokenizer(req)
+    assert tok is not eng.get_tokenizer()
+    assert eng.get_tokenizer(req) is tok  # cached

@@ -383,14 +383,26 @@ def test_abort_lands_mid_dispatch():
     async def scenario(eng_factory):
         engine = AsyncLLMEngine(eng_factory)
         dispatch_started = threading.Event()
+        abort_done = threading.Event()
         inner_execute = engine.engine.execute_step
 
         def slow_execute(plan, prepared):
             dispatch_started.set()
-            _time.sleep(0.15)  # hold the device busy
-            return inner_execute(plan, prepared)
+            # the dispatch does not return until the abort has landed: if
+            # abort were serialized behind the whole-step lock (the old
+            # behavior) this would deadlock until the timeout — making the
+            # property structural, not a wall-clock race
+            aborted_in_flight = abort_done.wait(timeout=5)
+            result = inner_execute(plan, prepared)
+            return result, aborted_in_flight
 
-        engine.engine.execute_step = slow_execute
+        def unwrap(plan, prepared):  # restore shape for commit
+            result, flag = slow_execute(plan, prepared)
+            flags.append(flag)
+            return result
+
+        flags: list[bool] = []
+        engine.engine.execute_step = unwrap
 
         stream = engine.generate(
             prompt=None,
@@ -408,17 +420,14 @@ def test_abort_lands_mid_dispatch():
                 outs.append(out)
 
         task = asyncio.create_task(consume())
-        # wait until a dispatch is actually on the device, then abort:
-        # with the old whole-step lock this abort() would block until the
-        # dispatch finished; now it must complete while the device is busy
+        # wait until a dispatch is actually on the device, then abort
         while not dispatch_started.is_set():
             await asyncio.sleep(0.01)
-        t0 = _time.monotonic()
         await engine.abort("victim")
-        abort_latency = _time.monotonic() - t0
+        abort_done.set()
         await asyncio.wait_for(task, timeout=10)
         await engine.stop()
-        return abort_latency, outs
+        return all(flags[:1]), outs
 
     import tests.conftest  # noqa: F401 — platform already forced
 
@@ -450,10 +459,10 @@ def test_abort_lands_mid_dispatch():
             lora_config=LoRAConfig(),
         )
         core = LLMEngine.from_config(config)
-        abort_latency, outs = asyncio.run(scenario(core))
+        aborted_in_flight, outs = asyncio.run(scenario(core))
 
-    # the abort returned while the 0.15 s dispatch was still sleeping
-    assert abort_latency < 0.1
+    # the abort completed while the first dispatch was still in flight
+    assert aborted_in_flight
     # and the stream terminated with an aborted final output
     assert outs and outs[-1].finished
     assert outs[-1].outputs[0].finish_reason == "abort"

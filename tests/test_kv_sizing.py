@@ -168,3 +168,133 @@ def test_from_args_requests_auto_sizing(tiny_model_dir):
     args = parser.parse_args(["--model", tiny_model_dir])
     cfg = EngineConfig.from_args(args)
     assert cfg.cache_config.num_blocks == 0  # auto → resolved at boot
+
+
+# ---------------------------------------------------------- prefix caching
+
+
+def _alloc(num_blocks=16, block_size=4):
+    from vllm_tgis_adapter_tpu.engine.kv_cache import BlockAllocator
+
+    return BlockAllocator(num_blocks, block_size, enable_prefix_caching=True)
+
+
+def test_prefix_cache_match_and_register():
+    a = _alloc()
+    ids = list(range(1, 14))  # 13 tokens -> 3 full pages + partial
+    blocks = a.allocate(4)
+    a.register_prefix(ids, blocks)
+    # full prompt re-sent: match caps one token short (needs >=1 to prefill)
+    hit, matched = a.match_prefix(ids)
+    assert matched == 12 and hit == blocks[:3]
+    # shorter shared prefix
+    hit2, matched2 = a.match_prefix(ids[:9])
+    assert matched2 == 8 and hit2 == blocks[:2]
+    # divergent second page
+    other = ids[:4] + [99, 98, 97, 96] + ids[8:]
+    hit3, matched3 = a.match_prefix(other)
+    assert matched3 == 4 and hit3 == blocks[:1]
+    # different lora -> no match
+    assert a.match_prefix(ids, lora_name="adapterX") == ([], 0)
+
+
+def test_prefix_cache_refcount_and_reclaim():
+    a = _alloc(num_blocks=4, block_size=4)
+    ids = list(range(1, 9))  # 2 full pages
+    owner = a.allocate(2)
+    a.register_prefix(ids, owner)
+    hit, matched = a.match_prefix(ids + [42])  # adopts both pages
+    assert matched == 8
+    # owner releases: pages still referenced by the adopter -> not free
+    a.free(owner)
+    assert a.num_free == 2  # only the 2 unallocated pages
+    # adopter releases: registered pages park in the cached pool
+    a.free(hit)
+    assert a.num_free == 4
+    # they are still matchable...
+    hit2, m2 = a.match_prefix(ids + [42])
+    assert m2 == 8
+    a.free(hit2)
+    # ...until allocation pressure reclaims them (LRU) and drops the hash
+    taken = a.allocate(4)
+    assert len(taken) == 4
+    assert a.match_prefix(ids + [42]) == ([], 0)
+
+
+def test_prefix_cache_engine_end_to_end(tiny_model_dir):
+    """Second request with a shared prefix skips prefill for the matched
+    pages (prefill_pos > 0 at admission) and produces identical output."""
+    from vllm_tgis_adapter_tpu.engine.config import (
+        CacheConfig,
+        EngineConfig,
+        LoRAConfig,
+        ModelConfig,
+        ParallelConfig,
+        SchedulerConfig,
+    )
+    from vllm_tgis_adapter_tpu.engine.core import LLMEngine
+    from vllm_tgis_adapter_tpu.engine.sampling_params import SamplingParams
+
+    def build(prefix_caching: bool) -> LLMEngine:
+        mcfg = ModelConfig.from_pretrained(tiny_model_dir, dtype="float32")
+        return LLMEngine.from_config(EngineConfig(
+            model_config=mcfg,
+            cache_config=CacheConfig(block_size=16, num_blocks=64,
+                                     cache_dtype=mcfg.dtype,
+                                     enable_prefix_caching=prefix_caching),
+            scheduler_config=SchedulerConfig(
+                max_num_seqs=4, prefill_buckets=(32, 64, 128)),
+            parallel_config=ParallelConfig(),
+            lora_config=LoRAConfig(),
+        ))
+
+    shared = list(range(3, 60))  # 57 tokens: 3 full pages of 16 + tail
+    sp = dict(temperature=0.0, max_tokens=6, ignore_eos=True)
+
+    def run(eng, rid, ids):
+        eng.add_request(rid, None, SamplingParams(**sp),
+                        prompt_token_ids=ids)
+        for _ in range(60):
+            if not eng.has_unfinished_requests():
+                break
+            for out in eng.step():
+                if out.finished:
+                    return out.outputs[0].token_ids
+        raise AssertionError("did not finish")
+
+    plain = build(False)
+    want_a = run(plain, "a", shared)
+    want_b = run(plain, "b", shared[:40] + [7, 8, 9])
+
+    cached = build(True)
+    got_a = run(cached, "a", shared)
+    assert cached.scheduler.allocator.prefix_hits == 0  # cold
+    got_b2 = run(cached, "a2", shared)  # full prefix reuse
+    assert cached.scheduler.allocator.prefix_hits == 48  # 3 pages
+    got_b = run(cached, "b", shared[:40] + [7, 8, 9])  # 2-page reuse
+    assert cached.scheduler.allocator.prefix_hits == 48 + 32
+
+    assert got_a == want_a == got_b2
+    assert got_b == want_b
+
+    # prompt-logprob requests must NOT adopt cached pages (their table is
+    # built from one whole-prompt pass); the full table still comes back
+    hits_before = cached.scheduler.allocator.prefix_hits
+    cached.add_request(
+        "lp", None,
+        SamplingParams(temperature=0.0, max_tokens=2, ignore_eos=True,
+                       prompt_logprobs=2, logprobs=2),
+        prompt_token_ids=shared,
+    )
+    final = None
+    for _ in range(60):
+        if not cached.has_unfinished_requests():
+            break
+        for out in cached.step():
+            if out.finished:
+                final = out
+    assert final is not None
+    assert cached.scheduler.allocator.prefix_hits == hits_before
+    assert len(final.prompt_logprobs) == len(shared)
+    assert final.prompt_logprobs[0] is None
+    assert all(e is not None for e in final.prompt_logprobs[1:])

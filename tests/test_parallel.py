@@ -197,3 +197,43 @@ def test_runner_with_tp_mesh():
                        bucket_len=8)
     sampled, _ = runner.run_prefill(plan)
     assert 0 <= sampled.token_id < mcfg.vocab_size
+
+
+def test_engine_generation_on_sp_tp_mesh(tiny_model_dir):
+    """End-to-end engine generation over a joint sp=2 x tp=2 mesh must
+    match the single-device engine token-for-token (VERDICT r2 #4:
+    ring attention reachable from config, through the engine's own
+    prefill/decode path, not just the bare op)."""
+    from vllm_tgis_adapter_tpu.engine.core import LLMEngine
+    from vllm_tgis_adapter_tpu.engine.sampling_params import SamplingParams
+
+    def run(parallel_config):
+        mcfg = ModelConfig.from_pretrained(tiny_model_dir, dtype="float32")
+        config = EngineConfig(
+            model_config=mcfg,
+            cache_config=CacheConfig(block_size=16, num_blocks=64,
+                                     cache_dtype=mcfg.dtype),
+            scheduler_config=SchedulerConfig(
+                max_num_seqs=4, prefill_buckets=(32, 64)),
+            parallel_config=parallel_config,
+            lora_config=LoRAConfig(),
+        )
+        eng = LLMEngine.from_config(config)
+        eng.add_request(
+            "r", None,
+            SamplingParams(temperature=0.0, max_tokens=6, ignore_eos=True),
+            prompt_token_ids=list(range(3, 40)),
+        )
+        for _ in range(100):
+            if not eng.has_unfinished_requests():
+                break
+            outs = eng.step()
+            for o in outs:
+                if o.finished:
+                    return o.outputs[0].token_ids
+        raise AssertionError("engine did not finish")
+
+    single = run(ParallelConfig())
+    sp_tp = run(ParallelConfig(tensor_parallel_size=2,
+                               sequence_parallel_size=2))
+    assert sp_tp == single

@@ -86,7 +86,13 @@ class AsyncLLMEngine:
         )
 
     async def get_tokenizer(self, lora_request=None):  # noqa: ANN001
-        return self.engine.get_tokenizer()
+        if lora_request is None:
+            return self.engine.get_tokenizer()
+        # cold path does filesystem probes + a tokenizer load; keep it off
+        # the event loop (the cached path returns without touching disk)
+        return await asyncio.to_thread(
+            self.engine.get_tokenizer, lora_request
+        )
 
     async def get_model_config(self):
         return self.engine.get_model_config()

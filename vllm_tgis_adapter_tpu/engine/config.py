@@ -145,6 +145,9 @@ class CacheConfig:
     # (kv_cache.resolve_num_blocks); a positive value is used as-is
     num_blocks: int = 512
     cache_dtype: Any = jnp.bfloat16
+    # content-addressed reuse of full prompt pages across requests
+    # (engine/kv_cache.py BlockAllocator prefix caching)
+    enable_prefix_caching: bool = False
 
 
 @dataclasses.dataclass(frozen=True)
@@ -175,6 +178,12 @@ class ParallelConfig:
     tensor_parallel_size: int = 1
     pipeline_parallel_size: int = 1
     data_parallel_size: int = 1
+    # ring-attention sequence parallelism for long-context prefill: the
+    # sequence axis of prefill activations/attention is sharded over the
+    # mesh's sp axis (ops/ring_attention.py); the paged KV cache stays
+    # head-sharded on tp and replicated over sp, so decode runs replicated
+    # across sp shards — sp buys prefill memory/compute scale-out
+    sequence_parallel_size: int = 1
 
 
 @dataclasses.dataclass(frozen=True)
@@ -220,6 +229,9 @@ class EngineConfig:
             cache_config=CacheConfig(
                 block_size=args.block_size,
                 num_blocks=0,  # auto-size from HBM at engine boot
+                enable_prefix_caching=getattr(
+                    args, "enable_prefix_caching", False
+                ),
                 cache_dtype=(
                     model_config.dtype
                     if args.kv_cache_dtype == "auto"
@@ -238,6 +250,9 @@ class EngineConfig:
                 tensor_parallel_size=args.tensor_parallel_size or 1,
                 pipeline_parallel_size=args.pipeline_parallel_size,
                 data_parallel_size=args.data_parallel_size,
+                sequence_parallel_size=getattr(
+                    args, "sequence_parallel_size", 1
+                ) or 1,
             ),
             lora_config=LoRAConfig(
                 enabled=args.enable_lora,

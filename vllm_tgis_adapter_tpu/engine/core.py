@@ -67,6 +67,7 @@ class LLMEngine:
             max_model_len=config.max_model_len,
         )
         self._seqs: dict[str, Sequence] = {}
+        self._lora_tokenizers: dict[str, object] = {}
         # adapter registry consumed by the gRPC adapter store
         # (grpc/adapters.py) and by the runner's stacked device tensors
         from vllm_tgis_adapter_tpu.engine.lora import LoRAManager
@@ -108,8 +109,31 @@ class LLMEngine:
         tokenizer = AutoTokenizer.from_pretrained(config.tokenizer or mcfg.model)
         return cls(config, model, params, tokenizer, mesh=mesh)
 
-    def get_tokenizer(self):
-        return self.tokenizer
+    def get_tokenizer(self, lora_request=None):  # noqa: ANN001
+        """Base tokenizer, or the adapter's own if its directory ships
+        tokenizer files (reference behavior: per-LoRA tokenizers,
+        /root/reference/src/vllm_tgis_adapter/grpc/grpc_server.py:648-652).
+        """
+        path = getattr(lora_request, "lora_path", None)
+        if not path:
+            return self.tokenizer
+        cached = self._lora_tokenizers.get(path)
+        if cached is not None:
+            return cached
+        import os
+
+        has_tok = any(
+            os.path.exists(os.path.join(path, f))
+            for f in ("tokenizer.json", "tokenizer_config.json",
+                      "tokenizer.model")
+        )
+        tok = self.tokenizer
+        if has_tok:
+            from transformers import AutoTokenizer
+
+            tok = AutoTokenizer.from_pretrained(path)
+        self._lora_tokenizers[path] = tok
+        return tok
 
     def get_model_config(self):
         return self.config.model_config
@@ -241,6 +265,9 @@ class LLMEngine:
                 return []  # mid-prompt chunk: nothing emitted yet
             if seq.is_finished:
                 return []  # aborted while the dispatch was in flight
+            # the prompt's K/V is now fully resident: publish its full
+            # pages for prefix reuse (no-op unless --enable-prefix-caching)
+            self.scheduler.register_prefix(seq)
             if prompt_info is not None and seq.prompt_logprobs is None:
                 seq.prompt_logprobs = self._build_prompt_logprobs(
                     seq, prompt_info

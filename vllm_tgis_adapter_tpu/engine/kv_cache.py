@@ -126,34 +126,151 @@ def resolve_num_blocks(
 
 
 class BlockAllocator:
-    """Free-list allocator over a fixed pool of KV pages."""
+    """Refcounted allocator over a fixed pool of KV pages, with optional
+    content-addressed prefix caching.
 
-    def __init__(self, num_blocks: int, block_size: int):
+    Prefix caching (the engine's analog of vLLM's automatic prefix
+    caching): a page whose tokens are a full page-aligned slice of a
+    prompt is registered under the rolling hash of the prompt up to and
+    including that page.  A later prompt that shares the prefix adopts
+    those pages read-only (refcount++) and starts prefill AFTER them —
+    the chunked-prefill path (models/llama.py prefill_chunk) already
+    attends through the paged cache from any start position, so reuse
+    needs no new device code.  Freed-but-registered pages park in an LRU
+    side pool and are reclaimed only when the free list runs dry.
+
+    Safety: registered pages are never written again — prefill writes
+    start at the first unmatched token, decode writes start after the
+    prompt — and sharing keys include the LoRA adapter (same tokens under
+    different adapters produce different K/V).
+    """
+
+    def __init__(self, num_blocks: int, block_size: int,
+                 enable_prefix_caching: bool = False):
         self.num_blocks = num_blocks
         self.block_size = block_size
+        self.enable_prefix_caching = enable_prefix_caching
         self._free: list[int] = list(range(num_blocks - 1, -1, -1))
+        self._refcount: dict[int, int] = {}
+        # content-addressing state (empty unless prefix caching is on).
+        # Each table entry keeps (block, parent_hash, page_tokens) so a
+        # hit verifies the actual chain content — Python's hash() is a
+        # fast non-cryptographic mix and prompts are attacker-controlled,
+        # so a bare hash match must never adopt another request's pages.
+        self._hash_to_block: dict[int, tuple[int, int, tuple]] = {}
+        self._block_hash: dict[int, int] = {}
+        self._cached_free: dict[int, None] = {}  # LRU order: oldest first
+        self.prefix_hits = 0  # tokens served from cache (stats/metrics)
 
     @property
     def num_free(self) -> int:
-        return len(self._free)
+        return len(self._free) + len(self._cached_free)
 
     def can_allocate(self, n: int) -> bool:
-        return len(self._free) >= n
+        return self.num_free >= n
 
     def allocate(self, n: int) -> list[int]:
-        if n > len(self._free):
+        if n > self.num_free:
             raise RuntimeError(
-                f"KV cache exhausted: need {n} pages, {len(self._free)} free"
+                f"KV cache exhausted: need {n} pages, {self.num_free} free"
             )
-        taken = self._free[-n:][::-1]
-        del self._free[len(self._free) - n:]
+        taken: list[int] = []
+        while len(taken) < n and self._free:
+            taken.append(self._free.pop())
+        while len(taken) < n:
+            # reclaim the least-recently-parked cached page
+            block = next(iter(self._cached_free))
+            del self._cached_free[block]
+            self._drop_hash(block)
+            taken.append(block)
+        for block in taken:
+            self._refcount[block] = 1
         return taken
 
     def free(self, blocks: list[int]) -> None:
-        self._free.extend(reversed(blocks))
+        for block in reversed(blocks):
+            left = self._refcount.get(block, 1) - 1
+            if left > 0:
+                self._refcount[block] = left
+                continue
+            self._refcount.pop(block, None)
+            if block in self._block_hash:
+                # keep registered content resident until pages are needed
+                self._cached_free.pop(block, None)
+                self._cached_free[block] = None  # move to MRU end
+            else:
+                self._free.append(block)
 
     def blocks_needed(self, num_tokens: int) -> int:
         return -(-num_tokens // self.block_size)
+
+    # ------------------------------------------------------- prefix caching
+
+    @staticmethod
+    def _chain_seed(lora_name: Optional[str]) -> int:
+        return hash(("kv-prefix", lora_name))
+
+    def _drop_hash(self, block: int) -> None:
+        h = self._block_hash.pop(block, None)
+        if h is not None and h in self._hash_to_block and (
+            self._hash_to_block[h][0] == block
+        ):
+            del self._hash_to_block[h]
+
+    def match_prefix(
+        self, token_ids: list[int], lora_name: Optional[str] = None
+    ) -> tuple[list[int], int]:
+        """Adopt the longest chain of cached pages covering the prompt.
+
+        Returns (blocks, matched_tokens).  Matching is capped one token
+        short of the prompt so at least the final position always runs
+        through prefill (its logits seed the first sampled token).
+        Adopted pages are refcounted and must be released via free().
+        Every hit is verified against the stored parent hash AND page
+        tokens — a hash collision degrades to a cache miss, never to
+        adopting foreign KV content.
+        """
+        if not self.enable_prefix_caching:
+            return [], 0
+        max_pages = (len(token_ids) - 1) // self.block_size
+        h = self._chain_seed(lora_name)
+        blocks: list[int] = []
+        for p in range(max_pages):
+            page = tuple(
+                token_ids[p * self.block_size: (p + 1) * self.block_size]
+            )
+            nh = hash((h, page))
+            entry = self._hash_to_block.get(nh)
+            if entry is None or entry[1] != h or entry[2] != page:
+                break
+            block = entry[0]
+            self._refcount[block] = self._refcount.get(block, 0) + 1
+            self._cached_free.pop(block, None)  # now live again
+            blocks.append(block)
+            h = nh
+        return blocks, len(blocks) * self.block_size
+
+    def register_prefix(
+        self,
+        token_ids: list[int],
+        blocks: list[int],
+        lora_name: Optional[str] = None,
+    ) -> None:
+        """Publish a prompt's full pages for reuse (first writer wins)."""
+        if not self.enable_prefix_caching:
+            return
+        h = self._chain_seed(lora_name)
+        for p in range(len(token_ids) // self.block_size):
+            page = tuple(
+                token_ids[p * self.block_size: (p + 1) * self.block_size]
+            )
+            nh = hash((h, page))
+            if nh not in self._hash_to_block:
+                block = blocks[p]
+                if block not in self._block_hash:
+                    self._hash_to_block[nh] = (block, h, page)
+                    self._block_hash[block] = nh
+            h = nh
 
 
 class SequenceBlocks:
@@ -163,6 +280,10 @@ class SequenceBlocks:
         self._allocator = allocator
         self.blocks: list[int] = []
         self.num_tokens = 0
+
+    def adopt(self, blocks: list[int]) -> None:
+        """Prepend already-refcounted pages (prefix-cache hits)."""
+        self.blocks.extend(blocks)
 
     def ensure_capacity(self, num_tokens: int) -> None:
         """Grow the page list to hold ``num_tokens`` total tokens."""

@@ -152,6 +152,21 @@ class ModelRunner:
             )
 
             validate_tp_divisibility(mcfg, mesh.shape["tp"])
+            sp = dict(mesh.shape).get("sp", 1)
+            if sp > 1:
+                # fail at boot, not inside the first jitted prefill: the
+                # ring requires every padded sequence length to split
+                # evenly across the sp axis
+                bad = [
+                    b for b in config.scheduler_config.prefill_buckets
+                    if b % sp
+                ]
+                if bad:
+                    raise ValueError(
+                        f"sequence_parallel_size={sp} does not divide "
+                        f"prefill bucket(s) {bad}; adjust "
+                        "--sequence-parallel-size or the bucket list"
+                    )
             params = shard_llama_params(mesh, params)
             # allocate the cache sharded from the start: the pool is sized
             # against the mesh's AGGREGATE HBM, so materialising it on one

@@ -64,7 +64,13 @@ class Scheduler:
         self.config = scheduler_config
         self.block_size = cache_config.block_size
         self.max_model_len = max_model_len
-        self.allocator = BlockAllocator(num_blocks, cache_config.block_size)
+        self.allocator = BlockAllocator(
+            num_blocks,
+            cache_config.block_size,
+            enable_prefix_caching=getattr(
+                cache_config, "enable_prefix_caching", False
+            ),
+        )
         self.waiting: deque[Sequence] = deque()
         self.running: list[Sequence] = []
         # sequences the scheduler itself finished (rejected prompts); the
@@ -111,6 +117,18 @@ class Scheduler:
                 self.finish(seq)
                 return seq
         return None
+
+    def register_prefix(self, seq: Sequence) -> None:
+        """Publish a completed prefill's full prompt pages for reuse.
+
+        Called by the engine core AFTER the prefill dispatch executed —
+        registering at plan time would let another request adopt pages
+        whose K/V had not been written yet had the owner been aborted.
+        """
+        if seq.blocks is not None:
+            self.allocator.register_prefix(
+                seq.prompt_token_ids, seq.blocks.blocks, seq.lora_name
+            )
 
     def finish(self, seq: Sequence) -> None:
         """Release a sequence's device resources (idempotent)."""
@@ -167,6 +185,21 @@ class Scheduler:
             return None
         token_ids = seq.all_token_ids  # includes output on preemption-resume
         total = len(token_ids)
+        if first_chunk:
+            # adopt cached prefix pages BEFORE sizing the chunk: matched
+            # tokens skip prefill entirely (the first chunk then starts at
+            # start_pos = matched and attends to the shared pages through
+            # the paged cache, exactly like a later chunk).  prompt-logprob
+            # requests never adopt: their per-position table is built from
+            # one pass over the WHOLE prompt (same reason they don't chunk)
+            seq.blocks = SequenceBlocks(self.allocator)
+            if self._chunkable(seq):
+                hit_blocks, matched = self.allocator.match_prefix(
+                    token_ids, seq.lora_name
+                )
+                if matched:
+                    seq.blocks.adopt(hit_blocks)
+                    seq.prefill_pos = matched
         remaining = total - seq.prefill_pos
         chunk = (
             min(remaining, self.chunk_budget)
@@ -174,8 +207,16 @@ class Scheduler:
             else remaining
         )
         bucket = self._prefill_bucket(chunk)
+
+        def roll_back_admission() -> None:
+            if seq.blocks is not None:
+                seq.blocks.release()
+                seq.blocks = None
+            seq.prefill_pos = 0
+
         if bucket is None:
             # cannot happen if server-side validation enforced max_model_len
+            roll_back_admission()
             self.waiting.popleft()
             seq.status = SequenceStatus.FINISHED_LENGTH
             self.newly_finished.append(seq)
@@ -184,11 +225,15 @@ class Scheduler:
             return None
         end = seq.prefill_pos + chunk
         if first_chunk:
-            needed = self.allocator.blocks_needed(total)
+            needed = (
+                self.allocator.blocks_needed(total)
+                - len(seq.blocks.blocks)
+            )
             if not self.allocator.can_allocate(needed):
                 # never preempt running work to admit new work — wait for
                 # pages to free up as running sequences finish
                 if not self.running:
+                    roll_back_admission()
                     self.waiting.popleft()
                     seq.status = SequenceStatus.FINISHED_LENGTH
                     self.newly_finished.append(seq)
@@ -198,10 +243,13 @@ class Scheduler:
                         seq.request_id, needed, self.allocator.num_blocks,
                     )
                     return None
+                roll_back_admission()
                 return None
-            seq.blocks = SequenceBlocks(self.allocator)
             seq.blocks.ensure_capacity(total)
             seq.slot = self._free_slots.pop()
+            # count cache hits only once admission actually succeeded
+            # (a rolled-back admission re-matches on its next attempt)
+            self.allocator.prefix_hits += seq.prefill_pos
 
         plan = PrefillPlan(
             seq=seq,

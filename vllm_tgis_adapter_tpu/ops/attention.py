@@ -92,7 +92,21 @@ def prefill_attention(
     Under a TP mesh the kernel runs inside shard_map over the head axis
     (each shard attends with its local query/kv heads; GQA grouping is
     preserved because tp divides both H and Hkv, parallel/sharding.py).
+    Under an sp mesh axis > 1 the sequence axis is sharded instead and
+    K/V chunks rotate around the ring (ops/ring_attention.py) — the
+    long-context path.
     """
+    if mesh is not None and dict(mesh.shape).get("sp", 1) > 1:
+        from vllm_tgis_adapter_tpu.ops.ring_attention import (
+            ring_prefill_attention,
+        )
+
+        vl = (
+            jnp.asarray(q.shape[0], jnp.int32)
+            if valid_len is None
+            else valid_len
+        )
+        return ring_prefill_attention(q, k, v, scale, vl, mesh)
     if _use_pallas():
         from vllm_tgis_adapter_tpu.ops import pallas_attention
 

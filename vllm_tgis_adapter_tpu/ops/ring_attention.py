@@ -24,7 +24,7 @@ import jax.numpy as jnp
 from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
-from vllm_tgis_adapter_tpu.parallel.mesh import SP_AXIS
+from vllm_tgis_adapter_tpu.parallel.mesh import SP_AXIS, TP_AXIS
 
 NEG_INF = float("-inf")
 
@@ -68,23 +68,28 @@ def ring_prefill_attention(
     """Causal attention with the sequence axis sharded over ``axis``.
 
     All inputs/outputs are global-view arrays; shard_map splits them so
-    each device keeps only its T/n chunk resident.
+    each device keeps only its T/n chunk resident.  On a joint sp×tp mesh
+    the head axis is additionally split over tp, so every device holds a
+    (T/sp, H/tp) tile — ring hops move only local-head K/V chunks.
     """
     n = mesh.shape[axis]
     if n == 1:
         from vllm_tgis_adapter_tpu.ops.attention import prefill_attention_xla
 
         return prefill_attention_xla(q, k, v, scale, valid_len)
-    t, num_heads, head_dim = q.shape
-    num_kv = k.shape[1]
-    g = num_heads // num_kv
+    t, _, head_dim = q.shape
     if t % n:
         raise ValueError(f"sequence {t} not divisible by ring size {n}")
     c = t // n
+    tp = dict(mesh.shape).get(TP_AXIS, 1)
+    head_axis = TP_AXIS if tp > 1 else None
 
     def local_fn(q_loc, k_loc, v_loc, vl):
-        # q_loc [C, H, Dh]; k_loc/v_loc [C, Hkv, Dh]; vl [1]
+        # q_loc [C, H/tp, Dh]; k_loc/v_loc [C, Hkv/tp, Dh]; vl [1]
         d = jax.lax.axis_index(axis)
+        num_heads = q_loc.shape[1]
+        num_kv = k_loc.shape[1]
+        g = num_heads // num_kv
         qf = q_loc.reshape(c, num_kv, g, head_dim).astype(jnp.float32)
         q_pos = d * c + jnp.arange(c)
 
@@ -113,7 +118,7 @@ def ring_prefill_attention(
         )
         return out.astype(q_loc.dtype)
 
-    seq = P(axis, None, None)
+    seq = P(axis, head_axis, None)
     return shard_map(
         local_fn,
         mesh=mesh,

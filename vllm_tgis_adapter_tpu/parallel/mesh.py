@@ -116,9 +116,13 @@ def mesh_from_parallel_config(pcfg) -> Mesh | None:
             "one engine per replica behind a load balancer (deployment-"
             "level DP, as the reference stack deploys TGIS)"
         )
-    if pcfg.tensor_parallel_size <= 1:
+    sp = getattr(pcfg, "sequence_parallel_size", 1)
+    if pcfg.tensor_parallel_size <= 1 and sp <= 1:
         return None
-    return build_mesh(tensor_parallel_size=pcfg.tensor_parallel_size)
+    return build_mesh(
+        tensor_parallel_size=pcfg.tensor_parallel_size,
+        sequence_parallel_size=sp,
+    )
 
 
 def initialize_multihost(

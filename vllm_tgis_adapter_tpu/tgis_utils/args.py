@@ -193,11 +193,16 @@ def add_engine_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     g.add_argument("--disable-log-stats", action="store_true",
                    help="disable periodic engine stats logging")
     g.add_argument("--enable-prefix-caching", action="store_true",
-                   help="reuse KV pages across requests with a shared prefix")
+                   help="content-addressed reuse of full prompt KV pages "
+                        "across requests sharing a prefix (matched pages "
+                        "skip prefill; engine/kv_cache.py)")
 
     g = parser.add_argument_group("parallelism")
     g.add_argument("--tensor-parallel-size", "-tp", type=int, default=None,
                    help="SPMD tensor-parallel mesh size over ICI")
+    g.add_argument("--sequence-parallel-size", "-sp", type=int, default=1,
+                   help="ring-attention sequence-parallel mesh axis for "
+                        "long-context prefill (total chips = sp * tp)")
     g.add_argument("--pipeline-parallel-size", "-pp", type=int, default=1,
                    help="pipeline stages across the mesh")
     g.add_argument("--data-parallel-size", "-dp", type=int, default=1,
