@@ -349,13 +349,28 @@ def test_guided_decoding_over_grpc(grpc_client, guided):
         assert text in ("alpha", "beta")
 
 
-def test_guided_grammar_rejected(grpc_client):
+def test_guided_grammar_generation(grpc_client):
+    """Grammar-constrained generation over the wire: the reply must be a
+    sentence of the grammar (reference parity: guided_decoding_grammar,
+    /root/reference/tests/test_grpc_server.py:189-196)."""
     from vllm_tgis_adapter_tpu.grpc.pb import generation_pb2 as pb2
 
-    decoding = pb2.DecodingParameters(grammar="root ::= x")
+    grammar = 'root ::= "yes " ("please" | "thanks")'
+    params = pb2.Parameters(
+        stopping=pb2.StoppingCriteria(max_new_tokens=32),
+        decoding=pb2.DecodingParameters(grammar=grammar),
+    )
+    response = grpc_client.make_request("answer: ", params=params)
+    assert response.text in ("yes please", "yes thanks")
+
+
+def test_guided_grammar_malformed_rejected(grpc_client):
+    """A malformed grammar fails request validation, not the stream."""
+    from vllm_tgis_adapter_tpu.grpc.pb import generation_pb2 as pb2
+
     params = pb2.Parameters(
         stopping=pb2.StoppingCriteria(max_new_tokens=4),
-        decoding=decoding,
+        decoding=pb2.DecodingParameters(grammar='root ::= "unterminated'),
     )
     with pytest.raises(grpc.RpcError) as excinfo:
         grpc_client.make_request("test", params=params)

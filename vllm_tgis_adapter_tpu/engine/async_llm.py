@@ -88,8 +88,12 @@ class AsyncLLMEngine:
     async def get_tokenizer(self, lora_request=None):  # noqa: ANN001
         if lora_request is None:
             return self.engine.get_tokenizer()
+        path = getattr(lora_request, "lora_path", None)
+        cached = self.engine._lora_tokenizers.get(path)
+        if cached is not None:
+            return cached
         # cold path does filesystem probes + a tokenizer load; keep it off
-        # the event loop (the cached path returns without touching disk)
+        # the event loop
         return await asyncio.to_thread(
             self.engine.get_tokenizer, lora_request
         )

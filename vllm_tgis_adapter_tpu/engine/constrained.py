@@ -286,7 +286,10 @@ class ByteDFA:
 
     @staticmethod
     def from_regex(pattern: str) -> "ByteDFA":
-        ast = _Parser(pattern).parse()
+        return ByteDFA.from_ast(_Parser(pattern).parse())
+
+    @staticmethod
+    def from_ast(ast) -> "ByteDFA":
         nfa = _NFA()
         start, end = nfa.build(ast)
 
@@ -484,12 +487,343 @@ def constraint_regex(params) -> str:
         return schema_to_regex(params.json)
     if params.json_object:
         return json_object_regex()
-    if params.grammar is not None:
-        raise ValueError(
-            "grammar-constrained decoding is not supported yet; use "
-            "regex, choice, or json_schema"
-        )
     raise ValueError("empty structured-output constraint")
+
+
+# ------------------------------------------------------------------- grammars
+
+
+class GrammarError(ValueError):
+    pass
+
+
+class _GrammarParser:
+    """GBNF / Lark-subset EBNF grammar → regex AST for the NFA/DFA core.
+
+    Accepts both header styles the reference stack's backends take
+    (GBNF ``name ::= …`` with root rule ``root``, Lark ``name: …`` with
+    root rule ``start``; reference mapping
+    /root/reference/src/vllm_tgis_adapter/tgis_utils/structured_outputs.py:32-33,
+    sample grammar /root/reference/tests/test_grpc_server.py:15-27).
+    Body elements: "string" literals with escapes, [char-classes],
+    /regex/ literals, rule references, ( ) groups, ``|`` alternation,
+    ``* + ?`` quantifiers, and Lark ``~ n``/``~ n..m`` repeats.
+
+    Recursive rules are expanded to a bounded depth (recursion beyond
+    ``MAX_DEPTH`` becomes a dead branch), which turns the CFG into the
+    regular approximation the byte-DFA machinery executes — the same
+    depth-bounding stance as ``json_object_regex``.  A node budget guards
+    exponential blowups.
+    """
+
+    MAX_DEPTH = 8
+    MAX_NODES = 250_000
+    _HEADER = None  # compiled lazily (module import cost)
+
+    def __init__(self, text: str):
+        import re as _re
+
+        if _GrammarParser._HEADER is None:
+            _GrammarParser._HEADER = _re.compile(
+                r"^\s*[?!]?([A-Za-z_]\w*)\s*(::=|:)(.*)$"
+            )
+        self.rules: dict[str, str] = {}
+        self.order: list[str] = []
+        self._nodes = 0
+        self._split_rules(text)
+
+    # ------------------------------------------------------------- rule split
+
+    @staticmethod
+    def _strip_comment(line: str) -> str:
+        """Drop ``#`` (GBNF) and ``//`` (Lark) comments.
+
+        Context-aware: ``#`` and ``/`` are literal inside "strings",
+        [char-classes], and /regex/ literals.  A lone ``/`` opens a regex
+        literal; ``//`` outside any literal starts a comment (a regex
+        matching a literal slash is spelled ``/\\//``, never ``//…``).
+        """
+        out = []
+        mode = None  # None | '"' | '[' | '/'
+        i = 0
+        while i < len(line):
+            c = line[i]
+            if mode is not None:
+                if c == "\\" and i + 1 < len(line):
+                    out.append(line[i: i + 2])
+                    i += 2
+                    continue
+                if (mode, c) in (('"', '"'), ("[", "]"), ("/", "/")):
+                    mode = None
+                out.append(c)
+            elif c == '"' or c == "[":
+                mode = c
+                out.append(c)
+            elif c == "#" or line.startswith("//", i):
+                break
+            elif c == "/":
+                mode = "/"
+                out.append(c)
+            else:
+                out.append(c)
+            i += 1
+        return "".join(out)
+
+    def _split_rules(self, text: str) -> None:
+        current: Optional[str] = None
+        for raw in text.splitlines():
+            line = self._strip_comment(raw)
+            if not line.strip():
+                continue
+            m = self._HEADER.match(line)
+            if m:
+                current = m.group(1)
+                if current in self.rules:
+                    raise GrammarError(f"duplicate rule {current!r}")
+                self.rules[current] = m.group(3)
+                self.order.append(current)
+            elif current is not None:
+                self.rules[current] += " " + line.strip()
+            else:
+                raise GrammarError(f"text before first rule: {line.strip()!r}")
+        if not self.rules:
+            raise GrammarError("grammar defines no rules")
+
+    @property
+    def root(self) -> str:
+        for name in ("root", "start"):
+            if name in self.rules:
+                return name
+        return self.order[0]
+
+    # ------------------------------------------------------------- expansion
+
+    def _budget(self, node):
+        self._nodes += 1
+        if self._nodes > self.MAX_NODES:
+            raise GrammarError(
+                "grammar expansion exceeds the node budget; reduce "
+                "recursion depth or rule complexity"
+            )
+        return node
+
+    def ast(self):
+        return self._expand(self.root, ())
+
+    def _expand(self, name: str, stack: tuple):
+        if name not in self.rules:
+            raise GrammarError(f"undefined rule {name!r}")
+        if stack.count(name) >= self.MAX_DEPTH:
+            # bounded recursion: deeper nesting becomes unreachable
+            return self._budget(("lit", frozenset()))
+        body = _RuleBody(self.rules[name], name)
+        return self._build(body.parse(), stack + (name,))
+
+    def _build(self, item, stack: tuple):
+        kind = item[0]
+        if kind == "ref":
+            return self._expand(item[1], stack)
+        if kind in ("lit", "eps"):
+            return self._budget(item)
+        if kind == "ast":  # pre-parsed regex literal subtree
+            return self._budget(item[1])
+        if kind in ("cat", "alt"):
+            return self._budget(
+                (kind, self._build(item[1], stack),
+                 self._build(item[2], stack))
+            )
+        if kind in ("star", "plus", "opt"):
+            return self._budget((kind, self._build(item[1], stack)))
+        if kind == "rep":
+            return self._budget(
+                ("rep", self._build(item[1], stack), item[2], item[3])
+            )
+        raise GrammarError(f"unknown grammar item {kind!r}")
+
+
+class _RuleBody:
+    """Recursive-descent parser for one rule's expansion text.
+
+    Produces the same tuple AST as the regex parser, with ("ref", name)
+    placeholders for rule references (expanded by _GrammarParser)."""
+
+    def __init__(self, src: str, rule: str):
+        self.src = src
+        self.pos = 0
+        self.rule = rule
+
+    def parse(self):
+        node = self._alternation()
+        self._ws()
+        if self.pos != len(self.src):
+            raise GrammarError(
+                f"unexpected {self.src[self.pos]!r} in rule {self.rule!r}"
+            )
+        return node
+
+    def _ws(self) -> None:
+        while self.pos < len(self.src) and self.src[self.pos].isspace():
+            self.pos += 1
+
+    def _peek(self) -> Optional[str]:
+        self._ws()
+        return self.src[self.pos] if self.pos < len(self.src) else None
+
+    def _alternation(self):
+        node = self._sequence()
+        while self._peek() == "|":
+            self.pos += 1
+            node = ("alt", node, self._sequence())
+        return node
+
+    def _sequence(self):
+        parts = []
+        while True:
+            c = self._peek()
+            if c is None or c in "|)":
+                break
+            parts.append(self._quantified())
+        if not parts:
+            return ("eps",)
+        node = parts[0]
+        for p in parts[1:]:
+            node = ("cat", node, p)
+        return node
+
+    def _quantified(self):
+        node = self._atom()
+        while True:
+            c = self._peek()
+            if c == "*":
+                self.pos += 1
+                node = ("star", node)
+            elif c == "+":
+                self.pos += 1
+                node = ("plus", node)
+            elif c == "?":
+                self.pos += 1
+                node = ("opt", node)
+            elif c == "~":  # lark repeat: ~ n or ~ n..m
+                self.pos += 1
+                lo = self._int()
+                hi = lo
+                self._ws()
+                if self.src.startswith("..", self.pos):
+                    self.pos += 2
+                    hi = self._int()
+                node = ("rep", node, lo, hi)
+            else:
+                return node
+
+    def _int(self) -> int:
+        self._ws()
+        start = self.pos
+        while self.pos < len(self.src) and self.src[self.pos].isdigit():
+            self.pos += 1
+        if start == self.pos:
+            raise GrammarError(f"expected integer in rule {self.rule!r}")
+        return int(self.src[start: self.pos])
+
+    def _atom(self):
+        c = self._peek()
+        if c == "(":
+            self.pos += 1
+            node = self._alternation()
+            if self._peek() != ")":
+                raise GrammarError(f"unbalanced '(' in rule {self.rule!r}")
+            self.pos += 1
+            return node
+        if c == '"':
+            return self._string()
+        if c == "[":
+            return self._char_class()
+        if c == "/":
+            return self._regex_literal()
+        if c is not None and (c.isalpha() or c == "_"):
+            start = self.pos
+            while self.pos < len(self.src) and (
+                self.src[self.pos].isalnum() or self.src[self.pos] == "_"
+            ):
+                self.pos += 1
+            return ("ref", self.src[start: self.pos])
+        raise GrammarError(f"unexpected {c!r} in rule {self.rule!r}")
+
+    def _string(self):
+        assert self.src[self.pos] == '"'
+        self.pos += 1
+        out = bytearray()
+        while True:
+            if self.pos >= len(self.src):
+                raise GrammarError(
+                    f"unterminated string in rule {self.rule!r}"
+                )
+            c = self.src[self.pos]
+            self.pos += 1
+            if c == '"':
+                break
+            if c == "\\":
+                if self.pos >= len(self.src):
+                    raise GrammarError(
+                        f"dangling escape in rule {self.rule!r}"
+                    )
+                e = self.src[self.pos]
+                self.pos += 1
+                table = {"n": "\n", "t": "\t", "r": "\r"}
+                if e == "x":
+                    hexpair = self.src[self.pos: self.pos + 2]
+                    if len(hexpair) < 2:
+                        raise GrammarError(
+                            f"truncated \\x escape in rule {self.rule!r}"
+                        )
+                    out.append(int(hexpair, 16))
+                    self.pos += 2
+                    continue
+                c = table.get(e, e)
+            out.extend(c.encode("utf-8"))
+        if not out:
+            return ("eps",)
+        node = ("lit", frozenset({out[0]}))
+        for b in out[1:]:
+            node = ("cat", node, ("lit", frozenset({b})))
+        return node
+
+    def _find_unescaped(self, delim: str, what: str) -> int:
+        """Index of the first ``delim`` not escaped by an ODD run of
+        backslashes (``\\\\]`` is a literal backslash then a real ``]``)."""
+        end = self.pos
+        while True:
+            end = self.src.find(delim, end + 1)
+            if end == -1:
+                raise GrammarError(
+                    f"unterminated {what} in rule {self.rule!r}"
+                )
+            backslashes = 0
+            j = end - 1
+            while j >= 0 and self.src[j] == "\\":
+                backslashes += 1
+                j -= 1
+            if backslashes % 2 == 0:
+                return end
+
+    def _char_class(self):
+        # delegate to the regex parser's class syntax (same semantics)
+        end = self._find_unescaped("]", "char class")
+        sub = _Parser(self.src[self.pos: end + 1])
+        node = sub._char_class()
+        self.pos = end + 1
+        return node
+
+    def _regex_literal(self):
+        assert self.src[self.pos] == "/"
+        end = self._find_unescaped("/", "/regex/")
+        body = self.src[self.pos + 1: end].replace("\\/", "/")
+        self.pos = end + 1
+        return ("ast", _Parser(body).parse())
+
+
+def grammar_to_ast(text: str):
+    """EBNF grammar text → regex AST (bounded-recursion approximation)."""
+    return _GrammarParser(text).ast()
 
 
 # --------------------------------------------------------------- token tables
@@ -652,9 +986,14 @@ def token_byte_strings(tokenizer) -> list[bytes]:
 
 def compile_fsm(params, tokenizer, eos_id: int) -> TokenFSM:
     """StructuredOutputsParams + tokenizer → cached TokenFSM."""
-    pattern = constraint_regex(params)
+    pattern = None
+    if params.grammar is not None:
+        source = "grammar\x00" + params.grammar
+    else:
+        pattern = constraint_regex(params)
+        source = "regex\x00" + pattern
     key = (
-        hashlib.sha256(pattern.encode()).hexdigest(),
+        hashlib.sha256(source.encode()).hexdigest(),
         id(tokenizer),
         eos_id,
     )
@@ -665,14 +1004,17 @@ def compile_fsm(params, tokenizer, eos_id: int) -> TokenFSM:
         if matrix is None:
             matrix = _pad_token_bytes(token_byte_strings(tokenizer))
             _TOKEN_MATRIX_CACHE[tok_key] = matrix
-        dfa = ByteDFA.from_regex(pattern)
+        if pattern is None:
+            dfa = ByteDFA.from_ast(grammar_to_ast(params.grammar))
+        else:
+            dfa = ByteDFA.from_regex(pattern)
         fsm = TokenFSM(dfa, matrix, eos_id)
         _FSM_CACHE[key] = fsm
         while len(_FSM_CACHE) > _FSM_CACHE_MAX:
             _FSM_CACHE.popitem(last=False)
         logger.info(
-            "compiled constraint FSM: %d DFA states, pattern %.60s…",
-            dfa.num_states, pattern,
+            "compiled constraint FSM: %d DFA states, source %.60s…",
+            dfa.num_states, source.replace("\x00", ":"),
         )
     else:
         _FSM_CACHE.move_to_end(key)

@@ -153,12 +153,11 @@ class BlockAllocator:
         self._free: list[int] = list(range(num_blocks - 1, -1, -1))
         self._refcount: dict[int, int] = {}
         # content-addressing state (empty unless prefix caching is on).
-        # Each table entry keeps (block, parent_hash, page_tokens) so a
-        # hit verifies the actual chain content — Python's hash() is a
-        # fast non-cryptographic mix and prompts are attacker-controlled,
-        # so a bare hash match must never adopt another request's pages.
-        self._hash_to_block: dict[int, tuple[int, int, tuple]] = {}
-        self._block_hash: dict[int, int] = {}
+        # Chain keys are sha256 digests over the full token chain (seed ‖
+        # page₀ ‖ … ‖ pageₚ): prompts are attacker-controlled, so the
+        # chain must be collision-resistant — Python's hash() is not.
+        self._hash_to_block: dict[bytes, int] = {}
+        self._block_hash: dict[int, bytes] = {}
         self._cached_free: dict[int, None] = {}  # LRU order: oldest first
         self.prefix_hits = 0  # tokens served from cache (stats/metrics)
 
@@ -207,14 +206,24 @@ class BlockAllocator:
     # ------------------------------------------------------- prefix caching
 
     @staticmethod
-    def _chain_seed(lora_name: Optional[str]) -> int:
-        return hash(("kv-prefix", lora_name))
+    def _chain_seed(lora_name: Optional[str]) -> bytes:
+        import hashlib
+
+        return hashlib.sha256(
+            b"kv-prefix\x00" + (lora_name or "").encode()
+        ).digest()
+
+    @staticmethod
+    def _chain_step(parent: bytes, page: tuple) -> bytes:
+        import hashlib
+
+        h = hashlib.sha256(parent)
+        h.update(repr(page).encode())
+        return h.digest()
 
     def _drop_hash(self, block: int) -> None:
         h = self._block_hash.pop(block, None)
-        if h is not None and h in self._hash_to_block and (
-            self._hash_to_block[h][0] == block
-        ):
+        if h is not None and self._hash_to_block.get(h) == block:
             del self._hash_to_block[h]
 
     def match_prefix(
@@ -226,9 +235,6 @@ class BlockAllocator:
         short of the prompt so at least the final position always runs
         through prefill (its logits seed the first sampled token).
         Adopted pages are refcounted and must be released via free().
-        Every hit is verified against the stored parent hash AND page
-        tokens — a hash collision degrades to a cache miss, never to
-        adopting foreign KV content.
         """
         if not self.enable_prefix_caching:
             return [], 0
@@ -239,15 +245,13 @@ class BlockAllocator:
             page = tuple(
                 token_ids[p * self.block_size: (p + 1) * self.block_size]
             )
-            nh = hash((h, page))
-            entry = self._hash_to_block.get(nh)
-            if entry is None or entry[1] != h or entry[2] != page:
+            h = self._chain_step(h, page)
+            block = self._hash_to_block.get(h)
+            if block is None:
                 break
-            block = entry[0]
             self._refcount[block] = self._refcount.get(block, 0) + 1
             self._cached_free.pop(block, None)  # now live again
             blocks.append(block)
-            h = nh
         return blocks, len(blocks) * self.block_size
 
     def register_prefix(
@@ -264,13 +268,12 @@ class BlockAllocator:
             page = tuple(
                 token_ids[p * self.block_size: (p + 1) * self.block_size]
             )
-            nh = hash((h, page))
-            if nh not in self._hash_to_block:
+            h = self._chain_step(h, page)
+            if h not in self._hash_to_block:
                 block = blocks[p]
                 if block not in self._block_hash:
-                    self._hash_to_block[nh] = (block, h, page)
-                    self._block_hash[block] = nh
-            h = nh
+                    self._hash_to_block[h] = block
+                    self._block_hash[block] = h
 
 
 class SequenceBlocks:

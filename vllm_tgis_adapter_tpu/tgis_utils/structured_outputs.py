@@ -34,11 +34,12 @@ def get_structured_output_params(
         return StructuredOutputsParams(choice=list(choice_list))
 
     if guided == "grammar":
-        # surfaces at request validation → INVALID_ARGUMENT, not mid-stream
-        raise ValueError(
-            "grammar-constrained decoding is not supported yet; use "
-            "regex, choice, or json_schema"
-        )
+        # validate eagerly: a malformed grammar surfaces at request
+        # validation → INVALID_ARGUMENT, not as mid-stream engine death
+        from vllm_tgis_adapter_tpu.engine.constrained import grammar_to_ast
+
+        grammar_to_ast(decoding_params.grammar)
+        return StructuredOutputsParams(grammar=decoding_params.grammar)
 
     if decoding_params.format == DecodingParameters.JSON:
         return StructuredOutputsParams(json_object=True)
