@@ -185,7 +185,7 @@ class AsyncLLMEngine:
                     )
                     async with self._engine_lock:
                         outputs = outputs + self.engine.commit_step(
-                            plan, result
+                            plan, result, prepared
                         )
                 for out in outputs:
                     queue = self._queues.get(out.request_id)

@@ -194,6 +194,39 @@ class LoRAConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class SpeculativeConfig:
+    """Draft-model speculative decoding (engine/speculative.py)."""
+
+    draft_model: str  # local path of the draft checkpoint
+    num_speculative_tokens: int
+    draft_model_config: ModelConfig
+
+    @staticmethod
+    def from_args(args: Any, target: ModelConfig) -> "Optional[SpeculativeConfig]":
+        path = getattr(args, "speculative_model", None)
+        if not path:
+            return None
+        n = getattr(args, "num_speculative_tokens", None)
+        if n is None:
+            n = 5
+        if n < 1:
+            raise ValueError(
+                f"--num-speculative-tokens must be >= 1 (got {n}); drop "
+                "--speculative-model to disable speculation"
+            )
+        draft = ModelConfig.from_pretrained(
+            path,
+            max_model_len=target.max_model_len,
+            dtype=args.dtype,
+        )
+        return SpeculativeConfig(
+            draft_model=path,
+            num_speculative_tokens=n,
+            draft_model_config=draft,
+        )
+
+
+@dataclasses.dataclass(frozen=True)
 class EngineConfig:
     model_config: ModelConfig
     cache_config: CacheConfig
@@ -207,6 +240,7 @@ class EngineConfig:
     quantization: str | None = None
     otlp_traces_endpoint: str | None = None
     disable_log_requests: bool = True
+    speculative: "Optional[SpeculativeConfig]" = None
 
     @property
     def max_model_len(self) -> int:
@@ -259,6 +293,7 @@ class EngineConfig:
                 max_loras=args.max_loras,
                 max_lora_rank=args.max_lora_rank,
             ),
+            speculative=SpeculativeConfig.from_args(args, model_config),
             tokenizer=args.tokenizer,
             seed=args.seed,
             max_logprobs=args.max_logprobs,

@@ -106,8 +106,27 @@ class LLMEngine:
             place = make_place_fn(mesh)
         logger.info("loading weights from %s", mcfg.model)
         params = load_llama_params(mcfg, mcfg.model, place=place)
+
+        # the draft loads BEFORE the engine so the KV-pool auto-sizing
+        # (resolve_num_blocks, driven by post-weights free HBM) sees the
+        # draft's parameter footprint too
+        draft_model = draft_params = None
+        if config.speculative is not None:
+            spec = config.speculative
+            logger.info(
+                "loading speculative draft weights from %s", spec.draft_model
+            )
+            draft_cfg = spec.draft_model_config
+            draft_model = get_model_class(draft_cfg.model_type)(draft_cfg)
+            draft_params = load_llama_params(
+                draft_cfg, spec.draft_model, place=place
+            )
+
         tokenizer = AutoTokenizer.from_pretrained(config.tokenizer or mcfg.model)
-        return cls(config, model, params, tokenizer, mesh=mesh)
+        engine = cls(config, model, params, tokenizer, mesh=mesh)
+        if draft_model is not None:
+            engine.runner.attach_speculative(draft_model, draft_params)
+        return engine
 
     def get_tokenizer(self, lora_request=None):  # noqa: ANN001
         """Base tokenizer, or the adapter's own if its directory ships
@@ -172,6 +191,10 @@ class LLMEngine:
             lora_name=lora_name,
         )
         seq.lora_slot = self.lora_manager.slot_of(lora_name)
+        if self.runner.spec is not None:
+            from vllm_tgis_adapter_tpu.engine.speculative import plain_greedy
+
+            seq.spec_eligible = plain_greedy(params) and lora_name is None
         if params.structured_outputs is not None:
             from vllm_tgis_adapter_tpu.engine.constrained import compile_fsm
 
@@ -219,7 +242,7 @@ class LLMEngine:
         if plan is None:
             return outputs
         result = self.execute_step(plan, prepared)
-        return outputs + self.commit_step(plan, result)
+        return outputs + self.commit_step(plan, result, prepared)
 
     def plan_step(self):
         """Phase 1 (host, engine lock held): drain scheduler-finished
@@ -255,12 +278,23 @@ class LLMEngine:
             return self.runner.execute_prefill(prepared)
         return self.runner.execute_decode(prepared)
 
-    def commit_step(self, plan, result) -> list[RequestOutput]:
+    def commit_step(self, plan, result, prepared=None) -> list[RequestOutput]:
         """Phase 3 (host, engine lock held): fold sampled tokens back into
         sequences; requests aborted mid-dispatch are skipped here."""
         if isinstance(plan, PrefillPlan):
             seq = plan.seq
             sampled, prompt_info = result
+            # draft-cache accounting: this chunk was mirrored into the
+            # draft only if it extends the draft's contiguous prefix
+            # (prefix-cache-adopted regions are target-only and get
+            # re-run through the draft by the catch-up path)
+            if (
+                not seq.is_finished
+                and prepared is not None
+                and getattr(prepared, "spec_eligible", False)
+                and seq.draft_pos == plan.start_pos
+            ):
+                seq.draft_pos = plan.start_pos + len(plan.token_ids)
             if sampled is None:
                 return []  # mid-prompt chunk: nothing emitted yet
             if seq.is_finished:
@@ -273,7 +307,14 @@ class LLMEngine:
                     seq, prompt_info
                 )
             return self._process_sampled([seq], [[sampled]])
-        return self._process_sampled(plan.seqs, result)
+        outputs = self._process_sampled(plan.seqs, result)
+        if prepared is not None and getattr(prepared, "spec_ran", False):
+            for seq in plan.seqs:
+                if not seq.is_finished:
+                    # propose wrote K/V through the last consumed token's
+                    # predecessor; everything beyond is stale-by-design
+                    seq.draft_pos = seq.num_tokens - 1
+        return outputs
 
     # -------------------------------------------------------------- internal
 

@@ -68,12 +68,22 @@ def resolve_num_blocks(
     mcfg = config.model_config
     ccfg = config.cache_config
     tp = config.parallel_config.tensor_parallel_size or 1
-    kv_heads_per_dev = max(1, mcfg.num_kv_heads // tp)
     itemsize = jnp.dtype(ccfg.cache_dtype).itemsize
-    block_bytes = (
-        2 * mcfg.num_layers * ccfg.block_size
-        * kv_heads_per_dev * mcfg.head_dim * itemsize
-    )
+
+    def per_block_bytes(m) -> int:  # noqa: ANN001
+        kv_heads_per_dev = max(1, m.num_kv_heads // tp)
+        return (
+            2 * m.num_layers * ccfg.block_size
+            * kv_heads_per_dev * m.head_dim * itemsize
+        )
+
+    block_bytes = per_block_bytes(mcfg)
+    if config.speculative is not None:
+        # the draft model keeps a parallel paged cache with the same slot
+        # geometry (engine/speculative.py) — its pages share the budget
+        block_bytes += per_block_bytes(
+            config.speculative.draft_model_config
+        )
     blocks_per_seq = -(-mcfg.max_model_len // ccfg.block_size)
     # beyond full occupancy (every batch row at max_model_len) extra pages
     # can never be touched

@@ -72,6 +72,9 @@ class PreparedPrefill:
     tensors: Optional[SamplingTensors]  # final chunks only
     allowed_row: "Optional[np.ndarray]"  # FSM mask, final chunks only
     lora_slot: int
+    # mirror this chunk into the draft cache (spec-eligible rows only —
+    # ineligible rows would pay a draft forward they can never use)
+    spec_eligible: bool = False
 
 
 @dataclasses.dataclass
@@ -90,6 +93,15 @@ class PreparedDecode:
     tensors: SamplingTensors
     allowed_mask: "Optional[np.ndarray]"
     lora_idx: "Optional[np.ndarray]"
+    # every row is plain-greedy and adapterless → the speculative path
+    # may take this dispatch (engine/speculative.py)
+    spec_ok: bool = False
+    # rows whose draft cache lags (they decoded in mixed batches): each
+    # entry is the padded draft-chunk inputs to catch that row up
+    draft_catchups: list = dataclasses.field(default_factory=list)
+    # set by SpeculativeDecoder.run when the dispatch actually speculated
+    # (commit then advances each row's draft_pos)
+    spec_ran: bool = False
 
 
 @dataclasses.dataclass
@@ -209,6 +221,19 @@ class ModelRunner:
         )
         self._seen_pad_lens = sorted(
             set(config.scheduler_config.prefill_buckets)
+        )
+        # draft-model speculative decoding; attached by the engine when
+        # --speculative-model is configured (engine/speculative.py)
+        self.spec = None
+
+    def attach_speculative(self, draft_model, draft_params) -> None:  # noqa: ANN001
+        from vllm_tgis_adapter_tpu.engine.speculative import (
+            SpeculativeDecoder,
+        )
+
+        self.spec = SpeculativeDecoder(
+            self, draft_model, draft_params,
+            self.config.speculative.num_speculative_tokens,
         )
 
     def sync_lora(self, manager) -> None:
@@ -390,6 +415,7 @@ class ModelRunner:
             tensors=tensors,
             allowed_row=allowed_row,
             lora_slot=seq.lora_slot,
+            spec_eligible=seq.spec_eligible,
         )
 
     def execute_prefill(
@@ -424,6 +450,10 @@ class ModelRunner:
                 self._put(prep.logits_indices),
                 *lora_args,
             )
+        if self.spec is not None and prep.spec_eligible:
+            # the draft model needs the prompt in ITS cache before it can
+            # propose continuations
+            self.spec.draft_prefill(prep)
         if not prep.is_final:
             return None, None  # mid-prompt chunk: nothing to sample
 
@@ -535,7 +565,42 @@ class ModelRunner:
             for i, seq in enumerate(seqs):
                 lora_idx[i] = seq.lora_slot
 
+        spec_ok = False
+        draft_catchups: list = []
+        if self.spec is not None:
+            spec_ok = all(seq.spec_eligible for seq in seqs)
+            if spec_ok:
+                # rows that decoded in mixed batches have a stale draft
+                # cache; snapshot the chunk inputs that re-run their
+                # missing tokens through the draft (all but the last
+                # token, which is the propose input)
+                for i, seq in enumerate(seqs):
+                    end = seq.num_tokens - 1
+                    if seq.draft_pos >= end:
+                        continue
+                    gap = seq.all_token_ids[seq.draft_pos:end]
+                    bucket = self._seen_pad_len(len(gap))
+                    ids = np.zeros(bucket, np.int32)
+                    ids[: len(gap)] = gap
+                    pos = seq.draft_pos + np.arange(bucket, dtype=np.int32)
+                    slots = np.full(bucket, -1, np.int32)
+                    slots[: len(gap)] = seq.blocks.slots_for_range(
+                        seq.draft_pos, end
+                    )
+                    draft_catchups.append(
+                        dict(
+                            t=len(gap),
+                            token_ids=ids,
+                            positions=pos,
+                            slot_mapping=slots,
+                            block_table=block_tables[i],
+                            start_pos=seq.draft_pos,
+                        )
+                    )
+
         return PreparedDecode(
+            spec_ok=spec_ok,
+            draft_catchups=draft_catchups,
             num_seqs=len(seqs),
             num_steps=plan.num_steps,
             steps_per_seq=list(plan.steps_per_seq),
@@ -551,9 +616,11 @@ class ModelRunner:
         )
 
     def execute_decode(self, prep: "PreparedDecode") -> list[list[SampledToken]]:
-        """Device half; returns per-seq token lists (row i gets
+        """Device half; returns per-seq token lists (row i gets UP TO
         ``steps_per_seq[i]`` entries; the engine stops consuming a row's
         list at EOS/stop-string)."""
+        if prep.spec_ok:
+            return self.spec.run(prep)
         lora = self.lora_stacks if prep.lora_idx is not None else None
         self.caches, self.seen, outs = self._decode_fn(
             self.params,

@@ -141,6 +141,7 @@ class Scheduler:
             seq.blocks.release()
             seq.blocks = None
         seq.prefill_pos = 0  # preemption-resume re-runs the whole prefill
+        seq.draft_pos = 0  # the draft's pages were released with ours
 
     # -------------------------------------------------------------- planning
 

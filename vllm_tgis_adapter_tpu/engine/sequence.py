@@ -80,6 +80,12 @@ class Sequence:
         self.prefill_pos: int = 0
         # stop-string scan frontier: chars of output_text already cleared
         self.stop_scan_pos: int = 0
+        # speculative decoding (engine/speculative.py): eligibility is
+        # fixed at admission; draft_pos counts the positions whose K/V is
+        # valid in the DRAFT cache (fused-decode dispatches don't write
+        # it, so spec dispatches catch the draft up first)
+        self.spec_eligible: bool = False
+        self.draft_pos: int = 0
         # FSM-constrained decoding (engine/constrained.py): compiled token
         # FSM + current state; None when the request is unconstrained
         self.fsm = None

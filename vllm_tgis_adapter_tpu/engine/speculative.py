@@ -1,0 +1,365 @@
+"""Draft-model speculative decoding (propose γ → verify in one pass).
+
+The reference exposes ``--speculative-model`` / ``--num-speculative-tokens``
+and delegates the mechanism to its engine
+(/root/reference/src/vllm_tgis_adapter/tgis_utils/args.py:164-168,221-231);
+this is the TPU-native mechanism itself:
+
+* **propose**: a ``lax.scan`` over γ greedy draft-model decode steps —
+  one device dispatch proposes γ tokens per batch row and writes the
+  draft's own paged KV as it goes;
+* **verify**: ONE target-model forward over each row's
+  ``[last_token, d₁ … d_γ]`` window (the batched multi-token analog of
+  the chunked-prefill attention path), greedy acceptance on device, and
+  the per-token logprob/rank/top-N stats the engine reports;
+* rejected positions leave stale K/V in both caches, which is safe: the
+  next dispatch re-inputs the corrected token at that position and
+  overwrites the slot before anything reads it (device work is strictly
+  serialized).
+
+Greedy equivalence: the accepted prefix plus the bonus token reproduces
+exactly the non-speculative greedy chain — each accepted dᵢ equals the
+target argmax given the identical prefix.  Speculation therefore engages
+only for batches where every row is *plain greedy* (temperature 0, no
+penalties/typical-p/FSM/min-tokens/LoRA); anything else falls back to
+the standard fused decode in the same dispatch slot.
+
+Draft/target contract: same tokenizer and vocab size (validated at
+boot); the draft shares the target's block tables and slot geometry, so
+its cache is simply a second (smaller) set of paged arrays.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import TYPE_CHECKING, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from vllm_tgis_adapter_tpu.logging import init_logger
+
+if TYPE_CHECKING:
+    from vllm_tgis_adapter_tpu.engine.runner import (
+        ModelRunner,
+        PreparedDecode,
+        SampledToken,
+    )
+
+logger = init_logger(__name__)
+
+_LOG_EVERY = 50  # dispatches between acceptance-rate log lines
+
+
+def plain_greedy(params) -> bool:  # noqa: ANN001
+    """Row eligibility: sampling modes speculation reproduces exactly."""
+    return (
+        params.temperature == 0.0
+        and params.repetition_penalty == 1.0
+        and params.typical_p == 1.0
+        and params.length_penalty is None
+        and params.min_tokens == 0
+        and params.structured_outputs is None
+    )
+
+
+@dataclasses.dataclass
+class SpecStats:
+    proposed: int = 0
+    accepted: int = 0
+    dispatches: int = 0
+
+    @property
+    def acceptance_rate(self) -> float:
+        return self.accepted / self.proposed if self.proposed else 0.0
+
+
+class SpeculativeDecoder:
+    """Owns the draft model's device state + the propose/verify programs."""
+
+    def __init__(
+        self,
+        runner: "ModelRunner",
+        draft_model,  # noqa: ANN001
+        draft_params,  # noqa: ANN001
+        num_speculative_tokens: int,
+    ):
+        if num_speculative_tokens < 1:
+            raise ValueError("--num-speculative-tokens must be >= 1")
+        self.runner = runner
+        self.gamma = num_speculative_tokens
+        self.draft_model = draft_model
+        self.stats = SpecStats()
+
+        tcfg = runner.config.model_config
+        dcfg = draft_model.config
+        if dcfg.vocab_size != tcfg.vocab_size:
+            raise ValueError(
+                f"draft vocab_size {dcfg.vocab_size} != target "
+                f"{tcfg.vocab_size}; speculative decoding requires a "
+                "shared tokenizer"
+            )
+
+        mesh = runner.mesh
+        draft_model.mesh = mesh
+        cache_dtype = runner.config.cache_config.cache_dtype
+        if mesh is not None:
+            from vllm_tgis_adapter_tpu.parallel import (
+                cache_sharding,
+                shard_llama_params,
+                validate_tp_divisibility,
+            )
+
+            validate_tp_divisibility(dcfg, mesh.shape["tp"])
+            draft_params = shard_llama_params(mesh, draft_params)
+            sh = cache_sharding(mesh)
+            self.draft_caches = jax.jit(
+                lambda: draft_model.make_kv_caches(
+                    runner.num_slots, cache_dtype
+                ),
+                out_shardings=(sh, sh),
+            )()
+        else:
+            self.draft_caches = draft_model.make_kv_caches(
+                runner.num_slots, cache_dtype
+            )
+        self.draft_params = draft_params
+
+        donate = (1,) if jax.default_backend() == "tpu" else ()
+        self._draft_prefill_fn = jax.jit(
+            draft_model.prefill, donate_argnums=donate
+        )
+        self._draft_chunk_fn = jax.jit(
+            functools.partial(
+                draft_model.prefill_chunk, block_size=runner.block_size
+            ),
+            donate_argnums=donate,
+        )
+        self._propose_fn = self._build_propose_fn()
+        self._verify_fn = self._build_verify_fn()
+
+    # ------------------------------------------------------------- prefill
+
+    def draft_prefill(self, prep) -> None:  # noqa: ANN001
+        """Mirror the target's prefill (chunk) into the draft cache."""
+        put = self.runner._put
+        common = (
+            self.draft_params,
+            self.draft_caches,
+            put(prep.token_ids),
+            put(prep.positions),
+            put(prep.slot_mapping),
+            put(np.asarray(prep.t, np.int32)),
+        )
+        # logits for row 0 only — the draft's prefill output is unused,
+        # only its KV writes matter
+        idx = put(np.asarray([0], np.int32))
+        if prep.start_pos == 0:
+            _, self.draft_caches = self._draft_prefill_fn(*common, idx)
+        else:
+            _, self.draft_caches = self._draft_chunk_fn(
+                *common, put(prep.block_table), idx
+            )
+
+    # -------------------------------------------------------------- decode
+
+    def _build_propose_fn(self):
+        draft = self.draft_model
+        block_size = self.runner.block_size
+
+        def propose(
+            params, caches, tokens0, positions0, limits, block_tables,
+            context_lens0, gamma: int,
+        ):
+            max_blocks = block_tables.shape[1]
+
+            def step(carry, k):
+                caches, tok = carry
+                pos = positions0 + k
+                active = pos <= limits
+                blk = jnp.take_along_axis(
+                    block_tables,
+                    jnp.clip(pos // block_size, 0, max_blocks - 1)[:, None],
+                    axis=1,
+                )[:, 0]
+                slot = jnp.where(
+                    active, blk * block_size + pos % block_size, -1
+                )
+                logits, caches = draft.decode(
+                    params, caches, tok, pos, slot, block_tables,
+                    context_lens0 + k, block_size,
+                )
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                return (caches, nxt), nxt
+
+            # gamma+1 steps: the extra step feeds d_gamma back so ITS K/V
+            # lands in the draft cache too — on a fully-accepted window
+            # the next dispatch's context covers d_gamma's position, which
+            # would otherwise be a permanent hole (its logits are unused)
+            (caches, _), drafted = jax.lax.scan(
+                step, (caches, tokens0), jnp.arange(gamma + 1)
+            )
+            return caches, drafted[:gamma]  # [gamma, B]
+
+        donate = (1,) if jax.default_backend() == "tpu" else ()
+        return jax.jit(propose, static_argnums=(7,), donate_argnums=donate)
+
+    def _build_verify_fn(self):
+        target = self.runner.model
+        block_size = self.runner.block_size
+        from vllm_tgis_adapter_tpu.engine.sampler import TOPN_WIDTH
+
+        def verify(
+            params, caches, window,  # [B, K]: last token + γ draft tokens
+            positions0, limits, block_tables,
+        ):
+            b, k = window.shape
+            pos = positions0[:, None] + jnp.arange(k)[None, :]  # [B, K]
+            active = pos <= limits[:, None]
+            max_blocks = block_tables.shape[1]
+            blk = jnp.take_along_axis(
+                block_tables,
+                jnp.clip(pos // block_size, 0, max_blocks - 1),
+                axis=1,
+            )
+            slots = jnp.where(
+                active, blk * block_size + pos % block_size, -1
+            )
+            logits, caches = target.verify(
+                params, caches, window, pos, slots, block_tables, block_size,
+            )  # [B, K, V] f32
+
+            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, K]
+            # greedy[:, j] is the target's choice for position pos+j+1;
+            # draft proposed window[:, j+1] for it
+            matches = greedy[:, :-1] == window[:, 1:]
+            accepted = jnp.sum(
+                jnp.cumprod(matches.astype(jnp.int32), axis=1), axis=1
+            )  # [B] in 0..K-1
+            cols = jnp.arange(k)[None, :]
+            emitted = jnp.where(
+                cols < accepted[:, None],
+                jnp.pad(window[:, 1:], ((0, 0), (0, 1))),
+                greedy,
+            )  # [B, K]; col j<a: draft token, col a: bonus, cols>a unused
+
+            logprobs = jax.nn.log_softmax(logits, axis=-1)
+            chosen_lp = jnp.take_along_axis(
+                logprobs, emitted[..., None], axis=-1
+            )[..., 0]
+            chosen_logit = jnp.take_along_axis(
+                logits, emitted[..., None], axis=-1
+            )
+            rank = 1 + jnp.sum(logits > chosen_logit, axis=-1).astype(
+                jnp.int32
+            )
+            topn_lp, topn_ids = jax.lax.top_k(logprobs, TOPN_WIDTH)
+            return (
+                caches,
+                emitted,
+                accepted,
+                chosen_lp,
+                rank,
+                topn_ids.astype(jnp.int32),
+                topn_lp,
+            )
+
+        donate = (1,) if jax.default_backend() == "tpu" else ()
+        return jax.jit(verify, donate_argnums=donate)
+
+    def run(self, prep: "PreparedDecode") -> list[list["SampledToken"]]:
+        """One speculative dispatch; same output contract as
+        ModelRunner.execute_decode (row i: up to steps_per_seq[i] tokens).
+        """
+        from vllm_tgis_adapter_tpu.engine.runner import SampledToken
+
+        runner = self.runner
+        put = runner._put
+        # K-1 proposals + 1 bonus per dispatch, bounded by the page
+        # capacity the scheduler planned for
+        k = min(self.gamma + 1, max(prep.num_steps, 1))
+        gamma = k - 1
+        if gamma == 0:
+            # no room to speculate this dispatch: plain fused decode
+            return runner.execute_decode(
+                dataclasses.replace(prep, spec_ok=False)
+            )
+
+        # catch lagging rows' draft caches up first (rows that decoded in
+        # mixed batches, or prompts admitted via target-side prefix-cache
+        # hits the draft never saw)
+        for cu in prep.draft_catchups:
+            _, self.draft_caches = self._draft_chunk_fn(
+                self.draft_params,
+                self.draft_caches,
+                put(cu["token_ids"]),
+                put(cu["positions"]),
+                put(cu["slot_mapping"]),
+                put(np.asarray(cu["t"], np.int32)),
+                put(cu["block_table"]),
+                put(np.asarray([0], np.int32)),
+            )
+
+        tokens0 = put(prep.token_ids)
+        positions0 = put(prep.positions)
+        limits = put(prep.limits)
+        tables = put(prep.block_tables)
+        ctx0 = put(prep.context_lens)
+
+        self.draft_caches, drafted = self._propose_fn(
+            self.draft_params, self.draft_caches, tokens0, positions0,
+            limits, tables, ctx0, gamma,
+        )
+        window = jnp.concatenate(
+            [tokens0[:, None], jnp.transpose(drafted)], axis=1
+        )  # [B, K]
+        (
+            runner.caches, emitted, accepted, lp, rank, topn_ids, topn_lp,
+        ) = self._verify_fn(
+            runner.params, runner.caches, window, positions0, limits, tables,
+        )
+
+        emitted = np.asarray(emitted)  # [B, K]
+        accepted = np.asarray(accepted)
+        lp = np.asarray(lp)
+        rank = np.asarray(rank)
+        topn_ids = np.asarray(topn_ids)
+        topn_lp = np.asarray(topn_lp)
+
+        out: list[list[SampledToken]] = []
+        batch_proposed = batch_accepted = 0
+        for i in range(prep.num_seqs):
+            emit = min(int(accepted[i]) + 1, prep.steps_per_seq[i])
+            out.append([
+                SampledToken(
+                    token_id=int(emitted[i, j]),
+                    logprob=float(lp[i, j]),
+                    rank=int(rank[i, j]),
+                    topn_ids=topn_ids[i, j].tolist(),
+                    topn_logprobs=topn_lp[i, j].tolist(),
+                )
+                for j in range(emit)
+            ])
+            batch_proposed += min(gamma, prep.steps_per_seq[i])
+            batch_accepted += min(int(accepted[i]), prep.steps_per_seq[i])
+        self.stats.proposed += batch_proposed
+        self.stats.accepted += batch_accepted
+        self.stats.dispatches += 1
+        prep.spec_ran = True  # commit advances each row's draft_pos
+        try:
+            from vllm_tgis_adapter_tpu import metrics
+
+            metrics.spec_proposed_tokens_total.inc(batch_proposed)
+            metrics.spec_accepted_tokens_total.inc(batch_accepted)
+        except Exception:  # pragma: no cover - metrics are best-effort
+            pass
+        if self.stats.dispatches % _LOG_EVERY == 0:
+            logger.info(
+                "speculative decoding: %.1f%% acceptance over %d proposed "
+                "tokens (%d dispatches)",
+                100 * self.stats.acceptance_rate, self.stats.proposed,
+                self.stats.dispatches,
+            )
+        return out

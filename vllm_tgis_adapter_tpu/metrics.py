@@ -67,6 +67,16 @@ num_requests_running = _get_or_create(
     f"{_PREFIX}_num_requests_running",
     "Requests currently being generated",
 )
+spec_proposed_tokens_total = _get_or_create(
+    Counter,
+    f"{_PREFIX}_spec_proposed_tokens_total",
+    "Draft tokens proposed by speculative decoding",
+)
+spec_accepted_tokens_total = _get_or_create(
+    Counter,
+    f"{_PREFIX}_spec_accepted_tokens_total",
+    "Draft tokens accepted by target verification",
+)
 
 
 def record_response(

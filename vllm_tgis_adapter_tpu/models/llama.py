@@ -332,6 +332,62 @@ class LlamaForCausalLM:
             x = x[logits_indices]
         return self._logits(params, x), (k_cache, v_cache)
 
+    def verify(
+        self,
+        params: dict,
+        caches: tuple[jax.Array, jax.Array],
+        token_ids: jax.Array,  # [B, K] speculation windows
+        positions: jax.Array,  # [B, K] global positions
+        slot_mapping: jax.Array,  # [B, K] cache slot per token; -1 masked
+        block_tables: jax.Array,  # [B, max_blocks]
+        block_size: int,
+    ) -> tuple[jax.Array, tuple[jax.Array, jax.Array]]:
+        """Multi-token verification forward for speculative decoding.
+
+        Each row's K tokens run in one pass; token j attends to the row's
+        paged context up to and including itself (its K/V is scattered
+        first), i.e. the batched generalisation of ``prefill_chunk``.
+        Returns logits for EVERY window position as ``[B, K, V]``.
+        """
+        cfg = self.config
+        k_cache, v_cache = caches
+        scale = self._attention_scale()
+        b, k = token_ids.shape
+
+        flat_tokens = token_ids.reshape(-1)
+        flat_pos = positions.reshape(-1)
+        flat_slots = slot_mapping.reshape(-1)
+        tables = jnp.repeat(block_tables, k, axis=0)  # [B*K, max_blocks]
+        ctx_lens = jnp.clip(flat_pos + 1, 1, None)
+
+        cos, sin = rotary_cos_sin(flat_pos, cfg.head_dim, cfg.rope_theta)
+        safe_slots = jnp.where(flat_slots < 0, k_cache.shape[2], flat_slots)
+
+        x = self._embed(params, flat_tokens)
+        for i, layer in enumerate(params["layers"]):
+            h = rms_norm(x, layer["input_norm"], cfg.rms_norm_eps)
+            q, kk, v = self._qkv(layer, h)
+            q = apply_rotary(q, cos, sin)
+            kk = apply_rotary(kk, cos, sin)
+            k_cache = k_cache.at[i, :, safe_slots].set(
+                kk.astype(k_cache.dtype), mode="drop"
+            )
+            v_cache = v_cache.at[i, :, safe_slots].set(
+                v.astype(v_cache.dtype), mode="drop"
+            )
+            o = attn_ops.paged_decode_attention(
+                q, k_cache[i], v_cache[i], tables, ctx_lens,
+                block_size, scale, mesh=self.mesh,
+            )
+            o = o.reshape(x.shape[0], -1) @ layer["wo"]
+            x = x + cfg.residual_multiplier * o
+
+            h = rms_norm(x, layer["post_attn_norm"], cfg.rms_norm_eps)
+            x = x + cfg.residual_multiplier * self._mlp(layer, h)
+
+        logits = self._logits(params, x)  # [B*K, V]
+        return logits.reshape(b, k, -1), (k_cache, v_cache)
+
     def decode(
         self,
         params: dict,
