@@ -1,0 +1,141 @@
+"""Example gRPC client for the TGIS ``fmaas.GenerationService`` API.
+
+Covers the same operator flows as the reference example client
+(/root/reference/examples/inference.py): TLS/insecure channel setup,
+batched generation with guided decoding, streaming, and tokenization —
+built on this package's bundled protobuf modules (lazily generated from
+generation.proto on first import), so no protoc step is needed.
+
+Usage:
+    python examples/inference.py --server localhost:8033 \
+        "At what temperature does Nitrogen boil?"
+    python examples/inference.py --stream "Tell me a story"
+    python examples/inference.py --tokenize "count my tokens"
+    python examples/inference.py --regex '[0-9]+\\.[0-9]+' "Pi is about "
+    python examples/inference.py --tls --ca-cert ./ca.pem "secure hello"
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+import grpc
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from vllm_tgis_adapter_tpu.grpc.pb import generation_pb2 as pb  # noqa: E402
+from vllm_tgis_adapter_tpu.grpc.pb.rpc import (  # noqa: E402
+    GenerationServiceStub,
+)
+
+
+def build_channel(args: argparse.Namespace) -> grpc.Channel:
+    if not args.tls:
+        return grpc.insecure_channel(args.server)
+    root = Path(args.ca_cert).read_bytes() if args.ca_cert else None
+    key = Path(args.client_key).read_bytes() if args.client_key else None
+    cert = Path(args.client_cert).read_bytes() if args.client_cert else None
+    creds = grpc.ssl_channel_credentials(
+        root_certificates=root, private_key=key, certificate_chain=cert
+    )
+    return grpc.secure_channel(args.server, creds)
+
+
+def build_params(args: argparse.Namespace) -> pb.Parameters:
+    stopping = pb.StoppingCriteria(
+        min_new_tokens=args.min_new_tokens,
+        max_new_tokens=args.max_new_tokens,
+    )
+    decoding = pb.DecodingParameters()
+    if args.regex:
+        decoding.regex = args.regex
+    response = pb.ResponseOptions(
+        generated_tokens=args.token_info,
+        token_logprobs=args.token_info,
+        token_ranks=args.token_info,
+    )
+    return pb.Parameters(
+        stopping=stopping, decoding=decoding, response=response
+    )
+
+
+def generate(stub, prompts, params, correlation_id):  # noqa: ANN001
+    metadata = (
+        [("x-correlation-id", correlation_id)] if correlation_id else []
+    )
+    reply = stub.Generate(
+        pb.BatchedGenerationRequest(
+            requests=[pb.GenerationRequest(text=p) for p in prompts],
+            params=params,
+        ),
+        metadata=metadata,
+    )
+    for prompt, resp in zip(prompts, reply.responses):
+        print(f"--- prompt: {prompt!r}")
+        print(f"    stop_reason={pb.StopReason.Name(resp.stop_reason)} "
+              f"tokens={resp.generated_token_count}")
+        print(f"    {resp.text!r}")
+
+
+def generate_stream(stub, prompt, params):  # noqa: ANN001
+    request = pb.SingleGenerationRequest(
+        request=pb.GenerationRequest(text=prompt), params=params
+    )
+    print(f"--- streaming: {prompt!r}")
+    for frame in stub.GenerateStream(request):
+        if frame.input_token_count:
+            print(f"    [input tokens: {frame.input_token_count}]")
+        if frame.text:
+            sys.stdout.write(frame.text)
+            sys.stdout.flush()
+    print()
+
+
+def tokenize(stub, text):  # noqa: ANN001
+    reply = stub.Tokenize(
+        pb.BatchedTokenizeRequest(
+            requests=[pb.TokenizeRequest(text=text)],
+            return_tokens=True,
+        )
+    )
+    for resp in reply.responses:
+        print(f"{resp.token_count} tokens: {list(resp.tokens)}")
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("prompts", nargs="+", help="prompt text(s)")
+    parser.add_argument("--server", default="localhost:8033")
+    parser.add_argument("--stream", action="store_true",
+                        help="use GenerateStream (first prompt only)")
+    parser.add_argument("--tokenize", action="store_true",
+                        help="tokenize instead of generating")
+    parser.add_argument("--regex", default=None,
+                        help="guided decoding: constrain output to a regex")
+    parser.add_argument("--min-new-tokens", type=int, default=1)
+    parser.add_argument("--max-new-tokens", type=int, default=64)
+    parser.add_argument("--token-info", action="store_true",
+                        help="request per-token logprobs/ranks")
+    parser.add_argument("--correlation-id", default=None)
+    parser.add_argument("--tls", action="store_true")
+    parser.add_argument("--ca-cert", default=None)
+    parser.add_argument("--client-cert", default=None)
+    parser.add_argument("--client-key", default=None)
+    args = parser.parse_args()
+
+    with build_channel(args) as channel:
+        stub = GenerationServiceStub(channel)
+        if args.tokenize:
+            for prompt in args.prompts:
+                tokenize(stub, prompt)
+        elif args.stream:
+            generate_stream(stub, args.prompts[0], build_params(args))
+        else:
+            generate(stub, args.prompts, build_params(args),
+                     args.correlation_id)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,33 @@
+#!/bin/bash
+# grpcurl smoke call against a running server (same operator flow as the
+# reference's examples/inference.sh: batched Generate with guided regex).
+#
+# This server registers gRPC reflection, so grpcurl needs no -proto flag;
+# pass PROTO=path/to/generation.proto to pin the schema instead (e.g. for
+# servers built without reflection).
+set -euo pipefail
+
+GRPC_HOSTNAME="${GRPC_HOSTNAME:-localhost}"
+GRPC_PORT="${GRPC_PORT:-8033}"
+
+PROTO_ARGS=()
+if [[ -n "${PROTO:-}" ]]; then
+  PROTO_ARGS=(-proto "${PROTO}")
+fi
+
+# replace -plaintext with -insecure (or CA flags) when the server runs TLS
+grpcurl -v \
+  -plaintext \
+  "${PROTO_ARGS[@]}" \
+  -d '{
+    "requests": [
+      {"text": "At what temperature does Nitrogen boil?"},
+      {"text": "another request"}
+    ],
+    "params": {
+      "stopping": {"min_new_tokens": 4, "max_new_tokens": 32},
+      "decoding": {"regex": "-?[0-9]+ degrees"}
+    }
+  }' \
+  "${GRPC_HOSTNAME}:${GRPC_PORT}" \
+  fmaas.GenerationService/Generate

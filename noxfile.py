@@ -1,0 +1,48 @@
+"""Nox automation: CPU test suite, lint, wheel build.
+
+Same session layout as the reference's noxfile (tests/lint/build) but
+against the JAX CPU backend — the suite forces
+JAX_PLATFORMS=cpu + an 8-device virtual mesh itself (tests/conftest.py),
+so every session runs on plain CI runners with no accelerator.
+"""
+
+from __future__ import annotations
+
+import nox
+
+nox.options.sessions = ("lint", "tests")
+nox.options.reuse_existing_virtualenvs = True
+
+PYTHON_VERSIONS = ["3.12", "3.11"]
+
+
+@nox.session(python=PYTHON_VERSIONS)
+def tests(session: nox.Session) -> None:
+    session.install("-e", ".[tests]")
+    session.run(
+        "pytest", "tests/", "-q",
+        *session.posargs,
+        env={"JAX_PLATFORMS": "cpu"},
+    )
+
+
+@nox.session(python="3.12")
+def tpu_tests(session: nox.Session) -> None:
+    """On-hardware kernel gate; requires an attached TPU."""
+    session.install("-e", ".[tests]")
+    session.run(
+        "pytest", "tests", "-m", "tpu", "-q",
+        env={"RUN_TPU_TESTS": "1"},
+    )
+
+
+@nox.session(python="3.12")
+def lint(session: nox.Session) -> None:
+    session.install("ruff")
+    session.run("ruff", "check", "vllm_tgis_adapter_tpu", "tests")
+
+
+@nox.session(python="3.12")
+def build(session: nox.Session) -> None:
+    session.install("build")
+    session.run("python", "-m", "build")

@@ -1,0 +1,159 @@
+"""Tracing: W3C traceparent parsing + OTLP/HTTP JSON span export.
+
+The reference forwards trace headers into its engine's OTel integration
+(reference grpc_server.py:257-263); here the span pipeline itself is
+exercised against a local collector.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from vllm_tgis_adapter_tpu.tracing import extract_trace_context
+
+
+def test_traceparent_parsing():
+    good = {
+        "traceparent":
+            "00-0af7651916cd43dd8448eb211c80319c-b7ad6b7169203331-01",
+    }
+    ctx = extract_trace_context(good)
+    assert ctx.trace_id == "0af7651916cd43dd8448eb211c80319c"
+    assert ctx.parent_span_id == "b7ad6b7169203331"
+    assert ctx.sampled
+
+    # case-insensitive header names
+    assert extract_trace_context(
+        {"Traceparent": good["traceparent"]}
+    ) is not None
+
+    # sampled-out flag parses (the tracer then skips the span entirely)
+    off = extract_trace_context({
+        "traceparent":
+            "00-0af7651916cd43dd8448eb211c80319c-b7ad6b7169203331-00",
+    })
+    assert off is not None and not off.sampled
+
+    for bad in (
+        None,
+        {},
+        {"traceparent": "junk"},
+        {"traceparent": "00-short-b7ad6b7169203331-01"},
+        {"traceparent": "00-" + "0" * 32 + "-b7ad6b7169203331-01"},
+        {"traceparent":
+         "00-0af7651916cd43dd8448eb211c80319c-" + "0" * 16 + "-01"},
+        {"traceparent":
+         "00-0af7651916cd43dd8448eb211c80319c-b7ad6b7169203331-zz"},
+        # right lengths, non-hex: must be rejected, not exported broken
+        {"traceparent": "00-" + "z" * 32 + "-b7ad6b7169203331-01"},
+        {"traceparent":
+         "00-0af7651916cd43dd8448eb211c80319c-" + "z" * 16 + "-01"},
+    ):
+        assert extract_trace_context(bad) is None
+
+
+def test_sampled_out_requests_produce_no_span():
+    from vllm_tgis_adapter_tpu.tracing import RequestTracer
+
+    tracer = RequestTracer.__new__(RequestTracer)  # no exporter needed
+    span = RequestTracer.start_span(
+        tracer, "rid",
+        {"traceparent":
+         "00-0af7651916cd43dd8448eb211c80319c-b7ad6b7169203331-00"},
+    )
+    assert span is None
+
+
+class _Collector(BaseHTTPRequestHandler):
+    received: list = []
+
+    def do_POST(self):  # noqa: N802
+        length = int(self.headers["Content-Length"])
+        _Collector.received.append(
+            (self.path, json.loads(self.rfile.read(length)))
+        )
+        self.send_response(200)
+        self.end_headers()
+
+    def log_message(self, *args):  # noqa: ANN002
+        pass
+
+
+@pytest.fixture()
+def collector():
+    _Collector.received = []
+    server = HTTPServer(("127.0.0.1", 0), _Collector)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    yield f"http://127.0.0.1:{server.server_port}", _Collector.received
+    server.shutdown()
+
+
+def test_request_span_exported_end_to_end(tiny_model_dir, collector):
+    """A generate() call with a traceparent produces one OTLP span with
+    the caller's trace id, the parent span id, and token-usage
+    attributes."""
+    endpoint, received = collector
+
+    from vllm_tgis_adapter_tpu.engine.async_llm import AsyncLLMEngine
+    from vllm_tgis_adapter_tpu.engine.config import (
+        CacheConfig,
+        EngineConfig,
+        LoRAConfig,
+        ModelConfig,
+        ParallelConfig,
+        SchedulerConfig,
+    )
+    from vllm_tgis_adapter_tpu.engine.sampling_params import SamplingParams
+
+    mcfg = ModelConfig.from_pretrained(tiny_model_dir, dtype="float32")
+    config = EngineConfig(
+        model_config=mcfg,
+        cache_config=CacheConfig(block_size=16, num_blocks=32,
+                                 cache_dtype=mcfg.dtype),
+        scheduler_config=SchedulerConfig(max_num_seqs=2,
+                                         prefill_buckets=(32,)),
+        parallel_config=ParallelConfig(),
+        lora_config=LoRAConfig(),
+        otlp_traces_endpoint=endpoint,
+    )
+    engine = AsyncLLMEngine.from_config(config)
+
+    trace_id = "0af7651916cd43dd8448eb211c80319c"
+    parent = "b7ad6b7169203331"
+
+    async def scenario():
+        assert await engine.is_tracing_enabled()
+        async for _ in engine.generate(
+            prompt=None,
+            sampling_params=SamplingParams(
+                temperature=0.0, max_tokens=5, ignore_eos=True
+            ),
+            request_id="traced-1",
+            prompt_token_ids=list(range(3, 10)),
+            trace_headers={
+                "traceparent": f"00-{trace_id}-{parent}-01"
+            },
+        ):
+            pass
+        await engine.stop()  # flushes the export queue (tracer shutdown)
+
+    asyncio.run(scenario())
+
+    assert received, "no OTLP batch reached the collector"
+    path, payload = received[0]
+    assert path == "/v1/traces"
+    spans = payload["resourceSpans"][0]["scopeSpans"][0]["spans"]
+    span = next(s for s in spans if s["traceId"] == trace_id)
+    assert span["parentSpanId"] == parent
+    assert span["name"] == "llm_request"
+    attrs = {a["key"]: a["value"] for a in span["attributes"]}
+    assert attrs["gen_ai.request.id"]["stringValue"] == "traced-1"
+    assert attrs["gen_ai.usage.prompt_tokens"]["intValue"] == "7"
+    assert attrs["gen_ai.usage.completion_tokens"]["intValue"] == "5"
+    assert int(span["endTimeUnixNano"]) > int(span["startTimeUnixNano"])

@@ -52,6 +52,16 @@ def create_server_socket(host: str | None, port: int) -> socket.socket:
 async def start_servers(args: "argparse.Namespace") -> None:
     sock = create_server_socket(args.host, args.port)
 
+    if getattr(args, "jax_profiler_port", None):
+        # device-level profiling story (SURVEY §5): TensorBoard/XProf
+        # connects here to capture XLA/TPU traces of the live engine
+        import jax
+
+        jax.profiler.start_server(args.jax_profiler_port)
+        logger.info(
+            "jax.profiler server listening on port %d", args.jax_profiler_port
+        )
+
     engine = None
     tasks: list[asyncio.Task] = []
     try:

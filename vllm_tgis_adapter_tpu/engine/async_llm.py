@@ -42,6 +42,13 @@ class AsyncLLMEngine:
         # serializes engine-state mutations (add/abort) against the step
         # running in the worker thread — scheduler state is not thread-safe
         self._engine_lock = asyncio.Lock()
+        # one server span per request when --otlp-traces-endpoint is set
+        self._tracer = None
+        endpoint = engine.config.otlp_traces_endpoint
+        if endpoint:
+            from vllm_tgis_adapter_tpu.tracing import RequestTracer
+
+            self._tracer = RequestTracer(endpoint)
 
     # ------------------------------------------------------------- lifecycle
 
@@ -65,6 +72,10 @@ class AsyncLLMEngine:
             except (asyncio.CancelledError, Exception):  # noqa: BLE001
                 pass
             self._loop_task = None
+        if self._tracer is not None:
+            # flush buffered spans before the exporter thread dies with
+            # the process
+            await asyncio.to_thread(self._tracer.shutdown)
 
     # ----------------------------------------------------- EngineClient-like
 
@@ -133,6 +144,9 @@ class AsyncLLMEngine:
             raise ValueError(f"duplicate request_id {request_id!r}")
         queue: asyncio.Queue = asyncio.Queue()
         self._queues[request_id] = queue
+        span = None
+        if self._tracer is not None:
+            span = self._tracer.start_span(request_id, trace_headers)
         try:
             async with self._engine_lock:
                 self.engine.add_request(
@@ -142,20 +156,29 @@ class AsyncLLMEngine:
                     prompt_token_ids=prompt_token_ids,
                     lora_name=getattr(lora_request, "name", None),
                 )
-        except Exception:
+        except Exception as e:
             self._queues.pop(request_id, None)
+            if span is not None:
+                # rejected admissions are precisely the requests tracing
+                # must not lose
+                span.attributes["error.type"] = type(e).__name__
+                self._tracer.finish_span(span, None)
             raise
         self._new_work.set()
+        final = None
         try:
             while True:
                 item = await queue.get()
                 if isinstance(item, BaseException):
                     raise item
+                final = item
                 yield item
                 if item.finished:
                     return
         finally:
             self._queues.pop(request_id, None)
+            if span is not None:
+                self._tracer.finish_span(span, final)
 
     async def abort(self, request_id: str) -> None:
         async with self._engine_lock:

@@ -248,6 +248,10 @@ def add_engine_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     g = parser.add_argument_group("observability")
     g.add_argument("--otlp-traces-endpoint", type=str, default=None,
                    help="OTLP endpoint; enables trace-context propagation")
+    g.add_argument("--jax-profiler-port", type=int, default=None,
+                   help="start a jax.profiler server on this port "
+                        "(connect with TensorBoard/XProf to capture "
+                        "device traces)")
     g.add_argument("--disable-log-requests", action="store_true",
                    help="disable engine-level per-request logs")
 

@@ -1,0 +1,260 @@
+"""Request tracing: W3C context propagation + OTLP/HTTP JSON export.
+
+The reference adapter forwards W3C trace headers into its engine, which
+creates one span per request through vLLM's OTel integration (reference
+grpc_server.py:22-26,257-263 and SURVEY.md §5 tracing).  The OTel SDK is
+not available in this environment, so the span pipeline is
+self-contained: ``traceparent`` parsing per the W3C spec, a minimal span
+record, and a background exporter speaking OTLP's standard JSON
+encoding over HTTP (`POST <endpoint>/v1/traces`) — any OTLP collector
+(otel-collector, Jaeger, Tempo) ingests it directly.
+
+Spans are emitted only when ``--otlp-traces-endpoint`` is configured;
+export runs on a daemon thread so the serving path never blocks on the
+collector.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import queue
+import secrets
+import threading
+import time
+import urllib.request
+from typing import Optional
+
+from vllm_tgis_adapter_tpu.logging import init_logger
+
+logger = init_logger(__name__)
+
+_SERVICE_NAME = "vllm-tgis-adapter-tpu"
+_EXPORT_BATCH = 64
+_EXPORT_INTERVAL_S = 2.0
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceContext:
+    """Parsed W3C ``traceparent``."""
+
+    trace_id: str  # 32 hex chars
+    parent_span_id: str  # 16 hex chars
+    sampled: bool
+
+
+def _is_hex(value: str) -> bool:
+    try:
+        int(value, 16)
+    except ValueError:
+        return False
+    return True
+
+
+def extract_trace_context(
+    headers: Optional[dict],
+) -> Optional[TraceContext]:
+    """headers (case-insensitive keys) → TraceContext, or None.
+
+    Every field is hex-validated — a malformed id must degrade to "no
+    context", never to an invalid OTLP traceId that poisons an export
+    batch at the collector.
+    """
+    if not headers:
+        return None
+    lowered = {k.lower(): v for k, v in headers.items()}
+    raw = lowered.get("traceparent")
+    if not raw:
+        return None
+    parts = raw.strip().split("-")
+    if len(parts) != 4:
+        return None
+    version, trace_id, span_id, flags = parts
+    if (
+        len(version) != 2
+        or len(trace_id) != 32
+        or len(span_id) != 16
+        or len(flags) != 2
+        or not all(_is_hex(p) for p in parts)
+        or trace_id == "0" * 32
+        or span_id == "0" * 16
+    ):
+        return None
+    return TraceContext(
+        trace_id=trace_id.lower(),
+        parent_span_id=span_id.lower(),
+        sampled=bool(int(flags, 16) & 0x01),
+    )
+
+
+@dataclasses.dataclass
+class Span:
+    name: str
+    trace_id: str
+    span_id: str
+    parent_span_id: Optional[str]
+    start_ns: int
+    end_ns: int = 0
+    attributes: dict = dataclasses.field(default_factory=dict)
+
+    def otlp_json(self) -> dict:
+        def value(v):  # noqa: ANN001, ANN202
+            if isinstance(v, bool):
+                return {"boolValue": v}
+            if isinstance(v, int):
+                return {"intValue": str(v)}
+            if isinstance(v, float):
+                return {"doubleValue": v}
+            return {"stringValue": str(v)}
+
+        return {
+            "traceId": self.trace_id,
+            "spanId": self.span_id,
+            **(
+                {"parentSpanId": self.parent_span_id}
+                if self.parent_span_id
+                else {}
+            ),
+            "name": self.name,
+            "kind": 2,  # SPAN_KIND_SERVER
+            "startTimeUnixNano": str(self.start_ns),
+            "endTimeUnixNano": str(self.end_ns),
+            "attributes": [
+                {"key": k, "value": value(v)}
+                for k, v in self.attributes.items()
+            ],
+        }
+
+
+class OtlpJsonExporter:
+    """Batching OTLP/HTTP JSON trace exporter (daemon thread + queue)."""
+
+    def __init__(self, endpoint: str, timeout_s: float = 5.0):
+        self.url = endpoint.rstrip("/") + "/v1/traces"
+        self.timeout_s = timeout_s
+        self._queue: "queue.Queue[Optional[Span]]" = queue.Queue(maxsize=4096)
+        self._worker = threading.Thread(
+            target=self._run, name="otlp-exporter", daemon=True
+        )
+        self._worker.start()
+        logger.info("OTLP trace export enabled → %s", self.url)
+
+    def export(self, span: Span) -> None:
+        try:
+            self._queue.put_nowait(span)
+        except queue.Full:
+            logger.warning("trace export queue full; dropping span")
+
+    def shutdown(self) -> None:
+        self._queue.put(None)
+        self._worker.join(timeout=self.timeout_s)
+
+    # ------------------------------------------------------------- internals
+
+    def _run(self) -> None:
+        done = False
+        while not done:
+            batch: list[Span] = []
+            try:
+                item = self._queue.get(timeout=_EXPORT_INTERVAL_S)
+            except queue.Empty:
+                continue
+            while item is not None:
+                batch.append(item)
+                if len(batch) >= _EXPORT_BATCH:
+                    break
+                try:
+                    item = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+            done = item is None
+            if batch:
+                self._post(batch)
+
+    def _post(self, batch: list[Span]) -> None:
+        payload = {
+            "resourceSpans": [{
+                "resource": {
+                    "attributes": [{
+                        "key": "service.name",
+                        "value": {"stringValue": _SERVICE_NAME},
+                    }],
+                },
+                "scopeSpans": [{
+                    "scope": {"name": _SERVICE_NAME},
+                    "spans": [s.otlp_json() for s in batch],
+                }],
+            }],
+        }
+        request = urllib.request.Request(
+            self.url,
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(request, timeout=self.timeout_s):
+                pass
+        except Exception as e:  # noqa: BLE001 — telemetry must never raise
+            logger.warning("OTLP trace export failed: %s", e)
+
+
+class RequestTracer:
+    """Creates one server span per generation request."""
+
+    def __init__(self, endpoint: str):
+        self._exporter = OtlpJsonExporter(endpoint)
+
+    def start_span(
+        self,
+        request_id: str,
+        trace_headers: Optional[dict],
+    ) -> Optional[Span]:
+        """Returns None when the caller's traceparent says sampled-out —
+        the upstream sampling decision is honoured, not overridden."""
+        ctx = extract_trace_context(trace_headers)
+        if ctx is not None and not ctx.sampled:
+            return None
+        return Span(
+            name="llm_request",
+            trace_id=ctx.trace_id if ctx else secrets.token_hex(16),
+            span_id=secrets.token_hex(8),
+            parent_span_id=ctx.parent_span_id if ctx else None,
+            start_ns=time.time_ns(),
+            attributes={"gen_ai.request.id": request_id},
+        )
+
+    def finish_span(self, span: Span, final_output) -> None:  # noqa: ANN001
+        span.end_ns = time.time_ns()
+        if final_output is not None:
+            completion = (
+                final_output.outputs[0] if final_output.outputs else None
+            )
+            span.attributes.update({
+                "gen_ai.usage.prompt_tokens": len(
+                    final_output.prompt_token_ids or ()
+                ),
+                "gen_ai.usage.completion_tokens": (
+                    len(completion.token_ids) if completion else 0
+                ),
+                "gen_ai.response.finish_reason": (
+                    completion.finish_reason if completion else None
+                ) or "unfinished",
+            })
+            metrics = final_output.metrics
+            if metrics is not None and metrics.time_in_queue is not None:
+                span.attributes["gen_ai.latency.time_in_queue"] = (
+                    metrics.time_in_queue
+                )
+            if (
+                metrics is not None
+                and metrics.first_token_time is not None
+                and metrics.arrival_time is not None
+            ):
+                span.attributes["gen_ai.latency.time_to_first_token"] = (
+                    metrics.first_token_time - metrics.arrival_time
+                )
+        self._exporter.export(span)
+
+    def shutdown(self) -> None:
+        self._exporter.shutdown()
