@@ -122,6 +122,64 @@ def build_tiny_llama(path: str, seed: int = 0) -> str:
     return str(out)
 
 
+def build_tiny_mixtral(path: str, seed: int = 0, num_experts: int = 4,
+                       experts_per_tok: int = 2) -> str:
+    """Tiny mixtral-architecture checkpoint: llama attention skeleton with
+    a router + per-expert FFNs in HF block_sparse_moe naming."""
+    import numpy as np
+    from safetensors.numpy import save_file
+
+    out = Path(path)
+    out.mkdir(parents=True, exist_ok=True)
+
+    tokenizer = build_tokenizer(path)
+    cfg = dict(TINY_LLAMA_CONFIG)
+    cfg["architectures"] = ["MixtralForCausalLM"]
+    cfg["model_type"] = "mixtral"
+    cfg["num_local_experts"] = num_experts
+    cfg["num_experts_per_tok"] = experts_per_tok
+    cfg["vocab_size"] = max(cfg["vocab_size"], len(tokenizer))
+    with open(out / "config.json", "w") as f:
+        json.dump(cfg, f, indent=2)
+
+    rng = np.random.default_rng(seed)
+    d = cfg["hidden_size"]
+    dh = cfg["head_dim"]
+    h = cfg["num_attention_heads"]
+    hkv = cfg["num_key_value_heads"]
+    inter = cfg["intermediate_size"]
+    vocab = cfg["vocab_size"]
+
+    def w(shape):
+        return (rng.standard_normal(shape) * 0.02).astype(np.float32)
+
+    tensors = {
+        "model.embed_tokens.weight": w((vocab, d)),
+        "model.norm.weight": np.ones(d, dtype=np.float32),
+        "lm_head.weight": w((vocab, d)),
+    }
+    for i in range(cfg["num_hidden_layers"]):
+        p = f"model.layers.{i}"
+        tensors |= {
+            f"{p}.input_layernorm.weight": np.ones(d, dtype=np.float32),
+            f"{p}.post_attention_layernorm.weight": np.ones(d, dtype=np.float32),
+            f"{p}.self_attn.q_proj.weight": w((h * dh, d)),
+            f"{p}.self_attn.k_proj.weight": w((hkv * dh, d)),
+            f"{p}.self_attn.v_proj.weight": w((hkv * dh, d)),
+            f"{p}.self_attn.o_proj.weight": w((d, h * dh)),
+            f"{p}.block_sparse_moe.gate.weight": w((num_experts, d)),
+        }
+        for e in range(num_experts):
+            q = f"{p}.block_sparse_moe.experts.{e}"
+            tensors |= {
+                f"{q}.w1.weight": w((inter, d)),
+                f"{q}.w2.weight": w((d, inter)),
+                f"{q}.w3.weight": w((inter, d)),
+            }
+    save_file(tensors, out / "model.safetensors")
+    return str(out)
+
+
 def build_tiny_lora_adapter(path: str, seed: int = 7, rank: int = 4) -> str:
     """PEFT-format LoRA adapter matching the tiny llama fixture: real
     random A/B weights on q/v projections of both layers (the reference's

@@ -73,7 +73,9 @@ class LLMEngine:
         from vllm_tgis_adapter_tpu.engine.lora import LoRAManager
 
         self.lora_manager = LoRAManager(
-            config.lora_config.max_loras, config.lora_config.max_lora_rank
+            config.lora_config.max_loras,
+            config.lora_config.max_lora_rank,
+            moe_model=config.model_config.num_experts > 0,
         )
 
     # ------------------------------------------------------------- lifecycle

@@ -124,9 +124,14 @@ class LoRAManager:
     runner knows when to rebuild its stacked device tensors.
     """
 
-    def __init__(self, max_loras: int = 4, max_lora_rank: int = 64):
+    def __init__(self, max_loras: int = 4, max_lora_rank: int = 64,
+                 moe_model: bool = False):
         self.max_loras = max_loras
         self.max_lora_rank = max_lora_rank
+        # MoE models have no dense MLP for the gate/up/down deltas to
+        # attach to — adapters targeting them are rejected at load time
+        # instead of having those deltas silently dropped
+        self.moe_model = moe_model
         self.lora_requests: dict[str, LoRARequest] = {}
         self._weights: dict[str, LoRAAdapterWeights] = {}
         self._slots: dict[str, int] = {}
@@ -145,6 +150,19 @@ class LoRAManager:
         import asyncio
 
         weights = await asyncio.to_thread(load_peft_adapter, lora_path)
+        if self.moe_model:
+            mlp = {"gate_proj", "up_proj", "down_proj"}
+            hit = sorted({
+                key.rsplit(".", 1)[-1]
+                for key in weights.a
+                if key.rsplit(".", 1)[-1] in mlp
+            })
+            if hit:
+                raise LoRAError(
+                    f"adapter targets MLP projections {hit}, which have no "
+                    "dense counterpart in an MoE model; retrain the "
+                    "adapter against attention projections only"
+                )
         if weights.rank > self.max_lora_rank:
             # truncating silently corrupts every request using the adapter;
             # the reference path rejects over-rank adapters at load time

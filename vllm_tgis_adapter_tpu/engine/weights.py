@@ -114,10 +114,31 @@ def load_llama_params(
             "wk": take(f"{prefix}.self_attn.k_proj.weight", transpose=True),
             "wv": take(f"{prefix}.self_attn.v_proj.weight", transpose=True),
             "wo": take(f"{prefix}.self_attn.o_proj.weight", transpose=True),
-            "w_gate": take(f"{prefix}.mlp.gate_proj.weight", transpose=True),
-            "w_up": take(f"{prefix}.mlp.up_proj.weight", transpose=True),
-            "w_down": take(f"{prefix}.mlp.down_proj.weight", transpose=True),
         }
+        if config.num_experts > 0:
+            # mixtral: per-expert FFNs stacked into [E, ...] tensors
+            # (w1=gate, w3=up, w2=down in HF naming); the stacked arrays
+            # get their final mesh placement from shard_llama_params
+            moe = f"{prefix}.block_sparse_moe"
+            layer["router"] = take(f"{moe}.gate.weight", transpose=True)
+
+            def stack(which: str, transpose: bool) -> jax.Array:
+                return jnp.stack([
+                    take(f"{moe}.experts.{e}.{which}.weight",
+                         transpose=transpose)
+                    for e in range(config.num_experts)
+                ])
+
+            layer["experts_gate"] = stack("w1", True)
+            layer["experts_up"] = stack("w3", True)
+            layer["experts_down"] = stack("w2", True)
+        else:
+            layer["w_gate"] = take(f"{prefix}.mlp.gate_proj.weight",
+                                   transpose=True)
+            layer["w_up"] = take(f"{prefix}.mlp.up_proj.weight",
+                                 transpose=True)
+            layer["w_down"] = take(f"{prefix}.mlp.down_proj.weight",
+                                   transpose=True)
         if config.attention_bias:
             layer["bq"] = take(f"{prefix}.self_attn.q_proj.bias")
             layer["bk"] = take(f"{prefix}.self_attn.k_proj.bias")

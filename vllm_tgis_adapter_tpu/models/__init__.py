@@ -16,6 +16,9 @@ _REGISTRY = {
     "mistral": LlamaForCausalLM,
     "granite": LlamaForCausalLM,
     "qwen2": LlamaForCausalLM,
+    # sparse-MoE variant of the same skeleton: layers carry a router +
+    # stacked expert FFNs instead of one dense MLP (llama.py _moe_mlp)
+    "mixtral": LlamaForCausalLM,
     "gpt_neox": None,  # reserved
     "opt": None,  # reserved
 }

@@ -111,7 +111,7 @@ class LlamaForCausalLM:
         if not cfg.tie_word_embeddings:
             params["lm_head"] = dense(next(keys), (d, cfg.vocab_size))
         for _ in range(cfg.num_layers):
-            lk = iter(jax.random.split(next(keys), 8))
+            lk = iter(jax.random.split(next(keys), 9))
             layer = {
                 "input_norm": jnp.ones((d,), dtype=cfg.dtype),
                 "post_attn_norm": jnp.ones((d,), dtype=cfg.dtype),
@@ -119,10 +119,24 @@ class LlamaForCausalLM:
                 "wk": dense(next(lk), (d, hkv * dh)),
                 "wv": dense(next(lk), (d, hkv * dh)),
                 "wo": dense(next(lk), (h * dh, d)),
-                "w_gate": dense(next(lk), (d, f)),
-                "w_up": dense(next(lk), (d, f)),
-                "w_down": dense(next(lk), (f, d)),
             }
+            if cfg.num_experts > 0:
+                e = cfg.num_experts
+
+                def stacked(key, shape, fan_in):
+                    return (
+                        jax.random.normal(key, shape, dtype=jnp.float32)
+                        / (fan_in**0.5)
+                    ).astype(cfg.dtype)
+
+                layer["router"] = dense(next(lk), (d, e)).astype(jnp.float32)
+                layer["experts_gate"] = stacked(next(lk), (e, d, f), d)
+                layer["experts_up"] = stacked(next(lk), (e, d, f), d)
+                layer["experts_down"] = stacked(next(lk), (e, f, d), f)
+            else:
+                layer["w_gate"] = dense(next(lk), (d, f))
+                layer["w_up"] = dense(next(lk), (d, f))
+                layer["w_down"] = dense(next(lk), (f, d))
             if cfg.attention_bias:
                 layer["bq"] = jnp.zeros((h * dh,), dtype=cfg.dtype)
                 layer["bk"] = jnp.zeros((hkv * dh,), dtype=cfg.dtype)
@@ -167,6 +181,8 @@ class LlamaForCausalLM:
         )
 
     def _mlp(self, layer: dict, x: jax.Array, dl=None) -> jax.Array:
+        if "router" in layer:
+            return self._moe_mlp(layer, x)
         gate = x @ layer["w_gate"]
         up = x @ layer["w_up"]
         if dl is not None:
@@ -177,6 +193,41 @@ class LlamaForCausalLM:
         if dl is not None:
             out = out + dl("down_proj", h)
         return out
+
+    def _moe_mlp(self, layer: dict, x: jax.Array) -> jax.Array:
+        """Mixtral-style sparse MoE block, dense-routed for jit stability.
+
+        Router picks top-k experts per token (softmax over router logits,
+        renormalised over the selected k, HF mixtral convention).  Every
+        expert runs on every token as one stacked einsum and non-selected
+        contributions are zeroed by the routing weights — no
+        data-dependent shapes, so XLA compiles one static program and
+        expert-parallel sharding is a plain psum over the expert axis
+        (parallel/sharding.py).  Compute cost is E/k × the ideal sparse
+        dispatch; acceptable at serving batch sizes, and the layout is
+        ready for a capacity-based ragged dispatch later.
+        """
+        cfg = self.config
+        k = cfg.num_experts_per_tok
+        num_experts = layer["router"].shape[1]
+
+        logits = x.astype(jnp.float32) @ layer["router"].astype(jnp.float32)
+        probs = jax.nn.softmax(logits, axis=-1)  # [T, E]
+        top_p, top_idx = jax.lax.top_k(probs, k)
+        top_p = top_p / jnp.sum(top_p, axis=-1, keepdims=True)
+        weights = jnp.sum(
+            jax.nn.one_hot(top_idx, num_experts, dtype=jnp.float32)
+            * top_p[..., None],
+            axis=1,
+        )  # [T, E] — zero for unselected experts
+
+        gate = jnp.einsum("td,edf->tef", x, layer["experts_gate"])
+        up = jnp.einsum("td,edf->tef", x, layer["experts_up"])
+        h = jax.nn.silu(gate) * up
+        out = jnp.einsum("tef,efd->ted", h, layer["experts_down"])
+        return jnp.sum(
+            out * weights[..., None].astype(out.dtype), axis=1
+        ).astype(x.dtype)
 
     def _embed(self, params: dict, token_ids: jax.Array) -> jax.Array:
         cfg = self.config

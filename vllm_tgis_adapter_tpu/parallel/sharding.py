@@ -38,7 +38,10 @@ def validate_tp_divisibility(config: "ModelConfig", tp: int) -> None:
         problems.append(f"num_heads={config.num_heads}")
     if config.num_kv_heads % tp:
         problems.append(f"num_kv_heads={config.num_kv_heads}")
-    if config.intermediate_size % tp:
+    expert_parallel = config.num_experts > 0 and config.num_experts % tp == 0
+    if not expert_parallel and config.intermediate_size % tp:
+        # MoE models whose expert count divides tp shard the EXPERT axis
+        # instead of the ffn dim, so the ffn constraint doesn't apply
         problems.append(f"intermediate_size={config.intermediate_size}")
     if config.vocab_size % tp:
         problems.append(f"vocab_size={config.vocab_size}")
@@ -62,16 +65,27 @@ _LAYER_SPECS = {
     "bq": P(TP_AXIS),
     "bk": P(TP_AXIS),
     "bv": P(TP_AXIS),
-    # mixtral-style MoE: experts stacked on axis 0, expert-parallel later;
-    # per-expert ffn dims follow the dense rules on their trailing axes
     "router": P(None, None),
+}
+
+# mixtral MoE expert stacks [E, ...]: EXPERT-parallel when tp divides E
+# (each shard computes its local experts over all tokens; the dense
+# routing sum becomes a psum the partitioner merges with the layer's
+# existing output all-reduce), else Megatron-style within-expert ffn
+# sharding on the trailing dims
+_EXPERT_EP_SPECS = {
+    "experts_gate": P(TP_AXIS, None, None),
+    "experts_up": P(TP_AXIS, None, None),
+    "experts_down": P(TP_AXIS, None, None),
+}
+_EXPERT_FFN_SPECS = {
     "experts_gate": P(None, None, TP_AXIS),
     "experts_up": P(None, None, TP_AXIS),
     "experts_down": P(None, TP_AXIS, None),
 }
 
 
-def llama_param_specs(params: dict) -> dict:
+def llama_param_specs(params: dict, tp: int = 1) -> dict:
     """PartitionSpec pytree matching models/llama.py's param layout."""
     specs: dict = {
         "embed": P(TP_AXIS, None),
@@ -79,10 +93,19 @@ def llama_param_specs(params: dict) -> dict:
     }
     if "lm_head" in params:
         specs["lm_head"] = P(None, TP_AXIS)
-    specs["layers"] = [
-        {name: _LAYER_SPECS[name] for name in layer}
-        for layer in params["layers"]
-    ]
+
+    def layer_spec(layer: dict) -> dict:
+        expert_specs = _EXPERT_FFN_SPECS
+        if "experts_gate" in layer:
+            num_experts = layer["experts_gate"].shape[0]
+            if tp > 1 and num_experts % tp == 0:
+                expert_specs = _EXPERT_EP_SPECS
+        return {
+            name: expert_specs.get(name) or _LAYER_SPECS[name]
+            for name in layer
+        }
+
+    specs["layers"] = [layer_spec(layer) for layer in params["layers"]]
     return specs
 
 
@@ -93,7 +116,7 @@ def shard_llama_params(mesh: Mesh, params: dict) -> dict:
     ``specs`` are passed through whole — they are never flattened even
     though PartitionSpec subclasses tuple.)
     """
-    specs = llama_param_specs(params)
+    specs = llama_param_specs(params, tp=mesh.shape[TP_AXIS])
     return jax.tree.map(
         lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)),
         params,
@@ -116,6 +139,14 @@ _HF_NAME_SPECS = (
     ("q_proj.bias", P(TP_AXIS)),
     ("k_proj.bias", P(TP_AXIS)),
     ("v_proj.bias", P(TP_AXIS)),
+    # mixtral per-expert FFNs (w1=gate, w3=up: column-parallel; w2=down:
+    # row-parallel after the loader's transpose).  Sharding each expert
+    # tensor as it is read keeps the anti-OOM invariant for the model
+    # family with the LARGEST weights; shard_llama_params may later
+    # redistribute the stacked [E, ...] arrays onto the expert axis (EP)
+    ("w1.weight", P(None, TP_AXIS)),
+    ("w3.weight", P(None, TP_AXIS)),
+    ("w2.weight", P(TP_AXIS, None)),
     ("norm.weight", P(None)),
     ("layernorm.weight", P(None)),
 )
