@@ -152,3 +152,59 @@ def test_version(http_base_url):
     status, body = _get(f"{http_base_url}/version")
     assert status == 200
     assert "version" in json.loads(body)
+
+
+def test_chat_completions(http_base_url):
+    _, raw = _post_json(
+        f"{http_base_url}/v1/chat/completions",
+        {
+            "messages": [
+                {"role": "system", "content": "You are terse."},
+                {"role": "user", "content": "say something"},
+            ],
+            "max_tokens": 6,
+            "temperature": 0,
+        },
+    )
+    resp = json.loads(raw)
+    assert resp["object"] == "chat.completion"
+    choice = resp["choices"][0]
+    assert choice["message"]["role"] == "assistant"
+    assert isinstance(choice["message"]["content"], str)
+    assert resp["usage"]["completion_tokens"] == 6
+    assert resp["usage"]["total_tokens"] == (
+        resp["usage"]["prompt_tokens"] + 6
+    )
+
+
+def test_chat_completions_stream(http_base_url):
+    _, raw = _post_json(
+        f"{http_base_url}/v1/chat/completions",
+        {
+            "messages": [{"role": "user", "content": "hi"}],
+            "max_tokens": 5,
+            "temperature": 0,
+            "stream": True,
+        },
+    )
+    lines = [
+        ln for ln in raw.decode().splitlines() if ln.startswith("data: ")
+    ]
+    assert lines[-1] == "data: [DONE]"
+    chunks = [json.loads(ln[6:]) for ln in lines[:-1]]
+    assert chunks[0]["choices"][0]["delta"]["role"] == "assistant"
+    text = "".join(
+        c["choices"][0]["delta"].get("content", "") for c in chunks
+    )
+    assert text  # streamed some content
+    assert chunks[-1]["choices"][0]["finish_reason"] in ("length", "stop")
+
+
+def test_chat_completions_validation(http_base_url):
+    for bad in ({"messages": "not a list"}, {"messages": []},
+                {"messages": [{"role": "user", "content": "x"}], "n": 2}):
+        try:
+            _post_json(f"{http_base_url}/v1/chat/completions", bad)
+            raise AssertionError(f"expected 400 for {bad}")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400

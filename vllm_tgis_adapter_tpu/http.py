@@ -140,6 +140,7 @@ def build_http_server(args: "argparse.Namespace", engine: "AsyncLLMEngine") -> A
     app.route("GET", "/version")(_version)
     app.route("GET", "/v1/models")(_models)
     app.route("POST", "/v1/completions")(_completions)
+    app.route("POST", "/v1/chat/completions")(_chat_completions)
     return app
 
 
@@ -216,24 +217,41 @@ def _completion_sampling_params(body: dict[str, Any]) -> SamplingParams:
     return SamplingParams(**params)
 
 
-async def _completions(app: App, request: HttpRequest):  # noqa: ANN201, C901, PLR0915
-    engine: AsyncLLMEngine = app.state["engine"]
+def _openai_preamble(app: App, request: HttpRequest):
+    """Auth + body parse + model lookup shared by the OpenAI endpoints.
+
+    Returns (body, model_name, None) on success or (None, None, error
+    response) — one implementation so an auth or validation fix can
+    never land on one endpoint and miss the other.
+    """
     if (key := app.state.get("api_key")) and request.headers.get(
         "authorization"
     ) != f"Bearer {key}":
-        return error_response(401, "invalid api key", "authentication_error")
+        return None, None, error_response(
+            401, "invalid api key", "authentication_error"
+        )
     try:
         body = request.json()
     except json.JSONDecodeError as e:
-        return error_response(400, f"invalid JSON body: {e}")
+        return None, None, error_response(400, f"invalid JSON body: {e}")
+    model_name = body.get("model") or app.state["model_names"][0]
+    if model_name not in app.state["model_names"]:
+        return None, None, error_response(
+            404, f"model {model_name!r} does not exist"
+        )
+    return body, model_name, None
+
+
+async def _completions(app: App, request: HttpRequest):  # noqa: ANN201, C901, PLR0915
+    engine: AsyncLLMEngine = app.state["engine"]
+    body, model_name, err = _openai_preamble(app, request)
+    if err is not None:
+        return err
 
     prompt = body.get("prompt", "")
     prompts = prompt if isinstance(prompt, list) else [prompt]
     if not prompts or not all(isinstance(p, str) for p in prompts):
         return error_response(400, "prompt must be a string or list of strings")
-    model_name = body.get("model") or app.state["model_names"][0]
-    if model_name not in app.state["model_names"]:
-        return error_response(404, f"model {model_name!r} does not exist")
     try:
         sampling_params = _completion_sampling_params(body)
     except (ValueError, TypeError) as e:
@@ -333,6 +351,149 @@ async def _completions(app: App, request: HttpRequest):  # noqa: ANN201, C901, P
             },
         }
     )
+
+
+def _render_chat_prompt(tokenizer, messages: list[dict]) -> str:  # noqa: ANN001
+    """messages → prompt text via the model's chat template.
+
+    Models without a bundled template get a minimal role-prefixed layout
+    (same fallback stance as serving stacks that accept template-less
+    models rather than rejecting chat outright).
+    """
+    if getattr(tokenizer, "chat_template", None):
+        return tokenizer.apply_chat_template(
+            messages, tokenize=False, add_generation_prompt=True
+        )
+    lines = [f"{m['role']}: {m['content']}" for m in messages]
+    return "\n".join(lines) + "\nassistant:"
+
+
+async def _chat_completions(app: App, request: HttpRequest):  # noqa: ANN201, C901
+    """OpenAI chat API over the shared engine (reference parity: the
+    embedded vLLM app serves chat from the same engine as completions)."""
+    engine: AsyncLLMEngine = app.state["engine"]
+    body, model_name, err = _openai_preamble(app, request)
+    if err is not None:
+        return err
+
+    messages = body.get("messages")
+    if (
+        not isinstance(messages, list)
+        or not messages
+        or not all(
+            isinstance(m, dict) and isinstance(m.get("content"), str)
+            and m.get("role")
+            for m in messages
+        )
+    ):
+        return error_response(
+            400, "messages must be a non-empty list of {role, content} "
+                 "objects"
+        )
+    if int(body.get("n", 1)) != 1:
+        return error_response(400, "n > 1 is not supported")
+    if body.get("logprobs"):
+        return error_response(
+            400, "logprobs is not supported on the chat endpoint"
+        )
+
+    tokenizer = engine.engine.get_tokenizer()
+    try:
+        prompt = _render_chat_prompt(tokenizer, messages)
+    except Exception as e:  # noqa: BLE001 — template errors are client input
+        return error_response(400, f"chat template rejected messages: {e}")
+
+    if "max_tokens" not in body and "max_completion_tokens" in body:
+        body = {**body, "max_tokens": body["max_completion_tokens"]}
+    if "max_tokens" not in body:
+        # chat clients rarely set a budget; default to the remaining
+        # context (the vLLM chat server's behavior) instead of the
+        # completions endpoint's OpenAI-compat default of 16
+        config = await engine.get_model_config()
+        n_prompt = len(tokenizer(prompt).input_ids)
+        body = {
+            **body,
+            "max_tokens": max(1, config.max_model_len - n_prompt - 1),
+        }
+    try:
+        sampling_params = _completion_sampling_params(body)
+    except (ValueError, TypeError) as e:
+        return error_response(400, str(e))
+
+    stream = bool(body.get("stream", False))
+    base_request_id = uuid.uuid4().hex
+    created = int(time.time())
+    chat_id = f"chatcmpl-{base_request_id}"
+    logs.set_correlation_id(
+        base_request_id, request.headers.get(CORRELATION_ID_HEADER)
+    )
+    sampling_params.output_kind = (
+        RequestOutputKind.DELTA if stream else RequestOutputKind.FINAL_ONLY
+    )
+    generator = engine.generate(
+        prompt=prompt,
+        sampling_params=sampling_params,
+        request_id=f"chat-{base_request_id}-0",
+    )
+
+    if stream:
+
+        async def sse() -> AsyncIterator[bytes]:
+            def chunk(delta: dict, finish: Optional[str]) -> bytes:
+                payload = {
+                    "id": chat_id,
+                    "object": "chat.completion.chunk",
+                    "created": created,
+                    "model": model_name,
+                    "choices": [{
+                        "index": 0,
+                        "delta": delta,
+                        "finish_reason": finish,
+                    }],
+                }
+                return f"data: {json.dumps(payload)}\n\n".encode()
+
+            yield chunk({"role": "assistant", "content": ""}, None)
+            try:
+                async for res in generator:
+                    out = res.outputs[0]
+                    if out.text:
+                        yield chunk({"content": out.text}, None)
+                    if out.finish_reason:
+                        yield chunk({}, out.finish_reason)
+            except Exception as e:  # noqa: BLE001 — cancellation propagates
+                err = {"error": {"message": str(e), "type": "server_error"}}
+                yield f"data: {json.dumps(err)}\n\n".encode()
+            yield b"data: [DONE]\n\n"
+
+        return StreamingResponse(sse())
+
+    final = None
+    try:
+        async for res in generator:
+            final = res
+    except ValueError as e:
+        return error_response(400, str(e))
+    out = final.outputs[0]
+    n_prompt = len(final.prompt_token_ids or ())
+    n_out = len(out.token_ids)
+    return JsonResponse({
+        "id": chat_id,
+        "object": "chat.completion",
+        "created": created,
+        "model": model_name,
+        "choices": [{
+            "index": 0,
+            "message": {"role": "assistant", "content": out.text},
+            "finish_reason": out.finish_reason,
+            "stop_reason": out.stop_reason,
+        }],
+        "usage": {
+            "prompt_tokens": n_prompt,
+            "completion_tokens": n_out,
+            "total_tokens": n_prompt + n_out,
+        },
+    })
 
 
 def _convert_http_logprobs(out, engine) -> Optional[dict]:  # noqa: ANN001
