@@ -202,3 +202,90 @@ def test_pallas_kernels_under_tp_mesh(monkeypatch):
     np.testing.assert_allclose(np.asarray(got_p)[:valid],
                                np.asarray(ref_p)[:valid],
                                rtol=2e-5, atol=2e-5)
+
+
+# ------------------------------------------------------------ chunked prefill
+
+
+def make_chunk_case(seed, t, valid, start, num_kv, g, head_dim, block_size,
+                    dtype=np.float32):
+    """A chunk of queries + a cache holding start+valid tokens of context
+    at the block table's pages (rest of the cache is noise)."""
+    rng = np.random.default_rng(seed)
+    total = start + valid
+    max_blocks = -(-max(total, 1) // block_size) + 2
+    num_slots = max(512, (max_blocks + 4) * block_size)
+    q = rng.standard_normal((t, num_kv * g, head_dim)).astype(dtype)
+    k_cache = rng.standard_normal((num_kv, num_slots, head_dim)).astype(dtype)
+    v_cache = rng.standard_normal((num_kv, num_slots, head_dim)).astype(dtype)
+    table = rng.permutation(num_slots // block_size)[:max_blocks].astype(
+        np.int32
+    )
+    return q, k_cache, v_cache, table
+
+
+@pytest.mark.parametrize("t,valid,start", [
+    (64, 64, 128),   # full chunk, deep context
+    (64, 33, 48),    # ragged chunk, unaligned start
+    (128, 100, 0),   # first chunk (no prior context)
+    (32, 32, 7),     # start not page-aligned
+])
+@pytest.mark.parametrize("g", [1, 4])
+def test_chunked_prefill_kernel_matches_decode_formulation(t, valid, start, g):
+    num_kv, head_dim, block_size = 2, 64, 16
+    q, kc, vc, table = make_chunk_case(
+        t + valid + start, t, valid, start, num_kv, g, head_dim, block_size
+    )
+    scale = head_dim**-0.5
+
+    # ground truth: each query as a decode row with context pos+1
+    local = np.arange(t)
+    positions = start + local
+    ctx = np.where(local < valid, positions + 1, 1).astype(np.int32)
+    tables = np.broadcast_to(table[None, :], (t, table.shape[0]))
+    ref = ref_ops.paged_decode_attention_xla(
+        jnp.asarray(q), jnp.asarray(kc), jnp.asarray(vc),
+        jnp.asarray(tables), jnp.asarray(ctx), block_size, scale,
+    )
+
+    got = pk.chunked_prefill_attention(
+        jnp.asarray(q), jnp.asarray(kc), jnp.asarray(vc),
+        jnp.asarray(table), jnp.asarray(start, jnp.int32),
+        jnp.asarray(valid, jnp.int32), block_size, scale,
+        block_q=32, interpret=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(got)[:valid], np.asarray(ref)[:valid],
+        rtol=2e-5, atol=2e-5,
+    )
+
+
+def test_chunked_prefill_dispatch_under_tp_mesh(monkeypatch):
+    """shard_map-wrapped chunk kernel over the head-sharded mesh matches
+    the unsharded fallback."""
+    from vllm_tgis_adapter_tpu.ops import attention as attn
+    from vllm_tgis_adapter_tpu.parallel import build_mesh
+
+    num_kv, g, head_dim, block_size = 4, 2, 64, 16
+    t, valid, start = 64, 50, 32
+    q, kc, vc, table = make_chunk_case(
+        9, t, valid, start, num_kv, g, head_dim, block_size
+    )
+    scale = head_dim**-0.5
+    monkeypatch.setenv("ATTENTION_BACKEND", "xla")
+    ref = attn.chunked_prefill_attention(
+        jnp.asarray(q), jnp.asarray(kc), jnp.asarray(vc),
+        jnp.asarray(table), jnp.asarray(start), jnp.asarray(valid),
+        block_size, scale,
+    )
+    monkeypatch.setenv("ATTENTION_BACKEND", "pallas")
+    mesh = build_mesh(tensor_parallel_size=4)
+    got = attn.chunked_prefill_attention(
+        jnp.asarray(q), jnp.asarray(kc), jnp.asarray(vc),
+        jnp.asarray(table), jnp.asarray(start), jnp.asarray(valid),
+        block_size, scale, mesh=mesh,
+    )
+    np.testing.assert_allclose(
+        np.asarray(got)[:valid], np.asarray(ref)[:valid],
+        rtol=2e-5, atol=2e-5,
+    )

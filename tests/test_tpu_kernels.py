@@ -90,3 +90,40 @@ def test_prefill_kernel_compiles_and_matches(
         np.asarray(ref, np.float32)[:valid],
         rtol=tol, atol=tol,
     )
+
+
+@pytest.mark.parametrize(
+    "t,valid,start,num_kv,g,head_dim,block_size,dtype",
+    [
+        (256, 256, 1024, 8, 4, 128, 16, jnp.bfloat16),  # llama-8B chunk
+        (64, 50, 48, 2, 4, 64, 16, jnp.float32),
+    ],
+)
+def test_chunked_prefill_kernel_compiles_and_matches(
+    t, valid, start, num_kv, g, head_dim, block_size, dtype
+):
+    from tests.test_pallas_attention import make_chunk_case
+
+    q, kc, vc, table = make_chunk_case(
+        1, t, valid, start, num_kv, g, head_dim, block_size,
+        dtype=np.float32,
+    )
+    q, kc, vc = (jnp.asarray(x, dtype) for x in (q, kc, vc))
+    scale = head_dim**-0.5
+    got = pk.chunked_prefill_attention(
+        q, kc, vc, jnp.asarray(table), jnp.asarray(start, jnp.int32),
+        jnp.asarray(valid, jnp.int32), block_size, scale,
+    )
+    got.block_until_ready()  # Mosaic compile + execute
+    local = np.arange(t)
+    ctx = np.where(local < valid, start + local + 1, 1).astype(np.int32)
+    tables = np.broadcast_to(table[None, :], (t, table.shape[0]))
+    ref = ref_ops.paged_decode_attention_xla(
+        q, kc, vc, jnp.asarray(tables), jnp.asarray(ctx), block_size, scale
+    )
+    tol = 2e-2 if dtype == jnp.bfloat16 else 5e-3
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32)[:valid],
+        np.asarray(ref, np.float32)[:valid],
+        rtol=tol, atol=tol,
+    )

@@ -326,13 +326,11 @@ class LlamaForCausalLM:
         """A non-first prefill chunk: queries attend to the chunk AND all
         earlier context already resident in the paged cache.
 
-        The chunk's K/V are scattered into the cache first, then attention
-        runs through the paged decode kernel with the chunk's T queries as
-        batch rows and per-row context lengths ``position + 1`` — exact
-        causal semantics over [0, start+T) with no new kernel and no
-        Mosaic-illegal shapes.  Bandwidth note: pages are re-read per query
-        row (T× the traffic of the fused flash path), which is why the
-        scheduler only produces chunks bounded by max_num_batched_tokens.
+        The chunk's K/V are scattered into the cache first, then the
+        chunk's queries attend over [0, start+T) through
+        ``ops.attention.chunked_prefill_attention`` — a dedicated Pallas
+        kernel on TPU (each context page read once per kv-head × query
+        block), the gather-based decode formulation elsewhere.
         """
         cfg = self.config
         k_cache, v_cache = caches
@@ -340,12 +338,9 @@ class LlamaForCausalLM:
         cos, sin = rotary_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
         safe_slots = jnp.where(slot_mapping < 0, k_cache.shape[2], slot_mapping)
 
-        t = token_ids.shape[0]
-        local = jnp.arange(t, dtype=jnp.int32)
-        # each real query sees everything up to and including itself;
-        # padding rows read one slot of page 0 and are discarded
-        ctx_lens = jnp.where(local < valid_len, positions + 1, 1)
-        tables = jnp.broadcast_to(block_table[None, :], (t, block_table.shape[0]))
+        # the chunk's first global position; padding rows (beyond
+        # valid_len) produce garbage the caller discards
+        start = positions[0]
 
         x = self._embed(params, token_ids)
         for i, layer in enumerate(params["layers"]):
@@ -366,8 +361,8 @@ class LlamaForCausalLM:
             v_cache = v_cache.at[i, :, safe_slots].set(
                 v.astype(v_cache.dtype), mode="drop"
             )
-            o = attn_ops.paged_decode_attention(
-                q, k_cache[i], v_cache[i], tables, ctx_lens,
+            o = attn_ops.chunked_prefill_attention(
+                q, k_cache[i], v_cache[i], block_table, start, valid_len,
                 block_size, scale, mesh=self.mesh,
             )
             o_flat = o.reshape(x.shape[0], -1)

@@ -211,6 +211,61 @@ def paged_decode_attention(
     )
 
 
+def chunked_prefill_attention(
+    q: jax.Array,  # [T, H, Dh] one chunk's queries
+    k_cache: jax.Array,  # [Hkv, num_slots, Dh]
+    v_cache: jax.Array,
+    block_table: jax.Array,  # [max_blocks] this sequence's page table
+    start_pos: jax.Array,  # scalar: context tokens before this chunk
+    valid_len: jax.Array,  # scalar: real tokens in the chunk
+    block_size: int,
+    scale: float,
+    mesh=None,
+) -> jax.Array:
+    """Causal chunk-vs-paged-context attention (the chunked-prefill and
+    prefix-cache-resume hot path).
+
+    TPU: dedicated Pallas kernel — each context page is read once per
+    (kv head, query block) instead of once per query token.  Fallback:
+    the decode formulation (each query as a batch row with its own
+    context length), which is what the kernel's numerics are pinned to.
+    """
+    if _use_pallas():
+        from vllm_tgis_adapter_tpu.ops import pallas_attention
+
+        kernel = functools.partial(
+            pallas_attention.chunked_prefill_attention,
+            block_size=block_size,
+            scale=scale,
+            interpret=_pallas_interpret(),
+        )
+        if mesh is not None:
+            from jax.sharding import PartitionSpec as P
+
+            heads = P(None, "tp", None)
+            cache = P("tp", None, None)
+            return shard_map(
+                kernel,
+                mesh=mesh,
+                in_specs=(heads, cache, cache, P(), P(), P()),
+                out_specs=heads,
+                check_vma=False,
+            )(q, k_cache, v_cache, block_table,
+              jnp.asarray(start_pos, jnp.int32),
+              jnp.asarray(valid_len, jnp.int32))
+        return kernel(q, k_cache, v_cache, block_table, start_pos, valid_len)
+    # XLA fallback: every chunk query becomes a decode row with context
+    # length position+1 (exact same semantics, gather-based)
+    t = q.shape[0]
+    local = jnp.arange(t, dtype=jnp.int32)
+    positions = jnp.asarray(start_pos, jnp.int32) + local
+    ctx_lens = jnp.where(local < valid_len, positions + 1, 1)
+    tables = jnp.broadcast_to(block_table[None, :], (t, block_table.shape[0]))
+    return paged_decode_attention_xla(
+        q, k_cache, v_cache, tables, ctx_lens, block_size, scale
+    )
+
+
 def paged_decode_attention_xla(
     q: jax.Array,  # [B, H, Dh]
     k_cache: jax.Array,  # [Hkv, num_slots, Dh] head-leading

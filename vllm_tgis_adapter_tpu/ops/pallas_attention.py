@@ -180,6 +180,186 @@ def paged_decode_attention(
     return out.reshape(b, num_heads, head_dim)
 
 
+# ------------------------------------------------------------ chunked prefill
+
+
+def _chunk_kernel(
+    # scalar prefetch
+    block_table_ref,  # [max_blocks] SMEM — this sequence's page table
+    meta_ref,  # [2] SMEM: (start_pos, valid_len)
+    # blocks
+    q_ref,  # [1, G*bq, Dh] VMEM (query block iq of kv head h)
+    k_ref,  # [1, block_size, Dh] VMEM — page picked by index_map
+    v_ref,  # [1, block_size, Dh]
+    o_ref,  # [1, G*bq, Dh]
+    # scratch
+    m_ref,  # [G*bq, 1] f32
+    l_ref,  # [G*bq, 1] f32
+    acc_ref,  # [G*bq, Dh] f32
+    *,
+    scale: float,
+    block_size: int,
+    block_q: int,
+    g: int,
+):
+    iq = pl.program_id(1)
+    j = pl.program_id(2)
+    last = pl.num_programs(2) - 1
+    start = meta_ref[0]
+    valid = meta_ref[1]
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    # the page is live when it starts at or before the LAST query of this
+    # block (causality) and holds real context
+    q_hi = start + iq * block_q + block_q - 1
+    @pl.when((j * block_size <= q_hi) & (j * block_size < start + valid))
+    def _page():
+        q = q_ref[0].astype(jnp.float32)  # [G*bq, Dh]
+        k = k_ref[0].astype(jnp.float32)  # [bs, Dh]
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [G*bq, bs]
+
+        # rows are (g, i) flattened row-major: query index i = row % bq
+        row = jax.lax.broadcasted_iota(jnp.int32, s.shape, dimension=0)
+        q_pos = start + iq * block_q + row % block_q
+        k_pos = j * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, dimension=1
+        )
+        mask = (k_pos <= q_pos) & (k_pos < start + valid)
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        # fully masked rows (padding queries) keep m == -inf; pin the
+        # shift to a finite value so exp() stays NaN-free
+        shift = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(s - shift)
+        alpha = jnp.exp(jnp.where(jnp.isfinite(m_prev), m_prev, shift) - shift)
+        l_ref[...] = alpha * l_ref[...] + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = alpha * acc_ref[...] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[...] = m_new
+
+    @pl.when(j == last)
+    def _finalize():
+        denom = jnp.maximum(l_ref[...], 1e-30)
+        o_ref[0] = (acc_ref[...] / denom).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("block_size", "scale", "block_q", "interpret"),
+)
+def chunked_prefill_attention(
+    q: jax.Array,  # [T, H, Dh] one chunk's queries (padded bucket)
+    k_cache: jax.Array,  # [Hkv, num_slots, Dh] head-leading paged cache
+    v_cache: jax.Array,
+    block_table: jax.Array,  # [max_blocks] int32, this sequence's pages
+    start_pos: jax.Array,  # scalar: tokens already in cache before chunk
+    valid_len: jax.Array,  # scalar: real tokens in this chunk
+    block_size: int,
+    scale: float,
+    *,
+    block_q: int = 128,
+    interpret: bool = False,
+) -> jax.Array:
+    """Causal attention of one prompt chunk against its paged context.
+
+    The chunk's own K/V must already be scattered into the cache.  Every
+    page is DMA'd ONCE per (kv head, query block) and its read is shared
+    by all ``G × block_q`` query rows — versus the decode-kernel
+    formulation of this computation, which re-reads the page for every
+    individual query token (T× the HBM traffic).  Causality is the
+    logical page index j: the j-th table entry covers sequence positions
+    [j·bs, (j+1)·bs), so the mask needs no gather.
+    """
+    t, num_heads, head_dim = q.shape
+    num_kv = k_cache.shape[0]
+    g = num_heads // num_kv
+    max_blocks = block_table.shape[0]
+    block_q = min(block_q, t)
+    nq = pl.cdiv(t, block_q)
+    t_pad = nq * block_q
+
+    # [Hkv, nq·G·bq, Dh] with each q block laid out (G, bq) row-major:
+    # kv head outermost so one page read serves the head's whole GQA
+    # group × the query block
+    qp = jnp.pad(q, ((0, t_pad - t), (0, 0), (0, 0)))
+    qh = jnp.transpose(
+        qp.reshape(nq, block_q, num_kv, g, head_dim), (2, 0, 3, 1, 4)
+    ).reshape(num_kv, nq * g * block_q, head_dim)
+
+    safe_table = jnp.clip(block_table, 0, k_cache.shape[1] // block_size - 1)
+
+    def page_index(h, iq, j, bt, meta):
+        # clamp steps past this q block's causal horizon to the last live
+        # page: consecutive identical indices elide the DMA entirely
+        last_needed = jnp.minimum(
+            (meta[0] + iq * block_q + block_q - 1) // block_size,
+            jnp.maximum(meta[0] + meta[1] - 1, 0) // block_size,
+        )
+        return bt[jnp.clip(jnp.minimum(j, last_needed), 0, None)]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(num_kv, nq, max_blocks),
+        in_specs=[
+            pl.BlockSpec(
+                (1, g * block_q, head_dim),
+                lambda h, iq, j, bt, meta: (h, iq, 0),
+            ),
+            pl.BlockSpec(
+                (1, block_size, head_dim),
+                lambda h, iq, j, bt, meta: (
+                    h, page_index(h, iq, j, bt, meta), 0
+                ),
+            ),
+            pl.BlockSpec(
+                (1, block_size, head_dim),
+                lambda h, iq, j, bt, meta: (
+                    h, page_index(h, iq, j, bt, meta), 0
+                ),
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, g * block_q, head_dim),
+            lambda h, iq, j, bt, meta: (h, iq, 0),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((g * block_q, 1), jnp.float32),
+            pltpu.VMEM((g * block_q, 1), jnp.float32),
+            pltpu.VMEM((g * block_q, head_dim), jnp.float32),
+        ],
+    )
+    meta = jnp.stack([
+        jnp.asarray(start_pos, jnp.int32), jnp.asarray(valid_len, jnp.int32)
+    ])
+    out = pl.pallas_call(
+        functools.partial(
+            _chunk_kernel, scale=scale, block_size=block_size,
+            block_q=block_q, g=g,
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(
+            (num_kv, nq * g * block_q, head_dim), q.dtype
+        ),
+        interpret=interpret,
+    )(safe_table, meta, qh, k_cache, v_cache)
+    return jnp.transpose(
+        out.reshape(num_kv, nq, g, block_q, head_dim), (1, 3, 0, 2, 4)
+    ).reshape(t_pad, num_heads, head_dim)[:t]
+
+
 # -------------------------------------------------------------------- prefill
 
 
