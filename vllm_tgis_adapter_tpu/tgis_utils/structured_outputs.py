@@ -1,47 +1,61 @@
 """Map the proto constrained-decoding oneof onto engine params.
 
-TPU-native analog of the reference mapping (tgis_utils/structured_outputs.py:
-14-38): the proto ``DecodingParameters.guided`` oneof becomes a
-``StructuredOutputsParams`` consumed by the engine's FSM-constrained sampler
-(ops/constrained.py) rather than a vLLM backend.
+TPU-native analog of the reference mapping
+(/root/reference/src/vllm_tgis_adapter/tgis_utils/structured_outputs.py:
+14-38): the ``DecodingParameters.guided`` oneof becomes a
+``StructuredOutputsParams`` consumed by the engine's FSM-constrained
+sampler (engine/constrained.py) rather than a vLLM backend.  The oneof
+field set is the wire contract; dispatch here is table-driven.
 """
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Callable, Optional
 
 from vllm_tgis_adapter_tpu.engine.sampling_params import StructuredOutputsParams
 from vllm_tgis_adapter_tpu.grpc.pb.generation_pb2 import DecodingParameters
 
 
+def _from_choice(decoding: DecodingParameters) -> StructuredOutputsParams:
+    options = list(decoding.choice.choices)
+    if len(options) < 2:
+        raise ValueError("Must provide at least two choices")
+    return StructuredOutputsParams(choice=options)
+
+
+def _from_grammar(decoding: DecodingParameters) -> StructuredOutputsParams:
+    # validate eagerly: a malformed grammar surfaces at request
+    # validation → INVALID_ARGUMENT, not as mid-stream engine death
+    from vllm_tgis_adapter_tpu.engine.constrained import grammar_to_ast
+
+    grammar_to_ast(decoding.grammar)
+    return StructuredOutputsParams(grammar=decoding.grammar)
+
+
+def _from_format(decoding: DecodingParameters) -> StructuredOutputsParams:
+    if decoding.format == DecodingParameters.JSON:
+        return StructuredOutputsParams(json_object=True)
+    raise ValueError("format")
+
+
+_ONEOF_BUILDERS: dict[
+    str, Callable[[DecodingParameters], StructuredOutputsParams]
+] = {
+    "format": _from_format,
+    "json_schema": lambda d: StructuredOutputsParams(json=d.json_schema),
+    "regex": lambda d: StructuredOutputsParams(regex=d.regex),
+    "choice": _from_choice,
+    "grammar": _from_grammar,
+}
+
+
 def get_structured_output_params(
     decoding_params: DecodingParameters,
 ) -> Optional[StructuredOutputsParams]:
-    guided = decoding_params.WhichOneof("guided")
-    if not guided:
+    which = decoding_params.WhichOneof("guided")
+    if which is None:
         return None
-
-    if guided == "json_schema":
-        return StructuredOutputsParams(json=decoding_params.json_schema)
-
-    if guided == "regex":
-        return StructuredOutputsParams(regex=decoding_params.regex)
-
-    if guided == "choice":
-        choice_list = decoding_params.choice.choices
-        if len(choice_list) < 2:
-            raise ValueError("Must provide at least two choices")
-        return StructuredOutputsParams(choice=list(choice_list))
-
-    if guided == "grammar":
-        # validate eagerly: a malformed grammar surfaces at request
-        # validation → INVALID_ARGUMENT, not as mid-stream engine death
-        from vllm_tgis_adapter_tpu.engine.constrained import grammar_to_ast
-
-        grammar_to_ast(decoding_params.grammar)
-        return StructuredOutputsParams(grammar=decoding_params.grammar)
-
-    if decoding_params.format == DecodingParameters.JSON:
-        return StructuredOutputsParams(json_object=True)
-
-    raise ValueError(guided)
+    build = _ONEOF_BUILDERS.get(which)
+    if build is None:
+        raise ValueError(which)
+    return build(decoding_params)
