@@ -466,3 +466,71 @@ def test_abort_lands_mid_dispatch():
     # and the stream terminated with an aborted final output
     assert outs and outs[-1].finished
     assert outs[-1].outputs[0].finish_reason == "abort"
+
+
+def test_stats_logging_loop(tiny_model_dir, caplog):
+    """--disable-log-stats gates a real periodic stats line (the flag was
+    previously a facade: parsed, never consumed)."""
+    import logging as _logging
+
+    from vllm_tgis_adapter_tpu.engine.async_llm import AsyncLLMEngine
+    from vllm_tgis_adapter_tpu.engine.config import (
+        CacheConfig,
+        EngineConfig,
+        LoRAConfig,
+        ModelConfig,
+        ParallelConfig,
+        SchedulerConfig,
+    )
+    from vllm_tgis_adapter_tpu.engine.sampling_params import SamplingParams
+
+    mcfg = ModelConfig.from_pretrained(tiny_model_dir, dtype="float32")
+    config = EngineConfig(
+        model_config=mcfg,
+        cache_config=CacheConfig(block_size=16, num_blocks=32,
+                                 cache_dtype=mcfg.dtype),
+        scheduler_config=SchedulerConfig(max_num_seqs=2,
+                                         prefill_buckets=(32,)),
+        parallel_config=ParallelConfig(),
+        lora_config=LoRAConfig(),
+    )
+    engine = AsyncLLMEngine.from_config(config)
+    engine.STATS_INTERVAL_S = 0.05
+
+    # hold each dispatch long enough that the generation is guaranteed to
+    # span several stats ticks (a warm compile cache could otherwise
+    # finish all 24 tokens before the first 50ms tick)
+    import time as _time
+
+    inner_execute = engine.engine.execute_step
+
+    def slow_execute(plan, prepared):
+        _time.sleep(0.08)
+        return inner_execute(plan, prepared)
+
+    engine.engine.execute_step = slow_execute
+
+    async def scenario():
+        async for _ in engine.generate(
+            prompt=None,
+            sampling_params=SamplingParams(temperature=0.0, max_tokens=24,
+                                           ignore_eos=True),
+            request_id="s1",
+            prompt_token_ids=list(range(3, 10)),
+        ):
+            pass
+        await asyncio.sleep(0.2)  # one more tick after going idle
+        await engine.stop()
+
+    # the package logger doesn't propagate (own dictConfig); route it to
+    # the root for capture
+    root_logger = _logging.getLogger("vllm_tgis_adapter_tpu")
+    root_logger.propagate = True
+    try:
+        with caplog.at_level(_logging.INFO):
+            asyncio.run(scenario())
+    finally:
+        root_logger.propagate = False
+    lines = [r.message for r in caplog.records if "Engine stats" in r.message]
+    assert lines, "no stats line was emitted"
+    assert "KV pages" in lines[0]

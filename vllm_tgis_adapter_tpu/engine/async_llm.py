@@ -42,6 +42,9 @@ class AsyncLLMEngine:
         # serializes engine-state mutations (add/abort) against the step
         # running in the worker thread — scheduler state is not thread-safe
         self._engine_lock = asyncio.Lock()
+        # periodic operational stats line (vLLM-style), unless
+        # --disable-log-stats
+        self._stats_task: Optional[asyncio.Task] = None
         # one server span per request when --otlp-traces-endpoint is set
         self._tracer = None
         endpoint = engine.config.otlp_traces_endpoint
@@ -56,15 +59,26 @@ class AsyncLLMEngine:
     def from_config(cls, config: EngineConfig) -> "AsyncLLMEngine":
         return cls(LLMEngine.from_config(config))
 
+    STATS_INTERVAL_S = 10.0
+
     async def start(self) -> None:
         if self._loop_task is None:
             self._loop_task = asyncio.create_task(
                 self._run_loop(), name="engine-step-loop"
             )
+        if self._stats_task is None and not (
+            self.engine.config.disable_log_stats
+        ):
+            self._stats_task = asyncio.create_task(
+                self._log_stats_loop(), name="engine-stats-loop"
+            )
 
     async def stop(self) -> None:
         self._stopped = True
         self._new_work.set()
+        if self._stats_task is not None:
+            self._stats_task.cancel()
+            self._stats_task = None
         if self._loop_task is not None:
             self._loop_task.cancel()
             try:
@@ -186,6 +200,39 @@ class AsyncLLMEngine:
         queue = self._queues.get(request_id)
         if queue is not None and out is not None:
             queue.put_nowait(out)
+
+    # ------------------------------------------------------------ stats loop
+
+    async def _log_stats_loop(self) -> None:
+        """One operational stats line every STATS_INTERVAL_S while work is
+        in flight (the --disable-log-stats flag's actual behavior)."""
+        was_active = False
+        while not self._stopped and not self.errored:
+            # a dead engine must not keep reporting "running: N" forever
+            await asyncio.sleep(self.STATS_INTERVAL_S)
+            if self.errored:
+                break
+            scheduler = self.engine.scheduler
+            active = self.engine.has_unfinished_requests()
+            if not active and not was_active:
+                continue  # idle: stay quiet until work arrives
+            was_active = active
+            allocator = scheduler.allocator
+            used = allocator.num_blocks - allocator.num_free
+            line = (
+                f"running: {len(scheduler.running)} reqs, "
+                f"waiting: {len(scheduler.waiting)} reqs, "
+                f"KV pages: {used}/{allocator.num_blocks} used"
+            )
+            if allocator.enable_prefix_caching:
+                line += f", prefix-cache hit tokens: {allocator.prefix_hits}"
+            spec = self.engine.runner.spec
+            if spec is not None and spec.stats.proposed:
+                line += (
+                    f", spec acceptance: "
+                    f"{100 * spec.stats.acceptance_rate:.1f}%"
+                )
+            logger.info("Engine stats: %s", line)
 
     # ------------------------------------------------------------- step loop
 

@@ -240,6 +240,7 @@ class EngineConfig:
     quantization: str | None = None
     otlp_traces_endpoint: str | None = None
     disable_log_requests: bool = True
+    disable_log_stats: bool = False
     speculative: "Optional[SpeculativeConfig]" = None
 
     @property
@@ -300,5 +301,6 @@ class EngineConfig:
             hbm_memory_utilization=args.hbm_memory_utilization,
             quantization=args.quantization,
             otlp_traces_endpoint=args.otlp_traces_endpoint,
+            disable_log_stats=getattr(args, "disable_log_stats", False),
             disable_log_requests=args.disable_log_requests,
         )
