@@ -9,10 +9,14 @@ Robustness contract (round-2, VERDICT #1): this script ALWAYS exits 0 and
 ALWAYS prints exactly one JSON line on stdout.  TPU backend availability
 is probed in a subprocess with a hard timeout — the round-1 run died
 inside in-process backend init (rc=1, no output), and the tunnel-backed
-plugin has also been observed to hang rather than fail.  If the probe
-fails or times out, the bench falls back to the CPU backend and reports
-the proxy number with a ``backend: cpu`` annotation; if the bench itself
-raises, the JSON line carries value 0 and the error.
+plugin has also been observed to hang rather than fail.  The TPU bench
+itself then ALSO runs in a bounded subprocess: the tunnel has been
+observed to die *mid-run* (round 3, 2026-07-30 — probe passed, kernels
+compiled, then a dispatch blocked forever with zero CPU progress), and
+only a process boundary can bound that.  Any TPU-side hang, crash, or
+zero score degrades to the CPU-backend proxy number with the TPU error
+annotated; if even that raises, the JSON line carries value 0 and the
+error.
 
 Proxy model (no network egress, 70B/8B checkpoints unavailable): a
 Llama-3.2-1B-shaped decoder with random weights and a 16k byte-level
@@ -34,13 +38,14 @@ every (prefill-bucket, batch-bucket) program is compiled before timing.
 
 Env knobs: BENCH_TINY=1 (CI smoke on CPU), BENCH_REQUESTS, BENCH_PROMPT,
 BENCH_OUTPUT, BENCH_BATCH, BENCH_STEPS, BENCH_PROBE_TIMEOUT (s),
-BENCH_FORCE_CPU=1.
+BENCH_TPU_TIMEOUT (s, whole TPU run incl. compiles), BENCH_FORCE_CPU=1.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import signal
 import subprocess
 import sys
 import time
@@ -241,9 +246,104 @@ def run_bench(on_tpu: bool) -> dict:
     }
 
 
-def main() -> None:
-    on_tpu = False
+def _tpu_child() -> None:
+    """Entire TPU bench in a throwaway process (parent bounds its wall
+    time).  Prints one JSON line on success; any failure is allowed to
+    crash — the parent maps crash/hang/score-0 to the CPU fallback."""
+    import jax
+
+    if jax.default_backend() != "tpu":
+        msg = f"child backend is {jax.default_backend()}, not tpu"
+        raise SystemExit(msg)
     kernel_error = None
+    try:
+        stats = run_bench(True)
+    except Exception as exc:  # noqa: BLE001
+        # Pallas lowering/compile failures must degrade to a slower
+        # NUMBER via the XLA attention path, never to a 0.0 score
+        # (round-2 lesson: a kernel bug zeroed the whole round)
+        if os.environ.get("ATTENTION_BACKEND") == "xla":
+            raise
+        kernel_error = f"{type(exc).__name__}: {exc}"
+    if kernel_error:
+        # retry OUTSIDE the except block: the live traceback would
+        # otherwise pin the failed run's weights/KV buffers in HBM
+        # while the fallback loads its own copy
+        os.environ["ATTENTION_BACKEND"] = "xla"
+        stats = run_bench(True)
+    value = stats.pop("value")
+    stats["tpu_probe_ok"] = True
+    if kernel_error:
+        stats["pallas_fallback_error"] = kernel_error[:500]
+    _emit(value, extra=stats)
+
+
+def _last_json_line(text) -> dict | None:
+    if isinstance(text, bytes):
+        text = text.decode(errors="replace")
+    for line in reversed((text or "").strip().splitlines()):
+        try:
+            return json.loads(line)
+        except ValueError:
+            continue
+    return None
+
+
+def _run_tpu_bench_subprocess(timeout_s: float) -> tuple[dict | None, str]:
+    """Run this script in TPU-child mode under a hard wall-clock bound.
+
+    Returns (parsed JSON line, "") or (None, reason).  A mid-run tunnel
+    death shows up as a hang — on timeout the whole child process GROUP
+    is SIGKILLed (the PJRT plugin may hold helper processes on the
+    inherited pipes; killing only the direct child would leave
+    ``communicate`` blocked on pipe EOF forever).  Output already written
+    before the kill is still parsed: a child that finished the timed
+    pass but hung in PJRT teardown keeps its on-hardware number."""
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env["BENCH_TPU_CHILD"] = "1"
+    try:
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env, start_new_session=True,
+        )
+    except OSError as exc:
+        return None, f"spawn failed: {exc}"
+    timed_out = False
+    try:
+        out, err_txt = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired as exc:
+        timed_out = True
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            proc.kill()
+        try:
+            # group is dead -> pipes reach EOF; drain what was written
+            out, err_txt = proc.communicate(timeout=30)
+        except (subprocess.TimeoutExpired, ValueError, OSError):
+            out, err_txt = exc.stdout, exc.stderr
+    parsed = _last_json_line(out)
+    if parsed is not None and parsed.get("value", 0) > 0:
+        if timed_out:
+            parsed["tpu_teardown_hang"] = True
+        return parsed, ""
+    if timed_out:
+        return None, f"TPU bench exceeded {timeout_s:.0f}s (tunnel hang?)"
+    if parsed is not None:
+        return None, f"TPU bench scored 0: {parsed.get('error', '?')}"
+    stderr_tail = (err_txt or "")[-300:] if not isinstance(
+        err_txt, bytes) else err_txt[-300:].decode(errors="replace")
+    return None, f"TPU bench rc={proc.returncode}: {stderr_tail}"
+
+
+def main() -> None:
+    if os.environ.get("BENCH_TPU_CHILD") == "1":
+        _tpu_child()
+        return
+    on_tpu = False
+    tpu_error = None
     try:
         force_cpu = (
             os.environ.get("BENCH_FORCE_CPU", "") == "1"
@@ -251,29 +351,28 @@ def main() -> None:
         )
         probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", 300))
         on_tpu = False if force_cpu else _probe_tpu(probe_timeout)
-        try:
-            stats = run_bench(on_tpu)
-        except Exception as exc:  # noqa: BLE001
-            # Pallas lowering/compile failures must degrade to a slower
-            # NUMBER via the XLA attention path, never to a 0.0 score
-            # (round-2 lesson: a kernel bug zeroed the whole round)
-            if not on_tpu or os.environ.get("ATTENTION_BACKEND") == "xla":
-                raise
-            kernel_error = f"{type(exc).__name__}: {exc}"
-        if kernel_error:
-            # retry OUTSIDE the except block: the live traceback would
-            # otherwise pin the failed run's weights/KV buffers in HBM
-            # while the fallback loads its own copy
-            os.environ["ATTENTION_BACKEND"] = "xla"
-            stats = run_bench(on_tpu)
+        if on_tpu:
+            tpu_timeout = float(os.environ.get("BENCH_TPU_TIMEOUT", 1500))
+            child_line, tpu_error = _run_tpu_bench_subprocess(tpu_timeout)
+            if child_line is not None:
+                print(json.dumps(child_line), flush=True)
+                return
+            # pin this process to the CPU backend BEFORE any jax device
+            # use: with the tunnel plugin env still set, TPU backend init
+            # in the fallback could block unboundedly — the exact hang
+            # the subprocess guard above just contained
+            os.environ["JAX_PLATFORMS"] = "cpu"
+        stats = run_bench(False)
     except Exception as exc:  # noqa: BLE001 — must still emit JSON
         _emit(0.0, extra={"error": f"{type(exc).__name__}: {exc}",
-                          "tpu_probe_ok": on_tpu})
+                          "tpu_probe_ok": on_tpu,
+                          **({"tpu_bench_error": tpu_error[:500]}
+                             if tpu_error else {})})
         return
     value = stats.pop("value")
     stats["tpu_probe_ok"] = on_tpu
-    if kernel_error:
-        stats["pallas_fallback_error"] = kernel_error[:500]
+    if tpu_error:
+        stats["tpu_bench_error"] = tpu_error[:500]
     _emit(value, extra=stats)
 
 
