@@ -357,11 +357,11 @@ def main() -> None:
             if child_line is not None:
                 print(json.dumps(child_line), flush=True)
                 return
-            # pin this process to the CPU backend BEFORE any jax device
-            # use: with the tunnel plugin env still set, TPU backend init
-            # in the fallback could block unboundedly — the exact hang
-            # the subprocess guard above just contained
-            os.environ["JAX_PLATFORMS"] = "cpu"
+        # pin this process to the CPU backend BEFORE any jax device use:
+        # with the tunnel plugin env still set, TPU backend init in the
+        # fallback could block unboundedly — both when the child bench
+        # just hung AND when the startup probe itself timed out
+        os.environ["JAX_PLATFORMS"] = "cpu"
         stats = run_bench(False)
     except Exception as exc:  # noqa: BLE001 — must still emit JSON
         _emit(0.0, extra={"error": f"{type(exc).__name__}: {exc}",

@@ -1,0 +1,221 @@
+"""In-process data parallelism: AsyncLLMEngine replica fleet.
+
+``--data-parallel-size N`` builds N full engine replicas over disjoint
+device slices (async_llm.AsyncLLMEngine.from_config).  The reference
+stack gets DP by deploying one pod per replica behind a load balancer;
+here one process owns the fleet, so these tests assert the properties
+that deployment shape provides for free: request-level routing, result
+correctness independent of the chosen replica, whole-engine crash-fast
+on any replica death, and a shared LoRA registry (one hot-load serves
+all replicas).
+
+Runs on the 8-virtual-CPU-device conftest mesh.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def dp_config(tiny_model_dir):
+    from vllm_tgis_adapter_tpu.engine.config import (
+        CacheConfig,
+        EngineConfig,
+        LoRAConfig,
+        ModelConfig,
+        ParallelConfig,
+        SchedulerConfig,
+    )
+
+    model_config = ModelConfig.from_pretrained(tiny_model_dir, dtype="float32")
+
+    def make(dp: int, tp: int = 1):
+        return EngineConfig(
+            model_config=model_config,
+            cache_config=CacheConfig(
+                block_size=16, num_blocks=64, cache_dtype=model_config.dtype
+            ),
+            scheduler_config=SchedulerConfig(
+                max_num_seqs=4, prefill_buckets=(32, 64)
+            ),
+            parallel_config=ParallelConfig(
+                data_parallel_size=dp, tensor_parallel_size=tp
+            ),
+            lora_config=LoRAConfig(),
+        )
+
+    return make
+
+
+async def _collect(engine, prompts, max_tokens=8):
+    from vllm_tgis_adapter_tpu.engine.sampling_params import SamplingParams
+
+    async def one(i, prompt):
+        final = None
+        async for out in engine.generate(
+            prompt,
+            SamplingParams(temperature=0.0, max_tokens=max_tokens),
+            request_id=f"req-{i}",
+        ):
+            final = out
+        return final
+
+    try:
+        return await asyncio.gather(
+            *(one(i, p) for i, p in enumerate(prompts))
+        )
+    finally:
+        await engine.stop()
+
+
+def test_dp_replicas_build_on_disjoint_devices(dp_config):
+    from vllm_tgis_adapter_tpu.engine.async_llm import AsyncLLMEngine
+
+    engine = AsyncLLMEngine.from_config(dp_config(dp=2, tp=2))
+    assert len(engine._replicas) == 2
+    meshes = [rep.engine.runner.mesh for rep in engine._replicas]
+    assert all(m is not None for m in meshes)
+    seen = [
+        {d.id for d in m.devices.flatten()} for m in meshes
+    ]
+    assert seen[0].isdisjoint(seen[1])
+    assert all(len(s) == 2 for s in seen)
+    # replicas share ONE adapter registry (a hot-load serves the fleet)
+    managers = {id(rep.engine.lora_manager) for rep in engine._replicas}
+    assert len(managers) == 1
+
+
+def test_dp_results_match_single_engine(dp_config):
+    """Greedy outputs must not depend on which replica served a request."""
+    from vllm_tgis_adapter_tpu.engine.async_llm import AsyncLLMEngine
+
+    prompts = [f"count to {i}" for i in range(6)]
+    single = AsyncLLMEngine.from_config(dp_config(dp=1))
+    dp = AsyncLLMEngine.from_config(dp_config(dp=2))
+
+    ref = asyncio.run(_collect(single, prompts))
+    got = asyncio.run(_collect(dp, prompts))
+    for r, g in zip(ref, got):
+        assert r.outputs[0].token_ids == g.outputs[0].token_ids
+        assert r.outputs[0].finish_reason == g.outputs[0].finish_reason
+
+
+def test_dp_routes_to_both_replicas(dp_config):
+    """Concurrent admissions must spread over the fleet, not pile onto
+    replica 0 (least-loaded routing)."""
+    from vllm_tgis_adapter_tpu.engine.async_llm import AsyncLLMEngine
+    from vllm_tgis_adapter_tpu.engine.sampling_params import SamplingParams
+
+    engine = AsyncLLMEngine.from_config(dp_config(dp=2))
+    served: list[set] = []
+
+    async def scenario():
+        streams = []
+
+        async def one(i):
+            final = None
+            async for out in engine.generate(
+                f"prompt {i}",
+                SamplingParams(temperature=0.0, max_tokens=16),
+                request_id=f"r-{i}",
+            ):
+                final = out
+            return final
+
+        for i in range(6):
+            streams.append(asyncio.create_task(one(i)))
+        # let admissions land, then snapshot ownership while in flight
+        while len(engine._owner) < 6:
+            await asyncio.sleep(0.01)
+        served.append({rep.index for rep in engine._owner.values()})
+        await asyncio.gather(*streams)
+        await engine.stop()
+
+    asyncio.run(scenario())
+    assert served[0] == {0, 1}
+
+
+def test_dp_abort_routes_to_owner(dp_config):
+    from vllm_tgis_adapter_tpu.engine.async_llm import AsyncLLMEngine
+    from vllm_tgis_adapter_tpu.engine.sampling_params import (
+        RequestOutputKind,
+        SamplingParams,
+    )
+
+    engine = AsyncLLMEngine.from_config(dp_config(dp=2))
+
+    async def scenario():
+        params = SamplingParams(
+            temperature=0.0, max_tokens=400, ignore_eos=True,
+            output_kind=RequestOutputKind.DELTA,
+        )
+        results = {}
+
+        async def one(rid):
+            # DELTA frames carry only the new tokens; count cumulatively
+            seen = 0
+            async for out in engine.generate(
+                "stream away", dataclasses.replace(params),
+                request_id=rid,
+            ):
+                results[rid] = out
+                seen += len(out.outputs[0].token_ids)
+                if rid == "victim" and seen >= 2 and not out.finished:
+                    await engine.abort(rid)
+            return results[rid], seen
+
+        (victim, _), (survivor, n_survivor) = await asyncio.gather(
+            one("victim"), one("survivor")
+        )
+        await engine.stop()
+        return victim, survivor, n_survivor
+
+    victim, survivor, n_survivor = asyncio.run(scenario())
+    assert victim.finished and victim.outputs[0].finish_reason == "abort"
+    assert survivor.finished
+    assert survivor.outputs[0].finish_reason in ("length", "stop")
+    assert n_survivor == 400
+
+
+def test_dp_replica_death_is_engine_death(dp_config):
+    """Any replica's step-loop death must surface as whole-engine death
+    (errored=True) so both servers crash-fast, like the single engine."""
+    from vllm_tgis_adapter_tpu.engine.async_llm import AsyncLLMEngine
+    from vllm_tgis_adapter_tpu.engine.sampling_params import SamplingParams
+
+    engine = AsyncLLMEngine.from_config(dp_config(dp=2))
+
+    async def scenario():
+        await engine.start()
+        # an idle fleet routes to replica 0 (tie-break); fault exactly it
+        rep0 = engine._replicas[0]
+
+        def boom(*a, **k):
+            raise RuntimeError("injected replica fault")
+
+        rep0.engine.plan_step = boom  # type: ignore[method-assign]
+        with pytest.raises(RuntimeError, match="injected replica fault"):
+            async for _ in engine.generate(
+                "doomed",
+                SamplingParams(temperature=0.0, max_tokens=4),
+                request_id="doomed-1",
+            ):
+                pass
+        assert engine.errored
+        assert not engine.is_running
+        with pytest.raises(BaseException, match="injected replica fault"):
+            await engine.check_health()
+        await engine.stop()
+
+    asyncio.run(scenario())
+
+
+def test_dp_needs_enough_devices(dp_config):
+    from vllm_tgis_adapter_tpu.engine.async_llm import AsyncLLMEngine
+
+    with pytest.raises(ValueError, match="devices"):
+        AsyncLLMEngine.from_config(dp_config(dp=4, tp=4))

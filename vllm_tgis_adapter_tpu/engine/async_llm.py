@@ -7,9 +7,22 @@ RequestOutput, ``abort`` cancels and evicts, ``errored``/``is_running``
 surface engine death to the servers, and the tokenizer/model-config
 accessors feed validation.
 
-Concurrency model: the jitted device step is blocking, so the step loop
-runs in a single dedicated worker thread (device work is serialized by
-construction) while asyncio queues fan results out to per-request streams.
+Concurrency model: the jitted device step is blocking, so each step loop
+dispatches it to a worker thread (device work is serialized per replica
+by construction) while asyncio queues fan results out to per-request
+streams.
+
+Data parallelism (in-process): ``--data-parallel-size N`` builds N full
+engine replicas, each owning a disjoint ``sp × tp`` device slice, its own
+scheduler/KV pool, and its own step loop — DP for inference is
+independent batches, so replicas share nothing on the critical path
+(SURVEY.md §2.4: replica groups; no cross-replica collectives needed).
+New requests route to the least-loaded replica; the LoRA registry is
+shared so one hot-load serves the whole fleet; any replica death is
+whole-engine death (crash-fast, same as the reference's engine-death
+semantics).  This is the same replica-per-device-group shape the
+reference stack gets from deployment-level DP, minus the extra pods: one
+process, one tokenizer, both servers, N device groups.
 """
 
 from __future__ import annotations
@@ -31,23 +44,38 @@ class EngineDeadError(RuntimeError):
     pass
 
 
-class AsyncLLMEngine:
-    def __init__(self, engine: LLMEngine):
+class _Replica:
+    """One engine + the concurrency state serializing access to it."""
+
+    __slots__ = ("engine", "lock", "new_work", "task", "index")
+
+    def __init__(self, engine: LLMEngine, index: int):
         self.engine = engine
+        self.index = index
+        # serializes engine-state mutations (add/abort) against the step
+        # host phases — scheduler state is not thread-safe
+        self.lock = asyncio.Lock()
+        self.new_work = asyncio.Event()
+        self.task: Optional[asyncio.Task] = None
+
+
+class AsyncLLMEngine:
+    def __init__(self, engine: LLMEngine | list[LLMEngine]):
+        engines = engine if isinstance(engine, list) else [engine]
+        # replica 0 doubles as the host-side singleton surface (tokenizer,
+        # model config, shared LoRA registry) the serving layer reads
+        self.engine = engines[0]
+        self._replicas = [_Replica(e, i) for i, e in enumerate(engines)]
+        self._owner: dict[str, _Replica] = {}
         self._queues: dict[str, asyncio.Queue] = {}
-        self._new_work = asyncio.Event()
-        self._loop_task: Optional[asyncio.Task] = None
         self._dead_error: Optional[BaseException] = None
         self._stopped = False
-        # serializes engine-state mutations (add/abort) against the step
-        # running in the worker thread — scheduler state is not thread-safe
-        self._engine_lock = asyncio.Lock()
         # periodic operational stats line (vLLM-style), unless
         # --disable-log-stats
         self._stats_task: Optional[asyncio.Task] = None
         # one server span per request when --otlp-traces-endpoint is set
         self._tracer = None
-        endpoint = engine.config.otlp_traces_endpoint
+        endpoint = self.engine.config.otlp_traces_endpoint
         if endpoint:
             from vllm_tgis_adapter_tpu.tracing import RequestTracer
 
@@ -57,15 +85,57 @@ class AsyncLLMEngine:
 
     @classmethod
     def from_config(cls, config: EngineConfig) -> "AsyncLLMEngine":
-        return cls(LLMEngine.from_config(config))
+        import dataclasses
+
+        pcfg = config.parallel_config
+        dp = pcfg.data_parallel_size
+        if dp <= 1:
+            return cls(LLMEngine.from_config(config))
+        import jax
+
+        per_replica = pcfg.tensor_parallel_size * pcfg.sequence_parallel_size
+        devices = jax.devices()
+        if dp * per_replica > len(devices):
+            raise ValueError(
+                f"data_parallel_size={dp} needs {dp * per_replica} devices "
+                f"(sp×tp={per_replica} each) but only {len(devices)} are "
+                "visible"
+            )
+        replica_config = dataclasses.replace(
+            config,
+            parallel_config=dataclasses.replace(pcfg, data_parallel_size=1),
+        )
+        engines = []
+        for rank in range(dp):
+            logger.info("building dp replica %d/%d", rank + 1, dp)
+            engines.append(
+                LLMEngine.from_config(
+                    replica_config,
+                    devices=devices[
+                        rank * per_replica:(rank + 1) * per_replica
+                    ],
+                )
+            )
+        # one adapter registry fleet-wide: a hot-load registers once and
+        # every replica's runner builds its stacks from the same slots;
+        # pin/unpin refcounts sum across replicas so no replica can evict
+        # an adapter another replica's running row still indexes.  Safe
+        # unsynchronized: all mutations happen in host phases on the one
+        # event-loop thread.
+        shared = engines[0].lora_manager
+        for e in engines[1:]:
+            e.lora_manager = shared
+        return cls(engines)
 
     STATS_INTERVAL_S = 10.0
 
     async def start(self) -> None:
-        if self._loop_task is None:
-            self._loop_task = asyncio.create_task(
-                self._run_loop(), name="engine-step-loop"
-            )
+        for rep in self._replicas:
+            if rep.task is None:
+                rep.task = asyncio.create_task(
+                    self._run_loop(rep),
+                    name=f"engine-step-loop-{rep.index}",
+                )
         if self._stats_task is None and not (
             self.engine.config.disable_log_stats
         ):
@@ -75,17 +145,18 @@ class AsyncLLMEngine:
 
     async def stop(self) -> None:
         self._stopped = True
-        self._new_work.set()
         if self._stats_task is not None:
             self._stats_task.cancel()
             self._stats_task = None
-        if self._loop_task is not None:
-            self._loop_task.cancel()
-            try:
-                await self._loop_task
-            except (asyncio.CancelledError, Exception):  # noqa: BLE001
-                pass
-            self._loop_task = None
+        for rep in self._replicas:
+            rep.new_work.set()
+            if rep.task is not None:
+                rep.task.cancel()
+                try:
+                    await rep.task
+                except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                    pass
+                rep.task = None
         if self._tracer is not None:
             # flush buffered spans before the exporter thread dies with
             # the process
@@ -106,8 +177,10 @@ class AsyncLLMEngine:
         return (
             not self.errored
             and not self._stopped
-            and self._loop_task is not None
-            and not self._loop_task.done()
+            and all(
+                rep.task is not None and not rep.task.done()
+                for rep in self._replicas
+            )
         )
 
     async def get_tokenizer(self, lora_request=None):  # noqa: ANN001
@@ -150,7 +223,7 @@ class AsyncLLMEngine:
         """
         if self.errored:
             raise self.dead_error
-        if self._loop_task is None:
+        if self._replicas[0].task is None:
             await self.start()
         sampling_params = sampling_params or SamplingParams()
         if request_id in self._queues:
@@ -158,12 +231,18 @@ class AsyncLLMEngine:
             raise ValueError(f"duplicate request_id {request_id!r}")
         queue: asyncio.Queue = asyncio.Queue()
         self._queues[request_id] = queue
+        # least-loaded replica wins; ties fall to the lowest index, so a
+        # dp=1 engine routes exactly like the pre-dp code path
+        rep = min(
+            self._replicas,
+            key=lambda r: (r.engine.scheduler.num_unfinished, r.index),
+        )
         span = None
         if self._tracer is not None:
             span = self._tracer.start_span(request_id, trace_headers)
         try:
-            async with self._engine_lock:
-                self.engine.add_request(
+            async with rep.lock:
+                rep.engine.add_request(
                     request_id,
                     prompt,
                     sampling_params,
@@ -178,7 +257,8 @@ class AsyncLLMEngine:
                 span.attributes["error.type"] = type(e).__name__
                 self._tracer.finish_span(span, None)
             raise
-        self._new_work.set()
+        self._owner[request_id] = rep
+        rep.new_work.set()
         final = None
         try:
             while True:
@@ -191,12 +271,16 @@ class AsyncLLMEngine:
                     return
         finally:
             self._queues.pop(request_id, None)
+            self._owner.pop(request_id, None)
             if span is not None:
                 self._tracer.finish_span(span, final)
 
     async def abort(self, request_id: str) -> None:
-        async with self._engine_lock:
-            out = self.engine.abort_request(request_id)
+        rep = self._owner.get(request_id)
+        if rep is None:
+            return
+        async with rep.lock:
+            out = rep.engine.abort_request(request_id)
         queue = self._queues.get(request_id)
         if queue is not None and out is not None:
             queue.put_nowait(out)
@@ -212,49 +296,64 @@ class AsyncLLMEngine:
             await asyncio.sleep(self.STATS_INTERVAL_S)
             if self.errored:
                 break
-            scheduler = self.engine.scheduler
-            active = self.engine.has_unfinished_requests()
+            engines = [rep.engine for rep in self._replicas]
+            active = any(e.has_unfinished_requests() for e in engines)
             if not active and not was_active:
                 continue  # idle: stay quiet until work arrives
             was_active = active
-            allocator = scheduler.allocator
-            used = allocator.num_blocks - allocator.num_free
+            allocators = [e.scheduler.allocator for e in engines]
+            num_blocks = sum(a.num_blocks for a in allocators)
+            used = num_blocks - sum(a.num_free for a in allocators)
             line = (
-                f"running: {len(scheduler.running)} reqs, "
-                f"waiting: {len(scheduler.waiting)} reqs, "
-                f"KV pages: {used}/{allocator.num_blocks} used"
+                f"running: "
+                f"{sum(len(e.scheduler.running) for e in engines)} reqs, "
+                f"waiting: "
+                f"{sum(len(e.scheduler.waiting) for e in engines)} reqs, "
+                f"KV pages: {used}/{num_blocks} used"
             )
-            if allocator.enable_prefix_caching:
-                line += f", prefix-cache hit tokens: {allocator.prefix_hits}"
-            spec = self.engine.runner.spec
-            if spec is not None and spec.stats.proposed:
+            if len(engines) > 1:
                 line += (
-                    f", spec acceptance: "
-                    f"{100 * spec.stats.acceptance_rate:.1f}%"
+                    ", per-replica running: "
+                    + "/".join(
+                        str(len(e.scheduler.running)) for e in engines
+                    )
+                )
+            if allocators[0].enable_prefix_caching:
+                hits = sum(a.prefix_hits for a in allocators)
+                line += f", prefix-cache hit tokens: {hits}"
+            specs = [
+                e.runner.spec for e in engines if e.runner.spec is not None
+            ]
+            proposed = sum(s.stats.proposed for s in specs)
+            if proposed:
+                accepted = sum(s.stats.accepted for s in specs)
+                line += (
+                    f", spec acceptance: {100 * accepted / proposed:.1f}%"
                 )
             logger.info("Engine stats: %s", line)
 
     # ------------------------------------------------------------- step loop
 
-    async def _run_loop(self) -> None:
+    async def _run_loop(self, rep: _Replica) -> None:
+        engine = rep.engine
         try:
             while not self._stopped:
-                if not self.engine.has_unfinished_requests():
-                    self._new_work.clear()
-                    await self._new_work.wait()
+                if not engine.has_unfinished_requests():
+                    rep.new_work.clear()
+                    await rep.new_work.wait()
                     continue
                 # the lock covers only the fast host phases (plan/commit);
                 # the blocking device dispatch runs WITHOUT it so aborts
                 # and new requests land mid-dispatch instead of queueing
                 # behind a full fused-step program
-                async with self._engine_lock:
-                    outputs, plan, prepared = self.engine.plan_step()
+                async with rep.lock:
+                    outputs, plan, prepared = engine.plan_step()
                 if plan is not None:
                     result = await asyncio.to_thread(
-                        self.engine.execute_step, plan, prepared
+                        engine.execute_step, plan, prepared
                     )
-                    async with self._engine_lock:
-                        outputs = outputs + self.engine.commit_step(
+                    async with rep.lock:
+                        outputs = outputs + engine.commit_step(
                             plan, result, prepared
                         )
                 for out in outputs:
@@ -263,12 +362,14 @@ class AsyncLLMEngine:
                         queue.put_nowait(out)
                     elif not out.finished:
                         # stream consumer went away → stop generating
-                        async with self._engine_lock:
-                            self.engine.abort_request(out.request_id)
+                        async with rep.lock:
+                            engine.abort_request(out.request_id)
         except asyncio.CancelledError:
             raise
         except BaseException as e:  # noqa: BLE001 — engine death is terminal
-            logger.exception("engine step loop died")
+            # one replica dying is whole-engine death: the servers read
+            # ``errored`` and crash-fast, matching single-engine semantics
+            logger.exception("engine step loop %d died", rep.index)
             self._dead_error = e
             for queue in self._queues.values():
                 queue.put_nowait(e)

@@ -81,7 +81,10 @@ class LLMEngine:
     # ------------------------------------------------------------- lifecycle
 
     @classmethod
-    def from_config(cls, config: EngineConfig) -> "LLMEngine":
+    def from_config(cls, config: EngineConfig, devices=None) -> "LLMEngine":
+        """Build one engine replica.  ``devices``: explicit device slice
+        this replica owns (dp replicas get disjoint slices from
+        AsyncLLMEngine.from_config); None = all visible devices."""
         from transformers import AutoTokenizer
 
         from vllm_tgis_adapter_tpu.engine.weights import load_llama_params
@@ -101,7 +104,9 @@ class LLMEngine:
         # build the mesh BEFORE loading so every tensor is sharded onto it
         # as it is read — sharding after a full single-device load would
         # OOM device 0 for models that need TP in the first place
-        mesh = mesh_from_parallel_config(config.parallel_config)
+        mesh = mesh_from_parallel_config(
+            config.parallel_config, devices=devices
+        )
         place = None
         if mesh is not None:
             validate_tp_divisibility(mcfg, mesh.shape["tp"])
@@ -125,7 +130,13 @@ class LLMEngine:
             )
 
         tokenizer = AutoTokenizer.from_pretrained(config.tokenizer or mcfg.model)
-        engine = cls(config, model, params, tokenizer, mesh=mesh)
+        # KV auto-sizing must read free HBM from a device THIS replica
+        # owns: under dp, device 0 belongs to replica 0 and is already
+        # full of replica-0 weights by the time later replicas size
+        # their pools
+        memory_device = devices[0] if devices else None
+        engine = cls(config, model, params, tokenizer, mesh=mesh,
+                     memory_device=memory_device)
         if draft_model is not None:
             engine.runner.attach_speculative(draft_model, draft_params)
         return engine

@@ -97,13 +97,17 @@ def build_mesh(
     return mesh
 
 
-def mesh_from_parallel_config(pcfg) -> Mesh | None:
-    """Mesh for an engine's ParallelConfig; None for the single-chip path.
+def mesh_from_parallel_config(pcfg, devices=None) -> Mesh | None:
+    """Mesh for ONE engine replica's ParallelConfig (always dp=1 here:
+    in-process data parallelism lives a level up, in
+    ``AsyncLLMEngine.from_config``, which builds one LLMEngine per dp
+    rank over a disjoint device slice and passes it down via ``devices``).
 
-    Fails fast on parallelism modes the engine does not implement yet, so
-    a flag the CLI accepts can never silently run unsharded (dp replicas
-    are deployment-level in this release: one engine per replica behind a
-    load balancer, as the reference deploys TGIS).
+    Returns None for the plain single-chip path; fails fast on modes the
+    engine does not implement yet, so a flag the CLI accepts can never
+    silently run unsharded.  With an explicit ``devices`` list a mesh is
+    built even at sp=tp=1 — a 1×1×1 mesh pins every array of that replica
+    to its one assigned device, which default placement would not.
     """
     if pcfg.pipeline_parallel_size > 1:
         raise NotImplementedError(
@@ -112,16 +116,17 @@ def mesh_from_parallel_config(pcfg) -> Mesh | None:
         )
     if pcfg.data_parallel_size > 1:
         raise NotImplementedError(
-            "--data-parallel-size > 1 is not implemented in-process; run "
-            "one engine per replica behind a load balancer (deployment-"
-            "level DP, as the reference stack deploys TGIS)"
+            "LLMEngine is always a single dp rank; construct via "
+            "AsyncLLMEngine.from_config for in-process --data-parallel-"
+            "size replicas"
         )
     sp = getattr(pcfg, "sequence_parallel_size", 1)
-    if pcfg.tensor_parallel_size <= 1 and sp <= 1:
+    if pcfg.tensor_parallel_size <= 1 and sp <= 1 and devices is None:
         return None
     return build_mesh(
         tensor_parallel_size=pcfg.tensor_parallel_size,
         sequence_parallel_size=sp,
+        devices=devices,
     )
 
 

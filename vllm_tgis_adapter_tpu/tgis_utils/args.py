@@ -206,7 +206,10 @@ def add_engine_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     g.add_argument("--pipeline-parallel-size", "-pp", type=int, default=1,
                    help="pipeline stages across the mesh")
     g.add_argument("--data-parallel-size", "-dp", type=int, default=1,
-                   help="engine replicas over a data-parallel mesh axis")
+                   help="in-process engine replicas, each owning a "
+                        "disjoint sp*tp device slice with its own "
+                        "scheduler and KV pool; requests route to the "
+                        "least-loaded replica (total chips = dp*sp*tp)")
 
     g = parser.add_argument_group("lora")
     g.add_argument("--enable-lora", action="store_true",
