@@ -180,6 +180,87 @@ def build_tiny_mixtral(path: str, seed: int = 0, num_experts: int = 4,
     return str(out)
 
 
+TINY_OPT_CONFIG = {
+    "architectures": ["OPTForCausalLM"],
+    "model_type": "opt",
+    "vocab_size": 512,
+    "hidden_size": 64,
+    "ffn_dim": 128,
+    "num_hidden_layers": 2,
+    "num_attention_heads": 4,
+    "max_position_embeddings": 512,
+    "word_embed_proj_dim": 64,
+    "do_layer_norm_before": True,
+    "enable_bias": True,
+    "activation_function": "relu",
+    "tie_word_embeddings": True,
+    "bos_token_id": 1,
+    "eos_token_id": 2,
+    "pad_token_id": 2,
+    "torch_dtype": "float32",
+}
+
+
+def build_tiny_opt(path: str, seed: int = 0) -> str:
+    """Tiny OPT-architecture checkpoint in HF naming (BASELINE.json's
+    opt-125m config class): learned offset-by-2 positions, pre-LayerNorm
+    with biases, fc1/ReLU/fc2, tied lm_head."""
+    import numpy as np
+    from safetensors.numpy import save_file
+
+    out = Path(path)
+    out.mkdir(parents=True, exist_ok=True)
+
+    tokenizer = build_tokenizer(path)
+    cfg = dict(TINY_OPT_CONFIG)
+    cfg["vocab_size"] = max(cfg["vocab_size"], len(tokenizer))
+    with open(out / "config.json", "w") as f:
+        json.dump(cfg, f, indent=2)
+
+    rng = np.random.default_rng(seed)
+    d = cfg["hidden_size"]
+    h = cfg["num_attention_heads"]
+    inter = cfg["ffn_dim"]
+    vocab = cfg["vocab_size"]
+
+    def w(shape):
+        return (rng.standard_normal(shape) * 0.02).astype(np.float32)
+
+    def b(n):
+        return (rng.standard_normal(n) * 0.01).astype(np.float32)
+
+    tensors = {
+        "model.decoder.embed_tokens.weight": w((vocab, d)),
+        "model.decoder.embed_positions.weight": w(
+            (cfg["max_position_embeddings"] + 2, d)
+        ),
+        "model.decoder.final_layer_norm.weight": np.ones(d, np.float32),
+        "model.decoder.final_layer_norm.bias": b(d),
+    }
+    for i in range(cfg["num_hidden_layers"]):
+        p = f"model.decoder.layers.{i}"
+        tensors |= {
+            f"{p}.self_attn_layer_norm.weight": np.ones(d, np.float32),
+            f"{p}.self_attn_layer_norm.bias": b(d),
+            f"{p}.final_layer_norm.weight": np.ones(d, np.float32),
+            f"{p}.final_layer_norm.bias": b(d),
+            f"{p}.self_attn.q_proj.weight": w((d, d)),
+            f"{p}.self_attn.q_proj.bias": b(d),
+            f"{p}.self_attn.k_proj.weight": w((d, d)),
+            f"{p}.self_attn.k_proj.bias": b(d),
+            f"{p}.self_attn.v_proj.weight": w((d, d)),
+            f"{p}.self_attn.v_proj.bias": b(d),
+            f"{p}.self_attn.out_proj.weight": w((d, d)),
+            f"{p}.self_attn.out_proj.bias": b(d),
+            f"{p}.fc1.weight": w((inter, d)),
+            f"{p}.fc1.bias": b(inter),
+            f"{p}.fc2.weight": w((d, inter)),
+            f"{p}.fc2.bias": b(d),
+        }
+    save_file(tensors, out / "model.safetensors")
+    return str(out)
+
+
 def build_tiny_lora_adapter(path: str, seed: int = 7, rank: int = 4) -> str:
     """PEFT-format LoRA adapter matching the tiny llama fixture: real
     random A/B weights on q/v projections of both layers (the reference's

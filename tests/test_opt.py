@@ -1,0 +1,242 @@
+"""OPT model family: numerical parity vs HF torch + engine e2e.
+
+BASELINE.json's first benchmark config is "opt-125m single Generate" —
+the reference CI's model class (reference tests/conftest.py:85-89 boots
+an opt-class tiny model).  OPT runs through the same decoder skeleton as
+the llama lineage via static config branches (models/llama.py): learned
+offset-by-2 positional embeddings, pre-LayerNorm with biases,
+fc1/ReLU/fc2 MLP, biased out-projection, MHA paged KV.
+
+Gold-standard checks mirror tests/test_model_correctness.py: identical
+weights + inputs must reproduce HF torch logits and greedy generate
+tokens exactly (float32 tolerances).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module")
+def opt_dir(tmp_path_factory):
+    from tests.fixture_models import build_tiny_opt
+
+    return build_tiny_opt(str(tmp_path_factory.mktemp("tiny-opt")))
+
+
+@pytest.fixture(scope="module")
+def setup(opt_dir):
+    import jax.numpy as jnp
+
+    from vllm_tgis_adapter_tpu.engine.config import ModelConfig
+    from vllm_tgis_adapter_tpu.engine.weights import load_model_params
+    from vllm_tgis_adapter_tpu.models import get_model_class
+
+    config = ModelConfig.from_pretrained(opt_dir, dtype="float32")
+    model = get_model_class(config.model_type)(config)
+    params = load_model_params(config, opt_dir)
+    caches = model.make_kv_caches(num_slots=1024, dtype=jnp.float32)
+    return opt_dir, config, model, params, caches
+
+
+def _hf_model(model_dir):
+    import torch
+    from transformers import AutoModelForCausalLM
+
+    hf = AutoModelForCausalLM.from_pretrained(
+        model_dir, torch_dtype=torch.float32
+    )
+    hf.eval()
+    return hf
+
+
+def _tokenize(model_dir, text):
+    from transformers import AutoTokenizer
+
+    return AutoTokenizer.from_pretrained(model_dir)(text).input_ids
+
+
+def test_opt_config_mapping(setup):
+    _, config, _, params, _ = setup
+    assert config.model_type == "opt"
+    assert config.position_embedding == "learned"
+    assert config.learned_pos_offset == 2
+    assert config.norm_type == "layernorm"
+    assert not config.gated_mlp
+    assert config.num_kv_heads == config.num_heads  # MHA
+    assert "pos_embed" in params
+    assert "lm_head" not in params  # tied
+    layer = params["layers"][0]
+    for name in ("bq", "bk", "bv", "bo", "b_up", "b_down",
+                 "input_norm_bias", "post_attn_norm_bias"):
+        assert name in layer, name
+    assert "w_gate" not in layer
+
+
+def test_opt_prefill_logits_match_hf(setup):
+    import jax.numpy as jnp
+    import torch
+
+    model_dir, config, model, params, caches = setup
+    input_ids = _tokenize(model_dir, "the quick brown fox jumps")
+    t = len(input_ids)
+
+    logits, _ = model.prefill(
+        params, caches,
+        jnp.asarray(input_ids, dtype=jnp.int32),
+        jnp.arange(t, dtype=jnp.int32),
+        jnp.arange(t, dtype=jnp.int32),
+        jnp.asarray(t, dtype=jnp.int32),
+    )
+    hf = _hf_model(model_dir)
+    with torch.no_grad():
+        hf_logits = hf(torch.tensor([input_ids])).logits[0].numpy()
+    np.testing.assert_allclose(
+        np.asarray(logits), hf_logits, rtol=1e-3, atol=1e-3
+    )
+
+
+def test_opt_padded_prefill_matches_unpadded(setup):
+    """Bucket padding must not perturb real positions — the learned
+    position lookup for pad rows (positions -1/clipped) must stay out of
+    the real rows' outputs."""
+    import jax.numpy as jnp
+
+    model_dir, config, model, params, caches = setup
+    input_ids = _tokenize(model_dir, "hello world")
+    t, bucket = len(input_ids), 32
+
+    logits, _ = model.prefill(
+        params, caches,
+        jnp.asarray(input_ids, dtype=jnp.int32),
+        jnp.arange(t, dtype=jnp.int32),
+        jnp.arange(t, dtype=jnp.int32),
+        jnp.asarray(t, dtype=jnp.int32),
+    )
+    padded = input_ids + [0] * (bucket - t)
+    logits_padded, _ = model.prefill(
+        params, caches,
+        jnp.asarray(padded, dtype=jnp.int32),
+        jnp.arange(bucket, dtype=jnp.int32),
+        jnp.concatenate(
+            [jnp.arange(t, dtype=jnp.int32),
+             jnp.full((bucket - t,), -1, dtype=jnp.int32)]
+        ),
+        jnp.asarray(t, dtype=jnp.int32),
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(logits_padded)[:t],
+        rtol=1e-4, atol=1e-4,
+    )
+
+
+def test_opt_greedy_decode_matches_hf_generate(setup):
+    import jax.numpy as jnp
+    import torch
+
+    model_dir, config, model, params, caches = setup
+    input_ids = _tokenize(model_dir, "the capital of France")
+    t = len(input_ids)
+    new_tokens = 12
+    block_size = 16
+    max_blocks = 8
+
+    hf = _hf_model(model_dir)
+    with torch.no_grad():
+        hf_out = hf.generate(
+            torch.tensor([input_ids]),
+            max_new_tokens=new_tokens,
+            do_sample=False,
+            eos_token_id=None,
+        )[0].tolist()
+    expected = hf_out[t:]
+
+    logits, caches = model.prefill(
+        params, caches,
+        jnp.asarray(input_ids, dtype=jnp.int32),
+        jnp.arange(t, dtype=jnp.int32),
+        jnp.arange(t, dtype=jnp.int32),
+        jnp.asarray(t, dtype=jnp.int32),
+    )
+    block_tables = jnp.arange(max_blocks, dtype=jnp.int32)[None, :]
+    next_token = int(jnp.argmax(logits[t - 1]))
+    produced = [next_token]
+    pos = t
+    for _ in range(new_tokens - 1):
+        step_logits, caches = model.decode(
+            params, caches,
+            jnp.asarray([next_token], dtype=jnp.int32),
+            jnp.asarray([pos], dtype=jnp.int32),
+            jnp.asarray([pos], dtype=jnp.int32),
+            block_tables,
+            jnp.asarray([pos + 1], dtype=jnp.int32),
+            block_size,
+        )
+        next_token = int(jnp.argmax(step_logits[0]))
+        produced.append(next_token)
+        pos += 1
+
+    assert produced == expected
+
+
+def test_opt_engine_end_to_end(opt_dir):
+    """The full engine slice serves OPT: admission → bucketed prefill →
+    continuous-batching decode → outputs, greedy-deterministic."""
+    import jax.numpy as jnp
+
+    from vllm_tgis_adapter_tpu.engine.config import (
+        CacheConfig,
+        EngineConfig,
+        LoRAConfig,
+        ModelConfig,
+        ParallelConfig,
+        SchedulerConfig,
+    )
+    from vllm_tgis_adapter_tpu.engine.core import LLMEngine
+    from vllm_tgis_adapter_tpu.engine.sampling_params import SamplingParams
+
+    mcfg = ModelConfig.from_pretrained(opt_dir, dtype="float32")
+    config = EngineConfig(
+        model_config=mcfg,
+        cache_config=CacheConfig(block_size=16, num_blocks=64,
+                                 cache_dtype=mcfg.dtype),
+        scheduler_config=SchedulerConfig(max_num_seqs=4,
+                                         prefill_buckets=(32, 64)),
+        parallel_config=ParallelConfig(),
+        lora_config=LoRAConfig(),
+    )
+    engine = LLMEngine.from_config(config)
+    for i in range(3):
+        engine.add_request(
+            f"opt-{i}", f"tell me about topic {i}",
+            SamplingParams(temperature=0.0, max_tokens=8, ignore_eos=True),
+        )
+    done = {}
+    for _ in range(200):
+        if not engine.has_unfinished_requests():
+            break
+        for out in engine.step():
+            if out.finished:
+                done[out.request_id] = out
+    assert set(done) == {"opt-0", "opt-1", "opt-2"}
+    for out in done.values():
+        assert len(out.outputs[0].token_ids) == 8
+        assert out.outputs[0].text  # detokenizer produced something
+
+
+def test_opt_rejects_post_norm_variant(tmp_path):
+    """opt-350m-style post-norm configs must fail fast, not run wrong."""
+    import json
+
+    from tests.fixture_models import TINY_OPT_CONFIG
+
+    from vllm_tgis_adapter_tpu.engine.config import ModelConfig
+
+    cfg = dict(TINY_OPT_CONFIG)
+    cfg["do_layer_norm_before"] = False
+    path = tmp_path / "post-norm-opt"
+    path.mkdir()
+    (path / "config.json").write_text(json.dumps(cfg))
+    with pytest.raises(ValueError, match="post-norm"):
+        ModelConfig.from_pretrained(str(path))

@@ -62,6 +62,17 @@ class ModelConfig:
     num_experts_per_tok: int = 0
     attention_bias: bool = False
     mlp_bias: bool = False
+    # architecture family knobs beyond the llama lineage (OPT et al.);
+    # all are static Python branches in models/llama.py, so each
+    # combination still compiles to one straight-line XLA program
+    position_embedding: str = "rope"  # "rope" | "learned"
+    norm_type: str = "rmsnorm"  # "rmsnorm" | "layernorm"
+    hidden_act: str = "silu"  # "silu" | "relu" | "gelu" | "gelu_new"
+    gated_mlp: bool = True  # SwiGLU gate/up/down vs plain fc1/act/fc2
+    attention_out_bias: bool = False
+    # learned-position table: row count and the OPT-style lookup offset
+    num_position_embeddings: int = 0
+    learned_pos_offset: int = 0
 
     @property
     def q_per_kv(self) -> int:
@@ -88,6 +99,10 @@ class ModelConfig:
         eos = hf.get("eos_token_id", 2)
         if isinstance(eos, list):
             eos = eos[0]
+        if model_type == "opt":
+            return ModelConfig._from_opt_config(
+                model, hf, max_model_len=max_model_len, dtype=dtype
+            )
         return ModelConfig(
             model=model,
             model_type=model_type,
@@ -113,6 +128,77 @@ class ModelConfig:
             num_experts_per_tok=hf.get("num_experts_per_tok", 0),
             attention_bias=hf.get("attention_bias", False),
             mlp_bias=hf.get("mlp_bias", False),
+        )
+
+    @staticmethod
+    def _from_opt_config(
+        model: str,
+        hf: dict,
+        *,
+        max_model_len: int | None = None,
+        dtype: str = "auto",
+    ) -> "ModelConfig":
+        """OPT decoder (BASELINE.json config: opt-125m single Generate).
+
+        Same paged-KV skeleton, different block chemistry: learned
+        positional embeddings with the HF offset-by-2 table, pre-LayerNorm
+        with biases, plain fc1/ReLU/fc2 MLP, biased out-projection, MHA.
+        """
+        if not hf.get("do_layer_norm_before", True):
+            raise ValueError(
+                "post-norm OPT variants (do_layer_norm_before=false, e.g. "
+                "opt-350m) are not supported"
+            )
+        hidden = hf["hidden_size"]
+        proj = hf.get("word_embed_proj_dim", hidden)
+        if proj != hidden:
+            raise ValueError(
+                f"OPT word_embed_proj_dim={proj} != hidden_size={hidden} "
+                "(projected-embedding variants are not supported)"
+            )
+        heads = hf["num_attention_heads"]
+        derived_len = hf.get("max_position_embeddings", 2048)
+        if max_model_len and max_model_len > derived_len:
+            # positions past the learned table would silently clip to its
+            # last row (models/llama.py _embed) — wrong hidden states, so
+            # reject like the other unsupported-variant checks above
+            raise ValueError(
+                f"max_model_len={max_model_len} exceeds OPT's learned-"
+                f"position table ({derived_len} positions)"
+            )
+        bias = hf.get("enable_bias", True)
+        eos = hf.get("eos_token_id", 2)
+        if isinstance(eos, list):
+            eos = eos[0]
+        return ModelConfig(
+            model=model,
+            model_type="opt",
+            vocab_size=hf["vocab_size"],
+            hidden_size=hidden,
+            intermediate_size=hf["ffn_dim"],
+            num_layers=hf["num_hidden_layers"],
+            num_heads=heads,
+            num_kv_heads=heads,
+            head_dim=hidden // heads,
+            max_model_len=max_model_len or derived_len,
+            # layernorm epsilon rides the rms_norm_eps field (HF
+            # OPTConfig has no eps knob; torch LayerNorm default)
+            rms_norm_eps=1e-5,
+            tie_word_embeddings=hf.get("tie_word_embeddings", True),
+            dtype=resolve_dtype(dtype),
+            eos_token_id=eos,
+            bos_token_id=hf.get("bos_token_id", 2) or 2,
+            attention_bias=bias,
+            attention_out_bias=bias,
+            mlp_bias=bias,
+            position_embedding="learned",
+            norm_type="layernorm",
+            hidden_act=hf.get("activation_function", "relu"),
+            gated_mlp=False,
+            # HF OPTLearnedPositionalEmbedding: table rows = max_pos + 2,
+            # lookup index = position + 2
+            num_position_embeddings=derived_len + 2,
+            learned_pos_offset=2,
         )
 
     @staticmethod

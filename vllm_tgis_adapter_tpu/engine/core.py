@@ -87,7 +87,7 @@ class LLMEngine:
         AsyncLLMEngine.from_config); None = all visible devices."""
         from transformers import AutoTokenizer
 
-        from vllm_tgis_adapter_tpu.engine.weights import load_llama_params
+        from vllm_tgis_adapter_tpu.engine.weights import load_model_params
         from vllm_tgis_adapter_tpu.models import get_model_class
 
         from vllm_tgis_adapter_tpu.parallel import (
@@ -112,7 +112,7 @@ class LLMEngine:
             validate_tp_divisibility(mcfg, mesh.shape["tp"])
             place = make_place_fn(mesh)
         logger.info("loading weights from %s", mcfg.model)
-        params = load_llama_params(mcfg, mcfg.model, place=place)
+        params = load_model_params(mcfg, mcfg.model, place=place)
 
         # the draft loads BEFORE the engine so the KV-pool auto-sizing
         # (resolve_num_blocks, driven by post-weights free HBM) sees the
@@ -125,7 +125,7 @@ class LLMEngine:
             )
             draft_cfg = spec.draft_model_config
             draft_model = get_model_class(draft_cfg.model_type)(draft_cfg)
-            draft_params = load_llama_params(
+            draft_params = load_model_params(
                 draft_cfg, spec.draft_model, place=place
             )
 

@@ -150,3 +150,89 @@ def load_llama_params(
         logger.warning("ignored %d unexpected checkpoint tensors: %s",
                        len(ignored), ignored[:5])
     return params
+
+
+def load_opt_params(
+    config: "ModelConfig",
+    model_path: str,
+    place: Optional[PlaceFn] = None,
+) -> dict:
+    """OPT checkpoint → the shared decoder param pytree.
+
+    HF OPT names: ``model.decoder.layers.N.self_attn.{q,k,v,out}_proj``,
+    ``fc1``/``fc2``, ``self_attn_layer_norm`` (pre-attention LN) and the
+    confusingly-named per-layer ``final_layer_norm`` (pre-MLP LN), plus a
+    decoder-level ``final_layer_norm`` and the offset-by-2
+    ``embed_positions`` table.  Some exports drop the ``model.`` prefix;
+    both spellings are accepted.
+    """
+    place = place or (lambda _name, x: x)
+    dtype = config.dtype
+    raw = CheckpointIndex(model_path)
+
+    def take(name: str, transpose: bool = False) -> jax.Array:
+        for cand in (f"model.{name}", name):
+            if cand in raw:
+                x = _np_to_jnp(raw.pop(cand), dtype)
+                if transpose:
+                    x = x.T
+                return place(cand, x)
+        raise ValueError(f"checkpoint is missing tensor {name!r}")
+
+    params: dict = {
+        "embed": take("decoder.embed_tokens.weight"),
+        "pos_embed": take("decoder.embed_positions.weight"),
+        "final_norm": take("decoder.final_layer_norm.weight"),
+        "final_norm_bias": take("decoder.final_layer_norm.bias"),
+        "layers": [],
+    }
+    if not config.tie_word_embeddings:
+        params["lm_head"] = take("lm_head.weight", transpose=True)
+    else:
+        # tied exports often still materialise the duplicate tensor
+        for cand in ("lm_head.weight", "model.lm_head.weight"):
+            if cand in raw:
+                raw.pop(cand)
+
+    for i in range(config.num_layers):
+        prefix = f"decoder.layers.{i}"
+        layer = {
+            "input_norm": take(f"{prefix}.self_attn_layer_norm.weight"),
+            "input_norm_bias": take(f"{prefix}.self_attn_layer_norm.bias"),
+            "post_attn_norm": take(f"{prefix}.final_layer_norm.weight"),
+            "post_attn_norm_bias": take(f"{prefix}.final_layer_norm.bias"),
+            "wq": take(f"{prefix}.self_attn.q_proj.weight", transpose=True),
+            "wk": take(f"{prefix}.self_attn.k_proj.weight", transpose=True),
+            "wv": take(f"{prefix}.self_attn.v_proj.weight", transpose=True),
+            "wo": take(f"{prefix}.self_attn.out_proj.weight",
+                       transpose=True),
+            "w_up": take(f"{prefix}.fc1.weight", transpose=True),
+            "w_down": take(f"{prefix}.fc2.weight", transpose=True),
+        }
+        if config.attention_bias:
+            layer["bq"] = take(f"{prefix}.self_attn.q_proj.bias")
+            layer["bk"] = take(f"{prefix}.self_attn.k_proj.bias")
+            layer["bv"] = take(f"{prefix}.self_attn.v_proj.bias")
+        if config.attention_out_bias:
+            layer["bo"] = take(f"{prefix}.self_attn.out_proj.bias")
+        if config.mlp_bias:
+            layer["b_up"] = take(f"{prefix}.fc1.bias")
+            layer["b_down"] = take(f"{prefix}.fc2.bias")
+        params["layers"].append(layer)
+
+    ignored = raw.remaining()
+    if ignored:
+        logger.warning("ignored %d unexpected checkpoint tensors: %s",
+                       len(ignored), ignored[:5])
+    return params
+
+
+def load_model_params(
+    config: "ModelConfig",
+    model_path: str,
+    place: Optional[PlaceFn] = None,
+) -> dict:
+    """Dispatch to the checkpoint layout for ``config.model_type``."""
+    if config.model_type == "opt":
+        return load_opt_params(config, model_path, place)
+    return load_llama_params(config, model_path, place)

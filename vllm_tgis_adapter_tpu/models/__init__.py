@@ -19,8 +19,11 @@ _REGISTRY = {
     # sparse-MoE variant of the same skeleton: layers carry a router +
     # stacked expert FFNs instead of one dense MLP (llama.py _moe_mlp)
     "mixtral": LlamaForCausalLM,
+    # OPT lineage (BASELINE.json: opt-125m): learned positions,
+    # pre-LayerNorm + biases, fc1/ReLU/fc2 — static config branches in
+    # the same skeleton (config.py _from_opt_config)
+    "opt": LlamaForCausalLM,
     "gpt_neox": None,  # reserved
-    "opt": None,  # reserved
 }
 
 

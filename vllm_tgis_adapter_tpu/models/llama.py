@@ -1,9 +1,14 @@
-"""Llama-family decoder in pure JAX over the paged KV cache.
+"""Decoder-family models in pure JAX over the paged KV cache.
 
-Covers llama / mistral / granite / qwen2 (the reference stack's flagship
-models, BASELINE.json) as one parameterised skeleton: RMSNorm → GQA
-attention with rotary embeddings → SwiGLU MLP, pre-norm residuals, optional
-granite scaling multipliers and qwen-style attention biases.
+Covers llama / mistral / granite / qwen2 / mixtral (the reference stack's
+flagship models, BASELINE.json) as one parameterised skeleton: RMSNorm →
+GQA attention with rotary embeddings → SwiGLU MLP, pre-norm residuals,
+optional granite scaling multipliers and qwen-style attention biases —
+plus the OPT lineage (BASELINE.json: opt-125m) through static config
+branches: learned positional embeddings (HF offset-by-2 table),
+pre-LayerNorm with biases, plain fc1/ReLU/fc2 MLP, biased
+out-projection.  Every branch is plain Python on frozen config, so each
+architecture still traces to one straight-line XLA program.
 
 Design notes (TPU-first, SURVEY.md §7):
 * params are a plain pytree (list of per-layer dicts) — no framework
@@ -35,6 +40,28 @@ def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
     var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
     x = x * jax.lax.rsqrt(var + eps)
     return (x * weight.astype(jnp.float32)).astype(dtype)
+
+
+def layer_norm(
+    x: jax.Array, weight: jax.Array, bias: jax.Array, eps: float
+) -> jax.Array:
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=-1, keepdims=True)
+    x = (x - mean) * jax.lax.rsqrt(var + eps)
+    out = x * weight.astype(jnp.float32) + bias.astype(jnp.float32)
+    return out.astype(dtype)
+
+
+_ACTIVATIONS = {
+    "silu": jax.nn.silu,
+    "relu": jax.nn.relu,
+    # HF "gelu" is the exact erf form; jax.nn.gelu defaults to the tanh
+    # approximation, which is HF's distinct "gelu_new"
+    "gelu": lambda x: jax.nn.gelu(x, approximate=False),
+    "gelu_new": lambda x: jax.nn.gelu(x, approximate=True),
+}
 
 
 def rotary_cos_sin(
@@ -108,6 +135,12 @@ class LlamaForCausalLM:
             "final_norm": jnp.ones((d,), dtype=cfg.dtype),
             "layers": [],
         }
+        if cfg.norm_type == "layernorm":
+            params["final_norm_bias"] = jnp.zeros((d,), dtype=cfg.dtype)
+        if cfg.position_embedding == "learned":
+            params["pos_embed"] = dense(
+                next(keys), (cfg.num_position_embeddings, d)
+            )
         if not cfg.tie_word_embeddings:
             params["lm_head"] = dense(next(keys), (d, cfg.vocab_size))
         for _ in range(cfg.num_layers):
@@ -120,6 +153,13 @@ class LlamaForCausalLM:
                 "wv": dense(next(lk), (d, hkv * dh)),
                 "wo": dense(next(lk), (h * dh, d)),
             }
+            if cfg.norm_type == "layernorm":
+                layer["input_norm_bias"] = jnp.zeros((d,), dtype=cfg.dtype)
+                layer["post_attn_norm_bias"] = jnp.zeros(
+                    (d,), dtype=cfg.dtype
+                )
+            if cfg.attention_out_bias:
+                layer["bo"] = jnp.zeros((d,), dtype=cfg.dtype)
             if cfg.num_experts > 0:
                 e = cfg.num_experts
 
@@ -133,10 +173,16 @@ class LlamaForCausalLM:
                 layer["experts_gate"] = stacked(next(lk), (e, d, f), d)
                 layer["experts_up"] = stacked(next(lk), (e, d, f), d)
                 layer["experts_down"] = stacked(next(lk), (e, f, d), f)
-            else:
+            elif cfg.gated_mlp:
                 layer["w_gate"] = dense(next(lk), (d, f))
                 layer["w_up"] = dense(next(lk), (d, f))
                 layer["w_down"] = dense(next(lk), (f, d))
+            else:
+                layer["w_up"] = dense(next(lk), (d, f))
+                layer["w_down"] = dense(next(lk), (f, d))
+                if cfg.mlp_bias:
+                    layer["b_up"] = jnp.zeros((f,), dtype=cfg.dtype)
+                    layer["b_down"] = jnp.zeros((d,), dtype=cfg.dtype)
             if cfg.attention_bias:
                 layer["bq"] = jnp.zeros((h * dh,), dtype=cfg.dtype)
                 layer["bk"] = jnp.zeros((hkv * dh,), dtype=cfg.dtype)
@@ -159,6 +205,30 @@ class LlamaForCausalLM:
         if cfg.attention_multiplier is not None:
             return cfg.attention_multiplier
         return cfg.head_dim**-0.5
+
+    def _norm(self, container: dict, x: jax.Array, name: str) -> jax.Array:
+        cfg = self.config
+        if cfg.norm_type == "layernorm":
+            return layer_norm(
+                x, container[name], container[f"{name}_bias"],
+                cfg.rms_norm_eps,
+            )
+        return rms_norm(x, container[name], cfg.rms_norm_eps)
+
+    def _rope_tables(self, positions: jax.Array):
+        """cos/sin for rotary models; None when positions enter at embed."""
+        cfg = self.config
+        if cfg.position_embedding != "rope":
+            return None
+        return rotary_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
+
+    def _apply_pos_qk(
+        self, q: jax.Array, k: jax.Array, tables
+    ) -> tuple[jax.Array, jax.Array]:
+        if tables is None:
+            return q, k
+        cos, sin = tables
+        return apply_rotary(q, cos, sin), apply_rotary(k, cos, sin)
 
     def _qkv(self, layer: dict, x: jax.Array, dl=None) -> tuple[jax.Array, ...]:
         cfg = self.config
@@ -183,12 +253,27 @@ class LlamaForCausalLM:
     def _mlp(self, layer: dict, x: jax.Array, dl=None) -> jax.Array:
         if "router" in layer:
             return self._moe_mlp(layer, x)
+        act = _ACTIVATIONS[self.config.hidden_act]
+        if not self.config.gated_mlp:
+            # fc1 → act → fc2 (OPT lineage), biases optional
+            h = x @ layer["w_up"]
+            if "b_up" in layer:
+                h = h + layer["b_up"]
+            if dl is not None:
+                h = h + dl("up_proj", x)
+            h = act(h)
+            out = h @ layer["w_down"]
+            if "b_down" in layer:
+                out = out + layer["b_down"]
+            if dl is not None:
+                out = out + dl("down_proj", h)
+            return out
         gate = x @ layer["w_gate"]
         up = x @ layer["w_up"]
         if dl is not None:
             gate = gate + dl("gate_proj", x)
             up = up + dl("up_proj", x)
-        h = jax.nn.silu(gate) * up
+        h = act(gate) * up
         out = h @ layer["w_down"]
         if dl is not None:
             out = out + dl("down_proj", h)
@@ -229,16 +314,27 @@ class LlamaForCausalLM:
             out * weights[..., None].astype(out.dtype), axis=1
         ).astype(x.dtype)
 
-    def _embed(self, params: dict, token_ids: jax.Array) -> jax.Array:
+    def _embed(
+        self, params: dict, token_ids: jax.Array, positions: jax.Array
+    ) -> jax.Array:
         cfg = self.config
         x = jnp.take(params["embed"], token_ids, axis=0)
         if cfg.embedding_multiplier != 1.0:
             x = x * cfg.embedding_multiplier
+        if cfg.position_embedding == "learned":
+            # clip keeps padding rows (positions past the table) in
+            # bounds; their outputs are discarded by the caller
+            idx = jnp.clip(
+                positions + cfg.learned_pos_offset,
+                0,
+                params["pos_embed"].shape[0] - 1,
+            )
+            x = x + jnp.take(params["pos_embed"], idx, axis=0)
         return x
 
     def _logits(self, params: dict, x: jax.Array) -> jax.Array:
         cfg = self.config
-        x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+        x = self._norm(params, x, "final_norm")
         if cfg.tie_word_embeddings:
             logits = x.astype(jnp.float32) @ params["embed"].T.astype(jnp.float32)
         else:
@@ -269,12 +365,12 @@ class LlamaForCausalLM:
         cfg = self.config
         k_cache, v_cache = caches
         scale = self._attention_scale()
-        cos, sin = rotary_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
+        tables = self._rope_tables(positions)
         # negative (padding) slots must not wrap: remap past the end, then
         # scatter mode='drop' discards them (JAX drops only positive OOB)
         safe_slots = jnp.where(slot_mapping < 0, k_cache.shape[2], slot_mapping)
 
-        x = self._embed(params, token_ids)
+        x = self._embed(params, token_ids, positions)
         for i, layer in enumerate(params["layers"]):
             dl = None
             if lora is not None:
@@ -283,10 +379,9 @@ class LlamaForCausalLM:
                         lora, i, lora_slot, target, xx
                     )
                 )
-            h = rms_norm(x, layer["input_norm"], cfg.rms_norm_eps)
+            h = self._norm(layer, x, "input_norm")
             q, k, v = self._qkv(layer, h, dl)
-            q = apply_rotary(q, cos, sin)
-            k = apply_rotary(k, cos, sin)
+            q, k = self._apply_pos_qk(q, k, tables)
             k_cache = k_cache.at[i, :, safe_slots].set(
                 k.astype(k_cache.dtype), mode="drop"
             )
@@ -297,11 +392,13 @@ class LlamaForCausalLM:
                                            mesh=self.mesh)
             o_flat = o.reshape(x.shape[0], -1)
             o = o_flat @ layer["wo"]
+            if "bo" in layer:
+                o = o + layer["bo"]
             if dl is not None:
                 o = o + dl("o_proj", o_flat)
             x = x + cfg.residual_multiplier * o
 
-            h = rms_norm(x, layer["post_attn_norm"], cfg.rms_norm_eps)
+            h = self._norm(layer, x, "post_attn_norm")
             x = x + cfg.residual_multiplier * self._mlp(layer, h, dl)
 
         if logits_indices is not None:
@@ -335,14 +432,14 @@ class LlamaForCausalLM:
         cfg = self.config
         k_cache, v_cache = caches
         scale = self._attention_scale()
-        cos, sin = rotary_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
+        tables = self._rope_tables(positions)
         safe_slots = jnp.where(slot_mapping < 0, k_cache.shape[2], slot_mapping)
 
         # the chunk's first global position; padding rows (beyond
         # valid_len) produce garbage the caller discards
         start = positions[0]
 
-        x = self._embed(params, token_ids)
+        x = self._embed(params, token_ids, positions)
         for i, layer in enumerate(params["layers"]):
             dl = None
             if lora is not None:
@@ -351,10 +448,9 @@ class LlamaForCausalLM:
                         lora, i, lora_slot, target, xx
                     )
                 )
-            h = rms_norm(x, layer["input_norm"], cfg.rms_norm_eps)
+            h = self._norm(layer, x, "input_norm")
             q, k, v = self._qkv(layer, h, dl)
-            q = apply_rotary(q, cos, sin)
-            k = apply_rotary(k, cos, sin)
+            q, k = self._apply_pos_qk(q, k, tables)
             k_cache = k_cache.at[i, :, safe_slots].set(
                 k.astype(k_cache.dtype), mode="drop"
             )
@@ -367,11 +463,13 @@ class LlamaForCausalLM:
             )
             o_flat = o.reshape(x.shape[0], -1)
             o = o_flat @ layer["wo"]
+            if "bo" in layer:
+                o = o + layer["bo"]
             if dl is not None:
                 o = o + dl("o_proj", o_flat)
             x = x + cfg.residual_multiplier * o
 
-            h = rms_norm(x, layer["post_attn_norm"], cfg.rms_norm_eps)
+            h = self._norm(layer, x, "post_attn_norm")
             x = x + cfg.residual_multiplier * self._mlp(layer, h, dl)
 
         if logits_indices is not None:
@@ -406,15 +504,14 @@ class LlamaForCausalLM:
         tables = jnp.repeat(block_tables, k, axis=0)  # [B*K, max_blocks]
         ctx_lens = jnp.clip(flat_pos + 1, 1, None)
 
-        cos, sin = rotary_cos_sin(flat_pos, cfg.head_dim, cfg.rope_theta)
+        rope = self._rope_tables(flat_pos)
         safe_slots = jnp.where(flat_slots < 0, k_cache.shape[2], flat_slots)
 
-        x = self._embed(params, flat_tokens)
+        x = self._embed(params, flat_tokens, flat_pos)
         for i, layer in enumerate(params["layers"]):
-            h = rms_norm(x, layer["input_norm"], cfg.rms_norm_eps)
+            h = self._norm(layer, x, "input_norm")
             q, kk, v = self._qkv(layer, h)
-            q = apply_rotary(q, cos, sin)
-            kk = apply_rotary(kk, cos, sin)
+            q, kk = self._apply_pos_qk(q, kk, rope)
             k_cache = k_cache.at[i, :, safe_slots].set(
                 kk.astype(k_cache.dtype), mode="drop"
             )
@@ -426,9 +523,11 @@ class LlamaForCausalLM:
                 block_size, scale, mesh=self.mesh,
             )
             o = o.reshape(x.shape[0], -1) @ layer["wo"]
+            if "bo" in layer:
+                o = o + layer["bo"]
             x = x + cfg.residual_multiplier * o
 
-            h = rms_norm(x, layer["post_attn_norm"], cfg.rms_norm_eps)
+            h = self._norm(layer, x, "post_attn_norm")
             x = x + cfg.residual_multiplier * self._mlp(layer, h)
 
         logits = self._logits(params, x)  # [B*K, V]
@@ -451,11 +550,11 @@ class LlamaForCausalLM:
         cfg = self.config
         k_cache, v_cache = caches
         scale = self._attention_scale()
-        cos, sin = rotary_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
+        tables = self._rope_tables(positions)
         # see prefill: negative pad slots must not wrap to the last page
         safe_slots = jnp.where(slot_mapping < 0, k_cache.shape[2], slot_mapping)
 
-        x = self._embed(params, token_ids)
+        x = self._embed(params, token_ids, positions)
         for i, layer in enumerate(params["layers"]):
             dl = None
             if lora is not None:
@@ -464,10 +563,9 @@ class LlamaForCausalLM:
                         lora, i, lora_idx, target, xx
                     )
                 )
-            h = rms_norm(x, layer["input_norm"], cfg.rms_norm_eps)
+            h = self._norm(layer, x, "input_norm")
             q, k, v = self._qkv(layer, h, dl)
-            q = apply_rotary(q, cos, sin)
-            k = apply_rotary(k, cos, sin)
+            q, k = self._apply_pos_qk(q, k, tables)
             k_cache = k_cache.at[i, :, safe_slots].set(
                 k.astype(k_cache.dtype), mode="drop"
             )
@@ -480,11 +578,13 @@ class LlamaForCausalLM:
             )
             o_flat = o.reshape(x.shape[0], -1)
             o = o_flat @ layer["wo"]
+            if "bo" in layer:
+                o = o + layer["bo"]
             if dl is not None:
                 o = o + dl("o_proj", o_flat)
             x = x + cfg.residual_multiplier * o
 
-            h = rms_norm(x, layer["post_attn_norm"], cfg.rms_norm_eps)
+            h = self._norm(layer, x, "post_attn_norm")
             x = x + cfg.residual_multiplier * self._mlp(layer, h, dl)
 
         return self._logits(params, x), (k_cache, v_cache)

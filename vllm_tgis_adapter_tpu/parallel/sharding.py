@@ -54,7 +54,9 @@ def validate_tp_divisibility(config: "ModelConfig", tp: int) -> None:
 
 _LAYER_SPECS = {
     "input_norm": P(None),
+    "input_norm_bias": P(None),
     "post_attn_norm": P(None),
+    "post_attn_norm_bias": P(None),
     "wq": P(None, TP_AXIS),
     "wk": P(None, TP_AXIS),
     "wv": P(None, TP_AXIS),
@@ -65,6 +67,11 @@ _LAYER_SPECS = {
     "bq": P(TP_AXIS),
     "bk": P(TP_AXIS),
     "bv": P(TP_AXIS),
+    # row-parallel output biases: replicated, added once after the psum
+    "bo": P(None),
+    "b_down": P(None),
+    # column-parallel fc1 bias follows its weight's tp split
+    "b_up": P(TP_AXIS),
     "router": P(None, None),
 }
 
@@ -91,6 +98,11 @@ def llama_param_specs(params: dict, tp: int = 1) -> dict:
         "embed": P(TP_AXIS, None),
         "final_norm": P(None),
     }
+    if "final_norm_bias" in params:
+        specs["final_norm_bias"] = P(None)
+    if "pos_embed" in params:
+        # tiny table (max_len rows); replicate rather than shard
+        specs["pos_embed"] = P(None, None)
     if "lm_head" in params:
         specs["lm_head"] = P(None, TP_AXIS)
 
@@ -147,7 +159,16 @@ _HF_NAME_SPECS = (
     ("w1.weight", P(None, TP_AXIS)),
     ("w3.weight", P(None, TP_AXIS)),
     ("w2.weight", P(TP_AXIS, None)),
+    # OPT lineage: out_proj/fc1/fc2 + biases, learned position table
+    ("out_proj.weight", P(TP_AXIS, None)),
+    ("out_proj.bias", P()),
+    ("fc1.weight", P(None, TP_AXIS)),
+    ("fc1.bias", P(TP_AXIS)),
+    ("fc2.weight", P(TP_AXIS, None)),
+    ("fc2.bias", P()),
+    ("embed_positions.weight", P()),
     ("norm.weight", P(None)),
+    ("norm.bias", P(None)),
     ("layernorm.weight", P(None)),
 )
 
