@@ -44,6 +44,26 @@ TINY_LLAMA_CONFIG = {
 }
 
 
+def hf_reference_model(model_dir: str):
+    """Torch-side gold reference for numerical-parity tests (shared by
+    test_model_correctness / test_opt / test_gpt_neox so HF loading
+    settings cannot silently diverge between families)."""
+    import torch
+    from transformers import AutoModelForCausalLM
+
+    hf = AutoModelForCausalLM.from_pretrained(
+        model_dir, torch_dtype=torch.float32
+    )
+    hf.eval()
+    return hf
+
+
+def hf_tokenize(model_dir: str, text: str) -> list:
+    from transformers import AutoTokenizer
+
+    return AutoTokenizer.from_pretrained(model_dir)(text).input_ids
+
+
 def build_tokenizer(path: str, vocab_size: int = 512):
     from tokenizers import Tokenizer, decoders, models, pre_tokenizers, trainers
     from transformers import PreTrainedTokenizerFast
@@ -256,6 +276,80 @@ def build_tiny_opt(path: str, seed: int = 0) -> str:
             f"{p}.fc1.bias": b(inter),
             f"{p}.fc2.weight": w((d, inter)),
             f"{p}.fc2.bias": b(d),
+        }
+    save_file(tensors, out / "model.safetensors")
+    return str(out)
+
+
+TINY_GPT_NEOX_CONFIG = {
+    "architectures": ["GPTNeoXForCausalLM"],
+    "model_type": "gpt_neox",
+    "vocab_size": 512,
+    "hidden_size": 64,
+    "intermediate_size": 128,
+    "num_hidden_layers": 2,
+    "num_attention_heads": 4,
+    "max_position_embeddings": 512,
+    "rotary_pct": 0.25,
+    "rotary_emb_base": 10000,
+    "layer_norm_eps": 1e-5,
+    "use_parallel_residual": True,
+    "hidden_act": "gelu",
+    "tie_word_embeddings": False,
+    "bos_token_id": 1,
+    "eos_token_id": 2,
+    "torch_dtype": "float32",
+}
+
+
+def build_tiny_gpt_neox(path: str, seed: int = 0) -> str:
+    """Tiny GPT-NeoX/Pythia checkpoint in HF naming: fused
+    head-interleaved query_key_value, parallel residual, partial rotary,
+    untied embed_out head."""
+    import numpy as np
+    from safetensors.numpy import save_file
+
+    out = Path(path)
+    out.mkdir(parents=True, exist_ok=True)
+
+    tokenizer = build_tokenizer(path)
+    cfg = dict(TINY_GPT_NEOX_CONFIG)
+    cfg["vocab_size"] = max(cfg["vocab_size"], len(tokenizer))
+    with open(out / "config.json", "w") as f:
+        json.dump(cfg, f, indent=2)
+
+    rng = np.random.default_rng(seed)
+    d = cfg["hidden_size"]
+    inter = cfg["intermediate_size"]
+    vocab = cfg["vocab_size"]
+
+    def w(shape):
+        return (rng.standard_normal(shape) * 0.02).astype(np.float32)
+
+    def b(n):
+        return (rng.standard_normal(n) * 0.01).astype(np.float32)
+
+    tensors = {
+        "gpt_neox.embed_in.weight": w((vocab, d)),
+        "gpt_neox.final_layer_norm.weight": np.ones(d, np.float32),
+        "gpt_neox.final_layer_norm.bias": b(d),
+        "embed_out.weight": w((vocab, d)),
+    }
+    for i in range(cfg["num_hidden_layers"]):
+        p = f"gpt_neox.layers.{i}"
+        tensors |= {
+            f"{p}.input_layernorm.weight": np.ones(d, np.float32),
+            f"{p}.input_layernorm.bias": b(d),
+            f"{p}.post_attention_layernorm.weight": np.ones(d, np.float32),
+            f"{p}.post_attention_layernorm.bias": b(d),
+            f"{p}.attention.query_key_value.weight": w((3 * d, d)),
+            f"{p}.attention.query_key_value.bias": b(3 * d),
+            f"{p}.attention.dense.weight": w((d, d)),
+            f"{p}.attention.dense.bias": b(d),
+            f"{p}.mlp.dense_h_to_4h.weight": w((inter, d)),
+            f"{p}.mlp.dense_h_to_4h.bias": b(inter),
+            f"{p}.mlp.dense_4h_to_h.weight": w((d, inter)),
+            f"{p}.mlp.dense_4h_to_h.bias": b(d),
         }
     save_file(tensors, out / "model.safetensors")
     return str(out)

@@ -1,15 +1,12 @@
-"""OPT model family: numerical parity vs HF torch + engine e2e.
+"""GPT-NeoX / Pythia family: numerical parity vs HF torch + engine e2e.
 
-BASELINE.json's first benchmark config is "opt-125m single Generate" —
-the reference CI's model class (reference tests/conftest.py:85-89 boots
-an opt-class tiny model).  OPT runs through the same decoder skeleton as
-the llama lineage via static config branches (models/llama.py): learned
-offset-by-2 positional embeddings, pre-LayerNorm with biases,
-fc1/ReLU/fc2 MLP, biased out-projection, MHA paged KV.
+Fourth architecture family through the shared decoder skeleton
+(models/llama.py): partial rotary (rotary_pct of each head), parallel
+attention+MLP residual, pre-LayerNorm with biases, fused head-interleaved
+query_key_value checkpoints (de-interleaved at load,
+engine/weights.py load_gpt_neox_params), untied embed_out lm_head.
 
-Gold-standard checks mirror tests/test_model_correctness.py: identical
-weights + inputs must reproduce HF torch logits and greedy generate
-tokens exactly (float32 tolerances).
+Gold-standard checks mirror tests/test_model_correctness.py.
 """
 
 from __future__ import annotations
@@ -21,45 +18,43 @@ from tests.fixture_models import hf_reference_model, hf_tokenize
 
 
 @pytest.fixture(scope="module")
-def opt_dir(tmp_path_factory):
-    from tests.fixture_models import build_tiny_opt
+def neox_dir(tmp_path_factory):
+    from tests.fixture_models import build_tiny_gpt_neox
 
-    return build_tiny_opt(str(tmp_path_factory.mktemp("tiny-opt")))
+    return build_tiny_gpt_neox(str(tmp_path_factory.mktemp("tiny-neox")))
 
 
 @pytest.fixture(scope="module")
-def setup(opt_dir):
+def setup(neox_dir):
     import jax.numpy as jnp
 
     from vllm_tgis_adapter_tpu.engine.config import ModelConfig
     from vllm_tgis_adapter_tpu.engine.weights import load_model_params
     from vllm_tgis_adapter_tpu.models import get_model_class
 
-    config = ModelConfig.from_pretrained(opt_dir, dtype="float32")
+    config = ModelConfig.from_pretrained(neox_dir, dtype="float32")
     model = get_model_class(config.model_type)(config)
-    params = load_model_params(config, opt_dir)
+    params = load_model_params(config, neox_dir)
     caches = model.make_kv_caches(num_slots=1024, dtype=jnp.float32)
-    return opt_dir, config, model, params, caches
+    return neox_dir, config, model, params, caches
 
 
-def test_opt_config_mapping(setup):
+def test_neox_config_mapping(setup):
     _, config, _, params, _ = setup
-    assert config.model_type == "opt"
-    assert config.position_embedding == "learned"
-    assert config.learned_pos_offset == 2
+    assert config.model_type == "gpt_neox"
+    assert config.parallel_residual
+    assert config.rotary_dim == 4  # head_dim 16 × rotary_pct 0.25
     assert config.norm_type == "layernorm"
-    assert not config.gated_mlp
-    assert config.num_kv_heads == config.num_heads  # MHA
-    assert "pos_embed" in params
-    assert "lm_head" not in params  # tied
+    assert not config.gated_mlp and config.hidden_act == "gelu"
+    assert "lm_head" in params  # untied embed_out
     layer = params["layers"][0]
-    for name in ("bq", "bk", "bv", "bo", "b_up", "b_down",
-                 "input_norm_bias", "post_attn_norm_bias"):
+    # fused qkv was de-interleaved into standard projections
+    for name in ("wq", "wk", "wv", "bq", "bk", "bv", "bo",
+                 "b_up", "b_down"):
         assert name in layer, name
-    assert "w_gate" not in layer
 
 
-def test_opt_prefill_logits_match_hf(setup):
+def test_neox_prefill_logits_match_hf(setup):
     import jax.numpy as jnp
     import torch
 
@@ -82,41 +77,7 @@ def test_opt_prefill_logits_match_hf(setup):
     )
 
 
-def test_opt_padded_prefill_matches_unpadded(setup):
-    """Bucket padding must not perturb real positions — the learned
-    position lookup for pad rows (positions -1/clipped) must stay out of
-    the real rows' outputs."""
-    import jax.numpy as jnp
-
-    model_dir, config, model, params, caches = setup
-    input_ids = hf_tokenize(model_dir, "hello world")
-    t, bucket = len(input_ids), 32
-
-    logits, _ = model.prefill(
-        params, caches,
-        jnp.asarray(input_ids, dtype=jnp.int32),
-        jnp.arange(t, dtype=jnp.int32),
-        jnp.arange(t, dtype=jnp.int32),
-        jnp.asarray(t, dtype=jnp.int32),
-    )
-    padded = input_ids + [0] * (bucket - t)
-    logits_padded, _ = model.prefill(
-        params, caches,
-        jnp.asarray(padded, dtype=jnp.int32),
-        jnp.arange(bucket, dtype=jnp.int32),
-        jnp.concatenate(
-            [jnp.arange(t, dtype=jnp.int32),
-             jnp.full((bucket - t,), -1, dtype=jnp.int32)]
-        ),
-        jnp.asarray(t, dtype=jnp.int32),
-    )
-    np.testing.assert_allclose(
-        np.asarray(logits), np.asarray(logits_padded)[:t],
-        rtol=1e-4, atol=1e-4,
-    )
-
-
-def test_opt_greedy_decode_matches_hf_generate(setup):
+def test_neox_greedy_decode_matches_hf_generate(setup):
     import jax.numpy as jnp
     import torch
 
@@ -165,11 +126,7 @@ def test_opt_greedy_decode_matches_hf_generate(setup):
     assert produced == expected
 
 
-def test_opt_engine_end_to_end(opt_dir):
-    """The full engine slice serves OPT: admission → bucketed prefill →
-    continuous-batching decode → outputs, greedy-deterministic."""
-    import jax.numpy as jnp
-
+def test_neox_engine_end_to_end(neox_dir):
     from vllm_tgis_adapter_tpu.engine.config import (
         CacheConfig,
         EngineConfig,
@@ -181,7 +138,7 @@ def test_opt_engine_end_to_end(opt_dir):
     from vllm_tgis_adapter_tpu.engine.core import LLMEngine
     from vllm_tgis_adapter_tpu.engine.sampling_params import SamplingParams
 
-    mcfg = ModelConfig.from_pretrained(opt_dir, dtype="float32")
+    mcfg = ModelConfig.from_pretrained(neox_dir, dtype="float32")
     config = EngineConfig(
         model_config=mcfg,
         cache_config=CacheConfig(block_size=16, num_blocks=64,
@@ -194,7 +151,7 @@ def test_opt_engine_end_to_end(opt_dir):
     engine = LLMEngine.from_config(config)
     for i in range(3):
         engine.add_request(
-            f"opt-{i}", f"tell me about topic {i}",
+            f"neox-{i}", f"tell me about topic {i}",
             SamplingParams(temperature=0.0, max_tokens=8, ignore_eos=True),
         )
     done = {}
@@ -204,24 +161,51 @@ def test_opt_engine_end_to_end(opt_dir):
         for out in engine.step():
             if out.finished:
                 done[out.request_id] = out
-    assert set(done) == {"opt-0", "opt-1", "opt-2"}
+    assert set(done) == {"neox-0", "neox-1", "neox-2"}
     for out in done.values():
         assert len(out.outputs[0].token_ids) == 8
-        assert out.outputs[0].text  # detokenizer produced something
 
 
-def test_opt_rejects_post_norm_variant(tmp_path):
-    """opt-350m-style post-norm configs must fail fast, not run wrong."""
-    import json
-
-    from tests.fixture_models import TINY_OPT_CONFIG
+def test_neox_tp2_matches_single_device(neox_dir):
+    """The de-interleaved fused QKV must shard correctly: TP=2 logits
+    equal single-device logits (the de-interleave put each head's rows
+    contiguous, which the Megatron column split requires)."""
+    import jax
+    import jax.numpy as jnp
 
     from vllm_tgis_adapter_tpu.engine.config import ModelConfig
+    from vllm_tgis_adapter_tpu.engine.weights import load_model_params
+    from vllm_tgis_adapter_tpu.models import get_model_class
+    from vllm_tgis_adapter_tpu.parallel import build_mesh, make_place_fn
 
-    cfg = dict(TINY_OPT_CONFIG)
-    cfg["do_layer_norm_before"] = False
-    path = tmp_path / "post-norm-opt"
-    path.mkdir()
-    (path / "config.json").write_text(json.dumps(cfg))
-    with pytest.raises(ValueError, match="post-norm"):
-        ModelConfig.from_pretrained(str(path))
+    config = ModelConfig.from_pretrained(neox_dir, dtype="float32")
+    model = get_model_class(config.model_type)(config)
+    params = load_model_params(config, neox_dir)
+    caches = model.make_kv_caches(num_slots=256, dtype=jnp.float32)
+
+    input_ids = hf_tokenize(neox_dir, "sharding parity probe")
+    t = len(input_ids)
+    args = (
+        jnp.asarray(input_ids, dtype=jnp.int32),
+        jnp.arange(t, dtype=jnp.int32),
+        jnp.arange(t, dtype=jnp.int32),
+        jnp.asarray(t, dtype=jnp.int32),
+    )
+    ref, _ = model.prefill(params, caches, *args)
+
+    mesh = build_mesh(tensor_parallel_size=2,
+                      devices=jax.devices()[:2])
+    place = make_place_fn(mesh)
+    sharded_params = load_model_params(config, neox_dir, place=place)
+    tp_model = get_model_class(config.model_type)(config)
+    tp_model.mesh = mesh
+    from vllm_tgis_adapter_tpu.parallel.sharding import cache_sharding
+
+    tp_caches = jax.device_put(
+        model.make_kv_caches(num_slots=256, dtype=jnp.float32),
+        cache_sharding(mesh),
+    )
+    got, _ = tp_model.prefill(sharded_params, tp_caches, *args)
+    np.testing.assert_allclose(
+        np.asarray(ref), np.asarray(got), rtol=1e-4, atol=1e-4
+    )

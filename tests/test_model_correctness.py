@@ -10,6 +10,8 @@ from __future__ import annotations
 import numpy as np
 import pytest
 
+from tests.fixture_models import hf_reference_model, hf_tokenize
+
 
 @pytest.fixture(scope="module")
 def setup(tiny_model_dir):
@@ -26,30 +28,12 @@ def setup(tiny_model_dir):
     return tiny_model_dir, config, model, params, caches
 
 
-def _hf_model(model_dir):
-    import torch
-    from transformers import AutoModelForCausalLM
-
-    hf = AutoModelForCausalLM.from_pretrained(
-        model_dir, torch_dtype=torch.float32
-    )
-    hf.eval()
-    return hf
-
-
-def _tokenize(model_dir, text):
-    from transformers import AutoTokenizer
-
-    tokenizer = AutoTokenizer.from_pretrained(model_dir)
-    return tokenizer(text).input_ids
-
-
 def test_prefill_logits_match_hf(setup):
     import jax.numpy as jnp
     import torch
 
     model_dir, config, model, params, caches = setup
-    input_ids = _tokenize(model_dir, "the quick brown fox jumps")
+    input_ids = hf_tokenize(model_dir, "the quick brown fox jumps")
     t = len(input_ids)
 
     logits, _ = model.prefill(
@@ -61,7 +45,7 @@ def test_prefill_logits_match_hf(setup):
         jnp.asarray(t, dtype=jnp.int32),
     )
 
-    hf = _hf_model(model_dir)
+    hf = hf_reference_model(model_dir)
     with torch.no_grad():
         hf_logits = hf(torch.tensor([input_ids])).logits[0].numpy()
 
@@ -75,7 +59,7 @@ def test_prefill_padding_invariance(setup):
     import jax.numpy as jnp
 
     model_dir, config, model, params, caches = setup
-    input_ids = _tokenize(model_dir, "hello world")
+    input_ids = hf_tokenize(model_dir, "hello world")
     t, bucket = len(input_ids), 32
 
     logits, _ = model.prefill(
@@ -106,14 +90,14 @@ def test_greedy_decode_matches_hf_generate(setup):
     import torch
 
     model_dir, config, model, params, caches = setup
-    input_ids = _tokenize(model_dir, "the capital of France")
+    input_ids = hf_tokenize(model_dir, "the capital of France")
     t = len(input_ids)
     new_tokens = 12
     block_size = 16
     max_blocks = 8
 
     # HF reference
-    hf = _hf_model(model_dir)
+    hf = hf_reference_model(model_dir)
     with torch.no_grad():
         hf_out = hf.generate(
             torch.tensor([input_ids]),

@@ -73,6 +73,10 @@ class ModelConfig:
     # learned-position table: row count and the OPT-style lookup offset
     num_position_embeddings: int = 0
     learned_pos_offset: int = 0
+    # gpt_neox-style partial rotary (0 = rotate the full head_dim) and
+    # parallel attention+MLP residual (x + attn(ln1 x) + mlp(ln2 x))
+    rotary_dim: int = 0
+    parallel_residual: bool = False
 
     @property
     def q_per_kv(self) -> int:
@@ -101,6 +105,10 @@ class ModelConfig:
             eos = eos[0]
         if model_type == "opt":
             return ModelConfig._from_opt_config(
+                model, hf, max_model_len=max_model_len, dtype=dtype
+            )
+        if model_type == "gpt_neox":
+            return ModelConfig._from_gpt_neox_config(
                 model, hf, max_model_len=max_model_len, dtype=dtype
             )
         return ModelConfig(
@@ -193,12 +201,88 @@ class ModelConfig:
             mlp_bias=bias,
             position_embedding="learned",
             norm_type="layernorm",
-            hidden_act=hf.get("activation_function", "relu"),
+            hidden_act=ModelConfig._validated_hidden_act(
+                hf.get("activation_function", "relu"), "opt"
+            ),
             gated_mlp=False,
             # HF OPTLearnedPositionalEmbedding: table rows = max_pos + 2,
             # lookup index = position + 2
             num_position_embeddings=derived_len + 2,
             learned_pos_offset=2,
+        )
+
+    @staticmethod
+    def _validated_hidden_act(act: str, model_type: str) -> str:
+        """Fail at config time, not with a KeyError mid-trace on the
+        first forward pass (HF has many ACT2FN names we don't map)."""
+        from vllm_tgis_adapter_tpu.models.llama import _ACTIVATIONS
+
+        if act not in _ACTIVATIONS:
+            raise ValueError(
+                f"{model_type}: hidden_act {act!r} is not supported; "
+                f"supported: {sorted(_ACTIVATIONS)}"
+            )
+        return act
+
+    @staticmethod
+    def _from_gpt_neox_config(
+        model: str,
+        hf: dict,
+        *,
+        max_model_len: int | None = None,
+        dtype: str = "auto",
+    ) -> "ModelConfig":
+        """GPT-NeoX / Pythia family: partial rotary (rotary_pct of each
+        head), parallel attention+MLP residual, pre-LayerNorm with
+        biases, fused-QKV checkpoints (de-interleaved by the loader),
+        plain fc1/GELU/fc2, untied embed_out lm_head, MHA."""
+        hidden = hf["hidden_size"]
+        heads = hf["num_attention_heads"]
+        head_dim = hidden // heads
+        rotary_pct = hf.get("rotary_pct", 0.25)
+        rotary_dim = int(head_dim * rotary_pct)
+        if rotary_dim % 2:
+            raise ValueError(
+                f"rotary_pct={rotary_pct} gives odd rotary_dim="
+                f"{rotary_dim} (head_dim {head_dim}); rotate-half needs "
+                "an even dimension"
+            )
+        eos = hf.get("eos_token_id", 0)
+        if isinstance(eos, list):
+            eos = eos[0]
+        return ModelConfig(
+            model=model,
+            model_type="gpt_neox",
+            vocab_size=hf["vocab_size"],
+            hidden_size=hidden,
+            intermediate_size=hf.get("intermediate_size", 4 * hidden),
+            num_layers=hf["num_hidden_layers"],
+            num_heads=heads,
+            num_kv_heads=heads,
+            head_dim=head_dim,
+            max_model_len=max_model_len
+            or hf.get("max_position_embeddings", 2048),
+            # legacy configs spell it rotary_emb_base; newer transformers
+            # serialise rope_theta
+            rope_theta=hf.get(
+                "rotary_emb_base", hf.get("rope_theta", 10000.0)
+            ),
+            # layernorm epsilon rides the rms_norm_eps field
+            rms_norm_eps=hf.get("layer_norm_eps", 1e-5),
+            tie_word_embeddings=hf.get("tie_word_embeddings", False),
+            dtype=resolve_dtype(dtype),
+            eos_token_id=eos,
+            bos_token_id=hf.get("bos_token_id", 0) or 0,
+            attention_bias=hf.get("attention_bias", True),
+            attention_out_bias=hf.get("attention_bias", True),
+            mlp_bias=True,
+            norm_type="layernorm",
+            hidden_act=ModelConfig._validated_hidden_act(
+                hf.get("hidden_act", "gelu"), "gpt_neox"
+            ),
+            gated_mlp=False,
+            rotary_dim=rotary_dim if rotary_dim != head_dim else 0,
+            parallel_residual=hf.get("use_parallel_residual", True),
         )
 
     @staticmethod

@@ -227,6 +227,97 @@ def load_opt_params(
     return params
 
 
+def load_gpt_neox_params(
+    config: "ModelConfig",
+    model_path: str,
+    place: Optional[PlaceFn] = None,
+) -> dict:
+    """GPT-NeoX / Pythia checkpoint → the shared decoder param pytree.
+
+    The attention projection ships FUSED and head-interleaved:
+    ``query_key_value.weight`` is ``[H·3·Dh, d]`` with each head's q, k,
+    v rows adjacent.  De-interleave to per-projection matrices BEFORE
+    mesh placement, so the split tensors land with the standard Megatron
+    column-parallel specs (placed under q/k/v_proj alias names, matching
+    parallel/sharding.py's suffix table).
+    """
+    place = place or (lambda _name, x: x)
+    dtype = config.dtype
+    raw = CheckpointIndex(model_path)
+    h, dh, d = config.num_heads, config.head_dim, config.hidden_size
+
+    def take(name: str, transpose: bool = False) -> jax.Array:
+        if name not in raw:
+            raise ValueError(f"checkpoint is missing tensor {name!r}")
+        x = _np_to_jnp(raw.pop(name), dtype)
+        if transpose:
+            x = x.T
+        return place(name, x)
+
+    def split_qkv(prefix: str) -> dict:
+        fused_w = _np_to_jnp(
+            raw.pop(f"{prefix}.query_key_value.weight"), dtype
+        ).reshape(h, 3, dh, d)
+        out = {}
+        for j, proj in enumerate(("q", "k", "v")):
+            w = fused_w[:, j].reshape(h * dh, d).T  # → [in, out]
+            out[f"w{proj}"] = place(f"{prefix}.{proj}_proj.weight", w)
+        if config.attention_bias:
+            fused_b = _np_to_jnp(
+                raw.pop(f"{prefix}.query_key_value.bias"), dtype
+            ).reshape(h, 3, dh)
+            for j, proj in enumerate(("q", "k", "v")):
+                out[f"b{proj}"] = place(
+                    f"{prefix}.{proj}_proj.bias",
+                    fused_b[:, j].reshape(h * dh),
+                )
+        return out
+
+    params: dict = {
+        "embed": take("gpt_neox.embed_in.weight"),
+        "final_norm": take("gpt_neox.final_layer_norm.weight"),
+        "final_norm_bias": take("gpt_neox.final_layer_norm.bias"),
+        "layers": [],
+    }
+    if not config.tie_word_embeddings:
+        params["lm_head"] = take("embed_out.weight", transpose=True)
+
+    for i in range(config.num_layers):
+        prefix = f"gpt_neox.layers.{i}"
+        layer = {
+            "input_norm": take(f"{prefix}.input_layernorm.weight"),
+            "input_norm_bias": take(f"{prefix}.input_layernorm.bias"),
+            "post_attn_norm": take(
+                f"{prefix}.post_attention_layernorm.weight"
+            ),
+            "post_attn_norm_bias": take(
+                f"{prefix}.post_attention_layernorm.bias"
+            ),
+            "wo": take(f"{prefix}.attention.dense.weight", transpose=True),
+            "bo": take(f"{prefix}.attention.dense.bias"),
+            "w_up": take(f"{prefix}.mlp.dense_h_to_4h.weight",
+                         transpose=True),
+            "b_up": take(f"{prefix}.mlp.dense_h_to_4h.bias"),
+            "w_down": take(f"{prefix}.mlp.dense_4h_to_h.weight",
+                           transpose=True),
+            "b_down": take(f"{prefix}.mlp.dense_4h_to_h.bias"),
+        }
+        layer |= split_qkv(f"{prefix}.attention")
+        params["layers"].append(layer)
+
+    # attention.bias / masked_bias are HF's precomputed causal-mask
+    # buffers, not weights
+    ignored = [
+        n for n in raw.remaining()
+        if "rotary_emb" not in n
+        and not n.endswith(("attention.bias", "attention.masked_bias"))
+    ]
+    if ignored:
+        logger.warning("ignored %d unexpected checkpoint tensors: %s",
+                       len(ignored), ignored[:5])
+    return params
+
+
 def load_model_params(
     config: "ModelConfig",
     model_path: str,
@@ -235,4 +326,6 @@ def load_model_params(
     """Dispatch to the checkpoint layout for ``config.model_type``."""
     if config.model_type == "opt":
         return load_opt_params(config, model_path, place)
+    if config.model_type == "gpt_neox":
+        return load_gpt_neox_params(config, model_path, place)
     return load_llama_params(config, model_path, place)

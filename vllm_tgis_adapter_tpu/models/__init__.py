@@ -23,7 +23,9 @@ _REGISTRY = {
     # pre-LayerNorm + biases, fc1/ReLU/fc2 — static config branches in
     # the same skeleton (config.py _from_opt_config)
     "opt": LlamaForCausalLM,
-    "gpt_neox": None,  # reserved
+    # GPT-NeoX / Pythia: partial rotary, parallel attn+MLP residual,
+    # fused-QKV checkpoints (config.py _from_gpt_neox_config)
+    "gpt_neox": LlamaForCausalLM,
 }
 
 

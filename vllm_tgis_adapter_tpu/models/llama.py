@@ -220,7 +220,8 @@ class LlamaForCausalLM:
         cfg = self.config
         if cfg.position_embedding != "rope":
             return None
-        return rotary_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
+        rd = cfg.rotary_dim or cfg.head_dim
+        return rotary_cos_sin(positions, rd, cfg.rope_theta)
 
     def _apply_pos_qk(
         self, q: jax.Array, k: jax.Array, tables
@@ -228,7 +229,46 @@ class LlamaForCausalLM:
         if tables is None:
             return q, k
         cos, sin = tables
-        return apply_rotary(q, cos, sin), apply_rotary(k, cos, sin)
+        rd = self.config.rotary_dim
+        if not rd or rd == self.config.head_dim:
+            return apply_rotary(q, cos, sin), apply_rotary(k, cos, sin)
+        # gpt_neox partial rotary: rotate the first rotary_dim dims of
+        # each head, pass the rest through unchanged
+        q = jnp.concatenate(
+            [apply_rotary(q[..., :rd], cos, sin), q[..., rd:]], axis=-1
+        )
+        k = jnp.concatenate(
+            [apply_rotary(k[..., :rd], cos, sin), k[..., rd:]], axis=-1
+        )
+        return q, k
+
+    def _decoder_block(
+        self, layer: dict, x: jax.Array, attend, dl, rope
+    ) -> jax.Array:
+        """One transformer block around an ``attend(q, k, v) -> [T, H, Dh]``
+        closure (the caller owns the KV-cache scatter and the attention
+        variant: dense prefill / chunked / paged decode)."""
+        cfg = self.config
+        h = self._norm(layer, x, "input_norm")
+        q, k, v = self._qkv(layer, h, dl)
+        q, k = self._apply_pos_qk(q, k, rope)
+        o = attend(q, k, v)
+        o_flat = o.reshape(x.shape[0], -1)
+        o = o_flat @ layer["wo"]
+        if "bo" in layer:
+            o = o + layer["bo"]
+        if dl is not None:
+            o = o + dl("o_proj", o_flat)
+        if cfg.parallel_residual:
+            # gpt_neox: x + attn(ln1 x) + mlp(ln2 x) — the MLP reads a
+            # second norm of the block INPUT, not of the attn residual
+            h = self._norm(layer, x, "post_attn_norm")
+            return x + cfg.residual_multiplier * (
+                o + self._mlp(layer, h, dl)
+            )
+        x = x + cfg.residual_multiplier * o
+        h = self._norm(layer, x, "post_attn_norm")
+        return x + cfg.residual_multiplier * self._mlp(layer, h, dl)
 
     def _qkv(self, layer: dict, x: jax.Array, dl=None) -> tuple[jax.Array, ...]:
         cfg = self.config
@@ -370,6 +410,17 @@ class LlamaForCausalLM:
         # scatter mode='drop' discards them (JAX drops only positive OOB)
         safe_slots = jnp.where(slot_mapping < 0, k_cache.shape[2], slot_mapping)
 
+        def attend(i, q, k, v):
+            nonlocal k_cache, v_cache
+            k_cache = k_cache.at[i, :, safe_slots].set(
+                k.astype(k_cache.dtype), mode="drop"
+            )
+            v_cache = v_cache.at[i, :, safe_slots].set(
+                v.astype(v_cache.dtype), mode="drop"
+            )
+            return attn_ops.prefill_attention(q, k, v, scale, valid_len,
+                                              mesh=self.mesh)
+
         x = self._embed(params, token_ids, positions)
         for i, layer in enumerate(params["layers"]):
             dl = None
@@ -379,27 +430,10 @@ class LlamaForCausalLM:
                         lora, i, lora_slot, target, xx
                     )
                 )
-            h = self._norm(layer, x, "input_norm")
-            q, k, v = self._qkv(layer, h, dl)
-            q, k = self._apply_pos_qk(q, k, tables)
-            k_cache = k_cache.at[i, :, safe_slots].set(
-                k.astype(k_cache.dtype), mode="drop"
+            x = self._decoder_block(
+                layer, x, lambda q, k, v, i=i: attend(i, q, k, v), dl,
+                tables,
             )
-            v_cache = v_cache.at[i, :, safe_slots].set(
-                v.astype(v_cache.dtype), mode="drop"
-            )
-            o = attn_ops.prefill_attention(q, k, v, scale, valid_len,
-                                           mesh=self.mesh)
-            o_flat = o.reshape(x.shape[0], -1)
-            o = o_flat @ layer["wo"]
-            if "bo" in layer:
-                o = o + layer["bo"]
-            if dl is not None:
-                o = o + dl("o_proj", o_flat)
-            x = x + cfg.residual_multiplier * o
-
-            h = self._norm(layer, x, "post_attn_norm")
-            x = x + cfg.residual_multiplier * self._mlp(layer, h, dl)
 
         if logits_indices is not None:
             x = x[logits_indices]
@@ -439,6 +473,19 @@ class LlamaForCausalLM:
         # valid_len) produce garbage the caller discards
         start = positions[0]
 
+        def attend(i, q, k, v):
+            nonlocal k_cache, v_cache
+            k_cache = k_cache.at[i, :, safe_slots].set(
+                k.astype(k_cache.dtype), mode="drop"
+            )
+            v_cache = v_cache.at[i, :, safe_slots].set(
+                v.astype(v_cache.dtype), mode="drop"
+            )
+            return attn_ops.chunked_prefill_attention(
+                q, k_cache[i], v_cache[i], block_table, start, valid_len,
+                block_size, scale, mesh=self.mesh,
+            )
+
         x = self._embed(params, token_ids, positions)
         for i, layer in enumerate(params["layers"]):
             dl = None
@@ -448,29 +495,10 @@ class LlamaForCausalLM:
                         lora, i, lora_slot, target, xx
                     )
                 )
-            h = self._norm(layer, x, "input_norm")
-            q, k, v = self._qkv(layer, h, dl)
-            q, k = self._apply_pos_qk(q, k, tables)
-            k_cache = k_cache.at[i, :, safe_slots].set(
-                k.astype(k_cache.dtype), mode="drop"
+            x = self._decoder_block(
+                layer, x, lambda q, k, v, i=i: attend(i, q, k, v), dl,
+                tables,
             )
-            v_cache = v_cache.at[i, :, safe_slots].set(
-                v.astype(v_cache.dtype), mode="drop"
-            )
-            o = attn_ops.chunked_prefill_attention(
-                q, k_cache[i], v_cache[i], block_table, start, valid_len,
-                block_size, scale, mesh=self.mesh,
-            )
-            o_flat = o.reshape(x.shape[0], -1)
-            o = o_flat @ layer["wo"]
-            if "bo" in layer:
-                o = o + layer["bo"]
-            if dl is not None:
-                o = o + dl("o_proj", o_flat)
-            x = x + cfg.residual_multiplier * o
-
-            h = self._norm(layer, x, "post_attn_norm")
-            x = x + cfg.residual_multiplier * self._mlp(layer, h, dl)
 
         if logits_indices is not None:
             x = x[logits_indices]
@@ -507,28 +535,25 @@ class LlamaForCausalLM:
         rope = self._rope_tables(flat_pos)
         safe_slots = jnp.where(flat_slots < 0, k_cache.shape[2], flat_slots)
 
-        x = self._embed(params, flat_tokens, flat_pos)
-        for i, layer in enumerate(params["layers"]):
-            h = self._norm(layer, x, "input_norm")
-            q, kk, v = self._qkv(layer, h)
-            q, kk = self._apply_pos_qk(q, kk, rope)
+        def attend(i, q, kk, v):
+            nonlocal k_cache, v_cache
             k_cache = k_cache.at[i, :, safe_slots].set(
                 kk.astype(k_cache.dtype), mode="drop"
             )
             v_cache = v_cache.at[i, :, safe_slots].set(
                 v.astype(v_cache.dtype), mode="drop"
             )
-            o = attn_ops.paged_decode_attention(
+            return attn_ops.paged_decode_attention(
                 q, k_cache[i], v_cache[i], tables, ctx_lens,
                 block_size, scale, mesh=self.mesh,
             )
-            o = o.reshape(x.shape[0], -1) @ layer["wo"]
-            if "bo" in layer:
-                o = o + layer["bo"]
-            x = x + cfg.residual_multiplier * o
 
-            h = self._norm(layer, x, "post_attn_norm")
-            x = x + cfg.residual_multiplier * self._mlp(layer, h)
+        x = self._embed(params, flat_tokens, flat_pos)
+        for i, layer in enumerate(params["layers"]):
+            x = self._decoder_block(
+                layer, x, lambda q, k, v, i=i: attend(i, q, k, v), None,
+                rope,
+            )
 
         logits = self._logits(params, x)  # [B*K, V]
         return logits.reshape(b, k, -1), (k_cache, v_cache)
@@ -554,6 +579,19 @@ class LlamaForCausalLM:
         # see prefill: negative pad slots must not wrap to the last page
         safe_slots = jnp.where(slot_mapping < 0, k_cache.shape[2], slot_mapping)
 
+        def attend(i, q, k, v):
+            nonlocal k_cache, v_cache
+            k_cache = k_cache.at[i, :, safe_slots].set(
+                k.astype(k_cache.dtype), mode="drop"
+            )
+            v_cache = v_cache.at[i, :, safe_slots].set(
+                v.astype(v_cache.dtype), mode="drop"
+            )
+            return attn_ops.paged_decode_attention(
+                q, k_cache[i], v_cache[i], block_tables, context_lens,
+                block_size, scale, mesh=self.mesh,
+            )
+
         x = self._embed(params, token_ids, positions)
         for i, layer in enumerate(params["layers"]):
             dl = None
@@ -563,28 +601,9 @@ class LlamaForCausalLM:
                         lora, i, lora_idx, target, xx
                     )
                 )
-            h = self._norm(layer, x, "input_norm")
-            q, k, v = self._qkv(layer, h, dl)
-            q, k = self._apply_pos_qk(q, k, tables)
-            k_cache = k_cache.at[i, :, safe_slots].set(
-                k.astype(k_cache.dtype), mode="drop"
+            x = self._decoder_block(
+                layer, x, lambda q, k, v, i=i: attend(i, q, k, v), dl,
+                tables,
             )
-            v_cache = v_cache.at[i, :, safe_slots].set(
-                v.astype(v_cache.dtype), mode="drop"
-            )
-            o = attn_ops.paged_decode_attention(
-                q, k_cache[i], v_cache[i], block_tables, context_lens,
-                block_size, scale, mesh=self.mesh,
-            )
-            o_flat = o.reshape(x.shape[0], -1)
-            o = o_flat @ layer["wo"]
-            if "bo" in layer:
-                o = o + layer["bo"]
-            if dl is not None:
-                o = o + dl("o_proj", o_flat)
-            x = x + cfg.residual_multiplier * o
-
-            h = self._norm(layer, x, "post_attn_norm")
-            x = x + cfg.residual_multiplier * self._mlp(layer, h, dl)
 
         return self._logits(params, x), (k_cache, v_cache)

@@ -167,6 +167,17 @@ _HF_NAME_SPECS = (
     ("fc2.weight", P(TP_AXIS, None)),
     ("fc2.bias", P()),
     ("embed_positions.weight", P()),
+    # gpt_neox lineage: attention.dense (row-parallel out), h_to_4h
+    # (column) / 4h_to_h (row) MLP, vocab-parallel embed_in, and
+    # embed_out placed post-transpose like lm_head
+    ("attention.dense.weight", P(TP_AXIS, None)),
+    ("attention.dense.bias", P()),
+    ("dense_h_to_4h.weight", P(None, TP_AXIS)),
+    ("dense_h_to_4h.bias", P(TP_AXIS)),
+    ("dense_4h_to_h.weight", P(TP_AXIS, None)),
+    ("dense_4h_to_h.bias", P()),
+    ("embed_in.weight", P(TP_AXIS, None)),
+    ("embed_out.weight", P(None, TP_AXIS)),
     ("norm.weight", P(None)),
     ("norm.bias", P(None)),
     ("layernorm.weight", P(None)),
