@@ -44,18 +44,34 @@ TINY_LLAMA_CONFIG = {
 }
 
 
-def hf_reference_model(model_dir: str):
+def hf_reference_model(model_dir: str, **kwargs):
     """Torch-side gold reference for numerical-parity tests (shared by
-    test_model_correctness / test_opt / test_gpt_neox so HF loading
-    settings cannot silently diverge between families)."""
+    test_model_correctness / test_opt / test_gpt_neox / sliding-window
+    so HF loading settings cannot silently diverge between families).
+    kwargs pass through (e.g. attn_implementation='eager', which the
+    sliding-window tests need for HF to honor the band mask)."""
     import torch
     from transformers import AutoModelForCausalLM
 
     hf = AutoModelForCausalLM.from_pretrained(
-        model_dir, torch_dtype=torch.float32
+        model_dir, torch_dtype=torch.float32, **kwargs
     )
     hf.eval()
     return hf
+
+
+def build_tiny_mistral(path: str, seed: int = 0,
+                       sliding_window: int | None = 8) -> str:
+    """Tiny mistral-architecture checkpoint: llama tensor naming with a
+    sliding-window config (the v0.1 lineage's distinguishing feature)."""
+    build_tiny_llama(path, seed=seed)
+    cfg = json.load(open(Path(path) / "config.json"))
+    cfg["architectures"] = ["MistralForCausalLM"]
+    cfg["model_type"] = "mistral"
+    cfg["sliding_window"] = sliding_window
+    with open(Path(path) / "config.json", "w") as f:
+        json.dump(cfg, f, indent=2)
+    return path
 
 
 def hf_tokenize(model_dir: str, text: str) -> list:

@@ -1,0 +1,311 @@
+"""Sliding-window attention (mistral v0.1 lineage).
+
+Each token attends to at most the previous ``sliding_window`` tokens — a
+band mask in the attention ops (ops/attention.py) applied on every path
+(bucketed prefill, chunked prefill, paged decode).  Without it, serving
+a windowed checkpoint beyond its window silently diverges from the
+model's training-time attention pattern.
+
+Gold standard: HF torch MistralForCausalLM with attn_implementation=
+"eager" (the HF path that honors config.sliding_window exactly) on
+prompts LONGER than the window, so the band actually cuts context.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from tests.fixture_models import hf_reference_model
+
+
+@pytest.fixture(scope="module")
+def mistral_dir(tmp_path_factory):
+    from tests.fixture_models import build_tiny_mistral
+
+    return build_tiny_mistral(
+        str(tmp_path_factory.mktemp("tiny-mistral")), sliding_window=8
+    )
+
+
+@pytest.fixture(scope="module")
+def setup(mistral_dir):
+    import jax.numpy as jnp
+
+    from vllm_tgis_adapter_tpu.engine.config import ModelConfig
+    from vllm_tgis_adapter_tpu.engine.weights import load_model_params
+    from vllm_tgis_adapter_tpu.models import get_model_class
+
+    config = ModelConfig.from_pretrained(mistral_dir, dtype="float32")
+    model = get_model_class(config.model_type)(config)
+    params = load_model_params(config, mistral_dir)
+    caches = model.make_kv_caches(num_slots=1024, dtype=jnp.float32)
+    return mistral_dir, config, model, params, caches
+
+
+# a 24-token prompt: longer than the 8-token window, so the band mask
+# actually removes context for later positions
+_PROMPT_IDS = list(range(5, 29))
+
+
+def test_sliding_window_config_parsing(setup, tmp_path):
+    import json
+
+    from tests.fixture_models import TINY_LLAMA_CONFIG
+
+    from vllm_tgis_adapter_tpu.engine.config import ModelConfig
+
+    _, config, _, _, _ = setup
+    assert config.model_type == "mistral"
+    assert config.sliding_window == 8
+
+    # v0.3-style null window → disabled
+    cfg = dict(TINY_LLAMA_CONFIG)
+    cfg["model_type"] = "mistral"
+    cfg["sliding_window"] = None
+    p = tmp_path / "null-window"
+    p.mkdir()
+    (p / "config.json").write_text(json.dumps(cfg))
+    assert ModelConfig.from_pretrained(str(p)).sliding_window == 0
+
+    # qwen2 gates the field behind use_sliding_window (default off)
+    cfg["model_type"] = "qwen2"
+    cfg["sliding_window"] = 16
+    (p / "config.json").write_text(json.dumps(cfg))
+    assert ModelConfig.from_pretrained(str(p)).sliding_window == 0
+
+    # ... and when on, the first max_window_layers layers stay full
+    cfg["use_sliding_window"] = True
+    cfg["max_window_layers"] = 1
+    (p / "config.json").write_text(json.dumps(cfg))
+    qcfg = ModelConfig.from_pretrained(str(p))
+    assert qcfg.sliding_window == 16
+    assert qcfg.max_window_layers == 1
+
+    from vllm_tgis_adapter_tpu.models import get_model_class
+
+    qmodel = get_model_class("qwen2")(qcfg)
+    assert qmodel._window_for_layer(0) == 0  # full attention
+    assert qmodel._window_for_layer(1) == 16  # banded
+
+
+def test_window_wider_than_seq_equals_full_attention():
+    import jax
+
+    from vllm_tgis_adapter_tpu.ops.attention import prefill_attention_xla
+
+    key = jax.random.PRNGKey(0)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (12, 4, 16))
+    k = jax.random.normal(kk, (12, 2, 16))
+    v = jax.random.normal(kv, (12, 2, 16))
+    full = prefill_attention_xla(q, k, v, 0.25)
+    windowed = prefill_attention_xla(q, k, v, 0.25, window=64)
+    np.testing.assert_allclose(
+        np.asarray(full), np.asarray(windowed), rtol=1e-6, atol=1e-6
+    )
+
+
+def test_windowed_prefill_matches_hf(setup):
+    import jax.numpy as jnp
+    import torch
+
+    model_dir, config, model, params, caches = setup
+    t = len(_PROMPT_IDS)
+
+    logits, _ = model.prefill(
+        params, caches,
+        jnp.asarray(_PROMPT_IDS, dtype=jnp.int32),
+        jnp.arange(t, dtype=jnp.int32),
+        jnp.arange(t, dtype=jnp.int32),
+        jnp.asarray(t, dtype=jnp.int32),
+    )
+    hf = hf_reference_model(model_dir, attn_implementation="eager")
+    assert hf.config.sliding_window == 8
+    with torch.no_grad():
+        hf_logits = hf(torch.tensor([_PROMPT_IDS])).logits[0].numpy()
+    np.testing.assert_allclose(
+        np.asarray(logits), hf_logits, rtol=1e-3, atol=1e-3
+    )
+
+
+def test_windowed_prefill_differs_from_full_attention(setup):
+    """Sanity check the gold test bites: with a 24-token prompt and an
+    8-token window, late positions MUST differ from full attention."""
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    from vllm_tgis_adapter_tpu.models import get_model_class
+
+    model_dir, config, model, params, caches = setup
+    t = len(_PROMPT_IDS)
+    args = (
+        jnp.asarray(_PROMPT_IDS, dtype=jnp.int32),
+        jnp.arange(t, dtype=jnp.int32),
+        jnp.arange(t, dtype=jnp.int32),
+        jnp.asarray(t, dtype=jnp.int32),
+    )
+    windowed, _ = model.prefill(params, caches, *args)
+
+    full_cfg = dataclasses.replace(config, sliding_window=0)
+    full_model = get_model_class(config.model_type)(full_cfg)
+    full, _ = full_model.prefill(params, caches, *args)
+
+    assert not np.allclose(np.asarray(windowed)[-1], np.asarray(full)[-1])
+    # early positions (inside the window) are unaffected
+    np.testing.assert_allclose(
+        np.asarray(windowed)[:8], np.asarray(full)[:8], rtol=1e-5, atol=1e-5
+    )
+
+
+def test_windowed_greedy_decode_matches_hf_generate(setup):
+    """Paged DECODE must apply the band too: generate far past the
+    window and match HF token-for-token."""
+    import jax.numpy as jnp
+    import torch
+
+    model_dir, config, model, params, caches = setup
+    t = len(_PROMPT_IDS)
+    new_tokens = 12
+    block_size = 16
+    max_blocks = 8
+
+    hf = hf_reference_model(model_dir, attn_implementation="eager")
+    with torch.no_grad():
+        hf_out = hf.generate(
+            torch.tensor([_PROMPT_IDS]),
+            max_new_tokens=new_tokens,
+            do_sample=False,
+            eos_token_id=None,
+        )[0].tolist()
+    expected = hf_out[t:]
+
+    logits, caches = model.prefill(
+        params, caches,
+        jnp.asarray(_PROMPT_IDS, dtype=jnp.int32),
+        jnp.arange(t, dtype=jnp.int32),
+        jnp.arange(t, dtype=jnp.int32),
+        jnp.asarray(t, dtype=jnp.int32),
+    )
+    block_tables = jnp.arange(max_blocks, dtype=jnp.int32)[None, :]
+    next_token = int(jnp.argmax(logits[t - 1]))
+    produced = [next_token]
+    pos = t
+    for _ in range(new_tokens - 1):
+        step_logits, caches = model.decode(
+            params, caches,
+            jnp.asarray([next_token], dtype=jnp.int32),
+            jnp.asarray([pos], dtype=jnp.int32),
+            jnp.asarray([pos], dtype=jnp.int32),
+            block_tables,
+            jnp.asarray([pos + 1], dtype=jnp.int32),
+            block_size,
+        )
+        next_token = int(jnp.argmax(step_logits[0]))
+        produced.append(next_token)
+        pos += 1
+
+    assert produced == expected
+
+
+def test_windowed_chunked_prefill_matches_hf(mistral_dir):
+    """Numeric parity for the CHUNKED windowed path: admitting the long
+    prompt in budget-sized chunks must reproduce HF's greedy tokens
+    exactly (an off-by-one in chunked_prefill_attention's window lower
+    bound would change every chunked windowed prefill)."""
+    import torch
+
+    from vllm_tgis_adapter_tpu.engine.config import (
+        CacheConfig,
+        EngineConfig,
+        LoRAConfig,
+        ModelConfig,
+        ParallelConfig,
+        SchedulerConfig,
+    )
+    from vllm_tgis_adapter_tpu.engine.core import LLMEngine
+    from vllm_tgis_adapter_tpu.engine.sampling_params import SamplingParams
+
+    new_tokens = 10
+    hf = hf_reference_model(mistral_dir, attn_implementation="eager")
+    with torch.no_grad():
+        hf_out = hf.generate(
+            torch.tensor([_PROMPT_IDS]),
+            max_new_tokens=new_tokens,
+            do_sample=False,
+            eos_token_id=None,
+        )[0].tolist()
+    expected = hf_out[len(_PROMPT_IDS):]
+
+    mcfg = ModelConfig.from_pretrained(mistral_dir, dtype="float32")
+    config = EngineConfig(
+        model_config=mcfg,
+        cache_config=CacheConfig(block_size=16, num_blocks=64,
+                                 cache_dtype=mcfg.dtype),
+        scheduler_config=SchedulerConfig(
+            max_num_seqs=4, prefill_buckets=(8, 16, 32),
+            max_num_batched_tokens=8,  # 24-token prompt → 3 chunks
+        ),
+        parallel_config=ParallelConfig(),
+        lora_config=LoRAConfig(),
+    )
+    engine = LLMEngine.from_config(config)
+    engine.add_request(
+        "sw-chunked", None,
+        SamplingParams(temperature=0.0, max_tokens=new_tokens,
+                       ignore_eos=True),
+        prompt_token_ids=list(_PROMPT_IDS),
+    )
+    done = {}
+    for _ in range(200):
+        if not engine.has_unfinished_requests():
+            break
+        for out in engine.step():
+            if out.finished:
+                done[out.request_id] = out
+    assert done["sw-chunked"].outputs[0].token_ids == expected
+
+
+def test_windowed_engine_end_to_end(mistral_dir):
+    """Chunked prefill + fused decode through the engine on a windowed
+    model: the scheduler path hits chunked_prefill_attention's band."""
+    from vllm_tgis_adapter_tpu.engine.config import (
+        CacheConfig,
+        EngineConfig,
+        LoRAConfig,
+        ModelConfig,
+        ParallelConfig,
+        SchedulerConfig,
+    )
+    from vllm_tgis_adapter_tpu.engine.core import LLMEngine
+    from vllm_tgis_adapter_tpu.engine.sampling_params import SamplingParams
+
+    mcfg = ModelConfig.from_pretrained(mistral_dir, dtype="float32")
+    assert mcfg.sliding_window == 8
+    config = EngineConfig(
+        model_config=mcfg,
+        cache_config=CacheConfig(block_size=16, num_blocks=64,
+                                 cache_dtype=mcfg.dtype),
+        scheduler_config=SchedulerConfig(
+            max_num_seqs=4, prefill_buckets=(16, 32, 64),
+            max_num_batched_tokens=16,  # forces chunked prefill
+        ),
+        parallel_config=ParallelConfig(),
+        lora_config=LoRAConfig(),
+    )
+    engine = LLMEngine.from_config(config)
+    engine.add_request(
+        "sw-long", None,
+        SamplingParams(temperature=0.0, max_tokens=8, ignore_eos=True),
+        prompt_token_ids=list(range(3, 43)),  # 40 tokens → 3 chunks
+    )
+    done = {}
+    for _ in range(200):
+        if not engine.has_unfinished_requests():
+            break
+        for out in engine.step():
+            if out.finished:
+                done[out.request_id] = out
+    assert set(done) == {"sw-long"}
+    assert len(done["sw-long"].outputs[0].token_ids) == 8

@@ -16,6 +16,10 @@ from typing import Any, Optional
 
 import jax.numpy as jnp
 
+from vllm_tgis_adapter_tpu.logging import init_logger
+
+_logger = init_logger(__name__)
+
 _DTYPE_MAP = {
     "bfloat16": jnp.bfloat16,
     "float16": jnp.float16,
@@ -77,6 +81,14 @@ class ModelConfig:
     # parallel attention+MLP residual (x + attn(ln1 x) + mlp(ln2 x))
     rotary_dim: int = 0
     parallel_residual: bool = False
+    # mistral-style sliding-window attention: each token attends to at
+    # most the previous ``sliding_window`` tokens (0 = full attention).
+    # Enforced as a band mask in the attention ops; KV pages beyond the
+    # window are still resident (no rolling-buffer eviction yet)
+    sliding_window: int = 0
+    # qwen2 semantics: the first ``max_window_layers`` layers use FULL
+    # attention, the band applies from that layer on (0 = all layers)
+    max_window_layers: int = 0
 
     @property
     def q_per_kv(self) -> int:
@@ -103,6 +115,24 @@ class ModelConfig:
         eos = hf.get("eos_token_id", 2)
         if isinstance(eos, list):
             eos = eos[0]
+        # mistral v0.1 ships sliding_window=4096; v0.3 sets it null.
+        # qwen2 carries the field but gates it off by default, and when
+        # on keeps its first max_window_layers layers on full attention.
+        sliding_window = hf.get("sliding_window") or 0
+        max_window_layers = 0
+        if model_type == "qwen2":
+            if not hf.get("use_sliding_window", False):
+                sliding_window = 0
+            else:
+                max_window_layers = hf.get("max_window_layers", 0)
+        if sliding_window:
+            _logger.warning(
+                "sliding-window attention (window=%d) currently runs on "
+                "the XLA attention path, which materialises per-chunk "
+                "score tensors — long-context windowed serving is "
+                "memory-bound until the Pallas band-mask kernel lands",
+                sliding_window,
+            )
         if model_type == "opt":
             return ModelConfig._from_opt_config(
                 model, hf, max_model_len=max_model_len, dtype=dtype
@@ -136,6 +166,8 @@ class ModelConfig:
             num_experts_per_tok=hf.get("num_experts_per_tok", 0),
             attention_bias=hf.get("attention_bias", False),
             mlp_bias=hf.get("mlp_bias", False),
+            sliding_window=sliding_window,
+            max_window_layers=max_window_layers,
         )
 
     @staticmethod

@@ -215,6 +215,15 @@ class LlamaForCausalLM:
             )
         return rms_norm(x, container[name], cfg.rms_norm_eps)
 
+    def _window_for_layer(self, i: int) -> int:
+        """Per-layer sliding window: qwen2 keeps the first
+        ``max_window_layers`` layers on full attention (HF semantics);
+        every other windowed model bands all layers."""
+        cfg = self.config
+        if cfg.sliding_window and i < cfg.max_window_layers:
+            return 0
+        return cfg.sliding_window
+
     def _rope_tables(self, positions: jax.Array):
         """cos/sin for rotary models; None when positions enter at embed."""
         cfg = self.config
@@ -418,8 +427,10 @@ class LlamaForCausalLM:
             v_cache = v_cache.at[i, :, safe_slots].set(
                 v.astype(v_cache.dtype), mode="drop"
             )
-            return attn_ops.prefill_attention(q, k, v, scale, valid_len,
-                                              mesh=self.mesh)
+            return attn_ops.prefill_attention(
+                q, k, v, scale, valid_len, mesh=self.mesh,
+                window=self._window_for_layer(i),
+            )
 
         x = self._embed(params, token_ids, positions)
         for i, layer in enumerate(params["layers"]):
@@ -484,6 +495,7 @@ class LlamaForCausalLM:
             return attn_ops.chunked_prefill_attention(
                 q, k_cache[i], v_cache[i], block_table, start, valid_len,
                 block_size, scale, mesh=self.mesh,
+                window=self._window_for_layer(i),
             )
 
         x = self._embed(params, token_ids, positions)
@@ -546,6 +558,7 @@ class LlamaForCausalLM:
             return attn_ops.paged_decode_attention(
                 q, k_cache[i], v_cache[i], tables, ctx_lens,
                 block_size, scale, mesh=self.mesh,
+                window=self._window_for_layer(i),
             )
 
         x = self._embed(params, flat_tokens, flat_pos)
@@ -590,6 +603,7 @@ class LlamaForCausalLM:
             return attn_ops.paged_decode_attention(
                 q, k_cache[i], v_cache[i], block_tables, context_lens,
                 block_size, scale, mesh=self.mesh,
+                window=self._window_for_layer(i),
             )
 
         x = self._embed(params, token_ids, positions)

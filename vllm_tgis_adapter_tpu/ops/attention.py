@@ -79,6 +79,28 @@ def write_kv(
     return k_cache, v_cache
 
 
+_warned_window_fallback = False
+
+
+def _window_uses_xla(window: int) -> bool:
+    """Sliding-window masking is implemented in the XLA formulations; the
+    Pallas kernels don't carry the band mask yet, so windowed models
+    (mistral v0.1 lineage) take the XLA path on every backend."""
+    global _warned_window_fallback
+    if window <= 0:
+        return False
+    if _use_pallas() and not _warned_window_fallback:
+        _warned_window_fallback = True
+        from vllm_tgis_adapter_tpu.logging import init_logger
+
+        init_logger(__name__).info(
+            "sliding-window attention (window=%d) uses the XLA attention "
+            "path; Pallas band-mask kernels are not implemented yet",
+            window,
+        )
+    return True
+
+
 def prefill_attention(
     q: jax.Array,
     k: jax.Array,
@@ -86,6 +108,7 @@ def prefill_attention(
     scale: float,
     valid_len: jax.Array | None = None,
     mesh=None,
+    window: int = 0,
 ) -> jax.Array:
     """Dispatch: flash Pallas kernel on TPU, XLA fallback elsewhere.
 
@@ -96,6 +119,18 @@ def prefill_attention(
     K/V chunks rotate around the ring (ops/ring_attention.py) — the
     long-context path.
     """
+    if window > 0 and mesh is not None and dict(mesh.shape).get("sp", 1) > 1:
+        raise NotImplementedError(
+            "sliding-window attention does not compose with "
+            "--sequence-parallel-size > 1 yet (ring attention has no band "
+            "mask); windowed models bound their own context instead"
+        )
+    if _window_uses_xla(window):
+        # plain XLA ops: the GSPMD partitioner splits them over any mesh
+        # from the operand shardings (no shard_map needed — that is only
+        # for the opaque pallas_call)
+        return prefill_attention_xla(q, k, v, scale, valid_len,
+                                     window=window)
     if mesh is not None and dict(mesh.shape).get("sp", 1) > 1:
         from vllm_tgis_adapter_tpu.ops.ring_attention import (
             ring_prefill_attention,
@@ -141,6 +176,7 @@ def prefill_attention_xla(
     v: jax.Array,  # [T, Hkv, Dh]
     scale: float,
     valid_len: jax.Array | None = None,  # scalar int: tokens < valid_len attend
+    window: int = 0,  # >0: attend to at most the previous `window` tokens
 ) -> jax.Array:
     """Causal self-attention over a single (padded) prompt.
 
@@ -161,6 +197,11 @@ def prefill_attention_xla(
     scores = jnp.einsum("tkgd,skd->kgts", qh, kh) * scale
     causal = jnp.tril(jnp.ones((t, t), dtype=bool))
     mask = causal
+    if window > 0:
+        # band mask: query i sees keys (i-window, i] (HF mistral
+        # convention — the diagonal plus window-1 predecessors)
+        offsets = jnp.arange(t)[:, None] - jnp.arange(t)[None, :]
+        mask = mask & (offsets < window)
     if valid_len is not None:
         mask = mask & (jnp.arange(t) < valid_len)[None, :]
     scores = jnp.where(mask[None, None], scores, NEG_INF)
@@ -178,12 +219,18 @@ def paged_decode_attention(
     block_size: int,
     scale: float,
     mesh=None,
+    window: int = 0,
 ) -> jax.Array:
     """Dispatch: flash Pallas kernel on TPU, XLA fallback elsewhere.
 
     Under a TP mesh the kernel runs inside shard_map: the cache is
     head-sharded on tp, so each shard's kernel reads only its local pages.
     """
+    if _window_uses_xla(window):
+        return paged_decode_attention_xla(
+            q, k_cache, v_cache, block_tables, context_lens, block_size,
+            scale, window=window,
+        )
     if _use_pallas():
         from vllm_tgis_adapter_tpu.ops import pallas_attention
 
@@ -221,6 +268,7 @@ def chunked_prefill_attention(
     block_size: int,
     scale: float,
     mesh=None,
+    window: int = 0,
 ) -> jax.Array:
     """Causal chunk-vs-paged-context attention (the chunked-prefill and
     prefix-cache-resume hot path).
@@ -230,7 +278,7 @@ def chunked_prefill_attention(
     the decode formulation (each query as a batch row with its own
     context length), which is what the kernel's numerics are pinned to.
     """
-    if _use_pallas():
+    if _use_pallas() and not _window_uses_xla(window):
         from vllm_tgis_adapter_tpu.ops import pallas_attention
 
         kernel = functools.partial(
@@ -262,7 +310,8 @@ def chunked_prefill_attention(
     ctx_lens = jnp.where(local < valid_len, positions + 1, 1)
     tables = jnp.broadcast_to(block_table[None, :], (t, block_table.shape[0]))
     return paged_decode_attention_xla(
-        q, k_cache, v_cache, tables, ctx_lens, block_size, scale
+        q, k_cache, v_cache, tables, ctx_lens, block_size, scale,
+        window=window,
     )
 
 
@@ -274,6 +323,7 @@ def paged_decode_attention_xla(
     context_lens: jax.Array,  # [B] int32, tokens of context incl. current
     block_size: int,
     scale: float,
+    window: int = 0,  # >0: attend to at most the last `window` tokens
 ) -> jax.Array:
     """One-token-per-sequence attention against the paged cache.
 
@@ -301,6 +351,11 @@ def paged_decode_attention_xla(
     qh = q.reshape(b, num_kv, q_per_kv, head_dim).astype(jnp.float32)
     scores = jnp.einsum("bkgd,kbsd->bkgs", qh, keys) * scale
     length_mask = jnp.arange(s)[None, :] < context_lens[:, None]  # [B, S]
+    if window > 0:
+        # sliding window: only the last `window` in-context positions
+        length_mask = length_mask & (
+            jnp.arange(s)[None, :] >= context_lens[:, None] - window
+        )
     scores = jnp.where(length_mask[:, None, None, :], scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bkgs,kbsd->bkgd", probs, values)
