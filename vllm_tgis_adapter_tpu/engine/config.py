@@ -445,6 +445,35 @@ class EngineConfig:
     disable_log_stats: bool = False
     speculative: "Optional[SpeculativeConfig]" = None
 
+    def __post_init__(self) -> None:
+        pp = self.parallel_config.pipeline_parallel_size
+        if pp <= 1:
+            return
+        # v1 pipeline-parallel scope (engine/pipeline.py): composes with
+        # tp / chunked prefill / prefix caching / guided decoding; the
+        # features below need per-stage plumbing that doesn't exist yet,
+        # so they fail at config time rather than running wrong
+        if self.speculative is not None:
+            raise ValueError(
+                "--speculative-model is not supported with "
+                "--pipeline-parallel-size > 1 yet"
+            )
+        if self.lora_config.enabled:
+            raise ValueError(
+                "--enable-lora is not supported with "
+                "--pipeline-parallel-size > 1 yet"
+            )
+        if self.parallel_config.sequence_parallel_size > 1:
+            raise ValueError(
+                "--sequence-parallel-size does not compose with "
+                "--pipeline-parallel-size yet"
+            )
+        if self.parallel_config.data_parallel_size > 1:
+            raise ValueError(
+                "--data-parallel-size does not compose with "
+                "--pipeline-parallel-size yet"
+            )
+
     @property
     def max_model_len(self) -> int:
         return self.model_config.max_model_len

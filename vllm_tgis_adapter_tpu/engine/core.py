@@ -40,7 +40,7 @@ class LLMEngine:
     """Single-process engine: one model, one scheduler, one device program."""
 
     def __init__(self, config: EngineConfig, model, params, tokenizer,
-                 mesh=None, memory_device=None):
+                 mesh=None, memory_device=None, pp_devices=None):
         if config.cache_config.num_blocks <= 0:
             # auto-size the KV pool from free HBM now that the weights are
             # resident (reference behavior: vLLM's gpu_memory_utilization)
@@ -50,16 +50,44 @@ class LLMEngine:
                 resolve_num_blocks,
             )
 
+            size_cfg = config
+            pp = config.parallel_config.pipeline_parallel_size
+            if pp > 1:
+                # each device stores only its stage's layer slice, so a
+                # block costs num_layers/pp of the whole-model estimate;
+                # size against the LARGEST stage so every stage fits
+                from vllm_tgis_adapter_tpu.engine.pipeline import (
+                    split_layer_ranges,
+                )
+
+                stage_layers = max(
+                    hi - lo
+                    for lo, hi in split_layer_ranges(
+                        config.model_config.num_layers, pp
+                    )
+                )
+                size_cfg = _dc.replace(
+                    config,
+                    model_config=_dc.replace(
+                        config.model_config, num_layers=stage_layers
+                    ),
+                )
             config = _dc.replace(
                 config,
                 cache_config=_dc.replace(
                     config.cache_config,
-                    num_blocks=resolve_num_blocks(config, memory_device),
+                    num_blocks=resolve_num_blocks(size_cfg, memory_device),
                 ),
             )
         self.config = config
         self.tokenizer = tokenizer
-        self.runner = ModelRunner(config, model, params, mesh=mesh)
+        if config.parallel_config.pipeline_parallel_size > 1:
+            from vllm_tgis_adapter_tpu.engine.pipeline import PipelineRunner
+
+            self.runner = PipelineRunner(config, model, params,
+                                         devices=pp_devices)
+        else:
+            self.runner = ModelRunner(config, model, params, mesh=mesh)
         self.scheduler = Scheduler(
             config.scheduler_config,
             config.cache_config,
@@ -104,13 +132,24 @@ class LLMEngine:
         # build the mesh BEFORE loading so every tensor is sharded onto it
         # as it is read — sharding after a full single-device load would
         # OOM device 0 for models that need TP in the first place
-        mesh = mesh_from_parallel_config(
-            config.parallel_config, devices=devices
-        )
-        place = None
-        if mesh is not None:
-            validate_tp_divisibility(mcfg, mesh.shape["tp"])
-            place = make_place_fn(mesh)
+        mesh = None
+        pp = config.parallel_config.pipeline_parallel_size
+        if pp > 1:
+            # stage-routed placement: each layer's tensors land directly
+            # on its pipeline stage's device group (engine/pipeline.py)
+            from vllm_tgis_adapter_tpu.engine.pipeline import (
+                make_pp_place_fn,
+            )
+
+            place = make_pp_place_fn(config, devices=devices)
+        else:
+            mesh = mesh_from_parallel_config(
+                config.parallel_config, devices=devices
+            )
+            place = None
+            if mesh is not None:
+                validate_tp_divisibility(mcfg, mesh.shape["tp"])
+                place = make_place_fn(mesh)
         logger.info("loading weights from %s", mcfg.model)
         params = load_model_params(mcfg, mcfg.model, place=place)
 
@@ -136,7 +175,7 @@ class LLMEngine:
         # their pools
         memory_device = devices[0] if devices else None
         engine = cls(config, model, params, tokenizer, mesh=mesh,
-                     memory_device=memory_device)
+                     memory_device=memory_device, pp_devices=devices)
         if draft_model is not None:
             engine.runner.attach_speculative(draft_model, draft_params)
         return engine

@@ -1,0 +1,455 @@
+"""Pipeline-parallel model runner: layer stages over disjoint device groups.
+
+The reference stack's engine tier supports ``--pipeline-parallel-size``
+(vLLM arg surface consumed via the adapter's parser, SURVEY.md §2.3/§2.4);
+this is the TPU-native equivalent.  The model's layers are split into S
+contiguous stages, each owning a disjoint ``tp``-sized device slice with
+its own layer-sliced KV cache and jitted stage program; activations hop
+stage to stage with ``jax.device_put`` (ICI transfers on real hardware).
+PP's primary inference value is CAPACITY — serving a model S× bigger than
+one device group's HBM — which this delivers; stage overlap via
+microbatching is future work, so per-request latency pays the bubble
+(documented, not hidden).
+
+Scope (fail-fast otherwise, engine/config.py validation): composes with
+TP (stage meshes) and everything sampler-side (guided decoding, seeded
+sampling, penalties, stop strings, chunked prefill, prefix caching);
+NOT with speculative decoding, LoRA, or sequence parallelism yet.
+
+Decode under PP runs one step per stage chain (the single-jit fused
+K-step scan cannot span device groups); the scheduler's
+``num_decode_steps`` still batches K steps per plan, paid as K chained
+dispatches.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import re
+from typing import TYPE_CHECKING
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from vllm_tgis_adapter_tpu.engine import sampler as sampler_mod
+from vllm_tgis_adapter_tpu.engine.runner import (
+    ModelRunner,
+    PromptLogprobInfo,
+    SampledToken,
+    _HostSamplerOutput,
+)
+from vllm_tgis_adapter_tpu.logging import init_logger
+
+if TYPE_CHECKING:
+    from vllm_tgis_adapter_tpu.engine.config import EngineConfig
+
+logger = init_logger(__name__)
+
+
+def _stage_meshes(config: "EngineConfig", devices=None) -> list:
+    """The deterministic stage → device-slice mapping (shared by the
+    weight-loading place fn and the runner so both land tensors on the
+    same devices)."""
+    from vllm_tgis_adapter_tpu.parallel import build_mesh
+
+    pcfg = config.parallel_config
+    pp, tp = pcfg.pipeline_parallel_size, pcfg.tensor_parallel_size
+    devices = list(devices if devices is not None else jax.devices())
+    if pp * tp > len(devices):
+        raise ValueError(
+            f"pipeline_parallel_size={pp} × tensor_parallel_size={tp} "
+            f"needs {pp * tp} devices but only {len(devices)} are visible"
+        )
+    return [
+        build_mesh(tensor_parallel_size=tp,
+                   devices=devices[s * tp:(s + 1) * tp])
+        for s in range(pp)
+    ]
+
+
+def make_pp_place_fn(config: "EngineConfig", devices=None):
+    """Shard-on-load placement routed by pipeline stage: each layer's
+    tensors go straight to their stage's device group (with the usual
+    Megatron tp spec within it), embeddings to stage 0, head/final norm
+    to the last — so no device group ever materialises another stage's
+    weights."""
+    from jax.sharding import NamedSharding
+
+    from vllm_tgis_adapter_tpu.parallel.sharding import hf_name_spec
+
+    meshes = _stage_meshes(config, devices)
+    ranges = split_layer_ranges(
+        config.model_config.num_layers, len(meshes)
+    )
+
+    def stage_of_layer(j: int) -> int:
+        for s, (lo, hi) in enumerate(ranges):
+            if lo <= j < hi:
+                return s
+        raise ValueError(f"layer index {j} out of range {ranges}")
+
+    def place(name: str, x: jax.Array) -> jax.Array:
+        m = re.search(r"layers\.(\d+)\.", name)
+        if m is not None:
+            mesh = meshes[stage_of_layer(int(m.group(1)))]
+        elif any(k in name for k in
+                 ("embed_tokens", "embed_in", "embed_positions")):
+            mesh = meshes[0]
+        else:  # lm_head / embed_out / decoder-level final norm
+            mesh = meshes[-1]
+        return jax.device_put(x, NamedSharding(mesh, hf_name_spec(name)))
+
+    return place
+
+
+def split_layer_ranges(num_layers: int, stages: int) -> list[tuple[int, int]]:
+    """Contiguous near-even layer ranges, earlier stages taking the
+    remainder (they also hold the embedding)."""
+    base, rem = divmod(num_layers, stages)
+    ranges = []
+    start = 0
+    for s in range(stages):
+        n = base + (1 if s < rem else 0)
+        ranges.append((start, start + n))
+        start += n
+    return ranges
+
+
+def split_pipeline_params(params: dict, ranges) -> list[dict]:
+    """Stage param dicts (views, no copies): embed(+pos) on stage 0,
+    final norm + lm_head on the last, each stage its layer slice."""
+    stages = []
+    last = len(ranges) - 1
+    for s, (lo, hi) in enumerate(ranges):
+        p: dict = {"layers": params["layers"][lo:hi]}
+        if s == 0:
+            p["embed"] = params["embed"]
+            if "pos_embed" in params:
+                p["pos_embed"] = params["pos_embed"]
+        if s == last:
+            # tied lm_head reads params["embed"]; the last stage needs its
+            # own reference even when stage 0 also holds it
+            if "embed" not in p:
+                p["embed"] = params["embed"]
+            p["final_norm"] = params["final_norm"]
+            if "final_norm_bias" in params:
+                p["final_norm_bias"] = params["final_norm_bias"]
+            if "lm_head" in params:
+                p["lm_head"] = params["lm_head"]
+        stages.append(p)
+    return stages
+
+
+@dataclasses.dataclass
+class _Stage:
+    model: object  # layer-sliced model instance (own config/layer_offset)
+    params: dict
+    caches: tuple
+    mesh: object  # this stage's tp mesh (placement + Megatron specs)
+    data_sharding: object  # replicated NamedSharding on this stage's mesh
+    first: bool
+    last: bool
+    prefill_fn: object
+    chunk_fn: object
+    decode_fn: object
+
+
+class PipelineRunner(ModelRunner):
+    """Drop-in ModelRunner with the device tier split into pp stages.
+
+    Reuses the host halves (prepare_prefill / prepare_decode) unchanged;
+    only initialisation and the execute halves differ.
+    """
+
+    def __init__(self, config: "EngineConfig", model, params, devices=None):
+        from vllm_tgis_adapter_tpu.parallel import (
+            cache_sharding,
+            data_sharding,
+            shard_llama_params,
+            validate_tp_divisibility,
+        )
+
+        pcfg = config.parallel_config
+        pp = pcfg.pipeline_parallel_size
+        tp = pcfg.tensor_parallel_size
+        mcfg = config.model_config
+        cache_cfg = config.cache_config
+
+        # same deterministic stage -> device-slice mapping as the weight
+        # loader's place fn, so stage programs run where the weights live
+        meshes = _stage_meshes(config, devices)
+        if pp > mcfg.num_layers:
+            raise ValueError(
+                f"pipeline_parallel_size={pp} exceeds num_layers="
+                f"{mcfg.num_layers}"
+            )
+        validate_tp_divisibility(mcfg, tp)
+
+        # ---- host-side state the inherited prepare_* halves consume ----
+        self.config = config
+        self.model = model  # whole-model reference (config introspection)
+        self.block_size = cache_cfg.block_size
+        self.num_slots = cache_cfg.num_blocks * cache_cfg.block_size
+        self.max_blocks_per_seq = -(-mcfg.max_model_len // self.block_size)
+        self._rng = np.random.default_rng(config.seed)
+        self.lora_stacks = None
+        self._lora_version = 0
+        self._seen_pad_lens = sorted(
+            set(config.scheduler_config.prefill_buckets)
+        )
+        self.spec = None
+        self.mesh = None  # whole-runner mesh is meaningless under pp
+
+        # ---- stage construction ----
+        self.ranges = split_layer_ranges(mcfg.num_layers, pp)
+        stage_params = split_pipeline_params(params, self.ranges)
+        model_cls = type(model)
+        donate = (1,) if jax.default_backend() == "tpu" else ()
+        self.stages: list[_Stage] = []
+        for s, (lo, hi) in enumerate(self.ranges):
+            smesh = meshes[s]
+            scfg = dataclasses.replace(mcfg, num_layers=hi - lo)
+            smodel = model_cls(scfg)
+            smodel.mesh = smesh
+            smodel.layer_offset = lo
+            sparams = shard_llama_params(smesh, stage_params[s])
+            sh = cache_sharding(smesh)
+            caches = jax.jit(
+                lambda m=smodel: m.make_kv_caches(
+                    self.num_slots, cache_cfg.cache_dtype
+                ),
+                out_shardings=(sh, sh),
+            )()
+            first, last = s == 0, s == pp - 1
+            self.stages.append(_Stage(
+                model=smodel,
+                params=sparams,
+                caches=caches,
+                mesh=smesh,
+                data_sharding=data_sharding(smesh),
+                first=first,
+                last=last,
+                prefill_fn=jax.jit(
+                    functools.partial(
+                        smodel.prefill, first_stage=first, last_stage=last
+                    ),
+                    donate_argnums=donate,
+                ),
+                chunk_fn=jax.jit(
+                    functools.partial(
+                        smodel.prefill_chunk, block_size=self.block_size,
+                        first_stage=first, last_stage=last,
+                    ),
+                    donate_argnums=donate,
+                ),
+                decode_fn=jax.jit(
+                    functools.partial(
+                        smodel.decode, block_size=self.block_size,
+                        first_stage=first, last_stage=last,
+                    ),
+                    donate_argnums=donate,
+                ),
+            ))
+        logger.info(
+            "pipeline runner: %d stages × tp=%d, layer ranges %s",
+            pp, tp, self.ranges,
+        )
+
+        last_stage = self.stages[-1]
+        self._data_sharding = last_stage.data_sharding  # sampler inputs
+        max_seqs = config.scheduler_config.max_num_seqs
+        self.seen = self._put(jnp.zeros((max_seqs, mcfg.vocab_size), bool))
+
+    # ------------------------------------------------------------- helpers
+
+    def _stage_put(self, stage: _Stage, x):
+        return jax.device_put(np.asarray(x), stage.data_sharding)
+
+    def sync_lora(self, manager) -> None:  # noqa: ANN001
+        if manager is not None and manager.lora_requests:
+            raise NotImplementedError(
+                "LoRA adapters are not supported with "
+                "--pipeline-parallel-size > 1 yet"
+            )
+
+    # ------------------------------------------------------------- prefill
+
+    def execute_prefill(self, prep):
+        """Chain the prompt (chunk) through the stages; sample on the
+        last stage's devices."""
+        t = prep.t
+        hidden = None
+        logits = None
+        for stage in self.stages:
+            common = dict(
+                token_ids=self._stage_put(stage, prep.token_ids),
+                positions=self._stage_put(stage, prep.positions),
+                slot_mapping=self._stage_put(stage, prep.slot_mapping),
+                valid_len=self._stage_put(stage, np.asarray(t, np.int32)),
+                logits_indices=self._stage_put(stage, prep.logits_indices),
+            )
+            if not stage.first:
+                common["hidden"] = jax.device_put(
+                    hidden, stage.data_sharding
+                )
+            if prep.start_pos == 0:
+                out, stage.caches = stage.prefill_fn(
+                    stage.params, stage.caches, **common
+                )
+            else:
+                out, stage.caches = stage.chunk_fn(
+                    stage.params, stage.caches,
+                    block_table=self._stage_put(stage, prep.block_table),
+                    **common,
+                )
+            if stage.last:
+                logits = out
+            else:
+                hidden = out
+        if not prep.is_final:
+            return None, None
+
+        prompt_info = None
+        if prep.want_prompt_lp:
+            lp, rank, tn_ids, tn_lp = sampler_mod.prompt_logprob_info(
+                logits, jnp.asarray(prep.token_ids)
+            )
+            n = t - 1
+            prompt_info = PromptLogprobInfo(
+                logprobs=np.asarray(lp)[:n].tolist(),
+                ranks=np.asarray(rank)[:n].tolist(),
+                topn_ids=np.asarray(tn_ids)[:n].tolist(),
+                topn_logprobs=np.asarray(tn_lp)[:n].tolist(),
+            )
+            last_logits = logits[t - 1][None]
+        else:
+            last_logits = logits
+
+        self.seen = sampler_mod.set_seen_row(
+            self.seen,
+            self._put(np.asarray(prep.row_slot)),
+            self._put(prep.seen_tokens),
+        )
+        allowed_mask = (
+            self._put(prep.allowed_row[None, :])
+            if prep.allowed_row is not None
+            else None
+        )
+        seen_rows = jnp.take(
+            self.seen,
+            jnp.clip(jnp.asarray([prep.row_slot]), 0, None),
+            axis=0,
+        )
+        out = sampler_mod.sample(
+            last_logits,
+            seen_rows,
+            jax.tree.map(self._put, prep.tensors),
+            allowed_mask=allowed_mask,
+        )
+        self.seen = sampler_mod.update_seen(
+            self.seen, jnp.asarray([prep.row_slot]), out.tokens
+        )
+        host = _HostSamplerOutput.from_device(
+            jax.tree.map(lambda x: x[None], out)
+        )
+        return host.token(0, 0), prompt_info
+
+    # -------------------------------------------------------------- decode
+
+    def execute_decode(self, prep) -> list[list[SampledToken]]:
+        """K single-step stage chains per plan (the fused on-device scan
+        cannot span device groups); penalties/sampling run on the last
+        stage exactly as the fused path does."""
+        tokens = np.asarray(prep.token_ids)
+        active_rows = np.asarray(prep.slots) >= 0
+        rows = np.clip(np.asarray(prep.slots), 0, None)
+
+        # stage-constant inputs, placed once per dispatch
+        per_stage = []
+        for stage in self.stages:
+            per_stage.append(dict(
+                block_tables=self._stage_put(stage, prep.block_tables),
+            ))
+
+        seen_tensors = jax.tree.map(self._put, prep.tensors)
+        allowed = (
+            self._put(prep.allowed_mask)
+            if prep.allowed_mask is not None
+            else None
+        )
+        last = self.stages[-1]
+        outs_per_step = []
+        for k in range(prep.num_steps):
+            positions = np.asarray(prep.positions) + k
+            active = (positions <= np.asarray(prep.limits)) & active_rows
+            blk = np.take_along_axis(
+                np.asarray(prep.block_tables),
+                np.clip(positions // self.block_size, 0,
+                        self.max_blocks_per_seq - 1)[:, None],
+                axis=1,
+            )[:, 0]
+            slot = np.where(
+                active, blk * self.block_size + positions % self.block_size,
+                -1,
+            ).astype(np.int32)
+            context_lens = (np.asarray(prep.context_lens) + k).astype(
+                np.int32
+            )
+
+            hidden = None
+            logits = None
+            for stage, sconst in zip(self.stages, per_stage):
+                kwargs = dict(
+                    token_ids=self._stage_put(stage, tokens),
+                    positions=self._stage_put(stage, positions),
+                    slot_mapping=self._stage_put(stage, slot),
+                    block_tables=sconst["block_tables"],
+                    context_lens=self._stage_put(stage, context_lens),
+                )
+                if not stage.first:
+                    kwargs["hidden"] = jax.device_put(
+                        hidden, stage.data_sharding
+                    )
+                out, stage.caches = stage.decode_fn(
+                    stage.params, stage.caches, **kwargs
+                )
+                if stage.last:
+                    logits = out
+                else:
+                    hidden = out
+
+            t_k = dataclasses.replace(
+                seen_tensors, gen_len=seen_tensors.gen_len + k
+            )
+            seen_rows = jnp.take(self.seen, jnp.asarray(rows), axis=0)
+            out = sampler_mod.sample(
+                logits, seen_rows, t_k, allowed_mask=allowed
+            )
+            self.seen = sampler_mod.update_seen(
+                self.seen,
+                jnp.asarray(np.where(active, np.asarray(prep.slots), -1)),
+                out.tokens,
+            )
+            outs_per_step.append(out)
+            # feed the sampled tokens back as the next step's inputs
+            tokens = np.asarray(out.tokens)
+
+        host = _HostSamplerOutput(
+            tokens=np.stack([np.asarray(o.tokens) for o in outs_per_step]),
+            logprobs=np.stack(
+                [np.asarray(o.logprob) for o in outs_per_step]
+            ),
+            ranks=np.stack([np.asarray(o.rank) for o in outs_per_step]),
+            topn_ids=np.stack(
+                [np.asarray(o.topn_ids) for o in outs_per_step]
+            ),
+            topn_logprobs=np.stack(
+                [np.asarray(o.topn_logprobs) for o in outs_per_step]
+            ),
+        )
+        return [
+            [host.token(k, i) for k in range(prep.steps_per_seq[i])]
+            for i in range(prep.num_seqs)
+        ]

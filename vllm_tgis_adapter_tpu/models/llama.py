@@ -114,6 +114,10 @@ class LlamaForCausalLM:
         # TP mesh for shard_map-wrapped Pallas attention (ops/attention.py);
         # assigned by the runner at boot, None on a single device
         self.mesh = None
+        # pipeline parallelism: a stage model sees only its layer slice;
+        # this offset maps local layer index -> global (qwen2's
+        # max_window_layers gate needs the global index)
+        self.layer_offset = 0
 
     # ---------------------------------------------------------------- params
 
@@ -218,9 +222,10 @@ class LlamaForCausalLM:
     def _window_for_layer(self, i: int) -> int:
         """Per-layer sliding window: qwen2 keeps the first
         ``max_window_layers`` layers on full attention (HF semantics);
-        every other windowed model bands all layers."""
+        every other windowed model bands all layers.  ``i`` is local to
+        this stage's layer slice; layer_offset globalises it."""
         cfg = self.config
-        if cfg.sliding_window and i < cfg.max_window_layers:
+        if cfg.sliding_window and i + self.layer_offset < cfg.max_window_layers:
             return 0
         return cfg.sliding_window
 
@@ -403,8 +408,16 @@ class LlamaForCausalLM:
         logits_indices: jax.Array | None = None,  # [R] rows to compute logits for
         lora=None,  # LoRAStacks (engine/lora.py) or None
         lora_slot: jax.Array | None = None,  # scalar adapter slot
+        *,
+        hidden: jax.Array | None = None,  # [T, d] from the previous pp stage
+        first_stage: bool = True,  # embed input tokens here
+        last_stage: bool = True,  # apply final norm + lm_head here
     ) -> tuple[jax.Array, tuple[jax.Array, jax.Array]]:
         """Full-prompt forward.
+
+        Pipeline parallelism: a non-first stage takes ``hidden`` instead
+        of embedding ``token_ids``; a non-last stage returns the raw
+        hidden states for the next stage instead of logits.
 
         Returns logits only at ``logits_indices`` (default: every position).
         Restricting to the sampled row avoids materialising a ``[T, vocab]``
@@ -432,7 +445,11 @@ class LlamaForCausalLM:
                 window=self._window_for_layer(i),
             )
 
-        x = self._embed(params, token_ids, positions)
+        x = (
+            self._embed(params, token_ids, positions)
+            if first_stage
+            else hidden
+        )
         for i, layer in enumerate(params["layers"]):
             dl = None
             if lora is not None:
@@ -446,6 +463,8 @@ class LlamaForCausalLM:
                 tables,
             )
 
+        if not last_stage:
+            return x, (k_cache, v_cache)
         if logits_indices is not None:
             x = x[logits_indices]
         return self._logits(params, x), (k_cache, v_cache)
@@ -464,6 +483,9 @@ class LlamaForCausalLM:
         lora_slot: jax.Array | None = None,
         *,
         block_size: int,
+        hidden: jax.Array | None = None,
+        first_stage: bool = True,
+        last_stage: bool = True,
     ) -> tuple[jax.Array, tuple[jax.Array, jax.Array]]:
         """A non-first prefill chunk: queries attend to the chunk AND all
         earlier context already resident in the paged cache.
@@ -498,7 +520,11 @@ class LlamaForCausalLM:
                 window=self._window_for_layer(i),
             )
 
-        x = self._embed(params, token_ids, positions)
+        x = (
+            self._embed(params, token_ids, positions)
+            if first_stage
+            else hidden
+        )
         for i, layer in enumerate(params["layers"]):
             dl = None
             if lora is not None:
@@ -512,6 +538,8 @@ class LlamaForCausalLM:
                 tables,
             )
 
+        if not last_stage:
+            return x, (k_cache, v_cache)
         if logits_indices is not None:
             x = x[logits_indices]
         return self._logits(params, x), (k_cache, v_cache)
@@ -583,6 +611,9 @@ class LlamaForCausalLM:
         block_size: int,
         lora=None,  # LoRAStacks or None
         lora_idx: jax.Array | None = None,  # [B] adapter slot per row
+        hidden: jax.Array | None = None,  # [B, d] from the previous pp stage
+        first_stage: bool = True,
+        last_stage: bool = True,
     ) -> tuple[jax.Array, tuple[jax.Array, jax.Array]]:
         """One decode step for the whole (padded) running batch."""
         cfg = self.config
@@ -606,7 +637,11 @@ class LlamaForCausalLM:
                 window=self._window_for_layer(i),
             )
 
-        x = self._embed(params, token_ids, positions)
+        x = (
+            self._embed(params, token_ids, positions)
+            if first_stage
+            else hidden
+        )
         for i, layer in enumerate(params["layers"]):
             dl = None
             if lora is not None:
@@ -620,4 +655,6 @@ class LlamaForCausalLM:
                 tables,
             )
 
+        if not last_stage:
+            return x, (k_cache, v_cache)
         return self._logits(params, x), (k_cache, v_cache)

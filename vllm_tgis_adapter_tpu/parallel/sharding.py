@@ -94,17 +94,20 @@ _EXPERT_FFN_SPECS = {
 
 def llama_param_specs(params: dict, tp: int = 1) -> dict:
     """PartitionSpec pytree matching models/llama.py's param layout."""
-    specs: dict = {
+    # emit a spec for exactly the keys present: pipeline stages carry
+    # partial trees (embed on stage 0 only, final norm / lm_head on the
+    # last), and tree.map requires identical dict structure
+    top_specs = {
         "embed": P(TP_AXIS, None),
         "final_norm": P(None),
-    }
-    if "final_norm_bias" in params:
-        specs["final_norm_bias"] = P(None)
-    if "pos_embed" in params:
+        "final_norm_bias": P(None),
         # tiny table (max_len rows); replicate rather than shard
-        specs["pos_embed"] = P(None, None)
-    if "lm_head" in params:
-        specs["lm_head"] = P(None, TP_AXIS)
+        "pos_embed": P(None, None),
+        "lm_head": P(None, TP_AXIS),
+    }
+    specs: dict = {
+        name: top_specs[name] for name in params if name != "layers"
+    }
 
     def layer_spec(layer: dict) -> dict:
         expert_specs = _EXPERT_FFN_SPECS
