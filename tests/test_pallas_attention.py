@@ -289,3 +289,99 @@ def test_chunked_prefill_dispatch_under_tp_mesh(monkeypatch):
         np.asarray(got)[:valid], np.asarray(ref)[:valid],
         rtol=2e-5, atol=2e-5,
     )
+
+
+# ------------------------------------------------------- sliding window
+
+@pytest.mark.parametrize("window", [8, 24])
+@pytest.mark.parametrize("g", [1, 4])
+def test_windowed_paged_decode_matches_reference(window, g):
+    """Band-masked decode kernel vs the XLA windowed reference."""
+    b, num_kv, head_dim, block_size, max_blocks = 5, 2, 64, 16, 4
+    q, k_cache, v_cache, bt, cl = make_paged_case(
+        3, b, num_kv, g, head_dim, block_size, max_blocks, num_slots=512
+    )
+    scale = head_dim**-0.5
+    ref = ref_ops.paged_decode_attention_xla(
+        jnp.asarray(q), jnp.asarray(k_cache), jnp.asarray(v_cache),
+        jnp.asarray(bt), jnp.asarray(cl), block_size, scale, window=window,
+    )
+    got = pk.paged_decode_attention(
+        jnp.asarray(q), jnp.asarray(k_cache), jnp.asarray(v_cache),
+        jnp.asarray(bt), jnp.asarray(cl), block_size, scale,
+        window=window, interpret=True,
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("t,valid,window", [
+    (128, 128, 16),   # band cuts deep into the prompt
+    (128, 100, 16),   # + padding region
+    (256, 256, 200),  # window wider than most rows' context
+    (64, 64, 1),      # degenerate: attend to self only
+])
+def test_windowed_flash_prefill_matches_reference(t, valid, window):
+    rng = np.random.default_rng(7)
+    num_kv, g, head_dim = 2, 2, 32
+    h = num_kv * g
+    q = rng.standard_normal((t, h, head_dim)).astype(np.float32)
+    k = rng.standard_normal((t, num_kv, head_dim)).astype(np.float32)
+    v = rng.standard_normal((t, num_kv, head_dim)).astype(np.float32)
+    scale = head_dim**-0.5
+    ref = ref_ops.prefill_attention_xla(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), scale,
+        jnp.asarray(valid), window=window,
+    )
+    got = pk.prefill_attention(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), scale,
+        jnp.asarray(valid, dtype=jnp.int32), window=window, interpret=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(got)[:valid], np.asarray(ref)[:valid],
+        rtol=2e-5, atol=2e-5,
+    )
+
+
+@pytest.mark.parametrize("t,valid,start,window", [
+    (64, 64, 64, 16),   # later chunk, band inside prior context
+    (64, 40, 100, 8),   # ragged chunk deep in context
+    (32, 32, 0, 48),    # first chunk, window wider than the chunk
+])
+def test_windowed_chunked_prefill_matches_reference(t, valid, start, window):
+    """Band-masked chunked kernel vs the windowed decode formulation."""
+    rng = np.random.default_rng(11)
+    num_kv, g, head_dim, block_size = 2, 2, 32, 16
+    h = num_kv * g
+    total = start + t
+    max_blocks = -(-total // block_size) + 2
+    num_slots = 1024
+    q = rng.standard_normal((t, h, head_dim)).astype(np.float32)
+    k_cache = rng.standard_normal(
+        (num_kv, num_slots, head_dim)).astype(np.float32)
+    v_cache = rng.standard_normal(
+        (num_kv, num_slots, head_dim)).astype(np.float32)
+    table = rng.permutation(num_slots // block_size)[:max_blocks].astype(
+        np.int32
+    )
+
+    # reference: each chunk query as a decode row with a banded context
+    local = np.arange(t)
+    positions = start + local
+    ctx = np.where(local < valid, positions + 1, 1).astype(np.int32)
+    tables = np.broadcast_to(table, (t, max_blocks))
+    ref = ref_ops.paged_decode_attention_xla(
+        jnp.asarray(q), jnp.asarray(k_cache), jnp.asarray(v_cache),
+        jnp.asarray(tables), jnp.asarray(ctx),
+        block_size, head_dim**-0.5, window=window,
+    )
+    got = pk.chunked_prefill_attention(
+        jnp.asarray(q), jnp.asarray(k_cache), jnp.asarray(v_cache),
+        jnp.asarray(table), jnp.asarray(start, jnp.int32),
+        jnp.asarray(valid, jnp.int32), block_size, head_dim**-0.5,
+        window=window, interpret=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(got)[:valid], np.asarray(ref)[:valid],
+        rtol=2e-5, atol=2e-5,
+    )

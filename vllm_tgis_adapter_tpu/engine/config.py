@@ -126,11 +126,8 @@ class ModelConfig:
             else:
                 max_window_layers = hf.get("max_window_layers", 0)
         if sliding_window:
-            _logger.warning(
-                "sliding-window attention (window=%d) currently runs on "
-                "the XLA attention path, which materialises per-chunk "
-                "score tensors — long-context windowed serving is "
-                "memory-bound until the Pallas band-mask kernel lands",
+            _logger.info(
+                "sliding-window attention enabled (window=%d tokens)",
                 sliding_window,
             )
         if model_type == "opt":

@@ -79,28 +79,6 @@ def write_kv(
     return k_cache, v_cache
 
 
-_warned_window_fallback = False
-
-
-def _window_uses_xla(window: int) -> bool:
-    """Sliding-window masking is implemented in the XLA formulations; the
-    Pallas kernels don't carry the band mask yet, so windowed models
-    (mistral v0.1 lineage) take the XLA path on every backend."""
-    global _warned_window_fallback
-    if window <= 0:
-        return False
-    if _use_pallas() and not _warned_window_fallback:
-        _warned_window_fallback = True
-        from vllm_tgis_adapter_tpu.logging import init_logger
-
-        init_logger(__name__).info(
-            "sliding-window attention (window=%d) uses the XLA attention "
-            "path; Pallas band-mask kernels are not implemented yet",
-            window,
-        )
-    return True
-
-
 def prefill_attention(
     q: jax.Array,
     k: jax.Array,
@@ -125,12 +103,6 @@ def prefill_attention(
             "--sequence-parallel-size > 1 yet (ring attention has no band "
             "mask); windowed models bound their own context instead"
         )
-    if _window_uses_xla(window):
-        # plain XLA ops: the GSPMD partitioner splits them over any mesh
-        # from the operand shardings (no shard_map needed — that is only
-        # for the opaque pallas_call)
-        return prefill_attention_xla(q, k, v, scale, valid_len,
-                                     window=window)
     if mesh is not None and dict(mesh.shape).get("sp", 1) > 1:
         from vllm_tgis_adapter_tpu.ops.ring_attention import (
             ring_prefill_attention,
@@ -153,6 +125,7 @@ def prefill_attention(
         kernel = functools.partial(
             pallas_attention.prefill_attention,
             scale=scale,
+            window=window,
             interpret=_pallas_interpret(),
         )
         if mesh is not None:
@@ -167,7 +140,7 @@ def prefill_attention(
                 check_vma=False,
             )(q, k, v, vl)
         return kernel(q, k, v, valid_len=vl)
-    return prefill_attention_xla(q, k, v, scale, valid_len)
+    return prefill_attention_xla(q, k, v, scale, valid_len, window=window)
 
 
 def prefill_attention_xla(
@@ -226,11 +199,6 @@ def paged_decode_attention(
     Under a TP mesh the kernel runs inside shard_map: the cache is
     head-sharded on tp, so each shard's kernel reads only its local pages.
     """
-    if _window_uses_xla(window):
-        return paged_decode_attention_xla(
-            q, k_cache, v_cache, block_tables, context_lens, block_size,
-            scale, window=window,
-        )
     if _use_pallas():
         from vllm_tgis_adapter_tpu.ops import pallas_attention
 
@@ -238,6 +206,7 @@ def paged_decode_attention(
             pallas_attention.paged_decode_attention,
             block_size=block_size,
             scale=scale,
+            window=window,
             interpret=_pallas_interpret(),
         )
         if mesh is not None:
@@ -254,7 +223,8 @@ def paged_decode_attention(
             )(q, k_cache, v_cache, block_tables, context_lens)
         return kernel(q, k_cache, v_cache, block_tables, context_lens)
     return paged_decode_attention_xla(
-        q, k_cache, v_cache, block_tables, context_lens, block_size, scale
+        q, k_cache, v_cache, block_tables, context_lens, block_size, scale,
+        window=window,
     )
 
 
@@ -278,13 +248,14 @@ def chunked_prefill_attention(
     the decode formulation (each query as a batch row with its own
     context length), which is what the kernel's numerics are pinned to.
     """
-    if _use_pallas() and not _window_uses_xla(window):
+    if _use_pallas():
         from vllm_tgis_adapter_tpu.ops import pallas_attention
 
         kernel = functools.partial(
             pallas_attention.chunked_prefill_attention,
             block_size=block_size,
             scale=scale,
+            window=window,
             interpret=_pallas_interpret(),
         )
         if mesh is not None:

@@ -61,11 +61,14 @@ def _decode_kernel(
     *,
     scale: float,
     block_size: int,
+    window: int,
 ):
     b = pl.program_id(0)
     j = pl.program_id(2)
     last = pl.num_programs(2) - 1
     ctx = context_lens_ref[b]
+    # sliding window: only keys at positions >= ctx - window are live
+    win_lo = jnp.maximum(ctx - window, 0) if window > 0 else 0
 
     @pl.when(j == 0)
     def _init():
@@ -73,7 +76,8 @@ def _decode_kernel(
         l_ref[...] = jnp.zeros_like(l_ref)
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    @pl.when(j * block_size < ctx)
+    @pl.when((j * block_size < ctx)
+             & ((j + 1) * block_size > win_lo))
     def _page():
         q = q_ref[0, 0].astype(jnp.float32)  # [G, Dh]
         k = k_ref[0].astype(jnp.float32)  # [bs, Dh]
@@ -85,12 +89,18 @@ def _decode_kernel(
         pos = j * block_size + jax.lax.broadcasted_iota(
             jnp.int32, s.shape, dimension=1
         )
-        s = jnp.where(pos < ctx, s, NEG_INF)
+        live = pos < ctx
+        if window > 0:
+            live &= pos >= win_lo
+        s = jnp.where(live, s, NEG_INF)
 
         m_prev = m_ref[...]  # [G, 1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)  # [G, bs]
-        alpha = jnp.exp(m_prev - m_new)  # [G, 1]
+        shift = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(s - shift)  # [G, bs]
+        alpha = jnp.exp(
+            jnp.where(jnp.isfinite(m_prev), m_prev, shift) - shift
+        )  # [G, 1]
         l_ref[...] = alpha * l_ref[...] + jnp.sum(p, axis=-1, keepdims=True)
         acc_ref[...] = alpha * acc_ref[...] + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())),
@@ -107,7 +117,7 @@ def _decode_kernel(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("block_size", "scale", "interpret")
+    jax.jit, static_argnames=("block_size", "scale", "window", "interpret")
 )
 def paged_decode_attention(
     q: jax.Array,  # [B, H, Dh]
@@ -118,6 +128,7 @@ def paged_decode_attention(
     block_size: int,
     scale: float,
     *,
+    window: int = 0,  # >0: attend to at most the last `window` tokens
     interpret: bool = False,
 ) -> jax.Array:
     """Flash-style paged decode attention, one query token per sequence."""
@@ -132,12 +143,17 @@ def paged_decode_attention(
     safe_tables = jnp.clip(block_tables, 0, k_cache.shape[1] // block_size - 1)
 
     def page_index(i, j, bt, cl):
-        # page steps beyond the live context re-map to the last live page:
-        # Pallas elides the DMA when consecutive grid steps hit the same
-        # block, so HBM traffic stops at the context boundary instead of
-        # scaling with max_blocks (the pl.when only skips compute)
+        # page steps beyond the live context re-map to the last live page
+        # (and, with a sliding window, steps below the band to the first
+        # live one): Pallas elides the DMA when consecutive grid steps
+        # hit the same block, so HBM traffic covers only the live span
+        # (the pl.when only skips compute)
         last_live = jnp.maximum(cl[i] - 1, 0) // block_size
-        return bt[i, jnp.minimum(j, last_live)]
+        j_eff = jnp.minimum(j, last_live)
+        if window > 0:
+            first_live = jnp.maximum(cl[i] - window, 0) // block_size
+            j_eff = jnp.maximum(j_eff, first_live)
+        return bt[i, j_eff]
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
@@ -171,7 +187,8 @@ def paged_decode_attention(
     )
     out = pl.pallas_call(
         functools.partial(
-            _decode_kernel, scale=scale, block_size=block_size
+            _decode_kernel, scale=scale, block_size=block_size,
+            window=window,
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, num_kv, g, head_dim), q.dtype),
@@ -201,6 +218,7 @@ def _chunk_kernel(
     block_size: int,
     block_q: int,
     g: int,
+    window: int,
 ):
     iq = pl.program_id(1)
     j = pl.program_id(2)
@@ -215,9 +233,15 @@ def _chunk_kernel(
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
     # the page is live when it starts at or before the LAST query of this
-    # block (causality) and holds real context
+    # block (causality), holds real context, and (with a sliding window)
+    # reaches the FIRST query's band
     q_hi = start + iq * block_q + block_q - 1
-    @pl.when((j * block_size <= q_hi) & (j * block_size < start + valid))
+    live = (j * block_size <= q_hi) & (j * block_size < start + valid)
+    if window > 0:
+        band_lo = start + iq * block_q - window + 1
+        live &= (j + 1) * block_size > band_lo
+
+    @pl.when(live)
     def _page():
         q = q_ref[0].astype(jnp.float32)  # [G*bq, Dh]
         k = k_ref[0].astype(jnp.float32)  # [bs, Dh]
@@ -234,6 +258,8 @@ def _chunk_kernel(
             jnp.int32, s.shape, dimension=1
         )
         mask = (k_pos <= q_pos) & (k_pos < start + valid)
+        if window > 0:
+            mask &= q_pos - k_pos < window
         s = jnp.where(mask, s, NEG_INF)
 
         m_prev = m_ref[...]
@@ -258,7 +284,9 @@ def _chunk_kernel(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("block_size", "scale", "block_q", "interpret"),
+    static_argnames=(
+        "block_size", "scale", "block_q", "window", "interpret"
+    ),
 )
 def chunked_prefill_attention(
     q: jax.Array,  # [T, H, Dh] one chunk's queries (padded bucket)
@@ -271,6 +299,7 @@ def chunked_prefill_attention(
     scale: float,
     *,
     block_q: int = 128,
+    window: int = 0,
     interpret: bool = False,
 ) -> jax.Array:
     """Causal attention of one prompt chunk against its paged context.
@@ -302,13 +331,20 @@ def chunked_prefill_attention(
     safe_table = jnp.clip(block_table, 0, k_cache.shape[1] // block_size - 1)
 
     def page_index(h, iq, j, bt, meta):
-        # clamp steps past this q block's causal horizon to the last live
-        # page: consecutive identical indices elide the DMA entirely
+        # clamp steps past this q block's causal horizon (and, windowed,
+        # below its band) to a live page: consecutive identical indices
+        # elide the DMA entirely
         last_needed = jnp.minimum(
             (meta[0] + iq * block_q + block_q - 1) // block_size,
             jnp.maximum(meta[0] + meta[1] - 1, 0) // block_size,
         )
-        return bt[jnp.clip(jnp.minimum(j, last_needed), 0, None)]
+        j_eff = jnp.minimum(j, last_needed)
+        if window > 0:
+            first_needed = jnp.maximum(
+                meta[0] + iq * block_q - window + 1, 0
+            ) // block_size
+            j_eff = jnp.maximum(j_eff, first_needed)
+        return bt[jnp.clip(j_eff, 0, None)]
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
@@ -347,7 +383,7 @@ def chunked_prefill_attention(
     out = pl.pallas_call(
         functools.partial(
             _chunk_kernel, scale=scale, block_size=block_size,
-            block_q=block_q, g=g,
+            block_q=block_q, g=g, window=window,
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct(
@@ -376,6 +412,7 @@ def _prefill_kernel(
     scale: float,
     block_q: int,
     block_k: int,
+    window: int,
 ):
     i = pl.program_id(1)  # query block
     j = pl.program_id(2)  # key block
@@ -390,10 +427,13 @@ def _prefill_kernel(
 
     # causal: skip key blocks fully beyond this query block; valid_len is
     # scalar-prefetched, so blocks entirely in the padding region (every
-    # score masked anyway) are skipped for free too
-    @pl.when(
-        (j * block_k <= i * block_q + block_q - 1) & (j * block_k < valid)
-    )
+    # score masked anyway) are skipped for free too.  With a sliding
+    # window, blocks entirely below the query block's band skip as well.
+    live = (j * block_k <= i * block_q + block_q - 1) & (j * block_k < valid)
+    if window > 0:
+        live &= (j + 1) * block_k > i * block_q - window + 1
+
+    @pl.when(live)
     def _block():
         q = q_ref[0].astype(jnp.float32)  # [bq, Dh]
         k = k_ref[0].astype(jnp.float32)  # [bk, Dh]
@@ -408,7 +448,10 @@ def _prefill_kernel(
         cols = j * block_k + jax.lax.broadcasted_iota(
             jnp.int32, s.shape, dimension=1
         )
-        s = jnp.where((cols <= rows) & (cols < valid), s, NEG_INF)
+        keep = (cols <= rows) & (cols < valid)
+        if window > 0:
+            keep &= rows - cols < window
+        s = jnp.where(keep, s, NEG_INF)
 
         m_prev = m_ref[...]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
@@ -432,7 +475,7 @@ def _prefill_kernel(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("scale", "block_q", "block_k", "interpret"),
+    static_argnames=("scale", "block_q", "block_k", "window", "interpret"),
 )
 def prefill_attention(
     q: jax.Array,  # [T, H, Dh]
@@ -443,6 +486,7 @@ def prefill_attention(
     *,
     block_q: int = 128,
     block_k: int = 128,
+    window: int = 0,  # >0: band mask, rows - cols < window
     interpret: bool = False,
 ) -> jax.Array:
     """Flash causal self-attention over one padded prompt bucket.
@@ -492,7 +536,8 @@ def prefill_attention(
     )
     out = pl.pallas_call(
         functools.partial(
-            _prefill_kernel, scale=scale, block_q=block_q, block_k=block_k
+            _prefill_kernel, scale=scale, block_q=block_q,
+            block_k=block_k, window=window,
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((num_heads, t, head_dim), q.dtype),
