@@ -7,9 +7,12 @@ contiguous stages, each owning a disjoint ``tp``-sized device slice with
 its own layer-sliced KV cache and jitted stage program; activations hop
 stage to stage with ``jax.device_put`` (ICI transfers on real hardware).
 PP's primary inference value is CAPACITY — serving a model S× bigger than
-one device group's HBM — which this delivers; stage overlap via
-microbatching is future work, so per-request latency pays the bubble
-(documented, not hidden).
+one device group's HBM.  Decode additionally overlaps the stages: the
+batch splits into up to S microbatches whose chains are issued with no
+host synchronisation (sampled tokens feed back to stage 0 as device
+arrays), so JAX's async dispatch keeps every stage busy on a different
+microbatch.  Prefill chains remain sequential per prompt (single-request
+latency pays the stage bubble there).
 
 Scope (fail-fast otherwise, engine/config.py validation): composes with
 TP (stage meshes) and everything sampler-side (guided decoding, seeded
@@ -361,93 +364,148 @@ class PipelineRunner(ModelRunner):
     def execute_decode(self, prep) -> list[list[SampledToken]]:
         """K single-step stage chains per plan (the fused on-device scan
         cannot span device groups); penalties/sampling run on the last
-        stage exactly as the fused path does."""
-        tokens = np.asarray(prep.token_ids)
-        active_rows = np.asarray(prep.slots) >= 0
-        rows = np.clip(np.asarray(prep.slots), 0, None)
+        stage exactly as the fused path does.
 
-        # stage-constant inputs, placed once per dispatch
-        per_stage = []
-        for stage in self.stages:
-            per_stage.append(dict(
-                block_tables=self._stage_put(stage, prep.block_tables),
+        Overlap: the batch splits into up to ``num_stages`` microbatches
+        and dispatches are issued STEP-MAJOR (all chains' step k before
+        any chain's step k+1) with no host synchronisation — the sampled
+        tokens feed back to stage 0 as device arrays.  Per-device queues
+        execute FIFO, so step-major order is what lets stage s run
+        microbatch m's step while stage s+1 runs m-1's (chain-major
+        order would park a feedback-blocked dispatch at the head of the
+        queue and serialise everything behind it).  The host blocks only
+        once, collecting all K results at the end.  Microbatches touch
+        disjoint seen-matrix rows, so their sampler calls' shared
+        ordering on the last stage's device is not a correctness
+        constraint."""
+        b = prep.token_ids.shape[0]
+        n_stages = len(self.stages)
+        m_count = n_stages if (b % n_stages == 0 and b >= n_stages) else 1
+        mb = b // m_count
+        active_rows = np.asarray(prep.slots) >= 0
+        rows_all = np.clip(np.asarray(prep.slots), 0, None)
+
+        positions0 = np.asarray(prep.positions)
+        limits = np.asarray(prep.limits)
+        ctx0 = np.asarray(prep.context_lens)
+        tables_host = np.asarray(prep.block_tables)
+
+        # per-microbatch issue state (tensors leaves are [B] host numpy,
+        # engine/sampler.py SamplingTensors.from_params)
+        chains = []
+        for m in range(m_count):
+            lo, hi = m * mb, (m + 1) * mb
+            chains.append(dict(
+                lo=lo, hi=hi,
+                tokens=None,  # device array after step 0
+                tensors=jax.tree.map(
+                    lambda x, lo=lo, hi=hi: self._put(x[lo:hi]),
+                    prep.tensors,
+                ),
+                allowed=(
+                    self._put(prep.allowed_mask[lo:hi])
+                    if prep.allowed_mask is not None
+                    else None
+                ),
+                rows=jnp.asarray(rows_all[lo:hi]),
+                # stage-constant placements, done once per chain: block
+                # tables plus a token placeholder for non-first stages
+                # (decode() reads `hidden` there, not token_ids)
+                tables=[
+                    self._stage_put(stage, prep.block_tables[lo:hi])
+                    for stage in self.stages
+                ],
+                tok_placeholder=[
+                    self._stage_put(stage, prep.token_ids[lo:hi])
+                    for stage in self.stages
+                ],
+                outs=[],
             ))
 
-        seen_tensors = jax.tree.map(self._put, prep.tensors)
-        allowed = (
-            self._put(prep.allowed_mask)
-            if prep.allowed_mask is not None
-            else None
-        )
-        last = self.stages[-1]
-        outs_per_step = []
         for k in range(prep.num_steps):
-            positions = np.asarray(prep.positions) + k
-            active = (positions <= np.asarray(prep.limits)) & active_rows
-            blk = np.take_along_axis(
-                np.asarray(prep.block_tables),
-                np.clip(positions // self.block_size, 0,
-                        self.max_blocks_per_seq - 1)[:, None],
-                axis=1,
-            )[:, 0]
-            slot = np.where(
-                active, blk * self.block_size + positions % self.block_size,
-                -1,
-            ).astype(np.int32)
-            context_lens = (np.asarray(prep.context_lens) + k).astype(
-                np.int32
-            )
+            for chain in chains:
+                lo, hi = chain["lo"], chain["hi"]
+                positions = positions0[lo:hi] + k
+                active = (positions <= limits[lo:hi]) & active_rows[lo:hi]
+                blk = np.take_along_axis(
+                    tables_host[lo:hi],
+                    np.clip(positions // self.block_size, 0,
+                            self.max_blocks_per_seq - 1)[:, None],
+                    axis=1,
+                )[:, 0]
+                slot = np.where(
+                    active,
+                    blk * self.block_size + positions % self.block_size,
+                    -1,
+                ).astype(np.int32)
+                context_lens = (ctx0[lo:hi] + k).astype(np.int32)
 
-            hidden = None
-            logits = None
-            for stage, sconst in zip(self.stages, per_stage):
-                kwargs = dict(
-                    token_ids=self._stage_put(stage, tokens),
-                    positions=self._stage_put(stage, positions),
-                    slot_mapping=self._stage_put(stage, slot),
-                    block_tables=sconst["block_tables"],
-                    context_lens=self._stage_put(stage, context_lens),
-                )
-                if not stage.first:
-                    kwargs["hidden"] = jax.device_put(
-                        hidden, stage.data_sharding
+                hidden = None
+                logits = None
+                for si, stage in enumerate(self.stages):
+                    if stage.first and chain["tokens"] is not None:
+                        # sampled on the last stage, consumed on the
+                        # first: device-to-device, no host sync
+                        tok_in = jax.device_put(
+                            chain["tokens"], stage.data_sharding
+                        )
+                    else:
+                        tok_in = chain["tok_placeholder"][si]
+                    kwargs = dict(
+                        token_ids=tok_in,
+                        positions=self._stage_put(stage, positions),
+                        slot_mapping=self._stage_put(stage, slot),
+                        block_tables=chain["tables"][si],
+                        context_lens=self._stage_put(stage, context_lens),
                     )
-                out, stage.caches = stage.decode_fn(
-                    stage.params, stage.caches, **kwargs
-                )
-                if stage.last:
-                    logits = out
-                else:
-                    hidden = out
+                    if not stage.first:
+                        kwargs["hidden"] = jax.device_put(
+                            hidden, stage.data_sharding
+                        )
+                    out, stage.caches = stage.decode_fn(
+                        stage.params, stage.caches, **kwargs
+                    )
+                    if stage.last:
+                        logits = out
+                    else:
+                        hidden = out
 
-            t_k = dataclasses.replace(
-                seen_tensors, gen_len=seen_tensors.gen_len + k
-            )
-            seen_rows = jnp.take(self.seen, jnp.asarray(rows), axis=0)
-            out = sampler_mod.sample(
-                logits, seen_rows, t_k, allowed_mask=allowed
-            )
-            self.seen = sampler_mod.update_seen(
-                self.seen,
-                jnp.asarray(np.where(active, np.asarray(prep.slots), -1)),
-                out.tokens,
-            )
-            outs_per_step.append(out)
-            # feed the sampled tokens back as the next step's inputs
-            tokens = np.asarray(out.tokens)
+                t_k = dataclasses.replace(
+                    chain["tensors"],
+                    gen_len=chain["tensors"].gen_len + k,
+                )
+                seen_rows = jnp.take(self.seen, chain["rows"], axis=0)
+                out = sampler_mod.sample(
+                    logits, seen_rows, t_k, allowed_mask=chain["allowed"]
+                )
+                self.seen = sampler_mod.update_seen(
+                    self.seen,
+                    jnp.asarray(
+                        np.where(
+                            active, np.asarray(prep.slots)[lo:hi], -1
+                        )
+                    ),
+                    out.tokens,
+                )
+                chain["outs"].append(out)
+                chain["tokens"] = out.tokens  # stays on device
+
+        def collect(field):
+            # [K, B]: concatenate microbatch columns per step
+            return np.stack([
+                np.concatenate([
+                    np.asarray(getattr(chain["outs"][k], field))
+                    for chain in chains
+                ])
+                for k in range(prep.num_steps)
+            ])
 
         host = _HostSamplerOutput(
-            tokens=np.stack([np.asarray(o.tokens) for o in outs_per_step]),
-            logprobs=np.stack(
-                [np.asarray(o.logprob) for o in outs_per_step]
-            ),
-            ranks=np.stack([np.asarray(o.rank) for o in outs_per_step]),
-            topn_ids=np.stack(
-                [np.asarray(o.topn_ids) for o in outs_per_step]
-            ),
-            topn_logprobs=np.stack(
-                [np.asarray(o.topn_logprobs) for o in outs_per_step]
-            ),
+            tokens=collect("tokens"),
+            logprobs=collect("logprob"),
+            ranks=collect("rank"),
+            topn_ids=collect("topn_ids"),
+            topn_logprobs=collect("topn_logprobs"),
         )
         return [
             [host.token(k, i) for k in range(prep.steps_per_seq[i])]
