@@ -145,6 +145,20 @@ def split_pipeline_params(params: dict, ranges) -> list[dict]:
     return stages
 
 
+def _stage_decode(model, block_size, first, last,
+                  params, caches, token_ids, step_ints, block_tables,
+                  hidden=None):
+    """Jitted per-stage decode wrapper: the three identical per-step row
+    vectors (positions, slot_mapping, context_lens) travel as ONE packed
+    [3, B] int32 buffer per stage — each host↔device buffer is its own
+    transfer (and, tunnel-attached, its own network round trip)."""
+    return model.decode(
+        params, caches, token_ids, step_ints[0], step_ints[1],
+        block_tables, step_ints[2], block_size,
+        hidden=hidden, first_stage=first, last_stage=last,
+    )
+
+
 @dataclasses.dataclass
 class _Stage:
     model: object  # layer-sliced model instance (own config/layer_offset)
@@ -249,8 +263,8 @@ class PipelineRunner(ModelRunner):
                 ),
                 decode_fn=jax.jit(
                     functools.partial(
-                        smodel.decode, block_size=self.block_size,
-                        first_stage=first, last_stage=last,
+                        _stage_decode, smodel, self.block_size,
+                        first, last,
                     ),
                     donate_argnums=donate,
                 ),
@@ -390,8 +404,9 @@ class PipelineRunner(ModelRunner):
         ctx0 = np.asarray(prep.context_lens)
         tables_host = np.asarray(prep.block_tables)
 
-        # per-microbatch issue state (tensors leaves are [B] host numpy,
-        # engine/sampler.py SamplingTensors.from_params)
+        # per-microbatch issue state; tensors leaves are [B] host numpy
+        # (engine/sampler.py SamplingTensors.from_params keeps them on
+        # host precisely so callers control the transfer)
         chains = []
         for m in range(m_count):
             lo, hi = m * mb, (m + 1) * mb
@@ -439,6 +454,7 @@ class PipelineRunner(ModelRunner):
                     -1,
                 ).astype(np.int32)
                 context_lens = (ctx0[lo:hi] + k).astype(np.int32)
+                step_ints = np.stack([positions, slot, context_lens])
 
                 hidden = None
                 logits = None
@@ -453,10 +469,8 @@ class PipelineRunner(ModelRunner):
                         tok_in = chain["tok_placeholder"][si]
                     kwargs = dict(
                         token_ids=tok_in,
-                        positions=self._stage_put(stage, positions),
-                        slot_mapping=self._stage_put(stage, slot),
+                        step_ints=self._stage_put(stage, step_ints),
                         block_tables=chain["tables"][si],
-                        context_lens=self._stage_put(stage, context_lens),
                     )
                     if not stage.first:
                         kwargs["hidden"] = jax.device_put(
@@ -490,22 +504,29 @@ class PipelineRunner(ModelRunner):
                 chain["outs"].append(out)
                 chain["tokens"] = out.tokens  # stays on device
 
-        def collect(field):
-            # [K, B]: concatenate microbatch columns per step
-            return np.stack([
-                np.concatenate([
-                    np.asarray(getattr(chain["outs"][k], field))
-                    for chain in chains
-                ])
-                for k in range(prep.num_steps)
-            ])
-
+        # pack each chain's K results ON DEVICE into one int and one
+        # float array, so the host pulls 2 buffers per chain instead of
+        # 5 per (chain, step)
+        ints_np, floats_np = [], []
+        for chain in chains:
+            outs = chain["outs"]
+            ints_np.append(np.asarray(jnp.concatenate([
+                jnp.stack([o.tokens for o in outs])[..., None],
+                jnp.stack([o.rank for o in outs])[..., None],
+                jnp.stack([o.topn_ids for o in outs]),
+            ], axis=-1)))  # [K, mb, 2+W]
+            floats_np.append(np.asarray(jnp.concatenate([
+                jnp.stack([o.logprob for o in outs])[..., None],
+                jnp.stack([o.topn_logprobs for o in outs]),
+            ], axis=-1)))  # [K, mb, 1+W]
+        ints_all = np.concatenate(ints_np, axis=1)  # [K, B, 2+W]
+        floats_all = np.concatenate(floats_np, axis=1)
         host = _HostSamplerOutput(
-            tokens=collect("tokens"),
-            logprobs=collect("logprob"),
-            ranks=collect("rank"),
-            topn_ids=collect("topn_ids"),
-            topn_logprobs=collect("topn_logprobs"),
+            tokens=ints_all[..., 0],
+            ranks=ints_all[..., 1],
+            topn_ids=ints_all[..., 2:],
+            logprobs=floats_all[..., 0],
+            topn_logprobs=floats_all[..., 1:],
         )
         return [
             [host.token(k, i) for k in range(prep.steps_per_seq[i])]

@@ -263,6 +263,14 @@ class ModelRunner:
         are computed on device from the block tables; rows finish early
         via the ``limits`` mask (their writes are dropped and their
         sampled tokens discarded by the host).
+
+        Transfer packing: the eleven per-row int32 inputs travel as ONE
+        ``[11, B]`` array and the five float32 sampling knobs as one
+        ``[5, B]`` array; results come back as one int and one float
+        array.  Each host↔device buffer is its own transfer at the
+        runtime layer — and through a tunnel-attached chip, its own
+        network round trip — so per-dispatch overhead scales with the
+        BUFFER count, not the byte count (these are all tiny).
         """
         model = self.model
         block_size = self.block_size
@@ -271,19 +279,37 @@ class ModelRunner:
             params,
             caches,
             seen,  # [max_seqs, V] full seen-token matrix (carried)
-            tokens,  # [B] last sampled token per row
-            positions0,  # [B] position of that token
-            limits,  # [B] last position each row may run (mask after)
+            ints,  # [11, B] i32: tokens, positions0, limits, ctx_lens0,
+            #      row_slots, top_k, len_penalty_start, min_tokens,
+            #      eos_token_id, gen_len, base_key (uint32 bitcast)
+            floats,  # [5, B] f32: temperature, top_p, typical_p,
+            #        repetition_penalty, len_penalty_decay
             block_tables,  # [B, max_blocks]
-            context_lens0,  # [B] length including the current token
-            row_slots,  # [B] row index into ``seen``; -1 pads
-            tensors: SamplingTensors,
             allowed_mask,  # [B, V] bool or None (FSM-constrained rows)
             lora,  # LoRAStacks or None
             lora_idx,  # [B] adapter slot per row or None
             num_steps: int,  # static: steps fused into this dispatch
         ):
-            b = tokens.shape[0]
+            tokens0 = ints[0]
+            positions0 = ints[1]
+            limits = ints[2]
+            context_lens0 = ints[3]
+            row_slots = ints[4]
+            tensors = SamplingTensors(
+                temperature=floats[0],
+                top_k=ints[5],
+                top_p=floats[1],
+                typical_p=floats[2],
+                repetition_penalty=floats[3],
+                len_penalty_start=ints[6],
+                len_penalty_decay=floats[4],
+                min_tokens=ints[7],
+                eos_token_id=ints[8],
+                gen_len=ints[9],
+                base_key=jax.lax.bitcast_convert_type(
+                    ints[10], jnp.uint32
+                ),
+            )
             rows = jnp.clip(row_slots, 0, None)
             max_blocks = block_tables.shape[1]
 
@@ -316,12 +342,20 @@ class ModelRunner:
                 return (caches, seen, out.tokens), out
 
             (caches, seen, _), outs = jax.lax.scan(
-                step, (caches, seen, tokens), jnp.arange(num_steps)
+                step, (caches, seen, tokens0), jnp.arange(num_steps)
             )
-            return caches, seen, outs
+            ints_out = jnp.concatenate(
+                [outs.tokens[..., None], outs.rank[..., None],
+                 outs.topn_ids],
+                axis=-1,
+            )  # [K, B, 2+W]
+            floats_out = jnp.concatenate(
+                [outs.logprob[..., None], outs.topn_logprobs], axis=-1
+            )  # [K, B, 1+W]
+            return caches, seen, ints_out, floats_out
 
         donate = (1, 2) if jax.default_backend() == "tpu" else ()
-        return jax.jit(decode_steps, static_argnums=(13,),
+        return jax.jit(decode_steps, static_argnums=(9,),
                        donate_argnums=donate)
 
     def _put(self, x) -> jax.Array:
@@ -622,17 +656,28 @@ class ModelRunner:
         if prep.spec_ok:
             return self.spec.run(prep)
         lora = self.lora_stacks if prep.lora_idx is not None else None
-        self.caches, self.seen, outs = self._decode_fn(
+        t = prep.tensors
+        ints = np.stack([
+            prep.token_ids, prep.positions, prep.limits,
+            prep.context_lens, prep.slots,
+            np.asarray(t.top_k, np.int32),
+            np.asarray(t.len_penalty_start, np.int32),
+            np.asarray(t.min_tokens, np.int32),
+            np.asarray(t.eos_token_id, np.int32),
+            np.asarray(t.gen_len, np.int32),
+            np.asarray(t.base_key, np.uint32).view(np.int32),
+        ]).astype(np.int32)
+        floats = np.stack([
+            t.temperature, t.top_p, t.typical_p,
+            t.repetition_penalty, t.len_penalty_decay,
+        ]).astype(np.float32)
+        self.caches, self.seen, ints_out, floats_out = self._decode_fn(
             self.params,
             self.caches,
             self.seen,
-            self._put(prep.token_ids),
-            self._put(prep.positions),
-            self._put(prep.limits),
+            self._put(ints),
+            self._put(floats),
             self._put(prep.block_tables),
-            self._put(prep.context_lens),
-            self._put(prep.slots),
-            jax.tree.map(self._put, prep.tensors),
             self._put(prep.allowed_mask)
             if prep.allowed_mask is not None
             else None,
@@ -641,7 +686,15 @@ class ModelRunner:
             prep.num_steps,
         )
 
-        host = _HostSamplerOutput.from_device(outs)  # [K, B] arrays
+        ints_np = np.asarray(ints_out)  # [K, B, 2+W]
+        floats_np = np.asarray(floats_out)  # [K, B, 1+W]
+        host = _HostSamplerOutput(
+            tokens=ints_np[..., 0],
+            ranks=ints_np[..., 1],
+            topn_ids=ints_np[..., 2:],
+            logprobs=floats_np[..., 0],
+            topn_logprobs=floats_np[..., 1:],
+        )
         return [
             [host.token(k, i) for k in range(prep.steps_per_seq[i])]
             for i in range(prep.num_seqs)

@@ -88,18 +88,23 @@ class SamplingTensors:
             min_tokens[i] = p.min_tokens
             if p.seed is not None:
                 keys[i] = np.uint32(p.seed & 0xFFFFFFFF) ^ np.uint32(p.seed >> 32)
+        # HOST numpy leaves: callers decide when (and packed how) these
+        # cross to the device — runner.execute_decode packs them into two
+        # arrays per dispatch, execute_prefill tree-maps _put.  Returning
+        # device arrays here would force a device round trip per field
+        # on every decode dispatch.
         return SamplingTensors(
-            temperature=jnp.asarray(temperature),
-            top_k=jnp.asarray(top_k),
-            top_p=jnp.asarray(top_p),
-            typical_p=jnp.asarray(typical_p),
-            repetition_penalty=jnp.asarray(rep),
-            len_penalty_start=jnp.asarray(lp_start),
-            len_penalty_decay=jnp.asarray(lp_decay),
-            min_tokens=jnp.asarray(min_tokens),
-            eos_token_id=jnp.full(n, eos_token_id, jnp.int32),
-            gen_len=jnp.asarray(np.asarray(gen_lens, np.int32)),
-            base_key=jnp.asarray(keys),
+            temperature=temperature,
+            top_k=top_k,
+            top_p=top_p,
+            typical_p=typical_p,
+            repetition_penalty=rep,
+            len_penalty_start=lp_start,
+            len_penalty_decay=lp_decay,
+            min_tokens=min_tokens,
+            eos_token_id=np.full(n, eos_token_id, np.int32),
+            gen_len=np.asarray(gen_lens, np.int32),
+            base_key=keys,
         )
 
 
