@@ -371,6 +371,76 @@ def build_tiny_gpt_neox(path: str, seed: int = 0) -> str:
     return str(out)
 
 
+TINY_BLOOM_CONFIG = {
+    "architectures": ["BloomForCausalLM"],
+    "model_type": "bloom",
+    "vocab_size": 512,
+    "hidden_size": 64,
+    "n_layer": 2,
+    "n_head": 4,
+    "layer_norm_epsilon": 1e-5,
+    "apply_residual_connection_post_layernorm": False,
+    "tie_word_embeddings": True,
+    "bos_token_id": 1,
+    "eos_token_id": 2,
+    "torch_dtype": "float32",
+}
+
+
+def build_tiny_bloom(path: str, seed: int = 0) -> str:
+    """Tiny BLOOM checkpoint in HF naming: ALiBi (no position params),
+    word_embeddings_layernorm, fused head-interleaved query_key_value,
+    tied head."""
+    import numpy as np
+    from safetensors.numpy import save_file
+
+    out = Path(path)
+    out.mkdir(parents=True, exist_ok=True)
+
+    tokenizer = build_tokenizer(path)
+    cfg = dict(TINY_BLOOM_CONFIG)
+    cfg["vocab_size"] = max(cfg["vocab_size"], len(tokenizer))
+    with open(out / "config.json", "w") as f:
+        json.dump(cfg, f, indent=2)
+
+    rng = np.random.default_rng(seed)
+    d = cfg["hidden_size"]
+    inter = 4 * d
+    vocab = cfg["vocab_size"]
+
+    def w(shape):
+        return (rng.standard_normal(shape) * 0.02).astype(np.float32)
+
+    def b(n):
+        return (rng.standard_normal(n) * 0.01).astype(np.float32)
+
+    tensors = {
+        "word_embeddings.weight": w((vocab, d)),
+        "word_embeddings_layernorm.weight": np.ones(d, np.float32),
+        "word_embeddings_layernorm.bias": b(d),
+        "ln_f.weight": np.ones(d, np.float32),
+        "ln_f.bias": b(d),
+    }
+    for i in range(cfg["n_layer"]):
+        p = f"h.{i}"
+        tensors |= {
+            f"{p}.input_layernorm.weight": np.ones(d, np.float32),
+            f"{p}.input_layernorm.bias": b(d),
+            f"{p}.post_attention_layernorm.weight": np.ones(d, np.float32),
+            f"{p}.post_attention_layernorm.bias": b(d),
+            f"{p}.self_attention.query_key_value.weight": w((3 * d, d)),
+            f"{p}.self_attention.query_key_value.bias": b(3 * d),
+            f"{p}.self_attention.dense.weight": w((d, d)),
+            f"{p}.self_attention.dense.bias": b(d),
+            f"{p}.mlp.dense_h_to_4h.weight": w((inter, d)),
+            f"{p}.mlp.dense_h_to_4h.bias": b(inter),
+            f"{p}.mlp.dense_4h_to_h.weight": w((d, inter)),
+            f"{p}.mlp.dense_4h_to_h.bias": b(d),
+        }
+    save_file(tensors, out / "model.safetensors")
+    return str(out)
+
+
 def build_tiny_lora_adapter(path: str, seed: int = 7, rank: int = 4) -> str:
     """PEFT-format LoRA adapter matching the tiny llama fixture: real
     random A/B weights on q/v projections of both layers (the reference's

@@ -1,0 +1,253 @@
+"""BLOOM family: numerical parity vs HF torch + engine e2e.
+
+Fifth architecture family through the shared decoder skeleton, and the
+original TGIS flagship lineage.  Distinguishing chemistry: ALiBi
+per-head position biases (no positional parameters at all, applied as
+``score += slope_h · k_pos`` in the attention ops), a LayerNorm directly
+on the embedding output, fused head-interleaved ``query_key_value``
+checkpoints under ``h.{i}.self_attention``, and a tied head.
+
+Gold-standard checks mirror the other family suites.  The ALiBi decode
+path is exercised deep past the prompt so the paged formulation's
+position bias (flat slot index == sequence position) is pinned against
+HF's cached generate.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from tests.fixture_models import hf_reference_model, hf_tokenize
+
+
+@pytest.fixture(scope="module")
+def bloom_dir(tmp_path_factory):
+    from tests.fixture_models import build_tiny_bloom
+
+    return build_tiny_bloom(str(tmp_path_factory.mktemp("tiny-bloom")))
+
+
+@pytest.fixture(scope="module")
+def setup(bloom_dir):
+    import jax.numpy as jnp
+
+    from vllm_tgis_adapter_tpu.engine.config import ModelConfig
+    from vllm_tgis_adapter_tpu.engine.weights import load_model_params
+    from vllm_tgis_adapter_tpu.models import get_model_class
+
+    config = ModelConfig.from_pretrained(bloom_dir, dtype="float32")
+    model = get_model_class(config.model_type)(config)
+    params = load_model_params(config, bloom_dir)
+    caches = model.make_kv_caches(num_slots=1024, dtype=jnp.float32)
+    return bloom_dir, config, model, params, caches
+
+
+def test_bloom_config_mapping(setup):
+    _, config, model, params, _ = setup
+    assert config.model_type == "bloom"
+    assert config.position_embedding == "alibi"
+    assert config.embed_norm
+    assert config.norm_type == "layernorm"
+    assert config.hidden_act == "gelu_new"  # BloomGelu == tanh approx
+    assert config.tie_word_embeddings
+    assert model.alibi is not None and model.alibi.shape == (4,)
+    assert "embed_norm" in params and "pos_embed" not in params
+    layer = params["layers"][0]
+    for name in ("wq", "bq", "bo", "b_up", "b_down"):
+        assert name in layer, name
+
+
+def test_alibi_slopes_formula():
+    from vllm_tgis_adapter_tpu.models.llama import alibi_slopes
+
+    # power of two: 2^-1 .. 2^-8 for 8 heads
+    np.testing.assert_allclose(
+        alibi_slopes(8), [2.0 ** (-i) for i in range(1, 9)], rtol=1e-9
+    )
+    # non-power-of-two: closest power + interleave (HF convention)
+    got = alibi_slopes(6)
+    assert len(got) == 6
+    np.testing.assert_allclose(got[:4], [2.0 ** (-i * 2) for i in
+                                         (1, 2, 3, 4)], rtol=1e-9)
+
+
+def test_bloom_prefill_logits_match_hf(setup):
+    import jax.numpy as jnp
+    import torch
+
+    model_dir, config, model, params, caches = setup
+    input_ids = hf_tokenize(model_dir, "the quick brown fox jumps")
+    t = len(input_ids)
+
+    logits, _ = model.prefill(
+        params, caches,
+        jnp.asarray(input_ids, dtype=jnp.int32),
+        jnp.arange(t, dtype=jnp.int32),
+        jnp.arange(t, dtype=jnp.int32),
+        jnp.asarray(t, dtype=jnp.int32),
+    )
+    hf = hf_reference_model(model_dir)
+    with torch.no_grad():
+        hf_logits = hf(torch.tensor([input_ids])).logits[0].numpy()
+    np.testing.assert_allclose(
+        np.asarray(logits), hf_logits, rtol=1e-3, atol=1e-3
+    )
+
+
+def test_bloom_greedy_decode_matches_hf_generate(setup):
+    import jax.numpy as jnp
+    import torch
+
+    model_dir, config, model, params, caches = setup
+    input_ids = hf_tokenize(model_dir, "the capital of France")
+    t = len(input_ids)
+    new_tokens = 16  # deep enough that ALiBi biases clearly shift ranks
+    block_size = 16
+    max_blocks = 8
+
+    hf = hf_reference_model(model_dir)
+    with torch.no_grad():
+        hf_out = hf.generate(
+            torch.tensor([input_ids]),
+            max_new_tokens=new_tokens,
+            do_sample=False,
+            eos_token_id=None,
+        )[0].tolist()
+    expected = hf_out[t:]
+
+    logits, caches = model.prefill(
+        params, caches,
+        jnp.asarray(input_ids, dtype=jnp.int32),
+        jnp.arange(t, dtype=jnp.int32),
+        jnp.arange(t, dtype=jnp.int32),
+        jnp.asarray(t, dtype=jnp.int32),
+    )
+    block_tables = jnp.arange(max_blocks, dtype=jnp.int32)[None, :]
+    next_token = int(jnp.argmax(logits[t - 1]))
+    produced = [next_token]
+    pos = t
+    for _ in range(new_tokens - 1):
+        step_logits, caches = model.decode(
+            params, caches,
+            jnp.asarray([next_token], dtype=jnp.int32),
+            jnp.asarray([pos], dtype=jnp.int32),
+            jnp.asarray([pos], dtype=jnp.int32),
+            block_tables,
+            jnp.asarray([pos + 1], dtype=jnp.int32),
+            block_size,
+        )
+        next_token = int(jnp.argmax(step_logits[0]))
+        produced.append(next_token)
+        pos += 1
+
+    assert produced == expected
+
+
+def test_bloom_engine_end_to_end(bloom_dir):
+    """Engine slice incl. CHUNKED prefill over the ALiBi path."""
+    from vllm_tgis_adapter_tpu.engine.config import (
+        CacheConfig,
+        EngineConfig,
+        LoRAConfig,
+        ModelConfig,
+        ParallelConfig,
+        SchedulerConfig,
+    )
+    from vllm_tgis_adapter_tpu.engine.core import LLMEngine
+    from vllm_tgis_adapter_tpu.engine.sampling_params import SamplingParams
+
+    mcfg = ModelConfig.from_pretrained(bloom_dir, dtype="float32")
+    config = EngineConfig(
+        model_config=mcfg,
+        cache_config=CacheConfig(block_size=16, num_blocks=64,
+                                 cache_dtype=mcfg.dtype),
+        scheduler_config=SchedulerConfig(
+            max_num_seqs=4, prefill_buckets=(16, 32, 64),
+            max_num_batched_tokens=16,  # chunked admission
+        ),
+        parallel_config=ParallelConfig(),
+        lora_config=LoRAConfig(),
+    )
+    engine = LLMEngine.from_config(config)
+    engine.add_request(
+        "bloom-long", None,
+        SamplingParams(temperature=0.0, max_tokens=8, ignore_eos=True),
+        prompt_token_ids=list(range(3, 43)),  # 40 tokens → 3 chunks
+    )
+    engine.add_request(
+        "bloom-short", "short prompt",
+        SamplingParams(temperature=0.0, max_tokens=8, ignore_eos=True),
+    )
+    done = {}
+    for _ in range(200):
+        if not engine.has_unfinished_requests():
+            break
+        for out in engine.step():
+            if out.finished:
+                done[out.request_id] = out
+    assert set(done) == {"bloom-long", "bloom-short"}
+    for out in done.values():
+        assert len(out.outputs[0].token_ids) == 8
+
+
+def test_bloom_chunked_prefill_matches_unchunked(bloom_dir):
+    """ALiBi + chunked prefill: chunk-admitted generation must equal the
+    whole-prompt path (the chunk formulation's k_pos bias indexing)."""
+    from vllm_tgis_adapter_tpu.engine.config import (
+        CacheConfig,
+        EngineConfig,
+        LoRAConfig,
+        ModelConfig,
+        ParallelConfig,
+        SchedulerConfig,
+    )
+    from vllm_tgis_adapter_tpu.engine.core import LLMEngine
+    from vllm_tgis_adapter_tpu.engine.sampling_params import SamplingParams
+
+    mcfg = ModelConfig.from_pretrained(bloom_dir, dtype="float32")
+
+    def run(chunk):
+        sched = dict(max_num_seqs=4, prefill_buckets=(16, 32, 64))
+        if chunk:
+            sched["max_num_batched_tokens"] = chunk
+        engine = LLMEngine.from_config(EngineConfig(
+            model_config=mcfg,
+            cache_config=CacheConfig(block_size=16, num_blocks=64,
+                                     cache_dtype=mcfg.dtype),
+            scheduler_config=SchedulerConfig(**sched),
+            parallel_config=ParallelConfig(),
+            lora_config=LoRAConfig(),
+        ))
+        engine.add_request(
+            "r", None,
+            SamplingParams(temperature=0.0, max_tokens=10,
+                           ignore_eos=True),
+            prompt_token_ids=list(range(5, 45)),
+        )
+        done = {}
+        for _ in range(200):
+            if not engine.has_unfinished_requests():
+                break
+            for out in engine.step():
+                if out.finished:
+                    done[out.request_id] = out
+        return done["r"].outputs[0].token_ids
+
+    assert run(None) == run(16)
+
+
+def test_bloom_rejects_post_norm_variant(tmp_path):
+    import json
+
+    from tests.fixture_models import TINY_BLOOM_CONFIG
+
+    from vllm_tgis_adapter_tpu.engine.config import ModelConfig
+
+    cfg = dict(TINY_BLOOM_CONFIG)
+    cfg["apply_residual_connection_post_layernorm"] = True
+    p = tmp_path / "post-norm-bloom"
+    p.mkdir()
+    (p / "config.json").write_text(json.dumps(cfg))
+    with pytest.raises(ValueError, match="post_layernorm"):
+        ModelConfig.from_pretrained(str(p))

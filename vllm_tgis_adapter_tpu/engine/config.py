@@ -69,7 +69,7 @@ class ModelConfig:
     # architecture family knobs beyond the llama lineage (OPT et al.);
     # all are static Python branches in models/llama.py, so each
     # combination still compiles to one straight-line XLA program
-    position_embedding: str = "rope"  # "rope" | "learned"
+    position_embedding: str = "rope"  # "rope" | "learned" | "alibi"
     norm_type: str = "rmsnorm"  # "rmsnorm" | "layernorm"
     hidden_act: str = "silu"  # "silu" | "relu" | "gelu" | "gelu_new"
     gated_mlp: bool = True  # SwiGLU gate/up/down vs plain fc1/act/fc2
@@ -81,6 +81,8 @@ class ModelConfig:
     # parallel attention+MLP residual (x + attn(ln1 x) + mlp(ln2 x))
     rotary_dim: int = 0
     parallel_residual: bool = False
+    # bloom-style LayerNorm directly after the embedding lookup
+    embed_norm: bool = False
     # mistral-style sliding-window attention: each token attends to at
     # most the previous ``sliding_window`` tokens (0 = full attention).
     # Enforced as a band mask in the attention ops; KV pages beyond the
@@ -109,6 +111,20 @@ class ModelConfig:
         granite scaling multipliers.
         """
         model_type = hf.get("model_type", "llama")
+        # non-llama-lineage families have their own HF field spellings —
+        # dispatch BEFORE reading any llama-keyed fields
+        if model_type == "opt":
+            return ModelConfig._from_opt_config(
+                model, hf, max_model_len=max_model_len, dtype=dtype
+            )
+        if model_type == "gpt_neox":
+            return ModelConfig._from_gpt_neox_config(
+                model, hf, max_model_len=max_model_len, dtype=dtype
+            )
+        if model_type == "bloom":
+            return ModelConfig._from_bloom_config(
+                model, hf, max_model_len=max_model_len, dtype=dtype
+            )
         hidden = hf["hidden_size"]
         heads = hf["num_attention_heads"]
         derived_len = hf.get("max_position_embeddings", 2048)
@@ -129,14 +145,6 @@ class ModelConfig:
             _logger.info(
                 "sliding-window attention enabled (window=%d tokens)",
                 sliding_window,
-            )
-        if model_type == "opt":
-            return ModelConfig._from_opt_config(
-                model, hf, max_model_len=max_model_len, dtype=dtype
-            )
-        if model_type == "gpt_neox":
-            return ModelConfig._from_gpt_neox_config(
-                model, hf, max_model_len=max_model_len, dtype=dtype
             )
         return ModelConfig(
             model=model,
@@ -312,6 +320,57 @@ class ModelConfig:
             gated_mlp=False,
             rotary_dim=rotary_dim if rotary_dim != head_dim else 0,
             parallel_residual=hf.get("use_parallel_residual", True),
+        )
+
+    @staticmethod
+    def _from_bloom_config(
+        model: str,
+        hf: dict,
+        *,
+        max_model_len: int | None = None,
+        dtype: str = "auto",
+    ) -> "ModelConfig":
+        """BLOOM family (the original TGIS flagship): ALiBi positional
+        biases (no position params at all), a LayerNorm directly on the
+        embeddings, pre-LN with biases, fused per-head query_key_value
+        checkpoints, plain fc1/GELU(tanh)/fc2, tied head, MHA."""
+        if hf.get("apply_residual_connection_post_layernorm", False):
+            raise ValueError(
+                "bloom: apply_residual_connection_post_layernorm=true "
+                "variants are not supported"
+            )
+        hidden = hf["hidden_size"]
+        heads = hf["n_head"]
+        eos = hf.get("eos_token_id", 2)
+        if isinstance(eos, list):
+            eos = eos[0]
+        return ModelConfig(
+            model=model,
+            model_type="bloom",
+            vocab_size=hf["vocab_size"],
+            hidden_size=hidden,
+            intermediate_size=4 * hidden,
+            num_layers=hf["n_layer"],
+            num_heads=heads,
+            num_kv_heads=heads,
+            head_dim=hidden // heads,
+            # ALiBi has no positional table to outgrow; 2048 is BLOOM's
+            # training length and a sane serving default
+            max_model_len=max_model_len or hf.get("seq_length", 2048),
+            rms_norm_eps=hf.get("layer_norm_epsilon", 1e-5),
+            tie_word_embeddings=hf.get("tie_word_embeddings", True),
+            dtype=resolve_dtype(dtype),
+            eos_token_id=eos,
+            bos_token_id=hf.get("bos_token_id", 1) or 1,
+            attention_bias=True,
+            attention_out_bias=True,
+            mlp_bias=True,
+            norm_type="layernorm",
+            # HF BloomGelu is the tanh approximation
+            hidden_act="gelu_new",
+            gated_mlp=False,
+            position_embedding="alibi",
+            embed_norm=True,
         )
 
     @staticmethod

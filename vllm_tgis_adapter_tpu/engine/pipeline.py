@@ -98,7 +98,10 @@ def make_pp_place_fn(config: "EngineConfig", devices=None):
         if m is not None:
             mesh = meshes[stage_of_layer(int(m.group(1)))]
         elif any(k in name for k in
-                 ("embed_tokens", "embed_in", "embed_positions")):
+                 ("embed_tokens", "embed_in", "embed_positions",
+                  "word_embeddings")):
+            # word_embeddings also catches bloom's
+            # word_embeddings_layernorm — both live on stage 0
             mesh = meshes[0]
         else:  # lm_head / embed_out / decoder-level final norm
             mesh = meshes[-1]
@@ -129,8 +132,9 @@ def split_pipeline_params(params: dict, ranges) -> list[dict]:
         p: dict = {"layers": params["layers"][lo:hi]}
         if s == 0:
             p["embed"] = params["embed"]
-            if "pos_embed" in params:
-                p["pos_embed"] = params["pos_embed"]
+            for name in ("pos_embed", "embed_norm", "embed_norm_bias"):
+                if name in params:
+                    p[name] = params[name]
         if s == last:
             # tied lm_head reads params["embed"]; the last stage needs its
             # own reference even when stage 0 also holds it

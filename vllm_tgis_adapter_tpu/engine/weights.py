@@ -227,6 +227,52 @@ def load_opt_params(
     return params
 
 
+def _make_take(raw, dtype, place, prefixes):
+    """Tensor lookup over alternative name prefixes; ``placed=False``
+    returns the raw host array (for tensors that are re-laid-out before
+    placement, e.g. fused QKV)."""
+
+    def take(name: str, transpose: bool = False, placed: bool = True):
+        for pre in prefixes:
+            cand = pre + name
+            if cand in raw:
+                x = _np_to_jnp(raw.pop(cand), dtype)
+                if transpose:
+                    x = x.T
+                return place(cand, x) if placed else x
+        raise ValueError(f"checkpoint is missing tensor {name!r}")
+
+    return take
+
+
+def _split_fused_qkv(take, place, prefix: str, h: int, dh: int, d: int,
+                     *, bias: bool) -> dict:
+    """De-interleave a head-major fused ``[H·3·Dh, d]`` query_key_value
+    tensor (the HF gpt_neox AND bloom layout: each head's q, k, v rows
+    adjacent) into per-projection ``[in, out]`` matrices, placed under
+    q/k/v_proj alias names so the standard Megatron column-parallel
+    specs apply (parallel/sharding.py suffix table)."""
+    out = {}
+    fused_w = take(
+        f"{prefix}.query_key_value.weight", placed=False
+    ).reshape(h, 3, dh, d)
+    for j, proj in enumerate(("q", "k", "v")):
+        out[f"w{proj}"] = place(
+            f"{prefix}.{proj}_proj.weight",
+            fused_w[:, j].reshape(h * dh, d).T,
+        )
+    if bias:
+        fused_b = take(
+            f"{prefix}.query_key_value.bias", placed=False
+        ).reshape(h, 3, dh)
+        for j, proj in enumerate(("q", "k", "v")):
+            out[f"b{proj}"] = place(
+                f"{prefix}.{proj}_proj.bias",
+                fused_b[:, j].reshape(h * dh),
+            )
+    return out
+
+
 def load_gpt_neox_params(
     config: "ModelConfig",
     model_path: str,
@@ -242,36 +288,9 @@ def load_gpt_neox_params(
     parallel/sharding.py's suffix table).
     """
     place = place or (lambda _name, x: x)
-    dtype = config.dtype
     raw = CheckpointIndex(model_path)
     h, dh, d = config.num_heads, config.head_dim, config.hidden_size
-
-    def take(name: str, transpose: bool = False) -> jax.Array:
-        if name not in raw:
-            raise ValueError(f"checkpoint is missing tensor {name!r}")
-        x = _np_to_jnp(raw.pop(name), dtype)
-        if transpose:
-            x = x.T
-        return place(name, x)
-
-    def split_qkv(prefix: str) -> dict:
-        fused_w = _np_to_jnp(
-            raw.pop(f"{prefix}.query_key_value.weight"), dtype
-        ).reshape(h, 3, dh, d)
-        out = {}
-        for j, proj in enumerate(("q", "k", "v")):
-            w = fused_w[:, j].reshape(h * dh, d).T  # → [in, out]
-            out[f"w{proj}"] = place(f"{prefix}.{proj}_proj.weight", w)
-        if config.attention_bias:
-            fused_b = _np_to_jnp(
-                raw.pop(f"{prefix}.query_key_value.bias"), dtype
-            ).reshape(h, 3, dh)
-            for j, proj in enumerate(("q", "k", "v")):
-                out[f"b{proj}"] = place(
-                    f"{prefix}.{proj}_proj.bias",
-                    fused_b[:, j].reshape(h * dh),
-                )
-        return out
+    take = _make_take(raw, config.dtype, place, ("",))
 
     params: dict = {
         "embed": take("gpt_neox.embed_in.weight"),
@@ -302,7 +321,10 @@ def load_gpt_neox_params(
                            transpose=True),
             "b_down": take(f"{prefix}.mlp.dense_4h_to_h.bias"),
         }
-        layer |= split_qkv(f"{prefix}.attention")
+        layer |= _split_fused_qkv(
+            take, place, f"{prefix}.attention", h, dh, d,
+            bias=config.attention_bias,
+        )
         params["layers"].append(layer)
 
     # attention.bias / masked_bias are HF's precomputed causal-mask
@@ -312,6 +334,70 @@ def load_gpt_neox_params(
         if "rotary_emb" not in n
         and not n.endswith(("attention.bias", "attention.masked_bias"))
     ]
+    if ignored:
+        logger.warning("ignored %d unexpected checkpoint tensors: %s",
+                       len(ignored), ignored[:5])
+    return params
+
+
+def load_bloom_params(
+    config: "ModelConfig",
+    model_path: str,
+    place: Optional[PlaceFn] = None,
+) -> dict:
+    """BLOOM checkpoint → the shared decoder param pytree.
+
+    Layers live under ``h.{i}`` with the same fused head-interleaved
+    ``query_key_value`` layout as GPT-NeoX (``[H·3·Dh, d]``, each head's
+    q/k/v rows adjacent — HF BloomAttention._split_heads), de-interleaved
+    before placement under q/k/v_proj alias names.  A LayerNorm sits
+    directly on the embeddings (``word_embeddings_layernorm``); the head
+    is tied.  Both bare and ``transformer.``-prefixed exports load.
+    """
+    place = place or (lambda _name, x: x)
+    raw = CheckpointIndex(model_path)
+    h, dh, d = config.num_heads, config.head_dim, config.hidden_size
+    take = _make_take(raw, config.dtype, place, ("", "transformer."))
+
+    params: dict = {
+        "embed": take("word_embeddings.weight"),
+        "embed_norm": take("word_embeddings_layernorm.weight"),
+        "embed_norm_bias": take("word_embeddings_layernorm.bias"),
+        "final_norm": take("ln_f.weight"),
+        "final_norm_bias": take("ln_f.bias"),
+        "layers": [],
+    }
+    for cand in ("lm_head.weight",):  # tied; drop duplicate exports
+        if cand in raw:
+            raw.pop(cand)
+
+    for i in range(config.num_layers):
+        prefix = f"h.{i}"
+        layer = {
+            "input_norm": take(f"{prefix}.input_layernorm.weight"),
+            "input_norm_bias": take(f"{prefix}.input_layernorm.bias"),
+            "post_attn_norm": take(
+                f"{prefix}.post_attention_layernorm.weight"
+            ),
+            "post_attn_norm_bias": take(
+                f"{prefix}.post_attention_layernorm.bias"
+            ),
+            "wo": take(f"{prefix}.self_attention.dense.weight",
+                       transpose=True),
+            "bo": take(f"{prefix}.self_attention.dense.bias"),
+            "w_up": take(f"{prefix}.mlp.dense_h_to_4h.weight",
+                         transpose=True),
+            "b_up": take(f"{prefix}.mlp.dense_h_to_4h.bias"),
+            "w_down": take(f"{prefix}.mlp.dense_4h_to_h.weight",
+                           transpose=True),
+            "b_down": take(f"{prefix}.mlp.dense_4h_to_h.bias"),
+        }
+        layer |= _split_fused_qkv(
+            take, place, f"{prefix}.self_attention", h, dh, d, bias=True,
+        )
+        params["layers"].append(layer)
+
+    ignored = raw.remaining()
     if ignored:
         logger.warning("ignored %d unexpected checkpoint tensors: %s",
                        len(ignored), ignored[:5])
@@ -328,4 +414,6 @@ def load_model_params(
         return load_opt_params(config, model_path, place)
     if config.model_type == "gpt_neox":
         return load_gpt_neox_params(config, model_path, place)
+    if config.model_type == "bloom":
+        return load_bloom_params(config, model_path, place)
     return load_llama_params(config, model_path, place)

@@ -26,6 +26,10 @@ _REGISTRY = {
     # GPT-NeoX / Pythia: partial rotary, parallel attn+MLP residual,
     # fused-QKV checkpoints (config.py _from_gpt_neox_config)
     "gpt_neox": LlamaForCausalLM,
+    # BLOOM (the original TGIS flagship): ALiBi position biases,
+    # embedding LayerNorm, fused-QKV, tied head
+    # (config.py _from_bloom_config)
+    "bloom": LlamaForCausalLM,
 }
 
 

@@ -54,6 +54,23 @@ def layer_norm(
     return out.astype(dtype)
 
 
+def alibi_slopes(n_heads: int) -> list[float]:
+    """Standard ALiBi head slopes (HF build_alibi_tensor convention):
+    powers of 2^(-8/n) for power-of-two head counts, with the
+    closest-power-of-two + interleave rule otherwise."""
+    import math
+
+    def pow2_slopes(n: int) -> list[float]:
+        start = 2.0 ** (-(2.0 ** -(math.log2(n) - 3)))
+        return [start ** (i + 1) for i in range(n)]
+
+    if math.log2(n_heads).is_integer():
+        return pow2_slopes(n_heads)
+    closest = 2 ** math.floor(math.log2(n_heads))
+    extra = pow2_slopes(2 * closest)[0::2][: n_heads - closest]
+    return pow2_slopes(closest) + extra
+
+
 _ACTIVATIONS = {
     "silu": jax.nn.silu,
     "relu": jax.nn.relu,
@@ -118,6 +135,13 @@ class LlamaForCausalLM:
         # this offset maps local layer index -> global (qwen2's
         # max_window_layers gate needs the global index)
         self.layer_offset = 0
+        # bloom lineage: per-head position-bias slopes, a pure function
+        # of the head count (never a checkpoint tensor)
+        self.alibi = (
+            jnp.asarray(alibi_slopes(config.num_heads), jnp.float32)
+            if config.position_embedding == "alibi"
+            else None
+        )
 
     # ---------------------------------------------------------------- params
 
@@ -145,6 +169,9 @@ class LlamaForCausalLM:
             params["pos_embed"] = dense(
                 next(keys), (cfg.num_position_embeddings, d)
             )
+        if cfg.embed_norm:
+            params["embed_norm"] = jnp.ones((d,), dtype=cfg.dtype)
+            params["embed_norm_bias"] = jnp.zeros((d,), dtype=cfg.dtype)
         if not cfg.tie_word_embeddings:
             params["lm_head"] = dense(next(keys), (d, cfg.vocab_size))
         for _ in range(cfg.num_layers):
@@ -384,6 +411,11 @@ class LlamaForCausalLM:
                 params["pos_embed"].shape[0] - 1,
             )
             x = x + jnp.take(params["pos_embed"], idx, axis=0)
+        if cfg.embed_norm:
+            x = layer_norm(
+                x, params["embed_norm"], params["embed_norm_bias"],
+                cfg.rms_norm_eps,
+            )
         return x
 
     def _logits(self, params: dict, x: jax.Array) -> jax.Array:
@@ -443,6 +475,7 @@ class LlamaForCausalLM:
             return attn_ops.prefill_attention(
                 q, k, v, scale, valid_len, mesh=self.mesh,
                 window=self._window_for_layer(i),
+                alibi_slopes=self.alibi,
             )
 
         x = (
@@ -518,6 +551,7 @@ class LlamaForCausalLM:
                 q, k_cache[i], v_cache[i], block_table, start, valid_len,
                 block_size, scale, mesh=self.mesh,
                 window=self._window_for_layer(i),
+                alibi_slopes=self.alibi,
             )
 
         x = (
@@ -587,6 +621,7 @@ class LlamaForCausalLM:
                 q, k_cache[i], v_cache[i], tables, ctx_lens,
                 block_size, scale, mesh=self.mesh,
                 window=self._window_for_layer(i),
+                alibi_slopes=self.alibi,
             )
 
         x = self._embed(params, flat_tokens, flat_pos)
@@ -635,6 +670,7 @@ class LlamaForCausalLM:
                 q, k_cache[i], v_cache[i], block_tables, context_lens,
                 block_size, scale, mesh=self.mesh,
                 window=self._window_for_layer(i),
+                alibi_slopes=self.alibi,
             )
 
         x = (

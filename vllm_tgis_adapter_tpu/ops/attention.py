@@ -87,6 +87,7 @@ def prefill_attention(
     valid_len: jax.Array | None = None,
     mesh=None,
     window: int = 0,
+    alibi_slopes: jax.Array | None = None,  # [H] f32 (bloom lineage)
 ) -> jax.Array:
     """Dispatch: flash Pallas kernel on TPU, XLA fallback elsewhere.
 
@@ -97,12 +98,21 @@ def prefill_attention(
     K/V chunks rotate around the ring (ops/ring_attention.py) — the
     long-context path.
     """
-    if window > 0 and mesh is not None and dict(mesh.shape).get("sp", 1) > 1:
+    if mesh is not None and dict(mesh.shape).get("sp", 1) > 1 and (
+        window > 0 or alibi_slopes is not None
+    ):
         raise NotImplementedError(
-            "sliding-window attention does not compose with "
-            "--sequence-parallel-size > 1 yet (ring attention has no band "
-            "mask); windowed models bound their own context instead"
+            "sliding-window / ALiBi attention does not compose with "
+            "--sequence-parallel-size > 1 yet (ring attention carries "
+            "neither the band mask nor position biases)"
         )
+    if alibi_slopes is not None:
+        # ALiBi rides the XLA formulations on every backend for now (the
+        # Pallas kernels don't carry the position-bias term yet); plain
+        # XLA ops partition over any mesh via GSPMD
+        return prefill_attention_xla(q, k, v, scale, valid_len,
+                                     window=window,
+                                     alibi_slopes=alibi_slopes)
     if mesh is not None and dict(mesh.shape).get("sp", 1) > 1:
         from vllm_tgis_adapter_tpu.ops.ring_attention import (
             ring_prefill_attention,
@@ -150,6 +160,7 @@ def prefill_attention_xla(
     scale: float,
     valid_len: jax.Array | None = None,  # scalar int: tokens < valid_len attend
     window: int = 0,  # >0: attend to at most the previous `window` tokens
+    alibi_slopes: jax.Array | None = None,  # [H] f32 per-head bias slopes
 ) -> jax.Array:
     """Causal self-attention over a single (padded) prompt.
 
@@ -168,6 +179,14 @@ def prefill_attention_xla(
 
     # [num_kv, q_per_kv, Tq, Tk]
     scores = jnp.einsum("tkgd,skd->kgts", qh, kh) * scale
+    if alibi_slopes is not None:
+        # HF bloom convention: score(q_i, k_j) += slope_h * j (the
+        # row-constant -slope_h*i term cancels in the softmax)
+        slopes = alibi_slopes.reshape(num_kv, q_per_kv).astype(jnp.float32)
+        scores = scores + (
+            slopes[:, :, None, None]
+            * jnp.arange(t, dtype=jnp.float32)[None, None, None, :]
+        )
     causal = jnp.tril(jnp.ones((t, t), dtype=bool))
     mask = causal
     if window > 0:
@@ -193,12 +212,18 @@ def paged_decode_attention(
     scale: float,
     mesh=None,
     window: int = 0,
+    alibi_slopes: jax.Array | None = None,  # [H] f32 (bloom lineage)
 ) -> jax.Array:
     """Dispatch: flash Pallas kernel on TPU, XLA fallback elsewhere.
 
     Under a TP mesh the kernel runs inside shard_map: the cache is
     head-sharded on tp, so each shard's kernel reads only its local pages.
     """
+    if alibi_slopes is not None:
+        return paged_decode_attention_xla(
+            q, k_cache, v_cache, block_tables, context_lens, block_size,
+            scale, window=window, alibi_slopes=alibi_slopes,
+        )
     if _use_pallas():
         from vllm_tgis_adapter_tpu.ops import pallas_attention
 
@@ -239,6 +264,7 @@ def chunked_prefill_attention(
     scale: float,
     mesh=None,
     window: int = 0,
+    alibi_slopes: jax.Array | None = None,  # [H] f32 (bloom lineage)
 ) -> jax.Array:
     """Causal chunk-vs-paged-context attention (the chunked-prefill and
     prefix-cache-resume hot path).
@@ -248,7 +274,7 @@ def chunked_prefill_attention(
     the decode formulation (each query as a batch row with its own
     context length), which is what the kernel's numerics are pinned to.
     """
-    if _use_pallas():
+    if _use_pallas() and alibi_slopes is None:
         from vllm_tgis_adapter_tpu.ops import pallas_attention
 
         kernel = functools.partial(
@@ -282,7 +308,7 @@ def chunked_prefill_attention(
     tables = jnp.broadcast_to(block_table[None, :], (t, block_table.shape[0]))
     return paged_decode_attention_xla(
         q, k_cache, v_cache, tables, ctx_lens, block_size, scale,
-        window=window,
+        window=window, alibi_slopes=alibi_slopes,
     )
 
 
@@ -295,6 +321,7 @@ def paged_decode_attention_xla(
     block_size: int,
     scale: float,
     window: int = 0,  # >0: attend to at most the last `window` tokens
+    alibi_slopes: jax.Array | None = None,  # [H] f32 per-head bias slopes
 ) -> jax.Array:
     """One-token-per-sequence attention against the paged cache.
 
@@ -321,6 +348,14 @@ def paged_decode_attention_xla(
 
     qh = q.reshape(b, num_kv, q_per_kv, head_dim).astype(jnp.float32)
     scores = jnp.einsum("bkgd,kbsd->bkgs", qh, keys) * scale
+    if alibi_slopes is not None:
+        # position index s IS the sequence position (block j of the table
+        # covers positions [j*bs, (j+1)*bs)); same bias as prefill
+        slopes = alibi_slopes.reshape(num_kv, q_per_kv).astype(jnp.float32)
+        scores = scores + (
+            slopes[None, :, :, None]
+            * jnp.arange(s, dtype=jnp.float32)[None, None, None, :]
+        )
     length_mask = jnp.arange(s)[None, :] < context_lens[:, None]  # [B, S]
     if window > 0:
         # sliding window: only the last `window` in-context positions

@@ -101,6 +101,8 @@ def llama_param_specs(params: dict, tp: int = 1) -> dict:
         "embed": P(TP_AXIS, None),
         "final_norm": P(None),
         "final_norm_bias": P(None),
+        "embed_norm": P(None),
+        "embed_norm_bias": P(None),
         # tiny table (max_len rows); replicate rather than shard
         "pos_embed": P(None, None),
         "lm_head": P(None, TP_AXIS),
@@ -181,6 +183,11 @@ _HF_NAME_SPECS = (
     ("dense_4h_to_h.bias", P()),
     ("embed_in.weight", P(TP_AXIS, None)),
     ("embed_out.weight", P(None, TP_AXIS)),
+    # bloom: vocab-parallel embeddings, replicated final norm (the
+    # generic norm.weight/bias suffixes catch the layernorms)
+    ("word_embeddings.weight", P(TP_AXIS, None)),
+    ("ln_f.weight", P(None)),
+    ("ln_f.bias", P(None)),
     ("norm.weight", P(None)),
     ("norm.bias", P(None)),
     ("layernorm.weight", P(None)),
