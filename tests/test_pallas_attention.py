@@ -385,3 +385,92 @@ def test_windowed_chunked_prefill_matches_reference(t, valid, start, window):
         np.asarray(got)[:valid], np.asarray(ref)[:valid],
         rtol=2e-5, atol=2e-5,
     )
+
+
+# ---------------------------------------------------------------- alibi
+
+def _slopes(h):
+    from vllm_tgis_adapter_tpu.models.llama import alibi_slopes
+    return jnp.asarray(alibi_slopes(h), jnp.float32)
+
+
+@pytest.mark.parametrize("g", [1, 4])
+def test_alibi_paged_decode_matches_reference(g):
+    b, num_kv, head_dim, block_size, max_blocks = 5, 2, 64, 16, 4
+    q, k_cache, v_cache, bt, cl = make_paged_case(
+        13, b, num_kv, g, head_dim, block_size, max_blocks, num_slots=512
+    )
+    scale = head_dim**-0.5
+    slopes = _slopes(num_kv * g)
+    ref = ref_ops.paged_decode_attention_xla(
+        jnp.asarray(q), jnp.asarray(k_cache), jnp.asarray(v_cache),
+        jnp.asarray(bt), jnp.asarray(cl), block_size, scale,
+        alibi_slopes=slopes,
+    )
+    got = pk.paged_decode_attention(
+        jnp.asarray(q), jnp.asarray(k_cache), jnp.asarray(v_cache),
+        jnp.asarray(bt), jnp.asarray(cl), block_size, scale,
+        alibi_slopes=slopes, interpret=True,
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("t,valid", [(128, 128), (256, 200)])
+def test_alibi_flash_prefill_matches_reference(t, valid):
+    rng = np.random.default_rng(17)
+    num_kv, g, head_dim = 2, 2, 32
+    h = num_kv * g
+    q = rng.standard_normal((t, h, head_dim)).astype(np.float32)
+    k = rng.standard_normal((t, num_kv, head_dim)).astype(np.float32)
+    v = rng.standard_normal((t, num_kv, head_dim)).astype(np.float32)
+    scale = head_dim**-0.5
+    slopes = _slopes(h)
+    ref = ref_ops.prefill_attention_xla(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), scale,
+        jnp.asarray(valid), alibi_slopes=slopes,
+    )
+    got = pk.prefill_attention(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), scale,
+        jnp.asarray(valid, dtype=jnp.int32), alibi_slopes=slopes,
+        interpret=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(got)[:valid], np.asarray(ref)[:valid],
+        rtol=2e-5, atol=2e-5,
+    )
+
+
+def test_alibi_chunked_prefill_matches_reference():
+    rng = np.random.default_rng(19)
+    num_kv, g, head_dim, block_size = 2, 2, 32, 16
+    h = num_kv * g
+    t, start = 64, 64
+    max_blocks = -(-(start + t) // block_size) + 2
+    num_slots = 1024
+    q = rng.standard_normal((t, h, head_dim)).astype(np.float32)
+    k_cache = rng.standard_normal(
+        (num_kv, num_slots, head_dim)).astype(np.float32)
+    v_cache = rng.standard_normal(
+        (num_kv, num_slots, head_dim)).astype(np.float32)
+    table = rng.permutation(num_slots // block_size)[:max_blocks].astype(
+        np.int32
+    )
+    slopes = _slopes(h)
+
+    local = np.arange(t)
+    ctx = (start + local + 1).astype(np.int32)
+    tables = np.broadcast_to(table, (t, max_blocks))
+    ref = ref_ops.paged_decode_attention_xla(
+        jnp.asarray(q), jnp.asarray(k_cache), jnp.asarray(v_cache),
+        jnp.asarray(tables), jnp.asarray(ctx),
+        block_size, head_dim**-0.5, alibi_slopes=slopes,
+    )
+    got = pk.chunked_prefill_attention(
+        jnp.asarray(q), jnp.asarray(k_cache), jnp.asarray(v_cache),
+        jnp.asarray(table), jnp.asarray(start, jnp.int32),
+        jnp.asarray(t, jnp.int32), block_size, head_dim**-0.5,
+        alibi_slopes=slopes, interpret=True,
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)

@@ -203,3 +203,82 @@ def test_windowed_kernels_compile_and_match():
         np.asarray(got, np.float32), np.asarray(ref, np.float32),
         rtol=2e-2, atol=2e-2,
     )
+
+
+def test_alibi_kernels_compile_and_match():
+    """ALiBi (per-head position bias) variants of all three kernels
+    lower through Mosaic and match the XLA references on the chip
+    (BLOOM-lineage serving path).  Slopes enter via scalar prefetch."""
+    from vllm_tgis_adapter_tpu.models.llama import alibi_slopes
+
+    scale = 128**-0.5
+    num_kv, g, head_dim = 8, 4, 128
+    slopes = jnp.asarray(alibi_slopes(num_kv * g), jnp.float32)
+
+    q, kc, vc, bt, cl = _paged_case(7, 8, num_kv, g, head_dim, 16, 8,
+                                    jnp.bfloat16)
+    got = pk.paged_decode_attention(
+        q, kc, vc, bt, cl, 16, scale, alibi_slopes=slopes
+    )
+    ref = ref_ops.paged_decode_attention_xla(
+        q, kc, vc, bt, cl, 16, scale, alibi_slopes=slopes
+    )
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(ref, np.float32),
+        rtol=2e-2, atol=2e-2,
+    )
+
+    rng = np.random.default_rng(21)
+    t = 1024
+    qp = jnp.asarray(
+        rng.standard_normal((t, num_kv * g, head_dim)), jnp.bfloat16
+    )
+    kp = jnp.asarray(
+        rng.standard_normal((t, num_kv, head_dim)), jnp.bfloat16
+    )
+    vp = jnp.asarray(
+        rng.standard_normal((t, num_kv, head_dim)), jnp.bfloat16
+    )
+    got = pk.prefill_attention(
+        qp, kp, vp, scale, jnp.asarray(t, jnp.int32), alibi_slopes=slopes
+    )
+    ref = ref_ops.prefill_attention_xla(
+        qp, kp, vp, scale, jnp.asarray(t, jnp.int32), alibi_slopes=slopes
+    )
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(ref, np.float32),
+        rtol=2e-2, atol=2e-2,
+    )
+
+    # chunked prefill — the jnp.repeat-over-scalar-reads slope layout is
+    # the ALiBi shape most likely to trip Mosaic; gate it explicitly
+    block_size, start, tchunk = 16, 512, 256
+    num_slots = 2048
+    table = jnp.asarray(
+        rng.permutation(num_slots // block_size)[:64], jnp.int32
+    )
+    kcache = jnp.asarray(
+        rng.standard_normal((num_kv, num_slots, head_dim)), jnp.bfloat16
+    )
+    vcache = jnp.asarray(
+        rng.standard_normal((num_kv, num_slots, head_dim)), jnp.bfloat16
+    )
+    qc = jnp.asarray(
+        rng.standard_normal((tchunk, num_kv * g, head_dim)), jnp.bfloat16
+    )
+    got = pk.chunked_prefill_attention(
+        qc, kcache, vcache, table, jnp.asarray(start, jnp.int32),
+        jnp.asarray(tchunk, jnp.int32), block_size, scale,
+        alibi_slopes=slopes,
+    )
+    local = np.arange(tchunk)
+    ctx = (start + local + 1).astype(np.int32)
+    tables = jnp.asarray(np.broadcast_to(np.asarray(table), (tchunk, 64)))
+    ref = ref_ops.paged_decode_attention_xla(
+        qc, kcache, vcache, tables, jnp.asarray(ctx), block_size, scale,
+        alibi_slopes=slopes,
+    )
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(ref, np.float32),
+        rtol=2e-2, atol=2e-2,
+    )

@@ -94,7 +94,8 @@ def make_pp_place_fn(config: "EngineConfig", devices=None):
         raise ValueError(f"layer index {j} out of range {ranges}")
 
     def place(name: str, x: jax.Array) -> jax.Array:
-        m = re.search(r"layers\.(\d+)\.", name)
+        # llama/opt/neox spell layers "…layers.N."; bloom uses "h.N."
+        m = re.search(r"(?:^|\.)(?:layers|h)\.(\d+)\.", name)
         if m is not None:
             mesh = meshes[stage_of_layer(int(m.group(1)))]
         elif any(k in name for k in

@@ -106,13 +106,6 @@ def prefill_attention(
             "--sequence-parallel-size > 1 yet (ring attention carries "
             "neither the band mask nor position biases)"
         )
-    if alibi_slopes is not None:
-        # ALiBi rides the XLA formulations on every backend for now (the
-        # Pallas kernels don't carry the position-bias term yet); plain
-        # XLA ops partition over any mesh via GSPMD
-        return prefill_attention_xla(q, k, v, scale, valid_len,
-                                     window=window,
-                                     alibi_slopes=alibi_slopes)
     if mesh is not None and dict(mesh.shape).get("sp", 1) > 1:
         from vllm_tgis_adapter_tpu.ops.ring_attention import (
             ring_prefill_attention,
@@ -142,15 +135,25 @@ def prefill_attention(
             from jax.sharding import PartitionSpec as P
 
             heads = P(None, "tp", None)
+            # slopes (when present) shard with the query heads: each tp
+            # shard's kernel sees exactly its local heads' slopes
+            operands = [q, k, v, vl]
+            specs = [heads, heads, heads, P()]
+            if alibi_slopes is not None:
+                operands.append(alibi_slopes)
+                specs.append(P("tp"))
+
+            def wrapped(q, k, v, vl, *rest):
+                return kernel(q, k, v, valid_len=vl,
+                              alibi_slopes=rest[0] if rest else None)
+
             return shard_map(
-                lambda q, k, v, vl: kernel(q, k, v, valid_len=vl),
-                mesh=mesh,
-                in_specs=(heads, heads, heads, P()),
-                out_specs=heads,
-                check_vma=False,
-            )(q, k, v, vl)
-        return kernel(q, k, v, valid_len=vl)
-    return prefill_attention_xla(q, k, v, scale, valid_len, window=window)
+                wrapped, mesh=mesh, in_specs=tuple(specs),
+                out_specs=heads, check_vma=False,
+            )(*operands)
+        return kernel(q, k, v, valid_len=vl, alibi_slopes=alibi_slopes)
+    return prefill_attention_xla(q, k, v, scale, valid_len, window=window,
+                                 alibi_slopes=alibi_slopes)
 
 
 def prefill_attention_xla(
@@ -219,11 +222,6 @@ def paged_decode_attention(
     Under a TP mesh the kernel runs inside shard_map: the cache is
     head-sharded on tp, so each shard's kernel reads only its local pages.
     """
-    if alibi_slopes is not None:
-        return paged_decode_attention_xla(
-            q, k_cache, v_cache, block_tables, context_lens, block_size,
-            scale, window=window, alibi_slopes=alibi_slopes,
-        )
     if _use_pallas():
         from vllm_tgis_adapter_tpu.ops import pallas_attention
 
@@ -239,17 +237,25 @@ def paged_decode_attention(
 
             heads = P(None, "tp", None)
             cache = P("tp", None, None)
+            operands = [q, k_cache, v_cache, block_tables, context_lens]
+            specs = [heads, cache, cache, P(), P()]
+            if alibi_slopes is not None:
+                operands.append(alibi_slopes)
+                specs.append(P("tp"))
+
+            def wrapped(q, kc, vc, bt, cl, *rest):
+                return kernel(q, kc, vc, bt, cl,
+                              alibi_slopes=rest[0] if rest else None)
+
             return shard_map(
-                kernel,
-                mesh=mesh,
-                in_specs=(heads, cache, cache, P(), P()),
-                out_specs=heads,
-                check_vma=False,
-            )(q, k_cache, v_cache, block_tables, context_lens)
-        return kernel(q, k_cache, v_cache, block_tables, context_lens)
+                wrapped, mesh=mesh, in_specs=tuple(specs),
+                out_specs=heads, check_vma=False,
+            )(*operands)
+        return kernel(q, k_cache, v_cache, block_tables, context_lens,
+                      alibi_slopes=alibi_slopes)
     return paged_decode_attention_xla(
         q, k_cache, v_cache, block_tables, context_lens, block_size, scale,
-        window=window,
+        window=window, alibi_slopes=alibi_slopes,
     )
 
 
@@ -274,7 +280,7 @@ def chunked_prefill_attention(
     the decode formulation (each query as a batch row with its own
     context length), which is what the kernel's numerics are pinned to.
     """
-    if _use_pallas() and alibi_slopes is None:
+    if _use_pallas():
         from vllm_tgis_adapter_tpu.ops import pallas_attention
 
         kernel = functools.partial(
@@ -289,16 +295,24 @@ def chunked_prefill_attention(
 
             heads = P(None, "tp", None)
             cache = P("tp", None, None)
+            operands = [q, k_cache, v_cache, block_table,
+                        jnp.asarray(start_pos, jnp.int32),
+                        jnp.asarray(valid_len, jnp.int32)]
+            specs = [heads, cache, cache, P(), P(), P()]
+            if alibi_slopes is not None:
+                operands.append(alibi_slopes)
+                specs.append(P("tp"))
+
+            def wrapped(q, kc, vc, bt, sp, vl, *rest):
+                return kernel(q, kc, vc, bt, sp, vl,
+                              alibi_slopes=rest[0] if rest else None)
+
             return shard_map(
-                kernel,
-                mesh=mesh,
-                in_specs=(heads, cache, cache, P(), P(), P()),
-                out_specs=heads,
-                check_vma=False,
-            )(q, k_cache, v_cache, block_table,
-              jnp.asarray(start_pos, jnp.int32),
-              jnp.asarray(valid_len, jnp.int32))
-        return kernel(q, k_cache, v_cache, block_table, start_pos, valid_len)
+                wrapped, mesh=mesh, in_specs=tuple(specs),
+                out_specs=heads, check_vma=False,
+            )(*operands)
+        return kernel(q, k_cache, v_cache, block_table, start_pos,
+                      valid_len, alibi_slopes=alibi_slopes)
     # XLA fallback: every chunk query becomes a decode row with context
     # length position+1 (exact same semantics, gather-based)
     t = q.shape[0]

@@ -49,6 +49,7 @@ def _decode_kernel(
     # scalar prefetch
     block_tables_ref,  # [B, max_blocks] SMEM
     context_lens_ref,  # [B] SMEM
+    alibi_ref,  # [H] f32 SMEM slopes; all-zero == disabled
     # blocks
     q_ref,  # [1, 1, G, Dh] VMEM (G = q_per_kv)
     k_ref,  # [1, block_size, Dh] VMEM — page picked by index_map
@@ -62,8 +63,11 @@ def _decode_kernel(
     scale: float,
     block_size: int,
     window: int,
+    use_alibi: bool,
+    g_count: int,
 ):
     b = pl.program_id(0)
+    h = pl.program_id(1)
     j = pl.program_id(2)
     last = pl.num_programs(2) - 1
     ctx = context_lens_ref[b]
@@ -89,6 +93,13 @@ def _decode_kernel(
         pos = j * block_size + jax.lax.broadcasted_iota(
             jnp.int32, s.shape, dimension=1
         )
+        if use_alibi:
+            # per-row slope: query head = h·G + g (row-constant query
+            # term cancels in softmax, so the bias is slope · k_pos)
+            slopes = jnp.stack(
+                [alibi_ref[h * g_count + gi] for gi in range(g_count)]
+            )[:, None]  # [G, 1]
+            s = s + slopes * pos.astype(jnp.float32)
         live = pos < ctx
         if window > 0:
             live &= pos >= win_lo
@@ -129,6 +140,7 @@ def paged_decode_attention(
     scale: float,
     *,
     window: int = 0,  # >0: attend to at most the last `window` tokens
+    alibi_slopes: jax.Array | None = None,  # [H] f32 (bloom lineage)
     interpret: bool = False,
 ) -> jax.Array:
     """Flash-style paged decode attention, one query token per sequence."""
@@ -155,29 +167,38 @@ def paged_decode_attention(
             j_eff = jnp.maximum(j_eff, first_live)
         return bt[i, j_eff]
 
+    slopes = (
+        jnp.zeros(num_heads, jnp.float32)
+        if alibi_slopes is None
+        else alibi_slopes.astype(jnp.float32)
+    )
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=3,
         grid=(b, num_kv, max_blocks),
         in_specs=[
             pl.BlockSpec(
                 (1, 1, g, head_dim),
-                lambda i, h, j, bt, cl: (i, h, 0, 0),
+                lambda i, h, j, bt, cl, al: (i, h, 0, 0),
             ),
             # page p of head h is block (h, p) of a (1, block_size, Dh)
             # grid over the [Hkv, num_slots, Dh] cache — trailing dims
             # (block_size, Dh) are a legal (sublane, lane) tile
             pl.BlockSpec(
                 (1, block_size, head_dim),
-                lambda i, h, j, bt, cl: (h, page_index(i, j, bt, cl), 0),
+                lambda i, h, j, bt, cl, al: (
+                    h, page_index(i, j, bt, cl), 0
+                ),
             ),
             pl.BlockSpec(
                 (1, block_size, head_dim),
-                lambda i, h, j, bt, cl: (h, page_index(i, j, bt, cl), 0),
+                lambda i, h, j, bt, cl, al: (
+                    h, page_index(i, j, bt, cl), 0
+                ),
             ),
         ],
         out_specs=pl.BlockSpec(
             (1, 1, g, head_dim),
-            lambda i, h, j, bt, cl: (i, h, 0, 0),
+            lambda i, h, j, bt, cl, al: (i, h, 0, 0),
         ),
         scratch_shapes=[
             pltpu.VMEM((g, 1), jnp.float32),
@@ -188,12 +209,13 @@ def paged_decode_attention(
     out = pl.pallas_call(
         functools.partial(
             _decode_kernel, scale=scale, block_size=block_size,
-            window=window,
+            window=window, use_alibi=alibi_slopes is not None,
+            g_count=g,
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, num_kv, g, head_dim), q.dtype),
         interpret=interpret,
-    )(safe_tables, context_lens, qg, k_cache, v_cache)
+    )(safe_tables, context_lens, slopes, qg, k_cache, v_cache)
     return out.reshape(b, num_heads, head_dim)
 
 
@@ -204,6 +226,7 @@ def _chunk_kernel(
     # scalar prefetch
     block_table_ref,  # [max_blocks] SMEM — this sequence's page table
     meta_ref,  # [2] SMEM: (start_pos, valid_len)
+    alibi_ref,  # [H] f32 SMEM slopes; unused unless use_alibi
     # blocks
     q_ref,  # [1, G*bq, Dh] VMEM (query block iq of kv head h)
     k_ref,  # [1, block_size, Dh] VMEM — page picked by index_map
@@ -219,7 +242,9 @@ def _chunk_kernel(
     block_q: int,
     g: int,
     window: int,
+    use_alibi: bool,
 ):
+    h = pl.program_id(0)
     iq = pl.program_id(1)
     j = pl.program_id(2)
     last = pl.num_programs(2) - 1
@@ -257,6 +282,14 @@ def _chunk_kernel(
         k_pos = j * block_size + jax.lax.broadcasted_iota(
             jnp.int32, s.shape, dimension=1
         )
+        if use_alibi:
+            # rows are (g, i) flattened row-major: g = row // block_q;
+            # query head = h·G + g
+            slopes = jnp.repeat(
+                jnp.stack([alibi_ref[h * g + gi] for gi in range(g)]),
+                block_q,
+            )[:, None]  # [G·bq, 1]
+            s = s + slopes * k_pos.astype(jnp.float32)
         mask = (k_pos <= q_pos) & (k_pos < start + valid)
         if window > 0:
             mask &= q_pos - k_pos < window
@@ -300,6 +333,7 @@ def chunked_prefill_attention(
     *,
     block_q: int = 128,
     window: int = 0,
+    alibi_slopes: jax.Array | None = None,  # [H] f32 (bloom lineage)
     interpret: bool = False,
 ) -> jax.Array:
     """Causal attention of one prompt chunk against its paged context.
@@ -346,30 +380,35 @@ def chunked_prefill_attention(
             j_eff = jnp.maximum(j_eff, first_needed)
         return bt[jnp.clip(j_eff, 0, None)]
 
+    slopes = (
+        jnp.zeros(num_heads, jnp.float32)
+        if alibi_slopes is None
+        else alibi_slopes.astype(jnp.float32)
+    )
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=3,
         grid=(num_kv, nq, max_blocks),
         in_specs=[
             pl.BlockSpec(
                 (1, g * block_q, head_dim),
-                lambda h, iq, j, bt, meta: (h, iq, 0),
+                lambda h, iq, j, bt, meta, al: (h, iq, 0),
             ),
             pl.BlockSpec(
                 (1, block_size, head_dim),
-                lambda h, iq, j, bt, meta: (
+                lambda h, iq, j, bt, meta, al: (
                     h, page_index(h, iq, j, bt, meta), 0
                 ),
             ),
             pl.BlockSpec(
                 (1, block_size, head_dim),
-                lambda h, iq, j, bt, meta: (
+                lambda h, iq, j, bt, meta, al: (
                     h, page_index(h, iq, j, bt, meta), 0
                 ),
             ),
         ],
         out_specs=pl.BlockSpec(
             (1, g * block_q, head_dim),
-            lambda h, iq, j, bt, meta: (h, iq, 0),
+            lambda h, iq, j, bt, meta, al: (h, iq, 0),
         ),
         scratch_shapes=[
             pltpu.VMEM((g * block_q, 1), jnp.float32),
@@ -384,13 +423,14 @@ def chunked_prefill_attention(
         functools.partial(
             _chunk_kernel, scale=scale, block_size=block_size,
             block_q=block_q, g=g, window=window,
+            use_alibi=alibi_slopes is not None,
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct(
             (num_kv, nq * g * block_q, head_dim), q.dtype
         ),
         interpret=interpret,
-    )(safe_table, meta, qh, k_cache, v_cache)
+    )(safe_table, meta, slopes, qh, k_cache, v_cache)
     return jnp.transpose(
         out.reshape(num_kv, nq, g, block_q, head_dim), (1, 3, 0, 2, 4)
     ).reshape(t_pad, num_heads, head_dim)[:t]
@@ -401,6 +441,7 @@ def chunked_prefill_attention(
 
 def _prefill_kernel(
     valid_len_ref,  # [1] SMEM scalar prefetch
+    alibi_ref,  # [H] f32 SMEM slopes; unused unless use_alibi
     q_ref,  # [1, bq, Dh]
     k_ref,  # [1, bk, Dh] (kv head h, key block j)
     v_ref,  # [1, bk, Dh]
@@ -413,7 +454,9 @@ def _prefill_kernel(
     block_q: int,
     block_k: int,
     window: int,
+    use_alibi: bool,
 ):
+    h = pl.program_id(0)  # query head
     i = pl.program_id(1)  # query block
     j = pl.program_id(2)  # key block
     last = pl.num_programs(2) - 1
@@ -448,6 +491,8 @@ def _prefill_kernel(
         cols = j * block_k + jax.lax.broadcasted_iota(
             jnp.int32, s.shape, dimension=1
         )
+        if use_alibi:
+            s = s + alibi_ref[h] * cols.astype(jnp.float32)
         keep = (cols <= rows) & (cols < valid)
         if window > 0:
             keep &= rows - cols < window
@@ -487,6 +532,7 @@ def prefill_attention(
     block_q: int = 128,
     block_k: int = 128,
     window: int = 0,  # >0: band mask, rows - cols < window
+    alibi_slopes: jax.Array | None = None,  # [H] f32 (bloom lineage)
     interpret: bool = False,
 ) -> jax.Array:
     """Flash causal self-attention over one padded prompt bucket.
@@ -507,26 +553,31 @@ def prefill_attention(
     kh = jnp.swapaxes(k, 0, 1)  # [Hkv, T, Dh]
     vh = jnp.swapaxes(v, 0, 1)
 
+    slopes = (
+        jnp.zeros(num_heads, jnp.float32)
+        if alibi_slopes is None
+        else alibi_slopes.astype(jnp.float32)
+    )
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
+        num_scalar_prefetch=2,
         grid=(num_heads, nq, nk),
         in_specs=[
             pl.BlockSpec(
                 (1, block_q, head_dim),
-                lambda h, i, j, vl: (h, i, 0),
+                lambda h, i, j, vl, al: (h, i, 0),
             ),
             pl.BlockSpec(
                 (1, block_k, head_dim),
-                lambda h, i, j, vl: (h // g, j, 0),
+                lambda h, i, j, vl, al: (h // g, j, 0),
             ),
             pl.BlockSpec(
                 (1, block_k, head_dim),
-                lambda h, i, j, vl: (h // g, j, 0),
+                lambda h, i, j, vl, al: (h // g, j, 0),
             ),
         ],
         out_specs=pl.BlockSpec(
             (1, block_q, head_dim),
-            lambda h, i, j, vl: (h, i, 0),
+            lambda h, i, j, vl, al: (h, i, 0),
         ),
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -538,9 +589,10 @@ def prefill_attention(
         functools.partial(
             _prefill_kernel, scale=scale, block_q=block_q,
             block_k=block_k, window=window,
+            use_alibi=alibi_slopes is not None,
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((num_heads, t, head_dim), q.dtype),
         interpret=interpret,
-    )(jnp.asarray([valid_len], jnp.int32), qh, kh, vh)
+    )(jnp.asarray([valid_len], jnp.int32), slopes, qh, kh, vh)
     return jnp.swapaxes(out, 0, 1)
