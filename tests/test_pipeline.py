@@ -170,3 +170,69 @@ def test_pp_rejects_unsupported_combos(tiny_model_dir):
                 cfg.parallel_config, data_parallel_size=2
             ),
         )
+
+
+def test_pp_prompt_logprobs(tiny_model_dir):
+    """Full-bucket logits + prompt-logprob extraction run on the LAST
+    stage; parity with the single-stage engine covers the whole table."""
+    from vllm_tgis_adapter_tpu.engine.core import LLMEngine
+
+    def run(pp):
+        done = _run(
+            LLMEngine.from_config(_engine_config(tiny_model_dir, pp=pp)),
+            [("lp", list(range(7, 27)))],
+            max_tokens=4, prompt_logprobs=3, logprobs=3,
+        )
+        assert "lp" in done, f"pp={pp} request never finished"
+        return done["lp"]
+
+    ref, pp = run(1), run(2)
+    assert ref.prompt_logprobs is not None and pp.prompt_logprobs is not None
+    assert len(ref.prompt_logprobs) == len(pp.prompt_logprobs) == 20
+    for a, b in zip(ref.prompt_logprobs[1:], pp.prompt_logprobs[1:]):
+        assert set(a) == set(b)
+        for tid in a:
+            assert abs(a[tid].logprob - b[tid].logprob) < 1e-4
+    assert ref.outputs[0].token_ids == pp.outputs[0].token_ids
+    for a, b in zip(ref.outputs[0].logprobs, pp.outputs[0].logprobs):
+        assert set(a) == set(b)
+        for tid in a:
+            assert abs(a[tid].logprob - b[tid].logprob) < 1e-4
+
+
+def test_pp_abort_mid_generation(tiny_model_dir):
+    """Aborting a request between steps under the staged runner frees it
+    and leaves the other rows' results intact."""
+    from vllm_tgis_adapter_tpu.engine.core import LLMEngine
+    from vllm_tgis_adapter_tpu.engine.sampling_params import SamplingParams
+
+    engine = LLMEngine.from_config(_engine_config(tiny_model_dir, pp=2))
+    engine.add_request(
+        "victim", None,
+        SamplingParams(temperature=0.0, max_tokens=200, ignore_eos=True),
+        prompt_token_ids=list(range(5, 21)),
+    )
+    engine.add_request(
+        "survivor", None,
+        SamplingParams(temperature=0.0, max_tokens=12, ignore_eos=True),
+        prompt_token_ids=list(range(9, 25)),
+    )
+    done = {}
+    aborted = False
+    for _ in range(300):
+        if not engine.has_unfinished_requests():
+            break
+        for out in engine.step():
+            if out.finished:
+                done[out.request_id] = out
+        if not aborted and engine._seqs.get("victim") is not None:
+            seq = engine._seqs["victim"]
+            if seq.num_output_tokens >= 2:
+                out = engine.abort_request("victim")
+                assert out is not None
+                done["victim"] = out
+                aborted = True
+    assert aborted
+    assert done["victim"].outputs[0].finish_reason == "abort"
+    assert done["survivor"].outputs[0].finish_reason == "length"
+    assert len(done["survivor"].outputs[0].token_ids) == 12
