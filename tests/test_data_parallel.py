@@ -219,3 +219,38 @@ def test_dp_needs_enough_devices(dp_config):
 
     with pytest.raises(ValueError, match="devices"):
         AsyncLLMEngine.from_config(dp_config(dp=4, tp=4))
+
+
+def test_dp_of_pipelines(dp_config):
+    """dp × pp composes: each replica is a FULL pipeline over a disjoint
+    pp×tp device slice, and results still match the plain engine."""
+    from vllm_tgis_adapter_tpu.engine.async_llm import AsyncLLMEngine
+    from vllm_tgis_adapter_tpu.engine.pipeline import PipelineRunner
+
+    cfg = dp_config(dp=2, tp=2)
+    cfg = dataclasses.replace(
+        cfg,
+        parallel_config=dataclasses.replace(
+            cfg.parallel_config, pipeline_parallel_size=2,
+            tensor_parallel_size=2,
+        ),
+    )
+    engine = AsyncLLMEngine.from_config(cfg)  # 2 × (2 stages × tp2) = 8
+    assert len(engine._replicas) == 2
+    device_sets = []
+    for rep in engine._replicas:
+        runner = rep.engine.runner
+        assert isinstance(runner, PipelineRunner)
+        devs = set()
+        for stage in runner.stages:
+            devs |= {d.id for d in stage.mesh.devices.flatten()}
+        assert len(devs) == 4  # pp=2 × tp=2 per pipeline
+        device_sets.append(devs)
+    assert device_sets[0].isdisjoint(device_sets[1])
+
+    prompts = [f"compose {i}" for i in range(4)]
+    single = AsyncLLMEngine.from_config(dp_config(dp=1))
+    ref = asyncio.run(_collect(single, prompts))
+    got = asyncio.run(_collect(engine, prompts))
+    for r, g in zip(ref, got):
+        assert r.outputs[0].token_ids == g.outputs[0].token_ids

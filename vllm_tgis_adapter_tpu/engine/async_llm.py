@@ -93,13 +93,19 @@ class AsyncLLMEngine:
             return cls(LLMEngine.from_config(config))
         import jax
 
-        per_replica = pcfg.tensor_parallel_size * pcfg.sequence_parallel_size
+        # each replica owns a full sp×tp slice — or, under pp, a full
+        # pipeline's pp×tp worth of devices
+        per_replica = (
+            pcfg.tensor_parallel_size
+            * pcfg.sequence_parallel_size
+            * pcfg.pipeline_parallel_size
+        )
         devices = jax.devices()
         if dp * per_replica > len(devices):
             raise ValueError(
                 f"data_parallel_size={dp} needs {dp * per_replica} devices "
-                f"(sp×tp={per_replica} each) but only {len(devices)} are "
-                "visible"
+                f"(pp×sp×tp={per_replica} each) but only {len(devices)} "
+                "are visible"
             )
         replica_config = dataclasses.replace(
             config,

@@ -524,11 +524,9 @@ class EngineConfig:
                 "--sequence-parallel-size does not compose with "
                 "--pipeline-parallel-size yet"
             )
-        if self.parallel_config.data_parallel_size > 1:
-            raise ValueError(
-                "--data-parallel-size does not compose with "
-                "--pipeline-parallel-size yet"
-            )
+        # dp × pp composes: the async fleet builds one PIPELINE per dp
+        # replica over a disjoint pp×tp device slice
+        # (engine/async_llm.py from_config)
 
     @property
     def max_model_len(self) -> int:

@@ -135,6 +135,17 @@ class LLMEngine:
         mesh = None
         pp = config.parallel_config.pipeline_parallel_size
         if pp > 1:
+            if config.parallel_config.data_parallel_size > 1 and (
+                devices is None
+            ):
+                # dp replicas are built a level up (AsyncLLMEngine), each
+                # passing its own device slice; a direct construction
+                # with dp>1 and no slice would silently drop dp
+                raise ValueError(
+                    "LLMEngine is one dp replica; construct via "
+                    "AsyncLLMEngine.from_config for --data-parallel-size "
+                    "replicas of a pipeline"
+                )
             # stage-routed placement: each layer's tensors land directly
             # on its pipeline stage's device group (engine/pipeline.py)
             from vllm_tgis_adapter_tpu.engine.pipeline import (
