@@ -254,3 +254,33 @@ def test_dp_of_pipelines(dp_config):
     got = asyncio.run(_collect(engine, prompts))
     for r, g in zip(ref, got):
         assert r.outputs[0].token_ids == g.outputs[0].token_ids
+
+
+def test_dp_of_sp_rings(dp_config):
+    """dp × sp composes: each replica runs ring-attention prefill over
+    its own sp×tp slice (the per-replica multiplier already counts sp)."""
+    from vllm_tgis_adapter_tpu.engine.async_llm import AsyncLLMEngine
+
+    cfg = dp_config(dp=2, tp=2)
+    cfg = dataclasses.replace(
+        cfg,
+        parallel_config=dataclasses.replace(
+            cfg.parallel_config, sequence_parallel_size=2,
+            tensor_parallel_size=2,
+        ),
+    )
+    engine = AsyncLLMEngine.from_config(cfg)  # 2 × (sp2 × tp2) = 8
+    assert len(engine._replicas) == 2
+    device_sets = []
+    for rep in engine._replicas:
+        mesh = rep.engine.runner.mesh
+        assert dict(mesh.shape)["sp"] == 2 and dict(mesh.shape)["tp"] == 2
+        device_sets.append({d.id for d in mesh.devices.flatten()})
+    assert device_sets[0].isdisjoint(device_sets[1])
+
+    prompts = [f"ring {i}" for i in range(4)]
+    single = AsyncLLMEngine.from_config(dp_config(dp=1))
+    ref = asyncio.run(_collect(single, prompts))
+    got = asyncio.run(_collect(engine, prompts))
+    for r, g in zip(ref, got):
+        assert r.outputs[0].token_ids == g.outputs[0].token_ids

@@ -163,13 +163,14 @@ def test_pp_rejects_unsupported_combos(tiny_model_dir):
                 cfg.parallel_config, sequence_parallel_size=2
             ),
         )
-    with pytest.raises(ValueError, match="data-parallel"):
-        dataclasses.replace(
-            cfg,
-            parallel_config=dataclasses.replace(
-                cfg.parallel_config, data_parallel_size=2
-            ),
-        )
+    # dp × pp is a SUPPORTED composition (one pipeline per replica,
+    # tests/test_data_parallel.py::test_dp_of_pipelines)
+    dataclasses.replace(
+        cfg,
+        parallel_config=dataclasses.replace(
+            cfg.parallel_config, data_parallel_size=2
+        ),
+    )
 
 
 def test_pp_prompt_logprobs(tiny_model_dir):

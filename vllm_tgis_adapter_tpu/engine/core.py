@@ -126,6 +126,17 @@ class LLMEngine:
             mesh_from_parallel_config,
         )
 
+        if config.parallel_config.data_parallel_size > 1:
+            # LLMEngine is always ONE dp rank: AsyncLLMEngine builds the
+            # replica fleet and hands each LLMEngine a dp=1 config plus
+            # its device slice.  Rejecting here (not per-branch) keeps
+            # the pp and non-pp paths consistent — a dp>1 config can
+            # never silently run at 1/dp capacity.
+            raise ValueError(
+                "LLMEngine is one dp replica; construct via "
+                "AsyncLLMEngine.from_config for --data-parallel-size "
+                "replicas"
+            )
         mcfg = config.model_config
         model_cls = get_model_class(mcfg.model_type)
         model = model_cls(mcfg)
@@ -135,17 +146,6 @@ class LLMEngine:
         mesh = None
         pp = config.parallel_config.pipeline_parallel_size
         if pp > 1:
-            if config.parallel_config.data_parallel_size > 1 and (
-                devices is None
-            ):
-                # dp replicas are built a level up (AsyncLLMEngine), each
-                # passing its own device slice; a direct construction
-                # with dp>1 and no slice would silently drop dp
-                raise ValueError(
-                    "LLMEngine is one dp replica; construct via "
-                    "AsyncLLMEngine.from_config for --data-parallel-size "
-                    "replicas of a pipeline"
-                )
             # stage-routed placement: each layer's tensors land directly
             # on its pipeline stage's device group (engine/pipeline.py)
             from vllm_tgis_adapter_tpu.engine.pipeline import (
