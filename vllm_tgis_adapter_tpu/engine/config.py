@@ -514,11 +514,6 @@ class EngineConfig:
                 "--speculative-model is not supported with "
                 "--pipeline-parallel-size > 1 yet"
             )
-        if self.lora_config.enabled:
-            raise ValueError(
-                "--enable-lora is not supported with "
-                "--pipeline-parallel-size > 1 yet"
-            )
         if self.parallel_config.sequence_parallel_size > 1:
             raise ValueError(
                 "--sequence-parallel-size does not compose with "

@@ -15,9 +15,10 @@ microbatch.  Prefill chains remain sequential per prompt (single-request
 latency pays the stage bubble there).
 
 Scope (fail-fast otherwise, engine/config.py validation): composes with
-TP (stage meshes) and everything sampler-side (guided decoding, seeded
+TP (stage meshes), DP (one pipeline per replica), LoRA (stage-sliced
+adapter stacks), and everything sampler-side (guided decoding, seeded
 sampling, penalties, stop strings, chunked prefill, prefix caching);
-NOT with speculative decoding, LoRA, or sequence parallelism yet.
+NOT with speculative decoding or sequence parallelism yet.
 
 Decode under PP runs one step per stage chain (the single-jit fused
 K-step scan cannot span device groups); the scheduler's
@@ -152,14 +153,14 @@ def split_pipeline_params(params: dict, ranges) -> list[dict]:
 
 def _stage_decode(model, block_size, first, last,
                   params, caches, token_ids, step_ints, block_tables,
-                  hidden=None):
+                  hidden=None, lora=None, lora_idx=None):
     """Jitted per-stage decode wrapper: the three identical per-step row
     vectors (positions, slot_mapping, context_lens) travel as ONE packed
     [3, B] int32 buffer per stage — each host↔device buffer is its own
     transfer (and, tunnel-attached, its own network round trip)."""
     return model.decode(
         params, caches, token_ids, step_ints[0], step_ints[1],
-        block_tables, step_ints[2], block_size,
+        block_tables, step_ints[2], block_size, lora, lora_idx,
         hidden=hidden, first_stage=first, last_stage=last,
     )
 
@@ -217,6 +218,7 @@ class PipelineRunner(ModelRunner):
         self.max_blocks_per_seq = -(-mcfg.max_model_len // self.block_size)
         self._rng = np.random.default_rng(config.seed)
         self.lora_stacks = None
+        self._stage_lora = None
         self._lora_version = 0
         self._seen_pad_lens = sorted(
             set(config.scheduler_config.prefill_buckets)
@@ -289,12 +291,26 @@ class PipelineRunner(ModelRunner):
     def _stage_put(self, stage: _Stage, x):
         return jax.device_put(np.asarray(x), stage.data_sharding)
 
-    def sync_lora(self, manager) -> None:  # noqa: ANN001
-        if manager is not None and manager.lora_requests:
-            raise NotImplementedError(
-                "LoRA adapters are not supported with "
-                "--pipeline-parallel-size > 1 yet"
+    def _place_lora_stacks(self, stacks):  # noqa: ANN001
+        """Per-stage adapter stacks: the [L, ...] target arrays slice on
+        the layer axis exactly like the params, so each stage's model
+        indexes them with its LOCAL layer number.  Returns a bare truthy
+        marker — keeping the full host stacks alive would pin gigabytes
+        for big models; the sliced device copies hold the data."""
+        self._stage_lora = []
+        for stage, (lo, hi) in zip(self.stages, self.ranges):
+            sliced = dataclasses.replace(
+                stacks,
+                a={t: v[lo:hi] for t, v in stacks.a.items()},
+                b={t: v[lo:hi] for t, v in stacks.b.items()},
             )
+            self._stage_lora.append(jax.tree.map(
+                lambda x, st=stage: jax.device_put(
+                    np.asarray(x), st.data_sharding
+                ),
+                sliced,
+            ))
+        return True
 
     # ------------------------------------------------------------- prefill
 
@@ -304,7 +320,7 @@ class PipelineRunner(ModelRunner):
         t = prep.t
         hidden = None
         logits = None
-        for stage in self.stages:
+        for si, stage in enumerate(self.stages):
             common = dict(
                 token_ids=self._stage_put(stage, prep.token_ids),
                 positions=self._stage_put(stage, prep.positions),
@@ -312,6 +328,11 @@ class PipelineRunner(ModelRunner):
                 valid_len=self._stage_put(stage, np.asarray(t, np.int32)),
                 logits_indices=self._stage_put(stage, prep.logits_indices),
             )
+            if self.lora_stacks is not None:
+                common["lora"] = self._stage_lora[si]
+                common["lora_slot"] = self._stage_put(
+                    stage, np.asarray(prep.lora_slot, np.int32)
+                )
             if not stage.first:
                 common["hidden"] = jax.device_put(
                     hidden, stage.data_sharding
@@ -439,6 +460,14 @@ class PipelineRunner(ModelRunner):
                     self._stage_put(stage, prep.token_ids[lo:hi])
                     for stage in self.stages
                 ],
+                lora_idx=(
+                    [
+                        self._stage_put(stage, prep.lora_idx[lo:hi])
+                        for stage in self.stages
+                    ]
+                    if prep.lora_idx is not None
+                    else None
+                ),
                 outs=[],
             ))
 
@@ -477,6 +506,9 @@ class PipelineRunner(ModelRunner):
                         step_ints=self._stage_put(stage, step_ints),
                         block_tables=chain["tables"][si],
                     )
+                    if chain["lora_idx"] is not None:
+                        kwargs["lora"] = self._stage_lora[si]
+                        kwargs["lora_idx"] = chain["lora_idx"][si]
                     if not stage.first:
                         kwargs["hidden"] = jax.device_put(
                             hidden, stage.data_sharding

@@ -249,8 +249,14 @@ class ModelRunner:
             self.config.model_config, manager.max_loras,
             lcfg.max_lora_rank, manager,
         )
-        self.lora_stacks = jax.tree.map(self._put, stacks)
+        # subclasses override placement (the pipeline runner slices per
+        # stage); the host-side build above stays shared so the version
+        # protocol cannot drift between runners
+        self.lora_stacks = self._place_lora_stacks(stacks)
         self._lora_version = manager.version
+
+    def _place_lora_stacks(self, stacks):  # noqa: ANN001
+        return jax.tree.map(self._put, stacks)
 
     def _build_decode_fn(self):
         """Fused K-step decode+sample program (SURVEY.md §7 recompilation
