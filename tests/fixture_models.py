@@ -441,6 +441,75 @@ def build_tiny_bloom(path: str, seed: int = 0) -> str:
     return str(out)
 
 
+TINY_GPT2_CONFIG = {
+    "architectures": ["GPT2LMHeadModel"],
+    "model_type": "gpt2",
+    "vocab_size": 512,
+    "n_embd": 64,
+    "n_layer": 2,
+    "n_head": 4,
+    "n_positions": 512,
+    "n_ctx": 512,
+    "layer_norm_epsilon": 1e-5,
+    "activation_function": "gelu_new",
+    "bos_token_id": 1,
+    "eos_token_id": 2,
+    "torch_dtype": "float32",
+}
+
+
+def build_tiny_gpt2(path: str, seed: int = 0) -> str:
+    """Tiny GPT-2 checkpoint in HF naming: Conv1D ([in, out]) weights,
+    fused c_attn in plain q|k|v column thirds, wte/wpe, tied head."""
+    import numpy as np
+    from safetensors.numpy import save_file
+
+    out = Path(path)
+    out.mkdir(parents=True, exist_ok=True)
+
+    tokenizer = build_tokenizer(path)
+    cfg = dict(TINY_GPT2_CONFIG)
+    cfg["vocab_size"] = max(cfg["vocab_size"], len(tokenizer))
+    with open(out / "config.json", "w") as f:
+        json.dump(cfg, f, indent=2)
+
+    rng = np.random.default_rng(seed)
+    d = cfg["n_embd"]
+    inter = 4 * d
+    vocab = cfg["vocab_size"]
+
+    def w(shape):
+        return (rng.standard_normal(shape) * 0.02).astype(np.float32)
+
+    def b(n):
+        return (rng.standard_normal(n) * 0.01).astype(np.float32)
+
+    tensors = {
+        "transformer.wte.weight": w((vocab, d)),
+        "transformer.wpe.weight": w((cfg["n_positions"], d)),
+        "transformer.ln_f.weight": np.ones(d, np.float32),
+        "transformer.ln_f.bias": b(d),
+    }
+    for i in range(cfg["n_layer"]):
+        p = f"transformer.h.{i}"
+        tensors |= {
+            f"{p}.ln_1.weight": np.ones(d, np.float32),
+            f"{p}.ln_1.bias": b(d),
+            f"{p}.ln_2.weight": np.ones(d, np.float32),
+            f"{p}.ln_2.bias": b(d),
+            f"{p}.attn.c_attn.weight": w((d, 3 * d)),  # Conv1D [in, out]
+            f"{p}.attn.c_attn.bias": b(3 * d),
+            f"{p}.attn.c_proj.weight": w((d, d)),
+            f"{p}.attn.c_proj.bias": b(d),
+            f"{p}.mlp.c_fc.weight": w((d, inter)),
+            f"{p}.mlp.c_fc.bias": b(inter),
+            f"{p}.mlp.c_proj.weight": w((inter, d)),
+            f"{p}.mlp.c_proj.bias": b(d),
+        }
+    save_file(tensors, out / "model.safetensors")
+    return str(out)
+
+
 def build_tiny_lora_adapter(path: str, seed: int = 7, rank: int = 4) -> str:
     """PEFT-format LoRA adapter matching the tiny llama fixture: real
     random A/B weights on q/v projections of both layers (the reference's

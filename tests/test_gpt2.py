@@ -1,0 +1,177 @@
+"""GPT-2 family: numerical parity vs HF torch + engine e2e.
+
+Sixth architecture family through the shared decoder skeleton: learned
+positions with no lookup offset, pre-LayerNorm with biases, Conv1D
+projections (already ``[in, out]`` — no transpose), fused ``c_attn``
+split into plain q|k|v column thirds by the loader, fc/GELU(tanh)/proj
+MLP, tied head, MHA.  Gold-standard checks mirror the other suites.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from tests.fixture_models import hf_reference_model, hf_tokenize
+
+
+@pytest.fixture(scope="module")
+def gpt2_dir(tmp_path_factory):
+    from tests.fixture_models import build_tiny_gpt2
+
+    return build_tiny_gpt2(str(tmp_path_factory.mktemp("tiny-gpt2")))
+
+
+@pytest.fixture(scope="module")
+def setup(gpt2_dir):
+    import jax.numpy as jnp
+
+    from vllm_tgis_adapter_tpu.engine.config import ModelConfig
+    from vllm_tgis_adapter_tpu.engine.weights import load_model_params
+    from vllm_tgis_adapter_tpu.models import get_model_class
+
+    config = ModelConfig.from_pretrained(gpt2_dir, dtype="float32")
+    model = get_model_class(config.model_type)(config)
+    params = load_model_params(config, gpt2_dir)
+    caches = model.make_kv_caches(num_slots=1024, dtype=jnp.float32)
+    return gpt2_dir, config, model, params, caches
+
+
+def test_gpt2_config_mapping(setup):
+    _, config, _, params, _ = setup
+    assert config.model_type == "gpt2"
+    assert config.position_embedding == "learned"
+    assert config.learned_pos_offset == 0
+    assert config.norm_type == "layernorm"
+    assert config.hidden_act == "gelu_new"
+    assert config.tie_word_embeddings
+    assert "pos_embed" in params and "lm_head" not in params
+    layer = params["layers"][0]
+    for name in ("wq", "bq", "bo", "b_up", "b_down"):
+        assert name in layer, name
+
+
+def test_gpt2_prefill_logits_match_hf(setup):
+    import jax.numpy as jnp
+    import torch
+
+    model_dir, config, model, params, caches = setup
+    input_ids = hf_tokenize(model_dir, "the quick brown fox jumps")
+    t = len(input_ids)
+
+    logits, _ = model.prefill(
+        params, caches,
+        jnp.asarray(input_ids, dtype=jnp.int32),
+        jnp.arange(t, dtype=jnp.int32),
+        jnp.arange(t, dtype=jnp.int32),
+        jnp.asarray(t, dtype=jnp.int32),
+    )
+    hf = hf_reference_model(model_dir)
+    with torch.no_grad():
+        hf_logits = hf(torch.tensor([input_ids])).logits[0].numpy()
+    np.testing.assert_allclose(
+        np.asarray(logits), hf_logits, rtol=1e-3, atol=1e-3
+    )
+
+
+def test_gpt2_greedy_decode_matches_hf_generate(setup):
+    import jax.numpy as jnp
+    import torch
+
+    model_dir, config, model, params, caches = setup
+    input_ids = hf_tokenize(model_dir, "the capital of France")
+    t = len(input_ids)
+    new_tokens = 12
+    block_size = 16
+    max_blocks = 8
+
+    hf = hf_reference_model(model_dir)
+    with torch.no_grad():
+        hf_out = hf.generate(
+            torch.tensor([input_ids]),
+            max_new_tokens=new_tokens,
+            do_sample=False,
+            eos_token_id=None,
+        )[0].tolist()
+    expected = hf_out[t:]
+
+    logits, caches = model.prefill(
+        params, caches,
+        jnp.asarray(input_ids, dtype=jnp.int32),
+        jnp.arange(t, dtype=jnp.int32),
+        jnp.arange(t, dtype=jnp.int32),
+        jnp.asarray(t, dtype=jnp.int32),
+    )
+    block_tables = jnp.arange(max_blocks, dtype=jnp.int32)[None, :]
+    next_token = int(jnp.argmax(logits[t - 1]))
+    produced = [next_token]
+    pos = t
+    for _ in range(new_tokens - 1):
+        step_logits, caches = model.decode(
+            params, caches,
+            jnp.asarray([next_token], dtype=jnp.int32),
+            jnp.asarray([pos], dtype=jnp.int32),
+            jnp.asarray([pos], dtype=jnp.int32),
+            block_tables,
+            jnp.asarray([pos + 1], dtype=jnp.int32),
+            block_size,
+        )
+        next_token = int(jnp.argmax(step_logits[0]))
+        produced.append(next_token)
+        pos += 1
+
+    assert produced == expected
+
+
+def test_gpt2_engine_end_to_end(gpt2_dir):
+    from vllm_tgis_adapter_tpu.engine.config import (
+        CacheConfig,
+        EngineConfig,
+        LoRAConfig,
+        ModelConfig,
+        ParallelConfig,
+        SchedulerConfig,
+    )
+    from vllm_tgis_adapter_tpu.engine.core import LLMEngine
+    from vllm_tgis_adapter_tpu.engine.sampling_params import SamplingParams
+
+    mcfg = ModelConfig.from_pretrained(gpt2_dir, dtype="float32")
+    config = EngineConfig(
+        model_config=mcfg,
+        cache_config=CacheConfig(block_size=16, num_blocks=64,
+                                 cache_dtype=mcfg.dtype),
+        scheduler_config=SchedulerConfig(max_num_seqs=4,
+                                         prefill_buckets=(32, 64)),
+        parallel_config=ParallelConfig(),
+        lora_config=LoRAConfig(),
+    )
+    engine = LLMEngine.from_config(config)
+    for i in range(3):
+        engine.add_request(
+            f"g2-{i}", f"tell me about topic {i}",
+            SamplingParams(temperature=0.0, max_tokens=8, ignore_eos=True),
+        )
+    done = {}
+    for _ in range(200):
+        if not engine.has_unfinished_requests():
+            break
+        for out in engine.step():
+            if out.finished:
+                done[out.request_id] = out
+    assert set(done) == {"g2-0", "g2-1", "g2-2"}
+    for out in done.values():
+        assert len(out.outputs[0].token_ids) == 8
+
+
+def test_gpt2_rejects_oversized_max_len(tmp_path):
+    import json
+
+    from tests.fixture_models import TINY_GPT2_CONFIG
+
+    from vllm_tgis_adapter_tpu.engine.config import ModelConfig
+
+    p = tmp_path / "g2"
+    p.mkdir()
+    (p / "config.json").write_text(json.dumps(TINY_GPT2_CONFIG))
+    with pytest.raises(ValueError, match="learned-position table"):
+        ModelConfig.from_pretrained(str(p), max_model_len=4096)

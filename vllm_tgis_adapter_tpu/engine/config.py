@@ -125,6 +125,10 @@ class ModelConfig:
             return ModelConfig._from_bloom_config(
                 model, hf, max_model_len=max_model_len, dtype=dtype
             )
+        if model_type == "gpt2":
+            return ModelConfig._from_gpt2_config(
+                model, hf, max_model_len=max_model_len, dtype=dtype
+            )
         hidden = hf["hidden_size"]
         heads = hf["num_attention_heads"]
         derived_len = hf.get("max_position_embeddings", 2048)
@@ -320,6 +324,74 @@ class ModelConfig:
             gated_mlp=False,
             rotary_dim=rotary_dim if rotary_dim != head_dim else 0,
             parallel_residual=hf.get("use_parallel_residual", True),
+        )
+
+    @staticmethod
+    def _from_gpt2_config(
+        model: str,
+        hf: dict,
+        *,
+        max_model_len: int | None = None,
+        dtype: str = "auto",
+    ) -> "ModelConfig":
+        """GPT-2 family: learned positions (no offset), pre-LayerNorm
+        with biases, fused Conv1D c_attn (plain column thirds, split by
+        the loader), fc/GELU(tanh)/proj MLP, tied head, MHA.
+
+        Note: the official checkpoints' vocab_size of 50257 is odd, so
+        tensor parallelism rejects them at boot (validate_tp_divisibility
+        — vocab padding is not implemented); gpt2-scale models fit one
+        chip anyway.
+        """
+        if hf.get("scale_attn_by_inverse_layer_idx", False):
+            raise ValueError(
+                "gpt2: scale_attn_by_inverse_layer_idx=true variants are "
+                "not supported"
+            )
+        if not hf.get("scale_attn_weights", True):
+            # HF skips the 1/sqrt(head_dim) scaling for these; the shared
+            # kernel always applies it, so loading would be silently wrong
+            raise ValueError(
+                "gpt2: scale_attn_weights=false variants are not supported"
+            )
+        hidden = hf["n_embd"]
+        heads = hf["n_head"]
+        derived_len = hf.get("n_positions", hf.get("n_ctx", 1024))
+        if max_model_len and max_model_len > derived_len:
+            raise ValueError(
+                f"max_model_len={max_model_len} exceeds GPT-2's learned-"
+                f"position table ({derived_len} positions)"
+            )
+        eos = hf.get("eos_token_id", 50256)
+        if isinstance(eos, list):
+            eos = eos[0]
+        return ModelConfig(
+            model=model,
+            model_type="gpt2",
+            vocab_size=hf["vocab_size"],
+            hidden_size=hidden,
+            intermediate_size=hf.get("n_inner") or 4 * hidden,
+            num_layers=hf["n_layer"],
+            num_heads=heads,
+            num_kv_heads=heads,
+            head_dim=hidden // heads,
+            max_model_len=max_model_len or derived_len,
+            rms_norm_eps=hf.get("layer_norm_epsilon", 1e-5),
+            tie_word_embeddings=True,
+            dtype=resolve_dtype(dtype),
+            eos_token_id=eos,
+            bos_token_id=hf.get("bos_token_id", 50256) or 50256,
+            attention_bias=True,
+            attention_out_bias=True,
+            mlp_bias=True,
+            norm_type="layernorm",
+            hidden_act=ModelConfig._validated_hidden_act(
+                hf.get("activation_function", "gelu_new"), "gpt2"
+            ),
+            gated_mlp=False,
+            position_embedding="learned",
+            num_position_embeddings=derived_len,
+            learned_pos_offset=0,
         )
 
     @staticmethod

@@ -404,6 +404,72 @@ def load_bloom_params(
     return params
 
 
+def load_gpt2_params(
+    config: "ModelConfig",
+    model_path: str,
+    place: Optional[PlaceFn] = None,
+) -> dict:
+    """GPT-2 checkpoint → the shared decoder param pytree.
+
+    HF GPT-2 stores projections as Conv1D — already ``[in, out]``, so no
+    transpose anywhere.  ``attn.c_attn.weight`` is the fused ``[d, 3d]``
+    projection whose COLUMNS split into plain q|k|v thirds (heads are
+    contiguous within each third, unlike the neox/bloom per-head
+    interleave).  ``wpe`` is the learned position table (no offset);
+    the head is tied to ``wte``.  Both bare and ``transformer.``-prefixed
+    exports load.
+    """
+    place = place or (lambda _name, x: x)
+    raw = CheckpointIndex(model_path)
+    d = config.hidden_size
+    take = _make_take(raw, config.dtype, place, ("", "transformer."))
+
+    params: dict = {
+        "embed": take("wte.weight"),
+        "pos_embed": take("wpe.weight"),
+        "final_norm": take("ln_f.weight"),
+        "final_norm_bias": take("ln_f.bias"),
+        "layers": [],
+    }
+    for cand in ("lm_head.weight",):  # tied; drop duplicate exports
+        if cand in raw:
+            raw.pop(cand)
+
+    for i in range(config.num_layers):
+        prefix = f"h.{i}"
+        fused_w = take(f"{prefix}.attn.c_attn.weight", placed=False)
+        fused_b = take(f"{prefix}.attn.c_attn.bias", placed=False)
+        layer = {
+            "input_norm": take(f"{prefix}.ln_1.weight"),
+            "input_norm_bias": take(f"{prefix}.ln_1.bias"),
+            "post_attn_norm": take(f"{prefix}.ln_2.weight"),
+            "post_attn_norm_bias": take(f"{prefix}.ln_2.bias"),
+            "wo": take(f"{prefix}.attn.c_proj.weight"),
+            "bo": take(f"{prefix}.attn.c_proj.bias"),
+            "w_up": take(f"{prefix}.mlp.c_fc.weight"),
+            "b_up": take(f"{prefix}.mlp.c_fc.bias"),
+            "w_down": take(f"{prefix}.mlp.c_proj.weight"),
+            "b_down": take(f"{prefix}.mlp.c_proj.bias"),
+        }
+        for j, proj in enumerate(("q", "k", "v")):
+            layer[f"w{proj}"] = place(
+                f"{prefix}.{proj}_proj.weight",
+                fused_w[:, j * d:(j + 1) * d],
+            )
+            layer[f"b{proj}"] = place(
+                f"{prefix}.{proj}_proj.bias",
+                fused_b[j * d:(j + 1) * d],
+            )
+        params["layers"].append(layer)
+
+    ignored = [n for n in raw.remaining()
+               if not n.endswith(("attn.bias", "attn.masked_bias"))]
+    if ignored:
+        logger.warning("ignored %d unexpected checkpoint tensors: %s",
+                       len(ignored), ignored[:5])
+    return params
+
+
 def load_model_params(
     config: "ModelConfig",
     model_path: str,
@@ -416,4 +482,6 @@ def load_model_params(
         return load_gpt_neox_params(config, model_path, place)
     if config.model_type == "bloom":
         return load_bloom_params(config, model_path, place)
+    if config.model_type == "gpt2":
+        return load_gpt2_params(config, model_path, place)
     return load_llama_params(config, model_path, place)

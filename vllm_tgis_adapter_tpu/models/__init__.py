@@ -30,6 +30,9 @@ _REGISTRY = {
     # embedding LayerNorm, fused-QKV, tied head
     # (config.py _from_bloom_config)
     "bloom": LlamaForCausalLM,
+    # GPT-2: learned positions (no offset), Conv1D fused c_attn split
+    # into column thirds by the loader (config.py _from_gpt2_config)
+    "gpt2": LlamaForCausalLM,
 }
 
 

@@ -188,6 +188,14 @@ _HF_NAME_SPECS = (
     ("word_embeddings.weight", P(TP_AXIS, None)),
     ("ln_f.weight", P(None)),
     ("ln_f.bias", P(None)),
+    # gpt2: Conv1D (already [in, out]); attn/mlp c_proj are both
+    # row-parallel, c_fc column-parallel, wte vocab-parallel, wpe + the
+    # ln_1/ln_2 norms replicate via the default P()
+    ("c_proj.weight", P(TP_AXIS, None)),
+    ("c_proj.bias", P()),
+    ("c_fc.weight", P(None, TP_AXIS)),
+    ("c_fc.bias", P(TP_AXIS)),
+    ("wte.weight", P(TP_AXIS, None)),
     ("norm.weight", P(None)),
     ("norm.bias", P(None)),
     ("layernorm.weight", P(None)),
