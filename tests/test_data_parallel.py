@@ -284,3 +284,46 @@ def test_dp_of_sp_rings(dp_config):
     got = asyncio.run(_collect(engine, prompts))
     for r, g in zip(ref, got):
         assert r.outputs[0].token_ids == g.outputs[0].token_ids
+
+
+def test_dp_with_speculative_draft(dp_config, tmp_path_factory):
+    """dp × speculative decoding: each replica owns its own draft model
+    and cache; greedy outputs still match the plain dp=1 engine."""
+    from tests.fixture_models import build_tiny_llama
+
+    from vllm_tgis_adapter_tpu.engine.async_llm import AsyncLLMEngine
+    from vllm_tgis_adapter_tpu.engine.config import (
+        ModelConfig,
+        SpeculativeConfig,
+    )
+
+    draft_dir = build_tiny_llama(
+        str(tmp_path_factory.mktemp("dp-draft")), seed=7
+    )
+
+    def with_spec(cfg):
+        return dataclasses.replace(
+            cfg,
+            speculative=SpeculativeConfig(
+                draft_model=draft_dir,
+                num_speculative_tokens=4,
+                draft_model_config=ModelConfig.from_pretrained(
+                    draft_dir, dtype="float32"
+                ),
+            ),
+        )
+
+    prompts = [f"speculate {i}" for i in range(4)]
+    plain = AsyncLLMEngine.from_config(dp_config(dp=1))
+    ref = asyncio.run(_collect(plain, prompts, max_tokens=12))
+    spec_fleet = AsyncLLMEngine.from_config(with_spec(dp_config(dp=2)))
+    assert all(
+        rep.engine.runner.spec is not None
+        for rep in spec_fleet._replicas
+    )
+    # each replica has its OWN draft cache (no cross-replica sharing)
+    spec_ids = {id(rep.engine.runner.spec) for rep in spec_fleet._replicas}
+    assert len(spec_ids) == 2
+    got = asyncio.run(_collect(spec_fleet, prompts, max_tokens=12))
+    for r, g in zip(ref, got):
+        assert r.outputs[0].token_ids == g.outputs[0].token_ids
