@@ -13,8 +13,9 @@ by construction) while asyncio queues fan results out to per-request
 streams.
 
 Data parallelism (in-process): ``--data-parallel-size N`` builds N full
-engine replicas, each owning a disjoint ``sp × tp`` device slice, its own
-scheduler/KV pool, and its own step loop — DP for inference is
+engine replicas, each owning a disjoint ``pp × sp × tp`` device slice
+(a replica can be a whole pipeline), its own scheduler/KV pool, and its
+own step loop — DP for inference is
 independent batches, so replicas share nothing on the critical path
 (SURVEY.md §2.4: replica groups; no cross-replica collectives needed).
 New requests route to the least-loaded replica; the LoRA registry is
