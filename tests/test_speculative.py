@@ -21,7 +21,8 @@ def draft_model_dir(tmp_path_factory) -> str:
     return str(path)
 
 
-def make_engine(model_dir, draft_dir=None, gamma=4, **sched):
+def make_engine(model_dir, draft_dir=None, gamma=4, parallel_config=None,
+                **sched):
     from vllm_tgis_adapter_tpu.engine.config import (
         CacheConfig,
         EngineConfig,
@@ -51,7 +52,7 @@ def make_engine(model_dir, draft_dir=None, gamma=4, **sched):
             max_num_seqs=4, prefill_buckets=(32, 64, 128),
             num_decode_steps=8, **sched,
         ),
-        parallel_config=ParallelConfig(),
+        parallel_config=parallel_config or ParallelConfig(),
         lora_config=LoRAConfig(),
         speculative=speculative,
     )
@@ -263,3 +264,21 @@ def test_spec_with_prefix_caching(tiny_model_dir):
     assert (
         second["b"].outputs[0].token_ids == first["a"].outputs[0].token_ids
     )
+
+
+def test_spec_under_sequence_parallelism(tiny_model_dir, draft_model_dir):
+    """Speculation composes with sp: the draft shares the sp×tp mesh and
+    ring-prefills its own cache; greedy outputs match the plain engine."""
+    from vllm_tgis_adapter_tpu.engine.config import ParallelConfig
+
+    req = [("r", list(range(5, 25)),
+            dict(temperature=0.0, max_tokens=12, ignore_eos=True))]
+    plain = run_all(make_engine(tiny_model_dir), req)
+    engine = make_engine(
+        tiny_model_dir, draft_dir=draft_model_dir,
+        parallel_config=ParallelConfig(sequence_parallel_size=2),
+    )
+    assert engine.runner.spec is not None
+    assert dict(engine.runner.mesh.shape)["sp"] == 2
+    got = run_all(engine, req)
+    assert got["r"].outputs[0].token_ids == plain["r"].outputs[0].token_ids
