@@ -1,0 +1,192 @@
+"""TPU tunnel-watch daemon: capture the first up-window automatically.
+
+The axon-tunnelled chip dies and revives unpredictably (rounds 1-3 all
+failed to record an on-hardware number: crash, Mosaic bug, mid-session
+tunnel death).  Waiting for an up-window to coincide with a manual run
+loses the window; this daemon makes the capture inevitable instead:
+
+  loop every PROBE_INTERVAL seconds:
+    probe the TPU in a bounded subprocess (never in-process: backend
+    init blocks forever when the tunnel is down)
+    on success, immediately and in priority order:
+      1. full TPU bench (``python bench.py`` — the round deliverable;
+         it re-probes, runs in its own killable process group, and
+         degrades Pallas failures to an XLA number rather than zero)
+      2. RUN_TPU_TESTS=1 pytest -m tpu  (Mosaic lowering gates for the
+         windowed/ALiBi kernels that only ever ran in interpreter mode)
+      3. bench again with ATTENTION_BACKEND=xla (pallas-vs-xla delta)
+    append every result as a timestamped JSON line to
+    TPU_WATCH/results.jsonl; exit 0 once a backend=="tpu" bench line
+    has been captured (steps 2-3 are still attempted first while the
+    window lasts).
+
+The bench is run FIRST because observed windows can be ~6 minutes and
+the bench is the deliverable; a Pallas bug cannot zero it (bench.py
+retries on the XLA attention path), so the test gates are not a
+prerequisite.  Each step has its own wall-clock bound so one hang
+cannot eat the window budget for the rest.
+
+Usage: ``python bench_daemon.py`` (foreground; run under nohup/tmux).
+Env: WATCH_PROBE_INTERVAL (s, default 180), WATCH_PROBE_TIMEOUT
+(default 120), WATCH_MAX_HOURS (default 11), WATCH_DIR.
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+WATCH_DIR = os.environ.get("WATCH_DIR", os.path.join(REPO, "TPU_WATCH"))
+PROBE_INTERVAL = float(os.environ.get("WATCH_PROBE_INTERVAL", 180))
+PROBE_TIMEOUT = float(os.environ.get("WATCH_PROBE_TIMEOUT", 120))
+MAX_HOURS = float(os.environ.get("WATCH_MAX_HOURS", 11))
+
+
+def _now() -> str:
+    return datetime.datetime.now(datetime.timezone.utc).isoformat(
+        timespec="seconds")
+
+
+def _log(msg: str) -> None:
+    line = f"[{_now()}] {msg}"
+    print(line, flush=True)
+    with open(os.path.join(WATCH_DIR, "watch.log"), "a") as f:
+        f.write(line + "\n")
+
+
+def _record(kind: str, payload: dict) -> None:
+    entry = {"ts": _now(), "kind": kind, **payload}
+    with open(os.path.join(WATCH_DIR, "results.jsonl"), "a") as f:
+        f.write(json.dumps(entry) + "\n")
+
+
+def _run_bounded(cmd: list[str], timeout_s: float, env: dict,
+                 tag: str) -> tuple[int | None, str, str]:
+    """Run cmd in its own process group with a hard bound; SIGKILL the
+    whole group on timeout (the PJRT plugin holds helper processes on
+    the inherited pipes — killing only the child leaves communicate()
+    blocked on pipe EOF)."""
+    _log(f"{tag}: start (timeout {timeout_s:.0f}s): {' '.join(cmd)}")
+    try:
+        proc = subprocess.Popen(
+            cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, env=env, cwd=REPO, start_new_session=True,
+        )
+    except OSError as exc:
+        return None, "", f"spawn failed: {exc}"
+    try:
+        out, err = proc.communicate(timeout=timeout_s)
+        return proc.returncode, out or "", err or ""
+    except subprocess.TimeoutExpired as exc:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            proc.kill()
+        try:
+            out, err = proc.communicate(timeout=30)
+        except (subprocess.TimeoutExpired, ValueError, OSError):
+            out = exc.stdout if isinstance(exc.stdout, str) else ""
+            err = exc.stderr if isinstance(exc.stderr, str) else ""
+        return None, out or "", err or ""
+
+
+def _probe() -> bool:
+    code = (
+        "import jax, jax.numpy as jnp\n"
+        "assert jax.default_backend() == 'tpu', jax.default_backend()\n"
+        "x = jnp.ones((128, 128), jnp.bfloat16)\n"
+        "assert float((x @ x).sum()) > 0\n"
+        "print('TPU_OK', jax.devices()[0].device_kind)\n"
+    )
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    rc, out, _ = _run_bounded([sys.executable, "-c", code],
+                              PROBE_TIMEOUT, env, "probe")
+    ok = rc == 0 and "TPU_OK" in out
+    _log(f"probe: {'UP ' + out.strip().splitlines()[-1] if ok else 'down'}")
+    return ok
+
+
+def _last_json_line(text: str) -> dict | None:
+    for line in reversed(text.strip().splitlines()):
+        try:
+            parsed = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(parsed, dict):
+            return parsed
+    return None
+
+
+def _run_bench(attention_backend: str | None) -> dict | None:
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    # the daemon just probed; don't spend window time on a long re-probe
+    env.setdefault("BENCH_PROBE_TIMEOUT", "90")
+    env.setdefault("BENCH_TPU_TIMEOUT", "1200")
+    tag = f"bench[{attention_backend or 'default'}]"
+    if attention_backend:
+        env["ATTENTION_BACKEND"] = attention_backend
+    rc, out, err = _run_bounded(
+        [sys.executable, os.path.join(REPO, "bench.py")], 1500, env, tag)
+    parsed = _last_json_line(out)
+    if parsed is None:
+        _log(f"{tag}: no JSON line (rc={rc}) stderr tail: {err[-200:]}")
+        _record("bench_fail", {"attention": attention_backend or "default",
+                               "rc": rc, "stderr_tail": err[-500:]})
+        return None
+    parsed["attention_requested"] = attention_backend or "default"
+    _record("bench", parsed)
+    _log(f"{tag}: backend={parsed.get('backend')} "
+         f"value={parsed.get('value')} mfu={parsed.get('mfu')}")
+    return parsed
+
+
+def _run_tpu_tests() -> None:
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env["RUN_TPU_TESTS"] = "1"
+    rc, out, err = _run_bounded(
+        [sys.executable, "-m", "pytest", "tests", "-m", "tpu", "-q"],
+        1500, env, "tpu-tests")
+    tail = (out or "").strip().splitlines()[-15:]
+    _record("tpu_tests", {"rc": rc, "tail": tail,
+                          "stderr_tail": (err or "")[-300:]})
+    _log(f"tpu-tests: rc={rc} tail={tail[-1] if tail else '?'}")
+
+
+def main() -> None:
+    os.makedirs(WATCH_DIR, exist_ok=True)
+    deadline = time.monotonic() + MAX_HOURS * 3600
+    _log(f"daemon start: probe every {PROBE_INTERVAL:.0f}s, "
+         f"max {MAX_HOURS:.1f}h")
+    captured = False
+    while time.monotonic() < deadline:
+        if _probe():
+            result = _run_bench(None)
+            if result and result.get("backend") == "tpu":
+                captured = True
+                with open(os.path.join(WATCH_DIR, "bench_success.json"),
+                          "w") as f:
+                    json.dump(result, f, indent=1)
+            # window may still be open: run the Mosaic gates + xla delta
+            _run_tpu_tests()
+            xla = _run_bench("xla")
+            if xla and xla.get("backend") == "tpu" and not captured:
+                captured = True
+            if captured:
+                _log("capture complete; exiting")
+                return
+        time.sleep(PROBE_INTERVAL)
+    _log(f"daemon done after {MAX_HOURS:.1f}h; captured={captured}")
+    sys.exit(0 if captured else 3)
+
+
+if __name__ == "__main__":
+    main()

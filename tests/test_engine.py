@@ -534,3 +534,133 @@ def test_stats_logging_loop(tiny_model_dir, caplog):
     lines = [r.message for r in caplog.records if "Engine stats" in r.message]
     assert lines, "no stats line was emitted"
     assert "KV pages" in lines[0]
+
+
+def test_abort_during_admission_window(tiny_model_dir):
+    """abort() arriving while add_request is still awaiting the replica
+    lock must cancel the request, not silently no-op (ADVICE r3: the
+    owner was registered only after the admission critical section, so
+    an abort in that window found no owner)."""
+    from vllm_tgis_adapter_tpu.engine.async_llm import AsyncLLMEngine
+    from vllm_tgis_adapter_tpu.engine.config import (
+        CacheConfig,
+        EngineConfig,
+        LoRAConfig,
+        ModelConfig,
+        ParallelConfig,
+        SchedulerConfig,
+    )
+    from vllm_tgis_adapter_tpu.engine.sampling_params import (
+        RequestOutputKind,
+        SamplingParams,
+    )
+
+    mcfg = ModelConfig.from_pretrained(tiny_model_dir, dtype="float32")
+    config = EngineConfig(
+        model_config=mcfg,
+        cache_config=CacheConfig(block_size=16, num_blocks=64,
+                                 cache_dtype=mcfg.dtype),
+        scheduler_config=SchedulerConfig(
+            max_num_seqs=4, prefill_buckets=(32,), num_decode_steps=4),
+        parallel_config=ParallelConfig(),
+        lora_config=LoRAConfig(),
+    )
+
+    async def scenario():
+        engine = AsyncLLMEngine.from_config(config)
+        await engine.start()
+        rep = engine._replicas[0]
+        outs = []
+
+        async def consume():
+            async for out in engine.generate(
+                prompt=None,
+                sampling_params=SamplingParams(
+                    temperature=0.0, max_tokens=64, ignore_eos=True,
+                    output_kind=RequestOutputKind.DELTA,
+                ),
+                request_id="victim",
+                prompt_token_ids=list(range(3, 10)),
+            ):
+                outs.append(out)
+
+        # hold the admission lock so generate() parks exactly in the
+        # race window: owner registered, add_request not yet run
+        async with rep.lock:
+            task = asyncio.create_task(consume())
+            for _ in range(1000):
+                if "victim" in engine._owner:
+                    break
+                await asyncio.sleep(0)
+            assert "victim" in engine._owner, (
+                "owner must be visible while admission is in flight"
+            )
+            # abort now queues on the lock behind generate(); once the
+            # test releases it, admission completes and the abort lands
+            # immediately after
+            abort_task = asyncio.create_task(engine.abort("victim"))
+            await asyncio.sleep(0)
+        await abort_task
+        await asyncio.wait_for(task, timeout=10)
+        await engine.stop()
+        return outs
+
+    outs = asyncio.run(scenario())
+    assert outs and outs[-1].finished
+    assert outs[-1].outputs[0].finish_reason == "abort"
+
+
+def test_abort_before_admission_leaves_tombstone(tiny_model_dir):
+    """An abort that wins the replica lock BEFORE add_request leaves an
+    early-abort tombstone, and generate() honors it right after
+    admission — zero tokens are generated."""
+    from vllm_tgis_adapter_tpu.engine.async_llm import AsyncLLMEngine
+    from vllm_tgis_adapter_tpu.engine.config import (
+        CacheConfig,
+        EngineConfig,
+        LoRAConfig,
+        ModelConfig,
+        ParallelConfig,
+        SchedulerConfig,
+    )
+    from vllm_tgis_adapter_tpu.engine.sampling_params import SamplingParams
+
+    mcfg = ModelConfig.from_pretrained(tiny_model_dir, dtype="float32")
+    config = EngineConfig(
+        model_config=mcfg,
+        cache_config=CacheConfig(block_size=16, num_blocks=64,
+                                 cache_dtype=mcfg.dtype),
+        scheduler_config=SchedulerConfig(
+            max_num_seqs=4, prefill_buckets=(32,), num_decode_steps=4),
+        parallel_config=ParallelConfig(),
+        lora_config=LoRAConfig(),
+    )
+
+    async def scenario():
+        engine = AsyncLLMEngine.from_config(config)
+        await engine.start()
+        rep = engine._replicas[0]
+        # first half of generate(): owner registered, admission pending
+        engine._owner["victim"] = rep
+        await engine.abort("victim")
+        assert "victim" in engine._early_aborts, (
+            "abort before admission must leave a tombstone"
+        )
+        engine._owner.pop("victim")
+        # now the real generate() runs with the tombstone in place
+        outs = []
+        async for out in engine.generate(
+            prompt=None,
+            sampling_params=SamplingParams(
+                temperature=0.0, max_tokens=64, ignore_eos=True),
+            request_id="victim",
+            prompt_token_ids=list(range(3, 10)),
+        ):
+            outs.append(out)
+        await engine.stop()
+        return outs
+
+    outs = asyncio.run(scenario())
+    assert outs and outs[-1].finished
+    assert outs[-1].outputs[0].finish_reason == "abort"
+    assert outs[-1].outputs[0].token_ids == []

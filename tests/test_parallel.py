@@ -120,7 +120,9 @@ def test_tp_matches_single_device(tp):
 def test_unimplemented_parallel_modes_fail_fast():
     from vllm_tgis_adapter_tpu.parallel.mesh import mesh_from_parallel_config
 
-    with pytest.raises(NotImplementedError, match="pipeline-parallel"):
+    # pp>1 is implemented via engine/pipeline.py; this mesh builder only
+    # serves non-pipelined replicas and must say so (ADVICE r3)
+    with pytest.raises(NotImplementedError, match="PipelineRunner"):
         mesh_from_parallel_config(ParallelConfig(pipeline_parallel_size=2))
     with pytest.raises(NotImplementedError, match="data-parallel"):
         mesh_from_parallel_config(ParallelConfig(data_parallel_size=2))

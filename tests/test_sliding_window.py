@@ -309,3 +309,32 @@ def test_windowed_engine_end_to_end(mistral_dir):
                 done[out.request_id] = out
     assert set(done) == {"sw-long"}
     assert len(done["sw-long"].outputs[0].token_ids) == 8
+
+
+def test_sliding_window_rejects_sequence_parallel(mistral_dir):
+    """sp > 1 routes prefill through ring attention, which carries no
+    band mask — a windowed model must fail at CONFIG time, not on the
+    first request (ADVICE r3: the trace-time check in ops/attention.py
+    let the server boot and then die crash-fast)."""
+    import pytest
+
+    from vllm_tgis_adapter_tpu.engine.config import (
+        CacheConfig,
+        EngineConfig,
+        LoRAConfig,
+        ModelConfig,
+        ParallelConfig,
+        SchedulerConfig,
+    )
+
+    mcfg = ModelConfig.from_pretrained(mistral_dir, dtype="float32")
+    assert mcfg.sliding_window > 0
+    with pytest.raises(ValueError, match="sliding-window"):
+        EngineConfig(
+            model_config=mcfg,
+            cache_config=CacheConfig(block_size=16, num_blocks=8,
+                                     cache_dtype=mcfg.dtype),
+            scheduler_config=SchedulerConfig(max_num_seqs=2),
+            parallel_config=ParallelConfig(sequence_parallel_size=2),
+            lora_config=LoRAConfig(),
+        )

@@ -69,6 +69,9 @@ class AsyncLLMEngine:
         self._replicas = [_Replica(e, i) for i, e in enumerate(engines)]
         self._owner: dict[str, _Replica] = {}
         self._queues: dict[str, asyncio.Queue] = {}
+        # request_ids whose abort() arrived while add_request was still
+        # in flight on the owner replica (see generate()/abort())
+        self._early_aborts: set[str] = set()
         self._dead_error: Optional[BaseException] = None
         self._stopped = False
         # periodic operational stats line (vLLM-style), unless
@@ -247,6 +250,12 @@ class AsyncLLMEngine:
         span = None
         if self._tracer is not None:
             span = self._tracer.start_span(request_id, trace_headers)
+        # owner is registered BEFORE the awaited admission critical
+        # section: an abort() arriving in that window must find the
+        # replica rather than silently no-op and leave the request
+        # generating until the consumer-gone reap
+        self._owner[request_id] = rep
+        aborted_out = None
         try:
             async with rep.lock:
                 rep.engine.add_request(
@@ -256,15 +265,29 @@ class AsyncLLMEngine:
                     prompt_token_ids=prompt_token_ids,
                     lora_name=getattr(lora_request, "name", None),
                 )
-        except Exception as e:
+                if request_id in self._early_aborts:
+                    # abort() ran before the engine knew the request; it
+                    # left a tombstone instead — honor it now, before a
+                    # single step is scheduled
+                    self._early_aborts.discard(request_id)
+                    aborted_out = rep.engine.abort_request(request_id)
+        except BaseException as e:
+            # BaseException, not Exception: a client disconnect lands
+            # here as CancelledError/GeneratorExit thrown into the
+            # generator while it waits for the replica lock — leaking
+            # the owner entry would make a later abort() plant a
+            # tombstone nothing ever clears
+            self._owner.pop(request_id, None)
             self._queues.pop(request_id, None)
+            self._early_aborts.discard(request_id)
             if span is not None:
                 # rejected admissions are precisely the requests tracing
                 # must not lose
                 span.attributes["error.type"] = type(e).__name__
                 self._tracer.finish_span(span, None)
             raise
-        self._owner[request_id] = rep
+        if aborted_out is not None:
+            queue.put_nowait(aborted_out)
         rep.new_work.set()
         final = None
         try:
@@ -279,6 +302,7 @@ class AsyncLLMEngine:
         finally:
             self._queues.pop(request_id, None)
             self._owner.pop(request_id, None)
+            self._early_aborts.discard(request_id)
             if span is not None:
                 self._tracer.finish_span(span, final)
 
@@ -288,6 +312,12 @@ class AsyncLLMEngine:
             return
         async with rep.lock:
             out = rep.engine.abort_request(request_id)
+            if out is None and request_id in self._owner:
+                # the owner exists but the engine does not know the
+                # request yet: generate() is between owner registration
+                # and add_request.  Leave a tombstone; generate() aborts
+                # the request immediately after admission.
+                self._early_aborts.add(request_id)
         queue = self._queues.get(request_id)
         if queue is not None and out is not None:
             queue.put_nowait(out)

@@ -27,6 +27,7 @@ from vllm_tgis_adapter_tpu.engine.sampling_params import (
 )
 from vllm_tgis_adapter_tpu.engine.scheduler import (
     DecodePlan,
+    PackedPrefillPlan,
     PrefillPlan,
     Scheduler,
 )
@@ -93,6 +94,20 @@ class LLMEngine:
             config.cache_config,
             config.cache_config.num_blocks,
             max_model_len=config.max_model_len,
+        )
+        # packed multi-prompt prefill needs the plain block-diagonal
+        # causal mask: no sliding window / ALiBi biases (both are
+        # position-offset-based), no pp stage plumbing, no sp ring, and
+        # no speculative draft mirroring (the draft prefill path is
+        # per-sequence)
+        mcfg = config.model_config
+        pcfg = config.parallel_config
+        self.scheduler.allow_packed = (
+            config.speculative is None
+            and pcfg.pipeline_parallel_size == 1
+            and pcfg.sequence_parallel_size == 1
+            and mcfg.sliding_window == 0
+            and mcfg.position_embedding != "alibi"
         )
         self._seqs: dict[str, Sequence] = {}
         self._lora_tokenizers: dict[str, object] = {}
@@ -163,6 +178,18 @@ class LLMEngine:
                 place = make_place_fn(mesh)
         logger.info("loading weights from %s", mcfg.model)
         params = load_model_params(mcfg, mcfg.model, place=place)
+        if config.quantization == "int8":
+            # weight-only int8 after (possibly sharded) load; the KV pool
+            # auto-sizing below sees the freed HBM.  The draft model (if
+            # any) stays in the model dtype: it is small by construction
+            # and its logits feed acceptance tests directly.
+            from vllm_tgis_adapter_tpu.engine.weights import (
+                quantize_params_int8,
+            )
+
+            params = quantize_params_int8(params)
+            logger.info("quantized projection weights to int8 "
+                        "(weight-only, per-out-channel scales)")
 
         # the draft loads BEFORE the engine so the KV-pool auto-sizing
         # (resolve_num_blocks, driven by post-weights free HBM) sees the
@@ -323,7 +350,15 @@ class LLMEngine:
         if plan is None:
             return outputs, None, None
 
-        if isinstance(plan, PrefillPlan):
+        if isinstance(plan, PackedPrefillPlan):
+            now = time.time()
+            for item in plan.items:
+                m = item.seq.metrics
+                if m.first_scheduled_time is None:
+                    m.first_scheduled_time = now
+                    m.time_in_queue = now - m.arrival_time
+            prepared = self.runner.prepare_packed_prefill(plan)
+        elif isinstance(plan, PrefillPlan):
             seq = plan.seq
             if seq.metrics.first_scheduled_time is None:
                 now = time.time()
@@ -337,6 +372,8 @@ class LLMEngine:
     def execute_step(self, plan, prepared):
         """Phase 2 (device, lock-free): runs only against the snapshot and
         runner-owned device state — never reads scheduler structures."""
+        if isinstance(plan, PackedPrefillPlan):
+            return self.runner.execute_packed_prefill(prepared)
         if isinstance(plan, PrefillPlan):
             return self.runner.execute_prefill(prepared)
         return self.runner.execute_decode(prepared)
@@ -344,6 +381,16 @@ class LLMEngine:
     def commit_step(self, plan, result, prepared=None) -> list[RequestOutput]:
         """Phase 3 (host, engine lock held): fold sampled tokens back into
         sequences; requests aborted mid-dispatch are skipped here."""
+        if isinstance(plan, PackedPrefillPlan):
+            seqs, toks = [], []
+            for item, tok in zip(plan.items, result):
+                seq = item.seq
+                if seq.is_finished:
+                    continue  # aborted while the packed dispatch ran
+                self.scheduler.register_prefix(seq)
+                seqs.append(seq)
+                toks.append([tok])
+            return self._process_sampled(seqs, toks)
         if isinstance(plan, PrefillPlan):
             seq = plan.seq
             sampled, prompt_info = result

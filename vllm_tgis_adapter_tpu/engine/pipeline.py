@@ -101,9 +101,10 @@ def make_pp_place_fn(config: "EngineConfig", devices=None):
             mesh = meshes[stage_of_layer(int(m.group(1)))]
         elif any(k in name for k in
                  ("embed_tokens", "embed_in", "embed_positions",
-                  "word_embeddings")):
+                  "word_embeddings", "wte", "wpe")):
             # word_embeddings also catches bloom's
-            # word_embeddings_layernorm — both live on stage 0
+            # word_embeddings_layernorm; wte/wpe are gpt2's token and
+            # learned-position embeddings — all live on stage 0
             mesh = meshes[0]
         else:  # lm_head / embed_out / decoder-level final norm
             mesh = meshes[-1]

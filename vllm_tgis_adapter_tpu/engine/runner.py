@@ -78,6 +78,30 @@ class PreparedPrefill:
 
 
 @dataclasses.dataclass
+class PreparedPackedPrefill:
+    """Host-built dispatch inputs for one packed multi-prompt prefill.
+
+    ``MAX_PACK`` fixed-width per-row arrays (segment starts, logits rows,
+    sampler tensors) keep one compile shape per token bucket regardless
+    of how many prompts were packed (engine/scheduler.py MAX_PACK).
+    """
+
+    bucket: int
+    num_items: int  # real packed prompts (<= MAX_PACK)
+    total_tokens: int  # real tokens across all segments
+    token_ids: "np.ndarray"  # [bucket] concatenated prompts
+    positions: "np.ndarray"  # [bucket] restarting at 0 per segment
+    slot_mapping: "np.ndarray"  # [bucket]
+    seg_starts: "np.ndarray"  # [MAX_PACK] flat start per segment (pad=bucket)
+    logits_indices: "np.ndarray"  # [MAX_PACK] last-token row (pad=0)
+    row_slots: "np.ndarray"  # [MAX_PACK] batch row per segment (pad=-1)
+    seen_tokens: "np.ndarray"  # [MAX_PACK, P] prompt ids for seen seeding
+    tensors: SamplingTensors  # MAX_PACK rows
+    allowed_mask: "Optional[np.ndarray]"  # [MAX_PACK, V] FSM rows or None
+    lora_slot: int  # shared by every packed prompt (scheduler invariant)
+
+
+@dataclasses.dataclass
 class PreparedDecode:
     """Host-built dispatch inputs for one fused K-step decode."""
 
@@ -547,6 +571,135 @@ class ModelRunner:
         self, plan: "PrefillPlan"
     ) -> tuple[Optional[SampledToken], Optional[PromptLogprobInfo]]:
         return self.execute_prefill(self.prepare_prefill(plan))
+
+    # -------------------------------------------------------- packed prefill
+
+    def prepare_packed_prefill(self, plan) -> "PreparedPackedPrefill":
+        """Host half for a multi-prompt packed prefill
+        (scheduler.PackedPrefillPlan): concatenate the prompts on the
+        token axis, record per-segment starts / sampling rows."""
+        from vllm_tgis_adapter_tpu.engine.scheduler import MAX_PACK
+
+        items = plan.items
+        bucket = plan.bucket_len
+        k = len(items)
+        token_ids = np.zeros(bucket, np.int32)
+        positions = np.zeros(bucket, np.int32)
+        slot_mapping = np.full(bucket, -1, np.int32)
+        seg_starts = np.full(MAX_PACK, bucket, np.int32)
+        logits_indices = np.zeros(MAX_PACK, np.int32)
+        row_slots = np.full(MAX_PACK, -1, np.int32)
+        seeds = np.zeros(MAX_PACK, np.uint32)
+        # one shared pad width (the largest item's seen bucket) so the
+        # whole pack seeds the seen matrix in ONE batched dispatch
+        pad = max(
+            self._seen_pad_len(len(it.seq.all_token_ids)) for it in items
+        )
+        seen_tokens = np.full((MAX_PACK, pad), -1, np.int32)
+        off = 0
+        for i, it in enumerate(items):
+            t = len(it.token_ids)
+            token_ids[off : off + t] = it.token_ids
+            positions[off : off + t] = np.arange(t, dtype=np.int32)
+            slot_mapping[off : off + t] = it.slots
+            seg_starts[i] = off
+            logits_indices[i] = off + t - 1
+            row_slots[i] = it.seq.slot
+            seeds[i] = it.seq.fallback_seed
+            all_ids = it.seq.all_token_ids
+            seen_tokens[i, : len(all_ids)] = all_ids
+            off += t
+
+        params_list = [it.seq.params for it in items] + [None] * (
+            MAX_PACK - k
+        )
+        gen_lens = [it.seq.num_output_tokens for it in items] + [0] * (
+            MAX_PACK - k
+        )
+        tensors = SamplingTensors.from_params(
+            params_list,
+            eos_token_id=self.config.model_config.eos_token_id,
+            gen_lens=gen_lens,
+            fallback_seeds=seeds,
+        )
+
+        allowed_mask = None
+        if any(it.seq.fsm is not None for it in items):
+            vocab = self.config.model_config.vocab_size
+            allowed_mask = np.ones((MAX_PACK, vocab), bool)
+            for i, it in enumerate(items):
+                if it.seq.fsm is not None:
+                    row = it.seq.fsm.allowed_row(it.seq.fsm_state)
+                    allowed_mask[i, : len(row)] = row
+                    allowed_mask[i, len(row):] = False
+
+        return PreparedPackedPrefill(
+            bucket=bucket,
+            num_items=k,
+            total_tokens=off,
+            token_ids=token_ids,
+            positions=positions,
+            slot_mapping=slot_mapping,
+            seg_starts=seg_starts,
+            logits_indices=logits_indices,
+            row_slots=row_slots,
+            seen_tokens=seen_tokens,
+            tensors=tensors,
+            allowed_mask=allowed_mask,
+            lora_slot=items[0].seq.lora_slot,
+        )
+
+    def execute_packed_prefill(
+        self, prep: "PreparedPackedPrefill"
+    ) -> list[SampledToken]:
+        """Device half: ONE forward over the packed bucket (block-diagonal
+        causal mask via seg_starts), then the batched sampler over the
+        MAX_PACK last-token rows.  Returns one SampledToken per real
+        packed prompt, in pack order."""
+        lora_args = ()
+        if self.lora_stacks is not None:
+            lora_args = (
+                self.lora_stacks,
+                self._put(np.asarray(prep.lora_slot, np.int32)),
+            )
+        logits, self.caches = self._prefill_fn(
+            self.params,
+            self.caches,
+            self._put(prep.token_ids),
+            self._put(prep.positions),
+            self._put(prep.slot_mapping),
+            self._put(np.asarray(prep.total_tokens, np.int32)),
+            self._put(prep.logits_indices),
+            *lora_args,
+            seg_starts=self._put(prep.seg_starts),
+        )
+        self.seen = sampler_mod.set_seen_rows(
+            self.seen,
+            self._put(prep.row_slots),
+            self._put(prep.seen_tokens),
+        )
+        seen_rows = jnp.take(
+            self.seen,
+            jnp.clip(self._put(prep.row_slots), 0, None),
+            axis=0,
+        )
+        out = sampler_mod.sample(
+            logits,
+            seen_rows,
+            jax.tree.map(self._put, prep.tensors),
+            allowed_mask=(
+                self._put(prep.allowed_mask)
+                if prep.allowed_mask is not None
+                else None
+            ),
+        )
+        self.seen = sampler_mod.update_seen(
+            self.seen, self._put(prep.row_slots), out.tokens
+        )
+        host = _HostSamplerOutput.from_device(
+            jax.tree.map(lambda x: x[None], out)
+        )
+        return [host.token(0, i) for i in range(prep.num_items)]
 
     # ---------------------------------------------------------------- decode
 

@@ -270,6 +270,25 @@ def set_seen_row(seen: jax.Array, row: jax.Array, token_ids: jax.Array) -> jax.A
 
 
 @jax.jit
+def set_seen_rows(
+    seen: jax.Array,  # [max_seqs, V]
+    rows: jax.Array,  # [K] batch rows; -1 entries are dropped
+    token_ids: jax.Array,  # [K, P] padded prompt tokens (-1 pads)
+) -> jax.Array:
+    """Batched ``set_seen_row``: seed K rows in ONE dispatch (packed
+    prefill seeds every packed prompt's row; K sequential calls would
+    copy the full seen matrix K times)."""
+    k = rows.shape[0]
+    v = seen.shape[1]
+    clipped = jnp.where(token_ids < 0, v, token_ids)  # [K, P]
+    row_vecs = jnp.zeros((k, v), bool).at[
+        jnp.arange(k)[:, None], clipped
+    ].set(True, mode="drop")
+    safe_rows = jnp.where(rows < 0, seen.shape[0], rows)
+    return seen.at[safe_rows].set(row_vecs, mode="drop")
+
+
+@jax.jit
 def prompt_logprob_info(
     logits: jax.Array,  # [T, V] prefill logits (row i predicts token i+1)
     token_ids: jax.Array,  # [T] the prompt tokens

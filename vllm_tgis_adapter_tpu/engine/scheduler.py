@@ -42,6 +42,29 @@ class PrefillPlan:
     is_final: bool = True
 
 
+# Max prompts per packed prefill dispatch.  Bounds the scalar-prefetched
+# segment-start vector (a static kernel shape) and the fixed sampler row
+# count, so packing adds no compile-shape variance beyond the buckets.
+MAX_PACK = 8
+
+
+@dataclasses.dataclass
+class PackedPrefillPlan:
+    """Several whole prompts concatenated into ONE prefill dispatch.
+
+    The reference's engine batches waiting prompts into a single forward
+    (vLLM continuous batching, consumed at
+    /root/reference/src/vllm_tgis_adapter/grpc/grpc_server.py:205-225);
+    the TPU-native equivalent packs them along the token axis of one
+    compile bucket with a block-diagonal causal mask (segment starts ride
+    scalar prefetch — ops/pallas_attention.py), so k short prompts cost
+    one dispatch + one bucket fill instead of k.
+    """
+
+    items: list[PrefillPlan]  # ≥2, each whole-prompt (start_pos=0, final)
+    bucket_len: int  # compile bucket for the concatenated token axis
+
+
 @dataclasses.dataclass
 class DecodePlan:
     seqs: list[Sequence]  # active rows, in slot order
@@ -92,6 +115,10 @@ class Scheduler:
             max(scheduler_config.prefill_buckets),
         )
         self._last_was_prefill = False
+        # packed (multi-prompt) prefill: flipped on by the engine when the
+        # model/parallel mode supports the block-diagonal mask (plain
+        # causal attention, no pp/sp, no speculative draft mirroring)
+        self.allow_packed = False
 
     # ------------------------------------------------------------ bookkeeping
 
@@ -167,9 +194,95 @@ class Scheduler:
         plan = self._try_schedule_prefill()
         if plan is not None:
             self._last_was_prefill = True
+            if self._packable(plan):
+                packed = self._extend_pack(plan)
+                if packed is not None:
+                    return packed
             return plan
         self._last_was_prefill = False
         return self._schedule_decode()
+
+    def _packable(self, plan: PrefillPlan) -> bool:
+        return (
+            self.allow_packed
+            and plan.start_pos == 0
+            and plan.is_final
+            and plan.seq.params.prompt_logprobs is None
+        )
+
+    def _extend_pack(self, head: PrefillPlan) -> Optional[PackedPrefillPlan]:
+        """Greedily append more waiting whole prompts to ``head``'s
+        dispatch while the concatenated tokens still fit a compile bucket
+        and the token budget, slots and pages allow.  Later waiting
+        requests may jump an unpackable one (standard continuous-batching
+        reordering); each appended sequence is admitted exactly like a
+        solo prefill (slot + pages), so abort/preempt handling downstream
+        is unchanged.
+
+        Deliberately NOT shared with _try_schedule_prefill's admission:
+        the queue HEAD must handle failure modes (chunking, rollback,
+        pool-empty rejection, prefix adoption with hit accounting) —
+        a pack CANDIDATE simply skips on any of those and stays queued
+        for the solo path to deal with when it reaches the head.  The
+        two follow different policies, not a drifted copy of one."""
+        items = [head]
+        total = len(head.token_ids)
+        for seq in list(self.waiting):
+            if len(items) >= MAX_PACK:
+                break
+            if (
+                seq.prefill_pos != 0
+                or seq.blocks is not None  # mid-chunk: holds pages already
+                or seq.params.prompt_logprobs is not None
+                or seq.lora_slot != head.seq.lora_slot
+                or not self._free_slots
+            ):
+                continue
+            token_ids = seq.all_token_ids
+            new_total = total + len(token_ids)
+            if (
+                new_total > self.chunk_budget
+                or self._prefill_bucket(new_total) is None
+            ):
+                continue
+            if self.allocator.enable_prefix_caching:
+                hit_blocks, matched = self.allocator.match_prefix(
+                    token_ids, seq.lora_name
+                )
+                if matched:
+                    # cache hit: the solo path admits it with the pages
+                    # adopted (start_pos > 0) — packing would re-prefill
+                    # the matched span.  The probe refcounted the hit
+                    # pages (match_prefix contract); undo it or they pin
+                    # forever
+                    self.allocator.free(hit_blocks)
+                    continue
+            needed = self.allocator.blocks_needed(len(token_ids))
+            if not self.allocator.can_allocate(needed):
+                continue
+            seq.blocks = SequenceBlocks(self.allocator)
+            seq.blocks.ensure_capacity(len(token_ids))
+            seq.slot = self._free_slots.pop()
+            self.waiting.remove(seq)
+            seq.status = SequenceStatus.RUNNING
+            self.running.append(seq)
+            items.append(
+                PrefillPlan(
+                    seq=seq,
+                    bucket_len=0,  # the pack bucket is shared (below)
+                    token_ids=list(token_ids),
+                    slots=seq.blocks.slots_for_range(0, len(token_ids)),
+                    start_pos=0,
+                    is_final=True,
+                )
+            )
+            seq.prefill_pos = len(token_ids)
+            total = new_total
+        if len(items) < 2:
+            return None
+        return PackedPrefillPlan(
+            items=items, bucket_len=self._prefill_bucket(total)
+        )
 
     def _chunkable(self, seq: Sequence) -> bool:
         # prompt-logprob requests need one pass over the whole prompt (the

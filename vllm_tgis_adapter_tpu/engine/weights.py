@@ -485,3 +485,59 @@ def load_model_params(
     if config.model_type == "gpt2":
         return load_gpt2_params(config, model_path, place)
     return load_llama_params(config, model_path, place)
+
+
+# ------------------------------------------------------------- quantization
+
+# Per-layer 2-D projection weights eligible for weight-only int8 (the
+# decode-phase HBM bandwidth dominators).  Embeddings, lm_head, norms,
+# biases and the mixtral expert stacks stay in the model dtype: the first
+# two feed gather/logits numerics, the rest are small.
+INT8_QUANT_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+def _quantize_int8(w: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Symmetric per-output-channel int8: w ≈ q8 · scale[out].
+
+    The scale factors out of the contraction over the in dim, so
+    ``(x @ q8) * scale`` reproduces ``x @ w`` exactly up to the rounding
+    step — the standard weight-only scheme the reference gets from
+    vLLM's quantization engine (consumed via
+    /root/reference/src/vllm_tgis_adapter/tgis_utils/args.py:127-136).
+    """
+    wf = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(wf), axis=0)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0).astype(jnp.float32)
+    q = jnp.clip(jnp.round(wf / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def quantize_params_int8(params: dict) -> dict:
+    """Replace eligible projection leaves with ``{key}_q8`` + ``{key}_scale``
+    pairs (models/llama.py ``linear`` consumes either representation).
+
+    Runs after (possibly sharded) load: each int8 leaf keeps its source
+    weight's mesh placement, and the [out] scale vector takes the
+    weight's out-axis spec, so Megatron TP semantics are unchanged.
+    Memory drops ~2× (bf16) / ~4× (f32) for the quantized leaves, and
+    the KV-pool auto-sizing (kv_cache.resolve_num_blocks) sees the
+    savings because it reads free HBM after weights are resident.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    for layer in params.get("layers", []):
+        for key in INT8_QUANT_KEYS:
+            w = layer.pop(key, None)
+            if w is None:
+                continue
+            q, scale = _quantize_int8(w)
+            sh = getattr(w, "sharding", None)
+            if isinstance(sh, NamedSharding):
+                q = jax.device_put(q, sh)
+                out_axis = sh.spec[1] if len(sh.spec) > 1 else None
+                scale = jax.device_put(
+                    scale, NamedSharding(sh.mesh, PartitionSpec(out_axis))
+                )
+            layer[key + "_q8"] = q
+            layer[key + "_scale"] = scale
+    return params

@@ -107,6 +107,21 @@ def apply_rotary(
     return out.astype(x.dtype)
 
 
+def linear(layer: dict, name: str, x: jax.Array) -> jax.Array:
+    """``x @ layer[name]``, transparently consuming weight-only int8
+    leaves (engine/weights.py quantize_params_int8): the int8 → x.dtype
+    cast rides into the matmul (MXU bf16 in, f32 accumulate; int8 values
+    are exact in bf16) and the per-out-channel scale is one fused
+    elementwise multiply on the output."""
+    q = layer.get(name + "_q8")
+    if q is None:
+        return x @ layer[name]
+    y = x @ q.astype(x.dtype)
+    return (
+        y.astype(jnp.float32) * layer[name + "_scale"]
+    ).astype(x.dtype)
+
+
 def _lora_delta_single(lora, layer: int, slot, target: str, x: jax.Array):
     """LoRA delta for one sequence (scalar adapter slot): x @ A @ B · s."""
     a_l = lora.a[target][layer][slot]  # [din, r]
@@ -295,7 +310,7 @@ class LlamaForCausalLM:
         q, k = self._apply_pos_qk(q, k, rope)
         o = attend(q, k, v)
         o_flat = o.reshape(x.shape[0], -1)
-        o = o_flat @ layer["wo"]
+        o = linear(layer, "wo", o_flat)
         if "bo" in layer:
             o = o + layer["bo"]
         if dl is not None:
@@ -314,9 +329,9 @@ class LlamaForCausalLM:
     def _qkv(self, layer: dict, x: jax.Array, dl=None) -> tuple[jax.Array, ...]:
         cfg = self.config
         t = x.shape[0]
-        q = x @ layer["wq"]
-        k = x @ layer["wk"]
-        v = x @ layer["wv"]
+        q = linear(layer, "wq", x)
+        k = linear(layer, "wk", x)
+        v = linear(layer, "wv", x)
         if dl is not None:  # LoRA deltas share the projection input
             q = q + dl("q_proj", x)
             k = k + dl("k_proj", x)
@@ -337,25 +352,25 @@ class LlamaForCausalLM:
         act = _ACTIVATIONS[self.config.hidden_act]
         if not self.config.gated_mlp:
             # fc1 → act → fc2 (OPT lineage), biases optional
-            h = x @ layer["w_up"]
+            h = linear(layer, "w_up", x)
             if "b_up" in layer:
                 h = h + layer["b_up"]
             if dl is not None:
                 h = h + dl("up_proj", x)
             h = act(h)
-            out = h @ layer["w_down"]
+            out = linear(layer, "w_down", h)
             if "b_down" in layer:
                 out = out + layer["b_down"]
             if dl is not None:
                 out = out + dl("down_proj", h)
             return out
-        gate = x @ layer["w_gate"]
-        up = x @ layer["w_up"]
+        gate = linear(layer, "w_gate", x)
+        up = linear(layer, "w_up", x)
         if dl is not None:
             gate = gate + dl("gate_proj", x)
             up = up + dl("up_proj", x)
         h = act(gate) * up
-        out = h @ layer["w_down"]
+        out = linear(layer, "w_down", h)
         if dl is not None:
             out = out + dl("down_proj", h)
         return out
@@ -441,6 +456,7 @@ class LlamaForCausalLM:
         lora=None,  # LoRAStacks (engine/lora.py) or None
         lora_slot: jax.Array | None = None,  # scalar adapter slot
         *,
+        seg_starts: jax.Array | None = None,  # [max_segs] packed prefill
         hidden: jax.Array | None = None,  # [T, d] from the previous pp stage
         first_stage: bool = True,  # embed input tokens here
         last_stage: bool = True,  # apply final norm + lm_head here
@@ -450,6 +466,11 @@ class LlamaForCausalLM:
         Pipeline parallelism: a non-first stage takes ``hidden`` instead
         of embedding ``token_ids``; a non-last stage returns the raw
         hidden states for the next stage instead of logits.
+
+        Packed (batched) prefill: with ``seg_starts`` the token axis
+        carries several concatenated prompts; ``positions`` restarts at 0
+        per segment (so RoPE/learned embeddings are per-prompt) and
+        attention is block-diagonal causal (ops/attention.py).
 
         Returns logits only at ``logits_indices`` (default: every position).
         Restricting to the sampled row avoids materialising a ``[T, vocab]``
@@ -476,6 +497,7 @@ class LlamaForCausalLM:
                 q, k, v, scale, valid_len, mesh=self.mesh,
                 window=self._window_for_layer(i),
                 alibi_slopes=self.alibi,
+                seg_starts=seg_starts,
             )
 
         x = (

@@ -88,6 +88,7 @@ def prefill_attention(
     mesh=None,
     window: int = 0,
     alibi_slopes: jax.Array | None = None,  # [H] f32 (bloom lineage)
+    seg_starts: jax.Array | None = None,  # [max_segs] i32 packed-prefill
 ) -> jax.Array:
     """Dispatch: flash Pallas kernel on TPU, XLA fallback elsewhere.
 
@@ -97,6 +98,13 @@ def prefill_attention(
     Under an sp mesh axis > 1 the sequence axis is sharded instead and
     K/V chunks rotate around the ring (ops/ring_attention.py) — the
     long-context path.
+
+    ``seg_starts`` enables packed (batched) prefill: several prompts are
+    concatenated along the token axis and ``seg_starts[b]`` is the flat
+    start index of segment b (entry 0 is 0; unused entries pad with T).
+    Queries then attend only within their own segment (block-diagonal
+    causal mask).  The scheduler only packs on the plain causal path, so
+    seg_starts never combines with window/ALiBi/sp.
     """
     if mesh is not None and dict(mesh.shape).get("sp", 1) > 1 and (
         window > 0 or alibi_slopes is not None
@@ -105,6 +113,16 @@ def prefill_attention(
             "sliding-window / ALiBi attention does not compose with "
             "--sequence-parallel-size > 1 yet (ring attention carries "
             "neither the band mask nor position biases)"
+        )
+    if seg_starts is not None and (
+        window > 0
+        or alibi_slopes is not None
+        or (mesh is not None and dict(mesh.shape).get("sp", 1) > 1)
+    ):
+        raise NotImplementedError(
+            "packed prefill (seg_starts) composes only with plain causal "
+            "attention — the scheduler must not pack windowed/ALiBi/sp "
+            "requests (engine/scheduler.py allow_packed)"
         )
     if mesh is not None and dict(mesh.shape).get("sp", 1) > 1:
         from vllm_tgis_adapter_tpu.ops.ring_attention import (
@@ -140,20 +158,29 @@ def prefill_attention(
             operands = [q, k, v, vl]
             specs = [heads, heads, heads, P()]
             if alibi_slopes is not None:
-                operands.append(alibi_slopes)
-                specs.append(P("tp"))
+                operands.append(("alibi", alibi_slopes, P("tp")))
+            if seg_starts is not None:
+                operands.append(("segs", seg_starts, P()))
+            tagged = [op for op in operands if isinstance(op, tuple)]
+            operands = operands[:4] + [op[1] for op in tagged]
+            specs = specs + [op[2] for op in tagged]
+            names = [op[0] for op in tagged]
 
             def wrapped(q, k, v, vl, *rest):
+                by_name = dict(zip(names, rest))
                 return kernel(q, k, v, valid_len=vl,
-                              alibi_slopes=rest[0] if rest else None)
+                              alibi_slopes=by_name.get("alibi"),
+                              seg_starts=by_name.get("segs"))
 
             return shard_map(
                 wrapped, mesh=mesh, in_specs=tuple(specs),
                 out_specs=heads, check_vma=False,
             )(*operands)
-        return kernel(q, k, v, valid_len=vl, alibi_slopes=alibi_slopes)
+        return kernel(q, k, v, valid_len=vl, alibi_slopes=alibi_slopes,
+                      seg_starts=seg_starts)
     return prefill_attention_xla(q, k, v, scale, valid_len, window=window,
-                                 alibi_slopes=alibi_slopes)
+                                 alibi_slopes=alibi_slopes,
+                                 seg_starts=seg_starts)
 
 
 def prefill_attention_xla(
@@ -164,6 +191,7 @@ def prefill_attention_xla(
     valid_len: jax.Array | None = None,  # scalar int: tokens < valid_len attend
     window: int = 0,  # >0: attend to at most the previous `window` tokens
     alibi_slopes: jax.Array | None = None,  # [H] f32 per-head bias slopes
+    seg_starts: jax.Array | None = None,  # [max_segs] i32 packed-prefill starts
 ) -> jax.Array:
     """Causal self-attention over a single (padded) prompt.
 
@@ -171,6 +199,12 @@ def prefill_attention_xla(
     through the math (static shapes) but their K/V are masked out for real
     tokens' queries via the causal mask, and their own outputs are discarded
     by the caller.
+
+    With ``seg_starts`` (packed prefill) the mask is block-diagonal
+    causal: token p belongs to segment ``sum(p >= seg_starts) `` and only
+    attends within it.  Padding tokens land in the last segment, but
+    their keys are already excluded by valid_len and their query rows are
+    discarded by the caller.
     """
     t, num_heads, head_dim = q.shape
     num_kv = k.shape[1]
@@ -197,6 +231,12 @@ def prefill_attention_xla(
         # convention — the diagonal plus window-1 predecessors)
         offsets = jnp.arange(t)[:, None] - jnp.arange(t)[None, :]
         mask = mask & (offsets < window)
+    if seg_starts is not None:
+        # segment of token p = how many segment starts are <= p
+        seg = (
+            jnp.arange(t)[:, None] >= seg_starts[None, :].astype(jnp.int32)
+        ).sum(axis=1)
+        mask = mask & (seg[:, None] == seg[None, :])
     if valid_len is not None:
         mask = mask & (jnp.arange(t) < valid_len)[None, :]
     scores = jnp.where(mask[None, None], scores, NEG_INF)

@@ -442,6 +442,8 @@ def chunked_prefill_attention(
 def _prefill_kernel(
     valid_len_ref,  # [1] SMEM scalar prefetch
     alibi_ref,  # [H] f32 SMEM slopes; unused unless use_alibi
+    seg_ref,  # [max_segs] i32 SMEM packed-segment starts; unused unless
+    #           use_segs (then entry 0 is 0, unused entries pad with T)
     q_ref,  # [1, bq, Dh]
     k_ref,  # [1, bk, Dh] (kv head h, key block j)
     v_ref,  # [1, bk, Dh]
@@ -455,6 +457,8 @@ def _prefill_kernel(
     block_k: int,
     window: int,
     use_alibi: bool,
+    use_segs: bool,
+    max_segs: int,
 ):
     h = pl.program_id(0)  # query head
     i = pl.program_id(1)  # query block
@@ -475,6 +479,19 @@ def _prefill_kernel(
     live = (j * block_k <= i * block_q + block_q - 1) & (j * block_k < valid)
     if window > 0:
         live &= (j + 1) * block_k > i * block_q - window + 1
+    if use_segs:
+        # packed prefill: skip key blocks that end before this query
+        # block's first segment begins — with the causal skip above this
+        # prunes whole-block work down to ~sum(len_i^2) over segments.
+        # seg(p) = number of segment starts <= p (scalar SMEM reads).
+        row_lo = i * block_q
+        col_hi = j * block_k + block_k - 1
+        seg_row_lo = jnp.int32(0)
+        seg_col_hi = jnp.int32(0)
+        for b in range(max_segs):
+            seg_row_lo += (row_lo >= seg_ref[b]).astype(jnp.int32)
+            seg_col_hi += (col_hi >= seg_ref[b]).astype(jnp.int32)
+        live &= seg_col_hi >= seg_row_lo
 
     @pl.when(live)
     def _block():
@@ -496,6 +513,14 @@ def _prefill_kernel(
         keep = (cols <= rows) & (cols < valid)
         if window > 0:
             keep &= rows - cols < window
+        if use_segs:
+            # block-diagonal mask: query and key must share a segment
+            seg_q = jnp.zeros(rows.shape, jnp.int32)
+            seg_k = jnp.zeros(cols.shape, jnp.int32)
+            for b in range(max_segs):
+                seg_q += (rows >= seg_ref[b]).astype(jnp.int32)
+                seg_k += (cols >= seg_ref[b]).astype(jnp.int32)
+            keep &= seg_q == seg_k
         s = jnp.where(keep, s, NEG_INF)
 
         m_prev = m_ref[...]
@@ -533,6 +558,7 @@ def prefill_attention(
     block_k: int = 128,
     window: int = 0,  # >0: band mask, rows - cols < window
     alibi_slopes: jax.Array | None = None,  # [H] f32 (bloom lineage)
+    seg_starts: jax.Array | None = None,  # [max_segs] i32 packed starts
     interpret: bool = False,
 ) -> jax.Array:
     """Flash causal self-attention over one padded prompt bucket.
@@ -540,6 +566,12 @@ def prefill_attention(
     GQA is handled by repeating K/V heads logically: the grid runs over
     *query* heads and the K/V BlockSpec maps query head → kv head, so no
     repeated K/V materialisation in HBM.
+
+    ``seg_starts`` turns the mask block-diagonal for packed prefill (see
+    ops/attention.py prefill_attention): k prompts concatenated on the
+    token axis, each attending only within its own segment.  The starts
+    ride scalar prefetch (SMEM) like the block tables do elsewhere, so
+    the mask and the block-skip test are scalar reads, not HBM gathers.
     """
     t, num_heads, head_dim = q.shape
     num_kv = k.shape[1]
@@ -558,26 +590,32 @@ def prefill_attention(
         if alibi_slopes is None
         else alibi_slopes.astype(jnp.float32)
     )
+    use_segs = seg_starts is not None
+    segs = (
+        jnp.zeros(1, jnp.int32)
+        if seg_starts is None
+        else seg_starts.astype(jnp.int32)
+    )
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=3,
         grid=(num_heads, nq, nk),
         in_specs=[
             pl.BlockSpec(
                 (1, block_q, head_dim),
-                lambda h, i, j, vl, al: (h, i, 0),
+                lambda h, i, j, vl, al, sg: (h, i, 0),
             ),
             pl.BlockSpec(
                 (1, block_k, head_dim),
-                lambda h, i, j, vl, al: (h // g, j, 0),
+                lambda h, i, j, vl, al, sg: (h // g, j, 0),
             ),
             pl.BlockSpec(
                 (1, block_k, head_dim),
-                lambda h, i, j, vl, al: (h // g, j, 0),
+                lambda h, i, j, vl, al, sg: (h // g, j, 0),
             ),
         ],
         out_specs=pl.BlockSpec(
             (1, block_q, head_dim),
-            lambda h, i, j, vl, al: (h, i, 0),
+            lambda h, i, j, vl, al, sg: (h, i, 0),
         ),
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -590,9 +628,10 @@ def prefill_attention(
             _prefill_kernel, scale=scale, block_q=block_q,
             block_k=block_k, window=window,
             use_alibi=alibi_slopes is not None,
+            use_segs=use_segs, max_segs=int(segs.shape[0]),
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((num_heads, t, head_dim), q.dtype),
         interpret=interpret,
-    )(jnp.asarray([valid_len], jnp.int32), slopes, qh, kh, vh)
+    )(jnp.asarray([valid_len], jnp.int32), slopes, segs, qh, kh, vh)
     return jnp.swapaxes(out, 0, 1)

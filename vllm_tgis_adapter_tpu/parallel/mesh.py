@@ -111,8 +111,10 @@ def mesh_from_parallel_config(pcfg, devices=None) -> Mesh | None:
     """
     if pcfg.pipeline_parallel_size > 1:
         raise NotImplementedError(
-            "--pipeline-parallel-size > 1 is not implemented yet; "
-            "use --tensor-parallel-size to scale within a slice"
+            "this function builds the mesh for a single non-pipelined "
+            "replica; LLMEngine routes pipeline_parallel_size > 1 "
+            "through engine/pipeline.py (PipelineRunner), which builds "
+            "one mesh per stage itself"
         )
     if pcfg.data_parallel_size > 1:
         raise NotImplementedError(

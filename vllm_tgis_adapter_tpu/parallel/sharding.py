@@ -117,10 +117,20 @@ def llama_param_specs(params: dict, tp: int = 1) -> dict:
             num_experts = layer["experts_gate"].shape[0]
             if tp > 1 and num_experts % tp == 0:
                 expert_specs = _EXPERT_EP_SPECS
-        return {
-            name: expert_specs.get(name) or _LAYER_SPECS[name]
-            for name in layer
-        }
+
+        def spec_of(name: str) -> P:
+            # weight-only int8 leaves (engine/weights.py): the q8 matrix
+            # keeps its source weight's spec; the [out] scale vector
+            # follows the weight's out axis (tp-split for column-parallel
+            # weights, replicated for row-parallel ones)
+            if name.endswith("_q8"):
+                name = name[: -len("_q8")]
+            elif name.endswith("_scale"):
+                base = _LAYER_SPECS[name[: -len("_scale")]]
+                return P(base[1] if len(base) > 1 else None)
+            return expert_specs.get(name) or _LAYER_SPECS[name]
+
+        return {name: spec_of(name) for name in layer}
 
     specs["layers"] = [layer_spec(layer) for layer in params["layers"]]
     return specs

@@ -159,8 +159,10 @@ def add_engine_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                    help="KV-cache storage dtype")
     g.add_argument("--quantization", type=str, default=None,
                    choices=["int8", "awq", "gptq", "squeezellm"],
-                   help="weight quantization scheme (int8 native; others "
-                        "reserved)")
+                   help="weight quantization scheme: int8 is native "
+                        "(weight-only, per-channel, quantized on load); "
+                        "awq/gptq/squeezellm are accepted for CLI compat "
+                        "but rejected at engine boot until implemented")
     g.add_argument("--max-model-len", type=int, default=None,
                    help="model context length; derived from the model config "
                         "if unset")
