@@ -128,8 +128,8 @@ def test_packed_greedy_matches_solo(tiny_model_dir):
     packed_plans = []
     orig_schedule = engine.scheduler.schedule
 
-    def spy():
-        plan = orig_schedule()
+    def spy(**kwargs):
+        plan = orig_schedule(**kwargs)
         if isinstance(plan, PackedPrefillPlan):
             packed_plans.append(plan)
         return plan
@@ -200,8 +200,8 @@ def test_prompt_logprob_requests_never_pack(tiny_model_dir):
     plans = []
     orig_schedule = engine.scheduler.schedule
 
-    def spy():
-        plan = orig_schedule()
+    def spy(**kwargs):
+        plan = orig_schedule(**kwargs)
         plans.append(plan)
         return plan
 

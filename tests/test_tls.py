@@ -1,0 +1,216 @@
+"""TLS / mTLS end-to-end over the real gRPC server.
+
+The reference's test client was built for this path
+(/root/reference/tests/utils.py:76-130: _make_tls_channel /
+_make_mtls_channel); here the dual-server stack boots with generated
+certs and the TLS-capable GrpcClient (tests/utils.py) drives it:
+
+* TLS: server cert signed by a test CA; client verifies via the CA.
+* mTLS (--ssl-ca-certs set): a cert-less client must be REJECTED at the
+  handshake; a client presenting a CA-signed cert succeeds.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import datetime
+import threading
+from contextlib import suppress
+
+import grpc
+import pytest
+
+
+def _make_cert(subject_name: str, issuer_key=None, issuer_cert=None,
+               *, is_ca: bool = False):
+    """(key_pem, cert_pem, key_obj, cert_obj) — self-signed when no issuer."""
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    name = x509.Name(
+        [x509.NameAttribute(NameOID.COMMON_NAME, subject_name)]
+    )
+    issuer = issuer_cert.subject if issuer_cert is not None else name
+    signing_key = issuer_key if issuer_key is not None else key
+    now = datetime.datetime.now(datetime.timezone.utc)
+    builder = (
+        x509.CertificateBuilder()
+        .subject_name(name)
+        .issuer_name(issuer)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=5))
+        .not_valid_after(now + datetime.timedelta(hours=2))
+        .add_extension(
+            x509.BasicConstraints(ca=is_ca, path_length=None),
+            critical=True,
+        )
+    )
+    if not is_ca:
+        builder = builder.add_extension(
+            x509.SubjectAlternativeName([x509.DNSName("localhost")]),
+            critical=False,
+        )
+    cert = builder.sign(signing_key, hashes.SHA256())
+    key_pem = key.private_bytes(
+        serialization.Encoding.PEM,
+        serialization.PrivateFormat.TraditionalOpenSSL,
+        serialization.NoEncryption(),
+    )
+    cert_pem = cert.public_bytes(serialization.Encoding.PEM)
+    return key_pem, cert_pem, key, cert
+
+
+@pytest.fixture(scope="module")
+def tls_material(tmp_path_factory):
+    d = tmp_path_factory.mktemp("tls")
+    ca_key_pem, ca_cert_pem, ca_key, ca_cert = _make_cert(
+        "test-ca", is_ca=True
+    )
+    srv_key_pem, srv_cert_pem, _, _ = _make_cert(
+        "localhost", issuer_key=ca_key, issuer_cert=ca_cert
+    )
+    cli_key_pem, cli_cert_pem, _, _ = _make_cert(
+        "test-client", issuer_key=ca_key, issuer_cert=ca_cert
+    )
+    paths = {}
+    for name, blob in (
+        ("ca.crt", ca_cert_pem),
+        ("server.key", srv_key_pem),
+        ("server.crt", srv_cert_pem),
+        ("client.key", cli_key_pem),
+        ("client.crt", cli_cert_pem),
+    ):
+        p = d / name
+        p.write_bytes(blob)
+        paths[name] = str(p)
+    paths["ca_pem"] = ca_cert_pem
+    paths["client_key_pem"] = cli_key_pem
+    paths["client_cert_pem"] = cli_cert_pem
+    return paths
+
+
+def _boot_servers(args):
+    """Start the dual-server stack in a thread; return (loop, thread)."""
+    from vllm_tgis_adapter_tpu.__main__ import start_servers
+
+    loop = asyncio.new_event_loop()
+
+    def target() -> None:
+        asyncio.set_event_loop(loop)
+        task = loop.create_task(start_servers(args))
+        with suppress(asyncio.CancelledError):
+            loop.run_until_complete(task)
+
+    thread = threading.Thread(target=target, daemon=True)
+    thread.start()
+    return loop, thread
+
+
+def _stop_servers(loop, thread) -> None:
+    def cancel_all() -> None:
+        for task in asyncio.all_tasks(loop):
+            task.cancel()
+
+    loop.call_soon_threadsafe(cancel_all)
+    thread.join(timeout=60)
+    if not loop.is_closed():
+        loop.close()
+
+
+def _server_args(tiny_model_dir, tmp_path, tls_material, *, mtls: bool):
+    from tests.utils import get_random_port
+
+    from vllm_tgis_adapter_tpu.tgis_utils.args import (
+        make_parser,
+        postprocess_tgis_args,
+    )
+
+    argv = [
+        "--model", tiny_model_dir,
+        "--max-model-len", "512",
+        "--dtype", "float32",
+        "--grpc-port", str(get_random_port()),
+        "--port", str(get_random_port()),
+        "--max-num-seqs", "4",
+        "--adapter-cache", str(tmp_path / "adapters"),
+        "--ssl-keyfile", tls_material["server.key"],
+        "--ssl-certfile", tls_material["server.crt"],
+    ]
+    if mtls:
+        argv += ["--ssl-ca-certs", tls_material["ca.crt"]]
+    return postprocess_tgis_args(make_parser().parse_args(argv))
+
+
+def _wait_tls_healthy(port, tls_material, *, with_client_cert: bool):
+    from tests.utils import GrpcClient, wait_until
+
+    def healthy() -> bool:
+        kwargs = dict(insecure=False, ca_cert=tls_material["ca_pem"])
+        if with_client_cert:
+            kwargs.update(
+                client_cert=tls_material["client_cert_pem"],
+                client_key=tls_material["client_key_pem"],
+            )
+        with GrpcClient("localhost", port, **kwargs) as client:
+            return client.health_check()
+
+    wait_until(healthy, timeout=300)
+
+
+def test_tls_end_to_end(tiny_model_dir, tmp_path, tls_material):
+    """Server TLS: CA-verifying client generates; plaintext client fails."""
+    from tests.utils import GrpcClient
+
+    args = _server_args(tiny_model_dir, tmp_path, tls_material, mtls=False)
+    loop, thread = _boot_servers(args)
+    try:
+        _wait_tls_healthy(args.grpc_port, tls_material,
+                          with_client_cert=False)
+        with GrpcClient(
+            "localhost", args.grpc_port, insecure=False,
+            ca_cert=tls_material["ca_pem"],
+        ) as client:
+            out = client.make_request("hello tls", model_id="m",
+                                      max_new_tokens=4)
+            assert out.generated_token_count == 4
+
+        # a plaintext client on the TLS port must fail fast
+        with GrpcClient("localhost", args.grpc_port) as client, \
+                pytest.raises(grpc.RpcError):
+            client.make_request("plaintext", model_id="m",
+                                max_new_tokens=2)
+    finally:
+        _stop_servers(loop, thread)
+
+
+def test_mtls_requires_client_cert(tiny_model_dir, tmp_path, tls_material):
+    """mTLS (--ssl-ca-certs): CA-signed client cert generates; a
+    cert-less TLS client is rejected at the handshake."""
+    from tests.utils import GrpcClient
+
+    args = _server_args(tiny_model_dir, tmp_path, tls_material, mtls=True)
+    loop, thread = _boot_servers(args)
+    try:
+        _wait_tls_healthy(args.grpc_port, tls_material,
+                          with_client_cert=True)
+        with GrpcClient(
+            "localhost", args.grpc_port, insecure=False,
+            ca_cert=tls_material["ca_pem"],
+            client_cert=tls_material["client_cert_pem"],
+            client_key=tls_material["client_key_pem"],
+        ) as client:
+            out = client.make_request("hello mtls", model_id="m",
+                                      max_new_tokens=4)
+            assert out.generated_token_count == 4
+
+        with GrpcClient(
+            "localhost", args.grpc_port, insecure=False,
+            ca_cert=tls_material["ca_pem"],
+        ) as client, pytest.raises(grpc.RpcError):
+            client.make_request("no cert", model_id="m", max_new_tokens=2)
+    finally:
+        _stop_servers(loop, thread)

@@ -139,6 +139,17 @@ def main() -> None:
     if not args.model:
         parser.error("--model (or --model-name / MODEL_NAME env) is required")
 
+    try:
+        # faster event loop for the per-token wire hot path (reference
+        # installs it unconditionally, __main__.py:10,128); optional here
+        # so the framework runs on images without the wheel
+        import uvloop
+
+        asyncio.set_event_loop_policy(uvloop.EventLoopPolicy())
+        logger.info("using uvloop event loop")
+    except ImportError:
+        pass
+
     loop = asyncio.new_event_loop()
     try:
         task = loop.create_task(start_servers(args))

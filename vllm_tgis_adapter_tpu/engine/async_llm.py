@@ -146,9 +146,10 @@ class AsyncLLMEngine:
                     self._run_loop(rep),
                     name=f"engine-step-loop-{rep.index}",
                 )
-        if self._stats_task is None and not (
-            self.engine.config.disable_log_stats
-        ):
+        if self._stats_task is None:
+            # always runs: it also feeds the /metrics engine-state gauges
+            # (KV usage, queue depth); --disable-log-stats gates only the
+            # periodic log LINE inside the loop
             self._stats_task = asyncio.create_task(
                 self._log_stats_loop(), name="engine-stats-loop"
             )
@@ -322,6 +323,31 @@ class AsyncLLMEngine:
         if queue is not None and out is not None:
             queue.put_nowait(out)
 
+    def refresh_engine_gauges(self) -> tuple[int, int]:
+        """Push current engine state into the Prometheus gauges
+        (metrics.update_engine_gauges): KV page usage, waiting-queue
+        depth, prefix-hit tokens — aggregated over dp replicas.  Called
+        every stats tick AND on each /metrics scrape so scraped values
+        are never a tick stale.  Returns (kv_used, kv_total) so the
+        stats log line reuses the same aggregation (single source for
+        the usage formula)."""
+        engines = [rep.engine for rep in self._replicas]
+        allocators = [e.scheduler.allocator for e in engines]
+        num_blocks = sum(a.num_blocks for a in allocators)
+        used = num_blocks - sum(a.num_free for a in allocators)
+        try:
+            from vllm_tgis_adapter_tpu import metrics
+
+            metrics.update_engine_gauges(
+                waiting=sum(len(e.scheduler.waiting) for e in engines),
+                kv_used=used,
+                kv_total=num_blocks,
+                prefix_hits=sum(a.prefix_hits for a in allocators),
+            )
+        except Exception:  # pragma: no cover — metrics are best-effort
+            logger.debug("engine gauge refresh failed", exc_info=True)
+        return used, num_blocks
+
     # ------------------------------------------------------------ stats loop
 
     async def _log_stats_loop(self) -> None:
@@ -335,12 +361,13 @@ class AsyncLLMEngine:
                 break
             engines = [rep.engine for rep in self._replicas]
             active = any(e.has_unfinished_requests() for e in engines)
-            if not active and not was_active:
-                continue  # idle: stay quiet until work arrives
-            was_active = active
             allocators = [e.scheduler.allocator for e in engines]
-            num_blocks = sum(a.num_blocks for a in allocators)
-            used = num_blocks - sum(a.num_free for a in allocators)
+            used, num_blocks = self.refresh_engine_gauges()
+            if self.engine.config.disable_log_stats or (
+                not active and not was_active
+            ):
+                continue  # idle or log line disabled: stay quiet
+            was_active = active
             line = (
                 f"running: "
                 f"{sum(len(e.scheduler.running) for e in engines)} reqs, "
@@ -372,35 +399,84 @@ class AsyncLLMEngine:
     # ------------------------------------------------------------- step loop
 
     async def _run_loop(self, rep: _Replica) -> None:
+        """Depth-1 pipelined step loop (host/device overlap).
+
+        The lock covers only the fast host phases (plan/commit); device
+        work runs WITHOUT it so aborts and new requests land mid-dispatch
+        instead of queueing behind a full fused-step program.
+
+        Overlap: ``dispatch_step`` only ENQUEUES device work (JAX async
+        dispatch); while one dispatch executes, the loop plans and
+        enqueues the next admission (``plan_step(prefill_only=True)`` —
+        admissions are independent of the pending commit) and only then
+        blocks on the in-flight results.  The device therefore runs
+        back-to-back programs across prefill waves instead of idling
+        through each step's host prep — the async-scheduling behavior
+        the reference consumes from vLLM
+        (/root/reference/src/vllm_tgis_adapter/grpc/grpc_server.py:205).
+        """
         engine = rep.engine
+        in_flight: Optional[tuple] = None  # (plan, prepared, handle)
+
+        async def emit(outputs) -> None:
+            for out in outputs:
+                queue = self._queues.get(out.request_id)
+                if queue is not None:
+                    queue.put_nowait(out)
+                elif not out.finished:
+                    # stream consumer went away → stop generating
+                    async with rep.lock:
+                        engine.abort_request(out.request_id)
+
+        async def commit_in_flight() -> None:
+            nonlocal in_flight
+            plan, prepared, handle = in_flight
+            result = await asyncio.to_thread(
+                engine.wait_step, plan, prepared, handle
+            )
+            async with rep.lock:
+                outs = engine.commit_step(plan, result, prepared)
+            in_flight = None
+            await emit(outs)
+
         try:
             while not self._stopped:
-                if not engine.has_unfinished_requests():
+                if not engine.has_unfinished_requests() and in_flight is None:
                     rep.new_work.clear()
                     await rep.new_work.wait()
                     continue
-                # the lock covers only the fast host phases (plan/commit);
-                # the blocking device dispatch runs WITHOUT it so aborts
-                # and new requests land mid-dispatch instead of queueing
-                # behind a full fused-step program
                 async with rep.lock:
-                    outputs, plan, prepared = engine.plan_step()
-                if plan is not None:
-                    result = await asyncio.to_thread(
-                        engine.execute_step, plan, prepared
+                    outputs, plan, prepared = engine.plan_step(
+                        prefill_only=in_flight is not None
                     )
-                    async with rep.lock:
-                        outputs = outputs + engine.commit_step(
-                            plan, result, prepared
-                        )
-                for out in outputs:
-                    queue = self._queues.get(out.request_id)
-                    if queue is not None:
-                        queue.put_nowait(out)
-                    elif not out.finished:
-                        # stream consumer went away → stop generating
-                        async with rep.lock:
-                            engine.abort_request(out.request_id)
+                await emit(outputs)
+                if plan is None:
+                    if in_flight is not None:
+                        await commit_in_flight()
+                    continue
+                handle = await asyncio.to_thread(
+                    engine.dispatch_step, plan, prepared
+                )
+                if in_flight is not None:
+                    # commits stay in dispatch order: drain the older
+                    # dispatch (its device work overlapped our planning)
+                    await commit_in_flight()
+                from vllm_tgis_adapter_tpu.engine.runner import (
+                    SYNC_DISPATCH,
+                )
+
+                if handle is SYNC_DISPATCH:
+                    # not enqueue-only (speculative multi-phase verify,
+                    # staged pipeline): the device work happens inside
+                    # wait_step, so it must NOT sit in flight — a later
+                    # eagerly-dispatched prefill would then execute
+                    # BEFORE it on device, breaking the plan-order
+                    # invariant (stale K/V writes onto re-allocated
+                    # pages).  Execute and commit synchronously instead.
+                    in_flight = (plan, prepared, handle)
+                    await commit_in_flight()
+                else:
+                    in_flight = (plan, prepared, handle)
         except asyncio.CancelledError:
             raise
         except BaseException as e:  # noqa: BLE001 — engine death is terminal

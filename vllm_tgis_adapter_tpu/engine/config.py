@@ -64,6 +64,14 @@ class ModelConfig:
     # mixtral-style MoE (num_experts == 0 means dense)
     num_experts: int = 0
     num_experts_per_tok: int = 0
+    # MoE dispatch mode: "dense" runs every expert on every token (exact,
+    # E/k x FLOP overhead — fine for tiny fixtures); "capacity" routes
+    # each (token, expert) assignment into a static per-expert buffer of
+    # ceil(T*k/E * capacity_factor) rows — FLOPs scale with k, and
+    # assignments past an expert's capacity are dropped (their routing
+    # weight contributes zero), the standard MoE serving trade-off
+    moe_dispatch: str = "dense"  # "dense" | "capacity"
+    moe_capacity_factor: float = 1.25
     attention_bias: bool = False
     mlp_bias: bool = False
     # architecture family knobs beyond the llama lineage (OPT et al.);
@@ -514,6 +522,11 @@ class ParallelConfig:
     # head-sharded on tp and replicated over sp, so decode runs replicated
     # across sp shards — sp buys prefill memory/compute scale-out
     sequence_parallel_size: int = 1
+    # sp>1 attention style: "ring" (ppermute K/V rotation — bandwidth
+    # pipelined under compute) or "ulysses" (head/seq all-to-all — the
+    # single-device flash kernel runs unchanged on the gathered slice;
+    # needs sp to divide the per-tp-shard head counts)
+    sequence_parallel_mode: str = "ring"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -630,6 +643,15 @@ class EngineConfig:
             max_model_len=args.max_model_len,
             dtype=args.dtype,
         )
+        moe_dispatch = getattr(args, "moe_dispatch", "dense")
+        if model_config.num_experts > 0 and moe_dispatch != "dense":
+            model_config = dataclasses.replace(
+                model_config,
+                moe_dispatch=moe_dispatch,
+                moe_capacity_factor=getattr(
+                    args, "moe_capacity_factor", 1.25
+                ),
+            )
         max_len = model_config.max_model_len
         buckets = tuple(
             b for b in SchedulerConfig.prefill_buckets if b < max_len
@@ -663,6 +685,9 @@ class EngineConfig:
                 sequence_parallel_size=getattr(
                     args, "sequence_parallel_size", 1
                 ) or 1,
+                sequence_parallel_mode=getattr(
+                    args, "sequence_parallel_mode", "ring"
+                ),
             ),
             lora_config=LoRAConfig(
                 enabled=args.enable_lora,

@@ -99,13 +99,22 @@ class LLMEngine:
         # causal mask: no sliding window / ALiBi biases (both are
         # position-offset-based), no pp stage plumbing, no sp ring, and
         # no speculative draft mirroring (the draft prefill path is
-        # per-sequence)
+        # per-sequence).  The RUNNER's mesh is authoritative for sp —
+        # callers (dp replicas, the multichip dry run) may pass a mesh
+        # explicitly without it appearing in parallel_config
         mcfg = config.model_config
         pcfg = config.parallel_config
+        runner_mesh = getattr(self.runner, "mesh", None)
+        mesh_sp = (
+            dict(runner_mesh.shape).get("sp", 1)
+            if runner_mesh is not None
+            else 1
+        )
         self.scheduler.allow_packed = (
             config.speculative is None
             and pcfg.pipeline_parallel_size == 1
             and pcfg.sequence_parallel_size == 1
+            and mesh_sp == 1
             and mcfg.sliding_window == 0
             and mcfg.position_embedding != "alibi"
         )
@@ -282,9 +291,14 @@ class LLMEngine:
         )
         seq.lora_slot = self.lora_manager.slot_of(lora_name)
         if self.runner.spec is not None:
-            from vllm_tgis_adapter_tpu.engine.speculative import plain_greedy
+            from vllm_tgis_adapter_tpu.engine.speculative import (
+                spec_eligible,
+            )
 
-            seq.spec_eligible = plain_greedy(params) and lora_name is None
+            # greedy rows verify by argmax match, sampled rows by
+            # rejection sampling; LoRA rows verify through the adapted
+            # target (engine/speculative.py spec_eligible)
+            seq.spec_eligible = spec_eligible(params)
         if params.structured_outputs is not None:
             from vllm_tgis_adapter_tpu.engine.constrained import compile_fsm
 
@@ -334,9 +348,15 @@ class LLMEngine:
         result = self.execute_step(plan, prepared)
         return outputs + self.commit_step(plan, result, prepared)
 
-    def plan_step(self):
+    def plan_step(self, prefill_only: bool = False):
         """Phase 1 (host, engine lock held): drain scheduler-finished
-        requests, pick the next plan, snapshot its dispatch inputs."""
+        requests, pick the next plan, snapshot its dispatch inputs.
+
+        ``prefill_only``: the async loop sets this while a dispatch is
+        still in flight — admissions are independent of in-flight results
+        and may be enqueued behind them, whereas a decode plan depends on
+        the pending commit (tokens, page frees) and must wait.
+        """
         outputs: list[RequestOutput] = []
         for seq in self.scheduler.newly_finished:
             self._seqs.pop(seq.request_id, None)
@@ -346,7 +366,7 @@ class LLMEngine:
         self.scheduler.newly_finished.clear()
 
         self.runner.sync_lora(self.lora_manager)
-        plan = self.scheduler.schedule()
+        plan = self.scheduler.schedule(prefill_only=prefill_only)
         if plan is None:
             return outputs, None, None
 
@@ -377,6 +397,26 @@ class LLMEngine:
         if isinstance(plan, PrefillPlan):
             return self.runner.execute_prefill(prepared)
         return self.runner.execute_decode(prepared)
+
+    def dispatch_step(self, plan, prepared):
+        """Phase 2a (lock-free): enqueue the device work without blocking
+        on results (JAX async dispatch).  Pair with ``wait_step``; the
+        async engine plans and dispatches the NEXT step between the two,
+        so host-side prep overlaps device execution."""
+        if isinstance(plan, PackedPrefillPlan):
+            return self.runner.dispatch_packed_prefill(prepared)
+        if isinstance(plan, PrefillPlan):
+            return self.runner.dispatch_prefill(prepared)
+        return self.runner.dispatch_decode(prepared)
+
+    def wait_step(self, plan, prepared, handle):
+        """Phase 2b (lock-free, blocking): pull the dispatched step's
+        results to host."""
+        if isinstance(plan, PackedPrefillPlan):
+            return self.runner.wait_packed_prefill(prepared, handle)
+        if isinstance(plan, PrefillPlan):
+            return self.runner.wait_prefill(prepared, handle)
+        return self.runner.wait_decode(prepared, handle)
 
     def commit_step(self, plan, result, prepared=None) -> list[RequestOutput]:
         """Phase 3 (host, engine lock held): fold sampled tokens back into

@@ -315,6 +315,26 @@ class PipelineRunner(ModelRunner):
 
     # ------------------------------------------------------------- prefill
 
+    # staged execution synchronises hidden-state handoffs between stage
+    # device groups, so the enqueue-only dispatch/wait split does not
+    # apply: dispatch returns the sentinel and wait runs the full staged
+    # execution (engine/runner.py SYNC_DISPATCH contract)
+    def dispatch_prefill(self, prep):
+        from vllm_tgis_adapter_tpu.engine.runner import SYNC_DISPATCH
+
+        return SYNC_DISPATCH
+
+    def wait_prefill(self, prep, handle):
+        return self.execute_prefill(prep)
+
+    def dispatch_decode(self, prep):
+        from vllm_tgis_adapter_tpu.engine.runner import SYNC_DISPATCH
+
+        return SYNC_DISPATCH
+
+    def wait_decode(self, prep, handle):
+        return self.execute_decode(prep)
+
     def execute_prefill(self, prep):
         """Chain the prompt (chunk) through the stages; sample on the
         last stage's devices."""

@@ -28,6 +28,11 @@ if TYPE_CHECKING:
 
 logger = init_logger(__name__)
 
+#: dispatch/wait split sentinel: returned by a ``dispatch_*`` method when
+#: the path cannot enqueue-only (speculative multi-phase verify, staged
+#: pipeline runner) — the paired ``wait_*`` then runs the full execution.
+SYNC_DISPATCH = object()
+
 
 @dataclasses.dataclass
 class SampledToken:
@@ -222,8 +227,24 @@ class ModelRunner:
         self.caches = caches
         # pallas kernels must be shard_map-wrapped under a TP mesh; the
         # mesh travels on the model so each engine's retraces see its own
-        # (ops/attention.py dispatch)
+        # (ops/attention.py dispatch), as does the sequence-parallel
+        # attention style
         model.mesh = mesh
+        model.sp_mode = getattr(pcfg, "sequence_parallel_mode", "ring")
+        if mesh is not None and model.sp_mode == "ulysses":
+            sp = dict(mesh.shape).get("sp", 1)
+            tp = mesh.shape["tp"]
+            if sp > 1 and (
+                (mcfg.num_heads // tp) % sp
+                or (mcfg.num_kv_heads // tp) % sp
+            ):
+                raise ValueError(
+                    f"--sequence-parallel-mode ulysses needs sp={sp} to "
+                    f"divide the per-tp-shard head counts "
+                    f"(heads={mcfg.num_heads // tp}, "
+                    f"kv_heads={mcfg.num_kv_heads // tp} at tp={tp}); "
+                    "use ring mode or adjust sp/tp"
+                )
 
         # buffer donation lets XLA update the KV cache in place; host
         # platforms don't implement donation and warn, so gate it
@@ -482,10 +503,15 @@ class ModelRunner:
             spec_eligible=seq.spec_eligible,
         )
 
-    def execute_prefill(
-        self, prep: "PreparedPrefill"
-    ) -> tuple[Optional[SampledToken], Optional[PromptLogprobInfo]]:
-        """Device half; touches only runner-owned state."""
+    def dispatch_prefill(self, prep: "PreparedPrefill"):
+        """Enqueue the prefill's device work WITHOUT blocking on results.
+
+        JAX dispatch is asynchronous: every call below returns device
+        arrays (futures) immediately; the blocking host transfers live in
+        ``wait_prefill``.  The async engine exploits the split to keep
+        the device fed — while one dispatch executes, the next step is
+        planned and enqueued (engine/async_llm.py step loop).
+        """
         t = prep.t
         lora_args = ()
         if self.lora_stacks is not None:
@@ -519,19 +545,12 @@ class ModelRunner:
             # propose continuations
             self.spec.draft_prefill(prep)
         if not prep.is_final:
-            return None, None  # mid-prompt chunk: nothing to sample
+            return None  # mid-prompt chunk: nothing to sample
 
-        prompt_info = None
+        lp_parts = None
         if prep.want_prompt_lp:
-            lp, rank, tn_ids, tn_lp = sampler_mod.prompt_logprob_info(
+            lp_parts = sampler_mod.prompt_logprob_info(
                 logits, jnp.asarray(prep.token_ids)
-            )
-            n = t - 1  # rows 0..t-2 describe positions 1..t-1
-            prompt_info = PromptLogprobInfo(
-                logprobs=np.asarray(lp)[:n].tolist(),
-                ranks=np.asarray(rank)[:n].tolist(),
-                topn_ids=np.asarray(tn_ids)[:n].tolist(),
-                topn_logprobs=np.asarray(tn_lp)[:n].tolist(),
             )
             last_logits = logits[t - 1][None]
         else:
@@ -562,10 +581,34 @@ class ModelRunner:
         self.seen = sampler_mod.update_seen(
             self.seen, jnp.asarray([prep.row_slot]), out.tokens
         )
+        return {"out": out, "lp": lp_parts}
+
+    def wait_prefill(
+        self, prep: "PreparedPrefill", handle
+    ) -> tuple[Optional[SampledToken], Optional[PromptLogprobInfo]]:
+        """Blocking half: pull the dispatched results to host."""
+        if handle is None:
+            return None, None  # mid-prompt chunk
+        prompt_info = None
+        if handle["lp"] is not None:
+            lp, rank, tn_ids, tn_lp = handle["lp"]
+            n = prep.t - 1  # rows 0..t-2 describe positions 1..t-1
+            prompt_info = PromptLogprobInfo(
+                logprobs=np.asarray(lp)[:n].tolist(),
+                ranks=np.asarray(rank)[:n].tolist(),
+                topn_ids=np.asarray(tn_ids)[:n].tolist(),
+                topn_logprobs=np.asarray(tn_lp)[:n].tolist(),
+            )
         host = _HostSamplerOutput.from_device(
-            jax.tree.map(lambda x: x[None], out)
+            jax.tree.map(lambda x: x[None], handle["out"])
         )
         return host.token(0, 0), prompt_info
+
+    def execute_prefill(
+        self, prep: "PreparedPrefill"
+    ) -> tuple[Optional[SampledToken], Optional[PromptLogprobInfo]]:
+        """Device half; touches only runner-owned state."""
+        return self.wait_prefill(prep, self.dispatch_prefill(prep))
 
     def run_prefill(
         self, plan: "PrefillPlan"
@@ -649,13 +692,11 @@ class ModelRunner:
             lora_slot=items[0].seq.lora_slot,
         )
 
-    def execute_packed_prefill(
-        self, prep: "PreparedPackedPrefill"
-    ) -> list[SampledToken]:
-        """Device half: ONE forward over the packed bucket (block-diagonal
-        causal mask via seg_starts), then the batched sampler over the
-        MAX_PACK last-token rows.  Returns one SampledToken per real
-        packed prompt, in pack order."""
+    def dispatch_packed_prefill(self, prep: "PreparedPackedPrefill"):
+        """Enqueue ONE forward over the packed bucket (block-diagonal
+        causal mask via seg_starts) plus the batched sampler over the
+        MAX_PACK last-token rows; no blocking transfers (see
+        dispatch_prefill)."""
         lora_args = ()
         if self.lora_stacks is not None:
             lora_args = (
@@ -696,10 +737,24 @@ class ModelRunner:
         self.seen = sampler_mod.update_seen(
             self.seen, self._put(prep.row_slots), out.tokens
         )
+        return out
+
+    def wait_packed_prefill(
+        self, prep: "PreparedPackedPrefill", handle
+    ) -> list[SampledToken]:
+        """Blocking half: one SampledToken per real packed prompt, in
+        pack order."""
         host = _HostSamplerOutput.from_device(
-            jax.tree.map(lambda x: x[None], out)
+            jax.tree.map(lambda x: x[None], handle)
         )
         return [host.token(0, i) for i in range(prep.num_items)]
+
+    def execute_packed_prefill(
+        self, prep: "PreparedPackedPrefill"
+    ) -> list[SampledToken]:
+        return self.wait_packed_prefill(
+            prep, self.dispatch_packed_prefill(prep)
+        )
 
     # ---------------------------------------------------------------- decode
 
@@ -808,12 +863,15 @@ class ModelRunner:
             lora_idx=lora_idx,
         )
 
-    def execute_decode(self, prep: "PreparedDecode") -> list[list[SampledToken]]:
-        """Device half; returns per-seq token lists (row i gets UP TO
-        ``steps_per_seq[i]`` entries; the engine stops consuming a row's
-        list at EOS/stop-string)."""
+    def dispatch_decode(self, prep: "PreparedDecode"):
+        """Enqueue the fused K-step decode; no blocking transfers.
+
+        The speculative path runs multiple host-synchronised phases
+        (propose → verify → accept) and cannot enqueue-only: it returns
+        ``SYNC_DISPATCH`` and executes inside ``wait_decode`` instead.
+        """
         if prep.spec_ok:
-            return self.spec.run(prep)
+            return SYNC_DISPATCH
         lora = self.lora_stacks if prep.lora_idx is not None else None
         t = prep.tensors
         ints = np.stack([
@@ -844,7 +902,17 @@ class ModelRunner:
             self._put(prep.lora_idx) if prep.lora_idx is not None else None,
             prep.num_steps,
         )
+        return ints_out, floats_out
 
+    def wait_decode(
+        self, prep: "PreparedDecode", handle
+    ) -> list[list[SampledToken]]:
+        """Blocking half: per-seq token lists (row i gets UP TO
+        ``steps_per_seq[i]`` entries; the engine stops consuming a row's
+        list at EOS/stop-string)."""
+        if handle is SYNC_DISPATCH:
+            return self.spec.run(prep)
+        ints_out, floats_out = handle
         ints_np = np.asarray(ints_out)  # [K, B, 2+W]
         floats_np = np.asarray(floats_out)  # [K, B, 1+W]
         host = _HostSamplerOutput(
@@ -858,6 +926,10 @@ class ModelRunner:
             [host.token(k, i) for k in range(prep.steps_per_seq[i])]
             for i in range(prep.num_seqs)
         ]
+
+    def execute_decode(self, prep: "PreparedDecode") -> list[list[SampledToken]]:
+        """Device half; see wait_decode for the result contract."""
+        return self.wait_decode(prep, self.dispatch_decode(prep))
 
     def run_decode(self, plan: "DecodePlan") -> list[list[SampledToken]]:
         return self.execute_decode(self.prepare_decode(plan))

@@ -178,15 +178,26 @@ class Scheduler:
                 return b
         return None
 
-    def schedule(self) -> Optional[PrefillPlan | DecodePlan]:
+    def schedule(
+        self, prefill_only: bool = False
+    ) -> Optional[PrefillPlan | PackedPrefillPlan | DecodePlan]:
         """Pick the next device step.
 
         Prefill normally has priority (a waiting prompt becomes a running
         row as fast as possible), but right after a prefill chunk a decode
         step runs first if any rows are runnable — chunked admission of a
         long prompt interleaves with decode instead of starving it.
+
+        ``prefill_only`` (async overlap, engine/async_llm.py): another
+        dispatch is still in flight, so only plans independent of its
+        commit — admissions — may be produced.  The prefill/decode
+        interleave is preserved: right after a prefill, returning None
+        makes the loop drain the in-flight dispatch and run the decode,
+        so heavy admission still cannot starve running sequences.
         """
         if self._last_was_prefill and self.running:
+            if prefill_only:
+                return None
             self._last_was_prefill = False
             plan = self._schedule_decode()
             if plan is not None:
@@ -200,6 +211,8 @@ class Scheduler:
                     return packed
             return plan
         self._last_was_prefill = False
+        if prefill_only:
+            return None
         return self._schedule_decode()
 
     def _packable(self, plan: PrefillPlan) -> bool:

@@ -19,10 +19,15 @@ this is the TPU-native mechanism itself:
 
 Greedy equivalence: the accepted prefix plus the bonus token reproduces
 exactly the non-speculative greedy chain — each accepted dᵢ equals the
-target argmax given the identical prefix.  Speculation therefore engages
-only for batches where every row is *plain greedy* (temperature 0, no
-penalties/typical-p/FSM/min-tokens/LoRA); anything else falls back to
-the standard fused decode in the same dispatch slot.
+target argmax given the identical prefix.  Sampled rows (temperature>0,
+top-k/top-p, seeded or not) verify by REJECTION SAMPLING — accept dᵢ
+with prob min(1, p(dᵢ)/q(dᵢ)), resample the residual norm(max(p−q,0))
+on reject — which emits tokens distributed exactly as the target's
+sampling distribution (Leviathan et al. 2023).  LoRA rows verify
+through the adapted target while the draft proposes from base weights.
+Rows with state-evolving knobs (repetition penalty, typical-p,
+length-penalty/min-tokens, FSM) fall back to the standard fused decode
+in the same dispatch slot.
 
 Draft/target contract: same tokenizer and vocab size (validated at
 boot); the draft shares the target's block tables and slot geometry, so
@@ -52,9 +57,126 @@ logger = init_logger(__name__)
 
 _LOG_EVERY = 50  # dispatches between acceptance-rate log lines
 
+# PRNG stream salts: the draft's proposal draws, the acceptance uniforms
+# and the residual/bonus draws must be mutually independent streams per
+# (request, position) or acceptance correlates with the proposal
+_SALT_DRAFT = 1
+_SALT_ACCEPT = 2
+_SALT_EMIT = 3
+
+
+def _spec_dist(
+    logits: jax.Array,  # [N, V] raw model logits
+    temps: jax.Array,  # [N] f32; 0 == greedy row
+    top_k: jax.Array,  # [N] i32; <=0 disabled
+    top_p: jax.Array,  # [N] f32
+) -> jax.Array:
+    """Per-row sampling distribution: temperature scale + top-k/top-p
+    filter, softmax; greedy rows become exact one-hots so the rejection
+    test degenerates to an argmax match for them."""
+    import types
+
+    from vllm_tgis_adapter_tpu.engine.sampler import (
+        _filter_top_k_top_p_typical,
+    )
+
+    greedy = temps <= 0.0
+    safe = jnp.where(greedy, 1.0, temps)[:, None]
+    scaled = logits.astype(jnp.float32) / safe
+    knobs = types.SimpleNamespace(
+        top_k=top_k, top_p=top_p, typical_p=jnp.ones_like(top_p)
+    )
+    probs = jax.nn.softmax(_filter_top_k_top_p_typical(scaled, knobs), -1)
+    onehot = jax.nn.one_hot(
+        jnp.argmax(logits, axis=-1), logits.shape[-1], dtype=probs.dtype
+    )
+    return jnp.where(greedy[:, None], onehot, probs)
+
+
+def _rejection_core(
+    logits: jax.Array,  # [B, K, V] target logits over the window
+    q_probs: jax.Array,  # [gamma, B, V] draft sampling distributions
+    window: jax.Array,  # [B, K] last token + gamma draft proposals
+    temps: jax.Array,  # [B]
+    top_k: jax.Array,  # [B]
+    top_p: jax.Array,  # [B]
+    base_key: jax.Array,  # [B] uint32
+    gen0: jax.Array,  # [B] tokens generated so far (PRNG position base)
+) -> tuple[jax.Array, jax.Array]:
+    """Pure rejection-sampling acceptance + emission (Leviathan et al.).
+
+    Accept draft token d_j with prob min(1, p(d_j)/q(d_j)); at the first
+    rejection sample from the residual norm(max(p−q, 0)); on full
+    acceptance sample the bonus token from p directly.  Greedy rows have
+    one-hot p/q, so acceptance degenerates to the argmax match test and
+    emission to the target argmax — bit-identical to the greedy verify.
+    Returns (emitted [B, K], accepted [B] in 0..gamma).  Factored out of
+    the verify program so the distribution-preservation property is
+    testable without a model (tests/test_speculative.py).
+    """
+    b, kw, v = logits.shape
+    gamma = kw - 1
+    rep = lambda x: jnp.repeat(x, kw, axis=0)  # noqa: E731
+    p_probs = _spec_dist(
+        logits.reshape(b * kw, v), rep(temps), rep(top_k), rep(top_p)
+    ).reshape(b, kw, v)
+
+    d = window[:, 1:]  # [B, gamma] draft proposals
+    q_t = jnp.moveaxis(q_probs, 0, 1)  # [B, gamma, V]
+    p_d = jnp.take_along_axis(
+        p_probs[:, :gamma], d[..., None], axis=-1
+    )[..., 0]
+    q_d = jnp.take_along_axis(q_t, d[..., None], axis=-1)[..., 0]
+    ratio = p_d / jnp.maximum(q_d, 1e-20)
+
+    def u_one(s, p):
+        kk = jax.random.fold_in(
+            jax.random.fold_in(jax.random.PRNGKey(s), p), _SALT_ACCEPT
+        )
+        return jax.random.uniform(kk)
+
+    u = jax.vmap(
+        lambda s, g: jax.vmap(lambda j: u_one(s, g + j))(jnp.arange(gamma))
+    )(base_key, gen0)  # [B, gamma]
+    accept = u < ratio
+    accepted = jnp.sum(
+        jnp.cumprod(accept.astype(jnp.int32), axis=1), axis=1
+    )  # [B] in 0..gamma
+
+    # emission at the first non-accepted position: residual distribution
+    # (or p itself for the bonus token)
+    pos_e = jnp.minimum(accepted, gamma)
+    p_e = jnp.take_along_axis(p_probs, pos_e[:, None, None], axis=1)[:, 0]
+    q_e = jnp.take_along_axis(
+        q_t, jnp.minimum(accepted, gamma - 1)[:, None, None], axis=1
+    )[:, 0]
+    q_e = jnp.where((accepted >= gamma)[:, None], 0.0, q_e)
+    resid = jnp.maximum(p_e - q_e, 0.0)
+    mass = jnp.sum(resid, axis=-1, keepdims=True)
+    dist = jnp.where(mass > 0, resid / jnp.maximum(mass, 1e-30), p_e)
+    keys_e = jax.vmap(
+        lambda s, p: jax.random.fold_in(
+            jax.random.fold_in(jax.random.PRNGKey(s), p), _SALT_EMIT
+        )
+    )(base_key, gen0 + accepted)
+    tok_sampled = jax.vmap(jax.random.categorical)(
+        keys_e, jnp.log(dist + 1e-30)
+    )
+    tok_e = jnp.where(
+        temps <= 0.0, jnp.argmax(dist, axis=-1), tok_sampled
+    ).astype(jnp.int32)
+
+    cols = jnp.arange(kw)[None, :]
+    emitted = jnp.where(
+        cols < accepted[:, None],
+        jnp.pad(d, ((0, 0), (0, 1))),
+        tok_e[:, None],
+    )  # [B, K]; col j<a: draft token, col a: resampled/bonus
+    return emitted, accepted
+
 
 def plain_greedy(params) -> bool:  # noqa: ANN001
-    """Row eligibility: sampling modes speculation reproduces exactly."""
+    """Greedy rows speculation reproduces EXACTLY (match-test verify)."""
     return (
         params.temperature == 0.0
         and params.repetition_penalty == 1.0
@@ -62,6 +184,36 @@ def plain_greedy(params) -> bool:  # noqa: ANN001
         and params.length_penalty is None
         and params.min_tokens == 0
         and params.structured_outputs is None
+    )
+
+
+def spec_eligible(params) -> bool:  # noqa: ANN001
+    """Row eligibility for speculative dispatches.
+
+    Greedy rows verify by argmax match; unseeded sampled rows (any
+    temperature, top-k/top-p) verify by rejection sampling — accept
+    draft token d with prob min(1, p(d)/q(d)), resample the residual on
+    reject — which preserves the target distribution exactly (Leviathan
+    et al.; the mechanism the reference consumes from vLLM's spec
+    decode).  Excluded:
+
+    * knobs whose state evolves WITHIN a speculation window (repetition
+      penalty's seen matrix, typical-p's entropy set, length-penalty/
+      min-tokens EOS shaping, FSM masks);
+    * SEEDED sampled requests: the sampler guarantees a seeded request
+      replays the same draw stream no matter how it is batched
+      (engine/sampler.py), and the spec path's salted draft/accept/emit
+      streams differ from the fused sampler's — since path choice
+      depends on batch-mates (spec_ok = all rows eligible), a seeded
+      row must always take the one deterministic path.
+    """
+    return (
+        params.repetition_penalty == 1.0
+        and params.typical_p == 1.0
+        and params.length_penalty is None
+        and params.min_tokens == 0
+        and params.structured_outputs is None
+        and (params.temperature == 0.0 or params.seed is None)
     )
 
 
@@ -139,6 +291,8 @@ class SpeculativeDecoder:
         )
         self._propose_fn = self._build_propose_fn()
         self._verify_fn = self._build_verify_fn()
+        self._propose_sampled_fn = self._build_propose_sampled_fn()
+        self._verify_sampled_fn = self._build_verify_sampled_fn()
 
     # ------------------------------------------------------------- prefill
 
@@ -206,29 +360,37 @@ class SpeculativeDecoder:
         donate = (1,) if jax.default_backend() == "tpu" else ()
         return jax.jit(propose, static_argnums=(7,), donate_argnums=donate)
 
+    def _window_slots(self, window, positions0, limits, block_tables):
+        """[B, K] positions + KV slots for a speculation window."""
+        block_size = self.runner.block_size
+        b, k = window.shape
+        pos = positions0[:, None] + jnp.arange(k)[None, :]  # [B, K]
+        active = pos <= limits[:, None]
+        max_blocks = block_tables.shape[1]
+        blk = jnp.take_along_axis(
+            block_tables,
+            jnp.clip(pos // block_size, 0, max_blocks - 1),
+            axis=1,
+        )
+        slots = jnp.where(active, blk * block_size + pos % block_size, -1)
+        return pos, slots
+
     def _build_verify_fn(self):
         target = self.runner.model
         block_size = self.runner.block_size
+        window_slots = self._window_slots
         from vllm_tgis_adapter_tpu.engine.sampler import TOPN_WIDTH
 
         def verify(
             params, caches, window,  # [B, K]: last token + γ draft tokens
-            positions0, limits, block_tables,
+            positions0, limits, block_tables, lora, lora_idx,
         ):
             b, k = window.shape
-            pos = positions0[:, None] + jnp.arange(k)[None, :]  # [B, K]
-            active = pos <= limits[:, None]
-            max_blocks = block_tables.shape[1]
-            blk = jnp.take_along_axis(
-                block_tables,
-                jnp.clip(pos // block_size, 0, max_blocks - 1),
-                axis=1,
-            )
-            slots = jnp.where(
-                active, blk * block_size + pos % block_size, -1
-            )
+            pos, slots = window_slots(window, positions0, limits,
+                                      block_tables)
             logits, caches = target.verify(
                 params, caches, window, pos, slots, block_tables, block_size,
+                lora, lora_idx,
             )  # [B, K, V] f32
 
             greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, K]
@@ -256,6 +418,118 @@ class SpeculativeDecoder:
                 jnp.int32
             )
             topn_lp, topn_ids = jax.lax.top_k(logprobs, TOPN_WIDTH)
+            return (
+                caches,
+                emitted,
+                accepted,
+                chosen_lp,
+                rank,
+                topn_ids.astype(jnp.int32),
+                topn_lp,
+            )
+
+        donate = (1,) if jax.default_backend() == "tpu" else ()
+        return jax.jit(verify, donate_argnums=donate)
+
+    # --------------------------------------------- sampled (rejection) path
+
+    def _build_propose_sampled_fn(self):
+        """Draft proposes by SAMPLING from its (temperature/top-k/top-p
+        transformed) distribution and returns that distribution per
+        proposed position — rejection-sampling verification needs q(x)
+        over the full vocab to form the residual."""
+        draft = self.draft_model
+        block_size = self.runner.block_size
+
+        def propose(
+            params, caches, tokens0, positions0, limits, block_tables,
+            context_lens0, temps, top_k, top_p, base_key, gen0, gamma: int,
+        ):
+            max_blocks = block_tables.shape[1]
+
+            def step(carry, k):
+                caches, tok = carry
+                pos = positions0 + k
+                active = pos <= limits
+                blk = jnp.take_along_axis(
+                    block_tables,
+                    jnp.clip(pos // block_size, 0, max_blocks - 1)[:, None],
+                    axis=1,
+                )[:, 0]
+                slot = jnp.where(
+                    active, blk * block_size + pos % block_size, -1
+                )
+                logits, caches = draft.decode(
+                    params, caches, tok, pos, slot, block_tables,
+                    context_lens0 + k, block_size,
+                )
+                probs = _spec_dist(logits, temps, top_k, top_p)
+                keys = jax.vmap(
+                    lambda s, p: jax.random.fold_in(
+                        jax.random.fold_in(jax.random.PRNGKey(s), p),
+                        _SALT_DRAFT,
+                    )
+                )(base_key, gen0 + k)
+                sampled = jax.vmap(jax.random.categorical)(
+                    keys, jnp.log(probs + 1e-30)
+                )
+                nxt = jnp.where(
+                    temps <= 0.0, jnp.argmax(logits, axis=-1), sampled
+                ).astype(jnp.int32)
+                return (caches, nxt), (nxt, probs)
+
+            # gamma+1 steps for the same cache-hole reason as the greedy
+            # propose; the extra step's distribution is discarded
+            (caches, _), (drafted, qprobs) = jax.lax.scan(
+                step, (caches, tokens0), jnp.arange(gamma + 1)
+            )
+            return caches, drafted[:gamma], qprobs[:gamma]  # [γ,B],[γ,B,V]
+
+        donate = (1,) if jax.default_backend() == "tpu" else ()
+        return jax.jit(propose, static_argnums=(12,), donate_argnums=donate)
+
+    def _build_verify_sampled_fn(self):
+        """Rejection-sampling verification (Leviathan et al.): accept
+        draft token d_j with prob min(1, p(d_j)/q(d_j)); at the first
+        rejection sample from the residual norm(max(p - q, 0)); on full
+        acceptance sample the bonus token from p directly.  Greedy rows
+        degenerate exactly to the argmax match test (p and q are
+        one-hots), so mixed greedy/sampled batches ride one program."""
+        target = self.runner.model
+        block_size = self.runner.block_size
+        window_slots = self._window_slots
+        from vllm_tgis_adapter_tpu.engine.sampler import TOPN_WIDTH
+
+        def verify(
+            params, caches, window, positions0, limits, block_tables,
+            q_probs,  # [gamma, B, V] draft distributions
+            temps, top_k, top_p, base_key, gen0, lora, lora_idx,
+        ):
+            b, kw = window.shape
+            gamma = kw - 1
+            pos, slots = window_slots(window, positions0, limits,
+                                      block_tables)
+            logits, caches = target.verify(
+                params, caches, window, pos, slots, block_tables,
+                block_size, lora, lora_idx,
+            )  # [B, K, V] f32
+            emitted, accepted = _rejection_core(
+                logits, q_probs, window, temps, top_k, top_p, base_key,
+                gen0,
+            )
+
+            # token-info reporting matches the non-spec sampler: logprobs
+            # of the temperature-scaled distribution (no penalties on
+            # eligible rows by construction)
+            safe = jnp.where(temps <= 0.0, 1.0, temps)[:, None, None]
+            logp = jax.nn.log_softmax(logits / safe, axis=-1)
+            chosen_lp = jnp.take_along_axis(
+                logp, emitted[..., None], axis=-1
+            )[..., 0]
+            rank = 1 + jnp.sum(
+                logp > chosen_lp[..., None], axis=-1
+            ).astype(jnp.int32)
+            topn_lp, topn_ids = jax.lax.top_k(logp, TOPN_WIDTH)
             return (
                 caches,
                 emitted,
@@ -307,19 +581,50 @@ class SpeculativeDecoder:
         limits = put(prep.limits)
         tables = put(prep.block_tables)
         ctx0 = put(prep.context_lens)
+        lora = runner.lora_stacks if prep.lora_idx is not None else None
+        lora_idx = (
+            put(prep.lora_idx) if prep.lora_idx is not None else None
+        )
 
-        self.draft_caches, drafted = self._propose_fn(
-            self.draft_params, self.draft_caches, tokens0, positions0,
-            limits, tables, ctx0, gamma,
-        )
-        window = jnp.concatenate(
-            [tokens0[:, None], jnp.transpose(drafted)], axis=1
-        )  # [B, K]
-        (
-            runner.caches, emitted, accepted, lp, rank, topn_ids, topn_lp,
-        ) = self._verify_fn(
-            runner.params, runner.caches, window, positions0, limits, tables,
-        )
+        t = prep.tensors
+        any_sampled = bool(np.any(np.asarray(t.temperature) > 0.0))
+        if any_sampled:
+            temps = put(np.asarray(t.temperature, np.float32))
+            top_k = put(np.asarray(t.top_k, np.int32))
+            top_p = put(np.asarray(t.top_p, np.float32))
+            base_key = put(np.asarray(t.base_key, np.uint32))
+            gen0 = put(np.asarray(t.gen_len, np.int32))
+            self.draft_caches, drafted, q_probs = self._propose_sampled_fn(
+                self.draft_params, self.draft_caches, tokens0, positions0,
+                limits, tables, ctx0, temps, top_k, top_p, base_key, gen0,
+                gamma,
+            )
+            window = jnp.concatenate(
+                [tokens0[:, None], jnp.transpose(drafted)], axis=1
+            )  # [B, K]
+            (
+                runner.caches, emitted, accepted, lp, rank, topn_ids,
+                topn_lp,
+            ) = self._verify_sampled_fn(
+                runner.params, runner.caches, window, positions0, limits,
+                tables, q_probs, temps, top_k, top_p, base_key, gen0,
+                lora, lora_idx,
+            )
+        else:
+            self.draft_caches, drafted = self._propose_fn(
+                self.draft_params, self.draft_caches, tokens0, positions0,
+                limits, tables, ctx0, gamma,
+            )
+            window = jnp.concatenate(
+                [tokens0[:, None], jnp.transpose(drafted)], axis=1
+            )  # [B, K]
+            (
+                runner.caches, emitted, accepted, lp, rank, topn_ids,
+                topn_lp,
+            ) = self._verify_fn(
+                runner.params, runner.caches, window, positions0, limits,
+                tables, lora, lora_idx,
+            )
 
         emitted = np.asarray(emitted)  # [B, K]
         accepted = np.asarray(accepted)

@@ -141,6 +141,10 @@ def build_http_server(args: "argparse.Namespace", engine: "AsyncLLMEngine") -> A
     app.route("GET", "/v1/models")(_models)
     app.route("POST", "/v1/completions")(_completions)
     app.route("POST", "/v1/chat/completions")(_chat_completions)
+    # vLLM-app extras the reference exposes by mounting the full OpenAI
+    # app (/root/reference/src/vllm_tgis_adapter/http.py:52)
+    app.route("POST", "/tokenize")(_tokenize)
+    app.route("POST", "/detokenize")(_detokenize)
     return app
 
 
@@ -154,9 +158,56 @@ async def _health(app: App, request: HttpRequest) -> HttpResponse:
 
 
 async def _metrics(app: App, request: HttpRequest) -> HttpResponse:  # noqa: ARG001
+    engine: AsyncLLMEngine = app.state["engine"]
+    # engine-state gauges (KV usage, queue depth) refresh on scrape so
+    # the autoscaler never reads a stats-tick-stale value
+    refresh = getattr(engine, "refresh_engine_gauges", None)
+    if refresh is not None:
+        refresh()
     return HttpResponse(
         200, metrics.render(), content_type="text/plain; version=0.0.4"
     )
+
+
+async def _tokenize(app: App, request: HttpRequest) -> HttpResponse:
+    """vLLM-style /tokenize: {"prompt": str, "add_special_tokens"?: bool}
+    → {"count", "max_model_len", "tokens"?}."""
+    engine: AsyncLLMEngine = app.state["engine"]
+    try:
+        body = request.json()
+    except ValueError:
+        return error_response(400, "request body must be JSON")
+    prompt = body.get("prompt")
+    if not isinstance(prompt, str):
+        return error_response(400, "prompt must be a string")
+    tokenizer = engine.engine.get_tokenizer()
+    ids = tokenizer(
+        prompt,
+        add_special_tokens=bool(body.get("add_special_tokens", True)),
+    ).input_ids
+    payload = {
+        "count": len(ids),
+        "max_model_len": engine.engine.config.max_model_len,
+    }
+    if body.get("return_tokens", True):
+        payload["tokens"] = list(ids)
+    return JsonResponse(payload)
+
+
+async def _detokenize(app: App, request: HttpRequest) -> HttpResponse:
+    """vLLM-style /detokenize: {"tokens": [int]} → {"prompt": str}."""
+    engine: AsyncLLMEngine = app.state["engine"]
+    try:
+        body = request.json()
+    except ValueError:
+        return error_response(400, "request body must be JSON")
+    tokens = body.get("tokens")
+    if not isinstance(tokens, list) or not all(
+        isinstance(t, int) for t in tokens
+    ):
+        return error_response(400, "tokens must be a list of integers")
+    tokenizer = engine.engine.get_tokenizer()
+    return JsonResponse({"prompt": tokenizer.decode(tokens)})
 
 
 async def _version(app: App, request: HttpRequest) -> HttpResponse:  # noqa: ARG001

@@ -78,6 +78,53 @@ spec_accepted_tokens_total = _get_or_create(
     "Draft tokens accepted by target verification",
 )
 
+# ---- engine-state gauges (k8s autoscaling keys off exactly these; the
+# reference exports the vLLM equivalents vllm:num_requests_running/
+# waiting/gpu_cache_usage_perc through its /metrics).  Fed by the async
+# engine's stats loop (engine/async_llm.py), aggregated over dp replicas.
+num_requests_waiting = _get_or_create(
+    Gauge,
+    f"{_PREFIX}_num_requests_waiting",
+    "Requests queued, not yet running",
+)
+kv_pages_total = _get_or_create(
+    Gauge,
+    f"{_PREFIX}_kv_pages_total",
+    "KV cache pages in the pool (all replicas)",
+)
+kv_pages_used = _get_or_create(
+    Gauge,
+    f"{_PREFIX}_kv_pages_used",
+    "KV cache pages currently allocated",
+)
+kv_cache_usage = _get_or_create(
+    Gauge,
+    f"{_PREFIX}_kv_cache_usage",
+    "Fraction of KV cache pages in use (0-1)",
+)
+prefix_cache_hit_tokens = _get_or_create(
+    Gauge,
+    f"{_PREFIX}_prefix_cache_hit_tokens",
+    "Cumulative prompt tokens served from the prefix cache",
+)
+
+
+def update_engine_gauges(
+    *,
+    waiting: int,
+    kv_used: int,
+    kv_total: int,
+    prefix_hits: int,
+) -> None:
+    # num_requests_running is NOT set here: the serving layer inc/decs it
+    # per request (tgis_utils/logs.py) and a periodic .set() from a
+    # second writer would flip-flop the two views
+    num_requests_waiting.set(waiting)
+    kv_pages_used.set(kv_used)
+    kv_pages_total.set(kv_total)
+    kv_cache_usage.set(kv_used / kv_total if kv_total else 0.0)
+    prefix_cache_hit_tokens.set(prefix_hits)
+
 
 def record_response(
     *,

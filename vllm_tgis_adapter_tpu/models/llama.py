@@ -146,6 +146,10 @@ class LlamaForCausalLM:
         # TP mesh for shard_map-wrapped Pallas attention (ops/attention.py);
         # assigned by the runner at boot, None on a single device
         self.mesh = None
+        # sequence-parallel prefill style under an sp>1 mesh: "ring"
+        # (ppermute K/V rotation) or "ulysses" (head/seq all-to-all);
+        # stamped by the runner from ParallelConfig
+        self.sp_mode = "ring"
         # pipeline parallelism: a stage model sees only its layer slice;
         # this offset maps local layer index -> global (qwen2's
         # max_window_layers gate needs the global index)
@@ -376,17 +380,18 @@ class LlamaForCausalLM:
         return out
 
     def _moe_mlp(self, layer: dict, x: jax.Array) -> jax.Array:
-        """Mixtral-style sparse MoE block, dense-routed for jit stability.
+        """Mixtral-style sparse MoE block.
 
         Router picks top-k experts per token (softmax over router logits,
-        renormalised over the selected k, HF mixtral convention).  Every
-        expert runs on every token as one stacked einsum and non-selected
-        contributions are zeroed by the routing weights — no
-        data-dependent shapes, so XLA compiles one static program and
-        expert-parallel sharding is a plain psum over the expert axis
-        (parallel/sharding.py).  Compute cost is E/k × the ideal sparse
-        dispatch; acceptable at serving batch sizes, and the layout is
-        ready for a capacity-based ragged dispatch later.
+        renormalised over the selected k, HF mixtral convention), then
+        dispatches per ``config.moe_dispatch``:
+
+        * ``dense`` (default): every expert runs on every token as one
+          stacked einsum and non-selected contributions are zeroed by
+          the routing weights — exact, no data-dependent shapes, E/k ×
+          the ideal sparse FLOPs (fine for tiny fixtures/tests);
+        * ``capacity``: static per-expert buffers, FLOPs scale with k
+          (serving-grade; see _moe_capacity_mlp).
         """
         cfg = self.config
         k = cfg.num_experts_per_tok
@@ -396,6 +401,10 @@ class LlamaForCausalLM:
         probs = jax.nn.softmax(logits, axis=-1)  # [T, E]
         top_p, top_idx = jax.lax.top_k(probs, k)
         top_p = top_p / jnp.sum(top_p, axis=-1, keepdims=True)
+
+        if cfg.moe_dispatch == "capacity":
+            return self._moe_capacity_mlp(layer, x, top_idx, top_p)
+
         weights = jnp.sum(
             jax.nn.one_hot(top_idx, num_experts, dtype=jnp.float32)
             * top_p[..., None],
@@ -409,6 +418,76 @@ class LlamaForCausalLM:
         return jnp.sum(
             out * weights[..., None].astype(out.dtype), axis=1
         ).astype(x.dtype)
+
+    def _moe_capacity_mlp(
+        self,
+        layer: dict,
+        x: jax.Array,  # [T, d]
+        top_idx: jax.Array,  # [T, k] selected expert ids
+        top_p: jax.Array,  # [T, k] renormalised routing weights
+    ) -> jax.Array:
+        """Capacity-bucketed top-k dispatch: FLOPs scale with k, not E.
+
+        Every (token, expert) assignment is scattered into a static
+        ``[E, C, d]`` buffer where ``C = ceil(T*k/E * capacity_factor)``
+        (all shapes static per compile bucket — jit-stable).  Each expert
+        runs its FFN over its C rows only; outputs gather back and sum
+        with the routing weights.  Assignments beyond an expert's
+        capacity are DROPPED (contribute zero) — the standard MoE
+        serving trade-off; raise --moe-capacity-factor to trade FLOPs
+        for fidelity (factor >= E/k can never drop).
+
+        Expert parallelism: the expert stacks are sharded on the expert
+        axis when tp divides E (parallel/sharding.py); the scatter from
+        replicated tokens into the E-sharded buffer and the gather back
+        become XLA collectives over the tp axis — the all-to-all
+        dispatch/combine of a classic EP MoE, derived by the SPMD
+        partitioner instead of hand-written.
+        """
+        cfg = self.config
+        t, d = x.shape
+        k = cfg.num_experts_per_tok
+        num_experts = layer["router"].shape[1]
+        capacity = max(
+            1,
+            int(-(-t * k * cfg.moe_capacity_factor // num_experts)),
+        )
+        capacity = min(capacity, t)  # an expert can't exceed all tokens
+
+        flat_e = top_idx.reshape(-1)  # [T*k]
+        flat_w = top_p.reshape(-1)
+        flat_tok = jnp.repeat(jnp.arange(t, dtype=jnp.int32), k)
+
+        # position of each assignment within its expert's buffer: rank
+        # among same-expert assignments in flat order (cumsum of the
+        # one-hot assignment matrix)
+        onehot = jax.nn.one_hot(flat_e, num_experts, dtype=jnp.int32)
+        pos = jnp.take_along_axis(
+            jnp.cumsum(onehot, axis=0) - 1, flat_e[:, None], axis=1
+        )[:, 0]  # [T*k]
+        keep = pos < capacity
+
+        # scatter tokens into per-expert buffers; dropped assignments
+        # remap to expert index E and are discarded by mode='drop'
+        safe_e = jnp.where(keep, flat_e, num_experts)
+        safe_pos = jnp.where(keep, pos, 0)
+        buf = jnp.zeros((num_experts, capacity, d), x.dtype)
+        buf = buf.at[safe_e, safe_pos].set(x[flat_tok], mode="drop")
+
+        gate = jnp.einsum("ecd,edf->ecf", buf, layer["experts_gate"])
+        up = jnp.einsum("ecd,edf->ecf", buf, layer["experts_up"])
+        h = jax.nn.silu(gate) * up
+        out_e = jnp.einsum("ecf,efd->ecd", h, layer["experts_down"])
+
+        # combine: gather each assignment's expert output, weight it,
+        # and segment-sum back over the token axis
+        clamped_e = jnp.clip(flat_e, 0, num_experts - 1)
+        y = out_e[clamped_e, safe_pos]  # [T*k, d]
+        y = jnp.where(
+            keep[:, None], y * flat_w[:, None].astype(y.dtype), 0.0
+        )
+        combined = jnp.zeros((t, d), y.dtype).at[flat_tok].add(y)
+        return combined.astype(x.dtype)
 
     def _embed(
         self, params: dict, token_ids: jax.Array, positions: jax.Array
@@ -498,6 +577,7 @@ class LlamaForCausalLM:
                 window=self._window_for_layer(i),
                 alibi_slopes=self.alibi,
                 seg_starts=seg_starts,
+                sp_mode=self.sp_mode,
             )
 
         x = (
@@ -609,6 +689,8 @@ class LlamaForCausalLM:
         slot_mapping: jax.Array,  # [B, K] cache slot per token; -1 masked
         block_tables: jax.Array,  # [B, max_blocks]
         block_size: int,
+        lora=None,  # LoRAStacks or None
+        lora_idx: jax.Array | None = None,  # [B] adapter slot per row
     ) -> tuple[jax.Array, tuple[jax.Array, jax.Array]]:
         """Multi-token verification forward for speculative decoding.
 
@@ -616,6 +698,10 @@ class LlamaForCausalLM:
         paged context up to and including itself (its K/V is scattered
         first), i.e. the batched generalisation of ``prefill_chunk``.
         Returns logits for EVERY window position as ``[B, K, V]``.
+
+        LoRA: the row's adapter applies to the TARGET verification pass
+        (the draft proposes from its base weights), so acceptance drops
+        but emitted tokens follow the adapted model exactly.
         """
         cfg = self.config
         k_cache, v_cache = caches
@@ -630,6 +716,9 @@ class LlamaForCausalLM:
 
         rope = self._rope_tables(flat_pos)
         safe_slots = jnp.where(flat_slots < 0, k_cache.shape[2], flat_slots)
+        flat_lora_idx = (
+            jnp.repeat(lora_idx, k) if lora_idx is not None else None
+        )
 
         def attend(i, q, kk, v):
             nonlocal k_cache, v_cache
@@ -648,8 +737,15 @@ class LlamaForCausalLM:
 
         x = self._embed(params, flat_tokens, flat_pos)
         for i, layer in enumerate(params["layers"]):
+            dl = None
+            if lora is not None:
+                dl = (
+                    lambda target, xx, i=i: _lora_delta_batched(
+                        lora, i, flat_lora_idx, target, xx
+                    )
+                )
             x = self._decoder_block(
-                layer, x, lambda q, k, v, i=i: attend(i, q, k, v), None,
+                layer, x, lambda q, k, v, i=i: attend(i, q, k, v), dl,
                 rope,
             )
 

@@ -89,6 +89,7 @@ def prefill_attention(
     window: int = 0,
     alibi_slopes: jax.Array | None = None,  # [H] f32 (bloom lineage)
     seg_starts: jax.Array | None = None,  # [max_segs] i32 packed-prefill
+    sp_mode: str = "ring",  # "ring" | "ulysses" sequence-parallel style
 ) -> jax.Array:
     """Dispatch: flash Pallas kernel on TPU, XLA fallback elsewhere.
 
@@ -125,15 +126,21 @@ def prefill_attention(
             "requests (engine/scheduler.py allow_packed)"
         )
     if mesh is not None and dict(mesh.shape).get("sp", 1) > 1:
-        from vllm_tgis_adapter_tpu.ops.ring_attention import (
-            ring_prefill_attention,
-        )
-
         vl = (
             jnp.asarray(q.shape[0], jnp.int32)
             if valid_len is None
             else valid_len
         )
+        if sp_mode == "ulysses":
+            from vllm_tgis_adapter_tpu.ops.ulysses_attention import (
+                ulysses_prefill_attention,
+            )
+
+            return ulysses_prefill_attention(q, k, v, scale, vl, mesh)
+        from vllm_tgis_adapter_tpu.ops.ring_attention import (
+            ring_prefill_attention,
+        )
+
         return ring_prefill_attention(q, k, v, scale, vl, mesh)
     if _use_pallas():
         from vllm_tgis_adapter_tpu.ops import pallas_attention

@@ -154,6 +154,15 @@ def add_engine_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     g.add_argument("--dtype", type=str, default="auto",
                    choices=["auto", "bfloat16", "float16", "float32"],
                    help="activation/weight dtype; 'auto' picks bfloat16 on TPU")
+    g.add_argument("--moe-dispatch", type=str, default="dense",
+                   choices=["dense", "capacity"],
+                   help="MoE expert dispatch: 'dense' runs every expert "
+                        "on every token (exact); 'capacity' routes into "
+                        "static per-expert buffers so FLOPs scale with "
+                        "top-k (assignments past capacity are dropped)")
+    g.add_argument("--moe-capacity-factor", type=float, default=1.25,
+                   help="per-expert buffer headroom for --moe-dispatch "
+                        "capacity: capacity = ceil(T*k/E * factor)")
     g.add_argument("--kv-cache-dtype", type=str, default="auto",
                    choices=["auto", "bfloat16", "float32", "float8_e4m3"],
                    help="KV-cache storage dtype")
@@ -203,8 +212,14 @@ def add_engine_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     g.add_argument("--tensor-parallel-size", "-tp", type=int, default=None,
                    help="SPMD tensor-parallel mesh size over ICI")
     g.add_argument("--sequence-parallel-size", "-sp", type=int, default=1,
-                   help="ring-attention sequence-parallel mesh axis for "
-                        "long-context prefill (total chips = sp * tp)")
+                   help="sequence-parallel mesh axis for long-context "
+                        "prefill (total chips = sp * tp)")
+    g.add_argument("--sequence-parallel-mode", type=str, default="ring",
+                   choices=["ring", "ulysses"],
+                   help="sp>1 attention style: 'ring' rotates K/V chunks "
+                        "via ppermute; 'ulysses' all-to-alls to full-"
+                        "sequence head slices (sp must divide the per-tp "
+                        "head counts)")
     g.add_argument("--pipeline-parallel-size", "-pp", type=int, default=1,
                    help="pipeline stages across the mesh")
     g.add_argument("--data-parallel-size", "-dp", type=int, default=1,
