@@ -310,3 +310,40 @@ def test_pack_probe_does_not_pin_prefix_pages(tiny_model_dir):
     # every page must be reclaimable once all requests finished: cached
     # pages sit in the reusable pool, none pinned by leaked refcounts
     assert alloc.num_free == alloc.num_blocks
+
+
+def test_packed_prefill_with_fsm_rows(tiny_model_dir):
+    """Guided-decoding requests pack too: the packed sampler carries a
+    per-row FSM mask, so each packed prompt's FIRST sampled token already
+    honors its constraint."""
+    from vllm_tgis_adapter_tpu.engine.sampling_params import (
+        SamplingParams,
+        StructuredOutputsParams,
+    )
+    from vllm_tgis_adapter_tpu.engine.scheduler import PackedPrefillPlan
+
+    engine = _engine(tiny_model_dir)
+    packed_plans = []
+    orig_schedule = engine.scheduler.schedule
+
+    def spy(**kwargs):
+        plan = orig_schedule(**kwargs)
+        if isinstance(plan, PackedPrefillPlan):
+            packed_plans.append(plan)
+        return plan
+
+    engine.scheduler.schedule = spy
+    for i in range(2):
+        engine.add_request(
+            f"guided-{i}", f"pick {i}",
+            SamplingParams(
+                temperature=0.0, max_tokens=8,
+                structured_outputs=StructuredOutputsParams(
+                    choice=["yes", "no"]
+                ),
+            ),
+        )
+    outputs = _drain(engine)
+    assert packed_plans and len(packed_plans[0].items) == 2
+    for i in range(2):
+        assert outputs[f"guided-{i}"].outputs[0].text in ("yes", "no")

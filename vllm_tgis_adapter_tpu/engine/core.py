@@ -445,17 +445,27 @@ class LLMEngine:
                 and seq.draft_pos == plan.start_pos
             ):
                 seq.draft_pos = plan.start_pos + len(plan.token_ids)
-            if sampled is None:
-                return []  # mid-prompt chunk: nothing emitted yet
             if seq.is_finished:
                 return []  # aborted while the dispatch was in flight
+            if (
+                seq.params.prompt_logprobs is not None
+                and seq.prompt_logprobs is None
+                and plan.start_pos == 0
+            ):
+                # the table always exists once prefill ran — a 1-token
+                # prompt has zero computable rows but still reports
+                # [None] (position 0 never has a logprob)
+                seq.prompt_logprobs = [None]
+            if prompt_info is not None:
+                # chunked prompt-logprobs: each chunk appends its rows
+                self._append_prompt_logprobs(
+                    seq, prompt_info, plan.start_pos
+                )
+            if sampled is None:
+                return []  # mid-prompt chunk: nothing emitted yet
             # the prompt's K/V is now fully resident: publish its full
             # pages for prefix reuse (no-op unless --enable-prefix-caching)
             self.scheduler.register_prefix(seq)
-            if prompt_info is not None and seq.prompt_logprobs is None:
-                seq.prompt_logprobs = self._build_prompt_logprobs(
-                    seq, prompt_info
-                )
             return self._process_sampled([seq], [[sampled]])
         outputs = self._process_sampled(plan.seqs, result)
         if prepared is not None and getattr(prepared, "spec_ran", False):
@@ -568,13 +578,24 @@ class LLMEngine:
             )
         return entry
 
-    def _build_prompt_logprobs(
-        self, seq: Sequence, info: PromptLogprobInfo
-    ) -> list:
+    def _append_prompt_logprobs(
+        self, seq: Sequence, info: PromptLogprobInfo, start_pos: int
+    ) -> None:
+        """Fold one (chunk's) prompt-logprob rows into the sequence's
+        table.  Row i describes position ``start_pos + i + 1``; chunks
+        commit in order, so appends only happen when the table's length
+        is exactly the chunk's start — a preemption-resume re-running
+        chunks over an already-recorded span is a no-op."""
+        if seq.prompt_logprobs is None:
+            seq.prompt_logprobs = [None]  # position 0 has no logprob
+        if len(seq.prompt_logprobs) != start_pos + 1:
+            return
         n = seq.params.prompt_logprobs or 0
-        result: list = [None]  # position 0 has no logprob
         for i in range(len(info.logprobs)):
-            token_id = seq.prompt_token_ids[i + 1]
+            pos = start_pos + i + 1
+            if pos >= len(seq.prompt_token_ids):
+                break
+            token_id = seq.prompt_token_ids[pos]
             entry: dict[int, Logprob] = {}
             for j in range(min(n, len(info.topn_ids[i]))):
                 tid = info.topn_ids[i][j]
@@ -589,5 +610,4 @@ class LLMEngine:
                     rank=info.ranks[i],
                     decoded_token=self._decode_token_text(token_id),
                 )
-            result.append(entry)
-        return result
+            seq.prompt_logprobs.append(entry)

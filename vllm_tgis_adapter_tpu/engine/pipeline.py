@@ -372,21 +372,18 @@ class PipelineRunner(ModelRunner):
                 logits = out
             else:
                 hidden = out
-        if not prep.is_final:
-            return None, None
-
         prompt_info = None
         if prep.want_prompt_lp:
-            lp, rank, tn_ids, tn_lp = sampler_mod.prompt_logprob_info(
-                logits, jnp.asarray(prep.token_ids)
+            prompt_info = PromptLogprobInfo.from_parts(
+                sampler_mod.prompt_logprob_info(
+                    logits, jnp.asarray(prep.lp_targets)
+                ),
+                prep.lp_rows,
             )
-            n = t - 1
-            prompt_info = PromptLogprobInfo(
-                logprobs=np.asarray(lp)[:n].tolist(),
-                ranks=np.asarray(rank)[:n].tolist(),
-                topn_ids=np.asarray(tn_ids)[:n].tolist(),
-                topn_logprobs=np.asarray(tn_lp)[:n].tolist(),
-            )
+        if not prep.is_final:
+            return None, prompt_info  # lp chunks carry their table rows
+
+        if prep.want_prompt_lp:
             last_logits = logits[t - 1][None]
         else:
             last_logits = logits

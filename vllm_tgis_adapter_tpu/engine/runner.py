@@ -54,6 +54,19 @@ class PromptLogprobInfo:
     topn_ids: list[list[int]]
     topn_logprobs: list[list[float]]
 
+    @classmethod
+    def from_parts(cls, parts, n: int) -> "PromptLogprobInfo":
+        """Slice the device tuple from sampler.prompt_logprob_info down
+        to the ``n`` valid rows (shared by the single-runner and
+        pipeline-runner prefill paths)."""
+        lp, rank, tn_ids, tn_lp = parts
+        return cls(
+            logprobs=np.asarray(lp)[:n].tolist(),
+            ranks=np.asarray(rank)[:n].tolist(),
+            topn_ids=np.asarray(tn_ids)[:n].tolist(),
+            topn_logprobs=np.asarray(tn_lp)[:n].tolist(),
+        )
+
 
 @dataclasses.dataclass
 class PreparedPrefill:
@@ -80,6 +93,11 @@ class PreparedPrefill:
     # mirror this chunk into the draft cache (spec-eligible rows only —
     # ineligible rows would pay a draft forward they can never use)
     spec_eligible: bool = False
+    # chunked prompt-logprobs: token each logits row predicts (-1 pads;
+    # a chunk's last row targets the NEXT chunk's first token) and the
+    # valid row count — positions past the prompt carry none
+    lp_targets: "Optional[np.ndarray]" = None
+    lp_rows: int = 0
 
 
 @dataclasses.dataclass
@@ -445,9 +463,32 @@ class ModelRunner:
         slot_mapping = np.full(bucket, -1, np.int32)
         slot_mapping[:t] = plan.slots
 
-        want_prompt_lp = (
-            plan.is_final and seq.params.prompt_logprobs is not None
+        # chunked prompt-logprobs: EVERY chunk of an lp request computes
+        # full-bucket logits and its per-row targets; the table
+        # accumulates at commit (core._append_prompt_logprobs).  A
+        # preemption-resume whose table is already complete skips the
+        # extra logits work entirely.
+        n_prompt = seq.num_prompt_tokens
+        table_done = (
+            seq.prompt_logprobs is not None
+            and len(seq.prompt_logprobs) >= n_prompt
         )
+        want_prompt_lp = (
+            seq.params.prompt_logprobs is not None and not table_done
+        )
+        lp_targets = None
+        lp_rows = 0
+        if want_prompt_lp:
+            # row i predicts global position start+i+1; rows past the
+            # last PROMPT position carry no entry (resume re-runs cover
+            # generated positions too)
+            lp_rows = max(0, min(t, n_prompt - 1 - plan.start_pos))
+            all_ids = seq.all_token_ids
+            lp_targets = np.full(bucket, -1, np.int32)
+            lp_targets[:lp_rows] = all_ids[
+                plan.start_pos + 1 : plan.start_pos + 1 + lp_rows
+            ]
+            want_prompt_lp = lp_rows > 0
         # logits rows: the sampled row only, except prompt-logprob requests
         # which need every bucket row.  (The bucket is already the smallest
         # compile shape ≥ t, so an exact [t]-row gather would only change
@@ -495,6 +536,8 @@ class ModelRunner:
             block_table=block_table,
             logits_indices=logits_indices,
             want_prompt_lp=want_prompt_lp,
+            lp_targets=lp_targets,
+            lp_rows=lp_rows,
             row_slot=seq.slot,
             seen_tokens=seen_tokens,
             tensors=tensors,
@@ -544,14 +587,19 @@ class ModelRunner:
             # the draft model needs the prompt in ITS cache before it can
             # propose continuations
             self.spec.draft_prefill(prep)
-        if not prep.is_final:
-            return None  # mid-prompt chunk: nothing to sample
-
         lp_parts = None
         if prep.want_prompt_lp:
             lp_parts = sampler_mod.prompt_logprob_info(
-                logits, jnp.asarray(prep.token_ids)
+                logits, self._put(prep.lp_targets)
             )
+        if not prep.is_final:
+            # mid-prompt chunk: nothing to sample, but an lp chunk's
+            # per-row table travels back for accumulation
+            if lp_parts is None:
+                return None
+            return {"out": None, "lp": lp_parts}
+
+        if prep.want_prompt_lp:
             last_logits = logits[t - 1][None]
         else:
             last_logits = logits
@@ -588,17 +636,14 @@ class ModelRunner:
     ) -> tuple[Optional[SampledToken], Optional[PromptLogprobInfo]]:
         """Blocking half: pull the dispatched results to host."""
         if handle is None:
-            return None, None  # mid-prompt chunk
+            return None, None  # mid-prompt chunk without lp accumulation
         prompt_info = None
         if handle["lp"] is not None:
-            lp, rank, tn_ids, tn_lp = handle["lp"]
-            n = prep.t - 1  # rows 0..t-2 describe positions 1..t-1
-            prompt_info = PromptLogprobInfo(
-                logprobs=np.asarray(lp)[:n].tolist(),
-                ranks=np.asarray(rank)[:n].tolist(),
-                topn_ids=np.asarray(tn_ids)[:n].tolist(),
-                topn_logprobs=np.asarray(tn_lp)[:n].tolist(),
+            prompt_info = PromptLogprobInfo.from_parts(
+                handle["lp"], prep.lp_rows
             )
+        if handle["out"] is None:
+            return None, prompt_info  # lp chunk: table rows only
         host = _HostSamplerOutput.from_device(
             jax.tree.map(lambda x: x[None], handle["out"])
         )

@@ -290,17 +290,20 @@ def set_seen_rows(
 
 @jax.jit
 def prompt_logprob_info(
-    logits: jax.Array,  # [T, V] prefill logits (row i predicts token i+1)
-    token_ids: jax.Array,  # [T] the prompt tokens
+    logits: jax.Array,  # [T, V] prefill (chunk) logits
+    targets: jax.Array,  # [T] token each row predicts (-1 pads)
 ) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """Per-position prompt logprob/rank/top-N (TGIS input token details).
 
-    Row i of the result describes prompt position i+1; the caller offsets
-    accordingly (position 0 has no logprob).
+    Row i describes the prediction of ``targets[i]`` — the token at the
+    NEXT global position.  Targets cross chunk boundaries (the host
+    supplies the next chunk's first token for a chunk's last row), which
+    is what makes chunked prompt-logprobs exact; negative pads clamp and
+    the caller slices the valid row count.
     """
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-    nxt = jnp.roll(token_ids, -1)
-    chosen = jnp.take_along_axis(logp, nxt[:, None], axis=-1)[:, 0]
+    safe = jnp.clip(targets, 0, logp.shape[-1] - 1)
+    chosen = jnp.take_along_axis(logp, safe[:, None], axis=-1)[:, 0]
     rank = 1 + jnp.sum(logp > chosen[:, None], axis=-1).astype(jnp.int32)
     topn_lp, topn_ids = jax.lax.top_k(logp, min(TOPN_WIDTH, logp.shape[-1]))
     return chosen, rank, topn_ids.astype(jnp.int32), topn_lp

@@ -297,10 +297,11 @@ class Scheduler:
             items=items, bucket_len=self._prefill_bucket(total)
         )
 
-    def _chunkable(self, seq: Sequence) -> bool:
-        # prompt-logprob requests need one pass over the whole prompt (the
-        # per-position logprob table is built from a single bucket of
-        # logits) — they are admitted unchunked
+    def _adoptable(self, seq: Sequence) -> bool:
+        # prompt-logprob requests never adopt cached prefix pages: the
+        # adopted span's logits are skipped, so its table rows could
+        # never be computed.  (Chunked admission is fine — each chunk
+        # computes and appends its own rows, runner.prepare_prefill.)
         return seq.params.prompt_logprobs is None
 
     def _try_schedule_prefill(self) -> Optional[PrefillPlan]:
@@ -317,10 +318,10 @@ class Scheduler:
             # tokens skip prefill entirely (the first chunk then starts at
             # start_pos = matched and attends to the shared pages through
             # the paged cache, exactly like a later chunk).  prompt-logprob
-            # requests never adopt: their per-position table is built from
-            # one pass over the WHOLE prompt (same reason they don't chunk)
+            # requests never adopt (their skipped span's table rows could
+            # never be computed — see _adoptable)
             seq.blocks = SequenceBlocks(self.allocator)
-            if self._chunkable(seq):
+            if self._adoptable(seq):
                 hit_blocks, matched = self.allocator.match_prefix(
                     token_ids, seq.lora_name
                 )
@@ -328,11 +329,7 @@ class Scheduler:
                     seq.blocks.adopt(hit_blocks)
                     seq.prefill_pos = matched
         remaining = total - seq.prefill_pos
-        chunk = (
-            min(remaining, self.chunk_budget)
-            if self._chunkable(seq)
-            else remaining
-        )
+        chunk = min(remaining, self.chunk_budget)
         bucket = self._prefill_bucket(chunk)
 
         def roll_back_admission() -> None:
