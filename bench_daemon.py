@@ -131,7 +131,10 @@ def _run_bench(attention_backend: str | None) -> dict | None:
     env.setdefault("BENCH_PROBE_TIMEOUT", "90")
     env.setdefault("BENCH_TPU_TIMEOUT", "1200")
     tag = f"bench[{attention_backend or 'default'}]"
-    if attention_backend:
+    if attention_backend == "int8":
+        # weight-only int8 variant rides the default attention backend
+        env["BENCH_QUANT"] = "1"
+    elif attention_backend:
         env["ATTENTION_BACKEND"] = attention_backend
     rc, out, err = _run_bounded(
         [sys.executable, os.path.join(REPO, "bench.py")], 1500, env, tag)
@@ -175,11 +178,13 @@ def main() -> None:
                 with open(os.path.join(WATCH_DIR, "bench_success.json"),
                           "w") as f:
                     json.dump(result, f, indent=1)
-            # window may still be open: run the Mosaic gates + xla delta
+            # window may still be open: run the Mosaic gates, the
+            # pallas-vs-xla delta and the int8 variant
             _run_tpu_tests()
             xla = _run_bench("xla")
             if xla and xla.get("backend") == "tpu" and not captured:
                 captured = True
+            _run_bench("int8")
             if captured:
                 _log("capture complete; exiting")
                 return
