@@ -212,7 +212,8 @@ def run_bench(on_tpu: bool) -> dict:
     # the serving-path feature the bench is meant to exercise
     from vllm_tgis_adapter_tpu.engine.scheduler import PackedPrefillPlan
 
-    pack_stats = {"packed_dispatches": 0, "packed_prompts": 0}
+    pack_stats = {"packed_dispatches": 0, "packed_prompts": 0,
+                  "chained_dispatches": 0}
     orig_schedule = engine.scheduler.schedule
 
     def counting_schedule(**kwargs):
@@ -223,6 +224,13 @@ def run_bench(on_tpu: bool) -> dict:
         return plan
 
     engine.scheduler.schedule = counting_schedule
+    orig_chained = engine.dispatch_chained_step
+
+    def counting_chained(plan, prepared, prev_handle):
+        pack_stats["chained_dispatches"] += 1
+        return orig_chained(plan, prepared, prev_handle)
+
+    engine.dispatch_chained_step = counting_chained
 
     # matmul weight elements -> decode FLOPs/token (2*N MACs) for MFU
     matmul_elems = sum(

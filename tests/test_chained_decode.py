@@ -1,0 +1,234 @@
+"""Chained decode waves (vLLM-style async scheduling).
+
+While one fused decode wave executes on device, its successor is planned
+from host projections and dispatched with token feedback read from the
+in-flight wave's device outputs (engine/runner.py chained_decode_steps).
+Pinned here:
+
+* greedy output parity with the synchronous engine;
+* rows finishing early (EOS/max_tokens) mid-chain discard the successor
+  wave's tokens without corrupting batchmates;
+* abort while a chained wave is in flight;
+* the free-quarantine epochs that keep stale projected writes off
+  re-allocated pages.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+
+def _config(tiny_model_dir, **sched):
+    from vllm_tgis_adapter_tpu.engine.config import (
+        CacheConfig,
+        EngineConfig,
+        LoRAConfig,
+        ModelConfig,
+        ParallelConfig,
+        SchedulerConfig,
+    )
+
+    mcfg = ModelConfig.from_pretrained(tiny_model_dir, dtype="float32")
+    return EngineConfig(
+        model_config=mcfg,
+        cache_config=CacheConfig(block_size=16, num_blocks=64,
+                                 cache_dtype=mcfg.dtype),
+        scheduler_config=SchedulerConfig(
+            max_num_seqs=4, prefill_buckets=(32,),
+            num_decode_steps=sched.pop("num_decode_steps", 4), **sched),
+        parallel_config=ParallelConfig(),
+        lora_config=LoRAConfig(),
+    )
+
+
+def _sync_baseline(config, requests):
+    from vllm_tgis_adapter_tpu.engine.core import LLMEngine
+    from vllm_tgis_adapter_tpu.engine.sampling_params import SamplingParams
+
+    engine = LLMEngine.from_config(config)
+    for rid, ids, kwargs in requests:
+        engine.add_request(rid, None, SamplingParams(**kwargs),
+                           prompt_token_ids=ids)
+    outs = {}
+    for _ in range(400):
+        if not engine.has_unfinished_requests():
+            break
+        for out in engine.step():
+            if out.finished:
+                outs[out.request_id] = out
+    return {rid: o.outputs[0].token_ids for rid, o in outs.items()}
+
+
+def _async_run(config, requests, expect_chained=True):
+    from vllm_tgis_adapter_tpu.engine.async_llm import AsyncLLMEngine
+    from vllm_tgis_adapter_tpu.engine.core import LLMEngine
+    from vllm_tgis_adapter_tpu.engine.sampling_params import SamplingParams
+
+    async def scenario():
+        core = LLMEngine.from_config(config)
+        engine = AsyncLLMEngine(core)
+        chained_count = [0]
+        inner = core.dispatch_chained_step
+
+        def spy(plan, prepared, prev_handle):
+            chained_count[0] += 1
+            return inner(plan, prepared, prev_handle)
+
+        core.dispatch_chained_step = spy
+        results = {}
+
+        async def one(rid, ids, kwargs):
+            final = None
+            async for out in engine.generate(
+                prompt=None,
+                sampling_params=SamplingParams(**kwargs),
+                request_id=rid,
+                prompt_token_ids=ids,
+            ):
+                final = out
+            results[rid] = final.outputs[0].token_ids
+
+        await asyncio.gather(
+            *[one(rid, ids, kw) for rid, ids, kw in requests]
+        )
+        await engine.stop()
+        return results, chained_count[0]
+
+    results, chained = asyncio.run(scenario())
+    if expect_chained:
+        assert chained > 0, "no chained decode wave was dispatched"
+    return results
+
+
+def test_chained_greedy_matches_sync(tiny_model_dir):
+    """Long greedy generations (many waves) must be token-identical to
+    the synchronous engine, and chained dispatches must actually fire."""
+    requests = [
+        ("a", list(range(3, 10)),
+         dict(temperature=0.0, max_tokens=32, ignore_eos=True)),
+        ("b", list(range(11, 20)),
+         dict(temperature=0.0, max_tokens=32, ignore_eos=True)),
+    ]
+    baseline = _sync_baseline(_config(tiny_model_dir), requests)
+    chained = _async_run(_config(tiny_model_dir), requests)
+    assert chained == baseline
+
+
+def test_chained_seeded_sampling_matches_sync(tiny_model_dir):
+    """Chained waves keep the position-based PRNG streams: a seeded
+    sampled request produces the identical tokens as the sync engine."""
+    requests = [
+        ("s", list(range(3, 10)),
+         dict(temperature=0.9, seed=11, max_tokens=24, ignore_eos=True)),
+    ]
+    baseline = _sync_baseline(_config(tiny_model_dir), requests)
+    chained = _async_run(_config(tiny_model_dir), requests)
+    assert chained == baseline
+
+
+def test_chained_mixed_lengths_early_finish(tiny_model_dir):
+    """A row hitting max_tokens mid-chain discards its projected wave
+    tokens; surviving batchmates stay token-identical to sync."""
+    requests = [
+        ("short", list(range(3, 10)),
+         dict(temperature=0.0, max_tokens=6, ignore_eos=True)),
+        ("long", list(range(11, 20)),
+         dict(temperature=0.0, max_tokens=40, ignore_eos=True)),
+    ]
+    baseline = _sync_baseline(_config(tiny_model_dir), requests)
+    chained = _async_run(_config(tiny_model_dir), requests)
+    assert chained == baseline
+    assert len(chained["short"]) == 6
+    assert len(chained["long"]) == 40
+
+
+def test_abort_during_chained_flight(tiny_model_dir):
+    """abort() landing while a chained wave is in flight cancels the
+    request; its packmate completes identically to sync."""
+    from vllm_tgis_adapter_tpu.engine.async_llm import AsyncLLMEngine
+    from vllm_tgis_adapter_tpu.engine.core import LLMEngine
+    from vllm_tgis_adapter_tpu.engine.sampling_params import (
+        RequestOutputKind,
+        SamplingParams,
+    )
+
+    config = _config(tiny_model_dir)
+
+    async def scenario():
+        core = LLMEngine.from_config(config)
+        engine = AsyncLLMEngine(core)
+        chained_seen = asyncio.Event()
+        inner = core.dispatch_chained_step
+
+        def spy(plan, prepared, prev_handle):
+            chained_seen.set()
+            return inner(plan, prepared, prev_handle)
+
+        core.dispatch_chained_step = spy
+
+        outs = {}
+
+        async def one(rid, max_tokens):
+            final = None
+            produced = 0
+            async for out in engine.generate(
+                prompt=None,
+                sampling_params=SamplingParams(
+                    temperature=0.0, max_tokens=max_tokens,
+                    ignore_eos=True,
+                    output_kind=RequestOutputKind.DELTA),
+                request_id=rid,
+                prompt_token_ids=list(range(3, 10)),
+            ):
+                final = out
+                produced += len(out.outputs[0].token_ids)
+            outs[rid] = (final, produced)
+
+        tasks = [
+            asyncio.create_task(one("victim", 64)),
+            asyncio.create_task(one("survivor", 64)),
+        ]
+        await asyncio.wait_for(chained_seen.wait(), timeout=30)
+        await engine.abort("victim")
+        await asyncio.wait_for(asyncio.gather(*tasks), timeout=60)
+        await engine.stop()
+        # pool fully reclaimable once everything finished (quarantine
+        # epochs all flushed)
+        alloc = core.scheduler.allocator
+        assert not alloc._free_epochs
+        assert alloc.num_free == alloc.num_blocks
+        return outs
+
+    outs = asyncio.run(scenario())
+    assert outs["victim"][0].outputs[0].finish_reason == "abort"
+    assert outs["survivor"][0].outputs[0].finish_reason == "length"
+    assert outs["survivor"][1] == 64
+
+
+def test_free_epoch_quarantine_unit():
+    """free() during an open epoch buffers; pages release only at the
+    matching flush, in FIFO epoch order."""
+    from vllm_tgis_adapter_tpu.engine.kv_cache import BlockAllocator
+
+    alloc = BlockAllocator(8, 16)
+    a = alloc.allocate(2)
+    b = alloc.allocate(2)
+    assert alloc.num_free == 4
+
+    alloc.begin_free_epoch()
+    alloc.free(a)
+    assert alloc.num_free == 4  # quarantined, not reusable
+    alloc.begin_free_epoch()
+    alloc.free(b)
+    assert alloc.num_free == 4
+
+    alloc.flush_free_epoch()  # oldest epoch: releases a
+    assert alloc.num_free == 6
+    alloc.flush_free_epoch()
+    assert alloc.num_free == 8
+    # balanced: no epochs left, frees are immediate again
+    c = alloc.allocate(1)
+    alloc.free(c)
+    assert alloc.num_free == 8

@@ -298,3 +298,48 @@ def test_prefix_cache_engine_end_to_end(tiny_model_dir):
     assert len(final.prompt_logprobs) == len(shared)
     assert final.prompt_logprobs[0] is None
     assert all(e is not None for e in final.prompt_logprobs[1:])
+
+
+def test_fp8_kv_cache_end_to_end(tiny_model_dir):
+    """--kv-cache-dtype float8_e4m3 really stores the KV pool in fp8
+    (half the pages' bytes) and generation still runs: K/V quantize on
+    the cache write, attention reads cast back to f32 (truthful-flag
+    audit, round 4)."""
+    import jax.numpy as jnp
+
+    from vllm_tgis_adapter_tpu.engine.config import (
+        CacheConfig,
+        EngineConfig,
+        LoRAConfig,
+        ModelConfig,
+        ParallelConfig,
+        SchedulerConfig,
+    )
+    from vllm_tgis_adapter_tpu.engine.core import LLMEngine
+    from vllm_tgis_adapter_tpu.engine.sampling_params import SamplingParams
+
+    mcfg = ModelConfig.from_pretrained(tiny_model_dir, dtype="float32")
+    config = EngineConfig(
+        model_config=mcfg,
+        cache_config=CacheConfig(block_size=16, num_blocks=64,
+                                 cache_dtype=jnp.float8_e4m3fn),
+        scheduler_config=SchedulerConfig(
+            max_num_seqs=4, prefill_buckets=(32,)),
+        parallel_config=ParallelConfig(),
+        lora_config=LoRAConfig(),
+    )
+    engine = LLMEngine.from_config(config)
+    assert engine.runner.caches[0].dtype == jnp.float8_e4m3fn
+    engine.add_request(
+        "f8", None,
+        SamplingParams(temperature=0.0, max_tokens=8, ignore_eos=True),
+        prompt_token_ids=list(range(3, 12)),
+    )
+    toks = None
+    for _ in range(100):
+        if not engine.has_unfinished_requests():
+            break
+        for out in engine.step():
+            if out.finished:
+                toks = out.outputs[0].token_ids
+    assert toks is not None and len(toks) == 8

@@ -415,8 +415,12 @@ class AsyncLLMEngine:
         the reference consumes from vLLM
         (/root/reference/src/vllm_tgis_adapter/grpc/grpc_server.py:205).
         """
+        from vllm_tgis_adapter_tpu.engine.runner import SYNC_DISPATCH
+
         engine = rep.engine
-        in_flight: Optional[tuple] = None  # (plan, prepared, handle)
+        # (plan, prepared, handle, chained) — chained waves hold a free
+        # quarantine epoch open until they retire
+        in_flight: Optional[tuple] = None
 
         async def emit(outputs) -> None:
             for out in outputs:
@@ -430,14 +434,44 @@ class AsyncLLMEngine:
 
         async def commit_in_flight() -> None:
             nonlocal in_flight
-            plan, prepared, handle = in_flight
+            plan, prepared, handle, chained = in_flight
             result = await asyncio.to_thread(
                 engine.wait_step, plan, prepared, handle
             )
             async with rep.lock:
+                if chained:
+                    # this wave has retired: the frees quarantined when
+                    # it was dispatched can no longer be stale-written
+                    engine.flush_free_epoch()
                 outs = engine.commit_step(plan, result, prepared)
             in_flight = None
             await emit(outs)
+
+        async def try_chain() -> Optional[tuple]:
+            """Dispatch the in-flight decode's successor wave from
+            device-resident token feedback (async scheduling).  Returns
+            the successor's in_flight tuple, or None when chaining is
+            not possible."""
+            plan, prepared, handle, _ = in_flight
+            if handle is SYNC_DISPATCH:
+                return None
+            async with rep.lock:
+                chained = engine.plan_chained_step(plan, prepared)
+                if chained is None:
+                    return None
+                # the quarantine epoch opens in the SAME critical section
+                # that planned the successor: from this point any free —
+                # an abort sneaking in during the dispatch await, or the
+                # predecessor's commit reaping finished rows — buffers
+                # until the successor (whose block tables reference those
+                # pages) has retired
+                engine.begin_free_epoch()
+            c_plan, c_prep = chained
+            c_handle = await asyncio.to_thread(
+                engine.dispatch_chained_step, c_plan, c_prep, handle
+            )
+            await commit_in_flight()
+            return (c_plan, c_prep, c_handle, True)
 
         try:
             while not self._stopped:
@@ -452,6 +486,10 @@ class AsyncLLMEngine:
                 await emit(outputs)
                 if plan is None:
                     if in_flight is not None:
+                        chained = await try_chain()
+                        if chained is not None:
+                            in_flight = chained
+                            continue
                         await commit_in_flight()
                     continue
                 handle = await asyncio.to_thread(
@@ -461,10 +499,6 @@ class AsyncLLMEngine:
                     # commits stay in dispatch order: drain the older
                     # dispatch (its device work overlapped our planning)
                     await commit_in_flight()
-                from vllm_tgis_adapter_tpu.engine.runner import (
-                    SYNC_DISPATCH,
-                )
-
                 if handle is SYNC_DISPATCH:
                     # not enqueue-only (speculative multi-phase verify,
                     # staged pipeline): the device work happens inside
@@ -473,10 +507,10 @@ class AsyncLLMEngine:
                     # BEFORE it on device, breaking the plan-order
                     # invariant (stale K/V writes onto re-allocated
                     # pages).  Execute and commit synchronously instead.
-                    in_flight = (plan, prepared, handle)
+                    in_flight = (plan, prepared, handle, False)
                     await commit_in_flight()
                 else:
-                    in_flight = (plan, prepared, handle)
+                    in_flight = (plan, prepared, handle, False)
         except asyncio.CancelledError:
             raise
         except BaseException as e:  # noqa: BLE001 — engine death is terminal
@@ -487,3 +521,7 @@ class AsyncLLMEngine:
             for queue in self._queues.values():
                 queue.put_nowait(e)
             raise
+        finally:
+            # epochs left open by a death between a chained dispatch and
+            # its commit would quarantine their pages forever
+            engine.flush_all_free_epochs()

@@ -418,6 +418,42 @@ class LLMEngine:
             return self.runner.wait_prefill(prepared, handle)
         return self.runner.wait_decode(prepared, handle)
 
+    # --------------------------------------------------- chained decode waves
+
+    def plan_chained_step(self, prev_plan, prev_prepared):
+        """Phase 1' (host, engine lock held): plan the SUCCESSOR decode
+        wave of an in-flight plain decode dispatch — projections assume
+        full step consumption; token feedback stays on device
+        (scheduler.schedule_chained / runner.prepare_chained_decode).
+        Returns (plan, prepared) or None when chaining is not safe."""
+        if not isinstance(prev_plan, DecodePlan):
+            return None
+        if prev_prepared.spec_ok:
+            return None  # speculative dispatches are SYNC, never chained
+        plan = self.scheduler.schedule_chained(prev_plan)
+        if plan is None:
+            return None
+        return plan, self.runner.prepare_chained_decode(
+            plan, prev_prepared
+        )
+
+    def dispatch_chained_step(self, plan, prepared, prev_handle):  # noqa: ARG002
+        """Phase 2a' (lock-free): enqueue the successor wave behind the
+        in-flight one."""
+        return self.runner.dispatch_chained_decode(prepared, prev_handle)
+
+    def begin_free_epoch(self) -> None:
+        self.scheduler.allocator.begin_free_epoch()
+
+    def flush_free_epoch(self) -> None:
+        self.scheduler.allocator.flush_free_epoch()
+
+    def flush_all_free_epochs(self) -> None:
+        """Step-loop teardown: nothing can be in flight any more, so any
+        epochs left open (loop died between a chained dispatch and its
+        commit) release their quarantined pages."""
+        self.scheduler.allocator.flush_all_free_epochs()
+
     def commit_step(self, plan, result, prepared=None) -> list[RequestOutput]:
         """Phase 3 (host, engine lock held): fold sampled tokens back into
         sequences; requests aborted mid-dispatch are skipped here."""

@@ -12,6 +12,7 @@ from the HBM budget left after weights (engine/core.py).
 
 from __future__ import annotations
 
+from collections import deque
 from typing import TYPE_CHECKING, Optional
 
 from vllm_tgis_adapter_tpu.logging import init_logger
@@ -170,6 +171,12 @@ class BlockAllocator:
         self._block_hash: dict[int, bytes] = {}
         self._cached_free: dict[int, None] = {}  # LRU order: oldest first
         self.prefix_hits = 0  # tokens served from cache (stats/metrics)
+        # free epochs (chained-decode quarantine, engine/async_llm.py):
+        # while a chained wave is in flight its predecessor's stale K/V
+        # writes may still land on pages freed by finished/aborted rows,
+        # so those frees buffer in the newest epoch and only release when
+        # the wave that could touch them has retired
+        self._free_epochs: deque[list[list[int]]] = deque()
 
     @property
     def num_free(self) -> int:
@@ -197,6 +204,14 @@ class BlockAllocator:
         return taken
 
     def free(self, blocks: list[int]) -> None:
+        if self._free_epochs:
+            # quarantined: released at flush_free_epoch once the in-flight
+            # chained wave (the last program that may write them) retires
+            self._free_epochs[-1].append(list(blocks))
+            return
+        self._free_now(blocks)
+
+    def _free_now(self, blocks: list[int]) -> None:
         for block in reversed(blocks):
             left = self._refcount.get(block, 1) - 1
             if left > 0:
@@ -209,6 +224,27 @@ class BlockAllocator:
                 self._cached_free[block] = None  # move to MRU end
             else:
                 self._free.append(block)
+
+    # ------------------------------------------------- chained-free epochs
+
+    def begin_free_epoch(self) -> None:
+        """Open a quarantine epoch: subsequent free() calls buffer until
+        the matching flush.  Epochs nest as a FIFO — one per in-flight
+        chained decode wave."""
+        self._free_epochs.append([])
+
+    def flush_free_epoch(self) -> None:
+        """Release the OLDEST epoch's buffered frees (its potential stale
+        writers have retired)."""
+        if not self._free_epochs:
+            return
+        for blocks in self._free_epochs.popleft():
+            self._free_now(blocks)
+
+    def flush_all_free_epochs(self) -> None:
+        """Chain ended with no wave in flight: release everything."""
+        while self._free_epochs:
+            self.flush_free_epoch()
 
     def blocks_needed(self, num_tokens: int) -> int:
         return -(-num_tokens // self.block_size)

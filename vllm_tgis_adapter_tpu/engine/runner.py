@@ -149,6 +149,9 @@ class PreparedDecode:
     # set by SpeculativeDecoder.run when the dispatch actually speculated
     # (commit then advances each row's draft_pos)
     spec_ran: bool = False
+    # chained wave (async scheduling): which step row of the PREVIOUS
+    # wave's device outputs feeds each row's input token
+    chain_idx: "Optional[np.ndarray]" = None
 
 
 @dataclasses.dataclass
@@ -424,6 +427,31 @@ class ModelRunner:
             return caches, seen, ints_out, floats_out
 
         donate = (1, 2) if jax.default_backend() == "tpu" else ()
+
+        def chained_decode_steps(
+            params, caches, seen,
+            prev_ints_out,  # [K_prev, B, 2+W] the in-flight wave's outputs
+            chain_idx,  # [B] i32: last live step per row in prev wave
+            ints, floats, block_tables, allowed_mask, lora, lora_idx,
+            num_steps: int,
+        ):
+            # chained wave (async scheduling): the input token of each row
+            # is the PREVIOUS wave's final sampled token, read directly
+            # from its device-resident outputs — no host round trip
+            # between decode waves
+            tokens0 = jnp.take_along_axis(
+                prev_ints_out[..., 0], chain_idx[None, :], axis=0
+            )[0]
+            ints = ints.at[0].set(tokens0)
+            return decode_steps(
+                params, caches, seen, ints, floats, block_tables,
+                allowed_mask, lora, lora_idx, num_steps,
+            )
+
+        self._chained_decode_fn = jax.jit(
+            chained_decode_steps, static_argnums=(11,),
+            donate_argnums=donate,
+        )
         return jax.jit(decode_steps, static_argnums=(9,),
                        donate_argnums=donate)
 
@@ -908,16 +936,97 @@ class ModelRunner:
             lora_idx=lora_idx,
         )
 
-    def dispatch_decode(self, prep: "PreparedDecode"):
-        """Enqueue the fused K-step decode; no blocking transfers.
+    def prepare_chained_decode(
+        self, plan: "DecodePlan", prev_prep: "PreparedDecode"
+    ) -> "PreparedDecode":
+        """Host inputs for the SUCCESSOR wave of ``prev_prep``, planned
+        while that wave still executes (scheduler.schedule_chained):
+        every per-row position/length/PRNG projection assumes the row
+        consumes its full previous step budget; the input tokens stay on
+        device (dispatch_chained_decode reads them from the in-flight
+        wave's outputs)."""
+        seqs = plan.seqs
+        b = plan.batch_bucket
+        prev_k = prev_prep.steps_per_seq
 
-        The speculative path runs multiple host-synchronised phases
-        (propose → verify → accept) and cannot enqueue-only: it returns
-        ``SYNC_DISPATCH`` and executes inside ``wait_decode`` instead.
-        """
-        if prep.spec_ok:
-            return SYNC_DISPATCH
+        token_ids = np.zeros(b, np.int32)  # overridden on device
+        positions = np.zeros(b, np.int32)
+        limits = np.full(b, -1, np.int32)
+        context_lens = np.ones(b, np.int32)
+        block_tables = np.zeros((b, self.max_blocks_per_seq), np.int32)
+        slots = np.full(b, -1, np.int32)
+        seeds = np.zeros(b, np.uint32)
+        chain_idx = np.zeros(b, np.int32)
+        gen_lens = []
+        for i, seq in enumerate(seqs):
+            pos = seq.num_tokens - 1 + prev_k[i]
+            positions[i] = pos
+            limits[i] = pos + plan.steps_per_seq[i] - 1
+            context_lens[i] = seq.num_tokens + prev_k[i]
+            blocks = seq.blocks.blocks
+            block_tables[i, : len(blocks)] = blocks
+            slots[i] = seq.slot
+            seeds[i] = seq.fallback_seed
+            chain_idx[i] = prev_k[i] - 1
+            gen_lens.append(seq.num_output_tokens + prev_k[i])
+
+        params_list = [s.params for s in seqs] + [None] * (b - len(seqs))
+        tensors = SamplingTensors.from_params(
+            params_list,
+            eos_token_id=self.config.model_config.eos_token_id,
+            gen_lens=gen_lens + [0] * (b - len(seqs)),
+            fallback_seeds=seeds,
+        )
+        lora_idx = None
+        if self.lora_stacks is not None:
+            lora_idx = np.zeros(b, np.int32)
+            for i, seq in enumerate(seqs):
+                lora_idx[i] = seq.lora_slot
+
+        return PreparedDecode(
+            num_seqs=len(seqs),
+            num_steps=plan.num_steps,
+            steps_per_seq=list(plan.steps_per_seq),
+            token_ids=token_ids,
+            positions=positions,
+            limits=limits,
+            context_lens=context_lens,
+            block_tables=block_tables,
+            slots=slots,
+            tensors=tensors,
+            allowed_mask=None,  # FSM rows never chain (scheduler bail)
+            lora_idx=lora_idx,
+            chain_idx=chain_idx,
+        )
+
+    def dispatch_chained_decode(self, prep: "PreparedDecode", prev_handle):
+        """Enqueue the successor wave behind the in-flight one, feeding
+        input tokens from its device-resident outputs."""
+        prev_ints_out, _ = prev_handle
         lora = self.lora_stacks if prep.lora_idx is not None else None
+        ints, floats = self._pack_decode_inputs(prep)
+        self.caches, self.seen, ints_out, floats_out = (
+            self._chained_decode_fn(
+                self.params,
+                self.caches,
+                self.seen,
+                prev_ints_out,
+                self._put(prep.chain_idx),
+                self._put(ints),
+                self._put(floats),
+                self._put(prep.block_tables),
+                None,
+                lora,
+                self._put(prep.lora_idx)
+                if prep.lora_idx is not None
+                else None,
+                prep.num_steps,
+            )
+        )
+        return ints_out, floats_out
+
+    def _pack_decode_inputs(self, prep: "PreparedDecode"):
+        """Two transfer-packed arrays (see _build_decode_fn docstring)."""
         t = prep.tensors
         ints = np.stack([
             prep.token_ids, prep.positions, prep.limits,
@@ -933,6 +1042,19 @@ class ModelRunner:
             t.temperature, t.top_p, t.typical_p,
             t.repetition_penalty, t.len_penalty_decay,
         ]).astype(np.float32)
+        return ints, floats
+
+    def dispatch_decode(self, prep: "PreparedDecode"):
+        """Enqueue the fused K-step decode; no blocking transfers.
+
+        The speculative path runs multiple host-synchronised phases
+        (propose → verify → accept) and cannot enqueue-only: it returns
+        ``SYNC_DISPATCH`` and executes inside ``wait_decode`` instead.
+        """
+        if prep.spec_ok:
+            return SYNC_DISPATCH
+        lora = self.lora_stacks if prep.lora_idx is not None else None
+        ints, floats = self._pack_decode_inputs(prep)
         self.caches, self.seen, ints_out, floats_out = self._decode_fn(
             self.params,
             self.caches,

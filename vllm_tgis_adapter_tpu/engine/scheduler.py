@@ -454,6 +454,70 @@ class Scheduler:
                 return b
         return self.batch_buckets[-1]
 
+    def schedule_chained(
+        self, prev: DecodePlan
+    ) -> Optional[DecodePlan]:
+        """Plan the NEXT decode wave while ``prev`` is still executing
+        (vLLM-style async scheduling): token feedback stays on device, so
+        the only host inputs are projections — each row is ASSUMED to
+        consume its full ``prev`` step budget.  Rows that finish early
+        simply discard the successor wave's tokens at commit (the
+        standard fused-decode over-run path).
+
+        Bails (returns None) whenever the projection could be wrong or
+        unsafe: waiting work exists (admissions/chunks take priority and
+        change the batch), any row is FSM-constrained (host must rebuild
+        its mask between tokens), the batch composition changed, or page
+        growth would need preemption (never preempt on a projection).
+        """
+        if self.waiting or not self.running:
+            return None
+        if len(self.running) != len(prev.seqs) or {
+            id(s) for s in self.running
+        } != {id(s) for s in prev.seqs}:
+            # a row finished/aborted since prev was planned: the device
+            # wave still runs it, but projections are stale — fall back
+            return None
+        # two passes: validate EVERY row before allocating a single page,
+        # so a bail on a later row cannot leave earlier rows holding
+        # speculative capacity for a wave that never dispatches
+        planned: list[int] = []
+        total_needed = 0
+        for seq, prev_k in zip(prev.seqs, prev.steps_per_seq):
+            if seq.fsm is not None:
+                return None
+            projected = seq.num_tokens + prev_k  # after prev commits
+            k = self.config.num_decode_steps
+            if seq.params.max_tokens is not None:
+                k = min(
+                    k,
+                    seq.params.max_tokens
+                    - (seq.num_output_tokens + prev_k),
+                )
+            k = min(k, self.max_model_len - projected)
+            if k < 1:
+                return None  # row exhausts its budget inside prev
+            total_needed += max(
+                0,
+                self.allocator.blocks_needed(projected - 1 + k)
+                - len(seq.blocks.blocks),
+            )
+            planned.append(k)
+        if total_needed > 0 and not self.allocator.can_allocate(
+            total_needed
+        ):
+            return None
+        for seq, prev_k, k in zip(
+            prev.seqs, prev.steps_per_seq, planned
+        ):
+            seq.blocks.ensure_capacity(seq.num_tokens + prev_k - 1 + k)
+        return DecodePlan(
+            seqs=list(prev.seqs),
+            batch_bucket=self._batch_bucket(len(prev.seqs)),
+            num_steps=max(planned),
+            steps_per_seq=planned,
+        )
+
     # ------------------------------------------------------------ preemption
 
     def _preempt_youngest(self, exclude: Optional[Sequence] = None) -> bool:
