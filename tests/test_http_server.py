@@ -251,3 +251,27 @@ def test_tokenize_validation(http_base_url):
     with pytest.raises(urllib.error.HTTPError) as excinfo:
         _post_json(f"{http_base_url}/detokenize", {"tokens": "nope"})
     assert excinfo.value.code == 400
+
+
+def test_root_path_prefix_stripped():
+    """--root-path: routes match with the reverse-proxy prefix stripped
+    (the flag used to be accepted and ignored — truthful-flag audit)."""
+    import asyncio
+
+    from vllm_tgis_adapter_tpu.http import App, HttpRequest, JsonResponse
+
+    app = App(root_path="/proxy/llm")
+
+    @app.route("GET", "/ping")
+    async def ping(app, request):  # noqa: ANN001, ARG001
+        return JsonResponse({"ok": True})
+
+    def req(path):
+        return HttpRequest(method="GET", path=path, headers={}, body=b"")
+
+    ok = asyncio.run(app.dispatch(req("/proxy/llm/ping")))
+    assert ok.status == 200
+    bare = asyncio.run(app.dispatch(req("/ping")))
+    assert bare.status == 200  # unprefixed still works (direct access)
+    missing = asyncio.run(app.dispatch(req("/proxy/llm/nope")))
+    assert missing.status == 404

@@ -347,3 +347,59 @@ def test_packed_prefill_with_fsm_rows(tiny_model_dir):
     assert packed_plans and len(packed_plans[0].items) == 2
     for i in range(2):
         assert outputs[f"guided-{i}"].outputs[0].text in ("yes", "no")
+
+
+def test_packed_prefill_under_tensor_parallel(tiny_model_dir):
+    """Packed prefill on a tp=2 mesh: the seg_starts operand rides
+    shard_map replicated while heads split — tokens must match the
+    single-device packed run."""
+    import jax
+
+    from vllm_tgis_adapter_tpu.engine.config import (
+        CacheConfig,
+        EngineConfig,
+        LoRAConfig,
+        ModelConfig,
+        ParallelConfig,
+        SchedulerConfig,
+    )
+    from vllm_tgis_adapter_tpu.engine.core import LLMEngine
+    from vllm_tgis_adapter_tpu.engine.sampling_params import SamplingParams
+    from vllm_tgis_adapter_tpu.engine.scheduler import PackedPrefillPlan
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the 8-device CPU mesh")
+
+    def run(tp):
+        mcfg = ModelConfig.from_pretrained(tiny_model_dir, dtype="float32")
+        engine = LLMEngine.from_config(EngineConfig(
+            model_config=mcfg,
+            cache_config=CacheConfig(block_size=16, num_blocks=64,
+                                     cache_dtype=mcfg.dtype),
+            scheduler_config=SchedulerConfig(
+                max_num_seqs=8, prefill_buckets=(32, 64)),
+            parallel_config=ParallelConfig(tensor_parallel_size=tp),
+            lora_config=LoRAConfig(),
+        ))
+        packed = []
+        orig = engine.scheduler.schedule
+
+        def spy(**kwargs):
+            plan = orig(**kwargs)
+            if isinstance(plan, PackedPrefillPlan):
+                packed.append(plan)
+            return plan
+
+        engine.scheduler.schedule = spy
+        for i in range(3):
+            engine.add_request(
+                f"r{i}", None,
+                SamplingParams(temperature=0.0, max_tokens=6,
+                               ignore_eos=True),
+                prompt_token_ids=list(range(3 + i, 12 + i)),
+            )
+        outs = _drain(engine)
+        assert packed, "packing did not engage"
+        return {rid: o.outputs[0].token_ids for rid, o in outs.items()}
+
+    assert run(2) == run(1)

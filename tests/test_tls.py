@@ -214,3 +214,27 @@ def test_mtls_requires_client_cert(tiny_model_dir, tmp_path, tls_material):
             client.make_request("no cert", model_id="m", max_new_tokens=2)
     finally:
         _stop_servers(loop, thread)
+
+
+def test_ssl_cert_reqs_overrides_mtls(tiny_model_dir, tmp_path,
+                                      tls_material):
+    """--ssl-cert-reqs 0 with a CA bundle: verify-if-presented but never
+    require — a cert-less TLS client must now succeed (the flag used to
+    be accepted and ignored)."""
+    from tests.utils import GrpcClient
+
+    args = _server_args(tiny_model_dir, tmp_path, tls_material, mtls=True)
+    args.ssl_cert_reqs = 0
+    loop, thread = _boot_servers(args)
+    try:
+        _wait_tls_healthy(args.grpc_port, tls_material,
+                          with_client_cert=False)
+        with GrpcClient(
+            "localhost", args.grpc_port, insecure=False,
+            ca_cert=tls_material["ca_pem"],
+        ) as client:
+            out = client.make_request("no cert needed", model_id="m",
+                                      max_new_tokens=4)
+            assert out.generated_token_count == 4
+    finally:
+        _stop_servers(loop, thread)

@@ -50,6 +50,15 @@ def create_server_socket(host: str | None, port: int) -> socket.socket:
 
 
 async def start_servers(args: "argparse.Namespace") -> None:
+    level = getattr(args, "uvicorn_log_level", None)
+    if level and level != "info":
+        # flag name kept for reference compat; here it drives the HTTP
+        # server module's own logger ("trace" maps below DEBUG)
+        import logging as _logging
+
+        _logging.getLogger("vllm_tgis_adapter_tpu.http").setLevel(
+            5 if level == "trace" else level.upper()
+        )
     sock = create_server_socket(args.host, args.port)
 
     if getattr(args, "jax_profiler_port", None):

@@ -577,6 +577,9 @@ class EngineConfig:
     parallel_config: ParallelConfig
     lora_config: LoRAConfig
     tokenizer: str | None = None
+    # allow custom tokenizer/config code shipped inside the (local)
+    # model directory — passed through to AutoTokenizer.from_pretrained
+    trust_remote_code: bool = False
     seed: int = 0
     max_logprobs: int = 20
     hbm_memory_utilization: float = 0.90
@@ -696,6 +699,7 @@ class EngineConfig:
             ),
             speculative=SpeculativeConfig.from_args(args, model_config),
             tokenizer=args.tokenizer,
+            trust_remote_code=getattr(args, "trust_remote_code", False),
             seed=args.seed,
             max_logprobs=args.max_logprobs,
             hbm_memory_utilization=args.hbm_memory_utilization,

@@ -215,7 +215,10 @@ class LLMEngine:
                 draft_cfg, spec.draft_model, place=place
             )
 
-        tokenizer = AutoTokenizer.from_pretrained(config.tokenizer or mcfg.model)
+        tokenizer = AutoTokenizer.from_pretrained(
+            config.tokenizer or mcfg.model,
+            trust_remote_code=config.trust_remote_code,
+        )
         # KV auto-sizing must read free HBM from a device THIS replica
         # owns: under dp, device 0 belongs to replica 0 and is already
         # full of replica-0 weights by the time later replicas size
@@ -249,7 +252,9 @@ class LLMEngine:
         if has_tok:
             from transformers import AutoTokenizer
 
-            tok = AutoTokenizer.from_pretrained(path)
+            tok = AutoTokenizer.from_pretrained(
+                path, trust_remote_code=self.config.trust_remote_code
+            )
         self._lora_tokenizers[path] = tok
         return tok
 

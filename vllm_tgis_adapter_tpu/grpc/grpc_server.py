@@ -531,7 +531,9 @@ class TextGenerationService(rpc.GenerationServiceServicer):
 def _tls_credentials(args: "argparse.Namespace"):  # noqa: ANN202
     """Build server TLS credentials from --ssl-* args, or None for
     plaintext.  mTLS (client-cert verification) turns on when a CA bundle
-    is supplied."""
+    is supplied; ``--ssl-cert-reqs`` (ssl.CERT_* constants) overrides:
+    0 = never require a client cert, 1 = request but don't require,
+    2 = always require."""
     if not (args.ssl_keyfile and args.ssl_certfile):
         return None
 
@@ -545,10 +547,17 @@ def _tls_credentials(args: "argparse.Namespace"):  # noqa: ANN202
     key = read(args.ssl_keyfile, "ssl_keyfile")
     cert = read(args.ssl_certfile, "ssl_certfile")
     ca = read(args.ssl_ca_certs, "ssl_ca_certs") if args.ssl_ca_certs else None
+    cert_reqs = getattr(args, "ssl_cert_reqs", None)
+    require = ca is not None if cert_reqs is None else cert_reqs == 2
+    if require and ca is None:
+        raise ValueError(
+            "--ssl-cert-reqs 2 (CERT_REQUIRED) needs --ssl-ca-certs to "
+            "verify client certificates against"
+        )
     return grpc.ssl_server_credentials(
         [(key, cert)],
         root_certificates=ca,
-        require_client_auth=ca is not None,
+        require_client_auth=require,
     )
 
 

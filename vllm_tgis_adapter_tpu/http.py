@@ -103,9 +103,12 @@ def error_response(status: int, message: str, err_type: str = "invalid_request_e
 class App:
     """Method+path router with the shared-engine state, FastAPI-app analog."""
 
-    def __init__(self) -> None:
+    def __init__(self, root_path: str | None = None) -> None:
         self.routes: dict[tuple[str, str], Callable] = {}
         self.state: dict[str, Any] = {}
+        # --root-path: prefix prepended by a reverse proxy; requests
+        # arrive as {root_path}{route} and are matched with it stripped
+        self.root_path = (root_path or "").rstrip("/")
 
     def route(self, method: str, path: str):  # noqa: ANN201
         def register(fn):  # noqa: ANN001, ANN202
@@ -115,9 +118,12 @@ class App:
         return register
 
     async def dispatch(self, request: HttpRequest):  # noqa: ANN201
-        handler = self.routes.get((request.method, request.path.split("?")[0]))
+        path = request.path.split("?")[0]
+        if self.root_path and path.startswith(self.root_path):
+            path = path[len(self.root_path):] or "/"
+        handler = self.routes.get((request.method, path))
         if handler is None:
-            if any(p == request.path for (_, p) in self.routes):
+            if any(p == path for (_, p) in self.routes):
                 return error_response(405, "method not allowed")
             return error_response(404, "not found")
         return await handler(self, request)
@@ -128,7 +134,7 @@ class App:
 
 def build_http_server(args: "argparse.Namespace", engine: "AsyncLLMEngine") -> App:
     """Assemble the app around the SHARED engine (reference: http.py:41-67)."""
-    app = App()
+    app = App(root_path=getattr(args, "root_path", None))
     app.state["engine"] = engine
     app.state["args"] = args
     served_names = args.served_model_name or [args.model]

@@ -258,8 +258,11 @@ def add_engine_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     g.add_argument("--ssl-keyfile", type=str, default=None)
     g.add_argument("--ssl-certfile", type=str, default=None)
     g.add_argument("--ssl-ca-certs", type=str, default=None)
-    g.add_argument("--ssl-cert-reqs", type=int, default=0,
-                   help="ssl.CERT_* constant for client cert verification")
+    g.add_argument("--ssl-cert-reqs", type=int, default=None,
+                   choices=[0, 1, 2],
+                   help="ssl.CERT_* constant for client cert verification "
+                        "(0 never, 1 optional, 2 required); default: "
+                        "required exactly when --ssl-ca-certs is given")
     g.add_argument("--root-path", type=str, default=None,
                    help="HTTP root path prefix when behind a proxy")
     g.add_argument("--api-key", type=str, default=None,
