@@ -232,3 +232,38 @@ def test_free_epoch_quarantine_unit():
     c = alloc.allocate(1)
     alloc.free(c)
     assert alloc.num_free == 8
+
+
+def test_engine_death_during_chained_wave_flushes_epochs(tiny_model_dir):
+    """A chained dispatch failure is whole-engine death (crash-fast):
+    consumers get the error, and the quarantine epochs flush at loop
+    teardown so no pages leak."""
+    from vllm_tgis_adapter_tpu.engine.async_llm import AsyncLLMEngine
+    from vllm_tgis_adapter_tpu.engine.core import LLMEngine
+    from vllm_tgis_adapter_tpu.engine.sampling_params import SamplingParams
+
+    config = _config(tiny_model_dir)
+
+    async def scenario():
+        core = LLMEngine.from_config(config)
+        engine = AsyncLLMEngine(core)
+
+        def boom(plan, prepared, prev_handle):
+            raise RuntimeError("injected chained-dispatch failure")
+
+        core.dispatch_chained_step = boom
+
+        with pytest.raises(RuntimeError, match="injected"):
+            async for _ in engine.generate(
+                prompt=None,
+                sampling_params=SamplingParams(
+                    temperature=0.0, max_tokens=32, ignore_eos=True),
+                request_id="doomed",
+                prompt_token_ids=list(range(3, 10)),
+            ):
+                pass
+        assert engine.errored
+        assert not core.scheduler.allocator._free_epochs
+        await engine.stop()
+
+    asyncio.run(scenario())

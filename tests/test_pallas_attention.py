@@ -474,3 +474,28 @@ def test_alibi_chunked_prefill_matches_reference():
     )
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_paged_decode_fp8_cache_matches_reference():
+    """--kv-cache-dtype float8_e4m3 through the Pallas decode kernel:
+    the cache stores f8, the kernel casts to f32 on read — parity with
+    the XLA formulation on the same quantized cache (the on-chip Mosaic
+    gate for this dtype rides tests/test_tpu_kernels.py)."""
+    b, num_kv, g, head_dim, block_size, max_blocks = 4, 2, 2, 64, 16, 4
+    q, k_cache, v_cache, bt, cl = make_paged_case(
+        0, b, num_kv, g, head_dim, block_size, max_blocks, num_slots=512
+    )
+    kc8 = jnp.asarray(k_cache).astype(jnp.float8_e4m3fn)
+    vc8 = jnp.asarray(v_cache).astype(jnp.float8_e4m3fn)
+    scale = head_dim**-0.5
+    ref = ref_ops.paged_decode_attention_xla(
+        jnp.asarray(q), kc8, vc8,
+        jnp.asarray(bt), jnp.asarray(cl), block_size, scale,
+    )
+    got = pk.paged_decode_attention(
+        jnp.asarray(q), kc8, vc8,
+        jnp.asarray(bt), jnp.asarray(cl), block_size, scale,
+        interpret=True,
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
