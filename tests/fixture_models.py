@@ -553,3 +553,60 @@ def build_tiny_lora_adapter(path: str, seed: int = 7, rank: int = 4) -> str:
         tensors[f"{p}.v_proj.lora_B.weight"] = w((hkv * dh, rank))
     save_file(tensors, out / "adapter_model.safetensors")
     return str(out)
+
+
+def build_tiny_gemma(path: str, seed: int = 0) -> str:
+    """Tiny gemma-architecture checkpoint: llama-style tensor names with
+    gemma block chemistry — GeGLU (gelu_pytorch_tanh), (1+w) RMSNorm,
+    sqrt(hidden)-scaled embeddings, tied head (no lm_head tensor)."""
+    import numpy as np
+    from safetensors.numpy import save_file
+
+    out = Path(path)
+    out.mkdir(parents=True, exist_ok=True)
+
+    tokenizer = build_tokenizer(path)
+    cfg = dict(TINY_LLAMA_CONFIG)
+    cfg["architectures"] = ["GemmaForCausalLM"]
+    cfg["model_type"] = "gemma"
+    cfg["hidden_activation"] = "gelu_pytorch_tanh"
+    cfg["tie_word_embeddings"] = True
+    cfg["vocab_size"] = max(cfg["vocab_size"], len(tokenizer))
+    with open(out / "config.json", "w") as f:
+        json.dump(cfg, f, indent=2)
+
+    rng = np.random.default_rng(seed)
+    d = cfg["hidden_size"]
+    dh = cfg["head_dim"]
+    h = cfg["num_attention_heads"]
+    hkv = cfg["num_key_value_heads"]
+    inter = cfg["intermediate_size"]
+    vocab = cfg["vocab_size"]
+
+    def w(shape):
+        return (rng.standard_normal(shape) * 0.02).astype(np.float32)
+
+    # HF gemma norms store w with (1+w) applied at runtime: random small
+    # values (not ones) so the offset path is actually exercised
+    def norm():
+        return (rng.standard_normal(d) * 0.1).astype(np.float32)
+
+    tensors = {
+        "model.embed_tokens.weight": w((vocab, d)),
+        "model.norm.weight": norm(),
+    }
+    for i in range(cfg["num_hidden_layers"]):
+        p = f"model.layers.{i}"
+        tensors |= {
+            f"{p}.input_layernorm.weight": norm(),
+            f"{p}.post_attention_layernorm.weight": norm(),
+            f"{p}.self_attn.q_proj.weight": w((h * dh, d)),
+            f"{p}.self_attn.k_proj.weight": w((hkv * dh, d)),
+            f"{p}.self_attn.v_proj.weight": w((hkv * dh, d)),
+            f"{p}.self_attn.o_proj.weight": w((d, h * dh)),
+            f"{p}.mlp.gate_proj.weight": w((inter, d)),
+            f"{p}.mlp.up_proj.weight": w((inter, d)),
+            f"{p}.mlp.down_proj.weight": w((d, inter)),
+        }
+    save_file(tensors, out / "model.safetensors")
+    return str(out)

@@ -79,6 +79,10 @@ class ModelConfig:
     # combination still compiles to one straight-line XLA program
     position_embedding: str = "rope"  # "rope" | "learned" | "alibi"
     norm_type: str = "rmsnorm"  # "rmsnorm" | "layernorm"
+    # gemma lineage: HF computes (1 + w) * x̂ in RMSNorm; the weight
+    # loader folds the offset into the stored weights once at load
+    # (engine/weights.py), so the runtime norm stays the plain w * x̂
+    norm_weight_offset: float = 0.0
     hidden_act: str = "silu"  # "silu" | "relu" | "gelu" | "gelu_new"
     gated_mlp: bool = True  # SwiGLU gate/up/down vs plain fc1/act/fc2
     attention_out_bias: bool = False
@@ -158,6 +162,24 @@ class ModelConfig:
                 "sliding-window attention enabled (window=%d tokens)",
                 sliding_window,
             )
+        hidden_act = hf.get("hidden_act") or "silu"
+        embedding_multiplier = hf.get("embedding_multiplier", 1.0)
+        norm_weight_offset = 0.0
+        tie = hf.get("tie_word_embeddings", False)
+        if model_type == "gemma":
+            # gemma: GeGLU MLP (HF spells the activation under
+            # hidden_activation, default gelu_pytorch_tanh == our
+            # gelu_new), sqrt(d)-scaled embeddings, (1+w) RMSNorm,
+            # tied head
+            act = (
+                hf.get("hidden_activation")
+                or hf.get("hidden_act")
+                or "gelu_pytorch_tanh"
+            )
+            hidden_act = {"gelu_pytorch_tanh": "gelu_new"}.get(act, act)
+            embedding_multiplier = float(hidden) ** 0.5
+            norm_weight_offset = 1.0
+            tie = hf.get("tie_word_embeddings", True)
         return ModelConfig(
             model=model,
             model_type=model_type,
@@ -171,12 +193,14 @@ class ModelConfig:
             max_model_len=max_model_len or derived_len,
             rope_theta=hf.get("rope_theta", 10000.0),
             rms_norm_eps=hf.get("rms_norm_eps", 1e-6),
-            tie_word_embeddings=hf.get("tie_word_embeddings", False),
+            tie_word_embeddings=tie,
             dtype=resolve_dtype(dtype),
             eos_token_id=eos,
             bos_token_id=hf.get("bos_token_id", 1) or 1,
             logits_scaling=hf.get("logits_scaling", 1.0),
-            embedding_multiplier=hf.get("embedding_multiplier", 1.0),
+            embedding_multiplier=embedding_multiplier,
+            hidden_act=hidden_act,
+            norm_weight_offset=norm_weight_offset,
             residual_multiplier=hf.get("residual_multiplier", 1.0),
             attention_multiplier=hf.get("attention_multiplier"),
             num_experts=hf.get("num_local_experts", 0),

@@ -86,6 +86,10 @@ def load_llama_params(
     place = place or (lambda _name, x: x)
     dtype = config.dtype
     raw = CheckpointIndex(model_path)
+    # gemma lineage: HF's RMSNorm computes (1 + w) * x̂; folding the
+    # offset into the stored weight once here keeps the runtime norm
+    # the plain w * x̂ shared by the whole family
+    norm_offset = getattr(config, "norm_weight_offset", 0.0)
 
     def take(name: str, transpose: bool = False) -> jax.Array:
         if name not in raw:
@@ -93,6 +97,9 @@ def load_llama_params(
         x = _np_to_jnp(raw.pop(name), dtype)
         if transpose:
             x = x.T
+        if norm_offset and name.endswith(("layernorm.weight",
+                                          "norm.weight")):
+            x = x + norm_offset
         return place(name, x)
 
     params: dict = {

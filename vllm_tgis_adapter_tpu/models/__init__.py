@@ -33,6 +33,9 @@ _REGISTRY = {
     # GPT-2: learned positions (no offset), Conv1D fused c_attn split
     # into column thirds by the loader (config.py _from_gpt2_config)
     "gpt2": LlamaForCausalLM,
+    # Gemma: GeGLU MLP, (1+w) RMSNorm folded into weights at load,
+    # sqrt(hidden)-scaled embeddings, tied head (config.py from_hf_config)
+    "gemma": LlamaForCausalLM,
 }
 
 
