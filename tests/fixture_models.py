@@ -610,3 +610,54 @@ def build_tiny_gemma(path: str, seed: int = 0) -> str:
         }
     save_file(tensors, out / "model.safetensors")
     return str(out)
+
+
+def build_tiny_phi3(path: str, seed: int = 0) -> str:
+    """Tiny phi3-architecture checkpoint: llama block chemistry with the
+    HF phi-3 FUSED projections — qkv_proj (q|k|v stacked row slices) and
+    gate_up_proj (gate over up) — untied head."""
+    import numpy as np
+    from safetensors.numpy import save_file
+
+    out = Path(path)
+    out.mkdir(parents=True, exist_ok=True)
+
+    tokenizer = build_tokenizer(path)
+    cfg = dict(TINY_LLAMA_CONFIG)
+    cfg["architectures"] = ["Phi3ForCausalLM"]
+    cfg["model_type"] = "phi3"
+    cfg["pad_token_id"] = 0
+    cfg["vocab_size"] = max(cfg["vocab_size"], len(tokenizer))
+    with open(out / "config.json", "w") as f:
+        json.dump(cfg, f, indent=2)
+
+    rng = np.random.default_rng(seed)
+    d = cfg["hidden_size"]
+    dh = cfg["head_dim"]
+    h = cfg["num_attention_heads"]
+    hkv = cfg["num_key_value_heads"]
+    inter = cfg["intermediate_size"]
+    vocab = cfg["vocab_size"]
+
+    def w(shape):
+        return (rng.standard_normal(shape) * 0.02).astype(np.float32)
+
+    tensors = {
+        "model.embed_tokens.weight": w((vocab, d)),
+        "model.norm.weight": np.ones(d, dtype=np.float32),
+        "lm_head.weight": w((vocab, d)),
+    }
+    for i in range(cfg["num_hidden_layers"]):
+        p = f"model.layers.{i}"
+        tensors |= {
+            f"{p}.input_layernorm.weight": np.ones(d, dtype=np.float32),
+            f"{p}.post_attention_layernorm.weight": np.ones(
+                d, dtype=np.float32
+            ),
+            f"{p}.self_attn.qkv_proj.weight": w(((h + 2 * hkv) * dh, d)),
+            f"{p}.self_attn.o_proj.weight": w((d, h * dh)),
+            f"{p}.mlp.gate_up_proj.weight": w((2 * inter, d)),
+            f"{p}.mlp.down_proj.weight": w((d, inter)),
+        }
+    save_file(tensors, out / "model.safetensors")
+    return str(out)

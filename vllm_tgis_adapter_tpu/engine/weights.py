@@ -477,6 +477,69 @@ def load_gpt2_params(
     return params
 
 
+def load_phi3_params(
+    config: "ModelConfig",
+    model_path: str,
+    place: Optional[PlaceFn] = None,
+) -> dict:
+    """Phi-3 checkpoint → the shared decoder param pytree.
+
+    Llama block chemistry with two FUSED projections: ``qkv_proj`` is
+    ``[(H+2·Hkv)·Dh, d]`` with q, k, v stacked as contiguous ROW slices
+    (not per-head interleaved like neox/bloom), and ``gate_up_proj`` is
+    ``[2f, d]`` with gate on top of up.  Both split before placement so
+    the standard Megatron column-parallel specs apply.
+    """
+    place = place or (lambda _name, x: x)
+    raw = CheckpointIndex(model_path)
+    h, hkv, dh = config.num_heads, config.num_kv_heads, config.head_dim
+    f = config.intermediate_size
+    take = _make_take(raw, config.dtype, place, ("",))
+
+    params: dict = {
+        "embed": take("model.embed_tokens.weight"),
+        "final_norm": take("model.norm.weight"),
+        "layers": [],
+    }
+    if not config.tie_word_embeddings:
+        params["lm_head"] = take("lm_head.weight", transpose=True)
+    elif "lm_head.weight" in raw:
+        raw.pop("lm_head.weight")
+
+    for i in range(config.num_layers):
+        prefix = f"model.layers.{i}"
+        fused_qkv = take(f"{prefix}.self_attn.qkv_proj.weight",
+                         placed=False)
+        fused_gu = take(f"{prefix}.mlp.gate_up_proj.weight", placed=False)
+        q_rows, kv_rows = h * dh, hkv * dh
+        layer = {
+            "input_norm": take(f"{prefix}.input_layernorm.weight"),
+            "post_attn_norm": take(
+                f"{prefix}.post_attention_layernorm.weight"
+            ),
+            "wq": place(f"{prefix}.self_attn.q_proj.weight",
+                        fused_qkv[:q_rows].T),
+            "wk": place(f"{prefix}.self_attn.k_proj.weight",
+                        fused_qkv[q_rows : q_rows + kv_rows].T),
+            "wv": place(f"{prefix}.self_attn.v_proj.weight",
+                        fused_qkv[q_rows + kv_rows :].T),
+            "wo": take(f"{prefix}.self_attn.o_proj.weight",
+                       transpose=True),
+            "w_gate": place(f"{prefix}.mlp.gate_proj.weight",
+                            fused_gu[:f].T),
+            "w_up": place(f"{prefix}.mlp.up_proj.weight", fused_gu[f:].T),
+            "w_down": take(f"{prefix}.mlp.down_proj.weight",
+                           transpose=True),
+        }
+        params["layers"].append(layer)
+
+    ignored = [n for n in raw.remaining() if "rotary_emb" not in n]
+    if ignored:
+        logger.warning("ignored %d unexpected checkpoint tensors: %s",
+                       len(ignored), ignored[:5])
+    return params
+
+
 def load_model_params(
     config: "ModelConfig",
     model_path: str,
@@ -491,6 +554,8 @@ def load_model_params(
         return load_bloom_params(config, model_path, place)
     if config.model_type == "gpt2":
         return load_gpt2_params(config, model_path, place)
+    if config.model_type == "phi3":
+        return load_phi3_params(config, model_path, place)
     return load_llama_params(config, model_path, place)
 
 

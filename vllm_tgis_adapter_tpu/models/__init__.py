@@ -36,6 +36,10 @@ _REGISTRY = {
     # Gemma: GeGLU MLP, (1+w) RMSNorm folded into weights at load,
     # sqrt(hidden)-scaled embeddings, tied head (config.py from_hf_config)
     "gemma": LlamaForCausalLM,
+    # Phi-3: llama block chemistry with fused qkv_proj / gate_up_proj
+    # checkpoints split row-wise by the loader (weights.py
+    # load_phi3_params); mini variants also carry a sliding window
+    "phi3": LlamaForCausalLM,
 }
 
 
