@@ -1,0 +1,174 @@
+"""rope_scaling parity vs HF torch (llama3 / longrope / linear).
+
+Silently running plain RoPE on a scaled checkpoint was the failure mode
+(code review r4: llama-3.1+ and phi-3-128k configs carry rope_scaling);
+now the scaling computes at config time into per-dim inverse-frequency
+divisors + an attention factor, pinned bit-for-bit against transformers'
+modeling_rope_utils, and unknown types fail at load.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from tests.fixture_models import hf_reference_model, hf_tokenize
+
+
+def _patched_dir(base_builder, tmp_path, name, patch):
+    d = str(tmp_path / name)
+    base_builder(d)
+    cfg_path = Path(d) / "config.json"
+    cfg = json.loads(cfg_path.read_text())
+    cfg.update(patch)
+    cfg_path.write_text(json.dumps(cfg, indent=2))
+    return d
+
+
+def _prefill_logits(model_dir, text):
+    import jax.numpy as jnp
+
+    from vllm_tgis_adapter_tpu.engine.config import ModelConfig
+    from vllm_tgis_adapter_tpu.engine.weights import load_model_params
+    from vllm_tgis_adapter_tpu.models import get_model_class
+
+    config = ModelConfig.from_pretrained(model_dir, dtype="float32")
+    model = get_model_class(config.model_type)(config)
+    params = load_model_params(config, model_dir)
+    caches = model.make_kv_caches(num_slots=1024, dtype=jnp.float32)
+    input_ids = hf_tokenize(model_dir, text)
+    t = len(input_ids)
+    logits, _ = model.prefill(
+        params, caches,
+        jnp.asarray(input_ids, dtype=jnp.int32),
+        jnp.arange(t, dtype=jnp.int32),
+        jnp.arange(t, dtype=jnp.int32),
+        jnp.asarray(t, dtype=jnp.int32),
+    )
+    return np.asarray(logits), input_ids, config
+
+
+def _hf_logits(model_dir, input_ids):
+    import torch
+
+    hf = hf_reference_model(model_dir)
+    with torch.no_grad():
+        return hf(torch.tensor([input_ids])).logits[0].numpy()
+
+
+def test_llama3_rope_scaling_matches_hf(tmp_path):
+    """llama-3.1-style wavelength-dependent scaling: low frequencies
+    compress by `factor`, high ones stay, smooth ramp between."""
+    from tests.fixture_models import build_tiny_llama
+
+    d = _patched_dir(build_tiny_llama, tmp_path, "llama3-rope", {
+        "rope_scaling": {
+            "rope_type": "llama3",
+            "factor": 4.0,
+            "low_freq_factor": 1.0,
+            "high_freq_factor": 4.0,
+            "original_max_position_embeddings": 64,
+        },
+    })
+    logits, input_ids, config = _prefill_logits(
+        d, "the quick brown fox jumps over the lazy dog again and again"
+    )
+    assert config.rope_inv_freq_divisors is not None
+    divs = np.asarray(config.rope_inv_freq_divisors)
+    assert divs.max() > 1.0 + 1e-6  # some dims really scale
+    np.testing.assert_allclose(
+        logits, _hf_logits(d, input_ids), rtol=1e-3, atol=1e-3
+    )
+
+
+def test_longrope_scaling_matches_hf(tmp_path):
+    """phi-3-style longrope: per-dim factor arrays + the
+    sqrt(1 + ln f / ln L) attention factor on cos/sin.
+
+    HF selects short vs long factor PER FORWARD from the live seq_len;
+    the compile-once engine selects statically from max_model_len (the
+    vLLM convention).  The parity fixture uses identical short/long
+    arrays so both paths compute the same thing and the per-dim divisors
+    + mscale are pinned exactly; the static selection itself is asserted
+    separately below."""
+    from tests.fixture_models import build_tiny_phi3
+
+    rng = np.random.default_rng(0)
+    half = 8  # head_dim 16
+    factors = (1.0 + rng.random(half) * 3.0).round(3).tolist()
+    d = _patched_dir(build_tiny_phi3, tmp_path, "phi3-longrope", {
+        "original_max_position_embeddings": 64,
+        "max_position_embeddings": 512,  # factor 8 → mscale > 1
+        "rope_scaling": {
+            "type": "longrope",
+            "long_factor": factors,
+            "short_factor": factors,
+        },
+    })
+    logits, input_ids, config = _prefill_logits(
+        d, "to be or not to be that is the question"
+    )
+    assert config.rope_mscale > 1.0
+    np.testing.assert_allclose(
+        np.asarray(config.rope_inv_freq_divisors), factors, rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        logits, _hf_logits(d, input_ids), rtol=1e-3, atol=1e-3
+    )
+
+
+def test_longrope_static_factor_selection(tmp_path):
+    """Serving beyond the pretrained window selects long_factor; within
+    it selects short_factor (static, from max_model_len)."""
+    from tests.fixture_models import build_tiny_phi3
+
+    from vllm_tgis_adapter_tpu.engine.config import ModelConfig
+
+    half = 8
+    long_factor = [2.0] * half
+    short_factor = [1.5] * half
+    d = _patched_dir(build_tiny_phi3, tmp_path, "phi3-select", {
+        "original_max_position_embeddings": 64,
+        "max_position_embeddings": 512,
+        "rope_scaling": {
+            "type": "longrope",
+            "long_factor": long_factor,
+            "short_factor": short_factor,
+        },
+    })
+    long_cfg = ModelConfig.from_pretrained(d, dtype="float32")
+    assert long_cfg.rope_inv_freq_divisors == tuple(long_factor)
+    short_cfg = ModelConfig.from_pretrained(
+        d, dtype="float32", max_model_len=64
+    )
+    assert short_cfg.rope_inv_freq_divisors == tuple(short_factor)
+
+
+def test_linear_rope_scaling_matches_hf(tmp_path):
+    from tests.fixture_models import build_tiny_llama
+
+    d = _patched_dir(build_tiny_llama, tmp_path, "linear-rope", {
+        "rope_scaling": {"rope_type": "linear", "factor": 2.0},
+    })
+    logits, input_ids, config = _prefill_logits(d, "hello scaled world")
+    assert config.rope_inv_freq_divisors == (2.0,) * 8
+    np.testing.assert_allclose(
+        logits, _hf_logits(d, input_ids), rtol=1e-3, atol=1e-3
+    )
+
+
+def test_unknown_rope_scaling_rejected(tmp_path):
+    """yarn/dynamic/etc. fail at CONFIG load — running plain RoPE on a
+    scaled checkpoint would silently produce wrong logits."""
+    from tests.fixture_models import build_tiny_llama
+
+    from vllm_tgis_adapter_tpu.engine.config import ModelConfig
+
+    d = _patched_dir(build_tiny_llama, tmp_path, "yarn-rope", {
+        "rope_scaling": {"rope_type": "yarn", "factor": 2.0},
+    })
+    with pytest.raises(ValueError, match="rope_scaling"):
+        ModelConfig.from_pretrained(d, dtype="float32")

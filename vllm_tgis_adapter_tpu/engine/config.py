@@ -83,6 +83,13 @@ class ModelConfig:
     # loader folds the offset into the stored weights once at load
     # (engine/weights.py), so the runtime norm stays the plain w * x̂
     norm_weight_offset: float = 0.0
+    # rope_scaling (llama3 / longrope / linear), precomputed at config
+    # time into per-dim DIVISORS of the base inverse frequencies plus a
+    # cos/sin attention factor (models/llama.py rotary_cos_sin); unknown
+    # scaling types fail at config load rather than silently running
+    # plain RoPE (see _rope_scaling_factors)
+    rope_inv_freq_divisors: Optional[tuple] = None  # len head_dim // 2
+    rope_mscale: float = 1.0
     hidden_act: str = "silu"  # "silu" | "relu" | "gelu" | "gelu_new"
     gated_mlp: bool = True  # SwiGLU gate/up/down vs plain fc1/act/fc2
     attention_out_bias: bool = False
@@ -97,8 +104,9 @@ class ModelConfig:
     embed_norm: bool = False
     # mistral-style sliding-window attention: each token attends to at
     # most the previous ``sliding_window`` tokens (0 = full attention).
-    # Enforced as a band mask in the attention ops; KV pages beyond the
-    # window are still resident (no rolling-buffer eviction yet)
+    # Enforced as a band mask in the attention ops; KV pages that fall
+    # entirely below the band are freed as decode advances when the
+    # rolling-eviction gates hold (engine/scheduler.py rolling_window)
     sliding_window: int = 0
     # qwen2 semantics: the first ``max_window_layers`` layers use FULL
     # attention, the band applies from that layer on (0 = all layers)
@@ -107,6 +115,83 @@ class ModelConfig:
     @property
     def q_per_kv(self) -> int:
         return self.num_heads // self.num_kv_heads
+
+    @staticmethod
+    def _rope_scaling_factors(
+        scaling: dict, *, theta: float, dim: int, max_len: int, hf: dict
+    ) -> tuple[tuple, float]:
+        """HF ``rope_scaling`` → (per-dim inv_freq divisors, mscale).
+
+        Mirrors transformers' modeling_rope_utils exactly:
+
+        * ``linear``: every frequency divided by ``factor``;
+        * ``llama3`` (llama-3.1+): long wavelengths divided by
+          ``factor``, short ones untouched, smooth ramp between;
+        * ``longrope`` (phi-3 long-context): per-dim short/long factor
+          arrays — chosen STATICALLY by whether the serving context
+          (max_model_len) exceeds the pretrained window, matching the
+          compile-once model — plus the sqrt(1 + ln f / ln L) attention
+          factor on cos/sin.
+
+        Anything else raises: running plain RoPE under an unsupported
+        scaling would silently produce wrong logits.
+        """
+        import math
+
+        import numpy as np
+
+        rtype = scaling.get("rope_type") or scaling.get("type")
+        if rtype in (None, "default"):
+            return None, 1.0
+        half = dim // 2
+        if rtype == "linear":
+            return (float(scaling["factor"]),) * half, 1.0
+        inv_freq = 1.0 / (theta ** (np.arange(0, dim, 2) / dim))
+        if rtype == "llama3":
+            factor = scaling["factor"]
+            lo_f = scaling["low_freq_factor"]
+            hi_f = scaling["high_freq_factor"]
+            old = scaling["original_max_position_embeddings"]
+            wavelen = 2 * np.pi / inv_freq
+            scaled = np.where(
+                wavelen > old / lo_f, inv_freq / factor, inv_freq
+            )
+            smooth = (old / wavelen - lo_f) / (hi_f - lo_f)
+            smoothed = (1 - smooth) * inv_freq / factor + smooth * inv_freq
+            medium = ~(wavelen < old / hi_f) & ~(wavelen > old / lo_f)
+            scaled = np.where(medium, smoothed, scaled)
+            return tuple((inv_freq / scaled).tolist()), 1.0
+        if rtype == "longrope":
+            orig = (
+                hf.get("original_max_position_embeddings")
+                or scaling.get("original_max_position_embeddings")
+                or hf.get("max_position_embeddings")
+            )
+            max_pos = hf.get("max_position_embeddings", orig)
+            factor = max_pos / orig if orig else scaling.get("factor", 1.0)
+            mscale = scaling.get("attention_factor")
+            if mscale is None:
+                mscale = (
+                    1.0
+                    if factor <= 1.0
+                    else math.sqrt(1 + math.log(factor) / math.log(orig))
+                )
+            ext = (
+                scaling["long_factor"]
+                if (max_len or max_pos) > orig
+                else scaling["short_factor"]
+            )
+            if len(ext) != half:
+                raise ValueError(
+                    f"longrope factor length {len(ext)} != head_dim/2 "
+                    f"({half})"
+                )
+            return tuple(float(x) for x in ext), float(mscale)
+        raise ValueError(
+            f"rope_scaling type {rtype!r} is not supported (supported: "
+            "linear, llama3, longrope); refusing to run plain RoPE on a "
+            "scaled checkpoint"
+        )
 
     @staticmethod
     def from_hf_config(
@@ -166,6 +251,15 @@ class ModelConfig:
         embedding_multiplier = hf.get("embedding_multiplier", 1.0)
         norm_weight_offset = 0.0
         tie = hf.get("tie_word_embeddings", False)
+        rope_divisors, rope_mscale = None, 1.0
+        if hf.get("rope_scaling"):
+            rope_divisors, rope_mscale = ModelConfig._rope_scaling_factors(
+                hf["rope_scaling"],
+                theta=hf.get("rope_theta", 10000.0),
+                dim=hf.get("head_dim", hidden // heads),
+                max_len=max_model_len or derived_len,
+                hf=hf,
+            )
         if model_type == "gemma":
             # gemma: GeGLU MLP (HF spells the activation under
             # hidden_activation, default gelu_pytorch_tanh == our
@@ -201,6 +295,8 @@ class ModelConfig:
             embedding_multiplier=embedding_multiplier,
             hidden_act=hidden_act,
             norm_weight_offset=norm_weight_offset,
+            rope_inv_freq_divisors=rope_divisors,
+            rope_mscale=rope_mscale,
             residual_multiplier=hf.get("residual_multiplier", 1.0),
             attention_multiplier=hf.get("attention_multiplier"),
             num_experts=hf.get("num_local_experts", 0),

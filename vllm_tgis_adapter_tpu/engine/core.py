@@ -118,6 +118,14 @@ class LLMEngine:
             and mcfg.sliding_window == 0
             and mcfg.position_embedding != "alibi"
         )
+        # rolling-window KV eviction (scheduler docstring for the gates)
+        if (
+            mcfg.sliding_window > 0
+            and mcfg.max_window_layers == 0
+            and not config.cache_config.enable_prefix_caching
+            and config.speculative is None
+        ):
+            self.scheduler.rolling_window = mcfg.sliding_window
         self._seqs: dict[str, Sequence] = {}
         self._lora_tokenizers: dict[str, object] = {}
         # adapter registry consumed by the gRPC adapter store

@@ -329,6 +329,7 @@ class SequenceBlocks:
         self._allocator = allocator
         self.blocks: list[int] = []
         self.num_tokens = 0
+        self._evicted_upto = 0  # rolling-window cursor (evict_below)
 
     def adopt(self, blocks: list[int]) -> None:
         """Prepend already-refcounted pages (prefix-cache hits)."""
@@ -350,8 +351,29 @@ class SequenceBlocks:
     def slots_for_range(self, start: int, end: int) -> list[int]:
         return [self.slot_for(p) for p in range(start, end)]
 
+    def evict_below(self, position: int) -> int:
+        """Rolling-window eviction: free every page that lies ENTIRELY
+        below ``position`` (sliding-window models never read below the
+        band again).  Freed entries become -1 — the list keeps its
+        position-aligned indexing, device-side lookups clamp negative
+        ids and the band mask discards whatever those pages now hold.
+        A cursor makes each call O(pages newly freed), not O(history).
+        Returns the number of pages freed."""
+        bs = self._allocator.block_size
+        last_dead = min(position // bs, len(self.blocks))
+        if last_dead <= self._evicted_upto:
+            return 0
+        dead = self.blocks[self._evicted_upto:last_dead]
+        self.blocks[self._evicted_upto:last_dead] = [-1] * len(dead)
+        self._evicted_upto = last_dead
+        if dead:
+            self._allocator.free(dead)
+        return len(dead)
+
     def release(self) -> None:
-        if self.blocks:
-            self._allocator.free(self.blocks)
-            self.blocks = []
+        live = [b for b in self.blocks if b >= 0]
+        if live:
+            self._allocator.free(live)
+        self.blocks = []
         self.num_tokens = 0
+        self._evicted_upto = 0

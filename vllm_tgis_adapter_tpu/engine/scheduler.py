@@ -119,6 +119,14 @@ class Scheduler:
         # model/parallel mode supports the block-diagonal mask (plain
         # causal attention, no pp/sp, no speculative draft mirroring)
         self.allow_packed = False
+        # rolling-window KV eviction (sliding-window models): pages that
+        # fall entirely below every layer's attention band free as decode
+        # advances, bounding a generation's KV footprint by
+        # ~window+block_size instead of its full history.  Set by the
+        # engine only when EVERY layer is banded (max_window_layers == 0),
+        # prefix caching is off (registered pages must stay intact) and
+        # speculation is off (the draft cache shares slot geometry).
+        self.rolling_window = 0
 
     # ------------------------------------------------------------ bookkeeping
 
@@ -407,6 +415,10 @@ class Scheduler:
     def _schedule_decode(self) -> Optional[DecodePlan]:
         if not self.running:
             return None
+        # rolling-window eviction runs BEFORE capacity/preemption: the
+        # pages it reclaims must be visible to this pass's ensure_capacity,
+        # or a tight pool preempts work that eviction could have fed
+        self._roll_window(self.running)
         # grow each sequence's page list for every token this dispatch may
         # write (positions num_tokens-1 … num_tokens-2+allowed); preempt
         # youngest sequences if the pool runs dry.  Iterate over a snapshot
@@ -454,6 +466,18 @@ class Scheduler:
                 return b
         return self.batch_buckets[-1]
 
+    def _roll_window(self, seqs: list[Sequence]) -> None:
+        """Free KV pages entirely below the attention band (see
+        ``rolling_window``).  No wave — in flight or planned — reads
+        positions under ``num_tokens - window``, and band masks discard
+        whatever a reallocated page later holds."""
+        if not self.rolling_window:
+            return
+        for seq in seqs:
+            lo = seq.num_tokens - self.rolling_window
+            if lo > 0 and seq.blocks is not None:
+                seq.blocks.evict_below(lo)
+
     def schedule_chained(
         self, prev: DecodePlan
     ) -> Optional[DecodePlan]:
@@ -478,6 +502,12 @@ class Scheduler:
             # a row finished/aborted since prev was planned: the device
             # wave still runs it, but projections are stale — fall back
             return None
+        # eviction first (see _schedule_decode): reclaimed pages must
+        # count toward this projection's capacity check.  The in-flight
+        # wave's deepest read is num_tokens - window, and reallocation is
+        # safe because any new owner's writes are dispatched (and
+        # therefore execute) after that wave retires.
+        self._roll_window(prev.seqs)
         # two passes: validate EVERY row before allocating a single page,
         # so a bail on a later row cannot leave earlier rows holding
         # speculative capacity for a wave that never dispatches

@@ -82,15 +82,21 @@ _ACTIVATIONS = {
 
 
 def rotary_cos_sin(
-    positions: jax.Array, head_dim: int, theta: float
+    positions: jax.Array,
+    head_dim: int,
+    theta: float,
+    inv_freq_divisors=None,  # per-dim divisors (rope_scaling, config.py)
+    mscale: float = 1.0,  # longrope attention factor on cos/sin
 ) -> tuple[jax.Array, jax.Array]:
     """cos/sin tables for the HF llama rotate-half convention."""
     inv_freq = 1.0 / (
         theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
     )
+    if inv_freq_divisors is not None:
+        inv_freq = inv_freq / jnp.asarray(inv_freq_divisors, jnp.float32)
     freqs = positions.astype(jnp.float32)[:, None] * inv_freq[None, :]
     emb = jnp.concatenate([freqs, freqs], axis=-1)  # [T, Dh]
-    return jnp.cos(emb), jnp.sin(emb)
+    return jnp.cos(emb) * mscale, jnp.sin(emb) * mscale
 
 
 def apply_rotary(
@@ -281,7 +287,11 @@ class LlamaForCausalLM:
         if cfg.position_embedding != "rope":
             return None
         rd = cfg.rotary_dim or cfg.head_dim
-        return rotary_cos_sin(positions, rd, cfg.rope_theta)
+        return rotary_cos_sin(
+            positions, rd, cfg.rope_theta,
+            inv_freq_divisors=cfg.rope_inv_freq_divisors,
+            mscale=cfg.rope_mscale,
+        )
 
     def _apply_pos_qk(
         self, q: jax.Array, k: jax.Array, tables
