@@ -661,3 +661,58 @@ def build_tiny_phi3(path: str, seed: int = 0) -> str:
         }
     save_file(tensors, out / "model.safetensors")
     return str(out)
+
+
+def build_tiny_qwen3(path: str, seed: int = 0) -> str:
+    """Tiny qwen3-architecture checkpoint: llama tensor names plus
+    per-layer head-dim q_norm/k_norm weights."""
+    import numpy as np
+    from safetensors.numpy import save_file
+
+    out = Path(path)
+    out.mkdir(parents=True, exist_ok=True)
+
+    tokenizer = build_tokenizer(path)
+    cfg = dict(TINY_LLAMA_CONFIG)
+    cfg["architectures"] = ["Qwen3ForCausalLM"]
+    cfg["model_type"] = "qwen3"
+    cfg["vocab_size"] = max(cfg["vocab_size"], len(tokenizer))
+    with open(out / "config.json", "w") as f:
+        json.dump(cfg, f, indent=2)
+
+    rng = np.random.default_rng(seed)
+    d = cfg["hidden_size"]
+    dh = cfg["head_dim"]
+    h = cfg["num_attention_heads"]
+    hkv = cfg["num_key_value_heads"]
+    inter = cfg["intermediate_size"]
+    vocab = cfg["vocab_size"]
+
+    def w(shape):
+        return (rng.standard_normal(shape) * 0.02).astype(np.float32)
+
+    def norm(n):
+        return (1.0 + rng.standard_normal(n) * 0.1).astype(np.float32)
+
+    tensors = {
+        "model.embed_tokens.weight": w((vocab, d)),
+        "model.norm.weight": norm(d),
+        "lm_head.weight": w((vocab, d)),
+    }
+    for i in range(cfg["num_hidden_layers"]):
+        p = f"model.layers.{i}"
+        tensors |= {
+            f"{p}.input_layernorm.weight": norm(d),
+            f"{p}.post_attention_layernorm.weight": norm(d),
+            f"{p}.self_attn.q_proj.weight": w((h * dh, d)),
+            f"{p}.self_attn.k_proj.weight": w((hkv * dh, d)),
+            f"{p}.self_attn.v_proj.weight": w((hkv * dh, d)),
+            f"{p}.self_attn.o_proj.weight": w((d, h * dh)),
+            f"{p}.self_attn.q_norm.weight": norm(dh),
+            f"{p}.self_attn.k_norm.weight": norm(dh),
+            f"{p}.mlp.gate_proj.weight": w((inter, d)),
+            f"{p}.mlp.up_proj.weight": w((inter, d)),
+            f"{p}.mlp.down_proj.weight": w((d, inter)),
+        }
+    save_file(tensors, out / "model.safetensors")
+    return str(out)

@@ -1,0 +1,169 @@
+"""Qwen3 family: numerical parity vs HF torch + engine e2e.
+
+Ninth architecture family through the shared decoder skeleton: qwen2
+lineage plus per-head-dim q/k RMSNorms applied after projection and
+before rotary (HF Qwen3Attention order).  Gold-standard checks mirror
+the other family suites.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from tests.fixture_models import hf_reference_model, hf_tokenize
+
+
+@pytest.fixture(scope="module")
+def qwen3_dir(tmp_path_factory):
+    from tests.fixture_models import build_tiny_qwen3
+
+    return build_tiny_qwen3(str(tmp_path_factory.mktemp("tiny-qwen3")))
+
+
+@pytest.fixture(scope="module")
+def setup(qwen3_dir):
+    import jax.numpy as jnp
+
+    from vllm_tgis_adapter_tpu.engine.config import ModelConfig
+    from vllm_tgis_adapter_tpu.engine.weights import load_model_params
+    from vllm_tgis_adapter_tpu.models import get_model_class
+
+    config = ModelConfig.from_pretrained(qwen3_dir, dtype="float32")
+    model = get_model_class(config.model_type)(config)
+    params = load_model_params(config, qwen3_dir)
+    caches = model.make_kv_caches(num_slots=1024, dtype=jnp.float32)
+    return qwen3_dir, config, model, params, caches
+
+
+def test_qwen3_config_mapping(setup):
+    _, config, _, params, _ = setup
+    assert config.model_type == "qwen3"
+    assert config.qk_norm
+    assert config.norm_type == "rmsnorm"
+    assert config.hidden_act == "silu"
+    assert not config.tie_word_embeddings
+    layer = params["layers"][0]
+    assert layer["q_norm"].shape == (config.head_dim,)
+    assert layer["k_norm"].shape == (config.head_dim,)
+
+
+def test_qwen3_prefill_logits_match_hf(setup):
+    import jax.numpy as jnp
+    import torch
+
+    model_dir, config, model, params, caches = setup
+    input_ids = hf_tokenize(model_dir, "the quick brown fox jumps")
+    t = len(input_ids)
+
+    logits, _ = model.prefill(
+        params, caches,
+        jnp.asarray(input_ids, dtype=jnp.int32),
+        jnp.arange(t, dtype=jnp.int32),
+        jnp.arange(t, dtype=jnp.int32),
+        jnp.asarray(t, dtype=jnp.int32),
+    )
+    hf = hf_reference_model(model_dir)
+    with torch.no_grad():
+        hf_logits = hf(torch.tensor([input_ids])).logits[0].numpy()
+    np.testing.assert_allclose(
+        np.asarray(logits), hf_logits, rtol=1e-3, atol=1e-3
+    )
+
+
+def test_qwen3_greedy_decode_matches_hf_generate(setup):
+    import torch
+
+    from vllm_tgis_adapter_tpu.engine.config import (
+        CacheConfig,
+        EngineConfig,
+        LoRAConfig,
+        ParallelConfig,
+        SchedulerConfig,
+    )
+    from vllm_tgis_adapter_tpu.engine.core import LLMEngine
+    from vllm_tgis_adapter_tpu.engine.sampling_params import SamplingParams
+
+    model_dir, config, *_ = setup
+    input_ids = hf_tokenize(model_dir, "to be or not to be")
+    new_tokens = 10
+
+    hf = hf_reference_model(model_dir)
+    with torch.no_grad():
+        hf_out = hf.generate(
+            torch.tensor([input_ids]),
+            max_new_tokens=new_tokens,
+            do_sample=False,
+            eos_token_id=None,
+        )[0].tolist()
+    expected = hf_out[len(input_ids):]
+
+    engine = LLMEngine.from_config(EngineConfig(
+        model_config=config,
+        cache_config=CacheConfig(block_size=16, num_blocks=64,
+                                 cache_dtype=config.dtype),
+        scheduler_config=SchedulerConfig(
+            max_num_seqs=4, prefill_buckets=(32, 64)),
+        parallel_config=ParallelConfig(),
+        lora_config=LoRAConfig(),
+    ))
+    engine.add_request(
+        "p", None,
+        SamplingParams(temperature=0.0, max_tokens=new_tokens,
+                       ignore_eos=True),
+        prompt_token_ids=list(input_ids),
+    )
+    got = None
+    for _ in range(100):
+        if not engine.has_unfinished_requests():
+            break
+        for out in engine.step():
+            if out.finished:
+                got = out.outputs[0].token_ids
+    assert got == expected
+
+
+def test_qwen3_under_tensor_parallel(qwen3_dir):
+    """tp=2: the head-dim q/k norms replicate while heads split; tokens
+    match single-device."""
+    import jax
+
+    from vllm_tgis_adapter_tpu.engine.config import (
+        CacheConfig,
+        EngineConfig,
+        LoRAConfig,
+        ModelConfig,
+        ParallelConfig,
+        SchedulerConfig,
+    )
+    from vllm_tgis_adapter_tpu.engine.core import LLMEngine
+    from vllm_tgis_adapter_tpu.engine.sampling_params import SamplingParams
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the 8-device CPU mesh")
+
+    def run(tp):
+        mcfg = ModelConfig.from_pretrained(qwen3_dir, dtype="float32")
+        engine = LLMEngine.from_config(EngineConfig(
+            model_config=mcfg,
+            cache_config=CacheConfig(block_size=16, num_blocks=64,
+                                     cache_dtype=mcfg.dtype),
+            scheduler_config=SchedulerConfig(
+                max_num_seqs=2, prefill_buckets=(32,)),
+            parallel_config=ParallelConfig(tensor_parallel_size=tp),
+            lora_config=LoRAConfig(),
+        ))
+        engine.add_request(
+            "r", None,
+            SamplingParams(temperature=0.0, max_tokens=8, ignore_eos=True),
+            prompt_token_ids=list(range(3, 14)),
+        )
+        for _ in range(60):
+            if not engine.has_unfinished_requests():
+                break
+            for out in engine.step():
+                if out.finished:
+                    return out.outputs[0].token_ids
+        raise AssertionError("engine did not finish")
+
+    assert run(2) == run(1)

@@ -90,6 +90,9 @@ class ModelConfig:
     # plain RoPE (see _rope_scaling_factors)
     rope_inv_freq_divisors: Optional[tuple] = None  # len head_dim // 2
     rope_mscale: float = 1.0
+    # qwen3: per-head-dim RMSNorm on q and k after projection, before
+    # rotary (weights q_norm/k_norm of size head_dim per layer)
+    qk_norm: bool = False
     hidden_act: str = "silu"  # "silu" | "relu" | "gelu" | "gelu_new"
     gated_mlp: bool = True  # SwiGLU gate/up/down vs plain fc1/act/fc2
     attention_out_bias: bool = False
@@ -237,7 +240,7 @@ class ModelConfig:
         # on keeps its first max_window_layers layers on full attention.
         sliding_window = hf.get("sliding_window") or 0
         max_window_layers = 0
-        if model_type == "qwen2":
+        if model_type in ("qwen2", "qwen3"):
             if not hf.get("use_sliding_window", False):
                 sliding_window = 0
             else:
@@ -297,6 +300,7 @@ class ModelConfig:
             norm_weight_offset=norm_weight_offset,
             rope_inv_freq_divisors=rope_divisors,
             rope_mscale=rope_mscale,
+            qk_norm=model_type == "qwen3",
             residual_multiplier=hf.get("residual_multiplier", 1.0),
             attention_multiplier=hf.get("attention_multiplier"),
             num_experts=hf.get("num_local_experts", 0),

@@ -122,6 +122,10 @@ def load_llama_params(
             "wv": take(f"{prefix}.self_attn.v_proj.weight", transpose=True),
             "wo": take(f"{prefix}.self_attn.o_proj.weight", transpose=True),
         }
+        if getattr(config, "qk_norm", False):
+            # qwen3 per-head-dim q/k RMSNorms
+            layer["q_norm"] = take(f"{prefix}.self_attn.q_norm.weight")
+            layer["k_norm"] = take(f"{prefix}.self_attn.k_norm.weight")
         if config.num_experts > 0:
             # mixtral: per-expert FFNs stacked into [E, ...] tensors
             # (w1=gate, w3=up, w2=down in HF naming); the stacked arrays

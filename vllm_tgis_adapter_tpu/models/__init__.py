@@ -40,6 +40,9 @@ _REGISTRY = {
     # checkpoints split row-wise by the loader (weights.py
     # load_phi3_params); mini variants also carry a sliding window
     "phi3": LlamaForCausalLM,
+    # Qwen3: qwen2 lineage plus per-head-dim q/k RMSNorms applied before
+    # rotary (config.py qk_norm; llama.py _qkv)
+    "qwen3": LlamaForCausalLM,
 }
 
 

@@ -354,11 +354,15 @@ class LlamaForCausalLM:
             q = q + layer["bq"]
             k = k + layer["bk"]
             v = v + layer["bv"]
-        return (
-            q.reshape(t, cfg.num_heads, cfg.head_dim),
-            k.reshape(t, cfg.num_kv_heads, cfg.head_dim),
-            v.reshape(t, cfg.num_kv_heads, cfg.head_dim),
-        )
+        q = q.reshape(t, cfg.num_heads, cfg.head_dim)
+        k = k.reshape(t, cfg.num_kv_heads, cfg.head_dim)
+        v = v.reshape(t, cfg.num_kv_heads, cfg.head_dim)
+        if "q_norm" in layer:
+            # qwen3: per-head-dim RMSNorm on q/k after projection,
+            # BEFORE rotary (HF Qwen3Attention order)
+            q = rms_norm(q, layer["q_norm"], cfg.rms_norm_eps)
+            k = rms_norm(k, layer["k_norm"], cfg.rms_norm_eps)
+        return q, k, v
 
     def _mlp(self, layer: dict, x: jax.Array, dl=None) -> jax.Array:
         if "router" in layer:

@@ -67,6 +67,9 @@ _LAYER_SPECS = {
     "bq": P(TP_AXIS),
     "bk": P(TP_AXIS),
     "bv": P(TP_AXIS),
+    # qwen3 per-head-dim q/k norms: [head_dim] vectors, replicated
+    "q_norm": P(None),
+    "k_norm": P(None),
     # row-parallel output biases: replicated, added once after the psum
     "bo": P(None),
     "b_down": P(None),
