@@ -275,3 +275,27 @@ def test_root_path_prefix_stripped():
     assert bare.status == 200  # unprefixed still works (direct access)
     missing = asyncio.run(app.dispatch(req("/proxy/llm/nope")))
     assert missing.status == 404
+
+
+def test_root_path_overlapping_native_route():
+    """--root-path /v1 must not shadow the native /v1/* routes: a direct
+    (unproxied) request to /v1/completions strips to /completions, which
+    is unregistered — the dispatcher must fall back to the raw path
+    (advisor r4)."""
+    import asyncio
+
+    from vllm_tgis_adapter_tpu.http import App, HttpRequest, JsonResponse
+
+    app = App(root_path="/v1")
+
+    @app.route("POST", "/v1/completions")
+    async def completions(app, request):  # noqa: ANN001, ARG001
+        return JsonResponse({"ok": True})
+
+    def req(path):
+        return HttpRequest(method="POST", path=path, headers={}, body=b"")
+
+    direct = asyncio.run(app.dispatch(req("/v1/completions")))
+    assert direct.status == 200
+    proxied = asyncio.run(app.dispatch(req("/v1/v1/completions")))
+    assert proxied.status == 200

@@ -218,9 +218,9 @@ def test_mtls_requires_client_cert(tiny_model_dir, tmp_path, tls_material):
 
 def test_ssl_cert_reqs_overrides_mtls(tiny_model_dir, tmp_path,
                                       tls_material):
-    """--ssl-cert-reqs 0 with a CA bundle: verify-if-presented but never
-    require — a cert-less TLS client must now succeed (the flag used to
-    be accepted and ignored)."""
+    """--ssl-cert-reqs 0 with a CA bundle: CERT_NONE disables client-cert
+    verification entirely (the CA is dropped, certs are neither required
+    nor validated) — a cert-less TLS client must succeed."""
     from tests.utils import GrpcClient
 
     args = _server_args(tiny_model_dir, tmp_path, tls_material, mtls=True)
@@ -238,3 +238,17 @@ def test_ssl_cert_reqs_overrides_mtls(tiny_model_dir, tmp_path,
             assert out.generated_token_count == 4
     finally:
         _stop_servers(loop, thread)
+
+
+def test_ssl_cert_reqs_optional_requires_ca(tiny_model_dir, tmp_path,
+                                            tls_material):
+    """--ssl-cert-reqs 1 (CERT_OPTIONAL) without a CA bundle cannot
+    verify any presented cert — fail fast instead of silently degrading
+    to no verification (advisor r4)."""
+    from vllm_tgis_adapter_tpu.grpc.grpc_server import _tls_credentials
+
+    args = _server_args(tiny_model_dir, tmp_path, tls_material, mtls=False)
+    args.ssl_cert_reqs = 1
+    assert args.ssl_ca_certs is None
+    with pytest.raises(ValueError, match="CERT_OPTIONAL"):
+        _tls_credentials(args)

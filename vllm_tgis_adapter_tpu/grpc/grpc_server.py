@@ -549,11 +549,15 @@ def _tls_credentials(args: "argparse.Namespace"):  # noqa: ANN202
     ca = read(args.ssl_ca_certs, "ssl_ca_certs") if args.ssl_ca_certs else None
     cert_reqs = getattr(args, "ssl_cert_reqs", None)
     require = ca is not None if cert_reqs is None else cert_reqs == 2
-    if require and ca is None:
+    if cert_reqs in (1, 2) and ca is None:
         raise ValueError(
-            "--ssl-cert-reqs 2 (CERT_REQUIRED) needs --ssl-ca-certs to "
-            "verify client certificates against"
+            f"--ssl-cert-reqs {cert_reqs} "
+            f"({'CERT_OPTIONAL' if cert_reqs == 1 else 'CERT_REQUIRED'}) "
+            "needs --ssl-ca-certs to verify client certificates against"
         )
+    if cert_reqs == 0:
+        # CERT_NONE: never validate client certs, even if a CA was given
+        ca = None
     return grpc.ssl_server_credentials(
         [(key, cert)],
         root_certificates=ca,

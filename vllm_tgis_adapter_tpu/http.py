@@ -118,12 +118,22 @@ class App:
         return register
 
     async def dispatch(self, request: HttpRequest):  # noqa: ANN201
-        path = request.path.split("?")[0]
-        if self.root_path and path.startswith(self.root_path):
-            path = path[len(self.root_path):] or "/"
-        handler = self.routes.get((request.method, path))
+        raw_path = request.path.split("?")[0]
+        # Proxied requests arrive as {root_path}{route}; direct requests
+        # arrive unprefixed.  Like FastAPI's root_path handling, match the
+        # stripped form first but fall back to the raw path so a direct
+        # request to a native route (e.g. --root-path /v1 + /v1/completions)
+        # still resolves.
+        candidates = [raw_path]
+        if self.root_path and raw_path.startswith(self.root_path):
+            candidates.insert(0, raw_path[len(self.root_path):] or "/")
+        handler = None
+        for path in candidates:
+            handler = self.routes.get((request.method, path))
+            if handler is not None:
+                break
         if handler is None:
-            if any(p == path for (_, p) in self.routes):
+            if any(p in candidates for (_, p) in self.routes):
                 return error_response(405, "method not allowed")
             return error_response(404, "not found")
         return await handler(self, request)

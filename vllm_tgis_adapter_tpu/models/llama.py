@@ -425,9 +425,10 @@ class LlamaForCausalLM:
             axis=1,
         )  # [T, E] — zero for unselected experts
 
+        act = _ACTIVATIONS[cfg.hidden_act]
         gate = jnp.einsum("td,edf->tef", x, layer["experts_gate"])
         up = jnp.einsum("td,edf->tef", x, layer["experts_up"])
-        h = jax.nn.silu(gate) * up
+        h = act(gate) * up
         out = jnp.einsum("tef,efd->ted", h, layer["experts_down"])
         return jnp.sum(
             out * weights[..., None].astype(out.dtype), axis=1
@@ -488,9 +489,10 @@ class LlamaForCausalLM:
         buf = jnp.zeros((num_experts, capacity, d), x.dtype)
         buf = buf.at[safe_e, safe_pos].set(x[flat_tok], mode="drop")
 
+        act = _ACTIVATIONS[cfg.hidden_act]
         gate = jnp.einsum("ecd,edf->ecf", buf, layer["experts_gate"])
         up = jnp.einsum("ecd,edf->ecf", buf, layer["experts_up"])
-        h = jax.nn.silu(gate) * up
+        h = act(gate) * up
         out_e = jnp.einsum("ecf,efd->ecd", h, layer["experts_down"])
 
         # combine: gather each assignment's expert output, weight it,
