@@ -146,6 +146,19 @@ def test_longrope_static_factor_selection(tmp_path):
     )
     assert short_cfg.rope_inv_freq_divisors == tuple(short_factor)
 
+    # phi-3's pre-rename checkpoints spell the same scaling "su"
+    su = _patched_dir(build_tiny_phi3, tmp_path, "phi3-su", {
+        "original_max_position_embeddings": 64,
+        "max_position_embeddings": 512,
+        "rope_scaling": {
+            "type": "su",
+            "long_factor": long_factor,
+            "short_factor": short_factor,
+        },
+    })
+    su_cfg = ModelConfig.from_pretrained(su, dtype="float32")
+    assert su_cfg.rope_inv_freq_divisors == tuple(long_factor)
+
 
 def test_linear_rope_scaling_matches_hf(tmp_path):
     from tests.fixture_models import build_tiny_llama
@@ -160,15 +173,115 @@ def test_linear_rope_scaling_matches_hf(tmp_path):
     )
 
 
-def test_unknown_rope_scaling_rejected(tmp_path):
-    """yarn/dynamic/etc. fail at CONFIG load — running plain RoPE on a
-    scaled checkpoint would silently produce wrong logits."""
+def test_yarn_rope_scaling_matches_hf(tmp_path):
+    """YaRN (NTK-by-parts): low frequencies interpolate by `factor`,
+    high ones extrapolate, linear ramp between the beta correction dims,
+    and cos/sin scale by 0.1·ln(factor)+1 (pinned vs transformers
+    _compute_yarn_parameters)."""
+    from tests.fixture_models import build_tiny_llama
+
+    d = _patched_dir(build_tiny_llama, tmp_path, "yarn-rope", {
+        "rope_scaling": {
+            "rope_type": "yarn",
+            "factor": 4.0,
+            "original_max_position_embeddings": 64,
+        },
+    })
+    logits, input_ids, config = _prefill_logits(
+        d, "pack my box with five dozen liquor jugs and then some more"
+    )
+    import math
+    assert config.rope_mscale == pytest.approx(0.1 * math.log(4.0) + 1.0)
+    divs = np.asarray(config.rope_inv_freq_divisors)
+    assert divs.max() > 1.0 + 1e-6  # interpolated dims really scale
+    assert divs.min() >= 1.0 - 1e-6  # extrapolated dims stay unscaled
+    np.testing.assert_allclose(
+        logits, _hf_logits(d, input_ids), rtol=1e-3, atol=1e-3
+    )
+
+
+def test_yarn_inv_freq_pinned_against_hf_rope_utils(tmp_path):
+    """Bit-level pin of the yarn inverse frequencies + attention factor
+    against transformers.modeling_rope_utils, incl. the deepseek-style
+    mscale/mscale_all_dim attention-factor variant."""
+    import torch
+    from transformers import AutoConfig
+    from transformers.modeling_rope_utils import _compute_yarn_parameters
+
     from tests.fixture_models import build_tiny_llama
 
     from vllm_tgis_adapter_tpu.engine.config import ModelConfig
 
-    d = _patched_dir(build_tiny_llama, tmp_path, "yarn-rope", {
-        "rope_scaling": {"rope_type": "yarn", "factor": 2.0},
+    for name, scaling in [
+        ("plain", {"rope_type": "yarn", "factor": 8.0,
+                   "original_max_position_embeddings": 128}),
+        ("betas", {"rope_type": "yarn", "factor": 16.0, "beta_fast": 64,
+                   "beta_slow": 2,
+                   "original_max_position_embeddings": 256}),
+        ("mscale", {"rope_type": "yarn", "factor": 40.0, "mscale": 1.0,
+                    "mscale_all_dim": 0.8,
+                    "original_max_position_embeddings": 64}),
+    ]:
+        d = _patched_dir(build_tiny_llama, tmp_path, f"yarn-{name}",
+                         {"rope_scaling": dict(scaling)})
+        hf_cfg = AutoConfig.from_pretrained(d)
+        hf_inv, hf_attn = _compute_yarn_parameters(hf_cfg, torch.device("cpu"))
+        cfg = ModelConfig.from_pretrained(d, dtype="float32")
+        theta = hf_cfg.rope_theta
+        dim = hf_cfg.hidden_size // hf_cfg.num_attention_heads
+        base_inv = 1.0 / (theta ** (np.arange(0, dim, 2) / dim))
+        ours = base_inv / np.asarray(cfg.rope_inv_freq_divisors)
+        np.testing.assert_allclose(ours, hf_inv.numpy(), rtol=1e-6,
+                                   err_msg=name)
+        assert cfg.rope_mscale == pytest.approx(hf_attn), name
+
+
+def test_dynamic_ntk_rope_scaling_matches_hf(tmp_path):
+    """dynamic NTK within the pretrained window: HF's init-time
+    frequencies (seq_len = max_position_embeddings) — exact parity.
+    Serving beyond the window bakes the stretched-base frequencies
+    statically (compile-once convention, like longrope)."""
+    from tests.fixture_models import build_tiny_llama
+
+    from vllm_tgis_adapter_tpu.engine.config import ModelConfig
+
+    d = _patched_dir(build_tiny_llama, tmp_path, "dynamic-rope", {
+        "rope_scaling": {"rope_type": "dynamic", "factor": 2.0},
+    })
+    logits, input_ids, config = _prefill_logits(d, "dynamic ntk parity")
+    # within the window HF uses seq_len = max_pos -> stretch term is
+    # (factor*1 - factor + 1) = 1 -> divisors all 1 (plain RoPE)
+    np.testing.assert_allclose(
+        np.asarray(config.rope_inv_freq_divisors), 1.0, rtol=1e-9
+    )
+    np.testing.assert_allclose(
+        logits, _hf_logits(d, input_ids), rtol=1e-3, atol=1e-3
+    )
+
+    # serving at 4x the window: divisors follow (new_base/base)^(2i/dim)
+    cfg_json = json.loads((Path(d) / "config.json").read_text())
+    max_pos = cfg_json["max_position_embeddings"]
+    theta = cfg_json.get("rope_theta", 10000.0)
+    dim = cfg_json["hidden_size"] // cfg_json["num_attention_heads"]
+    long_cfg = ModelConfig.from_pretrained(
+        d, dtype="float32", max_model_len=4 * max_pos
+    )
+    new_theta = theta * (2.0 * 4 - 1.0) ** (dim / (dim - 2))
+    expect = (new_theta / theta) ** (np.arange(0, dim, 2) / dim)
+    np.testing.assert_allclose(
+        np.asarray(long_cfg.rope_inv_freq_divisors), expect, rtol=1e-9
+    )
+
+
+def test_unknown_rope_scaling_rejected(tmp_path):
+    """Unsupported scaling types fail at CONFIG load — running plain
+    RoPE on a scaled checkpoint would silently produce wrong logits."""
+    from tests.fixture_models import build_tiny_llama
+
+    from vllm_tgis_adapter_tpu.engine.config import ModelConfig
+
+    d = _patched_dir(build_tiny_llama, tmp_path, "weird-rope", {
+        "rope_scaling": {"rope_type": "my_custom_scaling", "factor": 2.0},
     })
     with pytest.raises(ValueError, match="rope_scaling"):
         ModelConfig.from_pretrained(d, dtype="float32")

@@ -134,7 +134,18 @@ class ModelConfig:
           arrays — chosen STATICALLY by whether the serving context
           (max_model_len) exceeds the pretrained window, matching the
           compile-once model — plus the sqrt(1 + ln f / ln L) attention
-          factor on cos/sin.
+          factor on cos/sin;
+        * ``yarn`` (yarn-llama, Qwen-long, deepseek lineage): NTK-by-parts
+          — interpolate low frequencies by ``factor``, extrapolate high
+          ones, linear ramp between the beta_fast/beta_slow correction
+          dims — plus the 0.1·mscale·ln(factor)+1 attention factor;
+        * ``dynamic`` (dynamic NTK): base stretched by
+          ``(factor·L/max_pos − factor + 1)^(dim/(dim−2))``.  HF rescales
+          per forward from the live seq_len; the compile-once engine
+          evaluates it STATICALLY at L = max(max_model_len, max_pos) —
+          identical to HF whenever max_model_len stays within the
+          pretrained window (HF's init-time value), and the serving-length
+          frequencies otherwise (same static convention as longrope).
 
         Anything else raises: running plain RoPE under an unsupported
         scaling would silently produce wrong logits.
@@ -164,7 +175,9 @@ class ModelConfig:
             medium = ~(wavelen < old / hi_f) & ~(wavelen > old / lo_f)
             scaled = np.where(medium, smoothed, scaled)
             return tuple((inv_freq / scaled).tolist()), 1.0
-        if rtype == "longrope":
+        if rtype in ("longrope", "su"):
+            # "su" is phi-3's original alias for what transformers later
+            # standardised as "longrope" — identical semantics
             orig = (
                 hf.get("original_max_position_embeddings")
                 or scaling.get("original_max_position_embeddings")
@@ -190,10 +203,60 @@ class ModelConfig:
                     f"({half})"
                 )
             return tuple(float(x) for x in ext), float(mscale)
+        if rtype == "yarn":
+            factor = scaling["factor"]
+            orig = (
+                scaling.get("original_max_position_embeddings")
+                or hf.get("max_position_embeddings", 2048)
+            )
+            attn_factor = scaling.get("attention_factor")
+            msc, msc_all = scaling.get("mscale"), scaling.get("mscale_all_dim")
+
+            def get_mscale(scale: float, m: float = 1.0) -> float:
+                return 1.0 if scale <= 1 else 0.1 * m * math.log(scale) + 1.0
+
+            if attn_factor is None:
+                attn_factor = (
+                    get_mscale(factor, msc) / get_mscale(factor, msc_all)
+                    if msc and msc_all
+                    else get_mscale(factor)
+                )
+            beta_fast = scaling.get("beta_fast") or 32
+            beta_slow = scaling.get("beta_slow") or 1
+
+            def correction_dim(rotations: float) -> float:
+                return (
+                    dim * math.log(orig / (rotations * 2 * math.pi))
+                ) / (2 * math.log(theta))
+
+            low, high = correction_dim(beta_fast), correction_dim(beta_slow)
+            if scaling.get("truncate", True):
+                low, high = math.floor(low), math.ceil(high)
+            low, high = max(low, 0), min(high, dim - 1)
+            if low == high:
+                high += 0.001  # avoid the 0/0 ramp singularity
+            ramp = np.clip(
+                (np.arange(half, dtype=np.float32) - low) / (high - low),
+                0.0, 1.0,
+            )
+            extrap_w = 1.0 - ramp  # 1 → keep base freq, 0 → interpolate
+            scaled = (
+                inv_freq / factor * (1 - extrap_w) + inv_freq * extrap_w
+            )
+            return tuple((inv_freq / scaled).tolist()), float(attn_factor)
+        if rtype == "dynamic":
+            factor = scaling["factor"]
+            max_pos = hf.get("max_position_embeddings", 2048)
+            seq_len = max(max_len or max_pos, max_pos)
+            new_theta = theta * (
+                (factor * seq_len / max_pos) - (factor - 1)
+            ) ** (dim / (dim - 2))
+            scaled = 1.0 / (new_theta ** (np.arange(0, dim, 2) / dim))
+            return tuple((inv_freq / scaled).tolist()), 1.0
         raise ValueError(
             f"rope_scaling type {rtype!r} is not supported (supported: "
-            "linear, llama3, longrope); refusing to run plain RoPE on a "
-            "scaled checkpoint"
+            "linear, llama3, longrope/su, yarn, dynamic); refusing to "
+            "run plain RoPE on a scaled checkpoint"
         )
 
     @staticmethod
