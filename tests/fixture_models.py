@@ -716,3 +716,132 @@ def build_tiny_qwen3(path: str, seed: int = 0) -> str:
         }
     save_file(tensors, out / "model.safetensors")
     return str(out)
+
+
+# ---------------------------------------------------------- int4 checkpoints
+
+
+def _pack_int32_nibbles(vals, axis):
+    """int4 values → int32 words, 8 per word along ``axis`` (sequential
+    nibble order) — the inverse of engine/quantized._unpack_int32_nibbles."""
+    import numpy as np
+
+    vals = np.asarray(vals).astype(np.int64) & 0xF
+    vals = vals.astype(np.uint32)
+    new_shape = list(vals.shape)
+    new_shape[axis] //= 8
+    grouped = vals.reshape(
+        new_shape[:axis] + [new_shape[axis], 8] + new_shape[axis + 1:]
+    )
+    shifts = (np.arange(8, dtype=np.uint32) * 4).reshape(
+        (1,) * (axis + 1) + (8,) + (1,) * (grouped.ndim - axis - 2)
+    )
+    # ascontiguousarray: safetensors serialises the raw buffer, so a
+    # non-contiguous result would be written scrambled
+    return np.ascontiguousarray(
+        (grouped << shifts).sum(axis=axis + 1).astype(np.int32)
+    )
+
+
+def quantize_checkpoint_int4(src_dir, dst_dir, *, method="awq",
+                             group_size=8, desc_act=False, seed=0):
+    """Re-write a tiny fp checkpoint in the AWQ / AutoGPTQ int4 wire
+    format (qweight/qzeros/scales[/g_idx] + quantization_config) so the
+    dequant-on-load path (engine/quantized.py) can be pinned without
+    network access.  Returns the destination path."""
+    import json
+    import shutil
+    from pathlib import Path
+
+    import numpy as np
+    from safetensors.numpy import save_file
+
+    from safetensors import safe_open
+
+    AWQ_ORDER = (0, 2, 4, 6, 1, 3, 5, 7)
+    src, dst = Path(src_dir), Path(dst_dir)
+    dst.mkdir(parents=True, exist_ok=True)
+    for f in src.iterdir():
+        if f.name != "model.safetensors":
+            shutil.copy(f, dst / f.name)
+
+    rng = np.random.default_rng(seed)
+    quant_suffixes = ("q_proj.weight", "k_proj.weight", "v_proj.weight",
+                      "o_proj.weight", "gate_proj.weight",
+                      "up_proj.weight", "down_proj.weight")
+    out_tensors = {}
+    with safe_open(src / "model.safetensors", framework="numpy") as fh:
+        for name in fh.keys():
+            w = fh.get_tensor(name)
+            if not name.endswith(quant_suffixes):
+                out_tensors[name] = w
+                continue
+            prefix = name[: -len(".weight")]
+            wt = w.astype(np.float32).T  # [in, out]
+            in_f, out_f = wt.shape
+            assert in_f % group_size == 0 and out_f % 8 == 0
+            groups = in_f // group_size
+            if method == "gptq" and desc_act:
+                g_idx = rng.permutation(
+                    np.repeat(np.arange(groups), group_size)
+                ).astype(np.int32)
+            else:
+                g_idx = np.repeat(np.arange(groups), group_size)
+            # per (group, out-col) asymmetric int4 quantization
+            scales = np.zeros((groups, out_f), np.float32)
+            zeros = np.zeros((groups, out_f), np.int32)
+            q = np.zeros((in_f, out_f), np.int32)
+            for g in range(groups):
+                rows = np.nonzero(g_idx == g)[0]
+                block = wt[rows]
+                # the quantization range must include 0 so the zero-point
+                # lands in [0, 15] (an all-negative group would otherwise
+                # clip z and shift the whole block by |hi|)
+                lo = np.minimum(block.min(axis=0), 0.0)
+                hi = np.maximum(block.max(axis=0), 0.0)
+                s = np.maximum((hi - lo) / 15.0, 1e-8)
+                # gptq: floor 1 keeps the stored-minus-one convention
+                # invertible (z=0 would wrap to 15 on unpack)
+                z_floor = 1 if method == "gptq" else 0
+                z = np.clip(np.round(-lo / s), z_floor, 15)
+                scales[g], zeros[g] = s, z.astype(np.int32)
+                q[rows] = np.clip(
+                    np.round(block / s) + z, 0, 15
+                ).astype(np.int32)
+            if method == "awq":
+                # nibble interleave along out: inverse of the unpack order
+                order = np.arange(out_f).reshape(-1, 8)[
+                    :, list(AWQ_ORDER)
+                ].reshape(-1)
+                inv = np.empty_like(order)
+                inv[order] = np.arange(out_f)
+                out_tensors[f"{prefix}.qweight"] = _pack_int32_nibbles(
+                    q[:, inv], axis=1)
+                out_tensors[f"{prefix}.qzeros"] = _pack_int32_nibbles(
+                    zeros[:, inv], axis=1)
+                out_tensors[f"{prefix}.scales"] = scales.astype(np.float16)
+            else:  # gptq
+                out_tensors[f"{prefix}.qweight"] = _pack_int32_nibbles(
+                    q, axis=0)
+                # classic stored-minus-one zero-point convention
+                out_tensors[f"{prefix}.qzeros"] = _pack_int32_nibbles(
+                    zeros - 1, axis=1)
+                out_tensors[f"{prefix}.scales"] = scales.astype(np.float16)
+                if desc_act:
+                    out_tensors[f"{prefix}.g_idx"] = g_idx
+    save_file(out_tensors, dst / "model.safetensors")
+
+    cfg_path = dst / "config.json"
+    cfg = json.loads(cfg_path.read_text())
+    if method == "awq":
+        cfg["quantization_config"] = {
+            "quant_method": "awq", "bits": 4, "group_size": group_size,
+            "version": "gemm", "zero_point": True,
+        }
+    else:
+        cfg["quantization_config"] = {
+            "quant_method": "gptq", "bits": 4, "group_size": group_size,
+            "desc_act": desc_act, "sym": False,
+        }
+    cfg_path.write_text(json.dumps(cfg, indent=2))
+    return str(dst)

@@ -61,6 +61,12 @@ class ModelConfig:
     embedding_multiplier: float = 1.0
     residual_multiplier: float = 1.0
     attention_multiplier: Optional[float] = None
+    # int4 quantized checkpoint (AWQ/AutoGPTQ wire formats): tensors are
+    # stored packed (qweight/qzeros/scales[/g_idx]) and dequantized
+    # group-wise at load into the model dtype (engine/quantized.py)
+    checkpoint_quant: Optional[str] = None  # None | "awq" | "gptq"
+    checkpoint_quant_group_size: int = 128
+    checkpoint_quant_desc_act: bool = False  # gptq act-order (g_idx)
     # mixtral-style MoE (num_experts == 0 means dense)
     num_experts: int = 0
     num_experts_per_tok: int = 0
@@ -72,6 +78,10 @@ class ModelConfig:
     # weight contributes zero), the standard MoE serving trade-off
     moe_dispatch: str = "dense"  # "dense" | "capacity"
     moe_capacity_factor: float = 1.25
+    # surface capacity-dispatch drop counts to Prometheus via a host
+    # io_callback — set by the engine on single-device runs only
+    # (engine/core.py from_config); off under SPMD meshes
+    moe_record_drops: bool = False
     attention_bias: bool = False
     mlp_bias: bool = False
     # architecture family knobs beyond the llama lineage (OPT et al.);
@@ -261,6 +271,42 @@ class ModelConfig:
 
     @staticmethod
     def from_hf_config(
+        model: str,
+        hf: dict,
+        *,
+        max_model_len: int | None = None,
+        dtype: str = "auto",
+    ) -> "ModelConfig":
+        """Map a HF transformers config dict onto ModelConfig, including
+        the int4 quantized-checkpoint metadata (AWQ/GPTQ)."""
+        cfg = ModelConfig._from_hf_config_impl(
+            model, hf, max_model_len=max_model_len, dtype=dtype
+        )
+        qc = hf.get("quantization_config")
+        if qc:
+            method = (qc.get("quant_method") or "").lower()
+            if method not in ("awq", "gptq"):
+                raise ValueError(
+                    f"quantization_config quant_method {method!r} is not "
+                    "supported (supported: awq, gptq)"
+                )
+            bits = qc.get("bits", qc.get("w_bit", 4))
+            if bits != 4:
+                raise ValueError(
+                    f"{method} checkpoints with bits={bits} are not "
+                    "supported (int4 only)"
+                )
+            group = qc.get("group_size", qc.get("q_group_size", 128))
+            cfg = dataclasses.replace(
+                cfg,
+                checkpoint_quant=method,
+                checkpoint_quant_group_size=int(group),
+                checkpoint_quant_desc_act=bool(qc.get("desc_act", False)),
+            )
+        return cfg
+
+    @staticmethod
+    def _from_hf_config_impl(
         model: str,
         hf: dict,
         *,
@@ -764,6 +810,11 @@ class EngineConfig:
     parallel_config: ParallelConfig
     lora_config: LoRAConfig
     tokenizer: str | None = None
+    # checkpoint revision: picks the HF-cache snapshot when --model is a
+    # hub id (tgis_utils/hub.get_model_path) and rides through to
+    # AutoTokenizer.from_pretrained (reference passes it into vLLM's
+    # engine args, src/vllm_tgis_adapter/tgis_utils/args.py)
+    revision: str | None = None
     # allow custom tokenizer/config code shipped inside the (local)
     # model directory — passed through to AutoTokenizer.from_pretrained
     trust_remote_code: bool = False
@@ -777,16 +828,29 @@ class EngineConfig:
     speculative: "Optional[SpeculativeConfig]" = None
 
     def __post_init__(self) -> None:
-        if self.quantization not in (None, "int8"):
-            # truthful flags (VERDICT r2/r3): only the scheme that is
+        if self.quantization not in (None, "int8", "awq", "gptq"):
+            # truthful flags (VERDICT r2/r3): only the schemes that are
             # actually implemented may pass boot.  Reference maps these
             # names into vLLM's quantization engine
             # (tgis_utils/args.py --quantize); here int8 weight-only is
-            # native (engine/weights.py quantize_params_int8)
+            # native (engine/weights.py quantize_params_int8) and
+            # awq/gptq int4 checkpoints dequantize at load
+            # (engine/quantized.py)
             raise ValueError(
                 f"quantization scheme {self.quantization!r} is not "
-                "implemented; only 'int8' (native weight-only, "
-                "per-channel) is supported"
+                "implemented; supported: 'int8' (native weight-only, "
+                "per-channel), 'awq'/'gptq' (int4 checkpoint, "
+                "dequant-on-load)"
+            )
+        ckpt_quant = self.model_config.checkpoint_quant
+        if self.quantization in ("awq", "gptq") and (
+            self.quantization != ckpt_quant
+        ):
+            raise ValueError(
+                f"--quantization {self.quantization} but the checkpoint's "
+                f"quantization_config says "
+                f"{ckpt_quant or 'no quantization'}; the checkpoint "
+                "format is authoritative — drop the flag or fix the model"
             )
         if self.parallel_config.sequence_parallel_size > 1 and (
             self.model_config.sliding_window > 0
@@ -828,8 +892,28 @@ class EngineConfig:
     @staticmethod
     def from_args(args: Any) -> "EngineConfig":
         """Build from the parsed CLI namespace (tgis_utils/args.py)."""
+        revision = getattr(args, "revision", None)
+        model_path = args.model
+        if not Path(model_path).exists():
+            # hub id: resolve (model, revision) to the cached snapshot
+            # directory — tgis_utils/hub applies local path > cache
+            # override > HF cache, offline-only
+            from ..tgis_utils import hub
+
+            try:
+                model_path = hub.get_model_path(model_path, revision)
+            except Exception as e:
+                # keep the wire-visible boot error (termination log +
+                # healthcheck parse "config.json") for a model that is
+                # neither a local path nor a cached snapshot
+                raise ValueError(
+                    f"model path {model_path!r} has no config.json and is "
+                    "not a cached hub snapshot; only local model paths are "
+                    "supported (use `model-util download-weights` to fetch "
+                    "from the HF hub)"
+                ) from e
         model_config = ModelConfig.from_pretrained(
-            args.model,
+            model_path,
             max_model_len=args.max_model_len,
             dtype=args.dtype,
         )
@@ -886,6 +970,7 @@ class EngineConfig:
             ),
             speculative=SpeculativeConfig.from_args(args, model_config),
             tokenizer=args.tokenizer,
+            revision=revision,
             trust_remote_code=getattr(args, "trust_remote_code", False),
             seed=args.seed,
             max_logprobs=args.max_logprobs,

@@ -170,6 +170,20 @@ class LLMEngine:
                 "replicas"
             )
         mcfg = config.model_config
+        pcfg = config.parallel_config
+        if (
+            mcfg.moe_dispatch == "capacity"
+            and not mcfg.moe_record_drops
+            and pcfg.tensor_parallel_size
+            * pcfg.pipeline_parallel_size
+            * pcfg.sequence_parallel_size == 1
+        ):
+            # observable capacity drops (metrics.py record_moe_dispatch);
+            # multi-device meshes skip the host callback — it would run
+            # per-shard inside the SPMD program and stall collectives
+            import dataclasses as _dc
+
+            mcfg = _dc.replace(mcfg, moe_record_drops=True)
         model_cls = get_model_class(mcfg.model_type)
         model = model_cls(mcfg)
         # build the mesh BEFORE loading so every tensor is sharded onto it
@@ -225,6 +239,7 @@ class LLMEngine:
 
         tokenizer = AutoTokenizer.from_pretrained(
             config.tokenizer or mcfg.model,
+            revision=config.revision,
             trust_remote_code=config.trust_remote_code,
         )
         # KV auto-sizing must read free HBM from a device THIS replica

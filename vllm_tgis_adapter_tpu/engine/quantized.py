@@ -1,0 +1,177 @@
+"""Int4 quantized-checkpoint loading: AWQ and GPTQ dequant-on-load.
+
+The reference serves AWQ/GPTQ checkpoints by passing ``--quantize``
+through to vLLM's CUDA dequant kernels
+(/root/reference/src/vllm_tgis_adapter/tgis_utils/args.py:157-163).  A
+TPU has no int4 MXU path, so the TPU-native design dequantizes
+group-wise at LOAD time into the model dtype (bf16 resident; compose
+with ``--quantization int8`` to requantize the dense projections to
+int8 weight-only for ~2× HBM savings).  Decode throughput is
+HBM-bandwidth-bound, so the resident dtype — not the checkpoint
+format — sets the perf ceiling; dequant-on-load keeps the whole
+serving path (Pallas kernels, TP sharding, LoRA) unchanged.
+
+Layouts (AutoAWQ / AutoGPTQ wire formats):
+
+* AWQ ``qweight``: int32 ``[in, out/8]``, eight 4-bit values per word
+  in the interleaved order ``[0, 2, 4, 6, 1, 3, 5, 7]``; ``qzeros``
+  int32 ``[in/g, out/8]`` same packing; ``scales`` fp16 ``[in/g, out]``.
+  Dequant: ``w = (q - z) * s``.
+* GPTQ ``qweight``: int32 ``[in/8, out]``, eight 4-bit values per word
+  in sequential nibble order along the INPUT dim; ``qzeros`` int32
+  ``[groups, out/8]`` sequential; ``scales`` fp16 ``[groups, out]``;
+  optional ``g_idx`` int32 ``[in]`` row→group map (``desc_act=True``).
+  Dequant: ``w = (q - (z + 1)) * s`` (the classic stored-minus-one
+  zero-point convention).
+
+Both dequantize to ``W[in, out]`` and are returned transposed to the
+HF Linear convention ``[out, in]`` so every family loader's
+``take(..., transpose=True)`` works unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from vllm_tgis_adapter_tpu.logging import init_logger
+
+logger = init_logger(__name__)
+
+_AWQ_ORDER = (0, 2, 4, 6, 1, 3, 5, 7)
+_PACK = 8  # int4 values per int32 word
+
+
+def _unpack_int32_nibbles(packed: np.ndarray, axis: int) -> np.ndarray:
+    """int32 array → int4 values expanded 8× along ``axis`` (sequential
+    nibble order: value ``i`` lives at bits ``4i``)."""
+    shifts = np.arange(_PACK, dtype=np.uint32) * 4
+    x = packed.astype(np.uint32)
+    x = np.expand_dims(x, axis=axis + 1)
+    shape = [1] * x.ndim
+    shape[axis + 1] = _PACK
+    vals = (x >> shifts.reshape(shape)) & 0xF
+    new_shape = list(packed.shape)
+    new_shape[axis] *= _PACK
+    return vals.reshape(new_shape).astype(np.int32)
+
+
+def _reverse_awq_order(unpacked: np.ndarray) -> np.ndarray:
+    """Undo AWQ's nibble interleave along the last axis."""
+    n = unpacked.shape[-1]
+    order = np.arange(n).reshape(-1, _PACK)[:, list(_AWQ_ORDER)].reshape(-1)
+    return unpacked[..., order]
+
+
+# dequant processes the input dim in slabs so host memory stays near ONE
+# output tensor (the CheckpointIndex contract for 70B-class loads): a
+# whole-tensor unpack would hold q/z/s [in, out] int32+f32 intermediates
+# at once, ~16× the packed int4 bytes
+_DEQUANT_CHUNK_ROWS = 4096
+
+
+def dequantize_awq(
+    qweight: np.ndarray,  # int32 [in, out/8]
+    qzeros: np.ndarray,  # int32 [in/g, out/8]
+    scales: np.ndarray,  # fp16/fp32 [in/g, out]
+    group_size: int,
+) -> np.ndarray:
+    """AWQ int4 → float32 ``W[in, out]``."""
+    in_f, out_f = qweight.shape[0], qweight.shape[1] * _PACK
+    if group_size <= 0:  # q_group_size -1: one group over the whole dim
+        group_size = in_f
+    z = _reverse_awq_order(_unpack_int32_nibbles(qzeros, axis=1))
+    s = scales.astype(np.float32)
+    out = np.empty((in_f, out_f), np.float32)
+    # chunk on group boundaries so the per-chunk repeat stays aligned
+    chunk = max(group_size,
+                _DEQUANT_CHUNK_ROWS // group_size * group_size)
+    for r0 in range(0, in_f, chunk):
+        r1 = min(in_f, r0 + chunk)
+        q = _reverse_awq_order(_unpack_int32_nibbles(qweight[r0:r1], axis=1))
+        g0 = r0 // group_size
+        g1 = -(-r1 // group_size)
+        sc = np.repeat(s[g0:g1], group_size, axis=0)[: r1 - r0]
+        zc = np.repeat(z[g0:g1], group_size, axis=0)[: r1 - r0]
+        out[r0:r1] = (q - zc) * sc
+    return out
+
+
+def dequantize_gptq(
+    qweight: np.ndarray,  # int32 [in/8, out]
+    qzeros: np.ndarray,  # int32 [groups, out/8]
+    scales: np.ndarray,  # fp16/fp32 [groups, out]
+    group_size: int,
+    g_idx: Optional[np.ndarray] = None,  # int32 [in] row→group
+) -> np.ndarray:
+    """GPTQ int4 → float32 ``W[in, out]`` (handles act-order g_idx)."""
+    in_f, out_f = qweight.shape[0] * _PACK, qweight.shape[1]
+    if g_idx is None:
+        if group_size <= 0:
+            group_size = in_f
+        g_idx = np.arange(in_f, dtype=np.int64) // group_size
+    else:
+        g_idx = np.asarray(g_idx, dtype=np.int64)
+    z = _unpack_int32_nibbles(qzeros, axis=1) + 1  # stored minus one
+    s = scales.astype(np.float32)
+    out = np.empty((in_f, out_f), np.float32)
+    chunk = _DEQUANT_CHUNK_ROWS  # multiple of the 8-row packing
+    for r0 in range(0, in_f, chunk):
+        r1 = min(in_f, r0 + chunk)
+        q = _unpack_int32_nibbles(qweight[r0 // _PACK: r1 // _PACK], axis=0)
+        gi = g_idx[r0:r1]
+        out[r0:r1] = (q - z[gi]) * s[gi]
+    return out
+
+
+class Int4CheckpointIndex:
+    """Wrap a ``CheckpointIndex`` so quantized projections look like
+    plain fp tensors: ``X.weight`` is synthesised on demand from
+    ``X.qweight`` + ``X.qzeros`` + ``X.scales`` (+ ``X.g_idx``), in the
+    HF Linear orientation ``[out, in]``.  Unquantized tensors
+    (embeddings, norms, lm_head) pass straight through, so the family
+    loaders in engine/weights.py need no changes.
+    """
+
+    def __init__(self, raw, *, method: str, group_size: int):
+        if method not in ("awq", "gptq"):
+            raise ValueError(f"unsupported int4 method {method!r}")
+        self._raw = raw
+        self._method = method
+        self._group_size = group_size
+
+    def _quant_prefix(self, name: str) -> Optional[str]:
+        if not name.endswith(".weight"):
+            return None
+        prefix = name[: -len(".weight")]
+        if f"{prefix}.qweight" in self._raw:
+            return prefix
+        return None
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._raw or self._quant_prefix(name) is not None
+
+    def pop(self, name: str):  # noqa: ANN201 — mirrors CheckpointIndex
+        prefix = self._quant_prefix(name)
+        if prefix is None:
+            return self._raw.pop(name)
+        qweight = np.asarray(self._raw.pop(f"{prefix}.qweight"))
+        qzeros = np.asarray(self._raw.pop(f"{prefix}.qzeros"))
+        scales = np.asarray(self._raw.pop(f"{prefix}.scales"),
+                            dtype=np.float32)
+        if self._method == "awq":
+            w = dequantize_awq(qweight, qzeros, scales, self._group_size)
+        else:
+            g_idx = None
+            if f"{prefix}.g_idx" in self._raw:
+                g_idx = np.asarray(self._raw.pop(f"{prefix}.g_idx"))
+            w = dequantize_gptq(
+                qweight, qzeros, scales, self._group_size, g_idx
+            )
+        # quantized linears may also carry an fp bias — passed through
+        # under its own name by the loaders that consume it
+        return w.T  # HF Linear convention [out, in]
+
+    def remaining(self) -> list[str]:
+        return self._raw.remaining()

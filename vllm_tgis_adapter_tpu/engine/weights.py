@@ -77,6 +77,30 @@ def load_checkpoint_tensors(model_path: str) -> dict:
     return {name: index.pop(name) for name in index.remaining()}
 
 
+
+def open_checkpoint_index(config: "ModelConfig", model_path: str):
+    """CheckpointIndex, wrapped for int4 (AWQ/GPTQ) checkpoints so
+    quantized projections surface as plain fp ``.weight`` tensors
+    (engine/quantized.py dequant-on-load)."""
+    raw = CheckpointIndex(model_path)
+    method = getattr(config, "checkpoint_quant", None)
+    if method:
+        from vllm_tgis_adapter_tpu.engine.quantized import (
+            Int4CheckpointIndex,
+        )
+
+        logger.info(
+            "int4 %s checkpoint: dequantizing group-wise (group_size=%d) "
+            "to %s at load", method,
+            config.checkpoint_quant_group_size, config.dtype.__name__,
+        )
+        raw = Int4CheckpointIndex(
+            raw, method=method,
+            group_size=config.checkpoint_quant_group_size,
+        )
+    return raw
+
+
 def load_llama_params(
     config: "ModelConfig",
     model_path: str,
@@ -85,7 +109,7 @@ def load_llama_params(
     """Build the LlamaForCausalLM param pytree from a HF checkpoint."""
     place = place or (lambda _name, x: x)
     dtype = config.dtype
-    raw = CheckpointIndex(model_path)
+    raw = open_checkpoint_index(config, model_path)
     # gemma lineage: HF's RMSNorm computes (1 + w) * x̂; folding the
     # offset into the stored weight once here keeps the runtime norm
     # the plain w * x̂ shared by the whole family
@@ -179,7 +203,7 @@ def load_opt_params(
     """
     place = place or (lambda _name, x: x)
     dtype = config.dtype
-    raw = CheckpointIndex(model_path)
+    raw = open_checkpoint_index(config, model_path)
 
     def take(name: str, transpose: bool = False) -> jax.Array:
         for cand in (f"model.{name}", name):
@@ -299,7 +323,7 @@ def load_gpt_neox_params(
     parallel/sharding.py's suffix table).
     """
     place = place or (lambda _name, x: x)
-    raw = CheckpointIndex(model_path)
+    raw = open_checkpoint_index(config, model_path)
     h, dh, d = config.num_heads, config.head_dim, config.hidden_size
     take = _make_take(raw, config.dtype, place, ("",))
 
@@ -366,7 +390,7 @@ def load_bloom_params(
     is tied.  Both bare and ``transformer.``-prefixed exports load.
     """
     place = place or (lambda _name, x: x)
-    raw = CheckpointIndex(model_path)
+    raw = open_checkpoint_index(config, model_path)
     h, dh, d = config.num_heads, config.head_dim, config.hidden_size
     take = _make_take(raw, config.dtype, place, ("", "transformer."))
 
@@ -431,7 +455,7 @@ def load_gpt2_params(
     exports load.
     """
     place = place or (lambda _name, x: x)
-    raw = CheckpointIndex(model_path)
+    raw = open_checkpoint_index(config, model_path)
     d = config.hidden_size
     take = _make_take(raw, config.dtype, place, ("", "transformer."))
 
@@ -495,7 +519,7 @@ def load_phi3_params(
     the standard Megatron column-parallel specs apply.
     """
     place = place or (lambda _name, x: x)
-    raw = CheckpointIndex(model_path)
+    raw = open_checkpoint_index(config, model_path)
     h, hkv, dh = config.num_heads, config.num_kv_heads, config.head_dim
     f = config.intermediate_size
     take = _make_take(raw, config.dtype, place, ("",))

@@ -473,14 +473,51 @@ class LlamaForCausalLM:
         flat_w = top_p.reshape(-1)
         flat_tok = jnp.repeat(jnp.arange(t, dtype=jnp.int32), k)
 
+        # padding rows (bucket tail, slot -1 — mask set at the prefill/
+        # decode entry points) must not eat expert capacity: zero them out
+        # of the position ranking and never dispatch them, so real tokens
+        # get the full buffer and the drop metrics count real work only
+        valid = getattr(self, "_moe_valid_mask", None)
+        flat_valid = None if valid is None else jnp.repeat(valid, k)
+
         # position of each assignment within its expert's buffer: rank
         # among same-expert assignments in flat order (cumsum of the
         # one-hot assignment matrix)
         onehot = jax.nn.one_hot(flat_e, num_experts, dtype=jnp.int32)
+        if flat_valid is not None:
+            onehot = onehot * flat_valid[:, None].astype(onehot.dtype)
         pos = jnp.take_along_axis(
             jnp.cumsum(onehot, axis=0) - 1, flat_e[:, None], axis=1
         )[:, 0]  # [T*k]
         keep = pos < capacity
+        if flat_valid is not None:
+            keep = keep & flat_valid
+
+        if cfg.moe_record_drops:
+            # surface the silent-drop count to Prometheus (metrics.py).
+            # Only wired on single-device engines: a host callback inside
+            # an SPMD program would run per-shard and stall collectives.
+            import functools
+
+            from jax.experimental import io_callback
+
+            from vllm_tgis_adapter_tpu import metrics as _metrics
+
+            if flat_valid is None:
+                dropped = jnp.sum(jnp.logical_not(keep))
+                total = jnp.asarray(t * k, jnp.int32)
+            else:
+                dropped = jnp.sum(jnp.logical_not(keep) & flat_valid)
+                total = jnp.sum(flat_valid).astype(jnp.int32)
+            io_callback(
+                functools.partial(
+                    _metrics.record_moe_dispatch, capacity=capacity
+                ),
+                None,
+                dropped,
+                total,
+                ordered=False,
+            )
 
         # scatter tokens into per-expert buffers; dropped assignments
         # remap to expert index E and are discarded by mode='drop'
@@ -576,6 +613,10 @@ class LlamaForCausalLM:
         k_cache, v_cache = caches
         scale = self._attention_scale()
         tables = self._rope_tables(positions)
+        # trace-local row-validity mask (padding rows carry slot -1):
+        # capacity MoE dispatch excludes padding so it cannot eat expert
+        # capacity or skew the drop metrics (_moe_capacity_mlp)
+        self._moe_valid_mask = slot_mapping >= 0
         # negative (padding) slots must not wrap: remap past the end, then
         # scatter mode='drop' discards them (JAX drops only positive OOB)
         safe_slots = jnp.where(slot_mapping < 0, k_cache.shape[2], slot_mapping)
@@ -651,6 +692,7 @@ class LlamaForCausalLM:
         k_cache, v_cache = caches
         scale = self._attention_scale()
         tables = self._rope_tables(positions)
+        self._moe_valid_mask = slot_mapping >= 0  # see prefill
         safe_slots = jnp.where(slot_mapping < 0, k_cache.shape[2], slot_mapping)
 
         # the chunk's first global position; padding rows (beyond
@@ -789,6 +831,7 @@ class LlamaForCausalLM:
         k_cache, v_cache = caches
         scale = self._attention_scale()
         tables = self._rope_tables(positions)
+        self._moe_valid_mask = slot_mapping >= 0  # see prefill
         # see prefill: negative pad slots must not wrap to the last page
         safe_slots = jnp.where(slot_mapping < 0, k_cache.shape[2], slot_mapping)
 

@@ -147,7 +147,8 @@ def add_engine_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     g.add_argument("--served-model-name", type=str, nargs="*", default=None,
                    help="model name(s) reported by the APIs; defaults to --model")
     g.add_argument("--revision", type=str, default=None,
-                   help="model revision (accepted for compatibility)")
+                   help="model revision: selects the HF-cache snapshot for "
+                        "weights/config and the tokenizer revision")
     g.add_argument("--trust-remote-code", action="store_true",
                    help="allow custom code from the model repo when loading "
                         "tokenizer/config")
@@ -170,8 +171,11 @@ def add_engine_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                    choices=["int8", "awq", "gptq", "squeezellm"],
                    help="weight quantization scheme: int8 is native "
                         "(weight-only, per-channel, quantized on load); "
-                        "awq/gptq/squeezellm are accepted for CLI compat "
-                        "but rejected at engine boot until implemented")
+                        "awq/gptq int4 checkpoints dequantize group-wise "
+                        "at load (the checkpoint's quantization_config is "
+                        "authoritative — the flag just validates it); "
+                        "squeezellm is accepted for CLI compat but "
+                        "rejected at engine boot")
     g.add_argument("--max-model-len", type=int, default=None,
                    help="model context length; derived from the model config "
                         "if unset")
