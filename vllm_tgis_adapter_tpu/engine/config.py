@@ -852,18 +852,11 @@ class EngineConfig:
                 f"{ckpt_quant or 'no quantization'}; the checkpoint "
                 "format is authoritative — drop the flag or fix the model"
             )
-        if self.parallel_config.sequence_parallel_size > 1 and (
-            self.model_config.sliding_window > 0
-            or self.model_config.position_embedding == "alibi"
-        ):
-            # ring attention (the sp>1 prefill path) carries neither the
-            # band mask nor position biases; without this check the
-            # server boots and then dies on the first request when
-            # ops/attention.py rejects the combination at trace time
-            raise ValueError(
-                "sliding-window / ALiBi models do not compose with "
-                "--sequence-parallel-size > 1 yet"
-            )
+        # sliding-window / ALiBi compose with sp>1: the ring carries the
+        # band mask and position bias in global coordinates across hops,
+        # ulysses head-slices the slopes (ops/ring_attention.py,
+        # ops/ulysses_attention.py; parity on the virtual mesh in
+        # tests/test_ring_attention.py)
         pp = self.parallel_config.pipeline_parallel_size
         if pp <= 1:
             return

@@ -23,6 +23,7 @@ Design notes (TPU-first, SURVEY.md §7):
 
 from __future__ import annotations
 
+import functools
 from typing import TYPE_CHECKING
 
 import jax
@@ -144,6 +145,22 @@ def _lora_delta_batched(lora, layer: int, idx, target: str, x: jax.Array):
     t = jnp.einsum("bd,bdr->br", x.astype(jnp.float32), a_sel)
     d = jnp.einsum("br,bro->bo", t, b_sel)
     return (jnp.take(lora.scaling, idx)[:, None] * d).astype(x.dtype)
+
+
+def _clears_moe_mask(fn):
+    """Reset the trace-local MoE validity mask when the entry point
+    returns: the attribute is only meaningful inside the trace that set
+    it, and a leaked tracer would poison any later direct _moe_mlp call
+    (advisor: stale-state hazard of the side-channel mask)."""
+
+    @functools.wraps(fn)
+    def wrapper(self, *args, **kwargs):
+        try:
+            return fn(self, *args, **kwargs)
+        finally:
+            self._moe_valid_mask = None
+
+    return wrapper
 
 
 class LlamaForCausalLM:
@@ -576,6 +593,7 @@ class LlamaForCausalLM:
             logits = logits / cfg.logits_scaling
         return logits
 
+    @_clears_moe_mask
     def prefill(
         self,
         params: dict,
@@ -661,6 +679,7 @@ class LlamaForCausalLM:
             x = x[logits_indices]
         return self._logits(params, x), (k_cache, v_cache)
 
+    @_clears_moe_mask
     def prefill_chunk(
         self,
         params: dict,
@@ -810,6 +829,7 @@ class LlamaForCausalLM:
         logits = self._logits(params, x)  # [B*K, V]
         return logits.reshape(b, k, -1), (k_cache, v_cache)
 
+    @_clears_moe_mask
     def decode(
         self,
         params: dict,

@@ -107,14 +107,6 @@ def prefill_attention(
     causal mask).  The scheduler only packs on the plain causal path, so
     seg_starts never combines with window/ALiBi/sp.
     """
-    if mesh is not None and dict(mesh.shape).get("sp", 1) > 1 and (
-        window > 0 or alibi_slopes is not None
-    ):
-        raise NotImplementedError(
-            "sliding-window / ALiBi attention does not compose with "
-            "--sequence-parallel-size > 1 yet (ring attention carries "
-            "neither the band mask nor position biases)"
-        )
     if seg_starts is not None and (
         window > 0
         or alibi_slopes is not None
@@ -126,6 +118,10 @@ def prefill_attention(
             "requests (engine/scheduler.py allow_packed)"
         )
     if mesh is not None and dict(mesh.shape).get("sp", 1) > 1:
+        # window/ALiBi ride through both sp styles: the ring carries the
+        # band mask / position bias in GLOBAL coordinates across hops
+        # (ops/ring_attention.py _chunk_attention), ulysses head-slices
+        # the slopes to follow its all-to-all repartition
         vl = (
             jnp.asarray(q.shape[0], jnp.int32)
             if valid_len is None
@@ -136,12 +132,18 @@ def prefill_attention(
                 ulysses_prefill_attention,
             )
 
-            return ulysses_prefill_attention(q, k, v, scale, vl, mesh)
+            return ulysses_prefill_attention(
+                q, k, v, scale, vl, mesh, window=window,
+                alibi_slopes=alibi_slopes,
+            )
         from vllm_tgis_adapter_tpu.ops.ring_attention import (
             ring_prefill_attention,
         )
 
-        return ring_prefill_attention(q, k, v, scale, vl, mesh)
+        return ring_prefill_attention(
+            q, k, v, scale, vl, mesh, window=window,
+            alibi_slopes=alibi_slopes,
+        )
     if _use_pallas():
         from vllm_tgis_adapter_tpu.ops import pallas_attention
 
@@ -248,6 +250,11 @@ def prefill_attention_xla(
         mask = mask & (jnp.arange(t) < valid_len)[None, :]
     scores = jnp.where(mask[None, None], scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
+    # fully-masked rows (bucket padding beyond valid_len+window) softmax
+    # to NaN, and 0·NaN in the value contraction would poison EVERY row
+    # at the next layer (padding rows feed layer n+1's K/V); exact zeros
+    # keep padding outputs finite (0) and valid rows untouched
+    probs = jnp.where(mask[None, None], probs, 0.0)
     out = jnp.einsum("kgts,skd->tkgd", probs, vh)
     return out.reshape(t, num_heads, head_dim).astype(q.dtype)
 

@@ -40,11 +40,29 @@ def _chunk_attention(
     m: jax.Array,  # [Hkv, G, C, 1] running max
     l: jax.Array,  # [Hkv, G, C, 1] running denom
     acc: jax.Array,  # [Hkv, G, C, Dh] running numerator
+    window: int = 0,  # >0: band mask over GLOBAL positions
+    slopes: jax.Array | None = None,  # [Hkv, G] f32 ALiBi slopes
 ):
     s = jnp.einsum("ckgd,skd->kgcs", q, k) * scale  # [Hkv, G, C, C]
+    if slopes is not None:
+        # HF bloom convention (ops/attention.py prefill_attention_xla):
+        # score += slope_h * j with j the GLOBAL key position — the
+        # row-constant term cancels in softmax, and global positions
+        # keep the bias identical across ring hops
+        s = s + (
+            slopes[:, :, None, None]
+            * k_pos.astype(jnp.float32)[None, None, None, :]
+        )
     mask = (k_pos[None, :] <= q_pos[:, None]) & (
         k_pos[None, :] < valid_len
     )  # [C, C]
+    if window > 0:
+        # band over global positions: query i sees keys (i-window, i];
+        # hops entirely below the band contribute nothing (all -inf,
+        # alpha carries prior partials through unchanged)
+        mask = mask & (
+            (q_pos[:, None] - k_pos[None, :]) < window
+        )
     s = jnp.where(mask[None, None], s, NEG_INF)
 
     m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
@@ -64,6 +82,8 @@ def ring_prefill_attention(
     valid_len: jax.Array,  # scalar int32 (global)
     mesh: Mesh,
     axis: str = SP_AXIS,
+    window: int = 0,  # mistral-style sliding window (0 = full causal)
+    alibi_slopes: jax.Array | None = None,  # [H] f32 (bloom lineage)
 ) -> jax.Array:
     """Causal attention with the sequence axis sharded over ``axis``.
 
@@ -76,7 +96,9 @@ def ring_prefill_attention(
     if n == 1:
         from vllm_tgis_adapter_tpu.ops.attention import prefill_attention_xla
 
-        return prefill_attention_xla(q, k, v, scale, valid_len)
+        return prefill_attention_xla(q, k, v, scale, valid_len,
+                                     window=window,
+                                     alibi_slopes=alibi_slopes)
     t, _, head_dim = q.shape
     if t % n:
         raise ValueError(f"sequence {t} not divisible by ring size {n}")
@@ -84,14 +106,19 @@ def ring_prefill_attention(
     tp = dict(mesh.shape).get(TP_AXIS, 1)
     head_axis = TP_AXIS if tp > 1 else None
 
-    def local_fn(q_loc, k_loc, v_loc, vl):
-        # q_loc [C, H/tp, Dh]; k_loc/v_loc [C, Hkv/tp, Dh]; vl [1]
+    def local_fn(q_loc, k_loc, v_loc, vl, slopes_loc):
+        # q_loc [C, H/tp, Dh]; k_loc/v_loc [C, Hkv/tp, Dh]; vl [1];
+        # slopes_loc [H/tp] (zero-size placeholder when ALiBi is off)
         d = jax.lax.axis_index(axis)
         num_heads = q_loc.shape[1]
         num_kv = k_loc.shape[1]
         g = num_heads // num_kv
         qf = q_loc.reshape(c, num_kv, g, head_dim).astype(jnp.float32)
         q_pos = d * c + jnp.arange(c)
+        slopes = (
+            slopes_loc.reshape(num_kv, g).astype(jnp.float32)
+            if slopes_loc.size else None
+        )
 
         m = jnp.full((num_kv, g, c, 1), NEG_INF, jnp.float32)
         l = jnp.zeros((num_kv, g, c, 1), jnp.float32)
@@ -105,7 +132,8 @@ def ring_prefill_attention(
             src = (d - i) % n  # chunk currently visiting this device
             k_pos = src * c + jnp.arange(c)
             m, l, acc = _chunk_attention(
-                qf, k_cur, v_cur, scale, q_pos, k_pos, vl[0], m, l, acc
+                qf, k_cur, v_cur, scale, q_pos, k_pos, vl[0], m, l, acc,
+                window=window, slopes=slopes,
             )
             if i != n - 1:
                 perm = [(j, (j + 1) % n) for j in range(n)]
@@ -119,10 +147,15 @@ def ring_prefill_attention(
         return out.astype(q_loc.dtype)
 
     seq = P(axis, head_axis, None)
+    slopes_in = (
+        jnp.zeros((0,), jnp.float32)
+        if alibi_slopes is None
+        else alibi_slopes.astype(jnp.float32)
+    )
     return shard_map(
         local_fn,
         mesh=mesh,
-        in_specs=(seq, seq, seq, P()),
+        in_specs=(seq, seq, seq, P(), P(head_axis)),
         out_specs=seq,
         check_vma=False,
-    )(q, k, v, jnp.asarray([valid_len], jnp.int32))
+    )(q, k, v, jnp.asarray([valid_len], jnp.int32), slopes_in)

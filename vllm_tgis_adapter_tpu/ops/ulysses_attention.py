@@ -37,6 +37,8 @@ def ulysses_prefill_attention(
     valid_len: jax.Array,  # scalar int32 (global)
     mesh: Mesh,
     axis: str = SP_AXIS,
+    window: int = 0,  # mistral-style sliding window (0 = full causal)
+    alibi_slopes: jax.Array | None = None,  # [H] f32 (bloom lineage)
 ) -> jax.Array:
     """Causal prefill attention with the sequence axis sharded over
     ``axis``, computed via head/sequence all-to-all re-partitioning.
@@ -51,14 +53,17 @@ def ulysses_prefill_attention(
 
     n = mesh.shape[axis]
     if n == 1:
-        return attn_ops.prefill_attention_xla(q, k, v, scale, valid_len)
+        return attn_ops.prefill_attention_xla(
+            q, k, v, scale, valid_len, window=window,
+            alibi_slopes=alibi_slopes,
+        )
     t = q.shape[0]
     if t % n:
         raise ValueError(f"sequence {t} not divisible by sp size {n}")
     tp = dict(mesh.shape).get(TP_AXIS, 1)
     head_axis = TP_AXIS if tp > 1 else None
 
-    def local_fn(q_loc, k_loc, v_loc, vl):
+    def local_fn(q_loc, k_loc, v_loc, vl, slopes_loc):
         # [T/sp, H/tp, Dh] → [T, H/(tp·sp), Dh]
         if q_loc.shape[1] % n or k_loc.shape[1] % n:
             raise ValueError(
@@ -74,16 +79,28 @@ def ulysses_prefill_attention(
         vt = jax.lax.all_to_all(
             v_loc, axis, split_axis=1, concat_axis=0, tiled=True
         )
+        # the head all_to_all keeps chunk j of the local head slice on
+        # sp-rank j — slice the slopes the same way so bias follows head
+        slopes = None
+        if slopes_loc.size:
+            j = jax.lax.axis_index(axis)
+            slopes = jax.lax.dynamic_slice_in_dim(
+                slopes_loc, j * (slopes_loc.shape[0] // n),
+                slopes_loc.shape[0] // n,
+            )
         if attn_ops._use_pallas():
             from vllm_tgis_adapter_tpu.ops import pallas_attention
 
             out = pallas_attention.prefill_attention(
                 qt, kt, vt, scale, jnp.asarray(vl[0], jnp.int32),
+                window=window,
+                alibi_slopes=slopes,
                 interpret=attn_ops._pallas_interpret(),
             )
         else:
             out = attn_ops.prefill_attention_xla(
-                qt, kt, vt, scale, vl[0]
+                qt, kt, vt, scale, vl[0], window=window,
+                alibi_slopes=slopes,
             )
         # [T, H/(tp·sp), Dh] → [T/sp, H/tp, Dh]
         return jax.lax.all_to_all(
@@ -91,10 +108,15 @@ def ulysses_prefill_attention(
         )
 
     seq = P(axis, head_axis, None)
+    slopes_in = (
+        jnp.zeros((0,), jnp.float32)
+        if alibi_slopes is None
+        else alibi_slopes.astype(jnp.float32)
+    )
     return shard_map(
         local_fn,
         mesh=mesh,
-        in_specs=(seq, seq, seq, P()),
+        in_specs=(seq, seq, seq, P(), P(head_axis)),
         out_specs=seq,
         check_vma=False,
-    )(q, k, v, jax.numpy.asarray([valid_len], jax.numpy.int32))
+    )(q, k, v, jax.numpy.asarray([valid_len], jax.numpy.int32), slopes_in)
