@@ -25,7 +25,7 @@ def engine_factory(tiny_model_dir):
     from vllm_tgis_adapter_tpu.engine.core import LLMEngine
 
     def make(num_blocks=64, max_num_seqs=8, scheduler_kwargs=None,
-             **model_kwargs):
+             engine_kwargs=None, **model_kwargs):
         model_config = ModelConfig.from_pretrained(
             tiny_model_dir, dtype="float32", **model_kwargs
         )
@@ -42,6 +42,7 @@ def engine_factory(tiny_model_dir):
             ),
             parallel_config=ParallelConfig(),
             lora_config=LoRAConfig(),
+            **(engine_kwargs or {}),
         )
         return LLMEngine.from_config(config)
 
@@ -791,3 +792,79 @@ def test_prompt_logprobs_single_token_prompt(engine_factory):
     )
     out = run_to_completion(engine)["one"]
     assert out.prompt_logprobs == [None]
+
+
+def test_preemption_swaps_kv_instead_of_recompute(engine_factory):
+    """--swap-space: a preempted decode's KV pages ride to host and
+    restore on re-admission — no recompute-prefill — and greedy outputs
+    stay identical to the roomy-pool run."""
+    from vllm_tgis_adapter_tpu import metrics
+    from vllm_tgis_adapter_tpu.engine.sampling_params import SamplingParams
+
+    out_before = metrics.kv_swap_out_total._value.get()
+    in_before = metrics.kv_swap_in_total._value.get()
+
+    engine = engine_factory(num_blocks=6, max_num_seqs=4,
+                            engine_kwargs={"swap_space_gib": 1.0})
+    assert engine.scheduler.swap_out_fn is not None
+
+    recompute_prefills = []
+    orig = engine.runner.prepare_prefill
+
+    def spy(plan):
+        # a swap-in resume never re-runs prefill over prompt+output; any
+        # prefill whose tokens extend past the prompt is a recompute
+        if plan.start_pos + len(plan.token_ids) > len(
+            plan.seq.prompt_token_ids
+        ):
+            recompute_prefills.append(plan.seq.request_id)
+        return orig(plan)
+
+    engine.runner.prepare_prefill = spy
+
+    for i in range(3):
+        engine.add_request(
+            f"sw-{i}", "the quick brown fox jumps over",
+            SamplingParams(temperature=0.0, max_tokens=40, ignore_eos=True),
+        )
+    outputs = run_to_completion(engine, max_steps=2000)
+    assert len(outputs) == 3
+    for i in range(3):
+        assert len(outputs[f"sw-{i}"].outputs[0].token_ids) == 40
+
+    swaps_out = metrics.kv_swap_out_total._value.get() - out_before
+    swaps_in = metrics.kv_swap_in_total._value.get() - in_before
+    assert swaps_out >= 1, "tiny pool must have preempted at least once"
+    assert swaps_in == swaps_out
+    assert recompute_prefills == []  # every preemption resumed from swap
+    assert engine._swap_used == 0  # budget fully returned
+
+    roomy = engine_factory(num_blocks=64, max_num_seqs=4)
+    roomy.add_request(
+        "ref", "the quick brown fox jumps over",
+        SamplingParams(temperature=0.0, max_tokens=40, ignore_eos=True),
+    )
+    ref = run_to_completion(roomy)["ref"].outputs[0].token_ids
+    assert outputs["sw-0"].outputs[0].token_ids == ref
+
+
+def test_swap_budget_exhaustion_falls_back_to_recompute(engine_factory):
+    """A zero-ish budget cannot hold any pages: preemptions fall back to
+    the recompute path and still finish correctly."""
+    from vllm_tgis_adapter_tpu.engine.sampling_params import SamplingParams
+
+    engine = engine_factory(
+        num_blocks=6, max_num_seqs=4,
+        engine_kwargs={"swap_space_gib": 1e-9},  # ~1 byte: nothing fits
+    )
+    assert engine.scheduler.swap_out_fn is not None
+    for i in range(3):
+        engine.add_request(
+            f"nb-{i}", "the quick brown fox jumps over",
+            SamplingParams(temperature=0.0, max_tokens=40, ignore_eos=True),
+        )
+    outputs = run_to_completion(engine, max_steps=2000)
+    assert len(outputs) == 3
+    for i in range(3):
+        assert len(outputs[f"nb-{i}"].outputs[0].token_ids) == 40
+    assert engine._swap_used == 0

@@ -821,6 +821,12 @@ class EngineConfig:
     seed: int = 0
     max_logprobs: int = 20
     hbm_memory_utilization: float = 0.90
+    # --swap-space GiB of HOST memory for preempted sequences' KV: > 0
+    # swaps a decode-phase preemption victim's pages to host and restores
+    # them on re-admission instead of recompute-prefill (engine/core.py
+    # _swap_out_seq; reference maps the flag into vLLM's CPU swap).
+    # 0 keeps the recompute-only path.
+    swap_space_gib: float = 0.0
     quantization: str | None = None
     otlp_traces_endpoint: str | None = None
     disable_log_requests: bool = True
@@ -968,6 +974,7 @@ class EngineConfig:
             seed=args.seed,
             max_logprobs=args.max_logprobs,
             hbm_memory_utilization=args.hbm_memory_utilization,
+            swap_space_gib=getattr(args, "swap_space", 0.0) or 0.0,
             quantization=args.quantization,
             otlp_traces_endpoint=args.otlp_traces_endpoint,
             disable_log_stats=getattr(args, "disable_log_stats", False),

@@ -32,6 +32,7 @@ from vllm_tgis_adapter_tpu.engine.scheduler import (
     Scheduler,
 )
 from vllm_tgis_adapter_tpu.engine.sequence import Sequence, SequenceStatus
+from vllm_tgis_adapter_tpu import metrics
 from vllm_tgis_adapter_tpu.logging import init_logger
 
 logger = init_logger(__name__)
@@ -126,6 +127,19 @@ class LLMEngine:
             and config.speculative is None
         ):
             self.scheduler.rolling_window = mcfg.sliding_window
+        # --swap-space host KV swap for preemption victims.  Gates: the
+        # flat ModelRunner cache only (pp stages split the layer axis),
+        # and no rolling-window eviction (evicted low pages make the
+        # [0, n) slot range unsaveable — recompute is cheap there anyway)
+        self._swap_budget = int(config.swap_space_gib * (1 << 30))
+        self._swap_used = 0
+        if (
+            self._swap_budget > 0
+            and pcfg.pipeline_parallel_size == 1
+            and self.scheduler.rolling_window == 0
+        ):
+            self.scheduler.swap_out_fn = self._swap_out_seq
+            self.scheduler.swap_drop_fn = self._swap_drop_seq
         self._seqs: dict[str, Sequence] = {}
         self._lora_tokenizers: dict[str, object] = {}
         # adapter registry consumed by the gRPC adapter store
@@ -361,6 +375,64 @@ class LLMEngine:
     def has_unfinished_requests(self) -> bool:
         return self.scheduler.num_unfinished > 0
 
+    # -------------------------------------------------------------- KV swap
+
+    def _swap_out_seq(self, seq: Sequence) -> bool:
+        """Preemption hook (scheduler._preempt_youngest): copy the
+        victim's computed KV to host within the --swap-space budget.
+        Cache coverage invariant between dispatches: positions
+        [0, num_tokens-1) are written; the next decode writes
+        num_tokens-1."""
+        n = seq.num_tokens - 1
+        if n <= 0 or seq.blocks is None:
+            return False
+        slots = seq.blocks.slots_for_range(0, n)
+        k_cache, _ = self.runner.caches
+        per_slot = (
+            2 * k_cache.shape[0] * k_cache.shape[1] * k_cache.shape[3]
+            * k_cache.dtype.itemsize
+        )
+        nbytes = per_slot * len(slots)
+        if self._swap_used + nbytes > self._swap_budget:
+            logger.info(
+                "swap-space full (%d/%d bytes): request %s falls back to "
+                "recompute", self._swap_used, self._swap_budget,
+                seq.request_id,
+            )
+            return False
+        k_host, v_host = self.runner.extract_kv(slots)
+        seq.swapped = (k_host, v_host, n, nbytes)
+        self._swap_used += nbytes
+        metrics.kv_swap_out_total.inc()
+        # inc/dec (not set): dp replicas share the process-global gauge,
+        # so absolute sets from different replicas would clobber
+        metrics.kv_swap_used_bytes.inc(nbytes)
+        return True
+
+    def _swap_drop_seq(self, seq: Sequence) -> None:
+        """Release a held host copy (recompute admission won the race)."""
+        if seq.swapped is not None:
+            self._swap_used -= seq.swapped[3]
+            metrics.kv_swap_used_bytes.dec(seq.swapped[3])
+
+    def _drain_swap_ins(self) -> None:
+        """Restore swapped queue heads on a clean dispatch boundary (the
+        caches rebind must not race an in-flight dispatch's commit)."""
+        while True:
+            seq = self.scheduler.try_swap_in()
+            if seq is None:
+                return
+            k_host, v_host, n, nbytes = seq.swapped
+            self.runner.restore_kv(
+                seq.blocks.slots_for_range(0, n), k_host, v_host
+            )
+            seq.swapped = None
+            self._swap_used -= nbytes
+            metrics.kv_swap_in_total.inc()
+            metrics.kv_swap_used_bytes.dec(nbytes)
+            logger.info("restored request %s from host swap (%d tokens)",
+                        seq.request_id, n)
+
     # ------------------------------------------------------------- step loop
 
     def step(self) -> list[RequestOutput]:
@@ -393,6 +465,10 @@ class LLMEngine:
             outputs.append(seq.to_request_output())
         self.scheduler.newly_finished.clear()
 
+        if not prefill_only and self.scheduler.swap_out_fn is not None:
+            # prefill_only means a dispatch is in flight — restoring
+            # would rebind runner.caches under it (runner.restore_kv)
+            self._drain_swap_ins()
         self.runner.sync_lora(self.lora_manager)
         plan = self.scheduler.schedule(prefill_only=prefill_only)
         if plan is None:

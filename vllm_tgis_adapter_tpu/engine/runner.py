@@ -291,6 +291,8 @@ class ModelRunner:
         # draft-model speculative decoding; attached by the engine when
         # --speculative-model is configured (engine/speculative.py)
         self.spec = None
+        # --swap-space: donated jitted scatter, built on first swap-in
+        self._restore_kv_fn = None
 
     def attach_speculative(self, draft_model, draft_params) -> None:  # noqa: ANN001
         from vllm_tgis_adapter_tpu.engine.speculative import (
@@ -465,6 +467,59 @@ class ModelRunner:
     def new_fallback_seed(self) -> int:
         """Engine-drawn PRNG material for requests without an explicit seed."""
         return int(self._rng.integers(0, 2**32, dtype=np.uint32))
+
+    # ------------------------------------------------------------- KV swap
+
+    def extract_kv(self, slots: list[int]) -> tuple:
+        """Gather ``slots`` of both caches to host (--swap-space swap-out;
+        the transfer is one device gather + copy per cache)."""
+        k_cache, v_cache = self.caches
+        idx = jnp.asarray(slots, jnp.int32)
+        return (
+            np.asarray(jnp.take(k_cache, idx, axis=2)),
+            np.asarray(jnp.take(v_cache, idx, axis=2)),
+        )
+
+    @staticmethod
+    def _scatter_kv(k_cache, v_cache, idx, k_new, v_new):  # noqa: ANN001, ANN205
+        # positive out-of-range pad indices are dropped by mode="drop"
+        return (
+            k_cache.at[:, :, idx, :].set(
+                k_new.astype(k_cache.dtype), mode="drop"
+            ),
+            v_cache.at[:, :, idx, :].set(
+                v_new.astype(v_cache.dtype), mode="drop"
+            ),
+        )
+
+    def restore_kv(self, slots: list[int], k_host, v_host) -> None:
+        """Scatter a host KV copy into ``slots`` (swap-in).  Must only run
+        on a clean dispatch boundary: the functional update rebinds
+        self.caches, so an in-flight dispatch's commit would drop it.
+
+        Donated jit: the KV pool is sized to ~90% of free HBM, so an
+        eager (non-donating) scatter would transiently hold TWO full
+        caches and OOM exactly when swap triggers (memory pressure).
+        Slot counts bucket to powers of two (pads scatter out of range
+        and drop) so compile variety stays logarithmic."""
+        if self._restore_kv_fn is None:
+            donate = (0, 1) if jax.default_backend() == "tpu" else ()
+            self._restore_kv_fn = jax.jit(
+                self._scatter_kv, donate_argnums=donate
+            )
+        n = len(slots)
+        bucket = 1
+        while bucket < n:
+            bucket *= 2
+        pad = [(0, 0), (0, 0), (0, bucket - n), (0, 0)]
+        idx = np.full(bucket, self.num_slots, np.int32)  # OOB → dropped
+        idx[:n] = slots
+        k_cache, v_cache = self.caches
+        self.caches = self._restore_kv_fn(
+            k_cache, v_cache, jnp.asarray(idx),
+            self._put(np.pad(np.asarray(k_host), pad)),
+            self._put(np.pad(np.asarray(v_host), pad)),
+        )
 
     # --------------------------------------------------------------- prefill
 

@@ -127,6 +127,12 @@ class Scheduler:
         # prefix caching is off (registered pages must stay intact) and
         # speculation is off (the draft cache shares slot geometry).
         self.rolling_window = 0
+        # --swap-space hooks (engine/core.py): swap_out_fn(seq) copies a
+        # preemption victim's KV to host (sets seq.swapped, returns bool);
+        # swap_drop_fn(seq) releases a held host copy when the sequence
+        # falls back to recompute admission.  None = recompute-only.
+        self.swap_out_fn = None
+        self.swap_drop_fn = None
 
     # ------------------------------------------------------------ bookkeeping
 
@@ -145,6 +151,7 @@ class Scheduler:
                 seq.status = SequenceStatus.FINISHED_ABORTED
                 # mid-chunked-prefill sequences wait with pages+slot held
                 self.finish(seq)
+                self._drop_swap(seq)
                 return seq
         for seq in self.running:
             if seq.request_id == request_id:
@@ -152,6 +159,12 @@ class Scheduler:
                 self.finish(seq)
                 return seq
         return None
+
+    def _drop_swap(self, seq: Sequence) -> None:
+        if seq.swapped is not None:
+            if self.swap_drop_fn is not None:
+                self.swap_drop_fn(seq)
+            seq.swapped = None
 
     def register_prefix(self, seq: Sequence) -> None:
         """Publish a completed prefill's full prompt pages for reuse.
@@ -316,6 +329,13 @@ class Scheduler:
         if not self.waiting:
             return None
         seq = self.waiting[0]
+        if seq.swapped is not None and self.swap_out_fn is not None:
+            # a swapped head is re-admitted by try_swap_in (plan_step
+            # drains it on every clean dispatch boundary with the same
+            # slot+page requirements); recompute-admitting it here —
+            # e.g. during async prefill_only planning — would forfeit
+            # the saved KV
+            return None
         first_chunk = seq.prefill_pos == 0
         if first_chunk and not self._free_slots:
             return None
@@ -548,6 +568,34 @@ class Scheduler:
             steps_per_seq=planned,
         )
 
+    def try_swap_in(self) -> Optional[Sequence]:
+        """Re-admit the queue head from its host KV copy (no recompute).
+
+        Allocates a batch slot + pages for the full token history and
+        moves the sequence straight to RUNNING; the engine then scatters
+        the host copy into the fresh pages (runner.restore_kv) before the
+        next dispatch.  Returns None when the head is not swapped or
+        resources are short — a swapped head is re-admitted EXCLUSIVELY
+        here (prefill admission skips it), retried on every clean
+        dispatch boundary until the slot + pages free up; its host copy
+        is held until then (or dropped on abort)."""
+        if not self.waiting:
+            return None
+        seq = self.waiting[0]
+        if seq.swapped is None or not self._free_slots:
+            return None
+        total = len(seq.all_token_ids)
+        needed = self.allocator.blocks_needed(total)
+        if not self.allocator.can_allocate(needed):
+            return None
+        seq.blocks = SequenceBlocks(self.allocator)
+        seq.blocks.ensure_capacity(total)
+        seq.slot = self._free_slots.pop()
+        self.waiting.popleft()
+        seq.status = SequenceStatus.RUNNING
+        self.running.append(seq)
+        return seq
+
     # ------------------------------------------------------------ preemption
 
     def _preempt_youngest(self, exclude: Optional[Sequence] = None) -> bool:
@@ -564,6 +612,12 @@ class Scheduler:
         logger.info("preempting request %s (KV pool exhausted)",
                     victim.request_id)
         was_running = victim in self.running
+        if was_running and self.swap_out_fn is not None:
+            # decode-phase victim: copy its computed KV to host BEFORE the
+            # pages free; re-admission then restores instead of
+            # recomputing (mid-prefill victims always recompute — their
+            # cache coverage is partial and cheap to redo)
+            self.swap_out_fn(victim)
         self.finish(victim)  # releases pages+slot, resets prefill_pos
         victim.status = SequenceStatus.PREEMPTED
         if was_running:

@@ -92,6 +92,11 @@ class Sequence:
         self.fsm_state: int = 0
         # device slot of this request's LoRA adapter (0 = base model)
         self.lora_slot: int = 0
+        # --swap-space: host copy of this sequence's KV written at
+        # preemption (engine/core.py _swap_out_seq) — (k, v, num_tokens,
+        # nbytes); restored into fresh pages on re-admission instead of
+        # recompute-prefill.  None = recompute path.
+        self.swapped: Optional[tuple] = None
         self.detokenizer: Optional["IncrementalDetokenizer"] = None
         # for DELTA streams: what has already been emitted
         self._emitted_text_len = 0

@@ -109,6 +109,26 @@ prefix_cache_hit_tokens = _get_or_create(
 )
 
 
+# ---- --swap-space host KV swap (engine/core.py): preemption victims'
+# pages copied to host and restored on re-admission instead of
+# recompute-prefill
+kv_swap_out_total = _get_or_create(
+    Counter,
+    f"{_PREFIX}_kv_swap_out_total",
+    "Preempted sequences whose KV pages were swapped to host memory",
+)
+kv_swap_in_total = _get_or_create(
+    Counter,
+    f"{_PREFIX}_kv_swap_in_total",
+    "Sequences restored from host KV swap instead of recompute-prefill",
+)
+kv_swap_used_bytes = _get_or_create(
+    Gauge,
+    f"{_PREFIX}_kv_swap_used_bytes",
+    "Host bytes currently held by swapped-out KV copies",
+)
+
+
 # ---- guided-decoding constraint compilation (engine/constrained.py
 # compile_fsm): first use of a constraint compiles a DFA + token table
 # synchronously; repeats hit the LRU.  These expose the latency spike

@@ -201,7 +201,10 @@ def add_engine_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         "cache (accepts --gpu-memory-utilization for "
                         "compatibility)")
     g.add_argument("--swap-space", type=float, default=0,
-                   help="accepted for compatibility; host swap is not used")
+                   help="GiB of host memory for preempted sequences' KV: "
+                        "a preempted decode's pages swap to host and "
+                        "restore on re-admission instead of recomputing "
+                        "the whole prefill (0 = recompute only)")
     g.add_argument("--enforce-eager", action="store_true",
                    help="accepted for compatibility; the TPU engine always "
                         "compiles with XLA")
