@@ -768,7 +768,9 @@ def quantize_checkpoint_int4(src_dir, dst_dir, *, method="awq",
     rng = np.random.default_rng(seed)
     quant_suffixes = ("q_proj.weight", "k_proj.weight", "v_proj.weight",
                       "o_proj.weight", "gate_proj.weight",
-                      "up_proj.weight", "down_proj.weight")
+                      "up_proj.weight", "down_proj.weight",
+                      # phi-3 fused projections quantize as single linears
+                      "qkv_proj.weight", "gate_up_proj.weight")
     out_tensors = {}
     with safe_open(src / "model.safetensors", framework="numpy") as fh:
         for name in fh.keys():

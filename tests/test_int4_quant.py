@@ -324,3 +324,52 @@ def test_awq_checkpoint_serves_over_grpc(tmp_path):
                 loop.close()
 
     assert generate(packed) == generate(src)
+
+
+def test_int4_awq_phi3_fused_projections(tmp_path):
+    """phi-3's FUSED qkv_proj / gate_up_proj quantize as single linears
+    (the AWQ convention); the virtual index dequantizes them and the
+    loader's fused-split path works unchanged — logits match the
+    offline-dequant reference checkpoint bit-exactly."""
+    import shutil
+
+    from safetensors import safe_open
+    from safetensors.numpy import save_file
+
+    from tests.fixture_models import build_tiny_phi3
+
+    src = str(tmp_path / "fp")
+    build_tiny_phi3(src)
+    packed = quantize_checkpoint_int4(src, str(tmp_path / "awq"),
+                                      method="awq", group_size=8)
+
+    ref_dir = tmp_path / "ref"
+    ref_dir.mkdir()
+    for f in (tmp_path / "fp").iterdir():
+        if f.name != "model.safetensors":
+            shutil.copy(f, ref_dir / f.name)
+    tensors = {}
+    with safe_open(f"{packed}/model.safetensors", framework="numpy") as fh:
+        for name in fh.keys():
+            if name.endswith((".qzeros", ".scales", ".g_idx")):
+                continue
+            if name.endswith(".qweight"):
+                prefix = name[: -len(".qweight")]
+                w = dequantize_awq(
+                    fh.get_tensor(name),
+                    fh.get_tensor(f"{prefix}.qzeros"),
+                    fh.get_tensor(f"{prefix}.scales").astype(np.float32),
+                    8,
+                )
+                tensors[f"{prefix}.weight"] = np.ascontiguousarray(
+                    w.T.astype(np.float32))
+            else:
+                tensors[name] = fh.get_tensor(name)
+    assert any("qkv_proj.weight" in n for n in tensors)  # fused really hit
+    save_file(tensors, ref_dir / "model.safetensors")
+
+    prompt = list(range(3, 19))
+    packed_logits, config = _prefill_logits(packed, prompt)
+    ref_logits, _ = _prefill_logits(str(ref_dir), prompt)
+    assert config.checkpoint_quant == "awq"
+    np.testing.assert_array_equal(packed_logits, ref_logits)
