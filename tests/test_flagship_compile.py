@@ -16,5 +16,6 @@ def test_flagship_shapes_aot_compile():
     import __graft_entry__
 
     timings = __graft_entry__.dryrun_compile_flagship(8)
-    assert set(timings) == {"prefill[2048]", "decode[b32]", "sample[b32]"}
+    assert set(timings) == {"prefill[2048]", "decode[b32]",
+                        "prefill[2048]@sp2xtp4", "sample[b32]"}
     assert all(t > 0 for t in timings.values())
