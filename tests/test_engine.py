@@ -822,10 +822,17 @@ def test_preemption_swaps_kv_instead_of_recompute(engine_factory):
 
     engine.runner.prepare_prefill = spy
 
+    # DISTINCT prompts: a stale seen row inherited from a different
+    # occupant then really perturbs the repetition penalty, so the
+    # per-request parity below catches a missing swap-in reseed
+    prompts = ["the quick brown fox jumps over",
+               "pack my box with five dozen jugs",
+               "how vexingly quick daft zebras jump"]
     for i in range(3):
         engine.add_request(
-            f"sw-{i}", "the quick brown fox jumps over",
-            SamplingParams(temperature=0.0, max_tokens=40, ignore_eos=True),
+            f"sw-{i}", prompts[i],
+            SamplingParams(temperature=0.0, max_tokens=40, ignore_eos=True,
+                           repetition_penalty=1.3),
         )
     outputs = run_to_completion(engine, max_steps=2000)
     assert len(outputs) == 3
@@ -840,12 +847,16 @@ def test_preemption_swaps_kv_instead_of_recompute(engine_factory):
     assert engine._swap_used == 0  # budget fully returned
 
     roomy = engine_factory(num_blocks=64, max_num_seqs=4)
-    roomy.add_request(
-        "ref", "the quick brown fox jumps over",
-        SamplingParams(temperature=0.0, max_tokens=40, ignore_eos=True),
-    )
-    ref = run_to_completion(roomy)["ref"].outputs[0].token_ids
-    assert outputs["sw-0"].outputs[0].token_ids == ref
+    for i in range(3):
+        roomy.add_request(
+            f"ref-{i}", prompts[i],
+            SamplingParams(temperature=0.0, max_tokens=40, ignore_eos=True,
+                           repetition_penalty=1.3),
+        )
+    refs = run_to_completion(roomy)
+    for i in range(3):
+        assert (outputs[f"sw-{i}"].outputs[0].token_ids
+                == refs[f"ref-{i}"].outputs[0].token_ids), f"sw-{i}"
 
 
 def test_swap_budget_exhaustion_falls_back_to_recompute(engine_factory):

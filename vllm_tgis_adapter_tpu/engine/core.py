@@ -426,6 +426,9 @@ class LLMEngine:
             self.runner.restore_kv(
                 seq.blocks.slots_for_range(0, n), k_host, v_host
             )
+            # the new batch row may hold a stale seen-token matrix from a
+            # previous occupant; prefill's seeding is skipped on swap-in
+            self.runner.reseed_seen_row(seq.slot, seq.all_token_ids)
             seq.swapped = None
             self._swap_used -= nbytes
             metrics.kv_swap_in_total.inc()

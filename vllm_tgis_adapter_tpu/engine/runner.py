@@ -492,6 +492,17 @@ class ModelRunner:
             ),
         )
 
+    def reseed_seen_row(self, slot: int, token_ids: list[int]) -> None:
+        """Reset one batch row of the seen-token matrix (swap-in: the
+        freshly assigned slot may hold a previous occupant's stale row,
+        and the prefill seeding that normally resets it is skipped)."""
+        pad = self._seen_pad_len(len(token_ids))
+        arr = np.full(pad, -1, np.int32)
+        arr[: len(token_ids)] = token_ids
+        self.seen = sampler_mod.set_seen_row(
+            self.seen, self._put(np.asarray(slot)), self._put(arr)
+        )
+
     def restore_kv(self, slots: list[int], k_host, v_host) -> None:
         """Scatter a host KV copy into ``slots`` (swap-in).  Must only run
         on a clean dispatch boundary: the functional update rebinds
