@@ -879,3 +879,69 @@ def test_swap_budget_exhaustion_falls_back_to_recompute(engine_factory):
     for i in range(3):
         assert len(outputs[f"nb-{i}"].outputs[0].token_ids) == 40
     assert engine._swap_used == 0
+
+
+def test_async_engine_swap_under_pressure(tiny_model_dir):
+    """The ASYNC step loop (plan_step prefill_only gating) composes with
+    --swap-space: concurrent long generations on a starved pool preempt,
+    swap, restore on a clean dispatch boundary, and finish with the same
+    greedy tokens as a roomy pool."""
+    import asyncio
+
+    from vllm_tgis_adapter_tpu import metrics
+    from vllm_tgis_adapter_tpu.engine.async_llm import AsyncLLMEngine
+    from vllm_tgis_adapter_tpu.engine.config import (
+        CacheConfig,
+        EngineConfig,
+        LoRAConfig,
+        ModelConfig,
+        ParallelConfig,
+        SchedulerConfig,
+    )
+    from vllm_tgis_adapter_tpu.engine.sampling_params import SamplingParams
+
+    mcfg = ModelConfig.from_pretrained(tiny_model_dir, dtype="float32")
+
+    def build(num_blocks, swap):
+        return AsyncLLMEngine.from_config(EngineConfig(
+            model_config=mcfg,
+            cache_config=CacheConfig(block_size=16, num_blocks=num_blocks,
+                                     cache_dtype=mcfg.dtype),
+            scheduler_config=SchedulerConfig(max_num_seqs=4,
+                                             prefill_buckets=(32, 64)),
+            parallel_config=ParallelConfig(),
+            lora_config=LoRAConfig(),
+            swap_space_gib=swap,
+        ))
+
+    prompts = ["the quick brown fox jumps over",
+               "pack my box with five dozen jugs",
+               "how vexingly quick daft zebras jump"]
+
+    async def run(engine):
+        await engine.start()
+
+        async def one(i, prompt):
+            final = None
+            async for out in engine.generate(
+                prompt,
+                SamplingParams(temperature=0.0, max_tokens=40,
+                               ignore_eos=True, repetition_penalty=1.3),
+                request_id=f"as-{i}",
+            ):
+                final = out
+            return final.outputs[0].token_ids
+
+        try:
+            return await asyncio.gather(
+                *(one(i, p) for i, p in enumerate(prompts))
+            )
+        finally:
+            await engine.stop()
+
+    in_before = metrics.kv_swap_in_total._value.get()
+    tight = asyncio.run(run(build(num_blocks=6, swap=1.0)))
+    roomy = asyncio.run(run(build(num_blocks=64, swap=0.0)))
+    assert all(len(t) == 40 for t in tight)
+    assert tight == roomy
+    assert metrics.kv_swap_in_total._value.get() > in_before
