@@ -218,7 +218,7 @@ def test_chat_completions_stream(http_base_url):
 
 def test_chat_completions_validation(http_base_url):
     for bad in ({"messages": "not a list"}, {"messages": []},
-                {"messages": [{"role": "user", "content": "x"}], "n": 2}):
+                {"messages": [{"role": "user", "content": "x"}], "n": 0}):
         try:
             _post_json(f"{http_base_url}/v1/chat/completions", bad)
             raise AssertionError(f"expected 400 for {bad}")
@@ -299,3 +299,57 @@ def test_root_path_overlapping_native_route():
     assert direct.status == 200
     proxied = asyncio.run(app.dispatch(req("/v1/v1/completions")))
     assert proxied.status == 200
+
+
+def test_completions_n_samples(http_base_url):
+    """OpenAI `n`: one prompt expands into n choices (prompt-major
+    indices); seeded sampling gives DISTINCT per-sample streams that are
+    reproducible as a set; usage counts the prompt once."""
+    body = {
+        "prompt": "the quick brown",
+        "max_tokens": 6,
+        "n": 3,
+        "temperature": 0.9,
+        "seed": 7,
+        "ignore_eos": True,
+    }
+    import json as _json
+
+    _, raw = _post_json(f"{http_base_url}/v1/completions", body)
+    first = _json.loads(raw)
+    assert [c["index"] for c in first["choices"]] == [0, 1, 2]
+    texts = [c["text"] for c in first["choices"]]
+    assert len(set(texts)) > 1, "sibling seeds must differ"
+    _, raw = _post_json(f"{http_base_url}/v1/completions", body)
+    assert [c["text"] for c in _json.loads(raw)["choices"]] == texts
+
+    _, raw = _post_json(f"{http_base_url}/v1/completions", {**body, "n": 1})
+    one = _json.loads(raw)
+    assert first["usage"]["prompt_tokens"] == one["usage"]["prompt_tokens"]
+    assert first["usage"]["completion_tokens"] == 18
+
+
+def test_chat_completions_n_samples(http_base_url):
+    import json as _json
+
+    _, raw = _post_json(f"{http_base_url}/v1/chat/completions", {
+        "messages": [{"role": "user", "content": "hi"}],
+        "max_tokens": 5,
+        "n": 2,
+        "temperature": 0.9,
+        "seed": 3,
+        "ignore_eos": True,
+    })
+    out = _json.loads(raw)
+    assert [c["index"] for c in out["choices"]] == [0, 1]
+    assert all(c["message"]["role"] == "assistant" for c in out["choices"])
+    assert out["usage"]["completion_tokens"] == 10
+
+
+def test_completions_n_bounds(http_base_url):
+    import urllib.error
+
+    with pytest.raises(urllib.error.HTTPError) as excinfo:
+        _post_json(f"{http_base_url}/v1/completions",
+                   {"prompt": "x", "n": 0})
+    assert excinfo.value.code == 400

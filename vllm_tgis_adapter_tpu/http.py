@@ -309,6 +309,30 @@ def _openai_preamble(app: App, request: HttpRequest):
     return body, model_name, None
 
 
+def _parse_n(body: dict[str, Any]):
+    """OpenAI ``n`` (samples per prompt): strict-integer 1..64, shared by
+    the completions and chat endpoints so validation cannot diverge."""
+    n = body.get("n", 1)
+    if isinstance(n, bool) or not isinstance(n, int) or not 1 <= n <= 64:
+        return None, error_response(
+            400, "n must be an integer between 1 and 64"
+        )
+    return n, None
+
+
+def _sibling_params(sampling_params: "SamplingParams", k: int, n: int,
+                    output_kind) -> "SamplingParams":  # noqa: ANN001
+    """Per-sample copy of the request params: sibling k of a seeded
+    request gets a DISTINCT but reproducible stream (seed+k, wrapped to
+    the uint64 domain __post_init__ enforces)."""
+    sp = SamplingParams(**{**sampling_params.__dict__})
+    if sp.seed is not None and n > 1:
+        sp.seed = (sp.seed + k) % (1 << 64)
+    sp.output_kind = output_kind
+    return sp
+
+
+
 async def _completions(app: App, request: HttpRequest):  # noqa: ANN201, C901, PLR0915
     engine: AsyncLLMEngine = app.state["engine"]
     body, model_name, err = _openai_preamble(app, request)
@@ -319,6 +343,9 @@ async def _completions(app: App, request: HttpRequest):  # noqa: ANN201, C901, P
     prompts = prompt if isinstance(prompt, list) else [prompt]
     if not prompts or not all(isinstance(p, str) for p in prompts):
         return error_response(400, "prompt must be a string or list of strings")
+    n, err = _parse_n(body)
+    if err is not None:
+        return err
     try:
         sampling_params = _completion_sampling_params(body)
     except (ValueError, TypeError) as e:
@@ -330,21 +357,28 @@ async def _completions(app: App, request: HttpRequest):  # noqa: ANN201, C901, P
     completion_id = f"cmpl-{base_request_id}"
     correlation_id = request.headers.get(CORRELATION_ID_HEADER)
 
+    # OpenAI n: each prompt expands into n independent samples; choices
+    # are prompt-major (index = prompt_idx * n + k).  Each sample is its
+    # own engine request, so with --enable-prefix-caching the n-1
+    # siblings adopt the first sample's prompt pages instead of
+    # re-running prefill.
+    logs.set_correlation_id(base_request_id, correlation_id)
+    out_kind = (
+        RequestOutputKind.DELTA if stream else RequestOutputKind.FINAL_ONLY
+    )
     generators = []
-    for i, p in enumerate(prompts):
-        # id format {method}-{base}-{index} is what logs.get_correlation_id
-        # strips back down (reference format, tgis_utils/logs.py:40-44)
-        request_id = f"cmpl-{base_request_id}-{i}"
-        logs.set_correlation_id(base_request_id, correlation_id)
-        sp = SamplingParams(**{**sampling_params.__dict__})
-        sp.output_kind = (
-            RequestOutputKind.DELTA if stream else RequestOutputKind.FINAL_ONLY
-        )
-        generators.append(
-            engine.generate(
-                prompt=p, sampling_params=sp, request_id=request_id
-            )
-        )
+    for pi, p in enumerate(prompts):
+        for k in range(n):
+            # id format {method}-{base}-{index} is what
+            # logs.get_correlation_id strips back down (reference format,
+            # tgis_utils/logs.py:40-44)
+            generators.append(engine.generate(
+                prompt=p,
+                sampling_params=_sibling_params(
+                    sampling_params, k, n, out_kind
+                ),
+                request_id=f"cmpl-{base_request_id}-{pi * n + k}",
+            ))
 
     from vllm_tgis_adapter_tpu.utils import merge_async_iterators
 
@@ -378,21 +412,25 @@ async def _completions(app: App, request: HttpRequest):  # noqa: ANN201, C901, P
 
         return StreamingResponse(sse())
 
-    results: list = [None] * len(prompts)
+    results: list = [None] * (len(prompts) * n)
     try:
         async for i, res in merged:
             results[i] = res
     except ValueError as e:
         return error_response(400, str(e))
 
-    prompt_tokens = sum(len(r.prompt_token_ids) for r in results)
+    # usage counts each prompt's tokens ONCE (OpenAI convention) even
+    # though n siblings each carry it
+    prompt_tokens = sum(
+        len(results[pi * n].prompt_token_ids) for pi in range(len(prompts))
+    )
     completion_tokens = sum(len(r.outputs[0].token_ids) for r in results)
     choices = []
     for i, res in enumerate(results):
         out = res.outputs[0]
         text = out.text
         if body.get("echo"):
-            text = prompts[i] + text
+            text = prompts[i // n] + text
         choices.append(
             {
                 "index": i,
@@ -457,8 +495,9 @@ async def _chat_completions(app: App, request: HttpRequest):  # noqa: ANN201, C9
             400, "messages must be a non-empty list of {role, content} "
                  "objects"
         )
-    if int(body.get("n", 1)) != 1:
-        return error_response(400, "n > 1 is not supported")
+    n, err = _parse_n(body)
+    if err is not None:
+        return err
     if body.get("logprobs"):
         return error_response(
             400, "logprobs is not supported on the chat endpoint"
@@ -494,40 +533,51 @@ async def _chat_completions(app: App, request: HttpRequest):  # noqa: ANN201, C9
     logs.set_correlation_id(
         base_request_id, request.headers.get(CORRELATION_ID_HEADER)
     )
-    sampling_params.output_kind = (
+    out_kind = (
         RequestOutputKind.DELTA if stream else RequestOutputKind.FINAL_ONLY
     )
-    generator = engine.generate(
-        prompt=prompt,
-        sampling_params=sampling_params,
-        request_id=f"chat-{base_request_id}-0",
-    )
+    # n independent samples of the same rendered prompt (prefix caching
+    # lets siblings adopt the first sample's prompt pages)
+    generators = [
+        engine.generate(
+            prompt=prompt,
+            sampling_params=_sibling_params(sampling_params, k, n, out_kind),
+            request_id=f"chat-{base_request_id}-{k}",
+        )
+        for k in range(n)
+    ]
+
+    from vllm_tgis_adapter_tpu.utils import merge_async_iterators
+
+    merged = merge_async_iterators(*generators)
 
     if stream:
 
         async def sse() -> AsyncIterator[bytes]:
-            def chunk(delta: dict, finish: Optional[str]) -> bytes:
+            def chunk(idx: int, delta: dict,
+                      finish: Optional[str]) -> bytes:
                 payload = {
                     "id": chat_id,
                     "object": "chat.completion.chunk",
                     "created": created,
                     "model": model_name,
                     "choices": [{
-                        "index": 0,
+                        "index": idx,
                         "delta": delta,
                         "finish_reason": finish,
                     }],
                 }
                 return f"data: {json.dumps(payload)}\n\n".encode()
 
-            yield chunk({"role": "assistant", "content": ""}, None)
+            for k in range(n):
+                yield chunk(k, {"role": "assistant", "content": ""}, None)
             try:
-                async for res in generator:
+                async for k, res in merged:
                     out = res.outputs[0]
                     if out.text:
-                        yield chunk({"content": out.text}, None)
+                        yield chunk(k, {"content": out.text}, None)
                     if out.finish_reason:
-                        yield chunk({}, out.finish_reason)
+                        yield chunk(k, {}, out.finish_reason)
             except Exception as e:  # noqa: BLE001 — cancellation propagates
                 err = {"error": {"message": str(e), "type": "server_error"}}
                 yield f"data: {json.dumps(err)}\n\n".encode()
@@ -535,26 +585,27 @@ async def _chat_completions(app: App, request: HttpRequest):  # noqa: ANN201, C9
 
         return StreamingResponse(sse())
 
-    final = None
+    finals: list = [None] * n
     try:
-        async for res in generator:
-            final = res
+        async for k, res in merged:
+            finals[k] = res
     except ValueError as e:
         return error_response(400, str(e))
-    out = final.outputs[0]
-    n_prompt = len(final.prompt_token_ids or ())
-    n_out = len(out.token_ids)
+    n_prompt = len(finals[0].prompt_token_ids or ())
+    n_out = sum(len(f.outputs[0].token_ids) for f in finals)
     return JsonResponse({
         "id": chat_id,
         "object": "chat.completion",
         "created": created,
         "model": model_name,
         "choices": [{
-            "index": 0,
-            "message": {"role": "assistant", "content": out.text},
-            "finish_reason": out.finish_reason,
-            "stop_reason": out.stop_reason,
-        }],
+            "index": k,
+            "message": {
+                "role": "assistant", "content": f.outputs[0].text
+            },
+            "finish_reason": f.outputs[0].finish_reason,
+            "stop_reason": f.outputs[0].stop_reason,
+        } for k, f in enumerate(finals)],
         "usage": {
             "prompt_tokens": n_prompt,
             "completion_tokens": n_out,
