@@ -284,11 +284,13 @@ def _chunk_kernel(
         )
         if use_alibi:
             # rows are (g, i) flattened row-major: g = row // block_q;
-            # query head = h·G + g
-            slopes = jnp.repeat(
-                jnp.stack([alibi_ref[h * g + gi] for gi in range(g)]),
-                block_q,
-            )[:, None]  # [G·bq, 1]
+            # query head = h·G + g. Built with 2-D selects — a 1-D
+            # [G·bq] repeat+reshape is a shape cast Mosaic can't lower.
+            slopes = jnp.full(s.shape, alibi_ref[h * g], jnp.float32)
+            for gi in range(1, g):
+                slopes = jnp.where(
+                    row // block_q == gi, alibi_ref[h * g + gi], slopes
+                )
             s = s + slopes * k_pos.astype(jnp.float32)
         mask = (k_pos <= q_pos) & (k_pos < start + valid)
         if window > 0:
