@@ -355,15 +355,24 @@ def _tpu_child() -> None:
         stats = run_bench(True)
     except Exception as exc:  # noqa: BLE001
         # Pallas lowering/compile failures must degrade to a slower
-        # NUMBER via the XLA attention path, never to a 0.0 score
-        # (round-2 lesson: a kernel bug zeroed the whole round)
+        # NUMBER, never to a 0.0 score (round-2 lesson: a kernel bug
+        # zeroed the whole round).  Chain: folded decode kernel ->
+        # per-head decode kernel -> XLA attention.
         if os.environ.get("ATTENTION_BACKEND") == "xla":
             raise
         kernel_error = f"{type(exc).__name__}: {exc}"
+    if kernel_error and os.environ.get("PALLAS_DECODE_KERNEL") is None:
+        # retries happen OUTSIDE the except block: the live traceback
+        # would otherwise pin the failed run's weights/KV buffers in
+        # HBM while the fallback loads its own copy
+        os.environ["PALLAS_DECODE_KERNEL"] = "perhead"
+        try:
+            stats = run_bench(True)
+            stats["pallas_fallback"] = "perhead"
+            kernel_error = None
+        except Exception as exc:  # noqa: BLE001
+            kernel_error = f"{kernel_error}; perhead: {type(exc).__name__}: {exc}"
     if kernel_error:
-        # retry OUTSIDE the except block: the live traceback would
-        # otherwise pin the failed run's weights/KV buffers in HBM
-        # while the fallback loads its own copy
         os.environ["ATTENTION_BACKEND"] = "xla"
         stats = run_bench(True)
     value = stats.pop("value")

@@ -164,12 +164,34 @@ def _run_tpu_tests() -> None:
     _log(f"tpu-tests: rc={rc} tail={tail[-1] if tail else '?'}")
 
 
+def _run_profile() -> None:
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    rc, out, err = _run_bounded(
+        [sys.executable, os.path.join(REPO, "tools", "profile_decode.py")],
+        1200, env, "profile")
+    lines = []
+    for line in (out or "").strip().splitlines():
+        try:
+            parsed = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(parsed, dict):
+            lines.append(parsed)
+    _record("profile", {"rc": rc, "components": lines,
+                        "stderr_tail": (err or "")[-300:] if not lines
+                        else ""})
+    _log(f"profile: rc={rc} components={len(lines)}")
+
+
 def main() -> None:
     os.makedirs(WATCH_DIR, exist_ok=True)
     deadline = time.monotonic() + MAX_HOURS * 3600
     _log(f"daemon start: probe every {PROBE_INTERVAL:.0f}s, "
          f"max {MAX_HOURS:.1f}h")
     captured = False
+    keep = os.environ.get("WATCH_KEEP", "1") == "1"
+    cooldown = float(os.environ.get("WATCH_COOLDOWN", 900))
     while time.monotonic() < deadline:
         if _probe():
             result = _run_bench(None)
@@ -179,15 +201,25 @@ def main() -> None:
                           "w") as f:
                     json.dump(result, f, indent=1)
             # window may still be open: run the Mosaic gates, the
-            # pallas-vs-xla delta and the int8 variant
+            # component profile, the pallas-vs-xla delta and the int8
+            # variant
             _run_tpu_tests()
+            _run_profile()
             xla = _run_bench("xla")
             if xla and xla.get("backend") == "tpu" and not captured:
                 captured = True
             _run_bench("int8")
-            if captured:
+            if captured and not keep:
                 _log("capture complete; exiting")
                 return
+            if captured:
+                # keep-alive mode: the working tree keeps improving over
+                # the round, so re-capture every cooldown while windows
+                # recur instead of exiting at first success
+                _log(f"capture complete; cooldown {cooldown:.0f}s "
+                     "(WATCH_KEEP=1)")
+                time.sleep(cooldown)
+                continue
         time.sleep(PROBE_INTERVAL)
     _log(f"daemon done after {MAX_HOURS:.1f}h; captured={captured}")
     sys.exit(0 if captured else 3)

@@ -36,7 +36,8 @@ def _config(tiny_model_dir, **sched):
         cache_config=CacheConfig(block_size=16, num_blocks=64,
                                  cache_dtype=mcfg.dtype),
         scheduler_config=SchedulerConfig(
-            max_num_seqs=4, prefill_buckets=(32,),
+            max_num_seqs=sched.pop("max_num_seqs", 4),
+            prefill_buckets=(32,),
             num_decode_steps=sched.pop("num_decode_steps", 4), **sched),
         parallel_config=ParallelConfig(),
         lora_config=LoRAConfig(),
@@ -267,3 +268,91 @@ def test_engine_death_during_chained_wave_flushes_epochs(tiny_model_dir):
         await engine.stop()
 
     asyncio.run(scenario())
+
+
+def test_chained_engages_under_saturation(tiny_model_dir):
+    """A full batch with a waiting queue BLOCKED on slots must still
+    chain (the saturated-server steady state): before round 5 the
+    scheduler bailed on ANY waiting work, so a loaded server never got
+    on-device token feedback.  Outputs stay token-identical to sync and
+    every queued request completes."""
+    requests = [
+        (f"r{i}", list(range(3 + i, 12 + i)),
+         dict(temperature=0.0, max_tokens=24, ignore_eos=True))
+        for i in range(5)
+    ]
+    # max_num_seqs=2 -> 2 running, 3 waiting with no free slot for the
+    # whole first cohort; admissions happen only as rows finish
+    config = _config(tiny_model_dir, max_num_seqs=2)
+    baseline = _sync_baseline(config, requests)
+    chained = _async_run(_config(tiny_model_dir, max_num_seqs=2), requests)
+    assert chained == baseline
+    assert all(len(v) == 24 for v in chained.values())
+
+
+def test_waiting_head_admissible_predicate(tiny_model_dir):
+    """Unit: the chain gate mirrors admission — blocked on slots or
+    pages -> not admissible (chain allowed); resources free ->
+    admissible (chain bails)."""
+    from vllm_tgis_adapter_tpu.engine.core import LLMEngine
+    from vllm_tgis_adapter_tpu.engine.sampling_params import SamplingParams
+
+    config = _config(tiny_model_dir, max_num_seqs=2)
+    engine = LLMEngine.from_config(config)
+    sched = engine.scheduler
+    assert not sched._waiting_head_admissible()  # empty queue
+
+    for rid in ("a", "b", "c"):
+        engine.add_request(rid, None,
+                           SamplingParams(temperature=0.0, max_tokens=8,
+                                          ignore_eos=True),
+                           prompt_token_ids=list(range(3, 10)))
+    # nothing admitted yet: head is admissible (slots + pages free)
+    assert sched._waiting_head_admissible()
+    # admit a+b (fills both slots) -> head "c" blocked on slots
+    for _ in range(4):
+        if len(sched.running) == 2:
+            break
+        engine.step()
+    assert len(sched.running) == 2
+    assert sched.waiting and sched.waiting[0].request_id == "c"
+    assert not sched._free_slots
+    assert not sched._waiting_head_admissible()
+
+
+def test_admissible_probe_releases_prefix_refcounts(tiny_model_dir):
+    """The chain-gate's prefix probe must not pin cached pages: repeated
+    probes with prefix caching on leave the allocator's free count
+    untouched (match_prefix refcounts its hits; the probe frees them)."""
+    from vllm_tgis_adapter_tpu.engine.core import LLMEngine
+    from vllm_tgis_adapter_tpu.engine.sampling_params import SamplingParams
+
+    import dataclasses as _dc
+
+    config = _config(tiny_model_dir, max_num_seqs=2)
+    config = _dc.replace(
+        config,
+        cache_config=_dc.replace(config.cache_config,
+                                 enable_prefix_caching=True))
+    engine = LLMEngine.from_config(config)
+    sched = engine.scheduler
+
+    shared = list(range(3, 35))  # two full pages of shared prefix
+    engine.add_request("warm", None,
+                       SamplingParams(temperature=0.0, max_tokens=4,
+                                      ignore_eos=True),
+                       prompt_token_ids=shared)
+    for _ in range(40):
+        if not engine.has_unfinished_requests():
+            break
+        engine.step()
+    free_before = sched.allocator.num_free
+
+    # same prefix waits in the queue: every probe hits the cache
+    engine.add_request("probe-target", None,
+                       SamplingParams(temperature=0.0, max_tokens=4,
+                                      ignore_eos=True),
+                       prompt_token_ids=list(shared))
+    for _ in range(25):
+        sched._waiting_head_admissible()
+    assert sched.allocator.num_free == free_before

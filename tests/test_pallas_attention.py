@@ -499,3 +499,39 @@ def test_paged_decode_fp8_cache_matches_reference():
     )
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize(
+    "window,alibi,g",
+    [(0, False, 4), (0, False, 1), (24, False, 2), (0, True, 2)],
+)
+def test_paged_decode_perhead_variant_matches(window, alibi, g):
+    """The pre-round-5 per-head grid kernel stays available as
+    PALLAS_DECODE_KERNEL=perhead (bench.py's Mosaic-failure fallback);
+    pin it against the XLA reference alongside the folded default."""
+    b, num_kv, head_dim, block_size, max_blocks = 4, 2, 64, 16, 4
+    q, k_cache, v_cache, bt, cl = make_paged_case(
+        3, b, num_kv, g, head_dim, block_size, max_blocks, num_slots=512
+    )
+    h = num_kv * g
+    slopes = (
+        jnp.asarray(np.geomspace(0.5, 0.004, h), jnp.float32)
+        if alibi else None
+    )
+    scale = head_dim**-0.5
+    ref = ref_ops.paged_decode_attention_xla(
+        jnp.asarray(q), jnp.asarray(k_cache), jnp.asarray(v_cache),
+        jnp.asarray(bt), jnp.asarray(cl), block_size, scale,
+        window=window, alibi_slopes=slopes,
+    )
+    for variant in ("perhead", "folded"):
+        got = pk.paged_decode_attention(
+            jnp.asarray(q), jnp.asarray(k_cache), jnp.asarray(v_cache),
+            jnp.asarray(bt), jnp.asarray(cl), block_size, scale,
+            window=window, alibi_slopes=slopes, interpret=True,
+            variant=variant,
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5,
+            err_msg=f"variant={variant}",
+        )

@@ -287,3 +287,58 @@ def test_from_params_packing(sampler_mod):
     assert t.len_penalty_start.tolist() == [5, -1]
     assert t.gen_len.tolist() == [4, 0]
     assert t.base_key[1] == 22
+
+
+def test_want_topn_false_skips_topn_same_tokens(sampler_mod):
+    """The no-logprobs sampler variant (round-5 fast path: no per-step
+    full-vocab lax.top_k) emits zero-width topn arrays but identical
+    tokens/logprob/rank."""
+    import jax.numpy as jnp
+
+    logits = jnp.asarray([[0.1, 3.0, -1.0, 0.5], [2.0, 0.0, 0.1, -5.0]])
+    t = make_tensors(sampler_mod, 2, temperature=[0.0, 0.9],
+                     top_k=[0, 2])
+    full = sampler_mod.sample(logits, no_seen(2, 4), t)
+    slim = sampler_mod.sample(logits, no_seen(2, 4), t, want_topn=False)
+    assert slim.tokens.tolist() == full.tokens.tolist()
+    assert slim.rank.tolist() == full.rank.tolist()
+    np.testing.assert_allclose(np.asarray(slim.logprob),
+                               np.asarray(full.logprob), rtol=1e-6)
+    assert slim.topn_ids.shape == (2, 0)
+    assert slim.topn_logprobs.shape == (2, 0)
+
+
+def test_runtime_gates_match_ungated(sampler_mod):
+    """The lax.cond gates around penalties/filtering must be pure
+    routing: a batch that NEEDS them (one default row + one row with
+    every feature on) produces the same result as calling the heavy
+    helpers unconditionally."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    v = 64
+    key = jax.random.PRNGKey(0)
+    logits = jax.random.normal(key, (2, v), jnp.float32) * 3
+    seen = no_seen(2, v).at[1, 5].set(True)
+    t = make_tensors(
+        sampler_mod, 2,
+        temperature=[0.0, 0.8], top_k=[0, 8], top_p=[1.0, 0.9],
+        repetition_penalty=[1.0, 1.3], min_tokens=[0, 2],
+        len_penalty_start=[-1, 1], len_penalty_decay=[1.0, 1.05],
+        gen_len=[0, 4],
+    )
+    out = sampler_mod.sample(logits, seen, t)
+    # reference: un-gated pipeline
+    ref_logits = sampler_mod.apply_penalties(
+        logits.astype(jnp.float32), seen, t)
+    greedy = t.temperature <= 0.0
+    scaled = ref_logits / jnp.where(greedy, 1.0, t.temperature)[:, None]
+    filtered = sampler_mod._filter_top_k_top_p_typical(scaled, t)
+    keys = jax.vmap(
+        lambda s, g: jax.random.fold_in(jax.random.PRNGKey(s), g)
+    )(t.base_key, t.gen_len)
+    sampled = jax.vmap(jax.random.categorical)(keys, filtered)
+    expect = jnp.where(greedy, jnp.argmax(ref_logits, -1), sampled)
+    assert out.tokens.tolist() == expect.tolist()

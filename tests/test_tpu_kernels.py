@@ -39,6 +39,7 @@ def _paged_case(seed, b, num_kv, g, head_dim, block_size, max_blocks, dtype):
     return tuple(jnp.asarray(x) for x in (q, kc, vc, bt, cl))
 
 
+@pytest.mark.parametrize("variant", ["folded", "perhead"])
 @pytest.mark.parametrize(
     "b,num_kv,g,head_dim,block_size,dtype",
     [
@@ -48,12 +49,13 @@ def _paged_case(seed, b, num_kv, g, head_dim, block_size, max_blocks, dtype):
     ],
 )
 def test_decode_kernel_compiles_and_matches(
-    b, num_kv, g, head_dim, block_size, dtype
+    b, num_kv, g, head_dim, block_size, dtype, variant
 ):
     q, kc, vc, bt, cl = _paged_case(0, b, num_kv, g, head_dim, block_size, 8,
                                     dtype)
     scale = head_dim**-0.5
-    got = pk.paged_decode_attention(q, kc, vc, bt, cl, block_size, scale)
+    got = pk.paged_decode_attention(q, kc, vc, bt, cl, block_size, scale,
+                                    variant=variant)
     got.block_until_ready()  # forces the Mosaic compile + execute
     ref = ref_ops.paged_decode_attention_xla(
         q, kc, vc, bt, cl, block_size, scale

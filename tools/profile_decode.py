@@ -1,0 +1,281 @@
+"""On-chip component breakdown of the serving hot path.
+
+Round-5 on-chip profiling found steady-state fused decode waves costing
+500-770ms (expected ~105ms = tunnel RTT + HBM-bound compute) and packed
+prefill ~330ms (expected ~80ms).  This script times each suspect in
+isolation at the bench geometry (Llama-3.2-1B shape, batch 32, 16 fused
+steps) so the next TPU window attributes the latency instead of
+guessing.  Run by bench_daemon.py after the Mosaic gates; prints one
+JSON line per component.
+
+Components:
+  roofline     chained 2048x8192 matmuls (MXU sanity, TFLOP/s)
+  decode_full  the engine's real fused 16-step decode+sample dispatch
+  model_only   16-step scan of model.decode without the sampler
+  attn_pallas  16x16 paged decode attention calls (pallas) alone
+  attn_xla     same with the XLA gather fallback
+  sampler      16 chained sample() steps on [B, V] logits
+  sampler_greedy  same logits, all-greedy batch (argmax path)
+  kv_write     16x16 write_kv scatters
+  prefill_packed  one packed 2x128-token prefill dispatch
+
+Each timing first runs once to compile, then reports the median of 5
+timed runs (block_until_ready between runs; timings include one tunnel
+RTT each — subtract the reported `rtt_ms`).
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import statistics
+import time
+
+
+def _med_ms(fn, n: int = 5) -> float:
+    fn()  # compile / warm
+    ts = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        fn()
+        ts.append((time.perf_counter() - t0) * 1e3)
+    return round(statistics.median(ts), 1)
+
+
+def main() -> None:
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", "/tmp/jax_compile_cache")
+    import sys
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    import os
+
+    allow_cpu = os.environ.get("PROFILE_ALLOW_CPU") == "1"
+    if not allow_cpu:
+        assert jax.default_backend() == "tpu", jax.default_backend()
+    tiny = os.environ.get("PROFILE_TINY") == "1"
+    sys.path.insert(0, ".")
+
+    def emit(component: str, ms: float, extra: dict | None = None) -> None:
+        line = {"component": component, "ms": ms, **(extra or {})}
+        print(json.dumps(line), flush=True)
+
+    # tunnel RTT reference: block on a trivial ready result
+    x0 = jnp.ones((8, 128), jnp.bfloat16)
+    tiny = jax.jit(lambda a: a * 2)
+    rtt = _med_ms(lambda: tiny(x0).block_until_ready())
+    emit("rtt", rtt)
+
+    # ---- roofline
+    w = jnp.ones((2048, 8192), jnp.bfloat16)
+    h = jnp.ones((32, 2048), jnp.bfloat16)
+
+    @jax.jit
+    def chain(h, w):
+        for _ in range(32):
+            h = jnp.tanh(h @ w @ w.T * 1e-3)
+        return h
+
+    ms = _med_ms(lambda: chain(h, w).block_until_ready())
+    tf = 32 * 2 * 2 * 32 * 2048 * 8192 / (ms / 1e3) / 1e12
+    emit("roofline", ms, {"tflops": round(tf, 1)})
+
+    # ---- engine pieces at bench geometry
+    from bench import build_model_dir
+    from transformers import AutoTokenizer
+
+    from vllm_tgis_adapter_tpu.engine.config import (
+        CacheConfig,
+        EngineConfig,
+        LoRAConfig,
+        ModelConfig,
+        ParallelConfig,
+        SchedulerConfig,
+    )
+    from vllm_tgis_adapter_tpu.engine.core import LLMEngine
+    from vllm_tgis_adapter_tpu.engine.sampling_params import SamplingParams
+    from vllm_tgis_adapter_tpu.models.llama import LlamaForCausalLM
+
+    model_dir, arch = build_model_dir(tiny)
+    dtype = jnp.float32 if tiny else jnp.bfloat16
+    prompt_len, max_seqs = (32, 4) if tiny else (128, 32)
+    max_len = prompt_len + 144
+    mcfg = ModelConfig(model=model_dir, model_type="llama",
+                       max_model_len=max_len, rope_theta=500000.0,
+                       dtype=dtype, **arch)
+    config = EngineConfig(
+        model_config=mcfg,
+        cache_config=CacheConfig(block_size=16,
+                                 num_blocks=max_seqs * 17 * 2,
+                                 cache_dtype=dtype),
+        scheduler_config=SchedulerConfig(
+            max_num_seqs=max_seqs,
+            prefill_buckets=(prompt_len, max_len),
+            num_decode_steps=16),
+        parallel_config=ParallelConfig(),
+        lora_config=LoRAConfig(),
+    )
+    model = LlamaForCausalLM(mcfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+    tok = AutoTokenizer.from_pretrained(model_dir)
+    engine = LLMEngine(config, model, params, tok)
+    rng = np.random.default_rng(0)
+    for i in range(max_seqs):
+        ids = rng.integers(3, mcfg.vocab_size, size=prompt_len).tolist()
+        engine.add_request(
+            f"r{i}", None,
+            SamplingParams(temperature=0.0, max_tokens=64,
+                           ignore_eos=True),
+            prompt_token_ids=ids)
+
+    # drive prefills through, timing one packed dispatch; stop at the
+    # first decode plan and keep it for the wave timings below
+    prefill_ms = None
+    while True:
+        outs, plan, prepared = engine.plan_step()
+        if plan is None:
+            break
+        if type(plan).__name__ == "DecodePlan":
+            break
+        t0 = time.perf_counter()
+        handle = engine.dispatch_step(plan, prepared)
+        result = engine.wait_step(plan, prepared, handle)
+        prefill_ms = round((time.perf_counter() - t0) * 1e3, 1)
+        engine.commit_step(plan, result, prepared)
+    emit("prefill_packed", prefill_ms or -1.0)
+    assert plan is not None and type(plan).__name__ == "DecodePlan", plan
+    runner = engine.runner
+
+    def full_wave():
+        handle = runner.dispatch_decode(prepared)
+        runner.wait_decode(prepared, handle)
+
+    emit("decode_full", _med_ms(full_wave),
+         {"steps": prepared.num_steps,
+          "batch": int(prepared.block_tables.shape[0])})
+
+    # ---- model-only scan (no sampler): greedy argmax feedback
+    b = prepared.block_tables.shape[0]
+    ints, floats = runner._pack_decode_inputs(prepared)
+    ints_d = jnp.asarray(ints)
+    bt = jnp.asarray(prepared.block_tables)
+    block_size = 16
+
+    @functools.partial(jax.jit, static_argnums=(3,))
+    def model_scan(params, caches, ints, num_steps):
+        tokens0, positions0, limits = ints[0], ints[1], ints[2]
+        context0, row_slots = ints[3], ints[4]
+        max_blocks = bt.shape[1]
+
+        def step(carry, k):
+            caches, tokens = carry
+            pos = positions0 + k
+            active = (pos <= limits) & (row_slots >= 0)
+            blk = jnp.take_along_axis(
+                bt, jnp.clip(pos // block_size, 0, max_blocks - 1)[:, None],
+                axis=1)[:, 0]
+            slot = jnp.where(active, blk * block_size + pos % block_size, -1)
+            logits, caches = model.decode(
+                params, caches, tokens, pos, slot, bt,
+                context0 + k, block_size, None, None)
+            return (caches, jnp.argmax(logits, -1).astype(jnp.int32)), ()
+
+        (caches, tokens), _ = jax.lax.scan(
+            step, (caches, ints[0]), jnp.arange(num_steps))
+        return tokens
+
+    emit("model_only", _med_ms(
+        lambda: model_scan(params, runner.caches, ints_d,
+                           16).block_until_ready()))
+
+    # ---- attention alone (pallas vs xla), 16 layers x 16 steps worth
+    from vllm_tgis_adapter_tpu.ops import attention as attn_ops
+
+    kc = runner.caches[0][0]
+    vc = runner.caches[1][0]
+    q = jnp.ones((b, arch["num_heads"], arch["head_dim"]), dtype)
+    cl = jnp.asarray(prepared.context_lens
+                     if hasattr(prepared, "context_lens")
+                     else np.full(b, 140, np.int32))
+
+    n_calls = 4 if tiny else 16 * 16  # layers x fused steps
+
+    def attn_loop(impl):
+        @jax.jit
+        def many(q, kc, vc, bt, cl):
+            acc = q
+            for _ in range(n_calls):
+                acc = impl(acc, kc, vc, bt, cl)
+            return acc
+
+        return _med_ms(lambda: many(q, kc, vc, bt, cl).block_until_ready())
+
+    from vllm_tgis_adapter_tpu.ops import pallas_attention
+
+    emit(f"attn_pallas_{n_calls}calls", attn_loop(
+        lambda q, kc, vc, bt, cl: pallas_attention.paged_decode_attention(
+            q, kc, vc, bt, cl, block_size=16, scale=0.125,
+            interpret=allow_cpu)))
+    emit(f"attn_xla_{n_calls}calls", attn_loop(
+        lambda q, kc, vc, bt, cl: attn_ops.paged_decode_attention_xla(
+            q, kc, vc, bt, cl, 16, 0.125)))
+
+    # ---- sampler alone: 16 chained steps, sampled vs greedy
+    from vllm_tgis_adapter_tpu.engine import sampler as sampler_mod
+
+    logits = jnp.ones((b, mcfg.vocab_size), jnp.float32)
+    seen = runner.seen
+
+    def build_tensors(greedy: bool):
+        t = sampler_mod.SamplingTensors(
+            temperature=jnp.full(b, 0.0 if greedy else 0.9, jnp.float32),
+            top_k=jnp.full(b, 0 if greedy else 40, jnp.int32),
+            top_p=jnp.full(b, 1.0 if greedy else 0.9, jnp.float32),
+            typical_p=jnp.ones(b, jnp.float32),
+            repetition_penalty=jnp.full(b, 1.0 if greedy else 1.1,
+                                        jnp.float32),
+            len_penalty_start=jnp.full(b, 10 ** 9, jnp.int32),
+            len_penalty_decay=jnp.ones(b, jnp.float32),
+            min_tokens=jnp.zeros(b, jnp.int32),
+            eos_token_id=jnp.full(b, -1, jnp.int32),
+            gen_len=jnp.zeros(b, jnp.int32),
+            base_key=jnp.arange(b, dtype=jnp.uint32),
+        )
+
+        @jax.jit
+        def sample16(logits, seen, t):
+            def step(carry, k):
+                logits, seen = carry
+                out = sampler_mod.sample(
+                    logits, jnp.take(seen, jnp.arange(b), axis=0), t)
+                logits = logits + out.tokens[:, None] * 1e-6
+                return (logits, seen), out.tokens
+
+            (_, _), toks = jax.lax.scan(step, (logits, seen),
+                                        jnp.arange(16))
+            return toks
+
+        return lambda: sample16(logits, seen, t).block_until_ready()
+
+    emit("sampler_sampled_16", _med_ms(build_tensors(False)))
+    emit("sampler_greedy_16", _med_ms(build_tensors(True)))
+
+    # ---- kv write scatter alone
+    kx = jnp.ones((b, arch["num_kv_heads"], arch["head_dim"]), dtype)
+    slots = jnp.arange(b, dtype=jnp.int32) * 16
+
+    @jax.jit
+    def scatter_many(kc, vc, kx, slots):
+        for _ in range(n_calls):
+            kc, vc = attn_ops.write_kv(kc, vc, kx, kx, slots)
+        return kc[0, 0, 0]
+
+    emit(f"kv_write_{n_calls}", _med_ms(
+        lambda: scatter_many(kc, vc, kx, slots).block_until_ready()))
+
+
+if __name__ == "__main__":
+    main()

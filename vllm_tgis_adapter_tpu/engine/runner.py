@@ -143,6 +143,10 @@ class PreparedDecode:
     # every row is plain-greedy and adapterless → the speculative path
     # may take this dispatch (engine/speculative.py)
     spec_ok: bool = False
+    # any row asked for top-N logprobs: False compiles/selects the
+    # sampler variant with no per-step lax.top_k and zero-width topn
+    # outputs (the common serving case)
+    want_topn: bool = True
     # rows whose draft cache lags (they decoded in mixed batches): each
     # entry is the padded draft-chunk inputs to catch that row up
     draft_catchups: list = dataclasses.field(default_factory=list)
@@ -363,6 +367,7 @@ class ModelRunner:
             lora,  # LoRAStacks or None
             lora_idx,  # [B] adapter slot per row or None
             num_steps: int,  # static: steps fused into this dispatch
+            want_topn: bool = True,  # static: any row wants top-N logprobs
         ):
             tokens0 = ints[0]
             positions0 = ints[1]
@@ -408,7 +413,8 @@ class ModelRunner:
                 )
                 seen_rows = jnp.take(seen, rows, axis=0)
                 out = sampler_mod.sample(
-                    logits, seen_rows, t_k, allowed_mask=allowed_mask
+                    logits, seen_rows, t_k, allowed_mask=allowed_mask,
+                    want_topn=want_topn,
                 )
                 seen = sampler_mod.update_seen(
                     seen, jnp.where(active, row_slots, -1), out.tokens
@@ -436,6 +442,7 @@ class ModelRunner:
             chain_idx,  # [B] i32: last live step per row in prev wave
             ints, floats, block_tables, allowed_mask, lora, lora_idx,
             num_steps: int,
+            want_topn: bool = True,
         ):
             # chained wave (async scheduling): the input token of each row
             # is the PREVIOUS wave's final sampled token, read directly
@@ -447,14 +454,14 @@ class ModelRunner:
             ints = ints.at[0].set(tokens0)
             return decode_steps(
                 params, caches, seen, ints, floats, block_tables,
-                allowed_mask, lora, lora_idx, num_steps,
+                allowed_mask, lora, lora_idx, num_steps, want_topn,
             )
 
         self._chained_decode_fn = jax.jit(
-            chained_decode_steps, static_argnums=(11,),
+            chained_decode_steps, static_argnums=(11, 12),
             donate_argnums=donate,
         )
-        return jax.jit(decode_steps, static_argnums=(9,),
+        return jax.jit(decode_steps, static_argnums=(9, 10),
                        donate_argnums=donate)
 
     def _put(self, x) -> jax.Array:
@@ -987,6 +994,9 @@ class ModelRunner:
 
         return PreparedDecode(
             spec_ok=spec_ok,
+            want_topn=any(
+                seq.params.logprobs not in (None, 0) for seq in seqs
+            ),
             draft_catchups=draft_catchups,
             num_seqs=len(seqs),
             num_steps=plan.num_steps,
@@ -1063,6 +1073,9 @@ class ModelRunner:
             allowed_mask=None,  # FSM rows never chain (scheduler bail)
             lora_idx=lora_idx,
             chain_idx=chain_idx,
+            want_topn=any(
+                seq.params.logprobs not in (None, 0) for seq in seqs
+            ),
         )
 
     def dispatch_chained_decode(self, prep: "PreparedDecode", prev_handle):
@@ -1087,6 +1100,7 @@ class ModelRunner:
                 if prep.lora_idx is not None
                 else None,
                 prep.num_steps,
+                prep.want_topn,
             )
         )
         return ints_out, floats_out
@@ -1134,6 +1148,7 @@ class ModelRunner:
             lora,
             self._put(prep.lora_idx) if prep.lora_idx is not None else None,
             prep.num_steps,
+            prep.want_topn,
         )
         return ints_out, floats_out
 

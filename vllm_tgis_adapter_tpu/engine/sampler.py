@@ -194,12 +194,16 @@ def _filter_top_k_top_p_typical(
     return jnp.where(keep, scaled, NEG_INF)
 
 
-@partial(jax.jit, donate_argnums=())
+@partial(jax.jit, donate_argnums=(), static_argnames=("want_topn",))
 def sample(
     logits: jax.Array,  # [B, V] f32 raw model logits for the last position
     seen: jax.Array,  # [B, V] bool
     t: SamplingTensors,
     allowed_mask: jax.Array | None = None,  # [B, V] bool structured-output mask
+    *,
+    want_topn: bool = True,  # static: False skips the per-step top-k
+    #     entirely and emits zero-width topn arrays (no request in the
+    #     batch asked for top-N logprobs — the common case)
 ) -> SamplerOutput:
     b, v = logits.shape
     logits = logits.astype(jnp.float32)
@@ -217,7 +221,23 @@ def sample(
         t = dataclasses.replace(
             t, min_tokens=jnp.where(non_eos_allowed > 0, t.min_tokens, 0)
         )
-    logits = apply_penalties(logits, seen, t)
+    # decode waves run sample() every fused step, so the [B, V] heavy
+    # ops are gated at RUNTIME on whether any row actually uses them
+    # (lax.cond executes one branch on TPU): an all-default batch skips
+    # the penalty rewrite and — the big one — the two full-vocab sorts
+    # of the top-k/top-p/typical filter.  One compiled program still
+    # serves every batch composition (no retrace; the predicate is data).
+    needs_penalties = (
+        jnp.any(t.repetition_penalty != 1.0)
+        | jnp.any(t.len_penalty_start >= 0)
+        | jnp.any(t.min_tokens > 0)
+    )
+    logits = jax.lax.cond(
+        needs_penalties,
+        lambda lg: apply_penalties(lg, seen, t),
+        lambda lg: lg,
+        logits,
+    )
 
     # token-info distribution: post-penalty, pre-filter (matches the TGIS
     # token detail semantics of "logprob the model assigned")
@@ -226,7 +246,17 @@ def sample(
     scaled = logits / safe_temp
     logp = jax.nn.log_softmax(scaled, axis=-1)
 
-    filtered = _filter_top_k_top_p_typical(scaled, t)
+    needs_filter = jnp.any(~greedy) & (
+        jnp.any(t.top_k > 0)
+        | jnp.any(t.top_p < 1.0)
+        | jnp.any(t.typical_p < 1.0)
+    )
+    filtered = jax.lax.cond(
+        needs_filter,
+        lambda s: _filter_top_k_top_p_typical(s, t),
+        lambda s: s,
+        scaled,
+    )
     # fold the per-request position (NOT a global step counter) into the
     # key: a seeded request replays the same draw stream no matter how it
     # is batched or scheduled
@@ -239,7 +269,11 @@ def sample(
 
     chosen_logp = jnp.take_along_axis(logp, tokens[:, None], axis=-1)[:, 0]
     rank = 1 + jnp.sum(logp > chosen_logp[:, None], axis=-1).astype(jnp.int32)
-    topn_logprobs, topn_ids = jax.lax.top_k(logp, min(TOPN_WIDTH, v))
+    if want_topn:
+        topn_logprobs, topn_ids = jax.lax.top_k(logp, min(TOPN_WIDTH, v))
+    else:
+        topn_logprobs = jnp.zeros((b, 0), jnp.float32)
+        topn_ids = jnp.zeros((b, 0), jnp.int32)
     return SamplerOutput(
         tokens=tokens,
         logprob=chosen_logp,

@@ -514,7 +514,14 @@ class Scheduler:
         its mask between tokens), the batch composition changed, or page
         growth would need preemption (never preempt on a projection).
         """
-        if self.waiting or not self.running:
+        if not self.running:
+            return None
+        if self._waiting_head_admissible():
+            # waiting work that can actually make progress takes
+            # priority over a projected wave; a head that is BLOCKED
+            # (no batch slot / no KV pages) costs nothing to chain past
+            # and is re-checked before every chained wave, so a slot
+            # freed by a finishing row stops the chain within one wave
             return None
         if len(self.running) != len(prev.seqs) or {
             id(s) for s in self.running
@@ -567,6 +574,43 @@ class Scheduler:
             num_steps=max(planned),
             steps_per_seq=planned,
         )
+
+    def _waiting_head_admissible(self) -> bool:
+        """Could the waiting head make progress if plan_step ran now?
+
+        Used by ``schedule_chained``: chaining past an ADMISSIBLE head
+        would delay its admission by a full fused wave, but chaining
+        while the head is blocked on resources is free throughput —
+        the saturated-server steady state (queue deep, batch full) is
+        exactly where on-device token feedback matters most.  Mirrors
+        the resource checks of ``_try_schedule_prefill`` /
+        ``try_swap_in``; the prefix probe's refcounts are released
+        before returning."""
+        if not self.waiting:
+            return False
+        seq = self.waiting[0]
+        total = len(seq.all_token_ids)
+        if seq.swapped is not None:
+            return bool(self._free_slots) and self.allocator.can_allocate(
+                self.allocator.blocks_needed(total)
+            )
+        if seq.prefill_pos > 0:
+            return True  # mid-chunk prefill always continues
+        if not self._free_slots:
+            return False
+        matched = 0
+        if self._adoptable(seq):
+            hit_blocks, matched = self.allocator.match_prefix(
+                seq.all_token_ids, seq.lora_name
+            )
+            if matched:
+                # probe only: match_prefix refcounted the hit pages
+                # (its contract); release or they pin forever
+                self.allocator.free(hit_blocks)
+        needed = self.allocator.blocks_needed(total) - (
+            self.allocator.blocks_needed(matched) if matched else 0
+        )
+        return self.allocator.can_allocate(max(0, needed))
 
     def try_swap_in(self) -> Optional[Sequence]:
         """Re-admit the queue head from its host KV copy (no recompute).
