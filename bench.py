@@ -172,10 +172,12 @@ def run_bench(on_tpu: bool) -> dict:
     backend = jax.default_backend()
     device = jax.devices()[0]
     tiny = os.environ.get("BENCH_TINY", "") == "1" or backend != "tpu"
-    n_requests = int(os.environ.get("BENCH_REQUESTS", 16 if tiny else 64))
+    n_requests = int(os.environ.get("BENCH_REQUESTS", 16 if tiny else 128))
     prompt_len = int(os.environ.get("BENCH_PROMPT", 32 if tiny else 128))
     output_len = int(os.environ.get("BENCH_OUTPUT", 16 if tiny else 128))
-    max_seqs = int(os.environ.get("BENCH_BATCH", 8 if tiny else 32))
+    # decode is weight-read bound: batch 64 halves the HBM cost per
+    # token vs 32 (weights stream once per wave regardless of rows)
+    max_seqs = int(os.environ.get("BENCH_BATCH", 8 if tiny else 64))
 
     model_dir, arch = build_model_dir(tiny)
     dtype = jnp.float32 if tiny else jnp.bfloat16
@@ -193,7 +195,11 @@ def run_bench(on_tpu: bool) -> dict:
                                  cache_dtype=dtype),
         scheduler_config=SchedulerConfig(
             max_num_seqs=max_seqs,
-            prefill_buckets=(prompt_len, max_len),
+            # buckets beyond max_len exist for PACKED prefill: the
+            # tunnel chip pays ~64ms per dispatch, so packing 8 prompts
+            # per dispatch (1024 bucket) instead of 2 (272) cuts the
+            # prefill dispatch count 4x (scheduler._extend_pack)
+            prefill_buckets=(prompt_len, max_len, 512, 1024),
             # fused K-step decode: one dispatch (and one result transfer)
             # per K tokens per wave.  The tunnel-backed chip pays a
             # network round trip per dispatch, so the TPU default fuses

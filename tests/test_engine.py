@@ -945,3 +945,54 @@ def test_async_engine_swap_under_pressure(tiny_model_dir):
     assert all(len(t) == 40 for t in tight)
     assert tight == roomy
     assert metrics.kv_swap_in_total._value.get() > in_before
+
+
+def test_precompile_warms_shapes_and_leaves_engine_clean(engine_factory):
+    """precompile (--precompile) drives every batch-width bucket through
+    prefill+decode and leaves an idle engine; serving afterwards works
+    and an active engine refuses to precompile."""
+    from vllm_tgis_adapter_tpu.engine.sampling_params import SamplingParams
+
+    engine = engine_factory(max_num_seqs=4,
+                            scheduler_kwargs=dict(num_decode_steps=4))
+    chained_calls = [0]
+    inner = engine.dispatch_chained_step
+
+    def spy(plan, prepared, prev_handle):
+        chained_calls[0] += 1
+        return inner(plan, prepared, prev_handle)
+
+    engine.dispatch_chained_step = spy
+    n = engine.precompile("all")
+    # widths 1, 2, 4 x two topn variants -> 14 warmup requests
+    assert n == 14
+    assert chained_calls[0] > 0  # the chained program compiled in warmup
+    assert not engine.has_unfinished_requests()
+    alloc = engine.scheduler.allocator
+    assert alloc.num_free == alloc.num_blocks
+    assert len(engine.scheduler._free_slots) == 4
+
+    engine.add_request("real", None,
+                       SamplingParams(temperature=0.0, max_tokens=5,
+                                      ignore_eos=True),
+                       prompt_token_ids=list(range(3, 12)))
+    outs = []
+    for _ in range(50):
+        if not engine.has_unfinished_requests():
+            break
+        outs.extend(o for o in engine.step() if o.finished)
+    assert outs and len(outs[0].outputs[0].token_ids) == 5
+
+    engine.add_request("busy", None,
+                       SamplingParams(temperature=0.0, max_tokens=5,
+                                      ignore_eos=True),
+                       prompt_token_ids=list(range(3, 12)))
+    with pytest.raises(AssertionError, match="idle"):
+        engine.precompile("max")
+
+
+def test_precompile_max_only_widest_batch(engine_factory):
+    engine = engine_factory(max_num_seqs=4,
+                            scheduler_kwargs=dict(num_decode_steps=4))
+    assert engine.precompile("max") == 4
+    assert not engine.has_unfinished_requests()

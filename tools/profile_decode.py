@@ -65,8 +65,8 @@ def main() -> None:
 
     # tunnel RTT reference: block on a trivial ready result
     x0 = jnp.ones((8, 128), jnp.bfloat16)
-    tiny = jax.jit(lambda a: a * 2)
-    rtt = _med_ms(lambda: tiny(x0).block_until_ready())
+    probe_fn = jax.jit(lambda a: a * 2)
+    rtt = _med_ms(lambda: probe_fn(x0).block_until_ready())
     emit("rtt", rtt)
 
     # ---- roofline

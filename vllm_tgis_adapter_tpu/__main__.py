@@ -77,6 +77,13 @@ async def start_servers(args: "argparse.Namespace") -> None:
         from vllm_tgis_adapter_tpu.engine.config import EngineConfig
 
         engine = AsyncLLMEngine.from_config(EngineConfig.from_args(args))
+        if getattr(args, "precompile", None):
+            # warm every serving shape BEFORE the servers bind: the
+            # first real request then never pays a 20-40s TPU compile
+            for rep in engine._replicas:
+                await asyncio.to_thread(
+                    rep.engine.precompile, args.precompile
+                )
         await engine.start()
 
         # uniform TGIS-style request logging for both servers

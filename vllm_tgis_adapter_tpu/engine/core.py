@@ -438,6 +438,102 @@ class LLMEngine:
 
     # ------------------------------------------------------------- step loop
 
+    def precompile(self, batch_widths: str = "all") -> int:
+        """Boot-time shape warmup: drive dummy requests through every
+        prefill bucket and decode batch-width bucket so production
+        traffic never pays an XLA/Mosaic compile (first compiles run
+        ~20-40s each on TPU; the persistent compilation cache then
+        serves restarts).  Mirrors the TPU warmup the reference stack
+        inherits from vLLM's TPU worker.
+
+        ``batch_widths``: "all" compiles every power-of-two decode
+        bucket (1, 2, 4, ... max_num_seqs); "max" only the widest —
+        faster boot, later fill-in compiles as load ramps.
+
+        Returns the number of warmup requests run.  Must be called
+        before serving starts (asserts the engine is idle); leaves no
+        residual state (all warmup requests run to completion).
+        """
+        assert not self.has_unfinished_requests(), (
+            "precompile must run on an idle engine"
+        )
+        sched = self.scheduler
+        max_len = self.config.max_model_len
+        widths = (
+            list(sched.batch_buckets)
+            if batch_widths == "all"
+            else [sched.batch_buckets[-1]]
+        )
+        # "all" also compiles the want_topn=True decode variant (static
+        # argnum: flipping it at serving time is a fresh full compile)
+        topn_variants = [False, True] if batch_widths == "all" else [False]
+        # two full fused waves: the first compiles the production
+        # num_decode_steps program, the second is dispatched CHAINED so
+        # the async loop's separately-jitted _chained_decode_fn compiles
+        # at the same (width, steps) shape
+        steps = sched.config.num_decode_steps
+        total = 0
+        for width in widths:
+            for want_topn in topn_variants:
+                for i in range(width):
+                    bucket = sched.config.prefill_buckets[
+                        i % len(sched.config.prefill_buckets)
+                    ]
+                    plen = max(1, min(bucket, max_len - 2 * steps - 2))
+                    self.add_request(
+                        f"__warmup_{width}_{want_topn}_{i}",
+                        None,
+                        SamplingParams(
+                            temperature=0.0, max_tokens=2 * steps + 1,
+                            ignore_eos=True,
+                            logprobs=1 if want_topn else None,
+                        ),
+                        prompt_token_ids=[1] * plen,
+                    )
+                    total += 1
+                self._precompile_drain(width)
+        logger.info(
+            "precompile: %d warmup requests across %d batch widths "
+            "(topn variants: %s, chained: yes)",
+            total, len(widths), topn_variants,
+        )
+        return total
+
+    def _precompile_drain(self, width: int) -> None:
+        """Run the warmup batch to completion, dispatching one decode
+        wave per batch CHAINED (mirroring the async loop's
+        plan_chained_step -> dispatch_chained_step -> commit order,
+        free-epoch discipline included) so the chained program compiles
+        during warmup rather than on the first production wave."""
+        chained_done = False
+        guard = 0
+        while self.has_unfinished_requests():
+            guard += 1
+            if guard > 200 * width + 2000:  # pragma: no cover
+                raise RuntimeError("precompile did not converge")
+            outputs, plan, prepared = self.plan_step()
+            if plan is None:
+                continue
+            handle = self.dispatch_step(plan, prepared)
+            chained = None
+            if not chained_done:
+                chained = self.plan_chained_step(plan, prepared)
+            if chained is None:
+                self.commit_step(
+                    plan, self.wait_step(plan, prepared, handle), prepared
+                )
+                continue
+            c_plan, c_prep = chained
+            self.begin_free_epoch()
+            c_handle = self.dispatch_chained_step(c_plan, c_prep, handle)
+            self.commit_step(
+                plan, self.wait_step(plan, prepared, handle), prepared
+            )
+            c_result = self.wait_step(c_plan, c_prep, c_handle)
+            self.flush_free_epoch()  # chained wave retired
+            self.commit_step(c_plan, c_result, c_prep)
+            chained_done = True
+
     def step(self) -> list[RequestOutput]:
         """Run one device step; return outputs due for emission.
 

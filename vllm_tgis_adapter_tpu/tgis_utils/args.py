@@ -214,6 +214,14 @@ def add_engine_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                    help="content-addressed reuse of full prompt KV pages "
                         "across requests sharing a prefix (matched pages "
                         "skip prefill; engine/kv_cache.py)")
+    g.add_argument("--precompile", type=str, default=None,
+                   choices=["all", "max"],
+                   help="warm every serving shape at boot (TPU compiles "
+                        "run 20-40s; the compilation cache persists them "
+                        "across restarts): 'all' compiles every decode "
+                        "batch-width bucket x prefill bucket, 'max' only "
+                        "the widest batch (faster boot, fill-in compiles "
+                        "as load ramps)")
 
     g = parser.add_argument_group("parallelism")
     g.add_argument("--tensor-parallel-size", "-tp", type=int, default=None,
