@@ -956,17 +956,22 @@ def test_precompile_warms_shapes_and_leaves_engine_clean(engine_factory):
     engine = engine_factory(max_num_seqs=4,
                             scheduler_kwargs=dict(num_decode_steps=4))
     chained_calls = [0]
+    chained_widths = []
     inner = engine.dispatch_chained_step
 
     def spy(plan, prepared, prev_handle):
         chained_calls[0] += 1
+        chained_widths.append(len(plan.seqs))
         return inner(plan, prepared, prev_handle)
 
     engine.dispatch_chained_step = spy
     n = engine.precompile("all")
     # widths 1, 2, 4 x two topn variants -> 14 warmup requests
     assert n == 14
-    assert chained_calls[0] > 0  # the chained program compiled in warmup
+    # the chained program compiled in warmup AT THE FULL BATCH WIDTH
+    # (the production shape) - not just narrow tail batches
+    assert chained_calls[0] > 0
+    assert max(chained_widths) == 4, chained_widths
     assert not engine.has_unfinished_requests()
     alloc = engine.scheduler.allocator
     assert alloc.num_free == alloc.num_blocks
@@ -987,7 +992,7 @@ def test_precompile_warms_shapes_and_leaves_engine_clean(engine_factory):
                        SamplingParams(temperature=0.0, max_tokens=5,
                                       ignore_eos=True),
                        prompt_token_ids=list(range(3, 12)))
-    with pytest.raises(AssertionError, match="idle"):
+    with pytest.raises(RuntimeError, match="idle"):
         engine.precompile("max")
 
 

@@ -80,10 +80,7 @@ async def start_servers(args: "argparse.Namespace") -> None:
         if getattr(args, "precompile", None):
             # warm every serving shape BEFORE the servers bind: the
             # first real request then never pays a 20-40s TPU compile
-            for rep in engine._replicas:
-                await asyncio.to_thread(
-                    rep.engine.precompile, args.precompile
-                )
+            await engine.precompile(args.precompile)
         await engine.start()
 
         # uniform TGIS-style request logging for both servers

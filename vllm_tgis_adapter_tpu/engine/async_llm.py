@@ -139,6 +139,17 @@ class AsyncLLMEngine:
 
     STATS_INTERVAL_S = 10.0
 
+    async def precompile(self, batch_widths: str = "all") -> int:
+        """Warm every serving shape on every replica before ``start()``
+        (--precompile): delegates to each core engine's precompile off
+        the event loop.  Returns total warmup requests run."""
+        total = 0
+        for rep in self._replicas:
+            total += await asyncio.to_thread(
+                rep.engine.precompile, batch_widths
+            )
+        return total
+
     async def start(self) -> None:
         for rep in self._replicas:
             if rep.task is None:

@@ -454,9 +454,8 @@ class LLMEngine:
         before serving starts (asserts the engine is idle); leaves no
         residual state (all warmup requests run to completion).
         """
-        assert not self.has_unfinished_requests(), (
-            "precompile must run on an idle engine"
-        )
+        if self.has_unfinished_requests():
+            raise RuntimeError("precompile must run on an idle engine")
         sched = self.scheduler
         max_len = self.config.max_model_len
         widths = (
@@ -473,13 +472,19 @@ class LLMEngine:
         # at the same (width, steps) shape
         steps = sched.config.num_decode_steps
         total = 0
+        covered: set[int] = set()
+
+        def warm_len(bucket: int, headroom: int = 0) -> int:
+            plen = max(1, min(bucket, max_len - (headroom or 2 * steps) - 2))
+            covered.add(sched._prefill_bucket(plen))
+            return plen
+
         for width in widths:
             for want_topn in topn_variants:
                 for i in range(width):
                     bucket = sched.config.prefill_buckets[
                         i % len(sched.config.prefill_buckets)
                     ]
-                    plen = max(1, min(bucket, max_len - 2 * steps - 2))
                     self.add_request(
                         f"__warmup_{width}_{want_topn}_{i}",
                         None,
@@ -488,23 +493,67 @@ class LLMEngine:
                             ignore_eos=True,
                             logprobs=1 if want_topn else None,
                         ),
-                        prompt_token_ids=[1] * plen,
+                        prompt_token_ids=[1] * warm_len(bucket),
                     )
                     total += 1
                 self._precompile_drain(width)
+        # prefill compiles key on the BUCKET, not the batch width: any
+        # bucket the width loops didn't reach (narrow batches, long
+        # bucket lists) gets a solo pass so long prompts don't compile
+        # at serving time either
+        for bucket in sched.config.prefill_buckets:
+            if bucket in covered or bucket >= max_len:
+                continue
+            self.add_request(
+                f"__warmup_bucket_{bucket}",
+                None,
+                SamplingParams(temperature=0.0, max_tokens=1,
+                               ignore_eos=True),
+                prompt_token_ids=[1] * warm_len(bucket, headroom=1),
+            )
+            total += 1
+            self._precompile_drain(1)
         logger.info(
-            "precompile: %d warmup requests across %d batch widths "
-            "(topn variants: %s, chained: yes)",
-            total, len(widths), topn_variants,
+            "precompile: %d warmup requests across %d batch widths, "
+            "%d prefill buckets (topn variants: %s, chained: yes)",
+            total, len(widths), len(covered), topn_variants,
         )
         return total
 
     def _precompile_drain(self, width: int) -> None:
-        """Run the warmup batch to completion, dispatching one decode
-        wave per batch CHAINED (mirroring the async loop's
+        """Run the warmup batch to completion, dispatching the FIRST
+        full-batch decode wave CHAINED (mirroring the async loop's
         plan_chained_step -> dispatch_chained_step -> commit order,
         free-epoch discipline included) so the chained program compiles
-        during warmup rather than on the first production wave."""
+        at the production (width, num_decode_steps) shape rather than
+        on the first live chained wave.
+
+        All prefills drain first (``prefill_only=True`` planning):
+        organic interleaving would let early rows burn their max_tokens
+        budget before the batch fills, making schedule_chained bail on
+        the full-width wave (the projection needs >= 1 step of headroom
+        on every row)."""
+        guard = 0
+        while True:
+            guard += 1
+            if guard > 50 * width + 500:  # pragma: no cover
+                raise RuntimeError("precompile prefill did not converge")
+            # the prefill/decode anti-starvation interleave
+            # (scheduler._last_was_prefill) returns None after every
+            # admission; there is nothing to starve during warmup, so
+            # clear it — all prompts must be resident before the first
+            # decode or early rows burn their budget pre-full-width
+            self.scheduler._last_was_prefill = False
+            outputs, plan, prepared = self.plan_step(prefill_only=True)
+            if plan is None:
+                break
+            self.commit_step(
+                plan,
+                self.wait_step(
+                    plan, prepared, self.dispatch_step(plan, prepared)
+                ),
+                prepared,
+            )
         chained_done = False
         guard = 0
         while self.has_unfinished_requests():

@@ -424,21 +424,29 @@ class ModelRunner:
             (caches, seen, _), outs = jax.lax.scan(
                 step, (caches, seen, tokens0), jnp.arange(num_steps)
             )
-            ints_out = jnp.concatenate(
+            # ONE packed result buffer (floats bitcast to i32): each
+            # device->host buffer is its own transfer at the runtime
+            # layer — and through a tunnel-attached chip, its own
+            # network round trip — so the wave's entire result comes
+            # back in a single fetch.  Layout: [tokens, rank, topn_ids
+            # (W), logprob, topn_logprobs (W)] -> [K, B, 3+2W]
+            packed_out = jnp.concatenate(
                 [outs.tokens[..., None], outs.rank[..., None],
-                 outs.topn_ids],
+                 outs.topn_ids,
+                 jax.lax.bitcast_convert_type(
+                     outs.logprob, jnp.int32)[..., None],
+                 jax.lax.bitcast_convert_type(
+                     outs.topn_logprobs, jnp.int32)],
                 axis=-1,
-            )  # [K, B, 2+W]
-            floats_out = jnp.concatenate(
-                [outs.logprob[..., None], outs.topn_logprobs], axis=-1
-            )  # [K, B, 1+W]
-            return caches, seen, ints_out, floats_out
+            )
+            return caches, seen, packed_out
 
         donate = (1, 2) if jax.default_backend() == "tpu" else ()
 
         def chained_decode_steps(
             params, caches, seen,
-            prev_ints_out,  # [K_prev, B, 2+W] the in-flight wave's outputs
+            prev_ints_out,  # [K_prev, B, 3+2W] the in-flight wave's packed
+            #     outputs (column 0 = sampled tokens; see packed_out)
             chain_idx,  # [B] i32: last live step per row in prev wave
             ints, floats, block_tables, allowed_mask, lora, lora_idx,
             num_steps: int,
@@ -447,7 +455,7 @@ class ModelRunner:
             # chained wave (async scheduling): the input token of each row
             # is the PREVIOUS wave's final sampled token, read directly
             # from its device-resident outputs — no host round trip
-            # between decode waves
+            # between decode waves (packed layout: column 0 is tokens)
             tokens0 = jnp.take_along_axis(
                 prev_ints_out[..., 0], chain_idx[None, :], axis=0
             )[0]
@@ -1081,15 +1089,14 @@ class ModelRunner:
     def dispatch_chained_decode(self, prep: "PreparedDecode", prev_handle):
         """Enqueue the successor wave behind the in-flight one, feeding
         input tokens from its device-resident outputs."""
-        prev_ints_out, _ = prev_handle
         lora = self.lora_stacks if prep.lora_idx is not None else None
         ints, floats = self._pack_decode_inputs(prep)
-        self.caches, self.seen, ints_out, floats_out = (
+        self.caches, self.seen, packed_out = (
             self._chained_decode_fn(
                 self.params,
                 self.caches,
                 self.seen,
-                prev_ints_out,
+                prev_handle,
                 self._put(prep.chain_idx),
                 self._put(ints),
                 self._put(floats),
@@ -1103,7 +1110,7 @@ class ModelRunner:
                 prep.want_topn,
             )
         )
-        return ints_out, floats_out
+        return packed_out
 
     def _pack_decode_inputs(self, prep: "PreparedDecode"):
         """Two transfer-packed arrays (see _build_decode_fn docstring)."""
@@ -1135,7 +1142,7 @@ class ModelRunner:
             return SYNC_DISPATCH
         lora = self.lora_stacks if prep.lora_idx is not None else None
         ints, floats = self._pack_decode_inputs(prep)
-        self.caches, self.seen, ints_out, floats_out = self._decode_fn(
+        self.caches, self.seen, packed_out = self._decode_fn(
             self.params,
             self.caches,
             self.seen,
@@ -1150,7 +1157,7 @@ class ModelRunner:
             prep.num_steps,
             prep.want_topn,
         )
-        return ints_out, floats_out
+        return packed_out
 
     def wait_decode(
         self, prep: "PreparedDecode", handle
@@ -1160,15 +1167,16 @@ class ModelRunner:
         list at EOS/stop-string)."""
         if handle is SYNC_DISPATCH:
             return self.spec.run(prep)
-        ints_out, floats_out = handle
-        ints_np = np.asarray(ints_out)  # [K, B, 2+W]
-        floats_np = np.asarray(floats_out)  # [K, B, 1+W]
+        packed = np.asarray(handle)  # [K, B, 3+2W] — one fetch per wave
+        w = (packed.shape[-1] - 3) // 2
         host = _HostSamplerOutput(
-            tokens=ints_np[..., 0],
-            ranks=ints_np[..., 1],
-            topn_ids=ints_np[..., 2:],
-            logprobs=floats_np[..., 0],
-            topn_logprobs=floats_np[..., 1:],
+            tokens=packed[..., 0],
+            ranks=packed[..., 1],
+            topn_ids=packed[..., 2:2 + w],
+            logprobs=np.ascontiguousarray(
+                packed[..., 2 + w]).view(np.float32),
+            topn_logprobs=np.ascontiguousarray(
+                packed[..., 3 + w:]).view(np.float32),
         )
         return [
             [host.token(k, i) for k in range(prep.steps_per_seq[i])]
