@@ -57,14 +57,29 @@ class PromptLogprobInfo:
     @classmethod
     def from_parts(cls, parts, n: int) -> "PromptLogprobInfo":
         """Slice the device tuple from sampler.prompt_logprob_info down
-        to the ``n`` valid rows (shared by the single-runner and
-        pipeline-runner prefill paths)."""
+        to the ``n`` valid rows (pipeline-runner prefill path; the
+        single-runner path packs to one buffer — from_packed)."""
         lp, rank, tn_ids, tn_lp = parts
         return cls(
             logprobs=np.asarray(lp)[:n].tolist(),
             ranks=np.asarray(rank)[:n].tolist(),
             topn_ids=np.asarray(tn_ids)[:n].tolist(),
             topn_logprobs=np.asarray(tn_lp)[:n].tolist(),
+        )
+
+    @classmethod
+    def from_packed(cls, packed_dev, n: int) -> "PromptLogprobInfo":
+        """Unpack sampler.pack_prompt_logprob_parts — one device fetch
+        for the whole prompt-logprob row table."""
+        packed = np.asarray(packed_dev)[:n]  # [n, 2+2W]
+        w = (packed.shape[-1] - 2) // 2
+        return cls(
+            logprobs=np.ascontiguousarray(
+                packed[..., 0]).view(np.float32).tolist(),
+            ranks=packed[..., 1].tolist(),
+            topn_ids=packed[..., 2:2 + w].tolist(),
+            topn_logprobs=np.ascontiguousarray(
+                packed[..., 2 + w:]).view(np.float32).tolist(),
         )
 
 
@@ -176,6 +191,23 @@ class _HostSamplerOutput:
             ranks=np.asarray(outs.rank),
             topn_ids=np.asarray(outs.topn_ids),
             topn_logprobs=np.asarray(outs.topn_logprobs),
+        )
+
+    @staticmethod
+    def from_packed(packed_dev) -> "_HostSamplerOutput":
+        """Unpack sampler.pack_output's single buffer — ONE device
+        fetch for the whole result (decode waves and prefill samples
+        both ride this through the tunnel)."""
+        packed = np.asarray(packed_dev)  # [..., 3+2W]
+        w = (packed.shape[-1] - 3) // 2
+        return _HostSamplerOutput(
+            tokens=packed[..., 0],
+            ranks=packed[..., 1],
+            topn_ids=packed[..., 2:2 + w],
+            logprobs=np.ascontiguousarray(
+                packed[..., 2 + w]).view(np.float32),
+            topn_logprobs=np.ascontiguousarray(
+                packed[..., 3 + w:]).view(np.float32),
         )
 
     def token(self, k: int, i: int) -> "SampledToken":
@@ -424,22 +456,9 @@ class ModelRunner:
             (caches, seen, _), outs = jax.lax.scan(
                 step, (caches, seen, tokens0), jnp.arange(num_steps)
             )
-            # ONE packed result buffer (floats bitcast to i32): each
-            # device->host buffer is its own transfer at the runtime
-            # layer — and through a tunnel-attached chip, its own
-            # network round trip — so the wave's entire result comes
-            # back in a single fetch.  Layout: [tokens, rank, topn_ids
-            # (W), logprob, topn_logprobs (W)] -> [K, B, 3+2W]
-            packed_out = jnp.concatenate(
-                [outs.tokens[..., None], outs.rank[..., None],
-                 outs.topn_ids,
-                 jax.lax.bitcast_convert_type(
-                     outs.logprob, jnp.int32)[..., None],
-                 jax.lax.bitcast_convert_type(
-                     outs.topn_logprobs, jnp.int32)],
-                axis=-1,
-            )
-            return caches, seen, packed_out
+            # ONE packed result buffer per wave (sampler.pack_output):
+            # the whole wave's results come back in a single fetch
+            return caches, seen, sampler_mod.pack_output(outs)
 
         donate = (1, 2) if jax.default_backend() == "tpu" else ()
 
@@ -698,8 +717,10 @@ class ModelRunner:
             self.spec.draft_prefill(prep)
         lp_parts = None
         if prep.want_prompt_lp:
-            lp_parts = sampler_mod.prompt_logprob_info(
-                logits, self._put(prep.lp_targets)
+            lp_parts = sampler_mod.pack_prompt_logprob_parts(
+                sampler_mod.prompt_logprob_info(
+                    logits, self._put(prep.lp_targets)
+                )
             )
         if not prep.is_final:
             # mid-prompt chunk: nothing to sample, but an lp chunk's
@@ -738,24 +759,23 @@ class ModelRunner:
         self.seen = sampler_mod.update_seen(
             self.seen, jnp.asarray([prep.row_slot]), out.tokens
         )
-        return {"out": out, "lp": lp_parts}
+        return {"out": sampler_mod.pack_output(out), "lp": lp_parts}
 
     def wait_prefill(
         self, prep: "PreparedPrefill", handle
     ) -> tuple[Optional[SampledToken], Optional[PromptLogprobInfo]]:
-        """Blocking half: pull the dispatched results to host."""
+        """Blocking half: pull the dispatched results to host (one
+        fetch per packed buffer)."""
         if handle is None:
             return None, None  # mid-prompt chunk without lp accumulation
         prompt_info = None
         if handle["lp"] is not None:
-            prompt_info = PromptLogprobInfo.from_parts(
+            prompt_info = PromptLogprobInfo.from_packed(
                 handle["lp"], prep.lp_rows
             )
         if handle["out"] is None:
             return None, prompt_info  # lp chunk: table rows only
-        host = _HostSamplerOutput.from_device(
-            jax.tree.map(lambda x: x[None], handle["out"])
-        )
+        host = _HostSamplerOutput.from_packed(handle["out"][None])
         return host.token(0, 0), prompt_info
 
     def execute_prefill(
@@ -891,16 +911,14 @@ class ModelRunner:
         self.seen = sampler_mod.update_seen(
             self.seen, self._put(prep.row_slots), out.tokens
         )
-        return out
+        return sampler_mod.pack_output(out)
 
     def wait_packed_prefill(
         self, prep: "PreparedPackedPrefill", handle
     ) -> list[SampledToken]:
         """Blocking half: one SampledToken per real packed prompt, in
-        pack order."""
-        host = _HostSamplerOutput.from_device(
-            jax.tree.map(lambda x: x[None], handle)
-        )
+        pack order (one device fetch for the whole pack)."""
+        host = _HostSamplerOutput.from_packed(handle[None])
         return [host.token(0, i) for i in range(prep.num_items)]
 
     def execute_packed_prefill(
@@ -1167,17 +1185,8 @@ class ModelRunner:
         list at EOS/stop-string)."""
         if handle is SYNC_DISPATCH:
             return self.spec.run(prep)
-        packed = np.asarray(handle)  # [K, B, 3+2W] — one fetch per wave
-        w = (packed.shape[-1] - 3) // 2
-        host = _HostSamplerOutput(
-            tokens=packed[..., 0],
-            ranks=packed[..., 1],
-            topn_ids=packed[..., 2:2 + w],
-            logprobs=np.ascontiguousarray(
-                packed[..., 2 + w]).view(np.float32),
-            topn_logprobs=np.ascontiguousarray(
-                packed[..., 3 + w:]).view(np.float32),
-        )
+        # [K, B, 3+2W] — one fetch per wave
+        host = _HostSamplerOutput.from_packed(handle)
         return [
             [host.token(k, i) for k in range(prep.steps_per_seq[i])]
             for i in range(prep.num_seqs)

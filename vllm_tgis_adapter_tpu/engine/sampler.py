@@ -284,6 +284,38 @@ def sample(
 
 
 @jax.jit
+def pack_output(out: SamplerOutput) -> jax.Array:
+    """Merge a SamplerOutput into ONE int32 buffer (floats bitcast).
+
+    Each device->host buffer is its own transfer at the runtime layer —
+    through a tunnel-attached chip, its own network round trip — so the
+    five result arrays come back in a single fetch.  Layout along the
+    last axis: [tokens, rank, topn_ids (W), logprob, topn_logprobs (W)]
+    -> [..., 3+2W]; unpacked by _HostSamplerOutput.from_packed."""
+    return jnp.concatenate(
+        [out.tokens[..., None], out.rank[..., None], out.topn_ids,
+         jax.lax.bitcast_convert_type(out.logprob, jnp.int32)[..., None],
+         jax.lax.bitcast_convert_type(out.topn_logprobs, jnp.int32)],
+        axis=-1,
+    )
+
+
+@jax.jit
+def pack_prompt_logprob_parts(
+    parts: tuple[jax.Array, jax.Array, jax.Array, jax.Array],
+) -> jax.Array:
+    """Same single-fetch packing for prompt_logprob_info's row table:
+    [logprob, rank, topn_ids (W), topn_logprobs (W)] -> [T, 2+2W] i32."""
+    lp, rank, tn_ids, tn_lp = parts
+    return jnp.concatenate(
+        [jax.lax.bitcast_convert_type(lp, jnp.int32)[..., None],
+         rank[..., None], tn_ids,
+         jax.lax.bitcast_convert_type(tn_lp, jnp.int32)],
+        axis=-1,
+    )
+
+
+@jax.jit
 def update_seen(seen: jax.Array, rows: jax.Array, tokens: jax.Array) -> jax.Array:
     """Mark newly generated tokens in the seen-token presence matrix.
 
