@@ -374,9 +374,11 @@ class PipelineRunner(ModelRunner):
                 hidden = out
         prompt_info = None
         if prep.want_prompt_lp:
-            prompt_info = PromptLogprobInfo.from_parts(
-                sampler_mod.prompt_logprob_info(
-                    logits, jnp.asarray(prep.lp_targets)
+            prompt_info = PromptLogprobInfo.from_packed(
+                sampler_mod.pack_prompt_logprob_parts(
+                    sampler_mod.prompt_logprob_info(
+                        logits, jnp.asarray(prep.lp_targets)
+                    )
                 ),
                 prep.lp_rows,
             )
@@ -412,8 +414,8 @@ class PipelineRunner(ModelRunner):
         self.seen = sampler_mod.update_seen(
             self.seen, jnp.asarray([prep.row_slot]), out.tokens
         )
-        host = _HostSamplerOutput.from_device(
-            jax.tree.map(lambda x: x[None], out)
+        host = _HostSamplerOutput.from_packed(
+            sampler_mod.pack_output(out)[None]
         )
         return host.token(0, 0), prompt_info
 
@@ -559,29 +561,23 @@ class PipelineRunner(ModelRunner):
                 chain["outs"].append(out)
                 chain["tokens"] = out.tokens  # stays on device
 
-        # pack each chain's K results ON DEVICE into one int and one
-        # float array, so the host pulls 2 buffers per chain instead of
-        # 5 per (chain, step)
-        ints_np, floats_np = [], []
+        # pack every chain's K results ON DEVICE into one buffer
+        # (sampler.pack_output) and concatenate across chains there
+        # too: the host pulls ONE buffer per wave instead of 5 per
+        # (chain, step)
+        packed_dev = []
         for chain in chains:
             outs = chain["outs"]
-            ints_np.append(np.asarray(jnp.concatenate([
-                jnp.stack([o.tokens for o in outs])[..., None],
-                jnp.stack([o.rank for o in outs])[..., None],
-                jnp.stack([o.topn_ids for o in outs]),
-            ], axis=-1)))  # [K, mb, 2+W]
-            floats_np.append(np.asarray(jnp.concatenate([
-                jnp.stack([o.logprob for o in outs])[..., None],
-                jnp.stack([o.topn_logprobs for o in outs]),
-            ], axis=-1)))  # [K, mb, 1+W]
-        ints_all = np.concatenate(ints_np, axis=1)  # [K, B, 2+W]
-        floats_all = np.concatenate(floats_np, axis=1)
-        host = _HostSamplerOutput(
-            tokens=ints_all[..., 0],
-            ranks=ints_all[..., 1],
-            topn_ids=ints_all[..., 2:],
-            logprobs=floats_all[..., 0],
-            topn_logprobs=floats_all[..., 1:],
+            stacked = sampler_mod.SamplerOutput(
+                tokens=jnp.stack([o.tokens for o in outs]),
+                logprob=jnp.stack([o.logprob for o in outs]),
+                rank=jnp.stack([o.rank for o in outs]),
+                topn_ids=jnp.stack([o.topn_ids for o in outs]),
+                topn_logprobs=jnp.stack([o.topn_logprobs for o in outs]),
+            )
+            packed_dev.append(sampler_mod.pack_output(stacked))
+        host = _HostSamplerOutput.from_packed(
+            jnp.concatenate(packed_dev, axis=1)  # [K, B, 3+2W]
         )
         return [
             [host.token(k, i) for k in range(prep.steps_per_seq[i])]

@@ -55,19 +55,6 @@ class PromptLogprobInfo:
     topn_logprobs: list[list[float]]
 
     @classmethod
-    def from_parts(cls, parts, n: int) -> "PromptLogprobInfo":
-        """Slice the device tuple from sampler.prompt_logprob_info down
-        to the ``n`` valid rows (pipeline-runner prefill path; the
-        single-runner path packs to one buffer — from_packed)."""
-        lp, rank, tn_ids, tn_lp = parts
-        return cls(
-            logprobs=np.asarray(lp)[:n].tolist(),
-            ranks=np.asarray(rank)[:n].tolist(),
-            topn_ids=np.asarray(tn_ids)[:n].tolist(),
-            topn_logprobs=np.asarray(tn_lp)[:n].tolist(),
-        )
-
-    @classmethod
     def from_packed(cls, packed_dev, n: int) -> "PromptLogprobInfo":
         """Unpack sampler.pack_prompt_logprob_parts — one device fetch
         for the whole prompt-logprob row table."""
@@ -182,16 +169,6 @@ class _HostSamplerOutput:
     ranks: "np.ndarray"
     topn_ids: "np.ndarray"  # [K, B, W]
     topn_logprobs: "np.ndarray"
-
-    @staticmethod
-    def from_device(outs) -> "_HostSamplerOutput":
-        return _HostSamplerOutput(
-            tokens=np.asarray(outs.tokens),
-            logprobs=np.asarray(outs.logprob),
-            ranks=np.asarray(outs.rank),
-            topn_ids=np.asarray(outs.topn_ids),
-            topn_logprobs=np.asarray(outs.topn_logprobs),
-        )
 
     @staticmethod
     def from_packed(packed_dev) -> "_HostSamplerOutput":

@@ -175,6 +175,25 @@ def _rejection_core(
     return emitted, accepted
 
 
+@jax.jit
+def _pack_spec_results(emitted, accepted, lp, rank, topn_ids, topn_lp):
+    """Merge the verify outputs into ONE int32 buffer so the whole spec
+    dispatch result comes back in a single device fetch: the standard
+    sampler.pack_output layout ([B, K, 3+2W]) plus a trailing
+    broadcast `accepted` column -> [B, K, 4+2W].  Unpacked by
+    _HostSamplerOutput.from_packed on [..., :-1]."""
+    from vllm_tgis_adapter_tpu.engine import sampler as sampler_mod
+
+    packed = sampler_mod.pack_output(sampler_mod.SamplerOutput(
+        tokens=emitted, logprob=lp, rank=rank,
+        topn_ids=topn_ids, topn_logprobs=topn_lp,
+    ))
+    acc = jnp.broadcast_to(
+        accepted.astype(jnp.int32)[:, None, None], (*emitted.shape, 1)
+    )
+    return jnp.concatenate([packed, acc], axis=-1)
+
+
 def plain_greedy(params) -> bool:  # noqa: ANN001
     """Greedy rows speculation reproduces EXACTLY (match-test verify)."""
     return (
@@ -626,12 +645,16 @@ class SpeculativeDecoder:
                 tables, lora, lora_idx,
             )
 
-        emitted = np.asarray(emitted)  # [B, K]
-        accepted = np.asarray(accepted)
-        lp = np.asarray(lp)
-        rank = np.asarray(rank)
-        topn_ids = np.asarray(topn_ids)
-        topn_lp = np.asarray(topn_lp)
+        from vllm_tgis_adapter_tpu.engine.runner import _HostSamplerOutput
+
+        packed = np.asarray(_pack_spec_results(
+            emitted, accepted, lp, rank, topn_ids, topn_lp
+        ))  # [B, K, 4+2W] — one fetch for the whole dispatch
+        host = _HostSamplerOutput.from_packed(packed[..., :-1])
+        emitted, rank = host.tokens, host.ranks
+        topn_ids, lp = host.topn_ids, host.logprobs
+        topn_lp = host.topn_logprobs
+        accepted = packed[..., 0, -1]  # [B] broadcast column
 
         out: list[list[SampledToken]] = []
         batch_proposed = batch_accepted = 0
