@@ -331,6 +331,10 @@ def run_bench(on_tpu: bool) -> dict:
         "attention_backend": (
             "pallas" if attn_ops._use_pallas() else "xla"
         ),
+        "decode_kernel": (
+            os.environ.get("PALLAS_DECODE_KERNEL", "folded")
+            if attn_ops._use_pallas() else None
+        ),
         "device_kind": device.device_kind,
         "mfu": mfu,
         "model_gflop_per_tok": round(flops_per_tok / 1e9, 3),
