@@ -152,44 +152,63 @@ def _filter_top_k_top_p_typical(
     scaled: jax.Array,  # [B, V] temperature-scaled logits
     t: SamplingTensors,
 ) -> jax.Array:
-    """Mask logits outside the top-k / nucleus / typical sets (one sort)."""
+    """Mask logits outside the top-k / nucleus / typical sets.
+
+    Each family's full-vocab sort is gated by its own lax.cond, so a
+    batch only pays for the filters some row actually enables."""
     b, v = scaled.shape
     probs = jax.nn.softmax(scaled, axis=-1)
 
     # ---- top-k + top-p share one descending sort of the probabilities
-    order = jnp.argsort(-probs, axis=-1)  # [B, V] desc
-    sorted_probs = jnp.take_along_axis(probs, order, axis=-1)
-    positions = jnp.arange(v, dtype=jnp.int32)[None, :]
+    def topk_topp_mask(keep):
+        order = jnp.argsort(-probs, axis=-1)  # [B, V] desc
+        sorted_probs = jnp.take_along_axis(probs, order, axis=-1)
+        positions = jnp.arange(v, dtype=jnp.int32)[None, :]
 
-    k = jnp.where(t.top_k <= 0, v, t.top_k)[:, None]
-    keep_sorted = positions < k
+        k = jnp.where(t.top_k <= 0, v, t.top_k)[:, None]
+        keep_sorted = positions < k
 
-    cumulative = jnp.cumsum(sorted_probs, axis=-1)
-    # keep tokens until the cumulative mass *before* them reaches top_p
-    exclusive = cumulative - sorted_probs
-    keep_sorted &= exclusive < t.top_p[:, None]
-    keep_sorted = keep_sorted.at[:, 0].set(True)  # never drop the best token
+        cumulative = jnp.cumsum(sorted_probs, axis=-1)
+        # keep tokens until the cumulative mass *before* them reaches
+        # top_p
+        exclusive = cumulative - sorted_probs
+        keep_sorted &= exclusive < t.top_p[:, None]
+        # never drop the best token
+        keep_sorted = keep_sorted.at[:, 0].set(True)
 
-    keep = jnp.zeros((b, v), bool).at[
-        jnp.arange(b)[:, None], order
-    ].set(keep_sorted)
+        return keep & jnp.zeros((b, v), bool).at[
+            jnp.arange(b)[:, None], order
+        ].set(keep_sorted)
+
+    keep = jax.lax.cond(
+        jnp.any(t.top_k > 0) | jnp.any(t.top_p < 1.0),
+        topk_topp_mask, lambda k: k, jnp.ones((b, v), bool),
+    )
 
     # ---- typical-p: rank tokens by |surprisal - entropy| ascending, keep
-    # the smallest set with cumulative prob >= typical_p
-    logp = jax.nn.log_softmax(scaled, axis=-1)
-    entropy = -jnp.sum(jnp.where(probs > 0, probs * logp, 0.0), axis=-1,
-                       keepdims=True)
-    shifted = jnp.abs(-logp - entropy)
-    t_order = jnp.argsort(shifted, axis=-1)
-    t_sorted_probs = jnp.take_along_axis(probs, t_order, axis=-1)
-    t_cum = jnp.cumsum(t_sorted_probs, axis=-1)
-    t_keep_sorted = (t_cum - t_sorted_probs) < t.typical_p[:, None]
-    t_keep_sorted = t_keep_sorted.at[:, 0].set(True)
-    t_keep = jnp.zeros((b, v), bool).at[
-        jnp.arange(b)[:, None], t_order
-    ].set(t_keep_sorted)
-    typical_active = (t.typical_p < 1.0)[:, None]
-    keep &= jnp.where(typical_active, t_keep, True)
+    # the smallest set with cumulative prob >= typical_p.  Its own sort
+    # is gated separately — top-k/top-p batches are common, typical-p
+    # rare, and the lax.cond skips the second full-vocab sort entirely
+    # when no row uses it
+    def typical_mask(keep):
+        logp = jax.nn.log_softmax(scaled, axis=-1)
+        entropy = -jnp.sum(jnp.where(probs > 0, probs * logp, 0.0),
+                           axis=-1, keepdims=True)
+        shifted = jnp.abs(-logp - entropy)
+        t_order = jnp.argsort(shifted, axis=-1)
+        t_sorted_probs = jnp.take_along_axis(probs, t_order, axis=-1)
+        t_cum = jnp.cumsum(t_sorted_probs, axis=-1)
+        t_keep_sorted = (t_cum - t_sorted_probs) < t.typical_p[:, None]
+        t_keep_sorted = t_keep_sorted.at[:, 0].set(True)
+        t_keep = jnp.zeros((b, v), bool).at[
+            jnp.arange(b)[:, None], t_order
+        ].set(t_keep_sorted)
+        typical_active = (t.typical_p < 1.0)[:, None]
+        return keep & jnp.where(typical_active, t_keep, True)
+
+    keep = jax.lax.cond(
+        jnp.any(t.typical_p < 1.0), typical_mask, lambda k: k, keep
+    )
 
     return jnp.where(keep, scaled, NEG_INF)
 
