@@ -232,7 +232,7 @@ def run_bench(on_tpu: bool) -> dict:
     from vllm_tgis_adapter_tpu.engine.scheduler import PackedPrefillPlan
 
     pack_stats = {"packed_dispatches": 0, "packed_prompts": 0,
-                  "chained_dispatches": 0}
+                  "chained_dispatches": 0, "host_syncs": 0}
     orig_schedule = engine.scheduler.schedule
 
     def counting_schedule(**kwargs):
@@ -250,6 +250,17 @@ def run_bench(on_tpu: bool) -> dict:
         return orig_chained(plan, prepared, prev_handle)
 
     engine.dispatch_chained_step = counting_chained
+
+    # host_syncs counts blocking result pulls (wait_step) — through a
+    # network-attached chip each costs one round trip, so tokens-per-
+    # sync is the tunnel-relevant efficiency metric
+    orig_wait = engine.wait_step
+
+    def counting_wait(plan, prepared, handle):
+        pack_stats["host_syncs"] += 1
+        return orig_wait(plan, prepared, handle)
+
+    engine.wait_step = counting_wait
 
     # matmul weight elements -> decode FLOPs/token (2*N MACs) for MFU
     matmul_elems = sum(
@@ -308,6 +319,11 @@ def run_bench(on_tpu: bool) -> dict:
 
     async def both_passes():
         await run_pass("warm", min(n_requests, 2 * max_seqs), output_len)
+        # counters report the TIMED pass only (same scope as
+        # produced_tok/elapsed) — the warm pass would otherwise skew
+        # the tokens-per-sync and packing ratios
+        for key in pack_stats:
+            pack_stats[key] = 0
         produced, elapsed = await run_pass("timed", n_requests, output_len)
         await aengine.stop()
         return produced, elapsed

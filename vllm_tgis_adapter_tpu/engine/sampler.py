@@ -160,7 +160,7 @@ def _filter_top_k_top_p_typical(
     probs = jax.nn.softmax(scaled, axis=-1)
 
     # ---- top-k + top-p share one descending sort of the probabilities
-    def topk_topp_mask(keep):
+    def topk_topp_mask():
         order = jnp.argsort(-probs, axis=-1)  # [B, V] desc
         sorted_probs = jnp.take_along_axis(probs, order, axis=-1)
         positions = jnp.arange(v, dtype=jnp.int32)[None, :]
@@ -176,13 +176,13 @@ def _filter_top_k_top_p_typical(
         # never drop the best token
         keep_sorted = keep_sorted.at[:, 0].set(True)
 
-        return keep & jnp.zeros((b, v), bool).at[
+        return jnp.zeros((b, v), bool).at[
             jnp.arange(b)[:, None], order
         ].set(keep_sorted)
 
     keep = jax.lax.cond(
         jnp.any(t.top_k > 0) | jnp.any(t.top_p < 1.0),
-        topk_topp_mask, lambda k: k, jnp.ones((b, v), bool),
+        topk_topp_mask, lambda: jnp.ones((b, v), bool),
     )
 
     # ---- typical-p: rank tokens by |surprisal - entropy| ascending, keep
