@@ -319,9 +319,11 @@ def run_bench(on_tpu: bool) -> dict:
 
     async def both_passes():
         await run_pass("warm", min(n_requests, 2 * max_seqs), output_len)
-        # counters report the TIMED pass only (same scope as
+        # counters report the TIMED pass (same scope as
         # produced_tok/elapsed) — the warm pass would otherwise skew
-        # the tokens-per-sync and packing ratios
+        # the tokens-per-sync and packing ratios.  A warm-pass tail
+        # wave still in flight at the reset can leak ±1-2 counts;
+        # negligible against the timed pass's hundreds
         for key in pack_stats:
             pack_stats[key] = 0
         produced, elapsed = await run_pass("timed", n_requests, output_len)

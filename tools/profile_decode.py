@@ -215,10 +215,12 @@ def main() -> None:
 
     from vllm_tgis_adapter_tpu.ops import pallas_attention
 
-    emit(f"attn_pallas_{n_calls}calls", attn_loop(
-        lambda q, kc, vc, bt, cl: pallas_attention.paged_decode_attention(
-            q, kc, vc, bt, cl, block_size=16, scale=0.125,
-            interpret=allow_cpu)))
+    for variant in ("folded", "perhead"):
+        emit(f"attn_pallas_{variant}_{n_calls}calls", attn_loop(
+            lambda q, kc, vc, bt, cl, v=variant:
+            pallas_attention.paged_decode_attention(
+                q, kc, vc, bt, cl, block_size=16, scale=0.125,
+                interpret=allow_cpu, variant=v)))
     emit(f"attn_xla_{n_calls}calls", attn_loop(
         lambda q, kc, vc, bt, cl: attn_ops.paged_decode_attention_xla(
             q, kc, vc, bt, cl, 16, 0.125)))
