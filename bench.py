@@ -195,11 +195,12 @@ def run_bench(on_tpu: bool) -> dict:
                                  cache_dtype=dtype),
         scheduler_config=SchedulerConfig(
             max_num_seqs=max_seqs,
-            # buckets beyond max_len exist for PACKED prefill: the
-            # tunnel chip pays ~64ms per dispatch, so packing 8 prompts
-            # per dispatch (1024 bucket) instead of 2 (272) cuts the
-            # prefill dispatch count 4x (scheduler._extend_pack)
-            prefill_buckets=(prompt_len, max_len, 512, 1024),
+            # the 1024 bucket exists for PACKED prefill: the tunnel
+            # chip pays ~64ms per dispatch, so packing 8 prompts per
+            # dispatch instead of 2 cuts the prefill dispatch count 4x
+            # (scheduler._extend_pack); no intermediate 512 bucket —
+            # every compiled shape costs real window time
+            prefill_buckets=(prompt_len, max_len, 1024),
             # fused K-step decode: one dispatch (and one result transfer)
             # per K tokens per wave.  The tunnel-backed chip pays a
             # network round trip per dispatch, so the TPU default fuses
@@ -484,7 +485,10 @@ def main() -> None:
         probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", 300))
         on_tpu = False if force_cpu else _probe_tpu(probe_timeout)
         if on_tpu:
-            tpu_timeout = float(os.environ.get("BENCH_TPU_TIMEOUT", 1500))
+            # generous default: the round-5 config compiles more shapes
+            # (3 prefill buckets incl. the 1024 packing bucket, batch
+            # 64) and the persistent cache may be cold on a fresh chip
+            tpu_timeout = float(os.environ.get("BENCH_TPU_TIMEOUT", 2100))
             child_line, tpu_error = _run_tpu_bench_subprocess(tpu_timeout)
             if child_line is not None:
                 print(json.dumps(child_line), flush=True)

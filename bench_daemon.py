@@ -129,7 +129,7 @@ def _run_bench(attention_backend: str | None) -> dict | None:
     env.pop("JAX_PLATFORMS", None)
     # the daemon just probed; don't spend window time on a long re-probe
     env.setdefault("BENCH_PROBE_TIMEOUT", "90")
-    env.setdefault("BENCH_TPU_TIMEOUT", "1200")
+    env.setdefault("BENCH_TPU_TIMEOUT", "2100")
     tag = f"bench[{attention_backend or 'default'}]"
     if attention_backend == "int8":
         # weight-only int8 variant rides the default attention backend
@@ -137,7 +137,7 @@ def _run_bench(attention_backend: str | None) -> dict | None:
     elif attention_backend:
         env["ATTENTION_BACKEND"] = attention_backend
     rc, out, err = _run_bounded(
-        [sys.executable, os.path.join(REPO, "bench.py")], 1500, env, tag)
+        [sys.executable, os.path.join(REPO, "bench.py")], 2400, env, tag)
     parsed = _last_json_line(out)
     if parsed is None:
         _log(f"{tag}: no JSON line (rc={rc}) stderr tail: {err[-200:]}")
