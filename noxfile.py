@@ -37,6 +37,18 @@ def tpu_tests(session: nox.Session) -> None:
 
 
 @nox.session(python="3.12")
+def obs_check(session: nox.Session) -> None:
+    """Docs ↔ metrics-registry drift gate: boot the HTTP server
+    in-process, scrape /metrics, fail if any metric documented in
+    docs/OBSERVABILITY.md is absent from the scrape."""
+    session.install("-e", ".[tests]")
+    session.run(
+        "python", "tools/obs_check.py",
+        env={"JAX_PLATFORMS": "cpu"},
+    )
+
+
+@nox.session(python="3.12")
 def lint(session: nox.Session) -> None:
     session.install("ruff")
     session.run("ruff", "check", "vllm_tgis_adapter_tpu", "tests")

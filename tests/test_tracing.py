@@ -146,14 +146,134 @@ def test_request_span_exported_end_to_end(tiny_model_dir, collector):
     asyncio.run(scenario())
 
     assert received, "no OTLP batch reached the collector"
-    path, payload = received[0]
-    assert path == "/v1/traces"
-    spans = payload["resourceSpans"][0]["scopeSpans"][0]["spans"]
-    span = next(s for s in spans if s["traceId"] == trace_id)
+    assert all(path == "/v1/traces" for path, _ in received)
+    spans = [
+        s
+        for _, payload in received
+        for s in payload["resourceSpans"][0]["scopeSpans"][0]["spans"]
+    ]
+    span = next(
+        s for s in spans
+        if s["traceId"] == trace_id and s["name"] == "llm_request"
+    )
     assert span["parentSpanId"] == parent
-    assert span["name"] == "llm_request"
     attrs = {a["key"]: a["value"] for a in span["attributes"]}
     assert attrs["gen_ai.request.id"]["stringValue"] == "traced-1"
     assert attrs["gen_ai.usage.prompt_tokens"]["intValue"] == "7"
     assert attrs["gen_ai.usage.completion_tokens"]["intValue"] == "5"
     assert int(span["endTimeUnixNano"]) > int(span["startTimeUnixNano"])
+
+    # phase child spans: same trace, parented under the request span,
+    # time-ordered and contained within the request span's window
+    children = {
+        s["name"]: s
+        for s in spans
+        if s.get("parentSpanId") == span["spanId"]
+    }
+    assert {"queue", "prefill", "decode"} <= set(children)
+    for child in children.values():
+        assert child["traceId"] == trace_id
+        assert child["kind"] == 1  # SPAN_KIND_INTERNAL
+        assert int(child["startTimeUnixNano"]) >= int(
+            span["startTimeUnixNano"]
+        )
+        assert int(child["endTimeUnixNano"]) <= int(span["endTimeUnixNano"])
+    assert int(children["queue"]["endTimeUnixNano"]) <= int(
+        children["prefill"]["startTimeUnixNano"]
+    )
+    assert int(children["prefill"]["endTimeUnixNano"]) <= int(
+        children["decode"]["startTimeUnixNano"]
+    )
+
+
+def test_http_completions_propagate_trace_context(
+    tiny_model_dir, collector
+):
+    """A traceparent header on /v1/completions reaches the engine: the
+    request span joins the caller's trace (the same propagation the gRPC
+    server does via invocation metadata)."""
+    import argparse
+
+    endpoint, received = collector
+
+    from vllm_tgis_adapter_tpu.engine.async_llm import AsyncLLMEngine
+    from vllm_tgis_adapter_tpu.engine.config import (
+        CacheConfig,
+        EngineConfig,
+        LoRAConfig,
+        ModelConfig,
+        ParallelConfig,
+        SchedulerConfig,
+    )
+    from vllm_tgis_adapter_tpu.http import HttpRequest, build_http_server
+
+    mcfg = ModelConfig.from_pretrained(tiny_model_dir, dtype="float32")
+    config = EngineConfig(
+        model_config=mcfg,
+        cache_config=CacheConfig(block_size=16, num_blocks=32,
+                                 cache_dtype=mcfg.dtype),
+        scheduler_config=SchedulerConfig(max_num_seqs=2,
+                                         prefill_buckets=(32,)),
+        parallel_config=ParallelConfig(),
+        lora_config=LoRAConfig(),
+        otlp_traces_endpoint=endpoint,
+    )
+    engine = AsyncLLMEngine.from_config(config)
+    args = argparse.Namespace(
+        served_model_name=None, model=tiny_model_dir, api_key=None,
+        root_path=None, profile_dir=None,
+    )
+    app = build_http_server(args, engine)
+    trace_id = "1bf7651916cd43dd8448eb211c80319c"
+
+    async def scenario() -> int:
+        response = await app.dispatch(HttpRequest(
+            "POST", "/v1/completions",
+            {"traceparent": f"00-{trace_id}-b7ad6b7169203331-01"},
+            json.dumps({
+                "prompt": "Hi", "max_tokens": 3, "temperature": 0.0,
+            }).encode(),
+        ))
+        await engine.stop()
+        return response.status
+
+    assert asyncio.run(scenario()) == 200
+    spans = [
+        s
+        for _, payload in received
+        for s in payload["resourceSpans"][0]["scopeSpans"][0]["spans"]
+    ]
+    assert any(
+        s["name"] == "llm_request" and s["traceId"] == trace_id
+        for s in spans
+    ), "HTTP traceparent did not reach the engine span"
+
+
+def test_exporter_flushes_partial_batch_on_shutdown(collector):
+    """Spans still queued at shutdown — fewer than _EXPORT_BATCH, some
+    racing the sentinel — must all reach the collector before close."""
+    import time
+
+    from vllm_tgis_adapter_tpu.tracing import OtlpJsonExporter, Span
+
+    endpoint, received = collector
+    exporter = OtlpJsonExporter(endpoint)
+    now = time.time_ns()
+    for i in range(5):
+        exporter.export(
+            Span(
+                name=f"s{i}",
+                trace_id="ab" * 16,
+                span_id=f"{i:016x}",
+                parent_span_id=None,
+                start_ns=now,
+                end_ns=now + 1,
+            )
+        )
+    exporter.shutdown()
+    names = {
+        s["name"]
+        for _, payload in received
+        for s in payload["resourceSpans"][0]["scopeSpans"][0]["spans"]
+    }
+    assert names == {f"s{i}" for i in range(5)}, "spans dropped on close"

@@ -1,0 +1,118 @@
+"""Docs ↔ registry drift gate (nox -s obs_check).
+
+Boots the real HTTP server in-process against a tiny fixture model,
+scrapes ``/metrics`` over a real socket, and fails if any metric name
+documented in docs/OBSERVABILITY.md is missing from the scrape.  Run
+directly with ``JAX_PLATFORMS=cpu python tools/obs_check.py``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import re
+import socket
+import sys
+import tempfile
+import urllib.request
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def documented_metrics(doc_path: Path) -> set[str]:
+    """Backticked ``tgis_tpu_*`` names from the observability doc
+    (placeholder suffixes like ``pp{N}`` never name a whole metric)."""
+    text = doc_path.read_text()
+    return {
+        name
+        for name in re.findall(r"`(tgis_tpu_[a-z0-9_]+)`", text)
+    }
+
+
+async def scrape_metrics() -> str:
+    from tests.fixture_models import build_tiny_llama
+
+    from vllm_tgis_adapter_tpu.engine.async_llm import AsyncLLMEngine
+    from vllm_tgis_adapter_tpu.engine.config import EngineConfig
+    from vllm_tgis_adapter_tpu.http import build_http_server, run_http_server
+    from vllm_tgis_adapter_tpu.tgis_utils.args import (
+        make_parser,
+        postprocess_tgis_args,
+    )
+
+    model_dir = tempfile.mkdtemp(prefix="obs-check-model-")
+    build_tiny_llama(model_dir)
+
+    sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    sock.bind(("127.0.0.1", 0))
+    port = sock.getsockname()[1]
+
+    old_argv = sys.argv
+    sys.argv = [
+        "obs_check", "--model", model_dir, "--max-model-len", "512",
+        "--dtype", "float32", "--max-num-seqs", "4",
+        "--port", str(port),
+    ]
+    try:
+        args = postprocess_tgis_args(make_parser().parse_args())
+    finally:
+        sys.argv = old_argv
+
+    engine = AsyncLLMEngine.from_config(EngineConfig.from_args(args))
+    await engine.start()
+    app = build_http_server(args, engine)
+    server_task = asyncio.create_task(
+        run_http_server(args, engine, app, sock)
+    )
+    try:
+        for _ in range(50):
+            await asyncio.sleep(0.1)
+            try:
+                body = await asyncio.to_thread(
+                    lambda: urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/metrics", timeout=5
+                    ).read()
+                )
+                return body.decode()
+            except OSError:
+                continue
+        raise RuntimeError("HTTP server never became scrapeable")
+    finally:
+        server_task.cancel()
+        try:
+            await server_task
+        except (asyncio.CancelledError, Exception):  # noqa: BLE001
+            pass
+        await engine.stop()
+
+
+def main() -> int:
+    documented = documented_metrics(REPO_ROOT / "docs" / "OBSERVABILITY.md")
+    if not documented:
+        print("obs_check: no metrics documented — parse failure?")
+        return 1
+    scraped = asyncio.run(scrape_metrics())
+    missing = sorted(
+        name for name in documented if name not in scraped
+    )
+    if missing:
+        print(
+            "obs_check: metrics documented in docs/OBSERVABILITY.md but "
+            "missing from the /metrics scrape:"
+        )
+        for name in missing:
+            print(f"  {name}")
+        return 1
+    print(
+        f"obs_check: all {len(documented)} documented metrics present "
+        "on /metrics"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

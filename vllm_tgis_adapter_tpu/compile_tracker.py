@@ -1,0 +1,111 @@
+"""XLA recompile tracker: makes every compile-cache miss observable.
+
+On TPU a fresh XLA/Mosaic compile costs 20-40s of serving latency, so the
+whole scheduler is built around bounded shape buckets (SURVEY.md §7).
+This module closes the loop: each jitted entry point the runner dispatches
+through is wrapped so a compile-cache miss is recorded as
+
+* ``tgis_tpu_xla_recompile_total{fn, shape}`` — which program compiled and
+  the (bucket, batch, steps) shape that triggered it,
+* ``tgis_tpu_xla_compile_seconds`` — how long the compiling dispatch took,
+* one WARNING log line per novel shape — a shape appearing *after* warmup
+  means the bucket discipline leaked.
+
+Miss detection uses the jitted function's executable-cache size
+(``PjitFunction._cache_size``), which has been stable across JAX releases;
+when a runtime does not expose it the wrapper degrades to a transparent
+passthrough rather than guessing.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+from vllm_tgis_adapter_tpu import metrics
+from vllm_tgis_adapter_tpu.logging import init_logger
+
+logger = init_logger(__name__)
+
+# process-global view across engines/replicas (dp replicas share one
+# metrics registry anyway); guarded because step loops run in worker
+# threads
+_lock = threading.Lock()
+_seen_shapes: set[tuple[str, str]] = set()
+_total_recompiles = 0
+
+
+def record_compile(fn_name: str, shape: str, seconds: float) -> None:
+    """Fold one observed compile into the counters (also the hook tests
+    and non-jit compile sites can feed directly)."""
+    global _total_recompiles
+    with _lock:
+        novel = (fn_name, shape) not in _seen_shapes
+        if novel:
+            _seen_shapes.add((fn_name, shape))
+            metrics.xla_compiled_shapes.set(len(_seen_shapes))
+        _total_recompiles += 1
+    metrics.xla_recompile_total.labels(fn=fn_name, shape=shape).inc()
+    metrics.xla_compile_seconds.observe(seconds)
+    if novel:
+        logger.warning(
+            "XLA compiled novel shape: fn=%s shape=%s (%.2fs); shapes "
+            "appearing after warmup mean a bucket leak",
+            fn_name, shape, seconds,
+        )
+
+
+def num_shapes() -> int:
+    with _lock:
+        return len(_seen_shapes)
+
+
+def total_recompiles() -> int:
+    with _lock:
+        return _total_recompiles
+
+
+def reset() -> None:
+    """Test hook: forget seen shapes (Prometheus counters keep history)."""
+    global _total_recompiles
+    with _lock:
+        _seen_shapes.clear()
+        _total_recompiles = 0
+
+
+def track_jit(
+    name: str,
+    fn: Callable,
+    label: Optional[Callable[[tuple, dict], str]] = None,
+) -> Callable:
+    """Wrap a jitted callable so cache misses are recorded.
+
+    ``label(args, kwargs)`` renders the dispatch-shape label for a miss
+    (e.g. ``"tokens=512"``); it runs only when a compile actually
+    happened, so it can be as lazy as it likes.  Without a usable cache
+    probe the original function is returned unchanged.
+    """
+    cache_size = getattr(fn, "_cache_size", None)
+    if cache_size is None:
+        logger.debug(
+            "jit cache probe unavailable for %s; recompile tracking off",
+            name,
+        )
+        return fn
+
+    def tracked(*args, **kwargs):  # noqa: ANN002, ANN003, ANN202
+        before = cache_size()
+        t0 = time.perf_counter()
+        out = fn(*args, **kwargs)
+        if cache_size() > before:
+            shape = ""
+            if label is not None:
+                try:
+                    shape = label(args, kwargs)
+                except Exception:  # noqa: BLE001 — telemetry must not raise
+                    shape = "?"
+            record_compile(name, shape, time.perf_counter() - t0)
+        return out
+
+    return tracked

@@ -405,6 +405,30 @@ class AsyncLLMEngine:
                 line += (
                     f", spec acceptance: {100 * accepted / proposed:.1f}%"
                 )
+            # step-level telemetry mirror (metrics.step_snapshot /
+            # compile_tracker): the SAME values the gauges export, so the
+            # log line and /metrics can never tell different stories.
+            # Collection happens in the engine core unconditionally —
+            # --disable-log-stats gates only this line (the invariant
+            # documented at metrics.py update_engine_gauges).
+            from vllm_tgis_adapter_tpu import compile_tracker, metrics
+
+            snap = metrics.step_snapshot
+            if snap.decode_steps:
+                line += (
+                    f", decode occupancy: {100 * snap.decode_occupancy:.0f}%"
+                )
+            if snap.prefill_steps:
+                line += (
+                    ", prefill padding: "
+                    f"{100 * snap.prefill_padding_waste:.0f}%"
+                )
+            shapes = compile_tracker.num_shapes()
+            if shapes:
+                line += (
+                    f", XLA shapes: {shapes} "
+                    f"({compile_tracker.total_recompiles()} compiles)"
+                )
             logger.info("Engine stats: %s", line)
 
     # ------------------------------------------------------------- step loop

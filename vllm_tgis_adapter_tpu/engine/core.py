@@ -403,6 +403,7 @@ class LLMEngine:
         k_host, v_host = self.runner.extract_kv(slots)
         seq.swapped = (k_host, v_host, n, nbytes)
         self._swap_used += nbytes
+        seq.metrics.events.append(("swap_out", time.time_ns()))
         metrics.kv_swap_out_total.inc()
         # inc/dec (not set): dp replicas share the process-global gauge,
         # so absolute sets from different replicas would clobber
@@ -431,6 +432,7 @@ class LLMEngine:
             self.runner.reseed_seen_row(seq.slot, seq.all_token_ids)
             seq.swapped = None
             self._swap_used -= nbytes
+            seq.metrics.events.append(("swap_in", time.time_ns()))
             metrics.kv_swap_in_total.inc()
             metrics.kv_swap_used_bytes.dec(nbytes)
             logger.info("restored request %s from host swap (%d tokens)",
@@ -639,7 +641,37 @@ class LLMEngine:
             prepared = self.runner.prepare_prefill(plan)
         else:
             prepared = self.runner.prepare_decode(plan)
+        self._observe_plan(plan, prepared)
         return outputs, plan, prepared
+
+    @staticmethod
+    def _observe_plan(plan, prepared) -> None:
+        """Step-level telemetry (metrics.py): batch occupancy / padding
+        waste gauges for this dispatch's shape, plus the plan→commit
+        timestamp the commit phase turns into a step-duration sample."""
+        try:
+            if isinstance(plan, PackedPrefillPlan):
+                metrics.observe_prefill_plan(
+                    real_tokens=prepared.total_tokens,
+                    bucket=plan.bucket_len,
+                    num_prompts=len(plan.items),
+                )
+            elif isinstance(plan, PrefillPlan):
+                metrics.observe_prefill_plan(
+                    real_tokens=len(plan.token_ids),
+                    bucket=plan.bucket_len,
+                    num_prompts=1,
+                )
+            else:
+                metrics.observe_decode_plan(
+                    num_seqs=len(plan.seqs),
+                    batch_bucket=plan.batch_bucket,
+                    num_steps=plan.num_steps,
+                )
+        except Exception:  # pragma: no cover — metrics are best-effort
+            logger.debug("step metric observation failed", exc_info=True)
+        if prepared is not None:
+            prepared._obs_plan_t0 = time.perf_counter()  # noqa: SLF001
 
     def execute_step(self, plan, prepared):
         """Phase 2 (device, lock-free): runs only against the snapshot and
@@ -685,9 +717,9 @@ class LLMEngine:
         plan = self.scheduler.schedule_chained(prev_plan)
         if plan is None:
             return None
-        return plan, self.runner.prepare_chained_decode(
-            plan, prev_prepared
-        )
+        prepared = self.runner.prepare_chained_decode(plan, prev_prepared)
+        self._observe_plan(plan, prepared)
+        return plan, prepared
 
     def dispatch_chained_step(self, plan, prepared, prev_handle):  # noqa: ARG002
         """Phase 2a' (lock-free): enqueue the successor wave behind the
@@ -709,6 +741,13 @@ class LLMEngine:
     def commit_step(self, plan, result, prepared=None) -> list[RequestOutput]:
         """Phase 3 (host, engine lock held): fold sampled tokens back into
         sequences; requests aborted mid-dispatch are skipped here."""
+        t0 = getattr(prepared, "_obs_plan_t0", None)
+        if t0 is not None:
+            duration = time.perf_counter() - t0
+            if isinstance(plan, DecodePlan):
+                metrics.decode_step_seconds.observe(duration)
+            else:
+                metrics.prefill_step_seconds.observe(duration)
         if isinstance(plan, PackedPrefillPlan):
             seqs, toks = [], []
             for item, tok in zip(plan.items, result):
@@ -780,8 +819,21 @@ class LLMEngine:
         for seq, toks in zip(seqs, sampled):
             if seq.is_finished:
                 continue  # aborted mid-step
+            # per-token latency telemetry: a fused wave commits all its
+            # tokens with one host timestamp, so the wave's gap since the
+            # previous commit is spread evenly over its tokens — sample
+            # count stays the token count and the histogram sum stays the
+            # true wall time (metrics.inter_token_seconds doc)
+            first_wave = seq.metrics.first_token_time is None
+            if first_wave:
+                metrics.ttft_seconds.observe(
+                    max(0.0, now - seq.metrics.arrival_time)
+                )
+            prev_commit = seq.metrics.last_token_time
+            consumed = 0
             for tok in toks:
                 seq.output_token_ids.append(tok.token_id)
+                consumed += 1
                 if seq.fsm is not None:
                     seq.fsm_state = seq.fsm.next_state(
                         seq.fsm_state, tok.token_id
@@ -789,7 +841,11 @@ class LLMEngine:
                 if seq.metrics.first_token_time is None:
                     seq.metrics.first_token_time = now
                 seq.metrics.last_token_time = now
+                detok_t0 = time.perf_counter()
                 seq.detokenizer.append([tok.token_id])
+                seq.metrics.detokenize_time += (
+                    time.perf_counter() - detok_t0
+                )
                 if seq.output_logprobs is not None:
                     seq.output_logprobs.append(
                         self._build_logprob_dict(seq, tok)
@@ -805,6 +861,10 @@ class LLMEngine:
                 if seq.params.output_kind != RequestOutputKind.FINAL_ONLY:
                     # DELTA with an empty text delta still carries the token
                     outputs.append(seq.to_request_output())
+            if not first_wave and prev_commit is not None and consumed:
+                itl = max(0.0, now - prev_commit) / consumed
+                for _ in range(consumed):
+                    metrics.inter_token_seconds.observe(itl)
         return outputs
 
     def _maybe_finish(self, seq: Sequence, token_id: int) -> None:

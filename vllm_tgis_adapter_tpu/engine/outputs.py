@@ -32,6 +32,13 @@ class RequestMetrics:
     last_token_time: Optional[float] = None
     time_in_queue: Optional[float] = None
     finished_time: Optional[float] = None
+    # host seconds spent incrementally detokenizing this request's tokens
+    # (accumulated across commits; the tracer renders it as a child span)
+    detokenize_time: float = 0.0
+    # lifecycle markers — (event_name, time_unix_nano) tuples appended by
+    # the scheduler/engine (preempted, swap_out, swap_in); exported as
+    # OTLP span events on the request span
+    events: list[tuple[str, int]] = dataclasses.field(default_factory=list)
 
 
 @dataclasses.dataclass

@@ -37,6 +37,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from vllm_tgis_adapter_tpu.compile_tracker import track_jit
 from vllm_tgis_adapter_tpu.engine import sampler as sampler_mod
 from vllm_tgis_adapter_tpu.engine.runner import (
     ModelRunner,
@@ -256,25 +257,45 @@ class PipelineRunner(ModelRunner):
                 data_sharding=data_sharding(smesh),
                 first=first,
                 last=last,
-                prefill_fn=jax.jit(
-                    functools.partial(
-                        smodel.prefill, first_stage=first, last_stage=last
+                # stage fns are invoked with token_ids as a KEYWORD
+                # (execute paths build a kwargs dict), so the shape
+                # labels read kwargs, not positional args
+                prefill_fn=track_jit(
+                    f"pp{s}_prefill",
+                    jax.jit(
+                        functools.partial(
+                            smodel.prefill, first_stage=first,
+                            last_stage=last,
+                        ),
+                        donate_argnums=donate,
                     ),
-                    donate_argnums=donate,
+                    label=lambda args, kwargs:
+                        f"tokens={kwargs['token_ids'].shape[0]}",
                 ),
-                chunk_fn=jax.jit(
-                    functools.partial(
-                        smodel.prefill_chunk, block_size=self.block_size,
-                        first_stage=first, last_stage=last,
+                chunk_fn=track_jit(
+                    f"pp{s}_prefill_chunk",
+                    jax.jit(
+                        functools.partial(
+                            smodel.prefill_chunk,
+                            block_size=self.block_size,
+                            first_stage=first, last_stage=last,
+                        ),
+                        donate_argnums=donate,
                     ),
-                    donate_argnums=donate,
+                    label=lambda args, kwargs:
+                        f"tokens={kwargs['token_ids'].shape[0]}",
                 ),
-                decode_fn=jax.jit(
-                    functools.partial(
-                        _stage_decode, smodel, self.block_size,
-                        first, last,
+                decode_fn=track_jit(
+                    f"pp{s}_decode",
+                    jax.jit(
+                        functools.partial(
+                            _stage_decode, smodel, self.block_size,
+                            first, last,
+                        ),
+                        donate_argnums=donate,
                     ),
-                    donate_argnums=donate,
+                    label=lambda args, kwargs:
+                        f"batch={kwargs['token_ids'].shape[-1]}",
                 ),
             ))
         logger.info(

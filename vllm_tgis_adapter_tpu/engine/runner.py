@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from vllm_tgis_adapter_tpu.compile_tracker import track_jit
 from vllm_tgis_adapter_tpu.engine import sampler as sampler_mod
 from vllm_tgis_adapter_tpu.engine.sampler import TOPN_WIDTH, SamplingTensors
 from vllm_tgis_adapter_tpu.logging import init_logger
@@ -283,7 +284,15 @@ class ModelRunner:
         # buffer donation lets XLA update the KV cache in place; host
         # platforms don't implement donation and warn, so gate it
         donate = (1,) if jax.default_backend() == "tpu" else ()
-        self._prefill_fn = jax.jit(model.prefill, donate_argnums=donate)
+        # recompile tracking (compile_tracker.py): every jitted entry
+        # point is wrapped so a compile-cache miss records the (bucket,
+        # batch, steps) shape that triggered it — on TPU a leak past the
+        # scheduler's buckets costs a 20-40s serving stall per shape
+        self._prefill_fn = track_jit(
+            "prefill",
+            jax.jit(model.prefill, donate_argnums=donate),
+            label=lambda args, kwargs: f"tokens={args[2].shape[0]}",
+        )
         self._decode_fn = self._build_decode_fn()
 
         max_seqs = config.scheduler_config.max_num_seqs
@@ -294,9 +303,15 @@ class ModelRunner:
 
         # chunked prefill: non-first chunks attend to prior context through
         # the paged cache (models/llama.py prefill_chunk)
-        self._prefill_chunk_fn = jax.jit(
-            functools.partial(model.prefill_chunk, block_size=self.block_size),
-            donate_argnums=donate,
+        self._prefill_chunk_fn = track_jit(
+            "prefill_chunk",
+            jax.jit(
+                functools.partial(
+                    model.prefill_chunk, block_size=self.block_size
+                ),
+                donate_argnums=donate,
+            ),
+            label=lambda args, kwargs: f"tokens={args[2].shape[0]}",
         )
         self._seen_pad_lens = sorted(
             set(config.scheduler_config.prefill_buckets)
@@ -461,12 +476,22 @@ class ModelRunner:
                 allowed_mask, lora, lora_idx, num_steps, want_topn,
             )
 
-        self._chained_decode_fn = jax.jit(
-            chained_decode_steps, static_argnums=(11, 12),
-            donate_argnums=donate,
+        self._chained_decode_fn = track_jit(
+            "chained_decode",
+            jax.jit(chained_decode_steps, static_argnums=(11, 12),
+                    donate_argnums=donate),
+            # ints is arg 5 ([11, B]), num_steps is static arg 11
+            label=lambda args, kwargs:
+                f"batch={args[5].shape[1]},steps={args[11]}",
         )
-        return jax.jit(decode_steps, static_argnums=(9, 10),
-                       donate_argnums=donate)
+        return track_jit(
+            "decode",
+            jax.jit(decode_steps, static_argnums=(9, 10),
+                    donate_argnums=donate),
+            # ints is arg 3 ([11, B]), num_steps is static arg 9
+            label=lambda args, kwargs:
+                f"batch={args[3].shape[1]},steps={args[9]}",
+        )
 
     def _put(self, x) -> jax.Array:
         """Host array → device; replicated over the mesh when distributed
@@ -526,8 +551,10 @@ class ModelRunner:
         and drop) so compile variety stays logarithmic."""
         if self._restore_kv_fn is None:
             donate = (0, 1) if jax.default_backend() == "tpu" else ()
-            self._restore_kv_fn = jax.jit(
-                self._scatter_kv, donate_argnums=donate
+            self._restore_kv_fn = track_jit(
+                "restore_kv",
+                jax.jit(self._scatter_kv, donate_argnums=donate),
+                label=lambda args, kwargs: f"slots={args[2].shape[0]}",
             )
         n = len(slots)
         bucket = 1

@@ -18,9 +18,11 @@ Design for XLA's compile-once model (SURVEY.md §7 "hard parts"):
 from __future__ import annotations
 
 import dataclasses
+import time
 from collections import deque
 from typing import Optional
 
+from vllm_tgis_adapter_tpu import metrics
 from vllm_tgis_adapter_tpu.engine.config import CacheConfig, SchedulerConfig
 from vllm_tgis_adapter_tpu.engine.kv_cache import BlockAllocator, SequenceBlocks
 from vllm_tgis_adapter_tpu.engine.sequence import Sequence, SequenceStatus
@@ -655,6 +657,8 @@ class Scheduler:
         victim = max(candidates, key=lambda s: s.metrics.arrival_time)
         logger.info("preempting request %s (KV pool exhausted)",
                     victim.request_id)
+        victim.metrics.events.append(("preempted", time.time_ns()))
+        metrics.preemptions_total.inc()
         was_running = victim in self.running
         if was_running and self.swap_out_fn is not None:
             # decode-phase victim: copy its computed KV to host BEFORE the

@@ -1,0 +1,79 @@
+"""tgis_tpu.debug.v1.Debug server implementation.
+
+The gRPC face of the on-demand profiler (profiler.py): StartProfile /
+StopProfile bracket a ``jax.profiler`` capture, sharing one controller
+with the HTTP routes so either front-end can start or stop it.
+Registration helpers and the client stub are hand-written for the same
+reason as pb/rpc.py (no grpcio-tools in this environment).
+"""
+
+from __future__ import annotations
+
+import grpc
+
+from vllm_tgis_adapter_tpu.logging import init_logger
+from vllm_tgis_adapter_tpu.profiler import ProfilerController, ProfilerError
+
+from .pb import debug_pb2
+
+logger = init_logger(__name__)
+
+SERVICE_NAME = "tgis_tpu.debug.v1.Debug"
+
+_METHODS = (
+    ("StartProfile", debug_pb2.ProfileRequest, debug_pb2.ProfileResponse),
+    ("StopProfile", debug_pb2.ProfileRequest, debug_pb2.ProfileResponse),
+)
+
+
+class DebugServicer:
+    def __init__(self, controller: ProfilerController):
+        self._controller = controller
+
+    async def StartProfile(self, request, context):  # noqa: ANN001, ARG002
+        return await self._run(self._controller.start, context)
+
+    async def StopProfile(self, request, context):  # noqa: ANN001, ARG002
+        return await self._run(self._controller.stop, context)
+
+    @staticmethod
+    async def _run(op, context):  # noqa: ANN001
+        try:
+            result = op()
+        except ProfilerError as e:
+            await context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
+        return debug_pb2.ProfileResponse(
+            status=result["status"],
+            profile_dir=result.get("profile_dir") or "",
+            duration_seconds=result.get("duration_seconds", 0.0),
+        )
+
+
+def add_DebugServicer_to_server(servicer: DebugServicer, server) -> None:  # noqa: ANN001, N802
+    handlers = {
+        name: grpc.unary_unary_rpc_method_handler(
+            getattr(servicer, name),
+            request_deserializer=req_cls.FromString,
+            response_serializer=resp_cls.SerializeToString,
+        )
+        for name, req_cls, resp_cls in _METHODS
+    }
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(SERVICE_NAME, handlers),)
+    )
+
+
+class DebugStub:
+    """Client stub; works with both sync and asyncio grpc channels."""
+
+    def __init__(self, channel: grpc.Channel):
+        for name, req_cls, resp_cls in _METHODS:
+            setattr(
+                self,
+                name,
+                channel.unary_unary(
+                    f"/{SERVICE_NAME}/{name}",
+                    request_serializer=req_cls.SerializeToString,
+                    response_deserializer=resp_cls.FromString,
+                ),
+            )

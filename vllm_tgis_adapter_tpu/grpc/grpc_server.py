@@ -579,8 +579,19 @@ async def start_grpc_server(
     await service.post_init()
     rpc.add_GenerationServiceServicer_to_server(service, server)
 
+    # debug service: on-demand profiler capture sharing the HTTP routes'
+    # controller (profiler.py get_controller)
+    from vllm_tgis_adapter_tpu.grpc import debug as debug_svc
+    from vllm_tgis_adapter_tpu.profiler import get_controller
+
+    debug_servicer = debug_svc.DebugServicer(
+        get_controller(getattr(args, "profile_dir", None))
+    )
+    debug_svc.add_DebugServicer_to_server(debug_servicer, server)
+
     reflection.enable_server_reflection(
-        (service.SERVICE_NAME, health.SERVICE_NAME), server
+        (service.SERVICE_NAME, health.SERVICE_NAME,
+         debug_svc.SERVICE_NAME), server
     )
 
     address = f"{args.host or '0.0.0.0'}:{args.grpc_port}"  # noqa: S104

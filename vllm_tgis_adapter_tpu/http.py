@@ -37,6 +37,16 @@ if TYPE_CHECKING:
 logger = init_logger(__name__)
 
 CORRELATION_ID_HEADER = "x-correlation-id"
+_TRACE_HEADERS = ("traceparent", "tracestate")
+
+
+def _trace_headers(request: "HttpRequest") -> Optional[dict[str, str]]:
+    """W3C trace-context headers to forward into the engine (same
+    propagation the gRPC server does via its invocation metadata)."""
+    headers = {
+        k: request.headers[k] for k in _TRACE_HEADERS if k in request.headers
+    }
+    return headers or None
 
 
 # --------------------------------------------------------------------- app
@@ -161,6 +171,16 @@ def build_http_server(args: "argparse.Namespace", engine: "AsyncLLMEngine") -> A
     # app (/root/reference/src/vllm_tgis_adapter/http.py:52)
     app.route("POST", "/tokenize")(_tokenize)
     app.route("POST", "/detokenize")(_detokenize)
+    # on-demand jax.profiler capture, gated by --profile-dir (vLLM-app
+    # analog: start_profile/stop_profile); shared with the gRPC debug
+    # service so either front-end can bracket a capture
+    from vllm_tgis_adapter_tpu.profiler import get_controller
+
+    app.state["profiler"] = get_controller(
+        getattr(args, "profile_dir", None)
+    )
+    app.route("POST", "/start_profile")(_start_profile)
+    app.route("POST", "/stop_profile")(_stop_profile)
     return app
 
 
@@ -183,6 +203,28 @@ async def _metrics(app: App, request: HttpRequest) -> HttpResponse:  # noqa: ARG
     return HttpResponse(
         200, metrics.render(), content_type="text/plain; version=0.0.4"
     )
+
+
+async def _start_profile(app: App, request: HttpRequest) -> HttpResponse:  # noqa: ARG001
+    from vllm_tgis_adapter_tpu.profiler import ProfilerError
+
+    try:
+        return JsonResponse(app.state["profiler"].start())
+    except ProfilerError as e:
+        return error_response(
+            409 if "already active" in str(e) else 400, str(e)
+        )
+
+
+async def _stop_profile(app: App, request: HttpRequest) -> HttpResponse:  # noqa: ARG001
+    from vllm_tgis_adapter_tpu.profiler import ProfilerError
+
+    try:
+        return JsonResponse(app.state["profiler"].stop())
+    except ProfilerError as e:
+        return error_response(
+            409 if "no profiler capture" in str(e) else 400, str(e)
+        )
 
 
 async def _tokenize(app: App, request: HttpRequest) -> HttpResponse:
@@ -378,6 +420,7 @@ async def _completions(app: App, request: HttpRequest):  # noqa: ANN201, C901, P
                     sampling_params, k, n, out_kind
                 ),
                 request_id=f"cmpl-{base_request_id}-{pi * n + k}",
+                trace_headers=_trace_headers(request),
             ))
 
     from vllm_tgis_adapter_tpu.utils import merge_async_iterators
@@ -543,6 +586,7 @@ async def _chat_completions(app: App, request: HttpRequest):  # noqa: ANN201, C9
             prompt=prompt,
             sampling_params=_sibling_params(sampling_params, k, n, out_kind),
             request_id=f"chat-{base_request_id}-{k}",
+            trace_headers=_trace_headers(request),
         )
         for k in range(n)
     ]

@@ -1,0 +1,29 @@
+"""Version shims for JAX APIs that moved between releases.
+
+The engine targets the modern spelling (``jax.shard_map`` with the
+``check_vma`` kwarg); older runtimes (< 0.6) only ship
+``jax.experimental.shard_map.shard_map`` with the ``check_rep`` kwarg.
+Import ``shard_map`` from here instead of from ``jax`` so one shim covers
+every call site.
+"""
+
+from __future__ import annotations
+
+try:
+    from jax import shard_map  # type: ignore[attr-defined]
+except ImportError:  # pragma: no cover — depends on installed jax
+    from jax.experimental.shard_map import (
+        shard_map as _experimental_shard_map,
+    )
+
+    def shard_map(f, /, *, mesh, in_specs, out_specs, check_vma=True):  # noqa: ANN001, ANN201
+        return _experimental_shard_map(
+            f,
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            check_rep=check_vma,
+        )
+
+
+__all__ = ["shard_map"]

@@ -17,16 +17,19 @@ from prometheus_client import (
 
 _PREFIX = "tgis_tpu"
 
+# every collector this module ever constructed, keyed by metric name — the
+# idempotency source of truth, so re-registration never has to reach into
+# prometheus_client's private registry internals
+_COLLECTORS: dict[str, object] = {}
+
 
 def _get_or_create(cls, name: str, doc: str, **kwargs):  # noqa: ANN001, ANN003, ANN202
     """Idempotent metric construction (tests boot multiple servers)."""
-    try:
-        return cls(name, doc, **kwargs)
-    except ValueError:
-        collector = REGISTRY._names_to_collectors.get(name)  # noqa: SLF001
-        if collector is None:
-            raise
-        return collector
+    collector = _COLLECTORS.get(name)
+    if collector is None:
+        collector = cls(name, doc, **kwargs)
+        _COLLECTORS[name] = collector
+    return collector
 
 
 request_count = _get_or_create(
@@ -174,6 +177,134 @@ moe_expert_capacity = _get_or_create(
     "Realized per-expert buffer rows of the most recent MoE dispatch "
     "(ceil(T*k/E * capacity_factor), bounded by T)",
 )
+
+
+# ---- step-level engine telemetry (docs/OBSERVABILITY.md): per-token
+# latency, per-dispatch batch-shape efficiency, preemption pressure, and
+# XLA compilation discipline.  Fed from the engine core's plan/commit
+# phases and the runner's jit wrappers (compile_tracker.py); collection
+# is never gated by --disable-log-stats (that flag only silences the
+# periodic log LINE, engine/async_llm.py).
+ttft_seconds = _get_or_create(
+    Histogram,
+    f"{_PREFIX}_ttft_seconds",
+    "Time to first token: request arrival to the first sampled token "
+    "committing on host (the live counterpart of the bench's ttft_ms)",
+    buckets=(0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+             30.0, 60.0),
+)
+inter_token_seconds = _get_or_create(
+    Histogram,
+    f"{_PREFIX}_inter_token_seconds",
+    "Inter-token latency; fused multi-step waves commit K tokens at "
+    "once, so each of the wave's tokens observes the wave gap / K",
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+             1.0, 2.5),
+)
+decode_step_seconds = _get_or_create(
+    Histogram,
+    f"{_PREFIX}_decode_step_seconds",
+    "Wall time of one fused decode dispatch, plan to commit",
+    buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+             5.0),
+)
+prefill_step_seconds = _get_or_create(
+    Histogram,
+    f"{_PREFIX}_prefill_step_seconds",
+    "Wall time of one prefill (chunk or packed) dispatch, plan to commit",
+    buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+             5.0),
+)
+decode_batch_occupancy = _get_or_create(
+    Gauge,
+    f"{_PREFIX}_decode_batch_occupancy",
+    "Real sequences / padded batch bucket of the most recent decode "
+    "dispatch (0-1); low values mean the compile bucket is mostly pad",
+)
+prefill_padding_waste = _get_or_create(
+    Gauge,
+    f"{_PREFIX}_prefill_padding_waste",
+    "Padded fraction of the most recent prefill dispatch's token bucket "
+    "(0-1)",
+)
+padded_tokens_total = _get_or_create(
+    Counter,
+    f"{_PREFIX}_padded_tokens_total",
+    "Cumulative token slots dispatched as padding, by phase — the "
+    "device work bucketed shapes waste to stay compile-bounded",
+    labelnames=("phase",),
+)
+packed_prefill_prompts = _get_or_create(
+    Histogram,
+    f"{_PREFIX}_packed_prefill_prompts",
+    "Whole prompts packed into one prefill dispatch (1 = solo prefill)",
+    buckets=(1, 2, 3, 4, 5, 6, 7, 8),
+)
+preemptions_total = _get_or_create(
+    Counter,
+    f"{_PREFIX}_preemptions_total",
+    "Sequences preempted because the KV page pool ran dry",
+)
+xla_recompile_total = _get_or_create(
+    Counter,
+    f"{_PREFIX}_xla_recompile_total",
+    "XLA compile-cache misses per jitted entry point and dispatch "
+    "shape (compile_tracker.py); steady-state serving should add none",
+    labelnames=("fn", "shape"),
+)
+xla_compile_seconds = _get_or_create(
+    Histogram,
+    f"{_PREFIX}_xla_compile_seconds",
+    "Wall time of dispatches that triggered an XLA compile (includes "
+    "the traced execution itself)",
+    buckets=(0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+             120.0, 300.0),
+)
+xla_compiled_shapes = _get_or_create(
+    Gauge,
+    f"{_PREFIX}_xla_compiled_shapes",
+    "Distinct (fn, shape) programs compiled since boot",
+)
+
+
+class _StepSnapshot:
+    """Host-side mirror of the latest per-dispatch shape stats, so the
+    periodic stats log line (engine/async_llm.py) can report them without
+    reading gauge internals back out of prometheus_client."""
+
+    __slots__ = ("decode_occupancy", "prefill_padding_waste",
+                 "decode_steps", "prefill_steps")
+
+    def __init__(self) -> None:
+        self.decode_occupancy = 0.0
+        self.prefill_padding_waste = 0.0
+        self.decode_steps = 0
+        self.prefill_steps = 0
+
+
+step_snapshot = _StepSnapshot()
+
+
+def observe_decode_plan(*, num_seqs: int, batch_bucket: int,
+                        num_steps: int) -> None:
+    occupancy = num_seqs / batch_bucket if batch_bucket else 0.0
+    decode_batch_occupancy.set(occupancy)
+    padded = (batch_bucket - num_seqs) * num_steps
+    if padded > 0:
+        padded_tokens_total.labels(phase="decode").inc(padded)
+    step_snapshot.decode_occupancy = occupancy
+    step_snapshot.decode_steps += 1
+
+
+def observe_prefill_plan(*, real_tokens: int, bucket: int,
+                         num_prompts: int) -> None:
+    waste = (bucket - real_tokens) / bucket if bucket else 0.0
+    prefill_padding_waste.set(waste)
+    if bucket > real_tokens:
+        padded_tokens_total.labels(phase="prefill").inc(bucket - real_tokens)
+    packed_prefill_prompts.observe(num_prompts)
+    step_snapshot.prefill_padding_waste = waste
+    step_snapshot.prefill_steps += 1
 
 
 def record_moe_dispatch(dropped: int, total: int, capacity: int) -> None:

@@ -27,7 +27,7 @@ import os
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from vllm_tgis_adapter_tpu.jax_compat import shard_map
 
 NEG_INF = float("-inf")
 

@@ -1,0 +1,127 @@
+"""On-demand device profiling backed by ``jax.profiler``.
+
+Reference analog: vLLM's ``/start_profile`` / ``/stop_profile`` routes
+(active when the torch profiler dir env var is set).  Here the capture is
+a ``jax.profiler`` trace written under ``--profile-dir`` and viewable in
+TensorBoard/XProf; both serving front-ends drive the SAME controller so a
+capture started over HTTP can be stopped over gRPC and vice versa.
+
+The controller is deliberately forgiving: profiling is operator tooling,
+so a backend without a usable profiler (bare CPU CI images, stub
+runtimes) degrades to a recorded no-op instead of failing the request or
+— worse — the serving process.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from vllm_tgis_adapter_tpu.logging import init_logger
+
+logger = init_logger(__name__)
+
+
+class ProfilerError(ValueError):
+    """Operator-facing misuse (disabled / double start / idle stop)."""
+
+
+class ProfilerController:
+    """Serializes jax.profiler trace capture behind a process-wide lock."""
+
+    def __init__(self, profile_dir: Optional[str]):
+        self.profile_dir = profile_dir
+        self._lock = threading.Lock()
+        self._active = False
+        self._noop = False
+        self._started_at: Optional[float] = None
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.profile_dir)
+
+    @property
+    def active(self) -> bool:
+        return self._active
+
+    def start(self) -> dict:
+        if not self.enabled:
+            raise ProfilerError(
+                "profiling is disabled; restart the server with "
+                "--profile-dir"
+            )
+        with self._lock:
+            if self._active:
+                raise ProfilerError("a profiler capture is already active")
+            self._noop = False
+            try:
+                import jax
+
+                jax.profiler.start_trace(self.profile_dir)
+            except Exception as e:  # noqa: BLE001 — profiling must not kill serving
+                logger.warning(
+                    "jax.profiler unavailable (%s); capture is a no-op", e
+                )
+                self._noop = True
+            self._active = True
+            self._started_at = time.time()
+            logger.info("profiler capture started → %s", self.profile_dir)
+            return {
+                "status": "noop" if self._noop else "started",
+                "profile_dir": self.profile_dir,
+            }
+
+    def stop(self) -> dict:
+        if not self.enabled:
+            raise ProfilerError(
+                "profiling is disabled; restart the server with "
+                "--profile-dir"
+            )
+        with self._lock:
+            if not self._active:
+                raise ProfilerError("no profiler capture is active")
+            noop = self._noop
+            if not noop:
+                try:
+                    import jax
+
+                    jax.profiler.stop_trace()
+                except Exception as e:  # noqa: BLE001
+                    logger.warning("jax.profiler stop failed: %s", e)
+                    noop = True
+            duration = time.time() - (self._started_at or time.time())
+            self._active = False
+            self._started_at = None
+            logger.info(
+                "profiler capture stopped after %.2fs → %s",
+                duration, self.profile_dir,
+            )
+            return {
+                "status": "noop" if noop else "stopped",
+                "profile_dir": self.profile_dir,
+                "duration_seconds": duration,
+            }
+
+
+_controller: Optional[ProfilerController] = None
+_controller_lock = threading.Lock()
+
+
+def get_controller(profile_dir: Optional[str]) -> ProfilerController:
+    """Process-wide controller: jax.profiler allows one trace at a time,
+    so the HTTP and gRPC front-ends must share state."""
+    global _controller
+    with _controller_lock:
+        if _controller is None:
+            _controller = ProfilerController(profile_dir)
+        elif profile_dir and not _controller.profile_dir:
+            _controller.profile_dir = profile_dir
+        return _controller
+
+
+def reset_controller() -> None:
+    """Test hook."""
+    global _controller
+    with _controller_lock:
+        _controller = None

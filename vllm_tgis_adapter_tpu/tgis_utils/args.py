@@ -290,6 +290,12 @@ def add_engine_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                    help="start a jax.profiler server on this port "
                         "(connect with TensorBoard/XProf to capture "
                         "device traces)")
+    g.add_argument("--profile-dir", type=str, default=None,
+                   help="enable on-demand jax.profiler captures written "
+                        "to this directory: POST /start_profile and "
+                        "/stop_profile on the HTTP server (and the gRPC "
+                        "debug service) bracket a capture; view with "
+                        "TensorBoard/XProf")
     g.add_argument("--disable-log-requests", action="store_true",
                    help="disable engine-level per-request logs")
 

@@ -87,6 +87,10 @@ def extract_trace_context(
     )
 
 
+SPAN_KIND_INTERNAL = 1
+SPAN_KIND_SERVER = 2
+
+
 @dataclasses.dataclass
 class Span:
     name: str
@@ -96,6 +100,9 @@ class Span:
     start_ns: int
     end_ns: int = 0
     attributes: dict = dataclasses.field(default_factory=dict)
+    kind: int = SPAN_KIND_SERVER
+    # OTLP span events: (name, time_unix_nano) — preemption/swap markers
+    events: list = dataclasses.field(default_factory=list)
 
     def otlp_json(self) -> dict:
         def value(v):  # noqa: ANN001, ANN202
@@ -116,13 +123,23 @@ class Span:
                 else {}
             ),
             "name": self.name,
-            "kind": 2,  # SPAN_KIND_SERVER
+            "kind": self.kind,
             "startTimeUnixNano": str(self.start_ns),
             "endTimeUnixNano": str(self.end_ns),
             "attributes": [
                 {"key": k, "value": value(v)}
                 for k, v in self.attributes.items()
             ],
+            **(
+                {
+                    "events": [
+                        {"name": n, "timeUnixNano": str(t)}
+                        for n, t in self.events
+                    ]
+                }
+                if self.events
+                else {}
+            ),
         }
 
 
@@ -147,7 +164,9 @@ class OtlpJsonExporter:
 
     def shutdown(self) -> None:
         self._queue.put(None)
-        self._worker.join(timeout=self.timeout_s)
+        # generous join: the worker may have one in-flight POST plus the
+        # final drain's POSTs to finish before spans are safe
+        self._worker.join(timeout=4 * self.timeout_s)
 
     # ------------------------------------------------------------- internals
 
@@ -170,6 +189,20 @@ class OtlpJsonExporter:
             done = item is None
             if batch:
                 self._post(batch)
+        # shutdown drain: spans enqueued concurrently with shutdown() land
+        # BEHIND the sentinel — a close must flush them too, partial
+        # batches included, or the last requests of a process lose their
+        # traces exactly when they are most interesting (crash analysis)
+        leftovers: list[Span] = []
+        while True:
+            try:
+                item = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if item is not None:
+                leftovers.append(item)
+        for i in range(0, len(leftovers), _EXPORT_BATCH):
+            self._post(leftovers[i:i + _EXPORT_BATCH])
 
     def _post(self, batch: list[Span]) -> None:
         payload = {
@@ -254,7 +287,60 @@ class RequestTracer:
                 span.attributes["gen_ai.latency.time_to_first_token"] = (
                     metrics.first_token_time - metrics.arrival_time
                 )
+            if metrics is not None:
+                # preemption / swap markers recorded by the scheduler and
+                # engine core ride on the request span as OTLP events
+                span.events.extend(getattr(metrics, "events", ()))
+                for child in self._phase_children(span, metrics):
+                    self._exporter.export(child)
         self._exporter.export(span)
+
+    @staticmethod
+    def _phase_children(parent: Span, m) -> list[Span]:  # noqa: ANN001
+        """Queue/prefill/decode/detokenize child spans derived from the
+        engine's RequestMetrics timestamps.
+
+        Phases with no recorded boundary (e.g. a request aborted while
+        still queued never prefilled) are simply omitted; the detokenize
+        child aggregates the incremental host-side detokenization time
+        accumulated across commits and is anchored to the request's end.
+        """
+
+        def ns(t: float) -> int:
+            return int(t * 1e9)
+
+        def child(name: str, start: float, end: float) -> Span:
+            return Span(
+                name=name,
+                trace_id=parent.trace_id,
+                span_id=secrets.token_hex(8),
+                parent_span_id=parent.span_id,
+                start_ns=ns(start),
+                end_ns=ns(end),
+                kind=SPAN_KIND_INTERNAL,
+            )
+
+        children: list[Span] = []
+        arrival = m.arrival_time
+        scheduled = m.first_scheduled_time
+        first_tok = m.first_token_time
+        last_tok = m.last_token_time
+        finished = m.finished_time
+        if arrival is not None and scheduled is not None:
+            children.append(child("queue", arrival, scheduled))
+        if scheduled is not None and first_tok is not None:
+            children.append(child("prefill", scheduled, first_tok))
+        if first_tok is not None:
+            children.append(child("decode", first_tok,
+                                  last_tok or first_tok))
+        detok = getattr(m, "detokenize_time", 0.0)
+        if detok > 0.0:
+            end = finished or last_tok or first_tok
+            if end is not None:
+                span = child("detokenize", end - detok, end)
+                span.attributes["detokenize.cumulative_seconds"] = detok
+                children.append(span)
+        return children
 
     def shutdown(self) -> None:
         self._exporter.shutdown()
