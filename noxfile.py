@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import nox
 
-nox.options.sessions = ("lint", "tests")
+nox.options.sessions = ("lint", "tpulint", "typecheck", "tests")
 nox.options.reuse_existing_virtualenvs = True
 
 PYTHON_VERSIONS = ["3.12", "3.11"]
@@ -50,8 +50,35 @@ def obs_check(session: nox.Session) -> None:
 
 @nox.session(python="3.12")
 def lint(session: nox.Session) -> None:
+    # rule set pinned in pyproject.toml [tool.ruff.lint] — reproducible
+    # across ruff releases instead of the floating defaults
     session.install("ruff")
     session.run("ruff", "check", "vllm_tgis_adapter_tpu", "tests")
+
+
+@nox.session(python="3.12")
+def tpulint(session: nox.Session) -> None:
+    """Project hazard analyzer (docs/STATIC_ANALYSIS.md): recompile,
+    host-sync and async-blocking gates over the package.  Pure stdlib —
+    nothing to install; exit codes are scriptable (0/1/2) like
+    tools/obs_check.py."""
+    session.run(
+        "python", "tools/tpulint/cli.py",
+        *(session.posargs or ["vllm_tgis_adapter_tpu"]),
+    )
+
+
+@nox.session(python="3.12")
+def typecheck(session: nox.Session) -> None:
+    """mypy over the whole package; pyproject's [[tool.mypy.overrides]]
+    alone defines the typed core subset (everything else is
+    override-ignored until annotated), so there is exactly ONE module
+    list to maintain."""
+    session.install("mypy")
+    session.run(
+        "mypy", "--config-file", "pyproject.toml",
+        "vllm_tgis_adapter_tpu",
+    )
 
 
 @nox.session(python="3.12")

@@ -1,0 +1,403 @@
+"""tpulint analyzer tests: every rule code in both directions.
+
+``FIXTURES`` maps each rule code to a (firing, clean) pair of snippet
+modules; one parametrized test asserts the firing snippet raises exactly
+that code and the clean snippet raises nothing.  Separate tests cover the
+suppression contract (reasoned disables suppress, reason-less disables
+are TPL000) and the self-check: the shipped package must be
+tpulint-clean (exit 0) with zero unexplained suppressions.
+"""
+
+from __future__ import annotations
+
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+if str(REPO_ROOT) not in sys.path:
+    sys.path.insert(0, str(REPO_ROOT))
+
+from tools.tpulint import config as lint_config  # noqa: E402
+from tools.tpulint.analyzer import analyze_file  # noqa: E402
+from tools.tpulint.cli import main as tpulint_main  # noqa: E402
+
+STEP_PATH = "pkg/engine/runner.py"  # classified as step-loop
+ASYNC_PATH = "pkg/grpc/server.py"  # any module; rules key off async def
+
+
+def lint(tmp_path: Path, rel: str, source: str):
+    """Write ``source`` at ``rel`` under tmp_path and analyze it."""
+    target = tmp_path / rel
+    target.parent.mkdir(parents=True, exist_ok=True)
+    target.write_text(textwrap.dedent(source))
+    return analyze_file(target, root=tmp_path)
+
+
+def active_codes(findings) -> list[str]:
+    return [f.code for f in findings if not f.suppressed]
+
+
+# --------------------------------------------------------------- fixtures
+
+FIXTURES: dict[str, tuple[str, str, str]] = {
+    # code: (path, firing snippet, clean snippet)
+    "TPL000": (
+        STEP_PATH,
+        """
+        import numpy as np
+        def pull(packed_dev):
+            return np.asarray(packed_dev)  # tpulint: disable=TPL202
+        """,
+        """
+        import numpy as np
+        def pull(packed_dev):
+            return np.asarray(packed_dev)  # tpulint: disable=TPL202(one sanctioned fetch)
+        """,
+    ),
+    "TPL101": (
+        STEP_PATH,
+        """
+        import jax
+        @jax.jit
+        def f(x, n):
+            if x.shape[0] > n:
+                return x
+            return x * 2
+        """,
+        """
+        import jax
+        from functools import partial
+        @partial(jax.jit, static_argnames=("n",))
+        def f(x, n=4):
+            if n > 2:
+                return x
+            if x is None:
+                return x
+            return x * 2
+        """,
+    ),
+    "TPL102": (
+        STEP_PATH,
+        """
+        import jax
+        @jax.jit
+        def f(x, table):
+            return table[f"bucket-{x.shape[0]}"]
+        """,
+        """
+        import jax
+        @jax.jit
+        def f(x, table):
+            if x is None:
+                raise ValueError(f"bad shape {x.shape}")
+            return table["bucket"]
+        """,
+    ),
+    "TPL103": (
+        STEP_PATH,
+        """
+        import jax
+        def g(x, num_steps: int, flashy: bool = True):
+            return x
+        fn = jax.jit(g)
+        """,
+        """
+        import jax
+        def g(x, num_steps: int, flashy: bool = True):
+            return x
+        fn = jax.jit(g, static_argnums=(1,), static_argnames=("flashy",))
+        """,
+    ),
+    "TPL104": (
+        STEP_PATH,
+        """
+        import jax
+        def build(model):
+            return jax.jit(model.decode)
+        """,
+        """
+        import jax
+        def build(model, sh):
+            a = jax.jit(model.decode, donate_argnums=(1,))
+            b = jax.jit(lambda: model.make_kv_caches(8), out_shardings=sh)
+            c = jax.jit(model.propose)
+            return a, b, c
+        """,
+    ),
+    "TPL201": (
+        STEP_PATH,
+        """
+        import jax
+        def step(x):
+            x.block_until_ready()
+            return x[0].item() + jax.device_get(x)[1]
+        """,
+        """
+        import jax
+        def step(x):
+            return x[0] + x[1]
+        """,
+    ),
+    "TPL202": (
+        STEP_PATH,
+        """
+        import numpy as np
+        def pull(packed_dev, logits):
+            return np.asarray(packed_dev), float(logits[0])
+        """,
+        """
+        import numpy as np
+        def host_prep(rows, slots):
+            return np.asarray(rows), np.asarray([1, 2]), int(slots[0])
+        """,
+    ),
+    "TPL301": (
+        ASYNC_PATH,
+        """
+        import time
+        async def handler():
+            time.sleep(0.1)
+        """,
+        """
+        import asyncio, time
+        async def handler():
+            await asyncio.sleep(0.1)
+        def sync_helper():
+            time.sleep(0.1)
+        """,
+    ),
+    "TPL302": (
+        ASYNC_PATH,
+        """
+        from pathlib import Path
+        async def handler(path):
+            with open(path) as f:
+                pass
+            return Path(path).read_text()
+        """,
+        """
+        import asyncio
+        from pathlib import Path
+        def _read(path):
+            with open(path) as f:
+                return f.read()
+        async def handler(path):
+            def inner():
+                return Path(path).read_text()
+            return await asyncio.to_thread(_read, path)
+        """,
+    ),
+    "TPL303": (
+        ASYNC_PATH,
+        """
+        async def loop(engine, plan):
+            return engine.wait_step(plan)
+        """,
+        """
+        import asyncio
+        async def loop(engine, plan):
+            await engine.precompile("all")
+            return await asyncio.to_thread(engine.wait_step, plan)
+        """,
+    ),
+}
+
+
+@pytest.mark.parametrize("code", sorted(lint_config.RULES))
+def test_rule_fires_and_stays_quiet(tmp_path, code):
+    rel, firing, clean = FIXTURES[code]
+    fired = active_codes(lint(tmp_path, rel, firing))
+    assert code in fired, f"{code} did not fire on its firing fixture"
+    assert active_codes(lint(tmp_path, "clean/" + rel, clean)) == [], (
+        f"clean fixture for {code} raised findings"
+    )
+
+
+def test_fixture_table_covers_every_rule():
+    assert sorted(FIXTURES) == sorted(lint_config.RULES)
+
+
+# ----------------------------------------------------------- suppressions
+
+
+def test_suppression_with_reason_suppresses(tmp_path):
+    findings = lint(
+        tmp_path, STEP_PATH,
+        """
+        import numpy as np
+        def pull(packed_dev):
+            return np.asarray(packed_dev)  # tpulint: disable=TPL202(one fetch per wave)
+        """,
+    )
+    assert active_codes(findings) == []
+    suppressed = [f for f in findings if f.suppressed]
+    assert len(suppressed) == 1
+    assert suppressed[0].code == "TPL202"
+    assert suppressed[0].reason == "one fetch per wave"
+
+
+def test_suppression_on_preceding_line(tmp_path):
+    findings = lint(
+        tmp_path, STEP_PATH,
+        """
+        import numpy as np
+        def pull(packed_dev):
+            # tpulint: disable=TPL202(statement too long for a trailing comment)
+            return np.asarray(packed_dev)
+        """,
+    )
+    assert active_codes(findings) == []
+
+
+def test_reasonless_suppression_does_not_suppress(tmp_path):
+    findings = lint(
+        tmp_path, STEP_PATH,
+        """
+        import numpy as np
+        def pull(packed_dev):
+            return np.asarray(packed_dev)  # tpulint: disable=TPL202
+        """,
+    )
+    codes = active_codes(findings)
+    assert "TPL000" in codes  # the audit finding
+    assert "TPL202" in codes  # and the original hazard still reported
+
+
+def test_trailing_suppression_does_not_leak_to_next_line(tmp_path):
+    """A trailing disable waives ONLY its own line — the hazard on the
+    line below must still be reported."""
+    findings = lint(
+        tmp_path, STEP_PATH,
+        """
+        import numpy as np
+        def pull(packed_dev, logits):
+            a = np.asarray(packed_dev)  # tpulint: disable=TPL202(first line only)
+            b = np.asarray(logits)
+            return a, b
+        """,
+    )
+    assert active_codes(findings) == ["TPL202"]
+    assert [f for f in findings if f.suppressed][0].line < [
+        f for f in findings if not f.suppressed
+    ][0].line
+
+
+def test_reason_may_contain_parentheses(tmp_path):
+    findings = lint(
+        tmp_path, STEP_PATH,
+        """
+        import numpy as np
+        def pull(packed_dev):
+            return np.asarray(packed_dev)  # tpulint: disable=TPL202(one fetch (per wave), by design)
+        """,
+    )
+    assert active_codes(findings) == []
+    assert [f for f in findings if f.suppressed][0].reason == (
+        "one fetch (per wave), by design"
+    )
+
+
+def test_disable_marker_in_docstring_is_not_a_suppression(tmp_path):
+    """Quoting the syntax in a docstring (as the docs do) must neither
+    suppress anything nor raise TPL000."""
+    findings = lint(
+        tmp_path, STEP_PATH,
+        '''
+        """Docs: write `# tpulint: disable=TPL202` to waive a finding."""
+        import numpy as np
+        def pull(packed_dev):
+            return np.asarray(packed_dev)
+        """mid-module string: # tpulint: disable=TPL202"""
+        ''',
+    )
+    assert active_codes(findings) == ["TPL202"]
+
+
+def test_awaited_sync_io_names_are_exempt(tmp_path):
+    findings = lint(
+        tmp_path, ASYNC_PATH,
+        """
+        async def handler(aiopath):
+            return await aiopath.read_text()
+        """,
+    )
+    assert active_codes(findings) == []
+
+
+def test_wrong_code_does_not_suppress(tmp_path):
+    findings = lint(
+        tmp_path, STEP_PATH,
+        """
+        import numpy as np
+        def pull(packed_dev):
+            return np.asarray(packed_dev)  # tpulint: disable=TPL201(wrong code)
+        """,
+    )
+    assert "TPL202" in active_codes(findings)
+
+
+# ------------------------------------------------------------ scope rules
+
+
+def test_host_sync_rules_scoped_to_step_loop_modules(tmp_path):
+    src = """
+    import numpy as np
+    def pull(packed_dev):
+        return np.asarray(packed_dev), packed_dev.item()
+    """
+    assert active_codes(lint(tmp_path, "pkg/grpc/conv.py", src)) == []
+    fired = active_codes(lint(tmp_path, "pkg/ops/kernels.py", src))
+    assert set(fired) == {"TPL201", "TPL202"}
+
+
+def test_registry_methods_are_jit_scoped(tmp_path):
+    findings = lint(
+        tmp_path, "pkg/models/llama.py",
+        """
+        class LlamaForCausalLM:
+            def prefill(self, params, token_ids):
+                if token_ids.shape[0] > 8:
+                    return params
+                return token_ids
+        """,
+    )
+    assert active_codes(findings) == ["TPL101"]
+
+
+# -------------------------------------------------------------- CLI gate
+
+
+def test_cli_exit_codes(tmp_path, capsys):
+    bad = tmp_path / "engine" / "runner.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text(
+        "import numpy as np\n"
+        "def pull(packed_dev):\n"
+        "    return np.asarray(packed_dev)\n"
+    )
+    assert tpulint_main([str(bad)]) == 1
+    capsys.readouterr()
+    assert tpulint_main([str(tmp_path / "missing.py")]) == 2
+    assert tpulint_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for code in lint_config.RULES:
+        assert code in out
+
+
+def test_shipped_package_is_tpulint_clean(capsys):
+    """The acceptance gate: zero findings, zero unexplained suppressions
+    on the shipped package (same invocation as ``nox -s tpulint``)."""
+    rc = tpulint_main([str(REPO_ROOT / "vllm_tgis_adapter_tpu")])
+    out = capsys.readouterr().out
+    assert rc == 0, f"tpulint found hazards:\n{out}"
+
+
+def test_docs_list_every_rule_code():
+    """docs/STATIC_ANALYSIS.md ↔ rule-table drift gate (obs_check style)."""
+    doc = (REPO_ROOT / "docs" / "STATIC_ANALYSIS.md").read_text()
+    for code in lint_config.RULES:
+        assert code in doc, f"{code} missing from docs/STATIC_ANALYSIS.md"
+    assert "tpulint: disable=" in doc  # suppression syntax documented

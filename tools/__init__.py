@@ -1,0 +1,1 @@
+"""Repo tooling (CI gates, profiling drivers, static analysis)."""

@@ -1,0 +1,34 @@
+"""tpulint: AST-based hazard analyzer for this JAX/TPU serving stack.
+
+Three hazard families, one per latency pathology we have paged on:
+
+* **TPL1xx recompile hazards** — code inside a jitted function that makes
+  the traced program shape- or value-dependent (each novel shape is a
+  20-40s XLA/Mosaic compile on TPU; see compile_tracker.py).
+* **TPL2xx host-sync hazards** — device→host pulls on the engine step
+  path (``engine/core.py → runner.py → pipeline.py → ops/*``), where a
+  single stray ``.item()`` serialises the async dispatch pipeline.
+* **TPL3xx async-blocking hazards** — synchronous work on the event loop
+  in the serving tier (``grpc/``, ``http.py``, ``engine/async_llm.py``),
+  which stalls every in-flight stream at once.
+
+The analyzer knows which functions are jitted: direct ``jax.jit`` /
+``shard_map`` decoration, ``functools.partial(jax.jit, ...)``, call-site
+``jax.jit(fn)`` wrapping (including the entry points compile_tracker's
+``track_jit`` registers), plus a per-file registry for model methods that
+are jitted from another module (tools/tpulint/config.py JIT_REGISTRY).
+
+Findings are suppressed line-local with a mandatory reason::
+
+    np.asarray(packed_dev)  # tpulint: disable=TPL202(one sanctioned fetch per wave)
+
+A reason-less ``disable`` is itself an error (TPL000), so the gate
+enforces that every suppression is explained.  CLI: ``python -m
+tools.tpulint vllm_tgis_adapter_tpu`` or ``nox -s tpulint``; exit codes
+are scriptable (0 clean, 1 findings, 2 internal error) like
+tools/obs_check.py.  See docs/STATIC_ANALYSIS.md for the full rule table.
+"""
+
+from tools.tpulint.analyzer import Finding, analyze_file, analyze_source
+
+__all__ = ["Finding", "analyze_file", "analyze_source"]

@@ -1,0 +1,6 @@
+"""``python -m tools.tpulint`` entry point."""
+
+from tools.tpulint.cli import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

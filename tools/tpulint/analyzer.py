@@ -1,0 +1,624 @@
+"""The tpulint AST passes.
+
+Two passes per module:
+
+* **Pass A (jit index)** — find every jitted function: ``@jax.jit`` /
+  ``@partial(jax.jit, ...)`` decoration, call-site wrapping
+  (``jax.jit(fn, ...)``, ``shard_map(fn, ...)``, including through
+  ``functools.partial``), and the cross-module registry
+  (config.JIT_REGISTRY).  Static parameters are resolved from
+  ``static_argnums``/``static_argnames`` and partial-bound arguments.
+* **Pass B (checker)** — a scoped walk that applies the TPL rules with
+  the jit index, the module's step-loop classification, and the
+  enclosing-function kind (async vs sync) as context.
+
+Suppressions are line-local comments::
+
+    expr  # tpulint: disable=TPL202(reason), TPL201(other reason)
+
+and apply to their own line and the line below (for statements too long
+to carry a trailing comment).  A disable entry without a reason raises
+TPL000 instead of suppressing anything.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import re
+import tokenize
+from pathlib import Path
+from typing import Iterable, Optional, Union
+
+from tools.tpulint import config
+
+_DISABLE_RE = re.compile(r"#\s*tpulint:\s*disable=(?P<body>.+)$")
+# lazy reason + lookahead to the next entry or end-of-comment, so
+# reasons may contain (balanced) parentheses and commas
+_ENTRY_RE = re.compile(
+    r"(TPL\d{3})\s*(?:\((.*?)\))?(?=\s*(?:,\s*TPL\d{3}|$))"
+)
+
+_FuncNode = Union[ast.FunctionDef, ast.AsyncFunctionDef]
+
+
+@dataclasses.dataclass
+class Finding:
+    """One rule hit; ``suppressed`` hits stay in the list for reporting."""
+
+    path: str
+    line: int
+    col: int
+    code: str
+    message: str
+    suppressed: bool = False
+    reason: Optional[str] = None
+
+    def render(self) -> str:
+        tag = f" [suppressed: {self.reason}]" if self.suppressed else ""
+        return f"{self.path}:{self.line}:{self.col}: {self.code} {self.message}{tag}"
+
+
+# --------------------------------------------------------------- helpers
+
+
+def _is_jit_expr(node: ast.expr) -> bool:
+    """``jax.jit`` / bare ``jit`` (imported from jax)."""
+    if isinstance(node, ast.Attribute):
+        return node.attr == "jit"
+    return isinstance(node, ast.Name) and node.id == "jit"
+
+
+def _is_partial_expr(node: ast.expr) -> bool:
+    if isinstance(node, ast.Attribute):
+        return node.attr == "partial"
+    return isinstance(node, ast.Name) and node.id == "partial"
+
+
+def _is_shard_map_expr(node: ast.expr) -> bool:
+    if isinstance(node, ast.Attribute):
+        return node.attr == "shard_map"
+    return isinstance(node, ast.Name) and node.id == "shard_map"
+
+
+def _const_ints(node: Optional[ast.expr]) -> list[int]:
+    """Literal ints from ``static_argnums=(9, 10)`` / ``=9`` forms."""
+    if node is None:
+        return []
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [
+            e.value for e in node.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, int)
+        ]
+    return []
+
+
+def _const_strs(node: Optional[ast.expr]) -> list[str]:
+    if node is None:
+        return []
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [
+            e.value for e in node.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, str)
+        ]
+    return []
+
+
+def _positional_params(fn: _FuncNode) -> list[str]:
+    a = fn.args
+    return [p.arg for p in (*a.posonlyargs, *a.args)]
+
+
+def _all_params(fn: _FuncNode) -> list[str]:
+    a = fn.args
+    return [p.arg for p in (*a.posonlyargs, *a.args, *a.kwonlyargs)]
+
+
+def _identifiers(node: ast.expr) -> Iterable[str]:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name):
+            yield sub.id
+        elif isinstance(sub, ast.Attribute):
+            yield sub.attr
+
+
+def _mentions_shape(node: ast.expr) -> bool:
+    return any(
+        isinstance(sub, ast.Attribute) and sub.attr == "shape"
+        for sub in ast.walk(node)
+    )
+
+
+def _device_hinted(node: ast.expr) -> bool:
+    return any(config.DEVICE_HINTS.search(name) for name in _identifiers(node))
+
+
+def _call_name(func: ast.expr) -> Optional[str]:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def _np_rooted(func: ast.expr) -> bool:
+    return (
+        isinstance(func, ast.Attribute)
+        and isinstance(func.value, ast.Name)
+        and func.value.id in ("np", "numpy")
+    )
+
+
+# ------------------------------------------------------------ suppression
+
+
+def parse_suppressions(
+    source: str,
+) -> tuple[dict[int, dict[str, str]], set[int], list[tuple[int, str]]]:
+    """→ ({lineno: {code: reason}}, {standalone-comment linenos},
+    [(lineno, code) with empty reason]).
+
+    Only real COMMENT tokens count (the tokenize module, not a line
+    regex), so the disable syntax can be quoted in docstrings and
+    strings without acting as a suppression.  ``standalone`` marks
+    comment-only lines: a disable also covers the NEXT line only when
+    it stands alone — a trailing disable must not waive the line below.
+    """
+    by_line: dict[int, dict[str, str]] = {}
+    standalone: set[int] = set()
+    missing: list[tuple[int, str]] = []
+    lines = source.splitlines()
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
+    except (tokenize.TokenError, IndentationError):  # pragma: no cover
+        tokens = []
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = _DISABLE_RE.search(tok.string)
+        if not m:
+            continue
+        lineno = tok.start[0]
+        line_text = lines[lineno - 1] if lineno - 1 < len(lines) else ""
+        if line_text.lstrip().startswith("#"):
+            standalone.add(lineno)
+        entries = by_line.setdefault(lineno, {})
+        for code, reason in _ENTRY_RE.findall(m.group("body")):
+            if reason and reason.strip():
+                entries[code] = reason.strip()
+            else:
+                missing.append((lineno, code))
+    return by_line, standalone, missing
+
+
+# ------------------------------------------------------- pass A: jit index
+
+
+class JitIndex:
+    """Which function/lambda nodes are jitted, and their static params."""
+
+    def __init__(self) -> None:
+        self.defs: dict[_FuncNode, frozenset[str]] = {}
+        self.lambdas: dict[ast.Lambda, frozenset[str]] = {}
+        self.call_sites: list[tuple[ast.Call, Optional[str], bool]] = []
+
+    def statics_for(self, node) -> frozenset[str]:  # noqa: ANN001
+        if isinstance(node, ast.Lambda):
+            return self.lambdas.get(node, frozenset())
+        return self.defs.get(node, frozenset())
+
+
+def _statics_from_keywords(
+    call: ast.Call, target: Optional[_FuncNode]
+) -> frozenset[str]:
+    names: set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            names.update(_const_strs(kw.value))
+        elif kw.arg == "static_argnums" and target is not None:
+            params = _positional_params(target)
+            for i in _const_ints(kw.value):
+                if 0 <= i < len(params):
+                    names.add(params[i])
+    return frozenset(names)
+
+
+def _index_module(
+    tree: ast.Module, rel_path: str
+) -> tuple[JitIndex, dict[_FuncNode, str]]:
+    index = JitIndex()
+
+    # qualnames + name→def map (bare-name resolution is enough here:
+    # jitted locals like decode_steps are unique within their module)
+    qualnames: dict[_FuncNode, str] = {}
+    by_name: dict[str, list[_FuncNode]] = {}
+
+    def fill(node: ast.AST, prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}{child.name}"
+                qualnames[child] = qual
+                by_name.setdefault(child.name, []).append(child)
+                fill(child, f"{qual}.")
+            elif isinstance(child, ast.ClassDef):
+                fill(child, f"{prefix}{child.name}.")
+            else:
+                fill(child, prefix)
+
+    fill(tree, "")
+
+    # registry entries (methods jitted from another module)
+    registered = config.registry_qualnames(rel_path)
+    for node, qual in qualnames.items():
+        if qual in registered:
+            index.defs[node] = config.REGISTRY_STATIC_PARAMS
+
+    # decorators
+    for node in qualnames:
+        for dec in node.decorator_list:
+            if _is_jit_expr(dec) or _is_shard_map_expr(dec):
+                index.defs.setdefault(node, frozenset())
+            elif isinstance(dec, ast.Call) and (
+                _is_jit_expr(dec.func)
+                or (
+                    _is_partial_expr(dec.func)
+                    and dec.args
+                    and _is_jit_expr(dec.args[0])
+                )
+            ):
+                index.defs[node] = index.defs.get(
+                    node, frozenset()
+                ) | _statics_from_keywords(dec, node)
+
+    # call sites: jax.jit(target, ...) / shard_map(target, ...)
+    for call in ast.walk(tree):
+        if not isinstance(call, ast.Call):
+            continue
+        if not (_is_jit_expr(call.func) or _is_shard_map_expr(call.func)):
+            continue
+        if not call.args:
+            continue
+        target = call.args[0]
+        bound_static: set[str] = set()
+        name: Optional[str] = None
+        resolved: list[_FuncNode] = []
+        if isinstance(target, ast.Call) and _is_partial_expr(target.func):
+            # functools.partial(fn, a, b, kw=...): bound args are static
+            inner = target.args[0] if target.args else None
+            if isinstance(inner, ast.Name):
+                name = inner.id
+                resolved = by_name.get(name, [])
+            elif isinstance(inner, ast.Attribute):
+                name = inner.attr
+            bound_static.update(
+                kw.arg for kw in target.keywords if kw.arg is not None
+            )
+            n_bound = max(len(target.args) - 1, 0)
+            for fn in resolved:
+                bound_static.update(_positional_params(fn)[:n_bound])
+        elif isinstance(target, ast.Name):
+            name = target.id
+            resolved = by_name.get(name, [])
+        elif isinstance(target, ast.Attribute):
+            name = target.attr
+        elif isinstance(target, ast.Lambda):
+            statics = _statics_from_keywords(call, None)
+            index.lambdas[target] = (
+                index.lambdas.get(target, frozenset()) | statics
+            )
+        for fn in resolved:
+            statics = _statics_from_keywords(call, fn) | bound_static
+            index.defs[fn] = index.defs.get(fn, frozenset()) | frozenset(
+                statics
+            )
+        zero_arg_lambda = (
+            isinstance(target, ast.Lambda)
+            and not _positional_params_of_lambda(target)
+        )
+        index.call_sites.append((call, name, zero_arg_lambda))
+
+    return index, qualnames
+
+
+def _positional_params_of_lambda(lam: ast.Lambda) -> list[str]:
+    a = lam.args
+    return [p.arg for p in (*a.posonlyargs, *a.args)]
+
+
+# ------------------------------------------------------- pass B: checker
+
+
+class _Checker(ast.NodeVisitor):
+    def __init__(
+        self,
+        rel_path: str,
+        index: JitIndex,
+        findings: list[Finding],
+        awaited: Optional[set] = None,
+    ) -> None:
+        self.rel_path = rel_path
+        self.index = index
+        self.findings = findings
+        self.step_loop = config.is_step_loop_module(rel_path)
+        # (kind, traced-params, static-params); nested defs inside a
+        # jitted function inherit its frame — they are traced too
+        self._frames: list[tuple[str, frozenset[str], frozenset[str]]] = []
+        # awaited calls are async-native, not event-loop blockers
+        self._awaited: set = awaited or set()
+        self._raise_depth = 0
+
+    # ----- frame helpers
+
+    def _push(self, node, kind: str) -> None:  # noqa: ANN001
+        jitted = (
+            node in self.index.defs
+            if not isinstance(node, ast.Lambda)
+            else node in self.index.lambdas
+        )
+        if jitted:
+            params = frozenset(
+                _all_params(node)
+                if not isinstance(node, ast.Lambda)
+                else _positional_params_of_lambda(node)
+            )
+            statics = self.index.statics_for(node)
+            self._frames.append((kind, params, statics))
+        elif self._frames and self._frames[-1][1]:
+            # keep the enclosing jit context, switch the function kind
+            self._frames.append((kind, *self._frames[-1][1:]))
+        else:
+            self._frames.append((kind, frozenset(), frozenset()))
+
+    @property
+    def _in_jit(self) -> bool:
+        return bool(self._frames) and bool(self._frames[-1][1])
+
+    @property
+    def _in_async(self) -> bool:
+        return bool(self._frames) and self._frames[-1][0] == "async"
+
+    def _emit(self, node: ast.AST, code: str, detail: str = "") -> None:
+        message = config.RULES[code].split(" (")[0]
+        if detail:
+            message = f"{message}: {detail}"
+        self.findings.append(
+            Finding(
+                path=self.rel_path,
+                line=getattr(node, "lineno", 1),
+                col=getattr(node, "col_offset", 0),
+                code=code,
+                message=message,
+            )
+        )
+
+    # ----- scope tracking
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._check_jit_decl(node)
+        self._push(node, "sync")
+        self.generic_visit(node)
+        self._frames.pop()
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._check_jit_decl(node)
+        self._push(node, "async")
+        self.generic_visit(node)
+        self._frames.pop()
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        self._push(node, "lambda")
+        self.generic_visit(node)
+        self._frames.pop()
+
+    # ----- TPL103: static coverage at the jit declaration
+
+    def _check_jit_decl(self, node: _FuncNode) -> None:
+        statics = self.index.defs.get(node)
+        if statics is None:
+            return
+        for arg in (*node.args.posonlyargs, *node.args.args,
+                    *node.args.kwonlyargs):
+            if arg.arg in statics or arg.arg == "self":
+                continue
+            ann = arg.annotation
+            if (
+                isinstance(ann, ast.Name)
+                and ann.id in ("int", "bool")
+            ):
+                self._emit(
+                    node, "TPL103",
+                    f"parameter {arg.arg!r} of jitted {node.name!r}",
+                )
+
+    # ----- TPL101: traced-value branching
+
+    def _check_test(self, stmt: ast.AST, test: ast.expr) -> None:
+        if not self._in_jit:
+            return
+        _, params, statics = self._frames[-1]
+        traced = params - statics
+        for comp in ast.walk(test):
+            if not isinstance(comp, ast.Compare):
+                continue
+            if all(
+                isinstance(op, (ast.Is, ast.IsNot, ast.In, ast.NotIn))
+                for op in comp.ops
+            ):
+                continue  # `x is None` / `"k" in layer` are trace-static
+            for side in (comp.left, *comp.comparators):
+                hit = _mentions_shape(side) or any(
+                    isinstance(sub, ast.Name) and sub.id in traced
+                    for sub in ast.walk(side)
+                )
+                if hit:
+                    self._emit(stmt, "TPL101")
+                    return
+
+    def visit_If(self, node: ast.If) -> None:
+        self._check_test(node, node.test)
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        self._check_test(node, node.test)
+        self.generic_visit(node)
+
+    def visit_IfExp(self, node: ast.IfExp) -> None:
+        self._check_test(node, node.test)
+        self.generic_visit(node)
+
+    # ----- TPL102: shape-keyed strings / dict keys
+
+    def visit_Raise(self, node: ast.Raise) -> None:
+        # shape-formatted *error messages* are trace-time validation,
+        # not shape-keyed control flow — exempt
+        self._raise_depth += 1
+        self.generic_visit(node)
+        self._raise_depth -= 1
+
+    def visit_JoinedStr(self, node: ast.JoinedStr) -> None:
+        if self._in_jit and not self._raise_depth and _mentions_shape(node):
+            self._emit(node, "TPL102")
+        self.generic_visit(node)
+
+    def visit_Dict(self, node: ast.Dict) -> None:
+        if self._in_jit and any(
+            key is not None and _mentions_shape(key) for key in node.keys
+        ):
+            self._emit(node, "TPL102")
+        self.generic_visit(node)
+
+    # ----- call-shaped rules
+
+    def visit_Call(self, node: ast.Call) -> None:
+        func = node.func
+        name = _call_name(func)
+
+        if self.step_loop:
+            # TPL201: explicit syncs
+            if isinstance(func, ast.Attribute) and (
+                name in config.SYNC_ATTR_CALLS or name == "device_get"
+            ):
+                self._emit(node, "TPL201", f"{name}()")
+            # TPL202: device→host pulls on hint-named values
+            elif (
+                _np_rooted(func)
+                and name in config.HOST_PULLS
+                and node.args
+                and _device_hinted(node.args[0])
+            ):
+                self._emit(node, "TPL202", f"np.{name}(...)")
+            elif (
+                isinstance(func, ast.Name)
+                and name in config.HOST_CASTS
+                and len(node.args) == 1
+                and _device_hinted(node.args[0])
+            ):
+                self._emit(node, "TPL202", f"{name}(...)")
+
+        if self._in_async:
+            if (
+                isinstance(func, ast.Attribute)
+                and name == "sleep"
+                and isinstance(func.value, ast.Name)
+                and func.value.id in config.SLEEP_MODULES
+            ):
+                self._emit(node, "TPL301")
+            elif (
+                (
+                    isinstance(func, ast.Name)
+                    and name in config.SYNC_IO_NAMES
+                )
+                or (
+                    isinstance(func, ast.Attribute)
+                    and name in config.SYNC_IO_ATTRS
+                )
+            ) and node not in self._awaited:
+                # awaited calls are async-native (aiopath-style APIs
+                # share these method names)
+                self._emit(node, "TPL302", f"{name}(...)")
+            elif (
+                name in config.BLOCKING_HELPERS
+                and node not in self._awaited
+            ):
+                self._emit(node, "TPL303", f"{name}(...)")
+
+        self.generic_visit(node)
+
+
+def _check_jit_call_sites(index: JitIndex, rel_path: str,
+                          findings: list[Finding]) -> None:
+    """TPL104 at runtime-wrapped jit entry points: large-buffer names
+    must carry donate_argnums (decorated kernel jits are read-only by
+    convention here and exempt)."""
+    for call, name, zero_arg_lambda in index.call_sites:
+        if _is_shard_map_expr(call.func):
+            continue
+        if zero_arg_lambda or name is None:
+            continue
+        if not config.LARGE_BUFFER.search(name):
+            continue
+        if any(kw.arg == "donate_argnums" for kw in call.keywords):
+            continue
+        findings.append(
+            Finding(
+                path=rel_path,
+                line=call.lineno,
+                col=call.col_offset,
+                code="TPL104",
+                message=f"{config.RULES['TPL104'].split(' (')[0]}: "
+                        f"jax.jit({name}, ...)",
+            )
+        )
+
+
+# ------------------------------------------------------------- public API
+
+
+def analyze_source(source: str, rel_path: str) -> list[Finding]:
+    """All findings for one module (suppressed ones flagged, not
+    dropped, so callers can audit the suppression inventory)."""
+    tree = ast.parse(source, filename=rel_path)
+    index, _ = _index_module(tree, rel_path)
+
+    findings: list[Finding] = []
+    suppressions, standalone, missing_reasons = parse_suppressions(source)
+    for lineno, code in missing_reasons:
+        findings.append(
+            Finding(
+                path=rel_path,
+                line=lineno,
+                col=0,
+                code="TPL000",
+                message=f"{config.RULES['TPL000'].split(': #')[0]} "
+                        f"(disable={code})",
+            )
+        )
+
+    _check_jit_call_sites(index, rel_path, findings)
+    awaited = {n.value for n in ast.walk(tree) if isinstance(n, ast.Await)}
+    _Checker(rel_path, index, findings, awaited).visit(tree)
+
+    for f in findings:
+        if f.code == "TPL000":
+            continue  # the audit rule itself cannot be waived
+        # own line (trailing comment), or a STANDALONE disable directly
+        # above — a trailing disable never waives the line below it
+        reason = suppressions.get(f.line, {}).get(f.code)
+        if reason is None and f.line - 1 in standalone:
+            reason = suppressions.get(f.line - 1, {}).get(f.code)
+        if reason is not None:
+            f.suppressed = True
+            f.reason = reason
+    findings.sort(key=lambda f: (f.line, f.col, f.code))
+    return findings
+
+
+def analyze_file(path, root=None) -> list[Finding]:  # noqa: ANN001
+    p = Path(path)
+    rel = p.relative_to(root).as_posix() if root else p.as_posix()
+    return analyze_source(p.read_text(encoding="utf-8"), rel)

@@ -1,0 +1,128 @@
+"""Rule table and project knowledge for tpulint.
+
+Everything project-specific lives here — which modules sit on the engine
+step loop, which model methods are jitted from other modules, which
+helper calls are known to block the event loop — so the analyzer itself
+stays a generic AST pass.
+"""
+
+from __future__ import annotations
+
+import re
+
+#: rule code → one-line description (docs/STATIC_ANALYSIS.md carries the
+#: full rationale per rule; keep the two in sync — test_tpulint checks).
+RULES: dict[str, str] = {
+    "TPL000": "suppression without a reason: # tpulint: disable=CODE "
+              "must carry (why) so the gate stays auditable",
+    "TPL101": "Python branch on a traced value/shape inside a jitted "
+              "function (every novel outcome re-traces and recompiles)",
+    "TPL102": "f-string or dict key built from an array .shape inside a "
+              "jitted function (shape-keyed control flow leaks retraces)",
+    "TPL103": "likely-static control parameter (int/bool) jitted without "
+              "static_argnums/static_argnames (recompile-by-value or "
+              "tracer leak)",
+    "TPL104": "jax.jit of a large-buffer entry point without a "
+              "donate_argnums kwarg (transiently doubles HBM)",
+    "TPL201": "explicit host synchronisation on the step path (.item(), "
+              "jax.device_get, block_until_ready)",
+    "TPL202": "implicit device→host pull on the step path (np.asarray/"
+              "float()/int()/bool() on a device-array-named value)",
+    "TPL301": "time.sleep inside async code (stalls every in-flight "
+              "stream; use asyncio.sleep)",
+    "TPL302": "synchronous file/network I/O inside async code (move it "
+              "to asyncio.to_thread or a sync helper off the loop)",
+    "TPL303": "known-blocking engine/device call on the event loop "
+              "(dispatch via asyncio.to_thread like the step loop does)",
+}
+
+#: modules reachable from the engine step loop (engine/core.py →
+#: runner.py → pipeline.py → ops/*): the TPL2xx host-sync scope.
+#: Entries ending in "/" match directories, others match path suffixes.
+STEP_LOOP_PATHS: tuple[str, ...] = (
+    "engine/core.py",
+    "engine/runner.py",
+    "engine/pipeline.py",
+    "engine/speculative.py",
+    "engine/sampler.py",
+    "ops/",
+    "models/",
+)
+
+#: functions jitted from ANOTHER module (jax.jit(model.prefill) in
+#: engine/runner.py), which call-site detection cannot see.  Keyed by
+#: path suffix; values are qualnames within that file.
+JIT_REGISTRY: dict[str, frozenset[str]] = {
+    "models/llama.py": frozenset({
+        "LlamaForCausalLM.prefill",
+        "LlamaForCausalLM.prefill_chunk",
+        "LlamaForCausalLM.decode",
+    }),
+}
+
+#: registry-method params that are static at every jit site (bound via
+#: functools.partial or passed as Python scalars, never traced).
+REGISTRY_STATIC_PARAMS: frozenset[str] = frozenset({
+    "self", "block_size", "first_stage", "last_stage",
+})
+
+#: identifiers that mark a value as (probably) a live device array for
+#: TPL202 — the documented naming discipline for device handles in this
+#: codebase (packed result buffers, logits, KV caches, stage hiddens).
+DEVICE_HINTS = re.compile(
+    r"pack|logits|cache|hidden|handle|_dev\b|device", re.IGNORECASE
+)
+
+#: np.<fn>(x) that materialise x on host (one blocking transfer each).
+HOST_PULLS: frozenset[str] = frozenset({"asarray", "array"})
+
+#: builtin casts that force a scalar device→host round trip.
+HOST_CASTS: frozenset[str] = frozenset({"float", "int", "bool"})
+
+#: method calls that are *always* an explicit sync (TPL201).
+SYNC_ATTR_CALLS: frozenset[str] = frozenset({"item", "block_until_ready"})
+
+#: jit targets that move whole KV caches / weight-sized buffers and must
+#: donate them (TPL104); zero-arg lambdas are exempt (nothing to donate).
+LARGE_BUFFER = re.compile(r"prefill|decode|scatter|restore|cache")
+
+#: synchronous I/O surfaces for TPL302: bare calls by name …
+SYNC_IO_NAMES: frozenset[str] = frozenset({"open"})
+#: … and method/attr calls.  Deliberately specific (``.read()`` alone is
+#: too ambiguous — StreamReader.read is async).
+SYNC_IO_ATTRS: frozenset[str] = frozenset({
+    "read_text", "read_bytes", "write_text", "write_bytes",
+    "urlopen", "load_cert_chain", "load_verify_locations",
+    "check_output", "check_call",
+})
+
+#: project helpers known to block (device waits, file reads) that must
+#: ride asyncio.to_thread when called from async code (TPL303).
+BLOCKING_HELPERS: frozenset[str] = frozenset({
+    "wait_step", "dispatch_step", "dispatch_chained_step", "precompile",
+    "_tls_credentials", "block_until_ready",
+})
+
+#: time.sleep spelling for TPL301.
+SLEEP_MODULES: frozenset[str] = frozenset({"time"})
+
+
+def is_step_loop_module(rel_path: str) -> bool:
+    """Does ``rel_path`` (posix, repo-relative) sit on the step loop?"""
+    rel = rel_path.replace("\\", "/")
+    for entry in STEP_LOOP_PATHS:
+        if entry.endswith("/"):
+            if rel.startswith(entry) or f"/{entry}" in rel:
+                return True
+        elif rel.endswith(entry):
+            return True
+    return False
+
+
+def registry_qualnames(rel_path: str) -> frozenset[str]:
+    """Registry-jitted qualnames for ``rel_path``, if any."""
+    rel = rel_path.replace("\\", "/")
+    for suffix, names in JIT_REGISTRY.items():
+        if rel.endswith(suffix):
+            return names
+    return frozenset()

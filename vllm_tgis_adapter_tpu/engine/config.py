@@ -28,7 +28,8 @@ _DTYPE_MAP = {
 }
 
 
-def resolve_dtype(name: str, default: str = "bfloat16"):
+def resolve_dtype(name: str, default: str = "bfloat16") -> Any:
+    """``"bfloat16"``-style name → jnp scalar type (jit-static)."""
     if name in ("auto", None):
         name = default
     if name not in _DTYPE_MAP:
@@ -736,7 +737,7 @@ class SchedulerConfig:
     # sequence are discarded — cheap next to the dispatch savings.
     num_decode_steps: int = 8
 
-    def __post_init__(self):
+    def __post_init__(self) -> None:
         if self.num_decode_steps < 1:
             raise ValueError(
                 f"num_decode_steps must be >= 1 "

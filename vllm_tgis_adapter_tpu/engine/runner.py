@@ -59,6 +59,7 @@ class PromptLogprobInfo:
     def from_packed(cls, packed_dev, n: int) -> "PromptLogprobInfo":
         """Unpack sampler.pack_prompt_logprob_parts — one device fetch
         for the whole prompt-logprob row table."""
+        # tpulint: disable=TPL202(sanctioned sync: the ONE packed fetch per prompt-logprob table, called from the blocking wait_* half only)
         packed = np.asarray(packed_dev)[:n]  # [n, 2+2W]
         w = (packed.shape[-1] - 2) // 2
         return cls(
@@ -176,6 +177,7 @@ class _HostSamplerOutput:
         """Unpack sampler.pack_output's single buffer — ONE device
         fetch for the whole result (decode waves and prefill samples
         both ride this through the tunnel)."""
+        # tpulint: disable=TPL202(sanctioned sync: the ONE packed fetch per wave, called from the blocking wait_* half only)
         packed = np.asarray(packed_dev)  # [..., 3+2W]
         w = (packed.shape[-1] - 3) // 2
         return _HostSamplerOutput(
@@ -512,8 +514,8 @@ class ModelRunner:
         k_cache, v_cache = self.caches
         idx = jnp.asarray(slots, jnp.int32)
         return (
-            np.asarray(jnp.take(k_cache, idx, axis=2)),
-            np.asarray(jnp.take(v_cache, idx, axis=2)),
+            np.asarray(jnp.take(k_cache, idx, axis=2)),  # tpulint: disable=TPL202(swap-out IS the device→host copy; runs on a clean dispatch boundary)
+            np.asarray(jnp.take(v_cache, idx, axis=2)),  # tpulint: disable=TPL202(swap-out IS the device→host copy; runs on a clean dispatch boundary)
         )
 
     @staticmethod

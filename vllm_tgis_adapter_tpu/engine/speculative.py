@@ -647,6 +647,7 @@ class SpeculativeDecoder:
 
         from vllm_tgis_adapter_tpu.engine.runner import _HostSamplerOutput
 
+        # tpulint: disable=TPL202(sanctioned sync: spec verify is a host-synchronised phase by design — one packed fetch for the whole window)
         packed = np.asarray(_pack_spec_results(
             emitted, accepted, lp, rank, topn_ids, topn_lp
         ))  # [B, K, 4+2W] — one fetch for the whole dispatch

@@ -595,7 +595,10 @@ async def start_grpc_server(
     )
 
     address = f"{args.host or '0.0.0.0'}:{args.grpc_port}"  # noqa: S104
-    creds = _tls_credentials(args)
+    # key/cert files are read off the event loop (tpulint TPL303): boot
+    # shares the loop with an engine that may already be serving health
+    # probes, and NFS-mounted cert dirs can stall for seconds
+    creds = await asyncio.to_thread(_tls_credentials, args)
     if creds is not None:
         server.add_secure_port(address, creds)
     else:

@@ -819,6 +819,21 @@ def _http_date() -> str:
     return formatdate(time.time(), usegmt=True)
 
 
+def _build_ssl_context(
+    args: "argparse.Namespace",
+) -> Optional[ssl_module.SSLContext]:
+    """Blocking half of TLS setup (cert/key/CA file reads); callers on
+    the event loop dispatch it through ``asyncio.to_thread``."""
+    if not (args.ssl_keyfile and args.ssl_certfile):
+        return None
+    ssl_context = ssl_module.SSLContext(ssl_module.PROTOCOL_TLS_SERVER)
+    ssl_context.load_cert_chain(args.ssl_certfile, args.ssl_keyfile)
+    if args.ssl_ca_certs:
+        ssl_context.load_verify_locations(args.ssl_ca_certs)
+        ssl_context.verify_mode = ssl_module.CERT_REQUIRED
+    return ssl_context
+
+
 async def run_http_server(
     args: "argparse.Namespace",
     engine: "AsyncLLMEngine",
@@ -826,13 +841,9 @@ async def run_http_server(
     sock: Optional[socket.socket] = None,
 ) -> None:
     """Serve the app forever on ``sock`` (pre-bound by the entrypoint)."""
-    ssl_context = None
-    if args.ssl_keyfile and args.ssl_certfile:
-        ssl_context = ssl_module.SSLContext(ssl_module.PROTOCOL_TLS_SERVER)
-        ssl_context.load_cert_chain(args.ssl_certfile, args.ssl_keyfile)
-        if args.ssl_ca_certs:
-            ssl_context.load_verify_locations(args.ssl_ca_certs)
-            ssl_context.verify_mode = ssl_module.CERT_REQUIRED
+    # cert files load off the loop (tpulint TPL302): the gRPC server and
+    # engine step loop are already live when the HTTP tier boots
+    ssl_context = await asyncio.to_thread(_build_ssl_context, args)
 
     async def client_connected(
         reader: asyncio.StreamReader, writer: asyncio.StreamWriter

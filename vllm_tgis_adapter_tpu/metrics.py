@@ -7,6 +7,8 @@ Here the registry is fed directly by our engine and servers.
 
 from __future__ import annotations
 
+from typing import Any, Callable, TypeVar
+
 from prometheus_client import (
     REGISTRY,
     Counter,
@@ -17,13 +19,17 @@ from prometheus_client import (
 
 _PREFIX = "tgis_tpu"
 
+_C = TypeVar("_C")
+
 # every collector this module ever constructed, keyed by metric name — the
 # idempotency source of truth, so re-registration never has to reach into
 # prometheus_client's private registry internals
-_COLLECTORS: dict[str, object] = {}
+_COLLECTORS: dict[str, Any] = {}
 
 
-def _get_or_create(cls, name: str, doc: str, **kwargs):  # noqa: ANN001, ANN003, ANN202
+def _get_or_create(
+    cls: Callable[..., _C], name: str, doc: str, **kwargs: Any
+) -> _C:
     """Idempotent metric construction (tests boot multiple servers)."""
     collector = _COLLECTORS.get(name)
     if collector is None:

@@ -23,9 +23,15 @@ import secrets
 import threading
 import time
 import urllib.request
-from typing import Optional
+from typing import TYPE_CHECKING, Optional
 
 from vllm_tgis_adapter_tpu.logging import init_logger
+
+if TYPE_CHECKING:
+    from vllm_tgis_adapter_tpu.engine.outputs import (
+        RequestMetrics,
+        RequestOutput,
+    )
 
 logger = init_logger(__name__)
 
@@ -105,7 +111,7 @@ class Span:
     events: list = dataclasses.field(default_factory=list)
 
     def otlp_json(self) -> dict:
-        def value(v):  # noqa: ANN001, ANN202
+        def value(v: object) -> dict:
             if isinstance(v, bool):
                 return {"boolValue": v}
             if isinstance(v, int):
@@ -257,7 +263,9 @@ class RequestTracer:
             attributes={"gen_ai.request.id": request_id},
         )
 
-    def finish_span(self, span: Span, final_output) -> None:  # noqa: ANN001
+    def finish_span(
+        self, span: Span, final_output: "Optional[RequestOutput]"
+    ) -> None:
         span.end_ns = time.time_ns()
         if final_output is not None:
             completion = (
@@ -296,7 +304,7 @@ class RequestTracer:
         self._exporter.export(span)
 
     @staticmethod
-    def _phase_children(parent: Span, m) -> list[Span]:  # noqa: ANN001
+    def _phase_children(parent: Span, m: "RequestMetrics") -> list[Span]:
         """Queue/prefill/decode/detokenize child spans derived from the
         engine's RequestMetrics timestamps.
 
