@@ -965,9 +965,22 @@ def test_precompile_warms_shapes_and_leaves_engine_clean(engine_factory):
         return inner(plan, prepared, prev_handle)
 
     engine.dispatch_chained_step = spy
+    solo_buckets = []
+    inner_prefill = engine.runner.dispatch_prefill
+
+    def prefill_spy(prep):
+        solo_buckets.append(len(prep.token_ids))  # padded to the bucket
+        return inner_prefill(prep)
+
+    engine.runner.dispatch_prefill = prefill_spy
     n = engine.precompile("all")
-    # widths 1, 2, 4 x two topn variants -> 14 warmup requests
-    assert n == 14
+    # widths 1, 2, 4 x two topn variants -> 14 warmup requests, plus the
+    # bucket-coverage sweep for every solo prefill shape the width loops'
+    # PACKED admissions swallowed (coverage is recorded from dispatched
+    # plans, not at add_request time): bucket 64 here -> 15 total
+    assert n == 15
+    # every prefill bucket's SOLO program actually compiled
+    assert set(solo_buckets) >= {32, 64, 128}, solo_buckets
     # the chained program compiled in warmup AT THE FULL BATCH WIDTH
     # (the production shape) - not just narrow tail batches
     assert chained_calls[0] > 0
@@ -999,5 +1012,7 @@ def test_precompile_warms_shapes_and_leaves_engine_clean(engine_factory):
 def test_precompile_max_only_widest_batch(engine_factory):
     engine = engine_factory(max_num_seqs=4,
                             scheduler_kwargs=dict(num_decode_steps=4))
-    assert engine.precompile("max") == 4
+    # widest batch only (4 requests) + the solo-bucket sweep for the two
+    # buckets (32, 64) whose solo shapes the packed admission swallowed
+    assert engine.precompile("max") == 6
     assert not engine.has_unfinished_requests()

@@ -1,0 +1,515 @@
+"""Flight recorder + stall watchdog + live introspection (docs/OBSERVABILITY.md).
+
+CPU-backed: the ring buffer's bounds/eviction/ordering contracts, the
+snapshot serializers' golden shape, watchdog stall detection (heartbeat
+starvation fires a dump; an in-flight compile suspends it; dump file +
+termination log carry the snapshot), and the HTTP debug surfaces via the
+real app dispatch.  The gRPC twins of these endpoints are covered in
+test_grpc_server.py (they need generated pb modules).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import re
+
+import pytest
+
+
+def _sample(text: str, name: str, labels: tuple[str, ...] = ()) -> float:
+    for line in text.splitlines():
+        m = re.match(rf"^{re.escape(name)}(\{{[^}}]*\}})? (\S+)$", line)
+        if m and all(lbl in (m.group(1) or "") for lbl in labels):
+            return float(m.group(2))
+    return 0.0
+
+
+def _scrape() -> str:
+    from vllm_tgis_adapter_tpu import metrics
+
+    return metrics.render().decode()
+
+
+# ------------------------------------------------------------- ring buffer
+
+
+def test_ring_bounds_and_eviction():
+    from vllm_tgis_adapter_tpu.flight_recorder import FlightRecorder
+
+    rec = FlightRecorder(capacity=8)
+    for i in range(20):
+        rec.record("admit", f"r{i}", step=i)
+    assert len(rec) == 8
+    assert rec.total_recorded == 20
+    events = rec.events()
+    # oldest 12 evicted; survivors keep arrival order
+    assert [e["request_id"] for e in events] == [
+        f"r{i}" for i in range(12, 20)
+    ]
+    assert [e["request_id"] for e in rec.events(last_n=3)] == [
+        "r17", "r18", "r19"
+    ]
+    # evicted requests leave no timeline
+    assert rec.events_for("r0") == []
+    assert len(rec.events_for("r19")) == 1
+
+
+def test_event_ordering_fields_and_metrics():
+    from vllm_tgis_adapter_tpu.flight_recorder import FlightRecorder
+
+    before = _sample(
+        _scrape(), "tgis_tpu_flight_recorder_events_total",
+        ('kind="preempt"',),
+    )
+    rec = FlightRecorder()
+    rec.record("admit", "req-1", step=1, prompt_tokens=7)
+    rec.record("decode", step=2, num_seqs=3, batch_bucket=4)
+    rec.record("preempt", "req-1", step=3, was_running=True)
+    events = rec.events()
+    assert [e["kind"] for e in events] == ["admit", "decode", "preempt"]
+    # monotonic stamps are non-decreasing: the ring IS the ordering
+    monos = [e["mono_ns"] for e in events]
+    assert monos == sorted(monos)
+    assert events[0]["detail"] == {"prompt_tokens": 7}
+    assert events[0]["step"] == 1
+    assert "request_id" not in events[1]  # batch-level event
+    assert events[2]["detail"] == {"was_running": True}
+    after = _sample(
+        _scrape(), "tgis_tpu_flight_recorder_events_total",
+        ('kind="preempt"',),
+    )
+    assert after - before == 1
+
+
+def test_trace_id_correlation():
+    from vllm_tgis_adapter_tpu.flight_recorder import FlightRecorder
+
+    rec = FlightRecorder()
+    rec.record("admit", "req-a", trace_id="a" * 32)
+    rec.record("admit", "req-b", trace_id="b" * 32)
+    rec.record("finish", "req-a", trace_id="a" * 32, reason="stop")
+    timeline = rec.events_for("req-a")
+    assert [e["kind"] for e in timeline] == ["admit", "finish"]
+    assert all(e["trace_id"] == "a" * 32 for e in timeline)
+
+
+# ------------------------------------------------------------ serializers
+
+
+def test_allocator_stats_golden_shape():
+    from vllm_tgis_adapter_tpu.engine.kv_cache import BlockAllocator
+    from vllm_tgis_adapter_tpu.flight_recorder import allocator_stats
+
+    alloc = BlockAllocator(16, 4)
+    held = alloc.allocate(4)
+    stats = allocator_stats(alloc)
+    assert stats == {
+        "num_blocks": 16,
+        "used": 4,
+        "free": 12,
+        "cached_free": 0,
+        "occupancy": 4 / 16,
+        "fragmentation": 0.0,
+        "free_epochs_open": 0,
+        "quarantined": 0,
+        "prefix_hit_tokens": 0,
+    }
+    # frees inside an open epoch quarantine instead of freeing
+    alloc.begin_free_epoch()
+    alloc.free(held)
+    stats = allocator_stats(alloc)
+    assert stats["free_epochs_open"] == 1
+    assert stats["quarantined"] == 4
+    assert stats["used"] == 4  # still held until the epoch flushes
+    alloc.flush_free_epoch()
+    assert allocator_stats(alloc)["used"] == 0
+
+
+def test_scheduler_queue_snapshot():
+    from vllm_tgis_adapter_tpu.engine.config import (
+        CacheConfig,
+        SchedulerConfig,
+    )
+    from vllm_tgis_adapter_tpu.engine.sampling_params import SamplingParams
+    from vllm_tgis_adapter_tpu.engine.scheduler import Scheduler
+    from vllm_tgis_adapter_tpu.engine.sequence import Sequence
+    from vllm_tgis_adapter_tpu.flight_recorder import scheduler_queues
+
+    sched = Scheduler(
+        SchedulerConfig(max_num_seqs=4, prefill_buckets=(32,)),
+        CacheConfig(block_size=16, num_blocks=8, cache_dtype="float32"),
+        num_blocks=8,
+    )
+    seq = Sequence("snap-1", None, [1, 2, 3], SamplingParams(max_tokens=4))
+    seq.trace_id = "c" * 32
+    sched.add(seq)
+    snap = scheduler_queues(sched)
+    assert snap["num_unfinished"] == 1
+    assert snap["running"] == [] and snap["swapped"] == []
+    (info,) = snap["waiting"]
+    assert info["request_id"] == "snap-1"
+    assert info["status"] == "WAITING"
+    assert info["prompt_tokens"] == 3
+    assert info["trace_id"] == "c" * 32
+    assert info["age_s"] >= 0
+    json.dumps(snap)  # the snapshot must be JSON-serializable as-is
+
+
+# ------------------------------------------------------------ real engine
+
+
+def _build_engine(tiny_model_dir, **overrides):
+    from vllm_tgis_adapter_tpu.engine.async_llm import AsyncLLMEngine
+    from vllm_tgis_adapter_tpu.engine.config import (
+        CacheConfig,
+        EngineConfig,
+        LoRAConfig,
+        ModelConfig,
+        ParallelConfig,
+        SchedulerConfig,
+    )
+
+    mcfg = ModelConfig.from_pretrained(tiny_model_dir, dtype="float32")
+    config = EngineConfig(
+        model_config=mcfg,
+        cache_config=CacheConfig(
+            block_size=16, num_blocks=64, cache_dtype=mcfg.dtype
+        ),
+        scheduler_config=SchedulerConfig(
+            max_num_seqs=2, prefill_buckets=(32, 64)
+        ),
+        parallel_config=ParallelConfig(),
+        lora_config=LoRAConfig(),
+        **overrides,
+    )
+    return AsyncLLMEngine.from_config(config)
+
+
+async def _generate_one(engine, request_id: str, max_tokens: int = 4):
+    from vllm_tgis_adapter_tpu.engine.sampling_params import SamplingParams
+
+    final = None
+    async for out in engine.generate(
+        prompt=None,
+        sampling_params=SamplingParams(
+            temperature=0.0, max_tokens=max_tokens, ignore_eos=True
+        ),
+        request_id=request_id,
+        prompt_token_ids=list(range(3, 20)),
+    ):
+        final = out
+    return final
+
+
+def test_engine_records_lifecycle_and_debug_state(tiny_model_dir):
+    """A served request leaves an admit → prefill → finish timeline in
+    the recorder, and debug_state() carries queues, KV stats, compile
+    state, and those events in one JSON-serializable snapshot."""
+    engine = _build_engine(tiny_model_dir)
+
+    async def scenario():
+        await _generate_one(engine, "fr-live-1")
+        state = engine.debug_state()
+        trace = engine.request_trace("fr-live-1")
+        missing = engine.request_trace("never-admitted")
+        await engine.stop()
+        return state, trace, missing
+
+    state, trace, missing = asyncio.run(scenario())
+    json.dumps(state)  # wire-ready as-is
+
+    assert state["engine"]["replicas"] == 1
+    (replica,) = state["replicas"]
+    assert replica["scheduler"]["num_unfinished"] == 0
+    assert replica["kv_cache"]["num_blocks"] == 64
+    assert 0.0 <= replica["kv_cache"]["occupancy"] <= 1.0
+    assert state["compile_tracker"]["compiled_shapes"] >= 0
+    assert state["watchdog"]["deadline_s"] == 120.0
+    kinds = {e["kind"] for e in state["events"]}
+    assert {"admit", "prefill", "decode", "finish"} <= kinds
+
+    assert missing is None
+    assert trace["request_id"] == "fr-live-1"
+    assert trace["live"] is None  # finished: no longer resident
+    t_kinds = [e["kind"] for e in trace["events"]]
+    assert t_kinds[0] == "admit" and t_kinds[-1] == "finish"
+    # finish carries the reason; every event of one request shares a step
+    # ordering consistent with the engine's dispatch counter
+    assert trace["events"][-1]["detail"]["reason"] == "length"
+    steps = [e["step"] for e in trace["events"]]
+    assert steps == sorted(steps)
+
+
+def test_abort_event_recorded(tiny_model_dir):
+    engine = _build_engine(tiny_model_dir)
+
+    async def scenario():
+        from vllm_tgis_adapter_tpu.engine.sampling_params import (
+            SamplingParams,
+        )
+
+        gen = engine.generate(
+            prompt=None,
+            sampling_params=SamplingParams(
+                temperature=0.0, max_tokens=500, ignore_eos=True
+            ),
+            request_id="fr-abort-1",
+            prompt_token_ids=list(range(3, 20)),
+        )
+        await gen.__anext__()  # wait until it is producing
+        await engine.abort("fr-abort-1")
+        await gen.aclose()
+        for _ in range(100):
+            if not engine.engine.has_unfinished_requests():
+                break
+            await asyncio.sleep(0.02)
+        trace = engine.request_trace("fr-abort-1")
+        await engine.stop()
+        return trace
+
+    trace = asyncio.run(scenario())
+    assert "abort" in [e["kind"] for e in trace["events"]]
+
+
+# --------------------------------------------------------------- watchdog
+
+
+@pytest.fixture()
+def _clean_tracker():
+    from vllm_tgis_adapter_tpu import compile_tracker
+
+    compile_tracker.reset()
+    yield
+    compile_tracker.reset()
+
+
+def _watchdog(tmp_path, term_log, **kwargs):
+    from vllm_tgis_adapter_tpu.watchdog import StallWatchdog
+
+    defaults = dict(
+        snapshot_fn=lambda: {"replicas": [], "events": []},
+        active_fn=lambda: True,
+        deadline_s=0.05,
+        dump_dir=str(tmp_path / "dumps"),
+        termination_log=str(term_log),
+    )
+    defaults.update(kwargs)
+    return StallWatchdog(**defaults)
+
+
+def test_watchdog_fires_on_heartbeat_starvation(tmp_path, _clean_tracker):
+    term_log = tmp_path / "termination-log"
+    term_log.write_text("")  # must exist (write_termination_log contract)
+    stalls_0 = _sample(_scrape(), "tgis_tpu_watchdog_stalls_total")
+
+    async def scenario():
+        wd = _watchdog(tmp_path, term_log)
+        wd.beat()
+        assert await wd.check() is None  # fresh heartbeat: healthy
+        await asyncio.sleep(0.08)
+        fired = await wd.check()
+        again = await wd.check()  # same episode: one dump only
+        wd.beat()
+        assert await wd.check() is None  # recovered: re-armed
+        return wd, fired, again
+
+    wd, fired, again = asyncio.run(scenario())
+    assert fired is not None and again is None
+    assert fired["reason"] == "step-loop heartbeat stall"
+    assert fired["heartbeat_age_s"] > 0.05
+
+    # dump file landed under --dump-dir with the full snapshot
+    assert wd.last_dump_path is not None
+    on_disk = json.loads(open(wd.last_dump_path).read())
+    assert on_disk["reason"] == "step-loop heartbeat stall"
+    assert "replicas" in on_disk and "events" in on_disk
+
+    # termination log names the stall and points at the dump
+    term = term_log.read_text()
+    assert "stalled" in term and wd.last_dump_path in term
+
+    after = _sample(_scrape(), "tgis_tpu_watchdog_stalls_total")
+    assert after - stalls_0 == 1
+    assert _sample(
+        _scrape(), "tgis_tpu_watchdog_last_heartbeat_age_seconds"
+    ) >= 0
+
+
+def test_watchdog_idle_engine_never_fires(tmp_path, _clean_tracker):
+    term_log = tmp_path / "t"
+    term_log.write_text("")
+
+    async def scenario():
+        wd = _watchdog(tmp_path, term_log, active_fn=lambda: False)
+        await asyncio.sleep(0.08)
+        return await wd.check()
+
+    assert asyncio.run(scenario()) is None
+
+
+def test_watchdog_suspended_while_compile_in_flight(
+    tmp_path, _clean_tracker
+):
+    from vllm_tgis_adapter_tpu import compile_tracker
+
+    term_log = tmp_path / "t"
+    term_log.write_text("")
+
+    async def scenario():
+        wd = _watchdog(tmp_path, term_log)
+        await asyncio.sleep(0.08)
+        token = compile_tracker.begin_dispatch("decode")
+        suspended = await wd.check()  # compile in flight: no stall
+        compile_tracker.end_dispatch(token)
+        fired = await wd.check()  # compile retired, still no beat: stall
+        return suspended, fired
+
+    suspended, fired = asyncio.run(scenario())
+    assert suspended is None
+    assert fired is not None
+
+
+def test_watchdog_compile_grace_is_bounded(tmp_path, _clean_tracker):
+    """A 'compile' that outlives the grace window is a hang: fire."""
+    from vllm_tgis_adapter_tpu import compile_tracker
+
+    term_log = tmp_path / "t"
+    term_log.write_text("")
+
+    async def scenario():
+        wd = _watchdog(tmp_path, term_log, compile_grace_s=0.0)
+        await asyncio.sleep(0.08)
+        token = compile_tracker.begin_dispatch("decode")
+        try:
+            return await wd.check()
+        finally:
+            compile_tracker.end_dispatch(token)
+
+    assert asyncio.run(scenario()) is not None
+
+
+def test_simulated_stall_dumps_real_engine_state(tiny_model_dir, tmp_path):
+    """Acceptance: a simulated step-loop stall on a REAL engine produces
+    a JSON snapshot containing the scheduler queues (with the stuck
+    request), KV occupancy, and the flight recorder's recent events."""
+    import time as _time
+
+    engine = _build_engine(
+        tiny_model_dir,
+        watchdog_deadline_s=0.05,
+        dump_dir=str(tmp_path / "dumps"),
+    )
+    term_log = tmp_path / "termination-log"
+    term_log.write_text("")
+    engine.watchdog._termination_log = str(term_log)
+    engine.watchdog.check_interval_s = 0.01
+
+    async def scenario():
+        from vllm_tgis_adapter_tpu.engine.sampling_params import (
+            SamplingParams,
+        )
+
+        # admit a request directly into the core engine WITHOUT starting
+        # the step loops — work exists, nothing beats: a stall
+        rep = engine._replicas[0]
+        async with rep.lock:
+            rep.engine.add_request(
+                "stuck-1", None,
+                SamplingParams(temperature=0.0, max_tokens=4),
+                prompt_token_ids=list(range(3, 20)),
+            )
+        rep.last_beat = _time.monotonic() - 60.0
+        fired = await engine.watchdog.check()
+        # the watchdog's own task loop is exercised separately above;
+        # here the tick is driven directly for determinism
+        await engine.stop()
+        return fired
+
+    fired = asyncio.run(scenario())
+    assert fired is not None
+    dump = json.loads(open(engine.watchdog.last_dump_path).read())
+    waiting = dump["replicas"][0]["scheduler"]["waiting"]
+    assert [w["request_id"] for w in waiting] == ["stuck-1"]
+    assert dump["replicas"][0]["heartbeat_age_s"] > 50
+    assert "occupancy" in dump["replicas"][0]["kv_cache"]
+    kinds = [e["kind"] for e in dump["events"]]
+    assert "admit" in kinds and kinds[-1] == "stall"
+    assert term_log.read_text().strip()
+
+
+# --------------------------------------------------------- HTTP endpoints
+
+
+def _debug_app(engine, tiny_model_dir):
+    import argparse
+
+    from vllm_tgis_adapter_tpu.http import build_http_server
+
+    args = argparse.Namespace(
+        served_model_name=None, model=tiny_model_dir, api_key=None,
+        root_path=None, profile_dir=None,
+    )
+    return build_http_server(args, engine)
+
+
+def test_http_debug_state_and_request_trace(tiny_model_dir):
+    from vllm_tgis_adapter_tpu.http import HttpRequest
+
+    engine = _build_engine(tiny_model_dir)
+    app = _debug_app(engine, tiny_model_dir)
+
+    async def scenario():
+        await _generate_one(engine, "http-debug-1")
+        state_resp = await app.dispatch(
+            HttpRequest("GET", "/debug/state", {}, b"")
+        )
+        trace_resp = await app.dispatch(
+            HttpRequest("GET", "/debug/requests/http-debug-1", {}, b"")
+        )
+        missing_resp = await app.dispatch(
+            HttpRequest("GET", "/debug/requests/no-such-request", {}, b"")
+        )
+        method_resp = await app.dispatch(
+            HttpRequest("POST", "/debug/state", {}, b"")
+        )
+        await engine.stop()
+        return state_resp, trace_resp, missing_resp, method_resp
+
+    state_resp, trace_resp, missing_resp, method_resp = asyncio.run(
+        scenario()
+    )
+    assert state_resp.status == 200
+    state = json.loads(state_resp.body)
+    assert state["replicas"][0]["kv_cache"]["num_blocks"] == 64
+    assert any(e["kind"] == "finish" for e in state["events"])
+
+    assert trace_resp.status == 200
+    trace = json.loads(trace_resp.body)
+    assert trace["request_id"] == "http-debug-1"
+    assert trace["events"][0]["kind"] == "admit"
+
+    assert missing_resp.status == 404
+    assert method_resp.status == 405
+
+
+def test_http_metrics_expose_watchdog_and_recorder_families(
+    tiny_model_dir,
+):
+    from vllm_tgis_adapter_tpu.http import HttpRequest
+
+    engine = _build_engine(tiny_model_dir)
+    app = _debug_app(engine, tiny_model_dir)
+
+    async def scenario() -> bytes:
+        response = await app.dispatch(HttpRequest("GET", "/metrics", {}, b""))
+        await engine.stop()
+        return response.body
+
+    body = asyncio.run(scenario()).decode()
+    for family in (
+        "tgis_tpu_flight_recorder_events_total",
+        "tgis_tpu_watchdog_last_heartbeat_age_seconds",
+        "tgis_tpu_watchdog_stalls_total",
+    ):
+        assert family in body, f"{family} missing from /metrics"

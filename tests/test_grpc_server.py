@@ -416,3 +416,73 @@ def test_server_reflection(_servers):
 
         missing = ask(ch, file_containing_symbol="no.such.Service")
         assert missing.error_response.error_message
+
+
+def test_debug_dump_state_rpc(grpc_client, _servers):
+    """tgis_tpu.debug.v1.Debug/DumpState serves the same snapshot as
+    GET /debug/state (acceptance: queues + KV occupancy + events live
+    over gRPC)."""
+    import json as _json
+
+    import grpc as _grpc
+
+    from vllm_tgis_adapter_tpu.grpc.debug import DebugStub
+    from vllm_tgis_adapter_tpu.grpc.pb import debug_pb2
+
+    grpc_client.make_request("dump state probe", max_new_tokens=3)
+    with _grpc.insecure_channel(f"localhost:{_servers.grpc_port}") as ch:
+        stub = DebugStub(ch)
+        resp = stub.DumpState(debug_pb2.StateRequest())
+        state = _json.loads(resp.state_json)
+        assert state["engine"]["running"] is True
+        replica = state["replicas"][0]
+        assert replica["kv_cache"]["num_blocks"] > 0
+        assert "waiting" in replica["scheduler"]
+        assert {"admit", "finish"} <= {e["kind"] for e in state["events"]}
+
+        # last_events caps the tail the snapshot carries
+        capped = _json.loads(
+            stub.DumpState(
+                debug_pb2.StateRequest(last_events=2)
+            ).state_json
+        )
+        assert len(capped["events"]) <= 2
+
+
+def test_debug_request_trace_rpc(grpc_client, _servers):
+    import json as _json
+
+    import grpc as _grpc
+
+    from vllm_tgis_adapter_tpu.grpc.debug import DebugStub
+    from vllm_tgis_adapter_tpu.grpc.pb import debug_pb2
+
+    grpc_client.make_request("trace probe", max_new_tokens=3)
+    with _grpc.insecure_channel(f"localhost:{_servers.grpc_port}") as ch:
+        stub = DebugStub(ch)
+        state = _json.loads(
+            stub.DumpState(debug_pb2.StateRequest()).state_json
+        )
+        finished = [
+            e["request_id"]
+            for e in state["events"]
+            if e["kind"] == "finish" and "request_id" in e
+        ]
+        assert finished
+        resp = stub.GetRequestTrace(
+            debug_pb2.RequestTraceRequest(request_id=finished[-1])
+        )
+        trace = _json.loads(resp.trace_json)
+        assert trace["request_id"] == finished[-1]
+        kinds = [e["kind"] for e in trace["events"]]
+        assert kinds[0] == "admit" and kinds[-1] == "finish"
+
+        with pytest.raises(_grpc.RpcError) as excinfo:
+            stub.GetRequestTrace(
+                debug_pb2.RequestTraceRequest(request_id="no-such-request")
+            )
+        assert excinfo.value.code() == _grpc.StatusCode.NOT_FOUND
+
+        with pytest.raises(_grpc.RpcError) as excinfo:
+            stub.GetRequestTrace(debug_pb2.RequestTraceRequest())
+        assert excinfo.value.code() == _grpc.StatusCode.INVALID_ARGUMENT

@@ -353,3 +353,67 @@ def test_completions_n_bounds(http_base_url):
         _post_json(f"{http_base_url}/v1/completions",
                    {"prompt": "x", "n": 0})
     assert excinfo.value.code == 400
+
+
+def test_debug_state_live(http_base_url, server_args):
+    """GET /debug/state over a real socket: full snapshot with queues,
+    KV stats, compile-tracker state, and recorder events — and the three
+    watchdog/recorder metric families on /metrics (acceptance)."""
+    import json as _json
+
+    _post_json(
+        f"{http_base_url}/v1/completions",
+        {"model": server_args.model, "prompt": "state probe",
+         "max_tokens": 3},
+    )
+    status, body = _get(f"{http_base_url}/debug/state")
+    assert status == 200
+    state = _json.loads(body)
+    assert state["engine"]["running"] is True
+    replica = state["replicas"][0]
+    assert replica["kv_cache"]["num_blocks"] > 0
+    assert "waiting" in replica["scheduler"]
+    assert "compiled_shapes" in state["compile_tracker"]
+    kinds = {e["kind"] for e in state["events"]}
+    assert {"admit", "finish"} <= kinds
+
+    _, body = _get(f"{http_base_url}/metrics")
+    text = body.decode()
+    for family in (
+        "tgis_tpu_flight_recorder_events_total",
+        "tgis_tpu_watchdog_last_heartbeat_age_seconds",
+        "tgis_tpu_watchdog_stalls_total",
+    ):
+        assert family in text, f"missing metric {family}"
+
+
+def test_debug_request_timeline(http_base_url, server_args):
+    """GET /debug/requests/{id}: the per-request flight-recorder
+    timeline, discovered via the finish events in /debug/state."""
+    import json as _json
+    import urllib.error
+
+    _post_json(
+        f"{http_base_url}/v1/completions",
+        {"model": server_args.model, "prompt": "trace me",
+         "max_tokens": 3},
+    )
+    _, body = _get(f"{http_base_url}/debug/state")
+    finished = [
+        e["request_id"]
+        for e in _json.loads(body)["events"]
+        if e["kind"] == "finish" and "request_id" in e
+    ]
+    assert finished
+    status, body = _get(
+        f"{http_base_url}/debug/requests/{finished[-1]}"
+    )
+    assert status == 200
+    trace = _json.loads(body)
+    assert trace["request_id"] == finished[-1]
+    kinds = [e["kind"] for e in trace["events"]]
+    assert kinds[0] == "admit" and kinds[-1] == "finish"
+
+    with pytest.raises(urllib.error.HTTPError) as excinfo:
+        _get(f"{http_base_url}/debug/requests/no-such-request")
+    assert excinfo.value.code == 404

@@ -535,3 +535,79 @@ def test_paged_decode_perhead_variant_matches(window, alibi, g):
             np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5,
             err_msg=f"variant={variant}",
         )
+
+
+def test_decode_kernel_default_is_perhead(monkeypatch):
+    """ADVICE r5: the serving default is the hardware-validated per-head
+    kernel; folded stays opt-in until it passes on-chip."""
+    monkeypatch.delenv("PALLAS_DECODE_KERNEL", raising=False)
+    ref_ops.reset_decode_kernel()
+    assert ref_ops.decode_kernel_variant() == "perhead"
+    monkeypatch.setenv("PALLAS_DECODE_KERNEL", "folded")
+    assert ref_ops.decode_kernel_variant() == "folded"
+    ref_ops.reset_decode_kernel()
+
+
+def test_decode_kernel_degradation_chain(monkeypatch):
+    monkeypatch.setenv("PALLAS_DECODE_KERNEL", "folded")
+    ref_ops.reset_decode_kernel()
+    try:
+        assert ref_ops.degrade_decode_kernel() == "perhead"
+        assert ref_ops.decode_kernel_variant() == "perhead"
+        assert ref_ops.degrade_decode_kernel() == "xla"
+        assert ref_ops.degrade_decode_kernel() is None  # floor reached
+    finally:
+        ref_ops.reset_decode_kernel()
+
+
+def test_runner_dispatch_degrades_on_mosaic_rejection(monkeypatch):
+    """The serving dispatch path retries through folded → perhead → xla
+    on Mosaic/Pallas lowering failures instead of crashing the engine;
+    unrelated errors propagate untouched."""
+    from vllm_tgis_adapter_tpu.engine.runner import ModelRunner
+
+    monkeypatch.setenv("PALLAS_DECODE_KERNEL", "folded")
+    ref_ops.reset_decode_kernel()
+    try:
+        calls = []
+
+        def dispatch():
+            calls.append(ref_ops.decode_kernel_variant())
+            if len(calls) < 3:
+                raise RuntimeError(
+                    "Mosaic failed to compile the kernel"
+                )
+            return "ok"
+
+        # _decode_kernel_retry reads no runner state: exercise it bare
+        out = ModelRunner._decode_kernel_retry(None, dispatch)
+        assert out == "ok"
+        assert calls == ["folded", "perhead", "xla"]
+
+        ref_ops.reset_decode_kernel()
+
+        def unrelated():
+            raise ValueError("shape mismatch")
+
+        with pytest.raises(ValueError, match="shape mismatch"):
+            ModelRunner._decode_kernel_retry(None, unrelated)
+        # non-kernel errors must not burn a degradation level
+        assert ref_ops.decode_kernel_variant() == "folded"
+    finally:
+        ref_ops.reset_decode_kernel()
+
+
+def test_decode_kernel_degrade_compare_and_swap(monkeypatch):
+    """Concurrent identical failures burn ONE level: a degrade reporting
+    a variant that is no longer current returns the newer variant
+    without stepping again."""
+    monkeypatch.setenv("PALLAS_DECODE_KERNEL", "folded")
+    ref_ops.reset_decode_kernel()
+    try:
+        assert ref_ops.degrade_decode_kernel("folded") == "perhead"
+        # a second thread that ALSO saw folded fail must not step past
+        # the perhead level the first degrade just selected
+        assert ref_ops.degrade_decode_kernel("folded") == "perhead"
+        assert ref_ops.decode_kernel_variant() == "perhead"
+    finally:
+        ref_ops.reset_decode_kernel()

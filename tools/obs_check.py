@@ -2,8 +2,12 @@
 
 Boots the real HTTP server in-process against a tiny fixture model,
 scrapes ``/metrics`` over a real socket, and fails if any metric name
-documented in docs/OBSERVABILITY.md is missing from the scrape.  Run
-directly with ``JAX_PLATFORMS=cpu python tools/obs_check.py``.
+documented in docs/OBSERVABILITY.md is missing from the scrape (the
+three flight-recorder/watchdog metrics included).  Also hits
+``GET /debug/state`` and fails if the snapshot is missing any of the
+top-level sections the doc promises — the introspection surface and its
+documentation cannot drift silently either.  Run directly with
+``JAX_PLATFORMS=cpu python tools/obs_check.py``.
 """
 
 from __future__ import annotations
@@ -33,7 +37,15 @@ def documented_metrics(doc_path: Path) -> set[str]:
     }
 
 
-async def scrape_metrics() -> str:
+# top-level sections docs/OBSERVABILITY.md documents for the
+# /debug/state snapshot; a missing key means code and doc diverged
+DEBUG_STATE_KEYS = (
+    "engine", "replicas", "compile_tracker", "watchdog", "events",
+)
+REPLICA_KEYS = ("scheduler", "kv_cache", "in_flight", "step_counter")
+
+
+async def scrape_metrics() -> tuple[str, dict]:
     from tests.fixture_models import build_tiny_llama
 
     from vllm_tgis_adapter_tpu.engine.async_llm import AsyncLLMEngine
@@ -77,9 +89,16 @@ async def scrape_metrics() -> str:
                         f"http://127.0.0.1:{port}/metrics", timeout=5
                     ).read()
                 )
-                return body.decode()
             except OSError:
                 continue
+            state_body = await asyncio.to_thread(
+                lambda: urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/debug/state", timeout=5
+                ).read()
+            )
+            import json
+
+            return body.decode(), json.loads(state_body)
         raise RuntimeError("HTTP server never became scrapeable")
     finally:
         server_task.cancel()
@@ -95,7 +114,7 @@ def main() -> int:
     if not documented:
         print("obs_check: no metrics documented — parse failure?")
         return 1
-    scraped = asyncio.run(scrape_metrics())
+    scraped, state = asyncio.run(scrape_metrics())
     missing = sorted(
         name for name in documented if name not in scraped
     )
@@ -107,9 +126,20 @@ def main() -> int:
         for name in missing:
             print(f"  {name}")
         return 1
+    state_missing = [k for k in DEBUG_STATE_KEYS if k not in state]
+    replicas = state.get("replicas") or [{}]
+    state_missing += [
+        f"replicas[0].{k}" for k in REPLICA_KEYS if k not in replicas[0]
+    ]
+    if state_missing:
+        print(
+            "obs_check: /debug/state is missing documented sections: "
+            + ", ".join(state_missing)
+        )
+        return 1
     print(
         f"obs_check: all {len(documented)} documented metrics present "
-        "on /metrics"
+        "on /metrics; /debug/state serves every documented section"
     )
     return 0
 

@@ -35,6 +35,42 @@ _lock = threading.Lock()
 _seen_shapes: set[tuple[str, str]] = set()
 _total_recompiles = 0
 
+# dispatches currently executing inside a tracked jit entry point,
+# token -> (fn_name, monotonic entry time).  The stall watchdog
+# (watchdog.py) reads this to tell "the loop is hung" apart from "the
+# runtime is grinding through a 20-40s Mosaic compile": while a tracked
+# dispatch is in flight the stall deadline is suspended (bounded by the
+# watchdog's compile grace).
+_inflight: dict[int, tuple[str, float]] = {}
+_next_token = 0
+
+
+def begin_dispatch(fn_name: str) -> int:
+    """Mark a tracked dispatch as in flight; returns the token for
+    ``end_dispatch``.  Public so watchdog tests can simulate a compile
+    in flight without a real device."""
+    global _next_token
+    with _lock:
+        _next_token += 1
+        token = _next_token
+        _inflight[token] = (fn_name, time.monotonic())
+    return token
+
+
+def end_dispatch(token: int) -> None:
+    with _lock:
+        _inflight.pop(token, None)
+
+
+def inflight_dispatch() -> Optional[tuple[str, float]]:
+    """(fn_name, age_seconds) of the OLDEST tracked dispatch still
+    executing, or None when the runtime is idle at the jit boundary."""
+    with _lock:
+        if not _inflight:
+            return None
+        name, t0 = min(_inflight.values(), key=lambda v: v[1])
+    return name, time.monotonic() - t0
+
 
 def record_compile(fn_name: str, shape: str, seconds: float) -> None:
     """Fold one observed compile into the counters (also the hook tests
@@ -72,6 +108,7 @@ def reset() -> None:
     with _lock:
         _seen_shapes.clear()
         _total_recompiles = 0
+        _inflight.clear()
 
 
 def track_jit(
@@ -97,7 +134,11 @@ def track_jit(
     def tracked(*args, **kwargs):  # noqa: ANN002, ANN003, ANN202
         before = cache_size()
         t0 = time.perf_counter()
-        out = fn(*args, **kwargs)
+        token = begin_dispatch(name)
+        try:
+            out = fn(*args, **kwargs)
+        finally:
+            end_dispatch(token)
         if cache_size() > before:
             shape = ""
             if label is not None:

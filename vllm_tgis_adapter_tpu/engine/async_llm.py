@@ -29,11 +29,12 @@ process, one tokenizer, both servers, N device groups.
 from __future__ import annotations
 
 import asyncio
+import time
 from collections.abc import AsyncGenerator, Mapping
 from typing import Optional
 
 from vllm_tgis_adapter_tpu.engine.config import EngineConfig
-from vllm_tgis_adapter_tpu.engine.core import LLMEngine
+from vllm_tgis_adapter_tpu.engine.core import LLMEngine, describe_plan
 from vllm_tgis_adapter_tpu.engine.outputs import RequestOutput
 from vllm_tgis_adapter_tpu.engine.sampling_params import SamplingParams
 from vllm_tgis_adapter_tpu.logging import init_logger
@@ -48,7 +49,8 @@ class EngineDeadError(RuntimeError):
 class _Replica:
     """One engine + the concurrency state serializing access to it."""
 
-    __slots__ = ("engine", "lock", "new_work", "task", "index")
+    __slots__ = ("engine", "lock", "new_work", "task", "index",
+                 "last_beat", "in_flight_desc")
 
     def __init__(self, engine: LLMEngine, index: int):
         self.engine = engine
@@ -58,6 +60,14 @@ class _Replica:
         self.lock = asyncio.Lock()
         self.new_work = asyncio.Event()
         self.task: Optional[asyncio.Task] = None
+        # stall-watchdog heartbeat: the step loop touches this every
+        # iteration; request submission touches it too so a dead loop
+        # gets exactly one deadline of grace from when work arrives
+        self.last_beat = time.monotonic()
+        # describe_plan() summary of the dispatch currently in flight
+        # (None between dispatches) — the watchdog dump's "what was the
+        # device doing" line
+        self.in_flight_desc: Optional[dict] = None
 
 
 class AsyncLLMEngine:
@@ -84,6 +94,24 @@ class AsyncLLMEngine:
             from vllm_tgis_adapter_tpu.tracing import RequestTracer
 
             self._tracer = RequestTracer(endpoint)
+        # stall watchdog (watchdog.py): heartbeat-fed; fires a full
+        # diagnostic snapshot when a step loop with unfinished work stops
+        # beating past the configured deadline.  0 disables.
+        self.watchdog = None
+        config = self.engine.config
+        if config.watchdog_deadline_s > 0:
+            from vllm_tgis_adapter_tpu.watchdog import StallWatchdog
+
+            self.watchdog = StallWatchdog(
+                snapshot_fn=self._stall_snapshot,
+                active_fn=lambda: any(
+                    rep.engine.has_unfinished_requests()
+                    for rep in self._replicas
+                ),
+                age_fn=self._stall_age,
+                deadline_s=config.watchdog_deadline_s,
+                dump_dir=config.dump_dir,
+            )
 
     # ------------------------------------------------------------- lifecycle
 
@@ -164,9 +192,13 @@ class AsyncLLMEngine:
             self._stats_task = asyncio.create_task(
                 self._log_stats_loop(), name="engine-stats-loop"
             )
+        if self.watchdog is not None:
+            self.watchdog.start()
 
     async def stop(self) -> None:
         self._stopped = True
+        if self.watchdog is not None:
+            await self.watchdog.stop()
         if self._stats_task is not None:
             self._stats_task.cancel()
             self._stats_task = None
@@ -276,6 +308,7 @@ class AsyncLLMEngine:
                     sampling_params,
                     prompt_token_ids=prompt_token_ids,
                     lora_name=getattr(lora_request, "name", None),
+                    trace_id=getattr(span, "trace_id", None),
                 )
                 if request_id in self._early_aborts:
                     # abort() ran before the engine knew the request; it
@@ -300,6 +333,9 @@ class AsyncLLMEngine:
             raise
         if aborted_out is not None:
             queue.put_nowait(aborted_out)
+        # submission counts as a beat: a parked loop gets one full
+        # watchdog deadline to pick this request up before it's a stall
+        rep.last_beat = time.monotonic()
         rep.new_work.set()
         final = None
         try:
@@ -333,6 +369,120 @@ class AsyncLLMEngine:
         queue = self._queues.get(request_id)
         if queue is not None and out is not None:
             queue.put_nowait(out)
+
+    # -------------------------------------------------------- introspection
+
+    def _stall_age(self) -> float:
+        """Max heartbeat age over replicas that actually have work; a
+        parked idle loop never counts as stalled."""
+        now = time.monotonic()
+        return max(
+            (
+                now - rep.last_beat
+                for rep in self._replicas
+                if rep.engine.has_unfinished_requests()
+            ),
+            default=0.0,
+        )
+
+    def _stall_snapshot(self) -> dict:
+        # mark the episode in the ring FIRST so the dump (and any later
+        # /debug/state read) self-locates the stall in the event
+        # timeline.  The marker lands on the STALLED replica's recorder
+        # (oldest beat among replicas with work), stamped with ITS step
+        # counter — under dp the healthy replicas' timelines must not
+        # absorb a stall that is not theirs.
+        now = time.monotonic()
+        stalled = max(
+            (
+                rep for rep in self._replicas
+                if rep.engine.has_unfinished_requests()
+            ),
+            key=lambda rep: now - rep.last_beat,
+            default=self._replicas[0],
+        )
+        stalled.engine.recorder.record(
+            "stall", step=stalled.engine.step_counter,
+            replica=stalled.index,
+            heartbeat_age_s=round(now - stalled.last_beat, 3),
+        )
+        return self.debug_state()
+
+    def debug_state(self, last_events: int = 256) -> dict:
+        """The one engine-state snapshot every introspection surface
+        serves: GET /debug/state, the DumpState RPC, and the stall
+        watchdog's dump all call exactly this (flight_recorder.py
+        serializers), so the three views can never diverge."""
+        from vllm_tgis_adapter_tpu import compile_tracker
+        from vllm_tgis_adapter_tpu.flight_recorder import (
+            engine_introspection,
+        )
+
+        replicas = []
+        now = time.monotonic()
+        for rep in self._replicas:
+            state = engine_introspection(rep.engine)
+            state["replica"] = rep.index
+            state["in_flight"] = rep.in_flight_desc
+            state["heartbeat_age_s"] = round(now - rep.last_beat, 3)
+            replicas.append(state)
+        events: list[dict] = []
+        for rep in self._replicas:
+            events.extend(rep.engine.recorder.events())
+        events.sort(key=lambda e: e["mono_ns"])
+        inflight = compile_tracker.inflight_dispatch()
+        return {
+            "engine": {
+                "running": self.is_running,
+                "errored": self.errored,
+                "replicas": len(self._replicas),
+            },
+            "replicas": replicas,
+            "compile_tracker": {
+                "compiled_shapes": compile_tracker.num_shapes(),
+                "total_compiles": compile_tracker.total_recompiles(),
+                "inflight_dispatch": (
+                    {"fn": inflight[0], "age_s": round(inflight[1], 3)}
+                    if inflight is not None
+                    else None
+                ),
+            },
+            "watchdog": (
+                {
+                    "deadline_s": self.watchdog.deadline_s,
+                    "heartbeat_age_s": round(
+                        self.watchdog.heartbeat_age(), 3
+                    ),
+                    "stalls": self.watchdog.stalls,
+                    "last_dump": self.watchdog.last_dump_path,
+                }
+                if self.watchdog is not None
+                else None
+            ),
+            "events": events[-last_events:],
+        }
+
+    def request_trace(self, request_id: str) -> Optional[dict]:
+        """One request's flight-recorder timeline + live state, or None
+        when the request was never seen (or its events aged out)."""
+        events = []
+        live = None
+        for rep in self._replicas:
+            events.extend(rep.engine.recorder.events_for(request_id))
+            seq = rep.engine._seqs.get(request_id)  # noqa: SLF001
+            if seq is not None:
+                from vllm_tgis_adapter_tpu.flight_recorder import _seq_info
+
+                live = _seq_info(seq, time.time())
+                live["replica"] = rep.index
+        if not events and live is None:
+            return None
+        events.sort(key=lambda e: e["mono_ns"])
+        return {
+            "request_id": request_id,
+            "live": live,
+            "events": events,
+        }
 
     def refresh_engine_gauges(self) -> tuple[int, int]:
         """Push current engine state into the Prometheus gauges
@@ -480,6 +630,8 @@ class AsyncLLMEngine:
                     engine.flush_free_epoch()
                 outs = engine.commit_step(plan, result, prepared)
             in_flight = None
+            rep.in_flight_desc = None
+            rep.last_beat = time.monotonic()
             await emit(outs)
 
         async def try_chain() -> Optional[tuple]:
@@ -505,11 +657,14 @@ class AsyncLLMEngine:
             c_handle = await asyncio.to_thread(
                 engine.dispatch_chained_step, c_plan, c_prep, handle
             )
+            chained_desc = {**(describe_plan(c_plan) or {}), "chained": True}
             await commit_in_flight()
+            rep.in_flight_desc = chained_desc
             return (c_plan, c_prep, c_handle, True)
 
         try:
             while not self._stopped:
+                rep.last_beat = time.monotonic()
                 if not engine.has_unfinished_requests() and in_flight is None:
                     rep.new_work.clear()
                     await rep.new_work.wait()
@@ -530,10 +685,14 @@ class AsyncLLMEngine:
                 handle = await asyncio.to_thread(
                     engine.dispatch_step, plan, prepared
                 )
+                new_desc = describe_plan(plan)
                 if in_flight is not None:
                     # commits stay in dispatch order: drain the older
                     # dispatch (its device work overlapped our planning)
                     await commit_in_flight()
+                # set AFTER the older commit (which clears the field):
+                # the watchdog dump should describe the newest dispatch
+                rep.in_flight_desc = new_desc
                 if handle is SYNC_DISPATCH:
                     # not enqueue-only (speculative multi-phase verify,
                     # staged pipeline): the device work happens inside
@@ -552,6 +711,10 @@ class AsyncLLMEngine:
             # one replica dying is whole-engine death: the servers read
             # ``errored`` and crash-fast, matching single-engine semantics
             logger.exception("engine step loop %d died", rep.index)
+            engine.recorder.record(
+                "error", step=engine.step_counter, replica=rep.index,
+                error=f"{type(e).__name__}: {e}",
+            )
             self._dead_error = e
             for queue in self._queues.values():
                 queue.put_nowait(e)

@@ -832,6 +832,13 @@ class EngineConfig:
     otlp_traces_endpoint: str | None = None
     disable_log_requests: bool = True
     disable_log_stats: bool = False
+    # stall watchdog (watchdog.py): a step loop with unfinished work
+    # that stops beating for this long gets a full diagnostic dump
+    # (scheduler queues, KV stats, flight-recorder tail).  0 disables.
+    watchdog_deadline_s: float = 120.0
+    # --dump-dir: directory for watchdog stall snapshots (JSON, one file
+    # per stall); None keeps dumps in the log/termination-log only
+    dump_dir: str | None = None
     speculative: "Optional[SpeculativeConfig]" = None
 
     def __post_init__(self) -> None:
@@ -980,4 +987,8 @@ class EngineConfig:
             otlp_traces_endpoint=args.otlp_traces_endpoint,
             disable_log_stats=getattr(args, "disable_log_stats", False),
             disable_log_requests=args.disable_log_requests,
+            watchdog_deadline_s=float(
+                getattr(args, "watchdog_deadline", 120.0) or 0.0
+            ),
+            dump_dir=getattr(args, "dump_dir", None),
         )

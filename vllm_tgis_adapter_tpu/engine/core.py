@@ -33,9 +33,43 @@ from vllm_tgis_adapter_tpu.engine.scheduler import (
 )
 from vllm_tgis_adapter_tpu.engine.sequence import Sequence, SequenceStatus
 from vllm_tgis_adapter_tpu import metrics
+from vllm_tgis_adapter_tpu.flight_recorder import (
+    DECODE_PROGRESS_EVERY,
+    FlightRecorder,
+)
 from vllm_tgis_adapter_tpu.logging import init_logger
 
 logger = init_logger(__name__)
+
+
+def describe_plan(plan) -> Optional[dict]:  # noqa: ANN001
+    """Small JSON-safe summary of a dispatch plan (the "in-flight batch
+    plan" line of watchdog dumps and /debug/state)."""
+    if plan is None:
+        return None
+    if isinstance(plan, PackedPrefillPlan):
+        return {
+            "kind": "packed_prefill",
+            "bucket": plan.bucket_len,
+            "num_prompts": len(plan.items),
+            "request_ids": [i.seq.request_id for i in plan.items],
+        }
+    if isinstance(plan, PrefillPlan):
+        return {
+            "kind": "prefill",
+            "bucket": plan.bucket_len,
+            "request_id": plan.seq.request_id,
+            "start_pos": plan.start_pos,
+            "chunk_tokens": len(plan.token_ids),
+            "is_final": plan.is_final,
+        }
+    return {
+        "kind": "decode",
+        "batch_bucket": plan.batch_bucket,
+        "num_seqs": len(plan.seqs),
+        "num_steps": plan.num_steps,
+        "request_ids": [s.request_id for s in plan.seqs],
+    }
 
 
 class LLMEngine:
@@ -140,6 +174,14 @@ class LLMEngine:
         ):
             self.scheduler.swap_out_fn = self._swap_out_seq
             self.scheduler.swap_drop_fn = self._swap_drop_seq
+        # black-box lifecycle recorder (flight_recorder.py): every
+        # admission/dispatch/preemption/finish appends one bounded ring
+        # entry; the scheduler shares it for preemption events
+        self.recorder = FlightRecorder()
+        self.scheduler.recorder = self.recorder
+        # monotonically increasing dispatch counter; stamps recorder
+        # events so "which wave was in flight" is answerable post-hoc
+        self.step_counter = 0
         self._seqs: dict[str, Sequence] = {}
         self._lora_tokenizers: dict[str, object] = {}
         # adapter registry consumed by the gRPC adapter store
@@ -309,6 +351,7 @@ class LLMEngine:
         prompt_token_ids: Optional[list[int]] = None,
         arrival_time: Optional[float] = None,
         lora_name: Optional[str] = None,
+        trace_id: Optional[str] = None,
     ) -> None:
         if request_id in self._seqs:
             raise ValueError(f"duplicate request_id {request_id!r}")
@@ -331,6 +374,7 @@ class LLMEngine:
             fallback_seed=self.runner.new_fallback_seed(),
             lora_name=lora_name,
         )
+        seq.trace_id = trace_id
         seq.lora_slot = self.lora_manager.slot_of(lora_name)
         if self.runner.spec is not None:
             from vllm_tgis_adapter_tpu.engine.speculative import (
@@ -362,6 +406,11 @@ class LLMEngine:
         self.lora_manager.pin(lora_name)
         self._seqs[request_id] = seq
         self.scheduler.add(seq)
+        self.recorder.record(
+            "admit", request_id, step=self.step_counter, trace_id=trace_id,
+            prompt_tokens=len(prompt_token_ids),
+            **({"lora": lora_name} if lora_name else {}),
+        )
 
     def abort_request(self, request_id: str) -> Optional[RequestOutput]:
         seq = self._seqs.pop(request_id, None)
@@ -370,6 +419,10 @@ class LLMEngine:
         self.scheduler.abort(request_id)
         self.lora_manager.unpin(seq.lora_name)
         seq.metrics.finished_time = time.time()
+        self.recorder.record(
+            "abort", request_id, step=self.step_counter,
+            trace_id=seq.trace_id, output_tokens=seq.num_output_tokens,
+        )
         return seq.to_request_output()
 
     def has_unfinished_requests(self) -> bool:
@@ -404,6 +457,10 @@ class LLMEngine:
         seq.swapped = (k_host, v_host, n, nbytes)
         self._swap_used += nbytes
         seq.metrics.events.append(("swap_out", time.time_ns()))
+        self.recorder.record(
+            "swap_out", seq.request_id, step=self.step_counter,
+            trace_id=seq.trace_id, tokens=n, bytes=nbytes,
+        )
         metrics.kv_swap_out_total.inc()
         # inc/dec (not set): dp replicas share the process-global gauge,
         # so absolute sets from different replicas would clobber
@@ -433,6 +490,10 @@ class LLMEngine:
             seq.swapped = None
             self._swap_used -= nbytes
             seq.metrics.events.append(("swap_in", time.time_ns()))
+            self.recorder.record(
+                "swap_in", seq.request_id, step=self.step_counter,
+                trace_id=seq.trace_id, tokens=n,
+            )
             metrics.kv_swap_in_total.inc()
             metrics.kv_swap_used_bytes.dec(nbytes)
             logger.info("restored request %s from host swap (%d tokens)",
@@ -474,12 +535,17 @@ class LLMEngine:
         # at the same (width, steps) shape
         steps = sched.config.num_decode_steps
         total = 0
+        # solo-prefill buckets whose program ACTUALLY compiled: recorded
+        # by _precompile_drain from the plans it dispatched (ADVICE r5:
+        # recording at add_request time was optimistic — _extend_pack
+        # swallows co-admitted warmup prompts into a PACKED dispatch, a
+        # different entry point, leaving the solo shape cold and the
+        # first real solo prompt at that bucket paying a serving-time
+        # compile)
         covered: set[int] = set()
 
         def warm_len(bucket: int, headroom: int = 0) -> int:
-            plen = max(1, min(bucket, max_len - (headroom or 2 * steps) - 2))
-            covered.add(sched._prefill_bucket(plen))
-            return plen
+            return max(1, min(bucket, max_len - (headroom or 2 * steps) - 2))
 
         for width in widths:
             for want_topn in topn_variants:
@@ -498,11 +564,12 @@ class LLMEngine:
                         prompt_token_ids=[1] * warm_len(bucket),
                     )
                     total += 1
-                self._precompile_drain(width)
+                self._precompile_drain(width, covered)
         # prefill compiles key on the BUCKET, not the batch width: any
-        # bucket the width loops didn't reach (narrow batches, long
-        # bucket lists) gets a solo pass so long prompts don't compile
-        # at serving time either
+        # bucket whose solo shape no dispatched plan covered (packed
+        # admission, narrow batches, long bucket lists) gets a solo pass
+        # — one request at a time, so _extend_pack has nothing to pack
+        # it with and the solo program truly compiles
         for bucket in sched.config.prefill_buckets:
             if bucket in covered or bucket >= max_len:
                 continue
@@ -514,7 +581,7 @@ class LLMEngine:
                 prompt_token_ids=[1] * warm_len(bucket, headroom=1),
             )
             total += 1
-            self._precompile_drain(1)
+            self._precompile_drain(1, covered)
         logger.info(
             "precompile: %d warmup requests across %d batch widths, "
             "%d prefill buckets (topn variants: %s, chained: yes)",
@@ -522,7 +589,9 @@ class LLMEngine:
         )
         return total
 
-    def _precompile_drain(self, width: int) -> None:
+    def _precompile_drain(
+        self, width: int, covered: Optional[set[int]] = None
+    ) -> None:
         """Run the warmup batch to completion, dispatching the FIRST
         full-batch decode wave CHAINED (mirroring the async loop's
         plan_chained_step -> dispatch_chained_step -> commit order,
@@ -530,11 +599,21 @@ class LLMEngine:
         at the production (width, num_decode_steps) shape rather than
         on the first live chained wave.
 
+        ``covered`` (when given) collects the SOLO prefill buckets this
+        drain actually dispatched — the ground truth precompile() needs
+        to decide which buckets still want a solo pass (packed plans
+        compile a different entry point and do not count).
+
         All prefills drain first (``prefill_only=True`` planning):
         organic interleaving would let early rows burn their max_tokens
         budget before the batch fills, making schedule_chained bail on
         the full-width wave (the projection needs >= 1 step of headroom
         on every row)."""
+
+        def note_plan(plan) -> None:  # noqa: ANN001
+            if covered is not None and isinstance(plan, PrefillPlan):
+                covered.add(plan.bucket_len)
+
         guard = 0
         while True:
             guard += 1
@@ -549,6 +628,7 @@ class LLMEngine:
             outputs, plan, prepared = self.plan_step(prefill_only=True)
             if plan is None:
                 break
+            note_plan(plan)
             self.commit_step(
                 plan,
                 self.wait_step(
@@ -565,6 +645,7 @@ class LLMEngine:
             outputs, plan, prepared = self.plan_step()
             if plan is None:
                 continue
+            note_plan(plan)
             handle = self.dispatch_step(plan, prepared)
             chained = None
             if not chained_done:
@@ -612,6 +693,11 @@ class LLMEngine:
             self._seqs.pop(seq.request_id, None)
             self.lora_manager.unpin(seq.lora_name)
             seq.metrics.finished_time = time.time()
+            self.recorder.record(
+                "finish", seq.request_id, step=self.step_counter,
+                trace_id=seq.trace_id, reason=seq.finish_reason,
+                rejected=True,
+            )
             outputs.append(seq.to_request_output())
         self.scheduler.newly_finished.clear()
 
@@ -642,7 +728,36 @@ class LLMEngine:
         else:
             prepared = self.runner.prepare_decode(plan)
         self._observe_plan(plan, prepared)
+        self._record_dispatch(plan)
         return outputs, plan, prepared
+
+    def _record_dispatch(self, plan) -> None:  # noqa: ANN001
+        """One recorder entry per dispatch (per prompt for prefills, so
+        ``events_for(request_id)`` sees every wave that touched it;
+        batch-level for decode — per-request decode cadence is the
+        ``decode_progress`` marker in ``_process_sampled``)."""
+        self.step_counter += 1
+        step = self.step_counter
+        if isinstance(plan, PackedPrefillPlan):
+            for item in plan.items:
+                self.recorder.record(
+                    "packed_prefill", item.seq.request_id, step=step,
+                    trace_id=item.seq.trace_id, bucket=plan.bucket_len,
+                    num_prompts=len(plan.items),
+                    tokens=len(item.token_ids),
+                )
+        elif isinstance(plan, PrefillPlan):
+            self.recorder.record(
+                "prefill", plan.seq.request_id, step=step,
+                trace_id=plan.seq.trace_id, bucket=plan.bucket_len,
+                start_pos=plan.start_pos, tokens=len(plan.token_ids),
+                is_final=plan.is_final,
+            )
+        else:
+            self.recorder.record(
+                "decode", step=step, num_seqs=len(plan.seqs),
+                batch_bucket=plan.batch_bucket, num_steps=plan.num_steps,
+            )
 
     @staticmethod
     def _observe_plan(plan, prepared) -> None:
@@ -719,6 +834,7 @@ class LLMEngine:
             return None
         prepared = self.runner.prepare_chained_decode(plan, prev_prepared)
         self._observe_plan(plan, prepared)
+        self._record_dispatch(plan)
         return plan, prepared
 
     def dispatch_chained_step(self, plan, prepared, prev_handle):  # noqa: ARG002
@@ -856,8 +972,21 @@ class LLMEngine:
                     self.scheduler.finish(seq)
                     self._seqs.pop(seq.request_id, None)
                     self.lora_manager.unpin(seq.lora_name)
+                    self.recorder.record(
+                        "finish", seq.request_id, step=self.step_counter,
+                        trace_id=seq.trace_id, reason=seq.finish_reason,
+                        output_tokens=seq.num_output_tokens,
+                    )
                     outputs.append(seq.to_request_output())
                     break
+                if seq.num_output_tokens % DECODE_PROGRESS_EVERY == 0:
+                    # bounded per-request decode cadence marker: one ring
+                    # entry per N tokens, not per token
+                    self.recorder.record(
+                        "decode_progress", seq.request_id,
+                        step=self.step_counter, trace_id=seq.trace_id,
+                        output_tokens=seq.num_output_tokens,
+                    )
                 if seq.params.output_kind != RequestOutputKind.FINAL_ONLY:
                     # DELTA with an empty text delta still carries the token
                     outputs.append(seq.to_request_output())

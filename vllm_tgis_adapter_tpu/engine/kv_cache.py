@@ -300,6 +300,31 @@ class BlockAllocator:
             blocks.append(block)
         return blocks, len(blocks) * self.block_size
 
+    def peek_prefix(
+        self, token_ids: list[int], lora_name: Optional[str] = None
+    ) -> int:
+        """Length (in tokens) of the cached prefix ``match_prefix`` would
+        adopt — WITHOUT adopting it.  Pure hash-walk: no refcounts, no
+        ``_cached_free`` LRU reordering, safe inside an open free epoch.
+        The probe the chained-decode admissibility check uses
+        (scheduler._waiting_head_admissible): a blocked head probed every
+        chained wave must not promote its prefix pages to MRU or pin
+        refcounts it cannot release symmetrically."""
+        if not self.enable_prefix_caching:
+            return 0
+        max_pages = (len(token_ids) - 1) // self.block_size
+        h = self._chain_seed(lora_name)
+        matched = 0
+        for p in range(max_pages):
+            page = tuple(
+                token_ids[p * self.block_size: (p + 1) * self.block_size]
+            )
+            h = self._chain_step(h, page)
+            if h not in self._hash_to_block:
+                break
+            matched += 1
+        return matched * self.block_size
+
     def register_prefix(
         self,
         token_ids: list[int],

@@ -1115,8 +1115,9 @@ class ModelRunner:
         input tokens from its device-resident outputs."""
         lora = self.lora_stacks if prep.lora_idx is not None else None
         ints, floats = self._pack_decode_inputs(prep)
-        self.caches, self.seen, packed_out = (
-            self._chained_decode_fn(
+
+        def call():  # noqa: ANN202
+            return self._chained_decode_fn(
                 self.params,
                 self.caches,
                 self.seen,
@@ -1133,7 +1134,8 @@ class ModelRunner:
                 prep.num_steps,
                 prep.want_topn,
             )
-        )
+
+        self.caches, self.seen, packed_out = self._decode_kernel_retry(call)
         return packed_out
 
     def _pack_decode_inputs(self, prep: "PreparedDecode"):
@@ -1155,6 +1157,36 @@ class ModelRunner:
         ]).astype(np.float32)
         return ints, floats
 
+    def _decode_kernel_retry(self, dispatch):  # noqa: ANN001
+        """Serving-path decode-kernel degradation (ADVICE r5): a Mosaic
+        rejection of the opted-in folded kernel steps down
+        folded → perhead → xla (ops/attention.degrade_decode_kernel) and
+        retries the dispatch instead of killing the engine at boot
+        precompile or on the first live decode.  The variant is read at
+        trace time inside the jitted model, and a failed compile leaves
+        no cache entry, so the retry re-traces and picks up the
+        degraded variant."""
+        from vllm_tgis_adapter_tpu.ops import attention as attn_ops
+
+        while True:
+            tried = attn_ops.decode_kernel_variant()
+            try:
+                return dispatch()
+            except Exception as e:  # noqa: BLE001 — inspected, re-raised
+                if not attn_ops.is_kernel_lowering_error(e):
+                    raise
+                # compare-and-swap on the variant THIS attempt traced
+                # with: a concurrent replica's identical failure burns
+                # one level between them, not two
+                nxt = attn_ops.degrade_decode_kernel(tried)
+                if nxt is None:
+                    raise
+                logger.warning(
+                    "decode kernel %r failed to lower (%s: %s); "
+                    "degrading to %r and retrying the dispatch",
+                    tried, type(e).__name__, e, nxt,
+                )
+
     def dispatch_decode(self, prep: "PreparedDecode"):
         """Enqueue the fused K-step decode; no blocking transfers.
 
@@ -1166,21 +1198,27 @@ class ModelRunner:
             return SYNC_DISPATCH
         lora = self.lora_stacks if prep.lora_idx is not None else None
         ints, floats = self._pack_decode_inputs(prep)
-        self.caches, self.seen, packed_out = self._decode_fn(
-            self.params,
-            self.caches,
-            self.seen,
-            self._put(ints),
-            self._put(floats),
-            self._put(prep.block_tables),
-            self._put(prep.allowed_mask)
-            if prep.allowed_mask is not None
-            else None,
-            lora,
-            self._put(prep.lora_idx) if prep.lora_idx is not None else None,
-            prep.num_steps,
-            prep.want_topn,
-        )
+
+        def call():  # noqa: ANN202
+            return self._decode_fn(
+                self.params,
+                self.caches,
+                self.seen,
+                self._put(ints),
+                self._put(floats),
+                self._put(prep.block_tables),
+                self._put(prep.allowed_mask)
+                if prep.allowed_mask is not None
+                else None,
+                lora,
+                self._put(prep.lora_idx)
+                if prep.lora_idx is not None
+                else None,
+                prep.num_steps,
+                prep.want_topn,
+            )
+
+        self.caches, self.seen, packed_out = self._decode_kernel_retry(call)
         return packed_out
 
     def wait_decode(

@@ -135,6 +135,10 @@ class Scheduler:
         # falls back to recompute admission.  None = recompute-only.
         self.swap_out_fn = None
         self.swap_drop_fn = None
+        # flight recorder (flight_recorder.py), shared with the engine
+        # core so scheduler-originated events (preemption) land in the
+        # same per-request timeline; None when running standalone (tests)
+        self.recorder = None
 
     # ------------------------------------------------------------ bookkeeping
 
@@ -586,8 +590,12 @@ class Scheduler:
         the saturated-server steady state (queue deep, batch full) is
         exactly where on-device token feedback matters most.  Mirrors
         the resource checks of ``_try_schedule_prefill`` /
-        ``try_swap_in``; the prefix probe's refcounts are released
-        before returning."""
+        ``try_swap_in``.  The prefix probe is ``peek_prefix`` — a pure
+        hash-walk (ADVICE r5): the old match_prefix+free round-trip
+        promoted a blocked head's cached pages to the LRU's MRU end on
+        EVERY chained-wave attempt (skewing eviction order), and inside
+        an open free epoch its decref would quarantine while the incref
+        applied immediately, temporarily pinning cached pages."""
         if not self.waiting:
             return False
         seq = self.waiting[0]
@@ -602,13 +610,9 @@ class Scheduler:
             return False
         matched = 0
         if self._adoptable(seq):
-            hit_blocks, matched = self.allocator.match_prefix(
+            matched = self.allocator.peek_prefix(
                 seq.all_token_ids, seq.lora_name
             )
-            if matched:
-                # probe only: match_prefix refcounted the hit pages
-                # (its contract); release or they pin forever
-                self.allocator.free(hit_blocks)
         needed = self.allocator.blocks_needed(total) - (
             self.allocator.blocks_needed(matched) if matched else 0
         )
@@ -658,6 +662,16 @@ class Scheduler:
         logger.info("preempting request %s (KV pool exhausted)",
                     victim.request_id)
         victim.metrics.events.append(("preempted", time.time_ns()))
+        if self.recorder is not None:
+            self.recorder.record(
+                "preempt", victim.request_id,
+                trace_id=victim.trace_id,
+                was_running=victim in self.running,
+                pages_held=(
+                    len(victim.blocks.blocks)
+                    if victim.blocks is not None else 0
+                ),
+            )
         metrics.preemptions_total.inc()
         was_running = victim in self.running
         if was_running and self.swap_out_fn is not None:

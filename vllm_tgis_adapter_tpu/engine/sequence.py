@@ -72,6 +72,10 @@ class Sequence:
         self.output_token_ids: list[int] = []
         self.fallback_seed = fallback_seed
         self.lora_name = lora_name
+        # OTLP trace id of the request's server span (tracing.py), set by
+        # the async layer at admission so flight-recorder events and
+        # /debug/requests timelines correlate with the exported spans
+        self.trace_id: Optional[str] = None
 
         self.blocks: Optional["SequenceBlocks"] = None
         self.slot: int = -1  # fixed batch row while RUNNING

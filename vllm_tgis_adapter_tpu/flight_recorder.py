@@ -1,0 +1,217 @@
+"""Flight recorder: a bounded black-box log of request lifecycle events.
+
+When the engine misbehaves in production — a decode dispatch that never
+retires, a request stuck in the waiting queue, a KV pool that drains and
+never refills — metrics say *that* something is wrong but not *what the
+engine was doing at that moment*.  This module is the black-box half of
+the answer (the stall watchdog in ``watchdog.py`` is the trigger half):
+
+* ``FlightRecorder`` — an allocation-cheap ring buffer (a ``deque`` of
+  plain tuples, ``maxlen``-bounded so memory is O(capacity) forever) of
+  per-request lifecycle events: admit, prefill/packed/decode dispatch,
+  preemption, KV swap in/out, finish, abort, error.  Events are stamped
+  with wall time, monotonic time, the engine's step counter, and the
+  request's trace id, so a recorder timeline lines up with the OTLP
+  spans PR 1 exports for the same request.
+* the snapshot serializers (``engine_introspection``,
+  ``allocator_stats``, ``scheduler_queues``) every introspection surface
+  shares: the stall watchdog's JSON dump, ``GET /debug/state``, and the
+  ``tgis_tpu.debug.v1.Debug/DumpState`` RPC all render the exact same
+  dict, so operators never reconcile three divergent views of one
+  engine.
+
+Recording must stay cheap enough for the step-loop hot path: one tuple
+append per event, no locks (events are recorded only from host phases on
+the event-loop thread or under the engine lock), and the Prometheus
+counter increment is the only side effect.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import TYPE_CHECKING, Any, Optional
+
+from vllm_tgis_adapter_tpu import metrics
+
+if TYPE_CHECKING:
+    from vllm_tgis_adapter_tpu.engine.kv_cache import BlockAllocator
+    from vllm_tgis_adapter_tpu.engine.scheduler import Scheduler
+    from vllm_tgis_adapter_tpu.engine.sequence import Sequence
+
+# Default ring capacity: at one batch-level event per dispatch plus a
+# handful of per-request lifecycle events, 4096 entries cover minutes of
+# saturated serving — enough context around a stall without unbounded
+# growth.
+DEFAULT_CAPACITY = 4096
+
+# Event kinds (the full schema, documented in docs/OBSERVABILITY.md).
+EVENT_KINDS = (
+    "admit",          # request entered the engine (add_request)
+    "prefill",        # solo prefill chunk dispatched
+    "packed_prefill",  # multi-prompt packed prefill dispatched
+    "decode",         # fused decode wave dispatched (batch-level)
+    "decode_progress",  # per-request marker every N committed tokens
+    "preempt",        # KV pool ran dry; victim evicted
+    "swap_out",       # victim's KV copied to host (--swap-space)
+    "swap_in",        # sequence restored from host KV copy
+    "finish",         # request completed (stop/length)
+    "abort",          # request aborted by the client
+    "error",          # engine step loop died
+    "stall",          # watchdog fired (recorded so dumps self-locate)
+)
+
+# Per-request decode events are recorded every N committed tokens — one
+# event per token would flood the ring with exactly the traffic that is
+# healthiest.
+DECODE_PROGRESS_EVERY = 32
+
+
+class FlightRecorder:
+    """Bounded ring of ``(wall_ns, mono_ns, step, kind, request_id,
+    trace_id, detail)`` tuples; oldest events fall off the end."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        self.capacity = capacity
+        self._events: deque[tuple] = deque(maxlen=capacity)
+        self._recorded = 0  # total ever recorded (ring evicts, this doesn't)
+
+    def record(
+        self,
+        kind: str,
+        request_id: Optional[str] = None,
+        *,
+        step: int = 0,
+        trace_id: Optional[str] = None,
+        **detail: Any,
+    ) -> None:
+        self._events.append((
+            time.time_ns(),
+            time.monotonic_ns(),
+            step,
+            kind,
+            request_id,
+            trace_id,
+            detail or None,
+        ))
+        self._recorded += 1
+        try:
+            metrics.flight_recorder_events_total.labels(kind=kind).inc()
+        except Exception:  # pragma: no cover — telemetry must not raise
+            pass
+
+    # ------------------------------------------------------------- queries
+
+    def __len__(self) -> int:
+        return len(self._events)
+
+    @property
+    def total_recorded(self) -> int:
+        return self._recorded
+
+    def events(self, last_n: Optional[int] = None) -> list[dict]:
+        """Newest-last list of event dicts (the serialized form)."""
+        items = list(self._events)
+        if last_n is not None:
+            items = items[-last_n:]
+        return [self._to_dict(e) for e in items]
+
+    def events_for(self, request_id: str) -> list[dict]:
+        """This request's surviving timeline, oldest first."""
+        return [
+            self._to_dict(e)
+            for e in self._events
+            if e[4] == request_id
+        ]
+
+    @staticmethod
+    def _to_dict(e: tuple) -> dict:
+        wall_ns, mono_ns, step, kind, request_id, trace_id, detail = e
+        out = {
+            "ts": wall_ns / 1e9,
+            "mono_ns": mono_ns,
+            "step": step,
+            "kind": kind,
+        }
+        if request_id is not None:
+            out["request_id"] = request_id
+        if trace_id is not None:
+            out["trace_id"] = trace_id
+        if detail:
+            out["detail"] = detail
+        return out
+
+
+# ------------------------------------------------------------- serializers
+
+
+def allocator_stats(allocator: "BlockAllocator") -> dict:
+    """KV page pool occupancy / fragmentation / cached-free stats."""
+    num_blocks = allocator.num_blocks
+    free_list = len(allocator._free)  # noqa: SLF001 — introspection owns this view
+    cached_free = len(allocator._cached_free)  # noqa: SLF001
+    quarantined = sum(
+        len(blocks)
+        for epoch in allocator._free_epochs  # noqa: SLF001
+        for blocks in epoch
+    )
+    used = num_blocks - allocator.num_free
+    return {
+        "num_blocks": num_blocks,
+        "used": used,
+        "free": free_list,
+        "cached_free": cached_free,
+        "occupancy": used / num_blocks if num_blocks else 0.0,
+        # reclaimable-but-parked fraction of the nominally free pool:
+        # high values mean the free list is mostly prefix-cache parking,
+        # so a burst of new prompts will churn the content cache
+        "fragmentation": (
+            cached_free / (free_list + cached_free)
+            if (free_list + cached_free)
+            else 0.0
+        ),
+        "free_epochs_open": len(allocator._free_epochs),  # noqa: SLF001
+        "quarantined": quarantined,
+        "prefix_hit_tokens": allocator.prefix_hits,
+    }
+
+
+def _seq_info(seq: "Sequence", now: float) -> dict:
+    info = {
+        "request_id": seq.request_id,
+        "status": seq.status.name,
+        "age_s": round(max(0.0, now - seq.metrics.arrival_time), 3),
+        "prompt_tokens": seq.num_prompt_tokens,
+        "output_tokens": seq.num_output_tokens,
+        "prefill_pos": seq.prefill_pos,
+        "slot": seq.slot,
+        "pages": len(seq.blocks.blocks) if seq.blocks is not None else 0,
+        "swapped": seq.swapped is not None,
+    }
+    trace_id = getattr(seq, "trace_id", None)
+    if trace_id:
+        info["trace_id"] = trace_id
+    if seq.lora_name:
+        info["lora"] = seq.lora_name
+    return info
+
+
+def scheduler_queues(scheduler: "Scheduler") -> dict:
+    """Waiting/running/swapped queues with per-request ages."""
+    now = time.time()
+    waiting = [_seq_info(s, now) for s in scheduler.waiting]
+    return {
+        "waiting": waiting,
+        "running": [_seq_info(s, now) for s in scheduler.running],
+        "swapped": [s for s in waiting if s["swapped"]],
+        "num_unfinished": scheduler.num_unfinished,
+    }
+
+
+def engine_introspection(engine) -> dict:  # noqa: ANN001 — LLMEngine (import cycle)
+    """One sync engine's full host-side state (scheduler + KV pool)."""
+    return {
+        "scheduler": scheduler_queues(engine.scheduler),
+        "kv_cache": allocator_stats(engine.scheduler.allocator),
+        "step_counter": getattr(engine, "step_counter", 0),
+    }

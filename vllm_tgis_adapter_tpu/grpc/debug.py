@@ -1,13 +1,21 @@
 """tgis_tpu.debug.v1.Debug server implementation.
 
-The gRPC face of the on-demand profiler (profiler.py): StartProfile /
-StopProfile bracket a ``jax.profiler`` capture, sharing one controller
-with the HTTP routes so either front-end can start or stop it.
+The gRPC face of the operator tooling: StartProfile / StopProfile
+bracket a ``jax.profiler`` capture (sharing one controller with the HTTP
+routes so either front-end can start or stop it), and DumpState /
+GetRequestTrace serve the live engine-state snapshot and per-request
+flight-recorder timelines — the exact same serializer behind
+``GET /debug/state`` and ``GET /debug/requests/{id}``
+(AsyncLLMEngine.debug_state / request_trace), JSON-encoded on the wire
+so the schema can evolve with the engine without proto churn.
 Registration helpers and the client stub are hand-written for the same
 reason as pb/rpc.py (no grpcio-tools in this environment).
 """
 
 from __future__ import annotations
+
+import json
+from typing import TYPE_CHECKING, Optional
 
 import grpc
 
@@ -16,6 +24,9 @@ from vllm_tgis_adapter_tpu.profiler import ProfilerController, ProfilerError
 
 from .pb import debug_pb2
 
+if TYPE_CHECKING:
+    from vllm_tgis_adapter_tpu.engine.async_llm import AsyncLLMEngine
+
 logger = init_logger(__name__)
 
 SERVICE_NAME = "tgis_tpu.debug.v1.Debug"
@@ -23,18 +34,57 @@ SERVICE_NAME = "tgis_tpu.debug.v1.Debug"
 _METHODS = (
     ("StartProfile", debug_pb2.ProfileRequest, debug_pb2.ProfileResponse),
     ("StopProfile", debug_pb2.ProfileRequest, debug_pb2.ProfileResponse),
+    ("DumpState", debug_pb2.StateRequest, debug_pb2.StateResponse),
+    ("GetRequestTrace", debug_pb2.RequestTraceRequest,
+     debug_pb2.RequestTraceResponse),
 )
 
 
 class DebugServicer:
-    def __init__(self, controller: ProfilerController):
+    def __init__(
+        self,
+        controller: ProfilerController,
+        engine: "Optional[AsyncLLMEngine]" = None,
+    ):
         self._controller = controller
+        self._engine = engine
 
     async def StartProfile(self, request, context):  # noqa: ANN001, ARG002
         return await self._run(self._controller.start, context)
 
     async def StopProfile(self, request, context):  # noqa: ANN001, ARG002
         return await self._run(self._controller.stop, context)
+
+    async def DumpState(self, request, context):  # noqa: ANN001
+        state_fn = getattr(self._engine, "debug_state", None)
+        if state_fn is None:
+            await context.abort(
+                grpc.StatusCode.UNIMPLEMENTED,
+                "engine exposes no debug state",
+            )
+        last = request.last_events
+        state = state_fn(last_events=last) if last > 0 else state_fn()
+        return debug_pb2.StateResponse(state_json=json.dumps(state))
+
+    async def GetRequestTrace(self, request, context):  # noqa: ANN001
+        trace_fn = getattr(self._engine, "request_trace", None)
+        if trace_fn is None:
+            await context.abort(
+                grpc.StatusCode.UNIMPLEMENTED,
+                "engine exposes no request traces",
+            )
+        if not request.request_id:
+            await context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT, "request_id required"
+            )
+        trace = trace_fn(request.request_id)
+        if trace is None:
+            await context.abort(
+                grpc.StatusCode.NOT_FOUND,
+                f"request {request.request_id!r} is unknown (never "
+                "admitted, or its events aged out of the flight recorder)",
+            )
+        return debug_pb2.RequestTraceResponse(trace_json=json.dumps(trace))
 
     @staticmethod
     async def _run(op, context):  # noqa: ANN001

@@ -580,12 +580,13 @@ async def start_grpc_server(
     rpc.add_GenerationServiceServicer_to_server(service, server)
 
     # debug service: on-demand profiler capture sharing the HTTP routes'
-    # controller (profiler.py get_controller)
+    # controller (profiler.py get_controller), plus DumpState /
+    # GetRequestTrace engine introspection off the shared engine
     from vllm_tgis_adapter_tpu.grpc import debug as debug_svc
     from vllm_tgis_adapter_tpu.profiler import get_controller
 
     debug_servicer = debug_svc.DebugServicer(
-        get_controller(getattr(args, "profile_dir", None))
+        get_controller(getattr(args, "profile_dir", None)), engine
     )
     debug_svc.add_DebugServicer_to_server(debug_servicer, server)
 

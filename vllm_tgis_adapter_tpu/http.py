@@ -64,6 +64,9 @@ class HttpRequest:
         self.path = path
         self.headers = headers
         self.body = body
+        # decoded tail of a prefix route (App.route_prefix), e.g. the
+        # {id} of /debug/requests/{id}; set by App.dispatch
+        self.path_param: Optional[str] = None
 
     def json(self) -> Any:
         return json.loads(self.body or b"{}")
@@ -115,6 +118,9 @@ class App:
 
     def __init__(self, root_path: str | None = None) -> None:
         self.routes: dict[tuple[str, str], Callable] = {}
+        # path-prefix routes ({prefix}{rest}, e.g. /debug/requests/{id});
+        # the matched suffix is delivered as request.path_param
+        self.prefix_routes: dict[tuple[str, str], Callable] = {}
         self.state: dict[str, Any] = {}
         # --root-path: prefix prepended by a reverse proxy; requests
         # arrive as {root_path}{route} and are matched with it stripped
@@ -123,6 +129,16 @@ class App:
     def route(self, method: str, path: str):  # noqa: ANN201
         def register(fn):  # noqa: ANN001, ANN202
             self.routes[(method, path)] = fn
+            return fn
+
+        return register
+
+    def route_prefix(self, method: str, prefix: str):  # noqa: ANN201
+        """Register ``{prefix}{rest}``; the handler reads the decoded
+        ``rest`` from ``request.path_param``."""
+
+        def register(fn):  # noqa: ANN001, ANN202
+            self.prefix_routes[(method, prefix)] = fn
             return fn
 
         return register
@@ -140,6 +156,15 @@ class App:
         handler = None
         for path in candidates:
             handler = self.routes.get((request.method, path))
+            if handler is not None:
+                break
+            for (method, prefix), fn in self.prefix_routes.items():
+                if method == request.method and path.startswith(prefix):
+                    from urllib.parse import unquote
+
+                    request.path_param = unquote(path[len(prefix):])
+                    handler = fn
+                    break
             if handler is not None:
                 break
         if handler is None:
@@ -181,6 +206,11 @@ def build_http_server(args: "argparse.Namespace", engine: "AsyncLLMEngine") -> A
     )
     app.route("POST", "/start_profile")(_start_profile)
     app.route("POST", "/stop_profile")(_stop_profile)
+    # live engine-state introspection (flight_recorder.py): the same
+    # snapshot/timeline serializer the stall watchdog dumps and the gRPC
+    # Debug service serves, so all surfaces tell one story
+    app.route("GET", "/debug/state")(_debug_state)
+    app.route_prefix("GET", "/debug/requests/")(_debug_request)
     return app
 
 
@@ -225,6 +255,37 @@ async def _stop_profile(app: App, request: HttpRequest) -> HttpResponse:  # noqa
         return error_response(
             409 if "no profiler capture" in str(e) else 400, str(e)
         )
+
+
+async def _debug_state(app: App, request: HttpRequest) -> HttpResponse:  # noqa: ARG001
+    """Full engine-state snapshot: scheduler queues with ages, KV pool
+    stats, in-flight batch plan, compile-tracker + watchdog state, and
+    the flight recorder's recent events (AsyncLLMEngine.debug_state)."""
+    engine: AsyncLLMEngine = app.state["engine"]
+    state_fn = getattr(engine, "debug_state", None)
+    if state_fn is None:
+        return error_response(501, "engine exposes no debug state")
+    return JsonResponse(state_fn())
+
+
+async def _debug_request(app: App, request: HttpRequest) -> HttpResponse:
+    """One request's flight-recorder timeline (+ live state while it is
+    still in the engine)."""
+    engine: AsyncLLMEngine = app.state["engine"]
+    request_id = request.path_param or ""
+    if not request_id:
+        return error_response(400, "request id required")
+    trace_fn = getattr(engine, "request_trace", None)
+    if trace_fn is None:
+        return error_response(501, "engine exposes no request traces")
+    trace = trace_fn(request_id)
+    if trace is None:
+        return error_response(
+            404,
+            f"request {request_id!r} is unknown (never admitted, or its "
+            "events aged out of the flight recorder)",
+        )
+    return JsonResponse(trace)
 
 
 async def _tokenize(app: App, request: HttpRequest) -> HttpResponse:

@@ -273,6 +273,33 @@ xla_compiled_shapes = _get_or_create(
 )
 
 
+# ---- flight recorder + stall watchdog (flight_recorder.py /
+# watchdog.py): the black-box half of observability.  The events counter
+# makes recorder throughput alertable (a silent recorder during an
+# incident is itself a finding); the heartbeat-age gauge and stall
+# counter turn step-loop hangs into pageable signals instead of
+# dump-files nobody reads until the postmortem.
+flight_recorder_events_total = _get_or_create(
+    Counter,
+    f"{_PREFIX}_flight_recorder_events_total",
+    "Request lifecycle events recorded in the flight-recorder ring, by "
+    "event kind (admit/prefill/decode/preempt/swap/finish/abort/...)",
+    labelnames=("kind",),
+)
+watchdog_last_heartbeat_age_seconds = _get_or_create(
+    Gauge,
+    f"{_PREFIX}_watchdog_last_heartbeat_age_seconds",
+    "Seconds since the engine step loop last beat the stall watchdog "
+    "(sampled on every watchdog tick)",
+)
+watchdog_stalls_total = _get_or_create(
+    Counter,
+    f"{_PREFIX}_watchdog_stalls_total",
+    "Step-loop stalls the watchdog detected (heartbeat older than the "
+    "deadline with work in flight and no compile in progress)",
+)
+
+
 class _StepSnapshot:
     """Host-side mirror of the latest per-dispatch shape stats, so the
     periodic stats log line (engine/async_llm.py) can report them without

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import functools
 import os
+import threading
 
 import jax
 import jax.numpy as jnp
@@ -50,6 +51,75 @@ def _use_pallas() -> bool:
     if mode == "pallas":
         return True
     return jax.default_backend() == "tpu"
+
+
+# ---- decode-kernel variant selection + serving-path degradation.
+#
+# The folded decode kernel (pallas_attention._decode_kernel_folded) is
+# faster but carries interpreter parity only; the per-head kernel is
+# hardware-validated, so it is the DEFAULT (ADVICE r5).  When an
+# operator opts into folded (PALLAS_DECODE_KERNEL=folded) and Mosaic
+# rejects it at the first real compile, the serving path must degrade —
+# folded → perhead → xla, the same chain bench.py retries through —
+# instead of crashing the server at boot (--precompile) or on the first
+# decode.  The override is process-global on purpose: one Mosaic verdict
+# applies to every engine replica in the process — which also means dp
+# replicas' dispatch threads can fail CONCURRENTLY, so the step-down is
+# a locked compare-and-swap: threads reporting the same failed variant
+# burn exactly one level between them.
+_DECODE_KERNEL_CHAIN = ("folded", "perhead", "xla")
+_decode_kernel_lock = threading.Lock()
+_decode_kernel_override: str | None = None
+
+
+def decode_kernel_variant() -> str:
+    """The decode-kernel variant this dispatch will use: a sticky
+    degradation override if one is set, else the env/default."""
+    if _decode_kernel_override is not None:
+        return _decode_kernel_override
+    return os.environ.get("PALLAS_DECODE_KERNEL", "perhead")
+
+
+def degrade_decode_kernel(failed: str | None = None) -> str | None:
+    """Step the decode kernel down one level (folded → perhead → xla).
+
+    ``failed`` names the variant the caller observed failing: if another
+    thread already degraded past it, the current (newer) variant is
+    returned WITHOUT stepping again, so concurrent identical failures
+    cannot skip straight to the XLA floor.  Returns the variant to retry
+    with, or None when already at the floor.
+    """
+    global _decode_kernel_override
+    with _decode_kernel_lock:
+        current = decode_kernel_variant()
+        if failed is not None and current != failed:
+            return current  # someone else degraded already: retry as-is
+        try:
+            idx = _DECODE_KERNEL_CHAIN.index(current)
+        except ValueError:
+            idx = 0
+        if idx + 1 >= len(_DECODE_KERNEL_CHAIN):
+            return None
+        _decode_kernel_override = _DECODE_KERNEL_CHAIN[idx + 1]
+        return _decode_kernel_override
+
+
+def reset_decode_kernel() -> None:
+    """Test hook: clear a sticky degradation."""
+    global _decode_kernel_override
+    with _decode_kernel_lock:
+        _decode_kernel_override = None
+
+
+def is_kernel_lowering_error(exc: BaseException) -> bool:
+    """Heuristic: does this exception look like a Pallas/Mosaic lowering
+    or compile failure (retriable by degrading the kernel) rather than a
+    bug in the inputs?"""
+    text = f"{type(exc).__name__}: {exc}"
+    return any(
+        marker in text
+        for marker in ("Mosaic", "mosaic", "Pallas", "pallas")
+    )
 
 
 def _pallas_interpret() -> bool:
@@ -276,7 +346,11 @@ def paged_decode_attention(
     Under a TP mesh the kernel runs inside shard_map: the cache is
     head-sharded on tp, so each shard's kernel reads only its local pages.
     """
-    if _use_pallas():
+    # the variant resolves OUTSIDE the jitted model so a degradation
+    # (folded → perhead → xla, see degrade_decode_kernel) selects a
+    # fresh trace on the retry instead of hitting a stale cache entry
+    variant = decode_kernel_variant()
+    if _use_pallas() and variant != "xla":
         from vllm_tgis_adapter_tpu.ops import pallas_attention
 
         kernel = functools.partial(
@@ -285,6 +359,7 @@ def paged_decode_attention(
             scale=scale,
             window=window,
             interpret=_pallas_interpret(),
+            variant=variant,
         )
         if mesh is not None:
             from jax.sharding import PartitionSpec as P

@@ -298,6 +298,17 @@ def add_engine_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         "TensorBoard/XProf")
     g.add_argument("--disable-log-requests", action="store_true",
                    help="disable engine-level per-request logs")
+    g.add_argument("--dump-dir", type=str, default=None,
+                   help="directory for stall-watchdog diagnostic "
+                        "snapshots (one timestamped JSON file per "
+                        "detected step-loop stall); unset keeps dumps "
+                        "in the log and termination log only")
+    g.add_argument("--watchdog-deadline", type=float, default=120.0,
+                   help="seconds the engine step loop may go without a "
+                        "heartbeat (while work is in flight) before the "
+                        "stall watchdog dumps engine state; suspended "
+                        "during in-flight XLA/Mosaic compiles; 0 "
+                        "disables the watchdog")
 
     return parser
 

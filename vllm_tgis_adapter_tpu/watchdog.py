@@ -1,0 +1,197 @@
+"""Stall watchdog: turns a silent step-loop hang into a diagnostic dump.
+
+The async engine's step loops beat this watchdog on every iteration
+(``engine/async_llm.py``).  When a replica has unfinished work but its
+loop has not beaten for ``deadline_s`` (default 120 s), the watchdog
+emits one full diagnostic snapshot — scheduler queues with request ages,
+KV allocator occupancy, the in-flight batch plan, compile-tracker state,
+and the last N flight-recorder events — to three places at once:
+
+* the log (ERROR, single line of JSON so log pipelines keep it intact),
+* the Kubernetes termination log (the stall usually precedes a liveness
+  kill; the dump must survive the pod),
+* a timestamped JSON file under ``--dump-dir`` (when configured).
+
+Compile-awareness: XLA/Mosaic compiles on TPU run 20-40 s *each* and a
+cold bucket sweep runs several back to back, all of which legitimately
+starves the heartbeat.  While the compile tracker reports a tracked
+dispatch in flight the deadline is suspended — up to
+``compile_grace_s`` (default 600 s), after which a "compile" that never
+returns is treated as the hang it is.
+
+One dump per stall episode: after firing, the watchdog re-arms only
+once a fresh heartbeat proves the loop recovered.  File writes happen in
+``asyncio.to_thread`` so the dump path itself can never block the event
+loop it is diagnosing (tpulint TPL302).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import time
+from typing import Callable, Optional
+
+from vllm_tgis_adapter_tpu import compile_tracker, metrics
+from vllm_tgis_adapter_tpu.logging import init_logger
+from vllm_tgis_adapter_tpu.utils import write_termination_log
+
+logger = init_logger(__name__)
+
+DEFAULT_DEADLINE_S = 120.0
+DEFAULT_COMPILE_GRACE_S = 600.0
+
+
+class StallWatchdog:
+    """Heartbeat-fed watchdog task over one engine's step loops.
+
+    ``snapshot_fn`` builds the diagnostic dict (the shared serializer in
+    ``flight_recorder.py`` via ``AsyncLLMEngine.debug_state``);
+    ``active_fn`` reports whether any work is in flight (an idle engine
+    never beats, and never stalls); ``beat()`` is called by the step
+    loops (and on request submission, so a dead loop gets exactly one
+    deadline of grace from the moment work arrives).
+    """
+
+    def __init__(
+        self,
+        *,
+        snapshot_fn: Callable[[], dict],
+        active_fn: Callable[[], bool],
+        age_fn: Optional[Callable[[], float]] = None,
+        deadline_s: float = DEFAULT_DEADLINE_S,
+        compile_grace_s: float = DEFAULT_COMPILE_GRACE_S,
+        dump_dir: Optional[str] = None,
+        check_interval_s: Optional[float] = None,
+        termination_log: Optional[str] = None,
+    ):
+        self.deadline_s = deadline_s
+        self.compile_grace_s = compile_grace_s
+        self.dump_dir = dump_dir
+        self.check_interval_s = check_interval_s or max(
+            1.0, min(deadline_s / 4, 15.0)
+        )
+        self._snapshot_fn = snapshot_fn
+        self._active_fn = active_fn
+        # age_fn overrides the built-in single heartbeat: a dp fleet
+        # reports max(age over replicas with unfinished work), so one
+        # stalled replica fires even while its siblings beat happily
+        self._age_fn = age_fn
+        self._termination_log = termination_log or os.getenv(
+            "TERMINATION_LOG_DIR", "/dev/termination-log"
+        )
+        self._last_beat = time.monotonic()
+        self._fired = False  # one dump per stall episode
+        self.stalls = 0  # fired count (the counter metric keeps history)
+        self.last_dump_path: Optional[str] = None
+        self._task: Optional[asyncio.Task] = None
+
+    # ------------------------------------------------------------ heartbeat
+
+    def beat(self) -> None:
+        self._last_beat = time.monotonic()
+
+    def heartbeat_age(self) -> float:
+        if self._age_fn is not None:
+            return self._age_fn()
+        return time.monotonic() - self._last_beat
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> None:
+        if self._task is None:
+            self.beat()  # boot counts as a beat: deadline starts now
+            self._task = asyncio.get_running_loop().create_task(
+                self.run(), name="stall-watchdog"
+            )
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+            self._task = None
+
+    async def run(self) -> None:
+        while True:
+            await asyncio.sleep(self.check_interval_s)
+            await self.check()
+
+    # ----------------------------------------------------------- detection
+
+    async def check(self) -> Optional[dict]:
+        """One watchdog tick; returns the snapshot if a stall fired."""
+        age = self.heartbeat_age()
+        metrics.watchdog_last_heartbeat_age_seconds.set(age)
+        if age <= self.deadline_s:
+            self._fired = False  # loop recovered: re-arm
+            return None
+        if not self._active_fn():
+            return None
+        inflight = compile_tracker.inflight_dispatch()
+        if inflight is not None and inflight[1] < self.compile_grace_s:
+            # a tracked dispatch (possibly a 20-40s Mosaic compile, or a
+            # serial warmup sweep of them) is still making the runtime
+            # do work — suspend the stall verdict until the grace runs out
+            logger.debug(
+                "watchdog suspended: dispatch %s in flight for %.1fs",
+                inflight[0], inflight[1],
+            )
+            return None
+        if self._fired:
+            return None  # already dumped this episode
+        self._fired = True
+        return await self.fire(age)
+
+    async def fire(self, age: float) -> dict:
+        """Emit the diagnostic snapshot everywhere it can outlive the pod."""
+        self.stalls += 1
+        metrics.watchdog_stalls_total.inc()
+        try:
+            snapshot = self._snapshot_fn()
+        except Exception:  # noqa: BLE001 — a broken engine is the expected case
+            logger.exception("watchdog snapshot collection failed")
+            snapshot = {"error": "snapshot collection failed"}
+        snapshot = {
+            "reason": "step-loop heartbeat stall",
+            "heartbeat_age_s": round(age, 3),
+            "deadline_s": self.deadline_s,
+            "dumped_at": time.time(),
+            **snapshot,
+        }
+        blob = json.dumps(snapshot, default=str)
+        logger.error(
+            "engine step loop stalled (no heartbeat for %.1fs > %.1fs "
+            "deadline); diagnostic snapshot: %s", age, self.deadline_s, blob,
+        )
+        dump_ref = "logs"
+        if self.dump_dir:
+            path = os.path.join(
+                self.dump_dir,
+                f"stall-{time.strftime('%Y%m%dT%H%M%S')}-{self.stalls}.json",
+            )
+
+            def _write() -> None:
+                os.makedirs(self.dump_dir, exist_ok=True)
+                with open(path, "w") as f:
+                    f.write(blob)
+
+            try:
+                await asyncio.to_thread(_write)
+                self.last_dump_path = path
+                dump_ref = path
+                logger.error("stall snapshot written to %s", path)
+            except Exception:  # noqa: BLE001 — the log copy already exists
+                logger.exception("failed to write stall dump to %s", path)
+        summary = (
+            f"engine step loop stalled: no heartbeat for {age:.1f}s "
+            f"(deadline {self.deadline_s:.0f}s); see {dump_ref} for the "
+            "full snapshot"
+        )
+        await asyncio.to_thread(
+            write_termination_log, summary, self._termination_log
+        )
+        return snapshot
