@@ -171,6 +171,13 @@ def run_bench(on_tpu: bool) -> dict:
 
     backend = jax.default_backend()
     device = jax.devices()[0]
+    # the variant the run STARTS with; "decode_kernel" in the emitted
+    # stats is re-read after the run, so a serving-path degradation
+    # (degrade_decode_kernel) shows up as requested != dispatched plus
+    # the decode_kernel_degrades event list
+    requested_kernel = (
+        attn_ops.decode_kernel_variant() if attn_ops._use_pallas() else None
+    )
     tiny = os.environ.get("BENCH_TINY", "") == "1" or backend != "tpu"
     n_requests = int(os.environ.get("BENCH_REQUESTS", 16 if tiny else 128))
     prompt_len = int(os.environ.get("BENCH_PROMPT", 32 if tiny else 128))
@@ -350,10 +357,16 @@ def run_bench(on_tpu: bool) -> dict:
         "attention_backend": (
             "pallas" if attn_ops._use_pallas() else "xla"
         ),
+        # post-run read: the variant decode dispatches actually ended on
+        # (degradation is sticky), not the one the run was asked for
         "decode_kernel": (
             attn_ops.decode_kernel_variant()
             if attn_ops._use_pallas() else None
         ),
+        "decode_kernel_requested": requested_kernel,
+        # every folded→perhead→xla step the process took, timestamped —
+        # a 4x tok/s drop with a non-empty list here is attributable
+        "decode_kernel_degrades": attn_ops.decode_kernel_degrades(),
         "device_kind": device.device_kind,
         "mfu": mfu,
         "model_gflop_per_tok": round(flops_per_tok / 1e9, 3),

@@ -49,6 +49,21 @@ def obs_check(session: nox.Session) -> None:
 
 
 @nox.session(python="3.12")
+def overload_check(session: nox.Session) -> None:
+    """Synthetic-overload gate (docs/FRONTDOOR.md): flood a small
+    engine through the front door and assert bounded queue depth,
+    correct shed statuses + Retry-After, per-tenant fairness, and a
+    lossless SIGTERM drain.  Also runs inside the tier-1 suite; this
+    session is the fast standalone entry point."""
+    session.install("-e", ".[tests]")
+    session.run(
+        "pytest", "tests/test_frontdoor.py", "-q",
+        *session.posargs,
+        env={"JAX_PLATFORMS": "cpu"},
+    )
+
+
+@nox.session(python="3.12")
 def lint(session: nox.Session) -> None:
     # rule set pinned in pyproject.toml [tool.ruff.lint] — reproducible
     # across ruff releases instead of the floating defaults

@@ -40,9 +40,20 @@ def documented_metrics(doc_path: Path) -> set[str]:
 # top-level sections docs/OBSERVABILITY.md documents for the
 # /debug/state snapshot; a missing key means code and doc diverged
 DEBUG_STATE_KEYS = (
-    "engine", "replicas", "compile_tracker", "watchdog", "events",
+    "engine", "frontdoor", "replicas", "compile_tracker", "watchdog",
+    "events",
 )
 REPLICA_KEYS = ("scheduler", "kv_cache", "in_flight", "step_counter")
+
+# the front-door metric surface (docs/FRONTDOOR.md) must BOTH be
+# documented in docs/OBSERVABILITY.md and appear on /metrics — adding a
+# frontdoor metric without documenting it fails here, not in review
+REQUIRED_FRONTDOOR_METRICS = (
+    "tgis_tpu_frontdoor_queue_depth",
+    "tgis_tpu_frontdoor_queue_age_seconds",
+    "tgis_tpu_frontdoor_sheds_total",
+    "tgis_tpu_frontdoor_tenant_tokens_total",
+)
 
 
 async def scrape_metrics() -> tuple[str, dict]:
@@ -113,6 +124,17 @@ def main() -> int:
     documented = documented_metrics(REPO_ROOT / "docs" / "OBSERVABILITY.md")
     if not documented:
         print("obs_check: no metrics documented — parse failure?")
+        return 1
+    undocumented = sorted(
+        name
+        for name in REQUIRED_FRONTDOOR_METRICS
+        if name not in documented
+    )
+    if undocumented:
+        print(
+            "obs_check: front-door metrics missing from "
+            "docs/OBSERVABILITY.md: " + ", ".join(undocumented)
+        )
         return 1
     scraped, state = asyncio.run(scrape_metrics())
     missing = sorted(

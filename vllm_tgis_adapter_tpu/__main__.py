@@ -72,7 +72,10 @@ async def start_servers(args: "argparse.Namespace") -> None:
         )
 
     engine = None
+    drain = None
     tasks: list[asyncio.Task] = []
+    drain_waiter: asyncio.Task | None = None
+    loop = asyncio.get_running_loop()
     try:
         from vllm_tgis_adapter_tpu.engine.config import EngineConfig
 
@@ -86,9 +89,20 @@ async def start_servers(args: "argparse.Namespace") -> None:
         # uniform TGIS-style request logging for both servers
         logs.add_logging_wrappers(engine)
 
+        # graceful drain (frontdoor/drain.py): SIGTERM stops admission
+        # (health → DRAINING/503), in-flight generations finish up to
+        # --drain-grace, the termination log is checkpointed, and only
+        # then are the server tasks torn down
+        from vllm_tgis_adapter_tpu.frontdoor.drain import DrainCoordinator
+
+        drain = DrainCoordinator(
+            engine,
+            grace_s=engine.engine.config.frontdoor.drain_grace_s,
+        )
+        drain.install(loop)
+
         http_app = build_http_server(args, engine)
 
-        loop = asyncio.get_running_loop()
         tasks = [
             loop.create_task(
                 run_http_server(args, engine, http_app, sock),
@@ -103,9 +117,18 @@ async def start_servers(args: "argparse.Namespace") -> None:
         with_task_names = ", ".join(t.get_name() for t in tasks)
         logger.info("Started tasks: %s", with_task_names)
 
-        done, _pending = await asyncio.wait(
-            tasks, return_when=asyncio.FIRST_COMPLETED
+        drain_waiter = loop.create_task(
+            drain.shutdown_event.wait(), name="drain_shutdown"
         )
+        done, _pending = await asyncio.wait(
+            [*tasks, drain_waiter], return_when=asyncio.FIRST_COMPLETED
+        )
+
+        if drain_waiter in done:
+            # drained to completion: this is the clean exit path — the
+            # finally block cancels the (idle) servers
+            logger.info("drain complete; shutting down servers")
+            return
 
         if engine.errored:
             # surface the engine failure rather than a generic task error
@@ -117,6 +140,10 @@ async def start_servers(args: "argparse.Namespace") -> None:
                     f"task {task.get_name()} failed"
                 ) from exception
     finally:
+        if drain is not None:
+            drain.uninstall(loop)
+        if drain_waiter is not None and not drain_waiter.done():
+            drain_waiter.cancel()
         for task in tasks:
             if not task.done():
                 task.cancel()

@@ -35,8 +35,15 @@ from typing import Optional
 
 from vllm_tgis_adapter_tpu.engine.config import EngineConfig
 from vllm_tgis_adapter_tpu.engine.core import LLMEngine, describe_plan
-from vllm_tgis_adapter_tpu.engine.outputs import RequestOutput
+from vllm_tgis_adapter_tpu.engine.outputs import (
+    CompletionOutput,
+    RequestOutput,
+)
 from vllm_tgis_adapter_tpu.engine.sampling_params import SamplingParams
+from vllm_tgis_adapter_tpu.frontdoor.errors import (
+    SHED_TTL,
+    AdmissionShedError,
+)
 from vllm_tgis_adapter_tpu.logging import init_logger
 
 logger = init_logger(__name__)
@@ -94,6 +101,43 @@ class AsyncLLMEngine:
             from vllm_tgis_adapter_tpu.tracing import RequestTracer
 
             self._tracer = RequestTracer(endpoint)
+        # front door (frontdoor/admission.py): bounded admission, per-
+        # tenant weighted fair queuing, rate limits, queue TTLs, drain.
+        # The serving layer hands requests here; the engine's own
+        # waiting queue keeps only a small admission window (enough for
+        # packed prefill to see candidates) and everything beyond it
+        # parks in the fair queue.
+        self.frontdoor = None
+        fd_config = getattr(self.engine.config, "frontdoor", None)
+        if fd_config is not None and fd_config.enabled:
+            from vllm_tgis_adapter_tpu.engine.scheduler import MAX_PACK
+            from vllm_tgis_adapter_tpu.frontdoor.admission import FrontDoor
+
+            window = min(
+                self.engine.config.scheduler_config.max_num_seqs,
+                MAX_PACK,
+            )
+            self.frontdoor = FrontDoor(
+                fd_config,
+                admit_window=window,
+                room_fn=self._frontdoor_room,
+                waiting_depth_fn=lambda: sum(
+                    len(rep.engine.scheduler.waiting)
+                    for rep in self._replicas
+                ),
+                backlog_tokens_fn=lambda: float(sum(
+                    rep.engine.scheduler.waiting_token_backlog()
+                    for rep in self._replicas
+                )),
+                kv_token_capacity_fn=self._kv_token_capacity,
+                record_shed=self._record_shed,
+            )
+            for rep in self._replicas:
+                # scheduler-side TTL sheds count toward the same
+                # lifetime total /debug/state reports
+                rep.engine.scheduler.shed_hook = (
+                    self.frontdoor.note_external_shed
+                )
         # stall watchdog (watchdog.py): heartbeat-fed; fires a full
         # diagnostic snapshot when a step loop with unfinished work stops
         # beating past the configured deadline.  0 disables.
@@ -112,6 +156,54 @@ class AsyncLLMEngine:
                 deadline_s=config.watchdog_deadline_s,
                 dump_dir=config.dump_dir,
             )
+
+    # ------------------------------------------------------------ frontdoor
+
+    def _frontdoor_room(self, pending: int) -> bool:
+        """Can some replica take another admission, counting grants
+        already issued but not yet turned into ``add_request``?"""
+        depth = min(
+            len(rep.engine.scheduler.waiting) for rep in self._replicas
+        )
+        return depth + pending < self.frontdoor.admit_window
+
+    def _kv_token_capacity(self) -> float:
+        """Total KV pool size in tokens (the resolve_num_blocks budget
+        across replicas) — the admission estimator's throughput prior."""
+        total = 0
+        for rep in self._replicas:
+            scheduler = rep.engine.scheduler
+            total += scheduler.allocator.num_blocks * scheduler.block_size
+        return float(total)
+
+    def _record_shed(
+        self, request_id: str, tenant: str, reason: str, **detail
+    ) -> None:
+        """Flight-recorder hook for front-door sheds; the request never
+        reached a replica, so the event lands on the host-surface
+        (replica 0) recorder."""
+        self.engine.recorder.record(
+            "shed", request_id, step=self.engine.step_counter,
+            tenant=tenant, reason=reason, **detail,
+        )
+
+    @staticmethod
+    def _plan_tokens(plan) -> int:  # noqa: ANN001 — any engine plan type
+        """Committed-token estimate of one dispatch, for the front
+        door's throughput EWMA.  Tolerant of every plan shape."""
+        items = getattr(plan, "items", None)
+        if items is not None:  # packed prefill
+            return sum(len(i.token_ids) for i in items)
+        token_ids = getattr(plan, "token_ids", None)
+        if token_ids is not None:  # solo prefill chunk
+            return len(token_ids)
+        steps = getattr(plan, "steps_per_seq", None)
+        if steps:  # fused decode
+            return sum(steps)
+        seqs = getattr(plan, "seqs", None)
+        if seqs is not None:
+            return len(seqs) * getattr(plan, "num_steps", 1)
+        return 0
 
     # ------------------------------------------------------------- lifecycle
 
@@ -197,6 +289,10 @@ class AsyncLLMEngine:
 
     async def stop(self) -> None:
         self._stopped = True
+        if self.frontdoor is not None:
+            # parked waiters fail fast instead of hanging on a pump
+            # that is about to be cancelled
+            await self.frontdoor.shutdown()
         if self.watchdog is not None:
             await self.watchdog.stop()
         if self._stats_task is not None:
@@ -269,11 +365,19 @@ class AsyncLLMEngine:
         prompt_token_ids: Optional[list[int]] = None,
         lora_request=None,  # noqa: ANN001 — adapter-store LoRARequest
         trace_headers: Optional[Mapping[str, str]] = None,
+        tenant_id: Optional[str] = None,
+        deadline: Optional[float] = None,
     ) -> AsyncGenerator[RequestOutput, None]:
         """Submit a request and stream its outputs.
 
         Yield cadence follows ``sampling_params.output_kind``: DELTA and
         CUMULATIVE yield every step, FINAL_ONLY yields exactly once.
+
+        ``tenant_id`` keys front-door fair queuing / rate limits;
+        ``deadline`` (epoch seconds) lets the queue TTL early-abort the
+        request if it would only start prefill after its SLO.  May raise
+        ``AdmissionShedError`` (frontdoor/errors.py) before any engine
+        state is touched.
         """
         if self.errored:
             raise self.dead_error
@@ -283,6 +387,62 @@ class AsyncLLMEngine:
         if request_id in self._queues:
             # reject WITHOUT touching the existing request's queue
             raise ValueError(f"duplicate request_id {request_id!r}")
+        if self.frontdoor is None:
+            # --disable-frontdoor restores pre-PR4 semantics entirely:
+            # no queue-TTL deadline reaches the scheduler either
+            deadline = None
+        else:
+            # the queue-TTL clock starts NOW — time parked in the fair
+            # queue counts against --queue-ttl, not just engine time
+            ttl = self.frontdoor.config.queue_ttl_s
+            if ttl > 0:
+                ttl_deadline = time.time() + ttl
+                deadline = (
+                    ttl_deadline
+                    if deadline is None
+                    else min(deadline, ttl_deadline)
+                )
+            # the front door may park us (fair-queue order, engine
+            # admission window) or shed us (bounds/limits/drain); a shed
+            # leaves zero engine state behind
+            est_tokens = (
+                len(prompt_token_ids)
+                if prompt_token_ids is not None
+                else max(1, len(prompt or "") // 4)
+            ) + (sampling_params.max_tokens or 16)
+            try:
+                await self.frontdoor.acquire(
+                    request_id=request_id,
+                    tenant=tenant_id or getattr(lora_request, "name", None),
+                    tokens=float(est_tokens),
+                    deadline=deadline,
+                )
+            except AdmissionShedError as e:
+                if e.reason != SHED_TTL:
+                    raise
+                # deadline passed while parked: the SAME graceful wire
+                # shape as a scheduler-side TTL shed — one final empty
+                # aborted frame, not an RPC error.  A batched RPC's
+                # timed-out sub-request must not abort its siblings,
+                # and TGIS time_limit semantics are a partial (here:
+                # empty) response, not DEADLINE_EXCEEDED.
+                yield RequestOutput(
+                    request_id=request_id,
+                    prompt=prompt,
+                    prompt_token_ids=list(prompt_token_ids or []),
+                    outputs=[CompletionOutput(
+                        index=0, text="", token_ids=[],
+                        finish_reason="abort",
+                    )],
+                    finished=True,
+                )
+                return
+            if request_id in self._queues:
+                # re-check after the suspension: a same-id request may
+                # have registered while we were parked — clobbering its
+                # queue would orphan its output stream
+                self.frontdoor.note_admitted()
+                raise ValueError(f"duplicate request_id {request_id!r}")
         queue: asyncio.Queue = asyncio.Queue()
         self._queues[request_id] = queue
         # least-loaded replica wins; ties fall to the lowest index, so a
@@ -309,6 +469,7 @@ class AsyncLLMEngine:
                     prompt_token_ids=prompt_token_ids,
                     lora_name=getattr(lora_request, "name", None),
                     trace_id=getattr(span, "trace_id", None),
+                    deadline=deadline,
                 )
                 if request_id in self._early_aborts:
                     # abort() ran before the engine knew the request; it
@@ -331,6 +492,12 @@ class AsyncLLMEngine:
                 span.attributes["error.type"] = type(e).__name__
                 self._tracer.finish_span(span, None)
             raise
+        finally:
+            if self.frontdoor is not None:
+                # the admission-window slot the front door granted is
+                # now the scheduler's (or vacated, on failure) — runs on
+                # every exit from the critical section, exactly once
+                self.frontdoor.note_admitted()
         if aborted_out is not None:
             queue.put_nowait(aborted_out)
         # submission counts as a beat: a parked loop gets one full
@@ -369,6 +536,9 @@ class AsyncLLMEngine:
         queue = self._queues.get(request_id)
         if queue is not None and out is not None:
             queue.put_nowait(out)
+        if self.frontdoor is not None:
+            # an aborted waiting request vacates admission-window room
+            self.frontdoor.kick()
 
     # -------------------------------------------------------- introspection
 
@@ -437,6 +607,11 @@ class AsyncLLMEngine:
                 "errored": self.errored,
                 "replicas": len(self._replicas),
             },
+            "frontdoor": (
+                self.frontdoor.debug_state()
+                if self.frontdoor is not None
+                else None
+            ),
             "replicas": replicas,
             "compile_tracker": {
                 "compiled_shapes": compile_tracker.num_shapes(),
@@ -496,11 +671,20 @@ class AsyncLLMEngine:
         allocators = [e.scheduler.allocator for e in engines]
         num_blocks = sum(a.num_blocks for a in allocators)
         used = num_blocks - sum(a.num_free for a in allocators)
+        # requests parked in the front-door fair queue are "waiting" in
+        # every operational sense (they count against the bound and the
+        # autoscaler should see them), they just haven't reached a
+        # scheduler deque yet
+        parked = 0
+        if self.frontdoor is not None:
+            parked = self.frontdoor.parked
+            self.frontdoor.refresh_gauges()
         try:
             from vllm_tgis_adapter_tpu import metrics
 
             metrics.update_engine_gauges(
-                waiting=sum(len(e.scheduler.waiting) for e in engines),
+                waiting=parked
+                + sum(len(e.scheduler.waiting) for e in engines),
                 kv_used=used,
                 kv_total=num_blocks,
                 prefix_hits=sum(a.prefix_hits for a in allocators),
@@ -633,6 +817,10 @@ class AsyncLLMEngine:
             rep.in_flight_desc = None
             rep.last_beat = time.monotonic()
             await emit(outs)
+            if self.frontdoor is not None:
+                # finished rows free batch slots/pages and the commit's
+                # tokens feed the admission estimator's throughput EWMA
+                self.frontdoor.note_progress(self._plan_tokens(plan))
 
         async def try_chain() -> Optional[tuple]:
             """Dispatch the in-flight decode's successor wave from
@@ -674,6 +862,10 @@ class AsyncLLMEngine:
                         prefill_only=in_flight is not None
                     )
                 await emit(outputs)
+                if self.frontdoor is not None:
+                    # planning admits waiting rows (and sheds expired
+                    # ones): admission-window room may have opened
+                    self.frontdoor.kick()
                 if plan is None:
                     if in_flight is not None:
                         chained = await try_chain()
@@ -715,9 +907,20 @@ class AsyncLLMEngine:
                 "error", step=engine.step_counter, replica=rep.index,
                 error=f"{type(e).__name__}: {e}",
             )
-            self._dead_error = e
+            # typed at the boundary (frontdoor/errors.py): XLA OOM text
+            # becomes DeviceOOMError here, so the servers map engine
+            # death to a status code by isinstance, never by substring
+            from vllm_tgis_adapter_tpu.frontdoor.errors import (
+                wrap_engine_error,
+            )
+
+            err = wrap_engine_error(e)
+            self._dead_error = err
             for queue in self._queues.values():
-                queue.put_nowait(e)
+                queue.put_nowait(err)
+            if self.frontdoor is not None:
+                # parked waiters must observe the death too
+                self.frontdoor.fail_all(err)
             raise
         finally:
             # epochs left open by a death between a chained dispatch and

@@ -804,6 +804,97 @@ class SpeculativeConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class FrontdoorConfig:
+    """Admission control / fair queuing / drain knobs (frontdoor/).
+
+    All-zero defaults reproduce the pre-frontdoor behavior exactly
+    except for ordering: requests beyond the engine's small admission
+    window park in the weighted fair queue instead of the scheduler's
+    deque, and are released in per-tenant virtual-time order.
+    """
+
+    enabled: bool = True
+    # > 0 bounds parked + engine-waiting requests; past it new arrivals
+    # shed with RESOURCE_EXHAUSTED/429 + Retry-After.  0 = unbounded.
+    max_waiting_requests: int = 0
+    # > 0 sheds a request when the ESTIMATED queue-drain time (observed
+    # token throughput EWMA, seeded from KV-pool token capacity)
+    # already exceeds this many seconds.  0 disables.
+    admission_deadline_s: float = 0.0
+    # > 0 early-aborts requests still pre-prefill this long after
+    # arrival (tightened by any request-level deadline).  0 disables.
+    queue_ttl_s: float = 0.0
+    # SIGTERM drain: seconds in-flight generations get to finish before
+    # the process exits anyway.
+    drain_grace_s: float = 30.0
+    # ("tenant", weight) pairs for weighted fair queuing; unlisted
+    # tenants weigh 1.0
+    tenant_weights: tuple[tuple[str, float], ...] = ()
+    # per-tenant token bucket: sustained tokens/s and burst capacity
+    # (0 burst defaults to 10s of sustained rate).  0 rate disables.
+    tenant_rate_tokens_per_s: float = 0.0
+    tenant_burst_tokens: float = 0.0
+    # header / gRPC metadata key carrying the tenant id; requests
+    # without it fall back to the adapter id, then "default"
+    tenant_header: str = "x-tenant-id"
+
+    @staticmethod
+    def parse_tenant_weights(spec: Optional[str]) -> tuple[tuple[str, float], ...]:
+        """``"teamA=4,teamB=1"`` → (("teamA", 4.0), ("teamB", 1.0))."""
+        if not spec:
+            return ()
+        out = []
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            name, sep, weight = part.partition("=")
+            if not sep or not name:
+                raise ValueError(
+                    f"--tenant-weights entry {part!r} is not name=weight"
+                )
+            w = float(weight)
+            if w <= 0:
+                raise ValueError(
+                    f"--tenant-weights weight for {name!r} must be > 0"
+                )
+            out.append((name.strip(), w))
+        return tuple(out)
+
+    @staticmethod
+    def from_args(args: Any) -> "FrontdoorConfig":
+        return FrontdoorConfig(
+            enabled=not getattr(args, "disable_frontdoor", False),
+            max_waiting_requests=int(
+                getattr(args, "max_waiting_requests", 0) or 0
+            ),
+            admission_deadline_s=float(
+                getattr(args, "admission_deadline", 0.0) or 0.0
+            ),
+            queue_ttl_s=float(getattr(args, "queue_ttl", 0.0) or 0.0),
+            drain_grace_s=float(
+                getattr(args, "drain_grace", 30.0) or 0.0
+            ),
+            tenant_weights=FrontdoorConfig.parse_tenant_weights(
+                getattr(args, "tenant_weights", None)
+            ),
+            tenant_rate_tokens_per_s=float(
+                getattr(args, "tenant_rate_limit", 0.0) or 0.0
+            ),
+            tenant_burst_tokens=float(
+                getattr(args, "tenant_burst", 0.0) or 0.0
+            ),
+            # lowercased once here: HTTP header parsing and gRPC
+            # invocation metadata both produce lowercase keys, and
+            # every consumer of this field must match them
+            tenant_header=(
+                getattr(args, "tenant_header", "x-tenant-id")
+                or "x-tenant-id"
+            ).lower(),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
 class EngineConfig:
     model_config: ModelConfig
     cache_config: CacheConfig
@@ -840,6 +931,11 @@ class EngineConfig:
     # per stall); None keeps dumps in the log/termination-log only
     dump_dir: str | None = None
     speculative: "Optional[SpeculativeConfig]" = None
+    # front door (frontdoor/): admission control, per-tenant fair
+    # queuing, load shedding, graceful drain
+    frontdoor: FrontdoorConfig = dataclasses.field(
+        default_factory=FrontdoorConfig
+    )
 
     def __post_init__(self) -> None:
         if self.quantization not in (None, "int8", "awq", "gptq"):
@@ -991,4 +1087,5 @@ class EngineConfig:
                 getattr(args, "watchdog_deadline", 120.0) or 0.0
             ),
             dump_dir=getattr(args, "dump_dir", None),
+            frontdoor=FrontdoorConfig.from_args(args),
         )

@@ -352,6 +352,7 @@ class LLMEngine:
         arrival_time: Optional[float] = None,
         lora_name: Optional[str] = None,
         trace_id: Optional[str] = None,
+        deadline: Optional[float] = None,
     ) -> None:
         if request_id in self._seqs:
             raise ValueError(f"duplicate request_id {request_id!r}")
@@ -375,6 +376,27 @@ class LLMEngine:
             lora_name=lora_name,
         )
         seq.trace_id = trace_id
+        # queue TTL (frontdoor): the async layer passes the effective
+        # deadline (request SLO ∧ arrival + --queue-ttl, stamped before
+        # any fair-queue parking); direct core users get the same
+        # tightening from THEIR arrival time here
+        fd = getattr(self.config, "frontdoor", None)
+        if (
+            fd is not None
+            and fd.enabled
+            and fd.queue_ttl_s > 0
+            # precompile warmups (__warmup_*) wait behind tens of
+            # seconds of XLA compiles by design — a TTL shed there
+            # would silently lose bucket coverage
+            and not request_id.startswith("__warmup")
+        ):
+            ttl_deadline = seq.metrics.arrival_time + fd.queue_ttl_s
+            deadline = (
+                ttl_deadline
+                if deadline is None
+                else min(deadline, ttl_deadline)
+            )
+        seq.deadline = deadline
         seq.lora_slot = self.lora_manager.slot_of(lora_name)
         if self.runner.spec is not None:
             from vllm_tgis_adapter_tpu.engine.speculative import (
@@ -426,7 +448,13 @@ class LLMEngine:
         return seq.to_request_output()
 
     def has_unfinished_requests(self) -> bool:
-        return self.scheduler.num_unfinished > 0
+        # newly_finished counts: a scheduler-rejected/shed request's
+        # final output is emitted by the NEXT plan_step — the step loop
+        # must not park before that drain or the client hangs
+        return (
+            self.scheduler.num_unfinished > 0
+            or bool(self.scheduler.newly_finished)
+        )
 
     # -------------------------------------------------------------- KV swap
 

@@ -139,6 +139,12 @@ class Scheduler:
         # core so scheduler-originated events (preemption) land in the
         # same per-request timeline; None when running standalone (tests)
         self.recorder = None
+        # queue-TTL plumbing (frontdoor): scan only once a deadline-
+        # bearing request has ever been added; shed_hook (set by the
+        # async layer) keeps the front door's lifetime shed count in
+        # step with scheduler-side sheds
+        self._saw_deadlines = False
+        self.shed_hook = None
 
     # ------------------------------------------------------------ bookkeeping
 
@@ -148,7 +154,23 @@ class Scheduler:
 
     def add(self, seq: Sequence) -> None:
         seq.status = SequenceStatus.WAITING
+        if seq.deadline is not None:
+            # arms the per-step TTL scan (_shed_expired); stays set —
+            # deployments that never use deadlines never pay the scan
+            self._saw_deadlines = True
         self.waiting.append(seq)
+
+    def waiting_token_backlog(self) -> int:
+        """Tokens the waiting queue still owes the device (prompt
+        remainder + requested output budget) — the front door's
+        queue-drain-estimate input (frontdoor/admission.py)."""
+        total = 0
+        for seq in self.waiting:
+            remaining_prompt = max(
+                0, len(seq.all_token_ids) - seq.prefill_pos
+            )
+            total += remaining_prompt + (seq.params.max_tokens or 0)
+        return total
 
     def abort(self, request_id: str) -> Optional[Sequence]:
         for i, seq in enumerate(self.waiting):
@@ -222,6 +244,7 @@ class Scheduler:
         makes the loop drain the in-flight dispatch and run the decode,
         so heavy admission still cannot starve running sequences.
         """
+        self._shed_expired()
         if self._last_was_prefill and self.running:
             if prefill_only:
                 return None
@@ -241,6 +264,48 @@ class Scheduler:
         if prefill_only:
             return None
         return self._schedule_decode()
+
+    def _shed_expired(self) -> None:
+        """Queue-TTL shed (frontdoor): drop waiting requests whose
+        deadline passed before they reached prefill.
+
+        Only pure pre-prefill entries qualify — no KV pages written, no
+        output tokens, no held resources (mid-chunk prefills, swapped
+        and preempted sequences have sunk device work worth finishing).
+        The shed emits through ``newly_finished`` like any other
+        scheduler-rejected request, so the client still receives a
+        final (empty, aborted) output frame.
+        """
+        if not self._saw_deadlines or not self.waiting:
+            return
+        now = time.time()
+        expired = [
+            s for s in self.waiting
+            if s.deadline is not None
+            and now >= s.deadline
+            and s.prefill_pos == 0
+            and s.num_output_tokens == 0
+            and s.blocks is None
+            and s.swapped is None
+        ]
+        for seq in expired:
+            self.waiting.remove(seq)
+            seq.status = SequenceStatus.FINISHED_ABORTED
+            self.finish(seq)  # no-op resource-wise; keeps invariants
+            self.newly_finished.append(seq)
+            queued_s = max(0.0, now - seq.metrics.arrival_time)
+            logger.warning(
+                "shedding request %s: queued %.1fs, deadline passed "
+                "before prefill", seq.request_id, queued_s,
+            )
+            if self.recorder is not None:
+                self.recorder.record(
+                    "shed", seq.request_id, trace_id=seq.trace_id,
+                    reason="ttl", queued_s=round(queued_s, 3),
+                )
+            metrics.frontdoor_sheds_total.labels(reason="ttl").inc()
+            if self.shed_hook is not None:
+                self.shed_hook()
 
     def _packable(self, plan: PrefillPlan) -> bool:
         return (
@@ -466,7 +531,11 @@ class Scheduler:
                         k = k // 2
                         continue
                     if not self._preempt_youngest(exclude=seq):
-                        raise RuntimeError(
+                        from vllm_tgis_adapter_tpu.frontdoor.errors import (
+                            KVPoolExhaustedError,
+                        )
+
+                        raise KVPoolExhaustedError(
                             "KV cache too small for a single sequence"
                         ) from None
             planned[id(seq)] = k

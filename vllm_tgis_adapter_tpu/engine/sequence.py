@@ -76,6 +76,11 @@ class Sequence:
         # the async layer at admission so flight-recorder events and
         # /debug/requests timelines correlate with the exported spans
         self.trace_id: Optional[str] = None
+        # epoch-seconds queue TTL (request deadline tightened by
+        # --queue-ttl, engine/core.py add_request): while still
+        # pre-prefill past this, the scheduler sheds the request
+        # instead of spending prefill compute on it
+        self.deadline: Optional[float] = None
 
         self.blocks: Optional["SequenceBlocks"] = None
         self.slot: int = -1  # fixed batch row while RUNNING

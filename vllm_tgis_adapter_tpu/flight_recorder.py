@@ -57,6 +57,9 @@ EVENT_KINDS = (
     "swap_in",        # sequence restored from host KV copy
     "finish",         # request completed (stop/length)
     "abort",          # request aborted by the client
+    "shed",           # admission control refused/expired the request
+    #                   (frontdoor/: queue_full, deadline, rate_limit,
+    #                   ttl, draining — detail carries the reason)
     "error",          # engine step loop died
     "stall",          # watchdog fired (recorded so dumps self-locate)
 )

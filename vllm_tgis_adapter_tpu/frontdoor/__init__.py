@@ -1,0 +1,36 @@
+"""Front door: admission control, fair queuing, load shedding, drain.
+
+The subsystem between the API surfaces (grpc/grpc_server.py, http.py)
+and the engine's scheduler — see docs/FRONTDOOR.md for the admission
+flow, tenant keying, flag reference, and drain sequence.
+"""
+
+from vllm_tgis_adapter_tpu.frontdoor.admission import FrontDoor
+from vllm_tgis_adapter_tpu.frontdoor.drain import DrainCoordinator
+from vllm_tgis_adapter_tpu.frontdoor.errors import (
+    AdmissionShedError,
+    CapacityError,
+    DeviceOOMError,
+    ErrorDisposition,
+    KVPoolExhaustedError,
+    classify,
+    wrap_engine_error,
+)
+from vllm_tgis_adapter_tpu.frontdoor.fairness import (
+    TokenBucket,
+    WeightedFairQueue,
+)
+
+__all__ = [
+    "AdmissionShedError",
+    "CapacityError",
+    "DeviceOOMError",
+    "DrainCoordinator",
+    "ErrorDisposition",
+    "FrontDoor",
+    "KVPoolExhaustedError",
+    "TokenBucket",
+    "WeightedFairQueue",
+    "classify",
+    "wrap_engine_error",
+]

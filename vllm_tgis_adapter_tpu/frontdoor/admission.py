@@ -1,0 +1,556 @@
+"""The admission controller: everything between ``generate()`` and
+``Scheduler.add``.
+
+Before PR 4 both API surfaces handed every request straight to the
+scheduler's unbounded ``waiting`` deque — under overload the queue (and
+its detokenizers, FSMs, prompt buffers) grew until HBM or the event
+loop keeled over, and a request could sit queued long past its own
+deadline before ever reaching prefill.  S-LoRA (arXiv:2311.03285) shows
+SLO-aware early-abort admission control is what keeps goodput up under
+overload; this module implements that front door:
+
+* **bounded queue** — ``--max-waiting-requests`` bounds parked +
+  engine-waiting requests; past it, requests shed immediately with a
+  Retry-After estimate instead of queuing into futility;
+* **deadline-aware admission** — ``--admission-deadline`` sheds
+  requests whose *estimated* queue-drain time already exceeds the SLO,
+  using an observed token-throughput EWMA (seeded from the KV pool's
+  token capacity before any observation, the ``resolve_num_blocks``
+  budget math);
+* **per-tenant WFQ + token buckets** (fairness.py) — requests park in
+  a weighted fair queue keyed on the tenant header (falling back to
+  adapter id) and are released to the engine in virtual-time order, a
+  few at a time (the engine keeps only a small admission window so
+  packed prefill still sees candidates but ordering stays ours);
+* **queue TTLs** — a parked request whose deadline passes before
+  prefill is shed (``shed`` flight-recorder event) instead of wasting
+  prefill compute on an answer nobody is waiting for;
+* **drain** — SIGTERM stops admission (``draining`` sheds) while
+  in-flight requests finish (frontdoor/drain.py orchestrates).
+
+Concurrency: everything here runs on the event loop; the pump task is
+the only place entries leave the fair queue, and grants are accounted
+(``_pending_grants``) so the engine window cannot be overshot between a
+grant and the winner's ``add_request``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import TYPE_CHECKING, Callable, Optional
+
+from vllm_tgis_adapter_tpu import metrics
+from vllm_tgis_adapter_tpu.frontdoor.errors import (
+    SHED_DEADLINE,
+    SHED_DRAINING,
+    SHED_QUEUE_FULL,
+    SHED_RATE_LIMIT,
+    SHED_TTL,
+    AdmissionShedError,
+)
+from vllm_tgis_adapter_tpu.frontdoor.fairness import (
+    TokenBucket,
+    WeightedFairQueue,
+)
+from vllm_tgis_adapter_tpu.logging import init_logger
+
+if TYPE_CHECKING:
+    from vllm_tgis_adapter_tpu.engine.config import FrontdoorConfig
+
+logger = init_logger(__name__)
+
+DEFAULT_TENANT = "default"
+
+# throughput prior before any observed commit: assume the engine turns
+# over one full KV pool of tokens in this many seconds.  Deliberately
+# conservative — it only gates --admission-deadline sheds until the
+# first real throughput sample lands (~1s of serving).
+_CAPACITY_TURNOVER_S = 30.0
+
+# tenant-label cardinality cap for the per-tenant token counter; the
+# fair queue itself is not capped (tenant state is O(1) per tenant)
+_MAX_TENANT_LABELS = 64
+
+# liveness backstop: when entries are parked the pump re-checks at
+# least this often even if every kick was missed
+_PUMP_BACKSTOP_S = 0.5
+
+
+class FrontDoor:
+    def __init__(
+        self,
+        config: "FrontdoorConfig",
+        *,
+        admit_window: int,
+        room_fn: Callable[[int], bool],
+        waiting_depth_fn: Callable[[], int],
+        backlog_tokens_fn: Callable[[], float],
+        kv_token_capacity_fn: Callable[[], float],
+        record_shed: Optional[Callable[..., None]] = None,
+    ):
+        """``room_fn(pending)`` — can the engine take another request
+        given ``pending`` already-granted-but-not-yet-added ones;
+        ``waiting_depth_fn`` — requests in the engines' waiting queues;
+        ``backlog_tokens_fn`` — token backlog already inside the
+        engines; ``kv_token_capacity_fn`` — pool size in tokens (the
+        ``resolve_num_blocks`` budget), the throughput prior's base;
+        ``record_shed(request_id, tenant, reason, **detail)`` — flight
+        recorder hook."""
+        self.config = config
+        self.admit_window = max(1, admit_window)
+        self._room_fn = room_fn
+        self._waiting_depth_fn = waiting_depth_fn
+        self._backlog_tokens_fn = backlog_tokens_fn
+        self._kv_token_capacity_fn = kv_token_capacity_fn
+        self._record_shed = record_shed
+
+        self._wfq = WeightedFairQueue(dict(config.tenant_weights))
+        self._buckets: dict[str, TokenBucket] = {}
+        self._pending_grants = 0
+        self._pump_task: Optional[asyncio.Task] = None
+        self._wake = asyncio.Event()
+        # explicit stop flag: Task.cancel() alone is unreliable here —
+        # py3.10's asyncio.wait_for swallows a cancellation that lands
+        # while the awaited event is already set (bpo-42130), which is
+        # exactly the shutdown-right-after-wake interleaving
+        self._stop = False
+        self.draining = False
+        self._drain_listeners: list[Callable[[], None]] = []
+        self._tenant_labels: set[str] = set()
+
+        # observed decode/prefill token throughput (tokens/s EWMA)
+        self._rate: Optional[float] = None
+        self._acc_tokens = 0.0
+        self._acc_since: Optional[float] = None
+
+        # lifetime counters (drain summary + tests)
+        self.admitted_total = 0
+        self.shed_total = 0
+
+    # ---------------------------------------------------------------- intake
+
+    async def acquire(
+        self,
+        *,
+        request_id: str,
+        tenant: Optional[str],
+        tokens: float,
+        deadline: Optional[float] = None,
+    ) -> None:
+        """Admit or shed one request.  Returns when the engine may take
+        it (the caller MUST then call ``note_admitted()`` exactly once,
+        success or failure); raises ``AdmissionShedError`` otherwise.
+
+        ``tokens`` is the request's budget estimate (prompt + max new);
+        ``deadline`` is the effective epoch-seconds SLO — the request's
+        own deadline already tightened by ``--queue-ttl`` (the caller,
+        AsyncLLMEngine.generate, stamps it at arrival so parked time
+        counts against the TTL).
+        """
+        tenant = tenant or DEFAULT_TENANT
+        cfg = self.config
+        if self.draining:
+            self._shed(
+                request_id, tenant, SHED_DRAINING,
+                "server is draining; not accepting new requests",
+            )
+        if cfg.max_waiting_requests > 0:
+            # pending grants count: they are waiting requests that just
+            # haven't reached add_request yet — omitting them lets
+            # same-tick fast-path admissions overshoot the bound
+            depth = (
+                len(self._wfq)
+                + self._waiting_depth_fn()
+                + self._pending_grants
+            )
+            if depth >= cfg.max_waiting_requests:
+                self._shed(
+                    request_id, tenant, SHED_QUEUE_FULL,
+                    f"waiting queue is full ({depth} >= "
+                    f"{cfg.max_waiting_requests})",
+                    retry_after_s=self._drain_estimate(tokens),
+                )
+        if cfg.admission_deadline_s > 0:
+            est = self._drain_estimate(tokens)
+            if est > cfg.admission_deadline_s:
+                self._shed(
+                    request_id, tenant, SHED_DEADLINE,
+                    f"estimated queue drain {est:.1f}s exceeds the "
+                    f"admission deadline {cfg.admission_deadline_s:.1f}s",
+                    retry_after_s=est,
+                )
+        # the bucket is consumed LAST: a request shed on the bounds
+        # above must not burn its tenant's rate budget
+        wait = self._bucket(tenant).try_consume(tokens)
+        if wait > 0:
+            self._shed(
+                request_id, tenant, SHED_RATE_LIMIT,
+                f"tenant {tenant!r} exceeded its token rate limit",
+                retry_after_s=wait,
+            )
+
+        self._note_tenant_tokens(tenant, tokens)
+        # fast path: nothing queued ahead and the engine has room — no
+        # pump round-trip, same latency as the pre-frontdoor hand-off
+        if len(self._wfq) == 0 and self._room_fn(self._pending_grants):
+            self._pending_grants += 1
+            self.admitted_total += 1
+            return
+
+        loop = asyncio.get_running_loop()
+        future: asyncio.Future = loop.create_future()
+        entry = self._wfq.push(
+            tenant, tokens,
+            {
+                "request_id": request_id,
+                "future": future,
+                "deadline": deadline,
+                "enqueued": time.time(),
+                "tenant": tenant,
+            },
+        )
+        self._ensure_pump()
+        self._wake.set()
+        self._refresh_gauges()
+        try:
+            await future
+        except BaseException:
+            if (
+                future.done()
+                and not future.cancelled()
+                and future.exception() is None
+            ):
+                # the pump granted us (result set, _pending_grants
+                # incremented) but cancellation landed before we
+                # resumed — give the admission-window slot back or it
+                # leaks until restart
+                self.note_admitted()
+            else:
+                # still parked (or shed via the future): drop the entry
+                self._wfq.cancel(entry)
+            self._refresh_gauges()
+            raise
+        self.admitted_total += 1
+
+    def note_admitted(self) -> None:
+        """The granted request has reached (or failed) ``add_request``;
+        its admission-window slot is the engine's problem now."""
+        if self._pending_grants > 0:
+            self._pending_grants -= 1
+        self._wake.set()
+
+    # ----------------------------------------------------------------- pump
+
+    def _ensure_pump(self) -> None:
+        if self._pump_task is None or self._pump_task.done():
+            self._stop = False  # an engine restarted after stop() pumps again
+            self._pump_task = asyncio.get_running_loop().create_task(
+                self._pump(), name="frontdoor-pump"
+            )
+
+    async def _pump(self) -> None:
+        """Release parked entries to the engine in WFQ order whenever
+        the admission window has room; expire TTLs while waiting."""
+        while not self._stop:
+            timeout = None
+            if len(self._wfq):
+                timeout = _PUMP_BACKSTOP_S
+                next_deadline = min(
+                    (
+                        e.payload["deadline"]
+                        for e in self._wfq.entries()
+                        if e.payload["deadline"] is not None
+                    ),
+                    default=None,
+                )
+                if next_deadline is not None:
+                    timeout = min(
+                        timeout, max(0.0, next_deadline - time.time())
+                    )
+            try:
+                await asyncio.wait_for(self._wake.wait(), timeout)
+            except asyncio.TimeoutError:
+                pass
+            if self._stop:
+                return
+            self._wake.clear()
+            self._expire_ttls()
+            while len(self._wfq) and self._room_fn(self._pending_grants):
+                entry = self._wfq.pop()
+                if entry is None:
+                    break
+                future = entry.payload["future"]
+                if future.done():
+                    continue
+                self._pending_grants += 1
+                future.set_result(None)
+            self._refresh_gauges()
+
+    def _expire_ttls(self) -> None:
+        now = time.time()
+        for entry in self._wfq.entries():
+            deadline = entry.payload["deadline"]
+            if deadline is None or now < deadline:
+                continue
+            future = entry.payload["future"]
+            self._wfq.cancel(entry)
+            if future.done():
+                continue
+            queued_s = now - entry.payload["enqueued"]
+            future.set_exception(
+                self._shed_error(
+                    entry.payload["request_id"], entry.tenant, SHED_TTL,
+                    f"request spent {queued_s:.1f}s queued and passed "
+                    "its deadline before prefill",
+                    queued_s=round(queued_s, 3),
+                )
+            )
+
+    def kick(self) -> None:
+        """Engine progress signal (a commit retired, a request finished
+        or aborted): re-check the admission window."""
+        if len(self._wfq):
+            self._wake.set()
+
+    # ------------------------------------------------------------ estimator
+
+    # an accumulation window older than this is an idle gap, not a
+    # throughput observation — idle time must not read as low tok/s
+    _RATE_WINDOW_MAX_S = 10.0
+
+    def note_progress(self, tokens: float) -> None:
+        """Feed one committed dispatch's token count into the
+        throughput EWMA that prices --admission-deadline sheds."""
+        now = time.monotonic()
+        if (
+            self._acc_since is None
+            or now - self._acc_since > self._RATE_WINDOW_MAX_S
+        ):
+            # first sample, or the window spans an idle period: start
+            # fresh instead of decaying the EWMA toward zero
+            self._acc_since = now
+            self._acc_tokens = tokens
+            self.kick()
+            return
+        self._acc_tokens += tokens
+        dt = now - self._acc_since
+        if dt >= 1.0:
+            inst = self._acc_tokens / dt
+            self._rate = (
+                inst
+                if self._rate is None
+                else 0.7 * self._rate + 0.3 * inst
+            )
+            self._acc_tokens = 0.0
+            self._acc_since = now
+        self.kick()
+
+    def _throughput(self) -> float:
+        if self._rate is not None and self._rate > 0:
+            return self._rate
+        capacity = max(self._kv_token_capacity_fn(), 1.0)
+        return capacity / _CAPACITY_TURNOVER_S
+
+    def _drain_estimate(self, extra_tokens: float = 0.0) -> float:
+        """Seconds until a request admitted now would reach the device,
+        assuming current backlog and observed throughput."""
+        backlog = (
+            self._backlog_tokens_fn()
+            + self._wfq.queued_cost
+            + extra_tokens
+        )
+        return backlog / self._throughput()
+
+    # ---------------------------------------------------------------- drain
+
+    def add_drain_listener(self, listener: Callable[[], None]) -> None:
+        self._drain_listeners.append(listener)
+        if self.draining:
+            listener()
+
+    def begin_drain(self) -> int:
+        """Stop admitting; shed everything still parked (it never
+        reached prefill — the client should retry against another
+        replica).  Returns the number of parked requests shed.
+        Idempotent."""
+        if self.draining:
+            return 0
+        self.draining = True
+        shed = 0
+        for entry in self._wfq.entries():
+            future = entry.payload["future"]
+            self._wfq.cancel(entry)
+            if future.done():
+                continue
+            shed += 1
+            future.set_exception(
+                self._shed_error(
+                    entry.payload["request_id"], entry.tenant,
+                    SHED_DRAINING,
+                    "server is draining; not accepting new requests",
+                )
+            )
+        for listener in self._drain_listeners:
+            try:
+                listener()
+            except Exception:  # noqa: BLE001 — one listener must not block drain
+                logger.exception("frontdoor drain listener failed")
+        self._refresh_gauges()
+        return shed
+
+    def fail_all(self, exc: BaseException) -> None:
+        """Engine death / shutdown: parked waiters must not hang."""
+        for entry in self._wfq.entries():
+            future = entry.payload["future"]
+            self._wfq.cancel(entry)
+            if not future.done():
+                future.set_exception(exc)
+        self._refresh_gauges()
+
+    @property
+    def parked(self) -> int:
+        """Entries in the fair queue — O(1), for scrape-path callers."""
+        return len(self._wfq)
+
+    def note_external_shed(self) -> None:
+        """A shed decided OUTSIDE the front door (the scheduler's
+        queue-TTL path) still counts toward the lifetime total, so
+        /debug/state and the metrics counter tell one story."""
+        self.shed_total += 1
+
+    async def shutdown(self) -> None:
+        from vllm_tgis_adapter_tpu.engine.async_llm import EngineDeadError
+
+        self.fail_all(EngineDeadError("engine is stopping"))
+        if self._pump_task is not None:
+            # stop flag first (see _stop) so the pump exits even when
+            # the cancellation is swallowed by wait_for; cancel +
+            # wake cover both suspension points
+            self._stop = True
+            self._wake.set()
+            self._pump_task.cancel()
+            try:
+                await self._pump_task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+            self._pump_task = None
+
+    # ------------------------------------------------------------ shed/metrics
+
+    # tenant ids are client-controlled: bound the bucket map.  Evicting
+    # oldest-created does not weaken the rate-limit model — an attacker
+    # minting fresh tenant ids gets a fresh (full) bucket either way;
+    # per-tenant limits only bind honest, stable tenant ids.
+    _MAX_TENANT_BUCKETS = 1024
+
+    def _bucket(self, tenant: str) -> TokenBucket:
+        bucket = self._buckets.get(tenant)
+        if bucket is None:
+            cfg = self.config
+            burst = cfg.tenant_burst_tokens or (
+                cfg.tenant_rate_tokens_per_s * 10.0
+            )
+            bucket = TokenBucket(cfg.tenant_rate_tokens_per_s, burst)
+            while len(self._buckets) >= self._MAX_TENANT_BUCKETS:
+                self._buckets.pop(next(iter(self._buckets)))
+            self._buckets[tenant] = bucket
+        return bucket
+
+    def _tenant_label(self, tenant: str) -> str:
+        if tenant in self._tenant_labels:
+            return tenant
+        if len(self._tenant_labels) >= _MAX_TENANT_LABELS:
+            return "other"
+        self._tenant_labels.add(tenant)
+        return tenant
+
+    def _note_tenant_tokens(self, tenant: str, tokens: float) -> None:
+        try:
+            metrics.frontdoor_tenant_tokens_total.labels(
+                tenant=self._tenant_label(tenant)
+            ).inc(tokens)
+        except Exception:  # pragma: no cover — telemetry must not raise
+            pass
+
+    def _shed_error(
+        self,
+        request_id: str,
+        tenant: str,
+        reason: str,
+        message: str,
+        *,
+        retry_after_s: Optional[float] = None,
+        **detail,
+    ) -> AdmissionShedError:
+        """Build + account one shed (metrics, flight recorder, log)."""
+        self.shed_total += 1
+        try:
+            metrics.frontdoor_sheds_total.labels(reason=reason).inc()
+        except Exception:  # pragma: no cover
+            pass
+        if self._record_shed is not None:
+            try:
+                self._record_shed(
+                    request_id, tenant, reason,
+                    **(
+                        {"retry_after_s": round(retry_after_s, 3)}
+                        if retry_after_s is not None
+                        else {}
+                    ),
+                    **detail,
+                )
+            except Exception:  # pragma: no cover
+                logger.exception("shed recording failed")
+        logger.warning(
+            "shedding request %s (tenant=%s): %s [%s]",
+            request_id, tenant, message, reason,
+        )
+        return AdmissionShedError(
+            reason, message, retry_after_s=retry_after_s, tenant=tenant
+        )
+
+    def _shed(self, request_id, tenant, reason, message, **kwargs) -> None:  # noqa: ANN001, ANN003
+        raise self._shed_error(
+            request_id, tenant, reason, message, **kwargs
+        )
+
+    def _refresh_gauges(self) -> None:
+        try:
+            metrics.frontdoor_queue_depth.set(len(self._wfq))
+            oldest = min(
+                (e.payload["enqueued"] for e in self._wfq.entries()),
+                default=None,
+            )
+            metrics.frontdoor_queue_age_seconds.set(
+                max(0.0, time.time() - oldest)
+                if oldest is not None
+                else 0.0
+            )
+        except Exception:  # pragma: no cover
+            pass
+
+    def refresh_gauges(self) -> None:
+        """Scrape-time hook (AsyncLLMEngine.refresh_engine_gauges)."""
+        self._refresh_gauges()
+
+    def debug_state(self) -> dict:
+        """Front-door section of the engine's /debug/state snapshot."""
+        entries = self._wfq.entries()
+        now = time.time()
+        return {
+            "draining": self.draining,
+            "parked": len(entries),
+            "pending_grants": self._pending_grants,
+            "admitted_total": self.admitted_total,
+            "shed_total": self.shed_total,
+            "throughput_tok_per_s": round(self._throughput(), 1),
+            "oldest_age_s": round(
+                max(
+                    (now - e.payload["enqueued"] for e in entries),
+                    default=0.0,
+                ),
+                3,
+            ),
+            "tenants": sorted({e.tenant for e in entries}),
+        }

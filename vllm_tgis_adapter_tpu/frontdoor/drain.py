@@ -1,0 +1,165 @@
+"""Graceful drain: SIGTERM → stop admitting → finish in-flight → exit.
+
+Before PR 4 SIGTERM cancelled the server tasks outright: the engine
+step loops died mid-decode and every in-flight generation was lost.
+Kubernetes sends SIGTERM, waits ``terminationGracePeriodSeconds``, then
+SIGKILLs — this coordinator uses that window properly:
+
+1. flip health (gRPC ``DRAINING``, HTTP ``/health`` → 503) so
+   orchestrators stop routing new traffic at the pod;
+2. stop admitting (the front door sheds with ``draining`` /
+   UNAVAILABLE; parked-but-not-prefilled requests are shed too — their
+   clients retry against a healthy replica);
+3. let requests already inside the engine finish, bounded by
+   ``--drain-grace``;
+4. checkpoint the termination log with the drain outcome and release
+   the server loop to shut down normally.
+
+A second SIGTERM during drain forces immediate shutdown (the operator
+means it).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import signal
+import time
+from typing import TYPE_CHECKING, Optional
+
+from vllm_tgis_adapter_tpu.logging import init_logger
+from vllm_tgis_adapter_tpu.utils import write_termination_log
+
+if TYPE_CHECKING:
+    from vllm_tgis_adapter_tpu.engine.async_llm import AsyncLLMEngine
+
+logger = init_logger(__name__)
+
+_POLL_S = 0.05
+
+
+class DrainCoordinator:
+    def __init__(
+        self,
+        engine: "AsyncLLMEngine",
+        *,
+        grace_s: float = 30.0,
+        shutdown_event: Optional[asyncio.Event] = None,
+        termination_log_dir: Optional[str] = None,
+    ):
+        self.engine = engine
+        self.grace_s = grace_s
+        self.shutdown_event = shutdown_event or asyncio.Event()
+        self._termination_log_dir = termination_log_dir or os.getenv(
+            "TERMINATION_LOG_DIR", "/dev/termination-log"
+        )
+        self._task: Optional[asyncio.Task] = None
+        self._parked_shed = 0
+        self.started = False
+        self.summary: Optional[dict] = None
+
+    # ------------------------------------------------------------- lifecycle
+
+    def install(self, loop: asyncio.AbstractEventLoop) -> bool:
+        """Register the SIGTERM handler; False where unsupported
+        (non-unix / non-main-thread loops)."""
+        try:
+            loop.add_signal_handler(signal.SIGTERM, self.begin)
+        except (NotImplementedError, RuntimeError, ValueError):
+            logger.info(
+                "SIGTERM drain handler not installed "
+                "(unsupported on this platform/loop)"
+            )
+            return False
+        return True
+
+    def uninstall(self, loop: asyncio.AbstractEventLoop) -> None:
+        try:
+            loop.remove_signal_handler(signal.SIGTERM)
+        except (NotImplementedError, RuntimeError, ValueError):
+            pass
+
+    def begin(self) -> None:
+        """Start the drain (signal-handler-safe, idempotent); a repeat
+        call while draining forces immediate shutdown."""
+        if self.started:
+            logger.warning(
+                "second drain request: forcing immediate shutdown"
+            )
+            self.shutdown_event.set()
+            return
+        self.started = True
+        frontdoor = getattr(self.engine, "frontdoor", None)
+        if frontdoor is None:
+            # --disable-frontdoor: with no admission gate there is
+            # nothing to stop and no DRAINING health to flip — waiting
+            # out the grace window would keep accepting requests only
+            # to kill them at its end.  Honor the escape hatch's
+            # pre-PR4 contract: immediate shutdown.
+            logger.warning(
+                "SIGTERM with the front door disabled: no drain "
+                "possible, shutting down immediately"
+            )
+            self.summary = {"frontdoor": "disabled"}
+            self.shutdown_event.set()
+            return
+        # stop admission SYNCHRONOUSLY: from the moment the signal
+        # handler returns, no new request can slip past the front door
+        self._parked_shed = frontdoor.begin_drain()
+        self._task = asyncio.get_event_loop().create_task(
+            self._drain(), name="frontdoor-drain"
+        )
+
+    # ----------------------------------------------------------------- drain
+
+    def _in_flight(self) -> int:
+        engine_resident = sum(
+            rep.engine.scheduler.num_unfinished
+            for rep in self.engine._replicas  # noqa: SLF001 — coordinator owns this view
+        )
+        frontdoor = getattr(self.engine, "frontdoor", None)
+        granted = (
+            frontdoor._pending_grants  # noqa: SLF001
+            if frontdoor is not None
+            else 0
+        )
+        # registered output queues count too: the engine may be done
+        # generating while a (slow) client is still consuming its final
+        # frames — tearing the servers down then would truncate the
+        # very responses the drain promised to finish
+        undelivered = len(self.engine._queues)  # noqa: SLF001
+        return engine_resident + granted + undelivered
+
+    async def _drain(self) -> None:
+        t0 = time.monotonic()
+        shed_parked = self._parked_shed
+        in_flight0 = self._in_flight()
+        logger.info(
+            "drain started: %d in-flight requests to finish "
+            "(grace %.0fs), %d parked requests shed",
+            in_flight0, self.grace_s, shed_parked,
+        )
+        deadline = t0 + max(0.0, self.grace_s)
+        while self._in_flight() > 0 and time.monotonic() < deadline:
+            await asyncio.sleep(_POLL_S)
+        remaining = self._in_flight()
+        elapsed = time.monotonic() - t0
+        self.summary = {
+            "drained_s": round(elapsed, 3),
+            "in_flight_at_sigterm": in_flight0,
+            "parked_shed": shed_parked,
+            "unfinished_at_exit": remaining,
+        }
+        msg = (
+            f"graceful drain {'complete' if remaining == 0 else 'TIMED OUT'}: "
+            f"{in_flight0} in-flight finished in {elapsed:.1f}s, "
+            f"{shed_parked} parked shed, {remaining} unfinished at exit"
+        )
+        (logger.info if remaining == 0 else logger.warning)("%s", msg)
+        # checkpoint the outcome where k8s post-mortems read it; on the
+        # happy path this is the LAST write (the process exits cleanly)
+        write_termination_log(msg, self._termination_log_dir)
+        # one settle tick for the transports to flush the final frames
+        # already handed to the sockets
+        await asyncio.sleep(0.25)
+        self.shutdown_event.set()

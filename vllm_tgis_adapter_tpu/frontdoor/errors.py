@@ -1,0 +1,174 @@
+"""Typed overload/exhaustion error taxonomy + wire-status mapping.
+
+The serving layer used to classify engine failures by substring
+(``"RESOURCE_EXHAUSTED" in str(exc)``, grpc_server pre-PR4) — brittle,
+and it conflated three very different conditions: device HBM OOM (the
+engine is probably dying), KV page-pool exhaustion (a sizing bug — the
+pool cannot hold even one sequence), and deliberate front-door load
+shedding (the server is healthy and the client should retry).  This
+module is the single place where each condition gets a TYPE, and the
+single table that maps those types onto gRPC status codes and HTTP
+statuses, so the two API surfaces can never drift apart.
+
+Text inspection of foreign exceptions still exists — it has to, XLA's
+OOM surfaces as an ``XlaRuntimeError`` with a message — but it happens
+in exactly one boundary function (``wrap_engine_error``), which converts
+the foreign exception into a typed one the rest of the stack matches
+with ``isinstance``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+# shed reasons (AdmissionShedError.reason); docs/FRONTDOOR.md documents
+# the wire semantics of each
+SHED_QUEUE_FULL = "queue_full"    # --max-waiting-requests bound hit
+SHED_DEADLINE = "deadline"        # est. queue drain > --admission-deadline
+SHED_RATE_LIMIT = "rate_limit"    # tenant token bucket empty
+SHED_TTL = "ttl"                  # queued past its deadline, pre-prefill
+SHED_DRAINING = "draining"        # SIGTERM drain in progress
+
+SHED_REASONS = (
+    SHED_QUEUE_FULL, SHED_DEADLINE, SHED_RATE_LIMIT, SHED_TTL,
+    SHED_DRAINING,
+)
+
+
+class AdmissionShedError(RuntimeError):
+    """The front door refused this request before the engine saw it.
+
+    Carries the machine-readable ``reason`` (one of ``SHED_REASONS``),
+    the tenant it was accounted against, and — for retryable sheds — a
+    drain-time estimate the servers surface as ``Retry-After``.
+    """
+
+    def __init__(
+        self,
+        reason: str,
+        message: str,
+        *,
+        retry_after_s: Optional[float] = None,
+        tenant: Optional[str] = None,
+    ):
+        super().__init__(message)
+        self.reason = reason
+        self.retry_after_s = retry_after_s
+        self.tenant = tenant
+
+
+class CapacityError(RuntimeError):
+    """Base for engine-side resource exhaustion (not a client error)."""
+
+
+class KVPoolExhaustedError(CapacityError):
+    """The KV page pool cannot hold even a single sequence's pages.
+
+    Raised by the scheduler when preemption has no victims left; a
+    sizing problem (pool too small for the workload), distinct from
+    device OOM and from deliberate shedding.
+    """
+
+
+class DeviceOOMError(CapacityError):
+    """Device (HBM) allocation failure, wrapped from the XLA runtime."""
+
+
+# message markers that identify an XLA/PJRT out-of-memory failure; used
+# ONLY by wrap_engine_error below — nothing else in the stack may
+# classify by substring.  Deliberately narrow: a marker that can appear
+# inside client-echoed text (request ids, adapter names) would
+# misroute ordinary validation errors
+_OOM_MARKERS = (
+    "RESOURCE_EXHAUSTED",
+    "RESOURCE EXHAUSTED",
+    "out of memory",
+    "Out of memory",
+    "Allocation failure",
+    "failed to allocate",
+)
+
+# client-input / programming error families that must never be
+# reclassified as device OOM, whatever their message echoes
+_NEVER_WRAP = (ValueError, TypeError, KeyError, AssertionError)
+
+
+def wrap_engine_error(exc: BaseException) -> BaseException:
+    """Boundary conversion: foreign engine-death exceptions → typed ones.
+
+    Our own typed errors pass through untouched; an XLA/runtime error
+    whose message matches an OOM marker becomes ``DeviceOOMError`` with
+    the original chained as ``__cause__``.  Anything else is returned
+    as-is (and will map to INTERNAL/500 downstream).
+    """
+    if isinstance(exc, (AdmissionShedError, CapacityError)):
+        return exc
+    if isinstance(exc, _NEVER_WRAP):
+        return exc
+    text = f"{type(exc).__name__}: {exc}"
+    if any(marker in text for marker in _OOM_MARKERS):
+        wrapped = DeviceOOMError(str(exc) or type(exc).__name__)
+        wrapped.__cause__ = exc
+        return wrapped
+    return exc
+
+
+def retry_after_seconds(estimate: Optional[float]) -> int:
+    """The one Retry-After clamp both API surfaces use: a drain-time
+    estimate becomes a 1–60s integer header/metadata value."""
+    import math
+
+    if estimate is None:
+        return 1
+    return int(min(60.0, max(1.0, math.ceil(estimate))))
+
+
+@dataclasses.dataclass(frozen=True)
+class ErrorDisposition:
+    """How one error class goes on the wire, for both API surfaces.
+
+    Engine-death handling is NOT encoded here: the gRPC server decides
+    that from the live engine (``engine.errored``), not from the error
+    class — a capacity error only means the engine died when the
+    engine says so.
+    """
+
+    grpc_code: str       # grpc.StatusCode attribute name
+    http_status: int
+    err_type: str        # OpenAI-shaped error body "type"
+    retry_after_s: Optional[float] = None
+
+
+_SHED_DISPOSITIONS = {
+    SHED_QUEUE_FULL: ("RESOURCE_EXHAUSTED", 429, "rate_limit_exceeded"),
+    SHED_DEADLINE: ("RESOURCE_EXHAUSTED", 429, "rate_limit_exceeded"),
+    SHED_RATE_LIMIT: ("RESOURCE_EXHAUSTED", 429, "rate_limit_exceeded"),
+    SHED_TTL: ("DEADLINE_EXCEEDED", 408, "timeout_error"),
+    SHED_DRAINING: ("UNAVAILABLE", 503, "service_unavailable"),
+}
+
+
+def classify(exc: BaseException) -> Optional[ErrorDisposition]:
+    """Type-based status mapping; None means "not ours" (the caller's
+    generic INTERNAL/500 path applies)."""
+    exc = wrap_engine_error(exc)
+    if isinstance(exc, AdmissionShedError):
+        code, status, err_type = _SHED_DISPOSITIONS.get(
+            exc.reason, _SHED_DISPOSITIONS[SHED_QUEUE_FULL]
+        )
+        return ErrorDisposition(
+            grpc_code=code,
+            http_status=status,
+            err_type=err_type,
+            retry_after_s=exc.retry_after_s,
+        )
+    if isinstance(exc, (KVPoolExhaustedError, DeviceOOMError)):
+        # engine-side exhaustion (pool sizing / device HBM): retrying
+        # this pod is pointless until the engine recovers or restarts
+        return ErrorDisposition(
+            grpc_code="RESOURCE_EXHAUSTED",
+            http_status=503,
+            err_type="server_error",
+        )
+    return None

@@ -1,0 +1,159 @@
+"""Per-tenant weighted fair queuing + token-bucket rate limiting.
+
+Pure data structures (no asyncio, injectable clocks) so the fairness
+math is unit-testable in isolation; ``admission.FrontDoor`` owns the
+concurrency around them.
+
+The fair queue is classic virtual-time WFQ over *token* cost, not
+request count: a tenant submitting 4k-token prompts consumes its share
+4k tokens at a time, so a tenant of equal weight sending 32-token
+prompts still gets through.  Heterogeneous-adapter serving work
+(PAPERS.md, arXiv:2511.22880) motivates exactly this: adapters/tenants
+sharing one engine must not be starved by a heavyweight neighbor.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+import time
+from typing import Any, Callable, Mapping, Optional
+
+
+class TokenBucket:
+    """Standard token bucket: ``rate`` tokens/s refill, ``burst`` cap.
+
+    ``try_consume(n)`` returns 0.0 on success or the seconds until the
+    bucket would hold ``n`` tokens (the Retry-After hint).  A request
+    larger than the burst can never succeed; the returned wait is still
+    finite so callers shed it with a truthful (if optimistic) hint.
+    """
+
+    def __init__(
+        self,
+        rate: float,
+        burst: float,
+        now: Callable[[], float] = time.monotonic,
+    ):
+        self.rate = rate
+        self.burst = max(burst, 1.0)
+        self._now = now
+        self._tokens = self.burst
+        self._last = now()
+
+    def _refill(self) -> None:
+        now = self._now()
+        self._tokens = min(
+            self.burst, self._tokens + (now - self._last) * self.rate
+        )
+        self._last = now
+
+    def try_consume(self, n: float) -> float:
+        if self.rate <= 0:
+            return 0.0  # rate limiting disabled
+        self._refill()
+        if n <= self._tokens:
+            self._tokens -= n
+            return 0.0
+        return (n - self._tokens) / self.rate
+
+
+@dataclasses.dataclass
+class QueueEntry:
+    tenant: str
+    cost: float
+    payload: Any
+    tag: float = 0.0     # virtual finish time
+    seq: int = 0         # arrival tiebreak
+    cancelled: bool = False
+    popped: bool = False  # left the queue via pop(); cancel() no-ops
+
+
+class WeightedFairQueue:
+    """Virtual-time WFQ: pop order interleaves tenants by weight.
+
+    Each tenant's entries get virtual finish tags
+    ``start + cost / weight`` where ``start`` continues the tenant's
+    previous tag (per-tenant FIFO) but never falls behind the global
+    virtual time (an idle tenant doesn't bank unbounded credit).  Pop
+    returns the smallest tag; ties break by arrival order.  Removal is
+    lazy (``cancelled`` flag) so client disconnects are O(1).
+    """
+
+    def __init__(
+        self,
+        weights: Optional[Mapping[str, float]] = None,
+        default_weight: float = 1.0,
+    ):
+        self._weights = dict(weights or {})
+        self._default_weight = max(default_weight, 1e-9)
+        self._heap: list[tuple[float, int, QueueEntry]] = []
+        self._last_tag: dict[str, float] = {}
+        self._virtual_time = 0.0
+        self._seq = 0
+        self._live = 0
+        self._live_cost = 0.0
+
+    def weight_of(self, tenant: str) -> float:
+        w = self._weights.get(tenant, self._default_weight)
+        return max(float(w), 1e-9)
+
+    # cap on remembered per-tenant finish tags: the tenant id comes
+    # from a client-controlled header, so the dict must not grow
+    # unboundedly.  Tags at or below the virtual time carry no
+    # information (start = max(virtual_time, last_tag)), so idle
+    # tenants prune losslessly.
+    _MAX_TENANT_TAGS = 1024
+
+    def push(self, tenant: str, cost: float, payload: Any) -> QueueEntry:
+        cost = max(float(cost), 1.0)
+        if len(self._last_tag) > self._MAX_TENANT_TAGS:
+            self._last_tag = {
+                t: tag
+                for t, tag in self._last_tag.items()
+                if tag > self._virtual_time
+            }
+        start = max(
+            self._virtual_time, self._last_tag.get(tenant, 0.0)
+        )
+        entry = QueueEntry(tenant=tenant, cost=cost, payload=payload)
+        entry.tag = start + cost / self.weight_of(tenant)
+        entry.seq = self._seq
+        self._seq += 1
+        self._last_tag[tenant] = entry.tag
+        heapq.heappush(self._heap, (entry.tag, entry.seq, entry))
+        self._live += 1
+        self._live_cost += cost
+        return entry
+
+    def cancel(self, entry: QueueEntry) -> None:
+        if not entry.cancelled and not entry.popped:
+            entry.cancelled = True
+            self._live -= 1
+            self._live_cost -= entry.cost
+
+    def pop(self) -> Optional[QueueEntry]:
+        while self._heap:
+            tag, _, entry = heapq.heappop(self._heap)
+            if entry.cancelled:
+                continue
+            entry.popped = True
+            self._virtual_time = max(self._virtual_time, tag)
+            self._live -= 1
+            self._live_cost -= entry.cost
+            return entry
+        return None
+
+    def entries(self) -> list[QueueEntry]:
+        """Live entries, UNORDERED — O(n).  Every caller (TTL scans,
+        drain shedding, gauge refresh) aggregates or acts on all
+        entries; pop order comes only from ``pop()``."""
+        return [e for _, _, e in self._heap if not e.cancelled]
+
+    def __len__(self) -> int:
+        return self._live
+
+    @property
+    def queued_cost(self) -> float:
+        """Total token cost of live entries (drain-estimate input)."""
+        return max(self._live_cost, 0.0)

@@ -114,6 +114,11 @@ class TextGenerationService(rpc.GenerationServiceServicer):
             if store_dir
             else None
         )
+        # lowercased: gRPC invocation-metadata keys arrive lowercase
+        # per spec (the HTTP surface lowercases identically)
+        self.tenant_header = (
+            getattr(args, "tenant_header", "x-tenant-id") or "x-tenant-id"
+        ).lower()
 
     async def post_init(self) -> None:
         self.config = await self.engine.get_model_config()
@@ -129,18 +134,50 @@ class TextGenerationService(rpc.GenerationServiceServicer):
         """Uniform failure handling for every RPC.
 
         Engine death flips the server's stop event (the process is done
-        serving); HBM exhaustion maps onto RESOURCE_EXHAUSTED; everything
-        else logs and re-raises as INTERNAL via grpc.aio's default path.
-        AbortError means we already set a status — pass it through silently.
+        serving).  Status mapping is exception-TYPE-based through
+        ``frontdoor.errors.classify`` — admission sheds, KV-pool
+        exhaustion, and device OOM each carry a deliberate status code
+        (retryable sheds also get Retry-After trailing metadata);
+        message-substring inspection happens only inside that module's
+        one boundary function.  Everything unclassified logs and
+        re-raises as INTERNAL via grpc.aio's default path.  AbortError
+        means we already set a status — pass it through silently.
         """
         if self.engine.errored and not self.engine.is_running:
             self.stop_event.set()
         if isinstance(exc, aio.AbortError):
             raise exc
-        msg = str(exc)
-        if "RESOURCE_EXHAUSTED" in msg or "out of memory" in msg.lower():
-            logger.exception("%s caused TPU HBM OOM error", rpc_name)
-            await context.abort(StatusCode.RESOURCE_EXHAUSTED, msg)
+        from vllm_tgis_adapter_tpu.frontdoor.errors import (
+            AdmissionShedError,
+            classify,
+        )
+
+        disposition = classify(exc)
+        if disposition is not None:
+            if isinstance(exc, AdmissionShedError):
+                # deliberate load shed: WARNING, not a stack trace
+                logger.warning(
+                    "%s shed by admission control (%s): %s",
+                    rpc_name, exc.reason, exc,
+                )
+            else:
+                logger.exception(
+                    "%s failed with engine resource exhaustion", rpc_name
+                )
+            if disposition.retry_after_s is not None:
+                from vllm_tgis_adapter_tpu.frontdoor.errors import (
+                    retry_after_seconds,
+                )
+
+                context.set_trailing_metadata((
+                    (
+                        "retry-after",
+                        str(retry_after_seconds(disposition.retry_after_s)),
+                    ),
+                ))
+            await context.abort(
+                getattr(StatusCode, disposition.grpc_code), str(exc)
+            )
         logger.exception("%s failed", rpc_name)
         raise exc
 
@@ -181,6 +218,17 @@ class TextGenerationService(rpc.GenerationServiceServicer):
             engine_kwargs["trace_headers"] = {
                 k: v for k, v in headers.items() if k.lower() in _TRACE_HEADERS
             }
+        # front-door tenant keying: metadata header, falling back to the
+        # adapter id (heterogeneous adapters sharing one engine are the
+        # natural tenancy boundary), else the shared default bucket
+        engine_kwargs["tenant_id"] = (
+            headers.get(self.tenant_header)
+            or getattr(request, "adapter_id", None)
+            or None
+        )
+        # the TGIS time_limit also bounds QUEUE time: a request that
+        # would only reach prefill after its deadline sheds early
+        engine_kwargs["deadline"] = deadline
         correlation_id = headers.get(CORRELATION_ID_HEADER)
         logs.set_correlation_id(request_id, correlation_id)
         return _RequestSetup(
@@ -578,6 +626,17 @@ async def start_grpc_server(
     service = TextGenerationService(engine, args, health_servicer, stop_event)
     await service.post_init()
     rpc.add_GenerationServiceServicer_to_server(service, server)
+
+    # graceful drain (frontdoor/drain.py): the moment SIGTERM flips the
+    # front door to draining, health reports DRAINING so orchestrators
+    # stop routing to this pod before it disappears
+    frontdoor = getattr(engine, "frontdoor", None)
+    if frontdoor is not None:
+        def _flip_health_draining() -> None:
+            health_servicer.set("", health.DRAINING)
+            health_servicer.set(service.SERVICE_NAME, health.DRAINING)
+
+        frontdoor.add_drain_listener(_flip_health_draining)
 
     # debug service: on-demand profiler capture sharing the HTTP routes'
     # controller (profiler.py get_controller), plus DumpState /

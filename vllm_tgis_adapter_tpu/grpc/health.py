@@ -19,6 +19,26 @@ SERVICE_NAME = "grpc.health.v1.Health"
 
 ServingStatus = health_pb2.HealthCheckResponse.ServingStatus
 
+# Drain state (frontdoor/drain.py): the pod is healthy but refusing new
+# work while in-flight generations finish.  Proto3 enums are open, so
+# the value travels fine even against clients whose generated enum
+# predates it (pb/health.proto declares it as DRAINING = 4); referenced
+# as a plain int here so stale pb2 checkouts keep importing.
+DRAINING = 4
+
+_STATUS_NAMES = {
+    0: "UNKNOWN",
+    1: "SERVING",
+    2: "NOT_SERVING",
+    3: "SERVICE_UNKNOWN",
+    DRAINING: "DRAINING",
+}
+
+
+def status_name(status: int) -> str:
+    """Printable name covering the DRAINING open-enum extension."""
+    return _STATUS_NAMES.get(status, str(status))
+
 
 class HealthServicer:
     """Async health servicer with per-service status and Watch streaming."""

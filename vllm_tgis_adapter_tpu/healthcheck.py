@@ -18,10 +18,20 @@ DEFAULT_SERVICE = "fmaas.GenerationService"  # TextGenerationService.SERVICE_NAM
 
 
 def probe(target: str, service: str, timeout: float, secure: bool) -> int:
-    """Run one Health/Check round trip; return a process exit code."""
+    """Run one Health/Check round trip; return a process exit code.
+
+    0 = SERVING; 2 = DRAINING (the server is healthy but refusing new
+    work while in-flight requests finish — orchestrators must stop
+    routing, and a readiness exec probe using this CLI goes unready
+    before the pod dies); 1 = anything else.
+    """
     import grpc
 
-    from vllm_tgis_adapter_tpu.grpc.health import HealthStub
+    from vllm_tgis_adapter_tpu.grpc.health import (
+        DRAINING,
+        HealthStub,
+        status_name,
+    )
     from vllm_tgis_adapter_tpu.grpc.pb.health_pb2 import (
         HealthCheckRequest,
         HealthCheckResponse,
@@ -43,7 +53,11 @@ def probe(target: str, service: str, timeout: float, secure: bool) -> int:
         print(f"Health.Check failed: code={err.code()}, details={err.details()}")
         return 1
 
-    print(str(reply).strip())
+    # name the status ourselves: DRAINING is an open-enum extension the
+    # generated message may not know how to print
+    print(f"status: {status_name(reply.status)}")
+    if reply.status == DRAINING:
+        return 2
     return 0 if reply.status == HealthCheckResponse.SERVING else 1
 
 

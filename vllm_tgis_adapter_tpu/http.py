@@ -26,6 +26,10 @@ from vllm_tgis_adapter_tpu.engine.sampling_params import (
     RequestOutputKind,
     SamplingParams,
 )
+from vllm_tgis_adapter_tpu.frontdoor.errors import (
+    AdmissionShedError,
+    CapacityError,
+)
 from vllm_tgis_adapter_tpu.logging import init_logger
 from vllm_tgis_adapter_tpu.tgis_utils import logs
 
@@ -47,6 +51,47 @@ def _trace_headers(request: "HttpRequest") -> Optional[dict[str, str]]:
         k: request.headers[k] for k in _TRACE_HEADERS if k in request.headers
     }
     return headers or None
+
+
+def _tenant_id(app: App, request: "HttpRequest") -> Optional[str]:
+    """Front-door tenant key: the configured header (default
+    ``x-tenant-id``), same keying as the gRPC surface."""
+    return request.headers.get(app.state.get("tenant_header") or
+                               "x-tenant-id")
+
+
+def _shed_response(exc: BaseException) -> HttpResponse:
+    """Admission-shed / capacity errors → deliberate HTTP statuses.
+
+    Type-based mapping shared with the gRPC surface
+    (frontdoor.errors.classify): sheds are 429 with ``Retry-After``,
+    drain is 503, queue-TTL expiry is 408; returns a generic 500 for
+    anything unclassified (callers only pass classified errors).
+    """
+    from vllm_tgis_adapter_tpu.frontdoor.errors import (
+        classify,
+        retry_after_seconds,
+    )
+
+    disposition = classify(exc)
+    if disposition is None:
+        return error_response(500, str(exc), "server_error")
+    headers = {}
+    if disposition.retry_after_s is not None:
+        headers["retry-after"] = str(
+            retry_after_seconds(disposition.retry_after_s)
+        )
+    return JsonResponse(
+        {
+            "error": {
+                "message": str(exc),
+                "type": disposition.err_type,
+                "code": disposition.http_status,
+            }
+        },
+        status=disposition.http_status,
+        headers=headers,
+    )
 
 
 # --------------------------------------------------------------------- app
@@ -185,6 +230,9 @@ def build_http_server(args: "argparse.Namespace", engine: "AsyncLLMEngine") -> A
     served_names = args.served_model_name or [args.model]
     app.state["model_names"] = served_names
     app.state["api_key"] = args.api_key
+    app.state["tenant_header"] = (
+        getattr(args, "tenant_header", "x-tenant-id") or "x-tenant-id"
+    ).lower()
 
     app.route("GET", "/health")(_health)
     app.route("GET", "/metrics")(_metrics)
@@ -216,6 +264,14 @@ def build_http_server(args: "argparse.Namespace", engine: "AsyncLLMEngine") -> A
 
 async def _health(app: App, request: HttpRequest) -> HttpResponse:
     engine: AsyncLLMEngine = app.state["engine"]
+    frontdoor = getattr(engine, "frontdoor", None)
+    if frontdoor is not None and frontdoor.draining:
+        # drain (frontdoor/drain.py): healthy but refusing new work —
+        # 503 pulls this pod out of load-balancer rotation while
+        # in-flight generations finish
+        return error_response(
+            503, "server is draining", "service_unavailable"
+        )
     try:
         await engine.check_health()
     except Exception as e:  # noqa: BLE001 — cancellation must propagate
@@ -436,6 +492,27 @@ def _sibling_params(sampling_params: "SamplingParams", k: int, n: int,
 
 
 
+async def _stream_head(merged):  # noqa: ANN001, ANN202
+    """Await the merged generators' first item before the streaming
+    response commits its status line.
+
+    Returns ``((index, result) | None, None)`` on success (None when
+    every stream was empty) or ``(None, error_response)`` when the
+    first event was a shed/overload/validation failure — those must go
+    on the wire as their real statuses (429/503/400), which is only
+    possible before any body bytes exist.  A failure arriving later,
+    mid-stream, still degrades to an in-band error frame.
+    """
+    try:
+        return await merged.__anext__(), None
+    except StopAsyncIteration:
+        return None, None
+    except (AdmissionShedError, CapacityError) as e:
+        return None, _shed_response(e)
+    except ValueError as e:
+        return None, error_response(400, str(e))
+
+
 async def _completions(app: App, request: HttpRequest):  # noqa: ANN201, C901, PLR0915
     engine: AsyncLLMEngine = app.state["engine"]
     body, model_name, err = _openai_preamble(app, request)
@@ -482,6 +559,7 @@ async def _completions(app: App, request: HttpRequest):  # noqa: ANN201, C901, P
                 ),
                 request_id=f"cmpl-{base_request_id}-{pi * n + k}",
                 trace_headers=_trace_headers(request),
+                tenant_id=_tenant_id(app, request),
             ))
 
     from vllm_tgis_adapter_tpu.utils import merge_async_iterators
@@ -489,29 +567,42 @@ async def _completions(app: App, request: HttpRequest):  # noqa: ANN201, C901, P
     merged = merge_async_iterators(*generators)
 
     if stream:
+        # pull the first result BEFORE committing the 200 + stream
+        # headers: a shed/overload raised on the generators' first
+        # iteration must surface as a real 429/503 + Retry-After, not
+        # as an error frame inside a 200 stream
+        first, err = await _stream_head(merged)
+        if err is not None:
+            return err
 
         async def sse() -> AsyncIterator[bytes]:
+            def chunk(i: int, out) -> bytes:  # noqa: ANN001
+                payload = {
+                    "id": completion_id,
+                    "object": "text_completion",
+                    "created": created,
+                    "model": model_name,
+                    "choices": [
+                        {
+                            "index": i,
+                            "text": out.text,
+                            "logprobs": None,
+                            "finish_reason": out.finish_reason,
+                        }
+                    ],
+                }
+                return f"data: {json.dumps(payload)}\n\n".encode()
+
             try:
+                if first is not None:
+                    yield chunk(first[0], first[1].outputs[0])
                 async for i, res in merged:
-                    out = res.outputs[0]
-                    chunk = {
-                        "id": completion_id,
-                        "object": "text_completion",
-                        "created": created,
-                        "model": model_name,
-                        "choices": [
-                            {
-                                "index": i,
-                                "text": out.text,
-                                "logprobs": None,
-                                "finish_reason": out.finish_reason,
-                            }
-                        ],
-                    }
-                    yield f"data: {json.dumps(chunk)}\n\n".encode()
+                    yield chunk(i, res.outputs[0])
             except Exception as e:  # noqa: BLE001 — cancellation must propagate
-                err = {"error": {"message": str(e), "type": "server_error"}}
-                yield f"data: {json.dumps(err)}\n\n".encode()
+                err_frame = {
+                    "error": {"message": str(e), "type": "server_error"}
+                }
+                yield f"data: {json.dumps(err_frame)}\n\n".encode()
             yield b"data: [DONE]\n\n"
 
         return StreamingResponse(sse())
@@ -520,6 +611,10 @@ async def _completions(app: App, request: HttpRequest):  # noqa: ANN201, C901, P
     try:
         async for i, res in merged:
             results[i] = res
+    except (AdmissionShedError, CapacityError) as e:
+        # overload: 429 + Retry-After (shed) or 503 (exhaustion); any
+        # sibling streams already admitted are reaped on cancellation
+        return _shed_response(e)
     except ValueError as e:
         return error_response(400, str(e))
 
@@ -648,6 +743,7 @@ async def _chat_completions(app: App, request: HttpRequest):  # noqa: ANN201, C9
             sampling_params=_sibling_params(sampling_params, k, n, out_kind),
             request_id=f"chat-{base_request_id}-{k}",
             trace_headers=_trace_headers(request),
+            tenant_id=_tenant_id(app, request),
         )
         for k in range(n)
     ]
@@ -657,6 +753,11 @@ async def _chat_completions(app: App, request: HttpRequest):  # noqa: ANN201, C9
     merged = merge_async_iterators(*generators)
 
     if stream:
+        # same head-await as _completions: sheds on the first iteration
+        # become real 429/503 responses, not error frames inside a 200
+        first, head_err = await _stream_head(merged)
+        if head_err is not None:
+            return head_err
 
         async def sse() -> AsyncIterator[bytes]:
             def chunk(idx: int, delta: dict,
@@ -674,15 +775,24 @@ async def _chat_completions(app: App, request: HttpRequest):  # noqa: ANN201, C9
                 }
                 return f"data: {json.dumps(payload)}\n\n".encode()
 
+            def content_chunks(k: int, res) -> list[bytes]:  # noqa: ANN001
+                out = res.outputs[0]
+                frames = []
+                if out.text:
+                    frames.append(chunk(k, {"content": out.text}, None))
+                if out.finish_reason:
+                    frames.append(chunk(k, {}, out.finish_reason))
+                return frames
+
             for k in range(n):
                 yield chunk(k, {"role": "assistant", "content": ""}, None)
             try:
+                if first is not None:
+                    for frame in content_chunks(first[0], first[1]):
+                        yield frame
                 async for k, res in merged:
-                    out = res.outputs[0]
-                    if out.text:
-                        yield chunk(k, {"content": out.text}, None)
-                    if out.finish_reason:
-                        yield chunk(k, {}, out.finish_reason)
+                    for frame in content_chunks(k, res):
+                        yield frame
             except Exception as e:  # noqa: BLE001 — cancellation propagates
                 err = {"error": {"message": str(e), "type": "server_error"}}
                 yield f"data: {json.dumps(err)}\n\n".encode()
@@ -694,6 +804,8 @@ async def _chat_completions(app: App, request: HttpRequest):  # noqa: ANN201, C9
     try:
         async for k, res in merged:
             finals[k] = res
+    except (AdmissionShedError, CapacityError) as e:
+        return _shed_response(e)
     except ValueError as e:
         return error_response(400, str(e))
     n_prompt = len(finals[0].prompt_token_ids or ())

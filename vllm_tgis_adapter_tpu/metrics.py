@@ -300,6 +300,40 @@ watchdog_stalls_total = _get_or_create(
 )
 
 
+# ---- front door (frontdoor/): admission control, per-tenant fair
+# queuing, load shedding (docs/FRONTDOOR.md).  Queue depth/age cover
+# the fair queue in FRONT of the engines (the scheduler's own waiting
+# queues feed num_requests_waiting, which also includes these); sheds
+# are the requests deliberately refused under overload, by reason.
+frontdoor_queue_depth = _get_or_create(
+    Gauge,
+    f"{_PREFIX}_frontdoor_queue_depth",
+    "Requests parked in the front-door fair queue, not yet handed to "
+    "an engine scheduler",
+)
+frontdoor_queue_age_seconds = _get_or_create(
+    Gauge,
+    f"{_PREFIX}_frontdoor_queue_age_seconds",
+    "Age of the oldest request parked in the front-door fair queue "
+    "(0 when empty)",
+)
+frontdoor_sheds_total = _get_or_create(
+    Counter,
+    f"{_PREFIX}_frontdoor_sheds_total",
+    "Requests shed by admission control, by reason (queue_full, "
+    "deadline, rate_limit, ttl, draining)",
+    labelnames=("reason",),
+)
+frontdoor_tenant_tokens_total = _get_or_create(
+    Counter,
+    f"{_PREFIX}_frontdoor_tenant_tokens_total",
+    "Token budget (prompt + max new) accepted into the front door per "
+    "tenant — the fair-queue cost unit (tenant label capped at 64 "
+    "distinct values, then 'other')",
+    labelnames=("tenant",),
+)
+
+
 class _StepSnapshot:
     """Host-side mirror of the latest per-dispatch shape stats, so the
     periodic stats log line (engine/async_llm.py) can report them without

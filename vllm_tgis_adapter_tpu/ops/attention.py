@@ -70,6 +70,10 @@ def _use_pallas() -> bool:
 _DECODE_KERNEL_CHAIN = ("folded", "perhead", "xla")
 _decode_kernel_lock = threading.Lock()
 _decode_kernel_override: str | None = None
+# every degradation step this process took, in order — bench.py stamps
+# these into BENCH_*.json so a run that silently fell back to a slower
+# kernel is attributable instead of a throughput mystery
+_decode_kernel_degrades: list[dict] = []
 
 
 def decode_kernel_variant() -> str:
@@ -101,14 +105,29 @@ def degrade_decode_kernel(failed: str | None = None) -> str | None:
         if idx + 1 >= len(_DECODE_KERNEL_CHAIN):
             return None
         _decode_kernel_override = _DECODE_KERNEL_CHAIN[idx + 1]
+        import time
+
+        _decode_kernel_degrades.append({
+            "from": current,
+            "to": _decode_kernel_override,
+            "ts": round(time.time(), 3),
+        })
         return _decode_kernel_override
 
 
+def decode_kernel_degrades() -> list[dict]:
+    """Degradation steps taken this process (oldest first); see
+    ``_decode_kernel_degrades``."""
+    with _decode_kernel_lock:
+        return list(_decode_kernel_degrades)
+
+
 def reset_decode_kernel() -> None:
-    """Test hook: clear a sticky degradation."""
+    """Test hook: clear a sticky degradation (and its event log)."""
     global _decode_kernel_override
     with _decode_kernel_lock:
         _decode_kernel_override = None
+        _decode_kernel_degrades.clear()
 
 
 def is_kernel_lowering_error(exc: BaseException) -> bool:

@@ -243,6 +243,48 @@ def add_engine_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         "scheduler and KV pool; requests route to the "
                         "least-loaded replica (total chips = dp*sp*tp)")
 
+    g = parser.add_argument_group("front door (admission control)")
+    g.add_argument("--max-waiting-requests", type=int, default=0,
+                   help="bound on requests waiting for admission "
+                        "(front-door queue + engine waiting queues); "
+                        "past it new requests shed with "
+                        "RESOURCE_EXHAUSTED/429 + Retry-After instead "
+                        "of queuing unboundedly (0 = unbounded)")
+    g.add_argument("--admission-deadline", type=float, default=0.0,
+                   help="shed a new request when the estimated "
+                        "queue-drain time (observed token throughput, "
+                        "seeded from KV-pool capacity) already exceeds "
+                        "this many seconds (0 disables)")
+    g.add_argument("--queue-ttl", type=float, default=0.0,
+                   help="early-abort requests still waiting for "
+                        "prefill this many seconds after arrival; "
+                        "request-level deadlines (time_limit_millis) "
+                        "tighten it per request (0 disables)")
+    g.add_argument("--drain-grace", type=float, default=30.0,
+                   help="on SIGTERM, seconds in-flight generations may "
+                        "finish before the process exits anyway "
+                        "(health flips to DRAINING/503 immediately)")
+    g.add_argument("--tenant-weights", type=str, default=None,
+                   help="weighted-fair-queue tenant weights as "
+                        "name=weight[,name=weight...]; unlisted "
+                        "tenants weigh 1.0")
+    g.add_argument("--tenant-rate-limit", type=float, default=0.0,
+                   help="per-tenant sustained token budget "
+                        "(tokens/second, prompt + max new tokens) "
+                        "enforced by a token bucket; 0 disables")
+    g.add_argument("--tenant-burst", type=float, default=0.0,
+                   help="per-tenant token-bucket burst capacity; 0 "
+                        "defaults to 10s of --tenant-rate-limit")
+    g.add_argument("--tenant-header", type=str, default="x-tenant-id",
+                   help="HTTP header / gRPC metadata key carrying the "
+                        "tenant id for fair queuing and rate limits "
+                        "(falls back to the adapter id, then "
+                        "'default')")
+    g.add_argument("--disable-frontdoor", action="store_true",
+                   help="bypass the front door entirely: unbounded "
+                        "FIFO hand-off straight to the scheduler "
+                        "(pre-PR4 behavior; escape hatch)")
+
     g = parser.add_argument_group("lora")
     g.add_argument("--enable-lora", action="store_true",
                    help="enable LoRA adapter support")
