@@ -10,7 +10,9 @@ from __future__ import annotations
 
 import nox
 
-nox.options.sessions = ("lint", "tpulint", "typecheck", "tests")
+nox.options.sessions = (
+    "lint", "tpulint", "typecheck", "tests", "overload_check", "chaos_check",
+)
 nox.options.reuse_existing_virtualenvs = True
 
 PYTHON_VERSIONS = ["3.12", "3.11"]
@@ -58,6 +60,22 @@ def overload_check(session: nox.Session) -> None:
     session.install("-e", ".[tests]")
     session.run(
         "pytest", "tests/test_frontdoor.py", "-q",
+        *session.posargs,
+        env={"JAX_PLATFORMS": "cpu"},
+    )
+
+
+@nox.session(python="3.12")
+def chaos_check(session: nox.Session) -> None:
+    """Failpoint-driven recovery gate (docs/RECOVERY.md): inject
+    step-loop crashes, OOMs, stuck dispatches, and death-during-recovery
+    through supervisor/failpoints.py and assert the supervisor replays
+    pre-prefill work losslessly, fails mid-decode retryable, re-arms
+    health, and trips the crash-loop circuit breaker.  Also runs inside
+    the tier-1 suite; this session is the fast standalone entry point."""
+    session.install("-e", ".[tests]")
+    session.run(
+        "pytest", "tests/test_supervisor.py", "-q",
         *session.posargs,
         env={"JAX_PLATFORMS": "cpu"},
     )

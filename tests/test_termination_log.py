@@ -54,3 +54,97 @@ def test_no_termination_log_file_is_fine(tmp_path, monkeypatch):
     )
     with pytest.raises(ValueError, match="config.json"):
         _boot(args)
+
+
+# ------------------------------------------------------- engine-death path
+
+
+def test_engine_death_checkpoints_error_and_snapshot(
+    tiny_model_dir, tmp_path, monkeypatch
+):
+    """Terminal (unsupervised) engine death must checkpoint the dead
+    error text AND a flight-recorder/engine-state snapshot — until PR 5
+    only the happy drain path wrote anything here."""
+    import asyncio
+    import time
+
+    from tests.test_supervisor import _build_engine, _collect
+    from vllm_tgis_adapter_tpu.supervisor import failpoints
+
+    termination_log = tmp_path / "termination-log"
+    termination_log.touch()
+    monkeypatch.setenv("TERMINATION_LOG_DIR", str(termination_log))
+
+    engine = _build_engine(tiny_model_dir, max_engine_restarts=0)
+    assert engine.supervisor is None
+
+    async def scenario():
+        failpoints.arm_site("core.plan_step", "raise", 1)
+        try:
+            status, err = await _collect(
+                engine, "r", prompt_ids=list(range(3, 12)), max_tokens=4
+            )
+            # the dying task writes the report off-loop; wait for it
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if "engine died" in termination_log.read_text():
+                    break
+                await asyncio.sleep(0.02)
+            return status, err
+        finally:
+            failpoints.disarm()
+            await engine.stop()
+
+    status, err = asyncio.run(scenario())
+    assert status == "err"
+    assert engine.errored and engine.lifecycle == "dead"
+    contents = termination_log.read_text()
+    assert "engine died" in contents
+    assert "FailpointError" in contents            # the dead error text
+    assert "engine state snapshot" in contents     # debug_state JSON
+    assert '"events"' in contents                  # flight-recorder tail
+    assert '"kind": "error"' in contents           # the death event itself
+
+
+def test_supervised_restart_checkpoints_history(
+    tiny_model_dir, tmp_path, monkeypatch
+):
+    """Each successful supervised restart checkpoints the restart
+    history, so a later unrelated pod death still shows the restarts in
+    the post-mortem."""
+    import asyncio
+    import time
+
+    from tests.test_supervisor import _build_engine, _collect
+    from vllm_tgis_adapter_tpu.supervisor import failpoints
+
+    termination_log = tmp_path / "termination-log"
+    termination_log.touch()
+    monkeypatch.setenv("TERMINATION_LOG_DIR", str(termination_log))
+
+    engine = _build_engine(tiny_model_dir, max_engine_restarts=3)
+
+    async def scenario():
+        failpoints.arm_site("core.plan_step", "raise", 1)
+        try:
+            status, final = await _collect(
+                engine, "r", prompt_ids=list(range(3, 12)), max_tokens=4
+            )
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if "restarted under supervision" in (
+                    termination_log.read_text()
+                ):
+                    break
+                await asyncio.sleep(0.02)
+            return status, final
+        finally:
+            failpoints.disarm()
+            await engine.stop()
+
+    status, final = asyncio.run(scenario())
+    assert status == "ok"  # zero tokens at death: replayed to completion
+    contents = termination_log.read_text()
+    assert "restarted under supervision" in contents
+    assert "cause=step_loop" in contents
+    assert "recovered in" in contents

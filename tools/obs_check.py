@@ -40,7 +40,8 @@ def documented_metrics(doc_path: Path) -> set[str]:
 # top-level sections docs/OBSERVABILITY.md documents for the
 # /debug/state snapshot; a missing key means code and doc diverged
 DEBUG_STATE_KEYS = (
-    "engine", "frontdoor", "replicas", "compile_tracker", "watchdog",
+    "engine", "supervisor", "frontdoor", "replicas", "compile_tracker",
+    "watchdog",
     "events",
 )
 REPLICA_KEYS = ("scheduler", "kv_cache", "in_flight", "step_counter")

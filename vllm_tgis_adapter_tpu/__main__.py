@@ -71,10 +71,19 @@ async def start_servers(args: "argparse.Namespace") -> None:
             "jax.profiler server listening on port %d", args.jax_profiler_port
         )
 
+    if getattr(args, "failpoints", None):
+        # deliberate chaos-testing fault injection
+        # (supervisor/failpoints.py; also via TGIS_TPU_FAILPOINTS) —
+        # armed BEFORE engine boot so boot-path sites can fire too
+        from vllm_tgis_adapter_tpu.supervisor import failpoints
+
+        failpoints.arm(args.failpoints)
+
     engine = None
     drain = None
     tasks: list[asyncio.Task] = []
     drain_waiter: asyncio.Task | None = None
+    dead_waiter: asyncio.Task | None = None
     loop = asyncio.get_running_loop()
     try:
         from vllm_tgis_adapter_tpu.engine.config import EngineConfig
@@ -120,8 +129,16 @@ async def start_servers(args: "argparse.Namespace") -> None:
         drain_waiter = loop.create_task(
             drain.shutdown_event.wait(), name="drain_shutdown"
         )
+        # terminal engine death (unsupervised, or the supervisor's
+        # crash-loop circuit breaker) wakes this wait directly — the
+        # process must exit promptly, not at the next RPC.  Supervised
+        # restarts never set this: the engine recovers in place.
+        dead_waiter = loop.create_task(
+            engine.dead_event.wait(), name="engine_dead"
+        )
         done, _pending = await asyncio.wait(
-            [*tasks, drain_waiter], return_when=asyncio.FIRST_COMPLETED
+            [*tasks, drain_waiter, dead_waiter],
+            return_when=asyncio.FIRST_COMPLETED,
         )
 
         if drain_waiter in done:
@@ -142,8 +159,9 @@ async def start_servers(args: "argparse.Namespace") -> None:
     finally:
         if drain is not None:
             drain.uninstall(loop)
-        if drain_waiter is not None and not drain_waiter.done():
-            drain_waiter.cancel()
+        for waiter in (drain_waiter, dead_waiter):
+            if waiter is not None and not waiter.done():
+                waiter.cancel()
         for task in tasks:
             if not task.done():
                 task.cancel()
@@ -165,10 +183,13 @@ def run_and_catch_termination_cause(
     try:
         loop.run_until_complete(task)
     except BaseException:
-        # report the first exception as the cause of termination
+        # report the first exception as the cause of termination;
+        # APPENDED so an engine-death report / restart-history
+        # checkpoint already written this process survives alongside it
         msg = traceback.format_exc()
         write_termination_log(
-            msg, os.getenv("TERMINATION_LOG_DIR", "/dev/termination-log")
+            msg, os.getenv("TERMINATION_LOG_DIR", "/dev/termination-log"),
+            append=True,
         )
         raise
 

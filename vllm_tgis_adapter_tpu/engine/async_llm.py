@@ -29,6 +29,7 @@ process, one tokenizer, both servers, N device groups.
 from __future__ import annotations
 
 import asyncio
+import os
 import time
 from collections.abc import AsyncGenerator, Mapping
 from typing import Optional
@@ -45,6 +46,12 @@ from vllm_tgis_adapter_tpu.frontdoor.errors import (
     AdmissionShedError,
 )
 from vllm_tgis_adapter_tpu.logging import init_logger
+from vllm_tgis_adapter_tpu.supervisor.lifecycle import (
+    LIFECYCLE_DEAD,
+    LIFECYCLE_RECOVERING,
+    LIFECYCLE_SERVING,
+)
+from vllm_tgis_adapter_tpu.utils import write_termination_log
 
 logger = init_logger(__name__)
 
@@ -91,6 +98,19 @@ class AsyncLLMEngine:
         self._early_aborts: set[str] = set()
         self._dead_error: Optional[BaseException] = None
         self._stopped = False
+        # lifecycle state machine (supervisor/lifecycle.py): serving →
+        # recovering → serving under supervision; → dead when terminal.
+        # Every health surface reads THIS, not the raw booleans.
+        self.lifecycle = LIFECYCLE_SERVING
+        # set exactly once, on terminal death — __main__ waits on it so
+        # the process exits promptly instead of at the next RPC
+        self.dead_event = asyncio.Event()
+        # precompile() remembers its batch-widths argument so a
+        # supervised rebuild can re-warm the same serving shapes
+        self._precompile_widths: Optional[str] = None
+        # the replica the last stall snapshot blamed (consumed by
+        # --watchdog-action=restart so the restart hits that replica)
+        self._last_stalled_rep: Optional[_Replica] = None
         # periodic operational stats line (vLLM-style), unless
         # --disable-log-stats
         self._stats_task: Optional[asyncio.Task] = None
@@ -155,7 +175,50 @@ class AsyncLLMEngine:
                 age_fn=self._stall_age,
                 deadline_s=config.watchdog_deadline_s,
                 dump_dir=config.dump_dir,
+                action=config.watchdog_action,
+                restart_fn=self._watchdog_restart,
             )
+        # engine supervision (supervisor/): --max-engine-restarts > 0
+        # turns engine death from terminal into quiesce → replay-safe
+        # triage → rebuild → re-arm, with a crash-loop circuit breaker.
+        # 0 (the library/config default) keeps crash-fast semantics.
+        if (
+            config.watchdog_action == "restart"
+            and config.watchdog_deadline_s <= 0
+        ):
+            # same loud-downgrade courtesy as the pp gate below: the
+            # operator asked for stall restarts, but with the watchdog
+            # disabled no stall is ever detected
+            logger.warning(
+                "--watchdog-action=restart has no effect with "
+                "--watchdog-deadline 0: the stall watchdog is disabled, "
+                "so stalls are never detected"
+            )
+        self.supervisor = None
+        if config.max_engine_restarts > 0:
+            if config.parallel_config.pipeline_parallel_size > 1:
+                # the rebuild path reuses runner.params, which the
+                # PipelineRunner splits into per-stage state at
+                # construction — supervised rebuild under pp needs
+                # per-stage plumbing that doesn't exist yet.  Refuse
+                # loudly at boot (crash-fast semantics preserved)
+                # rather than crash-looping on the first real death.
+                logger.warning(
+                    "engine supervision is not supported with "
+                    "--pipeline-parallel-size > 1 yet; running with "
+                    "crash-fast engine-death semantics"
+                )
+            else:
+                from vllm_tgis_adapter_tpu.supervisor.supervisor import (
+                    EngineSupervisor,
+                )
+
+                self.supervisor = EngineSupervisor(
+                    self,
+                    max_restarts=config.max_engine_restarts,
+                    window_s=config.engine_restart_window_s,
+                    backoff_base_s=config.engine_restart_backoff_s,
+                )
 
     # ------------------------------------------------------------ frontdoor
 
@@ -263,6 +326,8 @@ class AsyncLLMEngine:
         """Warm every serving shape on every replica before ``start()``
         (--precompile): delegates to each core engine's precompile off
         the event loop.  Returns total warmup requests run."""
+        # remembered so a supervised rebuild re-warms the same shapes
+        self._precompile_widths = batch_widths
         total = 0
         for rep in self._replicas:
             total += await asyncio.to_thread(
@@ -289,6 +354,9 @@ class AsyncLLMEngine:
 
     async def stop(self) -> None:
         self._stopped = True
+        if self.supervisor is not None:
+            # an in-flight recovery must not race the teardown below
+            await self.supervisor.stop()
         if self.frontdoor is not None:
             # parked waiters fail fast instead of hanging on a pump
             # that is about to be cancelled
@@ -381,7 +449,19 @@ class AsyncLLMEngine:
         """
         if self.errored:
             raise self.dead_error
-        if self._replicas[0].task is None:
+        if self.lifecycle == LIFECYCLE_RECOVERING and self.frontdoor is None:
+            # without a front door there is nowhere to park the request
+            # while the engine rebuilds — refuse retryable (UNAVAILABLE
+            # + Retry-After), never with the terminal dead error
+            from vllm_tgis_adapter_tpu.frontdoor.errors import (
+                EngineRestartError,
+            )
+
+            raise EngineRestartError(
+                "engine is restarting after a fault; retry shortly",
+                retry_after_s=2.0,
+            )
+        if self._replicas[0].task is None and self.lifecycle == LIFECYCLE_SERVING:
             await self.start()
         sampling_params = sampling_params or SamplingParams()
         if request_id in self._queues:
@@ -555,6 +635,34 @@ class AsyncLLMEngine:
             default=0.0,
         )
 
+    def _stalled_replica(self) -> _Replica:
+        """The replica the watchdog is (or would be) complaining about:
+        oldest heartbeat among replicas with unfinished work."""
+        now = time.monotonic()
+        return max(
+            (
+                rep for rep in self._replicas
+                if rep.engine.has_unfinished_requests()
+            ),
+            key=lambda rep: now - rep.last_beat,
+            default=self._replicas[0],
+        )
+
+    def _watchdog_restart(self) -> None:
+        """--watchdog-action=restart hand-off (called by the watchdog
+        AFTER its snapshot is written)."""
+        if self.supervisor is None:
+            logger.warning(
+                "--watchdog-action=restart but engine supervision is "
+                "disabled (--max-engine-restarts 0); snapshot only"
+            )
+            return
+        # restart the replica the SNAPSHOT blamed: re-resolving now,
+        # after the dump I/O, could pick a healthy replica if the
+        # stall cleared in that window
+        rep, self._last_stalled_rep = self._last_stalled_rep, None
+        self.supervisor.request_restart(rep=rep)
+
     def _stall_snapshot(self) -> dict:
         # mark the episode in the ring FIRST so the dump (and any later
         # /debug/state read) self-locates the stall in the event
@@ -563,14 +671,10 @@ class AsyncLLMEngine:
         # counter — under dp the healthy replicas' timelines must not
         # absorb a stall that is not theirs.
         now = time.monotonic()
-        stalled = max(
-            (
-                rep for rep in self._replicas
-                if rep.engine.has_unfinished_requests()
-            ),
-            key=lambda rep: now - rep.last_beat,
-            default=self._replicas[0],
-        )
+        stalled = self._stalled_replica()
+        # remembered for a subsequent --watchdog-action=restart: the
+        # restart must hit the replica THIS snapshot describes
+        self._last_stalled_rep = stalled
         stalled.engine.recorder.record(
             "stall", step=stalled.engine.step_counter,
             replica=stalled.index,
@@ -605,8 +709,14 @@ class AsyncLLMEngine:
             "engine": {
                 "running": self.is_running,
                 "errored": self.errored,
+                "lifecycle": self.lifecycle,
                 "replicas": len(self._replicas),
             },
+            "supervisor": (
+                self.supervisor.debug_state()
+                if self.supervisor is not None
+                else None
+            ),
             "frontdoor": (
                 self.frontdoor.debug_state()
                 if self.frontdoor is not None
@@ -699,11 +809,22 @@ class AsyncLLMEngine:
         """One operational stats line every STATS_INTERVAL_S while work is
         in flight (the --disable-log-stats flag's actual behavior)."""
         was_active = False
-        while not self._stopped and not self.errored:
-            # a dead engine must not keep reporting "running: N" forever
+        while not self._stopped:
             await asyncio.sleep(self.STATS_INTERVAL_S)
-            if self.errored:
+            if self.errored or self.lifecycle == LIFECYCLE_DEAD:
+                # terminal: nothing can bring this engine back — exit
+                # instead of sleeping forever in embeddings that never
+                # call stop()
                 break
+            if self.lifecycle == LIFECYCLE_RECOVERING:
+                # a rebuilding engine must not report "running: N" —
+                # but the loop stays ALIVE (continue, not break): after
+                # the supervised restart it resumes reporting.  The
+                # pre-PR5 `while not errored` was a one-way latch that
+                # silenced stats on an engine that later recovered.
+                # Draining still reports: the operator is watching
+                # exactly this line to see how much work remains.
+                continue
             engines = [rep.engine for rep in self._replicas]
             active = any(e.has_unfinished_requests() for e in engines)
             allocators = [e.scheduler.allocator for e in engines]
@@ -899,30 +1020,199 @@ class AsyncLLMEngine:
                     in_flight = (plan, prepared, handle, False)
         except asyncio.CancelledError:
             raise
-        except BaseException as e:  # noqa: BLE001 — engine death is terminal
-            # one replica dying is whole-engine death: the servers read
-            # ``errored`` and crash-fast, matching single-engine semantics
+        except BaseException as e:  # noqa: BLE001 — engine death boundary
             logger.exception("engine step loop %d died", rep.index)
             engine.recorder.record(
                 "error", step=engine.step_counter, replica=rep.index,
                 error=f"{type(e).__name__}: {e}",
             )
             # typed at the boundary (frontdoor/errors.py): XLA OOM text
-            # becomes DeviceOOMError here, so the servers map engine
-            # death to a status code by isinstance, never by substring
+            # becomes DeviceOOMError here, so the servers (and the
+            # supervisor's cause label) classify engine death by
+            # isinstance, never by substring
             from vllm_tgis_adapter_tpu.frontdoor.errors import (
                 wrap_engine_error,
             )
 
             err = wrap_engine_error(e)
-            self._dead_error = err
-            for queue in self._queues.values():
-                queue.put_nowait(err)
-            if self.frontdoor is not None:
-                # parked waiters must observe the death too
-                self.frontdoor.fail_all(err)
+            if (
+                self.supervisor is not None
+                and not self._stopped
+                and self.supervisor.accepts()
+            ):
+                # supervised death: the supervisor quiesces the front
+                # door, replays pre-prefill work into a rebuilt engine,
+                # and fails mid-decode requests retryable.  This task
+                # just exits — NOT errored: the pod is recovering, not
+                # dead (supervisor/supervisor.py).
+                self.supervisor.notify_death(rep, err)
+                return
+            # terminal death (no supervisor / breaker tripped / engine
+            # stopping): pre-PR5 crash-fast semantics
+            self._terminal_death(err)
+            # flush BEFORE the first await below: a consumer woken by
+            # the failed queue must never observe a still-open epoch
+            # (the finally-flush would otherwise run one yield too late)
+            engine.flush_all_free_epochs()
+            await asyncio.to_thread(
+                write_termination_log,
+                self._death_report(err),
+                os.getenv("TERMINATION_LOG_DIR", "/dev/termination-log"),
+            )
+            # only NOW wake __main__: the report write above has
+            # completed, so its final appended traceback cannot be
+            # truncated by an unfinished mode-'w' write
+            self.dead_event.set()
             raise
         finally:
             # epochs left open by a death between a chained dispatch and
             # its commit would quarantine their pages forever
             engine.flush_all_free_epochs()
+
+    # ----------------------------------------------------- death & recovery
+
+    def _terminal_death(self, err: BaseException) -> None:
+        """The engine is done for good: mark it dead and fail every
+        consumer.  Called by the step loop (unsupervised death) and by
+        the supervisor's circuit breaker.  Callers set ``dead_event``
+        themselves, AFTER their termination-log checkpoint completes —
+        waking __main__ first would let its final append race (and be
+        truncated by) the still-in-flight mode-'w' report write."""
+        self._dead_error = err
+        self.lifecycle = LIFECYCLE_DEAD
+        for queue in self._queues.values():
+            queue.put_nowait(err)
+        if self.frontdoor is not None:
+            # parked waiters must observe the death too
+            self.frontdoor.fail_all(err)
+
+    def _death_report(self, err: BaseException) -> str:
+        """Termination-log body for terminal engine death: the error,
+        any restart history, and a flight-recorder/engine snapshot —
+        everything a post-mortem needs after the pod is gone."""
+        import json
+
+        lines = [f"engine died: {type(err).__name__}: {err}"]
+        if self.supervisor is not None and self.supervisor.restart_history:
+            lines.append("restart history:")
+            lines.extend(self.supervisor.history_lines())
+        try:
+            snapshot = self.debug_state(last_events=64)
+            lines.append(
+                "engine state snapshot: "
+                + json.dumps(snapshot, default=str)
+            )
+        except Exception:  # noqa: BLE001 — a broken engine is the expected case
+            logger.exception("death-report snapshot collection failed")
+            lines.append("engine state snapshot unavailable")
+        return "\n".join(lines)
+
+    def _arm_replica(self, rep: _Replica) -> None:
+        """(Re)start one replica's step loop (supervisor re-arm)."""
+        rep.last_beat = time.monotonic()
+        rep.task = asyncio.get_running_loop().create_task(
+            self._run_loop(rep), name=f"engine-step-loop-{rep.index}"
+        )
+        rep.new_work.set()
+
+    async def fail_unreplayable(
+        self, rep: _Replica, fail_error: BaseException
+    ) -> int:
+        """Quiesce-time triage of requests whose outcome is already
+        fixed at death: mid-decode requests (tokens the client already
+        holds — replay would duplicate them) fail with ``fail_error``
+        NOW, before the multi-second rebuild/re-warm, so their clients
+        can retry immediately; finished-but-undrained requests deliver
+        their completed output.  Runs under the replica lock with the
+        step loop reaped; returns the failed count."""
+        failed = 0
+        async with rep.lock:
+            old = rep.engine
+            for seq in list(old._seqs.values()):  # noqa: SLF001
+                if not seq.is_finished and seq.num_output_tokens == 0:
+                    continue  # replay-safe: restart_replica re-queues it
+                old._seqs.pop(seq.request_id, None)  # noqa: SLF001
+                old.lora_manager.unpin(seq.lora_name)
+                queue = self._queues.get(seq.request_id)
+                if queue is None:
+                    continue
+                if seq.is_finished:
+                    # completed (e.g. scheduler-shed awaiting its
+                    # drain) exactly at death: deliver, don't retry
+                    queue.put_nowait(seq.to_request_output())
+                else:
+                    queue.put_nowait(fail_error)
+                    failed += 1
+        return failed
+
+    async def restart_replica(
+        self, rep: _Replica, new_engine: LLMEngine,
+        fail_error: BaseException,
+    ) -> tuple[int, int]:
+        """Swap a dead replica's engine for a freshly built one.
+
+        Called by the supervisor with the replica's step loop already
+        reaped.  Under the replica lock (serializing against concurrent
+        ``add_request``/``abort``), engine-resident requests are triaged:
+
+        * zero emitted tokens (scheduler-waiting, or mid-prefill) —
+          transparently re-queued into the new engine with their
+          original arrival time and deadline: the client's stream never
+          notices the restart;
+        * one or more emitted tokens (mid-decode) — failed with
+          ``fail_error`` (EngineRestartError → UNAVAILABLE +
+          Retry-After): replaying them would re-emit tokens the client
+          already holds.
+
+        Front-door-parked requests never reached the engine and simply
+        stay parked (the pump is paused during recovery).  Returns
+        ``(replayed, failed)`` counts.
+        """
+        from vllm_tgis_adapter_tpu.supervisor import failpoints
+
+        replayed = 0
+        fails: list[str] = []
+        async with rep.lock:
+            failpoints.fire("supervisor.replay")
+            old = rep.engine
+            # the adapter registry survives the restart (hot-loaded
+            # LoRAs stay served); pins held by the dead engine's
+            # sequences are released — replayed ones re-pin on re-add
+            new_engine.lora_manager = old.lora_manager
+            replays = []
+            for seq in list(old._seqs.values()):  # noqa: SLF001
+                old.lora_manager.unpin(seq.lora_name)
+                if seq.is_finished or seq.num_output_tokens > 0:
+                    # fail_unreplayable (quiesce triage) already
+                    # delivered/failed these under this same lock;
+                    # anything still here is a bug — fail it retryable
+                    # rather than replaying tokens the client holds
+                    fails.append(seq.request_id)
+                    continue
+                replays.append(seq)
+            rep.engine = new_engine
+            rep.in_flight_desc = None
+            if rep is self._replicas[0]:
+                # replica 0 doubles as the host-side singleton surface
+                self.engine = new_engine
+            for seq in replays:
+                if seq.request_id not in self._queues:
+                    continue  # consumer vanished while the engine was down
+                new_engine.add_request(
+                    seq.request_id,
+                    seq.prompt,
+                    seq.params,
+                    prompt_token_ids=list(seq.prompt_token_ids),
+                    arrival_time=seq.metrics.arrival_time,
+                    lora_name=seq.lora_name,
+                    trace_id=seq.trace_id,
+                    deadline=seq.deadline,
+                )
+                replayed += 1
+        failed = 0
+        for request_id in fails:
+            queue = self._queues.get(request_id)
+            if queue is not None:
+                queue.put_nowait(fail_error)
+                failed += 1
+        return replayed, failed

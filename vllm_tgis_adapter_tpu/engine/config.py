@@ -927,9 +927,26 @@ class EngineConfig:
     # that stops beating for this long gets a full diagnostic dump
     # (scheduler queues, KV stats, flight-recorder tail).  0 disables.
     watchdog_deadline_s: float = 120.0
+    # --watchdog-action: what a declared stall triggers beyond the
+    # diagnostic snapshot — 'snapshot' (PR-3 behavior: diagnose only) or
+    # 'restart' (hand the stalled replica to the engine supervisor; the
+    # snapshot is still written FIRST)
+    watchdog_action: str = "snapshot"
     # --dump-dir: directory for watchdog stall snapshots (JSON, one file
     # per stall); None keeps dumps in the log/termination-log only
     dump_dir: str | None = None
+    # engine supervision (supervisor/): > 0 enables supervised restart
+    # after engine death — quiesce, replay pre-prefill work, fail
+    # mid-decode retryable, rebuild with a fresh KV pool — allowing at
+    # most this many restarts inside engine_restart_window_s before the
+    # crash-loop circuit breaker escalates to clean process death.
+    # 0 keeps the pre-PR5 crash-fast semantics (the library default;
+    # the served binary defaults to 3 via --max-engine-restarts).
+    max_engine_restarts: int = 0
+    engine_restart_window_s: float = 300.0
+    # base of the exponential backoff between restart attempts
+    # (base * 2^(attempts_in_window - 1), capped at 30s)
+    engine_restart_backoff_s: float = 0.5
     speculative: "Optional[SpeculativeConfig]" = None
     # front door (frontdoor/): admission control, per-tenant fair
     # queuing, load shedding, graceful drain
@@ -938,6 +955,11 @@ class EngineConfig:
     )
 
     def __post_init__(self) -> None:
+        if self.watchdog_action not in ("snapshot", "restart"):
+            raise ValueError(
+                f"--watchdog-action must be 'snapshot' or 'restart' "
+                f"(got {self.watchdog_action!r})"
+            )
         if self.quantization not in (None, "int8", "awq", "gptq"):
             # truthful flags (VERDICT r2/r3): only the schemes that are
             # actually implemented may pass boot.  Reference maps these
@@ -1086,6 +1108,17 @@ class EngineConfig:
             watchdog_deadline_s=float(
                 getattr(args, "watchdog_deadline", 120.0) or 0.0
             ),
+            watchdog_action=getattr(args, "watchdog_action", "snapshot")
+            or "snapshot",
             dump_dir=getattr(args, "dump_dir", None),
+            max_engine_restarts=int(
+                getattr(args, "max_engine_restarts", 0) or 0
+            ),
+            engine_restart_window_s=float(
+                getattr(args, "engine_restart_window", 300.0) or 0.0
+            ),
+            engine_restart_backoff_s=float(
+                getattr(args, "engine_restart_backoff", 0.5) or 0.0
+            ),
             frontdoor=FrontdoorConfig.from_args(args),
         )

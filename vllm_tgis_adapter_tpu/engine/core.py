@@ -37,6 +37,7 @@ from vllm_tgis_adapter_tpu.flight_recorder import (
     DECODE_PROGRESS_EVERY,
     FlightRecorder,
 )
+from vllm_tgis_adapter_tpu.supervisor import failpoints
 from vllm_tgis_adapter_tpu.logging import init_logger
 
 logger = init_logger(__name__)
@@ -183,6 +184,10 @@ class LLMEngine:
         # events so "which wave was in flight" is answerable post-hoc
         self.step_counter = 0
         self._seqs: dict[str, Sequence] = {}
+        # explicit device slice (from_config sets it under dp/pp); the
+        # supervisor's rebuild reuses it so a replacement engine lands
+        # on the devices this replica owns
+        self._devices = None
         self._lora_tokenizers: dict[str, object] = {}
         # adapter registry consumed by the gRPC adapter store
         # (grpc/adapters.py) and by the runner's stacked device tensors
@@ -305,6 +310,10 @@ class LLMEngine:
         memory_device = devices[0] if devices else None
         engine = cls(config, model, params, tokenizer, mesh=mesh,
                      memory_device=memory_device, pp_devices=devices)
+        # remembered for supervised rebuild (supervisor/supervisor.py):
+        # a replacement engine must own the SAME device slice — under dp
+        # the other slices hold other replicas' weights and pools
+        engine._devices = devices
         if draft_model is not None:
             engine.runner.attach_speculative(draft_model, draft_params)
         return engine
@@ -716,6 +725,7 @@ class LLMEngine:
         and may be enqueued behind them, whereas a decode plan depends on
         the pending commit (tokens, page frees) and must wait.
         """
+        failpoints.fire("core.plan_step")
         outputs: list[RequestOutput] = []
         for seq in self.scheduler.newly_finished:
             self._seqs.pop(seq.request_id, None)
@@ -830,6 +840,7 @@ class LLMEngine:
         on results (JAX async dispatch).  Pair with ``wait_step``; the
         async engine plans and dispatches the NEXT step between the two,
         so host-side prep overlaps device execution."""
+        failpoints.fire("core.dispatch_step")  # worker thread: hang-capable
         if isinstance(plan, PackedPrefillPlan):
             return self.runner.dispatch_packed_prefill(prepared)
         if isinstance(plan, PrefillPlan):
@@ -839,6 +850,7 @@ class LLMEngine:
     def wait_step(self, plan, prepared, handle):
         """Phase 2b (lock-free, blocking): pull the dispatched step's
         results to host."""
+        failpoints.fire("core.wait_step")  # worker thread: hang-capable
         if isinstance(plan, PackedPrefillPlan):
             return self.runner.wait_packed_prefill(prepared, handle)
         if isinstance(plan, PrefillPlan):
@@ -885,6 +897,7 @@ class LLMEngine:
     def commit_step(self, plan, result, prepared=None) -> list[RequestOutput]:
         """Phase 3 (host, engine lock held): fold sampled tokens back into
         sequences; requests aborted mid-dispatch are skipped here."""
+        failpoints.fire("core.commit_step")
         t0 = getattr(prepared, "_obs_plan_t0", None)
         if t0 is not None:
             duration = time.perf_counter() - t0

@@ -22,6 +22,7 @@ from vllm_tgis_adapter_tpu.compile_tracker import track_jit
 from vllm_tgis_adapter_tpu.engine import sampler as sampler_mod
 from vllm_tgis_adapter_tpu.engine.sampler import TOPN_WIDTH, SamplingTensors
 from vllm_tgis_adapter_tpu.logging import init_logger
+from vllm_tgis_adapter_tpu.supervisor import failpoints
 
 if TYPE_CHECKING:
     from vllm_tgis_adapter_tpu.engine.config import EngineConfig
@@ -689,6 +690,7 @@ class ModelRunner:
         the device fed — while one dispatch executes, the next step is
         planned and enqueued (engine/async_llm.py step loop).
         """
+        failpoints.fire("runner.dispatch_prefill")
         t = prep.t
         lora_args = ()
         if self.lora_stacks is not None:
@@ -1194,6 +1196,7 @@ class ModelRunner:
         (propose → verify → accept) and cannot enqueue-only: it returns
         ``SYNC_DISPATCH`` and executes inside ``wait_decode`` instead.
         """
+        failpoints.fire("runner.dispatch_decode")
         if prep.spec_ok:
             return SYNC_DISPATCH
         lora = self.lora_stacks if prep.lora_idx is not None else None

@@ -27,6 +27,7 @@ from vllm_tgis_adapter_tpu.engine.config import CacheConfig, SchedulerConfig
 from vllm_tgis_adapter_tpu.engine.kv_cache import BlockAllocator, SequenceBlocks
 from vllm_tgis_adapter_tpu.engine.sequence import Sequence, SequenceStatus
 from vllm_tgis_adapter_tpu.logging import init_logger
+from vllm_tgis_adapter_tpu.supervisor import failpoints
 
 logger = init_logger(__name__)
 
@@ -244,6 +245,7 @@ class Scheduler:
         makes the loop drain the in-flight dispatch and run the decode,
         so heavy admission still cannot starve running sequences.
         """
+        failpoints.fire("scheduler.schedule")
         self._shed_expired()
         if self._last_was_prefill and self.running:
             if prefill_only:

@@ -62,6 +62,9 @@ EVENT_KINDS = (
     #                   ttl, draining — detail carries the reason)
     "error",          # engine step loop died
     "stall",          # watchdog fired (recorded so dumps self-locate)
+    "restart",        # supervised engine restart completed
+    #                   (supervisor/: detail carries cause, attempt,
+    #                   replayed/failed counts, recovery seconds)
 )
 
 # Per-request decode events are recorded every N committed tokens — one

@@ -116,6 +116,10 @@ class FrontDoor:
         # exactly the shutdown-right-after-wake interleaving
         self._stop = False
         self.draining = False
+        # supervised recovery (supervisor/): paused means "hold, don't
+        # shed" — new arrivals park, parked entries are not granted,
+        # nothing is failed.  Distinct from draining, which refuses.
+        self.paused = False
         self._drain_listeners: list[Callable[[], None]] = []
         self._tenant_labels: set[str] = set()
 
@@ -192,8 +196,14 @@ class FrontDoor:
 
         self._note_tenant_tokens(tenant, tokens)
         # fast path: nothing queued ahead and the engine has room — no
-        # pump round-trip, same latency as the pre-frontdoor hand-off
-        if len(self._wfq) == 0 and self._room_fn(self._pending_grants):
+        # pump round-trip, same latency as the pre-frontdoor hand-off.
+        # Paused (engine recovery in flight) always parks: the request
+        # must not reach add_request until the rebuilt engine is in.
+        if (
+            not self.paused
+            and len(self._wfq) == 0
+            and self._room_fn(self._pending_grants)
+        ):
             self._pending_grants += 1
             self.admitted_total += 1
             return
@@ -276,7 +286,11 @@ class FrontDoor:
                 return
             self._wake.clear()
             self._expire_ttls()
-            while len(self._wfq) and self._room_fn(self._pending_grants):
+            while (
+                not self.paused
+                and len(self._wfq)
+                and self._room_fn(self._pending_grants)
+            ):
                 entry = self._wfq.pop()
                 if entry is None:
                     break
@@ -312,6 +326,25 @@ class FrontDoor:
         or aborted): re-check the admission window."""
         if len(self._wfq):
             self._wake.set()
+
+    # ---------------------------------------------------------------- pause
+
+    def pause(self) -> None:
+        """Supervised engine recovery: hold all admission WITHOUT
+        shedding — new arrivals park in the fair queue, parked entries
+        keep their place, and nothing is granted until ``resume()``.
+        Bounds and TTLs stay live (a full queue still sheds honestly;
+        a deadline that expires while the engine rebuilds still expires).
+        Idempotent."""
+        self.paused = True
+
+    def resume(self) -> None:
+        """Recovery finished: grant again, oldest virtual-time first."""
+        if not self.paused:
+            return
+        self.paused = False
+        self._ensure_pump()
+        self._wake.set()
 
     # ------------------------------------------------------------ estimator
 
@@ -540,6 +573,7 @@ class FrontDoor:
         now = time.time()
         return {
             "draining": self.draining,
+            "paused": self.paused,
             "parked": len(entries),
             "pending_grants": self._pending_grants,
             "admitted_total": self.admitted_total,

@@ -89,6 +89,18 @@ class DrainCoordinator:
             self.shutdown_event.set()
             return
         self.started = True
+        # lifecycle alignment (supervisor/lifecycle.py): healthcheck /
+        # debug surfaces read 'draining' from the same state machine the
+        # supervisor drives; don't clobber a terminal 'dead'
+        from vllm_tgis_adapter_tpu.supervisor.lifecycle import (
+            LIFECYCLE_DEAD,
+            LIFECYCLE_DRAINING,
+        )
+
+        if getattr(self.engine, "lifecycle", None) not in (
+            None, LIFECYCLE_DEAD,
+        ):
+            self.engine.lifecycle = LIFECYCLE_DRAINING
         frontdoor = getattr(self.engine, "frontdoor", None)
         if frontdoor is None:
             # --disable-frontdoor: with no admission gate there is

@@ -58,6 +58,23 @@ class AdmissionShedError(RuntimeError):
         self.tenant = tenant
 
 
+class EngineRestartError(RuntimeError):
+    """The engine died mid-request and is being rebuilt by the
+    supervisor (supervisor/supervisor.py).
+
+    Raised to requests that had already emitted tokens when the engine
+    died (replaying them would duplicate output) and to new arrivals
+    while recovery is in progress with the front door disabled.  Always
+    retryable: the pod expects to be SERVING again within
+    ``retry_after_s`` — the wire mapping is UNAVAILABLE / 503 with a
+    Retry-After hint, unlike terminal engine death (INTERNAL / 500).
+    """
+
+    def __init__(self, message: str, *, retry_after_s: Optional[float] = None):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+
 class CapacityError(RuntimeError):
     """Base for engine-side resource exhaustion (not a client error)."""
 
@@ -102,7 +119,7 @@ def wrap_engine_error(exc: BaseException) -> BaseException:
     the original chained as ``__cause__``.  Anything else is returned
     as-is (and will map to INTERNAL/500 downstream).
     """
-    if isinstance(exc, (AdmissionShedError, CapacityError)):
+    if isinstance(exc, (AdmissionShedError, CapacityError, EngineRestartError)):
         return exc
     if isinstance(exc, _NEVER_WRAP):
         return exc
@@ -161,6 +178,15 @@ def classify(exc: BaseException) -> Optional[ErrorDisposition]:
             grpc_code=code,
             http_status=status,
             err_type=err_type,
+            retry_after_s=exc.retry_after_s,
+        )
+    if isinstance(exc, EngineRestartError):
+        # supervised restart in progress: the pod itself will be back —
+        # retry HERE after the hint, unlike terminal engine death
+        return ErrorDisposition(
+            grpc_code="UNAVAILABLE",
+            http_status=503,
+            err_type="service_unavailable",
             retry_after_s=exc.retry_after_s,
         )
     if isinstance(exc, (KVPoolExhaustedError, DeviceOOMError)):

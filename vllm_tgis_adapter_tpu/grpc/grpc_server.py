@@ -143,12 +143,18 @@ class TextGenerationService(rpc.GenerationServiceServicer):
         re-raises as INTERNAL via grpc.aio's default path.  AbortError
         means we already set a status — pass it through silently.
         """
-        if self.engine.errored and not self.engine.is_running:
+        from vllm_tgis_adapter_tpu.supervisor.lifecycle import engine_is_dead
+
+        if engine_is_dead(self.engine):
+            # TERMINALLY dead only — a supervised restart in progress
+            # (lifecycle 'recovering') must not tear the server down;
+            # the engine comes back and the server keeps serving
             self.stop_event.set()
         if isinstance(exc, aio.AbortError):
             raise exc
         from vllm_tgis_adapter_tpu.frontdoor.errors import (
             AdmissionShedError,
+            EngineRestartError,
             classify,
         )
 
@@ -159,6 +165,12 @@ class TextGenerationService(rpc.GenerationServiceServicer):
                 logger.warning(
                     "%s shed by admission control (%s): %s",
                     rpc_name, exc.reason, exc,
+                )
+            elif isinstance(exc, EngineRestartError):
+                # supervised restart: retryable by design, not an
+                # exception-worthy surprise
+                logger.warning(
+                    "%s interrupted by engine restart: %s", rpc_name, exc
                 )
             else:
                 logger.exception(
@@ -637,6 +649,31 @@ async def start_grpc_server(
             health_servicer.set(service.SERVICE_NAME, health.DRAINING)
 
         frontdoor.add_drain_listener(_flip_health_draining)
+
+    # engine supervision (supervisor/): health mirrors the lifecycle
+    # state machine — NOT_SERVING while a supervised restart rebuilds
+    # the engine (or after terminal death), back to SERVING once the
+    # restarted engine is re-armed.  Draining wins: a recovery that
+    # completes mid-drain must not advertise SERVING on a pod that is
+    # about to exit.
+    supervisor = getattr(engine, "supervisor", None)
+    if supervisor is not None:
+        from vllm_tgis_adapter_tpu.supervisor.lifecycle import (
+            LIFECYCLE_SERVING,
+        )
+
+        def _flip_health_lifecycle(state: str) -> None:
+            if frontdoor is not None and frontdoor.draining:
+                return
+            status = (
+                HealthCheckResponse.SERVING
+                if state == LIFECYCLE_SERVING
+                else HealthCheckResponse.NOT_SERVING
+            )
+            health_servicer.set("", status)
+            health_servicer.set(service.SERVICE_NAME, status)
+
+        supervisor.add_listener(_flip_health_lifecycle)
 
     # debug service: on-demand profiler capture sharing the HTTP routes'
     # controller (profiler.py get_controller), plus DumpState /

@@ -17,24 +17,42 @@ DEFAULT_TARGET = "localhost:8033"
 DEFAULT_SERVICE = "fmaas.GenerationService"  # TextGenerationService.SERVICE_NAME
 
 
-def probe(target: str, service: str, timeout: float, secure: bool) -> int:
-    """Run one Health/Check round trip; return a process exit code.
+def exit_code_for(status: int) -> int:
+    """Map one Health/Check status onto the probe's exit-code contract.
 
-    0 = SERVING; 2 = DRAINING (the server is healthy but refusing new
-    work while in-flight requests finish — orchestrators must stop
-    routing, and a readiness exec probe using this CLI goes unready
-    before the pod dies); 1 = anything else.
+    Aligned with the engine lifecycle states (supervisor/lifecycle.py):
+
+    * 0 — SERVING;
+    * 2 — DRAINING (healthy, refusing new work while in-flight requests
+      finish; a readiness exec probe goes unready before the pod dies);
+    * 3 — NOT_SERVING (engine dead or a supervised restart is rebuilding
+      it; readiness should fail but liveness should NOT kill the pod —
+      the in-process supervisor is already handling the recovery);
+    * 1 — anything else (unknown service, transport failure, ...).
     """
+    from vllm_tgis_adapter_tpu.grpc.health import DRAINING
+    from vllm_tgis_adapter_tpu.grpc.pb.health_pb2 import HealthCheckResponse
+
+    if status == HealthCheckResponse.SERVING:
+        return 0
+    if status == DRAINING:
+        return 2
+    if status == HealthCheckResponse.NOT_SERVING:
+        return 3
+    return 1
+
+
+def probe(target: str, service: str, timeout: float, secure: bool) -> int:
+    """Run one Health/Check round trip; return a process exit code
+    (``exit_code_for``), printing the reported state."""
     import grpc
 
     from vllm_tgis_adapter_tpu.grpc.health import (
-        DRAINING,
         HealthStub,
         status_name,
     )
     from vllm_tgis_adapter_tpu.grpc.pb.health_pb2 import (
         HealthCheckRequest,
-        HealthCheckResponse,
     )
 
     print("health check...", end="")
@@ -56,9 +74,7 @@ def probe(target: str, service: str, timeout: float, secure: bool) -> int:
     # name the status ourselves: DRAINING is an open-enum extension the
     # generated message may not know how to print
     print(f"status: {status_name(reply.status)}")
-    if reply.status == DRAINING:
-        return 2
-    return 0 if reply.status == HealthCheckResponse.SERVING else 1
+    return exit_code_for(reply.status)
 
 
 def _build_parser() -> argparse.ArgumentParser:

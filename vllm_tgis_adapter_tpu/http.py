@@ -29,6 +29,7 @@ from vllm_tgis_adapter_tpu.engine.sampling_params import (
 from vllm_tgis_adapter_tpu.frontdoor.errors import (
     AdmissionShedError,
     CapacityError,
+    EngineRestartError,
 )
 from vllm_tgis_adapter_tpu.logging import init_logger
 from vllm_tgis_adapter_tpu.tgis_utils import logs
@@ -263,6 +264,11 @@ def build_http_server(args: "argparse.Namespace", engine: "AsyncLLMEngine") -> A
 
 
 async def _health(app: App, request: HttpRequest) -> HttpResponse:
+    from vllm_tgis_adapter_tpu.supervisor.lifecycle import (
+        LIFECYCLE_RECOVERING,
+        engine_lifecycle,
+    )
+
     engine: AsyncLLMEngine = app.state["engine"]
     frontdoor = getattr(engine, "frontdoor", None)
     if frontdoor is not None and frontdoor.draining:
@@ -272,6 +278,14 @@ async def _health(app: App, request: HttpRequest) -> HttpResponse:
         return error_response(
             503, "server is draining", "service_unavailable"
         )
+    if engine_lifecycle(engine) == LIFECYCLE_RECOVERING:
+        # supervised restart in flight (supervisor/): 503 + Retry-After
+        # through the SAME classify mapping every other restart surface
+        # uses, mirroring the gRPC health NOT_SERVING flip
+        return _shed_response(EngineRestartError(
+            "engine is restarting after a fault; retry shortly",
+            retry_after_s=2.0,
+        ))
     try:
         await engine.check_health()
     except Exception as e:  # noqa: BLE001 — cancellation must propagate
@@ -507,7 +521,7 @@ async def _stream_head(merged):  # noqa: ANN001, ANN202
         return await merged.__anext__(), None
     except StopAsyncIteration:
         return None, None
-    except (AdmissionShedError, CapacityError) as e:
+    except (AdmissionShedError, CapacityError, EngineRestartError) as e:
         return None, _shed_response(e)
     except ValueError as e:
         return None, error_response(400, str(e))
@@ -611,7 +625,7 @@ async def _completions(app: App, request: HttpRequest):  # noqa: ANN201, C901, P
     try:
         async for i, res in merged:
             results[i] = res
-    except (AdmissionShedError, CapacityError) as e:
+    except (AdmissionShedError, CapacityError, EngineRestartError) as e:
         # overload: 429 + Retry-After (shed) or 503 (exhaustion); any
         # sibling streams already admitted are reaped on cancellation
         return _shed_response(e)
@@ -804,7 +818,7 @@ async def _chat_completions(app: App, request: HttpRequest):  # noqa: ANN201, C9
     try:
         async for k, res in merged:
             finals[k] = res
-    except (AdmissionShedError, CapacityError) as e:
+    except (AdmissionShedError, CapacityError, EngineRestartError) as e:
         return _shed_response(e)
     except ValueError as e:
         return error_response(400, str(e))

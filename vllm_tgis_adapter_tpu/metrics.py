@@ -300,6 +300,31 @@ watchdog_stalls_total = _get_or_create(
 )
 
 
+# ---- engine supervision (supervisor/): supervised restart after engine
+# death, with pre-prefill request replay (docs/RECOVERY.md)
+engine_restarts_total = _get_or_create(
+    Counter,
+    f"{_PREFIX}_engine_restarts_total",
+    "Supervised engine restarts, by death cause (step_loop, oom, stall, "
+    "recovery_failure)",
+    labelnames=("cause",),
+)
+requests_replayed_total = _get_or_create(
+    Counter,
+    f"{_PREFIX}_requests_replayed_total",
+    "Requests transparently re-queued into a rebuilt engine after a "
+    "supervised restart (pre-prefill work only: zero tokens had been "
+    "emitted, so replay cannot duplicate output)",
+)
+recovery_seconds = _get_or_create(
+    Histogram,
+    f"{_PREFIX}_recovery_seconds",
+    "Wall time of one supervised engine recovery: quiesce, triage, "
+    "rebuild (incl. precompile re-warm), replay, re-arm",
+    buckets=(0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0),
+)
+
+
 # ---- front door (frontdoor/): admission control, per-tenant fair
 # queuing, load shedding (docs/FRONTDOOR.md).  Queue depth/age cover
 # the fair queue in FRONT of the engines (the scheduler's own waiting

@@ -1,0 +1,54 @@
+"""Engine supervision: self-healing restart + deterministic fault injection.
+
+The package splits into three deliberately decoupled modules:
+
+* ``lifecycle`` — the engine lifecycle state constants (SERVING /
+  RECOVERING / DRAINING / DEAD) and the helpers every health surface
+  (gRPC health, HTTP ``/health``, ``grpc_healthcheck``) shares, so the
+  surfaces can never disagree about what a state means;
+* ``failpoints`` — a zero-cost-when-unarmed fault-injection registry
+  (``--failpoints`` / ``TGIS_TPU_FAILPOINTS``) with named sites across
+  the engine core, runner, and scheduler, so every recovery path is
+  exercised deterministically in CI (``nox -s chaos_check``);
+* ``supervisor`` — the :class:`EngineSupervisor` that turns engine death
+  into quiesce → triage (replay vs. retryable-fail) → rebuild → re-arm,
+  with exponential backoff and a crash-loop circuit breaker.
+
+This ``__init__`` stays import-light on purpose: the engine core imports
+``supervisor.failpoints`` on its hot path, and that must not drag the
+supervisor's own (engine-importing) module into every process.
+"""
+
+from __future__ import annotations
+
+from vllm_tgis_adapter_tpu.supervisor.lifecycle import (  # noqa: F401
+    LIFECYCLE_DEAD,
+    LIFECYCLE_DRAINING,
+    LIFECYCLE_RECOVERING,
+    LIFECYCLE_SERVING,
+    engine_is_dead,
+    engine_lifecycle,
+)
+
+__all__ = [
+    "LIFECYCLE_DEAD",
+    "LIFECYCLE_DRAINING",
+    "LIFECYCLE_RECOVERING",
+    "LIFECYCLE_SERVING",
+    "EngineSupervisor",
+    "engine_is_dead",
+    "engine_lifecycle",
+]
+
+
+def __getattr__(name: str):  # noqa: ANN202 — lazy re-export
+    # EngineSupervisor imports engine modules; loading it eagerly here
+    # would make `import supervisor.failpoints` (engine core hot path)
+    # transitively import the whole engine stack
+    if name == "EngineSupervisor":
+        from vllm_tgis_adapter_tpu.supervisor.supervisor import (
+            EngineSupervisor,
+        )
+
+        return EngineSupervisor
+    raise AttributeError(name)

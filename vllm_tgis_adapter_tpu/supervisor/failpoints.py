@@ -1,0 +1,258 @@
+"""Deterministic fault injection (failpoints) for the serving stack.
+
+Chaos testing a TPU engine by hoping for real XLA OOMs is not a test
+plan.  This module plants named *sites* across the engine core, runner,
+and scheduler (``failpoints.fire("core.plan_step")`` at the top of the
+host phases) that do nothing until armed — one module-global boolean
+check, no allocation, no lock — and, when armed via ``--failpoints`` or
+``TGIS_TPU_FAILPOINTS``, inject a chosen failure a chosen number of
+times.  Every supervisor recovery path (docs/RECOVERY.md) is exercised
+this way in CI (``nox -s chaos_check``).
+
+Spec grammar (comma-separated)::
+
+    site=action[:count]
+
+    core.plan_step=raise            # one injected step-loop exception
+    core.wait_step=oom:2            # two XLA-OOM-shaped failures
+    core.wait_step=hang             # one stuck dispatch (release() frees it)
+    scheduler.schedule=raise:forever  # crash-loop until disarmed
+
+Actions:
+
+* ``raise`` — ``FailpointError`` (a plain RuntimeError subclass): the
+  generic step-loop crash.
+* ``oom`` — RuntimeError whose text matches the XLA OOM markers in
+  ``frontdoor/errors.py``, so the death classifies as ``DeviceOOMError``
+  exactly like a real HBM exhaustion.
+* ``hang`` — blocks the calling thread on a permit until ``release()``
+  / ``disarm()`` (bounded by ``HANG_MAX_S`` so an abandoned failpoint
+  cannot wedge a test runner forever); permits bank, so a release that
+  races ahead of the fire still frees it, and a multi-count hang parks
+  on every fire.  Only allowed at sites that run in worker threads
+  (``HANG_SITES``); it simulates the stuck device dispatch the stall
+  watchdog exists for.
+
+Sites (kept in one tuple so docs and tests can enumerate them):
+see ``KNOWN_SITES``.
+
+Thread-safety: sites fire from the event loop AND from ``to_thread``
+workers; the count bookkeeping takes a lock, but only once armed.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from vllm_tgis_adapter_tpu.logging import init_logger
+
+logger = init_logger(__name__)
+
+ENV_VAR = "TGIS_TPU_FAILPOINTS"
+
+#: Upper bound on a ``hang`` action: a forgotten release must not block
+#: a worker thread (and therefore interpreter shutdown) forever.
+HANG_MAX_S = 120.0
+
+#: Forever sentinel for the count field.
+FOREVER = -1
+
+ACTIONS = ("raise", "oom", "hang")
+
+#: Named sites planted in the stack (documented in docs/RECOVERY.md).
+#: Arming an unknown site is an error — a typo'd chaos spec that never
+#: fires must fail loudly, not pass silently.
+KNOWN_SITES = (
+    "core.plan_step",       # host planning phase (engine lock held)
+    "core.dispatch_step",   # device enqueue (worker thread; hang-capable)
+    "core.wait_step",       # device result pull (worker thread; hang-capable)
+    "core.commit_step",     # host commit phase (engine lock held)
+    "scheduler.schedule",   # scheduler planning inside plan_step
+    "runner.dispatch_decode",   # decode dispatch inside the runner
+    "runner.dispatch_prefill",  # prefill dispatch inside the runner
+    "supervisor.rebuild",   # engine rebuild — death DURING recovery
+    "supervisor.replay",    # request replay — death during replay
+)
+
+#: Sites that run in worker threads (asyncio.to_thread) — the only
+#: places a ``hang`` is allowed: parking the event-loop thread itself
+#: would freeze the watchdog, the servers, and release()'s caller —
+#: the exact machinery a hang exists to exercise.
+HANG_SITES = frozenset((
+    "core.dispatch_step",
+    "core.wait_step",
+    "supervisor.rebuild",
+))
+
+
+class FailpointError(RuntimeError):
+    """The generic injected failure (``raise`` action)."""
+
+
+class _Failpoint:
+    __slots__ = ("site", "action", "remaining", "fired", "hang_sem")
+
+    def __init__(self, site: str, action: str, count: int):
+        self.site = site
+        self.action = action
+        self.remaining = count
+        self.fired = 0
+        # hang is permit-based (not an event): every fire consumes one
+        # permit, every release() grants one — so a multi-count hang
+        # re-hangs on each fire, AND a release that lands before the
+        # fire is banked rather than lost (both orders are races real
+        # tests hit)
+        self.hang_sem = (
+            threading.Semaphore(0) if action == "hang" else None
+        )
+
+
+_lock = threading.Lock()
+_points: dict[str, _Failpoint] = {}
+# the zero-cost gate: fire() reads this one module global and returns;
+# nothing else happens until a spec is armed
+_armed = False
+
+
+def parse_spec(spec: str) -> list[tuple[str, str, int]]:
+    """``"a=raise,b=oom:2"`` → ``[("a","raise",1),("b","oom",2)]``."""
+    out: list[tuple[str, str, int]] = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        site, sep, rest = part.partition("=")
+        site = site.strip()
+        if not sep or not site or not rest:
+            raise ValueError(
+                f"failpoint entry {part!r} is not site=action[:count]"
+            )
+        action, _, count_s = rest.partition(":")
+        action = action.strip()
+        if action not in ACTIONS:
+            raise ValueError(
+                f"failpoint action {action!r} for site {site!r}; "
+                f"supported: {', '.join(ACTIONS)}"
+            )
+        if site not in KNOWN_SITES:
+            raise ValueError(
+                f"unknown failpoint site {site!r}; known sites: "
+                + ", ".join(KNOWN_SITES)
+            )
+        if action == "hang" and site not in HANG_SITES:
+            raise ValueError(
+                f"failpoint site {site!r} runs on the event loop; "
+                "'hang' is only allowed at worker-thread sites: "
+                + ", ".join(sorted(HANG_SITES))
+            )
+        count = 1
+        if count_s:
+            count_s = count_s.strip()
+            count = FOREVER if count_s == "forever" else int(count_s)
+            if count != FOREVER and count < 1:
+                raise ValueError(
+                    f"failpoint count for {site!r} must be >= 1 or "
+                    f"'forever' (got {count_s!r})"
+                )
+        out.append((site, action, count))
+    return out
+
+
+def arm(spec: str) -> None:
+    """Arm every ``site=action[:count]`` entry in ``spec``."""
+    for site, action, count in parse_spec(spec):
+        arm_site(site, action, count)
+
+
+def arm_site(site: str, action: str, count: int = 1) -> None:
+    global _armed
+    if site not in KNOWN_SITES:
+        raise ValueError(f"unknown failpoint site {site!r}")
+    if action not in ACTIONS:
+        raise ValueError(f"unknown failpoint action {action!r}")
+    if action == "hang" and site not in HANG_SITES:
+        raise ValueError(
+            f"failpoint site {site!r} runs on the event loop; 'hang' is "
+            f"only allowed at worker-thread sites: "
+            + ", ".join(sorted(HANG_SITES))
+        )
+    with _lock:
+        _points[site] = _Failpoint(site, action, count)
+        _armed = True
+    logger.warning(
+        "failpoint armed: %s=%s (count=%s) — deliberate fault injection "
+        "is ON", site, action, "forever" if count == FOREVER else count,
+    )
+
+
+def disarm(site: Optional[str] = None) -> None:
+    """Disarm one site (or all); any thread parked on a ``hang`` is
+    released."""
+    global _armed
+    with _lock:
+        targets = [site] if site is not None else list(_points)
+        for name in targets:
+            point = _points.pop(name, None)
+            if point is not None and point.hang_sem is not None:
+                # free every thread that could ever park here
+                point.hang_sem.release(64)
+        _armed = bool(_points)
+
+
+def release(site: str) -> None:
+    """Grant one hang permit: frees one parked thread, or lets the next
+    fire pass straight through if none is parked yet (the release may
+    race ahead of the fire).  Does not disarm the site."""
+    with _lock:
+        point = _points.get(site)
+    if point is not None and point.hang_sem is not None:
+        point.hang_sem.release()
+
+
+def is_armed(site: Optional[str] = None) -> bool:
+    if site is None:
+        return _armed
+    with _lock:
+        return site in _points
+
+
+def fired(site: str) -> int:
+    """How many times a site has injected (0 when never armed)."""
+    with _lock:
+        point = _points.get(site)
+        return point.fired if point is not None else 0
+
+
+def fire(site: str) -> None:
+    """The site hook: no-op unless this exact site is armed.
+
+    Called on engine hot paths — the unarmed fast path is a single
+    module-global read.
+    """
+    if not _armed:
+        return
+    with _lock:
+        point = _points.get(site)
+        if point is None or point.remaining == 0:
+            return
+        if point.remaining != FOREVER:
+            point.remaining -= 1
+        point.fired += 1
+        action = point.action
+        hang_sem = point.hang_sem
+    logger.warning("failpoint firing: %s=%s", site, action)
+    if action == "raise":
+        raise FailpointError(f"failpoint {site!r} injected failure")
+    if action == "oom":
+        # matches frontdoor.errors._OOM_MARKERS so the death boundary
+        # classifies it exactly like a real XLA allocation failure
+        raise RuntimeError(
+            f"RESOURCE_EXHAUSTED: failpoint {site!r} injected out of "
+            "memory: failed to allocate 16.00GiB"
+        )
+    # hang: park the calling (worker) thread like a stuck device
+    # dispatch; never the event loop — hang-capable sites run in
+    # asyncio.to_thread by construction (core.dispatch_step/wait_step)
+    assert hang_sem is not None
+    hang_sem.acquire(timeout=HANG_MAX_S)

@@ -1,0 +1,63 @@
+"""Engine lifecycle states shared by every health surface.
+
+Before PR 5 the serving layer inferred engine health from two booleans
+(``errored`` / ``is_running``), which cannot express "the engine died
+but the supervisor is rebuilding it".  These constants are the single
+vocabulary; ``AsyncLLMEngine.lifecycle`` carries the current value and
+the gRPC health servicer, HTTP ``/health``, ``grpc_healthcheck``, and
+the server shutdown logic all read it through the helpers below.
+
+State machine (docs/RECOVERY.md):
+
+    serving ──engine death──▶ recovering ──rebuilt + replayed──▶ serving
+       │                          │
+       │ SIGTERM                  │ circuit breaker
+       ▼                          ▼   (N restarts in W seconds)
+    draining                     dead  (process exits)
+
+This module is dependency-free on purpose: it is imported by the engine,
+both servers, and the standalone healthcheck CLI.
+"""
+
+from __future__ import annotations
+
+LIFECYCLE_SERVING = "serving"
+#: The engine died and the supervisor is rebuilding it: health reports
+#: NOT_SERVING, admission is paused (parked requests wait), pre-prefill
+#: requests will be replayed, mid-decode requests fail retryable.
+LIFECYCLE_RECOVERING = "recovering"
+#: SIGTERM drain (frontdoor/drain.py): healthy, refusing new work.
+LIFECYCLE_DRAINING = "draining"
+#: Terminal: no supervisor, or the crash-loop circuit breaker tripped.
+LIFECYCLE_DEAD = "dead"
+
+LIFECYCLES = (
+    LIFECYCLE_SERVING,
+    LIFECYCLE_RECOVERING,
+    LIFECYCLE_DRAINING,
+    LIFECYCLE_DEAD,
+)
+
+
+def engine_lifecycle(engine) -> str:  # noqa: ANN001 — any engine-like object
+    """Current lifecycle of an engine-like object.
+
+    Falls back to the pre-PR5 boolean derivation for objects that do not
+    carry a ``lifecycle`` attribute (test fakes, foreign engines): an
+    errored engine whose loops are gone is dead, everything else serves.
+    """
+    lifecycle = getattr(engine, "lifecycle", None)
+    if lifecycle is not None:
+        return lifecycle
+    if getattr(engine, "errored", False) and not getattr(
+        engine, "is_running", True
+    ):
+        return LIFECYCLE_DEAD
+    return LIFECYCLE_SERVING
+
+
+def engine_is_dead(engine) -> bool:  # noqa: ANN001
+    """Terminally dead — serving this process is over (the pre-PR5
+    ``errored and not is_running`` check, now lifecycle-aware so a
+    supervised restart in progress does NOT read as death)."""
+    return engine_lifecycle(engine) == LIFECYCLE_DEAD

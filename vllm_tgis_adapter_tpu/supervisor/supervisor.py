@@ -1,0 +1,462 @@
+"""The engine supervisor: engine death → quiesce → triage → rebuild → re-arm.
+
+Before PR 5 any engine death — step-loop exception, XLA OOM, Mosaic
+kernel failure, watchdog-declared stall — was terminal: the error
+propagated out of ``__main__.run`` and killed the whole pod, dropping
+every queued request.  The reference serving stack survives engine
+faults through *process* supervision (systemd/k8s restart the pod); a
+TPU-native single-process design restarts in-process instead, which is
+both faster (weights stay resident — only the KV pool, scheduler, and
+compiled programs are rebuilt) and lossless for work that never reached
+the device.
+
+One recovery (``docs/RECOVERY.md``; every step failpoint-tested in
+``tests/test_supervisor.py``):
+
+1. **quiesce** — lifecycle → ``recovering`` (health NOT_SERVING), front
+   door paused (parked requests HOLD, nothing sheds), the dead replica's
+   step-loop task reaped;
+2. **triage** — engine-resident requests split by whether replay is
+   safe: zero emitted tokens (waiting, or mid-prefill) → captured for
+   replay; one or more emitted tokens (mid-decode) → failed with
+   ``EngineRestartError`` (UNAVAILABLE + Retry-After — the client
+   retries, this pod included);
+3. **rebuild** — a fresh ``LLMEngine`` over the SAME weights/tokenizer/
+   device slice (no checkpoint reload): new KV pool, new scheduler, new
+   jitted programs, ``precompile()`` re-warm when the boot warmed;
+4. **replay + re-arm** — captured requests re-enter the new engine with
+   their original arrival times and deadlines, the step loop restarts,
+   the front door resumes, lifecycle → ``serving``.
+
+Exponential backoff separates attempts; a crash-loop circuit breaker
+(``--max-engine-restarts`` within ``--engine-restart-window``) escalates
+to clean process death with the full restart history in the termination
+log — a pod that cannot hold an engine up must say so and die, not
+flap forever.
+
+Under ``--data-parallel-size N`` only the dead replica is rebuilt;
+healthy replicas keep serving their in-flight work throughout (one
+replica's fault must not take down the fleet's queue).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import time
+from typing import TYPE_CHECKING, Callable, Optional
+
+from vllm_tgis_adapter_tpu import metrics
+from vllm_tgis_adapter_tpu.frontdoor.errors import (
+    DeviceOOMError,
+    EngineRestartError,
+    wrap_engine_error,
+)
+from vllm_tgis_adapter_tpu.logging import init_logger
+from vllm_tgis_adapter_tpu.supervisor import failpoints
+from vllm_tgis_adapter_tpu.supervisor.lifecycle import (
+    LIFECYCLE_DEAD,
+    LIFECYCLE_DRAINING,
+    LIFECYCLE_RECOVERING,
+    LIFECYCLE_SERVING,
+)
+from vllm_tgis_adapter_tpu.utils import write_termination_log
+
+if TYPE_CHECKING:
+    from vllm_tgis_adapter_tpu.engine.async_llm import AsyncLLMEngine, _Replica
+    from vllm_tgis_adapter_tpu.engine.core import LLMEngine
+
+logger = init_logger(__name__)
+
+BACKOFF_MAX_S = 30.0
+
+# death causes (the engine_restarts_total{cause} label values)
+CAUSE_STEP_LOOP = "step_loop"
+CAUSE_OOM = "oom"
+CAUSE_STALL = "stall"
+CAUSE_RECOVERY_FAILURE = "recovery_failure"
+
+
+def classify_cause(err: BaseException) -> str:
+    """Death-cause label for one wrapped engine error."""
+    return CAUSE_OOM if isinstance(err, DeviceOOMError) else CAUSE_STEP_LOOP
+
+
+class EngineSupervisor:
+    """Owns the restart lifecycle of one ``AsyncLLMEngine``'s replicas.
+
+    Constructed by ``AsyncLLMEngine.__init__`` when
+    ``config.max_engine_restarts > 0``; the step loops report deaths via
+    ``notify_death`` and the watchdog requests stall restarts via
+    ``request_restart`` — both are synchronous and safe to call from any
+    event-loop context (the actual recovery runs as its own task).
+    """
+
+    def __init__(
+        self,
+        engine: "AsyncLLMEngine",
+        *,
+        max_restarts: int,
+        window_s: float,
+        backoff_base_s: float = 0.5,
+        termination_log: Optional[str] = None,
+    ):
+        self.engine = engine
+        self.max_restarts = max(1, max_restarts)
+        self.window_s = max(1.0, window_s)
+        self.backoff_base_s = max(0.0, backoff_base_s)
+        self._termination_log = termination_log or os.getenv(
+            "TERMINATION_LOG_DIR", "/dev/termination-log"
+        )
+        #: One dict per completed or failed restart attempt — the
+        #: termination-log checkpoint and /debug/state both render this.
+        self.restart_history: list[dict] = []
+        # monotonic stamps of attempts, for the sliding-window breaker
+        self._attempt_times: list[float] = []
+        self._pending: list[tuple["_Replica", BaseException, str]] = []
+        self._pending_reps: set[int] = set()
+        self._task: Optional[asyncio.Task] = None
+        self._listeners: list[Callable[[str], None]] = []
+        self._stopping = False
+
+    # ------------------------------------------------------------ reporting
+
+    def add_listener(self, listener: Callable[[str], None]) -> None:
+        """Lifecycle-transition hook (called with the new state); the
+        gRPC server registers one to flip health SERVING ↔ NOT_SERVING."""
+        self._listeners.append(listener)
+
+    def _set_lifecycle(self, state: str) -> None:
+        self.engine.lifecycle = state
+        for listener in self._listeners:
+            try:
+                listener(state)
+            except Exception:  # noqa: BLE001 — one listener must not stall recovery
+                logger.exception("supervisor lifecycle listener failed")
+
+    def debug_state(self) -> dict:
+        """Supervisor section of the /debug/state snapshot."""
+        return {
+            "max_restarts": self.max_restarts,
+            "window_s": self.window_s,
+            "restarts": len(
+                [h for h in self.restart_history if h.get("recovered")]
+            ),
+            "attempts": len(self.restart_history),
+            "recovering": bool(self._pending)
+            or (self._task is not None and not self._task.done()),
+            "history": self.restart_history[-8:],
+        }
+
+    def history_lines(self) -> list[str]:
+        """Human-readable restart history (termination log, escalation
+        error message)."""
+        lines = []
+        for h in self.restart_history:
+            outcome = (
+                f"recovered in {h['recovery_s']:.2f}s "
+                f"(replayed={h['replayed']}, failed={h['failed']})"
+                if h.get("recovered")
+                else f"recovery FAILED: {h.get('error', '?')}"
+            )
+            lines.append(
+                f"  #{h['attempt']} at {h['at']} replica={h['replica']} "
+                f"cause={h['cause']} [{h.get('death', '?')}] {outcome}"
+            )
+        return lines
+
+    # ------------------------------------------------------- death intake
+
+    def accepts(self) -> bool:
+        """May the supervisor take this death, or is it terminal?"""
+        return (
+            not self._stopping
+            and self.engine.lifecycle != LIFECYCLE_DEAD
+        )
+
+    def notify_death(
+        self, rep: "_Replica", err: BaseException, cause: Optional[str] = None
+    ) -> None:
+        """A step loop died (already-wrapped error).  Synchronous: by
+        the time it returns, lifecycle is ``recovering``, admission is
+        paused, and the recovery task is scheduled."""
+        if not self.accepts():
+            return
+        if rep.index in self._pending_reps:
+            return  # this replica's recovery is already queued
+        self._pending_reps.add(rep.index)
+        self._pending.append((rep, err, cause or classify_cause(err)))
+        self._set_lifecycle(LIFECYCLE_RECOVERING)
+        frontdoor = self.engine.frontdoor
+        if frontdoor is not None:
+            frontdoor.pause()
+        if self._task is None or self._task.done():
+            self._task = asyncio.get_running_loop().create_task(
+                self._recover_all(), name="engine-supervisor"
+            )
+
+    def request_restart(
+        self, cause: str = CAUSE_STALL, rep: Optional["_Replica"] = None
+    ) -> None:
+        """Watchdog ``--watchdog-action=restart`` entry point: declare
+        the stalled replica dead and recover it.  Its stuck step-loop
+        task is cancelled during quiesce (the dispatch thread it was
+        blocked on is abandoned — on real hardware a truly wedged device
+        program cannot be interrupted from the host; the rebuilt engine
+        dispatches fresh programs).
+
+        ``rep`` is the replica captured at DETECTION time (the snapshot
+        identified it before the dump I/O); re-resolving here could
+        blame a healthy replica if the stall cleared in that window."""
+        if rep is None:
+            rep = self.engine._stalled_replica()  # noqa: SLF001 — supervisor owns this view
+        err = EngineRestartError(
+            "watchdog declared a step-loop stall; the engine is being "
+            "restarted"
+        )
+        self.notify_death(rep, err, cause)
+
+    # ------------------------------------------------------------- recovery
+
+    async def stop(self) -> None:
+        self._stopping = True
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+            self._task = None
+
+    def _recent_attempts(self, now: float) -> int:
+        self._attempt_times = [
+            t for t in self._attempt_times if now - t <= self.window_s
+        ]
+        return len(self._attempt_times)
+
+    async def _recover_all(self) -> None:
+        """Drain the pending-death queue; one recovery at a time."""
+        while self._pending:
+            rep, err, cause = self._pending.pop(0)
+            now = time.monotonic()
+            if self._recent_attempts(now) >= self.max_restarts:
+                await self._escalate(err, cause)
+                return
+            self._attempt_times.append(now)
+            attempt = len(self.restart_history) + 1
+            # base * 2^(n-1) over attempts in the window — exactly the
+            # formula the --engine-restart-backoff help documents
+            backoff = 0.0
+            if self.backoff_base_s > 0:
+                backoff = min(
+                    BACKOFF_MAX_S,
+                    self.backoff_base_s
+                    * (2 ** (len(self._attempt_times) - 1)),
+                )
+            entry = {
+                "attempt": attempt,
+                "at": time.strftime("%Y-%m-%dT%H:%M:%S"),
+                "replica": rep.index,
+                "cause": cause,
+                "death": f"{type(err).__name__}: {err}",
+                "backoff_s": round(backoff, 3),
+            }
+            self.restart_history.append(entry)
+            metrics.engine_restarts_total.labels(cause=cause).inc()
+            logger.warning(
+                "engine supervisor: replica %d died (%s); restart attempt "
+                "%d/%d in window, backoff %.2fs",
+                rep.index, cause, len(self._attempt_times),
+                self.max_restarts, backoff,
+            )
+            if backoff > 0:
+                await asyncio.sleep(backoff)
+            t0 = time.monotonic()
+            try:
+                replayed, failed = await self._recover_one(rep, err)
+            except asyncio.CancelledError:
+                raise
+            except BaseException as exc:  # noqa: BLE001 — death DURING recovery
+                wrapped = wrap_engine_error(exc)
+                entry["recovered"] = False
+                entry["error"] = f"{type(wrapped).__name__}: {wrapped}"
+                logger.exception(
+                    "engine recovery attempt %d failed; re-queueing",
+                    attempt,
+                )
+                # drop the frame references BEFORE re-queueing (after
+                # the log above consumed them): the traceback pins
+                # _recover_one's locals — possibly a fully built
+                # replacement engine whose KV pool must be collectable
+                # before the retry's rebuild (two pools cannot coexist
+                # on TPU)
+                exc.__traceback__ = None
+                wrapped.__traceback__ = None
+                self._pending_reps.discard(rep.index)
+                self.notify_death(rep, wrapped, CAUSE_RECOVERY_FAILURE)
+                continue
+            duration = time.monotonic() - t0
+            entry.update(
+                recovered=True,
+                recovery_s=round(duration, 3),
+                replayed=replayed,
+                failed=failed,
+            )
+            metrics.recovery_seconds.observe(duration)
+            # counted only on the attempt that SUCCEEDED: a failed
+            # attempt's partial replays get re-triaged and re-counted
+            # by its retry, which would overstate the metric
+            metrics.requests_replayed_total.inc(replayed)
+            rep.engine.recorder.record(
+                "restart", step=rep.engine.step_counter, replica=rep.index,
+                cause=cause, attempt=attempt, replayed=replayed,
+                failed=failed, recovery_s=round(duration, 3),
+            )
+            self._pending_reps.discard(rep.index)
+            logger.warning(
+                "engine supervisor: replica %d recovered in %.2fs "
+                "(%d requests replayed, %d failed retryable)",
+                rep.index, duration, replayed, failed,
+            )
+            # checkpoint: if the pod dies later for an unrelated reason,
+            # the post-mortem still sees that (and why) restarts happened
+            await asyncio.to_thread(
+                write_termination_log,
+                "engine restarted under supervision "
+                f"({len(self.restart_history)} attempt(s)):\n"
+                + "\n".join(self.history_lines()),
+                self._termination_log,
+            )
+        # every pending death recovered: back to serving — unless a
+        # SIGTERM drain began mid-recovery, which wins (the listeners
+        # guard the same way, so health never flips back to SERVING on
+        # a draining pod).  This tail MUST stay await-free: notify_death
+        # only interleaves at await points, so a death arriving after
+        # the while-condition saw an empty queue would otherwise strand
+        # in _pending with this task already exiting.
+        frontdoor = self.engine.frontdoor
+        draining = (
+            (frontdoor is not None and frontdoor.draining)
+            # --disable-frontdoor drains too: the coordinator stamps the
+            # lifecycle directly, and recovery must not clobber it
+            or self.engine.lifecycle == LIFECYCLE_DRAINING
+        )
+        self._set_lifecycle(
+            LIFECYCLE_DRAINING if draining else LIFECYCLE_SERVING
+        )
+        if frontdoor is not None:
+            frontdoor.resume()
+
+    async def _recover_one(
+        self, rep: "_Replica", err: BaseException
+    ) -> tuple[int, int]:
+        """Quiesce + rebuild + replay one replica.  Raises on failure
+        (the caller converts that into another attempt)."""
+        # reap the dead (or stuck) step-loop task; a stalled task is
+        # blocked in to_thread — cancelling abandons the worker thread
+        task = rep.task
+        if task is not None and not task.done():
+            task.cancel()
+            try:
+                await task
+            except asyncio.CancelledError:
+                # ambiguous: the reaped task's cancellation, or OUR
+                # cancellation (supervisor.stop() during shutdown).
+                # Honor our own — recovery must not continue into a
+                # minutes-long rebuild on an engine being torn down.
+                if self._stopping:
+                    raise
+            except Exception:  # noqa: BLE001 — the reaped loop's death error
+                pass
+        rep.task = None
+        fail_error = EngineRestartError(
+            "engine restarted mid-request after a fault; partial output "
+            f"was discarded (cause: {type(err).__name__}: {err})",
+            retry_after_s=2.0,
+        )
+        fail_error.__cause__ = err
+        # triage the fixed-outcome requests FIRST: a mid-decode client
+        # gets its retryable UNAVAILABLE now, not after the rebuild and
+        # precompile re-warm it cannot benefit from
+        failed = await self.engine.fail_unreplayable(rep, fail_error)
+        old = rep.engine
+        new_engine = await asyncio.to_thread(self._rebuild, old)
+        # re-warm the serving shapes the boot warmed: the rebuilt
+        # runner's jitted programs are cold, and the first real request
+        # must not pay a multi-second compile sweep
+        widths = self.engine._precompile_widths  # noqa: SLF001
+        if widths is not None:
+            await asyncio.to_thread(new_engine.precompile, widths)
+        replayed, late_failed = await self.engine.restart_replica(
+            rep, new_engine, fail_error
+        )
+        self.engine._arm_replica(rep)  # noqa: SLF001
+        return replayed, failed + late_failed
+
+    def _rebuild(self, old: "LLMEngine") -> "LLMEngine":
+        """Build the replacement engine (worker thread; slow is fine).
+
+        Reuses the resident weights, tokenizer, and device slice —
+        everything stateful (KV pool, scheduler, block allocator, jitted
+        programs, flight recorder) is constructed fresh.
+        """
+        failpoints.fire("supervisor.rebuild")
+        from vllm_tgis_adapter_tpu.engine.core import LLMEngine
+
+        runner = old.runner
+        spec = getattr(runner, "spec", None)
+        draft = (spec.draft_model, spec.draft_params) if spec else None
+        # release the dead engine's device pools BEFORE allocating the
+        # replacement: on TPU the boot pool was sized to ~all free HBM,
+        # and two of them cannot coexist — holding the old reference
+        # here would make every rebuild die in RESOURCE_EXHAUSTED.  The
+        # weights (runner.params) stay resident; only KV goes.
+        runner.caches = None
+        if spec is not None:
+            spec.draft_caches = None
+        # old.config already carries the boot-resolved num_blocks, so no
+        # re-sizing happens here; memory_device is still passed so any
+        # future re-size path reads THIS replica's device, not device 0
+        devices = old._devices  # noqa: SLF001
+        new = LLMEngine(
+            old.config,
+            runner.model,
+            runner.params,
+            old.tokenizer,
+            mesh=getattr(runner, "mesh", None),
+            memory_device=devices[0] if devices else None,
+            pp_devices=devices,
+        )
+        new._devices = old._devices  # noqa: SLF001
+        if draft is not None:
+            new.runner.attach_speculative(*draft)
+        return new
+
+    # ------------------------------------------------------------ escalation
+
+    async def _escalate(self, err: BaseException, cause: str) -> None:
+        """Crash-loop circuit breaker tripped: die cleanly and loudly."""
+        from vllm_tgis_adapter_tpu.engine.async_llm import EngineDeadError
+
+        history = "\n".join(self.history_lines())
+        msg = (
+            f"engine crash-loop: {len(self._attempt_times)} restarts "
+            f"within {self.window_s:.0f}s hit --max-engine-restarts="
+            f"{self.max_restarts}; giving up and exiting. Last death "
+            f"({cause}): {type(err).__name__}: {err}\n"
+            f"restart history:\n{history}"
+        )
+        logger.error("%s", msg)
+        final = EngineDeadError(msg)
+        final.__cause__ = err
+        self._set_lifecycle(LIFECYCLE_DEAD)
+        # checkpoint the history FIRST: the final traceback write in
+        # __main__ embeds this same message, but a SIGKILL between here
+        # and there must not lose the evidence
+        await asyncio.to_thread(
+            write_termination_log, msg, self._termination_log
+        )
+        self.engine._terminal_death(final)  # noqa: SLF001 — the one sanctioned caller
+        # wake __main__ only after the checkpoint write above finished
+        # (its final traceback APPENDS to what we just wrote)
+        self.engine.dead_event.set()

@@ -352,6 +352,39 @@ def add_engine_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         "during in-flight XLA/Mosaic compiles; 0 "
                         "disables the watchdog")
 
+    g = parser.add_argument_group("self-healing (docs/RECOVERY.md)")
+    g.add_argument("--max-engine-restarts", type=int, default=3,
+                   help="supervised engine restarts allowed within "
+                        "--engine-restart-window before the crash-loop "
+                        "circuit breaker escalates to clean process "
+                        "death (restart history lands in the "
+                        "termination log); 0 disables supervision "
+                        "entirely — any engine death kills the process "
+                        "(pre-restart behavior)")
+    g.add_argument("--engine-restart-window", type=float, default=300.0,
+                   help="sliding window (seconds) the crash-loop "
+                        "circuit breaker counts restarts over")
+    g.add_argument("--engine-restart-backoff", type=float, default=0.5,
+                   help="base of the exponential backoff between "
+                        "restart attempts (base * 2^(n-1), capped at "
+                        "30s)")
+    g.add_argument("--watchdog-action", type=str, default="snapshot",
+                   choices=["snapshot", "restart"],
+                   help="what a watchdog-declared stall triggers: "
+                        "'snapshot' diagnoses only (default); "
+                        "'restart' additionally hands the stalled "
+                        "engine to the supervisor — the diagnostic "
+                        "snapshot is still written first")
+    g.add_argument("--failpoints", type=str,
+                   default=os.getenv("TGIS_TPU_FAILPOINTS"),
+                   help="DELIBERATE fault injection for chaos testing "
+                        "(never in production): comma-separated "
+                        "site=action[:count] entries, e.g. "
+                        "'core.plan_step=raise:1,core.wait_step=oom'; "
+                        "actions: raise, oom, hang; also read from "
+                        "TGIS_TPU_FAILPOINTS "
+                        "(supervisor/failpoints.py)")
+
     return parser
 
 

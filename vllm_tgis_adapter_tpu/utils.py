@@ -26,12 +26,18 @@ def check_for_failed_tasks(tasks: Iterable[asyncio.Task]) -> Optional[asyncio.Ta
     return None
 
 
-def write_termination_log(msg: str, file: str = "/dev/termination-log") -> None:
+def write_termination_log(
+    msg: str, file: str = "/dev/termination-log", *, append: bool = False
+) -> None:
     """Record the cause of death where Kubernetes probes can read it.
 
     Mirrors the reference semantics (utils.py:20-41): silently skip when the
     file does not exist (not running under k8s), and never let logging errors
     mask the original failure.
+
+    ``append`` preserves an earlier checkpoint in the same process — the
+    final traceback write in ``__main__`` must not clobber the engine
+    death report / restart history the supervisor already recorded.
     """
     if not os.path.exists(file):
         from .logging import DEFAULT_LOGGER_NAME, init_logger
@@ -41,7 +47,7 @@ def write_termination_log(msg: str, file: str = "/dev/termination-log") -> None:
         )
         return
     try:
-        with open(file, "w") as f:
+        with open(file, "a" if append else "w") as f:
             f.write(f"{msg}\n")
     except Exception:
         from .logging import DEFAULT_LOGGER_NAME, init_logger

@@ -65,7 +65,21 @@ class StallWatchdog:
         dump_dir: Optional[str] = None,
         check_interval_s: Optional[float] = None,
         termination_log: Optional[str] = None,
+        action: str = "snapshot",
+        restart_fn: Optional[Callable[[], None]] = None,
     ):
+        if action not in ("snapshot", "restart"):
+            raise ValueError(
+                f"--watchdog-action must be 'snapshot' or 'restart' "
+                f"(got {action!r})"
+            )
+        # detection → action wiring (--watchdog-action): 'snapshot'
+        # preserves the PR-3 behavior (diagnose only); 'restart' hands
+        # the stall to restart_fn (the engine supervisor) AFTER the
+        # snapshot has been written — the evidence always outlives the
+        # restart that destroys the stalled state
+        self.action = action
+        self._restart_fn = restart_fn
         self.deadline_s = deadline_s
         self.compile_grace_s = compile_grace_s
         self.dump_dir = dump_dir
@@ -194,4 +208,15 @@ class StallWatchdog:
         await asyncio.to_thread(
             write_termination_log, summary, self._termination_log
         )
+        if self.action == "restart" and self._restart_fn is not None:
+            # snapshot first, restart second: the dump above captured
+            # the stalled state this restart is about to tear down
+            logger.error(
+                "watchdog action=restart: requesting supervised engine "
+                "restart for the stalled step loop"
+            )
+            try:
+                self._restart_fn()
+            except Exception:  # noqa: BLE001 — the dump already happened
+                logger.exception("watchdog restart request failed")
         return snapshot
