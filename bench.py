@@ -38,7 +38,12 @@ every (prefill-bucket, batch-bucket) program is compiled before timing.
 
 Env knobs: BENCH_TINY=1 (CI smoke on CPU), BENCH_REQUESTS, BENCH_PROMPT,
 BENCH_OUTPUT, BENCH_BATCH, BENCH_STEPS, BENCH_PROBE_TIMEOUT (s),
-BENCH_TPU_TIMEOUT (s, whole TPU run incl. compiles), BENCH_FORCE_CPU=1.
+BENCH_TPU_TIMEOUT (s, whole TPU run incl. compiles), BENCH_FORCE_CPU=1,
+BENCH_ATTENTION_BACKEND=bucketed|ragged (also `--attention-backend=X`
+argv; selects the serving data path, docs/ATTENTION.md — the emitted
+line stamps compiled-shape counts and the padding-waste fraction so the
+two backends' compile lattices and pad overhead are directly
+comparable).
 """
 
 from __future__ import annotations
@@ -89,6 +94,25 @@ def _emit(value: float, *, extra: dict) -> None:
         line["cpu_proxy_tok_per_s"] = round(float(value), 2)
     line.update(extra)
     print(json.dumps(line), flush=True)
+
+
+def _attention_data_path() -> str:
+    """Serving data path for this run: ``--attention-backend=X`` argv or
+    BENCH_ATTENTION_BACKEND (docs/ATTENTION.md); bucketed by default."""
+    for arg in sys.argv[1:]:
+        if arg.startswith("--attention-backend="):
+            return arg.split("=", 1)[1]
+    return os.environ.get("BENCH_ATTENTION_BACKEND", "bucketed")
+
+
+def _padded_tokens_total(metrics_mod) -> float:
+    """Cumulative padding-slot count across phases (prometheus)."""
+    total = 0.0
+    for metric in metrics_mod.padded_tokens_total.collect():
+        for sample in metric.samples:
+            if sample.name.endswith("_total"):
+                total += sample.value
+    return total
 
 
 def _probe_tpu(timeout_s: float) -> bool:
@@ -169,8 +193,11 @@ def run_bench(on_tpu: bool) -> dict:
     from vllm_tgis_adapter_tpu.models.llama import LlamaForCausalLM
     from vllm_tgis_adapter_tpu.ops import attention as attn_ops
 
+    from vllm_tgis_adapter_tpu import compile_tracker, metrics
+
     backend = jax.default_backend()
     device = jax.devices()[0]
+    data_path = _attention_data_path()
     # the variant the run STARTS with; "decode_kernel" in the emitted
     # stats is re-read after the run, so a serving-path degradation
     # (degrade_decode_kernel) shows up as requested != dispatched plus
@@ -219,6 +246,7 @@ def run_bench(on_tpu: bool) -> dict:
         ),
         parallel_config=ParallelConfig(),
         lora_config=LoRAConfig(),
+        attention_backend=data_path,
     )
     model = LlamaForCausalLM(mcfg)
     params = model.init_params(jax.random.PRNGKey(0))
@@ -234,6 +262,16 @@ def run_bench(on_tpu: bool) -> dict:
         quantization = "int8"
     tokenizer = AutoTokenizer.from_pretrained(model_dir)
     engine = LLMEngine(config, model, params, tokenizer)
+
+    # BENCH_PRECOMPILE=1: run the boot-time shape warmup first and stamp
+    # the number of compiled programs it took — the FULL compile lattice
+    # a production boot pays, which is where the ragged path's collapse
+    # shows (organic tiny-bench traffic only touches a few shapes)
+    precompiled_shapes = None
+    if os.environ.get("BENCH_PRECOMPILE", "") == "1":
+        compile_tracker.reset()
+        engine.precompile()
+        precompiled_shapes = compile_tracker.num_shapes()
 
     # count packed multi-prompt prefill dispatches (engine/scheduler.py):
     # the serving-path feature the bench is meant to exercise
@@ -334,12 +372,19 @@ def run_bench(on_tpu: bool) -> dict:
         # negligible against the timed pass's hundreds
         for key in pack_stats:
             pack_stats[key] = 0
+        pad0 = _padded_tokens_total(metrics)
         produced, elapsed = await run_pass("timed", n_requests, output_len)
         await aengine.stop()
-        return produced, elapsed
+        return produced, elapsed, _padded_tokens_total(metrics) - pad0
 
-    produced, elapsed = asyncio.run(both_passes())
+    produced, elapsed, padded_tok = asyncio.run(both_passes())
     value = produced / elapsed
+    # padding fraction of the timed pass: pad slots dispatched over pad
+    # slots + real work (prompt tokens enter once even when chunked;
+    # decode real work ≈ produced) — the number the ragged backend is
+    # built to drive to ~0
+    real_tok = n_requests * prompt_len + produced
+    padding_waste = padded_tok / max(1.0, padded_tok + real_tok)
 
     peak = _peak_flops(device.device_kind) if backend == "tpu" else None
     mfu = round(value * flops_per_tok / peak, 4) if peak else None
@@ -354,6 +399,17 @@ def run_bench(on_tpu: bool) -> dict:
     return {
         "value": value,
         "backend": backend,
+        # the serving DATA PATH (bucketed vs ragged, docs/ATTENTION.md);
+        # "attention_backend" keeps its historical meaning of the
+        # kernel tier (pallas vs xla)
+        "attention_data_path": data_path,
+        # compile_tracker evidence: the ragged path's whole point is a
+        # collapsed compile lattice — distinct compiled (fn, shape)
+        # programs and total compile-cache misses over the run
+        "compiled_shapes": compile_tracker.num_shapes(),
+        "precompiled_shapes": precompiled_shapes,
+        "xla_compiles": compile_tracker.total_recompiles(),
+        "padding_waste_frac": round(padding_waste, 4),
         "attention_backend": (
             "pallas" if attn_ops._use_pallas() else "xla"
         ),

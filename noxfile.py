@@ -12,6 +12,7 @@ import nox
 
 nox.options.sessions = (
     "lint", "tpulint", "typecheck", "tests", "overload_check", "chaos_check",
+    "perf_check",
 )
 nox.options.reuse_existing_virtualenvs = True
 
@@ -76,6 +77,21 @@ def chaos_check(session: nox.Session) -> None:
     session.install("-e", ".[tests]")
     session.run(
         "pytest", "tests/test_supervisor.py", "-q",
+        *session.posargs,
+        env={"JAX_PLATFORMS": "cpu"},
+    )
+
+
+@nox.session(python="3.12")
+def perf_check(session: nox.Session) -> None:
+    """Perf regression gate (ROADMAP item 5, minimal core): run the
+    CPU-proxy mini-bench per serving data path (bucketed + ragged) and
+    fail on >20% tok/s regression or padding-waste growth against the
+    checked-in PERF_BASELINE.json — the instrument the r05 4x drop
+    lacked (BASELINE.md 'Perf regression log')."""
+    session.install("-e", ".[tests]")
+    session.run(
+        "python", "tools/perf_check.py",
         *session.posargs,
         env={"JAX_PLATFORMS": "cpu"},
     )

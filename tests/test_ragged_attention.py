@@ -1,0 +1,888 @@
+"""Ragged paged attention (--attention-backend=ragged): kernel parity,
+backend equivalence, compile-lattice collapse, and chaos recovery.
+
+The contract under test (docs/ATTENTION.md): the unified ragged path
+produces PER-ROW outputs numerically identical (same dtype, same
+reduction discipline → exact or within float tolerance) to the bucketed
+solo-prefill, packed-prefill and fused-decode paths, across mixed
+batches including sliding-window, LoRA-slot and prefix-cache-hit rows —
+while compiling strictly fewer programs and reporting its padding
+honestly (fill ratio ~1 whenever backlog exists).
+"""
+
+from __future__ import annotations
+
+import ast
+import asyncio
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+
+# ---------------------------------------------------------------- kernel
+
+
+def _build_mixed_case(rng, cases, *, hkv=2, g=2, dh=16, bs=4, max_blocks=8):
+    """Build a paged cache + flat mixed stream from (ctx_before, n_new)
+    span specs; returns everything the ragged kernel consumes plus the
+    per-sequence pieces the reference needs."""
+    import jax.numpy as jnp
+
+    from vllm_tgis_adapter_tpu.ops.attention import write_kv
+
+    h = hkv * g
+    num_blocks = 32
+    kc = jnp.zeros((hkv, num_blocks * bs, dh), jnp.float32)
+    vc = jnp.zeros_like(kc)
+    tables = np.zeros((len(cases), max_blocks), np.int32)
+    spans, pos_base, flat_q, flat_pos, per_seq = [], [], [], [], []
+    next_block, row = 0, 0
+    for s, (ctx_before, n_new) in enumerate(cases):
+        total = ctx_before + n_new
+        nb = -(-total // bs)
+        blocks = list(range(next_block, next_block + nb))
+        next_block += nb
+        tables[s, :nb] = blocks
+        k_seq = rng.standard_normal((total, hkv, dh)).astype(np.float32)
+        v_seq = rng.standard_normal((total, hkv, dh)).astype(np.float32)
+        slots = [blocks[p // bs] * bs + p % bs for p in range(total)]
+        kc, vc = write_kv(
+            kc, vc, jnp.asarray(k_seq), jnp.asarray(v_seq),
+            jnp.asarray(slots, jnp.int32),
+        )
+        q_new = rng.standard_normal((n_new, h, dh)).astype(np.float32)
+        flat_q.append(q_new)
+        flat_pos += list(range(ctx_before, total))
+        spans.append((row, n_new, ctx_before))
+        pos_base.append(ctx_before)
+        per_seq.append((q_new, ctx_before, n_new))
+        row += n_new
+    t = row
+    t_pad = 16 if t <= 16 else 32
+    q = np.zeros((t_pad, h, dh), np.float32)
+    q[:t] = np.concatenate(flat_q)
+    positions = np.zeros(t_pad, np.int32)
+    positions[:t] = flat_pos
+    s_pad = len(cases) + 1
+    seq_starts = np.full(s_pad + 1, t_pad, np.int32)
+    for s, (start, _, _) in enumerate(spans):
+        seq_starts[s] = start
+    seq_starts[len(spans)] = t
+    pb = np.zeros(s_pad, np.int32)
+    pb[: len(pos_base)] = pos_base
+    bt = np.zeros((s_pad, max_blocks), np.int32)
+    bt[: len(cases)] = tables
+    return dict(
+        kc=kc, vc=vc, q=q, t=t, t_pad=t_pad, positions=positions,
+        seq_starts=seq_starts, pos_base=pb, block_tables=bt,
+        spans=spans, per_seq=per_seq, bs=bs, scale=dh**-0.5, h=h,
+    )
+
+
+@pytest.mark.parametrize("window", [0, 4])
+def test_ragged_xla_matches_decode_reference(window):
+    """Every ragged row == the pinned decode formulation of the same
+    (query, paged context) — prefill chunks, decode rows and
+    prefix-resume chunks alike."""
+    import jax.numpy as jnp
+
+    from vllm_tgis_adapter_tpu.ops import ragged_attention as ra
+    from vllm_tgis_adapter_tpu.ops.attention import (
+        paged_decode_attention_xla,
+    )
+
+    rng = np.random.default_rng(0)
+    case = _build_mixed_case(rng, [(0, 7), (9, 1), (3, 5)])
+    out = ra.ragged_attention_xla(
+        jnp.asarray(case["q"]), case["kc"], case["vc"],
+        jnp.asarray(case["positions"]), jnp.asarray(case["seq_starts"]),
+        jnp.asarray(case["t"]), jnp.asarray(case["block_tables"]),
+        case["bs"], case["scale"], window=window,
+    )
+    row = 0
+    for s, (q_new, ctx_before, n_new) in enumerate(case["per_seq"]):
+        ctx = jnp.arange(ctx_before + 1, ctx_before + n_new + 1,
+                         dtype=jnp.int32)
+        tb = jnp.broadcast_to(
+            jnp.asarray(case["block_tables"][s])[None],
+            (n_new, case["block_tables"].shape[1]),
+        )
+        ref = paged_decode_attention_xla(
+            jnp.asarray(q_new), case["kc"], case["vc"], tb, ctx,
+            case["bs"], case["scale"], window=window,
+        )
+        np.testing.assert_allclose(
+            np.asarray(out[row: row + n_new]), np.asarray(ref),
+            rtol=1e-5, atol=1e-5,
+        )
+        row += n_new
+
+
+@pytest.mark.parametrize("window", [0, 4])
+@pytest.mark.parametrize("use_alibi", [False, True])
+@pytest.mark.parametrize("schedule", ["sparse", "dense"])
+def test_ragged_pallas_matches_xla(window, use_alibi, schedule):
+    """The Pallas kernel (interpret mode) matches the XLA reference for
+    both the host-built sparse schedule (mixed engine steps, multi-row
+    spans) and the in-trace dense schedule (the fused decode scan —
+    single-row spans by contract, including a pow2-boundary row count
+    so the pad descriptor slot lands past the last query block)."""
+    import jax.numpy as jnp
+
+    from vllm_tgis_adapter_tpu.ops import ragged_attention as ra
+
+    rng = np.random.default_rng(1)
+    case = _build_mixed_case(
+        rng,
+        [(0, 6), (9, 1), (3, 4), (5, 1)]
+        if schedule == "sparse"
+        # decode contract: every span one row (seq s IS row s); 16 rows
+        # make block_q=8 divide t exactly, the pad-sequence clamp case
+        else [(i % 11, 1) for i in range(16)],
+    )
+    slopes = (
+        jnp.asarray(rng.standard_normal(case["h"]).astype(np.float32) * 0.1)
+        if use_alibi
+        else None
+    )
+    ref = ra.ragged_attention_xla(
+        jnp.asarray(case["q"]), case["kc"], case["vc"],
+        jnp.asarray(case["positions"]), jnp.asarray(case["seq_starts"]),
+        jnp.asarray(case["t"]), jnp.asarray(case["block_tables"]),
+        case["bs"], case["scale"], window=window, alibi_slopes=slopes,
+    )
+    if schedule == "sparse":
+        work = jnp.asarray(ra.build_work_schedule(
+            case["spans"], case["block_tables"],
+            block_size=case["bs"], block_q=8, t_pad=case["t_pad"],
+        ))
+    else:
+        work = ra.dense_work_schedule(
+            jnp.asarray(case["pos_base"]),
+            jnp.asarray(case["block_tables"]),
+            block_size=case["bs"], block_q=8, t_pad=case["t_pad"],
+        )
+    out = ra._ragged_attention_pallas(
+        jnp.asarray(case["q"]), case["kc"], case["vc"],
+        jnp.asarray(case["seq_starts"]), jnp.asarray(case["pos_base"]),
+        work, case["bs"], case["scale"], block_q=8, window=window,
+        alibi_slopes=slopes, interpret=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out[: case["t"]]), np.asarray(ref[: case["t"]]),
+        rtol=1e-5, atol=1e-5,
+    )
+
+
+def test_ragged_dense_schedule_non_pow2_stream(monkeypatch):
+    """work=None dispatch (the fused decode scan) at a non-power-of-two
+    stream width: the in-trace dense schedule must cover exactly the
+    kernel's cdiv query-block grid.  A wider (pow2) schedule emits
+    block indices past the output grid whose first/last flags re-init
+    and finalise the clamped last real block with zeros — silently
+    zeroing the tail rows of every non-pow2 decode wave."""
+    import jax.numpy as jnp
+
+    from vllm_tgis_adapter_tpu.ops import ragged_attention as ra
+
+    monkeypatch.setattr(ra, "_use_pallas", lambda: True)
+    monkeypatch.setattr(ra, "_pallas_interpret", lambda: True)
+
+    rng = np.random.default_rng(3)
+    # 24 single-row spans: T=24 gives pow2_ceil(T)=32 but cdiv(24,8)*8=24
+    case = _build_mixed_case(rng, [(i % 3, 1) for i in range(24)])
+    t = case["t"]
+    assert t == 24
+    ref = ra.ragged_attention_xla(
+        jnp.asarray(case["q"][:t]), case["kc"], case["vc"],
+        jnp.asarray(case["positions"][:t]), jnp.asarray(case["seq_starts"]),
+        jnp.asarray(t), jnp.asarray(case["block_tables"]),
+        case["bs"], case["scale"],
+    )
+    out = ra.ragged_paged_attention(
+        jnp.asarray(case["q"][:t]), case["kc"], case["vc"],
+        jnp.asarray(case["positions"][:t]), jnp.asarray(case["seq_starts"]),
+        jnp.asarray(case["pos_base"]), jnp.asarray(t),
+        jnp.asarray(case["block_tables"]), case["bs"], case["scale"],
+        work=None,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5
+    )
+
+
+# ------------------------------------------------------------ engine pair
+
+
+def _make_engine(model_dir, backend, *, num_blocks=128, max_num_seqs=8,
+                 prefix_caching=False, lora=False, seed=0):
+    from vllm_tgis_adapter_tpu.engine.config import (
+        CacheConfig,
+        EngineConfig,
+        LoRAConfig,
+        ModelConfig,
+        ParallelConfig,
+        SchedulerConfig,
+    )
+    from vllm_tgis_adapter_tpu.engine.core import LLMEngine
+
+    mcfg = ModelConfig.from_pretrained(model_dir, dtype="float32")
+    config = EngineConfig(
+        model_config=mcfg,
+        cache_config=CacheConfig(
+            block_size=16, num_blocks=num_blocks, cache_dtype=mcfg.dtype,
+            enable_prefix_caching=prefix_caching,
+        ),
+        scheduler_config=SchedulerConfig(
+            max_num_seqs=max_num_seqs, prefill_buckets=(32, 64, 128),
+        ),
+        parallel_config=ParallelConfig(),
+        lora_config=(
+            LoRAConfig(enabled=True, max_loras=2, max_lora_rank=8)
+            if lora
+            else LoRAConfig()
+        ),
+        seed=seed,
+        attention_backend=backend,
+    )
+    return LLMEngine.from_config(config)
+
+
+def _run_requests(engine, requests):
+    """requests: (rid, prompt_ids, sampling_kwargs, add_kwargs)."""
+    from vllm_tgis_adapter_tpu.engine.sampling_params import SamplingParams
+
+    for rid, ids, skw, akw in requests:
+        engine.add_request(
+            rid, None, SamplingParams(**skw), prompt_token_ids=list(ids),
+            **akw,
+        )
+    outs = {}
+    for _ in range(1000):
+        if not engine.has_unfinished_requests():
+            break
+        for o in engine.step():
+            outs[o.request_id] = o
+    assert not engine.has_unfinished_requests(), "engine did not drain"
+    return {k: list(v.outputs[0].token_ids) for k, v in outs.items()}
+
+
+def _mixed_requests(rng, n=6, greedy=True):
+    reqs = []
+    for i in range(n):
+        ids = rng.integers(3, 500, size=int(rng.integers(4, 60))).tolist()
+        skw = dict(max_tokens=int(rng.integers(3, 14)), ignore_eos=True)
+        if greedy:
+            skw["temperature"] = 0.0
+        else:
+            skw["temperature"] = 0.8
+            skw["seed"] = 1234 + i
+        reqs.append((f"r{i}", ids, skw, {}))
+    return reqs
+
+
+def test_ragged_equals_bucketed_mixed_batch(tiny_model_dir):
+    """Greedy mixed batch (staggered lengths/budgets): token-identical
+    to the bucketed solo/packed/fused-decode composition."""
+    rng = np.random.default_rng(7)
+    reqs = _mixed_requests(rng)
+    r_bucketed = _run_requests(
+        _make_engine(tiny_model_dir, "bucketed"), reqs
+    )
+    r_ragged = _run_requests(_make_engine(tiny_model_dir, "ragged"), reqs)
+    assert r_bucketed == r_ragged
+
+
+def test_ragged_equals_bucketed_sampled_rows(tiny_model_dir):
+    """Seeded (temperature > 0) rows: the sampler consumes identical
+    logits and per-row PRNG streams on both paths."""
+    rng = np.random.default_rng(11)
+    reqs = _mixed_requests(rng, n=4, greedy=False)
+    r_bucketed = _run_requests(
+        _make_engine(tiny_model_dir, "bucketed"), reqs
+    )
+    r_ragged = _run_requests(_make_engine(tiny_model_dir, "ragged"), reqs)
+    assert r_bucketed == r_ragged
+
+
+@pytest.fixture(scope="module")
+def tiny_mistral_dir(tmp_path_factory):
+    from tests.fixture_models import build_tiny_mistral
+
+    return build_tiny_mistral(
+        str(tmp_path_factory.mktemp("tiny-mistral")), sliding_window=8
+    )
+
+
+def test_ragged_equals_bucketed_sliding_window(tiny_mistral_dir):
+    """Sliding-window rows: the ragged kernel's band mask matches the
+    bucketed prefill/decode band masks."""
+    rng = np.random.default_rng(13)
+    reqs = _mixed_requests(rng, n=4)
+    r_bucketed = _run_requests(
+        _make_engine(tiny_mistral_dir, "bucketed"), reqs
+    )
+    r_ragged = _run_requests(
+        _make_engine(tiny_mistral_dir, "ragged"), reqs
+    )
+    assert r_bucketed == r_ragged
+
+
+@pytest.fixture(scope="module")
+def tiny_lora_dir(tmp_path_factory):
+    from tests.fixture_models import build_tiny_lora_adapter
+
+    return build_tiny_lora_adapter(
+        str(tmp_path_factory.mktemp("tiny-lora"))
+    )
+
+
+def test_ragged_equals_bucketed_lora_rows(tiny_model_dir, tiny_lora_dir):
+    """Mixed adapter/base rows: the ragged per-row LoRA gather matches
+    the bucketed per-sequence/per-row delta paths."""
+    results = {}
+    for backend in ("bucketed", "ragged"):
+        engine = _make_engine(tiny_model_dir, backend, lora=True)
+        asyncio.run(
+            engine.lora_manager.load_lora_adapter("tl", tiny_lora_dir)
+        )
+        rng = np.random.default_rng(17)
+        reqs = []
+        for i in range(4):
+            ids = rng.integers(3, 500, size=20).tolist()
+            akw = {"lora_name": "tl"} if i % 2 else {}
+            reqs.append((
+                f"r{i}", ids,
+                dict(temperature=0.0, max_tokens=6, ignore_eos=True),
+                akw,
+            ))
+        results[backend] = _run_requests(engine, reqs)
+    assert results["bucketed"] == results["ragged"]
+    # the adapter actually did something (otherwise the case is vacuous)
+    assert results["ragged"]["r0"] != results["ragged"]["r1"]
+
+
+def test_ragged_equals_bucketed_prefix_cache_hit(tiny_model_dir):
+    """Prefix-cache-hit rows: the ragged span starts mid-prompt
+    (start_pos = matched tokens) and attends through the adopted pages,
+    matching the bucketed chunked-resume path."""
+    rng = np.random.default_rng(19)
+    shared = rng.integers(3, 500, size=40).tolist()
+    other = rng.integers(3, 500, size=24).tolist()
+    results = {}
+    for backend in ("bucketed", "ragged"):
+        engine = _make_engine(tiny_model_dir, backend, prefix_caching=True)
+        skw = dict(temperature=0.0, max_tokens=6, ignore_eos=True)
+        first = _run_requests(engine, [("warm", shared, skw, {})])
+        hits0 = engine.scheduler.allocator.prefix_hits
+        second = _run_requests(
+            engine,
+            [("hit", shared, skw, {}), ("miss", other, skw, {})],
+        )
+        assert engine.scheduler.allocator.prefix_hits > hits0, (
+            f"{backend}: prefix cache never hit — the case is vacuous"
+        )
+        assert second["hit"] == first["warm"]
+        results[backend] = (first, second)
+    assert results["bucketed"] == results["ragged"]
+
+
+def test_ragged_prompt_logprobs_legacy_fallback(tiny_model_dir):
+    """A waiting head bearing prompt_logprobs is served by the legacy
+    solo-prefill path even under the ragged backend (full-bucket logits
+    rows; docs/ATTENTION.md "Limits"), interleaved with ragged planning
+    for everything else — arriving mid-stream against running decode
+    rows so the alternation branch actually runs.  Tokens and the
+    prompt-logprob table must match the bucketed backend."""
+    from vllm_tgis_adapter_tpu.engine.sampling_params import SamplingParams
+    from vllm_tgis_adapter_tpu.engine.scheduler import (
+        PrefillPlan,
+        RaggedPlan,
+    )
+
+    rng = np.random.default_rng(37)
+    lp_ids = rng.integers(3, 500, size=20).tolist()
+    plain = [
+        rng.integers(3, 500, size=int(n)).tolist() for n in (12, 44, 7)
+    ]
+
+    results = {}
+    for backend in ("bucketed", "ragged"):
+        engine = _make_engine(tiny_model_dir, backend)
+        plans = []
+        orig = engine.scheduler.schedule
+
+        def spy(**kwargs):
+            plan = orig(**kwargs)
+            plans.append(plan)
+            return plan
+
+        engine.scheduler.schedule = spy
+        for i, ids in enumerate(plain):
+            engine.add_request(
+                f"p{i}", None,
+                SamplingParams(
+                    temperature=0.0, max_tokens=8, ignore_eos=True
+                ),
+                prompt_token_ids=ids,
+            )
+        outs = {}
+        for _ in range(3):  # plain rows reach decode before lp arrives
+            for o in engine.step():
+                outs[o.request_id] = o
+        engine.add_request(
+            "lp", None,
+            SamplingParams(
+                temperature=0.0, max_tokens=4, prompt_logprobs=2,
+                logprobs=2, ignore_eos=True,
+            ),
+            prompt_token_ids=list(lp_ids),
+        )
+        for _ in range(400):
+            if not engine.has_unfinished_requests():
+                break
+            for o in engine.step():
+                outs[o.request_id] = o
+        assert not engine.has_unfinished_requests(), "engine did not drain"
+        if backend == "ragged":
+            assert any(isinstance(p, RaggedPlan) for p in plans)
+            assert any(
+                isinstance(p, PrefillPlan) and p.seq.request_id == "lp"
+                for p in plans
+            ), "lp head never took the legacy solo path"
+        lp = outs["lp"]
+        assert lp.prompt_logprobs is not None
+        assert lp.prompt_logprobs[0] is None
+        assert len(lp.prompt_logprobs) == len(lp_ids)
+        results[backend] = (
+            {k: list(v.outputs[0].token_ids) for k, v in outs.items()},
+            lp.prompt_logprobs,
+        )
+    assert results["bucketed"][0] == results["ragged"][0]
+    for a, b in zip(
+        results["bucketed"][1][1:], results["ragged"][1][1:]
+    ):
+        assert set(a) == set(b)
+        for tid in a:
+            assert abs(a[tid].logprob - b[tid].logprob) < 1e-4
+
+
+# -------------------------------------------------- lattice + observability
+
+
+def test_ragged_compile_lattice_is_smaller(tiny_model_dir):
+    """precompile() on the ragged backend compiles strictly fewer
+    programs than the bucketed lattice at the same serving config (the
+    bench JSON carries the same evidence via compiled_shapes /
+    xla_compiles; docs/ATTENTION.md documents the expected counts)."""
+    from vllm_tgis_adapter_tpu import compile_tracker
+
+    counts = {}
+    for backend in ("bucketed", "ragged"):
+        engine = _make_engine(
+            tiny_model_dir, backend, num_blocks=256, max_num_seqs=8
+        )
+        compile_tracker.reset()
+        engine.precompile()
+        counts[backend] = (
+            compile_tracker.num_shapes(),
+            compile_tracker.total_recompiles(),
+        )
+    compile_tracker.reset()
+    assert counts["ragged"][0] < counts["bucketed"][0]
+    assert counts["ragged"][1] < counts["bucketed"][1]
+
+
+def test_ragged_fill_ratio_and_plan_description(tiny_model_dir):
+    """The padding gauges read from the RAGGED plan: a backlogged mixed
+    step reports fill ratio 1.0 / waste 0.0, and describe_plan renders
+    the ragged batch for /debug/state."""
+    from vllm_tgis_adapter_tpu import metrics
+    from vllm_tgis_adapter_tpu.engine.core import describe_plan
+    from vllm_tgis_adapter_tpu.engine.sampling_params import SamplingParams
+
+    engine = _make_engine(tiny_model_dir, "ragged")
+    rng = np.random.default_rng(23)
+    # enough backlog to cover a bucket: the slice-to-fit planner must
+    # dispatch an exactly-full flat bucket
+    for i in range(6):
+        engine.add_request(
+            f"r{i}", None,
+            SamplingParams(temperature=0.0, max_tokens=4, ignore_eos=True),
+            prompt_token_ids=rng.integers(3, 500, size=50).tolist(),
+        )
+    outputs, plan, prepared = engine.plan_step()
+    desc = describe_plan(plan)
+    assert desc["kind"] == "ragged"
+    assert desc["total_tokens"] == desc["bucket"]  # exactly full
+    assert desc["num_prefill"] >= 1
+    assert metrics.ragged_batch_fill_ratio._value.get() == 1.0
+    assert metrics.prefill_padding_waste._value.get() == 0.0
+    # drain so the module-scoped engine state is clean
+    engine.commit_step(
+        plan, engine.execute_step(plan, prepared), prepared
+    )
+    while engine.has_unfinished_requests():
+        engine.step()
+
+
+def test_ragged_work_schedule_width_is_per_bucket_stable(
+    tiny_model_dir, monkeypatch
+):
+    """The Pallas work-schedule width is a compile shape of the jitted
+    ragged step: every dispatch at a given flat bucket must reuse ONE
+    quantized width (pow2 high-water, floored), not retrace at every
+    distinct (item count) the batch mix happens to produce."""
+    from vllm_tgis_adapter_tpu.ops import attention as attn_ops
+
+    monkeypatch.setattr(attn_ops, "_use_pallas", lambda: True)
+    engine = _make_engine(tiny_model_dir, "ragged")
+    runner = engine.runner
+    orig = runner.prepare_ragged
+    seen: list[tuple[int, int]] = []
+
+    def spy(plan):
+        prep = orig(plan)
+        assert prep.work is not None
+        seen.append((prep.bucket, prep.work.shape[1]))
+        return prep
+
+    monkeypatch.setattr(runner, "prepare_ragged", spy)
+    rng = np.random.default_rng(29)
+    _run_requests(engine, _mixed_requests(rng))
+    assert seen
+    by_bucket: dict[int, set[int]] = {}
+    for bucket, width in seen:
+        by_bucket.setdefault(bucket, set()).add(width)
+    for bucket, widths in by_bucket.items():
+        assert len(widths) == 1, (bucket, widths)
+        (w,) = widths
+        assert w >= 64 and (w & (w - 1)) == 0
+    assert runner._ragged_work_hwm == {
+        b: max(ws) for b, ws in by_bucket.items()
+    }
+
+
+def test_ragged_precompile_warms_decode_heavy_tail_bucket(tiny_model_dir):
+    """Flat buckets past the chunk budget are reachable only when a
+    large running batch pushes the planner over it (bucket =
+    max(floor_bucket, _ragged_bucket(base+1))); precompile's mixed
+    tail phase must warm exactly the reachable ones and skip the rest."""
+    from vllm_tgis_adapter_tpu.engine.config import (
+        CacheConfig,
+        EngineConfig,
+        LoRAConfig,
+        ModelConfig,
+        ParallelConfig,
+        SchedulerConfig,
+    )
+    from vllm_tgis_adapter_tpu.engine.core import LLMEngine
+
+    mcfg = ModelConfig.from_pretrained(tiny_model_dir, dtype="float32")
+    engine = LLMEngine.from_config(EngineConfig(
+        model_config=mcfg,
+        cache_config=CacheConfig(
+            block_size=16, num_blocks=256, cache_dtype=mcfg.dtype,
+        ),
+        scheduler_config=SchedulerConfig(
+            max_num_seqs=40, prefill_buckets=(32,),
+            max_num_batched_tokens=32,
+        ),
+        parallel_config=ParallelConfig(),
+        lora_config=LoRAConfig(),
+        attention_backend="ragged",
+    ))
+    sched = engine.scheduler
+    assert sched.chunk_budget == 32
+    assert sched.ragged_buckets == [16, 32, 64, 128]
+
+    buckets: list[int] = []
+    orig = engine.runner.prepare_ragged
+
+    def spy(plan):
+        prep = orig(plan)
+        buckets.append(prep.bucket)
+        return prep
+
+    engine.runner.prepare_ragged = spy
+    engine.precompile("max")
+    assert not engine.has_unfinished_requests()
+    # 64 needs base > 32 running rows (prompt warmups cap at the 32
+    # chunk budget): only the mixed tail phase reaches it
+    assert 64 in buckets
+    # 128 is unreachable at this config (base <= 40, chunk <= 32 ->
+    # desired <= 72; _ragged_bucket(41) = 64): must be skipped
+    assert 128 not in buckets
+
+
+def test_ragged_precompile_tail_skips_full_batch_prev_route(tiny_model_dir):
+    """prev == max_num_seqs must NOT take the prev-route: parking
+    max_num_seqs one-token rows leaves zero free slots, so the filler
+    prompt could never be admitted and the warm cycle was a guaranteed
+    miss (park + drain paid for nothing).  The bucket is unreachable at
+    serving time too (base <= 63 with a prefill slot caps the plan at
+    bucket 64), so the right behavior is a silent skip."""
+    from vllm_tgis_adapter_tpu.engine.config import (
+        CacheConfig,
+        EngineConfig,
+        LoRAConfig,
+        ModelConfig,
+        ParallelConfig,
+        SchedulerConfig,
+    )
+    from vllm_tgis_adapter_tpu.engine.core import LLMEngine
+
+    mcfg = ModelConfig.from_pretrained(tiny_model_dir, dtype="float32")
+    engine = LLMEngine.from_config(EngineConfig(
+        model_config=mcfg,
+        cache_config=CacheConfig(
+            block_size=16, num_blocks=256, cache_dtype=mcfg.dtype,
+        ),
+        scheduler_config=SchedulerConfig(
+            max_num_seqs=64, prefill_buckets=(32,),
+            max_num_batched_tokens=32,
+        ),
+        parallel_config=ParallelConfig(),
+        lora_config=LoRAConfig(),
+        attention_backend="ragged",
+    ))
+    sched = engine.scheduler
+    assert sched.ragged_buckets == [16, 32, 64, 128]
+
+    buckets: list[int] = []
+    warm_ids: list[str] = []
+    orig_prep = engine.runner.prepare_ragged
+    orig_add = engine.add_request
+
+    def spy_prep(plan):
+        prep = orig_prep(plan)
+        buckets.append(prep.bucket)
+        return prep
+
+    def spy_add(request_id, *args, **kwargs):
+        warm_ids.append(request_id)
+        return orig_add(request_id, *args, **kwargs)
+
+    engine.runner.prepare_ragged = spy_prep
+    engine.add_request = spy_add
+    engine.precompile("max")
+    assert not engine.has_unfinished_requests()
+    # bucket 64 warms via the prev=32 route as before
+    assert 64 in buckets
+    # bucket 128: prev == max_num_seqs == 64 — no rows may be parked
+    # for a warm that cannot admit its filler
+    assert 128 not in buckets
+    assert not [r for r in warm_ids if r.startswith("__warmup_mix_128")]
+
+
+def test_ragged_seen_seed_pad_ignores_decode_rows(tiny_model_dir):
+    """Only finishing prompts seed the seen matrix, so the seeding pad
+    width must track the seeding prompts — not a decode row whose
+    all_token_ids has grown past the largest prefill bucket (that would
+    retrace jitted set_seen_rows at every quantum the longest running
+    generation crosses, with an ever-larger host transfer)."""
+    from vllm_tgis_adapter_tpu.engine.sampling_params import SamplingParams
+
+    rng = np.random.default_rng(31)
+    long_ids = rng.integers(3, 500, size=120).tolist()
+    shorts = [rng.integers(3, 500, size=8).tolist() for _ in range(4)]
+
+    def run(backend, widths=None):
+        engine = _make_engine(tiny_model_dir, backend)
+        if widths is not None:
+            orig = engine.runner.prepare_ragged
+
+            def spy(plan):
+                prep = orig(plan)
+                widths.append(prep.seed_tokens.shape[1])
+                return prep
+
+            engine.runner.prepare_ragged = spy
+        engine.add_request(
+            "long", None,
+            SamplingParams(temperature=0.0, max_tokens=60, ignore_eos=True),
+            prompt_token_ids=list(long_ids),
+        )
+        outs = {}
+        pending = list(enumerate(shorts))
+        # stagger the short prompts into the long request's decode
+        # phase, after its total length has crossed the largest bucket
+        for step in range(1000):
+            if pending and step >= 12 and step % 6 == 0:
+                i, ids = pending.pop(0)
+                engine.add_request(
+                    f"s{i}", None,
+                    SamplingParams(
+                        temperature=0.0, max_tokens=2, ignore_eos=True
+                    ),
+                    prompt_token_ids=list(ids),
+                )
+            for o in engine.step():
+                outs[o.request_id] = list(o.outputs[0].token_ids)
+            if not engine.has_unfinished_requests() and not pending:
+                break
+        assert not engine.has_unfinished_requests()
+        assert not pending
+        return outs
+
+    widths: list[int] = []
+    r_ragged = run("ragged", widths)
+    r_bucketed = run("bucketed")
+    assert r_ragged == r_bucketed
+    assert len(r_ragged) == 5
+    # the longest SEEDING prompt is 120 tokens (pad 128); the long
+    # request's 120+60-token decode rows must not widen it to 256
+    assert widths and max(widths) <= 128
+
+
+def test_runner_jits_are_compile_tracker_wrapped():
+    """Every jax.jit in runner.py is wrapped in track_jit, and
+    ops/ragged_attention.py introduces no untracked module-level jit —
+    its entry points compile inside the runner's tracked programs (the
+    tpulint registry carries ragged_forward for the same reason)."""
+    runner_src = (
+        REPO_ROOT / "vllm_tgis_adapter_tpu" / "engine" / "runner.py"
+    ).read_text()
+    tree = ast.parse(runner_src)
+
+    def is_jit(node):
+        return (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "jit"
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id == "jax"
+        )
+
+    def jit_descendants(node):
+        return [n for n in ast.walk(node) if is_jit(n)]
+
+    tracked = set()
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "track_jit"
+        ):
+            for arg in node.args:
+                tracked.update(id(j) for j in jit_descendants(arg))
+
+    def is_boot_time(jit_call):
+        # jax.jit(lambda: ...) with no params is a one-shot boot-time
+        # allocator (the sharded cache build), not a serving entry
+        # point — same exemption tpulint's TPL104 applies
+        return any(
+            isinstance(a, ast.Lambda) and not a.args.args
+            for a in jit_call.args
+        )
+
+    untracked = [
+        j.lineno for j in jit_descendants(tree)
+        if id(j) not in tracked and not is_boot_time(j)
+    ]
+    assert not untracked, (
+        f"runner.py has jax.jit calls outside track_jit at lines "
+        f"{untracked} — every jitted entry point must be "
+        f"compile-tracker-wrapped"
+    )
+
+    ragged_src = (
+        REPO_ROOT / "vllm_tgis_adapter_tpu" / "ops" / "ragged_attention.py"
+    ).read_text()
+    ragged_tree = ast.parse(ragged_src)
+    assert not jit_descendants(ragged_tree), (
+        "ops/ragged_attention.py must not jit its own entry points — "
+        "they compile inside the runner's tracked programs"
+    )
+
+    # the tpulint registry knows the ragged entry point (satellite
+    # contract: new jit-registry entries ride along with the kernel)
+    import sys
+
+    sys.path.insert(0, str(REPO_ROOT))
+    try:
+        from tools.tpulint import config as tpulint_config
+    finally:
+        sys.path.pop(0)
+    assert "LlamaForCausalLM.ragged_forward" in tpulint_config.JIT_REGISTRY[
+        "models/llama.py"
+    ]
+
+
+# ------------------------------------------------------------------ chaos
+
+
+def test_ragged_dispatch_failpoint_replays_onto_ragged_path(tiny_model_dir):
+    """Chaos case: a failpoint in the ragged dispatch kills the step
+    loop before any token is emitted; the supervisor must replay the
+    requests into the rebuilt engine and finish them ON the ragged path
+    (token-identical to an uncrashed ragged run)."""
+    from vllm_tgis_adapter_tpu.engine.async_llm import AsyncLLMEngine
+    from vllm_tgis_adapter_tpu.engine.config import (
+        CacheConfig,
+        EngineConfig,
+        FrontdoorConfig,
+        LoRAConfig,
+        ModelConfig,
+        ParallelConfig,
+        SchedulerConfig,
+    )
+    from vllm_tgis_adapter_tpu.engine.sampling_params import SamplingParams
+    from vllm_tgis_adapter_tpu.supervisor import failpoints
+
+    def build():
+        mcfg = ModelConfig.from_pretrained(tiny_model_dir, dtype="float32")
+        config = EngineConfig(
+            model_config=mcfg,
+            cache_config=CacheConfig(
+                block_size=16, num_blocks=64, cache_dtype=mcfg.dtype
+            ),
+            scheduler_config=SchedulerConfig(
+                max_num_seqs=4, prefill_buckets=(32, 64)
+            ),
+            parallel_config=ParallelConfig(),
+            lora_config=LoRAConfig(),
+            max_engine_restarts=3,
+            engine_restart_backoff_s=0.02,
+            frontdoor=FrontdoorConfig(enabled=True),
+            attention_backend="ragged",
+        )
+        return AsyncLLMEngine.from_config(config)
+
+    async def run(engine):
+        async def one(i):
+            final = None
+            async for out in engine.generate(
+                prompt=None,
+                sampling_params=SamplingParams(
+                    temperature=0.0, max_tokens=6, ignore_eos=True
+                ),
+                request_id=f"r{i}",
+                prompt_token_ids=[5 + i] * 12,
+            ):
+                final = out
+            return list(final.outputs[0].token_ids)
+
+        await engine.start()
+        try:
+            return await asyncio.gather(*[one(i) for i in range(3)])
+        finally:
+            await engine.stop()
+
+    failpoints.disarm()
+    baseline = asyncio.run(run(build()))
+
+    engine = build()
+    failpoints.arm("runner.dispatch_ragged=raise:1")
+    try:
+        replayed = asyncio.run(run(engine))
+        fired = failpoints.fired("runner.dispatch_ragged")
+    finally:
+        failpoints.disarm()
+    assert fired == 1, "failpoint never fired — the chaos case is vacuous"
+    assert replayed == baseline
+    assert engine.supervisor is not None
+    assert engine.supervisor.restart_history, "no supervised restart ran"

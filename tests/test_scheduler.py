@@ -235,3 +235,34 @@ def test_mid_chunk_prefill_sequence_is_preemptible():
     assert b.status == SequenceStatus.PREEMPTED
     assert b.blocks is None and b.prefill_pos == 0
     assert b in sched.waiting  # never left the queue; re-runs from chunk 0
+
+
+def test_ragged_fully_prefilled_waiting_row_reruns_and_finishes():
+    """Defensive path of _schedule_ragged: a waiting row whose prompt is
+    somehow already fully prefilled (impossible today — guarded against
+    future prefix-adoption/replay changes) must re-run its last position
+    and leave the queue, not wedge as a perpetual zero-chunk candidate."""
+    from vllm_tgis_adapter_tpu.engine.kv_cache import SequenceBlocks
+    from vllm_tgis_adapter_tpu.engine.scheduler import RaggedPlan
+    from vllm_tgis_adapter_tpu.engine.sequence import SequenceStatus
+
+    sched = make_scheduler(num_blocks=16)
+    sched.ragged = True
+    seq = make_seq("a", 6)
+    sched.add(seq)
+    # hand-build the supposedly impossible state: pages + slot held,
+    # prefill_pos past the end, still parked in waiting
+    seq.blocks = SequenceBlocks(sched.allocator)
+    seq.blocks.ensure_capacity(len(seq.all_token_ids))
+    seq.slot = sched._free_slots.pop()
+    seq.prefill_pos = len(seq.all_token_ids)
+
+    plan = sched.schedule()
+    assert isinstance(plan, RaggedPlan)
+    [item] = plan.items
+    assert item.seq is seq
+    assert item.start_pos == len(seq.all_token_ids) - 1
+    assert item.token_ids == [seq.all_token_ids[-1]]
+    assert item.is_final and not item.is_decode
+    assert seq.status == SequenceStatus.RUNNING
+    assert seq in sched.running and seq not in sched.waiting

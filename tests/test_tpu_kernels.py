@@ -331,3 +331,80 @@ def test_int8_weight_only_matmul_matches_on_chip():
         np.asarray(got, np.float32), np.asarray(ref, np.float32),
         rtol=5e-2, atol=5e-2,
     )
+
+
+@pytest.mark.parametrize(
+    "num_kv,g,head_dim,block_size,dtype",
+    [
+        (8, 4, 128, 16, jnp.bfloat16),  # llama-8B-ish shapes
+        (4, 1, 64, 16, jnp.float32),  # MHA small-head
+    ],
+)
+def test_ragged_kernel_compiles_and_matches(
+    num_kv, g, head_dim, block_size, dtype
+):
+    """Ragged paged attention (ops/ragged_attention.py): the Mosaic
+    lowering gate the default flip waits on (docs/ATTENTION.md,
+    ROADMAP item 1).  Mixed prefill-chunk + decode spans, host-built
+    sparse work schedule, compared against the XLA reference on the
+    same device."""
+    from vllm_tgis_adapter_tpu.ops import ragged_attention as ra
+
+    rng = np.random.default_rng(3)
+    h = num_kv * g
+    num_slots = 64 * block_size
+    kc = jnp.asarray(
+        rng.standard_normal((num_kv, num_slots, head_dim)), dtype
+    )
+    vc = jnp.asarray(
+        rng.standard_normal((num_kv, num_slots, head_dim)), dtype
+    )
+    # spans: fresh 130-token prefill, one decode row deep in context,
+    # a 50-token chunk resuming at position 77
+    cases = [(0, 130), (255, 1), (77, 50)]
+    max_blocks = 32
+    tables = np.zeros((4, max_blocks), np.int32)
+    spans, pos_base, flat_pos = [], [], []
+    next_block, row = 0, 0
+    for s, (ctx, n_new) in enumerate(cases):
+        nb = -(-(ctx + n_new) // block_size)
+        tables[s, :nb] = range(next_block, next_block + nb)
+        next_block += nb
+        spans.append((row, n_new, ctx))
+        pos_base.append(ctx)
+        flat_pos += list(range(ctx, ctx + n_new))
+        row += n_new
+    t = row
+    block_q = 128
+    t_pad = -(-t // block_q) * block_q
+    q = jnp.asarray(
+        np.pad(rng.standard_normal((t, h, head_dim)),
+               ((0, t_pad - t), (0, 0), (0, 0))), dtype
+    )
+    positions = np.zeros(t_pad, np.int32)
+    positions[:t] = flat_pos
+    seq_starts = np.full(5, t_pad, np.int32)
+    for s, (start, _, _) in enumerate(spans):
+        seq_starts[s] = start
+    seq_starts[len(spans)] = t
+    pb = np.zeros(4, np.int32)
+    pb[:3] = pos_base
+    scale = head_dim**-0.5
+    work = ra.build_work_schedule(
+        spans, tables, block_size=block_size, block_q=block_q,
+        t_pad=t_pad,
+    )
+    got = ra._ragged_attention_pallas(
+        q, kc, vc, jnp.asarray(seq_starts), jnp.asarray(pb),
+        jnp.asarray(work), block_size, scale, block_q=block_q,
+        window=0, alibi_slopes=None, interpret=False,
+    )
+    got.block_until_ready()  # forces the Mosaic compile + execute
+    ref = ra.ragged_attention_xla(
+        q, kc, vc, jnp.asarray(positions), jnp.asarray(seq_starts),
+        jnp.asarray(t), jnp.asarray(tables), block_size, scale,
+    )
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32)[:t], np.asarray(ref, np.float32)[:t],
+        rtol=2e-2, atol=2e-2,
+    )

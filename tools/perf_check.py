@@ -1,0 +1,181 @@
+"""Perf regression gate: ``nox -s perf_check`` (ROADMAP item 5, minimal core).
+
+Runs the closed-loop mini-bench (bench.py machinery, CPU proxy,
+BENCH_TINY-sized) once per serving data path and diffs the results
+against the checked-in ``PERF_BASELINE.json``:
+
+* ``aggregate_output_tok_per_s`` — fails on > ``tolerance`` (default
+  20%) regression against the baseline, using the BEST of ``runs``
+  short passes per backend to damp scheduler/load jitter (the r05
+  lesson: a single 0.5s timed pass swings 3x run-to-run, which is how
+  the 1847 → 466 drop went unattributed for a round — BASELINE.md
+  "Perf regression log");
+* ``padding_waste_frac`` — fails when the padding fraction grows more
+  than ``waste_slack`` absolute over the baseline (the ragged backend's
+  whole claim is waste ≈ 0; a silent return of bucket padding is a
+  regression even if tok/s survives);
+* cross-path sanity: the ragged path must not fall below the bucketed
+  path's throughput (it currently clears it ~3.5x on the CPU proxy).
+
+Exit codes follow obs_check: 0 green, 1 regression, 2 tool error.
+Update the baseline deliberately with ``--write`` after a reviewed
+perf-relevant change; the JSON records the config knobs it was
+measured under.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+BASELINE_PATH = REPO_ROOT / "PERF_BASELINE.json"
+
+
+def run_bench(backend: str, env_overrides: dict) -> dict:
+    env = dict(os.environ)
+    env.update(env_overrides)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["BENCH_FORCE_CPU"] = "1"
+    env["BENCH_TINY"] = "1"
+    env["BENCH_ATTENTION_BACKEND"] = backend
+    proc = subprocess.run(
+        [sys.executable, str(REPO_ROOT / "bench.py")],
+        capture_output=True, text=True, timeout=600, env=env,
+    )
+    line = None
+    for candidate in reversed(proc.stdout.strip().splitlines()):
+        try:
+            parsed = json.loads(candidate)
+        except ValueError:
+            continue
+        if isinstance(parsed, dict):
+            line = parsed
+            break
+    if proc.returncode != 0 or line is None:
+        raise RuntimeError(
+            f"bench.py ({backend}) failed rc={proc.returncode}: "
+            f"{proc.stderr[-400:]}"
+        )
+    if "error" in line:
+        raise RuntimeError(f"bench.py ({backend}) errored: {line['error']}")
+    return line
+
+
+def measure(backend: str, runs: int, env_overrides: dict) -> dict:
+    best = None
+    for _ in range(runs):
+        line = run_bench(backend, env_overrides)
+        if best is None or line["value"] > best["value"]:
+            best = line
+    return {
+        "aggregate_output_tok_per_s": best["value"],
+        "padding_waste_frac": best["padding_waste_frac"],
+        "compiled_shapes": best["compiled_shapes"],
+    }
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    write = "--write" in argv
+    try:
+        baseline = json.loads(BASELINE_PATH.read_text())
+    except FileNotFoundError:
+        if not write:
+            print(f"perf_check: {BASELINE_PATH} missing — run --write first")
+            return 2
+        baseline = {"backends": {}}
+    runs = int(baseline.get("runs", 2))
+    tolerance = float(baseline.get("tolerance", 0.20))
+    waste_slack = float(baseline.get("waste_slack", 0.05))
+    env_overrides = dict(baseline.get("env", {}))
+
+    measured: dict[str, dict] = {}
+    for backend in ("bucketed", "ragged"):
+        try:
+            measured[backend] = measure(backend, runs, env_overrides)
+        except Exception as exc:  # noqa: BLE001 — tool boundary
+            print(f"perf_check: measurement failed for {backend}: {exc}")
+            return 2
+        m = measured[backend]
+        print(
+            f"perf_check: {backend:8s} "
+            f"tok/s={m['aggregate_output_tok_per_s']:8.1f} "
+            f"waste={m['padding_waste_frac']:.4f} "
+            f"shapes={m['compiled_shapes']}"
+        )
+
+    if write:
+        out = {
+            "_comment": (
+                "CPU-proxy perf floors for nox -s perf_check (best of "
+                "`runs` BENCH_TINY passes per backend; see "
+                "tools/perf_check.py and BASELINE.md 'Perf regression "
+                "log').  Update with `python tools/perf_check.py "
+                "--write` after a reviewed perf-relevant change."
+            ),
+            "runs": runs,
+            "tolerance": tolerance,
+            "waste_slack": waste_slack,
+            "env": env_overrides,
+            "backends": {
+                name: {
+                    "aggregate_output_tok_per_s": round(
+                        m["aggregate_output_tok_per_s"], 1
+                    ),
+                    "padding_waste_frac": round(
+                        m["padding_waste_frac"], 4
+                    ),
+                }
+                for name, m in measured.items()
+            },
+        }
+        BASELINE_PATH.write_text(json.dumps(out, indent=2) + "\n")
+        print(f"perf_check: baseline written to {BASELINE_PATH}")
+        return 0
+
+    failures = []
+    for backend, base in baseline.get("backends", {}).items():
+        m = measured.get(backend)
+        if m is None:
+            failures.append(f"{backend}: no measurement")
+            continue
+        floor = base["aggregate_output_tok_per_s"] * (1.0 - tolerance)
+        if m["aggregate_output_tok_per_s"] < floor:
+            failures.append(
+                f"{backend}: {m['aggregate_output_tok_per_s']:.1f} tok/s "
+                f"< floor {floor:.1f} (baseline "
+                f"{base['aggregate_output_tok_per_s']:.1f} - {tolerance:.0%})"
+            )
+        waste_ceiling = base["padding_waste_frac"] + waste_slack
+        if m["padding_waste_frac"] > waste_ceiling:
+            failures.append(
+                f"{backend}: padding waste {m['padding_waste_frac']:.4f} "
+                f"> ceiling {waste_ceiling:.4f} (baseline "
+                f"{base['padding_waste_frac']:.4f} + {waste_slack})"
+            )
+    if (
+        "ragged" in measured
+        and "bucketed" in measured
+        and measured["ragged"]["aggregate_output_tok_per_s"]
+        < measured["bucketed"]["aggregate_output_tok_per_s"]
+    ):
+        failures.append(
+            "ragged backend fell below the bucketed backend's tok/s — "
+            "the unified path must never be the slower one"
+        )
+
+    if failures:
+        print("perf_check: REGRESSION")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    print("perf_check: green")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

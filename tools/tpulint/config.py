@@ -57,6 +57,9 @@ JIT_REGISTRY: dict[str, frozenset[str]] = {
         "LlamaForCausalLM.prefill",
         "LlamaForCausalLM.prefill_chunk",
         "LlamaForCausalLM.decode",
+        # ragged backend (ops/ragged_attention.py): the unified mixed
+        # prefill+decode entry point, jitted as runner._ragged_fn
+        "LlamaForCausalLM.ragged_forward",
     }),
 }
 
@@ -64,6 +67,9 @@ JIT_REGISTRY: dict[str, frozenset[str]] = {
 #: functools.partial or passed as Python scalars, never traced).
 REGISTRY_STATIC_PARAMS: frozenset[str] = frozenset({
     "self", "block_size", "first_stage", "last_stage",
+    # closed over as a Python bool by the ragged fused-decode builder
+    # (runner._build_decode_fn); never traced
+    "use_ragged_kernel",
 })
 
 #: identifiers that mark a value as (probably) a live device array for

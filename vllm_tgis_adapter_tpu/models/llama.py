@@ -757,6 +757,78 @@ class LlamaForCausalLM:
             x = x[logits_indices]
         return self._logits(params, x), (k_cache, v_cache)
 
+    @_clears_moe_mask
+    def ragged_forward(
+        self,
+        params: dict,
+        caches: tuple[jax.Array, jax.Array],
+        token_ids: jax.Array,  # [T] flat mixed stream, padded to a bucket
+        positions: jax.Array,  # [T] GLOBAL position per row
+        slot_mapping: jax.Array,  # [T] cache slot per row; -1 pads
+        seq_starts: jax.Array,  # [S+1] flat span start per sequence
+        pos_base: jax.Array,  # [S] global position of each span's first row
+        total_tokens: jax.Array,  # scalar: real rows in the stream
+        block_tables: jax.Array,  # [S, max_blocks]
+        logits_indices: jax.Array,  # [R] rows to compute logits for
+        lora=None,  # LoRAStacks or None
+        lora_idx: jax.Array | None = None,  # [T] adapter slot per ROW
+        *,
+        block_size: int,
+        work: jax.Array | None = None,  # Pallas work schedule (TPU only)
+    ) -> tuple[jax.Array, tuple[jax.Array, jax.Array]]:
+        """One forward over a mixed ragged prefill+decode token stream.
+
+        The ragged backend's unified step (ops/ragged_attention.py):
+        each sequence owns a contiguous span of the flat token axis — a
+        whole prompt, a prefill chunk, or a single decode token — and
+        every row attends causally to its sequence's paged context.
+        This single entry point replaces the solo-prefill, packed-
+        prefill, chunked-prefill AND single-step-decode programs of the
+        bucketed path, so the compile lattice collapses to the flat
+        token buckets.
+        """
+        k_cache, v_cache = caches
+        scale = self._attention_scale()
+        tables = self._rope_tables(positions)
+        self._moe_valid_mask = slot_mapping >= 0  # see prefill
+        safe_slots = jnp.where(slot_mapping < 0, k_cache.shape[2], slot_mapping)
+
+        def attend(i, q, k, v):
+            nonlocal k_cache, v_cache
+            k_cache = k_cache.at[i, :, safe_slots].set(
+                k.astype(k_cache.dtype), mode="drop"
+            )
+            v_cache = v_cache.at[i, :, safe_slots].set(
+                v.astype(v_cache.dtype), mode="drop"
+            )
+            from vllm_tgis_adapter_tpu.ops.ragged_attention import (
+                ragged_paged_attention,
+            )
+
+            return ragged_paged_attention(
+                q, k_cache[i], v_cache[i], positions, seq_starts,
+                pos_base, total_tokens, block_tables, block_size, scale,
+                work=work, mesh=self.mesh,
+                window=self._window_for_layer(i),
+                alibi_slopes=self.alibi,
+            )
+
+        x = self._embed(params, token_ids, positions)
+        for i, layer in enumerate(params["layers"]):
+            dl = None
+            if lora is not None:
+                dl = (
+                    lambda target, xx, i=i: _lora_delta_batched(
+                        lora, i, lora_idx, target, xx
+                    )
+                )
+            x = self._decoder_block(
+                layer, x, lambda q, k, v, i=i: attend(i, q, k, v), dl,
+                tables,
+            )
+        x = x[logits_indices]
+        return self._logits(params, x), (k_cache, v_cache)
+
     def verify(
         self,
         params: dict,
@@ -845,8 +917,17 @@ class LlamaForCausalLM:
         hidden: jax.Array | None = None,  # [B, d] from the previous pp stage
         first_stage: bool = True,
         last_stage: bool = True,
+        use_ragged_kernel: bool = False,  # static: ragged-backend decode
     ) -> tuple[jax.Array, tuple[jax.Array, jax.Array]]:
-        """One decode step for the whole (padded) running batch."""
+        """One decode step for the whole (padded) running batch.
+
+        ``use_ragged_kernel`` (static, closed over by the ragged
+        backend's fused-decode builder) routes attention through the
+        unified ragged kernel instead of the bucketed decode ladder —
+        each batch row is a one-token span, so the decode wave and the
+        mixed ragged step run the SAME kernel and the
+        folded → perhead → xla variant chain is retired on that path.
+        """
         cfg = self.config
         k_cache, v_cache = caches
         scale = self._attention_scale()
@@ -863,6 +944,26 @@ class LlamaForCausalLM:
             v_cache = v_cache.at[i, :, safe_slots].set(
                 v.astype(v_cache.dtype), mode="drop"
             )
+            if use_ragged_kernel:
+                from vllm_tgis_adapter_tpu.ops.ragged_attention import (
+                    ragged_paged_attention,
+                )
+
+                b = token_ids.shape[0]
+                # one-token spans: row i is sequence i at position
+                # context_lens[i] - 1 (dead rows carry context 1/slot -1
+                # and their garbage output is discarded by the sampler
+                # mask, same as the bucketed decode contract)
+                return ragged_paged_attention(
+                    q, k_cache[i], v_cache[i],
+                    jnp.maximum(context_lens, 1) - 1,
+                    jnp.arange(b + 1, dtype=jnp.int32),
+                    jnp.maximum(context_lens, 1) - 1,
+                    jnp.asarray(b, jnp.int32),
+                    block_tables, block_size, scale, mesh=self.mesh,
+                    window=self._window_for_layer(i),
+                    alibi_slopes=self.alibi,
+                )
             return attn_ops.paged_decode_attention(
                 q, k_cache[i], v_cache[i], block_tables, context_lens,
                 block_size, scale, mesh=self.mesh,

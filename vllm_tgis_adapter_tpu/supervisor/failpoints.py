@@ -70,6 +70,8 @@ KNOWN_SITES = (
     "core.commit_step",     # host commit phase (engine lock held)
     "scheduler.schedule",   # scheduler planning inside plan_step
     "runner.dispatch_decode",   # decode dispatch inside the runner
+    "runner.dispatch_ragged",   # unified ragged dispatch
+    #                             (--attention-backend=ragged)
     "runner.dispatch_prefill",  # prefill dispatch inside the runner
     "supervisor.rebuild",   # engine rebuild — death DURING recovery
     "supervisor.replay",    # request replay — death during replay
