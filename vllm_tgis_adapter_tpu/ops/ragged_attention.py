@@ -1,0 +1,484 @@
+"""Ragged paged attention: one kernel, one dispatch, zero bucket padding.
+
+The bucketed data path runs THREE attention families per engine step —
+solo/packed flash prefill over a padded prompt bucket, chunked prefill
+against the paged cache, and a per-batch-width decode ladder
+(folded → perhead → xla).  Every family carries its own compile lattice
+and its own padding.  This module collapses them into ONE computation
+(PAPERS.md: *Ragged Paged Attention — A High-Performance and Flexible
+LLM Inference Kernel for TPU*): the engine hands the kernel a FLAT token
+stream in which each sequence owns a contiguous span — a whole prompt, a
+prefill chunk, or a single decode token — plus per-sequence descriptors,
+and every row attends causally to its sequence's paged KV context.  A
+mixed prefill+decode batch is one dispatch with no per-prompt bucket
+padding; the only pad is the tail of the single flat-length bucket.
+
+Layout contract (shared with ops/attention.py):
+* KV cache per layer is head-leading ``[Hkv, num_slots, Dh]`` — a page is
+  a contiguous ``(block_size, Dh)`` Mosaic-legal tile;
+* the caller scatters this step's K/V into the cache BEFORE attention,
+  so prefill rows see their own chunk and decode rows see their token
+  through the same paged read path — that unification is what removes
+  the separate prefill/decode kernels.
+
+Descriptors (all device arrays; S = padded sequence-descriptor width):
+* ``seq_starts [S+1]`` — flat row where sequence s's span begins; spans
+  are contiguous and sorted; unused/pad entries hold the padded stream
+  length, so a span's membership test is just its two bounds;
+* ``pos_base [S]`` — global position of sequence s's first row (chunk
+  ``start_pos``; ``num_tokens - 1`` for a decode row);
+* ``block_tables [S, max_blocks]`` — page table per sequence;
+* ``positions [T]`` — global position per row (redundant with
+  pos_base/seq_starts; the XLA path uses it directly, the Pallas kernel
+  re-derives it from SMEM scalars to avoid vector gathers).
+
+Pallas kernel: grid ``(kv_head, work_item)`` over a precomputed WORK
+SCHEDULE — one item per (query block, sequence, logical page) triple that
+actually overlaps, exactly the ragged-friendly formulation the paper's
+kernel uses instead of a dense (batch, page) grid.  The schedule rides
+scalar prefetch; pages DMA straight out of the paged cache via the
+BlockSpec index map (the gather happens in the memory system).  Mixed
+engine steps pass a host-built sparse schedule (``build_work_schedule``);
+in-jit callers (the fused decode scan) build the dense per-row schedule
+in-trace (``dense_work_schedule``).  Numerics: f32 online-softmax
+accumulation, masking identical to ``paged_decode_attention_xla`` — the
+XLA path below IS that function, so parity is pinned to the same
+reference the bucketed kernels are.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from vllm_tgis_adapter_tpu.jax_compat import shard_map
+from vllm_tgis_adapter_tpu.ops.attention import (
+    NEG_INF,
+    _pallas_interpret,
+    _use_pallas,
+    paged_decode_attention_xla,
+)
+
+#: work-schedule row layout ([WORK_FIELDS, W] i32): query-block index,
+#: sequence id, physical page id (DMA target), logical page index within
+#: the sequence, first-item-of-block flag, last-item-of-block flag,
+#: live flag (0 = padding/masked item: no compute, accumulators only).
+WORK_FIELDS = 7
+
+
+def _pow2_ceil(n: int) -> int:
+    b = 1
+    while b < n:
+        b *= 2
+    return b
+
+
+# ------------------------------------------------------------- schedules
+
+
+def build_work_schedule(
+    spans: list[tuple[int, int, int]],  # per seq: (start_row, n_rows, pos_base)
+    block_tables: "np.ndarray",  # [S, max_blocks] int32
+    *,
+    block_size: int,
+    block_q: int,
+    t_pad: int,
+    w_bucket: int | None = None,
+) -> "np.ndarray":
+    """Host-side sparse schedule for a mixed ragged batch.
+
+    Emits one work item per (query block, sequence, logical page) triple
+    whose page could be causally visible to some row of that sequence in
+    that block — the exact page set, so the kernel never DMAs a page no
+    row reads.  Windowed layers mask inside the kernel (the schedule is
+    shared across layers and some layers may be full-attention, so it
+    must cover the full causal span).  Every query block gets at least
+    one item (a dead one if the block is all padding) so its output
+    block is always initialised and finalised.
+
+    Returns ``[WORK_FIELDS, W]`` int32 with W padded to a power of two
+    (``w_bucket`` overrides) — the schedule width is a compile shape.
+    """
+    nq = t_pad // block_q
+    per_block: list[list[tuple[int, int, int, int]]] = [[] for _ in range(nq)]
+    for s, (start, n_rows, pos0) in enumerate(spans):
+        if n_rows <= 0:
+            continue
+        lo_block = start // block_q
+        hi_block = (start + n_rows - 1) // block_q
+        for qb in range(lo_block, hi_block + 1):
+            # deepest position any of this sequence's rows in block qb
+            # can see: its last row's own position
+            row_hi = min(start + n_rows - 1, (qb + 1) * block_q - 1)
+            max_pos = pos0 + (row_hi - start)
+            for j in range(max_pos // block_size + 1):
+                per_block[qb].append((s, int(block_tables[s, j]), j, 1))
+    items: list[tuple[int, ...]] = []
+    for qb in range(nq):
+        blk = per_block[qb] or [(0, 0, 0, 0)]  # dead item: zeros the block
+        for i, (s, page, j, live) in enumerate(blk):
+            items.append((
+                qb, s, page, j,
+                1 if i == 0 else 0,
+                1 if i == len(blk) - 1 else 0,
+                live,
+            ))
+    w = len(items)
+    width = w_bucket or _pow2_ceil(w)
+    work = np.zeros((WORK_FIELDS, width), np.int32)
+    work[:, :w] = np.asarray(items, np.int32).T
+    if width > w:
+        # pads keep the final real block's index so the output pipeline
+        # never revisits an earlier block; flags all zero = no-ops
+        work[0, w:] = items[-1][0]
+    return work
+
+
+def dense_work_schedule(
+    pos_base: jax.Array,  # [S] i32: context position per row (= seq)
+    block_tables: jax.Array,  # [S, max_blocks] i32
+    *,
+    block_size: int,
+    block_q: int,
+    t_pad: int,
+) -> jax.Array:
+    """In-trace schedule for the fused decode scan, where every span is
+    exactly ONE row (``seq_starts = arange(S+1)``): sequence *s* IS flat
+    row *s*, so its items live only in query block ``s // block_q`` and
+    the schedule is the plain (sequence, logical page) cross product —
+    W = S · max_blocks grid steps, nq× fewer than the general
+    (q-block, sequence, page) product would need.  Pages past a row's
+    context carry ``live=0`` with their DMA index clamped to a live page
+    so consecutive identical indices elide the transfer (same trick as
+    the decode kernel's ``page_index``).  Descriptor slots past the
+    stream (pad sequences, when the caller's S exceeds the row count)
+    clamp onto the last query block; their rows sit outside every real
+    span, so the kernel masks them and only pad outputs are touched.
+    """
+    s_count, max_blocks = block_tables.shape
+    nq = t_pad // block_q
+    w = jnp.arange(s_count * max_blocks, dtype=jnp.int32)
+    s = w // max_blocks
+    j = w % max_blocks
+    qb = jnp.minimum(s // block_q, nq - 1)
+    max_pos = jnp.take(pos_base, s)
+    live = j * block_size <= max_pos
+    j_eff = jnp.minimum(j, jnp.maximum(max_pos, 0) // block_size)
+    page = jnp.take_along_axis(
+        jnp.take(block_tables, s, axis=0), j_eff[:, None], axis=1
+    )[:, 0]
+    page = jnp.clip(page, 0, None)
+    # first/last flags on the block TRANSITIONS (not modular indexing):
+    # the clamp above can hand the last block a ragged item count, and
+    # every block's accumulators must init exactly once and finalise on
+    # the true final item
+    step = qb[1:] != qb[:-1]
+    edge = jnp.ones(1, bool)
+    first = jnp.concatenate([edge, step]).astype(jnp.int32)
+    last = jnp.concatenate([step, edge]).astype(jnp.int32)
+    return jnp.stack([
+        qb, s, page, j, first, last, live.astype(jnp.int32)
+    ])
+
+
+# ----------------------------------------------------------------- kernel
+
+
+def _ragged_kernel(
+    # scalar prefetch
+    work_ref,  # [WORK_FIELDS, W] SMEM work schedule
+    starts_ref,  # [S+1] SMEM flat span starts (pads = padded length)
+    base_ref,  # [S] SMEM global position of each span's first row
+    alibi_ref,  # [H] f32 SMEM slopes; unused unless use_alibi
+    # blocks
+    q_ref,  # [1, G*bq, Dh] VMEM — query block of kv head h
+    k_ref,  # [1, block_size, Dh] VMEM — page picked by index_map
+    v_ref,  # [1, block_size, Dh]
+    o_ref,  # [1, G*bq, Dh]
+    # scratch
+    m_ref,  # [G*bq, 1] f32 running max
+    l_ref,  # [G*bq, 1] f32 running denominator
+    acc_ref,  # [G*bq, Dh] f32 running numerator
+    *,
+    scale: float,
+    block_size: int,
+    block_q: int,
+    g: int,
+    window: int,
+    use_alibi: bool,
+):
+    h = pl.program_id(0)
+    w = pl.program_id(1)
+    seq = work_ref[1, w]
+    page_pos = work_ref[3, w]
+
+    @pl.when(work_ref[4, w] == 1)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    @pl.when(work_ref[6, w] == 1)
+    def _item():
+        q = q_ref[0].astype(jnp.float32)  # [G*bq, Dh]
+        k = k_ref[0].astype(jnp.float32)  # [bs, Dh]
+        v = v_ref[0].astype(jnp.float32)
+        s_mat = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [G*bq, bs]
+        # rows are (g, i) flattened row-major (chunked-kernel layout):
+        # flat token index = qb*bq + row % bq
+        row = jax.lax.broadcasted_iota(jnp.int32, s_mat.shape, dimension=0)
+        tok = work_ref[0, w] * block_q + row % block_q
+        # the item already names its sequence, and spans are contiguous
+        # and sorted — membership and global position are two SMEM
+        # scalar reads of the span bounds, not a scan over every
+        # descriptor slot (no vector gathers either way; rows outside
+        # the span mask out, so their garbage pos_row never matters)
+        start = starts_ref[seq]
+        pos_row = base_ref[seq] + tok - start
+        col = jax.lax.broadcasted_iota(jnp.int32, s_mat.shape, dimension=1)
+        k_pos = page_pos * block_size + col
+        keep = (
+            (tok >= start)
+            & (tok < starts_ref[seq + 1])
+            & (k_pos <= pos_row)
+        )
+        if window > 0:
+            keep &= pos_row - k_pos < window
+        if use_alibi:
+            # query head = h·G + (row // bq); 2-D selects, no 1-D gathers
+            slopes = jnp.full(s_mat.shape, alibi_ref[h * g], jnp.float32)
+            for gi in range(1, g):
+                slopes = jnp.where(
+                    row // block_q == gi, alibi_ref[h * g + gi], slopes
+                )
+            s_mat = s_mat + slopes * k_pos.astype(jnp.float32)
+        s_mat = jnp.where(keep, s_mat, NEG_INF)
+
+        m_prev = m_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s_mat, axis=-1, keepdims=True))
+        # fully masked rows keep m == -inf; pin the shift finite so exp
+        # stays NaN-free (house convention, see _prefill_kernel)
+        shift = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(s_mat - shift)
+        alpha = jnp.exp(
+            jnp.where(jnp.isfinite(m_prev), m_prev, shift) - shift
+        )
+        l_ref[...] = alpha * l_ref[...] + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = alpha * acc_ref[...] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[...] = m_new
+
+    @pl.when(work_ref[5, w] == 1)
+    def _finalize():
+        denom = jnp.maximum(l_ref[...], 1e-30)
+        o_ref[0] = (acc_ref[...] / denom).astype(o_ref.dtype)
+
+
+def _ragged_attention_pallas(
+    q: jax.Array,  # [T, H, Dh] flat mixed stream
+    k_cache: jax.Array,  # [Hkv, num_slots, Dh]
+    v_cache: jax.Array,
+    seq_starts: jax.Array,  # [S+1]
+    pos_base: jax.Array,  # [S]
+    work: jax.Array,  # [WORK_FIELDS, W]
+    block_size: int,
+    scale: float,
+    *,
+    block_q: int,
+    window: int,
+    alibi_slopes: jax.Array | None,
+    interpret: bool,
+) -> jax.Array:
+    t, num_heads, head_dim = q.shape
+    num_kv = k_cache.shape[0]
+    g = num_heads // num_kv
+    block_q = min(block_q, _pow2_ceil(t))
+    nq = pl.cdiv(t, block_q)
+    t_pad = nq * block_q
+
+    # [Hkv, nq·G·bq, Dh] with each q block laid out (G, bq) row-major —
+    # the chunked-prefill kernel's layout: one page DMA serves the whole
+    # GQA group of the block
+    qp = jnp.pad(q, ((0, t_pad - t), (0, 0), (0, 0)))
+    qh = jnp.transpose(
+        qp.reshape(nq, block_q, num_kv, g, head_dim), (2, 0, 3, 1, 4)
+    ).reshape(num_kv, nq * g * block_q, head_dim)
+
+    slopes = (
+        jnp.zeros(num_heads, jnp.float32)
+        if alibi_slopes is None
+        else alibi_slopes.astype(jnp.float32)
+    )
+    num_work = work.shape[1]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(num_kv, num_work),
+        in_specs=[
+            pl.BlockSpec(
+                (1, g * block_q, head_dim),
+                lambda h, w, wk, st, bs_, al: (h, wk[0, w], 0),
+            ),
+            pl.BlockSpec(
+                (1, block_size, head_dim),
+                lambda h, w, wk, st, bs_, al: (h, wk[2, w], 0),
+            ),
+            pl.BlockSpec(
+                (1, block_size, head_dim),
+                lambda h, w, wk, st, bs_, al: (h, wk[2, w], 0),
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, g * block_q, head_dim),
+            lambda h, w, wk, st, bs_, al: (h, wk[0, w], 0),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((g * block_q, 1), jnp.float32),
+            pltpu.VMEM((g * block_q, 1), jnp.float32),
+            pltpu.VMEM((g * block_q, head_dim), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _ragged_kernel, scale=scale, block_size=block_size,
+            block_q=block_q, g=g, window=window,
+            use_alibi=alibi_slopes is not None,
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(
+            (num_kv, nq * g * block_q, head_dim), q.dtype
+        ),
+        interpret=interpret,
+    )(work, seq_starts.astype(jnp.int32), pos_base.astype(jnp.int32),
+      slopes, qh, k_cache, v_cache)
+    return jnp.transpose(
+        out.reshape(num_kv, nq, g, block_q, head_dim), (1, 3, 0, 2, 4)
+    ).reshape(t_pad, num_heads, head_dim)[:t]
+
+
+# --------------------------------------------------------------- dispatch
+
+
+def ragged_attention_xla(
+    q: jax.Array,  # [T, H, Dh] flat mixed stream
+    k_cache: jax.Array,  # [Hkv, num_slots, Dh]
+    v_cache: jax.Array,
+    positions: jax.Array,  # [T] global position per row
+    seq_starts: jax.Array,  # [S+1] flat span starts (pads = T)
+    total_tokens: jax.Array,  # scalar: real rows in the stream
+    block_tables: jax.Array,  # [S, max_blocks]
+    block_size: int,
+    scale: float,
+    *,
+    window: int = 0,
+    alibi_slopes: jax.Array | None = None,
+) -> jax.Array:
+    """XLA reference: every ragged row IS a decode row with context
+    length ``position + 1`` against its sequence's page table — the
+    formulation the bucketed chunked-prefill fallback already pins its
+    numerics to, generalised to a mixed multi-sequence stream."""
+    t = q.shape[0]
+    num_seqs = block_tables.shape[0]
+    rows = jnp.arange(t, dtype=jnp.int32)
+    seq = jnp.sum(
+        rows[:, None] >= seq_starts[None, :num_seqs].astype(jnp.int32),
+        axis=1,
+    ) - 1
+    seq = jnp.clip(seq, 0, num_seqs - 1)
+    tables = jnp.take(block_tables, seq, axis=0)  # [T, max_blocks]
+    ctx = jnp.where(rows < total_tokens, positions.astype(jnp.int32) + 1, 1)
+    return paged_decode_attention_xla(
+        q, k_cache, v_cache, tables, ctx, block_size, scale,
+        window=window, alibi_slopes=alibi_slopes,
+    )
+
+
+def ragged_paged_attention(
+    q: jax.Array,  # [T, H, Dh] flat mixed stream
+    k_cache: jax.Array,  # [Hkv, num_slots, Dh] head-leading
+    v_cache: jax.Array,
+    positions: jax.Array,  # [T]
+    seq_starts: jax.Array,  # [S+1]
+    pos_base: jax.Array,  # [S]
+    total_tokens: jax.Array,  # scalar
+    block_tables: jax.Array,  # [S, max_blocks]
+    block_size: int,
+    scale: float,
+    *,
+    work: jax.Array | None = None,  # [WORK_FIELDS, W] or None
+    mesh=None,
+    window: int = 0,
+    alibi_slopes: jax.Array | None = None,  # [H] f32 (bloom lineage)
+    block_q: int = 128,
+) -> jax.Array:
+    """One causal paged-attention dispatch over a mixed ragged stream.
+
+    The caller must have scattered this step's K/V into the cache first.
+    TPU runs the Pallas work-schedule kernel (``work`` from
+    ``build_work_schedule``; built densely in-trace when None, the fused
+    decode-scan case); elsewhere the XLA reference runs and ``work`` is
+    ignored entirely — it never becomes an operand, so schedule-width
+    shape variety cannot retrace the CPU path.
+
+    Under a TP mesh the kernel runs inside shard_map over the head axis,
+    cache head-sharded — same contract as the bucketed kernels.
+    """
+    if _use_pallas():
+        if work is None:
+            # dense in-trace schedule (the fused decode scan; requires
+            # single-row spans, seq_starts = arange): small q blocks —
+            # every span is one row, so a wide block would only
+            # multiply masked work items per (block, seq) pair.
+            # t_pad must equal the kernel's cdiv padding: a wider pad
+            # (e.g. pow2) emits query-block indices past the kernel's
+            # output grid, and their first/last flags would re-init and
+            # finalise a clamped real block with zeros
+            block_q = min(block_q, 8, _pow2_ceil(q.shape[0]))
+            work = dense_work_schedule(
+                pos_base, block_tables,
+                block_size=block_size, block_q=block_q,
+                t_pad=-(-q.shape[0] // block_q) * block_q,
+            )
+        kernel = functools.partial(
+            _ragged_attention_pallas,
+            block_size=block_size,
+            scale=scale,
+            block_q=block_q,
+            window=window,
+            interpret=_pallas_interpret(),
+        )
+        if mesh is not None:
+            from jax.sharding import PartitionSpec as P
+
+            heads = P(None, "tp", None)
+            cache = P("tp", None, None)
+            operands = [q, k_cache, v_cache, seq_starts, pos_base, work]
+            specs = [heads, cache, cache, P(), P(), P()]
+            if alibi_slopes is not None:
+                operands.append(alibi_slopes)
+                specs.append(P("tp"))
+
+            def wrapped(q, kc, vc, st, pb, wk, *rest):
+                return kernel(q, kc, vc, st, pb, wk,
+                              alibi_slopes=rest[0] if rest else None)
+
+            return shard_map(
+                wrapped, mesh=mesh, in_specs=tuple(specs),
+                out_specs=heads, check_vma=False,
+            )(*operands)
+        return kernel(q, k_cache, v_cache, seq_starts, pos_base, work,
+                      alibi_slopes=alibi_slopes)
+    return ragged_attention_xla(
+        q, k_cache, v_cache, positions, seq_starts, total_tokens,
+        block_tables, block_size, scale,
+        window=window, alibi_slopes=alibi_slopes,
+    )
