@@ -44,12 +44,31 @@ argv; selects the serving data path, docs/ATTENTION.md — the emitted
 line stamps compiled-shape counts and the padding-waste fraction so the
 two backends' compile lattices and pad overhead are directly
 comparable).
+
+Data-parallel replica knobs (docs/SCALING.md): BENCH_DP=N (also
+`--dp-replicas=N` argv) boots N engine replicas behind the placement
+router — on CPU each replica gets its own virtual host device
+(``--xla_force_host_platform_device_count``) so replicas own
+independent execution streams like real dp device slices; request count
+scales xN; the line stamps per-replica committed tokens and the
+placement-policy counts/affinity hit rate.  BENCH_ARCH=small swaps the
+tiny proxy for a 4-layer/hidden-256 one whose per-dispatch device work
+dominates the host path — the arch the dp scaling gate measures with
+(a host-work-bound proxy under-reports replica scaling the real
+machine would deliver).  BENCH_SYNC_DISPATCH=1 disables jax's CPU
+async dispatch: the CPU backend funnels async-dispatched computations
+from every replica through shared dispatch machinery, serializing
+them; synchronous dispatch executes on each replica's own worker
+thread, which is how independent accelerator streams behave (CPU-only
+knob; the dp gate sets it for ALL its points, dp=1 included, so
+ratios compare like with like).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import re
 import signal
 import subprocess
 import sys
@@ -105,6 +124,15 @@ def _attention_data_path() -> str:
     return os.environ.get("BENCH_ATTENTION_BACKEND", "bucketed")
 
 
+def _dp_replicas() -> int:
+    """Replica count for this run: ``--dp-replicas=N`` argv or BENCH_DP
+    (docs/SCALING.md); 1 (single replica, pre-router path) by default."""
+    for arg in sys.argv[1:]:
+        if arg.startswith("--dp-replicas="):
+            return max(1, int(arg.split("=", 1)[1]))
+    return max(1, int(os.environ.get("BENCH_DP", "1")))
+
+
 def _padded_tokens_total(metrics_mod) -> float:
     """Cumulative padding-slot count across phases (prometheus)."""
     total = 0.0
@@ -149,32 +177,86 @@ def _peak_flops(device_kind: str) -> float | None:
     return None
 
 
-def build_model_dir(tiny: bool) -> tuple[str, dict]:
-    """Write tokenizer + config for the bench model; params are random."""
+def build_model_dir(tiny: bool, profile: str | None = None,
+                    weights: bool = False) -> tuple[str, dict]:
+    """Write tokenizer + config for the bench model; params are random.
+
+    ``profile`` overrides the tiny/1b split: "small" is the dp scaling
+    gate's proxy — enough per-dispatch device work that replica scaling
+    is not hidden behind the (GIL-serialized) host path.  ``weights``
+    additionally writes deterministic random HF-format safetensors so
+    the production ``from_config`` boot path (which the dp fleet uses)
+    can load the model from disk.
+    """
     sys.path.insert(0, os.path.join(os.path.dirname(__file__), "tests"))
     from fixture_models import build_tokenizer
 
-    if tiny:
+    if profile == "small":
+        arch = dict(vocab_size=512, hidden_size=256, intermediate_size=512,
+                    num_layers=4, num_heads=8, num_kv_heads=4, head_dim=32)
+        name = "small"
+    elif tiny:
         arch = dict(vocab_size=512, hidden_size=64, intermediate_size=128,
                     num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16)
+        name = "tiny"
     else:
         # Llama-3.2-1B shape, 16k vocab (see module docstring)
         arch = dict(vocab_size=16384, hidden_size=2048,
                     intermediate_size=8192, num_layers=16, num_heads=32,
                     num_kv_heads=8, head_dim=64)
-    path = f"/tmp/bench-model-{'tiny' if tiny else '1b'}"
+        name = "1b"
+    path = f"/tmp/bench-model-{name}"
     if not os.path.exists(os.path.join(path, "tokenizer.json")):
         os.makedirs(path, exist_ok=True)
         build_tokenizer(path, vocab_size=arch["vocab_size"])
+    if weights and not os.path.exists(
+        os.path.join(path, "model.safetensors")
+    ):
+        # the shared fixture writer is the single source of the HF
+        # tensor layout the loader expects — seed-0 deterministic
+        from fixture_models import write_llama_safetensors
+
+        write_llama_safetensors(path, **arch)
     return path, arch
 
 
 def run_bench(on_tpu: bool) -> dict:
+    dp = _dp_replicas()
+    if dp > 1 and not on_tpu:
+        # one virtual host device per replica, so each replica owns an
+        # independent execution stream (the CPU analogue of disjoint dp
+        # device slices).  XLA_FLAGS is read at backend init — this must
+        # run before the first device query below.
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={dp}"
+            ).strip()
+        else:
+            m = re.search(
+                r"xla_force_host_platform_device_count=(\d+)", flags
+            )
+            if m and int(m.group(1)) < dp:
+                # a pre-existing count can't be overridden reliably
+                # (first flag wins in some XLA versions) — warn loudly
+                # on stderr so a garbage dp scaling number is
+                # attributable; stdout stays one clean JSON line
+                print(
+                    f"bench: XLA_FLAGS already forces "
+                    f"{m.group(1)} host device(s) < dp={dp}; replicas "
+                    "will share devices and dp scaling will be "
+                    "meaningless — unset XLA_FLAGS",
+                    file=sys.stderr,
+                )
     import jax
 
     jax.config.update("jax_compilation_cache_dir", "/tmp/jax_compile_cache")
     if not on_tpu:
         jax.config.update("jax_platforms", "cpu")
+        if os.environ.get("BENCH_SYNC_DISPATCH", "") == "1":
+            # see module docstring: CPU async dispatch serializes
+            # concurrent replicas through shared dispatch machinery
+            jax.config.update("jax_cpu_enable_async_dispatch", False)
 
     import jax.numpy as jnp
     import numpy as np
@@ -206,14 +288,21 @@ def run_bench(on_tpu: bool) -> dict:
         attn_ops.decode_kernel_variant() if attn_ops._use_pallas() else None
     )
     tiny = os.environ.get("BENCH_TINY", "") == "1" or backend != "tpu"
+    profile = os.environ.get("BENCH_ARCH") or None
     n_requests = int(os.environ.get("BENCH_REQUESTS", 16 if tiny else 128))
+    # replica count scales the offered load: the dp gate measures
+    # AGGREGATE throughput at fixed per-replica batch shape
+    n_requests *= dp
     prompt_len = int(os.environ.get("BENCH_PROMPT", 32 if tiny else 128))
     output_len = int(os.environ.get("BENCH_OUTPUT", 16 if tiny else 128))
     # decode is weight-read bound: batch 64 halves the HBM cost per
     # token vs 32 (weights stream once per wave regardless of rows)
     max_seqs = int(os.environ.get("BENCH_BATCH", 8 if tiny else 64))
 
-    model_dir, arch = build_model_dir(tiny)
+    # the dp fleet boots through the production from_config path, which
+    # loads weights from disk — write them once, seed-0 deterministic
+    model_dir, arch = build_model_dir(tiny, profile=profile,
+                                      weights=dp > 1)
     dtype = jnp.float32 if tiny else jnp.bfloat16
     max_len = prompt_len + output_len + 16
     mcfg = ModelConfig(
@@ -244,24 +333,44 @@ def run_bench(on_tpu: bool) -> dict:
                 os.environ.get("BENCH_STEPS", 8 if tiny else 16)
             ),
         ),
-        parallel_config=ParallelConfig(),
+        parallel_config=ParallelConfig(dp_replicas=dp),
         lora_config=LoRAConfig(),
         attention_backend=data_path,
+        quantization=(
+            "int8"
+            if dp > 1 and os.environ.get("BENCH_QUANT", "") == "1"
+            else None
+        ),
     )
-    model = LlamaForCausalLM(mcfg)
-    params = model.init_params(jax.random.PRNGKey(0))
-    quantization = None
-    if os.environ.get("BENCH_QUANT", "") == "1":
-        # weight-only int8 variant: decode is HBM-bandwidth-bound, so the
-        # ~2x smaller projection weights should lift tok/s on chip
-        from vllm_tgis_adapter_tpu.engine.weights import (
-            quantize_params_int8,
-        )
 
-        params = quantize_params_int8(params)
-        quantization = "int8"
-    tokenizer = AutoTokenizer.from_pretrained(model_dir)
-    engine = LLMEngine(config, model, params, tokenizer)
+    from vllm_tgis_adapter_tpu.engine.async_llm import AsyncLLMEngine
+
+    quantization = config.quantization
+    if dp > 1:
+        # the production fleet boot (docs/SCALING.md): N replicas over
+        # disjoint (virtual) device slices behind the placement router,
+        # weights loaded from the model dir per replica
+        aengine = AsyncLLMEngine.from_config(config)
+        engines = [rep.engine for rep in aengine._replicas]
+        params = engines[0].runner.params
+    else:
+        model = LlamaForCausalLM(mcfg)
+        params = model.init_params(jax.random.PRNGKey(0))
+        if os.environ.get("BENCH_QUANT", "") == "1":
+            # weight-only int8 variant: decode is HBM-bandwidth-bound,
+            # so the ~2x smaller projection weights should lift tok/s
+            # on chip
+            from vllm_tgis_adapter_tpu.engine.weights import (
+                quantize_params_int8,
+            )
+
+            params = quantize_params_int8(params)
+            quantization = "int8"
+        tokenizer = AutoTokenizer.from_pretrained(model_dir)
+        aengine = AsyncLLMEngine(
+            LLMEngine(config, model, params, tokenizer)
+        )
+        engines = [aengine.engine]
 
     # BENCH_PRECOMPILE=1: run the boot-time shape warmup first and stamp
     # the number of compiled programs it took — the FULL compile lattice
@@ -270,43 +379,50 @@ def run_bench(on_tpu: bool) -> dict:
     precompiled_shapes = None
     if os.environ.get("BENCH_PRECOMPILE", "") == "1":
         compile_tracker.reset()
-        engine.precompile()
+        for eng in engines:
+            eng.precompile()
         precompiled_shapes = compile_tracker.num_shapes()
 
     # count packed multi-prompt prefill dispatches (engine/scheduler.py):
-    # the serving-path feature the bench is meant to exercise
+    # the serving-path feature the bench is meant to exercise — summed
+    # over the replica fleet
     from vllm_tgis_adapter_tpu.engine.scheduler import PackedPrefillPlan
 
     pack_stats = {"packed_dispatches": 0, "packed_prompts": 0,
                   "chained_dispatches": 0, "host_syncs": 0}
-    orig_schedule = engine.scheduler.schedule
 
-    def counting_schedule(**kwargs):
-        plan = orig_schedule(**kwargs)
-        if isinstance(plan, PackedPrefillPlan):
-            pack_stats["packed_dispatches"] += 1
-            pack_stats["packed_prompts"] += len(plan.items)
-        return plan
+    def instrument(eng) -> None:
+        orig_schedule = eng.scheduler.schedule
 
-    engine.scheduler.schedule = counting_schedule
-    orig_chained = engine.dispatch_chained_step
+        def counting_schedule(**kwargs):
+            plan = orig_schedule(**kwargs)
+            if isinstance(plan, PackedPrefillPlan):
+                pack_stats["packed_dispatches"] += 1
+                pack_stats["packed_prompts"] += len(plan.items)
+            return plan
 
-    def counting_chained(plan, prepared, prev_handle):
-        pack_stats["chained_dispatches"] += 1
-        return orig_chained(plan, prepared, prev_handle)
+        eng.scheduler.schedule = counting_schedule
+        orig_chained = eng.dispatch_chained_step
 
-    engine.dispatch_chained_step = counting_chained
+        def counting_chained(plan, prepared, prev_handle):
+            pack_stats["chained_dispatches"] += 1
+            return orig_chained(plan, prepared, prev_handle)
 
-    # host_syncs counts blocking result pulls (wait_step) — through a
-    # network-attached chip each costs one round trip, so tokens-per-
-    # sync is the tunnel-relevant efficiency metric
-    orig_wait = engine.wait_step
+        eng.dispatch_chained_step = counting_chained
 
-    def counting_wait(plan, prepared, handle):
-        pack_stats["host_syncs"] += 1
-        return orig_wait(plan, prepared, handle)
+        # host_syncs counts blocking result pulls (wait_step) — through
+        # a network-attached chip each costs one round trip, so tokens-
+        # per-sync is the tunnel-relevant efficiency metric
+        orig_wait = eng.wait_step
 
-    engine.wait_step = counting_wait
+        def counting_wait(plan, prepared, handle):
+            pack_stats["host_syncs"] += 1
+            return orig_wait(plan, prepared, handle)
+
+        eng.wait_step = counting_wait
+
+    for eng in engines:
+        instrument(eng)
 
     # matmul weight elements -> decode FLOPs/token (2*N MACs) for MFU
     matmul_elems = sum(
@@ -329,12 +445,10 @@ def run_bench(on_tpu: bool) -> dict:
     # a synchronous engine.step() loop would not exercise either
     import asyncio
 
-    from vllm_tgis_adapter_tpu.engine.async_llm import AsyncLLMEngine
     from vllm_tgis_adapter_tpu.engine.sampling_params import (
         RequestOutputKind,
     )
 
-    aengine = AsyncLLMEngine(engine)
     ttfts: list[float] = []
 
     async def one(tag: str, i: int, out_tokens: int) -> int:
@@ -363,8 +477,14 @@ def run_bench(on_tpu: bool) -> dict:
         )
         return sum(counts), time.perf_counter() - start
 
+    router = aengine.router
+
     async def both_passes():
-        await run_pass("warm", min(n_requests, 2 * max_seqs), output_len)
+        # warm 2×max_seqs PER REPLICA: placement spreads the warm load
+        # so every replica's compile lattice is paid before timing
+        await run_pass(
+            "warm", min(n_requests, 2 * max_seqs * dp), output_len
+        )
         # counters report the TIMED pass (same scope as
         # produced_tok/elapsed) — the warm pass would otherwise skew
         # the tokens-per-sync and packing ratios.  A warm-pass tail
@@ -373,11 +493,26 @@ def run_bench(on_tpu: bool) -> dict:
         for key in pack_stats:
             pack_stats[key] = 0
         pad0 = _padded_tokens_total(metrics)
+        # placement/attribution snapshots: the dp stamps cover only the
+        # timed pass, same scope as produced_tok/elapsed
+        placed0 = dict(router.placed_by_policy)
+        committed0 = router.committed_by_replica()
         produced, elapsed = await run_pass("timed", n_requests, output_len)
         await aengine.stop()
-        return produced, elapsed, _padded_tokens_total(metrics) - pad0
+        placement = {
+            k: v - placed0.get(k, 0)
+            for k, v in router.placed_by_policy.items()
+        }
+        committed = {
+            k: v - committed0.get(k, 0.0)
+            for k, v in router.committed_by_replica().items()
+        }
+        return (produced, elapsed, _padded_tokens_total(metrics) - pad0,
+                placement, committed)
 
-    produced, elapsed, padded_tok = asyncio.run(both_passes())
+    produced, elapsed, padded_tok, placement, committed = asyncio.run(
+        both_passes()
+    )
     value = produced / elapsed
     # padding fraction of the timed pass: pad slots dispatched over pad
     # slots + real work (prompt tokens enter once even when chunked;
@@ -432,6 +567,33 @@ def run_bench(on_tpu: bool) -> dict:
         "produced_tok": produced,
         "elapsed_s": round(elapsed, 3),
         "serving_path": "async",  # overlapped step loop + packed prefill
+        "dp_replicas": dp,
+        **({"bench_arch": profile} if profile else {}),
+        **(
+            {"sync_dispatch": True}
+            if not on_tpu
+            and os.environ.get("BENCH_SYNC_DISPATCH", "") == "1"
+            else {}
+        ),
+        **(
+            {
+                # committed tokens (prefill + decode, scheduler commit
+                # phase) per replica over the timed pass — near-equal
+                # shares mean placement kept the fleet balanced
+                "per_replica_committed_tok_per_s": {
+                    str(idx): round(tok / elapsed, 1)
+                    for idx, tok in sorted(committed.items())
+                },
+                "placement_by_policy": placement,
+                "placement_affinity_hit_rate": round(
+                    (placement.get("prefix", 0)
+                     + placement.get("tenant", 0))
+                    / max(1, sum(placement.values())), 4
+                ),
+            }
+            if dp > 1
+            else {}
+        ),
         "quantization": quantization,
         "ttft_ms_p50": pct(0.50),
         "ttft_ms_p99": pct(0.99),

@@ -72,7 +72,10 @@ def chaos_check(session: nox.Session) -> None:
     step-loop crashes, OOMs, stuck dispatches, and death-during-recovery
     through supervisor/failpoints.py and assert the supervisor replays
     pre-prefill work losslessly, fails mid-decode retryable, re-arms
-    health, and trips the crash-loop circuit breaker.  Also runs inside
+    health, and trips the crash-loop circuit breaker.  Includes the dp
+    partial-outage scenario (docs/SCALING.md): a replica dying mid-load
+    replays its zero-token requests token-identically onto a healthy
+    sibling while that sibling's TTFT stays bounded.  Also runs inside
     the tier-1 suite; this session is the fast standalone entry point."""
     session.install("-e", ".[tests]")
     session.run(
@@ -88,7 +91,10 @@ def perf_check(session: nox.Session) -> None:
     CPU-proxy mini-bench per serving data path (bucketed + ragged) and
     fail on >20% tok/s regression or padding-waste growth against the
     checked-in PERF_BASELINE.json — the instrument the r05 4x drop
-    lacked (BASELINE.md 'Perf regression log')."""
+    lacked (BASELINE.md 'Perf regression log').  Also runs the dp
+    replica-scaling gate (docs/SCALING.md): aggregate tok/s at
+    dp=1/2/4 must clear the baseline floors and the dp=2 ≥ 1.6x /
+    dp=4 ≥ 2.8x near-linear scaling ratios."""
     session.install("-e", ".[tests]")
     session.run(
         "python", "tools/perf_check.py",

@@ -111,11 +111,49 @@ def build_tokenizer(path: str, vocab_size: int = 512):
     return fast
 
 
-def build_tiny_llama(path: str, seed: int = 0) -> str:
-    """Write config.json + model.safetensors + tokenizer to ``path``."""
+def write_llama_safetensors(path: str, *, vocab_size: int,
+                            hidden_size: int, intermediate_size: int,
+                            num_layers: int, num_heads: int,
+                            num_kv_heads: int, head_dim: int,
+                            seed: int = 0) -> None:
+    """HF-format llama ``model.safetensors`` with seed-deterministic
+    random weights, shaped by the given arch — the single source of the
+    tensor-name layout the loader expects (engine/weights.py).  The
+    tiny test fixture and bench.py's dp-fleet model both write through
+    here so the layout cannot drift between them."""
     import numpy as np
     from safetensors.numpy import save_file
 
+    rng = np.random.default_rng(seed)
+    d = hidden_size
+    dh = head_dim
+
+    def w(shape):
+        return (rng.standard_normal(shape) * 0.02).astype(np.float32)
+
+    tensors = {
+        "model.embed_tokens.weight": w((vocab_size, d)),
+        "model.norm.weight": np.ones(d, dtype=np.float32),
+        "lm_head.weight": w((vocab_size, d)),
+    }
+    for i in range(num_layers):
+        p = f"model.layers.{i}"
+        tensors |= {
+            f"{p}.input_layernorm.weight": np.ones(d, dtype=np.float32),
+            f"{p}.post_attention_layernorm.weight": np.ones(d, dtype=np.float32),
+            f"{p}.self_attn.q_proj.weight": w((num_heads * dh, d)),
+            f"{p}.self_attn.k_proj.weight": w((num_kv_heads * dh, d)),
+            f"{p}.self_attn.v_proj.weight": w((num_kv_heads * dh, d)),
+            f"{p}.self_attn.o_proj.weight": w((d, num_heads * dh)),
+            f"{p}.mlp.gate_proj.weight": w((intermediate_size, d)),
+            f"{p}.mlp.up_proj.weight": w((intermediate_size, d)),
+            f"{p}.mlp.down_proj.weight": w((d, intermediate_size)),
+        }
+    save_file(tensors, Path(path) / "model.safetensors")
+
+
+def build_tiny_llama(path: str, seed: int = 0) -> str:
+    """Write config.json + model.safetensors + tokenizer to ``path``."""
     out = Path(path)
     out.mkdir(parents=True, exist_ok=True)
 
@@ -125,36 +163,17 @@ def build_tiny_llama(path: str, seed: int = 0) -> str:
     with open(out / "config.json", "w") as f:
         json.dump(cfg, f, indent=2)
 
-    rng = np.random.default_rng(seed)
-    d = cfg["hidden_size"]
-    dh = cfg["head_dim"]
-    h = cfg["num_attention_heads"]
-    hkv = cfg["num_key_value_heads"]
-    inter = cfg["intermediate_size"]
-    vocab = cfg["vocab_size"]
-
-    def w(shape):
-        return (rng.standard_normal(shape) * 0.02).astype(np.float32)
-
-    tensors = {
-        "model.embed_tokens.weight": w((vocab, d)),
-        "model.norm.weight": np.ones(d, dtype=np.float32),
-        "lm_head.weight": w((vocab, d)),
-    }
-    for i in range(cfg["num_hidden_layers"]):
-        p = f"model.layers.{i}"
-        tensors |= {
-            f"{p}.input_layernorm.weight": np.ones(d, dtype=np.float32),
-            f"{p}.post_attention_layernorm.weight": np.ones(d, dtype=np.float32),
-            f"{p}.self_attn.q_proj.weight": w((h * dh, d)),
-            f"{p}.self_attn.k_proj.weight": w((hkv * dh, d)),
-            f"{p}.self_attn.v_proj.weight": w((hkv * dh, d)),
-            f"{p}.self_attn.o_proj.weight": w((d, h * dh)),
-            f"{p}.mlp.gate_proj.weight": w((inter, d)),
-            f"{p}.mlp.up_proj.weight": w((inter, d)),
-            f"{p}.mlp.down_proj.weight": w((d, inter)),
-        }
-    save_file(tensors, out / "model.safetensors")
+    write_llama_safetensors(
+        path,
+        vocab_size=cfg["vocab_size"],
+        hidden_size=cfg["hidden_size"],
+        intermediate_size=cfg["intermediate_size"],
+        num_layers=cfg["num_hidden_layers"],
+        num_heads=cfg["num_attention_heads"],
+        num_kv_heads=cfg["num_key_value_heads"],
+        head_dim=cfg["head_dim"],
+        seed=seed,
+    )
     return str(out)
 
 

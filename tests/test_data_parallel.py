@@ -286,6 +286,255 @@ def test_dp_of_sp_rings(dp_config):
         assert r.outputs[0].token_ids == g.outputs[0].token_ids
 
 
+# ---------------- placement scorer units (frontdoor/placement.py) ----------
+
+
+def _snap(index, load, prefix=0):
+    from vllm_tgis_adapter_tpu.frontdoor.placement import ReplicaSnapshot
+
+    return ReplicaSnapshot(index=index, load=load, prefix_tokens=prefix)
+
+
+def _router(**kwargs):
+    from vllm_tgis_adapter_tpu.frontdoor.placement import PlacementRouter
+
+    return PlacementRouter(**kwargs)
+
+
+def test_placement_prefix_affinity_beats_load():
+    """A replica holding the request's prompt prefix wins over a less
+    loaded sibling, as long as it is within the load slack."""
+    router = _router(load_slack=2.0)
+    idx, policy = router.place([_snap(0, 3, prefix=64), _snap(1, 1)])
+    assert (idx, policy) == (0, "prefix")
+
+
+def test_placement_prefix_affinity_yields_to_load():
+    """Affinity must not pile a replica over: past the slack, the hot
+    prefix loses to the least-loaded fallback."""
+    router = _router(load_slack=2.0)
+    idx, policy = router.place([_snap(0, 9, prefix=64), _snap(1, 1)])
+    assert (idx, policy) == (1, "load")
+
+
+def test_placement_longest_prefix_wins():
+    router = _router()
+    idx, policy = router.place(
+        [_snap(0, 0, prefix=16), _snap(1, 0, prefix=48), _snap(2, 0)]
+    )
+    assert (idx, policy) == (1, "prefix")
+
+
+def test_placement_sticky_tenant():
+    """A tenant's second request lands on the replica its first one
+    did (adapter/WFQ stickiness), even when another replica is now
+    equally or slightly less loaded."""
+    router = _router(load_slack=2.0)
+    idx0, _ = router.place([_snap(0, 0), _snap(1, 0)], affinity_key="t")
+    assert idx0 == 0
+    idx1, policy = router.place(
+        [_snap(0, 1), _snap(1, 0)], affinity_key="t"
+    )
+    assert (idx1, policy) == (0, "tenant")
+
+
+def test_placement_sticky_tenant_yields_to_load_and_follows():
+    router = _router(load_slack=2.0)
+    router.place([_snap(0, 0), _snap(1, 0)], affinity_key="t")  # -> 0
+    # replica 0 now 5 deep: stickiness must yield...
+    idx, policy = router.place(
+        [_snap(0, 5), _snap(1, 0)], affinity_key="t"
+    )
+    assert (idx, policy) == (1, "load")
+    # ...and the sticky entry follows the tenant to its new home
+    idx2, policy2 = router.place(
+        [_snap(0, 0), _snap(1, 1)], affinity_key="t"
+    )
+    assert (idx2, policy2) == (1, "tenant")
+
+
+def test_placement_anonymous_traffic_spreads_by_depth():
+    """No affinity key (untagged default-tenant traffic) means no
+    stickiness: consecutive placements follow queue depth only."""
+    router = _router()
+    idx0, policy0 = router.place([_snap(0, 0), _snap(1, 0)])
+    idx1, policy1 = router.place([_snap(0, 1), _snap(1, 0)])
+    assert (idx0, policy0) == (0, "load")
+    assert (idx1, policy1) == (1, "load")
+
+
+def test_placement_load_tie_breaks_to_colder_replica():
+    """Equal queue depth: the committed-token EWMA sends the request to
+    the replica currently grinding fewer tokens."""
+    router = _router()
+    router.note_committed(0, 1000.0)
+    router.note_committed(1, 10.0)
+    idx, _ = router.place([_snap(0, 1), _snap(1, 1)])
+    assert idx == 1
+    # a rebuilt replica starts cold again
+    router.forget_replica_rate(0)
+    idx2, _ = router.place([_snap(0, 1), _snap(1, 1)])
+    assert idx2 == 0
+
+
+def test_placement_sticky_lru_bound():
+    """Tenant ids are client-controlled: the sticky map must stay
+    bounded, evicting least-recently-placed tenants."""
+    router = _router(max_sticky_tenants=2)
+    router.place([_snap(0, 0), _snap(1, 9)], affinity_key="a")  # -> 0
+    router.place([_snap(0, 0), _snap(1, 9)], affinity_key="b")
+    router.place([_snap(0, 0), _snap(1, 9)], affinity_key="c")
+    assert len(router._sticky) == 2
+    # "a" was evicted: equal-load placement falls back to load policy
+    _, policy = router.place([_snap(0, 0), _snap(1, 0)], affinity_key="a")
+    assert policy == "load"
+
+
+def test_placement_counters_and_metric():
+    import re
+
+    from vllm_tgis_adapter_tpu import metrics
+
+    def sample(policy):
+        text = metrics.render().decode()
+        for line in text.splitlines():
+            if (
+                line.startswith("tgis_tpu_frontdoor_placement_total")
+                and f'policy="{policy}"' in line
+            ):
+                return float(re.split(r"\s+", line)[-1])
+        return 0.0
+
+    before = sample("prefix")
+    router = _router()
+    router.place([_snap(0, 0, prefix=8), _snap(1, 0)])
+    assert router.placed_by_policy["prefix"] == 1
+    assert router.placed_by_replica == {0: 1}
+    assert router.affinity_hit_rate() == 1.0
+    assert sample("prefix") == before + 1
+    state = router.debug_state()
+    assert state["placed_by_policy"]["prefix"] == 1
+    assert state["affinity_hit_rate"] == 1.0
+
+
+# -------------------- fleet-level placement (AsyncLLMEngine) ----------------
+
+
+def test_dp_dead_replica_excluded_from_placement(dp_config):
+    """A quiesced replica (serving=False — what the supervisor flips
+    during a rebuild) must receive no placements; re-admitting it
+    restores spreading."""
+    from vllm_tgis_adapter_tpu.engine.async_llm import AsyncLLMEngine
+
+    engine = AsyncLLMEngine.from_config(dp_config(dp=2))
+    rep0, rep1 = engine._replicas
+    rep0.serving = False
+    for i in range(4):
+        rep = engine._place_replica([3, 4, 5, 6], None, None)
+        assert rep is rep1
+    rep0.serving = True
+    placed = {
+        engine._place_replica([3, 4, 5, 6], None, None).index
+        for _ in range(4)
+    }
+    assert 0 in placed
+
+
+def test_dp_all_replicas_quiesced_falls_back_to_full_fleet(dp_config):
+    """Zero serving replicas (full-outage recovery): the estimator and
+    placement fall back to the whole fleet instead of dividing by an
+    empty list — the front door is paused then, so nothing is really
+    placed, but the hooks must not raise."""
+    from vllm_tgis_adapter_tpu.engine.async_llm import AsyncLLMEngine
+
+    engine = AsyncLLMEngine.from_config(dp_config(dp=2))
+    for rep in engine._replicas:
+        rep.serving = False
+    assert len(engine._serving_replicas()) == 2
+    assert engine._kv_token_capacity() > 0
+    assert engine._place_replica([3, 4, 5], None, None) is not None
+
+
+def test_dp_tenant_stickiness_routes_fleet_requests(dp_config):
+    """generate(tenant_id=...) threads the tenant into placement: two
+    tenants pin to their first replicas while anonymous load spreads."""
+    from vllm_tgis_adapter_tpu.engine.async_llm import AsyncLLMEngine
+    from vllm_tgis_adapter_tpu.engine.sampling_params import SamplingParams
+
+    engine = AsyncLLMEngine.from_config(dp_config(dp=2))
+
+    async def scenario():
+        owners = {}
+
+        async def one(rid, tenant):
+            final = None
+            async for out in engine.generate(
+                f"prompt {rid}",
+                SamplingParams(temperature=0.0, max_tokens=4),
+                request_id=rid,
+                tenant_id=tenant,
+            ):
+                if rid in engine._owner:
+                    owners.setdefault(rid, engine._owner[rid].index)
+                final = out
+            return final
+
+        # serialized rounds so load is equal at each placement: the
+        # second round must follow stickiness, not luck
+        await asyncio.gather(one("a1", "ta"), one("b1", "tb"))
+        await asyncio.gather(one("a2", "ta"), one("b2", "tb"))
+        await engine.stop()
+        return owners
+
+    owners = asyncio.run(scenario())
+    assert owners["a2"] == owners["a1"]
+    assert owners["b2"] == owners["b1"]
+    policy = engine.router.placed_by_policy
+    assert policy["tenant"] >= 2
+
+
+def test_dp_replicas_flag_shares_devices_when_short(dp_config):
+    """--dp-replicas tolerates a host with fewer devices than
+    replicas × per-replica size: replicas share the visible device set
+    (CPU dev mode), each still owning its own scheduler and KV pool."""
+    import dataclasses as dc
+
+    from vllm_tgis_adapter_tpu.engine.async_llm import AsyncLLMEngine
+
+    cfg = dp_config(dp=1)
+    cfg = dc.replace(
+        cfg,
+        parallel_config=dc.replace(
+            cfg.parallel_config, dp_replicas=5, tensor_parallel_size=2
+        ),
+    )
+    # 5 replicas × tp2 = 10 > 8 visible devices -> shared mode
+    engine = AsyncLLMEngine.from_config(cfg)
+    assert len(engine._replicas) == 5
+    seen = [
+        {d.id for d in rep.engine.runner.mesh.devices.flatten()}
+        for rep in engine._replicas
+    ]
+    assert all(s == seen[0] for s in seen)
+    allocators = {
+        id(rep.engine.scheduler.allocator) for rep in engine._replicas
+    }
+    assert len(allocators) == 5
+
+
+def test_dp_replicas_and_data_parallel_size_are_exclusive(dp_config):
+    import dataclasses as dc
+
+    cfg = dp_config(dp=1)
+    with pytest.raises(ValueError, match="exactly one"):
+        dc.replace(
+            cfg,
+            parallel_config=dc.replace(
+                cfg.parallel_config, dp_replicas=2, data_parallel_size=2
+            ),
+        )
+
+
 def test_dp_with_speculative_draft(dp_config, tmp_path_factory):
     """dp × speculative decoding: each replica owns its own draft model
     and cache; greedy outputs still match the plain dp=1 engine."""

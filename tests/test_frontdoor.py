@@ -205,6 +205,49 @@ def _make_frontdoor(*, window=2, waiting=None, backlog=0.0,
     return fd, room, waiting
 
 
+def test_frontdoor_per_replica_throughput_excludes_recovering():
+    """ISSUE 7 satellite: the drain estimator prices --admission-deadline
+    sheds from PER-REPLICA throughput EWMAs summed over the replicas the
+    ``serving_replicas_fn`` hook reports — one replica in supervised
+    recovery subtracts its capacity instead of dragging a fleet-global
+    average down (and firing sheds spuriously)."""
+    from vllm_tgis_adapter_tpu.engine.config import FrontdoorConfig
+    from vllm_tgis_adapter_tpu.frontdoor.admission import (
+        FrontDoor,
+        _ReplicaRate,
+    )
+
+    serving = {"set": frozenset({0, 1})}
+    fd = FrontDoor(
+        FrontdoorConfig(),
+        admit_window=2,
+        room_fn=lambda pending: True,
+        waiting_depth_fn=lambda: 0,
+        backlog_tokens_fn=lambda: 0.0,
+        kv_token_capacity_fn=lambda: 900.0,
+        serving_replicas_fn=lambda: serving["set"],
+    )
+    # note_progress keys accumulation per replica
+    fd.note_progress(100.0, replica=0)
+    fd.note_progress(50.0, replica=1)
+    assert set(fd._rep_rates) == {0, 1}
+
+    # observed rates sum over the serving set only
+    r0, r1 = _ReplicaRate(), _ReplicaRate()
+    r0.rate, r1.rate = 100.0, 50.0
+    fd._rep_rates = {0: r0, 1: r1}
+    assert fd._throughput() == 150.0
+    serving["set"] = frozenset({1})  # replica 0 quiesced
+    assert fd._throughput() == 50.0
+    # full outage: fall back to the capacity prior, never divide by zero
+    serving["set"] = frozenset()
+    assert fd._throughput() == 900.0 / 30.0
+    # a hook that raises must not break admission
+    fd._serving_replicas_fn = lambda: 1 / 0
+    serving["set"] = frozenset({0, 1})
+    assert fd._throughput() == 150.0
+
+
 def test_frontdoor_queue_full_shed_and_release():
     from vllm_tgis_adapter_tpu.frontdoor.errors import AdmissionShedError
 

@@ -40,11 +40,13 @@ def documented_metrics(doc_path: Path) -> set[str]:
 # top-level sections docs/OBSERVABILITY.md documents for the
 # /debug/state snapshot; a missing key means code and doc diverged
 DEBUG_STATE_KEYS = (
-    "engine", "supervisor", "frontdoor", "replicas", "compile_tracker",
+    "engine", "supervisor", "frontdoor", "router", "replicas",
+    "compile_tracker",
     "watchdog",
     "events",
 )
-REPLICA_KEYS = ("scheduler", "kv_cache", "in_flight", "step_counter")
+REPLICA_KEYS = ("scheduler", "kv_cache", "in_flight", "step_counter",
+                "serving")
 
 # the front-door metric surface (docs/FRONTDOOR.md) must BOTH be
 # documented in docs/OBSERVABILITY.md and appear on /metrics — adding a
@@ -54,6 +56,7 @@ REQUIRED_FRONTDOOR_METRICS = (
     "tgis_tpu_frontdoor_queue_age_seconds",
     "tgis_tpu_frontdoor_sheds_total",
     "tgis_tpu_frontdoor_tenant_tokens_total",
+    "tgis_tpu_frontdoor_placement_total",
 )
 
 

@@ -15,7 +15,15 @@ against the checked-in ``PERF_BASELINE.json``:
   whole claim is waste ≈ 0; a silent return of bucket padding is a
   regression even if tok/s survives);
 * cross-path sanity: the ragged path must not fall below the bucketed
-  path's throughput (it currently clears it ~3.5x on the CPU proxy).
+  path's throughput (it currently clears it ~3.5x on the CPU proxy);
+* dp scaling (docs/SCALING.md): aggregate tok/s across the baseline's
+  ``dp.points`` replica counts (ragged backend, BENCH_ARCH=small +
+  BENCH_SYNC_DISPATCH=1 — see bench.py's docstring for why the dp gate
+  needs both), gated on absolute floors AND the dp=N / dp=1 scaling
+  ratios in ``dp.min_scaling`` (ISSUE 7 acceptance: dp=2 ≥ 1.6x,
+  dp=4 ≥ 2.8x).  Ratio gates are robust to shared-runner load jitter
+  (both points see the same load); the floors catch a uniformly slow
+  fleet.
 
 Exit codes follow obs_check: 0 green, 1 regression, 2 tool error.
 Update the baseline deliberately with ``--write`` after a reviewed
@@ -78,6 +86,28 @@ def measure(backend: str, runs: int, env_overrides: dict) -> dict:
     }
 
 
+def measure_dp(dp_cfg: dict, runs: int) -> dict[str, dict]:
+    """Best-of-``runs`` bench line per replica count in ``dp_cfg``."""
+    backend = dp_cfg.get("backend", "ragged")
+    results: dict[str, dict] = {}
+    for point in dp_cfg.get("points", []):
+        env = dict(dp_cfg.get("env", {}))
+        env["BENCH_DP"] = str(point)
+        best = None
+        for _ in range(runs):
+            line = run_bench(backend, env)
+            if best is None or line["value"] > best["value"]:
+                best = line
+        results[str(point)] = best
+        print(
+            f"perf_check: dp={point}     "
+            f"tok/s={best['value']:8.1f} "
+            f"per_replica={best.get('per_replica_committed_tok_per_s')} "
+            f"affinity_hits={best.get('placement_affinity_hit_rate')}"
+        )
+    return results
+
+
 def main(argv: list[str] | None = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     write = "--write" in argv
@@ -108,6 +138,15 @@ def main(argv: list[str] | None = None) -> int:
             f"shapes={m['compiled_shapes']}"
         )
 
+    dp_cfg = baseline.get("dp")
+    dp_measured: dict[str, dict] = {}
+    if dp_cfg:
+        try:
+            dp_measured = measure_dp(dp_cfg, int(dp_cfg.get("runs", runs)))
+        except Exception as exc:  # noqa: BLE001 — tool boundary
+            print(f"perf_check: dp measurement failed: {exc}")
+            return 2
+
     if write:
         out = {
             "_comment": (
@@ -133,6 +172,19 @@ def main(argv: list[str] | None = None) -> int:
                 for name, m in measured.items()
             },
         }
+        if dp_cfg:
+            out["dp"] = {
+                **dp_cfg,
+                # the dp gate compares floors with NO additional
+                # tolerance (unlike the main tok/s gate), so the ~70%
+                # haircut the checked-in style documents is applied at
+                # write time — a freshly written baseline must not fail
+                # the very next run on ordinary best-of-N jitter
+                "floors_tok_per_s": {
+                    point: round(line["value"] * 0.7, 1)
+                    for point, line in dp_measured.items()
+                },
+            }
         BASELINE_PATH.write_text(json.dumps(out, indent=2) + "\n")
         print(f"perf_check: baseline written to {BASELINE_PATH}")
         return 0
@@ -167,6 +219,33 @@ def main(argv: list[str] | None = None) -> int:
             "ragged backend fell below the bucketed backend's tok/s — "
             "the unified path must never be the slower one"
         )
+
+    if dp_cfg:
+        # absolute floors (already hand-haircut in the checked-in file,
+        # so compared directly — no extra tolerance)
+        for point, floor in dp_cfg.get("floors_tok_per_s", {}).items():
+            line = dp_measured.get(str(point))
+            if line is None:
+                failures.append(f"dp={point}: no measurement")
+            elif line["value"] < floor:
+                failures.append(
+                    f"dp={point}: {line['value']:.1f} tok/s < floor "
+                    f"{floor:.1f}"
+                )
+        # near-linear scaling vs the SAME session's dp=1 measurement
+        base_line = dp_measured.get("1")
+        for point, min_ratio in dp_cfg.get("min_scaling", {}).items():
+            line = dp_measured.get(str(point))
+            if line is None or base_line is None:
+                failures.append(f"dp={point}: scaling unmeasurable")
+                continue
+            ratio = line["value"] / max(base_line["value"], 1e-9)
+            if ratio < min_ratio:
+                failures.append(
+                    f"dp={point}: {ratio:.2f}x dp=1 < required "
+                    f"{min_ratio}x ({line['value']:.1f} vs "
+                    f"{base_line['value']:.1f} tok/s)"
+                )
 
     if failures:
         print("perf_check: REGRESSION")

@@ -64,11 +64,17 @@ class _Replica:
     """One engine + the concurrency state serializing access to it."""
 
     __slots__ = ("engine", "lock", "new_work", "task", "index",
-                 "last_beat", "in_flight_desc")
+                 "last_beat", "in_flight_desc", "serving")
 
     def __init__(self, engine: LLMEngine, index: int):
         self.engine = engine
         self.index = index
+        # False while this replica's supervisor has it quiesced for a
+        # rebuild: the placement router excludes it, the front door's
+        # drain estimator stops counting its capacity, and new arrivals
+        # land on its healthy siblings (capacity loss, not an outage —
+        # docs/SCALING.md).  Flipped back on lifecycle→serving.
+        self.serving = True
         # serializes engine-state mutations (add/abort) against the step
         # host phases — scheduler state is not thread-safe
         self.lock = asyncio.Lock()
@@ -91,6 +97,20 @@ class AsyncLLMEngine:
         # model config, shared LoRA registry) the serving layer reads
         self.engine = engines[0]
         self._replicas = [_Replica(e, i) for i, e in enumerate(engines)]
+        for rep in self._replicas:
+            # the `replica` label on the per-dispatch step metrics
+            rep.engine.replica_index = rep.index
+        # affinity-aware placement over the replica fleet
+        # (frontdoor/placement.py): prefix-cache residency > tenant/
+        # adapter stickiness > least-loaded.  Built even at dp=1 (it
+        # also carries per-replica committed-token attribution for the
+        # bench), but generate() short-circuits single-replica routing
+        # so dp=1 placement costs nothing and scores nothing.
+        from vllm_tgis_adapter_tpu.frontdoor.placement import (
+            PlacementRouter,
+        )
+
+        self.router = PlacementRouter()
         self._owner: dict[str, _Replica] = {}
         self._queues: dict[str, asyncio.Queue] = {}
         # request_ids whose abort() arrived while add_request was still
@@ -145,11 +165,26 @@ class AsyncLLMEngine:
                     len(rep.engine.scheduler.waiting)
                     for rep in self._replicas
                 ),
+                # drain-estimate inputs count SERVING replicas only: a
+                # recovering replica's backlog is being replayed onto
+                # its siblings and its capacity is gone until re-admit,
+                # so pricing it would fire --admission-deadline sheds
+                # spuriously during a partial outage
                 backlog_tokens_fn=lambda: float(sum(
                     rep.engine.scheduler.waiting_token_backlog()
-                    for rep in self._replicas
+                    for rep in self._serving_replicas()
                 )),
                 kv_token_capacity_fn=self._kv_token_capacity,
+                # the TRUE serving set — deliberately NOT
+                # _serving_replicas(), whose full-fleet fallback would
+                # make a full outage unrepresentable here and leave the
+                # estimator summing dead replicas' stale EWMAs instead
+                # of falling back to the capacity prior
+                serving_replicas_fn=lambda: frozenset(
+                    rep.index
+                    for rep in self._replicas
+                    if rep.serving
+                ),
                 record_shed=self._record_shed,
             )
             for rep in self._replicas:
@@ -222,22 +257,81 @@ class AsyncLLMEngine:
 
     # ------------------------------------------------------------ frontdoor
 
+    def _serving_replicas(self) -> list[_Replica]:
+        """Replicas placement may use.  Falls back to the full fleet
+        when every replica is quiesced (full-outage recovery: the front
+        door is paused then, so nothing is placed anyway, but the
+        estimator and gauges must not divide by an empty fleet)."""
+        serving = [rep for rep in self._replicas if rep.serving]
+        return serving or self._replicas
+
     def _frontdoor_room(self, pending: int) -> bool:
-        """Can some replica take another admission, counting grants
-        already issued but not yet turned into ``add_request``?"""
+        """Can some SERVING replica take another admission, counting
+        grants already issued but not yet turned into ``add_request``?"""
         depth = min(
-            len(rep.engine.scheduler.waiting) for rep in self._replicas
+            len(rep.engine.scheduler.waiting)
+            for rep in self._serving_replicas()
         )
         return depth + pending < self.frontdoor.admit_window
 
     def _kv_token_capacity(self) -> float:
         """Total KV pool size in tokens (the resolve_num_blocks budget
-        across replicas) — the admission estimator's throughput prior."""
+        across SERVING replicas) — the admission estimator's throughput
+        prior.  A quiesced replica's pool is not capacity."""
         total = 0
-        for rep in self._replicas:
+        for rep in self._serving_replicas():
             scheduler = rep.engine.scheduler
             total += scheduler.allocator.num_blocks * scheduler.block_size
         return float(total)
+
+    def _place_replica(
+        self,
+        prompt_token_ids,  # noqa: ANN001 — Optional[list[int]]
+        tenant: Optional[str],
+        lora_name: Optional[str],
+    ) -> _Replica:
+        """Route one request onto a replica (frontdoor/placement.py).
+
+        Single-replica fleets short-circuit — dp=1 routing is exactly
+        the pre-router behavior, with no peek_prefix probe and no
+        placement accounting."""
+        if len(self._replicas) == 1:
+            return self._replicas[0]
+        from vllm_tgis_adapter_tpu.frontdoor.placement import (
+            ReplicaSnapshot,
+        )
+
+        candidates = self._serving_replicas()
+        snapshots = []
+        for rep in candidates:
+            scheduler = rep.engine.scheduler
+            prefix_tokens = 0
+            if (
+                prompt_token_ids
+                and scheduler.allocator.enable_prefix_caching
+            ):
+                # pure hash walk (no refcounts, no LRU mutation) — the
+                # same read-only probe the chained-decode admissibility
+                # check uses, safe from the event loop
+                prefix_tokens = scheduler.allocator.peek_prefix(
+                    prompt_token_ids, lora_name
+                )
+            snapshots.append(ReplicaSnapshot(
+                index=rep.index,
+                load=scheduler.num_unfinished,
+                prefix_tokens=prefix_tokens,
+            ))
+        index, _policy = self.router.place(
+            snapshots,
+            # anonymous default-tenant traffic gets no stickiness: bulk
+            # untagged load must spread by depth, not pile onto one
+            # replica behind a sticky "default" entry
+            affinity_key=tenant or lora_name,
+        )
+        for rep in candidates:
+            if rep.index == index:
+                return rep
+        return candidates[0]  # unreachable; defensive
 
     def _record_shed(
         self, request_id: str, tenant: str, reason: str, **detail
@@ -275,7 +369,10 @@ class AsyncLLMEngine:
         import dataclasses
 
         pcfg = config.parallel_config
-        dp = pcfg.data_parallel_size
+        # two spellings of the replica count (config.py validates that
+        # at most one is > 1): data_parallel_size requires disjoint
+        # device slices, dp_replicas tolerates sharing them
+        dp = max(pcfg.data_parallel_size, pcfg.dp_replicas)
         if dp <= 1:
             return cls(LLMEngine.from_config(config))
         import jax
@@ -288,15 +385,32 @@ class AsyncLLMEngine:
             * pcfg.pipeline_parallel_size
         )
         devices = jax.devices()
+        shared_devices = False
         if dp * per_replica > len(devices):
-            raise ValueError(
-                f"data_parallel_size={dp} needs {dp * per_replica} devices "
-                f"(pp×sp×tp={per_replica} each) but only {len(devices)} "
-                "are visible"
+            if pcfg.dp_replicas <= 1:
+                raise ValueError(
+                    f"data_parallel_size={dp} needs {dp * per_replica} "
+                    f"devices (pp×sp×tp={per_replica} each) but only "
+                    f"{len(devices)} are visible"
+                )
+            # --dp-replicas shared-device mode: every replica runs on
+            # the same device slice with its own KV pool.  Correct, and
+            # what the CPU-proxy bench/chaos tests use; on a real
+            # accelerator N pools on one HBM is almost never what you
+            # want — say so loudly.
+            shared_devices = True
+            logger.warning(
+                "--dp-replicas %d exceeds the %d visible device(s): "
+                "replicas will SHARE the device set (each with its own "
+                "KV pool).  Fine on CPU hosts; on accelerators prefer "
+                "--data-parallel-size with disjoint slices",
+                dp, len(devices),
             )
         replica_config = dataclasses.replace(
             config,
-            parallel_config=dataclasses.replace(pcfg, data_parallel_size=1),
+            parallel_config=dataclasses.replace(
+                pcfg, data_parallel_size=1, dp_replicas=1
+            ),
         )
         engines = []
         for rank in range(dp):
@@ -304,9 +418,13 @@ class AsyncLLMEngine:
             engines.append(
                 LLMEngine.from_config(
                     replica_config,
-                    devices=devices[
-                        rank * per_replica:(rank + 1) * per_replica
-                    ],
+                    devices=(
+                        devices[:per_replica]
+                        if shared_devices
+                        else devices[
+                            rank * per_replica:(rank + 1) * per_replica
+                        ]
+                    ),
                 )
             )
         # one adapter registry fleet-wide: a hot-load registers once and
@@ -392,13 +510,17 @@ class AsyncLLMEngine:
 
     @property
     def is_running(self) -> bool:
-        return (
-            not self.errored
-            and not self._stopped
-            and all(
-                rep.task is not None and not rep.task.done()
-                for rep in self._replicas
-            )
+        """Every SERVING replica's step loop is alive.  A replica the
+        supervisor has quiesced (serving=False, task reaped) does not
+        count against the fleet — a partial outage still serves; with
+        every replica quiesced (dp=1 recovery, or a full-fleet fault)
+        this is False, exactly the pre-router behavior."""
+        if self.errored or self._stopped:
+            return False
+        serving = [rep for rep in self._replicas if rep.serving]
+        return bool(serving) and all(
+            rep.task is not None and not rep.task.done()
+            for rep in serving
         )
 
     async def get_tokenizer(self, lora_request=None):  # noqa: ANN001
@@ -525,11 +647,14 @@ class AsyncLLMEngine:
                 raise ValueError(f"duplicate request_id {request_id!r}")
         queue: asyncio.Queue = asyncio.Queue()
         self._queues[request_id] = queue
-        # least-loaded replica wins; ties fall to the lowest index, so a
-        # dp=1 engine routes exactly like the pre-dp code path
-        rep = min(
-            self._replicas,
-            key=lambda r: (r.engine.scheduler.num_unfinished, r.index),
+        # affinity-aware placement (frontdoor/placement.py): prefix-
+        # cache residency > tenant/adapter stickiness > least-loaded,
+        # over SERVING replicas only.  dp=1 short-circuits to replica 0
+        # — exactly the pre-router code path.
+        rep = self._place_replica(
+            prompt_token_ids,
+            tenant_id,
+            getattr(lora_request, "name", None),
         )
         span = None
         if self._tracer is not None:
@@ -550,6 +675,7 @@ class AsyncLLMEngine:
                     lora_name=getattr(lora_request, "name", None),
                     trace_id=getattr(span, "trace_id", None),
                     deadline=deadline,
+                    tenant_id=tenant_id,
                 )
                 if request_id in self._early_aborts:
                     # abort() ran before the engine knew the request; it
@@ -697,6 +823,7 @@ class AsyncLLMEngine:
         for rep in self._replicas:
             state = engine_introspection(rep.engine)
             state["replica"] = rep.index
+            state["serving"] = rep.serving
             state["in_flight"] = rep.in_flight_desc
             state["heartbeat_age_s"] = round(now - rep.last_beat, 3)
             replicas.append(state)
@@ -722,6 +849,7 @@ class AsyncLLMEngine:
                 if self.frontdoor is not None
                 else None
             ),
+            "router": self.router.debug_state(),
             "replicas": replicas,
             "compile_tracker": {
                 "compiled_shapes": compile_tracker.num_shapes(),
@@ -938,10 +1066,15 @@ class AsyncLLMEngine:
             rep.in_flight_desc = None
             rep.last_beat = time.monotonic()
             await emit(outs)
+            committed = self._plan_tokens(plan)
+            # per-replica committed-token attribution: the placement
+            # router's load tiebreak and the bench's per-replica tok/s
+            self.router.note_committed(rep.index, committed)
             if self.frontdoor is not None:
                 # finished rows free batch slots/pages and the commit's
-                # tokens feed the admission estimator's throughput EWMA
-                self.frontdoor.note_progress(self._plan_tokens(plan))
+                # tokens feed the admission estimator's PER-REPLICA
+                # throughput EWMA
+                self.frontdoor.note_progress(committed, replica=rep.index)
 
         async def try_chain() -> Optional[tuple]:
             """Dispatch the in-flight decode's successor wave from
@@ -1145,6 +1278,80 @@ class AsyncLLMEngine:
                     failed += 1
         return failed
 
+    async def replay_to_replicas(self, rep: _Replica) -> int:
+        """Cross-replica replay (docs/SCALING.md): move the dead
+        replica's replay-safe requests (zero emitted tokens — parked in
+        its scheduler or mid-prefill) onto HEALTHY replicas NOW, before
+        the multi-second rebuild, so their TTFT pays a placement hop
+        instead of a full recovery.  Runs under the dead replica's lock
+        with its step loop reaped; ``fail_unreplayable`` has already
+        triaged everything else out.  Returns the number moved; 0 when
+        no healthy replica exists (dp=1 — ``restart_replica`` then
+        replays into the rebuilt engine, the pre-router behavior).
+        """
+        healthy = [
+            r for r in self._replicas if r.serving and r is not rep
+        ]
+        if not healthy:
+            return 0
+        moved = 0
+        targets: set[int] = set()
+        async with rep.lock:
+            old = rep.engine
+            for seq in list(old._seqs.values()):  # noqa: SLF001
+                if seq.is_finished or seq.num_output_tokens > 0:
+                    continue  # fail_unreplayable owns these
+                if seq.request_id not in self._queues:
+                    # consumer vanished while the replica was down
+                    old._seqs.pop(seq.request_id, None)  # noqa: SLF001
+                    old.lora_manager.unpin(seq.lora_name)
+                    continue
+                # tenant threaded through so stickiness FOLLOWS the
+                # replay: place() re-pins the tenant's sticky entry to
+                # the replica the request lands on
+                target = self._place_replica(
+                    list(seq.prompt_token_ids), seq.tenant_id,
+                    seq.lora_name,
+                )
+                if target is rep:  # defensive: never replay onto the dead
+                    target = healthy[moved % len(healthy)]
+                old._seqs.pop(seq.request_id, None)  # noqa: SLF001
+                old.lora_manager.unpin(seq.lora_name)
+                # no target.lock needed: add_request is synchronous and
+                # every engine-state mutation runs on this one event-loop
+                # thread, so it cannot interleave a target critical
+                # section (taking target.lock here, inside rep.lock,
+                # would create the fleet's only nested-lock site)
+                target.engine.add_request(
+                    seq.request_id,
+                    seq.prompt,
+                    seq.params,
+                    prompt_token_ids=list(seq.prompt_token_ids),
+                    arrival_time=seq.metrics.arrival_time,
+                    lora_name=seq.lora_name,
+                    trace_id=seq.trace_id,
+                    deadline=seq.deadline,
+                    tenant_id=seq.tenant_id,
+                )
+                # abort()/stream bookkeeping must follow the request to
+                # its new home — the dead replica's engine no longer
+                # knows it
+                self._owner[seq.request_id] = target
+                targets.add(target.index)
+                moved += 1
+        for r in self._replicas:
+            if r.index in targets:
+                r.last_beat = time.monotonic()
+                r.new_work.set()
+        if moved:
+            from vllm_tgis_adapter_tpu import metrics
+
+            # counted HERE, not on the recovery attempt: a cross-replica
+            # move happens exactly once even when the dead replica's
+            # rebuild later fails and retries
+            metrics.requests_replayed_total.inc(moved)
+        return moved
+
     async def restart_replica(
         self, rep: _Replica, new_engine: LLMEngine,
         fail_error: BaseException,
@@ -1190,8 +1397,15 @@ class AsyncLLMEngine:
                     fails.append(seq.request_id)
                     continue
                 replays.append(seq)
+            new_engine.replica_index = rep.index
             rep.engine = new_engine
             rep.in_flight_desc = None
+            # the replacement's committed-token rates start fresh, in
+            # BOTH consumers: the router's load tiebreak and the front
+            # door's drain estimator
+            self.router.forget_replica_rate(rep.index)
+            if self.frontdoor is not None:
+                self.frontdoor.forget_replica_rate(rep.index)
             if rep is self._replicas[0]:
                 # replica 0 doubles as the host-side singleton surface
                 self.engine = new_engine
@@ -1207,6 +1421,7 @@ class AsyncLLMEngine:
                     lora_name=seq.lora_name,
                     trace_id=seq.trace_id,
                     deadline=seq.deadline,
+                    tenant_id=seq.tenant_id,
                 )
                 replayed += 1
         failed = 0

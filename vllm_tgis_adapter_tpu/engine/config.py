@@ -750,6 +750,16 @@ class ParallelConfig:
     tensor_parallel_size: int = 1
     pipeline_parallel_size: int = 1
     data_parallel_size: int = 1
+    # --dp-replicas: like data_parallel_size (N independent engine
+    # replicas behind the front door's placement router), but tolerant
+    # of a host with fewer than N*pp*sp*tp devices — replicas then SHARE
+    # the visible device set (each still owns its own scheduler, KV
+    # pool, step loop, and flight recorder).  That shared mode is the
+    # CPU-proxy / single-host dev story (bench dp scaling, chaos tests);
+    # on real multi-chip hosts with enough devices both flags partition
+    # identical disjoint slices.  Mutually exclusive with
+    # data_parallel_size > 1 (one replica-count knob at a time).
+    dp_replicas: int = 1
     # ring-attention sequence parallelism for long-context prefill: the
     # sequence axis of prefill activations/attention is sharded over the
     # mesh's sp axis (ops/ring_attention.py); the paged KV cache stays
@@ -991,6 +1001,20 @@ class EngineConfig:
                     "reads the replicated paged cache, not the sp ring); "
                     "drop one of the flags"
                 )
+        if self.parallel_config.dp_replicas < 1:
+            raise ValueError(
+                f"--dp-replicas must be >= 1 "
+                f"(got {self.parallel_config.dp_replicas})"
+            )
+        if (
+            self.parallel_config.dp_replicas > 1
+            and self.parallel_config.data_parallel_size > 1
+        ):
+            raise ValueError(
+                "--dp-replicas and --data-parallel-size are two spellings "
+                "of the replica count (strict disjoint-device vs "
+                "shared-device-tolerant); set exactly one of them > 1"
+            )
         if self.watchdog_action not in ("snapshot", "restart"):
             raise ValueError(
                 f"--watchdog-action must be 'snapshot' or 'restart' "
@@ -1117,6 +1141,10 @@ class EngineConfig:
                 tensor_parallel_size=args.tensor_parallel_size or 1,
                 pipeline_parallel_size=args.pipeline_parallel_size,
                 data_parallel_size=args.data_parallel_size,
+                # no `or 1` coercion: --dp-replicas 0 must reach the
+                # >= 1 validation and be rejected, not silently boot
+                # a single replica
+                dp_replicas=getattr(args, "dp_replicas", 1),
                 sequence_parallel_size=getattr(
                     args, "sequence_parallel_size", 1
                 ) or 1,

@@ -203,6 +203,10 @@ class LLMEngine:
         # monotonically increasing dispatch counter; stamps recorder
         # events so "which wave was in flight" is answerable post-hoc
         self.step_counter = 0
+        # dp replica index (AsyncLLMEngine stamps it on every replica's
+        # engine, and on rebuilt replacements): the `replica` label on
+        # the per-dispatch step/occupancy metrics
+        self.replica_index = 0
         self._seqs: dict[str, Sequence] = {}
         # explicit device slice (from_config sets it under dp/pp); the
         # supervisor's rebuild reuses it so a replacement engine lands
@@ -239,16 +243,19 @@ class LLMEngine:
             mesh_from_parallel_config,
         )
 
-        if config.parallel_config.data_parallel_size > 1:
+        if (
+            config.parallel_config.data_parallel_size > 1
+            or config.parallel_config.dp_replicas > 1
+        ):
             # LLMEngine is always ONE dp rank: AsyncLLMEngine builds the
             # replica fleet and hands each LLMEngine a dp=1 config plus
             # its device slice.  Rejecting here (not per-branch) keeps
-            # the pp and non-pp paths consistent — a dp>1 config can
-            # never silently run at 1/dp capacity.
+            # the pp and non-pp paths consistent — a dp>1 config (either
+            # spelling) can never silently run at 1/dp capacity.
             raise ValueError(
                 "LLMEngine is one dp replica; construct via "
-                "AsyncLLMEngine.from_config for --data-parallel-size "
-                "replicas"
+                "AsyncLLMEngine.from_config for --data-parallel-size / "
+                "--dp-replicas replicas"
             )
         mcfg = config.model_config
         pcfg = config.parallel_config
@@ -382,6 +389,7 @@ class LLMEngine:
         lora_name: Optional[str] = None,
         trace_id: Optional[str] = None,
         deadline: Optional[float] = None,
+        tenant_id: Optional[str] = None,
     ) -> None:
         if request_id in self._seqs:
             raise ValueError(f"duplicate request_id {request_id!r}")
@@ -405,6 +413,7 @@ class LLMEngine:
             lora_name=lora_name,
         )
         seq.trace_id = trace_id
+        seq.tenant_id = tenant_id
         # queue TTL (frontdoor): the async layer passes the effective
         # deadline (request SLO ∧ arrival + --queue-ttl, stamped before
         # any fair-queue parking); direct core users get the same
@@ -972,8 +981,7 @@ class LLMEngine:
                 batch_bucket=plan.batch_bucket, num_steps=plan.num_steps,
             )
 
-    @staticmethod
-    def _observe_plan(plan, prepared) -> None:
+    def _observe_plan(self, plan, prepared) -> None:
         """Step-level telemetry (metrics.py): batch occupancy / padding
         waste gauges for this dispatch's shape, plus the plan→commit
         timestamp the commit phase turns into a step-duration sample."""
@@ -1004,6 +1012,7 @@ class LLMEngine:
                     num_seqs=len(plan.seqs),
                     batch_bucket=plan.batch_bucket,
                     num_steps=plan.num_steps,
+                    replica=self.replica_index,
                 )
         except Exception:  # pragma: no cover — metrics are best-effort
             logger.debug("step metric observation failed", exc_info=True)
@@ -1091,10 +1100,15 @@ class LLMEngine:
         t0 = getattr(prepared, "_obs_plan_t0", None)
         if t0 is not None:
             duration = time.perf_counter() - t0
+            rep = str(self.replica_index)
             if isinstance(plan, DecodePlan):
-                metrics.decode_step_seconds.observe(duration)
+                metrics.decode_step_seconds.labels(replica=rep).observe(
+                    duration
+                )
             else:
-                metrics.prefill_step_seconds.observe(duration)
+                metrics.prefill_step_seconds.labels(replica=rep).observe(
+                    duration
+                )
         if isinstance(plan, RaggedPlan):
             seqs, toks = [], []
             for item, tok in zip(plan.items, result):

@@ -76,6 +76,10 @@ class Sequence:
         # the async layer at admission so flight-recorder events and
         # /debug/requests timelines correlate with the exported spans
         self.trace_id: Optional[str] = None
+        # tenant id (x-tenant-id / adapter fallback), carried so a
+        # cross-replica replay can preserve the placement router's
+        # tenant stickiness (frontdoor/placement.py)
+        self.tenant_id: Optional[str] = None
         # epoch-seconds queue TTL (request deadline tightened by
         # --queue-ttl, engine/core.py add_request): while still
         # pre-prefill past this, the scheduler sheds the request

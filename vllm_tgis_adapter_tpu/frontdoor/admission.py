@@ -77,6 +77,17 @@ _MAX_TENANT_LABELS = 64
 _PUMP_BACKSTOP_S = 0.5
 
 
+class _ReplicaRate:
+    """One replica's committed-token throughput observation state."""
+
+    __slots__ = ("rate", "acc_tokens", "acc_since")
+
+    def __init__(self) -> None:
+        self.rate: Optional[float] = None
+        self.acc_tokens = 0.0
+        self.acc_since: Optional[float] = None
+
+
 class FrontDoor:
     def __init__(
         self,
@@ -87,6 +98,9 @@ class FrontDoor:
         waiting_depth_fn: Callable[[], int],
         backlog_tokens_fn: Callable[[], float],
         kv_token_capacity_fn: Callable[[], float],
+        serving_replicas_fn: Optional[
+            Callable[[], "frozenset[int]"]
+        ] = None,
         record_shed: Optional[Callable[..., None]] = None,
     ):
         """``room_fn(pending)`` — can the engine take another request
@@ -95,6 +109,12 @@ class FrontDoor:
         ``backlog_tokens_fn`` — token backlog already inside the
         engines; ``kv_token_capacity_fn`` — pool size in tokens (the
         ``resolve_num_blocks`` budget), the throughput prior's base;
+        ``serving_replicas_fn`` — indices of replicas currently serving
+        (None = every replica that ever reported progress counts): the
+        drain estimator sums PER-REPLICA throughput EWMAs over exactly
+        this set, so one replica in supervised recovery subtracts its
+        capacity instead of dragging a fleet-global average down and
+        firing --admission-deadline sheds spuriously;
         ``record_shed(request_id, tenant, reason, **detail)`` — flight
         recorder hook."""
         self.config = config
@@ -103,6 +123,7 @@ class FrontDoor:
         self._waiting_depth_fn = waiting_depth_fn
         self._backlog_tokens_fn = backlog_tokens_fn
         self._kv_token_capacity_fn = kv_token_capacity_fn
+        self._serving_replicas_fn = serving_replicas_fn
         self._record_shed = record_shed
 
         self._wfq = WeightedFairQueue(dict(config.tenant_weights))
@@ -123,10 +144,11 @@ class FrontDoor:
         self._drain_listeners: list[Callable[[], None]] = []
         self._tenant_labels: set[str] = set()
 
-        # observed decode/prefill token throughput (tokens/s EWMA)
-        self._rate: Optional[float] = None
-        self._acc_tokens = 0.0
-        self._acc_since: Optional[float] = None
+        # observed decode/prefill token throughput, PER REPLICA
+        # (tokens/s EWMA each): the drain estimate sums the serving
+        # replicas' rates, so a recovering replica subtracts capacity
+        # cleanly instead of poisoning one global average
+        self._rep_rates: dict[int, _ReplicaRate] = {}
 
         # lifetime counters (drain summary + tests)
         self.admitted_total = 0
@@ -352,36 +374,78 @@ class FrontDoor:
     # throughput observation — idle time must not read as low tok/s
     _RATE_WINDOW_MAX_S = 10.0
 
-    def note_progress(self, tokens: float) -> None:
-        """Feed one committed dispatch's token count into the
-        throughput EWMA that prices --admission-deadline sheds."""
+    def note_progress(self, tokens: float, replica: int = 0) -> None:
+        """Feed one committed dispatch's token count into ``replica``'s
+        throughput EWMA.  The drain estimate that prices
+        --admission-deadline sheds sums these over the replicas the
+        ``serving_replicas_fn`` hook currently reports.
+
+        Per-replica windows make the idle reset trip more often than
+        the old fleet-global accumulator (each replica sees 1/dp of the
+        commits), so under very light traffic no rate may form and the
+        estimate rests on the capacity prior.  Deliberate: the prior is
+        the better predictor of under-backlog throughput anyway, and a
+        real burst produces per-replica commits well inside the window,
+        forming observed rates within a second or two."""
+        state = self._rep_rates.get(replica)
+        if state is None:
+            state = self._rep_rates[replica] = _ReplicaRate()
         now = time.monotonic()
         if (
-            self._acc_since is None
-            or now - self._acc_since > self._RATE_WINDOW_MAX_S
+            state.acc_since is None
+            or now - state.acc_since > self._RATE_WINDOW_MAX_S
         ):
             # first sample, or the window spans an idle period: start
             # fresh instead of decaying the EWMA toward zero
-            self._acc_since = now
-            self._acc_tokens = tokens
+            state.acc_since = now
+            state.acc_tokens = tokens
             self.kick()
             return
-        self._acc_tokens += tokens
-        dt = now - self._acc_since
+        state.acc_tokens += tokens
+        dt = now - state.acc_since
         if dt >= 1.0:
-            inst = self._acc_tokens / dt
-            self._rate = (
+            inst = state.acc_tokens / dt
+            state.rate = (
                 inst
-                if self._rate is None
-                else 0.7 * self._rate + 0.3 * inst
+                if state.rate is None
+                else 0.7 * state.rate + 0.3 * inst
             )
-            self._acc_tokens = 0.0
-            self._acc_since = now
+            state.acc_tokens = 0.0
+            state.acc_since = now
         self.kick()
 
+    def forget_replica_rate(self, replica: int) -> None:
+        """A replica was rebuilt: its pre-death throughput EWMA must not
+        price the drain estimate the moment it re-admits (the rebuilt
+        engine starts with an empty queue and a cold cache — counting
+        the old rate would over-admit against --admission-deadline)."""
+        self._rep_rates.pop(replica, None)
+
+    def _serving_replicas(self) -> Optional["frozenset[int]"]:
+        if self._serving_replicas_fn is None:
+            return None
+        try:
+            return self._serving_replicas_fn()
+        except Exception:  # pragma: no cover — estimator must not raise
+            return None
+
     def _throughput(self) -> float:
-        if self._rate is not None and self._rate > 0:
-            return self._rate
+        serving = self._serving_replicas()
+        rates = [
+            state.rate
+            for idx, state in self._rep_rates.items()
+            if state.rate is not None
+            and state.rate > 0
+            and (serving is None or idx in serving)
+        ]
+        if rates:
+            return float(sum(rates))
+        # prior before any observation: pool capacity over a
+        # conservative turnover.  On a partial outage the capacity hook
+        # excludes quiesced replicas; on a FULL outage it deliberately
+        # falls back to the whole fleet — admission is paused then, and
+        # full-fleet capacity is the right prior for the moment
+        # recovery re-opens it
         capacity = max(self._kv_token_capacity_fn(), 1.0)
         return capacity / _CAPACITY_TURNOVER_S
 
@@ -579,6 +643,11 @@ class FrontDoor:
             "admitted_total": self.admitted_total,
             "shed_total": self.shed_total,
             "throughput_tok_per_s": round(self._throughput(), 1),
+            "throughput_by_replica": {
+                str(idx): round(state.rate, 1)
+                for idx, state in sorted(self._rep_rates.items())
+                if state.rate is not None
+            },
             "oldest_age_s": round(
                 max(
                     (now - e.payload["enqueued"] for e in entries),

@@ -1,0 +1,232 @@
+"""Affinity-aware replica placement for data-parallel serving.
+
+With ``--dp-replicas N`` (or ``--data-parallel-size N``) the front door
+fronts N independent engine replicas, each with its own scheduler, KV
+pool, and prefix cache.  WHERE a request lands then matters twice over:
+
+* **prefix-cache affinity** — a replica whose paged cache already holds
+  the request's prompt prefix serves prefill nearly for free
+  (``BlockAllocator.peek_prefix``: a pure hash walk, no refcounts);
+  routing the request anywhere else re-computes KV that exists on the
+  fleet.  This is the cache-aware routing the data-parallel serving
+  literature converges on (PAPERS.md: Orca-style continuous-batching
+  replicas; the SGLang/Mooncake cache-aware router family).
+* **tenant/adapter affinity** — a tenant's LoRA stack and its WFQ
+  virtual-time state live wherever its requests land; sticky placement
+  keeps an adapter resident on one replica instead of faulting it into
+  every pool in rotation.
+* **load** — both affinities yield to load: a replica more than
+  ``load_slack`` requests deeper than the least-loaded one is not
+  eligible for affinity placement, so a hot prefix or a chatty tenant
+  cannot pile a replica over while its siblings idle.
+
+``place()`` is a pure function of the snapshots handed to it — the
+async engine builds one ``ReplicaSnapshot`` per SERVING replica (dead
+and recovering replicas are excluded by the caller, so placement drains
+away from a replica the moment its supervisor quiesces it) and routes
+the request to the returned index.  Scoring order: prefix > tenant >
+least-loaded, mirroring the tentpole spec in docs/SCALING.md.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import OrderedDict
+from typing import Optional
+
+from vllm_tgis_adapter_tpu import metrics
+from vllm_tgis_adapter_tpu.logging import init_logger
+
+logger = init_logger(__name__)
+
+POLICY_PREFIX = "prefix"
+POLICY_TENANT = "tenant"
+POLICY_LOAD = "load"
+POLICIES = (POLICY_PREFIX, POLICY_TENANT, POLICY_LOAD)
+
+# EWMA weight for the per-replica committed-token rate (load tiebreak +
+# bench attribution); one sample ~= one committed dispatch
+_EWMA_ALPHA = 0.3
+
+
+@dataclasses.dataclass
+class ReplicaSnapshot:
+    """One serving replica's placement-relevant state at decision time.
+
+    ``load`` is the scheduler's queue depth (waiting + running);
+    ``prefix_tokens`` is the length of THIS request's prompt prefix
+    already resident in the replica's paged cache (0 when prefix
+    caching is off or the caller skipped the probe).
+    """
+
+    index: int
+    load: float
+    prefix_tokens: int = 0
+
+
+class PlacementRouter:
+    """Scores replicas for each request and remembers tenant stickiness.
+
+    Host-side only, event-loop confined (no locks needed): ``place()``
+    runs in ``generate()`` and ``note_committed()`` in the step loops'
+    commit phase, both on the one event-loop thread.
+    """
+
+    def __init__(
+        self,
+        *,
+        load_slack: float = 2.0,
+        max_sticky_tenants: int = 1024,
+    ):
+        # affinity placement is only allowed onto replicas within this
+        # many queued requests of the least-loaded one — the guard that
+        # keeps a hot prefix or sticky tenant from overloading a replica
+        self.load_slack = load_slack
+        # tenant/adapter -> replica index of the last placement; bounded
+        # LRU because tenant ids are client-controlled
+        self._sticky: "OrderedDict[str, int]" = OrderedDict()
+        self._max_sticky = max_sticky_tenants
+        #: lifetime placements by policy (debug_state + bench stamps)
+        self.placed_by_policy: dict[str, int] = {p: 0 for p in POLICIES}
+        #: lifetime placements per replica index
+        self.placed_by_replica: dict[int, int] = {}
+        # per-replica committed-token accounting (commit-phase feed):
+        # lifetime totals for bench attribution, EWMA rate for the load
+        # tiebreak between equally-deep queues
+        self._committed_total: dict[int, float] = {}
+        self._committed_rate: dict[int, float] = {}
+
+    # ------------------------------------------------------------- feeds
+
+    def note_committed(self, replica: int, tokens: float) -> None:
+        """One committed dispatch's token count on ``replica``."""
+        self._committed_total[replica] = (
+            self._committed_total.get(replica, 0.0) + tokens
+        )
+        prev = self._committed_rate.get(replica)
+        self._committed_rate[replica] = (
+            tokens
+            if prev is None
+            else (1 - _EWMA_ALPHA) * prev + _EWMA_ALPHA * tokens
+        )
+
+    def forget_replica_rate(self, replica: int) -> None:
+        """A replica was rebuilt: its in-flight rate is history."""
+        self._committed_rate.pop(replica, None)
+
+    def committed_by_replica(self) -> dict[int, float]:
+        """Lifetime committed-token totals per replica (bench stamps)."""
+        return dict(self._committed_total)
+
+    # --------------------------------------------------------- placement
+
+    def _sticky_get(self, key: str) -> Optional[int]:
+        idx = self._sticky.get(key)
+        if idx is not None:
+            self._sticky.move_to_end(key)
+        return idx
+
+    def _sticky_set(self, key: str, idx: int) -> None:
+        self._sticky[key] = idx
+        self._sticky.move_to_end(key)
+        while len(self._sticky) > self._max_sticky:
+            self._sticky.popitem(last=False)
+
+    def place(
+        self,
+        snapshots: list[ReplicaSnapshot],
+        *,
+        affinity_key: Optional[str] = None,
+    ) -> tuple[int, str]:
+        """Pick a replica for one request.
+
+        ``snapshots`` must be non-empty and contain only replicas the
+        caller is willing to use (serving ones; the caller's fallback
+        for a fleet with zero serving replicas is its own).
+        ``affinity_key`` is the tenant id or adapter name — ``None``
+        (anonymous default-tenant traffic) gets no stickiness, so bulk
+        un-tenanted load spreads purely by depth.
+
+        Returns ``(replica_index, policy)`` with policy one of
+        ``prefix`` / ``tenant`` / ``load``.
+        """
+        best_load = min(s.load for s in snapshots)
+        eligible = [
+            s for s in snapshots if s.load <= best_load + self.load_slack
+        ]
+
+        chosen: Optional[ReplicaSnapshot] = None
+        policy = POLICY_LOAD
+        # 1. prefix affinity: the most resident prompt tokens wins,
+        # provided that replica is not already over the load slack
+        prefix_best = max(
+            eligible, key=lambda s: (s.prefix_tokens, -s.load, -s.index)
+        )
+        if prefix_best.prefix_tokens > 0:
+            chosen, policy = prefix_best, POLICY_PREFIX
+        # 2. tenant/adapter stickiness
+        if chosen is None and affinity_key is not None:
+            sticky_idx = self._sticky_get(affinity_key)
+            if sticky_idx is not None:
+                for s in eligible:
+                    if s.index == sticky_idx:
+                        chosen, policy = s, POLICY_TENANT
+                        break
+        # 3. least-loaded fallback; committed-rate EWMA breaks depth
+        # ties toward the replica currently grinding fewer tokens
+        if chosen is None:
+            chosen = min(
+                snapshots,
+                key=lambda s: (
+                    s.load,
+                    self._committed_rate.get(s.index, 0.0),
+                    s.index,
+                ),
+            )
+            policy = POLICY_LOAD
+
+        if affinity_key is not None:
+            self._sticky_set(affinity_key, chosen.index)
+        self.placed_by_policy[policy] += 1
+        self.placed_by_replica[chosen.index] = (
+            self.placed_by_replica.get(chosen.index, 0) + 1
+        )
+        try:
+            metrics.frontdoor_placement_total.labels(policy=policy).inc()
+        except Exception:  # pragma: no cover — telemetry must not raise
+            pass
+        return chosen.index, policy
+
+    # ------------------------------------------------------ introspection
+
+    @property
+    def placed_total(self) -> int:
+        return sum(self.placed_by_policy.values())
+
+    def affinity_hit_rate(self) -> float:
+        """Fraction of placements won by an affinity policy (prefix or
+        tenant) rather than the least-loaded fallback."""
+        total = self.placed_total
+        if total == 0:
+            return 0.0
+        hits = (
+            self.placed_by_policy[POLICY_PREFIX]
+            + self.placed_by_policy[POLICY_TENANT]
+        )
+        return hits / total
+
+    def debug_state(self) -> dict:
+        """Router section of the engine's /debug/state snapshot."""
+        return {
+            "placed_by_policy": dict(self.placed_by_policy),
+            "placed_by_replica": {
+                str(k): v
+                for k, v in sorted(self.placed_by_replica.items())
+            },
+            "affinity_hit_rate": round(self.affinity_hit_rate(), 4),
+            "sticky_tenants": len(self._sticky),
+            "committed_tokens_by_replica": {
+                str(k): round(v, 1)
+                for k, v in sorted(self._committed_total.items())
+            },
+        }

@@ -210,14 +210,18 @@ inter_token_seconds = _get_or_create(
 decode_step_seconds = _get_or_create(
     Histogram,
     f"{_PREFIX}_decode_step_seconds",
-    "Wall time of one fused decode dispatch, plan to commit",
+    "Wall time of one fused decode dispatch, plan to commit, per dp "
+    "replica",
+    labelnames=("replica",),
     buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
              5.0),
 )
 prefill_step_seconds = _get_or_create(
     Histogram,
     f"{_PREFIX}_prefill_step_seconds",
-    "Wall time of one prefill (chunk or packed) dispatch, plan to commit",
+    "Wall time of one prefill (chunk or packed) dispatch, plan to "
+    "commit, per dp replica",
+    labelnames=("replica",),
     buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
              5.0),
 )
@@ -225,7 +229,9 @@ decode_batch_occupancy = _get_or_create(
     Gauge,
     f"{_PREFIX}_decode_batch_occupancy",
     "Real sequences / padded batch bucket of the most recent decode "
-    "dispatch (0-1); low values mean the compile bucket is mostly pad",
+    "dispatch (0-1), per dp replica; low values mean the compile "
+    "bucket is mostly pad",
+    labelnames=("replica",),
 )
 prefill_padding_waste = _get_or_create(
     Gauge,
@@ -323,8 +329,8 @@ engine_restarts_total = _get_or_create(
     Counter,
     f"{_PREFIX}_engine_restarts_total",
     "Supervised engine restarts, by death cause (step_loop, oom, stall, "
-    "recovery_failure)",
-    labelnames=("cause",),
+    "recovery_failure) and dp replica index",
+    labelnames=("cause", "replica"),
 )
 requests_replayed_total = _get_or_create(
     Counter,
@@ -374,6 +380,16 @@ frontdoor_tenant_tokens_total = _get_or_create(
     "distinct values, then 'other')",
     labelnames=("tenant",),
 )
+frontdoor_placement_total = _get_or_create(
+    Counter,
+    f"{_PREFIX}_frontdoor_placement_total",
+    "Requests placed onto a dp replica by the placement router, by the "
+    "policy that won: prefix (prompt prefix resident in that replica's "
+    "cache), tenant (tenant/adapter stickiness), load (least-loaded "
+    "fallback).  Never incremented at --dp-replicas 1 (single-replica "
+    "routing short-circuits)",
+    labelnames=("policy",),
+)
 
 
 class _StepSnapshot:
@@ -395,9 +411,9 @@ step_snapshot = _StepSnapshot()
 
 
 def observe_decode_plan(*, num_seqs: int, batch_bucket: int,
-                        num_steps: int) -> None:
+                        num_steps: int, replica: int = 0) -> None:
     occupancy = num_seqs / batch_bucket if batch_bucket else 0.0
-    decode_batch_occupancy.set(occupancy)
+    decode_batch_occupancy.labels(replica=str(replica)).set(occupancy)
     padded = (batch_bucket - num_seqs) * num_steps
     if padded > 0:
         padded_tokens_total.labels(phase="decode").inc(padded)

@@ -111,8 +111,14 @@ class EngineSupervisor:
         #: One dict per completed or failed restart attempt — the
         #: termination-log checkpoint and /debug/state both render this.
         self.restart_history: list[dict] = []
-        # monotonic stamps of attempts, for the sliding-window breaker
-        self._attempt_times: list[float] = []
+        # monotonic stamps of attempts PER REPLICA, for the sliding-
+        # window breaker: on a dp fleet each replica gets its own
+        # restart budget and backoff ladder, so independent transient
+        # faults on different replicas never pool into an escalation
+        # that kills healthy replicas (docs/SCALING.md: the pod dies
+        # only when ONE replica crash-loops or the last replica dies).
+        # At dp=1 this is exactly the old single-engine budget.
+        self._attempt_times: dict[int, list[float]] = {}
         self._pending: list[tuple["_Replica", BaseException, str]] = []
         self._pending_reps: set[int] = set()
         self._task: Optional[asyncio.Task] = None
@@ -178,18 +184,41 @@ class EngineSupervisor:
         self, rep: "_Replica", err: BaseException, cause: Optional[str] = None
     ) -> None:
         """A step loop died (already-wrapped error).  Synchronous: by
-        the time it returns, lifecycle is ``recovering``, admission is
-        paused, and the recovery task is scheduled."""
+        the time it returns the dead replica is out of placement and
+        the recovery task is scheduled.
+
+        Scope depends on the fleet (docs/SCALING.md): while at least
+        one OTHER replica is serving, this is a PARTIAL outage —
+        lifecycle stays ``serving``, the front door keeps admitting
+        (the placement router routes around the quiesced replica), and
+        the pod's health surfaces never flinch.  Only when the LAST
+        serving replica dies does the whole pod quiesce: lifecycle →
+        ``recovering``, admission paused — exactly the dp=1 behavior.
+        """
         if not self.accepts():
             return
         if rep.index in self._pending_reps:
             return  # this replica's recovery is already queued
+        # out of placement BEFORE anything else: new arrivals and the
+        # drain estimator must stop seeing this replica immediately
+        rep.serving = False
         self._pending_reps.add(rep.index)
         self._pending.append((rep, err, cause or classify_cause(err)))
-        self._set_lifecycle(LIFECYCLE_RECOVERING)
-        frontdoor = self.engine.frontdoor
-        if frontdoor is not None:
-            frontdoor.pause()
+        healthy = [
+            r for r in self.engine._replicas  # noqa: SLF001
+            if r.serving
+        ]
+        if healthy:
+            logger.warning(
+                "engine supervisor: replica %d quiesced; %d replica(s) "
+                "keep serving (capacity loss, not an outage)",
+                rep.index, len(healthy),
+            )
+        else:
+            self._set_lifecycle(LIFECYCLE_RECOVERING)
+            frontdoor = self.engine.frontdoor
+            if frontdoor is not None:
+                frontdoor.pause()
         if self._task is None or self._task.done():
             self._task = asyncio.get_running_loop().create_task(
                 self._recover_all(), name="engine-supervisor"
@@ -228,30 +257,34 @@ class EngineSupervisor:
                 pass
             self._task = None
 
-    def _recent_attempts(self, now: float) -> int:
-        self._attempt_times = [
-            t for t in self._attempt_times if now - t <= self.window_s
+    def _recent_attempts(self, rep_index: int, now: float) -> int:
+        stamps = [
+            t
+            for t in self._attempt_times.get(rep_index, [])
+            if now - t <= self.window_s
         ]
-        return len(self._attempt_times)
+        self._attempt_times[rep_index] = stamps
+        return len(stamps)
 
     async def _recover_all(self) -> None:
         """Drain the pending-death queue; one recovery at a time."""
         while self._pending:
             rep, err, cause = self._pending.pop(0)
             now = time.monotonic()
-            if self._recent_attempts(now) >= self.max_restarts:
+            if self._recent_attempts(rep.index, now) >= self.max_restarts:
                 await self._escalate(err, cause)
                 return
-            self._attempt_times.append(now)
+            self._attempt_times[rep.index].append(now)
             attempt = len(self.restart_history) + 1
-            # base * 2^(n-1) over attempts in the window — exactly the
-            # formula the --engine-restart-backoff help documents
+            # base * 2^(n-1) over THIS replica's attempts in the window
+            # — exactly the formula the --engine-restart-backoff help
+            # documents, per replica
             backoff = 0.0
             if self.backoff_base_s > 0:
                 backoff = min(
                     BACKOFF_MAX_S,
                     self.backoff_base_s
-                    * (2 ** (len(self._attempt_times) - 1)),
+                    * (2 ** (len(self._attempt_times[rep.index]) - 1)),
                 )
             entry = {
                 "attempt": attempt,
@@ -262,18 +295,21 @@ class EngineSupervisor:
                 "backoff_s": round(backoff, 3),
             }
             self.restart_history.append(entry)
-            metrics.engine_restarts_total.labels(cause=cause).inc()
+            metrics.engine_restarts_total.labels(
+                cause=cause, replica=str(rep.index)
+            ).inc()
             logger.warning(
                 "engine supervisor: replica %d died (%s); restart attempt "
-                "%d/%d in window, backoff %.2fs",
-                rep.index, cause, len(self._attempt_times),
+                "%d/%d in its window, backoff %.2fs",
+                rep.index, cause, len(self._attempt_times[rep.index]),
                 self.max_restarts, backoff,
             )
-            if backoff > 0:
-                await asyncio.sleep(backoff)
             t0 = time.monotonic()
             try:
-                replayed, failed = await self._recover_one(rep, err)
+                moved, rebuilt_replayed, failed = await self._recover_one(
+                    rep, err, backoff
+                )
+                replayed = moved + rebuilt_replayed
             except asyncio.CancelledError:
                 raise
             except BaseException as exc:  # noqa: BLE001 — death DURING recovery
@@ -305,8 +341,11 @@ class EngineSupervisor:
             metrics.recovery_seconds.observe(duration)
             # counted only on the attempt that SUCCEEDED: a failed
             # attempt's partial replays get re-triaged and re-counted
-            # by its retry, which would overstate the metric
-            metrics.requests_replayed_total.inc(replayed)
+            # by its retry, which would overstate the metric.  Cross-
+            # replica moves are NOT re-counted here — replay_to_replicas
+            # counted them at move time (they happen exactly once, even
+            # across rebuild retries).
+            metrics.requests_replayed_total.inc(rebuilt_replayed)
             rep.engine.recorder.record(
                 "restart", step=rep.engine.step_counter, replica=rep.index,
                 cause=cause, attempt=attempt, replayed=replayed,
@@ -348,10 +387,11 @@ class EngineSupervisor:
             frontdoor.resume()
 
     async def _recover_one(
-        self, rep: "_Replica", err: BaseException
-    ) -> tuple[int, int]:
-        """Quiesce + rebuild + replay one replica.  Raises on failure
-        (the caller converts that into another attempt)."""
+        self, rep: "_Replica", err: BaseException, backoff: float = 0.0
+    ) -> tuple[int, int, int]:
+        """Quiesce + rebuild + replay one replica.  Returns
+        ``(moved_to_healthy, replayed_into_rebuilt, failed)``; raises
+        on failure (the caller converts that into another attempt)."""
         # reap the dead (or stuck) step-loop task; a stalled task is
         # blocked in to_thread — cancelling abandons the worker thread
         task = rep.task
@@ -379,8 +419,25 @@ class EngineSupervisor:
         # gets its retryable UNAVAILABLE now, not after the rebuild and
         # precompile re-warm it cannot benefit from
         failed = await self.engine.fail_unreplayable(rep, fail_error)
+        # then move replay-safe work onto HEALTHY replicas immediately
+        # (cross-replica replay, docs/SCALING.md): those requests reach
+        # prefill while this replica is still rebuilding.  dp=1 (no
+        # healthy sibling) moves nothing — restart_replica replays into
+        # the rebuilt engine below, the pre-router behavior.
+        moved = await self.engine.replay_to_replicas(rep)
+        # crash-loop backoff delays only the REBUILD: triage and cross-
+        # replica replay above already ran, so no request waits out the
+        # backoff of a crash-looping replica — only the replica's own
+        # capacity restoration does
+        if backoff > 0:
+            await asyncio.sleep(backoff)
         old = rep.engine
         new_engine = await asyncio.to_thread(self._rebuild, old)
+        # stamp the replica index BEFORE the precompile re-warm: its
+        # warmup dispatches record per-replica step metrics, which must
+        # not land in replica 0's histograms (restart_replica stamps it
+        # again, harmlessly)
+        new_engine.replica_index = rep.index
         # re-warm the serving shapes the boot warmed: the rebuilt
         # runner's jitted programs are cold, and the first real request
         # must not pay a multi-second compile sweep
@@ -391,7 +448,10 @@ class EngineSupervisor:
             rep, new_engine, fail_error
         )
         self.engine._arm_replica(rep)  # noqa: SLF001
-        return replayed, failed + late_failed
+        # re-admit to placement only now, with the rebuilt engine armed:
+        # the router starts routing to it again from the next request
+        rep.serving = True
+        return moved, replayed, failed + late_failed
 
     def _rebuild(self, old: "LLMEngine") -> "LLMEngine":
         """Build the replacement engine (worker thread; slow is fine).
@@ -440,9 +500,11 @@ class EngineSupervisor:
 
         history = "\n".join(self.history_lines())
         msg = (
-            f"engine crash-loop: {len(self._attempt_times)} restarts "
-            f"within {self.window_s:.0f}s hit --max-engine-restarts="
-            f"{self.max_restarts}; giving up and exiting. Last death "
+            f"engine crash-loop: "
+            f"{max(map(len, self._attempt_times.values()), default=0)} "
+            f"restarts of one replica within {self.window_s:.0f}s hit "
+            f"--max-engine-restarts={self.max_restarts}; giving up and "
+            f"exiting. Last death "
             f"({cause}): {type(err).__name__}: {err}\n"
             f"restart history:\n{history}"
         )

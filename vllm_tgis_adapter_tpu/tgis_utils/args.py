@@ -249,8 +249,18 @@ def add_engine_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     g.add_argument("--data-parallel-size", "-dp", type=int, default=1,
                    help="in-process engine replicas, each owning a "
                         "disjoint sp*tp device slice with its own "
-                        "scheduler and KV pool; requests route to the "
-                        "least-loaded replica (total chips = dp*sp*tp)")
+                        "scheduler and KV pool; the front door's "
+                        "placement router scores replicas by prefix/"
+                        "tenant affinity and load (total chips = "
+                        "dp*sp*tp)")
+    g.add_argument("--dp-replicas", type=int, default=1,
+                   help="replica count like --data-parallel-size, but "
+                        "tolerant of hosts with fewer than N*pp*sp*tp "
+                        "devices: replicas then share the visible "
+                        "device set (CPU-proxy / dev mode; each still "
+                        "owns its own scheduler, KV pool, and step "
+                        "loop).  docs/SCALING.md; mutually exclusive "
+                        "with --data-parallel-size > 1")
 
     g = parser.add_argument_group("front door (admission control)")
     g.add_argument("--max-waiting-requests", type=int, default=0,
