@@ -36,6 +36,12 @@ Workload: 64 requests × 128 prompt tokens → 128 output tokens, greedy,
 max_num_seqs=32 (continuous batching ramps 1→32).  Warmup pass first so
 every (prefill-bucket, batch-bucket) program is compiled before timing.
 
+Adapter-churn knobs (docs/LORA.md): BENCH_LORA_ADAPTERS=N registers N
+LoRA adapters and round-robins requests over them with skewed
+popularity (hot 8 + churning tail) through a BENCH_LORA_SLOTS-resident
+paged pool (default 16); stamps swap counts, residency high-water, hit
+rate, and ITL percentiles for the perf_check `lora` gate.
+
 Env knobs: BENCH_TINY=1 (CI smoke on CPU), BENCH_REQUESTS, BENCH_PROMPT,
 BENCH_OUTPUT, BENCH_BATCH, BENCH_STEPS, BENCH_PROBE_TIMEOUT (s),
 BENCH_TPU_TIMEOUT (s, whole TPU run incl. compiles), BENCH_FORCE_CPU=1,
@@ -220,6 +226,46 @@ def build_model_dir(tiny: bool, profile: str | None = None,
     return path, arch
 
 
+def _write_bench_adapters(root: str, names: list[str], arch: dict) -> dict:
+    """PEFT-format rank-2 q/v adapters matching the bench arch, one dir
+    per name (seeded per adapter so every adapter's deltas differ)."""
+    import json as json_mod
+
+    import numpy as np
+    from safetensors.numpy import save_file
+
+    d = arch["hidden_size"]
+    dh = arch["head_dim"]
+    h = arch["num_heads"]
+    hkv = arch["num_kv_heads"]
+    rank = 2
+    paths = {}
+    for k, name in enumerate(names):
+        out = os.path.join(root, "bench-loras", name)
+        paths[name] = out
+        if os.path.exists(os.path.join(out, "adapter_config.json")):
+            continue
+        os.makedirs(out, exist_ok=True)
+        rng = np.random.default_rng(1000 + k)
+        with open(os.path.join(out, "adapter_config.json"), "w") as f:
+            json_mod.dump({
+                "peft_type": "LORA", "r": rank, "lora_alpha": rank,
+                "target_modules": ["q_proj", "v_proj"],
+            }, f)
+        tensors = {}
+        for i in range(arch["num_layers"]):
+            p = f"base_model.model.model.layers.{i}.self_attn"
+            w = lambda shape: (  # noqa: E731
+                rng.standard_normal(shape) * 0.05
+            ).astype(np.float32)
+            tensors[f"{p}.q_proj.lora_A.weight"] = w((rank, d))
+            tensors[f"{p}.q_proj.lora_B.weight"] = w((h * dh, rank))
+            tensors[f"{p}.v_proj.lora_A.weight"] = w((rank, d))
+            tensors[f"{p}.v_proj.lora_B.weight"] = w((hkv * dh, rank))
+        save_file(tensors, os.path.join(out, "adapter_model.safetensors"))
+    return paths
+
+
 def run_bench(on_tpu: bool) -> dict:
     dp = _dp_replicas()
     if dp > 1 and not on_tpu:
@@ -298,6 +344,9 @@ def run_bench(on_tpu: bool) -> dict:
     # decode is weight-read bound: batch 64 halves the HBM cost per
     # token vs 32 (weights stream once per wave regardless of rows)
     max_seqs = int(os.environ.get("BENCH_BATCH", 8 if tiny else 64))
+    # adapter-churn scenario knobs (docs/LORA.md)
+    n_lora = int(os.environ.get("BENCH_LORA_ADAPTERS", "0"))
+    n_lora_slots = int(os.environ.get("BENCH_LORA_SLOTS", "16"))
 
     # the dp fleet boots through the production from_config path, which
     # loads weights from disk — write them once, seed-0 deterministic
@@ -334,7 +383,19 @@ def run_bench(on_tpu: bool) -> dict:
             ),
         ),
         parallel_config=ParallelConfig(dp_replicas=dp),
-        lora_config=LoRAConfig(),
+        # BENCH_LORA_ADAPTERS=N: the adapter-churn scenario
+        # (docs/LORA.md) — N registered adapters round-robined with
+        # skewed popularity over a BENCH_LORA_SLOTS-resident paged pool
+        lora_config=(
+            LoRAConfig(
+                enabled=True,
+                max_loras=n_lora_slots,
+                max_lora_rank=8,
+                max_cpu_loras=max(n_lora, n_lora_slots),
+            )
+            if n_lora
+            else LoRAConfig()
+        ),
         attention_backend=data_path,
         quantization=(
             "int8"
@@ -424,6 +485,30 @@ def run_bench(on_tpu: bool) -> dict:
     for eng in engines:
         instrument(eng)
 
+    # adapter-churn scenario: deterministic skewed popularity — even
+    # request indices round-robin a HOT set of (≤8) adapters, odd ones
+    # round-robin the cold tail, so a few slots stay warm while the
+    # rest of the pool churns (the S-LoRA traffic shape)
+    lora_names = [f"bench-lora-{k:03d}" for k in range(n_lora)]
+    lora_paths = (
+        _write_bench_adapters(model_dir, lora_names, arch)
+        if n_lora
+        else {}
+    )
+    lora_requests: dict = {}
+
+    def _lora_for(i: int):
+        if not n_lora:
+            return None
+        if n_lora == 1:
+            return lora_requests.get(lora_names[0])
+        hot = lora_names[: min(8, n_lora)]
+        tail = lora_names[min(8, n_lora):] or hot
+        name = hot[(i // 2) % len(hot)] if i % 2 == 0 else (
+            tail[(i // 2) % len(tail)]
+        )
+        return lora_requests.get(name)
+
     # matmul weight elements -> decode FLOPs/token (2*N MACs) for MFU
     matmul_elems = sum(
         int(np.prod(x.shape))
@@ -450,6 +535,7 @@ def run_bench(on_tpu: bool) -> dict:
     )
 
     ttfts: list[float] = []
+    itls: list[float] = []
 
     async def one(tag: str, i: int, out_tokens: int) -> int:
         ids = rng.integers(3, mcfg.vocab_size, size=prompt_len).tolist()
@@ -461,12 +547,21 @@ def run_bench(on_tpu: bool) -> dict:
                            output_kind=RequestOutputKind.FINAL_ONLY),
             request_id=f"bench-{tag}-{i}",
             prompt_token_ids=ids,
+            lora_request=_lora_for(i),
         ):
             final = out
         m = final.metrics
+        produced_n = len(final.outputs[0].token_ids)
         if tag == "timed" and m and m.first_token_time:
             ttfts.append(m.first_token_time - m.arrival_time)
-        return len(final.outputs[0].token_ids)
+            if m.finished_time and produced_n > 1:
+                # mean inter-token latency of this request — the
+                # adapter-churn gate's "single-adapter-level ITL" number
+                itls.append(
+                    (m.finished_time - m.first_token_time)
+                    / (produced_n - 1)
+                )
+        return produced_n
 
     async def run_pass(tag: str, num: int,
                        out_tokens: int) -> tuple[int, float]:
@@ -480,6 +575,14 @@ def run_bench(on_tpu: bool) -> dict:
     router = aengine.router
 
     async def both_passes():
+        if n_lora:
+            # register the whole adapter fleet host-side (streaming to
+            # device happens on demand, overlapped with serving)
+            manager = engines[0].lora_manager
+            for name in lora_names:
+                lora_requests[name] = await manager.load_lora_adapter(
+                    name, lora_paths[name]
+                )
         # warm 2×max_seqs PER REPLICA: placement spreads the warm load
         # so every replica's compile lattice is paid before timing
         await run_pass(
@@ -530,6 +633,19 @@ def run_bench(on_tpu: bool) -> dict:
             return None
         return round(ttfts_s[min(len(ttfts_s) - 1,
                                  int(p * len(ttfts_s)))] * 1000, 1)
+
+    def _pct_ms(values: list[float], p: float) -> float | None:
+        if not values:
+            return None
+        vs = sorted(values)
+        return round(vs[min(len(vs) - 1, int(p * len(vs)))] * 1000, 3)
+
+    def _pools():
+        return [
+            e.runner.adapter_pool
+            for e in engines
+            if getattr(e.runner, "adapter_pool", None) is not None
+        ]
 
     return {
         "value": value,
@@ -597,6 +713,30 @@ def run_bench(on_tpu: bool) -> dict:
         "quantization": quantization,
         "ttft_ms_p50": pct(0.50),
         "ttft_ms_p99": pct(0.99),
+        "itl_ms_p50": _pct_ms(itls, 0.50),
+        "itl_ms_p99": _pct_ms(itls, 0.99),
+        **(
+            {
+                # adapter-churn stamps (docs/LORA.md): pool swap counts
+                # + residency prove the run actually churned; ITL above
+                # is what the perf_check lora gate ratios against the
+                # single-adapter run
+                "lora_adapters": n_lora,
+                "lora_slots": n_lora_slots,
+                "lora_swaps_in": sum(p.swaps_in for p in _pools()),
+                "lora_swaps_out": sum(p.swaps_out for p in _pools()),
+                "lora_resident_high_water": max(
+                    (p.resident_high_water for p in _pools()), default=0
+                ),
+                "lora_pool_hit_rate": round(
+                    sum(p.hits for p in _pools())
+                    / max(1, sum(p.hits + p.misses for p in _pools())),
+                    4,
+                ),
+            }
+            if n_lora
+            else {}
+        ),
         **pack_stats,
     }
 

@@ -75,11 +75,16 @@ def chaos_check(session: nox.Session) -> None:
     health, and trips the crash-loop circuit breaker.  Includes the dp
     partial-outage scenario (docs/SCALING.md): a replica dying mid-load
     replays its zero-token requests token-identically onto a healthy
-    sibling while that sibling's TTFT stays bounded.  Also runs inside
-    the tier-1 suite; this session is the fast standalone entry point."""
+    sibling while that sibling's TTFT stays bounded; and the adapter-
+    pool suite (docs/LORA.md) with its adapter-swap-during-restart
+    scenario — replayed requests carry LoRA identity onto the rebuilt
+    engine's cold pool and reproduce the uncrashed tokens.  Also runs
+    inside the tier-1 suite; this session is the fast standalone entry
+    point."""
     session.install("-e", ".[tests]")
     session.run(
-        "pytest", "tests/test_supervisor.py", "-q",
+        "pytest", "tests/test_supervisor.py", "tests/test_adapter_pool.py",
+        "-q",
         *session.posargs,
         env={"JAX_PLATFORMS": "cpu"},
     )

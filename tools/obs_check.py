@@ -46,7 +46,7 @@ DEBUG_STATE_KEYS = (
     "events",
 )
 REPLICA_KEYS = ("scheduler", "kv_cache", "in_flight", "step_counter",
-                "serving")
+                "serving", "adapter_pool")
 
 # the front-door metric surface (docs/FRONTDOOR.md) must BOTH be
 # documented in docs/OBSERVABILITY.md and appear on /metrics — adding a

@@ -108,6 +108,37 @@ def measure_dp(dp_cfg: dict, runs: int) -> dict[str, dict]:
     return results
 
 
+def measure_lora(lora_cfg: dict, runs: int) -> tuple[dict, dict]:
+    """Best-of-``runs`` adapter-churn line + single-adapter line
+    (docs/LORA.md; the churn line is the acceptance demo: 128
+    registered / 16 resident / churning tail).  Best = lowest ITL p50
+    — the gate is a latency ratio, so 'best' must mean least load
+    noise on BOTH sides."""
+    backend = lora_cfg.get("backend", "ragged")
+
+    def best_of(env: dict) -> dict:
+        best = None
+        for _ in range(runs):
+            line = run_bench(backend, dict(env))
+            itl = line.get("itl_ms_p50")
+            if itl is None:
+                raise RuntimeError("bench emitted no itl_ms_p50")
+            if best is None or itl < best["itl_ms_p50"]:
+                best = line
+        return best
+
+    churn = best_of(lora_cfg.get("env", {}))
+    single = best_of(lora_cfg.get("single_env", {}))
+    print(
+        f"perf_check: lora     churn itl_p50={churn['itl_ms_p50']}ms "
+        f"single itl_p50={single['itl_ms_p50']}ms "
+        f"resident_hw={churn.get('lora_resident_high_water')} "
+        f"swaps_in={churn.get('lora_swaps_in')} "
+        f"registered={churn.get('lora_adapters')}"
+    )
+    return churn, single
+
+
 def main(argv: list[str] | None = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     write = "--write" in argv
@@ -147,6 +178,18 @@ def main(argv: list[str] | None = None) -> int:
             print(f"perf_check: dp measurement failed: {exc}")
             return 2
 
+    lora_cfg = baseline.get("lora")
+    lora_churn: dict | None = None
+    lora_single: dict | None = None
+    if lora_cfg:
+        try:
+            lora_churn, lora_single = measure_lora(
+                lora_cfg, int(lora_cfg.get("runs", runs))
+            )
+        except Exception as exc:  # noqa: BLE001 — tool boundary
+            print(f"perf_check: lora measurement failed: {exc}")
+            return 2
+
     if write:
         out = {
             "_comment": (
@@ -172,6 +215,18 @@ def main(argv: list[str] | None = None) -> int:
                 for name, m in measured.items()
             },
         }
+        if lora_cfg:
+            # the lora section is declarative (ratio + structural
+            # demands, not measured floors) — carried through, with the
+            # tok/s floor refreshed at the documented ~70% haircut
+            out["lora"] = {
+                **lora_cfg,
+                **(
+                    {"min_tok_per_s": round(lora_churn["value"] * 0.7, 1)}
+                    if lora_churn is not None
+                    else {}
+                ),
+            }
         if dp_cfg:
             out["dp"] = {
                 **dp_cfg,
@@ -246,6 +301,40 @@ def main(argv: list[str] | None = None) -> int:
                     f"{min_ratio}x ({line['value']:.1f} vs "
                     f"{base_line['value']:.1f} tok/s)"
                 )
+
+    if lora_cfg and lora_churn is not None and lora_single is not None:
+        # ISSUE 8 acceptance: adapter-churn ITL within max_itl_ratio of
+        # the single-adapter run (same session, so load jitter cancels),
+        # the demo residency/churn shape actually achieved, and a
+        # conservative absolute tok/s floor
+        ratio = lora_churn["itl_ms_p50"] / max(
+            lora_single["itl_ms_p50"], 1e-9
+        )
+        max_ratio = float(lora_cfg.get("max_itl_ratio", 1.5))
+        if ratio > max_ratio:
+            failures.append(
+                f"lora: churn ITL p50 {lora_churn['itl_ms_p50']}ms is "
+                f"{ratio:.2f}x the single-adapter run "
+                f"({lora_single['itl_ms_p50']}ms) > allowed {max_ratio}x"
+            )
+        min_resident = int(lora_cfg.get("min_resident", 0))
+        if lora_churn.get("lora_resident_high_water", 0) < min_resident:
+            failures.append(
+                f"lora: resident high-water "
+                f"{lora_churn.get('lora_resident_high_water')} < "
+                f"required {min_resident} (pool not actually exercised)"
+            )
+        min_swaps = int(lora_cfg.get("min_swaps_in", 0))
+        if lora_churn.get("lora_swaps_in", 0) < min_swaps:
+            failures.append(
+                f"lora: swaps_in {lora_churn.get('lora_swaps_in')} < "
+                f"required {min_swaps} (no churn happened)"
+            )
+        floor = float(lora_cfg.get("min_tok_per_s", 0.0))
+        if lora_churn["value"] < floor:
+            failures.append(
+                f"lora: {lora_churn['value']:.1f} tok/s < floor {floor:.1f}"
+            )
 
     if failures:
         print("perf_check: REGRESSION")

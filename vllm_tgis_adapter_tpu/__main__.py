@@ -89,6 +89,20 @@ async def start_servers(args: "argparse.Namespace") -> None:
         from vllm_tgis_adapter_tpu.engine.config import EngineConfig
 
         engine = AsyncLLMEngine.from_config(EngineConfig.from_args(args))
+        if getattr(args, "enable_lora", False) and getattr(
+            args, "lora_modules", None
+        ):
+            # static boot registration (name=path ...): adapters are
+            # host-registered up front; device residency streams on
+            # demand through the paged pool (docs/LORA.md)
+            manager = engine.engine.lora_manager
+            for spec in args.lora_modules:
+                name, _, path = spec.partition("=")
+                if not name or not path:
+                    raise ValueError(
+                        f"--lora-modules entry {spec!r} is not name=path"
+                    )
+                await manager.load_lora_adapter(name, path)
         if getattr(args, "precompile", None):
             # warm every serving shape BEFORE the servers bind: the
             # first real request then never pays a 20-40s TPU compile

@@ -1,0 +1,420 @@
+"""Paged device-resident LoRA adapter pool (S-LoRA-class serving).
+
+The pre-pool path (``runner.sync_lora``) rebuilt the ENTIRE stacked
+adapter tensor on the host and re-transferred it to the device on every
+registry change, synchronously, in the step path — fine for 4 tenants,
+fatal for a thousand (S-LoRA, arXiv:2311.03285; InfiniLoRA's
+disaggregated variant).  This module replaces it with a paged pool:
+
+* **Fixed-shape slot stacks.**  Device weights live in the same
+  ``LoRAStacks`` layout the model already consumes (``a[target]:
+  [L, S, d_in, max_rank]`` etc., S = ``max_loras`` + base slot 0), so
+  ONE compiled program serves every adapter and a swap never retraces.
+* **Async host→device streaming.**  A cold adapter's rank-padded
+  per-layer blocks (``lora.build_adapter_blocks``) transfer and
+  scatter into their slot via one jitted ``dynamic_update_slice``
+  program — in a worker thread, overlapped with serving.  Never a
+  full-stack rebuild, never on the event loop.  The update is
+  deliberately NOT buffer-donated: a dispatch thread may have read the
+  previous stacks reference concurrently, and consuming a donated
+  (deleted) array there would poison the in-flight step; the price is
+  one device-side stack copy per swap, fully off the host critical
+  path.
+* **LRU eviction over unpinned slots.**  Every in-flight sequence
+  pins its adapter by name (registry refcounts, admission→finish), so
+  eviction can only reassign slots no live row indexes.
+* **Parking, not blocking.**  The scheduler's adapter gate
+  (``Scheduler.lora_gate``) asks ``ensure_resident``; a miss issues
+  the prefetch and the request PARKS in the waiting queue while
+  resident-adapter work proceeds around it — batch composition prefers
+  resident adapters, so churn cannot stall the step loop.
+
+One pool per runner (per dp replica); the shared ``LoRAManager`` is
+the host-RAM registry feeding every pool.  All pool state mutates on
+the event-loop thread (or single-threaded in offline engines); worker
+threads only build blocks and run device programs.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Callable, Optional
+
+import jax
+import numpy as np
+
+from vllm_tgis_adapter_tpu.compile_tracker import track_jit
+from vllm_tgis_adapter_tpu.engine.lora import (
+    LORA_TARGETS,
+    LoRAStacks,
+    _target_dims,
+    build_adapter_blocks,
+)
+from vllm_tgis_adapter_tpu.logging import init_logger
+
+logger = init_logger(__name__)
+
+
+def _update_slot(stacks: LoRAStacks, slot, a_blocks, b_blocks, scale):  # noqa: ANN001
+    """One adapter's blocks → its device slot (jitted once; ``slot`` is
+    traced so every swap reuses the same program)."""
+    a = {
+        t: stacks.a[t].at[:, slot].set(a_blocks[t]) for t in stacks.a
+    }
+    b = {
+        t: stacks.b[t].at[:, slot].set(b_blocks[t]) for t in stacks.b
+    }
+    return LoRAStacks(
+        a=a, b=b, scaling=stacks.scaling.at[slot].set(scale)
+    )
+
+
+class AdapterPool:
+    """Device residency of LoRA adapters for ONE runner."""
+
+    def __init__(
+        self,
+        model_config,  # noqa: ANN001 — engine.config.ModelConfig
+        max_loras: int,
+        max_lora_rank: int,
+        put_fn: Callable,
+        prefetch_concurrency: int = 2,
+    ):
+        self.mcfg = model_config
+        self.max_loras = max_loras
+        self.max_rank = max_lora_rank
+        self._put = put_fn
+        # host→device block builds allowed in flight at once; the final
+        # slot scatter is serialized by _stream_lock regardless
+        self.prefetch_concurrency = max(1, prefetch_concurrency)
+        # the registry feeding this pool; set by the owning engine and
+        # re-pointed by adopt_lora_manager on dp sharing / rebuild
+        self.manager = None
+        # runner hook: called with the new stacks object after every
+        # committed slot update (runner.lora_stacks stays current)
+        self.on_commit: Optional[Callable] = None
+        # name -> slot for RESIDENT adapters (committed streams only)
+        self._slots: dict[str, int] = {}
+        self._free: list[int] = list(range(max_loras, 0, -1))
+        # name -> last-touch monotonic over resident adapters (LRU)
+        self._lru: dict[str, float] = {}
+        # names with a stream in flight (slot allocated, not committed)
+        self._streaming: dict[str, object] = {}
+        # names invalidated (host-evicted) while streaming: their commit
+        # must drop the slot instead of publishing it
+        self._invalidated: set[str] = set()
+        self._stream_lock = asyncio.Lock()
+        self._sema: Optional[asyncio.Semaphore] = None
+        self._closed = False
+        # admission-time lookup accounting (lora_pool_hit_rate)
+        self.hits = 0
+        self.misses = 0
+        self.swaps_in = 0
+        self.swaps_out = 0
+        self.resident_high_water = 0
+        self.stacks = self._zero_stacks()
+        self._update_fn = track_jit(
+            "lora_slot_update",
+            jax.jit(_update_slot),
+            label=lambda args, kwargs: "slot",
+        )
+
+    # ------------------------------------------------------------ stacks
+
+    def _zero_stacks(self) -> LoRAStacks:
+        s_count = self.max_loras + 1
+        layers = self.mcfg.num_layers
+        a = {}
+        b = {}
+        for target in LORA_TARGETS:
+            din, dout = _target_dims(self.mcfg, target)
+            a[target] = self._put(
+                np.zeros((layers, s_count, din, self.max_rank), np.float32)
+            )
+            b[target] = self._put(
+                np.zeros((layers, s_count, self.max_rank, dout), np.float32)
+            )
+        return LoRAStacks(
+            a=a, b=b, scaling=self._put(np.zeros(s_count, np.float32))
+        )
+
+    def release(self) -> None:
+        """Drop the device stacks (supervisor rebuild: the replacement
+        engine's pool allocates its own, and two cannot coexist in a
+        tight HBM budget).  In-flight streams commit into nothing."""
+        self._closed = True
+        self.stacks = None
+        self._slots.clear()
+        self._lru.clear()
+
+    def close(self) -> None:
+        """Terminal shutdown: stop accepting prefetches and cancel any
+        in-flight stream tasks (engine.stop())."""
+        self._closed = True
+        for task in list(self._streaming.values()):
+            cancel = getattr(task, "cancel", None)
+            if cancel is not None:
+                cancel()
+
+    # --------------------------------------------------------- residency
+
+    def resident(self, lora_name: Optional[str]) -> bool:
+        """True when the adapter's weights are live in a device slot
+        (the placement router's per-replica residency probe)."""
+        return bool(lora_name) and lora_name in self._slots
+
+    @property
+    def num_resident(self) -> int:
+        return len(self._slots)
+
+    def note_lookup(self, lora_name: str, replica: int = 0) -> None:
+        """Admission-time hit/miss accounting — counted ONCE per
+        request (the schedule-time gate retries every step and would
+        inflate both sides).  The gauge carries the replica label: at
+        dp>1 each pool's local ratio is its own series, not a
+        last-writer-wins scribble over a global."""
+        if lora_name in self._slots:
+            self.hits += 1
+        else:
+            self.misses += 1
+        try:
+            from vllm_tgis_adapter_tpu import metrics
+
+            total = self.hits + self.misses
+            if total:
+                metrics.lora_pool_hit_rate.labels(
+                    replica=str(replica)
+                ).set(self.hits / total)
+        except Exception:  # pragma: no cover — telemetry must not raise
+            pass
+
+    def ensure_resident(self, lora_name: str) -> Optional[int]:
+        """The scheduler gate: the adapter's slot when resident (LRU
+        touched), else None with a prefetch issued — the request parks
+        and the stream overlaps serving.
+
+        An adapter unknown to the registry resolves to slot 0 (base
+        weights) — the legacy ``slot_of`` contract for unloaded names,
+        so a racing host-evict degrades exactly like the old path
+        instead of wedging the request."""
+        slot = self._slots.get(lora_name)
+        if slot is not None:
+            self._lru[lora_name] = time.monotonic()
+            return slot
+        if self.manager is None or self.manager.get_weights(lora_name) is None:
+            # debug, not warning: the gate retries this every schedule
+            # attempt and the condition is the documented legacy
+            # behavior, not a fault
+            logger.debug(
+                "request references unregistered adapter %r; serving "
+                "base weights (legacy slot-0 semantics)", lora_name,
+            )
+            return 0
+        if self.prefetch(lora_name):
+            # offline/sync engines stream inline — the adapter may be
+            # resident the moment prefetch returns
+            slot = self._slots.get(lora_name)
+            if slot is not None:
+                self._lru[lora_name] = time.monotonic()
+                return slot
+        return None
+
+    # --------------------------------------------------------- streaming
+
+    def prefetch(self, lora_name: str) -> bool:
+        """Begin (or observe) host→device streaming for one adapter.
+        Returns True when already resident.  Idempotent; safe to call
+        every schedule attempt."""
+        if self._closed:
+            return False
+        if lora_name in self._slots:
+            return True
+        if lora_name in self._streaming:
+            return False
+        weights = (
+            self.manager.get_weights(lora_name)
+            if self.manager is not None
+            else None
+        )
+        if weights is None:
+            return False
+        slot = self._allocate_slot()
+        if slot is None:
+            # every slot is pinned by live rows: the request stays
+            # parked; the gate re-prefetches once a pin releases
+            return False
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            loop = None
+        if loop is None:
+            # offline/sync engine (tests, batch runs): stream inline —
+            # there is no event loop to protect.  Same failure contract
+            # as the async path: a failed stream returns its slot and
+            # the request stays parked, never crashes the schedule.
+            try:
+                self._stream_blocking(lora_name, weights, slot)
+            except Exception:
+                logger.exception(
+                    "adapter stream for %r failed; slot %d returned to "
+                    "the pool", lora_name, slot,
+                )
+                if lora_name not in self._slots:
+                    self._free.append(slot)
+                return False
+            return True
+        self._streaming[lora_name] = loop.create_task(
+            self._stream(lora_name, weights, slot),
+            name=f"lora-stream-{lora_name}",
+        )
+        return False
+
+    def _allocate_slot(self) -> Optional[int]:
+        if self._free:
+            return self._free.pop()
+        # LRU eviction over UNPINNED residents only: a pinned adapter's
+        # slot index is live in scheduled rows and must never change
+        victim = None
+        for name in sorted(self._lru, key=self._lru.get):
+            if self.manager is not None and self.manager.pinned(name):
+                continue
+            victim = name
+            break
+        if victim is None:
+            return None
+        slot = self._slots.pop(victim)
+        self._lru.pop(victim, None)
+        self.swaps_out += 1
+        self._count_swap("out")
+        logger.info("adapter pool: evicting %s from slot %d", victim, slot)
+        return slot
+
+    def invalidate(self, lora_name: str) -> None:
+        """The host registry dropped this adapter: free its slot (no
+        live pins exist by the registry's eviction contract)."""
+        if lora_name in self._streaming:
+            self._invalidated.add(lora_name)
+        slot = self._slots.pop(lora_name, None)
+        self._lru.pop(lora_name, None)
+        if slot is not None:
+            self._free.append(slot)
+            self.swaps_out += 1
+            self._count_swap("out")
+
+    def _build_device_blocks(self, weights):  # noqa: ANN001
+        """Worker-thread half: host block assembly + device transfer of
+        ONE adapter (the only per-swap host→device traffic)."""
+        a_blocks, b_blocks = build_adapter_blocks(
+            self.mcfg, self.max_rank, weights
+        )
+        return (
+            {t: self._put(v) for t, v in a_blocks.items()},
+            {t: self._put(v) for t, v in b_blocks.items()},
+        )
+
+    def _apply(self, slot: int, a_dev, b_dev, scaling: float):  # noqa: ANN001
+        """Worker-thread half: scatter one adapter's device blocks into
+        its slot.  One compiled program for every (adapter, slot)."""
+        return self._update_fn(
+            self.stacks,
+            np.int32(slot),
+            a_dev,
+            b_dev,
+            np.float32(scaling),
+        )
+
+    def _commit(self, lora_name: str, slot: int, new_stacks) -> None:  # noqa: ANN001
+        if self._closed or lora_name in self._invalidated:
+            self._invalidated.discard(lora_name)
+            if not self._closed:
+                self._free.append(slot)
+            return
+        self.stacks = new_stacks
+        if self.on_commit is not None:
+            self.on_commit(new_stacks)
+        self._slots[lora_name] = slot
+        self._lru[lora_name] = time.monotonic()
+        self.swaps_in += 1
+        self.resident_high_water = max(
+            self.resident_high_water, len(self._slots)
+        )
+        self._count_swap("in")
+
+    def _stream_blocking(self, lora_name: str, weights, slot: int) -> None:  # noqa: ANN001
+        t0 = time.monotonic()
+        a_dev, b_dev = self._build_device_blocks(weights)
+        new_stacks = self._apply(slot, a_dev, b_dev, weights.scaling)
+        self._commit(lora_name, slot, new_stacks)
+        self._observe_prefetch(time.monotonic() - t0)
+
+    async def _stream(self, lora_name: str, weights, slot: int) -> None:  # noqa: ANN001
+        t0 = time.monotonic()
+        try:
+            if self._sema is None:
+                self._sema = asyncio.Semaphore(self.prefetch_concurrency)
+            async with self._sema:
+                a_dev, b_dev = await asyncio.to_thread(
+                    self._build_device_blocks, weights
+                )
+            # the scatter reads self.stacks: serialize against sibling
+            # streams so no update is built on a stale base and lost
+            async with self._stream_lock:
+                new_stacks = await asyncio.to_thread(
+                    self._apply, slot, a_dev, b_dev, weights.scaling
+                )
+                self._commit(lora_name, slot, new_stacks)
+            self._observe_prefetch(time.monotonic() - t0)
+        except Exception:
+            logger.exception(
+                "adapter stream for %r failed; slot %d returned to the "
+                "pool", lora_name, slot,
+            )
+            if not self._closed and lora_name not in self._slots:
+                self._free.append(slot)
+        finally:
+            self._streaming.pop(lora_name, None)
+            self._invalidated.discard(lora_name)
+
+    # ------------------------------------------------------------ metrics
+
+    @staticmethod
+    def _count_swap(direction: str) -> None:
+        try:
+            from vllm_tgis_adapter_tpu import metrics
+
+            metrics.lora_swap_total.labels(direction=direction).inc()
+        except Exception:  # pragma: no cover — telemetry must not raise
+            pass
+
+    @staticmethod
+    def _observe_prefetch(seconds: float) -> None:
+        try:
+            from vllm_tgis_adapter_tpu import metrics
+
+            metrics.lora_prefetch_seconds.observe(seconds)
+        except Exception:  # pragma: no cover — telemetry must not raise
+            pass
+
+    def debug_state(self) -> dict:
+        """``adapter_pool`` section of the per-replica /debug/state."""
+        total = self.hits + self.misses
+        return {
+            "max_loras": self.max_loras,
+            "registered": (
+                len(self.manager.lora_requests)
+                if self.manager is not None
+                else 0
+            ),
+            "resident": sorted(
+                self._slots, key=self._slots.get
+            ),
+            "streaming": sorted(self._streaming),
+            "free_slots": len(self._free),
+            "hits": self.hits,
+            "misses": self.misses,
+            "hit_rate": round(self.hits / total, 4) if total else 0.0,
+            "swaps_in": self.swaps_in,
+            "swaps_out": self.swaps_out,
+            "resident_high_water": self.resident_high_water,
+        }

@@ -316,10 +316,17 @@ class AsyncLLMEngine:
                 prefix_tokens = scheduler.allocator.peek_prefix(
                     prompt_token_ids, lora_name
                 )
+            # TRUE adapter-pool residency, not just remembered
+            # stickiness: landing on a replica whose pool already holds
+            # the adapter skips the host→device stream entirely
+            pool = getattr(rep.engine.runner, "adapter_pool", None)
             snapshots.append(ReplicaSnapshot(
                 index=rep.index,
                 load=scheduler.num_unfinished,
                 prefix_tokens=prefix_tokens,
+                adapter_resident=(
+                    pool is not None and pool.resident(lora_name)
+                ),
             ))
         index, _policy = self.router.place(
             snapshots,
@@ -427,15 +434,16 @@ class AsyncLLMEngine:
                     ),
                 )
             )
-        # one adapter registry fleet-wide: a hot-load registers once and
-        # every replica's runner builds its stacks from the same slots;
-        # pin/unpin refcounts sum across replicas so no replica can evict
-        # an adapter another replica's running row still indexes.  Safe
+        # one adapter registry fleet-wide: a hot-load registers once
+        # (host RAM) and every replica's POOL streams its own device
+        # residency from the shared weights on demand; pin/unpin
+        # refcounts sum across replicas so no replica can evict an
+        # adapter another replica's running row still indexes.  Safe
         # unsynchronized: all mutations happen in host phases on the one
         # event-loop thread.
         shared = engines[0].lora_manager
         for e in engines[1:]:
-            e.lora_manager = shared
+            e.adopt_lora_manager(shared)
         return cls(engines)
 
     STATS_INTERVAL_S = 10.0
@@ -486,6 +494,11 @@ class AsyncLLMEngine:
             self._stats_task = None
         for rep in self._replicas:
             rep.new_work.set()
+            # terminal shutdown: in-flight adapter streams must not
+            # outlive the loop (pending-task noise, pinned device stacks)
+            pool = getattr(rep.engine.runner, "adapter_pool", None)
+            if pool is not None:
+                pool.close()
             if rep.task is not None:
                 rep.task.cancel()
                 try:
@@ -927,6 +940,17 @@ class AsyncLLMEngine:
                 kv_total=num_blocks,
                 prefix_hits=sum(a.prefix_hits for a in allocators),
             )
+            for rep in self._replicas:
+                pool = getattr(rep.engine.runner, "adapter_pool", None)
+                if pool is not None:
+                    metrics.lora_adapters_resident.labels(
+                        replica=str(rep.index)
+                    ).set(pool.num_resident)
+            manager = getattr(self.engine, "lora_manager", None)
+            if manager is not None:
+                metrics.lora_adapters_registered.set(
+                    len(manager.lora_requests)
+                )
         except Exception:  # pragma: no cover — metrics are best-effort
             logger.debug("engine gauge refresh failed", exc_info=True)
         return used, num_blocks
@@ -1127,6 +1151,13 @@ class AsyncLLMEngine:
                             in_flight = chained
                             continue
                         await commit_in_flight()
+                    elif engine.has_unfinished_requests():
+                        # nothing plannable right now — e.g. every
+                        # waiting row parked on an adapter stream, or a
+                        # blocked swapped head.  Yield briefly instead
+                        # of spinning the host phase at full rate while
+                        # the background transfer completes.
+                        await asyncio.sleep(0.001)
                     continue
                 handle = await asyncio.to_thread(
                     engine.dispatch_step, plan, prepared
@@ -1384,8 +1415,11 @@ class AsyncLLMEngine:
             old = rep.engine
             # the adapter registry survives the restart (hot-loaded
             # LoRAs stay served); pins held by the dead engine's
-            # sequences are released — replayed ones re-pin on re-add
-            new_engine.lora_manager = old.lora_manager
+            # sequences are released — replayed ones re-pin on re-add,
+            # and each re-add prefetches into the rebuilt engine's
+            # (cold) pool, so exactly the adapters live requests
+            # reference re-stream
+            new_engine.adopt_lora_manager(old.lora_manager)
             replays = []
             for seq in list(old._seqs.values()):  # noqa: SLF001
                 old.lora_manager.unpin(seq.lora_name)

@@ -776,8 +776,25 @@ class ParallelConfig:
 @dataclasses.dataclass(frozen=True)
 class LoRAConfig:
     enabled: bool = False
+    # device slots concurrently resident per replica pool (slot 0 =
+    # base model is extra); also the legacy registry capacity when the
+    # pool is disabled
     max_loras: int = 4
     max_lora_rank: int = 64
+    # paged adapter pool (engine/adapter_pool.py): host registry up to
+    # max_cpu_loras adapters, device residency streamed on demand.
+    # False = pre-pool behavior (sync_lora full-stack rebuild slow path)
+    pool: bool = True
+    # host-RAM registry capacity in pool mode (>= max_loras); 0 =
+    # auto (max(64, 4 * max_loras))
+    max_cpu_loras: int = 0
+    # concurrent host→device adapter streams per pool
+    prefetch_concurrency: int = 2
+
+    def resolved_max_cpu_loras(self) -> int:
+        if self.max_cpu_loras > 0:
+            return max(self.max_cpu_loras, self.max_loras)
+        return max(64, 4 * self.max_loras)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -1156,6 +1173,11 @@ class EngineConfig:
                 enabled=args.enable_lora,
                 max_loras=args.max_loras,
                 max_lora_rank=args.max_lora_rank,
+                pool=getattr(args, "lora_pool", True),
+                max_cpu_loras=getattr(args, "max_cpu_loras", 0) or 0,
+                prefetch_concurrency=getattr(
+                    args, "lora_prefetch_concurrency", 2
+                ),
             ),
             speculative=SpeculativeConfig.from_args(args, model_config),
             tokenizer=args.tokenizer,

@@ -214,14 +214,32 @@ class LLMEngine:
         self._devices = None
         self._lora_tokenizers: dict[str, object] = {}
         # adapter registry consumed by the gRPC adapter store
-        # (grpc/adapters.py) and by the runner's stacked device tensors
+        # (grpc/adapters.py) and by the runner's device residency —
+        # the paged pool (engine/adapter_pool.py) when the runner built
+        # one, else the legacy sync_lora stacked tensors
         from vllm_tgis_adapter_tpu.engine.lora import LoRAManager
 
+        pool = getattr(self.runner, "adapter_pool", None)
         self.lora_manager = LoRAManager(
             config.lora_config.max_loras,
             config.lora_config.max_lora_rank,
             moe_model=config.model_config.num_experts > 0,
+            max_cpu_loras=(
+                config.lora_config.resolved_max_cpu_loras()
+                if pool is not None
+                else 0
+            ),
         )
+        if pool is not None:
+            pool.manager = self.lora_manager
+            self.lora_manager.attach_pool(pool)
+            # adapter-affinity scheduling: rows whose adapter is still
+            # streaming PARK instead of blocking the batch
+            self.scheduler.lora_gate = self._lora_gate
+        elif config.lora_config.enabled:
+            # legacy slow path: registry changes rebuild the stacks OFF
+            # the event loop at load time (satellite of the pool work)
+            self.lora_manager.add_resync(self)
 
     # ------------------------------------------------------------- lifecycle
 
@@ -435,7 +453,19 @@ class LLMEngine:
                 else min(deadline, ttl_deadline)
             )
         seq.deadline = deadline
-        seq.lora_slot = self.lora_manager.slot_of(lora_name)
+        pool = getattr(self.runner, "adapter_pool", None)
+        if pool is None:
+            seq.lora_slot = self.lora_manager.slot_of(lora_name)
+        else:
+            # pool mode: the slot is resolved at SCHEDULE time by the
+            # adapter gate once the weights are device-resident; issue
+            # the prefetch NOW so the host→device stream overlaps the
+            # queue wait (and a supervised rebuild re-streams exactly
+            # the adapters its replayed requests reference)
+            seq.lora_slot = 0
+            if lora_name is not None:
+                pool.note_lookup(lora_name, replica=self.replica_index)
+                pool.prefetch(lora_name)
         if self.runner.spec is not None:
             from vllm_tgis_adapter_tpu.engine.speculative import (
                 spec_eligible,
@@ -493,6 +523,33 @@ class LLMEngine:
             self.scheduler.num_unfinished > 0
             or bool(self.scheduler.newly_finished)
         )
+
+    # ---------------------------------------------------------------- LoRA
+
+    def _lora_gate(self, seq: Sequence) -> bool:
+        """Scheduler adapter gate (pool mode): True when ``seq``'s
+        adapter is device-resident (slot resolved onto the sequence);
+        False parks the request while the pool streams it in."""
+        name = seq.lora_name
+        if name is None:
+            return True
+        slot = self.runner.adapter_pool.ensure_resident(name)
+        if slot is None:
+            return False
+        seq.lora_slot = slot
+        return True
+
+    def adopt_lora_manager(self, manager) -> None:  # noqa: ANN001
+        """Point this engine at a shared/survivor adapter registry (dp
+        fleet construction, supervised rebuild) and re-attach the
+        runner's pool (or legacy resync hook) to it."""
+        self.lora_manager = manager
+        pool = getattr(self.runner, "adapter_pool", None)
+        if pool is not None:
+            pool.manager = manager
+            manager.attach_pool(pool)
+        elif self.config.lora_config.enabled:
+            manager.add_resync(self)
 
     # -------------------------------------------------------------- KV swap
 

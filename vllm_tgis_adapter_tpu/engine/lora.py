@@ -65,8 +65,15 @@ def load_peft_adapter(path: str) -> LoRAAdapterWeights:
     config_file = adapter_dir / "adapter_config.json"
     if not config_file.exists():
         raise LoRAError(f"no adapter_config.json in {path!r}")
-    with open(config_file) as f:
-        config = json.load(f)
+    try:
+        with open(config_file) as f:
+            config = json.load(f)
+    except (json.JSONDecodeError, UnicodeDecodeError) as e:
+        # a corrupt checkpoint is CLIENT input: it must classify as the
+        # typed 4xx like every other parse failure, not a generic 500
+        raise LoRAError(
+            f"invalid adapter_config.json in {path!r}: {e}"
+        ) from e
     peft_type = config.get("peft_type")
     if peft_type != "LORA":
         raise LoRAError(f"unsupported peft type {peft_type!r}")
@@ -74,6 +81,16 @@ def load_peft_adapter(path: str) -> LoRAAdapterWeights:
     rank = int(config.get("r", 8))
     alpha = float(config.get("lora_alpha", rank))
     target_modules = tuple(config.get("target_modules", ()))
+    unknown = sorted({
+        t for t in target_modules
+        if t.rsplit(".", 1)[-1] not in LORA_TARGETS
+    })
+    if unknown:
+        raise LoRAError(
+            f"adapter targets unknown modules {unknown}; this server "
+            f"supports LoRA on {sorted(LORA_TARGETS)} only — retrain the "
+            "adapter against those projections"
+        )
 
     weights_file = adapter_dir / "adapter_model.safetensors"
     a: dict[str, np.ndarray] = {}
@@ -81,7 +98,13 @@ def load_peft_adapter(path: str) -> LoRAAdapterWeights:
     if weights_file.exists():
         from safetensors.numpy import load_file
 
-        for key, value in load_file(str(weights_file)).items():
+        try:
+            tensors = load_file(str(weights_file))
+        except Exception as e:  # noqa: BLE001 — safetensors parse boundary
+            raise LoRAError(
+                f"invalid adapter_model.safetensors in {path!r}: {e}"
+            ) from e
+        for key, value in tensors.items():
             # PEFT keys look like:
             # base_model.model.model.layers.0.self_attn.q_proj.lora_A.weight
             if "lora_A" in key:
@@ -119,15 +142,33 @@ class LoRAManager:
     """Registry of hot-loaded adapters, shaped like the serving-models
     handler the reference adapter store talks to.
 
-    Each adapter owns a device slot 1..max_loras (slot 0 = "no adapter",
-    identically zero); ``version`` bumps on every load/evict so the model
-    runner knows when to rebuild its stacked device tensors.
+    Two residency models share this one registry surface:
+
+    * **legacy mode** (``max_cpu_loras == 0``, the pre-pool behavior):
+      each adapter owns a device slot 1..max_loras (slot 0 = "no
+      adapter", identically zero); ``version`` bumps on every
+      load/evict so the model runner rebuilds its stacked device
+      tensors (``runner.sync_lora`` slow path).
+    * **pool mode** (``max_cpu_loras > 0``): the registry holds up to
+      ``max_cpu_loras`` adapters in HOST RAM; device residency is owned
+      by the per-replica ``engine/adapter_pool.AdapterPool``s attached
+      via :meth:`attach_pool`, which stream cold adapters host→device
+      on demand and assign slots themselves (``slot_of`` is
+      meaningless here and returns 0).
+
+    Pin counts are by NAME in both modes: one ref per in-flight
+    sequence, held from admission to finish, so neither the host
+    registry nor any device pool can evict weights a live request still
+    references.
     """
 
     def __init__(self, max_loras: int = 4, max_lora_rank: int = 64,
-                 moe_model: bool = False):
+                 moe_model: bool = False, max_cpu_loras: int = 0):
         self.max_loras = max_loras
         self.max_lora_rank = max_lora_rank
+        # > 0 switches the registry to pool mode: host capacity for
+        # registered adapters, device residency delegated to pools
+        self.max_cpu_loras = max_cpu_loras
         # MoE models have no dense MLP for the gate/up/down deltas to
         # attach to — adapters targeting them are rejected at load time
         # instead of having those deltas silently dropped
@@ -142,6 +183,36 @@ class LoRAManager:
         self._free_slots = list(range(max_loras, 0, -1))
         self._next_id = 1
         self.version = 0
+        # device pools fed by this registry (pool mode): weak so a
+        # supervised rebuild's dead runner (and its pool) can be
+        # collected without an explicit detach
+        import weakref
+
+        self._pools: "weakref.WeakSet" = weakref.WeakSet()
+        # legacy-mode resync hooks (one per engine replica): after a
+        # registry change the stacked device tensors rebuild OFF the
+        # event loop here, so the step path's sync_lora version check
+        # is already satisfied and never pays the transfer inline
+        self._resync_cbs: "weakref.WeakSet" = weakref.WeakSet()
+
+    @property
+    def pool_mode(self) -> bool:
+        return self.max_cpu_loras > 0
+
+    @property
+    def host_capacity(self) -> int:
+        return self.max_cpu_loras if self.pool_mode else self.max_loras
+
+    def attach_pool(self, pool) -> None:  # noqa: ANN001 — AdapterPool (cycle)
+        self._pools.add(pool)
+
+    def add_resync(self, engine) -> None:  # noqa: ANN001 — LLMEngine (cycle)
+        """Register a legacy-mode engine whose runner stacks should
+        rebuild off-loop after every registry change."""
+        self._resync_cbs.add(engine)
+
+    def pinned(self, lora_name: str) -> bool:
+        return bool(self._refs.get(lora_name))
 
     async def load_lora_adapter(self, lora_name: str, lora_path: str) -> LoRARequest:
         """Load (or return the cached) adapter; raises LoRAError on bad input."""
@@ -170,36 +241,97 @@ class LoRAManager:
                 f"adapter rank {weights.rank} exceeds --max-lora-rank "
                 f"{self.max_lora_rank}"
             )
-        if not self._free_slots:
+        if len(self.lora_requests) >= self.host_capacity:
             evict = next(
                 (n for n in self.lora_requests if not self._refs.get(n)),
                 None,
             )
             if evict is None:
                 raise LoRAError(
-                    f"all {self.max_loras} adapter slots are pinned by "
-                    "running requests; retry when they finish"
+                    f"all {self.host_capacity} registered adapters are "
+                    "pinned by running requests; retry when they finish"
                 )
-            logger.info("evicting LoRA adapter %s", evict)
-            self.lora_requests.pop(evict, None)
-            self._weights.pop(evict, None)
-            self._refs.pop(evict, None)
-            self._free_slots.append(self._slots.pop(evict))
+            self._evict_host(evict)
         request = LoRARequest(
             lora_name=lora_name, lora_int_id=self._next_id, lora_path=lora_path
         )
         self._next_id += 1
         self.lora_requests[lora_name] = request
         self._weights[lora_name] = weights
-        self._slots[lora_name] = self._free_slots.pop()
+        if not self.pool_mode:
+            self._slots[lora_name] = self._free_slots.pop()
         self.version += 1
+        self._report_registered()
+        # legacy engines rebuild their stacks NOW, off the event loop,
+        # so the next plan_step's sync_lora sees a matching version and
+        # never pays the device transfer in the step path
+        await self._resync_engines()
         return request
+
+    async def _resync_engines(self) -> None:
+        import asyncio
+
+        for engine in list(self._resync_cbs):
+            await asyncio.to_thread(engine.runner.sync_lora, self)
+
+    def unload_lora_adapter(self, lora_name: str) -> None:
+        """Administratively drop one registered adapter.
+
+        Raises LoRAError when the name is unknown or the adapter is
+        pinned by in-flight requests (unloading under a live row would
+        serve it the replacement's weights)."""
+        if lora_name not in self.lora_requests:
+            raise LoRAError(f"adapter {lora_name!r} is not loaded")
+        if self._refs.get(lora_name):
+            raise LoRAError(
+                f"adapter {lora_name!r} is pinned by "
+                f"{self._refs[lora_name]} running request(s); retry when "
+                "they finish"
+            )
+        self._evict_host(lora_name)
+        self.version += 1
+        self._report_registered()
+        # legacy-mode stacks rebuild off-loop here too (same contract
+        # as load); plan_step's version-checked call stays the backstop
+        # for the scheduling race and for offline engines
+        import asyncio
+
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            for engine in list(self._resync_cbs):
+                engine.runner.sync_lora(self)
+        else:
+            loop.create_task(self._resync_engines())
+
+    def _evict_host(self, name: str) -> None:
+        """Drop one (unpinned) host registry entry and invalidate any
+        device-pool residency it had."""
+        logger.info("evicting LoRA adapter %s", name)
+        self.lora_requests.pop(name, None)
+        self._weights.pop(name, None)
+        self._refs.pop(name, None)
+        slot = self._slots.pop(name, None)
+        if slot is not None:
+            self._free_slots.append(slot)
+        for pool in list(self._pools):
+            pool.invalidate(name)
+
+    def _report_registered(self) -> None:
+        try:
+            from vllm_tgis_adapter_tpu import metrics
+
+            metrics.lora_adapters_registered.set(len(self.lora_requests))
+        except Exception:  # pragma: no cover — telemetry must not raise
+            pass
 
     def get_weights(self, lora_name: str) -> Optional[LoRAAdapterWeights]:
         return self._weights.get(lora_name)
 
     def slot_of(self, lora_name: Optional[str]) -> int:
-        """Device slot for a loaded adapter name (0 = no adapter)."""
+        """Device slot for a loaded adapter name (0 = no adapter).
+        Legacy mode only — pool-mode slots live in the per-replica
+        AdapterPool and are resolved at schedule time."""
         if lora_name is None:
             return 0
         return self._slots.get(lora_name, 0)
@@ -272,6 +404,41 @@ class LoRAStacks:
     scaling: object  # [S] f32
 
 
+def build_adapter_blocks(
+    mcfg, max_rank: int, weights: LoRAAdapterWeights
+) -> tuple[dict[str, np.ndarray], dict[str, np.ndarray]]:
+    """ONE adapter's rank-padded per-layer blocks — the host-side unit
+    the adapter pool streams into a device slot
+    (``a[target]: [L, d_in, max_rank]``, ``b[target]: [L, max_rank,
+    d_out]``).  ``build_lora_stacks`` composes these per slot."""
+    layers = mcfg.num_layers
+    a = {}
+    b = {}
+    for target in LORA_TARGETS:
+        din, dout = _target_dims(mcfg, target)
+        a[target] = np.zeros((layers, din, max_rank), np.float32)
+        b[target] = np.zeros((layers, max_rank, dout), np.float32)
+    r = min(weights.rank, max_rank)
+    if weights.rank > max_rank:
+        logger.warning(
+            "adapter rank %d exceeds --max-lora-rank %d; truncating",
+            weights.rank, max_rank,
+        )
+    for key, mat in weights.a.items():
+        # key = "layers.N.<target>"; PEFT lora_A is [r, d_in]
+        _, layer_s, target = key.split(".")
+        if target not in a or not layer_s.isdigit():
+            continue
+        a[target][int(layer_s), :, :r] = mat.T[:, :r]
+    for key, mat in weights.b.items():
+        # PEFT lora_B is [d_out, r]
+        _, layer_s, target = key.split(".")
+        if target not in b or not layer_s.isdigit():
+            continue
+        b[target][int(layer_s), :r, :] = mat.T[:r, :]
+    return a, b
+
+
 def build_lora_stacks(mcfg, max_loras: int, max_rank: int,
                       manager: LoRAManager) -> LoRAStacks:
     """Host-side assembly of the padded stacks from loaded adapters."""
@@ -285,25 +452,9 @@ def build_lora_stacks(mcfg, max_loras: int, max_rank: int,
         a[target] = np.zeros((layers, s_count, din, max_rank), np.float32)
         b[target] = np.zeros((layers, s_count, max_rank, dout), np.float32)
     for slot, weights in manager.loaded():
-        r = min(weights.rank, max_rank)
-        if weights.rank > max_rank:
-            logger.warning(
-                "adapter rank %d exceeds --max-lora-rank %d; truncating",
-                weights.rank, max_rank,
-            )
         scaling[slot] = weights.scaling
-        for key, mat in weights.a.items():
-            # key = "layers.N.<target>"; PEFT lora_A is [r, d_in]
-            _, layer_s, target = key.split(".")
-            if target not in a or not layer_s.isdigit():
-                continue
-            layer = int(layer_s)
-            a[target][layer, slot, :, :r] = mat.T[:, :r]
-        for key, mat in weights.b.items():
-            # PEFT lora_B is [d_out, r]
-            _, layer_s, target = key.split(".")
-            if target not in b or not layer_s.isdigit():
-                continue
-            layer = int(layer_s)
-            b[target][layer, slot, :r, :] = mat.T[:r, :]
+        blocks_a, blocks_b = build_adapter_blocks(mcfg, max_rank, weights)
+        for target in LORA_TARGETS:
+            a[target][:, slot] = blocks_a[target]
+            b[target][:, slot] = blocks_b[target]
     return LoRAStacks(a=a, b=b, scaling=scaling)

@@ -352,6 +352,29 @@ class ModelRunner:
         self._rng = np.random.default_rng(config.seed)
         self.lora_stacks = None
         self._lora_version = 0  # manager starts at 0 = nothing loaded
+        # paged adapter pool (engine/adapter_pool.py): device residency
+        # and async host→device streaming replace the sync_lora
+        # full-stack rebuild.  Stacks exist (zeroed) from boot, so the
+        # serving programs compile WITH lora args once and adapter
+        # swaps never add a compile shape.
+        self.adapter_pool = None
+        lcfg = config.lora_config
+        if lcfg.enabled and lcfg.pool:
+            from vllm_tgis_adapter_tpu.engine.adapter_pool import (
+                AdapterPool,
+            )
+
+            self.adapter_pool = AdapterPool(
+                mcfg,
+                lcfg.max_loras,
+                lcfg.max_lora_rank,
+                self._put,
+                prefetch_concurrency=lcfg.prefetch_concurrency,
+            )
+            self.lora_stacks = self.adapter_pool.stacks
+            self.adapter_pool.on_commit = (
+                lambda stacks: setattr(self, "lora_stacks", stacks)
+            )
 
         # chunked prefill: non-first chunks attend to prior context through
         # the paged cache (models/llama.py prefill_chunk)
@@ -409,9 +432,20 @@ class ModelRunner:
         )
 
     def sync_lora(self, manager) -> None:
-        """Rebuild the stacked adapter tensors when the registry changed
-        (hot load/evict).  One compiled program serves every adapter —
-        slots and padded ranks keep shapes constant across reloads."""
+        """Legacy slow path: rebuild the stacked adapter tensors when
+        the registry changed (hot load/evict).  One compiled program
+        serves every adapter — slots and padded ranks keep shapes
+        constant across reloads.
+
+        With the paged pool (--lora-pool, the default) this is a no-op:
+        the pool streams per-slot updates asynchronously instead.  On
+        the legacy path the rebuild runs from the registry's off-loop
+        resync hook at LOAD time (lora.LoRAManager.load_lora_adapter),
+        so the plan_step call sees a matching version and this is free
+        in the step path; it remains as the correctness backstop for
+        offline engines driving plan_step directly."""
+        if getattr(self, "adapter_pool", None) is not None:
+            return
         if manager is None or manager.version == self._lora_version:
             return
         from vllm_tgis_adapter_tpu.engine.lora import build_lora_stacks

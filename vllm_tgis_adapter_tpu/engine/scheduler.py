@@ -189,6 +189,14 @@ class Scheduler:
         # step with scheduler-side sheds
         self._saw_deadlines = False
         self.shed_hook = None
+        # adapter-residency gate (engine/adapter_pool.py, set by the
+        # engine core in pool mode): gate(seq) -> bool.  True resolves
+        # seq.lora_slot and admits; False means the adapter is still
+        # streaming host→device — the request PARKS in `waiting` and
+        # planning prefers resident-adapter work instead of blocking
+        # the batch on the transfer.  None (legacy / no LoRA) admits
+        # everything.
+        self.lora_gate = None
 
     # ------------------------------------------------------------ bookkeeping
 
@@ -270,6 +278,46 @@ class Scheduler:
             if n <= b:
                 return b
         return None
+
+    # adapter-gate scan bound: a parked head looks this far down the
+    # waiting queue for resident-adapter work (bounded — the queue is
+    # client-sized)
+    LORA_SCAN = 16
+
+    def _lora_ready(self, seq: Sequence) -> bool:
+        return self.lora_gate is None or self.lora_gate(seq)
+
+    def _lora_standin(self) -> Optional[Sequence]:
+        """First fresh, adapter-ready waiting candidate behind a parked
+        head (bounded scan; no queue mutation) — the ONE predicate both
+        head promotion and chained-decode admissibility use, so the two
+        can never disagree about whether resident work exists."""
+        for i, seq in enumerate(self.waiting):
+            if i == 0:
+                continue
+            if i > self.LORA_SCAN:
+                return None
+            if (
+                seq.swapped is not None
+                or seq.blocks is not None
+                or seq.prefill_pos != 0
+            ):
+                continue
+            if self._lora_ready(seq):
+                return seq
+        return None
+
+    def _promote_lora_ready(self) -> Optional[Sequence]:
+        """The waiting HEAD is parked on adapter streaming: move the
+        first fresh, adapter-ready candidate to the queue head so it
+        (and the head-only chunk/swap invariants) serve resident work
+        while the stream completes.  The parked former head keeps the
+        next position and resumes the moment its adapter lands."""
+        seq = self._lora_standin()
+        if seq is not None:
+            self.waiting.remove(seq)
+            self.waiting.appendleft(seq)
+        return seq
 
     def schedule(
         self, prefill_only: bool = False
@@ -386,8 +434,14 @@ class Scheduler:
                 seq.prefill_pos != 0
                 or seq.blocks is not None  # mid-chunk: holds pages already
                 or seq.params.prompt_logprobs is not None
-                or seq.lora_slot != head.seq.lora_slot
                 or not self._free_slots
+            ):
+                continue
+            # residency gate BEFORE the slot comparison: the gate is
+            # what resolves seq.lora_slot in pool mode
+            if (
+                not self._lora_ready(seq)
+                or seq.lora_slot != head.seq.lora_slot
             ):
                 continue
             token_ids = seq.all_token_ids
@@ -454,6 +508,17 @@ class Scheduler:
             # e.g. during async prefill_only planning — would forfeit
             # the saved KV
             return None
+        if (
+            seq.blocks is None
+            and seq.prefill_pos == 0
+            and not self._lora_ready(seq)
+        ):
+            # head parked on adapter streaming (mid-chunk heads hold a
+            # pin and are always resident): serve resident-adapter work
+            # around it instead of stalling admissions on the transfer
+            seq = self._promote_lora_ready()
+            if seq is None:
+                return None
         first_chunk = seq.prefill_pos == 0
         if first_chunk and not self._free_slots:
             return None
@@ -712,6 +777,11 @@ class Scheduler:
                 or seq.swapped is not None
             ):
                 continue  # legacy path / swap-in path own these
+            if not self._lora_ready(seq):
+                # adapter still streaming: the row parks and the bucket
+                # fills with resident-adapter work — batch composition
+                # prefers residency so churn cannot thrash the pool
+                continue
             first = seq.prefill_pos == 0 and seq.blocks is None
             matched = 0
             if first:
@@ -962,6 +1032,14 @@ class Scheduler:
         if not self.waiting:
             return False
         seq = self.waiting[0]
+        if not self._lora_ready(seq):
+            # a head parked on adapter streaming cannot progress; the
+            # first adapter-ready candidate in scan range stands in (it
+            # is what schedule() would promote) — none ready means
+            # chaining is free throughput
+            seq = self._lora_standin()
+            if seq is None:
+                return False
         total = len(seq.all_token_ids)
         if seq.swapped is not None:
             return bool(self._free_slots) and self.allocator.can_allocate(

@@ -218,8 +218,12 @@ def scheduler_queues(scheduler: "Scheduler") -> dict:
 
 def engine_introspection(engine) -> dict:  # noqa: ANN001 — LLMEngine (import cycle)
     """One sync engine's full host-side state (scheduler + KV pool)."""
+    pool = getattr(getattr(engine, "runner", None), "adapter_pool", None)
     return {
         "scheduler": scheduler_queues(engine.scheduler),
         "kv_cache": allocator_stats(engine.scheduler.allocator),
         "step_counter": getattr(engine, "step_counter", 0),
+        # paged LoRA pool residency (engine/adapter_pool.py); None when
+        # LoRA is disabled or the legacy stacked path is serving
+        "adapter_pool": pool.debug_state() if pool is not None else None,
     }

@@ -170,6 +170,21 @@ def classify(exc: BaseException) -> Optional[ErrorDisposition]:
     """Type-based status mapping; None means "not ours" (the caller's
     generic INTERNAL/500 path applies)."""
     exc = wrap_engine_error(exc)
+    # adapter load/parse failures (missing adapter_config.json, rank >
+    # --max-lora-rank, unknown target modules, pinned-full registry) are
+    # CLIENT errors with actionable messages — INVALID_ARGUMENT / 400,
+    # never a generic 500.  Lazy import: engine.lora pulls in jax, and
+    # this module must stay importable standalone.
+    try:
+        from vllm_tgis_adapter_tpu.engine.lora import LoRAError
+    except Exception:  # pragma: no cover — partial-install safety
+        LoRAError = ()  # noqa: N806
+    if LoRAError and isinstance(exc, LoRAError):
+        return ErrorDisposition(
+            grpc_code="INVALID_ARGUMENT",
+            http_status=400,
+            err_type="invalid_request_error",
+        )
     if isinstance(exc, AdmissionShedError):
         code, status, err_type = _SHED_DISPOSITIONS.get(
             exc.reason, _SHED_DISPOSITIONS[SHED_QUEUE_FULL]

@@ -40,9 +40,10 @@ from vllm_tgis_adapter_tpu.logging import init_logger
 logger = init_logger(__name__)
 
 POLICY_PREFIX = "prefix"
+POLICY_ADAPTER = "adapter"
 POLICY_TENANT = "tenant"
 POLICY_LOAD = "load"
-POLICIES = (POLICY_PREFIX, POLICY_TENANT, POLICY_LOAD)
+POLICIES = (POLICY_PREFIX, POLICY_ADAPTER, POLICY_TENANT, POLICY_LOAD)
 
 # EWMA weight for the per-replica committed-token rate (load tiebreak +
 # bench attribution); one sample ~= one committed dispatch
@@ -62,6 +63,10 @@ class ReplicaSnapshot:
     index: int
     load: float
     prefix_tokens: int = 0
+    # this request's LoRA adapter is live in the replica's device pool
+    # (engine/adapter_pool.py) — TRUE residency, read at decision time,
+    # unlike the sticky map which only remembers past placements
+    adapter_resident: bool = False
 
 
 class PlacementRouter:
@@ -164,7 +169,16 @@ class PlacementRouter:
         )
         if prefix_best.prefix_tokens > 0:
             chosen, policy = prefix_best, POLICY_PREFIX
-        # 2. tenant/adapter stickiness
+        # 2a. true adapter-pool residency: a replica already holding the
+        # adapter's device weights beats the sticky map's memory of past
+        # placements (the adapter may have been evicted there since, or
+        # streamed elsewhere by a replay)
+        if chosen is None:
+            resident = [s for s in eligible if s.adapter_resident]
+            if resident:
+                chosen = min(resident, key=lambda s: (s.load, s.index))
+                policy = POLICY_ADAPTER
+        # 2b. tenant/adapter stickiness
         if chosen is None and affinity_key is not None:
             sticky_idx = self._sticky_get(affinity_key)
             if sticky_idx is not None:
@@ -211,6 +225,7 @@ class PlacementRouter:
             return 0.0
         hits = (
             self.placed_by_policy[POLICY_PREFIX]
+            + self.placed_by_policy[POLICY_ADAPTER]
             + self.placed_by_policy[POLICY_TENANT]
         )
         return hits / total

@@ -239,6 +239,8 @@ def build_http_server(args: "argparse.Namespace", engine: "AsyncLLMEngine") -> A
     app.route("GET", "/metrics")(_metrics)
     app.route("GET", "/version")(_version)
     app.route("GET", "/v1/models")(_models)
+    app.route("POST", "/v1/load_lora_adapter")(_load_lora_adapter)
+    app.route("POST", "/v1/unload_lora_adapter")(_unload_lora_adapter)
     app.route("POST", "/v1/completions")(_completions)
     app.route("POST", "/v1/chat/completions")(_chat_completions)
     # vLLM-app extras the reference exposes by mounting the full OpenAI
@@ -457,29 +459,109 @@ def _completion_sampling_params(body: dict[str, Any]) -> SamplingParams:
     return SamplingParams(**params)
 
 
-def _openai_preamble(app: App, request: HttpRequest):
-    """Auth + body parse + model lookup shared by the OpenAI endpoints.
-
-    Returns (body, model_name, None) on success or (None, None, error
-    response) — one implementation so an auth or validation fix can
-    never land on one endpoint and miss the other.
-    """
+def _check_api_key(app: App, request: HttpRequest) -> Optional[HttpResponse]:
+    """The one --api-key Bearer check (OpenAI endpoints AND the
+    mutating adapter admin endpoints — an auth fix can never land on
+    one surface and miss the other)."""
     if (key := app.state.get("api_key")) and request.headers.get(
         "authorization"
     ) != f"Bearer {key}":
-        return None, None, error_response(
+        return error_response(
             401, "invalid api key", "authentication_error"
+        )
+    return None
+
+
+async def _load_lora_adapter(app: App, request: HttpRequest) -> HttpResponse:
+    """vLLM-compatible dynamic adapter registration: ``{"lora_name":
+    ..., "lora_path": ...}``.  Load/parse failures (missing
+    adapter_config.json, over-rank, unknown target modules, pinned-full
+    registry) surface as 400 with the actionable message via the typed
+    taxonomy (frontdoor/errors.py classify), never a generic 500."""
+    if (err := _check_api_key(app, request)) is not None:
+        return err
+    engine: AsyncLLMEngine = app.state["engine"]
+    lora_manager = getattr(engine.engine, "lora_manager", None)
+    if lora_manager is None or not engine.engine.config.lora_config.enabled:
+        return error_response(
+            400, "LoRA is disabled on this server (--enable-lora)"
         )
     try:
         body = request.json()
     except json.JSONDecodeError as e:
-        return None, None, error_response(400, f"invalid JSON body: {e}")
-    model_name = body.get("model") or app.state["model_names"][0]
-    if model_name not in app.state["model_names"]:
-        return None, None, error_response(
-            404, f"model {model_name!r} does not exist"
+        return error_response(400, f"invalid JSON body: {e}")
+    name = body.get("lora_name")
+    path = body.get("lora_path")
+    if not name or not path:
+        return error_response(
+            400, "body must carry lora_name and lora_path"
         )
-    return body, model_name, None
+    from vllm_tgis_adapter_tpu.engine.lora import LoRAError
+
+    try:
+        await lora_manager.load_lora_adapter(name, path)
+    except LoRAError as e:
+        return _shed_response(e)
+    except OSError as e:
+        return error_response(400, f"cannot read adapter {name!r}: {e}")
+    return JsonResponse({"status": "ok", "lora_name": name})
+
+
+async def _unload_lora_adapter(app: App, request: HttpRequest) -> HttpResponse:
+    if (err := _check_api_key(app, request)) is not None:
+        return err
+    engine: AsyncLLMEngine = app.state["engine"]
+    lora_manager = getattr(engine.engine, "lora_manager", None)
+    if lora_manager is None or not engine.engine.config.lora_config.enabled:
+        return error_response(
+            400, "LoRA is disabled on this server (--enable-lora)"
+        )
+    try:
+        body = request.json()
+    except json.JSONDecodeError as e:
+        return error_response(400, f"invalid JSON body: {e}")
+    name = body.get("lora_name")
+    if not name:
+        return error_response(400, "body must carry lora_name")
+    from vllm_tgis_adapter_tpu.engine.lora import LoRAError
+
+    try:
+        lora_manager.unload_lora_adapter(name)
+    except LoRAError as e:
+        return _shed_response(e)
+    return JsonResponse({"status": "ok", "lora_name": name})
+
+
+def _openai_preamble(app: App, request: HttpRequest):
+    """Auth + body parse + model lookup shared by the OpenAI endpoints.
+
+    Returns (body, model_name, lora_request, None) on success or
+    (None, None, None, error response) — one implementation so an auth
+    or validation fix can never land on one endpoint and miss the
+    other.  ``model`` naming a registered LoRA adapter (the /v1/models
+    listing includes them) resolves to that adapter's engine request —
+    the OpenAI-compatible multi-LoRA surface vLLM serves.
+    """
+    if (err := _check_api_key(app, request)) is not None:
+        return None, None, None, err
+    try:
+        body = request.json()
+    except json.JSONDecodeError as e:
+        return None, None, None, error_response(
+            400, f"invalid JSON body: {e}"
+        )
+    model_name = body.get("model") or app.state["model_names"][0]
+    lora_request = None
+    if model_name not in app.state["model_names"]:
+        engine: AsyncLLMEngine = app.state["engine"]
+        lora_manager = getattr(engine.engine, "lora_manager", None)
+        if lora_manager is not None:
+            lora_request = lora_manager.lora_requests.get(model_name)
+        if lora_request is None:
+            return None, None, None, error_response(
+                404, f"model {model_name!r} does not exist"
+            )
+    return body, model_name, lora_request, None
 
 
 def _parse_n(body: dict[str, Any]):
@@ -529,7 +611,7 @@ async def _stream_head(merged):  # noqa: ANN001, ANN202
 
 async def _completions(app: App, request: HttpRequest):  # noqa: ANN201, C901, PLR0915
     engine: AsyncLLMEngine = app.state["engine"]
-    body, model_name, err = _openai_preamble(app, request)
+    body, model_name, lora_request, err = _openai_preamble(app, request)
     if err is not None:
         return err
 
@@ -572,6 +654,7 @@ async def _completions(app: App, request: HttpRequest):  # noqa: ANN201, C901, P
                     sampling_params, k, n, out_kind
                 ),
                 request_id=f"cmpl-{base_request_id}-{pi * n + k}",
+                lora_request=lora_request,
                 trace_headers=_trace_headers(request),
                 tenant_id=_tenant_id(app, request),
             ))
@@ -690,7 +773,7 @@ async def _chat_completions(app: App, request: HttpRequest):  # noqa: ANN201, C9
     """OpenAI chat API over the shared engine (reference parity: the
     embedded vLLM app serves chat from the same engine as completions)."""
     engine: AsyncLLMEngine = app.state["engine"]
-    body, model_name, err = _openai_preamble(app, request)
+    body, model_name, lora_request, err = _openai_preamble(app, request)
     if err is not None:
         return err
 
@@ -756,6 +839,7 @@ async def _chat_completions(app: App, request: HttpRequest):  # noqa: ANN201, C9
             prompt=prompt,
             sampling_params=_sibling_params(sampling_params, k, n, out_kind),
             request_id=f"chat-{base_request_id}-{k}",
+            lora_request=lora_request,
             trace_headers=_trace_headers(request),
             tenant_id=_tenant_id(app, request),
         )

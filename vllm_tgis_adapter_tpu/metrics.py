@@ -391,6 +391,46 @@ frontdoor_placement_total = _get_or_create(
     labelnames=("policy",),
 )
 
+# ------------------------------------------------------ LoRA adapter pool
+
+lora_adapters_registered = _get_or_create(
+    Gauge,
+    f"{_PREFIX}_lora_adapters_registered",
+    "LoRA adapters registered in the host-RAM registry "
+    "(engine/lora.py LoRAManager; bounded by --max-cpu-loras in pool "
+    "mode, --max-loras on the legacy path)",
+)
+lora_adapters_resident = _get_or_create(
+    Gauge,
+    f"{_PREFIX}_lora_adapters_resident",
+    "LoRA adapters currently device-resident in the replica's paged "
+    "adapter pool (engine/adapter_pool.py; bounded by --max-loras)",
+    labelnames=("replica",),
+)
+lora_swap_total = _get_or_create(
+    Counter,
+    f"{_PREFIX}_lora_swap_total",
+    "Adapter pool slot swaps, by direction: 'in' = host→device stream "
+    "committed, 'out' = LRU eviction / host-registry invalidation "
+    "freed a slot",
+    labelnames=("direction",),
+)
+lora_pool_hit_rate = _get_or_create(
+    Gauge,
+    f"{_PREFIX}_lora_pool_hit_rate",
+    "Fraction of adapter-bearing admissions whose adapter was already "
+    "device-resident in the replica's pool (counted once per request "
+    "at admission, not per schedule retry)",
+    labelnames=("replica",),
+)
+lora_prefetch_seconds = _get_or_create(
+    Histogram,
+    f"{_PREFIX}_lora_prefetch_seconds",
+    "Host→device adapter stream latency (block build + transfer + "
+    "jitted slot scatter), per committed stream",
+    buckets=(0.005, 0.02, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0),
+)
+
 
 class _StepSnapshot:
     """Host-side mirror of the latest per-dispatch shape stats, so the

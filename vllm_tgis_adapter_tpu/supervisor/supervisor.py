@@ -470,8 +470,17 @@ class EngineSupervisor:
         # replacement: on TPU the boot pool was sized to ~all free HBM,
         # and two of them cannot coexist — holding the old reference
         # here would make every rebuild die in RESOURCE_EXHAUSTED.  The
-        # weights (runner.params) stay resident; only KV goes.
+        # weights (runner.params) stay resident; only KV goes — and the
+        # adapter pool's slot stacks, whose HBM reservation the
+        # replacement's own (cold) pool re-claims.  The rebuilt engine
+        # re-streams ONLY the adapters its replayed requests reference:
+        # each replayed add_request issues a pool prefetch
+        # (engine/core.py), so dead tenants' weights stay on the host.
         runner.caches = None
+        pool = getattr(runner, "adapter_pool", None)
+        if pool is not None:
+            pool.release()
+            runner.lora_stacks = None
         if spec is not None:
             spec.draft_caches = None
         # old.config already carries the boot-resolved num_blocks, so no

@@ -313,6 +313,19 @@ def add_engine_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                    help="max supported LoRA rank")
     g.add_argument("--lora-modules", type=str, nargs="*", default=None,
                    help="static LoRA modules to register: name=path ...")
+    g.add_argument("--max-cpu-loras", type=int, default=0,
+                   help="host-RAM adapter registry capacity for the "
+                        "paged pool (0 = auto: max(64, 4*max-loras)); "
+                        "device residency stays bounded by --max-loras")
+    g.add_argument("--lora-pool", action=argparse.BooleanOptionalAction,
+                   default=True,
+                   help="paged device adapter pool with async "
+                        "host-to-device streaming (docs/LORA.md); "
+                        "--no-lora-pool restores the legacy full-stack "
+                        "rebuild slow path")
+    g.add_argument("--lora-prefetch-concurrency", type=int, default=2,
+                   help="concurrent host-to-device adapter streams per "
+                        "replica pool")
 
     g = parser.add_argument_group("speculative decoding")
     g.add_argument("--speculative-model", type=str, default=None,
