@@ -78,12 +78,16 @@ def chaos_check(session: nox.Session) -> None:
     sibling while that sibling's TTFT stays bounded; and the adapter-
     pool suite (docs/LORA.md) with its adapter-swap-during-restart
     scenario — replayed requests carry LoRA identity onto the rebuilt
-    engine's cold pool and reproduce the uncrashed tokens.  Also runs
-    inside the tier-1 suite; this session is the fast standalone entry
-    point."""
+    engine's cold pool and reproduce the uncrashed tokens; and the
+    tiered-KV suite (docs/KV_TIERING.md) with its cross-restart
+    acceptance — a failpoint-killed engine rebuilds and re-serves a
+    warm prefix from the SURVIVING host tier, token-identically.  Also
+    runs inside the tier-1 suite; this session is the fast standalone
+    entry point."""
     session.install("-e", ".[tests]")
     session.run(
         "pytest", "tests/test_supervisor.py", "tests/test_adapter_pool.py",
+        "tests/test_kv_tier.py",
         "-q",
         *session.posargs,
         env={"JAX_PLATFORMS": "cpu"},

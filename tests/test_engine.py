@@ -801,8 +801,8 @@ def test_preemption_swaps_kv_instead_of_recompute(engine_factory):
     from vllm_tgis_adapter_tpu import metrics
     from vllm_tgis_adapter_tpu.engine.sampling_params import SamplingParams
 
-    out_before = metrics.kv_swap_out_total._value.get()
-    in_before = metrics.kv_swap_in_total._value.get()
+    out_before = metrics.kv_swap_out_total.labels(replica="0")._value.get()
+    in_before = metrics.kv_swap_in_total.labels(replica="0")._value.get()
 
     engine = engine_factory(num_blocks=6, max_num_seqs=4,
                             engine_kwargs={"swap_space_gib": 1.0})
@@ -839,8 +839,8 @@ def test_preemption_swaps_kv_instead_of_recompute(engine_factory):
     for i in range(3):
         assert len(outputs[f"sw-{i}"].outputs[0].token_ids) == 40
 
-    swaps_out = metrics.kv_swap_out_total._value.get() - out_before
-    swaps_in = metrics.kv_swap_in_total._value.get() - in_before
+    swaps_out = metrics.kv_swap_out_total.labels(replica="0")._value.get() - out_before
+    swaps_in = metrics.kv_swap_in_total.labels(replica="0")._value.get() - in_before
     assert swaps_out >= 1, "tiny pool must have preempted at least once"
     assert swaps_in == swaps_out
     assert recompute_prefills == []  # every preemption resumed from swap
@@ -939,12 +939,12 @@ def test_async_engine_swap_under_pressure(tiny_model_dir):
         finally:
             await engine.stop()
 
-    in_before = metrics.kv_swap_in_total._value.get()
+    in_before = metrics.kv_swap_in_total.labels(replica="0")._value.get()
     tight = asyncio.run(run(build(num_blocks=6, swap=1.0)))
     roomy = asyncio.run(run(build(num_blocks=64, swap=0.0)))
     assert all(len(t) == 40 for t in tight)
     assert tight == roomy
-    assert metrics.kv_swap_in_total._value.get() > in_before
+    assert metrics.kv_swap_in_total.labels(replica="0")._value.get() > in_before
 
 
 def test_precompile_warms_shapes_and_leaves_engine_clean(engine_factory):

@@ -40,7 +40,8 @@ def documented_metrics(doc_path: Path) -> set[str]:
 # top-level sections docs/OBSERVABILITY.md documents for the
 # /debug/state snapshot; a missing key means code and doc diverged
 DEBUG_STATE_KEYS = (
-    "engine", "supervisor", "frontdoor", "router", "replicas",
+    "engine", "supervisor", "frontdoor", "router", "kv_host_tier",
+    "replicas",
     "compile_tracker",
     "watchdog",
     "events",

@@ -139,6 +139,37 @@ def measure_lora(lora_cfg: dict, runs: int) -> tuple[dict, dict]:
     return churn, single
 
 
+def measure_kv_tier(kv_cfg: dict, runs: int) -> dict:
+    """Best-of-``runs`` prefix-reuse line (docs/KV_TIERING.md; the
+    acceptance demo: device pool capped below the reusable working set,
+    warm pass served through the host tier).  Best = lowest warm/cold
+    TTFT ratio — the gate is a latency ratio, so 'best' must mean the
+    least load-noise-polluted run."""
+    backend = kv_cfg.get("backend", "ragged")
+    best = None
+    for _ in range(runs):
+        line = run_bench(backend, dict(kv_cfg.get("env", {})))
+        kv = line.get("kv_tier")
+        if kv is None or kv.get("warm_cold_ttft_ratio") is None:
+            raise RuntimeError("bench emitted no kv_tier stamps")
+        if (
+            best is None
+            or kv["warm_cold_ttft_ratio"]
+            < best["kv_tier"]["warm_cold_ttft_ratio"]
+        ):
+            best = line
+    kv = best["kv_tier"]
+    print(
+        f"perf_check: kv_tier  warm/cold ttft "
+        f"{kv['ttft_warm_ms_p50']}/{kv['ttft_cold_ms_p50']}ms "
+        f"(ratio {kv['warm_cold_ttft_ratio']}) "
+        f"hit_rate={kv['combined_hit_rate']} "
+        f"host_tokens={kv['host_promoted_tokens']} "
+        f"identical={kv['token_identical']}"
+    )
+    return best
+
+
 def main(argv: list[str] | None = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     write = "--write" in argv
@@ -190,6 +221,15 @@ def main(argv: list[str] | None = None) -> int:
             print(f"perf_check: lora measurement failed: {exc}")
             return 2
 
+    kv_cfg = baseline.get("kv_tier")
+    kv_line: dict | None = None
+    if kv_cfg:
+        try:
+            kv_line = measure_kv_tier(kv_cfg, int(kv_cfg.get("runs", runs)))
+        except Exception as exc:  # noqa: BLE001 — tool boundary
+            print(f"perf_check: kv_tier measurement failed: {exc}")
+            return 2
+
     if write:
         out = {
             "_comment": (
@@ -227,6 +267,10 @@ def main(argv: list[str] | None = None) -> int:
                     else {}
                 ),
             }
+        if kv_cfg:
+            # declarative section (ratio + structural demands): carried
+            # through unchanged — there is no measured floor to refresh
+            out["kv_tier"] = dict(kv_cfg)
         if dp_cfg:
             out["dp"] = {
                 **dp_cfg,
@@ -334,6 +378,38 @@ def main(argv: list[str] | None = None) -> int:
         if lora_churn["value"] < floor:
             failures.append(
                 f"lora: {lora_churn['value']:.1f} tok/s < floor {floor:.1f}"
+            )
+
+    if kv_cfg and kv_line is not None:
+        # ISSUE 9 acceptance: with the device prefix pool capped below
+        # the reusable working set, warm TTFT p50 ≤ max_warm_ttft_ratio
+        # of cold, combined hit rate ≥ min_hit_rate, the host tier
+        # actually served tokens, and cold↔warm outputs token-identical
+        kv = kv_line["kv_tier"]
+        max_ratio = float(kv_cfg.get("max_warm_ttft_ratio", 0.6))
+        if kv["warm_cold_ttft_ratio"] > max_ratio:
+            failures.append(
+                f"kv_tier: warm TTFT p50 {kv['ttft_warm_ms_p50']}ms is "
+                f"{kv['warm_cold_ttft_ratio']}x cold "
+                f"({kv['ttft_cold_ms_p50']}ms) > allowed {max_ratio}x"
+            )
+        min_hit = float(kv_cfg.get("min_hit_rate", 0.5))
+        if kv["combined_hit_rate"] < min_hit:
+            failures.append(
+                f"kv_tier: combined hit rate {kv['combined_hit_rate']} "
+                f"< required {min_hit}"
+            )
+        min_host = int(kv_cfg.get("min_host_promoted_tokens", 0))
+        if kv.get("host_promoted_tokens", 0) < min_host:
+            failures.append(
+                f"kv_tier: host_promoted_tokens "
+                f"{kv.get('host_promoted_tokens')} < required {min_host} "
+                "(reuse never flowed through the host tier)"
+            )
+        if not kv.get("token_identical"):
+            failures.append(
+                "kv_tier: warm-pass outputs diverged from the cold pass "
+                "(promoted KV must be byte-equivalent to recompute)"
             )
 
     if failures:

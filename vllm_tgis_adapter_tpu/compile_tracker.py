@@ -109,6 +109,14 @@ def num_shapes() -> int:
         return len(_seen_shapes)
 
 
+def shapes() -> set[tuple[str, str]]:
+    """Snapshot of the distinct (fn, shape) programs compiled since boot
+    (per-entry-point compile-discipline assertions, e.g. the KV tier's
+    fixed-block-shape gather/scatter gate in tests/test_kv_tier.py)."""
+    with _lock:
+        return set(_seen_shapes)
+
+
 def total_recompiles() -> int:
     with _lock:
         return _total_recompiles

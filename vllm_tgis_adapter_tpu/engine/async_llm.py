@@ -302,6 +302,18 @@ class AsyncLLMEngine:
         )
 
         candidates = self._serving_replicas()
+        # host-tier residency (engine/kv_tier.py): probed ONCE — the
+        # tier is shared fleet-wide, so every replica could promote the
+        # same pages; the router scores it below device residency
+        # (docs/SCALING.md placement tiers)
+        host_tokens = 0
+        tier = self.engine.kv_tier
+        if prompt_token_ids and tier is not None:
+            # incremental walk: one hash on a cold tier, O(covered)
+            # when warm — this runs per request on the admission path
+            host_tokens = tier.block_size * tier.peek_prefix_pages(
+                prompt_token_ids, lora_name
+            )
         snapshots = []
         for rep in candidates:
             scheduler = rep.engine.scheduler
@@ -324,6 +336,7 @@ class AsyncLLMEngine:
                 index=rep.index,
                 load=scheduler.num_unfinished,
                 prefix_tokens=prefix_tokens,
+                host_prefix_tokens=host_tokens,
                 adapter_resident=(
                     pool is not None and pool.resident(lora_name)
                 ),
@@ -444,6 +457,13 @@ class AsyncLLMEngine:
         shared = engines[0].lora_manager
         for e in engines[1:]:
             e.adopt_lora_manager(shared)
+        # one host KV tier fleet-wide (engine/kv_tier.py): KV content is
+        # a pure function of tokens ‖ adapter ‖ model, so pages demoted
+        # by any replica serve every replica — and the shared store is
+        # what a rebuilt replica re-warms from (docs/KV_TIERING.md)
+        if engines[0].kv_tier is not None:
+            for e in engines[1:]:
+                e.adopt_kv_tier(engines[0].kv_tier)
         return cls(engines)
 
     STATS_INTERVAL_S = 10.0
@@ -506,6 +526,12 @@ class AsyncLLMEngine:
                 except (asyncio.CancelledError, Exception):  # noqa: BLE001
                     pass
                 rep.task = None
+        tier = getattr(self.engine, "kv_tier", None)
+        if tier is not None:
+            # terminal shutdown: stop accepting demotions and release
+            # the host pages (restart-survival is the SUPERVISOR's path,
+            # which never calls stop())
+            tier.close()
         if self._tracer is not None:
             # flush buffered spans before the exporter thread dies with
             # the process
@@ -863,6 +889,13 @@ class AsyncLLMEngine:
                 else None
             ),
             "router": self.router.debug_state(),
+            # shared host KV tier (engine/kv_tier.py); None when
+            # --no-kv-host-cache / library default off
+            "kv_host_tier": (
+                self.engine.kv_tier.debug_state()
+                if getattr(self.engine, "kv_tier", None) is not None
+                else None
+            ),
             "replicas": replicas,
             "compile_tracker": {
                 "compiled_shapes": compile_tracker.num_shapes(),
@@ -940,6 +973,26 @@ class AsyncLLMEngine:
                 kv_total=num_blocks,
                 prefix_hits=sum(a.prefix_hits for a in allocators),
             )
+            # per-tier prefix hit rates (docs/KV_TIERING.md): tokens
+            # served from each tier over prompt tokens that consulted
+            # the prefix cache, per replica.  Device hits include
+            # promoted pages once re-registered; the host series counts
+            # the promotions themselves.
+            for rep in self._replicas:
+                alloc = rep.engine.scheduler.allocator
+                lookups = max(1, alloc.prefix_lookup_tokens)
+                host_tokens = getattr(
+                    rep.engine, "kv_host_promoted_tokens", 0
+                )
+                metrics.kv_prefix_hit_rate.labels(
+                    tier="device", replica=str(rep.index)
+                ).set((alloc.prefix_hits - host_tokens) / lookups)
+                metrics.kv_prefix_hit_rate.labels(
+                    tier="host", replica=str(rep.index)
+                ).set(host_tokens / lookups)
+            tier = getattr(self.engine, "kv_tier", None)
+            if tier is not None:
+                metrics.kv_host_tier_bytes.set(tier.bytes_used)
             for rep in self._replicas:
                 pool = getattr(rep.engine.runner, "adapter_pool", None)
                 if pool is not None:

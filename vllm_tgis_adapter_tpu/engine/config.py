@@ -946,6 +946,16 @@ class EngineConfig:
     # _swap_out_seq; reference maps the flag into vLLM's CPU swap).
     # 0 keeps the recompute-only path.
     swap_space_gib: float = 0.0
+    # --kv-host-cache-gb GiB of host RAM for the tiered KV store
+    # (engine/kv_tier.py, docs/KV_TIERING.md): a hash-addressed
+    # prefix-page cache behind the device pool — registered prompt pages
+    # demote device→host, prefix misses the tier can cover park for an
+    # async promotion, preemption swap-out lands in the same store, and
+    # the store survives supervised engine restarts.  0 (the library
+    # default) is byte-identical to the pre-tier engine; the served
+    # binary defaults it ON (tgis_utils/args.py, --no-kv-host-cache to
+    # disable).
+    kv_host_cache_gb: float = 0.0
     quantization: str | None = None
     otlp_traces_endpoint: str | None = None
     disable_log_requests: bool = True
@@ -1187,6 +1197,11 @@ class EngineConfig:
             max_logprobs=args.max_logprobs,
             hbm_memory_utilization=args.hbm_memory_utilization,
             swap_space_gib=getattr(args, "swap_space", 0.0) or 0.0,
+            kv_host_cache_gb=(
+                0.0
+                if getattr(args, "no_kv_host_cache", False)
+                else float(getattr(args, "kv_host_cache_gb", 0.0) or 0.0)
+            ),
             quantization=args.quantization,
             otlp_traces_endpoint=args.otlp_traces_endpoint,
             disable_log_stats=getattr(args, "disable_log_stats", False),

@@ -195,6 +195,35 @@ class LLMEngine:
         ):
             self.scheduler.swap_out_fn = self._swap_out_seq
             self.scheduler.swap_drop_fn = self._swap_drop_seq
+        # host-RAM KV tier (--kv-host-cache-gb, engine/kv_tier.py): a
+        # hash-addressed prefix-page store behind the swap machinery —
+        # registered prompt pages demote device→host asynchronously,
+        # prefix-cache misses the tier can cover PARK for an async
+        # promotion, and preemption swap-out lands in the same store.
+        # Same gates as --swap-space (flat ModelRunner cache only, no
+        # rolling-window eviction).  0 (library default) is byte-
+        # identical to the pre-tier engine; dp fleets and supervised
+        # rebuilds re-attach a shared/surviving tier via adopt_kv_tier.
+        self.kv_tier = None
+        self._promotions: list = []  # (seq, ticket) awaiting apply
+        self.kv_host_promoted_tokens = 0
+        if (
+            config.kv_host_cache_gb > 0
+            and pcfg.pipeline_parallel_size == 1
+            and self.scheduler.rolling_window == 0
+        ):
+            from vllm_tgis_adapter_tpu.engine.kv_tier import HostKVTier
+
+            self.kv_tier = HostKVTier(
+                round(config.kv_host_cache_gb * (1 << 30)),
+                config.cache_config.block_size,
+            )
+            self._wire_kv_tier()
+        elif config.kv_host_cache_gb > 0:
+            logger.warning(
+                "--kv-host-cache-gb has no effect with pp > 1 or "
+                "rolling-window KV eviction; host KV tier disabled"
+            )
         # black-box lifecycle recorder (flight_recorder.py): every
         # admission/dispatch/preemption/finish appends one bounded ring
         # entry; the scheduler shares it for preemption events
@@ -562,6 +591,12 @@ class LLMEngine:
         n = seq.num_tokens - 1
         if n <= 0 or seq.blocks is None:
             return False
+        if self.kv_tier is not None:
+            # the victim's full pages ALSO land in the hash-addressed
+            # host tier: the per-seq swap copy below restores this one
+            # request, the tier serves every future request sharing the
+            # prefix (and survives an engine restart)
+            self._tier_demote(seq, seq.all_token_ids, written=n)
         slots = seq.blocks.slots_for_range(0, n)
         k_cache, _ = self.runner.caches
         per_slot = (
@@ -584,7 +619,9 @@ class LLMEngine:
             "swap_out", seq.request_id, step=self.step_counter,
             trace_id=seq.trace_id, tokens=n, bytes=nbytes,
         )
-        metrics.kv_swap_out_total.inc()
+        metrics.kv_swap_out_total.labels(
+            replica=str(self.replica_index)
+        ).inc()
         # inc/dec (not set): dp replicas share the process-global gauge,
         # so absolute sets from different replicas would clobber
         metrics.kv_swap_used_bytes.inc(nbytes)
@@ -617,10 +654,307 @@ class LLMEngine:
                 "swap_in", seq.request_id, step=self.step_counter,
                 trace_id=seq.trace_id, tokens=n,
             )
-            metrics.kv_swap_in_total.inc()
+            metrics.kv_swap_in_total.labels(
+                replica=str(self.replica_index)
+            ).inc()
             metrics.kv_swap_used_bytes.dec(nbytes)
             logger.info("restored request %s from host swap (%d tokens)",
                         seq.request_id, n)
+
+    # --------------------------------------------------------- host KV tier
+
+    def _wire_kv_tier(self) -> None:
+        self.scheduler.kv_gate = self._kv_tier_gate
+        if self.config.cache_config.enable_prefix_caching:
+            # eviction → demotion: a registered page copies to the host
+            # tier at the moment the device LRU reclaims it — never
+            # earlier, so pages the device keeps (or that are never
+            # reused) cost no transfer (ISSUE 9 integration point 1)
+            self.scheduler.allocator.evict_hook = self._tier_evict_demote
+        if self.scheduler.swap_out_fn is None:
+            # no --swap-space: preemption victims demote their computed
+            # full pages into the hash-addressed store instead (resume
+            # then recomputes only the uncovered tail via promotion)
+            self.scheduler.swap_out_fn = self._tier_swap_out
+
+    def adopt_kv_tier(self, tier) -> None:  # noqa: ANN001
+        """Point this engine at a shared/surviving host KV tier (dp
+        fleet construction, supervised rebuild).  The construction-time
+        fresh tier (if any) is discarded; in-flight promotion tickets
+        stay with the engine that issued them — their target pages
+        belong to that engine's (possibly dead) pool."""
+        if tier is None:
+            return
+        if self.config.parallel_config.pipeline_parallel_size > 1:
+            return  # no flat cache to gather/scatter against
+        self.kv_tier = tier
+        self._wire_kv_tier()
+
+    def _tier_demote(
+        self,
+        seq: Sequence,
+        token_ids: list[int],
+        written: Optional[int] = None,
+    ) -> int:
+        """Queue ``seq``'s full pages of ``token_ids`` that the host
+        tier lacks: per-page jitted device gathers are ENQUEUED here
+        (ordered before any later dispatch that could overwrite the
+        pages, so the read content is the content current now), and the
+        tier's worker thread completes the device→host copies off the
+        event loop.  Returns the number of pages queued.
+
+        ``written`` caps demotion at the cache-coverage frontier: a
+        page may only tier when EVERY position it covers has its K/V
+        written.  Preemption passes ``num_tokens - 1`` (the invariant
+        ``_swap_out_seq`` documents: the just-sampled token's slot is
+        written by the NEXT dispatch) — without the cap, the last page
+        could carry one garbage position into the hash-addressed store
+        and poison every future chain extension through it."""
+        tier = self.kv_tier
+        if tier is None or seq.blocks is None:
+            return 0
+        bs = self.config.cache_config.block_size
+        limit = len(token_ids) if written is None else min(
+            len(token_ids), written
+        )
+        pages = min(limit // bs, len(seq.blocks.blocks))
+        if pages <= 0:
+            return 0
+        from vllm_tgis_adapter_tpu.engine.kv_cache import chain_digests
+
+        digests = chain_digests(token_ids, bs, seq.lora_name, pages)
+        batch = []
+        for p in range(pages):
+            if tier.has(digests[p]) or seq.blocks.blocks[p] < 0:
+                continue
+            start = p * bs
+            k_dev, v_dev = self.runner.gather_kv_block(
+                seq.blocks.slots_for_range(start, start + bs)
+            )
+            batch.append((digests[p], k_dev, v_dev))
+        if not batch:
+            return 0
+        tier.submit(batch)
+        self.recorder.record(
+            "demote_host", seq.request_id, step=self.step_counter,
+            trace_id=seq.trace_id, pages=len(batch),
+        )
+        return len(batch)
+
+    def _tier_swap_out(self, seq: Sequence) -> bool:
+        """Preemption hook when the tier is on and --swap-space is not:
+        the victim's computed full pages land in the hash-addressed
+        store (keyed over prompt ‖ generated tokens, so the resume's
+        promotion walk matches), and re-admission recomputes only the
+        uncovered tail.  Returns False — ``seq.swapped`` is never set;
+        the store, not a per-sequence copy, owns the bytes."""
+        self._tier_demote(
+            seq, seq.all_token_ids, written=seq.num_tokens - 1
+        )
+        return False
+
+    # cap on promotions in flight per engine: each parked promotion
+    # reserves its request's full prompt pages, so an unbounded warm
+    # backlog could thrash the pool via preemption of its own parked
+    # work; excess candidates simply admit on the recompute path
+    MAX_INFLIGHT_PROMOTIONS = 8
+
+    def _tier_evict_demote(self, digest: bytes, block: int) -> None:
+        """Allocator evict hook: ONE registered page is about to be
+        reclaimed — gather it now (device-ordered before the reclaiming
+        owner's first write) and hand it to the tier's async committer.
+        Runs under the engine lock inside planning/admission."""
+        tier = self.kv_tier
+        if tier is None or tier.has(digest):
+            return
+        bs = self.config.cache_config.block_size
+        k_dev, v_dev = self.runner.gather_kv_block(
+            list(range(block * bs, (block + 1) * bs))
+        )
+        tier.submit([(digest, k_dev, v_dev)])
+        self.recorder.record(
+            "demote_host", step=self.step_counter, pages=1, block=block,
+        )
+
+    def _register_prefix(self, seq: Sequence) -> None:
+        """Publish a completed prefill's pages for reuse: the device
+        prefix cache, whose LRU eviction then demotes to the host tier
+        (``_tier_evict_demote``) — or, when --enable-prefix-caching is
+        OFF and only the host tier serves reuse, demote the (final,
+        fully written) prompt pages directly at this commit."""
+        self.scheduler.register_prefix(seq)
+        if (
+            self.kv_tier is not None
+            and not self.config.cache_config.enable_prefix_caching
+        ):
+            self._tier_demote(seq, seq.prompt_token_ids)
+
+    def _kv_tier_gate(self, seq: Sequence, start: bool = True) -> bool:
+        """Scheduler kv gate: True = admit normally; False = the request
+        PARKS while its host-tier-resident prefix promotes to device.
+        ``start=True`` (planning paths) may begin a promotion: target
+        pages are allocated NOW (device prefix hits adopted first, the
+        host span on fresh pages) and the tier stages the transfer off
+        the loop; ``start=False`` is a pure in-flight probe."""
+        if seq.kv_promotion is not None:
+            return False  # parked until _drain_promotions applies it
+        if not start:
+            return True
+        if len(self._promotions) >= self.MAX_INFLIGHT_PROMOTIONS:
+            # bound the pages parked promotions hold (each reserves its
+            # full prompt capacity) and the transfer backlog: excess
+            # warm candidates admit on the plain recompute path NOW and
+            # later candidates re-gate once a promotion applies
+            return True
+        if (
+            seq.prefill_pos != 0
+            or seq.blocks is not None
+            or seq.swapped is not None
+            or seq.params.prompt_logprobs is not None  # _adoptable rule
+        ):
+            return True
+        token_ids = seq.all_token_ids
+        bs = self.config.cache_config.block_size
+        max_pages = (len(token_ids) - 1) // bs  # match_prefix's cap
+        if max_pages <= 0:
+            return True
+        alloc = self.scheduler.allocator
+        matched = (
+            alloc.peek_prefix(token_ids, seq.lora_name)
+            if alloc.enable_prefix_caching
+            else 0
+        )
+        start_page = matched // bs
+        if start_page >= max_pages:
+            return True  # device cache already covers everything usable
+        # incremental probe: hashes only through the covered span, so a
+        # cold-tier miss costs O(start_page + 1) hashes, not O(prompt)
+        extra = self.kv_tier.peek_prefix_pages(
+            token_ids, seq.lora_name, start_page
+        )
+        if extra <= 0:
+            return True
+        from vllm_tgis_adapter_tpu.engine.kv_cache import (
+            SequenceBlocks,
+            chain_digests,
+        )
+
+        digests = chain_digests(
+            token_ids, bs, seq.lora_name, start_page + extra
+        )
+        lead = digests[start_page]
+        for _, other in self._promotions:
+            if not other.cancelled and lead in other.digests:
+                # a sibling request is already streaming this span:
+                # park WITHOUT a duplicate ticket — when the sibling
+                # applies, its pages re-register in the device cache
+                # and this request admits with device hits; if the
+                # sibling fails, the next gate pass starts our own
+                return False
+        # promotion must not demand more than plain admission would: if
+        # the pool cannot hold the whole prompt, let the normal path
+        # wait/reject — never park a request the tier cannot unblock
+        if not alloc.can_allocate(alloc.blocks_needed(len(token_ids))):
+            return True
+        seq.blocks = SequenceBlocks(alloc)
+        if matched:
+            hit_blocks, adopted = alloc.match_prefix(
+                token_ids, seq.lora_name
+            )
+            seq.blocks.adopt(hit_blocks)
+            start_page = adopted // bs  # same lock, but stay exact
+        end_tokens = (start_page + extra) * bs
+        # FULL prompt capacity, exactly like first-chunk admission
+        # (which does ensure_capacity(total)): the post-promotion
+        # mid-chunk continuation assumes every prompt page exists
+        seq.blocks.ensure_capacity(len(token_ids))
+        from vllm_tgis_adapter_tpu.engine.kv_tier import PromotionTicket
+
+        ticket = PromotionTicket(
+            request_id=seq.request_id,
+            digests=digests[start_page:start_page + extra],
+            start_tokens=start_page * bs,
+            end_tokens=end_tokens,
+        )
+        seq.kv_promotion = ticket
+        self._promotions.append((seq, ticket))
+        self.kv_tier.start_promotion(ticket, self.runner._put)  # noqa: SLF001
+        return False
+
+    def _drain_promotions(self) -> None:
+        """Apply completed host→device promotions on a clean dispatch
+        boundary (the per-page scatter rebinds ``runner.caches``, same
+        contract as swap-in).  An applied request resumes as a mid-chunk
+        prefill AFTER the restored span; a failed/shrunk-to-zero ticket
+        un-parks the request onto the plain recompute path."""
+        if not self._promotions:
+            return
+        rest: list = []
+        bs = self.config.cache_config.block_size
+        alloc = self.scheduler.allocator
+        for seq, ticket in self._promotions:
+            if (
+                ticket.cancelled
+                or seq.kv_promotion is not ticket
+                or self._seqs.get(seq.request_id) is not seq
+            ):
+                # aborted / preempted / belongs to a previous engine
+                # incarnation: finish()/teardown released its pages
+                if seq.kv_promotion is ticket:
+                    seq.kv_promotion = None
+                continue
+            if not ticket.ready:
+                rest.append((seq, ticket))
+                continue
+            if ticket.failed:
+                seq.kv_promotion = None
+                if seq.blocks is not None:
+                    seq.blocks.release()
+                    seq.blocks = None
+                seq.prefill_pos = 0  # un-park; plain admission serves it
+                continue
+            if not self.scheduler._free_slots:  # noqa: SLF001
+                rest.append((seq, ticket))  # retry next boundary
+                continue
+            for i, (k_dev, v_dev) in enumerate(ticket.pages):
+                pos = ticket.start_tokens + i * bs
+                self.runner.restore_kv_block(
+                    seq.blocks.slots_for_range(pos, pos + bs),
+                    k_dev, v_dev,
+                )
+            seq.slot = self.scheduler._free_slots.pop()  # noqa: SLF001
+            seq.prefill_pos = ticket.end_tokens
+            seq.kv_promotion = None
+            promoted = ticket.end_tokens - ticket.start_tokens
+            alloc.prefix_hits += ticket.end_tokens
+            alloc.prefix_lookup_tokens += len(seq.all_token_ids)
+            self.kv_host_promoted_tokens += promoted
+            self.kv_tier.note_promoted(len(ticket.pages), promoted)
+            metrics.kv_prefix_tokens_reused_total.labels(
+                tier="host"
+            ).inc(promoted)
+            if ticket.start_tokens:
+                metrics.kv_prefix_tokens_reused_total.labels(
+                    tier="device"
+                ).inc(ticket.start_tokens)
+            # the restored pages are now device content like any other:
+            # publish them so the NEXT request hits on device directly
+            alloc.register_prefix(
+                seq.all_token_ids[:ticket.end_tokens],
+                seq.blocks.blocks,
+                seq.lora_name,
+            )
+            self.recorder.record(
+                "promote_host", seq.request_id, step=self.step_counter,
+                trace_id=seq.trace_id, tokens=promoted,
+                pages=len(ticket.pages),
+            )
+            logger.info(
+                "request %s: %d prefix tokens promoted from the host KV "
+                "tier (%d already device-resident)",
+                seq.request_id, promoted, ticket.start_tokens,
+            )
+        self._promotions = rest
 
     # ------------------------------------------------------------- step loop
 
@@ -964,6 +1298,10 @@ class LLMEngine:
             # prefill_only means a dispatch is in flight — restoring
             # would rebind runner.caches under it (runner.restore_kv)
             self._drain_swap_ins()
+        if not prefill_only and self.kv_tier is not None:
+            # same clean-boundary contract: the promotion scatter also
+            # rebinds runner.caches (runner.restore_kv_block)
+            self._drain_promotions()
         self.runner.sync_lora(self.lora_manager)
         plan = self.scheduler.schedule(prefill_only=prefill_only)
         if plan is None:
@@ -1174,8 +1512,9 @@ class LLMEngine:
                     continue  # aborted while the ragged dispatch ran
                 if item.is_final and not item.is_decode:
                     # the prompt's K/V is now fully resident: publish
-                    # its pages for prefix reuse
-                    self.scheduler.register_prefix(seq)
+                    # its pages for prefix reuse (device cache + host
+                    # tier demotion)
+                    self._register_prefix(seq)
                 if tok is None:
                     continue  # mid-prompt chunk: nothing emitted yet
                 seqs.append(seq)
@@ -1187,7 +1526,7 @@ class LLMEngine:
                 seq = item.seq
                 if seq.is_finished:
                     continue  # aborted while the packed dispatch ran
-                self.scheduler.register_prefix(seq)
+                self._register_prefix(seq)
                 seqs.append(seq)
                 toks.append([tok])
             return self._process_sampled(seqs, toks)
@@ -1224,8 +1563,8 @@ class LLMEngine:
             if sampled is None:
                 return []  # mid-prompt chunk: nothing emitted yet
             # the prompt's K/V is now fully resident: publish its full
-            # pages for prefix reuse (no-op unless --enable-prefix-caching)
-            self.scheduler.register_prefix(seq)
+            # pages for prefix reuse (device cache + host tier demotion)
+            self._register_prefix(seq)
             return self._process_sampled([seq], [[sampled]])
         outputs = self._process_sampled(plan.seqs, result)
         if prepared is not None and getattr(prepared, "spec_ran", False):

@@ -136,6 +136,30 @@ def resolve_num_blocks(
     return num_blocks
 
 
+def chain_digests(
+    token_ids: list[int],
+    block_size: int,
+    lora_name: Optional[str] = None,
+    max_pages: Optional[int] = None,
+) -> list[bytes]:
+    """The token-chain digests ``match_prefix`` walks, one per full page
+    — shared with the host KV tier (engine/kv_tier.py) so the device
+    cache and the host store can never disagree about what a key means.
+    ``max_pages`` defaults to every FULL page; promotion callers pass
+    ``(len - 1) // block_size`` to honor match_prefix's one-token-short
+    cap."""
+    if max_pages is None:
+        max_pages = len(token_ids) // block_size
+    h = BlockAllocator._chain_seed(lora_name)
+    out: list[bytes] = []
+    for p in range(max_pages):
+        h = BlockAllocator._chain_step(
+            h, tuple(token_ids[p * block_size: (p + 1) * block_size])
+        )
+        out.append(h)
+    return out
+
+
 class BlockAllocator:
     """Refcounted allocator over a fixed pool of KV pages, with optional
     content-addressed prefix caching.
@@ -171,6 +195,18 @@ class BlockAllocator:
         self._block_hash: dict[int, bytes] = {}
         self._cached_free: dict[int, None] = {}  # LRU order: oldest first
         self.prefix_hits = 0  # tokens served from cache (stats/metrics)
+        # cumulative prompt tokens of fresh admissions that consulted the
+        # prefix cache — the denominator of kv_prefix_hit_rate{tier}
+        # (prefix_hits / lookup tokens); fed by the scheduler at
+        # admission and by the host-tier promotion apply (engine/core.py)
+        self.prefix_lookup_tokens = 0
+        # eviction → demotion hook (engine/kv_tier.py, set by the engine
+        # core when the host tier is on): called with (chain_digest,
+        # block) just BEFORE a registered page is reclaimed and its hash
+        # dropped — the one moment device content is about to vanish.
+        # The hook runs under the engine lock (allocate() is only called
+        # from planning/admission), so it may enqueue device gathers.
+        self.evict_hook = None
         # free epochs (chained-decode quarantine, engine/async_llm.py):
         # while a chained wave is in flight its predecessor's stale K/V
         # writes may still land on pages freed by finished/aborted rows,
@@ -197,6 +233,12 @@ class BlockAllocator:
             # reclaim the least-recently-parked cached page
             block = next(iter(self._cached_free))
             del self._cached_free[block]
+            if self.evict_hook is not None:
+                h = self._block_hash.get(block)
+                if h is not None:
+                    # demote instead of vanishing: the host tier copies
+                    # the page before its content is overwritten
+                    self.evict_hook(h, block)
             self._drop_hash(block)
             taken.append(block)
         for block in taken:

@@ -1,0 +1,418 @@
+"""Host-RAM KV tier: a hash-addressed prefix-page store behind the device pool.
+
+The device prefix cache (``kv_cache.BlockAllocator``) is bounded by HBM,
+so fleet-scale system-prompt and RAG-corpus reuse — the dominant sharing
+pattern under heavy multi-tenant traffic — evicts exactly when it
+matters.  This module adds the next level of the memory hierarchy
+(ROADMAP item 4; the same host-registry-feeding-device-slots move the
+paged LoRA pool makes for adapters, engine/adapter_pool.py):
+
+* **Content-hash-addressed page store.**  Every entry is ONE full KV
+  page's host copy (``[L, H, block_size, D]`` per cache), keyed by the
+  SAME token-chain digest ``match_prefix`` walks
+  (``kv_cache.chain_digests``: sha256 over seed ‖ page₀ ‖ … ‖ pageₚ,
+  LoRA-seeded), so device cache and host tier can never disagree about
+  what a key means.  A byte-budgeted LRU (``--kv-host-cache-gb``) bounds
+  host RAM; entries are validated on read (shape/dtype/nbytes) and a
+  corrupt or short entry is dropped, never served.
+* **Demotion (device → host).**  When a prompt's pages become final
+  (prefix registration at prefill commit) or a preemption victim's
+  computed pages are about to free (``core._swap_out_seq`` territory),
+  the engine enqueues a fixed-shape jitted per-page gather
+  (``runner.gather_kv_block`` — the device-side read is ordered before
+  any later overwrite by dispatch order) and hands the device arrays
+  here; the actual device→host copy runs in ``asyncio.to_thread`` under
+  a transfer lock, mirroring the adapter pool's streaming discipline —
+  never a sync copy on the event loop.
+* **Promotion (host → device).**  A prefix-cache miss that the host
+  tier can cover PARKS the request (``Scheduler.kv_gate``, exactly the
+  adapter-pool parking shape: resident work fills the batch on both the
+  bucketed and ragged planners) while the tier assembles the pages and
+  ``device_put``s them off the loop; the engine core then scatters them
+  into freshly allocated pages at a clean dispatch boundary
+  (``runner.restore_kv_block``) and the request resumes prefill AFTER
+  the restored span — the same continuation path a device prefix hit
+  takes.
+* **Cross-restart reuse.**  The store is plain host memory with no
+  reference to the engine that fed it: a supervised rebuild
+  (supervisor/supervisor.py) re-attaches the SURVIVING tier to the
+  replacement engine, so a restarted replica re-serves warm prefixes
+  without recompute; dp replicas share one tier (KV content is a pure
+  function of tokens ‖ adapter ‖ model, so pages demoted by any replica
+  serve all of them).
+
+All store mutations happen on the event-loop thread (or single-threaded
+in offline engines); worker threads only run the device↔host copies.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import time
+from collections import OrderedDict
+from typing import Callable, Optional
+
+import numpy as np
+
+from vllm_tgis_adapter_tpu.logging import init_logger
+
+logger = init_logger(__name__)
+
+
+class _Entry:
+    """One full KV page's host copy."""
+
+    __slots__ = ("k", "v", "nbytes", "stored_at")
+
+    def __init__(self, k: np.ndarray, v: np.ndarray):
+        self.k = k
+        self.v = v
+        self.nbytes = int(k.nbytes) + int(v.nbytes)
+        self.stored_at = time.monotonic()
+
+
+@dataclasses.dataclass
+class PromotionTicket:
+    """One parked request's in-flight host→device prefix restore.
+
+    Created by the scheduler's kv gate (engine/core.py
+    ``_kv_tier_gate``) with the target pages already allocated on the
+    sequence; completed by the tier's assembly task; APPLIED by the
+    engine core at a clean dispatch boundary (``_drain_promotions``) —
+    the scatter rebinds ``runner.caches`` and must not race an in-flight
+    dispatch, the same constraint swap-in has.
+    """
+
+    request_id: str
+    digests: list
+    start_tokens: int  # device-matched span already adopted
+    end_tokens: int  # promotion target; may SHRINK at assembly (LRU race)
+    pages: Optional[list] = None  # [(k_dev, v_dev)] once assembled
+    ready: bool = False
+    failed: bool = False
+    cancelled: bool = False
+
+    def cancel(self) -> None:
+        self.cancelled = True
+
+
+class HostKVTier:
+    """Byte-budgeted LRU of hash-addressed KV pages in host RAM."""
+
+    def __init__(self, budget_bytes: int, block_size: int):
+        self.budget_bytes = int(budget_bytes)
+        self.block_size = block_size
+        # digest -> entry; LRU order, oldest first
+        self._entries: "OrderedDict[bytes, _Entry]" = OrderedDict()
+        self.bytes_used = 0
+        # all pages of one engine config share a shape; pinned on first
+        # insert so corrupt entries are detectable on read
+        self._expected: Optional[tuple] = None
+        # digests with a demotion copy in flight: dedups repeat gathers
+        # of a hot prefix while its first copy still streams
+        self._inflight: set[bytes] = set()
+        # demotion backpressure: gathered device-side page copies live
+        # OUTSIDE the KV pool's budget until the worker thread drains
+        # them, so sustained eviction churn must not queue faster than
+        # the serialized host copy drains — past this bound demotions
+        # DROP (a dropped demotion is only a future cache miss)
+        self.max_inflight_demotion_bytes = min(
+            self.budget_bytes, 64 << 20
+        )
+        self._inflight_bytes = 0
+        self.demotions_dropped = 0
+        # serializes device↔host copies (adapter_pool's stream-lock
+        # discipline): demotions and promotion assemblies never compete
+        # for host-transfer bandwidth
+        self._transfer_lock = asyncio.Lock()
+        # strong refs to in-flight demote/promote tasks: the event loop
+        # holds only WEAK task references, so an unreferenced transfer
+        # task could be garbage-collected mid-flight (a lost promotion
+        # would leave its request parked forever).  Mirrors
+        # AdapterPool._streaming; close() cancels through this set.
+        self._tasks: set = set()
+        self._closed = False
+        # lifetime stats (debug_state / bench stamps)
+        self.demoted_pages = 0
+        self.promoted_pages = 0
+        self.promoted_tokens = 0
+        self.evictions = 0
+        self.dropped_corrupt = 0
+
+    # ------------------------------------------------------------- lookups
+
+    def has(self, digest: bytes) -> bool:
+        """Committed OR in-flight: the engine uses this to skip duplicate
+        demotion gathers, so an in-flight copy counts."""
+        return digest in self._entries or digest in self._inflight
+
+    def peek_pages(self, digests: list) -> int:
+        """Consecutive committed pages from ``digests[0]`` — the
+        promotion-coverage probe (read-only, no LRU touch: mirrors
+        ``BlockAllocator.peek_prefix``'s pure-walk contract)."""
+        n = 0
+        for digest in digests:
+            if digest not in self._entries:
+                break
+            n += 1
+        return n
+
+    def peek_prefix_pages(
+        self,
+        token_ids: list,
+        lora_name=None,  # noqa: ANN001 — Optional[str]
+        start_page: int = 0,
+    ) -> int:
+        """Incremental chain walk: committed pages covering
+        ``token_ids`` from ``start_page`` on, hashing only as far as
+        entries exist.  The common cold-tier miss costs
+        ``start_page + 1`` hashes instead of one per prompt page —
+        this is the admission/placement hot-path probe; callers that
+        need the digests themselves (ticket construction) re-derive
+        exactly the covered span via ``kv_cache.chain_digests``.
+        Capped one token short of the prompt, like ``match_prefix``."""
+        from vllm_tgis_adapter_tpu.engine.kv_cache import BlockAllocator
+
+        bs = self.block_size
+        max_pages = (len(token_ids) - 1) // bs
+        h = BlockAllocator._chain_seed(lora_name)  # noqa: SLF001
+        matched = 0
+        for p in range(max_pages):
+            h = BlockAllocator._chain_step(  # noqa: SLF001
+                h, tuple(token_ids[p * bs: (p + 1) * bs])
+            )
+            if p < start_page:
+                continue  # chain continuity only; not probed
+            if h not in self._entries:
+                break
+            matched += 1
+        return matched
+
+    def _get_valid(self, digest: bytes) -> Optional[_Entry]:
+        """Entry for ``digest`` with its integrity verified; a corrupt or
+        short entry is DROPPED (never served) and reads as a miss."""
+        entry = self._entries.get(digest)
+        if entry is None:
+            return None
+        exp = self._expected
+        ok = (
+            exp is not None
+            and getattr(entry.k, "shape", None) == exp[0]
+            and getattr(entry.k, "dtype", None) == exp[1]
+            and getattr(entry.v, "shape", None) == exp[2]
+            and getattr(entry.v, "dtype", None) == exp[3]
+            and entry.nbytes == int(entry.k.nbytes) + int(entry.v.nbytes)
+        )
+        if not ok:
+            logger.warning(
+                "kv host tier: dropping corrupt entry (shape/dtype/size "
+                "mismatch) instead of serving it"
+            )
+            self._entries.pop(digest, None)
+            self.bytes_used -= entry.nbytes
+            self.dropped_corrupt += 1
+            self._observe_bytes()
+            return None
+        self._entries.move_to_end(digest)  # LRU touch
+        return entry
+
+    # ------------------------------------------------------------ demotion
+
+    def submit(self, batch: list) -> None:
+        """Accept ``[(digest, k_dev, v_dev), ...]`` freshly gathered
+        device pages.  The device→host copy (``np.asarray``) runs in a
+        worker thread under the transfer lock; entries commit to the LRU
+        back on the loop.  Offline engines (no running loop) copy
+        inline."""
+        if self._closed or not batch:
+            return
+        batch_bytes = sum(
+            int(k.nbytes) + int(v.nbytes) for _, k, v in batch
+        )
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            loop = None
+        if (
+            loop is not None
+            and self._inflight_bytes + batch_bytes
+            > self.max_inflight_demotion_bytes
+        ):
+            # backlogged: drop rather than accumulate device copies
+            # outside the pool's budget while the transfer lock drains
+            self.demotions_dropped += len(batch)
+            return
+        for digest, _, _ in batch:
+            self._inflight.add(digest)
+        if loop is None:
+            self._insert(self._to_host(batch))
+            return
+        self._inflight_bytes += batch_bytes
+        self._retain(loop.create_task(
+            self._demote_async(batch, batch_bytes),
+            name="kv-tier-demote",
+        ))
+
+    def _retain(self, task) -> None:  # noqa: ANN001 — asyncio.Task
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+
+    async def _demote_async(self, batch: list, batch_bytes: int) -> None:
+        try:
+            async with self._transfer_lock:
+                host = await asyncio.to_thread(self._to_host, batch)
+        except Exception:
+            logger.exception("kv host tier: demotion copy failed")
+            for digest, _, _ in batch:
+                self._inflight.discard(digest)
+            return
+        finally:
+            self._inflight_bytes -= batch_bytes
+        self._insert(host)
+
+    @staticmethod
+    def _to_host(batch: list) -> list:
+        """Worker-thread half: materialise the gathered device pages."""
+        return [
+            (digest, np.asarray(k_dev), np.asarray(v_dev))
+            for digest, k_dev, v_dev in batch
+        ]
+
+    def _insert(self, host_batch: list) -> None:
+        for digest, k, v in host_batch:
+            self._inflight.discard(digest)
+            if self._closed or digest in self._entries:
+                continue
+            entry = _Entry(k, v)
+            if self._expected is None:
+                self._expected = (k.shape, k.dtype, v.shape, v.dtype)
+            if entry.nbytes > self.budget_bytes:
+                continue  # a single page over budget can never fit
+            while (
+                self.bytes_used + entry.nbytes > self.budget_bytes
+                and self._entries
+            ):
+                _, victim = self._entries.popitem(last=False)
+                self.bytes_used -= victim.nbytes
+                self.evictions += 1
+                self._count_eviction()
+            self._entries[digest] = entry
+            self.bytes_used += entry.nbytes
+            self.demoted_pages += 1
+        self._observe_bytes()
+
+    # ----------------------------------------------------------- promotion
+
+    def start_promotion(self, ticket: PromotionTicket, put_fn: Callable) -> None:
+        """Assemble the ticket's pages and ``device_put`` them off the
+        loop; ``ticket.ready`` flips once the device arrays are staged
+        (the engine core applies them at the next clean boundary).
+        Offline engines assemble inline."""
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            loop = None
+        if loop is None:
+            self._finish_assembly(
+                ticket, self._stage(self._collect(ticket), put_fn)
+            )
+            return
+        self._retain(loop.create_task(
+            self._assemble(ticket, put_fn),
+            name=f"kv-tier-promote-{ticket.request_id}",
+        ))
+
+    def _collect(self, ticket: PromotionTicket) -> list:
+        """Longest still-valid prefix of the ticket's entries (host
+        references; loop-thread dict reads only)."""
+        pages = []
+        for digest in ticket.digests:
+            entry = self._get_valid(digest)
+            if entry is None:
+                break
+            pages.append((entry.k, entry.v))
+        return pages
+
+    @staticmethod
+    def _stage(pages: list, put_fn: Callable) -> list:
+        """Worker-thread half: host→device transfer of the assembled
+        pages (the promotion's only bulk transfer)."""
+        return [(put_fn(k), put_fn(v)) for k, v in pages]
+
+    async def _assemble(self, ticket: PromotionTicket, put_fn: Callable) -> None:
+        pages = self._collect(ticket)  # on loop: validated dict reads
+        try:
+            async with self._transfer_lock:
+                staged = await asyncio.to_thread(self._stage, pages, put_fn)
+        except Exception:
+            logger.exception(
+                "kv host tier: promotion staging for %r failed",
+                ticket.request_id,
+            )
+            ticket.failed = True
+            ticket.ready = True
+            return
+        self._finish_assembly(ticket, staged)
+
+    def _finish_assembly(self, ticket: PromotionTicket, staged: list) -> None:
+        if not staged:
+            # every entry evicted (or invalidated) between the gate's
+            # peek and assembly: the request un-parks and recomputes
+            ticket.failed = True
+        else:
+            ticket.pages = staged
+            # the coverage may have SHRUNK if the LRU evicted tail
+            # entries mid-flight; the apply scatters only what survived
+            ticket.end_tokens = (
+                ticket.start_tokens + len(staged) * self.block_size
+            )
+        ticket.ready = True
+
+    def note_promoted(self, pages: int, tokens: int) -> None:
+        """Apply-time accounting (the engine core is the one applier)."""
+        self.promoted_pages += pages
+        self.promoted_tokens += tokens
+
+    # ------------------------------------------------------------ lifecycle
+
+    def close(self) -> None:
+        self._closed = True
+        for task in list(self._tasks):
+            task.cancel()
+        self._entries.clear()
+        self.bytes_used = 0
+
+    # ------------------------------------------------------------- metrics
+
+    def _observe_bytes(self) -> None:
+        try:
+            from vllm_tgis_adapter_tpu import metrics
+
+            metrics.kv_host_tier_bytes.set(self.bytes_used)
+        except Exception:  # pragma: no cover — telemetry must not raise
+            pass
+
+    @staticmethod
+    def _count_eviction() -> None:
+        try:
+            from vllm_tgis_adapter_tpu import metrics
+
+            metrics.kv_host_tier_evictions_total.inc()
+        except Exception:  # pragma: no cover — telemetry must not raise
+            pass
+
+    def debug_state(self) -> dict:
+        """``kv_host_tier`` section of the /debug/state snapshot."""
+        return {
+            "budget_bytes": self.budget_bytes,
+            "bytes_used": self.bytes_used,
+            "pages": len(self._entries),
+            "inflight_demotions": len(self._inflight),
+            "demoted_pages": self.demoted_pages,
+            "demotions_dropped": self.demotions_dropped,
+            "promoted_pages": self.promoted_pages,
+            "promoted_tokens": self.promoted_tokens,
+            "evictions": self.evictions,
+            "dropped_corrupt": self.dropped_corrupt,
+        }

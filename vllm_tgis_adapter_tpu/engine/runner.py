@@ -420,6 +420,12 @@ class ModelRunner:
         self.spec = None
         # --swap-space: donated jitted scatter, built on first swap-in
         self._restore_kv_fn = None
+        # host KV tier (engine/kv_tier.py): fixed-block-shape gather /
+        # scatter programs, built on first demotion / promotion — ONE
+        # compile shape each (slots is always block_size), so the tier
+        # adds zero shapes to the serving lattice past its first use
+        self._gather_kv_fn = None
+        self._block_scatter_fn = None
 
     def attach_speculative(self, draft_model, draft_params) -> None:  # noqa: ANN001
         from vllm_tgis_adapter_tpu.engine.speculative import (
@@ -686,6 +692,55 @@ class ModelRunner:
             k_cache, v_cache, jnp.asarray(idx),
             self._put(np.pad(np.asarray(k_host), pad)),
             self._put(np.pad(np.asarray(v_host), pad)),
+        )
+
+    # ------------------------------------------------------- host KV tier
+
+    @staticmethod
+    def _gather_kv(k_cache, v_cache, idx):  # noqa: ANN001, ANN205
+        return (
+            jnp.take(k_cache, idx, axis=2),
+            jnp.take(v_cache, idx, axis=2),
+        )
+
+    def gather_kv_block(self, slots: list[int]) -> tuple:
+        """Enqueue a device-side gather of ONE page's slots for host-tier
+        demotion (engine/kv_tier.py).  Returns DEVICE arrays without
+        blocking — the tier's worker thread does the device→host copy —
+        and the gather is ordered before any later dispatch that could
+        overwrite the page, so the content read is the content current
+        at enqueue even if the page is reclaimed immediately after.
+        ``slots`` is always exactly block_size long: one compiled shape,
+        forever."""
+        if self._gather_kv_fn is None:
+            self._gather_kv_fn = track_jit(
+                "gather_kv",
+                jax.jit(self._gather_kv),
+                label=lambda args, kwargs: f"slots={args[2].shape[0]}",
+            )
+        k_cache, v_cache = self.caches
+        return self._gather_kv_fn(
+            k_cache, v_cache, jnp.asarray(slots, jnp.int32)
+        )
+
+    def restore_kv_block(self, slots: list[int], k_dev, v_dev) -> None:
+        """Scatter one promoted page into its freshly allocated slots
+        (host-tier promotion apply).  Same clean-dispatch-boundary
+        contract as ``restore_kv`` (the functional update rebinds
+        ``self.caches``); the inputs are already device-resident (the
+        tier's assembly thread staged them), so the loop-side cost is
+        one jitted dispatch.  Fixed [block_size] index shape: one
+        compiled program covers every promotion."""
+        if self._block_scatter_fn is None:
+            donate = (0, 1) if jax.default_backend() == "tpu" else ()
+            self._block_scatter_fn = track_jit(
+                "scatter_kv",
+                jax.jit(self._scatter_kv, donate_argnums=donate),
+                label=lambda args, kwargs: f"slots={args[2].shape[0]}",
+            )
+        k_cache, v_cache = self.caches
+        self.caches = self._block_scatter_fn(
+            k_cache, v_cache, jnp.asarray(slots, jnp.int32), k_dev, v_dev
         )
 
     # --------------------------------------------------------------- prefill

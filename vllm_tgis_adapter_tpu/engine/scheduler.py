@@ -197,6 +197,14 @@ class Scheduler:
         # the batch on the transfer.  None (legacy / no LoRA) admits
         # everything.
         self.lora_gate = None
+        # host-KV-tier gate (engine/kv_tier.py, set by the engine core
+        # when --kv-host-cache-gb > 0): gate(seq, start) -> bool.  False
+        # means the request's prompt prefix is being promoted host →
+        # device — it PARKS in `waiting` (adapter-pool style) and
+        # planning serves other work until the restored pages land.
+        # ``start=False`` is a pure probe (no promotion started) for
+        # chained-decode admissibility checks.
+        self.kv_gate = None
 
     # ------------------------------------------------------------ bookkeeping
 
@@ -260,6 +268,12 @@ class Scheduler:
 
     def finish(self, seq: Sequence) -> None:
         """Release a sequence's device resources (idempotent)."""
+        ticket = getattr(seq, "kv_promotion", None)
+        if ticket is not None:
+            # abort/preemption mid-promotion: the apply must never
+            # scatter into pages this release is about to free
+            ticket.cancel()
+            seq.kv_promotion = None
         if seq in self.running:
             self.running.remove(seq)
         if seq.slot >= 0:
@@ -287,6 +301,17 @@ class Scheduler:
     def _lora_ready(self, seq: Sequence) -> bool:
         return self.lora_gate is None or self.lora_gate(seq)
 
+    def _tier_ready(self, seq: Sequence) -> bool:
+        """May ``seq`` admit now, or should it park for a host-tier
+        prefix promotion?  Calling this may START a promotion (pages
+        allocated, transfer scheduled) — planning paths only."""
+        return self.kv_gate is None or self.kv_gate(seq)
+
+    def _tier_ready_peek(self, seq: Sequence) -> bool:
+        """Pure probe: False only while a promotion is in flight.  Never
+        starts one — safe from chained-decode admissibility checks."""
+        return self.kv_gate is None or self.kv_gate(seq, start=False)
+
     def _lora_standin(self) -> Optional[Sequence]:
         """First fresh, adapter-ready waiting candidate behind a parked
         head (bounded scan; no queue mutation) — the ONE predicate both
@@ -303,7 +328,10 @@ class Scheduler:
                 or seq.prefill_pos != 0
             ):
                 continue
-            if self._lora_ready(seq):
+            # peek-only tier probe: a standin scan must not fan out
+            # promotion starts down the queue (fresh candidates without
+            # a ticket always pass it)
+            if self._lora_ready(seq) and self._tier_ready_peek(seq):
                 return seq
         return None
 
@@ -444,6 +472,10 @@ class Scheduler:
                 or seq.lora_slot != head.seq.lora_slot
             ):
                 continue
+            if not self._tier_ready(seq):
+                # host-tier coverage beats packing: the candidate parks
+                # for promotion instead of recomputing its prefix here
+                continue
             token_ids = seq.all_token_ids
             new_total = total + len(token_ids)
             if (
@@ -508,16 +540,25 @@ class Scheduler:
             # e.g. during async prefill_only planning — would forfeit
             # the saved KV
             return None
-        if (
+        if seq.kv_promotion is not None or (
             seq.blocks is None
             and seq.prefill_pos == 0
-            and not self._lora_ready(seq)
+            and not (self._lora_ready(seq) and self._tier_ready(seq))
         ):
-            # head parked on adapter streaming (mid-chunk heads hold a
-            # pin and are always resident): serve resident-adapter work
-            # around it instead of stalling admissions on the transfer
+            # head parked on adapter streaming or a host-tier prefix
+            # promotion (mid-chunk heads hold a pin and are always
+            # resident): serve ready work around it instead of stalling
+            # admissions on the transfer.  The kv_promotion check comes
+            # FIRST: a promoting head already holds its target pages, so
+            # falling through to first-chunk admission would clobber
+            # them with a fresh SequenceBlocks.
             seq = self._promote_lora_ready()
             if seq is None:
+                return None
+            if not self._tier_ready(seq):
+                # the standin's own prefix turned out to be host-tier
+                # resident: it parks (now at the head, ticket attached)
+                # and the next planning pass scans for ready work again
                 return None
         first_chunk = seq.prefill_pos == 0
         if first_chunk and not self._free_slots:
@@ -585,6 +626,11 @@ class Scheduler:
             # count cache hits only once admission actually succeeded
             # (a rolled-back admission re-matches on its next attempt)
             self.allocator.prefix_hits += seq.prefill_pos
+            self.allocator.prefix_lookup_tokens += total
+            if seq.prefill_pos:
+                metrics.kv_prefix_tokens_reused_total.labels(
+                    tier="device"
+                ).inc(seq.prefill_pos)
 
         plan = PrefillPlan(
             seq=seq,
@@ -782,6 +828,12 @@ class Scheduler:
                 # fills with resident-adapter work — batch composition
                 # prefers residency so churn cannot thrash the pool
                 continue
+            if not self._tier_ready(seq):
+                # host-tier promotion in flight (or just started): the
+                # row parks and the bucket fills with resident work —
+                # the SAME parking shape the adapter gate uses, on the
+                # ragged planner too
+                continue
             first = seq.prefill_pos == 0 and seq.blocks is None
             matched = 0
             if first:
@@ -880,6 +932,11 @@ class Scheduler:
                 seq.blocks.ensure_capacity(n_total)
                 seq.slot = self._free_slots.pop()
                 self.allocator.prefix_hits += seq.prefill_pos
+                self.allocator.prefix_lookup_tokens += n_total
+                if seq.prefill_pos:
+                    metrics.kv_prefix_tokens_reused_total.labels(
+                        tier="device"
+                    ).inc(seq.prefill_pos)
             if n_total - seq.prefill_pos <= 0:
                 # mirrors phase 1's remaining<=0 guard: a waiting row
                 # whose prompt is somehow fully prefilled re-runs its
@@ -1032,11 +1089,14 @@ class Scheduler:
         if not self.waiting:
             return False
         seq = self.waiting[0]
-        if not self._lora_ready(seq):
-            # a head parked on adapter streaming cannot progress; the
-            # first adapter-ready candidate in scan range stands in (it
-            # is what schedule() would promote) — none ready means
-            # chaining is free throughput
+        if not self._lora_ready(seq) or not self._tier_ready_peek(seq):
+            # a head parked on adapter streaming or a host-tier prefix
+            # promotion cannot progress; the first ready candidate in
+            # scan range stands in (it is what schedule() would promote)
+            # — none ready means chaining is free throughput.  The tier
+            # probe is peek-only: admissibility checks must not START
+            # promotions (they run between chained waves, possibly
+            # inside an open free epoch).
             seq = self._lora_standin()
             if seq is None:
                 return False

@@ -110,6 +110,11 @@ class Sequence:
         # nbytes); restored into fresh pages on re-admission instead of
         # recompute-prefill.  None = recompute path.
         self.swapped: Optional[tuple] = None
+        # host-KV-tier promotion in flight (engine/kv_tier.py
+        # PromotionTicket): while set the request PARKS in the waiting
+        # queue (target pages allocated, host→device transfer running);
+        # cleared when the engine core applies or cancels the restore.
+        self.kv_promotion = None
         self.detokenizer: Optional["IncrementalDetokenizer"] = None
         # for DELTA streams: what has already been emitted
         self._emitted_text_len = 0

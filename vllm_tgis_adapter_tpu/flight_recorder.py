@@ -57,6 +57,11 @@ EVENT_KINDS = (
     "preempt",        # KV pool ran dry; victim evicted
     "swap_out",       # victim's KV copied to host (--swap-space)
     "swap_in",        # sequence restored from host KV copy
+    "demote_host",    # full KV pages queued into the host tier
+    #                   (--kv-host-cache-gb: prefix registration or
+    #                   preemption; detail carries the page count)
+    "promote_host",   # host-tier pages restored to device and the
+    #                   parked request resumed (detail: tokens, pages)
     "finish",         # request completed (stop/length)
     "abort",          # request aborted by the client
     "shed",           # admission control refused/expired the request

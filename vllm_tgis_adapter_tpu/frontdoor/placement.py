@@ -49,6 +49,11 @@ POLICIES = (POLICY_PREFIX, POLICY_ADAPTER, POLICY_TENANT, POLICY_LOAD)
 # bench attribution); one sample ~= one committed dispatch
 _EWMA_ALPHA = 0.3
 
+# host-tier residency scores below device residency (a promotion still
+# pays a host→device transfer; an adopted device page is free): one
+# host-resident token is worth this fraction of a device-resident one
+HOST_TIER_WEIGHT = 0.25
+
 
 @dataclasses.dataclass
 class ReplicaSnapshot:
@@ -63,6 +68,11 @@ class ReplicaSnapshot:
     index: int
     load: float
     prefix_tokens: int = 0
+    # prompt tokens the HOST KV tier could promote for this request
+    # (engine/kv_tier.py; the tier is fleet-shared, so the caller stamps
+    # the same value on every snapshot) — scored at a lower weight than
+    # device residency: a promotion still pays a host→device transfer
+    host_prefix_tokens: int = 0
     # this request's LoRA adapter is live in the replica's device pool
     # (engine/adapter_pool.py) — TRUE residency, read at decision time,
     # unlike the sticky map which only remembers past placements
@@ -162,10 +172,21 @@ class PlacementRouter:
 
         chosen: Optional[ReplicaSnapshot] = None
         policy = POLICY_LOAD
+
         # 1. prefix affinity: the most resident prompt tokens wins,
-        # provided that replica is not already over the load slack
+        # provided that replica is not already over the load slack.
+        # Host-tier residency counts at HOST_TIER_WEIGHT below device
+        # residency (docs/SCALING.md) — but only as an EXTENSION of a
+        # device match: the tier is fleet-shared, so host-only coverage
+        # carries no replica-discriminating information and must not
+        # claim the prefix policy ahead of adapter/tenant affinity
+        # (step 2c below is its weaker, post-affinity slot).
+        def prefix_score(s: ReplicaSnapshot) -> float:
+            host_extra = max(0, s.host_prefix_tokens - s.prefix_tokens)
+            return s.prefix_tokens + HOST_TIER_WEIGHT * host_extra
+
         prefix_best = max(
-            eligible, key=lambda s: (s.prefix_tokens, -s.load, -s.index)
+            eligible, key=lambda s: (prefix_score(s), -s.load, -s.index)
         )
         if prefix_best.prefix_tokens > 0:
             chosen, policy = prefix_best, POLICY_PREFIX
@@ -186,6 +207,16 @@ class PlacementRouter:
                     if s.index == sticky_idx:
                         chosen, policy = s, POLICY_TENANT
                         break
+        # 2c. host-only prefix coverage: every eligible replica can
+        # promote the shared tier's pages equally, so take the least
+        # loaded — still a prefix placement (the request skips the
+        # prefill recompute), just subordinate to every affinity that
+        # actually distinguishes replicas
+        if chosen is None:
+            hosted = [s for s in eligible if s.host_prefix_tokens > 0]
+            if hosted:
+                chosen = min(hosted, key=lambda s: (s.load, s.index))
+                policy = POLICY_PREFIX
         # 3. least-loaded fallback; committed-rate EWMA breaks depth
         # ties toward the replica currently grinding fewer tokens
         if chosen is None:

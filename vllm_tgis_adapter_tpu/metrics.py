@@ -120,21 +120,60 @@ prefix_cache_hit_tokens = _get_or_create(
 
 # ---- --swap-space host KV swap (engine/core.py): preemption victims'
 # pages copied to host and restored on re-admission instead of
-# recompute-prefill
+# recompute-prefill.  Per dp replica (PR 7 gave the other engine
+# counters the label; these two were left scribbling one shared series).
 kv_swap_out_total = _get_or_create(
     Counter,
     f"{_PREFIX}_kv_swap_out_total",
-    "Preempted sequences whose KV pages were swapped to host memory",
+    "Preempted sequences whose KV pages were swapped to host memory, "
+    "per dp replica",
+    labelnames=("replica",),
 )
 kv_swap_in_total = _get_or_create(
     Counter,
     f"{_PREFIX}_kv_swap_in_total",
-    "Sequences restored from host KV swap instead of recompute-prefill",
+    "Sequences restored from host KV swap instead of recompute-prefill, "
+    "per dp replica",
+    labelnames=("replica",),
 )
 kv_swap_used_bytes = _get_or_create(
     Gauge,
     f"{_PREFIX}_kv_swap_used_bytes",
     "Host bytes currently held by swapped-out KV copies",
+)
+
+
+# ---- tiered KV store (--kv-host-cache-gb, engine/kv_tier.py): the
+# host-RAM hash-addressed prefix cache behind the device pool
+# (docs/KV_TIERING.md).  Hit rate is tokens served from each tier over
+# prompt tokens that consulted the prefix cache, cumulative per replica.
+kv_prefix_hit_rate = _get_or_create(
+    Gauge,
+    f"{_PREFIX}_kv_prefix_hit_rate",
+    "Fraction of prefix-cache-consulting prompt tokens served from each "
+    "tier (tier=device: pages adopted from the device prefix cache; "
+    "tier=host: pages promoted from the host-RAM KV tier), cumulative "
+    "per dp replica",
+    labelnames=("tier", "replica"),
+)
+kv_prefix_tokens_reused_total = _get_or_create(
+    Counter,
+    f"{_PREFIX}_kv_prefix_tokens_reused_total",
+    "Prompt tokens whose KV was reused instead of recomputed, by the "
+    "tier that served them (device = prefix-cache adoption, host = "
+    "host-tier promotion)",
+    labelnames=("tier",),
+)
+kv_host_tier_bytes = _get_or_create(
+    Gauge,
+    f"{_PREFIX}_kv_host_tier_bytes",
+    "Host bytes held by the hash-addressed KV tier "
+    "(--kv-host-cache-gb budget; shared across dp replicas)",
+)
+kv_host_tier_evictions_total = _get_or_create(
+    Counter,
+    f"{_PREFIX}_kv_host_tier_evictions_total",
+    "KV pages evicted from the host tier's byte-budgeted LRU",
 )
 
 

@@ -499,6 +499,13 @@ class EngineSupervisor:
         new._devices = old._devices  # noqa: SLF001
         if draft is not None:
             new.runner.attach_speculative(*draft)
+        # the host KV tier SURVIVES the restart (it is host memory, not
+        # part of the dead engine): the replacement adopts it, so warm
+        # prefixes promote instead of recomputing — in-flight tickets
+        # stay with the dead engine (their target pages died with its
+        # pool) and are simply never applied (docs/KV_TIERING.md)
+        if old.kv_tier is not None:
+            new.adopt_kv_tier(old.kv_tier)
         return new
 
     # ------------------------------------------------------------ escalation

@@ -214,6 +214,18 @@ def add_engine_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         "a preempted decode's pages swap to host and "
                         "restore on re-admission instead of recomputing "
                         "the whole prefill (0 = recompute only)")
+    g.add_argument("--kv-host-cache-gb", type=float, default=4.0,
+                   help="GiB of host RAM for the tiered KV store "
+                        "(docs/KV_TIERING.md): full prompt pages demote "
+                        "to a hash-addressed host cache when they are "
+                        "registered or evicted, and prefix misses the "
+                        "tier can cover promote back asynchronously — "
+                        "cross-request AND cross-restart prefix reuse "
+                        "beyond HBM.  The served default is on; library "
+                        "constructions default off")
+    g.add_argument("--no-kv-host-cache", action="store_true",
+                   help="disable the host KV tier entirely "
+                        "(pre-tier engine behavior, byte-identical)")
     g.add_argument("--enforce-eager", action="store_true",
                    help="accepted for compatibility; the TPU engine always "
                         "compiles with XLA")
