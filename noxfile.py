@@ -12,7 +12,7 @@ import nox
 
 nox.options.sessions = (
     "lint", "tpulint", "typecheck", "tests", "overload_check", "chaos_check",
-    "perf_check",
+    "chaos_soak", "perf_check",
 )
 nox.options.reuse_existing_virtualenvs = True
 
@@ -71,8 +71,11 @@ def chaos_check(session: nox.Session) -> None:
     """Failpoint-driven recovery gate (docs/RECOVERY.md): inject
     step-loop crashes, OOMs, stuck dispatches, and death-during-recovery
     through supervisor/failpoints.py and assert the supervisor replays
-    pre-prefill work losslessly, fails mid-decode retryable, re-arms
-    health, and trips the crash-loop circuit breaker.  Includes the dp
+    pre-prefill work losslessly, checkpoints mid-decode work into the
+    host KV tier and resumes it token-identically (locally and onto a
+    healthy dp sibling; retryable failure only down the degradation
+    ladder), re-arms health, and trips the crash-loop circuit breaker.
+    Includes the dp
     partial-outage scenario (docs/SCALING.md): a replica dying mid-load
     replays its zero-token requests token-identically onto a healthy
     sibling while that sibling's TTFT stays bounded; and the adapter-
@@ -89,6 +92,25 @@ def chaos_check(session: nox.Session) -> None:
         "pytest", "tests/test_supervisor.py", "tests/test_adapter_pool.py",
         "tests/test_kv_tier.py",
         "-q",
+        *session.posargs,
+        env={"JAX_PLATFORMS": "cpu"},
+    )
+
+
+@nox.session(python="3.12")
+def chaos_soak(session: nox.Session) -> None:
+    """Randomized chaos soak (docs/RECOVERY.md): a seeded schedule
+    draws faults (raise/oom/hang) across the failpoint sites under
+    mixed chat/RAG/LoRA load on a supervised, KV-tiered engine (some
+    seeds dp=2) and asserts the global recovery invariants — every
+    request exactly one terminal outcome, no harness-bound hangs,
+    resumed outputs token-identical to the uncrashed baseline, zero
+    new checkpoint/resume compile shapes.  N >= 5 seeds, bounded
+    ~120 s; reproduce one schedule with
+    `python tools/chaos_soak.py --seed <n>`."""
+    session.install("-e", ".[tests]")
+    session.run(
+        "python", "tools/chaos_soak.py",
         *session.posargs,
         env={"JAX_PLATFORMS": "cpu"},
     )

@@ -398,6 +398,12 @@ def test_tier_off_is_pre_tier_engine(tiny_model_dir):
     assert EngineConfig.from_args(args).kv_host_cache_gb == 0.0
     args = make_parser().parse_args(["--model", tiny_model_dir])
     assert EngineConfig.from_args(args).kv_host_cache_gb == 4.0
+    # --no-decode-resume: the mid-decode checkpoint/resume escape hatch
+    assert EngineConfig.from_args(args).decode_resume is True
+    args = make_parser().parse_args(
+        ["--model", tiny_model_dir, "--no-decode-resume"]
+    )
+    assert EngineConfig.from_args(args).decode_resume is False
 
 
 def test_placement_scores_host_tier_below_device():
@@ -551,3 +557,111 @@ def test_cross_restart_reuse_from_surviving_tier(tiny_model_dir):
         await engine.stop()
 
     asyncio.run(scenario())
+
+
+# --------------------------------------- decode checkpoints (ISSUE 10)
+
+
+def _ckpt(request_id="r", digests=(), pages=0, **overrides):
+    import dataclasses
+
+    from vllm_tgis_adapter_tpu.engine.kv_tier import DecodeCheckpoint
+
+    base = DecodeCheckpoint(
+        request_id=request_id, prompt=None,
+        prompt_token_ids=[1, 2, 3], output_token_ids=[4, 5],
+        params=None, fallback_seed=7, arrival_time=0.0, deadline=None,
+        tenant_id=None, lora_name=None, trace_id=None,
+        emitted_token_len=2, emitted_text_len=0, stop_scan_pos=0,
+        output_logprobs=None, prompt_logprobs=None,
+        first_scheduled_time=None, first_token_time=None,
+        last_token_time=None, time_in_queue=None,
+        digests=list(digests), pages=pages,
+    )
+    return dataclasses.replace(base, **overrides) if overrides else base
+
+
+def test_checkpoint_store_stage_validate_pop():
+    """Store units for the mid-decode resume records: staging, the
+    all-pages-committed validation read (corrupt entries read as
+    misses), the trivially-valid zero-page case, and consumption."""
+    tier = _tier()
+    d0, d1 = b"a" * 8, b"b" * 8
+    ckpt = _ckpt(digests=[d0, d1], pages=2)
+    tier.stage_checkpoint(ckpt)
+    assert tier.pending_checkpoints() == [ckpt]
+    assert tier.debug_state()["checkpoints"] == 1
+
+    assert not tier.validate_checkpoint(ckpt)  # nothing committed
+    tier.submit([(d0, *_page(0))])
+    assert not tier.validate_checkpoint(ckpt)  # short by one page
+    tier.submit([(d1, *_page(1))])
+    assert tier.validate_checkpoint(ckpt)
+
+    # a checkpoint with no full page written resumes via recompute —
+    # trivially valid
+    assert tier.validate_checkpoint(_ckpt(request_id="r0"))
+
+    # corrupt entry: validation reads it as a miss (and drops it)
+    tier._entries[d1].k = tier._entries[d1].k[:1]
+    assert not tier.validate_checkpoint(ckpt)
+    assert tier.dropped_corrupt == 1
+
+    assert tier.pop_checkpoint("r") is ckpt
+    assert tier.pop_checkpoint("r") is None
+    assert tier.pending_checkpoints() == []
+
+
+def test_abort_mid_promotion_cancels_ticket_and_frees_kv(tiny_model_dir):
+    """Client-disconnect hardening (ISSUE 10 satellite): aborting a
+    request parked on an IN-FLIGHT promotion cancels its ticket,
+    releases the reserved pages and slot, and a late-completing
+    assembly must not scatter into the freed pages."""
+    eng = _build_engine(tiny_model_dir, tier_gb=1.0)  # 6-page pool
+    from vllm_tgis_adapter_tpu.engine.sampling_params import SamplingParams
+
+    # warm the tier with the shared prefix, then keep its pages OUT of
+    # the device cache so a re-request must promote
+    _run(eng, "warm", SHARED)
+    _run(eng, "f1", FILLER_1)
+    _run(eng, "f2", FILLER_2)
+    # hold the assembly in flight: planning parks the request with a
+    # ticket that never completes until we say so
+    started = []
+    eng.kv_tier.start_promotion = (
+        lambda ticket, put_fn: started.append((ticket, put_fn))
+    )
+    free0 = eng.scheduler.allocator.num_free
+
+    eng.add_request(
+        "re", None,
+        SamplingParams(temperature=0.0, max_tokens=4, ignore_eos=True),
+        prompt_token_ids=SHARED,
+    )
+    outputs, plan, prepared = eng.plan_step()
+    assert plan is None  # parked, nothing else to run
+    assert started, "promotion never started"
+    seq = next(s for s in eng.scheduler.waiting if s.request_id == "re")
+    ticket = seq.kv_promotion
+    assert ticket is not None
+    assert eng.scheduler.allocator.num_free < free0  # pages reserved
+
+    out = eng.abort_request("re")
+    assert out is not None and out.finished
+    assert ticket.cancelled
+    assert seq.kv_promotion is None
+    assert seq.blocks is None
+    assert eng.scheduler.allocator.num_free == free0  # pages returned
+
+    # the assembly completes LATE: the drain must skip the cancelled
+    # ticket instead of scattering into reassigned pages
+    ticket.pages = [(None, None)]
+    ticket.ready = True
+    eng.plan_step()
+    assert eng._promotions == []
+    # the engine is still healthy: fresh work runs to completion
+    # (real promotion machinery restored first — the filler prefix is
+    # host-tiered too and would otherwise park forever on the stub)
+    del eng.kv_tier.start_promotion
+    got = _run(eng, "after", FILLER_1, n=4)
+    assert len(got) == 4

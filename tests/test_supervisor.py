@@ -197,7 +197,7 @@ def _build_engine(tiny_model_dir, *, max_num_seqs=2, num_blocks=64,
                   max_engine_restarts=3, window_s=300.0, backoff_s=0.02,
                   watchdog_deadline_s=0.0, watchdog_action="snapshot",
                   dump_dir=None, frontdoor=None, frontdoor_enabled=True,
-                  dp=1):
+                  dp=1, tier_gb=0.0, decode_resume=True):
     from vllm_tgis_adapter_tpu.engine.async_llm import AsyncLLMEngine
     from vllm_tgis_adapter_tpu.engine.config import (
         CacheConfig,
@@ -220,6 +220,8 @@ def _build_engine(tiny_model_dir, *, max_num_seqs=2, num_blocks=64,
         ),
         parallel_config=ParallelConfig(dp_replicas=dp),
         lora_config=LoRAConfig(),
+        kv_host_cache_gb=tier_gb,
+        decode_resume=decode_resume,
         watchdog_deadline_s=watchdog_deadline_s,
         watchdog_action=watchdog_action,
         dump_dir=dump_dir,
@@ -905,3 +907,368 @@ def test_dp_replica_death_replays_cross_replica_with_bounded_ttft(
     )
     history = engine.supervisor.restart_history
     assert history[-1]["recovered"] and history[-1]["replica"] == victim_idx
+
+
+# ---------------------------------------------- mid-decode checkpoint/resume
+#
+# ISSUE 10 tentpole (docs/RECOVERY.md): with the host KV tier on, engine
+# death no longer costs mid-decode requests — they checkpoint at quiesce
+# (frontier-capped page demotion + a DecodeCheckpoint record) and resume
+# token-identically on the rebuilt engine or a healthy dp sibling, with
+# zero duplicate or missing streamed tokens.  The degradation ladder
+# (tier off = the PR-5 tests above, budget exceeded, --no-decode-resume)
+# keeps the retryable-failure floor.
+
+
+def _delta_params(max_tokens=24, *, seed=None, temperature=0.0):
+    from vllm_tgis_adapter_tpu.engine.sampling_params import (
+        RequestOutputKind,
+        SamplingParams,
+    )
+
+    return SamplingParams(
+        temperature=temperature, seed=seed, max_tokens=max_tokens,
+        ignore_eos=True, output_kind=RequestOutputKind.DELTA,
+    )
+
+
+async def _collect_delta(engine, request_id, prompt_ids, params):
+    """Drive one DELTA stream to the end; returns EVERY streamed token
+    in order (so duplicates and gaps are both visible)."""
+    toks: list[int] = []
+    async for out in engine.generate(
+        prompt=None,
+        sampling_params=params,
+        request_id=request_id,
+        prompt_token_ids=list(prompt_ids),
+    ):
+        toks.extend(out.outputs[0].token_ids)
+    return toks
+
+
+def test_middecode_checkpoint_resume_local_token_identical(tiny_model_dir):
+    """THE local acceptance: a step-loop crash with one greedy and one
+    SEEDED-sampled request mid-decode → both checkpoint into the host
+    tier and resume on the rebuilt engine, streaming exactly the
+    uncrashed token sequence (no duplicates, no gaps — the DELTA frames
+    concatenate to the baseline), with the resume observable in the
+    counters, the restart history, and the flight recorder."""
+    engine = _build_engine(tiny_model_dir, tier_gb=1.0)
+    prompt_g = list(range(3, 21))  # 18 tokens: one full 16-token page
+    prompt_s = list(range(5, 23))
+    n = 48  # long decode: the crash below cannot race the finish
+    resumed0 = _sample(
+        _scrape(), "tgis_tpu_requests_resumed_total", ('path="local"',)
+    )
+    ck0 = _sample(
+        _scrape(), "tgis_tpu_decode_checkpoints_total",
+        ('outcome="resumed"',),
+    )
+
+    async def scenario():
+        # uncrashed baselines on the same engine (greedy is
+        # deterministic; the seeded stream replays per-position draws)
+        ref_g = await _collect_delta(
+            engine, "ref-g", prompt_g, _delta_params(n)
+        )
+        ref_s = await _collect_delta(
+            engine, "ref-s", prompt_s,
+            _delta_params(n, seed=1234, temperature=0.9),
+        )
+        g_task = asyncio.create_task(_collect_delta(
+            engine, "g", prompt_g, _delta_params(n)
+        ))
+        s_task = asyncio.create_task(_collect_delta(
+            engine, "s", prompt_s,
+            _delta_params(n, seed=1234, temperature=0.9),
+        ))
+        # >= 1 COMMITTED (and therefore streamed) token each: the
+        # no-duplicate assertion below covers exactly these tokens.
+        # Waiting for a deeper window is flaky — wave commits land in
+        # bursts while XLA compiles hold the GIL.
+        await _wait_for(
+            lambda: _output_tokens(engine, "g") >= 1
+            and _output_tokens(engine, "s") >= 1,
+            what="both requests mid-decode",
+        )
+        failpoints.arm_site("core.plan_step", "raise", 1)
+        toks_g = await g_task
+        toks_s = await s_task
+        await _wait_for(lambda: engine.lifecycle == "serving",
+                        what="recovery to finish")
+        new_core = engine._replicas[0].engine
+        observed = {
+            "promoted": new_core.kv_host_promoted_tokens,
+            "kinds": {e["kind"] for e in new_core.recorder.events()},
+            "checkpoints_left": len(
+                new_core.kv_tier.pending_checkpoints()
+            ),
+        }
+        await engine.stop()
+        return ref_g, ref_s, toks_g, toks_s, observed
+
+    ref_g, ref_s, toks_g, toks_s, observed = asyncio.run(scenario())
+
+    # token-identical, zero duplicate/missing streamed tokens
+    assert toks_g == ref_g and len(toks_g) == n
+    assert toks_s == ref_s and len(toks_s) == n
+
+    # the resume promoted checkpointed pages back from the tier
+    assert observed["promoted"] > 0
+    assert "resume" in observed["kinds"]
+    assert observed["checkpoints_left"] == 0  # consumed, not leaked
+
+    history = engine.supervisor.restart_history
+    assert history[-1]["recovered"]
+    assert history[-1]["resumed"] == 2
+    assert history[-1]["failed"] == 0
+    assert _sample(
+        _scrape(), "tgis_tpu_requests_resumed_total", ('path="local"',)
+    ) == resumed0 + 2
+    assert _sample(
+        _scrape(), "tgis_tpu_decode_checkpoints_total",
+        ('outcome="resumed"',),
+    ) == ck0 + 2
+
+
+def test_middecode_resume_cross_replica_before_rebuild(tiny_model_dir):
+    """Cross-replica acceptance: a dp sibling resumes the victim's
+    mid-decode request from the SHARED tier BEFORE the victim's rebuild
+    completes (held open with a hang failpoint) — the stream finishes
+    token-identically while the dead replica is still down."""
+    engine = _build_engine(
+        tiny_model_dir, dp=2, max_num_seqs=2, tier_gb=1.0
+    )
+    prompt = list(range(3, 21))
+    n = 48
+    xr0 = _sample(
+        _scrape(), "tgis_tpu_requests_resumed_total",
+        ('path="cross_replica"',),
+    )
+
+    async def scenario():
+        ref = await _collect_delta(
+            engine, "ref", prompt, _delta_params(n)
+        )
+        a_task = asyncio.create_task(_collect_delta(
+            engine, "a", prompt, _delta_params(n)
+        ))
+        await _wait_for(lambda: _output_tokens(engine, "a") >= 1,
+                        what="request a mid-decode")
+        victim = engine._owner["a"]
+        sibling = next(
+            r for r in engine._replicas if r is not victim
+        )
+        # hold the victim's rebuild open, then fault exactly the victim
+        failpoints.arm_site("supervisor.rebuild", "hang")
+
+        def boom(*args, **kwargs):
+            raise RuntimeError("injected victim fault")
+
+        victim.engine.plan_step = boom  # type: ignore[method-assign]
+        toks = await a_task
+        # the stream completed while the victim was still rebuilding
+        mid = {"victim_serving": victim.serving,
+               "lifecycle": engine.lifecycle}
+        failpoints.release("supervisor.rebuild")
+        await _wait_for(
+            lambda: victim.serving
+            and engine.supervisor.restart_history
+            and engine.supervisor.restart_history[-1].get("recovered"),
+            what="victim replica rebuilt",
+        )
+        observed = {
+            "sibling_kinds": {
+                e["kind"] for e in sibling.engine.recorder.events()
+            },
+        }
+        await engine.stop()
+        return ref, toks, mid, observed
+
+    ref, toks, mid, observed = asyncio.run(scenario())
+    assert toks == ref and len(toks) == n
+    # resumed while the victim was down: partial outage, not a pause
+    assert mid["victim_serving"] is False
+    assert mid["lifecycle"] == "serving"
+    assert "resume" in observed["sibling_kinds"]
+    history = engine.supervisor.restart_history
+    assert history[-1]["resumed"] == 1 and history[-1]["failed"] == 0
+    assert _sample(
+        _scrape(), "tgis_tpu_requests_resumed_total",
+        ('path="cross_replica"',),
+    ) == xr0 + 1
+
+
+def _expect_middecode_fallback(tiny_model_dir, engine):
+    """Shared ladder driver: one mid-decode request + a step crash must
+    yield the PR-5 retryable EngineRestartError and a counted fallback."""
+    from vllm_tgis_adapter_tpu.frontdoor.errors import EngineRestartError
+
+    fb0 = _sample(
+        _scrape(), "tgis_tpu_decode_checkpoints_total",
+        ('outcome="fallback"',),
+    )
+
+    async def scenario():
+        task = asyncio.create_task(_collect(
+            engine, "a", prompt_ids=list(range(3, 21)), max_tokens=64
+        ))
+        await _wait_for(lambda: _output_tokens(engine, "a") >= 2,
+                        what="request a mid-decode")
+        failpoints.arm_site("core.plan_step", "raise", 1)
+        status, err = await task
+        await _wait_for(lambda: engine.lifecycle == "serving",
+                        what="recovery to finish")
+        await engine.stop()
+        return status, err
+
+    status, err = asyncio.run(scenario())
+    assert status == "err"
+    assert isinstance(err, EngineRestartError)
+    assert _sample(
+        _scrape(), "tgis_tpu_decode_checkpoints_total",
+        ('outcome="fallback"',),
+    ) == fb0 + 1
+
+
+def test_checkpoint_over_tier_budget_falls_back_retryable(tiny_model_dir):
+    """Ladder rung: a checkpoint whose written KV cannot fit the tier
+    budget keeps today's semantics — retryable failure, counted."""
+    engine = _build_engine(tiny_model_dir, tier_gb=1e-6)  # ~1 KiB
+    _expect_middecode_fallback(tiny_model_dir, engine)
+
+
+def test_no_decode_resume_escape_hatch(tiny_model_dir):
+    """Ladder rung: --no-decode-resume forces the PR-5 floor even with
+    the tier on and healthy."""
+    engine = _build_engine(tiny_model_dir, tier_gb=1.0,
+                           decode_resume=False)
+    _expect_middecode_fallback(tiny_model_dir, engine)
+
+
+def test_disconnect_mid_resume_drops_checkpoint(tiny_model_dir):
+    """Client-disconnect hardening (satellite): a stream that goes away
+    while its checkpoint awaits resume is dropped — the staged record
+    is discarded, no engine state is created, and the rebuilt engine's
+    pool is fully free."""
+    engine = _build_engine(tiny_model_dir, tier_gb=1.0)
+
+    async def scenario():
+        a_task = asyncio.create_task(_collect_delta(
+            engine, "a", list(range(3, 21)), _delta_params(64)
+        ))
+        await _wait_for(lambda: _output_tokens(engine, "a") >= 4,
+                        what="request a mid-decode")
+        tier = engine.engine.kv_tier
+        # hold the rebuild open so the disconnect lands BETWEEN the
+        # checkpoint staging and the resume
+        failpoints.arm_site("supervisor.rebuild", "hang")
+        failpoints.arm_site("core.plan_step", "raise", 1)
+        await _wait_for(lambda: tier.pending_checkpoints(),
+                        what="checkpoint staged")
+        a_task.cancel()  # the client disconnects
+        await asyncio.gather(a_task, return_exceptions=True)
+        failpoints.release("supervisor.rebuild")
+        await _wait_for(
+            lambda: engine.supervisor.restart_history
+            and engine.supervisor.restart_history[-1].get("recovered"),
+            what="recovery to finish",
+        )
+        new_core = engine._replicas[0].engine
+        observed = {
+            "staged": len(tier.pending_checkpoints()),
+            "known": "a" in new_core._seqs,
+            "free": new_core.scheduler.allocator.num_free,
+            "total": new_core.scheduler.allocator.num_blocks,
+        }
+        await engine.stop()
+        return observed
+
+    observed = asyncio.run(scenario())
+    assert observed["staged"] == 0  # dropped, not leaked
+    assert not observed["known"]  # never resumed into the new engine
+    assert observed["free"] == observed["total"]
+
+
+def test_abort_while_checkpointed_delivers_final_frame(tiny_model_dir):
+    """Explicit abort between checkpoint staging and resume: the client
+    gets its final aborted frame immediately and the later resume pass
+    skips the cancelled record."""
+    engine = _build_engine(tiny_model_dir, tier_gb=1.0)
+
+    async def scenario():
+        a_task = asyncio.create_task(_collect(
+            engine, "a", prompt_ids=list(range(3, 21)), max_tokens=64
+        ))
+        await _wait_for(lambda: _output_tokens(engine, "a") >= 4,
+                        what="request a mid-decode")
+        tier = engine.engine.kv_tier
+        failpoints.arm_site("supervisor.rebuild", "hang")
+        failpoints.arm_site("core.plan_step", "raise", 1)
+        await _wait_for(lambda: tier.pending_checkpoints(),
+                        what="checkpoint staged")
+        await engine.abort("a")
+        status, final = await a_task
+        failpoints.release("supervisor.rebuild")
+        await _wait_for(
+            lambda: engine.supervisor.restart_history
+            and engine.supervisor.restart_history[-1].get("recovered"),
+            what="recovery to finish",
+        )
+        new_core = engine._replicas[0].engine
+        observed = {
+            "staged": len(tier.pending_checkpoints()),
+            "known": "a" in new_core._seqs,
+        }
+        await engine.stop()
+        return status, final, observed
+
+    status, final, observed = asyncio.run(scenario())
+    assert status == "ok"
+    assert final.finished
+    assert final.outputs[0].finish_reason == "abort"
+    assert observed["staged"] == 0
+    assert not observed["known"]
+
+
+def test_failed_recovery_attempt_keeps_checkpoints_for_retry(
+    tiny_model_dir,
+):
+    """Death DURING recovery must not lose the attempt's checkpoints:
+    they survive staged in the (surviving) tier, the retry adopts
+    them, and the mid-decode request still resumes token-identically."""
+    engine = _build_engine(tiny_model_dir, tier_gb=1.0,
+                           max_engine_restarts=4)
+    prompt = list(range(3, 21))
+    n = 48
+
+    async def scenario():
+        ref = await _collect_delta(
+            engine, "ref", prompt, _delta_params(n)
+        )
+        a_task = asyncio.create_task(_collect_delta(
+            engine, "a", prompt, _delta_params(n)
+        ))
+        await _wait_for(lambda: _output_tokens(engine, "a") >= 1,
+                        what="request a mid-decode")
+        # the first rebuild dies; the retry must resume from the
+        # checkpoints the failed attempt staged
+        failpoints.arm_site("supervisor.rebuild", "raise", 1)
+        failpoints.arm_site("core.plan_step", "raise", 1)
+        toks = await a_task
+        await _wait_for(lambda: engine.lifecycle == "serving",
+                        what="recovery to finish")
+        staged = len(
+            engine._replicas[0].engine.kv_tier.pending_checkpoints()
+        )
+        await engine.stop()
+        return ref, toks, staged
+
+    ref, toks, staged = asyncio.run(scenario())
+    assert toks == ref and len(toks) == n
+    assert staged == 0  # consumed by the retry, not leaked
+    history = engine.supervisor.restart_history
+    assert len(history) == 2
+    assert history[0]["recovered"] is False
+    assert history[1]["recovered"] is True
+    assert history[1]["resumed"] == 1

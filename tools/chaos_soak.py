@@ -1,0 +1,528 @@
+"""Randomized chaos soak: property-based recovery coverage (nox -s chaos_soak).
+
+``chaos_check`` proves hand-picked recovery scenarios; this harness
+proves the *surface*: a SEEDED schedule draws faults (``raise`` /
+``oom`` / ``hang``) across the failpoint sites while a mixed
+chat/RAG/LoRA workload runs against a supervised engine with the host
+KV tier on (some seeds dp=2), then asserts the global invariants no
+single scenario can (docs/RECOVERY.md "Randomized chaos soak"):
+
+* every submitted request reaches EXACTLY ONE terminal outcome — a
+  completed stream or a typed retryable ``EngineRestartError`` — and
+  nothing outlives the harness bound (no watchdog-visible hangs);
+* every request that completes streams TOKEN-IDENTICAL output to its
+  uncrashed baseline (greedy and seeded-sampled alike, resumed from a
+  decode checkpoint or not), with zero duplicate/missing DELTA tokens;
+* the engine returns to ``serving`` after every injected fault within
+  the bound;
+* checkpoint/resume adds ZERO new compile shapes over the warmed set
+  for its entry points (``gather_kv`` / ``scatter_kv`` ride one fixed
+  block shape each — compile-tracker gated).
+
+Each seed is one reproducible schedule: ``python tools/chaos_soak.py
+--seed 7`` replays exactly what CI saw.  ``--recovery-bench`` runs the
+perf gate instead (tools/perf_check.py ``recovery`` section): one long
+request killed mid-decode must complete, resumed, within
+``max_ratio`` x its uncrashed wall time — with the JAX persistent
+compilation cache on, so the rebuilt engine's recompiles cost what a
+TPU restart with a warm XLA cache pays, not a cold build.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import random
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+DEFAULT_SEEDS = 5
+DEFAULT_BASE_SEED = 20260804
+#: nothing — request, recovery, or drain — may outlive this (the soak's
+#: watchdog bound; the in-engine stall watchdog runs far tighter)
+HARNESS_BOUND_S = 60.0
+#: soft overall budget: exceeded → loud warning, never silent trimming
+BUDGET_S = 120.0
+
+REQUESTS_PER_SEED = 8
+#: the shared "system prompt" RAG requests reuse (tiers + prefix paths)
+RAG_PREFIX = list(range(400, 424))
+
+# (site, action) pool the schedule draws from.  ``hang`` is listed once
+# and only used at dp=1 seeds (the watchdog declares the stall and the
+# supervisor restarts the replica — detection needs the stalled replica
+# to be the one with work, which dp=2 placement makes nondeterministic).
+FAULTS = (
+    ("core.plan_step", "raise"),
+    ("core.commit_step", "raise"),
+    ("core.wait_step", "oom"),
+    ("scheduler.schedule", "raise"),
+    ("runner.dispatch_prefill", "raise"),
+    ("runner.dispatch_decode", "raise"),
+    ("core.wait_step", "hang"),
+    # armed in one round, fires during a LATER round's recovery: the
+    # death-during-recovery retry, which must adopt the failed
+    # attempt's staged checkpoints instead of losing them
+    ("supervisor.rebuild", "raise"),
+)
+
+
+def _build_fixtures() -> tuple[str, str]:
+    """Tiny llama + one live LoRA adapter, built once per process."""
+    from tests.fixture_models import (
+        build_tiny_llama,
+        build_tiny_lora_adapter,
+    )
+
+    model_dir = tempfile.mkdtemp(prefix="chaos-soak-model-")
+    build_tiny_llama(model_dir)
+    adapter_dir = build_tiny_lora_adapter(
+        os.path.join(model_dir, "ad-soak"), seed=11, rank=2
+    )
+    return model_dir, adapter_dir
+
+
+def _build_engine(model_dir: str, *, dp: int, watchdog: bool):
+    from vllm_tgis_adapter_tpu.engine.async_llm import AsyncLLMEngine
+    from vllm_tgis_adapter_tpu.engine.config import (
+        CacheConfig,
+        EngineConfig,
+        FrontdoorConfig,
+        LoRAConfig,
+        ModelConfig,
+        ParallelConfig,
+        SchedulerConfig,
+    )
+
+    mcfg = ModelConfig.from_pretrained(model_dir, dtype="float32")
+    config = EngineConfig(
+        model_config=mcfg,
+        cache_config=CacheConfig(
+            block_size=16, num_blocks=96, cache_dtype=mcfg.dtype,
+            enable_prefix_caching=True,
+        ),
+        scheduler_config=SchedulerConfig(
+            max_num_seqs=4, prefill_buckets=(32, 64)
+        ),
+        parallel_config=ParallelConfig(dp_replicas=dp),
+        lora_config=LoRAConfig(enabled=True, max_loras=2,
+                               max_lora_rank=2),
+        kv_host_cache_gb=1.0,
+        max_engine_restarts=20,
+        engine_restart_window_s=300.0,
+        engine_restart_backoff_s=0.01,
+        # the in-engine stall watchdog is the hang schedule's detector
+        watchdog_deadline_s=1.0 if watchdog else 0.0,
+        watchdog_action="restart",
+        frontdoor=FrontdoorConfig(enabled=True),
+    )
+    return AsyncLLMEngine.from_config(config)
+
+
+def _make_workload(rng: random.Random) -> list[dict]:
+    """REQUESTS_PER_SEED request specs: chat (unique prompts), RAG
+    (shared prefix + unique tail), LoRA-tagged — greedy and
+    seeded-sampled mixed in."""
+    specs = []
+    for i in range(REQUESTS_PER_SEED):
+        kind = ("chat", "rag", "lora")[i % 3]
+        if kind == "rag":
+            prompt = RAG_PREFIX + [
+                rng.randrange(3, 300)
+                for _ in range(rng.randint(4, 12))
+            ]
+        else:
+            prompt = [
+                rng.randrange(3, 300)
+                for _ in range(rng.randint(6, 20))
+            ]
+        sampled = rng.random() < 0.34
+        specs.append({
+            "kind": kind,
+            "prompt": prompt,
+            "max_tokens": rng.randint(8, 24),
+            "temperature": 0.9 if sampled else 0.0,
+            "seed": rng.randrange(1, 2**31) if sampled else None,
+        })
+    return specs
+
+
+def _params(spec: dict):
+    from vllm_tgis_adapter_tpu.engine.sampling_params import (
+        RequestOutputKind,
+        SamplingParams,
+    )
+
+    return SamplingParams(
+        temperature=spec["temperature"],
+        seed=spec["seed"],
+        max_tokens=spec["max_tokens"],
+        ignore_eos=True,
+        output_kind=RequestOutputKind.DELTA,
+    )
+
+
+async def _run_request(engine, rid: str, spec: dict, lora_req):
+    """One DELTA stream to its terminal outcome.  Returns
+    ``("ok", [every streamed token, in order])`` or ``("err", exc)`` —
+    exactly one of the two, exactly once."""
+    toks: list[int] = []
+    try:
+        async for out in engine.generate(
+            prompt=None,
+            sampling_params=_params(spec),
+            request_id=rid,
+            prompt_token_ids=list(spec["prompt"]),
+            lora_request=lora_req if spec["kind"] == "lora" else None,
+        ):
+            toks.extend(out.outputs[0].token_ids)
+        return ("ok", toks)
+    except BaseException as e:  # noqa: BLE001 — the outcome IS the result
+        return ("err", e)
+
+
+async def _wait_serving(engine, what: str, bound: float) -> None:
+    deadline = time.monotonic() + bound
+    while time.monotonic() < deadline:
+        if engine.lifecycle == "serving" and all(
+            rep.serving for rep in engine._replicas  # noqa: SLF001
+        ):
+            return
+        await asyncio.sleep(0.02)
+    raise AssertionError(
+        f"seed invariant violated: {what} did not return to serving "
+        f"within {bound:.0f}s (lifecycle={engine.lifecycle})"
+    )
+
+
+async def _run_seed(seed: int, model_dir: str, adapter_dir: str) -> dict:
+    from vllm_tgis_adapter_tpu import compile_tracker
+    from vllm_tgis_adapter_tpu.frontdoor.errors import EngineRestartError
+    from vllm_tgis_adapter_tpu.supervisor import failpoints
+
+    rng = random.Random(seed)
+    dp = 2 if rng.random() < 0.4 else 1
+    engine = _build_engine(model_dir, dp=dp, watchdog=(dp == 1))
+    hang_released: list[str] = []
+    try:
+        lora_req = await engine.engine.lora_manager.load_lora_adapter(
+            "ad-soak", adapter_dir
+        )
+        specs = _make_workload(rng)
+
+        # ---- warm phase: the uncrashed BASELINE, and the compile set.
+        # Running the identical workload first (a) pins the per-request
+        # correct outputs and (b) compiles every shape the chaos phase
+        # can reach; the re-send of spec 0 exercises one host-tier
+        # promotion so scatter_kv is in the warmed set too.
+        baseline: dict[int, list[int]] = {}
+        for i, spec in enumerate(specs):
+            status, toks = await _run_request(
+                engine, f"warm-{seed}-{i}", spec, lora_req
+            )
+            assert status == "ok", f"warm request {i} failed: {toks!r}"
+            baseline[i] = toks
+        status, toks = await _run_request(
+            engine, f"warm-{seed}-promote", specs[0], lora_req
+        )
+        assert status == "ok" and toks == baseline[0], (
+            "warm re-send diverged — prefix/tier reuse broke determinism"
+        )
+        warm_shapes = compile_tracker.shapes()
+
+        # ---- chaos phase: same workload, seeded fault schedule
+        tasks = {
+            i: asyncio.create_task(_run_request(
+                engine, f"chaos-{seed}-{i}", spec, lora_req
+            ))
+            for i, spec in enumerate(specs)
+        }
+        injected: list[str] = []
+        for _ in range(rng.randint(1, 3)):
+            await asyncio.sleep(rng.uniform(0.1, 0.6))
+            if all(t.done() for t in tasks.values()):
+                break
+            site, action = rng.choice(FAULTS)
+            if action == "hang" and dp != 1:
+                site, action = "core.plan_step", "raise"
+            injected.append(f"{site}={action}")
+            failpoints.arm_site(site, action, 1)
+            if action == "hang":
+                # the stall watchdog declares it and the supervisor
+                # restarts the replica; the abandoned worker thread is
+                # released once recovery is observed
+                await _wait_serving(
+                    engine, f"hang recovery ({site})", HARNESS_BOUND_S
+                )
+                failpoints.release(site)
+                hang_released.append(site)
+            else:
+                await _wait_serving(
+                    engine, f"recovery after {site}={action}",
+                    HARNESS_BOUND_S,
+                )
+
+        done, pending = await asyncio.wait(
+            tasks.values(), timeout=HARNESS_BOUND_S
+        )
+        assert not pending, (
+            "seed invariant violated: "
+            f"{len(pending)} request(s) hung past the "
+            f"{HARNESS_BOUND_S:.0f}s harness bound"
+        )
+        await _wait_serving(engine, "post-chaos engine", HARNESS_BOUND_S)
+
+        ok = retryable = 0
+        for i, task in tasks.items():
+            status, payload = task.result()
+            if status == "ok":
+                assert payload == baseline[i], (
+                    f"seed invariant violated: request {i} "
+                    f"({specs[i]['kind']}) completed but its streamed "
+                    f"tokens diverged from the uncrashed baseline\n"
+                    f"  baseline: {baseline[i]}\n  got:      {payload}"
+                )
+                ok += 1
+            else:
+                assert isinstance(payload, EngineRestartError), (
+                    "seed invariant violated: request "
+                    f"{i} terminated with an untyped error: {payload!r}"
+                )
+                retryable += 1
+
+        # compile discipline: checkpoint/resume rides the fixed-shape
+        # per-page programs — across ANY number of checkpoints, pages
+        # and resumes, gather/scatter each hold exactly one compiled
+        # shape (their first compile may land lazily at the first
+        # checkpoint; what must never happen is a SECOND shape), and
+        # no other entry point gains a shape the warm phase lacked
+        for fn in ("gather_kv", "scatter_kv"):
+            fn_shapes = {
+                s for s in compile_tracker.shapes() if s[0] == fn
+            }
+            assert len(fn_shapes) <= 1, (
+                "seed invariant violated: checkpoint/resume entry "
+                f"point {fn} compiled {len(fn_shapes)} shapes: "
+                f"{sorted(fn_shapes)}"
+            )
+        new_shapes = {
+            s for s in compile_tracker.shapes() - warm_shapes
+            if s[0] not in ("gather_kv", "scatter_kv")
+            and s[0].startswith(("gather", "scatter"))
+        }
+        assert not new_shapes, (
+            "seed invariant violated: unexpected checkpoint/resume "
+            f"shapes: {sorted(new_shapes)}"
+        )
+
+        restarts = len([
+            h for h in (engine.supervisor.restart_history or [])
+            if h.get("recovered")
+        ])
+        resumed = sum(
+            h.get("resumed", 0)
+            for h in engine.supervisor.restart_history
+        )
+        return {
+            "seed": seed,
+            "dp": dp,
+            "requests": len(specs),
+            "ok": ok,
+            "retryable": retryable,
+            "faults": injected,
+            "restarts": restarts,
+            "resumed": resumed,
+        }
+    finally:
+        # a count=1 fault that never fired must not bleed into the next
+        # seed's engine; disarm also frees any still-parked hang thread
+        failpoints.disarm()
+        try:
+            await engine.stop()
+        except Exception:  # noqa: BLE001 — teardown is best-effort
+            pass
+
+
+async def _recovery_bench(model_dir: str) -> dict:
+    """perf_check ``recovery`` gate: one long greedy request killed
+    mid-decode must complete RESUMED within ``max_ratio`` x its
+    uncrashed wall time.
+
+    Measurement discipline (CPU-proxy fidelity): on the tiny fixture,
+    decode is ~0.3 ms/token while re-TRACING the fused decode programs
+    on ANY fresh engine costs seconds — a warm-baseline ratio would
+    measure JAX tracing, not recovery.  Both sides therefore run with
+    COLD per-engine programs over one shared persistent XLA cache:
+    the baseline is the request's wall time on a freshly built engine,
+    the resumed side is the same request crashed mid-decode (its
+    rebuilt engine is equally cold).  The ratio then isolates exactly
+    what checkpoint/resume adds: the quiesce gathers, the rebuild, the
+    tier promotion, and the tail recompute."""
+    from vllm_tgis_adapter_tpu.supervisor import failpoints
+
+    spec = {
+        "kind": "chat",
+        "prompt": list(range(3, 21)),
+        "max_tokens": 384,
+        "temperature": 0.0,
+        "seed": None,
+    }
+
+    # populate the shared persistent XLA cache (and the decode-tail
+    # step variants a resume can land on) so neither measured side
+    # pays a first-ever backend compile
+    warm = _build_engine(model_dir, dp=1, watchdog=False)
+    try:
+        for k in range(8):
+            status, _ = await _run_request(
+                warm, f"tailwarm-{k}", {**spec, "max_tokens": 9 + k},
+                None,
+            )
+            assert status == "ok"
+        status, base_toks = await _run_request(warm, "full", spec, None)
+        assert status == "ok"
+    finally:
+        await warm.stop()
+
+    # baseline: cold-program engine, uncrashed
+    base = _build_engine(model_dir, dp=1, watchdog=False)
+    try:
+        t0 = time.perf_counter()
+        status, got = await asyncio.wait_for(
+            _run_request(base, "base", spec, None), HARNESS_BOUND_S
+        )
+        base_s = time.perf_counter() - t0
+        assert status == "ok" and got == base_toks
+    finally:
+        await base.stop()
+
+    # resumed: cold-program engine, killed mid-decode; the rebuilt
+    # engine is cold the same way the baseline engine was
+    engine = _build_engine(model_dir, dp=1, watchdog=False)
+    try:
+        t0 = time.perf_counter()
+        task = asyncio.create_task(
+            _run_request(engine, "crashed", spec, None)
+        )
+        deadline = time.monotonic() + HARNESS_BOUND_S
+        while time.monotonic() < deadline:
+            seq = engine.engine._seqs.get("crashed")  # noqa: SLF001
+            # >= 1 COMMITTED (already-streamed) token = mid-decode; the
+            # soak kills at arbitrary depths — here the kill lands at
+            # the first token so the ratio measures recovery, not how
+            # many decode programs happened to trace twice
+            if seq is not None and seq.num_output_tokens >= 1:
+                break
+            await asyncio.sleep(0.005)
+        failpoints.arm_site("core.plan_step", "raise", 1)
+        status, resumed_toks = await asyncio.wait_for(
+            task, HARNESS_BOUND_S
+        )
+        resumed_s = time.perf_counter() - t0
+        assert status == "ok", f"resumed request failed: {resumed_toks!r}"
+        history = engine.supervisor.restart_history
+        return {
+            "kind": "recovery",
+            "base_s": round(base_s, 3),
+            "resumed_s": round(resumed_s, 3),
+            "ratio": round(resumed_s / max(base_s, 1e-9), 3),
+            "token_identical": resumed_toks == base_toks,
+            "resumed": sum(h.get("resumed", 0) for h in history),
+        }
+    finally:
+        failpoints.disarm()
+        try:
+            await engine.stop()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+def _enable_persistent_compile_cache() -> None:
+    """Warm-XLA-cache fidelity for the recovery bench: a rebuilt
+    engine's recompiles should cost what a TPU restart with the
+    persistent compilation cache pays, not a cold build."""
+    import jax
+
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        tempfile.mkdtemp(prefix="chaos-soak-xla-cache-"),
+    )
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--seeds", type=int, default=DEFAULT_SEEDS,
+                        help="number of seeds (schedules) to run")
+    parser.add_argument("--base-seed", type=int,
+                        default=DEFAULT_BASE_SEED)
+    parser.add_argument("--seed", type=int, default=None,
+                        help="run exactly one seed (reproduce a CI run)")
+    parser.add_argument("--recovery-bench", action="store_true",
+                        help="run the perf_check recovery measurement "
+                             "and print one JSON line")
+    args = parser.parse_args(argv)
+
+    _enable_persistent_compile_cache()
+    model_dir, adapter_dir = _build_fixtures()
+
+    if args.recovery_bench:
+        line = asyncio.run(_recovery_bench(model_dir))
+        print(json.dumps(line))
+        return 0
+
+    seeds = (
+        [args.seed]
+        if args.seed is not None
+        else [args.base_seed + i for i in range(args.seeds)]
+    )
+    t0 = time.monotonic()
+    failures = 0
+    for seed in seeds:
+        try:
+            stats = asyncio.run(_run_seed(seed, model_dir, adapter_dir))
+        except AssertionError as e:
+            failures += 1
+            print(f"chaos_soak: seed {seed} FAILED: {e}")
+            continue
+        print(
+            f"chaos_soak: seed {stats['seed']} ok  dp={stats['dp']} "
+            f"requests={stats['requests']} "
+            f"(ok={stats['ok']} retryable={stats['retryable']}) "
+            f"faults=[{', '.join(stats['faults'])}] "
+            f"restarts={stats['restarts']} resumed={stats['resumed']}"
+        )
+    elapsed = time.monotonic() - t0
+    if elapsed > BUDGET_S:
+        print(
+            f"chaos_soak: WARNING — {elapsed:.0f}s exceeded the "
+            f"{BUDGET_S:.0f}s budget (all {len(seeds)} seed(s) still "
+            "ran; nothing was trimmed)"
+        )
+    if failures:
+        print(
+            f"chaos_soak: {failures}/{len(seeds)} seed(s) violated an "
+            "invariant — reproduce with "
+            "`python tools/chaos_soak.py --seed <n>`"
+        )
+        return 1
+    print(
+        f"chaos_soak: all {len(seeds)} seed(s) green in {elapsed:.0f}s "
+        "(one terminal outcome per request, token-identical resumes, "
+        "no harness-bound hangs, zero new checkpoint/resume shapes)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

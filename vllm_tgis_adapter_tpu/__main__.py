@@ -16,7 +16,6 @@ import traceback
 from typing import TYPE_CHECKING
 
 from vllm_tgis_adapter_tpu.engine.async_llm import AsyncLLMEngine
-from vllm_tgis_adapter_tpu.grpc.grpc_server import run_grpc_server
 from vllm_tgis_adapter_tpu.http import build_http_server, run_http_server
 from vllm_tgis_adapter_tpu.logging import init_logger
 from vllm_tgis_adapter_tpu.tgis_utils import logs
@@ -123,6 +122,16 @@ async def start_servers(args: "argparse.Namespace") -> None:
             grace_s=engine.engine.config.frontdoor.drain_grace_s,
         )
         drain.install(loop)
+
+        # imported at point of use, not module top: the pb2 modules
+        # behind the gRPC server are protoc-generated, and a boot
+        # failure BEFORE the servers (bad model path, config
+        # validation) must still reach the termination log on hosts
+        # without protoc — tests/test_termination_log.py exercises
+        # exactly that
+        from vllm_tgis_adapter_tpu.grpc.grpc_server import (
+            run_grpc_server,
+        )
 
         http_app = build_http_server(args, engine)
 

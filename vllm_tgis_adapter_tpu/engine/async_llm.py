@@ -34,6 +34,7 @@ import time
 from collections.abc import AsyncGenerator, Mapping
 from typing import Optional
 
+from vllm_tgis_adapter_tpu import metrics
 from vllm_tgis_adapter_tpu.engine.config import EngineConfig
 from vllm_tgis_adapter_tpu.engine.core import LLMEngine, describe_plan
 from vllm_tgis_adapter_tpu.engine.outputs import (
@@ -772,6 +773,13 @@ class AsyncLLMEngine:
             return
         async with rep.lock:
             out = rep.engine.abort_request(request_id)
+            if out is None:
+                # abort-mid-recovery: the request may be a staged decode
+                # checkpoint (its dead engine forgot it at triage) —
+                # cancel the record NOW and answer with the final
+                # aborted frame instead of leaving the client to wait
+                # out the rebuild
+                out = self._abort_checkpointed(request_id)
             if out is None and request_id in self._owner:
                 # the owner exists but the engine does not know the
                 # request yet: generate() is between owner registration
@@ -1334,23 +1342,41 @@ class AsyncLLMEngine:
 
     async def fail_unreplayable(
         self, rep: _Replica, fail_error: BaseException
-    ) -> int:
+    ) -> tuple[int, list]:
         """Quiesce-time triage of requests whose outcome is already
-        fixed at death: mid-decode requests (tokens the client already
-        holds — replay would duplicate them) fail with ``fail_error``
+        fixed at death: finished-but-undrained requests deliver their
+        completed output; mid-decode requests (tokens the client
+        already holds — replay would duplicate them) CHECKPOINT into
+        the host KV tier for a token-identical resume
+        (docs/RECOVERY.md), or — down the degradation ladder (tier
+        disabled, ``--no-decode-resume``, checkpoint over the tier
+        budget, validation read failing) — fail with ``fail_error``
         NOW, before the multi-second rebuild/re-warm, so their clients
-        can retry immediately; finished-but-undrained requests deliver
-        their completed output.  Runs under the replica lock with the
-        step loop reaped; returns the failed count."""
+        can retry immediately.  Runs under the replica lock with the
+        step loop reaped; returns ``(failed, checkpoints)``."""
         failed = 0
+        checkpoints: list = []
         async with rep.lock:
             old = rep.engine
             for seq in list(old._seqs.values()):  # noqa: SLF001
                 if not seq.is_finished and seq.num_output_tokens == 0:
                     continue  # replay-safe: restart_replica re-queues it
+                queue = self._queues.get(seq.request_id)
+                ckpt = None
+                if (
+                    not seq.is_finished
+                    and queue is not None
+                    and seq.request_id not in self._early_aborts
+                ):
+                    # the tentpole: checkpoint instead of fail.  None
+                    # means the ladder applies — fall through to the
+                    # PR-5 retryable-failure floor below.
+                    ckpt = old.checkpoint_decode(seq)
                 old._seqs.pop(seq.request_id, None)  # noqa: SLF001
                 old.lora_manager.unpin(seq.lora_name)
-                queue = self._queues.get(seq.request_id)
+                if ckpt is not None:
+                    checkpoints.append(ckpt)
+                    continue
                 if queue is None:
                     continue
                 if seq.is_finished:
@@ -1358,9 +1384,236 @@ class AsyncLLMEngine:
                     # drain) exactly at death: deliver, don't retry
                     queue.put_nowait(seq.to_request_output())
                 else:
+                    self._count_fallback(old, seq.request_id, "ladder")
                     queue.put_nowait(fail_error)
                     failed += 1
-        return failed
+        # validation read: the quiesce-time gathers commit off the loop
+        # — wait them out, then verify every checkpointed page reads
+        # back valid.  A short checkpoint (demotion dropped under
+        # backpressure, LRU raced the commit, corrupt entry) falls back
+        # to the retryable floor rather than resuming a request whose
+        # KV it cannot restore.
+        tier = getattr(rep.engine, "kv_tier", None)
+        if checkpoints and tier is not None:
+            await tier.drain_transfers()
+            validated = []
+            for ckpt in checkpoints:
+                if tier.validate_checkpoint(ckpt):
+                    metrics.checkpoint_seconds.observe(
+                        max(0.0, time.perf_counter() - ckpt.t0)
+                    )
+                    validated.append(ckpt)
+                    continue
+                tier.pop_checkpoint(ckpt.request_id)
+                self._count_fallback(
+                    rep.engine, ckpt.request_id, "validation"
+                )
+                queue = self._queues.get(ckpt.request_id)
+                if queue is not None:
+                    queue.put_nowait(fail_error)
+                    failed += 1
+            checkpoints = validated
+        return failed, checkpoints
+
+    def _count_fallback(
+        self, engine: LLMEngine, request_id: str, reason: str
+    ) -> None:
+        """One mid-decode request kept the pre-resume semantics
+        (counted + flight-recorded, docs/RECOVERY.md ladder)."""
+        metrics.decode_checkpoints_total.labels(outcome="fallback").inc()
+        engine.recorder.record(
+            "checkpoint", request_id, step=engine.step_counter,
+            outcome="fallback", reason=reason,
+        )
+
+    def staged_checkpoints(self, fresh: list) -> list:
+        """``fresh`` plus any checkpoint a FAILED recovery attempt left
+        staged in the (surviving) tier: the records outlive the attempt
+        exactly like the KV pages, so a retry resumes them instead of
+        losing them.  Staged records whose consumer vanished are
+        dropped here."""
+        tier = getattr(self.engine, "kv_tier", None)
+        if tier is None:
+            return fresh
+        seen = {ckpt.request_id for ckpt in fresh}
+        out = list(fresh)
+        for ckpt in tier.pending_checkpoints():
+            rid = ckpt.request_id
+            if rid in seen:
+                continue
+            if rid not in self._queues:
+                tier.pop_checkpoint(rid)  # disconnected while staged
+                continue
+            if any(
+                rid in r.engine._seqs  # noqa: SLF001
+                for r in self._replicas
+            ):
+                continue  # already resumed somewhere live
+            out.append(ckpt)
+        return out
+
+    async def resume_to_replicas(
+        self, rep: _Replica, checkpoints: list,
+        fail_error: BaseException,
+    ) -> tuple[int, int, list]:
+        """Cross-replica resume (docs/RECOVERY.md): move validated
+        checkpoints onto HEALTHY dp siblings NOW, before the dead
+        replica's multi-second rebuild — the same placement-scored hop
+        zero-token replays take, so a streaming client sees only a
+        pause.  Returns ``(resumed, failed, remaining)``: with no
+        healthy sibling everything remains for the rebuilt engine
+        (``resume_into``); with siblings present every checkpoint is
+        consumed here (resumed, failed retryable, or dropped with its
+        vanished consumer) and ``remaining`` is empty."""
+        healthy = [
+            r for r in self._replicas if r.serving and r is not rep
+        ]
+        if not healthy or not checkpoints:
+            return 0, 0, checkpoints
+        tier = getattr(self.engine, "kv_tier", None)
+        resumed = failed = 0
+        targets: set[int] = set()
+        for ckpt in checkpoints:
+            if not self._resume_consumer_alive(ckpt, tier):
+                continue
+            target = self._place_replica(
+                list(ckpt.prompt_token_ids) + list(ckpt.output_token_ids),
+                ckpt.tenant_id,
+                ckpt.lora_name,
+            )
+            if target is rep:  # defensive: never resume onto the dead
+                target = healthy[resumed % len(healthy)]
+            try:
+                async with target.lock:
+                    # re-checked INSIDE the lock: abort() serializes on
+                    # the DEAD owner's lock, not this target's, so a
+                    # cancel/disconnect can land while we awaited here
+                    if not self._resume_consumer_alive(ckpt, tier):
+                        continue
+                    target.engine.resume_request(
+                        ckpt, path="cross_replica"
+                    )
+            except Exception:  # noqa: BLE001 — one bad resume must not sink the rest
+                logger.exception(
+                    "cross-replica resume of %s failed; falling back "
+                    "to retryable failure", ckpt.request_id,
+                )
+                if tier is not None:
+                    tier.pop_checkpoint(ckpt.request_id)
+                self._count_fallback(
+                    target.engine, ckpt.request_id, "resume"
+                )
+                queue = self._queues.get(ckpt.request_id)
+                if queue is not None:
+                    queue.put_nowait(fail_error)
+                    failed += 1
+                continue
+            if tier is not None:
+                tier.pop_checkpoint(ckpt.request_id)
+            self._owner[ckpt.request_id] = target
+            targets.add(target.index)
+            resumed += 1
+            metrics.requests_resumed_total.labels(
+                path="cross_replica"
+            ).inc()
+            metrics.decode_checkpoints_total.labels(
+                outcome="resumed"
+            ).inc()
+        for r in self._replicas:
+            if r.index in targets:
+                r.last_beat = time.monotonic()
+                r.new_work.set()
+        return resumed, failed, []
+
+    async def resume_into(
+        self, rep: _Replica, checkpoints: list,
+        fail_error: BaseException,
+    ) -> tuple[int, int]:
+        """Local resume: re-enter the remaining checkpoints into the
+        REBUILT engine (already swapped onto ``rep`` by
+        ``restart_replica``).  Returns ``(resumed, failed)``."""
+        tier = getattr(self.engine, "kv_tier", None)
+        resumed = failed = 0
+        async with rep.lock:
+            for ckpt in checkpoints:
+                if not self._resume_consumer_alive(ckpt, tier):
+                    continue
+                try:
+                    rep.engine.resume_request(ckpt, path="local")
+                except Exception:  # noqa: BLE001
+                    logger.exception(
+                        "resume of %s into the rebuilt engine failed; "
+                        "falling back to retryable failure",
+                        ckpt.request_id,
+                    )
+                    if tier is not None:
+                        tier.pop_checkpoint(ckpt.request_id)
+                    self._count_fallback(
+                        rep.engine, ckpt.request_id, "resume"
+                    )
+                    queue = self._queues.get(ckpt.request_id)
+                    if queue is not None:
+                        queue.put_nowait(fail_error)
+                        failed += 1
+                    continue
+                if tier is not None:
+                    tier.pop_checkpoint(ckpt.request_id)
+                self._owner[ckpt.request_id] = rep
+                resumed += 1
+                metrics.requests_resumed_total.labels(path="local").inc()
+                metrics.decode_checkpoints_total.labels(
+                    outcome="resumed"
+                ).inc()
+        return resumed, failed
+
+    def _abort_checkpointed(self, request_id: str):
+        """Cancel a staged decode checkpoint (explicit abort during
+        recovery).  Returns the final aborted RequestOutput, or None
+        when no checkpoint is staged under this id."""
+        tier = getattr(self.engine, "kv_tier", None)
+        if tier is None:
+            return None
+        ckpt = tier.pop_checkpoint(request_id)
+        if ckpt is None:
+            return None
+        ckpt.cancelled = True  # a resume path may still hold a reference
+        return self._aborted_output(ckpt)
+
+    def _resume_consumer_alive(self, ckpt, tier) -> bool:  # noqa: ANN001
+        """Disconnect/abort-mid-resume hardening: a checkpoint whose
+        stream is gone (or was aborted while staged) is dropped — no
+        engine state is created, the staged record is discarded, and an
+        explicit abort gets its final aborted frame."""
+        rid = ckpt.request_id
+        if ckpt.cancelled:
+            return False  # abort() already delivered the final frame
+        queue = self._queues.get(rid)
+        if queue is None:
+            if tier is not None:
+                tier.pop_checkpoint(rid)
+            return False
+        if rid in self._early_aborts:
+            self._early_aborts.discard(rid)
+            if tier is not None:
+                tier.pop_checkpoint(rid)
+            queue.put_nowait(self._aborted_output(ckpt))
+            return False
+        return True
+
+    @staticmethod
+    def _aborted_output(ckpt) -> RequestOutput:  # noqa: ANN001
+        """Final aborted frame for a checkpointed request that was
+        aborted before its resume (same graceful wire shape as a
+        TTL shed: an empty delta, finished, reason 'abort')."""
+        return RequestOutput(
+            request_id=ckpt.request_id,
+            prompt=ckpt.prompt,
+            prompt_token_ids=list(ckpt.prompt_token_ids),
+            outputs=[CompletionOutput(
+                index=0, text="", token_ids=[], finish_reason="abort",
+            )],
+            finished=True,
+        )
 
     async def replay_to_replicas(self, rep: _Replica) -> int:
         """Cross-replica replay (docs/SCALING.md): move the dead

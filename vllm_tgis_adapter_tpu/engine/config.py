@@ -984,6 +984,14 @@ class EngineConfig:
     # base of the exponential backoff between restart attempts
     # (base * 2^(attempts_in_window - 1), capped at 30s)
     engine_restart_backoff_s: float = 0.5
+    # mid-decode checkpoint/resume at supervised restart
+    # (docs/RECOVERY.md): when supervision AND the host KV tier are both
+    # on, a mid-decode request checkpoints into the tier at quiesce and
+    # resumes token-identically instead of failing EngineRestartError.
+    # --no-decode-resume is the escape hatch back to the fail-retryable
+    # floor; the flag is inert without --max-engine-restarts > 0 and
+    # --kv-host-cache-gb > 0.
+    decode_resume: bool = True
     speculative: "Optional[SpeculativeConfig]" = None
     # front door (frontdoor/): admission control, per-tenant fair
     # queuing, load shedding, graceful drain
@@ -1221,6 +1229,7 @@ class EngineConfig:
             engine_restart_backoff_s=float(
                 getattr(args, "engine_restart_backoff", 0.5) or 0.0
             ),
+            decode_resume=not getattr(args, "no_decode_resume", False),
             frontdoor=FrontdoorConfig.from_args(args),
             attention_backend=getattr(
                 args, "attention_backend", "bucketed"

@@ -482,6 +482,21 @@ class LLMEngine:
                 else min(deadline, ttl_deadline)
             )
         seq.deadline = deadline
+        self._prepare_admission(seq)
+        self._commit_admission(seq)
+        self.recorder.record(
+            "admit", request_id, step=self.step_counter, trace_id=trace_id,
+            prompt_tokens=len(prompt_token_ids),
+            **({"lora": lora_name} if lora_name else {}),
+        )
+
+    def _prepare_admission(self, seq: Sequence) -> None:
+        """Per-request machinery shared by fresh admission and decode
+        resume: adapter residency, speculative eligibility, FSM
+        compilation (left at its init state — resume replays it), and
+        the incremental detokenizer (empty — resume replays it)."""
+        params = seq.params
+        lora_name = seq.lora_name
         pool = getattr(self.runner, "adapter_pool", None)
         if pool is None:
             seq.lora_slot = self.lora_manager.slot_of(lora_name)
@@ -490,7 +505,7 @@ class LLMEngine:
             # adapter gate once the weights are device-resident; issue
             # the prefetch NOW so the host→device stream overlaps the
             # queue wait (and a supervised rebuild re-streams exactly
-            # the adapters its replayed requests reference)
+            # the adapters its replayed/resumed requests reference)
             seq.lora_slot = 0
             if lora_name is not None:
                 pool.note_lookup(lora_name, replica=self.replica_index)
@@ -518,18 +533,18 @@ class LLMEngine:
             seq.prompt_token_ids,
             skip_special_tokens=params.skip_special_tokens,
         )
-        # pin for the sequence's whole lifetime (incl. preemption-resume):
-        # eviction must not reassign a slot a running row still indexes.
-        # Pinned only once admission can no longer fail — an exception
-        # above this line must not leak a ref no finish path will release.
-        self.lora_manager.pin(lora_name)
-        self._seqs[request_id] = seq
+
+    def _commit_admission(self, seq: Sequence) -> None:
+        """Hand a fully prepared sequence to the scheduler.
+
+        Pinned only once admission can no longer fail — an exception in
+        preparation must not leak a ref no finish path will release;
+        the pin covers the sequence's whole lifetime (incl.
+        preemption-resume: eviction must not reassign a slot a running
+        row still indexes)."""
+        self.lora_manager.pin(seq.lora_name)
+        self._seqs[seq.request_id] = seq
         self.scheduler.add(seq)
-        self.recorder.record(
-            "admit", request_id, step=self.step_counter, trace_id=trace_id,
-            prompt_tokens=len(prompt_token_ids),
-            **({"lora": lora_name} if lora_name else {}),
-        )
 
     def abort_request(self, request_id: str) -> Optional[RequestOutput]:
         seq = self._seqs.pop(request_id, None)
@@ -955,6 +970,177 @@ class LLMEngine:
                 seq.request_id, promoted, ticket.start_tokens,
             )
         self._promotions = rest
+
+    # ------------------------------------- mid-decode checkpoint / resume
+
+    def checkpoint_decode(self, seq: Sequence):
+        """Quiesce-time capture of one mid-decode request
+        (docs/RECOVERY.md): demote its fully WRITTEN KV pages into the
+        host tier (frontier-capped at ``num_tokens - 1`` — the
+        just-sampled token's slot is written by a dispatch that died)
+        and stage a ``DecodeCheckpoint`` alongside.  Returns the staged
+        record, or None when the degradation ladder applies (tier off,
+        ``--no-decode-resume``, checkpoint over the tier budget, or the
+        gather itself failing on a wedged device) — the caller then
+        falls back to the retryable ``EngineRestartError`` floor.
+
+        Called by the supervisor's triage under the replica lock with
+        the step loop reaped; the gathers are the same fixed-shape
+        jitted per-page programs ordinary demotion uses, so a
+        checkpoint never adds a compile shape.
+        """
+        tier = self.kv_tier
+        if tier is None or not self.config.decode_resume:
+            return None
+        bs = self.config.cache_config.block_size
+        token_ids = seq.all_token_ids
+        written = seq.num_tokens - 1
+        pages = max(0, written // bs)
+        caches = getattr(self.runner, "caches", None)
+        if pages and caches is not None:
+            k_cache = caches[0]
+            per_page = (
+                2 * k_cache.shape[0] * k_cache.shape[1]
+                * k_cache.shape[3] * k_cache.dtype.itemsize * bs
+            )
+            if pages * per_page > tier.budget_bytes:
+                # can never fit — the store would evict the checkpoint's
+                # own head while inserting its tail
+                return None
+        t0 = time.perf_counter()
+        try:
+            if (
+                seq.status == SequenceStatus.RUNNING
+                and seq.kv_promotion is None
+                and seq.blocks is not None
+            ):
+                # gather the device-resident frontier.  Non-RUNNING
+                # mid-decode states already demoted at their transition
+                # (preemption swap-out lands in the tier; a parked
+                # promotion's SOURCE pages are the tier) — their device
+                # pages are absent or unwritten, so gathering here
+                # would poison the store; the validation read decides.
+                self._tier_demote(seq, token_ids, written=written)
+        except Exception:  # noqa: BLE001 — a wedged device fails the ladder, not recovery
+            logger.exception(
+                "decode-checkpoint gather failed for request %s; "
+                "falling back to retryable failure", seq.request_id,
+            )
+            return None
+        from vllm_tgis_adapter_tpu.engine.kv_cache import chain_digests
+        from vllm_tgis_adapter_tpu.engine.kv_tier import DecodeCheckpoint
+
+        m = seq.metrics
+        ckpt = DecodeCheckpoint(
+            request_id=seq.request_id,
+            prompt=seq.prompt,
+            prompt_token_ids=list(seq.prompt_token_ids),
+            output_token_ids=list(seq.output_token_ids),
+            params=seq.params,
+            fallback_seed=seq.fallback_seed,
+            arrival_time=m.arrival_time,
+            deadline=seq.deadline,
+            tenant_id=seq.tenant_id,
+            lora_name=seq.lora_name,
+            trace_id=seq.trace_id,
+            emitted_token_len=seq._emitted_token_len,  # noqa: SLF001
+            emitted_text_len=seq._emitted_text_len,  # noqa: SLF001
+            stop_scan_pos=seq.stop_scan_pos,
+            output_logprobs=(
+                list(seq.output_logprobs)
+                if seq.output_logprobs is not None
+                else None
+            ),
+            prompt_logprobs=(
+                list(seq.prompt_logprobs)
+                if seq.prompt_logprobs is not None
+                else None
+            ),
+            first_scheduled_time=m.first_scheduled_time,
+            first_token_time=m.first_token_time,
+            last_token_time=m.last_token_time,
+            time_in_queue=m.time_in_queue,
+            digests=(
+                chain_digests(token_ids, bs, seq.lora_name, pages)
+                if pages
+                else []
+            ),
+            pages=pages,
+            t0=t0,
+        )
+        tier.stage_checkpoint(ckpt)
+        self.recorder.record(
+            "checkpoint", seq.request_id, step=self.step_counter,
+            trace_id=seq.trace_id, output_tokens=seq.num_output_tokens,
+            pages=pages,
+        )
+        return ckpt
+
+    def resume_request(self, ckpt, path: str = "local") -> None:  # noqa: ANN001
+        """Re-enter one checkpointed mid-decode request
+        (docs/RECOVERY.md): rebuild its ``Sequence`` — emitted tokens,
+        sampler seed, detokenizer/FSM state replayed, streaming
+        bookkeeping restored so nothing re-emits — and hand it to the
+        scheduler as a preemption-resume-shaped admission.  The kv gate
+        then promotes the checkpointed pages from the host tier and the
+        uncovered tail recomputes, so decode continues token-identically
+        (the sampler folds the per-request POSITION into the per-request
+        key, so the draw stream is scheduling-independent).
+
+        ``path`` labels the flight-recorder event and metrics: 'local'
+        (into the rebuilt replica) or 'cross_replica' (onto a healthy
+        dp sibling before the rebuild).
+        """
+        rid = ckpt.request_id
+        if rid in self._seqs:
+            raise ValueError(f"duplicate request_id {rid!r}")
+        params = ckpt.params
+        seq = Sequence(
+            rid,
+            ckpt.prompt,
+            list(ckpt.prompt_token_ids),
+            params,
+            arrival_time=ckpt.arrival_time,
+            fallback_seed=ckpt.fallback_seed,
+            lora_name=ckpt.lora_name,
+        )
+        seq.resumed = True
+        seq.trace_id = ckpt.trace_id
+        seq.tenant_id = ckpt.tenant_id
+        seq.deadline = ckpt.deadline
+        seq.output_token_ids = list(ckpt.output_token_ids)
+        if ckpt.output_logprobs is not None:
+            seq.output_logprobs = list(ckpt.output_logprobs)
+        if ckpt.prompt_logprobs is not None:
+            seq.prompt_logprobs = list(ckpt.prompt_logprobs)
+        m = seq.metrics
+        # timing restore: a resumed request is NOT a new arrival — TTFT
+        # was observed in its first life and must not re-observe
+        m.first_scheduled_time = ckpt.first_scheduled_time
+        m.first_token_time = ckpt.first_token_time
+        m.last_token_time = ckpt.last_token_time
+        m.time_in_queue = ckpt.time_in_queue
+        m.events.append(("resumed", time.time_ns()))
+        self._prepare_admission(seq)
+        if seq.fsm is not None:
+            state = seq.fsm.init_state
+            for tok in seq.output_token_ids:
+                # replay, don't carry: state ids are private to THIS
+                # compile of the FSM
+                state = seq.fsm.next_state(state, tok)
+            seq.fsm_state = state
+        if seq.output_token_ids:
+            # deterministic replay: output_text lands exactly where the
+            # dead engine left it, so DELTA offsets below stay valid
+            seq.detokenizer.append(list(seq.output_token_ids))
+        seq.stop_scan_pos = ckpt.stop_scan_pos
+        seq._emitted_token_len = ckpt.emitted_token_len  # noqa: SLF001
+        seq._emitted_text_len = ckpt.emitted_text_len  # noqa: SLF001
+        self._commit_admission(seq)
+        self.recorder.record(
+            "resume", rid, step=self.step_counter, trace_id=ckpt.trace_id,
+            output_tokens=len(seq.output_token_ids), path=path,
+        )
 
     # ------------------------------------------------------------- step loop
 

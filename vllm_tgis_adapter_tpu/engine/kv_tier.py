@@ -73,6 +73,60 @@ class _Entry:
 
 
 @dataclasses.dataclass
+class DecodeCheckpoint:
+    """One mid-decode request's resumable host-side state.
+
+    Created by ``LLMEngine.checkpoint_decode`` at supervisor quiesce
+    time (docs/RECOVERY.md): the request's fully WRITTEN KV pages demote
+    into the tier via the frontier-capped gathers, and this record —
+    everything the device does not hold — is staged alongside, keyed by
+    request id.  A resume (``LLMEngine.resume_request``) rebuilds a
+    ``Sequence`` from it on the rebuilt replica or a healthy dp sibling;
+    decode then continues token-identically because the sampler's PRNG
+    folds the per-request position into ``fallback_seed`` (not a global
+    step counter) and the seen-penalty matrix reseeds from the full
+    prompt ‖ output chain, exactly like preemption-resume.
+
+    The record is tiny (token ids + scalars — no tensors): the KV bytes
+    live in the hash-addressed page store, shared with ordinary prefix
+    reuse.  Schema documented in docs/KV_TIERING.md.
+    """
+
+    request_id: str
+    prompt: Optional[str]
+    prompt_token_ids: list
+    output_token_ids: list  # emitted tokens — the client already holds these
+    params: object  # SamplingParams (carries seed/penalties/stop/fsm spec)
+    fallback_seed: int  # sampler key material — the token-identity anchor
+    arrival_time: float
+    deadline: Optional[float]
+    tenant_id: Optional[str]
+    lora_name: Optional[str]
+    trace_id: Optional[str]
+    # streaming bookkeeping: restored so DELTA streams never re-emit
+    emitted_token_len: int
+    emitted_text_len: int
+    stop_scan_pos: int
+    output_logprobs: Optional[list]
+    prompt_logprobs: Optional[list]
+    # request-timing restore: TTFT must not be re-observed on resume
+    first_scheduled_time: Optional[float]
+    first_token_time: Optional[float]
+    last_token_time: Optional[float]
+    time_in_queue: Optional[float]
+    # the validation-read target: every one of these page digests must
+    # be committed in the store before a resume is attempted
+    digests: list
+    pages: int
+    # perf_counter stamp at capture (checkpoint_seconds observation)
+    t0: float = 0.0
+    # set by an explicit abort between staging and resume: the resume
+    # paths skip a cancelled record even if they still hold a reference
+    # to it (the client already received its final aborted frame)
+    cancelled: bool = False
+
+
+@dataclasses.dataclass
 class PromotionTicket:
     """One parked request's in-flight host→device prefix restore.
 
@@ -133,6 +187,15 @@ class HostKVTier:
         # AdapterPool._streaming; close() cancels through this set.
         self._tasks: set = set()
         self._closed = False
+        # staged DecodeCheckpoints (request_id → record): mid-decode
+        # requests captured at supervisor quiesce, consumed at resume.
+        # Records are token-id-sized, so no byte budget; they live in
+        # the tier because the tier is exactly the state that SURVIVES
+        # the dead engine (and is shared fleet-wide under dp, so a
+        # healthy sibling can resume them before the rebuild).
+        self._checkpoints: "OrderedDict[str, DecodeCheckpoint]" = (
+            OrderedDict()
+        )
         # lifetime stats (debug_state / bench stamps)
         self.demoted_pages = 0
         self.promoted_pages = 0
@@ -374,6 +437,53 @@ class HostKVTier:
         self.promoted_pages += pages
         self.promoted_tokens += tokens
 
+    # -------------------------------------------------- decode checkpoints
+
+    def stage_checkpoint(self, ckpt: DecodeCheckpoint) -> None:
+        """Stage one mid-decode request's resume record (quiesce-time
+        triage).  Overwrites a same-id leftover — a retried recovery's
+        fresh capture is always the authoritative one."""
+        if self._closed:
+            return
+        self._checkpoints[ckpt.request_id] = ckpt
+
+    def pop_checkpoint(
+        self, request_id: str
+    ) -> Optional[DecodeCheckpoint]:
+        """Consume (resume) or discard (abort/disconnect/fallback) one
+        staged record."""
+        return self._checkpoints.pop(request_id, None)
+
+    def pending_checkpoints(self) -> list:
+        """Staged records not yet consumed — a recovery retry adopts
+        these (the first attempt's captures survive its failure here,
+        exactly like the KV pages themselves)."""
+        return list(self._checkpoints.values())
+
+    async def drain_transfers(self) -> None:
+        """Barrier: await the transfer tasks in flight AT ENTRY.  The
+        checkpoint validation read needs the quiesce-time gathers
+        COMMITTED (a still-in-flight page reads as a miss and would
+        fail a resume that is about to succeed); those were submitted
+        before this call, so a single snapshot covers them.  Waiting
+        for the set to EMPTY instead would never terminate on a shared
+        dp tier whose healthy replicas keep streaming new transfers."""
+        tasks = [t for t in list(self._tasks) if not t.done()]
+        if tasks:
+            await asyncio.gather(*tasks, return_exceptions=True)
+
+    def validate_checkpoint(self, ckpt: DecodeCheckpoint) -> bool:
+        """The resume-eligibility read: every checkpointed page digest
+        must be committed AND pass the per-entry integrity check
+        (corrupt entries drop here, exactly as on the promotion path).
+        A zero-page checkpoint (short decode — not one full page
+        written yet) is trivially valid: resume recomputes from the
+        prompt, still token-identically."""
+        for digest in ckpt.digests[: ckpt.pages]:
+            if self._get_valid(digest) is None:
+                return False
+        return True
+
     # ------------------------------------------------------------ lifecycle
 
     def close(self) -> None:
@@ -381,6 +491,7 @@ class HostKVTier:
         for task in list(self._tasks):
             task.cancel()
         self._entries.clear()
+        self._checkpoints.clear()
         self.bytes_used = 0
 
     # ------------------------------------------------------------- metrics
@@ -415,4 +526,5 @@ class HostKVTier:
             "promoted_tokens": self.promoted_tokens,
             "evictions": self.evictions,
             "dropped_corrupt": self.dropped_corrupt,
+            "checkpoints": len(self._checkpoints),
         }

@@ -115,6 +115,12 @@ class Sequence:
         # queue (target pages allocated, host→device transfer running);
         # cleared when the engine core applies or cancels the restore.
         self.kv_promotion = None
+        # True for a request rebuilt from a DecodeCheckpoint after
+        # engine death (engine/core.py resume_request): its
+        # output_token_ids predate this engine incarnation and were
+        # already streamed — emission bookkeeping is restored so the
+        # client never sees a duplicate (docs/RECOVERY.md)
+        self.resumed = False
         self.detokenizer: Optional["IncrementalDetokenizer"] = None
         # for DELTA streams: what has already been emitted
         self._emitted_text_len = 0

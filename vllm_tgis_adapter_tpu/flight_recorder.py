@@ -72,6 +72,13 @@ EVENT_KINDS = (
     "restart",        # supervised engine restart completed
     #                   (supervisor/: detail carries cause, attempt,
     #                   replayed/failed counts, recovery seconds)
+    "checkpoint",     # mid-decode request checkpointed at quiesce
+    #                   (docs/RECOVERY.md: detail carries output_tokens,
+    #                   pages and — on the degradation ladder —
+    #                   outcome="fallback" with the reason)
+    "resume",         # checkpointed request re-entered an engine and
+    #                   decode continued (detail: output_tokens, path =
+    #                   local | cross_replica)
 )
 
 # Per-request decode events are recorded every N committed tokens — one
@@ -206,6 +213,10 @@ def _seq_info(seq: "Sequence", now: float) -> dict:
         info["trace_id"] = trace_id
     if seq.lora_name:
         info["lora"] = seq.lora_name
+    if getattr(seq, "resumed", False):
+        # re-entered from a decode checkpoint after engine death — its
+        # output_tokens predate this engine incarnation
+        info["resumed"] = True
     return info
 
 

@@ -385,6 +385,33 @@ recovery_seconds = _get_or_create(
     "rebuild (incl. precompile re-warm), replay, re-arm",
     buckets=(0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0),
 )
+requests_resumed_total = _get_or_create(
+    Counter,
+    f"{_PREFIX}_requests_resumed_total",
+    "Mid-decode requests resumed from a decode checkpoint after engine "
+    "death (docs/RECOVERY.md): 'local' = into the rebuilt replica, "
+    "'cross_replica' = onto a healthy dp sibling before the rebuild",
+    labelnames=("path",),
+)
+decode_checkpoints_total = _get_or_create(
+    Counter,
+    f"{_PREFIX}_decode_checkpoints_total",
+    "Quiesce-time outcomes for mid-decode requests, by outcome: "
+    "'resumed' = checkpointed into the host KV tier and resumed "
+    "token-identically; 'fallback' = the degradation ladder kept the "
+    "pre-resume semantics (tier disabled, --no-decode-resume, "
+    "checkpoint over the tier budget, or a failed validation read) and "
+    "the request failed retryable (EngineRestartError)",
+    labelnames=("outcome",),
+)
+checkpoint_seconds = _get_or_create(
+    Histogram,
+    f"{_PREFIX}_checkpoint_seconds",
+    "Wall time to checkpoint one mid-decode request at quiesce: "
+    "frontier-capped KV page gathers, host-tier commit, and the "
+    "validation read",
+    buckets=(0.005, 0.02, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0),
+)
 
 
 # ---- front door (frontdoor/): admission control, per-tenant fair

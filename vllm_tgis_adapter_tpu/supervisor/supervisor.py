@@ -161,7 +161,8 @@ class EngineSupervisor:
         for h in self.restart_history:
             outcome = (
                 f"recovered in {h['recovery_s']:.2f}s "
-                f"(replayed={h['replayed']}, failed={h['failed']})"
+                f"(replayed={h['replayed']}, "
+                f"resumed={h.get('resumed', 0)}, failed={h['failed']})"
                 if h.get("recovered")
                 else f"recovery FAILED: {h.get('error', '?')}"
             )
@@ -306,8 +307,8 @@ class EngineSupervisor:
             )
             t0 = time.monotonic()
             try:
-                moved, rebuilt_replayed, failed = await self._recover_one(
-                    rep, err, backoff
+                moved, rebuilt_replayed, failed, resumed = (
+                    await self._recover_one(rep, err, backoff)
                 )
                 replayed = moved + rebuilt_replayed
             except asyncio.CancelledError:
@@ -337,6 +338,7 @@ class EngineSupervisor:
                 recovery_s=round(duration, 3),
                 replayed=replayed,
                 failed=failed,
+                resumed=resumed,
             )
             metrics.recovery_seconds.observe(duration)
             # counted only on the attempt that SUCCEEDED: a failed
@@ -349,13 +351,15 @@ class EngineSupervisor:
             rep.engine.recorder.record(
                 "restart", step=rep.engine.step_counter, replica=rep.index,
                 cause=cause, attempt=attempt, replayed=replayed,
-                failed=failed, recovery_s=round(duration, 3),
+                failed=failed, resumed=resumed,
+                recovery_s=round(duration, 3),
             )
             self._pending_reps.discard(rep.index)
             logger.warning(
                 "engine supervisor: replica %d recovered in %.2fs "
-                "(%d requests replayed, %d failed retryable)",
-                rep.index, duration, replayed, failed,
+                "(%d requests replayed, %d mid-decode resumed, "
+                "%d failed retryable)",
+                rep.index, duration, replayed, resumed, failed,
             )
             # checkpoint: if the pod dies later for an unrelated reason,
             # the post-mortem still sees that (and why) restarts happened
@@ -388,10 +392,11 @@ class EngineSupervisor:
 
     async def _recover_one(
         self, rep: "_Replica", err: BaseException, backoff: float = 0.0
-    ) -> tuple[int, int, int]:
-        """Quiesce + rebuild + replay one replica.  Returns
-        ``(moved_to_healthy, replayed_into_rebuilt, failed)``; raises
-        on failure (the caller converts that into another attempt)."""
+    ) -> tuple[int, int, int, int]:
+        """Quiesce + rebuild + replay/resume one replica.  Returns
+        ``(moved_to_healthy, replayed_into_rebuilt, failed, resumed)``;
+        raises on failure (the caller converts that into another
+        attempt)."""
         # reap the dead (or stuck) step-loop task; a stalled task is
         # blocked in to_thread — cancelling abandons the worker thread
         task = rep.task
@@ -415,16 +420,34 @@ class EngineSupervisor:
             retry_after_s=2.0,
         )
         fail_error.__cause__ = err
-        # triage the fixed-outcome requests FIRST: a mid-decode client
-        # gets its retryable UNAVAILABLE now, not after the rebuild and
-        # precompile re-warm it cannot benefit from
-        failed = await self.engine.fail_unreplayable(rep, fail_error)
+        # triage the fixed-outcome requests FIRST: finished output
+        # delivers, and each mid-decode request either CHECKPOINTS into
+        # the host KV tier for a token-identical resume or — down the
+        # degradation ladder — gets its retryable UNAVAILABLE now, not
+        # after the rebuild and precompile re-warm it cannot benefit
+        # from (docs/RECOVERY.md)
+        failed, checkpoints = await self.engine.fail_unreplayable(
+            rep, fail_error
+        )
+        # a FAILED earlier attempt's checkpoints survive in the tier
+        # (like the KV pages themselves): adopt them so the retry
+        # resumes instead of losing them
+        checkpoints = self.engine.staged_checkpoints(checkpoints)
         # then move replay-safe work onto HEALTHY replicas immediately
         # (cross-replica replay, docs/SCALING.md): those requests reach
         # prefill while this replica is still rebuilding.  dp=1 (no
         # healthy sibling) moves nothing — restart_replica replays into
         # the rebuilt engine below, the pre-router behavior.
         moved = await self.engine.replay_to_replicas(rep)
+        # checkpointed mid-decode work takes the same hop when a healthy
+        # sibling exists (the tier is shared fleet-wide): decode resumes
+        # BEFORE the rebuild, placement-scored like the replays above
+        resumed, cross_failed, checkpoints = (
+            await self.engine.resume_to_replicas(
+                rep, checkpoints, fail_error
+            )
+        )
+        failed += cross_failed
         # crash-loop backoff delays only the REBUILD: triage and cross-
         # replica replay above already ran, so no request waits out the
         # backoff of a crash-looping replica — only the replica's own
@@ -447,11 +470,22 @@ class EngineSupervisor:
         replayed, late_failed = await self.engine.restart_replica(
             rep, new_engine, fail_error
         )
+        # checkpoints no healthy sibling took resume into the rebuilt
+        # engine (dp=1: all of them) — the kv gate promotes their pages
+        # back from the surviving tier and decode continues
+        local_resumed, resume_failed = await self.engine.resume_into(
+            rep, checkpoints, fail_error
+        )
         self.engine._arm_replica(rep)  # noqa: SLF001
         # re-admit to placement only now, with the rebuilt engine armed:
         # the router starts routing to it again from the next request
         rep.serving = True
-        return moved, replayed, failed + late_failed
+        return (
+            moved,
+            replayed,
+            failed + late_failed + resume_failed,
+            resumed + local_resumed,
+        )
 
     def _rebuild(self, old: "LLMEngine") -> "LLMEngine":
         """Build the replacement engine (worker thread; slow is fine).

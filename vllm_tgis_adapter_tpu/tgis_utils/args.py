@@ -412,6 +412,14 @@ def add_engine_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                    help="base of the exponential backoff between "
                         "restart attempts (base * 2^(n-1), capped at "
                         "30s)")
+    g.add_argument("--no-decode-resume", action="store_true",
+                   help="disable mid-decode checkpoint/resume at "
+                        "supervised restart: mid-decode requests fail "
+                        "retryable (UNAVAILABLE + Retry-After) instead "
+                        "of checkpointing into the host KV tier and "
+                        "resuming token-identically (docs/RECOVERY.md; "
+                        "resume is on by default whenever supervision "
+                        "and --kv-host-cache-gb are both active)")
     g.add_argument("--watchdog-action", type=str, default="snapshot",
                    choices=["snapshot", "restart"],
                    help="what a watchdog-declared stall triggers: "
