@@ -52,6 +52,15 @@ populates the tier, a warm pass re-sends identical prompts; the line's
 hit rate, promotion/demotion counts, and cold↔warm token identity for
 the perf_check `kv_tier` gate.
 
+Disaggregation knobs (docs/SCALING.md "Disaggregated roles"):
+BENCH_ROLES=mixed|disagg runs the concurrent chat+RAG scenario at
+equal replica count (dp forced >= 2) — 'disagg' splits the fleet half
+prefill / half decode, 'mixed' keeps it uniform; BENCH_CHAT_N/
+BENCH_CHAT_PROMPT/BENCH_CHAT_OUTPUT and BENCH_RAG_N/BENCH_RAG_PROMPT/
+BENCH_RAG_OUTPUT shape the workload.  Stamps chat ITL p50/p99 under
+the RAG load, handoff outcomes, and a greedy outputs digest that must
+match across modes (handoff token identity; perf_check `disagg` gate).
+
 Env knobs: BENCH_TINY=1 (CI smoke on CPU), BENCH_REQUESTS, BENCH_PROMPT,
 BENCH_OUTPUT, BENCH_BATCH, BENCH_STEPS, BENCH_PROBE_TIMEOUT (s),
 BENCH_TPU_TIMEOUT (s, whole TPU run incl. compiles), BENCH_FORCE_CPU=1,
@@ -129,6 +138,21 @@ def _emit(value: float, *, extra: dict) -> None:
         line["cpu_proxy_tok_per_s"] = round(float(value), 2)
     line.update(extra)
     print(json.dumps(line), flush=True)
+
+
+def _outputs_digest(outputs_by_tag: dict) -> str:
+    """Stable digest of every tagged request's output tokens — greedy
+    workloads must produce the SAME digest whatever fleet shape or
+    placement served them (the disagg gate's token-identity check)."""
+    import hashlib
+
+    src = {
+        tag: {str(i): toks for i, toks in sorted(outs.items())}
+        for tag, outs in sorted(outputs_by_tag.items())
+    }
+    return hashlib.sha256(
+        json.dumps(src, sort_keys=True).encode()
+    ).hexdigest()
 
 
 def _attention_data_path() -> str:
@@ -278,6 +302,20 @@ def _write_bench_adapters(root: str, names: list[str], arch: dict) -> dict:
 
 def run_bench(on_tpu: bool) -> dict:
     dp = _dp_replicas()
+    # BENCH_ROLES=mixed|disagg: the prefill/decode disaggregation
+    # scenario (docs/SCALING.md "Disaggregated roles") — concurrent
+    # short-prompt chat streams + long-prompt RAG requests at equal
+    # replica count, stamping chat ITL percentiles, handoff outcomes,
+    # and an outputs digest (greedy, so the digest must match across
+    # modes — handoff token identity).  'disagg' splits the fleet
+    # half prefill / half decode; 'mixed' is the same fleet all-mixed.
+    roles_mode = os.environ.get("BENCH_ROLES", "")
+    if roles_mode not in ("", "mixed", "disagg"):
+        raise ValueError(
+            f"BENCH_ROLES must be 'mixed' or 'disagg' (got {roles_mode!r})"
+        )
+    if roles_mode:
+        dp = max(2, dp)
     if dp > 1 and not on_tpu:
         # one virtual host device per replica, so each replica owns an
         # independent execution stream (the CPU analogue of disjoint dp
@@ -368,6 +406,19 @@ def run_bench(on_tpu: bool) -> dict:
     prefix_chunk_len = int(os.environ.get("BENCH_PREFIX_CHUNK", "64"))
     prefix_tail_len = int(os.environ.get("BENCH_PREFIX_TAIL", "16"))
     kv_host_gb = float(os.environ.get("BENCH_KV_HOST_GB", "1"))
+    # disaggregation scenario knobs (docs/SCALING.md): chat = short
+    # prompt, long-ish decode (the ITL-sensitive stream); RAG = long
+    # prompt, short decode (the prefill pressure)
+    chat_n = int(os.environ.get("BENCH_CHAT_N", "8"))
+    chat_prompt_len = int(os.environ.get("BENCH_CHAT_PROMPT", "16"))
+    chat_output_len = int(os.environ.get("BENCH_CHAT_OUTPUT", "48"))
+    rag_n = int(os.environ.get("BENCH_RAG_N", "12"))
+    rag_prompt_len = int(os.environ.get("BENCH_RAG_PROMPT", "256"))
+    rag_output_len = int(os.environ.get("BENCH_RAG_OUTPUT", "4"))
+    if roles_mode:
+        n_requests = chat_n + rag_n
+        prompt_len = rag_prompt_len
+        output_len = chat_output_len
 
     # the dp fleet boots through the production from_config path, which
     # loads weights from disk — write them once, seed-0 deterministic
@@ -395,7 +446,17 @@ def run_bench(on_tpu: bool) -> dict:
                                  num_blocks=blocks_needed,
                                  cache_dtype=dtype,
                                  enable_prefix_caching=prefix_reuse),
-        kv_host_cache_gb=kv_host_gb if prefix_reuse else 0.0,
+        kv_host_cache_gb=(
+            kv_host_gb if (prefix_reuse or roles_mode) else 0.0
+        ),
+        # disaggregated fleet shape: half prefill / half decode; the
+        # 'mixed' mode runs the SAME config with default (mixed) roles
+        # so the two runs differ only in disaggregation
+        dp_replica_roles=(
+            ("prefill",) * (dp // 2) + ("decode",) * (dp - dp // 2)
+            if roles_mode == "disagg"
+            else ()
+        ),
         scheduler_config=SchedulerConfig(
             max_num_seqs=max_seqs,
             # the 1024 bucket exists for PACKED prefill: the tunnel
@@ -574,6 +635,23 @@ def run_bench(on_tpu: bool) -> dict:
                     3, mcfg.vocab_size, size=prefix_tail_len
                 ).tolist()
             )
+    # disaggregation workload: deterministic chat + RAG prompts, so
+    # the 'mixed' and 'disagg' runs (and any replica placement) see
+    # EXACTLY the same greedy requests and the outputs digest below is
+    # comparable across modes — handoff token identity, checked by the
+    # perf_check `disagg` gate
+    roles_prompts: dict[tuple, list[int]] = {}
+    if roles_mode:
+        for i in range(chat_n):
+            r = np.random.default_rng(9000 + i)
+            roles_prompts[("chat", i)] = r.integers(
+                3, mcfg.vocab_size, size=chat_prompt_len
+            ).tolist()
+        for i in range(rag_n):
+            r = np.random.default_rng(9500 + i)
+            roles_prompts[("rag", i)] = r.integers(
+                3, mcfg.vocab_size, size=rag_prompt_len
+            ).tolist()
     ttft_by_tag: dict[str, list[float]] = {}
     outputs_by_tag: dict[str, dict[int, list[int]]] = {}
 
@@ -593,6 +671,8 @@ def run_bench(on_tpu: bool) -> dict:
     async def one(tag: str, i: int, out_tokens: int) -> int:
         if tag in ("cold", "reuse"):
             ids = list(prefix_prompts[i])
+        elif tag in ("chat", "rag"):
+            ids = list(roles_prompts[(tag, i)])
         else:
             ids = rng.integers(3, mcfg.vocab_size, size=prompt_len).tolist()
         final = None
@@ -608,13 +688,21 @@ def run_bench(on_tpu: bool) -> dict:
             final = out
         m = final.metrics
         produced_n = len(final.outputs[0].token_ids)
-        if tag in ("cold", "reuse"):
+        if tag in ("cold", "reuse", "chat", "rag"):
             outputs_by_tag.setdefault(tag, {})[i] = list(
                 final.outputs[0].token_ids
             )
             if m and m.first_token_time:
                 ttft_by_tag.setdefault(tag, []).append(
                     m.first_token_time - m.arrival_time
+                )
+        if tag == "chat" and m and m.first_token_time:
+            # chat-only ITL: the number the disagg gate ratios — per-
+            # request mean inter-token latency under the RAG load
+            if m.finished_time and produced_n > 1:
+                itls.append(
+                    (m.finished_time - m.first_token_time)
+                    / (produced_n - 1)
                 )
         if tag == "timed" and m and m.first_token_time:
             ttfts.append(m.first_token_time - m.arrival_time)
@@ -664,8 +752,21 @@ def run_bench(on_tpu: bool) -> dict:
         # timed pass, same scope as produced_tok/elapsed
         placed0 = dict(router.placed_by_policy)
         committed0 = router.committed_by_replica()
+        handoffs0 = dict(aengine.handoff_outcomes)
         kv_stats = None
-        if prefix_reuse:
+        if roles_mode:
+            # concurrent chat + RAG at equal replica count: the chat
+            # streams' ITL under this prefill pressure is the number
+            # disaggregation exists to protect
+            await aengine.start()
+            t_roles = time.perf_counter()
+            counts = await asyncio.gather(
+                *[one("chat", i, chat_output_len) for i in range(chat_n)],
+                *[one("rag", i, rag_output_len) for i in range(rag_n)],
+            )
+            produced = sum(counts)
+            elapsed = time.perf_counter() - t_roles
+        elif prefix_reuse:
             # cold pass: first touch of every scenario prefix (the
             # generic warm pass above used UNIQUE random prompts, so
             # compiles are paid but the prefixes are genuinely cold);
@@ -730,10 +831,10 @@ def run_bench(on_tpu: bool) -> dict:
             for k, v in router.committed_by_replica().items()
         }
         return (produced, elapsed, _padded_tokens_total(metrics) - pad0,
-                placement, committed, kv_stats)
+                placement, committed, kv_stats, handoffs0)
 
     (produced, elapsed, padded_tok, placement, committed,
-     kv_stats) = asyncio.run(both_passes())
+     kv_stats, handoffs0) = asyncio.run(both_passes())
     value = produced / elapsed
     # padding fraction of the timed pass: pad slots dispatched over pad
     # slots + real work (prompt tokens enter once even when chunked;
@@ -836,6 +937,46 @@ def run_bench(on_tpu: bool) -> dict:
         # and the cold↔warm token-identity verdict — the perf_check
         # `kv_tier` gate reads exactly these
         **({"kv_tier": kv_stats} if kv_stats is not None else {}),
+        # disaggregation scenario stamps (docs/SCALING.md): chat ITL
+        # percentiles under concurrent RAG load, handoff outcomes over
+        # the timed pass, and the greedy outputs digest the perf_check
+        # `disagg` gate compares across modes (token identity)
+        **(
+            {
+                "roles": {
+                    "mode": roles_mode,
+                    "dp": dp,
+                    "fleet_roles": [
+                        rep.role for rep in aengine._replicas
+                    ],
+                    "chat_requests": chat_n,
+                    "rag_requests": rag_n,
+                    "chat_prompt_len": chat_prompt_len,
+                    "chat_output_len": chat_output_len,
+                    "rag_prompt_len": rag_prompt_len,
+                    "rag_output_len": rag_output_len,
+                    "chat_itl_ms_p50": _pct_ms(itls, 0.50),
+                    "chat_itl_ms_p99": _pct_ms(itls, 0.99),
+                    "chat_ttft_ms_p50": _pct_ms(
+                        ttft_by_tag.get("chat", []), 0.50
+                    ),
+                    "rag_ttft_ms_p50": _pct_ms(
+                        ttft_by_tag.get("rag", []), 0.50
+                    ),
+                    "handoffs_completed": (
+                        aengine.handoff_outcomes["completed"]
+                        - handoffs0["completed"]
+                    ),
+                    "handoffs_fallback": (
+                        aengine.handoff_outcomes["fallback"]
+                        - handoffs0["fallback"]
+                    ),
+                    "outputs_digest": _outputs_digest(outputs_by_tag),
+                }
+            }
+            if roles_mode
+            else {}
+        ),
         "itl_ms_p50": _pct_ms(itls, 0.50),
         "itl_ms_p99": _pct_ms(itls, 0.99),
         **(

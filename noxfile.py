@@ -84,13 +84,17 @@ def chaos_check(session: nox.Session) -> None:
     engine's cold pool and reproduce the uncrashed tokens; and the
     tiered-KV suite (docs/KV_TIERING.md) with its cross-restart
     acceptance — a failpoint-killed engine rebuilds and re-serves a
-    warm prefix from the SURVIVING host tier, token-identically.  Also
+    warm prefix from the SURVIVING host tier, token-identically; and
+    the disaggregation suite (docs/SCALING.md "Disaggregated roles")
+    with its dead-prefill-replica scenario — a prefill replica killed
+    mid-handoff recovers with its role while the staged handoff
+    resumes on the decode sibling, token-identically.  Also
     runs inside the tier-1 suite; this session is the fast standalone
     entry point."""
     session.install("-e", ".[tests]")
     session.run(
         "pytest", "tests/test_supervisor.py", "tests/test_adapter_pool.py",
-        "tests/test_kv_tier.py",
+        "tests/test_kv_tier.py", "tests/test_disagg.py",
         "-q",
         *session.posargs,
         env={"JAX_PLATFORMS": "cpu"},

@@ -396,14 +396,16 @@ def test_placement_counters_and_metric():
     from vllm_tgis_adapter_tpu import metrics
 
     def sample(policy):
+        # summed over label combinations: the counter also carries a
+        # replica_role label (docs/SCALING.md), so one policy can have
+        # several series once a roles test ran in this process
         text = metrics.render().decode()
-        for line in text.splitlines():
-            if (
-                line.startswith("tgis_tpu_frontdoor_placement_total")
-                and f'policy="{policy}"' in line
-            ):
-                return float(re.split(r"\s+", line)[-1])
-        return 0.0
+        return sum(
+            float(re.split(r"\s+", line)[-1])
+            for line in text.splitlines()
+            if line.startswith("tgis_tpu_frontdoor_placement_total")
+            and f'policy="{policy}"' in line
+        )
 
     before = sample("prefix")
     router = _router()

@@ -1,0 +1,437 @@
+"""Prefill/decode disaggregation: replica roles with KV-tier handoff
+(docs/SCALING.md "Disaggregated roles").
+
+Covers the role config/CLI validation surface, the router's role tier,
+the handoff boundary (abort between prefill commit and decode
+admission, duplicate-handoff dedup through the tier's digest path),
+end-to-end token identity of handed-off streams against a
+single-replica mixed baseline (greedy AND seeded-sampled, DELTA
+streams with zero duplicate/missing tokens), and role-aware recovery:
+a prefill replica killed mid-handoff whose staged handoff resumes on
+the decode sibling.
+
+Runs on the CPU backend (conftest virtual-device mesh).
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+
+# --------------------------------------------------------------- fixtures
+
+
+@pytest.fixture(scope="module")
+def role_config(tiny_model_dir):
+    from vllm_tgis_adapter_tpu.engine.config import (
+        CacheConfig,
+        EngineConfig,
+        FrontdoorConfig,
+        LoRAConfig,
+        ModelConfig,
+        ParallelConfig,
+        SchedulerConfig,
+    )
+
+    model_config = ModelConfig.from_pretrained(
+        tiny_model_dir, dtype="float32"
+    )
+
+    def make(roles=(), dp=1, **overrides):
+        kwargs = dict(
+            model_config=model_config,
+            cache_config=CacheConfig(
+                block_size=16, num_blocks=96,
+                cache_dtype=model_config.dtype,
+                enable_prefix_caching=True,
+            ),
+            scheduler_config=SchedulerConfig(
+                max_num_seqs=4, prefill_buckets=(32, 64)
+            ),
+            parallel_config=ParallelConfig(dp_replicas=dp),
+            lora_config=LoRAConfig(),
+            kv_host_cache_gb=1.0,
+            dp_replica_roles=tuple(roles),
+            frontdoor=FrontdoorConfig(enabled=True),
+        )
+        kwargs.update(overrides)
+        return EngineConfig(**kwargs)
+
+    return make
+
+
+def _build(role_config, roles, dp, **overrides):
+    from vllm_tgis_adapter_tpu.engine.async_llm import AsyncLLMEngine
+
+    return AsyncLLMEngine.from_config(
+        role_config(roles=roles, dp=dp, **overrides)
+    )
+
+
+async def _stream(engine, rid, ids, *, max_tokens=12, temperature=0.0,
+                  seed=None):
+    """One DELTA stream; returns every streamed token in order (the
+    zero-duplicate/zero-missing check IS comparing this list)."""
+    from vllm_tgis_adapter_tpu.engine.sampling_params import (
+        RequestOutputKind,
+        SamplingParams,
+    )
+
+    toks: list[int] = []
+    async for out in engine.generate(
+        None,
+        SamplingParams(
+            temperature=temperature, seed=seed, max_tokens=max_tokens,
+            ignore_eos=True, output_kind=RequestOutputKind.DELTA,
+        ),
+        request_id=rid,
+        prompt_token_ids=list(ids),
+    ):
+        toks.extend(out.outputs[0].token_ids)
+    return toks
+
+
+PROMPTS = [
+    [3 + i, 7, 11 + i, 13, 17, 19 + i, 23, 29] for i in range(4)
+]
+SAMPLING = [dict(), dict(temperature=0.9, seed=41),
+            dict(), dict(temperature=0.7, seed=97)]
+
+
+# ------------------------------------------------- config/CLI validation
+
+
+def test_role_validation_refusals(role_config):
+    # no decode-capable replica
+    with pytest.raises(ValueError, match="decode-capable"):
+        role_config(roles=("prefill", "prefill"), dp=2)
+    # no prefill-capable replica
+    with pytest.raises(ValueError, match="prefill-capable"):
+        role_config(roles=("decode", "decode"), dp=2)
+    # roles without the KV tier
+    with pytest.raises(ValueError, match="host KV tier"):
+        role_config(
+            roles=("prefill", "decode"), dp=2, kv_host_cache_gb=0.0
+        )
+    # roles without decode-resume
+    with pytest.raises(ValueError, match="no-decode-resume"):
+        role_config(
+            roles=("prefill", "decode"), dp=2, decode_resume=False
+        )
+    # length mismatch
+    with pytest.raises(ValueError, match="names 2 replica"):
+        role_config(roles=("prefill", "decode"), dp=3)
+    # unknown role name
+    with pytest.raises(ValueError, match="not one of"):
+        role_config(roles=("prefill", "bogus"), dp=2)
+    # single mixed replica stays valid (pre-disaggregation behavior)
+    cfg = role_config()
+    assert cfg.resolved_replica_roles() == ("mixed",)
+    assert not cfg.roles_active()
+
+
+def test_replica_role_uniform_refusal(role_config):
+    # --replica-role prefill with no decode-capable sibling is refused
+    # via the same fleet-level check
+    with pytest.raises(ValueError, match="decode-capable"):
+        role_config(replica_role="prefill")
+
+
+def test_dp_replica_roles_cli_parsing(tiny_model_dir):
+    import sys
+
+    from vllm_tgis_adapter_tpu.engine.config import EngineConfig
+    from vllm_tgis_adapter_tpu.tgis_utils.args import (
+        make_parser,
+        postprocess_tgis_args,
+    )
+
+    old_argv = sys.argv
+    sys.argv = [
+        "test", "--model", tiny_model_dir, "--dtype", "float32",
+        "--dp-replicas", "2",
+        "--dp-replica-roles", " prefill , decode ",
+    ]
+    try:
+        args = postprocess_tgis_args(make_parser().parse_args())
+    finally:
+        sys.argv = old_argv
+    config = EngineConfig.from_args(args)
+    assert config.resolved_replica_roles() == ("prefill", "decode")
+    assert config.roles_active()
+
+
+def test_replica_role_cli_choices():
+    import sys
+
+    from vllm_tgis_adapter_tpu.tgis_utils.args import make_parser
+
+    old_argv = sys.argv
+    sys.argv = ["test", "--replica-role", "sideways"]
+    try:
+        with pytest.raises(SystemExit):
+            make_parser().parse_args(["--replica-role", "sideways"])
+    finally:
+        sys.argv = old_argv
+
+
+# --------------------------------------------------- router role tier
+
+
+def _snap(index, load, role="mixed", prefix=0):
+    from vllm_tgis_adapter_tpu.frontdoor.placement import ReplicaSnapshot
+
+    return ReplicaSnapshot(
+        index=index, load=load, prefix_tokens=prefix, replica_role=role
+    )
+
+
+def test_router_role_tier_filters_before_affinity():
+    from vllm_tgis_adapter_tpu.frontdoor.placement import PlacementRouter
+
+    router = PlacementRouter()
+    snaps = [
+        _snap(0, 0, role="prefill", prefix=64),  # best prefix, wrong role
+        _snap(1, 5, role="decode"),
+        _snap(2, 9, role="mixed"),
+    ]
+    # a decode-kind placement never lands on the prefill replica, even
+    # though it holds the best prefix affinity
+    idx, _ = router.place(snaps, kind="decode")
+    assert idx == 1  # least-loaded among decode-capable
+    # a prefill-kind placement restricts to prefill-capable and the
+    # prefix affinity wins within that set
+    idx, policy = router.place(snaps, kind="prefill")
+    assert idx == 0
+    assert policy == "prefix"
+
+
+def test_router_role_tier_falls_open_when_no_capable():
+    from vllm_tgis_adapter_tpu.frontdoor.placement import PlacementRouter
+
+    router = PlacementRouter()
+    snaps = [_snap(0, 1, role="prefill"), _snap(1, 0, role="prefill")]
+    # availability over purity: with zero decode-capable candidates the
+    # filter falls open instead of stranding the request
+    idx, _ = router.place(snaps, kind="decode")
+    assert idx == 1
+
+
+# ------------------------------------------- end-to-end handoff fleet
+
+
+def test_disagg_fleet_token_identical_to_mixed_baseline(role_config):
+    """The acceptance shape: a prefill+decode fleet streams exactly the
+    tokens a single mixed replica streams — greedy and seeded-sampled,
+    DELTA cadence, zero duplicate or missing tokens — with every
+    request handed off exactly once."""
+
+    async def scenario():
+        fleet = _build(role_config, ("prefill", "decode"), 2)
+        try:
+            got = await asyncio.gather(*[
+                _stream(fleet, f"r{i}", p, **SAMPLING[i])
+                for i, p in enumerate(PROMPTS)
+            ])
+            assert fleet.handoff_outcomes == {
+                "completed": len(PROMPTS), "fallback": 0,
+            }
+            prefill_rep, decode_rep = fleet._replicas
+            assert prefill_rep.role == "prefill"
+            assert prefill_rep.engine.replica_role == "prefill"
+            assert prefill_rep.engine.scheduler.role == "prefill"
+            # the prefill replica is empty after handoff: no decode ran
+            # there, and the decode replica produced the output tokens
+            assert prefill_rep.engine.scheduler.num_unfinished == 0
+            committed = fleet.router.committed_by_replica()
+            assert committed.get(1, 0) > committed.get(0, 0)
+            # role-aware introspection surfaces
+            state = fleet.debug_state()
+            assert state["router"]["handoffs"]["completed"] == len(PROMPTS)
+            assert set(state["router"]["role_queue_depths"]) == {
+                "prefill", "decode",
+            }
+            roles = [r["role"] for r in state["replicas"]]
+            assert roles == ["prefill", "decode"]
+            # the decode role widens the promotion admission throat;
+            # re-roling must restore the class default (a stale wide
+            # bound on a mixed replica re-opens the pool-thrash the
+            # default exists to prevent)
+            decode_engine = fleet._replicas[1].engine
+            assert decode_engine.MAX_INFLIGHT_PROMOTIONS == 32
+            decode_engine.set_replica_role("mixed")
+            assert decode_engine.MAX_INFLIGHT_PROMOTIONS == 8
+            decode_engine.set_replica_role("decode")
+            assert decode_engine.MAX_INFLIGHT_PROMOTIONS == 32
+        finally:
+            await fleet.stop()
+
+        base = _build(role_config, ("mixed",), 1)
+        try:
+            want = await asyncio.gather(*[
+                _stream(base, f"r{i}", p, **SAMPLING[i])
+                for i, p in enumerate(PROMPTS)
+            ])
+        finally:
+            await base.stop()
+        assert got == want
+
+    asyncio.run(scenario())
+
+
+def test_abort_between_commit_and_admission_cancels_record(role_config):
+    """Satellite: an abort landing in the handoff window (prefill
+    commit done, decode admission not yet) cancels the staged record,
+    frees the prefill replica's pins/pages, and answers the final
+    aborted frame — no engine state survives anywhere."""
+
+    async def scenario():
+        fleet = _build(role_config, ("prefill", "decode"), 2)
+        # hold the handoff open: staging happens (commit path), but the
+        # drain is parked until we release it
+        gate = asyncio.Event()
+        real_drain = fleet._drain_handoffs
+
+        async def held_drain(rep):
+            await gate.wait()
+            await real_drain(rep)
+
+        fleet._drain_handoffs = held_drain
+        try:
+            prefill_rep = fleet._replicas[0]
+            alloc = prefill_rep.engine.scheduler.allocator
+            free0 = alloc.num_free
+            task = asyncio.create_task(
+                _stream(fleet, "held", PROMPTS[0], max_tokens=16)
+            )
+            tier = fleet.engine.kv_tier
+            for _ in range(2000):
+                if tier._checkpoints:  # noqa: SLF001 — staged = window open
+                    break
+                await asyncio.sleep(0.005)
+            assert "held" in tier._checkpoints  # noqa: SLF001
+            # the prefill replica already released the request's pages
+            # and pins at staging time
+            assert prefill_rep.engine._seqs == {}  # noqa: SLF001
+            assert alloc.num_free == free0
+            assert not prefill_rep.engine.lora_manager._refs  # noqa: SLF001
+            await fleet.abort("held")
+            # the record is cancelled and the client saw its final
+            # aborted frame (the stream ends with whatever tokens the
+            # prefill replica emitted before the abort)
+            assert tier.pop_checkpoint("held") is None
+            gate.set()
+            toks = await asyncio.wait_for(task, 10)
+            assert len(toks) <= 1  # at most the first-commit token
+            # the released drain found a cancelled/consumed record: the
+            # decode replica never admitted it
+            assert fleet._replicas[1].engine._seqs == {}  # noqa: SLF001
+        finally:
+            gate.set()
+            await fleet.stop()
+
+    asyncio.run(scenario())
+
+
+def test_duplicate_handoff_dedups_through_tier_digests(role_config):
+    """Satellite: two requests with the SAME prompt hand off without
+    demoting the shared pages twice — the tier's digest dedup
+    (``has`` covers committed AND in-flight entries) makes the second
+    capture free."""
+
+    async def scenario():
+        fleet = _build(role_config, ("prefill", "decode"), 2)
+        try:
+            tier = fleet.engine.kv_tier
+            prompt = list(range(3, 3 + 35))  # 2 full pages + tail
+            first = await _stream(fleet, "dup-a", prompt, max_tokens=6)
+            pages_after_first = tier.demoted_pages
+            assert pages_after_first >= 2
+            second = await _stream(fleet, "dup-b", prompt, max_tokens=6)
+            assert second == first  # same greedy prompt, same stream
+            # the second handoff re-used the committed entries: no new
+            # demotion copies for the shared prompt pages
+            assert tier.demoted_pages == pages_after_first
+            assert fleet.handoff_outcomes["completed"] == 2
+        finally:
+            await fleet.stop()
+
+    asyncio.run(scenario())
+
+
+def test_handoff_fallback_is_typed_retryable(role_config):
+    """A handoff that cannot reach a decode replica fails with the
+    typed HandoffError (UNAVAILABLE/503 + Retry-After wire mapping via
+    EngineRestartError subclassing), counted as outcome=fallback."""
+    from vllm_tgis_adapter_tpu.frontdoor.errors import (
+        EngineRestartError,
+        HandoffError,
+        classify,
+    )
+
+    disposition = classify(HandoffError("x", retry_after_s=2.0))
+    assert disposition is not None
+    assert disposition.grpc_code == "UNAVAILABLE"
+    assert disposition.http_status == 503
+    assert issubclass(HandoffError, EngineRestartError)
+
+    async def scenario():
+        fleet = _build(role_config, ("prefill", "decode"), 2)
+        try:
+            # simulate the decode replica quiescing mid-window: the
+            # pre-placement capability check must fail the handoff
+            # retryable, not strand or misroute it
+            fleet._replicas[1].serving = False
+            with pytest.raises(HandoffError):
+                await _stream(fleet, "nowhere", PROMPTS[0])
+            assert fleet.handoff_outcomes["fallback"] == 1
+        finally:
+            fleet._replicas[1].serving = True
+            await fleet.stop()
+
+    asyncio.run(scenario())
+
+
+def test_dead_prefill_replica_handoff_resumes_on_sibling(role_config):
+    """Role-aware recovery: the prefill replica dies BETWEEN staging a
+    handoff and resuming it (the chaos-soak fault site).  The staged
+    record survives in the fleet-shared tier, supervisor recovery
+    adopts it, and the stream completes token-identically on the
+    decode sibling."""
+    from vllm_tgis_adapter_tpu.supervisor import failpoints
+
+    async def scenario():
+        base = _build(role_config, ("mixed",), 1)
+        try:
+            want = await _stream(base, "chaos", PROMPTS[0],
+                                 max_tokens=16)
+        finally:
+            await base.stop()
+
+        fleet = _build(
+            role_config, ("prefill", "decode"), 2,
+            max_engine_restarts=3, engine_restart_backoff_s=0.01,
+        )
+        try:
+            failpoints.arm_site("async.handoff", "raise", 1)
+            got = await asyncio.wait_for(
+                _stream(fleet, "chaos", PROMPTS[0], max_tokens=16), 60
+            )
+            assert got == want
+            # the prefill replica died and recovered with its role
+            history = fleet.supervisor.restart_history
+            assert any(h.get("recovered") for h in history)
+            assert history[0]["replica"] == 0
+            prefill_rep = fleet._replicas[0]
+            assert prefill_rep.role == "prefill"
+            assert prefill_rep.engine.replica_role == "prefill"
+            # the handoff was adopted and resumed, not failed
+            resumed = sum(h.get("resumed", 0) for h in history)
+            assert resumed >= 1
+        finally:
+            failpoints.disarm()
+            await fleet.stop()
+
+    asyncio.run(scenario())

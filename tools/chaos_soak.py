@@ -4,8 +4,11 @@
 proves the *surface*: a SEEDED schedule draws faults (``raise`` /
 ``oom`` / ``hang``) across the failpoint sites while a mixed
 chat/RAG/LoRA workload runs against a supervised engine with the host
-KV tier on (some seeds dp=2), then asserts the global invariants no
-single scenario can (docs/RECOVERY.md "Randomized chaos soak"):
+KV tier on (some seeds dp=2; some of THOSE run a disaggregated
+prefill+decode fleet and always arm the kill-prefill-replica-
+mid-handoff fault — docs/SCALING.md "Disaggregated roles"), then
+asserts the global invariants no single scenario can
+(docs/RECOVERY.md "Randomized chaos soak"):
 
 * every submitted request reaches EXACTLY ONE terminal outcome — a
   completed stream or a typed retryable ``EngineRestartError`` — and
@@ -91,7 +94,8 @@ def _build_fixtures() -> tuple[str, str]:
     return model_dir, adapter_dir
 
 
-def _build_engine(model_dir: str, *, dp: int, watchdog: bool):
+def _build_engine(model_dir: str, *, dp: int, watchdog: bool,
+                  roles: tuple = ()):
     from vllm_tgis_adapter_tpu.engine.async_llm import AsyncLLMEngine
     from vllm_tgis_adapter_tpu.engine.config import (
         CacheConfig,
@@ -116,6 +120,11 @@ def _build_engine(model_dir: str, *, dp: int, watchdog: bool):
         parallel_config=ParallelConfig(dp_replicas=dp),
         lora_config=LoRAConfig(enabled=True, max_loras=2,
                                max_lora_rank=2),
+        # prefill/decode disaggregation seeds (docs/SCALING.md): some
+        # dp=2 schedules run a prefill+decode split, exercising the
+        # handoff path (and the kill-prefill-replica-mid-handoff fault)
+        # under the same invariants
+        dp_replica_roles=tuple(roles),
         kv_host_cache_gb=1.0,
         max_engine_restarts=20,
         engine_restart_window_s=300.0,
@@ -211,7 +220,19 @@ async def _run_seed(seed: int, model_dir: str, adapter_dir: str) -> dict:
 
     rng = random.Random(seed)
     dp = 2 if rng.random() < 0.4 else 1
-    engine = _build_engine(model_dir, dp=dp, watchdog=(dp == 1))
+    # disaggregated-roles seeds: a dp=2 fleet split prefill+decode.
+    # Every request then crosses the handoff boundary, and the seed
+    # ALWAYS arms the kill-prefill-replica-mid-handoff fault below —
+    # role-aware recovery (staged handoffs resume on the decode
+    # sibling) is asserted by the same token-identity invariants.
+    roles = (
+        ("prefill", "decode")
+        if dp == 2 and rng.random() < 0.7
+        else ()
+    )
+    engine = _build_engine(
+        model_dir, dp=dp, watchdog=(dp == 1), roles=roles
+    )
     hang_released: list[str] = []
     try:
         lora_req = await engine.engine.lora_manager.load_lora_adapter(
@@ -240,13 +261,21 @@ async def _run_seed(seed: int, model_dir: str, adapter_dir: str) -> dict:
         warm_shapes = compile_tracker.shapes()
 
         # ---- chaos phase: same workload, seeded fault schedule
+        injected: list[str] = []
+        if roles:
+            # kill-prefill-replica-mid-handoff: armed BEFORE the
+            # workload, so the first handoff drain dies BETWEEN stage
+            # and resume — the staged records survive in the
+            # fleet-shared tier and role-aware recovery must adopt
+            # them onto the decode sibling (docs/SCALING.md)
+            failpoints.arm_site("async.handoff", "raise", 1)
+            injected.append("async.handoff=raise")
         tasks = {
             i: asyncio.create_task(_run_request(
                 engine, f"chaos-{seed}-{i}", spec, lora_req
             ))
             for i, spec in enumerate(specs)
         }
-        injected: list[str] = []
         for _ in range(rng.randint(1, 3)):
             await asyncio.sleep(rng.uniform(0.1, 0.6))
             if all(t.done() for t in tasks.values()):
@@ -332,15 +361,39 @@ async def _run_seed(seed: int, model_dir: str, adapter_dir: str) -> dict:
             h.get("resumed", 0)
             for h in engine.supervisor.restart_history
         )
+        if roles:
+            # role-aware recovery invariants: the fleet actually handed
+            # work off (the warm phase alone guarantees >= 1), the
+            # armed mid-handoff kill recovered the PREFILL replica with
+            # its role intact, and at least one staged handoff was
+            # adopted and resumed rather than lost
+            assert engine.handoff_outcomes["completed"] >= 1, (
+                "roles seed invariant violated: no handoff completed"
+            )
+            assert any(
+                h.get("recovered") and h.get("replica") == 0
+                for h in engine.supervisor.restart_history
+            ), (
+                "roles seed invariant violated: the prefill replica "
+                "was not killed+recovered by the armed handoff fault"
+            )
+            assert engine._replicas[0].role == "prefill"  # noqa: SLF001
+            assert resumed >= 1, (
+                "roles seed invariant violated: the mid-handoff kill's "
+                "staged records were not adopted and resumed"
+            )
         return {
             "seed": seed,
             "dp": dp,
+            "roles": list(roles) or None,
             "requests": len(specs),
             "ok": ok,
             "retryable": retryable,
             "faults": injected,
             "restarts": restarts,
             "resumed": resumed,
+            **({"handoffs": dict(engine.handoff_outcomes)}
+               if roles else {}),
         }
     finally:
         # a count=1 fault that never fired must not bleed into the next
@@ -495,12 +548,19 @@ def main(argv: list[str] | None = None) -> int:
             failures += 1
             print(f"chaos_soak: seed {seed} FAILED: {e}")
             continue
+        roles_note = (
+            f" roles={','.join(stats['roles'])} "
+            f"handoffs={stats['handoffs']['completed']}"
+            if stats.get("roles")
+            else ""
+        )
         print(
             f"chaos_soak: seed {stats['seed']} ok  dp={stats['dp']} "
             f"requests={stats['requests']} "
             f"(ok={stats['ok']} retryable={stats['retryable']}) "
             f"faults=[{', '.join(stats['faults'])}] "
             f"restarts={stats['restarts']} resumed={stats['resumed']}"
+            f"{roles_note}"
         )
     elapsed = time.monotonic() - t0
     if elapsed > BUDGET_S:

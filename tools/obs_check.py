@@ -47,7 +47,11 @@ DEBUG_STATE_KEYS = (
     "events",
 )
 REPLICA_KEYS = ("scheduler", "kv_cache", "in_flight", "step_counter",
-                "serving", "adapter_pool")
+                "serving", "role", "adapter_pool")
+# router-section keys the doc promises (incl. the disaggregation
+# additions: per-role queue depths and handoff outcomes)
+ROUTER_KEYS = ("placed_by_policy", "affinity_hit_rate",
+               "role_queue_depths", "handoffs")
 
 # the front-door metric surface (docs/FRONTDOOR.md) must BOTH be
 # documented in docs/OBSERVABILITY.md and appear on /metrics — adding a
@@ -157,6 +161,10 @@ def main() -> int:
     replicas = state.get("replicas") or [{}]
     state_missing += [
         f"replicas[0].{k}" for k in REPLICA_KEYS if k not in replicas[0]
+    ]
+    router = state.get("router") or {}
+    state_missing += [
+        f"router.{k}" for k in ROUTER_KEYS if k not in router
     ]
     if state_missing:
         print(

@@ -25,6 +25,13 @@ against the checked-in ``PERF_BASELINE.json``:
   (both points see the same load); the floors catch a uniformly slow
   fleet.
 
+* prefill/decode disaggregation (docs/SCALING.md "Disaggregated
+  roles"): the BENCH_ROLES chat+RAG scenario run disaggregated AND
+  all-mixed at equal replica count — disaggregated chat ITL p99 must
+  stay ≤ ``disagg.max_itl_ratio`` (default 0.5×, i.e. ≥ 2× better) of
+  the mixed fleet's, handoff streams token-identical (outputs digest),
+  every handoff taken with zero fallbacks.
+
 Exit codes follow obs_check: 0 green, 1 regression, 2 tool error.
 Update the baseline deliberately with ``--write`` after a reviewed
 perf-relevant change; the JSON records the config knobs it was
@@ -170,6 +177,47 @@ def measure_kv_tier(kv_cfg: dict, runs: int) -> dict:
     return best
 
 
+def measure_disagg(dis_cfg: dict, runs: int) -> tuple[dict, dict]:
+    """ISSUE 11 gate driver: the BENCH_ROLES chat+RAG scenario run
+    twice — a disaggregated (prefill+decode) fleet and an all-mixed
+    fleet at EQUAL replica count (docs/SCALING.md "Disaggregated
+    roles").  Best of ``runs`` per mode = lowest chat ITL p99: the
+    gate is a latency ratio, so 'best' must mean the least
+    load-noise-polluted run on BOTH sides."""
+    backend = dis_cfg.get("backend", "ragged")
+
+    def best_of(mode: str) -> dict:
+        best = None
+        for _ in range(runs):
+            env = dict(dis_cfg.get("env", {}))
+            env["BENCH_ROLES"] = mode
+            line = run_bench(backend, env)
+            roles = line.get("roles")
+            if not roles or roles.get("chat_itl_ms_p99") is None:
+                raise RuntimeError(
+                    f"bench ({mode}) emitted no roles stamps"
+                )
+            if (
+                best is None
+                or roles["chat_itl_ms_p99"]
+                < best["roles"]["chat_itl_ms_p99"]
+            ):
+                best = line
+        return best
+
+    disagg = best_of("disagg")
+    mixed = best_of("mixed")
+    d, m = disagg["roles"], mixed["roles"]
+    print(
+        f"perf_check: disagg   chat itl_p99 {d['chat_itl_ms_p99']}ms "
+        f"vs mixed {m['chat_itl_ms_p99']}ms at dp={d['dp']} "
+        f"(handoffs {d['handoffs_completed']}/"
+        f"{d['handoffs_fallback']} fallback) "
+        f"identical={d['outputs_digest'] == m['outputs_digest']}"
+    )
+    return disagg, mixed
+
+
 def measure_recovery(rec_cfg: dict, runs: int) -> dict:
     """ISSUE 10 gate driver: ``tools/chaos_soak.py --recovery-bench``
     in a subprocess (own engines, shared persistent XLA cache — see
@@ -272,6 +320,18 @@ def main(argv: list[str] | None = None) -> int:
             print(f"perf_check: kv_tier measurement failed: {exc}")
             return 2
 
+    dis_cfg = baseline.get("disagg")
+    dis_line: dict | None = None
+    mixed_line: dict | None = None
+    if dis_cfg:
+        try:
+            dis_line, mixed_line = measure_disagg(
+                dis_cfg, int(dis_cfg.get("runs", runs))
+            )
+        except Exception as exc:  # noqa: BLE001 — tool boundary
+            print(f"perf_check: disagg measurement failed: {exc}")
+            return 2
+
     rec_cfg = baseline.get("recovery")
     rec_line: dict | None = None
     if rec_cfg:
@@ -328,6 +388,11 @@ def main(argv: list[str] | None = None) -> int:
             # declarative too: the ≤2x resumed/uncrashed ratio is the
             # ISSUE 10 acceptance bound, not a measured floor
             out["recovery"] = dict(rec_cfg)
+        if dis_cfg:
+            # declarative (ratio + structural demands): the ≤0.5x
+            # disagg/mixed chat-ITL bound is the ISSUE 11 acceptance
+            # criterion, not a measured floor
+            out["disagg"] = dict(dis_cfg)
         if dp_cfg:
             out["dp"] = {
                 **dp_cfg,
@@ -467,6 +532,46 @@ def main(argv: list[str] | None = None) -> int:
             failures.append(
                 "kv_tier: warm-pass outputs diverged from the cold pass "
                 "(promoted KV must be byte-equivalent to recompute)"
+            )
+
+    if dis_cfg and dis_line is not None and mixed_line is not None:
+        # ISSUE 11 acceptance: chat ITL p99 under concurrent RAG load
+        # ≥ 2x better disaggregated than all-mixed at equal replica
+        # count, handoff streams token-identical (same greedy outputs
+        # digest), every handoff actually taken (none fell back)
+        d, m = dis_line["roles"], mixed_line["roles"]
+        max_ratio = float(dis_cfg.get("max_itl_ratio", 0.5))
+        ratio = d["chat_itl_ms_p99"] / max(m["chat_itl_ms_p99"], 1e-9)
+        if ratio > max_ratio:
+            failures.append(
+                f"disagg: chat ITL p99 {d['chat_itl_ms_p99']}ms is "
+                f"{ratio:.2f}x the mixed fleet's "
+                f"({m['chat_itl_ms_p99']}ms) > allowed {max_ratio}x — "
+                "disaggregation stopped isolating chat from RAG "
+                "prefill"
+            )
+        if d["outputs_digest"] != m["outputs_digest"]:
+            failures.append(
+                "disagg: outputs digest diverged from the mixed fleet "
+                "(handoff must be token-identical)"
+            )
+        min_handoffs = int(dis_cfg.get("min_handoffs", 1))
+        if d.get("handoffs_completed", 0) < min_handoffs:
+            failures.append(
+                f"disagg: {d.get('handoffs_completed')} handoffs "
+                f"completed < required {min_handoffs} (the split fleet "
+                "did not actually hand off)"
+            )
+        if d.get("handoffs_fallback", 0) > 0:
+            failures.append(
+                f"disagg: {d['handoffs_fallback']} handoff(s) fell "
+                "back to retryable failure under a healthy fleet"
+            )
+        if m.get("handoffs_completed", 0) != 0:
+            failures.append(
+                "disagg: the mixed-mode control run handed off "
+                f"{m['handoffs_completed']} request(s) — control is "
+                "contaminated"
             )
 
     if rec_cfg and rec_line is not None:

@@ -61,15 +61,28 @@ class EngineDeadError(RuntimeError):
     pass
 
 
+# replica-role capability sets: the router owns the one canonical
+# table (frontdoor/placement.py) — admission filtering and routing can
+# never diverge on what a role may serve
+from vllm_tgis_adapter_tpu.frontdoor.placement import ROLE_CAPABLE
+
+_PREFILL_CAPABLE = ROLE_CAPABLE["prefill"]
+_DECODE_CAPABLE = ROLE_CAPABLE["decode"]
+
+
 class _Replica:
     """One engine + the concurrency state serializing access to it."""
 
     __slots__ = ("engine", "lock", "new_work", "task", "index",
-                 "last_beat", "in_flight_desc", "serving")
+                 "last_beat", "in_flight_desc", "serving", "role")
 
     def __init__(self, engine: LLMEngine, index: int):
         self.engine = engine
         self.index = index
+        # prefill/decode disaggregation role (docs/SCALING.md
+        # "Disaggregated roles"), stamped by apply_replica_roles;
+        # "mixed" = pre-disaggregation behavior
+        self.role = "mixed"
         # False while this replica's supervisor has it quiesced for a
         # rebuild: the placement router excludes it, the front door's
         # drain estimator stops counting its capacity, and new arrivals
@@ -112,6 +125,11 @@ class AsyncLLMEngine:
         )
 
         self.router = PlacementRouter()
+        # prefill/decode disaggregation (docs/SCALING.md): flipped by
+        # apply_replica_roles when any replica serves a dedicated role;
+        # lifetime handoff outcomes feed /debug/state and the bench
+        self._roles_active = False
+        self.handoff_outcomes = {"completed": 0, "fallback": 0}
         self._owner: dict[str, _Replica] = {}
         self._queues: dict[str, asyncio.Queue] = {}
         # request_ids whose abort() arrived while add_request was still
@@ -266,21 +284,61 @@ class AsyncLLMEngine:
         serving = [rep for rep in self._replicas if rep.serving]
         return serving or self._replicas
 
+    def _role_capable(self, kind: str) -> list[_Replica]:
+        """Serving replicas able to take ``kind`` work ("prefill" =
+        fresh prompts/replays, "decode" = handoff/checkpoint resumes).
+        With roles inactive this is exactly the serving set; with roles
+        active and NO capable replica serving (partial outage) it falls
+        open to the serving set — the same availability-over-purity
+        fallback the router's role tier makes (callers that must not
+        degrade, like the handoff drain, pre-check capability
+        themselves)."""
+        serving = self._serving_replicas()
+        if not self._roles_active:
+            return serving
+        want = (
+            _PREFILL_CAPABLE if kind == "prefill" else _DECODE_CAPABLE
+        )
+        return [rep for rep in serving if rep.role in want] or serving
+
+    def apply_replica_roles(self, roles) -> None:  # noqa: ANN001
+        """Stamp per-replica disaggregation roles (from_config; tests).
+        The role reaches three layers: the replica record (placement,
+        front-door estimators), the engine core (handoff staging at
+        prefill commit, promotion bound), and the scheduler
+        (role-aware backlog estimation)."""
+        roles = tuple(roles)
+        if len(roles) != len(self._replicas):
+            raise ValueError(
+                f"{len(roles)} role(s) for {len(self._replicas)} "
+                "replica(s)"
+            )
+        for rep, role in zip(self._replicas, roles):
+            rep.role = role
+            rep.engine.set_replica_role(role)
+        self._roles_active = any(r != "mixed" for r in roles)
+
     def _frontdoor_room(self, pending: int) -> bool:
-        """Can some SERVING replica take another admission, counting
-        grants already issued but not yet turned into ``add_request``?"""
+        """Can some PREFILL-CAPABLE serving replica take another
+        admission, counting grants already issued but not yet turned
+        into ``add_request``?  Fresh admissions only ever place onto
+        prefill-capable replicas (role tier), so a decode replica's
+        near-empty waiting queue must not open the window."""
         depth = min(
             len(rep.engine.scheduler.waiting)
-            for rep in self._serving_replicas()
+            for rep in self._role_capable("prefill")
         )
         return depth + pending < self.frontdoor.admit_window
 
     def _kv_token_capacity(self) -> float:
-        """Total KV pool size in tokens (the resolve_num_blocks budget
-        across SERVING replicas) — the admission estimator's throughput
-        prior.  A quiesced replica's pool is not capacity."""
+        """Total KV pool size in tokens (the resolve_num_blocks budget)
+        — the admission estimator's throughput prior.  A quiesced
+        replica's pool is not capacity; under disaggregated roles only
+        DECODE-CAPABLE replicas count — tokens are produced there, and
+        a prefill replica's pool turns over into the host tier rather
+        than into output throughput."""
         total = 0
-        for rep in self._serving_replicas():
+        for rep in self._role_capable("decode"):
             scheduler = rep.engine.scheduler
             total += scheduler.allocator.num_blocks * scheduler.block_size
         return float(total)
@@ -290,9 +348,12 @@ class AsyncLLMEngine:
         prompt_token_ids,  # noqa: ANN001 — Optional[list[int]]
         tenant: Optional[str],
         lora_name: Optional[str],
+        kind: str = "prefill",
     ) -> _Replica:
         """Route one request onto a replica (frontdoor/placement.py).
 
+        ``kind`` drives the router's role tier ("prefill" = fresh
+        prompts and replays, "decode" = handoff/checkpoint resumes).
         Single-replica fleets short-circuit — dp=1 routing is exactly
         the pre-router behavior, with no peek_prefix probe and no
         placement accounting."""
@@ -341,6 +402,7 @@ class AsyncLLMEngine:
                 adapter_resident=(
                     pool is not None and pool.resident(lora_name)
                 ),
+                replica_role=rep.role,
             ))
         index, _policy = self.router.place(
             snapshots,
@@ -348,6 +410,7 @@ class AsyncLLMEngine:
             # untagged load must spread by depth, not pile onto one
             # replica behind a sticky "default" entry
             affinity_key=tenant or lora_name,
+            kind=kind,
         )
         for rep in candidates:
             if rep.index == index:
@@ -432,6 +495,12 @@ class AsyncLLMEngine:
             parallel_config=dataclasses.replace(
                 pcfg, data_parallel_size=1, dp_replicas=1
             ),
+            # roles are a FLEET property: the per-replica config must
+            # re-validate as an ordinary dp=1 engine (a one-replica
+            # config can never satisfy the fleet-level role demands);
+            # apply_replica_roles stamps each engine below
+            replica_role="mixed",
+            dp_replica_roles=(),
         )
         engines = []
         for rank in range(dp):
@@ -465,7 +534,12 @@ class AsyncLLMEngine:
         if engines[0].kv_tier is not None:
             for e in engines[1:]:
                 e.adopt_kv_tier(engines[0].kv_tier)
-        return cls(engines)
+        fleet = cls(engines)
+        # prefill/decode disaggregation (docs/SCALING.md): stamp each
+        # replica's role — placement, handoff staging, and the front
+        # door's estimators all read it
+        fleet.apply_replica_roles(config.resolved_replica_roles())
+        return fleet
 
     STATS_INTERVAL_S = 10.0
 
@@ -867,13 +941,19 @@ class AsyncLLMEngine:
 
         replicas = []
         now = time.monotonic()
+        role_depths: dict[str, int] = {}
         for rep in self._replicas:
             state = engine_introspection(rep.engine)
             state["replica"] = rep.index
             state["serving"] = rep.serving
+            state["role"] = rep.role
             state["in_flight"] = rep.in_flight_desc
             state["heartbeat_age_s"] = round(now - rep.last_beat, 3)
             replicas.append(state)
+            role_depths[rep.role] = (
+                role_depths.get(rep.role, 0)
+                + rep.engine.scheduler.num_unfinished
+            )
         events: list[dict] = []
         for rep in self._replicas:
             events.extend(rep.engine.recorder.events())
@@ -896,7 +976,14 @@ class AsyncLLMEngine:
                 if self.frontdoor is not None
                 else None
             ),
-            "router": self.router.debug_state(),
+            "router": {
+                **self.router.debug_state(),
+                # prefill/decode disaggregation (docs/SCALING.md):
+                # waiting+running per replica role, and lifetime
+                # handoff outcomes
+                "role_queue_depths": role_depths,
+                "handoffs": dict(self.handoff_outcomes),
+            },
             # shared host KV tier (engine/kv_tier.py); None when
             # --no-kv-host-cache / library default off
             "kv_host_tier": (
@@ -1151,6 +1238,11 @@ class AsyncLLMEngine:
             rep.in_flight_desc = None
             rep.last_beat = time.monotonic()
             await emit(outs)
+            if engine.pending_handoffs:
+                # prefill-role commit staged finished prompts: move
+                # them onto decode-capable replicas NOW, before the
+                # next prefill wave (docs/SCALING.md)
+                await self._drain_handoffs(rep)
             committed = self._plan_tokens(plan)
             # per-replica committed-token attribution: the placement
             # router's load tiebreak and the bench's per-replica tok/s
@@ -1358,6 +1450,23 @@ class AsyncLLMEngine:
         checkpoints: list = []
         async with rep.lock:
             old = rep.engine
+            # handoffs staged at a commit the step loop died before
+            # draining: records in the tier are adopted by
+            # staged_checkpoints (they resume on a decode-capable
+            # sibling); capture-ladder failures (no record) must fail
+            # retryable HERE — their sequences already left _seqs
+            pending, old.pending_handoffs = old.pending_handoffs, []
+            for rid, ckpt in pending:
+                if ckpt is not None:
+                    continue  # staged fleet-visible; adoption owns it
+                if rid in self._queues:
+                    # the same accounting every exhausted handoff rung
+                    # gets (handoffs_total{outcome="fallback"} +
+                    # handoff_out event + typed HandoffError): an
+                    # operator alerting on the handoff metric must see
+                    # capture failures triaged at death too
+                    self._handoff_fallback(rep, rid, "capture")
+                    failed += 1
             for seq in list(old._seqs.values()):  # noqa: SLF001
                 if not seq.is_finished and seq.num_output_tokens == 0:
                     continue  # replay-safe: restart_replica re-queues it
@@ -1480,6 +1589,7 @@ class AsyncLLMEngine:
                 list(ckpt.prompt_token_ids) + list(ckpt.output_token_ids),
                 ckpt.tenant_id,
                 ckpt.lora_name,
+                kind="decode",  # resumes decode; role tier steers
             )
             if target is rep:  # defensive: never resume onto the dead
                 target = healthy[resumed % len(healthy)]
@@ -1565,6 +1675,137 @@ class AsyncLLMEngine:
                     outcome="resumed"
                 ).inc()
         return resumed, failed
+
+    # ------------------------------------------- prefill→decode handoff
+
+    async def _drain_handoffs(self, src: _Replica) -> None:
+        """Consume the handoffs ``src``'s prefill-role engine staged at
+        its last commit (docs/SCALING.md "Disaggregated roles"): for
+        each, wait out the in-flight tier transfers, validate the
+        staged pages by digest, place a decode-capable replica (role
+        tier + the usual affinity policies over prompt ‖ output), and
+        ``resume_request`` onto it — the kv gate then promotes the
+        pages at that replica's next clean dispatch boundary and decode
+        continues token-identically (zero duplicate or missing streamed
+        tokens: the checkpoint carries the stream offsets).
+
+        Degradation ladder (each rung counted in
+        ``handoffs_total{outcome="fallback"}`` and failed retryable
+        with ``HandoffError``): capture failed on the prefill replica →
+        validation read failed → no decode-capable replica serving →
+        the resume itself raised.  An abort or disconnect between
+        prefill commit and decode admission drops the record with zero
+        engine state (``_resume_consumer_alive``)."""
+        engine = src.engine
+        pending, engine.pending_handoffs = engine.pending_handoffs, []
+        tier = getattr(self.engine, "kv_tier", None)
+        # capture-ladder failures settle synchronously, before the
+        # first await below: a death mid-drain must never strand a
+        # request that has no staged record to be adopted from
+        staged = []
+        for rid, ckpt in pending:
+            if ckpt is None or tier is None:
+                self._handoff_fallback(src, rid, "capture")
+            else:
+                staged.append(ckpt)
+        if staged:
+            await self._resume_handoffs(src, staged, tier)
+
+    async def _resume_handoffs(
+        self, src: _Replica, staged: list, tier
+    ) -> None:
+        from vllm_tgis_adapter_tpu.supervisor import failpoints
+
+        # chaos site (tools/chaos_soak.py): a raise here kills the
+        # prefill replica BETWEEN stage and resume — the records
+        # survive in the fleet-shared tier and supervisor recovery
+        # adopts them onto a decode-capable sibling
+        failpoints.fire("async.handoff")
+        await tier.drain_transfers()
+        for ckpt in staged:
+            rid = ckpt.request_id
+            if not self._resume_consumer_alive(ckpt, tier):
+                continue  # aborted/disconnected pre-admission
+            if not tier.validate_checkpoint(ckpt):
+                tier.pop_checkpoint(rid)
+                self._handoff_fallback(src, rid, "validation")
+                continue
+            targets = [
+                rep for rep in self._replicas
+                if rep.serving
+                and rep is not src
+                and rep.role in _DECODE_CAPABLE
+            ]
+            if not targets:
+                tier.pop_checkpoint(rid)
+                self._handoff_fallback(src, rid, "no_decode_replica")
+                continue
+            target = self._place_replica(
+                list(ckpt.prompt_token_ids) + list(ckpt.output_token_ids),
+                ckpt.tenant_id,
+                ckpt.lora_name,
+                kind="decode",
+            )
+            if target not in targets:  # defensive: router fell open
+                target = min(
+                    targets,
+                    key=lambda r: r.engine.scheduler.num_unfinished,
+                )
+            try:
+                async with target.lock:
+                    # re-checked INSIDE the lock: abort serializes on
+                    # the SOURCE owner's lock, so a cancel can land
+                    # while we awaited this one
+                    if not self._resume_consumer_alive(ckpt, tier):
+                        continue
+                    target.engine.resume_request(ckpt, path="handoff")
+            except Exception:  # noqa: BLE001 — one bad handoff must not sink the rest
+                logger.exception(
+                    "handoff resume of %s onto replica %d failed; "
+                    "falling back to retryable failure",
+                    rid, target.index,
+                )
+                tier.pop_checkpoint(rid)
+                self._handoff_fallback(target, rid, "resume")
+                continue
+            tier.pop_checkpoint(rid)
+            self._owner[rid] = target
+            target.last_beat = time.monotonic()
+            target.new_work.set()
+            self.handoff_outcomes["completed"] += 1
+            metrics.handoffs_total.labels(outcome="completed").inc()
+            metrics.handoff_seconds.observe(
+                max(0.0, time.perf_counter() - ckpt.t0)
+            )
+            target.engine.recorder.record(
+                "handoff_in", rid, step=target.engine.step_counter,
+                trace_id=ckpt.trace_id, from_replica=src.index,
+                output_tokens=len(ckpt.output_token_ids),
+            )
+
+    def _handoff_fallback(
+        self, rep: _Replica, request_id: str, reason: str
+    ) -> None:
+        """One handoff exhausted its ladder: fail the stream retryable
+        (HandoffError → UNAVAILABLE/503 + Retry-After — the retry is
+        cheap, the prompt's pages usually still promote from the
+        tier)."""
+        from vllm_tgis_adapter_tpu.frontdoor.errors import HandoffError
+
+        self.handoff_outcomes["fallback"] += 1
+        metrics.handoffs_total.labels(outcome="fallback").inc()
+        rep.engine.recorder.record(
+            "handoff_out", request_id, step=rep.engine.step_counter,
+            outcome="fallback", reason=reason,
+        )
+        queue = self._queues.get(request_id)
+        if queue is not None:
+            queue.put_nowait(HandoffError(
+                "prefill→decode handoff failed "
+                f"({reason}); partial output was discarded — retry "
+                "shortly",
+                retry_after_s=2.0,
+            ))
 
     def _abort_checkpointed(self, request_id: str):
         """Cancel a staged decode checkpoint (explicit abort during
@@ -1738,6 +1979,10 @@ class AsyncLLMEngine:
                     continue
                 replays.append(seq)
             new_engine.replica_index = rep.index
+            # the replacement serves the SAME disaggregation role the
+            # dead engine did (a rebuilt prefill replica must resume
+            # staging handoffs, not decode)
+            new_engine.set_replica_role(rep.role)
             rep.engine = new_engine
             rep.in_flight_desc = None
             # the replacement's committed-token rates start fresh, in

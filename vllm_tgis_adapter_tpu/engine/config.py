@@ -998,6 +998,18 @@ class EngineConfig:
     frontdoor: FrontdoorConfig = dataclasses.field(
         default_factory=FrontdoorConfig
     )
+    # prefill/decode disaggregation (docs/SCALING.md "Disaggregated
+    # roles"): the role every replica serves when --dp-replica-roles is
+    # not given.  'mixed' (default) is the pre-disaggregation behavior;
+    # 'prefill' replicas run ragged full-bucket prefill only and hand
+    # finished prompts to decode-capable replicas through the host KV
+    # tier (demote at prefill commit, stage a DecodeCheckpoint, resume
+    # at decode admission — the PR-10 machinery verbatim); 'decode'
+    # replicas admit handoffs through the kv gate and run decode.
+    replica_role: str = "mixed"
+    # per-replica role list ("prefill,decode,decode"), length must equal
+    # the replica count; overrides replica_role.  () = uniform.
+    dp_replica_roles: tuple[str, ...] = ()
     # --attention-backend: the serving data path (docs/ATTENTION.md).
     # "bucketed" (default) keeps the solo/packed prefill buckets plus
     # the per-batch-width decode ladder; "ragged" runs the unified
@@ -1050,6 +1062,7 @@ class EngineConfig:
                 "of the replica count (strict disjoint-device vs "
                 "shared-device-tolerant); set exactly one of them > 1"
             )
+        self._validate_replica_roles()
         if self.watchdog_action not in ("snapshot", "restart"):
             raise ValueError(
                 f"--watchdog-action must be 'snapshot' or 'restart' "
@@ -1104,6 +1117,106 @@ class EngineConfig:
         # dp × pp composes: the async fleet builds one PIPELINE per dp
         # replica over a disjoint pp×tp device slice
         # (engine/async_llm.py from_config)
+
+    def resolved_replica_roles(self) -> tuple[str, ...]:
+        """One role per replica: ``dp_replica_roles`` when given, else
+        ``replica_role`` repeated over the replica count."""
+        dp = max(
+            self.parallel_config.data_parallel_size,
+            self.parallel_config.dp_replicas,
+        )
+        if self.dp_replica_roles:
+            return tuple(self.dp_replica_roles)
+        return (self.replica_role,) * dp
+
+    def roles_active(self) -> bool:
+        """True when any replica serves a dedicated (non-mixed) role."""
+        return any(r != "mixed" for r in self.resolved_replica_roles())
+
+    def _validate_replica_roles(self) -> None:
+        """Boot-time refusals for --replica-role / --dp-replica-roles:
+        a role config that could never serve (no decode-capable or no
+        prefill-capable replica) or whose handoff substrate is missing
+        (KV tier off, decode-resume off, pp > 1) fails HERE, loudly,
+        not at the first handoff."""
+        valid = ("prefill", "decode", "mixed")
+        if self.replica_role not in valid:
+            raise ValueError(
+                f"--replica-role must be one of {valid} "
+                f"(got {self.replica_role!r})"
+            )
+        for role in self.dp_replica_roles:
+            if role not in valid:
+                raise ValueError(
+                    f"--dp-replica-roles entry {role!r} is not one of "
+                    f"{valid}"
+                )
+        dp = max(
+            self.parallel_config.data_parallel_size,
+            self.parallel_config.dp_replicas,
+        )
+        if self.dp_replica_roles and len(self.dp_replica_roles) != dp:
+            raise ValueError(
+                f"--dp-replica-roles names {len(self.dp_replica_roles)} "
+                f"replica(s) but the fleet has {dp}; give exactly one "
+                "role per replica"
+            )
+        roles = self.resolved_replica_roles()
+        if all(r == "mixed" for r in roles):
+            return  # pre-disaggregation behavior; nothing to demand
+        if not any(r in ("decode", "mixed") for r in roles):
+            raise ValueError(
+                f"replica roles {roles} have no decode-capable replica "
+                "(decode or mixed): prefill replicas would stage "
+                "handoffs nothing can ever consume"
+            )
+        if not any(r in ("prefill", "mixed") for r in roles):
+            raise ValueError(
+                f"replica roles {roles} have no prefill-capable replica "
+                "(prefill or mixed): fresh requests would have nowhere "
+                "to run their prompt"
+            )
+        if self.kv_host_cache_gb <= 0:
+            raise ValueError(
+                "prefill/decode replica roles require the host KV tier "
+                "(--kv-host-cache-gb > 0): the prefill→decode handoff "
+                "moves KV pages through it (docs/SCALING.md)"
+            )
+        if not self.decode_resume:
+            raise ValueError(
+                "prefill/decode replica roles do not compose with "
+                "--no-decode-resume: the handoff IS a decode "
+                "checkpoint/resume (docs/SCALING.md); drop one flag"
+            )
+        if self.parallel_config.pipeline_parallel_size > 1:
+            raise ValueError(
+                "prefill/decode replica roles do not compose with "
+                "--pipeline-parallel-size > 1 yet (the staged runner "
+                "has no KV-tier gather/scatter plumbing)"
+            )
+        # a max-length prompt whose KV cannot fit the tier can NEVER
+        # hand off: its capture hits the budget rung deterministically
+        # and every retry 503s the same way.  Warn loudly at boot —
+        # the operator should size --kv-host-cache-gb (or cap
+        # --max-model-len) before clients discover this per-request.
+        import numpy as _np
+
+        mcfg = self.model_config
+        per_token = (
+            2 * mcfg.num_layers * mcfg.num_kv_heads * mcfg.head_dim
+            * _np.dtype(self.cache_config.cache_dtype).itemsize
+        )
+        worst = per_token * self.max_model_len
+        budget = self.kv_host_cache_gb * (1 << 30)
+        if worst > budget:
+            _logger.warning(
+                "replica roles: a max-length prompt's KV (~%d MiB at "
+                "--max-model-len %d) exceeds the host tier budget "
+                "(--kv-host-cache-gb %.1f) — such prompts can never "
+                "hand off and will fail retryable every time; raise "
+                "the tier budget or cap the model length",
+                worst >> 20, self.max_model_len, self.kv_host_cache_gb,
+            )
 
     @property
     def max_model_len(self) -> int:
@@ -1230,6 +1343,15 @@ class EngineConfig:
                 getattr(args, "engine_restart_backoff", 0.5) or 0.0
             ),
             decode_resume=not getattr(args, "no_decode_resume", False),
+            replica_role=getattr(args, "replica_role", "mixed")
+            or "mixed",
+            dp_replica_roles=tuple(
+                part.strip()
+                for part in (
+                    getattr(args, "dp_replica_roles", None) or ""
+                ).split(",")
+                if part.strip()
+            ),
             frontdoor=FrontdoorConfig.from_args(args),
             attention_backend=getattr(
                 args, "attention_backend", "bucketed"

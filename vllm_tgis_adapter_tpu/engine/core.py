@@ -236,6 +236,14 @@ class LLMEngine:
         # engine, and on rebuilt replacements): the `replica` label on
         # the per-dispatch step/occupancy metrics
         self.replica_index = 0
+        # prefill/decode disaggregation (docs/SCALING.md "Disaggregated
+        # roles"): stamped by the async layer via set_replica_role.  A
+        # 'prefill' engine stages every sequence that samples its first
+        # token into pending_handoffs at commit (the async layer drains
+        # them onto decode-capable replicas); 'mixed' (default) is the
+        # pre-disaggregation behavior.
+        self.replica_role = "mixed"
+        self.pending_handoffs: list = []
         self._seqs: dict[str, Sequence] = {}
         # explicit device slice (from_config sets it under dp/pp); the
         # supervisor's rebuild reuses it so a replacement engine lands
@@ -692,6 +700,24 @@ class LLMEngine:
             # then recomputes only the uncovered tail via promotion)
             self.scheduler.swap_out_fn = self._tier_swap_out
 
+    def set_replica_role(self, role: str) -> None:
+        """Stamp this replica's disaggregation role (async layer /
+        supervisor rebuild).  A decode replica's admission throat is the
+        in-flight-promotion bound — every handoff arrives as a parked
+        promotion — so it gets a wider bound than the mixed default
+        (each parked promotion still reserves its full prompt pages;
+        the kv gate's recompute fallback stays the overflow valve)."""
+        self.replica_role = role
+        self.scheduler.role = role
+        if role == "decode":
+            self.MAX_INFLIGHT_PROMOTIONS = 32
+        else:
+            # restore the class default on re-role: a widened bound
+            # left behind on a now-mixed replica would let 32 parked
+            # promotions reserve full prompt capacity each — the
+            # pool-thrash the default of 8 exists to prevent
+            self.__dict__.pop("MAX_INFLIGHT_PROMOTIONS", None)
+
     def adopt_kv_tier(self, tier) -> None:  # noqa: ANN001
         """Point this engine at a shared/surviving host KV tier (dp
         fleet construction, supervised rebuild).  The construction-time
@@ -1140,6 +1166,59 @@ class LLMEngine:
         self.recorder.record(
             "resume", rid, step=self.step_counter, trace_id=ckpt.trace_id,
             output_tokens=len(seq.output_token_ids), path=path,
+        )
+
+    # --------------------------------------------- prefill→decode handoff
+
+    def _stage_handoffs(self, plan) -> None:  # noqa: ANN001
+        """Prefill-role commit hook (docs/SCALING.md "Disaggregated
+        roles"): every sequence this commit left MID-DECODE — its
+        prefill finished and its first token sampled (and, for DELTA
+        streams, already emitted by ``_process_sampled``) — leaves this
+        replica NOW, as a staged decode checkpoint the async layer
+        resumes on a decode-capable replica.  Decode plans are scanned
+        too: the only legitimately decoding rows here are precompile
+        warmups (exempt) and requests a role-degraded resume parked on
+        this replica — the latter must bounce back off rather than
+        decode a prefill replica's bucket away."""
+        if isinstance(plan, (RaggedPlan, PackedPrefillPlan)):
+            seqs = [item.seq for item in plan.items]
+        elif isinstance(plan, PrefillPlan):
+            seqs = [plan.seq]
+        else:
+            seqs = list(plan.seqs)
+        for seq in seqs:
+            if (
+                seq.is_finished
+                or seq.num_output_tokens < 1
+                or seq.request_id.startswith("__warmup")
+                or self._seqs.get(seq.request_id) is not seq
+            ):
+                continue
+            self._stage_handoff(seq)
+
+    def _stage_handoff(self, seq: Sequence) -> None:
+        """Capture one finished-prefill sequence for decode handoff:
+        ``checkpoint_decode`` demotes its written pages into the
+        fleet-shared host tier and stages the ``DecodeCheckpoint``
+        (identity, sampler seed, stream offsets, digest-validated
+        pages — the PR-10 record, verbatim); the sequence's device
+        state is released immediately — the demotion gathers were
+        ENQUEUED first, so the device reads its pages before any later
+        program can overwrite them (the ``_tier_demote`` ordering
+        contract).  A ``None`` checkpoint means the capture ladder
+        failed (tier budget, gather failure); the async layer's drain
+        turns that into a retryable ``HandoffError``."""
+        ckpt = self.checkpoint_decode(seq)
+        self.scheduler.finish(seq)
+        self._seqs.pop(seq.request_id, None)
+        self.lora_manager.unpin(seq.lora_name)
+        self.pending_handoffs.append((seq.request_id, ckpt))
+        self.recorder.record(
+            "handoff_out", seq.request_id, step=self.step_counter,
+            trace_id=seq.trace_id, staged=ckpt is not None,
+            output_tokens=seq.num_output_tokens,
+            pages=getattr(ckpt, "pages", 0),
         )
 
     # ------------------------------------------------------------- step loop
@@ -1676,20 +1755,30 @@ class LLMEngine:
 
     def commit_step(self, plan, result, prepared=None) -> list[RequestOutput]:
         """Phase 3 (host, engine lock held): fold sampled tokens back into
-        sequences; requests aborted mid-dispatch are skipped here."""
+        sequences; requests aborted mid-dispatch are skipped here.  On a
+        'prefill'-role replica (docs/SCALING.md "Disaggregated roles"),
+        sequences left mid-decode by this commit — their first token just
+        sampled — are then staged for handoff to a decode replica."""
+        outputs = self._commit_inner(plan, result, prepared)
+        if self.replica_role == "prefill":
+            self._stage_handoffs(plan)
+        return outputs
+
+    def _commit_inner(self, plan, result, prepared=None) -> list[RequestOutput]:
         failpoints.fire("core.commit_step")
         t0 = getattr(prepared, "_obs_plan_t0", None)
         if t0 is not None:
             duration = time.perf_counter() - t0
             rep = str(self.replica_index)
+            role = self.replica_role
             if isinstance(plan, DecodePlan):
-                metrics.decode_step_seconds.labels(replica=rep).observe(
-                    duration
-                )
+                metrics.decode_step_seconds.labels(
+                    replica=rep, replica_role=role
+                ).observe(duration)
             else:
-                metrics.prefill_step_seconds.labels(replica=rep).observe(
-                    duration
-                )
+                metrics.prefill_step_seconds.labels(
+                    replica=rep, replica_role=role
+                ).observe(duration)
         if isinstance(plan, RaggedPlan):
             seqs, toks = [], []
             for item, tok in zip(plan.items, result):

@@ -205,6 +205,13 @@ class Scheduler:
         # ``start=False`` is a pure probe (no promotion started) for
         # chained-decode admissibility checks.
         self.kv_gate = None
+        # prefill/decode disaggregation role (engine/core.py
+        # set_replica_role, docs/SCALING.md): informational for
+        # planning/estimation — a 'prefill' scheduler's running set is
+        # empty by construction (handed-off sequences leave at commit),
+        # and a 'decode' scheduler's waiting set is mostly parked
+        # promotions whose prompt spans restore rather than recompute.
+        self.role = "mixed"
 
     # ------------------------------------------------------------ bookkeeping
 
@@ -229,6 +236,20 @@ class Scheduler:
             remaining_prompt = max(
                 0, len(seq.all_token_ids) - seq.prefill_pos
             )
+            ticket = getattr(seq, "kv_promotion", None)
+            if ticket is not None:
+                # parked host-tier promotion (incl. every resumed
+                # handoff on a decode-role replica): the covered span
+                # will be RESTORED, not recomputed — pricing it as
+                # prefill work would inflate the front door's drain
+                # estimate and fire deadline sheds spuriously
+                remaining_prompt = max(
+                    0,
+                    min(
+                        remaining_prompt,
+                        len(seq.all_token_ids) - ticket.end_tokens,
+                    ),
+                )
             total += remaining_prompt + (seq.params.max_tokens or 0)
         return total
 

@@ -78,7 +78,16 @@ EVENT_KINDS = (
     #                   outcome="fallback" with the reason)
     "resume",         # checkpointed request re-entered an engine and
     #                   decode continued (detail: output_tokens, path =
-    #                   local | cross_replica)
+    #                   local | cross_replica | handoff)
+    "handoff_out",    # prefill-role replica staged a finished prompt
+    #                   for decode handoff at prefill commit
+    #                   (docs/SCALING.md; detail: staged, pages,
+    #                   output_tokens — and outcome="fallback" with the
+    #                   reason when the ladder exhausted)
+    "handoff_in",     # decode-capable replica admitted a handoff (the
+    #                   kv gate promotes its pages at the next clean
+    #                   dispatch boundary; detail: output_tokens,
+    #                   from_replica)
 )
 
 # Per-request decode events are recorded every N committed tokens — one

@@ -75,6 +75,23 @@ class EngineRestartError(RuntimeError):
         self.retry_after_s = retry_after_s
 
 
+class HandoffError(EngineRestartError):
+    """A prefill→decode handoff exhausted its degradation ladder
+    (docs/SCALING.md "Disaggregated roles"): capture failed (tier
+    budget / gather failure on the prefill replica), the staged pages
+    failed the validation read, no decode-capable replica is serving,
+    or the resume itself raised.
+
+    Subclasses ``EngineRestartError`` deliberately: the wire semantics
+    are identical — UNAVAILABLE / 503 with a Retry-After hint, always
+    retryable (the retry is cheap: the prompt's pages usually survive
+    in the host tier and promote instead of recomputing) — so every
+    existing classification site handles it by isinstance.  The
+    distinct type exists for tests, logs, and the
+    ``handoffs_total{outcome="fallback"}`` accounting.
+    """
+
+
 class CapacityError(RuntimeError):
     """Base for engine-side resource exhaustion (not a client error)."""
 

@@ -24,8 +24,9 @@ pool, and prefix cache.  WHERE a request lands then matters twice over:
 async engine builds one ``ReplicaSnapshot`` per SERVING replica (dead
 and recovering replicas are excluded by the caller, so placement drains
 away from a replica the moment its supervisor quiesces it) and routes
-the request to the returned index.  Scoring order: prefix > tenant >
-least-loaded, mirroring the tentpole spec in docs/SCALING.md.
+the request to the returned index.  Scoring order: role (prefill/
+decode disaggregation, docs/SCALING.md "Disaggregated roles") >
+prefix > adapter > tenant > least-loaded.
 """
 
 from __future__ import annotations
@@ -44,6 +45,15 @@ POLICY_ADAPTER = "adapter"
 POLICY_TENANT = "tenant"
 POLICY_LOAD = "load"
 POLICIES = (POLICY_PREFIX, POLICY_ADAPTER, POLICY_TENANT, POLICY_LOAD)
+
+# replica-role capability sets (docs/SCALING.md "Disaggregated roles"):
+# the role TIER sits above every affinity policy — fresh requests run
+# their prompt on prefill-capable replicas, handoff/checkpoint resumes
+# decode on decode-capable ones.  A 'mixed' replica is both.
+ROLE_CAPABLE = {
+    "prefill": ("prefill", "mixed"),
+    "decode": ("decode", "mixed"),
+}
 
 # EWMA weight for the per-replica committed-token rate (load tiebreak +
 # bench attribution); one sample ~= one committed dispatch
@@ -77,6 +87,9 @@ class ReplicaSnapshot:
     # (engine/adapter_pool.py) — TRUE residency, read at decision time,
     # unlike the sticky map which only remembers past placements
     adapter_resident: bool = False
+    # the replica's disaggregation role (prefill/decode/mixed) — the
+    # role TIER filters candidates before any affinity policy scores
+    replica_role: str = "mixed"
 
 
 class PlacementRouter:
@@ -152,6 +165,7 @@ class PlacementRouter:
         snapshots: list[ReplicaSnapshot],
         *,
         affinity_key: Optional[str] = None,
+        kind: str = "prefill",
     ) -> tuple[int, str]:
         """Pick a replica for one request.
 
@@ -162,9 +176,24 @@ class PlacementRouter:
         (anonymous default-tenant traffic) gets no stickiness, so bulk
         un-tenanted load spreads purely by depth.
 
+        ``kind`` drives the ROLE tier above every other policy
+        (docs/SCALING.md "Disaggregated roles"): ``"prefill"`` (fresh
+        requests and replays — they must run their prompt) restricts to
+        prefill-capable replicas, ``"decode"`` (handoff/checkpoint
+        resumes) to decode-capable ones.  If no capable replica is in
+        the candidate set, the filter falls open to the full set —
+        availability beats role purity during a partial outage (callers
+        that must NOT degrade, like the handoff drain, pre-check
+        capability and fail retryable instead).
+
         Returns ``(replica_index, policy)`` with policy one of
-        ``prefix`` / ``tenant`` / ``load``.
+        ``prefix`` / ``adapter`` / ``tenant`` / ``load``.
         """
+        capable_roles = ROLE_CAPABLE.get(kind, ROLE_CAPABLE["prefill"])
+        capable = [
+            s for s in snapshots if s.replica_role in capable_roles
+        ]
+        snapshots = capable or snapshots
         best_load = min(s.load for s in snapshots)
         eligible = [
             s for s in snapshots if s.load <= best_load + self.load_slack
@@ -237,7 +266,9 @@ class PlacementRouter:
             self.placed_by_replica.get(chosen.index, 0) + 1
         )
         try:
-            metrics.frontdoor_placement_total.labels(policy=policy).inc()
+            metrics.frontdoor_placement_total.labels(
+                policy=policy, replica_role=chosen.replica_role
+            ).inc()
         except Exception:  # pragma: no cover — telemetry must not raise
             pass
         return chosen.index, policy

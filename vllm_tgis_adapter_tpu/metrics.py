@@ -250,8 +250,8 @@ decode_step_seconds = _get_or_create(
     Histogram,
     f"{_PREFIX}_decode_step_seconds",
     "Wall time of one fused decode dispatch, plan to commit, per dp "
-    "replica",
-    labelnames=("replica",),
+    "replica and replica role (prefill/decode/mixed)",
+    labelnames=("replica", "replica_role"),
     buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
              5.0),
 )
@@ -259,8 +259,8 @@ prefill_step_seconds = _get_or_create(
     Histogram,
     f"{_PREFIX}_prefill_step_seconds",
     "Wall time of one prefill (chunk or packed) dispatch, plan to "
-    "commit, per dp replica",
-    labelnames=("replica",),
+    "commit, per dp replica and replica role (prefill/decode/mixed)",
+    labelnames=("replica", "replica_role"),
     buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
              5.0),
 )
@@ -452,9 +452,33 @@ frontdoor_placement_total = _get_or_create(
     "Requests placed onto a dp replica by the placement router, by the "
     "policy that won: prefix (prompt prefix resident in that replica's "
     "cache), tenant (tenant/adapter stickiness), load (least-loaded "
-    "fallback).  Never incremented at --dp-replicas 1 (single-replica "
-    "routing short-circuits)",
-    labelnames=("policy",),
+    "fallback); replica_role is the CHOSEN replica's disaggregation "
+    "role (docs/SCALING.md).  Never incremented at --dp-replicas 1 "
+    "(single-replica routing short-circuits)",
+    labelnames=("policy", "replica_role"),
+)
+
+# ------------------------------- prefill/decode disaggregation (handoff)
+
+handoffs_total = _get_or_create(
+    Counter,
+    f"{_PREFIX}_handoffs_total",
+    "Prefill→decode handoffs (docs/SCALING.md 'Disaggregated roles'), "
+    "by outcome: 'completed' = the staged checkpoint resumed on a "
+    "decode-capable replica; 'fallback' = the degradation ladder "
+    "exhausted (capture failure, validation-read failure, no decode "
+    "replica serving, resume failure) and the request failed retryable "
+    "(HandoffError → UNAVAILABLE/503 + Retry-After)",
+    labelnames=("outcome",),
+)
+handoff_seconds = _get_or_create(
+    Histogram,
+    f"{_PREFIX}_handoff_seconds",
+    "Wall time of one completed prefill→decode handoff: capture at "
+    "prefill commit (frontier-capped page gathers + checkpoint "
+    "staging) through validation read, placement, and resume on the "
+    "decode replica",
+    buckets=(0.001, 0.005, 0.02, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0),
 )
 
 # ------------------------------------------------------ LoRA adapter pool

@@ -75,6 +75,10 @@ KNOWN_SITES = (
     "runner.dispatch_prefill",  # prefill dispatch inside the runner
     "supervisor.rebuild",   # engine rebuild — death DURING recovery
     "supervisor.replay",    # request replay — death during replay
+    "async.handoff",        # prefill→decode handoff drain, between the
+    #                         stage and the resume (docs/SCALING.md):
+    #                         a raise here is the kill-prefill-replica-
+    #                         mid-handoff chaos scenario
 )
 
 #: Sites that run in worker threads (asyncio.to_thread) — the only

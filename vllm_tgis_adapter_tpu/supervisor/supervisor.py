@@ -456,11 +456,14 @@ class EngineSupervisor:
             await asyncio.sleep(backoff)
         old = rep.engine
         new_engine = await asyncio.to_thread(self._rebuild, old)
-        # stamp the replica index BEFORE the precompile re-warm: its
-        # warmup dispatches record per-replica step metrics, which must
-        # not land in replica 0's histograms (restart_replica stamps it
+        # stamp the replica index AND role BEFORE the precompile
+        # re-warm: its warmup dispatches record per-replica/role step
+        # metrics, which must not land in replica 0's histograms, and a
+        # prefill-role engine's warmups must run under the handoff
+        # exemption it will serve with (restart_replica stamps both
         # again, harmlessly)
         new_engine.replica_index = rep.index
+        new_engine.set_replica_role(rep.role)
         # re-warm the serving shapes the boot warmed: the rebuilt
         # runner's jitted programs are cold, and the first real request
         # must not pay a multi-second compile sweep

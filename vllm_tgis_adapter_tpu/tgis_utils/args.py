@@ -273,6 +273,24 @@ def add_engine_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         "owns its own scheduler, KV pool, and step "
                         "loop).  docs/SCALING.md; mutually exclusive "
                         "with --data-parallel-size > 1")
+    g.add_argument("--replica-role", type=str, default="mixed",
+                   choices=("prefill", "decode", "mixed"),
+                   help="prefill/decode disaggregation (docs/SCALING.md "
+                        "'Disaggregated roles'): the role every replica "
+                        "serves when --dp-replica-roles is not given.  "
+                        "'prefill' replicas run full-bucket prefill and "
+                        "hand finished prompts to decode-capable "
+                        "replicas through the host KV tier; 'decode' "
+                        "replicas admit those handoffs and run decode; "
+                        "'mixed' (default) is the pre-disaggregation "
+                        "behavior.  Non-mixed roles require the KV tier "
+                        "and at least one prefill-capable AND one "
+                        "decode-capable replica (validated at boot)")
+    g.add_argument("--dp-replica-roles", type=str, default=None,
+                   help="comma-separated per-replica role list, e.g. "
+                        "'prefill,decode,decode,mixed' — length must "
+                        "equal the replica count; overrides "
+                        "--replica-role")
 
     g = parser.add_argument_group("front door (admission control)")
     g.add_argument("--max-waiting-requests", type=int, default=0,
