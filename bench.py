@@ -157,11 +157,12 @@ def _outputs_digest(outputs_by_tag: dict) -> str:
 
 def _attention_data_path() -> str:
     """Serving data path for this run: ``--attention-backend=X`` argv or
-    BENCH_ATTENTION_BACKEND (docs/ATTENTION.md); bucketed by default."""
+    BENCH_ATTENTION_BACKEND (docs/ATTENTION.md); ragged — the only
+    backend — by default ('bucketed' fails engine boot)."""
     for arg in sys.argv[1:]:
         if arg.startswith("--attention-backend="):
             return arg.split("=", 1)[1]
-    return os.environ.get("BENCH_ATTENTION_BACKEND", "bucketed")
+    return os.environ.get("BENCH_ATTENTION_BACKEND", "ragged")
 
 
 def _dp_replicas() -> int:
@@ -363,6 +364,7 @@ def run_bench(on_tpu: bool) -> dict:
         ModelConfig,
         ParallelConfig,
         SchedulerConfig,
+        SpeculativeConfig,
     )
     from vllm_tgis_adapter_tpu.engine.core import LLMEngine
     from vllm_tgis_adapter_tpu.engine.sampling_params import SamplingParams
@@ -374,13 +376,6 @@ def run_bench(on_tpu: bool) -> dict:
     backend = jax.default_backend()
     device = jax.devices()[0]
     data_path = _attention_data_path()
-    # the variant the run STARTS with; "decode_kernel" in the emitted
-    # stats is re-read after the run, so a serving-path degradation
-    # (degrade_decode_kernel) shows up as requested != dispatched plus
-    # the decode_kernel_degrades event list
-    requested_kernel = (
-        attn_ops.decode_kernel_variant() if attn_ops._use_pallas() else None
-    )
     tiny = os.environ.get("BENCH_TINY", "") == "1" or backend != "tpu"
     profile = os.environ.get("BENCH_ARCH") or None
     n_requests = int(os.environ.get("BENCH_REQUESTS", 16 if tiny else 128))
@@ -415,6 +410,15 @@ def run_bench(on_tpu: bool) -> dict:
     rag_n = int(os.environ.get("BENCH_RAG_N", "12"))
     rag_prompt_len = int(os.environ.get("BENCH_RAG_PROMPT", "256"))
     rag_output_len = int(os.environ.get("BENCH_RAG_OUTPUT", "4"))
+    # speculative-decoding scenario knobs (docs/ATTENTION.md
+    # "Speculative decoding"): BENCH_SPEC=1 attaches a SAME-WEIGHTS
+    # draft (the perfect-draft proxy — acceptance sits at the ceiling,
+    # so the run measures the verify-span machinery, not draft
+    # quality) and stamps acceptance + accepted-tokens/dispatch; the
+    # perf_check `spec` gate ratios chat ITL against a BENCH_SPEC=0
+    # run of the same decode-heavy workload
+    spec_mode = os.environ.get("BENCH_SPEC", "") == "1"
+    spec_gamma = int(os.environ.get("BENCH_SPEC_GAMMA", "4"))
     if roles_mode:
         n_requests = chat_n + rag_n
         prompt_len = rag_prompt_len
@@ -489,6 +493,15 @@ def run_bench(on_tpu: bool) -> dict:
             else LoRAConfig()
         ),
         attention_backend=data_path,
+        speculative=(
+            SpeculativeConfig(
+                draft_model=model_dir,
+                num_speculative_tokens=spec_gamma,
+                draft_model_config=mcfg,
+            )
+            if spec_mode
+            else None
+        ),
         quantization=(
             "int8"
             if dp > 1 and os.environ.get("BENCH_QUANT", "") == "1"
@@ -524,6 +537,11 @@ def run_bench(on_tpu: bool) -> dict:
             LLMEngine(config, model, params, tokenizer)
         )
         engines = [aengine.engine]
+        if spec_mode:
+            # same-weights draft, attached directly (the dp=1 path
+            # skips from_config's weight load); its KV caches are its
+            # own — only the parameters are shared
+            engines[0].attach_speculative(LlamaForCausalLM(mcfg), params)
 
     # BENCH_PRECOMPILE=1: run the boot-time shape warmup first and stamp
     # the number of compiled programs it took — the FULL compile lattice
@@ -536,12 +554,11 @@ def run_bench(on_tpu: bool) -> dict:
             eng.precompile()
         precompiled_shapes = compile_tracker.num_shapes()
 
-    # count packed multi-prompt prefill dispatches (engine/scheduler.py):
-    # the serving-path feature the bench is meant to exercise — summed
-    # over the replica fleet
-    from vllm_tgis_adapter_tpu.engine.scheduler import PackedPrefillPlan
+    # count speculative verify dispatches (scheduler verify spans) —
+    # summed over the replica fleet
+    from vllm_tgis_adapter_tpu.engine.scheduler import RaggedPlan
 
-    pack_stats = {"packed_dispatches": 0, "packed_prompts": 0,
+    pack_stats = {"verify_dispatches": 0, "verify_spans": 0,
                   "chained_dispatches": 0, "host_syncs": 0}
 
     def instrument(eng) -> None:
@@ -549,9 +566,11 @@ def run_bench(on_tpu: bool) -> dict:
 
         def counting_schedule(**kwargs):
             plan = orig_schedule(**kwargs)
-            if isinstance(plan, PackedPrefillPlan):
-                pack_stats["packed_dispatches"] += 1
-                pack_stats["packed_prompts"] += len(plan.items)
+            if isinstance(plan, RaggedPlan):
+                spans = sum(1 for i in plan.items if i.spec_width > 0)
+                if spans:
+                    pack_stats["verify_dispatches"] += 1
+                    pack_stats["verify_spans"] += spans
             return plan
 
         eng.scheduler.schedule = counting_schedule
@@ -688,7 +707,7 @@ def run_bench(on_tpu: bool) -> dict:
             final = out
         m = final.metrics
         produced_n = len(final.outputs[0].token_ids)
-        if tag in ("cold", "reuse", "chat", "rag"):
+        if tag in ("cold", "reuse", "chat", "rag", "timed"):
             outputs_by_tag.setdefault(tag, {})[i] = list(
                 final.outputs[0].token_ids
             )
@@ -883,16 +902,6 @@ def run_bench(on_tpu: bool) -> dict:
         "attention_backend": (
             "pallas" if attn_ops._use_pallas() else "xla"
         ),
-        # post-run read: the variant decode dispatches actually ended on
-        # (degradation is sticky), not the one the run was asked for
-        "decode_kernel": (
-            attn_ops.decode_kernel_variant()
-            if attn_ops._use_pallas() else None
-        ),
-        "decode_kernel_requested": requested_kernel,
-        # every folded→perhead→xla step the process took, timestamped —
-        # a 4x tok/s drop with a non-empty list here is attributable
-        "decode_kernel_degrades": attn_ops.decode_kernel_degrades(),
         "device_kind": device.device_kind,
         "mfu": mfu,
         "model_gflop_per_tok": round(flops_per_tok / 1e9, 3),
@@ -979,6 +988,38 @@ def run_bench(on_tpu: bool) -> dict:
         ),
         "itl_ms_p50": _pct_ms(itls, 0.50),
         "itl_ms_p99": _pct_ms(itls, 0.99),
+        # greedy outputs digest of the timed pass: the perf_check
+        # `spec` gate compares it across BENCH_SPEC=1/0 runs (verify
+        # spans must be token-identical to plain decode under greedy)
+        **(
+            {"timed_outputs_digest": _outputs_digest(
+                {"timed": outputs_by_tag.get("timed", {})}
+            )}
+            if not roles_mode and not prefix_reuse
+            else {}
+        ),
+        # speculative stamps (docs/ATTENTION.md): acceptance and
+        # per-dispatch accepted tokens over the timed pass
+        **(
+            {
+                "spec": {
+                    "gamma": spec_gamma,
+                    "proposed": engines[0].runner.spec.stats.proposed,
+                    "accepted": engines[0].runner.spec.stats.accepted,
+                    "acceptance_rate": round(
+                        engines[0].runner.spec.stats.acceptance_rate, 4
+                    ),
+                    "verify_dispatches": pack_stats["verify_dispatches"],
+                    "accepted_tokens_per_dispatch": round(
+                        engines[0].runner.spec.stats.accepted
+                        / max(1, engines[0].runner.spec.stats.dispatches),
+                        3,
+                    ),
+                }
+            }
+            if spec_mode
+            else {}
+        ),
         **(
             {
                 # adapter-churn stamps (docs/LORA.md): pool swap counts
@@ -1015,33 +1056,16 @@ def _tpu_child() -> None:
         msg = f"child backend is {jax.default_backend()}, not tpu"
         raise SystemExit(msg)
     kernel_error = None
-    # the bench still leads with the fast folded kernel (the serving
-    # default is the hardware-validated perhead, ops/attention.py); an
-    # explicit operator choice is respected as before
-    defaulted_kernel = "PALLAS_DECODE_KERNEL" not in os.environ
-    if defaulted_kernel:
-        os.environ["PALLAS_DECODE_KERNEL"] = "folded"
     try:
         stats = run_bench(True)
     except Exception as exc:  # noqa: BLE001
         # Pallas lowering/compile failures must degrade to a slower
         # NUMBER, never to a 0.0 score (round-2 lesson: a kernel bug
-        # zeroed the whole round).  Chain: folded decode kernel ->
-        # per-head decode kernel -> XLA attention.
+        # zeroed the whole round).  Chain: ragged Pallas kernel ->
+        # XLA attention (the folded/perhead decode ladder is retired).
         if os.environ.get("ATTENTION_BACKEND") == "xla":
             raise
         kernel_error = f"{type(exc).__name__}: {exc}"
-    if kernel_error and defaulted_kernel:
-        # retries happen OUTSIDE the except block: the live traceback
-        # would otherwise pin the failed run's weights/KV buffers in
-        # HBM while the fallback loads its own copy
-        os.environ["PALLAS_DECODE_KERNEL"] = "perhead"
-        try:
-            stats = run_bench(True)
-            stats["pallas_fallback"] = "perhead"
-            kernel_error = None
-        except Exception as exc:  # noqa: BLE001
-            kernel_error = f"{kernel_error}; perhead: {type(exc).__name__}: {exc}"
     if kernel_error:
         os.environ["ATTENTION_BACKEND"] = "xla"
         stats = run_bench(True)

@@ -83,8 +83,23 @@ def adapter_cache_dir(tmp_path_factory) -> str:
     return str(cache)
 
 
+def _require_pb() -> None:
+    """Skip (don't error) when the protoc-generated gRPC bindings are
+    unavailable: the pb package probes every pb2 module at import and
+    regenerates stale ones, which needs protoc on PATH."""
+    try:  # pragma: no cover - environment probe
+        import vllm_tgis_adapter_tpu.grpc.pb  # noqa: F401
+    except ImportError as e:
+        pytest.skip(
+            f"protoc-generated gRPC bindings unavailable ({e}); install "
+            "protoc (or a wheel with prebuilt pb2 modules) to run the "
+            "dual-server suites"
+        )
+
+
 @pytest.fixture(scope="session")
 def server_args(tiny_model_dir, adapter_cache_dir):
+    _require_pb()
     from tests.utils import get_random_port
 
     return _build_args(
@@ -111,6 +126,7 @@ def server_args(tiny_model_dir, adapter_cache_dir):
 def _servers(server_args):
     """Boot the REAL dual-server stack (no mock engine) in a background
     thread's event loop, mirroring the reference's integration strategy."""
+    _require_pb()
     import asyncio
     import threading
     import urllib.request

@@ -196,7 +196,7 @@ def test_unknown_target_modules_rejected(tmp_path):
 # ------------------------------------------------------------- engine-level
 
 
-def _engine_config(tiny_model_dir, *, backend="bucketed", max_loras=2,
+def _engine_config(tiny_model_dir, *, backend="ragged", max_loras=2,
                    max_num_seqs=4, pool=True):
     from vllm_tgis_adapter_tpu.engine.config import (
         CacheConfig,
@@ -240,7 +240,7 @@ def _run_requests(engine, reqs, *, max_tokens=6):
     return {k: v.outputs[0].token_ids for k, v in outs.items()}
 
 
-@pytest.mark.parametrize("backend", ["bucketed", "ragged"])
+@pytest.mark.parametrize("backend", ["ragged"])
 def test_cross_adapter_batch_token_identical_to_solo(
     tiny_model_dir, lora_dirs, backend
 ):

@@ -435,3 +435,56 @@ def test_dead_prefill_replica_handoff_resumes_on_sibling(role_config):
             await fleet.stop()
 
     asyncio.run(scenario())
+
+
+def test_stage_handoffs_skips_mid_chunk_resumed_rows(role_config):
+    """Regression (chaos soak seed 20260806): a request resumed onto a
+    prefill-role replica MID-CHUNK through its recompute tail carries
+    output tokens from its first life but is still WAITING (pages held,
+    queued for the next chunk).  Staging it for handoff at that commit
+    hands off a stale checkpoint while the scheduler keeps running it
+    from the waiting queue — the stream then executes on BOTH replicas
+    and the client sees duplicated tokens.  Mid-chunk rows must stage
+    only at their final-chunk commit."""
+    from vllm_tgis_adapter_tpu.engine.config import SchedulerConfig
+    from vllm_tgis_adapter_tpu.engine.core import LLMEngine
+    from vllm_tgis_adapter_tpu.engine.sampling_params import SamplingParams
+    from vllm_tgis_adapter_tpu.engine.scheduler import RaggedPlan
+    from vllm_tgis_adapter_tpu.engine.sequence import SequenceStatus
+
+    config = role_config(
+        scheduler_config=SchedulerConfig(
+            max_num_seqs=4, prefill_buckets=(16, 32, 64),
+            max_num_batched_tokens=16,
+        ),
+    )
+    engine = LLMEngine.from_config(config)
+    engine.set_replica_role("prefill")
+    engine.add_request(
+        "mid", None,
+        SamplingParams(temperature=0.0, max_tokens=8, ignore_eos=True),
+        prompt_token_ids=list(range(3, 45)),  # 42 tokens → 3 chunks @16
+    )
+    seq = engine._seqs["mid"]
+    # simulate the resumed-request shape: output tokens from the first
+    # life, prefill still mid-chunk on THIS replica
+    seq.output_token_ids.append(7)
+    outputs, plan, prepared = engine.plan_step()
+    assert isinstance(plan, RaggedPlan)
+    assert seq.status == SequenceStatus.WAITING  # mid-chunk
+    engine.commit_step(
+        plan, engine.execute_step(plan, prepared), prepared
+    )
+    assert not engine.pending_handoffs, (
+        "a mid-chunk resumed row was staged for handoff — it would "
+        "double-execute"
+    )
+    assert engine._seqs.get("mid") is seq  # still owned by this replica
+    # run to the FINAL chunk commit: now it stages exactly once
+    for _ in range(20):
+        if engine.pending_handoffs or not engine.has_unfinished_requests():
+            break
+        engine.step()
+    assert len(engine.pending_handoffs) == 1
+    rid, ckpt = engine.pending_handoffs[0]
+    assert rid == "mid" and ckpt is not None

@@ -227,7 +227,7 @@ def test_engine_records_lifecycle_and_debug_state(tiny_model_dir):
     assert state["compile_tracker"]["compiled_shapes"] >= 0
     assert state["watchdog"]["deadline_s"] == 120.0
     kinds = {e["kind"] for e in state["events"]}
-    assert {"admit", "prefill", "decode", "finish"} <= kinds
+    assert {"admit", "ragged_step", "finish"} <= kinds
 
     assert missing is None
     assert trace["request_id"] == "fr-live-1"

@@ -7,6 +7,8 @@ deselected by default, mirroring the reference's test gating.
 
 from __future__ import annotations
 
+import os
+
 import json
 from pathlib import Path
 
@@ -108,6 +110,12 @@ def test_convert_fast_tokenizer_roundtrip(tmp_path, tiny_model_dir):
 
 
 @pytest.mark.hf_data
+@pytest.mark.network
+@pytest.mark.skipif(
+    not os.environ.get("RUN_NETWORK_TESTS"),
+    reason="live hub download needs network access (RUN_NETWORK_TESTS=1 "
+           "to opt in)",
+)
 def test_download_weights_live():
     hub.download_weights("bigscience/bloom-560m", extension=".safetensors")
 

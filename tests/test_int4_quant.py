@@ -252,6 +252,11 @@ def test_quantization_flag_must_match_checkpoint(tmp_path):
 
 
 def test_awq_checkpoint_serves_over_grpc(tmp_path):
+    pytest.importorskip(
+        "vllm_tgis_adapter_tpu.grpc.pb.generation_pb2",
+        reason="protoc-generated gRPC bindings unavailable; install "
+               "protoc to run the gRPC serving path",
+    )
     """End-to-end: an AWQ int4 llama checkpoint boots the dual-server
     stack (reference --quantize parity) and answers a generation RPC
     with the same greedy tokens as the fp checkpoint it was packed from

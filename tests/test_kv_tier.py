@@ -153,7 +153,7 @@ def test_store_shrunk_to_zero_fails_ticket():
 
 
 def _build_engine(tiny_model_dir, *, tier_gb=1.0, num_blocks=6,
-                  backend="bucketed", prefix_caching=True, max_seqs=4):
+                  backend="ragged", prefix_caching=True, max_seqs=4):
     import jax.numpy as jnp  # noqa: F401
 
     from vllm_tgis_adapter_tpu.engine.config import (
@@ -205,7 +205,7 @@ FILLER_1 = list(range(100, 157))
 FILLER_2 = list(range(200, 257))
 
 
-@pytest.mark.parametrize("backend", ["bucketed", "ragged"])
+@pytest.mark.parametrize("backend", ["ragged"])
 def test_demote_promote_token_identity_vs_untiered(tiny_model_dir, backend):
     """Device pool too small to retain the prefix across churn: the warm
     re-send must be served through the host tier (promotion observed)

@@ -1,18 +1,16 @@
-"""Packed (multi-prompt) prefill: one dispatch carries several prompts.
+"""Packed (block-diagonal) prefill attention: ops-level mask machinery.
 
-The reference's engine batches waiting prompts into a single forward
-(vLLM continuous batching, consumed at
-/root/reference/src/vllm_tgis_adapter/grpc/grpc_server.py:205-225); the
-TPU-native equivalent concatenates prompts along the token axis of one
-compile bucket under a block-diagonal causal mask
-(engine/scheduler.py PackedPrefillPlan).  These tests pin:
+The ENGINE-level packed-prefill planner (PackedPrefillPlan) is RETIRED —
+the ragged data path subsumes it: a ragged step IS a multi-prompt pack
+without the bucket padding (docs/ATTENTION.md).  What survives here:
 
-* ops-level parity: packed attention == per-prompt attention (XLA and
-  Pallas-interpreter paths);
-* engine-level determinism: packed admission reproduces solo greedy
-  outputs exactly;
-* scheduling: the pack respects bucket/budget/slot limits;
-* abort: killing one packed prompt mid-dispatch doesn't disturb the rest.
+* ops-level parity of the block-diagonal mask (seg_starts), which the
+  prefill kernels keep as generic masking machinery;
+* the multi-prompt-per-dispatch ENGINE property, now delivered by the
+  ragged planner: several whole prompts admitted in ONE dispatch,
+  token-identical to solo admission;
+* the deprecation contract: --attention-backend=bucketed fails boot
+  with a migration pointer.
 """
 
 from __future__ import annotations
@@ -106,300 +104,62 @@ def test_ops_packed_parity_xla_and_pallas_interpret():
         )
 
 
-def test_packed_greedy_matches_solo(tiny_model_dir):
-    """k prompts admitted together (one packed dispatch) must produce
-    exactly the tokens each one gets when admitted alone."""
+def test_multi_prompt_single_dispatch_matches_solo(tiny_model_dir):
+    """Several short prompts admitted together must ride ONE ragged
+    dispatch (the packed-prefill property, without the bucket padding)
+    and reproduce solo greedy outputs exactly."""
     from vllm_tgis_adapter_tpu.engine.sampling_params import SamplingParams
-    from vllm_tgis_adapter_tpu.engine.scheduler import PackedPrefillPlan
 
-    prompts = ["the quick brown", "hello world, this", "to be or not"]
+    prompts = [list(range(3, 10)), list(range(20, 26)), [7, 8, 9, 10]]
 
-    engine = _engine(tiny_model_dir)
-    assert engine.scheduler.allow_packed
-    solo = []
-    for i, p in enumerate(prompts):
-        engine.add_request(
-            f"solo-{i}", p, SamplingParams(temperature=0.0, max_tokens=8)
+    solo = {}
+    eng = _engine(tiny_model_dir)
+    for i, ids in enumerate(prompts):
+        eng.add_request(
+            f"solo-{i}", None,
+            SamplingParams(temperature=0.0, max_tokens=6, ignore_eos=True),
+            prompt_token_ids=ids,
         )
-        solo.append(_drain(engine)[f"solo-{i}"].outputs[0].token_ids)
+        solo[i] = _drain(eng)[f"solo-{i}"].outputs[0].token_ids
 
-    # fresh engine so prefix state/slots match a cold start
-    engine = _engine(tiny_model_dir)
-    packed_plans = []
-    orig_schedule = engine.scheduler.schedule
+    eng2 = _engine(tiny_model_dir)
+    dispatched = []
+    inner = eng2.runner.prepare_ragged
 
-    def spy(**kwargs):
-        plan = orig_schedule(**kwargs)
-        if isinstance(plan, PackedPrefillPlan):
-            packed_plans.append(plan)
-        return plan
+    def spy(plan):
+        dispatched.append(len([i for i in plan.items if not i.is_decode]))
+        return inner(plan)
 
-    engine.scheduler.schedule = spy
-    for i, p in enumerate(prompts):
-        engine.add_request(
-            f"pack-{i}", p, SamplingParams(temperature=0.0, max_tokens=8)
+    eng2.runner.prepare_ragged = spy
+    for i, ids in enumerate(prompts):
+        eng2.add_request(
+            f"batch-{i}", None,
+            SamplingParams(temperature=0.0, max_tokens=6, ignore_eos=True),
+            prompt_token_ids=ids,
         )
-    outputs = _drain(engine)
-    assert packed_plans, "expected at least one packed prefill dispatch"
-    assert len(packed_plans[0].items) == len(prompts)
+    outs = _drain(eng2)
     for i in range(len(prompts)):
-        assert outputs[f"pack-{i}"].outputs[0].token_ids == solo[i], (
-            f"prompt {i} diverged under packed prefill"
+        assert outs[f"batch-{i}"].outputs[0].token_ids == solo[i], (
+            f"prompt {i} diverged under multi-prompt admission"
         )
-
-
-def test_pack_respects_token_budget(tiny_model_dir):
-    """Prompts whose concatenation exceeds the chunk budget / largest
-    bucket must split across dispatches instead of over-packing."""
-    from vllm_tgis_adapter_tpu.engine.sampling_params import SamplingParams
-    from vllm_tgis_adapter_tpu.engine.scheduler import PackedPrefillPlan
-
-    engine = _engine(tiny_model_dir, max_num_batched_tokens=64)
-    for i in range(3):
-        engine.add_request(
-            f"r{i}", None,
-            SamplingParams(temperature=0.0, max_tokens=2, ignore_eos=True),
-            prompt_token_ids=list(range(3, 33)),  # 30 tokens each
-        )
-    plan = engine.scheduler.schedule()
-    assert isinstance(plan, PackedPrefillPlan)
-    # 30 + 30 fits the 64 budget; the third prompt would blow it
-    assert len(plan.items) == 2
-    assert plan.bucket_len == 64
-    assert len(engine.scheduler.waiting) == 1
-
-
-def test_pack_requires_free_slots(tiny_model_dir):
-    """Packing never admits more prompts than free batch rows."""
-    from vllm_tgis_adapter_tpu.engine.sampling_params import SamplingParams
-    from vllm_tgis_adapter_tpu.engine.scheduler import PackedPrefillPlan
-
-    engine = _engine(tiny_model_dir)
-    engine.scheduler._free_slots = engine.scheduler._free_slots[:2]
-    for i in range(4):
-        engine.add_request(
-            f"r{i}", None,
-            SamplingParams(temperature=0.0, max_tokens=2, ignore_eos=True),
-            prompt_token_ids=list(range(3, 10)),
-        )
-    plan = engine.scheduler.schedule()
-    assert isinstance(plan, PackedPrefillPlan)
-    assert len(plan.items) == 2
-
-
-def test_prompt_logprob_requests_never_pack(tiny_model_dir):
-    """prompt_logprobs needs a full-bucket logits pass — those requests
-    stay on the solo path and do not join or start a pack."""
-    from vllm_tgis_adapter_tpu.engine.sampling_params import SamplingParams
-    from vllm_tgis_adapter_tpu.engine.scheduler import (
-        PackedPrefillPlan,
-        PrefillPlan,
+    assert max(dispatched) >= len(prompts), (
+        f"prompts were not admitted in one dispatch: {dispatched}"
     )
 
-    engine = _engine(tiny_model_dir)
-    plans = []
-    orig_schedule = engine.scheduler.schedule
 
-    def spy(**kwargs):
-        plan = orig_schedule(**kwargs)
-        plans.append(plan)
-        return plan
+def test_bucketed_backend_is_a_deprecation_error(tiny_model_dir):
+    """--attention-backend=bucketed fails boot with a migration pointer
+    (the retired backend must not silently alias onto ragged)."""
+    import dataclasses as _dc
 
-    engine.scheduler.schedule = spy
-    engine.add_request(
-        "lp", None,
-        SamplingParams(temperature=0.0, max_tokens=2, prompt_logprobs=2,
-                       ignore_eos=True),
-        prompt_token_ids=list(range(3, 10)),
-    )
-    engine.add_request(
-        "plain", None,
-        SamplingParams(temperature=0.0, max_tokens=2, ignore_eos=True),
-        prompt_token_ids=list(range(3, 10)),
-    )
-    outputs = _drain(engine)
-    assert not any(isinstance(p, PackedPrefillPlan) for p in plans)
-    assert isinstance(plans[0], PrefillPlan)
-    assert plans[0].seq.request_id == "lp"
-    assert outputs["lp"].prompt_logprobs is not None
+    from vllm_tgis_adapter_tpu.engine.config import ModelConfig
 
-
-def test_abort_mid_packed_dispatch(tiny_model_dir):
-    """Aborting one packed prompt between plan and commit must drop only
-    that prompt; its packmates keep their (deterministic) outputs."""
-    from vllm_tgis_adapter_tpu.engine.sampling_params import SamplingParams
-    from vllm_tgis_adapter_tpu.engine.scheduler import PackedPrefillPlan
-
-    prompts = ["the quick brown", "hello world, this", "to be or not"]
-    engine = _engine(tiny_model_dir)
-    solo = []
-    for i, p in enumerate(prompts):
-        engine.add_request(
-            f"solo-{i}", p, SamplingParams(temperature=0.0, max_tokens=8)
-        )
-        solo.append(_drain(engine)[f"solo-{i}"].outputs[0].token_ids)
-
-    engine = _engine(tiny_model_dir)
-    for i, p in enumerate(prompts):
-        engine.add_request(
-            f"pack-{i}", p, SamplingParams(temperature=0.0, max_tokens=8)
-        )
-    outputs, plan, prepared = engine.plan_step()
-    assert isinstance(plan, PackedPrefillPlan)
-    assert len(plan.items) == 3
-    result = engine.execute_step(plan, prepared)
-    aborted = engine.abort_request("pack-1")  # lands mid-dispatch
-    assert aborted is not None and aborted.finished
-    engine.commit_step(plan, result, prepared)
-    finished = _drain(engine)
-    assert "pack-1" not in finished
-    assert finished["pack-0"].outputs[0].token_ids == solo[0]
-    assert finished["pack-2"].outputs[0].token_ids == solo[2]
-
-
-def test_pack_probe_does_not_pin_prefix_pages(tiny_model_dir):
-    """The pack-candidate prefix probe must release its refcounts (code
-    review r4): a cached-prefix candidate that declines packing must not
-    permanently pin its matched pages."""
-    from vllm_tgis_adapter_tpu.engine.config import (
-        CacheConfig,
-        EngineConfig,
-        LoRAConfig,
+    eng = _engine(tiny_model_dir)
+    with pytest.raises(ValueError, match="retired"):
+        _dc.replace(eng.config, attention_backend="bucketed")
+    with pytest.raises(ValueError, match="ragged"):
+        _dc.replace(eng.config, attention_backend="nonsense")
+    assert isinstance(
+        ModelConfig.from_pretrained(tiny_model_dir, dtype="float32"),
         ModelConfig,
-        ParallelConfig,
-        SchedulerConfig,
     )
-    from vllm_tgis_adapter_tpu.engine.core import LLMEngine
-    from vllm_tgis_adapter_tpu.engine.sampling_params import SamplingParams
-
-    mcfg = ModelConfig.from_pretrained(tiny_model_dir, dtype="float32")
-    config = EngineConfig(
-        model_config=mcfg,
-        cache_config=CacheConfig(block_size=16, num_blocks=64,
-                                 cache_dtype=mcfg.dtype,
-                                 enable_prefix_caching=True),
-        scheduler_config=SchedulerConfig(
-            max_num_seqs=8, prefill_buckets=(32, 64, 128)),
-        parallel_config=ParallelConfig(),
-        lora_config=LoRAConfig(),
-    )
-    engine = LLMEngine.from_config(config)
-    alloc = engine.scheduler.allocator
-    cached_prompt = list(range(3, 40))  # 2+ full pages to cache
-
-    engine.add_request(
-        "warm", None,
-        SamplingParams(temperature=0.0, max_tokens=2, ignore_eos=True),
-        prompt_token_ids=cached_prompt,
-    )
-    _drain(engine)
-
-    # head is packable; the candidate hits the cached prefix and must be
-    # skipped WITHOUT keeping the probe's refcounts
-    engine.add_request(
-        "head", None,
-        SamplingParams(temperature=0.0, max_tokens=2, ignore_eos=True),
-        prompt_token_ids=list(range(3, 10)),
-    )
-    engine.add_request(
-        "cand", None,
-        SamplingParams(temperature=0.0, max_tokens=2, ignore_eos=True),
-        prompt_token_ids=list(cached_prompt),
-    )
-    _drain(engine)
-    # every page must be reclaimable once all requests finished: cached
-    # pages sit in the reusable pool, none pinned by leaked refcounts
-    assert alloc.num_free == alloc.num_blocks
-
-
-def test_packed_prefill_with_fsm_rows(tiny_model_dir):
-    """Guided-decoding requests pack too: the packed sampler carries a
-    per-row FSM mask, so each packed prompt's FIRST sampled token already
-    honors its constraint."""
-    from vllm_tgis_adapter_tpu.engine.sampling_params import (
-        SamplingParams,
-        StructuredOutputsParams,
-    )
-    from vllm_tgis_adapter_tpu.engine.scheduler import PackedPrefillPlan
-
-    engine = _engine(tiny_model_dir)
-    packed_plans = []
-    orig_schedule = engine.scheduler.schedule
-
-    def spy(**kwargs):
-        plan = orig_schedule(**kwargs)
-        if isinstance(plan, PackedPrefillPlan):
-            packed_plans.append(plan)
-        return plan
-
-    engine.scheduler.schedule = spy
-    for i in range(2):
-        engine.add_request(
-            f"guided-{i}", f"pick {i}",
-            SamplingParams(
-                temperature=0.0, max_tokens=8,
-                structured_outputs=StructuredOutputsParams(
-                    choice=["yes", "no"]
-                ),
-            ),
-        )
-    outputs = _drain(engine)
-    assert packed_plans and len(packed_plans[0].items) == 2
-    for i in range(2):
-        assert outputs[f"guided-{i}"].outputs[0].text in ("yes", "no")
-
-
-def test_packed_prefill_under_tensor_parallel(tiny_model_dir):
-    """Packed prefill on a tp=2 mesh: the seg_starts operand rides
-    shard_map replicated while heads split — tokens must match the
-    single-device packed run."""
-    import jax
-
-    from vllm_tgis_adapter_tpu.engine.config import (
-        CacheConfig,
-        EngineConfig,
-        LoRAConfig,
-        ModelConfig,
-        ParallelConfig,
-        SchedulerConfig,
-    )
-    from vllm_tgis_adapter_tpu.engine.core import LLMEngine
-    from vllm_tgis_adapter_tpu.engine.sampling_params import SamplingParams
-    from vllm_tgis_adapter_tpu.engine.scheduler import PackedPrefillPlan
-
-    if len(jax.devices()) < 2:
-        pytest.skip("needs the 8-device CPU mesh")
-
-    def run(tp):
-        mcfg = ModelConfig.from_pretrained(tiny_model_dir, dtype="float32")
-        engine = LLMEngine.from_config(EngineConfig(
-            model_config=mcfg,
-            cache_config=CacheConfig(block_size=16, num_blocks=64,
-                                     cache_dtype=mcfg.dtype),
-            scheduler_config=SchedulerConfig(
-                max_num_seqs=8, prefill_buckets=(32, 64)),
-            parallel_config=ParallelConfig(tensor_parallel_size=tp),
-            lora_config=LoRAConfig(),
-        ))
-        packed = []
-        orig = engine.scheduler.schedule
-
-        def spy(**kwargs):
-            plan = orig(**kwargs)
-            if isinstance(plan, PackedPrefillPlan):
-                packed.append(plan)
-            return plan
-
-        engine.scheduler.schedule = spy
-        for i in range(3):
-            engine.add_request(
-                f"r{i}", None,
-                SamplingParams(temperature=0.0, max_tokens=6,
-                               ignore_eos=True),
-                prompt_token_ids=list(range(3 + i, 12 + i)),
-            )
-        outs = _drain(engine)
-        assert packed, "packing did not engage"
-        return {rid: o.outputs[0].token_ids for rid, o in outs.items()}
-
-    assert run(2) == run(1)

@@ -36,9 +36,32 @@ def make_paged_case(seed, b, num_kv, g, head_dim, block_size, max_blocks,
     return q, k_cache, v_cache, block_tables, context_lens
 
 
+def ragged_decode_pallas(q, k_cache, v_cache, block_tables, context_lens,
+                         block_size, scale, *, window=0, alibi_slopes=None):
+    """Serving decode through the RAGGED Pallas kernel (interpret mode):
+    each batch row is a one-token span — the formulation that replaced
+    the retired folded/perhead decode kernels (docs/ATTENTION.md)."""
+    from vllm_tgis_adapter_tpu.ops import ragged_attention as R
+
+    b = int(np.asarray(q).shape[0])
+    pos = jnp.maximum(jnp.asarray(context_lens, jnp.int32), 1) - 1
+    starts = jnp.arange(b + 1, dtype=jnp.int32)
+    block_q = min(8, R._pow2_ceil(b))
+    work = R.dense_work_schedule(
+        pos, jnp.asarray(block_tables, jnp.int32),
+        block_size=block_size, block_q=block_q,
+        t_pad=-(-b // block_q) * block_q,
+    )
+    return R._ragged_attention_pallas(
+        jnp.asarray(q), jnp.asarray(k_cache), jnp.asarray(v_cache),
+        starts, pos, work, block_size, scale, block_q=block_q,
+        window=window, alibi_slopes=alibi_slopes, interpret=True,
+    )
+
+
 @pytest.mark.parametrize("seed", [0, 1])
 @pytest.mark.parametrize("g", [1, 4])
-def test_paged_decode_matches_reference(seed, g):
+def test_ragged_decode_matches_reference(seed, g):
     b, num_kv, head_dim, block_size, max_blocks = 5, 2, 64, 16, 4
     q, k_cache, v_cache, bt, cl = make_paged_case(
         seed, b, num_kv, g, head_dim, block_size, max_blocks, num_slots=512
@@ -48,16 +71,13 @@ def test_paged_decode_matches_reference(seed, g):
         jnp.asarray(q), jnp.asarray(k_cache), jnp.asarray(v_cache),
         jnp.asarray(bt), jnp.asarray(cl), block_size, scale,
     )
-    got = pk.paged_decode_attention(
-        jnp.asarray(q), jnp.asarray(k_cache), jnp.asarray(v_cache),
-        jnp.asarray(bt), jnp.asarray(cl), block_size, scale,
-        interpret=True,
-    )
+    got = ragged_decode_pallas(q, k_cache, v_cache, bt, cl, block_size,
+                               scale)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
 
 
-def test_paged_decode_short_context_ignores_garbage_pages():
+def test_ragged_decode_short_context_ignores_garbage_pages():
     """Pages beyond context_len must not leak into the output even when
     the block table rows carry arbitrary ids there."""
     b, num_kv, g, head_dim, block_size, max_blocks = 2, 2, 2, 64, 16, 4
@@ -73,11 +93,8 @@ def test_paged_decode_short_context_ignores_garbage_pages():
         jnp.asarray(q), jnp.asarray(k_cache), jnp.asarray(v_cache),
         jnp.asarray(bt), jnp.asarray(cl), block_size, scale,
     )
-    got = pk.paged_decode_attention(
-        jnp.asarray(q), jnp.asarray(k_cache), jnp.asarray(v_cache),
-        jnp.asarray(bt_garbage), jnp.asarray(cl), block_size, scale,
-        interpret=True,
-    )
+    got = ragged_decode_pallas(q, k_cache, v_cache, bt_garbage, cl,
+                               block_size, scale)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
 
@@ -179,9 +196,16 @@ def test_pallas_kernels_under_tp_mesh(monkeypatch):
     )
 
     mesh = build_mesh(tensor_parallel_size=4)
-    got = attn.paged_decode_attention(
+    # decode through the serving ragged kernel (one-token spans), mesh
+    # shard_map over the head axis
+    from vllm_tgis_adapter_tpu.ops import ragged_attention as R
+
+    pos = jnp.maximum(jnp.asarray(cl, jnp.int32), 1) - 1
+    got = R.ragged_paged_attention(
         jnp.asarray(q), jnp.asarray(k_cache), jnp.asarray(v_cache),
-        jnp.asarray(bt), jnp.asarray(cl), block_size, scale, mesh=mesh,
+        pos, jnp.arange(b + 1, dtype=jnp.int32), pos,
+        jnp.asarray(b, jnp.int32), jnp.asarray(bt), block_size, scale,
+        mesh=mesh,
     )
     # prefill too
     t, valid = 128, 100
@@ -295,8 +319,8 @@ def test_chunked_prefill_dispatch_under_tp_mesh(monkeypatch):
 
 @pytest.mark.parametrize("window", [8, 24])
 @pytest.mark.parametrize("g", [1, 4])
-def test_windowed_paged_decode_matches_reference(window, g):
-    """Band-masked decode kernel vs the XLA windowed reference."""
+def test_windowed_ragged_decode_matches_reference(window, g):
+    """Band-masked ragged decode vs the XLA windowed reference."""
     b, num_kv, head_dim, block_size, max_blocks = 5, 2, 64, 16, 4
     q, k_cache, v_cache, bt, cl = make_paged_case(
         3, b, num_kv, g, head_dim, block_size, max_blocks, num_slots=512
@@ -306,11 +330,8 @@ def test_windowed_paged_decode_matches_reference(window, g):
         jnp.asarray(q), jnp.asarray(k_cache), jnp.asarray(v_cache),
         jnp.asarray(bt), jnp.asarray(cl), block_size, scale, window=window,
     )
-    got = pk.paged_decode_attention(
-        jnp.asarray(q), jnp.asarray(k_cache), jnp.asarray(v_cache),
-        jnp.asarray(bt), jnp.asarray(cl), block_size, scale,
-        window=window, interpret=True,
-    )
+    got = ragged_decode_pallas(q, k_cache, v_cache, bt, cl, block_size,
+                               scale, window=window)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
 
@@ -395,7 +416,7 @@ def _slopes(h):
 
 
 @pytest.mark.parametrize("g", [1, 4])
-def test_alibi_paged_decode_matches_reference(g):
+def test_alibi_ragged_decode_matches_reference(g):
     b, num_kv, head_dim, block_size, max_blocks = 5, 2, 64, 16, 4
     q, k_cache, v_cache, bt, cl = make_paged_case(
         13, b, num_kv, g, head_dim, block_size, max_blocks, num_slots=512
@@ -407,11 +428,8 @@ def test_alibi_paged_decode_matches_reference(g):
         jnp.asarray(bt), jnp.asarray(cl), block_size, scale,
         alibi_slopes=slopes,
     )
-    got = pk.paged_decode_attention(
-        jnp.asarray(q), jnp.asarray(k_cache), jnp.asarray(v_cache),
-        jnp.asarray(bt), jnp.asarray(cl), block_size, scale,
-        alibi_slopes=slopes, interpret=True,
-    )
+    got = ragged_decode_pallas(q, k_cache, v_cache, bt, cl, block_size,
+                               scale, alibi_slopes=slopes)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
 
@@ -476,8 +494,8 @@ def test_alibi_chunked_prefill_matches_reference():
                                rtol=2e-5, atol=2e-5)
 
 
-def test_paged_decode_fp8_cache_matches_reference():
-    """--kv-cache-dtype float8_e4m3 through the Pallas decode kernel:
+def test_ragged_decode_fp8_cache_matches_reference():
+    """--kv-cache-dtype float8_e4m3 through the ragged Pallas kernel:
     the cache stores f8, the kernel casts to f32 on read — parity with
     the XLA formulation on the same quantized cache (the on-chip Mosaic
     gate for this dtype rides tests/test_tpu_kernels.py)."""
@@ -492,11 +510,7 @@ def test_paged_decode_fp8_cache_matches_reference():
         jnp.asarray(q), kc8, vc8,
         jnp.asarray(bt), jnp.asarray(cl), block_size, scale,
     )
-    got = pk.paged_decode_attention(
-        jnp.asarray(q), kc8, vc8,
-        jnp.asarray(bt), jnp.asarray(cl), block_size, scale,
-        interpret=True,
-    )
+    got = ragged_decode_pallas(q, kc8, vc8, bt, cl, block_size, scale)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
 
@@ -505,10 +519,10 @@ def test_paged_decode_fp8_cache_matches_reference():
     "window,alibi,g",
     [(0, False, 4), (0, False, 1), (24, False, 2), (0, True, 2)],
 )
-def test_paged_decode_perhead_variant_matches(window, alibi, g):
-    """The pre-round-5 per-head grid kernel stays available as
-    PALLAS_DECODE_KERNEL=perhead (bench.py's Mosaic-failure fallback);
-    pin it against the XLA reference alongside the folded default."""
+def test_ragged_decode_mask_combinations_match(window, alibi, g):
+    """Window/ALiBi/GQA combinations through the ONE serving decode
+    kernel (ragged) — the grid the retired folded/perhead variants used
+    to cover."""
     b, num_kv, head_dim, block_size, max_blocks = 4, 2, 64, 16, 4
     q, k_cache, v_cache, bt, cl = make_paged_case(
         3, b, num_kv, g, head_dim, block_size, max_blocks, num_slots=512
@@ -524,90 +538,59 @@ def test_paged_decode_perhead_variant_matches(window, alibi, g):
         jnp.asarray(bt), jnp.asarray(cl), block_size, scale,
         window=window, alibi_slopes=slopes,
     )
-    for variant in ("perhead", "folded"):
-        got = pk.paged_decode_attention(
-            jnp.asarray(q), jnp.asarray(k_cache), jnp.asarray(v_cache),
-            jnp.asarray(bt), jnp.asarray(cl), block_size, scale,
-            window=window, alibi_slopes=slopes, interpret=True,
-            variant=variant,
-        )
-        np.testing.assert_allclose(
-            np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5,
-            err_msg=f"variant={variant}",
-        )
+    got = ragged_decode_pallas(q, k_cache, v_cache, bt, cl, block_size,
+                               scale, window=window, alibi_slopes=slopes)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5,
+    )
 
 
-def test_decode_kernel_default_is_perhead(monkeypatch):
-    """ADVICE r5: the serving default is the hardware-validated per-head
-    kernel; folded stays opt-in until it passes on-chip."""
-    monkeypatch.delenv("PALLAS_DECODE_KERNEL", raising=False)
-    ref_ops.reset_decode_kernel()
-    assert ref_ops.decode_kernel_variant() == "perhead"
-    monkeypatch.setenv("PALLAS_DECODE_KERNEL", "folded")
-    assert ref_ops.decode_kernel_variant() == "folded"
-    ref_ops.reset_decode_kernel()
-
-
-def test_decode_kernel_degradation_chain(monkeypatch):
-    monkeypatch.setenv("PALLAS_DECODE_KERNEL", "folded")
-    ref_ops.reset_decode_kernel()
-    try:
-        assert ref_ops.degrade_decode_kernel() == "perhead"
-        assert ref_ops.decode_kernel_variant() == "perhead"
-        assert ref_ops.degrade_decode_kernel() == "xla"
-        assert ref_ops.degrade_decode_kernel() is None  # floor reached
-    finally:
-        ref_ops.reset_decode_kernel()
-
-
-def test_runner_dispatch_degrades_on_mosaic_rejection(monkeypatch):
-    """The serving dispatch path retries through folded → perhead → xla
-    on Mosaic/Pallas lowering failures instead of crashing the engine;
-    unrelated errors propagate untouched."""
+def test_decode_kernel_ladder_is_retired():
+    """The folded/perhead/xla decode variant ladder is GONE: neither the
+    ops dispatcher nor the Pallas module exposes it, and the runner has
+    no retry chain — a lowering failure is a real error, not a silent
+    slow-path fallback (docs/ATTENTION.md)."""
     from vllm_tgis_adapter_tpu.engine.runner import ModelRunner
 
-    monkeypatch.setenv("PALLAS_DECODE_KERNEL", "folded")
-    ref_ops.reset_decode_kernel()
-    try:
-        calls = []
-
-        def dispatch():
-            calls.append(ref_ops.decode_kernel_variant())
-            if len(calls) < 3:
-                raise RuntimeError(
-                    "Mosaic failed to compile the kernel"
-                )
-            return "ok"
-
-        # _decode_kernel_retry reads no runner state: exercise it bare
-        out = ModelRunner._decode_kernel_retry(None, dispatch)
-        assert out == "ok"
-        assert calls == ["folded", "perhead", "xla"]
-
-        ref_ops.reset_decode_kernel()
-
-        def unrelated():
-            raise ValueError("shape mismatch")
-
-        with pytest.raises(ValueError, match="shape mismatch"):
-            ModelRunner._decode_kernel_retry(None, unrelated)
-        # non-kernel errors must not burn a degradation level
-        assert ref_ops.decode_kernel_variant() == "folded"
-    finally:
-        ref_ops.reset_decode_kernel()
+    for name in ("paged_decode_attention", "decode_kernel_variant",
+                 "degrade_decode_kernel", "reset_decode_kernel",
+                 "is_kernel_lowering_error"):
+        assert not hasattr(ref_ops, name), name
+    assert not hasattr(pk, "paged_decode_attention")
+    assert not hasattr(ModelRunner, "_decode_kernel_retry")
 
 
-def test_decode_kernel_degrade_compare_and_swap(monkeypatch):
-    """Concurrent identical failures burn ONE level: a degrade reporting
-    a variant that is no longer current returns the newer variant
-    without stepping again."""
-    monkeypatch.setenv("PALLAS_DECODE_KERNEL", "folded")
-    ref_ops.reset_decode_kernel()
-    try:
-        assert ref_ops.degrade_decode_kernel("folded") == "perhead"
-        # a second thread that ALSO saw folded fail must not step past
-        # the perhead level the first degrade just selected
-        assert ref_ops.degrade_decode_kernel("folded") == "perhead"
-        assert ref_ops.decode_kernel_variant() == "perhead"
-    finally:
-        ref_ops.reset_decode_kernel()
+def test_ragged_decode_single_row():
+    """b=1 decode (the narrowest serving shape) through the ragged
+    kernel."""
+    b, num_kv, g, head_dim, block_size, max_blocks = 1, 2, 2, 64, 16, 4
+    q, k_cache, v_cache, bt, cl = make_paged_case(
+        21, b, num_kv, g, head_dim, block_size, max_blocks, num_slots=256
+    )
+    scale = head_dim**-0.5
+    ref = ref_ops.paged_decode_attention_xla(
+        jnp.asarray(q), jnp.asarray(k_cache), jnp.asarray(v_cache),
+        jnp.asarray(bt), jnp.asarray(cl), block_size, scale,
+    )
+    got = ragged_decode_pallas(q, k_cache, v_cache, bt, cl, block_size,
+                               scale)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ragged_decode_nonpow2_batch():
+    """Non-power-of-two batch widths must not mis-pad the in-kernel
+    query-block grid (the dense-schedule t_pad regression class)."""
+    b, num_kv, g, head_dim, block_size, max_blocks = 11, 2, 2, 64, 16, 4
+    q, k_cache, v_cache, bt, cl = make_paged_case(
+        23, b, num_kv, g, head_dim, block_size, max_blocks, num_slots=1024
+    )
+    scale = head_dim**-0.5
+    ref = ref_ops.paged_decode_attention_xla(
+        jnp.asarray(q), jnp.asarray(k_cache), jnp.asarray(v_cache),
+        jnp.asarray(bt), jnp.asarray(cl), block_size, scale,
+    )
+    got = ragged_decode_pallas(q, k_cache, v_cache, bt, cl, block_size,
+                               scale)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)

@@ -8,8 +8,15 @@ from pathlib import Path
 import grpc
 import pytest
 
-from vllm_tgis_adapter_tpu.grpc import health
-from vllm_tgis_adapter_tpu.grpc.pb import generation_pb2, health_pb2, rpc
+try:  # pragma: no cover - environment probe
+    from vllm_tgis_adapter_tpu.grpc import health
+    from vllm_tgis_adapter_tpu.grpc.pb import generation_pb2, health_pb2, rpc
+except ImportError as _e:  # protoc missing in this environment
+    pytest.skip(
+        f"protoc-generated gRPC bindings unavailable ({_e}); install "
+        "protoc (or a wheel with prebuilt pb2 modules) to run this suite",
+        allow_module_level=True,
+    )
 
 
 def test_message_roundtrip():

@@ -251,6 +251,17 @@ def _make_engine(model_dir, backend, *, num_blocks=128, max_num_seqs=8,
     return LLMEngine.from_config(config)
 
 
+def _legacy_engine(model_dir, **kwargs):
+    """Same config, but planned through the surviving LEGACY
+    solo-prefill/fused-decode alternation (the pp>1 / sp>1 /
+    prompt-logprob path) — the independent planner the ragged path's
+    token-identity is anchored against now that the bucketed backend is
+    retired."""
+    engine = _make_engine(model_dir, "ragged", **kwargs)
+    engine.scheduler.ragged = False
+    return engine
+
+
 def _run_requests(engine, requests):
     """requests: (rid, prompt_ids, sampling_kwargs, add_kwargs)."""
     from vllm_tgis_adapter_tpu.engine.sampling_params import SamplingParams
@@ -284,28 +295,24 @@ def _mixed_requests(rng, n=6, greedy=True):
     return reqs
 
 
-def test_ragged_equals_bucketed_mixed_batch(tiny_model_dir):
+def test_ragged_equals_legacy_mixed_batch(tiny_model_dir):
     """Greedy mixed batch (staggered lengths/budgets): token-identical
-    to the bucketed solo/packed/fused-decode composition."""
+    to the legacy solo-prefill/fused-decode composition."""
     rng = np.random.default_rng(7)
     reqs = _mixed_requests(rng)
-    r_bucketed = _run_requests(
-        _make_engine(tiny_model_dir, "bucketed"), reqs
-    )
+    r_legacy = _run_requests(_legacy_engine(tiny_model_dir), reqs)
     r_ragged = _run_requests(_make_engine(tiny_model_dir, "ragged"), reqs)
-    assert r_bucketed == r_ragged
+    assert r_legacy == r_ragged
 
 
-def test_ragged_equals_bucketed_sampled_rows(tiny_model_dir):
+def test_ragged_equals_legacy_sampled_rows(tiny_model_dir):
     """Seeded (temperature > 0) rows: the sampler consumes identical
-    logits and per-row PRNG streams on both paths."""
+    logits and per-row PRNG streams on both planner paths."""
     rng = np.random.default_rng(11)
     reqs = _mixed_requests(rng, n=4, greedy=False)
-    r_bucketed = _run_requests(
-        _make_engine(tiny_model_dir, "bucketed"), reqs
-    )
+    r_legacy = _run_requests(_legacy_engine(tiny_model_dir), reqs)
     r_ragged = _run_requests(_make_engine(tiny_model_dir, "ragged"), reqs)
-    assert r_bucketed == r_ragged
+    assert r_legacy == r_ragged
 
 
 @pytest.fixture(scope="module")
@@ -317,18 +324,16 @@ def tiny_mistral_dir(tmp_path_factory):
     )
 
 
-def test_ragged_equals_bucketed_sliding_window(tiny_mistral_dir):
+def test_ragged_equals_legacy_sliding_window(tiny_mistral_dir):
     """Sliding-window rows: the ragged kernel's band mask matches the
-    bucketed prefill/decode band masks."""
+    legacy prefill/decode band masks."""
     rng = np.random.default_rng(13)
     reqs = _mixed_requests(rng, n=4)
-    r_bucketed = _run_requests(
-        _make_engine(tiny_mistral_dir, "bucketed"), reqs
-    )
+    r_legacy = _run_requests(_legacy_engine(tiny_mistral_dir), reqs)
     r_ragged = _run_requests(
         _make_engine(tiny_mistral_dir, "ragged"), reqs
     )
-    assert r_bucketed == r_ragged
+    assert r_legacy == r_ragged
 
 
 @pytest.fixture(scope="module")
@@ -340,12 +345,16 @@ def tiny_lora_dir(tmp_path_factory):
     )
 
 
-def test_ragged_equals_bucketed_lora_rows(tiny_model_dir, tiny_lora_dir):
+def test_ragged_equals_legacy_lora_rows(tiny_model_dir, tiny_lora_dir):
     """Mixed adapter/base rows: the ragged per-row LoRA gather matches
-    the bucketed per-sequence/per-row delta paths."""
+    the legacy per-sequence/per-row delta paths."""
     results = {}
-    for backend in ("bucketed", "ragged"):
-        engine = _make_engine(tiny_model_dir, backend, lora=True)
+    for backend in ("legacy", "ragged"):
+        engine = (
+            _legacy_engine(tiny_model_dir, lora=True)
+            if backend == "legacy"
+            else _make_engine(tiny_model_dir, backend, lora=True)
+        )
         asyncio.run(
             engine.lora_manager.load_lora_adapter("tl", tiny_lora_dir)
         )
@@ -360,21 +369,25 @@ def test_ragged_equals_bucketed_lora_rows(tiny_model_dir, tiny_lora_dir):
                 akw,
             ))
         results[backend] = _run_requests(engine, reqs)
-    assert results["bucketed"] == results["ragged"]
+    assert results["legacy"] == results["ragged"]
     # the adapter actually did something (otherwise the case is vacuous)
     assert results["ragged"]["r0"] != results["ragged"]["r1"]
 
 
-def test_ragged_equals_bucketed_prefix_cache_hit(tiny_model_dir):
+def test_ragged_equals_legacy_prefix_cache_hit(tiny_model_dir):
     """Prefix-cache-hit rows: the ragged span starts mid-prompt
     (start_pos = matched tokens) and attends through the adopted pages,
-    matching the bucketed chunked-resume path."""
+    matching the legacy chunked-resume path."""
     rng = np.random.default_rng(19)
     shared = rng.integers(3, 500, size=40).tolist()
     other = rng.integers(3, 500, size=24).tolist()
     results = {}
-    for backend in ("bucketed", "ragged"):
-        engine = _make_engine(tiny_model_dir, backend, prefix_caching=True)
+    for backend in ("legacy", "ragged"):
+        engine = (
+            _legacy_engine(tiny_model_dir, prefix_caching=True)
+            if backend == "legacy"
+            else _make_engine(tiny_model_dir, backend, prefix_caching=True)
+        )
         skw = dict(temperature=0.0, max_tokens=6, ignore_eos=True)
         first = _run_requests(engine, [("warm", shared, skw, {})])
         hits0 = engine.scheduler.allocator.prefix_hits
@@ -387,7 +400,7 @@ def test_ragged_equals_bucketed_prefix_cache_hit(tiny_model_dir):
         )
         assert second["hit"] == first["warm"]
         results[backend] = (first, second)
-    assert results["bucketed"] == results["ragged"]
+    assert results["legacy"] == results["ragged"]
 
 
 def test_ragged_prompt_logprobs_legacy_fallback(tiny_model_dir):
@@ -396,7 +409,7 @@ def test_ragged_prompt_logprobs_legacy_fallback(tiny_model_dir):
     rows; docs/ATTENTION.md "Limits"), interleaved with ragged planning
     for everything else — arriving mid-stream against running decode
     rows so the alternation branch actually runs.  Tokens and the
-    prompt-logprob table must match the bucketed backend."""
+    prompt-logprob table must match the legacy planner."""
     from vllm_tgis_adapter_tpu.engine.sampling_params import SamplingParams
     from vllm_tgis_adapter_tpu.engine.scheduler import (
         PrefillPlan,
@@ -410,8 +423,12 @@ def test_ragged_prompt_logprobs_legacy_fallback(tiny_model_dir):
     ]
 
     results = {}
-    for backend in ("bucketed", "ragged"):
-        engine = _make_engine(tiny_model_dir, backend)
+    for backend in ("legacy", "ragged"):
+        engine = (
+            _legacy_engine(tiny_model_dir)
+            if backend == "legacy"
+            else _make_engine(tiny_model_dir, backend)
+        )
         plans = []
         orig = engine.scheduler.schedule
 
@@ -461,9 +478,9 @@ def test_ragged_prompt_logprobs_legacy_fallback(tiny_model_dir):
             {k: list(v.outputs[0].token_ids) for k, v in outs.items()},
             lp.prompt_logprobs,
         )
-    assert results["bucketed"][0] == results["ragged"][0]
+    assert results["legacy"][0] == results["ragged"][0]
     for a, b in zip(
-        results["bucketed"][1][1:], results["ragged"][1][1:]
+        results["legacy"][1][1:], results["ragged"][1][1:]
     ):
         assert set(a) == set(b)
         for tid in a:
@@ -474,26 +491,34 @@ def test_ragged_prompt_logprobs_legacy_fallback(tiny_model_dir):
 
 
 def test_ragged_compile_lattice_is_smaller(tiny_model_dir):
-    """precompile() on the ragged backend compiles strictly fewer
-    programs than the bucketed lattice at the same serving config (the
-    bench JSON carries the same evidence via compiled_shapes /
-    xla_compiles; docs/ATTENTION.md documents the expected counts)."""
+    """precompile() compiles strictly fewer programs than the retired
+    PR 6 bucketed ladder at the same serving config (the bench JSON
+    carries the same evidence via compiled_shapes / xla_compiles;
+    docs/ATTENTION.md documents the expected counts)."""
     from vllm_tgis_adapter_tpu import compile_tracker
 
-    counts = {}
-    for backend in ("bucketed", "ragged"):
-        engine = _make_engine(
-            tiny_model_dir, backend, num_blocks=256, max_num_seqs=8
-        )
-        compile_tracker.reset()
-        engine.precompile()
-        counts[backend] = (
-            compile_tracker.num_shapes(),
-            compile_tracker.total_recompiles(),
-        )
+    engine = _make_engine(
+        tiny_model_dir, "ragged", num_blocks=256, max_num_seqs=8
+    )
     compile_tracker.reset()
-    assert counts["ragged"][0] < counts["bucketed"][0]
-    assert counts["ragged"][1] < counts["bucketed"][1]
+    engine.precompile()
+    shapes = compile_tracker.num_shapes()
+    compiles = compile_tracker.total_recompiles()
+    shape_list = list(compile_tracker.shapes())
+    compile_tracker.reset()
+    # the bucketed ladder at this config (buckets 32/64/128, widths
+    # 1/2/4/8, topn x2, solo+packed+chained entry points) measured 16
+    # distinct shapes / 26 compiles before its retirement (PR 6 / PR 12
+    # evidence, docs/ATTENTION.md "Compile lattice") — the consolidated
+    # lattice must stay STRICTLY below both
+    assert shapes < 16, shapes
+    assert compiles < 26, compiles
+    # and every mixed-step shape keys on a scheduler flat bucket
+    buckets = set(engine.scheduler.ragged_buckets)
+    for fn, shape in shape_list:
+        if fn == "ragged_step":
+            tokens = int(shape.split(",")[0].split("=")[1])
+            assert tokens in buckets, (fn, shape)
 
 
 def test_ragged_fill_ratio_and_plan_description(tiny_model_dir):
@@ -730,8 +755,6 @@ def test_ragged_seen_seed_pad_ignores_decode_rows(tiny_model_dir):
 
     widths: list[int] = []
     r_ragged = run("ragged", widths)
-    r_bucketed = run("bucketed")
-    assert r_ragged == r_bucketed
     assert len(r_ragged) == 5
     # the longest SEEDING prompt is 120 tokens (pad 128); the long
     # request's 120+60-token decode rows must not widen it to 256

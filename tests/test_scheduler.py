@@ -47,7 +47,8 @@ def test_prefill_then_decode_cycle():
     plan2 = sched.schedule()
     assert isinstance(plan2, DecodePlan)
     assert plan2.seqs == [seq]
-    assert plan2.batch_bucket == 1
+    # ONE decode width: the per-width bucket ladder is retired
+    assert plan2.batch_bucket == 4
 
 
 def test_prefill_waits_for_free_pages():
@@ -140,11 +141,22 @@ def test_oversized_prompt_rejected():
     assert sched.newly_finished == [seq]
 
 
-def test_batch_buckets_are_powers_of_two():
+def test_ragged_buckets_widen_for_spec_gamma():
+    """The flat-length ladder must hold a full decode batch of verify
+    spans: set_spec_gamma recomputes the ceiling to cover
+    max_num_seqs * (gamma + 1) + chunk_budget."""
     sched = make_scheduler(max_num_seqs=12)
-    assert sched.batch_buckets == [1, 2, 4, 8, 12]
-    assert sched._batch_bucket(3) == 4
-    assert sched._batch_bucket(9) == 12
+    base_ceiling = sched.ragged_buckets[-1]
+    assert base_ceiling >= sched.chunk_budget + 12
+    sched.set_spec_gamma(4)
+    assert sched.spec_gamma == 4
+    assert sched.ragged_buckets[-1] >= sched.chunk_budget + 12 * 5
+    assert sched.ragged_buckets[-1] >= base_ceiling
+    # pow2 ladder from 16
+    for a, b in zip(sched.ragged_buckets, sched.ragged_buckets[1:]):
+        assert b == 2 * a
+    sched.set_spec_gamma(0)
+    assert sched.ragged_buckets[-1] == base_ceiling
 
 
 def test_chunked_prefill_interleaves_with_decode():
@@ -266,3 +278,196 @@ def test_ragged_fully_prefilled_waiting_row_reruns_and_finishes():
     assert item.is_final and not item.is_decode
     assert seq.status == SequenceStatus.RUNNING
     assert seq in sched.running and seq not in sched.waiting
+
+
+# ------------------------------------------------- speculative verify spans
+
+
+def _spec_seq(rid, prompt_len, max_tokens=64, arrival=0.0):
+    seq = make_seq(rid, prompt_len, arrival=arrival, max_tokens=max_tokens)
+    seq.spec_eligible = True
+    return seq
+
+
+def _admit_running(sched, seq):
+    """Drive one sequence through ragged admission to RUNNING."""
+    sched.add(seq)
+    plan = sched.schedule()
+    from vllm_tgis_adapter_tpu.engine.scheduler import RaggedPlan
+
+    assert isinstance(plan, RaggedPlan)
+    seq.output_token_ids.append(1)
+    return plan
+
+
+def test_ragged_verify_span_planning():
+    """A spec-eligible running row plans a (γ+1)-token verify span —
+    last sampled token + γ placeholder rows with real KV slots — while
+    an ineligible row in the SAME plan keeps a plain one-token span."""
+    from vllm_tgis_adapter_tpu.engine.scheduler import RaggedPlan
+
+    sched = make_scheduler(num_blocks=32)
+    sched.ragged = True
+    sched.set_spec_gamma(3)
+    a = _spec_seq("a", 5)
+    b = make_seq("b", 5, arrival=1.0)  # not spec-eligible
+    _admit_running(sched, a)
+    _admit_running(sched, b)
+
+    plan = sched.schedule()
+    assert isinstance(plan, RaggedPlan)
+    by_rid = {it.seq.request_id: it for it in plan.items}
+    va = by_rid["a"]
+    assert va.spec_width == 4
+    assert len(va.token_ids) == 4 and len(va.slots) == 4
+    assert va.token_ids[0] == a.all_token_ids[-1]
+    assert va.start_pos == a.num_tokens - 1
+    assert all(s >= 0 for s in va.slots)  # pages reserved for the span
+    vb = by_rid["b"]
+    assert vb.spec_width == 0 and len(vb.token_ids) == 1
+    # the verify span counts γ+1 rows against the flat bucket
+    assert plan.total_tokens == 4 + 1
+
+
+def test_ragged_verify_span_budget_caps():
+    """max_tokens remainder and model-length headroom cap the span: a
+    row one token from its budget plans a PLAIN span (no draft rows to
+    accept), and a near-model-len row truncates."""
+    from vllm_tgis_adapter_tpu.engine.scheduler import RaggedPlan
+
+    sched = make_scheduler(num_blocks=32, max_num_seqs=4)
+    sched.ragged = True
+    sched.set_spec_gamma(4)
+    # budget: max_tokens=2 → after 1 output token, only 1 more may
+    # emit — extra is 0, so the row plans NO verify span and the pure-
+    # decode step falls through to the fused wave as without spec
+    from vllm_tgis_adapter_tpu.engine.scheduler import DecodePlan
+
+    a = _spec_seq("a", 5, max_tokens=2)
+    _admit_running(sched, a)
+    plan = sched.schedule()
+    assert isinstance(plan, DecodePlan)
+
+    sched2 = make_scheduler(num_blocks=32, max_num_seqs=4)
+    sched2.ragged = True
+    sched2.set_spec_gamma(4)
+    sched2.max_model_len = 8  # prompt 5 + 1 output → 2 tokens headroom
+    b = _spec_seq("b", 5)
+    _admit_running(sched2, b)
+    plan2 = sched2.schedule()
+    assert isinstance(plan2, RaggedPlan)
+    assert plan2.items[0].spec_width == 3  # 1 + min(gamma, headroom 2)
+
+
+def test_ragged_verify_spans_mix_with_fresh_prefill():
+    """One plan carries fresh-prefill spans AND verify spans AND plain
+    decode spans — the mixed-bucket composition the ISSUE names."""
+    from vllm_tgis_adapter_tpu.engine.scheduler import RaggedPlan
+
+    sched = make_scheduler(num_blocks=64, max_num_seqs=4)
+    sched.ragged = True
+    sched.set_spec_gamma(2)
+    a = _spec_seq("a", 4)
+    b = make_seq("b", 4, arrival=1.0)
+    _admit_running(sched, a)
+    _admit_running(sched, b)
+    fresh = make_seq("c", 6, arrival=2.0)
+    sched.add(fresh)
+    plan = sched.schedule()
+    assert isinstance(plan, RaggedPlan)
+    kinds = {
+        it.seq.request_id: (it.is_decode, it.spec_width) for it in plan.items
+    }
+    assert kinds["a"] == (True, 3)
+    assert kinds["b"] == (True, 0)
+    assert kinds["c"] == (False, 0)
+
+
+def test_ragged_verify_pure_decode_plans_ragged_not_fused():
+    """Pure-decode steps with a spec-eligible row plan a verify
+    RaggedPlan instead of falling to the fused wave; with no eligible
+    row the fused wave still runs."""
+    from vllm_tgis_adapter_tpu.engine.scheduler import (
+        DecodePlan,
+        RaggedPlan,
+    )
+
+    sched = make_scheduler(num_blocks=32)
+    sched.ragged = True
+    sched.set_spec_gamma(3)
+    a = _spec_seq("a", 5)
+    _admit_running(sched, a)
+    plan = sched.schedule()
+    assert isinstance(plan, RaggedPlan)
+    assert plan.items[0].spec_width == 4
+
+    sched2 = make_scheduler(num_blocks=32)
+    sched2.ragged = True
+    sched2.set_spec_gamma(3)
+    b = make_seq("b", 5)  # ineligible
+    _admit_running(sched2, b)
+    plan2 = sched2.schedule()
+    assert isinstance(plan2, DecodePlan)
+
+
+def test_ragged_verify_span_shrinks_under_page_pressure():
+    """A tight KV pool halves the verify span before preempting — the
+    row degrades to a plain decode span instead of evicting siblings."""
+    from vllm_tgis_adapter_tpu.engine.scheduler import RaggedPlan
+
+    # block_size=4, 6 pages: two 6-token rows hold 2 pages each; γ=8
+    # wants 4 pages per row (14 token slots) but only the OLDER row can
+    # grow — the younger halves its span until it fits its own pages
+    sched = make_scheduler(num_blocks=6, max_num_seqs=2)
+    sched.ragged = True
+    sched.set_spec_gamma(8)
+    a = _spec_seq("a", 5)
+    b = _spec_seq("b", 5, arrival=1.0)
+    _admit_running(sched, a)
+    _admit_running(sched, b)
+    plan = sched.schedule()
+    assert isinstance(plan, RaggedPlan)
+    # both rows still present (no preemption), spans shrunk to fit
+    assert {it.seq.request_id for it in plan.items} == {"a", "b"}
+    widths = {it.seq.request_id: it.spec_width for it in plan.items}
+    assert widths["a"] == 9  # full span: 1 + γ
+    assert 0 < widths["b"] < 9  # shrunk, not preempted
+    assert len(sched.running) == 2
+
+
+def test_ragged_verify_span_capacity_reservation():
+    """A verify span's KV slots are reserved through ensure_capacity at
+    plan time: positions [num_tokens-1, num_tokens-1+extra] all carry
+    real (non-negative, distinct) slots."""
+    from vllm_tgis_adapter_tpu.engine.scheduler import RaggedPlan
+
+    sched = make_scheduler(num_blocks=32)
+    sched.ragged = True
+    sched.set_spec_gamma(3)
+    a = _spec_seq("a", 5)
+    _admit_running(sched, a)
+    plan = sched.schedule()
+    assert isinstance(plan, RaggedPlan)
+    it = plan.items[0]
+    assert it.spec_width == 4
+    assert len(set(it.slots)) == 4
+    assert min(it.slots) >= 0
+    # the pages backing the span belong to the sequence
+    covered = (a.num_tokens - 1) + it.spec_width - 1
+    assert len(a.blocks.blocks) * sched.block_size > covered
+
+
+def test_spec_gamma_ignored_without_eligible_rows():
+    """spec_gamma set but no eligible row: planning is byte-identical
+    to a non-spec scheduler (plain decode spans, fused-wave fallthrough
+    intact)."""
+    from vllm_tgis_adapter_tpu.engine.scheduler import DecodePlan
+
+    sched = make_scheduler(num_blocks=32)
+    sched.ragged = True
+    sched.set_spec_gamma(4)
+    b = make_seq("b", 5)  # spec_eligible False
+    _admit_running(sched, b)
+    plan = sched.schedule()
+    assert isinstance(plan, DecodePlan)
+    assert plan.batch_bucket == sched.config.max_num_seqs

@@ -1,11 +1,16 @@
-"""Speculative decoding: greedy equivalence, acceptance stats, fallbacks.
+"""Speculative decoding on the ragged path: greedy equivalence,
+per-row verify spans, acceptance stats, chaos/recovery composition.
 
-The reference passes --speculative-model/--num-speculative-tokens through
-to its engine (reference tgis_utils/args.py:164-168,221-231); here the
-propose/verify mechanism itself is under test (engine/speculative.py).
+The reference passes --speculative-model/--num-speculative-tokens
+through to its engine (reference tgis_utils/args.py:164-168,221-231);
+here the propose/verify-as-a-span mechanism itself is under test
+(engine/speculative.py + runner._ragged_verify_fn, docs/ATTENTION.md
+"Speculative decoding").
 """
 
 from __future__ import annotations
+
+import asyncio
 
 import pytest
 
@@ -22,7 +27,7 @@ def draft_model_dir(tmp_path_factory) -> str:
 
 
 def make_engine(model_dir, draft_dir=None, gamma=4, parallel_config=None,
-                **sched):
+                num_blocks=64, engine_kwargs=None, **sched):
     from vllm_tgis_adapter_tpu.engine.config import (
         CacheConfig,
         EngineConfig,
@@ -46,7 +51,7 @@ def make_engine(model_dir, draft_dir=None, gamma=4, parallel_config=None,
         )
     config = EngineConfig(
         model_config=mcfg,
-        cache_config=CacheConfig(block_size=16, num_blocks=64,
+        cache_config=CacheConfig(block_size=16, num_blocks=num_blocks,
                                  cache_dtype=mcfg.dtype),
         scheduler_config=SchedulerConfig(
             max_num_seqs=4, prefill_buckets=(32, 64, 128),
@@ -55,6 +60,7 @@ def make_engine(model_dir, draft_dir=None, gamma=4, parallel_config=None,
         parallel_config=parallel_config or ParallelConfig(),
         lora_config=LoRAConfig(),
         speculative=speculative,
+        **(engine_kwargs or {}),
     )
     return LLMEngine.from_config(config)
 
@@ -74,6 +80,19 @@ def run_all(engine, requests, max_steps=400):
                 outs[out.request_id] = out
     assert not engine.has_unfinished_requests()
     return outs
+
+
+def spy_spec_plans(engine) -> list[list[bool]]:
+    """Record each ragged dispatch's per-item verify-span mask."""
+    recorded: list[list[bool]] = []
+    inner = engine.runner.prepare_ragged
+
+    def spy(plan):
+        recorded.append([it.spec_width > 0 for it in plan.items])
+        return inner(plan)
+
+    engine.runner.prepare_ragged = spy
+    return recorded
 
 
 GREEDY = dict(temperature=0.0, max_tokens=24, ignore_eos=True)
@@ -104,10 +123,10 @@ def test_spec_greedy_identical_imperfect_draft(tiny_model_dir,
 
 def test_spec_perfect_draft_accepts_most(tiny_model_dir):
     """Draft == target → high acceptance.  Not exactly 1.0: the draft's
-    fused one-step decode and the target's batched verify are different
-    XLA programs, and the random fixture's near-tie logits can flip
-    argmax between fusions — output equality is the invariant, the rate
-    is a quality signal."""
+    propose scan and the target's batched verify are different XLA
+    programs, and the random fixture's near-tie logits can flip argmax
+    between fusions — output equality is the invariant, the rate is a
+    quality signal."""
     reqs = [("r", list(range(3, 20)), dict(GREEDY))]
     eng = make_engine(tiny_model_dir, tiny_model_dir, gamma=3)
     baseline = run_all(make_engine(tiny_model_dir), reqs)
@@ -116,11 +135,12 @@ def test_spec_perfect_draft_accepts_most(tiny_model_dir):
     assert eng.runner.spec.stats.acceptance_rate > 0.5
 
 
-def test_spec_sampled_rows_speculate(tiny_model_dir, draft_model_dir):
+def test_spec_sampled_rows_ride_verify_spans(tiny_model_dir,
+                                             draft_model_dir):
     """Unseeded sampled rows ride speculation via rejection-sampling
-    verification (VERDICT r3 #5): mixed greedy/sampled batches stay
-    spec-eligible and greedy rows still match the non-spec baseline
-    exactly."""
+    verification (VERDICT r3 #5): mixed greedy/sampled batches plan
+    verify spans for BOTH rows (eligibility is per row on the ragged
+    path) and greedy rows still match the non-spec baseline exactly."""
     reqs = [
         ("greedy", list(range(3, 12)), dict(GREEDY)),
         ("sampled", list(range(3, 12)),
@@ -128,19 +148,7 @@ def test_spec_sampled_rows_speculate(tiny_model_dir, draft_model_dir):
     ]
     baseline = run_all(make_engine(tiny_model_dir), reqs)
     spec_eng = make_engine(tiny_model_dir, draft_model_dir)
-
-    # instrument: record each decode batch's eligibility decision
-    decisions = []
-    orig_prepare = spec_eng.runner.prepare_decode
-
-    def spy_prepare(plan):
-        prep = orig_prepare(plan)
-        decisions.append((
-            tuple(s.request_id for s in plan.seqs), prep.spec_ok,
-        ))
-        return prep
-
-    spec_eng.runner.prepare_decode = spy_prepare
+    recorded = spy_spec_plans(spec_eng)
     spec = run_all(spec_eng, reqs)
     # greedy rows: speculation is exact regardless of batch composition
     assert (
@@ -150,29 +158,39 @@ def test_spec_sampled_rows_speculate(tiny_model_dir, draft_model_dir):
     # sampled rows speculate too (rejection sampling) — the PRNG stream
     # differs from the non-spec path by design, but length is honored
     assert len(spec["sampled"].outputs[0].token_ids) == 12
-    mixed = [ok for rids, ok in decisions if "sampled" in rids]
-    assert mixed and all(mixed), f"sampled batches fell back: {decisions}"
+    two_span_plans = [m for m in recorded if sum(m) >= 2]
+    assert two_span_plans, f"no plan carried both verify spans: {recorded}"
     assert spec_eng.runner.spec.stats.proposed > 0
 
 
-def test_spec_seeded_rows_fall_back_deterministically(tiny_model_dir,
-                                                      draft_model_dir):
+def test_spec_seeded_rows_plain_spans_deterministic(tiny_model_dir,
+                                                    draft_model_dir):
     """SEEDED sampled rows are spec-ineligible: the sampler guarantees a
     seeded request replays the same stream no matter how it is batched,
     and the spec path draws from different (salted) streams — so seeded
-    rows must take the fused path and match the non-spec baseline
-    token-for-token."""
+    rows must ride a plain one-token decode span (in the SAME ragged
+    dispatches) and match the non-spec baseline token-for-token."""
     reqs = [
         ("seeded", list(range(3, 12)),
          dict(temperature=0.8, seed=7, max_tokens=12, ignore_eos=True)),
+        ("greedy", list(range(3, 12)), dict(GREEDY)),
     ]
     baseline = run_all(make_engine(tiny_model_dir), reqs)
     spec_eng = make_engine(tiny_model_dir, draft_model_dir)
+    recorded = spy_spec_plans(spec_eng)
     spec = run_all(spec_eng, reqs)
     assert (
         spec["seeded"].outputs[0].token_ids
         == baseline["seeded"].outputs[0].token_ids
     ), "seeded stream changed under a spec-enabled engine"
+    assert (
+        spec["greedy"].outputs[0].token_ids
+        == baseline["greedy"].outputs[0].token_ids
+    )
+    # at least one dispatch mixed a verify span (greedy) with a plain
+    # span (seeded) — per-row eligibility, not per-batch fallback
+    mixed = [m for m in recorded if len(m) >= 2 and any(m) and not all(m)]
+    assert mixed, f"no mixed verify/plain dispatch observed: {recorded}"
 
 
 def test_rejection_core_preserves_target_distribution():
@@ -229,7 +247,7 @@ def test_rejection_core_preserves_target_distribution():
 
 def test_rejection_core_greedy_degenerates_to_argmax():
     """temps=0 rows: acceptance is the argmax match test and emission is
-    the target argmax — bit-identical to the greedy verify."""
+    the target argmax — bit-identical to a greedy verify."""
     import jax.numpy as jnp
     import numpy as np
 
@@ -260,8 +278,9 @@ def test_rejection_core_greedy_degenerates_to_argmax():
 
 
 def test_spec_with_chunked_prefill(tiny_model_dir, draft_model_dir):
-    """Long prompts chunk through BOTH caches (the draft must see the
-    whole prompt before proposing)."""
+    """Long prompts chunk through the target; the draft catches up at
+    the first verify span (it must see the whole prompt before
+    proposing)."""
     reqs = [("long", list(range(3, 100)), dict(GREEDY))]
     baseline = run_all(make_engine(tiny_model_dir,
                                    max_num_batched_tokens=32), reqs)
@@ -306,34 +325,24 @@ def test_spec_vocab_mismatch_rejected(tiny_model_dir, tmp_path):
         make_engine(tiny_model_dir, str(draft))
 
 
-def test_spec_draft_catchup_after_mixed_batch(tiny_model_dir):
-    """A greedy row that decoded in mixed batches (fused path, draft cache
-    lagging) must catch the draft up before speculating again — with a
-    perfect draft, post-transition acceptance stays high instead of
-    collapsing over unwritten draft context."""
-    reqs = [
-        ("greedy", list(range(3, 12)),
-         dict(temperature=0.0, max_tokens=48, ignore_eos=True)),
-        ("sampled", list(range(3, 12)),
-         dict(temperature=0.9, seed=3, max_tokens=4, ignore_eos=True)),
-    ]
-    eng = make_engine(tiny_model_dir, tiny_model_dir, gamma=3)
-    baseline = run_all(make_engine(tiny_model_dir), reqs)
-    outs = run_all(eng, reqs)
-    assert (
-        outs["greedy"].outputs[0].token_ids
-        == baseline["greedy"].outputs[0].token_ids
-    )
-    stats = eng.runner.spec.stats
-    assert stats.dispatches > 0
-    # the perfect draft recovers after the catch-up; without it the
-    # acceptance over garbage context sits near 1/vocab
-    assert stats.acceptance_rate > 0.5
+def test_spec_refuses_sequence_parallelism(tiny_model_dir,
+                                           draft_model_dir):
+    """Speculation rides the ragged verify span; sp>1 engines use the
+    legacy planner — the composition is refused at config time
+    (truthful flags), not silently run wrong."""
+    from vllm_tgis_adapter_tpu.engine.config import ParallelConfig
+
+    with pytest.raises(ValueError, match="sequence-parallel"):
+        make_engine(
+            tiny_model_dir, draft_dir=draft_model_dir,
+            parallel_config=ParallelConfig(sequence_parallel_size=2),
+        )
 
 
-def test_spec_with_prefix_caching(tiny_model_dir):
+def test_spec_draft_catchup_after_prefix_adoption(tiny_model_dir):
     """Prefix-cache hits skip the target prefill but the draft never saw
-    those pages — the catch-up path re-runs them so outputs still match."""
+    those pages — the catch-up path re-runs them so outputs still match
+    and acceptance stays high with a perfect draft."""
     from vllm_tgis_adapter_tpu.engine.config import (
         CacheConfig,
         EngineConfig,
@@ -370,33 +379,15 @@ def test_spec_with_prefix_caching(tiny_model_dir):
     assert (
         second["b"].outputs[0].token_ids == first["a"].outputs[0].token_ids
     )
-
-
-def test_spec_under_sequence_parallelism(tiny_model_dir, draft_model_dir):
-    """Speculation composes with sp: the draft shares the sp×tp mesh and
-    ring-prefills its own cache; greedy outputs match the plain engine."""
-    from vllm_tgis_adapter_tpu.engine.config import ParallelConfig
-
-    req = [("r", list(range(5, 25)),
-            dict(temperature=0.0, max_tokens=12, ignore_eos=True))]
-    plain = run_all(make_engine(tiny_model_dir), req)
-    engine = make_engine(
-        tiny_model_dir, draft_dir=draft_model_dir,
-        parallel_config=ParallelConfig(sequence_parallel_size=2),
-    )
-    assert engine.runner.spec is not None
-    assert dict(engine.runner.mesh.shape)["sp"] == 2
-    got = run_all(engine, req)
-    assert got["r"].outputs[0].token_ids == plain["r"].outputs[0].token_ids
+    assert eng.runner.spec.stats.acceptance_rate > 0.5
 
 
 def test_spec_with_lora_greedy_exact(tiny_model_dir, draft_model_dir,
                                      tmp_path_factory):
     """LoRA rows speculate (VERDICT r3 #5): the draft proposes from base
-    weights, the target verifies WITH the adapter, so greedy output must
-    equal the non-spec adapted output exactly."""
-    import asyncio
-
+    weights, the target verifies WITH the adapter (per-row lora_idx
+    through the verify span), so greedy output must equal the non-spec
+    adapted output exactly."""
     from tests.fixture_models import build_tiny_lora_adapter
     from vllm_tgis_adapter_tpu.engine.config import LoRAConfig
     from vllm_tgis_adapter_tpu.engine.sampling_params import SamplingParams
@@ -437,76 +428,277 @@ def test_spec_with_lora_greedy_exact(tiny_model_dir, draft_model_dir,
 
     spec_eng = adapted_engine(draft_model_dir)
     asyncio.run(spec_eng.lora_manager.load_lora_adapter("tl", lora_dir))
-    decisions = []
-    orig_prepare = spec_eng.runner.prepare_decode
-
-    def spy_prepare(plan):
-        prep = orig_prepare(plan)
-        decisions.append(prep.spec_ok)
-        return prep
-
-    spec_eng.runner.prepare_decode = spy_prepare
+    recorded = spy_spec_plans(spec_eng)
     spec = generate(spec_eng, "r")
 
     assert spec == baseline, "LoRA row diverged under speculation"
-    assert decisions and all(decisions), "LoRA row did not speculate"
+    assert any(any(m) for m in recorded), "LoRA row never verify-spanned"
     assert spec_eng.runner.spec.stats.proposed > 0
 
 
-def test_async_spec_dispatch_never_overlapped(tiny_model_dir,
-                                              draft_model_dir):
-    """SYNC_DISPATCH steps (speculative decode) defer their device work
-    to wait_step, so the async loop must execute them synchronously —
-    a later dispatch sneaking in between would run on device BEFORE the
-    spec step and read/write re-allocated pages (code review r4)."""
-    import asyncio as _asyncio
+def test_spec_compile_lattice_stays_bounded(tiny_model_dir):
+    """Verify spans must not add compile shapes beyond the quantized
+    work-width lattice: every ragged_verify shape keys on a flat bucket
+    from the scheduler's ladder, and a SECOND identical workload
+    compiles nothing new."""
+    from vllm_tgis_adapter_tpu import compile_tracker
 
+    eng = make_engine(tiny_model_dir, tiny_model_dir, gamma=3)
+    prompts = [list(range(3, 20)), list(range(40, 49)), [7, 8, 9]]
+
+    def workload(tag):
+        reqs = [(f"{tag}{i}", p, dict(GREEDY))
+                for i, p in enumerate(prompts)]
+        return run_all(eng, reqs)
+
+    workload("a")
+    shapes_after_first = {
+        (fn, shape) for (fn, shape) in compile_tracker.shapes()
+        if fn in ("ragged_step", "ragged_verify")
+    }
+    buckets = set(eng.scheduler.ragged_buckets)
+    for fn, shape in shapes_after_first:
+        tokens = int(shape.split(",")[0].split("=")[1])
+        assert tokens in buckets, (fn, shape, sorted(buckets))
+    workload("b")
+    shapes_after_second = {
+        (fn, shape) for (fn, shape) in compile_tracker.shapes()
+        if fn in ("ragged_step", "ragged_verify")
+    }
+    assert shapes_after_second == shapes_after_first, (
+        "steady-state workload retraced the ragged/verify programs"
+    )
+
+
+def test_spec_with_kv_tier_promotion(tiny_model_dir):
+    """spec × kv-tier (ISSUE 12 satellite): a parked host-tier
+    promotion resumes into a spec-eligible row — the promoted span is
+    target-only (the draft never saw it), so the catch-up path must
+    replay it before proposing; outputs token-identical to the untiered
+    spec engine."""
+    eng_plain = make_engine(tiny_model_dir, tiny_model_dir, gamma=3)
+    prompt = list(range(3, 70))
+    base = run_all(eng_plain, [("a", prompt, dict(GREEDY))])
+
+    eng = make_engine(
+        tiny_model_dir, tiny_model_dir, gamma=3,
+        engine_kwargs=dict(kv_host_cache_gb=1.0),
+    )
+    first = run_all(eng, [("a", prompt, dict(GREEDY))])
+    assert (
+        first["a"].outputs[0].token_ids == base["a"].outputs[0].token_ids
+    )
+    # second pass: the prompt's pages are host-tier resident (demoted at
+    # prefill commit in tier-only mode); the request parks, promotes,
+    # and resumes into a spec-eligible running row
+    second = run_all(eng, [("b", prompt, dict(GREEDY))])
+    assert (
+        second["b"].outputs[0].token_ids == base["a"].outputs[0].token_ids
+    )
+    assert eng.kv_host_promoted_tokens > 0, (
+        "the host tier never promoted — the scenario is vacuous"
+    )
+    assert eng.runner.spec.stats.proposed > 0
+
+
+def _supervised_spec_config(model_dir, draft_dir, gamma=3):
+    from vllm_tgis_adapter_tpu.engine.config import (
+        CacheConfig,
+        EngineConfig,
+        FrontdoorConfig,
+        LoRAConfig,
+        ModelConfig,
+        ParallelConfig,
+        SchedulerConfig,
+        SpeculativeConfig,
+    )
+
+    mcfg = ModelConfig.from_pretrained(model_dir, dtype="float32")
+    return EngineConfig(
+        model_config=mcfg,
+        cache_config=CacheConfig(
+            block_size=16, num_blocks=64, cache_dtype=mcfg.dtype
+        ),
+        scheduler_config=SchedulerConfig(
+            max_num_seqs=4, prefill_buckets=(32, 64)
+        ),
+        parallel_config=ParallelConfig(),
+        lora_config=LoRAConfig(),
+        speculative=(
+            SpeculativeConfig(
+                draft_model=draft_dir,
+                num_speculative_tokens=gamma,
+                draft_model_config=ModelConfig.from_pretrained(
+                    draft_dir, dtype="float32"
+                ),
+            )
+            if draft_dir
+            else None
+        ),
+        kv_host_cache_gb=1.0,
+        max_engine_restarts=3,
+        engine_restart_backoff_s=0.02,
+        frontdoor=FrontdoorConfig(enabled=True),
+    )
+
+
+def test_mid_verify_death_resumes_token_identically(tiny_model_dir,
+                                                    draft_model_dir):
+    """Chaos acceptance (ISSUE 12 satellite): the engine dies INSIDE a
+    speculative verify dispatch (runner.dispatch_verify failpoint) —
+    the mid-decode requests checkpoint with only ACCEPTED tokens (the
+    in-flight draft window dies with the dispatch), resume through the
+    host tier, and finish token-identical to an uncrashed spec run."""
     from vllm_tgis_adapter_tpu.engine.async_llm import AsyncLLMEngine
-    from vllm_tgis_adapter_tpu.engine.runner import SYNC_DISPATCH
     from vllm_tgis_adapter_tpu.engine.sampling_params import SamplingParams
+    from vllm_tgis_adapter_tpu.supervisor import failpoints
 
-    async def scenario():
-        core = make_engine(tiny_model_dir, draft_model_dir, gamma=3)
-        engine = AsyncLLMEngine(core)
-        events = []
-        inner_dispatch = core.dispatch_step
-        inner_wait = core.wait_step
+    def build():
+        return AsyncLLMEngine.from_config(
+            _supervised_spec_config(tiny_model_dir, draft_model_dir)
+        )
 
-        def spy_dispatch(plan, prepared):
-            handle = inner_dispatch(plan, prepared)
-            events.append(("dispatch", handle is SYNC_DISPATCH, id(plan)))
-            return handle
+    async def run(engine, staged=None):
+        if staged is not None:
+            tier = engine.engine.kv_tier
+            inner = tier.stage_checkpoint
 
-        def spy_wait(plan, prepared, handle):
-            result = inner_wait(plan, prepared, handle)
-            events.append(("wait", handle is SYNC_DISPATCH, id(plan)))
-            return result
+            def spy(ckpt):
+                staged.append(
+                    (ckpt.request_id, list(ckpt.output_token_ids))
+                )
+                return inner(ckpt)
 
-        core.dispatch_step = spy_dispatch
-        core.wait_step = spy_wait
+            tier.stage_checkpoint = spy
 
-        async def consume(rid, delay):
-            await _asyncio.sleep(delay)
-            async for _ in engine.generate(
+        async def one(i):
+            final = None
+            async for out in engine.generate(
                 prompt=None,
                 sampling_params=SamplingParams(
-                    temperature=0.0, max_tokens=10, ignore_eos=True),
-                request_id=rid,
-                prompt_token_ids=list(range(3, 12)),
+                    temperature=0.0, max_tokens=12, ignore_eos=True
+                ),
+                request_id=f"r{i}",
+                prompt_token_ids=[5 + i] * 12,
             ):
-                pass
+                final = out
+            return list(final.outputs[0].token_ids)
 
-        await _asyncio.gather(consume("a", 0), consume("b", 0.2))
-        await engine.stop()
-        return events
+        await engine.start()
+        try:
+            return await asyncio.gather(*[one(i) for i in range(3)])
+        finally:
+            await engine.stop()
 
-    events = _asyncio.run(scenario())
-    sync_dispatches = [e for e in events if e[0] == "dispatch" and e[1]]
-    assert sync_dispatches, "no speculative (SYNC) dispatch ran"
-    for i, ev in enumerate(events):
-        if ev[0] == "dispatch" and ev[1]:
-            nxt = events[i + 1]
-            assert nxt == ("wait", True, ev[2]), (
-                f"work interleaved into a SYNC dispatch window: "
-                f"{events[i:i+3]}"
-            )
+    failpoints.disarm()
+    baseline = asyncio.run(run(build()))
+
+    engine = build()
+    staged: list = []
+    # the first verify dispatch is already mid-decode (every verify row
+    # committed its first sampled token at prefill), so the death
+    # exercises the checkpoint/resume path, not plain replay
+    failpoints.arm("runner.dispatch_verify=raise:1")
+    try:
+        resumed = asyncio.run(run(engine, staged))
+        fired = failpoints.fired("runner.dispatch_verify")
+    finally:
+        failpoints.disarm()
+    assert fired >= 1, "mid-verify failpoint never fired"
+    assert resumed == baseline, (
+        "resume after mid-verify death diverged from the uncrashed run"
+    )
+    assert staged, "no decode checkpoint was staged"
+    final_by_rid = {f"r{i}": toks for i, toks in enumerate(baseline)}
+    for rid, ckpt_tokens in staged:
+        final = final_by_rid[rid]
+        assert ckpt_tokens == final[: len(ckpt_tokens)], (
+            f"{rid}: checkpoint captured tokens that are not a prefix "
+            f"of the final stream — in-flight draft tokens leaked "
+            f"({ckpt_tokens} vs {final})"
+        )
+    assert engine.supervisor is not None
+    assert engine.supervisor.restart_history, "no supervised restart ran"
+
+
+def test_spec_on_decode_role_replica_with_handoff(tiny_model_dir):
+    """spec × disaggregation (ISSUE 12 satellite): a prefill+decode
+    fleet where the decode replica rides speculation — handoffs resume
+    into spec-eligible rows and the streams stay token-identical to a
+    plain mixed non-spec fleet."""
+    from vllm_tgis_adapter_tpu.engine.async_llm import AsyncLLMEngine
+    from vllm_tgis_adapter_tpu.engine.config import (
+        CacheConfig,
+        EngineConfig,
+        FrontdoorConfig,
+        LoRAConfig,
+        ModelConfig,
+        ParallelConfig,
+        SchedulerConfig,
+        SpeculativeConfig,
+    )
+    from vllm_tgis_adapter_tpu.engine.sampling_params import SamplingParams
+
+    def build(roles, spec):
+        mcfg = ModelConfig.from_pretrained(tiny_model_dir, dtype="float32")
+        return AsyncLLMEngine.from_config(EngineConfig(
+            model_config=mcfg,
+            cache_config=CacheConfig(
+                block_size=16, num_blocks=64, cache_dtype=mcfg.dtype
+            ),
+            scheduler_config=SchedulerConfig(
+                max_num_seqs=4, prefill_buckets=(32, 64)
+            ),
+            parallel_config=ParallelConfig(dp_replicas=2),
+            lora_config=LoRAConfig(),
+            dp_replica_roles=roles,
+            kv_host_cache_gb=1.0,
+            speculative=(
+                SpeculativeConfig(
+                    draft_model=tiny_model_dir,
+                    num_speculative_tokens=3,
+                    draft_model_config=ModelConfig.from_pretrained(
+                        tiny_model_dir, dtype="float32"
+                    ),
+                )
+                if spec
+                else None
+            ),
+            frontdoor=FrontdoorConfig(enabled=True),
+        ))
+
+    async def run(engine):
+        async def one(i):
+            final = None
+            async for out in engine.generate(
+                prompt=None,
+                sampling_params=SamplingParams(
+                    temperature=0.0, max_tokens=10, ignore_eos=True
+                ),
+                request_id=f"r{i}",
+                prompt_token_ids=[5 + i] * 12,
+            ):
+                final = out
+            return list(final.outputs[0].token_ids)
+
+        await engine.start()
+        try:
+            return await asyncio.gather(*[one(i) for i in range(4)])
+        finally:
+            await engine.stop()
+
+    baseline = asyncio.run(run(build((), False)))
+    engine = build(("prefill", "decode"), True)
+    got = asyncio.run(run(engine))
+    assert got == baseline, (
+        "spec decode-role replica diverged from the plain mixed fleet"
+    )
+    assert engine.handoff_outcomes["completed"] >= 4
+    assert engine.handoff_outcomes["fallback"] == 0
+    # the decode replica actually speculated on the handed-off rows
+    decode_rep = next(
+        rep for rep in engine._replicas if rep.role == "decode"
+    )
+    assert decode_rep.engine.runner.spec.stats.proposed > 0, (
+        "the decode-role replica never rode a verify span"
+    )

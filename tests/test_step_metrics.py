@@ -90,8 +90,8 @@ def test_streaming_generation_feeds_step_metrics(tiny_model_dir):
     ttft_0 = _sample(before, "tgis_tpu_ttft_seconds_count")
     itl_0 = _sample(before, "tgis_tpu_inter_token_seconds_count")
     # label deltas are per-engine: each engine owns fresh jitted fns, so
-    # its first bucket=32 dispatch compiles exactly once
-    prefill_lbl = ('fn="prefill"', 'shape="tokens=32"')
+    # its first flat-bucket-16 ragged dispatch compiles exactly once
+    prefill_lbl = ('fn="ragged_step"', 'shape="tokens=16"')
     compiles_0 = _sample(
         before, "tgis_tpu_xla_recompile_total", prefill_lbl
     )
@@ -124,8 +124,8 @@ def test_recompile_tracker_two_batch_shapes(tiny_model_dir):
     """Two distinct prefill bucket shapes each record their own labeled
     compile; re-dispatching either adds none."""
     engine = _build_engine(tiny_model_dir)
-    lbl32 = ('fn="prefill"', 'shape="tokens=32"')
-    lbl64 = ('fn="prefill"', 'shape="tokens=64"')
+    lbl32 = ('fn="ragged_step"', 'shape="tokens=16"')
+    lbl64 = ('fn="ragged_step"', 'shape="tokens=32"')
     before = _scrape()
     c32_0 = _sample(before, "tgis_tpu_xla_recompile_total", lbl32)
     c64_0 = _sample(before, "tgis_tpu_xla_recompile_total", lbl64)

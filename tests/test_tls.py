@@ -20,6 +20,15 @@ from contextlib import suppress
 import grpc
 import pytest
 
+try:  # pragma: no cover - environment probe
+    import cryptography  # noqa: F401
+except ImportError:
+    pytest.skip(
+        "the 'cryptography' package is unavailable; TLS cert generation "
+        "for this suite needs it (pip install cryptography)",
+        allow_module_level=True,
+    )
+
 
 def _make_cert(subject_name: str, issuer_key=None, issuer_cert=None,
                *, is_ca: bool = False):

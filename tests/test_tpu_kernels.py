@@ -39,7 +39,22 @@ def _paged_case(seed, b, num_kv, g, head_dim, block_size, max_blocks, dtype):
     return tuple(jnp.asarray(x) for x in (q, kc, vc, bt, cl))
 
 
-@pytest.mark.parametrize("variant", ["folded", "perhead"])
+def _ragged_decode(q, kc, vc, bt, cl, block_size, scale, *, window=0,
+                   alibi_slopes=None):
+    """The serving decode formulation: one-token spans through the
+    RAGGED Pallas kernel, Mosaic-compiled on chip (the retired
+    folded/perhead decode kernels' replacement — docs/ATTENTION.md)."""
+    from vllm_tgis_adapter_tpu.ops import ragged_attention as R
+
+    b = q.shape[0]
+    pos = jnp.maximum(jnp.asarray(cl, jnp.int32), 1) - 1
+    return R.ragged_paged_attention(
+        q, kc, vc, pos, jnp.arange(b + 1, dtype=jnp.int32), pos,
+        jnp.asarray(b, jnp.int32), bt, block_size, scale,
+        window=window, alibi_slopes=alibi_slopes,
+    )
+
+
 @pytest.mark.parametrize(
     "b,num_kv,g,head_dim,block_size,dtype",
     [
@@ -49,13 +64,12 @@ def _paged_case(seed, b, num_kv, g, head_dim, block_size, max_blocks, dtype):
     ],
 )
 def test_decode_kernel_compiles_and_matches(
-    b, num_kv, g, head_dim, block_size, dtype, variant
+    b, num_kv, g, head_dim, block_size, dtype
 ):
     q, kc, vc, bt, cl = _paged_case(0, b, num_kv, g, head_dim, block_size, 8,
                                     dtype)
     scale = head_dim**-0.5
-    got = pk.paged_decode_attention(q, kc, vc, bt, cl, block_size, scale,
-                                    variant=variant)
+    got = _ragged_decode(q, kc, vc, bt, cl, block_size, scale)
     got.block_until_ready()  # forces the Mosaic compile + execute
     ref = ref_ops.paged_decode_attention_xla(
         q, kc, vc, bt, cl, block_size, scale
@@ -141,9 +155,7 @@ def test_windowed_kernels_compile_and_match():
     # the decode case uses a 64-token window — the band must actually CUT
     # context or the gate degenerates to unwindowed attention
     q, kc, vc, bt, cl = _paged_case(5, 8, 8, 4, 128, 16, 8, jnp.bfloat16)
-    got = pk.paged_decode_attention(
-        q, kc, vc, bt, cl, 16, scale, window=64
-    )
+    got = _ragged_decode(q, kc, vc, bt, cl, 16, scale, window=64)
     ref = ref_ops.paged_decode_attention_xla(
         q, kc, vc, bt, cl, 16, scale, window=64
     )
@@ -219,9 +231,8 @@ def test_alibi_kernels_compile_and_match():
 
     q, kc, vc, bt, cl = _paged_case(7, 8, num_kv, g, head_dim, 16, 8,
                                     jnp.bfloat16)
-    got = pk.paged_decode_attention(
-        q, kc, vc, bt, cl, 16, scale, alibi_slopes=slopes
-    )
+    got = _ragged_decode(q, kc, vc, bt, cl, 16, scale,
+                         alibi_slopes=slopes)
     ref = ref_ops.paged_decode_attention_xla(
         q, kc, vc, bt, cl, 16, scale, alibi_slopes=slopes
     )

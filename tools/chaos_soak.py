@@ -69,8 +69,15 @@ FAULTS = (
     ("core.commit_step", "raise"),
     ("core.wait_step", "oom"),
     ("scheduler.schedule", "raise"),
-    ("runner.dispatch_prefill", "raise"),
+    ("runner.dispatch_ragged", "raise"),
     ("runner.dispatch_decode", "raise"),
+    # mid-spec-verify death (docs/ATTENTION.md "Speculative decoding"):
+    # fires inside the verify dispatch, AFTER the draft proposed but
+    # BEFORE any acceptance committed — the checkpoint/resume path must
+    # capture only ACCEPTED tokens (in-flight draft tokens die with the
+    # dispatch) and resume token-identically.  On non-spec seeds the
+    # schedule remaps this to the plain ragged dispatch site.
+    ("runner.dispatch_verify", "raise"),
     ("core.wait_step", "hang"),
     # armed in one round, fires during a LATER round's recovery: the
     # death-during-recovery retry, which must adopt the failed
@@ -95,7 +102,7 @@ def _build_fixtures() -> tuple[str, str]:
 
 
 def _build_engine(model_dir: str, *, dp: int, watchdog: bool,
-                  roles: tuple = ()):
+                  roles: tuple = (), spec: bool = False):
     from vllm_tgis_adapter_tpu.engine.async_llm import AsyncLLMEngine
     from vllm_tgis_adapter_tpu.engine.config import (
         CacheConfig,
@@ -105,6 +112,7 @@ def _build_engine(model_dir: str, *, dp: int, watchdog: bool,
         ModelConfig,
         ParallelConfig,
         SchedulerConfig,
+        SpeculativeConfig,
     )
 
     mcfg = ModelConfig.from_pretrained(model_dir, dtype="float32")
@@ -133,6 +141,18 @@ def _build_engine(model_dir: str, *, dp: int, watchdog: bool,
         watchdog_deadline_s=1.0 if watchdog else 0.0,
         watchdog_action="restart",
         frontdoor=FrontdoorConfig(enabled=True),
+        # speculative seeds (docs/ATTENTION.md): a same-weights draft —
+        # greedy requests ride verify spans, the mid-verify fault has a
+        # live site, and every recovery must re-attach the draft
+        speculative=(
+            SpeculativeConfig(
+                draft_model=model_dir,
+                num_speculative_tokens=3,
+                draft_model_config=mcfg,
+            )
+            if spec
+            else None
+        ),
     )
     return AsyncLLMEngine.from_config(config)
 
@@ -230,8 +250,13 @@ async def _run_seed(seed: int, model_dir: str, adapter_dir: str) -> dict:
         if dp == 2 and rng.random() < 0.7
         else ()
     )
+    # speculative seeds: ~60% of schedules attach the same-weights
+    # draft (greedy requests then ride verify spans; seeded-sampled
+    # ones stay on plain spans in the SAME dispatches) — composed with
+    # dp, roles and every fault in the pool
+    spec_on = rng.random() < 0.6
     engine = _build_engine(
-        model_dir, dp=dp, watchdog=(dp == 1), roles=roles
+        model_dir, dp=dp, watchdog=(dp == 1), roles=roles, spec=spec_on
     )
     hang_released: list[str] = []
     try:
@@ -283,6 +308,11 @@ async def _run_seed(seed: int, model_dir: str, adapter_dir: str) -> dict:
             site, action = rng.choice(FAULTS)
             if action == "hang" and dp != 1:
                 site, action = "core.plan_step", "raise"
+            if site == "runner.dispatch_verify" and not spec_on:
+                # no draft attached: the verify site never fires —
+                # remap to the plain ragged dispatch so the draw still
+                # injects a fault
+                site = "runner.dispatch_ragged"
             injected.append(f"{site}={action}")
             failpoints.arm_site(site, action, 1)
             if action == "hang":
@@ -314,6 +344,13 @@ async def _run_seed(seed: int, model_dir: str, adapter_dir: str) -> dict:
         for i, task in tasks.items():
             status, payload = task.result()
             if status == "ok":
+                if payload != baseline[i] and os.environ.get("CHAOS_DEBUG"):
+                    rid = f"chaos-{seed}-{i}"
+                    for rep_i, e in enumerate(
+                        rep.engine for rep in engine._replicas
+                    ):
+                        for ev in e.recorder.events_for(rid):
+                            print("DBG", rep_i, ev)
                 assert payload == baseline[i], (
                     f"seed invariant violated: request {i} "
                     f"({specs[i]['kind']}) completed but its streamed "

@@ -14,8 +14,11 @@ against the checked-in ``PERF_BASELINE.json``:
   than ``waste_slack`` absolute over the baseline (the ragged backend's
   whole claim is waste ≈ 0; a silent return of bucket padding is a
   regression even if tok/s survives);
-* cross-path sanity: the ragged path must not fall below the bucketed
-  path's throughput (it currently clears it ~3.5x on the CPU proxy);
+* speculative decoding (docs/ATTENTION.md "Speculative decoding"): the
+  decode-heavy chat scenario under concurrent RAG prefill load run with
+  and without a same-weights draft — spec chat ITL p50 must beat plain
+  ragged by ≥ ``spec.min_itl_speedup`` (ISSUE 12 acceptance: 1.5×) at
+  acceptance ≥ ``spec.min_acceptance`` with identical greedy outputs;
 * dp scaling (docs/SCALING.md): aggregate tok/s across the baseline's
   ``dp.points`` replica counts (ragged backend, BENCH_ARCH=small +
   BENCH_SYNC_DISPATCH=1 — see bench.py's docstring for why the dp gate
@@ -218,6 +221,49 @@ def measure_disagg(dis_cfg: dict, runs: int) -> tuple[dict, dict]:
     return disagg, mixed
 
 
+def measure_spec(spec_cfg: dict, runs: int) -> tuple[dict, dict]:
+    """ISSUE 12 gate driver: the decode-heavy chat scenario under
+    concurrent RAG prefill load (the BENCH_ROLES=mixed chat+RAG fleet),
+    run with BENCH_SPEC=1 (same-weights draft — ragged verify spans)
+    and BENCH_SPEC=0.  Best of ``runs`` per mode = lowest chat ITL p50:
+    a latency-ratio gate, so 'best' must mean the least
+    load-noise-polluted run on BOTH sides."""
+    backend = spec_cfg.get("backend", "ragged")
+
+    def best_of(spec_on: bool) -> dict:
+        best = None
+        for _ in range(runs):
+            env = dict(spec_cfg.get("env", {}))
+            env["BENCH_SPEC"] = "1" if spec_on else "0"
+            env["BENCH_SPEC_GAMMA"] = str(spec_cfg.get("gamma", 4))
+            line = run_bench(backend, env)
+            roles = line.get("roles")
+            if not roles or roles.get("chat_itl_ms_p50") is None:
+                raise RuntimeError(
+                    f"bench (spec={spec_on}) emitted no chat ITL stamps"
+                )
+            if (
+                best is None
+                or roles["chat_itl_ms_p50"]
+                < best["roles"]["chat_itl_ms_p50"]
+            ):
+                best = line
+        return best
+
+    spec = best_of(True)
+    plain = best_of(False)
+    s = spec["roles"]
+    print(
+        f"perf_check: spec     chat itl_p50 {s['chat_itl_ms_p50']}ms vs "
+        f"plain {plain['roles']['chat_itl_ms_p50']}ms "
+        f"(acceptance {spec['spec']['acceptance_rate']}, "
+        f"verify dispatches {spec['spec']['verify_dispatches']}) "
+        f"identical="
+        f"{s['outputs_digest'] == plain['roles']['outputs_digest']}"
+    )
+    return spec, plain
+
+
 def measure_recovery(rec_cfg: dict, runs: int) -> dict:
     """ISSUE 10 gate driver: ``tools/chaos_soak.py --recovery-bench``
     in a subprocess (own engines, shared persistent XLA cache — see
@@ -276,7 +322,7 @@ def main(argv: list[str] | None = None) -> int:
     env_overrides = dict(baseline.get("env", {}))
 
     measured: dict[str, dict] = {}
-    for backend in ("bucketed", "ragged"):
+    for backend in ("ragged",):
         try:
             measured[backend] = measure(backend, runs, env_overrides)
         except Exception as exc:  # noqa: BLE001 — tool boundary
@@ -330,6 +376,18 @@ def main(argv: list[str] | None = None) -> int:
             )
         except Exception as exc:  # noqa: BLE001 — tool boundary
             print(f"perf_check: disagg measurement failed: {exc}")
+            return 2
+
+    spec_cfg = baseline.get("spec")
+    spec_line: dict | None = None
+    plain_line: dict | None = None
+    if spec_cfg:
+        try:
+            spec_line, plain_line = measure_spec(
+                spec_cfg, int(spec_cfg.get("runs", runs))
+            )
+        except Exception as exc:  # noqa: BLE001 — tool boundary
+            print(f"perf_check: spec measurement failed: {exc}")
             return 2
 
     rec_cfg = baseline.get("recovery")
@@ -393,6 +451,10 @@ def main(argv: list[str] | None = None) -> int:
             # disagg/mixed chat-ITL bound is the ISSUE 11 acceptance
             # criterion, not a measured floor
             out["disagg"] = dict(dis_cfg)
+        if spec_cfg:
+            # declarative: the ≥1.5x spec/plain chat-ITL speedup and
+            # ≥0.6 acceptance are the ISSUE 12 acceptance criteria
+            out["spec"] = dict(spec_cfg)
         if dp_cfg:
             out["dp"] = {
                 **dp_cfg,
@@ -430,16 +492,6 @@ def main(argv: list[str] | None = None) -> int:
                 f"> ceiling {waste_ceiling:.4f} (baseline "
                 f"{base['padding_waste_frac']:.4f} + {waste_slack})"
             )
-    if (
-        "ragged" in measured
-        and "bucketed" in measured
-        and measured["ragged"]["aggregate_output_tok_per_s"]
-        < measured["bucketed"]["aggregate_output_tok_per_s"]
-    ):
-        failures.append(
-            "ragged backend fell below the bucketed backend's tok/s — "
-            "the unified path must never be the slower one"
-        )
 
     if dp_cfg:
         # absolute floors (already hand-haircut in the checked-in file,
@@ -572,6 +624,41 @@ def main(argv: list[str] | None = None) -> int:
                 "disagg: the mixed-mode control run handed off "
                 f"{m['handoffs_completed']} request(s) — control is "
                 "contaminated"
+            )
+
+    if spec_cfg and spec_line is not None and plain_line is not None:
+        # ISSUE 12 acceptance: ragged+spec beats plain ragged by >=
+        # min_itl_speedup on decode-heavy chat ITL at acceptance >=
+        # min_acceptance, token-identical under greedy, with verify
+        # dispatches actually taken
+        s, pl = spec_line["roles"], plain_line["roles"]
+        st = spec_line.get("spec", {})
+        min_speedup = float(spec_cfg.get("min_itl_speedup", 1.5))
+        speedup = pl["chat_itl_ms_p50"] / max(s["chat_itl_ms_p50"], 1e-9)
+        if speedup < min_speedup:
+            failures.append(
+                f"spec: chat ITL p50 {s['chat_itl_ms_p50']}ms is only "
+                f"{speedup:.2f}x better than plain ragged "
+                f"({pl['chat_itl_ms_p50']}ms) < required {min_speedup}x"
+            )
+        min_accept = float(spec_cfg.get("min_acceptance", 0.6))
+        if st.get("acceptance_rate", 0.0) < min_accept:
+            failures.append(
+                f"spec: acceptance {st.get('acceptance_rate')} < "
+                f"required {min_accept} (draft/verify machinery broken "
+                "— the same-weights draft should accept ~everything)"
+            )
+        min_vd = int(spec_cfg.get("min_verify_dispatches", 1))
+        if st.get("verify_dispatches", 0) < min_vd:
+            failures.append(
+                f"spec: {st.get('verify_dispatches')} verify dispatches "
+                f"< required {min_vd} (speculation never actually ran)"
+            )
+        if s["outputs_digest"] != pl["outputs_digest"]:
+            failures.append(
+                "spec: outputs digest diverged from the plain ragged "
+                "run (verify spans must be token-identical under "
+                "greedy sampling)"
             )
 
     if rec_cfg and rec_line is not None:

@@ -213,14 +213,20 @@ def main() -> None:
 
         return _med_ms(lambda: many(q, kc, vc, bt, cl).block_until_ready())
 
-    from vllm_tgis_adapter_tpu.ops import pallas_attention
+    from vllm_tgis_adapter_tpu.ops import ragged_attention as ragged_ops
 
-    for variant in ("folded", "perhead"):
-        emit(f"attn_pallas_{variant}_{n_calls}calls", attn_loop(
-            lambda q, kc, vc, bt, cl, v=variant:
-            pallas_attention.paged_decode_attention(
-                q, kc, vc, bt, cl, block_size=16, scale=0.125,
-                interpret=allow_cpu, variant=v)))
+    def ragged_decode(q, kc, vc, bt, cl):
+        # one-token spans: the serving decode path (the bucketed
+        # folded/perhead variant ladder is retired)
+        n = q.shape[0]
+        return ragged_ops.ragged_paged_attention(
+            q, kc, vc, jnp.maximum(cl, 1) - 1,
+            jnp.arange(n + 1, dtype=jnp.int32),
+            jnp.maximum(cl, 1) - 1, jnp.asarray(n, jnp.int32),
+            bt, 16, 0.125,
+        )
+
+    emit(f"attn_ragged_{n_calls}calls", attn_loop(ragged_decode))
     emit(f"attn_xla_{n_calls}calls", attn_loop(
         lambda q, kc, vc, bt, cl: attn_ops.paged_decode_attention_xla(
             q, kc, vc, bt, cl, 16, 0.125)))

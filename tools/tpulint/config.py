@@ -56,9 +56,14 @@ JIT_REGISTRY: dict[str, frozenset[str]] = {
     "models/llama.py": frozenset({
         "LlamaForCausalLM.prefill",
         "LlamaForCausalLM.prefill_chunk",
+        # decode is jitted from the fused-wave builder
+        # (runner._build_decode_fn) AND the speculative draft's propose
+        # scan (engine/speculative.py _build_propose_fn)
         "LlamaForCausalLM.decode",
-        # ragged backend (ops/ragged_attention.py): the unified mixed
-        # prefill+decode entry point, jitted as runner._ragged_fn
+        # the unified mixed prefill+decode entry point
+        # (ops/ragged_attention.py), jitted as runner._ragged_fn AND
+        # from inside the speculative verify program
+        # (runner._build_ragged_verify_fn, track_jit "ragged_verify")
         "LlamaForCausalLM.ragged_forward",
     }),
 }
@@ -67,9 +72,6 @@ JIT_REGISTRY: dict[str, frozenset[str]] = {
 #: functools.partial or passed as Python scalars, never traced).
 REGISTRY_STATIC_PARAMS: frozenset[str] = frozenset({
     "self", "block_size", "first_stage", "last_stage",
-    # closed over as a Python bool by the ragged fused-decode builder
-    # (runner._build_decode_fn); never traced
-    "use_ragged_kernel",
 })
 
 #: identifiers that mark a value as (probably) a live device array for

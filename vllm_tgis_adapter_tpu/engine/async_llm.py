@@ -69,6 +69,13 @@ from vllm_tgis_adapter_tpu.frontdoor.placement import ROLE_CAPABLE
 _PREFILL_CAPABLE = ROLE_CAPABLE["prefill"]
 _DECODE_CAPABLE = ROLE_CAPABLE["decode"]
 
+#: engine-resident admission window per replica when the front door is
+#: on: enough waiting candidates for the ragged planner to fill a flat
+#: bucket per step, while ordering beyond it stays WFQ-controlled
+#: (frontdoor/admission.py).  Historically MAX_PACK of the retired
+#: packed-prefill planner.
+ADMIT_WINDOW = 8
+
 
 class _Replica:
     """One engine + the concurrency state serializing access to it."""
@@ -164,17 +171,16 @@ class AsyncLLMEngine:
         # tenant weighted fair queuing, rate limits, queue TTLs, drain.
         # The serving layer hands requests here; the engine's own
         # waiting queue keeps only a small admission window (enough for
-        # packed prefill to see candidates) and everything beyond it
-        # parks in the fair queue.
+        # the ragged planner to fill its flat bucket with candidates)
+        # and everything beyond it parks in the fair queue.
         self.frontdoor = None
         fd_config = getattr(self.engine.config, "frontdoor", None)
         if fd_config is not None and fd_config.enabled:
-            from vllm_tgis_adapter_tpu.engine.scheduler import MAX_PACK
             from vllm_tgis_adapter_tpu.frontdoor.admission import FrontDoor
 
             window = min(
                 self.engine.config.scheduler_config.max_num_seqs,
-                MAX_PACK,
+                ADMIT_WINDOW,
             )
             self.frontdoor = FrontDoor(
                 fd_config,
@@ -1099,6 +1105,12 @@ class AsyncLLMEngine:
                 metrics.lora_adapters_registered.set(
                     len(manager.lora_requests)
                 )
+            for rep in self._replicas:
+                spec = getattr(rep.engine.runner, "spec", None)
+                if spec is not None and spec.stats.proposed:
+                    metrics.spec_acceptance_rate.labels(
+                        replica=str(rep.index)
+                    ).set(spec.stats.acceptance_rate)
         except Exception:  # pragma: no cover — metrics are best-effort
             logger.debug("engine gauge refresh failed", exc_info=True)
         return used, num_blocks
@@ -1324,13 +1336,15 @@ class AsyncLLMEngine:
                 # the watchdog dump should describe the newest dispatch
                 rep.in_flight_desc = new_desc
                 if handle is SYNC_DISPATCH:
-                    # not enqueue-only (speculative multi-phase verify,
-                    # staged pipeline): the device work happens inside
-                    # wait_step, so it must NOT sit in flight — a later
-                    # eagerly-dispatched prefill would then execute
-                    # BEFORE it on device, breaking the plan-order
-                    # invariant (stale K/V writes onto re-allocated
-                    # pages).  Execute and commit synchronously instead.
+                    # not enqueue-only (the staged pipeline runner —
+                    # speculative verify is enqueue-only since it moved
+                    # onto the ragged span path): the device work
+                    # happens inside wait_step, so it must NOT sit in
+                    # flight — a later eagerly-dispatched prefill would
+                    # then execute BEFORE it on device, breaking the
+                    # plan-order invariant (stale K/V writes onto
+                    # re-allocated pages).  Execute and commit
+                    # synchronously instead.
                     in_flight = (plan, prepared, handle, False)
                     await commit_in_flight()
                 else:

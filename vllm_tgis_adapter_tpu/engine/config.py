@@ -1011,43 +1011,46 @@ class EngineConfig:
     # the replica count; overrides replica_role.  () = uniform.
     dp_replica_roles: tuple[str, ...] = ()
     # --attention-backend: the serving data path (docs/ATTENTION.md).
-    # "bucketed" (default) keeps the solo/packed prefill buckets plus
-    # the per-batch-width decode ladder; "ragged" runs the unified
+    # "ragged" (the default AND only backend) runs the unified
     # ragged-paged-attention path (ops/ragged_attention.py): mixed
-    # prefill+decode token streams in one dispatch, one flat-length
-    # bucket, no per-prompt padding.  Bucketed stays the default until
-    # the ragged kernel is hardware-validated (ADVICE r5 caution); the
-    # flag makes the rewrite revertible per deployment.
-    attention_backend: str = "bucketed"
+    # prefill+decode token streams — speculative verify spans included
+    # — in one dispatch, one flat-length bucket, no per-prompt padding.
+    # "bucketed" (the pre-consolidation solo/packed prefill buckets +
+    # per-batch-width decode ladder) is RETIRED and fails boot with a
+    # pointer here; pp>1 / sp>1 engines and prompt-logprob requests
+    # transparently use the legacy solo-prefill/fused-decode planner.
+    attention_backend: str = "ragged"
 
     def __post_init__(self) -> None:
-        if self.attention_backend not in ("bucketed", "ragged"):
+        if self.attention_backend == "bucketed":
             raise ValueError(
-                f"--attention-backend must be 'bucketed' or 'ragged' "
-                f"(got {self.attention_backend!r})"
+                "--attention-backend=bucketed was retired: the ragged "
+                "paged-attention path (the default) is the only serving "
+                "data path — measured 3.5-4x bucketed tok/s at padding "
+                "waste 0.000 (docs/ATTENTION.md).  Drop the flag; pp>1 "
+                "/ sp>1 engines and prompt-logprob requests "
+                "transparently use the legacy solo-prefill planner."
             )
-        if self.attention_backend == "ragged":
-            # truthful flags (VERDICT r2/r3): refuse compositions the
-            # ragged path does not implement yet rather than run wrong
-            if self.speculative is not None:
-                raise ValueError(
-                    "--attention-backend=ragged does not compose with "
-                    "--speculative-model yet (the draft mirror runs the "
-                    "bucketed prefill path); drop one of the flags"
-                )
-            if self.parallel_config.pipeline_parallel_size > 1:
-                raise ValueError(
-                    "--attention-backend=ragged does not compose with "
-                    "--pipeline-parallel-size > 1 yet (the staged runner "
-                    "has no ragged plumbing); drop one of the flags"
-                )
-            if self.parallel_config.sequence_parallel_size > 1:
-                raise ValueError(
-                    "--attention-backend=ragged does not compose with "
-                    "--sequence-parallel-size > 1 yet (the ragged kernel "
-                    "reads the replicated paged cache, not the sp ring); "
-                    "drop one of the flags"
-                )
+        if self.attention_backend != "ragged":
+            raise ValueError(
+                f"--attention-backend must be 'ragged' "
+                f"(got {self.attention_backend!r}; 'bucketed' is "
+                "retired — docs/ATTENTION.md)"
+            )
+        if (
+            self.speculative is not None
+            and self.parallel_config.sequence_parallel_size > 1
+        ):
+            # truthful flags (VERDICT r2/r3): speculation rides the
+            # ragged verify span, and sp>1 engines plan through the
+            # legacy solo/fused path (the ragged kernel reads the
+            # replicated paged cache, not the sp ring)
+            raise ValueError(
+                "--speculative-model does not compose with "
+                "--sequence-parallel-size > 1 yet (speculative verify "
+                "rides the ragged span path; sp engines use the legacy "
+                "planner — docs/ATTENTION.md); drop one of the flags"
+            )
         if self.parallel_config.dp_replicas < 1:
             raise ValueError(
                 f"--dp-replicas must be >= 1 "
@@ -1354,6 +1357,6 @@ class EngineConfig:
             ),
             frontdoor=FrontdoorConfig.from_args(args),
             attention_backend=getattr(
-                args, "attention_backend", "bucketed"
-            ) or "bucketed",
+                args, "attention_backend", "ragged"
+            ) or "ragged",
         )

@@ -27,7 +27,6 @@ from vllm_tgis_adapter_tpu.engine.sampling_params import (
 )
 from vllm_tgis_adapter_tpu.engine.scheduler import (
     DecodePlan,
-    PackedPrefillPlan,
     PrefillPlan,
     RaggedPlan,
     Scheduler,
@@ -56,16 +55,12 @@ def describe_plan(plan) -> Optional[dict]:  # noqa: ANN001
             "total_tokens": plan.total_tokens,
             "num_decode": sum(1 for i in plan.items if i.is_decode),
             "num_prefill": sum(1 for i in plan.items if not i.is_decode),
+            "num_verify": sum(
+                1 for i in plan.items if i.spec_width > 0
+            ),
             "fill_ratio": round(
                 plan.total_tokens / plan.token_bucket, 4
             ) if plan.token_bucket else 0.0,
-            "request_ids": [i.seq.request_id for i in plan.items],
-        }
-    if isinstance(plan, PackedPrefillPlan):
-        return {
-            "kind": "packed_prefill",
-            "bucket": plan.bucket_len,
-            "num_prompts": len(plan.items),
             "request_ids": [i.seq.request_id for i in plan.items],
         }
     if isinstance(plan, PrefillPlan):
@@ -144,13 +139,14 @@ class LLMEngine:
             config.cache_config.num_blocks,
             max_model_len=config.max_model_len,
         )
-        # packed multi-prompt prefill needs the plain block-diagonal
-        # causal mask: no sliding window / ALiBi biases (both are
-        # position-offset-based), no pp stage plumbing, no sp ring, and
-        # no speculative draft mirroring (the draft prefill path is
-        # per-sequence).  The RUNNER's mesh is authoritative for sp —
-        # callers (dp replicas, the multichip dry run) may pass a mesh
-        # explicitly without it appearing in parallel_config
+        # ragged unified data path — THE serving planner
+        # (docs/ATTENTION.md): the scheduler plans token-budgeted
+        # RaggedPlans.  The legacy solo-prefill/fused-decode alternation
+        # serves only pp>1 / sp>1 engines (no ragged plumbing through
+        # the staged runner / sp ring yet) and prompt-logprob heads.
+        # The RUNNER's mesh is authoritative for sp — callers (dp
+        # replicas, the multichip dry run) may pass a mesh explicitly
+        # without it appearing in parallel_config
         mcfg = config.model_config
         pcfg = config.parallel_config
         runner_mesh = getattr(self.runner, "mesh", None)
@@ -159,21 +155,11 @@ class LLMEngine:
             if runner_mesh is not None
             else 1
         )
-        self.scheduler.allow_packed = (
-            config.speculative is None
-            and pcfg.pipeline_parallel_size == 1
+        self.scheduler.ragged = (
+            pcfg.pipeline_parallel_size == 1
             and pcfg.sequence_parallel_size == 1
             and mesh_sp == 1
-            and mcfg.sliding_window == 0
-            and mcfg.position_embedding != "alibi"
         )
-        # ragged unified data path (--attention-backend=ragged): the
-        # scheduler plans token-budgeted RaggedPlans; packed prefill is
-        # subsumed (a ragged step IS a multi-prompt pack without the
-        # bucket padding), so the packed entry point stays cold
-        if config.attention_backend == "ragged":
-            self.scheduler.ragged = True
-            self.scheduler.allow_packed = False
         # rolling-window KV eviction (scheduler docstring for the gates)
         if (
             mcfg.sliding_window > 0
@@ -397,8 +383,28 @@ class LLMEngine:
         # the other slices hold other replicas' weights and pools
         engine._devices = devices
         if draft_model is not None:
-            engine.runner.attach_speculative(draft_model, draft_params)
+            engine.attach_speculative(draft_model, draft_params)
         return engine
+
+    def attach_speculative(self, draft_model, draft_params) -> None:  # noqa: ANN001
+        """Attach the draft model (speculative decoding): the runner
+        builds the propose + jitted ragged-verify programs and the
+        scheduler starts planning verify spans for spec-eligible rows
+        (docs/ATTENTION.md "Speculative decoding")."""
+        self.runner.attach_speculative(draft_model, draft_params)
+        if not self.scheduler.ragged:
+            # legacy-planner engine (an explicitly passed sp mesh the
+            # config-level refusals cannot see): the draft would sit
+            # resident without a verify span ever planned
+            logger.warning(
+                "speculative draft attached to a legacy-planner engine "
+                "(pp/sp): verify spans ride the ragged planner only — "
+                "speculation will not run (docs/ATTENTION.md)"
+            )
+        if self.config.speculative is not None:
+            self.scheduler.set_spec_gamma(
+                self.config.speculative.num_speculative_tokens
+            )
 
     def get_tokenizer(self, lora_request=None):  # noqa: ANN001
         """Base tokenizer, or the adapter's own if its directory ships
@@ -1181,7 +1187,7 @@ class LLMEngine:
         warmups (exempt) and requests a role-degraded resume parked on
         this replica — the latter must bounce back off rather than
         decode a prefill replica's bucket away."""
-        if isinstance(plan, (RaggedPlan, PackedPrefillPlan)):
+        if isinstance(plan, RaggedPlan):
             seqs = [item.seq for item in plan.items]
         elif isinstance(plan, PrefillPlan):
             seqs = [plan.seq]
@@ -1194,6 +1200,16 @@ class LLMEngine:
                 or seq.request_id.startswith("__warmup")
                 or self._seqs.get(seq.request_id) is not seq
             ):
+                continue
+            if seq.status != SequenceStatus.RUNNING:
+                # a resumed request MID-CHUNK through its recompute tail
+                # (status WAITING, pages held, queued for the next
+                # chunk): it carries output tokens from its first life
+                # but has NOT finished prefill here — staging it now
+                # would hand off a stale checkpoint while the scheduler
+                # keeps (re)running it from the waiting queue, double-
+                # executing the stream.  It stages at its final-chunk
+                # commit, exactly like a fresh prompt.
                 continue
             self._stage_handoff(seq)
 
@@ -1231,9 +1247,10 @@ class LLMEngine:
         serves restarts).  Mirrors the TPU warmup the reference stack
         inherits from vLLM's TPU worker.
 
-        ``batch_widths``: "all" compiles every power-of-two decode
-        bucket (1, 2, 4, ... max_num_seqs); "max" only the widest —
-        faster boot, later fill-in compiles as load ramps.
+        ``batch_widths``: decode runs at ONE width (max_num_seqs);
+        "all" additionally compiles the want_topn sampler variant and
+        the full flat-bucket ladder, "max" keeps boot fast and lets
+        rare variants compile as load ramps.
 
         Returns the number of warmup requests run.  Must be called
         before serving starts (asserts the engine is idle); leaves no
@@ -1243,13 +1260,9 @@ class LLMEngine:
             raise RuntimeError("precompile must run on an idle engine")
         sched = self.scheduler
         max_len = self.config.max_model_len
-        widths = (
-            list(sched.batch_buckets)
-            if batch_widths == "all" and not sched.ragged
-            # ragged backend: decode runs at ONE width (max_num_seqs) —
-            # the per-width ladder is gone, so one pass warms it
-            else [sched.batch_buckets[-1]]
-        )
+        # decode runs at ONE width (max_num_seqs) — the per-width
+        # bucket ladder is retired, so one pass warms it
+        widths = [sched.config.max_num_seqs]
         # "all" also compiles the want_topn=True decode variant (static
         # argnum: flipping it at serving time is a fresh full compile)
         topn_variants = [False, True] if batch_widths == "all" else [False]
@@ -1580,14 +1593,6 @@ class LLMEngine:
                     m.first_scheduled_time = now
                     m.time_in_queue = now - m.arrival_time
             prepared = self.runner.prepare_ragged(plan)
-        elif isinstance(plan, PackedPrefillPlan):
-            now = time.time()
-            for item in plan.items:
-                m = item.seq.metrics
-                if m.first_scheduled_time is None:
-                    m.first_scheduled_time = now
-                    m.time_in_queue = now - m.arrival_time
-            prepared = self.runner.prepare_packed_prefill(plan)
         elif isinstance(plan, PrefillPlan):
             seq = plan.seq
             if seq.metrics.first_scheduled_time is None:
@@ -1618,17 +1623,12 @@ class LLMEngine:
                     start_pos=item.start_pos,
                     decode=item.is_decode,
                     is_final=item.is_final,
+                    # the verify phase of the ragged step: this item is
+                    # a speculative verify span (docs/OBSERVABILITY.md)
+                    verify=item.spec_width > 0,
                 )
             return
-        if isinstance(plan, PackedPrefillPlan):
-            for item in plan.items:
-                self.recorder.record(
-                    "packed_prefill", item.seq.request_id, step=step,
-                    trace_id=item.seq.trace_id, bucket=plan.bucket_len,
-                    num_prompts=len(plan.items),
-                    tokens=len(item.token_ids),
-                )
-        elif isinstance(plan, PrefillPlan):
+        if isinstance(plan, PrefillPlan):
             self.recorder.record(
                 "prefill", plan.seq.request_id, step=step,
                 trace_id=plan.seq.trace_id, bucket=plan.bucket_len,
@@ -1655,12 +1655,6 @@ class LLMEngine:
                     ),
                     num_decode=sum(1 for i in plan.items if i.is_decode),
                 )
-            elif isinstance(plan, PackedPrefillPlan):
-                metrics.observe_prefill_plan(
-                    real_tokens=prepared.total_tokens,
-                    bucket=plan.bucket_len,
-                    num_prompts=len(plan.items),
-                )
             elif isinstance(plan, PrefillPlan):
                 metrics.observe_prefill_plan(
                     real_tokens=len(plan.token_ids),
@@ -1684,8 +1678,6 @@ class LLMEngine:
         runner-owned device state — never reads scheduler structures."""
         if isinstance(plan, RaggedPlan):
             return self.runner.execute_ragged(prepared)
-        if isinstance(plan, PackedPrefillPlan):
-            return self.runner.execute_packed_prefill(prepared)
         if isinstance(plan, PrefillPlan):
             return self.runner.execute_prefill(prepared)
         return self.runner.execute_decode(prepared)
@@ -1698,8 +1690,6 @@ class LLMEngine:
         failpoints.fire("core.dispatch_step")  # worker thread: hang-capable
         if isinstance(plan, RaggedPlan):
             return self.runner.dispatch_ragged(prepared)
-        if isinstance(plan, PackedPrefillPlan):
-            return self.runner.dispatch_packed_prefill(prepared)
         if isinstance(plan, PrefillPlan):
             return self.runner.dispatch_prefill(prepared)
         return self.runner.dispatch_decode(prepared)
@@ -1710,8 +1700,6 @@ class LLMEngine:
         failpoints.fire("core.wait_step")  # worker thread: hang-capable
         if isinstance(plan, RaggedPlan):
             return self.runner.wait_ragged(prepared, handle)
-        if isinstance(plan, PackedPrefillPlan):
-            return self.runner.wait_packed_prefill(prepared, handle)
         if isinstance(plan, PrefillPlan):
             return self.runner.wait_prefill(prepared, handle)
         return self.runner.wait_decode(prepared, handle)
@@ -1726,8 +1714,6 @@ class LLMEngine:
         Returns (plan, prepared) or None when chaining is not safe."""
         if not isinstance(prev_plan, DecodePlan):
             return None
-        if prev_prepared.spec_ok:
-            return None  # speculative dispatches are SYNC, never chained
         plan = self.scheduler.schedule_chained(prev_plan)
         if plan is None:
             return None
@@ -1781,7 +1767,10 @@ class LLMEngine:
                 ).observe(duration)
         if isinstance(plan, RaggedPlan):
             seqs, toks = [], []
-            for item, tok in zip(plan.items, result):
+            spec_ran = prepared is not None and getattr(
+                prepared, "spec_ran", False
+            )
+            for item, tok_list in zip(plan.items, result):
                 seq = item.seq
                 if seq.is_finished:
                     continue  # aborted while the ragged dispatch ran
@@ -1790,21 +1779,20 @@ class LLMEngine:
                     # its pages for prefix reuse (device cache + host
                     # tier demotion)
                     self._register_prefix(seq)
-                if tok is None:
+                if tok_list is None:
                     continue  # mid-prompt chunk: nothing emitted yet
                 seqs.append(seq)
-                toks.append([tok])
-            return self._process_sampled(seqs, toks)
-        if isinstance(plan, PackedPrefillPlan):
-            seqs, toks = [], []
-            for item, tok in zip(plan.items, result):
-                seq = item.seq
-                if seq.is_finished:
-                    continue  # aborted while the packed dispatch ran
-                self._register_prefix(seq)
-                seqs.append(seq)
-                toks.append([tok])
-            return self._process_sampled(seqs, toks)
+                toks.append(tok_list)
+            outputs = self._process_sampled(seqs, toks)
+            if spec_ran:
+                for item in plan.items:
+                    if item.spec_width > 0 and not item.seq.is_finished:
+                        # propose wrote draft K/V through the last
+                        # consumed token's predecessor; everything
+                        # beyond is stale-by-design (next catch-up /
+                        # propose re-inputs the corrected token)
+                        item.seq.draft_pos = item.seq.num_tokens - 1
+            return outputs
         if isinstance(plan, PrefillPlan):
             seq = plan.seq
             sampled, prompt_info = result
@@ -1841,14 +1829,7 @@ class LLMEngine:
             # pages for prefix reuse (device cache + host tier demotion)
             self._register_prefix(seq)
             return self._process_sampled([seq], [[sampled]])
-        outputs = self._process_sampled(plan.seqs, result)
-        if prepared is not None and getattr(prepared, "spec_ran", False):
-            for seq in plan.seqs:
-                if not seq.is_finished:
-                    # propose wrote K/V through the last consumed token's
-                    # predecessor; everything beyond is stale-by-design
-                    seq.draft_pos = seq.num_tokens - 1
-        return outputs
+        return self._process_sampled(plan.seqs, result)
 
     # -------------------------------------------------------------- internal
 

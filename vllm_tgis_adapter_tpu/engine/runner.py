@@ -4,8 +4,9 @@ Owns the jit-compiled prefill/decode functions, the device-resident KV
 caches, the seen-token matrix for repetition penalties, and the sampler
 invocation.  All shapes flowing into jit are drawn from the scheduler's
 buckets, so the compile count is bounded by
-``len(prefill_buckets) + len(batch_buckets)`` (SURVEY.md §7 "XLA
-recompilation discipline").
+the flat ragged token buckets plus a handful of fused-decode step
+variants (SURVEY.md §7 "XLA recompilation discipline"; docs/ATTENTION.md
+"Compile lattice").
 """
 
 from __future__ import annotations
@@ -31,8 +32,8 @@ if TYPE_CHECKING:
 logger = init_logger(__name__)
 
 #: dispatch/wait split sentinel: returned by a ``dispatch_*`` method when
-#: the path cannot enqueue-only (speculative multi-phase verify, staged
-#: pipeline runner) — the paired ``wait_*`` then runs the full execution.
+#: the path cannot enqueue-only (the staged pipeline runner) — the
+#: paired ``wait_*`` then runs the full execution.
 SYNC_DISPATCH = object()
 
 #: minimum Pallas work-schedule width per ragged dispatch: small mixed
@@ -112,30 +113,6 @@ class PreparedPrefill:
 
 
 @dataclasses.dataclass
-class PreparedPackedPrefill:
-    """Host-built dispatch inputs for one packed multi-prompt prefill.
-
-    ``MAX_PACK`` fixed-width per-row arrays (segment starts, logits rows,
-    sampler tensors) keep one compile shape per token bucket regardless
-    of how many prompts were packed (engine/scheduler.py MAX_PACK).
-    """
-
-    bucket: int
-    num_items: int  # real packed prompts (<= MAX_PACK)
-    total_tokens: int  # real tokens across all segments
-    token_ids: "np.ndarray"  # [bucket] concatenated prompts
-    positions: "np.ndarray"  # [bucket] restarting at 0 per segment
-    slot_mapping: "np.ndarray"  # [bucket]
-    seg_starts: "np.ndarray"  # [MAX_PACK] flat start per segment (pad=bucket)
-    logits_indices: "np.ndarray"  # [MAX_PACK] last-token row (pad=0)
-    row_slots: "np.ndarray"  # [MAX_PACK] batch row per segment (pad=-1)
-    seen_tokens: "np.ndarray"  # [MAX_PACK, P] prompt ids for seen seeding
-    tensors: SamplingTensors  # MAX_PACK rows
-    allowed_mask: "Optional[np.ndarray]"  # [MAX_PACK, V] FSM rows or None
-    lora_slot: int  # shared by every packed prompt (scheduler invariant)
-
-
-@dataclasses.dataclass
 class PreparedRagged:
     """Host-built dispatch inputs for one unified ragged step
     (scheduler.RaggedPlan → ops/ragged_attention.py).
@@ -165,6 +142,23 @@ class PreparedRagged:
     samples: list[bool]  # per item: does it emit a token this step
     work: "Optional[np.ndarray]"  # Pallas work schedule (TPU only)
     want_topn: bool = True
+    # ---- speculative verify (docs/ATTENTION.md "Speculative decoding"):
+    # set when any item is a verify span (scheduler RaggedItem.spec_width
+    # > 0).  All fixed [S_max]/[S_max, γ(+1)] shapes, so the verify
+    # program compiles once per flat bucket like the plain ragged step.
+    has_spec: bool = False
+    spec_mask: "Optional[np.ndarray]" = None  # [S_max] bool: verify items
+    steps_per_item: "Optional[list[int]]" = None  # emission cap per item
+    verify_indices: "Optional[np.ndarray]" = None  # [S_max, γ+1] rows
+    draft_scatter: "Optional[np.ndarray]" = None  # [S_max, γ] stream rows
+    spec_tokens0: "Optional[np.ndarray]" = None  # [S_max] window head
+    spec_positions0: "Optional[np.ndarray]" = None  # [S_max]
+    spec_limits: "Optional[np.ndarray]" = None  # [S_max] (-1 inactive)
+    spec_context0: "Optional[np.ndarray]" = None  # [S_max]
+    draft_catchups: list = dataclasses.field(default_factory=list)
+    # set by dispatch when the verify path actually ran (commit then
+    # advances each verify row's draft_pos)
+    spec_ran: bool = False
 
 
 @dataclasses.dataclass
@@ -183,19 +177,10 @@ class PreparedDecode:
     tensors: SamplingTensors
     allowed_mask: "Optional[np.ndarray]"
     lora_idx: "Optional[np.ndarray]"
-    # every row is plain-greedy and adapterless → the speculative path
-    # may take this dispatch (engine/speculative.py)
-    spec_ok: bool = False
     # any row asked for top-N logprobs: False compiles/selects the
     # sampler variant with no per-step lax.top_k and zero-width topn
     # outputs (the common serving case)
     want_topn: bool = True
-    # rows whose draft cache lags (they decoded in mixed batches): each
-    # entry is the padded draft-chunk inputs to catch that row up
-    draft_catchups: list = dataclasses.field(default_factory=list)
-    # set by SpeculativeDecoder.run when the dispatch actually speculated
-    # (commit then advances each row's draft_pos)
-    spec_ran: bool = False
     # chained wave (async scheduling): which step row of the PREVIOUS
     # wave's device outputs feeds each row's input token
     chain_idx: "Optional[np.ndarray]" = None
@@ -322,28 +307,19 @@ class ModelRunner:
                     "use ring mode or adjust sp/tp"
                 )
 
-        # ragged unified data path (--attention-backend=ragged): the
-        # decode programs below trace the ragged kernel instead of the
-        # bucketed variant ladder, and _ragged_fn serves mixed steps
-        self._ragged_backend = (
-            getattr(config, "attention_backend", "bucketed") == "ragged"
-        )
         # buffer donation lets XLA update the KV cache in place; host
         # platforms don't implement donation and warn, so gate it
         donate = (1,) if jax.default_backend() == "tpu" else ()
         # recompile tracking (compile_tracker.py): every jitted entry
         # point is wrapped so a compile-cache miss records the (bucket,
         # batch, steps) shape that triggered it — on TPU a leak past the
-        # scheduler's buckets costs a 20-40s serving stall per shape
+        # scheduler's buckets costs a 20-40s serving stall per shape.
+        # The solo prefill program serves the legacy path only (pp/sp
+        # engines, prompt-logprob heads — docs/ATTENTION.md)
         self._prefill_fn = track_jit(
             "prefill",
             jax.jit(model.prefill, donate_argnums=donate),
-            # solo and packed prefill retrace separately (seg_starts
-            # changes the call arity) — label them apart so the
-            # compile-lattice evidence counts both programs
-            label=lambda args, kwargs: f"tokens={args[2].shape[0]}" + (
-                ",packed" if kwargs.get("seg_starts") is not None else ""
-            ),
+            label=lambda args, kwargs: f"tokens={args[2].shape[0]}",
         )
         self._decode_fn = self._build_decode_fn()
 
@@ -393,31 +369,35 @@ class ModelRunner:
         )
         # unified ragged step: one program per flat-length bucket serves
         # every mixed prefill+decode batch (ops/ragged_attention.py) —
-        # the compile lattice the bucketed path spreads over
-        # solo/packed/chunk prefill entry points collapses here
-        self._ragged_fn = None
+        # THE serving data path; solo prefill above is the legacy
+        # fallback only
+        self._ragged_fn = track_jit(
+            "ragged_step",
+            jax.jit(
+                functools.partial(
+                    model.ragged_forward, block_size=self.block_size
+                ),
+                donate_argnums=donate,
+            ),
+            label=lambda args, kwargs: f"tokens={args[2].shape[0]}"
+            + (
+                f",work={kwargs['work'].shape[1]}"
+                if kwargs.get("work") is not None
+                else ""
+            ),
+        )
         # per-flat-bucket high-water mark for the Pallas work-schedule
         # width (a compile shape of the ragged step; see prepare_ragged)
         self._ragged_work_hwm: dict[int, int] = {}
-        if self._ragged_backend:
-            self._ragged_fn = track_jit(
-                "ragged_step",
-                jax.jit(
-                    functools.partial(
-                        model.ragged_forward, block_size=self.block_size
-                    ),
-                    donate_argnums=donate,
-                ),
-                label=lambda args, kwargs: f"tokens={args[2].shape[0]}"
-                + (
-                    f",work={kwargs['work'].shape[1]}"
-                    if kwargs.get("work") is not None
-                    else ""
-                ),
-            )
         # draft-model speculative decoding; attached by the engine when
-        # --speculative-model is configured (engine/speculative.py)
+        # --speculative-model is configured (engine/speculative.py).
+        # _ragged_verify_fn is the jitted verify-span entry point,
+        # built at attach time (docs/ATTENTION.md "Speculative
+        # decoding"): draft-token scatter → ragged forward → per-span
+        # window gather → rejection sampling, all in ONE program per
+        # flat bucket.
         self.spec = None
+        self._ragged_verify_fn = None
         # --swap-space: donated jitted scatter, built on first swap-in
         self._restore_kv_fn = None
         # host KV tier (engine/kv_tier.py): fixed-block-shape gather /
@@ -436,6 +416,7 @@ class ModelRunner:
             self, draft_model, draft_params,
             self.config.speculative.num_speculative_tokens,
         )
+        self._ragged_verify_fn = self._build_ragged_verify_fn()
 
     def sync_lora(self, manager) -> None:
         """Legacy slow path: rebuild the stacked adapter tensors when
@@ -492,12 +473,11 @@ class ModelRunner:
         """
         model = self.model
         block_size = self.block_size
-        # ragged backend: the fused wave runs the SAME unified kernel
-        # as mixed steps (each row a one-token span) — the decode
-        # variant ladder (folded → perhead → xla) is retired on this
-        # path, and the compile labels split by backend so the
-        # compile-count-by-backend metric attributes shapes correctly
-        use_ragged = self._ragged_backend
+        # the fused wave runs the SAME unified ragged kernel as mixed
+        # steps (each row a one-token span) — the bucketed decode
+        # variant ladder (folded → perhead → xla) is retired; the
+        # ragged_* compile labels keep the by-backend attribution the
+        # compile-count metric reports
 
         def decode_steps(
             params,
@@ -553,7 +533,6 @@ class ModelRunner:
                 logits, caches = model.decode(
                     params, caches, tokens, pos, slot, block_tables,
                     context_lens0 + k, block_size, lora, lora_idx,
-                    use_ragged_kernel=use_ragged,
                 )
                 t_k = dataclasses.replace(
                     tensors, gen_len=tensors.gen_len + k
@@ -599,9 +578,8 @@ class ModelRunner:
                 allowed_mask, lora, lora_idx, num_steps, want_topn,
             )
 
-        prefix = "ragged_" if use_ragged else ""
         self._chained_decode_fn = track_jit(
-            f"{prefix}chained_decode",
+            "ragged_chained_decode",
             jax.jit(chained_decode_steps, static_argnums=(11, 12),
                     donate_argnums=donate),
             # ints is arg 5 ([11, B]), num_steps is static arg 11
@@ -609,7 +587,7 @@ class ModelRunner:
                 f"batch={args[5].shape[1]},steps={args[11]}",
         )
         return track_jit(
-            f"{prefix}decode",
+            "ragged_decode",
             jax.jit(decode_steps, static_argnums=(9, 10),
                     donate_argnums=donate),
             # ints is arg 3 ([11, B]), num_steps is static arg 9
@@ -967,82 +945,7 @@ class ModelRunner:
     ) -> tuple[Optional[SampledToken], Optional[PromptLogprobInfo]]:
         return self.execute_prefill(self.prepare_prefill(plan))
 
-    # -------------------------------------------------------- packed prefill
-
-    def prepare_packed_prefill(self, plan) -> "PreparedPackedPrefill":
-        """Host half for a multi-prompt packed prefill
-        (scheduler.PackedPrefillPlan): concatenate the prompts on the
-        token axis, record per-segment starts / sampling rows."""
-        from vllm_tgis_adapter_tpu.engine.scheduler import MAX_PACK
-
-        items = plan.items
-        bucket = plan.bucket_len
-        k = len(items)
-        token_ids = np.zeros(bucket, np.int32)
-        positions = np.zeros(bucket, np.int32)
-        slot_mapping = np.full(bucket, -1, np.int32)
-        seg_starts = np.full(MAX_PACK, bucket, np.int32)
-        logits_indices = np.zeros(MAX_PACK, np.int32)
-        row_slots = np.full(MAX_PACK, -1, np.int32)
-        seeds = np.zeros(MAX_PACK, np.uint32)
-        # one shared pad width (the largest item's seen bucket) so the
-        # whole pack seeds the seen matrix in ONE batched dispatch
-        pad = max(
-            self._seen_pad_len(len(it.seq.all_token_ids)) for it in items
-        )
-        seen_tokens = np.full((MAX_PACK, pad), -1, np.int32)
-        off = 0
-        for i, it in enumerate(items):
-            t = len(it.token_ids)
-            token_ids[off : off + t] = it.token_ids
-            positions[off : off + t] = np.arange(t, dtype=np.int32)
-            slot_mapping[off : off + t] = it.slots
-            seg_starts[i] = off
-            logits_indices[i] = off + t - 1
-            row_slots[i] = it.seq.slot
-            seeds[i] = it.seq.fallback_seed
-            all_ids = it.seq.all_token_ids
-            seen_tokens[i, : len(all_ids)] = all_ids
-            off += t
-
-        params_list = [it.seq.params for it in items] + [None] * (
-            MAX_PACK - k
-        )
-        gen_lens = [it.seq.num_output_tokens for it in items] + [0] * (
-            MAX_PACK - k
-        )
-        tensors = SamplingTensors.from_params(
-            params_list,
-            eos_token_id=self.config.model_config.eos_token_id,
-            gen_lens=gen_lens,
-            fallback_seeds=seeds,
-        )
-
-        allowed_mask = None
-        if any(it.seq.fsm is not None for it in items):
-            vocab = self.config.model_config.vocab_size
-            allowed_mask = np.ones((MAX_PACK, vocab), bool)
-            for i, it in enumerate(items):
-                if it.seq.fsm is not None:
-                    row = it.seq.fsm.allowed_row(it.seq.fsm_state)
-                    allowed_mask[i, : len(row)] = row
-                    allowed_mask[i, len(row):] = False
-
-        return PreparedPackedPrefill(
-            bucket=bucket,
-            num_items=k,
-            total_tokens=off,
-            token_ids=token_ids,
-            positions=positions,
-            slot_mapping=slot_mapping,
-            seg_starts=seg_starts,
-            logits_indices=logits_indices,
-            row_slots=row_slots,
-            seen_tokens=seen_tokens,
-            tensors=tensors,
-            allowed_mask=allowed_mask,
-            lora_slot=items[0].seq.lora_slot,
-        )
+    # ---------------------------------------------------------------- ragged
 
     def _sample_rows(
         self,
@@ -1054,11 +957,11 @@ class ModelRunner:
         allowed_mask,
         want_topn: bool = True,
     ):
-        """Post-forward sampler tail shared by the batched multi-row
-        dispatchers (packed prefill, ragged): seed the seen matrix for
-        finishing prompts (``seed_slots`` < 0 drop in the scatter; a
-        batch with nothing to seed skips the dispatch entirely), gather
-        per-row seen state, sample, record the sampled tokens."""
+        """Post-forward sampler tail of the ragged dispatchers: seed the
+        seen matrix for finishing prompts (``seed_slots`` < 0 drop in
+        the scatter; a batch with nothing to seed skips the dispatch
+        entirely), gather per-row seen state, sample, record the
+        sampled tokens."""
         if (seed_slots >= 0).any():
             self.seen = sampler_mod.set_seen_rows(
                 self.seen,
@@ -1085,54 +988,6 @@ class ModelRunner:
             self.seen, self._put(row_slots), out.tokens
         )
         return sampler_mod.pack_output(out)
-
-    def dispatch_packed_prefill(self, prep: "PreparedPackedPrefill"):
-        """Enqueue ONE forward over the packed bucket (block-diagonal
-        causal mask via seg_starts) plus the batched sampler over the
-        MAX_PACK last-token rows; no blocking transfers (see
-        dispatch_prefill)."""
-        lora_args = ()
-        if self.lora_stacks is not None:
-            lora_args = (
-                self.lora_stacks,
-                self._put(np.asarray(prep.lora_slot, np.int32)),
-            )
-        logits, self.caches = self._prefill_fn(
-            self.params,
-            self.caches,
-            self._put(prep.token_ids),
-            self._put(prep.positions),
-            self._put(prep.slot_mapping),
-            self._put(np.asarray(prep.total_tokens, np.int32)),
-            self._put(prep.logits_indices),
-            *lora_args,
-            seg_starts=self._put(prep.seg_starts),
-        )
-        return self._sample_rows(
-            logits,
-            prep.row_slots,
-            prep.row_slots,
-            prep.seen_tokens,
-            prep.tensors,
-            prep.allowed_mask,
-        )
-
-    def wait_packed_prefill(
-        self, prep: "PreparedPackedPrefill", handle
-    ) -> list[SampledToken]:
-        """Blocking half: one SampledToken per real packed prompt, in
-        pack order (one device fetch for the whole pack)."""
-        host = _HostSamplerOutput.from_packed(handle[None])
-        return [host.token(0, i) for i in range(prep.num_items)]
-
-    def execute_packed_prefill(
-        self, prep: "PreparedPackedPrefill"
-    ) -> list[SampledToken]:
-        return self.wait_packed_prefill(
-            prep, self.dispatch_packed_prefill(prep)
-        )
-
-    # ---------------------------------------------------------------- ragged
 
     def prepare_ragged(self, plan) -> "PreparedRagged":
         """Host half of one unified ragged step (scheduler.RaggedPlan):
@@ -1261,7 +1116,7 @@ class ModelRunner:
                 tail[0, :] = work[0, -1]
                 work = np.concatenate([work, tail], axis=1)
 
-        return PreparedRagged(
+        prep = PreparedRagged(
             bucket=bucket,
             total_tokens=off,
             num_items=len(items),
@@ -1285,12 +1140,239 @@ class ModelRunner:
                 for it in items
             ),
         )
+        if self.spec is not None and any(
+            it.spec_width > 0 for it in items
+        ):
+            self._prepare_spec(prep, items)
+        return prep
+
+    def _prepare_spec(self, prep: "PreparedRagged", items) -> None:
+        """Snapshot the speculative verify inputs onto ``prep``
+        (docs/ATTENTION.md "Speculative decoding"): per-span window
+        descriptors for the jitted verify program, the draft propose
+        inputs, and catch-up chunks for rows whose draft cache lags
+        (fresh prompts the ragged path prefilled target-only, rows that
+        decoded as plain spans, prefix-cache/host-tier adopted spans).
+        Every array is a fixed [S_max]-family shape, so the verify
+        program compiles once per flat bucket."""
+        s_max = self.config.scheduler_config.max_num_seqs
+        bucket = prep.bucket
+        gamma = self.spec.gamma
+        kw = gamma + 1
+        spec_mask = np.zeros(s_max, bool)
+        verify_indices = np.zeros((s_max, kw), np.int32)
+        # pads index one past the stream and drop in the scatter
+        draft_scatter = np.full((s_max, gamma), bucket, np.int32)
+        spec_tokens0 = np.zeros(s_max, np.int32)
+        spec_positions0 = np.zeros(s_max, np.int32)
+        spec_limits = np.full(s_max, -1, np.int32)
+        spec_context0 = np.ones(s_max, np.int32)
+        steps_per_item: list[int] = []
+        catchups: list[dict] = []
+        for i, it in enumerate(items):
+            off = int(prep.seq_starts[i])
+            w = it.spec_width
+            if w <= 0:
+                steps_per_item.append(1)
+                # every window column reads the item's own sampling row
+                # (garbage for mid-chunk items, discarded at wait)
+                verify_indices[i, :] = prep.logits_indices[i]
+                continue
+            seq = it.seq
+            spec_mask[i] = True
+            steps_per_item.append(w)
+            # window rows: the span's own stream rows; columns past a
+            # TRUNCATED span (w < γ+1, budget/model-len capped) repeat
+            # its last row so the shape stays fixed — emission caps at
+            # w, so the repeated columns never emit
+            for j in range(kw):
+                verify_indices[i, j] = off + min(j, w - 1)
+            for j in range(w - 1):
+                draft_scatter[i, j] = off + 1 + j
+            spec_tokens0[i] = seq.all_token_ids[-1]
+            spec_positions0[i] = it.start_pos
+            spec_limits[i] = it.start_pos + (w - 1)
+            spec_context0[i] = seq.num_tokens
+            end = seq.num_tokens - 1
+            if seq.draft_pos < end:
+                gap = seq.all_token_ids[seq.draft_pos:end]
+                cb = self._seen_pad_len(len(gap))
+                ids = np.zeros(cb, np.int32)
+                ids[: len(gap)] = gap
+                cpos = seq.draft_pos + np.arange(cb, dtype=np.int32)
+                cslots = np.full(cb, -1, np.int32)
+                cslots[: len(gap)] = seq.blocks.slots_for_range(
+                    seq.draft_pos, end
+                )
+                catchups.append(dict(
+                    t=len(gap),
+                    token_ids=ids,
+                    positions=cpos,
+                    slot_mapping=cslots,
+                    block_table=prep.block_tables[i],
+                    start_pos=seq.draft_pos,
+                ))
+        prep.has_spec = True
+        prep.spec_mask = spec_mask
+        prep.steps_per_item = steps_per_item
+        prep.verify_indices = verify_indices
+        prep.draft_scatter = draft_scatter
+        prep.spec_tokens0 = spec_tokens0
+        prep.spec_positions0 = spec_positions0
+        prep.spec_limits = spec_limits
+        prep.spec_context0 = spec_context0
+        prep.draft_catchups = catchups
+
+    def _build_ragged_verify_fn(self):
+        """Jitted speculative verify entry point (track_jit
+        "ragged_verify"): scatter the draft's proposals into their
+        reserved stream rows, run ONE ragged forward over the mixed
+        stream (fresh prefill + verify spans + plain decode spans in
+        the same bucket — the kernel's causal masking within each span
+        yields the verify logits), gather each span's (γ+1)-row window,
+        and accept/reject on device via the rejection sampler
+        (engine/speculative.py _rejection_core).  Returns the updated
+        caches, the per-item FINAL-row logits (the standard sampler
+        path for non-spec rows rides them exactly like the plain ragged
+        step), and the packed per-span verify results.  One program per
+        flat bucket × work width — the same lattice as ragged_step."""
+        model = self.model
+        block_size = self.block_size
+        from vllm_tgis_adapter_tpu.engine.speculative import (
+            _pack_spec_results,
+            _rejection_core,
+        )
+
+        def verify(
+            params, caches, token_ids, positions, slot_mapping,
+            seq_starts, pos_base, total_tokens, block_tables,
+            verify_indices,  # [S, γ+1] flat logits rows per item
+            drafted,  # [γ, S] draft proposals (device, from propose)
+            q_probs,  # [γ, S, V] draft sampling distributions
+            draft_scatter,  # [S, γ] stream rows (pads OOB → dropped)
+            spec_mask,  # [S] bool: verify items
+            tokens0,  # [S] window head (the span's last sampled token)
+            temps, top_k, top_p, base_key, gen0,  # [S] sampling rows
+            lora=None, lora_idx=None, *, work=None, want_topn=True,
+        ):
+            s, kw = verify_indices.shape
+            flat_idx = draft_scatter.reshape(-1)
+            flat_val = jnp.transpose(drafted).reshape(-1).astype(jnp.int32)
+            token_ids = token_ids.at[flat_idx].set(flat_val, mode="drop")
+            logits, caches = model.ragged_forward(
+                params, caches, token_ids, positions, slot_mapping,
+                seq_starts, pos_base, total_tokens, block_tables,
+                verify_indices.reshape(-1), lora, lora_idx,
+                block_size=block_size, work=work,
+            )
+            logits = logits.reshape(s, kw, -1)
+            window = jnp.concatenate(
+                [tokens0[:, None], jnp.transpose(drafted)], axis=1
+            )  # [S, γ+1]
+            emitted, accepted = _rejection_core(
+                logits, q_probs, window, temps, top_k, top_p,
+                base_key, gen0,
+            )
+            accepted = jnp.where(spec_mask, accepted, 0)
+            # token-info reporting matches the non-spec sampler:
+            # logprobs of the temperature-scaled distribution (no
+            # penalties on eligible rows by construction)
+            safe = jnp.where(temps <= 0.0, 1.0, temps)[:, None, None]
+            logp = jax.nn.log_softmax(logits / safe, axis=-1)
+            chosen_lp = jnp.take_along_axis(
+                logp, emitted[..., None], axis=-1
+            )[..., 0]
+            rank = 1 + jnp.sum(
+                logp > chosen_lp[..., None], axis=-1
+            ).astype(jnp.int32)
+            if want_topn:
+                topn_lp, topn_ids = jax.lax.top_k(logp, TOPN_WIDTH)
+            else:
+                # no row asked for top-N logprobs: skip the vocab-wide
+                # per-window top-k (the common serving case — same
+                # static variant split the plain sampler compiles)
+                topn_lp = jnp.zeros((s, kw, 0), jnp.float32)
+                topn_ids = jnp.zeros((s, kw, 0), jnp.int32)
+            packed_spec = _pack_spec_results(
+                emitted, accepted, chosen_lp, rank,
+                topn_ids.astype(jnp.int32), topn_lp,
+            )
+            # column γ is every item's FINAL real row (truncated spans
+            # repeat theirs; non-spec items carry it in every column)
+            return caches, logits[:, kw - 1], packed_spec
+
+        donate = (1,) if jax.default_backend() == "tpu" else ()
+        return track_jit(
+            "ragged_verify",
+            jax.jit(verify, donate_argnums=donate,
+                    static_argnames=("want_topn",)),
+            label=lambda args, kwargs: f"tokens={args[2].shape[0]}"
+            + (
+                f",work={kwargs['work'].shape[1]}"
+                if kwargs.get("work") is not None
+                else ""
+            ),
+        )
+
+    def _dispatch_ragged_verify(self, prep: "PreparedRagged"):
+        """Enqueue the speculative verify dispatch: draft catch-up +
+        the γ-step propose scan, then the single jitted verify program
+        above, then the standard sampler over every item's final row
+        (non-spec rows and finishing prompts sample exactly as on the
+        plain path).  Enqueue-only — the host fetch lives in
+        wait_ragged, so the async loop overlaps this dispatch like any
+        other."""
+        failpoints.fire("runner.dispatch_verify")
+        drafted, q_probs = self.spec.propose(prep)
+        t = prep.tensors
+        lora = self.lora_stacks if prep.lora_idx is not None else None
+        self.caches, final_logits, packed_spec = self._ragged_verify_fn(
+            self.params,
+            self.caches,
+            self._put(prep.token_ids),
+            self._put(prep.positions),
+            self._put(prep.slot_mapping),
+            self._put(prep.seq_starts),
+            self._put(prep.pos_base),
+            self._put(np.asarray(prep.total_tokens, np.int32)),
+            self._put(prep.block_tables),
+            self._put(prep.verify_indices),
+            drafted,
+            q_probs,
+            self._put(prep.draft_scatter),
+            self._put(prep.spec_mask),
+            self._put(prep.spec_tokens0),
+            self._put(np.asarray(t.temperature, np.float32)),
+            self._put(np.asarray(t.top_k, np.int32)),
+            self._put(np.asarray(t.top_p, np.float32)),
+            self._put(np.asarray(t.base_key, np.uint32)),
+            self._put(np.asarray(t.gen_len, np.int32)),
+            lora,
+            self._put(prep.lora_idx)
+            if prep.lora_idx is not None
+            else None,
+            work=self._put(prep.work) if prep.work is not None else None,
+            want_topn=prep.want_topn,
+        )
+        packed_std = self._sample_rows(
+            final_logits,
+            prep.row_slots,
+            prep.seed_slots,
+            prep.seed_tokens,
+            prep.tensors,
+            prep.allowed_mask,
+            want_topn=prep.want_topn,
+        )
+        prep.spec_ran = True
+        return {"std": packed_std, "spec": packed_spec}
 
     def dispatch_ragged(self, prep: "PreparedRagged"):
         """Enqueue ONE forward over the mixed ragged stream plus the
         batched sampler over every emitting row; no blocking transfers
         (see dispatch_prefill)."""
         failpoints.fire("runner.dispatch_ragged")
+        if prep.has_spec:
+            return self._dispatch_ragged_verify(prep)
         lora_args = ()
         if self.lora_stacks is not None:
             lora_args = (self.lora_stacks, self._put(prep.lora_idx))
@@ -1320,19 +1402,57 @@ class ModelRunner:
 
     def wait_ragged(
         self, prep: "PreparedRagged", handle
-    ) -> list[Optional[SampledToken]]:
+    ) -> list[Optional[list[SampledToken]]]:
         """Blocking half: one entry per plan item, in stream order —
-        a SampledToken for emitting items (decode rows, final chunks),
-        None for mid-prompt chunks (one device fetch for the batch)."""
+        a LIST of SampledTokens for emitting items (one for plain
+        decode rows / final chunks, up to ``spec_width`` for verify
+        spans), None for mid-prompt chunks.  One device fetch per
+        packed buffer."""
+        if isinstance(handle, dict):
+            return self._wait_ragged_verify(prep, handle)
         host = _HostSamplerOutput.from_packed(handle[None])
         return [
-            host.token(0, i) if prep.samples[i] else None
+            [host.token(0, i)] if prep.samples[i] else None
             for i in range(prep.num_items)
         ]
 
+    def _wait_ragged_verify(
+        self, prep: "PreparedRagged", handle: dict
+    ) -> list[Optional[list[SampledToken]]]:
+        host = _HostSamplerOutput.from_packed(handle["std"][None])
+        # tpulint: disable=TPL202(sanctioned sync: the packed verify-window fetch — a spec dispatch pays exactly TWO packed fetches, std rows above + this, in the blocking wait_* half only)
+        packed = np.asarray(handle["spec"])  # [S, γ+1, 4+2W]
+        spec_host = _HostSamplerOutput.from_packed(packed[..., :-1])
+        accepted = packed[:, 0, -1]  # [S] broadcast column
+        out: list[Optional[list[SampledToken]]] = []
+        proposed_n = accepted_n = 0
+        for i in range(prep.num_items):
+            if not prep.samples[i]:
+                out.append(None)
+                continue
+            if not prep.spec_mask[i]:
+                out.append([host.token(0, i)])
+                continue
+            w = prep.steps_per_item[i]
+            emit = min(int(accepted[i]) + 1, w)
+            out.append([
+                SampledToken(
+                    token_id=int(spec_host.tokens[i, j]),
+                    logprob=float(spec_host.logprobs[i, j]),
+                    rank=int(spec_host.ranks[i, j]),
+                    topn_ids=spec_host.topn_ids[i, j].tolist(),
+                    topn_logprobs=spec_host.topn_logprobs[i, j].tolist(),
+                )
+                for j in range(emit)
+            ])
+            proposed_n += w - 1
+            accepted_n += min(int(accepted[i]), w - 1)
+        self.spec.note_batch(proposed_n, accepted_n)
+        return out
+
     def execute_ragged(
         self, prep: "PreparedRagged"
-    ) -> list[Optional[SampledToken]]:
+    ) -> list[Optional[list[SampledToken]]]:
         return self.wait_ragged(prep, self.dispatch_ragged(prep))
 
     # ---------------------------------------------------------------- decode
@@ -1392,45 +1512,10 @@ class ModelRunner:
             for i, seq in enumerate(seqs):
                 lora_idx[i] = seq.lora_slot
 
-        spec_ok = False
-        draft_catchups: list = []
-        if self.spec is not None:
-            spec_ok = all(seq.spec_eligible for seq in seqs)
-            if spec_ok:
-                # rows that decoded in mixed batches have a stale draft
-                # cache; snapshot the chunk inputs that re-run their
-                # missing tokens through the draft (all but the last
-                # token, which is the propose input)
-                for i, seq in enumerate(seqs):
-                    end = seq.num_tokens - 1
-                    if seq.draft_pos >= end:
-                        continue
-                    gap = seq.all_token_ids[seq.draft_pos:end]
-                    bucket = self._seen_pad_len(len(gap))
-                    ids = np.zeros(bucket, np.int32)
-                    ids[: len(gap)] = gap
-                    pos = seq.draft_pos + np.arange(bucket, dtype=np.int32)
-                    slots = np.full(bucket, -1, np.int32)
-                    slots[: len(gap)] = seq.blocks.slots_for_range(
-                        seq.draft_pos, end
-                    )
-                    draft_catchups.append(
-                        dict(
-                            t=len(gap),
-                            token_ids=ids,
-                            positions=pos,
-                            slot_mapping=slots,
-                            block_table=block_tables[i],
-                            start_pos=seq.draft_pos,
-                        )
-                    )
-
         return PreparedDecode(
-            spec_ok=spec_ok,
             want_topn=any(
                 seq.params.logprobs not in (None, 0) for seq in seqs
             ),
-            draft_catchups=draft_catchups,
             num_seqs=len(seqs),
             num_steps=plan.num_steps,
             steps_per_seq=list(plan.steps_per_seq),
@@ -1536,7 +1621,7 @@ class ModelRunner:
                 prep.want_topn,
             )
 
-        self.caches, self.seen, packed_out = self._decode_kernel_retry(call)
+        self.caches, self.seen, packed_out = call()
         return packed_out
 
     def _pack_decode_inputs(self, prep: "PreparedDecode"):
@@ -1558,51 +1643,9 @@ class ModelRunner:
         ]).astype(np.float32)
         return ints, floats
 
-    def _decode_kernel_retry(self, dispatch):  # noqa: ANN001
-        """Serving-path decode-kernel degradation (ADVICE r5): a Mosaic
-        rejection of the opted-in folded kernel steps down
-        folded → perhead → xla (ops/attention.degrade_decode_kernel) and
-        retries the dispatch instead of killing the engine at boot
-        precompile or on the first live decode.  The variant is read at
-        trace time inside the jitted model, and a failed compile leaves
-        no cache entry, so the retry re-traces and picks up the
-        degraded variant."""
-        from vllm_tgis_adapter_tpu.ops import attention as attn_ops
-
-        # getattr: the degradation unit test drives this helper unbound
-        if getattr(self, "_ragged_backend", False):
-            # the ragged path has ONE kernel — no variant chain to step
-            # down; a lowering failure is a real error, not a retry
-            return dispatch()
-        while True:
-            tried = attn_ops.decode_kernel_variant()
-            try:
-                return dispatch()
-            except Exception as e:  # noqa: BLE001 — inspected, re-raised
-                if not attn_ops.is_kernel_lowering_error(e):
-                    raise
-                # compare-and-swap on the variant THIS attempt traced
-                # with: a concurrent replica's identical failure burns
-                # one level between them, not two
-                nxt = attn_ops.degrade_decode_kernel(tried)
-                if nxt is None:
-                    raise
-                logger.warning(
-                    "decode kernel %r failed to lower (%s: %s); "
-                    "degrading to %r and retrying the dispatch",
-                    tried, type(e).__name__, e, nxt,
-                )
-
     def dispatch_decode(self, prep: "PreparedDecode"):
-        """Enqueue the fused K-step decode; no blocking transfers.
-
-        The speculative path runs multiple host-synchronised phases
-        (propose → verify → accept) and cannot enqueue-only: it returns
-        ``SYNC_DISPATCH`` and executes inside ``wait_decode`` instead.
-        """
+        """Enqueue the fused K-step decode; no blocking transfers."""
         failpoints.fire("runner.dispatch_decode")
-        if prep.spec_ok:
-            return SYNC_DISPATCH
         lora = self.lora_stacks if prep.lora_idx is not None else None
         ints, floats = self._pack_decode_inputs(prep)
 
@@ -1625,7 +1668,7 @@ class ModelRunner:
                 prep.want_topn,
             )
 
-        self.caches, self.seen, packed_out = self._decode_kernel_retry(call)
+        self.caches, self.seen, packed_out = call()
         return packed_out
 
     def wait_decode(
@@ -1634,8 +1677,6 @@ class ModelRunner:
         """Blocking half: per-seq token lists (row i gets UP TO
         ``steps_per_seq[i]`` entries; the engine stops consuming a row's
         list at EOS/stop-string)."""
-        if handle is SYNC_DISPATCH:
-            return self.spec.run(prep)
         # [K, B, 3+2W] — one fetch per wave
         host = _HostSamplerOutput.from_packed(handle)
         return [

@@ -4,9 +4,13 @@ TPU-native counterpart of the scheduling capability the reference adapter
 consumes through ``engine.generate`` / ``engine.abort`` (SURVEY.md §2.3).
 Design for XLA's compile-once model (SURVEY.md §7 "hard parts"):
 
-* decode runs every step over ONE padded batch whose width is drawn from a
-  small set of power-of-two buckets — bounded compile count;
-* prefill admits one sequence per step, padded to a prompt-length bucket;
+* the serving planner is RAGGED (``_schedule_ragged``): every device step
+  is one flat mixed token stream — a decode span (or speculative verify
+  span) per running row plus prefill chunks sliced to exactly fill one
+  power-of-two flat-length bucket; pure-decode steps fuse K steps at ONE
+  batch width (max_num_seqs);
+* the legacy solo-prefill/fused-decode alternation survives only for
+  pp>1 / sp>1 engines and prompt-logprob heads (docs/ATTENTION.md);
 * each running sequence owns a fixed batch row (``slot``) so device-side
   per-row state (seen-token matrix, PRNG seeds) never shuffles;
 * when the KV page pool runs dry the youngest running sequence is
@@ -45,29 +49,6 @@ class PrefillPlan:
     is_final: bool = True
 
 
-# Max prompts per packed prefill dispatch.  Bounds the scalar-prefetched
-# segment-start vector (a static kernel shape) and the fixed sampler row
-# count, so packing adds no compile-shape variance beyond the buckets.
-MAX_PACK = 8
-
-
-@dataclasses.dataclass
-class PackedPrefillPlan:
-    """Several whole prompts concatenated into ONE prefill dispatch.
-
-    The reference's engine batches waiting prompts into a single forward
-    (vLLM continuous batching, consumed at
-    /root/reference/src/vllm_tgis_adapter/grpc/grpc_server.py:205-225);
-    the TPU-native equivalent packs them along the token axis of one
-    compile bucket with a block-diagonal causal mask (segment starts ride
-    scalar prefetch — ops/pallas_attention.py), so k short prompts cost
-    one dispatch + one bucket fill instead of k.
-    """
-
-    items: list[PrefillPlan]  # ≥2, each whole-prompt (start_pos=0, final)
-    bucket_len: int  # compile bucket for the concatenated token axis
-
-
 @dataclasses.dataclass
 class RaggedItem:
     """One sequence's contiguous span of a ragged mixed batch."""
@@ -77,7 +58,13 @@ class RaggedItem:
     slots: list[int]  # flat KV slot per token
     start_pos: int  # global position of the span's first token
     is_final: bool  # samples a token this step (decode items always do)
-    is_decode: bool  # single-token decode span for a running row
+    is_decode: bool  # decode span for a running row (incl. verify spans)
+    # speculative verify span (docs/ATTENTION.md "Speculative decoding"):
+    # > 0 means this running row's span reserves ``spec_width`` stream
+    # rows — its last sampled token plus spec_width-1 draft-token
+    # placeholders the runner scatters in AFTER the draft proposes.
+    # Acceptance emits up to ``spec_width`` tokens for the row.
+    spec_width: int = 0
 
 
 @dataclasses.dataclass
@@ -130,13 +117,6 @@ class Scheduler:
         # engine core drains this each step to emit their final outputs
         self.newly_finished: list[Sequence] = []
         self._free_slots = list(range(scheduler_config.max_num_seqs - 1, -1, -1))
-        # batch-width compile buckets: 1, 2, 4, ... max_num_seqs
-        self.batch_buckets: list[int] = []
-        b = 1
-        while b < scheduler_config.max_num_seqs:
-            self.batch_buckets.append(b)
-            b *= 2
-        self.batch_buckets.append(scheduler_config.max_num_seqs)
         # prefill token budget per device step: prompts longer than this
         # are admitted in chunks, with decode steps interleaved between
         # chunks so long prompts cannot starve running sequences
@@ -145,26 +125,22 @@ class Scheduler:
             max(scheduler_config.prefill_buckets),
         )
         self._last_was_prefill = False
-        # ragged data path (--attention-backend=ragged, engine/core.py):
+        # ragged data path (the serving default, engine/core.py):
         # schedule() plans token-budgeted RaggedPlans instead of the
-        # bucketed prefill/decode alternation.  The flat-length buckets
-        # are a power-of-two ladder — the ONLY compile lattice the
-        # mixed path has — sized so the widest bucket holds a full
-        # decode batch plus the chunk budget.
+        # legacy solo-prefill/fused-decode alternation (which survives
+        # only for pp>1 / sp>1 engines and prompt-logprob heads).  The
+        # flat-length buckets are a power-of-two ladder — the ONLY
+        # compile lattice the mixed path has — sized so the widest
+        # bucket holds a full decode batch plus the chunk budget.
         self.ragged = False
-        ceiling = 1
-        while ceiling < self.chunk_budget + scheduler_config.max_num_seqs:
-            ceiling *= 2
-        self.ragged_buckets: list[int] = []
-        b = 16
-        while b < ceiling:
-            self.ragged_buckets.append(b)
-            b *= 2
-        self.ragged_buckets.append(ceiling)
-        # packed (multi-prompt) prefill: flipped on by the engine when the
-        # model/parallel mode supports the block-diagonal mask (plain
-        # causal attention, no pp/sp, no speculative draft mirroring)
-        self.allow_packed = False
+        # speculative verify spans (docs/ATTENTION.md): > 0 means every
+        # spec-eligible running row plans a (spec_gamma+1)-token verify
+        # span instead of a one-token decode span.  Set via
+        # set_spec_gamma by the engine when a draft model is attached —
+        # it widens the flat-bucket ceiling so a full spec decode batch
+        # still fits one dispatch.
+        self.spec_gamma = 0
+        self._rebuild_ragged_buckets()
         # rolling-window KV eviction (sliding-window models): pages that
         # fall entirely below every layer's attention band free as decode
         # advances, bounding a generation's KV footprint by
@@ -212,6 +188,28 @@ class Scheduler:
         # and a 'decode' scheduler's waiting set is mostly parked
         # promotions whose prompt spans restore rather than recompute.
         self.role = "mixed"
+
+    def _rebuild_ragged_buckets(self) -> None:
+        """Flat-length compile ladder: pow2 from 16 up to a ceiling that
+        holds a full decode batch (every running row's span — one token,
+        or spec_gamma+1 for a verify span) plus the chunk budget."""
+        span = 1 + self.spec_gamma
+        ceiling = 1
+        while ceiling < self.chunk_budget + self.config.max_num_seqs * span:
+            ceiling *= 2
+        self.ragged_buckets = []
+        b = 16
+        while b < ceiling:
+            self.ragged_buckets.append(b)
+            b *= 2
+        self.ragged_buckets.append(ceiling)
+
+    def set_spec_gamma(self, gamma: int) -> None:
+        """Enable speculative verify-span planning (engine core, at
+        draft attach / supervised re-attach).  Recomputes the flat
+        bucket ladder so ``max_num_seqs`` verify spans fit one plan."""
+        self.spec_gamma = max(0, gamma)
+        self._rebuild_ragged_buckets()
 
     # ------------------------------------------------------------ bookkeeping
 
@@ -370,13 +368,18 @@ class Scheduler:
 
     def schedule(
         self, prefill_only: bool = False
-    ) -> Optional[PrefillPlan | PackedPrefillPlan | DecodePlan]:
+    ) -> Optional[PrefillPlan | RaggedPlan | DecodePlan]:
         """Pick the next device step.
 
-        Prefill normally has priority (a waiting prompt becomes a running
-        row as fast as possible), but right after a prefill chunk a decode
-        step runs first if any rows are runnable — chunked admission of a
-        long prompt interleaves with decode instead of starving it.
+        The ragged planner (``self.ragged``, the serving default) plans
+        token-budgeted mixed dispatches; the legacy solo-prefill /
+        fused-decode alternation below survives only for pp>1 / sp>1
+        engines (no ragged plumbing through the staged runner / sp ring
+        yet) and for prompt-logprob heads, which need full-bucket logits
+        rows.  Prefill normally has priority, but right after a prefill
+        chunk a decode step runs first if any rows are runnable —
+        chunked admission of a long prompt interleaves with decode
+        instead of starving it.
 
         ``prefill_only`` (async overlap, engine/async_llm.py): another
         dispatch is still in flight, so only plans independent of its
@@ -399,10 +402,6 @@ class Scheduler:
         plan = self._try_schedule_prefill()
         if plan is not None:
             self._last_was_prefill = True
-            if self._packable(plan):
-                packed = self._extend_pack(plan)
-                if packed is not None:
-                    return packed
             return plan
         self._last_was_prefill = False
         if prefill_only:
@@ -450,98 +449,6 @@ class Scheduler:
             metrics.frontdoor_sheds_total.labels(reason="ttl").inc()
             if self.shed_hook is not None:
                 self.shed_hook()
-
-    def _packable(self, plan: PrefillPlan) -> bool:
-        return (
-            self.allow_packed
-            and plan.start_pos == 0
-            and plan.is_final
-            and plan.seq.params.prompt_logprobs is None
-        )
-
-    def _extend_pack(self, head: PrefillPlan) -> Optional[PackedPrefillPlan]:
-        """Greedily append more waiting whole prompts to ``head``'s
-        dispatch while the concatenated tokens still fit a compile bucket
-        and the token budget, slots and pages allow.  Later waiting
-        requests may jump an unpackable one (standard continuous-batching
-        reordering); each appended sequence is admitted exactly like a
-        solo prefill (slot + pages), so abort/preempt handling downstream
-        is unchanged.
-
-        Deliberately NOT shared with _try_schedule_prefill's admission:
-        the queue HEAD must handle failure modes (chunking, rollback,
-        pool-empty rejection, prefix adoption with hit accounting) —
-        a pack CANDIDATE simply skips on any of those and stays queued
-        for the solo path to deal with when it reaches the head.  The
-        two follow different policies, not a drifted copy of one."""
-        items = [head]
-        total = len(head.token_ids)
-        for seq in list(self.waiting):
-            if len(items) >= MAX_PACK:
-                break
-            if (
-                seq.prefill_pos != 0
-                or seq.blocks is not None  # mid-chunk: holds pages already
-                or seq.params.prompt_logprobs is not None
-                or not self._free_slots
-            ):
-                continue
-            # residency gate BEFORE the slot comparison: the gate is
-            # what resolves seq.lora_slot in pool mode
-            if (
-                not self._lora_ready(seq)
-                or seq.lora_slot != head.seq.lora_slot
-            ):
-                continue
-            if not self._tier_ready(seq):
-                # host-tier coverage beats packing: the candidate parks
-                # for promotion instead of recomputing its prefix here
-                continue
-            token_ids = seq.all_token_ids
-            new_total = total + len(token_ids)
-            if (
-                new_total > self.chunk_budget
-                or self._prefill_bucket(new_total) is None
-            ):
-                continue
-            if self.allocator.enable_prefix_caching:
-                hit_blocks, matched = self.allocator.match_prefix(
-                    token_ids, seq.lora_name
-                )
-                if matched:
-                    # cache hit: the solo path admits it with the pages
-                    # adopted (start_pos > 0) — packing would re-prefill
-                    # the matched span.  The probe refcounted the hit
-                    # pages (match_prefix contract); undo it or they pin
-                    # forever
-                    self.allocator.free(hit_blocks)
-                    continue
-            needed = self.allocator.blocks_needed(len(token_ids))
-            if not self.allocator.can_allocate(needed):
-                continue
-            seq.blocks = SequenceBlocks(self.allocator)
-            seq.blocks.ensure_capacity(len(token_ids))
-            seq.slot = self._free_slots.pop()
-            self.waiting.remove(seq)
-            seq.status = SequenceStatus.RUNNING
-            self.running.append(seq)
-            items.append(
-                PrefillPlan(
-                    seq=seq,
-                    bucket_len=0,  # the pack bucket is shared (below)
-                    token_ids=list(token_ids),
-                    slots=seq.blocks.slots_for_range(0, len(token_ids)),
-                    start_pos=0,
-                    is_final=True,
-                )
-            )
-            seq.prefill_pos = len(token_ids)
-            total = new_total
-        if len(items) < 2:
-            return None
-        return PackedPrefillPlan(
-            items=items, bucket_len=self._prefill_bucket(total)
-        )
 
     def _adoptable(self, seq: Sequence) -> bool:
         # prompt-logprob requests never adopt cached prefix pages: the
@@ -724,30 +631,19 @@ class Scheduler:
         steps_per_seq = [planned[id(s)] for s in seqs]
         return DecodePlan(
             seqs=seqs,
-            # ragged backend: ONE decode width (max_num_seqs) — the
-            # whole point of the path is a collapsed compile lattice,
-            # so the per-width bucket ladder goes too; dead rows are
+            # ONE decode width (max_num_seqs) — the per-width bucket
+            # ladder retired with the bucketed backend; dead rows are
             # masked on device (slot -1), exactly like bucket padding
             # was, and the occupancy gauge keeps reporting real/width
-            batch_bucket=(
-                self.config.max_num_seqs
-                if self.ragged
-                else self._batch_bucket(len(seqs))
-            ),
+            batch_bucket=self.config.max_num_seqs,
             # fuse only as many steps as some row can consume: an
             # all-FSM-constrained batch (every row at 1 step) would
             # otherwise pay num_decode_steps of dead decode+sample work.
             # num_steps is a static jit arg bounded by num_decode_steps,
-            # so this adds at most a handful of compiles per batch bucket.
+            # so this adds at most a handful of compiles.
             num_steps=max(steps_per_seq),
             steps_per_seq=steps_per_seq,
         )
-
-    def _batch_bucket(self, n: int) -> int:
-        for b in self.batch_buckets:
-            if n <= b:
-                return b
-        return self.batch_buckets[-1]
 
     # ------------------------------------------------------- ragged planning
 
@@ -757,19 +653,40 @@ class Scheduler:
                 return b
         return self.ragged_buckets[-1]
 
+    def _spec_extra(self, seq: Sequence) -> int:
+        """Draft-token rows a verify span may append for ``seq`` this
+        dispatch (0 = plain one-token decode span): bounded by the
+        configured γ, the row's max_tokens remainder (the span emits up
+        to extra+1 tokens) and the model-length headroom (positions at
+        or past max_model_len have no page to write)."""
+        if self.spec_gamma <= 0 or not seq.spec_eligible:
+            return 0
+        extra = self.spec_gamma
+        if seq.params.max_tokens is not None:
+            extra = min(
+                extra, seq.params.max_tokens - seq.num_output_tokens - 1
+            )
+        extra = min(extra, self.max_model_len - seq.num_tokens)
+        return max(0, extra)
+
     def _schedule_ragged(
         self, prefill_only: bool = False
     ) -> Optional[RaggedPlan | PrefillPlan | DecodePlan]:
-        """Plan one unified ragged step (--attention-backend=ragged).
+        """Plan one unified ragged step (the serving default).
 
-        Every running row contributes its next decode token; the rest of
-        the flat token bucket fills with prefill work — continuing
-        chunks first, then new admissions, the LAST one sliced so the
-        bucket is exactly full whenever backlog exists (fill ratio 1, no
-        per-prompt bucket padding).  Pure-decode steps (no admissible
-        prefill) fall through to ``_schedule_decode`` — the fused
-        K-step wave runs the same ragged kernel via the runner's ragged
-        decode program, so chaining keeps working.
+        Every running row contributes a decode span — ONE token, or,
+        when a draft model is attached and the row is spec-eligible, a
+        (γ+1)-token speculative VERIFY span ``[last_token, γ draft
+        placeholders]`` (docs/ATTENTION.md "Speculative decoding"); the
+        rest of the flat token bucket fills with prefill work —
+        continuing chunks first, then new admissions, the LAST one
+        sliced so the bucket is exactly full whenever backlog exists
+        (fill ratio 1, no per-prompt bucket padding).  Pure-decode steps
+        (no admissible prefill) fall through to ``_schedule_decode`` —
+        the fused K-step wave runs the same ragged kernel via the
+        runner's ragged decode program, so chaining keeps working —
+        UNLESS a verify span is planned: speculation emits up to γ+1
+        tokens per row per dispatch, so the verify plan rides instead.
 
         Prompt-logprob requests need full-bucket logits rows, which the
         ragged step's per-sequence sample gather does not produce; a
@@ -802,10 +719,12 @@ class Scheduler:
         if prefill_only and self.running:
             return None
 
-        # mandatory decode spans: one token per running row, youngest
-        # preempted when the pool runs dry (same policy as
-        # _schedule_decode at k=1)
+        # mandatory decode spans: one token per running row (γ+1 for a
+        # spec-eligible verify span), youngest preempted when the pool
+        # runs dry (same policy as _schedule_decode; a tight pool
+        # shrinks the verify span before resorting to preemption)
         decode_seqs: list[Sequence] = []
+        spec_extra: dict[int, int] = {}
         if self.running:
             self._roll_window(self.running)
             for seq in sorted(
@@ -813,11 +732,15 @@ class Scheduler:
             ):
                 if seq not in self.running:
                     continue  # preempted earlier in this pass
+                extra = self._spec_extra(seq)
                 while True:
                     try:
-                        seq.blocks.ensure_capacity(seq.num_tokens)
+                        seq.blocks.ensure_capacity(seq.num_tokens + extra)
                         break
                     except RuntimeError:
+                        if extra > 0:
+                            extra //= 2
+                            continue
                         if not self._preempt_youngest(exclude=seq):
                             from vllm_tgis_adapter_tpu.frontdoor.errors import (
                                 KVPoolExhaustedError,
@@ -826,8 +749,12 @@ class Scheduler:
                             raise KVPoolExhaustedError(
                                 "KV cache too small for a single sequence"
                             ) from None
+                spec_extra[id(seq)] = extra
             decode_seqs = sorted(self.running, key=lambda s: s.slot)
-        base = len(decode_seqs)
+        base = sum(1 + spec_extra.get(id(s), 0) for s in decode_seqs)
+        has_spec = any(
+            spec_extra.get(id(s), 0) > 0 for s in decode_seqs
+        )
 
         # phase 1 (no state mutation): how many prefill tokens COULD
         # ride this dispatch — continuing chunks and new prompts, in
@@ -878,8 +805,13 @@ class Scheduler:
         if not cands:
             if prefill_only or not decode_seqs:
                 return None
-            # pure decode: the fused K-step wave (ragged kernel inside)
-            return self._schedule_decode()
+            if not has_spec:
+                # pure decode, nothing to verify: the fused K-step wave
+                # (ragged kernel inside)
+                return self._schedule_decode()
+            # pure decode with verify spans: the spec plan rides alone —
+            # γ+1 potential tokens per row per dispatch beat the fused
+            # wave's one-per-step on the latency the dispatch saves
 
         desired = base + sum(take for _, take in cands)
         # floor bucket + slice-to-fit: whenever backlog covers a bucket
@@ -892,20 +824,27 @@ class Scheduler:
         bucket = max(bucket, self._ragged_bucket(base + 1))
         space = bucket - base
 
-        # phase 2: allocate + emit, truncating to the bucket
-        items: list[RaggedItem] = [
-            RaggedItem(
-                seq=seq,
-                token_ids=[seq.all_token_ids[-1]],
-                slots=seq.blocks.slots_for_range(
-                    seq.num_tokens - 1, seq.num_tokens
-                ),
-                start_pos=seq.num_tokens - 1,
-                is_final=True,
-                is_decode=True,
+        # phase 2: allocate + emit, truncating to the bucket.  Verify
+        # spans carry placeholder 0s after the last sampled token — the
+        # runner scatters the draft's proposals into those stream rows
+        # on device (prepare_ragged / _ragged_verify_fn)
+        items: list[RaggedItem] = []
+        for seq in decode_seqs:
+            extra = spec_extra.get(id(seq), 0)
+            pos0 = seq.num_tokens - 1
+            items.append(
+                RaggedItem(
+                    seq=seq,
+                    token_ids=[seq.all_token_ids[-1]] + [0] * extra,
+                    slots=seq.blocks.slots_for_range(
+                        pos0, pos0 + 1 + extra
+                    ),
+                    start_pos=pos0,
+                    is_final=True,
+                    is_decode=True,
+                    spec_width=(1 + extra) if extra > 0 else 0,
+                )
             )
-            for seq in decode_seqs
-        ]
         total = base
         for seq, take in cands:
             if space <= 0:
@@ -990,7 +929,7 @@ class Scheduler:
             # legacy head-only chunk invariant)
         if total == base and not decode_seqs:
             return None
-        if total == base:
+        if total == base and not has_spec:
             # every candidate was blocked: fall back to the fused wave
             return self._schedule_decode()
         return RaggedPlan(
@@ -1083,11 +1022,7 @@ class Scheduler:
             seq.blocks.ensure_capacity(seq.num_tokens + prev_k - 1 + k)
         return DecodePlan(
             seqs=list(prev.seqs),
-            batch_bucket=(
-                self.config.max_num_seqs
-                if self.ragged
-                else self._batch_bucket(len(prev.seqs))
-            ),
+            batch_bucket=self.config.max_num_seqs,
             num_steps=max(planned),
             steps_per_seq=planned,
         )

@@ -1,17 +1,23 @@
-"""Draft-model speculative decoding (propose γ → verify in one pass).
+"""Draft-model speculative decoding (propose γ → verify as a ragged span).
 
 The reference exposes ``--speculative-model`` / ``--num-speculative-tokens``
 and delegates the mechanism to its engine
 (/root/reference/src/vllm_tgis_adapter/tgis_utils/args.py:164-168,221-231);
-this is the TPU-native mechanism itself:
+this is the TPU-native mechanism itself, composed with the ragged paged
+attention data path (docs/ATTENTION.md "Speculative decoding"):
 
-* **propose**: a ``lax.scan`` over γ greedy draft-model decode steps —
-  one device dispatch proposes γ tokens per batch row and writes the
-  draft's own paged KV as it goes;
-* **verify**: ONE target-model forward over each row's
-  ``[last_token, d₁ … d_γ]`` window (the batched multi-token analog of
-  the chunked-prefill attention path), greedy acceptance on device, and
-  the per-token logprob/rank/top-N stats the engine reports;
+* **propose**: a ``lax.scan`` over γ draft-model decode steps — one device
+  dispatch proposes γ tokens per batch row (writing the draft's own paged
+  K/V as it goes) and returns the draft's per-position sampling
+  distribution q, which rejection-sampling verification needs;
+* **verify**: a spec-eligible running row contributes a (γ+1)-token SPAN
+  ``[last_token, d₁ … d_γ]`` to the SAME flat ragged stream that carries
+  fresh prefill chunks and plain decode rows — the per-sequence span
+  descriptors from the Ragged Paged Attention formulation handle a short
+  multi-token span natively, and the kernel's causal masking within the
+  span yields exactly the verify logits.  One dispatch
+  (``runner._ragged_verify_fn``) serves the whole mixed batch; acceptance
+  runs on device via ``_rejection_core`` below;
 * rejected positions leave stale K/V in both caches, which is safe: the
   next dispatch re-inputs the corrected token at that position and
   overwrites the slot before anything reads it (device work is strictly
@@ -20,14 +26,15 @@ this is the TPU-native mechanism itself:
 Greedy equivalence: the accepted prefix plus the bonus token reproduces
 exactly the non-speculative greedy chain — each accepted dᵢ equals the
 target argmax given the identical prefix.  Sampled rows (temperature>0,
-top-k/top-p, seeded or not) verify by REJECTION SAMPLING — accept dᵢ
-with prob min(1, p(dᵢ)/q(dᵢ)), resample the residual norm(max(p−q,0))
-on reject — which emits tokens distributed exactly as the target's
-sampling distribution (Leviathan et al. 2023).  LoRA rows verify
-through the adapted target while the draft proposes from base weights.
-Rows with state-evolving knobs (repetition penalty, typical-p,
-length-penalty/min-tokens, FSM) fall back to the standard fused decode
-in the same dispatch slot.
+top-k/top-p, unseeded) verify by REJECTION SAMPLING — accept dᵢ with
+prob min(1, p(dᵢ)/q(dᵢ)), resample the residual norm(max(p−q,0)) on
+reject — which emits tokens distributed exactly as the target's sampling
+distribution (Leviathan et al. 2023).  LoRA rows verify through the
+adapted target (per-row ``lora_idx`` rides the stream) while the draft
+proposes from base weights.  Rows with state-evolving knobs (repetition
+penalty, typical-p, length-penalty/min-tokens, FSM) and SEEDED sampled
+rows ride the plain one-token decode span in the same dispatch —
+speculation is per-ROW on the ragged path, not per-batch.
 
 Draft/target contract: same tokenizer and vocab size (validated at
 boot); the draft shares the target's block tables and slot geometry, so
@@ -38,7 +45,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-from typing import TYPE_CHECKING, Optional
+from typing import TYPE_CHECKING
 
 import jax
 import jax.numpy as jnp
@@ -47,11 +54,7 @@ import numpy as np
 from vllm_tgis_adapter_tpu.logging import init_logger
 
 if TYPE_CHECKING:
-    from vllm_tgis_adapter_tpu.engine.runner import (
-        ModelRunner,
-        PreparedDecode,
-        SampledToken,
-    )
+    from vllm_tgis_adapter_tpu.engine.runner import ModelRunner
 
 logger = init_logger(__name__)
 
@@ -109,7 +112,7 @@ def _rejection_core(
     rejection sample from the residual norm(max(p−q, 0)); on full
     acceptance sample the bonus token from p directly.  Greedy rows have
     one-hot p/q, so acceptance degenerates to the argmax match test and
-    emission to the target argmax — bit-identical to the greedy verify.
+    emission to the target argmax — bit-identical to a greedy verify.
     Returns (emitted [B, K], accepted [B] in 0..gamma).  Factored out of
     the verify program so the distribution-preservation property is
     testable without a model (tests/test_speculative.py).
@@ -175,13 +178,13 @@ def _rejection_core(
     return emitted, accepted
 
 
-@jax.jit
 def _pack_spec_results(emitted, accepted, lp, rank, topn_ids, topn_lp):
     """Merge the verify outputs into ONE int32 buffer so the whole spec
-    dispatch result comes back in a single device fetch: the standard
-    sampler.pack_output layout ([B, K, 3+2W]) plus a trailing
-    broadcast `accepted` column -> [B, K, 4+2W].  Unpacked by
-    _HostSamplerOutput.from_packed on [..., :-1]."""
+    result comes back in a single device fetch: the standard
+    sampler.pack_output layout ([B, K, 3+2W]) plus a trailing broadcast
+    `accepted` column -> [B, K, 4+2W].  Unpacked by
+    _HostSamplerOutput.from_packed on [..., :-1].  Called from INSIDE
+    the jitted ragged_verify program (runner._build_ragged_verify_fn)."""
     from vllm_tgis_adapter_tpu.engine import sampler as sampler_mod
 
     packed = sampler_mod.pack_output(sampler_mod.SamplerOutput(
@@ -194,27 +197,16 @@ def _pack_spec_results(emitted, accepted, lp, rank, topn_ids, topn_lp):
     return jnp.concatenate([packed, acc], axis=-1)
 
 
-def plain_greedy(params) -> bool:  # noqa: ANN001
-    """Greedy rows speculation reproduces EXACTLY (match-test verify)."""
-    return (
-        params.temperature == 0.0
-        and params.repetition_penalty == 1.0
-        and params.typical_p == 1.0
-        and params.length_penalty is None
-        and params.min_tokens == 0
-        and params.structured_outputs is None
-    )
-
-
 def spec_eligible(params) -> bool:  # noqa: ANN001
-    """Row eligibility for speculative dispatches.
+    """Row eligibility for speculative verify spans.
 
     Greedy rows verify by argmax match; unseeded sampled rows (any
     temperature, top-k/top-p) verify by rejection sampling — accept
     draft token d with prob min(1, p(d)/q(d)), resample the residual on
     reject — which preserves the target distribution exactly (Leviathan
     et al.; the mechanism the reference consumes from vLLM's spec
-    decode).  Excluded:
+    decode).  Excluded (these rows ride a plain one-token decode span in
+    the SAME ragged dispatch — eligibility is per row, not per batch):
 
     * knobs whose state evolves WITHIN a speculation window (repetition
       penalty's seen matrix, typical-p's entropy set, length-penalty/
@@ -222,9 +214,8 @@ def spec_eligible(params) -> bool:  # noqa: ANN001
     * SEEDED sampled requests: the sampler guarantees a seeded request
       replays the same draw stream no matter how it is batched
       (engine/sampler.py), and the spec path's salted draft/accept/emit
-      streams differ from the fused sampler's — since path choice
-      depends on batch-mates (spec_ok = all rows eligible), a seeded
-      row must always take the one deterministic path.
+      streams differ from the fused sampler's — a seeded row must always
+      take the one deterministic path.
     """
     return (
         params.repetition_penalty == 1.0
@@ -248,7 +239,13 @@ class SpecStats:
 
 
 class SpeculativeDecoder:
-    """Owns the draft model's device state + the propose/verify programs."""
+    """Owns the draft model's device state + the propose program.
+
+    Verification itself lives in the runner's jitted ``ragged_verify``
+    entry point (the verify span IS part of the ragged dispatch); this
+    class contributes the draft side: cache mirroring/catch-up, the
+    γ-step propose scan, and acceptance accounting.
+    """
 
     def __init__(
         self,
@@ -309,14 +306,13 @@ class SpeculativeDecoder:
             donate_argnums=donate,
         )
         self._propose_fn = self._build_propose_fn()
-        self._verify_fn = self._build_verify_fn()
-        self._propose_sampled_fn = self._build_propose_sampled_fn()
-        self._verify_sampled_fn = self._build_verify_sampled_fn()
 
     # ------------------------------------------------------------- prefill
 
     def draft_prefill(self, prep) -> None:  # noqa: ANN001
-        """Mirror the target's prefill (chunk) into the draft cache."""
+        """Mirror the target's (legacy solo) prefill chunk into the draft
+        cache.  The ragged path never mirrors at prefill — verify-time
+        catch-up (``catch_up``) replays whatever the draft is missing."""
         put = self.runner._put
         common = (
             self.draft_params,
@@ -336,127 +332,35 @@ class SpeculativeDecoder:
                 *common, put(prep.block_table), idx
             )
 
-    # -------------------------------------------------------------- decode
+    def catch_up(self, catchups: list[dict]) -> None:
+        """Replay lagging rows' missing context through the draft (rows
+        that decoded as plain spans, fresh prompts the ragged path
+        prefilled target-only, prefix-cache / host-tier adopted spans
+        the draft never saw).  Chunk widths ride the prefill-bucket pad
+        ladder, so catch-up adds no compile shapes."""
+        put = self.runner._put
+        for cu in catchups:
+            _, self.draft_caches = self._draft_chunk_fn(
+                self.draft_params,
+                self.draft_caches,
+                put(cu["token_ids"]),
+                put(cu["positions"]),
+                put(cu["slot_mapping"]),
+                put(np.asarray(cu["t"], np.int32)),
+                put(cu["block_table"]),
+                put(np.asarray([0], np.int32)),
+            )
+
+    # -------------------------------------------------------------- propose
 
     def _build_propose_fn(self):
-        draft = self.draft_model
-        block_size = self.runner.block_size
-
-        def propose(
-            params, caches, tokens0, positions0, limits, block_tables,
-            context_lens0, gamma: int,
-        ):
-            max_blocks = block_tables.shape[1]
-
-            def step(carry, k):
-                caches, tok = carry
-                pos = positions0 + k
-                active = pos <= limits
-                blk = jnp.take_along_axis(
-                    block_tables,
-                    jnp.clip(pos // block_size, 0, max_blocks - 1)[:, None],
-                    axis=1,
-                )[:, 0]
-                slot = jnp.where(
-                    active, blk * block_size + pos % block_size, -1
-                )
-                logits, caches = draft.decode(
-                    params, caches, tok, pos, slot, block_tables,
-                    context_lens0 + k, block_size,
-                )
-                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-                return (caches, nxt), nxt
-
-            # gamma+1 steps: the extra step feeds d_gamma back so ITS K/V
-            # lands in the draft cache too — on a fully-accepted window
-            # the next dispatch's context covers d_gamma's position, which
-            # would otherwise be a permanent hole (its logits are unused)
-            (caches, _), drafted = jax.lax.scan(
-                step, (caches, tokens0), jnp.arange(gamma + 1)
-            )
-            return caches, drafted[:gamma]  # [gamma, B]
-
-        donate = (1,) if jax.default_backend() == "tpu" else ()
-        return jax.jit(propose, static_argnums=(7,), donate_argnums=donate)
-
-    def _window_slots(self, window, positions0, limits, block_tables):
-        """[B, K] positions + KV slots for a speculation window."""
-        block_size = self.runner.block_size
-        b, k = window.shape
-        pos = positions0[:, None] + jnp.arange(k)[None, :]  # [B, K]
-        active = pos <= limits[:, None]
-        max_blocks = block_tables.shape[1]
-        blk = jnp.take_along_axis(
-            block_tables,
-            jnp.clip(pos // block_size, 0, max_blocks - 1),
-            axis=1,
-        )
-        slots = jnp.where(active, blk * block_size + pos % block_size, -1)
-        return pos, slots
-
-    def _build_verify_fn(self):
-        target = self.runner.model
-        block_size = self.runner.block_size
-        window_slots = self._window_slots
-        from vllm_tgis_adapter_tpu.engine.sampler import TOPN_WIDTH
-
-        def verify(
-            params, caches, window,  # [B, K]: last token + γ draft tokens
-            positions0, limits, block_tables, lora, lora_idx,
-        ):
-            b, k = window.shape
-            pos, slots = window_slots(window, positions0, limits,
-                                      block_tables)
-            logits, caches = target.verify(
-                params, caches, window, pos, slots, block_tables, block_size,
-                lora, lora_idx,
-            )  # [B, K, V] f32
-
-            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, K]
-            # greedy[:, j] is the target's choice for position pos+j+1;
-            # draft proposed window[:, j+1] for it
-            matches = greedy[:, :-1] == window[:, 1:]
-            accepted = jnp.sum(
-                jnp.cumprod(matches.astype(jnp.int32), axis=1), axis=1
-            )  # [B] in 0..K-1
-            cols = jnp.arange(k)[None, :]
-            emitted = jnp.where(
-                cols < accepted[:, None],
-                jnp.pad(window[:, 1:], ((0, 0), (0, 1))),
-                greedy,
-            )  # [B, K]; col j<a: draft token, col a: bonus, cols>a unused
-
-            logprobs = jax.nn.log_softmax(logits, axis=-1)
-            chosen_lp = jnp.take_along_axis(
-                logprobs, emitted[..., None], axis=-1
-            )[..., 0]
-            chosen_logit = jnp.take_along_axis(
-                logits, emitted[..., None], axis=-1
-            )
-            rank = 1 + jnp.sum(logits > chosen_logit, axis=-1).astype(
-                jnp.int32
-            )
-            topn_lp, topn_ids = jax.lax.top_k(logprobs, TOPN_WIDTH)
-            return (
-                caches,
-                emitted,
-                accepted,
-                chosen_lp,
-                rank,
-                topn_ids.astype(jnp.int32),
-                topn_lp,
-            )
-
-        donate = (1,) if jax.default_backend() == "tpu" else ()
-        return jax.jit(verify, donate_argnums=donate)
-
-    # --------------------------------------------- sampled (rejection) path
-
-    def _build_propose_sampled_fn(self):
-        """Draft proposes by SAMPLING from its (temperature/top-k/top-p
-        transformed) distribution and returns that distribution per
-        proposed position — rejection-sampling verification needs q(x)
-        over the full vocab to form the residual."""
+        """One propose program for greedy AND sampled rows: the draft
+        SAMPLES from its (temperature/top-k/top-p transformed)
+        distribution — greedy rows degenerate to argmax through the
+        one-hot ``_spec_dist`` — and returns that distribution per
+        proposed position, which rejection-sampling verification needs
+        to form the residual.  Inactive rows (non-spec spans sharing the
+        dispatch) carry ``limits = -1`` so their writes drop."""
         draft = self.draft_model
         block_size = self.runner.block_size
 
@@ -497,8 +401,10 @@ class SpeculativeDecoder:
                 ).astype(jnp.int32)
                 return (caches, nxt), (nxt, probs)
 
-            # gamma+1 steps for the same cache-hole reason as the greedy
-            # propose; the extra step's distribution is discarded
+            # gamma+1 steps: the extra step feeds d_gamma back so ITS K/V
+            # lands in the draft cache too — on a fully-accepted window
+            # the next dispatch's context covers d_gamma's position, which
+            # would otherwise be a permanent hole (its logits are unused)
             (caches, _), (drafted, qprobs) = jax.lax.scan(
                 step, (caches, tokens0), jnp.arange(gamma + 1)
             )
@@ -507,181 +413,44 @@ class SpeculativeDecoder:
         donate = (1,) if jax.default_backend() == "tpu" else ()
         return jax.jit(propose, static_argnums=(12,), donate_argnums=donate)
 
-    def _build_verify_sampled_fn(self):
-        """Rejection-sampling verification (Leviathan et al.): accept
-        draft token d_j with prob min(1, p(d_j)/q(d_j)); at the first
-        rejection sample from the residual norm(max(p - q, 0)); on full
-        acceptance sample the bonus token from p directly.  Greedy rows
-        degenerate exactly to the argmax match test (p and q are
-        one-hots), so mixed greedy/sampled batches ride one program."""
-        target = self.runner.model
-        block_size = self.runner.block_size
-        window_slots = self._window_slots
-        from vllm_tgis_adapter_tpu.engine.sampler import TOPN_WIDTH
-
-        def verify(
-            params, caches, window, positions0, limits, block_tables,
-            q_probs,  # [gamma, B, V] draft distributions
-            temps, top_k, top_p, base_key, gen0, lora, lora_idx,
-        ):
-            b, kw = window.shape
-            gamma = kw - 1
-            pos, slots = window_slots(window, positions0, limits,
-                                      block_tables)
-            logits, caches = target.verify(
-                params, caches, window, pos, slots, block_tables,
-                block_size, lora, lora_idx,
-            )  # [B, K, V] f32
-            emitted, accepted = _rejection_core(
-                logits, q_probs, window, temps, top_k, top_p, base_key,
-                gen0,
-            )
-
-            # token-info reporting matches the non-spec sampler: logprobs
-            # of the temperature-scaled distribution (no penalties on
-            # eligible rows by construction)
-            safe = jnp.where(temps <= 0.0, 1.0, temps)[:, None, None]
-            logp = jax.nn.log_softmax(logits / safe, axis=-1)
-            chosen_lp = jnp.take_along_axis(
-                logp, emitted[..., None], axis=-1
-            )[..., 0]
-            rank = 1 + jnp.sum(
-                logp > chosen_lp[..., None], axis=-1
-            ).astype(jnp.int32)
-            topn_lp, topn_ids = jax.lax.top_k(logp, TOPN_WIDTH)
-            return (
-                caches,
-                emitted,
-                accepted,
-                chosen_lp,
-                rank,
-                topn_ids.astype(jnp.int32),
-                topn_lp,
-            )
-
-        donate = (1,) if jax.default_backend() == "tpu" else ()
-        return jax.jit(verify, donate_argnums=donate)
-
-    def run(self, prep: "PreparedDecode") -> list[list["SampledToken"]]:
-        """One speculative dispatch; same output contract as
-        ModelRunner.execute_decode (row i: up to steps_per_seq[i] tokens).
-        """
-        from vllm_tgis_adapter_tpu.engine.runner import SampledToken
-
-        runner = self.runner
-        put = runner._put
-        # K-1 proposals + 1 bonus per dispatch, bounded by the page
-        # capacity the scheduler planned for
-        k = min(self.gamma + 1, max(prep.num_steps, 1))
-        gamma = k - 1
-        if gamma == 0:
-            # no room to speculate this dispatch: plain fused decode
-            return runner.execute_decode(
-                dataclasses.replace(prep, spec_ok=False)
-            )
-
-        # catch lagging rows' draft caches up first (rows that decoded in
-        # mixed batches, or prompts admitted via target-side prefix-cache
-        # hits the draft never saw)
-        for cu in prep.draft_catchups:
-            _, self.draft_caches = self._draft_chunk_fn(
-                self.draft_params,
-                self.draft_caches,
-                put(cu["token_ids"]),
-                put(cu["positions"]),
-                put(cu["slot_mapping"]),
-                put(np.asarray(cu["t"], np.int32)),
-                put(cu["block_table"]),
-                put(np.asarray([0], np.int32)),
-            )
-
-        tokens0 = put(prep.token_ids)
-        positions0 = put(prep.positions)
-        limits = put(prep.limits)
-        tables = put(prep.block_tables)
-        ctx0 = put(prep.context_lens)
-        lora = runner.lora_stacks if prep.lora_idx is not None else None
-        lora_idx = (
-            put(prep.lora_idx) if prep.lora_idx is not None else None
-        )
-
+    def propose(self, prep) -> tuple:  # noqa: ANN001
+        """Run draft catch-up + the γ-step propose scan over a prepared
+        ragged verify dispatch (runner.PreparedRagged spec fields).
+        Returns device-resident ``(drafted [γ, S], q_probs [γ, S, V])``
+        — enqueue-only, no host synchronisation."""
+        self.catch_up(prep.draft_catchups)
+        put = self.runner._put
         t = prep.tensors
-        any_sampled = bool(np.any(np.asarray(t.temperature) > 0.0))
-        if any_sampled:
-            temps = put(np.asarray(t.temperature, np.float32))
-            top_k = put(np.asarray(t.top_k, np.int32))
-            top_p = put(np.asarray(t.top_p, np.float32))
-            base_key = put(np.asarray(t.base_key, np.uint32))
-            gen0 = put(np.asarray(t.gen_len, np.int32))
-            self.draft_caches, drafted, q_probs = self._propose_sampled_fn(
-                self.draft_params, self.draft_caches, tokens0, positions0,
-                limits, tables, ctx0, temps, top_k, top_p, base_key, gen0,
-                gamma,
-            )
-            window = jnp.concatenate(
-                [tokens0[:, None], jnp.transpose(drafted)], axis=1
-            )  # [B, K]
-            (
-                runner.caches, emitted, accepted, lp, rank, topn_ids,
-                topn_lp,
-            ) = self._verify_sampled_fn(
-                runner.params, runner.caches, window, positions0, limits,
-                tables, q_probs, temps, top_k, top_p, base_key, gen0,
-                lora, lora_idx,
-            )
-        else:
-            self.draft_caches, drafted = self._propose_fn(
-                self.draft_params, self.draft_caches, tokens0, positions0,
-                limits, tables, ctx0, gamma,
-            )
-            window = jnp.concatenate(
-                [tokens0[:, None], jnp.transpose(drafted)], axis=1
-            )  # [B, K]
-            (
-                runner.caches, emitted, accepted, lp, rank, topn_ids,
-                topn_lp,
-            ) = self._verify_fn(
-                runner.params, runner.caches, window, positions0, limits,
-                tables, lora, lora_idx,
-            )
+        self.draft_caches, drafted, q_probs = self._propose_fn(
+            self.draft_params,
+            self.draft_caches,
+            put(prep.spec_tokens0),
+            put(prep.spec_positions0),
+            put(prep.spec_limits),
+            put(prep.block_tables),
+            put(prep.spec_context0),
+            put(np.asarray(t.temperature, np.float32)),
+            put(np.asarray(t.top_k, np.int32)),
+            put(np.asarray(t.top_p, np.float32)),
+            put(np.asarray(t.base_key, np.uint32)),
+            put(np.asarray(t.gen_len, np.int32)),
+            self.gamma,
+        )
+        return drafted, q_probs
 
-        from vllm_tgis_adapter_tpu.engine.runner import _HostSamplerOutput
+    # ----------------------------------------------------------- accounting
 
-        # tpulint: disable=TPL202(sanctioned sync: spec verify is a host-synchronised phase by design — one packed fetch for the whole window)
-        packed = np.asarray(_pack_spec_results(
-            emitted, accepted, lp, rank, topn_ids, topn_lp
-        ))  # [B, K, 4+2W] — one fetch for the whole dispatch
-        host = _HostSamplerOutput.from_packed(packed[..., :-1])
-        emitted, rank = host.tokens, host.ranks
-        topn_ids, lp = host.topn_ids, host.logprobs
-        topn_lp = host.topn_logprobs
-        accepted = packed[..., 0, -1]  # [B] broadcast column
-
-        out: list[list[SampledToken]] = []
-        batch_proposed = batch_accepted = 0
-        for i in range(prep.num_seqs):
-            emit = min(int(accepted[i]) + 1, prep.steps_per_seq[i])
-            out.append([
-                SampledToken(
-                    token_id=int(emitted[i, j]),
-                    logprob=float(lp[i, j]),
-                    rank=int(rank[i, j]),
-                    topn_ids=topn_ids[i, j].tolist(),
-                    topn_logprobs=topn_lp[i, j].tolist(),
-                )
-                for j in range(emit)
-            ])
-            batch_proposed += min(gamma, prep.steps_per_seq[i])
-            batch_accepted += min(int(accepted[i]), prep.steps_per_seq[i])
-        self.stats.proposed += batch_proposed
-        self.stats.accepted += batch_accepted
+    def note_batch(self, proposed: int, accepted: int) -> None:
+        """Fold one verify dispatch's acceptance into the stats + the
+        spec metrics (called from the commit path with host counts)."""
+        self.stats.proposed += proposed
+        self.stats.accepted += accepted
         self.stats.dispatches += 1
-        prep.spec_ran = True  # commit advances each row's draft_pos
         try:
             from vllm_tgis_adapter_tpu import metrics
 
-            metrics.spec_proposed_tokens_total.inc(batch_proposed)
-            metrics.spec_accepted_tokens_total.inc(batch_accepted)
+            metrics.spec_proposed_tokens_total.inc(proposed)
+            metrics.spec_accepted_tokens_total.inc(accepted)
         except Exception:  # pragma: no cover - metrics are best-effort
             pass
         if self.stats.dispatches % _LOG_EVERY == 0:
@@ -691,4 +460,3 @@ class SpeculativeDecoder:
                 100 * self.stats.acceptance_rate, self.stats.proposed,
                 self.stats.dispatches,
             )
-        return out

@@ -86,6 +86,12 @@ spec_accepted_tokens_total = _get_or_create(
     f"{_PREFIX}_spec_accepted_tokens_total",
     "Draft tokens accepted by target verification",
 )
+spec_acceptance_rate = _get_or_create(
+    Gauge,
+    f"{_PREFIX}_spec_acceptance_rate",
+    "Lifetime draft-token acceptance rate of speculative verify spans",
+    labelnames=("replica",),
+)
 
 # ---- engine-state gauges (k8s autoscaling keys off exactly these; the
 # reference exports the vLLM equivalents vllm:num_requests_running/

@@ -606,7 +606,6 @@ class LlamaForCausalLM:
         lora=None,  # LoRAStacks (engine/lora.py) or None
         lora_slot: jax.Array | None = None,  # scalar adapter slot
         *,
-        seg_starts: jax.Array | None = None,  # [max_segs] packed prefill
         hidden: jax.Array | None = None,  # [T, d] from the previous pp stage
         first_stage: bool = True,  # embed input tokens here
         last_stage: bool = True,  # apply final norm + lm_head here
@@ -617,10 +616,9 @@ class LlamaForCausalLM:
         of embedding ``token_ids``; a non-last stage returns the raw
         hidden states for the next stage instead of logits.
 
-        Packed (batched) prefill: with ``seg_starts`` the token axis
-        carries several concatenated prompts; ``positions`` restarts at 0
-        per segment (so RoPE/learned embeddings are per-prompt) and
-        attention is block-diagonal causal (ops/attention.py).
+        This is the LEGACY solo entry point: the serving data path is
+        ``ragged_forward`` below; solo prefill survives for pp>1 / sp>1
+        engines and prompt-logprob heads (docs/ATTENTION.md).
 
         Returns logits only at ``logits_indices`` (default: every position).
         Restricting to the sampled row avoids materialising a ``[T, vocab]``
@@ -651,7 +649,6 @@ class LlamaForCausalLM:
                 q, k, v, scale, valid_len, mesh=self.mesh,
                 window=self._window_for_layer(i),
                 alibi_slopes=self.alibi,
-                seg_starts=seg_starts,
                 sp_mode=self.sp_mode,
             )
 
@@ -829,78 +826,6 @@ class LlamaForCausalLM:
         x = x[logits_indices]
         return self._logits(params, x), (k_cache, v_cache)
 
-    def verify(
-        self,
-        params: dict,
-        caches: tuple[jax.Array, jax.Array],
-        token_ids: jax.Array,  # [B, K] speculation windows
-        positions: jax.Array,  # [B, K] global positions
-        slot_mapping: jax.Array,  # [B, K] cache slot per token; -1 masked
-        block_tables: jax.Array,  # [B, max_blocks]
-        block_size: int,
-        lora=None,  # LoRAStacks or None
-        lora_idx: jax.Array | None = None,  # [B] adapter slot per row
-    ) -> tuple[jax.Array, tuple[jax.Array, jax.Array]]:
-        """Multi-token verification forward for speculative decoding.
-
-        Each row's K tokens run in one pass; token j attends to the row's
-        paged context up to and including itself (its K/V is scattered
-        first), i.e. the batched generalisation of ``prefill_chunk``.
-        Returns logits for EVERY window position as ``[B, K, V]``.
-
-        LoRA: the row's adapter applies to the TARGET verification pass
-        (the draft proposes from its base weights), so acceptance drops
-        but emitted tokens follow the adapted model exactly.
-        """
-        cfg = self.config
-        k_cache, v_cache = caches
-        scale = self._attention_scale()
-        b, k = token_ids.shape
-
-        flat_tokens = token_ids.reshape(-1)
-        flat_pos = positions.reshape(-1)
-        flat_slots = slot_mapping.reshape(-1)
-        tables = jnp.repeat(block_tables, k, axis=0)  # [B*K, max_blocks]
-        ctx_lens = jnp.clip(flat_pos + 1, 1, None)
-
-        rope = self._rope_tables(flat_pos)
-        safe_slots = jnp.where(flat_slots < 0, k_cache.shape[2], flat_slots)
-        flat_lora_idx = (
-            jnp.repeat(lora_idx, k) if lora_idx is not None else None
-        )
-
-        def attend(i, q, kk, v):
-            nonlocal k_cache, v_cache
-            k_cache = k_cache.at[i, :, safe_slots].set(
-                kk.astype(k_cache.dtype), mode="drop"
-            )
-            v_cache = v_cache.at[i, :, safe_slots].set(
-                v.astype(v_cache.dtype), mode="drop"
-            )
-            return attn_ops.paged_decode_attention(
-                q, k_cache[i], v_cache[i], tables, ctx_lens,
-                block_size, scale, mesh=self.mesh,
-                window=self._window_for_layer(i),
-                alibi_slopes=self.alibi,
-            )
-
-        x = self._embed(params, flat_tokens, flat_pos)
-        for i, layer in enumerate(params["layers"]):
-            dl = None
-            if lora is not None:
-                dl = (
-                    lambda target, xx, i=i: _lora_delta_batched(
-                        lora, i, flat_lora_idx, target, xx
-                    )
-                )
-            x = self._decoder_block(
-                layer, x, lambda q, k, v, i=i: attend(i, q, k, v), dl,
-                rope,
-            )
-
-        logits = self._logits(params, x)  # [B*K, V]
-        return logits.reshape(b, k, -1), (k_cache, v_cache)
-
     @_clears_moe_mask
     def decode(
         self,
@@ -917,16 +842,14 @@ class LlamaForCausalLM:
         hidden: jax.Array | None = None,  # [B, d] from the previous pp stage
         first_stage: bool = True,
         last_stage: bool = True,
-        use_ragged_kernel: bool = False,  # static: ragged-backend decode
     ) -> tuple[jax.Array, tuple[jax.Array, jax.Array]]:
         """One decode step for the whole (padded) running batch.
 
-        ``use_ragged_kernel`` (static, closed over by the ragged
-        backend's fused-decode builder) routes attention through the
-        unified ragged kernel instead of the bucketed decode ladder —
-        each batch row is a one-token span, so the decode wave and the
-        mixed ragged step run the SAME kernel and the
-        folded → perhead → xla variant chain is retired on that path.
+        Attention routes through the unified ragged kernel — each batch
+        row is a one-token span, so the fused decode wave and the mixed
+        ragged step run the SAME kernel (the bucketed
+        folded → perhead → xla variant chain is retired;
+        docs/ATTENTION.md).
         """
         cfg = self.config
         k_cache, v_cache = caches
@@ -944,29 +867,22 @@ class LlamaForCausalLM:
             v_cache = v_cache.at[i, :, safe_slots].set(
                 v.astype(v_cache.dtype), mode="drop"
             )
-            if use_ragged_kernel:
-                from vllm_tgis_adapter_tpu.ops.ragged_attention import (
-                    ragged_paged_attention,
-                )
+            from vllm_tgis_adapter_tpu.ops.ragged_attention import (
+                ragged_paged_attention,
+            )
 
-                b = token_ids.shape[0]
-                # one-token spans: row i is sequence i at position
-                # context_lens[i] - 1 (dead rows carry context 1/slot -1
-                # and their garbage output is discarded by the sampler
-                # mask, same as the bucketed decode contract)
-                return ragged_paged_attention(
-                    q, k_cache[i], v_cache[i],
-                    jnp.maximum(context_lens, 1) - 1,
-                    jnp.arange(b + 1, dtype=jnp.int32),
-                    jnp.maximum(context_lens, 1) - 1,
-                    jnp.asarray(b, jnp.int32),
-                    block_tables, block_size, scale, mesh=self.mesh,
-                    window=self._window_for_layer(i),
-                    alibi_slopes=self.alibi,
-                )
-            return attn_ops.paged_decode_attention(
-                q, k_cache[i], v_cache[i], block_tables, context_lens,
-                block_size, scale, mesh=self.mesh,
+            b = token_ids.shape[0]
+            # one-token spans: row i is sequence i at position
+            # context_lens[i] - 1 (dead rows carry context 1/slot -1
+            # and their garbage output is discarded by the sampler
+            # mask, same as the padded-batch decode contract)
+            return ragged_paged_attention(
+                q, k_cache[i], v_cache[i],
+                jnp.maximum(context_lens, 1) - 1,
+                jnp.arange(b + 1, dtype=jnp.int32),
+                jnp.maximum(context_lens, 1) - 1,
+                jnp.asarray(b, jnp.int32),
+                block_tables, block_size, scale, mesh=self.mesh,
                 window=self._window_for_layer(i),
                 alibi_slopes=self.alibi,
             )

@@ -2,11 +2,15 @@
 
 TPU-native replacement for the paged-attention CUDA kernels the reference
 stack executes inside vLLM (SURVEY.md §2.2/§2.3).  This module holds the
-XLA-composed implementations: dense causal prefill attention and
-gather-based paged decode attention.  They are correct on every backend
+XLA-composed implementations: dense causal prefill attention and the
+gather-based paged decode formulation.  They are correct on every backend
 (CPU tests included) and serve as the numerical reference for the Pallas
-TPU kernels in ``pallas_attention.py``, which are swapped in at engine boot
-when running on real TPU hardware.
+TPU kernels in ``pallas_attention.py`` / ``ragged_attention.py``, which
+are swapped in at engine boot when running on real TPU hardware.  Decode
+itself serves through the unified RAGGED kernel (ops/ragged_attention.py)
+— the bucketed folded/perhead decode variant ladder is retired
+(docs/ATTENTION.md); ``paged_decode_attention_xla`` below remains as the
+shared numerical reference and CPU path.
 
 Layout choices (TPU-first):
 * KV cache is one array per K/V of shape ``[num_layers, kv_heads, num_slots,
@@ -24,7 +28,6 @@ from __future__ import annotations
 
 import functools
 import os
-import threading
 
 import jax
 import jax.numpy as jnp
@@ -51,94 +54,6 @@ def _use_pallas() -> bool:
     if mode == "pallas":
         return True
     return jax.default_backend() == "tpu"
-
-
-# ---- decode-kernel variant selection + serving-path degradation.
-#
-# The folded decode kernel (pallas_attention._decode_kernel_folded) is
-# faster but carries interpreter parity only; the per-head kernel is
-# hardware-validated, so it is the DEFAULT (ADVICE r5).  When an
-# operator opts into folded (PALLAS_DECODE_KERNEL=folded) and Mosaic
-# rejects it at the first real compile, the serving path must degrade —
-# folded → perhead → xla, the same chain bench.py retries through —
-# instead of crashing the server at boot (--precompile) or on the first
-# decode.  The override is process-global on purpose: one Mosaic verdict
-# applies to every engine replica in the process — which also means dp
-# replicas' dispatch threads can fail CONCURRENTLY, so the step-down is
-# a locked compare-and-swap: threads reporting the same failed variant
-# burn exactly one level between them.
-_DECODE_KERNEL_CHAIN = ("folded", "perhead", "xla")
-_decode_kernel_lock = threading.Lock()
-_decode_kernel_override: str | None = None
-# every degradation step this process took, in order — bench.py stamps
-# these into BENCH_*.json so a run that silently fell back to a slower
-# kernel is attributable instead of a throughput mystery
-_decode_kernel_degrades: list[dict] = []
-
-
-def decode_kernel_variant() -> str:
-    """The decode-kernel variant this dispatch will use: a sticky
-    degradation override if one is set, else the env/default."""
-    if _decode_kernel_override is not None:
-        return _decode_kernel_override
-    return os.environ.get("PALLAS_DECODE_KERNEL", "perhead")
-
-
-def degrade_decode_kernel(failed: str | None = None) -> str | None:
-    """Step the decode kernel down one level (folded → perhead → xla).
-
-    ``failed`` names the variant the caller observed failing: if another
-    thread already degraded past it, the current (newer) variant is
-    returned WITHOUT stepping again, so concurrent identical failures
-    cannot skip straight to the XLA floor.  Returns the variant to retry
-    with, or None when already at the floor.
-    """
-    global _decode_kernel_override
-    with _decode_kernel_lock:
-        current = decode_kernel_variant()
-        if failed is not None and current != failed:
-            return current  # someone else degraded already: retry as-is
-        try:
-            idx = _DECODE_KERNEL_CHAIN.index(current)
-        except ValueError:
-            idx = 0
-        if idx + 1 >= len(_DECODE_KERNEL_CHAIN):
-            return None
-        _decode_kernel_override = _DECODE_KERNEL_CHAIN[idx + 1]
-        import time
-
-        _decode_kernel_degrades.append({
-            "from": current,
-            "to": _decode_kernel_override,
-            "ts": round(time.time(), 3),
-        })
-        return _decode_kernel_override
-
-
-def decode_kernel_degrades() -> list[dict]:
-    """Degradation steps taken this process (oldest first); see
-    ``_decode_kernel_degrades``."""
-    with _decode_kernel_lock:
-        return list(_decode_kernel_degrades)
-
-
-def reset_decode_kernel() -> None:
-    """Test hook: clear a sticky degradation (and its event log)."""
-    global _decode_kernel_override
-    with _decode_kernel_lock:
-        _decode_kernel_override = None
-        _decode_kernel_degrades.clear()
-
-
-def is_kernel_lowering_error(exc: BaseException) -> bool:
-    """Heuristic: does this exception look like a Pallas/Mosaic lowering
-    or compile failure (retriable by degrading the kernel) rather than a
-    bug in the inputs?"""
-    text = f"{type(exc).__name__}: {exc}"
-    return any(
-        marker in text
-        for marker in ("Mosaic", "mosaic", "Pallas", "pallas")
-    )
 
 
 def _pallas_interpret() -> bool:
@@ -203,8 +118,9 @@ def prefill_attention(
     ):
         raise NotImplementedError(
             "packed prefill (seg_starts) composes only with plain causal "
-            "attention — the scheduler must not pack windowed/ALiBi/sp "
-            "requests (engine/scheduler.py allow_packed)"
+            "attention; the block-diagonal mask survives as ops-level "
+            "machinery only — the serving planner is ragged "
+            "(docs/ATTENTION.md)"
         )
     if mesh is not None and dict(mesh.shape).get("sp", 1) > 1:
         # window/ALiBi ride through both sp styles: the ring carries the
@@ -346,65 +262,6 @@ def prefill_attention_xla(
     probs = jnp.where(mask[None, None], probs, 0.0)
     out = jnp.einsum("kgts,skd->tkgd", probs, vh)
     return out.reshape(t, num_heads, head_dim).astype(q.dtype)
-
-
-def paged_decode_attention(
-    q: jax.Array,
-    k_cache: jax.Array,
-    v_cache: jax.Array,
-    block_tables: jax.Array,
-    context_lens: jax.Array,
-    block_size: int,
-    scale: float,
-    mesh=None,
-    window: int = 0,
-    alibi_slopes: jax.Array | None = None,  # [H] f32 (bloom lineage)
-) -> jax.Array:
-    """Dispatch: flash Pallas kernel on TPU, XLA fallback elsewhere.
-
-    Under a TP mesh the kernel runs inside shard_map: the cache is
-    head-sharded on tp, so each shard's kernel reads only its local pages.
-    """
-    # the variant resolves OUTSIDE the jitted model so a degradation
-    # (folded → perhead → xla, see degrade_decode_kernel) selects a
-    # fresh trace on the retry instead of hitting a stale cache entry
-    variant = decode_kernel_variant()
-    if _use_pallas() and variant != "xla":
-        from vllm_tgis_adapter_tpu.ops import pallas_attention
-
-        kernel = functools.partial(
-            pallas_attention.paged_decode_attention,
-            block_size=block_size,
-            scale=scale,
-            window=window,
-            interpret=_pallas_interpret(),
-            variant=variant,
-        )
-        if mesh is not None:
-            from jax.sharding import PartitionSpec as P
-
-            heads = P(None, "tp", None)
-            cache = P("tp", None, None)
-            operands = [q, k_cache, v_cache, block_tables, context_lens]
-            specs = [heads, cache, cache, P(), P()]
-            if alibi_slopes is not None:
-                operands.append(alibi_slopes)
-                specs.append(P("tp"))
-
-            def wrapped(q, kc, vc, bt, cl, *rest):
-                return kernel(q, kc, vc, bt, cl,
-                              alibi_slopes=rest[0] if rest else None)
-
-            return shard_map(
-                wrapped, mesh=mesh, in_specs=tuple(specs),
-                out_specs=heads, check_vma=False,
-            )(*operands)
-        return kernel(q, k_cache, v_cache, block_tables, context_lens,
-                      alibi_slopes=alibi_slopes)
-    return paged_decode_attention_xla(
-        q, k_cache, v_cache, block_tables, context_lens, block_size, scale,
-        window=window, alibi_slopes=alibi_slopes,
-    )
 
 
 def chunked_prefill_attention(

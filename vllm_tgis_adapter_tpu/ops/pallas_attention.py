@@ -46,364 +46,6 @@ NEG_INF = float("-inf")
 # --------------------------------------------------------------------- decode
 
 
-def _decode_kernel(
-    # scalar prefetch
-    block_tables_ref,  # [B, max_blocks] SMEM
-    context_lens_ref,  # [B] SMEM
-    alibi_ref,  # [H] f32 SMEM slopes; all-zero == disabled
-    # blocks
-    q_ref,  # [1, 1, G, Dh] VMEM (G = q_per_kv)
-    k_ref,  # [1, block_size, Dh] VMEM — page picked by index_map
-    v_ref,  # [1, block_size, Dh] VMEM
-    o_ref,  # [1, 1, G, Dh] VMEM
-    # scratch
-    m_ref,  # [G, 1] f32 running max
-    l_ref,  # [G, 1] f32 running denominator
-    acc_ref,  # [G, Dh] f32 running numerator
-    *,
-    scale: float,
-    block_size: int,
-    window: int,
-    use_alibi: bool,
-    g_count: int,
-):
-    b = pl.program_id(0)
-    h = pl.program_id(1)
-    j = pl.program_id(2)
-    last = pl.num_programs(2) - 1
-    ctx = context_lens_ref[b]
-    # sliding window: only keys at positions >= ctx - window are live
-    win_lo = jnp.maximum(ctx - window, 0) if window > 0 else 0
-
-    @pl.when(j == 0)
-    def _init():
-        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
-        l_ref[...] = jnp.zeros_like(l_ref)
-        acc_ref[...] = jnp.zeros_like(acc_ref)
-
-    @pl.when((j * block_size < ctx)
-             & ((j + 1) * block_size > win_lo))
-    def _page():
-        q = q_ref[0, 0].astype(jnp.float32)  # [G, Dh]
-        k = k_ref[0].astype(jnp.float32)  # [bs, Dh]
-        v = v_ref[0].astype(jnp.float32)  # [bs, Dh]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale  # [G, bs]
-        pos = j * block_size + jax.lax.broadcasted_iota(
-            jnp.int32, s.shape, dimension=1
-        )
-        if use_alibi:
-            # per-row slope: query head = h·G + g (row-constant query
-            # term cancels in softmax, so the bias is slope · k_pos)
-            slopes = jnp.stack(
-                [alibi_ref[h * g_count + gi] for gi in range(g_count)]
-            )[:, None]  # [G, 1]
-            s = s + slopes * pos.astype(jnp.float32)
-        live = pos < ctx
-        if window > 0:
-            live &= pos >= win_lo
-        s = jnp.where(live, s, NEG_INF)
-
-        m_prev = m_ref[...]  # [G, 1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        shift = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
-        p = jnp.exp(s - shift)  # [G, bs]
-        alpha = jnp.exp(
-            jnp.where(jnp.isfinite(m_prev), m_prev, shift) - shift
-        )  # [G, 1]
-        l_ref[...] = alpha * l_ref[...] + jnp.sum(p, axis=-1, keepdims=True)
-        acc_ref[...] = alpha * acc_ref[...] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        m_ref[...] = m_new
-
-    @pl.when(j == last)
-    def _finalize():
-        # rows with zero context cannot occur for live sequences (the
-        # runner masks dead rows host-side); guard the divide anyway
-        denom = jnp.maximum(l_ref[...], 1e-30)
-        o_ref[0, 0] = (acc_ref[...] / denom).astype(o_ref.dtype)
-
-
-def _decode_kernel_folded(
-    # scalar prefetch
-    block_tables_ref,  # [B, max_blocks] SMEM
-    context_lens_ref,  # [B] SMEM
-    alibi_ref,  # [H] f32 SMEM slopes; all-zero == disabled
-    # blocks
-    q_ref,  # [1, H, Dh] VMEM — ALL query heads of one sequence
-    k_ref,  # [Hkv, block_size, Dh] VMEM — one page across ALL kv heads
-    v_ref,  # [Hkv, block_size, Dh] VMEM
-    o_ref,  # [1, H, Dh] VMEM
-    # scratch
-    m_ref,  # [H, 1] f32 running max
-    l_ref,  # [H, 1] f32 running denominator
-    acc_ref,  # [H, Dh] f32 running numerator
-    *,
-    scale: float,
-    block_size: int,
-    window: int,
-    use_alibi: bool,
-    g_count: int,
-):
-    """Head-folded decode attention (round-5 grid-overhead fix).
-
-    The per-head kernel runs a (B, Hkv, pages) grid whose per-step work
-    is a [G, Dh]x[Dh, bs] sliver — at llama-8B decode shapes that is
-    thousands of MXU-starved grid steps per layer.  This variant folds
-    every KV head into one step: grid (B, pages), one
-    [H, Dh]x[Dh, Hkv*bs] pass per page with the off-head blocks masked
-    to -inf (query head r attends kv head r // G; key col c belongs to
-    kv head c // block_size).  8x fewer grid steps, 8x bigger DMAs, and
-    the score matmul fills the MXU's 128-lane dimension instead of
-    one page's worth.
-    """
-    b = pl.program_id(0)
-    j = pl.program_id(1)
-    last = pl.num_programs(1) - 1
-    ctx = context_lens_ref[b]
-    win_lo = jnp.maximum(ctx - window, 0) if window > 0 else 0
-
-    @pl.when(j == 0)
-    def _init():
-        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
-        l_ref[...] = jnp.zeros_like(l_ref)
-        acc_ref[...] = jnp.zeros_like(acc_ref)
-
-    @pl.when((j * block_size < ctx)
-             & ((j + 1) * block_size > win_lo))
-    def _page():
-        num_kv, bs, dh = k_ref.shape
-        h = num_kv * g_count
-        q = q_ref[0].astype(jnp.float32)  # [H, Dh]
-        k = k_ref[...].astype(jnp.float32).reshape(num_kv * bs, dh)
-        v = v_ref[...].astype(jnp.float32).reshape(num_kv * bs, dh)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale  # [H, Hkv*bs]
-        row = jax.lax.broadcasted_iota(jnp.int32, s.shape, dimension=0)
-        col = jax.lax.broadcasted_iota(jnp.int32, s.shape, dimension=1)
-        pos = j * block_size + col % bs
-        live = (row // g_count == col // bs) & (pos < ctx)
-        if window > 0:
-            live &= pos >= win_lo
-        if use_alibi:
-            # query head == row (heads are grouped kv-major, matching
-            # the per-head kernel's h*G+g indexing); built with 2-D
-            # selects — 1-D gathers/reshapes are Mosaic-hostile
-            slopes = jnp.full(s.shape, alibi_ref[0], jnp.float32)
-            for hq in range(1, h):
-                slopes = jnp.where(row == hq, alibi_ref[hq], slopes)
-            s = s + slopes * pos.astype(jnp.float32)
-        s = jnp.where(live, s, NEG_INF)
-
-        m_prev = m_ref[...]  # [H, 1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        shift = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
-        p = jnp.exp(s - shift)  # [H, Hkv*bs]
-        alpha = jnp.exp(
-            jnp.where(jnp.isfinite(m_prev), m_prev, shift) - shift
-        )
-        l_ref[...] = alpha * l_ref[...] + jnp.sum(p, axis=-1, keepdims=True)
-        acc_ref[...] = alpha * acc_ref[...] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        m_ref[...] = m_new
-
-    @pl.when(j == last)
-    def _finalize():
-        denom = jnp.maximum(l_ref[...], 1e-30)
-        o_ref[0] = (acc_ref[...] / denom).astype(o_ref.dtype)
-
-
-def paged_decode_attention(
-    q: jax.Array,  # [B, H, Dh]
-    k_cache: jax.Array,  # [Hkv, num_slots, Dh] head-leading (module docstring)
-    v_cache: jax.Array,
-    block_tables: jax.Array,  # [B, max_blocks] int32 page ids
-    context_lens: jax.Array,  # [B] int32 incl. current token
-    block_size: int,
-    scale: float,
-    *,
-    window: int = 0,  # >0: attend to at most the last `window` tokens
-    alibi_slopes: jax.Array | None = None,  # [H] f32 (bloom lineage)
-    interpret: bool = False,
-    variant: str | None = None,  # folded|perhead; None reads env
-) -> jax.Array:
-    """Flash-style paged decode attention, one query token per sequence.
-
-    The variant is resolved OUTSIDE the jitted implementation so a
-    runtime env change (bench.py's Mosaic-failure retry chain) selects a
-    fresh compile instead of hitting the stale jit cache.
-    """
-    if variant is None:
-        # default is the hardware-validated per-head kernel (ADVICE r5):
-        # the folded variant carries interpreter parity only until
-        # test_decode_kernel_compiles_and_matches passes for it on-chip —
-        # opt in via PALLAS_DECODE_KERNEL=folded (bench.py does, behind
-        # its Mosaic-failure retry chain)
-        variant = os.environ.get("PALLAS_DECODE_KERNEL", "perhead")
-    if variant not in ("folded", "perhead"):
-        raise ValueError(
-            f"PALLAS_DECODE_KERNEL must be 'folded' or 'perhead', "
-            f"got {variant!r}"
-        )
-    return _paged_decode_attention_impl(
-        q, k_cache, v_cache, block_tables, context_lens, block_size,
-        scale, window=window, alibi_slopes=alibi_slopes,
-        interpret=interpret, variant=variant,
-    )
-
-
-@functools.partial(
-    jax.jit,
-    static_argnames=("block_size", "scale", "window", "interpret",
-                     "variant"),
-)
-def _paged_decode_attention_impl(
-    q: jax.Array,
-    k_cache: jax.Array,
-    v_cache: jax.Array,
-    block_tables: jax.Array,
-    context_lens: jax.Array,
-    block_size: int,
-    scale: float,
-    *,
-    window: int = 0,
-    alibi_slopes: jax.Array | None = None,
-    interpret: bool = False,
-    variant: str = "folded",
-) -> jax.Array:
-    b, num_heads, head_dim = q.shape
-    num_kv = k_cache.shape[0]
-    g = num_heads // num_kv
-    max_blocks = block_tables.shape[1]
-
-    qg = q.reshape(b, num_kv, g, head_dim)
-    # invalid/padding pages (id <= 0 beyond context) clamp to page 0; the
-    # in-kernel length mask discards their scores
-    safe_tables = jnp.clip(block_tables, 0, k_cache.shape[1] // block_size - 1)
-
-    def page_index(i, j, bt, cl):
-        # page steps beyond the live context re-map to the last live page
-        # (and, with a sliding window, steps below the band to the first
-        # live one): Pallas elides the DMA when consecutive grid steps
-        # hit the same block, so HBM traffic covers only the live span
-        # (the pl.when only skips compute)
-        last_live = jnp.maximum(cl[i] - 1, 0) // block_size
-        j_eff = jnp.minimum(j, last_live)
-        if window > 0:
-            first_live = jnp.maximum(cl[i] - window, 0) // block_size
-            j_eff = jnp.maximum(j_eff, first_live)
-        return bt[i, j_eff]
-
-    slopes = (
-        jnp.zeros(num_heads, jnp.float32)
-        if alibi_slopes is None
-        else alibi_slopes.astype(jnp.float32)
-    )
-    if variant == "folded":
-        grid_spec = pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=3,
-            grid=(b, max_blocks),
-            in_specs=[
-                pl.BlockSpec(
-                    (1, num_heads, head_dim),
-                    lambda i, j, bt, cl, al: (i, 0, 0),
-                ),
-                # one page across ALL kv heads: (Hkv, block_size, Dh)
-                # blocks of the [Hkv, num_slots, Dh] cache — trailing
-                # dims stay a legal (sublane, lane) tile per head
-                pl.BlockSpec(
-                    (num_kv, block_size, head_dim),
-                    lambda i, j, bt, cl, al: (
-                        0, page_index(i, j, bt, cl), 0
-                    ),
-                ),
-                pl.BlockSpec(
-                    (num_kv, block_size, head_dim),
-                    lambda i, j, bt, cl, al: (
-                        0, page_index(i, j, bt, cl), 0
-                    ),
-                ),
-            ],
-            out_specs=pl.BlockSpec(
-                (1, num_heads, head_dim),
-                lambda i, j, bt, cl, al: (i, 0, 0),
-            ),
-            scratch_shapes=[
-                pltpu.VMEM((num_heads, 1), jnp.float32),
-                pltpu.VMEM((num_heads, 1), jnp.float32),
-                pltpu.VMEM((num_heads, head_dim), jnp.float32),
-            ],
-        )
-        return pl.pallas_call(
-            functools.partial(
-                _decode_kernel_folded, scale=scale,
-                block_size=block_size, window=window,
-                use_alibi=alibi_slopes is not None, g_count=g,
-            ),
-            grid_spec=grid_spec,
-            out_shape=jax.ShapeDtypeStruct(
-                (b, num_heads, head_dim), q.dtype
-            ),
-            interpret=interpret,
-        )(safe_tables, context_lens, slopes, q, k_cache, v_cache)
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,
-        grid=(b, num_kv, max_blocks),
-        in_specs=[
-            pl.BlockSpec(
-                (1, 1, g, head_dim),
-                lambda i, h, j, bt, cl, al: (i, h, 0, 0),
-            ),
-            # page p of head h is block (h, p) of a (1, block_size, Dh)
-            # grid over the [Hkv, num_slots, Dh] cache — trailing dims
-            # (block_size, Dh) are a legal (sublane, lane) tile
-            pl.BlockSpec(
-                (1, block_size, head_dim),
-                lambda i, h, j, bt, cl, al: (
-                    h, page_index(i, j, bt, cl), 0
-                ),
-            ),
-            pl.BlockSpec(
-                (1, block_size, head_dim),
-                lambda i, h, j, bt, cl, al: (
-                    h, page_index(i, j, bt, cl), 0
-                ),
-            ),
-        ],
-        out_specs=pl.BlockSpec(
-            (1, 1, g, head_dim),
-            lambda i, h, j, bt, cl, al: (i, h, 0, 0),
-        ),
-        scratch_shapes=[
-            pltpu.VMEM((g, 1), jnp.float32),
-            pltpu.VMEM((g, 1), jnp.float32),
-            pltpu.VMEM((g, head_dim), jnp.float32),
-        ],
-    )
-    out = pl.pallas_call(
-        functools.partial(
-            _decode_kernel, scale=scale, block_size=block_size,
-            window=window, use_alibi=alibi_slopes is not None,
-            g_count=g,
-        ),
-        grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, num_kv, g, head_dim), q.dtype),
-        interpret=interpret,
-    )(safe_tables, context_lens, slopes, qg, k_cache, v_cache)
-    return out.reshape(b, num_heads, head_dim)
-
-
-# ------------------------------------------------------------ chunked prefill
-
-
 def _chunk_kernel(
     # scalar prefetch
     block_table_ref,  # [max_blocks] SMEM — this sequence's page table

@@ -71,6 +71,7 @@ KNOWN_SITES = (
     "scheduler.schedule",   # scheduler planning inside plan_step
     "runner.dispatch_decode",   # decode dispatch inside the runner
     "runner.dispatch_ragged",   # unified ragged dispatch
+    "runner.dispatch_verify",   # speculative verify dispatch (spec spans)
     #                             (--attention-backend=ragged)
     "runner.dispatch_prefill",  # prefill dispatch inside the runner
     "supervisor.rebuild",   # engine rebuild — death DURING recovery

@@ -535,7 +535,9 @@ class EngineSupervisor:
         )
         new._devices = old._devices  # noqa: SLF001
         if draft is not None:
-            new.runner.attach_speculative(*draft)
+            # engine-level attach: re-arms the scheduler's verify-span
+            # planning (spec_gamma) along with the runner's programs
+            new.attach_speculative(*draft)
         # the host KV tier SURVIVES the restart (it is host memory, not
         # part of the dead engine): the replacement adopts it, so warm
         # prefixes promote instead of recomputing — in-flight tickets

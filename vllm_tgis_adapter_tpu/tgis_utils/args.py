@@ -195,15 +195,16 @@ def add_engine_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         "round-trips); 1 disables multi-step decode")
     g.add_argument("--block-size", type=int, default=16,
                    help="KV-cache page size in tokens")
-    g.add_argument("--attention-backend", type=str, default="bucketed",
+    g.add_argument("--attention-backend", type=str, default="ragged",
                    choices=["bucketed", "ragged"],
                    help="serving data path (docs/ATTENTION.md): "
-                        "'bucketed' (default) pads prompts to prefill "
-                        "buckets and alternates prefill/decode "
-                        "dispatches; 'ragged' merges mixed "
-                        "prefill+decode token streams into one "
+                        "'ragged' (default, the only backend) merges "
+                        "mixed prefill+decode token streams — "
+                        "speculative verify spans included — into one "
                         "ragged-paged-attention dispatch with a single "
-                        "flat-length bucket and no per-prompt padding")
+                        "flat-length bucket and no per-prompt padding; "
+                        "'bucketed' is RETIRED and fails boot with a "
+                        "migration pointer")
     g.add_argument("--hbm-memory-utilization", "--gpu-memory-utilization",
                    dest="hbm_memory_utilization", type=float, default=0.90,
                    help="fraction of device memory budgeted for weights + KV "
