@@ -61,6 +61,13 @@ BENCH_RAG_OUTPUT shape the workload.  Stamps chat ITL p50/p99 under
 the RAG load, handoff outcomes, and a greedy outputs digest that must
 match across modes (handoff token identity; perf_check `disagg` gate).
 
+Quantization knobs (docs/QUANTIZATION.md): BENCH_QUANTIZATION=int8
+(weight-only --quantization path; BENCH_QUANT=1 is the historical
+spelling) and BENCH_KV_QUANT=int8|fp8 (--kv-quantization KV pages);
+both stamp weight_resident_bytes / kv_page_capacity_blocks so the two
+HBM savings compose measurably.  The token-QUALITY side of KV
+quantization is gated by tools/scenarios.py, not here.
+
 Env knobs: BENCH_TINY=1 (CI smoke on CPU), BENCH_REQUESTS, BENCH_PROMPT,
 BENCH_OUTPUT, BENCH_BATCH, BENCH_STEPS, BENCH_PROBE_TIMEOUT (s),
 BENCH_TPU_TIMEOUT (s, whole TPU run incl. compiles), BENCH_FORCE_CPU=1,
@@ -419,6 +426,23 @@ def run_bench(on_tpu: bool) -> dict:
     # run of the same decode-heavy workload
     spec_mode = os.environ.get("BENCH_SPEC", "") == "1"
     spec_gamma = int(os.environ.get("BENCH_SPEC_GAMMA", "4"))
+    # weight quantization (docs/QUANTIZATION.md): BENCH_QUANTIZATION
+    # names the --quantization scheme (int8 = native weight-only);
+    # BENCH_QUANT=1 is the historical spelling of int8.  The run stamps
+    # weight_resident_bytes so the HBM saving composes measurably with
+    # BENCH_KV_QUANT (the --kv-quantization scheme for KV pages).
+    weight_quant = os.environ.get("BENCH_QUANTIZATION", "") or (
+        "int8" if os.environ.get("BENCH_QUANT", "") == "1" else ""
+    )
+    if weight_quant not in ("", "int8"):
+        # truthful stamps: only the native weight-only scheme runs in
+        # bench (awq/gptq are load-time checkpoint formats, fp8 weights
+        # do not exist) — anything else would silently measure int8
+        raise SystemExit(
+            f"BENCH_QUANTIZATION={weight_quant!r} is not benchable; "
+            "only 'int8' (native weight-only) is supported here"
+        )
+    kv_quant_scheme = os.environ.get("BENCH_KV_QUANT", "") or "none"
     if roles_mode:
         n_requests = chat_n + rag_n
         prompt_len = rag_prompt_len
@@ -449,7 +473,8 @@ def run_bench(on_tpu: bool) -> dict:
         cache_config=CacheConfig(block_size=block_size,
                                  num_blocks=blocks_needed,
                                  cache_dtype=dtype,
-                                 enable_prefix_caching=prefix_reuse),
+                                 enable_prefix_caching=prefix_reuse,
+                                 kv_quantization=kv_quant_scheme),
         kv_host_cache_gb=(
             kv_host_gb if (prefix_reuse or roles_mode) else 0.0
         ),
@@ -502,11 +527,7 @@ def run_bench(on_tpu: bool) -> dict:
             if spec_mode
             else None
         ),
-        quantization=(
-            "int8"
-            if dp > 1 and os.environ.get("BENCH_QUANT", "") == "1"
-            else None
-        ),
+        quantization=("int8" if dp > 1 and weight_quant else None),
     )
 
     from vllm_tgis_adapter_tpu.engine.async_llm import AsyncLLMEngine
@@ -522,7 +543,7 @@ def run_bench(on_tpu: bool) -> dict:
     else:
         model = LlamaForCausalLM(mcfg)
         params = model.init_params(jax.random.PRNGKey(0))
-        if os.environ.get("BENCH_QUANT", "") == "1":
+        if weight_quant:
             # weight-only int8 variant: decode is HBM-bandwidth-bound,
             # so the ~2x smaller projection weights should lift tok/s
             # on chip
@@ -620,6 +641,14 @@ def run_bench(on_tpu: bool) -> dict:
         )
         return lora_requests.get(name)
 
+    # resident parameter bytes (post-quantization): the HBM the weights
+    # actually hold — BENCH_QUANTIZATION's saving reads directly off
+    # this stamp, and it composes with the KV-side capacity stamp
+    weight_resident_bytes = sum(
+        int(x.nbytes)
+        for x in jax.tree_util.tree_leaves(params)
+        if hasattr(x, "nbytes")
+    )
     # matmul weight elements -> decode FLOPs/token (2*N MACs) for MFU
     matmul_elems = sum(
         int(np.prod(x.shape))
@@ -939,6 +968,12 @@ def run_bench(on_tpu: bool) -> dict:
             else {}
         ),
         "quantization": quantization,
+        # weight + KV quantization stamps (docs/QUANTIZATION.md): the
+        # perf_check `quant` section floors the weight-quantized run
+        # and compares resident bytes against the full-precision run
+        "weight_resident_bytes": weight_resident_bytes,
+        "kv_quantization": kv_quant_scheme,
+        "kv_page_capacity_blocks": blocks_needed,
         "ttft_ms_p50": pct(0.50),
         "ttft_ms_p99": pct(0.99),
         # prefix-reuse scenario stamps (docs/KV_TIERING.md): warm-vs-

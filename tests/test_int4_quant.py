@@ -75,6 +75,37 @@ def test_gptq_pack_dequant_roundtrip_and_act_order():
     np.testing.assert_allclose(w2, expect2, rtol=1e-6)
 
 
+def test_gptq_g_idx_remap_across_dequant_chunks(monkeypatch):
+    """The ``desc_act=True`` row→group remap must hold when the input
+    dim spans MULTIPLE dequant slabs (``_DEQUANT_CHUNK_ROWS``): each
+    chunk slices ``g_idx[r0:r1]`` and gathers z/s rows by group — an
+    off-by-a-chunk there reads the wrong group's scale for every
+    act-order row past the first slab.  The suite's other g_idx
+    coverage runs single-slab (32 rows << 4096) or compares the engine
+    against its own dequant, so this is the one branch nothing else
+    exercises independently."""
+    from vllm_tgis_adapter_tpu.engine import quantized
+    from tests.fixture_models import _pack_int32_nibbles
+
+    rng = np.random.default_rng(7)
+    in_f, out_f, group = 64, 16, 8
+    q, z, s = _random_qzs(rng, in_f, out_f, group)
+    qweight = _pack_int32_nibbles(q, axis=0)
+    qzeros = _pack_int32_nibbles(z - 1, axis=1)
+    # act-order permutation crossing the (patched) 8-row slab boundary:
+    # consecutive rows land in far-apart groups
+    g_idx = rng.permutation(np.repeat(np.arange(in_f // group), group))
+
+    whole = dequantize_gptq(qweight, qzeros, s, group, g_idx=g_idx)
+    monkeypatch.setattr(quantized, "_DEQUANT_CHUNK_ROWS", 8)
+    chunked = quantized.dequantize_gptq(
+        qweight, qzeros, s, group, g_idx=g_idx
+    )
+    expect = (q - z[g_idx]) * s[g_idx]
+    np.testing.assert_allclose(chunked, expect, rtol=1e-6)
+    np.testing.assert_array_equal(chunked, whole)
+
+
 def _prefill_logits(model_dir, token_ids):
     import jax
     import jax.numpy as jnp

@@ -6,8 +6,14 @@ proves the *surface*: a SEEDED schedule draws faults (``raise`` /
 chat/RAG/LoRA workload runs against a supervised engine with the host
 KV tier on (some seeds dp=2; some of THOSE run a disaggregated
 prefill+decode fleet and always arm the kill-prefill-replica-
-mid-handoff fault — docs/SCALING.md "Disaggregated roles"), then
-asserts the global invariants no single scenario can
+mid-handoff fault — docs/SCALING.md "Disaggregated roles"; a fixed
+rotation of seeds serves with --kv-quantization int8/fp8, proving
+checkpoint/resume and cross-replica migration token-stable under
+QUANTIZED KV pages — docs/QUANTIZATION.md).  The closed-loop engine
+this harness drives (fixtures, engine build, request driving, seeded
+workloads) lives in tools/scenarios.py — the steady-state suites and
+this soak share one workload engine.  Asserted here are the global
+invariants no single scenario can
 (docs/RECOVERY.md "Randomized chaos soak"):
 
 * every submitted request reaches EXACTLY ONE terminal outcome — a
@@ -48,6 +54,13 @@ sys.path.insert(0, str(REPO_ROOT))
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
+from tools.scenarios import (  # noqa: E402 — after sys.path insert
+    build_engine,
+    build_fixtures,
+    make_mixed_workload,
+    run_request,
+)
+
 DEFAULT_SEEDS = 5
 DEFAULT_BASE_SEED = 20260804
 #: nothing — request, recovery, or drain — may outlive this (the soak's
@@ -57,8 +70,14 @@ HARNESS_BOUND_S = 60.0
 BUDGET_S = 120.0
 
 REQUESTS_PER_SEED = 8
-#: the shared "system prompt" RAG requests reuse (tiers + prefix paths)
-RAG_PREFIX = list(range(400, 424))
+
+#: deterministic --kv-quantization rotation per seed (seed % 3): does
+#: not perturb the rng draw sequence of pre-existing schedules, and
+#: the default 5-seed CI run always covers int8 AND fp8 — every fault,
+#: checkpoint/resume and cross-replica migration in those schedules
+#: then runs over quantized pages + scale sidecars, with the
+#: token-identity invariant held against the SAME-engine baseline
+KV_QUANT_ROTATION = ("none", "int8", "fp8")
 
 # (site, action) pool the schedule draws from.  ``hang`` is listed once
 # and only used at dp=1 seeds (the watchdog declares the stall and the
@@ -86,137 +105,24 @@ FAULTS = (
 )
 
 
-def _build_fixtures() -> tuple[str, str]:
-    """Tiny llama + one live LoRA adapter, built once per process."""
-    from tests.fixture_models import (
-        build_tiny_llama,
-        build_tiny_lora_adapter,
-    )
-
-    model_dir = tempfile.mkdtemp(prefix="chaos-soak-model-")
-    build_tiny_llama(model_dir)
-    adapter_dir = build_tiny_lora_adapter(
-        os.path.join(model_dir, "ad-soak"), seed=11, rank=2
-    )
-    return model_dir, adapter_dir
+# fixture build, engine construction, seeded workloads and request
+# driving were PROMOTED into tools/scenarios.py (the steady-state suite
+# engine); the soak keeps only the chaos schedule and its invariants
+_build_fixtures = build_fixtures
+_run_request = run_request
 
 
 def _build_engine(model_dir: str, *, dp: int, watchdog: bool,
-                  roles: tuple = (), spec: bool = False):
-    from vllm_tgis_adapter_tpu.engine.async_llm import AsyncLLMEngine
-    from vllm_tgis_adapter_tpu.engine.config import (
-        CacheConfig,
-        EngineConfig,
-        FrontdoorConfig,
-        LoRAConfig,
-        ModelConfig,
-        ParallelConfig,
-        SchedulerConfig,
-        SpeculativeConfig,
+                  roles: tuple = (), spec: bool = False,
+                  kv_quantization: str = "none"):
+    return build_engine(
+        model_dir, dp=dp, watchdog=watchdog, roles=roles, spec=spec,
+        kv_quantization=kv_quantization,
     )
-
-    mcfg = ModelConfig.from_pretrained(model_dir, dtype="float32")
-    config = EngineConfig(
-        model_config=mcfg,
-        cache_config=CacheConfig(
-            block_size=16, num_blocks=96, cache_dtype=mcfg.dtype,
-            enable_prefix_caching=True,
-        ),
-        scheduler_config=SchedulerConfig(
-            max_num_seqs=4, prefill_buckets=(32, 64)
-        ),
-        parallel_config=ParallelConfig(dp_replicas=dp),
-        lora_config=LoRAConfig(enabled=True, max_loras=2,
-                               max_lora_rank=2),
-        # prefill/decode disaggregation seeds (docs/SCALING.md): some
-        # dp=2 schedules run a prefill+decode split, exercising the
-        # handoff path (and the kill-prefill-replica-mid-handoff fault)
-        # under the same invariants
-        dp_replica_roles=tuple(roles),
-        kv_host_cache_gb=1.0,
-        max_engine_restarts=20,
-        engine_restart_window_s=300.0,
-        engine_restart_backoff_s=0.01,
-        # the in-engine stall watchdog is the hang schedule's detector
-        watchdog_deadline_s=1.0 if watchdog else 0.0,
-        watchdog_action="restart",
-        frontdoor=FrontdoorConfig(enabled=True),
-        # speculative seeds (docs/ATTENTION.md): a same-weights draft —
-        # greedy requests ride verify spans, the mid-verify fault has a
-        # live site, and every recovery must re-attach the draft
-        speculative=(
-            SpeculativeConfig(
-                draft_model=model_dir,
-                num_speculative_tokens=3,
-                draft_model_config=mcfg,
-            )
-            if spec
-            else None
-        ),
-    )
-    return AsyncLLMEngine.from_config(config)
 
 
 def _make_workload(rng: random.Random) -> list[dict]:
-    """REQUESTS_PER_SEED request specs: chat (unique prompts), RAG
-    (shared prefix + unique tail), LoRA-tagged — greedy and
-    seeded-sampled mixed in."""
-    specs = []
-    for i in range(REQUESTS_PER_SEED):
-        kind = ("chat", "rag", "lora")[i % 3]
-        if kind == "rag":
-            prompt = RAG_PREFIX + [
-                rng.randrange(3, 300)
-                for _ in range(rng.randint(4, 12))
-            ]
-        else:
-            prompt = [
-                rng.randrange(3, 300)
-                for _ in range(rng.randint(6, 20))
-            ]
-        sampled = rng.random() < 0.34
-        specs.append({
-            "kind": kind,
-            "prompt": prompt,
-            "max_tokens": rng.randint(8, 24),
-            "temperature": 0.9 if sampled else 0.0,
-            "seed": rng.randrange(1, 2**31) if sampled else None,
-        })
-    return specs
-
-
-def _params(spec: dict):
-    from vllm_tgis_adapter_tpu.engine.sampling_params import (
-        RequestOutputKind,
-        SamplingParams,
-    )
-
-    return SamplingParams(
-        temperature=spec["temperature"],
-        seed=spec["seed"],
-        max_tokens=spec["max_tokens"],
-        ignore_eos=True,
-        output_kind=RequestOutputKind.DELTA,
-    )
-
-
-async def _run_request(engine, rid: str, spec: dict, lora_req):
-    """One DELTA stream to its terminal outcome.  Returns
-    ``("ok", [every streamed token, in order])`` or ``("err", exc)`` —
-    exactly one of the two, exactly once."""
-    toks: list[int] = []
-    try:
-        async for out in engine.generate(
-            prompt=None,
-            sampling_params=_params(spec),
-            request_id=rid,
-            prompt_token_ids=list(spec["prompt"]),
-            lora_request=lora_req if spec["kind"] == "lora" else None,
-        ):
-            toks.extend(out.outputs[0].token_ids)
-        return ("ok", toks)
-    except BaseException as e:  # noqa: BLE001 — the outcome IS the result
-        return ("err", e)
+    return make_mixed_workload(rng, REQUESTS_PER_SEED)
 
 
 async def _wait_serving(engine, what: str, bound: float) -> None:
@@ -255,8 +161,17 @@ async def _run_seed(seed: int, model_dir: str, adapter_dir: str) -> dict:
     # ones stay on plain spans in the SAME dispatches) — composed with
     # dp, roles and every fault in the pool
     spec_on = rng.random() < 0.6
+    # quantized-KV seeds: a fixed seed-keyed rotation (not an rng draw,
+    # so existing schedules keep their exact fault sequence) serves
+    # some schedules with int8/fp8 KV pages — checkpoints, resumes,
+    # cross-replica migration and role handoffs then move quantized
+    # pages + scale sidecars, and the token-identity invariant (vs the
+    # same engine's uncrashed baseline) proves the page scale
+    # discipline reproducible across every recompute path
+    kvq = KV_QUANT_ROTATION[seed % len(KV_QUANT_ROTATION)]
     engine = _build_engine(
-        model_dir, dp=dp, watchdog=(dp == 1), roles=roles, spec=spec_on
+        model_dir, dp=dp, watchdog=(dp == 1), roles=roles, spec=spec_on,
+        kv_quantization=kvq,
     )
     hang_released: list[str] = []
     try:
@@ -423,6 +338,7 @@ async def _run_seed(seed: int, model_dir: str, adapter_dir: str) -> dict:
             "seed": seed,
             "dp": dp,
             "roles": list(roles) or None,
+            "kv_quantization": kvq,
             "requests": len(specs),
             "ok": ok,
             "retryable": retryable,
@@ -593,6 +509,7 @@ def main(argv: list[str] | None = None) -> int:
         )
         print(
             f"chaos_soak: seed {stats['seed']} ok  dp={stats['dp']} "
+            f"kvq={stats['kv_quantization']} "
             f"requests={stats['requests']} "
             f"(ok={stats['ok']} retryable={stats['retryable']}) "
             f"faults=[{', '.join(stats['faults'])}] "

@@ -93,6 +93,7 @@ def measure(backend: str, runs: int, env_overrides: dict) -> dict:
         "aggregate_output_tok_per_s": best["value"],
         "padding_waste_frac": best["padding_waste_frac"],
         "compiled_shapes": best["compiled_shapes"],
+        "weight_resident_bytes": best.get("weight_resident_bytes"),
     }
 
 
@@ -306,6 +307,78 @@ def measure_recovery(rec_cfg: dict, runs: int) -> dict:
     return best
 
 
+def measure_quant(q_cfg: dict, runs: int) -> tuple[dict, dict | None]:
+    """ISSUE 13 gate driver (docs/QUANTIZATION.md): the steady-state
+    scenario suites (tools/scenarios.py --quant-gate) run bf16-KV vs
+    --kv-quantization at an EQUAL synthetic HBM budget — per-scenario
+    tok/s + logprob deltas + the analytic page-capacity ratio — plus
+    the weight-only BENCH_QUANTIZATION bench line.  Best of ``runs`` =
+    highest chat tok/s ratio (a ratio gate; the quality deltas are
+    near-deterministic, so the same run serves them)."""
+    scheme = q_cfg.get("scheme", "int8")
+    best = None
+    for _ in range(max(1, runs)):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        proc = subprocess.run(
+            [
+                sys.executable,
+                str(REPO_ROOT / "tools" / "scenarios.py"),
+                "--quant-gate", "--scheme", scheme,
+            ],
+            capture_output=True, text=True, timeout=900, env=env,
+        )
+        line = None
+        for candidate in reversed(proc.stdout.strip().splitlines()):
+            try:
+                parsed = json.loads(candidate)
+            except ValueError:
+                continue
+            if isinstance(parsed, dict) and parsed.get("kind") == "quant":
+                line = parsed
+                break
+        if proc.returncode != 0 or line is None:
+            raise RuntimeError(
+                f"scenarios --quant-gate failed rc={proc.returncode}: "
+                f"{proc.stderr[-400:]}"
+            )
+        if (
+            best is None
+            or line["scenarios"]["chat"]["tok_per_s_ratio"]
+            > best["scenarios"]["chat"]["tok_per_s_ratio"]
+        ):
+            best = line
+    weight_line = None
+    weights_env = q_cfg.get("weights_env")
+    if weights_env:
+        for _ in range(max(1, runs)):
+            line = run_bench(
+                q_cfg.get("backend", "ragged"), dict(weights_env)
+            )
+            if weight_line is None or line["value"] > weight_line["value"]:
+                weight_line = line
+    cap = best["capacity"]
+    chat = best["scenarios"]["chat"]
+    print(
+        f"perf_check: quant    {scheme} capacity "
+        f"{cap['bf16_blocks']}→{cap['quant_blocks']} pages "
+        f"({cap['ratio']}x), chat tok/s "
+        f"{chat['bf16_tok_per_s']}→{chat['quant_tok_per_s']} "
+        f"({chat['tok_per_s_ratio']}x), logprob deltas "
+        + ", ".join(
+            f"{s}={line['mean_abs_logprob_delta']}"
+            for s, line in best["scenarios"].items()
+        )
+        + (
+            f", weights {weight_line['value']:.1f} tok/s "
+            f"@ {weight_line['weight_resident_bytes']}B"
+            if weight_line is not None
+            else ""
+        )
+    )
+    return best, weight_line
+
+
 def main(argv: list[str] | None = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     write = "--write" in argv
@@ -401,6 +474,18 @@ def main(argv: list[str] | None = None) -> int:
             print(f"perf_check: recovery measurement failed: {exc}")
             return 2
 
+    q_cfg = baseline.get("quant")
+    q_line: dict | None = None
+    q_weight_line: dict | None = None
+    if q_cfg:
+        try:
+            q_line, q_weight_line = measure_quant(
+                q_cfg, int(q_cfg.get("runs", 1))
+            )
+        except Exception as exc:  # noqa: BLE001 — tool boundary
+            print(f"perf_check: quant measurement failed: {exc}")
+            return 2
+
     if write:
         out = {
             "_comment": (
@@ -455,6 +540,20 @@ def main(argv: list[str] | None = None) -> int:
             # declarative: the ≥1.5x spec/plain chat-ITL speedup and
             # ≥0.6 acceptance are the ISSUE 12 acceptance criteria
             out["spec"] = dict(spec_cfg)
+        if q_cfg:
+            # declarative (capacity/speedup/quality bounds are the
+            # ISSUE 13 acceptance criteria); only the weight-path
+            # tok/s floor is measured, refreshed at the ~70% haircut
+            out["quant"] = {
+                **q_cfg,
+                **(
+                    {"min_weight_tok_per_s": round(
+                        q_weight_line["value"] * 0.7, 1
+                    )}
+                    if q_weight_line is not None
+                    else {}
+                ),
+            }
         if dp_cfg:
             out["dp"] = {
                 **dp_cfg,
@@ -683,6 +782,84 @@ def main(argv: list[str] | None = None) -> int:
                 "recovery: the mid-decode request was not resumed "
                 "(fallback ladder taken — gate measured nothing)"
             )
+
+    if q_cfg and q_line is not None:
+        # ISSUE 13 acceptance (docs/QUANTIZATION.md): KV-page capacity
+        # ≥ min_capacity_ratio x bf16 at equal HBM, per-scenario
+        # logprob deltas bounded (token quality IS the gate — greedy
+        # identity cannot police a numerics-changing optimization),
+        # chat-suite tok/s ≥ min_chat_speedup with the device pool
+        # capped below the working set, and the weight-only int8 path
+        # floored with its resident-bytes saving demonstrated
+        cap_ratio = q_line["capacity"]["ratio"]
+        min_cap = float(q_cfg.get("min_capacity_ratio", 1.9))
+        if cap_ratio < min_cap:
+            failures.append(
+                f"quant: KV-page capacity {cap_ratio}x bf16 at equal "
+                f"HBM < required {min_cap}x "
+                f"({q_line['capacity']['bf16_blocks']} → "
+                f"{q_line['capacity']['quant_blocks']} pages)"
+            )
+        max_deltas = q_cfg.get("max_logprob_delta", {})
+        min_match = q_cfg.get("min_token_match", {})
+        for suite, line in q_line["scenarios"].items():
+            bound = float(max_deltas.get(suite, 0.05))
+            delta = line.get("mean_abs_logprob_delta")
+            if delta is None:
+                failures.append(
+                    f"quant/{suite}: no logprob deltas measured "
+                    "(quality gate measured nothing)"
+                )
+            elif delta > bound:
+                failures.append(
+                    f"quant/{suite}: mean |Δlogprob| {delta} > bound "
+                    f"{bound} (quantized KV is perturbing token "
+                    "quality beyond the per-scenario budget)"
+                )
+            floor = float(
+                min_match.get(suite, 0.3)
+                if isinstance(min_match, dict)
+                else min_match
+            )
+            if line.get("token_match_frac", 0.0) < floor:
+                failures.append(
+                    f"quant/{suite}: token_match_frac "
+                    f"{line.get('token_match_frac')} < required {floor}"
+                )
+        chat = q_line["scenarios"]["chat"]
+        min_speed = float(q_cfg.get("min_chat_speedup", 1.3))
+        if chat["tok_per_s_ratio"] < min_speed:
+            failures.append(
+                f"quant: chat-suite tok/s ratio "
+                f"{chat['tok_per_s_ratio']}x bf16 < required "
+                f"{min_speed}x at equal HBM "
+                f"({chat['bf16_tok_per_s']} vs "
+                f"{chat['quant_tok_per_s']} tok/s — the 2x page pool "
+                "stopped buying batch occupancy)"
+            )
+        if q_weight_line is not None:
+            floor = float(q_cfg.get("min_weight_tok_per_s", 0.0))
+            if q_weight_line["value"] < floor:
+                failures.append(
+                    f"quant/weights: {q_weight_line['value']:.1f} "
+                    f"tok/s < floor {floor:.1f}"
+                )
+            base_bytes = (
+                measured.get("ragged", {}).get("weight_resident_bytes")
+            )
+            max_ratio = float(q_cfg.get("max_weight_bytes_ratio", 0.75))
+            if base_bytes:
+                ratio = (
+                    q_weight_line["weight_resident_bytes"] / base_bytes
+                )
+                if ratio > max_ratio:
+                    failures.append(
+                        f"quant/weights: resident bytes "
+                        f"{q_weight_line['weight_resident_bytes']} are "
+                        f"{ratio:.2f}x the full-precision run "
+                        f"({base_bytes}) > allowed {max_ratio}x — "
+                        "int8 weight quantization stopped saving HBM"
+                    )
 
     if failures:
         print("perf_check: REGRESSION")

@@ -66,6 +66,14 @@ JIT_REGISTRY: dict[str, frozenset[str]] = {
         # (runner._build_ragged_verify_fn, track_jit "ragged_verify")
         "LlamaForCausalLM.ragged_forward",
     }),
+    # per-page quantize/dequantize movement ops (ops/kv_quant.py):
+    # jitted from engine/runner.py as track_jit "gather_kv" /
+    # "scatter_kv" — the host-tier / checkpoint / handoff page path,
+    # one fixed block shape each, quantized caches included
+    "ops/kv_quant.py": frozenset({
+        "gather_kv_page",
+        "restore_kv_page",
+    }),
 }
 
 #: registry-method params that are static at every jit site (bound via

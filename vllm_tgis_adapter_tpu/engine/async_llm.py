@@ -1095,6 +1095,13 @@ class AsyncLLMEngine:
             if tier is not None:
                 metrics.kv_host_tier_bytes.set(tier.bytes_used)
             for rep in self._replicas:
+                # page capacity labeled by the page storage dtype: the
+                # --kv-quantization capacity lift reads directly off
+                # this gauge (docs/QUANTIZATION.md)
+                ccfg = rep.engine.config.cache_config
+                metrics.kv_page_capacity_blocks.labels(
+                    dtype=ccfg.kv_dtype_label(), replica=str(rep.index)
+                ).set(rep.engine.scheduler.allocator.num_blocks)
                 pool = getattr(rep.engine.runner, "adapter_pool", None)
                 if pool is not None:
                     metrics.lora_adapters_resident.labels(

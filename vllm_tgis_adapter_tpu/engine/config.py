@@ -720,6 +720,24 @@ class CacheConfig:
     # content-addressed reuse of full prompt pages across requests
     # (engine/kv_cache.py BlockAllocator prefix caching)
     enable_prefix_caching: bool = False
+    # --kv-quantization {none,int8,fp8}: KV pages stored quantized with
+    # per-page-per-head scales, dequantized at the page read
+    # (ops/kv_quant.py, docs/QUANTIZATION.md).  "none" (default) is
+    # byte-identical to the unquantized engine; int8/fp8 roughly double
+    # KV-page capacity at equal HBM.  Subsumes the raw-cast
+    # --kv-cache-dtype fp8/int8 spellings (tgis_utils/args.py).
+    kv_quantization: str = "none"
+
+    def kv_dtype_label(self) -> str:
+        """Metrics label for kv_page_capacity_blocks{dtype=...}."""
+        if self.kv_quantization != "none":
+            return self.kv_quantization
+        import numpy as _np
+
+        try:
+            return str(jnp.dtype(self.cache_dtype).name)
+        except Exception:  # pragma: no cover — exotic dtype objects
+            return str(_np.dtype(self.cache_dtype))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -1085,6 +1103,50 @@ class EngineConfig:
                 "per-channel), 'awq'/'gptq' (int4 checkpoint, "
                 "dequant-on-load)"
             )
+        kvq = self.cache_config.kv_quantization
+        if kvq not in ("none", "int8", "fp8"):
+            raise ValueError(
+                f"--kv-quantization must be one of none/int8/fp8 "
+                f"(got {kvq!r}); see docs/QUANTIZATION.md"
+            )
+        if kvq != "none":
+            # truthful flags: refuse every combo the quantized page
+            # lifecycle does not implement, at boot — not as a trace
+            # failure three layers down (docs/QUANTIZATION.md "Flags")
+            if kvq == "fp8" and not hasattr(jnp, "float8_e4m3fn"):
+                raise ValueError(
+                    "--kv-quantization fp8 needs jax.numpy."
+                    "float8_e4m3fn, which this JAX build lacks; use "
+                    "int8 or upgrade JAX"
+                )
+            if self.parallel_config.pipeline_parallel_size > 1:
+                raise ValueError(
+                    "--kv-quantization does not compose with "
+                    "--pipeline-parallel-size > 1 yet (the staged "
+                    "runner has no quantized-cache plumbing); drop one "
+                    "flag"
+                )
+            if self.parallel_config.sequence_parallel_size > 1:
+                raise ValueError(
+                    "--kv-quantization does not compose with "
+                    "--sequence-parallel-size > 1 yet (ring/ulysses "
+                    "attention reads dense K/V, not quantized pages); "
+                    "drop one flag"
+                )
+            if self.swap_space_gib > 0:
+                raise ValueError(
+                    "--kv-quantization does not compose with "
+                    "--swap-space (the per-sequence swap copy predates "
+                    "the scale sidecar); use the host KV tier "
+                    "(--kv-host-cache-gb), which carries quantized "
+                    "pages natively"
+                )
+            if "float8" in str(self.cache_config.cache_dtype):
+                raise ValueError(
+                    "--kv-cache-dtype fp8 and --kv-quantization both "
+                    "set: the raw-cast dtype path is subsumed by "
+                    "--kv-quantization fp8 — drop --kv-cache-dtype"
+                )
         ckpt_quant = self.model_config.checkpoint_quant
         if self.quantization in ("awq", "gptq") and (
             self.quantization != ckpt_quant
@@ -1205,9 +1267,16 @@ class EngineConfig:
         import numpy as _np
 
         mcfg = self.model_config
+        # quantized pages store 1-byte values (ops/kv_quant.py); the
+        # per-page scale sidecar is noise at this warning's granularity
+        itemsize = (
+            1
+            if self.cache_config.kv_quantization != "none"
+            else _np.dtype(self.cache_config.cache_dtype).itemsize
+        )
         per_token = (
             2 * mcfg.num_layers * mcfg.num_kv_heads * mcfg.head_dim
-            * _np.dtype(self.cache_config.cache_dtype).itemsize
+            * itemsize
         )
         worst = per_token * self.max_model_len
         budget = self.kv_host_cache_gb * (1 << 30)
@@ -1266,6 +1335,51 @@ class EngineConfig:
         buckets = tuple(
             b for b in SchedulerConfig.prefill_buckets if b < max_len
         ) + (max_len,)
+        # --kv-cache-dtype folds into the --kv-quantization validation
+        # (docs/QUANTIZATION.md "Flags"): the old path resolved ANY
+        # dtype string and handed it straight to make_kv_caches — a
+        # float8 raw cast with no scales and no kernel-support check,
+        # failing as a downstream trace error.  Quantized spellings now
+        # route to the real quantized-page path; everything else must
+        # be a dtype the kernels actually serve.
+        kvq = (
+            getattr(args, "kv_quantization", "none") or "none"
+        ).lower()
+        kcd = str(args.kv_cache_dtype or "auto").lower()
+        _KCD_QUANT = {
+            "float8_e4m3": "fp8", "float8_e4m3fn": "fp8", "fp8": "fp8",
+            "int8": "int8",
+        }
+        if kcd in _KCD_QUANT:
+            mapped = _KCD_QUANT[kcd]
+            if kvq not in ("none", mapped):
+                raise ValueError(
+                    f"--kv-cache-dtype {args.kv_cache_dtype} conflicts "
+                    f"with --kv-quantization {kvq}; drop "
+                    "--kv-cache-dtype (it is subsumed — "
+                    "docs/QUANTIZATION.md)"
+                )
+            _logger.warning(
+                "--kv-cache-dtype %s is subsumed by --kv-quantization "
+                "%s: serving the scaled quantized-page path, not a raw "
+                "dtype cast (docs/QUANTIZATION.md)",
+                args.kv_cache_dtype, mapped,
+            )
+            kvq = mapped
+            cache_dtype = model_config.dtype
+        elif kcd == "auto":
+            cache_dtype = model_config.dtype
+        elif kcd in ("bfloat16", "float16", "float32"):
+            cache_dtype = resolve_dtype(kcd)
+        else:
+            raise ValueError(
+                f"--kv-cache-dtype {args.kv_cache_dtype!r} is not a "
+                "KV layout the kernels serve: use auto/bfloat16/"
+                "float16/float32 for full-precision pages, or "
+                "--kv-quantization int8|fp8 (spellings fp8/int8/"
+                "float8_e4m3 here map to it) for quantized pages "
+                "(docs/QUANTIZATION.md)"
+            )
         return EngineConfig(
             model_config=model_config,
             cache_config=CacheConfig(
@@ -1274,11 +1388,8 @@ class EngineConfig:
                 enable_prefix_caching=getattr(
                     args, "enable_prefix_caching", False
                 ),
-                cache_dtype=(
-                    model_config.dtype
-                    if args.kv_cache_dtype == "auto"
-                    else resolve_dtype(args.kv_cache_dtype)
-                ),
+                cache_dtype=cache_dtype,
+                kv_quantization=kvq,
             ),
             scheduler_config=SchedulerConfig(
                 max_num_seqs=args.max_num_seqs,

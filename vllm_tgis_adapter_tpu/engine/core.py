@@ -775,10 +775,15 @@ class LLMEngine:
             if tier.has(digests[p]) or seq.blocks.blocks[p] < 0:
                 continue
             start = p * bs
-            k_dev, v_dev = self.runner.gather_kv_block(
-                seq.blocks.slots_for_range(start, start + bs)
-            )
-            batch.append((digests[p], k_dev, v_dev))
+            # the gathered tuple is (k, v) — plus the per-head scale
+            # columns under --kv-quantization — stored verbatim so the
+            # eventual restore is bit-exact
+            batch.append((
+                digests[p],
+                *self.runner.gather_kv_block(
+                    seq.blocks.slots_for_range(start, start + bs)
+                ),
+            ))
         if not batch:
             return 0
         tier.submit(batch)
@@ -815,10 +820,12 @@ class LLMEngine:
         if tier is None or tier.has(digest):
             return
         bs = self.config.cache_config.block_size
-        k_dev, v_dev = self.runner.gather_kv_block(
-            list(range(block * bs, (block + 1) * bs))
-        )
-        tier.submit([(digest, k_dev, v_dev)])
+        tier.submit([(
+            digest,
+            *self.runner.gather_kv_block(
+                list(range(block * bs, (block + 1) * bs))
+            ),
+        )])
         self.recorder.record(
             "demote_host", step=self.step_counter, pages=1, block=block,
         )
@@ -963,11 +970,11 @@ class LLMEngine:
             if not self.scheduler._free_slots:  # noqa: SLF001
                 rest.append((seq, ticket))  # retry next boundary
                 continue
-            for i, (k_dev, v_dev) in enumerate(ticket.pages):
+            for i, arrays in enumerate(ticket.pages):
                 pos = ticket.start_tokens + i * bs
                 self.runner.restore_kv_block(
                     seq.blocks.slots_for_range(pos, pos + bs),
-                    k_dev, v_dev,
+                    *arrays,
                 )
             seq.slot = self.scheduler._free_slots.pop()  # noqa: SLF001
             seq.prefill_pos = ticket.end_tokens

@@ -46,6 +46,60 @@ def _lora_stack_bytes(config: "EngineConfig") -> int:
     return elems * 4
 
 
+def per_block_bytes(config: "EngineConfig") -> int:
+    """Per-device bytes ONE page costs (both caches, target + draft).
+
+    Quantization-aware (docs/QUANTIZATION.md): with ``--kv-quantization``
+    the K/V payload shrinks to the storage dtype's itemsize (1 byte for
+    int8/fp8) and the per-page-per-head f32 scale sidecar
+    (ops/kv_quant.py) is added — ~2x pages per HBM budget at the usual
+    ``block_size * head_dim`` tile sizes.  This is the single pricing
+    formula the allocator, the perf gate's capacity check and the bench
+    stamps all share.
+    """
+    import jax.numpy as jnp
+
+    from vllm_tgis_adapter_tpu.ops import kv_quant
+
+    ccfg = config.cache_config
+    tp = config.parallel_config.tensor_parallel_size or 1
+    qdtype = kv_quant.storage_dtype(ccfg.kv_quantization)
+    itemsize = jnp.dtype(
+        ccfg.cache_dtype if qdtype is None else qdtype
+    ).itemsize
+
+    def one_model(m) -> int:  # noqa: ANN001
+        kv_heads_per_dev = max(1, m.num_kv_heads // tp)
+        payload = (
+            2 * m.num_layers * ccfg.block_size
+            * kv_heads_per_dev * m.head_dim * itemsize
+        )
+        if qdtype is not None:
+            payload += kv_quant.scale_bytes_per_page(
+                m.num_layers, kv_heads_per_dev
+            )
+        return payload
+
+    block_bytes = one_model(config.model_config)
+    if config.speculative is not None:
+        # the draft model keeps a parallel paged cache with the same slot
+        # geometry (engine/speculative.py) — its pages share the budget
+        block_bytes += one_model(config.speculative.draft_model_config)
+    return block_bytes
+
+
+def pages_for_budget(config: "EngineConfig", budget_bytes: int) -> int:
+    """Pages ``budget_bytes`` of per-device HBM buys under ``config``.
+
+    Pure arithmetic over :func:`per_block_bytes` — the same division
+    ``resolve_num_blocks`` performs against measured free HBM, exposed
+    so the quant perf gate (tools/perf_check.py ``quant`` section) can
+    price the capacity ratio at an EQUAL synthetic budget on backends
+    whose pool would otherwise fall back to the static size.
+    """
+    return max(0, int(budget_bytes) // per_block_bytes(config))
+
+
 def resolve_num_blocks(
     config: "EngineConfig", device=None
 ) -> int:
@@ -55,7 +109,9 @@ def resolve_num_blocks(
     (vLLM behavior the adapter inherits via its engine args); the TPU
     analog measures per-device free HBM AFTER the weights are resident
     (PJRT ``memory_stats``), applies ``hbm_memory_utilization`` to the
-    device's total, and divides by the per-device bytes of one page.
+    device's total, and divides by the per-device bytes of one page
+    (:func:`per_block_bytes` — quantization-aware, scale sidecar
+    included).
 
     Under TP the cache is head-sharded, so each device holds
     ``num_kv_heads / tp`` heads of every page — the per-device page cost
@@ -64,27 +120,11 @@ def resolve_num_blocks(
     Backends without memory stats (CPU tests) fall back to a static pool.
     """
     import jax
-    import jax.numpy as jnp
 
     mcfg = config.model_config
     ccfg = config.cache_config
-    tp = config.parallel_config.tensor_parallel_size or 1
-    itemsize = jnp.dtype(ccfg.cache_dtype).itemsize
 
-    def per_block_bytes(m) -> int:  # noqa: ANN001
-        kv_heads_per_dev = max(1, m.num_kv_heads // tp)
-        return (
-            2 * m.num_layers * ccfg.block_size
-            * kv_heads_per_dev * m.head_dim * itemsize
-        )
-
-    block_bytes = per_block_bytes(mcfg)
-    if config.speculative is not None:
-        # the draft model keeps a parallel paged cache with the same slot
-        # geometry (engine/speculative.py) — its pages share the budget
-        block_bytes += per_block_bytes(
-            config.speculative.draft_model_config
-        )
+    block_bytes = per_block_bytes(config)
     blocks_per_seq = -(-mcfg.max_model_len // ccfg.block_size)
     # beyond full occupancy (every batch row at max_model_len) extra pages
     # can never be touched

@@ -61,15 +61,39 @@ logger = init_logger(__name__)
 
 
 class _Entry:
-    """One full KV page's host copy."""
+    """One full KV page's host copy.
 
-    __slots__ = ("k", "v", "nbytes", "stored_at")
+    ``arrays`` is whatever ``runner.gather_kv_block`` produced for the
+    page: ``(k, v)`` for plain caches, ``(k, v, k_scale, v_scale)`` when
+    KV pages are quantized (ops/kv_quant.py — the per-head dequant
+    scale column travels WITH the page, so promotions, checkpoints and
+    role handoffs restore bit-exact content).  The store treats the
+    tuple opaquely; validation pins every member's shape/dtype.
+    """
 
-    def __init__(self, k: np.ndarray, v: np.ndarray):
-        self.k = k
-        self.v = v
-        self.nbytes = int(k.nbytes) + int(v.nbytes)
+    __slots__ = ("arrays", "nbytes", "stored_at")
+
+    def __init__(self, *arrays: np.ndarray):
+        self.arrays = tuple(arrays)
+        self.nbytes = sum(int(a.nbytes) for a in self.arrays)
         self.stored_at = time.monotonic()
+
+    # legacy accessors (tests corrupt entries through these)
+    @property
+    def k(self) -> np.ndarray:
+        return self.arrays[0]
+
+    @k.setter
+    def k(self, value: np.ndarray) -> None:
+        self.arrays = (value,) + self.arrays[1:]
+
+    @property
+    def v(self) -> np.ndarray:
+        return self.arrays[1]
+
+    @v.setter
+    def v(self, value: np.ndarray) -> None:
+        self.arrays = self.arrays[:1] + (value,) + self.arrays[2:]
 
 
 @dataclasses.dataclass
@@ -261,11 +285,14 @@ class HostKVTier:
         exp = self._expected
         ok = (
             exp is not None
-            and getattr(entry.k, "shape", None) == exp[0]
-            and getattr(entry.k, "dtype", None) == exp[1]
-            and getattr(entry.v, "shape", None) == exp[2]
-            and getattr(entry.v, "dtype", None) == exp[3]
-            and entry.nbytes == int(entry.k.nbytes) + int(entry.v.nbytes)
+            and len(entry.arrays) == len(exp)
+            and all(
+                getattr(a, "shape", None) == shape
+                and getattr(a, "dtype", None) == dtype
+                for a, (shape, dtype) in zip(entry.arrays, exp)
+            )
+            and entry.nbytes
+            == sum(int(a.nbytes) for a in entry.arrays)
         )
         if not ok:
             logger.warning(
@@ -283,15 +310,17 @@ class HostKVTier:
     # ------------------------------------------------------------ demotion
 
     def submit(self, batch: list) -> None:
-        """Accept ``[(digest, k_dev, v_dev), ...]`` freshly gathered
-        device pages.  The device→host copy (``np.asarray``) runs in a
-        worker thread under the transfer lock; entries commit to the LRU
-        back on the loop.  Offline engines (no running loop) copy
-        inline."""
+        """Accept ``[(digest, *page_arrays), ...]`` freshly gathered
+        device pages — ``(k, v)`` per page, plus the scale columns when
+        KV pages are quantized (``runner.gather_kv_block``'s tuple,
+        stored verbatim).  The device→host copy (``np.asarray``) runs
+        in a worker thread under the transfer lock; entries commit to
+        the LRU back on the loop.  Offline engines (no running loop)
+        copy inline."""
         if self._closed or not batch:
             return
         batch_bytes = sum(
-            int(k.nbytes) + int(v.nbytes) for _, k, v in batch
+            int(a.nbytes) for item in batch for a in item[1:]
         )
         try:
             loop = asyncio.get_running_loop()
@@ -306,7 +335,7 @@ class HostKVTier:
             # outside the pool's budget while the transfer lock drains
             self.demotions_dropped += len(batch)
             return
-        for digest, _, _ in batch:
+        for digest, *_ in batch:
             self._inflight.add(digest)
         if loop is None:
             self._insert(self._to_host(batch))
@@ -327,7 +356,7 @@ class HostKVTier:
                 host = await asyncio.to_thread(self._to_host, batch)
         except Exception:
             logger.exception("kv host tier: demotion copy failed")
-            for digest, _, _ in batch:
+            for digest, *_ in batch:
                 self._inflight.discard(digest)
             return
         finally:
@@ -338,18 +367,20 @@ class HostKVTier:
     def _to_host(batch: list) -> list:
         """Worker-thread half: materialise the gathered device pages."""
         return [
-            (digest, np.asarray(k_dev), np.asarray(v_dev))
-            for digest, k_dev, v_dev in batch
+            (item[0], *(np.asarray(a) for a in item[1:]))
+            for item in batch
         ]
 
     def _insert(self, host_batch: list) -> None:
-        for digest, k, v in host_batch:
+        for digest, *arrays in host_batch:
             self._inflight.discard(digest)
             if self._closed or digest in self._entries:
                 continue
-            entry = _Entry(k, v)
+            entry = _Entry(*arrays)
             if self._expected is None:
-                self._expected = (k.shape, k.dtype, v.shape, v.dtype)
+                self._expected = tuple(
+                    (a.shape, a.dtype) for a in arrays
+                )
             if entry.nbytes > self.budget_bytes:
                 continue  # a single page over budget can never fit
             while (
@@ -394,14 +425,15 @@ class HostKVTier:
             entry = self._get_valid(digest)
             if entry is None:
                 break
-            pages.append((entry.k, entry.v))
+            pages.append(entry.arrays)
         return pages
 
     @staticmethod
     def _stage(pages: list, put_fn: Callable) -> list:
         """Worker-thread half: host→device transfer of the assembled
-        pages (the promotion's only bulk transfer)."""
-        return [(put_fn(k), put_fn(v)) for k, v in pages]
+        pages (the promotion's only bulk transfer; scale columns ride
+        along for quantized pages)."""
+        return [tuple(put_fn(a) for a in page) for page in pages]
 
     async def _assemble(self, ticket: PromotionTicket, put_fn: Callable) -> None:
         pages = self._collect(ticket)  # on loop: validated dict reads

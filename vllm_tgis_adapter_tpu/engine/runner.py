@@ -274,15 +274,39 @@ class ModelRunner:
             # against the mesh's AGGREGATE HBM, so materialising it on one
             # device first would OOM exactly like an unsharded weight load
             sh = cache_sharding(mesh)
+            out_sh = sh
+            if cache_cfg.kv_quantization != "none":
+                # quantized caches are (data, scale) pytrees: the scale
+                # sidecar [L, Hkv, pages] head-shards with its cache
+                from jax.sharding import (
+                    NamedSharding,
+                    PartitionSpec as _P,
+                )
+
+                from vllm_tgis_adapter_tpu.ops.kv_quant import (
+                    QuantizedKVCache,
+                )
+
+                out_sh = QuantizedKVCache(
+                    sh,
+                    NamedSharding(mesh, _P(None, "tp", None)),
+                    cache_cfg.block_size,
+                )
             caches = jax.jit(
                 lambda: model.make_kv_caches(
-                    self.num_slots, cache_cfg.cache_dtype
+                    self.num_slots, cache_cfg.cache_dtype,
+                    quantization=cache_cfg.kv_quantization,
+                    block_size=cache_cfg.block_size,
                 ),
-                out_shardings=(sh, sh),
+                out_shardings=(out_sh, out_sh),
             )()
             self._data_sharding = data_sharding(mesh)
         else:
-            caches = model.make_kv_caches(self.num_slots, cache_cfg.cache_dtype)
+            caches = model.make_kv_caches(
+                self.num_slots, cache_cfg.cache_dtype,
+                quantization=cache_cfg.kv_quantization,
+                block_size=cache_cfg.block_size,
+            )
             self._data_sharding = None
         self.params = params
         self.caches = caches
@@ -674,13 +698,6 @@ class ModelRunner:
 
     # ------------------------------------------------------- host KV tier
 
-    @staticmethod
-    def _gather_kv(k_cache, v_cache, idx):  # noqa: ANN001, ANN205
-        return (
-            jnp.take(k_cache, idx, axis=2),
-            jnp.take(v_cache, idx, axis=2),
-        )
-
     def gather_kv_block(self, slots: list[int]) -> tuple:
         """Enqueue a device-side gather of ONE page's slots for host-tier
         demotion (engine/kv_tier.py).  Returns DEVICE arrays without
@@ -689,11 +706,16 @@ class ModelRunner:
         overwrite the page, so the content read is the content current
         at enqueue even if the page is reclaimed immediately after.
         ``slots`` is always exactly block_size long: one compiled shape,
-        forever."""
+        forever.  With quantized KV (ops/kv_quant.py ``gather_kv_page``)
+        the tuple grows the page's per-head scale columns — the sidecar
+        travels with the page into tier entries, decode checkpoints and
+        role handoffs."""
         if self._gather_kv_fn is None:
+            from vllm_tgis_adapter_tpu.ops.kv_quant import gather_kv_page
+
             self._gather_kv_fn = track_jit(
                 "gather_kv",
-                jax.jit(self._gather_kv),
+                jax.jit(gather_kv_page),
                 label=lambda args, kwargs: f"slots={args[2].shape[0]}",
             )
         k_cache, v_cache = self.caches
@@ -701,24 +723,31 @@ class ModelRunner:
             k_cache, v_cache, jnp.asarray(slots, jnp.int32)
         )
 
-    def restore_kv_block(self, slots: list[int], k_dev, v_dev) -> None:
+    def restore_kv_block(self, slots: list[int], *arrays) -> None:
         """Scatter one promoted page into its freshly allocated slots
         (host-tier promotion apply).  Same clean-dispatch-boundary
         contract as ``restore_kv`` (the functional update rebinds
         ``self.caches``); the inputs are already device-resident (the
         tier's assembly thread staged them), so the loop-side cost is
         one jitted dispatch.  Fixed [block_size] index shape: one
-        compiled program covers every promotion."""
+        compiled program covers every promotion.  ``arrays`` is exactly
+        the tuple ``gather_kv_block`` produced — quantized pages restore
+        their stored integers AND scale column verbatim, so the
+        roundtrip is bit-exact (ops/kv_quant.py ``restore_kv_page``)."""
         if self._block_scatter_fn is None:
+            from vllm_tgis_adapter_tpu.ops.kv_quant import (
+                restore_kv_page,
+            )
+
             donate = (0, 1) if jax.default_backend() == "tpu" else ()
             self._block_scatter_fn = track_jit(
                 "scatter_kv",
-                jax.jit(self._scatter_kv, donate_argnums=donate),
+                jax.jit(restore_kv_page, donate_argnums=donate),
                 label=lambda args, kwargs: f"slots={args[2].shape[0]}",
             )
         k_cache, v_cache = self.caches
         self.caches = self._block_scatter_fn(
-            k_cache, v_cache, jnp.asarray(slots, jnp.int32), k_dev, v_dev
+            k_cache, v_cache, jnp.asarray(slots, jnp.int32), *arrays
         )
 
     # --------------------------------------------------------------- prefill

@@ -272,7 +272,8 @@ class SpeculativeDecoder:
 
         mesh = runner.mesh
         draft_model.mesh = mesh
-        cache_dtype = runner.config.cache_config.cache_dtype
+        cache_cfg = runner.config.cache_config
+        cache_dtype = cache_cfg.cache_dtype
         if mesh is not None:
             from vllm_tgis_adapter_tpu.parallel import (
                 cache_sharding,
@@ -283,15 +284,38 @@ class SpeculativeDecoder:
             validate_tp_divisibility(dcfg, mesh.shape["tp"])
             draft_params = shard_llama_params(mesh, draft_params)
             sh = cache_sharding(mesh)
+            out_sh = sh
+            if cache_cfg.kv_quantization != "none":
+                from jax.sharding import (
+                    NamedSharding,
+                    PartitionSpec as _P,
+                )
+
+                from vllm_tgis_adapter_tpu.ops.kv_quant import (
+                    QuantizedKVCache,
+                )
+
+                out_sh = QuantizedKVCache(
+                    sh,
+                    NamedSharding(mesh, _P(None, "tp", None)),
+                    cache_cfg.block_size,
+                )
             self.draft_caches = jax.jit(
                 lambda: draft_model.make_kv_caches(
-                    runner.num_slots, cache_dtype
+                    runner.num_slots, cache_dtype,
+                    quantization=cache_cfg.kv_quantization,
+                    block_size=cache_cfg.block_size,
                 ),
-                out_shardings=(sh, sh),
+                out_shardings=(out_sh, out_sh),
             )()
         else:
+            # the draft's paged cache follows the target's quantization
+            # (greedy acceptance compares against TARGET logits, so a
+            # quantized draft never perturbs emitted tokens)
             self.draft_caches = draft_model.make_kv_caches(
-                runner.num_slots, cache_dtype
+                runner.num_slots, cache_dtype,
+                quantization=cache_cfg.kv_quantization,
+                block_size=cache_cfg.block_size,
             )
         self.draft_params = draft_params
 

@@ -183,6 +183,28 @@ kv_host_tier_evictions_total = _get_or_create(
 )
 
 
+# ---- quantized KV pages (--kv-quantization, ops/kv_quant.py,
+# docs/QUANTIZATION.md): capacity is the whole point — the dtype label
+# makes the ~2x page-count lift visible next to the HBM budget — and
+# the logprob delta is the token-quality bound the scenario suites
+# gate (tools/scenarios.py writes the last measured value here).
+kv_page_capacity_blocks = _get_or_create(
+    Gauge,
+    f"{_PREFIX}_kv_page_capacity_blocks",
+    "KV pages the device pool holds, labeled by the page storage dtype "
+    "(bf16/f32 full precision, int8/fp8 quantized) per dp replica — "
+    "the capacity the HBM budget buys under --kv-quantization",
+    labelnames=("dtype", "replica"),
+)
+quant_logprob_delta = _get_or_create(
+    Gauge,
+    f"{_PREFIX}_quant_logprob_delta",
+    "Mean per-token |logprob delta| of the quantized KV path vs the "
+    "bf16 baseline, as last measured by the steady-state scenario "
+    "suites (tools/scenarios.py; 0 until a suite has run)",
+)
+
+
 # ---- guided-decoding constraint compilation (engine/constrained.py
 # compile_fsm): first use of a constraint compiles a DFA + token table
 # synchronously; repeats hit the LRU.  These expose the latency spike

@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 
 from vllm_tgis_adapter_tpu.ops import attention as attn_ops
+from vllm_tgis_adapter_tpu.ops import kv_quant
 
 if TYPE_CHECKING:
     from vllm_tgis_adapter_tpu.engine.config import ModelConfig
@@ -263,13 +264,25 @@ class LlamaForCausalLM:
             params["layers"].append(layer)
         return params
 
-    def make_kv_caches(self, num_slots: int, dtype) -> tuple[jax.Array, jax.Array]:
+    def make_kv_caches(
+        self,
+        num_slots: int,
+        dtype,
+        quantization: str = "none",
+        block_size: int = 16,
+    ) -> tuple:
         # head-leading layout: a KV page is a contiguous (block_size, Dh)
         # tile per head — the shape the Pallas decode kernel DMAs directly
-        # (ops/pallas_attention.py module docstring)
+        # (ops/pallas_attention.py module docstring).  With
+        # --kv-quantization the caches become QuantizedKVCache pytrees
+        # (int8/fp8 data + per-page-per-head scale sidecar,
+        # ops/kv_quant.py); "none" returns the plain arrays unchanged.
         cfg = self.config
         shape = (cfg.num_layers, cfg.num_kv_heads, num_slots, cfg.head_dim)
-        return jnp.zeros(shape, dtype=dtype), jnp.zeros(shape, dtype=dtype)
+        return (
+            kv_quant.make_kv_cache(shape, dtype, quantization, block_size),
+            kv_quant.make_kv_cache(shape, dtype, quantization, block_size),
+        )
 
     # --------------------------------------------------------------- forward
 
@@ -639,12 +652,10 @@ class LlamaForCausalLM:
 
         def attend(i, q, k, v):
             nonlocal k_cache, v_cache
-            k_cache = k_cache.at[i, :, safe_slots].set(
-                k.astype(k_cache.dtype), mode="drop"
-            )
-            v_cache = v_cache.at[i, :, safe_slots].set(
-                v.astype(v_cache.dtype), mode="drop"
-            )
+            k_cache = kv_quant.scatter_layer(k_cache, i, safe_slots, k)
+            v_cache = kv_quant.scatter_layer(v_cache, i, safe_slots, v)
+            # dense attend over the chunk's own (full-precision) K/V:
+            # quantization only perturbs later PAGED reads of this cache
             return attn_ops.prefill_attention(
                 q, k, v, scale, valid_len, mesh=self.mesh,
                 window=self._window_for_layer(i),
@@ -717,17 +728,15 @@ class LlamaForCausalLM:
 
         def attend(i, q, k, v):
             nonlocal k_cache, v_cache
-            k_cache = k_cache.at[i, :, safe_slots].set(
-                k.astype(k_cache.dtype), mode="drop"
-            )
-            v_cache = v_cache.at[i, :, safe_slots].set(
-                v.astype(v_cache.dtype), mode="drop"
-            )
+            k_cache = kv_quant.scatter_layer(k_cache, i, safe_slots, k)
+            v_cache = kv_quant.scatter_layer(v_cache, i, safe_slots, v)
             return attn_ops.chunked_prefill_attention(
-                q, k_cache[i], v_cache[i], block_table, start, valid_len,
-                block_size, scale, mesh=self.mesh,
+                q, kv_quant.layer_data(k_cache, i),
+                kv_quant.layer_data(v_cache, i), block_table, start,
+                valid_len, block_size, scale, mesh=self.mesh,
                 window=self._window_for_layer(i),
                 alibi_slopes=self.alibi,
+                kv_scales=kv_quant.layer_scales(k_cache, v_cache, i),
             )
 
         x = (
@@ -792,22 +801,20 @@ class LlamaForCausalLM:
 
         def attend(i, q, k, v):
             nonlocal k_cache, v_cache
-            k_cache = k_cache.at[i, :, safe_slots].set(
-                k.astype(k_cache.dtype), mode="drop"
-            )
-            v_cache = v_cache.at[i, :, safe_slots].set(
-                v.astype(v_cache.dtype), mode="drop"
-            )
+            k_cache = kv_quant.scatter_layer(k_cache, i, safe_slots, k)
+            v_cache = kv_quant.scatter_layer(v_cache, i, safe_slots, v)
             from vllm_tgis_adapter_tpu.ops.ragged_attention import (
                 ragged_paged_attention,
             )
 
             return ragged_paged_attention(
-                q, k_cache[i], v_cache[i], positions, seq_starts,
+                q, kv_quant.layer_data(k_cache, i),
+                kv_quant.layer_data(v_cache, i), positions, seq_starts,
                 pos_base, total_tokens, block_tables, block_size, scale,
                 work=work, mesh=self.mesh,
                 window=self._window_for_layer(i),
                 alibi_slopes=self.alibi,
+                kv_scales=kv_quant.layer_scales(k_cache, v_cache, i),
             )
 
         x = self._embed(params, token_ids, positions)
@@ -861,12 +868,8 @@ class LlamaForCausalLM:
 
         def attend(i, q, k, v):
             nonlocal k_cache, v_cache
-            k_cache = k_cache.at[i, :, safe_slots].set(
-                k.astype(k_cache.dtype), mode="drop"
-            )
-            v_cache = v_cache.at[i, :, safe_slots].set(
-                v.astype(v_cache.dtype), mode="drop"
-            )
+            k_cache = kv_quant.scatter_layer(k_cache, i, safe_slots, k)
+            v_cache = kv_quant.scatter_layer(v_cache, i, safe_slots, v)
             from vllm_tgis_adapter_tpu.ops.ragged_attention import (
                 ragged_paged_attention,
             )
@@ -877,7 +880,8 @@ class LlamaForCausalLM:
             # and their garbage output is discarded by the sampler
             # mask, same as the padded-batch decode contract)
             return ragged_paged_attention(
-                q, k_cache[i], v_cache[i],
+                q, kv_quant.layer_data(k_cache, i),
+                kv_quant.layer_data(v_cache, i),
                 jnp.maximum(context_lens, 1) - 1,
                 jnp.arange(b + 1, dtype=jnp.int32),
                 jnp.maximum(context_lens, 1) - 1,
@@ -885,6 +889,7 @@ class LlamaForCausalLM:
                 block_tables, block_size, scale, mesh=self.mesh,
                 window=self._window_for_layer(i),
                 alibi_slopes=self.alibi,
+                kv_scales=kv_quant.layer_scales(k_cache, v_cache, i),
             )
 
         x = (

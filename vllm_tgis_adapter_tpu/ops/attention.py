@@ -276,6 +276,7 @@ def chunked_prefill_attention(
     mesh=None,
     window: int = 0,
     alibi_slopes: jax.Array | None = None,  # [H] f32 (bloom lineage)
+    kv_scales: tuple | None = None,  # ([Hkv, pages] f32 x2) quantized KV
 ) -> jax.Array:
     """Causal chunk-vs-paged-context attention (the chunked-prefill and
     prefix-cache-resume hot path).
@@ -284,8 +285,12 @@ def chunked_prefill_attention(
     (kv head, query block) instead of once per query token.  Fallback:
     the decode formulation (each query as a batch row with its own
     context length), which is what the kernel's numerics are pinned to.
+    With quantized KV (``kv_scales`` set, ops/kv_quant.py) the gather
+    formulation runs everywhere — this is the legacy solo planner's
+    path only (prompt-logprob heads); the ragged serving kernel has its
+    own in-register dequant.
     """
-    if _use_pallas():
+    if _use_pallas() and kv_scales is None:
         from vllm_tgis_adapter_tpu.ops import pallas_attention
 
         kernel = functools.partial(
@@ -327,7 +332,7 @@ def chunked_prefill_attention(
     tables = jnp.broadcast_to(block_table[None, :], (t, block_table.shape[0]))
     return paged_decode_attention_xla(
         q, k_cache, v_cache, tables, ctx_lens, block_size, scale,
-        window=window, alibi_slopes=alibi_slopes,
+        window=window, alibi_slopes=alibi_slopes, kv_scales=kv_scales,
     )
 
 
@@ -341,11 +346,16 @@ def paged_decode_attention_xla(
     scale: float,
     window: int = 0,  # >0: attend to at most the last `window` tokens
     alibi_slopes: jax.Array | None = None,  # [H] f32 per-head bias slopes
+    kv_scales: tuple | None = None,  # ([Hkv, pages] f32 x2) quantized KV
 ) -> jax.Array:
     """One-token-per-sequence attention against the paged cache.
 
     Gather-based XLA implementation: materialises each sequence's pages as
     ``[B, max_blocks * block_size]`` rows, masks beyond ``context_len``.
+    With quantized KV (``kv_scales`` from ops/kv_quant.py) the gathered
+    page values multiply by their per-(head, page) scale right after the
+    gather — the dequant stays on the gathered working set, never the
+    whole cache.
     """
     b, num_heads, head_dim = q.shape
     max_blocks = block_tables.shape[1]
@@ -364,6 +374,15 @@ def paged_decode_attention_xla(
 
     keys = jnp.take(k_cache, gather_idx, axis=1).astype(jnp.float32)  # [Hkv,B,S,Dh]
     values = jnp.take(v_cache, gather_idx, axis=1).astype(jnp.float32)
+    if kv_scales is not None:
+        k_scale, v_scale = kv_scales
+        page_idx = gather_idx // block_size  # [B, S] physical page ids
+        keys = keys * jnp.take(
+            k_scale.astype(jnp.float32), page_idx, axis=1
+        )[..., None]
+        values = values * jnp.take(
+            v_scale.astype(jnp.float32), page_idx, axis=1
+        )[..., None]
 
     qh = q.reshape(b, num_kv, q_per_kv, head_dim).astype(jnp.float32)
     scores = jnp.einsum("bkgd,kbsd->bkgs", qh, keys) * scale

@@ -1,0 +1,319 @@
+"""Quantized KV pages: int8/fp8 storage with per-page-per-head scales.
+
+Decode throughput on TPU is HBM-bandwidth- and KV-capacity-bound, and
+since PRs 9-11 the KV page is the unit of *everything* — the device
+pool, the host prefix tier, decode checkpoints, and prefill→decode
+handoffs all move whole pages.  Halving page bytes therefore doubles
+effective capacity across the entire stack at once: more pages per HBM
+budget → bigger ragged batches → direct tok/s (ROADMAP item 5; the
+Gemma-on-TPU serving comparison in PAPERS.md is the low-precision
+precedent).  This module implements the storage scheme and every
+quantize/dequantize primitive the rest of the stack composes
+(docs/QUANTIZATION.md):
+
+* **Storage.**  ``--kv-quantization int8`` stores pages as symmetric
+  int8 (``q ∈ [-127, 127]``); ``fp8`` stores ``float8_e4m3fn`` (max
+  normal 448).  The cache becomes a :class:`QuantizedKVCache` — the
+  quantized ``data`` array in the familiar head-leading
+  ``[L, Hkv, num_slots, Dh]`` layout plus a f32 ``scale`` sidecar
+  ``[L, Hkv, num_pages]``: ONE dequant scale per (layer, kv head,
+  physical page).  ``none`` (the default) keeps plain arrays and is
+  byte-identical to the pre-quantization engine — none of the helpers
+  below emit a single different op for raw arrays.
+
+* **Scale discipline (the token-identity anchor).**  A page's scale is
+  (re)set exactly when its FIRST slot is written: from that row's
+  per-head ``|amax|`` times a fixed headroom margin.  Every write in
+  the same dispatch — and every later append to the page — quantizes
+  with the post-update scale (values past the range clip).  Because a
+  position's K/V is a pure function of the token history, the scale is
+  REPRODUCIBLE no matter which path writes slot 0 (solo prefill, a
+  ragged chunk, a decode step, a speculative verify span, or a
+  checkpoint-resume tail recompute): demote→promote through the host
+  tier, decode checkpoint/resume, and prefill→decode handoffs all stay
+  token-identical under quantization.  A running per-page amax would
+  be tighter but is NOT append-consistent — growing the scale would
+  silently rescale previously stored integers.
+
+* **Dequantization at the page read.**  The Pallas ragged kernel
+  multiplies each DMA'd page tile by its one scale scalar in-register
+  (ops/ragged_attention.py); the XLA reference path multiplies after
+  the page gather (ops/attention.py ``paged_decode_attention_xla``).
+  Softmax stays f32 either way, so quantization only perturbs the K/V
+  operands, never the accumulation.
+
+* **Page movement.**  ``gather_kv_page`` / ``restore_kv_page`` are the
+  jitted per-page entry points the host tier and checkpoint paths ride
+  (engine/runner.py wraps them in ``track_jit``): one fixed
+  block-shaped program each, quantized or not — the scale column
+  travels WITH the page, so tier entries, checkpoints and role
+  handoffs carry the sidecar for free.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+#: accepted --kv-quantization values (engine/config.py validates).
+SCHEMES = ("none", "int8", "fp8")
+
+#: headroom multiplier on the scale-setting row's |amax|: later tokens
+#: appended to the page clip only when they exceed MARGIN x the first
+#: token's per-head amax.  Costs one effective bit of int8 precision;
+#: K/V magnitudes are near-stationary across positions, so the clip
+#: rate stays negligible (tests/test_kv_quant.py roundtrip bounds).
+SCALE_MARGIN = 2.0
+
+_EPS = 1e-8
+
+
+def storage_dtype(scheme: str):
+    """Quantized storage dtype for ``scheme`` (``none`` → None)."""
+    if scheme == "int8":
+        return jnp.int8
+    if scheme == "fp8":
+        return jnp.float8_e4m3fn
+    return None
+
+
+def qmax_for(dtype) -> float:
+    """Largest representable magnitude quantization targets."""
+    if jnp.dtype(dtype) == jnp.dtype(jnp.int8):
+        return 127.0
+    return 448.0  # float8_e4m3fn max normal
+
+
+def scale_bytes_per_page(num_layers: int, kv_heads: int) -> int:
+    """Sidecar bytes ONE page adds (both caches): 2 x [L, Hkv] f32."""
+    return 2 * num_layers * kv_heads * 4
+
+
+@jax.tree_util.register_pytree_node_class
+class QuantizedKVCache:
+    """One quantized K (or V) cache: ``data`` + per-page ``scale``.
+
+    ``data``  — ``[L, Hkv, num_slots, Dh]`` int8 / float8_e4m3fn
+    ``scale`` — ``[L, Hkv, num_pages]`` f32, dequant multiplier per
+    physical page (``num_pages = num_slots // block_size``); 0 marks a
+    never-written page (its garbage content is masked by context
+    length everywhere it could be read).
+
+    Registered as a pytree so it flows through ``jax.jit`` / ``scan``
+    carries / donation exactly like the raw array it replaces; the
+    ``shape`` / ``dtype`` properties keep the handful of geometry reads
+    (``k_cache.shape[2]``) working unchanged.
+    """
+
+    __slots__ = ("data", "scale", "block_size")
+
+    def __init__(self, data, scale, block_size: int):
+        self.data = data
+        self.scale = scale
+        self.block_size = block_size
+
+    @property
+    def shape(self):
+        return self.data.shape
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    def tree_flatten(self):
+        return (self.data, self.scale), self.block_size
+
+    @classmethod
+    def tree_unflatten(cls, block_size, children):
+        data, scale = children
+        return cls(data, scale, block_size)
+
+
+def is_quantized(cache) -> bool:
+    return isinstance(cache, QuantizedKVCache)
+
+
+def make_kv_cache(
+    shape: tuple, dtype, scheme: str = "none", block_size: int = 16
+):
+    """Zeroed cache in the layout ``scheme`` dictates.
+
+    ``none`` returns the plain zeros array the engine always built —
+    byte-identical off.  int8/fp8 return a :class:`QuantizedKVCache`
+    with an all-zero scale sidecar (every page starts "never written").
+    """
+    qdtype = storage_dtype(scheme)
+    if qdtype is None:
+        return jnp.zeros(shape, dtype=dtype)
+    num_layers, kv_heads, num_slots, _ = shape
+    return QuantizedKVCache(
+        jnp.zeros(shape, dtype=qdtype),
+        jnp.zeros(
+            (num_layers, kv_heads, num_slots // block_size), jnp.float32
+        ),
+        block_size,
+    )
+
+
+def layer_data(cache, i):
+    """The per-layer array attention kernels read (quantized or not)."""
+    if is_quantized(cache):
+        return cache.data[i]
+    return cache[i]
+
+
+def layer_scales(k_cache, v_cache, i):
+    """``kv_scales`` operand for the attention ops: ``(k_scale[i],
+    v_scale[i])`` (each ``[Hkv, num_pages]`` f32) or None when the
+    caches are unquantized."""
+    if is_quantized(k_cache):
+        return (k_cache.scale[i], v_cache.scale[i])
+    return None
+
+
+def dequantize(x, scale):
+    """Dequantize gathered page values: ``x * scale`` in f32.
+
+    ``scale`` must broadcast against ``x`` with the trailing head-dim
+    axis already expanded by the caller (one scale per page covers
+    every slot and every head-dim lane of that page's tile).
+    """
+    return x.astype(jnp.float32) * scale
+
+
+def _quantize_values(x, qdtype, qmax):
+    """f32 ``x`` (already divided by scale) → storage dtype, saturating."""
+    if jnp.dtype(qdtype) == jnp.dtype(jnp.int8):
+        return jnp.clip(jnp.round(x), -qmax, qmax).astype(jnp.int8)
+    # float8_e4m3fn has no inf: out-of-range casts become NaN, so clip
+    # to the max normal first (saturation semantics, like the MXU)
+    return jnp.clip(x, -qmax, qmax).astype(qdtype)
+
+
+def scatter_layer(cache, i, safe_slots, vals):
+    """Scatter this step's K (or V) rows into layer ``i`` of ``cache``.
+
+    ``vals`` is ``[T, Hkv, Dh]``; ``safe_slots`` is ``[T]`` with padding
+    rows remapped to ``num_slots`` (positive out-of-bounds, dropped by
+    the scatter).  For a raw cache this is EXACTLY the historical
+    ``cache.at[i, :, safe_slots].set(vals.astype(dtype), mode="drop")``.
+
+    For a quantized cache the scale sidecar updates first: every page
+    whose slot 0 is among this dispatch's writes re-sets its scale from
+    that row's per-head |amax| (x SCALE_MARGIN), then ALL rows quantize
+    with the post-update scales and scatter.  One slot is written at
+    most once per dispatch (spans are disjoint), so the scatter-max
+    candidates never race.
+    """
+    if not is_quantized(cache):
+        return cache.at[i, :, safe_slots].set(
+            vals.astype(cache.dtype), mode="drop"
+        )
+    data, scale = cache.data, cache.scale
+    bs = cache.block_size
+    num_pages = scale.shape[2]
+    qmax = qmax_for(data.dtype)
+    pages = safe_slots // bs  # [T]; padding rows land OOB and drop
+    vt = jnp.swapaxes(vals.astype(jnp.float32), 0, 1)  # [Hkv, T, Dh]
+    amax = jnp.max(jnp.abs(vt), axis=-1)  # [Hkv, T]
+    setter = (safe_slots % bs == 0).astype(jnp.int32)  # [T]
+    # per-page candidate amax from the slot-0 rows of THIS dispatch
+    # (at most one such row per page — spans write each slot once)
+    cand = (
+        jnp.zeros((vt.shape[0], num_pages), jnp.float32)
+        .at[:, pages]
+        .max(amax * setter[None, :].astype(jnp.float32), mode="drop")
+    )
+    fresh = (
+        jnp.zeros((num_pages,), jnp.int32)
+        .at[pages]
+        .max(setter, mode="drop")
+    )
+    layer_scale = jnp.where(
+        fresh[None, :] == 1,
+        jnp.maximum(cand * SCALE_MARGIN, _EPS) / qmax,
+        scale[i],
+    )
+    scale = scale.at[i].set(layer_scale)
+    row_scale = jnp.take(
+        layer_scale, jnp.clip(pages, 0, num_pages - 1), axis=1
+    )  # [Hkv, T]; padding rows read garbage their scatter then drops
+    q = _quantize_values(
+        vt / jnp.maximum(row_scale, _EPS)[..., None], data.dtype, qmax
+    )
+    data = data.at[i, :, safe_slots].set(
+        jnp.swapaxes(q, 0, 1), mode="drop"
+    )
+    return QuantizedKVCache(data, scale, bs)
+
+
+# ------------------------------------------------- per-page movement ops
+#
+# The jitted entry points the host KV tier, decode checkpoints and
+# prefill→decode handoffs ride (engine/runner.py gather_kv_block /
+# restore_kv_block wrap these in track_jit "gather_kv" / "scatter_kv"):
+# ``idx`` is always exactly one page's block_size slots, so each holds
+# ONE compiled shape forever, quantized or not.  Registered in
+# tools/tpulint/config.py JIT_REGISTRY.
+
+
+def gather_kv_page(k_cache, v_cache, idx):
+    """Gather one page from both caches for host-tier demotion.
+
+    Raw caches return ``(k, v)`` slot gathers — the historical
+    contract.  Quantized caches return ``(k, v, k_scale, v_scale)``
+    where the scale columns are ``[L, Hkv]`` f32: the sidecar travels
+    with the page into the tier entry (and through checkpoints and
+    role handoffs, which reference the same entries).
+    """
+    if not is_quantized(k_cache):
+        return (
+            jnp.take(k_cache, idx, axis=2),
+            jnp.take(v_cache, idx, axis=2),
+        )
+    page = idx[0] // k_cache.block_size
+    return (
+        jnp.take(k_cache.data, idx, axis=2),
+        jnp.take(v_cache.data, idx, axis=2),
+        k_cache.scale[:, :, page],
+        v_cache.scale[:, :, page],
+    )
+
+
+def restore_kv_page(k_cache, v_cache, idx, *arrays):
+    """Scatter one promoted/checkpointed page back into both caches.
+
+    ``arrays`` is exactly what ``gather_kv_page`` produced (the tier
+    stores and re-stages it verbatim, so the quantized roundtrip is
+    BIT-exact — no requantization, token identity preserved).  Raw
+    caches scatter values with a dtype cast, the historical behavior.
+    """
+    if not is_quantized(k_cache):
+        k_host, v_host = arrays
+        return (
+            k_cache.at[:, :, idx, :].set(
+                k_host.astype(k_cache.dtype), mode="drop"
+            ),
+            v_cache.at[:, :, idx, :].set(
+                v_host.astype(v_cache.dtype), mode="drop"
+            ),
+        )
+    k_host, v_host, k_scale, v_scale = arrays
+    page = idx[0] // k_cache.block_size
+    bs = k_cache.block_size
+    return (
+        QuantizedKVCache(
+            k_cache.data.at[:, :, idx, :].set(
+                k_host.astype(k_cache.data.dtype), mode="drop"
+            ),
+            k_cache.scale.at[:, :, page].set(k_scale),
+            bs,
+        ),
+        QuantizedKVCache(
+            v_cache.data.at[:, :, idx, :].set(
+                v_host.astype(v_cache.data.dtype), mode="drop"
+            ),
+            v_cache.scale.at[:, :, page].set(v_scale),
+            bs,
+        ),
+    )

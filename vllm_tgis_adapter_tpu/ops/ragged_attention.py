@@ -195,23 +195,28 @@ def _ragged_kernel(
     starts_ref,  # [S+1] SMEM flat span starts (pads = padded length)
     base_ref,  # [S] SMEM global position of each span's first row
     alibi_ref,  # [H] f32 SMEM slopes; unused unless use_alibi
-    # blocks
-    q_ref,  # [1, G*bq, Dh] VMEM — query block of kv head h
-    k_ref,  # [1, block_size, Dh] VMEM — page picked by index_map
-    v_ref,  # [1, block_size, Dh]
-    o_ref,  # [1, G*bq, Dh]
-    # scratch
-    m_ref,  # [G*bq, 1] f32 running max
-    l_ref,  # [G*bq, 1] f32 running denominator
-    acc_ref,  # [G*bq, Dh] f32 running numerator
-    *,
+    # blocks: q_ref [1, G*bq, Dh], k_ref/v_ref [1, block_size, Dh] (the
+    # page picked by index_map), then — quantized caches only — ks_ref/
+    # vs_ref [1, 1] f32 (the page's dequant scale, same index map), then
+    # o_ref [1, G*bq, Dh] and the three f32 scratch accumulators
+    # (m [G*bq, 1], l [G*bq, 1], acc [G*bq, Dh])
+    q_ref,
+    k_ref,
+    v_ref,
+    *refs,
     scale: float,
     block_size: int,
     block_q: int,
     g: int,
     window: int,
     use_alibi: bool,
+    quantized: bool,
 ):
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = refs
+    else:
+        ks_ref = vs_ref = None
+        o_ref, m_ref, l_ref, acc_ref = refs
     h = pl.program_id(0)
     w = pl.program_id(1)
     seq = work_ref[1, w]
@@ -228,6 +233,12 @@ def _ragged_kernel(
         q = q_ref[0].astype(jnp.float32)  # [G*bq, Dh]
         k = k_ref[0].astype(jnp.float32)  # [bs, Dh]
         v = v_ref[0].astype(jnp.float32)
+        if quantized:
+            # in-register dequant: the whole page tile shares ONE
+            # per-(kv head, page) scale (ops/kv_quant.py sidecar),
+            # DMA'd as a 1x1 block by the same page index map
+            k = k * ks_ref[0, 0]
+            v = v * vs_ref[0, 0]
         s_mat = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -298,6 +309,7 @@ def _ragged_attention_pallas(
     window: int,
     alibi_slopes: jax.Array | None,
     interpret: bool,
+    kv_scales: tuple | None = None,  # ([Hkv, pages] f32 x2) quantized
 ) -> jax.Array:
     t, num_heads, head_dim = q.shape
     num_kv = k_cache.shape[0]
@@ -320,23 +332,38 @@ def _ragged_attention_pallas(
         else alibi_slopes.astype(jnp.float32)
     )
     num_work = work.shape[1]
+    quantized = kv_scales is not None
+    in_specs = [
+        pl.BlockSpec(
+            (1, g * block_q, head_dim),
+            lambda h, w, wk, st, bs_, al: (h, wk[0, w], 0),
+        ),
+        pl.BlockSpec(
+            (1, block_size, head_dim),
+            lambda h, w, wk, st, bs_, al: (h, wk[2, w], 0),
+        ),
+        pl.BlockSpec(
+            (1, block_size, head_dim),
+            lambda h, w, wk, st, bs_, al: (h, wk[2, w], 0),
+        ),
+    ]
+    operands = [qh, k_cache, v_cache]
+    if quantized:
+        # one (kv head, page) scale scalar per cache, picked by the same
+        # physical-page index the K/V tiles DMA with — the in-register
+        # dequant's only extra traffic is two 4-byte blocks per item
+        scale_spec = pl.BlockSpec(
+            (1, 1), lambda h, w, wk, st, bs_, al: (h, wk[2, w])
+        )
+        in_specs += [scale_spec, scale_spec]
+        operands += [
+            kv_scales[0].astype(jnp.float32),
+            kv_scales[1].astype(jnp.float32),
+        ]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=4,
         grid=(num_kv, num_work),
-        in_specs=[
-            pl.BlockSpec(
-                (1, g * block_q, head_dim),
-                lambda h, w, wk, st, bs_, al: (h, wk[0, w], 0),
-            ),
-            pl.BlockSpec(
-                (1, block_size, head_dim),
-                lambda h, w, wk, st, bs_, al: (h, wk[2, w], 0),
-            ),
-            pl.BlockSpec(
-                (1, block_size, head_dim),
-                lambda h, w, wk, st, bs_, al: (h, wk[2, w], 0),
-            ),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (1, g * block_q, head_dim),
             lambda h, w, wk, st, bs_, al: (h, wk[0, w], 0),
@@ -351,7 +378,7 @@ def _ragged_attention_pallas(
         functools.partial(
             _ragged_kernel, scale=scale, block_size=block_size,
             block_q=block_q, g=g, window=window,
-            use_alibi=alibi_slopes is not None,
+            use_alibi=alibi_slopes is not None, quantized=quantized,
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct(
@@ -359,7 +386,7 @@ def _ragged_attention_pallas(
         ),
         interpret=interpret,
     )(work, seq_starts.astype(jnp.int32), pos_base.astype(jnp.int32),
-      slopes, qh, k_cache, v_cache)
+      slopes, *operands)
     return jnp.transpose(
         out.reshape(num_kv, nq, g, block_q, head_dim), (1, 3, 0, 2, 4)
     ).reshape(t_pad, num_heads, head_dim)[:t]
@@ -381,6 +408,7 @@ def ragged_attention_xla(
     *,
     window: int = 0,
     alibi_slopes: jax.Array | None = None,
+    kv_scales: tuple | None = None,
 ) -> jax.Array:
     """XLA reference: every ragged row IS a decode row with context
     length ``position + 1`` against its sequence's page table — the
@@ -398,7 +426,7 @@ def ragged_attention_xla(
     ctx = jnp.where(rows < total_tokens, positions.astype(jnp.int32) + 1, 1)
     return paged_decode_attention_xla(
         q, k_cache, v_cache, tables, ctx, block_size, scale,
-        window=window, alibi_slopes=alibi_slopes,
+        window=window, alibi_slopes=alibi_slopes, kv_scales=kv_scales,
     )
 
 
@@ -419,6 +447,7 @@ def ragged_paged_attention(
     window: int = 0,
     alibi_slopes: jax.Array | None = None,  # [H] f32 (bloom lineage)
     block_q: int = 128,
+    kv_scales: tuple | None = None,  # ([Hkv, pages] f32 x2) quantized KV
 ) -> jax.Array:
     """One causal paged-attention dispatch over a mixed ragged stream.
 
@@ -428,6 +457,10 @@ def ragged_paged_attention(
     decode-scan case); elsewhere the XLA reference runs and ``work`` is
     ignored entirely — it never becomes an operand, so schedule-width
     shape variety cannot retrace the CPU path.
+
+    ``kv_scales`` marks the caches as quantized pages (ops/kv_quant.py):
+    the Pallas kernel dequantizes each page tile in-register against its
+    one per-(kv head, page) scale, the XLA path right after its gather.
 
     Under a TP mesh the kernel runs inside shard_map over the head axis,
     cache head-sharded — same contract as the bucketed kernels.
@@ -463,22 +496,32 @@ def ragged_paged_attention(
             cache = P("tp", None, None)
             operands = [q, k_cache, v_cache, seq_starts, pos_base, work]
             specs = [heads, cache, cache, P(), P(), P()]
+            n_scales = 0
+            if kv_scales is not None:
+                # scale sidecars shard with the kv-head axis like the
+                # caches they dequantize
+                operands.extend(kv_scales)
+                specs.extend([P("tp", None), P("tp", None)])
+                n_scales = 2
             if alibi_slopes is not None:
                 operands.append(alibi_slopes)
                 specs.append(P("tp"))
 
             def wrapped(q, kc, vc, st, pb, wk, *rest):
+                scales = tuple(rest[:n_scales]) if n_scales else None
+                rest = rest[n_scales:]
                 return kernel(q, kc, vc, st, pb, wk,
-                              alibi_slopes=rest[0] if rest else None)
+                              alibi_slopes=rest[0] if rest else None,
+                              kv_scales=scales)
 
             return shard_map(
                 wrapped, mesh=mesh, in_specs=tuple(specs),
                 out_specs=heads, check_vma=False,
             )(*operands)
         return kernel(q, k_cache, v_cache, seq_starts, pos_base, work,
-                      alibi_slopes=alibi_slopes)
+                      alibi_slopes=alibi_slopes, kv_scales=kv_scales)
     return ragged_attention_xla(
         q, k_cache, v_cache, positions, seq_starts, total_tokens,
         block_tables, block_size, scale,
-        window=window, alibi_slopes=alibi_slopes,
+        window=window, alibi_slopes=alibi_slopes, kv_scales=kv_scales,
     )

@@ -165,8 +165,21 @@ def add_engine_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                    help="per-expert buffer headroom for --moe-dispatch "
                         "capacity: capacity = ceil(T*k/E * factor)")
     g.add_argument("--kv-cache-dtype", type=str, default="auto",
-                   choices=["auto", "bfloat16", "float32", "float8_e4m3"],
-                   help="KV-cache storage dtype")
+                   choices=["auto", "bfloat16", "float16", "float32",
+                            "float8_e4m3", "fp8", "int8"],
+                   help="KV-cache storage dtype.  Quantized spellings "
+                        "(fp8/int8/float8_e4m3) are subsumed by "
+                        "--kv-quantization: they serve the scaled "
+                        "quantized-page path, never a raw cast "
+                        "(docs/QUANTIZATION.md)")
+    g.add_argument("--kv-quantization", type=str, default="none",
+                   choices=["none", "int8", "fp8"],
+                   help="store KV pages quantized with per-page-per-"
+                        "head scales, dequantized inside the ragged "
+                        "attention kernel — ~2x KV capacity at equal "
+                        "HBM, quality-gated per scenario "
+                        "(docs/QUANTIZATION.md).  'none' (default) is "
+                        "byte-identical to the unquantized engine")
     g.add_argument("--quantization", type=str, default=None,
                    choices=["int8", "awq", "gptq", "squeezellm"],
                    help="weight quantization scheme: int8 is native "
