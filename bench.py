@@ -513,6 +513,11 @@ def run_bench(on_tpu: bool) -> dict:
                 max_loras=n_lora_slots,
                 max_lora_rank=8,
                 max_cpu_loras=max(n_lora, n_lora_slots),
+                # BENCH_LORA_GATHERED=0 stamps the padded-matmul
+                # baseline next to the default gathered path
+                gathered=os.environ.get(
+                    "BENCH_LORA_GATHERED", "1"
+                ) != "0",
             )
             if n_lora
             else LoRAConfig()

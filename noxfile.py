@@ -88,13 +88,17 @@ def chaos_check(session: nox.Session) -> None:
     the disaggregation suite (docs/SCALING.md "Disaggregated roles")
     with its dead-prefill-replica scenario — a prefill replica killed
     mid-handoff recovers with its role while the staged handoff
-    resumes on the decode sibling, token-identically.  Also
+    resumes on the decode sibling, token-identically; and the
+    unified-arena suite (docs/MEMORY.md) with its mixed-churn
+    acceptance — an engine killed with a mixed KV+adapter working set
+    over HBM recovers with no cross-type page corruption.  Also
     runs inside the tier-1 suite; this session is the fast standalone
     entry point."""
     session.install("-e", ".[tests]")
     session.run(
         "pytest", "tests/test_supervisor.py", "tests/test_adapter_pool.py",
         "tests/test_kv_tier.py", "tests/test_disagg.py",
+        "tests/test_arena.py",
         "-q",
         *session.posargs,
         env={"JAX_PLATFORMS": "cpu"},

@@ -529,11 +529,14 @@ def build_tiny_gpt2(path: str, seed: int = 0) -> str:
     return str(out)
 
 
-def build_tiny_lora_adapter(path: str, seed: int = 7, rank: int = 4) -> str:
+def build_tiny_lora_adapter(path: str, seed: int = 7, rank: int = 4,
+                            arch: dict | None = None) -> str:
     """PEFT-format LoRA adapter matching the tiny llama fixture: real
     random A/B weights on q/v projections of both layers (the reference's
     fixture adapters carry dummy weights; ours are live so generation
-    with the adapter measurably diverges from the base model)."""
+    with the adapter measurably diverges from the base model).
+    ``arch`` overrides the fixture config for non-tiny hosts (same keys
+    as TINY_LLAMA_CONFIG)."""
     import json as json_mod
 
     import numpy as np
@@ -541,7 +544,7 @@ def build_tiny_lora_adapter(path: str, seed: int = 7, rank: int = 4) -> str:
 
     out = Path(path)
     out.mkdir(parents=True, exist_ok=True)
-    cfg = TINY_LLAMA_CONFIG
+    cfg = arch or TINY_LLAMA_CONFIG
     d = cfg["hidden_size"]
     dh = cfg["head_dim"]
     h = cfg["num_attention_heads"]

@@ -477,3 +477,127 @@ def test_kv_cache_dtype_rejects_unserved_layouts(tiny_model_dir):
     args = argparse.Namespace(**{**vars(args), "kv_cache_dtype": "int4"})
     with pytest.raises(ValueError, match="kv-quantization"):
         EngineConfig.from_args(args)
+
+
+# ------------------------------------- calibrated scale floors (ISSUE 14)
+
+
+def test_calibrated_floor_raises_page_scale_at_slot0():
+    """A checkpoint-calibrated k/v scale FLOORS the slot-0 amax scale
+    (outlier-prone heads keep the calibrated headroom) without ever
+    SHRINKING an amax that genuinely exceeds it — and appends still
+    never move the stored scale."""
+    floor = np.asarray([[0.5, 0.001]], np.float32)  # [L=1, H=2]
+    cache = kv_quant.make_kv_cache(
+        (1, 2, 8 * 16, 32), jnp.float32, "int8", 16, scale_floor=floor
+    )
+    vals = jnp.ones((1, 2, 32), jnp.float32)
+    cache = kv_quant.scatter_layer(
+        cache, 0, jnp.asarray([0], jnp.int32), vals
+    )
+    got = np.asarray(cache.scale[0][:, 0])
+    amax_scale = kv_quant.SCALE_MARGIN / 127.0  # ~0.0157
+    # head 0: floored at 0.5 (calibration wins over amax)
+    np.testing.assert_allclose(got[0], 0.5, rtol=1e-6)
+    # head 1: amax wins over the tiny floor
+    np.testing.assert_allclose(got[1], amax_scale, rtol=1e-6)
+    # appends keep the floored scale (append-consistency holds)
+    cache = kv_quant.scatter_layer(
+        cache, 0, jnp.asarray([1], jnp.int32),
+        jnp.full((1, 2, 32), 3.0, jnp.float32),
+    )
+    np.testing.assert_allclose(
+        np.asarray(cache.scale[0][:, 0]), got, rtol=1e-6
+    )
+    # page movement carries the floor through (pytree child survives)
+    moved = kv_quant.restore_kv_page(
+        cache, cache, jnp.arange(16, dtype=jnp.int32),
+        *kv_quant.gather_kv_page(cache, cache, jnp.arange(16, dtype=jnp.int32)),
+    )
+    assert moved[0].floor is not None
+    np.testing.assert_allclose(np.asarray(moved[0].floor), floor)
+
+
+def test_calibrated_checkpoint_floors_load_and_apply(tmp_path):
+    """A synthetic calibrated checkpoint (k_scale/v_scale tensors per
+    layer) surfaces [L, Hkv] floors through the loader, the runner
+    pops them off the params pytree, and the quantized caches carry
+    them (ISSUE 14 satellite)."""
+    import os
+
+    from safetensors.numpy import load_file, save_file
+
+    from tests.fixture_models import TINY_LLAMA_CONFIG, build_tiny_llama
+
+    from vllm_tgis_adapter_tpu.engine.config import (
+        CacheConfig,
+        EngineConfig,
+        LoRAConfig,
+        ModelConfig,
+        ParallelConfig,
+        SchedulerConfig,
+    )
+    from vllm_tgis_adapter_tpu.engine.core import LLMEngine
+    from vllm_tgis_adapter_tpu.engine.weights import load_model_params
+
+    model_dir = str(tmp_path / "calib")
+    build_tiny_llama(model_dir)
+    st = os.path.join(model_dir, "model.safetensors")
+    tensors = dict(load_file(st))
+    hkv = TINY_LLAMA_CONFIG["num_key_value_heads"]
+    # layer 0: scalar k_scale (broadcasts over heads) + per-head v
+    tensors["model.layers.0.self_attn.k_scale"] = np.asarray(
+        [0.25], np.float32
+    )
+    tensors["model.layers.0.self_attn.v_scale"] = np.linspace(
+        0.1, 0.2, hkv
+    ).astype(np.float32)
+    save_file(tensors, st)
+
+    mcfg = ModelConfig.from_pretrained(model_dir, dtype="float32")
+    params = load_model_params(mcfg, model_dir)
+    k_floors, v_floors = params["kv_scale_floors"]
+    assert k_floors.shape == (mcfg.num_layers, hkv)
+    np.testing.assert_allclose(k_floors[0], 0.25)
+    np.testing.assert_allclose(k_floors[1], 0.0)  # layer 1 uncalibrated
+    np.testing.assert_allclose(v_floors[0, -1], 0.2)
+
+    engine = LLMEngine.from_config(EngineConfig(
+        model_config=mcfg,
+        cache_config=CacheConfig(
+            block_size=16, num_blocks=32, cache_dtype=mcfg.dtype,
+            kv_quantization="int8",
+        ),
+        scheduler_config=SchedulerConfig(
+            max_num_seqs=2, prefill_buckets=(32, 64)),
+        parallel_config=ParallelConfig(),
+        lora_config=LoRAConfig(),
+    ))
+    k_cache, v_cache = engine.runner.caches
+    assert k_cache.floor is not None
+    np.testing.assert_allclose(np.asarray(k_cache.floor)[0], 0.25)
+    # the sidecar never leaked into the jitted params pytree
+    assert "kv_scale_floors" not in engine.runner.params
+    # and the engine still serves (floored scales participate in the
+    # real scatter path)
+    from vllm_tgis_adapter_tpu.engine.sampling_params import (
+        SamplingParams,
+    )
+
+    engine.add_request(
+        "c", None,
+        SamplingParams(temperature=0.0, max_tokens=4, ignore_eos=True),
+        prompt_token_ids=list(range(3, 40)),
+    )
+    done = False
+    for _ in range(200):
+        if not engine.has_unfinished_requests():
+            done = True
+            break
+        for out in engine.step():
+            pass
+    assert done
+    scale0 = np.asarray(k_cache.scale[0])
+    assert (scale0[scale0 > 0] >= 0.25 - 1e-6).all(), (
+        "written pages ignored the calibrated floor"
+    )

@@ -47,7 +47,11 @@ DEBUG_STATE_KEYS = (
     "events",
 )
 REPLICA_KEYS = ("scheduler", "kv_cache", "in_flight", "step_counter",
-                "serving", "role", "adapter_pool")
+                "serving", "role", "adapter_pool", "arena")
+# kv_host_tier section: the per-rung split (ISSUE 14 satellite — the
+# host and disk budgets must never read as one silently-summed number)
+KV_TIER_KEYS = ("tiers",)
+KV_TIER_TIERS = ("host", "disk")
 # router-section keys the doc promises (incl. the disaggregation
 # additions: per-role queue depths and handoff outcomes)
 ROUTER_KEYS = ("placed_by_policy", "affinity_hit_rate",
@@ -165,6 +169,15 @@ def main() -> int:
     router = state.get("router") or {}
     state_missing += [
         f"router.{k}" for k in ROUTER_KEYS if k not in router
+    ]
+    kv_tier = state.get("kv_host_tier") or {}
+    state_missing += [
+        f"kv_host_tier.{k}" for k in KV_TIER_KEYS if k not in kv_tier
+    ]
+    tiers = kv_tier.get("tiers") or {}
+    state_missing += [
+        f"kv_host_tier.tiers.{k}" for k in KV_TIER_TIERS
+        if k not in tiers
     ]
     if state_missing:
         print(

@@ -379,6 +379,56 @@ def measure_quant(q_cfg: dict, runs: int) -> tuple[dict, dict | None]:
     return best, weight_line
 
 
+def measure_unified(u_cfg: dict, runs: int) -> dict:
+    """ISSUE 14 gate driver (docs/MEMORY.md): the unified-arena tiered
+    memory measurement (tools/scenarios.py --unified-gate) — a mixed
+    RAG + adapter-churn working set >= 4x the device pool served
+    through arena + host tier + disk tier; cold pass populates, warm
+    pass must hit.  Best of ``runs`` = lowest warm/cold TTFT ratio."""
+    best = None
+    for _ in range(max(1, runs)):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        proc = subprocess.run(
+            [
+                sys.executable,
+                str(REPO_ROOT / "tools" / "scenarios.py"),
+                "--unified-gate",
+            ],
+            capture_output=True, text=True, timeout=900, env=env,
+        )
+        line = None
+        for candidate in reversed(proc.stdout.strip().splitlines()):
+            try:
+                parsed = json.loads(candidate)
+            except ValueError:
+                continue
+            if isinstance(parsed, dict) and parsed.get("kind") == "unified":
+                line = parsed
+                break
+        if proc.returncode != 0 or line is None:
+            raise RuntimeError(
+                f"scenarios --unified-gate failed rc={proc.returncode}: "
+                f"{proc.stderr[-400:]}"
+            )
+        if (
+            best is None
+            or line["warm_cold_ratio"] < best["warm_cold_ratio"]
+        ):
+            best = line
+    print(
+        f"perf_check: unified  working set "
+        f"{best['working_set_ratio']}x HBM, warm TTFT "
+        f"{best['ttft_ms_p50_warm']}ms vs cold "
+        f"{best['ttft_ms_p50_cold']}ms ({best['warm_cold_ratio']}x), "
+        f"{best['completed']}/{best['offered']} completed, disk "
+        f"{best['tier']['disk']['stored_pages']} stored / "
+        f"{best['tier']['disk']['loaded_pages']} loaded, arena "
+        f"charges {best['arena']['adapter_charges']}"
+    )
+    return best
+
+
 def main(argv: list[str] | None = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     write = "--write" in argv
@@ -486,6 +536,15 @@ def main(argv: list[str] | None = None) -> int:
             print(f"perf_check: quant measurement failed: {exc}")
             return 2
 
+    u_cfg = baseline.get("unified")
+    u_line: dict | None = None
+    if u_cfg:
+        try:
+            u_line = measure_unified(u_cfg, int(u_cfg.get("runs", 1)))
+        except Exception as exc:  # noqa: BLE001 — tool boundary
+            print(f"perf_check: unified measurement failed: {exc}")
+            return 2
+
     if write:
         out = {
             "_comment": (
@@ -554,6 +613,11 @@ def main(argv: list[str] | None = None) -> int:
                     else {}
                 ),
             }
+        if u_cfg:
+            # declarative: the <=0.5x warm/cold bound, the >=4x working
+            # set, and the zero-deadlock completion demand are the
+            # ISSUE 14 acceptance criteria, not measured floors
+            out["unified"] = dict(u_cfg)
         if dp_cfg:
             out["dp"] = {
                 **dp_cfg,
@@ -860,6 +924,50 @@ def main(argv: list[str] | None = None) -> int:
                         f"({base_bytes}) > allowed {max_ratio}x — "
                         "int8 weight quantization stopped saving HBM"
                     )
+
+    if u_cfg and u_line is not None:
+        # ISSUE 14 acceptance: mixed RAG + adapter-churn working set
+        # >= min_working_set_ratio x the device pool sustains warm-hit
+        # TTFT <= max_warm_cold_ratio x cold, with zero allocation
+        # deadlocks (every offered request completed) and the full
+        # hierarchy demonstrably exercised (host evictions cascaded to
+        # the disk tier, disk promotions served, arena charges flowed)
+        max_ratio = float(u_cfg.get("max_warm_cold_ratio", 0.5))
+        if u_line["warm_cold_ratio"] > max_ratio:
+            failures.append(
+                f"unified: warm TTFT p50 {u_line['ttft_ms_p50_warm']}ms "
+                f"is {u_line['warm_cold_ratio']}x cold "
+                f"({u_line['ttft_ms_p50_cold']}ms) > allowed {max_ratio}x"
+            )
+        min_ws = float(u_cfg.get("min_working_set_ratio", 4.0))
+        if u_line["working_set_ratio"] < min_ws:
+            failures.append(
+                f"unified: working set {u_line['working_set_ratio']}x "
+                f"the device pool < required {min_ws}x (the gate "
+                "stopped oversubscribing HBM)"
+            )
+        if u_line["completed"] != u_line["offered"]:
+            failures.append(
+                f"unified: {u_line['completed']}/{u_line['offered']} "
+                "requests completed — an allocation deadlock (or shed) "
+                "under arena pressure"
+            )
+        disk = u_line["tier"]["disk"] or {}
+        if not disk.get("stored_pages"):
+            failures.append(
+                "unified: the disk tier stored nothing — host "
+                "evictions stopped cascading down the hierarchy"
+            )
+        if not disk.get("loaded_pages"):
+            failures.append(
+                "unified: the disk tier served nothing — promotions "
+                "never walked disk→host→device"
+            )
+        if not (u_line.get("arena") or {}).get("adapter_charges"):
+            failures.append(
+                "unified: the arena charged no adapters — the unified "
+                "budget was not exercised"
+            )
 
     if failures:
         print("perf_check: REGRESSION")

@@ -56,9 +56,13 @@ from vllm_tgis_adapter_tpu.logging import init_logger
 logger = init_logger(__name__)
 
 
-def _update_slot(stacks: LoRAStacks, slot, a_blocks, b_blocks, scale):  # noqa: ANN001
-    """One adapter's blocks → its device slot (jitted once; ``slot`` is
-    traced so every swap reuses the same program)."""
+def _update_slot(stacks: LoRAStacks, slot, a_blocks, b_blocks, scale,
+                 rank):  # noqa: ANN001
+    """One adapter's blocks → its device slot (jitted once; ``slot``
+    and ``rank`` are traced so every swap reuses the same program).
+    ``rank`` is the adapter's rank BUCKET for the heterogeneous-rank
+    gathered matmul; with gathering off (``stacks.ranks is None``, a
+    static property of the pytree) it is carried but unused."""
     a = {
         t: stacks.a[t].at[:, slot].set(a_blocks[t]) for t in stacks.a
     }
@@ -66,7 +70,11 @@ def _update_slot(stacks: LoRAStacks, slot, a_blocks, b_blocks, scale):  # noqa: 
         t: stacks.b[t].at[:, slot].set(b_blocks[t]) for t in stacks.b
     }
     return LoRAStacks(
-        a=a, b=b, scaling=stacks.scaling.at[slot].set(scale)
+        a=a, b=b, scaling=stacks.scaling.at[slot].set(scale),
+        ranks=(
+            None if stacks.ranks is None
+            else stacks.ranks.at[slot].set(rank)
+        ),
     )
 
 
@@ -80,11 +88,19 @@ class AdapterPool:
         max_lora_rank: int,
         put_fn: Callable,
         prefetch_concurrency: int = 2,
+        gathered: bool = True,
     ):
         self.mcfg = model_config
         self.max_loras = max_loras
         self.max_rank = max_lora_rank
         self._put = put_fn
+        # heterogeneous-rank gathered matmul (docs/LORA.md): stacks
+        # carry a per-slot rank-bucket operand the model dispatches on
+        self.gathered = gathered
+        # unified paged arena (engine/arena.py, set by the engine core):
+        # device residency charges true-rank pages against the shared
+        # KV+adapter block budget; None = pre-arena fixed-slot behavior
+        self.arena = None
         # host→device block builds allowed in flight at once; the final
         # slot scatter is serialized by _stream_lock regardless
         self.prefetch_concurrency = max(1, prefetch_concurrency)
@@ -136,7 +152,12 @@ class AdapterPool:
                 np.zeros((layers, s_count, self.max_rank, dout), np.float32)
             )
         return LoRAStacks(
-            a=a, b=b, scaling=self._put(np.zeros(s_count, np.float32))
+            a=a, b=b, scaling=self._put(np.zeros(s_count, np.float32)),
+            ranks=(
+                self._put(np.zeros(s_count, np.int32))
+                if self.gathered
+                else None
+            ),
         )
 
     def release(self) -> None:
@@ -147,6 +168,10 @@ class AdapterPool:
         self.stacks = None
         self._slots.clear()
         self._lru.clear()
+        if self.arena is not None:
+            # the dying pool's charges return to the budget (the
+            # replacement engine's pool starts uncharged)
+            self.arena.release_pool(self)
 
     def close(self) -> None:
         """Terminal shutdown: stop accepting prefetches and cancel any
@@ -156,6 +181,8 @@ class AdapterPool:
             cancel = getattr(task, "cancel", None)
             if cancel is not None:
                 cancel()
+        if self.arena is not None:
+            self.arena.release_pool(self)
 
     # --------------------------------------------------------- residency
 
@@ -167,6 +194,51 @@ class AdapterPool:
     @property
     def num_resident(self) -> int:
         return len(self._slots)
+
+    def resident_names(self) -> list[str]:
+        """Committed residents — the arena's eviction candidate set."""
+        return list(self._slots)
+
+    def last_touch(self, lora_name: str) -> float:
+        """Last-touch monotonic time of a resident adapter (the
+        adapter side of the arena's unified LRU comparison)."""
+        return self._lru.get(lora_name, 0.0)
+
+    def evict_resident(self, lora_name: str) -> None:
+        """Evict ONE named resident adapter (arena reclaim under KV or
+        sibling-adapter pressure).  Host registry entry and pins are
+        untouched — the adapter falls back to host-RAM residency and
+        re-streams on next use; callers must never pass a pinned name
+        (the arena filters through ``manager.pinned``)."""
+        slot = self._slots.pop(lora_name, None)
+        self._lru.pop(lora_name, None)
+        if slot is None:
+            return
+        self._free.append(slot)
+        self.swaps_out += 1
+        self._count_swap("out")
+        if self.arena is not None:
+            self.arena.release_adapter(self, lora_name)
+
+    def _charge(self, lora_name: str, weights) -> bool:  # noqa: ANN001
+        """Reserve this adapter's true-rank page cost in the arena
+        (no-op pre-arena).  False = budget exhausted by live work; the
+        request parks exactly like a slot-pressure miss."""
+        if self.arena is None:
+            return True
+        from vllm_tgis_adapter_tpu.engine.lora import adapter_page_cost
+
+        return self.arena.charge_adapter(
+            self, lora_name,
+            adapter_page_cost(
+                self.mcfg, weights.rank, self.max_rank,
+                self.arena.kv_page_bytes,
+            ),
+        )
+
+    def _uncharge(self, lora_name: str) -> None:
+        if self.arena is not None:
+            self.arena.release_adapter(self, lora_name)
 
     def note_lookup(self, lora_name: str, replica: int = 0) -> None:
         """Admission-time hit/miss accounting — counted ONCE per
@@ -202,7 +274,14 @@ class AdapterPool:
         if slot is not None:
             self._lru[lora_name] = time.monotonic()
             return slot
-        if self.manager is None or self.manager.get_weights(lora_name) is None:
+        if self.manager is None:
+            return 0
+        if self.manager.get_weights(lora_name) is None:
+            if self.manager.request_disk_restore(lora_name):
+                # the adapter is spilled to the disk tier: PARK while
+                # it restores disk→host (then host→device streams it —
+                # the full promotion walk, docs/MEMORY.md)
+                return None
             # debug, not warning: the gate retries this every schedule
             # attempt and the condition is the documented legacy
             # behavior, not a fault
@@ -244,6 +323,12 @@ class AdapterPool:
             # every slot is pinned by live rows: the request stays
             # parked; the gate re-prefetches once a pin releases
             return False
+        if not self._charge(lora_name, weights):
+            # unified-arena budget exhausted by live KV + pinned
+            # adapters: park, exactly like slot pressure — the gate
+            # retries as work drains
+            self._free.append(slot)
+            return False
         try:
             loop = asyncio.get_running_loop()
         except RuntimeError:
@@ -262,6 +347,7 @@ class AdapterPool:
                 )
                 if lora_name not in self._slots:
                     self._free.append(slot)
+                    self._uncharge(lora_name)
                 return False
             return True
         self._streaming[lora_name] = loop.create_task(
@@ -287,6 +373,7 @@ class AdapterPool:
         self._lru.pop(victim, None)
         self.swaps_out += 1
         self._count_swap("out")
+        self._uncharge(victim)
         logger.info("adapter pool: evicting %s from slot %d", victim, slot)
         return slot
 
@@ -301,6 +388,10 @@ class AdapterPool:
             self._free.append(slot)
             self.swaps_out += 1
             self._count_swap("out")
+        if lora_name not in self._streaming:
+            # a streaming name keeps its charge until its commit/abort
+            # path settles it (the _invalidated flag routes it there)
+            self._uncharge(lora_name)
 
     def _build_device_blocks(self, weights):  # noqa: ANN001
         """Worker-thread half: host block assembly + device transfer of
@@ -313,15 +404,18 @@ class AdapterPool:
             {t: self._put(v) for t, v in b_blocks.items()},
         )
 
-    def _apply(self, slot: int, a_dev, b_dev, scaling: float):  # noqa: ANN001
+    def _apply(self, slot: int, a_dev, b_dev, scaling: float,
+               rank: int):  # noqa: ANN001
         """Worker-thread half: scatter one adapter's device blocks into
-        its slot.  One compiled program for every (adapter, slot)."""
+        its slot.  One compiled program for every (adapter, slot) —
+        the rank bucket is a traced operand, never a compile shape."""
         return self._update_fn(
             self.stacks,
             np.int32(slot),
             a_dev,
             b_dev,
             np.float32(scaling),
+            np.int32(rank),
         )
 
     def _commit(self, lora_name: str, slot: int, new_stacks) -> None:  # noqa: ANN001
@@ -329,6 +423,7 @@ class AdapterPool:
             self._invalidated.discard(lora_name)
             if not self._closed:
                 self._free.append(slot)
+                self._uncharge(lora_name)
             return
         self.stacks = new_stacks
         if self.on_commit is not None:
@@ -341,10 +436,17 @@ class AdapterPool:
         )
         self._count_swap("in")
 
+    def _rank_bucket(self, weights) -> int:  # noqa: ANN001
+        from vllm_tgis_adapter_tpu.engine.lora import rank_bucket
+
+        return rank_bucket(weights.rank, self.max_rank)
+
     def _stream_blocking(self, lora_name: str, weights, slot: int) -> None:  # noqa: ANN001
         t0 = time.monotonic()
         a_dev, b_dev = self._build_device_blocks(weights)
-        new_stacks = self._apply(slot, a_dev, b_dev, weights.scaling)
+        new_stacks = self._apply(
+            slot, a_dev, b_dev, weights.scaling, self._rank_bucket(weights)
+        )
         self._commit(lora_name, slot, new_stacks)
         self._observe_prefetch(time.monotonic() - t0)
 
@@ -361,7 +463,8 @@ class AdapterPool:
             # streams so no update is built on a stale base and lost
             async with self._stream_lock:
                 new_stacks = await asyncio.to_thread(
-                    self._apply, slot, a_dev, b_dev, weights.scaling
+                    self._apply, slot, a_dev, b_dev, weights.scaling,
+                    self._rank_bucket(weights),
                 )
                 self._commit(lora_name, slot, new_stacks)
             self._observe_prefetch(time.monotonic() - t0)
@@ -372,6 +475,7 @@ class AdapterPool:
             )
             if not self._closed and lora_name not in self._slots:
                 self._free.append(slot)
+                self._uncharge(lora_name)
         finally:
             self._streaming.pop(lora_name, None)
             self._invalidated.discard(lora_name)

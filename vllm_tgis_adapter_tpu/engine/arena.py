@@ -1,0 +1,364 @@
+"""Unified paged HBM arena: ONE block budget for KV pages + adapter shards.
+
+Before this module, the device's two big consumers were separately
+budgeted: ``kv_cache.BlockAllocator`` owned the KV page pool and
+``adapter_pool.AdapterPool`` owned fixed adapter slots — so HBM headroom
+could not flow between a KV-heavy RAG burst and an adapter-heavy
+multi-tenant burst, the half of S-LoRA's insight the repo had not yet
+adopted (PAPERS.md; ROADMAP item 3).  The arena merges the two into one
+paged budget with unified LRU + pinning semantics (docs/MEMORY.md):
+
+* **Typed pages, single budget.**  Every page of the budget is either a
+  KV page (owned by the allocator's refcounts / prefix cache) or an
+  adapter-shard page (charged when an adapter becomes device-resident).
+  An adapter's charge is priced by its TRUE rank bucket — a rank-8
+  adapter on a ``--max-lora-rank 64`` server charges ~1/8th of the
+  padded cost — so the heterogeneous-rank gathered matmul's storage
+  accounting and the budget agree (engine/lora.py ``adapter_page_cost``).
+
+* **Unified LRU scoring.**  When either workload needs pages, the arena
+  reclaims whichever cold resident scores worst: freed-but-registered
+  KV pages carry their park timestamp (``BlockAllocator``'s cached-free
+  LRU) and unpinned resident adapters carry their last-touch timestamp
+  (``AdapterPool._lru``); the older one is evicted first.  Existing
+  safety semantics are preserved verbatim — KV evictions still demote
+  into the host tier through ``evict_hook``, adapter evictions fall
+  back to the host registry (weights stay in ``LoRAManager`` host RAM,
+  or the disk tier beneath it), pinned adapters and refcounted KV pages
+  are never touched, and the prefix-cache hash walk is unchanged.
+
+* **Charge = physical reservation.**  An adapter charge RESERVES page
+  ids out of the allocator (``allocate``), so ``num_free``, the
+  scheduler's ``can_allocate`` checks, preemption pressure and the
+  /debug/state occupancy all see one truthful number without learning
+  anything about adapters.  The reserved ids are idle while charged
+  (the shard bytes physically live in the pool's stacked tensors, whose
+  boot-time cap ``resolve_num_blocks`` already prices); releasing the
+  charge returns them to the KV side.
+
+A floor (``min_kv_reserve``) keeps adapter pressure from starving the
+KV side below one max-length sequence — past it, adapter prefetches
+simply park (the existing adapter-gate contract) until KV work drains.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from vllm_tgis_adapter_tpu.logging import init_logger
+
+logger = init_logger(__name__)
+
+
+class UnifiedArena:
+    """Typed-page accounting over ONE BlockAllocator's block budget."""
+
+    #: an adapter is evictable under CROSS-type pressure only after
+    #: this many seconds idle.  Without the floor, a transient KV
+    #: shortfall evicts the LRU-oldest adapter even when it was touched
+    #: milliseconds ago (hot round-robin tenants make SOMEONE oldest),
+    #: and the very next request re-streams it — a ping-pong that trades
+    #: a cheap page preemption/recompute for an expensive host→device
+    #: adapter transfer, over and over (the ISSUE 8 churn gate caught
+    #: exactly this).  Genuinely cold adapters (the multi-tenant burst
+    #: tail) still fund KV demand; hot ones keep residency and KV falls
+    #: back to its pre-arena preemption behavior.
+    ADAPTER_MIN_IDLE_S = 2.0
+
+    def __init__(
+        self,
+        allocator,  # noqa: ANN001 — kv_cache.BlockAllocator
+        kv_page_bytes: int,
+        min_kv_reserve: int = 0,
+        adapter_budget_pages: int = 0,
+    ):
+        self.allocator = allocator
+        self.kv_page_bytes = max(1, int(kv_page_bytes))
+        # pages the KV side is guaranteed even under full adapter
+        # pressure: one max-length sequence by default so the
+        # scheduler's "prompt can never fit" refusal threshold is
+        # unchanged by adapter residency — but never more than HALF
+        # the pool (a tiny pool must still admit adapters; liveness
+        # beats a reserve nobody sized deliberately)
+        self.min_kv_reserve = min(
+            int(min_kv_reserve), max(0, allocator.num_blocks // 2)
+        )
+        # the adapter side's OWN budget, in KV-page units: the
+        # boot-time reservation the physical slot stacks already carve
+        # out of HBM (kv_cache._lora_stack_bytes — resolve_num_blocks
+        # subtracts it before sizing the KV pool).  Charges consume
+        # this reservation FIRST; only the overflow BORROWS page ids
+        # from the KV allocator.  Charging everything out of the KV
+        # pool instead would double-count the reservation and put a
+        # previously comfortable pool under permanent pressure — the
+        # hot-adapter eviction ping-pong the ISSUE 8 churn gate
+        # caught.  With today's padded slot stacks the true-rank sum
+        # never exceeds the padded cap, so borrowing engages only when
+        # callers size the budget BELOW the cap (and for the future
+        # page-granular shard storage — ROADMAP item 3a).
+        self.adapter_budget_pages = max(0, int(adapter_budget_pages))
+        self.adapter_reserve_used = 0
+        # pools drawing adapter pages from this arena (one per runner;
+        # dp replicas each have their own arena over their own pool)
+        self._pools: list = []
+        # (pool_id, adapter_name) -> (reserve_pages, borrowed page ids)
+        self._charges: dict[tuple[int, str], tuple[int, list[int]]] = {}
+        self.adapter_blocks = 0
+        self.borrowed_blocks = 0
+        # lifetime stats (debug_state / tests)
+        self.adapter_charges = 0
+        self.adapter_releases = 0
+        self.kv_reclaims = 0  # adapters evicted under KV pressure
+        self.adapter_funded_by_kv = 0  # cold KV pages consumed by charges
+        self._reclaiming = False
+
+    # ------------------------------------------------------------- wiring
+
+    def attach_pool(self, pool) -> None:  # noqa: ANN001 — AdapterPool
+        if pool not in self._pools:
+            self._pools.append(pool)
+
+    # ----------------------------------------------------- adapter charges
+
+    def charge_adapter(self, pool, name: str, pages: int) -> bool:  # noqa: ANN001
+        """Charge ``pages`` of the budget for one adapter becoming
+        device-resident: the adapter reservation funds it first, and
+        only the OVERFLOW borrows page ids from the KV allocator — in
+        unified-LRU order, free pages → whichever of (coldest cached
+        KV page, coldest idle unpinned adapter) is older, KV evictions
+        demoting into the host tier via the allocator's evict hook.
+        Returns False (the request parks, the existing adapter-gate
+        contract) when the overflow cannot be funded without dropping
+        the KV side below ``min_kv_reserve`` or touching pinned/live
+        pages."""
+        key = (id(pool), name)
+        if key in self._charges:
+            return True
+        pages = max(1, int(pages))
+        alloc = self.allocator
+        reserve_free = self.adapter_budget_pages - self.adapter_reserve_used
+        from_reserve = min(pages, max(0, reserve_free))
+        borrow = pages - from_reserve
+        if borrow > alloc.num_blocks - self.min_kv_reserve:
+            # this adapter could NEVER be charged, even alone — the
+            # whole budget is smaller than one adapter.  Grant an
+            # uncharged residency instead of parking its requests
+            # forever: liveness exactly as pre-arena, with the
+            # shortfall visible in the stats.
+            logger.warning(
+                "arena: adapter %s needs %d pages but the budget caps "
+                "adapter residency at %d reserved + %d borrowable — "
+                "granting UNCHARGED residency",
+                name, pages, self.adapter_budget_pages,
+                alloc.num_blocks - self.min_kv_reserve,
+            )
+            self._charges[key] = (0, [])
+            self.adapter_charges += 1
+            return True
+        blocks: list[int] = []
+        if borrow:
+            if (
+                self.borrowed_blocks + borrow
+                > alloc.num_blocks - self.min_kv_reserve
+            ):
+                # borrow cap: evicting colder BORROWING adapters can
+                # still fund this (hotter displaces colder)
+                if not self._evict_adapters_until(
+                    lambda: self.borrowed_blocks + borrow
+                    <= alloc.num_blocks - self.min_kv_reserve,
+                    skip=key,
+                ):
+                    return False
+                reserve_free = (
+                    self.adapter_budget_pages - self.adapter_reserve_used
+                )
+                from_reserve = min(pages, max(0, reserve_free))
+                borrow = pages - from_reserve
+        if borrow:
+            # cross-type LRU: prefer evicting an idle unpinned
+            # BORROWING adapter COLDER than the allocator's coldest
+            # cached page before allocate() consumes that (warmer) KV
+            # content — reserve-only adapters free no allocator pages,
+            # so evicting them here would burn re-streams for nothing
+            while len(alloc._free) < borrow:  # noqa: SLF001
+                kv_ts = alloc.oldest_cached_ts()
+                victim = self._coldest_adapter(
+                    skip=key, borrowers_only=True
+                )
+                if victim is not None and (
+                    kv_ts is None or victim[2] < kv_ts
+                ):
+                    self._evict_adapter(victim[0], victim[1])
+                    continue
+                break  # cached KV (if any) is colder; allocate() takes it
+            if not alloc.can_allocate(borrow):
+                # everything left is refcounted live KV: park
+                return False
+            before_cached = len(alloc._cached_free)  # noqa: SLF001
+            blocks = alloc.allocate(borrow)
+            self.adapter_funded_by_kv += max(
+                0, before_cached - len(alloc._cached_free)  # noqa: SLF001
+            )
+        self._charges[key] = (from_reserve, blocks)
+        self.adapter_reserve_used += from_reserve
+        self.adapter_blocks += pages
+        self.borrowed_blocks += len(blocks)
+        self.adapter_charges += 1
+        return True
+
+    def release_adapter(self, pool, name: str) -> None:  # noqa: ANN001
+        """Return one adapter's charge to the budget (device eviction /
+        invalidation / pool teardown)."""
+        got = self._charges.pop((id(pool), name), None)
+        if got is None:
+            return
+        from_reserve, blocks = got
+        self.adapter_reserve_used -= from_reserve
+        self.adapter_blocks -= from_reserve + len(blocks)
+        self.borrowed_blocks -= len(blocks)
+        self.adapter_releases += 1
+        if blocks:
+            # epoch-bypassing release: borrowed pages were never
+            # writable by KV programs (kv_cache.free_reserved)
+            self.allocator.free_reserved(blocks)
+
+    def release_pool(self, pool) -> None:  # noqa: ANN001
+        """Drop every charge a (dying) pool holds."""
+        for key in [k for k in self._charges if k[0] == id(pool)]:
+            from_reserve, blocks = self._charges.pop(key)
+            self.adapter_reserve_used -= from_reserve
+            self.adapter_blocks -= from_reserve + len(blocks)
+            self.borrowed_blocks -= len(blocks)
+            if blocks:
+                self.allocator.free_reserved(blocks)
+        self._pools = [p for p in self._pools if p is not pool]
+
+    # --------------------------------------------------------- KV pressure
+
+    def fund_kv(self, need: int) -> None:
+        """KV demand (``BlockAllocator.can_allocate`` shortfall): evict
+        cold idle unpinned adapters HOLDING BORROWED PAGES — in
+        unified-LRU order against the allocator's own cached pages —
+        until ``need`` pages are allocatable or no such adapter
+        remains.  Reservation-backed charges yield nothing the KV side
+        can use, so they are never evicted for KV; the allocator then
+        proceeds (or the scheduler preempts) exactly as before."""
+        if self._reclaiming or not self.borrowed_blocks:
+            return
+        alloc = self.allocator
+        self._reclaiming = True
+        try:
+            while not alloc.can_allocate(need):
+                victim = self._coldest_adapter(borrowers_only=True)
+                if victim is None:
+                    return
+                self._evict_adapter(victim[0], victim[1])
+            # free+cached now suffice; still prefer evicting borrowers
+            # COLDER than the cached KV content allocate() would destroy
+            while len(alloc._free) < need:  # noqa: SLF001
+                kv_ts = alloc.oldest_cached_ts()
+                victim = self._coldest_adapter(borrowers_only=True)
+                if victim is None or (
+                    kv_ts is not None and kv_ts <= victim[2]
+                ):
+                    return
+                self._evict_adapter(victim[0], victim[1])
+        finally:
+            self._reclaiming = False
+
+    # ------------------------------------------------------------ eviction
+
+    def _coldest_adapter(
+        self, skip: Optional[tuple] = None, borrowers_only: bool = False
+    ) -> Optional[tuple]:
+        """(pool, name, last_touch) of the coldest evictable charged
+        adapter — honoring pins AND the idle floor — or None.
+        ``borrowers_only`` restricts to charges holding borrowed KV
+        pages (the only evictions that help a KV shortfall)."""
+        best = None
+        horizon = time.monotonic() - self.ADAPTER_MIN_IDLE_S
+        for pool in self._pools:
+            manager = getattr(pool, "manager", None)
+            for name in pool.resident_names():
+                if skip is not None and (id(pool), name) == skip:
+                    continue
+                charge = self._charges.get((id(pool), name))
+                if charge is None:
+                    continue
+                if borrowers_only and not charge[1]:
+                    continue
+                if manager is not None and manager.pinned(name):
+                    continue
+                ts = pool.last_touch(name)
+                if ts > horizon:
+                    continue  # hot: cross-type eviction would ping-pong
+                if best is None or ts < best[2]:
+                    best = (pool, name, ts)
+        return best
+
+    def _evict_adapter(self, pool, name: str) -> None:  # noqa: ANN001
+        charge = self._charges.get((id(pool), name), (0, []))
+        logger.info(
+            "arena: evicting cold adapter %s (%d pages back to the "
+            "unified budget)",
+            name, charge[0] + len(charge[1]),
+        )
+        self.kv_reclaims += 1
+        # the pool's eviction path calls release_adapter back into us
+        pool.evict_resident(name)
+
+    def _evict_adapters_until(self, done, skip=None) -> bool:  # noqa: ANN001
+        while not done():
+            victim = self._coldest_adapter(skip=skip)
+            if victim is None:
+                return False
+            self._evict_adapter(victim[0], victim[1])
+        return True
+
+    # -------------------------------------------------------------- stats
+
+    @property
+    def num_blocks(self) -> int:
+        return self.allocator.num_blocks
+
+    def debug_state(self) -> dict:
+        """``arena`` section of the per-replica /debug/state."""
+        return {
+            "total_blocks": self.allocator.num_blocks,
+            "adapter_blocks": self.adapter_blocks,
+            "adapter_budget_pages": self.adapter_budget_pages,
+            "adapter_reserve_used": self.adapter_reserve_used,
+            "borrowed_blocks": self.borrowed_blocks,
+            "kv_free_blocks": self.allocator.num_free,
+            "min_kv_reserve": self.min_kv_reserve,
+            "charged_adapters": sorted(
+                name for (_pid, name) in self._charges
+            ),
+            "adapter_charges": self.adapter_charges,
+            "adapter_releases": self.adapter_releases,
+            "kv_reclaims": self.kv_reclaims,
+            "adapter_funded_by_kv": self.adapter_funded_by_kv,
+        }
+
+    def observe(self, replica: int = 0) -> None:
+        """Push the typed-page split into the arena gauge."""
+        try:
+            from vllm_tgis_adapter_tpu import metrics
+
+            alloc = self.allocator
+            rep = str(replica)
+            metrics.arena_blocks.labels(
+                type="adapter", replica=rep
+            ).set(self.adapter_blocks)
+            # only BORROWED adapter pages came out of the allocator
+            # (reserve-funded charges never touched it), so kv_used
+            # subtracts borrowed_blocks, not the whole adapter charge
+            metrics.arena_blocks.labels(
+                type="kv_used", replica=rep
+            ).set(alloc.num_blocks - alloc.num_free - self.borrowed_blocks)
+            metrics.arena_blocks.labels(
+                type="kv_free", replica=rep
+            ).set(alloc.num_free)
+        except Exception:  # pragma: no cover — telemetry must not raise
+            pass

@@ -1093,7 +1093,17 @@ class AsyncLLMEngine:
                 ).set(host_tokens / lookups)
             tier = getattr(self.engine, "kv_tier", None)
             if tier is not None:
-                metrics.kv_host_tier_bytes.set(tier.bytes_used)
+                metrics.kv_host_tier_bytes.labels(tier="host").set(
+                    tier.bytes_used
+                )
+                if tier.disk is not None:
+                    metrics.kv_host_tier_bytes.labels(tier="disk").set(
+                        tier.disk.bytes_used
+                    )
+            for rep in self._replicas:
+                arena = getattr(rep.engine, "arena", None)
+                if arena is not None:
+                    arena.observe(rep.index)
             for rep in self._replicas:
                 # page capacity labeled by the page storage dtype: the
                 # --kv-quantization capacity lift reads directly off

@@ -808,6 +808,12 @@ class LoRAConfig:
     max_cpu_loras: int = 0
     # concurrent host→device adapter streams per pool
     prefetch_concurrency: int = 2
+    # heterogeneous-rank gathered matmul (docs/LORA.md "Gathered
+    # matmul"): stacks carry a per-slot rank-bucket operand and each
+    # row's delta computes at its TRUE pow2 rank bucket instead of
+    # padding to max_lora_rank.  False (--no-lora-gathered) restores
+    # the padded matmuls bit-for-bit.
+    gathered: bool = True
 
     def resolved_max_cpu_loras(self) -> int:
         if self.max_cpu_loras > 0:
@@ -974,6 +980,25 @@ class EngineConfig:
     # binary defaults it ON (tgis_utils/args.py, --no-kv-host-cache to
     # disable).
     kv_host_cache_gb: float = 0.0
+    # --kv-disk-cache-gb GiB of local disk beneath the host tier
+    # (engine/kv_tier.py DiskKVTier, docs/MEMORY.md): host-tier LRU
+    # victims — cold KV prefix pages AND cold adapters spilled from the
+    # host registry — land in mmap-read, checksum-validated files;
+    # promotion walks disk → host → device through the existing
+    # park/promote gates.  0 (default) disables; requires the host tier.
+    kv_disk_cache_gb: float = 0.0
+    # directory for the disk tier's entries; None = a stable path under
+    # the system tempdir.  Entries are content-addressed and validated
+    # on read, so the directory may survive restarts (cross-restart
+    # reuse) or be shared by successive server generations.
+    kv_disk_cache_dir: str | None = None
+    # unified paged HBM arena (engine/arena.py, docs/MEMORY.md): KV
+    # pages and adapter shards draw from ONE block budget with unified
+    # LRU + pinning — adapter residency charges true-rank pages, KV
+    # pressure evicts cold adapters (back to the host registry), adapter
+    # pressure evicts cold cached KV pages (demoting into the host
+    # tier).  False restores separately-budgeted pools.
+    unified_arena: bool = True
     quantization: str | None = None
     otlp_traces_endpoint: str | None = None
     disable_log_requests: bool = True
@@ -1084,6 +1109,14 @@ class EngineConfig:
                 "shared-device-tolerant); set exactly one of them > 1"
             )
         self._validate_replica_roles()
+        if self.kv_disk_cache_gb > 0 and self.kv_host_cache_gb <= 0:
+            raise ValueError(
+                "--kv-disk-cache-gb requires the host KV tier "
+                "(--kv-host-cache-gb > 0): the disk tier sits BENEATH "
+                "host RAM — demotions cascade host→disk and promotions "
+                "walk disk→host→device (docs/MEMORY.md); raise the host "
+                "budget or drop the disk flag"
+            )
         if self.watchdog_action not in ("snapshot", "restart"):
             raise ValueError(
                 f"--watchdog-action must be 'snapshot' or 'restart' "
@@ -1423,6 +1456,7 @@ class EngineConfig:
                 prefetch_concurrency=getattr(
                     args, "lora_prefetch_concurrency", 2
                 ),
+                gathered=getattr(args, "lora_gathered", True),
             ),
             speculative=SpeculativeConfig.from_args(args, model_config),
             tokenizer=args.tokenizer,
@@ -1437,6 +1471,13 @@ class EngineConfig:
                 if getattr(args, "no_kv_host_cache", False)
                 else float(getattr(args, "kv_host_cache_gb", 0.0) or 0.0)
             ),
+            kv_disk_cache_gb=(
+                0.0
+                if getattr(args, "no_kv_host_cache", False)
+                else float(getattr(args, "kv_disk_cache_gb", 0.0) or 0.0)
+            ),
+            kv_disk_cache_dir=getattr(args, "kv_disk_cache_dir", None),
+            unified_arena=getattr(args, "unified_arena", True),
             quantization=args.quantization,
             otlp_traces_endpoint=args.otlp_traces_endpoint,
             disable_log_stats=getattr(args, "disable_log_stats", False),

@@ -204,6 +204,20 @@ class LLMEngine:
                 round(config.kv_host_cache_gb * (1 << 30)),
                 config.cache_config.block_size,
             )
+            if config.kv_disk_cache_gb > 0:
+                # disk rung beneath host RAM (--kv-disk-cache-gb,
+                # docs/MEMORY.md): host LRU victims — KV pages and
+                # spilled adapters — cascade down; promotions walk
+                # disk→host→device through the same park/promote gate
+                from vllm_tgis_adapter_tpu.engine.kv_tier import (
+                    DiskKVTier,
+                )
+
+                self.kv_tier.attach_disk(DiskKVTier(
+                    round(config.kv_disk_cache_gb * (1 << 30)),
+                    directory=config.kv_disk_cache_dir,
+                    block_size=config.cache_config.block_size,
+                ))
             self._wire_kv_tier()
         elif config.kv_host_cache_gb > 0:
             logger.warning(
@@ -263,6 +277,45 @@ class LLMEngine:
             # legacy slow path: registry changes rebuild the stacks OFF
             # the event loop at load time (satellite of the pool work)
             self.lora_manager.add_resync(self)
+        if self.kv_tier is not None and self.kv_tier.disk is not None:
+            # cold adapters ride the same disk rung as cold KV pages:
+            # host-registry evictions spill, later requests restore
+            self.lora_manager.attach_disk_tier(self.kv_tier.disk)
+        # unified paged HBM arena (engine/arena.py, docs/MEMORY.md):
+        # adapter residency and KV pages draw from ONE block budget
+        # with unified LRU + pinning.  Built only where both sides
+        # exist (a paged adapter pool over the flat runner's
+        # allocator); --no-unified-arena restores split budgets.
+        self.arena = None
+        if (
+            pool is not None
+            and config.unified_arena
+            and config.parallel_config.pipeline_parallel_size == 1
+        ):
+            from vllm_tgis_adapter_tpu.engine.arena import UnifiedArena
+            from vllm_tgis_adapter_tpu.engine.kv_cache import (
+                _lora_stack_bytes,
+                per_block_bytes,
+            )
+
+            alloc = self.scheduler.allocator
+            page_bytes = per_block_bytes(config)
+            self.arena = UnifiedArena(
+                alloc,
+                kv_page_bytes=page_bytes,
+                min_kv_reserve=alloc.blocks_needed(config.max_model_len),
+                # the padded slot stacks' boot-time HBM reservation, in
+                # page units: adapter charges consume it before any KV
+                # page is borrowed (resolve_num_blocks already priced
+                # it out of the KV pool — charging the KV pool again
+                # would double-count)
+                adapter_budget_pages=-(
+                    -_lora_stack_bytes(config) // page_bytes
+                ),
+            )
+            alloc.arena = self.arena
+            self.arena.attach_pool(pool)
+            pool.arena = self.arena
 
     # ------------------------------------------------------------- lifecycle
 
@@ -365,6 +418,14 @@ class LLMEngine:
             draft_params = load_model_params(
                 draft_cfg, spec.draft_model, place=place
             )
+            # calibrated kv-scale floors are a TARGET-cache feature
+            # (runner pops them for the main params): the draft's
+            # cache follows the target scheme and greedy acceptance
+            # compares TARGET logits, so a calibrated draft checkpoint
+            # must not leak this non-layer key into the draft pytree
+            # (shard_llama_params / jitted programs would choke on it)
+            if isinstance(draft_params, dict):
+                draft_params.pop("kv_scale_floors", None)
 
         tokenizer = AutoTokenizer.from_pretrained(
             config.tokenizer or mcfg.model,
@@ -608,6 +669,11 @@ class LLMEngine:
             manager.attach_pool(pool)
         elif self.config.lora_config.enabled:
             manager.add_resync(self)
+        if (
+            self.kv_tier is not None
+            and self.kv_tier.disk is not None
+        ):
+            manager.attach_disk_tier(self.kv_tier.disk)
 
     # -------------------------------------------------------------- KV swap
 
@@ -736,6 +802,9 @@ class LLMEngine:
             return  # no flat cache to gather/scatter against
         self.kv_tier = tier
         self._wire_kv_tier()
+        if tier.disk is not None:
+            # adapter spill/restore follows the surviving tier's disk
+            self.lora_manager.attach_disk_tier(tier.disk)
 
     def _tier_demote(
         self,

@@ -12,6 +12,7 @@ from the HBM budget left after weights (engine/core.py).
 
 from __future__ import annotations
 
+import time
 from collections import deque
 from typing import TYPE_CHECKING, Optional
 
@@ -227,6 +228,10 @@ class BlockAllocator:
         self.enable_prefix_caching = enable_prefix_caching
         self._free: list[int] = list(range(num_blocks - 1, -1, -1))
         self._refcount: dict[int, int] = {}
+        # unified paged arena (engine/arena.py): when set, a shortfall
+        # consults it so cold unpinned adapters can fund KV demand
+        # before the scheduler resorts to preemption — and vice versa
+        self.arena = None
         # content-addressing state (empty unless prefix caching is on).
         # Chain keys are sha256 digests over the full token chain (seed ‖
         # page₀ ‖ … ‖ pageₚ): prompts are attacker-controlled, so the
@@ -234,6 +239,10 @@ class BlockAllocator:
         self._hash_to_block: dict[bytes, int] = {}
         self._block_hash: dict[int, bytes] = {}
         self._cached_free: dict[int, None] = {}  # LRU order: oldest first
+        # park timestamp per cached-free page — the arena's unified LRU
+        # compares these against adapter last-touch times to decide
+        # which cold resident funds a shortfall
+        self._cached_at: dict[int, float] = {}
         self.prefix_hits = 0  # tokens served from cache (stats/metrics)
         # cumulative prompt tokens of fresh admissions that consulted the
         # prefix cache — the denominator of kv_prefix_hit_rate{tier}
@@ -259,9 +268,22 @@ class BlockAllocator:
         return len(self._free) + len(self._cached_free)
 
     def can_allocate(self, n: int) -> bool:
+        if n > self.num_free and self.arena is not None:
+            # unified arena: cold unpinned adapters may fund the
+            # shortfall before the caller concludes "preempt/refuse"
+            self.arena.fund_kv(n)
         return self.num_free >= n
 
+    def oldest_cached_ts(self):
+        """Park time of the coldest cached-free page (None when none) —
+        the KV side's entry in the arena's unified LRU comparison."""
+        for block in self._cached_free:
+            return self._cached_at.get(block, 0.0)
+        return None
+
     def allocate(self, n: int) -> list[int]:
+        if n > self.num_free and self.arena is not None:
+            self.arena.fund_kv(n)
         if n > self.num_free:
             raise RuntimeError(
                 f"KV cache exhausted: need {n} pages, {self.num_free} free"
@@ -273,6 +295,7 @@ class BlockAllocator:
             # reclaim the least-recently-parked cached page
             block = next(iter(self._cached_free))
             del self._cached_free[block]
+            self._cached_at.pop(block, None)
             if self.evict_hook is not None:
                 h = self._block_hash.get(block)
                 if h is not None:
@@ -304,8 +327,18 @@ class BlockAllocator:
                 # keep registered content resident until pages are needed
                 self._cached_free.pop(block, None)
                 self._cached_free[block] = None  # move to MRU end
+                self._cached_at[block] = time.monotonic()
             else:
                 self._free.append(block)
+
+    def free_reserved(self, blocks: list[int]) -> None:
+        """Release pages the arena reserved for adapter charges,
+        BYPASSING any open free epoch: reserved pages were never
+        addressable by KV programs, so the chained-decode stale-write
+        quarantine cannot apply to them — and quarantining them would
+        make an adapter eviction unable to fund the very KV demand
+        that triggered it."""
+        self._free_now(blocks)
 
     # ------------------------------------------------- chained-free epochs
 
@@ -379,6 +412,7 @@ class BlockAllocator:
                 break
             self._refcount[block] = self._refcount.get(block, 0) + 1
             self._cached_free.pop(block, None)  # now live again
+            self._cached_at.pop(block, None)
             blocks.append(block)
         return blocks, len(blocks) * self.block_size
 

@@ -49,8 +49,13 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
+import hashlib
+import json
+import mmap
+import os
 import time
 from collections import OrderedDict
+from pathlib import Path
 from typing import Callable, Optional
 
 import numpy as np
@@ -58,6 +63,377 @@ import numpy as np
 from vllm_tgis_adapter_tpu.logging import init_logger
 
 logger = init_logger(__name__)
+
+
+class DiskKVTier:
+    """Byte-budgeted local-disk tier BENEATH the host-RAM store
+    (``--kv-disk-cache-gb``, docs/MEMORY.md "Disk tier").
+
+    The lowest rung of the memory hierarchy: host-tier LRU victims —
+    cold KV prefix pages and cold adapters spilled from the host
+    registry — land here as one self-describing file per entry (a JSON
+    header naming shapes/dtypes plus a sha256 of the payload, then the
+    raw array bytes).  Reads go through ``mmap`` and are
+    digest-validated exactly like the host tier validates shapes: a
+    checksum mismatch UNLINKS the entry and reads as a miss, never
+    served.  Files are content-addressed (the same token-chain digests
+    the device cache and host tier key by), so the directory may
+    survive restarts — a rebooted server re-serves warm prefixes
+    straight from disk — and eviction is just an unlink of the LRU
+    entry.
+
+    All file I/O runs on worker threads under the host tier's transfer
+    lock (store during demotion spill, load during promotion staging);
+    the in-RAM index makes ``has``/peek probes loop-thread cheap.
+    """
+
+    PAGE_SUFFIX = ".kvpage"
+    ADAPTER_SUFFIX = ".kvadapter"
+
+    def __init__(
+        self,
+        budget_bytes: int,
+        directory: Optional[str] = None,
+        block_size: int = 16,
+    ):
+        import tempfile
+        import threading
+
+        self.budget_bytes = int(budget_bytes)
+        self.block_size = block_size
+        # KV page I/O arrives serialized by the host tier's asyncio
+        # transfer lock, but ADAPTER spills/restores come from
+        # LoRAManager's own worker threads — this thread lock makes
+        # every index/bytes_used mutation safe regardless of which
+        # path calls in (two concurrent _evict_to_budget walks would
+        # otherwise double-pop the LRU head and corrupt accounting)
+        self._lock = threading.Lock()
+        self.dir = Path(
+            directory
+            or os.path.join(tempfile.gettempdir(), "tgis-tpu-kv-disk")
+        )
+        self.dir.mkdir(parents=True, exist_ok=True)
+        # digest -> file size; LRU order, oldest first.  Adapters keyed
+        # separately by name (their files carry the name in the header).
+        self._index: "OrderedDict[bytes, int]" = OrderedDict()
+        self._adapters: "OrderedDict[str, int]" = OrderedDict()
+        self.bytes_used = 0
+        self.stored_pages = 0
+        self.loaded_pages = 0
+        self.stored_adapters = 0
+        self.loaded_adapters = 0
+        self.evictions = 0
+        self.dropped_corrupt = 0
+        self._closed = False
+        self._rescan()
+
+    # --------------------------------------------------------------- index
+
+    @staticmethod
+    def _unlink_garbage(path: Path) -> None:
+        try:
+            path.unlink()
+            logger.warning("kv disk tier: removed unadoptable file %s", path)
+        except OSError:
+            pass
+
+    def _page_path(self, digest: bytes) -> Path:
+        return self.dir / (digest.hex() + self.PAGE_SUFFIX)
+
+    def _adapter_path(self, name: str) -> Path:
+        return self.dir / (
+            hashlib.sha256(name.encode()).hexdigest()
+            + self.ADAPTER_SUFFIX
+        )
+
+    def _rescan(self) -> None:
+        """Adopt surviving entries (cross-restart reuse): sizes from
+        stat; integrity is verified lazily at load, like every read.
+        Files that can never be adopted are UNLINKED — an orphaned
+        ``.tmp`` from a crash mid-``_write`` or an unparseable name/
+        header would otherwise sit outside ``bytes_used`` forever,
+        uncountable and un-evictable, growing the directory past the
+        budget across restarts."""
+        for path in sorted(self.dir.glob("*.tmp")):
+            try:
+                path.unlink()
+            except OSError:
+                pass
+        for path in sorted(self.dir.glob("*" + self.PAGE_SUFFIX)):
+            try:
+                digest = bytes.fromhex(path.name[: -len(self.PAGE_SUFFIX)])
+                size = path.stat().st_size
+            except (ValueError, OSError):
+                self._unlink_garbage(path)
+                continue
+            self._index[digest] = size
+            self.bytes_used += size
+        for path in sorted(self.dir.glob("*" + self.ADAPTER_SUFFIX)):
+            try:
+                with open(path, "rb") as f:
+                    meta = json.loads(f.readline())
+                size = path.stat().st_size
+            except (ValueError, OSError):
+                self._unlink_garbage(path)
+                continue
+            name = meta.get("name")
+            if name:
+                self._adapters[name] = size
+                self.bytes_used += size
+            else:
+                self._unlink_garbage(path)
+        if self._index or self._adapters:
+            logger.info(
+                "kv disk tier: adopted %d page(s) + %d adapter(s) "
+                "(%.1f MiB) surviving in %s",
+                len(self._index), len(self._adapters),
+                self.bytes_used / (1 << 20), self.dir,
+            )
+        self._evict_to_budget()
+        self._observe_bytes()
+
+    def has(self, digest: bytes) -> bool:
+        return digest in self._index
+
+    def has_adapter(self, name: str) -> bool:
+        return name in self._adapters
+
+    # --------------------------------------------------------------- store
+
+    @staticmethod
+    def _serialize(arrays: tuple, meta: dict) -> bytes:
+        payload = b"".join(
+            np.ascontiguousarray(a).tobytes() for a in arrays
+        )
+        header = dict(meta)
+        header["arrays"] = [
+            {"shape": list(a.shape), "dtype": str(a.dtype)}
+            for a in arrays
+        ]
+        header["sha256"] = hashlib.sha256(payload).hexdigest()
+        return json.dumps(header).encode() + b"\n" + payload
+
+    def _write(self, path: Path, blob: bytes) -> None:
+        tmp = path.with_suffix(path.suffix + ".tmp")
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, path)  # atomic: readers never see a torn entry
+
+    def store_batch(self, items: list) -> None:
+        """Persist ``[(digest, *arrays), ...]`` host-tier victims.
+        Worker-thread half (file I/O under the transfer lock)."""
+        if self._closed:
+            return
+        with self._lock:
+            self._store_batch_locked(items)
+
+    def _store_batch_locked(self, items: list) -> None:
+        for digest, *arrays in items:
+            if digest in self._index:
+                continue
+            blob = self._serialize(tuple(arrays), {"kind": "kv"})
+            if len(blob) > self.budget_bytes:
+                continue
+            try:
+                self._write(self._page_path(digest), blob)
+            except OSError:
+                logger.exception("kv disk tier: page write failed")
+                continue
+            self._index[digest] = len(blob)
+            self.bytes_used += len(blob)
+            self.stored_pages += 1
+        self._evict_to_budget()
+        self._observe_bytes()
+
+    def store_adapter(self, name: str, weights, path_hint: str = "") -> None:  # noqa: ANN001
+        """Spill one host-registry-evicted adapter
+        (lora.LoRAAdapterWeights) to disk.  Worker-thread half."""
+        if self._closed:
+            return
+        with self._lock:
+            self._store_adapter_locked(name, weights, path_hint)
+
+    def _store_adapter_locked(self, name: str, weights, path_hint: str) -> None:  # noqa: ANN001
+        keys_a = sorted(weights.a)
+        keys_b = sorted(weights.b)
+        arrays = tuple(
+            [weights.a[k] for k in keys_a] + [weights.b[k] for k in keys_b]
+        )
+        blob = self._serialize(arrays, {
+            "kind": "adapter",
+            "name": name,
+            "rank": weights.rank,
+            "scaling": weights.scaling,
+            "target_modules": list(weights.target_modules),
+            "keys_a": keys_a,
+            "keys_b": keys_b,
+            "path": path_hint,
+        })
+        if len(blob) > self.budget_bytes:
+            return
+        try:
+            self._write(self._adapter_path(name), blob)
+        except OSError:
+            logger.exception("kv disk tier: adapter write failed")
+            return
+        old = self._adapters.pop(name, None)
+        if old is not None:
+            self.bytes_used -= old
+        self._adapters[name] = len(blob)
+        self.bytes_used += len(blob)
+        self.stored_adapters += 1
+        self._evict_to_budget()
+        self._observe_bytes()
+
+    # ---------------------------------------------------------------- load
+
+    def _read_validated(self, path: Path) -> Optional[tuple]:
+        """(meta, arrays) via an mmap'd read, payload checksum
+        verified; a corrupt entry is unlinked and reads as a miss."""
+        try:
+            with open(path, "rb") as f:
+                head = f.readline()
+                meta = json.loads(head)
+                offset = len(head)
+                with mmap.mmap(
+                    f.fileno(), 0, access=mmap.ACCESS_READ
+                ) as mm:
+                    payload = mm[offset:]
+                    if (
+                        hashlib.sha256(payload).hexdigest()
+                        != meta.get("sha256")
+                    ):
+                        raise ValueError("payload checksum mismatch")
+                    arrays = []
+                    pos = 0
+                    for spec in meta["arrays"]:
+                        dt = np.dtype(spec["dtype"])
+                        count = int(np.prod(spec["shape"])) or 0
+                        arr = np.frombuffer(
+                            payload, dtype=dt, count=count, offset=pos
+                        ).reshape(spec["shape"]).copy()
+                        pos += count * dt.itemsize
+                        arrays.append(arr)
+            return meta, tuple(arrays)
+        except FileNotFoundError:
+            return None
+        except Exception:  # noqa: BLE001 — any parse failure = corrupt
+            logger.warning(
+                "kv disk tier: dropping corrupt entry %s instead of "
+                "serving it", path.name,
+            )
+            self.dropped_corrupt += 1
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            return None
+
+    def load(self, digest: bytes) -> Optional[tuple]:
+        """One KV page's arrays, validated — worker-thread half (the
+        promotion staging path).  A miss/corrupt read drops the index
+        entry."""
+        with self._lock:
+            size = self._index.get(digest)
+            if size is None:
+                return None
+            got = self._read_validated(self._page_path(digest))
+            if got is None:
+                self._index.pop(digest, None)
+                self.bytes_used -= size
+                self._observe_bytes()
+                return None
+            self._index.move_to_end(digest)  # LRU touch
+            self.loaded_pages += 1
+            return got[1]
+
+    def load_adapter(self, name: str):  # noqa: ANN001 — LoRAAdapterWeights
+        """Restore one spilled adapter's weights — worker-thread half."""
+        with self._lock:
+            size = self._adapters.get(name)
+            if size is None:
+                return None
+            got = self._read_validated(self._adapter_path(name))
+            if got is None:
+                self._adapters.pop(name, None)
+                self.bytes_used -= size
+                self._observe_bytes()
+                return None
+            meta, arrays = got
+            self._adapters.move_to_end(name)
+            self.loaded_adapters += 1
+        from vllm_tgis_adapter_tpu.engine.lora import LoRAAdapterWeights
+
+        na = len(meta["keys_a"])
+        return LoRAAdapterWeights(
+            rank=int(meta["rank"]),
+            scaling=float(meta["scaling"]),
+            target_modules=tuple(meta["target_modules"]),
+            a=dict(zip(meta["keys_a"], arrays[:na])),
+            b=dict(zip(meta["keys_b"], arrays[na:])),
+        ), meta.get("path", "")
+
+    # ------------------------------------------------------------ eviction
+
+    def _evict_to_budget(self) -> None:
+        while self.bytes_used > self.budget_bytes and (
+            self._index or self._adapters
+        ):
+            # evict whichever kind holds the older LRU head
+            if self._index:
+                digest, size = next(iter(self._index.items()))
+                self._index.pop(digest)
+                path = self._page_path(digest)
+            else:
+                name, size = next(iter(self._adapters.items()))
+                self._adapters.pop(name)
+                path = self._adapter_path(name)
+            self.bytes_used -= size
+            self.evictions += 1
+            self._count_eviction("disk")
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        self._closed = True
+
+    # ------------------------------------------------------------- metrics
+
+    def _observe_bytes(self) -> None:
+        try:
+            from vllm_tgis_adapter_tpu import metrics
+
+            metrics.kv_host_tier_bytes.labels(tier="disk").set(
+                self.bytes_used
+            )
+        except Exception:  # pragma: no cover — telemetry must not raise
+            pass
+
+    @staticmethod
+    def _count_eviction(tier: str) -> None:
+        try:
+            from vllm_tgis_adapter_tpu import metrics
+
+            metrics.kv_host_tier_evictions_total.labels(tier=tier).inc()
+        except Exception:  # pragma: no cover — telemetry must not raise
+            pass
+
+    def debug_state(self) -> dict:
+        return {
+            "budget_bytes": self.budget_bytes,
+            "bytes_used": self.bytes_used,
+            "pages": len(self._index),
+            "adapters": len(self._adapters),
+            "stored_pages": self.stored_pages,
+            "loaded_pages": self.loaded_pages,
+            "stored_adapters": self.stored_adapters,
+            "loaded_adapters": self.loaded_adapters,
+            "evictions": self.evictions,
+            "dropped_corrupt": self.dropped_corrupt,
+            "directory": str(self.dir),
+        }
 
 
 class _Entry:
@@ -181,6 +557,9 @@ class HostKVTier:
     def __init__(self, budget_bytes: int, block_size: int):
         self.budget_bytes = int(budget_bytes)
         self.block_size = block_size
+        # optional disk tier beneath this store (--kv-disk-cache-gb):
+        # host LRU victims spill down, promotions walk disk→host→device
+        self.disk: Optional[DiskKVTier] = None
         # digest -> entry; LRU order, oldest first
         self._entries: "OrderedDict[bytes, _Entry]" = OrderedDict()
         self.bytes_used = 0
@@ -222,6 +601,11 @@ class HostKVTier:
         )
         # lifetime stats (debug_state / bench stamps)
         self.demoted_pages = 0
+        # disk-read pages hopped back UP into host RAM during a
+        # promotion walk — kept apart from demoted_pages so operators
+        # reading tier flow never see promotions inflate the demotion
+        # counter
+        self.recovered_pages = 0
         self.promoted_pages = 0
         self.promoted_tokens = 0
         self.evictions = 0
@@ -229,10 +613,22 @@ class HostKVTier:
 
     # ------------------------------------------------------------- lookups
 
+    def attach_disk(self, disk: "DiskKVTier") -> None:
+        """Hang the disk tier beneath this store (engine boot; the
+        shared dp/rebuild-surviving tier carries it along)."""
+        self.disk = disk
+
+    def _resident(self, digest: bytes) -> bool:
+        """Committed in host RAM OR on disk (either serves a
+        promotion; disk entries hop through host on the way up)."""
+        return digest in self._entries or (
+            self.disk is not None and self.disk.has(digest)
+        )
+
     def has(self, digest: bytes) -> bool:
-        """Committed OR in-flight: the engine uses this to skip duplicate
-        demotion gathers, so an in-flight copy counts."""
-        return digest in self._entries or digest in self._inflight
+        """Committed (any tier) OR in-flight: the engine uses this to
+        skip duplicate demotion gathers, so an in-flight copy counts."""
+        return self._resident(digest) or digest in self._inflight
 
     def peek_pages(self, digests: list) -> int:
         """Consecutive committed pages from ``digests[0]`` — the
@@ -240,7 +636,7 @@ class HostKVTier:
         ``BlockAllocator.peek_prefix``'s pure-walk contract)."""
         n = 0
         for digest in digests:
-            if digest not in self._entries:
+            if not self._resident(digest):
                 break
             n += 1
         return n
@@ -271,7 +667,7 @@ class HostKVTier:
             )
             if p < start_page:
                 continue  # chain continuity only; not probed
-            if h not in self._entries:
+            if not self._resident(h):
                 break
             matched += 1
         return matched
@@ -371,7 +767,11 @@ class HostKVTier:
             for item in batch
         ]
 
-    def _insert(self, host_batch: list) -> None:
+    def _insert(self, host_batch: list, recovered: bool = False) -> None:
+        """Adopt host copies into the RAM store.  ``recovered`` marks
+        disk-read pages hopping UP the hierarchy during a promotion —
+        counted apart so reads never inflate ``demoted_pages``."""
+        spill: list = []
         for digest, *arrays in host_batch:
             self._inflight.discard(digest)
             if self._closed or digest in self._entries:
@@ -387,14 +787,48 @@ class HostKVTier:
                 self.bytes_used + entry.nbytes > self.budget_bytes
                 and self._entries
             ):
-                _, victim = self._entries.popitem(last=False)
+                vdigest, victim = self._entries.popitem(last=False)
                 self.bytes_used -= victim.nbytes
                 self.evictions += 1
                 self._count_eviction()
+                if self.disk is not None and not self.disk.has(vdigest):
+                    # demotion cascades DOWN the hierarchy: the host
+                    # LRU victim's next home is the disk tier, not
+                    # oblivion (docs/MEMORY.md)
+                    spill.append((vdigest, *victim.arrays))
             self._entries[digest] = entry
             self.bytes_used += entry.nbytes
-            self.demoted_pages += 1
+            if recovered:
+                self.recovered_pages += 1
+            else:
+                self.demoted_pages += 1
         self._observe_bytes()
+        if spill:
+            self._spill_to_disk(spill)
+
+    def _spill_to_disk(self, spill: list) -> None:
+        """Write host-tier victims to the disk tier — file I/O on a
+        worker thread under the transfer lock (offline engines write
+        inline); victims are already host numpy, so no device work."""
+        if self.disk is None or self._closed:
+            return
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            loop = None
+        if loop is None:
+            self.disk.store_batch(spill)
+            return
+        self._retain(loop.create_task(
+            self._spill_async(spill), name="kv-tier-spill-disk",
+        ))
+
+    async def _spill_async(self, spill: list) -> None:
+        try:
+            async with self._transfer_lock:
+                await asyncio.to_thread(self.disk.store_batch, spill)
+        except Exception:  # noqa: BLE001 — a lost spill is a future miss
+            logger.exception("kv disk tier: spill failed")
 
     # ----------------------------------------------------------- promotion
 
@@ -408,9 +842,10 @@ class HostKVTier:
         except RuntimeError:
             loop = None
         if loop is None:
-            self._finish_assembly(
-                ticket, self._stage(self._collect(ticket), put_fn)
-            )
+            staged, recovered = self._stage(self._collect(ticket), put_fn)
+            if recovered:
+                self._insert(recovered, recovered=True)
+            self._finish_assembly(ticket, staged)
             return
         self._retain(loop.create_task(
             self._assemble(ticket, put_fn),
@@ -418,28 +853,72 @@ class HostKVTier:
         ))
 
     def _collect(self, ticket: PromotionTicket) -> list:
-        """Longest still-valid prefix of the ticket's entries (host
-        references; loop-thread dict reads only)."""
-        pages = []
+        """Longest still-valid prefix of the ticket's entries — host
+        arrays where RAM has them, ``("disk", digest)`` markers where
+        only the disk tier does (loaded by the worker-thread stage;
+        loop-thread dict reads only here)."""
+        pages: list = []
         for digest in ticket.digests:
             entry = self._get_valid(digest)
-            if entry is None:
-                break
-            pages.append(entry.arrays)
+            if entry is not None:
+                pages.append(entry.arrays)
+                continue
+            if self.disk is not None and self.disk.has(digest):
+                pages.append(("disk", digest))
+                continue
+            break
         return pages
 
-    @staticmethod
-    def _stage(pages: list, put_fn: Callable) -> list:
+    def _stage(self, pages: list, put_fn: Callable) -> tuple:
         """Worker-thread half: host→device transfer of the assembled
         pages (the promotion's only bulk transfer; scale columns ride
-        along for quantized pages)."""
-        return [tuple(put_fn(a) for a in page) for page in pages]
+        along for quantized pages).  Disk markers load-and-validate
+        here — a corrupt disk entry TRUNCATES the span (the existing
+        shrunk-ticket contract) — and the loaded host copies are
+        returned so the loop can promote them INTO the host tier
+        (disk → host → device, docs/MEMORY.md).
+
+        The transfer is BATCHED per tuple position: one stacked
+        ``put_fn`` per cache array instead of one per page per array —
+        a 15-page promotion pays 2-4 transfers, not 30-60, which is
+        what keeps warm-hit TTFT dominated by the restore itself
+        rather than per-transfer dispatch overhead (the unified gate's
+        warm/cold ratio rides on this)."""
+        resolved: list = []
+        recovered: list = []
+        for page in pages:
+            if isinstance(page, tuple) and len(page) == 2 and (
+                isinstance(page[0], str)
+            ):
+                arrays = (
+                    self.disk.load(page[1])
+                    if self.disk is not None
+                    else None
+                )
+                if arrays is None:
+                    break  # corrupt/evicted mid-flight: span shrinks
+                recovered.append((page[1], *arrays))
+                page = arrays
+            resolved.append(page)
+        if not resolved:
+            return [], recovered
+        cols = [
+            put_fn(np.stack([page[j] for page in resolved]))
+            for j in range(len(resolved[0]))
+        ]
+        staged = [
+            tuple(col[i] for col in cols)
+            for i in range(len(resolved))
+        ]
+        return staged, recovered
 
     async def _assemble(self, ticket: PromotionTicket, put_fn: Callable) -> None:
         pages = self._collect(ticket)  # on loop: validated dict reads
         try:
             async with self._transfer_lock:
-                staged = await asyncio.to_thread(self._stage, pages, put_fn)
+                staged, recovered = await asyncio.to_thread(
+                    self._stage, pages, put_fn
+                )
         except Exception:
             logger.exception(
                 "kv host tier: promotion staging for %r failed",
@@ -448,6 +927,11 @@ class HostKVTier:
             ticket.failed = True
             ticket.ready = True
             return
+        if recovered:
+            # promote the disk-read pages one rung up: later warm
+            # requests hit host RAM directly (back on the loop thread,
+            # the only _entries mutator)
+            self._insert(recovered, recovered=True)
         self._finish_assembly(ticket, staged)
 
     def _finish_assembly(self, ticket: PromotionTicket, staged: list) -> None:
@@ -512,8 +996,14 @@ class HostKVTier:
         written yet) is trivially valid: resume recomputes from the
         prompt, still token-identically."""
         for digest in ckpt.digests[: ckpt.pages]:
-            if self._get_valid(digest) is None:
-                return False
+            if self._get_valid(digest) is not None:
+                continue
+            if self.disk is not None and self.disk.has(digest):
+                # disk-resident pages count: their payload checksum is
+                # verified at load time, and a corrupt entry surfaces
+                # as a shrunk promotion → the existing fallback rung
+                continue
+            return False
         return True
 
     # ------------------------------------------------------------ lifecycle
@@ -525,6 +1015,8 @@ class HostKVTier:
         self._entries.clear()
         self._checkpoints.clear()
         self.bytes_used = 0
+        if self.disk is not None:
+            self.disk.close()
 
     # ------------------------------------------------------------- metrics
 
@@ -532,7 +1024,11 @@ class HostKVTier:
         try:
             from vllm_tgis_adapter_tpu import metrics
 
-            metrics.kv_host_tier_bytes.set(self.bytes_used)
+            # per-tier series (ISSUE 14 satellite): host and disk each
+            # report their own bytes instead of silently summing
+            metrics.kv_host_tier_bytes.labels(tier="host").set(
+                self.bytes_used
+            )
         except Exception:  # pragma: no cover — telemetry must not raise
             pass
 
@@ -541,22 +1037,41 @@ class HostKVTier:
         try:
             from vllm_tgis_adapter_tpu import metrics
 
-            metrics.kv_host_tier_evictions_total.inc()
+            metrics.kv_host_tier_evictions_total.labels(
+                tier="host"
+            ).inc()
         except Exception:  # pragma: no cover — telemetry must not raise
             pass
 
     def debug_state(self) -> dict:
-        """``kv_host_tier`` section of the /debug/state snapshot."""
-        return {
+        """``kv_host_tier`` section of the /debug/state snapshot.
+
+        The flat keys are the HOST tier's (the historical shape);
+        ``tiers.host`` / ``tiers.disk`` split the hierarchy per rung so
+        the two budgets never read as one silently-summed number
+        (obs_check gates both sub-sections)."""
+        host = {
             "budget_bytes": self.budget_bytes,
             "bytes_used": self.bytes_used,
             "pages": len(self._entries),
             "inflight_demotions": len(self._inflight),
             "demoted_pages": self.demoted_pages,
+            "recovered_pages": self.recovered_pages,
             "demotions_dropped": self.demotions_dropped,
             "promoted_pages": self.promoted_pages,
             "promoted_tokens": self.promoted_tokens,
             "evictions": self.evictions,
             "dropped_corrupt": self.dropped_corrupt,
             "checkpoints": len(self._checkpoints),
+        }
+        return {
+            **host,
+            "tiers": {
+                "host": dict(host),
+                "disk": (
+                    self.disk.debug_state()
+                    if self.disk is not None
+                    else None
+                ),
+            },
         }

@@ -194,6 +194,23 @@ class LoRAManager:
         # event loop here, so the step path's sync_lora version check
         # is already satisfied and never pays the transfer inline
         self._resync_cbs: "weakref.WeakSet" = weakref.WeakSet()
+        # disk tier beneath the host registry (--kv-disk-cache-gb,
+        # engine/kv_tier.DiskKVTier): host-evicted adapters spill to
+        # disk and restore through the same park/promote discipline
+        # the device pool uses — ensure_resident parks a request whose
+        # adapter is restoring (docs/MEMORY.md "Cold adapters")
+        self.disk_tier = None
+        self._restoring: set[str] = set()
+        # adapters whose spill WRITE is still on the worker thread: the
+        # registry entry is already gone but has_adapter() is not yet
+        # true, so without this set a request arriving in that window
+        # would fall through to slot-0 base weights and silently
+        # generate wrong tokens
+        self._spilling: set[str] = set()
+        self._disk_tasks: set = set()
+
+    def attach_disk_tier(self, disk) -> None:  # noqa: ANN001 — DiskKVTier
+        self.disk_tier = disk
 
     @property
     def pool_mode(self) -> bool:
@@ -306,16 +323,120 @@ class LoRAManager:
 
     def _evict_host(self, name: str) -> None:
         """Drop one (unpinned) host registry entry and invalidate any
-        device-pool residency it had."""
+        device-pool residency it had.  With a disk tier attached the
+        weights SPILL down the hierarchy first (off the event loop) —
+        a later request for the adapter restores disk→host→device
+        instead of 404ing."""
         logger.info("evicting LoRA adapter %s", name)
-        self.lora_requests.pop(name, None)
-        self._weights.pop(name, None)
+        request = self.lora_requests.pop(name, None)
+        weights = self._weights.pop(name, None)
         self._refs.pop(name, None)
         slot = self._slots.pop(name, None)
         if slot is not None:
             self._free_slots.append(slot)
         for pool in list(self._pools):
             pool.invalidate(name)
+        if self.disk_tier is not None and weights is not None:
+            self._spill_to_disk(
+                name, weights,
+                request.lora_path if request is not None else "",
+            )
+
+    def _spill_to_disk(self, name: str, weights, path: str) -> None:  # noqa: ANN001
+        """Write one evicted adapter to the disk tier — on a worker
+        thread when a loop is running (the file write must never block
+        the event loop), inline for offline engines."""
+        import asyncio
+
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            self.disk_tier.store_adapter(name, weights, path)
+            return
+        self._spilling.add(name)
+        task = loop.create_task(asyncio.to_thread(
+            self.disk_tier.store_adapter, name, weights, path
+        ))
+        # strong ref: the loop holds only weak task references
+        self._disk_tasks.add(task)
+
+        def _done(t, name=name):  # noqa: ANN001
+            self._disk_tasks.discard(t)
+            self._spilling.discard(name)
+
+        task.add_done_callback(_done)
+
+    def request_disk_restore(self, name: str) -> bool:
+        """Begin (or observe) restoring a disk-spilled adapter back
+        into the host registry.  True = a restore is resident-bound
+        (the caller PARKS its request — the adapter-gate contract);
+        False = the disk tier has nothing under this name (legacy
+        slot-0 base-weights semantics apply)."""
+        if self.disk_tier is None:
+            return False
+        if name in self._restoring or name in self._spilling:
+            # an in-flight restore OR spill: park now — once the spill
+            # write lands, the parked request's next gate retry sees
+            # has_adapter() and starts the restore (a FAILED spill
+            # leaves has_adapter false and the retry falls back to the
+            # pre-disk miss semantics)
+            return True
+        if not self.disk_tier.has_adapter(name):
+            return False
+        import asyncio
+
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            self._finish_restore(name, self.disk_tier.load_adapter(name))
+            return True
+        self._restoring.add(name)
+        task = loop.create_task(self._restore_async(name))
+        self._disk_tasks.add(task)
+        task.add_done_callback(self._disk_tasks.discard)
+        return True
+
+    async def _restore_async(self, name: str) -> None:
+        import asyncio
+
+        try:
+            got = await asyncio.to_thread(
+                self.disk_tier.load_adapter, name
+            )
+        except Exception:  # noqa: BLE001 — a failed restore = a miss
+            logger.exception("disk adapter restore for %r failed", name)
+            got = None
+        finally:
+            self._restoring.discard(name)
+        self._finish_restore(name, got)
+
+    def _finish_restore(self, name: str, got) -> None:  # noqa: ANN001
+        """Re-register a disk-restored adapter (loop thread).  The
+        parked request's next gate retry finds it and streams it to
+        the device like any cold registry hit."""
+        if got is None or name in self.lora_requests:
+            return
+        weights, path = got
+        if len(self.lora_requests) >= self.host_capacity:
+            evict = next(
+                (n for n in self.lora_requests if not self._refs.get(n)),
+                None,
+            )
+            if evict is None:
+                # every host entry pinned: drop the restore; the gate
+                # retries once pins release (re-probing the disk tier)
+                return
+            self._evict_host(evict)
+        self.lora_requests[name] = LoRARequest(
+            lora_name=name, lora_int_id=self._next_id, lora_path=path
+        )
+        self._next_id += 1
+        self._weights[name] = weights
+        if not self.pool_mode:
+            self._slots[name] = self._free_slots.pop()
+        self.version += 1
+        self._report_registered()
+        logger.info("adapter %s restored from the disk tier", name)
 
     def _report_registered(self) -> None:
         try:
@@ -368,6 +489,54 @@ LORA_TARGETS = (
 )
 
 
+def rank_lattice(max_rank: int) -> tuple[int, ...]:
+    """The small pow2 rank-bucket lattice the heterogeneous-rank
+    gathered matmul is jitted at (docs/LORA.md "Gathered matmul"): an
+    adapter's compute and its arena page charge are priced at the
+    smallest bucket covering its TRUE rank, not at ``--max-lora-rank``.
+    The lattice is a pure function of max_rank, so it is STATIC inside
+    every jitted program — swapping adapters changes only the per-slot
+    ``ranks`` operand, never a compile shape."""
+    out: list[int] = []
+    r = 4
+    while r < max_rank:
+        out.append(r)
+        r *= 2
+    out.append(max_rank)
+    return tuple(out)
+
+
+def rank_bucket(rank: int, max_rank: int) -> int:
+    """Smallest lattice bucket covering ``rank`` (>= 1)."""
+    for rb in rank_lattice(max_rank):
+        if rb >= max(1, rank):
+            return rb
+    return max_rank
+
+
+def adapter_shard_bytes(mcfg, rank: int, max_rank: int) -> int:
+    """Device bytes ONE adapter's shards occupy at its rank bucket —
+    the unit the unified arena charges (engine/arena.py): f32 A + B
+    blocks per target per layer at bucket width, NOT padded to
+    max_rank."""
+    rb = rank_bucket(rank, max_rank)
+    elems = 0
+    for target in LORA_TARGETS:
+        din, dout = _target_dims(mcfg, target)
+        elems += mcfg.num_layers * (din * rb + rb * dout)
+    return elems * 4
+
+
+def adapter_page_cost(mcfg, rank: int, max_rank: int,
+                      kv_page_bytes: int) -> int:
+    """Arena pages (KV-page-byte units) one resident adapter charges."""
+    return max(
+        1, -(-adapter_shard_bytes(mcfg, rank, max_rank) // max(
+            1, kv_page_bytes
+        ))
+    )
+
+
 def _target_dims(mcfg, target: str) -> tuple[int, int]:
     d, dh = mcfg.hidden_size, mcfg.head_dim
     h, hkv, f = mcfg.num_heads, mcfg.num_kv_heads, mcfg.intermediate_size
@@ -397,11 +566,19 @@ class LoRAStacks:
 
     ``a[target]``: [L, S, d_in, r] · ``b[target]``: [L, S, r, d_out] ·
     ``scaling``: [S] (slot 0 zero).
+
+    ``ranks`` ([S] i32, rank BUCKET per slot — see :func:`rank_lattice`;
+    0 for empty slots) arms the heterogeneous-rank gathered matmul
+    (models/llama.py ``_lora_delta_batched``): each row's delta is
+    computed at its slot's bucket width instead of padding every matmul
+    to ``max_rank``.  None (``--no-lora-gathered`` / legacy callers)
+    keeps the historical padded path bit-for-bit.
     """
 
     a: dict
     b: dict
     scaling: object  # [S] f32
+    ranks: object = None  # [S] i32 rank bucket per slot, or None
 
 
 def build_adapter_blocks(
@@ -440,21 +617,31 @@ def build_adapter_blocks(
 
 
 def build_lora_stacks(mcfg, max_loras: int, max_rank: int,
-                      manager: LoRAManager) -> LoRAStacks:
-    """Host-side assembly of the padded stacks from loaded adapters."""
+                      manager: LoRAManager,
+                      gathered: bool = True) -> LoRAStacks:
+    """Host-side assembly of the padded stacks from loaded adapters.
+
+    ``gathered`` fills the per-slot ``ranks`` operand (true rank
+    buckets) so the model runs the heterogeneous-rank gathered matmul;
+    False reproduces the pre-gathered stacks exactly (``ranks=None``,
+    padded matmuls)."""
     s_count = max_loras + 1
     layers = mcfg.num_layers
     a = {}
     b = {}
     scaling = np.zeros(s_count, np.float32)
+    ranks = np.zeros(s_count, np.int32)
     for target in LORA_TARGETS:
         din, dout = _target_dims(mcfg, target)
         a[target] = np.zeros((layers, s_count, din, max_rank), np.float32)
         b[target] = np.zeros((layers, s_count, max_rank, dout), np.float32)
     for slot, weights in manager.loaded():
         scaling[slot] = weights.scaling
+        ranks[slot] = rank_bucket(weights.rank, max_rank)
         blocks_a, blocks_b = build_adapter_blocks(mcfg, max_rank, weights)
         for target in LORA_TARGETS:
             a[target][:, slot] = blocks_a[target]
             b[target][:, slot] = blocks_b[target]
-    return LoRAStacks(a=a, b=b, scaling=scaling)
+    return LoRAStacks(
+        a=a, b=b, scaling=scaling, ranks=ranks if gathered else None
+    )

@@ -215,6 +215,11 @@ class PipelineRunner(ModelRunner):
         # ---- host-side state the inherited prepare_* halves consume ----
         self.config = config
         self.model = model  # whole-model reference (config introspection)
+        # calibrated kv-scale floors are a flat-runner feature
+        # (--kv-quantization refuses pp>1); drop the sidecar so stage
+        # slicing never sees a non-layer params key
+        if isinstance(params, dict):
+            params.pop("kv_scale_floors", None)
         self.block_size = cache_cfg.block_size
         self.num_slots = cache_cfg.num_blocks * cache_cfg.block_size
         self.max_blocks_per_seq = -(-mcfg.max_model_len // self.block_size)

@@ -233,6 +233,18 @@ class ModelRunner:
         self.block_size = cache_cfg.block_size
         self.num_slots = cache_cfg.num_blocks * cache_cfg.block_size
         self.max_blocks_per_seq = -(-mcfg.max_model_len // self.block_size)
+        # calibrated k_scale/v_scale page-scale floors from
+        # quantization-aware checkpoints (engine/weights.py): popped off
+        # the params pytree HERE — before sharding and before any jitted
+        # program sees the params treedef — and attached to the
+        # quantized caches below.  Inert without --kv-quantization.
+        kv_scale_floors = (
+            params.pop("kv_scale_floors", None)
+            if isinstance(params, dict)
+            else None
+        )
+        if cache_cfg.kv_quantization == "none":
+            kv_scale_floors = None
 
         # distributed: shard params/caches over the mesh; the XLA SPMD
         # partitioner propagates Megatron TP through the step fns
@@ -291,12 +303,19 @@ class ModelRunner:
                     sh,
                     NamedSharding(mesh, _P(None, "tp", None)),
                     cache_cfg.block_size,
+                    floor=(
+                        None
+                        if kv_scale_floors is None
+                        # calibrated floors head-shard with their cache
+                        else NamedSharding(mesh, _P(None, "tp"))
+                    ),
                 )
             caches = jax.jit(
                 lambda: model.make_kv_caches(
                     self.num_slots, cache_cfg.cache_dtype,
                     quantization=cache_cfg.kv_quantization,
                     block_size=cache_cfg.block_size,
+                    kv_scale_floors=kv_scale_floors,
                 ),
                 out_shardings=(out_sh, out_sh),
             )()
@@ -306,6 +325,7 @@ class ModelRunner:
                 self.num_slots, cache_cfg.cache_dtype,
                 quantization=cache_cfg.kv_quantization,
                 block_size=cache_cfg.block_size,
+                kv_scale_floors=kv_scale_floors,
             )
             self._data_sharding = None
         self.params = params
@@ -370,6 +390,7 @@ class ModelRunner:
                 lcfg.max_lora_rank,
                 self._put,
                 prefetch_concurrency=lcfg.prefetch_concurrency,
+                gathered=lcfg.gathered,
             )
             self.lora_stacks = self.adapter_pool.stacks
             self.adapter_pool.on_commit = (
@@ -464,7 +485,7 @@ class ModelRunner:
         lcfg = self.config.lora_config
         stacks = build_lora_stacks(
             self.config.model_config, manager.max_loras,
-            lcfg.max_lora_rank, manager,
+            lcfg.max_lora_rank, manager, gathered=lcfg.gathered,
         )
         # subclasses override placement (the pipeline runner slices per
         # stage); the host-side build above stays shared so the version

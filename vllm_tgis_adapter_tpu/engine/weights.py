@@ -136,6 +136,37 @@ def load_llama_params(
     elif "lm_head.weight" in raw:
         raw.pop("lm_head.weight")
 
+    # quantization-aware KV scales (docs/QUANTIZATION.md "Calibrated
+    # scales"): checkpoints calibrated for fp8/int8 KV caches ship
+    # per-layer k_scale/v_scale tensors (scalar or per-kv-head).  They
+    # are collected into [L, Hkv] floors the quantized page cache uses
+    # as the page-scale floor instead of pure amax; the runner pops
+    # them off the pytree before the params reach any jitted program.
+    import numpy as _np
+
+    k_floors = _np.zeros((config.num_layers, config.num_kv_heads),
+                         _np.float32)
+    v_floors = _np.zeros_like(k_floors)
+    saw_floors = False
+    for i in range(config.num_layers):
+        for which, dst in (("k_scale", k_floors), ("v_scale", v_floors)):
+            name = f"model.layers.{i}.self_attn.{which}"
+            if name not in raw:
+                continue
+            saw_floors = True
+            val = _np.asarray(raw.pop(name), _np.float32).reshape(-1)
+            # scalar broadcasts over heads; per-head vectors map 1:1
+            dst[i, :] = (
+                val[0] if val.size == 1 else val[: config.num_kv_heads]
+            )
+    if saw_floors:
+        logger.info(
+            "checkpoint carries calibrated k_scale/v_scale tensors: "
+            "quantized KV pages will floor their page scales at the "
+            "calibrated values (--kv-quantization)"
+        )
+        params["kv_scale_floors"] = (k_floors, v_floors)
+
     for i in range(config.num_layers):
         prefix = f"model.layers.{i}"
         layer = {

@@ -244,6 +244,7 @@ def scheduler_queues(scheduler: "Scheduler") -> dict:
 def engine_introspection(engine) -> dict:  # noqa: ANN001 — LLMEngine (import cycle)
     """One sync engine's full host-side state (scheduler + KV pool)."""
     pool = getattr(getattr(engine, "runner", None), "adapter_pool", None)
+    arena = getattr(engine, "arena", None)
     return {
         "scheduler": scheduler_queues(engine.scheduler),
         "kv_cache": allocator_stats(engine.scheduler.allocator),
@@ -251,4 +252,7 @@ def engine_introspection(engine) -> dict:  # noqa: ANN001 — LLMEngine (import 
         # paged LoRA pool residency (engine/adapter_pool.py); None when
         # LoRA is disabled or the legacy stacked path is serving
         "adapter_pool": pool.debug_state() if pool is not None else None,
+        # unified paged HBM arena (engine/arena.py, docs/MEMORY.md);
+        # None when LoRA/the pool is off or --no-unified-arena
+        "arena": arena.debug_state() if arena is not None else None,
     }

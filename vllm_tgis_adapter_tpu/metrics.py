@@ -173,13 +173,28 @@ kv_prefix_tokens_reused_total = _get_or_create(
 kv_host_tier_bytes = _get_or_create(
     Gauge,
     f"{_PREFIX}_kv_host_tier_bytes",
-    "Host bytes held by the hash-addressed KV tier "
-    "(--kv-host-cache-gb budget; shared across dp replicas)",
+    "Bytes held by each rung of the tiered KV store, by tier "
+    "(tier=host: the --kv-host-cache-gb hash-addressed RAM store; "
+    "tier=disk: the --kv-disk-cache-gb spill files beneath it) — "
+    "shared across dp replicas, never silently summed",
+    labelnames=("tier",),
 )
 kv_host_tier_evictions_total = _get_or_create(
     Counter,
     f"{_PREFIX}_kv_host_tier_evictions_total",
-    "KV pages evicted from the host tier's byte-budgeted LRU",
+    "Entries evicted from each KV-store rung's byte-budgeted LRU "
+    "(tier=host: RAM victims, which cascade to disk when the disk "
+    "tier is on; tier=disk: unlinked files)",
+    labelnames=("tier",),
+)
+arena_blocks = _get_or_create(
+    Gauge,
+    f"{_PREFIX}_arena_blocks",
+    "Unified paged HBM arena occupancy by page type per dp replica "
+    "(docs/MEMORY.md): type=adapter (true-rank pages charged by "
+    "device-resident LoRA shards), type=kv_used (pages held by live "
+    "or cached KV content), type=kv_free (allocatable)",
+    labelnames=("type", "replica"),
 )
 
 

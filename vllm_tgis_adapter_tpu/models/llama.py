@@ -130,22 +130,94 @@ def linear(layer: dict, name: str, x: jax.Array) -> jax.Array:
     ).astype(x.dtype)
 
 
+def _rank_lattice_for(lora, target: str) -> tuple[int, ...]:
+    """The pow2 rank-bucket lattice, derived STATICALLY from the
+    stacks' padded width (engine/lora.py rank_lattice) — identical in
+    every trace, so heterogeneous-rank dispatch adds zero compile
+    shapes per adapter swap."""
+    from vllm_tgis_adapter_tpu.engine.lora import rank_lattice
+
+    return rank_lattice(lora.a[target].shape[-1])
+
+
 def _lora_delta_single(lora, layer: int, slot, target: str, x: jax.Array):
-    """LoRA delta for one sequence (scalar adapter slot): x @ A @ B · s."""
-    a_l = lora.a[target][layer][slot]  # [din, r]
-    b_l = lora.b[target][layer][slot]  # [r, dout]
+    """LoRA delta for one sequence (scalar adapter slot): x @ A @ B · s.
+
+    With gathered stacks (``lora.ranks`` carried — docs/LORA.md
+    "Gathered matmul") the matmul runs at the slot's rank BUCKET via a
+    ``lax.switch`` over the static lattice: the A/B contractions touch
+    only the first ``rb`` rank lanes instead of padding every request
+    to ``--max-lora-rank``.  Zero-padded lanes contribute exactly 0, so
+    the result is the padded path's."""
     scale = lora.scaling[slot]
-    t = x.astype(jnp.float32) @ a_l
-    return (scale * (t @ b_l)).astype(x.dtype)
+    xf = x.astype(jnp.float32)
+    if getattr(lora, "ranks", None) is None:
+        a_l = lora.a[target][layer][slot]  # [din, r]
+        b_l = lora.b[target][layer][slot]  # [r, dout]
+        t = xf @ a_l
+        return (scale * (t @ b_l)).astype(x.dtype)
+    lattice = _rank_lattice_for(lora, target)
+
+    def branch(rb):
+        def run(xx):
+            a_l = lora.a[target][layer][slot][:, :rb]  # [din, rb]
+            b_l = lora.b[target][layer][slot][:rb, :]  # [rb, dout]
+            return (xx @ a_l) @ b_l
+
+        return run
+
+    which = jnp.searchsorted(
+        jnp.asarray(lattice, jnp.int32), lora.ranks[slot]
+    )
+    d = jax.lax.switch(which, [branch(rb) for rb in lattice], xf)
+    return (scale * d).astype(x.dtype)
 
 
 def _lora_delta_batched(lora, layer: int, idx, target: str, x: jax.Array):
-    """Per-row adapter slots (decode batch): gathered batched A·B GEMMs."""
-    a_sel = jnp.take(lora.a[target][layer], idx, axis=0)  # [B, din, r]
-    b_sel = jnp.take(lora.b[target][layer], idx, axis=0)  # [B, r, dout]
-    t = jnp.einsum("bd,bdr->br", x.astype(jnp.float32), a_sel)
-    d = jnp.einsum("br,bro->bo", t, b_sel)
-    return (jnp.take(lora.scaling, idx)[:, None] * d).astype(x.dtype)
+    """Per-row adapter slots (mixed ragged / decode batch): gathered
+    batched A·B GEMMs.
+
+    Gathered heterogeneous-rank path (``lora.ranks`` carried): the
+    batch's shards are gathered from the arena-resident stacks sliced
+    to the LARGEST rank bucket present and contracted once at that
+    width (a ``lax.cond`` per lattice value picks the one static
+    shape).  A chat batch over rank-8 tenant adapters pays rank-8
+    FLOPs even on a ``--max-lora-rank 256`` server; a mixed batch pays
+    its widest member's bucket — never more than the padded path.
+    Slot 0 (no adapter) has rank bucket 0 and scaling 0, so a
+    no-adapter batch contracts nothing and adapter-free rows
+    contribute zero — same as the padded path's zero slot."""
+    xf = x.astype(jnp.float32)
+    if getattr(lora, "ranks", None) is None:
+        a_sel = jnp.take(lora.a[target][layer], idx, axis=0)  # [B, din, r]
+        b_sel = jnp.take(lora.b[target][layer], idx, axis=0)  # [B, r, dout]
+        t = jnp.einsum("bd,bdr->br", xf, a_sel)
+        d = jnp.einsum("br,bro->bo", t, b_sel)
+        return (jnp.take(lora.scaling, idx)[:, None] * d).astype(x.dtype)
+    lattice = _rank_lattice_for(lora, target)
+    row_rb = jnp.take(lora.ranks, idx)  # [B]
+    a_layer = lora.a[target][layer]  # [S, din, rmax]
+    b_layer = lora.b[target][layer]  # [S, rmax, dout]
+    # ONE contraction at the batch's LARGEST present bucket: the stacks
+    # are zero past each adapter's true rank, so any row computes
+    # bit-identically at any width >= its own bucket (the extra terms
+    # are exact zeros), and max(present) <= sum(present) always — a
+    # per-present-bucket loop would recompute every row at every
+    # present width and cost MORE than the padded path on mixed
+    # batches.  maxrb lands exactly on a lattice value (or 0 for a
+    # no-adapter batch, which leaves the delta zero), so exactly one
+    # branch fires and no masking is needed.
+    maxrb = jnp.max(row_rb)
+    out = jnp.zeros((x.shape[0], b_layer.shape[-1]), jnp.float32)
+    for rb in lattice:
+        def bucket(acc, rb=rb):
+            a_sel = jnp.take(a_layer[:, :, :rb], idx, axis=0)
+            b_sel = jnp.take(b_layer[:, :rb, :], idx, axis=0)
+            t = jnp.einsum("bd,bdr->br", xf, a_sel)
+            return jnp.einsum("br,bro->bo", t, b_sel)
+
+        out = jax.lax.cond(maxrb == rb, bucket, lambda acc: acc, out)
+    return (jnp.take(lora.scaling, idx)[:, None] * out).astype(x.dtype)
 
 
 def _clears_moe_mask(fn):
@@ -270,6 +342,7 @@ class LlamaForCausalLM:
         dtype,
         quantization: str = "none",
         block_size: int = 16,
+        kv_scale_floors=None,
     ) -> tuple:
         # head-leading layout: a KV page is a contiguous (block_size, Dh)
         # tile per head — the shape the Pallas decode kernel DMAs directly
@@ -277,11 +350,25 @@ class LlamaForCausalLM:
         # --kv-quantization the caches become QuantizedKVCache pytrees
         # (int8/fp8 data + per-page-per-head scale sidecar,
         # ops/kv_quant.py); "none" returns the plain arrays unchanged.
+        # ``kv_scale_floors`` ((k_floor, v_floor), each [L, Hkv] f32)
+        # attaches calibrated page-scale floors from checkpoints that
+        # ship k_scale/v_scale tensors (engine/weights.py).
         cfg = self.config
         shape = (cfg.num_layers, cfg.num_kv_heads, num_slots, cfg.head_dim)
+        k_floor, v_floor = (
+            kv_scale_floors
+            if kv_scale_floors is not None
+            else (None, None)
+        )
         return (
-            kv_quant.make_kv_cache(shape, dtype, quantization, block_size),
-            kv_quant.make_kv_cache(shape, dtype, quantization, block_size),
+            kv_quant.make_kv_cache(
+                shape, dtype, quantization, block_size,
+                scale_floor=k_floor,
+            ),
+            kv_quant.make_kv_cache(
+                shape, dtype, quantization, block_size,
+                scale_floor=v_floor,
+            ),
         )
 
     # --------------------------------------------------------------- forward

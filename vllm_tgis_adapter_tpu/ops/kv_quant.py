@@ -103,14 +103,23 @@ class QuantizedKVCache:
     carries / donation exactly like the raw array it replaces; the
     ``shape`` / ``dtype`` properties keep the handful of geometry reads
     (``k_cache.shape[2]``) working unchanged.
+
+    ``floor`` (optional, ``[L, Hkv]`` f32) is the CALIBRATED per-layer
+    per-head scale floor loaded from checkpoints that ship
+    ``k_scale``/``v_scale`` tensors (engine/weights.py): models whose
+    K/V outliers punish pure-amax scaling set their page scales to
+    ``max(amax-derived, floor)`` at the slot-0 write.  None (the
+    default, and every checkpoint without the tensors) is bit-identical
+    to the pre-floor engine.
     """
 
-    __slots__ = ("data", "scale", "block_size")
+    __slots__ = ("data", "scale", "block_size", "floor")
 
-    def __init__(self, data, scale, block_size: int):
+    def __init__(self, data, scale, block_size: int, floor=None):
         self.data = data
         self.scale = scale
         self.block_size = block_size
+        self.floor = floor
 
     @property
     def shape(self):
@@ -121,12 +130,12 @@ class QuantizedKVCache:
         return self.data.dtype
 
     def tree_flatten(self):
-        return (self.data, self.scale), self.block_size
+        return (self.data, self.scale, self.floor), self.block_size
 
     @classmethod
     def tree_unflatten(cls, block_size, children):
-        data, scale = children
-        return cls(data, scale, block_size)
+        data, scale, floor = children
+        return cls(data, scale, block_size, floor)
 
 
 def is_quantized(cache) -> bool:
@@ -134,13 +143,16 @@ def is_quantized(cache) -> bool:
 
 
 def make_kv_cache(
-    shape: tuple, dtype, scheme: str = "none", block_size: int = 16
+    shape: tuple, dtype, scheme: str = "none", block_size: int = 16,
+    scale_floor=None,
 ):
     """Zeroed cache in the layout ``scheme`` dictates.
 
     ``none`` returns the plain zeros array the engine always built —
     byte-identical off.  int8/fp8 return a :class:`QuantizedKVCache`
     with an all-zero scale sidecar (every page starts "never written").
+    ``scale_floor`` ([L, Hkv] f32 or None) attaches the calibrated
+    per-head scale floor from quantization-aware checkpoints.
     """
     qdtype = storage_dtype(scheme)
     if qdtype is None:
@@ -152,6 +164,11 @@ def make_kv_cache(
             (num_layers, kv_heads, num_slots // block_size), jnp.float32
         ),
         block_size,
+        floor=(
+            None
+            if scale_floor is None
+            else jnp.asarray(scale_floor, jnp.float32)
+        ),
     )
 
 
@@ -229,9 +246,17 @@ def scatter_layer(cache, i, safe_slots, vals):
         .at[pages]
         .max(setter, mode="drop")
     )
+    set_scale = jnp.maximum(cand * SCALE_MARGIN, _EPS) / qmax
+    if cache.floor is not None:
+        # quantization-aware checkpoint (docs/QUANTIZATION.md
+        # "Calibrated scales"): the calibrated per-head scale FLOORS
+        # the amax-derived value at the slot-0 write — outlier-prone
+        # heads keep the headroom the calibration measured, while the
+        # floor itself never shrinks an amax that genuinely exceeds it
+        set_scale = jnp.maximum(set_scale, cache.floor[i][:, None])
     layer_scale = jnp.where(
         fresh[None, :] == 1,
-        jnp.maximum(cand * SCALE_MARGIN, _EPS) / qmax,
+        set_scale,
         scale[i],
     )
     scale = scale.at[i].set(layer_scale)
@@ -244,7 +269,7 @@ def scatter_layer(cache, i, safe_slots, vals):
     data = data.at[i, :, safe_slots].set(
         jnp.swapaxes(q, 0, 1), mode="drop"
     )
-    return QuantizedKVCache(data, scale, bs)
+    return QuantizedKVCache(data, scale, bs, floor=cache.floor)
 
 
 # ------------------------------------------------- per-page movement ops
@@ -308,6 +333,7 @@ def restore_kv_page(k_cache, v_cache, idx, *arrays):
             ),
             k_cache.scale.at[:, :, page].set(k_scale),
             bs,
+            floor=k_cache.floor,
         ),
         QuantizedKVCache(
             v_cache.data.at[:, :, idx, :].set(
@@ -315,5 +341,6 @@ def restore_kv_page(k_cache, v_cache, idx, *arrays):
             ),
             v_cache.scale.at[:, :, page].set(v_scale),
             bs,
+            floor=v_cache.floor,
         ),
     )

@@ -239,7 +239,31 @@ def add_engine_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         "constructions default off")
     g.add_argument("--no-kv-host-cache", action="store_true",
                    help="disable the host KV tier entirely "
-                        "(pre-tier engine behavior, byte-identical)")
+                        "(pre-tier engine behavior, byte-identical; "
+                        "also disables the disk tier beneath it)")
+    g.add_argument("--kv-disk-cache-gb", type=float, default=0.0,
+                   help="GiB of local disk beneath the host KV tier "
+                        "(docs/MEMORY.md): host-tier LRU victims — "
+                        "cold KV prefix pages and cold adapters "
+                        "spilled from the host registry — land in "
+                        "mmap-read, checksum-validated files and "
+                        "promote disk-to-host-to-device through the "
+                        "existing park/promote gates (0 = off; "
+                        "requires --kv-host-cache-gb > 0)")
+    g.add_argument("--kv-disk-cache-dir", type=str, default=None,
+                   help="directory for disk-tier entries (default: a "
+                        "stable path under the system tempdir); "
+                        "content-addressed and validated on read, so "
+                        "it may survive restarts for cross-restart "
+                        "reuse")
+    g.add_argument("--unified-arena",
+                   action=argparse.BooleanOptionalAction, default=True,
+                   help="one paged HBM arena for KV pages + adapter "
+                        "shards (docs/MEMORY.md): unified LRU + "
+                        "pinning over a single block budget, adapter "
+                        "residency charged at TRUE rank; "
+                        "--no-unified-arena restores separately-"
+                        "budgeted pools")
     g.add_argument("--enforce-eager", action="store_true",
                    help="accepted for compatibility; the TPU engine always "
                         "compiles with XLA")
@@ -370,6 +394,14 @@ def add_engine_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     g.add_argument("--lora-prefetch-concurrency", type=int, default=2,
                    help="concurrent host-to-device adapter streams per "
                         "replica pool")
+    g.add_argument("--lora-gathered",
+                   action=argparse.BooleanOptionalAction, default=True,
+                   help="heterogeneous-rank gathered LoRA matmul "
+                        "(docs/LORA.md): each row computes its delta "
+                        "at its adapter's TRUE pow2 rank bucket "
+                        "instead of padding to --max-lora-rank; "
+                        "--no-lora-gathered restores the padded "
+                        "matmuls")
 
     g = parser.add_argument_group("speculative decoding")
     g.add_argument("--speculative-model", type=str, default=None,
