@@ -25,7 +25,7 @@ def tests(session: nox.Session) -> None:
     session.run(
         "pytest", "tests/", "-q",
         *session.posargs,
-        env={"JAX_PLATFORMS": "cpu"},
+        env={"JAX_PLATFORMS": "cpu", "TGIS_TPU_SANITIZE": "1"},
     )
 
 
@@ -101,7 +101,7 @@ def chaos_check(session: nox.Session) -> None:
         "tests/test_arena.py",
         "-q",
         *session.posargs,
-        env={"JAX_PLATFORMS": "cpu"},
+        env={"JAX_PLATFORMS": "cpu", "TGIS_TPU_SANITIZE": "1"},
     )
 
 
@@ -120,7 +120,7 @@ def chaos_soak(session: nox.Session) -> None:
     session.run(
         "python", "tools/chaos_soak.py",
         *session.posargs,
-        env={"JAX_PLATFORMS": "cpu"},
+        env={"JAX_PLATFORMS": "cpu", "TGIS_TPU_SANITIZE": "1"},
     )
 
 
@@ -155,9 +155,12 @@ def lint(session: nox.Session) -> None:
 @nox.session(python="3.12")
 def tpulint(session: nox.Session) -> None:
     """Project hazard analyzer (docs/STATIC_ANALYSIS.md): recompile,
-    host-sync and async-blocking gates over the package.  Pure stdlib —
-    nothing to install; exit codes are scriptable (0/1/2) like
-    tools/obs_check.py."""
+    host-sync, async-blocking, lock-discipline (TPL4xx) and
+    resource-pairing (TPL5xx) gates over the package, plus the
+    compile-lattice manifest diff (TPL6xx;
+    `python -m tools.tpulint --write-lattice` regenerates after an
+    intentional jit change).  Pure stdlib — nothing to install; exit
+    codes are scriptable (0/1/2) like tools/obs_check.py."""
     session.run(
         "python", "tools/tpulint/cli.py",
         *(session.posargs or ["vllm_tgis_adapter_tpu"]),

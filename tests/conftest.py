@@ -21,6 +21,14 @@ from pathlib import Path
 # Pallas kernels through Mosaic on hardware (tests/test_tpu_kernels.py) —
 # the gate that interpreter-mode parity structurally cannot provide
 _TPU_RUN = os.environ.get("RUN_TPU_TESTS") == "1"
+
+# Step-boundary invariant sanitizer (engine/sanitizer.py): on for the
+# WHOLE tier-1 suite, so every existing test doubles as an invariant
+# test over allocator/arena/tier/pool accounting.  setdefault so a
+# developer can still run with TGIS_TPU_SANITIZE=0 to bisect whether a
+# failure is the bug itself or the sanitizer tripping on it.
+os.environ.setdefault("TGIS_TPU_SANITIZE", "1")
+
 if not _TPU_RUN:
     os.environ["JAX_PLATFORMS"] = "cpu"
     _flags = os.environ.get("XLA_FLAGS", "")

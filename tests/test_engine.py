@@ -1024,3 +1024,24 @@ def test_precompile_max_only_widest_batch(engine_factory):
     n = engine.precompile("max")
     assert 4 <= n <= 4 + 2 * len(engine.scheduler.ragged_buckets)
     assert not engine.has_unfinished_requests()
+
+
+def test_precompile_chained_failure_leaves_no_open_epoch(engine_factory):
+    """Regression (tpulint TPL501 finding): a failure between the
+    chained-warmup's begin_free_epoch and its flush used to leave the
+    epoch open — on a supervised re-warm retry every later free would
+    quarantine forever.  The flush is now finally-guarded."""
+    engine = engine_factory()
+    calls = {"n": 0}
+
+    def boom(plan, prepared, prev_handle):
+        calls["n"] += 1
+        raise RuntimeError("injected chained dispatch failure")
+
+    engine.dispatch_chained_step = boom
+    with pytest.raises(RuntimeError, match="injected chained"):
+        engine.precompile("all")
+    assert calls["n"] == 1, "warmup never reached the chained branch"
+    assert not engine.scheduler.allocator._free_epochs, (
+        "precompile failure leaked an open free epoch"
+    )

@@ -1,0 +1,335 @@
+"""Runtime invariant sanitizer units (engine/sanitizer.py).
+
+Each test deliberately corrupts one accounting surface — allocator
+pages, arena charges, tier bytes, pool slots, registry pins — and
+asserts the matching invariant trips with an actionable message.  A
+final integration test drives a REAL engine with the sanitizer armed
+through its step loop and asserts a clean bill of health (this is the
+same checker the whole tier-1 suite runs with ``TGIS_TPU_SANITIZE=1``).
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+if str(REPO_ROOT) not in sys.path:
+    sys.path.insert(0, str(REPO_ROOT))
+
+from vllm_tgis_adapter_tpu.engine import sanitizer  # noqa: E402
+from vllm_tgis_adapter_tpu.engine.kv_cache import BlockAllocator  # noqa: E402
+
+
+def violations_of(check, *args):
+    out: list[str] = []
+    check(*args, out)
+    return out
+
+
+# --------------------------------------------------------------- allocator
+
+
+def test_clean_allocator_passes():
+    alloc = BlockAllocator(8, 4, enable_prefix_caching=True)
+    alloc.allocate(3)
+    assert violations_of(sanitizer.check_allocator, alloc) == []
+
+
+def test_leaked_page_trips_conservation():
+    alloc = BlockAllocator(8, 4)
+    alloc.allocate(2)
+    # "lose" a live page: the bug class where a release path forgets it
+    alloc._refcount.pop(0, None)
+    out = violations_of(sanitizer.check_allocator, alloc)
+    assert any("page conservation broken" in v for v in out)
+
+
+def test_double_free_trips_overlap():
+    alloc = BlockAllocator(8, 4)
+    blocks = alloc.allocate(1)
+    # free it but ALSO leave it refcounted-live (torn free path)
+    alloc._free.append(blocks[0])
+    out = violations_of(sanitizer.check_allocator, alloc)
+    assert any("in both free and refcounted" in v for v in out)
+
+
+def test_epoch_overfree_trips():
+    alloc = BlockAllocator(8, 4)
+    blocks = alloc.allocate(1)
+    alloc.begin_free_epoch()
+    alloc.free(blocks)
+    alloc.free(blocks)  # double free INTO the quarantine
+    out = violations_of(sanitizer.check_allocator, alloc)
+    assert any("quarantine" in v for v in out)
+    # and the legitimate single-free version is clean
+    alloc2 = BlockAllocator(8, 4)
+    b2 = alloc2.allocate(1)
+    alloc2.begin_free_epoch()
+    alloc2.free(b2)
+    assert violations_of(sanitizer.check_allocator, alloc2) == []
+
+
+def test_prefix_map_asymmetry_trips():
+    alloc = BlockAllocator(8, 4, enable_prefix_caching=True)
+    alloc._hash_to_block[b"digest"] = 5
+    out = violations_of(sanitizer.check_allocator, alloc)
+    assert any("hash map asymmetry" in v for v in out)
+
+
+# ------------------------------------------------------------------- arena
+
+
+def _arena(num_blocks=16):
+    from vllm_tgis_adapter_tpu.engine.arena import UnifiedArena
+
+    alloc = BlockAllocator(num_blocks, 4)
+    return UnifiedArena(
+        alloc, kv_page_bytes=1024, adapter_budget_pages=4
+    ), alloc
+
+
+def test_clean_arena_passes():
+    arena, _ = _arena()
+    pool = SimpleNamespace()
+    assert arena.charge_adapter(pool, "tiny", 2)
+    assert violations_of(sanitizer.check_arena, arena) == []
+
+
+def test_arena_counter_drift_trips():
+    arena, _ = _arena()
+    pool = SimpleNamespace()
+    arena.charge_adapter(pool, "tiny", 2)
+    arena.adapter_blocks += 1  # accounting drift (lost release)
+    out = violations_of(sanitizer.check_arena, arena)
+    assert any("adapter_blocks" in v for v in out)
+
+
+def test_arena_borrowed_page_leak_trips():
+    arena, alloc = _arena()
+    pool = SimpleNamespace()
+    # force a borrow: charge past the 4-page reservation
+    assert arena.charge_adapter(pool, "big", 6)
+    assert arena.borrowed_blocks == 2
+    # simulate the allocator freeing a borrowed page behind the
+    # arena's back (charge/release desync)
+    borrowed = arena._charges[(id(pool), "big")][1]
+    alloc._refcount.pop(borrowed[0])
+    alloc._free.append(borrowed[0])
+    out = violations_of(sanitizer.check_arena, arena)
+    assert any("not refcounted" in v for v in out)
+
+
+# ------------------------------------------------------------------- tiers
+
+
+def _tier(budget=1 << 20):
+    from vllm_tgis_adapter_tpu.engine.kv_tier import HostKVTier
+
+    tier = HostKVTier(budget_bytes=budget, block_size=4)
+    page = (
+        b"\x01" * 32,
+        np.zeros((2, 1, 4, 4), np.float32),
+        np.zeros((2, 1, 4, 4), np.float32),
+    )
+    tier.submit([page])  # offline: inline host copy
+    return tier
+
+
+def test_clean_tier_passes():
+    tier = _tier()
+    assert tier.bytes_used > 0
+    assert violations_of(sanitizer.check_tier, tier) == []
+
+
+def test_tier_byte_drift_trips():
+    tier = _tier()
+    tier.bytes_used += 7  # the accounting bug class
+    out = violations_of(sanitizer.check_tier, tier)
+    assert any("accounting drift" in v for v in out)
+
+
+def test_tier_over_budget_trips():
+    tier = _tier()
+    tier.budget_bytes = tier.bytes_used - 1
+    # keep declared == actual so only the budget invariant trips
+    out = violations_of(sanitizer.check_tier, tier)
+    assert any("over the" in v and "budget" in v for v in out)
+
+
+def test_disk_tier_index_drift_trips(tmp_path):
+    from vllm_tgis_adapter_tpu.engine.kv_tier import DiskKVTier
+
+    tier = _tier()
+    disk = DiskKVTier(
+        budget_bytes=1 << 20, directory=str(tmp_path), block_size=4
+    )
+    disk.store_batch([
+        (b"\x02" * 32, np.ones((2, 1, 4, 4), np.float32),
+         np.ones((2, 1, 4, 4), np.float32)),
+    ])
+    tier.attach_disk(disk)
+    assert violations_of(sanitizer.check_tier, tier) == []
+    disk.bytes_used += 3
+    out = violations_of(sanitizer.check_tier, tier)
+    assert any("disk tier" in v for v in out)
+
+
+# ------------------------------------------------------- pool + registry
+
+
+def _fake_engine(pool=None, manager=None, seqs=None):
+    return SimpleNamespace(
+        runner=SimpleNamespace(adapter_pool=pool),
+        lora_manager=manager,
+        _seqs=seqs or {},
+        scheduler=SimpleNamespace(allocator=None),
+        arena=None,
+        kv_tier=None,
+        step_counter=0,
+        replica_index=0,
+    )
+
+
+def _fake_pool(max_loras=4):
+    return SimpleNamespace(
+        _closed=False,
+        _slots={"a": 1},
+        _streaming={},
+        _free=[2, 3, 4],
+        _lru={"a": 0.0},
+        max_loras=max_loras,
+    )
+
+
+def _manager():
+    from vllm_tgis_adapter_tpu.engine.lora import LoRAManager
+
+    return LoRAManager(max_loras=4, max_lora_rank=8)
+
+
+def test_clean_pool_and_pins_pass():
+    manager = _manager()
+    manager.pin("a")
+    seq = SimpleNamespace(lora_name="a", is_finished=False)
+    engine = _fake_engine(
+        pool=_fake_pool(), manager=manager, seqs={"r1": seq}
+    )
+    assert sanitizer.check_engine(engine, raise_on_violation=False) == []
+
+
+def test_slot_conservation_trips():
+    pool = _fake_pool()
+    pool._free = [2]  # two slots vanished
+    engine = _fake_engine(pool=pool, manager=None)
+    out = sanitizer.check_engine(engine, raise_on_violation=False)
+    assert any("slot conservation broken" in v for v in out)
+
+
+def test_lru_mirror_drift_trips():
+    pool = _fake_pool()
+    pool._lru = {}  # resident adapter missing its LRU stamp
+    engine = _fake_engine(pool=pool, manager=None)
+    out = sanitizer.check_engine(engine, raise_on_violation=False)
+    assert any("LRU keys disagree" in v for v in out)
+
+
+def test_leaked_pin_trips():
+    manager = _manager()
+    manager.pin("ghost")  # no live request references it
+    engine = _fake_engine(manager=manager)
+    out = sanitizer.check_engine(engine, raise_on_violation=False)
+    assert any("pin counts" in v and "ghost" in v for v in out)
+
+
+def test_missing_pin_trips():
+    manager = _manager()
+    seq = SimpleNamespace(lora_name="tiny", is_finished=False)
+    engine = _fake_engine(manager=manager, seqs={"r1": seq})
+    out = sanitizer.check_engine(engine, raise_on_violation=False)
+    assert any("pin counts" in v for v in out)
+
+
+def test_violation_raises_actionable_error():
+    manager = _manager()
+    manager.pin("ghost")
+    engine = _fake_engine(manager=manager)
+    with pytest.raises(sanitizer.SanitizerError) as exc:
+        sanitizer.check_engine(engine)
+    msg = str(exc.value)
+    assert "TGIS_TPU_SANITIZE" in msg and "ghost" in msg
+
+
+def test_enabled_reads_env(monkeypatch):
+    monkeypatch.setenv(sanitizer.ENV_VAR, "0")
+    assert not sanitizer.enabled()
+    monkeypatch.setenv(sanitizer.ENV_VAR, "1")
+    assert sanitizer.enabled()
+
+
+# -------------------------------------------------------------- integration
+
+
+def test_live_engine_steps_clean_under_sanitizer(
+    tiny_model_dir, monkeypatch
+):
+    """A real engine serving real requests holds every invariant at
+    every step boundary — the property the whole tier-1 suite now runs
+    under — and a deliberate post-hoc corruption trips the next step."""
+    from vllm_tgis_adapter_tpu.engine.config import (
+        CacheConfig,
+        EngineConfig,
+        LoRAConfig,
+        ModelConfig,
+        ParallelConfig,
+        SchedulerConfig,
+    )
+    from vllm_tgis_adapter_tpu.engine.core import LLMEngine
+    from vllm_tgis_adapter_tpu.engine.sampling_params import SamplingParams
+
+    monkeypatch.setenv(sanitizer.ENV_VAR, "1")
+    model_config = ModelConfig.from_pretrained(
+        tiny_model_dir, dtype="float32"
+    )
+    config = EngineConfig(
+        model_config=model_config,
+        cache_config=CacheConfig(
+            block_size=16, num_blocks=32,
+            cache_dtype=model_config.dtype,
+        ),
+        scheduler_config=SchedulerConfig(
+            max_num_seqs=4, prefill_buckets=(32, 64),
+        ),
+        parallel_config=ParallelConfig(),
+        lora_config=LoRAConfig(),
+    )
+    engine = LLMEngine.from_config(config)
+    for i in range(3):
+        engine.add_request(
+            f"san-{i}", f"request number {i}",
+            SamplingParams(max_tokens=8),
+        )
+    for _ in range(300):
+        if not engine.has_unfinished_requests():
+            break
+        engine.step()  # commit_step runs sanitizer.maybe_check
+    assert not engine.has_unfinished_requests()
+    assert sanitizer.check_engine(engine, raise_on_violation=False) == []
+
+    # a live single engine is its registry's SOLE user, so the EXACT
+    # pin-count branch is active (not just the fleet lower bound): a
+    # leaked pin with no live request must trip
+    engine.lora_manager.pin("ghost")
+    leaked = sanitizer.check_engine(engine, raise_on_violation=False)
+    assert any("ghost" in v for v in leaked)
+    engine.lora_manager.unpin("ghost")
+
+    # now corrupt the allocator and prove the NEXT boundary trips
+    alloc = engine.scheduler.allocator
+    alloc._free.pop()
+    with pytest.raises(sanitizer.SanitizerError):
+        sanitizer.check_engine(engine)

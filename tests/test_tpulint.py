@@ -28,12 +28,24 @@ STEP_PATH = "pkg/engine/runner.py"  # classified as step-loop
 ASYNC_PATH = "pkg/grpc/server.py"  # any module; rules key off async def
 
 
+#: pinned manifest for the fixtures: the TPL601 clean/firing snippets
+#: resolve against THIS dict, never the live checked-in manifest — an
+#: intentional lattice change must not break unrelated rule-unit tests.
+FIXTURE_MANIFEST = {
+    ("engine/runner.py", "prefill"): {
+        "module": "engine/runner.py", "name": "prefill",
+        "static_argnums": [], "static_argnames": [],
+        "partial_kwargs": [], "partial_pos": 0, "donate": True,
+    },
+}
+
+
 def lint(tmp_path: Path, rel: str, source: str):
     """Write ``source`` at ``rel`` under tmp_path and analyze it."""
     target = tmp_path / rel
     target.parent.mkdir(parents=True, exist_ok=True)
     target.write_text(textwrap.dedent(source))
-    return analyze_file(target, root=tmp_path)
+    return analyze_file(target, root=tmp_path, manifest=FIXTURE_MANIFEST)
 
 
 def active_codes(findings) -> list[str]:
@@ -203,10 +215,136 @@ FIXTURES: dict[str, tuple[str, str, str]] = {
             return await asyncio.to_thread(engine.wait_step, plan)
         """,
     ),
+    # --- TPL4xx lock discipline -----------------------------------------
+    "TPL401": (
+        "pkg/engine/kv_tier.py",
+        """
+        import asyncio
+        class Tier:
+            async def demote(self, other):
+                async with self._transfer_lock:
+                    await other.fetch()
+        """,
+        """
+        import asyncio
+        class Tier:
+            async def demote(self, batch):
+                async with self._transfer_lock:
+                    host = await asyncio.to_thread(self._to_host, batch)
+                self._insert(host)
+        """,
+    ),
+    "TPL402": (
+        "pkg/engine/core.py",
+        """
+        import threading
+        a_lock = threading.Lock()
+        b_lock = threading.Lock()
+        def one():
+            with a_lock:
+                with b_lock:
+                    pass
+        def two():
+            with b_lock:
+                with a_lock:
+                    pass
+        """,
+        """
+        import threading
+        a_lock = threading.Lock()
+        b_lock = threading.Lock()
+        def one():
+            with a_lock:
+                with b_lock:
+                    pass
+        def two():
+            with a_lock:
+                with b_lock:
+                    pass
+        """,
+    ),
+    "TPL403": (
+        "pkg/engine/adapter_pool.py",
+        """
+        import asyncio
+        class Pool:
+            async def stream(self):
+                self.swaps = 1
+                await asyncio.to_thread(self.worker)
+            def worker(self):
+                self.swaps = 2
+        """,
+        """
+        import asyncio, threading
+        class Pool:
+            async def stream(self):
+                with self._lock:
+                    self.swaps = 1
+                await asyncio.to_thread(self.worker)
+            def worker(self):
+                with self._lock:
+                    self.swaps = 2
+        """,
+    ),
+    # --- TPL5xx resource pairing ----------------------------------------
+    "TPL501": (
+        "pkg/engine/core.py",
+        """
+        def admit(self, seq):
+            self.lora_manager.pin(seq.lora_name)
+            self.scheduler.add(seq)
+            self.lora_manager.unpin(seq.lora_name)
+        """,
+        """
+        def admit(self, seq):
+            self.lora_manager.pin(seq.lora_name)
+            try:
+                self.scheduler.add(seq)
+            finally:
+                self.lora_manager.unpin(seq.lora_name)
+        """,
+    ),
+    "TPL502": (
+        "pkg/engine/kv_tier.py",
+        """
+        import asyncio
+        class Tier:
+            def submit(self, batch):
+                asyncio.create_task(self._demote(batch))
+        """,
+        """
+        from vllm_tgis_adapter_tpu.utils import spawn_task
+        class Tier:
+            def submit(self, batch):
+                spawn_task(
+                    self._demote(batch), name="demote",
+                    retain=self._tasks,
+                )
+        """,
+    ),
+    # --- TPL6xx compile-lattice manifest (per-file half) ----------------
+    "TPL601": (
+        "pkg/engine/runner.py",
+        """
+        import jax
+        from vllm_tgis_adapter_tpu.compile_tracker import track_jit
+        def build(model):
+            return track_jit("bogus_step", jax.jit(model.decode_bogus))
+        """,
+        """
+        import jax
+        from vllm_tgis_adapter_tpu.compile_tracker import track_jit
+        def build(model, donate):
+            return track_jit(
+                "prefill",
+                jax.jit(model.prefill, donate_argnums=donate),
+            )
+        """,
+    ),
 }
 
 
-@pytest.mark.parametrize("code", sorted(lint_config.RULES))
+@pytest.mark.parametrize("code", sorted(FIXTURES))
 def test_rule_fires_and_stays_quiet(tmp_path, code):
     rel, firing, clean = FIXTURES[code]
     fired = active_codes(lint(tmp_path, rel, firing))
@@ -216,8 +354,54 @@ def test_rule_fires_and_stays_quiet(tmp_path, code):
     )
 
 
+# TPL602/TPL603 are PROJECT-level (they need the manifest + docs as
+# inputs, not just one module), so their firing+clean fixtures drive
+# the project pass directly instead of analyze_file.
+_ENTRY = {
+    "module": "engine/runner.py", "name": "prefill",
+    "static_argnums": [], "static_argnames": [],
+    "partial_kwargs": [], "partial_pos": 0, "donate": True,
+}
+
+
+def _project_findings(tmp_path, sites, doc_text):
+    from tools.tpulint import lattice
+
+    doc = tmp_path / "ATTENTION.md"
+    doc.write_text(doc_text)
+    hits: list[tuple[str, str]] = []
+    lattice.check_project(
+        {"pkg/engine/runner.py": sites},
+        lambda _p, _l, code, detail: hits.append((code, detail)),
+        manifest={("engine/runner.py", "prefill"): dict(_ENTRY)},
+        attention_doc=doc,
+    )
+    return [code for code, _ in hits]
+
+
+PROJECT_FIXTURES = {"TPL602", "TPL603"}
+
+
+def test_tpl602_stale_manifest_entry(tmp_path):
+    # firing: the analyzed module has NO site for the manifest entry
+    assert "TPL602" in _project_findings(tmp_path, [], "prefill doc")
+    # clean: the site exists
+    site = {**_ENTRY, "line": 1}
+    assert _project_findings(tmp_path, [site], "prefill doc") == []
+
+
+def test_tpl603_entry_missing_from_docs(tmp_path):
+    site = {**_ENTRY, "line": 1}
+    assert "TPL603" in _project_findings(
+        tmp_path, [site], "no entry names here"
+    )
+    assert _project_findings(tmp_path, [site], "see `prefill`") == []
+
+
 def test_fixture_table_covers_every_rule():
-    assert sorted(FIXTURES) == sorted(lint_config.RULES)
+    assert sorted({*FIXTURES, *PROJECT_FIXTURES}) == sorted(
+        lint_config.RULES
+    )
 
 
 # ----------------------------------------------------------- suppressions
@@ -401,3 +585,331 @@ def test_docs_list_every_rule_code():
     for code in lint_config.RULES:
         assert code in doc, f"{code} missing from docs/STATIC_ANALYSIS.md"
     assert "tpulint: disable=" in doc  # suppression syntax documented
+
+
+# --------------------------------------------- historical bug shapes
+
+
+def test_tpl502_detects_the_pr9_gcd_promotion_task(tmp_path):
+    """The PR 9 bug shape verbatim: a transfer task spawned with a raw
+    create_task and referenced nowhere strongly — the loop's weak ref
+    lets GC collect it mid-flight, parking its request forever."""
+    findings = lint(
+        tmp_path, "pkg/engine/kv_tier.py",
+        """
+        import asyncio
+        class Tier:
+            def start_promotion(self, ticket, put_fn):
+                loop = asyncio.get_running_loop()
+                loop.create_task(self._assemble(ticket, put_fn))
+        """,
+    )
+    assert "TPL502" in active_codes(findings)
+
+
+def test_tpl501_detects_the_unpaired_pin_shape(tmp_path):
+    """The PR 5 bug shape: a pin whose release is skipped the moment
+    the work between the pair raises (exception path leaks the ref)."""
+    findings = lint(
+        tmp_path, "pkg/engine/core.py",
+        """
+        def restart(self, seq):
+            self.lora_manager.pin(seq.lora_name)
+            self.replay(seq)          # raises on a wedged device
+            self.lora_manager.unpin(seq.lora_name)
+        """,
+    )
+    assert "TPL501" in active_codes(findings)
+
+
+def test_tpl402_cross_module_cycle_via_project_pass(tmp_path):
+    """Interprocedural, cross-module: module A holds its lock and calls
+    into module B (which takes B's lock); module B holds its lock and
+    calls back into A.  Neither file alone shows a cycle."""
+    from tools.tpulint.analyzer import analyze_project
+
+    a = tmp_path / "pkg" / "engine" / "alpha.py"
+    b = tmp_path / "pkg" / "engine" / "beta.py"
+    a.parent.mkdir(parents=True)
+    a.write_text(textwrap.dedent(
+        """
+        import threading
+        alpha_lock = threading.Lock()
+        def touch_beta(beta):
+            with alpha_lock:
+                beta_side(beta)
+        def alpha_side(x):
+            with alpha_lock:
+                pass
+        """
+    ))
+    b.write_text(textwrap.dedent(
+        """
+        import threading
+        beta_lock = threading.Lock()
+        def beta_side(x):
+            with beta_lock:
+                pass
+        def touch_alpha(alpha):
+            with beta_lock:
+                alpha_side(alpha)
+        """
+    ))
+    findings = analyze_project([a, b], root=tmp_path)
+    cross = [
+        f for f in findings
+        if f.code == "TPL402" and "cross-module" in f.message
+    ]
+    assert cross, [f.render() for f in findings]
+
+
+def test_tpl501_second_unguarded_pair_still_fires(tmp_path):
+    """A correctly finally-guarded pair must not whitelist a SECOND,
+    unguarded acquire of the same names in the same function."""
+    findings = lint(
+        tmp_path, "pkg/engine/core.py",
+        """
+        def admit_two(self, a, b):
+            self.lora_manager.pin(a.name)
+            try:
+                work(a)
+            finally:
+                self.lora_manager.unpin(a.name)
+            self.lora_manager.pin(b.name)
+            work(b)
+            self.lora_manager.unpin(b.name)
+        """,
+    )
+    assert "TPL501" in active_codes(findings)
+
+
+def test_tpl402_cycle_through_recursive_helpers(tmp_path):
+    """Lock closures must converge through call cycles: fa<->fb
+    recurse, and a caller holding b_lock reaches a_lock only through
+    that cycle.  A memoized partial expansion used to drop the edge."""
+    findings = lint(
+        tmp_path, "pkg/engine/core.py",
+        """
+        import threading
+        a_lock = threading.Lock()
+        b_lock = threading.Lock()
+        def fa(n):
+            with a_lock:
+                pass
+            fb(n)
+        def fb(n):
+            fa(n)
+        def prime():
+            fb(0)  # populate the closure cache via the cycle
+        def under_b(n):
+            with b_lock:
+                fb(n)
+        def under_a():
+            with a_lock:
+                with b_lock:
+                    pass
+        """,
+    )
+    assert "TPL402" in active_codes(findings)
+
+
+def test_tpl402_multi_item_with_statement(tmp_path):
+    """`with a_lock, b_lock:` acquires in item order and must emit the
+    ordering edge exactly like two nested statements (the textbook
+    two-lock deadlock must not escape via the one-statement spelling)."""
+    findings = lint(
+        tmp_path, "pkg/engine/core.py",
+        """
+        import threading
+        a_lock = threading.Lock()
+        b_lock = threading.Lock()
+        def one():
+            with a_lock, b_lock:
+                pass
+        def two():
+            with b_lock, a_lock:
+                pass
+        """,
+    )
+    assert "TPL402" in active_codes(findings)
+
+
+def test_tpl402_cycle_edges_attributed_to_one_module(tmp_path):
+    """A cycle whose EDGES all attribute to one module can still be
+    invisible to the per-file pass (the called functions live in
+    another file) — the project pass must report it, deduping against
+    per-file-REPORTED cycles, not edge attribution."""
+    from tools.tpulint.analyzer import analyze_file as _af
+    from tools.tpulint.analyzer import analyze_project
+
+    a = tmp_path / "pkg" / "engine" / "alpha.py"
+    b = tmp_path / "pkg" / "engine" / "beta.py"
+    a.parent.mkdir(parents=True)
+    a.write_text(textwrap.dedent(
+        """
+        def first(tier):
+            with tier.x_lock:
+                take_y(tier)
+        def second(tier):
+            with tier.y_lock:
+                take_x(tier)
+        """
+    ))
+    b.write_text(textwrap.dedent(
+        """
+        def take_y(tier):
+            with tier.y_lock:
+                pass
+        def take_x(tier):
+            with tier.x_lock:
+                pass
+        """
+    ))
+    # neither file alone shows the cycle...
+    assert "TPL402" not in active_codes(_af(a, root=tmp_path))
+    assert "TPL402" not in active_codes(_af(b, root=tmp_path))
+    # ...so the project pass MUST
+    findings = analyze_project([a, b], root=tmp_path)
+    assert any(f.code == "TPL402" for f in findings), [
+        f.render() for f in findings
+    ]
+
+
+def test_tpl601_manifest_entry_missing_optional_key_is_not_drift(tmp_path):
+    """A hand-edited manifest entry without partial_pos must compare
+    against describe_site's default (0), not a bogus []."""
+    import ast as _ast
+
+    from tools.tpulint import lattice
+
+    entry = {
+        "module": "engine/runner.py", "name": "prefill",
+        "static_argnums": [], "static_argnames": [],
+        "partial_kwargs": [], "donate": True,
+        # no partial_pos key
+    }
+    src = textwrap.dedent(
+        """
+        import jax
+        from vllm_tgis_adapter_tpu.compile_tracker import track_jit
+        fn = track_jit("prefill", jax.jit(model.prefill,
+                                          donate_argnums=(0,)))
+        """
+    )
+    hits: list[str] = []
+    lattice.check_module(
+        _ast.parse(src), "pkg/engine/runner.py",
+        lambda _n, code, _d="": hits.append(code),
+        manifest={("engine/runner.py", "prefill"): entry},
+    )
+    assert hits == []
+
+
+def test_tpl502_exemption_is_exact_component():
+    """engine/io_utils.py must not inherit utils.py's exemption."""
+    from tools.tpulint import config as cfg
+
+    assert cfg.is_task_helper_module("vllm_tgis_adapter_tpu/utils.py")
+    assert cfg.is_task_helper_module("utils.py")
+    assert not cfg.is_task_helper_module(
+        "vllm_tgis_adapter_tpu/engine/io_utils.py"
+    )
+    assert not cfg.is_task_helper_module("pkg/tgis_utils.py")
+
+
+# ------------------------------------------- compile-lattice manifest
+
+
+def test_checked_in_manifest_matches_the_package():
+    """Drift gate: regenerating the manifest from the shipped package
+    must reproduce the checked-in file byte-for-byte (entries)."""
+    import json
+
+    from tools.tpulint.lattice import build_manifest
+
+    built = build_manifest([REPO_ROOT / "vllm_tgis_adapter_tpu"],
+                           root=REPO_ROOT)
+    checked_in = json.loads(
+        (REPO_ROOT / "tools" / "tpulint" / "lattice_manifest.json")
+        .read_text()
+    )
+    assert built["entries"] == checked_in["entries"], (
+        "lattice_manifest.json is stale — regenerate with "
+        "`python -m tools.tpulint --write-lattice` and update "
+        "docs/ATTENTION.md"
+    )
+
+
+def test_write_lattice_round_trips(tmp_path):
+    from tools.tpulint.lattice import write_manifest
+
+    out = tmp_path / "manifest.json"
+    target = write_manifest(
+        [REPO_ROOT / "vllm_tgis_adapter_tpu"], out=out, root=REPO_ROOT
+    )
+    assert target == out
+    import json
+
+    doc = json.loads(out.read_text())
+    names = {e["name"] for e in doc["entries"]}
+    assert "ragged_step" in names and "lora_slot_update" in names
+
+
+def test_manifest_agrees_with_live_engine_boot(tiny_model_dir):
+    """Acceptance: every entry point the compile tracker OBSERVES on a
+    live engine boot + serve matches a manifest name (fnmatch for the
+    pipeline's pp{s}_* templates)."""
+    import fnmatch
+    import json
+
+    from vllm_tgis_adapter_tpu import compile_tracker
+    from vllm_tgis_adapter_tpu.engine.config import (
+        CacheConfig,
+        EngineConfig,
+        LoRAConfig,
+        ModelConfig,
+        ParallelConfig,
+        SchedulerConfig,
+    )
+    from vllm_tgis_adapter_tpu.engine.core import LLMEngine
+    from vllm_tgis_adapter_tpu.engine.sampling_params import SamplingParams
+
+    compile_tracker.reset()
+    model_config = ModelConfig.from_pretrained(
+        tiny_model_dir, dtype="float32"
+    )
+    config = EngineConfig(
+        model_config=model_config,
+        cache_config=CacheConfig(
+            block_size=16, num_blocks=64,
+            cache_dtype=model_config.dtype,
+        ),
+        scheduler_config=SchedulerConfig(
+            max_num_seqs=4, prefill_buckets=(32, 64),
+        ),
+        parallel_config=ParallelConfig(),
+        lora_config=LoRAConfig(),
+    )
+    engine = LLMEngine.from_config(config)
+    engine.add_request(
+        "live-boot", "hello lattice", SamplingParams(max_tokens=8)
+    )
+    for _ in range(200):
+        if not engine.has_unfinished_requests():
+            break
+        engine.step()
+    observed = {fn for fn, _shape in compile_tracker.shapes()}
+    assert observed, "live boot compiled nothing — tracker broken?"
+    manifest = json.loads(
+        (REPO_ROOT / "tools" / "tpulint" / "lattice_manifest.json")
+        .read_text()
+    )
+    patterns = [e["name"] for e in manifest["entries"]]
+    unmatched = {
+        fn for fn in observed
+        if not any(fnmatch.fnmatch(fn, p) for p in patterns)
+    }
+    assert not unmatched, (
+        f"live engine compiled entry points missing from the "
+        f"compile-lattice manifest: {sorted(unmatched)}"
+    )

@@ -4,9 +4,11 @@ from __future__ import annotations
 
 import asyncio
 
+from vllm_tgis_adapter_tpu import utils
 from vllm_tgis_adapter_tpu.utils import (
     TTLCache,
     check_for_failed_tasks,
+    spawn_task,
     to_list,
     write_termination_log,
 )
@@ -78,3 +80,59 @@ def test_check_for_failed_tasks():
         return check_for_failed_tasks([t1, t2]) is t2
 
     assert asyncio.run(run())
+
+
+# ------------------------------------------------------------- spawn_task
+
+
+def test_spawn_task_holds_a_strong_ref_until_done():
+    """The PR 9 GC'd-task regression: the loop keeps only weak task
+    refs, so the spawner must retain the task until completion."""
+
+    async def main():
+        gate = asyncio.Event()
+
+        async def job():
+            await gate.wait()
+            return 41
+
+        task = spawn_task(job(), name="ref-test")
+        # strongly referenced while in flight, even if the caller drops
+        # its handle
+        assert task in utils._BACKGROUND_TASKS
+        gate.set()
+        assert await task == 41
+        await asyncio.sleep(0)  # let the done callback run
+        assert task not in utils._BACKGROUND_TASKS
+        assert task.get_name() == "ref-test"
+
+    asyncio.run(main())
+
+
+def test_spawn_task_retains_in_caller_container():
+    async def main():
+        mine: set = set()
+
+        async def job():
+            return "ok"
+
+        task = spawn_task(job(), retain=mine)
+        assert task in mine and task not in utils._BACKGROUND_TASKS
+        await task
+        await asyncio.sleep(0)
+        assert not mine
+
+    asyncio.run(main())
+
+
+def test_spawn_task_explicit_loop():
+    loop = asyncio.new_event_loop()
+    try:
+        async def job():
+            return 7
+
+        # schedule on a not-yet-running loop (the __main__ boot shape)
+        task = spawn_task(job(), loop=loop)
+        assert loop.run_until_complete(task) == 7
+    finally:
+        loop.close()

@@ -53,6 +53,10 @@ REPO_ROOT = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO_ROOT))
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# every soak step runs the invariant sanitizer (engine/sanitizer.py):
+# a fault schedule that corrupts allocator/arena/tier/pool accounting
+# fails AT the corrupting step, not as a downstream token divergence
+os.environ.setdefault("TGIS_TPU_SANITIZE", "1")
 
 from tools.scenarios import (  # noqa: E402 — after sys.path insert
     build_engine,

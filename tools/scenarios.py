@@ -48,6 +48,9 @@ REPO_ROOT = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO_ROOT))
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# steady-state suites double as invariant tests (engine/sanitizer.py):
+# accounting drift fails the suite at the drifting step
+os.environ.setdefault("TGIS_TPU_SANITIZE", "1")
 
 #: the shared "system prompt" RAG requests reuse (tiers + prefix paths)
 RAG_PREFIX = list(range(400, 424))

@@ -1,6 +1,6 @@
 """tpulint: AST-based hazard analyzer for this JAX/TPU serving stack.
 
-Three hazard families, one per latency pathology we have paged on:
+Six hazard families, one per bug class we have paged on:
 
 * **TPL1xx recompile hazards** — code inside a jitted function that makes
   the traced program shape- or value-dependent (each novel shape is a
@@ -11,6 +11,21 @@ Three hazard families, one per latency pathology we have paged on:
 * **TPL3xx async-blocking hazards** — synchronous work on the event loop
   in the serving tier (``grpc/``, ``http.py``, ``engine/async_llm.py``),
   which stalls every in-flight stream at once.
+* **TPL4xx lock discipline** (tools/tpulint/concurrency.py) —
+  interprocedural lock-acquisition graphs over ``engine/``,
+  ``supervisor/`` and ``frontdoor/``: awaits under engine locks,
+  cross-module lock-order cycles, loop/worker-thread write races.
+* **TPL5xx resource pairing** (tools/tpulint/resources.py) —
+  acquire/release pairs (pins, arena charges, pages, epochs, failpoint
+  arms) must release on every exit path; raw ``asyncio.create_task``
+  must ride ``utils.spawn_task``'s strong-ref set.
+* **TPL6xx compile-lattice manifest** (tools/tpulint/lattice.py) —
+  every ``track_jit`` entry point with its static args is pinned in
+  the checked-in ``lattice_manifest.json`` (``--write-lattice``
+  regenerates); unmanifested/stale/undocumented entries fail the gate.
+
+The runtime companion is ``engine/sanitizer.py`` (TGIS_TPU_SANITIZE=1):
+step-boundary invariant checks over the accounting these rules guard.
 
 The analyzer knows which functions are jitted: direct ``jax.jit`` /
 ``shard_map`` decoration, ``functools.partial(jax.jit, ...)``, call-site

@@ -31,7 +31,7 @@ import tokenize
 from pathlib import Path
 from typing import Iterable, Optional, Union
 
-from tools.tpulint import config
+from tools.tpulint import astutil, concurrency, config, lattice, resources
 
 _DISABLE_RE = re.compile(r"#\s*tpulint:\s*disable=(?P<body>.+)$")
 # lazy reason + lookahead to the next entry or end-of-comment, so
@@ -579,9 +579,47 @@ def _check_jit_call_sites(index: JitIndex, rel_path: str,
 # ------------------------------------------------------------- public API
 
 
-def analyze_source(source: str, rel_path: str) -> list[Finding]:
-    """All findings for one module (suppressed ones flagged, not
-    dropped, so callers can audit the suppression inventory)."""
+class ModuleAnalysis:
+    """One module's findings plus the artifacts the project-wide passes
+    (cross-module lock cycles, manifest staleness) consume."""
+
+    def __init__(
+        self,
+        findings: list[Finding],
+        lock_graph,  # noqa: ANN001 — concurrency.ModuleLockGraph
+        lattice_sites: list[dict],
+        suppressions: dict[int, dict[str, str]],
+        standalone: set[int],
+    ):
+        self.findings = findings
+        self.lock_graph = lock_graph
+        self.lattice_sites = lattice_sites
+        self.suppressions = suppressions
+        self.standalone = standalone
+
+
+def _apply_suppressions(
+    findings: list[Finding],
+    suppressions: dict[int, dict[str, str]],
+    standalone: set[int],
+) -> None:
+    for f in findings:
+        if f.code == "TPL000":
+            continue  # the audit rule itself cannot be waived
+        # own line (trailing comment), or a STANDALONE disable directly
+        # above — a trailing disable never waives the line below it
+        reason = suppressions.get(f.line, {}).get(f.code)
+        if reason is None and f.line - 1 in standalone:
+            reason = suppressions.get(f.line - 1, {}).get(f.code)
+        if reason is not None:
+            f.suppressed = True
+            f.reason = reason
+
+
+def analyze_module(
+    source: str, rel_path: str, manifest: Optional[dict] = None
+) -> ModuleAnalysis:
+    """Full per-module analysis (every per-file rule family)."""
     tree = ast.parse(source, filename=rel_path)
     index, _ = _index_module(tree, rel_path)
 
@@ -599,26 +637,132 @@ def analyze_source(source: str, rel_path: str) -> list[Finding]:
             )
         )
 
+    def emit(node, code, detail="") -> None:  # noqa: ANN001
+        message = config.RULES[code].split(" (")[0]
+        if detail:
+            message = f"{message}: {detail}"
+        findings.append(
+            Finding(
+                path=rel_path,
+                line=getattr(node, "lineno", 1),
+                col=getattr(node, "col_offset", 0),
+                code=code,
+                message=message,
+            )
+        )
+
     _check_jit_call_sites(index, rel_path, findings)
     awaited = {n.value for n in ast.walk(tree) if isinstance(n, ast.Await)}
     _Checker(rel_path, index, findings, awaited).visit(tree)
 
-    for f in findings:
-        if f.code == "TPL000":
-            continue  # the audit rule itself cannot be waived
-        # own line (trailing comment), or a STANDALONE disable directly
-        # above — a trailing disable never waives the line below it
-        reason = suppressions.get(f.line, {}).get(f.code)
-        if reason is None and f.line - 1 in standalone:
-            reason = suppressions.get(f.line - 1, {}).get(f.code)
-        if reason is not None:
-            f.suppressed = True
-            f.reason = reason
+    # TPL4xx: lock discipline (+ the module's own lock-order cycles;
+    # cross-module cycles are the CLI's project-wide pass)
+    lock_graph = concurrency.analyze_module(tree, rel_path, emit)
+    concurrency.emit_cycles(
+        lock_graph.edges(),
+        lambda _path, line, code, detail: emit(
+            astutil.Anchor(line), code, detail
+        ),
+    )
+    # TPL5xx: resource pairing + raw task spawns
+    resources.check_pairing(tree, rel_path, emit)
+    resources.check_task_spawns(tree, rel_path, emit)
+    # TPL6xx: compile-lattice manifest agreement (per-file half)
+    lattice_sites = lattice.check_module(
+        tree, rel_path, emit, manifest=manifest
+    )
+
+    _apply_suppressions(findings, suppressions, standalone)
     findings.sort(key=lambda f: (f.line, f.col, f.code))
-    return findings
+    return ModuleAnalysis(
+        findings, lock_graph, lattice_sites, suppressions, standalone
+    )
 
 
-def analyze_file(path, root=None) -> list[Finding]:  # noqa: ANN001
+def analyze_source(
+    source: str, rel_path: str, manifest: Optional[dict] = None
+) -> list[Finding]:
+    """All findings for one module (suppressed ones flagged, not
+    dropped, so callers can audit the suppression inventory).
+    ``manifest`` overrides the checked-in compile-lattice manifest —
+    unit fixtures pin their own so they never couple to the live jit
+    lattice."""
+    return analyze_module(source, rel_path, manifest=manifest).findings
+
+
+def analyze_file(path, root=None, manifest=None) -> list[Finding]:  # noqa: ANN001
     p = Path(path)
     rel = p.relative_to(root).as_posix() if root else p.as_posix()
-    return analyze_source(p.read_text(encoding="utf-8"), rel)
+    return analyze_source(
+        p.read_text(encoding="utf-8"), rel, manifest=manifest
+    )
+
+
+def analyze_project(
+    files, root=None, manifest=None, attention_doc=None
+) -> list[Finding]:  # noqa: ANN001
+    """Per-file analysis over ``files`` PLUS the project-wide passes:
+    cross-module lock-order cycles (TPL402) and manifest staleness /
+    doc drift (TPL602/TPL603).  The CLI's full-package invocation."""
+    if manifest is None:
+        manifest = config.load_manifest()
+    analyses: dict[str, ModuleAnalysis] = {}
+    findings: list[Finding] = []
+    for path in files:
+        p = Path(path)
+        rel = p.relative_to(root).as_posix() if root else p.as_posix()
+        analysis = analyze_module(
+            p.read_text(encoding="utf-8"), rel, manifest=manifest
+        )
+        analyses[rel] = analysis
+        findings.extend(analysis.findings)
+
+    # cross-module lock-order cycles: the project edge set additionally
+    # resolves calls ACROSS modules.  Dedup against the cycles the
+    # per-file passes ACTUALLY reported — not by which module the
+    # edges attribute to: a cycle whose edges all sit in one module can
+    # still be invisible per-file when its call targets live elsewhere
+    per_file_cycles: set[tuple[str, ...]] = set()
+    for analysis in analyses.values():
+        for cycle, _p, _l in concurrency.find_cycles(
+            analysis.lock_graph.edges()
+        ):
+            per_file_cycles.add(concurrency.canonical_cycle(cycle))
+    merged = concurrency.project_edges(
+        [a.lock_graph for a in analyses.values()]
+    )
+    cross: list[Finding] = []
+    for cycle, path_, line in concurrency.find_cycles(merged):
+        if concurrency.canonical_cycle(cycle) in per_file_cycles:
+            continue  # already reported by the per-file pass
+        pretty = " -> ".join([*cycle, cycle[0]])
+        cross.append(
+            Finding(
+                path=path_, line=line, col=0, code="TPL402",
+                message=f"{config.RULES['TPL402'].split(' (')[0]}: "
+                        f"{pretty} (cross-module)",
+            )
+        )
+    for f in cross:
+        analysis = analyses.get(f.path)
+        if analysis is not None:
+            _apply_suppressions(
+                [f], analysis.suppressions, analysis.standalone
+            )
+    findings.extend(cross)
+
+    # manifest staleness + docs drift
+    def emit_at(path_, line, code, detail) -> None:  # noqa: ANN001
+        message = config.RULES[code].split(" (")[0]
+        findings.append(
+            Finding(
+                path=str(path_), line=line, col=0, code=code,
+                message=f"{message}: {detail}",
+            )
+        )
+
+    lattice.check_project(
+        {rel: a.lattice_sites for rel, a in analyses.items()},
+        emit_at, manifest=manifest, attention_doc=attention_doc,
+    )
+    return findings

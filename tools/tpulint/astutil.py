@@ -1,0 +1,25 @@
+"""Tiny AST helpers shared by the tpulint rule families."""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+
+def call_bare_name(func: ast.expr) -> Optional[str]:
+    """The callable's last-segment name: ``foo`` for ``foo(...)``,
+    ``bar`` for ``obj.attr.bar(...)``; None for computed callees."""
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+class Anchor:
+    """Minimal node stand-in carrying a location for finding emitters
+    (rules that anchor to a line they computed, not an AST node)."""
+
+    def __init__(self, lineno: int, col_offset: int = 0):
+        self.lineno = lineno
+        self.col_offset = col_offset

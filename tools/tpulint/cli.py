@@ -17,7 +17,10 @@ if __package__ in (None, ""):  # direct-file invocation
     sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
 
 from tools.tpulint import config  # noqa: E402
-from tools.tpulint.analyzer import Finding, analyze_file  # noqa: E402
+from tools.tpulint.analyzer import (  # noqa: E402
+    Finding,
+    analyze_project,
+)
 
 
 def iter_py_files(paths: list[str]) -> list[Path]:
@@ -62,6 +65,12 @@ def main(argv: list[str] | None = None) -> int:
         help="print the rule table and exit 0",
     )
     parser.add_argument(
+        "--write-lattice", action="store_true",
+        help="regenerate tools/tpulint/lattice_manifest.json from the "
+             "given paths (after an INTENTIONAL jit-entry change; "
+             "mirrors perf_check's --write convention) and exit 0",
+    )
+    parser.add_argument(
         "--format", choices=("text", "json"), default="text",
         help="output format (json includes suppressed findings)",
     )
@@ -82,13 +91,18 @@ def main(argv: list[str] | None = None) -> int:
         print(f"tpulint: {e}", file=sys.stderr)
         return 2
 
-    findings: list[Finding] = []
-    for path in files:
-        try:
-            findings.extend(analyze_file(path))
-        except SyntaxError as e:
-            print(f"tpulint: cannot parse {path}: {e}", file=sys.stderr)
-            return 2
+    if args.write_lattice:
+        from tools.tpulint.lattice import write_manifest
+
+        target = write_manifest([Path(p) for p in args.paths])
+        print(f"tpulint: wrote compile-lattice manifest to {target}")
+        return 0
+
+    try:
+        findings: list[Finding] = analyze_project(files)
+    except SyntaxError as e:
+        print(f"tpulint: cannot parse: {e}", file=sys.stderr)
+        return 2
 
     if args.format == "json":
         print(json.dumps(

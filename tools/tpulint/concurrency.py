@@ -1,0 +1,471 @@
+"""TPL4xx: interprocedural lock-discipline analysis.
+
+Three rules over the engine's locking idiom (``async with self._lock`` /
+``with _lock`` on attribute- or module-resolved locks):
+
+* **TPL401** — an ``await`` of anything but ``asyncio.to_thread`` while
+  holding an engine lock.  The replica lock, the tier transfer lock and
+  the adapter stream lock all serialize the step loop's host phases; an
+  arbitrary suspension under one extends the critical section by an
+  unbounded amount and is the precondition for every lock-order deadlock.
+* **TPL402** — lock-order cycles.  Each module contributes a directed
+  graph (lock A held while lock B is acquired, directly or through a
+  called function's own acquisitions — the interprocedural part); a
+  cycle in the merged graph means two tasks can each hold one half.
+* **TPL403** — a ``self.<attr>`` written both from coroutine context
+  (an ``async def`` body) and from worker-thread context (a function
+  dispatched via ``asyncio.to_thread``, or a same-class function it
+  calls) with no common lock guarding both writes — the torn-accounting
+  bug class of the transfer paths.
+
+Lock identity is resolved statically: ``self.X`` → ``Class.X``,
+``other.X`` → ``*.X`` (instance wildcard — two replicas' ``rep.lock``
+are deliberately the SAME node, because taking two instances of one
+lock class in opposite orders is exactly the hazard), bare names →
+``module:name``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional, Union
+
+from tools.tpulint import config
+from tools.tpulint.astutil import Anchor, call_bare_name
+
+_FuncNode = Union[ast.FunctionDef, ast.AsyncFunctionDef]
+
+
+class FunctionLockInfo:
+    """Lock behavior of one function, for the cross-function passes."""
+
+    def __init__(self, qualname: str, node: _FuncNode, is_async: bool):
+        self.qualname = qualname
+        self.node = node
+        self.is_async = is_async
+        #: every lock this function acquires directly: (lock_id, lineno)
+        self.acquired: list[tuple[str, int]] = []
+        #: (outer_lock, inner_lock, lineno) — direct nesting in this fn
+        self.nested: list[tuple[str, str, int]] = []
+        #: (held_lock, callee_name, bare, lineno) — calls under a lock;
+        #: ``bare`` distinguishes ``release(x)`` (resolves to module
+        #: functions / nested defs) from ``obj.release(x)`` (resolves to
+        #: methods only — a semaphore's ``.release`` must never alias a
+        #: module-level function of the same name)
+        self.calls_under_lock: list[tuple[str, str, bool, int]] = []
+        #: (name, bare) of everything this function calls (any context)
+        self.calls: set[tuple[str, bool]] = set()
+
+
+class ModuleLockGraph:
+    """Per-module result: function infos + the module's own lock edges
+    (the CLI merges these across modules for the global cycle pass)."""
+
+    def __init__(self, rel_path: str):
+        self.rel_path = rel_path
+        self.functions: dict[str, FunctionLockInfo] = {}
+        #: name -> [qualnames] for bare-name call resolution
+        self.by_name: dict[str, list[str]] = {}
+
+    def resolve(self, caller: str, name: str, bare: bool) -> list[str]:
+        """Qualnames a call from ``caller`` may reach: bare-name calls
+        resolve to module-level functions and defs nested under the
+        caller; attribute calls resolve to class methods / nested defs
+        (never module-level functions — ``sem.release()`` must not
+        alias a module ``release``)."""
+        out = []
+        for qual in self.by_name.get(name, ()):
+            nested_in_caller = qual.startswith(f"{caller}.")
+            if bare and ("." not in qual or nested_in_caller):
+                out.append(qual)
+            elif not bare and ("." in qual):
+                out.append(qual)
+        return out
+
+    def edges(self) -> list[tuple[str, str, str, int]]:
+        """(outer, inner, path, line) lock-order edges, interprocedural
+        within this module's call graph."""
+        closure = _lock_closures(dict(self.functions), self.resolve)
+        out: list[tuple[str, str, str, int]] = []
+        for qual, info in self.functions.items():
+            for outer, inner, line in info.nested:
+                out.append((outer, inner, self.rel_path, line))
+            for held, callee, bare, line in info.calls_under_lock:
+                for target in self.resolve(qual, callee, bare):
+                    for inner in closure.get(target, ()):
+                        out.append((held, inner, self.rel_path, line))
+        return out
+
+
+def resolve_lock(expr: ast.expr, class_name: Optional[str],
+                 rel_path: str) -> Optional[str]:
+    """Static lock identity of a with-item context expression, or None
+    when the expression does not look like a lock at all."""
+    target = expr
+    # unwrap `lock.acquire()`-style calls conservatively: the with form
+    # is the idiom here, so only bare names/attributes are resolved
+    if isinstance(target, ast.Attribute):
+        if not config.LOCK_NAME.search(target.attr):
+            return None
+        base = target.value
+        if isinstance(base, ast.Name) and base.id == "self":
+            return f"{class_name or '?'}.{target.attr}"
+        return f"*.{target.attr}"
+    if isinstance(target, ast.Name):
+        if not config.LOCK_NAME.search(target.id):
+            return None
+        return f"{rel_path}:{target.id}"
+    return None
+
+
+def _allowed_await(value: ast.expr) -> bool:
+    """Is this awaitee sanctioned under a held lock (TPL401)?"""
+    if isinstance(value, ast.Call):
+        name = call_bare_name(value.func)
+        return name in config.ALLOWED_AWAITS_UNDER_LOCK
+    return False
+
+
+class _LockVisitor(ast.NodeVisitor):
+    """One walk collecting lock info + TPL401 findings for a module."""
+
+    def __init__(self, rel_path: str, emit) -> None:  # noqa: ANN001
+        self.rel_path = rel_path
+        self.emit = emit  # emit(node, code, detail)
+        self.graph = ModuleLockGraph(rel_path)
+        self._class: Optional[str] = None
+        self._fn: Optional[FunctionLockInfo] = None
+        self._held: list[str] = []  # lock stack within current function
+        self._prefix = ""
+
+    # ------------------------------------------------------------- scopes
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        prev, prev_prefix = self._class, self._prefix
+        self._class = node.name
+        self._prefix = f"{prev_prefix}{node.name}."
+        self.generic_visit(node)
+        self._class, self._prefix = prev, prev_prefix
+
+    def _visit_fn(self, node: _FuncNode, is_async: bool) -> None:
+        qual = f"{self._prefix}{node.name}"
+        info = FunctionLockInfo(qual, node, is_async)
+        prev_fn, prev_held, prev_prefix = self._fn, self._held, self._prefix
+        self._fn, self._held, self._prefix = info, [], f"{qual}."
+        self.graph.functions[qual] = info
+        self.graph.by_name.setdefault(node.name, []).append(qual)
+        self.generic_visit(node)
+        self._fn, self._held, self._prefix = prev_fn, prev_held, prev_prefix
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._visit_fn(node, is_async=False)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._visit_fn(node, is_async=True)
+
+    # -------------------------------------------------------------- locks
+
+    def _enter_with(self, node, is_async: bool) -> None:  # noqa: ANN001
+        # push each item onto the held stack BEFORE resolving the next:
+        # `with a_lock, b_lock:` acquires in item order and must emit
+        # the a->b ordering edge exactly like two nested statements
+        pushed = 0
+        for item in node.items:
+            lock = resolve_lock(item.context_expr, self._class,
+                                self.rel_path)
+            if lock is None:
+                continue
+            if self._fn is not None:
+                self._fn.acquired.append((lock, node.lineno))
+                if self._held:
+                    self._fn.nested.append(
+                        (self._held[-1], lock, node.lineno)
+                    )
+            self._held.append(lock)
+            pushed += 1
+        self.generic_visit(node)
+        del self._held[len(self._held) - pushed:]
+
+    def visit_With(self, node: ast.With) -> None:
+        self._enter_with(node, is_async=False)
+
+    def visit_AsyncWith(self, node: ast.AsyncWith) -> None:
+        self._enter_with(node, is_async=True)
+
+    # ------------------------------------------------- awaits and calls
+
+    def visit_Await(self, node: ast.Await) -> None:
+        if (
+            self._held
+            and config.is_lock_scope_module(self.rel_path)
+            and not _allowed_await(node.value)
+        ):
+            self.emit(
+                node, "TPL401",
+                f"holding {self._held[-1]}",
+            )
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = call_bare_name(node.func)
+        if name is not None and self._fn is not None:
+            bare = isinstance(node.func, ast.Name)
+            self._fn.calls.add((name, bare))
+            if self._held:
+                self._fn.calls_under_lock.append(
+                    (self._held[-1], name, bare, node.lineno)
+                )
+        self.generic_visit(node)
+
+
+def _lock_closures(functions: dict, resolve) -> dict:  # noqa: ANN001
+    """Transitive lock closure per function key — fixpoint iteration,
+    so call CYCLES converge to the full set instead of caching a
+    partial expansion (lock sets only grow, so termination is
+    guaranteed)."""
+    closure = {
+        key: {lock for lock, _ in info.acquired}
+        for key, info in functions.items()
+    }
+    callees = {
+        key: [
+            target
+            for name, bare in info.calls
+            for target in resolve(key, name, bare)
+        ]
+        for key, info in functions.items()
+    }
+    changed = True
+    while changed:
+        changed = False
+        for key, targets in callees.items():
+            acc = closure[key]
+            before = len(acc)
+            for target in targets:
+                acc |= closure.get(target, set())
+            if len(acc) != before:
+                changed = True
+    return closure
+
+
+def project_edges(
+    graphs: list[ModuleLockGraph],
+) -> list[tuple[str, str, str, int]]:
+    """Lock-order edges over a WHOLE analyzed file set, resolving calls
+    across modules (imported module-level functions by bare name, class
+    methods by attribute name).  Edge paths are attributed to the
+    calling module."""
+    by_name: dict[str, list[tuple[str, str]]] = {}
+    functions: dict[tuple[str, str], FunctionLockInfo] = {}
+    for g in graphs:
+        for qual, info in g.functions.items():
+            functions[(g.rel_path, qual)] = info
+            by_name.setdefault(
+                qual.rsplit(".", 1)[-1], []
+            ).append((g.rel_path, qual))
+
+    def resolve(caller: tuple[str, str], name: str,
+                bare: bool) -> list[tuple[str, str]]:
+        caller_path, caller_qual = caller
+        out = []
+        for path, qual in by_name.get(name, ()):
+            nested = (
+                path == caller_path
+                and qual.startswith(f"{caller_qual}.")
+            )
+            if bare and ("." not in qual or nested):
+                out.append((path, qual))
+            elif not bare and "." in qual:
+                out.append((path, qual))
+        return out
+
+    closure = _lock_closures(functions, resolve)
+
+    out: list[tuple[str, str, str, int]] = []
+    for key, info in functions.items():
+        path = key[0]
+        for outer, inner, line in info.nested:
+            out.append((outer, inner, path, line))
+        for held, callee, bare, line in info.calls_under_lock:
+            for target in resolve(key, callee, bare):
+                for inner in closure.get(target, ()):
+                    out.append((held, inner, path, line))
+    return out
+
+
+def canonical_cycle(cycle: list[str]) -> tuple[str, ...]:
+    """Rotation-canonical form of a lock cycle (for cross-pass dedup)."""
+    i = cycle.index(min(cycle))
+    return tuple(cycle[i:] + cycle[:i])
+
+
+def find_cycles(
+    edges: list[tuple[str, str, str, int]],
+) -> list[tuple[list[str], str, int]]:
+    """Cycles in the lock-order graph → ``(lock_cycle, path, line)``,
+    one per distinct cycle (canonicalized by rotation), anchored at the
+    smallest contributing edge site."""
+    adj: dict[str, dict[str, tuple[str, int]]] = {}
+    for outer, inner, path, line in edges:
+        slot = adj.setdefault(outer, {})
+        if inner not in slot or (path, line) < slot[inner]:
+            slot[inner] = (path, line)
+
+    seen: set[tuple[str, ...]] = set()
+    out: list[tuple[list[str], str, int]] = []
+
+    canonical = canonical_cycle
+
+    def dfs(start: str, node: str, path: list[str]) -> None:
+        for nxt in sorted(adj.get(node, ())):
+            if nxt == start:
+                cycle = path[:]
+                key = canonical(cycle)
+                if key not in seen:
+                    seen.add(key)
+                    sites = [
+                        adj[cycle[i]][cycle[(i + 1) % len(cycle)]]
+                        for i in range(len(cycle))
+                    ]
+                    anchor = min(sites)
+                    out.append((cycle, anchor[0], anchor[1]))
+            elif nxt not in path and len(path) < 8:
+                dfs(start, nxt, path + [nxt])
+
+    for start in sorted(adj):
+        dfs(start, start, [start])
+    return out
+
+
+# ----------------------------------------------------------------- TPL403
+
+
+def _attr_writes(fn: _FuncNode) -> list[tuple[str, int, frozenset]]:
+    """``self.<attr>`` writes in ``fn``'s own body → (attr, lineno,
+    locks-held) with the with-stack of enclosing lock contexts."""
+    out: list[tuple[str, int, frozenset]] = []
+
+    def walk(stmts, held: frozenset) -> None:  # noqa: ANN001
+        for node in stmts:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            now = held
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    lock = resolve_lock(item.context_expr, None, "")
+                    if lock is not None:
+                        now = now | {lock.rsplit(".", 1)[-1]}
+            targets: list[ast.expr] = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets = [node.target]
+            for t in targets:
+                if (
+                    isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"
+                ):
+                    out.append((t.attr, node.lineno, now))
+            walk(list(ast.iter_child_nodes(node)), now)
+
+    walk(list(fn.body), frozenset())
+    return out
+
+
+def check_shared_writes(tree: ast.Module, rel_path: str, emit) -> None:  # noqa: ANN001
+    """TPL403 over one module's classes."""
+    if not config.is_lock_scope_module(rel_path):
+        return
+
+    # names dispatched to worker threads anywhere in the module
+    thread_roots: set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = call_bare_name(node.func)
+        if name == "to_thread" and node.args:
+            root = call_bare_name(node.args[0])
+            if root:
+                thread_roots.add(root)
+        elif name == "run_in_executor" and len(node.args) >= 2:
+            root = call_bare_name(node.args[1])
+            if root:
+                thread_roots.add(root)
+
+    for cls in [n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)]:
+        methods: dict[str, _FuncNode] = {
+            m.name: m for m in cls.body
+            if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        calls: dict[str, set[str]] = {
+            name: {
+                call_bare_name(c.func)
+                for c in ast.walk(m) if isinstance(c, ast.Call)
+            } - {None}
+            for name, m in methods.items()
+        }
+        # worker-thread context: to_thread roots + same-class closure
+        thread_ctx: set[str] = set()
+        frontier = [n for n in methods if n in thread_roots]
+        while frontier:
+            name = frontier.pop()
+            if name in thread_ctx:
+                continue
+            thread_ctx.add(name)
+            frontier.extend(
+                c for c in calls.get(name, ()) if c in methods
+            )
+
+        coroutine_writes: dict[str, list[tuple[int, frozenset]]] = {}
+        thread_writes: dict[str, list[tuple[int, frozenset]]] = {}
+        for name, m in methods.items():
+            is_async = isinstance(m, ast.AsyncFunctionDef)
+            in_thread = name in thread_ctx and not is_async
+            if not is_async and not in_thread:
+                continue
+            for attr, line, held in _attr_writes(m):
+                side = coroutine_writes if is_async else thread_writes
+                side.setdefault(attr, []).append((line, held))
+
+        for attr in sorted(set(coroutine_writes) & set(thread_writes)):
+            for t_line, t_held in thread_writes[attr]:
+                # a common lock must guard BOTH sides; the thread side
+                # can only hold sync locks, so compare bare attr names
+                guarded = any(
+                    t_held & c_held
+                    for _line, c_held in coroutine_writes[attr]
+                )
+                if not guarded:
+                    emit_line = t_line
+                    emit(
+                        Anchor(emit_line), "TPL403",
+                        f"self.{attr} written in worker-thread context "
+                        f"here and in coroutine context at line "
+                        f"{coroutine_writes[attr][0][0]} "
+                        f"({cls.name})",
+                    )
+                    break  # one finding per attribute per class
+
+
+def analyze_module(
+    tree: ast.Module, rel_path: str, emit
+) -> ModuleLockGraph:  # noqa: ANN001
+    """Run the TPL4xx per-module passes; returns the module's lock graph
+    for the caller's (per-file or project-wide) cycle detection."""
+    visitor = _LockVisitor(rel_path, emit)
+    visitor.visit(tree)
+    check_shared_writes(tree, rel_path, emit)
+    return visitor.graph
+
+
+def emit_cycles(
+    edges: list[tuple[str, str, str, int]], emit_at
+) -> None:  # noqa: ANN001
+    """TPL402 over a merged edge list.  ``emit_at(path, line, code,
+    detail)`` so the CLI can attribute cross-module cycles to the right
+    file."""
+    for cycle, path, line in find_cycles(edges):
+        pretty = " -> ".join([*cycle, cycle[0]])
+        emit_at(path, line, "TPL402", pretty)

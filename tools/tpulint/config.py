@@ -9,6 +9,8 @@ stays a generic AST pass.
 from __future__ import annotations
 
 import re
+from pathlib import Path
+from typing import Optional
 
 #: rule code → one-line description (docs/STATIC_ANALYSIS.md carries the
 #: full rationale per rule; keep the two in sync — test_tpulint checks).
@@ -34,6 +36,33 @@ RULES: dict[str, str] = {
               "to asyncio.to_thread or a sync helper off the loop)",
     "TPL303": "known-blocking engine/device call on the event loop "
               "(dispatch via asyncio.to_thread like the step loop does)",
+    "TPL401": "await of a non-to_thread awaitable while holding an "
+              "engine lock (an arbitrary suspension under a "
+              "step-loop-scoped lock extends the critical section "
+              "unboundedly and invites lock-order deadlocks)",
+    "TPL402": "lock-order cycle: these locks are acquired in "
+              "conflicting orders across the engine (two tasks each "
+              "holding one half deadlock the step loop)",
+    "TPL403": "shared attribute written from both event-loop and "
+              "worker-thread context without a common lock (torn "
+              "accounting: the PR 9/PR 14 transfer-path bug class)",
+    "TPL501": "resource acquired but not released on every exit path: "
+              "put the release in try/finally or a context manager "
+              "(an exception between the pair leaks the pin/charge/"
+              "epoch forever)",
+    "TPL502": "raw asyncio task spawn: the event loop holds only weak "
+              "task refs, so an untracked create_task can be "
+              "garbage-collected mid-flight; spawn through "
+              "utils.spawn_task",
+    "TPL601": "jit entry point absent from (or disagreeing with) "
+              "tools/tpulint/lattice_manifest.json: regenerate with "
+              "python -m tools.tpulint --write-lattice and update "
+              "docs/ATTENTION.md expected-compile counts",
+    "TPL602": "stale compile-lattice manifest entry: no track_jit site "
+              "matches it (regenerate with --write-lattice)",
+    "TPL603": "compile-lattice manifest entry undocumented in "
+              "docs/ATTENTION.md (the expected-compile table must "
+              "name every jit entry point)",
 }
 
 #: modules reachable from the engine step loop (engine/core.py →
@@ -122,17 +151,112 @@ BLOCKING_HELPERS: frozenset[str] = frozenset({
 #: time.sleep spelling for TPL301.
 SLEEP_MODULES: frozenset[str] = frozenset({"time"})
 
+# ---------------------------------------------------------------- TPL4xx
 
-def is_step_loop_module(rel_path: str) -> bool:
-    """Does ``rel_path`` (posix, repo-relative) sit on the step loop?"""
+#: modules whose locks are "engine locks" for the TPL4xx family: the
+#: replica/step-loop locks, the tier transfer lock, the adapter stream
+#: lock, and the supervisor/frontdoor machinery that serializes against
+#: them.  Entries ending in "/" match directories, others path suffixes.
+LOCK_SCOPE_PATHS: tuple[str, ...] = (
+    "engine/",
+    "supervisor/",
+    "frontdoor/",
+)
+
+#: names that identify a with-statement context expression as a lock
+#: (``self._transfer_lock``, ``rep.lock``, module-global ``_lock``,
+#: ``self._sema`` — semaphores serialize exactly like locks here).
+LOCK_NAME = re.compile(r"lock|sema|mutex", re.IGNORECASE)
+
+#: awaitees that are sanctioned under a held lock (TPL401): worker-thread
+#: offloads — the lock exists precisely to serialize these.
+ALLOWED_AWAITS_UNDER_LOCK: frozenset[str] = frozenset({"to_thread"})
+
+# ---------------------------------------------------------------- TPL5xx
+
+#: acquire → release method pairs (TPL501).  The rule fires when BOTH
+#: ends appear in one function and the release is not on every exit path
+#: (not inside a ``finally``); cross-function protocols (pin at
+#: admission, unpin at finish) are lifecycle contracts the runtime
+#: sanitizer checks instead (engine/sanitizer.py).
+RESOURCE_PAIRS: dict[str, str] = {
+    "charge_adapter": "release_adapter",   # arena adapter charges
+    "pin": "unpin",                        # LoRA registry refcounts
+    "allocate": "free",                    # KV page allocator
+    "begin_free_epoch": "flush_free_epoch",  # chained-decode quarantine
+    "begin_dispatch": "end_dispatch",      # compile-tracker in-flight
+    "arm_site": "disarm",                  # failpoints
+    "arm": "disarm",
+    "acquire": "release",                  # bare lock/semaphore protocol
+}
+
+#: modules allowed to call asyncio's raw ``create_task`` (TPL502): the
+#: home of the shared strong-ref spawn helper itself.
+TASK_HELPER_MODULES: tuple[str, ...] = ("utils.py",)
+
+#: the sanctioned spawn wrapper every other module must use.
+TASK_HELPER_NAME = "spawn_task"
+
+# ---------------------------------------------------------------- TPL6xx
+
+#: checked-in compile-lattice manifest: every ``track_jit`` entry point
+#: with its static/partial-bound parameters.  Regenerate after an
+#: intentional jit change with ``python -m tools.tpulint
+#: --write-lattice`` (docs/STATIC_ANALYSIS.md "Compile-lattice
+#: manifest").
+MANIFEST_PATH = Path(__file__).resolve().parent / "lattice_manifest.json"
+
+#: the doc that carries the expected-compile-count table (TPL603).
+ATTENTION_DOC = (
+    Path(__file__).resolve().parents[2] / "docs" / "ATTENTION.md"
+)
+
+
+def load_manifest(path: Optional[Path] = None) -> dict:
+    """The manifest as ``{(module, name): entry_dict}`` (empty when the
+    file is absent — the --write-lattice bootstrap case)."""
+    import json
+
+    p = path or MANIFEST_PATH
+    if not p.exists():
+        return {}
+    data = json.loads(p.read_text(encoding="utf-8"))
+    return {
+        (e["module"], e["name"]): e for e in data.get("entries", [])
+    }
+
+
+def _path_in(rel_path: str, entries: tuple[str, ...]) -> bool:
     rel = rel_path.replace("\\", "/")
-    for entry in STEP_LOOP_PATHS:
+    for entry in entries:
         if entry.endswith("/"):
             if rel.startswith(entry) or f"/{entry}" in rel:
                 return True
         elif rel.endswith(entry):
             return True
     return False
+
+
+def is_step_loop_module(rel_path: str) -> bool:
+    """Does ``rel_path`` (posix, repo-relative) sit on the step loop?"""
+    return _path_in(rel_path, STEP_LOOP_PATHS)
+
+
+def is_lock_scope_module(rel_path: str) -> bool:
+    """Is ``rel_path`` in the TPL4xx lock-discipline scope?"""
+    return _path_in(rel_path, LOCK_SCOPE_PATHS)
+
+
+def is_task_helper_module(rel_path: str) -> bool:
+    """Is ``rel_path`` the sanctioned raw-create_task module (TPL502)?
+
+    Exact path-component match — ``engine/io_utils.py`` must NOT
+    inherit ``utils.py``'s exemption via a bare suffix test."""
+    rel = rel_path.replace("\\", "/")
+    return any(
+        rel == entry or rel.endswith(f"/{entry}")
+        for entry in TASK_HELPER_MODULES
+    )
 
 
 def registry_qualnames(rel_path: str) -> frozenset[str]:

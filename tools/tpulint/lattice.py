@@ -1,0 +1,294 @@
+"""TPL6xx: the compile-lattice manifest.
+
+Every jitted entry point in this codebase goes through
+``compile_tracker.track_jit(name, jax.jit(fn, ...))`` — that is the
+complete compile lattice (docs/ATTENTION.md carries the expected compile
+counts per entry).  This pass statically enumerates those sites with
+their compile-shape-relevant parameters (``static_argnums`` /
+``static_argnames`` / ``functools.partial``-bound arguments / donation)
+and diffs them against the checked-in
+``tools/tpulint/lattice_manifest.json``:
+
+* **TPL601** (per-file) — a ``track_jit`` site absent from, or
+  disagreeing with, its manifest entry.  Adding a jit entry point or a
+  new static argument without updating the manifest (and the
+  docs/ATTENTION.md counts) is a lint failure, not a silent lattice
+  growth discovered as a 20-40 s serving stall.
+* **TPL602** (project-wide) — a manifest entry with no matching site in
+  the analyzed module (stale after a deletion/rename).
+* **TPL603** (project-wide) — a manifest entry name missing from
+  docs/ATTENTION.md.
+
+Entry names built with f-strings (the pipeline's ``f"pp{s}_prefill"``)
+are normalized to ``fnmatch`` patterns (``pp*_prefill``); the live-boot
+test matches the compile tracker's observed entry names against the
+same patterns.  Regenerate after an intentional change with
+``python -m tools.tpulint --write-lattice``.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+from pathlib import Path
+from typing import Optional
+
+from tools.tpulint import config
+from tools.tpulint.astutil import Anchor, call_bare_name
+
+
+def _name_pattern(node: ast.expr) -> Optional[str]:
+    """track_jit's name argument as a literal or fnmatch pattern."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        parts = []
+        for piece in node.values:
+            if isinstance(piece, ast.Constant):
+                parts.append(str(piece.value))
+            else:
+                parts.append("*")
+        return "".join(parts)
+    return None
+
+
+def _const_ints(node: Optional[ast.expr]) -> list[int]:
+    if node is None:
+        return []
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return sorted(
+            e.value for e in node.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, int)
+        )
+    return []
+
+
+def _const_strs(node: Optional[ast.expr]) -> list[str]:
+    if node is None:
+        return []
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return sorted(
+            e.value for e in node.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, str)
+        )
+    return []
+
+
+def _is_partial(func: ast.expr) -> bool:
+    if isinstance(func, ast.Attribute):
+        return func.attr == "partial"
+    return isinstance(func, ast.Name) and func.id == "partial"
+
+
+def _is_jit(func: ast.expr) -> bool:
+    if isinstance(func, ast.Attribute):
+        return func.attr == "jit"
+    return isinstance(func, ast.Name) and func.id == "jit"
+
+
+def describe_site(call: ast.Call, module: str) -> Optional[dict]:
+    """One ``track_jit(name, jax.jit(...), ...)`` call → manifest entry
+    dict, or None when the call is not a recognizable track_jit site."""
+    if call_bare_name(call.func) != "track_jit" or len(call.args) < 2:
+        return None
+    name = _name_pattern(call.args[0])
+    if name is None:
+        return None
+    entry = {
+        "module": module,
+        "name": name,
+        "static_argnums": [],
+        "static_argnames": [],
+        "partial_kwargs": [],
+        "partial_pos": 0,
+        "donate": False,
+        "line": call.lineno,
+    }
+    jit_call = call.args[1]
+    if isinstance(jit_call, ast.Call) and _is_jit(jit_call.func):
+        for kw in jit_call.keywords:
+            if kw.arg == "static_argnums":
+                entry["static_argnums"] = _const_ints(kw.value)
+            elif kw.arg == "static_argnames":
+                entry["static_argnames"] = _const_strs(kw.value)
+            elif kw.arg == "donate_argnums":
+                entry["donate"] = True
+        target = jit_call.args[0] if jit_call.args else None
+        if isinstance(target, ast.Call) and _is_partial(target.func):
+            entry["partial_kwargs"] = sorted(
+                kw.arg for kw in target.keywords if kw.arg is not None
+            )
+            entry["partial_pos"] = max(0, len(target.args) - 1)
+    return entry
+
+
+def iter_sites(tree: ast.Module, module: str) -> list[dict]:
+    """All track_jit manifest entries in one module, source order."""
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            entry = describe_site(node, module)
+            if entry is not None:
+                out.append(entry)
+    return out
+
+
+_COMPARE_KEYS = (
+    "static_argnums", "static_argnames", "partial_kwargs",
+    "partial_pos", "donate",
+)
+
+#: per-key defaults for manifest entries missing a field (hand-edited
+#: or older manifests) — matching describe_site's own defaults
+_COMPARE_DEFAULTS: dict = {
+    "static_argnums": [], "static_argnames": [], "partial_kwargs": [],
+    "partial_pos": 0, "donate": False,
+}
+
+
+def _module_key(rel_path: str, manifest: dict) -> Optional[str]:
+    """The manifest module suffix matching ``rel_path``, if any."""
+    rel = rel_path.replace("\\", "/")
+    for module, _name in manifest:
+        if rel.endswith(module):
+            return module
+    return None
+
+
+def check_module(
+    tree: ast.Module, rel_path: str, emit,
+    manifest: Optional[dict] = None,
+) -> list[dict]:  # noqa: ANN001
+    """TPL601 for one module; returns the module's sites for the
+    project-wide passes."""
+    if manifest is None:
+        manifest = config.load_manifest()
+    sites = iter_sites(tree, rel_path.replace("\\", "/"))
+    if not sites:
+        return sites
+    module = _module_key(rel_path, manifest)
+    for site in sites:
+        entry = manifest.get((module, site["name"])) if module else None
+        anchor = Anchor(site["line"])
+        if entry is None:
+            emit(
+                anchor, "TPL601",
+                f"track_jit({site['name']!r}, ...) has no manifest "
+                f"entry",
+            )
+            continue
+        diffs = [
+            f"{key}: code={site[key]!r} manifest={entry.get(key)!r}"
+            for key in _COMPARE_KEYS
+            if site[key] != entry.get(key, _COMPARE_DEFAULTS[key])
+        ]
+        if diffs:
+            emit(
+                anchor, "TPL601",
+                f"track_jit({site['name']!r}, ...) disagrees with its "
+                f"manifest entry ({'; '.join(diffs)})",
+            )
+    return sites
+
+
+def check_project(
+    sites_by_path: dict[str, list[dict]], emit_at,
+    manifest: Optional[dict] = None,
+    attention_doc: Optional[Path] = None,
+) -> None:  # noqa: ANN001
+    """TPL602 + TPL603 over a whole analyzed file set.
+
+    ``emit_at(path, line, code, detail)``.  Stale-entry detection only
+    considers manifest modules that MATCH one of the analyzed files —
+    linting a single file must not declare the rest of the manifest
+    stale.
+    """
+    if manifest is None:
+        manifest = config.load_manifest()
+    if not manifest:
+        return
+    doc_path = attention_doc or config.ATTENTION_DOC
+    doc_text = doc_path.read_text(encoding="utf-8") if doc_path.exists() \
+        else None
+
+    found: set[tuple[str, str]] = set()
+    analyzed_modules: set[str] = set()
+    for rel_path, sites in sites_by_path.items():
+        rel = rel_path.replace("\\", "/")
+        for module, _name in manifest:
+            if rel.endswith(module):
+                analyzed_modules.add(module)
+                found.update(
+                    (module, site["name"]) for site in sites
+                )
+    for (module, name), _entry in sorted(manifest.items()):
+        if module in analyzed_modules and (module, name) not in found:
+            emit_at(
+                str(config.MANIFEST_PATH), 1, "TPL602",
+                f"{module}:{name} (no track_jit site matches)",
+            )
+        if doc_text is not None and name not in doc_text:
+            emit_at(
+                str(doc_path), 1, "TPL603",
+                f"{module}:{name} missing from {doc_path.name}",
+            )
+
+
+def build_manifest(paths: list[Path], root: Optional[Path] = None) -> dict:
+    """Scan ``paths`` (files or directories) and build the manifest
+    document for --write-lattice."""
+    files: list[Path] = []
+    for p in paths:
+        if p.is_dir():
+            files.extend(sorted(p.rglob("*.py")))
+        else:
+            files.append(p)
+    entries = []
+    for path in files:
+        # manifest modules are PACKAGE-relative suffixes ("engine/
+        # runner.py") so fixture trees in tests resolve against the
+        # same entries — derived from the resolved path's components,
+        # not a literal prefix, so `--write-lattice` produces the same
+        # manifest from any cwd / absolute-path spelling
+        parts = path.resolve().parts
+        if "vllm_tgis_adapter_tpu" in parts:
+            idx = len(parts) - 1 - parts[::-1].index(
+                "vllm_tgis_adapter_tpu"
+            )
+            module = "/".join(parts[idx + 1:])
+        elif root is not None:
+            module = path.resolve().relative_to(
+                Path(root).resolve()
+            ).as_posix()
+        else:
+            module = path.as_posix()
+        tree = ast.parse(path.read_text(encoding="utf-8"),
+                         filename=str(path))
+        for site in iter_sites(tree, module):
+            site.pop("line", None)
+            entries.append(site)
+    entries.sort(key=lambda e: (e["module"], e["name"]))
+    return {
+        "_comment": (
+            "Compile-lattice manifest: every track_jit jit entry point "
+            "with its static/partial-bound parameters.  tpulint TPL6xx "
+            "diffs code against this file; regenerate after an "
+            "INTENTIONAL jit change with `python -m tools.tpulint "
+            "--write-lattice` and update docs/ATTENTION.md."
+        ),
+        "entries": entries,
+    }
+
+
+def write_manifest(paths: list[Path], out: Optional[Path] = None,
+                   root: Optional[Path] = None) -> Path:
+    target = out or config.MANIFEST_PATH
+    doc = build_manifest(paths, root=root)
+    target.write_text(
+        json.dumps(doc, indent=2) + "\n", encoding="utf-8"
+    )
+    return target

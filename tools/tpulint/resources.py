@@ -1,0 +1,116 @@
+"""TPL5xx: resource acquire/release pairing.
+
+* **TPL501** — a function that both acquires and releases one of the
+  known resource pairs (``config.RESOURCE_PAIRS``: arena charges, LoRA
+  pins, allocator pages, free epochs, failpoint arms, bare lock
+  protocol) must put the release on EVERY exit path: a matching release
+  that only runs on the fall-through path leaks the resource the moment
+  anything between the pair raises — the PR 5 exception-traceback
+  KV-pool pin, the ISSUE 9 GC'd-ticket park.  The fix is ``try/finally``
+  or a context manager.  Cross-function protocols (pin at admission /
+  unpin at finish) are lifecycle contracts checked at runtime by
+  ``engine/sanitizer.py`` instead.
+* **TPL502** — every ``asyncio.create_task`` (or ``loop.create_task`` /
+  ``ensure_future``) call outside ``utils.py`` (the home of the shared
+  strong-ref helper).  The event loop holds only weak task references,
+  so a task not retained in a strong-ref container can be
+  garbage-collected mid-flight — the PR 9 GC'd-promotion-task bug.
+  ``utils.spawn_task`` retains every task it spawns until done.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional, Union
+
+from tools.tpulint import config
+from tools.tpulint.astutil import call_bare_name
+
+_FuncNode = Union[ast.FunctionDef, ast.AsyncFunctionDef]
+
+_RAW_SPAWNS = frozenset({"create_task", "ensure_future"})
+
+
+def _own_body_calls(fn: _FuncNode) -> list[tuple[str, ast.Call, bool]]:
+    """(bare_name, call, in_finally) for calls in ``fn``'s own body —
+    nested function/class definitions are skipped (they run in another
+    context), and ``in_finally`` is tracked through arbitrarily nested
+    compound statements."""
+    out: list[tuple[str, ast.Call, bool]] = []
+    skip = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef,
+            ast.Lambda)
+
+    def visit(node: ast.AST, in_finally: bool) -> None:
+        if isinstance(node, skip):
+            return
+        if isinstance(node, ast.Call):
+            name = call_bare_name(node.func)
+            if name is not None:
+                out.append((name, node, in_finally))
+        if isinstance(node, ast.Try):
+            for s in (*node.body, *node.orelse):
+                visit(s, in_finally)
+            for handler in node.handlers:
+                for s in handler.body:
+                    visit(s, in_finally)
+            for s in node.finalbody:
+                visit(s, True)
+            return
+        for child in ast.iter_child_nodes(node):
+            visit(child, in_finally)
+
+    for stmt in fn.body:
+        visit(stmt, False)
+    return out
+
+
+def check_pairing(tree: ast.Module, rel_path: str, emit) -> None:  # noqa: ANN001
+    """TPL501 over every function of the module."""
+    for fn in [
+        n for n in ast.walk(tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]:
+        calls = _own_body_calls(fn)
+        names = {name for name, _, _ in calls}
+        for acquire, release in config.RESOURCE_PAIRS.items():
+            if acquire == release:
+                continue
+            if acquire not in names or release not in names:
+                continue  # cross-function protocol: not this rule's job
+            # every acquire needs its own finally-guarded release: one
+            # correctly guarded pair must not whitelist a second,
+            # unguarded pair of the same names in the same function
+            acquires = sum(1 for name, _, _ in calls if name == acquire)
+            guarded = sum(
+                1 for name, _, in_finally in calls
+                if name == release and in_finally
+            )
+            if guarded >= acquires:
+                continue
+            site = next(
+                call for name, call, _ in calls if name == acquire
+            )
+            emit(
+                site, "TPL501",
+                f"{acquire}()/{release}() in {fn.name!r} without a "
+                f"finally-guarded release for every acquire "
+                f"({acquires} acquire(s), {guarded} finally-guarded "
+                f"release(s))",
+            )
+
+
+def check_task_spawns(tree: ast.Module, rel_path: str, emit) -> None:  # noqa: ANN001
+    """TPL502 over every call of the module."""
+    if config.is_task_helper_module(rel_path):
+        return
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = call_bare_name(node.func)
+        if name in _RAW_SPAWNS:
+            emit(
+                node, "TPL502",
+                f"{name}(...) — use "
+                f"{config.TASK_HELPER_NAME}(coro, name=..., "
+                f"retain=...) instead",
+            )

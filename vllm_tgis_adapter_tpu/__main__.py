@@ -25,6 +25,7 @@ from vllm_tgis_adapter_tpu.tgis_utils.args import (
 )
 from vllm_tgis_adapter_tpu.utils import (
     check_for_failed_tasks,
+    spawn_task,
     write_termination_log,
 )
 
@@ -136,28 +137,28 @@ async def start_servers(args: "argparse.Namespace") -> None:
         http_app = build_http_server(args, engine)
 
         tasks = [
-            loop.create_task(
+            spawn_task(
                 run_http_server(args, engine, http_app, sock),
-                name="http_server",
+                name="http_server", loop=loop,
             ),
-            loop.create_task(
+            spawn_task(
                 run_grpc_server(args, engine),
-                name="grpc_server",
+                name="grpc_server", loop=loop,
             ),
         ]
 
         with_task_names = ", ".join(t.get_name() for t in tasks)
         logger.info("Started tasks: %s", with_task_names)
 
-        drain_waiter = loop.create_task(
-            drain.shutdown_event.wait(), name="drain_shutdown"
+        drain_waiter = spawn_task(
+            drain.shutdown_event.wait(), name="drain_shutdown", loop=loop,
         )
         # terminal engine death (unsupervised, or the supervisor's
         # crash-loop circuit breaker) wakes this wait directly — the
         # process must exit promptly, not at the next RPC.  Supervised
         # restarts never set this: the engine recovers in place.
-        dead_waiter = loop.create_task(
-            engine.dead_event.wait(), name="engine_dead"
+        dead_waiter = spawn_task(
+            engine.dead_event.wait(), name="engine_dead", loop=loop,
         )
         done, _pending = await asyncio.wait(
             [*tasks, drain_waiter, dead_waiter],
@@ -236,7 +237,7 @@ def main() -> None:
 
     loop = asyncio.new_event_loop()
     try:
-        task = loop.create_task(start_servers(args))
+        task = spawn_task(start_servers(args), name="start_servers", loop=loop)
         run_and_catch_termination_cause(loop, task)
     finally:
         loop.close()

@@ -39,7 +39,7 @@ from __future__ import annotations
 
 import asyncio
 import time
-from typing import Callable, Optional
+from typing import TYPE_CHECKING, Callable, Optional
 
 import jax
 import numpy as np
@@ -52,6 +52,11 @@ from vllm_tgis_adapter_tpu.engine.lora import (
     build_adapter_blocks,
 )
 from vllm_tgis_adapter_tpu.logging import init_logger
+from vllm_tgis_adapter_tpu.utils import spawn_task
+
+if TYPE_CHECKING:  # pragma: no cover - typing only
+    from vllm_tgis_adapter_tpu.engine.arena import UnifiedArena
+    from vllm_tgis_adapter_tpu.engine.lora import LoRAManager
 
 logger = init_logger(__name__)
 
@@ -100,13 +105,13 @@ class AdapterPool:
         # unified paged arena (engine/arena.py, set by the engine core):
         # device residency charges true-rank pages against the shared
         # KV+adapter block budget; None = pre-arena fixed-slot behavior
-        self.arena = None
+        self.arena: Optional["UnifiedArena"] = None
         # host→device block builds allowed in flight at once; the final
         # slot scatter is serialized by _stream_lock regardless
         self.prefetch_concurrency = max(1, prefetch_concurrency)
         # the registry feeding this pool; set by the owning engine and
         # re-pointed by adopt_lora_manager on dp sharing / rebuild
-        self.manager = None
+        self.manager: Optional["LoRAManager"] = None
         # runner hook: called with the new stacks object after every
         # committed slot update (runner.lora_stacks stays current)
         self.on_commit: Optional[Callable] = None
@@ -129,7 +134,8 @@ class AdapterPool:
         self.swaps_in = 0
         self.swaps_out = 0
         self.resident_high_water = 0
-        self.stacks = self._zero_stacks()
+        # None only after release() (supervisor rebuild teardown)
+        self.stacks: Optional[LoRAStacks] = self._zero_stacks()
         self._update_fn = track_jit(
             "lora_slot_update",
             jax.jit(_update_slot),
@@ -350,9 +356,9 @@ class AdapterPool:
                     self._uncharge(lora_name)
                 return False
             return True
-        self._streaming[lora_name] = loop.create_task(
+        self._streaming[lora_name] = spawn_task(
             self._stream(lora_name, weights, slot),
-            name=f"lora-stream-{lora_name}",
+            name=f"lora-stream-{lora_name}", loop=loop,
         )
         return False
 

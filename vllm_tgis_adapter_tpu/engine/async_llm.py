@@ -52,7 +52,7 @@ from vllm_tgis_adapter_tpu.supervisor.lifecycle import (
     LIFECYCLE_RECOVERING,
     LIFECYCLE_SERVING,
 )
-from vllm_tgis_adapter_tpu.utils import write_termination_log
+from vllm_tgis_adapter_tpu.utils import spawn_task, write_termination_log
 
 logger = init_logger(__name__)
 
@@ -565,7 +565,7 @@ class AsyncLLMEngine:
     async def start(self) -> None:
         for rep in self._replicas:
             if rep.task is None:
-                rep.task = asyncio.create_task(
+                rep.task = spawn_task(
                     self._run_loop(rep),
                     name=f"engine-step-loop-{rep.index}",
                 )
@@ -573,7 +573,7 @@ class AsyncLLMEngine:
             # always runs: it also feeds the /metrics engine-state gauges
             # (KV usage, queue depth); --disable-log-stats gates only the
             # periodic log LINE inside the loop
-            self._stats_task = asyncio.create_task(
+            self._stats_task = spawn_task(
                 self._log_stats_loop(), name="engine-stats-loop"
             )
         if self.watchdog is not None:
@@ -800,8 +800,12 @@ class AsyncLLMEngine:
                 if request_id in self._early_aborts:
                     # abort() ran before the engine knew the request; it
                     # left a tombstone instead — honor it now, before a
-                    # single step is scheduled
+                    # single step is scheduled.  (tpulint's call graph
+                    # aliases core's `scheduler.abort` to THIS class's
+                    # lock-taking `abort` by bare name; abort_request
+                    # takes no lock — see the suppression below.)
                     self._early_aborts.discard(request_id)
+                    # tpulint: disable=TPL402(bare-name aliasing: abort_request -> scheduler.abort resolves to AsyncLLMEngine.abort; the scheduler method takes no lock)
                     aborted_out = rep.engine.abort_request(request_id)
         except BaseException as e:
             # BaseException, not Exception: a client disconnect lands
@@ -1458,7 +1462,7 @@ class AsyncLLMEngine:
     def _arm_replica(self, rep: _Replica) -> None:
         """(Re)start one replica's step loop (supervisor re-arm)."""
         rep.last_beat = time.monotonic()
-        rep.task = asyncio.get_running_loop().create_task(
+        rep.task = spawn_task(
             self._run_loop(rep), name=f"engine-step-loop-{rep.index}"
         )
         rep.new_work.set()

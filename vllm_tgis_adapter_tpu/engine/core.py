@@ -21,6 +21,7 @@ from vllm_tgis_adapter_tpu.engine.runner import (
     PromptLogprobInfo,
     SampledToken,
 )
+from vllm_tgis_adapter_tpu.engine import sanitizer
 from vllm_tgis_adapter_tpu.engine.sampling_params import (
     RequestOutputKind,
     SamplingParams,
@@ -1504,12 +1505,20 @@ class LLMEngine:
                 continue
             c_plan, c_prep = chained
             self.begin_free_epoch()
-            c_handle = self.dispatch_chained_step(c_plan, c_prep, handle)
-            self.commit_step(
-                plan, self.wait_step(plan, prepared, handle), prepared
-            )
-            c_result = self.wait_step(c_plan, c_prep, c_handle)
-            self.flush_free_epoch()  # chained wave retired
+            try:
+                c_handle = self.dispatch_chained_step(
+                    c_plan, c_prep, handle
+                )
+                self.commit_step(
+                    plan, self.wait_step(plan, prepared, handle), prepared
+                )
+                c_result = self.wait_step(c_plan, c_prep, c_handle)
+            finally:
+                # chained wave retired — or died with the warmup: a
+                # supervised re-warm failure is retried, and an epoch
+                # left open here would quarantine every later free on
+                # the retrying engine (tpulint TPL501)
+                self.flush_free_epoch()
             self.commit_step(c_plan, c_result, c_prep)
             chained_done = True
 
@@ -1824,6 +1833,11 @@ class LLMEngine:
         outputs = self._commit_inner(plan, result, prepared)
         if self.replica_role == "prefill":
             self._stage_handoffs(plan)
+        # step-boundary invariant sanitizer (TGIS_TPU_SANITIZE=1, zero
+        # cost off): every commit leaves the allocator/arena/tier/pool
+        # accounting closed, or we fail HERE rather than serving from
+        # corrupt state (engine/sanitizer.py, docs/STATIC_ANALYSIS.md)
+        sanitizer.maybe_check(self)
         return outputs
 
     def _commit_inner(self, plan, result, prepared=None) -> list[RequestOutput]:

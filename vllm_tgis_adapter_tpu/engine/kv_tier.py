@@ -61,6 +61,7 @@ from typing import Callable, Optional
 import numpy as np
 
 from vllm_tgis_adapter_tpu.logging import init_logger
+from vllm_tgis_adapter_tpu.utils import spawn_task
 
 logger = init_logger(__name__)
 
@@ -737,14 +738,10 @@ class HostKVTier:
             self._insert(self._to_host(batch))
             return
         self._inflight_bytes += batch_bytes
-        self._retain(loop.create_task(
+        spawn_task(
             self._demote_async(batch, batch_bytes),
-            name="kv-tier-demote",
-        ))
-
-    def _retain(self, task) -> None:  # noqa: ANN001 — asyncio.Task
-        self._tasks.add(task)
-        task.add_done_callback(self._tasks.discard)
+            name="kv-tier-demote", retain=self._tasks, loop=loop,
+        )
 
     async def _demote_async(self, batch: list, batch_bytes: int) -> None:
         try:
@@ -819,9 +816,10 @@ class HostKVTier:
         if loop is None:
             self.disk.store_batch(spill)
             return
-        self._retain(loop.create_task(
+        spawn_task(
             self._spill_async(spill), name="kv-tier-spill-disk",
-        ))
+            retain=self._tasks, loop=loop,
+        )
 
     async def _spill_async(self, spill: list) -> None:
         try:
@@ -847,10 +845,11 @@ class HostKVTier:
                 self._insert(recovered, recovered=True)
             self._finish_assembly(ticket, staged)
             return
-        self._retain(loop.create_task(
+        spawn_task(
             self._assemble(ticket, put_fn),
             name=f"kv-tier-promote-{ticket.request_id}",
-        ))
+            retain=self._tasks, loop=loop,
+        )
 
     def _collect(self, ticket: PromotionTicket) -> list:
         """Longest still-valid prefix of the ticket's entries — host

@@ -19,6 +19,7 @@ from typing import Optional
 import numpy as np
 
 from vllm_tgis_adapter_tpu.logging import init_logger
+from vllm_tgis_adapter_tpu.utils import spawn_task
 
 logger = init_logger(__name__)
 
@@ -319,7 +320,9 @@ class LoRAManager:
             for engine in list(self._resync_cbs):
                 engine.runner.sync_lora(self)
         else:
-            loop.create_task(self._resync_engines())
+            spawn_task(
+                self._resync_engines(), name="lora-resync", loop=loop
+            )
 
     def _evict_host(self, name: str) -> None:
         """Drop one (unpinned) host registry entry and invalidate any
@@ -354,17 +357,15 @@ class LoRAManager:
             self.disk_tier.store_adapter(name, weights, path)
             return
         self._spilling.add(name)
-        task = loop.create_task(asyncio.to_thread(
-            self.disk_tier.store_adapter, name, weights, path
-        ))
-        # strong ref: the loop holds only weak task references
-        self._disk_tasks.add(task)
-
-        def _done(t, name=name):  # noqa: ANN001
-            self._disk_tasks.discard(t)
-            self._spilling.discard(name)
-
-        task.add_done_callback(_done)
+        task = spawn_task(
+            asyncio.to_thread(
+                self.disk_tier.store_adapter, name, weights, path
+            ),
+            name=f"lora-spill-{name}", retain=self._disk_tasks, loop=loop,
+        )
+        task.add_done_callback(
+            lambda _t, name=name: self._spilling.discard(name)
+        )
 
     def request_disk_restore(self, name: str) -> bool:
         """Begin (or observe) restoring a disk-spilled adapter back
@@ -391,9 +392,10 @@ class LoRAManager:
             self._finish_restore(name, self.disk_tier.load_adapter(name))
             return True
         self._restoring.add(name)
-        task = loop.create_task(self._restore_async(name))
-        self._disk_tasks.add(task)
-        task.add_done_callback(self._disk_tasks.discard)
+        spawn_task(
+            self._restore_async(name), name=f"lora-restore-{name}",
+            retain=self._disk_tasks, loop=loop,
+        )
         return True
 
     async def _restore_async(self, name: str) -> None:

@@ -54,6 +54,7 @@ from vllm_tgis_adapter_tpu.frontdoor.fairness import (
     WeightedFairQueue,
 )
 from vllm_tgis_adapter_tpu.logging import init_logger
+from vllm_tgis_adapter_tpu.utils import spawn_task
 
 if TYPE_CHECKING:
     from vllm_tgis_adapter_tpu.engine.config import FrontdoorConfig
@@ -277,9 +278,7 @@ class FrontDoor:
     def _ensure_pump(self) -> None:
         if self._pump_task is None or self._pump_task.done():
             self._stop = False  # an engine restarted after stop() pumps again
-            self._pump_task = asyncio.get_running_loop().create_task(
-                self._pump(), name="frontdoor-pump"
-            )
+            self._pump_task = spawn_task(self._pump(), name="frontdoor-pump")
 
     async def _pump(self) -> None:
         """Release parked entries to the engine in WFQ order whenever

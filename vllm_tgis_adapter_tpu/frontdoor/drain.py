@@ -28,7 +28,7 @@ import time
 from typing import TYPE_CHECKING, Optional
 
 from vllm_tgis_adapter_tpu.logging import init_logger
-from vllm_tgis_adapter_tpu.utils import write_termination_log
+from vllm_tgis_adapter_tpu.utils import spawn_task, write_termination_log
 
 if TYPE_CHECKING:
     from vllm_tgis_adapter_tpu.engine.async_llm import AsyncLLMEngine
@@ -118,8 +118,9 @@ class DrainCoordinator:
         # stop admission SYNCHRONOUSLY: from the moment the signal
         # handler returns, no new request can slip past the front door
         self._parked_shed = frontdoor.begin_drain()
-        self._task = asyncio.get_event_loop().create_task(
-            self._drain(), name="frontdoor-drain"
+        self._task = spawn_task(
+            self._drain(), name="frontdoor-drain",
+            loop=asyncio.get_event_loop(),
         )
 
     # ----------------------------------------------------------------- drain

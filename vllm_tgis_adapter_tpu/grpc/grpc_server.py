@@ -47,7 +47,7 @@ from vllm_tgis_adapter_tpu.grpc.pb.health_pb2 import HealthCheckResponse
 from vllm_tgis_adapter_tpu.grpc.validation import validate_input, validate_params
 from vllm_tgis_adapter_tpu.logging import init_logger
 from vllm_tgis_adapter_tpu.tgis_utils import logs
-from vllm_tgis_adapter_tpu.utils import merge_async_iterators
+from vllm_tgis_adapter_tpu.utils import merge_async_iterators, spawn_task
 
 if TYPE_CHECKING:
     import argparse
@@ -365,7 +365,7 @@ class TextGenerationService(rpc.GenerationServiceServicer):
                 await self.engine.abort(f"{setup.request_id}-{j}")
 
         timer = (
-            asyncio.create_task(_expire())
+            spawn_task(_expire(), name=f"deadline-{setup.request_id}")
             if setup.deadline is not None
             else None
         )

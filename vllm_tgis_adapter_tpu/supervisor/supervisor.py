@@ -60,7 +60,7 @@ from vllm_tgis_adapter_tpu.supervisor.lifecycle import (
     LIFECYCLE_RECOVERING,
     LIFECYCLE_SERVING,
 )
-from vllm_tgis_adapter_tpu.utils import write_termination_log
+from vllm_tgis_adapter_tpu.utils import spawn_task, write_termination_log
 
 if TYPE_CHECKING:
     from vllm_tgis_adapter_tpu.engine.async_llm import AsyncLLMEngine, _Replica
@@ -221,7 +221,7 @@ class EngineSupervisor:
             if frontdoor is not None:
                 frontdoor.pause()
         if self._task is None or self._task.done():
-            self._task = asyncio.get_running_loop().create_task(
+            self._task = spawn_task(
                 self._recover_all(), name="engine-supervisor"
             )
 

@@ -15,6 +15,38 @@ if TYPE_CHECKING:
     from collections.abc import Iterable, Sequence
 
 
+#: Module-global strong-reference task set for :func:`spawn_task`.
+#: The event loop holds only WEAK references to tasks (bpo-44665), so a
+#: task whose handle is dropped can be garbage-collected mid-flight —
+#: the PR 9 GC'd-promotion-task bug class: a lost transfer task parks
+#: its request forever with no error anywhere.  Every task spawned
+#: through spawn_task stays referenced here (or in the caller-provided
+#: container) until it completes.
+_BACKGROUND_TASKS: set = set()
+
+
+def spawn_task(coro, *, name: Optional[str] = None, retain=None, loop=None):  # noqa: ANN001, ANN201
+    """Create an asyncio task holding a STRONG reference until it is done.
+
+    The one sanctioned ``create_task`` wrapper in this codebase (tpulint
+    TPL502 enforces it): the returned task is retained in ``retain`` (any
+    container with ``add``/``discard``; defaults to the module-global
+    set) and discarded by a done callback, so it can never be
+    garbage-collected mid-flight.  Callers that need the handle (cancel,
+    await, staleness checks) keep the return value exactly as with
+    ``create_task``.
+
+    ``loop`` runs the task on an explicit (possibly not-yet-running)
+    event loop — ``__main__``'s boot path; default is the running loop.
+    """
+    target = loop if loop is not None else asyncio.get_running_loop()
+    task = target.create_task(coro, name=name)
+    bucket = _BACKGROUND_TASKS if retain is None else retain
+    bucket.add(task)
+    task.add_done_callback(bucket.discard)
+    return task
+
+
 def check_for_failed_tasks(tasks: Iterable[asyncio.Task]) -> Optional[asyncio.Task]:
     """Return the first task that finished with an exception, if any."""
     for task in tasks:
@@ -84,7 +116,7 @@ async def merge_async_iterators(*iterators):  # noqa: ANN001, ANN201
             queue.put_nowait(done_sentinel)
 
     tasks = [
-        asyncio.create_task(produce(i, iterator))
+        spawn_task(produce(i, iterator), name=f"merge-stream-{i}")
         for i, iterator in enumerate(iterators)
     ]
     try:

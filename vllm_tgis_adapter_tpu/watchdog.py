@@ -35,7 +35,7 @@ from typing import Callable, Optional
 
 from vllm_tgis_adapter_tpu import compile_tracker, metrics
 from vllm_tgis_adapter_tpu.logging import init_logger
-from vllm_tgis_adapter_tpu.utils import write_termination_log
+from vllm_tgis_adapter_tpu.utils import spawn_task, write_termination_log
 
 logger = init_logger(__name__)
 
@@ -116,9 +116,7 @@ class StallWatchdog:
     def start(self) -> None:
         if self._task is None:
             self.beat()  # boot counts as a beat: deadline starts now
-            self._task = asyncio.get_running_loop().create_task(
-                self.run(), name="stall-watchdog"
-            )
+            self._task = spawn_task(self.run(), name="stall-watchdog")
 
     async def stop(self) -> None:
         if self._task is not None:
