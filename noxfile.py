@@ -12,7 +12,7 @@ import nox
 
 nox.options.sessions = (
     "lint", "tpulint", "typecheck", "tests", "overload_check", "chaos_check",
-    "chaos_soak", "perf_check",
+    "chaos_soak", "perf_check", "slo_check",
 )
 nox.options.reuse_existing_virtualenvs = True
 
@@ -139,6 +139,26 @@ def perf_check(session: nox.Session) -> None:
     session.install("-e", ".[tests]")
     session.run(
         "python", "tools/perf_check.py",
+        *session.posargs,
+        env={"JAX_PLATFORMS": "cpu"},
+    )
+
+
+@nox.session(python="3.12")
+def slo_check(session: nox.Session) -> None:
+    """SLO attainment gate (docs/OBSERVABILITY.md): replay the
+    checked-in reference bursty trace (tools/traces/) against a real
+    engine and assert the default chat TTFT/ITL objectives are met —
+    live slo_burn_rate{class=chat} < 1.0 — and that the cost ledger
+    conserves tokens (Σ per-tenant totals == tokens streamed); then
+    flood a deliberately tiny engine with a flash-crowd arrival
+    process under a tight declared objective and assert the burn-rate
+    gauge exceeds 1.0 (the alert actually fires).  Deterministic,
+    bounded < 60 s on the CPU proxy; `--write-reference` regenerates
+    the trace byte-identically."""
+    session.install("-e", ".[tests]")
+    session.run(
+        "python", "tools/trace_replay.py", "--check",
         *session.posargs,
         env={"JAX_PLATFORMS": "cpu"},
     )

@@ -233,10 +233,13 @@ def test_engine_records_lifecycle_and_debug_state(tiny_model_dir):
     assert trace["request_id"] == "fr-live-1"
     assert trace["live"] is None  # finished: no longer resident
     t_kinds = [e["kind"] for e in trace["events"]]
-    assert t_kinds[0] == "admit" and t_kinds[-1] == "finish"
+    # the cost ledger closes right after the terminal outcome, so the
+    # trace ends finish -> ledger
+    assert t_kinds[0] == "admit" and t_kinds[-2:] == ["finish", "ledger"]
     # finish carries the reason; every event of one request shares a step
     # ordering consistent with the engine's dispatch counter
-    assert trace["events"][-1]["detail"]["reason"] == "length"
+    assert trace["events"][-2]["detail"]["reason"] == "length"
+    assert trace["events"][-1]["detail"]["outcome"] == "finish"
     steps = [e["step"] for e in trace["events"]]
     assert steps == sorted(steps)
 

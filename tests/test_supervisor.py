@@ -1272,3 +1272,190 @@ def test_failed_recovery_attempt_keeps_checkpoints_for_retry(
     assert history[0]["recovered"] is False
     assert history[1]["recovered"] is True
     assert history[1]["resumed"] == 1
+
+
+# ------------------------------------------------------- ledger under chaos
+#
+# ISSUE 16 satellite: the cost ledger must bill exactly ONE closed
+# record per request no matter which chaos path the request takes —
+# shed at the front door, restart mid-decode with a local resume plus a
+# pre-prefill replay, or a cross-replica resume — with the
+# restart/resume counts on the record matching what actually happened.
+
+
+def _ledger_rows(engine, tmp_path):
+    """Attach a JSONL sink to the fleet ledger; returns a reader that
+    flushes and parses the per-request rows."""
+    import json
+
+    from vllm_tgis_adapter_tpu.telemetry import JsonlSink
+
+    path = tmp_path / "ledger.jsonl"
+    engine.ledger.sink = JsonlSink(str(path))
+
+    def rows():
+        engine.ledger.sink.flush_sync()
+        return [json.loads(x) for x in path.read_text().splitlines()]
+
+    return rows
+
+
+def test_ledger_shed_closes_exactly_one_record(tiny_model_dir, tmp_path):
+    """A queue-full shed bills one record with outcome=shed (never
+    abort, never a second close when the stream unwinds), while the
+    admitted requests bill one finish each."""
+    from vllm_tgis_adapter_tpu.engine.config import FrontdoorConfig
+    from vllm_tgis_adapter_tpu.frontdoor.errors import AdmissionShedError
+
+    engine = _build_engine(
+        tiny_model_dir, max_num_seqs=1,
+        frontdoor=FrontdoorConfig(enabled=True, max_waiting_requests=1),
+    )
+    rows = _ledger_rows(engine, tmp_path)
+
+    async def scenario():
+        a_task = asyncio.create_task(_collect(
+            engine, "a", prompt_ids=list(range(3, 15)), max_tokens=24,
+            tenant_id="acme",
+        ))
+        await _wait_for(lambda: _output_tokens(engine, "a") >= 1,
+                        what="request a decoding")
+        # freeze the step loop: b parks deterministically behind the
+        # size-1 waiting bound, so c MUST shed
+        failpoints.arm_site("core.wait_step", "hang")
+        b_task = asyncio.create_task(_collect(
+            engine, "b", prompt_ids=list(range(5, 17)), max_tokens=4,
+            tenant_id="acme",
+        ))
+        await _wait_for(
+            lambda: sum(
+                len(rep.engine.scheduler.waiting)
+                for rep in engine._replicas
+            ) >= 1,
+            what="b engine-waiting",
+        )
+        status_c, err_c = await _collect(
+            engine, "c", prompt_ids=list(range(7, 19)), max_tokens=4,
+            tenant_id="globex",
+        )
+        failpoints.release("core.wait_step")
+        results = await asyncio.gather(a_task, b_task)
+        ledger_kinds = {
+            e["kind"] for e in engine.engine.recorder.events()
+        }
+        await engine.stop()
+        return (status_c, err_c), results, ledger_kinds
+
+    (status_c, err_c), results, kinds = asyncio.run(scenario())
+    assert status_c == "err" and isinstance(err_c, AdmissionShedError)
+    assert all(status == "ok" for status, _ in results)
+
+    by_rid = {}
+    for row in rows():
+        assert row["request_id"] not in by_rid, "double-billed request"
+        by_rid[row["request_id"]] = row
+    assert set(by_rid) == {"a", "b", "c"}
+    assert by_rid["c"]["outcome"] == "shed"
+    assert by_rid["c"]["shed_reason"] == "queue_full"
+    assert by_rid["c"]["tokens_out"] == 0
+    assert by_rid["a"]["outcome"] == "finish"
+    assert by_rid["a"]["tokens_out"] == 24
+    assert by_rid["b"]["outcome"] == "finish"
+    assert engine.ledger.by_outcome["shed"] == 1
+    assert engine.ledger.open_count == 0
+    # every close emitted a flight-recorder breadcrumb
+    assert "ledger" in kinds
+
+
+def test_ledger_restart_middecode_bills_once(tiny_model_dir, tmp_path):
+    """A step crash with one mid-decode and one pre-prefill request:
+    the checkpointed request's single record shows resumes=1, the
+    replayed request's shows restarts=1 — and neither is billed
+    twice despite dying and living again."""
+    engine = _build_engine(tiny_model_dir, max_num_seqs=1, tier_gb=1.0)
+    rows = _ledger_rows(engine, tmp_path)
+    n = 48
+
+    async def scenario():
+        a_task = asyncio.create_task(_collect_delta(
+            engine, "a", list(range(3, 21)), _delta_params(n)
+        ))
+        b_task = asyncio.create_task(_collect_delta(
+            engine, "b", list(range(5, 17)), _delta_params(4)
+        ))
+        await _wait_for(lambda: _output_tokens(engine, "a") >= 1,
+                        what="request a mid-decode")
+        failpoints.arm_site("core.plan_step", "raise", 1)
+        toks_a = await a_task
+        toks_b = await b_task
+        await _wait_for(lambda: engine.lifecycle == "serving",
+                        what="recovery to finish")
+        await engine.stop()
+        return toks_a, toks_b
+
+    toks_a, toks_b = asyncio.run(scenario())
+    assert len(toks_a) == n and len(toks_b) == 4
+
+    by_rid = {}
+    for row in rows():
+        assert row["request_id"] not in by_rid, "double-billed request"
+        by_rid[row["request_id"]] = row
+    assert set(by_rid) == {"a", "b"}
+    # the mid-decode request resumed from its checkpoint, exactly once
+    a = by_rid["a"]
+    assert a["outcome"] == "finish"
+    assert a["resumes"] == 1 and a["restarts"] == 0
+    assert a["tokens_out"] == n  # full stream billed across the death
+    # the pre-prefill request replayed onto the rebuilt engine
+    b = by_rid["b"]
+    assert b["outcome"] == "finish"
+    assert b["restarts"] == 1 and b["resumes"] == 0
+    assert b["tokens_out"] == 4
+    assert engine.ledger.closed_total == 2
+    assert engine.ledger.open_count == 0
+
+
+def test_ledger_cross_replica_resume_bills_once(tiny_model_dir, tmp_path):
+    """A request resumed onto a dp sibling appears exactly once in the
+    ledger — resumes=1, full token total — even though two replicas
+    touched it (the acceptance criterion's no-double-billing half)."""
+    engine = _build_engine(
+        tiny_model_dir, dp=2, max_num_seqs=2, tier_gb=1.0
+    )
+    rows = _ledger_rows(engine, tmp_path)
+    n = 48
+
+    async def scenario():
+        a_task = asyncio.create_task(_collect_delta(
+            engine, "a", list(range(3, 21)), _delta_params(n)
+        ))
+        await _wait_for(lambda: _output_tokens(engine, "a") >= 1,
+                        what="request a mid-decode")
+        victim = engine._owner["a"]
+        failpoints.arm_site("supervisor.rebuild", "hang")
+
+        def boom(*args, **kwargs):
+            raise RuntimeError("injected victim fault")
+
+        victim.engine.plan_step = boom  # type: ignore[method-assign]
+        toks = await a_task
+        failpoints.release("supervisor.rebuild")
+        await _wait_for(
+            lambda: victim.serving
+            and engine.supervisor.restart_history
+            and engine.supervisor.restart_history[-1].get("recovered"),
+            what="victim replica rebuilt",
+        )
+        await engine.stop()
+        return toks
+
+    toks = asyncio.run(scenario())
+    assert len(toks) == n
+
+    matching = [r for r in rows() if r["request_id"] == "a"]
+    assert len(matching) == 1, "resumed request billed more than once"
+    a = matching[0]
+    assert a["outcome"] == "finish"
+    assert a["resumes"] == 1 and a["handoffs"] == 0
+    assert a["tokens_out"] == n
+    assert engine.ledger.open_count == 0

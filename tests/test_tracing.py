@@ -277,3 +277,45 @@ def test_exporter_flushes_partial_batch_on_shutdown(collector):
         for s in payload["resourceSpans"][0]["scopeSpans"][0]["spans"]
     }
     assert names == {f"s{i}" for i in range(5)}, "spans dropped on close"
+
+
+@pytest.mark.parametrize("path", ["local", "cross_replica", "handoff"])
+def test_resume_span_links_to_origin(collector, path):
+    """Every recovery hop emits a marker span that joins the origin's
+    trace AND carries an explicit OTLP span link to the originating
+    request span — the queryable relationship ("every request this
+    migration touched") that sharing a trace_id alone does not give a
+    backend."""
+    from vllm_tgis_adapter_tpu.tracing import RequestTracer
+
+    endpoint, received = collector
+    tracer = RequestTracer(endpoint)
+    origin = tracer.start_span(
+        "resumed-1",
+        {"traceparent":
+         "00-0af7651916cd43dd8448eb211c80319c-b7ad6b7169203331-01"},
+    )
+    marker = tracer.resume_span(origin, "resumed-1", path)
+    assert marker.links == [(origin.trace_id, origin.span_id)]
+    tracer.shutdown()
+
+    spans = [
+        s
+        for _, payload in received
+        for s in payload["resourceSpans"][0]["scopeSpans"][0]["spans"]
+    ]
+    resume = next(s for s in spans if s["name"] == "llm_request.resume")
+    # joins the origin's trace, parented under the request span
+    assert resume["traceId"] == origin.trace_id
+    assert resume["parentSpanId"] == origin.span_id
+    assert resume["kind"] == 1  # SPAN_KIND_INTERNAL
+    # the explicit link — both halves of the origin's identity
+    assert resume["links"] == [
+        {"traceId": origin.trace_id, "spanId": origin.span_id}
+    ]
+    attrs = {a["key"]: a["value"] for a in resume["attributes"]}
+    assert attrs["path"]["stringValue"] == path
+    assert attrs["gen_ai.request.id"]["stringValue"] == "resumed-1"
+    # zero-duration marker: recovery COST lives in the restart/handoff
+    # histograms, not in span length
+    assert resume["startTimeUnixNano"] == resume["endTimeUnixNano"]

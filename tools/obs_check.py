@@ -41,6 +41,8 @@ def documented_metrics(doc_path: Path) -> set[str]:
 # /debug/state snapshot; a missing key means code and doc diverged
 DEBUG_STATE_KEYS = (
     "engine", "supervisor", "frontdoor", "router", "kv_host_tier",
+    "ledger",
+    "slo",
     "replicas",
     "compile_tracker",
     "watchdog",
@@ -66,6 +68,22 @@ REQUIRED_FRONTDOOR_METRICS = (
     "tgis_tpu_frontdoor_sheds_total",
     "tgis_tpu_frontdoor_tenant_tokens_total",
     "tgis_tpu_frontdoor_placement_total",
+)
+
+# the telemetry signal layer (docs/OBSERVABILITY.md "Cost ledger" /
+# "SLO burn rates"): the cost-attribution counters, the SLO gauges,
+# and the live efficiency gauges must all BOTH be documented and
+# served — the elastic control plane reads these, so silent drift here
+# is an autoscaler flying blind
+REQUIRED_TELEMETRY_METRICS = (
+    "tgis_tpu_tenant_cost_tokens_total",
+    "tgis_tpu_tenant_cost_hbm_page_seconds_total",
+    "tgis_tpu_tenant_cost_tier_bytes_total",
+    "tgis_tpu_slo_attainment",
+    "tgis_tpu_slo_burn_rate",
+    "tgis_tpu_spec_acceptance_rate_ewma",
+    "tgis_tpu_model_tflops_per_s",
+    "tgis_tpu_mfu",
 )
 
 
@@ -140,12 +158,12 @@ def main() -> int:
         return 1
     undocumented = sorted(
         name
-        for name in REQUIRED_FRONTDOOR_METRICS
+        for name in REQUIRED_FRONTDOOR_METRICS + REQUIRED_TELEMETRY_METRICS
         if name not in documented
     )
     if undocumented:
         print(
-            "obs_check: front-door metrics missing from "
+            "obs_check: required metrics missing from "
             "docs/OBSERVABILITY.md: " + ", ".join(undocumented)
         )
         return 1

@@ -155,6 +155,9 @@ def build_engine(
     max_loras: int = 2,
     max_lora_rank: int = 2,
     frontdoor=None,
+    slo_config: str | None = None,
+    ledger_log: str | None = None,
+    capture_trace: str | None = None,
 ):
     """One production-shaped in-process engine (the closed-loop target
     both the steady-state suites and the chaos soak drive).  Defaults
@@ -198,6 +201,9 @@ def build_engine(
         engine_restart_backoff_s=0.01,
         watchdog_deadline_s=1.0 if watchdog else 0.0,
         watchdog_action="restart",
+        slo_config=slo_config,
+        ledger_log=ledger_log,
+        capture_trace=capture_trace,
         frontdoor=(
             frontdoor if frontdoor is not None
             else FrontdoorConfig(enabled=True)
@@ -368,39 +374,26 @@ def _pct(values: list[float], q: float) -> float | None:
     return values[idx]
 
 
-def _model_flops_per_token(mcfg) -> float:
-    """~2 FLOPs per weight per token (attention projections, MLP, and
-    the LM head; attention score FLOPs and embedding gathers omitted —
-    the standard MFU numerator convention)."""
-    d, dh = mcfg.hidden_size, mcfg.head_dim
-    h, hkv, f = mcfg.num_heads, mcfg.num_kv_heads, mcfg.intermediate_size
-    per_layer = 2 * (
-        d * h * dh          # q_proj
-        + 2 * d * hkv * dh  # k/v_proj
-        + h * dh * d        # o_proj
-        + 3 * d * f         # gate/up/down
-    )
-    return float(
-        mcfg.num_layers * per_layer + 2 * d * mcfg.vocab_size
-    )
-
-
 def mfu_stamp(tok_per_s: float, mcfg) -> dict:
     """MFU next to every tok/s number (ISSUE 14 satellite): achieved
-    model FLOP/s over the accelerator's peak.  The peak comes from
-    ``TGIS_PEAK_TFLOPS`` (a per-chip spec the operator sets — e.g. 197
-    for v5e bf16); without it the stamp still reports the achieved
-    model TFLOP/s so hardware runs can derive MFU post-hoc, and ``mfu``
-    is None (the CPU proxy has no meaningful peak)."""
-    flops = _model_flops_per_token(mcfg) * max(tok_per_s, 0.0)
-    peak_tflops = float(os.environ.get("TGIS_PEAK_TFLOPS", 0) or 0)
+    model FLOP/s over the accelerator's peak.  The math lives in
+    telemetry/mfu.py now — the SAME numerator feeds the live
+    ``mfu{replica}`` gauges, so the bench and the gauges cannot drift.
+    The peak comes from ``TGIS_PEAK_TFLOPS`` (a per-chip spec the
+    operator sets — e.g. 197 for v5e bf16); without it the stamp still
+    reports the achieved model TFLOP/s so hardware runs can derive MFU
+    post-hoc, and ``mfu`` is None (the CPU proxy has no meaningful
+    peak)."""
+    from vllm_tgis_adapter_tpu.telemetry.mfu import (
+        achieved_tflops,
+        peak_tflops,
+    )
+
+    achieved = achieved_tflops(tok_per_s, mcfg)
+    peak = peak_tflops()
     return {
-        "model_tflops_per_s": round(flops / 1e12, 6),
-        "mfu": (
-            round(flops / (peak_tflops * 1e12), 6)
-            if peak_tflops > 0
-            else None
-        ),
+        "model_tflops_per_s": round(achieved, 6),
+        "mfu": round(achieved / peak, 6) if peak > 0 else None,
     }
 
 

@@ -41,12 +41,26 @@ from vllm_tgis_adapter_tpu.engine.outputs import (
     CompletionOutput,
     RequestOutput,
 )
-from vllm_tgis_adapter_tpu.engine.sampling_params import SamplingParams
+from vllm_tgis_adapter_tpu.engine.sampling_params import (
+    RequestOutputKind,
+    SamplingParams,
+)
 from vllm_tgis_adapter_tpu.frontdoor.errors import (
     SHED_TTL,
     AdmissionShedError,
 )
 from vllm_tgis_adapter_tpu.logging import init_logger
+from vllm_tgis_adapter_tpu.telemetry import (
+    CostLedger,
+    JsonlSink,
+    SloEngine,
+    TokenRateEwma,
+)
+from vllm_tgis_adapter_tpu.telemetry.slo import (
+    estimate_tokens,
+    parse_slo_config,
+    resolve_request_class,
+)
 from vllm_tgis_adapter_tpu.supervisor.lifecycle import (
     LIFECYCLE_DEAD,
     LIFECYCLE_RECOVERING,
@@ -167,6 +181,42 @@ class AsyncLLMEngine:
             from vllm_tgis_adapter_tpu.tracing import RequestTracer
 
             self._tracer = RequestTracer(endpoint)
+        # telemetry signal layer (telemetry/, docs/OBSERVABILITY.md):
+        # the cost ledger and SLO engine live HERE, above the replicas —
+        # supervised restarts and cross-replica resumes swap engine
+        # cores underneath a request, but its open ledger record and
+        # SLO class stay put, so a migrated request bills exactly once
+        cfg = self.engine.config
+        self.slo_engine = SloEngine(parse_slo_config(cfg.slo_config))
+        self._ledger_sink = (
+            JsonlSink(cfg.ledger_log) if cfg.ledger_log else None
+        )
+        self.ledger = CostLedger(
+            sink=self._ledger_sink,
+            recorder=self.engine.recorder.record,
+        )
+        # --capture-trace: admitted-traffic shape (token counts and
+        # arrival offsets, never content) for tools/trace_replay.py;
+        # offsets are relative to boot
+        self._capture_sink = (
+            JsonlSink(cfg.capture_trace) if cfg.capture_trace else None
+        )
+        self._capture_t0 = time.time()
+        # request_id -> server Span while the stream is live: resume
+        # and handoff spans link back to it (tracing.py resume_span)
+        self._spans: dict[str, object] = {}
+        # per-replica committed-token rate EWMAs (the live MFU gauges)
+        # and the clock of each replica's last KV page-seconds sample
+        self._token_rate = {
+            rep.index: TokenRateEwma() for rep in self._replicas
+        }
+        self._kv_sample_t: dict[int, float] = {}
+        for rep in self._replicas:
+            # engine cores feed SLO latency observations and ledger
+            # attributions through these refs (None-guarded call sites;
+            # restart_replica re-attaches them on the rebuilt core)
+            rep.engine.slo = self.slo_engine
+            rep.engine.ledger = self.ledger
         # front door (frontdoor/admission.py): bounded admission, per-
         # tenant weighted fair queuing, rate limits, queue TTLs, drain.
         # The serving layer hands requests here; the engine's own
@@ -428,7 +478,10 @@ class AsyncLLMEngine:
     ) -> None:
         """Flight-recorder hook for front-door sheds; the request never
         reached a replica, so the event lands on the host-surface
-        (replica 0) recorder."""
+        (replica 0) recorder.  The noted reason makes the ledger close
+        with outcome "shed" whatever the stream-level exit looks like
+        (scheduler TTL sheds surface as graceful aborted frames)."""
+        self.ledger.note_shed(request_id, reason)
         self.engine.recorder.record(
             "shed", request_id, step=self.engine.step_counter,
             tenant=tenant, reason=reason, **detail,
@@ -613,6 +666,11 @@ class AsyncLLMEngine:
             # the host pages (restart-survival is the SUPERVISOR's path,
             # which never calls stop())
             tier.close()
+        for sink in (self._ledger_sink, self._capture_sink):
+            # final drain: records closed since the last stats tick
+            # must reach the JSONL files before the process exits
+            if sink is not None and sink.pending:
+                await asyncio.to_thread(sink.flush_sync)
         if self._tracer is not None:
             # flush buffered spans before the exporter thread dies with
             # the process
@@ -709,6 +767,65 @@ class AsyncLLMEngine:
         if request_id in self._queues:
             # reject WITHOUT touching the existing request's queue
             raise ValueError(f"duplicate request_id {request_id!r}")
+        # admission-time request-class resolution + ledger open
+        # (telemetry/): opened BEFORE the front door so a shed closes a
+        # record too; settle() below closes it exactly once at the
+        # stream's terminal outcome
+        lora_name = getattr(lora_request, "name", None)
+        request_class = resolve_request_class(
+            trace_headers,
+            estimate_tokens(prompt_token_ids, prompt),
+            sampling_params.max_tokens,
+        )
+        opened = self.ledger.open(
+            request_id,
+            tenant=tenant_id or lora_name,
+            request_class=request_class,
+            tokens_in=(
+                len(prompt_token_ids)
+                if prompt_token_ids is not None
+                else 0
+            ),
+            lora_name=lora_name,
+        ) is not None
+
+        def settle(outcome: str, final=None) -> None:  # noqa: ANN001
+            nonlocal opened
+            if not opened:
+                return
+            opened = False
+            rec = self.ledger.close(
+                request_id, outcome,
+                request_metrics=getattr(final, "metrics", None),
+                step=self.engine.step_counter,
+            )
+            if rec is None:
+                return
+            # availability feed (telemetry/slo.py): the terminal
+            # outcome under the class resolved at admission.  Warmup
+            # traffic is exempt like the TTFT/ITL feeds (core.py):
+            # precompile passes stall on XLA by design and must not
+            # burn real error budget — the ledger still bills them
+            if not request_id.startswith("__warmup"):
+                self.slo_engine.observe_outcome(
+                    rec.request_class, rec.outcome
+                )
+            if self._capture_sink is not None:
+                self._capture_sink.append({
+                    "offset_s": round(
+                        max(0.0, rec.arrival_time - self._capture_t0), 3
+                    ),
+                    "request_id": request_id,
+                    "tenant": rec.tenant,
+                    "class": rec.request_class,
+                    "adapter": rec.lora_name,
+                    "prompt_tokens": rec.tokens_in,
+                    "output_tokens": rec.tokens_out,
+                    "max_tokens": sampling_params.max_tokens,
+                    "temperature": sampling_params.temperature,
+                    "outcome": rec.outcome,
+                })
+
         if self.frontdoor is None:
             # --disable-frontdoor restores pre-PR4 semantics entirely:
             # no queue-TTL deadline reaches the scheduler either
@@ -740,6 +857,10 @@ class AsyncLLMEngine:
                     deadline=deadline,
                 )
             except AdmissionShedError as e:
+                # the _record_shed hook already noted the reason;
+                # note again here for direct-raise paths that bypass it
+                self.ledger.note_shed(request_id, e.reason)
+                settle("shed")
                 if e.reason != SHED_TTL:
                     raise
                 # deadline passed while parked: the SAME graceful wire
@@ -779,6 +900,9 @@ class AsyncLLMEngine:
         span = None
         if self._tracer is not None:
             span = self._tracer.start_span(request_id, trace_headers)
+            # registered while the stream is live so recovery paths can
+            # LINK their resume spans to this one (satellite: span links)
+            self._spans[request_id] = span
         # owner is registered BEFORE the awaited admission critical
         # section: an abort() arriving in that window must find the
         # replica rather than silently no-op and leave the request
@@ -792,10 +916,11 @@ class AsyncLLMEngine:
                     prompt,
                     sampling_params,
                     prompt_token_ids=prompt_token_ids,
-                    lora_name=getattr(lora_request, "name", None),
+                    lora_name=lora_name,
                     trace_id=getattr(span, "trace_id", None),
                     deadline=deadline,
                     tenant_id=tenant_id,
+                    request_class=request_class,
                 )
                 if request_id in self._early_aborts:
                     # abort() ran before the engine knew the request; it
@@ -816,6 +941,12 @@ class AsyncLLMEngine:
             self._owner.pop(request_id, None)
             self._queues.pop(request_id, None)
             self._early_aborts.discard(request_id)
+            self._spans.pop(request_id, None)
+            settle(
+                "abort"
+                if isinstance(e, (asyncio.CancelledError, GeneratorExit))
+                else "failed"
+            )
             if span is not None:
                 # rejected admissions are precisely the requests tracing
                 # must not lose
@@ -835,19 +966,57 @@ class AsyncLLMEngine:
         rep.last_beat = time.monotonic()
         rep.new_work.set()
         final = None
+        # "failed" is the floor: an exit with no terminal frame (engine
+        # death on the queue, mid-stream error) is a server failure; a
+        # cancel/disconnect flips it to "abort"; a terminal frame
+        # settles finish/abort; a noted shed wins over all of them
+        outcome = "failed"
+        is_delta = (
+            sampling_params.output_kind == RequestOutputKind.DELTA
+        )
+        tokens_seen = 0
+        noted_in = False
         try:
             while True:
                 item = await queue.get()
                 if isinstance(item, BaseException):
                     raise item
                 final = item
+                if not noted_in and item.prompt_token_ids:
+                    # the true tokenized prompt length (the admission
+                    # estimate may have come from raw text)
+                    self.ledger.note_tokens_in(
+                        request_id, len(item.prompt_token_ids)
+                    )
+                    noted_in = True
+                if item.outputs:
+                    # DELTA frames carry only new tokens; CUMULATIVE /
+                    # FINAL_ONLY carry the whole output — bill the
+                    # increment either way (a resumed request's restored
+                    # emission offsets keep deltas duplicate-free)
+                    n = len(item.outputs[0].token_ids)
+                    inc = n if is_delta else max(0, n - tokens_seen)
+                    if not is_delta:
+                        tokens_seen = n
+                    if inc:
+                        self.ledger.note_tokens_out(request_id, inc)
                 yield item
                 if item.finished:
+                    reason = (
+                        item.outputs[0].finish_reason
+                        if item.outputs else None
+                    )
+                    outcome = "abort" if reason == "abort" else "finish"
                     return
+        except (asyncio.CancelledError, GeneratorExit):
+            outcome = "abort"  # client hung up — not server failure
+            raise
         finally:
             self._queues.pop(request_id, None)
             self._owner.pop(request_id, None)
             self._early_aborts.discard(request_id)
+            self._spans.pop(request_id, None)
+            settle(outcome, final)
             if span is not None:
                 self._tracer.finish_span(span, final)
 
@@ -1001,6 +1170,10 @@ class AsyncLLMEngine:
                 if getattr(self.engine, "kv_tier", None) is not None
                 else None
             ),
+            # telemetry signal layer (telemetry/): per-tenant cost
+            # aggregates and per-class SLO attainment/burn
+            "ledger": self.ledger.debug_state(),
+            "slo": self.slo_engine.debug_state(),
             "replicas": replicas,
             "compile_tracker": {
                 "compiled_shapes": compile_tracker.num_shapes(),
@@ -1047,6 +1220,45 @@ class AsyncLLMEngine:
             "live": live,
             "events": events,
         }
+
+    def _note_step_telemetry(self, rep: _Replica, committed: int) -> None:
+        """Per-commit telemetry feeds (telemetry/): each open request's
+        current KV page count accrues page-seconds for the interval
+        since this replica's previous commit, and the committed tokens
+        fold into the replica's rate EWMA (the MFU numerator).  dt is
+        capped so an idle gap before a commit cannot bill a full idle
+        period at the current occupancy."""
+        now = time.monotonic()
+        last = self._kv_sample_t.get(rep.index)
+        self._kv_sample_t[rep.index] = now
+        if last is not None:
+            dt = min(now - last, 1.0)
+            if dt > 0:
+                try:
+                    self.ledger.sample_kv(
+                        rep.engine.kv_pages_by_request(), dt
+                    )
+                except Exception:  # noqa: BLE001 — telemetry must never raise
+                    logger.debug(
+                        "kv page-seconds sample failed", exc_info=True
+                    )
+        if committed > 0:
+            self._token_rate[rep.index].update(committed, now)
+
+    def _link_resume(self, request_id: str, path: str) -> None:
+        """Zero-duration resume span LINKED to the request's live
+        server span (tracing.py resume_span): a restart resume, a
+        cross-replica migration, or a prefill→decode handoff shows up
+        in the trace waterfall attached to the originating trace."""
+        if self._tracer is None:
+            return
+        origin = self._spans.get(request_id)
+        if origin is None:
+            return
+        try:
+            self._tracer.resume_span(origin, request_id, path)
+        except Exception:  # noqa: BLE001 — telemetry must never raise
+            logger.debug("resume span emit failed", exc_info=True)
 
     def refresh_engine_gauges(self) -> tuple[int, int]:
         """Push current engine state into the Prometheus gauges
@@ -1132,6 +1344,35 @@ class AsyncLLMEngine:
                     metrics.spec_acceptance_rate.labels(
                         replica=str(rep.index)
                     ).set(spec.stats.acceptance_rate)
+                    # time-decayed companion (telemetry/ewma.py): what
+                    # acceptance looks like NOW, not since boot — the
+                    # signal an adaptive-spec policy would act on
+                    if spec.acceptance_ewma.initialized:
+                        metrics.spec_acceptance_rate_ewma.labels(
+                            replica=str(rep.index)
+                        ).set(spec.acceptance_ewma.value)
+            # live MFU (telemetry/mfu.py): committed-token rate EWMA ×
+            # the analytic FLOPs/token the bench stamps; the mfu RATIO
+            # additionally needs an operator-declared TGIS_PEAK_TFLOPS
+            from vllm_tgis_adapter_tpu.telemetry import mfu as mfu_mod
+
+            peak = mfu_mod.peak_tflops()
+            mcfg = self.engine.config.model_config
+            for rep in self._replicas:
+                rate = self._token_rate[rep.index].rate
+                if rate <= 0:
+                    continue
+                achieved = mfu_mod.achieved_tflops(rate, mcfg)
+                metrics.model_tflops_per_s.labels(
+                    replica=str(rep.index)
+                ).set(achieved)
+                if peak:
+                    metrics.mfu.labels(replica=str(rep.index)).set(
+                        achieved / peak
+                    )
+            # SLO attainment/burn gauges refresh with the same cadence
+            # (every stats tick and every /metrics scrape)
+            self.slo_engine.refresh_gauges()
         except Exception:  # pragma: no cover — metrics are best-effort
             logger.debug("engine gauge refresh failed", exc_info=True)
         return used, num_blocks
@@ -1162,6 +1403,11 @@ class AsyncLLMEngine:
             active = any(e.has_unfinished_requests() for e in engines)
             allocators = [e.scheduler.allocator for e in engines]
             used, num_blocks = self.refresh_engine_gauges()
+            # drain the ledger/capture JSONL buffers off the event loop
+            # (JsonlSink.flush runs the write in asyncio.to_thread)
+            for sink in (self._ledger_sink, self._capture_sink):
+                if sink is not None and sink.pending:
+                    await sink.flush()
             if self.engine.config.disable_log_stats or (
                 not active and not was_active
             ):
@@ -1193,6 +1439,9 @@ class AsyncLLMEngine:
                 line += (
                     f", spec acceptance: {100 * accepted / proposed:.1f}%"
                 )
+            # per-class error-budget burn (telemetry/slo.py) — the one
+            # number the operator pages on, in the line they tail
+            line += ", " + self.slo_engine.stats_fragment()
             # step-level telemetry mirror (metrics.step_snapshot /
             # compile_tracker): the SAME values the gauges export, so the
             # log line and /metrics can never tell different stories.
@@ -1280,6 +1529,10 @@ class AsyncLLMEngine:
             # per-replica committed-token attribution: the placement
             # router's load tiebreak and the bench's per-replica tok/s
             self.router.note_committed(rep.index, committed)
+            # telemetry feeds at the same boundary: KV page-seconds
+            # sampling for the cost ledger and the token-rate EWMA
+            # behind the live MFU gauges
+            self._note_step_telemetry(rep, committed)
             if self.frontdoor is not None:
                 # finished rows free batch slots/pages and the commit's
                 # tokens feed the admission estimator's PER-REPLICA
@@ -1658,6 +1911,8 @@ class AsyncLLMEngine:
             self._owner[ckpt.request_id] = target
             targets.add(target.index)
             resumed += 1
+            self.ledger.note_resume(ckpt.request_id, "cross_replica")
+            self._link_resume(ckpt.request_id, "cross_replica")
             metrics.requests_resumed_total.labels(
                 path="cross_replica"
             ).inc()
@@ -1705,6 +1960,8 @@ class AsyncLLMEngine:
                     tier.pop_checkpoint(ckpt.request_id)
                 self._owner[ckpt.request_id] = rep
                 resumed += 1
+                self.ledger.note_resume(ckpt.request_id, "local")
+                self._link_resume(ckpt.request_id, "local")
                 metrics.requests_resumed_total.labels(path="local").inc()
                 metrics.decode_checkpoints_total.labels(
                     outcome="resumed"
@@ -1807,6 +2064,8 @@ class AsyncLLMEngine:
             self._owner[rid] = target
             target.last_beat = time.monotonic()
             target.new_work.set()
+            self.ledger.note_resume(rid, "handoff")
+            self._link_resume(rid, "handoff")
             self.handoff_outcomes["completed"] += 1
             metrics.handoffs_total.labels(outcome="completed").inc()
             metrics.handoff_seconds.observe(
@@ -1945,6 +2204,7 @@ class AsyncLLMEngine:
                     trace_id=seq.trace_id,
                     deadline=seq.deadline,
                     tenant_id=seq.tenant_id,
+                    request_class=seq.request_class,
                 )
                 # abort()/stream bookkeeping must follow the request to
                 # its new home — the dead replica's engine no longer
@@ -1952,6 +2212,9 @@ class AsyncLLMEngine:
                 self._owner[seq.request_id] = target
                 targets.add(target.index)
                 moved += 1
+                # the request survived its replica's death: one restart
+                # on its (still-open) ledger record
+                self.ledger.note_restart(seq.request_id)
         for r in self._replicas:
             if r.index in targets:
                 r.last_beat = time.monotonic()
@@ -2018,6 +2281,11 @@ class AsyncLLMEngine:
             # dead engine did (a rebuilt prefill replica must resume
             # staging handoffs, not decode)
             new_engine.set_replica_role(rep.role)
+            # the rebuilt core feeds the SAME fleet-level SLO engine
+            # and cost ledger (open records survive the swap — a
+            # restarted request bills once)
+            new_engine.slo = self.slo_engine
+            new_engine.ledger = self.ledger
             rep.engine = new_engine
             rep.in_flight_desc = None
             # the replacement's committed-token rates start fresh, in
@@ -2029,6 +2297,8 @@ class AsyncLLMEngine:
             if rep is self._replicas[0]:
                 # replica 0 doubles as the host-side singleton surface
                 self.engine = new_engine
+                # ledger flight-recorder events follow replica 0's ring
+                self.ledger.recorder = new_engine.recorder.record
             for seq in replays:
                 if seq.request_id not in self._queues:
                     continue  # consumer vanished while the engine was down
@@ -2042,8 +2312,12 @@ class AsyncLLMEngine:
                     trace_id=seq.trace_id,
                     deadline=seq.deadline,
                     tenant_id=seq.tenant_id,
+                    request_class=seq.request_class,
                 )
                 replayed += 1
+                # the request survived a supervised engine restart:
+                # count it on the still-open ledger record
+                self.ledger.note_restart(seq.request_id)
         failed = 0
         for request_id in fails:
             queue = self._queues.get(request_id)

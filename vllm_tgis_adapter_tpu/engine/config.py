@@ -1001,6 +1001,13 @@ class EngineConfig:
     unified_arena: bool = True
     quantization: str | None = None
     otlp_traces_endpoint: str | None = None
+    # telemetry signal layer (telemetry/, docs/OBSERVABILITY.md):
+    # per-class SLO objectives (JSON object or path; None = defaults),
+    # the cost-ledger JSONL sink, and admitted-traffic trace capture
+    # for tools/trace_replay.py — all optional, all zero-cost when off
+    slo_config: str | None = None
+    ledger_log: str | None = None
+    capture_trace: str | None = None
     disable_log_requests: bool = True
     disable_log_stats: bool = False
     # stall watchdog (watchdog.py): a step loop with unfinished work
@@ -1480,6 +1487,9 @@ class EngineConfig:
             unified_arena=getattr(args, "unified_arena", True),
             quantization=args.quantization,
             otlp_traces_endpoint=args.otlp_traces_endpoint,
+            slo_config=getattr(args, "slo_config", None),
+            ledger_log=getattr(args, "ledger_log", None),
+            capture_trace=getattr(args, "capture_trace", None),
             disable_log_stats=getattr(args, "disable_log_stats", False),
             disable_log_requests=args.disable_log_requests,
             watchdog_deadline_s=float(

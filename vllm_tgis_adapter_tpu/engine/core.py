@@ -194,6 +194,11 @@ class LLMEngine:
         self.kv_tier = None
         self._promotions: list = []  # (seq, ticket) awaiting apply
         self.kv_host_promoted_tokens = 0
+        # fleet-level telemetry hooks (telemetry/): attached by the
+        # async engine at build AND after every supervised rebuild —
+        # None for direct core users, and every call site guards on it
+        self.slo = None  # telemetry.slo.SloEngine
+        self.ledger = None  # telemetry.ledger.CostLedger
         if (
             config.kv_host_cache_gb > 0
             and pcfg.pipeline_parallel_size == 1
@@ -513,6 +518,7 @@ class LLMEngine:
         trace_id: Optional[str] = None,
         deadline: Optional[float] = None,
         tenant_id: Optional[str] = None,
+        request_class: Optional[str] = None,
     ) -> None:
         if request_id in self._seqs:
             raise ValueError(f"duplicate request_id {request_id!r}")
@@ -537,6 +543,8 @@ class LLMEngine:
         )
         seq.trace_id = trace_id
         seq.tenant_id = tenant_id
+        if request_class is not None:
+            seq.request_class = request_class
         # queue TTL (frontdoor): the async layer passes the effective
         # deadline (request SLO ∧ arrival + --queue-ttl, stamped before
         # any fair-queue parking); direct core users get the same
@@ -585,7 +593,11 @@ class LLMEngine:
             seq.lora_slot = 0
             if lora_name is not None:
                 pool.note_lookup(lora_name, replica=self.replica_index)
-                pool.prefetch(lora_name)
+                resident = pool.prefetch(lora_name)
+                if not resident and self.ledger is not None:
+                    # cost attribution: this admission is the one that
+                    # pulls the adapter onto the device
+                    self.ledger.note_adapter_swap(seq.request_id)
         if self.runner.spec is not None:
             from vllm_tgis_adapter_tpu.engine.speculative import (
                 spec_eligible,
@@ -861,6 +873,10 @@ class LLMEngine:
             "demote_host", seq.request_id, step=self.step_counter,
             trace_id=seq.trace_id, pages=len(batch),
         )
+        if self.ledger is not None:
+            self.ledger.note_tier_bytes(
+                seq.request_id, len(batch) * self._tier_page_bytes()
+            )
         return len(batch)
 
     def _tier_swap_out(self, seq: Sequence) -> bool:
@@ -1073,6 +1089,11 @@ class LLMEngine:
                 trace_id=seq.trace_id, tokens=promoted,
                 pages=len(ticket.pages),
             )
+            if self.ledger is not None:
+                self.ledger.note_tier_bytes(
+                    seq.request_id,
+                    len(ticket.pages) * self._tier_page_bytes(),
+                )
             logger.info(
                 "request %s: %d prefix tokens promoted from the host KV "
                 "tier (%d already device-resident)",
@@ -1081,6 +1102,33 @@ class LLMEngine:
         self._promotions = rest
 
     # ------------------------------------- mid-decode checkpoint / resume
+
+    def _tier_page_bytes(self) -> int:
+        """K+V bytes of one KV page at the device cache dtype — the
+        unit the cost ledger bills tier transfers in."""
+        caches = getattr(self.runner, "caches", None)
+        if not caches:
+            return 0
+        k_cache = caches[0]
+        bs = self.config.cache_config.block_size
+        # tpulint: disable=TPL202(static shape/dtype metadata only — .shape and .itemsize are host ints, no device value is pulled)
+        return int(
+            2 * k_cache.shape[0] * k_cache.shape[1] * k_cache.shape[3]
+            * k_cache.dtype.itemsize * bs
+        )
+
+    def kv_pages_by_request(self) -> dict[str, int]:
+        """{request_id: device KV pages currently held} over live
+        sequences — the cost ledger's commit-boundary HBM occupancy
+        sample (telemetry/ledger.py ``sample_kv``); warmups excluded."""
+        out: dict[str, int] = {}
+        for rid, seq in self._seqs.items():
+            if rid.startswith("__warmup"):
+                continue
+            blocks = seq.blocks
+            if blocks is not None:
+                out[rid] = len(blocks.blocks)
+        return out
 
     def checkpoint_decode(self, seq: Sequence):
         """Quiesce-time capture of one mid-decode request
@@ -1176,6 +1224,7 @@ class LLMEngine:
             ),
             pages=pages,
             t0=t0,
+            request_class=seq.request_class,
         )
         tier.stage_checkpoint(ckpt)
         self.recorder.record(
@@ -1217,6 +1266,7 @@ class LLMEngine:
         seq.trace_id = ckpt.trace_id
         seq.tenant_id = ckpt.tenant_id
         seq.deadline = ckpt.deadline
+        seq.request_class = getattr(ckpt, "request_class", "chat")
         seq.output_token_ids = list(ckpt.output_token_ids)
         if ckpt.output_logprobs is not None:
             seq.output_logprobs = list(ckpt.output_logprobs)
@@ -1873,6 +1923,20 @@ class LLMEngine:
                     continue  # mid-prompt chunk: nothing emitted yet
                 seqs.append(seq)
                 toks.append(tok_list)
+                if (
+                    spec_ran
+                    and item.spec_width > 0
+                    and self.ledger is not None
+                ):
+                    # per-request speculative attribution: the row
+                    # proposed spec_width drafts and consumed
+                    # len(tok_list) tokens, of which all but the bonus
+                    # token were accepted drafts
+                    self.ledger.note_spec(
+                        seq.request_id,
+                        item.spec_width,
+                        max(0, len(tok_list) - 1),
+                    )
             outputs = self._process_sampled(seqs, toks)
             if spec_ran:
                 for item in plan.items:
@@ -1944,9 +2008,17 @@ class LLMEngine:
             # true wall time (metrics.inter_token_seconds doc)
             first_wave = seq.metrics.first_token_time is None
             if first_wave:
-                metrics.ttft_seconds.observe(
-                    max(0.0, now - seq.metrics.arrival_time)
-                )
+                ttft = max(0.0, now - seq.metrics.arrival_time)
+                metrics.ttft_seconds.observe(ttft)
+                if (
+                    self.slo is not None
+                    and not seq.request_id.startswith("__warmup")
+                ):
+                    # SLO feed shares the histogram's observation point
+                    # (telemetry/slo.py); resumed requests keep their
+                    # restored first_token_time, so TTFT never
+                    # re-observes across a migration
+                    self.slo.observe_ttft(seq.request_class, ttft)
             prev_commit = seq.metrics.last_token_time
             consumed = 0
             for tok in toks:
@@ -1996,6 +2068,12 @@ class LLMEngine:
                 itl = max(0.0, now - prev_commit) / consumed
                 for _ in range(consumed):
                     metrics.inter_token_seconds.observe(itl)
+                if (
+                    self.slo is not None
+                    and not seq.request_id.startswith("__warmup")
+                ):
+                    for _ in range(consumed):
+                        self.slo.observe_itl(seq.request_class, itl)
         return outputs
 
     def _maybe_finish(self, seq: Sequence, token_id: int) -> None:

@@ -521,6 +521,9 @@ class DecodeCheckpoint:
     pages: int
     # perf_counter stamp at capture (checkpoint_seconds observation)
     t0: float = 0.0
+    # SLO/cost request class (telemetry/slo.py) — restored on resume so
+    # the migrated request keeps billing under its original class
+    request_class: str = "chat"
     # set by an explicit abort between staging and resume: the resume
     # paths skip a cancelled record even if they still hold a reference
     # to it (the client already received its final aborted frame)

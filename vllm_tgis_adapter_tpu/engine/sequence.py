@@ -85,6 +85,11 @@ class Sequence:
         # pre-prefill past this, the scheduler sheds the request
         # instead of spending prefill compute on it
         self.deadline: Optional[float] = None
+        # SLO/cost request class (telemetry/slo.py: chat | rag |
+        # batch), resolved once at admission and carried through
+        # restarts/resumes so attainment and billing never reclassify
+        # a request mid-flight
+        self.request_class: str = "chat"
 
         self.blocks: Optional["SequenceBlocks"] = None
         self.slot: int = -1  # fixed batch row while RUNNING

@@ -260,6 +260,12 @@ class SpeculativeDecoder:
         self.gamma = num_speculative_tokens
         self.draft_model = draft_model
         self.stats = SpecStats()
+        # time-decayed per-dispatch acceptance (30s half-life): the
+        # responsive signal the γ auto-tuner consumes, exported as
+        # spec_acceptance_rate_ewma next to the lifetime rate
+        from vllm_tgis_adapter_tpu.telemetry.ewma import DecayedEwma
+
+        self.acceptance_ewma = DecayedEwma(half_life_s=30.0)
 
         tcfg = runner.config.model_config
         dcfg = draft_model.config
@@ -470,6 +476,8 @@ class SpeculativeDecoder:
         self.stats.proposed += proposed
         self.stats.accepted += accepted
         self.stats.dispatches += 1
+        if proposed:
+            self.acceptance_ewma.update(accepted / proposed)
         try:
             from vllm_tgis_adapter_tpu import metrics
 

@@ -88,6 +88,9 @@ EVENT_KINDS = (
     #                   kv gate promotes its pages at the next clean
     #                   dispatch boundary; detail: output_tokens,
     #                   from_replica)
+    "ledger",         # cost-ledger record closed at terminal outcome
+    #                   (telemetry/ledger.py; detail: outcome, tenant,
+    #                   request_class, tokens in/out, restarts/resumes)
 )
 
 # Per-request decode events are recorded every N committed tokens — one

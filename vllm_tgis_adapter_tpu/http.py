@@ -42,7 +42,9 @@ if TYPE_CHECKING:
 logger = init_logger(__name__)
 
 CORRELATION_ID_HEADER = "x-correlation-id"
-_TRACE_HEADERS = ("traceparent", "tracestate")
+# x-request-class rides along with the W3C pair: it is consumed by
+# telemetry/slo.py class resolution at admission, not by tracing
+_TRACE_HEADERS = ("traceparent", "tracestate", "x-request-class")
 
 
 def _trace_headers(request: "HttpRequest") -> Optional[dict[str, str]]:

@@ -565,6 +565,77 @@ lora_prefetch_seconds = _get_or_create(
 )
 
 
+# ---- telemetry signal layer (ISSUE 16, telemetry/): per-tenant cost
+# attribution from the request ledger, per-class SLO attainment/burn,
+# and the live efficiency gauges the elastic control plane (ROADMAP
+# item 4) keys its placement/capacity decisions off.
+
+tenant_cost_tokens_total = _get_or_create(
+    Counter,
+    f"{_PREFIX}_tenant_cost_tokens_total",
+    "Tokens (prompt + generated) billed to each tenant and request "
+    "class by the cost ledger at terminal outcome "
+    "(telemetry/ledger.py; tenant labels bounded, overflow → 'other')",
+    labelnames=("tenant", "class"),
+)
+tenant_cost_hbm_page_seconds_total = _get_or_create(
+    Counter,
+    f"{_PREFIX}_tenant_cost_hbm_page_seconds_total",
+    "KV page-seconds of device HBM held per tenant and request class "
+    "(pages owned x wall seconds, sampled at each commit boundary) — "
+    "the memory-occupancy half of cost attribution",
+    labelnames=("tenant", "class"),
+)
+tenant_cost_tier_bytes_total = _get_or_create(
+    Counter,
+    f"{_PREFIX}_tenant_cost_tier_bytes_total",
+    "Host KV-tier bytes moved (demotions + promotions) on behalf of "
+    "each tenant and request class",
+    labelnames=("tenant", "class"),
+)
+slo_attainment = _get_or_create(
+    Gauge,
+    f"{_PREFIX}_slo_attainment",
+    "Fraction of recent (5m window) observations inside each declared "
+    "objective, per request class (telemetry/slo.py; objective = "
+    "ttft | itl | availability; 1.0 with no traffic)",
+    labelnames=("class", "objective"),
+)
+slo_burn_rate = _get_or_create(
+    Gauge,
+    f"{_PREFIX}_slo_burn_rate",
+    "Worst per-objective error-budget burn rate per request class and "
+    "sliding window (5m/1h): bad_fraction / error_budget — 1.0 burns "
+    "the budget exactly at the exhaustion rate, >1.0 is the paging "
+    "threshold",
+    labelnames=("class", "window"),
+)
+spec_acceptance_rate_ewma = _get_or_create(
+    Gauge,
+    f"{_PREFIX}_spec_acceptance_rate_ewma",
+    "Time-decayed (30s half-life) EWMA of the per-dispatch speculative "
+    "acceptance rate, per dp replica — the responsive signal the "
+    "gamma auto-tuner consumes (lifetime rate: spec_acceptance_rate)",
+    labelnames=("replica",),
+)
+model_tflops_per_s = _get_or_create(
+    Gauge,
+    f"{_PREFIX}_model_tflops_per_s",
+    "Achieved model TFLOP/s per dp replica from the live committed-"
+    "token rate (telemetry/mfu.py: ~2 FLOPs/weight/token, the "
+    "standard MFU numerator)",
+    labelnames=("replica",),
+)
+mfu = _get_or_create(
+    Gauge,
+    f"{_PREFIX}_mfu",
+    "Model FLOPs utilization per dp replica: achieved model FLOP/s "
+    "over the TGIS_PEAK_TFLOPS-declared per-chip peak; exported only "
+    "when the operator sets the peak (the CPU proxy has none)",
+    labelnames=("replica",),
+)
+
+
 class _StepSnapshot:
     """Host-side mirror of the latest per-dispatch shape stats, so the
     periodic stats log line (engine/async_llm.py) can report them without

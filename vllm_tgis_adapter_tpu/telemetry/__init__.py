@@ -1,0 +1,48 @@
+"""The signal layer for the elastic control plane (docs/OBSERVABILITY.md).
+
+Three composable pieces, each consumable on its own:
+
+* :mod:`~vllm_tgis_adapter_tpu.telemetry.ledger` — per-request cost
+  accounting closed exactly once at the terminal outcome, rolled up
+  into bounded per-tenant aggregates (``tenant_cost_*`` metrics, the
+  ``ledger`` /debug/state section, ``ledger`` flight-recorder events,
+  and the ``--ledger-log`` JSONL sink);
+* :mod:`~vllm_tgis_adapter_tpu.telemetry.slo` — declarative per-class
+  objectives (``--slo-config``) with multi-window attainment and
+  error-budget burn-rate gauges fed from the same observation points
+  the request-latency histograms use;
+* :mod:`~vllm_tgis_adapter_tpu.telemetry.ewma` /
+  :mod:`~vllm_tgis_adapter_tpu.telemetry.mfu` — the decayed-EWMA and
+  model-FLOPs primitives behind the live ``spec_acceptance_rate_ewma``
+  and ``mfu``/``model_tflops_per_s`` gauges.
+
+ROADMAP item 4 (the fleet reshaping itself under live load) keys its
+placement/role/capacity decisions off exactly these signals; trace
+capture (``--capture-trace``) + ``tools/trace_replay.py`` make every
+decision replayable against recorded or synthesized traffic.
+"""
+
+from vllm_tgis_adapter_tpu.telemetry.ewma import DecayedEwma, TokenRateEwma
+from vllm_tgis_adapter_tpu.telemetry.ledger import (
+    CostLedger,
+    CostRecord,
+    JsonlSink,
+)
+from vllm_tgis_adapter_tpu.telemetry.mfu import flops_per_token
+from vllm_tgis_adapter_tpu.telemetry.slo import (
+    REQUEST_CLASSES,
+    SloEngine,
+    resolve_request_class,
+)
+
+__all__ = [
+    "REQUEST_CLASSES",
+    "CostLedger",
+    "CostRecord",
+    "DecayedEwma",
+    "JsonlSink",
+    "SloEngine",
+    "TokenRateEwma",
+    "flops_per_token",
+    "resolve_request_class",
+]

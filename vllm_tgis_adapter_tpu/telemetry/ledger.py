@@ -1,0 +1,388 @@
+"""Per-request cost ledger (docs/OBSERVABILITY.md "Cost ledger").
+
+One :class:`CostRecord` opens per admitted generation request and
+closes **exactly once** at its terminal outcome — ``finish``, ``abort``,
+``shed``, or ``failed``.  The record accumulates everything a
+cost-attribution or capacity decision needs: the queue/prefill/decode
+wall split, tokens in/out, KV page-seconds held in HBM (sampled at
+commit), host-tier bytes moved on its behalf, adapter swaps and
+speculative propose/accept counts attributable to it, and the
+restarts/resumes/handoffs it survived.
+
+The ledger lives on the **fleet-level** async engine, not a replica's
+engine core: supervised restarts and cross-replica resumes swap engine
+cores underneath a request, but its open record stays put — a migrated
+request bills once (ISSUE 16 acceptance).  Aggregates are bounded per
+tenant (the frontdoor's 64-label discipline) and exported as the
+``tenant_cost_{tokens,hbm_page_seconds,tier_bytes}_total{tenant,class}``
+counters, a ``ledger`` /debug/state section, ``ledger`` flight-recorder
+events, and an optional ``--ledger-log`` JSONL sink (written via
+``asyncio.to_thread`` — no sync I/O on the event loop, tpulint-clean).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import json
+import threading
+import time
+from typing import Any, Callable, Optional
+
+from vllm_tgis_adapter_tpu import metrics
+from vllm_tgis_adapter_tpu.logging import init_logger
+
+logger = init_logger(__name__)
+
+#: Terminal outcomes a record can close with.
+OUTCOMES = ("finish", "abort", "shed", "failed")
+
+#: Bounded-cardinality guard for the ``tenant`` metric label — same
+#: budget the front door applies (frontdoor/admission.py); tenants past
+#: the cap aggregate under ``other`` so a tenant-id flood cannot blow
+#: up the registry.
+_MAX_TENANT_LABELS = 64
+_OVERFLOW_TENANT = "other"
+
+DEFAULT_TENANT = "default"
+DEFAULT_CLASS = "chat"
+
+
+@dataclasses.dataclass
+class CostRecord:
+    """One request's accounting, open from admission to terminal
+    outcome.  All float fields are seconds; ``tier_bytes`` counts host
+    KV-tier bytes moved on the request's behalf (demote + promote)."""
+
+    request_id: str
+    tenant: str
+    request_class: str
+    arrival_time: float  # wall clock (time.time)
+    tokens_in: int = 0
+    tokens_out: int = 0
+    queue_s: float = 0.0
+    prefill_s: float = 0.0
+    decode_s: float = 0.0
+    hbm_page_seconds: float = 0.0
+    tier_bytes: int = 0
+    adapter_swaps: int = 0
+    spec_proposed: int = 0
+    spec_accepted: int = 0
+    restarts: int = 0
+    resumes: int = 0
+    handoffs: int = 0
+    lora_name: Optional[str] = None
+    shed_reason: Optional[str] = None
+    outcome: Optional[str] = None  # set exactly once, at close
+
+    def to_dict(self) -> dict[str, Any]:
+        d = dataclasses.asdict(self)
+        # round the floats: the JSONL sink is an accounting log, not a
+        # profiler — 6 decimals (µs) is already below timer noise
+        for k in ("queue_s", "prefill_s", "decode_s", "hbm_page_seconds"):
+            d[k] = round(d[k], 6)
+        return d
+
+
+def _blank_totals() -> dict[str, float]:
+    return {
+        "requests": 0,
+        "tokens_in": 0,
+        "tokens_out": 0,
+        "hbm_page_seconds": 0.0,
+        "tier_bytes": 0,
+        "sheds": 0,
+        "restarts": 0,
+        "resumes": 0,
+    }
+
+
+class JsonlSink:
+    """Append-only JSONL file fed from the event loop without blocking
+    it: ``append`` only serializes into a buffer; the actual write runs
+    in :func:`asyncio.to_thread` from ``flush`` (spawned via the
+    spawn_task discipline by the owner)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._buffer: list[str] = []
+        self._lock = threading.Lock()
+
+    @property
+    def pending(self) -> int:
+        return len(self._buffer)
+
+    def append(self, obj: dict) -> None:
+        try:
+            line = json.dumps(obj, default=str)
+        except (TypeError, ValueError):  # pragma: no cover — defensive
+            logger.exception("unserializable ledger record dropped")
+            return
+        with self._lock:
+            # extend, not .append: a bare .append call under the lock
+            # aliases this method's own name in interprocedural lock
+            # analysis (tpulint TPL402)
+            self._buffer.extend((line,))
+
+    async def flush(self) -> None:
+        with self._lock:
+            lines, self._buffer = self._buffer, []
+        if not lines:
+            return
+        try:
+            await asyncio.to_thread(self._write, lines)
+        except Exception:  # noqa: BLE001 — telemetry must never raise
+            logger.exception("ledger JSONL flush to %s failed", self.path)
+
+    def flush_sync(self) -> None:
+        """Synchronous drain for non-async owners (tools, tests)."""
+        with self._lock:
+            lines, self._buffer = self._buffer, []
+        if lines:
+            self._write(lines)
+
+    def _write(self, lines: list[str]) -> None:
+        with open(self.path, "a") as f:
+            f.write("\n".join(lines) + "\n")
+
+
+class CostLedger:
+    """Fleet-level request cost accounting (see module docstring).
+
+    Every ``note_*`` hook is a silent no-op for request ids with no
+    open record — precompile warmups and direct core users never open
+    one, and a hook landing after close (a late tier transfer) must not
+    resurrect the record.  ``close`` is idempotent: the first call wins,
+    later calls return None.
+    """
+
+    def __init__(
+        self,
+        sink: Optional[JsonlSink] = None,
+        recorder: Optional[Callable[..., None]] = None,
+    ):
+        self._open: dict[str, CostRecord] = {}
+        # (tenant_label, class) -> totals; tenant labels bounded
+        self._agg: dict[tuple[str, str], dict[str, float]] = {}
+        self._tenant_labels: set[str] = set()
+        self.closed_total = 0
+        self.by_outcome: dict[str, int] = dict.fromkeys(OUTCOMES, 0)
+        self.sink = sink
+        # FlightRecorder.record-shaped callable (replica 0's recorder);
+        # attached by the async engine after construction
+        self.recorder = recorder
+
+    # ------------------------------------------------------------ lifecycle
+
+    def open(
+        self,
+        request_id: str,
+        *,
+        tenant: Optional[str],
+        request_class: str = DEFAULT_CLASS,
+        tokens_in: int = 0,
+        lora_name: Optional[str] = None,
+    ) -> Optional[CostRecord]:
+        if request_id in self._open:
+            # duplicate request_id racing admission (the async engine
+            # rejects the latecomer after it parks): the FIRST record is
+            # the live request's — never clobber it.  None tells the
+            # caller its request owns no record (so it must not close).
+            return None
+        rec = CostRecord(
+            request_id=request_id,
+            tenant=tenant or DEFAULT_TENANT,
+            request_class=request_class,
+            arrival_time=time.time(),
+            tokens_in=tokens_in,
+            lora_name=lora_name,
+        )
+        self._open[request_id] = rec
+        return rec
+
+    def get(self, request_id: str) -> Optional[CostRecord]:
+        return self._open.get(request_id)
+
+    def close(
+        self,
+        request_id: str,
+        outcome: str,
+        request_metrics=None,  # noqa: ANN001 — RequestMetrics duck-typed
+        step: int = 0,
+    ) -> Optional[CostRecord]:
+        """Close the open record (idempotent — None when already
+        closed).  A shed noted earlier wins over the caller's outcome:
+        the stream-level exit of a TTL-shed request looks like an
+        abort, but the request was refused, not cancelled."""
+        rec = self._open.pop(request_id, None)
+        if rec is None:
+            return None
+        if rec.shed_reason is not None:
+            outcome = "shed"
+        rec.outcome = outcome if outcome in OUTCOMES else "failed"
+        m = request_metrics
+        if m is not None:
+            arrival = getattr(m, "arrival_time", None) or rec.arrival_time
+            scheduled = getattr(m, "first_scheduled_time", None)
+            first_tok = getattr(m, "first_token_time", None)
+            last_tok = getattr(m, "last_token_time", None)
+            tq = getattr(m, "time_in_queue", None)
+            if tq is not None:
+                rec.queue_s = max(0.0, tq)
+            elif scheduled is not None:
+                rec.queue_s = max(0.0, scheduled - arrival)
+            if scheduled is not None and first_tok is not None:
+                rec.prefill_s = max(0.0, first_tok - scheduled)
+            if first_tok is not None and last_tok is not None:
+                rec.decode_s = max(0.0, last_tok - first_tok)
+        self._fold(rec)
+        self._export(rec)
+        if self.recorder is not None:
+            try:
+                self.recorder(
+                    "ledger", request_id, step=step,
+                    outcome=rec.outcome, tenant=rec.tenant,
+                    request_class=rec.request_class,
+                    tokens_in=rec.tokens_in, tokens_out=rec.tokens_out,
+                    restarts=rec.restarts, resumes=rec.resumes,
+                )
+            except Exception:  # noqa: BLE001 — telemetry must never raise
+                logger.exception("ledger flight-recorder event failed")
+        if self.sink is not None:
+            self.sink.append(rec.to_dict())
+        return rec
+
+    # ------------------------------------------------------- note_* hooks
+
+    def note_shed(self, request_id: str, reason: str) -> None:
+        rec = self._open.get(request_id)
+        if rec is not None:
+            rec.shed_reason = reason
+
+    def note_tokens_out(self, request_id: str, n: int) -> None:
+        rec = self._open.get(request_id)
+        if rec is not None:
+            rec.tokens_out += n
+
+    def note_tokens_in(self, request_id: str, n: int) -> None:
+        rec = self._open.get(request_id)
+        if rec is not None:
+            rec.tokens_in = n
+
+    def note_tier_bytes(self, request_id: str, nbytes: int) -> None:
+        rec = self._open.get(request_id)
+        if rec is not None:
+            rec.tier_bytes += int(nbytes)
+
+    def note_adapter_swap(self, request_id: str) -> None:
+        rec = self._open.get(request_id)
+        if rec is not None:
+            rec.adapter_swaps += 1
+
+    def note_spec(
+        self, request_id: str, proposed: int, accepted: int
+    ) -> None:
+        rec = self._open.get(request_id)
+        if rec is not None:
+            rec.spec_proposed += proposed
+            rec.spec_accepted += accepted
+
+    def note_restart(self, request_id: str) -> None:
+        rec = self._open.get(request_id)
+        if rec is not None:
+            rec.restarts += 1
+
+    def note_resume(self, request_id: str, path: str = "local") -> None:
+        rec = self._open.get(request_id)
+        if rec is not None:
+            rec.resumes += 1
+            if path == "handoff":
+                rec.handoffs += 1
+
+    def sample_kv(
+        self, pages_by_request: dict[str, int], dt_s: float
+    ) -> None:
+        """Fold one commit-boundary HBM occupancy sample: each open
+        request holding ``pages`` KV pages for the ``dt_s`` seconds
+        since the replica's previous sample accrues ``pages * dt_s``
+        page-seconds."""
+        if dt_s <= 0:
+            return
+        for rid, pages in pages_by_request.items():
+            rec = self._open.get(rid)
+            if rec is not None and pages > 0:
+                rec.hbm_page_seconds += pages * dt_s
+
+    # ----------------------------------------------------------- aggregates
+
+    def _tenant_label(self, tenant: str) -> str:
+        if tenant in self._tenant_labels:
+            return tenant
+        if len(self._tenant_labels) < _MAX_TENANT_LABELS:
+            self._tenant_labels.add(tenant)
+            return tenant
+        return _OVERFLOW_TENANT
+
+    def _fold(self, rec: CostRecord) -> None:
+        self.closed_total += 1
+        self.by_outcome[rec.outcome] = (
+            self.by_outcome.get(rec.outcome, 0) + 1
+        )
+        key = (self._tenant_label(rec.tenant), rec.request_class)
+        totals = self._agg.get(key)
+        if totals is None:
+            totals = self._agg[key] = _blank_totals()
+        totals["requests"] += 1
+        totals["tokens_in"] += rec.tokens_in
+        totals["tokens_out"] += rec.tokens_out
+        totals["hbm_page_seconds"] += rec.hbm_page_seconds
+        totals["tier_bytes"] += rec.tier_bytes
+        if rec.outcome == "shed":
+            totals["sheds"] += 1
+        totals["restarts"] += rec.restarts
+        totals["resumes"] += rec.resumes
+
+    def _export(self, rec: CostRecord) -> None:
+        tenant = self._tenant_label(rec.tenant)
+        cls = rec.request_class
+        try:
+            # positional labels: "class" is a Python keyword, so the
+            # kwargs form cannot spell the second label name
+            metrics.tenant_cost_tokens_total.labels(tenant, cls).inc(
+                rec.tokens_in + rec.tokens_out
+            )
+            if rec.hbm_page_seconds > 0:
+                metrics.tenant_cost_hbm_page_seconds_total.labels(
+                    tenant, cls
+                ).inc(rec.hbm_page_seconds)
+            if rec.tier_bytes > 0:
+                metrics.tenant_cost_tier_bytes_total.labels(
+                    tenant, cls
+                ).inc(rec.tier_bytes)
+        except Exception:  # noqa: BLE001 — telemetry must never raise
+            logger.exception("ledger metric export failed")
+
+    # ---------------------------------------------------------- inspection
+
+    @property
+    def open_count(self) -> int:
+        return len(self._open)
+
+    def tenant_totals(self) -> dict[str, dict[str, dict[str, float]]]:
+        """{tenant: {class: totals}} — bounded by the label budget."""
+        out: dict[str, dict[str, dict[str, float]]] = {}
+        for (tenant, cls), totals in sorted(self._agg.items()):
+            out.setdefault(tenant, {})[cls] = {
+                k: (round(v, 6) if isinstance(v, float) else v)
+                for k, v in totals.items()
+            }
+        return out
+
+    def debug_state(self) -> dict[str, Any]:
+        return {
+            "open": self.open_count,
+            "closed_total": self.closed_total,
+            "by_outcome": dict(self.by_outcome),
+            "tenants": self.tenant_totals(),
+            "sink_pending": self.sink.pending if self.sink else 0,
+        }

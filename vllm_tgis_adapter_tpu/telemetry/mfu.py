@@ -1,0 +1,45 @@
+"""Model-FLOPs utilization math (shared by the live gauges and bench).
+
+``flops_per_token`` was born as a tools/scenarios.py stamp helper;
+promoting it here lets the step loop export live ``mfu{replica}`` /
+``model_tflops_per_s{replica}`` gauges from the same numerator the
+bench suites stamp into their result lines — one convention, two
+consumers, no drift.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def flops_per_token(mcfg) -> float:  # noqa: ANN001 — ModelConfig duck-typed
+    """~2 FLOPs per weight per token (attention projections, MLP, and
+    the LM head; attention score FLOPs and embedding gathers omitted —
+    the standard MFU numerator convention)."""
+    d, dh = mcfg.hidden_size, mcfg.head_dim
+    h, hkv, f = mcfg.num_heads, mcfg.num_kv_heads, mcfg.intermediate_size
+    per_layer = 2 * (
+        d * h * dh          # q_proj
+        + 2 * d * hkv * dh  # k/v_proj
+        + h * dh * d        # o_proj
+        + 3 * d * f         # gate/up/down
+    )
+    return float(
+        mcfg.num_layers * per_layer + 2 * d * mcfg.vocab_size
+    )
+
+
+def peak_tflops() -> float:
+    """Operator-declared per-chip peak (``TGIS_PEAK_TFLOPS``, e.g. 197
+    for v5e bf16); 0.0 when unset OR unparseable — the CPU proxy has
+    no meaningful peak, so the ``mfu`` gauge stays unexported there
+    while ``model_tflops_per_s`` still reports the achieved numerator,
+    and an operator typo degrades the ratio, never the gauge refresh."""
+    try:
+        return max(0.0, float(os.environ.get("TGIS_PEAK_TFLOPS", 0) or 0))
+    except ValueError:
+        return 0.0
+
+
+def achieved_tflops(tok_per_s: float, mcfg) -> float:  # noqa: ANN001
+    return flops_per_token(mcfg) * max(tok_per_s, 0.0) / 1e12

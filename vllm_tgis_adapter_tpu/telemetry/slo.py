@@ -1,0 +1,298 @@
+"""Per-class SLO attainment and error-budget burn rate
+(docs/OBSERVABILITY.md "SLO engine").
+
+Objectives are declarative (``--slo-config`` JSON, per request class
+``chat | rag | batch``): a TTFT p99 target, an ITL p99 target, and an
+availability target.  The engine feeds from the SAME observation points
+the request-latency histograms use (engine/core.py
+``_process_sampled``) plus the terminal outcome at ledger close, keeps
+multi-window (5m / 1h) sliding windows, and exports
+
+* ``slo_attainment{class,objective}`` — fraction of recent
+  observations inside the objective (5m window), and
+* ``slo_burn_rate{class,window}`` — the worst per-objective
+  error-budget burn: ``bad_fraction / (1 - target_fraction)``; 1.0
+  means the budget burns exactly at the rate that exhausts it at the
+  window's end, >1.0 means faster (the alerting threshold).
+
+Request class resolves at admission from an explicit
+``x-request-class`` header or the prompt/decode token shape, and rides
+on ``Sequence`` (and the decode checkpoint) so restarts and resumes
+keep billing and SLO accounting under the original class.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from collections import deque
+from typing import Callable, Iterable, Mapping, Optional
+
+from vllm_tgis_adapter_tpu import metrics
+from vllm_tgis_adapter_tpu.logging import init_logger
+
+logger = init_logger(__name__)
+
+REQUEST_CLASSES = ("chat", "rag", "batch")
+
+#: header that pins the class explicitly (wins over the shape heuristic)
+CLASS_HEADER = "x-request-class"
+
+OBJECTIVES = ("ttft", "itl", "availability")
+
+#: (label, span) sliding windows — the short one drives paging-speed
+#: alerts, the long one page-out-speed alerts (multi-window burn).
+WINDOWS = (("5m", 300.0), ("1h", 3600.0))
+
+# Conservative CPU-proxy-meetable defaults; production operators
+# declare real targets via --slo-config.  Latency objectives are p99
+# (1% error budget); availability is the classic request-success SLO.
+DEFAULT_OBJECTIVES: dict[str, dict[str, float]] = {
+    "chat": {"ttft_p99_s": 10.0, "itl_p99_s": 2.0, "availability": 0.999},
+    "rag": {"ttft_p99_s": 30.0, "itl_p99_s": 2.0, "availability": 0.999},
+    "batch": {"ttft_p99_s": 120.0, "itl_p99_s": 10.0,
+              "availability": 0.99},
+}
+
+#: per-(class, objective, window) sample cap — ~2.7k ITL samples/s at
+#: full tilt would otherwise grow the 1h deque unboundedly; the cap
+#: keeps memory bounded and still spans minutes of saturated serving
+_MAX_SAMPLES = 65536
+
+
+def resolve_request_class(
+    trace_headers: Optional[Mapping[str, str]],
+    prompt_tokens: int,
+    max_tokens: Optional[int],
+) -> str:
+    """Admission-time class resolution: an explicit ``x-request-class``
+    header wins; otherwise the token shape decides — prompt-heavy
+    requests (long context, short answer) are ``rag``, very long
+    decodes are ``batch``, everything else is ``chat``.  Deterministic
+    and unit-tested (tests/test_telemetry.py)."""
+    if trace_headers:
+        for k, v in trace_headers.items():
+            if k.lower() == CLASS_HEADER:
+                cls = str(v).strip().lower()
+                if cls in REQUEST_CLASSES:
+                    return cls
+                break
+    out = max_tokens if max_tokens is not None else 16
+    if prompt_tokens >= 256 and prompt_tokens >= 4 * max(1, out):
+        return "rag"
+    if out >= 512:
+        return "batch"
+    return "chat"
+
+
+def parse_slo_config(raw: Optional[str]) -> dict[str, dict[str, float]]:
+    """``--slo-config`` JSON (a path or an inline object) → per-class
+    objectives, defaults filled per missing class/field.  Malformed
+    input degrades to the defaults with a logged warning — a bad
+    operator config must not take serving down."""
+    objectives = {
+        cls: dict(vals) for cls, vals in DEFAULT_OBJECTIVES.items()
+    }
+    if not raw:
+        return objectives
+    try:
+        text = raw.strip()
+        if not text.startswith("{"):
+            with open(text) as f:
+                text = f.read()
+        declared = json.loads(text)
+        if not isinstance(declared, dict):
+            raise ValueError("--slo-config must be a JSON object")
+        for cls, vals in declared.items():
+            if cls not in objectives or not isinstance(vals, dict):
+                logger.warning("--slo-config: ignoring unknown class %r",
+                               cls)
+                continue
+            for key in ("ttft_p99_s", "itl_p99_s", "availability"):
+                if key in vals:
+                    objectives[cls][key] = float(vals[key])
+    except Exception:  # noqa: BLE001 — config errors degrade, not crash
+        logger.exception(
+            "--slo-config %r unparseable; serving with default "
+            "objectives", raw,
+        )
+    return objectives
+
+
+class _Window:
+    """One sliding window of (t, good) observations."""
+
+    __slots__ = ("span_s", "samples")
+
+    def __init__(self, span_s: float):
+        self.span_s = span_s
+        self.samples: deque[tuple[float, bool]] = deque(
+            maxlen=_MAX_SAMPLES
+        )
+
+    def observe(self, t: float, good: bool) -> None:
+        self.samples.append((t, good))
+
+    def prune(self, now: float) -> None:
+        cutoff = now - self.span_s
+        s = self.samples
+        while s and s[0][0] < cutoff:
+            s.popleft()
+
+    def stats(self, now: float) -> tuple[int, int]:
+        """(total, good) inside the window."""
+        self.prune(now)
+        good = sum(1 for _, g in self.samples if g)
+        return len(self.samples), good
+
+
+class SloEngine:
+    """Sliding-window attainment + burn-rate accounting per request
+    class.  All hooks run on the event-loop thread (the same thread the
+    engine cores commit on); nothing here blocks or allocates beyond
+    the bounded deques."""
+
+    def __init__(
+        self,
+        objectives: Optional[dict[str, dict[str, float]]] = None,
+        timer: Callable[[], float] = time.monotonic,
+    ):
+        self.objectives = objectives or {
+            cls: dict(vals) for cls, vals in DEFAULT_OBJECTIVES.items()
+        }
+        self._timer = timer
+        # (class, objective) -> {window_label: _Window}
+        self._windows: dict[tuple[str, str], dict[str, _Window]] = {
+            (cls, obj): {
+                label: _Window(span) for label, span in WINDOWS
+            }
+            for cls in self.objectives
+            for obj in OBJECTIVES
+        }
+        self.observed_total = 0
+
+    # ------------------------------------------------------------- feeding
+
+    def _observe(self, cls: str, objective: str, good: bool) -> None:
+        windows = self._windows.get((cls, objective))
+        if windows is None:  # unknown class — never raise on the path
+            return
+        now = self._timer()
+        self.observed_total += 1
+        for w in windows.values():
+            w.observe(now, good)
+
+    def observe_ttft(self, cls: str, seconds: float) -> None:
+        target = self.objectives.get(cls, {}).get("ttft_p99_s")
+        if target is not None:
+            self._observe(cls, "ttft", seconds <= target)
+
+    def observe_itl(self, cls: str, seconds: float) -> None:
+        target = self.objectives.get(cls, {}).get("itl_p99_s")
+        if target is not None:
+            self._observe(cls, "itl", seconds <= target)
+
+    def observe_outcome(self, cls: str, outcome: str) -> None:
+        """Availability feed at ledger close: ``finish`` counts good,
+        ``shed``/``failed`` count bad (the server refused or broke),
+        ``abort`` is excluded — a client hanging up is not the
+        server's unavailability."""
+        if outcome == "abort":
+            return
+        self._observe(cls, "availability", outcome == "finish")
+
+    # ------------------------------------------------------------- reading
+
+    def _budget(self, cls: str, objective: str) -> float:
+        """Error-budget fraction: 1% for the p99 latency objectives,
+        ``1 - availability`` for availability."""
+        if objective == "availability":
+            avail = self.objectives.get(cls, {}).get("availability", 0.999)
+            return max(1e-6, 1.0 - avail)
+        return 0.01
+
+    def attainment(
+        self, cls: str, objective: str, window: str = "5m"
+    ) -> float:
+        """Good fraction inside the window; 1.0 with no observations
+        (no traffic is not an SLO violation)."""
+        windows = self._windows.get((cls, objective))
+        if windows is None or window not in windows:
+            return 1.0
+        total, good = windows[window].stats(self._timer())
+        return good / total if total else 1.0
+
+    def burn_rate(self, cls: str, window: str = "5m") -> float:
+        """Worst per-objective error-budget burn in the window."""
+        worst = 0.0
+        for objective in OBJECTIVES:
+            bad = 1.0 - self.attainment(cls, objective, window)
+            worst = max(worst, bad / self._budget(cls, objective))
+        return worst
+
+    # ------------------------------------------------------------- export
+
+    def refresh_gauges(self) -> None:
+        """Publish attainment (5m) + burn (every window) for every
+        declared class — called from the engine's gauge refresh so the
+        scrape always sees a complete, current matrix."""
+        try:
+            for cls in self.objectives:
+                for objective in OBJECTIVES:
+                    metrics.slo_attainment.labels(cls, objective).set(
+                        self.attainment(cls, objective, "5m")
+                    )
+                for label, _ in WINDOWS:
+                    metrics.slo_burn_rate.labels(cls, label).set(
+                        self.burn_rate(cls, label)
+                    )
+        except Exception:  # noqa: BLE001 — telemetry must never raise
+            logger.exception("SLO gauge refresh failed")
+
+    def stats_fragment(self) -> str:
+        """Compact per-class burn summary for the periodic stats log
+        line: ``slo burn(5m) chat 0.00 rag 0.00 batch 0.00``."""
+        parts = " ".join(
+            f"{cls} {self.burn_rate(cls, '5m'):.2f}"
+            for cls in self.objectives
+        )
+        return f"slo burn(5m) {parts}"
+
+    def debug_state(self) -> dict:
+        now = self._timer()
+        out: dict = {"observed_total": self.observed_total, "classes": {}}
+        for cls, targets in self.objectives.items():
+            entry: dict = {"objectives": dict(targets), "windows": {}}
+            for label, _ in WINDOWS:
+                per_obj = {}
+                for objective in OBJECTIVES:
+                    w = self._windows[(cls, objective)][label]
+                    total, good = w.stats(now)
+                    per_obj[objective] = {
+                        "samples": total,
+                        "attainment": round(
+                            good / total if total else 1.0, 6
+                        ),
+                    }
+                entry["windows"][label] = {
+                    "burn_rate": round(self.burn_rate(cls, label), 6),
+                    **per_obj,
+                }
+            out["classes"][cls] = entry
+        return out
+
+
+def estimate_tokens(
+    prompt_token_ids: Optional[Iterable[int]],
+    prompt: Optional[str],
+) -> int:
+    """Cheap admission-time prompt-size estimate for class resolution
+    when only raw text is available (~4 chars/token heuristic)."""
+    if prompt_token_ids is not None:
+        try:
+            return len(prompt_token_ids)  # type: ignore[arg-type]
+        except TypeError:
+            return sum(1 for _ in prompt_token_ids)
+    if prompt:
+        return max(1, len(prompt) // 4)
+    return 1

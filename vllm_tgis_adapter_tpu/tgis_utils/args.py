@@ -436,6 +436,23 @@ def add_engine_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     g = parser.add_argument_group("observability")
     g.add_argument("--otlp-traces-endpoint", type=str, default=None,
                    help="OTLP endpoint; enables trace-context propagation")
+    g.add_argument("--slo-config", type=str, default=None,
+                   help="per-class SLO objectives (telemetry/slo.py): "
+                        "inline JSON object or a path to one, keyed by "
+                        "request class (chat|rag|batch) with "
+                        "ttft_p99_s / itl_p99_s / availability; unset "
+                        "uses built-in defaults")
+    g.add_argument("--ledger-log", type=str, default=None,
+                   help="JSONL sink for closed request cost-ledger "
+                        "records (telemetry/ledger.py): one line per "
+                        "terminal request with wall-time splits, token "
+                        "counts, KV page-seconds, tier bytes, and "
+                        "recovery counts")
+    g.add_argument("--capture-trace", type=str, default=None,
+                   help="JSONL sink capturing admitted traffic shape "
+                        "(arrival offsets, token counts, tenant/class/"
+                        "adapter, sampling params — never content) for "
+                        "tools/trace_replay.py")
     g.add_argument("--jax-profiler-port", type=int, default=None,
                    help="start a jax.profiler server on this port "
                         "(connect with TensorBoard/XProf to capture "

@@ -109,6 +109,10 @@ class Span:
     kind: int = SPAN_KIND_SERVER
     # OTLP span events: (name, time_unix_nano) — preemption/swap markers
     events: list = dataclasses.field(default_factory=list)
+    # OTLP span links: (trace_id, span_id) — a resume/handoff span
+    # LINKS to the originating request span (sharing a trace_id alone
+    # is not a queryable relationship in most backends)
+    links: list = dataclasses.field(default_factory=list)
 
     def otlp_json(self) -> dict:
         def value(v: object) -> dict:
@@ -144,6 +148,16 @@ class Span:
                     ]
                 }
                 if self.events
+                else {}
+            ),
+            **(
+                {
+                    "links": [
+                        {"traceId": tid, "spanId": sid}
+                        for tid, sid in self.links
+                    ]
+                }
+                if self.links
                 else {}
             ),
         }
@@ -302,6 +316,31 @@ class RequestTracer:
                 for child in self._phase_children(span, metrics):
                     self._exporter.export(child)
         self._exporter.export(span)
+
+    def resume_span(
+        self, origin: Span, request_id: str, path: str
+    ) -> Span:
+        """One marker span per recovery hop (``path = local |
+        cross_replica | handoff``), exported immediately: it joins the
+        origin's trace AND carries an explicit span LINK to the
+        originating request span, so a backend can query "every
+        request this migration touched" without trace_id string
+        matching.  Zero-duration by design — the recovery cost itself
+        is visible in the restart/handoff histograms."""
+        now = time.time_ns()
+        span = Span(
+            name="llm_request.resume",
+            trace_id=origin.trace_id,
+            span_id=secrets.token_hex(8),
+            parent_span_id=origin.span_id,
+            start_ns=now,
+            end_ns=now,
+            kind=SPAN_KIND_INTERNAL,
+            attributes={"gen_ai.request.id": request_id, "path": path},
+            links=[(origin.trace_id, origin.span_id)],
+        )
+        self._exporter.export(span)
+        return span
 
     @staticmethod
     def _phase_children(parent: Span, m: "RequestMetrics") -> list[Span]:
