@@ -11,8 +11,9 @@ from __future__ import annotations
 import nox
 
 nox.options.sessions = (
-    "lint", "tpulint", "typecheck", "tests", "overload_check", "chaos_check",
-    "chaos_soak", "perf_check", "slo_check",
+    "lint", "tpulint", "race_check", "typecheck", "tests",
+    "overload_check", "chaos_check", "chaos_soak", "perf_check",
+    "slo_check",
 )
 nox.options.reuse_existing_virtualenvs = True
 
@@ -183,7 +184,29 @@ def tpulint(session: nox.Session) -> None:
     codes are scriptable (0/1/2) like tools/obs_check.py."""
     session.run(
         "python", "tools/tpulint/cli.py",
-        *(session.posargs or ["vllm_tgis_adapter_tpu"]),
+        *(session.posargs or ["vllm_tgis_adapter_tpu", "tools/dettest"]),
+    )
+
+
+@nox.session(python="3.12")
+def race_check(session: nox.Session) -> None:
+    """Deterministic async-schedule exploration gate
+    (docs/STATIC_ANALYSIS.md "Deterministic schedule exploration"):
+    run the owned control-plane scenarios (front-door admit/cancel/
+    TTL/drain, supervisor recovery vs SIGTERM, kv-tier promotion vs
+    abort/preempt, adapter-pool prefetch vs evict, ledger terminal
+    close) under tools/dettest's seeded deterministic event loop —
+    >= 50 distinct schedules each, every schedule checked against the
+    scenario invariants AND the lifecycle grammar — plus a bounded
+    co-ready-permutation DFS and a seeded-failpoint proof that a
+    recorded failing seed replays its schedule byte-for-byte.
+    Deterministic (two runs print identical output) and bounded
+    well under 120 s; reproduce one schedule with
+    ``explorer.replay(scenario, seed=N)`` (or ``trace=...``)."""
+    session.install("-e", ".[tests]")
+    session.run(
+        "python", "-m", "tools.dettest.race_check",
+        env={"JAX_PLATFORMS": "cpu", "TGIS_TPU_SANITIZE": "1"},
     )
 
 

@@ -249,6 +249,11 @@ def test_frontdoor_per_replica_throughput_excludes_recovering():
 
 
 def test_frontdoor_queue_full_shed_and_release():
+    # on the dettest DetLoop: the 50 ms park windows and the 5 s release
+    # timeout run on virtual time, so the test costs zero wall-clock and
+    # one deterministic schedule — same assertions as before
+    from tools.dettest.loop import det_run
+
     from vllm_tgis_adapter_tpu.frontdoor.errors import AdmissionShedError
 
     async def scenario():
@@ -282,7 +287,7 @@ def test_frontdoor_queue_full_shed_and_release():
         assert fd.admitted_total == 2 and fd.shed_total == 1
         await fd.shutdown()
 
-    asyncio.run(scenario())
+    det_run(scenario)
 
 
 def test_frontdoor_admission_deadline_shed_uses_capacity_prior():
@@ -325,6 +330,12 @@ def test_frontdoor_tenant_rate_limit():
 
 
 def test_frontdoor_parked_ttl_expiry():
+    # on the dettest DetLoop: the TTL deadline and the pump's backstop
+    # sweep run on virtual time (det_run patches time.time to the
+    # loop's clock), so the expiry fires instantly instead of sleeping
+    # out the real backstop interval — same assertions as before
+    from tools.dettest.loop import det_run
+
     from vllm_tgis_adapter_tpu.frontdoor.errors import AdmissionShedError
 
     async def scenario():
@@ -339,7 +350,7 @@ def test_frontdoor_parked_ttl_expiry():
         assert exc_info.value.reason == "ttl"
         await fd.shutdown()
 
-    asyncio.run(scenario())
+    det_run(scenario)
 
 
 def test_frontdoor_wfq_grant_order_across_tenants():

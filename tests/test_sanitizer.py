@@ -271,6 +271,88 @@ def test_enabled_reads_env(monkeypatch):
     assert sanitizer.enabled()
 
 
+# ------------------------------------------------------ lifecycle grammar
+
+
+def _fresh_recorder():
+    from vllm_tgis_adapter_tpu.flight_recorder import FlightRecorder
+
+    return FlightRecorder()  # grammar tracker state is per-recorder
+
+
+def test_grammar_decode_before_admit_trips(monkeypatch):
+    monkeypatch.setenv(sanitizer.ENV_VAR, "1")
+    monkeypatch.delenv(sanitizer.OBSERVE_ENV_VAR, raising=False)
+    recorder = _fresh_recorder()
+    with pytest.raises(sanitizer.SanitizerError) as exc:
+        recorder.record("decode", "gram-req-7")
+    msg = str(exc.value)
+    assert "gram-req-7" in msg, "message must name the request"
+    assert "<stream start> -> decode" in msg, (
+        "message must name the violated edge"
+    )
+    assert "LIFECYCLE_MANIFEST" in msg
+
+
+def test_grammar_double_ledger_close_trips(monkeypatch):
+    monkeypatch.setenv(sanitizer.ENV_VAR, "1")
+    monkeypatch.delenv(sanitizer.OBSERVE_ENV_VAR, raising=False)
+    recorder = _fresh_recorder()
+    recorder.record("admit", "gram-req-8")
+    recorder.record("finish", "gram-req-8")
+    recorder.record("ledger", "gram-req-8")
+    with pytest.raises(sanitizer.SanitizerError) as exc:
+        recorder.record("ledger", "gram-req-8")
+    msg = str(exc.value)
+    assert "gram-req-8" in msg
+    assert "ledger -> ledger" in msg
+
+
+def test_grammar_legal_stream_passes(monkeypatch):
+    monkeypatch.setenv(sanitizer.ENV_VAR, "1")
+    recorder = _fresh_recorder()
+    for kind in ("admit", "prefill", "decode_progress", "preempt",
+                 "swap_in", "finish", "ledger"):
+        recorder.record(kind, "gram-req-ok")
+    # batch-level kinds carry no request id and stay outside the DFA
+    recorder.record("decode", num_seqs=4)
+
+
+def test_grammar_off_switch(monkeypatch):
+    monkeypatch.setenv(sanitizer.ENV_VAR, "0")
+    recorder = _fresh_recorder()
+    recorder.record("decode", "gram-req-off")  # no raise when disarmed
+
+
+def test_grammar_observe_mode_records_instead_of_raising(
+    tmp_path, monkeypatch
+):
+    monkeypatch.setenv(sanitizer.ENV_VAR, "1")
+    observed = tmp_path / "edges.txt"
+    monkeypatch.setenv(sanitizer.OBSERVE_ENV_VAR, str(observed))
+    monkeypatch.setattr(sanitizer, "_observed", None)
+    recorder = _fresh_recorder()
+    recorder.record("decode", "gram-req-9")  # observed, not raised
+    assert "request: <stream start> -> decode" in observed.read_text()
+
+
+def test_grammar_lifecycle_edges(monkeypatch):
+    monkeypatch.setenv(sanitizer.ENV_VAR, "1")
+    monkeypatch.delenv(sanitizer.OBSERVE_ENV_VAR, raising=False)
+    sanitizer.check_lifecycle_edge(None, "serving")  # boot entry
+    sanitizer.check_lifecycle_edge("serving", "draining")
+    sanitizer.check_lifecycle_edge("recovering", "serving")
+    with pytest.raises(sanitizer.SanitizerError, match="dead -> serving"):
+        sanitizer.check_lifecycle_edge("dead", "serving")
+    # legal in general, forbidden while the front door is draining
+    with pytest.raises(
+        sanitizer.SanitizerError, match="while the front door is draining"
+    ):
+        sanitizer.check_lifecycle_edge(
+            "recovering", "serving", draining=True
+        )
+
+
 # -------------------------------------------------------------- integration
 
 

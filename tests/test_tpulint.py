@@ -286,6 +286,22 @@ FIXTURES: dict[str, tuple[str, str, str]] = {
                     self.swaps = 2
         """,
     ),
+    # --- TPL304 bpo-42130 wait_for(event.wait()) ------------------------
+    "TPL304": (
+        ASYNC_PATH,
+        """
+        import asyncio
+        async def pump(self):
+            await asyncio.wait_for(self._wake.wait(), 1.0)
+        """,
+        """
+        import asyncio
+        async def pump(self):
+            waiter = asyncio.get_running_loop().create_future()
+            self._waiters.append(waiter)
+            await asyncio.wait_for(waiter, 1.0)
+        """,
+    ),
     # --- TPL5xx resource pairing ----------------------------------------
     "TPL501": (
         "pkg/engine/core.py",
@@ -320,6 +336,34 @@ FIXTURES: dict[str, tuple[str, str, str]] = {
                     self._demote(batch), name="demote",
                     retain=self._tasks,
                 )
+        """,
+    ),
+    # --- TPL51x lifecycle grammar ---------------------------------------
+    "TPL511": (
+        "pkg/engine/core.py",
+        """
+        def note(self, rid):
+            self.recorder.record("warp_speed", rid)
+        """,
+        """
+        def note(self, rid):
+            self.recorder.record("admit", rid)
+            self.recorder.record("decode", num_seqs=4)
+        """,
+    ),
+    "TPL512": (
+        "pkg/supervisor/supervisor.py",
+        """
+        from vllm_tgis_adapter_tpu.engine import sanitizer
+        def resurrect(self):
+            sanitizer.check_lifecycle_edge("dead", "serving")
+            self.engine.lifecycle = "serving"
+        """,
+        """
+        from vllm_tgis_adapter_tpu.engine import sanitizer
+        def drain(self):
+            sanitizer.check_lifecycle_edge("serving", "draining")
+            self.engine.lifecycle = "draining"
         """,
     ),
     # --- TPL6xx compile-lattice manifest (per-file half) ----------------
@@ -573,8 +617,12 @@ def test_cli_exit_codes(tmp_path, capsys):
 
 def test_shipped_package_is_tpulint_clean(capsys):
     """The acceptance gate: zero findings, zero unexplained suppressions
-    on the shipped package (same invocation as ``nox -s tpulint``)."""
-    rc = tpulint_main([str(REPO_ROOT / "vllm_tgis_adapter_tpu")])
+    on the shipped package AND the dettest harness (same invocation as
+    ``nox -s tpulint``)."""
+    rc = tpulint_main([
+        str(REPO_ROOT / "vllm_tgis_adapter_tpu"),
+        str(REPO_ROOT / "tools" / "dettest"),
+    ])
     out = capsys.readouterr().out
     assert rc == 0, f"tpulint found hazards:\n{out}"
 
@@ -605,6 +653,66 @@ def test_tpl502_detects_the_pr9_gcd_promotion_task(tmp_path):
         """,
     )
     assert "TPL502" in active_codes(findings)
+
+
+def test_tpl511_batch_kind_with_request_id(tmp_path):
+    """A batch-level kind (no per-request DFA edges) recorded WITH a
+    request_id would enter the per-request stream the grammar
+    deliberately excludes it from — the second TPL511 mode."""
+    findings = lint(
+        tmp_path, "pkg/engine/core.py",
+        """
+        def wave(self, rid):
+            self.recorder.record("decode", request_id=rid, num_seqs=4)
+        """,
+    )
+    assert "TPL511" in active_codes(findings)
+
+
+def test_tpl512_undeclared_state_assignment(tmp_path):
+    """A lifecycle assignment to a state the manifest never declared."""
+    findings = lint(
+        tmp_path, "pkg/supervisor/supervisor.py",
+        """
+        def corrupt(self):
+            self.engine.lifecycle = "zombie"
+        """,
+    )
+    assert "TPL512" in active_codes(findings)
+
+
+def test_tpl512_symbolic_lifecycle_constants_resolve(tmp_path):
+    """LIFECYCLE_* spellings resolve to their lowercase suffix, so the
+    supervisor's symbolic transition sites are checked too."""
+    findings = lint(
+        tmp_path, "pkg/supervisor/supervisor.py",
+        """
+        from vllm_tgis_adapter_tpu.supervisor.lifecycle import (
+            LIFECYCLE_DEAD,
+            LIFECYCLE_SERVING,
+        )
+        from vllm_tgis_adapter_tpu.engine import sanitizer
+        def resurrect(self):
+            sanitizer.check_lifecycle_edge(LIFECYCLE_DEAD, LIFECYCLE_SERVING)
+        """,
+    )
+    assert "TPL512" in active_codes(findings)
+
+
+def test_tpl304_detects_the_pump_shape(tmp_path):
+    """The PR 4 pump-hang shape: wait_for over an admission wake event
+    that may already be set (bpo-42130 on py3.10)."""
+    findings = lint(
+        tmp_path, "pkg/frontdoor/admission.py",
+        """
+        import asyncio
+        class FrontDoor:
+            async def _pump(self):
+                while True:
+                    await asyncio.wait_for(self._wake.wait(), 0.25)
+        """,
+    )
+    assert "TPL304" in active_codes(findings)
 
 
 def test_tpl501_detects_the_unpaired_pin_shape(tmp_path):

@@ -1,0 +1,26 @@
+"""dettest: deterministic async-schedule exploration for the control plane.
+
+Every hard bug in this repo's history was an asyncio *interleaving*
+race (the grant-cancellation slot leak, the duplicate-request_id
+TOCTOU, the bpo-42130 pump hang, the shed-vs-stream terminal race).
+tpulint proves lock discipline statically but cannot see
+schedule-dependent bugs; this package makes them a deterministic,
+replayable, checked-in gate instead of review luck:
+
+* ``loop``     — ``DetLoop``, a seeded deterministic event loop on
+                 virtual time, plus the schedule choosers;
+* ``explorer`` — run a scenario under K seeds (or bounded co-ready
+                 permutation DFS), record failing schedules, replay
+                 them byte-for-byte;
+* ``lifecycle_grammar`` — the reviewed ``LIFECYCLE_MANIFEST``: the
+                 per-request flight-recorder event DFA and the engine
+                 lifecycle machine (enforced statically by tpulint
+                 TPL511/TPL512, at runtime by ``TGIS_TPU_SANITIZE=1``,
+                 and on every explored schedule by the explorer);
+* ``scenarios`` — the concurrency-critical control-plane scenarios
+                 (frontdoor, supervisor, kv-tier, adapter-pool,
+                 ledger) with their invariants;
+* ``race_check`` — the ``nox -s race_check`` gate entry point.
+
+See docs/STATIC_ANALYSIS.md "Deterministic schedule exploration".
+"""

@@ -1,0 +1,239 @@
+"""Schedule explorer: many schedules per scenario, replayable failures.
+
+Two exploration modes over a :class:`~tools.dettest.scenarios.Scenario`:
+
+* :func:`explore` — run the scenario under K seeds
+  (:class:`SeededChooser`); the workhorse for scenarios whose schedule
+  space is too large to enumerate.
+* :func:`explore_exhaustive` — bounded co-ready-permutation DFS
+  (:class:`PrefixChooser` backtracking): enumerate EVERY distinct
+  schedule of a small scenario up to a budget.
+
+Every explored schedule runs the scenario's own invariant ``check`` AND
+replays each recorder's per-request event streams through the lifecycle
+grammar (:func:`~tools.dettest.lifecycle_grammar.verify_request_stream`)
+— a schedule that produces a grammatically impossible stream fails even
+if the scenario's explicit invariants missed it.
+
+A failure is an artifact, not a flake: the :class:`Failure` carries the
+seed (or DFS prefix) and the canonical ``format_trace`` rendering, and
+:func:`replay` re-runs it — by seed, or exactly by trace via
+:class:`TraceChooser` — producing the same schedule byte-for-byte.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable, Optional
+
+from tools.dettest import lifecycle_grammar
+from tools.dettest.loop import (
+    DEFAULT_MAX_STEPS,
+    DEFAULT_TIME_LIMIT_S,
+    Chooser,
+    PrefixChooser,
+    ReplayDivergence,
+    SeededChooser,
+    TraceChooser,
+    det_run,
+    format_trace,
+)
+
+__all__ = [
+    "Failure",
+    "Report",
+    "explore",
+    "explore_exhaustive",
+    "parse_trace",
+    "replay",
+    "run_schedule",
+]
+
+
+@dataclasses.dataclass
+class Failure:
+    """One failing schedule, with everything needed to reproduce it."""
+
+    scenario: str
+    seed: Optional[int]  # None for DFS-enumerated schedules
+    prefix: Optional[list[int]]  # DFS choice prefix when seed is None
+    trace: str  # canonical format_trace rendering
+    error: str  # "ErrorType: message"
+
+    def describe(self) -> str:
+        how = (
+            f"seed={self.seed}"
+            if self.seed is not None
+            else f"prefix={self.prefix}"
+        )
+        return (
+            f"{self.scenario}[{how}]: {self.error}\n  schedule: {self.trace}"
+        )
+
+
+@dataclasses.dataclass
+class Report:
+    """Outcome of exploring one scenario."""
+
+    scenario: str
+    schedules: int = 0  # schedules actually run
+    distinct: set[str] = dataclasses.field(default_factory=set)
+    failures: list[Failure] = dataclasses.field(default_factory=list)
+    exhausted: bool = False  # DFS enumerated the whole space
+
+    @property
+    def distinct_count(self) -> int:
+        return len(self.distinct)
+
+    @property
+    def ok(self) -> bool:
+        return not self.failures
+
+
+def parse_trace(text: str) -> list[tuple[int, int, str]]:
+    """Inverse of ``format_trace`` (labels may not contain ``;``)."""
+    out: list[tuple[int, int, str]] = []
+    if not text:
+        return out
+    for part in text.split(";"):
+        n, idx, label = part.split(":", 2)
+        out.append((int(n), int(idx), label))
+    return out
+
+
+def _verify_grammar(scenario, state) -> None:  # noqa: ANN001
+    """Replay each recorder's per-request kind streams through the DFA."""
+    for recorder in scenario.recorders(state):
+        streams: dict[str, list[str]] = {}
+        for event in recorder._events:  # noqa: SLF001 — explorer owns this view
+            kind, request_id = event[3], event[4]
+            if request_id is not None:
+                streams.setdefault(request_id, []).append(kind)
+        for request_id, kinds in streams.items():
+            lifecycle_grammar.verify_request_stream(kinds, request_id)
+
+
+def run_schedule(
+    scenario,  # noqa: ANN001
+    chooser: Chooser,
+    *,
+    max_steps: int = DEFAULT_MAX_STEPS,
+    time_limit: float = DEFAULT_TIME_LIMIT_S,
+) -> tuple[str, Optional[str]]:
+    """Run one schedule of ``scenario`` under ``chooser``; returns the
+    canonical trace and the failure string (None = all invariants held).
+    ``ReplayDivergence`` propagates — a divergent replay/DFS prefix is a
+    nondeterministic scenario, which is a bug in the harness, not a
+    finding."""
+    state = scenario.build()
+    error: Optional[str] = None
+    try:
+        det_run(
+            lambda: scenario.run(state),
+            chooser=chooser,
+            max_steps=max_steps,
+            time_limit=time_limit,
+        )
+        scenario.check(state)
+        _verify_grammar(scenario, state)
+    except ReplayDivergence:
+        raise
+    except Exception as exc:  # noqa: BLE001 — any failure is a finding
+        error = f"{type(exc).__name__}: {exc}"
+    return format_trace(chooser.trace), error
+
+
+def explore(
+    scenario,  # noqa: ANN001
+    *,
+    seeds: Iterable[int],
+    max_steps: int = DEFAULT_MAX_STEPS,
+    time_limit: float = DEFAULT_TIME_LIMIT_S,
+) -> Report:
+    """Run ``scenario`` once per seed; collect distinct schedules and
+    failing schedules."""
+    report = Report(scenario=scenario.name)
+    for seed in seeds:
+        chooser = SeededChooser(seed)
+        trace, error = run_schedule(
+            scenario, chooser, max_steps=max_steps, time_limit=time_limit
+        )
+        report.schedules += 1
+        report.distinct.add(trace)
+        if error is not None:
+            report.failures.append(
+                Failure(
+                    scenario=scenario.name,
+                    seed=seed,
+                    prefix=None,
+                    trace=trace,
+                    error=error,
+                )
+            )
+    return report
+
+
+def explore_exhaustive(
+    scenario,  # noqa: ANN001
+    *,
+    max_schedules: int = 2000,
+    max_steps: int = DEFAULT_MAX_STEPS,
+    time_limit: float = DEFAULT_TIME_LIMIT_S,
+) -> Report:
+    """Enumerate distinct schedules by co-ready-permutation DFS.
+
+    Each run follows a choice prefix then picks index 0; backtracking
+    bumps the deepest non-exhausted choice.  ``exhausted=True`` on the
+    report means the FULL schedule space was covered within the budget.
+    """
+    report = Report(scenario=scenario.name)
+    prefix: list[int] = []
+    while report.schedules < max_schedules:
+        chooser = PrefixChooser(prefix)
+        trace, error = run_schedule(
+            scenario, chooser, max_steps=max_steps, time_limit=time_limit
+        )
+        report.schedules += 1
+        report.distinct.add(trace)
+        if error is not None:
+            report.failures.append(
+                Failure(
+                    scenario=scenario.name,
+                    seed=None,
+                    prefix=[idx for _, idx in chooser.taken],
+                    trace=trace,
+                    error=error,
+                )
+            )
+        # deepest choice with siblings left becomes the next prefix
+        taken = list(chooser.taken)
+        while taken and taken[-1][1] + 1 >= taken[-1][0]:
+            taken.pop()
+        if not taken:
+            report.exhausted = True
+            break
+        prefix = [idx for _, idx in taken[:-1]] + [taken[-1][1] + 1]
+    return report
+
+
+def replay(
+    scenario,  # noqa: ANN001
+    *,
+    seed: Optional[int] = None,
+    trace: Optional[str] = None,
+    max_steps: int = DEFAULT_MAX_STEPS,
+    time_limit: float = DEFAULT_TIME_LIMIT_S,
+) -> tuple[str, Optional[str]]:
+    """Reproduce one schedule: by ``seed`` (same PRNG, same schedule) or
+    exactly by recorded ``trace`` (divergence raises).  Returns the same
+    ``(trace, error)`` pair as the original run — byte-for-byte."""
+    if (seed is None) == (trace is None):
+        raise ValueError("replay needs exactly one of seed= or trace=")
+    chooser: Chooser = (
+        SeededChooser(seed)
+        if seed is not None
+        else TraceChooser(parse_trace(trace or ""))
+    )
+    return run_schedule(
+        scenario, chooser, max_steps=max_steps, time_limit=time_limit
+    )

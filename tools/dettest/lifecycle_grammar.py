@@ -1,0 +1,233 @@
+"""The reviewed lifecycle grammar: ``LIFECYCLE_MANIFEST``.
+
+One declarative spec of the two lifecycle machines the control plane
+must respect, checked in and diffed under review exactly like the
+compile-lattice manifest (tools/tpulint/lattice_manifest.json):
+
+* **per-request flight-recorder event DFA** — which
+  ``FlightRecorder.record(kind, request_id=...)`` event may follow
+  which, per request, per recorder.  The teeth are at the boundaries:
+  a request's stream must OPEN with a declared entry kind (``admit``
+  on the serving replica; ``resume``/``handoff_in`` on a replica
+  adopting recovered work; ``shed`` for requests refused before
+  admission; ``ledger`` on replica 0's recorder for requests served
+  elsewhere — the fleet-level ledger closes there regardless of where
+  the request ran), and once ``ledger`` closes the stream NOTHING may
+  follow (a double ledger close is exactly the shed-vs-stream race the
+  ledger had to special-case).  Between those boundaries the active
+  kinds may interleave freely — preemption, swaps, tier demote/promote,
+  checkpoints and handoffs genuinely reorder under load, and
+  over-constraining the middle would turn real schedules into false
+  positives.
+* **engine lifecycle machine** — the legal
+  ``serving``/``recovering``/``draining``/``dead`` transitions
+  (supervisor/lifecycle.py states), including the one schedule-
+  dependent rule the supervisor's recovery tail exists to uphold:
+  **never ``recovering`` → ``serving`` while the front door is
+  draining** (a SIGTERM that lands mid-recovery must win).
+
+Enforced three ways (docs/STATIC_ANALYSIS.md "Lifecycle grammar"):
+statically by tpulint TPL511/TPL512 (every ``record(...)`` call site
+and lifecycle-transition site must use a declared kind/state/edge — a
+new event kind becomes a reviewed diff of THIS file), at runtime by the
+``TGIS_TPU_SANITIZE=1`` sanitizer (event ORDER per request, lifecycle
+edges as they happen), and by the dettest explorer on every explored
+schedule.  tools/obs_check.py cross-checks the kind list here against
+``flight_recorder.EVENT_KINDS`` and docs/OBSERVABILITY.md so the three
+sources cannot drift.
+"""
+
+from __future__ import annotations
+
+# Kinds that appear mid-stream for a live request, in any order: the
+# engine genuinely interleaves these under preemption/recovery load.
+_ACTIVE = (
+    "prefill",
+    "packed_prefill",
+    "ragged_step",
+    "decode_progress",
+    "preempt",
+    "swap_out",
+    "swap_in",
+    "demote_host",
+    "promote_host",
+    "checkpoint",
+    "resume",
+    "handoff_out",
+    "handoff_in",
+)
+
+# Terminal *outcome* kinds: after one of these only outcome-adjacent
+# events and the ledger close may follow.  finish→demote_host covers
+# finish-time prefix registration into the host tier; abort/finish may
+# land in either order when a client abort races the final frame
+# (docs/RECOVERY.md "abort while checkpointed"); a shed noted by the
+# front door is followed by the stream-level exit of the same request.
+_AFTER_FINISH = ("ledger", "demote_host", "handoff_out", "abort")
+_AFTER_ABORT = ("ledger", "finish", "demote_host", "checkpoint")
+_AFTER_SHED = ("ledger", "abort", "finish")
+
+_OPEN = _ACTIVE + ("finish", "abort", "shed", "ledger")
+
+LIFECYCLE_MANIFEST = {
+    "version": 1,
+    "request_events": {
+        # first event a recorder may see for a request id
+        "entry": ["admit", "resume", "handoff_in", "shed", "ledger"],
+        # kinds after which the stream is closed (empty successor set)
+        "terminal": ["ledger"],
+        "edges": {
+            "admit": list(_OPEN),
+            **{kind: list(_OPEN) for kind in _ACTIVE},
+            "finish": list(_AFTER_FINISH),
+            "abort": list(_AFTER_ABORT),
+            "shed": list(_AFTER_SHED),
+            "ledger": [],
+        },
+    },
+    "engine_lifecycle": {
+        "states": ["serving", "recovering", "draining", "dead"],
+        "entry": ["serving"],
+        "edges": [
+            ["serving", "serving"],
+            ["serving", "recovering"],
+            ["serving", "draining"],
+            ["serving", "dead"],
+            ["recovering", "recovering"],
+            ["recovering", "serving"],
+            ["recovering", "draining"],
+            ["recovering", "dead"],
+            ["draining", "draining"],
+            ["draining", "recovering"],
+            ["draining", "dead"],
+        ],
+        # edges additionally forbidden while the front door is draining
+        # — legal in general, illegal under SIGTERM (the ISSUE 17
+        # invariant: recovery must not flip a draining pod back to
+        # serving)
+        "forbidden_while_draining": [["recovering", "serving"]],
+    },
+    # batch-level kinds: recorded WITHOUT a request_id (whole-wave /
+    # whole-engine events), so they are outside the per-request DFA.
+    # Declared here so tpulint TPL511 can reject a record() call whose
+    # kind is in NO part of the manifest, and so obs_check can assert
+    # request ∪ batch == flight_recorder.EVENT_KINDS exactly.
+    "batch_events": ["decode", "error", "restart", "stall"],
+}
+
+
+# --------------------------------------------------------------- accessors
+
+
+def request_edges() -> dict[str, frozenset[str]]:
+    ev = LIFECYCLE_MANIFEST["request_events"]
+    return {k: frozenset(v) for k, v in ev["edges"].items()}
+
+
+def request_entry_kinds() -> frozenset[str]:
+    return frozenset(LIFECYCLE_MANIFEST["request_events"]["entry"])
+
+
+def request_kinds() -> frozenset[str]:
+    """Every kind declared trackable per request."""
+    return frozenset(LIFECYCLE_MANIFEST["request_events"]["edges"])
+
+
+def batch_kinds() -> frozenset[str]:
+    """Kinds recorded without a request_id (outside the per-request DFA)."""
+    return frozenset(LIFECYCLE_MANIFEST["batch_events"])
+
+
+def all_kinds() -> frozenset[str]:
+    """Every declared kind — must equal ``flight_recorder.EVENT_KINDS``."""
+    return request_kinds() | batch_kinds()
+
+
+def engine_states() -> frozenset[str]:
+    return frozenset(LIFECYCLE_MANIFEST["engine_lifecycle"]["states"])
+
+
+def engine_edges() -> frozenset[tuple[str, str]]:
+    return frozenset(
+        (a, b) for a, b in LIFECYCLE_MANIFEST["engine_lifecycle"]["edges"]
+    )
+
+
+def engine_entry_states() -> frozenset[str]:
+    return frozenset(LIFECYCLE_MANIFEST["engine_lifecycle"]["entry"])
+
+
+def forbidden_while_draining() -> frozenset[tuple[str, str]]:
+    return frozenset(
+        (a, b)
+        for a, b in LIFECYCLE_MANIFEST["engine_lifecycle"][
+            "forbidden_while_draining"
+        ]
+    )
+
+
+# --------------------------------------------------------------- validation
+
+
+def self_check() -> list[str]:
+    """Internal-consistency problems of the manifest itself (empty =
+    sound).  ``nox -s race_check`` runs this before any exploration."""
+    problems: list[str] = []
+    edges = request_edges()
+    kinds = request_kinds()
+    for kind in request_entry_kinds():
+        if kind not in kinds:
+            problems.append(f"entry kind {kind!r} has no edge declaration")
+    for kind, successors in edges.items():
+        undeclared = successors - kinds
+        if undeclared:
+            problems.append(
+                f"{kind!r} declares undeclared successor(s) "
+                f"{sorted(undeclared)}"
+            )
+    for kind in LIFECYCLE_MANIFEST["request_events"]["terminal"]:
+        if edges.get(kind):
+            problems.append(
+                f"terminal kind {kind!r} declares successors "
+                f"{sorted(edges[kind])}"
+            )
+    overlap = request_kinds() & batch_kinds()
+    if overlap:
+        problems.append(
+            f"kind(s) declared both per-request and batch-level: "
+            f"{sorted(overlap)}"
+        )
+    states = engine_states()
+    for a, b in engine_edges() | forbidden_while_draining():
+        for s in (a, b):
+            if s not in states:
+                problems.append(f"lifecycle edge state {s!r} undeclared")
+    for s in engine_entry_states():
+        if s not in states:
+            problems.append(f"lifecycle entry state {s!r} undeclared")
+    if ("dead", "serving") in engine_edges():
+        problems.append("dead must be terminal (dead->serving declared)")
+    return problems
+
+
+def verify_request_stream(
+    kinds: "list[str]", request_id: str = "?"
+) -> None:
+    """Replay one request's recorded kind sequence through the DFA;
+    raises ``ValueError`` naming the violated edge.  The explorer runs
+    this over every recorder of every explored schedule."""
+    edges = request_edges()
+    entry = request_entry_kinds()
+    prev: "str | None" = None
+    for kind in kinds:
+        if prev is None:
+            ok = kind in entry
+        else:
+            ok = kind in edges.get(prev, frozenset())
+        if not ok:
+            raise ValueError(
+                f"request {request_id!r}: event {kind!r} after "
+                f"{prev if prev is not None else 'stream start'!r} is not "
+                f"a declared lifecycle edge"
+            )
+        prev = kind

@@ -1,0 +1,802 @@
+"""The concurrency-critical control-plane scenarios dettest explores.
+
+Each :class:`Scenario` drives REAL control-plane objects (the front
+door, the engine supervisor, the host KV tier, the adapter pool, the
+cost ledger) on a :class:`~tools.dettest.loop.DetLoop`, with only the
+device/engine layers stubbed — the races under test live entirely in
+the host-side state machines, so the stubs preserve every await point
+the real code has (``to_thread`` sections become chooser-visible
+schedule points on the deterministic loop).
+
+Invariants checked on EVERY explored schedule (``check``):
+
+* exactly one ledger record per request (``CostLedger`` open/close
+  conservation, one ``ledger`` flight-recorder event each);
+* no leaked admission slot (``FrontDoor._pending_grants`` and the
+  scenario's slot accounting both return to zero);
+* no lost output (every request reaches exactly one terminal outcome);
+* lifecycle never goes ``recovering → serving`` while draining;
+* tier/pool resource conservation (KV in-flight bytes return to zero,
+  adapter slots are a permutation of the pool).
+
+The explorer additionally replays every recorder's per-request event
+stream through the lifecycle grammar
+(:mod:`tools.dettest.lifecycle_grammar`).
+
+:data:`FAILPOINT` is an INTENTIONALLY racy scenario (the historical
+grant-cancellation slot over-grant, reconstructed as a check-then-act
+window): ``race_check`` uses it to prove the harness finds seeded
+races and reproduces a recorded failing seed byte-for-byte.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+from types import SimpleNamespace
+
+import numpy as np
+
+from vllm_tgis_adapter_tpu.engine.adapter_pool import AdapterPool
+from vllm_tgis_adapter_tpu.engine.config import FrontdoorConfig
+from vllm_tgis_adapter_tpu.engine.kv_tier import HostKVTier, PromotionTicket
+from vllm_tgis_adapter_tpu.flight_recorder import FlightRecorder
+from vllm_tgis_adapter_tpu.frontdoor.admission import FrontDoor
+from vllm_tgis_adapter_tpu.frontdoor.errors import AdmissionShedError
+from vllm_tgis_adapter_tpu.supervisor.lifecycle import LIFECYCLE_SERVING
+from vllm_tgis_adapter_tpu.supervisor.supervisor import EngineSupervisor
+from vllm_tgis_adapter_tpu.telemetry.ledger import CostLedger
+from vllm_tgis_adapter_tpu.utils import spawn_task
+
+__all__ = ["FAILPOINT", "SCENARIOS", "Scenario"]
+
+
+class Scenario:
+    """One explorable control-plane scenario.
+
+    ``build`` returns a fresh state object (new loop-bound primitives
+    every run — nothing may leak between schedules); ``run`` is the
+    coroutine the DetLoop executes; ``check`` raises on any violated
+    invariant; ``recorders`` exposes the flight recorders whose
+    per-request streams the explorer grammar-verifies.
+    """
+
+    name = "?"
+
+    def build(self):  # noqa: ANN201
+        raise NotImplementedError
+
+    async def run(self, state) -> None:  # noqa: ANN001
+        raise NotImplementedError
+
+    def check(self, state) -> None:  # noqa: ANN001
+        raise NotImplementedError
+
+    def recorders(self, state) -> list:  # noqa: ANN001
+        return []
+
+
+def _gather(tasks):  # noqa: ANN001, ANN202
+    return asyncio.gather(*tasks, return_exceptions=True)
+
+
+# ----------------------------------------------------------- 1. front door
+
+
+class FrontDoorScenario(Scenario):
+    """Admission grant vs client cancellation vs queue TTL vs drain.
+
+    A two-slot engine behind a real :class:`FrontDoor`: greedy clients
+    race for slots, a canceller tears two of them down mid-wait, two
+    park with short TTLs, and a SIGTERM drain lands in the middle of
+    it all.  Every request must end with exactly one ledger record and
+    the admission window must conserve slots on every interleaving —
+    this is the scenario that would have caught the historical
+    grant-cancellation slot leak.
+    """
+
+    name = "frontdoor-admit-cancel-ttl-drain"
+    SLOTS = 2
+
+    def build(self):  # noqa: ANN201
+        state = SimpleNamespace(
+            recorder=FlightRecorder(),
+            active=0,
+            outcomes={},
+            tasks=set(),
+        )
+        state.ledger = CostLedger(recorder=state.recorder.record)
+        config = FrontdoorConfig(
+            enabled=True,
+            max_waiting_requests=8,
+            admission_deadline_s=0.0,
+            queue_ttl_s=0.0,
+            drain_grace_s=1.0,
+        )
+        state.fd = FrontDoor(
+            config,
+            admit_window=self.SLOTS,
+            room_fn=lambda pending: state.active + pending < self.SLOTS,
+            waiting_depth_fn=lambda: 0,
+            backlog_tokens_fn=lambda: 0.0,
+            kv_token_capacity_fn=lambda: 4096.0,
+            record_shed=lambda rid, tenant, reason, **d: (
+                state.recorder.record("shed", rid, tenant=tenant,
+                                      reason=reason)
+            ),
+        )
+        return state
+
+    async def _client(self, state, rid, tenant, *, deadline=None,  # noqa: ANN001, ANN002
+                      hold_s=0.02) -> None:
+        import time
+
+        fd, ledger = state.fd, state.ledger
+        ledger.open(rid, tenant=tenant, tokens_in=8)
+        try:
+            await fd.acquire(
+                request_id=rid, tenant=tenant, tokens=8.0,
+                deadline=(time.time() + deadline)
+                if deadline is not None else None,
+            )
+        except AdmissionShedError as exc:
+            ledger.note_shed(rid, exc.reason)
+            ledger.close(rid, "shed")
+            state.outcomes[rid] = "shed"
+            return
+        except asyncio.CancelledError:
+            ledger.close(rid, "abort")
+            state.outcomes[rid] = "cancelled"
+            raise
+        # granted: hand the slot to the "engine" and serve
+        fd.note_admitted()
+        state.active += 1
+        state.recorder.record("admit", rid, tenant=tenant)
+        try:
+            await asyncio.sleep(hold_s)
+            state.recorder.record("finish", rid)
+            ledger.close(rid, "finish")
+            state.outcomes[rid] = "finish"
+        except asyncio.CancelledError:
+            state.recorder.record("abort", rid)
+            ledger.close(rid, "abort")
+            state.outcomes[rid] = "cancelled"
+            raise
+        finally:
+            state.active -= 1
+            fd.kick()
+
+    async def run(self, state) -> None:  # noqa: ANN001
+        clients = {}
+        for i, (rid, tenant, deadline) in enumerate([
+            ("fd-r0", "a", None),
+            ("fd-r1", "a", None),
+            ("fd-r2", "b", None),
+            ("fd-r3", "b", None),
+            ("fd-r4", "a", 0.01),  # short TTL: sheds if parked too long
+            ("fd-r5", "b", 0.01),
+        ]):
+            clients[rid] = spawn_task(
+                self._client(state, rid, tenant, deadline=deadline),
+                name=f"client-{rid}", retain=state.tasks,
+            )
+
+        async def _cancel(rid: str, after: float) -> None:
+            await asyncio.sleep(after)
+            clients[rid].cancel()
+
+        async def _drain(after: float) -> None:
+            await asyncio.sleep(after)
+            state.fd.begin_drain()
+
+        side = [
+            spawn_task(_cancel("fd-r2", 0.005), name="canceller-r2",
+                       retain=state.tasks),
+            spawn_task(_cancel("fd-r3", 0.005), name="canceller-r3",
+                       retain=state.tasks),
+            spawn_task(_drain(0.03), name="sigterm-drain",
+                       retain=state.tasks),
+        ]
+        await _gather(list(clients.values()) + side)
+        await state.fd.shutdown()
+
+    def check(self, state) -> None:  # noqa: ANN001
+        fd, ledger = state.fd, state.ledger
+        assert state.active == 0, f"engine slots leaked: {state.active}"
+        assert fd._pending_grants == 0, (  # noqa: SLF001
+            f"admission slots leaked: {fd._pending_grants} grants "  # noqa: SLF001
+            "outstanding after every client finished"
+        )
+        assert fd.parked == 0, f"{fd.parked} requests left parked"
+        assert ledger.open_count == 0, (
+            f"{ledger.open_count} ledger records never closed"
+        )
+        assert ledger.closed_total == 6, (
+            f"expected 6 ledger closes, got {ledger.closed_total}"
+        )
+        assert len(state.outcomes) == 6, (
+            f"lost output: only {sorted(state.outcomes)} reached a "
+            "terminal outcome"
+        )
+        per_request = {}
+        for event in state.recorder.events():
+            if event["kind"] == "ledger":
+                rid = event["request_id"]
+                per_request[rid] = per_request.get(rid, 0) + 1
+        assert all(n == 1 for n in per_request.values()), (
+            f"duplicate ledger close events: {per_request}"
+        )
+        assert len(per_request) == 6, (
+            f"missing ledger events: {sorted(per_request)}"
+        )
+
+    def recorders(self, state) -> list:  # noqa: ANN001
+        return [state.recorder]
+
+
+# ----------------------------------------------------------- 2. supervisor
+
+
+class _SubEngine:
+    """Per-replica engine stub: just the surface _recover_one touches."""
+
+    def __init__(self) -> None:
+        self.recorder = FlightRecorder()
+        self.step_counter = 0
+        self.replica_index = 0
+        self.role = "mixed"
+
+    def set_replica_role(self, role: str) -> None:
+        self.role = role
+
+
+class _StubReplica:
+    def __init__(self, index: int) -> None:
+        self.index = index
+        self.engine = _SubEngine()
+        self.serving = True
+        self.task = None
+        self.role = "mixed"
+
+
+class _FleetEngine:
+    """Fleet-level engine stub implementing the supervisor's recovery
+    contract, with an await point per phase so quiesce → triage →
+    rebuild is fully reorderable against racing deaths and SIGTERM."""
+
+    def __init__(self) -> None:
+        self.lifecycle = LIFECYCLE_SERVING
+        self.frontdoor = None
+        self._replicas = []
+        self._precompile_widths = None
+        self.dead_event = asyncio.Event()
+        self.terminal = None
+
+    async def fail_unreplayable(self, rep, err):  # noqa: ANN001, ANN201
+        await asyncio.sleep(0)
+        return 0, [f"ckpt-{rep.index}"]
+
+    def staged_checkpoints(self, checkpoints):  # noqa: ANN001, ANN201
+        return checkpoints
+
+    async def replay_to_replicas(self, rep):  # noqa: ANN001, ANN201
+        await asyncio.sleep(0)
+        healthy = [r for r in self._replicas if r.serving]
+        return 1 if healthy else 0
+
+    async def resume_to_replicas(self, rep, checkpoints, err):  # noqa: ANN001, ANN201
+        await asyncio.sleep(0)
+        healthy = [r for r in self._replicas if r.serving]
+        if healthy and checkpoints:
+            return len(checkpoints), 0, []
+        return 0, 0, checkpoints
+
+    async def restart_replica(self, rep, new_engine, err):  # noqa: ANN001, ANN201
+        await asyncio.sleep(0)
+        rep.engine = new_engine
+        return 1, 0
+
+    async def resume_into(self, rep, checkpoints, err):  # noqa: ANN001, ANN201
+        await asyncio.sleep(0)
+        return len(checkpoints), 0
+
+    def _arm_replica(self, rep) -> None:  # noqa: ANN001
+        pass
+
+    def _terminal_death(self, final) -> None:  # noqa: ANN001
+        self.terminal = final
+
+
+class _DetSupervisor(EngineSupervisor):
+    """Real supervisor with the (slow, device-touching) rebuild stubbed;
+    the rebuild still runs through ``to_thread`` so it stays a genuine
+    schedule point."""
+
+    def _rebuild(self, old):  # noqa: ANN001, ANN201
+        return _SubEngine()
+
+
+class SupervisorScenario(Scenario):
+    """Quiesce → triage → rebuild racing SIGTERM and a second replica
+    death.
+
+    Replica 0 and replica 1 die at the SAME virtual instant a SIGTERM
+    drain lands: depending on the schedule the second death arrives
+    before, during, or after the first recovery, and the drain lands
+    anywhere inside the recovery pipeline.  On every interleaving both
+    replicas must come back armed, the pending-death queue must empty,
+    and the lifecycle must never flip ``recovering → serving`` while
+    the front door is draining (the runtime sanitizer enforces the
+    same edge; the scenario also checks it explicitly from the
+    listener's view).
+    """
+
+    name = "supervisor-recovery-vs-sigterm"
+
+    def build(self):  # noqa: ANN201
+        state = SimpleNamespace(transitions=[], tasks=set())
+        fleet = _FleetEngine()
+        fleet._replicas = [_StubReplica(0), _StubReplica(1)]  # noqa: SLF001
+        config = FrontdoorConfig(enabled=True, drain_grace_s=1.0)
+        fleet.frontdoor = FrontDoor(
+            config,
+            admit_window=2,
+            room_fn=lambda pending: True,
+            waiting_depth_fn=lambda: 0,
+            backlog_tokens_fn=lambda: 0.0,
+            kv_token_capacity_fn=lambda: 4096.0,
+        )
+        state.fleet = fleet
+        state.sup = _DetSupervisor(
+            fleet, max_restarts=4, window_s=10.0, backoff_base_s=0.0,
+            termination_log=os.devnull,
+        )
+
+        def _listener(new_state: str) -> None:
+            state.transitions.append(
+                (new_state, fleet.frontdoor.draining)
+            )
+
+        state.sup.add_listener(_listener)
+        return state
+
+    async def run(self, state) -> None:  # noqa: ANN001
+        sup, fleet = state.sup, state.fleet
+
+        async def _die(rep) -> None:  # noqa: ANN001
+            await asyncio.sleep(0.01)
+            sup.notify_death(rep, RuntimeError(f"boom-{rep.index}"))
+
+        async def _sigterm() -> None:
+            await asyncio.sleep(0.01)
+            fleet.frontdoor.begin_drain()
+
+        await _gather([
+            spawn_task(_die(fleet._replicas[0]), name="death-rep0",  # noqa: SLF001
+                       retain=state.tasks),
+            spawn_task(_die(fleet._replicas[1]), name="death-rep1",  # noqa: SLF001
+                       retain=state.tasks),
+            spawn_task(_sigterm(), name="sigterm", retain=state.tasks),
+        ])
+        # wait out the recovery task (and any re-queued deaths); an
+        # escalation to dead ends the scenario too — check() rejects it
+        while fleet.lifecycle != "dead" and (
+            sup._pending  # noqa: SLF001
+            or (sup._task is not None and not sup._task.done())  # noqa: SLF001
+        ):
+            await asyncio.sleep(0.01)
+
+    def check(self, state) -> None:  # noqa: ANN001
+        sup, fleet = state.sup, state.fleet
+        assert not sup._pending, (  # noqa: SLF001
+            f"deaths stranded in the pending queue: {sup._pending}"  # noqa: SLF001
+        )
+        assert fleet.lifecycle != "recovering", (
+            "recovery finished but lifecycle is still 'recovering'"
+        )
+        assert fleet.terminal is None, (
+            f"supervisor escalated unexpectedly: {fleet.terminal}"
+        )
+        for rep in fleet._replicas:  # noqa: SLF001
+            assert rep.serving, (
+                f"replica {rep.index} never re-armed after recovery"
+            )
+        recovered = [
+            h for h in sup.restart_history if h.get("recovered")
+        ]
+        assert len(recovered) == len(sup.restart_history) == 2, (
+            f"expected 2 recovered attempts, got {sup.restart_history}"
+        )
+        # the ISSUE invariant, from the listener's own view: recovery
+        # must never flip a draining pod back to serving
+        last = None
+        for new_state, draining in state.transitions:
+            assert not (
+                last == "recovering" and new_state == "serving" and draining
+            ), (
+                "lifecycle went recovering -> serving while the front "
+                f"door was draining (transitions: {state.transitions})"
+            )
+            last = new_state
+        # SIGTERM always lands in this scenario: whoever transitioned
+        # last must have respected it
+        assert fleet.frontdoor.draining
+        assert fleet.lifecycle in ("serving", "draining")
+
+    def recorders(self, state) -> list:  # noqa: ANN001
+        return [rep.engine.recorder for rep in state.fleet._replicas]  # noqa: SLF001
+
+
+# -------------------------------------------------------------- 3. kv tier
+
+
+class KvTierScenario(Scenario):
+    """PromotionTicket staging vs abort vs eviction pressure.
+
+    Demotions stream into a byte-budgeted tier while two promotion
+    tickets assemble against it; one ticket is cancelled mid-flight
+    and a burst of fresh demotions evicts entries under the other's
+    assembly.  On every interleaving the tier's byte accounting must
+    balance, in-flight markers must drain, and every ticket must reach
+    ``ready`` exactly once with a page span consistent with its
+    bounds.
+    """
+
+    name = "kvtier-promotion-vs-abort-preempt"
+    BLOCK = 4
+
+    def build(self):  # noqa: ANN201
+        page = np.zeros((2, 8), np.float32)  # 64 bytes/array
+        state = SimpleNamespace(
+            # budget holds ~4 pages of 2x64B: eviction pressure is real
+            tier=HostKVTier(budget_bytes=560, block_size=self.BLOCK),
+            page=page,
+            tickets=[],
+        )
+        return state
+
+    @staticmethod
+    def _batch(state, digests):  # noqa: ANN001, ANN202
+        return [
+            (d, state.page.copy(), state.page.copy()) for d in digests
+        ]
+
+    async def run(self, state) -> None:  # noqa: ANN001
+        tier = state.tier
+        digests = [b"pg-%d" % i for i in range(8)]
+        tier.submit(self._batch(state, digests[:4]))
+
+        t_warm = PromotionTicket(
+            request_id="kv-warm", digests=digests[:3],
+            start_tokens=0, end_tokens=3 * self.BLOCK,
+        )
+        t_aborted = PromotionTicket(
+            request_id="kv-aborted", digests=digests[1:4],
+            start_tokens=0, end_tokens=3 * self.BLOCK,
+        )
+        state.tickets = [t_warm, t_aborted]
+
+        async def _promote(ticket) -> None:  # noqa: ANN001
+            await asyncio.sleep(0)
+            tier.start_promotion(ticket, put_fn=lambda x: x)
+
+        async def _abort() -> None:
+            await asyncio.sleep(0)
+            t_aborted.cancel()
+
+        async def _preempt_pressure() -> None:
+            # fresh demotions evict the LRU entries the tickets point at
+            await asyncio.sleep(0)
+            tier.submit(self._batch(state, digests[4:6]))
+            await asyncio.sleep(0)
+            tier.submit(self._batch(state, digests[6:8]))
+
+        await _gather([
+            spawn_task(_promote(t_warm), name="promote-warm"),
+            spawn_task(_promote(t_aborted), name="promote-aborted"),
+            spawn_task(_abort(), name="abort-ticket"),
+            spawn_task(_preempt_pressure(), name="preempt-pressure"),
+        ])
+        # settle every transfer task (drain_transfers snapshots at
+        # entry, so loop until the task set is quiet)
+        while any(not t.done() for t in tier._tasks):  # noqa: SLF001
+            await tier.drain_transfers()
+
+    def check(self, state) -> None:  # noqa: ANN001
+        tier = state.tier
+        assert tier._inflight_bytes == 0, (  # noqa: SLF001
+            f"in-flight demotion bytes leaked: {tier._inflight_bytes}"  # noqa: SLF001
+        )
+        assert not tier._inflight, (  # noqa: SLF001
+            f"in-flight digests leaked: {tier._inflight}"  # noqa: SLF001
+        )
+        actual = sum(
+            e.nbytes for e in tier._entries.values()  # noqa: SLF001
+        )
+        assert tier.bytes_used == actual, (
+            f"byte accounting drifted: bytes_used={tier.bytes_used} "
+            f"actual={actual}"
+        )
+        assert tier.bytes_used <= tier.budget_bytes
+        for ticket in state.tickets:
+            assert ticket.ready, (
+                f"ticket {ticket.request_id} never reached ready — its "
+                "request is parked forever"
+            )
+            if not ticket.failed:
+                assert ticket.pages is not None
+                assert (
+                    ticket.end_tokens
+                    == ticket.start_tokens
+                    + len(ticket.pages) * tier.block_size
+                ), f"ticket {ticket.request_id} span inconsistent"
+
+
+# --------------------------------------------------------- 4. adapter pool
+
+
+class _StubLoRAManager:
+    def __init__(self, names) -> None:  # noqa: ANN001
+        self._weights = {
+            name: SimpleNamespace(rank=8, scaling=1.0) for name in names
+        }
+
+    def get_weights(self, name):  # noqa: ANN001, ANN201
+        return self._weights.get(name)
+
+    def pinned(self, name) -> bool:  # noqa: ANN001
+        return False
+
+    def request_disk_restore(self, name) -> bool:  # noqa: ANN001
+        return False
+
+
+class _DetAdapterPool(AdapterPool):
+    """Real pool state machine with the device halves stubbed — the
+    build/apply phases still hop through ``to_thread``, so commit
+    ordering is fully explorable."""
+
+    def _zero_stacks(self):  # noqa: ANN201
+        return ("stacks", 0)
+
+    def _build_device_blocks(self, weights):  # noqa: ANN001, ANN201
+        return None, None
+
+    def _apply(self, slot, a_dev, b_dev, scaling, rank):  # noqa: ANN001, ANN201
+        return ("stacks", slot)
+
+    def _rank_bucket(self, weights) -> int:  # noqa: ANN001
+        return weights.rank
+
+
+class AdapterPoolScenario(Scenario):
+    """Prefetch streaming vs invalidate vs LRU eviction.
+
+    Three adapters race into a two-slot pool; one is host-invalidated
+    while its stream is in flight and one resident is evicted under
+    pressure.  Slot conservation must hold on every interleaving:
+    free + committed slots are always a permutation of the pool, no
+    slot is double-published, and the LRU tracks exactly the committed
+    residents.
+    """
+
+    name = "adapterpool-prefetch-vs-evict"
+
+    def build(self):  # noqa: ANN201
+        pool = _DetAdapterPool(
+            SimpleNamespace(num_layers=2),
+            max_loras=2,
+            max_lora_rank=8,
+            put_fn=lambda x: x,
+            prefetch_concurrency=2,
+        )
+        pool.manager = _StubLoRAManager(["lora-a", "lora-b", "lora-c"])
+        return SimpleNamespace(pool=pool, tasks=set())
+
+    async def run(self, state) -> None:  # noqa: ANN001
+        pool = state.pool
+
+        async def _prefetch(name: str) -> None:
+            await asyncio.sleep(0)
+            pool.prefetch(name)
+
+        async def _invalidate(name: str) -> None:
+            await asyncio.sleep(0)
+            pool.invalidate(name)
+
+        async def _evict(name: str) -> None:
+            await asyncio.sleep(0)
+            pool.evict_resident(name)
+
+        await _gather([
+            spawn_task(_prefetch("lora-a"), name="prefetch-a",
+                       retain=state.tasks),
+            spawn_task(_prefetch("lora-b"), name="prefetch-b",
+                       retain=state.tasks),
+            spawn_task(_prefetch("lora-c"), name="prefetch-c",
+                       retain=state.tasks),
+            spawn_task(_invalidate("lora-a"), name="invalidate-a",
+                       retain=state.tasks),
+            spawn_task(_evict("lora-b"), name="evict-b",
+                       retain=state.tasks),
+        ])
+        # settle in-flight streams, then retry the loser so the pool
+        # ends in a steady state
+        while pool._streaming:  # noqa: SLF001
+            await _gather(list(pool._streaming.values()))  # noqa: SLF001
+        pool.prefetch("lora-c")
+        while pool._streaming:  # noqa: SLF001
+            await _gather(list(pool._streaming.values()))  # noqa: SLF001
+
+    def check(self, state) -> None:  # noqa: ANN001
+        pool = state.pool
+        assert not pool._streaming  # noqa: SLF001
+        assert not pool._invalidated, (  # noqa: SLF001
+            f"invalidation markers leaked: {pool._invalidated}"  # noqa: SLF001
+        )
+        committed = list(pool._slots.values())  # noqa: SLF001
+        assert len(committed) == len(set(committed)), (
+            f"slot double-published: {pool._slots}"  # noqa: SLF001
+        )
+        census = sorted(pool._free + committed)  # noqa: SLF001
+        assert census == list(range(1, pool.max_loras + 1)), (
+            f"slot conservation violated: free={pool._free} "  # noqa: SLF001
+            f"committed={pool._slots}"  # noqa: SLF001
+        )
+        assert set(pool._lru) == set(pool._slots), (  # noqa: SLF001
+            "LRU tracks non-residents: "
+            f"lru={set(pool._lru)} slots={set(pool._slots)}"  # noqa: SLF001
+        )
+
+
+# ------------------------------------------------------------- 5. ledger
+
+
+class LedgerScenario(Scenario):
+    """Close-at-terminal-outcome: finish vs abort vs shed racing for
+    one request's single ledger record.
+
+    Small enough for exhaustive DFS.  Each racer checks liveness,
+    records its terminal event atomically with the check, then yields
+    before closing — the widest legal race window.  Every schedule
+    must produce exactly one close per request, a shed noted before
+    the close must win the outcome, and a duplicate ``open`` must
+    never mint a second record.
+    """
+
+    name = "ledger-close-at-terminal"
+
+    def build(self):  # noqa: ANN201
+        recorder = FlightRecorder()
+        return SimpleNamespace(
+            recorder=recorder,
+            ledger=CostLedger(recorder=recorder.record),
+            duplicate_open_rejected=False,
+            tasks=set(),
+        )
+
+    async def run(self, state) -> None:  # noqa: ANN001
+        ledger, recorder = state.ledger, state.recorder
+
+        async def _open(rid: str) -> None:
+            ledger.open(rid, tenant="t")
+            recorder.record("admit", rid)
+
+        async def _racer(rid: str, outcome: str) -> None:
+            await asyncio.sleep(0)
+            if ledger.get(rid) is None:
+                return  # lost the race: no event, no close
+            # event recorded atomically with the liveness check …
+            recorder.record(outcome, rid)
+            await asyncio.sleep(0)  # … then the race window
+            ledger.close(rid, outcome)
+
+        async def _shedder(rid: str) -> None:
+            await asyncio.sleep(0)
+            if ledger.get(rid) is None:
+                return
+            ledger.note_shed(rid, "ttl")
+            await asyncio.sleep(0)
+            ledger.close(rid, "abort")  # noted shed must win this
+
+        async def _dup_open(rid: str) -> None:
+            await asyncio.sleep(0)
+            if ledger.get(rid) is None:
+                # the record already closed: a same-id latecomer is a
+                # NEW request, not a duplicate — vacuously fine here
+                # (the TOCTOU re-check race is pinned in
+                # tests/test_dettest.py)
+                state.duplicate_open_rejected = True
+            else:
+                # atomic with the liveness check: the duplicate must be
+                # refused while the first record is still open
+                state.duplicate_open_rejected = (
+                    ledger.open(rid, tenant="latecomer") is None
+                )
+
+        await _open("led-r1")
+        await _open("led-r2")
+        await _gather([
+            spawn_task(_racer("led-r1", "finish"), name="finish-r1",
+                       retain=state.tasks),
+            spawn_task(_racer("led-r1", "abort"), name="abort-r1",
+                       retain=state.tasks),
+            spawn_task(_racer("led-r2", "finish"), name="finish-r2",
+                       retain=state.tasks),
+            spawn_task(_shedder("led-r2"), name="shed-r2",
+                       retain=state.tasks),
+            spawn_task(_dup_open("led-r1"), name="dup-open-r1",
+                       retain=state.tasks),
+        ])
+
+    def check(self, state) -> None:  # noqa: ANN001
+        ledger = state.ledger
+        assert ledger.open_count == 0, (
+            f"{ledger.open_count} records never closed"
+        )
+        assert ledger.closed_total == 2, (
+            f"expected exactly 2 closes, got {ledger.closed_total}"
+        )
+        assert state.duplicate_open_rejected, (
+            "duplicate open minted a second record"
+        )
+        ledger_events = {}
+        for event in state.recorder.events():
+            if event["kind"] == "ledger":
+                rid = event["request_id"]
+                ledger_events[rid] = ledger_events.get(rid, 0) + 1
+        assert ledger_events == {"led-r1": 1, "led-r2": 1}, (
+            f"ledger event conservation violated: {ledger_events}"
+        )
+
+    def recorders(self, state) -> list:  # noqa: ANN001
+        return [state.recorder]
+
+
+# ----------------------------------------------------- seeded failpoint
+
+
+class SlotOvergrantFailpoint(Scenario):
+    """INTENTIONALLY racy (this scenario is SUPPOSED to fail on some
+    schedules): the historical grant-cancellation slot leak reduced to
+    its essence — a check-then-act admission window with an await
+    between the room check and the grant.  ``race_check`` uses it to
+    prove the explorer finds seeded races, and that a recorded failing
+    seed replays byte-for-byte."""
+
+    name = "failpoint-slot-overgrant"
+    SLOTS = 2
+
+    def build(self):  # noqa: ANN201
+        return SimpleNamespace(used=0, peak=0, tasks=set())
+
+    async def run(self, state) -> None:  # noqa: ANN001
+        async def _worker() -> None:
+            if state.used < self.SLOTS:  # check …
+                await asyncio.sleep(0)  # … the buggy window …
+                state.used += 1  # … act
+                state.peak = max(state.peak, state.used)
+                await asyncio.sleep(0)
+                state.used -= 1
+
+        await _gather([
+            spawn_task(_worker(), name=f"worker-{i}", retain=state.tasks)
+            for i in range(3)
+        ])
+
+    def check(self, state) -> None:  # noqa: ANN001
+        assert state.peak <= self.SLOTS, (
+            f"admission over-grant: {state.peak} slots in use with a "
+            f"window of {self.SLOTS} (the check-then-act race fired)"
+        )
+
+
+SCENARIOS = [
+    FrontDoorScenario(),
+    SupervisorScenario(),
+    KvTierScenario(),
+    AdapterPoolScenario(),
+    LedgerScenario(),
+]
+
+FAILPOINT = SlotOvergrantFailpoint()

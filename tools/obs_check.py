@@ -27,6 +27,25 @@ sys.path.insert(0, str(REPO_ROOT))
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 
+def documented_event_kinds(doc_path: Path) -> set[str]:
+    """Backticked kind names from the first column of the
+    "Event schema" table — every flight-recorder kind the doc
+    promises (combined rows like ``swap_out`` / ``swap_in`` yield both
+    names)."""
+    kinds: set[str] = set()
+    in_table = False
+    for line in doc_path.read_text().splitlines():
+        if line.startswith("| Kind |"):
+            in_table = True
+            continue
+        if in_table:
+            if not line.startswith("|"):
+                break
+            first_cell = line.split("|")[1]
+            kinds.update(re.findall(r"`([a-z_]+)`", first_cell))
+    return kinds
+
+
 def documented_metrics(doc_path: Path) -> set[str]:
     """Backticked ``tgis_tpu_*`` names from the observability doc
     (placeholder suffixes like ``pp{N}`` never name a whole metric)."""
@@ -151,7 +170,47 @@ async def scrape_metrics() -> tuple[str, dict]:
         await engine.stop()
 
 
+def check_event_kinds(doc_path: Path) -> list[str]:
+    """Three-way flight-recorder kind agreement: the doc's event-schema
+    table, ``flight_recorder.EVENT_KINDS``, and the lifecycle-grammar
+    manifest (request ∪ batch kinds) must list the SAME set — adding a
+    kind without documenting it AND declaring its grammar edges fails
+    here, not in review."""
+    from tools.dettest import lifecycle_grammar
+
+    from vllm_tgis_adapter_tpu.flight_recorder import EVENT_KINDS
+
+    code_kinds = set(EVENT_KINDS)
+    problems: list[str] = []
+    for label, other in (
+        ("docs/OBSERVABILITY.md event-schema table",
+         documented_event_kinds(doc_path)),
+        ("lifecycle grammar manifest "
+         "(tools/dettest/lifecycle_grammar.py request ∪ batch kinds)",
+         set(lifecycle_grammar.all_kinds())),
+    ):
+        missing = sorted(code_kinds - other)
+        extra = sorted(other - code_kinds)
+        if missing:
+            problems.append(
+                f"{label} is missing kind(s): {', '.join(missing)}"
+            )
+        if extra:
+            problems.append(
+                f"{label} lists kind(s) absent from "
+                f"flight_recorder.EVENT_KINDS: {', '.join(extra)}"
+            )
+    return problems
+
+
 def main() -> int:
+    doc_path = REPO_ROOT / "docs" / "OBSERVABILITY.md"
+    kind_problems = check_event_kinds(doc_path)
+    if kind_problems:
+        print("obs_check: flight-recorder kind lists diverged:")
+        for problem in kind_problems:
+            print(f"  {problem}")
+        return 1
     documented = documented_metrics(REPO_ROOT / "docs" / "OBSERVABILITY.md")
     if not documented:
         print("obs_check: no metrics documented — parse failure?")

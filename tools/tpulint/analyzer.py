@@ -31,7 +31,14 @@ import tokenize
 from pathlib import Path
 from typing import Iterable, Optional, Union
 
-from tools.tpulint import astutil, concurrency, config, lattice, resources
+from tools.tpulint import (
+    astutil,
+    concurrency,
+    config,
+    lattice,
+    lifecycle,
+    resources,
+)
 
 _DISABLE_RE = re.compile(r"#\s*tpulint:\s*disable=(?P<body>.+)$")
 # lazy reason + lookahead to the next entry or end-of-comment, so
@@ -521,6 +528,22 @@ class _Checker(ast.NodeVisitor):
                 self._emit(node, "TPL202", f"{name}(...)")
 
         if self._in_async:
+            # TPL304: wait_for over an Event.wait() — the bpo-42130
+            # already-set-event pattern (py3.10 swallows the timeout
+            # cancellation, so the wait can hang past its deadline)
+            if name == "wait_for" and node.args:
+                inner = node.args[0]
+                if (
+                    isinstance(inner, ast.Call)
+                    and isinstance(inner.func, ast.Attribute)
+                    and inner.func.attr == "wait"
+                    and not inner.args
+                ):
+                    recv = _call_name(inner.func.value) or "event"
+                    self._emit(
+                        node, "TPL304",
+                        f"wait_for({recv}.wait(), ...)",
+                    )
             if (
                 isinstance(func, ast.Attribute)
                 and name == "sleep"
@@ -664,9 +687,10 @@ def analyze_module(
             astutil.Anchor(line), code, detail
         ),
     )
-    # TPL5xx: resource pairing + raw task spawns
+    # TPL5xx: resource pairing + raw task spawns + lifecycle grammar
     resources.check_pairing(tree, rel_path, emit)
     resources.check_task_spawns(tree, rel_path, emit)
+    lifecycle.check_module(tree, rel_path, emit)
     # TPL6xx: compile-lattice manifest agreement (per-file half)
     lattice_sites = lattice.check_module(
         tree, rel_path, emit, manifest=manifest

@@ -36,6 +36,11 @@ RULES: dict[str, str] = {
               "to asyncio.to_thread or a sync helper off the loop)",
     "TPL303": "known-blocking engine/device call on the event loop "
               "(dispatch via asyncio.to_thread like the step loop does)",
+    "TPL304": "asyncio.wait_for(event.wait(), ...): on py3.10 "
+              "bpo-42130 swallows the timeout cancellation when the "
+              "event is already set, so the wait can outlive its "
+              "deadline — gate the loop on a re-checked stop flag or "
+              "await a fresh per-wake future instead",
     "TPL401": "await of a non-to_thread awaitable while holding an "
               "engine lock (an arbitrary suspension under a "
               "step-loop-scoped lock extends the critical section "
@@ -54,6 +59,16 @@ RULES: dict[str, str] = {
               "task refs, so an untracked create_task can be "
               "garbage-collected mid-flight; spawn through "
               "utils.spawn_task",
+    "TPL511": "flight-recorder record() call with an event kind not "
+              "declared in the lifecycle grammar "
+              "(tools/dettest/lifecycle_grammar.py LIFECYCLE_MANIFEST) "
+              "— a new kind must land as a reviewed manifest diff, and "
+              "a batch-level kind must never carry a request_id",
+    "TPL512": "engine lifecycle transition with a state or edge not "
+              "declared in the lifecycle grammar's engine machine "
+              "(tools/dettest/lifecycle_grammar.py LIFECYCLE_MANIFEST "
+              "engine_lifecycle) — the supervisor may only move along "
+              "declared edges",
     "TPL601": "jit entry point absent from (or disagreeing with) "
               "tools/tpulint/lattice_manifest.json: regenerate with "
               "python -m tools.tpulint --write-lattice and update "

@@ -1,0 +1,186 @@
+"""TPL511/TPL512: static enforcement of the lifecycle grammar.
+
+The reviewed manifest in ``tools/dettest/lifecycle_grammar.py``
+declares every flight-recorder event kind (per-request DFA + batch
+kinds) and every legal engine-lifecycle edge.  The runtime sanitizer
+checks ORDER as events happen and the dettest explorer checks every
+explored schedule; these two rules close the static corner so an
+undeclared kind or edge cannot even be *written* without a manifest
+diff showing up in review:
+
+* **TPL511** — every ``<...recorder>.record("<kind>", ...)`` call site
+  must use a kind declared somewhere in the manifest, and a kind
+  declared batch-level (``decode``/``error``/``restart``/``stall``)
+  must never be recorded with a ``request_id`` (it would enter the
+  per-request DFA it was deliberately excluded from).
+* **TPL512** — lifecycle-transition call sites
+  (``check_lifecycle_edge(old, new)``, ``_set_lifecycle(state)``) and
+  direct ``*.lifecycle = <state>`` assignments must use declared
+  states, and statically-known (old, new) pairs must be declared
+  edges.  ``LIFECYCLE_SERVING``-style constants resolve to their
+  lowercase suffix, so the supervisor's symbolic spellings are checked
+  too; dynamically computed states are out of static reach (the
+  runtime sanitizer owns those).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from tools.dettest import lifecycle_grammar
+from tools.tpulint.astutil import call_bare_name
+
+#: receiver names that mark a ``.record(...)`` call as a
+#: flight-recorder record (``recorder.record``, ``self._recorder.record``,
+#: ``rep.engine.recorder.record`` — the naming discipline for recorder
+#: handles in this codebase).
+_RECORDER_MARK = "recorder"
+
+_SET_LIFECYCLE_NAMES = frozenset({"_set_lifecycle", "set_lifecycle"})
+
+_LIFECYCLE_CONST_PREFIX = "LIFECYCLE_"
+
+
+def _receiver_name(func: ast.expr) -> Optional[str]:
+    """Last identifier of the receiver of ``recv.attr(...)``."""
+    if not isinstance(func, ast.Attribute):
+        return None
+    value = func.value
+    if isinstance(value, ast.Name):
+        return value.id
+    if isinstance(value, ast.Attribute):
+        return value.attr
+    return None
+
+
+def _state_str(node: ast.expr) -> Optional[str]:
+    """Statically resolve a lifecycle-state expression: a string
+    constant, or a ``LIFECYCLE_<STATE>`` symbolic name (its lowercase
+    suffix).  None = not statically known."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    name = None
+    if isinstance(node, ast.Name):
+        name = node.id
+    elif isinstance(node, ast.Attribute):
+        name = node.attr
+    if name is not None and name.startswith(_LIFECYCLE_CONST_PREFIX):
+        return name[len(_LIFECYCLE_CONST_PREFIX):].lower()
+    return None
+
+
+def _is_none(node: ast.expr) -> bool:
+    return isinstance(node, ast.Constant) and node.value is None
+
+
+def check_record_kinds(tree: ast.Module, rel_path: str, emit) -> None:  # noqa: ANN001
+    """TPL511 over every recorder ``record()`` call of the module."""
+    declared = lifecycle_grammar.all_kinds()
+    per_request = lifecycle_grammar.request_kinds()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if call_bare_name(func) != "record":
+            continue
+        receiver = _receiver_name(func)
+        if receiver is None or _RECORDER_MARK not in receiver.lower():
+            continue
+        if not node.args:
+            continue
+        first = node.args[0]
+        if not (isinstance(first, ast.Constant)
+                and isinstance(first.value, str)):
+            continue  # dynamic kind: the runtime sanitizer's problem
+        kind = first.value
+        has_request_id = (
+            len(node.args) > 1 and not _is_none(node.args[1])
+        ) or any(
+            kw.arg == "request_id" and not _is_none(kw.value)
+            for kw in node.keywords
+        )
+        if kind not in declared:
+            emit(
+                node, "TPL511",
+                f"kind {kind!r} is not declared in LIFECYCLE_MANIFEST",
+            )
+        elif has_request_id and kind not in per_request:
+            emit(
+                node, "TPL511",
+                f"batch-level kind {kind!r} recorded with a request_id "
+                f"(it has no per-request DFA edges)",
+            )
+
+
+def check_lifecycle_transitions(
+    tree: ast.Module, rel_path: str, emit  # noqa: ANN001
+) -> None:
+    """TPL512 over transition call sites and lifecycle assignments."""
+    states = lifecycle_grammar.engine_states()
+    edges = lifecycle_grammar.engine_edges()
+    entries = lifecycle_grammar.engine_entry_states()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            name = call_bare_name(node.func)
+            if name == "check_lifecycle_edge" and len(node.args) >= 2:
+                old = _state_str(node.args[0])
+                new = _state_str(node.args[1])
+                for state in (old, new):
+                    if state is not None and state not in states:
+                        emit(
+                            node, "TPL512",
+                            f"state {state!r} is not a declared "
+                            f"lifecycle state",
+                        )
+                        break
+                else:
+                    if (
+                        _is_none(node.args[0])
+                        and new is not None
+                        and new not in entries
+                    ):
+                        emit(
+                            node, "TPL512",
+                            f"{new!r} is not a declared entry state",
+                        )
+                    elif (
+                        old is not None
+                        and new is not None
+                        and (old, new) not in edges
+                    ):
+                        emit(
+                            node, "TPL512",
+                            f"{old} -> {new} is not a declared "
+                            f"lifecycle edge",
+                        )
+            elif name in _SET_LIFECYCLE_NAMES and node.args:
+                state = _state_str(node.args[0])
+                if state is not None and state not in states:
+                    emit(
+                        node, "TPL512",
+                        f"state {state!r} is not a declared lifecycle "
+                        f"state",
+                    )
+        elif isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = (
+                node.targets
+                if isinstance(node, ast.Assign)
+                else [node.target]
+            )
+            if not any(
+                isinstance(t, ast.Attribute) and t.attr == "lifecycle"
+                for t in targets
+            ):
+                continue
+            state = _state_str(node.value) if node.value else None
+            if state is not None and state not in states:
+                emit(
+                    node, "TPL512",
+                    f"state {state!r} is not a declared lifecycle state",
+                )
+
+
+def check_module(tree: ast.Module, rel_path: str, emit) -> None:  # noqa: ANN001
+    check_record_kinds(tree, rel_path, emit)
+    check_lifecycle_transitions(tree, rel_path, emit)

@@ -322,3 +322,155 @@ def maybe_check(engine: "LLMEngine") -> None:
     ``TGIS_TPU_SANITIZE=1``."""
     if enabled():
         check_engine(engine)
+
+
+# ------------------------------------------------------ lifecycle grammar
+
+# The reviewed grammar lives with the schedule explorer
+# (tools/dettest/lifecycle_grammar.py — the checked-in
+# LIFECYCLE_MANIFEST); the installed package loads it by path from a
+# source checkout and degrades to grammar-off in a bare wheel, exactly
+# like the compile-lattice manifest is a repo artifact.  Statically the
+# same manifest backs tpulint TPL511/TPL512.
+
+#: set TGIS_TPU_GRAMMAR_OBSERVE to a file path to RECORD undeclared
+#: edges instead of raising — the manifest-diff workflow
+#: (docs/STATIC_ANALYSIS.md "Lifecycle grammar"): run the suite in
+#: observe mode, review the observed edges, extend the manifest.
+OBSERVE_ENV_VAR = "TGIS_TPU_GRAMMAR_OBSERVE"
+
+# bound on per-recorder request tracking state; past it the oldest
+# entry evicts and tracking degrades to entry-check-free (a forgotten
+# request must not false-positive as "decode before admit")
+_GRAMMAR_TRACK_CAP = 4096
+
+_grammar_module = None  # tri-state: None=unloaded, False=absent, module
+_observed: "Optional[set]" = None
+
+
+def _load_grammar():  # noqa: ANN202
+    """The lifecycle_grammar module, or None outside a source tree."""
+    global _grammar_module
+    if _grammar_module is None:
+        import importlib.util
+        from pathlib import Path
+
+        path = (
+            Path(__file__).resolve().parents[2]
+            / "tools" / "dettest" / "lifecycle_grammar.py"
+        )
+        _grammar_module = False
+        if path.exists():
+            spec = importlib.util.spec_from_file_location(
+                "_tgis_tpu_lifecycle_grammar", path
+            )
+            if spec is not None and spec.loader is not None:
+                module = importlib.util.module_from_spec(spec)
+                spec.loader.exec_module(module)
+                _grammar_module = module
+    return _grammar_module or None
+
+
+def _observe(edge: str) -> bool:
+    """Record ``edge`` to the observe file; True when observing."""
+    global _observed
+    path = os.environ.get(OBSERVE_ENV_VAR, "")
+    if not path:
+        return False
+    if _observed is None:
+        _observed = set()
+    if edge not in _observed:
+        _observed.add(edge)
+        with open(path, "a") as f:
+            f.write(edge + "\n")
+    return True
+
+
+class GrammarTracker:
+    """Per-recorder DFA state: request id → last recorded kind.
+
+    Fed by ``FlightRecorder.record`` for every per-request event while
+    ``TGIS_TPU_SANITIZE=1``; raises :class:`SanitizerError` the moment
+    an event arrives out of order (decode before admit, anything after
+    the ledger close), naming the request and the violated edge.
+    """
+
+    def __init__(self, grammar) -> None:  # noqa: ANN001
+        from collections import OrderedDict
+
+        self._edges = grammar.request_edges()
+        self._entry = grammar.request_entry_kinds()
+        self._last: "OrderedDict[str, str]" = OrderedDict()
+        self._evicted = False
+
+    def feed(self, kind: str, request_id: str) -> None:
+        prev = self._last.get(request_id)
+        if prev is None:
+            ok = kind in self._entry or (
+                # tracking state for this request may have been evicted
+                # mid-stream: accept any declared kind rather than
+                # false-positive on a long-lived request
+                self._evicted and kind in self._edges
+            )
+        else:
+            ok = kind in self._edges.get(prev, frozenset())
+        if not ok:
+            edge = f"{prev if prev is not None else '<stream start>'} -> {kind}"
+            if not _observe(f"request: {edge}"):
+                raise SanitizerError(
+                    f"{ENV_VAR}=1: flight-recorder lifecycle grammar "
+                    f"violation for request {request_id!r}: {edge} is "
+                    f"not a declared edge of the per-request event DFA "
+                    f"(tools/dettest/lifecycle_grammar.py "
+                    f"LIFECYCLE_MANIFEST)"
+                )
+        self._last[request_id] = kind
+        self._last.move_to_end(request_id)
+        while len(self._last) > _GRAMMAR_TRACK_CAP:
+            self._last.popitem(last=False)
+            self._evicted = True
+
+
+def track_event(recorder, kind: str, request_id: str) -> None:  # noqa: ANN001
+    """``FlightRecorder.record``'s per-request hook: validate the event
+    against the request's DFA state on this recorder.  No-op unless
+    ``TGIS_TPU_SANITIZE=1`` and the grammar manifest is loadable."""
+    if not enabled():
+        return
+    grammar = _load_grammar()
+    if grammar is None:
+        return
+    tracker = getattr(recorder, "_grammar_tracker", None)
+    if tracker is None:
+        tracker = recorder._grammar_tracker = GrammarTracker(grammar)  # noqa: SLF001
+    tracker.feed(kind, request_id)
+
+
+def check_lifecycle_edge(
+    old: Optional[str], new: str, *, draining: bool = False
+) -> None:
+    """Validate one engine lifecycle transition (``supervisor.
+    _set_lifecycle``'s hook).  ``draining`` flags the front door's
+    drain state: ``recovering -> serving`` is legal in general but
+    forbidden while draining (a SIGTERM landing mid-recovery wins).
+    No-op unless ``TGIS_TPU_SANITIZE=1``."""
+    if not enabled():
+        return
+    grammar = _load_grammar()
+    if grammar is None:
+        return
+    if old is None:
+        ok = new in grammar.engine_entry_states()
+    else:
+        ok = (old, new) in grammar.engine_edges()
+    if ok and draining and (old, new) in grammar.forbidden_while_draining():
+        ok = False
+    if not ok:
+        edge = f"{old if old is not None else '<boot>'} -> {new}"
+        suffix = " while the front door is draining" if draining else ""
+        if not _observe(f"lifecycle: {edge}{suffix}"):
+            raise SanitizerError(
+                f"{ENV_VAR}=1: engine lifecycle transition {edge}{suffix} "
+                f"is not a declared edge of the lifecycle machine "
+                f"(tools/dettest/lifecycle_grammar.py LIFECYCLE_MANIFEST)"
+            )

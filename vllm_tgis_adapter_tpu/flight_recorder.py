@@ -33,6 +33,7 @@ from collections import deque
 from typing import TYPE_CHECKING, Any, Optional
 
 from vllm_tgis_adapter_tpu import metrics
+from vllm_tgis_adapter_tpu.engine import sanitizer
 
 if TYPE_CHECKING:
     from vllm_tgis_adapter_tpu.engine.kv_cache import BlockAllocator
@@ -117,6 +118,11 @@ class FlightRecorder:
         trace_id: Optional[str] = None,
         **detail: Any,
     ) -> None:
+        if request_id is not None:
+            # lifecycle-grammar order check (TGIS_TPU_SANITIZE=1): the
+            # per-request event stream must follow the DFA declared in
+            # tools/dettest/lifecycle_grammar.py
+            sanitizer.track_event(self, kind, request_id)
         self._events.append((
             time.time_ns(),
             time.monotonic_ns(),

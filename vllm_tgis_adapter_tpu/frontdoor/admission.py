@@ -300,6 +300,7 @@ class FrontDoor:
                         timeout, max(0.0, next_deadline - time.time())
                     )
             try:
+                # tpulint: disable=TPL304(bpo-42130 is mitigated here: the loop re-checks _stop on every wake, timeout is bounded by _PUMP_BACKSTOP_S when work is queued, and stop() sets _wake so a swallowed timeout cancellation only delays one backstop interval)
                 await asyncio.wait_for(self._wake.wait(), timeout)
             except asyncio.TimeoutError:
                 pass

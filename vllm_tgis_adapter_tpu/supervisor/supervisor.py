@@ -47,6 +47,7 @@ import time
 from typing import TYPE_CHECKING, Callable, Optional
 
 from vllm_tgis_adapter_tpu import metrics
+from vllm_tgis_adapter_tpu.engine import sanitizer
 from vllm_tgis_adapter_tpu.frontdoor.errors import (
     DeviceOOMError,
     EngineRestartError,
@@ -133,6 +134,16 @@ class EngineSupervisor:
         self._listeners.append(listener)
 
     def _set_lifecycle(self, state: str) -> None:
+        # lifecycle-grammar edge check (TGIS_TPU_SANITIZE=1): the
+        # transition must be a declared edge of the lifecycle machine in
+        # tools/dettest/lifecycle_grammar.py — including the
+        # schedule-dependent rule that recovery never flips a draining
+        # pod back to serving
+        frontdoor = getattr(self.engine, "frontdoor", None)
+        sanitizer.check_lifecycle_edge(
+            getattr(self.engine, "lifecycle", None), state,
+            draining=bool(frontdoor is not None and frontdoor.draining),
+        )
         self.engine.lifecycle = state
         for listener in self._listeners:
             try:
