@@ -94,6 +94,17 @@ them; synchronous dispatch executes on each replica's own worker
 thread, which is how independent accelerator streams behave (CPU-only
 knob; the dp gate sets it for ALL its points, dp=1 included, so
 ratios compare like with like).
+
+Bottleneck-doctor validation (docs/OBSERVABILITY.md "Validating the
+doctor"): BENCH_NO_CHAIN=1 disables the chained-decode overlap
+(SchedulerConfig.enable_chained_decode) so the step loop runs strictly
+plan → dispatch → wait → commit.  The deliberately host-bound run is
+`BENCH_SYNC_DISPATCH=1 BENCH_STEPS=1 BENCH_NO_CHAIN=1 BENCH_OUTPUT=64`
+— one decode step per dispatch with no overlap means every token pays
+the full host round-trip (the longer decode keeps the anatomy window
+past the warmup compiles), host_gap_frac climbs past the host_bound
+threshold, and the run's stamp must list a host_bound verdict in
+doctor_regimes_observed.
 """
 
 from __future__ import annotations
@@ -501,6 +512,16 @@ def run_bench(on_tpu: bool) -> dict:
             # fused tail waste-free (128 % 16 == 0)
             num_decode_steps=int(
                 os.environ.get("BENCH_STEPS", 8 if tiny else 16)
+            ),
+            # BENCH_NO_CHAIN=1: serialize the step loop (no chained-
+            # decode overlap).  Together with BENCH_SYNC_DISPATCH=1 and
+            # BENCH_STEPS=1 this is the deliberately host-bound run the
+            # bottleneck doctor is validated against — every token pays
+            # the full un-overlapped host round-trip, so the run must
+            # stamp a high host_gap_frac and a host_bound verdict below
+            # (docs/OBSERVABILITY.md "Validating the doctor")
+            enable_chained_decode=(
+                os.environ.get("BENCH_NO_CHAIN", "") != "1"
             ),
         ),
         parallel_config=ParallelConfig(dp_replicas=dp),
@@ -952,6 +973,24 @@ def run_bench(on_tpu: bool) -> dict:
             if not on_tpu
             and os.environ.get("BENCH_SYNC_DISPATCH", "") == "1"
             else {}
+        ),
+        **(
+            {"chained_decode": False}
+            if os.environ.get("BENCH_NO_CHAIN", "") == "1"
+            else {}
+        ),
+        # step-anatomy stamps (telemetry/steptime.py): per-replica
+        # device-idle fraction over the run plus every regime the
+        # bottleneck doctor diagnosed — the deliberately host-bound run
+        # (BENCH_SYNC_DISPATCH=1 BENCH_STEPS=1 BENCH_NO_CHAIN=1) must
+        # show a high host gap and a host_bound verdict here
+        "host_gap_frac": {
+            str(e.replica_index): round(e.steptime.host_gap_frac(), 4)
+            for e in engines
+            if len(e.steptime)
+        },
+        "doctor_regimes_observed": sorted(
+            aengine.doctor.regimes_observed
         ),
         **(
             {

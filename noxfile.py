@@ -44,7 +44,10 @@ def tpu_tests(session: nox.Session) -> None:
 def obs_check(session: nox.Session) -> None:
     """Docs ↔ metrics-registry drift gate: boot the HTTP server
     in-process, scrape /metrics, fail if any metric documented in
-    docs/OBSERVABILITY.md is absent from the scrape."""
+    docs/OBSERVABILITY.md is absent from the scrape.  Also exercises
+    /debug/state ?section= filtering, /debug/doctor, and
+    /debug/timeline, and cross-checks the doc's doctor-regime table
+    against telemetry/doctor.py's REGIMES tuple."""
     session.install("-e", ".[tests]")
     session.run(
         "python", "tools/obs_check.py",
@@ -194,8 +197,9 @@ def race_check(session: nox.Session) -> None:
     (docs/STATIC_ANALYSIS.md "Deterministic schedule exploration"):
     run the owned control-plane scenarios (front-door admit/cancel/
     TTL/drain, supervisor recovery vs SIGTERM, kv-tier promotion vs
-    abort/preempt, adapter-pool prefetch vs evict, ledger terminal
-    close) under tools/dettest's seeded deterministic event loop —
+    abort/preempt, adapter-pool prefetch vs evict, doctor episode
+    lifecycle, ledger terminal close) under tools/dettest's seeded
+    deterministic event loop —
     >= 50 distinct schedules each, every schedule checked against the
     scenario invariants AND the lifecycle grammar — plus a bounded
     co-ready-permutation DFS and a seeded-failpoint proof that a

@@ -493,3 +493,43 @@ def test_debug_request_trace_rpc(grpc_client, _servers):
         with pytest.raises(_grpc.RpcError) as excinfo:
             stub.GetRequestTrace(debug_pb2.RequestTraceRequest())
         assert excinfo.value.code() == _grpc.StatusCode.INVALID_ARGUMENT
+
+
+def test_debug_timeline_rpc(grpc_client, _servers):
+    """tgis_tpu.debug.v1.Debug/GetTimeline serves the same chrome-trace
+    JSON as GET /debug/timeline (telemetry/timeline.py), so offline
+    tooling can pull a Perfetto-loadable artifact over gRPC."""
+    import json as _json
+
+    import grpc as _grpc
+
+    from vllm_tgis_adapter_tpu.grpc.debug import DebugStub
+    from vllm_tgis_adapter_tpu.grpc.pb import debug_pb2
+
+    grpc_client.make_request("timeline probe", max_new_tokens=3)
+    with _grpc.insecure_channel(f"localhost:{_servers.grpc_port}") as ch:
+        stub = DebugStub(ch)
+        resp = stub.GetTimeline(debug_pb2.TimelineRequest(format="chrome"))
+        trace = _json.loads(resp.timeline_json)
+        events = trace["traceEvents"]
+        assert any(e["ph"] == "M" for e in events)
+        assert any(
+            e["ph"] == "X" and e.get("cat") == "step" for e in events
+        )
+
+        # empty format defaults to chrome; last_steps bounds step rows
+        bounded = _json.loads(
+            stub.GetTimeline(
+                debug_pb2.TimelineRequest(last_steps=1)
+            ).timeline_json
+        )
+        steps = {
+            e["args"]["step"]
+            for e in bounded["traceEvents"]
+            if e["ph"] == "X" and e.get("cat") == "step"
+        }
+        assert len(steps) <= 1
+
+        with pytest.raises(_grpc.RpcError) as excinfo:
+            stub.GetTimeline(debug_pb2.TimelineRequest(format="xml"))
+        assert excinfo.value.code() == _grpc.StatusCode.INVALID_ARGUMENT

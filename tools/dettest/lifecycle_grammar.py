@@ -112,7 +112,7 @@ LIFECYCLE_MANIFEST = {
     # Declared here so tpulint TPL511 can reject a record() call whose
     # kind is in NO part of the manifest, and so obs_check can assert
     # request ∪ batch == flight_recorder.EVENT_KINDS exactly.
-    "batch_events": ["decode", "error", "restart", "stall"],
+    "batch_events": ["decode", "error", "restart", "stall", "doctor"],
 }
 
 

@@ -45,6 +45,7 @@ from vllm_tgis_adapter_tpu.frontdoor.admission import FrontDoor
 from vllm_tgis_adapter_tpu.frontdoor.errors import AdmissionShedError
 from vllm_tgis_adapter_tpu.supervisor.lifecycle import LIFECYCLE_SERVING
 from vllm_tgis_adapter_tpu.supervisor.supervisor import EngineSupervisor
+from vllm_tgis_adapter_tpu.telemetry.doctor import Doctor, ReplicaSignals
 from vllm_tgis_adapter_tpu.telemetry.ledger import CostLedger
 from vllm_tgis_adapter_tpu.utils import spawn_task
 
@@ -652,6 +653,158 @@ class AdapterPoolScenario(Scenario):
 # ------------------------------------------------------------- 5. ledger
 
 
+class DoctorScenario(Scenario):
+    """Bottleneck-doctor episode lifecycle under racing evaluations.
+
+    Two replicas' signal sources race: replica 0 sees host_bound-firing
+    windows from one task and quiet windows from another (conflicting
+    diagnoses of the SAME (replica, regime) key — the interleaving
+    decides whether hysteresis ever accumulates OPEN_AFTER consecutive
+    firing evals), while replica 1's queue_bound signals fire
+    unambiguously.  On EVERY schedule the recorder's ``doctor`` event
+    stream must be grammatical per (replica, regime) — open →
+    evidence* → close, never unbalanced — and the profiler capture the
+    host_bound episode brackets must start/stop exactly as many times
+    as episodes opened/closed with it.
+    """
+
+    name = "doctor-episode-lifecycle"
+
+    @staticmethod
+    def _firing_host(replica: int) -> "ReplicaSignals":
+        return ReplicaSignals(
+            replica=replica, steps=16, host_gap_frac=0.6,
+        )
+
+    @staticmethod
+    def _quiet(replica: int) -> "ReplicaSignals":
+        return ReplicaSignals(replica=replica, steps=16)
+
+    @staticmethod
+    def _firing_queue(replica: int) -> "ReplicaSignals":
+        return ReplicaSignals(
+            replica=replica, steps=16, waiting=32, running=4,
+            max_num_seqs=4,
+        )
+
+    def build(self):  # noqa: ANN201
+        recorder = FlightRecorder()
+        profiler = SimpleNamespace(starts=0, stops=0)
+
+        def _start():  # noqa: ANN202
+            profiler.starts += 1
+            return {"status": "started"}
+
+        def _stop():  # noqa: ANN202
+            profiler.stops += 1
+            return {"status": "stopped"}
+
+        profiler.start = _start
+        profiler.stop = _stop
+        doctor = Doctor(
+            record=lambda replica, **detail: recorder.record(
+                "doctor", replica=replica, **detail
+            ),
+            profiler=lambda: profiler,
+            min_interval=0.0,
+        )
+        return SimpleNamespace(
+            recorder=recorder,
+            doctor=doctor,
+            profiler=profiler,
+            clock=0.0,
+            tasks=set(),
+        )
+
+    def _eval(self, state, signals) -> None:  # noqa: ANN001
+        # one shared monotone clock across the racing tasks: the
+        # doctor differences counters against it, and interleaved
+        # per-task clocks would run it backwards
+        state.clock += 1.0
+        state.doctor.evaluate(signals, now=state.clock)
+
+    async def run(self, state) -> None:  # noqa: ANN001
+        async def _host_bound_rounds() -> None:
+            for _ in range(5):
+                await asyncio.sleep(0)
+                self._eval(state, [self._firing_host(0)])
+
+        async def _quiet_rounds() -> None:
+            for _ in range(5):
+                await asyncio.sleep(0)
+                self._eval(state, [self._quiet(0)])
+
+        async def _queue_bound_rounds() -> None:
+            for _ in range(4):
+                await asyncio.sleep(0)
+                self._eval(state, [self._firing_queue(1)])
+
+        await _gather([
+            spawn_task(_host_bound_rounds(), name="host-bound-0",
+                       retain=state.tasks),
+            spawn_task(_quiet_rounds(), name="quiet-0",
+                       retain=state.tasks),
+            spawn_task(_queue_bound_rounds(), name="queue-bound-1",
+                       retain=state.tasks),
+        ])
+        # deterministic quiet tail: whatever the interleaving opened
+        # must close (CLOSE_AFTER quiet evals per replica), so the
+        # post-run checks see a fully settled doctor
+        for _ in range(4):
+            self._eval(state, [self._quiet(0), self._quiet(1)])
+
+    def check(self, state) -> None:  # noqa: ANN001
+        assert not state.doctor.active, (
+            f"episodes still open after quiet tail: "
+            f"{[e.to_dict() for e in state.doctor.active]}"
+        )
+        # per-(replica, regime) grammar: open -> evidence* -> close
+        open_keys: set[tuple[int, str]] = set()
+        for event in state.recorder.events():
+            if event["kind"] != "doctor":
+                continue
+            assert "request_id" not in event, (
+                "doctor events are batch-scoped, never per-request"
+            )
+            detail = event["detail"]
+            key = (detail["replica"], detail["regime"])
+            phase = detail["phase"]
+            if phase == "open":
+                assert key not in open_keys, f"double open for {key}"
+                open_keys.add(key)
+            elif phase in ("evidence", "close"):
+                assert key in open_keys, (
+                    f"{phase} without an open episode for {key}"
+                )
+                if phase == "close":
+                    open_keys.discard(key)
+            else:  # pragma: no cover — schema guard
+                raise AssertionError(f"unknown doctor phase {phase!r}")
+        assert not open_keys, f"unclosed doctor streams: {open_keys}"
+        # queue_bound fires 4 consecutive rounds on replica 1 — past
+        # OPEN_AFTER on every schedule, so at least that episode exists
+        closed = [e.regime for e in state.doctor.episodes]
+        assert "queue_bound" in closed, (
+            f"queue_bound never opened (closed episodes: {closed})"
+        )
+        # capture conservation: one start per captured open, one stop
+        # per captured close — the quiet tail closed everything
+        assert state.profiler.starts == state.profiler.stops, (
+            f"profiler capture unbalanced: {state.profiler.starts} "
+            f"starts vs {state.profiler.stops} stops"
+        )
+        captured = sum(
+            1 for e in state.doctor.episodes if e.captured
+        )
+        assert state.profiler.starts == captured, (
+            f"{state.profiler.starts} captures for {captured} "
+            f"captured episodes"
+        )
+
+    def recorders(self, state) -> list:  # noqa: ANN001
+        return [state.recorder]
+
+
 class LedgerScenario(Scenario):
     """Close-at-terminal-outcome: finish vs abort vs shed racing for
     one request's single ledger record.
@@ -796,6 +949,10 @@ SCENARIOS = [
     SupervisorScenario(),
     KvTierScenario(),
     AdapterPoolScenario(),
+    # DoctorScenario rides BEFORE LedgerScenario: race_check's
+    # exhaustive-DFS pass assumes SCENARIOS[-1] is the small ledger
+    # scenario
+    DoctorScenario(),
     LedgerScenario(),
 ]
 

@@ -6,7 +6,11 @@ documented in docs/OBSERVABILITY.md is missing from the scrape (the
 three flight-recorder/watchdog metrics included).  Also hits
 ``GET /debug/state`` and fails if the snapshot is missing any of the
 top-level sections the doc promises — the introspection surface and its
-documentation cannot drift silently either.  Run directly with
+documentation cannot drift silently either.  The step-anatomy/doctor
+surfaces are gated the same way: ``?section=`` filtering,
+``GET /debug/doctor``, and the ``GET /debug/timeline`` chrome trace are
+exercised over the live server, and the doc's regime rule table must
+match ``telemetry.doctor.REGIMES`` exactly.  Run directly with
 ``JAX_PLATFORMS=cpu python tools/obs_check.py``.
 """
 
@@ -62,6 +66,8 @@ DEBUG_STATE_KEYS = (
     "engine", "supervisor", "frontdoor", "router", "kv_host_tier",
     "ledger",
     "slo",
+    "step_timeline",
+    "doctor",
     "replicas",
     "compile_tracker",
     "watchdog",
@@ -104,6 +110,35 @@ REQUIRED_TELEMETRY_METRICS = (
     "tgis_tpu_model_tflops_per_s",
     "tgis_tpu_mfu",
 )
+
+# step anatomy + bottleneck doctor (docs/OBSERVABILITY.md "Step
+# anatomy & doctor"): the phase histograms, the device-idle gauge, and
+# the episode counters must be documented AND served
+REQUIRED_STEPTIME_METRICS = (
+    "tgis_tpu_step_anatomy_seconds",
+    "tgis_tpu_host_gap_frac",
+    "tgis_tpu_doctor_episodes_total",
+    "tgis_tpu_doctor_active_regimes",
+)
+
+
+def documented_regimes(doc_path: Path) -> set[str]:
+    """Backticked regime names from the first column of the doctor's
+    "Regime rule table" in docs/OBSERVABILITY.md — cross-checked
+    against ``telemetry.doctor.REGIMES`` so the doc's rule table and
+    the classifier cannot drift."""
+    regimes: set[str] = set()
+    in_table = False
+    for line in doc_path.read_text().splitlines():
+        if line.startswith("| Regime |"):
+            in_table = True
+            continue
+        if in_table:
+            if not line.startswith("|"):
+                break
+            first_cell = line.split("|")[1]
+            regimes.update(re.findall(r"`([a-z_]+)`", first_cell))
+    return regimes
 
 
 async def scrape_metrics() -> tuple[str, dict]:
@@ -152,14 +187,31 @@ async def scrape_metrics() -> tuple[str, dict]:
                 )
             except OSError:
                 continue
-            state_body = await asyncio.to_thread(
-                lambda: urllib.request.urlopen(
-                    f"http://127.0.0.1:{port}/debug/state", timeout=5
-                ).read()
-            )
             import json
 
-            return body.decode(), json.loads(state_body)
+            def fetch(path: str) -> bytes:
+                return urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}", timeout=5
+                ).read()
+
+            state_body = await asyncio.to_thread(fetch, "/debug/state")
+            # the ?section= filter, the doctor view, and the chrome
+            # trace exercised over the SAME live server, so the new
+            # debug surfaces are gated end-to-end, not just imported
+            section_body = await asyncio.to_thread(
+                fetch, "/debug/state?section=doctor,step_timeline"
+            )
+            doctor_body = await asyncio.to_thread(fetch, "/debug/doctor")
+            timeline_body = await asyncio.to_thread(
+                fetch, "/debug/timeline?format=chrome"
+            )
+            return (
+                body.decode(),
+                json.loads(state_body),
+                json.loads(section_body),
+                json.loads(doctor_body),
+                json.loads(timeline_body),
+            )
         raise RuntimeError("HTTP server never became scrapeable")
     finally:
         server_task.cancel()
@@ -217,7 +269,9 @@ def main() -> int:
         return 1
     undocumented = sorted(
         name
-        for name in REQUIRED_FRONTDOOR_METRICS + REQUIRED_TELEMETRY_METRICS
+        for name in REQUIRED_FRONTDOOR_METRICS
+        + REQUIRED_TELEMETRY_METRICS
+        + REQUIRED_STEPTIME_METRICS
         if name not in documented
     )
     if undocumented:
@@ -226,7 +280,21 @@ def main() -> int:
             "docs/OBSERVABILITY.md: " + ", ".join(undocumented)
         )
         return 1
-    scraped, state = asyncio.run(scrape_metrics())
+    # doc's regime rule table ↔ the classifier's REGIMES tuple
+    from vllm_tgis_adapter_tpu.telemetry.doctor import REGIMES
+
+    doc_regimes = documented_regimes(doc_path)
+    if doc_regimes != set(REGIMES):
+        print(
+            "obs_check: doctor regime rule table diverged from "
+            "telemetry.doctor.REGIMES: doc-only "
+            f"{sorted(doc_regimes - set(REGIMES))}, code-only "
+            f"{sorted(set(REGIMES) - doc_regimes)}"
+        )
+        return 1
+    scraped, state, section_state, doctor_view, timeline = asyncio.run(
+        scrape_metrics()
+    )
     missing = sorted(
         name for name in documented if name not in scraped
     )
@@ -262,9 +330,40 @@ def main() -> int:
             + ", ".join(state_missing)
         )
         return 1
+    # ?section= filtering returned exactly the asked-for sections
+    if set(section_state) != {"doctor", "step_timeline"}:
+        print(
+            "obs_check: ?section=doctor,step_timeline returned "
+            f"{sorted(section_state)} instead of exactly the two "
+            "requested sections"
+        )
+        return 1
+    # the /debug/doctor view serves the classifier's full shape
+    doctor_missing = [
+        k for k in ("regimes", "active", "recent", "thresholds")
+        if k not in doctor_view
+    ]
+    if doctor_missing or doctor_view.get("regimes") != list(REGIMES):
+        print(
+            "obs_check: /debug/doctor is missing keys "
+            f"{doctor_missing} or its regime list diverged from "
+            "telemetry.doctor.REGIMES"
+        )
+        return 1
+    # the chrome trace is well-formed enough for Perfetto to load
+    events = timeline.get("traceEvents")
+    if not isinstance(events, list) or not any(
+        e.get("ph") == "M" for e in events
+    ):
+        print(
+            "obs_check: /debug/timeline?format=chrome returned no "
+            "traceEvents/metadata — not a loadable chrome trace"
+        )
+        return 1
     print(
         f"obs_check: all {len(documented)} documented metrics present "
-        "on /metrics; /debug/state serves every documented section"
+        "on /metrics; /debug/state (+?section=), /debug/doctor, and "
+        "/debug/timeline serve every documented section"
     )
     return 0
 

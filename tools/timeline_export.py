@@ -1,0 +1,117 @@
+"""Offline chrome-trace export from debug-state dumps.
+
+A stall watchdog dump, a saved ``GET /debug/state`` response, or a
+``DumpState`` RPC payload all carry the same snapshot — step-anatomy
+records, flight-recorder events, doctor episodes.  This tool turns one
+of them into a Perfetto-loadable chrome-trace JSON *after the fact*,
+when the serving process may be long gone:
+
+    python tools/timeline_export.py stall_dump.json -o timeline.json
+    python tools/timeline_export.py state.json --ledger-log ledger.jsonl
+
+``--ledger-log`` folds a ``--ledger-log`` JSONL file in as offline
+per-request spans (arrival → last decode), so request lifetimes line
+up under the step tracks they were served by.  The exporter is the
+exact same code path as ``GET /debug/timeline`` and the ``GetTimeline``
+RPC (telemetry/timeline.py) — one serializer, three surfaces.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+
+def load_ledger_records(path: str) -> list[dict]:
+    """--ledger-log JSONL → record dicts (bad lines are skipped loudly:
+    a torn final line from a killed process must not void the export)."""
+    records: list[dict] = []
+    skipped = 0
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError:
+                skipped += 1
+    if skipped:
+        print(
+            f"warning: skipped {skipped} unparsable ledger line(s)",
+            file=sys.stderr,
+        )
+    return records
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="debug-state dump -> Perfetto chrome-trace JSON",
+    )
+    parser.add_argument(
+        "state",
+        help="debug-state JSON file (stall dump, saved /debug/state "
+        "response, or DumpState payload)",
+    )
+    parser.add_argument(
+        "-o", "--output",
+        help="output path (default: <state stem>.trace.json)",
+    )
+    parser.add_argument(
+        "--ledger-log",
+        help="--ledger-log JSONL to fold in as offline request spans",
+    )
+    parser.add_argument(
+        "--last-steps", type=int, default=None,
+        help="cap on StepRecords per replica (default: all in the dump)",
+    )
+    parser.add_argument(
+        "--format", default="chrome", choices=("chrome",),
+        help="export format (chrome-trace JSON is the only format)",
+    )
+    args = parser.parse_args(argv)
+
+    from vllm_tgis_adapter_tpu.telemetry.timeline import (
+        chrome_trace_from_state,
+    )
+
+    try:
+        with open(args.state, encoding="utf-8") as fh:
+            state = json.load(fh)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"error: cannot read {args.state}: {e}", file=sys.stderr)
+        return 2
+    if not isinstance(state, dict):
+        print(
+            f"error: {args.state} is not a debug-state object",
+            file=sys.stderr,
+        )
+        return 2
+
+    ledger_records = (
+        load_ledger_records(args.ledger_log) if args.ledger_log else None
+    )
+    trace = chrome_trace_from_state(
+        state, ledger_records=ledger_records, last_steps=args.last_steps
+    )
+    out = args.output or str(
+        Path(args.state).with_suffix("").name + ".trace.json"
+    )
+    with open(out, "w", encoding="utf-8") as fh:
+        json.dump(trace, fh, default=str)
+    n_events = len(trace["traceEvents"])
+    print(
+        f"wrote {out}: {n_events} trace events from "
+        f"{len(state.get('step_timeline', {}).get('replicas', []))} "
+        f"replica(s) — open in https://ui.perfetto.dev"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -10,9 +10,9 @@ diff showing up in review:
 
 * **TPL511** — every ``<...recorder>.record("<kind>", ...)`` call site
   must use a kind declared somewhere in the manifest, and a kind
-  declared batch-level (``decode``/``error``/``restart``/``stall``)
-  must never be recorded with a ``request_id`` (it would enter the
-  per-request DFA it was deliberately excluded from).
+  declared batch-level (``decode``/``error``/``restart``/``stall``/
+  ``doctor``) must never be recorded with a ``request_id`` (it would
+  enter the per-request DFA it was deliberately excluded from).
 * **TPL512** — lifecycle-transition call sites
   (``check_lifecycle_edge(old, new)``, ``_set_lifecycle(state)``) and
   direct ``*.lifecycle = <state>`` assignments must use declared

@@ -56,6 +56,7 @@ from vllm_tgis_adapter_tpu.telemetry import (
     SloEngine,
     TokenRateEwma,
 )
+from vllm_tgis_adapter_tpu.telemetry.doctor import Doctor, ReplicaSignals
 from vllm_tgis_adapter_tpu.telemetry.slo import (
     estimate_tokens,
     parse_slo_config,
@@ -194,6 +195,16 @@ class AsyncLLMEngine:
         self.ledger = CostLedger(
             sink=self._ledger_sink,
             recorder=self.engine.recorder.record,
+        )
+        # bottleneck doctor (telemetry/doctor.py): fleet-level regime
+        # classifier over the per-replica step-anatomy windows.  The
+        # record hook lands `doctor` events on the BLAMED replica's
+        # recorder (batch-scoped, no request_id); the profiler hook
+        # resolves the shared controller lazily so a later
+        # --profile-dir enables episode auto-capture without re-wiring
+        self.doctor = Doctor(
+            record=self._doctor_record,
+            profiler=self._doctor_profiler,
         )
         # --capture-trace: admitted-traffic shape (token counts and
         # arrival offsets, never content) for tools/trace_replay.py;
@@ -1106,7 +1117,17 @@ class AsyncLLMEngine:
             replica=stalled.index,
             heartbeat_age_s=round(now - stalled.last_beat, 3),
         )
-        return self.debug_state()
+        state = self.debug_state()
+        # the blamed replica's recent step anatomy rides in the dump:
+        # the first question a stall triage asks is "what did its last
+        # steps look like", and the dump must answer without a live
+        # process to query
+        state["stalled_replica"] = {
+            "replica": stalled.index,
+            "heartbeat_age_s": round(now - stalled.last_beat, 3),
+            "step_records": stalled.engine.steptime.records(last_n=64),
+        }
+        return state
 
     def debug_state(self, last_events: int = 256) -> dict:
         """The one engine-state snapshot every introspection surface
@@ -1174,6 +1195,21 @@ class AsyncLLMEngine:
             # aggregates and per-class SLO attainment/burn
             "ledger": self.ledger.debug_state(),
             "slo": self.slo_engine.debug_state(),
+            # step-time anatomy (telemetry/steptime.py): per-replica
+            # phase-decomposed StepRecords — the rows the chrome-trace
+            # exporter (telemetry/timeline.py) turns into tracks
+            "step_timeline": {
+                "replicas": [
+                    {
+                        "replica": rep.index,
+                        **rep.engine.steptime.debug_state(),
+                    }
+                    for rep in self._replicas
+                ],
+            },
+            # bottleneck doctor (telemetry/doctor.py): active/recent
+            # regime episodes with their rule evidence
+            "doctor": self.doctor.debug_state(),
             "replicas": replicas,
             "compile_tracker": {
                 "compiled_shapes": compile_tracker.num_shapes(),
@@ -1244,6 +1280,79 @@ class AsyncLLMEngine:
                     )
         if committed > 0:
             self._token_rate[rep.index].update(committed, now)
+        # bottleneck doctor: throttled internally (cheap clock check
+        # before signals are even built), so this rides every commit
+        self.doctor.maybe_evaluate(self._doctor_signals)
+
+    # ------------------------------------------------------------- doctor
+
+    def _doctor_record(self, replica: int, **detail) -> None:
+        """Doctor event hook: one batch-scoped ``doctor`` event on the
+        blamed replica's recorder (falls back to replica 0 when the
+        blamed index is gone mid-rescale)."""
+        rep = (
+            self._replicas[replica]
+            if 0 <= replica < len(self._replicas)
+            else self._replicas[0]
+        )
+        rep.engine.recorder.record(
+            "doctor", step=rep.engine.step_counter,
+            replica=replica, **detail,
+        )
+
+    @staticmethod
+    def _doctor_profiler():  # noqa: ANN205 — ProfilerController (lazy import)
+        from vllm_tgis_adapter_tpu.profiler import get_controller
+
+        # the shared singleton: passing None never clobbers a real
+        # --profile-dir configured elsewhere, and start() on a
+        # disabled controller raises ProfilerError (doctor degrades)
+        return get_controller(None)
+
+    def _doctor_signals(self) -> "list[ReplicaSignals]":
+        """One ReplicaSignals per replica.  Process-global compile
+        signals are attributed to replica 0 only — one compile storm
+        must open ONE episode, not one per dp replica; same for the
+        shared host KV tier's page-movement counters."""
+        from vllm_tgis_adapter_tpu import compile_tracker
+        from vllm_tgis_adapter_tpu.flight_recorder import allocator_stats
+
+        inflight = compile_tracker.inflight_dispatch()
+        inflight_age = inflight[1] if inflight is not None else 0.0
+        recompiles = compile_tracker.total_recompiles()
+        tier = getattr(self.engine, "kv_tier", None)
+        tier_pages = (
+            tier.demoted_pages + tier.promoted_pages
+            if tier is not None
+            else 0
+        )
+        signals = []
+        for rep in self._replicas:
+            eng = rep.engine
+            alloc = allocator_stats(eng.scheduler.allocator)
+            spec = getattr(eng.runner, "spec", None)
+            acceptance = None
+            if spec is not None and spec.acceptance_ewma.initialized:
+                acceptance = spec.acceptance_ewma.value
+            first = rep.index == 0
+            signals.append(ReplicaSignals(
+                replica=rep.index,
+                steps=min(len(eng.steptime), eng.steptime.window),
+                host_gap_frac=eng.steptime.host_gap_frac(),
+                waiting=len(eng.scheduler.waiting),
+                running=len(eng.scheduler.running),
+                max_num_seqs=(
+                    eng.config.scheduler_config.max_num_seqs
+                ),
+                recompiles=recompiles if first else 0,
+                compile_inflight_age_s=inflight_age if first else 0.0,
+                fragmentation=alloc["fragmentation"],
+                occupancy=alloc["occupancy"],
+                tier_pages_moved=tier_pages if first else 0,
+                spec_active=spec is not None,
+                spec_acceptance=acceptance,
+            ))
+        return signals
 
     def _link_resume(self, request_id: str, path: str) -> None:
         """Zero-duration resume span LINKED to the request's live
@@ -1373,6 +1482,10 @@ class AsyncLLMEngine:
             # SLO attainment/burn gauges refresh with the same cadence
             # (every stats tick and every /metrics scrape)
             self.slo_engine.refresh_gauges()
+            # doctor rides the same cadence so open episodes CLOSE
+            # even when commits stop (an idle engine must not pin a
+            # stale regime — or a profiler capture — forever)
+            self.doctor.maybe_evaluate(self._doctor_signals)
         except Exception:  # pragma: no cover — metrics are best-effort
             logger.debug("engine gauge refresh failed", exc_info=True)
         return used, num_blocks
@@ -1466,6 +1579,19 @@ class AsyncLLMEngine:
                     f", XLA shapes: {shapes} "
                     f"({compile_tracker.total_recompiles()} compiles)"
                 )
+            # step anatomy + doctor verdict in the line operators tail:
+            # host_gap% is the "is the device waiting on the host"
+            # number, and an active regime set is the doctor paging
+            gaps = [
+                e.steptime.host_gap_frac()
+                for e in engines
+                if len(e.steptime)
+            ]
+            if gaps:
+                line += f", host gap: {100 * max(gaps):.1f}%"
+            regimes = self.doctor.active_regimes()
+            if regimes:
+                line += f", doctor: {'+'.join(regimes)}"
             logger.info("Engine stats: %s", line)
 
     # ------------------------------------------------------------- step loop

@@ -754,6 +754,17 @@ class SchedulerConfig:
     # afterwards, so up to K-1 speculatively decoded tokens per finished
     # sequence are discarded — cheap next to the dispatch savings.
     num_decode_steps: int = 8
+    # chained-decode overlap (async scheduling): while one decode wave
+    # runs on device, its successor is planned and enqueued from
+    # device-resident token feedback.  False serializes the step loop —
+    # plan / dispatch / wait / commit strictly in sequence — a
+    # diagnostic kill-switch for bisecting overlap bugs and the
+    # deliberately host-bound configuration the bottleneck doctor's
+    # host_bound regime is validated against (docs/OBSERVABILITY.md
+    # "Validating the doctor"): with sync dispatch and
+    # num_decode_steps=1 every token pays the full un-overlapped host
+    # round-trip.
+    enable_chained_decode: bool = True
 
     def __post_init__(self) -> None:
         if self.num_decode_steps < 1:

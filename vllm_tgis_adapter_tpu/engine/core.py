@@ -17,6 +17,7 @@ from vllm_tgis_adapter_tpu.engine.config import EngineConfig
 from vllm_tgis_adapter_tpu.engine.detokenizer import IncrementalDetokenizer
 from vllm_tgis_adapter_tpu.engine.outputs import Logprob, RequestOutput
 from vllm_tgis_adapter_tpu.engine.runner import (
+    SYNC_DISPATCH,
     ModelRunner,
     PromptLogprobInfo,
     SampledToken,
@@ -235,6 +236,18 @@ class LLMEngine:
         # entry; the scheduler shares it for preemption events
         self.recorder = FlightRecorder()
         self.scheduler.recorder = self.recorder
+        # step-time anatomy ring (telemetry/steptime.py): the step loop
+        # stamps phase boundaries below and commit_step finalizes one
+        # StepRecord per dispatch; per-engine like the recorder, so a
+        # supervised rebuild starts a fresh ring with no re-attach
+        from vllm_tgis_adapter_tpu.telemetry.steptime import (
+            StepTimeline,
+            backend_dispatch_blocks,
+        )
+
+        self.steptime = StepTimeline(
+            dispatch_blocks=backend_dispatch_blocks()
+        )
         # monotonically increasing dispatch counter; stamps recorder
         # events so "which wave was in flight" is answerable post-hoc
         self.step_counter = 0
@@ -1694,6 +1707,7 @@ class LLMEngine:
         the pending commit (tokens, page frees) and must wait.
         """
         failpoints.fire("core.plan_step")
+        _st_enter = time.perf_counter()  # steptime: plan-phase origin
         outputs: list[RequestOutput] = []
         for seq in self.scheduler.newly_finished:
             self._seqs.pop(seq.request_id, None)
@@ -1707,16 +1721,22 @@ class LLMEngine:
             outputs.append(seq.to_request_output())
         self.scheduler.newly_finished.clear()
 
+        _drain_s = 0.0
         if not prefill_only and self.scheduler.swap_out_fn is not None:
             # prefill_only means a dispatch is in flight — restoring
             # would rebind runner.caches under it (runner.restore_kv)
+            _t = time.perf_counter()
             self._drain_swap_ins()
+            _drain_s += time.perf_counter() - _t
         if not prefill_only and self.kv_tier is not None:
             # same clean-boundary contract: the promotion scatter also
             # rebinds runner.caches (runner.restore_kv_block)
+            _t = time.perf_counter()
             self._drain_promotions()
+            _drain_s += time.perf_counter() - _t
         self.runner.sync_lora(self.lora_manager)
         plan = self.scheduler.schedule(prefill_only=prefill_only)
+        _st_sched = time.perf_counter()
         if plan is None:
             return outputs, None, None
 
@@ -1739,6 +1759,10 @@ class LLMEngine:
             prepared = self.runner.prepare_decode(plan)
         self._observe_plan(plan, prepared)
         self._record_dispatch(plan)
+        self.steptime.stamp_plan(
+            prepared, t_enter=_st_enter, t_sched=_st_sched,
+            drain_s=_drain_s,
+        )
         return outputs, plan, prepared
 
     def _record_dispatch(self, plan) -> None:  # noqa: ANN001
@@ -1811,11 +1835,28 @@ class LLMEngine:
     def execute_step(self, plan, prepared):
         """Phase 2 (device, lock-free): runs only against the snapshot and
         runner-owned device state — never reads scheduler structures."""
+        self.steptime.begin_wait(prepared)
         if isinstance(plan, RaggedPlan):
-            return self.runner.execute_ragged(prepared)
-        if isinstance(plan, PrefillPlan):
-            return self.runner.execute_prefill(prepared)
-        return self.runner.execute_decode(prepared)
+            result = self.runner.execute_ragged(prepared)
+        elif isinstance(plan, PrefillPlan):
+            result = self.runner.execute_prefill(prepared)
+        else:
+            result = self.runner.execute_decode(prepared)
+        self.steptime.end_wait(prepared)
+        return result
+
+    def _stamp_dispatched(self, prepared, handle) -> None:  # noqa: ANN001
+        """steptime: close the dispatch window, noting whether the
+        runner deferred the device work to wait (SYNC_DISPATCH) and any
+        XLA compile in flight when this step was enqueued."""
+        from vllm_tgis_adapter_tpu import compile_tracker
+
+        inflight = compile_tracker.inflight_dispatch()
+        self.steptime.end_dispatch(
+            prepared,
+            sync=handle is SYNC_DISPATCH,
+            compile_fn=inflight[0] if inflight is not None else None,
+        )
 
     def dispatch_step(self, plan, prepared):
         """Phase 2a (lock-free): enqueue the device work without blocking
@@ -1823,21 +1864,29 @@ class LLMEngine:
         async engine plans and dispatches the NEXT step between the two,
         so host-side prep overlaps device execution."""
         failpoints.fire("core.dispatch_step")  # worker thread: hang-capable
+        self.steptime.begin_dispatch(prepared)
         if isinstance(plan, RaggedPlan):
-            return self.runner.dispatch_ragged(prepared)
-        if isinstance(plan, PrefillPlan):
-            return self.runner.dispatch_prefill(prepared)
-        return self.runner.dispatch_decode(prepared)
+            handle = self.runner.dispatch_ragged(prepared)
+        elif isinstance(plan, PrefillPlan):
+            handle = self.runner.dispatch_prefill(prepared)
+        else:
+            handle = self.runner.dispatch_decode(prepared)
+        self._stamp_dispatched(prepared, handle)
+        return handle
 
     def wait_step(self, plan, prepared, handle):
         """Phase 2b (lock-free, blocking): pull the dispatched step's
         results to host."""
         failpoints.fire("core.wait_step")  # worker thread: hang-capable
+        self.steptime.begin_wait(prepared)
         if isinstance(plan, RaggedPlan):
-            return self.runner.wait_ragged(prepared, handle)
-        if isinstance(plan, PrefillPlan):
-            return self.runner.wait_prefill(prepared, handle)
-        return self.runner.wait_decode(prepared, handle)
+            result = self.runner.wait_ragged(prepared, handle)
+        elif isinstance(plan, PrefillPlan):
+            result = self.runner.wait_prefill(prepared, handle)
+        else:
+            result = self.runner.wait_decode(prepared, handle)
+        self.steptime.end_wait(prepared)
+        return result
 
     # --------------------------------------------------- chained decode waves
 
@@ -1847,20 +1896,30 @@ class LLMEngine:
         full step consumption; token feedback stays on device
         (scheduler.schedule_chained / runner.prepare_chained_decode).
         Returns (plan, prepared) or None when chaining is not safe."""
+        if not self.config.scheduler_config.enable_chained_decode:
+            return None
         if not isinstance(prev_plan, DecodePlan):
             return None
+        _st_enter = time.perf_counter()
         plan = self.scheduler.schedule_chained(prev_plan)
         if plan is None:
             return None
+        _st_sched = time.perf_counter()
         prepared = self.runner.prepare_chained_decode(plan, prev_prepared)
         self._observe_plan(plan, prepared)
         self._record_dispatch(plan)
+        self.steptime.stamp_plan(
+            prepared, t_enter=_st_enter, t_sched=_st_sched, chained=True,
+        )
         return plan, prepared
 
     def dispatch_chained_step(self, plan, prepared, prev_handle):  # noqa: ARG002
         """Phase 2a' (lock-free): enqueue the successor wave behind the
         in-flight one."""
-        return self.runner.dispatch_chained_decode(prepared, prev_handle)
+        self.steptime.begin_dispatch(prepared)
+        handle = self.runner.dispatch_chained_decode(prepared, prev_handle)
+        self._stamp_dispatched(prepared, handle)
+        return handle
 
     def begin_free_epoch(self) -> None:
         self.scheduler.allocator.begin_free_epoch()
@@ -1888,7 +1947,36 @@ class LLMEngine:
         # accounting closed, or we fail HERE rather than serving from
         # corrupt state (engine/sanitizer.py, docs/STATIC_ANALYSIS.md)
         sanitizer.maybe_check(self)
+        self._finish_step_record(plan, prepared)
         return outputs
+
+    def _finish_step_record(self, plan, prepared) -> None:  # noqa: ANN001
+        """Commit boundary: finalize this dispatch's StepRecord
+        (telemetry/steptime.py) with the plan's shape facts."""
+        if prepared is None or plan is None:
+            return
+        if isinstance(plan, RaggedPlan):
+            kind = "ragged"
+            tokens = plan.total_tokens
+            bucket = plan.token_bucket
+            fill = tokens / bucket if bucket else 0.0
+        elif isinstance(plan, PrefillPlan):
+            kind = "solo"
+            tokens = len(plan.token_ids)
+            fill = tokens / plan.bucket_len if plan.bucket_len else 0.0
+        else:
+            kind = "decode-wave"
+            tokens = len(plan.seqs) * plan.num_steps
+            fill = (
+                len(plan.seqs) / plan.batch_bucket
+                if plan.batch_bucket
+                else 0.0
+            )
+        self.steptime.finish(
+            prepared, step=self.step_counter,
+            replica=self.replica_index, kind=kind, tokens=tokens,
+            fill_ratio=fill,
+        )
 
     def _commit_inner(self, plan, result, prepared=None) -> list[RequestOutput]:
         failpoints.fire("core.commit_step")

@@ -92,6 +92,10 @@ EVENT_KINDS = (
     "ledger",         # cost-ledger record closed at terminal outcome
     #                   (telemetry/ledger.py; detail: outcome, tenant,
     #                   request_class, tokens in/out, restarts/resumes)
+    "doctor",         # bottleneck-doctor episode transition (batch-
+    #                   level, telemetry/doctor.py; detail: regime,
+    #                   phase = open | evidence | close, replica, and
+    #                   the rule's evidence payload)
 )
 
 # Per-request decode events are recorded every N committed tokens — one

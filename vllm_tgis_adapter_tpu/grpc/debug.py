@@ -3,11 +3,13 @@
 The gRPC face of the operator tooling: StartProfile / StopProfile
 bracket a ``jax.profiler`` capture (sharing one controller with the HTTP
 routes so either front-end can start or stop it), and DumpState /
-GetRequestTrace serve the live engine-state snapshot and per-request
-flight-recorder timelines — the exact same serializer behind
-``GET /debug/state`` and ``GET /debug/requests/{id}``
-(AsyncLLMEngine.debug_state / request_trace), JSON-encoded on the wire
-so the schema can evolve with the engine without proto churn.
+GetTimeline / GetRequestTrace serve the live engine-state snapshot,
+the unified chrome-trace timeline, and per-request flight-recorder
+timelines — the exact same serializers behind ``GET /debug/state``,
+``GET /debug/timeline``, and ``GET /debug/requests/{id}``
+(AsyncLLMEngine.debug_state / telemetry.timeline / request_trace),
+JSON-encoded on the wire so the schema can evolve with the engine
+without proto churn.
 Registration helpers and the client stub are hand-written for the same
 reason as pb/rpc.py (no grpcio-tools in this environment).
 """
@@ -35,6 +37,8 @@ _METHODS = (
     ("StartProfile", debug_pb2.ProfileRequest, debug_pb2.ProfileResponse),
     ("StopProfile", debug_pb2.ProfileRequest, debug_pb2.ProfileResponse),
     ("DumpState", debug_pb2.StateRequest, debug_pb2.StateResponse),
+    ("GetTimeline", debug_pb2.TimelineRequest,
+     debug_pb2.TimelineResponse),
     ("GetRequestTrace", debug_pb2.RequestTraceRequest,
      debug_pb2.RequestTraceResponse),
 )
@@ -65,6 +69,30 @@ class DebugServicer:
         last = request.last_events
         state = state_fn(last_events=last) if last > 0 else state_fn()
         return debug_pb2.StateResponse(state_json=json.dumps(state))
+
+    async def GetTimeline(self, request, context):  # noqa: ANN001
+        state_fn = getattr(self._engine, "debug_state", None)
+        if state_fn is None:
+            await context.abort(
+                grpc.StatusCode.UNIMPLEMENTED,
+                "engine exposes no debug state",
+            )
+        if request.format not in ("", "chrome"):
+            await context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                f"unknown timeline format {request.format!r}; "
+                "supported: chrome",
+            )
+        from vllm_tgis_adapter_tpu.telemetry.timeline import (
+            chrome_trace_json,
+        )
+
+        last = request.last_steps
+        return debug_pb2.TimelineResponse(
+            timeline_json=chrome_trace_json(
+                state_fn(), last_steps=last if last > 0 else None
+            )
+        )
 
     async def GetRequestTrace(self, request, context):  # noqa: ANN001
         trace_fn = getattr(self._engine, "request_trace", None)

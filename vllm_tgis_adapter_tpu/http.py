@@ -263,6 +263,8 @@ def build_http_server(args: "argparse.Namespace", engine: "AsyncLLMEngine") -> A
     # snapshot/timeline serializer the stall watchdog dumps and the gRPC
     # Debug service serves, so all surfaces tell one story
     app.route("GET", "/debug/state")(_debug_state)
+    app.route("GET", "/debug/doctor")(_debug_doctor)
+    app.route("GET", "/debug/timeline")(_debug_timeline)
     app.route_prefix("GET", "/debug/requests/")(_debug_request)
     return app
 
@@ -334,12 +336,78 @@ async def _stop_profile(app: App, request: HttpRequest) -> HttpResponse:  # noqa
 async def _debug_state(app: App, request: HttpRequest) -> HttpResponse:  # noqa: ARG001
     """Full engine-state snapshot: scheduler queues with ages, KV pool
     stats, in-flight batch plan, compile-tracker + watchdog state, and
-    the flight recorder's recent events (AsyncLLMEngine.debug_state)."""
+    the flight recorder's recent events (AsyncLLMEngine.debug_state).
+
+    ``?section=<key>[,<key>...]`` narrows the payload to the named
+    top-level sections — a dashboard polling ``step_timeline`` every
+    second must not drag the full event ring along each time."""
+    from urllib.parse import parse_qs, urlsplit
+
     engine: AsyncLLMEngine = app.state["engine"]
     state_fn = getattr(engine, "debug_state", None)
     if state_fn is None:
         return error_response(501, "engine exposes no debug state")
-    return JsonResponse(state_fn())
+    state = state_fn()
+    query = parse_qs(urlsplit(request.path).query)
+    sections = [
+        key
+        for raw in query.get("section", ())
+        for key in raw.split(",")
+        if key
+    ]
+    if sections:
+        unknown = [k for k in sections if k not in state]
+        if unknown:
+            return error_response(
+                404,
+                f"unknown debug-state section(s) {unknown}; "
+                f"available: {sorted(state)}",
+            )
+        state = {k: state[k] for k in sections}
+    return JsonResponse(state)
+
+
+async def _debug_doctor(app: App, request: HttpRequest) -> HttpResponse:  # noqa: ARG001
+    """The bottleneck doctor's view alone (telemetry/doctor.py):
+    active/recent regime episodes with evidence + the rule thresholds."""
+    engine: AsyncLLMEngine = app.state["engine"]
+    doctor = getattr(engine, "doctor", None)
+    if doctor is None:
+        return error_response(501, "engine exposes no doctor state")
+    return JsonResponse(doctor.debug_state())
+
+
+async def _debug_timeline(app: App, request: HttpRequest) -> HttpResponse:  # noqa: ARG001
+    """Unified chrome-trace timeline (telemetry/timeline.py): step
+    anatomy + flight-recorder events + doctor episodes, loadable
+    directly in Perfetto / chrome://tracing.  ``?format=chrome`` is the
+    only (and default) format; ``?last_steps=N`` bounds the step rows."""
+    from urllib.parse import parse_qs, urlsplit
+
+    from vllm_tgis_adapter_tpu.telemetry.timeline import (
+        chrome_trace_from_state,
+    )
+
+    engine: AsyncLLMEngine = app.state["engine"]
+    state_fn = getattr(engine, "debug_state", None)
+    if state_fn is None:
+        return error_response(501, "engine exposes no debug state")
+    query = parse_qs(urlsplit(request.path).query)
+    fmt = query.get("format", ["chrome"])[0]
+    if fmt != "chrome":
+        return error_response(
+            400, f"unknown timeline format {fmt!r}; supported: chrome"
+        )
+    last_steps = None
+    raw_last = query.get("last_steps", [None])[0]
+    if raw_last is not None:
+        try:
+            last_steps = max(1, int(raw_last))
+        except ValueError:
+            return error_response(400, "last_steps must be an integer")
+    return JsonResponse(
+        chrome_trace_from_state(state_fn(), last_steps=last_steps)
+    )
 
 
 async def _debug_request(app: App, request: HttpRequest) -> HttpResponse:

@@ -634,6 +634,41 @@ mfu = _get_or_create(
     "when the operator sets the peak (the CPU proxy has none)",
     labelnames=("replica",),
 )
+step_anatomy_seconds = _get_or_create(
+    Histogram,
+    f"{_PREFIX}_step_anatomy_seconds",
+    "Per-step phase decomposition (telemetry/steptime.py): plan / "
+    "prepare / dispatch / device_wait / commit / host_gap, per dp "
+    "replica — the six phases sum to the step wall exactly",
+    labelnames=("phase", "replica"),
+    buckets=(0.0001, 0.0005, 0.001, 0.005, 0.01, 0.025, 0.05, 0.1,
+             0.25, 0.5, 1.0, 2.5),
+)
+host_gap_frac = _get_or_create(
+    Gauge,
+    f"{_PREFIX}_host_gap_frac",
+    "Sliding-window fraction of step wall the device sat idle waiting "
+    "on the host (telemetry/steptime.py) per dp replica — ~0 when the "
+    "pipelined loop overlaps host prep with device execution; the "
+    "doctor's host_bound input",
+    labelnames=("replica",),
+)
+doctor_episodes_total = _get_or_create(
+    Counter,
+    f"{_PREFIX}_doctor_episodes_total",
+    "Bottleneck-doctor episodes opened, per regime (host_bound / "
+    "compile_storm / queue_bound / tier_thrash / "
+    "allocator_fragmentation / spec_unprofitable) and dp replica "
+    "(telemetry/doctor.py)",
+    labelnames=("regime", "replica"),
+)
+doctor_active_regimes = _get_or_create(
+    Gauge,
+    f"{_PREFIX}_doctor_active_regimes",
+    "Currently open bottleneck-doctor episodes across the fleet — "
+    "nonzero means the doctor is attributing degraded serving to a "
+    "named regime right now (/debug/doctor has the evidence)",
+)
 
 
 class _StepSnapshot:

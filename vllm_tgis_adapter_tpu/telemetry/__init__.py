@@ -1,6 +1,6 @@
 """The signal layer for the elastic control plane (docs/OBSERVABILITY.md).
 
-Three composable pieces, each consumable on its own:
+Composable pieces, each consumable on its own:
 
 * :mod:`~vllm_tgis_adapter_tpu.telemetry.ledger` — per-request cost
   accounting closed exactly once at the terminal outcome, rolled up
@@ -11,6 +11,21 @@ Three composable pieces, each consumable on its own:
   objectives (``--slo-config``) with multi-window attainment and
   error-budget burn-rate gauges fed from the same observation points
   the request-latency histograms use;
+* :mod:`~vllm_tgis_adapter_tpu.telemetry.steptime` — step-time anatomy:
+  every engine step decomposed into host_gap/plan/prepare/dispatch/
+  device_wait/commit phases that sum exactly to wall time, kept in a
+  bounded per-replica ring (``step_anatomy_seconds`` histograms, the
+  ``host_gap_frac`` gauge, the ``step_timeline`` /debug/state section);
+* :mod:`~vllm_tgis_adapter_tpu.telemetry.doctor` — the bottleneck
+  doctor: a rule-table regime classifier over the anatomy windows that
+  opens bounded, evidence-carrying episodes (``host_bound``,
+  ``compile_storm``, ...) and brackets the worst of them with automatic
+  profiler captures;
+* :mod:`~vllm_tgis_adapter_tpu.telemetry.timeline` — unified Perfetto
+  timeline export: StepRecords + flight-recorder events + doctor
+  episodes + ledger records merged into one chrome-trace JSON
+  (``GET /debug/timeline``, the ``GetTimeline`` RPC, and
+  ``tools/timeline_export.py`` offline);
 * :mod:`~vllm_tgis_adapter_tpu.telemetry.ewma` /
   :mod:`~vllm_tgis_adapter_tpu.telemetry.mfu` — the decayed-EWMA and
   model-FLOPs primitives behind the live ``spec_acceptance_rate_ewma``
@@ -22,6 +37,12 @@ capture (``--capture-trace``) + ``tools/trace_replay.py`` make every
 decision replayable against recorded or synthesized traffic.
 """
 
+from vllm_tgis_adapter_tpu.telemetry.doctor import (
+    REGIMES,
+    Doctor,
+    Episode,
+    ReplicaSignals,
+)
 from vllm_tgis_adapter_tpu.telemetry.ewma import DecayedEwma, TokenRateEwma
 from vllm_tgis_adapter_tpu.telemetry.ledger import (
     CostLedger,
@@ -34,15 +55,33 @@ from vllm_tgis_adapter_tpu.telemetry.slo import (
     SloEngine,
     resolve_request_class,
 )
+from vllm_tgis_adapter_tpu.telemetry.steptime import (
+    PHASES,
+    StepRecord,
+    StepTimeline,
+)
+from vllm_tgis_adapter_tpu.telemetry.timeline import (
+    chrome_trace_from_state,
+    chrome_trace_json,
+)
 
 __all__ = [
+    "PHASES",
+    "REGIMES",
     "REQUEST_CLASSES",
     "CostLedger",
     "CostRecord",
     "DecayedEwma",
+    "Doctor",
+    "Episode",
     "JsonlSink",
+    "ReplicaSignals",
     "SloEngine",
+    "StepRecord",
+    "StepTimeline",
     "TokenRateEwma",
+    "chrome_trace_from_state",
+    "chrome_trace_json",
     "flops_per_token",
     "resolve_request_class",
 ]

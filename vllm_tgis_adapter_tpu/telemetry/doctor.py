@@ -1,0 +1,445 @@
+"""The bottleneck doctor: a rule-table regime classifier over sliding
+windows of step anatomy + the signals the gauges already export.
+
+The elastic control plane (ROADMAP item 4) needs "this replica is
+host-bound / compile-storming / queue-bound" as a first-class, tested
+fact.  The doctor turns the raw signals into bounded **episodes**:
+
+* :data:`REGIMES` — the closed regime list (docs/OBSERVABILITY.md
+  "Step anatomy & doctor" documents each rule; tools/obs_check.py
+  cross-checks that table against this tuple so doc and code cannot
+  drift);
+* :class:`ReplicaSignals` — one replica's inputs per evaluation.  The
+  async layer builds them from live engines
+  (``AsyncLLMEngine._doctor_signals``); tests and the dettest scenario
+  synthesize them directly, so every rule is unit-testable without an
+  engine;
+* :class:`Doctor` — hysteresis'd open/close (``OPEN_AFTER``
+  consecutive firing evaluations to open, ``CLOSE_AFTER`` quiet ones
+  to close — an oscillating signal never flaps an episode), a bounded
+  episode ring, ``doctor`` flight-recorder events in strict
+  open → evidence → close order, the
+  ``doctor_episodes_total{regime,replica}`` /
+  ``doctor_active_regimes`` metrics, and — for sustained
+  ``host_bound``/``compile_storm`` only — ONE automatic
+  ``jax.profiler`` capture per episode through the PR-1 profiler
+  controller (start at open, stop at close; a capture the operator
+  already holds, or a disabled ``--profile-dir``, degrades silently).
+
+Evaluation is pulled, not pushed: the owner calls
+:meth:`Doctor.maybe_evaluate` from its per-commit telemetry hook (and
+from gauge refresh, so episodes close while idle) and the doctor
+throttles itself to ``min_interval``.  Cumulative counters
+(recompiles, tier pages moved) are differenced against the previous
+evaluation per replica, so callers pass raw monotonic totals.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+from typing import Any, Callable, Optional
+
+from vllm_tgis_adapter_tpu.logging import init_logger
+
+logger = init_logger(__name__)
+
+#: The closed regime list.  obs_check cross-checks the doc's rule table
+#: against exactly this tuple.
+REGIMES = (
+    "host_bound",
+    "compile_storm",
+    "queue_bound",
+    "tier_thrash",
+    "allocator_fragmentation",
+    "spec_unprofitable",
+)
+
+# ------------------------------------------------------------ thresholds
+# (documented in docs/OBSERVABILITY.md's regime rule table — keep both
+# in sync; obs_check only pins the regime NAMES, the values are tuning)
+
+#: host_bound: device idle on host ≥ this fraction of step wall over a
+#: window of at least MIN_WINDOW_STEPS records.
+HOST_BOUND_GAP_FRAC = 0.35
+MIN_WINDOW_STEPS = 8
+#: compile_storm: ≥ this many fresh XLA compiles since the previous
+#: evaluation, or a tracked dispatch stuck compiling this long.
+COMPILE_STORM_RECOMPILES = 1
+COMPILE_INFLIGHT_AGE_S = 5.0
+#: queue_bound: backlog ≥ factor × max_num_seqs while the batch is full.
+QUEUE_BOUND_BACKLOG_FACTOR = 2.0
+#: tier_thrash: demote+promote page traffic rate across evaluations.
+TIER_THRASH_PAGES_PER_S = 64.0
+#: allocator_fragmentation: cached-free fraction of the free pool with
+#: real occupancy (an empty pool is "fragmented" only vacuously).
+FRAGMENTATION_THRESHOLD = 0.6
+FRAGMENTATION_MIN_OCCUPANCY = 0.7
+#: spec_unprofitable: decayed acceptance EWMA below this while the
+#: speculative path is active.
+SPEC_MIN_ACCEPTANCE = 0.3
+
+#: Hysteresis: consecutive firing evaluations to open an episode, and
+#: consecutive quiet ones to close it.
+OPEN_AFTER = 2
+CLOSE_AFTER = 3
+
+#: Regimes whose sustained episodes auto-trigger a profiler capture.
+CAPTURE_REGIMES = ("host_bound", "compile_storm")
+
+DEFAULT_MIN_INTERVAL_S = 0.25
+DEFAULT_MAX_EPISODES = 64
+
+
+@dataclasses.dataclass
+class ReplicaSignals:
+    """One replica's rule inputs for a single evaluation.  Counter
+    fields (``recompiles``, ``tier_pages_moved``) are cumulative; the
+    doctor differences them itself."""
+
+    replica: int
+    steps: int = 0               # StepRecords in the sliding window
+    host_gap_frac: float = 0.0   # StepTimeline.host_gap_frac()
+    waiting: int = 0
+    running: int = 0
+    max_num_seqs: int = 1
+    recompiles: int = 0          # cumulative (compile_tracker)
+    compile_inflight_age_s: float = 0.0
+    fragmentation: float = 0.0   # allocator_stats()["fragmentation"]
+    occupancy: float = 0.0       # allocator_stats()["occupancy"]
+    tier_pages_moved: int = 0    # cumulative demoted+promoted pages
+    spec_active: bool = False
+    spec_acceptance: Optional[float] = None  # EWMA, None = cold
+
+
+@dataclasses.dataclass
+class Episode:
+    """One bounded regime episode, open until the rule goes quiet for
+    CLOSE_AFTER evaluations."""
+
+    regime: str
+    replica: int
+    opened_ts: float                  # wall clock (time.time)
+    evidence: dict[str, Any]
+    closed_ts: Optional[float] = None
+    captured: bool = False            # a profiler capture brackets it
+
+    @property
+    def open(self) -> bool:
+        return self.closed_ts is None
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "regime": self.regime,
+            "replica": self.replica,
+            "opened_ts": round(self.opened_ts, 6),
+            "closed_ts": (
+                round(self.closed_ts, 6)
+                if self.closed_ts is not None
+                else None
+            ),
+            "duration_s": (
+                round(self.closed_ts - self.opened_ts, 3)
+                if self.closed_ts is not None
+                else None
+            ),
+            "evidence": self.evidence,
+            "captured": self.captured,
+        }
+
+
+def _rule_evidence(sig: ReplicaSignals,
+                   rates: dict[str, float]) -> dict[str, Optional[dict]]:
+    """The rule table: regime -> evidence payload if firing, else None.
+    Pure function of (signals, differenced rates) so every rule is
+    table-testable."""
+    fired: dict[str, Optional[dict]] = {}
+
+    fired["host_bound"] = (
+        {
+            "host_gap_frac": round(sig.host_gap_frac, 4),
+            "window_steps": sig.steps,
+        }
+        if sig.steps >= MIN_WINDOW_STEPS
+        and sig.host_gap_frac >= HOST_BOUND_GAP_FRAC
+        else None
+    )
+    fired["compile_storm"] = (
+        {
+            "recompiles_delta": int(rates.get("recompiles_delta", 0)),
+            "inflight_age_s": round(sig.compile_inflight_age_s, 3),
+        }
+        if rates.get("recompiles_delta", 0) >= COMPILE_STORM_RECOMPILES
+        or sig.compile_inflight_age_s >= COMPILE_INFLIGHT_AGE_S
+        else None
+    )
+    fired["queue_bound"] = (
+        {
+            "waiting": sig.waiting,
+            "running": sig.running,
+            "max_num_seqs": sig.max_num_seqs,
+        }
+        if sig.waiting
+        >= QUEUE_BOUND_BACKLOG_FACTOR * max(1, sig.max_num_seqs)
+        and sig.running >= sig.max_num_seqs
+        else None
+    )
+    fired["tier_thrash"] = (
+        {
+            "pages_per_s": round(rates.get("tier_pages_per_s", 0.0), 1),
+            "pages_delta": int(rates.get("tier_pages_delta", 0)),
+        }
+        if rates.get("tier_pages_per_s", 0.0) >= TIER_THRASH_PAGES_PER_S
+        else None
+    )
+    fired["allocator_fragmentation"] = (
+        {
+            "fragmentation": round(sig.fragmentation, 4),
+            "occupancy": round(sig.occupancy, 4),
+        }
+        if sig.fragmentation >= FRAGMENTATION_THRESHOLD
+        and sig.occupancy >= FRAGMENTATION_MIN_OCCUPANCY
+        else None
+    )
+    fired["spec_unprofitable"] = (
+        {"acceptance_ewma": round(sig.spec_acceptance, 4)}
+        if sig.spec_active
+        and sig.spec_acceptance is not None
+        and sig.spec_acceptance < SPEC_MIN_ACCEPTANCE
+        else None
+    )
+    return fired
+
+
+class Doctor:
+    """The classifier.  ``record`` is ``callable(replica, **detail)``
+    emitting one ``doctor`` flight-recorder event on that replica's
+    recorder (batch-scoped: never with a request_id); ``profiler`` is
+    a zero-arg callable returning the shared ProfilerController (or
+    None to disable auto-capture)."""
+
+    def __init__(
+        self,
+        record: Optional[Callable[..., None]] = None,
+        profiler: Optional[Callable[[], Any]] = None,
+        min_interval: float = DEFAULT_MIN_INTERVAL_S,
+        max_episodes: int = DEFAULT_MAX_EPISODES,
+    ) -> None:
+        self._record = record
+        self._profiler = profiler
+        self._min_interval = min_interval
+        self._last_eval: Optional[float] = None
+        # (replica, regime) -> consecutive firing / quiet eval counts
+        self._fire_streak: dict[tuple[int, str], int] = {}
+        self._quiet_streak: dict[tuple[int, str], int] = {}
+        self._open: dict[tuple[int, str], Episode] = {}
+        self.episodes: deque[Episode] = deque(maxlen=max_episodes)
+        # replica -> (eval monotonic time, recompiles, tier_pages)
+        self._last_counters: dict[int, tuple[float, int, int]] = {}
+        self.evaluations = 0
+        self.regimes_observed: set[str] = set()
+        # at most one auto-capture at a time; the episode holding it
+        self._capture_key: Optional[tuple[int, str]] = None
+
+    # ----------------------------------------------------------- evaluate
+
+    def maybe_evaluate(
+        self,
+        signals_fn: Callable[[], list[ReplicaSignals]],
+        now: Optional[float] = None,
+    ) -> None:
+        """Throttled entry point for hot-path callers: cheap clock
+        check first, signals built only when an evaluation is due."""
+        now = time.monotonic() if now is None else now
+        if (
+            self._last_eval is not None
+            and now - self._last_eval < self._min_interval
+        ):
+            return
+        try:
+            self.evaluate(signals_fn(), now=now)
+        except Exception:  # noqa: BLE001 — telemetry must never raise
+            logger.debug("doctor evaluation failed", exc_info=True)
+
+    def evaluate(
+        self,
+        signals: list[ReplicaSignals],
+        now: Optional[float] = None,
+    ) -> None:
+        """One classification pass over the fleet's signals."""
+        now = time.monotonic() if now is None else now
+        self._last_eval = now
+        self.evaluations += 1
+        for sig in signals:
+            rates = self._rates(sig, now)
+            for regime, evidence in _rule_evidence(sig, rates).items():
+                self._advance(sig.replica, regime, evidence)
+        self._set_gauge()
+
+    def _rates(self, sig: ReplicaSignals, now: float) -> dict[str, float]:
+        """Difference the cumulative counters against the previous
+        evaluation of this replica."""
+        last = self._last_counters.get(sig.replica)
+        self._last_counters[sig.replica] = (
+            now, sig.recompiles, sig.tier_pages_moved,
+        )
+        if last is None:
+            return {}
+        last_t, last_recompiles, last_pages = last
+        dt = max(1e-6, now - last_t)
+        pages_delta = max(0, sig.tier_pages_moved - last_pages)
+        return {
+            "recompiles_delta": max(0, sig.recompiles - last_recompiles),
+            "tier_pages_delta": pages_delta,
+            "tier_pages_per_s": pages_delta / dt,
+        }
+
+    # ------------------------------------------------- episode lifecycle
+
+    def _advance(self, replica: int, regime: str,
+                 evidence: Optional[dict]) -> None:
+        key = (replica, regime)
+        episode = self._open.get(key)
+        if evidence is not None:
+            self._fire_streak[key] = self._fire_streak.get(key, 0) + 1
+            self._quiet_streak[key] = 0
+            if episode is not None:
+                episode.evidence = evidence  # live view stays current
+            elif self._fire_streak[key] >= OPEN_AFTER:
+                self._open_episode(key, evidence)
+        else:
+            self._quiet_streak[key] = self._quiet_streak.get(key, 0) + 1
+            self._fire_streak[key] = 0
+            if episode is not None and (
+                self._quiet_streak[key] >= CLOSE_AFTER
+            ):
+                self._close_episode(key, episode)
+
+    def _open_episode(self, key: tuple[int, str],
+                      evidence: dict) -> None:
+        replica, regime = key
+        episode = Episode(
+            regime=regime, replica=replica, opened_ts=time.time(),
+            evidence=evidence,
+        )
+        self._open[key] = episode
+        self.regimes_observed.add(regime)
+        try:
+            from vllm_tgis_adapter_tpu import metrics
+
+            metrics.doctor_episodes_total.labels(
+                regime=regime, replica=str(replica)
+            ).inc()
+        except Exception:  # pragma: no cover — metrics are best-effort
+            logger.debug("doctor episode metric failed", exc_info=True)
+        self._emit(replica, regime=regime, phase="open")
+        self._emit(replica, regime=regime, phase="evidence", **evidence)
+        if regime in CAPTURE_REGIMES and self._capture_key is None:
+            if self._start_capture():
+                episode.captured = True
+                self._capture_key = key
+        logger.warning(
+            "doctor: %s episode OPEN on replica %d (%s)",
+            regime, replica, evidence,
+        )
+
+    def _close_episode(self, key: tuple[int, str],
+                       episode: Episode) -> None:
+        replica, regime = key
+        episode.closed_ts = time.time()
+        del self._open[key]
+        self.episodes.append(episode)
+        self._emit(
+            replica, regime=regime, phase="close",
+            duration_s=round(episode.closed_ts - episode.opened_ts, 3),
+            **episode.evidence,
+        )
+        if self._capture_key == key:
+            self._stop_capture()
+            self._capture_key = None
+        logger.info(
+            "doctor: %s episode CLOSED on replica %d after %.1fs",
+            regime, replica, episode.closed_ts - episode.opened_ts,
+        )
+
+    def _emit(self, replica: int, **detail: Any) -> None:
+        if self._record is None:
+            return
+        try:
+            self._record(replica, **detail)
+        except Exception:  # noqa: BLE001 — telemetry must never raise
+            logger.debug("doctor event emit failed", exc_info=True)
+
+    # --------------------------------------------------- profiler capture
+
+    def _controller(self):  # noqa: ANN202
+        if self._profiler is None:
+            return None
+        try:
+            return self._profiler()
+        except Exception:  # noqa: BLE001
+            return None
+
+    def _start_capture(self) -> bool:
+        """One bounded capture per qualifying episode; an unavailable
+        or operator-held profiler degrades to no capture."""
+        ctrl = self._controller()
+        if ctrl is None:
+            return False
+        try:
+            result = ctrl.start()
+        except Exception:  # noqa: BLE001 — capture is best-effort
+            return False
+        return result.get("status") == "started"
+
+    def _stop_capture(self) -> None:
+        ctrl = self._controller()
+        if ctrl is None:
+            return
+        try:
+            ctrl.stop()
+        except Exception:  # noqa: BLE001 — capture is best-effort
+            logger.debug("doctor capture stop failed", exc_info=True)
+
+    # -------------------------------------------------------------- reads
+
+    def _set_gauge(self) -> None:
+        try:
+            from vllm_tgis_adapter_tpu import metrics
+
+            metrics.doctor_active_regimes.set(len(self._open))
+        except Exception:  # pragma: no cover — metrics are best-effort
+            logger.debug("doctor gauge set failed", exc_info=True)
+
+    @property
+    def active(self) -> list[Episode]:
+        return sorted(
+            self._open.values(),
+            key=lambda e: (e.replica, e.regime),
+        )
+
+    def active_regimes(self) -> list[str]:
+        """Distinct regimes with an open episode (the stats log line)."""
+        return sorted({e.regime for e in self._open.values()})
+
+    def debug_state(self) -> dict:
+        return {
+            "regimes": list(REGIMES),
+            "active": [e.to_dict() for e in self.active],
+            "recent": [e.to_dict() for e in self.episodes],
+            "evaluations": self.evaluations,
+            "thresholds": {
+                "host_bound_gap_frac": HOST_BOUND_GAP_FRAC,
+                "min_window_steps": MIN_WINDOW_STEPS,
+                "compile_storm_recompiles": COMPILE_STORM_RECOMPILES,
+                "compile_inflight_age_s": COMPILE_INFLIGHT_AGE_S,
+                "queue_bound_backlog_factor": QUEUE_BOUND_BACKLOG_FACTOR,
+                "tier_thrash_pages_per_s": TIER_THRASH_PAGES_PER_S,
+                "fragmentation_threshold": FRAGMENTATION_THRESHOLD,
+                "fragmentation_min_occupancy": FRAGMENTATION_MIN_OCCUPANCY,
+                "spec_min_acceptance": SPEC_MIN_ACCEPTANCE,
+                "open_after": OPEN_AFTER,
+                "close_after": CLOSE_AFTER,
+            },
+        }

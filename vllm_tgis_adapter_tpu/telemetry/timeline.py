@@ -1,0 +1,244 @@
+"""Unified Perfetto timeline export (chrome-trace JSON).
+
+One merged, human-openable timeline of everything the observability
+stack records: StepRecords (telemetry/steptime.py) as per-phase spans,
+flight-recorder events as instants, doctor episodes as regime spans,
+and — offline — cost-ledger records as per-request spans.  The output
+is the chrome trace event format, which Perfetto (ui.perfetto.dev) and
+``chrome://tracing`` both load natively, so one artifact answers "what
+was the engine doing at 14:03:07" without bespoke tooling.
+
+The builder consumes the **serialized debug-state snapshot**, not live
+objects: ``GET /debug/timeline``, the ``Debug/GetTimeline`` RPC, and
+the ``tools/timeline_export.py`` offline CLI (over a dumped snapshot /
+watchdog stall file) all call :func:`chrome_trace_from_state` on the
+same dict, so the three surfaces can never diverge — the exact
+discipline ``debug_state`` itself established.
+
+Stable pid/tid mapping (the contract tests/test_steptime.py pins):
+each replica is a "process" (pid = replica index), each step phase is
+a fixed "thread" (:data:`PHASE_TIDS`), flight-recorder events, doctor
+episodes, and ledger requests get fixed tracks of their own.  All
+timestamps are wall-clock microseconds (chrome-trace's native unit),
+anchored per StepRecord at commit time, so spans from different
+replicas and recorders line up on one axis.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Iterable, Optional
+
+from vllm_tgis_adapter_tpu.telemetry.steptime import PHASES
+
+#: Fixed per-phase track ids inside each replica "process" — stable
+#: across exports so saved traces diff cleanly.
+PHASE_TIDS = {phase: i + 1 for i, phase in enumerate(PHASES)}
+#: Flight-recorder instants, doctor episodes, ledger request spans.
+EVENTS_TID = 16
+DOCTOR_TID = 17
+LEDGER_TID = 18
+
+_TID_NAMES = {
+    **{tid: f"step:{phase}" for phase, tid in PHASE_TIDS.items()},
+    EVENTS_TID: "flight_recorder",
+    DOCTOR_TID: "doctor",
+    LEDGER_TID: "requests",
+}
+
+
+def _us(ts_seconds: float) -> int:
+    return int(round(ts_seconds * 1e6))
+
+
+def _meta(pid: int, tid: Optional[int], name: str) -> dict:
+    event = {
+        "ph": "M",
+        "pid": pid,
+        "ts": 0,
+        "name": "process_name" if tid is None else "thread_name",
+        "args": {"name": name},
+    }
+    if tid is not None:
+        event["tid"] = tid
+    return event
+
+
+def _step_events(record: dict) -> Iterable[dict]:
+    """One StepRecord -> contiguous per-phase "X" complete events.
+    The decomposition telescopes (steptime.py), so phases lay out
+    back-to-back from ``ts``; host_gap (the device-idle lead-in)
+    precedes ``ts`` on its own track."""
+    pid = int(record.get("replica", 0))
+    phases = record.get("phases") or {}
+    ts = float(record.get("ts", 0.0))
+    args = {
+        "step": record.get("step"),
+        "kind": record.get("kind"),
+        "tokens": record.get("tokens"),
+        "fill_ratio": record.get("fill_ratio"),
+        "chained": record.get("chained"),
+        "sync": record.get("sync"),
+    }
+    if record.get("compile_fn"):
+        args["compile_fn"] = record["compile_fn"]
+    if record.get("drain_s"):
+        args["drain_s"] = record["drain_s"]
+    gap = float(phases.get("host_gap", 0.0))
+    if gap > 0:
+        yield {
+            "ph": "X", "name": "host_gap", "cat": "step",
+            "pid": pid, "tid": PHASE_TIDS["host_gap"],
+            "ts": _us(ts - gap), "dur": max(1, _us(gap)),
+            "args": args,
+        }
+    cursor = ts
+    for phase in PHASES:
+        if phase == "host_gap":
+            continue
+        dur = float(phases.get(phase, 0.0))
+        if dur > 0:
+            yield {
+                "ph": "X", "name": phase, "cat": "step",
+                "pid": pid, "tid": PHASE_TIDS[phase],
+                "ts": _us(cursor), "dur": max(1, _us(dur)),
+                "args": args,
+            }
+        cursor += dur
+
+
+def _recorder_events(events: Iterable[dict]) -> Iterable[dict]:
+    for event in events:
+        detail = event.get("detail") or {}
+        pid = int(detail.get("replica", 0) or 0)
+        args: dict[str, Any] = {"step": event.get("step"), **detail}
+        if event.get("request_id"):
+            args["request_id"] = event["request_id"]
+        if event.get("trace_id"):
+            args["trace_id"] = event["trace_id"]
+            from vllm_tgis_adapter_tpu.tracing import perfetto_flow_id
+
+            args["flow_id"] = perfetto_flow_id(event["trace_id"])
+        yield {
+            "ph": "i", "s": "p", "name": event.get("kind", "?"),
+            "cat": "recorder", "pid": pid, "tid": EVENTS_TID,
+            "ts": _us(float(event.get("ts", 0.0))),
+            "args": args,
+        }
+
+
+def _doctor_events(doctor_state: dict, now: float) -> Iterable[dict]:
+    episodes = list(doctor_state.get("active") or [])
+    episodes += list(doctor_state.get("recent") or [])
+    for ep in episodes:
+        opened = float(ep.get("opened_ts") or 0.0)
+        closed = ep.get("closed_ts")
+        end = float(closed) if closed is not None else now
+        yield {
+            "ph": "X", "name": ep.get("regime", "?"), "cat": "doctor",
+            "pid": int(ep.get("replica", 0)), "tid": DOCTOR_TID,
+            "ts": _us(opened),
+            "dur": max(1, _us(max(0.0, end - opened))),
+            "args": {
+                "evidence": ep.get("evidence"),
+                "captured": ep.get("captured"),
+                "open": closed is None,
+            },
+        }
+
+
+def _ledger_events(records: Iterable[dict]) -> Iterable[dict]:
+    """Offline CLI only: ``--ledger-log`` JSONL cost records become
+    per-request spans (arrival -> terminal outcome) on a shared
+    ``requests`` track of replica 0's process."""
+    for rec in records:
+        arrival = rec.get("arrival_time")
+        if arrival is None:
+            continue
+        dur = (
+            float(rec.get("queue_s") or 0.0)
+            + float(rec.get("prefill_s") or 0.0)
+            + float(rec.get("decode_s") or 0.0)
+        )
+        yield {
+            "ph": "X",
+            "name": rec.get("outcome") or "request",
+            "cat": "ledger", "pid": 0, "tid": LEDGER_TID,
+            "ts": _us(float(arrival)), "dur": max(1, _us(dur)),
+            "args": {
+                "request_id": rec.get("request_id"),
+                "tenant": rec.get("tenant"),
+                "request_class": rec.get("request_class"),
+                "tokens_in": rec.get("tokens_in"),
+                "tokens_out": rec.get("tokens_out"),
+            },
+        }
+
+
+def chrome_trace_from_state(
+    state: dict,
+    ledger_records: Optional[list[dict]] = None,
+    last_steps: Optional[int] = None,
+) -> dict:
+    """Build the Perfetto-loadable trace dict from one debug-state
+    snapshot (live or dumped).  ``last_steps`` bounds the StepRecords
+    per replica (None = everything the snapshot carries)."""
+    trace_events: list[dict] = []
+    pids: set[int] = {0}
+
+    step_timeline = state.get("step_timeline") or {}
+    for rep_state in step_timeline.get("replicas") or []:
+        records = rep_state.get("records") or []
+        if last_steps is not None:
+            records = records[-last_steps:]
+        for record in records:
+            pids.add(int(record.get("replica", 0)))
+            trace_events.extend(_step_events(record))
+
+    events = state.get("events") or []
+    for chrome_event in _recorder_events(events):
+        pids.add(chrome_event["pid"])
+        trace_events.append(chrome_event)
+
+    doctor_state = state.get("doctor") or {}
+    now = time.time()
+    for chrome_event in _doctor_events(doctor_state, now):
+        pids.add(chrome_event["pid"])
+        trace_events.append(chrome_event)
+
+    if ledger_records:
+        trace_events.extend(_ledger_events(ledger_records))
+
+    trace_events.sort(key=lambda e: (e["ts"], e["pid"], e.get("tid", 0)))
+
+    metadata: list[dict] = []
+    for pid in sorted(pids):
+        metadata.append(_meta(pid, None, f"replica {pid}"))
+        for tid, name in sorted(_TID_NAMES.items()):
+            metadata.append(_meta(pid, tid, name))
+
+    return {
+        "traceEvents": metadata + trace_events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "source": "vllm-tgis-adapter-tpu",
+            "format": "chrome",
+            "replicas": sorted(pids),
+            "exported_at": round(now, 3),
+        },
+    }
+
+
+def chrome_trace_json(
+    state: dict,
+    ledger_records: Optional[list[dict]] = None,
+    last_steps: Optional[int] = None,
+) -> str:
+    """The serialized form every surface serves (HTTP, gRPC, CLI)."""
+    return json.dumps(
+        chrome_trace_from_state(
+            state, ledger_records=ledger_records, last_steps=last_steps
+        ),
+        default=str,
+    )

@@ -57,6 +57,17 @@ def _is_hex(value: str) -> bool:
     return True
 
 
+def perfetto_flow_id(trace_id: str) -> int:
+    """Stable 53-bit int id derived from an OTLP trace id, used by the
+    chrome-trace exporter (telemetry/timeline.py) to link recorder
+    instants belonging to one request across tracks.  Bounded to 2**53
+    so the id survives a JSON round-trip through doubles."""
+    try:
+        return int(trace_id, 16) % (1 << 53)
+    except (TypeError, ValueError):
+        return 0
+
+
 def extract_trace_context(
     headers: Optional[dict],
 ) -> Optional[TraceContext]:
