@@ -1,0 +1,144 @@
+"""Subprocess driver for the two-process kvnet integration test
+(tests/test_kvnet.py): one REAL engine process with a networked KV
+tier, driven over a line-oriented JSON protocol on stdin/stdout.
+
+Commands (one JSON object per stdin line):
+    {"cmd": "run", "rid": ..., "prompt": [...], "max_tokens": N,
+     "temperature": T, "seed": S}   -> {"event": "done", "rid", "status",
+                                        "tokens" | "error"}
+    {"cmd": "debug"}                -> {"event": "debug", "state": {...}}
+    {"cmd": "stop"}                 -> graceful engine stop, exit 0
+
+On start the process prints {"event": "ready", "port": <kvnet port>}.
+Every protocol line goes to stdout; engine logs go to stderr, so the
+parent can parse stdout without filtering.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+
+def _emit(obj: dict) -> None:
+    sys.stdout.write(json.dumps(obj) + "\n")
+    sys.stdout.flush()
+
+
+def _build(args):
+    from vllm_tgis_adapter_tpu.engine.async_llm import AsyncLLMEngine
+    from vllm_tgis_adapter_tpu.engine.config import (
+        CacheConfig,
+        EngineConfig,
+        LoRAConfig,
+        ModelConfig,
+        ParallelConfig,
+        SchedulerConfig,
+    )
+
+    model_config = ModelConfig.from_pretrained(
+        args.model_dir, dtype="float32"
+    )
+    config = EngineConfig(
+        model_config=model_config,
+        cache_config=CacheConfig(
+            block_size=16, num_blocks=96,
+            cache_dtype=model_config.dtype,
+            # host-tier demotion at prefill commit, so this host's
+            # pages are INDEX-visible to peers without LRU pressure
+            enable_prefix_caching=False,
+        ),
+        scheduler_config=SchedulerConfig(
+            max_num_seqs=4, prefill_buckets=(32, 64)
+        ),
+        parallel_config=ParallelConfig(dp_replicas=1),
+        lora_config=LoRAConfig(),
+        kv_host_cache_gb=1.0,
+        dp_replica_roles=tuple(args.roles.split(",")) if args.roles
+        else (),
+        kvnet_listen=args.listen,
+        kvnet_peers=tuple(p for p in args.peers.split(",") if p),
+        kvnet_node_id=args.node_id,
+    )
+    return AsyncLLMEngine.from_config(config)
+
+
+async def _run_one(engine, cmd: dict) -> None:
+    from vllm_tgis_adapter_tpu.engine.sampling_params import (
+        RequestOutputKind,
+        SamplingParams,
+    )
+
+    rid = cmd["rid"]
+    toks: list[int] = []
+    try:
+        async for out in engine.generate(
+            None,
+            SamplingParams(
+                temperature=cmd.get("temperature", 0.0),
+                seed=cmd.get("seed"),
+                max_tokens=cmd.get("max_tokens", 8),
+                ignore_eos=True,
+                output_kind=RequestOutputKind.DELTA,
+            ),
+            request_id=rid,
+            prompt_token_ids=list(cmd["prompt"]),
+        ):
+            toks.extend(out.outputs[0].token_ids)
+    except Exception as e:  # noqa: BLE001 — reported to the parent
+        _emit({"event": "done", "rid": rid, "status": "err",
+               "error": f"{type(e).__name__}: {e}"})
+        return
+    _emit({"event": "done", "rid": rid, "status": "ok", "tokens": toks})
+
+
+async def _main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("model_dir")
+    parser.add_argument("--listen", default="127.0.0.1:0")
+    parser.add_argument("--peers", default="")
+    parser.add_argument("--node-id", default=None)
+    parser.add_argument("--roles", default="")
+    args = parser.parse_args()
+
+    engine = _build(args)
+    await engine.start()
+    port = engine.kvnet.listen_port if engine.kvnet else None
+    _emit({"event": "ready", "port": port})
+
+    loop = asyncio.get_running_loop()
+    running: set[asyncio.Task] = set()
+    while True:
+        line = await loop.run_in_executor(None, sys.stdin.readline)
+        if not line:
+            break
+        cmd = json.loads(line)
+        if cmd["cmd"] == "stop":
+            break
+        if cmd["cmd"] == "debug":
+            _emit({"event": "debug",
+                   "state": engine.kvnet.debug_state()
+                   if engine.kvnet else {}})
+        elif cmd["cmd"] == "run":
+            task = asyncio.ensure_future(_run_one(engine, cmd))
+            running.add(task)
+            task.add_done_callback(running.discard)
+    if running:
+        await asyncio.gather(*running, return_exceptions=True)
+    await engine.stop()
+
+
+if __name__ == "__main__":
+    asyncio.run(_main())

@@ -362,6 +362,225 @@ async def _run_seed(seed: int, model_dir: str, adapter_dir: str) -> dict:
             pass
 
 
+def _free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+async def _cross_host_soak(model_dir: str) -> dict:
+    """``--cross-host``: the networked-KV-tier fault family over a real
+    loopback TCP fleet (docs/CROSS_HOST.md).
+
+    Two engines in one process — ``A`` prefill-only, ``B`` mixed —
+    peered over localhost sockets, exactly the two-process topology's
+    wire traffic.  Asserted, against a kvnet-less baseline engine:
+
+    * corrupt-payload: a flipped byte in a remote page blob is a MISS
+      (checksum), the span recomputes locally, tokens identical;
+    * slow-peer / partition: a peer slower than the timeout (and a
+      ``kvnet.get`` failpoint) degrade to the local tiers — the request
+      completes token-identically or fails TYPED-retryable, and both
+      engines keep serving (a dead remote never stalls the step loop);
+    * remote handoff: with the fleet healthy, a request on the
+      prefill-only host decodes on the peer, token-identical;
+    * machine loss: ``A`` dies mid-decode of a handed-off request —
+      ``B`` adopts it, and the union of the tokens streamed before the
+      kill with ``B``'s banked tail equals the baseline exactly (zero
+      lost outputs).
+    """
+    from vllm_tgis_adapter_tpu.frontdoor.errors import EngineRestartError
+    from vllm_tgis_adapter_tpu.supervisor import failpoints
+
+    prompt = [5 + (i % 40) for i in range(48)]  # 3 full pages at bs=16
+    spec = {"kind": "chat", "prompt": prompt, "temperature": 0.0,
+            "seed": None, "max_tokens": 12, "logprobs": None}
+    long_spec = {**spec, "max_tokens": 48}
+
+    def _fleet_engine(**kw):  # noqa: ANN003, ANN202
+        return build_engine(
+            model_dir, kv_host_cache_gb=1.0,
+            # prefix registration demotes prompt pages at prefill
+            # commit, making them INDEX-visible without LRU pressure
+            enable_prefix_caching=False,
+            **kw,
+        )
+
+    # ---- uncrashed kvnet-less baseline
+    base_engine = _fleet_engine()
+    await base_engine.start()
+    status, base = await _run_request(base_engine, "xh-base", spec, None)
+    assert status == "ok", f"baseline failed: {base!r}"
+    status, base_long = await _run_request(
+        base_engine, "xh-base-long", long_spec, None
+    )
+    assert status == "ok", f"long baseline failed: {base_long!r}"
+    await base_engine.stop()
+
+    port_a, port_b = _free_port(), _free_port()
+    a = _fleet_engine(
+        roles=("prefill",),
+        kvnet_listen=f"127.0.0.1:{port_a}",
+        kvnet_peers=(f"127.0.0.1:{port_b}",), kvnet_node_id="A",
+        kvnet_timeout_s=1.0,
+    )
+    b = _fleet_engine(
+        kvnet_listen=f"127.0.0.1:{port_b}",
+        kvnet_peers=(f"127.0.0.1:{port_a}",), kvnet_node_id="B",
+        kvnet_timeout_s=1.0,
+    )
+    stats: dict = {}
+    consumer = None
+    try:
+        await a.start()
+        await b.start()
+
+        # warm the fleet-shared prefix on B; wait for A's mirror of it
+        status, toks = await _run_request(b, "xh-warm", spec, None)
+        assert status == "ok" and toks == base, "warm on B diverged"
+        for _ in range(200):
+            if a.kvnet.peers[0].mirror:
+                break
+            await asyncio.sleep(0.05)
+        assert a.kvnet.peers[0].mirror, (
+            "cross-host invariant violated: A never mirrored B's INDEX"
+        )
+
+        # ---- fault family: each fault, one request on A (remote
+        # prefix fetch from B + remote handoff back to B)
+        outcomes: dict[str, str] = {}
+        peer = a.kvnet.peers[0]
+        for fault in ("corrupt", "slow_peer", "partition", "healthy"):
+            if fault == "corrupt":
+                peer.corrupt_next = True
+            elif fault == "slow_peer":
+                peer.delay_s = 2.5  # > kvnet_timeout_s: every RPC times out
+            elif fault == "partition":
+                failpoints.arm_site("kvnet.get", "raise", 1)
+            t0 = time.monotonic()
+            status, payload = await asyncio.wait_for(
+                _run_request(a, f"xh-{fault}", spec, None),
+                timeout=HARNESS_BOUND_S,
+            )
+            elapsed = time.monotonic() - t0
+            if status == "ok":
+                assert payload == base, (
+                    f"cross-host invariant violated: {fault} request "
+                    f"completed but diverged from baseline\n"
+                    f"  baseline: {base}\n  got:      {payload}"
+                )
+                outcomes[fault] = "ok"
+            else:
+                # a prefill-only host with its one peer unreachable has
+                # no decode path — typed-retryable is the ladder floor
+                assert fault in ("slow_peer", "partition"), (
+                    f"cross-host invariant violated: {fault} request "
+                    f"failed ({payload!r}) instead of degrading to the "
+                    "local tiers"
+                )
+                assert isinstance(payload, EngineRestartError), (
+                    "cross-host invariant violated: untyped error "
+                    f"under {fault}: {payload!r}"
+                )
+                outcomes[fault] = "retryable"
+            assert elapsed < HARNESS_BOUND_S, "fault stalled the loop"
+            # corrupt/healthy MUST complete: the remote rung degrades
+            # per-page, never per-request
+            if fault in ("corrupt", "healthy"):
+                assert outcomes[fault] == "ok", (
+                    f"{fault} request did not complete"
+                )
+            peer.delay_s = 0.0
+            peer.corrupt_next = False
+            failpoints.disarm()
+            if fault in ("slow_peer", "partition"):
+                # wait for the heartbeat to revive the peer before the
+                # next leg (down peers are skipped, not retried inline)
+                for _ in range(200):
+                    if peer.state == "healthy":
+                        break
+                    await asyncio.sleep(0.05)
+        assert a.kvnet.remote._hits > 0, (  # noqa: SLF001
+            "cross-host invariant violated: no remote prefix page was "
+            "ever served (the healthy leg should have hit B's mirror)"
+        )
+
+        # ---- machine loss: kill A mid-decode of a handed-off request
+        got: list[int] = []
+
+        async def _consume() -> None:
+            # stream INCREMENTALLY (a real client banks every DELTA as
+            # it arrives): tokens A emitted before dying must count —
+            # run_request's end-of-stream return would discard them
+            from tools.scenarios import _params
+
+            try:
+                async for out in a.generate(
+                    prompt=None,
+                    sampling_params=_params(long_spec),
+                    request_id="xh-lost",
+                    prompt_token_ids=list(long_spec["prompt"]),
+                ):
+                    got.extend(out.outputs[0].token_ids)
+            except asyncio.CancelledError:
+                pass
+            except Exception:  # noqa: BLE001 — A dying mid-stream is the point
+                pass
+
+        # hold B's replica lock so the cross-host resume blocks right
+        # after queue registration — the kill below lands before any
+        # decode step, deterministically
+        async with b._replicas[0].lock:  # noqa: SLF001
+            consumer = asyncio.ensure_future(_consume())
+            for _ in range(5000):
+                if "xh-lost" in b._queues:  # noqa: SLF001
+                    break
+                await asyncio.sleep(0.005)
+            assert "xh-lost" in b._queues, (  # noqa: SLF001
+                "handoff never registered on the survivor"
+            )
+            await a.kvnet.stop()  # the machine-loss event
+            await asyncio.sleep(0.2)
+        deadline = time.monotonic() + HARNESS_BOUND_S
+        while time.monotonic() < deadline:
+            if "xh-lost" in b.kvnet.completed:
+                break
+            await asyncio.sleep(0.1)
+        tail: list[int] = []
+        for out in b.kvnet.completed.get("xh-lost", []):
+            tail.extend(out.outputs[0].token_ids)
+        assert got + tail == base_long, (
+            "cross-host invariant violated: streamed+banked tokens "
+            "after machine loss diverged from baseline\n"
+            f"  baseline ({len(base_long)}): {base_long}\n"
+            f"  streamed ({len(got)}) + banked ({len(tail)}): "
+            f"{got + tail}"
+        )
+        stats = {
+            "mode": "cross_host",
+            "fault_outcomes": outcomes,
+            "remote_hits": a.kvnet.remote._hits,  # noqa: SLF001
+            "loss_streamed": len(got),
+            "loss_banked": len(tail),
+            "baseline_tokens": len(base_long),
+        }
+        return stats
+    finally:
+        failpoints.disarm()
+        if consumer is not None:
+            consumer.cancel()
+            await asyncio.gather(consumer, return_exceptions=True)
+        for eng in (a, b):
+            try:
+                await eng.stop()
+            except Exception:  # noqa: BLE001 — teardown is best-effort
+                pass
+
+
 async def _recovery_bench(model_dir: str) -> dict:
     """perf_check ``recovery`` gate: one long greedy request killed
     mid-decode must complete RESUMED within ``max_ratio`` x its
@@ -481,6 +700,11 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--recovery-bench", action="store_true",
                         help="run the perf_check recovery measurement "
                              "and print one JSON line")
+    parser.add_argument("--cross-host", action="store_true",
+                        help="run the networked-KV-tier fault family "
+                             "(corrupt/slow-peer/partition + "
+                             "kill-mid-decode machine loss) over a "
+                             "loopback TCP fleet — docs/CROSS_HOST.md")
     args = parser.parse_args(argv)
 
     _enable_persistent_compile_cache()
@@ -489,6 +713,22 @@ def main(argv: list[str] | None = None) -> int:
     if args.recovery_bench:
         line = asyncio.run(_recovery_bench(model_dir))
         print(json.dumps(line))
+        return 0
+
+    if args.cross_host:
+        try:
+            stats = asyncio.run(_cross_host_soak(model_dir))
+        except AssertionError as e:
+            print(f"chaos_soak: cross-host FAILED: {e}")
+            return 1
+        print(
+            "chaos_soak: cross-host green — faults "
+            f"{stats['fault_outcomes']} "
+            f"remote_hits={stats['remote_hits']} machine-loss "
+            f"streamed+banked={stats['loss_streamed']}+"
+            f"{stats['loss_banked']} == "
+            f"baseline={stats['baseline_tokens']}"
+        )
         return 0
 
     seeds = (

@@ -55,6 +55,8 @@ _ACTIVE = (
     "resume",
     "handoff_out",
     "handoff_in",
+    "remote_hit",
+    "remote_handoff_in",
 )
 
 # Terminal *outcome* kinds: after one of these only outcome-adjacent
@@ -73,7 +75,10 @@ LIFECYCLE_MANIFEST = {
     "version": 1,
     "request_events": {
         # first event a recorder may see for a request id
-        "entry": ["admit", "resume", "handoff_in", "shed", "ledger"],
+        "entry": [
+            "admit", "resume", "handoff_in", "remote_handoff_in",
+            "shed", "ledger",
+        ],
         # kinds after which the stream is closed (empty successor set)
         "terminal": ["ledger"],
         "edges": {
@@ -112,7 +117,12 @@ LIFECYCLE_MANIFEST = {
     # Declared here so tpulint TPL511 can reject a record() call whose
     # kind is in NO part of the manifest, and so obs_check can assert
     # request ∪ batch == flight_recorder.EVENT_KINDS exactly.
-    "batch_events": ["decode", "error", "restart", "stall", "doctor"],
+    "batch_events": [
+        "decode", "error", "restart", "stall", "doctor",
+        # kvnet (docs/CROSS_HOST.md): whole-host peer traffic, outside
+        # any one request's DFA
+        "remote_put", "peer_up", "peer_down",
+    ],
 }
 
 

@@ -906,6 +906,109 @@ class LedgerScenario(Scenario):
         return [state.recorder]
 
 
+# ------------------------------------------- 6. kvnet staged handoffs
+
+
+class KvNetScenario(Scenario):
+    """Cross-host handoff COMMIT racing the peer-death adoption sweep
+    (docs/CROSS_HOST.md).
+
+    Drives the REAL :class:`~vllm_tgis_adapter_tpu.kvnet.manager.
+    StagedHandoffs` ledger: a prefill peer ``A`` has staged three
+    checkpoints on this host, and then — in chooser-visible order —
+    each request's CKPT_COMMIT arrives, ``A`` dies (two adoption
+    sweeps: peer-death notifications can duplicate), and one request
+    is cancelled source-side (a DISCARD).  Every schedule must resume
+    each surviving request exactly once (no lost output, no double
+    promote), and a discarded request at most once — the claim flag
+    flips atomically with the pop, so COMMIT-vs-sweep has exactly one
+    winner.
+    """
+
+    name = "kvnet-commit-vs-adopt"
+
+    def build(self):  # noqa: ANN201
+        from vllm_tgis_adapter_tpu.kvnet.manager import StagedHandoffs
+
+        recorder = FlightRecorder()
+        staged = StagedHandoffs()
+        rids = ("kn-r1", "kn-r2", "kn-r3")
+        for rid in rids:
+            staged.stage(SimpleNamespace(request_id=rid), source="A")
+        return SimpleNamespace(
+            recorder=recorder,
+            staged=staged,
+            rids=rids,
+            promoted={rid: 0 for rid in rids},
+            discarded=False,
+            tasks=set(),
+        )
+
+    async def run(self, state) -> None:  # noqa: ANN001
+        staged, recorder = state.staged, state.recorder
+
+        async def _promote(rec) -> None:  # noqa: ANN001
+            # the resume itself yields (queue registration, replica
+            # lock) — the claim above must already have settled the
+            # winner, so this window is legal
+            rid = rec["ckpt"].request_id
+            recorder.record("remote_handoff_in", rid, peer=rec["source"])
+            await asyncio.sleep(0)
+            state.promoted[rid] += 1
+            recorder.record("finish", rid)
+            recorder.record("ledger", rid)
+
+        async def _commit(rid: str) -> None:
+            await asyncio.sleep(0)
+            rec = staged.claim(rid)
+            if rec is not None:
+                await _promote(rec)
+
+        async def _sweep() -> None:
+            await asyncio.sleep(0)
+            recorder.record("peer_down", peer="A")
+            for rec in staged.adopt_for_peer("A"):
+                await _promote(rec)
+
+        async def _discard(rid: str) -> None:
+            # source-side cancel racing both the COMMIT and the sweep:
+            # at most one of the three touches the record
+            await asyncio.sleep(0)
+            staged.discard(rid)
+            state.discarded = True
+
+        await _gather([
+            spawn_task(_commit("kn-r1"), name="commit-r1",
+                       retain=state.tasks),
+            spawn_task(_commit("kn-r2"), name="commit-r2",
+                       retain=state.tasks),
+            spawn_task(_sweep(), name="peer-death-sweep-1",
+                       retain=state.tasks),
+            spawn_task(_sweep(), name="peer-death-sweep-2",
+                       retain=state.tasks),
+            spawn_task(_discard("kn-r3"), name="discard-r3",
+                       retain=state.tasks),
+        ])
+
+    def check(self, state) -> None:  # noqa: ANN001
+        assert state.staged.pending() == 0, (
+            f"{state.staged.pending()} staged handoffs leaked past "
+            "commit + adoption"
+        )
+        for rid in ("kn-r1", "kn-r2"):
+            assert state.promoted[rid] == 1, (
+                f"{rid} resumed {state.promoted[rid]} times: a "
+                "COMMIT-vs-adoption schedule lost or double-promoted it"
+            )
+        assert state.promoted["kn-r3"] <= 1, (
+            "a discarded handoff was double-promoted"
+        )
+        assert state.discarded, "the discard racer never ran"
+
+    def recorders(self, state) -> list:  # noqa: ANN001
+        return [state.recorder]
+
+
 # ----------------------------------------------------- seeded failpoint
 
 
@@ -949,6 +1052,7 @@ SCENARIOS = [
     SupervisorScenario(),
     KvTierScenario(),
     AdapterPoolScenario(),
+    KvNetScenario(),
     # DoctorScenario rides BEFORE LedgerScenario: race_check's
     # exhaustive-DFS pass assumes SCENARIOS[-1] is the small ledger
     # scenario

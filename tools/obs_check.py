@@ -78,7 +78,9 @@ REPLICA_KEYS = ("scheduler", "kv_cache", "in_flight", "step_counter",
 # kv_host_tier section: the per-rung split (ISSUE 14 satellite — the
 # host and disk budgets must never read as one silently-summed number)
 KV_TIER_KEYS = ("tiers",)
-KV_TIER_TIERS = ("host", "disk")
+# "remote" is always a key (None when no kvnet manager is attached) so
+# the networked rung can't silently drop out of the hierarchy snapshot
+KV_TIER_TIERS = ("host", "disk", "remote")
 # router-section keys the doc promises (incl. the disaggregation
 # additions: per-role queue depths and handoff outcomes)
 ROUTER_KEYS = ("placed_by_policy", "affinity_hit_rate",
@@ -119,6 +121,20 @@ REQUIRED_STEPTIME_METRICS = (
     "tgis_tpu_host_gap_frac",
     "tgis_tpu_doctor_episodes_total",
     "tgis_tpu_doctor_active_regimes",
+)
+
+# networked KV tier (kvnet/, docs/CROSS_HOST.md): the cross-host
+# sharing/handoff surface must be documented AND served — operators
+# diagnose a partitioned or slow peer from exactly these names, so
+# drift here means a fleet incident debugged blind
+REQUIRED_KVNET_METRICS = (
+    "tgis_tpu_kvnet_remote_lookups_total",
+    "tgis_tpu_kvnet_remote_hits_total",
+    "tgis_tpu_kvnet_remote_hit_ratio",
+    "tgis_tpu_kvnet_transfer_bytes_total",
+    "tgis_tpu_kvnet_peer_rtt_seconds",
+    "tgis_tpu_kvnet_peers",
+    "tgis_tpu_kvnet_handoffs_total",
 )
 
 
@@ -272,6 +288,7 @@ def main() -> int:
         for name in REQUIRED_FRONTDOOR_METRICS
         + REQUIRED_TELEMETRY_METRICS
         + REQUIRED_STEPTIME_METRICS
+        + REQUIRED_KVNET_METRICS
         if name not in documented
     )
     if undocumented:

@@ -379,6 +379,54 @@ def measure_quant(q_cfg: dict, runs: int) -> tuple[dict, dict | None]:
     return best, weight_line
 
 
+def measure_cross_host(x_cfg: dict, runs: int) -> dict:
+    """ISSUE 19 gate driver: ``tools/scenarios.py --cross-host-gate``
+    in a subprocess — the same prefill→decode request over the
+    in-process dp=2 handoff vs a loopback-TCP kvnet handoff, plus the
+    remote-prefix-fetch leg (docs/CROSS_HOST.md).  Best of ``runs`` =
+    lowest overhead ratio: a latency-ratio gate, so 'best' must mean
+    the least load-noise-polluted run."""
+    best = None
+    for _ in range(max(1, runs)):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        proc = subprocess.run(
+            [
+                sys.executable,
+                str(REPO_ROOT / "tools" / "scenarios.py"),
+                "--cross-host-gate",
+            ],
+            capture_output=True, text=True, timeout=600, env=env,
+        )
+        line = None
+        for candidate in reversed(proc.stdout.strip().splitlines()):
+            try:
+                parsed = json.loads(candidate)
+            except ValueError:
+                continue
+            if (
+                isinstance(parsed, dict)
+                and parsed.get("kind") == "cross_host"
+            ):
+                line = parsed
+                break
+        if proc.returncode != 0 or line is None:
+            raise RuntimeError(
+                f"scenarios --cross-host-gate failed "
+                f"rc={proc.returncode}: {proc.stderr[-400:]}"
+            )
+        if best is None or line["overhead_ratio"] < best["overhead_ratio"]:
+            best = line
+    print(
+        f"perf_check: cross_host remote handoff "
+        f"{best['remote']['wall_s']}s vs local "
+        f"{best['local']['wall_s']}s (ratio {best['overhead_ratio']}) "
+        f"prefix_hits={best['remote_prefix']['hits']} "
+        f"identical={best['token_identical']}"
+    )
+    return best
+
+
 def measure_unified(u_cfg: dict, runs: int) -> dict:
     """ISSUE 14 gate driver (docs/MEMORY.md): the unified-arena tiered
     memory measurement (tools/scenarios.py --unified-gate) — a mixed
@@ -545,6 +593,15 @@ def main(argv: list[str] | None = None) -> int:
             print(f"perf_check: unified measurement failed: {exc}")
             return 2
 
+    x_cfg = baseline.get("cross_host")
+    x_line: dict | None = None
+    if x_cfg:
+        try:
+            x_line = measure_cross_host(x_cfg, int(x_cfg.get("runs", 1)))
+        except Exception as exc:  # noqa: BLE001 — tool boundary
+            print(f"perf_check: cross_host measurement failed: {exc}")
+            return 2
+
     if write:
         out = {
             "_comment": (
@@ -618,6 +675,11 @@ def main(argv: list[str] | None = None) -> int:
             # set, and the zero-deadlock completion demand are the
             # ISSUE 14 acceptance criteria, not measured floors
             out["unified"] = dict(u_cfg)
+        if x_cfg:
+            # declarative: the remote-vs-local handoff overhead bound
+            # and the structural remote-hit/handoff demands are the
+            # ISSUE 19 acceptance criteria, not measured floors
+            out["cross_host"] = dict(x_cfg)
         if dp_cfg:
             out["dp"] = {
                 **dp_cfg,
@@ -967,6 +1029,40 @@ def main(argv: list[str] | None = None) -> int:
             failures.append(
                 "unified: the arena charged no adapters — the unified "
                 "budget was not exercised"
+            )
+
+    if x_cfg and x_line is not None:
+        # ISSUE 19 acceptance: a loopback-TCP kvnet handoff completes
+        # within max_overhead_ratio x the in-process dp=2 handoff,
+        # token-identical across all three legs, with the remote path
+        # actually taken (kvnet handoffs counted) and the
+        # remote-prefix leg actually served over the wire
+        max_ratio = float(x_cfg.get("max_overhead_ratio", 2.5))
+        if x_line["overhead_ratio"] > max_ratio:
+            failures.append(
+                f"cross_host: remote handoff {x_line['remote']['wall_s']}s "
+                f"is {x_line['overhead_ratio']}x the local fleet's "
+                f"({x_line['local']['wall_s']}s) > allowed {max_ratio}x"
+            )
+        if not x_line.get("token_identical"):
+            failures.append(
+                "cross_host: remote handoff or remote-prefix outputs "
+                "diverged (a remote hit must behave exactly like a "
+                "local one)"
+            )
+        min_handoffs = int(x_cfg.get("min_remote_handoffs", 1))
+        if x_line["remote"].get("handoffs_remote", 0) < min_handoffs:
+            failures.append(
+                f"cross_host: {x_line['remote'].get('handoffs_remote')} "
+                f"kvnet handoffs < required {min_handoffs} (the remote "
+                "path was not actually taken)"
+            )
+        min_hits = int(x_cfg.get("min_remote_prefix_hits", 1))
+        if x_line["remote_prefix"].get("hits", 0) < min_hits:
+            failures.append(
+                f"cross_host: {x_line['remote_prefix'].get('hits')} "
+                f"remote prefix pages served < required {min_hits} "
+                "(the prefix-sharing path was not actually exercised)"
             )
 
     if failures:

@@ -158,6 +158,10 @@ def build_engine(
     slo_config: str | None = None,
     ledger_log: str | None = None,
     capture_trace: str | None = None,
+    kvnet_listen: str | None = None,
+    kvnet_peers: tuple = (),
+    kvnet_node_id: str | None = None,
+    kvnet_timeout_s: float = 5.0,
 ):
     """One production-shaped in-process engine (the closed-loop target
     both the steady-state suites and the chaos soak drive).  Defaults
@@ -196,6 +200,10 @@ def build_engine(
         kv_host_cache_gb=kv_host_cache_gb,
         kv_disk_cache_gb=kv_disk_cache_gb,
         kv_disk_cache_dir=kv_disk_cache_dir,
+        kvnet_listen=kvnet_listen,
+        kvnet_peers=tuple(kvnet_peers),
+        kvnet_node_id=kvnet_node_id,
+        kvnet_timeout_s=kvnet_timeout_s,
         max_engine_restarts=20 if supervised else 0,
         engine_restart_window_s=300.0,
         engine_restart_backoff_s=0.01,
@@ -996,6 +1004,145 @@ async def unified_gate() -> dict:
         shutil.rmtree(disk_dir, ignore_errors=True)
 
 
+async def cross_host_gate(model_dir: str) -> dict:
+    """perf_check ``cross_host`` section (docs/CROSS_HOST.md): the
+    remote-vs-local handoff cost, measured honestly.
+
+    The SAME prefill→decode request runs twice — once on a dp=2
+    prefill+decode fleet whose handoff crosses the in-process shared
+    tier (the PR 11 path), once on a prefill-only host whose handoff
+    crosses a real loopback TCP kvnet to a peered decode host.  Both
+    sides warm their compile sets with a disjoint same-shape prompt
+    first, so the measured pass prices serialization + wire + remote
+    resume, not XLA tracing.  A third leg re-sends the measured prompt
+    on the DECODE host, whose prefix pages now live only on the
+    prefill host — stamping the remote-prefix-fetch TTFT and hit
+    count."""
+    import socket
+
+    from vllm_tgis_adapter_tpu import metrics
+
+    measured_prompt = [5 + (i % 40) for i in range(48)]  # 3 pages
+    warm_prompt = [211 + (i % 29) for i in range(48)]    # same shape
+    spec = {"kind": "chat", "prompt": measured_prompt,
+            "temperature": 0.0, "seed": None, "max_tokens": 32,
+            "logprobs": None}
+    warm_spec = {**spec, "prompt": warm_prompt}
+
+    def _port() -> int:
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    def _fleet(**kw):  # noqa: ANN003, ANN202
+        # prefix registration demotes prompt pages at prefill commit —
+        # the networked tier's INDEX visibility without LRU pressure
+        return build_engine(
+            model_dir, kv_host_cache_gb=1.0,
+            enable_prefix_caching=False, **kw,
+        )
+
+    # ---- local handoff: dp=2 prefill+decode, shared in-process tier
+    local = _fleet(dp=2, roles=("prefill", "decode"))
+    await local.start()
+    status, _ = await run_timed_request(local, "xh-warm-l", warm_spec,
+                                        None)
+    assert status == "ok", "local warm failed"
+    status, local_m = await run_timed_request(local, "xh-meas-l", spec,
+                                              None)
+    assert status == "ok", f"local measured failed: {local_m!r}"
+    local_handoffs = dict(local.handoff_outcomes)
+    await local.stop()
+
+    # ---- remote handoff: prefill-only A → kvnet → mixed B
+    port_a, port_b = _port(), _port()
+    a = _fleet(roles=("prefill",),
+               kvnet_listen=f"127.0.0.1:{port_a}",
+               kvnet_peers=(f"127.0.0.1:{port_b}",), kvnet_node_id="A")
+    b = _fleet(kvnet_listen=f"127.0.0.1:{port_b}",
+               kvnet_peers=(f"127.0.0.1:{port_a}",), kvnet_node_id="B")
+    try:
+        await a.start()
+        await b.start()
+        for _ in range(100):
+            if a.kvnet.peers[0].connected:
+                break
+            await asyncio.sleep(0.05)
+        remote_before = (
+            metrics.kvnet_handoffs_total.labels(outcome="remote")
+            ._value.get()  # noqa: SLF001
+        )
+        status, _ = await run_timed_request(a, "xh-warm-r", warm_spec,
+                                            None)
+        assert status == "ok", "remote warm failed"
+        status, remote_m = await run_timed_request(a, "xh-meas-r", spec,
+                                                   None)
+        assert status == "ok", f"remote measured failed: {remote_m!r}"
+        remote_handoffs = (
+            metrics.kvnet_handoffs_total.labels(outcome="remote")
+            ._value.get()  # noqa: SLF001
+            - remote_before
+        )
+
+        # ---- remote prefix fetch: a THIRD prompt served first on B
+        # (B is mixed — no handoff, so its pages live only in B's
+        # tier), then requested on A, whose prefill must pull the
+        # prefix over the wire instead of recomputing it.  Measured on
+        # A's TTFT — the fetch sits on the time-to-first-token path.
+        from vllm_tgis_adapter_tpu.engine.kv_cache import chain_digests
+
+        prefix_prompt = [97 + (i % 31) for i in range(48)]
+        prefix_spec = {**spec, "prompt": prefix_prompt}
+        status, prefix_base = await run_timed_request(
+            b, "xh-prefix-warm", prefix_spec, None
+        )
+        assert status == "ok", "remote-prefix warm on B failed"
+        wanted = set(chain_digests(prefix_prompt, 16, None, 3))
+        for _ in range(100):
+            if wanted <= set(a.kvnet.peers[0].mirror):
+                break
+            await asyncio.sleep(0.05)
+        hits_before = (
+            metrics.kvnet_remote_hits_total._value.get()  # noqa: SLF001
+        )
+        status, prefix_m = await run_timed_request(
+            a, "xh-prefix", prefix_spec, None
+        )
+        assert status == "ok", f"remote-prefix leg failed: {prefix_m!r}"
+        prefix_hits = (
+            metrics.kvnet_remote_hits_total._value.get()  # noqa: SLF001
+            - hits_before
+        )
+    finally:
+        await a.stop()
+        await b.stop()
+
+    return {
+        "kind": "cross_host",
+        "local": {
+            "wall_s": round(local_m["wall_s"], 4),
+            "ttft_ms": _round_ms(local_m["ttft_s"]),
+            "handoffs_completed": local_handoffs["completed"],
+        },
+        "remote": {
+            "wall_s": round(remote_m["wall_s"], 4),
+            "ttft_ms": _round_ms(remote_m["ttft_s"]),
+            "handoffs_remote": int(remote_handoffs),
+        },
+        "overhead_ratio": round(
+            remote_m["wall_s"] / max(local_m["wall_s"], 1e-9), 3
+        ),
+        "token_identical": remote_m["tokens"] == local_m["tokens"]
+        and prefix_m["tokens"] == prefix_base["tokens"],
+        "remote_prefix": {
+            "hits": int(prefix_hits),
+            "ttft_ms": _round_ms(prefix_m["ttft_s"]),
+        },
+    }
+
+
 async def steady_state(model_dir: str, adapter_dir: str) -> dict:
     """Plain steady-state run of every suite on the default engine —
     the non-gating inspection entry point."""
@@ -1037,6 +1184,11 @@ def main(argv: list[str] | None = None) -> int:
                              "measurement (working set 4x HBM, warm vs "
                              "cold TTFT) and print one JSON line "
                              "(perf_check `unified`)")
+    parser.add_argument("--cross-host-gate", action="store_true",
+                        help="measure remote-vs-local handoff cost over "
+                             "a loopback kvnet fleet and print one JSON "
+                             "line (perf_check `cross_host` — "
+                             "docs/CROSS_HOST.md)")
     parser.add_argument("--suite", default=None,
                         choices=["bursty_multitenant",
                                  "drain_under_load"],
@@ -1049,6 +1201,8 @@ def main(argv: list[str] | None = None) -> int:
     model_dir, adapter_dir = build_fixtures()
     if args.quant_gate:
         line = asyncio.run(quant_gate(model_dir, adapter_dir, args.scheme))
+    elif args.cross_host_gate:
+        line = asyncio.run(cross_host_gate(model_dir))
     elif args.unified_gate:
         line = asyncio.run(unified_gate())
     elif args.suite == "bursty_multitenant":

@@ -340,6 +340,24 @@ class AsyncLLMEngine:
                     window_s=config.engine_restart_window_s,
                     backoff_base_s=config.engine_restart_backoff_s,
                 )
+        # networked KV tier (kvnet/, docs/CROSS_HOST.md): cross-host
+        # prefix sharing + remote handoffs + machine-loss resume.
+        # Default OFF — with no --kvnet-* flags nothing below changes.
+        self.kvnet = None
+        if getattr(config, "kvnet_listen", None) or getattr(
+            config, "kvnet_peers", ()
+        ):
+            if getattr(self.engine, "kv_tier", None) is None:
+                logger.warning(
+                    "--kvnet-* requires the host KV tier "
+                    "(--kv-host-cache-gb > 0); kvnet disabled"
+                )
+            else:
+                from vllm_tgis_adapter_tpu.kvnet.manager import (
+                    KvNetManager,
+                )
+
+                self.kvnet = KvNetManager(self, config)
 
     # ------------------------------------------------------------ frontdoor
 
@@ -436,13 +454,25 @@ class AsyncLLMEngine:
         # same pages; the router scores it below device residency
         # (docs/SCALING.md placement tiers)
         host_tokens = 0
+        remote_tokens = 0
         tier = self.engine.kv_tier
         if prompt_token_ids and tier is not None:
             # incremental walk: one hash on a cold tier, O(covered)
             # when warm — this runs per request on the admission path
-            host_tokens = tier.block_size * tier.peek_prefix_pages(
-                prompt_token_ids, lora_name
+            local_pages = tier.peek_prefix_pages(
+                prompt_token_ids, lora_name, include_remote=False
             )
+            host_tokens = tier.block_size * local_pages
+            if getattr(tier, "remote", None) is not None:
+                # the covered-minus-local split: pages only a kvnet
+                # peer holds score at the (lower) remote-tier weight —
+                # the fetch + host→device transfer both still have to
+                # happen (docs/CROSS_HOST.md).  start_page resumes the
+                # chain walk where local coverage broke, so the return
+                # IS the remote-only extension
+                remote_tokens = tier.block_size * tier.peek_prefix_pages(
+                    prompt_token_ids, lora_name, start_page=local_pages
+                )
         snapshots = []
         for rep in candidates:
             scheduler = rep.engine.scheduler
@@ -466,6 +496,7 @@ class AsyncLLMEngine:
                 load=scheduler.num_unfinished,
                 prefix_tokens=prefix_tokens,
                 host_prefix_tokens=host_tokens,
+                remote_prefix_tokens=remote_tokens,
                 adapter_resident=(
                     pool is not None and pool.resident(lora_name)
                 ),
@@ -528,7 +559,13 @@ class AsyncLLMEngine:
         # device slices, dp_replicas tolerates sharing them
         dp = max(pcfg.data_parallel_size, pcfg.dp_replicas)
         if dp <= 1:
-            return cls(LLMEngine.from_config(config))
+            fleet = cls(LLMEngine.from_config(config))
+            # a dp=1 host may still serve a dedicated role when the
+            # missing capability lives across the kvnet (a lone
+            # prefill host handing decodes to peers, docs/CROSS_HOST.md)
+            # — config validation already demanded peers for that shape
+            fleet.apply_replica_roles(config.resolved_replica_roles())
+            return fleet
         import jax
 
         # each replica owns a full sp×tp slice — or, under pp, a full
@@ -642,12 +679,20 @@ class AsyncLLMEngine:
             )
         if self.watchdog is not None:
             self.watchdog.start()
+        if self.kvnet is not None:
+            # after the step loops: a peer's first INDEX sync may land
+            # as soon as the service port is open
+            await self.kvnet.start()
 
     async def stop(self) -> None:
         self._stopped = True
         if self.supervisor is not None:
             # an in-flight recovery must not race the teardown below
             await self.supervisor.stop()
+        if self.kvnet is not None:
+            # before the replicas: output pumps and the peer service
+            # must not observe half-torn engines
+            await self.kvnet.stop()
         if self.frontdoor is not None:
             # parked waiters fail fast instead of hanging on a pump
             # that is about to be cancelled
@@ -2155,6 +2200,15 @@ class AsyncLLMEngine:
                 and rep.role in _DECODE_CAPABLE
             ]
             if not targets:
+                # no local decode-capable replica: the networked tier
+                # extends the ladder ACROSS hosts before the retryable
+                # floor (docs/CROSS_HOST.md) — on success the peer owns
+                # decode and its OUTPUT frames feed this still-open
+                # stream; handoff_to_peer retires the local record
+                if self.kvnet is not None and (
+                    await self.kvnet.handoff_to_peer(ckpt, tier)
+                ):
+                    continue
                 tier.pop_checkpoint(rid)
                 self._handoff_fallback(src, rid, "no_decode_replica")
                 continue

@@ -1003,6 +1003,22 @@ class EngineConfig:
     # on read, so the directory may survive restarts (cross-restart
     # reuse) or be shared by successive server generations.
     kv_disk_cache_dir: str | None = None
+    # --kvnet-listen host:port of the networked KV tier's RPC service
+    # (kvnet/, docs/CROSS_HOST.md): cross-host prefix sharing, remote
+    # DecodeCheckpoint handoffs, and machine-loss resume over the
+    # disk-entry wire format.  None (default) keeps kvnet entirely off
+    # — zero behavior change.  Port 0 binds an ephemeral port (tests).
+    kvnet_listen: str | None = None
+    # --kvnet-peers host:port addresses of the other hosts in the
+    # fleet; each becomes a heartbeat-revived PeerClient whose digest
+    # mirror extends prefix coverage fleet-wide
+    kvnet_peers: tuple[str, ...] = ()
+    # --kvnet-node-id stable identity in peer HELLOs (adoption sweeps
+    # key staged handoffs by it); None derives one from the listen addr
+    kvnet_node_id: str | None = None
+    # --kvnet-timeout per-request deadline against a peer; bounded
+    # retry with backoff inside it, then degradation to local tiers
+    kvnet_timeout_s: float = 5.0
     # unified paged HBM arena (engine/arena.py, docs/MEMORY.md): KV
     # pages and adapter shards draw from ONE block budget with unified
     # LRU + pinning — adapter residency charges true-rank pages, KV
@@ -1280,18 +1296,25 @@ class EngineConfig:
         roles = self.resolved_replica_roles()
         if all(r == "mixed" for r in roles):
             return  # pre-disaggregation behavior; nothing to demand
-        if not any(r in ("decode", "mixed") for r in roles):
-            raise ValueError(
-                f"replica roles {roles} have no decode-capable replica "
-                "(decode or mixed): prefill replicas would stage "
-                "handoffs nothing can ever consume"
-            )
-        if not any(r in ("prefill", "mixed") for r in roles):
-            raise ValueError(
-                f"replica roles {roles} have no prefill-capable replica "
-                "(prefill or mixed): fresh requests would have nowhere "
-                "to run their prompt"
-            )
+        # a host with kvnet peers can satisfy either role REMOTELY:
+        # an all-prefill host hands checkpoints to decode-capable
+        # peers over the networked tier (docs/CROSS_HOST.md), and an
+        # all-decode host adopts staged checkpoints from prefill
+        # peers — so the single-host capability demands only apply
+        # when this process is the whole fleet
+        if not self.kvnet_peers:
+            if not any(r in ("decode", "mixed") for r in roles):
+                raise ValueError(
+                    f"replica roles {roles} have no decode-capable "
+                    "replica (decode or mixed): prefill replicas would "
+                    "stage handoffs nothing can ever consume"
+                )
+            if not any(r in ("prefill", "mixed") for r in roles):
+                raise ValueError(
+                    f"replica roles {roles} have no prefill-capable "
+                    "replica (prefill or mixed): fresh requests would "
+                    "have nowhere to run their prompt"
+                )
         if self.kv_host_cache_gb <= 0:
             raise ValueError(
                 "prefill/decode replica roles require the host KV tier "
@@ -1495,6 +1518,16 @@ class EngineConfig:
                 else float(getattr(args, "kv_disk_cache_gb", 0.0) or 0.0)
             ),
             kv_disk_cache_dir=getattr(args, "kv_disk_cache_dir", None),
+            kvnet_listen=getattr(args, "kvnet_listen", None),
+            kvnet_peers=tuple(
+                p.strip()
+                for p in (getattr(args, "kvnet_peers", None) or "").split(",")
+                if p.strip()
+            ),
+            kvnet_node_id=getattr(args, "kvnet_node_id", None),
+            kvnet_timeout_s=float(
+                getattr(args, "kvnet_timeout", 5.0) or 5.0
+            ),
             unified_arena=getattr(args, "unified_arena", True),
             quantization=args.quantization,
             otlp_traces_endpoint=args.otlp_traces_endpoint,

@@ -1097,6 +1097,19 @@ class LLMEngine:
                 seq.blocks.blocks,
                 seq.lora_name,
             )
+            remote_pages = getattr(ticket, "remote_pages", 0)
+            if remote_pages:
+                # pages a kvnet peer served into this promotion: prefill
+                # compute another HOST did (docs/CROSS_HOST.md) — priced
+                # apart from the local host/disk rungs
+                metrics.kv_prefix_tokens_reused_total.labels(
+                    tier="remote"
+                ).inc(remote_pages * bs)
+                self.recorder.record(
+                    "remote_hit", seq.request_id,
+                    step=self.step_counter, trace_id=seq.trace_id,
+                    pages=remote_pages, tokens=remote_pages * bs,
+                )
             self.recorder.record(
                 "promote_host", seq.request_id, step=self.step_counter,
                 trace_id=seq.trace_id, tokens=promoted,

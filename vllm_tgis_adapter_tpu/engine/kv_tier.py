@@ -65,6 +65,98 @@ from vllm_tgis_adapter_tpu.utils import spawn_task
 
 logger = init_logger(__name__)
 
+#: Entry-format version stamped into every serialized header ("v").
+#: The disk format IS the kvnet wire format (docs/CROSS_HOST.md), so
+#: the two evolve through this one number: readers accept any version
+#: up to their own and treat NEWER versions as corrupt (never guess at
+#: an unknown layout), which lets a rolling fleet upgrade writers one
+#: host at a time.  Entries written before the field existed parse as
+#: version 0 — the pre-versioning layout, which version 1 is payload-
+#: compatible with.
+ENTRY_VERSION = 1
+#: Header "flags" bit: the entry's array tuple carries quant-scale
+#: sidecars (ops/kv_quant.py — ``(k, v, k_scale, v_scale)``).  Purely
+#: descriptive today (the "arrays" list already names every member);
+#: UNKNOWN flag bits are ignored on read so future writers can mark
+#: capabilities without breaking old readers.
+ENTRY_FLAG_QUANT_SIDECAR = 0x1
+
+
+def serialize_entry(arrays: tuple, meta: dict) -> bytes:
+    """One self-describing entry blob: a JSON header line (version,
+    flags, array shapes/dtypes, payload sha256, caller meta) followed
+    by the raw concatenated array bytes.  This is both the on-disk
+    layout (``DiskKVTier``) and the kvnet wire payload."""
+    payload = b"".join(
+        np.ascontiguousarray(a).tobytes() for a in arrays
+    )
+    header = dict(meta)
+    header["v"] = ENTRY_VERSION
+    header["flags"] = (
+        ENTRY_FLAG_QUANT_SIDECAR if len(arrays) > 2 else 0
+    )
+    header["arrays"] = [
+        {"shape": list(a.shape), "dtype": str(a.dtype)}
+        for a in arrays
+    ]
+    header["sha256"] = hashlib.sha256(payload).hexdigest()
+    return json.dumps(header).encode() + b"\n" + payload
+
+
+def _validate_entry(meta: dict, payload: bytes) -> Optional[tuple]:
+    """Shared read-side validation: version gate, payload checksum,
+    array reconstruction.  ``None`` = corrupt or from-the-future —
+    never served (both the mmap disk read and the network read funnel
+    through here, so the two can never diverge on what "valid" means)."""
+    try:
+        if int(meta.get("v", 0)) > ENTRY_VERSION:
+            # a newer writer's entry: the payload layout may have
+            # changed in ways this reader cannot detect, so refuse it
+            # exactly like a checksum mismatch
+            return None
+        # meta.get("flags", 0): known bits are descriptive only;
+        # unknown bits are deliberately ignored (forward compat)
+        if hashlib.sha256(payload).hexdigest() != meta.get("sha256"):
+            return None
+        arrays = []
+        pos = 0
+        for spec in meta["arrays"]:
+            dt = np.dtype(spec["dtype"])
+            count = int(np.prod(spec["shape"])) or 0
+            arr = np.frombuffer(
+                payload, dtype=dt, count=count, offset=pos
+            ).reshape(spec["shape"]).copy()
+            pos += count * dt.itemsize
+            arrays.append(arr)
+        return tuple(arrays)
+    except Exception:  # noqa: BLE001 — any parse failure = corrupt
+        return None
+
+
+def _is_remote_marker(page) -> bool:  # noqa: ANN001
+    """A ``("remote", digest)`` placeholder from ``_collect`` — the
+    str check FIRST: a regular resolved page is a same-length tuple of
+    numpy arrays, where ``== "remote"`` would be ambiguous."""
+    return (
+        isinstance(page, tuple) and len(page) == 2
+        and isinstance(page[0], str) and page[0] == "remote"
+    )
+
+
+def parse_entry(blob: bytes) -> Optional[tuple]:
+    """``(meta, arrays)`` from one serialized entry blob (the wire
+    form a kvnet peer streams); ``None`` for corrupt / unknown-version
+    blobs, never served."""
+    try:
+        nl = blob.index(b"\n")
+        meta = json.loads(blob[:nl])
+    except Exception:  # noqa: BLE001 — unparseable header = corrupt
+        return None
+    arrays = _validate_entry(meta, blob[nl + 1:])
+    if arrays is None:
+        return None
+    return meta, arrays
+
 
 class DiskKVTier:
     """Byte-budgeted local-disk tier BENEATH the host-RAM store
@@ -203,16 +295,7 @@ class DiskKVTier:
 
     @staticmethod
     def _serialize(arrays: tuple, meta: dict) -> bytes:
-        payload = b"".join(
-            np.ascontiguousarray(a).tobytes() for a in arrays
-        )
-        header = dict(meta)
-        header["arrays"] = [
-            {"shape": list(a.shape), "dtype": str(a.dtype)}
-            for a in arrays
-        ]
-        header["sha256"] = hashlib.sha256(payload).hexdigest()
-        return json.dumps(header).encode() + b"\n" + payload
+        return serialize_entry(arrays, meta)
 
     def _write(self, path: Path, blob: bytes) -> None:
         tmp = path.with_suffix(path.suffix + ".tmp")
@@ -300,22 +383,15 @@ class DiskKVTier:
                     f.fileno(), 0, access=mmap.ACCESS_READ
                 ) as mm:
                     payload = mm[offset:]
-                    if (
-                        hashlib.sha256(payload).hexdigest()
-                        != meta.get("sha256")
-                    ):
-                        raise ValueError("payload checksum mismatch")
-                    arrays = []
-                    pos = 0
-                    for spec in meta["arrays"]:
-                        dt = np.dtype(spec["dtype"])
-                        count = int(np.prod(spec["shape"])) or 0
-                        arr = np.frombuffer(
-                            payload, dtype=dt, count=count, offset=pos
-                        ).reshape(spec["shape"]).copy()
-                        pos += count * dt.itemsize
-                        arrays.append(arr)
-            return meta, tuple(arrays)
+                    # shared validation with the wire read: version
+                    # gate (newer-writer entries read as corrupt),
+                    # checksum, array reconstruction
+                    arrays = _validate_entry(meta, payload)
+                    if arrays is None:
+                        raise ValueError(
+                            "corrupt or unknown-version entry"
+                        )
+            return meta, arrays
         except FileNotFoundError:
             return None
         except Exception:  # noqa: BLE001 — any parse failure = corrupt
@@ -550,6 +626,9 @@ class PromotionTicket:
     ready: bool = False
     failed: bool = False
     cancelled: bool = False
+    # pages fetched from a kvnet peer during assembly (engine core
+    # records a remote_hit event and the remote-reuse metrics at apply)
+    remote_pages: int = 0
 
     def cancel(self) -> None:
         self.cancelled = True
@@ -564,6 +643,13 @@ class HostKVTier:
         # optional disk tier beneath this store (--kv-disk-cache-gb):
         # host LRU victims spill down, promotions walk disk→host→device
         self.disk: Optional[DiskKVTier] = None
+        # optional networked tier beside/beneath the local rungs
+        # (kvnet/, docs/CROSS_HOST.md): a fleet of peers whose digest
+        # mirrors make `has` loop-thread cheap; fetches run async with
+        # bounded retry and a fetch failure TRUNCATES the promotion
+        # span (the shrunk-ticket contract) — a dead or slow peer
+        # degrades to recompute, never a stall
+        self.remote = None  # kvnet.client.RemoteKVTier
         # digest -> entry; LRU order, oldest first
         self._entries: "OrderedDict[bytes, _Entry]" = OrderedDict()
         self.bytes_used = 0
@@ -622,17 +708,48 @@ class HostKVTier:
         shared dp/rebuild-surviving tier carries it along)."""
         self.disk = disk
 
+    def attach_remote(self, remote) -> None:  # noqa: ANN001 — RemoteKVTier
+        """Hang the networked tier beside the local rungs
+        (kvnet.manager at engine start; the shared dp/rebuild-surviving
+        tier carries it along).  From here on, coverage probes and the
+        promotion walk count FLEET-wide residency: a digest a healthy
+        peer mirrors serves a park-and-promote exactly like a disk
+        entry does."""
+        self.remote = remote
+
     def _resident(self, digest: bytes) -> bool:
-        """Committed in host RAM OR on disk (either serves a
-        promotion; disk entries hop through host on the way up)."""
+        """Committed in LOCAL tiers — host RAM or disk (either serves
+        a promotion; disk entries hop through host on the way up)."""
         return digest in self._entries or (
             self.disk is not None and self.disk.has(digest)
         )
 
+    def _covered(self, digest: bytes) -> bool:
+        """Fetchable from ANY rung — local tiers or a healthy kvnet
+        peer's mirror.  The coverage/dedup probe: a remote-mirrored
+        page parks a request (promotion fetches it) and skips the
+        duplicate demotion gather (one copy fleet-wide)."""
+        return self._resident(digest) or (
+            self.remote is not None and self.remote.has(digest)
+        )
+
     def has(self, digest: bytes) -> bool:
-        """Committed (any tier) OR in-flight: the engine uses this to
-        skip duplicate demotion gathers, so an in-flight copy counts."""
+        """Committed in the LOCAL rungs OR in-flight: the engine uses
+        this to skip duplicate demotion gathers, so an in-flight copy
+        counts.  Deliberately NOT `_covered`: a page only a peer
+        mirrors must still demote here — this host can neither
+        advertise it over INDEX nor gather it for a checkpoint handoff
+        from a remote mirror (docs/CROSS_HOST.md)."""
         return self._resident(digest) or digest in self._inflight
+
+    def local_digests(self) -> list:
+        """Every digest committed in the LOCAL rungs (host RAM + disk)
+        — the kvnet INDEX sync answer, so peers mirror exactly what
+        this host can actually serve (loop-thread dict reads only)."""
+        out = list(self._entries.keys())
+        if self.disk is not None:
+            out.extend(self.disk._index.keys())  # noqa: SLF001 — same module
+        return out
 
     def peek_pages(self, digests: list) -> int:
         """Consecutive committed pages from ``digests[0]`` — the
@@ -640,7 +757,7 @@ class HostKVTier:
         ``BlockAllocator.peek_prefix``'s pure-walk contract)."""
         n = 0
         for digest in digests:
-            if not self._resident(digest):
+            if not self._covered(digest):
                 break
             n += 1
         return n
@@ -650,6 +767,7 @@ class HostKVTier:
         token_ids: list,
         lora_name=None,  # noqa: ANN001 — Optional[str]
         start_page: int = 0,
+        include_remote: bool = True,
     ) -> int:
         """Incremental chain walk: committed pages covering
         ``token_ids`` from ``start_page`` on, hashing only as far as
@@ -658,12 +776,15 @@ class HostKVTier:
         this is the admission/placement hot-path probe; callers that
         need the digests themselves (ticket construction) re-derive
         exactly the covered span via ``kv_cache.chain_digests``.
-        Capped one token short of the prompt, like ``match_prefix``."""
+        Capped one token short of the prompt, like ``match_prefix``.
+        ``include_remote=False`` restricts the walk to the LOCAL rungs
+        (placement scores local and peer coverage separately)."""
         from vllm_tgis_adapter_tpu.engine.kv_cache import BlockAllocator
 
         bs = self.block_size
         max_pages = (len(token_ids) - 1) // bs
         h = BlockAllocator._chain_seed(lora_name)  # noqa: SLF001
+        probe = self._covered if include_remote else self._resident
         matched = 0
         for p in range(max_pages):
             h = BlockAllocator._chain_step(  # noqa: SLF001
@@ -671,7 +792,7 @@ class HostKVTier:
             )
             if p < start_page:
                 continue  # chain continuity only; not probed
-            if not self._resident(h):
+            if not probe(h):
                 break
             matched += 1
         return matched
@@ -858,7 +979,9 @@ class HostKVTier:
         """Longest still-valid prefix of the ticket's entries — host
         arrays where RAM has them, ``("disk", digest)`` markers where
         only the disk tier does (loaded by the worker-thread stage;
-        loop-thread dict reads only here)."""
+        loop-thread dict reads only here), and ``("remote", digest)``
+        markers where only a kvnet peer mirrors the page (fetched
+        async by ``_resolve_remote`` BEFORE the transfer lock)."""
         pages: list = []
         for digest in ticket.digests:
             entry = self._get_valid(digest)
@@ -867,6 +990,9 @@ class HostKVTier:
                 continue
             if self.disk is not None and self.disk.has(digest):
                 pages.append(("disk", digest))
+                continue
+            if self.remote is not None and self.remote.has(digest):
+                pages.append(("remote", digest))
                 continue
             break
         return pages
@@ -892,6 +1018,11 @@ class HostKVTier:
             if isinstance(page, tuple) and len(page) == 2 and (
                 isinstance(page[0], str)
             ):
+                if page[0] != "disk":
+                    # an unresolved remote marker (offline engine, or
+                    # the fetch missed): the span shrinks here — the
+                    # stage never blocks a worker thread on a peer
+                    break
                 arrays = (
                     self.disk.load(page[1])
                     if self.disk is not None
@@ -914,8 +1045,48 @@ class HostKVTier:
         ]
         return staged, recovered
 
+    async def _resolve_remote(self, pages: list) -> tuple:
+        """Fetch the ``("remote", digest)`` markers from the networked
+        tier BEFORE the transfer lock (peer latency must never hold
+        local transfer bandwidth hostage).  Fetched pages are checksum-
+        validated entry blobs; a miss, timeout or corrupt payload
+        TRUNCATES the span at that page (the shrunk-ticket contract) —
+        a dead or slow peer degrades to recompute, never a stall.
+        Returns ``(resolved_pages, remote_page_count)``."""
+        wanted = [p[1] for p in pages if _is_remote_marker(p)]
+        if not wanted:
+            return pages, 0
+        fetched: dict = {}
+        if self.remote is not None and not self._closed:
+            try:
+                fetched = await self.remote.fetch(wanted)
+            except Exception:  # noqa: BLE001 — degradation, not failure
+                logger.exception(
+                    "kvnet: remote page fetch failed; promotion span "
+                    "truncates to the locally covered prefix"
+                )
+        out: list = []
+        recovered: list = []
+        hits = 0
+        for p in pages:
+            if _is_remote_marker(p):
+                arrays = fetched.get(p[1])
+                if arrays is None:
+                    break  # peer miss/corrupt mid-flight: span shrinks
+                recovered.append((p[1], *arrays))
+                out.append(arrays)
+                hits += 1
+            else:
+                out.append(p)
+        if recovered:
+            # remote pages hop INTO host RAM like disk reads do: the
+            # next warm request hits locally instead of re-fetching
+            self._insert(recovered, recovered=True)
+        return out, hits
+
     async def _assemble(self, ticket: PromotionTicket, put_fn: Callable) -> None:
         pages = self._collect(ticket)  # on loop: validated dict reads
+        pages, ticket.remote_pages = await self._resolve_remote(pages)
         try:
             async with self._transfer_lock:
                 staged, recovered = await asyncio.to_thread(
@@ -1005,6 +1176,11 @@ class HostKVTier:
                 # verified at load time, and a corrupt entry surfaces
                 # as a shrunk promotion → the existing fallback rung
                 continue
+            if self.remote is not None and self.remote.has(digest):
+                # peer-mirrored pages count the same way: the fetch
+                # validates the entry checksum, and a fetch failure
+                # shrinks the promotion span → recompute fallback
+                continue
             return False
         return True
 
@@ -1073,6 +1249,14 @@ class HostKVTier:
                 "disk": (
                     self.disk.debug_state()
                     if self.disk is not None
+                    else None
+                ),
+                # networked rung (kvnet/): None until a manager
+                # attaches one — the key itself is always present so
+                # obs_check can gate the hierarchy shape
+                "remote": (
+                    self.remote.debug_state()
+                    if self.remote is not None
                     else None
                 ),
             },

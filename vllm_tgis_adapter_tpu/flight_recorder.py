@@ -96,6 +96,21 @@ EVENT_KINDS = (
     #                   level, telemetry/doctor.py; detail: regime,
     #                   phase = open | evidence | close, replica, and
     #                   the rule's evidence payload)
+    "remote_put",     # a kvnet peer mirrored KV pages into this host's
+    #                   tier (batch-level, kvnet/service.py; detail:
+    #                   peer, pages)
+    "remote_hit",     # promotion pages were fetched FROM a kvnet peer
+    #                   (engine core at promotion apply; detail: pages,
+    #                   tokens — prefill compute saved fleet-wide)
+    "remote_handoff_in",  # a cross-host DecodeCheckpoint resumed on
+    #                   this host (kvnet/manager.py; detail: source,
+    #                   output_tokens — machine-loss adoption records
+    #                   it with the dead source's node id)
+    "peer_up",        # kvnet peer became reachable (batch-level;
+    #                   detail: peer)
+    "peer_down",      # kvnet peer lost — coverage, handoffs and
+    #                   output pumps degrade to local (batch-level;
+    #                   detail: peer)
 )
 
 # Per-request decode events are recorded every N committed tokens — one

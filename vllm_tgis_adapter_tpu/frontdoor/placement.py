@@ -64,6 +64,11 @@ _EWMA_ALPHA = 0.3
 # host-resident token is worth this fraction of a device-resident one
 HOST_TIER_WEIGHT = 0.25
 
+# kvnet-peer residency scores below even the host tier (a remote hit
+# pays a network fetch AND the host→device transfer; docs/CROSS_HOST.md
+# "degradation ladder"): better than recompute, worse than any local rung
+REMOTE_TIER_WEIGHT = 0.1
+
 
 @dataclasses.dataclass
 class ReplicaSnapshot:
@@ -83,6 +88,11 @@ class ReplicaSnapshot:
     # the same value on every snapshot) — scored at a lower weight than
     # device residency: a promotion still pays a host→device transfer
     host_prefix_tokens: int = 0
+    # prompt tokens only a kvnet PEER could serve (fleet coverage minus
+    # local coverage — engine/async_llm.py computes the split with two
+    # peek_prefix_pages walks); scored below the host tier: a remote
+    # hit pays a network fetch on top of the host→device transfer
+    remote_prefix_tokens: int = 0
     # this request's LoRA adapter is live in the replica's device pool
     # (engine/adapter_pool.py) — TRUE residency, read at decision time,
     # unlike the sticky map which only remembers past placements
@@ -212,7 +222,11 @@ class PlacementRouter:
         # (step 2c below is its weaker, post-affinity slot).
         def prefix_score(s: ReplicaSnapshot) -> float:
             host_extra = max(0, s.host_prefix_tokens - s.prefix_tokens)
-            return s.prefix_tokens + HOST_TIER_WEIGHT * host_extra
+            return (
+                s.prefix_tokens
+                + HOST_TIER_WEIGHT * host_extra
+                + REMOTE_TIER_WEIGHT * s.remote_prefix_tokens
+            )
 
         prefix_best = max(
             eligible, key=lambda s: (prefix_score(s), -s.load, -s.index)
@@ -242,7 +256,10 @@ class PlacementRouter:
         # prefill recompute), just subordinate to every affinity that
         # actually distinguishes replicas
         if chosen is None:
-            hosted = [s for s in eligible if s.host_prefix_tokens > 0]
+            hosted = [
+                s for s in eligible
+                if s.host_prefix_tokens > 0 or s.remote_prefix_tokens > 0
+            ]
             if hosted:
                 chosen = min(hosted, key=lambda s: (s.load, s.index))
                 policy = POLICY_PREFIX

@@ -1,0 +1,25 @@
+"""kvnet: the networked KV tier (docs/CROSS_HOST.md).
+
+Cross-host prefix sharing, remote DecodeCheckpoint handoffs, and
+machine-loss resume over a length-prefixed TCP framing of the PR 14
+disk-entry format.  Three pieces:
+
+* ``wire``    — the framed protocol + entry/checkpoint/output codecs
+                (the disk entry format IS the page payload format).
+* ``service`` — ``KvTierService``: the asyncio TCP server a host
+                exposes (put/get/has/index by digest, checkpoint
+                stage/commit, output streaming).
+* ``client``  — ``PeerClient`` (one connection + digest mirror + RTT/
+                degradation state per peer) and ``RemoteKVTier`` (the
+                tier backend that slots under ``HostKVTier`` via
+                ``attach_remote``).
+* ``manager`` — ``KvNetManager``: owns the service, the peers, the
+                heartbeat/adoption loops, and the remote-handoff
+                protocol; built by ``AsyncLLMEngine`` when
+                ``--kvnet-listen``/``--kvnet-peers`` is set.
+
+Everything degrades to the local tiers: a dead, slow, or corrupt peer
+costs at most a bounded timeout on an async path — never a step-loop
+stall (the partition/slow-peer/corrupt-payload fault family in
+tools/chaos_soak.py gates exactly that).
+"""

@@ -1,0 +1,429 @@
+"""kvnet client side: one ``PeerClient`` per configured peer, and the
+``RemoteKVTier`` backend that slots under ``HostKVTier.attach_remote``.
+
+The contract with the step loop (docs/CROSS_HOST.md):
+
+* ``has`` is loop-thread cheap — it consults the locally held digest
+  MIRROR of each healthy peer (synced via INDEX frames and updated on
+  every PUT ack), never the network.
+* every network call is async, carries a deadline
+  (``--kvnet-timeout``), and retries a bounded number of times with
+  exponential backoff; after ``_FAILS_TO_DOWN`` consecutive failures
+  the peer is marked ``down`` and its mirror stops answering ``has``
+  until the manager's heartbeat reconnects it.
+* a failure is ALWAYS a miss, never an error: the caller (promotion
+  assembly, handoff drain) falls back to the local tiers or to
+  recompute.
+
+Fault knobs (``delay_s``, ``corrupt_next``) exist for the
+partition/slow-peer/corrupt-payload chaos family; they default off and
+cost one attribute read.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Optional
+
+from vllm_tgis_adapter_tpu import metrics
+from vllm_tgis_adapter_tpu.kvnet import wire
+from vllm_tgis_adapter_tpu.supervisor import failpoints
+from vllm_tgis_adapter_tpu.utils import spawn_task
+
+logger = logging.getLogger(__name__)
+
+#: consecutive failed requests before a peer is declared ``down``
+#: (connection closed; only the heartbeat loop revives it)
+_FAILS_TO_DOWN = 3
+#: bounded retry inside one logical call: 1 try + _RETRIES retries
+_RETRIES = 2
+_BACKOFF_BASE_S = 0.05
+
+STATE_HEALTHY = "healthy"
+STATE_DEGRADED = "degraded"
+STATE_DOWN = "down"
+
+
+class PeerError(Exception):
+    """A request to a peer failed after bounded retry (timeout,
+    connection loss, or an ERR frame).  Callers degrade to local."""
+
+
+class PeerClient:
+    """One outbound connection to a kvnet peer.
+
+    Owns the socket, a reader task resolving rid-correlated response
+    futures, the peer's digest mirror, an RTT EWMA, and the
+    healthy→degraded→down ladder.  All methods run on the event loop;
+    the write path serializes under ``_wlock`` so concurrent requests
+    interleave whole frames, never bytes.
+    """
+
+    def __init__(
+        self,
+        addr: str,
+        *,
+        node_id: str,
+        timeout_s: float = 5.0,
+        on_push=None,       # noqa: ANN001 — async fn(peer, op, header, payload)
+        on_peer_lost=None,  # noqa: ANN001 — fn(peer)
+    ) -> None:
+        host, _, port = addr.rpartition(":")
+        self.addr = addr
+        self.host = host or "127.0.0.1"
+        self.port = int(port)
+        self.node_id = node_id
+        self.peer_node: Optional[str] = None  # from HELLO_R
+        self.timeout_s = timeout_s
+        self.state = STATE_DOWN  # down until the first HELLO succeeds
+        #: digests the peer claims to hold (INDEX sync + PUT acks);
+        #: the whole point: ``has`` answers from here, zero RTTs
+        self.mirror: set = set()
+        self.rtt_s = 0.0  # EWMA over successful round trips
+        # ---- fault knobs (chaos family; default off)
+        self.delay_s = 0.0       # slow-peer: added before every request
+        self.corrupt_next = False  # corrupt-payload: flip a byte in the
+        #                            next RESPONSE payload before decode
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._wlock = asyncio.Lock()
+        self._futures: dict = {}  # rid -> Future[(header, payload)]
+        self._next_rid = 0
+        self._fails = 0
+        self._reader_task = None
+        self._on_push = on_push
+        self._on_peer_lost = on_peer_lost
+        self._closing = False
+
+    # ------------------------------------------------------------ lifecycle
+
+    @property
+    def connected(self) -> bool:
+        return self._writer is not None and self.state != STATE_DOWN
+
+    async def connect(self) -> bool:
+        """Dial + HELLO.  Returns True on success; failure leaves the
+        peer ``down`` for the heartbeat to retry — never raises."""
+        if self.connected:
+            return True
+        try:
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection(self.host, self.port),
+                self.timeout_s,
+            )
+        except Exception:  # noqa: BLE001 — unreachable peer is routine
+            self.state = STATE_DOWN
+            return False
+        self._reader, self._writer = reader, writer
+        self._closing = False
+        self._reader_task = spawn_task(
+            self._read_loop(), name=f"kvnet-peer-{self.addr}"
+        )
+        try:
+            header, _ = await self._request(
+                wire.OP_HELLO,
+                {"node": self.node_id, "version": wire.WIRE_VERSION},
+            )
+        except PeerError:
+            await self.close()
+            return False
+        self.peer_node = header.get("node")
+        self.state = STATE_HEALTHY
+        self._fails = 0
+        return True
+
+    async def close(self) -> None:
+        self._closing = True
+        self.state = STATE_DOWN
+        writer, self._writer, self._reader = self._writer, None, None
+        task, self._reader_task = self._reader_task, None
+        if writer is not None:
+            try:
+                writer.close()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+        if task is not None:
+            task.cancel()
+        for fut in self._futures.values():
+            if not fut.done():
+                fut.set_exception(PeerError("connection closed"))
+        self._futures.clear()
+
+    # ---------------------------------------------------------- I/O core
+
+    async def _read_loop(self) -> None:
+        reader = self._reader
+        try:
+            while reader is not None:
+                op, _flags, header, payload = await wire.read_frame(
+                    reader
+                )
+                rid = header.get("rid")
+                fut = (
+                    self._futures.pop(rid, None)
+                    if rid is not None
+                    else None
+                )
+                if fut is not None:
+                    if not fut.done():
+                        fut.set_result((op, header, payload))
+                elif self._on_push is not None:
+                    # unsolicited frame: OUTPUT pushed by a handoff
+                    # target streaming a remote request's tokens back
+                    await self._on_push(self, op, header, payload)
+        except (asyncio.CancelledError, GeneratorExit):
+            raise
+        except Exception:  # noqa: BLE001 — EOF/reset/protocol = peer lost
+            if not self._closing:
+                logger.warning(
+                    "kvnet: connection to peer %s lost", self.addr
+                )
+                await self.close()
+                if self._on_peer_lost is not None:
+                    self._on_peer_lost(self)
+
+    async def _request(
+        self, op: int, header: dict, payload: bytes = b""
+    ) -> tuple:
+        """One framed round trip.  Raises ``PeerError`` on timeout,
+        connection loss, or an ERR reply; updates the RTT EWMA and the
+        degradation counters either way."""
+        if self._writer is None:
+            raise PeerError(f"peer {self.addr} not connected")
+        rid = self._next_rid = self._next_rid + 1
+        fut = asyncio.get_running_loop().create_future()
+        self._futures[rid] = fut
+        t0 = time.monotonic()
+        try:
+            if self.delay_s:
+                # slow-peer fault knob: the sleep counts against the
+                # caller's deadline, exactly like wire latency would
+                await asyncio.sleep(self.delay_s)
+            frame = wire.encode_frame(
+                op, {**header, "rid": rid}, payload
+            )
+            async with self._wlock:
+                self._writer.write(frame)
+                await self._writer.drain()
+            r_op, r_header, r_payload = await asyncio.wait_for(
+                fut, self.timeout_s
+            )
+        except PeerError:
+            self._note_fail()
+            raise
+        except (asyncio.TimeoutError, TimeoutError) as e:
+            self._futures.pop(rid, None)
+            self._note_fail()
+            raise PeerError(f"peer {self.addr} timed out") from e
+        except Exception as e:  # noqa: BLE001 — write failure etc.
+            self._futures.pop(rid, None)
+            self._note_fail()
+            raise PeerError(f"peer {self.addr}: {e}") from e
+        if r_op == wire.OP_ERR:
+            self._note_fail()
+            raise PeerError(
+                f"peer {self.addr}: {r_header.get('error', 'error')}"
+            )
+        self._note_ok(time.monotonic() - t0)
+        if self.corrupt_next and r_payload:
+            # corrupt-payload fault knob: flip one byte so entry
+            # checksum validation rejects the blob downstream
+            self.corrupt_next = False
+            mid = len(r_payload) // 2
+            r_payload = (
+                r_payload[:mid]
+                + bytes([r_payload[mid] ^ 0xFF])
+                + r_payload[mid + 1:]
+            )
+        return r_header, r_payload
+
+    async def request_retry(
+        self, op: int, header: dict, payload: bytes = b""
+    ) -> tuple:
+        """Bounded retry with exponential backoff around ``_request``.
+        Stops early once the peer goes ``down`` (no point hammering a
+        dead host); the LAST error propagates."""
+        last: Optional[Exception] = None
+        for attempt in range(1 + _RETRIES):
+            if self._writer is None:
+                break
+            try:
+                return await self._request(op, header, payload)
+            except PeerError as e:
+                last = e
+                if self.state == STATE_DOWN:
+                    break
+                await asyncio.sleep(_BACKOFF_BASE_S * (2 ** attempt))
+        raise last if last is not None else PeerError(
+            f"peer {self.addr} not connected"
+        )
+
+    async def push(
+        self, op: int, header: dict, payload: bytes = b""
+    ) -> None:
+        """Fire-and-forget frame (CANCEL); errors close the peer."""
+        if self._writer is None:
+            return
+        try:
+            frame = wire.encode_frame(op, header, payload)
+            async with self._wlock:
+                self._writer.write(frame)
+                await self._writer.drain()
+        except Exception:  # noqa: BLE001 — push loss is tolerable
+            await self.close()
+            if self._on_peer_lost is not None:
+                self._on_peer_lost(self)
+
+    # ------------------------------------------------------- degradation
+
+    def _note_ok(self, rtt: float) -> None:
+        self._fails = 0
+        if self.state != STATE_DOWN:
+            self.state = STATE_HEALTHY
+        self.rtt_s = (
+            rtt if self.rtt_s == 0.0 else 0.8 * self.rtt_s + 0.2 * rtt
+        )
+        metrics.kvnet_peer_rtt_seconds.labels(peer=self.addr).set(
+            self.rtt_s
+        )
+
+    def _note_fail(self) -> None:
+        self._fails += 1
+        if self._fails >= _FAILS_TO_DOWN:
+            if self.state != STATE_DOWN:
+                logger.warning(
+                    "kvnet: peer %s marked down after %d consecutive "
+                    "failures; degrading to local tiers",
+                    self.addr, self._fails,
+                )
+            self.state = STATE_DOWN
+            # close asynchronously; futures are failed by close()
+            spawn_task(self.close(), name=f"kvnet-close-{self.addr}")
+            if self._on_peer_lost is not None:
+                self._on_peer_lost(self)
+        elif self.state == STATE_HEALTHY:
+            self.state = STATE_DEGRADED
+
+    def debug_state(self) -> dict:
+        return {
+            "addr": self.addr,
+            "node": self.peer_node,
+            "state": self.state,
+            "rtt_s": round(self.rtt_s, 6),
+            "mirror": len(self.mirror),
+        }
+
+
+class RemoteKVTier:
+    """The networked rung: answers ``HostKVTier``'s coverage probes
+    from peer mirrors and fetches/mirrors page entries on demand.
+
+    Slots in via ``HostKVTier.attach_remote``; every method degrades to
+    a miss on peer failure — the local tiers and the recompute path
+    are always beneath it.
+    """
+
+    def __init__(self, peers: list) -> None:
+        self.peers = peers  # list[PeerClient], owned by the manager
+        self._lookups = 0  # lifetime fetch fan-out (hit-ratio gauge)
+        self._hits = 0
+
+    def _healthy(self) -> list:
+        return [p for p in self.peers if p.state != STATE_DOWN]
+
+    # ------------------------------------------------------ tier surface
+
+    def has(self, digest: bytes) -> bool:
+        """Loop-thread cheap: mirror membership, zero network."""
+        return any(digest in p.mirror for p in self._healthy())
+
+    async def fetch(self, digests: list) -> dict:
+        """``{digest: arrays}`` for every digest a peer could serve,
+        each blob checksum-validated through the shared disk read path.
+        Partial results are fine — the promotion span truncates at the
+        first miss; a failed peer contributes nothing and is NOT
+        retried beyond the bounded ladder."""
+        failpoints.fire("kvnet.get")
+        self._lookups += len(digests)
+        metrics.kvnet_remote_lookups_total.inc(len(digests))
+        out: dict = {}
+        remaining = list(digests)
+        for peer in self._healthy():
+            wanted = [d for d in remaining if d in peer.mirror]
+            if not wanted:
+                continue
+            try:
+                header, payload = await peer.request_retry(
+                    wire.OP_GET, {"digests": [d.hex() for d in wanted]}
+                )
+            except PeerError:
+                continue  # next peer may mirror the same digests
+            got = wire.unpack_entries(payload)
+            metrics.kvnet_transfer_bytes_total.labels(
+                direction="in"
+            ).inc(len(payload))
+            for digest, arrays in got:
+                out[digest] = arrays
+            # a digest the peer advertised but failed to serve (evicted
+            # or corrupt in transit) leaves its mirror so the next
+            # probe is honest
+            served = {d for d, _ in got}
+            for d in wanted:
+                if d not in served:
+                    peer.mirror.discard(d)
+            remaining = [d for d in remaining if d not in out]
+            if not remaining:
+                break
+        if out:
+            self._hits += len(out)
+            metrics.kvnet_remote_hits_total.inc(len(out))
+        if self._lookups:
+            metrics.kvnet_remote_hit_ratio.set(
+                self._hits / self._lookups
+            )
+        return out
+
+    async def put(self, items: list) -> int:
+        """Mirror ``[(digest, arrays), ...]`` to every healthy peer
+        (dedup upstream: the engine only gathers pages no rung —
+        peers included — already covers).  Returns the number of peers
+        that acked."""
+        failpoints.fire("kvnet.put")
+        if not items:
+            return 0
+        payload = wire.pack_entries(items)
+        digests = [d for d, _ in items]
+        acked = 0
+        for peer in self._healthy():
+            wanted = [d for d in digests if d not in peer.mirror]
+            if not wanted:
+                acked += 1
+                continue
+            try:
+                await peer.request_retry(
+                    wire.OP_PUT,
+                    {"digests": [d.hex() for d in digests]},
+                    payload,
+                )
+            except PeerError:
+                continue
+            metrics.kvnet_transfer_bytes_total.labels(
+                direction="out"
+            ).inc(len(payload))
+            peer.mirror.update(digests)
+            acked += 1
+        return acked
+
+    def debug_state(self) -> dict:
+        states = [p.state for p in self.peers]
+        return {
+            "peers": [p.debug_state() for p in self.peers],
+            "healthy": states.count(STATE_HEALTHY),
+            "degraded": states.count(STATE_DEGRADED),
+            "down": states.count(STATE_DOWN),
+            "mirrored_digests": len(
+                set().union(*(p.mirror for p in self.peers))
+            ) if self.peers else 0,
+        }

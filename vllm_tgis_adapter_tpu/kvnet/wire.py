@@ -1,0 +1,330 @@
+"""kvnet wire protocol: framing + codecs (docs/CROSS_HOST.md).
+
+One frame = a fixed 20-byte prefix, a JSON header, and an opaque
+payload::
+
+    magic "KVNT" | version u8 | flags u8 | op u8 | reserved u8
+    | header_len u32 | payload_len u64 | header JSON | payload
+
+The prefix carries the SAME version-byte + flags discipline the disk
+entry header grew in this PR (``engine/kv_tier.ENTRY_VERSION``): readers
+reject frames from a NEWER protocol version and ignore unknown flag
+bits, so the on-disk format and the network protocol evolve
+independently but by one rulebook.
+
+Page payloads are concatenated *disk-format entry blobs* — each one the
+exact self-describing bytes ``DiskKVTier`` would write (JSON header
+line with version/flags/shapes/sha256, then raw array bytes) — prefixed
+with a u64 blob length.  A receiver validates every blob through the
+shared ``kv_tier.parse_entry`` read path, so a corrupt network payload
+is dropped exactly like a corrupt disk entry: never served.
+
+The transport is plain asyncio TCP today; nothing in the frame or the
+codecs assumes TCP semantics beyond ordered byte streams, so an RDMA or
+ICI transport only has to replace the reader/writer pair.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import struct
+from typing import Optional
+
+from vllm_tgis_adapter_tpu.engine.kv_tier import (
+    DecodeCheckpoint,
+    parse_entry,
+    serialize_entry,
+)
+from vllm_tgis_adapter_tpu.engine.outputs import (
+    CompletionOutput,
+    Logprob,
+    RequestOutput,
+)
+from vllm_tgis_adapter_tpu.engine.sampling_params import (
+    RequestOutputKind,
+    SamplingParams,
+    StructuredOutputsParams,
+)
+
+try:  # json imported lazily-compatible with the engine's json use
+    import json
+except ImportError:  # pragma: no cover — stdlib
+    raise
+
+MAGIC = b"KVNT"
+WIRE_VERSION = 1
+_PREFIX = struct.Struct(">4sBBBBIQ")
+PREFIX_LEN = _PREFIX.size  # 20
+_BLOB_LEN = struct.Struct(">Q")
+
+# header/payload bounds: a malformed or hostile peer must cost a closed
+# connection, not an OOM
+MAX_HEADER_BYTES = 1 << 20
+MAX_PAYLOAD_BYTES = 1 << 30
+
+# ------------------------------------------------------------------- ops
+OP_HELLO = 1          # {node, version} -> HELLO_R {node, version}
+OP_HELLO_R = 2
+OP_PING = 3           # {} -> PONG {} (RTT probe, heartbeat)
+OP_PONG = 4
+OP_HAS = 5            # {digests: [hex]} -> HAS_R {hits: [bool]}
+OP_HAS_R = 6
+OP_GET = 7            # {digests: [hex]} -> GET_R {hits: [hex]} + blobs
+OP_GET_R = 8
+OP_PUT = 9            # {digests: [hex]} + blobs -> PUT_R {stored}
+OP_PUT_R = 10
+OP_CKPT_PUT = 11      # {ckpt, digests} + page blobs -> CKPT_STAGED {rid}
+OP_CKPT_STAGED = 12
+OP_CKPT_COMMIT = 13   # {rid} -> CKPT_COMMIT_R {accepted}
+OP_CKPT_COMMIT_R = 14
+OP_OUTPUT = 15        # {rid, out} — pushed target→source, no response
+OP_CANCEL = 16        # {rid} — pushed source→target, no response
+OP_INDEX = 17         # {} -> INDEX_R {digests: [hex]} (mirror sync)
+OP_INDEX_R = 18
+OP_ERR = 19           # {rid?, error} — request-scoped failure
+
+
+class ProtocolError(Exception):
+    """A frame violated the protocol (bad magic, newer version,
+    oversized header/payload).  The connection is not recoverable."""
+
+
+def encode_frame(
+    op: int, header: dict, payload: bytes = b"", flags: int = 0
+) -> bytes:
+    head = json.dumps(header, separators=(",", ":")).encode()
+    if len(head) > MAX_HEADER_BYTES:
+        raise ProtocolError(f"header too large ({len(head)} bytes)")
+    if len(payload) > MAX_PAYLOAD_BYTES:
+        raise ProtocolError(f"payload too large ({len(payload)} bytes)")
+    return (
+        _PREFIX.pack(
+            MAGIC, WIRE_VERSION, flags, op, 0, len(head), len(payload)
+        )
+        + head
+        + payload
+    )
+
+
+def decode_prefix(prefix: bytes) -> tuple:
+    """``(version, flags, op, header_len, payload_len)`` from the fixed
+    20-byte frame prefix; raises ``ProtocolError`` on violations."""
+    magic, version, flags, op, _reserved, hlen, plen = _PREFIX.unpack(
+        prefix
+    )
+    if magic != MAGIC:
+        raise ProtocolError(f"bad magic {magic!r}")
+    if version > WIRE_VERSION:
+        # a NEWER peer: refuse rather than misparse (the peer sees the
+        # closed connection and can degrade; rolling upgrades bump
+        # readers first)
+        raise ProtocolError(f"peer speaks wire version {version}")
+    if hlen > MAX_HEADER_BYTES:
+        raise ProtocolError(f"header too large ({hlen} bytes)")
+    if plen > MAX_PAYLOAD_BYTES:
+        raise ProtocolError(f"payload too large ({plen} bytes)")
+    # unknown FLAG bits are deliberately ignored (forward compat)
+    return version, flags, op, hlen, plen
+
+
+async def read_frame(reader) -> tuple:  # noqa: ANN001 — asyncio.StreamReader
+    """One ``(op, flags, header, payload)`` off the stream; raises
+    ``ProtocolError`` on violations and ``asyncio.IncompleteReadError``
+    on EOF."""
+    prefix = await reader.readexactly(PREFIX_LEN)
+    _version, flags, op, hlen, plen = decode_prefix(prefix)
+    head = await reader.readexactly(hlen) if hlen else b""
+    payload = await reader.readexactly(plen) if plen else b""
+    try:
+        header = json.loads(head) if head else {}
+    except ValueError as e:
+        raise ProtocolError(f"unparseable frame header: {e}") from e
+    return op, flags, header, payload
+
+
+# ---------------------------------------------------------- page payloads
+
+
+def pack_entries(items: list) -> bytes:
+    """``[(digest, arrays_tuple), ...]`` → concatenated length-prefixed
+    disk-format entry blobs (each self-describing and checksummed)."""
+    parts = []
+    for digest, arrays in items:
+        blob = serialize_entry(
+            tuple(arrays), {"kind": "kv", "digest": digest.hex()}
+        )
+        parts.append(_BLOB_LEN.pack(len(blob)))
+        parts.append(blob)
+    return b"".join(parts)
+
+
+def unpack_entries(payload: bytes) -> list:
+    """Concatenated length-prefixed entry blobs → ``[(digest, arrays),
+    ...]``, every blob validated through the SHARED disk read path
+    (``kv_tier.parse_entry``): a corrupt or unknown-version blob is
+    skipped — a network bit-flip reads as a miss, never served."""
+    out = []
+    pos = 0
+    n = len(payload)
+    while pos + _BLOB_LEN.size <= n:
+        (blen,) = _BLOB_LEN.unpack_from(payload, pos)
+        pos += _BLOB_LEN.size
+        if blen > n - pos:
+            break  # truncated tail: stop, serve what validated
+        got = parse_entry(payload[pos: pos + blen])
+        pos += blen
+        if got is None:
+            continue  # corrupt blob: dropped, exactly like disk
+        meta, arrays = got
+        digest_hex = meta.get("digest")
+        if not digest_hex:
+            continue
+        try:
+            digest = bytes.fromhex(digest_hex)
+        except ValueError:
+            continue
+        out.append((digest, arrays))
+    return out
+
+
+# ------------------------------------------------------- checkpoint codec
+
+
+def encode_params(p: SamplingParams) -> dict:
+    d = {f.name: getattr(p, f.name) for f in dataclasses.fields(p)}
+    d["output_kind"] = p.output_kind.value
+    if p.structured_outputs is not None:
+        d["structured_outputs"] = dataclasses.asdict(
+            p.structured_outputs
+        )
+    if p.length_penalty is not None:
+        d["length_penalty"] = list(p.length_penalty)
+    return d
+
+
+def decode_params(d: dict) -> SamplingParams:
+    d = dict(d)
+    d["output_kind"] = RequestOutputKind(int(d.get("output_kind", 0)))
+    so = d.get("structured_outputs")
+    if so is not None:
+        d["structured_outputs"] = StructuredOutputsParams(**so)
+    lp = d.get("length_penalty")
+    if lp is not None:
+        d["length_penalty"] = (int(lp[0]), float(lp[1]))
+    known = {f.name for f in dataclasses.fields(SamplingParams)}
+    return SamplingParams(
+        **{k: v for k, v in d.items() if k in known}
+    )
+
+
+def _encode_logprob_table(tbl: Optional[dict]) -> Optional[list]:
+    if tbl is None:
+        return None
+    return [
+        [int(tok), lp.logprob, lp.rank, lp.decoded_token]
+        for tok, lp in tbl.items()
+    ]
+
+
+def _decode_logprob_table(rows: Optional[list]) -> Optional[dict]:
+    if rows is None:
+        return None
+    return {
+        int(tok): Logprob(
+            logprob=lpv,
+            rank=None if rank is None else int(rank),
+            decoded_token=decoded,
+        )
+        for tok, lpv, rank, decoded in rows
+    }
+
+
+def _encode_logprobs(lst: Optional[list]) -> Optional[list]:
+    if lst is None:
+        return None
+    return [_encode_logprob_table(tbl) for tbl in lst]
+
+
+def _decode_logprobs(lst: Optional[list]) -> Optional[list]:
+    if lst is None:
+        return None
+    return [_decode_logprob_table(rows) for rows in lst]
+
+
+_CKPT_SCALARS = (
+    "request_id", "prompt", "prompt_token_ids", "output_token_ids",
+    "fallback_seed", "arrival_time", "deadline", "tenant_id",
+    "lora_name", "trace_id", "emitted_token_len", "emitted_text_len",
+    "stop_scan_pos", "first_scheduled_time", "first_token_time",
+    "last_token_time", "time_in_queue", "pages", "t0", "request_class",
+    "cancelled",
+)
+
+
+def encode_checkpoint(ckpt: DecodeCheckpoint) -> dict:
+    d = {name: getattr(ckpt, name) for name in _CKPT_SCALARS}
+    d["params"] = encode_params(ckpt.params)
+    d["digests"] = [dg.hex() for dg in ckpt.digests]
+    d["output_logprobs"] = _encode_logprobs(ckpt.output_logprobs)
+    d["prompt_logprobs"] = _encode_logprobs(ckpt.prompt_logprobs)
+    return d
+
+
+def decode_checkpoint(d: dict) -> DecodeCheckpoint:
+    kwargs = {name: d.get(name) for name in _CKPT_SCALARS}
+    kwargs["params"] = decode_params(d["params"])
+    kwargs["digests"] = [bytes.fromhex(h) for h in d.get("digests", [])]
+    kwargs["output_logprobs"] = _decode_logprobs(
+        d.get("output_logprobs")
+    )
+    kwargs["prompt_logprobs"] = _decode_logprobs(
+        d.get("prompt_logprobs")
+    )
+    return DecodeCheckpoint(**kwargs)
+
+
+# ----------------------------------------------------------- output codec
+
+
+def encode_request_output(out: RequestOutput) -> dict:
+    return {
+        "request_id": out.request_id,
+        "prompt": out.prompt,
+        "prompt_token_ids": list(out.prompt_token_ids or []),
+        "finished": bool(out.finished),
+        "prompt_logprobs": _encode_logprobs(out.prompt_logprobs),
+        "outputs": [
+            {
+                "index": c.index,
+                "text": c.text,
+                "token_ids": list(c.token_ids),
+                "cumulative_logprob": c.cumulative_logprob,
+                "logprobs": _encode_logprobs(c.logprobs),
+                "finish_reason": c.finish_reason,
+                "stop_reason": c.stop_reason,
+            }
+            for c in out.outputs
+        ],
+    }
+
+
+def decode_request_output(d: dict) -> RequestOutput:
+    return RequestOutput(
+        request_id=d["request_id"],
+        prompt=d.get("prompt"),
+        prompt_token_ids=list(d.get("prompt_token_ids") or []),
+        outputs=[
+            CompletionOutput(
+                index=int(c.get("index", 0)),
+                text=c.get("text", ""),
+                token_ids=list(c.get("token_ids") or []),
+                cumulative_logprob=c.get("cumulative_logprob"),
+                logprobs=_decode_logprobs(c.get("logprobs")),
+                finish_reason=c.get("finish_reason"),
+                stop_reason=c.get("stop_reason"),
+            )
+            for c in d.get("outputs", [])
+        ],
+        finished=bool(d.get("finished")),
+        prompt_logprobs=_decode_logprobs(d.get("prompt_logprobs")),
+    )

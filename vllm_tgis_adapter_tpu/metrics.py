@@ -524,6 +524,63 @@ handoff_seconds = _get_or_create(
     buckets=(0.001, 0.005, 0.02, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0),
 )
 
+# ---------------------------------------- networked KV tier (kvnet/,
+# docs/CROSS_HOST.md): cross-host prefix sharing + remote handoffs.
+# obs_check hard-gates every name here.
+
+kvnet_remote_lookups_total = _get_or_create(
+    Counter,
+    f"{_PREFIX}_kvnet_remote_lookups_total",
+    "KV page digests asked of kvnet peers during promotion assembly "
+    "(the remote rung's fetch fan-out, before hit/miss is known)",
+)
+kvnet_remote_hits_total = _get_or_create(
+    Counter,
+    f"{_PREFIX}_kvnet_remote_hits_total",
+    "KV pages served BY a kvnet peer into a local promotion "
+    "(checksum-validated entry blobs; each one is prefill compute "
+    "this host did not repeat)",
+)
+kvnet_remote_hit_ratio = _get_or_create(
+    Gauge,
+    f"{_PREFIX}_kvnet_remote_hit_ratio",
+    "Lifetime fraction of remote page lookups a peer actually served "
+    "(hits/lookups; 0 until the first remote promotion)",
+)
+kvnet_transfer_bytes_total = _get_or_create(
+    Counter,
+    f"{_PREFIX}_kvnet_transfer_bytes_total",
+    "Bytes of kvnet page/checkpoint payload moved over the wire, by "
+    "direction ('in' = received from peers, 'out' = sent to peers)",
+    labelnames=("direction",),
+)
+kvnet_peer_rtt_seconds = _get_or_create(
+    Gauge,
+    f"{_PREFIX}_kvnet_peer_rtt_seconds",
+    "EWMA round-trip time of kvnet requests, per peer address "
+    "(heartbeat PINGs keep it fresh while idle)",
+    labelnames=("peer",),
+)
+kvnet_peers = _get_or_create(
+    Gauge,
+    f"{_PREFIX}_kvnet_peers",
+    "Configured kvnet peers by degradation state: 'healthy' (serving), "
+    "'degraded' (recent failures, still answering), 'down' "
+    "(disconnected; coverage and handoffs skip it until the heartbeat "
+    "revives it)",
+    labelnames=("state",),
+)
+kvnet_handoffs_total = _get_or_create(
+    Counter,
+    f"{_PREFIX}_kvnet_handoffs_total",
+    "Cross-host DecodeCheckpoint handoffs by outcome: source side "
+    "'remote' (peer accepted decode) / 'stage_failed' / 'commit_lost' "
+    "/ 'rejected' / 'peer_lost'; target side 'staged' / 'accepted' / "
+    "'adopted' (machine-loss resume of a dead source's staged record) "
+    "/ 'validation' / 'no_replica' / 'resume'",
+    labelnames=("outcome",),
+)
+
 # ------------------------------------------------------ LoRA adapter pool
 
 lora_adapters_registered = _get_or_create(

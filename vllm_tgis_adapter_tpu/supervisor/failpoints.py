@@ -80,6 +80,14 @@ KNOWN_SITES = (
     #                         stage and the resume (docs/SCALING.md):
     #                         a raise here is the kill-prefill-replica-
     #                         mid-handoff chaos scenario
+    "kvnet.get",            # networked-tier page fetch (event loop;
+    #                         a raise = partition mid-promotion — the
+    #                         span truncates to local coverage)
+    "kvnet.put",            # networked-tier page mirror push (event
+    #                         loop; a raise = partition mid-demotion)
+    "kvnet.handoff",        # cross-host checkpoint stage+commit
+    #                         (docs/CROSS_HOST.md): a raise = partition
+    #                         mid-handoff — the local ladder continues
 )
 
 #: Sites that run in worker threads (asyncio.to_thread) — the only

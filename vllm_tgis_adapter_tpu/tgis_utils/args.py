@@ -256,6 +256,27 @@ def add_engine_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         "content-addressed and validated on read, so "
                         "it may survive restarts for cross-restart "
                         "reuse")
+    g.add_argument("--kvnet-listen", type=str, default=None,
+                   help="host:port for the networked KV tier's RPC "
+                        "service (docs/CROSS_HOST.md): cross-host "
+                        "prefix sharing, remote handoffs, and "
+                        "machine-loss resume over the disk-entry "
+                        "wire format (default: kvnet off; port 0 "
+                        "binds an ephemeral port)")
+    g.add_argument("--kvnet-peers", type=str, default=None,
+                   help="comma-separated host:port addresses of the "
+                        "other kvnet hosts; each peer's digest "
+                        "mirror extends prefix coverage fleet-wide "
+                        "and can accept cross-host handoffs")
+    g.add_argument("--kvnet-node-id", type=str, default=None,
+                   help="stable node identity in kvnet peer HELLOs "
+                        "(machine-loss adoption keys staged handoffs "
+                        "by it; default: derived from --kvnet-listen)")
+    g.add_argument("--kvnet-timeout", type=float, default=5.0,
+                   help="per-request deadline against a kvnet peer, "
+                        "seconds; bounded retry with backoff inside "
+                        "it, then graceful degradation to the local "
+                        "tiers")
     g.add_argument("--unified-arena",
                    action=argparse.BooleanOptionalAction, default=True,
                    help="one paged HBM arena for KV pages + adapter "
